@@ -1,0 +1,38 @@
+"""risingwave_tpu: a TPU-native distributed SQL streaming framework.
+
+A from-scratch re-design of the capabilities of RisingWave (reference:
+/root/reference, racevedoo/risingwave) for TPU hardware:
+
+- columnar ``DataChunk``/``StreamChunk`` batches living as JAX device arrays
+- stateful stream operators (hash join, hash agg) as jit/XLA/Pallas kernels
+  over device-resident hash tables
+- consistent-hash (256-vnode) data parallelism mapped onto a
+  ``jax.sharding.Mesh``; hash dispatch rides ICI collectives
+- Chandy-Lamport aligned-barrier checkpoints; an LSM state store
+  ("hummock-lite") over object storage
+- a PostgreSQL-flavoured SQL frontend compiling CREATE MATERIALIZED VIEW
+  into actor dataflow graphs
+
+Layering (mirrors SURVEY.md section 1):
+
+    common/      foundation: types, arrays, chunks, hashing, epochs, config
+    ops/         jit + pallas device kernels (vnode hash, hash tables, aggs)
+    state/       state store + relational StateTable (epoch MVCC)
+    stream/      executors, actors, barrier manager, exchange
+    parallel/    device mesh, shardings, collective dispatch
+    storage/     hummock-lite LSM over object store
+    frontend/    SQL parser -> binder -> planner -> fragmenter
+    meta/        catalog, DDL, global barrier manager, recovery, scaling
+    connectors/  sources (nexmark, datagen, kafka-shaped) and sinks
+    models/      pre-built flagship pipelines (nexmark q1/q7/q8, tpch)
+    utils/       logging, metrics, misc
+"""
+
+import jax
+
+# A streaming SQL engine needs real 64-bit ints (timestamps in ms, row ids).
+# JAX defaults to 32-bit; opt into x64 before any array is created. Hot-path
+# kernels still request bf16/f32/int32 explicitly where it matters for MXU/VPU.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

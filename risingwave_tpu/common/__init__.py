@@ -1,0 +1,29 @@
+"""Foundation layer: types, columnar chunks, hashing, epochs, config.
+
+Reference parity: src/common/ (types/mod.rs, array/, hash/, util/epoch.rs,
+config.rs) — re-designed for JAX device arrays rather than ported.
+"""
+
+from risingwave_tpu.common.types import DataType, Field, Schema
+from risingwave_tpu.common.chunk import DataChunk, StreamChunk, Op
+from risingwave_tpu.common.epoch import Epoch, EpochPair
+from risingwave_tpu.common.hash import VNODE_COUNT, VNODE_BITS, hash_columns, vnodes_of
+from risingwave_tpu.common.config import RwConfig, StreamingConfig, StorageConfig
+
+__all__ = [
+    "DataType",
+    "Field",
+    "Schema",
+    "DataChunk",
+    "StreamChunk",
+    "Op",
+    "Epoch",
+    "EpochPair",
+    "VNODE_COUNT",
+    "VNODE_BITS",
+    "hash_columns",
+    "vnodes_of",
+    "RwConfig",
+    "StreamingConfig",
+    "StorageConfig",
+]

@@ -1,0 +1,291 @@
+"""Columnar data batches: ``DataChunk`` and ``StreamChunk``.
+
+Reference parity: src/common/src/array/data_chunk.rs:65 and
+src/common/src/array/stream_chunk.rs:87.
+
+TPU-first design decisions (deliberately NOT a port of the Rust arrays):
+
+- A chunk is a set of fixed-capacity columns. Device-typed columns are JAX
+  arrays in HBM; varchar/bytea/jsonb columns stay on host as numpy object
+  arrays (strings never ship to the device).
+- Row validity is a single boolean *visibility* array (doubles as both the
+  reference's visibility bitmap and the padding mask). Capacity is padded to
+  a power-of-two bucket so XLA sees a small, stable set of static shapes —
+  this is how we live with dynamic row counts under jit (SURVEY.md section 7
+  "hard part 2").
+- Per-column null validity is an optional boolean array per column (None
+  means "no nulls").
+- ``StreamChunk`` adds an int8 ``ops`` vector with the 4 reference ops
+  (Insert/Delete/UpdateDelete/UpdateInsert); ``signs()`` maps them to +1/-1
+  which is what aggregation kernels actually consume.
+
+Kernels take raw arrays (``chunk.device_columns()``), not chunk objects —
+chunks are host-side bookkeeping, arrays are the jit boundary.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.common.types import DataType, Field, Schema
+
+
+def next_pow2(n: int, floor: int = 8) -> int:
+    """Pad row counts to power-of-two buckets to bound jit recompilation."""
+    c = floor
+    while c < n:
+        c <<= 1
+    return c
+
+
+class Op(enum.IntEnum):
+    """Row operation in a stream chunk (stream_chunk.rs:29-ish semantics)."""
+
+    INSERT = 1
+    DELETE = 2
+    UPDATE_DELETE = 3
+    UPDATE_INSERT = 4
+
+    @property
+    def is_insert(self) -> bool:
+        return self in (Op.INSERT, Op.UPDATE_INSERT)
+
+    @property
+    def sign(self) -> int:
+        return 1 if self.is_insert else -1
+
+
+# Vectorized op→sign: ops in {1,2,3,4}; insert-ish ops are odd (1) or 4.
+def ops_to_signs(ops: jnp.ndarray) -> jnp.ndarray:
+    """+1 for INSERT/UPDATE_INSERT, -1 for DELETE/UPDATE_DELETE (int32)."""
+    is_ins = (ops == Op.INSERT) | (ops == Op.UPDATE_INSERT)
+    return jnp.where(is_ins, jnp.int32(1), jnp.int32(-1))
+
+
+@dataclass
+class Column:
+    """One column: device JAX array or host numpy object array + null mask."""
+
+    data_type: DataType
+    values: Union[jnp.ndarray, np.ndarray]
+    validity: Optional[Union[jnp.ndarray, np.ndarray]] = None  # True = non-null
+
+    @property
+    def is_device(self) -> bool:
+        return self.data_type.is_device
+
+    def __len__(self) -> int:
+        return int(self.values.shape[0])
+
+    def take_host(self, idx: np.ndarray) -> "Column":
+        vals = np.asarray(self.values)[idx]
+        val = None if self.validity is None else np.asarray(self.validity)[idx]
+        return Column(self.data_type, vals if not self.is_device
+                      else jnp.asarray(vals), None if val is None
+                      else (val if not self.is_device else jnp.asarray(val)))
+
+
+def _make_column(dt: DataType, values, capacity: int,
+                 validity=None) -> Column:
+    """Build a column from python/numpy values, padded to `capacity`."""
+    n = len(values)
+    if dt.is_device:
+        arr = np.zeros(capacity, dtype=dt.np_dtype)
+        if n:
+            vs = [v if v is not None else 0 for v in values] \
+                if isinstance(values, list) else values
+            arr[:n] = np.asarray(vs, dtype=dt.np_dtype)
+        out_validity = None
+        nulls = [i for i, v in enumerate(values) if v is None] \
+            if isinstance(values, list) else []
+        if validity is not None or nulls:
+            val = np.ones(capacity, dtype=bool)
+            if validity is not None:
+                val[:n] = np.asarray(validity, dtype=bool)
+            for i in nulls:
+                val[i] = False
+            out_validity = jnp.asarray(val)
+        return Column(dt, jnp.asarray(arr), out_validity)
+    else:
+        arr = np.empty(capacity, dtype=object)
+        for i in range(n):
+            arr[i] = values[i]
+        out_validity = None
+        if validity is not None:
+            val = np.ones(capacity, dtype=bool)
+            val[:n] = np.asarray(validity, dtype=bool)
+            out_validity = val
+        return Column(dt, arr, out_validity)
+
+
+class DataChunk:
+    """A batch of columns + visibility mask (data_chunk.rs:65 analog)."""
+
+    def __init__(self, schema: Schema, columns: Sequence[Column],
+                 visibility: jnp.ndarray):
+        assert len(schema) == len(columns), (len(schema), len(columns))
+        self.schema = schema
+        self.columns: Tuple[Column, ...] = tuple(columns)
+        self.visibility = visibility  # jnp bool [capacity]
+        cap = int(visibility.shape[0])
+        for c in self.columns:
+            assert int(c.values.shape[0]) == cap, "column capacity mismatch"
+        self._capacity = cap
+
+    # -- constructors --------------------------------------------------
+    @staticmethod
+    def from_pydict(schema: Schema, data: Dict[str, list],
+                    capacity: Optional[int] = None) -> "DataChunk":
+        ncols = [data[f.name] for f in schema]
+        n = len(ncols[0]) if ncols else 0
+        cap = capacity or next_pow2(max(n, 1))
+        cols = [_make_column(f.data_type, vals, cap)
+                for f, vals in zip(schema, ncols)]
+        vis = np.zeros(cap, dtype=bool)
+        vis[:n] = True
+        return DataChunk(schema, cols, jnp.asarray(vis))
+
+    @staticmethod
+    def from_arrays(schema: Schema, arrays: Sequence, num_rows: int,
+                    capacity: Optional[int] = None) -> "DataChunk":
+        """From ready-made (device or host) arrays, all already `capacity`-long."""
+        cols = [Column(f.data_type, a) for f, a in zip(schema, arrays)]
+        cap = int(arrays[0].shape[0]) if arrays else (capacity or 8)
+        vis = np.zeros(cap, dtype=bool)
+        vis[:num_rows] = True
+        return DataChunk(schema, cols, jnp.asarray(vis))
+
+    @staticmethod
+    def empty(schema: Schema, capacity: int = 8) -> "DataChunk":
+        return DataChunk.from_pydict(schema, {f.name: [] for f in schema},
+                                     capacity=capacity)
+
+    # -- properties ----------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    def cardinality(self) -> int:
+        """Number of visible rows (host sync)."""
+        return int(jnp.sum(self.visibility))
+
+    def column(self, name: str) -> Column:
+        return self.columns[self.schema.index_of(name)]
+
+    def column_values(self, name: str):
+        return self.columns[self.schema.index_of(name)].values
+
+    def device_columns(self) -> List[jnp.ndarray]:
+        return [c.values for c in self.columns if c.is_device]
+
+    # -- transforms ----------------------------------------------------
+    def project(self, indices: Sequence[int]) -> "DataChunk":
+        return DataChunk(self.schema.select(indices),
+                         [self.columns[i] for i in indices], self.visibility)
+
+    def with_visibility(self, vis: jnp.ndarray) -> "DataChunk":
+        return DataChunk(self.schema, self.columns, vis)
+
+    def mask(self, predicate: jnp.ndarray) -> "DataChunk":
+        return self.with_visibility(self.visibility & predicate)
+
+    def with_columns(self, schema: Schema,
+                     columns: Sequence[Column]) -> "DataChunk":
+        return DataChunk(schema, columns, self.visibility)
+
+    # -- host materialization (tests, result sets, sinks) --------------
+    def to_pylist(self, compact: bool = True) -> List[tuple]:
+        vis = np.asarray(self.visibility)
+        host_cols = []
+        for c in self.columns:
+            vals = np.asarray(c.values)
+            val = None if c.validity is None else np.asarray(c.validity)
+            host_cols.append((vals, val, c.data_type))
+        rows = []
+        for i in range(self._capacity):
+            if compact and not vis[i]:
+                continue
+            row = []
+            for vals, val, dt in host_cols:
+                if val is not None and not val[i]:
+                    row.append(None)
+                else:
+                    v = vals[i]
+                    if dt.is_device:
+                        v = v.item() if hasattr(v, "item") else v
+                        if dt == DataType.BOOLEAN:
+                            v = bool(v)
+                    row.append(v)
+            rows.append(tuple(row))
+        return rows
+
+    def __repr__(self) -> str:
+        return (f"DataChunk(cap={self._capacity}, "
+                f"rows={self.cardinality()}, schema={self.schema})")
+
+
+class StreamChunk(DataChunk):
+    """DataChunk + per-row Op vector (stream_chunk.rs:87 analog)."""
+
+    def __init__(self, schema: Schema, columns: Sequence[Column],
+                 visibility: jnp.ndarray, ops: jnp.ndarray):
+        super().__init__(schema, columns, visibility)
+        assert int(ops.shape[0]) == self._capacity
+        self.ops = ops  # jnp int8 [capacity]
+
+    @staticmethod
+    def from_pydict(schema: Schema, data: Dict[str, list],
+                    ops: Optional[Sequence[int]] = None,
+                    capacity: Optional[int] = None) -> "StreamChunk":
+        base = DataChunk.from_pydict(schema, data, capacity=capacity)
+        n = len(next(iter(data.values()))) if data else 0
+        o = np.full(base.capacity, int(Op.INSERT), dtype=np.int8)
+        if ops is not None:
+            o[:n] = np.asarray([int(x) for x in ops], dtype=np.int8)
+        return StreamChunk(schema, base.columns, base.visibility,
+                           jnp.asarray(o))
+
+    @staticmethod
+    def from_data_chunk(chunk: DataChunk,
+                        ops: Optional[jnp.ndarray] = None) -> "StreamChunk":
+        o = ops if ops is not None else jnp.full(
+            chunk.capacity, int(Op.INSERT), dtype=jnp.int8)
+        return StreamChunk(chunk.schema, chunk.columns, chunk.visibility, o)
+
+    def signs(self) -> jnp.ndarray:
+        """+1/-1 per row (masked rows included; gate with visibility)."""
+        return ops_to_signs(self.ops)
+
+    def project(self, indices: Sequence[int]) -> "StreamChunk":
+        return StreamChunk(self.schema.select(indices),
+                           [self.columns[i] for i in indices],
+                           self.visibility, self.ops)
+
+    def with_visibility(self, vis: jnp.ndarray) -> "StreamChunk":
+        return StreamChunk(self.schema, self.columns, vis, self.ops)
+
+    def with_columns(self, schema: Schema,
+                     columns: Sequence[Column]) -> "StreamChunk":
+        return StreamChunk(schema, columns, self.visibility, self.ops)
+
+    def to_records(self, compact: bool = True) -> List[tuple]:
+        """[(Op, row-tuple)] for visible rows."""
+        vis = np.asarray(self.visibility)
+        ops = np.asarray(self.ops)
+        rows = super().to_pylist(compact=False)
+        out = []
+        for i, row in enumerate(rows):
+            if compact and not vis[i]:
+                continue
+            out.append((Op(int(ops[i])), row))
+        return out
+
+    def __repr__(self) -> str:
+        return (f"StreamChunk(cap={self._capacity}, "
+                f"rows={self.cardinality()}, schema={self.schema})")

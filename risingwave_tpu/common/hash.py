@@ -1,0 +1,158 @@
+"""Consistent hashing: 256 virtual nodes, jittable hash kernels.
+
+Reference parity: src/common/src/hash/consistent_hash/vnode.rs:54-57
+(VirtualNode::BITS=8, COUNT=256, Crc32 of distribution keys) and
+src/common/src/hash/key.rs (HashKey). TPU-first re-design: instead of Crc32
+over row-serialized keys (a per-row scalar loop), we use a vectorized
+integer mix (murmur3 finalizer) over the key columns — the whole chunk is
+hashed in one VPU pass. The exact hash need not match the reference; only
+the *consistency* property matters (same key → same vnode everywhere).
+
+``vnodes_of`` is the routing primitive used by both the hash dispatcher
+(dispatch.rs:645 analog) and state-table key partitioning.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+VNODE_BITS = 8
+VNODE_COUNT = 1 << VNODE_BITS  # 256, matches reference vnode.rs:56
+
+
+def _mix32(x: jnp.ndarray) -> jnp.ndarray:
+    """Murmur3 fmix32 — good avalanche, 5 VPU ops, uint32 in/out."""
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _to_u32_lanes(col: jnp.ndarray) -> List[jnp.ndarray]:
+    """Decompose a column into one or two uint32 lanes for hashing."""
+    dt = col.dtype
+    if dt == jnp.bool_:
+        return [col.astype(jnp.uint32)]
+    if jnp.issubdtype(dt, jnp.floating):
+        # Hash the bit pattern; normalize -0.0 to 0.0 first.
+        col = jnp.where(col == 0, jnp.zeros_like(col), col)
+        # Hash the f32 bit pattern even for f64 keys: the TPU x64-rewrite
+        # pass has no f64<->u64 bitcast, and a hash only needs consistency —
+        # nearby-double collisions are resolved by full-key equality checks.
+        bits = jax.lax.bitcast_convert_type(col.astype(jnp.float32), jnp.uint32)
+        return [bits]
+    if dt.itemsize <= 4:
+        return [col.astype(jnp.uint32)]
+    u = col.astype(jnp.uint64)
+    return [(u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32),
+            (u >> jnp.uint64(32)).astype(jnp.uint32)]
+
+
+def hash_columns(cols: Sequence[jnp.ndarray],
+                 seed: int = 0x9E3779B9) -> jnp.ndarray:
+    """Vectorized row hash over key columns → uint32 [n].
+
+    Combine rule is boost-style hash_combine folded through fmix32, applied
+    lane-wise; all columns must share the leading dimension.
+    """
+    assert len(cols) > 0, "hash_columns needs at least one key column"
+    n = cols[0].shape[0]
+    h = jnp.full((n,), jnp.uint32(seed))
+    for col in cols:
+        for lane in _to_u32_lanes(col):
+            h = _mix32(h ^ (lane + jnp.uint32(0x9E3779B9) +
+                            (h << 6) + (h >> 2)))
+    return h
+
+
+def vnodes_of(cols: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """Row → vnode in [0, 256) (VirtualNode::compute_chunk analog)."""
+    return (hash_columns(cols) & jnp.uint32(VNODE_COUNT - 1)).astype(jnp.int32)
+
+
+def hash_strings_host(values: np.ndarray, n: int) -> np.ndarray:
+    """Host-side stable hash for varchar key columns → uint32 [n].
+
+    Strings never ship to device; when a distribution key includes a varchar
+    column we hash it on host (cheap vs. the device work) and feed the lane
+    into `hash_columns` as a uint32 column.
+    """
+    import zlib
+    out = np.zeros(len(values), dtype=np.uint32)
+    for i in range(n):
+        v = values[i]
+        if v is not None:
+            out[i] = zlib.crc32(v.encode() if isinstance(v, str) else bytes(v))
+    return out
+
+
+class VnodeMapping:
+    """vnode → owner (actor or worker) mapping with rebalance support.
+
+    Reference parity: src/common/src/hash/consistent_hash/mapping.rs
+    (ActorMapping / WorkerMapping) and the bitmap math in
+    src/meta/src/stream/scale.rs:174. Stored dense: int32[256].
+    """
+
+    def __init__(self, owners: np.ndarray):
+        owners = np.asarray(owners, dtype=np.int32)
+        assert owners.shape == (VNODE_COUNT,)
+        self.owners = owners
+
+    @staticmethod
+    def new_uniform(num_owners: int) -> "VnodeMapping":
+        """Contiguous even split of 256 vnodes over `num_owners`."""
+        assert num_owners >= 1
+        base = VNODE_COUNT // num_owners
+        rem = VNODE_COUNT % num_owners
+        owners = np.repeat(np.arange(num_owners, dtype=np.int32),
+                           np.asarray([base + (i < rem)
+                                       for i in range(num_owners)]))
+        return VnodeMapping(owners)
+
+    def owner_of(self, vnode: int) -> int:
+        return int(self.owners[vnode])
+
+    def bitmap_of(self, owner: int) -> np.ndarray:
+        """bool[256] ownership bitmap for one owner (state-table vnodes)."""
+        return self.owners == owner
+
+    def num_owners(self) -> int:
+        return int(self.owners.max()) + 1 if len(self.owners) else 0
+
+    def rebalance(self, new_num_owners: int) -> "VnodeMapping":
+        """Minimal-movement rebalance to a new owner count.
+
+        Mirrors rebalance_actor_vnode (scale.rs:174): move just enough
+        vnodes from over-loaded owners to under-loaded ones.
+        """
+        target = [VNODE_COUNT // new_num_owners +
+                  (i < VNODE_COUNT % new_num_owners)
+                  for i in range(new_num_owners)]
+        owners = self.owners.copy()
+        # Clamp removed owners to -1 (to be redistributed).
+        owners[owners >= new_num_owners] = -1
+        counts = [int((owners == i).sum()) for i in range(new_num_owners)]
+        surplus: List[int] = []  # vnode indices to reassign
+        for i in range(new_num_owners):
+            if counts[i] > target[i]:
+                idxs = np.flatnonzero(owners == i)[: counts[i] - target[i]]
+                surplus.extend(idxs.tolist())
+        surplus.extend(np.flatnonzero(owners == -1).tolist())
+        k = 0
+        for i in range(new_num_owners):
+            while counts[i] < target[i]:
+                owners[surplus[k]] = i
+                counts[i] += 1
+                k += 1
+        return VnodeMapping(owners)
+
+    def to_device(self) -> jnp.ndarray:
+        return jnp.asarray(self.owners)

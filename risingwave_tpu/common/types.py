@@ -1,0 +1,173 @@
+"""Logical SQL types and their physical device representation.
+
+Reference parity: ``DataType`` in src/common/src/types/mod.rs:99-160 (17 SQL
+types). TPU-first design: every type picks a *physical* representation that is
+either a JAX dtype (device-resident, participates in kernels) or a host-side
+object column (varchar/jsonb — strings never ship to the device; they are
+dictionary-encoded or carried on host alongside the device columns).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataType(enum.Enum):
+    """Logical SQL data types (reference: src/common/src/types/mod.rs:99)."""
+
+    BOOLEAN = "boolean"
+    INT16 = "smallint"
+    INT32 = "int"
+    INT64 = "bigint"
+    FLOAT32 = "real"
+    FLOAT64 = "double precision"
+    DECIMAL = "numeric"          # physical: float64 (documented precision loss) v0
+    DATE = "date"                # days since epoch, int32
+    TIME = "time"                # microseconds since midnight, int64
+    TIMESTAMP = "timestamp"      # microseconds since unix epoch, int64
+    TIMESTAMPTZ = "timestamptz"  # microseconds since unix epoch (UTC), int64
+    INTERVAL = "interval"        # microseconds, int64 (months/days folded) v0
+    VARCHAR = "varchar"          # host column (numpy object)
+    BYTEA = "bytea"              # host column
+    JSONB = "jsonb"              # host column
+    SERIAL = "serial"            # int64 row id
+    # STRUCT / LIST handled as composite Schema-level features later rounds.
+
+    # ------------------------------------------------------------------
+    @property
+    def is_device(self) -> bool:
+        """Whether columns of this type live on device (JAX array)."""
+        return self not in _HOST_TYPES
+
+    @property
+    def dtype(self) -> Optional[jnp.dtype]:
+        """Physical JAX dtype for device types; None for host types."""
+        return _PHYSICAL.get(self)
+
+    @property
+    def np_dtype(self):
+        d = _PHYSICAL.get(self)
+        return np.dtype(object) if d is None else np.dtype(d)
+
+    @property
+    def is_numeric(self) -> bool:
+        return self in (
+            DataType.INT16, DataType.INT32, DataType.INT64,
+            DataType.FLOAT32, DataType.FLOAT64, DataType.DECIMAL,
+        )
+
+    @property
+    def is_integral(self) -> bool:
+        return self in (DataType.INT16, DataType.INT32, DataType.INT64,
+                        DataType.SERIAL)
+
+    def zero_value(self):
+        """Padding value used in fixed-capacity device buffers."""
+        if self.is_device:
+            return np.zeros((), dtype=self.np_dtype)[()]
+        return None
+
+    @staticmethod
+    def from_sql(name: str) -> "DataType":
+        return _SQL_NAMES[name.strip().lower()]
+
+
+_HOST_TYPES = frozenset({DataType.VARCHAR, DataType.BYTEA, DataType.JSONB})
+
+_PHYSICAL = {
+    DataType.BOOLEAN: jnp.bool_,
+    DataType.INT16: jnp.int16,
+    DataType.INT32: jnp.int32,
+    DataType.INT64: jnp.int64,
+    DataType.FLOAT32: jnp.float32,
+    DataType.FLOAT64: jnp.float64,
+    DataType.DECIMAL: jnp.float64,
+    DataType.DATE: jnp.int32,
+    DataType.TIME: jnp.int64,
+    DataType.TIMESTAMP: jnp.int64,
+    DataType.TIMESTAMPTZ: jnp.int64,
+    DataType.INTERVAL: jnp.int64,
+    DataType.SERIAL: jnp.int64,
+}
+
+_SQL_NAMES = {
+    "boolean": DataType.BOOLEAN, "bool": DataType.BOOLEAN,
+    "smallint": DataType.INT16, "int2": DataType.INT16,
+    "int": DataType.INT32, "integer": DataType.INT32, "int4": DataType.INT32,
+    "bigint": DataType.INT64, "int8": DataType.INT64,
+    "real": DataType.FLOAT32, "float4": DataType.FLOAT32,
+    "double precision": DataType.FLOAT64, "double": DataType.FLOAT64,
+    "float8": DataType.FLOAT64, "float": DataType.FLOAT64,
+    "numeric": DataType.DECIMAL, "decimal": DataType.DECIMAL,
+    "date": DataType.DATE,
+    "time": DataType.TIME,
+    "timestamp": DataType.TIMESTAMP,
+    "timestamptz": DataType.TIMESTAMPTZ,
+    "timestamp with time zone": DataType.TIMESTAMPTZ,
+    "interval": DataType.INTERVAL,
+    "varchar": DataType.VARCHAR, "text": DataType.VARCHAR,
+    "string": DataType.VARCHAR, "character varying": DataType.VARCHAR,
+    "bytea": DataType.BYTEA,
+    "jsonb": DataType.JSONB,
+    "serial": DataType.SERIAL,
+}
+
+
+@dataclass(frozen=True)
+class Field:
+    """A named, typed column (reference: src/common/src/catalog/field-like)."""
+
+    name: str
+    data_type: DataType
+
+    def __repr__(self) -> str:
+        return f"{self.name}:{self.data_type.name.lower()}"
+
+
+@dataclass(frozen=True)
+class Schema:
+    """Ordered list of fields describing a chunk/table/executor output."""
+
+    fields: Tuple[Field, ...] = field(default_factory=tuple)
+
+    def __init__(self, fields):
+        object.__setattr__(self, "fields", tuple(fields))
+
+    @staticmethod
+    def of(**cols: DataType) -> "Schema":
+        return Schema([Field(n, t) for n, t in cols.items()])
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+    def __getitem__(self, i: int) -> Field:
+        return self.fields[i]
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def index_of(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(name)
+
+    def names(self):
+        return [f.name for f in self.fields]
+
+    def types(self):
+        return [f.data_type for f in self.fields]
+
+    def select(self, indices) -> "Schema":
+        return Schema([self.fields[i] for i in indices])
+
+    def concat(self, other: "Schema") -> "Schema":
+        return Schema(list(self.fields) + list(other.fields))
+
+    def __repr__(self) -> str:
+        return "Schema(" + ", ".join(map(repr, self.fields)) + ")"

@@ -1,0 +1,25 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX import.
+
+Mirrors the reference's testing stance (SURVEY.md section 4): executor tests
+run against in-memory fakes; multi-chip sharding is validated on virtual CPU
+devices (`--xla_force_host_platform_device_count=8`) — JAX-on-CPU stands in
+for the TPU mesh. Real-TPU benchmarking happens only in bench.py.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,148 @@
+"""Foundation tests: types, chunks, hashing, epochs, config."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from risingwave_tpu.common import (
+    DataChunk, DataType, Epoch, EpochPair, Op, Schema, StreamChunk,
+    VNODE_COUNT, hash_columns, vnodes_of,
+)
+from risingwave_tpu.common.chunk import next_pow2, ops_to_signs
+from risingwave_tpu.common.hash import VnodeMapping
+from risingwave_tpu.common.types import Field
+
+
+def test_datatype_mapping():
+    assert DataType.INT64.dtype == jnp.int64
+    assert DataType.TIMESTAMP.dtype == jnp.int64
+    assert DataType.VARCHAR.dtype is None
+    assert not DataType.VARCHAR.is_device
+    assert DataType.from_sql("BIGINT") == DataType.INT64
+    assert DataType.from_sql("character varying") == DataType.VARCHAR
+
+
+def test_schema():
+    s = Schema.of(a=DataType.INT64, b=DataType.VARCHAR)
+    assert len(s) == 2
+    assert s.index_of("b") == 1
+    assert s.select([1]).names() == ["b"]
+    s2 = s.concat(Schema([Field("c", DataType.FLOAT64)]))
+    assert s2.names() == ["a", "b", "c"]
+
+
+def test_next_pow2():
+    assert next_pow2(1) == 8
+    assert next_pow2(8) == 8
+    assert next_pow2(9) == 16
+    assert next_pow2(4096) == 4096
+
+
+def test_data_chunk_roundtrip():
+    s = Schema.of(id=DataType.INT64, name=DataType.VARCHAR,
+                  price=DataType.FLOAT64)
+    c = DataChunk.from_pydict(
+        s, {"id": [1, 2, 3], "name": ["a", "b", None], "price": [1.5, 2.5, 3.5]})
+    assert c.capacity == 8
+    assert c.cardinality() == 3
+    assert c.to_pylist() == [(1, "a", 1.5), (2, "b", 2.5), (3, None, 3.5)]
+
+
+def test_data_chunk_nulls_device():
+    s = Schema.of(x=DataType.INT32)
+    c = DataChunk.from_pydict(s, {"x": [5, None, 7]})
+    assert c.to_pylist() == [(5,), (None,), (7,)]
+
+
+def test_visibility_mask():
+    s = Schema.of(x=DataType.INT64)
+    c = DataChunk.from_pydict(s, {"x": [1, 2, 3, 4]})
+    pred = c.column_values("x") % 2 == 0
+    c2 = c.mask(pred)
+    assert c2.to_pylist() == [(2,), (4,)]
+    assert c.cardinality() == 4  # original untouched
+
+
+def test_stream_chunk_ops_and_signs():
+    s = Schema.of(x=DataType.INT64)
+    c = StreamChunk.from_pydict(
+        s, {"x": [1, 2, 2, 3]},
+        ops=[Op.INSERT, Op.UPDATE_DELETE, Op.UPDATE_INSERT, Op.DELETE])
+    recs = c.to_records()
+    assert recs == [(Op.INSERT, (1,)), (Op.UPDATE_DELETE, (2,)),
+                    (Op.UPDATE_INSERT, (2,)), (Op.DELETE, (3,))]
+    signs = np.asarray(ops_to_signs(c.ops))[:4]
+    assert signs.tolist() == [1, -1, 1, -1]
+
+
+def test_stream_chunk_project():
+    s = Schema.of(a=DataType.INT64, b=DataType.INT64)
+    c = StreamChunk.from_pydict(s, {"a": [1], "b": [2]})
+    p = c.project([1])
+    assert p.schema.names() == ["b"]
+    assert p.to_records() == [(Op.INSERT, (2,))]
+
+
+def test_hash_consistency_and_spread():
+    keys = jnp.arange(10_000, dtype=jnp.int64)
+    h1 = hash_columns([keys])
+    h2 = hash_columns([keys])
+    assert np.array_equal(np.asarray(h1), np.asarray(h2))
+    vn = np.asarray(vnodes_of([keys]))
+    assert vn.min() >= 0 and vn.max() < VNODE_COUNT
+    counts = np.bincount(vn, minlength=VNODE_COUNT)
+    # roughly uniform: each vnode ~39 rows, allow wide tolerance
+    assert counts.min() > 5 and counts.max() < 120
+
+
+def test_hash_multi_column_and_floats():
+    a = jnp.asarray([1, 1, 2], dtype=jnp.int64)
+    b = jnp.asarray([1.0, 2.0, 1.0], dtype=jnp.float64)
+    h = np.asarray(hash_columns([a, b]))
+    assert h[0] != h[1] and h[0] != h[2]
+    # -0.0 and 0.0 must hash identically
+    z = np.asarray(hash_columns([jnp.asarray([0.0, -0.0])]))
+    assert z[0] == z[1]
+
+
+def test_vnode_mapping_uniform_and_rebalance():
+    m = VnodeMapping.new_uniform(4)
+    counts = np.bincount(m.owners, minlength=4)
+    assert counts.tolist() == [64, 64, 64, 64]
+    m2 = m.rebalance(5)
+    c2 = np.bincount(m2.owners, minlength=5)
+    assert sorted(c2.tolist()) == [51, 51, 51, 51, 52]
+    # minimal movement: at most the vnodes needed by the new owner moved
+    moved = int((m.owners != m2.owners).sum())
+    assert moved == 51  # exactly the new owner's target share moved
+    m3 = m2.rebalance(2)
+    c3 = np.bincount(m3.owners, minlength=2)
+    assert c3.tolist() == [128, 128]
+
+
+def test_epoch():
+    e = Epoch.from_physical(1000)
+    assert e.physical_ms == 1000
+    assert e.value == 1000 << 16
+    e2 = e.next()
+    assert e2.value > e.value
+    p = EpochPair.new_initial(e)
+    assert p.prev == Epoch.INVALID
+    p2 = p.advance(e2)
+    assert p2.prev == e and p2.curr == e2
+
+
+def test_config_defaults_and_toml(tmp_path):
+    from risingwave_tpu.common.config import RwConfig
+    cfg = RwConfig()
+    assert cfg.meta.barrier_interval_ms == 1000
+    toml = tmp_path / "rw.toml"
+    toml.write_text("[meta]\nbarrier_interval_ms = 250\n"
+                    "[streaming]\nchunk_capacity = 1024\n")
+    cfg2 = RwConfig.from_toml(str(toml),
+                              overrides={"meta.checkpoint_frequency": 5})
+    assert cfg2.meta.barrier_interval_ms == 250
+    assert cfg2.streaming.chunk_capacity == 1024
+    assert cfg2.meta.checkpoint_frequency == 5
+    sp2 = cfg2.system.set("checkpoint_frequency", 10)
+    assert sp2.version == cfg2.system.version + 1
