@@ -4,24 +4,38 @@ Reference parity: src/common/ (types/mod.rs, array/, hash/, util/epoch.rs,
 config.rs) — re-designed for JAX device arrays rather than ported.
 """
 
-from risingwave_tpu.common.types import DataType, Field, Schema
-from risingwave_tpu.common.chunk import DataChunk, StreamChunk, Op
-from risingwave_tpu.common.epoch import Epoch, EpochPair
-from risingwave_tpu.common.hash import VNODE_COUNT, VNODE_BITS, hash_columns, vnodes_of
+from risingwave_tpu.common.types import (
+    DataType, Field, Interval, Schema, DECIMAL_SCALE, decimal_to_scaled,
+    scaled_to_decimal,
+)
+from risingwave_tpu.common.chunk import Column, DataChunk, StreamChunk, Op
+from risingwave_tpu.common.epoch import Epoch, EpochPair, set_clock
+from risingwave_tpu.common.hash import (
+    VNODE_COUNT, VNODE_BITS, VnodeMapping, hash_columns, hash_strings_host,
+    vnodes_of,
+)
 from risingwave_tpu.common.config import RwConfig, StreamingConfig, StorageConfig
 
 __all__ = [
     "DataType",
     "Field",
+    "Interval",
     "Schema",
+    "DECIMAL_SCALE",
+    "decimal_to_scaled",
+    "scaled_to_decimal",
+    "Column",
     "DataChunk",
     "StreamChunk",
     "Op",
     "Epoch",
     "EpochPair",
+    "set_clock",
     "VNODE_COUNT",
     "VNODE_BITS",
+    "VnodeMapping",
     "hash_columns",
+    "hash_strings_host",
     "vnodes_of",
     "RwConfig",
     "StreamingConfig",

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from risingwave_tpu.common import types as types_mod
 from risingwave_tpu.common.types import DataType, Field, Schema
 
 
@@ -93,29 +94,57 @@ class Column:
 
 def _make_column(dt: DataType, values, capacity: int,
                  validity=None) -> Column:
-    """Build a column from python/numpy values, padded to `capacity`."""
+    """Build a column from python/numpy values, padded to `capacity`.
+
+    Vectorized: numpy-array inputs take the zero-copy fast path; python-list
+    inputs do one object-array pass for null detection (test construction
+    only — the ingest hot path feeds ``DataChunk.from_arrays`` with ready
+    numpy arrays, never lists).
+    """
     n = len(values)
+    if n > capacity:
+        raise ValueError(f"{n} values exceed column capacity {capacity}")
     if dt.is_device:
         arr = np.zeros(capacity, dtype=dt.np_dtype)
+        null_mask = None
         if n:
-            vs = [v if v is not None else 0 for v in values] \
-                if isinstance(values, list) else values
-            arr[:n] = np.asarray(vs, dtype=dt.np_dtype)
+            if isinstance(values, np.ndarray) and values.dtype != object:
+                if dt == DataType.DECIMAL:
+                    # logical-value ingest of decimals: scale, vectorized
+                    # (raw scaled-int arrays enter via from_arrays, not here)
+                    if np.issubdtype(values.dtype, np.integer):
+                        arr[:n] = values.astype(np.int64) * \
+                            types_mod.DECIMAL_SCALE
+                    else:
+                        arr[:n] = np.rint(values * types_mod.DECIMAL_SCALE)
+                else:
+                    arr[:n] = values.astype(dt.np_dtype)
+            else:
+                obj = np.asarray(values, dtype=object)
+                null_mask = obj == None  # noqa: E711  (elementwise)
+                if null_mask.any():
+                    obj = obj.copy()
+                    obj[null_mask] = 0
+                else:
+                    null_mask = None
+                if dt == DataType.DECIMAL:
+                    obj = np.asarray(
+                        [types_mod.decimal_to_scaled(v) for v in obj],
+                        dtype=object)
+                arr[:n] = obj.astype(dt.np_dtype)
         out_validity = None
-        nulls = [i for i, v in enumerate(values) if v is None] \
-            if isinstance(values, list) else []
-        if validity is not None or nulls:
+        if validity is not None or null_mask is not None:
             val = np.ones(capacity, dtype=bool)
             if validity is not None:
                 val[:n] = np.asarray(validity, dtype=bool)
-            for i in nulls:
-                val[i] = False
+            if null_mask is not None:
+                val[:n] &= ~null_mask
             out_validity = jnp.asarray(val)
         return Column(dt, jnp.asarray(arr), out_validity)
     else:
         arr = np.empty(capacity, dtype=object)
-        for i in range(n):
-            arr[i] = values[i]
+        # fromiter keeps tuple/list elements scalar (STRUCT/LIST columns)
+        arr[:n] = np.fromiter(values, dtype=object, count=n)
         out_validity = None
         if validity is not None:
             val = np.ones(capacity, dtype=bool)
@@ -157,14 +186,19 @@ class DataChunk:
         """From ready-made (device or host) arrays, all already `capacity`-long."""
         cols = [Column(f.data_type, a) for f, a in zip(schema, arrays)]
         cap = int(arrays[0].shape[0]) if arrays else (capacity or 8)
+        if capacity is not None and arrays and capacity != cap:
+            raise ValueError(
+                f"capacity={capacity} disagrees with array length {cap}")
+        if num_rows > cap:
+            raise ValueError(f"num_rows={num_rows} exceeds capacity {cap}")
         vis = np.zeros(cap, dtype=bool)
         vis[:num_rows] = True
         return DataChunk(schema, cols, jnp.asarray(vis))
 
-    @staticmethod
-    def empty(schema: Schema, capacity: int = 8) -> "DataChunk":
-        return DataChunk.from_pydict(schema, {f.name: [] for f in schema},
-                                     capacity=capacity)
+    @classmethod
+    def empty(cls, schema: Schema, capacity: int = 8) -> "DataChunk":
+        return cls.from_pydict(schema, {f.name: [] for f in schema},
+                               capacity=capacity)
 
     # -- properties ----------------------------------------------------
     @property
@@ -221,6 +255,8 @@ class DataChunk:
                         v = v.item() if hasattr(v, "item") else v
                         if dt == DataType.BOOLEAN:
                             v = bool(v)
+                        elif dt == DataType.DECIMAL:
+                            v = types_mod.scaled_to_decimal(v)
                     row.append(v)
             rows.append(tuple(row))
         return rows

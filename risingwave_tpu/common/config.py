@@ -111,5 +111,10 @@ class RwConfig:
                         setattr(cur, k, v)
         for dotted, v in (overrides or {}).items():
             section, key = dotted.split(".", 1)
-            setattr(getattr(cfg, section), key, v)
+            target = getattr(cfg, section)
+            known = {f.name for f in dataclasses.fields(target)}
+            if key not in known:
+                raise KeyError(f"unknown config key {dotted!r}; "
+                               f"known: {sorted(known)}")
+            setattr(target, key, v)
         return cfg

@@ -10,22 +10,35 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
+from typing import Callable, ClassVar
 
 EPOCH_PHYSICAL_SHIFT = 16
 
 # Keep our own epoch-zero so numbers stay small and readable in tests.
 UNIX_RISINGWAVE_DATE_EPOCH_MS = 1_617_235_200_000  # 2021-04-01, like reference
 
+# Injectable time source (seconds, like time.time) so the deterministic
+# simulation harness (SURVEY.md §4 madsim analog) can drive virtual time.
+_clock: Callable[[], float] = time.time
+
+
+def set_clock(clock: Callable[[], float]) -> Callable[[], float]:
+    """Swap the global time source; returns the previous one."""
+    global _clock
+    prev = _clock
+    _clock = clock
+    return prev
+
 
 def physical_now_ms() -> int:
-    return int(time.time() * 1000) - UNIX_RISINGWAVE_DATE_EPOCH_MS
+    return int(_clock() * 1000) - UNIX_RISINGWAVE_DATE_EPOCH_MS
 
 
 @dataclass(frozen=True, order=True)
 class Epoch:
     value: int
 
-    INVALID: "Epoch" = None  # patched below
+    INVALID: ClassVar["Epoch"]  # patched below
 
     @staticmethod
     def from_physical(ms: int, seq: int = 0) -> "Epoch":

@@ -77,19 +77,87 @@ def vnodes_of(cols: Sequence[jnp.ndarray]) -> jnp.ndarray:
     return (hash_columns(cols) & jnp.uint32(VNODE_COUNT - 1)).astype(jnp.int32)
 
 
+# ---------------------------------------------------------------------------
+# Host (numpy) twins — bit-identical to the device kernels so that host-side
+# state partitioning (StateTable) always agrees with device-side dispatch.
+# test_hash_host_device_consistency locks this in.
+
+
+def _mix32_np(x: np.ndarray) -> np.ndarray:
+    with np.errstate(over="ignore"):
+        x = x ^ (x >> np.uint32(16))
+        x = x * np.uint32(0x85EBCA6B)
+        x = x ^ (x >> np.uint32(13))
+        x = x * np.uint32(0xC2B2AE35)
+        x = x ^ (x >> np.uint32(16))
+    return x
+
+
+def _to_u32_lanes_np(col: np.ndarray) -> List[np.ndarray]:
+    dt = col.dtype
+    if dt == np.bool_:
+        return [col.astype(np.uint32)]
+    if np.issubdtype(dt, np.floating):
+        col = np.where(col == 0, np.zeros_like(col), col)
+        return [col.astype(np.float32).view(np.uint32)]
+    if dt.itemsize <= 4:
+        return [col.astype(np.uint32)]
+    u = col.astype(np.uint64)
+    return [(u & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+            (u >> np.uint64(32)).astype(np.uint32)]
+
+
+def hash_columns_host(cols: Sequence[np.ndarray],
+                      seed: int = 0x9E3779B9) -> np.ndarray:
+    """Numpy mirror of ``hash_columns`` — same bits, host arrays."""
+    assert len(cols) > 0
+    n = cols[0].shape[0]
+    h = np.full((n,), np.uint32(seed))
+    with np.errstate(over="ignore"):
+        for col in cols:
+            for lane in _to_u32_lanes_np(np.asarray(col)):
+                h = _mix32_np(h ^ (lane + np.uint32(0x9E3779B9) +
+                                   (h << np.uint32(6)) + (h >> np.uint32(2))))
+    return h
+
+
+def vnodes_of_host(cols: Sequence[np.ndarray]) -> np.ndarray:
+    return (hash_columns_host(cols) &
+            np.uint32(VNODE_COUNT - 1)).astype(np.int32)
+
+
+_STR_HASH_WIDTH = 16  # codepoints of prefix hashed (+ length); longer strings
+#                       sharing prefix AND length collide — skew-only concern,
+#                       correctness restored by full-key equality checks.
+
+
 def hash_strings_host(values: np.ndarray, n: int) -> np.ndarray:
     """Host-side stable hash for varchar key columns → uint32 [n].
 
     Strings never ship to device; when a distribution key includes a varchar
-    column we hash it on host (cheap vs. the device work) and feed the lane
-    into `hash_columns` as a uint32 column.
+    column we hash it on host and feed the lane into `hash_columns` as a
+    uint32 column. Vectorized: fixed-width codepoint matrix + Horner fold —
+    no per-row Python. Hashes the first 16 codepoints plus the exact length.
     """
-    import zlib
+    if n == 0:
+        return np.zeros(len(values), dtype=np.uint32)
+    vals = np.asarray(values[:n], dtype=object)
+    null_mask = vals == None  # noqa: E711
+    if null_mask.any():
+        vals = vals.copy()
+        vals[null_mask] = ""
+    u = vals.astype(str)                       # UCS4 unicode matrix
+    lengths = np.char.str_len(u).astype(np.uint32)
+    w = _STR_HASH_WIDTH
+    uw = np.ascontiguousarray(u.astype(f"U{w}"))   # truncate/pad to w chars
+    mat = uw.view(np.uint32).reshape(n, w)         # codepoints, 0-padded
+    h = lengths.copy()
+    with np.errstate(over="ignore"):
+        for j in range(w):  # w whole-column numpy ops, not per-row python
+            h = h * np.uint32(31) + mat[:, j]
+    h[null_mask] = 0
     out = np.zeros(len(values), dtype=np.uint32)
-    for i in range(n):
-        v = values[i]
-        if v is not None:
-            out[i] = zlib.crc32(v.encode() if isinstance(v, str) else bytes(v))
+    out[:n] = h
     return out
 
 

@@ -9,12 +9,75 @@ dictionary-encoded or carried on host alongside the device columns).
 
 from __future__ import annotations
 
+import decimal
 import enum
 from dataclasses import dataclass, field
 from typing import Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
+
+# DECIMAL physical representation: fixed-point int64, 4 fractional decimal
+# digits (like SQL money). Exact for add/sub/compare — the operations money
+# aggregates need — with documented bounds: |value| < 9.2e14 and products
+# must fit int64 before rescale. The reference's Decimal (types/decimal.rs)
+# is 28-digit arbitrary-scale; we trade generality for a representation the
+# MXU/VPU can aggregate natively with retraction-exact sums.
+DECIMAL_SCALE_DIGITS = 4
+DECIMAL_SCALE = 10 ** DECIMAL_SCALE_DIGITS
+
+
+def decimal_to_scaled(v) -> int:
+    """Python number → scaled int64 payload (banker-free, half-up round)."""
+    if isinstance(v, int):
+        return v * DECIMAL_SCALE
+    d = v if isinstance(v, decimal.Decimal) else decimal.Decimal(str(v))
+    return int((d * DECIMAL_SCALE).to_integral_value(
+        rounding=decimal.ROUND_HALF_UP))
+
+
+def scaled_to_decimal(raw: int) -> decimal.Decimal:
+    return decimal.Decimal(int(raw)) / DECIMAL_SCALE
+
+
+@dataclass(frozen=True, order=True)
+class Interval:
+    """Calendar interval: (months, days, microseconds) triple.
+
+    Reference parity: src/common/src/types/interval.rs — the three components
+    do NOT fold into each other (a month is not a fixed number of days).
+    Interval columns live on host; device window arithmetic uses
+    ``exact_usecs()`` of *literal* intervals at plan-build time.
+    """
+
+    months: int = 0
+    days: int = 0
+    usecs: int = 0
+
+    USECS_PER_DAY = 86_400_000_000
+    USECS_PER_MONTH_APPROX = 30 * 86_400_000_000  # ordering/display only
+
+    @staticmethod
+    def from_duration(*, weeks: int = 0, days: int = 0, hours: int = 0,
+                      minutes: int = 0, seconds: float = 0,
+                      millis: int = 0, usecs: int = 0) -> "Interval":
+        return Interval(0, weeks * 7 + days,
+                        usecs + millis * 1000 + int(seconds * 1_000_000)
+                        + minutes * 60_000_000 + hours * 3_600_000_000)
+
+    def exact_usecs(self) -> int:
+        """Total µs for month-free intervals; raises if months != 0."""
+        if self.months:
+            raise ValueError(
+                f"interval {self!r} has calendar months; no exact µs length")
+        return self.days * Interval.USECS_PER_DAY + self.usecs
+
+    def __add__(self, other: "Interval") -> "Interval":
+        return Interval(self.months + other.months, self.days + other.days,
+                        self.usecs + other.usecs)
+
+    def __neg__(self) -> "Interval":
+        return Interval(-self.months, -self.days, -self.usecs)
 
 
 class DataType(enum.Enum):
@@ -26,17 +89,19 @@ class DataType(enum.Enum):
     INT64 = "bigint"
     FLOAT32 = "real"
     FLOAT64 = "double precision"
-    DECIMAL = "numeric"          # physical: float64 (documented precision loss) v0
+    DECIMAL = "numeric"          # physical: scaled int64 fixed-point (exact)
     DATE = "date"                # days since epoch, int32
     TIME = "time"                # microseconds since midnight, int64
     TIMESTAMP = "timestamp"      # microseconds since unix epoch, int64
     TIMESTAMPTZ = "timestamptz"  # microseconds since unix epoch (UTC), int64
-    INTERVAL = "interval"        # microseconds, int64 (months/days folded) v0
+    INTERVAL = "interval"        # host column of Interval triples
     VARCHAR = "varchar"          # host column (numpy object)
     BYTEA = "bytea"              # host column
     JSONB = "jsonb"              # host column
     SERIAL = "serial"            # int64 row id
-    # STRUCT / LIST handled as composite Schema-level features later rounds.
+    INT256 = "rw_int256"         # host column (python int); device later
+    STRUCT = "struct"            # host column of tuples
+    LIST = "list"                # host column of lists
 
     # ------------------------------------------------------------------
     @property
@@ -77,7 +142,9 @@ class DataType(enum.Enum):
         return _SQL_NAMES[name.strip().lower()]
 
 
-_HOST_TYPES = frozenset({DataType.VARCHAR, DataType.BYTEA, DataType.JSONB})
+_HOST_TYPES = frozenset({DataType.VARCHAR, DataType.BYTEA, DataType.JSONB,
+                         DataType.INTERVAL, DataType.INT256, DataType.STRUCT,
+                         DataType.LIST})
 
 _PHYSICAL = {
     DataType.BOOLEAN: jnp.bool_,
@@ -86,12 +153,11 @@ _PHYSICAL = {
     DataType.INT64: jnp.int64,
     DataType.FLOAT32: jnp.float32,
     DataType.FLOAT64: jnp.float64,
-    DataType.DECIMAL: jnp.float64,
+    DataType.DECIMAL: jnp.int64,
     DataType.DATE: jnp.int32,
     DataType.TIME: jnp.int64,
     DataType.TIMESTAMP: jnp.int64,
     DataType.TIMESTAMPTZ: jnp.int64,
-    DataType.INTERVAL: jnp.int64,
     DataType.SERIAL: jnp.int64,
 }
 
@@ -115,6 +181,9 @@ _SQL_NAMES = {
     "bytea": DataType.BYTEA,
     "jsonb": DataType.JSONB,
     "serial": DataType.SERIAL,
+    "rw_int256": DataType.INT256, "int256": DataType.INT256,
+    "struct": DataType.STRUCT,
+    "list": DataType.LIST,
 }
 
 

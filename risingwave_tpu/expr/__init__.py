@@ -1,0 +1,42 @@
+"""Expression evaluation: vectorized ``eval(DataChunk) -> Column``.
+
+Reference parity: src/expr/src/expr/mod.rs:74,91 (Expression trait) and the
+vector_op scalar kernels. TPU re-design: every expression evaluates over the
+whole fixed-capacity chunk in one VPU pass (padding rows included — callers
+gate with visibility); null validity is a parallel bool array; DECIMAL
+arithmetic is exact scaled-int64 fixed point.
+"""
+
+from risingwave_tpu.expr.expr import (
+    BinaryOp,
+    Case,
+    Expression,
+    FuncCall,
+    InputRef,
+    Literal,
+    UnaryOp,
+    and_,
+    col,
+    lit,
+    or_,
+    register_function,
+    tumble_end,
+    tumble_start,
+)
+
+__all__ = [
+    "Expression",
+    "InputRef",
+    "Literal",
+    "BinaryOp",
+    "UnaryOp",
+    "FuncCall",
+    "Case",
+    "col",
+    "lit",
+    "and_",
+    "or_",
+    "register_function",
+    "tumble_start",
+    "tumble_end",
+]

@@ -1,0 +1,463 @@
+"""Vectorized expression tree.
+
+Reference parity: src/expr/src/expr/mod.rs:74 (`Expression::eval(&DataChunk)
+-> ArrayRef`), build.rs (tree construction), vector_op/ (scalar kernels).
+
+TPU-first notes:
+- ``eval`` returns a ``Column`` whose values cover the chunk's full static
+  capacity; invisible/padding rows compute garbage that is never observed
+  (XLA loves branchless full-width math; masking happens at the consumer).
+- Nulls: SQL three-valued logic via optional validity arrays. Arithmetic
+  propagates null; AND/OR implement Kleene logic.
+- DECIMAL is scaled int64: mul/div rescale; add/sub/compare are plain int
+  ops, so money aggregation is retraction-exact.
+- Division by zero yields NULL (documented divergence: the reference raises
+  ExprError::DivisionByZero and poisons the whole chunk; a streaming NULL
+  keeps the pipeline alive and is what our .slt harness asserts).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.common.chunk import Column, DataChunk
+from risingwave_tpu.common.types import (
+    DECIMAL_SCALE,
+    DataType,
+    Interval,
+    decimal_to_scaled,
+)
+
+# ---------------------------------------------------------------------------
+# type inference helpers
+
+
+_NUMERIC_ORDER = [
+    DataType.INT16, DataType.INT32, DataType.INT64,
+    DataType.DECIMAL, DataType.FLOAT32, DataType.FLOAT64,
+]
+
+
+def promote_numeric(lt: DataType, rt: DataType) -> DataType:
+    """Binary numeric result type: later in _NUMERIC_ORDER wins."""
+    if lt == rt:
+        return lt
+    for t in (lt, rt):
+        if t not in _NUMERIC_ORDER:
+            raise TypeError(f"not numeric: {t}")
+    return _NUMERIC_ORDER[max(_NUMERIC_ORDER.index(lt),
+                              _NUMERIC_ORDER.index(rt))]
+
+
+def _cast_values(vals: jnp.ndarray, src: DataType, dst: DataType) -> jnp.ndarray:
+    if src == dst:
+        return vals
+    if dst == DataType.DECIMAL:
+        if src in (DataType.FLOAT32, DataType.FLOAT64):
+            return jnp.rint(vals * DECIMAL_SCALE).astype(jnp.int64)
+        return vals.astype(jnp.int64) * jnp.int64(DECIMAL_SCALE)
+    if src == DataType.DECIMAL:
+        # decimal → float
+        return vals.astype(dst.dtype) / dst.dtype.type(DECIMAL_SCALE)
+    return vals.astype(dst.dtype)
+
+
+def _merge_validity(a: Optional[jnp.ndarray],
+                    b: Optional[jnp.ndarray]) -> Optional[jnp.ndarray]:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+def _div_trunc(num: jnp.ndarray, den: jnp.ndarray) -> jnp.ndarray:
+    """Integer division truncating toward zero (SQL numeric semantics)."""
+    q = num // den
+    rem = num % den
+    neg = (num < 0) != (den < 0)
+    return jnp.where(neg & (rem != 0), q + 1, q)
+
+
+# ---------------------------------------------------------------------------
+# expression nodes
+
+
+class Expression:
+    """Base: vectorized ``eval(chunk) -> Column`` (expr/mod.rs:74 analog)."""
+
+    return_type: DataType
+
+    def eval(self, chunk: DataChunk) -> Column:
+        raise NotImplementedError
+
+    # -- operator sugar for plan construction ---------------------------
+    def __add__(self, other):  return BinaryOp("+", self, _wrap(other))
+    def __sub__(self, other):  return BinaryOp("-", self, _wrap(other))
+    def __mul__(self, other):  return BinaryOp("*", self, _wrap(other))
+    def __truediv__(self, other): return BinaryOp("/", self, _wrap(other))
+    def __mod__(self, other):  return BinaryOp("%", self, _wrap(other))
+    def __eq__(self, other):   return BinaryOp("=", self, _wrap(other))  # type: ignore[override]
+    def __ne__(self, other):   return BinaryOp("<>", self, _wrap(other))  # type: ignore[override]
+    def __lt__(self, other):   return BinaryOp("<", self, _wrap(other))
+    def __le__(self, other):   return BinaryOp("<=", self, _wrap(other))
+    def __gt__(self, other):   return BinaryOp(">", self, _wrap(other))
+    def __ge__(self, other):   return BinaryOp(">=", self, _wrap(other))
+    def __and__(self, other):  return BinaryOp("and", self, _wrap(other))
+    def __or__(self, other):   return BinaryOp("or", self, _wrap(other))
+    def __invert__(self):      return UnaryOp("not", self)
+    def __neg__(self):         return UnaryOp("neg", self)
+    __hash__ = object.__hash__
+
+
+def _wrap(v) -> "Expression":
+    return v if isinstance(v, Expression) else Literal.infer(v)
+
+
+class InputRef(Expression):
+    """Column reference by index (expr/expr_input_ref.rs analog)."""
+
+    def __init__(self, index: int, data_type: DataType):
+        self.index = index
+        self.return_type = data_type
+
+    def eval(self, chunk: DataChunk) -> Column:
+        c = chunk.columns[self.index]
+        assert c.data_type == self.return_type, (c.data_type, self.return_type)
+        return c
+
+    def __repr__(self):
+        return f"${self.index}:{self.return_type.name.lower()}"
+
+
+def col(chunk_schema, name: str) -> InputRef:
+    """Convenience: InputRef by column name against a Schema."""
+    i = chunk_schema.index_of(name)
+    return InputRef(i, chunk_schema[i].data_type)
+
+
+class Literal(Expression):
+    """Constant (expr/expr_literal.rs analog); broadcast at eval."""
+
+    def __init__(self, value, data_type: DataType):
+        self.value = value
+        self.return_type = data_type
+
+    @staticmethod
+    def infer(v) -> "Literal":
+        if isinstance(v, bool):
+            return Literal(v, DataType.BOOLEAN)
+        if isinstance(v, int):
+            return Literal(v, DataType.INT64)
+        if isinstance(v, float):
+            return Literal(v, DataType.FLOAT64)
+        if isinstance(v, str):
+            return Literal(v, DataType.VARCHAR)
+        if isinstance(v, Interval):
+            return Literal(v, DataType.INTERVAL)
+        if v is None:
+            return Literal(None, DataType.INT64)
+        import decimal
+        if isinstance(v, decimal.Decimal):
+            return Literal(v, DataType.DECIMAL)
+        raise TypeError(f"cannot infer literal type of {v!r}")
+
+    def _physical(self):
+        if self.return_type == DataType.DECIMAL and self.value is not None:
+            return decimal_to_scaled(self.value)
+        return self.value
+
+    def eval(self, chunk: DataChunk) -> Column:
+        cap = chunk.capacity
+        dt = self.return_type
+        if self.value is None:
+            vals = (jnp.zeros(cap, dtype=dt.dtype) if dt.is_device
+                    else np.full(cap, None, dtype=object))
+            validity = jnp.zeros(cap, dtype=bool)
+            return Column(dt, vals, validity)
+        if dt.is_device:
+            return Column(dt, jnp.full(cap, self._physical(), dtype=dt.dtype))
+        return Column(dt, np.full(cap, self.value, dtype=object))
+
+    def __repr__(self):
+        return f"{self.value!r}:{self.return_type.name.lower()}"
+
+
+def lit(v, data_type: Optional[DataType] = None) -> Literal:
+    if data_type is DataType.DECIMAL and not hasattr(v, "as_tuple"):
+        import decimal
+        v = decimal.Decimal(str(v)) if v is not None else None
+    return Literal.infer(v) if data_type is None else Literal(v, data_type)
+
+
+_CMP_OPS = {"=", "<>", "<", "<=", ">", ">="}
+_ARITH_OPS = {"+", "-", "*", "/", "%"}
+_LOGIC_OPS = {"and", "or"}
+
+
+class BinaryOp(Expression):
+    """Arithmetic / comparison / logical binary op (expr_binary_* analog)."""
+
+    def __init__(self, op: str, left: Expression, right: Expression):
+        assert op in _CMP_OPS | _ARITH_OPS | _LOGIC_OPS, op
+        self.op = op
+        self.left = left
+        self.right = right
+        lt, rt = left.return_type, right.return_type
+        if op in _LOGIC_OPS:
+            assert lt == DataType.BOOLEAN and rt == DataType.BOOLEAN
+            self.return_type = DataType.BOOLEAN
+            self._common = DataType.BOOLEAN
+        elif op in _CMP_OPS:
+            self._common = lt if lt == rt else promote_numeric(lt, rt)
+            self.return_type = DataType.BOOLEAN
+        else:
+            self._common = lt if lt == rt else promote_numeric(lt, rt)
+            if op == "/" and self._common in (
+                    DataType.INT16, DataType.INT32, DataType.INT64):
+                self._common = DataType.DECIMAL  # SQL: int/int is exact-ish
+            self.return_type = self._common
+
+    def eval(self, chunk: DataChunk) -> Column:
+        lc = self.left.eval(chunk)
+        rc = self.right.eval(chunk)
+        if self.op in _LOGIC_OPS:
+            return self._eval_logic(lc, rc)
+        if not self._common.is_device:
+            return self._eval_host_cmp(chunk, lc, rc)
+        lv = _cast_values(lc.values, lc.data_type, self._common)
+        rv = _cast_values(rc.values, rc.data_type, self._common)
+        validity = _merge_validity(lc.validity, rc.validity)
+        op = self.op
+        if op in _CMP_OPS:
+            fn = {"=": jnp.equal, "<>": jnp.not_equal, "<": jnp.less,
+                  "<=": jnp.less_equal, ">": jnp.greater,
+                  ">=": jnp.greater_equal}[op]
+            return Column(DataType.BOOLEAN, fn(lv, rv), validity)
+        if op == "+":
+            out = lv + rv
+        elif op == "-":
+            out = lv - rv
+        elif op == "*":
+            if self._common == DataType.DECIMAL:
+                out = _div_trunc(lv * rv, jnp.int64(DECIMAL_SCALE))
+            else:
+                out = lv * rv
+        elif op == "%":
+            zero = rv == 0
+            out = jnp.where(zero, lv, lv % jnp.where(zero, 1, rv))
+            validity = _merge_validity(validity, ~zero)
+        else:  # "/"
+            zero = rv == 0
+            safe = jnp.where(zero, jnp.ones_like(rv), rv)
+            if self._common == DataType.DECIMAL:
+                out = _div_trunc(lv * jnp.int64(DECIMAL_SCALE), safe)
+            else:
+                out = lv / safe
+            validity = _merge_validity(validity, ~zero)
+        return Column(self.return_type, out, validity)
+
+    def _eval_host_cmp(self, chunk: DataChunk, lc: Column,
+                       rc: Column) -> Column:
+        """Comparisons over host columns (varchar etc.) — numpy object ops."""
+        if self.op not in _CMP_OPS:
+            raise TypeError(
+                f"operator {self.op!r} unsupported for host type "
+                f"{self._common}; only comparisons are")
+        cap = chunk.capacity
+        lv, rv = np.asarray(lc.values), np.asarray(rc.values)
+        validity = _merge_validity(lc.validity, rc.validity)
+        # None-safe: padding/null slots get "" before elementwise python cmp
+        lnull = lv == None  # noqa: E711
+        rnull = rv == None  # noqa: E711
+        if lnull.any():
+            lv = lv.copy(); lv[lnull] = ""
+        if rnull.any():
+            rv = rv.copy(); rv[rnull] = ""
+        import operator as _op
+        fn = {"=": _op.eq, "<>": _op.ne, "<": _op.lt, "<=": _op.le,
+              ">": _op.gt, ">=": _op.ge}[self.op]
+        res = np.asarray(fn(lv, rv), dtype=bool)
+        null_any = lnull | rnull
+        if null_any.any():
+            nv = jnp.asarray(~null_any)
+            validity = nv if validity is None else (validity & nv)
+        return Column(DataType.BOOLEAN, jnp.asarray(res), validity)
+
+    def _eval_logic(self, lc: Column, rc: Column) -> Column:
+        lv, rv = lc.values, rc.values
+        ln = lc.validity if lc.validity is not None else jnp.ones_like(lv)
+        rn = rc.validity if rc.validity is not None else jnp.ones_like(rv)
+        if self.op == "and":
+            # Kleene: false AND null = false; true AND null = null
+            out = lv & rv
+            validity = ((ln & rn) | (ln & ~lv) | (rn & ~rv))
+        else:
+            out = lv | rv
+            validity = ((ln & rn) | (ln & lv) | (rn & rv))
+        if lc.validity is None and rc.validity is None:
+            validity = None
+        return Column(DataType.BOOLEAN, out, validity)
+
+    def __repr__(self):
+        return f"({self.left!r} {self.op} {self.right!r})"
+
+
+def and_(*exprs: Expression) -> Expression:
+    out = exprs[0]
+    for e in exprs[1:]:
+        out = BinaryOp("and", out, e)
+    return out
+
+
+def or_(*exprs: Expression) -> Expression:
+    out = exprs[0]
+    for e in exprs[1:]:
+        out = BinaryOp("or", out, e)
+    return out
+
+
+class UnaryOp(Expression):
+    def __init__(self, op: str, child: Expression):
+        assert op in ("not", "neg", "is_null", "is_not_null"), op
+        self.op = op
+        self.child = child
+        self.return_type = (DataType.BOOLEAN if op in
+                            ("not", "is_null", "is_not_null")
+                            else child.return_type)
+
+    def eval(self, chunk: DataChunk) -> Column:
+        c = self.child.eval(chunk)
+        if self.op == "not":
+            return Column(DataType.BOOLEAN, ~c.values, c.validity)
+        if self.op == "neg":
+            return Column(c.data_type, -c.values, c.validity)
+        cap = chunk.capacity
+        present = (jnp.ones(cap, dtype=bool) if c.validity is None
+                   else c.validity)
+        vals = present if self.op == "is_not_null" else ~present
+        return Column(DataType.BOOLEAN, vals, None)
+
+    def __repr__(self):
+        return f"{self.op}({self.child!r})"
+
+
+# ---------------------------------------------------------------------------
+# function registry (sig/ analog, without the proc-macro machinery)
+
+_FUNCTIONS: Dict[str, Callable] = {}
+
+
+def register_function(name: str):
+    def deco(fn):
+        _FUNCTIONS[name] = fn
+        return fn
+    return deco
+
+
+class FuncCall(Expression):
+    """Named scalar function over evaluated child columns."""
+
+    def __init__(self, name: str, args: Sequence[Expression],
+                 return_type: DataType):
+        assert name in _FUNCTIONS, f"unknown function {name}"
+        self.name = name
+        self.args = list(args)
+        self.return_type = return_type
+
+    def eval(self, chunk: DataChunk) -> Column:
+        cols = [a.eval(chunk) for a in self.args]
+        out = _FUNCTIONS[self.name](self.return_type, *cols)
+        assert isinstance(out, Column)
+        return out
+
+    def __repr__(self):
+        return f"{self.name}({', '.join(map(repr, self.args))})"
+
+
+def _window_usecs(window: Column):
+    """Interval-literal column → scalar µs, or None for a NULL literal."""
+    if window.data_type != DataType.INTERVAL:
+        return window.values
+    iv = next((v for v in np.asarray(window.values) if v is not None), None)
+    return None if iv is None else jnp.int64(iv.exact_usecs())
+
+
+@register_function("tumble_start")
+def _tumble_start(rt: DataType, ts: Column, window: Column) -> Column:
+    """Window start for TUMBLE(ts, interval): ts - ts % window_usecs.
+
+    Reference: the TUMBLE rewrite in the frontend planner; the window size
+    must be a month-free interval literal. A NULL window yields NULL.
+    """
+    w = _window_usecs(window)
+    if w is None:
+        return Column(rt, jnp.zeros_like(ts.values),
+                      jnp.zeros(ts.values.shape[0], dtype=bool))
+    out = ts.values - (ts.values % w)
+    return Column(rt, out, ts.validity)
+
+
+@register_function("tumble_end")
+def _tumble_end(rt: DataType, ts: Column, window: Column) -> Column:
+    w = _window_usecs(window)
+    if w is None:
+        return Column(rt, jnp.zeros_like(ts.values),
+                      jnp.zeros(ts.values.shape[0], dtype=bool))
+    out = ts.values - (ts.values % w) + w
+    return Column(rt, out, ts.validity)
+
+
+@register_function("extract_epoch")
+def _extract_epoch(rt: DataType, ts: Column) -> Column:
+    """EXTRACT(EPOCH FROM ts): µs timestamp → seconds (decimal)."""
+    secs = ts.values * jnp.int64(DECIMAL_SCALE) // jnp.int64(1_000_000)
+    return Column(rt, secs, ts.validity)
+
+
+def tumble_start(ts: Expression, window: Interval) -> FuncCall:
+    return FuncCall("tumble_start", [ts, Literal(window, DataType.INTERVAL)],
+                    ts.return_type)
+
+
+def tumble_end(ts: Expression, window: Interval) -> FuncCall:
+    return FuncCall("tumble_end", [ts, Literal(window, DataType.INTERVAL)],
+                    ts.return_type)
+
+
+class Case(Expression):
+    """CASE WHEN …: branchless select over evaluated branches."""
+
+    def __init__(self, whens: Sequence[tuple], else_: Expression):
+        # whens: [(cond_expr, value_expr)]
+        self.whens = list(whens)
+        self.else_ = else_
+        self.return_type = else_.return_type
+        for _, v in self.whens:
+            assert v.return_type == self.return_type
+
+    def eval(self, chunk: DataChunk) -> Column:
+        out = self.else_.eval(chunk)
+        vals, validity = out.values, out.validity
+        cap = chunk.capacity
+        taken = jnp.zeros(cap, dtype=bool)
+        for cond, value in self.whens:
+            cc = cond.eval(chunk)
+            cv = cc.values & (cc.validity if cc.validity is not None
+                              else jnp.ones(cap, dtype=bool)) & ~taken
+            vc = value.eval(chunk)
+            vals = jnp.where(cv, vc.values, vals)
+            if validity is not None or vc.validity is not None:
+                lval = validity if validity is not None \
+                    else jnp.ones(cap, dtype=bool)
+                rval = vc.validity if vc.validity is not None \
+                    else jnp.ones(cap, dtype=bool)
+                validity = jnp.where(cv, rval, lval)
+            taken = taken | cv
+        return Column(self.return_type, vals, validity)
+
+    def __repr__(self):
+        return f"case({self.whens!r}, else={self.else_!r})"

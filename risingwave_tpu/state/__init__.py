@@ -1,0 +1,29 @@
+"""State layer: epoch-MVCC state store + relational StateTable.
+
+Reference parity: src/storage/src/{store.rs,memory.rs,mem_table.rs} and
+src/stream/src/common/table/state_table.rs. This is the checkpoint interface
+the north star keeps: TPU-resident operator state (device hash tables) must
+flush per-barrier deltas through a StateTable-shaped API, and every executor
+test runs against the in-memory fake.
+"""
+
+from risingwave_tpu.state.keycodec import (
+    decode_memcomparable,
+    encode_memcomparable,
+    encode_vnode_prefix,
+)
+from risingwave_tpu.state.store import MemoryStateStore, StateStore
+from risingwave_tpu.state.mem_table import KeyOp, MemTable, MemTableError
+from risingwave_tpu.state.state_table import StateTable
+
+__all__ = [
+    "encode_memcomparable",
+    "decode_memcomparable",
+    "encode_vnode_prefix",
+    "StateStore",
+    "MemoryStateStore",
+    "MemTable",
+    "MemTableError",
+    "KeyOp",
+    "StateTable",
+]

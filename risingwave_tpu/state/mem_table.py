@@ -1,0 +1,102 @@
+"""MemTable: per-executor buffer of uncommitted key ops.
+
+Reference parity: src/storage/src/mem_table.rs:44,53 — buffered
+KeyOp{Insert,Delete,Update} with inconsistent-operation detection, merged
+into the state store at barrier commit.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, Iterator, Optional, Tuple
+
+
+class MemTableError(Exception):
+    pass
+
+
+class KeyOp(enum.Enum):
+    INSERT = "insert"
+    DELETE = "delete"
+    UPDATE = "update"
+
+
+class MemTable:
+    """key → (op, old_value, new_value); op merge rules match mem_table.rs."""
+
+    def __init__(self, sanity_check: bool = True):
+        self._ops: Dict[bytes, Tuple[KeyOp, Optional[tuple],
+                                     Optional[tuple]]] = {}
+        self.sanity_check = sanity_check
+
+    def __len__(self) -> int:
+        return len(self._ops)
+
+    def is_dirty(self) -> bool:
+        return bool(self._ops)
+
+    def insert(self, key: bytes, value: tuple) -> None:
+        cur = self._ops.get(key)
+        if cur is None:
+            self._ops[key] = (KeyOp.INSERT, None, value)
+            return
+        op, old, _new = cur
+        if op == KeyOp.INSERT:
+            if self.sanity_check:
+                raise MemTableError(f"double insert on key {key!r}")
+            self._ops[key] = (KeyOp.INSERT, None, value)
+        elif op == KeyOp.DELETE:
+            self._ops[key] = (KeyOp.UPDATE, old, value)
+        else:  # UPDATE = delete-then-insert already happened
+            if self.sanity_check:
+                raise MemTableError(f"insert after update on key {key!r}")
+            self._ops[key] = (KeyOp.UPDATE, old, value)
+
+    def delete(self, key: bytes, old_value: tuple) -> None:
+        cur = self._ops.get(key)
+        if cur is None:
+            self._ops[key] = (KeyOp.DELETE, old_value, None)
+            return
+        op, old, _new = cur
+        if op == KeyOp.INSERT:
+            del self._ops[key]          # insert+delete annihilate
+        elif op == KeyOp.DELETE:
+            if self.sanity_check:
+                raise MemTableError(f"double delete on key {key!r}")
+        else:  # UPDATE
+            self._ops[key] = (KeyOp.DELETE, old, None)
+
+    def update(self, key: bytes, old_value: tuple, new_value: tuple) -> None:
+        cur = self._ops.get(key)
+        if cur is None:
+            self._ops[key] = (KeyOp.UPDATE, old_value, new_value)
+            return
+        op, old, new = cur
+        if op == KeyOp.INSERT:
+            if self.sanity_check and new != old_value:
+                raise MemTableError(
+                    f"update old {old_value!r} != buffered insert {new!r}")
+            self._ops[key] = (KeyOp.INSERT, None, new_value)
+        elif op == KeyOp.DELETE:
+            if self.sanity_check:
+                raise MemTableError(f"update after delete on key {key!r}")
+            self._ops[key] = (KeyOp.UPDATE, old, new_value)
+        else:
+            self._ops[key] = (KeyOp.UPDATE, old, new_value)
+
+    def get(self, key: bytes):
+        """(present, value) — present=False means 'no buffered op'."""
+        cur = self._ops.get(key)
+        if cur is None:
+            return False, None
+        op, _old, new = cur
+        return True, (new if op != KeyOp.DELETE else None)
+
+    def drain(self) -> Iterator[Tuple[bytes, Optional[tuple]]]:
+        """(key, value|None-tombstone) pairs for ingest_batch; clears."""
+        ops, self._ops = self._ops, {}
+        for key, (op, _old, new) in ops.items():
+            yield key, (None if op == KeyOp.DELETE else new)
+
+    def iter_ops(self):
+        return iter(sorted(self._ops.items()))

@@ -1,0 +1,132 @@
+"""State store: epoch-MVCC KV with table namespaces.
+
+Reference parity: src/storage/src/store.rs:72 (StateStoreRead: get/iter),
+:198 (LocalStateStore: ingest at epoch, seal), and memory.rs
+(MemoryStateStore — the BTreeMap fake every executor test runs on).
+
+Re-design notes: keys are vnode-prefixed memcomparable bytes; values are
+host row tuples (serialization to bytes happens at the hummock-lite SST
+boundary, not here). MVCC: per key we keep (epoch, value|None) versions,
+newest first; a read at epoch e sees the newest version with epoch <= e.
+Tombstones are value=None.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+Value = Optional[tuple]            # None = tombstone
+Versions = List[Tuple[int, Value]]  # newest-first [(epoch, value)]
+
+
+class StateStore:
+    """Interface both MemoryStateStore and hummock-lite implement."""
+
+    def ingest_batch(self, table_id: int,
+                     batch: Iterable[Tuple[bytes, Value]],
+                     epoch: int) -> int:
+        raise NotImplementedError
+
+    def get(self, table_id: int, key: bytes, epoch: int) -> Value:
+        raise NotImplementedError
+
+    def iter(self, table_id: int, epoch: int,
+             start: Optional[bytes] = None, end: Optional[bytes] = None
+             ) -> Iterator[Tuple[bytes, tuple]]:
+        raise NotImplementedError
+
+    def seal_epoch(self, epoch: int, is_checkpoint: bool) -> None:
+        """Global order point: no further writes at <= epoch."""
+
+    def sync(self, epoch: int) -> dict:
+        """Await all data at <= epoch durable; returns uploadinfo."""
+        return {}
+
+
+class _Table:
+    """One table's ordered MVCC map: sorted key index + version lists."""
+
+    __slots__ = ("keys", "versions")
+
+    def __init__(self) -> None:
+        self.keys: List[bytes] = []          # sorted
+        self.versions: Dict[bytes, Versions] = {}
+
+    def put(self, key: bytes, epoch: int, value: Value) -> None:
+        vs = self.versions.get(key)
+        if vs is None:
+            self.versions[key] = [(epoch, value)]
+            bisect.insort(self.keys, key)
+            return
+        # keep newest-first order even for out-of-order epoch ingest;
+        # same-epoch overwrite replaces (linear scan: version lists are short)
+        for i, (e, _v) in enumerate(vs):
+            if e == epoch:
+                vs[i] = (epoch, value)
+                return
+            if e < epoch:
+                vs.insert(i, (epoch, value))
+                return
+        vs.append((epoch, value))
+
+    def read(self, key: bytes, epoch: int) -> Value:
+        vs = self.versions.get(key)
+        if not vs:
+            return None
+        for e, v in vs:
+            if e <= epoch:
+                return v
+        return None
+
+
+class MemoryStateStore(StateStore):
+    """In-memory MVCC store (memory.rs analog) — the test/checkpoint fake."""
+
+    def __init__(self) -> None:
+        self._tables: Dict[int, _Table] = {}
+        self._sealed_epoch = 0
+
+    def _table(self, table_id: int) -> _Table:
+        t = self._tables.get(table_id)
+        if t is None:
+            t = self._tables[table_id] = _Table()
+        return t
+
+    # -- write path ----------------------------------------------------
+    def ingest_batch(self, table_id: int,
+                     batch: Iterable[Tuple[bytes, Value]],
+                     epoch: int) -> int:
+        if epoch <= self._sealed_epoch:
+            raise ValueError(
+                f"write at epoch {epoch} <= sealed {self._sealed_epoch}")
+        t = self._table(table_id)
+        n = 0
+        for key, value in batch:
+            t.put(key, epoch, value)
+            n += 1
+        return n
+
+    def seal_epoch(self, epoch: int, is_checkpoint: bool = True) -> None:
+        assert epoch >= self._sealed_epoch, (epoch, self._sealed_epoch)
+        self._sealed_epoch = epoch
+
+    # -- read path -----------------------------------------------------
+    def get(self, table_id: int, key: bytes, epoch: int) -> Value:
+        return self._table(table_id).read(key, epoch)
+
+    def iter(self, table_id: int, epoch: int,
+             start: Optional[bytes] = None, end: Optional[bytes] = None
+             ) -> Iterator[Tuple[bytes, tuple]]:
+        t = self._table(table_id)
+        lo = bisect.bisect_left(t.keys, start) if start is not None else 0
+        hi = bisect.bisect_left(t.keys, end) if end is not None else len(t.keys)
+        for i in range(lo, hi):
+            key = t.keys[i]
+            v = t.read(key, epoch)
+            if v is not None:
+                yield key, v
+
+    # -- test/debug helpers --------------------------------------------
+    def table_size(self, table_id: int, epoch: int) -> int:
+        return sum(1 for _ in self.iter(table_id, epoch))

@@ -146,3 +146,119 @@ def test_config_defaults_and_toml(tmp_path):
     assert cfg2.meta.checkpoint_frequency == 5
     sp2 = cfg2.system.set("checkpoint_frequency", 10)
     assert sp2.version == cfg2.system.version + 1
+
+
+def test_decimal_exact_scaled_int():
+    import decimal
+    from risingwave_tpu.common import DECIMAL_SCALE, decimal_to_scaled, scaled_to_decimal
+    s = Schema.of(price=DataType.DECIMAL)
+    c = DataChunk.from_pydict(s, {"price": ["1.01", 2, 3.555, None]})
+    vals = np.asarray(c.column_values("price"))
+    assert vals.dtype == np.int64
+    assert vals[:3].tolist() == [10100, 20000, 35550]
+    out = [r[0] for r in c.to_pylist()]
+    assert out == [decimal.Decimal("1.01"), decimal.Decimal(2),
+                   decimal.Decimal("3.555"), None]
+    # exact money arithmetic: 0.1 + 0.2 == 0.3 (fails in float64)
+    a = decimal_to_scaled("0.1") + decimal_to_scaled("0.2")
+    assert scaled_to_decimal(a) == decimal.Decimal("0.3")
+    assert DECIMAL_SCALE == 10_000
+
+
+def test_interval_triple():
+    from risingwave_tpu.common import Interval
+    i = Interval(months=1, days=2, usecs=3)
+    with pytest.raises(ValueError):
+        i.exact_usecs()
+    d = Interval.from_duration(days=1, hours=2)
+    assert d.exact_usecs() == 26 * 3_600_000_000
+    assert (i + Interval(months=1)).months == 2
+    assert (-i).days == -2
+    assert not DataType.INTERVAL.is_device
+    s = Schema.of(gap=DataType.INTERVAL)
+    c = DataChunk.from_pydict(s, {"gap": [d, None]})
+    assert c.to_pylist() == [(d,), (None,)]
+
+
+def test_hash_strings_host_vectorized():
+    from risingwave_tpu.common import hash_strings_host
+    vals = np.asarray(["abc", "abd", "abc", None, "", "日本語テキスト",
+                       "x" * 100, "x" * 101], dtype=object)
+    h = hash_strings_host(vals, 8)
+    assert h.dtype == np.uint32
+    assert h[0] == h[2] and h[0] != h[1]          # consistent + distinct
+    assert h[3] == 0                               # null
+    assert h[6] != h[7]                            # same prefix, diff length
+    h2 = hash_strings_host(vals, 8)
+    assert np.array_equal(h, h2)
+    empty = hash_strings_host(np.asarray([], dtype=object), 0)
+    assert empty.shape == (0,)
+
+
+def test_column_take_host():
+    s = Schema.of(x=DataType.INT64, name=DataType.VARCHAR)
+    c = DataChunk.from_pydict(s, {"x": [10, 20, 30], "name": ["a", None, "c"]})
+    idx = np.asarray([2, 0])
+    xc = c.columns[0].take_host(idx)
+    assert np.asarray(xc.values).tolist() == [30, 10]
+    nc = c.columns[1].take_host(idx)
+    assert np.asarray(nc.values).tolist() == ["c", "a"]
+
+
+def test_from_arrays_and_empty():
+    s = Schema.of(x=DataType.INT64)
+    arr = jnp.arange(8, dtype=jnp.int64)
+    c = DataChunk.from_arrays(s, [arr], num_rows=3)
+    assert c.cardinality() == 3 and c.capacity == 8
+    with pytest.raises(ValueError):
+        DataChunk.from_arrays(s, [arr], num_rows=3, capacity=16)
+    with pytest.raises(ValueError):
+        DataChunk.from_arrays(s, [arr], num_rows=9)
+    e = DataChunk.empty(s)
+    assert e.cardinality() == 0
+    se = StreamChunk.empty(s)
+    assert isinstance(se, StreamChunk) and hasattr(se, "ops")
+
+
+def test_vnode_mapping_bitmap_and_device():
+    m = VnodeMapping.new_uniform(4)
+    bm = m.bitmap_of(1)
+    assert bm.dtype == bool and bm.sum() == 64
+    assert set(np.flatnonzero(bm).tolist()) == {
+        v for v in range(VNODE_COUNT) if m.owner_of(v) == 1}
+    dev = m.to_device()
+    assert np.array_equal(np.asarray(dev), m.owners)
+
+
+def test_pluggable_clock():
+    from risingwave_tpu.common import set_clock
+    from risingwave_tpu.common.epoch import UNIX_RISINGWAVE_DATE_EPOCH_MS
+    fixed_s = (UNIX_RISINGWAVE_DATE_EPOCH_MS + 5_000) / 1000.0
+    prev = set_clock(lambda: fixed_s)
+    try:
+        e = Epoch.now()
+        assert e.physical_ms == 5_000
+        assert e.next().value == e.value + 1  # clock frozen -> seq bump
+    finally:
+        set_clock(prev)
+
+
+def test_config_override_validation(tmp_path):
+    from risingwave_tpu.common.config import RwConfig
+    toml = tmp_path / "rw.toml"
+    toml.write_text("")
+    with pytest.raises(KeyError):
+        RwConfig.from_toml(str(toml), overrides={"meta.barier_interval_ms": 1})
+
+
+def test_struct_list_host_columns():
+    s = Schema.of(st=DataType.STRUCT, ls=DataType.LIST)
+    c = DataChunk.from_pydict(s, {"st": [(1, 2), (3, 4)],
+                                  "ls": [[1], [2, 3]]})
+    assert c.to_pylist() == [((1, 2), [1]), ((3, 4), [2, 3])]
+
+
+def test_decimal_numpy_int_ingest_scales():
+    from risingwave_tpu.common.chunk import _make_column
+    col_ = _make_column(DataType.DECIMAL, np.asarray([1, 2]), 8)
+    assert np.asarray(col_.values)[:2].tolist() == [10000, 20000]

@@ -1,0 +1,210 @@
+"""State layer tests.
+
+Mirrors the core cases of the reference's
+src/stream/src/common/table/test_state_table.rs (write-read across commit,
+iteration order, update-pair atomicity) plus mem_table.rs op-merge rules and
+keycodec ordering properties.
+"""
+
+import decimal
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.common import (
+    DataType, Epoch, EpochPair, Op, Schema, StreamChunk,
+)
+from risingwave_tpu.common.hash import hash_columns, hash_columns_host
+from risingwave_tpu.state import (
+    KeyOp, MemTable, MemTableError, MemoryStateStore, StateTable,
+    decode_memcomparable, encode_memcomparable,
+)
+
+
+# -- key codec ---------------------------------------------------------------
+
+def test_memcomparable_ordering():
+    types = [DataType.INT64]
+    vals = [-(10**12), -5, -1, 0, 1, 7, 10**12]
+    encs = [encode_memcomparable((v,), types) for v in vals]
+    assert encs == sorted(encs)
+    ftypes = [DataType.FLOAT64]
+    fvals = [float("-inf"), -2.5, -0.0, 0.0, 1e-300, 3.7, float("inf")]
+    fencs = [encode_memcomparable((v,), ftypes) for v in fvals]
+    assert sorted(fencs) == fencs
+    stypes = [DataType.VARCHAR]
+    svals = ["", "a", "a\x00b", "ab", "b"]
+    sencs = [encode_memcomparable((v,), stypes) for v in svals]
+    assert sorted(sencs) == sencs
+
+
+def test_memcomparable_roundtrip():
+    types = [DataType.INT64, DataType.VARCHAR, DataType.FLOAT64,
+             DataType.BOOLEAN, DataType.DECIMAL]
+    row = (42, "hello\x00world", -3.25, True, decimal.Decimal("9.5001"))
+    enc = encode_memcomparable(row, types)
+    assert decode_memcomparable(enc, types) == row
+    nonerow = (None, None, None, None, None)
+    assert decode_memcomparable(
+        encode_memcomparable(nonerow, types), types) == nonerow
+    # composite ordering: first column dominates
+    a = encode_memcomparable((1, "z"), [DataType.INT64, DataType.VARCHAR])
+    b = encode_memcomparable((2, "a"), [DataType.INT64, DataType.VARCHAR])
+    assert a < b
+
+
+def test_hash_host_device_consistency():
+    """Host state partitioning must agree with device dispatch bit-for-bit."""
+    import jax.numpy as jnp
+    ints = np.arange(-500, 500, dtype=np.int64) * 997
+    floats = np.linspace(-5, 5, 1000)
+    small = np.arange(1000, dtype=np.int32)
+    dev = np.asarray(hash_columns([jnp.asarray(ints), jnp.asarray(floats),
+                                   jnp.asarray(small)]))
+    host = hash_columns_host([ints, floats, small])
+    assert np.array_equal(dev, host)
+
+
+# -- mem table ---------------------------------------------------------------
+
+def test_mem_table_merge_rules():
+    mt = MemTable()
+    mt.insert(b"k1", (1,))
+    mt.delete(b"k1", (1,))          # insert+delete annihilate
+    assert not mt.is_dirty()
+    mt.insert(b"k2", (2,))
+    with pytest.raises(MemTableError):
+        mt.insert(b"k2", (2,))      # double insert
+    mt.update(b"k2", (2,), (3,))    # update over buffered insert folds in
+    assert mt.get(b"k2") == (True, (3,))
+    mt.delete(b"k3", (9,))
+    with pytest.raises(MemTableError):
+        mt.delete(b"k3", (9,))      # double delete
+    mt.insert(b"k3", (10,))         # delete+insert → update
+    ops = dict(mt.iter_ops())
+    assert ops[b"k3"][0] == KeyOp.UPDATE
+    drained = dict(mt.drain())
+    assert drained == {b"k2": (3,), b"k3": (10,)}
+    assert not mt.is_dirty()
+
+
+# -- state store MVCC --------------------------------------------------------
+
+def test_memory_state_store_mvcc():
+    st = MemoryStateStore()
+    st.ingest_batch(1, [(b"a", (1,)), (b"b", (2,))], epoch=100)
+    st.ingest_batch(1, [(b"a", (10,)), (b"b", None)], epoch=200)
+    assert st.get(1, b"a", 100) == (1,)
+    assert st.get(1, b"a", 150) == (1,)
+    assert st.get(1, b"a", 200) == (10,)
+    assert st.get(1, b"b", 100) == (2,)
+    assert st.get(1, b"b", 200) is None          # tombstone
+    assert st.get(1, b"a", 50) is None           # before first write
+    assert [k for k, _ in st.iter(1, 200)] == [b"a"]
+    assert [k for k, _ in st.iter(1, 100)] == [b"a", b"b"]
+    st.seal_epoch(200)
+    with pytest.raises(ValueError):
+        st.ingest_batch(1, [(b"c", (3,))], epoch=150)  # write below seal
+
+
+# -- state table -------------------------------------------------------------
+
+def _table(sanity=True, dist=None):
+    schema = Schema.of(k=DataType.INT64, s=DataType.VARCHAR, v=DataType.INT64)
+    store = MemoryStateStore()
+    t = StateTable(table_id=7, schema=schema, pk_indices=[0], store=store,
+                   dist_key_indices=dist, sanity_check=sanity)
+    e1 = Epoch.from_physical(1)
+    t.init_epoch(EpochPair.new_initial(e1))
+    return t, store
+
+
+def _advance(t):
+    new = EpochPair(curr=t.epoch.curr.next(), prev=t.epoch.curr)
+    t.commit(new)
+    return new
+
+
+def test_state_table_write_read_across_commit():
+    t, _ = _table()
+    t.insert((1, "a", 10))
+    t.insert((2, "b", 20))
+    # uncommitted rows visible through the memtable
+    assert t.get_row((1,)) == (1, "a", 10)
+    _advance(t)
+    assert t.get_row((1,)) == (1, "a", 10)       # now from committed store
+    t.delete((1, "a", 10))
+    assert t.get_row((1,)) is None               # buffered delete wins
+    _advance(t)
+    assert t.get_row((1,)) is None
+    assert t.get_row((2,)) == (2, "b", 20)
+
+
+def test_state_table_iteration_order_and_merge():
+    t, _ = _table()
+    for k in (5, 1, 9):
+        t.insert((k, "x", k * 10))
+    _advance(t)
+    t.insert((3, "y", 30))          # buffered
+    t.delete((9, "x", 90))          # buffered delete of committed row
+    pks = [pk for pk, _ in t.iter_rows()]
+    assert pks == [(1,), (3,), (5,)]
+    rows = [r for _, r in t.iter_rows()]
+    assert rows[1] == (3, "y", 30)
+
+
+def test_state_table_update_pair_atomicity():
+    t, _ = _table()
+    t.insert((1, "a", 10))
+    _advance(t)
+    t.update((1, "a", 10), (1, "a", 11))
+    assert t.get_row((1,)) == (1, "a", 11)
+    _advance(t)
+    assert t.get_row((1,)) == (1, "a", 11)
+    # inconsistent update (wrong old row) caught by sanity check after insert
+    t2, _ = _table()
+    t2.insert((5, "q", 1))
+    with pytest.raises(MemTableError):
+        t2.update((5, "q", 999), (5, "q", 2))
+
+
+def test_state_table_write_chunk_and_vnode_partitioning():
+    t, store = _table(dist=[0])
+    s = t.schema
+    c = StreamChunk.from_pydict(
+        s, {"k": [1, 2, 1], "s": ["a", "b", "a"], "v": [10, 20, 10]},
+        ops=[Op.INSERT, Op.INSERT, Op.DELETE])
+    t.write_chunk(c)
+    assert t.get_row((2,)) == (2, "b", 20)
+    assert t.get_row((1,)) is None               # insert+delete annihilated
+    _advance(t)
+    # row landed in the vnode derived from the dist key
+    vnodes_with_data = {pk[0]: True for pk, _ in t.iter_rows()}
+    assert vnodes_with_data == {2: True}
+    from risingwave_tpu.common.hash import vnodes_of_host
+    vn = int(vnodes_of_host([np.asarray([2], dtype=np.int64)])[0])
+    assert [pk for pk, _ in t.iter_rows(vnode=vn)] == [(2,)]
+    assert list(t.iter_rows(vnode=(vn + 1) % 256)) == []
+
+
+def test_state_table_commit_epoch_progression():
+    t, store = _table()
+    t.insert((1, "a", 1))
+    e_first = t.epoch.curr
+    _advance(t)
+    # data written at the sealed epoch
+    assert store.get(7, t._encode_pk((1,)), e_first.value) == (1, "a", 1)
+    assert store.get(7, t._encode_pk((1,)), e_first.value - 1) is None
+    # commit with wrong epoch pair rejected
+    with pytest.raises(AssertionError):
+        t.commit(EpochPair(curr=t.epoch.curr.next(), prev=Epoch(1)))
+
+
+def test_state_table_vnode_bitmap_swap():
+    t, _ = _table()
+    t.insert((1, "a", 1))
+    with pytest.raises(AssertionError):
+        t.update_vnode_bitmap(np.zeros(256, dtype=bool))
+    _advance(t)
+    prev = t.update_vnode_bitmap(np.arange(256) < 128)
+    assert prev.all() and len(t.owned_vnodes()) == 128
