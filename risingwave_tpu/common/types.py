@@ -40,13 +40,15 @@ def scaled_to_decimal(raw: int) -> decimal.Decimal:
     return decimal.Decimal(int(raw)) / DECIMAL_SCALE
 
 
-@dataclass(frozen=True, order=True)
+@dataclass(frozen=True)
 class Interval:
     """Calendar interval: (months, days, microseconds) triple.
 
     Reference parity: src/common/src/types/interval.rs — the three components
     do NOT fold into each other (a month is not a fixed number of days).
-    Interval columns live on host; device window arithmetic uses
+    Comparison/equality use the *justified* value (month = 30 days), matching
+    the reference's IntervalCmpValue: INTERVAL '30 days' == INTERVAL
+    '1 month'. Interval columns live on host; device window arithmetic uses
     ``exact_usecs()`` of *literal* intervals at plan-build time.
     """
 
@@ -55,7 +57,31 @@ class Interval:
     usecs: int = 0
 
     USECS_PER_DAY = 86_400_000_000
-    USECS_PER_MONTH_APPROX = 30 * 86_400_000_000  # ordering/display only
+    USECS_PER_MONTH_APPROX = 30 * 86_400_000_000  # justified comparison
+
+    def _justified_usecs(self) -> int:
+        return (self.months * Interval.USECS_PER_MONTH_APPROX
+                + self.days * Interval.USECS_PER_DAY + self.usecs)
+
+    def __eq__(self, other):
+        if not isinstance(other, Interval):
+            return NotImplemented
+        return self._justified_usecs() == other._justified_usecs()
+
+    def __hash__(self):
+        return hash(self._justified_usecs())
+
+    def __lt__(self, other: "Interval"):
+        return self._justified_usecs() < other._justified_usecs()
+
+    def __le__(self, other: "Interval"):
+        return self._justified_usecs() <= other._justified_usecs()
+
+    def __gt__(self, other: "Interval"):
+        return self._justified_usecs() > other._justified_usecs()
+
+    def __ge__(self, other: "Interval"):
+        return self._justified_usecs() >= other._justified_usecs()
 
     @staticmethod
     def from_duration(*, weeks: int = 0, days: int = 0, hours: int = 0,

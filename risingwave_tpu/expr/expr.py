@@ -60,8 +60,9 @@ def _cast_values(vals: jnp.ndarray, src: DataType, dst: DataType) -> jnp.ndarray
             return jnp.rint(vals * DECIMAL_SCALE).astype(jnp.int64)
         return vals.astype(jnp.int64) * jnp.int64(DECIMAL_SCALE)
     if src == DataType.DECIMAL:
-        # decimal → float
-        return vals.astype(dst.dtype) / dst.dtype.type(DECIMAL_SCALE)
+        # decimal → float: divide in the destination float dtype
+        return vals.astype(dst.dtype) / jnp.asarray(DECIMAL_SCALE,
+                                                    dtype=dst.dtype)
     return vals.astype(dst.dtype)
 
 
@@ -248,7 +249,12 @@ class BinaryOp(Expression):
                 out = lv * rv
         elif op == "%":
             zero = rv == 0
-            out = jnp.where(zero, lv, lv % jnp.where(zero, 1, rv))
+            safe = jnp.where(zero, jnp.ones_like(rv), rv)
+            if self._common in (DataType.FLOAT32, DataType.FLOAT64):
+                out = jnp.fmod(lv, safe)  # truncated, sign of dividend
+            else:
+                # SQL truncated modulo: a - trunc(a/b)*b (sign follows a)
+                out = lv - _div_trunc(lv, safe) * safe
             validity = _merge_validity(validity, ~zero)
         else:  # "/"
             zero = rv == 0
@@ -270,17 +276,22 @@ class BinaryOp(Expression):
         cap = chunk.capacity
         lv, rv = np.asarray(lc.values), np.asarray(rc.values)
         validity = _merge_validity(lc.validity, rc.validity)
-        # None-safe: padding/null slots get "" before elementwise python cmp
-        lnull = lv == None  # noqa: E711
+        # Compare only slots where both sides are present — padding and null
+        # slots hold None (or stale objects of another type) and must never
+        # reach the python comparison operator.
+        lnull = lv == None  # noqa: E711  (elementwise)
         rnull = rv == None  # noqa: E711
-        if lnull.any():
-            lv = lv.copy(); lv[lnull] = ""
-        if rnull.any():
-            rv = rv.copy(); rv[rnull] = ""
+        vis = np.asarray(chunk.visibility)
+        if validity is not None:
+            vis = vis & np.asarray(validity)
+        ok = vis & ~lnull & ~rnull
         import operator as _op
         fn = {"=": _op.eq, "<>": _op.ne, "<": _op.lt, "<=": _op.le,
               ">": _op.gt, ">=": _op.ge}[self.op]
-        res = np.asarray(fn(lv, rv), dtype=bool)
+        res = np.zeros(cap, dtype=bool)
+        idx = np.flatnonzero(ok)
+        if idx.size:
+            res[idx] = np.asarray(fn(lv[idx], rv[idx]), dtype=bool)
         null_any = lnull | rnull
         if null_any.any():
             nv = jnp.asarray(~null_any)

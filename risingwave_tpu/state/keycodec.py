@@ -85,9 +85,9 @@ def encode_value(v, dt: DataType) -> bytes:
     if dt in (DataType.FLOAT32, DataType.FLOAT64):
         return _NONNULL + _encode_float(float(v))
     if dt == DataType.DECIMAL:
-        if isinstance(v, decimal.Decimal):
-            v = decimal_to_scaled(v)  # same rounding as column ingest
-        return _NONNULL + _encode_int(int(v))
+        # normalize ANY logical value (int/float/Decimal) through the same
+        # scaling as column ingest, so 5, 5.0 and Decimal('5') share one key
+        return _NONNULL + _encode_int(decimal_to_scaled(v))
     if dt == DataType.VARCHAR:
         return _NONNULL + _encode_bytes(str(v).encode("utf-8"))
     if dt == DataType.BYTEA:

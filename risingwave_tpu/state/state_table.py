@@ -179,7 +179,9 @@ class StateTable:
 def _key_lane(v, dt: DataType) -> np.ndarray:
     """One scalar → length-1 lane array matching device hashing rules."""
     if dt.is_device:
-        if dt == DataType.DECIMAL and isinstance(v, decimal.Decimal):
+        if dt == DataType.DECIMAL:
+            # scale ANY logical value (int/float/Decimal) exactly like
+            # column ingest, so host vnode == device vnode of the column
             v = decimal_to_scaled(v)
         return np.asarray([v], dtype=dt.np_dtype)
     return hash_strings_host(np.asarray([v], dtype=object), 1)

@@ -1,0 +1,37 @@
+"""Streaming engine: executors, actors, exchange, barriers.
+
+The TPU-native analog of the reference's src/stream/ crate (SURVEY §2.6):
+pull-based async executors over columnar device chunks, permit-based
+exchange channels, Chandy-Lamport aligned barriers, actors as asyncio
+tasks. Stateful operators (ops/) flush device state through StateTable at
+every barrier.
+"""
+
+from risingwave_tpu.stream.message import (
+    AddMutation, Barrier, BarrierKind, Message, Mutation, PauseMutation,
+    ResumeMutation, SourceChangeSplitMutation, StopMutation, UpdateMutation,
+    Watermark, is_barrier, is_chunk, is_watermark,
+)
+from risingwave_tpu.stream.executor import Executor, ExecutorInfo
+from risingwave_tpu.stream.exchange import (
+    ChannelClosed, Receiver, Sender, channel, channel_for_test,
+)
+from risingwave_tpu.stream.merge import MergeExecutor, barrier_align_2
+from risingwave_tpu.stream.dispatch import (
+    BroadcastDispatcher, DispatchExecutor, Dispatcher, HashDispatcher,
+    Output, RoundRobinDispatcher, SimpleDispatcher,
+)
+from risingwave_tpu.stream.actor import Actor, LocalBarrierManager
+
+__all__ = [
+    "AddMutation", "Barrier", "BarrierKind", "Message", "Mutation",
+    "PauseMutation", "ResumeMutation", "SourceChangeSplitMutation",
+    "StopMutation", "UpdateMutation", "Watermark",
+    "is_barrier", "is_chunk", "is_watermark",
+    "Executor", "ExecutorInfo",
+    "ChannelClosed", "Receiver", "Sender", "channel", "channel_for_test",
+    "MergeExecutor", "barrier_align_2",
+    "BroadcastDispatcher", "DispatchExecutor", "Dispatcher",
+    "HashDispatcher", "Output", "RoundRobinDispatcher", "SimpleDispatcher",
+    "Actor", "LocalBarrierManager",
+]

@@ -1,0 +1,134 @@
+"""Actors and the local barrier manager.
+
+Reference parity: src/stream/src/executor/actor.rs:36,121,153 (an actor is
+one spawned task driving an executor chain into its DispatchExecutor,
+reporting barrier completion) and src/stream/src/task/barrier_manager.rs:103,
+119 (LocalBarrierManager: sends injected barriers to source actors via
+registered senders, collects per-actor completion per epoch).
+
+TPU re-design: asyncio tasks stand in for tokio tasks. Barrier *collection*
+is the device sync point — an actor reports collected only after its
+executors have flushed device state for the epoch (kernels launched between
+barriers are free to run async until then).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Dict, List, Optional, Sequence, Set
+
+from risingwave_tpu.stream.dispatch import Dispatcher
+from risingwave_tpu.stream.exchange import ChannelClosed, Sender
+from risingwave_tpu.stream.executor import Executor
+from risingwave_tpu.stream.message import Barrier, is_barrier, is_chunk
+
+
+class Actor:
+    """One dataflow task: executor chain → dispatchers (actor.rs:36)."""
+
+    def __init__(self, actor_id: int, consumer: Executor,
+                 dispatchers: Sequence[Dispatcher],
+                 barrier_manager: Optional["LocalBarrierManager"] = None):
+        self.actor_id = actor_id
+        self.consumer = consumer
+        self.dispatchers = list(dispatchers)
+        self.barrier_manager = barrier_manager
+        self.failure: Optional[BaseException] = None
+
+    async def run(self) -> None:
+        try:
+            await self._run_consumer()
+        except asyncio.CancelledError:
+            raise
+        except BaseException as e:  # noqa: BLE001 — report, don't swallow
+            self.failure = e
+            if self.barrier_manager is not None:
+                self.barrier_manager.notify_failure(self.actor_id, e)
+            else:
+                raise
+
+    async def _run_consumer(self) -> None:
+        async for msg in self.consumer.execute():
+            if is_chunk(msg):
+                for d in self.dispatchers:
+                    await d.dispatch_data(msg)
+            elif is_barrier(msg):
+                barrier = msg.with_passed(self.actor_id)
+                for d in self.dispatchers:
+                    await d.dispatch_barrier(barrier)
+                # collected := barrier fully left this actor; device state
+                # for the epoch is flushed (executors flush before yielding
+                # the barrier downstream)
+                if self.barrier_manager is not None:
+                    self.barrier_manager.collect(self.actor_id, barrier)
+                if barrier.is_stop(self.actor_id):
+                    break
+            else:
+                for d in self.dispatchers:
+                    await d.dispatch_watermark(msg)
+        for d in self.dispatchers:
+            d.close()
+
+    def spawn(self) -> asyncio.Task:
+        return asyncio.ensure_future(self.run())
+
+
+class LocalBarrierManager:
+    """Collects per-actor barrier completions (barrier_manager.rs:119)."""
+
+    def __init__(self):
+        self._barrier_senders: Dict[int, List[Sender]] = {}
+        self._expected_actors: Set[int] = set()
+        self._collected: Dict[int, Set[int]] = {}   # epoch -> actor ids
+        self._complete: Dict[int, asyncio.Event] = {}
+        self._barriers: Dict[int, Barrier] = {}
+        self._failed: Optional[BaseException] = None
+
+    # -- wiring --------------------------------------------------------
+    def register_sender(self, actor_id: int, sender: Sender) -> None:
+        """Source-like actors receive injected barriers via these senders."""
+        self._barrier_senders.setdefault(actor_id, []).append(sender)
+
+    def set_expected_actors(self, actor_ids: Sequence[int]) -> None:
+        self._expected_actors = set(actor_ids)
+
+    # -- inject/collect (the InjectBarrier/BarrierComplete analog) -----
+    async def send_barrier(self, barrier: Barrier) -> None:
+        epoch = barrier.epoch.curr.value
+        self._collected.setdefault(epoch, set())
+        self._complete.setdefault(epoch, asyncio.Event())
+        self._barriers[epoch] = barrier
+        for senders in self._barrier_senders.values():
+            for s in senders:
+                await s.send(barrier)
+
+    def collect(self, actor_id: int, barrier: Barrier) -> None:
+        epoch = barrier.epoch.curr.value
+        got = self._collected.setdefault(epoch, set())
+        got.add(actor_id)
+        ev = self._complete.setdefault(epoch, asyncio.Event())
+        if self._expected_actors and got >= self._expected_actors:
+            ev.set()
+
+    def notify_failure(self, actor_id: int, err: BaseException) -> None:
+        self._failed = err
+        for ev in self._complete.values():
+            ev.set()
+
+    async def await_epoch_complete(self, epoch: int) -> Barrier:
+        """Block until every expected actor collected `epoch`."""
+        ev = self._complete.setdefault(epoch, asyncio.Event())
+        await ev.wait()
+        if self._failed is not None:
+            raise RuntimeError(
+                f"actor failure during epoch {epoch:#x}") from self._failed
+        self._collected.pop(epoch, None)
+        self._complete.pop(epoch, None)
+        return self._barriers.pop(epoch)
+
+    def drop_actor(self, actor_id: int) -> None:
+        self._expected_actors.discard(actor_id)
+        self._barrier_senders.pop(actor_id, None)
+        for epoch, got in self._collected.items():
+            if self._expected_actors and got >= self._expected_actors:
+                self._complete[epoch].set()

@@ -1,0 +1,16 @@
+"""Stream operator executors.
+
+Each module mirrors one reference executor family
+(src/stream/src/executor/*); see per-module docstrings for file:line parity.
+"""
+
+from risingwave_tpu.stream.executors.simple import (
+    FilterExecutor, ProjectExecutor, ReceiverExecutor,
+)
+from risingwave_tpu.stream.executors.materialize import MaterializeExecutor
+from risingwave_tpu.stream.executors.test_utils import MockSource
+
+__all__ = [
+    "FilterExecutor", "ProjectExecutor", "ReceiverExecutor",
+    "MaterializeExecutor", "MockSource",
+]

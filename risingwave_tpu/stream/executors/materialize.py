@@ -1,0 +1,74 @@
+"""MaterializeExecutor: sink a stream into its materialized-view table.
+
+Reference parity: src/stream/src/executor/mview/materialize.rs:53 — apply
+each StreamChunk to the MV's StateTable (pk-conflict handling per
+ConflictBehavior), commit on barrier, forward messages downstream.
+
+TPU notes: the MV table is the queryable result — batch `SELECT` reads the
+committed snapshot (storage side of the same state store). Overwrite
+conflict handling turns blind inserts into updates so the MV stays a
+function of pk (materialize.rs `handle_conflict` analog).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import AsyncIterator
+
+from risingwave_tpu.common.chunk import Op, StreamChunk
+from risingwave_tpu.state.state_table import StateTable
+from risingwave_tpu.stream.executor import Executor, ExecutorInfo
+from risingwave_tpu.stream.message import is_barrier, is_chunk, Message
+
+
+class ConflictBehavior(enum.Enum):
+    NO_CHECK = "no_check"        # trust upstream ops (MV over keyed stream)
+    OVERWRITE = "overwrite"      # last write wins on pk conflict
+    IGNORE = "ignore"            # first write wins
+
+
+class MaterializeExecutor(Executor):
+    """Materialize a changelog into a StateTable (materialize.rs:53)."""
+
+    def __init__(self, input_: Executor, table: StateTable,
+                 conflict: ConflictBehavior = ConflictBehavior.NO_CHECK):
+        self.input = input_
+        self.table = table
+        self.conflict = conflict
+        info = ExecutorInfo(input_.schema, list(table.pk_indices),
+                            "MaterializeExecutor")
+        super().__init__(info)
+
+    async def execute(self) -> AsyncIterator[Message]:
+        it = self.input.execute()
+        first = await it.__anext__()
+        assert is_barrier(first), "executor protocol: first message is the " \
+            f"init barrier, got {first!r}"
+        self.table.init_epoch(first.epoch)
+        yield first
+        async for msg in it:
+            if is_chunk(msg):
+                self._apply(msg)
+                yield msg
+            elif is_barrier(msg):
+                self.table.commit(msg.epoch)
+                yield msg
+            else:
+                yield msg
+
+    def _apply(self, chunk: StreamChunk) -> None:
+        if self.conflict == ConflictBehavior.NO_CHECK:
+            self.table.write_chunk(chunk)
+            return
+        for op, row in chunk.to_records():
+            pk = self.table.pk_of(row)
+            old = self.table.get_row(pk)
+            if op in (Op.INSERT, Op.UPDATE_INSERT):
+                if old is None:
+                    self.table.insert(row)
+                elif self.conflict == ConflictBehavior.OVERWRITE:
+                    self.table.update(old, row)
+                # IGNORE: keep first write
+            else:
+                if old is not None:
+                    self.table.delete(old)

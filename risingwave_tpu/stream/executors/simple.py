@@ -1,0 +1,122 @@
+"""Stateless single-input executors: Receiver, Project, Filter.
+
+Reference parity:
+- ReceiverExecutor: src/stream/src/executor/receiver.rs (single upstream
+  channel as an executor).
+- ProjectExecutor: src/stream/src/executor/project.rs — eval expressions
+  over the chunk, emit new columns; watermarks pass through with column
+  remapping when derivable.
+- FilterExecutor: src/stream/src/executor/filter.rs — predicate masks
+  visibility; UpdateDelete/UpdateInsert pairs whose halves diverge under
+  the predicate degrade to plain Delete/Insert (one half hidden).
+
+TPU notes: both operators are pure vectorized passes over the padded chunk;
+no per-row host work. Filter's pair-degradation is a shifted-mask trick,
+one fused VPU pass.
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator, List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from risingwave_tpu.common.chunk import Op, StreamChunk
+from risingwave_tpu.common.types import Field, Schema
+from risingwave_tpu.expr.expr import Expression
+from risingwave_tpu.stream.exchange import ChannelClosed, Receiver
+from risingwave_tpu.stream.executor import Executor, ExecutorInfo
+from risingwave_tpu.stream.message import (
+    Message, Watermark, is_barrier, is_chunk,
+)
+
+
+class ReceiverExecutor(Executor):
+    """Adapts one exchange Receiver into an Executor (receiver.rs)."""
+
+    def __init__(self, info: ExecutorInfo, rx: Receiver, actor_id: int = 0):
+        super().__init__(info)
+        self.rx = rx
+        self.actor_id = actor_id
+
+    async def execute(self) -> AsyncIterator[Message]:
+        while True:
+            try:
+                msg = await self.rx.recv()
+            except ChannelClosed:
+                return
+            yield msg
+            if is_barrier(msg) and msg.is_stop(self.actor_id):
+                return
+
+
+class ProjectExecutor(Executor):
+    """Vectorized projection (project.rs analog)."""
+
+    def __init__(self, input_: Executor, exprs: Sequence[Expression],
+                 names: Optional[Sequence[str]] = None,
+                 watermark_derivations: Optional[dict] = None):
+        self.input = input_
+        self.exprs = list(exprs)
+        names = list(names) if names else [
+            f"expr{i}" for i in range(len(exprs))]
+        out_fields: List[Field] = []
+        for name, e in zip(names, self.exprs):
+            out_fields.append(Field(name, e.return_type))
+        info = ExecutorInfo(Schema(out_fields), [], "ProjectExecutor")
+        super().__init__(info)
+        # input col idx -> output col idx, for passing watermarks through
+        self.watermark_derivations = dict(watermark_derivations or {})
+
+    async def execute(self) -> AsyncIterator[Message]:
+        async for msg in self.input.execute():
+            if is_chunk(msg):
+                cols = [e.eval(msg) for e in self.exprs]
+                yield StreamChunk(self.schema, cols, msg.visibility, msg.ops)
+            elif isinstance(msg, Watermark):
+                if msg.col_idx in self.watermark_derivations:
+                    yield msg.with_idx(
+                        self.watermark_derivations[msg.col_idx])
+                # underivable watermarks are dropped (reference behavior)
+            else:
+                yield msg
+
+
+class FilterExecutor(Executor):
+    """Visibility-mask filter with update-pair degradation (filter.rs)."""
+
+    def __init__(self, input_: Executor, predicate: Expression):
+        self.input = input_
+        self.predicate = predicate
+        info = ExecutorInfo(input_.schema, list(input_.pk_indices),
+                            "FilterExecutor")
+        super().__init__(info)
+
+    async def execute(self) -> AsyncIterator[Message]:
+        async for msg in self.input.execute():
+            if is_chunk(msg):
+                yield self._apply(msg)
+            else:
+                yield msg
+
+    def _apply(self, chunk: StreamChunk) -> StreamChunk:
+        pcol = self.predicate.eval(chunk)
+        pred = pcol.values.astype(bool)
+        if pcol.validity is not None:  # NULL predicate = not satisfied
+            pred = pred & pcol.validity
+        ops = chunk.ops
+        is_ud = ops == jnp.int8(int(Op.UPDATE_DELETE))
+        is_ui = ops == jnp.int8(int(Op.UPDATE_INSERT))
+        # pair (i, i+1): U- at i, U+ at i+1
+        next_is_ui = jnp.roll(is_ui, -1)
+        prev_is_ud = jnp.roll(is_ud, 1)
+        next_pred = jnp.roll(pred, -1)
+        prev_pred = jnp.roll(pred, 1)
+        # U- whose U+ half fails the predicate → plain DELETE
+        degrade_del = is_ud & next_is_ui & pred & ~next_pred
+        # U+ whose U- half fails the predicate → plain INSERT
+        degrade_ins = is_ui & prev_is_ud & pred & ~prev_pred
+        new_ops = jnp.where(degrade_del, jnp.int8(int(Op.DELETE)), ops)
+        new_ops = jnp.where(degrade_ins, jnp.int8(int(Op.INSERT)), new_ops)
+        return StreamChunk(chunk.schema, chunk.columns,
+                           chunk.visibility & pred, new_ops)

@@ -1,0 +1,199 @@
+"""Fan-in: MergeExecutor with N-way barrier alignment.
+
+Reference parity: src/stream/src/executor/merge.rs:36,112 (select-all over
+upstream inputs; an input that reaches a barrier is blocked until every
+input reaches the same barrier, then one aligned barrier is emitted) and
+src/stream/src/executor/barrier_align.rs:34,43 (the 2-way variant joins use).
+Watermarks follow the reference's BufferedWatermarks: emit the min across
+inputs, monotonically.
+
+This alignment is the Chandy-Lamport cut: everything before the barrier on
+every input is in epoch N, everything after in N+1.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator, Dict, List, Optional
+
+from risingwave_tpu.common.chunk import StreamChunk
+from risingwave_tpu.stream.exchange import ChannelClosed, Receiver
+from risingwave_tpu.stream.executor import Executor, ExecutorInfo
+from risingwave_tpu.stream.message import (
+    Barrier, Message, Watermark, is_barrier,
+)
+
+
+class _WatermarkAligner:
+    """Per-column min-watermark across N inputs (monotonic output)."""
+
+    def __init__(self, n_inputs: int):
+        self.n = n_inputs
+        self.per_col: Dict[int, Dict[int, object]] = {}
+        self.emitted: Dict[int, object] = {}
+
+    def update(self, input_idx: int, wm: Watermark) -> Optional[Watermark]:
+        seen = self.per_col.setdefault(wm.col_idx, {})
+        seen[input_idx] = wm.value
+        if len(seen) < self.n:
+            return None
+        lo = min(seen.values())
+        if wm.col_idx in self.emitted and lo <= self.emitted[wm.col_idx]:
+            return None
+        self.emitted[wm.col_idx] = lo
+        return Watermark(wm.col_idx, wm.data_type, lo)
+
+    def remove_input(self, input_idx: int) -> None:
+        for seen in self.per_col.values():
+            seen.pop(input_idx, None)
+
+
+class MergeExecutor(Executor):
+    """Merge N upstream channels into one aligned stream."""
+
+    def __init__(self, info: ExecutorInfo, inputs: List[Receiver],
+                 actor_id: int = 0):
+        super().__init__(info)
+        self.inputs = list(inputs)
+        self.actor_id = actor_id
+
+    async def execute(self) -> AsyncIterator[Message]:
+        n = len(self.inputs)
+        assert n > 0, "MergeExecutor needs at least one input"
+        wm_align = _WatermarkAligner(n)
+        out: asyncio.Queue = asyncio.Queue(maxsize=16)
+        # per-input gate: the pump may proceed past a barrier only when the
+        # aligner releases it for the next epoch
+        gates = [asyncio.Event() for _ in range(n)]
+        barrier_box: List[Optional[Barrier]] = [None] * n
+        arrived = asyncio.Queue()  # input indices that hit a barrier
+
+        async def pump(i: int, rx: Receiver):
+            try:
+                while True:
+                    msg = await rx.recv()
+                    if is_barrier(msg):
+                        barrier_box[i] = msg
+                        gates[i].clear()
+                        arrived.put_nowait(i)
+                        await gates[i].wait()  # blocked until all aligned
+                        if barrier_box[i] is StopIteration:  # closed
+                            return
+                    else:
+                        await out.put((i, msg))
+            except ChannelClosed:
+                arrived.put_nowait((i, "closed"))
+
+        pumps = [asyncio.ensure_future(pump(i, rx))
+                 for i, rx in enumerate(self.inputs)]
+        live = set(range(n))
+        try:
+            while live:
+                pending_barrier: Dict[int, Barrier] = {}
+                closed: set = set()
+                # drain data until every live input parks at a barrier
+                while len(pending_barrier) + len(closed) < len(live):
+                    getter = asyncio.ensure_future(out.get())
+                    arr = asyncio.ensure_future(arrived.get())
+                    done, _ = await asyncio.wait(
+                        {getter, arr}, return_when=asyncio.FIRST_COMPLETED)
+                    if getter in done:
+                        i, msg = getter.result()
+                        if isinstance(msg, Watermark):
+                            w = wm_align.update(i, msg)
+                            if w is not None:
+                                yield w
+                        else:
+                            yield msg
+                    else:
+                        getter.cancel()
+                    if arr in done:
+                        ev = arr.result()
+                        if isinstance(ev, tuple):  # (i, "closed")
+                            closed.add(ev[0])
+                        else:
+                            pending_barrier[ev] = barrier_box[ev]
+                    else:
+                        arr.cancel()
+                # all inputs aligned (or closed): emit one barrier
+                for i in closed:
+                    live.discard(i)
+                    wm_align.remove_input(i)
+                    wm_align.n = max(1, len(live))
+                if not pending_barrier:
+                    return  # every upstream closed without a barrier
+                barriers = list(pending_barrier.values())
+                epochs = {b.epoch.curr.value for b in barriers}
+                assert len(epochs) == 1, \
+                    f"misaligned barriers across inputs: {barriers}"
+                yield barriers[0].with_passed(self.actor_id)
+                stop = barriers[0].is_stop(self.actor_id)
+                for i in pending_barrier:
+                    if stop:
+                        barrier_box[i] = StopIteration
+                    gates[i].set()
+                if stop:
+                    return
+        finally:
+            for p in pumps:
+                p.cancel()
+            for rx in self.inputs:
+                rx.close()
+
+
+async def barrier_align_2(left: AsyncIterator[Message],
+                          right: AsyncIterator[Message]
+                          ) -> AsyncIterator[tuple]:
+    """2-way alignment for binary operators (barrier_align.rs:34 analog).
+
+    Yields ("left"|"right", msg) for data and ("barrier", Barrier) once per
+    aligned pair. Ends when either side ends.
+    """
+    async def nxt(it):
+        try:
+            return await it.__anext__()
+        except StopAsyncIteration:
+            return None
+
+    lt = asyncio.ensure_future(nxt(left))
+    rt = asyncio.ensure_future(nxt(right))
+    l_barrier: Optional[Barrier] = None
+    r_barrier: Optional[Barrier] = None
+    try:
+        while True:
+            if l_barrier is not None and r_barrier is not None:
+                assert l_barrier.epoch == r_barrier.epoch, \
+                    (l_barrier, r_barrier)
+                yield ("barrier", l_barrier)
+                l_barrier = r_barrier = None
+                lt = asyncio.ensure_future(nxt(left))
+                rt = asyncio.ensure_future(nxt(right))
+                continue
+            waits = set()
+            if l_barrier is None:
+                waits.add(lt)
+            if r_barrier is None:
+                waits.add(rt)
+            done, _ = await asyncio.wait(
+                waits, return_when=asyncio.FIRST_COMPLETED)
+            if lt in done and l_barrier is None:
+                msg = lt.result()
+                if msg is None:
+                    return
+                if is_barrier(msg):
+                    l_barrier = msg
+                else:
+                    yield ("left", msg)
+                    lt = asyncio.ensure_future(nxt(left))
+            if rt in done and r_barrier is None:
+                msg = rt.result()
+                if msg is None:
+                    return
+                if is_barrier(msg):
+                    r_barrier = msg
+                else:
+                    yield ("right", msg)
+                    rt = asyncio.ensure_future(nxt(right))
+    finally:
+        lt.cancel()
+        rt.cancel()

@@ -162,3 +162,39 @@ def test_tumble_null_window():
                  [col(s, "ts"), Literal(None, DataType.INTERVAL)],
                  DataType.TIMESTAMP)
     assert _vals(e.eval(c), 1) == [None]
+
+
+# -- round-2 review-fix regressions -----------------------------------------
+
+
+def test_decimal_to_float_cast():
+    import decimal as _d
+    s = Schema.of(d=DataType.DECIMAL, f=DataType.FLOAT64)
+    c = DataChunk.from_pydict(s, {"d": [_d.Decimal("1.5")], "f": [2.0]})
+    out = (col(s, "d") + col(s, "f")).eval(c)
+    assert out.data_type == DataType.FLOAT64
+    assert abs(float(out.values[0]) - 3.5) < 1e-9
+
+
+def test_modulo_truncated_sign():
+    s = Schema.of(a=DataType.INT64, b=DataType.INT64)
+    c = DataChunk.from_pydict(s, {"a": [-7, 7, -7, 7], "b": [3, 3, -3, -3]})
+    out = (col(s, "a") % col(s, "b")).eval(c)
+    assert [int(v) for v in out.values[:4]] == [-1, 1, -1, 1]
+
+
+def test_host_cmp_interval_with_padding():
+    from risingwave_tpu.common.types import Interval
+    s = Schema.of(iv=DataType.INTERVAL)
+    c = DataChunk.from_pydict(s, {"iv": [Interval(days=1)]})  # capacity 8
+    out = (col(s, "iv") < lit(Interval(usecs=360_000_000_000),
+                              DataType.INTERVAL)).eval(c)
+    # 1 day < 100 hours under justified comparison
+    assert bool(out.values[0])
+
+
+def test_interval_justified_ordering():
+    from risingwave_tpu.common.types import Interval
+    assert Interval(days=1) < Interval(usecs=360_000_000_000)
+    assert Interval(months=1) == Interval(days=30)
+    assert Interval(months=1) > Interval(days=29)

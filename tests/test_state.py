@@ -208,3 +208,21 @@ def test_state_table_vnode_bitmap_swap():
     _advance(t)
     prev = t.update_vnode_bitmap(np.arange(256) < 128)
     assert prev.all() and len(t.owned_vnodes()) == 128
+
+
+def test_decimal_pk_logical_value_consistency():
+    """5, 5.0 and Decimal('5') must encode to the same key and vnode."""
+    import decimal as _d
+    from risingwave_tpu.state.keycodec import encode_value
+    assert encode_value(5, DataType.DECIMAL) == \
+        encode_value(_d.Decimal("5"), DataType.DECIMAL) == \
+        encode_value(5.0, DataType.DECIMAL)
+
+    schema = Schema.of(d=DataType.DECIMAL, v=DataType.INT64)
+    store = MemoryStateStore()
+    t = StateTable(9, schema, pk_indices=[0], store=store,
+                   dist_key_indices=[0])
+    t.init_epoch(EpochPair.new_initial(Epoch.from_physical(1)))
+    t.insert((_d.Decimal("5"), 1))
+    assert t.get_row((5,)) == (_d.Decimal("5"), 1)
+    assert t.get_row((_d.Decimal("5"),)) == (_d.Decimal("5"), 1)

@@ -1,0 +1,309 @@
+"""Executor-spine tests: channels, merge alignment, dispatch, actors.
+
+Mirrors the reference's executor-test stance (SURVEY §4): MockSource feeds
+hand-built chunks/barriers; outputs asserted chunk-by-chunk.
+"""
+
+import asyncio
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from risingwave_tpu.common.chunk import Op, StreamChunk
+from risingwave_tpu.common.epoch import Epoch, EpochPair
+from risingwave_tpu.common.types import DataType, Schema
+from risingwave_tpu.expr import col, lit
+from risingwave_tpu.state.state_table import StateTable
+from risingwave_tpu.state.store import MemoryStateStore
+from risingwave_tpu.stream import (
+    Barrier, BarrierKind, LocalBarrierManager, MergeExecutor, StopMutation,
+    Watermark, channel_for_test, is_barrier, is_chunk,
+)
+from risingwave_tpu.stream.actor import Actor
+from risingwave_tpu.stream.dispatch import (
+    HashDispatcher, Output, SimpleDispatcher,
+)
+from risingwave_tpu.stream.executor import ExecutorInfo
+from risingwave_tpu.stream.executors import (
+    FilterExecutor, MaterializeExecutor, MockSource, ProjectExecutor,
+    ReceiverExecutor,
+)
+from risingwave_tpu.stream.executors.test_utils import collect_until_n_barriers
+
+SCHEMA = Schema.of(k=DataType.INT64, v=DataType.INT64)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def barrier(n: int, mutation=None, kind=BarrierKind.CHECKPOINT) -> Barrier:
+    curr, prev = Epoch.from_physical(n), (
+        Epoch.from_physical(n - 1) if n > 1 else Epoch.INVALID)
+    return Barrier(EpochPair(curr, prev), kind, mutation)
+
+
+def chunk(ks, vs, ops=None) -> StreamChunk:
+    return StreamChunk.from_pydict(SCHEMA, {"k": ks, "v": vs}, ops=ops)
+
+
+def visible_rows(c: StreamChunk):
+    return c.to_records()
+
+
+# ---------------------------------------------------------------------------
+
+
+def test_channel_roundtrip_and_close():
+    async def go():
+        tx, rx = channel_for_test()
+        await tx.send(chunk([1], [2]))
+        await tx.send(barrier(1))
+        tx.close()
+        m1 = await rx.recv()
+        assert is_chunk(m1)
+        m2 = await rx.recv()
+        assert is_barrier(m2)
+        from risingwave_tpu.stream import ChannelClosed
+        with pytest.raises(ChannelClosed):
+            await rx.recv()
+    run(go())
+
+
+def test_channel_backpressure_releases_on_recv():
+    async def go():
+        from risingwave_tpu.stream.exchange import channel
+        tx, rx = channel(chunk_permits=16, barrier_permits=2,
+                         max_chunk_cost=8)
+        # each 8-capacity chunk costs 8: two fit, third must wait
+        for _ in range(2):
+            await tx.send(chunk([1], [2]))
+        third = asyncio.ensure_future(tx.send(chunk([3], [4])))
+        await asyncio.sleep(0.01)
+        assert not third.done(), "third send should be blocked on permits"
+        await rx.recv()
+        await asyncio.wait_for(third, 1.0)
+    run(go())
+
+
+def test_project_filter_chain():
+    async def go():
+        msgs = [
+            barrier(1),
+            chunk([1, 2, 3, 4], [10, 20, 30, 40]),
+            barrier(2),
+        ]
+        src = MockSource(SCHEMA, msgs)
+        s = src.schema
+        proj = ProjectExecutor(
+            src, [col(s, "k"), col(s, "v") * lit(2)], names=["k", "v2"])
+        filt = FilterExecutor(proj, col(proj.schema, "v2") > lit(40))
+        out = await collect_until_n_barriers(filt, 2)
+        chunks = [m for m in out if is_chunk(m)]
+        assert len(chunks) == 1
+        assert chunks[0].to_records() == [
+            (Op.INSERT, (3, 60)), (Op.INSERT, (4, 80))]
+    run(go())
+
+
+def test_filter_update_pair_degradation():
+    async def go():
+        # pk 1: v 10 -> 60 (new half passes only) ; pk 2: v 70 -> 20 (old only)
+        c = chunk([1, 1, 2, 2], [10, 60, 70, 20],
+                  ops=[Op.UPDATE_DELETE, Op.UPDATE_INSERT,
+                       Op.UPDATE_DELETE, Op.UPDATE_INSERT])
+        src = MockSource(SCHEMA, [barrier(1), c, barrier(2)])
+        filt = FilterExecutor(src, col(SCHEMA, "v") > lit(40))
+        out = await collect_until_n_barriers(filt, 2)
+        recs = [m for m in out if is_chunk(m)][0].to_records()
+        assert recs == [(Op.INSERT, (1, 60)), (Op.DELETE, (2, 70))]
+    run(go())
+
+
+def test_merge_aligns_barriers():
+    async def go():
+        tx1, rx1 = channel_for_test()
+        tx2, rx2 = channel_for_test()
+        merge = MergeExecutor(ExecutorInfo(SCHEMA, [], "Merge"), [rx1, rx2])
+
+        async def feed():
+            await tx1.send(chunk([1], [1]))
+            await tx1.send(barrier(1))
+            await tx1.send(chunk([3], [3]))   # epoch-2 data on input 1
+            await asyncio.sleep(0.01)
+            await tx2.send(chunk([2], [2]))
+            await tx2.send(barrier(1))
+            await tx2.send(barrier(2))        # input 2 races ahead
+            await tx1.send(barrier(2))
+            tx1.close()
+            tx2.close()
+
+        feeder = asyncio.ensure_future(feed())
+        out = await collect_until_n_barriers(merge, 2)
+        await feeder
+        kinds = ["B" if is_barrier(m) else "C" for m in out]
+        # both data chunks precede the first aligned barrier; the epoch-2
+        # chunk comes after it
+        assert kinds == ["C", "C", "B", "C", "B"]
+        b1 = [m for m in out if is_barrier(m)][0]
+        assert b1.epoch.curr == Epoch.from_physical(1)
+    run(go())
+
+
+def test_merge_blocks_fast_input_until_alignment():
+    async def go():
+        tx1, rx1 = channel_for_test()
+        tx2, rx2 = channel_for_test()
+        merge = MergeExecutor(ExecutorInfo(SCHEMA, [], "Merge"), [rx1, rx2])
+        got = []
+
+        async def consume():
+            async for m in merge.execute():
+                got.append(m)
+                if is_barrier(m) and m.epoch.curr == Epoch.from_physical(2):
+                    return
+
+        task = asyncio.ensure_future(consume())
+        await tx1.send(barrier(1))
+        # input 1 sends epoch-2 data + barrier before input 2 says anything
+        await tx1.send(chunk([9], [9]))
+        await tx1.send(barrier(2))
+        await asyncio.sleep(0.05)
+        # nothing may be emitted yet: input 2 hasn't reached barrier 1
+        assert got == []
+        await tx2.send(barrier(1))
+        await tx2.send(barrier(2))
+        await asyncio.wait_for(task, 2.0)
+        kinds = ["B" if is_barrier(m) else "C" for m in got]
+        assert kinds == ["B", "C", "B"]
+    run(go())
+
+
+def test_hash_dispatch_partition_is_exhaustive_and_consistent():
+    async def go():
+        n_out = 3
+        chans = [channel_for_test() for _ in range(n_out)]
+        outputs = [Output(i, tx) for i, (tx, _) in enumerate(chans)]
+        disp = HashDispatcher(outputs, dist_key_indices=[0])
+        ks = list(range(40)) * 2  # duplicate keys must route identically
+        c = chunk(ks, [i * 10 for i in range(80)])
+        await disp.dispatch_data(c)
+        seen = {}
+        total = 0
+        for i, (_, rx) in enumerate(chans):
+            sub = await rx.recv()
+            recs = sub.to_records()
+            total += len(recs)
+            for _, (k, v) in recs:
+                assert seen.setdefault(k, i) == i, \
+                    f"key {k} routed to two outputs"
+        assert total == 80
+    run(go())
+
+
+def test_hash_dispatch_update_pair_degraded_across_outputs():
+    async def go():
+        chans = [channel_for_test() for _ in range(2)]
+        outputs = [Output(i, tx) for i, (tx, _) in enumerate(chans)]
+        disp = HashDispatcher(outputs, dist_key_indices=[0])
+        # find two keys routed to different outputs
+        probe = chunk(list(range(16)), [0] * 16)
+        owner = disp._route(probe)
+        k_a = 0
+        k_b = next(k for k in range(1, 16) if owner[k] != owner[k_a])
+        c = chunk([k_a, k_b], [1, 2],
+                  ops=[Op.UPDATE_DELETE, Op.UPDATE_INSERT])
+        await disp.dispatch_data(c)
+        recs = []
+        for _, rx in chans:
+            recs += (await rx.recv()).to_records()
+        ops = sorted(op for op, _ in recs)
+        assert ops == [Op.INSERT, Op.DELETE]  # degraded, atomic halves
+    run(go())
+
+
+def test_materialize_commits_on_barrier():
+    async def go():
+        store = MemoryStateStore()
+        table = StateTable(1, SCHEMA, pk_indices=[0], store=store)
+        msgs = [
+            barrier(1, kind=BarrierKind.INITIAL),
+            chunk([1, 2], [10, 20]),
+            barrier(2),
+            chunk([1], [10], ops=[Op.DELETE]),
+            chunk([3], [30]),
+            barrier(3),
+        ]
+        mat = MaterializeExecutor(MockSource(SCHEMA, msgs), table)
+        await collect_until_n_barriers(mat, 3)
+        store.seal_epoch(Epoch.from_physical(2).value)
+        assert table.get_row((1,)) is None
+        assert table.get_row((2,)) == (2, 20)
+        assert table.get_row((3,)) == (3, 30)
+        rows = [r for _, r in table.iter_rows()]
+        assert rows == [(2, 20), (3, 30)]
+    run(go())
+
+
+def test_actor_reports_barrier_to_manager():
+    async def go():
+        mgr = LocalBarrierManager()
+        tx, rx = channel_for_test()
+        src = ReceiverExecutor(ExecutorInfo(SCHEMA, [], "Recv"), rx,
+                               actor_id=7)
+        out_tx, out_rx = channel_for_test()
+        actor = Actor(7, src, [SimpleDispatcher(Output(99, out_tx))],
+                      barrier_manager=mgr)
+        mgr.register_sender(7, tx)
+        mgr.set_expected_actors([7])
+        task = actor.spawn()
+
+        b1 = barrier(1, kind=BarrierKind.INITIAL)
+        await mgr.send_barrier(b1)
+        done = await asyncio.wait_for(
+            mgr.await_epoch_complete(b1.epoch.curr.value), 2.0)
+        assert done.epoch == b1.epoch
+
+        b2 = barrier(2, mutation=StopMutation(frozenset({7})))
+        await mgr.send_barrier(b2)
+        await asyncio.wait_for(
+            mgr.await_epoch_complete(b2.epoch.curr.value), 2.0)
+        await asyncio.wait_for(task, 2.0)
+        assert actor.failure is None
+        # downstream saw both barriers
+        msgs = []
+        while True:
+            try:
+                msgs.append(await asyncio.wait_for(out_rx.recv(), 0.1))
+            except Exception:
+                break
+        assert [m.epoch.curr.physical_ms for m in msgs if is_barrier(m)] \
+            == [1, 2]
+    run(go())
+
+
+def test_watermark_min_alignment_in_merge():
+    async def go():
+        tx1, rx1 = channel_for_test()
+        tx2, rx2 = channel_for_test()
+        merge = MergeExecutor(ExecutorInfo(SCHEMA, [], "Merge"), [rx1, rx2])
+
+        async def feed():
+            await tx1.send(Watermark(0, DataType.INT64, 100))
+            await tx2.send(Watermark(0, DataType.INT64, 50))
+            await tx1.send(barrier(1))
+            await tx2.send(barrier(1))
+            await tx1.send(Watermark(0, DataType.INT64, 120))
+            await tx2.send(Watermark(0, DataType.INT64, 110))
+            await tx1.send(barrier(2))
+            await tx2.send(barrier(2))
+            tx1.close()
+            tx2.close()
+
+        feeder = asyncio.ensure_future(feed())
+        out = await collect_until_n_barriers(merge, 2)
+        await feeder
+        wms = [m.value for m in out if isinstance(m, Watermark)]
+        assert wms == [50, 110]  # min across inputs, monotonic
+    run(go())
