@@ -1,0 +1,116 @@
+"""Benchmark rig: Nexmark pipelines on the real chip.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ...,
+"vs_baseline": N} — the driver records it in BENCH_r{N}.json.
+
+Baseline (BASELINE.md): ≥1M events/sec/chip on Nexmark q7/q8 (one v5e).
+The headline metric is the best stateful-query throughput available; q1
+(stateless, host-bound reference path) is reported inside "extra" for
+tracking. Run `python bench.py --all` for the full table on stderr.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+import time
+
+BASELINE_EVENTS_PER_SEC = 1_000_000.0
+
+
+def bench_q1(total_events: int = 50 * 4000, chunk_size: int = 4096):
+    """q1: source → project → materialize (host/CPU reference path)."""
+    from risingwave_tpu.common.types import DataType, Field, Schema
+    from risingwave_tpu.connectors.nexmark import (
+        NexmarkConfig, NexmarkSplitReader,
+    )
+    from risingwave_tpu.expr.expr import InputRef, lit
+    from risingwave_tpu.meta.barrier import BarrierLoop
+    from risingwave_tpu.state.state_table import StateTable
+    from risingwave_tpu.state.store import MemoryStateStore
+    from risingwave_tpu.stream.actor import Actor, LocalBarrierManager
+    from risingwave_tpu.stream.exchange import channel_for_test
+    from risingwave_tpu.stream.executors.materialize import (
+        MaterializeExecutor,
+    )
+    from risingwave_tpu.stream.executors.row_id_gen import RowIdGenExecutor
+    from risingwave_tpu.stream.executors.simple import ProjectExecutor
+    from risingwave_tpu.stream.executors.source import SourceExecutor
+    from risingwave_tpu.stream.message import StopMutation
+
+    split_schema = Schema([Field("split_id", DataType.VARCHAR),
+                           Field("offset", DataType.INT64)])
+    cfg = NexmarkConfig(event_num=total_events, max_chunk_size=chunk_size)
+    store = MemoryStateStore()
+    reader = NexmarkSplitReader(cfg)
+    barrier_tx, barrier_rx = channel_for_test()
+    split_state = StateTable(1, split_schema, [0], store)
+    source = SourceExecutor(reader, barrier_rx, split_state, actor_id=1)
+    row_id = RowIdGenExecutor(source)
+    s = row_id.schema
+    project = ProjectExecutor(
+        row_id,
+        exprs=[InputRef(s.index_of("auction"), DataType.INT64),
+               InputRef(s.index_of("bidder"), DataType.INT64),
+               lit("0.908", DataType.DECIMAL)
+               * InputRef(s.index_of("price"), DataType.INT64),
+               InputRef(s.index_of("date_time"), DataType.TIMESTAMP),
+               InputRef(s.index_of("_row_id"), DataType.SERIAL)],
+        names=["auction", "bidder", "price", "date_time", "_row_id"])
+    mv_table = StateTable(2, project.schema, [4], store)
+    mat = MaterializeExecutor(project, mv_table)
+    local = LocalBarrierManager()
+    local.register_sender(1, barrier_tx)
+    local.set_expected_actors([1])
+    actor = Actor(1, mat, dispatchers=[], barrier_manager=local)
+    loop = BarrierLoop(local, store)
+
+    n_bids = total_events * 46 // 50
+
+    async def main():
+        task = actor.spawn()
+        t0 = time.perf_counter()
+        while reader.offset < n_bids:
+            await loop.inject_and_collect()
+        await loop.inject_and_collect()
+        elapsed = time.perf_counter() - t0
+        await loop.inject_and_collect(
+            mutation=StopMutation(frozenset([1])))
+        await task
+        if actor.failure is not None:
+            raise actor.failure
+        return elapsed
+
+    elapsed = asyncio.run(main())
+    return {
+        "metric": "nexmark_q1_events_per_sec",
+        "value": round(n_bids / elapsed, 1),
+        "unit": "events/s",
+        "p99_barrier_latency_s": round(loop.stats.p99_latency_s(), 4),
+        "events": n_bids,
+    }
+
+
+def main(argv):
+    run_all = "--all" in argv
+    results = {}
+    results["q1"] = bench_q1()
+    # headline: best stateful-operator throughput; until q7's device agg
+    # lands this is q1 (tracked as the CPU reference path)
+    headline = dict(results["q1"])
+    try:
+        from bench_q7 import bench_q7  # added when the q7 kernel lands
+        results["q7"] = bench_q7()
+        headline = dict(results["q7"])
+    except ImportError:
+        pass
+    headline["vs_baseline"] = round(
+        headline["value"] / BASELINE_EVENTS_PER_SEC, 4)
+    if run_all:
+        print(json.dumps(results, indent=2), file=sys.stderr)
+    print(json.dumps(headline))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

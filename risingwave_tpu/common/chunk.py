@@ -5,9 +5,14 @@ src/common/src/array/stream_chunk.rs:87.
 
 TPU-first design decisions (deliberately NOT a port of the Rust arrays):
 
-- A chunk is a set of fixed-capacity columns. Device-typed columns are JAX
-  arrays in HBM; varchar/bytea/jsonb columns stay on host as numpy object
-  arrays (strings never ship to the device).
+- A chunk is a set of fixed-capacity columns. Columns are HOST-resident
+  numpy arrays by default; device residency begins exactly at stateful
+  kernels, which call ``to_device()`` once per chunk (upload is cheap and
+  async) and transfer back only at barrier flush via one batched
+  ``jax.device_get``. Stateless operators (project/filter/dispatch) never
+  touch the device — per-op device dispatch would be latency-bound, not
+  compute-bound. varchar/bytea/jsonb columns are always host (numpy object
+  arrays; strings never ship to the device).
 - Row validity is a single boolean *visibility* array (doubles as both the
   reference's visibility bitmap and the padding mask). Capacity is padded to
   a power-of-two bucket so XLA sees a small, stable set of static shapes —
@@ -62,11 +67,25 @@ class Op(enum.IntEnum):
         return 1 if self.is_insert else -1
 
 
+def get_xp(*arrays):
+    """numpy for host arrays, jax.numpy once anything is a jax array/tracer.
+
+    The chunk/expression layer is backend-polymorphic: chunks stay numpy
+    (host) through stateless operators; the same code traces under jit when
+    a stateful kernel pulls arrays to the device (to_device()).
+    """
+    for a in arrays:
+        if isinstance(a, (jax.Array, jax.core.Tracer)):
+            return jnp
+    return np
+
+
 # Vectorized op→sign: ops in {1,2,3,4}; insert-ish ops are odd (1) or 4.
-def ops_to_signs(ops: jnp.ndarray) -> jnp.ndarray:
+def ops_to_signs(ops) -> "jnp.ndarray":
     """+1 for INSERT/UPDATE_INSERT, -1 for DELETE/UPDATE_DELETE (int32)."""
+    xp = get_xp(ops)
     is_ins = (ops == Op.INSERT) | (ops == Op.UPDATE_INSERT)
-    return jnp.where(is_ins, jnp.int32(1), jnp.int32(-1))
+    return xp.where(is_ins, xp.int32(1), xp.int32(-1))
 
 
 @dataclass
@@ -139,8 +158,8 @@ def _make_column(dt: DataType, values, capacity: int,
                 val[:n] = np.asarray(validity, dtype=bool)
             if null_mask is not None:
                 val[:n] &= ~null_mask
-            out_validity = jnp.asarray(val)
-        return Column(dt, jnp.asarray(arr), out_validity)
+            out_validity = val
+        return Column(dt, arr, out_validity)
     else:
         arr = np.empty(capacity, dtype=object)
         # fromiter keeps tuple/list elements scalar (STRUCT/LIST columns)
@@ -178,7 +197,7 @@ class DataChunk:
                 for f, vals in zip(schema, ncols)]
         vis = np.zeros(cap, dtype=bool)
         vis[:n] = True
-        return DataChunk(schema, cols, jnp.asarray(vis))
+        return DataChunk(schema, cols, vis)
 
     @staticmethod
     def from_arrays(schema: Schema, arrays: Sequence, num_rows: int,
@@ -193,7 +212,7 @@ class DataChunk:
             raise ValueError(f"num_rows={num_rows} exceeds capacity {cap}")
         vis = np.zeros(cap, dtype=bool)
         vis[:num_rows] = True
-        return DataChunk(schema, cols, jnp.asarray(vis))
+        return DataChunk(schema, cols, vis)
 
     @classmethod
     def empty(cls, schema: Schema, capacity: int = 8) -> "DataChunk":
@@ -207,7 +226,7 @@ class DataChunk:
 
     def cardinality(self) -> int:
         """Number of visible rows (host sync)."""
-        return int(jnp.sum(self.visibility))
+        return int(np.sum(np.asarray(self.visibility)))
 
     def column(self, name: str) -> Column:
         return self.columns[self.schema.index_of(name)]
@@ -217,6 +236,26 @@ class DataChunk:
 
     def device_columns(self) -> List[jnp.ndarray]:
         return [c.values for c in self.columns if c.is_device]
+
+    # -- device boundary -----------------------------------------------
+    def _device_parts(self):
+        cols = [
+            Column(c.data_type, jnp.asarray(c.values),
+                   None if c.validity is None else jnp.asarray(c.validity))
+            if c.is_device else c
+            for c in self.columns
+        ]
+        return cols, jnp.asarray(np.asarray(self.visibility))
+
+    def to_device(self) -> "DataChunk":
+        """Upload device-typed columns + visibility to HBM (async, cheap).
+
+        This is THE device boundary: stateless operators never call it;
+        stateful kernels call it once per chunk and never transfer back
+        until barrier flush (batched jax.device_get there).
+        """
+        cols, vis = self._device_parts()
+        return DataChunk(self.schema, cols, vis)
 
     # -- transforms ----------------------------------------------------
     def project(self, indices: Sequence[int]) -> "DataChunk":
@@ -284,19 +323,22 @@ class StreamChunk(DataChunk):
         o = np.full(base.capacity, int(Op.INSERT), dtype=np.int8)
         if ops is not None:
             o[:n] = np.asarray([int(x) for x in ops], dtype=np.int8)
-        return StreamChunk(schema, base.columns, base.visibility,
-                           jnp.asarray(o))
+        return StreamChunk(schema, base.columns, base.visibility, o)
 
     @staticmethod
     def from_data_chunk(chunk: DataChunk,
                         ops: Optional[jnp.ndarray] = None) -> "StreamChunk":
-        o = ops if ops is not None else jnp.full(
-            chunk.capacity, int(Op.INSERT), dtype=jnp.int8)
+        o = ops if ops is not None else np.full(
+            chunk.capacity, int(Op.INSERT), dtype=np.int8)
         return StreamChunk(chunk.schema, chunk.columns, chunk.visibility, o)
 
     def signs(self) -> jnp.ndarray:
         """+1/-1 per row (masked rows included; gate with visibility)."""
         return ops_to_signs(self.ops)
+
+    def to_device(self) -> "StreamChunk":
+        cols, vis = self._device_parts()
+        return StreamChunk(self.schema, cols, vis, jnp.asarray(self.ops))
 
     def project(self, indices: Sequence[int]) -> "StreamChunk":
         return StreamChunk(self.schema.select(indices),
@@ -309,6 +351,30 @@ class StreamChunk(DataChunk):
     def with_columns(self, schema: Schema,
                      columns: Sequence[Column]) -> "StreamChunk":
         return StreamChunk(schema, columns, self.visibility, self.ops)
+
+    def to_physical_records(self) -> Tuple[np.ndarray, List[tuple], np.ndarray]:
+        """Vectorized extraction of visible rows as *physical* tuples.
+
+        Returns (visible_idx, rows, ops[visible]) where rows hold raw
+        physical values (DECIMAL as scaled int, timestamps as µs ints,
+        NULL as None) — the representation state tables store. No per-row
+        Python beyond C-speed zip; this is the barrier-flush hot path.
+        """
+        vis = np.asarray(self.visibility)
+        idx = np.flatnonzero(vis)
+        cols: List[list] = []
+        for c in self.columns:
+            vals = np.asarray(c.values)[idx]
+            if c.validity is not None:
+                nulls = ~np.asarray(c.validity)[idx]
+                if nulls.any():
+                    out = vals.astype(object)
+                    out[nulls] = None
+                    cols.append(out.tolist())
+                    continue
+            cols.append(vals.tolist())
+        rows = list(zip(*cols)) if cols else []
+        return idx, rows, np.asarray(self.ops)[idx]
 
     def to_records(self, compact: bool = True) -> List[tuple]:
         """[(Op, row-tuple)] for visible rows."""

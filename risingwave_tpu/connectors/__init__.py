@@ -1,0 +1,23 @@
+"""Connectors: sources that feed pipelines and sinks that drain them.
+
+Reference parity: src/connector/ (source framework src/connector/src/source/
+base.rs:86,282) — here re-designed around vectorized chunk generation: a
+split reader produces whole numpy/JAX column batches, never per-row Python
+(SURVEY.md §7 hard part 6: 1M ev/s dies if ingest is row-bound).
+"""
+
+from risingwave_tpu.connectors.nexmark import (
+    AUCTION_SCHEMA,
+    BID_SCHEMA,
+    PERSON_SCHEMA,
+    NexmarkConfig,
+    NexmarkSplitReader,
+)
+
+__all__ = [
+    "AUCTION_SCHEMA",
+    "BID_SCHEMA",
+    "PERSON_SCHEMA",
+    "NexmarkConfig",
+    "NexmarkSplitReader",
+]

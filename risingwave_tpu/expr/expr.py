@@ -23,7 +23,7 @@ from typing import Callable, Dict, Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from risingwave_tpu.common.chunk import Column, DataChunk
+from risingwave_tpu.common.chunk import Column, DataChunk, get_xp
 from risingwave_tpu.common.types import (
     DECIMAL_SCALE,
     DataType,
@@ -52,17 +52,18 @@ def promote_numeric(lt: DataType, rt: DataType) -> DataType:
                               _NUMERIC_ORDER.index(rt))]
 
 
-def _cast_values(vals: jnp.ndarray, src: DataType, dst: DataType) -> jnp.ndarray:
+def _cast_values(vals, src: DataType, dst: DataType):
+    xp = get_xp(vals)
     if src == dst:
         return vals
     if dst == DataType.DECIMAL:
         if src in (DataType.FLOAT32, DataType.FLOAT64):
-            return jnp.rint(vals * DECIMAL_SCALE).astype(jnp.int64)
-        return vals.astype(jnp.int64) * jnp.int64(DECIMAL_SCALE)
+            return xp.rint(vals * DECIMAL_SCALE).astype(xp.int64)
+        return vals.astype(xp.int64) * xp.int64(DECIMAL_SCALE)
     if src == DataType.DECIMAL:
         # decimal → float: divide in the destination float dtype
-        return vals.astype(dst.dtype) / jnp.asarray(DECIMAL_SCALE,
-                                                    dtype=dst.dtype)
+        return vals.astype(dst.dtype) / xp.asarray(DECIMAL_SCALE,
+                                                   dtype=dst.dtype)
     return vals.astype(dst.dtype)
 
 
@@ -75,12 +76,13 @@ def _merge_validity(a: Optional[jnp.ndarray],
     return a & b
 
 
-def _div_trunc(num: jnp.ndarray, den: jnp.ndarray) -> jnp.ndarray:
+def _div_trunc(num, den):
     """Integer division truncating toward zero (SQL numeric semantics)."""
+    xp = get_xp(num, den)
     q = num // den
     rem = num % den
     neg = (num < 0) != (den < 0)
-    return jnp.where(neg & (rem != 0), q + 1, q)
+    return xp.where(neg & (rem != 0), q + 1, q)
 
 
 # ---------------------------------------------------------------------------
@@ -174,13 +176,15 @@ class Literal(Expression):
     def eval(self, chunk: DataChunk) -> Column:
         cap = chunk.capacity
         dt = self.return_type
+        xp = get_xp(chunk.visibility)
         if self.value is None:
-            vals = (jnp.zeros(cap, dtype=dt.dtype) if dt.is_device
+            vals = (xp.zeros(cap, dtype=dt.np_dtype) if dt.is_device
                     else np.full(cap, None, dtype=object))
-            validity = jnp.zeros(cap, dtype=bool)
+            validity = xp.zeros(cap, dtype=bool)
             return Column(dt, vals, validity)
         if dt.is_device:
-            return Column(dt, jnp.full(cap, self._physical(), dtype=dt.dtype))
+            return Column(dt, xp.full(cap, self._physical(),
+                                      dtype=dt.np_dtype))
         return Column(dt, np.full(cap, self.value, dtype=object))
 
     def __repr__(self):
@@ -231,12 +235,13 @@ class BinaryOp(Expression):
             return self._eval_host_cmp(chunk, lc, rc)
         lv = _cast_values(lc.values, lc.data_type, self._common)
         rv = _cast_values(rc.values, rc.data_type, self._common)
+        xp = get_xp(lv, rv)
         validity = _merge_validity(lc.validity, rc.validity)
         op = self.op
         if op in _CMP_OPS:
-            fn = {"=": jnp.equal, "<>": jnp.not_equal, "<": jnp.less,
-                  "<=": jnp.less_equal, ">": jnp.greater,
-                  ">=": jnp.greater_equal}[op]
+            fn = {"=": xp.equal, "<>": xp.not_equal, "<": xp.less,
+                  "<=": xp.less_equal, ">": xp.greater,
+                  ">=": xp.greater_equal}[op]
             return Column(DataType.BOOLEAN, fn(lv, rv), validity)
         if op == "+":
             out = lv + rv
@@ -244,23 +249,23 @@ class BinaryOp(Expression):
             out = lv - rv
         elif op == "*":
             if self._common == DataType.DECIMAL:
-                out = _div_trunc(lv * rv, jnp.int64(DECIMAL_SCALE))
+                out = _div_trunc(lv * rv, xp.int64(DECIMAL_SCALE))
             else:
                 out = lv * rv
         elif op == "%":
             zero = rv == 0
-            safe = jnp.where(zero, jnp.ones_like(rv), rv)
+            safe = xp.where(zero, xp.ones_like(rv), rv)
             if self._common in (DataType.FLOAT32, DataType.FLOAT64):
-                out = jnp.fmod(lv, safe)  # truncated, sign of dividend
+                out = xp.fmod(lv, safe)  # truncated, sign of dividend
             else:
                 # SQL truncated modulo: a - trunc(a/b)*b (sign follows a)
                 out = lv - _div_trunc(lv, safe) * safe
             validity = _merge_validity(validity, ~zero)
         else:  # "/"
             zero = rv == 0
-            safe = jnp.where(zero, jnp.ones_like(rv), rv)
+            safe = xp.where(zero, xp.ones_like(rv), rv)
             if self._common == DataType.DECIMAL:
-                out = _div_trunc(lv * jnp.int64(DECIMAL_SCALE), safe)
+                out = _div_trunc(lv * xp.int64(DECIMAL_SCALE), safe)
             else:
                 out = lv / safe
             validity = _merge_validity(validity, ~zero)
@@ -294,14 +299,16 @@ class BinaryOp(Expression):
             res[idx] = np.asarray(fn(lv[idx], rv[idx]), dtype=bool)
         null_any = lnull | rnull
         if null_any.any():
-            nv = jnp.asarray(~null_any)
-            validity = nv if validity is None else (validity & nv)
-        return Column(DataType.BOOLEAN, jnp.asarray(res), validity)
+            nv = ~null_any
+            validity = nv if validity is None \
+                else (np.asarray(validity) & nv)
+        return Column(DataType.BOOLEAN, res, validity)
 
     def _eval_logic(self, lc: Column, rc: Column) -> Column:
         lv, rv = lc.values, rc.values
-        ln = lc.validity if lc.validity is not None else jnp.ones_like(lv)
-        rn = rc.validity if rc.validity is not None else jnp.ones_like(rv)
+        xp = get_xp(lv, rv)
+        ln = lc.validity if lc.validity is not None else xp.ones_like(lv)
+        rn = rc.validity if rc.validity is not None else xp.ones_like(rv)
         if self.op == "and":
             # Kleene: false AND null = false; true AND null = null
             out = lv & rv
@@ -347,7 +354,8 @@ class UnaryOp(Expression):
         if self.op == "neg":
             return Column(c.data_type, -c.values, c.validity)
         cap = chunk.capacity
-        present = (jnp.ones(cap, dtype=bool) if c.validity is None
+        xp = get_xp(c.values)
+        present = (xp.ones(cap, dtype=bool) if c.validity is None
                    else c.validity)
         vals = present if self.op == "is_not_null" else ~present
         return Column(DataType.BOOLEAN, vals, None)
@@ -394,7 +402,7 @@ def _window_usecs(window: Column):
     if window.data_type != DataType.INTERVAL:
         return window.values
     iv = next((v for v in np.asarray(window.values) if v is not None), None)
-    return None if iv is None else jnp.int64(iv.exact_usecs())
+    return None if iv is None else np.int64(iv.exact_usecs())
 
 
 @register_function("tumble_start")
@@ -405,9 +413,10 @@ def _tumble_start(rt: DataType, ts: Column, window: Column) -> Column:
     must be a month-free interval literal. A NULL window yields NULL.
     """
     w = _window_usecs(window)
+    xp = get_xp(ts.values)
     if w is None:
-        return Column(rt, jnp.zeros_like(ts.values),
-                      jnp.zeros(ts.values.shape[0], dtype=bool))
+        return Column(rt, xp.zeros_like(ts.values),
+                      xp.zeros(ts.values.shape[0], dtype=bool))
     out = ts.values - (ts.values % w)
     return Column(rt, out, ts.validity)
 
@@ -415,9 +424,10 @@ def _tumble_start(rt: DataType, ts: Column, window: Column) -> Column:
 @register_function("tumble_end")
 def _tumble_end(rt: DataType, ts: Column, window: Column) -> Column:
     w = _window_usecs(window)
+    xp = get_xp(ts.values)
     if w is None:
-        return Column(rt, jnp.zeros_like(ts.values),
-                      jnp.zeros(ts.values.shape[0], dtype=bool))
+        return Column(rt, xp.zeros_like(ts.values),
+                      xp.zeros(ts.values.shape[0], dtype=bool))
     out = ts.values - (ts.values % w) + w
     return Column(rt, out, ts.validity)
 
@@ -425,7 +435,8 @@ def _tumble_end(rt: DataType, ts: Column, window: Column) -> Column:
 @register_function("extract_epoch")
 def _extract_epoch(rt: DataType, ts: Column) -> Column:
     """EXTRACT(EPOCH FROM ts): µs timestamp → seconds (decimal)."""
-    secs = ts.values * jnp.int64(DECIMAL_SCALE) // jnp.int64(1_000_000)
+    xp = get_xp(ts.values)
+    secs = ts.values * xp.int64(DECIMAL_SCALE) // xp.int64(1_000_000)
     return Column(rt, secs, ts.validity)
 
 
@@ -454,19 +465,20 @@ class Case(Expression):
         out = self.else_.eval(chunk)
         vals, validity = out.values, out.validity
         cap = chunk.capacity
-        taken = jnp.zeros(cap, dtype=bool)
+        xp = get_xp(chunk.visibility, vals)
+        taken = xp.zeros(cap, dtype=bool)
         for cond, value in self.whens:
             cc = cond.eval(chunk)
             cv = cc.values & (cc.validity if cc.validity is not None
-                              else jnp.ones(cap, dtype=bool)) & ~taken
+                              else xp.ones(cap, dtype=bool)) & ~taken
             vc = value.eval(chunk)
-            vals = jnp.where(cv, vc.values, vals)
+            vals = xp.where(cv, vc.values, vals)
             if validity is not None or vc.validity is not None:
                 lval = validity if validity is not None \
-                    else jnp.ones(cap, dtype=bool)
+                    else xp.ones(cap, dtype=bool)
                 rval = vc.validity if vc.validity is not None \
-                    else jnp.ones(cap, dtype=bool)
-                validity = jnp.where(cv, rval, lval)
+                    else xp.ones(cap, dtype=bool)
+                validity = xp.where(cv, rval, lval)
             taken = taken | cv
         return Column(self.return_type, vals, validity)
 

@@ -1,0 +1,9 @@
+"""Meta: the control plane — barrier loop, catalog, DDL (grows per layer 10).
+
+Reference parity: src/meta/ (GlobalBarrierManager src/meta/src/barrier/
+mod.rs:128; stream manager, catalog, recovery come in later rounds).
+"""
+
+from risingwave_tpu.meta.barrier import BarrierLoop, BarrierStats
+
+__all__ = ["BarrierLoop", "BarrierStats"]

@@ -1,0 +1,193 @@
+"""The barrier/checkpoint loop: the system heartbeat.
+
+Reference parity: src/meta/src/barrier/mod.rs:128,558,652 —
+GlobalBarrierManager ticks every `barrier_interval_ms`, pairs the tick with
+a scheduled command, issues the next epoch, injects the barrier at sources,
+keeps at most `in_flight_barrier_nums` barriers un-collected, and on
+collection commits the epoch to the state store (HummockManager::commit_epoch
+analog). `checkpoint_frequency` makes only every k-th barrier durable
+(BarrierKind::{Barrier,Checkpoint}).
+
+TPU notes: barrier collection is the device sync point — an epoch completes
+only after every actor flushed device state for it. The loop never blocks
+data flow: injection is pipelined up to the in-flight window.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from risingwave_tpu.common.epoch import Epoch, EpochPair
+from risingwave_tpu.state.store import StateStore
+from risingwave_tpu.stream.actor import LocalBarrierManager
+from risingwave_tpu.stream.message import Barrier, BarrierKind, Mutation
+
+
+@dataclass
+class BarrierStats:
+    """Collected per-epoch latencies (meta barrier_latency metric analog)."""
+
+    completed_epochs: List[int] = field(default_factory=list)
+    latencies_s: List[float] = field(default_factory=list)
+
+    def p99_latency_s(self) -> float:
+        if not self.latencies_s:
+            return 0.0
+        xs = sorted(self.latencies_s)
+        return xs[min(len(xs) - 1, int(len(xs) * 0.99))]
+
+    def mean_latency_s(self) -> float:
+        return (sum(self.latencies_s) / len(self.latencies_s)
+                if self.latencies_s else 0.0)
+
+
+class BarrierLoop:
+    """GlobalBarrierManager-lite driving one LocalBarrierManager.
+
+    Two driving modes:
+    - `run()`: background task ticking `interval_ms` on the (injectable)
+      wall clock — production shape.
+    - `inject_and_collect()` / `checkpoint()`: explicit stepping for tests
+      and benchmarks (deterministic; no timers).
+    """
+
+    def __init__(self, local: LocalBarrierManager, store: StateStore,
+                 interval_ms: int = 250, checkpoint_frequency: int = 1,
+                 in_flight_barrier_nums: int = 10,
+                 monotonic: Callable[[], float] = time.monotonic):
+        self.local = local
+        self.store = store
+        self.interval_ms = interval_ms
+        self.checkpoint_frequency = max(1, checkpoint_frequency)
+        self.in_flight_barrier_nums = max(1, in_flight_barrier_nums)
+        self.monotonic = monotonic
+        self.stats = BarrierStats()
+        self._epoch: Optional[Epoch] = None
+        self._barriers_since_checkpoint = 0
+        self._inject_times: Dict[int, float] = {}
+        self._in_flight: List[int] = []       # injected, not yet collected
+        self._committed_epoch = 0
+        self._pending_mutations: List[Mutation] = []
+        self._stopped = False
+
+    # -- command scheduling (BarrierScheduler analog) -------------------
+    def schedule_mutation(self, mutation: Mutation) -> None:
+        self._pending_mutations.append(mutation)
+
+    @property
+    def committed_epoch(self) -> int:
+        return self._committed_epoch
+
+    # -- one step -------------------------------------------------------
+    def _next_kind(self, force_checkpoint: bool) -> BarrierKind:
+        if self._epoch is None:
+            return BarrierKind.INITIAL
+        self._barriers_since_checkpoint += 1
+        if force_checkpoint or (self._barriers_since_checkpoint
+                                >= self.checkpoint_frequency):
+            return BarrierKind.CHECKPOINT
+        return BarrierKind.BARRIER
+
+    async def inject(self, mutation: Optional[Mutation] = None,
+                     force_checkpoint: bool = False) -> Barrier:
+        """Issue the next epoch and send its barrier to source actors."""
+        kind = self._next_kind(force_checkpoint)
+        if self._epoch is None:
+            curr = Epoch.now()
+            # recovery: the initial barrier's prev is the committed epoch,
+            # so state-table reads see the checkpointed data (recovery.rs)
+            recovered = Epoch(self.store.committed_epoch())
+            if curr.value <= recovered.value:
+                curr = Epoch(recovered.value + 1)
+            pair = EpochPair(curr=curr, prev=recovered)
+        else:
+            curr = self._epoch.next()
+            pair = EpochPair(curr=curr, prev=self._epoch)
+        self._epoch = curr
+        if mutation is None and self._pending_mutations:
+            mutation = self._pending_mutations.pop(0)
+        barrier = Barrier(pair, kind, mutation)
+        self._inject_times[curr.value] = self.monotonic()
+        self._in_flight.append(curr.value)
+        if kind.is_checkpoint:
+            self._barriers_since_checkpoint = 0
+        await self.local.send_barrier(barrier)
+        return barrier
+
+    async def collect_next(self) -> Barrier:
+        """Await the oldest in-flight epoch; commit it to the store."""
+        assert self._in_flight, "nothing in flight"
+        epoch = self._in_flight.pop(0)
+        barrier = await self.local.await_epoch_complete(epoch)
+        # the epoch whose data this barrier flushed is the one that ENDED:
+        # barrier.epoch.prev (meta commits prev_epoch — barrier/mod.rs:652).
+        # The INITIAL barrier has prev=INVALID: nothing to commit yet.
+        prev = barrier.epoch.prev.value
+        if prev > 0:
+            self.store.seal_epoch(prev, barrier.is_checkpoint)
+            if barrier.is_checkpoint:
+                self.store.sync(prev)
+                self._committed_epoch = prev
+        t0 = self._inject_times.pop(epoch, None)
+        if t0 is not None:
+            self.stats.latencies_s.append(self.monotonic() - t0)
+        self.stats.completed_epochs.append(epoch)
+        return barrier
+
+    async def inject_and_collect(
+            self, mutation: Optional[Mutation] = None,
+            force_checkpoint: bool = False) -> Barrier:
+        await self.inject(mutation, force_checkpoint)
+        # drain everything in flight, oldest first
+        barrier = None
+        while self._in_flight:
+            barrier = await self.collect_next()
+        assert barrier is not None
+        return barrier
+
+    async def checkpoint(self) -> Barrier:
+        """Force a durable checkpoint barrier and wait for it."""
+        return await self.inject_and_collect(force_checkpoint=True)
+
+    # -- background loop -------------------------------------------------
+    async def run(self, stop_after: Optional[int] = None) -> None:
+        """Tick-inject-collect until `stop()` (or `stop_after` barriers).
+
+        Injection and collection are pipelined: a new barrier is injected
+        on schedule as long as the in-flight window has room.
+        """
+        n = 0
+        collector = None
+        try:
+            while not self._stopped and (stop_after is None
+                                         or n < stop_after):
+                if len(self._in_flight) < self.in_flight_barrier_nums:
+                    await self.inject()
+                    n += 1
+                if collector is None and self._in_flight:
+                    collector = asyncio.ensure_future(self.collect_next())
+                sleeper = asyncio.ensure_future(
+                    asyncio.sleep(self.interval_ms / 1000))
+                waits = {sleeper} | ({collector} if collector else set())
+                done, _ = await asyncio.wait(
+                    waits, return_when=asyncio.FIRST_COMPLETED)
+                if collector in done:
+                    collector.result()
+                    collector = None
+                if sleeper not in done:
+                    sleeper.cancel()
+            while self._in_flight:
+                if collector is not None:
+                    await collector
+                    collector = None
+                else:
+                    await self.collect_next()
+        finally:
+            if collector is not None:
+                collector.cancel()
+
+    def stop(self) -> None:
+        self._stopped = True

@@ -5,6 +5,12 @@ encoded bytes compare (as unsigned byte strings) in the same order as the
 SQL values they encode. Re-designed minimal: we encode host python values
 (the state store is host-side; device state flushes through it at barriers).
 
+Values are PHYSICAL: DECIMAL is its scaled-int64 payload, timestamps are µs
+ints — the same representation device kernels and state-table rows use, so
+the vectorized bulk encoder (state_table._encode_pks_bulk) and this scalar
+codec produce identical bytes. Logical→physical normalization happens once,
+at chunk ingest (chunk._make_column / types.decimal_to_scaled).
+
 Layout per value:
   0x00                      NULL (nulls sort first, matching our iter tests)
   0x01 <payload>            non-null value
@@ -19,13 +25,10 @@ Payloads:
 
 from __future__ import annotations
 
-import decimal
 import struct
 from typing import Iterable, List, Optional, Sequence, Tuple
 
-from risingwave_tpu.common.types import (
-    DECIMAL_SCALE, DataType, decimal_to_scaled,
-)
+from risingwave_tpu.common.types import DataType
 
 _NULL = b"\x00"
 _NONNULL = b"\x01"
@@ -41,6 +44,8 @@ def _decode_int(b: bytes) -> int:
 
 
 def _encode_float(v: float) -> bytes:
+    if v == 0.0:
+        v = 0.0  # normalize -0.0: one SQL value, one key (matches hash.py)
     bits = struct.unpack(">Q", struct.pack(">d", v))[0]
     if bits & (1 << 63):
         bits = ~bits & ((1 << 64) - 1)   # negative: invert all
@@ -85,9 +90,8 @@ def encode_value(v, dt: DataType) -> bytes:
     if dt in (DataType.FLOAT32, DataType.FLOAT64):
         return _NONNULL + _encode_float(float(v))
     if dt == DataType.DECIMAL:
-        # normalize ANY logical value (int/float/Decimal) through the same
-        # scaling as column ingest, so 5, 5.0 and Decimal('5') share one key
-        return _NONNULL + _encode_int(decimal_to_scaled(v))
+        # physical scaled-int64 payload (already scaled at chunk ingest)
+        return _NONNULL + _encode_int(int(v))
     if dt == DataType.VARCHAR:
         return _NONNULL + _encode_bytes(str(v).encode("utf-8"))
     if dt == DataType.BYTEA:
@@ -106,8 +110,7 @@ def decode_value(buf: bytes, pos: int, dt: DataType):
     if dt in (DataType.FLOAT32, DataType.FLOAT64):
         return _decode_float(buf[pos:pos + 8]), pos + 8
     if dt == DataType.DECIMAL:
-        raw = _decode_int(buf[pos:pos + 8])
-        return decimal.Decimal(raw) / DECIMAL_SCALE, pos + 8
+        return _decode_int(buf[pos:pos + 8]), pos + 8
     if dt == DataType.VARCHAR:
         raw, pos = _scan_bytes(buf, pos)
         return raw.decode("utf-8"), pos

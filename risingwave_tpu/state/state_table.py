@@ -10,11 +10,15 @@ TPU re-design: this is the *host-side durability seam*. Device-resident
 operator state (HBM hash tables) flushes dirty entries through this API at
 every barrier; recovery reads it back to rebuild device state. Keys are
 2-byte-vnode-prefixed memcomparable bytes; values are host row tuples.
+
+Rows are PHYSICAL tuples: DECIMAL is its scaled int64, timestamps are µs
+ints, NULL is None — the exact representation device kernels flush and
+recovery re-uploads (no host conversion on the hot path). Present rows to
+users via ``to_logical_row``.
 """
 
 from __future__ import annotations
 
-import decimal
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -24,7 +28,7 @@ from risingwave_tpu.common.epoch import EpochPair
 from risingwave_tpu.common.hash import (
     VNODE_COUNT, hash_strings_host, vnodes_of_host,
 )
-from risingwave_tpu.common.types import DataType, Schema, decimal_to_scaled
+from risingwave_tpu.common.types import DataType, Schema, scaled_to_decimal
 from risingwave_tpu.state.keycodec import (
     decode_memcomparable, encode_memcomparable, encode_vnode_prefix,
 )
@@ -115,12 +119,109 @@ class StateTable:
             self.mem_table.insert(nk, new_row)
 
     def write_chunk(self, chunk: StreamChunk) -> None:
-        """Apply a visible-row StreamChunk (barrier-flush entry point)."""
-        for op, row in chunk.to_records():
-            if op in (Op.INSERT, Op.UPDATE_INSERT):
-                self.insert(row)
+        """Apply a visible-row StreamChunk — the barrier-flush hot path.
+
+        Fully vectorized up to the memtable: physical row extraction, vnode
+        hashing and pk encoding are whole-column numpy passes; only the
+        final dict ops are per-row.
+        """
+        idx, rows, ops = chunk.to_physical_records()
+        if not rows:
+            return
+        keys = self._encode_pks_bulk(chunk, idx)
+        is_ins = (ops == int(Op.INSERT)) | (ops == int(Op.UPDATE_INSERT))
+        mt = self.mem_table
+        for key, row, ins in zip(keys, rows, is_ins.tolist()):
+            if ins:
+                mt.insert(key, row)
             else:
-                self.delete(row)
+                mt.delete(key, row)
+
+    # fixed-width device pk types eligible for the bulk encoder
+    _BULK_OK = frozenset({
+        DataType.INT16, DataType.INT32, DataType.INT64, DataType.SERIAL,
+        DataType.DECIMAL, DataType.DATE, DataType.TIME, DataType.TIMESTAMP,
+        DataType.TIMESTAMPTZ, DataType.FLOAT32, DataType.FLOAT64,
+        DataType.BOOLEAN,
+    })
+
+    def _encode_pks_bulk(self, chunk: StreamChunk,
+                         idx: np.ndarray) -> List[bytes]:
+        """Vectorized vnode-prefixed memcomparable keys for visible rows."""
+        n = len(idx)
+        # vnodes (vectorized, same math as device dispatch)
+        if not self.dist_key_indices:
+            vnodes = np.zeros(n, dtype=np.int64)
+        else:
+            lanes = []
+            for i in self.dist_key_indices:
+                c = chunk.columns[i]
+                vals = np.asarray(c.values)[idx]
+                if c.data_type.is_device:
+                    if c.validity is not None:
+                        # NULL dist-key values hash as the zero lane (same
+                        # rule as _key_lane(None)) regardless of buffer fill
+                        vals = np.where(np.asarray(c.validity)[idx], vals,
+                                        np.zeros((), dtype=vals.dtype))
+                    lanes.append(vals)
+                else:
+                    lanes.append(hash_strings_host(vals, n))
+            vnodes = vnodes_of_host(lanes).astype(np.int64)
+
+        pk_cols = [chunk.columns[i] for i in self.pk_indices]
+        bulk_ok = all(
+            c.data_type in self._BULK_OK and
+            (c.validity is None or bool(np.asarray(c.validity)[idx].all()))
+            for c in pk_cols)
+        if not bulk_ok:  # rare path: varchar/null pks — per-row codec
+            out = []
+            host_pk = [(np.asarray(c.values)[idx],
+                        None if c.validity is None
+                        else np.asarray(c.validity)[idx]) for c in pk_cols]
+            for j in range(n):
+                pk = tuple(
+                    None if (val is not None and not val[j])
+                    else (vals[j].item() if hasattr(vals[j], "item")
+                          else vals[j])
+                    for vals, val in host_pk)
+                out.append(encode_vnode_prefix(int(vnodes[j]))
+                           + encode_memcomparable(pk, self.pk_types))
+            return out
+
+        # matrix layout: [2B vnode][per col: 0x01 + payload]
+        widths = [2] + [1 + (1 if c.data_type == DataType.BOOLEAN else 8)
+                        for c in pk_cols]
+        total = sum(widths)
+        m = np.empty((n, total), dtype=np.uint8)
+        m[:, 0] = (vnodes >> 8).astype(np.uint8)
+        m[:, 1] = (vnodes & 0xFF).astype(np.uint8)
+        off = 2
+        for c in pk_cols:
+            m[:, off] = 1  # non-null tag
+            off += 1
+            vals = np.asarray(c.values)[idx]
+            dt = c.data_type
+            if dt == DataType.BOOLEAN:
+                m[:, off] = vals.astype(np.uint8)
+                off += 1
+                continue
+            if dt in (DataType.FLOAT32, DataType.FLOAT64):
+                with np.errstate(over="ignore"):
+                    f = vals.astype(np.float64)
+                    f = np.where(f == 0, 0.0, f)  # -0.0 → 0.0
+                    bits = f.view(np.uint64)
+                    neg = (bits >> np.uint64(63)) == 1
+                    bits = np.where(neg, ~bits,
+                                    bits | np.uint64(1 << 63))
+            else:
+                with np.errstate(over="ignore"):
+                    bits = vals.astype(np.int64).view(np.uint64) \
+                        + np.uint64(1 << 63)
+            be = bits.astype(">u8").view(np.uint8).reshape(n, 8)
+            m[:, off:off + 8] = be
+            off += 8
+        flat = m.tobytes()
+        return [flat[i * total:(i + 1) * total] for i in range(n)]
 
     # -- read API --------------------------------------------------------
     def _read_epoch(self) -> int:
@@ -177,11 +278,20 @@ class StateTable:
 
 
 def _key_lane(v, dt: DataType) -> np.ndarray:
-    """One scalar → length-1 lane array matching device hashing rules."""
+    """One physical scalar → length-1 lane array (device hashing rules).
+
+    NULL hashes as the zero lane — consistent with the bulk encoder's
+    treatment of invalid slots, so a NULL dist-key row is addressable."""
     if dt.is_device:
-        if dt == DataType.DECIMAL:
-            # scale ANY logical value (int/float/Decimal) exactly like
-            # column ingest, so host vnode == device vnode of the column
-            v = decimal_to_scaled(v)
-        return np.asarray([v], dtype=dt.np_dtype)
+        return np.asarray([0 if v is None else v], dtype=dt.np_dtype)
     return hash_strings_host(np.asarray([v], dtype=object), 1)
+
+
+def to_logical_row(row: Sequence, schema: Schema) -> tuple:
+    """Physical state-table row → logical values (DECIMAL → Decimal)."""
+    out = []
+    for v, f in zip(row, schema):
+        if v is not None and f.data_type == DataType.DECIMAL:
+            v = scaled_to_decimal(v)
+        out.append(v)
+    return tuple(out)

@@ -43,6 +43,11 @@ class StateStore:
         """Await all data at <= epoch durable; returns uploadinfo."""
         return {}
 
+    def committed_epoch(self) -> int:
+        """Latest durably committed (checkpoint) epoch — the recovery
+        point the initial barrier's `prev` is set to after a restart."""
+        return 0
+
 
 class _Table:
     """One table's ordered MVCC map: sorted key index + version lists."""
@@ -86,6 +91,7 @@ class MemoryStateStore(StateStore):
     def __init__(self) -> None:
         self._tables: Dict[int, _Table] = {}
         self._sealed_epoch = 0
+        self._committed_epoch = 0
 
     def _table(self, table_id: int) -> _Table:
         t = self._tables.get(table_id)
@@ -110,6 +116,13 @@ class MemoryStateStore(StateStore):
     def seal_epoch(self, epoch: int, is_checkpoint: bool = True) -> None:
         assert epoch >= self._sealed_epoch, (epoch, self._sealed_epoch)
         self._sealed_epoch = epoch
+
+    def sync(self, epoch: int) -> dict:
+        self._committed_epoch = max(self._committed_epoch, epoch)
+        return {}
+
+    def committed_epoch(self) -> int:
+        return self._committed_epoch
 
     # -- read path -----------------------------------------------------
     def get(self, table_id: int, key: bytes, epoch: int) -> Value:

@@ -18,11 +18,10 @@ from __future__ import annotations
 import abc
 from typing import AsyncIterator, Dict, List, Optional, Sequence
 
-import jax.numpy as jnp
 import numpy as np
 
 from risingwave_tpu.common.chunk import Op, StreamChunk
-from risingwave_tpu.common.hash import VnodeMapping, vnodes_of
+from risingwave_tpu.common.hash import VnodeMapping
 from risingwave_tpu.stream.exchange import ChannelClosed, Sender
 from risingwave_tpu.stream.executor import Executor
 from risingwave_tpu.stream.message import (
@@ -158,17 +157,22 @@ class HashDispatcher(Dispatcher):
         self.dispatcher_id = dispatcher_id
 
     def _route(self, chunk: StreamChunk) -> np.ndarray:
-        """vnode → output index per row (host array, one device pass)."""
+        """vnode → output index per row (one vectorized host pass).
+
+        Chunks are host-resident here; the device twin of this routing is
+        the all-to-all permutation in parallel/ (same hash bits).
+        """
+        from risingwave_tpu.common.hash import hash_strings_host, \
+            vnodes_of_host
         key_cols = []
         for i in self.dist_key_indices:
             col = chunk.columns[i]
             if col.is_device:
-                key_cols.append(col.values)
+                key_cols.append(np.asarray(col.values))
             else:
-                from risingwave_tpu.common.hash import hash_strings_host
-                key_cols.append(jnp.asarray(hash_strings_host(
-                    np.asarray(col.values), chunk.capacity)))
-        vn = np.asarray(vnodes_of(key_cols))
+                key_cols.append(hash_strings_host(
+                    np.asarray(col.values), chunk.capacity))
+        vn = vnodes_of_host(key_cols)
         return np.asarray(self.mapping.owners)[vn]
 
     async def dispatch_data(self, chunk: StreamChunk) -> None:
@@ -184,11 +188,11 @@ class HashDispatcher(Dispatcher):
                     and owner[i] != owner[j]:
                 new_ops[i] = int(Op.DELETE)
                 new_ops[j] = int(Op.INSERT)
-        ops_dev = jnp.asarray(new_ops) if (new_ops != ops).any() \
-            else chunk.ops
+        out_ops = new_ops if (new_ops != ops).any() else chunk.ops
+        vis_host = np.asarray(chunk.visibility)
         for oi, out in enumerate(self._outputs):
-            sub_vis = chunk.visibility & jnp.asarray(owner == oi)
-            sub = StreamChunk(chunk.schema, chunk.columns, sub_vis, ops_dev)
+            sub_vis = vis_host & (owner == oi)
+            sub = StreamChunk(chunk.schema, chunk.columns, sub_vis, out_ops)
             await out.send(sub)
 
     async def dispatch_barrier(self, barrier: Barrier) -> None:

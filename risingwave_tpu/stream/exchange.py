@@ -92,6 +92,32 @@ class Receiver:
                 s.cond.notify_all()
         return msg
 
+    def try_recv(self) -> Optional[Message]:
+        """Non-blocking recv: None if empty (source barrier-select path)."""
+        s = self._s
+        try:
+            kind, cost, msg = s.queue.get_nowait()
+        except asyncio.QueueEmpty:
+            return None
+        if kind == "eos":
+            raise ChannelClosed
+        if cost:
+            # return permits without blocking: schedule the notify
+            if kind == "chunk":
+                s.chunk_permits += cost
+            else:
+                s.barrier_permits += 1
+            try:
+                loop = asyncio.get_running_loop()
+                loop.create_task(self._notify())
+            except RuntimeError:
+                pass
+        return msg
+
+    async def _notify(self) -> None:
+        async with self._s.cond:
+            self._s.cond.notify_all()
+
     def close(self) -> None:
         """Receiver drop: unblock any sender waiting for permits."""
         s = self._s

@@ -60,10 +60,11 @@ class MaterializeExecutor(Executor):
         if self.conflict == ConflictBehavior.NO_CHECK:
             self.table.write_chunk(chunk)
             return
-        for op, row in chunk.to_records():
+        _idx, rows, ops = chunk.to_physical_records()
+        for op, row in zip(ops.tolist(), rows):
             pk = self.table.pk_of(row)
             old = self.table.get_row(pk)
-            if op in (Op.INSERT, Op.UPDATE_INSERT):
+            if op in (int(Op.INSERT), int(Op.UPDATE_INSERT)):
                 if old is None:
                     self.table.insert(row)
                 elif self.conflict == ConflictBehavior.OVERWRITE:

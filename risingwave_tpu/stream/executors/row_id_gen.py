@@ -1,0 +1,53 @@
+"""RowIdGenExecutor: append a hidden serial row-id column.
+
+Reference parity: src/stream/src/executor/row_id_gen.rs — tables/MVs with no
+user pk get a generated `_row_id` so every row has a unique, stable key.
+The reference packs (vnode, local monotonic seq) so ids are unique across
+parallel actors; we do the same: id = (vnode_base << 48) | seq.
+
+TPU notes: id assignment is a vectorized arange add — one device op per
+chunk, no per-row Python.
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator
+
+import numpy as np
+
+from risingwave_tpu.common.chunk import Column, StreamChunk
+from risingwave_tpu.common.types import DataType, Field, Schema
+from risingwave_tpu.stream.executor import Executor, ExecutorInfo
+from risingwave_tpu.stream.message import Message, is_chunk
+
+ROW_ID_FIELD = Field("_row_id", DataType.SERIAL)
+
+
+class RowIdGenExecutor(Executor):
+    """Appends `_row_id` (SERIAL) as the last column (row_id_gen.rs)."""
+
+    def __init__(self, input_: Executor, vnode_base: int = 0):
+        schema = Schema(list(input_.schema.fields) + [ROW_ID_FIELD])
+        info = ExecutorInfo(schema, [len(input_.schema)], "RowIdGenExecutor")
+        super().__init__(info)
+        self.input = input_
+        # high 16 bits identify the generating shard: ids never collide
+        # across parallel source actors (row_id_gen.rs vnode split analog)
+        self._base = vnode_base << 48
+        self._seq = 0
+
+    async def execute(self) -> AsyncIterator[Message]:
+        async for msg in self.input.execute():
+            if is_chunk(msg):
+                cap = msg.capacity
+                # every slot (visible or padding) gets an id: vectorized,
+                # ids of padding slots are simply never observed
+                ids = self._base + self._seq + np.arange(
+                    cap, dtype=np.int64)
+                self._seq += cap
+                col = Column(DataType.SERIAL, ids)
+                yield StreamChunk(self.schema,
+                                  list(msg.columns) + [col],
+                                  msg.visibility, msg.ops)
+            else:
+                yield msg

@@ -19,9 +19,7 @@ from __future__ import annotations
 
 from typing import AsyncIterator, List, Optional, Sequence
 
-import jax.numpy as jnp
-
-from risingwave_tpu.common.chunk import Op, StreamChunk
+from risingwave_tpu.common.chunk import Op, StreamChunk, get_xp
 from risingwave_tpu.common.types import Field, Schema
 from risingwave_tpu.expr.expr import Expression
 from risingwave_tpu.stream.exchange import ChannelClosed, Receiver
@@ -101,22 +99,23 @@ class FilterExecutor(Executor):
 
     def _apply(self, chunk: StreamChunk) -> StreamChunk:
         pcol = self.predicate.eval(chunk)
+        xp = get_xp(pcol.values, chunk.ops)
         pred = pcol.values.astype(bool)
         if pcol.validity is not None:  # NULL predicate = not satisfied
             pred = pred & pcol.validity
         ops = chunk.ops
-        is_ud = ops == jnp.int8(int(Op.UPDATE_DELETE))
-        is_ui = ops == jnp.int8(int(Op.UPDATE_INSERT))
+        is_ud = ops == xp.int8(int(Op.UPDATE_DELETE))
+        is_ui = ops == xp.int8(int(Op.UPDATE_INSERT))
         # pair (i, i+1): U- at i, U+ at i+1
-        next_is_ui = jnp.roll(is_ui, -1)
-        prev_is_ud = jnp.roll(is_ud, 1)
-        next_pred = jnp.roll(pred, -1)
-        prev_pred = jnp.roll(pred, 1)
+        next_is_ui = xp.roll(is_ui, -1)
+        prev_is_ud = xp.roll(is_ud, 1)
+        next_pred = xp.roll(pred, -1)
+        prev_pred = xp.roll(pred, 1)
         # U- whose U+ half fails the predicate → plain DELETE
         degrade_del = is_ud & next_is_ui & pred & ~next_pred
         # U+ whose U- half fails the predicate → plain INSERT
         degrade_ins = is_ui & prev_is_ud & pred & ~prev_pred
-        new_ops = jnp.where(degrade_del, jnp.int8(int(Op.DELETE)), ops)
-        new_ops = jnp.where(degrade_ins, jnp.int8(int(Op.INSERT)), new_ops)
+        new_ops = xp.where(degrade_del, xp.int8(int(Op.DELETE)), ops)
+        new_ops = xp.where(degrade_ins, xp.int8(int(Op.INSERT)), new_ops)
         return StreamChunk(chunk.schema, chunk.columns,
                            chunk.visibility & pred, new_ops)

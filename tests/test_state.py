@@ -41,7 +41,8 @@ def test_memcomparable_ordering():
 def test_memcomparable_roundtrip():
     types = [DataType.INT64, DataType.VARCHAR, DataType.FLOAT64,
              DataType.BOOLEAN, DataType.DECIMAL]
-    row = (42, "hello\x00world", -3.25, True, decimal.Decimal("9.5001"))
+    # DECIMAL is physical: the scaled-int64 payload (9.5001 → 95001)
+    row = (42, "hello\x00world", -3.25, True, 95001)
     enc = encode_memcomparable(row, types)
     assert decode_memcomparable(enc, types) == row
     nonerow = (None, None, None, None, None)
@@ -210,19 +211,95 @@ def test_state_table_vnode_bitmap_swap():
     assert prev.all() and len(t.owned_vnodes()) == 128
 
 
-def test_decimal_pk_logical_value_consistency():
-    """5, 5.0 and Decimal('5') must encode to the same key and vnode."""
+def test_decimal_pk_physical_consistency():
+    """StateTable rows/keys are physical: DECIMAL pk = scaled int64.
+
+    Logical→physical normalization happens once at chunk ingest
+    (types.decimal_to_scaled); the state layer never re-scales.
+    """
     import decimal as _d
+    from risingwave_tpu.common.types import decimal_to_scaled
     from risingwave_tpu.state.keycodec import encode_value
-    assert encode_value(5, DataType.DECIMAL) == \
-        encode_value(_d.Decimal("5"), DataType.DECIMAL) == \
-        encode_value(5.0, DataType.DECIMAL)
+    phys = decimal_to_scaled(_d.Decimal("5"))
+    assert phys == decimal_to_scaled(5) == decimal_to_scaled(5.0) == 50000
+    assert encode_value(phys, DataType.DECIMAL) == \
+        encode_value(50000, DataType.DECIMAL)
 
     schema = Schema.of(d=DataType.DECIMAL, v=DataType.INT64)
     store = MemoryStateStore()
     t = StateTable(9, schema, pk_indices=[0], store=store,
                    dist_key_indices=[0])
     t.init_epoch(EpochPair.new_initial(Epoch.from_physical(1)))
-    t.insert((_d.Decimal("5"), 1))
-    assert t.get_row((5,)) == (_d.Decimal("5"), 1)
-    assert t.get_row((_d.Decimal("5"),)) == (_d.Decimal("5"), 1)
+    t.insert((phys, 1))
+    assert t.get_row((phys,)) == (phys, 1)
+    from risingwave_tpu.state.state_table import to_logical_row
+    assert to_logical_row(t.get_row((phys,)), schema) == \
+        (_d.Decimal("5"), 1)
+
+
+def test_bulk_and_scalar_key_encoding_agree():
+    """write_chunk's vectorized keys must equal the row-API's keys."""
+    from risingwave_tpu.common.chunk import StreamChunk
+
+    schema = Schema.of(a=DataType.INT64, f=DataType.FLOAT64,
+                       b=DataType.BOOLEAN, d=DataType.DECIMAL,
+                       v=DataType.VARCHAR)
+    data = {
+        "a": [-5, 0, 7, 2**40],
+        "f": [-2.5, 0.0, 3.75, 1e300],
+        "b": [True, False, True, False],
+        "d": [decimal.Decimal("1.5"), decimal.Decimal("-2"),
+              decimal.Decimal("0"), decimal.Decimal("99.9999")],
+        "v": ["x", "y", "z", "w"],
+    }
+    chunk = StreamChunk.from_pydict(schema, data)
+    store = MemoryStateStore()
+    ta = StateTable(21, schema, pk_indices=[0, 1, 2, 3], store=store,
+                    dist_key_indices=[0])
+    tb = StateTable(22, schema, pk_indices=[0, 1, 2, 3], store=store,
+                    dist_key_indices=[0])
+    ta.write_chunk(chunk)
+    _idx, rows, _ops = chunk.to_physical_records()
+    for row in rows:
+        tb.insert(row)
+    keys_a = sorted(k for k, _ in ta.mem_table.iter_ops())
+    keys_b = sorted(k for k, _ in tb.mem_table.iter_ops())
+    assert keys_a == keys_b
+    # and a varchar pk falls back to the scalar codec with the same result
+    tc = StateTable(23, schema, pk_indices=[4, 0], store=store)
+    td = StateTable(24, schema, pk_indices=[4, 0], store=store)
+    tc.write_chunk(chunk)
+    for row in rows:
+        td.insert(row)
+    assert sorted(k for k, _ in tc.mem_table.iter_ops()) == \
+        sorted(k for k, _ in td.mem_table.iter_ops())
+
+
+def test_negative_zero_and_null_distkey_key_consistency():
+    """Code-review regressions: -0.0 pk and NULL dist-key rows must be
+    addressable identically through write_chunk and the row API."""
+    from risingwave_tpu.common.chunk import StreamChunk
+
+    # -0.0 and 0.0 are one SQL value → one key on both paths
+    schema = Schema.of(f=DataType.FLOAT64, v=DataType.INT64)
+    store = MemoryStateStore()
+    t = StateTable(31, schema, pk_indices=[0], store=store)
+    t.init_epoch(EpochPair.new_initial(Epoch.from_physical(1)))
+    chunk = StreamChunk.from_pydict(
+        schema, {"f": np.asarray([-0.0]), "v": np.asarray([1])})
+    t.write_chunk(chunk)
+    assert t.get_row((-0.0,)) == (-0.0, 1) or t.get_row((0.0,)) == (-0.0, 1)
+    t.delete((0.0, 1))          # scalar delete reaches the bulk-written row
+    assert not t.mem_table.is_dirty()
+
+    # NULL dist-key value: row lands in a vnode and stays addressable
+    t2 = StateTable(32, schema, pk_indices=[0], store=store,
+                    dist_key_indices=[0])
+    t2.init_epoch(EpochPair.new_initial(Epoch.from_physical(1)))
+    c2 = StreamChunk.from_pydict(
+        schema, {"f": [None, 2.5], "v": [7, 8]})
+    t2.write_chunk(c2)
+    assert t2.get_row((None,)) == (None, 7)
+    assert t2.get_row((2.5,)) == (2.5, 8)
+    t2.delete((None, 7))
+    assert t2.get_row((None,)) is None
