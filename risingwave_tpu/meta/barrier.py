@@ -161,16 +161,22 @@ class BarrierLoop:
         """
         n = 0
         collector = None
+        interval = self.interval_ms / 1000
+        next_tick = self.monotonic()      # first barrier fires immediately
         try:
             while not self._stopped and (stop_after is None
                                          or n < stop_after):
-                if len(self._in_flight) < self.in_flight_barrier_nums:
-                    await self.inject()
-                    n += 1
+                if self.monotonic() >= next_tick:
+                    # the tick schedule survives fast collections: barriers
+                    # are injected at interval rate, not collection rate
+                    if len(self._in_flight) < self.in_flight_barrier_nums:
+                        await self.inject()
+                        n += 1
+                    next_tick = max(next_tick + interval, self.monotonic())
                 if collector is None and self._in_flight:
                     collector = asyncio.ensure_future(self.collect_next())
-                sleeper = asyncio.ensure_future(
-                    asyncio.sleep(self.interval_ms / 1000))
+                delay = max(0.0, next_tick - self.monotonic())
+                sleeper = asyncio.ensure_future(asyncio.sleep(delay))
                 waits = {sleeper} | ({collector} if collector else set())
                 done, _ = await asyncio.wait(
                     waits, return_when=asyncio.FIRST_COMPLETED)
@@ -179,7 +185,9 @@ class BarrierLoop:
                     collector = None
                 if sleeper not in done:
                     sleeper.cancel()
-            while self._in_flight:
+            # drain: a running collector holds an epoch already popped from
+            # _in_flight — await it too, or the last epoch never commits
+            while collector is not None or self._in_flight:
                 if collector is not None:
                     await collector
                     collector = None

@@ -1,0 +1,17 @@
+"""Device-resident stateful kernels: the TPU execution core.
+
+This package is the reason the project exists (BASELINE north star): the
+per-row Rust loops of the reference's stateful operators
+(src/stream/src/executor/hash_agg.rs:329, hash_join.rs:990) become
+whole-chunk XLA kernels over HBM-resident open-addressing hash tables.
+
+    hash_table   functional open-addressing table: probe/insert as jitted
+                 whole-batch kernels (the shared primitive)
+    hash_agg     grouped aggregation state machine (count/sum/min/max with
+                 retraction semantics)
+    hash_join    two-sided equi-join state (row arena + per-key chains)
+"""
+
+from risingwave_tpu.ops.hash_table import DeviceHashTable, TableState
+
+__all__ = ["DeviceHashTable", "TableState"]

@@ -1,0 +1,462 @@
+"""Device-resident grouped aggregation state (the q7 kernel).
+
+Reference parity: src/stream/src/executor/hash_agg.rs:67 (executor state),
+:329 (``apply_chunk``), :445 (``flush_data``); value-state accumulators
+src/stream/src/executor/aggregation/agg_group.rs. Re-designed TPU-first:
+the reference updates one `AggGroup` at a time through a hashbrown map —
+here the entire chunk is one XLA step: batch probe-insert into the HBM
+table, then scatter-add / scatter-max the per-row contributions into
+accumulator arrays. Python cost per chunk is O(1).
+
+State layout (all device arrays, slot-indexed, functional updates):
+
+    keys        int64[cap, K]   group-key lanes        (hash_table)
+    occ         bool[cap]                              (hash_table)
+    group_rows  int64[cap]      net row count (Σ signs) — group liveness
+    accs        flat per-call   COUNT: cnt  |  SUM: acc, nn  |  MIN/MAX:
+                                ext, nn   (nn = non-null input count)
+    dirty       bool[cap]       touched since last barrier flush
+    emitted_*   snapshot of (group_rows, *accs) as of the last flush — the
+                exact physical row persisted in the value StateTable, so
+                the barrier flush derives Insert/Update/Delete and the old
+                row for the state-table write with zero host-side maps.
+
+Retraction rules (Op sign semantics, stream_chunk.rs):
+  COUNT/SUM are sign-linear — scatter-add of ``sign * x``.
+  MIN/MAX are not invertible: supported on device for *append-only* input
+  (scatter-max/min); with retractions the executor layers the reference's
+  materialized-input strategy (aggregation/minput.rs) on top — deletes
+  force a recompute of affected groups at flush.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.common.chunk import next_pow2
+from risingwave_tpu.ops import hash_table as ht
+
+
+class AggKind(enum.Enum):
+    COUNT = "count"        # count(col) or count(*) when input is None
+    SUM = "sum"
+    MIN = "min"
+    MAX = "max"
+
+
+@dataclass(frozen=True)
+class AggSpec:
+    """One aggregate call, physical view (numpy dtypes)."""
+
+    kind: AggKind
+    in_dtype: Optional[np.dtype] = None   # None ⇒ count(*)
+
+    @property
+    def out_dtype(self) -> np.dtype:
+        if self.kind == AggKind.COUNT:
+            return np.dtype(np.int64)
+        assert self.in_dtype is not None
+        if self.kind == AggKind.SUM:
+            if np.issubdtype(self.in_dtype, np.floating):
+                return np.dtype(np.float64)
+            return np.dtype(np.int64)     # ints + scaled DECIMAL
+        return np.dtype(self.in_dtype)    # MIN/MAX
+
+    @property
+    def n_accs(self) -> int:
+        return 1 if self.kind == AggKind.COUNT else 2
+
+
+def _extreme(dtype: np.dtype, kind: AggKind):
+    """Identity element for scatter-max/min in `dtype`."""
+    if np.issubdtype(dtype, np.floating):
+        return -np.inf if kind == AggKind.MAX else np.inf
+    info = np.iinfo(dtype)
+    return info.min if kind == AggKind.MAX else info.max
+
+
+def acc_dtypes(specs: Sequence[AggSpec]) -> List[np.dtype]:
+    """Flat accumulator dtypes (the physical value-state row layout
+    after group keys and group_rows)."""
+    out: List[np.dtype] = []
+    for s in specs:
+        if s.kind == AggKind.COUNT:
+            out.append(np.dtype(np.int64))
+        else:
+            out.extend([s.out_dtype, np.dtype(np.int64)])
+    return out
+
+
+def acc_fills(specs: Sequence[AggSpec]) -> List:
+    fills: List = []
+    for s in specs:
+        if s.kind == AggKind.COUNT:
+            fills.append(0)
+        elif s.kind == AggKind.SUM:
+            fills.extend([0, 0])
+        else:
+            fills.extend([_extreme(s.in_dtype, s.kind), 0])
+    return fills
+
+
+def split_outputs(specs: Sequence[AggSpec], accs: Sequence
+                  ) -> Tuple[List, List]:
+    """Flat acc columns → per-call (out_value, is_null) — works on both
+    device arrays (jit-traced) and host numpy slices."""
+    xp = jnp if isinstance(accs[0], (jax.Array, jax.core.Tracer)) else np
+    outs, nulls = [], []
+    j = 0
+    for s in specs:
+        if s.kind == AggKind.COUNT:
+            outs.append(accs[j])
+            nulls.append(xp.zeros(accs[j].shape[0], dtype=bool))
+            j += 1
+        else:
+            outs.append(accs[j])
+            nulls.append(accs[j + 1] == 0)
+            j += 2
+    return outs, nulls
+
+
+class AggState(NamedTuple):
+    """Functional device state for one grouped-agg operator."""
+
+    table: ht.TableState
+    group_rows: jnp.ndarray            # int64[cap]
+    dirty: jnp.ndarray                 # bool[cap]
+    accs: Tuple[jnp.ndarray, ...]      # flat accumulators (acc_dtypes)
+    emitted_valid: jnp.ndarray         # bool[cap] — group was live at flush
+    emitted_rows: jnp.ndarray          # int64[cap] — snapshot group_rows
+    emitted_accs: Tuple[jnp.ndarray, ...]   # snapshot accs
+
+
+def make_agg_state(capacity: int, key_width: int,
+                   specs: Sequence[AggSpec]) -> AggState:
+    dts, fills = acc_dtypes(specs), acc_fills(specs)
+    accs = tuple(jnp.full(capacity, f, dtype=dt)
+                 for dt, f in zip(dts, fills))
+    return AggState(
+        table=ht.make_state(capacity, key_width),
+        group_rows=jnp.zeros(capacity, dtype=jnp.int64),
+        dirty=jnp.zeros(capacity, dtype=bool),
+        accs=accs,
+        emitted_valid=jnp.zeros(capacity, dtype=bool),
+        emitted_rows=jnp.zeros(capacity, dtype=jnp.int64),
+        emitted_accs=tuple(jnp.full(capacity, f, dtype=dt)
+                           for dt, f in zip(dts, fills)),
+    )
+
+
+def build_apply(specs: Sequence[AggSpec]):
+    """Compile the per-chunk step for a fixed agg plan.
+
+    step(state, key_lanes[N,K], signs[N] int32, vis[N] bool,
+         inputs: tuple per non-count(*) call of (values[N], valid[N]))
+    → (state, n_inserted). jit-cached per (cap, N).
+    """
+    specs = tuple(specs)
+
+    def step(state: AggState, key_lanes, signs, vis, inputs):
+        cap = state.table.capacity
+        table, slots, ins = ht.probe_insert(state.table, key_lanes, vis)
+        scat = jnp.where(vis, slots, cap)   # invisible rows dropped
+        s64 = signs.astype(jnp.int64)
+        group_rows = state.group_rows.at[scat].add(s64, mode="drop")
+        dirty = state.dirty.at[scat].set(True, mode="drop")
+        accs = list(state.accs)
+        j = 0       # flat acc cursor
+        k = 0       # inputs cursor
+        for spec in specs:
+            if spec.kind == AggKind.COUNT and spec.in_dtype is None:
+                accs[j] = accs[j].at[scat].add(s64, mode="drop")
+                j += 1
+                continue
+            vals, val_ok = inputs[k]
+            k += 1
+            live = vis & val_ok
+            live_scat = jnp.where(live, slots, cap)
+            if spec.kind == AggKind.COUNT:
+                accs[j] = accs[j].at[live_scat].add(s64, mode="drop")
+                j += 1
+            elif spec.kind == AggKind.SUM:
+                contrib = vals.astype(accs[j].dtype) * \
+                    s64.astype(accs[j].dtype)
+                accs[j] = accs[j].at[live_scat].add(contrib, mode="drop")
+                accs[j + 1] = accs[j + 1].at[live_scat].add(s64, mode="drop")
+                j += 2
+            else:   # MIN/MAX — device path covers inserts (sign > 0)
+                ins_scat = jnp.where(live & (s64 > 0), slots, cap)
+                v = vals.astype(accs[j].dtype)
+                if spec.kind == AggKind.MAX:
+                    accs[j] = accs[j].at[ins_scat].max(v, mode="drop")
+                else:
+                    accs[j] = accs[j].at[ins_scat].min(v, mode="drop")
+                accs[j + 1] = accs[j + 1].at[live_scat].add(s64, mode="drop")
+                j += 2
+        return AggState(table, group_rows, dirty, tuple(accs),
+                        state.emitted_valid, state.emitted_rows,
+                        state.emitted_accs), ins
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def build_flush(specs: Sequence[AggSpec]):
+    """Compile the barrier-flush gather/advance pair.
+
+    gather(state, idx[P]) → host-bound bundle for (padded) dirty slots.
+    advance(state, idx[P], live[P]) → emitted := current, dirty cleared.
+    """
+
+    @jax.jit
+    def gather(state: AggState, idx):
+        safe = jnp.minimum(idx, state.table.capacity - 1)
+        return (
+            state.table.keys[safe],
+            state.group_rows[safe],
+            tuple(a[safe] for a in state.accs),
+            state.emitted_valid[safe],
+            state.emitted_rows[safe],
+            tuple(a[safe] for a in state.emitted_accs),
+        )
+
+    @jax.jit
+    def advance(state: AggState, idx, live):
+        cap = state.table.capacity
+        safe = jnp.minimum(idx, cap - 1)
+        scat = jnp.where(live, idx, cap)
+        ev = state.emitted_valid.at[scat].set(
+            state.group_rows[safe] > 0, mode="drop")
+        er = state.emitted_rows.at[scat].set(
+            state.group_rows[safe], mode="drop")
+        ea = tuple(e.at[scat].set(a[safe], mode="drop")
+                   for e, a in zip(state.emitted_accs, state.accs))
+        return AggState(state.table, state.group_rows,
+                        jnp.zeros_like(state.dirty), state.accs,
+                        ev, er, ea)
+
+    return gather, advance
+
+
+def build_patch(specs: Sequence[AggSpec]):
+    """Compile the host→device acc patch (retractable MIN/MAX recompute
+    writes corrected extremes back before the snapshot advances)."""
+
+    @jax.jit
+    def patch(state: AggState, idx, new_accs):
+        cap = state.table.capacity
+        accs = tuple(a.at[jnp.minimum(idx, cap)].set(v, mode="drop")
+                     for a, v in zip(state.accs, new_accs))
+        return state._replace(accs=accs)
+
+    return patch
+
+
+def remap_slots(arr: jnp.ndarray, old_to_new: jnp.ndarray,
+                new_cap: int, fill) -> jnp.ndarray:
+    """Re-scatter a slot-indexed array after a table rehash.
+
+    `old_to_new[i]` is the new slot of old slot i (-1 for unoccupied)."""
+    if arr.dtype == jnp.bool_:
+        init = jnp.full(new_cap, bool(fill), dtype=arr.dtype)
+    else:
+        init = jnp.full(new_cap, fill, dtype=arr.dtype)
+    safe = jnp.where(old_to_new >= 0, old_to_new, new_cap)
+    return init.at[safe].set(arr, mode="drop")
+
+
+_remap_jit = jax.jit(remap_slots, static_argnums=(2, 3))
+
+
+@dataclass
+class FlushResult:
+    """Host view of the dirty groups at a barrier (pre-advance)."""
+
+    n: int
+    keys: np.ndarray                 # int64[n, K]
+    group_rows: np.ndarray           # int64[n] — current
+    accs: List[np.ndarray]           # flat acc columns, current
+    was_emitted: np.ndarray          # bool[n]
+    prev_rows: np.ndarray            # int64[n] — at last flush
+    prev_accs: List[np.ndarray]      # flat acc columns at last flush
+
+    @staticmethod
+    def empty(specs: Sequence[AggSpec], key_width: int) -> "FlushResult":
+        dts = acc_dtypes(specs)
+        return FlushResult(
+            0, np.zeros((0, key_width), dtype=np.int64),
+            np.zeros(0, dtype=np.int64),
+            [np.zeros(0, dtype=dt) for dt in dts],
+            np.zeros(0, dtype=bool),
+            np.zeros(0, dtype=np.int64),
+            [np.zeros(0, dtype=dt) for dt in dts])
+
+
+class GroupedAggKernel:
+    """Host wrapper: growth scheduling, flush bookkeeping, jit caches.
+
+    The executor drives it: ``apply`` per chunk (no device syncs),
+    ``flush`` per barrier (one gather round-trip), ``rebuild`` on recovery.
+    """
+
+    def __init__(self, key_width: int, specs: Sequence[AggSpec],
+                 capacity: int = ht.MIN_CAPACITY):
+        capacity = max(next_pow2(capacity), ht.MIN_CAPACITY)
+        self.specs = tuple(specs)
+        self.key_width = key_width
+        self.state = make_agg_state(capacity, key_width, self.specs)
+        self._apply = build_apply(self.specs)
+        self._gather, self._advance = build_flush(self.specs)
+        self._patch = build_patch(self.specs)
+        self._count_exact = 0
+        self._pending_rows = 0
+        self._pending_counters: List[jnp.ndarray] = []
+        # idx of the in-progress flush (set by flush, used by patch/advance)
+        self._flush_idx: Optional[np.ndarray] = None
+
+    @property
+    def capacity(self) -> int:
+        return self.state.table.capacity
+
+    # -- hot path -------------------------------------------------------
+    def apply(self, key_lanes: jnp.ndarray, signs: jnp.ndarray,
+              vis: jnp.ndarray, inputs: Tuple) -> None:
+        n = int(key_lanes.shape[0])
+        self._reserve(n)
+        self.state, ins = self._apply(self.state, key_lanes, signs, vis,
+                                      inputs)
+        self._pending_counters.append(ins)
+        self._pending_rows += n
+
+    # -- growth ---------------------------------------------------------
+    def _reserve(self, n: int) -> None:
+        while (self._count_exact + self._pending_rows + n
+               > ht.MAX_LOAD * self.capacity):
+            if self._pending_counters:
+                self._sync_count()   # bound may be loose — sync first
+                continue
+            self._grow()
+
+    def _sync_count(self) -> None:
+        for c in self._pending_counters:
+            self._count_exact += int(c)
+        self._pending_counters = []
+        self._pending_rows = 0
+
+    def _grow(self) -> None:
+        """Rehash into a doubled table, reclaiming dead groups.
+
+        A slot is live iff its group has rows OR a flush hasn't retired it
+        yet (dirty / still-emitted) — tumbling-window churn leaves fully
+        retracted groups behind, and carrying them forever would grow the
+        table without bound."""
+        old = self.state
+        new_cap = old.table.capacity * 2
+        new_table = ht.make_state(new_cap, self.key_width)
+        live = old.table.occ & ((old.group_rows != 0) | old.dirty
+                                | old.emitted_valid)
+        new_table, old_to_new, n_live = ht.probe_insert(
+            new_table, old.table.keys, live)
+        fills = acc_fills(self.specs)
+        self.state = AggState(
+            table=new_table,
+            group_rows=_remap_jit(old.group_rows, old_to_new, new_cap, 0),
+            dirty=_remap_jit(old.dirty, old_to_new, new_cap, 0),
+            accs=tuple(_remap_jit(a, old_to_new, new_cap, f)
+                       for a, f in zip(old.accs, fills)),
+            emitted_valid=_remap_jit(old.emitted_valid, old_to_new,
+                                     new_cap, 0),
+            emitted_rows=_remap_jit(old.emitted_rows, old_to_new,
+                                    new_cap, 0),
+            emitted_accs=tuple(_remap_jit(a, old_to_new, new_cap, f)
+                               for a, f in zip(old.emitted_accs, fills)),
+        )
+        # occupancy accounting restarts from the live population
+        self._count_exact = int(n_live)
+        assert not self._pending_counters, "grow with unsynced counters"
+
+    # -- barrier flush ---------------------------------------------------
+    def flush(self) -> FlushResult:
+        """Gather dirty groups to host. Call ``advance`` after consuming
+        (optionally ``patch``-ing corrected accs in between)."""
+        self._sync_count()
+        dirty = np.asarray(self.state.dirty)
+        idx = np.flatnonzero(dirty).astype(np.int32)
+        p = len(idx)
+        self._flush_idx = idx
+        if p == 0:
+            return FlushResult.empty(self.specs, self.key_width)
+        pad = next_pow2(p)
+        idx_padded = np.full(pad, self.capacity, dtype=np.int32)
+        idx_padded[:p] = idx
+        bundle = self._gather(self.state, jnp.asarray(idx_padded))
+        keys, rows, accs, was, prows, paccs = jax.device_get(bundle)
+        return FlushResult(
+            n=p, keys=keys[:p], group_rows=rows[:p],
+            accs=[a[:p] for a in accs], was_emitted=was[:p],
+            prev_rows=prows[:p], prev_accs=[a[:p] for a in paccs])
+
+    def patch_accs(self, accs: List[np.ndarray]) -> None:
+        """Overwrite the flushed groups' accumulators (minput recompute)."""
+        idx = self._flush_idx
+        assert idx is not None and len(idx) > 0
+        pad = next_pow2(len(idx))
+        idx_padded = np.full(pad, self.capacity, dtype=np.int32)
+        idx_padded[:len(idx)] = idx
+        padded = tuple(
+            np.concatenate([a, np.zeros(pad - len(idx), dtype=a.dtype)])
+        for a in accs)
+        self.state = self._patch(self.state, jnp.asarray(idx_padded),
+                                 padded)
+
+    def advance(self) -> None:
+        """Snapshot emitted := current for flushed groups; clear dirty."""
+        idx = self._flush_idx
+        assert idx is not None, "flush() first"
+        self._flush_idx = None
+        if len(idx) == 0:
+            return
+        pad = next_pow2(len(idx))
+        idx_padded = np.full(pad, self.capacity, dtype=np.int32)
+        idx_padded[:len(idx)] = idx
+        live = np.zeros(pad, dtype=bool)
+        live[:len(idx)] = True
+        self.state = self._advance(self.state, jnp.asarray(idx_padded),
+                                   jnp.asarray(live))
+
+    # -- recovery ---------------------------------------------------------
+    def rebuild(self, keys: np.ndarray, group_rows: np.ndarray,
+                acc_cols: Sequence[np.ndarray]) -> None:
+        """Reload from committed value-state rows (boot/recovery).
+
+        Restored groups are marked emitted — their outputs were committed
+        downstream before the recovery epoch.
+        """
+        n = len(group_rows)
+        cap = max(self.capacity, next_pow2(int(n / ht.MAX_LOAD) + 1))
+        self.state = make_agg_state(cap, self.key_width, self.specs)
+        self._count_exact = n
+        self._pending_rows = 0
+        self._pending_counters = []
+        if n == 0:
+            return
+        table, slots, _ = ht.probe_insert(
+            self.state.table, jnp.asarray(keys), jnp.ones(n, dtype=bool))
+        accs = tuple(a.at[slots].set(jnp.asarray(col))
+                     for a, col in zip(self.state.accs, acc_cols))
+        rows_dev = self.state.group_rows.at[slots].set(
+            jnp.asarray(group_rows))
+        self.state = AggState(
+            table=table, group_rows=rows_dev, dirty=self.state.dirty,
+            accs=accs,
+            emitted_valid=self.state.emitted_valid.at[slots].set(True),
+            # distinct buffers: the apply step donates the state, and a
+            # buffer may be donated at most once per call
+            emitted_rows=jnp.copy(rows_dev),
+            emitted_accs=tuple(jnp.copy(a) for a in accs),
+        )

@@ -1,0 +1,237 @@
+"""HBM-resident open-addressing hash table with whole-batch jitted kernels.
+
+Reference parity: the *role* of src/common/src/hash/key.rs (HashKey) plus
+the in-memory halves of JoinHashMap (src/stream/src/executor/managed_state/
+join/mod.rs:228) and the hash_agg group map (hash_agg.rs:67). The design is
+NOT a port: the reference probes a CPU hashbrown map row by row; here the
+whole chunk probes in parallel as one XLA computation.
+
+Design (TPU-first):
+
+- State is a pair of device arrays: ``keys: int64[cap, K]`` and
+  ``occ: bool[cap]``. Capacity is a power of two; the jit cache is keyed by
+  (cap, K, N) so growth or a new chunk bucket compiles once and is cached.
+- ``probe_insert`` finds-or-inserts a whole batch in one call. Collisions
+  *within* the batch (several rows landing on one empty slot) are resolved
+  with a claim round: an int32 scatter-min elects one winner per slot, the
+  winner writes its key, and every loser re-checks for a key match before
+  advancing — so duplicate keys in one batch converge on one slot.
+- Linear probing, stride 1: probe chains stay contiguous in HBM which is
+  exactly what the vector units want; the host wrapper keeps load factor
+  under ``MAX_LOAD`` so chains stay short.
+- Deletion is logical (the aggregation layer zeroes its per-group counts);
+  slots are reclaimed on growth rehash. Tombstone-free probing keeps the
+  kernel branchless.
+- All functions are pure: they take and return ``TableState``. The host
+  wrapper ``DeviceHashTable`` owns growth scheduling with a *sync-free*
+  occupancy upper bound (exact count is only synced at barriers, mirroring
+  the "no host round-trip inside the hot loop" rule).
+
+Keys are int64 lanes. Callers map their key columns to lanes:
+device-numeric columns cast losslessly; varchar keys hash on the host
+(common/hash.py:hash_strings_host) and feed the hash lane — equality on the
+lane is then *hash* equality, which is the same contract the reference's
+``HashKey`` serialization provides for its Key8..Key256 fast paths.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.common.hash import hash_columns
+
+MAX_LOAD = 0.70          # grow when occupancy upper bound crosses this
+MIN_CAPACITY = 1 << 10
+
+
+class TableState(NamedTuple):
+    """Functional hash-table state (all device arrays)."""
+
+    keys: jnp.ndarray    # int64[cap, K]
+    occ: jnp.ndarray     # bool[cap]
+
+    @property
+    def capacity(self) -> int:
+        return int(self.keys.shape[0])
+
+    @property
+    def key_width(self) -> int:
+        return int(self.keys.shape[1])
+
+
+def make_state(capacity: int, key_width: int) -> TableState:
+    assert capacity & (capacity - 1) == 0, "capacity must be a power of two"
+    return TableState(
+        keys=jnp.zeros((capacity, key_width), dtype=jnp.int64),
+        occ=jnp.zeros((capacity,), dtype=bool),
+    )
+
+
+def hash_key_lanes(batch_keys: jnp.ndarray) -> jnp.ndarray:
+    """uint32[N] hash of int64[N, K] key lanes (shared with dispatch)."""
+    cols = [batch_keys[:, i] for i in range(batch_keys.shape[1])]
+    return hash_columns(cols)
+
+
+def _match_at(keys: jnp.ndarray, occ: jnp.ndarray, slot: jnp.ndarray,
+              batch_keys: jnp.ndarray) -> jnp.ndarray:
+    return occ[slot] & jnp.all(keys[slot] == batch_keys, axis=1)
+
+
+def probe_insert(state: TableState, batch_keys: jnp.ndarray,
+                 valid: jnp.ndarray
+                 ) -> Tuple[TableState, jnp.ndarray, jnp.ndarray]:
+    """Find-or-insert every valid row of the batch.
+
+    Returns (new_state, slots int32[N], n_inserted int32). Rows with
+    ``valid=False`` get slot -1 and do not touch the table. The caller must
+    guarantee a free slot exists for every valid row (load-factor contract
+    enforced by DeviceHashTable) — under that contract the loop terminates
+    before ``cap`` steps.
+    """
+    cap = state.capacity
+    mask = jnp.int32(cap - 1)
+    n = batch_keys.shape[0]
+    row_ids = jnp.arange(n, dtype=jnp.int32)
+    slot0 = (hash_key_lanes(batch_keys).astype(jnp.int32)) & mask
+
+    def cond(carry):
+        _slot, done, _keys, _occ, steps, _ins = carry
+        return (~jnp.all(done)) & (steps < cap)
+
+    def body(carry):
+        slot, done, keys, occ, steps, ins = carry
+        # 1) key already present (from the table or an earlier iteration)?
+        done = done | _match_at(keys, occ, slot, batch_keys)
+        # 2) claim round for empty slots: scatter-min elects one winner.
+        want = ~done & ~occ[slot]
+        claim_idx = jnp.where(want, slot, cap)  # cap = out-of-bounds, dropped
+        claim = jnp.full((cap,), n, dtype=jnp.int32) \
+            .at[claim_idx].min(row_ids, mode="drop")
+        won = want & (claim[slot] == row_ids)
+        scat = jnp.where(won, slot, cap)
+        keys = keys.at[scat].set(batch_keys, mode="drop")
+        occ = occ.at[scat].set(True, mode="drop")
+        ins = ins + jnp.sum(won, dtype=jnp.int32)
+        # 3) re-check: winners match their own write; a loser whose key was
+        #    just written by its winner matches too (no duplicate chains).
+        done = done | _match_at(keys, occ, slot, batch_keys)
+        slot = jnp.where(done, slot, (slot + 1) & mask)
+        return slot, done, keys, occ, steps + 1, ins
+
+    init = (slot0, ~valid, state.keys, state.occ, jnp.int32(0), jnp.int32(0))
+    slot, done, keys, occ, _steps, ins = jax.lax.while_loop(cond, body, init)
+    slots = jnp.where(valid, slot, jnp.int32(-1))
+    return TableState(keys, occ), slots, ins
+
+
+def lookup(state: TableState, batch_keys: jnp.ndarray,
+           valid: jnp.ndarray) -> jnp.ndarray:
+    """Slots of existing keys; -1 for absent/invalid rows. Read-only."""
+    cap = state.capacity
+    mask = jnp.int32(cap - 1)
+    slot0 = (hash_key_lanes(batch_keys).astype(jnp.int32)) & mask
+    found0 = jnp.zeros(batch_keys.shape[0], dtype=bool)
+
+    def cond(carry):
+        _slot, done, _found, steps = carry
+        return (~jnp.all(done)) & (steps < cap)
+
+    def body(carry):
+        slot, done, found, steps = carry
+        m = _match_at(state.keys, state.occ, slot, batch_keys)
+        empty = ~state.occ[slot]
+        found = found | (~done & m)
+        done = done | m | empty          # empty slot ⇒ key absent
+        slot = jnp.where(done, slot, (slot + 1) & mask)
+        return slot, done, found, steps + 1
+
+    init = (slot0, ~valid, found0, jnp.int32(0))
+    slot, _done, found, _steps = jax.lax.while_loop(cond, body, init)
+    return jnp.where(valid & found, slot, jnp.int32(-1))
+
+
+_probe_insert_jit = jax.jit(probe_insert, donate_argnums=(0,))
+_lookup_jit = jax.jit(lookup)
+
+
+class DeviceHashTable:
+    """Host wrapper: owns growth scheduling and the sync-free load bound.
+
+    ``probe_insert`` never syncs; occupancy is tracked as an upper bound
+    (each batch can insert at most its row count). ``sync_count()`` — called
+    at barriers, where a device round-trip is already happening — collapses
+    the bound to the true count.
+    """
+
+    def __init__(self, key_width: int, capacity: int = MIN_CAPACITY):
+        self.state = make_state(max(capacity, MIN_CAPACITY), key_width)
+        self._count_exact = 0          # as of last sync
+        self._pending: list = []       # device int32 insert counters
+        self._pending_rows = 0         # upper bound on pending insertions
+
+    @property
+    def capacity(self) -> int:
+        return self.state.capacity
+
+    def _count_upper_bound(self) -> int:
+        return self._count_exact + self._pending_rows
+
+    def probe_insert(self, batch_keys: jnp.ndarray,
+                     valid: jnp.ndarray) -> jnp.ndarray:
+        n = int(batch_keys.shape[0])
+        self.reserve(n)
+        self.state, slots, ins = _probe_insert_jit(
+            self.state, batch_keys, valid)
+        self._pending.append(ins)
+        self._pending_rows += n
+        return slots
+
+    def lookup(self, batch_keys: jnp.ndarray,
+               valid: jnp.ndarray) -> jnp.ndarray:
+        return _lookup_jit(self.state, batch_keys, valid)
+
+    def reserve(self, n: int) -> bool:
+        """Grow (rehash) until `n` more insertions respect MAX_LOAD.
+
+        Returns True if a rehash happened (slots from before are invalid —
+        callers that cache slots must subscribe via on_grow).
+        """
+        grew = False
+        while self._count_upper_bound() + n > MAX_LOAD * self.capacity:
+            if self._pending:          # bound too loose? sync before paying
+                self.sync_count()      # for a rehash we may not need
+                if self._count_upper_bound() + n <= MAX_LOAD * self.capacity:
+                    break
+            self._grow()
+            grew = True
+        return grew
+
+    def _grow(self) -> None:
+        old = self.state
+        new = make_state(old.capacity * 2, old.key_width)
+        # Rehash: one batched probe_insert of every occupied slot.
+        occ = old.occ
+        new, slots, ins = _probe_insert_jit(new, old.keys, occ)
+        self.state = new
+        self._grow_slots = slots       # old slot i → new slot (for movers)
+        for hook in getattr(self, "_on_grow", []):
+            hook(slots, old.capacity)
+
+    def on_grow(self, hook) -> None:
+        """Register `hook(old_to_new_slots, old_capacity)` called on rehash."""
+        if not hasattr(self, "_on_grow"):
+            self._on_grow = []
+        self._on_grow.append(hook)
+
+    def sync_count(self) -> int:
+        """Collapse the occupancy bound to the exact device count (syncs)."""
+        for ins in self._pending:
+            self._count_exact += int(ins)
+        self._pending = []
+        self._pending_rows = 0
+        return self._count_exact

@@ -1,0 +1,331 @@
+"""HashAggExecutor: streaming GROUP BY on device-resident state.
+
+Reference parity: src/stream/src/executor/hash_agg.rs:67 (executor),
+:329 (apply_chunk), :445 (flush_data) and the value-state encoding of
+aggregation/agg_group.rs. The TPU re-design moves the per-row group map
+into HBM (ops/hash_agg.py); this executor is the thin host driver:
+
+  chunk    → build key lanes + agg inputs, one jitted device step
+  barrier  → one device gather of dirty groups → emit change chunk,
+             persist physical rows through the StateTable, commit epoch
+
+Emission semantics match flush_data: first touch of a group emits Insert,
+subsequent changes emit an UpdateDelete/UpdateInsert pair, a group whose
+row count drops to zero emits Delete. Outputs are compared against the
+device-resident emitted snapshot, so repeated no-op touches emit nothing.
+
+Value-state row layout (physical): group keys | group_rows | flat accs
+(COUNT: cnt; SUM: acc, nn; MIN/MAX: ext, nn). Recovery reloads the table
+and re-uploads it into the kernel (``GroupedAggKernel.rebuild``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import AsyncIterator, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.common.chunk import (
+    Column, Op, StreamChunk, next_pow2,
+)
+from risingwave_tpu.common.types import DataType, Field, Schema
+from risingwave_tpu.ops.hash_agg import (
+    AggKind, AggSpec, GroupedAggKernel, acc_dtypes, split_outputs,
+)
+from risingwave_tpu.state.state_table import StateTable
+from risingwave_tpu.stream.executor import Executor, ExecutorInfo
+from risingwave_tpu.stream.message import (
+    Barrier, Message, Watermark, is_barrier, is_chunk, is_watermark,
+)
+
+_SUM_OUT = {
+    DataType.INT16: DataType.INT64, DataType.INT32: DataType.INT64,
+    DataType.INT64: DataType.INT64, DataType.DECIMAL: DataType.DECIMAL,
+    DataType.FLOAT32: DataType.FLOAT64, DataType.FLOAT64: DataType.FLOAT64,
+}
+
+
+@dataclass(frozen=True)
+class AggCall:
+    """Logical aggregate call (agg/mod.rs AggCall analog)."""
+
+    kind: AggKind
+    input_idx: Optional[int] = None      # None ⇒ count(*)
+
+    def out_type(self, input_schema: Schema) -> DataType:
+        if self.kind == AggKind.COUNT:
+            return DataType.INT64
+        in_t = input_schema[self.input_idx].data_type
+        if self.kind == AggKind.SUM:
+            try:
+                return _SUM_OUT[in_t]
+            except KeyError:
+                raise TypeError(f"sum over {in_t} unsupported")
+        return in_t
+
+    def spec(self, input_schema: Schema) -> AggSpec:
+        if self.kind == AggKind.COUNT and self.input_idx is None:
+            return AggSpec(AggKind.COUNT, None)
+        in_t = input_schema[self.input_idx].data_type
+        if not in_t.is_device:
+            raise TypeError(f"agg over host type {in_t} needs the host path")
+        return AggSpec(self.kind, np.dtype(in_t.np_dtype))
+
+
+def agg_output_schema(input_schema: Schema, group_indices: Sequence[int],
+                      agg_calls: Sequence[AggCall],
+                      names: Optional[Sequence[str]] = None) -> Schema:
+    """Output schema: group keys then one column per agg call."""
+    fields = [input_schema[i] for i in group_indices]
+    for j, call in enumerate(agg_calls):
+        name = names[j] if names else f"agg{j}"
+        fields.append(Field(name, call.out_type(input_schema)))
+    return Schema(fields)
+
+
+def agg_state_schema(input_schema: Schema, group_indices: Sequence[int],
+                     agg_calls: Sequence[AggCall]
+                     ) -> Tuple[Schema, List[int]]:
+    """Value-state table schema + pk indices (pk = group keys)."""
+    fields = [input_schema[i] for i in group_indices]
+    fields.append(Field("_group_rows", DataType.INT64))
+    specs = [c.spec(input_schema) for c in agg_calls]
+    for j, dt in enumerate(acc_dtypes(specs)):
+        lt = DataType.FLOAT64 if np.issubdtype(dt, np.floating) \
+            else DataType.INT64
+        fields.append(Field(f"_acc{j}", lt))
+    return Schema(fields), list(range(len(group_indices)))
+
+
+class HashAggExecutor(Executor):
+    """Streaming hash aggregation over a device kernel (hash_agg.rs:67)."""
+
+    def __init__(self, input_: Executor, group_indices: Sequence[int],
+                 agg_calls: Sequence[AggCall], table: StateTable,
+                 append_only: bool = False,
+                 output_names: Optional[Sequence[str]] = None,
+                 actor_id: int = 0):
+        self.input = input_
+        self.group_indices = list(group_indices)
+        self.agg_calls = list(agg_calls)
+        self.table = table
+        self.append_only = append_only
+        in_schema = input_.schema
+        self.group_types = [in_schema[i].data_type
+                            for i in self.group_indices]
+        for dt in self.group_types:
+            if not dt.is_device:
+                raise TypeError(
+                    f"group key type {dt} not device-hashable yet")
+        self.specs = [c.spec(in_schema) for c in self.agg_calls]
+        if not append_only and any(
+                s.kind in (AggKind.MIN, AggKind.MAX) for s in self.specs):
+            raise NotImplementedError(
+                "retractable min/max needs the materialized-input state "
+                "(minput) path — pass append_only=True or use sum/count")
+        # two lanes per group col: value + null indicator (NULL is a group)
+        self.kernel = GroupedAggKernel(
+            key_width=2 * len(self.group_indices), specs=self.specs)
+        out_schema = agg_output_schema(in_schema, group_indices, agg_calls,
+                                       output_names)
+        super().__init__(ExecutorInfo(
+            out_schema, list(range(len(group_indices))),
+            f"HashAggExecutor(actor={actor_id})"))
+
+    # -- chunk path ------------------------------------------------------
+    @staticmethod
+    def _to_lane(vals: np.ndarray) -> np.ndarray:
+        """Column values → int64 lane, value-preserving per *distinct key*.
+
+        Floats are bit-cast (not value-cast: 1.2 and 1.7 are distinct
+        groups) with -0.0 normalized so it groups with 0.0."""
+        if np.issubdtype(vals.dtype, np.floating):
+            vals = np.where(vals == 0, np.zeros((), dtype=vals.dtype), vals)
+            return vals.astype(np.float64).view(np.int64)
+        return vals.astype(np.int64)
+
+    def _key_lanes(self, chunk: StreamChunk) -> jnp.ndarray:
+        n = chunk.capacity
+        lanes = np.empty((n, 2 * len(self.group_indices)), dtype=np.int64)
+        for j, i in enumerate(self.group_indices):
+            c = chunk.columns[i]
+            vals = self._to_lane(np.asarray(c.values))
+            if c.validity is None:
+                lanes[:, 2 * j] = vals
+                lanes[:, 2 * j + 1] = 1
+            else:
+                ok = np.asarray(c.validity)
+                lanes[:, 2 * j] = np.where(ok, vals, 0)
+                lanes[:, 2 * j + 1] = ok.astype(np.int64)
+        return jnp.asarray(lanes)
+
+    def _inputs(self, chunk: StreamChunk) -> Tuple:
+        out = []
+        for call in self.agg_calls:
+            if call.kind == AggKind.COUNT and call.input_idx is None:
+                continue
+            c = chunk.columns[call.input_idx]
+            ok = jnp.ones(chunk.capacity, dtype=bool) \
+                if c.validity is None else jnp.asarray(c.validity)
+            out.append((jnp.asarray(c.values), ok))
+        return tuple(out)
+
+    def _apply_chunk(self, chunk: StreamChunk) -> None:
+        self.kernel.apply(self._key_lanes(chunk),
+                          jnp.asarray(chunk.signs()),
+                          jnp.asarray(chunk.visibility),
+                          self._inputs(chunk))
+
+    # -- barrier path ----------------------------------------------------
+    def _group_key_host(self, keys: np.ndarray
+                        ) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """Lanes → per group col (values cast to col dtype, valid mask)."""
+        cols = []
+        for j, dt in enumerate(self.group_types):
+            lane = keys[:, 2 * j]
+            if np.issubdtype(np.dtype(dt.np_dtype), np.floating):
+                vals = lane.view(np.float64).astype(dt.np_dtype)
+            else:
+                vals = lane.astype(dt.np_dtype)
+            ok = keys[:, 2 * j + 1] != 0
+            cols.append((vals, ok))
+        return cols
+
+    def _flush(self) -> Optional[StreamChunk]:
+        fr = self.kernel.flush()
+        if fr.n == 0:
+            self.kernel.advance()
+            return None
+        outs, nulls = split_outputs(self.specs, fr.accs)
+        pouts, pnulls = split_outputs(self.specs, fr.prev_accs)
+        cur_live = fr.group_rows > 0
+        was = fr.was_emitted
+        changed = np.zeros(fr.n, dtype=bool)
+        for o, po, nu, pnu in zip(outs, pouts, nulls, pnulls):
+            changed |= (nu != pnu) | (~nu & (o != po))
+        ins_i = np.flatnonzero(cur_live & ~was)
+        upd_i = np.flatnonzero(cur_live & was & changed)
+        del_i = np.flatnonzero(~cur_live & was)
+        # persistence must also cover groups whose outputs are unchanged
+        # but whose internal state (group_rows / accs) moved — otherwise
+        # recovery reloads a stale row count
+        state_moved = fr.group_rows != fr.prev_rows
+        for a, pa in zip(fr.accs, fr.prev_accs):
+            state_moved |= a != pa
+        persist_upd_i = np.flatnonzero(
+            cur_live & was & (changed | state_moved))
+        self._persist(fr, ins_i, persist_upd_i, del_i)
+        self.kernel.advance()
+        t = len(ins_i) + 2 * len(upd_i) + len(del_i)
+        if t == 0:
+            return None
+        cap = next_pow2(t)
+
+        def emit_col(cur: np.ndarray, prev: np.ndarray, dtype) -> np.ndarray:
+            out = np.zeros(cap, dtype=dtype)
+            k = len(ins_i)
+            out[:k] = cur[ins_i]
+            out[k:k + 2 * len(upd_i):2] = prev[upd_i]
+            out[k + 1:k + 2 * len(upd_i):2] = cur[upd_i]
+            out[k + 2 * len(upd_i):t] = prev[del_i]
+            return out
+
+        columns: List[Column] = []
+        for (vals, ok), dt in zip(self._group_key_host(fr.keys),
+                                  self.group_types):
+            v = emit_col(vals, vals, dt.np_dtype)
+            okc = emit_col(ok, ok, bool)
+            columns.append(Column(dt, v, None if okc.all() else okc))
+        for j, (o, po, nu, pnu) in enumerate(zip(outs, pouts, nulls,
+                                                 pnulls)):
+            dt = self.schema[len(self.group_indices) + j].data_type
+            v = emit_col(o.astype(dt.np_dtype), po.astype(dt.np_dtype),
+                         dt.np_dtype)
+            nuc = emit_col(nu, pnu, bool)
+            columns.append(Column(dt, v, None if not nuc.any() else ~nuc))
+        ops = np.full(cap, int(Op.INSERT), dtype=np.int8)
+        k = len(ins_i)
+        ops[k:k + 2 * len(upd_i):2] = int(Op.UPDATE_DELETE)
+        ops[k + 1:k + 2 * len(upd_i):2] = int(Op.UPDATE_INSERT)
+        ops[k + 2 * len(upd_i):t] = int(Op.DELETE)
+        vis = np.zeros(cap, dtype=bool)
+        vis[:t] = True
+        return StreamChunk(self.schema, columns, vis, ops)
+
+    def _state_rows(self, fr, idx: np.ndarray, prev: bool) -> List[tuple]:
+        """Physical value-state rows for the given flush indices."""
+        gk = self._group_key_host(fr.keys)
+        rows_col = fr.prev_rows if prev else fr.group_rows
+        accs = fr.prev_accs if prev else fr.accs
+        cols: List[list] = []
+        for vals, ok in gk:
+            sel = vals[idx]
+            okl = ok[idx]
+            cols.append([v if o else None
+                         for v, o in zip(sel.tolist(), okl.tolist())])
+        cols.append(rows_col[idx].tolist())
+        for a in accs:
+            cols.append(a[idx].tolist())
+        return list(zip(*cols)) if cols else []
+
+    def _persist(self, fr, ins_i, upd_i, del_i) -> None:
+        for row in self._state_rows(fr, ins_i, prev=False):
+            self.table.insert(row)
+        olds = self._state_rows(fr, upd_i, prev=True)
+        news = self._state_rows(fr, upd_i, prev=False)
+        for old, new in zip(olds, news):
+            self.table.update(old, new)
+        for row in self._state_rows(fr, del_i, prev=True):
+            self.table.delete(row)
+
+    # -- recovery --------------------------------------------------------
+    def _recover(self) -> None:
+        keys_l: List[np.ndarray] = []
+        rows_l: List[int] = []
+        accs_l: List[tuple] = []
+        ng = len(self.group_indices)
+        for _pk, row in self.table.iter_rows():
+            lane = np.zeros(2 * ng, dtype=np.int64)
+            for j in range(ng):
+                v = row[j]
+                if v is not None:
+                    dt = self.group_types[j]
+                    lane[2 * j] = self._to_lane(
+                        np.asarray([v], dtype=dt.np_dtype))[0]
+                    lane[2 * j + 1] = 1
+            keys_l.append(lane)
+            rows_l.append(int(row[ng]))
+            accs_l.append(row[ng + 1:])
+        if not rows_l:
+            return
+        keys = np.stack(keys_l)
+        dts = acc_dtypes(self.specs)
+        acc_cols = [np.asarray([a[j] for a in accs_l], dtype=dt)
+                    for j, dt in enumerate(dts)]
+        self.kernel.rebuild(keys, np.asarray(rows_l, dtype=np.int64),
+                            acc_cols)
+
+    # -- main loop -------------------------------------------------------
+    async def execute(self) -> AsyncIterator[Message]:
+        it = self.input.execute()
+        first = await it.__anext__()
+        assert is_barrier(first), f"expected init barrier, got {first!r}"
+        self.table.init_epoch(first.epoch)
+        self._recover()
+        yield first
+        async for msg in it:
+            if is_chunk(msg):
+                self._apply_chunk(msg)
+            elif is_barrier(msg):
+                out = self._flush()
+                self.table.commit(msg.epoch)
+                if out is not None:
+                    yield out
+                yield msg
+            elif is_watermark(msg):
+                # forward only group-key watermarks, re-indexed to output
+                if msg.col_idx in self.group_indices:
+                    yield msg.with_idx(
+                        self.group_indices.index(msg.col_idx))

@@ -1,12 +1,21 @@
 """RowIdGenExecutor: append a hidden serial row-id column.
 
-Reference parity: src/stream/src/executor/row_id_gen.rs — tables/MVs with no
-user pk get a generated `_row_id` so every row has a unique, stable key.
-The reference packs (vnode, local monotonic seq) so ids are unique across
-parallel actors; we do the same: id = (vnode_base << 48) | seq.
+Reference parity: src/stream/src/executor/row_id_gen.rs + the snowflake
+layout of src/common/src/util/row_id.rs — tables/MVs with no user pk get a
+generated `_row_id` so every row has a unique, stable key. The reference
+packs (timestamp, vnode, sequence); ids are unique across parallel actors
+AND across restarts, because the timestamp component comes from the epoch
+and recovery always resumes at a strictly newer epoch.
 
-TPU notes: id assignment is a vectorized arange add — one device op per
-chunk, no per-row Python.
+Layout: | rel_ms (epoch physical ms, ~41 bits) | shard (10) | seq (12) |.
+The sequence is rebased to the current barrier's epoch floor at every
+barrier: after a crash the new INITIAL barrier carries an epoch above the
+committed one, so re-generated ids can never collide with committed MV pks.
+Sequence overflow within one epoch-ms spills into the ms bits (standard
+snowflake carry) — still monotone and unique per shard.
+
+TPU notes: id assignment is a vectorized arange add — one whole-column op
+per chunk, no per-row Python.
 """
 
 from __future__ import annotations
@@ -18,9 +27,12 @@ import numpy as np
 from risingwave_tpu.common.chunk import Column, StreamChunk
 from risingwave_tpu.common.types import DataType, Field, Schema
 from risingwave_tpu.stream.executor import Executor, ExecutorInfo
-from risingwave_tpu.stream.message import Message, is_chunk
+from risingwave_tpu.stream.message import Message, is_barrier, is_chunk
 
 ROW_ID_FIELD = Field("_row_id", DataType.SERIAL)
+
+_SHARD_BITS = 10
+_SEQ_BITS = 12
 
 
 class RowIdGenExecutor(Executor):
@@ -31,10 +43,15 @@ class RowIdGenExecutor(Executor):
         info = ExecutorInfo(schema, [len(input_.schema)], "RowIdGenExecutor")
         super().__init__(info)
         self.input = input_
-        # high 16 bits identify the generating shard: ids never collide
-        # across parallel source actors (row_id_gen.rs vnode split analog)
-        self._base = vnode_base << 48
-        self._seq = 0
+        assert 0 <= vnode_base < (1 << _SHARD_BITS)
+        self._shard = vnode_base << _SEQ_BITS
+        self._next = 0
+
+    def _rebase(self, epoch_value: int) -> None:
+        floor = ((epoch_value >> 16) << (_SHARD_BITS + _SEQ_BITS)) \
+            | self._shard
+        if self._next < floor:
+            self._next = floor
 
     async def execute(self) -> AsyncIterator[Message]:
         async for msg in self.input.execute():
@@ -42,12 +59,13 @@ class RowIdGenExecutor(Executor):
                 cap = msg.capacity
                 # every slot (visible or padding) gets an id: vectorized,
                 # ids of padding slots are simply never observed
-                ids = self._base + self._seq + np.arange(
-                    cap, dtype=np.int64)
-                self._seq += cap
+                ids = self._next + np.arange(cap, dtype=np.int64)
+                self._next += cap
                 col = Column(DataType.SERIAL, ids)
                 yield StreamChunk(self.schema,
                                   list(msg.columns) + [col],
                                   msg.visibility, msg.ops)
             else:
+                if is_barrier(msg):
+                    self._rebase(msg.epoch.curr.value)
                 yield msg

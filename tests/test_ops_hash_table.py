@@ -1,0 +1,132 @@
+"""Device hash table kernel vs a host-dict oracle.
+
+Mirrors the testing stance of the reference's hash-map-backed operators:
+random batches incl. heavy duplicate keys, asserted slot-consistency
+against a Python dict (SURVEY.md §4 — executor tests vs host oracles).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from risingwave_tpu.ops.hash_table import (
+    DeviceHashTable, MIN_CAPACITY, lookup, make_state, probe_insert,
+)
+
+
+def _oracle_slots(all_batches):
+    """key tuple → first-seen order id (identity of the group)."""
+    ids = {}
+    for batch, valid in all_batches:
+        for row, v in zip(batch, valid):
+            if v and tuple(row) not in ids:
+                ids[tuple(row)] = len(ids)
+    return ids
+
+
+def _assert_consistent(state, batches_and_slots):
+    """Same key ⇒ same slot; different keys ⇒ different slots."""
+    seen = {}
+    for batch, valid, slots in batches_and_slots:
+        slots = np.asarray(slots)
+        for row, v, s in zip(batch, valid, slots):
+            if not v:
+                assert s == -1
+                continue
+            k = tuple(row)
+            assert s >= 0, f"valid row {k} got slot -1"
+            if k in seen:
+                assert seen[k] == s, f"key {k}: slots {seen[k]} != {s}"
+            else:
+                assert s not in seen.values(), f"slot {s} reused across keys"
+                seen[k] = s
+        # table keys at those slots hold the batch keys
+        tkeys = np.asarray(state.keys)
+        for row, v, s in zip(batch, valid, slots):
+            if v:
+                assert tuple(tkeys[s]) == tuple(row)
+
+
+def test_probe_insert_basic():
+    state = make_state(64, 2)
+    batch = jnp.asarray([[1, 10], [2, 20], [1, 10], [3, 30]], dtype=jnp.int64)
+    valid = jnp.asarray([True, True, True, True])
+    state, slots, ins = probe_insert(state, batch, valid)
+    slots = np.asarray(slots)
+    assert int(ins) == 3                      # one duplicate in the batch
+    assert slots[0] == slots[2]               # duplicate keys share a slot
+    assert len({slots[0], slots[1], slots[3]}) == 3
+    # re-probing finds, not re-inserts
+    state2, slots2, ins2 = probe_insert(state, batch, valid)
+    assert int(ins2) == 0
+    assert np.array_equal(np.asarray(slots2), slots)
+
+
+def test_invalid_rows_untouched():
+    state = make_state(64, 1)
+    batch = jnp.asarray([[7], [8]], dtype=jnp.int64)
+    valid = jnp.asarray([True, False])
+    state, slots, ins = probe_insert(state, batch, valid)
+    assert int(ins) == 1
+    assert np.asarray(slots)[1] == -1
+    assert int(np.sum(np.asarray(state.occ))) == 1
+
+
+def test_lookup_absent_and_present():
+    state = make_state(64, 1)
+    ins_batch = jnp.asarray([[5], [6]], dtype=jnp.int64)
+    state, slots, _ = probe_insert(state, ins_batch,
+                                   jnp.ones(2, dtype=bool))
+    q = jnp.asarray([[6], [42], [5]], dtype=jnp.int64)
+    got = np.asarray(lookup(state, q, jnp.ones(3, dtype=bool)))
+    assert got[0] == np.asarray(slots)[1]
+    assert got[1] == -1
+    assert got[2] == np.asarray(slots)[0]
+
+
+def test_collision_heavy_random_oracle():
+    """Tiny capacity + skewed keys: every batch collides hard."""
+    rng = np.random.default_rng(7)
+    state = make_state(128, 2)
+    batches = []
+    for _ in range(6):
+        n = 32
+        batch = np.stack([rng.integers(0, 10, n),      # heavy duplicates
+                          rng.integers(0, 5, n)], axis=1).astype(np.int64)
+        valid = rng.random(n) > 0.2
+        state, slots, _ = probe_insert(
+            state, jnp.asarray(batch), jnp.asarray(valid))
+        batches.append((batch, valid, slots))
+    _assert_consistent(state, batches)
+    n_keys = len(_oracle_slots([(b, v) for b, v, _ in batches]))
+    assert int(np.sum(np.asarray(state.occ))) == n_keys
+
+
+def test_wrapper_growth_preserves_slots_mapping():
+    t = DeviceHashTable(key_width=1, capacity=MIN_CAPACITY)
+    moves = []
+    t.on_grow(lambda old_to_new, old_cap: moves.append(
+        (np.asarray(old_to_new), old_cap)))
+    n = MIN_CAPACITY  # force at least one growth past MAX_LOAD
+    keys = np.arange(n, dtype=np.int64).reshape(-1, 1)
+    slots_before = {}
+    for start in range(0, n, 256):
+        b = jnp.asarray(keys[start:start + 256])
+        s = np.asarray(t.probe_insert(b, jnp.ones(256, dtype=bool)))
+        for k, sl in zip(range(start, start + 256), s):
+            slots_before[k] = sl
+    assert t.capacity > MIN_CAPACITY
+    assert moves, "growth hooks must fire"
+    assert t.sync_count() == n
+    # every key still findable, exactly once
+    got = np.asarray(t.lookup(jnp.asarray(keys), jnp.ones(n, dtype=bool)))
+    assert (got >= 0).all()
+    assert len(set(got.tolist())) == n
+
+
+def test_full_table_contract():
+    """reserve() grows before a batch could overflow MAX_LOAD."""
+    t = DeviceHashTable(key_width=1)
+    cap0 = t.capacity
+    t.reserve(int(cap0 * 0.9))
+    assert t.capacity >= cap0 * 2
