@@ -5,28 +5,37 @@ Reference parity: src/stream/src/executor/hash_agg.rs:67 (executor state),
 src/stream/src/executor/aggregation/agg_group.rs. Re-designed TPU-first:
 the reference updates one `AggGroup` at a time through a hashbrown map —
 here the entire chunk is one XLA step: batch probe-insert into the HBM
-table, then scatter-add / scatter-max the per-row contributions into
-accumulator arrays. Python cost per chunk is O(1).
+table, then scatter the per-row contributions into accumulator arrays.
+Python cost per chunk is O(1).
 
-State layout (all device arrays, slot-indexed, functional updates):
+Everything on device is **int32/float32** (see ops/lanes.py — emulated
+64-bit scatter on TPU is ~1000x slower than native int32):
 
-    keys        int64[cap, K]   group-key lanes        (hash_table)
+    keys        int32[cap, K]   group-key lanes        (hash_table)
     occ         bool[cap]                              (hash_table)
-    group_rows  int64[cap]      net row count (Σ signs) — group liveness
-    accs        flat per-call   COUNT: cnt  |  SUM: acc, nn  |  MIN/MAX:
-                                ext, nn   (nn = non-null input count)
+    group_rows  int32[cap]      net row count (Σ signs) — group liveness
+    accs        per call:       COUNT → [cnt i32]
+                                SUM(int) → [4 limb i32] + nn   (exact)
+                                SUM(float) → [hi f32, lo f32] + nn
+                                  (paired-f32: per-value residual kept in
+                                   lo, but cross-chunk accumulation is
+                                   f32 — large/cancellation-heavy float
+                                   sums lose precision vs the reference's
+                                   f64 accumulator. DECIMAL/int money
+                                   sums use the exact limb path; an exact
+                                   float superaccumulator is backlogged.)
+                                MIN/MAX → [hi i32, lo i32] + nn
     dirty       bool[cap]       touched since last barrier flush
-    emitted_*   snapshot of (group_rows, *accs) as of the last flush — the
-                exact physical row persisted in the value StateTable, so
-                the barrier flush derives Insert/Update/Delete and the old
-                row for the state-table write with zero host-side maps.
+    emitted_*   device snapshot of (group_rows, accs) at last flush — the
+                flush derives Insert/Update/Delete and the old state row
+                with zero host-side group maps.
 
 Retraction rules (Op sign semantics, stream_chunk.rs):
-  COUNT/SUM are sign-linear — scatter-add of ``sign * x``.
+  COUNT/SUM are sign-linear — limb scatter-adds of ``sign * x``.
   MIN/MAX are not invertible: supported on device for *append-only* input
-  (scatter-max/min); with retractions the executor layers the reference's
-  materialized-input strategy (aggregation/minput.rs) on top — deletes
-  force a recompute of affected groups at flush.
+  (two-pass lexicographic scatter-max on order lanes); with retractions
+  the executor layers the reference's materialized-input strategy
+  (aggregation/minput.rs) on top.
 """
 
 from __future__ import annotations
@@ -41,6 +50,10 @@ import numpy as np
 
 from risingwave_tpu.common.chunk import next_pow2
 from risingwave_tpu.ops import hash_table as ht
+from risingwave_tpu.ops import lanes
+
+I32_MIN = -(1 << 31)
+I32_MAX = (1 << 31) - 1
 
 
 class AggKind(enum.Enum):
@@ -69,21 +82,81 @@ class AggSpec:
         return np.dtype(self.in_dtype)    # MIN/MAX
 
     @property
-    def n_accs(self) -> int:
-        return 1 if self.kind == AggKind.COUNT else 2
+    def is_float_sum(self) -> bool:
+        return (self.kind == AggKind.SUM and self.in_dtype is not None
+                and np.issubdtype(self.in_dtype, np.floating))
 
+    # device-array layout of this call's accumulators: [(dtype, fill)]
+    def dev_layout(self) -> List[Tuple[np.dtype, object]]:
+        i32 = np.dtype(np.int32)
+        f32 = np.dtype(np.float32)
+        if self.kind == AggKind.COUNT:
+            return [(i32, 0)]
+        if self.kind == AggKind.SUM:
+            if self.is_float_sum:
+                return [(f32, 0.0), (f32, 0.0), (i32, 0)]
+            return [(i32, 0)] * lanes.N_LIMBS + [(i32, 0)]
+        fill = I32_MIN if self.kind == AggKind.MAX else I32_MAX
+        return [(i32, fill), (i32, fill), (i32, 0)]
 
-def _extreme(dtype: np.dtype, kind: AggKind):
-    """Identity element for scatter-max/min in `dtype`."""
-    if np.issubdtype(dtype, np.floating):
-        return -np.inf if kind == AggKind.MAX else np.inf
-    info = np.iinfo(dtype)
-    return info.min if kind == AggKind.MAX else info.max
+    # -- host codecs -----------------------------------------------------
+    def encode_input(self, vals: np.ndarray) -> Tuple[np.ndarray, ...]:
+        """Host value column → device input lanes (numpy, vectorized)."""
+        if self.kind == AggKind.COUNT:
+            return ()
+        if self.kind == AggKind.SUM:
+            if self.is_float_sum:
+                hi = vals.astype(np.float32)
+                lo = (vals.astype(np.float64)
+                      - hi.astype(np.float64)).astype(np.float32)
+                return (hi, lo)
+            return lanes.sum_limbs(vals)
+        return lanes.order_lanes(vals)
+
+    def decode_acc(self, cols: Sequence[np.ndarray]
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+        """Gathered device acc columns → (value hostarray, is_null)."""
+        if self.kind == AggKind.COUNT:
+            cnt = cols[0].astype(np.int64)
+            return cnt, np.zeros(cnt.shape, dtype=bool)
+        nn = cols[-1]
+        null = nn == 0
+        if self.kind == AggKind.SUM:
+            if self.is_float_sum:
+                v = cols[0].astype(np.float64) + cols[1].astype(np.float64)
+            else:
+                v = lanes.merge_limbs(*cols[:-1])
+            return v, null
+        v = lanes.inv_order_lanes(cols[0], cols[1], self.out_dtype)
+        return v, null
+
+    def encode_acc(self, value: np.ndarray, nn: Optional[np.ndarray]
+                   ) -> Tuple[np.ndarray, ...]:
+        """(decoded value, nn) → device acc columns (recovery path).
+
+        NULL slots (nn == 0) re-encode as the identity fill."""
+        if self.kind == AggKind.COUNT:
+            return (value.astype(np.int32),)
+        assert nn is not None
+        nn32 = nn.astype(np.int32)
+        if self.kind == AggKind.SUM:
+            if self.is_float_sum:
+                hi = value.astype(np.float32)
+                lo = (value.astype(np.float64)
+                      - hi.astype(np.float64)).astype(np.float32)
+                return (hi, lo, nn32)
+            return lanes.sum_limbs(value.astype(np.int64)) + (nn32,)
+        hi, lo = lanes.order_lanes(
+            np.asarray(value, dtype=self.out_dtype))
+        fill = I32_MIN if self.kind == AggKind.MAX else I32_MAX
+        dead = nn32 == 0
+        hi = np.where(dead, np.int32(fill), hi).astype(np.int32)
+        lo = np.where(dead, np.int32(fill), lo).astype(np.int32)
+        return (hi, lo, nn32)
 
 
 def acc_dtypes(specs: Sequence[AggSpec]) -> List[np.dtype]:
-    """Flat accumulator dtypes (the physical value-state row layout
-    after group keys and group_rows)."""
+    """HOST (state-row) accumulator columns: per call value [+ nn]."""
     out: List[np.dtype] = []
     for s in specs:
         if s.kind == AggKind.COUNT:
@@ -93,34 +166,32 @@ def acc_dtypes(specs: Sequence[AggSpec]) -> List[np.dtype]:
     return out
 
 
-def acc_fills(specs: Sequence[AggSpec]) -> List:
-    fills: List = []
+def dev_layout(specs: Sequence[AggSpec]) -> List[Tuple[np.dtype, object]]:
+    out: List[Tuple[np.dtype, object]] = []
     for s in specs:
-        if s.kind == AggKind.COUNT:
-            fills.append(0)
-        elif s.kind == AggKind.SUM:
-            fills.extend([0, 0])
-        else:
-            fills.extend([_extreme(s.in_dtype, s.kind), 0])
-    return fills
+        out.extend(s.dev_layout())
+    return out
 
 
-def split_outputs(specs: Sequence[AggSpec], accs: Sequence
-                  ) -> Tuple[List, List]:
-    """Flat acc columns → per-call (out_value, is_null) — works on both
-    device arrays (jit-traced) and host numpy slices."""
-    xp = jnp if isinstance(accs[0], (jax.Array, jax.core.Tracer)) else np
+def _call_slices(specs: Sequence[AggSpec]) -> List[slice]:
+    """Flat device-acc array index range per call."""
+    out, j = [], 0
+    for s in specs:
+        n = len(s.dev_layout())
+        out.append(slice(j, j + n))
+        j += n
+    return out
+
+
+def decode_outputs(specs: Sequence[AggSpec],
+                   dev_cols: Sequence[np.ndarray]
+                   ) -> Tuple[List[np.ndarray], List[np.ndarray]]:
+    """Gathered device acc columns → per-call (value, is_null) host cols."""
     outs, nulls = [], []
-    j = 0
-    for s in specs:
-        if s.kind == AggKind.COUNT:
-            outs.append(accs[j])
-            nulls.append(xp.zeros(accs[j].shape[0], dtype=bool))
-            j += 1
-        else:
-            outs.append(accs[j])
-            nulls.append(accs[j + 1] == 0)
-            j += 2
+    for s, sl in zip(specs, _call_slices(specs)):
+        v, nu = s.decode_acc(dev_cols[sl])
+        outs.append(v)
+        nulls.append(nu)
     return outs, nulls
 
 
@@ -128,77 +199,104 @@ class AggState(NamedTuple):
     """Functional device state for one grouped-agg operator."""
 
     table: ht.TableState
-    group_rows: jnp.ndarray            # int64[cap]
+    group_rows: jnp.ndarray            # int32[cap]
     dirty: jnp.ndarray                 # bool[cap]
-    accs: Tuple[jnp.ndarray, ...]      # flat accumulators (acc_dtypes)
-    emitted_valid: jnp.ndarray         # bool[cap] — group was live at flush
-    emitted_rows: jnp.ndarray          # int64[cap] — snapshot group_rows
-    emitted_accs: Tuple[jnp.ndarray, ...]   # snapshot accs
+    accs: Tuple[jnp.ndarray, ...]      # flat device accumulators
+    emitted_valid: jnp.ndarray         # bool[cap] — live at last flush
+    emitted_rows: jnp.ndarray          # int32[cap]
+    emitted_accs: Tuple[jnp.ndarray, ...]
 
 
 def make_agg_state(capacity: int, key_width: int,
                    specs: Sequence[AggSpec]) -> AggState:
-    dts, fills = acc_dtypes(specs), acc_fills(specs)
-    accs = tuple(jnp.full(capacity, f, dtype=dt)
-                 for dt, f in zip(dts, fills))
+    lay = dev_layout(specs)
+    accs = tuple(jnp.full(capacity, f, dtype=dt) for dt, f in lay)
     return AggState(
         table=ht.make_state(capacity, key_width),
-        group_rows=jnp.zeros(capacity, dtype=jnp.int64),
+        group_rows=jnp.zeros(capacity, dtype=jnp.int32),
         dirty=jnp.zeros(capacity, dtype=bool),
         accs=accs,
         emitted_valid=jnp.zeros(capacity, dtype=bool),
-        emitted_rows=jnp.zeros(capacity, dtype=jnp.int64),
+        emitted_rows=jnp.zeros(capacity, dtype=jnp.int32),
         emitted_accs=tuple(jnp.full(capacity, f, dtype=dt)
-                           for dt, f in zip(dts, fills)),
+                           for dt, f in lay),
     )
+
+
+def _update_call(spec: AggSpec, accs: List[jnp.ndarray], sl: slice,
+                 in_lanes, valid_ok, slots, vis, sign, cap) -> None:
+    """Trace one call's accumulator updates in place (list mutation)."""
+    live = vis & valid_ok
+    scat = jnp.where(live, slots, cap)
+    if spec.kind == AggKind.COUNT:
+        accs[sl.start] = accs[sl.start].at[scat].add(sign, mode="drop")
+        return
+    nn_i = sl.stop - 1
+    accs[nn_i] = accs[nn_i].at[scat].add(sign, mode="drop")
+    if spec.kind == AggKind.SUM:
+        if spec.is_float_sum:
+            sf = sign.astype(jnp.float32)
+            for k in range(2):
+                accs[sl.start + k] = accs[sl.start + k].at[scat].add(
+                    in_lanes[k] * sf, mode="drop")
+        else:
+            for k in range(lanes.N_LIMBS):
+                accs[sl.start + k] = accs[sl.start + k].at[scat].add(
+                    in_lanes[k] * sign, mode="drop")
+            # carry-normalize so limbs never overflow across chunks
+            for k in range(lanes.N_LIMBS - 1):
+                carry = accs[sl.start + k] >> lanes.LIMB_BITS
+                accs[sl.start + k] = accs[sl.start + k] - \
+                    (carry << lanes.LIMB_BITS)
+                accs[sl.start + k + 1] = accs[sl.start + k + 1] + carry
+        return
+    # MIN/MAX (append-only device path: sign > 0 rows only): lexicographic
+    # (hi, lo) two-pass — pass 1 settles hi; pass 2 rebases lo wherever hi
+    # moved (a stale lo from a smaller hi must not win) and maxes in the
+    # lo of rows whose hi ties the new hi.
+    is_max = spec.kind == AggKind.MAX
+    ident = jnp.int32(I32_MIN if is_max else I32_MAX)
+    ins = live & (sign > 0)
+    iscat = jnp.where(ins, slots, cap)
+    hi_i, lo_i = sl.start, sl.start + 1
+    v_hi, v_lo = in_lanes
+    old_hi = accs[hi_i]
+    if is_max:
+        new_hi = old_hi.at[iscat].max(v_hi, mode="drop")
+    else:
+        new_hi = old_hi.at[iscat].min(v_hi, mode="drop")
+    lo_base = jnp.where(old_hi == new_hi, accs[lo_i], ident)
+    lo_contrib = jnp.where(v_hi == new_hi[jnp.where(ins, slots, 0)],
+                           v_lo, ident)
+    lscat = jnp.where(ins, slots, cap)
+    if is_max:
+        new_lo = lo_base.at[lscat].max(lo_contrib, mode="drop")
+    else:
+        new_lo = lo_base.at[lscat].min(lo_contrib, mode="drop")
+    accs[hi_i], accs[lo_i] = new_hi, new_lo
 
 
 def build_apply(specs: Sequence[AggSpec]):
     """Compile the per-chunk step for a fixed agg plan.
 
-    step(state, key_lanes[N,K], signs[N] int32, vis[N] bool,
-         inputs: tuple per non-count(*) call of (values[N], valid[N]))
+    step(state, key_lanes[N,K] i32, signs[N] i32, vis[N] bool,
+         inputs: tuple per call of (lanes tuple, valid[N] bool))
     → (state, n_inserted). jit-cached per (cap, N).
     """
     specs = tuple(specs)
+    slices = _call_slices(specs)
 
     def step(state: AggState, key_lanes, signs, vis, inputs):
         cap = state.table.capacity
         table, slots, ins = ht.probe_insert(state.table, key_lanes, vis)
         scat = jnp.where(vis, slots, cap)   # invisible rows dropped
-        s64 = signs.astype(jnp.int64)
-        group_rows = state.group_rows.at[scat].add(s64, mode="drop")
+        s32 = signs.astype(jnp.int32)
+        group_rows = state.group_rows.at[scat].add(s32, mode="drop")
         dirty = state.dirty.at[scat].set(True, mode="drop")
         accs = list(state.accs)
-        j = 0       # flat acc cursor
-        k = 0       # inputs cursor
-        for spec in specs:
-            if spec.kind == AggKind.COUNT and spec.in_dtype is None:
-                accs[j] = accs[j].at[scat].add(s64, mode="drop")
-                j += 1
-                continue
-            vals, val_ok = inputs[k]
-            k += 1
-            live = vis & val_ok
-            live_scat = jnp.where(live, slots, cap)
-            if spec.kind == AggKind.COUNT:
-                accs[j] = accs[j].at[live_scat].add(s64, mode="drop")
-                j += 1
-            elif spec.kind == AggKind.SUM:
-                contrib = vals.astype(accs[j].dtype) * \
-                    s64.astype(accs[j].dtype)
-                accs[j] = accs[j].at[live_scat].add(contrib, mode="drop")
-                accs[j + 1] = accs[j + 1].at[live_scat].add(s64, mode="drop")
-                j += 2
-            else:   # MIN/MAX — device path covers inserts (sign > 0)
-                ins_scat = jnp.where(live & (s64 > 0), slots, cap)
-                v = vals.astype(accs[j].dtype)
-                if spec.kind == AggKind.MAX:
-                    accs[j] = accs[j].at[ins_scat].max(v, mode="drop")
-                else:
-                    accs[j] = accs[j].at[ins_scat].min(v, mode="drop")
-                accs[j + 1] = accs[j + 1].at[live_scat].add(s64, mode="drop")
-                j += 2
+        for spec, sl, (in_lanes, val_ok) in zip(specs, slices, inputs):
+            _update_call(spec, accs, sl, in_lanes, val_ok, slots, vis,
+                         s32, cap)
         return AggState(table, group_rows, dirty, tuple(accs),
                         state.emitted_valid, state.emitted_rows,
                         state.emitted_accs), ins
@@ -249,8 +347,7 @@ def build_patch(specs: Sequence[AggSpec]):
 
     @jax.jit
     def patch(state: AggState, idx, new_accs):
-        cap = state.table.capacity
-        accs = tuple(a.at[jnp.minimum(idx, cap)].set(v, mode="drop")
+        accs = tuple(a.at[idx].set(v, mode="drop")
                      for a, v in zip(state.accs, new_accs))
         return state._replace(accs=accs)
 
@@ -275,26 +372,41 @@ _remap_jit = jax.jit(remap_slots, static_argnums=(2, 3))
 
 @dataclass
 class FlushResult:
-    """Host view of the dirty groups at a barrier (pre-advance)."""
+    """Host view of the dirty groups at a barrier (decoded values)."""
 
     n: int
-    keys: np.ndarray                 # int64[n, K]
+    keys: np.ndarray                 # int32[n, K] raw key lanes
     group_rows: np.ndarray           # int64[n] — current
-    accs: List[np.ndarray]           # flat acc columns, current
+    outs: List[np.ndarray]           # per call decoded output value
+    nulls: List[np.ndarray]          # per call output-is-NULL
+    nns: List[Optional[np.ndarray]]  # per call non-null count (None: cnt*)
     was_emitted: np.ndarray          # bool[n]
-    prev_rows: np.ndarray            # int64[n] — at last flush
-    prev_accs: List[np.ndarray]      # flat acc columns at last flush
+    prev_rows: np.ndarray
+    prev_outs: List[np.ndarray]
+    prev_nulls: List[np.ndarray]
+    prev_nns: List[Optional[np.ndarray]]
 
     @staticmethod
     def empty(specs: Sequence[AggSpec], key_width: int) -> "FlushResult":
-        dts = acc_dtypes(specs)
+        z = np.zeros(0, dtype=np.int64)
+        zb = np.zeros(0, dtype=bool)
+        vals = [np.zeros(0, dtype=s.out_dtype) for s in specs]
+        nns = [None if s.kind == AggKind.COUNT else z.copy()
+               for s in specs]
         return FlushResult(
-            0, np.zeros((0, key_width), dtype=np.int64),
-            np.zeros(0, dtype=np.int64),
-            [np.zeros(0, dtype=dt) for dt in dts],
-            np.zeros(0, dtype=bool),
-            np.zeros(0, dtype=np.int64),
-            [np.zeros(0, dtype=dt) for dt in dts])
+            0, np.zeros((0, key_width), dtype=np.int32), z.copy(),
+            list(vals), [zb.copy() for _ in specs], list(nns),
+            zb.copy(), z.copy(),
+            [v.copy() for v in vals], [zb.copy() for _ in specs],
+            [None if n is None else n.copy() for n in nns])
+
+
+def _nns_of(specs, dev_cols) -> List[Optional[np.ndarray]]:
+    out = []
+    for s, sl in zip(specs, _call_slices(specs)):
+        out.append(None if s.kind == AggKind.COUNT
+                   else dev_cols[sl][-1].astype(np.int64))
+    return out
 
 
 class GroupedAggKernel:
@@ -316,7 +428,6 @@ class GroupedAggKernel:
         self._count_exact = 0
         self._pending_rows = 0
         self._pending_counters: List[jnp.ndarray] = []
-        # idx of the in-progress flush (set by flush, used by patch/advance)
         self._flush_idx: Optional[np.ndarray] = None
 
     @property
@@ -327,6 +438,8 @@ class GroupedAggKernel:
     def apply(self, key_lanes: jnp.ndarray, signs: jnp.ndarray,
               vis: jnp.ndarray, inputs: Tuple) -> None:
         n = int(key_lanes.shape[0])
+        assert n <= lanes.MAX_CHUNK_ROWS, \
+            f"chunk capacity {n} > {lanes.MAX_CHUNK_ROWS} breaks limb math"
         self._reserve(n)
         self.state, ins = self._apply(self.state, key_lanes, signs, vis,
                                       inputs)
@@ -351,18 +464,18 @@ class GroupedAggKernel:
     def _grow(self) -> None:
         """Rehash into a doubled table, reclaiming dead groups.
 
-        A slot is live iff its group has rows OR a flush hasn't retired it
-        yet (dirty / still-emitted) — tumbling-window churn leaves fully
-        retracted groups behind, and carrying them forever would grow the
-        table without bound."""
+        A slot is live iff its group has rows OR a flush hasn't retired
+        it yet (dirty / still-emitted) — tumbling-window churn leaves
+        fully retracted groups behind, and carrying them forever would
+        grow the table without bound."""
         old = self.state
         new_cap = old.table.capacity * 2
         new_table = ht.make_state(new_cap, self.key_width)
         live = old.table.occ & ((old.group_rows != 0) | old.dirty
                                 | old.emitted_valid)
-        new_table, old_to_new, n_live = ht.probe_insert(
+        new_table, old_to_new, n_live = ht._probe_insert_jit(
             new_table, old.table.keys, live)
-        fills = acc_fills(self.specs)
+        fills = [f for _dt, f in dev_layout(self.specs)]
         self.state = AggState(
             table=new_table,
             group_rows=_remap_jit(old.group_rows, old_to_new, new_cap, 0),
@@ -382,8 +495,8 @@ class GroupedAggKernel:
 
     # -- barrier flush ---------------------------------------------------
     def flush(self) -> FlushResult:
-        """Gather dirty groups to host. Call ``advance`` after consuming
-        (optionally ``patch``-ing corrected accs in between)."""
+        """Gather dirty groups to host and decode. Call ``advance`` after
+        consuming (optionally ``patch_accs`` in between)."""
         self._sync_count()
         dirty = np.asarray(self.state.dirty)
         idx = np.flatnonzero(dirty).astype(np.int32)
@@ -396,21 +509,34 @@ class GroupedAggKernel:
         idx_padded[:p] = idx
         bundle = self._gather(self.state, jnp.asarray(idx_padded))
         keys, rows, accs, was, prows, paccs = jax.device_get(bundle)
+        accs = [a[:p] for a in accs]
+        paccs = [a[:p] for a in paccs]
+        outs, nulls = decode_outputs(self.specs, accs)
+        pouts, pnulls = decode_outputs(self.specs, paccs)
         return FlushResult(
-            n=p, keys=keys[:p], group_rows=rows[:p],
-            accs=[a[:p] for a in accs], was_emitted=was[:p],
-            prev_rows=prows[:p], prev_accs=[a[:p] for a in paccs])
+            n=p, keys=keys[:p],
+            group_rows=rows[:p].astype(np.int64),
+            outs=outs, nulls=nulls, nns=_nns_of(self.specs, accs),
+            was_emitted=was[:p],
+            prev_rows=prows[:p].astype(np.int64),
+            prev_outs=pouts, prev_nulls=pnulls,
+            prev_nns=_nns_of(self.specs, paccs))
 
-    def patch_accs(self, accs: List[np.ndarray]) -> None:
-        """Overwrite the flushed groups' accumulators (minput recompute)."""
+    def patch_accs(self, decoded: List[Tuple[np.ndarray, np.ndarray]]
+                   ) -> None:
+        """Overwrite flushed groups' accumulators with corrected decoded
+        (value, nn) pairs per call (minput recompute path)."""
         idx = self._flush_idx
         assert idx is not None and len(idx) > 0
+        dev_cols: List[np.ndarray] = []
+        for s, (v, nn) in zip(self.specs, decoded):
+            dev_cols.extend(s.encode_acc(v, nn))
         pad = next_pow2(len(idx))
         idx_padded = np.full(pad, self.capacity, dtype=np.int32)
         idx_padded[:len(idx)] = idx
         padded = tuple(
-            np.concatenate([a, np.zeros(pad - len(idx), dtype=a.dtype)])
-        for a in accs)
+            np.concatenate([c, np.zeros(pad - len(idx), dtype=c.dtype)])
+            for c in dev_cols)
         self.state = self._patch(self.state, jnp.asarray(idx_padded),
                                  padded)
 
@@ -434,8 +560,9 @@ class GroupedAggKernel:
                 acc_cols: Sequence[np.ndarray]) -> None:
         """Reload from committed value-state rows (boot/recovery).
 
-        Restored groups are marked emitted — their outputs were committed
-        downstream before the recovery epoch.
+        `acc_cols` uses the HOST layout (acc_dtypes: per call value
+        [+ nn]). Restored groups are marked emitted — their outputs were
+        committed downstream before the recovery epoch.
         """
         n = len(group_rows)
         cap = max(self.capacity, next_pow2(int(n / ht.MAX_LOAD) + 1))
@@ -445,12 +572,21 @@ class GroupedAggKernel:
         self._pending_counters = []
         if n == 0:
             return
-        table, slots, _ = ht.probe_insert(
+        dev_cols: List[np.ndarray] = []
+        j = 0
+        for s in self.specs:
+            if s.kind == AggKind.COUNT:
+                dev_cols.extend(s.encode_acc(acc_cols[j], None))
+                j += 1
+            else:
+                dev_cols.extend(s.encode_acc(acc_cols[j], acc_cols[j + 1]))
+                j += 2
+        table, slots, _ = ht._probe_insert_jit(
             self.state.table, jnp.asarray(keys), jnp.ones(n, dtype=bool))
         accs = tuple(a.at[slots].set(jnp.asarray(col))
-                     for a, col in zip(self.state.accs, acc_cols))
+                     for a, col in zip(self.state.accs, dev_cols))
         rows_dev = self.state.group_rows.at[slots].set(
-            jnp.asarray(group_rows))
+            jnp.asarray(group_rows, dtype=jnp.int32))
         self.state = AggState(
             table=table, group_rows=rows_dev, dirty=self.state.dirty,
             accs=accs,

@@ -27,8 +27,10 @@ Design (TPU-first):
   occupancy upper bound (exact count is only synced at barriers, mirroring
   the "no host round-trip inside the hot loop" rule).
 
-Keys are int64 lanes. Callers map their key columns to lanes:
-device-numeric columns cast losslessly; varchar keys hash on the host
+Keys are **int32 lanes** — the TPU has no native int64, and emulated
+64-bit scatters are ~1000x slower (see ops/lanes.py). Callers map key
+columns to lanes: 64-bit values split bijectively into (hi, lo) int32
+pairs (lanes.split_i64); narrower ints cast; varchar keys hash on the host
 (common/hash.py:hash_strings_host) and feed the hash lane — equality on the
 lane is then *hash* equality, which is the same contract the reference's
 ``HashKey`` serialization provides for its Key8..Key256 fast paths.
@@ -51,7 +53,7 @@ MIN_CAPACITY = 1 << 10
 class TableState(NamedTuple):
     """Functional hash-table state (all device arrays)."""
 
-    keys: jnp.ndarray    # int64[cap, K]
+    keys: jnp.ndarray    # int32[cap, K]
     occ: jnp.ndarray     # bool[cap]
 
     @property
@@ -66,13 +68,13 @@ class TableState(NamedTuple):
 def make_state(capacity: int, key_width: int) -> TableState:
     assert capacity & (capacity - 1) == 0, "capacity must be a power of two"
     return TableState(
-        keys=jnp.zeros((capacity, key_width), dtype=jnp.int64),
+        keys=jnp.zeros((capacity, key_width), dtype=jnp.int32),
         occ=jnp.zeros((capacity,), dtype=bool),
     )
 
 
 def hash_key_lanes(batch_keys: jnp.ndarray) -> jnp.ndarray:
-    """uint32[N] hash of int64[N, K] key lanes (shared with dispatch)."""
+    """uint32[N] hash of int32[N, K] key lanes (shared with dispatch)."""
     cols = [batch_keys[:, i] for i in range(batch_keys.shape[1])]
     return hash_columns(cols)
 
@@ -93,6 +95,8 @@ def probe_insert(state: TableState, batch_keys: jnp.ndarray,
     enforced by DeviceHashTable) — under that contract the loop terminates
     before ``cap`` steps.
     """
+    assert batch_keys.dtype == jnp.int32, \
+        "keys must be int32 lanes (lanes.split_i64 for 64-bit values)"
     cap = state.capacity
     mask = jnp.int32(cap - 1)
     n = batch_keys.shape[0]
@@ -132,6 +136,8 @@ def probe_insert(state: TableState, batch_keys: jnp.ndarray,
 def lookup(state: TableState, batch_keys: jnp.ndarray,
            valid: jnp.ndarray) -> jnp.ndarray:
     """Slots of existing keys; -1 for absent/invalid rows. Read-only."""
+    assert batch_keys.dtype == jnp.int32, \
+        "keys must be int32 lanes (lanes.split_i64 for 64-bit values)"
     cap = state.capacity
     mask = jnp.int32(cap - 1)
     slot0 = (hash_key_lanes(batch_keys).astype(jnp.int32)) & mask
@@ -218,7 +224,6 @@ class DeviceHashTable:
         occ = old.occ
         new, slots, ins = _probe_insert_jit(new, old.keys, occ)
         self.state = new
-        self._grow_slots = slots       # old slot i → new slot (for movers)
         for hook in getattr(self, "_on_grow", []):
             hook(slots, old.capacity)
 

@@ -5,7 +5,8 @@ Reference parity: src/stream/src/executor/hash_agg.rs:67 (executor),
 aggregation/agg_group.rs. The TPU re-design moves the per-row group map
 into HBM (ops/hash_agg.py); this executor is the thin host driver:
 
-  chunk    → build key lanes + agg inputs, one jitted device step
+  chunk    → build int32 key/input lanes (ops/lanes.py codecs), one
+             jitted device step
   barrier  → one device gather of dirty groups → emit change chunk,
              persist physical rows through the StateTable, commit epoch
 
@@ -14,9 +15,9 @@ subsequent changes emit an UpdateDelete/UpdateInsert pair, a group whose
 row count drops to zero emits Delete. Outputs are compared against the
 device-resident emitted snapshot, so repeated no-op touches emit nothing.
 
-Value-state row layout (physical): group keys | group_rows | flat accs
-(COUNT: cnt; SUM: acc, nn; MIN/MAX: ext, nn). Recovery reloads the table
-and re-uploads it into the kernel (``GroupedAggKernel.rebuild``).
+Value-state row layout (physical): group keys | group_rows | per call
+(value [+ non-null count]). Recovery reloads the table and re-encodes it
+into the kernel (``GroupedAggKernel.rebuild``).
 """
 
 from __future__ import annotations
@@ -31,8 +32,9 @@ from risingwave_tpu.common.chunk import (
     Column, Op, StreamChunk, next_pow2,
 )
 from risingwave_tpu.common.types import DataType, Field, Schema
+from risingwave_tpu.ops import lanes
 from risingwave_tpu.ops.hash_agg import (
-    AggKind, AggSpec, GroupedAggKernel, acc_dtypes, split_outputs,
+    AggKind, AggSpec, GroupedAggKernel, acc_dtypes,
 )
 from risingwave_tpu.state.state_table import StateTable
 from risingwave_tpu.stream.executor import Executor, ExecutorInfo
@@ -45,6 +47,9 @@ _SUM_OUT = {
     DataType.INT64: DataType.INT64, DataType.DECIMAL: DataType.DECIMAL,
     DataType.FLOAT32: DataType.FLOAT64, DataType.FLOAT64: DataType.FLOAT64,
 }
+
+# int32 lanes per group-key column: value hi, value lo, null flag
+_LANES_PER_KEY = 3
 
 
 @dataclass(frozen=True)
@@ -125,9 +130,9 @@ class HashAggExecutor(Executor):
             raise NotImplementedError(
                 "retractable min/max needs the materialized-input state "
                 "(minput) path — pass append_only=True or use sum/count")
-        # two lanes per group col: value + null indicator (NULL is a group)
         self.kernel = GroupedAggKernel(
-            key_width=2 * len(self.group_indices), specs=self.specs)
+            key_width=_LANES_PER_KEY * len(self.group_indices),
+            specs=self.specs)
         out_schema = agg_output_schema(in_schema, group_indices, agg_calls,
                                        output_names)
         super().__init__(ExecutorInfo(
@@ -136,11 +141,11 @@ class HashAggExecutor(Executor):
 
     # -- chunk path ------------------------------------------------------
     @staticmethod
-    def _to_lane(vals: np.ndarray) -> np.ndarray:
-        """Column values → int64 lane, value-preserving per *distinct key*.
+    def _to_i64(vals: np.ndarray) -> np.ndarray:
+        """Column values → int64, bijective per distinct key.
 
-        Floats are bit-cast (not value-cast: 1.2 and 1.7 are distinct
-        groups) with -0.0 normalized so it groups with 0.0."""
+        Floats are bit-cast (1.2 and 1.7 are distinct groups) with -0.0
+        normalized so it groups with 0.0."""
         if np.issubdtype(vals.dtype, np.floating):
             vals = np.where(vals == 0, np.zeros((), dtype=vals.dtype), vals)
             return vals.astype(np.float64).view(np.int64)
@@ -148,28 +153,39 @@ class HashAggExecutor(Executor):
 
     def _key_lanes(self, chunk: StreamChunk) -> jnp.ndarray:
         n = chunk.capacity
-        lanes = np.empty((n, 2 * len(self.group_indices)), dtype=np.int64)
+        out = np.empty((n, _LANES_PER_KEY * len(self.group_indices)),
+                       dtype=np.int32)
         for j, i in enumerate(self.group_indices):
             c = chunk.columns[i]
-            vals = self._to_lane(np.asarray(c.values))
+            v64 = self._to_i64(np.asarray(c.values))
             if c.validity is None:
-                lanes[:, 2 * j] = vals
-                lanes[:, 2 * j + 1] = 1
+                ok = None
             else:
                 ok = np.asarray(c.validity)
-                lanes[:, 2 * j] = np.where(ok, vals, 0)
-                lanes[:, 2 * j + 1] = ok.astype(np.int64)
-        return jnp.asarray(lanes)
+                v64 = np.where(ok, v64, 0)
+            hi, lo = lanes.split_i64(v64)
+            out[:, _LANES_PER_KEY * j] = hi
+            out[:, _LANES_PER_KEY * j + 1] = lo
+            out[:, _LANES_PER_KEY * j + 2] = \
+                1 if ok is None else ok.astype(np.int32)
+        return jnp.asarray(out)
 
     def _inputs(self, chunk: StreamChunk) -> Tuple:
+        """Per call: (device input lanes, valid mask)."""
+        ones = None
         out = []
-        for call in self.agg_calls:
-            if call.kind == AggKind.COUNT and call.input_idx is None:
+        for call, spec in zip(self.agg_calls, self.specs):
+            if call.input_idx is None:          # count(*)
+                if ones is None:
+                    ones = jnp.ones(chunk.capacity, dtype=bool)
+                out.append(((), ones))
                 continue
             c = chunk.columns[call.input_idx]
+            in_lanes = tuple(jnp.asarray(a) for a in
+                             spec.encode_input(np.asarray(c.values)))
             ok = jnp.ones(chunk.capacity, dtype=bool) \
                 if c.validity is None else jnp.asarray(c.validity)
-            out.append((jnp.asarray(c.values), ok))
+            out.append((in_lanes, ok))
         return tuple(out)
 
     def _apply_chunk(self, chunk: StreamChunk) -> None:
@@ -181,15 +197,17 @@ class HashAggExecutor(Executor):
     # -- barrier path ----------------------------------------------------
     def _group_key_host(self, keys: np.ndarray
                         ) -> List[Tuple[np.ndarray, np.ndarray]]:
-        """Lanes → per group col (values cast to col dtype, valid mask)."""
+        """Key lanes → per group col (values in col dtype, valid mask)."""
         cols = []
         for j, dt in enumerate(self.group_types):
-            lane = keys[:, 2 * j]
+            hi = keys[:, _LANES_PER_KEY * j]
+            lo = keys[:, _LANES_PER_KEY * j + 1]
+            ok = keys[:, _LANES_PER_KEY * j + 2] != 0
+            v64 = lanes.merge_i64(hi, lo)
             if np.issubdtype(np.dtype(dt.np_dtype), np.floating):
-                vals = lane.view(np.float64).astype(dt.np_dtype)
+                vals = v64.view(np.float64).astype(dt.np_dtype)
             else:
-                vals = lane.astype(dt.np_dtype)
-            ok = keys[:, 2 * j + 1] != 0
+                vals = v64.astype(dt.np_dtype)
             cols.append((vals, ok))
         return cols
 
@@ -198,8 +216,8 @@ class HashAggExecutor(Executor):
         if fr.n == 0:
             self.kernel.advance()
             return None
-        outs, nulls = split_outputs(self.specs, fr.accs)
-        pouts, pnulls = split_outputs(self.specs, fr.prev_accs)
+        outs, nulls = fr.outs, fr.nulls
+        pouts, pnulls = fr.prev_outs, fr.prev_nulls
         cur_live = fr.group_rows > 0
         was = fr.was_emitted
         changed = np.zeros(fr.n, dtype=bool)
@@ -209,14 +227,16 @@ class HashAggExecutor(Executor):
         upd_i = np.flatnonzero(cur_live & was & changed)
         del_i = np.flatnonzero(~cur_live & was)
         # persistence must also cover groups whose outputs are unchanged
-        # but whose internal state (group_rows / accs) moved — otherwise
+        # but whose internal state (row/non-null counts) moved — otherwise
         # recovery reloads a stale row count
         state_moved = fr.group_rows != fr.prev_rows
-        for a, pa in zip(fr.accs, fr.prev_accs):
-            state_moved |= a != pa
+        for nn, pnn in zip(fr.nns, fr.prev_nns):
+            if nn is not None:
+                state_moved |= nn != pnn
         persist_upd_i = np.flatnonzero(
             cur_live & was & (changed | state_moved))
-        self._persist(fr, ins_i, persist_upd_i, del_i)
+        gk = self._group_key_host(fr.keys)   # decode key lanes once
+        self._persist(fr, gk, ins_i, persist_upd_i, del_i)
         self.kernel.advance()
         t = len(ins_i) + 2 * len(upd_i) + len(del_i)
         if t == 0:
@@ -233,8 +253,7 @@ class HashAggExecutor(Executor):
             return out
 
         columns: List[Column] = []
-        for (vals, ok), dt in zip(self._group_key_host(fr.keys),
-                                  self.group_types):
+        for (vals, ok), dt in zip(gk, self.group_types):
             v = emit_col(vals, vals, dt.np_dtype)
             okc = emit_col(ok, ok, bool)
             columns.append(Column(dt, v, None if okc.all() else okc))
@@ -254,11 +273,13 @@ class HashAggExecutor(Executor):
         vis[:t] = True
         return StreamChunk(self.schema, columns, vis, ops)
 
-    def _state_rows(self, fr, idx: np.ndarray, prev: bool) -> List[tuple]:
+    def _state_rows(self, fr, gk, idx: np.ndarray,
+                    prev: bool) -> List[tuple]:
         """Physical value-state rows for the given flush indices."""
-        gk = self._group_key_host(fr.keys)
         rows_col = fr.prev_rows if prev else fr.group_rows
-        accs = fr.prev_accs if prev else fr.accs
+        outs = fr.prev_outs if prev else fr.outs
+        nulls = fr.prev_nulls if prev else fr.nulls
+        nns = fr.prev_nns if prev else fr.nns
         cols: List[list] = []
         for vals, ok in gk:
             sel = vals[idx]
@@ -266,18 +287,22 @@ class HashAggExecutor(Executor):
             cols.append([v if o else None
                          for v, o in zip(sel.tolist(), okl.tolist())])
         cols.append(rows_col[idx].tolist())
-        for a in accs:
-            cols.append(a[idx].tolist())
+        for o, nu, nn in zip(outs, nulls, nns):
+            ol = o[idx].tolist()
+            nul = nu[idx].tolist()
+            cols.append([None if bad else v for v, bad in zip(ol, nul)])
+            if nn is not None:
+                cols.append(nn[idx].tolist())
         return list(zip(*cols)) if cols else []
 
-    def _persist(self, fr, ins_i, upd_i, del_i) -> None:
-        for row in self._state_rows(fr, ins_i, prev=False):
+    def _persist(self, fr, gk, ins_i, upd_i, del_i) -> None:
+        for row in self._state_rows(fr, gk, ins_i, prev=False):
             self.table.insert(row)
-        olds = self._state_rows(fr, upd_i, prev=True)
-        news = self._state_rows(fr, upd_i, prev=False)
+        olds = self._state_rows(fr, gk, upd_i, prev=True)
+        news = self._state_rows(fr, gk, upd_i, prev=False)
         for old, new in zip(olds, news):
             self.table.update(old, new)
-        for row in self._state_rows(fr, del_i, prev=True):
+        for row in self._state_rows(fr, gk, del_i, prev=True):
             self.table.delete(row)
 
     # -- recovery --------------------------------------------------------
@@ -287,14 +312,17 @@ class HashAggExecutor(Executor):
         accs_l: List[tuple] = []
         ng = len(self.group_indices)
         for _pk, row in self.table.iter_rows():
-            lane = np.zeros(2 * ng, dtype=np.int64)
+            lane = np.zeros(_LANES_PER_KEY * ng, dtype=np.int32)
             for j in range(ng):
                 v = row[j]
                 if v is not None:
                     dt = self.group_types[j]
-                    lane[2 * j] = self._to_lane(
-                        np.asarray([v], dtype=dt.np_dtype))[0]
-                    lane[2 * j + 1] = 1
+                    v64 = self._to_i64(
+                        np.asarray([v], dtype=dt.np_dtype))
+                    hi, lo = lanes.split_i64(v64)
+                    lane[_LANES_PER_KEY * j] = hi[0]
+                    lane[_LANES_PER_KEY * j + 1] = lo[0]
+                    lane[_LANES_PER_KEY * j + 2] = 1
             keys_l.append(lane)
             rows_l.append(int(row[ng]))
             accs_l.append(row[ng + 1:])
@@ -302,8 +330,11 @@ class HashAggExecutor(Executor):
             return
         keys = np.stack(keys_l)
         dts = acc_dtypes(self.specs)
-        acc_cols = [np.asarray([a[j] for a in accs_l], dtype=dt)
-                    for j, dt in enumerate(dts)]
+        acc_cols = []
+        for j, dt in enumerate(dts):
+            col = np.asarray([0 if a[j] is None else a[j]
+                              for a in accs_l], dtype=dt)
+            acc_cols.append(col)
         self.kernel.rebuild(keys, np.asarray(rows_l, dtype=np.int64),
                             acc_cols)
 

@@ -7,12 +7,14 @@ packs (timestamp, vnode, sequence); ids are unique across parallel actors
 AND across restarts, because the timestamp component comes from the epoch
 and recovery always resumes at a strictly newer epoch.
 
-Layout: | rel_ms (epoch physical ms, ~41 bits) | shard (10) | seq (12) |.
-The sequence is rebased to the current barrier's epoch floor at every
-barrier: after a crash the new INITIAL barrier carries an epoch above the
-committed one, so re-generated ids can never collide with committed MV pks.
-Sequence overflow within one epoch-ms spills into the ms bits (standard
-snowflake carry) — still monotone and unique per shard.
+Layout: | shard (10, most significant) | rel_ms (epoch physical ms, ~41
+bits) | seq (12) |. Shard occupies the TOP bits so a sequence that
+overflows its 12 bits carries into rel_ms *within the same shard* — ids
+stay unique across shards at any per-epoch row count, and monotone per
+shard. The sequence is rebased to the current barrier's epoch floor at
+every barrier: after a crash the new INITIAL barrier carries an epoch
+above the committed one, so re-generated ids can never collide with
+committed MV pks.
 
 TPU notes: id assignment is a vectorized arange add — one whole-column op
 per chunk, no per-row Python.
@@ -44,12 +46,11 @@ class RowIdGenExecutor(Executor):
         super().__init__(info)
         self.input = input_
         assert 0 <= vnode_base < (1 << _SHARD_BITS)
-        self._shard = vnode_base << _SEQ_BITS
-        self._next = 0
+        self._shard = vnode_base << (63 - _SHARD_BITS)
+        self._next = self._shard
 
     def _rebase(self, epoch_value: int) -> None:
-        floor = ((epoch_value >> 16) << (_SHARD_BITS + _SEQ_BITS)) \
-            | self._shard
+        floor = self._shard | ((epoch_value >> 16) << _SEQ_BITS)
         if self._next < floor:
             self._next = floor
 

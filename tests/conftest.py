@@ -1,9 +1,15 @@
-"""Test harness: force an 8-device virtual CPU mesh before JAX import.
+"""Test harness: force an 8-device virtual CPU mesh before backend init.
 
 Mirrors the reference's testing stance (SURVEY.md section 4): executor tests
 run against in-memory fakes; multi-chip sharding is validated on virtual CPU
 devices (`--xla_force_host_platform_device_count=8`) — JAX-on-CPU stands in
 for the TPU mesh. Real-TPU benchmarking happens only in bench.py.
+
+The env var alone is NOT enough on axon machines: the axon sitecustomize
+(/root/.axon_site) calls jax.config.update("jax_platforms", "axon,cpu")
+at interpreter start, overriding JAX_PLATFORMS. We override it back via
+jax.config before any backend initializes — this also keeps the suite
+runnable when the TPU tunnel is down.
 """
 
 import os
@@ -14,12 +20,15 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def eight_devices():
-    import jax
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs

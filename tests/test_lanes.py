@@ -1,0 +1,63 @@
+"""32-bit lane codec properties (ops/lanes.py)."""
+
+import numpy as np
+
+from risingwave_tpu.ops import lanes
+
+
+def test_split_merge_i64_roundtrip():
+    rng = np.random.default_rng(0)
+    v = rng.integers(-(2**62), 2**62, 1000, dtype=np.int64)
+    v = np.concatenate([v, [0, 1, -1, 2**62, -(2**62), (1 << 63) - 1,
+                            -(1 << 63)]]).astype(np.int64)
+    hi, lo = lanes.split_i64(v)
+    assert hi.dtype == np.int32 and lo.dtype == np.int32
+    assert np.array_equal(lanes.merge_i64(hi, lo), v)
+
+
+def test_sum_limbs_exact():
+    rng = np.random.default_rng(1)
+    v = rng.integers(-(2**55), 2**55, 500, dtype=np.int64)
+    limbs = lanes.sum_limbs(v)
+    assert len(limbs) == lanes.N_LIMBS
+    assert np.array_equal(lanes.merge_limbs(*limbs), v)
+    # simulated accumulation: per-limb int32 adds + carry normalization
+    acc = [np.zeros(1, dtype=np.int32) for _ in range(lanes.N_LIMBS)]
+    for chunk in np.array_split(v, 10):
+        ls = lanes.sum_limbs(chunk)
+        for i in range(lanes.N_LIMBS):
+            acc[i] = (acc[i] + ls[i].sum(dtype=np.int64)).astype(np.int32)
+        for i in range(lanes.N_LIMBS - 1):
+            carry = acc[i] >> lanes.LIMB_BITS
+            acc[i] = acc[i] - (carry << lanes.LIMB_BITS)
+            acc[i + 1] = acc[i + 1] + carry
+    assert lanes.merge_limbs(*acc)[0] == v.sum()
+
+
+def test_order_lanes_int_lexicographic():
+    rng = np.random.default_rng(2)
+    v = np.concatenate([
+        rng.integers(-(2**62), 2**62, 500, dtype=np.int64),
+        np.asarray([0, 1, -1, 2**40, -(2**40), (1 << 63) - 1, -(1 << 63)],
+                   dtype=np.int64)])
+    hi, lo = lanes.order_lanes(v)
+    # lexicographic (hi, lo) order == value order
+    order_pairs = sorted(range(len(v)), key=lambda i: (hi[i], lo[i]))
+    order_vals = np.argsort(v, kind="stable")
+    assert np.array_equal(v[np.asarray(order_pairs)], v[order_vals])
+    assert np.array_equal(lanes.inv_order_lanes(hi, lo, np.dtype(np.int64)),
+                          v)
+
+
+def test_order_lanes_float():
+    v = np.asarray([-np.inf, -1e300, -1.5, -0.0, 0.0, 1e-300, 2.5, np.inf])
+    hi, lo = lanes.order_lanes(v)
+    keys = [(hi[i], lo[i]) for i in range(len(v))]
+    assert keys == sorted(keys)
+    back = lanes.inv_order_lanes(hi, lo, np.dtype(np.float64))
+    assert np.array_equal(back[back != 0], v[v != 0])  # -0.0 folded to 0.0
+    # float32 values survive the f64 round trip
+    v32 = np.asarray([-3.5, 1.25, 7.0], dtype=np.float32)
+    hi, lo = lanes.order_lanes(v32)
+    assert np.array_equal(
+        lanes.inv_order_lanes(hi, lo, np.dtype(np.float32)), v32)

@@ -49,7 +49,7 @@ def _assert_consistent(state, batches_and_slots):
 
 def test_probe_insert_basic():
     state = make_state(64, 2)
-    batch = jnp.asarray([[1, 10], [2, 20], [1, 10], [3, 30]], dtype=jnp.int64)
+    batch = jnp.asarray([[1, 10], [2, 20], [1, 10], [3, 30]], dtype=jnp.int32)
     valid = jnp.asarray([True, True, True, True])
     state, slots, ins = probe_insert(state, batch, valid)
     slots = np.asarray(slots)
@@ -64,7 +64,7 @@ def test_probe_insert_basic():
 
 def test_invalid_rows_untouched():
     state = make_state(64, 1)
-    batch = jnp.asarray([[7], [8]], dtype=jnp.int64)
+    batch = jnp.asarray([[7], [8]], dtype=jnp.int32)
     valid = jnp.asarray([True, False])
     state, slots, ins = probe_insert(state, batch, valid)
     assert int(ins) == 1
@@ -74,10 +74,10 @@ def test_invalid_rows_untouched():
 
 def test_lookup_absent_and_present():
     state = make_state(64, 1)
-    ins_batch = jnp.asarray([[5], [6]], dtype=jnp.int64)
+    ins_batch = jnp.asarray([[5], [6]], dtype=jnp.int32)
     state, slots, _ = probe_insert(state, ins_batch,
                                    jnp.ones(2, dtype=bool))
-    q = jnp.asarray([[6], [42], [5]], dtype=jnp.int64)
+    q = jnp.asarray([[6], [42], [5]], dtype=jnp.int32)
     got = np.asarray(lookup(state, q, jnp.ones(3, dtype=bool)))
     assert got[0] == np.asarray(slots)[1]
     assert got[1] == -1
@@ -92,7 +92,7 @@ def test_collision_heavy_random_oracle():
     for _ in range(6):
         n = 32
         batch = np.stack([rng.integers(0, 10, n),      # heavy duplicates
-                          rng.integers(0, 5, n)], axis=1).astype(np.int64)
+                          rng.integers(0, 5, n)], axis=1).astype(np.int32)
         valid = rng.random(n) > 0.2
         state, slots, _ = probe_insert(
             state, jnp.asarray(batch), jnp.asarray(valid))
@@ -108,7 +108,7 @@ def test_wrapper_growth_preserves_slots_mapping():
     t.on_grow(lambda old_to_new, old_cap: moves.append(
         (np.asarray(old_to_new), old_cap)))
     n = MIN_CAPACITY  # force at least one growth past MAX_LOAD
-    keys = np.arange(n, dtype=np.int64).reshape(-1, 1)
+    keys = np.arange(n, dtype=np.int32).reshape(-1, 1)
     slots_before = {}
     for start in range(0, n, 256):
         b = jnp.asarray(keys[start:start + 256])
