@@ -14,6 +14,8 @@ Everything on device is **int32/float32** (see ops/lanes.py — emulated
     keys        int32[cap, K]   group-key lanes        (hash_table)
     occ         bool[cap]                              (hash_table)
     group_rows  int32[cap]      net row count (Σ signs) — group liveness
+                                (int32 bound: 2^31 rows PER GROUP; the
+                                 flush guards against wraparound)
     accs        per call:       COUNT → [cnt i32]
                                 SUM(int) → [4 limb i32] + nn   (exact)
                                 SUM(float) → [hi f32, lo f32] + nn
@@ -118,8 +120,12 @@ class AggSpec:
         """Gathered device acc columns → (value hostarray, is_null)."""
         if self.kind == AggKind.COUNT:
             cnt = cols[0].astype(np.int64)
+            assert (cnt >= 0).all(), \
+                "COUNT wrapped int32 — a group exceeded 2^31 rows"
             return cnt, np.zeros(cnt.shape, dtype=bool)
         nn = cols[-1]
+        assert (nn >= 0).all(), \
+            "non-null count wrapped int32 — a group exceeded 2^31 rows"
         null = nn == 0
         if self.kind == AggKind.SUM:
             if self.is_float_sum:
@@ -171,6 +177,15 @@ def dev_layout(specs: Sequence[AggSpec]) -> List[Tuple[np.dtype, object]]:
     for s in specs:
         out.extend(s.dev_layout())
     return out
+
+
+def n_input_lanes(spec: AggSpec) -> int:
+    """Device input lanes per row for this call (encode_input arity)."""
+    if spec.kind == AggKind.COUNT:
+        return 0
+    if spec.kind == AggKind.SUM:
+        return 2 if spec.is_float_sum else lanes.N_LIMBS
+    return 2                                 # MIN/MAX order lanes
 
 
 def _call_slices(specs: Sequence[AggSpec]) -> List[slice]:
@@ -509,6 +524,8 @@ class GroupedAggKernel:
         idx_padded[:p] = idx
         bundle = self._gather(self.state, jnp.asarray(idx_padded))
         keys, rows, accs, was, prows, paccs = jax.device_get(bundle)
+        assert (rows[:p] >= 0).all(), \
+            "group_rows wrapped int32 — a group exceeded 2^31 rows"
         accs = [a[:p] for a in accs]
         paccs = [a[:p] for a in paccs]
         outs, nulls = decode_outputs(self.specs, accs)

@@ -1,0 +1,16 @@
+"""Multi-chip execution: vnode-sharded operators over a jax.sharding.Mesh.
+
+Reference parity: the data-parallel axis of SURVEY §2.12 — the reference
+routes rows by Crc32(dist key) → vnode → actor (dispatch.rs:582-690, one
+gRPC exchange per edge). TPU-native re-design: vnodes map to mesh shards,
+and the hash dispatch becomes an on-device bucketized ``all_to_all`` over
+ICI inside ``shard_map`` — no host hops on the data plane.
+
+    exchange     vnode bucketize + all_to_all (the DispatchExecutor core)
+    agg          vnode-sharded grouped aggregation (multi-chip HashAgg)
+"""
+
+from risingwave_tpu.parallel.exchange import bucketize_by_owner
+from risingwave_tpu.parallel.agg import ShardedAggKernel
+
+__all__ = ["bucketize_by_owner", "ShardedAggKernel"]

@@ -1,0 +1,189 @@
+"""Vnode-sharded grouped aggregation over a device mesh.
+
+Reference parity: N parallel HashAggExecutor actors fed by a HASH
+dispatcher (SURVEY §2.12 data parallelism; hash_agg.rs:67 +
+dispatch.rs:582). TPU re-design: ONE SPMD program under ``shard_map`` —
+each mesh shard owns a contiguous vnode range (VnodeMapping semantics)
+and a private slice of the hash-table/accumulator arrays; rows hop to
+their owner via the bucketized all_to_all (parallel/exchange.py) and are
+then aggregated with the exact same kernel math as the single-chip path
+(ops/hash_agg._update_call — one code path, two launch shapes).
+
+State is the single-chip ``AggState`` with a leading [n_dev] axis,
+sharded ``P('d')``. The barrier flush gathers per-shard dirty slots the
+same way the single-chip kernel does; shards never share groups because
+ownership is a function of the key hash.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from risingwave_tpu.common.hash import VNODE_COUNT
+from risingwave_tpu.ops import hash_table as ht
+from risingwave_tpu.ops import lanes
+from risingwave_tpu.ops.hash_agg import (
+    AggSpec, AggState, _call_slices, _update_call, decode_outputs,
+    make_agg_state, n_input_lanes,
+)
+from risingwave_tpu.parallel.exchange import (
+    bucketize_by_owner, exchange, vnodes_from_lanes,
+)
+
+AXIS = "d"
+
+
+def _stack_state(n_dev: int, capacity: int, key_width: int,
+                 specs: Sequence[AggSpec]) -> AggState:
+    """AggState with a leading device axis on every leaf."""
+    one = make_agg_state(capacity, key_width, specs)
+    return jax.tree.map(
+        lambda a: jnp.broadcast_to(a[None], (n_dev,) + a.shape), one)
+
+
+class ShardedAggKernel:
+    """Multi-chip grouped aggregation (fixed capacity v1 — growth and
+    elastic resharding land with the reschedule path).
+
+    apply(): one jitted SPMD step — vnode routing, all_to_all, local
+    probe+scatter per shard. snapshot(): host-side decode of all live
+    groups (test/flush support).
+    """
+
+    def __init__(self, mesh: Mesh, key_width: int,
+                 specs: Sequence[AggSpec], capacity: int = 1 << 12,
+                 bucket: Optional[int] = None):
+        self.mesh = mesh
+        self.n_dev = mesh.devices.size
+        self.specs = tuple(specs)
+        self.key_width = key_width
+        self.capacity = capacity
+        self.bucket = bucket
+        # vnode → owning shard: contiguous even split (VnodeMapping)
+        owners = np.repeat(np.arange(self.n_dev, dtype=np.int32),
+                           VNODE_COUNT // self.n_dev)
+        pad = VNODE_COUNT - len(owners)
+        if pad:
+            owners = np.concatenate(
+                [owners, np.full(pad, self.n_dev - 1, np.int32)])
+        self.owner_map = jnp.asarray(owners)
+        sharding = NamedSharding(mesh, P(AXIS))
+        self.state: AggState = jax.tree.map(
+            lambda a: jax.device_put(a, sharding),
+            _stack_state(self.n_dev, capacity, key_width, self.specs))
+        self._step_cache: Dict[Tuple[int, int], object] = {}
+
+    # -- the SPMD step ----------------------------------------------------
+    def _build_step(self, n_rows: int, bucket: int):
+        specs = self.specs
+        slices = _call_slices(specs)
+        n_dev = self.n_dev
+
+        def local_step(state: AggState, key_lanes, signs, vis, flat_in,
+                       owner_map):
+            # shard_map hands each shard a [1, ...] block: drop the axis
+            state = jax.tree.map(lambda a: a[0], state)
+            vn = vnodes_from_lanes(key_lanes)
+            owner = owner_map[vn]
+            # payload layout: keys, signs, then per call: lanes* + valid
+            payloads = [key_lanes, signs] + list(flat_in)
+            buckets, bvalid, overflow = bucketize_by_owner(
+                owner, vis, payloads, n_dev, bucket)
+            recv, rvalid = exchange(buckets, bvalid, AXIS)
+            m = n_dev * bucket
+            rkeys = recv[0].reshape(m, key_lanes.shape[1])
+            rsigns = recv[1].reshape(m)
+            rflat = [r.reshape(m) for r in recv[2:]]
+            rvis = rvalid.reshape(m)
+            table, slots, ins = ht.probe_insert(state.table, rkeys, rvis)
+            cap = state.table.capacity
+            scat = jnp.where(rvis, slots, cap)
+            s32 = rsigns.astype(jnp.int32)
+            group_rows = state.group_rows.at[scat].add(s32, mode="drop")
+            dirty = state.dirty.at[scat].set(True, mode="drop")
+            accs = list(state.accs)
+            k = 0
+            for spec, sl in zip(specs, slices):
+                n_in = n_input_lanes(spec)
+                in_lanes = tuple(rflat[k:k + n_in])
+                val_ok = rflat[k + n_in]
+                k += n_in + 1
+                _update_call(spec, accs, sl, in_lanes, val_ok, slots,
+                             rvis, s32, cap)
+            new = AggState(table, group_rows, dirty, tuple(accs),
+                           state.emitted_valid, state.emitted_rows,
+                           state.emitted_accs)
+            new = jax.tree.map(lambda a: a[None], new)
+            return new, ins[None], overflow[None]
+
+        state_spec = jax.tree.map(lambda _: P(AXIS), self.state)
+        mapped = jax.shard_map(
+            local_step, mesh=self.mesh,
+            in_specs=(state_spec, P(AXIS), P(AXIS), P(AXIS), P(AXIS),
+                      P()),
+            out_specs=(state_spec, P(AXIS), P(AXIS)),
+            check_vma=False)
+        return jax.jit(mapped, donate_argnums=(0,))
+
+    def apply(self, key_lanes: np.ndarray, signs: np.ndarray,
+              vis: np.ndarray,
+              inputs: Sequence[Tuple[Sequence[np.ndarray], np.ndarray]]
+              ) -> None:
+        """One SPMD step over a host batch.
+
+        Rows are split evenly across shards (row-sharded upload); the
+        all_to_all then moves each row to its vnode owner. `inputs` is
+        per call (value lanes, valid mask) — the single-chip layout;
+        lanes AND validity travel through the exchange. Batch rows must
+        divide n_dev.
+        """
+        n = key_lanes.shape[0]
+        assert n % self.n_dev == 0, (n, self.n_dev)
+        # per-shard post-exchange batch is n_dev*bucket rows in ONE
+        # scatter step — same int32 limb bound as the single-chip kernel
+        assert n <= lanes.MAX_CHUNK_ROWS, \
+            f"batch {n} > {lanes.MAX_CHUNK_ROWS} breaks limb math"
+        flat: List[jnp.ndarray] = []
+        for in_lanes, valid in inputs:
+            flat.extend(jnp.asarray(a) for a in in_lanes)
+            flat.append(jnp.asarray(valid))
+        # each shard holds n/n_dev local rows, so no owner can receive
+        # more than that: bucket = n/n_dev is overflow-free by
+        # construction AND keeps the exchanged tensor at n rows/shard
+        bucket = self.bucket or n // self.n_dev
+        key = (n, bucket)
+        if key not in self._step_cache:
+            self._step_cache[key] = self._build_step(n, bucket)
+        step = self._step_cache[key]
+        self.state, _ins, overflow = step(
+            self.state, jnp.asarray(key_lanes), jnp.asarray(signs),
+            jnp.asarray(vis), tuple(flat), self.owner_map)
+        assert not bool(np.asarray(overflow).any()), \
+            "bucket overflow: raise `bucket` (host retry path TBD)"
+
+    # -- host-side full decode (tests + dryrun assertions) ---------------
+    def snapshot(self) -> Dict[tuple, tuple]:
+        """group key lanes tuple → decoded outputs, across all shards."""
+        st = jax.device_get(self.state)
+        out: Dict[tuple, tuple] = {}
+        for d in range(self.n_dev):
+            occ = st.table.occ[d]
+            live = occ & (st.group_rows[d] > 0)
+            idx = np.flatnonzero(live)
+            if not len(idx):
+                continue
+            keys = st.table.keys[d][idx]
+            accs = [a[d][idx] for a in st.accs]
+            outs, nulls = decode_outputs(self.specs, accs)
+            for r in range(len(idx)):
+                kt = tuple(keys[r].tolist())
+                out[kt] = tuple(
+                    None if nulls[c][r] else outs[c][r].item()
+                    for c in range(len(self.specs)))
+        return out

@@ -1,0 +1,77 @@
+"""On-device hash dispatch: vnode bucketize + all_to_all.
+
+Reference parity: DispatcherType::HASH (src/stream/src/executor/
+dispatch.rs:582-690) — rows route by hash(dist key) → vnode → owner. The
+reference serializes per-downstream chunks onto gRPC; here the exchange is
+a single ``jax.lax.all_to_all`` over ICI: each shard bucketizes its rows
+by target shard into a fixed [n_dev, bucket] send tensor, the collective
+transposes it, and every shard receives exactly the rows it owns.
+
+Static shapes (XLA contract): `bucket` bounds rows-per-target per step.
+The default bucket (local row count) makes overflow impossible by
+construction; a caller shrinking it trades bandwidth for a fatal-on-skew
+contract — the overflow flag fires AFTER the step has applied the
+surviving rows, so it is an assertion, not a retry point. All lanes are
+int32 (ops/lanes.py rationale).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from risingwave_tpu.common.hash import VNODE_COUNT
+from risingwave_tpu.ops.hash_table import hash_key_lanes
+
+
+def vnodes_from_lanes(key_lanes: jnp.ndarray) -> jnp.ndarray:
+    """int32 vnode in [0, 256) from int32 key lanes (device twin of
+    common.hash.vnodes_of for pre-split lanes)."""
+    return (hash_key_lanes(key_lanes)
+            & jnp.uint32(VNODE_COUNT - 1)).astype(jnp.int32)
+
+
+def bucketize_by_owner(owner: jnp.ndarray, valid: jnp.ndarray,
+                       payloads: Sequence[jnp.ndarray], n_dev: int,
+                       bucket: int
+                       ) -> Tuple[List[jnp.ndarray], jnp.ndarray,
+                                  jnp.ndarray]:
+    """Pack rows into per-target buckets for an all_to_all.
+
+    owner: int32[N] target shard per row; valid: bool[N].
+    payloads: arrays [N] or [N, K] to route alongside.
+    Returns (bucketized payloads each [n_dev, bucket, ...],
+             valid [n_dev, bucket], overflowed bool scalar).
+    Row order within a bucket preserves input order (determinism).
+    """
+    n = owner.shape[0]
+    onehot = (owner[:, None] == jnp.arange(n_dev, dtype=jnp.int32)[None, :]
+              ) & valid[:, None]                          # [N, n_dev]
+    pos_all = jnp.cumsum(onehot.astype(jnp.int32), axis=0) - 1
+    row_pos = jnp.sum(jnp.where(onehot, pos_all, 0), axis=1)   # [N]
+    fits = valid & (row_pos < bucket)
+    dest = jnp.where(fits, owner * bucket + row_pos, n_dev * bucket)
+    out = []
+    for p in payloads:
+        flat_shape = (n_dev * bucket,) + p.shape[1:]
+        buf = jnp.zeros(flat_shape, dtype=p.dtype).at[dest].set(
+            p, mode="drop")
+        out.append(buf.reshape((n_dev, bucket) + p.shape[1:]))
+    vbuf = jnp.zeros(n_dev * bucket, dtype=bool).at[dest].set(
+        valid, mode="drop").reshape(n_dev, bucket)
+    overflowed = jnp.any(valid & ~fits)
+    return out, vbuf, overflowed
+
+
+def exchange(bucketized: Sequence[jnp.ndarray], valid: jnp.ndarray,
+             axis_name: str
+             ) -> Tuple[List[jnp.ndarray], jnp.ndarray]:
+    """The ICI collective: transpose [n_dev, bucket, ...] buckets so
+    shard i receives every shard's bucket-for-i (dispatch.rs's gRPC
+    exchange as one all_to_all)."""
+    out = [jax.lax.all_to_all(p, axis_name, split_axis=0, concat_axis=0)
+           for p in bucketized]
+    v = jax.lax.all_to_all(valid, axis_name, split_axis=0, concat_axis=0)
+    return out, v

@@ -1,0 +1,82 @@
+"""Vnode-sharded agg over the 8-device virtual mesh == single-chip result.
+
+VERDICT round-1 item #4: the multi-chip axis must be exercised, not just
+claimed — this test uses the eight_devices fixture and asserts the SPMD
+all_to_all path agrees with the single-device kernel on a random stream.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh
+
+from risingwave_tpu.ops import lanes
+from risingwave_tpu.ops.hash_agg import (
+    AggKind, AggSpec, GroupedAggKernel, decode_outputs,
+)
+from risingwave_tpu.parallel.agg import ShardedAggKernel
+
+
+def _mk_inputs(spec, vals, valid):
+    return (tuple(np.asarray(a) for a in spec.encode_input(vals)),
+            valid)
+
+
+def _single_chip_snapshot(kernel: GroupedAggKernel):
+    st = jax.device_get(kernel.state)
+    out = {}
+    live = st.table.occ & (st.group_rows > 0)
+    idx = np.flatnonzero(live)
+    keys = st.table.keys[idx]
+    accs = [a[idx] for a in st.accs]
+    outs, nulls = decode_outputs(kernel.specs, accs)
+    for r in range(len(idx)):
+        out[tuple(keys[r].tolist())] = tuple(
+            None if nulls[c][r] else outs[c][r].item()
+            for c in range(len(kernel.specs)))
+    return out
+
+
+def test_sharded_agg_matches_single_chip(eight_devices):
+    mesh = Mesh(np.asarray(eight_devices), ("d",))
+    specs = [AggSpec(AggKind.SUM, np.dtype(np.int64)),
+             AggSpec(AggKind.MAX, np.dtype(np.int64)),
+             AggSpec(AggKind.COUNT)]
+    # keys: one int64 logical key → (hi, lo) int32 lanes
+    sharded = ShardedAggKernel(mesh, key_width=2, specs=specs,
+                               capacity=1 << 10)
+    single = GroupedAggKernel(key_width=2, specs=specs)
+
+    rng = np.random.default_rng(5)
+    for _step in range(4):
+        n = 256
+        gk = rng.integers(0, 37, n).astype(np.int64) * 7_000_000_000
+        hi, lo = lanes.split_i64(gk)
+        key_lanes = np.stack([hi, lo], axis=1)
+        vals = rng.integers(-(10**9), 10**9, n)
+        signs = np.ones(n, dtype=np.int32)
+        vis = rng.random(n) > 0.1
+        valid = np.ones(n, dtype=bool)
+        inputs = [_mk_inputs(specs[0], vals, valid),
+                  _mk_inputs(specs[1], vals, valid),
+                  ((), valid)]
+        sharded.apply(key_lanes, signs, vis, inputs)
+        single.apply(jnp.asarray(key_lanes), jnp.asarray(signs),
+                     jnp.asarray(vis),
+                     tuple((tuple(jnp.asarray(x) for x in l),
+                            jnp.asarray(v)) for l, v in inputs))
+
+    got = sharded.snapshot()
+    want = _single_chip_snapshot(single)
+    assert got == want
+    assert len(got) == 37
+
+
+def test_sharded_state_is_actually_sharded(eight_devices):
+    mesh = Mesh(np.asarray(eight_devices), ("d",))
+    k = ShardedAggKernel(mesh, key_width=2,
+                         specs=[AggSpec(AggKind.COUNT)], capacity=1 << 10)
+    shardings = {str(a.sharding.spec) for a in
+                 [k.state.table.keys, k.state.group_rows]}
+    assert all("'d'" in s for s in shardings), shardings
