@@ -4,9 +4,10 @@ Prints ONE JSON line: {"metric": ..., "value": N, "unit": ...,
 "vs_baseline": N} — the driver records it in BENCH_r{N}.json.
 
 Baseline (BASELINE.md): ≥1M events/sec/chip on Nexmark q7/q8 (one v5e).
-The headline metric is the best stateful-query throughput available; q1
-(stateless, host-bound reference path) is reported inside "extra" for
-tracking. Run `python bench.py --all` for the full table on stderr.
+The headline metric is the stateful device-kernel path (q7: HashAgg on
+TPU). Run `python bench.py --all` for the full table (q1, q7, q8) on
+stderr. Pipelines come from risingwave_tpu.models.nexmark — the
+benchmarked plan is exactly the tested plan (tests/test_e2e_q*.py).
 """
 
 from __future__ import annotations
@@ -14,187 +15,77 @@ from __future__ import annotations
 import asyncio
 import json
 import sys
-import time
 
 BASELINE_EVENTS_PER_SEC = 1_000_000.0
 
 
-def bench_q1(total_events: int = 50 * 4000, chunk_size: int = 4096):
-    """q1: source → project → materialize (host/CPU reference path)."""
-    from risingwave_tpu.common.types import DataType, Field, Schema
-    from risingwave_tpu.connectors.nexmark import (
-        NexmarkConfig, NexmarkSplitReader,
-    )
-    from risingwave_tpu.expr.expr import InputRef, lit
-    from risingwave_tpu.meta.barrier import BarrierLoop
-    from risingwave_tpu.state.state_table import StateTable
-    from risingwave_tpu.state.store import MemoryStateStore
-    from risingwave_tpu.stream.actor import Actor, LocalBarrierManager
-    from risingwave_tpu.stream.exchange import channel_for_test
-    from risingwave_tpu.stream.executors.materialize import (
-        MaterializeExecutor,
-    )
-    from risingwave_tpu.stream.executors.row_id_gen import RowIdGenExecutor
-    from risingwave_tpu.stream.executors.simple import ProjectExecutor
-    from risingwave_tpu.stream.executors.source import SourceExecutor
-    from risingwave_tpu.stream.message import StopMutation
-
-    split_schema = Schema([Field("split_id", DataType.VARCHAR),
-                           Field("offset", DataType.INT64)])
-    cfg = NexmarkConfig(event_num=total_events, max_chunk_size=chunk_size)
-    store = MemoryStateStore()
-    reader = NexmarkSplitReader(cfg)
-    barrier_tx, barrier_rx = channel_for_test()
-    split_state = StateTable(1, split_schema, [0], store)
-    source = SourceExecutor(reader, barrier_rx, split_state, actor_id=1)
-    row_id = RowIdGenExecutor(source)
-    s = row_id.schema
-    project = ProjectExecutor(
-        row_id,
-        exprs=[InputRef(s.index_of("auction"), DataType.INT64),
-               InputRef(s.index_of("bidder"), DataType.INT64),
-               lit("0.908", DataType.DECIMAL)
-               * InputRef(s.index_of("price"), DataType.INT64),
-               InputRef(s.index_of("date_time"), DataType.TIMESTAMP),
-               InputRef(s.index_of("_row_id"), DataType.SERIAL)],
-        names=["auction", "bidder", "price", "date_time", "_row_id"])
-    mv_table = StateTable(2, project.schema, [4], store)
-    mat = MaterializeExecutor(project, mv_table)
-    local = LocalBarrierManager()
-    local.register_sender(1, barrier_tx)
-    local.set_expected_actors([1])
-    actor = Actor(1, mat, dispatchers=[], barrier_manager=local)
-    loop = BarrierLoop(local, store)
-
-    n_bids = total_events * 46 // 50
-
-    async def main():
-        task = actor.spawn()
-        t0 = time.perf_counter()
-        while reader.offset < n_bids:
-            await loop.inject_and_collect()
-        await loop.inject_and_collect()
-        elapsed = time.perf_counter() - t0
-        await loop.inject_and_collect(
-            mutation=StopMutation(frozenset([1])))
-        await task
-        if actor.failure is not None:
-            raise actor.failure
-        return elapsed
-
-    elapsed = asyncio.run(main())
+def _result(metric, elapsed, rows, loop):
     return {
-        "metric": "nexmark_q1_events_per_sec",
-        "value": round(n_bids / elapsed, 1),
+        "metric": metric,
+        "value": round(rows / elapsed, 1),
         "unit": "events/s",
         "p99_barrier_latency_s": round(loop.stats.p99_latency_s(), 4),
-        "events": n_bids,
+        "events": rows,
     }
+
+
+def bench_q1(total_events: int = 50 * 4000, chunk_size: int = 4096):
+    """q1: source → project → materialize (stateless reference path)."""
+    from risingwave_tpu.connectors.nexmark import NexmarkConfig
+    from risingwave_tpu.models.nexmark import build_q1, drive_to_completion
+    from risingwave_tpu.state.store import MemoryStateStore
+
+    cfg = NexmarkConfig(event_num=total_events, max_chunk_size=chunk_size)
+    p = build_q1(MemoryStateStore(), cfg, rate_limit=16)
+    n_bids = total_events * 46 // 50
+    elapsed, rows = asyncio.run(drive_to_completion(p, {1: n_bids}))
+    return _result("nexmark_q1_events_per_sec", elapsed, rows, p.loop)
 
 
 def bench_q7(total_events: int = 50 * 40_000, chunk_size: int = 8192):
     """q7 core: tumble-window MAX(price) on the device hash-agg kernel.
 
-    source → project(tumble_start, price) → HashAggExecutor(TPU) →
-    materialize. The stateful baseline config (BASELINE.md: HashAgg on
-    TPU, ≥1M events/s/chip)."""
-    from risingwave_tpu.common.types import (
-        DataType, Field, Interval, Schema,
-    )
-    from risingwave_tpu.connectors.nexmark import (
-        NexmarkConfig, NexmarkSplitReader,
-    )
-    from risingwave_tpu.expr.expr import InputRef, tumble_start
-    from risingwave_tpu.meta.barrier import BarrierLoop
-    from risingwave_tpu.ops.hash_agg import AggKind
-    from risingwave_tpu.state.state_table import StateTable
+    The stateful baseline config (BASELINE.md: HashAgg on TPU, ≥1M
+    events/s/chip)."""
+    from risingwave_tpu.connectors.nexmark import NexmarkConfig
+    from risingwave_tpu.models.nexmark import build_q7, drive_to_completion
     from risingwave_tpu.state.store import MemoryStateStore
-    from risingwave_tpu.stream.actor import Actor, LocalBarrierManager
-    from risingwave_tpu.stream.exchange import channel_for_test
-    from risingwave_tpu.stream.executors.hash_agg import (
-        AggCall, HashAggExecutor, agg_state_schema,
-    )
-    from risingwave_tpu.stream.executors.materialize import (
-        MaterializeExecutor,
-    )
-    from risingwave_tpu.stream.executors.simple import ProjectExecutor
-    from risingwave_tpu.stream.executors.source import SourceExecutor
-    from risingwave_tpu.stream.message import StopMutation
 
-    split_schema = Schema([Field("split_id", DataType.VARCHAR),
-                           Field("offset", DataType.INT64)])
-    window = Interval(usecs=10_000_000)
     cfg = NexmarkConfig(event_num=total_events, max_chunk_size=chunk_size,
                         generate_strings=False)
-    store = MemoryStateStore()
-    reader = NexmarkSplitReader(cfg)
-    barrier_tx, barrier_rx = channel_for_test()
-    split_state = StateTable(1, split_schema, [0], store)
-    source = SourceExecutor(reader, barrier_rx, split_state, actor_id=1,
-                            rate_limit_chunks_per_barrier=16)
-    s = source.schema
-    project = ProjectExecutor(
-        source,
-        exprs=[tumble_start(
-            InputRef(s.index_of("date_time"), DataType.TIMESTAMP), window),
-            InputRef(s.index_of("price"), DataType.INT64)],
-        names=["window_start", "price"])
-    calls = [AggCall(AggKind.MAX, 1), AggCall(AggKind.COUNT)]
-    agg_schema, agg_pk = agg_state_schema(project.schema, [0], calls)
-    agg_state = StateTable(2, agg_schema, agg_pk, store,
-                           dist_key_indices=[0])
-    agg = HashAggExecutor(project, [0], calls, agg_state, append_only=True,
-                          output_names=["max_price", "bid_count"])
-    mv_table = StateTable(3, agg.schema, [0], store)
-    mat = MaterializeExecutor(agg, mv_table)
-    local = LocalBarrierManager()
-    local.register_sender(1, barrier_tx)
-    local.set_expected_actors([1])
-    actor = Actor(1, mat, dispatchers=[], barrier_manager=local)
-    loop = BarrierLoop(local, store)
-
+    p = build_q7(MemoryStateStore(), cfg, rate_limit=16)
     n_bids = total_events * 46 // 50
+    elapsed, rows = asyncio.run(drive_to_completion(p, {1: n_bids}))
+    return _result("nexmark_q7_events_per_sec", elapsed, rows, p.loop)
 
-    async def main():
-        task = actor.spawn()
-        # warmup epoch: trigger jit compiles outside the timed window
-        await loop.inject_and_collect()
-        warm_events = reader.offset
-        warm_epochs = len(loop.stats.latencies_s)
-        t0 = time.perf_counter()
-        while reader.offset < n_bids:
-            await loop.inject_and_collect()
-        elapsed = time.perf_counter() - t0
-        timed_events = reader.offset - warm_events
-        await loop.inject_and_collect(
-            mutation=StopMutation(frozenset([1])))
-        await task
-        if actor.failure is not None:
-            raise actor.failure
-        # drop warmup epochs from the latency stats (compile time is not
-        # steady-state barrier latency)
-        loop.stats.latencies_s = loop.stats.latencies_s[warm_epochs:]
-        return elapsed, timed_events
 
-    elapsed, timed_events = asyncio.run(main())
-    return {
-        "metric": "nexmark_q7_events_per_sec",
-        "value": round(timed_events / elapsed, 1),
-        "unit": "events/s",
-        "p99_barrier_latency_s": round(loop.stats.p99_latency_s(), 4),
-        "events": timed_events,
-    }
+def bench_q8(total_events: int = 50 * 40_000, chunk_size: int = 4096):
+    """q8: windowed person⋈auction inner join on the device matcher.
+
+    Throughput counts rows entering the pipeline (persons + auctions)."""
+    from risingwave_tpu.connectors.nexmark import NexmarkConfig
+    from risingwave_tpu.models.nexmark import build_q8, drive_to_completion
+    from risingwave_tpu.state.store import MemoryStateStore
+
+    base = NexmarkConfig(event_num=total_events, max_chunk_size=chunk_size)
+    cfg_p = NexmarkConfig(**{**base.__dict__, "table_type": "person"})
+    cfg_a = NexmarkConfig(**{**base.__dict__, "table_type": "auction"})
+    p = build_q8(MemoryStateStore(), cfg_p, cfg_a, rate_limit=16)
+    targets = {1: total_events // 50, 2: total_events * 3 // 50}
+    elapsed, rows = asyncio.run(drive_to_completion(p, targets))
+    return _result("nexmark_q8_events_per_sec", elapsed, rows, p.loop)
 
 
 def main(argv):
     run_all = "--all" in argv
     results = {}
     # headline: the stateful device-kernel path (q7). q1 (stateless host
-    # reference path) is reported alongside on --all.
+    # reference path) and q8 (device join) are reported on --all.
     results["q7"] = bench_q7()
     headline = dict(results["q7"])
     if run_all:
         results["q1"] = bench_q1()
+        results["q8"] = bench_q8()
     headline["vs_baseline"] = round(
         headline["value"] / BASELINE_EVENTS_PER_SEC, 4)
     if run_all:

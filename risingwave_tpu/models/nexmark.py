@@ -1,0 +1,212 @@
+"""Hand-built Nexmark pipelines: q1 (stateless), q7-core (hash agg on
+device), q8 (windowed join on device).
+
+Reference parity: e2e_test/streaming/nexmark/q1|q7|q8 semantics; plan
+shapes mirror what the reference's fragmenter produces for these queries
+(src/frontend/src/stream_fragmenter/mod.rs) — hand-assembled here until
+the SQL frontend lands. Used by BOTH tests/test_e2e_q*.py and bench.py:
+the benchmarked pipeline is exactly the tested pipeline.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from risingwave_tpu.common.types import DataType, Field, Interval, Schema
+from risingwave_tpu.connectors.nexmark import NexmarkConfig, NexmarkSplitReader
+from risingwave_tpu.expr.expr import InputRef, lit, tumble_start
+from risingwave_tpu.meta.barrier import BarrierLoop
+from risingwave_tpu.ops.hash_agg import AggKind
+from risingwave_tpu.state.state_table import StateTable
+from risingwave_tpu.stream.actor import Actor, LocalBarrierManager
+from risingwave_tpu.stream.exchange import channel_for_test
+from risingwave_tpu.stream.executors.hash_agg import (
+    AggCall, HashAggExecutor, agg_state_schema,
+)
+from risingwave_tpu.stream.executors.hash_join import HashJoinExecutor
+from risingwave_tpu.stream.executors.materialize import MaterializeExecutor
+from risingwave_tpu.stream.executors.row_id_gen import RowIdGenExecutor
+from risingwave_tpu.stream.executors.simple import ProjectExecutor
+from risingwave_tpu.stream.executors.source import SourceExecutor
+
+SPLIT_STATE_SCHEMA = Schema([Field("split_id", DataType.VARCHAR),
+                             Field("offset", DataType.INT64)])
+DEFAULT_WINDOW = Interval(usecs=10_000_000)   # 10 seconds
+
+
+@dataclass
+class Pipeline:
+    """A runnable hand-built plan: one actor + its barrier loop."""
+
+    actor: Actor
+    loop: BarrierLoop
+    mv_table: StateTable
+    readers: Dict[int, NexmarkSplitReader]
+
+    @property
+    def reader(self) -> NexmarkSplitReader:
+        assert len(self.readers) == 1
+        return next(iter(self.readers.values()))
+
+
+def _source(local: LocalBarrierManager, store, actor_id: int,
+            cfg: NexmarkConfig, table_id: int,
+            rate_limit: Optional[int]) -> SourceExecutor:
+    reader = NexmarkSplitReader(cfg)
+    tx, rx = channel_for_test()
+    split_state = StateTable(table_id, SPLIT_STATE_SCHEMA, [0], store)
+    local.register_sender(actor_id, tx)
+    return SourceExecutor(reader, rx, split_state, actor_id=actor_id,
+                          rate_limit_chunks_per_barrier=rate_limit)
+
+
+def _finish(local: LocalBarrierManager, store, mat: MaterializeExecutor,
+            mv_table: StateTable, actor_id: int,
+            readers: Dict[int, NexmarkSplitReader]) -> Pipeline:
+    local.set_expected_actors([actor_id])
+    actor = Actor(actor_id, mat, dispatchers=[], barrier_manager=local)
+    return Pipeline(actor, BarrierLoop(local, store), mv_table, readers)
+
+
+def build_q1(store, cfg: NexmarkConfig,
+             rate_limit: Optional[int] = 3) -> Pipeline:
+    """q1: SELECT auction, bidder, 0.908*price, date_time FROM bid."""
+    local = LocalBarrierManager()
+    source = _source(local, store, 1, cfg, 1, rate_limit)
+    row_id = RowIdGenExecutor(source)
+    s = row_id.schema
+    project = ProjectExecutor(
+        row_id,
+        exprs=[InputRef(s.index_of("auction"), DataType.INT64),
+               InputRef(s.index_of("bidder"), DataType.INT64),
+               lit("0.908", DataType.DECIMAL)
+               * InputRef(s.index_of("price"), DataType.INT64),
+               InputRef(s.index_of("date_time"), DataType.TIMESTAMP),
+               InputRef(s.index_of("_row_id"), DataType.SERIAL)],
+        names=["auction", "bidder", "price", "date_time", "_row_id"])
+    mv_table = StateTable(2, project.schema, [4], store)  # pk = _row_id
+    mat = MaterializeExecutor(project, mv_table)
+    return _finish(local, store, mat, mv_table, 1,
+                   {1: source.reader})
+
+
+def build_q7(store, cfg: NexmarkConfig,
+             rate_limit: Optional[int] = 4,
+             window: Interval = DEFAULT_WINDOW) -> Pipeline:
+    """q7-core: MAX(price), COUNT(*) per tumbling window (device agg)."""
+    local = LocalBarrierManager()
+    source = _source(local, store, 1, cfg, 1, rate_limit)
+    s = source.schema
+    project = ProjectExecutor(
+        source,
+        exprs=[tumble_start(
+            InputRef(s.index_of("date_time"), DataType.TIMESTAMP), window),
+            InputRef(s.index_of("price"), DataType.INT64)],
+        names=["window_start", "price"])
+    calls = [AggCall(AggKind.MAX, 1), AggCall(AggKind.COUNT)]
+    agg_schema, agg_pk = agg_state_schema(project.schema, [0], calls)
+    agg_state = StateTable(2, agg_schema, agg_pk, store,
+                           dist_key_indices=[0])
+    agg = HashAggExecutor(project, [0], calls, agg_state,
+                          append_only=True,
+                          output_names=["max_price", "bid_count"])
+    mv_table = StateTable(3, agg.schema, [0], store)  # pk = window_start
+    mat = MaterializeExecutor(agg, mv_table)
+    return _finish(local, store, mat, mv_table, 1,
+                   {1: source.reader})
+
+
+def build_q8(store, cfg_p: NexmarkConfig, cfg_a: NexmarkConfig,
+             rate_limit: Optional[int] = 4,
+             window: Interval = DEFAULT_WINDOW) -> Pipeline:
+    """q8: persons who created an auction in the same tumbling window.
+
+    two sources → projects → auction-side hash-agg dedup → inner
+    HashJoin (device matcher) → project → materialize."""
+    local = LocalBarrierManager()
+    persons = _source(local, store, 1, cfg_p, 1, rate_limit)
+    ps = persons.schema
+    p_proj = ProjectExecutor(
+        persons,
+        exprs=[InputRef(ps.index_of("id"), DataType.INT64),
+               InputRef(ps.index_of("name"), DataType.VARCHAR),
+               tumble_start(InputRef(ps.index_of("date_time"),
+                                     DataType.TIMESTAMP), window)],
+        names=["id", "name", "starttime"])
+    auctions = _source(local, store, 2, cfg_a, 2, rate_limit)
+    asch = auctions.schema
+    a_proj = ProjectExecutor(
+        auctions,
+        exprs=[InputRef(asch.index_of("seller"), DataType.INT64),
+               tumble_start(InputRef(asch.index_of("date_time"),
+                                     DataType.TIMESTAMP), window)],
+        names=["seller", "starttime"])
+    calls = [AggCall(AggKind.COUNT)]
+    agg_sch, agg_pk = agg_state_schema(a_proj.schema, [0, 1], calls)
+    a_dedup = HashAggExecutor(
+        a_proj, [0, 1], calls,
+        StateTable(3, agg_sch, agg_pk, store, dist_key_indices=[0]),
+        append_only=True, output_names=["seller", "starttime", "_cnt"])
+    a_dedup_proj = ProjectExecutor(
+        a_dedup,
+        exprs=[InputRef(0, DataType.INT64),
+               InputRef(1, DataType.TIMESTAMP)],
+        names=["seller", "starttime"])
+    lt = StateTable(4, p_proj.schema, [0, 2], store, dist_key_indices=[0])
+    rt = StateTable(5, a_dedup_proj.schema, [0, 1], store,
+                    dist_key_indices=[0])
+    join = HashJoinExecutor(p_proj, a_dedup_proj,
+                            left_keys=[0, 2], right_keys=[0, 1],
+                            left_table=lt, right_table=rt)
+    out = ProjectExecutor(
+        join,
+        exprs=[InputRef(0, DataType.INT64),
+               InputRef(1, DataType.VARCHAR),
+               InputRef(2, DataType.TIMESTAMP)],
+        names=["id", "name", "starttime"])
+    mv = StateTable(6, out.schema, [0, 2], store)
+    mat = MaterializeExecutor(out, mv)
+    return _finish(local, store, mat, mv, 7,
+                   {1: persons.reader, 2: auctions.reader})
+
+
+def drive_to_completion(pipeline: Pipeline,
+                        targets: Dict[int, int],
+                        max_epochs: int = 500):
+    """Async driver: barrier-tick until every reader hits its target
+    offset, one final checkpoint, then a Stop barrier.
+
+    Returns (timed_elapsed_s, timed_rows) measured AFTER a warmup epoch
+    (jit compiles land outside the timed window)."""
+    import time
+
+    from risingwave_tpu.stream.message import StopMutation
+
+    async def run():
+        task = pipeline.actor.spawn()
+        loop = pipeline.loop
+        readers = pipeline.readers
+        await loop.inject_and_collect()      # warmup epoch
+        warm_rows = sum(r.offset for r in readers.values())
+        warm_epochs = len(loop.stats.latencies_s)
+        t0 = time.perf_counter()
+        for _ in range(max_epochs):
+            if all(readers[a].offset >= t for a, t in targets.items()):
+                break
+            await loop.inject_and_collect()
+        else:
+            raise RuntimeError(
+                f"sources stalled: "
+                f"{ {a: readers[a].offset for a in targets} } vs {targets}")
+        elapsed = time.perf_counter() - t0
+        timed_rows = sum(r.offset for r in readers.values()) - warm_rows
+        await loop.inject_and_collect(
+            mutation=StopMutation(frozenset(readers.keys())))
+        await task
+        if pipeline.actor.failure is not None:
+            raise pipeline.actor.failure
+        loop.stats.latencies_s = loop.stats.latencies_s[warm_epochs:]
+        return elapsed, timed_rows
+
+    return run()

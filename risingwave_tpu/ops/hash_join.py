@@ -1,0 +1,261 @@
+"""Device-resident join-side state (the q8 kernel's matcher).
+
+Reference parity: JoinHashMap (src/stream/src/executor/managed_state/
+join/mod.rs:228) — join key → multiset of rows — and the probe loop of
+hash_join.rs:990 (``eq_join_oneside``). TPU re-design: the reference
+walks a CPU hashbrown map row by row; here MATCHING runs on device as
+whole-batch kernels, while row payloads stay in host arenas (varchar can
+never live in HBM anyway — the device's job is the equality/match
+structure, the host's job is materialization):
+
+    table  DeviceHashTable     join-key lanes → key slot
+    head   int32[cap]          key slot → first row ref (-1 end)
+    next   int32[row_cap]      row ref → next row ref in its key chain
+    live   bool[row_cap]       tombstones (deletes unlink lazily)
+
+- ``insert``: whole-batch: one key probe-insert, then one chain-link
+  kernel. Rows of one batch that share a key are chained to each other
+  with one stable sort + shifted compares — no per-row host loop.
+- ``delete``: tombstone (live=False). Chains keep the node until a
+  rebuild; probes skip dead rows.
+- ``probe``: two passes — a degree-count walk, a host sync for the output
+  size, then an emit walk writing (probe_row, matched_ref) pairs at
+  cumsum offsets. ``lax.while_loop`` runs exactly max-chain-length
+  iterations (dynamic trip count, static shapes).
+
+All lanes int32 (ops/lanes.py rationale).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.ops import hash_table as ht
+
+
+class ChainState(NamedTuple):
+    """Functional chain arrays (the non-key half of a join side)."""
+
+    head: jnp.ndarray    # int32[cap]
+    next: jnp.ndarray    # int32[row_cap]
+    live: jnp.ndarray    # bool[row_cap]
+
+
+def link_rows(chains: ChainState, slots: jnp.ndarray,
+              row_refs: jnp.ndarray, vis: jnp.ndarray,
+              cap: int) -> ChainState:
+    """Front-insert a batch of rows into their key chains.
+
+    `slots` comes from the key table's probe_insert for the same batch;
+    rows of the batch that share a slot are linked to each other via a
+    stable sort so the whole batch needs one scatter per array."""
+    row_cap = int(chains.next.shape[0])
+    skey = jnp.where(vis & (slots >= 0), slots, cap)
+    order = jnp.argsort(skey, stable=True)
+    s = skey[order]
+    r = row_refs[order]
+    valid = s < cap
+    first = jnp.concatenate([jnp.ones(1, bool), s[1:] != s[:-1]])
+    last = jnp.concatenate([s[1:] != s[:-1], jnp.ones(1, bool)])
+    succ = jnp.roll(r, -1)                      # r[i+1] (garbage at end)
+    old_head = chains.head[jnp.minimum(s, cap - 1)]
+    nxt_val = jnp.where(last, old_head, succ)
+    nxt = chains.next.at[jnp.where(valid, r, row_cap)].set(
+        nxt_val, mode="drop")
+    head = chains.head.at[jnp.where(valid & first, s, cap)].set(
+        r, mode="drop")
+    live = chains.live.at[jnp.where(valid, r, row_cap)].set(
+        True, mode="drop")
+    return ChainState(head, nxt, live)
+
+
+def tombstone_rows(chains: ChainState, row_refs: jnp.ndarray,
+                   vis: jnp.ndarray) -> ChainState:
+    """Tombstone deletes; the chain node is skipped by probes."""
+    row_cap = int(chains.next.shape[0])
+    live = chains.live.at[jnp.where(vis, row_refs, row_cap)].set(
+        False, mode="drop")
+    return chains._replace(live=live)
+
+
+def _chain_walk(table: ht.TableState, chains: ChainState,
+                key_lanes, vis, body_extra, carry0):
+    """Shared chain-walk loop: calls body_extra(cur, is_match, carry)."""
+    slots = ht.lookup(table, key_lanes, vis)
+    cur0 = jnp.where(slots >= 0,
+                     chains.head[jnp.maximum(slots, 0)], jnp.int32(-1))
+
+    def cond(c):
+        cur = c[0]
+        return jnp.any(cur >= 0)
+
+    def body(c):
+        cur, carry = c
+        safe = jnp.maximum(cur, 0)
+        is_match = (cur >= 0) & chains.live[safe]
+        carry = body_extra(cur, is_match, carry)
+        cur = jnp.where(cur >= 0, chains.next[safe], jnp.int32(-1))
+        return cur, carry
+
+    _cur, carry = jax.lax.while_loop(cond, body, (cur0, carry0))
+    return carry
+
+
+def probe_degrees(table: ht.TableState, chains: ChainState,
+                  key_lanes: jnp.ndarray, vis: jnp.ndarray) -> jnp.ndarray:
+    """Matches per probe row (live rows in the key's chain)."""
+    n = key_lanes.shape[0]
+
+    def acc(cur, is_match, deg):
+        return deg + is_match.astype(jnp.int32)
+
+    return _chain_walk(table, chains, key_lanes, vis, acc,
+                       jnp.zeros(n, dtype=jnp.int32))
+
+
+def probe_emit(table: ht.TableState, chains: ChainState,
+               key_lanes: jnp.ndarray, vis: jnp.ndarray,
+               offsets: jnp.ndarray, out_cap: int
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Write (probe_row_idx, matched_ref) pairs at cumsum offsets.
+
+    out_cap is static (host computed next_pow2(total degrees))."""
+    n = key_lanes.shape[0]
+    row_ids = jnp.arange(n, dtype=jnp.int32)
+    out_probe = jnp.full(out_cap, -1, dtype=jnp.int32)
+    out_ref = jnp.full(out_cap, -1, dtype=jnp.int32)
+
+    def emit(cur, is_match, carry):
+        wp, op, orf = carry
+        dest = jnp.where(is_match, wp, out_cap)
+        op = op.at[dest].set(row_ids, mode="drop")
+        orf = orf.at[dest].set(cur, mode="drop")
+        return wp + is_match.astype(jnp.int32), op, orf
+
+    _wp, out_probe, out_ref = _chain_walk(
+        table, chains, key_lanes, vis, emit,
+        (offsets.astype(jnp.int32), out_probe, out_ref))
+    return out_probe, out_ref
+
+
+_link_jit = jax.jit(link_rows, donate_argnums=(0,), static_argnums=(4,))
+_tombstone_jit = jax.jit(tombstone_rows, donate_argnums=(0,))
+_degrees_jit = jax.jit(probe_degrees)
+_emit_jit = jax.jit(probe_emit, static_argnums=(5,))
+
+
+def _remap_head(head: jnp.ndarray, old_to_new: jnp.ndarray,
+                new_cap: int) -> jnp.ndarray:
+    safe = jnp.where(old_to_new >= 0, old_to_new, new_cap)
+    return jnp.full(new_cap, -1, dtype=jnp.int32).at[safe].set(
+        head, mode="drop")
+
+
+_remap_head_jit = jax.jit(_remap_head, static_argnums=(2,))
+
+
+class JoinSideKernel:
+    """Host wrapper: key table + chain arrays + arena growth.
+
+    The key table is a DeviceHashTable (growth, load factor, sync-free
+    occupancy bound all live there); on rehash its on_grow hook remaps
+    `head` from old slots to new. The executor assigns row refs (host
+    pk→ref map); tombstoned refs are NOT recycled — a dead ref stays
+    linked in its chain, so reuse would splice one node into two chains
+    and create cycles. Dead refs are reclaimed wholesale by `rebuild`
+    (recovery / future compaction)."""
+
+    def __init__(self, key_width: int,
+                 key_capacity: int = ht.MIN_CAPACITY,
+                 row_capacity: int = ht.MIN_CAPACITY):
+        self.key_width = key_width
+        self.table = ht.DeviceHashTable(key_width, key_capacity)
+        self.table.on_grow(self._on_table_grow)
+        self.chains = ChainState(
+            head=jnp.full(self.table.capacity, -1, dtype=jnp.int32),
+            next=jnp.full(row_capacity, -1, dtype=jnp.int32),
+            live=jnp.zeros(row_capacity, dtype=bool))
+
+    @property
+    def row_capacity(self) -> int:
+        return int(self.chains.next.shape[0])
+
+    # -- growth ----------------------------------------------------------
+    def _on_table_grow(self, old_to_new: jnp.ndarray,
+                       old_capacity: int) -> None:
+        self.chains = self.chains._replace(
+            head=_remap_head_jit(self.chains.head, old_to_new,
+                                 self.table.capacity))
+
+    def reserve_rows(self, max_ref: int) -> None:
+        row_cap = self.row_capacity
+        if max_ref < row_cap:
+            return
+        new_cap = row_cap
+        while new_cap <= max_ref:
+            new_cap *= 2
+        pad = new_cap - row_cap
+        self.chains = self.chains._replace(
+            next=jnp.concatenate(
+                [self.chains.next, jnp.full(pad, -1, dtype=jnp.int32)]),
+            live=jnp.concatenate(
+                [self.chains.live, jnp.zeros(pad, dtype=bool)]))
+
+    # -- ops --------------------------------------------------------------
+    def insert(self, key_lanes: jnp.ndarray, row_refs: np.ndarray,
+               vis: jnp.ndarray) -> None:
+        if len(row_refs):
+            self.reserve_rows(int(np.max(row_refs)))
+        slots = self.table.probe_insert(key_lanes, vis)
+        self.chains = _link_jit(self.chains, slots,
+                                jnp.asarray(row_refs), vis,
+                                self.table.capacity)
+
+    def delete(self, row_refs: np.ndarray, vis: jnp.ndarray) -> None:
+        self.chains = _tombstone_jit(self.chains, jnp.asarray(row_refs),
+                                     vis)
+
+    def probe(self, key_lanes: jnp.ndarray, vis: jnp.ndarray
+              ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(degrees, probe_idx[pairs], refs[pairs]) — one host sync."""
+        deg = np.asarray(_degrees_jit(self.table.state, self.chains,
+                                      key_lanes, vis))
+        total = int(deg.sum())
+        if total == 0:
+            z = np.zeros(0, dtype=np.int32)
+            return deg, z, z
+        offsets = np.cumsum(deg) - deg
+        from risingwave_tpu.common.chunk import next_pow2
+        # floor at 1024: collapses the 1..512 pow2 buckets into one jit
+        # entry — small probes dominate tests and warmup, and each
+        # distinct out_cap is a fresh XLA compile.
+        out_cap = max(1024, next_pow2(total))
+        op, orf = _emit_jit(self.table.state, self.chains, key_lanes, vis,
+                            jnp.asarray(offsets.astype(np.int32)), out_cap)
+        op = np.asarray(op)[:total]
+        orf = np.asarray(orf)[:total]
+        return deg, op, orf
+
+    # -- recovery ---------------------------------------------------------
+    def rebuild(self, key_lanes: np.ndarray, row_refs: np.ndarray) -> None:
+        """Reload all live rows (recovery): one batched insert."""
+        n = len(row_refs)
+        key_cap = max(self.table.capacity,
+                      ht.MIN_CAPACITY if n == 0 else
+                      1 << int(np.ceil(np.log2(max(n / ht.MAX_LOAD, 1)))))
+        row_cap = max(self.row_capacity,
+                      1 << int(np.ceil(np.log2(max(n + 1, 2)))))
+        self.table = ht.DeviceHashTable(self.key_width, key_cap)
+        self.table.on_grow(self._on_table_grow)
+        self.chains = ChainState(
+            head=jnp.full(self.table.capacity, -1, dtype=jnp.int32),
+            next=jnp.full(row_cap, -1, dtype=jnp.int32),
+            live=jnp.zeros(row_cap, dtype=bool))
+        if n == 0:
+            return
+        self.insert(jnp.asarray(key_lanes), row_refs,
+                    jnp.ones(n, dtype=bool))

@@ -32,12 +32,15 @@ from risingwave_tpu.common.chunk import (
     Column, Op, StreamChunk, next_pow2,
 )
 from risingwave_tpu.common.types import DataType, Field, Schema
-from risingwave_tpu.ops import lanes
 from risingwave_tpu.ops.hash_agg import (
     AggKind, AggSpec, GroupedAggKernel, acc_dtypes,
 )
 from risingwave_tpu.state.state_table import StateTable
 from risingwave_tpu.stream.executor import Executor, ExecutorInfo
+from risingwave_tpu.stream.executors.keys import (
+    LANES_PER_KEY as _LANES_PER_KEY, build_key_lanes, decode_key_lanes,
+    key_lanes_of_values,
+)
 from risingwave_tpu.stream.message import (
     Barrier, Message, Watermark, is_barrier, is_chunk, is_watermark,
 )
@@ -47,9 +50,6 @@ _SUM_OUT = {
     DataType.INT64: DataType.INT64, DataType.DECIMAL: DataType.DECIMAL,
     DataType.FLOAT32: DataType.FLOAT64, DataType.FLOAT64: DataType.FLOAT64,
 }
-
-# int32 lanes per group-key column: value hi, value lo, null flag
-_LANES_PER_KEY = 3
 
 
 @dataclass(frozen=True)
@@ -140,35 +140,8 @@ class HashAggExecutor(Executor):
             f"HashAggExecutor(actor={actor_id})"))
 
     # -- chunk path ------------------------------------------------------
-    @staticmethod
-    def _to_i64(vals: np.ndarray) -> np.ndarray:
-        """Column values → int64, bijective per distinct key.
-
-        Floats are bit-cast (1.2 and 1.7 are distinct groups) with -0.0
-        normalized so it groups with 0.0."""
-        if np.issubdtype(vals.dtype, np.floating):
-            vals = np.where(vals == 0, np.zeros((), dtype=vals.dtype), vals)
-            return vals.astype(np.float64).view(np.int64)
-        return vals.astype(np.int64)
-
     def _key_lanes(self, chunk: StreamChunk) -> jnp.ndarray:
-        n = chunk.capacity
-        out = np.empty((n, _LANES_PER_KEY * len(self.group_indices)),
-                       dtype=np.int32)
-        for j, i in enumerate(self.group_indices):
-            c = chunk.columns[i]
-            v64 = self._to_i64(np.asarray(c.values))
-            if c.validity is None:
-                ok = None
-            else:
-                ok = np.asarray(c.validity)
-                v64 = np.where(ok, v64, 0)
-            hi, lo = lanes.split_i64(v64)
-            out[:, _LANES_PER_KEY * j] = hi
-            out[:, _LANES_PER_KEY * j + 1] = lo
-            out[:, _LANES_PER_KEY * j + 2] = \
-                1 if ok is None else ok.astype(np.int32)
-        return jnp.asarray(out)
+        return jnp.asarray(build_key_lanes(chunk, self.group_indices))
 
     def _inputs(self, chunk: StreamChunk) -> Tuple:
         """Per call: (device input lanes, valid mask)."""
@@ -198,18 +171,7 @@ class HashAggExecutor(Executor):
     def _group_key_host(self, keys: np.ndarray
                         ) -> List[Tuple[np.ndarray, np.ndarray]]:
         """Key lanes → per group col (values in col dtype, valid mask)."""
-        cols = []
-        for j, dt in enumerate(self.group_types):
-            hi = keys[:, _LANES_PER_KEY * j]
-            lo = keys[:, _LANES_PER_KEY * j + 1]
-            ok = keys[:, _LANES_PER_KEY * j + 2] != 0
-            v64 = lanes.merge_i64(hi, lo)
-            if np.issubdtype(np.dtype(dt.np_dtype), np.floating):
-                vals = v64.view(np.float64).astype(dt.np_dtype)
-            else:
-                vals = v64.astype(dt.np_dtype)
-            cols.append((vals, ok))
-        return cols
+        return decode_key_lanes(keys, self.group_types)
 
     def _flush(self) -> Optional[StreamChunk]:
         fr = self.kernel.flush()
@@ -312,18 +274,7 @@ class HashAggExecutor(Executor):
         accs_l: List[tuple] = []
         ng = len(self.group_indices)
         for _pk, row in self.table.iter_rows():
-            lane = np.zeros(_LANES_PER_KEY * ng, dtype=np.int32)
-            for j in range(ng):
-                v = row[j]
-                if v is not None:
-                    dt = self.group_types[j]
-                    v64 = self._to_i64(
-                        np.asarray([v], dtype=dt.np_dtype))
-                    hi, lo = lanes.split_i64(v64)
-                    lane[_LANES_PER_KEY * j] = hi[0]
-                    lane[_LANES_PER_KEY * j + 1] = lo[0]
-                    lane[_LANES_PER_KEY * j + 2] = 1
-            keys_l.append(lane)
+            keys_l.append(key_lanes_of_values(row[:ng], self.group_types))
             rows_l.append(int(row[ng]))
             accs_l.append(row[ng + 1:])
         if not rows_l:

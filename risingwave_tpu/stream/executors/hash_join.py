@@ -1,0 +1,370 @@
+"""HashJoinExecutor: streaming two-sided equi-join (inner, q8 kernel).
+
+Reference parity: src/stream/src/executor/hash_join.rs:227 (executor),
+:697 (main loop over barrier-aligned sides), :990 (``eq_join_oneside``);
+state layout managed_state/join/mod.rs:228 (JoinHashMap). TPU re-design
+(ops/hash_join.py): the device owns the MATCH structure — key table +
+row chains probed as whole-batch kernels; the host owns row payloads
+(typed column arenas; varchar never ships to HBM) and materializes
+output chunks with vectorized gathers.
+
+Chunk lifecycle on side S (probing side O), mirroring eq_join_oneside:
+  1. probe every visible row of the chunk against O's current state
+     (two device passes: degrees, then pair emission at cumsum offsets)
+  2. emit matched rows: S columns gathered from the chunk, O columns
+     gathered from O's arena; Insert rows emit Insert matches, Delete
+     rows emit Delete matches (update pairs degrade to Delete+Insert —
+     the reference degrades split pairs the same way)
+  3. apply the chunk to S's own state: inserts allocate arena refs and
+     front-link into the device chains; deletes tombstone
+  4. barrier: both sides' StateTables commit (rows were written through
+     write_chunk as they flowed); recovery rebuilds arena + chains
+
+Inner-join NULL semantics: rows whose join key contains NULL can never
+match and are not stored (the reference's null-safe flag is per-column;
+non-null-safe is the SQL default). Degree tables for outer joins are the
+next increment.
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator, Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.common.chunk import Column, Op, StreamChunk, next_pow2
+from risingwave_tpu.common.types import Field, Schema
+from risingwave_tpu.ops.hash_join import JoinSideKernel
+from risingwave_tpu.state.state_table import StateTable
+from risingwave_tpu.stream.executor import Executor, ExecutorInfo
+from risingwave_tpu.stream.merge import barrier_align_2
+from risingwave_tpu.stream.executors.keys import (
+    LANES_PER_KEY, build_key_lanes, build_key_lanes_arrays,
+    key_lanes_of_values,
+)
+from risingwave_tpu.stream.message import Message, is_barrier
+
+
+class _Arena:
+    """Host row store: typed column arrays indexed by device row refs."""
+
+    def __init__(self, schema: Schema, capacity: int = 1024):
+        self.schema = schema
+        self.cap = capacity
+        self.cols: List[np.ndarray] = []
+        self.valid: List[np.ndarray] = []
+        for f in schema:
+            dt = f.data_type
+            self.cols.append(
+                np.zeros(capacity, dtype=dt.np_dtype) if dt.is_device
+                else np.empty(capacity, dtype=object))
+            self.valid.append(np.ones(capacity, dtype=bool))
+
+    def ensure(self, max_ref: int) -> None:
+        if max_ref < self.cap:
+            return
+        new_cap = self.cap
+        while new_cap <= max_ref:
+            new_cap *= 2
+        for i, c in enumerate(self.cols):
+            grown = np.zeros(new_cap, dtype=c.dtype) if c.dtype != object \
+                else np.empty(new_cap, dtype=object)
+            grown[:self.cap] = c
+            self.cols[i] = grown
+            v = np.ones(new_cap, dtype=bool)
+            v[:self.cap] = self.valid[i]
+            self.valid[i] = v
+        self.cap = new_cap
+
+    def store(self, refs: np.ndarray, chunk: StreamChunk,
+              row_idx: np.ndarray) -> None:
+        if not len(refs):
+            return
+        self.ensure(int(refs.max()))
+        for i, c in enumerate(chunk.columns):
+            vals = np.asarray(c.values)[row_idx]
+            self.cols[i][refs] = vals
+            self.valid[i][refs] = True if c.validity is None else \
+                np.asarray(c.validity)[row_idx]
+
+    def gather(self, refs: np.ndarray, out_cap: int
+               ) -> List[Column]:
+        out = []
+        for f, c, v in zip(self.schema, self.cols, self.valid):
+            vals = np.zeros(out_cap, dtype=c.dtype) if c.dtype != object \
+                else np.empty(out_cap, dtype=object)
+            vals[:len(refs)] = c[refs]
+            ok = np.ones(out_cap, dtype=bool)
+            ok[:len(refs)] = v[refs]
+            out.append(Column(f.data_type, vals,
+                              None if ok.all() else ok))
+        return out
+
+
+class _JoinSide:
+    """One side's state: device matcher + host arena + durability."""
+
+    def __init__(self, schema: Schema, key_indices: Sequence[int],
+                 pk_indices: Sequence[int], table: StateTable):
+        self.schema = schema
+        self.key_indices = list(key_indices)
+        self.pk_indices = list(pk_indices)
+        self.key_types = [schema[i].data_type for i in self.key_indices]
+        for dt in self.key_types:
+            if not dt.is_device:
+                raise TypeError(f"join key type {dt} not device-hashable")
+        self.table = table
+        self.kernel = JoinSideKernel(
+            key_width=LANES_PER_KEY * len(self.key_indices))
+        self.arena = _Arena(schema)
+        self.pk_to_ref: Dict[tuple, int] = {}
+        self.free: List[int] = []
+        self.next_ref = 0
+
+    def alloc_refs(self, k: int) -> np.ndarray:
+        """Bump allocation ONLY: a tombstoned ref stays linked in its
+        chain (deletes unlink lazily), so reusing it would splice its
+        node into a second chain and create cycles. Dead refs are
+        reclaimed wholesale when the arena is rebuilt (recovery /
+        future compaction); `self.free` tracks the reclaimable count."""
+        out = np.arange(self.next_ref, self.next_ref + k, dtype=np.int32)
+        self.next_ref += k
+        return out
+
+    def key_nonnull_mask(self, chunk: StreamChunk) -> np.ndarray:
+        m = np.ones(chunk.capacity, dtype=bool)
+        for i in self.key_indices:
+            c = chunk.columns[i]
+            if c.validity is not None:
+                m &= np.asarray(c.validity)
+        return m
+
+    def apply_chunk(self, chunk: StreamChunk,
+                    key_lanes: np.ndarray) -> None:
+        """Update this side's state with the chunk's inserts/deletes.
+
+        pk→ref bookkeeping runs in ROW ORDER (a delete refers to the
+        latest same-pk version, which may be an insert earlier in this
+        very chunk — update pairs land as [U-, U+] with one pk). The
+        device calls stay whole-batch: tombstoning and front-linking
+        commute once each delete has resolved to the right ref."""
+        vis = np.asarray(chunk.visibility)
+        storable = vis & self.key_nonnull_mask(chunk)
+        ops = np.asarray(chunk.ops)
+        is_ins = (ops == int(Op.INSERT)) | (ops == int(Op.UPDATE_INSERT))
+        ins_idx = np.flatnonzero(storable & is_ins)
+        # pk extraction for the host map
+        pk_cols = []
+        for i in self.pk_indices:
+            c = chunk.columns[i]
+            vals = np.asarray(c.values)
+            ok = None if c.validity is None else np.asarray(c.validity)
+            pk_cols.append((vals, ok))
+
+        def pk_of(r: int) -> tuple:
+            return tuple(
+                None if (ok is not None and not ok[r])
+                else (vals[r].item() if hasattr(vals[r], "item")
+                      else vals[r])
+                for vals, ok in pk_cols)
+
+        ins_refs = self.alloc_refs(len(ins_idx))
+        ins_pos = {int(r): j for j, r in enumerate(ins_idx)}
+        del_refs = np.zeros(chunk.capacity, dtype=np.int32)
+        del_mask = np.zeros(chunk.capacity, dtype=bool)
+        for r in np.flatnonzero(storable).tolist():
+            if r in ins_pos:
+                self.pk_to_ref[pk_of(r)] = int(ins_refs[ins_pos[r]])
+            else:
+                ref = self.pk_to_ref.pop(pk_of(r), None)
+                if ref is None:
+                    continue   # delete of unseen row (inconsistent op)
+                del_refs[r] = ref
+                del_mask[r] = True
+                self.free.append(ref)
+        if len(ins_idx):
+            self.arena.store(ins_refs, chunk, ins_idx)
+            full_refs = np.zeros(chunk.capacity, dtype=np.int32)
+            full_refs[ins_idx] = ins_refs
+            mask = np.zeros(chunk.capacity, dtype=bool)
+            mask[ins_idx] = True
+            self.kernel.insert(jnp.asarray(key_lanes), full_refs,
+                               jnp.asarray(mask))
+        if del_mask.any():
+            self.kernel.delete(del_refs, jnp.asarray(del_mask))
+        self.table.write_chunk(chunk)
+
+    # dead-ref fraction of the arena that triggers a compaction; dead
+    # refs cannot be recycled in place (see alloc_refs), so churn-heavy
+    # streams (update pairs every epoch) reclaim them wholesale here
+    COMPACT_DEAD_RATIO = 0.5
+    COMPACT_MIN_REFS = 4096
+
+    def maybe_compact(self) -> bool:
+        if (self.next_ref < self.COMPACT_MIN_REFS
+                or len(self.free) < self.COMPACT_DEAD_RATIO * self.next_ref):
+            return False
+        self.compact()
+        return True
+
+    def compact(self) -> None:
+        """Rebuild arena + device state with only live rows (dense refs)."""
+        live = np.fromiter(self.pk_to_ref.values(), dtype=np.int64,
+                           count=len(self.pk_to_ref))
+        n = len(live)
+        new_arena = _Arena(self.schema,
+                           capacity=max(1024, next_pow2(max(n, 1))))
+        for i in range(len(self.schema)):
+            new_arena.cols[i][:n] = self.arena.cols[i][live]
+            new_arena.valid[i][:n] = self.arena.valid[i][live]
+        self.arena = new_arena
+        new_refs = np.arange(n, dtype=np.int32)
+        self.pk_to_ref = dict(zip(self.pk_to_ref.keys(), new_refs.tolist()))
+        self.free = []
+        self.next_ref = n
+        if n:
+            key_cols = [(self.arena.cols[i][:n], self.arena.valid[i][:n])
+                        for i in self.key_indices]
+            self.kernel.rebuild(build_key_lanes_arrays(key_cols), new_refs)
+        else:
+            self.kernel.rebuild(
+                np.zeros((0, LANES_PER_KEY * len(self.key_indices)),
+                         dtype=np.int32),
+                new_refs)
+
+    def recover(self) -> None:
+        keys_l, refs_l = [], []
+        rows: List[tuple] = []
+        for _pk, row in self.table.iter_rows():
+            rows.append(row)
+        if not rows:
+            return
+        n = len(rows)
+        refs = self.alloc_refs(n)
+        self.arena.ensure(int(refs.max()))
+        for i, f in enumerate(self.schema):
+            col_vals = [r[i] for r in rows]
+            if f.data_type.is_device:
+                ok = np.asarray([v is not None for v in col_vals])
+                vals = np.asarray(
+                    [0 if v is None else v for v in col_vals],
+                    dtype=f.data_type.np_dtype)
+                self.arena.cols[i][refs] = vals
+                self.arena.valid[i][refs] = ok
+            else:
+                self.arena.cols[i][refs] = np.asarray(col_vals,
+                                                      dtype=object)
+        for row, ref in zip(rows, refs.tolist()):
+            pk = tuple(row[i] for i in self.pk_indices)
+            self.pk_to_ref[pk] = ref
+            keys_l.append(key_lanes_of_values(
+                [row[i] for i in self.key_indices], self.key_types))
+        # rows with NULL join keys were never stored on device
+        keep = [j for j, row in enumerate(rows)
+                if all(row[i] is not None for i in self.key_indices)]
+        if keep:
+            self.kernel.rebuild(np.stack([keys_l[j] for j in keep]),
+                                refs[keep])
+
+
+class HashJoinExecutor(Executor):
+    """Streaming inner equi-join (hash_join.rs:227, device matcher)."""
+
+    def __init__(self, left: Executor, right: Executor,
+                 left_keys: Sequence[int], right_keys: Sequence[int],
+                 left_table: StateTable, right_table: StateTable,
+                 actor_id: int = 0,
+                 output_names: Optional[Sequence[str]] = None):
+        assert len(left_keys) == len(right_keys)
+        self.left_in, self.right_in = left, right
+        self.sides = (
+            _JoinSide(left.schema, left_keys, left_table.pk_indices,
+                      left_table),
+            _JoinSide(right.schema, right_keys, right_table.pk_indices,
+                      right_table),
+        )
+        fields: List[Field] = []
+        names = list(output_names) if output_names else None
+        k = 0
+        for sch in (left.schema, right.schema):
+            for f in sch:
+                name = names[k] if names else f.name
+                fields.append(Field(name, f.data_type))
+                k += 1
+        out_schema = Schema(fields)
+        # output pk: both sides' pks (joined row identity)
+        n_left = len(left.schema)
+        pk = list(left_table.pk_indices) + \
+            [n_left + i for i in right_table.pk_indices]
+        super().__init__(ExecutorInfo(
+            out_schema, pk, f"HashJoinExecutor(actor={actor_id})"))
+
+    # -- emission ---------------------------------------------------------
+    def _emit(self, side_idx: int, chunk: StreamChunk,
+              key_lanes: np.ndarray) -> Optional[StreamChunk]:
+        """Probe the OTHER side and build the matched output chunk."""
+        me = self.sides[side_idx]
+        other = self.sides[1 - side_idx]
+        vis = np.asarray(chunk.visibility) & me.key_nonnull_mask(chunk)
+        if not vis.any():
+            return None
+        _deg, probe_idx, refs = other.kernel.probe(
+            jnp.asarray(key_lanes), jnp.asarray(vis))
+        t = len(probe_idx)
+        if t == 0:
+            return None
+        cap = next_pow2(t)
+        # my columns: gathered from the incoming chunk
+        my_cols: List[Column] = []
+        for f, c in zip(me.schema, chunk.columns):
+            src = np.asarray(c.values)[probe_idx]
+            vals = np.zeros(cap, dtype=src.dtype) if src.dtype != object \
+                else np.empty(cap, dtype=object)
+            vals[:t] = src
+            ok = np.ones(cap, dtype=bool)
+            if c.validity is not None:
+                ok[:t] = np.asarray(c.validity)[probe_idx]
+            my_cols.append(Column(f.data_type, vals,
+                                  None if ok.all() else ok))
+        other_cols = other.arena.gather(refs, cap)
+        columns = my_cols + other_cols if side_idx == 0 \
+            else other_cols + my_cols
+        # ops: degrade update pairs (split halves) to Delete/Insert
+        in_ops = np.asarray(chunk.ops)[probe_idx]
+        is_ins = (in_ops == int(Op.INSERT)) | \
+            (in_ops == int(Op.UPDATE_INSERT))
+        ops = np.full(cap, int(Op.INSERT), dtype=np.int8)
+        ops[:t] = np.where(is_ins, int(Op.INSERT), int(Op.DELETE))
+        out_vis = np.zeros(cap, dtype=bool)
+        out_vis[:t] = True
+        return StreamChunk(self.schema, columns, out_vis, ops)
+
+    # -- main loop --------------------------------------------------------
+    async def execute(self) -> AsyncIterator[Message]:
+        lit = self.left_in.execute()
+        rit = self.right_in.execute()
+        first_l = await lit.__anext__()
+        first_r = await rit.__anext__()
+        assert is_barrier(first_l) and is_barrier(first_r)
+        assert first_l.epoch == first_r.epoch
+        for side in self.sides:
+            side.table.init_epoch(first_l.epoch)
+            side.recover()
+        yield first_l
+        async for tag, msg in barrier_align_2(lit, rit):
+            if tag == "barrier":
+                for side in self.sides:
+                    side.table.commit(msg.epoch)
+                    side.maybe_compact()
+                yield msg
+            elif tag in ("left", "right"):
+                if isinstance(msg, StreamChunk):
+                    i = 0 if tag == "left" else 1
+                    lanes_np = build_key_lanes(
+                        msg, self.sides[i].key_indices)
+                    out = self._emit(i, msg, lanes_np)
+                    if out is not None:
+                        yield out
+                    self.sides[i].apply_chunk(msg, lanes_np)
+                # watermarks: forwarded only for join-key cols — deferred

@@ -1,0 +1,92 @@
+"""Shared key-lane building: chunk columns → int32 device key lanes.
+
+The device key contract (ops/hash_table.py): every key column becomes
+three int32 lanes — (hi, lo) bijective split of a 64-bit image of the
+value plus a null-indicator lane (NULL is a distinct key, matching the
+reference's group/join key semantics). Used by HashAgg group keys and
+HashJoin join keys; host twin of the dispatch hashing.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from risingwave_tpu.common.chunk import StreamChunk
+from risingwave_tpu.common.types import DataType
+from risingwave_tpu.ops import lanes
+
+LANES_PER_KEY = 3
+
+
+def to_i64(vals: np.ndarray) -> np.ndarray:
+    """Column values → int64, bijective per distinct key.
+
+    Floats are bit-cast (1.2 and 1.7 are distinct keys) with -0.0
+    normalized so it groups with 0.0."""
+    if np.issubdtype(vals.dtype, np.floating):
+        vals = np.where(vals == 0, np.zeros((), dtype=vals.dtype), vals)
+        return vals.astype(np.float64).view(np.int64)
+    return vals.astype(np.int64)
+
+
+def build_key_lanes(chunk: StreamChunk,
+                    indices: Sequence[int]) -> np.ndarray:
+    """int32[capacity, 3*len(indices)] key lanes for the device kernels."""
+    cols = []
+    for i in indices:
+        c = chunk.columns[i]
+        cols.append((np.asarray(c.values),
+                     None if c.validity is None
+                     else np.asarray(c.validity)))
+    return build_key_lanes_arrays(cols)
+
+
+def build_key_lanes_arrays(
+        cols: Sequence[Tuple[np.ndarray, np.ndarray]]) -> np.ndarray:
+    """(values, valid|None) pairs → int32[n, 3*len(cols)] key lanes."""
+    n = len(cols[0][0])
+    out = np.empty((n, LANES_PER_KEY * len(cols)), dtype=np.int32)
+    for j, (vals, ok) in enumerate(cols):
+        v64 = to_i64(vals)
+        if ok is not None:
+            v64 = np.where(ok, v64, 0)
+        hi, lo = lanes.split_i64(v64)
+        out[:, LANES_PER_KEY * j] = hi
+        out[:, LANES_PER_KEY * j + 1] = lo
+        out[:, LANES_PER_KEY * j + 2] = \
+            1 if ok is None else ok.astype(np.int32)
+    return out
+
+
+def key_lanes_of_values(values: Sequence, types: Sequence[DataType]
+                        ) -> np.ndarray:
+    """One logical key tuple → int32[3*k] lanes (recovery path)."""
+    lane = np.zeros(LANES_PER_KEY * len(types), dtype=np.int32)
+    for j, (v, dt) in enumerate(zip(values, types)):
+        if v is None:
+            continue
+        v64 = to_i64(np.asarray([v], dtype=dt.np_dtype))
+        hi, lo = lanes.split_i64(v64)
+        lane[LANES_PER_KEY * j] = hi[0]
+        lane[LANES_PER_KEY * j + 1] = lo[0]
+        lane[LANES_PER_KEY * j + 2] = 1
+    return lane
+
+
+def decode_key_lanes(keys: np.ndarray, types: Sequence[DataType]
+                     ) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Key-lane matrix → per key col (values in col dtype, valid mask)."""
+    cols = []
+    for j, dt in enumerate(types):
+        hi = keys[:, LANES_PER_KEY * j]
+        lo = keys[:, LANES_PER_KEY * j + 1]
+        ok = keys[:, LANES_PER_KEY * j + 2] != 0
+        v64 = lanes.merge_i64(hi, lo)
+        if np.issubdtype(np.dtype(dt.np_dtype), np.floating):
+            vals = v64.view(np.float64).astype(dt.np_dtype)
+        else:
+            vals = v64.astype(dt.np_dtype)
+        cols.append((vals, ok))
+    return cols

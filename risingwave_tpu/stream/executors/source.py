@@ -108,16 +108,22 @@ class SourceExecutor(Executor):
         exhausted = False
         chunks_this_epoch = 0
         while True:
-            # barrier always wins the select: check the channel first
+            # barrier wins the select — except for the FIRST chunk of an
+            # epoch, which is generated before looking at the channel.
+            # Without that progress guarantee, back-to-back barrier
+            # injection (collect → inject with no interval, the test/bench
+            # driving pattern) can starve the stream forever: every
+            # try_recv finds the next barrier already waiting.
             barrier: Optional[Barrier] = None
-            if self.paused or exhausted or (
-                    self.rate_limit is not None
-                    and chunks_this_epoch >= self.rate_limit):
+            can_generate = not (self.paused or exhausted or (
+                self.rate_limit is not None
+                and chunks_this_epoch >= self.rate_limit))
+            if not can_generate:
                 try:
                     barrier = await self.barrier_rx.recv()  # blocking
                 except ChannelClosed:
                     return
-            else:
+            elif chunks_this_epoch > 0:
                 try:
                     barrier = self.barrier_rx.try_recv()
                 except ChannelClosed:

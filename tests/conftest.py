@@ -24,6 +24,14 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the kernel tests compile many
+# (capacity, width, chunk) shape buckets; without a disk cache every
+# pytest invocation recompiles all of them from scratch.
+_CACHE_DIR = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.abspath(_CACHE_DIR))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.25)
+
 import pytest  # noqa: E402
 
 

@@ -5,7 +5,8 @@ q1 (currency conversion, stateless):
 
 Reference parity: e2e_test/streaming/nexmark/q1 semantics; pipeline shape
 mirrors §3.1-3.2 of SURVEY.md — source → project → materialize driven by
-the barrier loop, results read from the MV's committed snapshot.
+the barrier loop, results read from the MV's committed snapshot. The plan
+itself lives in risingwave_tpu.models.nexmark (shared with bench.py).
 """
 
 import asyncio
@@ -13,81 +14,24 @@ import decimal
 
 import numpy as np
 
-from risingwave_tpu.common.types import DataType, Field, Schema
-from risingwave_tpu.connectors.nexmark import (
-    NexmarkConfig, NexmarkSplitReader, gen_bids,
-)
-from risingwave_tpu.expr.expr import InputRef, lit
-from risingwave_tpu.meta.barrier import BarrierLoop
-from risingwave_tpu.state.state_table import StateTable
+from risingwave_tpu.connectors.nexmark import NexmarkConfig, gen_bids
+from risingwave_tpu.models.nexmark import build_q1, drive_to_completion
 from risingwave_tpu.state.store import MemoryStateStore
-from risingwave_tpu.stream.actor import Actor, LocalBarrierManager
-from risingwave_tpu.stream.exchange import channel_for_test
-from risingwave_tpu.stream.executors.materialize import MaterializeExecutor
-from risingwave_tpu.stream.executors.row_id_gen import RowIdGenExecutor
-from risingwave_tpu.stream.executors.simple import ProjectExecutor
-from risingwave_tpu.stream.executors.source import SourceExecutor
-from risingwave_tpu.stream.message import StopMutation
-
-SPLIT_STATE_SCHEMA = Schema([Field("split_id", DataType.VARCHAR),
-                             Field("offset", DataType.INT64)])
-
-
-def build_q1(store, cfg):
-    """Hand-built q1 plan (the fragmenter arrives with the frontend)."""
-    reader = NexmarkSplitReader(cfg)
-    barrier_tx, barrier_rx = channel_for_test()
-    split_state = StateTable(1, SPLIT_STATE_SCHEMA, [0], store)
-    source = SourceExecutor(reader, barrier_rx, split_state, actor_id=1,
-                            rate_limit_chunks_per_barrier=3)
-    row_id = RowIdGenExecutor(source)
-    s = row_id.schema
-    project = ProjectExecutor(
-        row_id,
-        exprs=[InputRef(s.index_of("auction"), DataType.INT64),
-               InputRef(s.index_of("bidder"), DataType.INT64),
-               lit("0.908", DataType.DECIMAL)
-               * InputRef(s.index_of("price"), DataType.INT64),
-               InputRef(s.index_of("date_time"), DataType.TIMESTAMP),
-               InputRef(s.index_of("_row_id"), DataType.SERIAL)],
-        names=["auction", "bidder", "price", "date_time", "_row_id"])
-    mv_table = StateTable(2, project.schema, [4], store)  # pk = _row_id
-    mat = MaterializeExecutor(project, mv_table)
-    local = LocalBarrierManager()
-    local.register_sender(1, barrier_tx)
-    local.set_expected_actors([1])
-    actor = Actor(1, mat, dispatchers=[], barrier_manager=local)
-    loop = BarrierLoop(local, store)
-    return actor, loop, mv_table, reader
 
 
 def test_q1_end_to_end():
     n_epochs = 100
     cfg = NexmarkConfig(event_num=50 * n_epochs, max_chunk_size=512)
-
-    async def main():
-        store = MemoryStateStore()
-        actor, loop, mv_table, reader = build_q1(store, cfg)
-        task = actor.spawn()
-        # barrier-drive until the bounded source is fully drained (the
-        # 3-chunks-per-barrier rate limit spreads it over ≥3 epochs), then
-        # a final checkpoint covers the tail, then stop
-        while reader.offset * 1 < 46 * n_epochs:
-            await loop.inject_and_collect()
-        await loop.inject_and_collect()
-        await loop.inject_and_collect(mutation=StopMutation(frozenset([1])))
-        await task
-        assert actor.failure is None, actor.failure
-        return store, mv_table, loop
-
-    store, mv_table, loop = asyncio.run(main())
-    assert len(loop.stats.completed_epochs) >= 4
+    pipeline = build_q1(MemoryStateStore(), cfg)
+    n_bids = 46 * n_epochs
+    asyncio.run(drive_to_completion(pipeline, {1: n_bids}))
+    loop, mv_table = pipeline.loop, pipeline.mv_table
+    assert len(loop.stats.completed_epochs) >= 2
 
     # read the MV snapshot, compare against a direct-computed oracle
     from risingwave_tpu.state.state_table import to_logical_row
     got = [to_logical_row(row, mv_table.schema)
            for _pk, row in mv_table.iter_rows()]
-    n_bids = 46 * n_epochs
     k = np.arange(n_bids, dtype=np.int64)
     bids = gen_bids(k, cfg)
     rate = decimal.Decimal("0.908")

@@ -9,7 +9,8 @@ baseline config; full q7's self-join lands with HashJoinExecutor):
 
 Reference parity: e2e_test/streaming/nexmark/q7.slt.part semantics;
 pipeline shape per SURVEY §3.2 — source → project(tumble) → hash-agg
-(device kernel) → materialize, driven by the barrier loop.
+(device kernel) → materialize, driven by the barrier loop. The plan
+itself lives in risingwave_tpu.models.nexmark (shared with bench.py).
 """
 
 import asyncio
@@ -17,59 +18,13 @@ from collections import defaultdict
 
 import numpy as np
 
-from risingwave_tpu.common.types import DataType, Field, Interval, Schema
-from risingwave_tpu.connectors.nexmark import (
-    NexmarkConfig, NexmarkSplitReader, gen_bids,
+from risingwave_tpu.connectors.nexmark import NexmarkConfig, gen_bids
+from risingwave_tpu.models.nexmark import (
+    DEFAULT_WINDOW, build_q7, drive_to_completion,
 )
-from risingwave_tpu.expr.expr import InputRef, tumble_start
-from risingwave_tpu.meta.barrier import BarrierLoop
-from risingwave_tpu.ops.hash_agg import AggKind
-from risingwave_tpu.state.state_table import StateTable
 from risingwave_tpu.state.store import MemoryStateStore
-from risingwave_tpu.stream.actor import Actor, LocalBarrierManager
-from risingwave_tpu.stream.exchange import channel_for_test
-from risingwave_tpu.stream.executors.hash_agg import (
-    AggCall, HashAggExecutor, agg_state_schema,
-)
-from risingwave_tpu.stream.executors.materialize import MaterializeExecutor
-from risingwave_tpu.stream.executors.simple import ProjectExecutor
-from risingwave_tpu.stream.executors.source import SourceExecutor
-from risingwave_tpu.stream.message import StopMutation
 
-SPLIT_STATE_SCHEMA = Schema([Field("split_id", DataType.VARCHAR),
-                             Field("offset", DataType.INT64)])
-WINDOW = Interval(usecs=10_000_000)   # 10 seconds
-
-
-def build_q7(store, cfg):
-    """Hand-built q7-core plan (fragmenter arrives with the frontend)."""
-    reader = NexmarkSplitReader(cfg)
-    barrier_tx, barrier_rx = channel_for_test()
-    split_state = StateTable(1, SPLIT_STATE_SCHEMA, [0], store)
-    source = SourceExecutor(reader, barrier_rx, split_state, actor_id=1,
-                            rate_limit_chunks_per_barrier=4)
-    s = source.schema
-    project = ProjectExecutor(
-        source,
-        exprs=[tumble_start(
-            InputRef(s.index_of("date_time"), DataType.TIMESTAMP), WINDOW),
-            InputRef(s.index_of("price"), DataType.INT64)],
-        names=["window_start", "price"])
-    calls = [AggCall(AggKind.MAX, 1), AggCall(AggKind.COUNT)]
-    agg_schema, agg_pk = agg_state_schema(project.schema, [0], calls)
-    agg_state = StateTable(2, agg_schema, agg_pk, store,
-                           dist_key_indices=[0])
-    agg = HashAggExecutor(project, [0], calls, agg_state,
-                          append_only=True,
-                          output_names=["max_price", "bid_count"])
-    mv_table = StateTable(3, agg.schema, [0], store)  # pk = window_start
-    mat = MaterializeExecutor(agg, mv_table)
-    local = LocalBarrierManager()
-    local.register_sender(1, barrier_tx)
-    local.set_expected_actors([1])
-    actor = Actor(1, mat, dispatchers=[], barrier_manager=local)
-    loop = BarrierLoop(local, store)
-    return actor, loop, mv_table, reader
+WINDOW = DEFAULT_WINDOW
 
 
 def q7_oracle(cfg, n_bids):
@@ -88,23 +43,13 @@ def test_q7_end_to_end():
     # ~3 windows over the whole run: gap 100µs ⇒ 10s window = 100K events
     cfg = NexmarkConfig(event_num=50 * 50 * n_epochs, max_chunk_size=1024,
                         min_event_gap_in_ns=100_000_000)  # 0.1s/event
-
-    async def main():
-        store = MemoryStateStore()
-        actor, loop, mv_table, reader = build_q7(store, cfg)
-        task = actor.spawn()
-        while reader.offset < 46 * 50 * n_epochs:
-            await loop.inject_and_collect()
-        await loop.inject_and_collect()
-        await loop.inject_and_collect(mutation=StopMutation(frozenset([1])))
-        await task
-        assert actor.failure is None, actor.failure
-        return store, mv_table, loop
-
-    store, mv_table, loop = asyncio.run(main())
+    pipeline = build_q7(MemoryStateStore(), cfg)
+    n_bids = 46 * 50 * n_epochs
+    asyncio.run(drive_to_completion(pipeline, {1: n_bids}))
+    loop, mv_table = pipeline.loop, pipeline.mv_table
     assert len(loop.stats.completed_epochs) >= 3
 
     got = {row[0]: (row[1], row[2]) for _pk, row in mv_table.iter_rows()}
-    expect = q7_oracle(cfg, 46 * 50 * n_epochs)
+    expect = q7_oracle(cfg, n_bids)
     assert len(got) > 3   # several windows
     assert got == expect

@@ -1,0 +1,299 @@
+"""HashJoin: device matcher vs dict oracle; executor vs a changelog oracle.
+
+Mirrors the inner-join cases of the reference's hash_join tests
+(src/stream/src/executor/hash_join.rs test mod): scripted chunks on both
+sides through barrier alignment, emitted changelog asserted against a
+recomputed join, including retractions and N:M matches.
+"""
+
+import asyncio
+from collections import Counter, defaultdict
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from risingwave_tpu.common.chunk import Op, StreamChunk
+from risingwave_tpu.common.epoch import Epoch, EpochPair
+from risingwave_tpu.common.types import DataType, Schema
+from risingwave_tpu.ops.hash_join import JoinSideKernel
+from risingwave_tpu.state.state_table import StateTable
+from risingwave_tpu.state.store import MemoryStateStore
+from risingwave_tpu.stream.executors.hash_join import HashJoinExecutor
+from risingwave_tpu.stream.executors.test_utils import (
+    MockSource, collect_until_n_barriers,
+)
+from risingwave_tpu.stream.message import Barrier, BarrierKind, is_chunk
+
+L_SCHEMA = Schema.of(lk=DataType.INT64, lv=DataType.INT64)
+R_SCHEMA = Schema.of(rk=DataType.INT64, rv=DataType.VARCHAR)
+
+
+def barrier(n: int) -> Barrier:
+    prev = Epoch.from_physical(n - 1) if n > 1 else Epoch.INVALID
+    return Barrier(EpochPair(Epoch.from_physical(n), prev),
+                   BarrierKind.CHECKPOINT)
+
+
+# -- kernel-level oracle ------------------------------------------------
+
+
+def test_join_kernel_chains_and_probe():
+    k = JoinSideKernel(key_width=1)
+    keys = jnp.asarray([[5], [5], [7], [5]], dtype=jnp.int32)
+    refs = np.asarray([0, 1, 2, 3], dtype=np.int32)
+    k.insert(keys, refs, jnp.ones(4, dtype=bool))
+    deg, _pidx, _prefs = k.probe(
+        jnp.asarray([[5], [7], [9]], dtype=jnp.int32),
+        jnp.ones(3, dtype=bool))
+    assert deg.tolist() == [3, 1, 0]
+    # tombstone one of the key-5 rows
+    k.delete(np.asarray([1], dtype=np.int32), jnp.ones(1, dtype=bool))
+    deg, _pidx, _prefs = k.probe(
+        jnp.asarray([[5]], dtype=jnp.int32), jnp.ones(1, dtype=bool))
+    assert deg.tolist() == [2]
+
+
+def test_join_kernel_random_oracle():
+    rng = np.random.default_rng(9)
+    k = JoinSideKernel(key_width=1)
+    oracle = defaultdict(set)       # key → set of live refs
+    ref_of_row = {}
+    next_ref = 0
+    for _round in range(6):
+        n = 64
+        keys = rng.integers(0, 12, n).astype(np.int32).reshape(-1, 1)
+        ins_mask = np.ones(n, dtype=bool)
+        refs = np.arange(next_ref, next_ref + n, dtype=np.int32)
+        next_ref += n
+        k.insert(jnp.asarray(keys), refs, jnp.asarray(ins_mask))
+        for i in range(n):
+            oracle[int(keys[i, 0])].add(int(refs[i]))
+            ref_of_row[int(refs[i])] = int(keys[i, 0])
+        # random deletes
+        live = [r for s in oracle.values() for r in s]
+        kill = rng.choice(live, size=min(20, len(live)), replace=False)
+        k.delete(np.asarray(kill, dtype=np.int32),
+                 jnp.ones(len(kill), dtype=bool))
+        for r in kill:
+            oracle[ref_of_row[int(r)]].discard(int(r))
+        probe_keys = np.arange(14, dtype=np.int32).reshape(-1, 1)
+        deg, pidx, prefs = k.probe(jnp.asarray(probe_keys),
+                                   jnp.ones(14, dtype=bool))
+        assert deg.tolist() == [len(oracle[int(q)]) for q in range(14)]
+        got = defaultdict(set)
+        for p, r in zip(pidx.tolist(), prefs.tolist()):
+            got[int(probe_keys[p, 0])].add(r)
+        for q in range(14):
+            assert got[q] == oracle[q], f"key {q}"
+
+
+# -- executor-level oracle ----------------------------------------------
+
+
+class JoinOracle:
+    """Maintains both sides + the expected inner-join multiset."""
+
+    def __init__(self):
+        self.left = []     # (lk, lv)
+        self.right = []    # (rk, rv)
+
+    def view(self) -> Counter:
+        out = Counter()
+        for lk, lv in self.left:
+            if lk is None:
+                continue
+            for rk, rv in self.right:
+                if rk == lk:
+                    out[(lk, lv, rk, rv)] += 1
+        return out
+
+
+def materialize_join(msgs) -> Counter:
+    view = Counter()
+    for m in msgs:
+        if not is_chunk(m):
+            continue
+        for op, row in m.to_records():
+            if op.is_insert:
+                view[row] += 1
+            else:
+                view[row] -= 1
+                assert view[row] >= 0, f"negative count for {row}"
+    return +view
+
+
+def run_join(script_l, script_r, n_barriers):
+    store = MemoryStateStore()
+    lt = StateTable(21, L_SCHEMA, [1], store, dist_key_indices=[])
+    rt = StateTable(22, R_SCHEMA, [1], store, dist_key_indices=[])
+    ex = HashJoinExecutor(
+        MockSource(L_SCHEMA, script_l), MockSource(R_SCHEMA, script_r),
+        left_keys=[0], right_keys=[0], left_table=lt, right_table=rt)
+    msgs = asyncio.run(collect_until_n_barriers(ex, n_barriers))
+    return msgs, (lt, rt, store)
+
+
+def lchunk(ks, vs, ops=None):
+    return StreamChunk.from_pydict(L_SCHEMA, {"lk": ks, "lv": vs}, ops=ops)
+
+
+def rchunk(ks, vs, ops=None):
+    return StreamChunk.from_pydict(R_SCHEMA, {"rk": ks, "rv": vs}, ops=ops)
+
+
+def test_inner_join_basic_both_sides():
+    script_l = [barrier(1), lchunk([1, 2], [10, 20]), barrier(2),
+                lchunk([1], [11]), barrier(3)]
+    script_r = [barrier(1), rchunk([1, 3], ["a", "c"]), barrier(2),
+                rchunk([2], ["b"]), barrier(3)]
+    msgs, _ = run_join(script_l, script_r, 3)
+    oracle = JoinOracle()
+    oracle.left = [(1, 10), (2, 20), (1, 11)]
+    oracle.right = [(1, "a"), (3, "c"), (2, "b")]
+    assert materialize_join(msgs) == oracle.view()
+
+
+def test_inner_join_retraction():
+    script_l = [barrier(1), lchunk([1, 1], [10, 11]), barrier(2),
+                lchunk([1], [10], ops=[Op.DELETE]), barrier(3)]
+    script_r = [barrier(1), rchunk([1], ["a"]), barrier(2),
+                rchunk([], []), barrier(3)]
+    msgs, _ = run_join(script_l, script_r, 3)
+    view = materialize_join(msgs)
+    assert view == Counter({(1, 11, 1, "a"): 1})
+
+
+def test_inner_join_null_keys_never_match():
+    script_l = [barrier(1),
+                StreamChunk.from_pydict(
+                    L_SCHEMA, {"lk": [None, 1], "lv": [1, 2]}),
+                barrier(2)]
+    script_r = [barrier(1),
+                StreamChunk.from_pydict(
+                    R_SCHEMA, {"rk": [None, 1], "rv": ["x", "y"]}),
+                barrier(2)]
+    msgs, _ = run_join(script_l, script_r, 2)
+    assert materialize_join(msgs) == Counter({(1, 2, 1, "y"): 1})
+
+
+def test_inner_join_random_stream_oracle():
+    rng = np.random.default_rng(17)
+    oracle = JoinOracle()
+    script_l, script_r = [barrier(1)], [barrier(1)]
+    b = 2
+    lpk, rpk = 0, 0
+    for _ in range(6):
+        # left chunk: inserts + deletes of existing rows
+        ks, vs, ops = [], [], []
+        for _ in range(24):
+            if oracle.left and rng.random() < 0.35:
+                i = int(rng.integers(0, len(oracle.left)))
+                k_, v_ = oracle.left.pop(i)
+                ks.append(k_)
+                vs.append(v_)
+                ops.append(Op.DELETE)
+            else:
+                k_, v_ = int(rng.integers(0, 8)), lpk
+                lpk += 1
+                oracle.left.append((k_, v_))
+                ks.append(k_)
+                vs.append(v_)
+                ops.append(Op.INSERT)
+        script_l.append(lchunk(ks, vs, ops=ops))
+        ks, vs, ops = [], [], []
+        for _ in range(16):
+            if oracle.right and rng.random() < 0.35:
+                i = int(rng.integers(0, len(oracle.right)))
+                k_, v_ = oracle.right.pop(i)
+                ks.append(k_)
+                vs.append(v_)
+                ops.append(Op.DELETE)
+            else:
+                k_, v_ = int(rng.integers(0, 8)), f"r{rpk}"
+                rpk += 1
+                oracle.right.append((k_, v_))
+                ks.append(k_)
+                vs.append(v_)
+                ops.append(Op.INSERT)
+        script_r.append(rchunk(ks, vs, ops=ops))
+        script_l.append(barrier(b))
+        script_r.append(barrier(b))
+        b += 1
+    msgs, _ = run_join(script_l, script_r, b - 1)
+    assert materialize_join(msgs) == oracle.view()
+
+
+def test_inner_join_update_pair_same_pk_one_chunk():
+    """An update pair [U-, U+] sharing a pk inside ONE chunk must
+    retract the old row and register the new one (regression: inserts
+    applied before deletes corrupted the pk→ref map)."""
+    script_l = [barrier(1), lchunk([1], [10]), barrier(2),
+                lchunk([1, 2], [10, 10],
+                       ops=[Op.UPDATE_DELETE, Op.UPDATE_INSERT]),
+                barrier(3),
+                # post-update probes: key 1 must be gone, key 2 must hit
+                lchunk([], []), barrier(4)]
+    script_r = [barrier(1), rchunk([1], ["a"]), barrier(2),
+                rchunk([], []), barrier(3),
+                rchunk([1, 2], ["a2", "b2"]), barrier(4)]
+    msgs, _ = run_join(script_l, script_r, 4)
+    assert materialize_join(msgs) == Counter({(2, 10, 2, "b2"): 1})
+
+
+def test_join_compaction_reclaims_dead_refs(monkeypatch):
+    """Update churn leaves dead refs; the barrier-time compaction must
+    reclaim them without changing join results."""
+    from risingwave_tpu.stream.executors.hash_join import _JoinSide
+    monkeypatch.setattr(_JoinSide, "COMPACT_MIN_REFS", 8)
+    script_l, script_r = [barrier(1)], [barrier(1)]
+    script_l.append(lchunk([0], [5]))
+    script_r.append(rchunk([3], ["z"]))
+    b = 2
+    k_cur = 0
+    for _ in range(20):   # 20 update pairs → 21 refs, ≥10 dead
+        script_l.append(barrier(b))
+        script_r.append(barrier(b))
+        b += 1
+        k_new = (k_cur + 1) % 4
+        script_l.append(lchunk([k_cur, k_new], [5, 5],
+                               ops=[Op.UPDATE_DELETE, Op.UPDATE_INSERT]))
+        k_cur = k_new
+    script_l.append(barrier(b))
+    script_r.append(barrier(b))
+    store = MemoryStateStore()
+    lt = StateTable(21, L_SCHEMA, [1], store, dist_key_indices=[])
+    rt = StateTable(22, R_SCHEMA, [1], store, dist_key_indices=[])
+    ex = HashJoinExecutor(
+        MockSource(L_SCHEMA, script_l), MockSource(R_SCHEMA, script_r),
+        left_keys=[0], right_keys=[0], left_table=lt, right_table=rt)
+    msgs = asyncio.run(collect_until_n_barriers(ex, b))
+    view = materialize_join(msgs)
+    expect = Counter({(k_cur, 5, 3, "z"): 1}) if k_cur == 3 else Counter()
+    assert view == expect
+    left = ex.sides[0]
+    # 21 refs were allocated over the run; compaction must have rebuilt
+    # to ~1 live row (plus post-compaction churn), not 21
+    assert left.next_ref < 21
+    assert len(left.free) < left.next_ref
+
+
+def test_join_recovery_resumes():
+    store = MemoryStateStore()
+
+    def build(sl, sr):
+        lt = StateTable(21, L_SCHEMA, [1], store, dist_key_indices=[])
+        rt = StateTable(22, R_SCHEMA, [1], store, dist_key_indices=[])
+        return HashJoinExecutor(
+            MockSource(L_SCHEMA, sl), MockSource(R_SCHEMA, sr),
+            left_keys=[0], right_keys=[0], left_table=lt, right_table=rt)
+
+    ex1 = build([barrier(1), lchunk([1], [10]), barrier(2)],
+                [barrier(1), rchunk([1], ["a"]), barrier(2)])
+    asyncio.run(collect_until_n_barriers(ex1, 2))
+    # restart: right side gets a new matching row — the recovered left
+    # row must produce the match
+    ex2 = build([barrier(3), barrier(4)],
+                [barrier(3), rchunk([1], ["b"]), barrier(4)])
+    msgs = asyncio.run(collect_until_n_barriers(ex2, 2))
+    assert materialize_join(msgs) == Counter({(1, 10, 1, "b"): 1})
