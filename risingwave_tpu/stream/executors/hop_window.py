@@ -1,0 +1,73 @@
+"""HopWindowExecutor: expand rows into their sliding (hop) windows.
+
+Reference parity: src/stream/src/executor/hop_window.rs:91 — with
+`units = window_size / window_slide` (must divide exactly), each input
+chunk yields `units` output chunks; copy i carries the i-th covering
+window's [window_start, window_end]. Window starts covering ts are
+  floor(ts / slide) * slide - i * slide,   i in 0..units-1
+(one tumble by `slide`, then shifted copies) — all vectorized.
+
+Rows whose timestamp is NULL are dropped (reference behavior: the window
+expression evaluates to NULL and downstream grouping would discard them;
+we mask them out up front).
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator, List
+
+import numpy as np
+
+from risingwave_tpu.common.chunk import Column, StreamChunk
+from risingwave_tpu.common.types import DataType, Field, Interval, Schema
+from risingwave_tpu.stream.executor import Executor, ExecutorInfo
+from risingwave_tpu.stream.message import Message, Watermark, is_chunk
+
+
+class HopWindowExecutor(Executor):
+    """Sliding-window expansion (hop_window.rs:91 analog)."""
+
+    def __init__(self, input_: Executor, time_col: int,
+                 window_slide: Interval, window_size: Interval,
+                 pk_indices: List[int] = ()):
+        slide, size = window_slide.usecs, window_size.usecs
+        if slide <= 0 or size % slide != 0:
+            raise ValueError(
+                f"window_size {size}us not divisible by slide {slide}us")
+        self.units = size // slide
+        self.slide = slide
+        self.size = size
+        self.time_col = time_col
+        fields = [Field(f.name, f.data_type) for f in input_.schema]
+        fields.append(Field("window_start", DataType.TIMESTAMP))
+        fields.append(Field("window_end", DataType.TIMESTAMP))
+        super().__init__(ExecutorInfo(Schema(fields), list(pk_indices),
+                                      "HopWindowExecutor"))
+        self.input = input_
+
+    async def execute(self) -> AsyncIterator[Message]:
+        ws_idx = len(self.input.schema)
+        async for msg in self.input.execute():
+            if isinstance(msg, Watermark):
+                if msg.col_idx == self.time_col:
+                    # a bound on ts is a bound on the last window's start
+                    base = (int(msg.value) // self.slide) * self.slide
+                    yield Watermark(ws_idx, DataType.TIMESTAMP,
+                                    base - (self.units - 1) * self.slide)
+                continue
+            if not is_chunk(msg):
+                yield msg
+                continue
+            c = msg.columns[self.time_col]
+            ts = np.asarray(c.values)
+            vis = np.asarray(msg.visibility)
+            if c.validity is not None:
+                vis = vis & np.asarray(c.validity)
+            base = (ts.astype(np.int64) // self.slide) * self.slide
+            for i in range(self.units):
+                start = base - i * self.slide
+                cols = list(msg.columns)
+                cols.append(Column(DataType.TIMESTAMP, start, None))
+                cols.append(Column(DataType.TIMESTAMP, start + self.size,
+                                   None))
+                yield StreamChunk(self.schema, cols, vis, msg.ops)

@@ -1,0 +1,223 @@
+"""TopN executors: streaming ORDER BY ... OFFSET ... LIMIT maintenance.
+
+Reference parity: src/stream/src/executor/top_n/ — top_n_plain.rs
+(TopNExecutor), group_top_n.rs (GroupTopNExecutor), top_n_appendonly.rs
+(AppendOnlyTopNExecutor); state layout managed state = all candidate
+rows keyed by [group key +] order key + pk (top_n_state.rs).
+
+Re-design notes: the reference replays each row against a btree cache
+and emits per-row deltas. Here each *chunk* applies as a batch and the
+executor emits the NET delta of the visible window [offset, offset+limit)
+per group — equivalent eventual output with one sorted-structure pass
+per chunk. Ordering is host-side (control-heavy small-N work, same as
+the reference's CPU btree — nothing here wants the MXU).
+
+NULLS ordering follows PostgreSQL: NULLS LAST for ASC, NULLS FIRST for
+DESC.
+"""
+
+from __future__ import annotations
+
+import bisect
+from collections import Counter
+from typing import AsyncIterator, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from risingwave_tpu.common.chunk import Column, Op, StreamChunk
+from risingwave_tpu.common.types import Schema
+from risingwave_tpu.state.state_table import StateTable, to_logical_row
+from risingwave_tpu.stream.executor import Executor, ExecutorInfo
+from risingwave_tpu.stream.message import (
+    Message, is_barrier, is_chunk, is_watermark,
+)
+
+
+class _Key:
+    """None-aware, per-column asc/desc comparable sort key."""
+
+    __slots__ = ("vals", "descs")
+
+    def __init__(self, vals: Tuple, descs: Tuple[bool, ...]):
+        self.vals = vals
+        self.descs = descs
+
+    def __lt__(self, other: "_Key") -> bool:
+        for a, b, d in zip(self.vals, other.vals, self.descs):
+            if a is None and b is None:
+                continue
+            if a is None:               # NULLS LAST asc / FIRST desc
+                return d
+            if b is None:
+                return not d
+            if a == b:
+                continue
+            return (a > b) if d else (a < b)
+        return False
+
+    def __eq__(self, other) -> bool:
+        return self.vals == other.vals
+
+    def __repr__(self) -> str:
+        return f"_Key({self.vals})"
+
+
+class _SortedRows:
+    """One group's candidates: rows sorted by order key + pk tiebreak."""
+
+    __slots__ = ("entries",)
+
+    def __init__(self):
+        self.entries: List[Tuple[_Key, tuple]] = []
+
+    def insert(self, key: _Key, row: tuple) -> None:
+        bisect.insort(self.entries, (key, row))
+
+    def delete(self, key: _Key, row: tuple) -> None:
+        i = bisect.bisect_left(self.entries, (key, row))
+        if i < len(self.entries) and self.entries[i][1] == row:
+            del self.entries[i]
+
+    def window(self, offset: int, limit: Optional[int]) -> List[tuple]:
+        hi = None if limit is None else offset + limit
+        return [r for _k, r in self.entries[offset:hi]]
+
+    def truncate_beyond(self, n: int) -> List[tuple]:
+        """Drop rows ranked >= n (append-only pruning); returns dropped."""
+        dropped = [r for _k, r in self.entries[n:]]
+        del self.entries[n:]
+        return dropped
+
+
+class GroupTopNExecutor(Executor):
+    """Streaming [group] top-n (top_n_plain.rs / group_top_n.rs analog).
+
+    `group_indices=[]` gives plain TopN; `append_only=True` prunes
+    managed state beyond the window (top_n_appendonly.rs analog).
+    """
+
+    def __init__(self, input_: Executor, order_by: Sequence[Tuple[int, bool]],
+                 offset: int, limit: Optional[int], state: StateTable,
+                 group_indices: Sequence[int] = (),
+                 append_only: bool = False):
+        super().__init__(ExecutorInfo(
+            input_.schema, list(input_.pk_indices),
+            "GroupTopNExecutor" if group_indices else "TopNExecutor"))
+        self.input = input_
+        self.order_by = list(order_by)
+        self.offset = int(offset)
+        self.limit = limit
+        self.state = state
+        self.group_indices = list(group_indices)
+        self.append_only = append_only
+        # sort = order cols, then pk for a total (deterministic) order
+        self._sort_cols = [i for i, _ in self.order_by] + [
+            i for i in input_.pk_indices
+            if i not in {j for j, _ in self.order_by}]
+        self._descs = tuple([d for _, d in self.order_by] +
+                            [False] * (len(self._sort_cols)
+                                       - len(self.order_by)))
+        self.groups: Dict[tuple, _SortedRows] = {}
+
+    # -- helpers ---------------------------------------------------------
+    def _key_of(self, row: tuple) -> _Key:
+        return _Key(tuple(row[i] for i in self._sort_cols), self._descs)
+
+    def _group_of(self, row: tuple) -> tuple:
+        return tuple(row[i] for i in self.group_indices)
+
+    def _window(self, g: tuple) -> List[tuple]:
+        rows = self.groups.get(g)
+        return rows.window(self.offset, self.limit) if rows else []
+
+    def _recover(self) -> None:
+        for _pk, raw in self.state.iter_rows():
+            row = to_logical_row(raw, self.schema)
+            g = self._group_of(row)
+            self.groups.setdefault(g, _SortedRows()).insert(
+                self._key_of(row), row)
+
+    # -- chunk path ------------------------------------------------------
+    def _apply(self, chunk: StreamChunk) -> Optional[StreamChunk]:
+        touched: Dict[tuple, List[tuple]] = {}
+        vis = np.asarray(chunk.visibility)
+        ops = np.asarray(chunk.ops)
+        for op, row in chunk.to_records():
+            g = self._group_of(row)
+            if g not in touched:
+                touched[g] = self._window(g)
+            rows = self.groups.setdefault(g, _SortedRows())
+            key = self._key_of(row)
+            if op.is_insert:
+                rows.insert(key, row)
+                self.state.insert(row)
+                if self.append_only and self.limit is not None:
+                    for dropped in rows.truncate_beyond(
+                            self.offset + self.limit):
+                        self.state.delete(dropped)
+            else:
+                if self.append_only:
+                    raise ValueError(
+                        "delete on append-only TopN input")
+                rows.delete(key, row)
+                self.state.delete(row)
+        del vis, ops
+        # net window delta per touched group
+        deletes: List[tuple] = []
+        inserts: List[tuple] = []
+        for g, old_window in touched.items():
+            new_window = self._window(g)
+            old_c, new_c = Counter(old_window), Counter(new_window)
+            for r, cnt in (old_c - new_c).items():
+                deletes.extend([r] * cnt)
+            for r, cnt in (new_c - old_c).items():
+                inserts.extend([r] * cnt)
+        if not deletes and not inserts:
+            return None
+        return self._delta_chunk(deletes, inserts)
+
+    def _delta_chunk(self, deletes: List[tuple],
+                     inserts: List[tuple]) -> StreamChunk:
+        rows = deletes + inserts
+        n = len(rows)
+        ops = np.asarray([int(Op.DELETE)] * len(deletes)
+                         + [int(Op.INSERT)] * len(inserts), dtype=np.int8)
+        cols: List[Column] = []
+        for j, f in enumerate(self.schema):
+            vals_l = [r[j] for r in rows]
+            ok = np.asarray([v is not None for v in vals_l])
+            if f.data_type.is_device:
+                vals = np.asarray([0 if v is None else v for v in vals_l],
+                                  dtype=f.data_type.np_dtype)
+            else:
+                vals = np.asarray(vals_l, dtype=object)
+            cols.append(Column(f.data_type, vals,
+                               None if ok.all() else ok))
+        return StreamChunk(self.schema, cols, np.ones(n, dtype=bool), ops)
+
+    async def execute(self) -> AsyncIterator[Message]:
+        it = self.input.execute()
+        first = await it.__anext__()
+        assert is_barrier(first)
+        self.state.init_epoch(first.epoch)
+        self._recover()
+        yield first
+        async for msg in it:
+            if is_chunk(msg):
+                out = self._apply(msg)
+                if out is not None:
+                    yield out
+            elif is_barrier(msg):
+                self.state.commit(msg.epoch)
+                yield msg
+            elif is_watermark(msg):
+                if msg.col_idx in self.group_indices:
+                    yield msg    # group-key watermarks pass through
+
+
+def TopNExecutor(input_: Executor, order_by, offset, limit,
+                 state: StateTable, append_only: bool = False
+                 ) -> GroupTopNExecutor:
+    """Plain (ungrouped) TopN — top_n_plain.rs / top_n_appendonly.rs."""
+    return GroupTopNExecutor(input_, order_by, offset, limit, state,
+                             group_indices=(), append_only=append_only)

@@ -1,0 +1,46 @@
+"""UnionExecutor: merge N same-schema inputs into one aligned stream.
+
+Reference parity: src/stream/src/executor/union.rs:29 (UnionExecutor —
+`merge` over child executors with barrier alignment) with watermark
+handling per super::watermark::BufferedWatermarks (min across inputs,
+monotonic). Unlike MergeExecutor (which merges exchange *channels*),
+Union composes child *executors* in the same actor.
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator, List, Sequence
+
+from risingwave_tpu.stream.executor import Executor, ExecutorInfo
+from risingwave_tpu.stream.merge import _WatermarkAligner, barrier_align_n
+from risingwave_tpu.stream.message import Message, Watermark
+
+
+class UnionExecutor(Executor):
+    """Merge N upstream executors (union.rs:29 analog)."""
+
+    def __init__(self, inputs: Sequence[Executor],
+                 pk_indices: Sequence[int] = ()):
+        assert inputs, "UnionExecutor needs at least one input"
+        schema = inputs[0].schema
+        for e in inputs[1:]:
+            assert [f.data_type for f in e.schema] == \
+                [f.data_type for f in schema], \
+                f"union schema mismatch: {e.schema!r} vs {schema!r}"
+        super().__init__(ExecutorInfo(schema, list(pk_indices),
+                                      "UnionExecutor"))
+        self.inputs = list(inputs)
+
+    async def execute(self) -> AsyncIterator[Message]:
+        n = len(self.inputs)
+        wm = _WatermarkAligner(n)
+        async for tag, msg in barrier_align_n(
+                [e.execute() for e in self.inputs]):
+            if tag == "barrier":
+                yield msg
+            elif isinstance(msg, Watermark):
+                w = wm.update(tag, msg)
+                if w is not None:
+                    yield w
+            else:
+                yield msg

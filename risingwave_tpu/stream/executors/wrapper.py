@@ -1,0 +1,88 @@
+"""WrapperExecutor: runtime sanity checks around any executor.
+
+Reference parity: src/stream/src/executor/wrapper.rs (+ wrapper/
+schema_check.rs, update_check.rs, epoch_check.rs) — in debug builds every
+executor is wrapped with assertions that catch protocol violations at
+the point of origin instead of three operators downstream:
+
+- schema check: chunk column count + dtypes match the executor schema
+- update check: UPDATE_DELETE must be immediately followed (in visible
+  row order) by UPDATE_INSERT
+- epoch check: barrier epochs strictly increase
+- watermark check: per-column watermark values never regress
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator, Dict, Optional
+
+import numpy as np
+
+from risingwave_tpu.common.chunk import Op, StreamChunk
+from risingwave_tpu.stream.executor import Executor, ExecutorInfo
+from risingwave_tpu.stream.message import (
+    Message, Watermark, is_barrier, is_chunk,
+)
+
+
+class SanityError(AssertionError):
+    """A stream-protocol violation caught by WrapperExecutor."""
+
+
+class WrapperExecutor(Executor):
+    """Debug assertions around an inner executor (wrapper.rs analog)."""
+
+    def __init__(self, inner: Executor):
+        super().__init__(ExecutorInfo(
+            inner.schema, list(inner.pk_indices),
+            f"Wrapper({inner.identity})"))
+        self.inner = inner
+        self._last_epoch: Optional[int] = None
+        self._watermarks: Dict[int, object] = {}
+
+    def _check_chunk(self, chunk: StreamChunk) -> None:
+        ident = self.inner.identity
+        if len(chunk.columns) != len(self.schema):
+            raise SanityError(
+                f"{ident}: chunk has {len(chunk.columns)} columns, "
+                f"schema has {len(self.schema)}")
+        for i, (c, f) in enumerate(zip(chunk.columns, self.schema)):
+            if c.data_type != f.data_type:
+                raise SanityError(
+                    f"{ident}: column {i} is {c.data_type}, "
+                    f"schema says {f.data_type}")
+        ops = np.asarray(chunk.ops)
+        vis = np.asarray(chunk.visibility)
+        visible_ops = ops[vis]
+        is_ud = visible_ops == int(Op.UPDATE_DELETE)
+        is_ui = visible_ops == int(Op.UPDATE_INSERT)
+        # every visible U- must be followed by a visible U+
+        follows = np.roll(is_ui, -1)
+        if len(visible_ops) and bool(is_ud[-1]):
+            raise SanityError(f"{ident}: chunk ends with UPDATE_DELETE")
+        if bool((is_ud & ~follows).any()):
+            raise SanityError(
+                f"{ident}: UPDATE_DELETE not followed by UPDATE_INSERT")
+        if bool((is_ui & ~np.roll(is_ud, 1)).any()):
+            raise SanityError(
+                f"{ident}: UPDATE_INSERT not preceded by UPDATE_DELETE")
+
+    async def execute(self) -> AsyncIterator[Message]:
+        async for msg in self.inner.execute():
+            if is_chunk(msg):
+                self._check_chunk(msg)
+            elif is_barrier(msg):
+                e = msg.epoch.curr.value
+                if self._last_epoch is not None and e <= self._last_epoch:
+                    raise SanityError(
+                        f"{self.inner.identity}: barrier epoch {e:#x} not "
+                        f"after {self._last_epoch:#x}")
+                self._last_epoch = e
+            elif isinstance(msg, Watermark):
+                prev = self._watermarks.get(msg.col_idx)
+                if prev is not None and msg.value < prev:
+                    raise SanityError(
+                        f"{self.inner.identity}: watermark regressed on "
+                        f"col {msg.col_idx}: {msg.value} < {prev}")
+                self._watermarks[msg.col_idx] = msg.value
+            yield msg

@@ -141,13 +141,13 @@ class MergeExecutor(Executor):
                 rx.close()
 
 
-async def barrier_align_2(left: AsyncIterator[Message],
-                          right: AsyncIterator[Message]
+async def barrier_align_n(inputs: List[AsyncIterator[Message]]
                           ) -> AsyncIterator[tuple]:
-    """2-way alignment for binary operators (barrier_align.rs:34 analog).
+    """N-way alignment over executor streams (barrier_align.rs:34 analog).
 
-    Yields ("left"|"right", msg) for data and ("barrier", Barrier) once per
-    aligned pair. Ends when either side ends.
+    Yields (input_idx, msg) for data and ("barrier", Barrier) once per
+    aligned set. An input that reaches a barrier is not pulled again
+    until every input reaches the same barrier. Ends when any input ends.
     """
     async def nxt(it):
         try:
@@ -155,45 +155,43 @@ async def barrier_align_2(left: AsyncIterator[Message],
         except StopAsyncIteration:
             return None
 
-    lt = asyncio.ensure_future(nxt(left))
-    rt = asyncio.ensure_future(nxt(right))
-    l_barrier: Optional[Barrier] = None
-    r_barrier: Optional[Barrier] = None
+    n = len(inputs)
+    futs = [asyncio.ensure_future(nxt(it)) for it in inputs]
+    parked: List[Optional[Barrier]] = [None] * n
     try:
         while True:
-            if l_barrier is not None and r_barrier is not None:
-                assert l_barrier.epoch == r_barrier.epoch, \
-                    (l_barrier, r_barrier)
-                yield ("barrier", l_barrier)
-                l_barrier = r_barrier = None
-                lt = asyncio.ensure_future(nxt(left))
-                rt = asyncio.ensure_future(nxt(right))
+            if all(b is not None for b in parked):
+                epochs = {b.epoch.curr.value for b in parked}
+                assert len(epochs) == 1, \
+                    f"misaligned barriers across inputs: {parked}"
+                yield ("barrier", parked[0])
+                parked = [None] * n
+                futs = [asyncio.ensure_future(nxt(it)) for it in inputs]
                 continue
-            waits = set()
-            if l_barrier is None:
-                waits.add(lt)
-            if r_barrier is None:
-                waits.add(rt)
+            waits = {futs[i] for i in range(n) if parked[i] is None}
             done, _ = await asyncio.wait(
                 waits, return_when=asyncio.FIRST_COMPLETED)
-            if lt in done and l_barrier is None:
-                msg = lt.result()
+            for i in range(n):
+                if parked[i] is not None or futs[i] not in done:
+                    continue
+                msg = futs[i].result()
                 if msg is None:
                     return
                 if is_barrier(msg):
-                    l_barrier = msg
+                    parked[i] = msg
                 else:
-                    yield ("left", msg)
-                    lt = asyncio.ensure_future(nxt(left))
-            if rt in done and r_barrier is None:
-                msg = rt.result()
-                if msg is None:
-                    return
-                if is_barrier(msg):
-                    r_barrier = msg
-                else:
-                    yield ("right", msg)
-                    rt = asyncio.ensure_future(nxt(right))
+                    yield (i, msg)
+                    futs[i] = asyncio.ensure_future(nxt(inputs[i]))
     finally:
-        lt.cancel()
-        rt.cancel()
+        for f in futs:
+            f.cancel()
+
+
+async def barrier_align_2(left: AsyncIterator[Message],
+                          right: AsyncIterator[Message]
+                          ) -> AsyncIterator[tuple]:
+    """2-way alignment for binary operators: ("left"|"right"|"barrier",
+    msg) — thin wrapper over barrier_align_n."""
+    tags = {0: "left", 1: "right"}
+    async for tag, msg in barrier_align_n([left, right]):
+        yield (tags.get(tag, tag), msg)

@@ -1,0 +1,409 @@
+"""Executor tests for the wider operator set: Union, Expand, HopWindow,
+WatermarkFilter, Wrapper, SimpleAgg, StatelessSimpleAgg, TopN family,
+DynamicFilter — MockSource + MemoryStateStore, mirroring the reference's
+per-executor test style (SURVEY §4)."""
+
+import asyncio
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.common.chunk import Op, StreamChunk
+from risingwave_tpu.common.epoch import Epoch, EpochPair
+from risingwave_tpu.common.types import DataType, Interval, Schema
+from risingwave_tpu.ops.hash_agg import AggKind
+from risingwave_tpu.state.state_table import StateTable
+from risingwave_tpu.state.store import MemoryStateStore
+from risingwave_tpu.stream.executors.dynamic_filter import (
+    DynamicFilterExecutor,
+)
+from risingwave_tpu.stream.executors.expand import ExpandExecutor
+from risingwave_tpu.stream.executors.hash_agg import AggCall
+from risingwave_tpu.stream.executors.hop_window import HopWindowExecutor
+from risingwave_tpu.stream.executors.simple_agg import (
+    SimpleAggExecutor, StatelessSimpleAggExecutor, simple_agg_state_schema,
+)
+from risingwave_tpu.stream.executors.test_utils import (
+    MockSource, collect_until_n_barriers,
+)
+from risingwave_tpu.stream.executors.top_n import (
+    GroupTopNExecutor, TopNExecutor,
+)
+from risingwave_tpu.stream.executors.union import UnionExecutor
+from risingwave_tpu.stream.executors.watermark_filter import (
+    WATERMARK_STATE_SCHEMA, WatermarkFilterExecutor,
+)
+from risingwave_tpu.stream.executors.wrapper import (
+    SanityError, WrapperExecutor,
+)
+from risingwave_tpu.stream.message import (
+    Barrier, BarrierKind, Watermark, is_chunk, is_watermark,
+)
+
+S2 = Schema.of(k=DataType.INT64, v=DataType.INT64)
+
+
+def barrier(n: int) -> Barrier:
+    prev = Epoch.from_physical(n - 1) if n > 1 else Epoch.INVALID
+    return Barrier(EpochPair(Epoch.from_physical(n), prev),
+                   BarrierKind.CHECKPOINT)
+
+
+def chunk(ks, vs, ops=None, schema=S2):
+    names = [f.name for f in schema]
+    return StreamChunk.from_pydict(
+        schema, {names[0]: ks, names[1]: vs}, ops=ops)
+
+
+def records(msgs) -> list:
+    out = []
+    for m in msgs:
+        if is_chunk(m):
+            out.extend(m.to_records())
+    return out
+
+
+def net_view(msgs) -> Counter:
+    """Signed net counts per row (zeros dropped, negatives KEPT)."""
+    view = Counter()
+    for op, row in records(msgs):
+        view[row] += 1 if op.is_insert else -1
+    return Counter({k: v for k, v in view.items() if v != 0})
+
+
+# -- Union ---------------------------------------------------------------
+
+
+def test_union_merges_aligned_inputs():
+    a = MockSource(S2, [barrier(1), chunk([1], [10]), barrier(2)])
+    b = MockSource(S2, [barrier(1), chunk([2], [20]), barrier(2)])
+    u = UnionExecutor([a, b])
+    msgs = asyncio.run(collect_until_n_barriers(u, 2))
+    assert net_view(msgs) == Counter({(1, 10): 1, (2, 20): 1})
+    n_barriers = sum(1 for m in msgs if not is_chunk(m)
+                     and not is_watermark(m))
+    assert n_barriers == 2     # one aligned barrier per epoch
+
+
+def test_union_watermark_is_min_across_inputs():
+    a = MockSource(S2, [barrier(1), Watermark(0, DataType.INT64, 10),
+                        barrier(2)])
+    b = MockSource(S2, [barrier(1), Watermark(0, DataType.INT64, 5),
+                        barrier(2)])
+    u = UnionExecutor([a, b])
+    msgs = asyncio.run(collect_until_n_barriers(u, 2))
+    wms = [m for m in msgs if is_watermark(m)]
+    assert [w.value for w in wms] == [5]
+
+
+# -- Expand --------------------------------------------------------------
+
+
+def test_expand_subsets_and_flag():
+    src = MockSource(S2, [barrier(1), chunk([1], [10]), barrier(2)])
+    ex = ExpandExecutor(src, column_subsets=[[0], [1]])
+    msgs = asyncio.run(collect_until_n_barriers(ex, 2))
+    rows = records(msgs)
+    # subset 0 keeps col k; subset 1 keeps col v; both append full copy
+    assert (Op.INSERT, (1, None, 1, 10, 0)) in rows
+    assert (Op.INSERT, (None, 10, 1, 10, 1)) in rows
+    assert len(rows) == 2
+    assert len(ex.schema) == 5 and ex.schema[4].name == "flag"
+
+
+# -- HopWindow -----------------------------------------------------------
+
+
+def test_hop_window_expands_each_row():
+    sch = Schema.of(ts=DataType.TIMESTAMP, v=DataType.INT64)
+    # slide 10s, size 30s → 3 windows per row
+    src = MockSource(sch, [barrier(1),
+                           chunk([25_000_000], [7], schema=sch),
+                           barrier(2)])
+    ex = HopWindowExecutor(src, time_col=0,
+                           window_slide=Interval(usecs=10_000_000),
+                           window_size=Interval(usecs=30_000_000))
+    msgs = asyncio.run(collect_until_n_barriers(ex, 2))
+    rows = records(msgs)
+    starts = sorted(r[2] for _op, r in rows)
+    assert starts == [0, 10_000_000, 20_000_000]
+    for _op, r in rows:
+        assert r[3] == r[2] + 30_000_000   # window_end
+        assert r[2] <= 25_000_000 < r[3]
+
+
+def test_hop_window_rejects_non_divisible():
+    sch = Schema.of(ts=DataType.TIMESTAMP, v=DataType.INT64)
+    src = MockSource(sch, [])
+    with pytest.raises(ValueError):
+        HopWindowExecutor(src, 0, Interval(usecs=7_000_000),
+                          Interval(usecs=30_000_000))
+
+
+# -- WatermarkFilter -----------------------------------------------------
+
+
+def test_watermark_filter_emits_and_drops_late():
+    sch = Schema.of(ts=DataType.TIMESTAMP, v=DataType.INT64)
+    store = MemoryStateStore()
+    state = StateTable(41, WATERMARK_STATE_SCHEMA, [0], store)
+    src = MockSource(sch, [
+        barrier(1),
+        chunk([100, 200], [1, 2], schema=sch),
+        barrier(2),
+        # late row (ts 50 < wm 200-100=100) + fresh row
+        chunk([50, 300], [3, 4], schema=sch),
+        barrier(3),
+    ])
+    ex = WatermarkFilterExecutor(src, time_col=0,
+                                 delay=Interval(usecs=100), state=state)
+    msgs = asyncio.run(collect_until_n_barriers(ex, 3))
+    rows = [r for _op, r in records(msgs)]
+    assert (50, 3) not in rows
+    assert {(100, 1), (200, 2), (300, 4)} == set(rows)
+    wms = [m.value for m in msgs if is_watermark(m)]
+    assert wms == [100, 200]
+    # watermark persisted at checkpoint
+    assert state.get_row((0,))[1] == 200
+
+
+def test_watermark_filter_recovers_watermark():
+    sch = Schema.of(ts=DataType.TIMESTAMP, v=DataType.INT64)
+    store = MemoryStateStore()
+
+    def build():
+        state = StateTable(41, WATERMARK_STATE_SCHEMA, [0], store)
+        return state
+
+    ex1 = WatermarkFilterExecutor(
+        MockSource(sch, [barrier(1), chunk([500], [1], schema=sch),
+                         barrier(2)]),
+        time_col=0, delay=Interval(usecs=100), state=build())
+    asyncio.run(collect_until_n_barriers(ex1, 2))
+    ex2 = WatermarkFilterExecutor(
+        MockSource(sch, [barrier(3)]),
+        time_col=0, delay=Interval(usecs=100), state=build())
+    msgs = asyncio.run(collect_until_n_barriers(ex2, 1))
+    wms = [m.value for m in msgs if is_watermark(m)]
+    assert wms == [400]    # restored 500-100
+
+
+# -- Wrapper -------------------------------------------------------------
+
+
+def test_wrapper_passes_valid_stream():
+    src = MockSource(S2, [barrier(1), chunk([1], [2]), barrier(2)])
+    msgs = asyncio.run(collect_until_n_barriers(WrapperExecutor(src), 2))
+    assert len(records(msgs)) == 1
+
+
+def test_wrapper_catches_broken_update_pair():
+    bad = chunk([1, 2], [1, 2],
+                ops=[Op.UPDATE_DELETE, Op.INSERT])  # U- not followed by U+
+    src = MockSource(S2, [barrier(1), bad])
+    with pytest.raises(SanityError):
+        asyncio.run(collect_until_n_barriers(WrapperExecutor(src), 2))
+
+
+def test_wrapper_catches_epoch_regression():
+    src = MockSource(S2, [barrier(2), barrier(1)])
+    with pytest.raises(SanityError):
+        asyncio.run(collect_until_n_barriers(WrapperExecutor(src), 2))
+
+
+# -- SimpleAgg -----------------------------------------------------------
+
+
+def _simple_agg(script, calls, append_only=False, store=None):
+    store = store or MemoryStateStore()
+    src = MockSource(S2, script)
+    schema, pk = simple_agg_state_schema(S2, calls)
+    state = StateTable(51, schema, pk, store)
+    return SimpleAggExecutor(src, calls, state,
+                             append_only=append_only), store
+
+
+def test_simple_agg_count_sum_first_emit_then_updates():
+    calls = [AggCall(AggKind.COUNT), AggCall(AggKind.SUM, 1)]
+    ex, _ = _simple_agg(
+        [barrier(1), barrier(2),
+         chunk([1, 2], [10, 20]), barrier(3),
+         chunk([1], [10], ops=[Op.DELETE]), barrier(4)], calls)
+    msgs = asyncio.run(collect_until_n_barriers(ex, 4))
+    recs = records(msgs)
+    # first barrier with no input emits the initial row (count 0, sum NULL)
+    assert recs[0] == (Op.INSERT, (0, None))
+    assert (Op.UPDATE_INSERT, (2, 30)) in recs
+    assert recs[-1] == (Op.UPDATE_INSERT, (1, 20))
+
+
+def test_simple_agg_max_append_only_and_recovery():
+    calls = [AggCall(AggKind.MAX, 1), AggCall(AggKind.COUNT)]
+    store = MemoryStateStore()
+    ex1, _ = _simple_agg(
+        [barrier(1), chunk([1, 2], [7, 30]), barrier(2)],
+        calls, append_only=True, store=store)
+    msgs = asyncio.run(collect_until_n_barriers(ex1, 2))
+    assert records(msgs)[-1] == (Op.INSERT, (30, 2))
+    # restart from the same store: no duplicate initial insert
+    ex2, _ = _simple_agg(
+        [barrier(3), chunk([5], [40]), barrier(4)],
+        calls, append_only=True, store=store)
+    msgs2 = asyncio.run(collect_until_n_barriers(ex2, 2))
+    recs2 = records(msgs2)
+    assert recs2 == [(Op.UPDATE_DELETE, (30, 2)),
+                     (Op.UPDATE_INSERT, (40, 3))]
+
+
+def test_simple_agg_min_retractable_rejected():
+    calls = [AggCall(AggKind.MIN, 1)]
+    with pytest.raises(NotImplementedError):
+        _simple_agg([], calls)
+
+
+def test_stateless_simple_agg_partials():
+    calls = [AggCall(AggKind.COUNT), AggCall(AggKind.SUM, 1)]
+    src = MockSource(S2, [barrier(1),
+                          chunk([1, 2], [10, 20]),
+                          chunk([3], [5], ops=[Op.DELETE]),
+                          barrier(2)])
+    ex = StatelessSimpleAggExecutor(src, calls)
+    msgs = asyncio.run(collect_until_n_barriers(ex, 2))
+    assert records(msgs) == [(Op.INSERT, (2, 30)),
+                             (Op.INSERT, (-1, -5))]
+
+
+# -- TopN ----------------------------------------------------------------
+
+
+def _topn(script, order_by, offset, limit, group_indices=(),
+          append_only=False, store=None):
+    store = store or MemoryStateStore()
+    src = MockSource(S2, script, pk_indices=[1])
+    state = StateTable(61, S2, [0, 1] if group_indices else [1],
+                       store, dist_key_indices=[])
+    return GroupTopNExecutor(src, order_by, offset, limit, state,
+                             group_indices=group_indices,
+                             append_only=append_only)
+
+
+def test_topn_basic_window_maintenance():
+    # top-2 by v ascending, pk = v
+    ex = _topn([barrier(1),
+                chunk([1, 1, 1], [30, 10, 20]), barrier(2),
+                chunk([1], [5]), barrier(3),
+                chunk([1], [10], ops=[Op.DELETE]), barrier(4)],
+               order_by=[(1, False)], offset=0, limit=2)
+    msgs = asyncio.run(collect_until_n_barriers(ex, 4))
+    assert net_view(msgs) == Counter({(1, 5): 1, (1, 20): 1})
+
+
+def test_topn_offset_skips_leaders():
+    ex = _topn([barrier(1), chunk([1, 1, 1], [30, 10, 20]), barrier(2)],
+               order_by=[(1, False)], offset=1, limit=1)
+    msgs = asyncio.run(collect_until_n_barriers(ex, 2))
+    assert net_view(msgs) == Counter({(1, 20): 1})
+
+
+def test_topn_descending():
+    ex = _topn([barrier(1), chunk([1, 1, 1], [30, 10, 20]), barrier(2)],
+               order_by=[(1, True)], offset=0, limit=2)
+    msgs = asyncio.run(collect_until_n_barriers(ex, 2))
+    assert net_view(msgs) == Counter({(1, 30): 1, (1, 20): 1})
+
+
+def test_group_topn_per_group_windows():
+    ex = _topn([barrier(1),
+                chunk([1, 1, 2, 2, 2], [10, 20, 7, 5, 6]), barrier(2)],
+               order_by=[(1, False)], offset=0, limit=1,
+               group_indices=[0])
+    msgs = asyncio.run(collect_until_n_barriers(ex, 2))
+    assert net_view(msgs) == Counter({(1, 10): 1, (2, 5): 1})
+
+
+def test_topn_recovery_from_state():
+    store = MemoryStateStore()
+    ex1 = _topn([barrier(1), chunk([1, 1], [10, 20]), barrier(2)],
+                order_by=[(1, False)], offset=0, limit=1, store=store)
+    asyncio.run(collect_until_n_barriers(ex1, 2))
+    # restart: a smaller row displaces the recovered leader
+    ex2 = _topn([barrier(3), chunk([1], [5]), barrier(4)],
+                order_by=[(1, False)], offset=0, limit=1, store=store)
+    msgs = asyncio.run(collect_until_n_barriers(ex2, 2))
+    assert net_view(msgs) == Counter({(1, 5): 1, (1, 10): -1})
+
+
+def test_append_only_topn_prunes_state():
+    store = MemoryStateStore()
+    ex = _topn([barrier(1),
+                chunk([1, 1, 1, 1], [40, 10, 30, 20]), barrier(2)],
+               order_by=[(1, False)], offset=0, limit=2,
+               append_only=True, store=store)
+    msgs = asyncio.run(collect_until_n_barriers(ex, 2))
+    assert net_view(msgs) == Counter({(1, 10): 1, (1, 20): 1})
+    # managed state kept only the window
+    kept = sorted(r[1] for _pk, r in ex.state.iter_rows())
+    assert kept == [10, 20]
+
+
+# -- DynamicFilter -------------------------------------------------------
+
+
+RHS_SCHEMA = Schema.of(bound=DataType.INT64, dummy=DataType.INT64)
+
+
+def _dyn(script_l, script_r, cmp):
+    store = MemoryStateStore()
+    lt = StateTable(71, S2, [1], store, dist_key_indices=[])
+    return DynamicFilterExecutor(
+        MockSource(S2, script_l, pk_indices=[1]),
+        MockSource(RHS_SCHEMA, script_r),
+        left_col=1, comparator=cmp, left_state=lt)
+
+
+def rhs(vals, ops=None):
+    return chunk(vals, [0] * len(vals), ops=ops, schema=RHS_SCHEMA)
+
+
+def test_dynamic_filter_emits_on_bound_and_transitions():
+    ex = _dyn(
+        [barrier(1), chunk([1, 1, 1], [10, 20, 30]), barrier(2),
+         chunk([1], [25]), barrier(3), barrier(4)],
+        [barrier(1), rhs([15]), barrier(2), barrier(3),
+         rhs([15, 28], ops=[Op.UPDATE_DELETE, Op.UPDATE_INSERT]),
+         barrier(4)],
+        cmp=">")
+    msgs = asyncio.run(collect_until_n_barriers(ex, 4))
+    # epoch 2: bound 15 applies at barrier → stored 20,30 emitted then;
+    # epoch 3: 25 passes inline; epoch 4: bound 28 retracts 20 and 25
+    assert net_view(msgs) == Counter({(1, 30): 1})
+
+
+def test_dynamic_filter_initial_bound_emits_backlog():
+    ex = _dyn(
+        [barrier(1), chunk([1, 1], [10, 30]), barrier(2), barrier(3)],
+        [barrier(1), barrier(2), rhs([20]), barrier(3)],
+        cmp=">=")
+    msgs = asyncio.run(collect_until_n_barriers(ex, 3))
+    assert net_view(msgs) == Counter({(1, 30): 1})
+
+
+def test_dynamic_filter_less_than():
+    ex = _dyn(
+        [barrier(1), chunk([1, 1], [10, 30]), barrier(2), barrier(3)],
+        [barrier(1), rhs([20]), barrier(2), barrier(3)],
+        cmp="<")
+    msgs = asyncio.run(collect_until_n_barriers(ex, 3))
+    assert net_view(msgs) == Counter({(1, 10): 1})
+
+
+def test_dynamic_filter_null_rows_never_match():
+    script_l = [barrier(1),
+                StreamChunk.from_pydict(S2, {"k": [1, 1],
+                                             "v": [None, 50]}),
+                barrier(2), barrier(3)]
+    ex = _dyn(script_l,
+              [barrier(1), rhs([20]), barrier(2), barrier(3)], cmp=">")
+    msgs = asyncio.run(collect_until_n_barriers(ex, 3))
+    assert net_view(msgs) == Counter({(1, 50): 1})
