@@ -184,7 +184,13 @@ def test_watermark_filter_recovers_watermark():
     ex2 = WatermarkFilterExecutor(
         MockSource(sch, [barrier(3)]),
         time_col=0, delay=Interval(usecs=100), state=build())
-    msgs = asyncio.run(collect_until_n_barriers(ex2, 1))
+
+    async def drain():
+        return [m async for m in ex2.execute()]
+
+    # the restored watermark is emitted right after the first barrier
+    # (reference recovery behavior), so drain the whole stream
+    msgs = asyncio.run(drain())
     wms = [m.value for m in msgs if is_watermark(m)]
     assert wms == [400]    # restored 500-100
 
