@@ -36,7 +36,7 @@ def bench_q1(total_events: int = 50 * 4000, chunk_size: int = 4096):
     from risingwave_tpu.state.store import MemoryStateStore
 
     cfg = NexmarkConfig(event_num=total_events, max_chunk_size=chunk_size)
-    p = build_q1(MemoryStateStore(), cfg, rate_limit=16)
+    p = build_q1(MemoryStateStore(), cfg, rate_limit=16, min_chunks=16)
     n_bids = total_events * 46 // 50
     elapsed, rows = asyncio.run(drive_to_completion(p, {1: n_bids}))
     return _result("nexmark_q1_events_per_sec", elapsed, rows, p.loop)
@@ -53,7 +53,7 @@ def bench_q7(total_events: int = 50 * 40_000, chunk_size: int = 8192):
 
     cfg = NexmarkConfig(event_num=total_events, max_chunk_size=chunk_size,
                         generate_strings=False)
-    p = build_q7(MemoryStateStore(), cfg, rate_limit=16)
+    p = build_q7(MemoryStateStore(), cfg, rate_limit=32, min_chunks=32)
     n_bids = total_events * 46 // 50
     elapsed, rows = asyncio.run(drive_to_completion(p, {1: n_bids}))
     return _result("nexmark_q7_events_per_sec", elapsed, rows, p.loop)
@@ -70,13 +70,16 @@ def bench_q8(total_events: int = 50 * 40_000, chunk_size: int = 4096):
     base = NexmarkConfig(event_num=total_events, max_chunk_size=chunk_size)
     cfg_p = NexmarkConfig(**{**base.__dict__, "table_type": "person"})
     cfg_a = NexmarkConfig(**{**base.__dict__, "table_type": "auction"})
-    p = build_q8(MemoryStateStore(), cfg_p, cfg_a, rate_limit=16)
+    p = build_q8(MemoryStateStore(), cfg_p, cfg_a, rate_limit=16,
+                 min_chunks=16)
     targets = {1: total_events // 50, 2: total_events * 3 // 50}
     elapsed, rows = asyncio.run(drive_to_completion(p, targets))
     return _result("nexmark_q8_events_per_sec", elapsed, rows, p.loop)
 
 
 def main(argv):
+    from risingwave_tpu.utils.jaxtools import enable_compilation_cache
+    enable_compilation_cache()
     run_all = "--all" in argv
     results = {}
     # headline: the stateful device-kernel path (q7). q1 (stateless host

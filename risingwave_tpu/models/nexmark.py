@@ -52,13 +52,15 @@ class Pipeline:
 
 def _source(local: LocalBarrierManager, store, actor_id: int,
             cfg: NexmarkConfig, table_id: int,
-            rate_limit: Optional[int]) -> SourceExecutor:
+            rate_limit: Optional[int],
+            min_chunks: Optional[int] = None) -> SourceExecutor:
     reader = NexmarkSplitReader(cfg)
     tx, rx = channel_for_test()
     split_state = StateTable(table_id, SPLIT_STATE_SCHEMA, [0], store)
     local.register_sender(actor_id, tx)
     return SourceExecutor(reader, rx, split_state, actor_id=actor_id,
-                          rate_limit_chunks_per_barrier=rate_limit)
+                          rate_limit_chunks_per_barrier=rate_limit,
+                          min_chunks_per_barrier=min_chunks)
 
 
 def _finish(local: LocalBarrierManager, store, mat: MaterializeExecutor,
@@ -70,10 +72,11 @@ def _finish(local: LocalBarrierManager, store, mat: MaterializeExecutor,
 
 
 def build_q1(store, cfg: NexmarkConfig,
-             rate_limit: Optional[int] = 3) -> Pipeline:
+             rate_limit: Optional[int] = 3,
+             min_chunks: Optional[int] = None) -> Pipeline:
     """q1: SELECT auction, bidder, 0.908*price, date_time FROM bid."""
     local = LocalBarrierManager()
-    source = _source(local, store, 1, cfg, 1, rate_limit)
+    source = _source(local, store, 1, cfg, 1, rate_limit, min_chunks)
     row_id = RowIdGenExecutor(source)
     s = row_id.schema
     project = ProjectExecutor(
@@ -93,10 +96,11 @@ def build_q1(store, cfg: NexmarkConfig,
 
 def build_q7(store, cfg: NexmarkConfig,
              rate_limit: Optional[int] = 4,
-             window: Interval = DEFAULT_WINDOW) -> Pipeline:
+             window: Interval = DEFAULT_WINDOW,
+             min_chunks: Optional[int] = None) -> Pipeline:
     """q7-core: MAX(price), COUNT(*) per tumbling window (device agg)."""
     local = LocalBarrierManager()
-    source = _source(local, store, 1, cfg, 1, rate_limit)
+    source = _source(local, store, 1, cfg, 1, rate_limit, min_chunks)
     s = source.schema
     project = ProjectExecutor(
         source,
@@ -119,13 +123,14 @@ def build_q7(store, cfg: NexmarkConfig,
 
 def build_q8(store, cfg_p: NexmarkConfig, cfg_a: NexmarkConfig,
              rate_limit: Optional[int] = 4,
-             window: Interval = DEFAULT_WINDOW) -> Pipeline:
+             window: Interval = DEFAULT_WINDOW,
+             min_chunks: Optional[int] = None) -> Pipeline:
     """q8: persons who created an auction in the same tumbling window.
 
     two sources → projects → auction-side hash-agg dedup → inner
     HashJoin (device matcher) → project → materialize."""
     local = LocalBarrierManager()
-    persons = _source(local, store, 1, cfg_p, 1, rate_limit)
+    persons = _source(local, store, 1, cfg_p, 1, rate_limit, min_chunks)
     ps = persons.schema
     p_proj = ProjectExecutor(
         persons,
@@ -134,7 +139,7 @@ def build_q8(store, cfg_p: NexmarkConfig, cfg_a: NexmarkConfig,
                tumble_start(InputRef(ps.index_of("date_time"),
                                      DataType.TIMESTAMP), window)],
         names=["id", "name", "starttime"])
-    auctions = _source(local, store, 2, cfg_a, 2, rate_limit)
+    auctions = _source(local, store, 2, cfg_a, 2, rate_limit, min_chunks)
     asch = auctions.schema
     a_proj = ProjectExecutor(
         auctions,

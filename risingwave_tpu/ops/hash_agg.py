@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass
+from functools import partial
 from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -291,69 +292,163 @@ def _update_call(spec: AggSpec, accs: List[jnp.ndarray], sl: slice,
     accs[hi_i], accs[lo_i] = new_hi, new_lo
 
 
-def build_apply(specs: Sequence[AggSpec]):
+def _has_valid_col(spec: AggSpec) -> bool:
+    """count(*) is the only call with no input → no non-null mask.
+    count(col) has zero value lanes but still needs its valid column."""
+    return spec.in_dtype is not None or spec.kind != AggKind.COUNT
+
+
+def packed_width(key_width: int, specs: Sequence[AggSpec]) -> int:
+    """Columns of the packed per-chunk input matrix.
+
+    Layout: key lanes | signs | vis | per call with input: lanes + valid.
+    Everything is int32 (f32 lanes travel bitcast) so the whole chunk is
+    ONE host→device transfer — through a tunneled device, per-array
+    transfer latency dominates, so fewer transfers beats nicer dtypes.
+    """
+    w = key_width + 2
+    for s in specs:
+        w += n_input_lanes(s) + (1 if _has_valid_col(s) else 0)
+    return w
+
+
+def pack_chunk(key_width: int, specs: Sequence[AggSpec],
+               key_lanes: np.ndarray, signs: np.ndarray, vis: np.ndarray,
+               inputs: Sequence) -> np.ndarray:
+    """Host-side chunk → one int32[N, W] matrix (vectorized column writes).
+
+    `inputs` is per call (value lane arrays, valid mask); count(*) calls
+    contribute no columns.
+    """
+    n = len(signs)
+    m = np.empty((n, packed_width(key_width, specs)), dtype=np.int32)
+    m[:, :key_width] = key_lanes
+    m[:, key_width] = signs
+    m[:, key_width + 1] = vis
+    c = key_width + 2
+    for s, (in_lanes, valid) in zip(specs, inputs):
+        if not _has_valid_col(s):
+            continue
+        for a in in_lanes:
+            a = np.asarray(a)
+            m[:, c] = a.view(np.int32) if a.dtype == np.float32 else a
+            c += 1
+        m[:, c] = np.asarray(valid)
+        c += 1
+    return m
+
+
+def build_apply(key_width: int, specs: Sequence[AggSpec]):
     """Compile the per-chunk step for a fixed agg plan.
 
-    step(state, key_lanes[N,K] i32, signs[N] i32, vis[N] bool,
-         inputs: tuple per call of (lanes tuple, valid[N] bool))
-    → (state, n_inserted). jit-cached per (cap, N).
+    step(state, packed int32[N, W]) → state. The packed matrix comes from
+    ``pack_chunk``; jit-cached per (cap, N).
     """
     specs = tuple(specs)
     slices = _call_slices(specs)
+    # column indices per call: (lane columns, valid column | None)
+    call_cols = []
+    c = key_width + 2
+    for s in specs:
+        nl = n_input_lanes(s)
+        if _has_valid_col(s):
+            call_cols.append((list(range(c, c + nl)), c + nl))
+            c += nl + 1
+        else:
+            call_cols.append(([], None))
 
-    def step(state: AggState, key_lanes, signs, vis, inputs):
+    def step(state: AggState, packed):
         cap = state.table.capacity
-        table, slots, ins = ht.probe_insert(state.table, key_lanes, vis)
+        key_lanes = packed[:, :key_width]
+        s32 = packed[:, key_width]
+        vis = packed[:, key_width + 1].astype(bool)
+        table, slots, _ins = ht.probe_insert(state.table, key_lanes, vis)
         scat = jnp.where(vis, slots, cap)   # invisible rows dropped
-        s32 = signs.astype(jnp.int32)
         group_rows = state.group_rows.at[scat].add(s32, mode="drop")
         dirty = state.dirty.at[scat].set(True, mode="drop")
         accs = list(state.accs)
-        for spec, sl, (in_lanes, val_ok) in zip(specs, slices, inputs):
+        all_true = jnp.ones(packed.shape[0], dtype=bool)
+        for spec, sl, (lc, vc) in zip(specs, slices, call_cols):
+            if spec.is_float_sum:
+                in_lanes = tuple(jax.lax.bitcast_convert_type(
+                    packed[:, i], jnp.float32) for i in lc)
+            else:
+                in_lanes = tuple(packed[:, i] for i in lc)
+            val_ok = all_true if vc is None else packed[:, vc].astype(bool)
             _update_call(spec, accs, sl, in_lanes, val_ok, slots, vis,
                          s32, cap)
         return AggState(table, group_rows, dirty, tuple(accs),
                         state.emitted_valid, state.emitted_rows,
-                        state.emitted_accs), ins
+                        state.emitted_accs)
 
     return jax.jit(step, donate_argnums=(0,))
 
 
-def build_flush(specs: Sequence[AggSpec]):
-    """Compile the barrier-flush gather/advance pair.
+def _col_i32(a: jnp.ndarray) -> jnp.ndarray:
+    if a.dtype == jnp.float32:
+        return jax.lax.bitcast_convert_type(a, jnp.int32)
+    if a.dtype == jnp.bool_:
+        return a.astype(jnp.int32)
+    return a
 
-    gather(state, idx[P]) → host-bound bundle for (padded) dirty slots.
-    advance(state, idx[P], live[P]) → emitted := current, dirty cleared.
+
+def build_gather_packed(key_width: int):
+    """Compile the barrier-flush gather: ONE packed device→host array.
+
+    gather(state, flush_cap) → int32[1 + flush_cap, W]. Row 0 is the
+    header [n_dirty, n_groups, 0…]; rows 1..1+n are the dirty slots:
+    slot idx | keys | group_rows | accs | emitted_valid | emitted_rows |
+    emitted accs (f32 accs bitcast). Dirty-slot compaction happens ON
+    DEVICE (cumsum positions) so the host never fetches the dirty bitmap;
+    the whole barrier costs one transfer. If n_dirty > flush_cap the host
+    retries with a doubled flush_cap (header tells it so).
     """
 
-    @jax.jit
-    def gather(state: AggState, idx):
-        safe = jnp.minimum(idx, state.table.capacity - 1)
-        return (
-            state.table.keys[safe],
-            state.group_rows[safe],
-            tuple(a[safe] for a in state.accs),
-            state.emitted_valid[safe],
-            state.emitted_rows[safe],
-            tuple(a[safe] for a in state.emitted_accs),
-        )
-
-    @jax.jit
-    def advance(state: AggState, idx, live):
+    @partial(jax.jit, static_argnums=(1,))
+    def gather(state: AggState, flush_cap: int):
         cap = state.table.capacity
-        safe = jnp.minimum(idx, cap - 1)
-        scat = jnp.where(live, idx, cap)
-        ev = state.emitted_valid.at[scat].set(
-            state.group_rows[safe] > 0, mode="drop")
-        er = state.emitted_rows.at[scat].set(
-            state.group_rows[safe], mode="drop")
-        ea = tuple(e.at[scat].set(a[safe], mode="drop")
-                   for e, a in zip(state.emitted_accs, state.accs))
-        return AggState(state.table, state.group_rows,
-                        jnp.zeros_like(state.dirty), state.accs,
-                        ev, er, ea)
+        dirty = state.dirty
+        d32 = dirty.astype(jnp.int32)
+        pos = jnp.cumsum(d32, dtype=jnp.int32) - 1
+        n_dirty = jnp.sum(d32, dtype=jnp.int32)
+        scat = jnp.where(dirty & (pos < flush_cap), pos, flush_cap)
+        slot_ids = jnp.arange(cap, dtype=jnp.int32)
+        idx = jnp.zeros(flush_cap, dtype=jnp.int32) \
+            .at[scat].set(slot_ids, mode="drop")
+        cols = [idx]
+        for k in range(key_width):
+            cols.append(state.table.keys[idx, k])
+        cols.append(state.group_rows[idx])
+        for a in state.accs:
+            cols.append(_col_i32(a[idx]))
+        cols.append(state.emitted_valid[idx].astype(jnp.int32))
+        cols.append(state.emitted_rows[idx])
+        for a in state.emitted_accs:
+            cols.append(_col_i32(a[idx]))
+        mat = jnp.stack(cols, axis=1)
+        n_groups = jnp.sum(state.table.occ, dtype=jnp.int32)
+        header = jnp.zeros((1, mat.shape[1]), dtype=jnp.int32) \
+            .at[0, 0].set(n_dirty).at[0, 1].set(n_groups)
+        return jnp.concatenate([header, mat], axis=0)
 
-    return gather, advance
+    return gather
+
+
+def build_advance():
+    """Compile the post-flush snapshot advance — fully on device, no
+    host index round-trip: emitted := current for every dirty slot."""
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def advance(state: AggState):
+        d = state.dirty
+        ev = jnp.where(d, state.group_rows > 0, state.emitted_valid)
+        er = jnp.where(d, state.group_rows, state.emitted_rows)
+        ea = tuple(jnp.where(d, a, e)
+                   for a, e in zip(state.accs, state.emitted_accs))
+        return AggState(state.table, state.group_rows,
+                        jnp.zeros_like(d), state.accs, ev, er, ea)
+
+    return advance
 
 
 def build_patch(specs: Sequence[AggSpec]):
@@ -427,22 +522,27 @@ def _nns_of(specs, dev_cols) -> List[Optional[np.ndarray]]:
 class GroupedAggKernel:
     """Host wrapper: growth scheduling, flush bookkeeping, jit caches.
 
-    The executor drives it: ``apply`` per chunk (no device syncs),
-    ``flush`` per barrier (one gather round-trip), ``rebuild`` on recovery.
+    The executor drives it: ``apply`` per chunk (ONE host→device transfer,
+    no syncs), ``flush`` per barrier (ONE device→host transfer),
+    ``rebuild`` on recovery. Occupancy is tracked as an upper bound
+    (rows seen since the last exact sync); the flush header carries the
+    exact group count for free, so steady state never syncs a scalar.
     """
 
     def __init__(self, key_width: int, specs: Sequence[AggSpec],
-                 capacity: int = ht.MIN_CAPACITY):
+                 capacity: int = ht.MIN_CAPACITY,
+                 flush_capacity: int = 1 << 12):
         capacity = max(next_pow2(capacity), ht.MIN_CAPACITY)
         self.specs = tuple(specs)
         self.key_width = key_width
         self.state = make_agg_state(capacity, key_width, self.specs)
-        self._apply = build_apply(self.specs)
-        self._gather, self._advance = build_flush(self.specs)
+        self._apply = build_apply(key_width, self.specs)
+        self._gather = build_gather_packed(key_width)
+        self._advance = build_advance()
         self._patch = build_patch(self.specs)
+        self._flush_cap = next_pow2(flush_capacity)
         self._count_exact = 0
-        self._pending_rows = 0
-        self._pending_counters: List[jnp.ndarray] = []
+        self._rows_since_sync = 0
         self._flush_idx: Optional[np.ndarray] = None
 
     @property
@@ -450,31 +550,33 @@ class GroupedAggKernel:
         return self.state.table.capacity
 
     # -- hot path -------------------------------------------------------
-    def apply(self, key_lanes: jnp.ndarray, signs: jnp.ndarray,
-              vis: jnp.ndarray, inputs: Tuple) -> None:
-        n = int(key_lanes.shape[0])
+    def apply(self, key_lanes: np.ndarray, signs: np.ndarray,
+              vis: np.ndarray, inputs: Sequence) -> None:
+        n = len(signs)
         assert n <= lanes.MAX_CHUNK_ROWS, \
             f"chunk capacity {n} > {lanes.MAX_CHUNK_ROWS} breaks limb math"
         self._reserve(n)
-        self.state, ins = self._apply(self.state, key_lanes, signs, vis,
-                                      inputs)
-        self._pending_counters.append(ins)
-        self._pending_rows += n
+        packed = pack_chunk(self.key_width, self.specs,
+                            np.asarray(key_lanes), np.asarray(signs),
+                            np.asarray(vis), inputs)
+        self.state = self._apply(self.state, jax.device_put(packed))
+        self._rows_since_sync += n
 
     # -- growth ---------------------------------------------------------
     def _reserve(self, n: int) -> None:
-        while (self._count_exact + self._pending_rows + n
-               > ht.MAX_LOAD * self.capacity):
-            if self._pending_counters:
-                self._sync_count()   # bound may be loose — sync first
-                continue
+        if (self._count_exact + self._rows_since_sync + n
+                <= ht.MAX_LOAD * self.capacity):
+            return
+        # bound crossed mid-epoch: collapse it with one exact occupancy
+        # sync (rare — the flush header refreshes the count every barrier)
+        self._sync_count()
+        while self._count_exact + n > ht.MAX_LOAD * self.capacity:
             self._grow()
 
     def _sync_count(self) -> None:
-        for c in self._pending_counters:
-            self._count_exact += int(c)
-        self._pending_counters = []
-        self._pending_rows = 0
+        self._count_exact = int(jnp.sum(
+            self.state.table.occ, dtype=jnp.int32))
+        self._rows_since_sync = 0
 
     def _grow(self) -> None:
         """Rehash into a doubled table, reclaiming dead groups.
@@ -506,36 +608,56 @@ class GroupedAggKernel:
         )
         # occupancy accounting restarts from the live population
         self._count_exact = int(n_live)
-        assert not self._pending_counters, "grow with unsynced counters"
+        self._rows_since_sync = 0
 
     # -- barrier flush ---------------------------------------------------
+    def _unpack_accs(self, data: np.ndarray, c0: int) -> List[np.ndarray]:
+        """Packed i32 matrix columns → device-layout acc arrays."""
+        out = []
+        for dt, _fill in dev_layout(self.specs):
+            col = np.ascontiguousarray(data[:, c0])
+            if dt == np.dtype(np.float32):
+                col = col.view(np.float32)
+            out.append(col)
+            c0 += 1
+        return out
+
     def flush(self) -> FlushResult:
-        """Gather dirty groups to host and decode. Call ``advance`` after
-        consuming (optionally ``patch_accs`` in between)."""
-        self._sync_count()
-        dirty = np.asarray(self.state.dirty)
-        idx = np.flatnonzero(dirty).astype(np.int32)
-        p = len(idx)
-        self._flush_idx = idx
+        """Gather dirty groups to host and decode — ONE device→host
+        transfer. Call ``advance`` after consuming (optionally
+        ``patch_accs`` in between)."""
+        while True:
+            mat = np.asarray(self._gather(self.state, self._flush_cap))
+            p = int(mat[0, 0])
+            self._count_exact = int(mat[0, 1])
+            self._rows_since_sync = 0
+            if p <= self._flush_cap:
+                break
+            self._flush_cap = max(self._flush_cap * 2, next_pow2(p))
         if p == 0:
+            self._flush_idx = np.zeros(0, dtype=np.int32)
             return FlushResult.empty(self.specs, self.key_width)
-        pad = next_pow2(p)
-        idx_padded = np.full(pad, self.capacity, dtype=np.int32)
-        idx_padded[:p] = idx
-        bundle = self._gather(self.state, jnp.asarray(idx_padded))
-        keys, rows, accs, was, prows, paccs = jax.device_get(bundle)
-        assert (rows[:p] >= 0).all(), \
+        data = mat[1:1 + p]
+        k = self.key_width
+        idx = np.ascontiguousarray(data[:, 0])
+        self._flush_idx = idx
+        keys = data[:, 1:1 + k]
+        rows = np.ascontiguousarray(data[:, 1 + k])
+        assert (rows >= 0).all(), \
             "group_rows wrapped int32 — a group exceeded 2^31 rows"
-        accs = [a[:p] for a in accs]
-        paccs = [a[:p] for a in paccs]
+        n_acc = len(dev_layout(self.specs))
+        accs = self._unpack_accs(data, 2 + k)
+        was = np.ascontiguousarray(data[:, 2 + k + n_acc]).astype(bool)
+        prows = np.ascontiguousarray(data[:, 3 + k + n_acc])
+        paccs = self._unpack_accs(data, 4 + k + n_acc)
         outs, nulls = decode_outputs(self.specs, accs)
         pouts, pnulls = decode_outputs(self.specs, paccs)
         return FlushResult(
-            n=p, keys=keys[:p],
-            group_rows=rows[:p].astype(np.int64),
+            n=p, keys=keys,
+            group_rows=rows.astype(np.int64),
             outs=outs, nulls=nulls, nns=_nns_of(self.specs, accs),
-            was_emitted=was[:p],
-            prev_rows=prows[:p].astype(np.int64),
+            was_emitted=was,
+            prev_rows=prows.astype(np.int64),
             prev_outs=pouts, prev_nulls=pnulls,
             prev_nns=_nns_of(self.specs, paccs))
 
@@ -558,19 +680,11 @@ class GroupedAggKernel:
                                  padded)
 
     def advance(self) -> None:
-        """Snapshot emitted := current for flushed groups; clear dirty."""
-        idx = self._flush_idx
-        assert idx is not None, "flush() first"
+        """Snapshot emitted := current for every dirty slot; clear dirty.
+        Fully on device — no transfers."""
+        assert self._flush_idx is not None, "flush() first"
         self._flush_idx = None
-        if len(idx) == 0:
-            return
-        pad = next_pow2(len(idx))
-        idx_padded = np.full(pad, self.capacity, dtype=np.int32)
-        idx_padded[:len(idx)] = idx
-        live = np.zeros(pad, dtype=bool)
-        live[:len(idx)] = True
-        self.state = self._advance(self.state, jnp.asarray(idx_padded),
-                                   jnp.asarray(live))
+        self.state = self._advance(self.state)
 
     # -- recovery ---------------------------------------------------------
     def rebuild(self, keys: np.ndarray, group_rows: np.ndarray,
@@ -585,8 +699,7 @@ class GroupedAggKernel:
         cap = max(self.capacity, next_pow2(int(n / ht.MAX_LOAD) + 1))
         self.state = make_agg_state(cap, self.key_width, self.specs)
         self._count_exact = n
-        self._pending_rows = 0
-        self._pending_counters = []
+        self._rows_since_sync = 0
         if n == 0:
             return
         dev_cols: List[np.ndarray] = []

@@ -25,7 +25,6 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import AsyncIterator, List, Optional, Sequence, Tuple
 
-import jax.numpy as jnp
 import numpy as np
 
 from risingwave_tpu.common.chunk import (
@@ -140,31 +139,25 @@ class HashAggExecutor(Executor):
             f"HashAggExecutor(actor={actor_id})"))
 
     # -- chunk path ------------------------------------------------------
-    def _key_lanes(self, chunk: StreamChunk) -> jnp.ndarray:
-        return jnp.asarray(build_key_lanes(chunk, self.group_indices))
-
     def _inputs(self, chunk: StreamChunk) -> Tuple:
-        """Per call: (device input lanes, valid mask)."""
-        ones = None
+        """Per call: (host input lane arrays, valid mask) — the kernel
+        packs everything into one int32 matrix (one transfer)."""
         out = []
         for call, spec in zip(self.agg_calls, self.specs):
             if call.input_idx is None:          # count(*)
-                if ones is None:
-                    ones = jnp.ones(chunk.capacity, dtype=bool)
-                out.append(((), ones))
+                out.append(((), None))
                 continue
             c = chunk.columns[call.input_idx]
-            in_lanes = tuple(jnp.asarray(a) for a in
-                             spec.encode_input(np.asarray(c.values)))
-            ok = jnp.ones(chunk.capacity, dtype=bool) \
-                if c.validity is None else jnp.asarray(c.validity)
+            in_lanes = spec.encode_input(np.asarray(c.values))
+            ok = np.ones(chunk.capacity, dtype=bool) \
+                if c.validity is None else np.asarray(c.validity)
             out.append((in_lanes, ok))
         return tuple(out)
 
     def _apply_chunk(self, chunk: StreamChunk) -> None:
-        self.kernel.apply(self._key_lanes(chunk),
-                          jnp.asarray(chunk.signs()),
-                          jnp.asarray(chunk.visibility),
+        self.kernel.apply(build_key_lanes(chunk, self.group_indices),
+                          chunk.signs(),
+                          np.asarray(chunk.visibility),
                           self._inputs(chunk))
 
     # -- barrier path ----------------------------------------------------

@@ -48,6 +48,7 @@ class SourceExecutor(Executor):
                  split_state: Optional[StateTable] = None,
                  actor_id: int = 0,
                  rate_limit_chunks_per_barrier: Optional[int] = None,
+                 min_chunks_per_barrier: Optional[int] = None,
                  identity: str = "SourceExecutor"):
         info = ExecutorInfo(reader.schema, [], identity)
         super().__init__(info)
@@ -58,6 +59,13 @@ class SourceExecutor(Executor):
         # optional throttle: max chunks generated per barrier interval
         # (FlowControlExecutor analog, keeps tests/bench deterministic)
         self.rate_limit = rate_limit_chunks_per_barrier
+        # optional floor: generate this many chunks per epoch BEFORE
+        # letting a waiting barrier win the select. The reference's
+        # "barrier always wins" rule assumes barriers arrive on a wall
+        # interval; under back-to-back injection (bench/test driving) it
+        # starves epochs down to one chunk. The floor restores real
+        # epoch sizes deterministically. None = reference behavior.
+        self.min_chunks = min_chunks_per_barrier
         self.paused = False
 
     # -- split-state persistence (state_table_handler.rs analog) --------
@@ -123,7 +131,9 @@ class SourceExecutor(Executor):
                     barrier = await self.barrier_rx.recv()  # blocking
                 except ChannelClosed:
                     return
-            elif chunks_this_epoch > 0:
+            elif chunks_this_epoch > 0 and (
+                    self.min_chunks is None
+                    or chunks_this_epoch >= self.min_chunks):
                 try:
                     barrier = self.barrier_rx.try_recv()
                 except ChannelClosed:

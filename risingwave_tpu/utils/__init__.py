@@ -1,0 +1,1 @@
+"""Host-side utilities (reference: src/utils/* grab-bag crates)."""

@@ -1,0 +1,29 @@
+"""JAX runtime knobs shared by bench/driver entry points.
+
+The stateful kernels compile one XLA program per (table capacity, chunk
+rows) shape pair; growth doublings therefore trigger a handful of
+compiles per process lifetime. The persistent compilation cache makes
+those a one-time cost per machine instead of per run — on a tunneled
+TPU a single kernel compile is ~0.5-1s, so a cold bench run would
+otherwise spend most of its wall clock in the compiler.
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
+
+
+def enable_compilation_cache(path: str | None = None) -> str:
+    """Point JAX's persistent compilation cache at a repo-local dir."""
+    import jax
+
+    cache_dir = path or os.environ.get("RW_TPU_JAX_CACHE", _DEFAULT_DIR)
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # cache small programs too — the kernels are latency-critical
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return cache_dir

@@ -62,10 +62,7 @@ def test_sharded_agg_matches_single_chip(eight_devices):
                   _mk_inputs(specs[1], vals, valid),
                   ((), valid)]
         sharded.apply(key_lanes, signs, vis, inputs)
-        single.apply(jnp.asarray(key_lanes), jnp.asarray(signs),
-                     jnp.asarray(vis),
-                     tuple((tuple(jnp.asarray(x) for x in l),
-                            jnp.asarray(v)) for l, v in inputs))
+        single.apply(key_lanes, signs, vis, inputs)
 
     got = sharded.snapshot()
     want = _single_chip_snapshot(single)
