@@ -298,18 +298,38 @@ def _has_valid_col(spec: AggSpec) -> bool:
     return spec.in_dtype is not None or spec.kind != AggKind.COUNT
 
 
-def packed_width(key_width: int, specs: Sequence[AggSpec]) -> int:
-    """Columns of the packed per-chunk input matrix.
+def packed_layout(key_width: int, specs: Sequence[AggSpec]
+                  ) -> List[Tuple[List[int], Optional[int]]]:
+    """Per-call (value-lane columns, valid column | None) of the packed
+    per-chunk input matrix — the ONE place the column cursor lives;
+    pack_chunk, build_apply and packed_width all consume it.
 
     Layout: key lanes | signs | vis | per call with input: lanes + valid.
     Everything is int32 (f32 lanes travel bitcast) so the whole chunk is
     ONE host→device transfer — through a tunneled device, per-array
     transfer latency dominates, so fewer transfers beats nicer dtypes.
     """
-    w = key_width + 2
+    out: List[Tuple[List[int], Optional[int]]] = []
+    c = key_width + 2
     for s in specs:
-        w += n_input_lanes(s) + (1 if _has_valid_col(s) else 0)
-    return w
+        if _has_valid_col(s):
+            nl = n_input_lanes(s)
+            out.append((list(range(c, c + nl)), c + nl))
+            c += nl + 1
+        else:
+            out.append(([], None))
+    return out
+
+
+def packed_width(key_width: int, specs: Sequence[AggSpec]) -> int:
+    lay = packed_layout(key_width, specs)
+    last = key_width + 1
+    for cols, vc in lay:
+        for i in cols:
+            last = max(last, i)
+        if vc is not None:
+            last = max(last, vc)
+    return last + 1
 
 
 def pack_chunk(key_width: int, specs: Sequence[AggSpec],
@@ -325,16 +345,13 @@ def pack_chunk(key_width: int, specs: Sequence[AggSpec],
     m[:, :key_width] = key_lanes
     m[:, key_width] = signs
     m[:, key_width + 1] = vis
-    c = key_width + 2
-    for s, (in_lanes, valid) in zip(specs, inputs):
-        if not _has_valid_col(s):
-            continue
-        for a in in_lanes:
+    for (cols, vc), (in_lanes, valid) in zip(
+            packed_layout(key_width, specs), inputs):
+        for c, a in zip(cols, in_lanes):
             a = np.asarray(a)
             m[:, c] = a.view(np.int32) if a.dtype == np.float32 else a
-            c += 1
-        m[:, c] = np.asarray(valid)
-        c += 1
+        if vc is not None:
+            m[:, vc] = np.asarray(valid)
     return m
 
 
@@ -346,16 +363,7 @@ def build_apply(key_width: int, specs: Sequence[AggSpec]):
     """
     specs = tuple(specs)
     slices = _call_slices(specs)
-    # column indices per call: (lane columns, valid column | None)
-    call_cols = []
-    c = key_width + 2
-    for s in specs:
-        nl = n_input_lanes(s)
-        if _has_valid_col(s):
-            call_cols.append((list(range(c, c + nl)), c + nl))
-            c += nl + 1
-        else:
-            call_cols.append(([], None))
+    call_cols = packed_layout(key_width, specs)
 
     def step(state: AggState, packed):
         cap = state.table.capacity

@@ -18,10 +18,12 @@ structure, the host's job is materialization):
   with one stable sort + shifted compares — no per-row host loop.
 - ``delete``: tombstone (live=False). Chains keep the node until a
   rebuild; probes skip dead rows.
-- ``probe``: two passes — a degree-count walk, a host sync for the output
-  size, then an emit walk writing (probe_row, matched_ref) pairs at
-  cumsum offsets. ``lax.while_loop`` runs exactly max-chain-length
-  iterations (dynamic trip count, static shapes).
+- ``probe``: ONE fused kernel — degree-count walk, device cumsum, emit
+  walk writing (probe_row, matched_ref) pairs at the cumsum offsets,
+  all returned as one packed matrix with a header (one device→host
+  transfer per chunk; host doubles the pair buffer and retries if the
+  header reports overflow). ``lax.while_loop`` runs exactly
+  max-chain-length iterations (dynamic trip count, static shapes).
 
 All lanes int32 (ops/lanes.py rationale).
 """
@@ -82,70 +84,62 @@ def tombstone_rows(chains: ChainState, row_refs: jnp.ndarray,
     return chains._replace(live=live)
 
 
-def _chain_walk(table: ht.TableState, chains: ChainState,
-                key_lanes, vis, body_extra, carry0):
-    """Shared chain-walk loop: calls body_extra(cur, is_match, carry)."""
+def probe_pairs(table: ht.TableState, chains: ChainState,
+                key_lanes: jnp.ndarray, vis: jnp.ndarray,
+                out_cap: int) -> jnp.ndarray:
+    """Fused degrees + cumsum + emit: ONE kernel, ONE packed d2h array.
+
+    Returns int32[1 + n + out_cap, 2]: row 0 header [total_pairs, 0];
+    rows 1..1+n degrees (col 0); remaining rows (probe_row_idx, ref)
+    pairs at device-computed cumsum offsets. Through a tunneled device
+    the separate degrees fetch + host cumsum + emit fetch cost three
+    round-trips per chunk; this costs one (the host retries with a
+    doubled out_cap if the header says the pair buffer overflowed).
+    """
+    n = key_lanes.shape[0]
     slots = ht.lookup(table, key_lanes, vis)
     cur0 = jnp.where(slots >= 0,
                      chains.head[jnp.maximum(slots, 0)], jnp.int32(-1))
 
     def cond(c):
-        cur = c[0]
-        return jnp.any(cur >= 0)
+        return jnp.any(c[0] >= 0)
 
-    def body(c):
-        cur, carry = c
+    def body1(c):
+        cur, deg = c
         safe = jnp.maximum(cur, 0)
-        is_match = (cur >= 0) & chains.live[safe]
-        carry = body_extra(cur, is_match, carry)
-        cur = jnp.where(cur >= 0, chains.next[safe], jnp.int32(-1))
-        return cur, carry
+        m = (cur >= 0) & chains.live[safe]
+        return (jnp.where(cur >= 0, chains.next[safe], jnp.int32(-1)),
+                deg + m.astype(jnp.int32))
 
-    _cur, carry = jax.lax.while_loop(cond, body, (cur0, carry0))
-    return carry
-
-
-def probe_degrees(table: ht.TableState, chains: ChainState,
-                  key_lanes: jnp.ndarray, vis: jnp.ndarray) -> jnp.ndarray:
-    """Matches per probe row (live rows in the key's chain)."""
-    n = key_lanes.shape[0]
-
-    def acc(cur, is_match, deg):
-        return deg + is_match.astype(jnp.int32)
-
-    return _chain_walk(table, chains, key_lanes, vis, acc,
-                       jnp.zeros(n, dtype=jnp.int32))
-
-
-def probe_emit(table: ht.TableState, chains: ChainState,
-               key_lanes: jnp.ndarray, vis: jnp.ndarray,
-               offsets: jnp.ndarray, out_cap: int
-               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Write (probe_row_idx, matched_ref) pairs at cumsum offsets.
-
-    out_cap is static (host computed next_pow2(total degrees))."""
-    n = key_lanes.shape[0]
+    _cur, deg = jax.lax.while_loop(
+        cond, body1, (cur0, jnp.zeros(n, dtype=jnp.int32)))
+    offsets = jnp.cumsum(deg, dtype=jnp.int32) - deg
+    total = jnp.sum(deg, dtype=jnp.int32)
     row_ids = jnp.arange(n, dtype=jnp.int32)
-    out_probe = jnp.full(out_cap, -1, dtype=jnp.int32)
-    out_ref = jnp.full(out_cap, -1, dtype=jnp.int32)
 
-    def emit(cur, is_match, carry):
-        wp, op, orf = carry
-        dest = jnp.where(is_match, wp, out_cap)
+    def body2(c):
+        cur, wp, op, orf = c
+        safe = jnp.maximum(cur, 0)
+        m = (cur >= 0) & chains.live[safe]
+        dest = jnp.where(m, wp, out_cap)
         op = op.at[dest].set(row_ids, mode="drop")
         orf = orf.at[dest].set(cur, mode="drop")
-        return wp + is_match.astype(jnp.int32), op, orf
+        return (jnp.where(cur >= 0, chains.next[safe], jnp.int32(-1)),
+                wp + m.astype(jnp.int32), op, orf)
 
-    _wp, out_probe, out_ref = _chain_walk(
-        table, chains, key_lanes, vis, emit,
-        (offsets.astype(jnp.int32), out_probe, out_ref))
-    return out_probe, out_ref
+    _cur, _wp, out_probe, out_ref = jax.lax.while_loop(
+        cond, body2,
+        (cur0, offsets, jnp.full(out_cap, -1, dtype=jnp.int32),
+         jnp.full(out_cap, -1, dtype=jnp.int32)))
+    pairs = jnp.stack([out_probe, out_ref], axis=1)
+    degs = jnp.stack([deg, jnp.zeros(n, dtype=jnp.int32)], axis=1)
+    header = jnp.zeros((1, 2), dtype=jnp.int32).at[0, 0].set(total)
+    return jnp.concatenate([header, degs, pairs], axis=0)
 
 
 _link_jit = jax.jit(link_rows, donate_argnums=(0,), static_argnums=(4,))
 _tombstone_jit = jax.jit(tombstone_rows, donate_argnums=(0,))
-_degrees_jit = jax.jit(probe_degrees)
-_emit_jit = jax.jit(probe_emit, static_argnums=(5,))
+_probe_pairs_jit = jax.jit(probe_pairs, static_argnums=(4,))
 
 
 def _remap_head(head: jnp.ndarray, old_to_new: jnp.ndarray,
@@ -171,10 +165,14 @@ class JoinSideKernel:
 
     def __init__(self, key_width: int,
                  key_capacity: int = ht.MIN_CAPACITY,
-                 row_capacity: int = ht.MIN_CAPACITY):
+                 row_capacity: int = ht.MIN_CAPACITY,
+                 probe_capacity: int = 1 << 14):
         self.key_width = key_width
         self.table = ht.DeviceHashTable(key_width, key_capacity)
         self.table.on_grow(self._on_table_grow)
+        # pair-output buffer rows for the fused probe; doubles on
+        # overflow (kept generous: each size is a fresh XLA compile)
+        self._probe_cap = probe_capacity
         self.chains = ChainState(
             head=jnp.full(self.table.capacity, -1, dtype=jnp.int32),
             next=jnp.full(row_capacity, -1, dtype=jnp.int32),
@@ -221,24 +219,23 @@ class JoinSideKernel:
 
     def probe(self, key_lanes: jnp.ndarray, vis: jnp.ndarray
               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """(degrees, probe_idx[pairs], refs[pairs]) — one host sync."""
-        deg = np.asarray(_degrees_jit(self.table.state, self.chains,
-                                      key_lanes, vis))
-        total = int(deg.sum())
-        if total == 0:
-            z = np.zeros(0, dtype=np.int32)
-            return deg, z, z
-        offsets = np.cumsum(deg) - deg
-        from risingwave_tpu.common.chunk import next_pow2
-        # floor at 1024: collapses the 1..512 pow2 buckets into one jit
-        # entry — small probes dominate tests and warmup, and each
-        # distinct out_cap is a fresh XLA compile.
-        out_cap = max(1024, next_pow2(total))
-        op, orf = _emit_jit(self.table.state, self.chains, key_lanes, vis,
-                            jnp.asarray(offsets.astype(np.int32)), out_cap)
-        op = np.asarray(op)[:total]
-        orf = np.asarray(orf)[:total]
-        return deg, op, orf
+        """(degrees, probe_idx[pairs], refs[pairs]) — ONE device→host
+        transfer (fused probe_pairs kernel; doubles the pair buffer and
+        retries if the header reports overflow)."""
+        n = int(key_lanes.shape[0])
+        while True:
+            mat = np.asarray(_probe_pairs_jit(
+                self.table.state, self.chains, key_lanes, vis,
+                self._probe_cap))
+            total = int(mat[0, 0])
+            if total <= self._probe_cap:
+                break
+            from risingwave_tpu.common.chunk import next_pow2
+            self._probe_cap = max(self._probe_cap * 2, next_pow2(total))
+        deg = np.ascontiguousarray(mat[1:1 + n, 0])
+        pairs = mat[1 + n:1 + n + total]
+        return (deg, np.ascontiguousarray(pairs[:, 0]),
+                np.ascontiguousarray(pairs[:, 1]))
 
     # -- recovery ---------------------------------------------------------
     def rebuild(self, key_lanes: np.ndarray, row_refs: np.ndarray) -> None:

@@ -361,10 +361,12 @@ class HashJoinExecutor(Executor):
             elif tag in ("left", "right"):
                 if isinstance(msg, StreamChunk):
                     i = 0 if tag == "left" else 1
-                    lanes_np = build_key_lanes(
-                        msg, self.sides[i].key_indices)
-                    out = self._emit(i, msg, lanes_np)
+                    # one host→device upload of the key lanes, shared by
+                    # the probe and this side's insert
+                    lanes_dev = jnp.asarray(build_key_lanes(
+                        msg, self.sides[i].key_indices))
+                    out = self._emit(i, msg, lanes_dev)
                     if out is not None:
                         yield out
-                    self.sides[i].apply_chunk(msg, lanes_np)
+                    self.sides[i].apply_chunk(msg, lanes_dev)
                 # watermarks: forwarded only for join-key cols — deferred

@@ -297,3 +297,19 @@ def test_join_recovery_resumes():
                 [barrier(3), rchunk([1], ["b"]), barrier(4)])
     msgs = asyncio.run(collect_until_n_barriers(ex2, 2))
     assert materialize_join(msgs) == Counter({(1, 10, 1, "b"): 1})
+
+
+def test_probe_pair_buffer_overflow_retries():
+    """probe_capacity=1 forces the pair-buffer double/retry path."""
+    k = JoinSideKernel(key_width=1, probe_capacity=1)
+    keys = jnp.asarray([[3]] * 9 + [[4]] * 7, dtype=jnp.int32)
+    refs = np.arange(16, dtype=np.int32)
+    k.insert(keys, refs, jnp.ones(16, dtype=bool))
+    deg, pidx, prefs = k.probe(
+        jnp.asarray([[3], [4], [5]], dtype=jnp.int32),
+        jnp.ones(3, dtype=bool))
+    assert deg.tolist() == [9, 7, 0]
+    assert k._probe_cap >= 16
+    assert {int(r) for p, r in zip(pidx, prefs) if p == 0} == set(range(9))
+    assert {int(r) for p, r in zip(pidx, prefs) if p == 1} == \
+        set(range(9, 16))
