@@ -1,0 +1,18 @@
+"""Persistent state backend (hummock-lite).
+
+Reference parity: src/storage/ — the Hummock LSM over object storage
+(store.rs:72 traits, sstable/builder.rs:91 SST format, event_handler/
+uploader.rs:567 checkpoint upload, compactor/). Re-designed small:
+same *semantics* (epoch-MVCC keys, snapshot reads at a committed epoch,
+shared-buffer → SST upload at checkpoint, version deltas, compaction),
+different encoding details.
+"""
+
+from risingwave_tpu.storage.object_store import (
+    LocalFsObjectStore, MemObjectStore, ObjectStore,
+)
+from risingwave_tpu.storage.hummock import HummockLite
+
+__all__ = [
+    "ObjectStore", "MemObjectStore", "LocalFsObjectStore", "HummockLite",
+]

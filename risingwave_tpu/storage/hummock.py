@@ -1,0 +1,347 @@
+"""HummockLite: LSM state store over an object store.
+
+Reference parity (semantics, not format):
+- shared buffer / imms / upload-at-checkpoint:
+  src/storage/src/hummock/event_handler/uploader.rs:567 — unsealed
+  writes buffer per epoch; seal turns them immutable; ``sync(epoch)``
+  builds one SST from all imms ≤ epoch and uploads it (the barrier
+  commit's durability point, meta commit_epoch analog).
+- version: L0 (time-ordered whole SSTs, newest last) + L1
+  (key-disjoint sorted runs), persisted as a JSON version snapshot in
+  the object store with a CURRENT pointer (HummockVersion/-Delta,
+  src/meta/src/hummock/manager/mod.rs:1335). Restart loads CURRENT —
+  recovery reads resume at the committed epoch.
+- reads: merge shared-buffer → imms → L0 (newest first) → L1 with
+  bloom-filter pruning for point gets (hummock_storage.rs read path).
+- compaction: when L0 grows past a threshold, a full merge of L0+L1
+  rewrites key-disjoint L1 runs, dropping versions shadowed below the
+  committed epoch and freeing objects (compactor/compactor_runner.rs,
+  vacuum.rs — collapsed to one in-process routine).
+"""
+
+from __future__ import annotations
+
+import heapq
+import json
+import struct
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+from risingwave_tpu.state.store import StateStore, Value
+from risingwave_tpu.storage.object_store import ObjectStore
+from risingwave_tpu.storage.sst import (
+    EPOCH_MASK, Sst, SstBuilder, full_key, split_full_key,
+)
+from risingwave_tpu.storage.value_codec import decode_row, encode_row
+
+L0_COMPACT_THRESHOLD = 4
+L1_TARGET_SST_BYTES = 4 * 1024 * 1024
+
+
+class HummockLite(StateStore):
+    """Single-process LSM store: StateStore for every table id."""
+
+    def __init__(self, obj: ObjectStore) -> None:
+        self.obj = obj
+        # unsealed writes: epoch → table → key → (tombstone, row)
+        self._mem: Dict[int, Dict[int, Dict[bytes, Value]]] = {}
+        # sealed, not yet synced: newest last
+        self._imms: List[Tuple[int, Dict[int, Dict[bytes, Value]]]] = []
+        self._sealed_epoch = 0
+        self._committed_epoch = 0
+        self._version_id = 0
+        self._next_sst_id = 1
+        self._l0: List[dict] = []       # SST infos, newest LAST
+        self._l1: List[dict] = []       # key-disjoint, sorted by smallest
+        self._cache: Dict[int, Sst] = {}
+        self._load_current()
+
+    # -- manifest ---------------------------------------------------------
+    def _load_current(self) -> None:
+        if not self.obj.exists("meta/CURRENT"):
+            return
+        vid = int(self.obj.read("meta/CURRENT").decode())
+        v = json.loads(self.obj.read(f"meta/v{vid}.json").decode())
+        self._version_id = v["version_id"]
+        self._committed_epoch = v["committed_epoch"]
+        self._sealed_epoch = v["committed_epoch"]
+        self._next_sst_id = v["next_sst_id"]
+        self._l0 = v["l0"]
+        self._l1 = v["l1"]
+
+    def _commit_version(self) -> None:
+        self._version_id += 1
+        v = {
+            "version_id": self._version_id,
+            "committed_epoch": self._committed_epoch,
+            "next_sst_id": self._next_sst_id,
+            "l0": self._l0,
+            "l1": self._l1,
+        }
+        self.obj.upload(f"meta/v{self._version_id}.json",
+                        json.dumps(v).encode())
+        self.obj.upload("meta/CURRENT", str(self._version_id).encode())
+        old = f"meta/v{self._version_id - 2}.json"
+        if self.obj.exists(old):
+            self.obj.delete(old)
+
+    # -- write path -------------------------------------------------------
+    def ingest_batch(self, table_id: int,
+                     batch: Iterable[Tuple[bytes, Value]],
+                     epoch: int) -> int:
+        if epoch <= self._sealed_epoch:
+            raise ValueError(
+                f"write at epoch {epoch} <= sealed {self._sealed_epoch}")
+        t = self._mem.setdefault(epoch, {}).setdefault(table_id, {})
+        n = 0
+        for key, value in batch:
+            t[key] = value
+            n += 1
+        return n
+
+    def seal_epoch(self, epoch: int, is_checkpoint: bool = True) -> None:
+        assert epoch >= self._sealed_epoch, (epoch, self._sealed_epoch)
+        self._sealed_epoch = epoch
+        for e in sorted(self._mem):
+            if e <= epoch:
+                self._imms.append((e, self._mem.pop(e)))
+        self._imms.sort(key=lambda t: t[0])
+
+    def sync(self, epoch: int) -> dict:
+        """Upload all imms ≤ epoch as one SST; commit the version."""
+        take = [im for im in self._imms if im[0] <= epoch]
+        self._imms = [im for im in self._imms if im[0] > epoch]
+        info = None
+        if take:
+            entries: List[Tuple[bytes, bool, bytes]] = []
+            for e, tables in take:
+                for table_id, kv in tables.items():
+                    for key, value in kv.items():
+                        fk = full_key(table_id, key, e)
+                        tomb = value is None
+                        entries.append(
+                            (fk, tomb, b"" if tomb else encode_row(value)))
+            entries.sort(key=lambda t: t[0])
+            sst_id = self._next_sst_id
+            self._next_sst_id += 1
+            b = SstBuilder(sst_id)
+            for fk, tomb, row in entries:
+                b.add(fk, tomb, row)
+            data, info = b.finish()
+            self.obj.upload(f"data/{sst_id}.sst", data)
+            self._l0.append(info)
+        self._committed_epoch = max(self._committed_epoch, epoch)
+        if len(self._l0) >= L0_COMPACT_THRESHOLD:
+            self.compact()
+        else:
+            self._commit_version()
+        return {"sst": info}
+
+    def committed_epoch(self) -> int:
+        return self._committed_epoch
+
+    # -- SST access -------------------------------------------------------
+    def _sst(self, info: dict) -> Sst:
+        s = self._cache.get(info["id"])
+        if s is None:
+            s = Sst(self.obj.read(f"data/{info['id']}.sst"), info)
+            self._cache[info["id"]] = s
+        return s
+
+    # -- read path --------------------------------------------------------
+    def get(self, table_id: int, key: bytes, epoch: int) -> Value:
+        # 1) unsealed epochs, newest first
+        for e in sorted(self._mem, reverse=True):
+            if e > epoch:
+                continue
+            kv = self._mem[e].get(table_id)
+            if kv is not None and key in kv:
+                return kv[key]
+        # 2) imms, newest first
+        for e, tables in reversed(self._imms):
+            if e > epoch:
+                continue
+            kv = tables.get(table_id)
+            if kv is not None and key in kv:
+                return kv[key]
+        # 3) L0 newest → oldest, then L1 (bloom-pruned point lookups)
+        for info in reversed(self._l0):
+            if info["min_epoch"] > epoch:
+                continue
+            hit = self._sst(info).get(table_id, key, epoch)
+            if hit is not None:
+                _found, tomb, row = hit
+                return None if tomb else decode_row(row)
+        lo = self._l1_candidate(table_id, key)
+        if lo is not None:
+            hit = self._sst(self._l1[lo]).get(table_id, key, epoch)
+            if hit is not None:
+                _found, tomb, row = hit
+                return None if tomb else decode_row(row)
+        return None
+
+    def _l1_candidate(self, table_id: int, key: bytes) -> Optional[int]:
+        """Run that could hold (table, key) — compare USER-key prefixes;
+        the inverted-epoch suffix would mis-order full-key compares."""
+        if not self._l1:
+            return None
+        target = full_key(table_id, key, 0)[:-8]
+        lo, hi, ans = 0, len(self._l1) - 1, None
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            if bytes.fromhex(self._l1[mid]["smallest"])[:-8] <= target:
+                ans = mid
+                lo = mid + 1
+            else:
+                hi = mid - 1
+        if ans is None:
+            return None
+        # key beyond this run's largest user key ⇒ in no run (disjoint)
+        if bytes.fromhex(self._l1[ans]["largest"])[:-8] < target:
+            return None
+        return ans
+
+    def iter(self, table_id: int, epoch: int,
+             start: Optional[bytes] = None, end: Optional[bytes] = None
+             ) -> Iterator[Tuple[bytes, tuple]]:
+        """Snapshot range scan: newest version ≤ epoch per key, no
+        tombstones — a k-way merge across all layers."""
+        start = start or b""
+        sources = []
+        rank = 0
+
+        def mem_source(e: int, kv: Dict[bytes, Value], r: int):
+            inv = (~e) & EPOCH_MASK
+            for k in sorted(kv):
+                if k < start or (end is not None and k >= end):
+                    continue
+                yield (k, inv, r, kv[k])
+
+        for e in sorted(self._mem, reverse=True):
+            if e <= epoch:
+                kv = self._mem[e].get(table_id)
+                if kv:
+                    sources.append(mem_source(e, kv, rank))
+                    rank += 1
+        for e, tables in reversed(self._imms):
+            if e <= epoch:
+                kv = tables.get(table_id)
+                if kv:
+                    sources.append(mem_source(e, kv, rank))
+                    rank += 1
+
+        def sst_source(sst: Sst, r: int):
+            sfk = full_key(table_id, start, EPOCH_MASK)
+            for fk, tomb, row in sst.iter_from(sfk):
+                t, uk, e = split_full_key(fk)
+                if t != table_id:
+                    break
+                if end is not None and uk >= end:
+                    break
+                if e > epoch:
+                    continue
+                yield (uk, (~e) & EPOCH_MASK, r,
+                       None if tomb else decode_row(row))
+
+        for info in reversed(self._l0):
+            sources.append(sst_source(self._sst(info), rank))
+            rank += 1
+        for info in self._l1:
+            sources.append(sst_source(self._sst(info), rank))
+            rank += 1
+
+        last_key: Optional[bytes] = None
+        for uk, _inv, _r, value in heapq.merge(
+                *sources, key=lambda t: (t[0], t[1], t[2])):
+            if uk == last_key:
+                continue
+            last_key = uk
+            if value is not None:
+                yield uk, value
+
+    # -- compaction -------------------------------------------------------
+    def compact(self) -> None:
+        """Full merge of L0+L1 into fresh key-disjoint L1 runs.
+
+        Versions shadowed below the committed epoch are dropped; a
+        tombstone that is the newest surviving version of its key is
+        dropped with the key (nothing older remains after a full merge).
+        Old objects are deleted after the new version commits (vacuum).
+        """
+        olds = list(self._l0) + list(self._l1)
+        if not olds:
+            self._commit_version()
+            return
+        safe = self._committed_epoch
+
+        def source(info: dict, r: int):
+            for fk, tomb, row in self._sst(info).iter_from(b""):
+                yield (fk, r, tomb, row)
+
+        merged = heapq.merge(
+            *[source(info, r)
+              for r, info in enumerate(reversed(list(self._l0)))] +
+            [source(info, len(self._l0) + r)
+             for r, info in enumerate(self._l1)],
+            key=lambda t: (t[0], t[1]))
+
+        new_infos: List[dict] = []
+        builder: Optional[SstBuilder] = None
+        last_tu: Optional[bytes] = None
+        kept_le_safe = False
+
+        def out(fk: bytes, tomb: bool, row: bytes) -> None:
+            nonlocal builder
+            # cut SSTs ONLY at user-key boundaries: all versions of one
+            # key must live in one run or _l1_candidate's disjoint-run
+            # binary search would find the wrong (stale) run
+            if (builder is not None
+                    and builder._off + builder.block.size()
+                    >= L1_TARGET_SST_BYTES
+                    and builder.largest is not None
+                    and builder.largest[:-8] != fk[:-8]):
+                data, info = builder.finish()
+                self.obj.upload(f"data/{info['id']}.sst", data)
+                new_infos.append(info)
+                builder = None
+            if builder is None:
+                builder = SstBuilder(self._next_sst_id)
+                self._next_sst_id += 1
+            builder.add(fk, tomb, row)
+
+        seen_fk: Optional[bytes] = None
+        for fk, _r, tomb, row in merged:
+            if fk == seen_fk:
+                continue               # same key+epoch: newer layer wins
+            seen_fk = fk
+            tu = fk[:-8]
+            _t, _u, e = split_full_key(fk)
+            if tu != last_tu:
+                last_tu = tu
+                kept_le_safe = False
+            if e > safe:
+                out(fk, tomb, row)
+                continue
+            if kept_le_safe:
+                continue               # older shadowed version: drop
+            kept_le_safe = True
+            if tomb:
+                continue               # newest ≤ safe is a delete: gone
+            out(fk, tomb, row)
+        if builder is not None:
+            data, info = builder.finish()
+            self.obj.upload(f"data/{info['id']}.sst", data)
+            new_infos.append(info)
+        self._l0 = []
+        self._l1 = new_infos
+        self._commit_version()
+        for info in olds:              # vacuum after commit
+            self.obj.delete(f"data/{info['id']}.sst")
+            self._cache.pop(info["id"], None)
+
+    # -- test/debug helpers ----------------------------------------------
+    def table_size(self, table_id: int, epoch: int) -> int:
+        return sum(1 for _ in self.iter(table_id, epoch))
+
+    @property
+    def levels(self) -> Tuple[int, int]:
+        return len(self._l0), len(self._l1)

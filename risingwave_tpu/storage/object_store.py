@@ -1,0 +1,101 @@
+"""Object store abstraction.
+
+Reference parity: src/object_store/src/object/mod.rs:81-121 — the
+`ObjectStore` trait (upload/read/delete/list) with S3/OpenDAL/mem
+backends. Here: an in-memory backend for tests and a local-FS backend
+(atomic temp+rename writes) standing in for cloud object storage; the
+interface is what matters — hummock-lite only ever uploads immutable
+whole objects and reads them back, exactly the reference's access
+pattern (SSTs are write-once).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Dict, List, Protocol
+
+
+class ObjectStore(Protocol):
+    def upload(self, path: str, data: bytes) -> None: ...
+
+    def read(self, path: str) -> bytes: ...
+
+    def delete(self, path: str) -> None: ...
+
+    def list(self, prefix: str) -> List[str]: ...
+
+    def exists(self, path: str) -> bool: ...
+
+
+class MemObjectStore:
+    """In-memory object store (object/mem.rs analog)."""
+
+    def __init__(self) -> None:
+        self._objects: Dict[str, bytes] = {}
+
+    def upload(self, path: str, data: bytes) -> None:
+        self._objects[path] = bytes(data)
+
+    def read(self, path: str) -> bytes:
+        return self._objects[path]
+
+    def delete(self, path: str) -> None:
+        self._objects.pop(path, None)
+
+    def list(self, prefix: str) -> List[str]:
+        return sorted(p for p in self._objects if p.startswith(prefix))
+
+    def exists(self, path: str) -> bool:
+        return path in self._objects
+
+
+class LocalFsObjectStore:
+    """Filesystem-backed store (OpenDAL-fs analog); atomic uploads."""
+
+    def __init__(self, root: str) -> None:
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _abs(self, path: str) -> str:
+        p = os.path.normpath(os.path.join(self.root, path))
+        if not (p == self.root or p.startswith(self.root + os.sep)):
+            raise ValueError(f"path escapes object-store root: {path}")
+        return p
+
+    def upload(self, path: str, data: bytes) -> None:
+        dst = self._abs(path)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(dst))
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, dst)          # atomic publish
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def read(self, path: str) -> bytes:
+        with open(self._abs(path), "rb") as f:
+            return f.read()
+
+    def delete(self, path: str) -> None:
+        try:
+            os.unlink(self._abs(path))
+        except FileNotFoundError:
+            pass
+
+    def list(self, prefix: str) -> List[str]:
+        out = []
+        root = os.path.abspath(self.root)
+        for dirpath, _dirs, files in os.walk(root):
+            for fn in files:
+                rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                rel = rel.replace(os.sep, "/")
+                if rel.startswith(prefix):
+                    out.append(rel)
+        return sorted(out)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(self._abs(path))

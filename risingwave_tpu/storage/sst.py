@@ -1,0 +1,275 @@
+"""SST: immutable sorted-run file format.
+
+Reference parity: src/storage/src/hummock/sstable/{builder.rs:91,
+block.rs, xor_filter.rs} and the FullKey encoding of
+hummock_sdk/src/key.rs:48-79 — same *semantics*, smaller format:
+
+  full key  = table_id(4B BE) ++ user_key ++ (~epoch)(8B BE)
+              → byte order == (table, key asc, epoch DESC): the newest
+              version of a key is the first one an iterator meets.
+  block     = restart-interval prefix-compressed entries
+              [shared][unshared][vlen][key suffix][value]; value byte 0
+              is the tombstone flag, the rest is value_codec row bytes.
+  filter    = split-block Bloom (10 bits/key, k=7) over
+              table_id ++ user_key — point-get pruning, same role as
+              the reference's xor filter.
+  footer    = block index (first key + offset + len per block),
+              smallest/largest key, epoch range, magic.
+
+Builders take entries pre-sorted (the LSM merge guarantees it);
+everything is write-once (object-store friendly).
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from risingwave_tpu.storage.value_codec import (
+    read_uvarint, write_uvarint,
+)
+
+MAGIC = b"RWT1"
+BLOCK_TARGET = 64 * 1024
+RESTART_INTERVAL = 16
+BLOOM_BITS_PER_KEY = 10
+BLOOM_K = 7
+
+EPOCH_MASK = (1 << 64) - 1
+
+
+def full_key(table_id: int, user_key: bytes, epoch: int) -> bytes:
+    return (struct.pack(">I", table_id) + user_key
+            + struct.pack(">Q", (~epoch) & EPOCH_MASK))
+
+
+def split_full_key(fk: bytes) -> Tuple[int, bytes, int]:
+    table_id = struct.unpack_from(">I", fk, 0)[0]
+    epoch = (~struct.unpack_from(">Q", fk, len(fk) - 8)[0]) & EPOCH_MASK
+    return table_id, fk[4:-8], epoch
+
+
+def _bloom_hashes(data: bytes) -> Tuple[int, int]:
+    h1 = zlib.crc32(data) & 0xFFFFFFFF
+    h2 = zlib.crc32(data, 0x9E3779B9) & 0xFFFFFFFF
+    return h1, h2 | 1
+
+
+class _BloomBuilder:
+    def __init__(self) -> None:
+        self.hashes: List[Tuple[int, int]] = []
+
+    def add(self, data: bytes) -> None:
+        self.hashes.append(_bloom_hashes(data))
+
+    def finish(self) -> bytes:
+        n = max(1, len(self.hashes))
+        nbits = max(64, n * BLOOM_BITS_PER_KEY)
+        nbits = (nbits + 7) // 8 * 8
+        bits = np.zeros(nbits, dtype=bool)
+        for h1, h2 in self.hashes:
+            for i in range(BLOOM_K):
+                bits[(h1 + i * h2) % nbits] = True
+        return np.packbits(bits).tobytes()
+
+
+def bloom_may_contain(filter_bytes: bytes, data: bytes) -> bool:
+    if not filter_bytes:
+        return True
+    nbits = len(filter_bytes) * 8
+    h1, h2 = _bloom_hashes(data)
+    for i in range(BLOOM_K):
+        bit = (h1 + i * h2) % nbits
+        if not (filter_bytes[bit >> 3] >> (7 - (bit & 7))) & 1:
+            return False
+    return True
+
+
+class _BlockBuilder:
+    def __init__(self) -> None:
+        self.buf = bytearray()
+        self.count = 0
+        self.last_key = b""
+        self.first_key = b""
+
+    def add(self, key: bytes, value: bytes) -> None:
+        if self.count % RESTART_INTERVAL == 0:
+            shared = 0
+        else:
+            shared = 0
+            m = min(len(key), len(self.last_key))
+            while shared < m and key[shared] == self.last_key[shared]:
+                shared += 1
+        if self.count == 0:
+            self.first_key = key
+        write_uvarint(self.buf, shared)
+        write_uvarint(self.buf, len(key) - shared)
+        write_uvarint(self.buf, len(value))
+        self.buf.extend(key[shared:])
+        self.buf.extend(value)
+        self.last_key = key
+        self.count += 1
+
+    def size(self) -> int:
+        return len(self.buf)
+
+    def finish(self) -> bytes:
+        return bytes(self.buf)
+
+
+def iter_block(data: bytes) -> Iterator[Tuple[bytes, bytes]]:
+    pos = 0
+    key = b""
+    n = len(data)
+    while pos < n:
+        shared, pos = read_uvarint(data, pos)
+        unshared, pos = read_uvarint(data, pos)
+        vlen, pos = read_uvarint(data, pos)
+        key = key[:shared] + data[pos:pos + unshared]
+        pos += unshared
+        value = data[pos:pos + vlen]
+        pos += vlen
+        yield key, value
+
+
+class SstBuilder:
+    """Builds one SST from pre-sorted (full_key, tombstone, row_bytes)."""
+
+    def __init__(self, sst_id: int) -> None:
+        self.sst_id = sst_id
+        self.blocks: List[bytes] = []
+        self.index: List[Tuple[bytes, int, int]] = []  # first_key, off, len
+        self.block = _BlockBuilder()
+        self.bloom = _BloomBuilder()
+        self.smallest: Optional[bytes] = None
+        self.largest: Optional[bytes] = None
+        self.count = 0
+        self.min_epoch = EPOCH_MASK
+        self.max_epoch = 0
+        self._off = 0
+        self._last_user = None
+
+    def add(self, fk: bytes, tombstone: bool, row: bytes) -> None:
+        assert self.largest is None or fk > self.largest, "unsorted add"
+        value = (b"\x01" if tombstone else b"\x00") + row
+        self.block.add(fk, value)
+        if self.smallest is None:
+            self.smallest = fk
+        self.largest = fk
+        table_user = fk[:-8]
+        if table_user != self._last_user:
+            self.bloom.add(table_user)
+            self._last_user = table_user
+        _t, _u, epoch = split_full_key(fk)
+        self.min_epoch = min(self.min_epoch, epoch)
+        self.max_epoch = max(self.max_epoch, epoch)
+        self.count += 1
+        if self.block.size() >= BLOCK_TARGET:
+            self._flush_block()
+
+    def _flush_block(self) -> None:
+        if self.block.count == 0:
+            return
+        data = self.block.finish()
+        self.index.append((self.block.first_key, self._off, len(data)))
+        self.blocks.append(data)
+        self._off += len(data)
+        self.block = _BlockBuilder()
+
+    def finish(self) -> Tuple[bytes, dict]:
+        self._flush_block()
+        out = bytearray()
+        for b in self.blocks:
+            out.extend(b)
+        bloom = self.bloom.finish() if self.count else b""
+        meta = bytearray()
+        write_uvarint(meta, len(self.index))
+        for first, off, ln in self.index:
+            write_uvarint(meta, len(first))
+            meta.extend(first)
+            write_uvarint(meta, off)
+            write_uvarint(meta, ln)
+        write_uvarint(meta, len(bloom))
+        meta.extend(bloom)
+        meta_off = len(out)
+        out.extend(meta)
+        out.extend(struct.pack(">Q", meta_off))
+        out.extend(MAGIC)
+        info = {
+            "id": self.sst_id,
+            "smallest": (self.smallest or b"").hex(),
+            "largest": (self.largest or b"").hex(),
+            "count": self.count,
+            "min_epoch": self.min_epoch if self.count else 0,
+            "max_epoch": self.max_epoch,
+            "size": len(out),
+        }
+        return bytes(out), info
+
+
+class Sst:
+    """Read handle over one SST's bytes (block index + bloom parsed)."""
+
+    def __init__(self, data: bytes, info: Optional[dict] = None) -> None:
+        assert data[-4:] == MAGIC, "bad SST magic"
+        meta_off = struct.unpack_from(">Q", data, len(data) - 12)[0]
+        self.data = data
+        self.info = info or {}
+        pos = meta_off
+        n, pos = read_uvarint(data, pos)
+        self.index: List[Tuple[bytes, int, int]] = []
+        for _ in range(n):
+            kl, pos = read_uvarint(data, pos)
+            first = data[pos:pos + kl]
+            pos += kl
+            off, pos = read_uvarint(data, pos)
+            ln, pos = read_uvarint(data, pos)
+            self.index.append((first, off, ln))
+        bl, pos = read_uvarint(data, pos)
+        self.bloom = data[pos:pos + bl]
+
+    def may_contain(self, table_id: int, user_key: bytes) -> bool:
+        return bloom_may_contain(
+            self.bloom, struct.pack(">I", table_id) + user_key)
+
+    def _block_range(self, start_fk: bytes) -> int:
+        """Index of the first block that could contain start_fk."""
+        lo, hi = 0, len(self.index) - 1
+        ans = 0
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            if self.index[mid][0] <= start_fk:
+                ans = mid
+                lo = mid + 1
+            else:
+                hi = mid - 1
+        return ans
+
+    def iter_from(self, start_fk: bytes
+                  ) -> Iterator[Tuple[bytes, bool, bytes]]:
+        """(full_key, tombstone, row_bytes) in order, from start_fk."""
+        if not self.index:
+            return
+        bi = self._block_range(start_fk)
+        for i in range(bi, len(self.index)):
+            _first, off, ln = self.index[i]
+            for fk, value in iter_block(self.data[off:off + ln]):
+                if fk < start_fk:
+                    continue
+                yield fk, value[0] == 1, value[1:]
+
+    def get(self, table_id: int, user_key: bytes, epoch: int
+            ) -> Optional[Tuple[bool, bool, bytes]]:
+        """(found, tombstone, row_bytes) for newest version ≤ epoch."""
+        if not self.may_contain(table_id, user_key):
+            return None
+        start = full_key(table_id, user_key, epoch)   # epoch desc order
+        prefix = start[:-8]
+        for fk, tomb, row in self.iter_from(start):
+            if fk[:-8] != prefix:
+                return None
+            return (True, tomb, row)
+        return None

@@ -1,0 +1,111 @@
+"""Compact row value encoding for SSTs.
+
+Reference parity: the *role* of src/common/src/util/value_encoding/ —
+a schema-light byte encoding of physical rows for storage values. The
+encoding is tag-per-value (rows are small; SST blocks amortize), with
+zigzag varints for ints: physical rows in this framework are host
+tuples of int / float / str / bool / None (DECIMAL is its scaled int64,
+timestamps are µs ints — see state/state_table.py).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Tuple
+
+_T_NULL = 0
+_T_INT = 1       # zigzag varint
+_T_FLOAT = 2     # 8-byte little-endian double
+_T_STR = 3       # varint len + utf8
+_T_TRUE = 4
+_T_FALSE = 5
+_T_BYTES = 6
+
+
+def write_uvarint(out: bytearray, v: int) -> None:
+    while v >= 0x80:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    out.append(v)
+
+
+def read_uvarint(buf: bytes, pos: int) -> Tuple[int, int]:
+    shift = 0
+    v = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        v |= (b & 0x7F) << shift
+        if b < 0x80:
+            return v, pos
+        shift += 7
+
+
+def _zigzag(v: int) -> int:
+    return (v << 1) ^ (v >> 63) if v >= 0 else ((-v) << 1) - 1
+
+
+def _unzigzag(v: int) -> int:
+    return (v >> 1) if (v & 1) == 0 else -((v + 1) >> 1)
+
+
+def encode_row(row: Tuple) -> bytes:
+    out = bytearray()
+    write_uvarint(out, len(row))
+    for v in row:
+        if v is None:
+            out.append(_T_NULL)
+        elif v is True:
+            out.append(_T_TRUE)
+        elif v is False:
+            out.append(_T_FALSE)
+        elif isinstance(v, int) or hasattr(v, "__index__"):
+            out.append(_T_INT)
+            write_uvarint(out, _zigzag(int(v)))
+        elif isinstance(v, float) or (hasattr(v, "dtype")
+                                      and v.dtype.kind == "f"):
+            out.append(_T_FLOAT)
+            out.extend(struct.pack("<d", float(v)))
+        elif isinstance(v, str):
+            out.append(_T_STR)
+            b = v.encode("utf-8")
+            write_uvarint(out, len(b))
+            out.extend(b)
+        elif isinstance(v, (bytes, bytearray)):
+            out.append(_T_BYTES)
+            write_uvarint(out, len(v))
+            out.extend(v)
+        else:
+            raise TypeError(f"unencodable value {v!r} ({type(v)})")
+    return bytes(out)
+
+
+def decode_row(buf: bytes) -> Tuple:
+    n, pos = read_uvarint(buf, 0)
+    out: List[Optional[object]] = []
+    for _ in range(n):
+        tag = buf[pos]
+        pos += 1
+        if tag == _T_NULL:
+            out.append(None)
+        elif tag == _T_TRUE:
+            out.append(True)
+        elif tag == _T_FALSE:
+            out.append(False)
+        elif tag == _T_INT:
+            z, pos = read_uvarint(buf, pos)
+            out.append(_unzigzag(z))
+        elif tag == _T_FLOAT:
+            out.append(struct.unpack_from("<d", buf, pos)[0])
+            pos += 8
+        elif tag == _T_STR:
+            ln, pos = read_uvarint(buf, pos)
+            out.append(buf[pos:pos + ln].decode("utf-8"))
+            pos += ln
+        elif tag == _T_BYTES:
+            ln, pos = read_uvarint(buf, pos)
+            out.append(bytes(buf[pos:pos + ln]))
+            pos += ln
+        else:
+            raise ValueError(f"bad value tag {tag}")
+    return tuple(out)
