@@ -1,0 +1,21 @@
+"""Batch engine: ad-hoc queries over committed snapshots.
+
+Reference parity: src/batch/ (~20K LoC) — the pull-based batch
+`Executor` tree (src/batch/src/executor/mod.rs:92) that serves
+`SELECT` over StorageTable snapshots at the committed epoch. Here the
+executor set is host-vectorized numpy over the same DataChunk type the
+streaming side uses; the heavy relational ops can promote to the
+device kernels when inputs are large (same ops/ layer).
+"""
+
+from risingwave_tpu.batch.storage_table import StorageTable
+from risingwave_tpu.batch.executors import (
+    BatchExecutor, BatchFilter, BatchHashAgg, BatchHashJoin, BatchLimit,
+    BatchOrderBy, BatchProject, BatchValues, RowSeqScan, collect,
+)
+
+__all__ = [
+    "StorageTable", "BatchExecutor", "RowSeqScan", "BatchFilter",
+    "BatchProject", "BatchHashAgg", "BatchHashJoin", "BatchOrderBy",
+    "BatchLimit", "BatchValues", "collect",
+]

@@ -1,0 +1,91 @@
+"""StorageTable: batch-side snapshot reads of a materialized table.
+
+Reference parity: src/storage/src/table/batch_table/storage_table.rs:55
+— point get + range scan over the committed state at a fixed epoch,
+with pk decode. The streaming side writes through StateTable; this is
+the read-only view batch queries use (same key codec, no memtable).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from risingwave_tpu.common.chunk import Column, DataChunk
+from risingwave_tpu.common.types import DataType, Schema
+from risingwave_tpu.state.keycodec import (
+    decode_memcomparable, encode_memcomparable, encode_vnode_prefix,
+)
+from risingwave_tpu.state.state_table import StateTable
+from risingwave_tpu.state.store import StateStore
+
+
+class StorageTable:
+    """Read-only snapshot view over one table id in the state store."""
+
+    def __init__(self, table_id: int, schema: Schema,
+                 pk_indices: Sequence[int], store: StateStore,
+                 dist_key_indices: Optional[Sequence[int]] = None):
+        self.table_id = table_id
+        self.schema = schema
+        self.pk_indices = list(pk_indices)
+        self.store = store
+        # reuse StateTable's key codec for gets (no memtable writes)
+        self._keys = StateTable(table_id, schema, pk_indices, store,
+                                dist_key_indices=dist_key_indices)
+
+    @staticmethod
+    def of(state_table: StateTable) -> "StorageTable":
+        return StorageTable(state_table.table_id, state_table.schema,
+                            state_table.pk_indices, state_table.store,
+                            state_table.dist_key_indices)
+
+    def get_row(self, pk_values: Sequence, epoch: int) -> Optional[tuple]:
+        key = self._keys._encode_pk(tuple(pk_values))
+        return self.store.get(self.table_id, key, epoch)
+
+    def iter_rows(self, epoch: int) -> Iterator[tuple]:
+        for _key, row in self.store.iter(self.table_id, epoch):
+            yield row
+
+    def scan_chunks(self, epoch: int, chunk_size: int = 1024
+                    ) -> Iterator[DataChunk]:
+        """Snapshot scan → DataChunks (vectorized column building)."""
+        buf: List[tuple] = []
+        for row in self.iter_rows(epoch):
+            buf.append(row)
+            if len(buf) >= chunk_size:
+                yield rows_to_chunk(self.schema, buf)
+                buf = []
+        if buf:
+            yield rows_to_chunk(self.schema, buf)
+
+
+def rows_to_chunk(schema: Schema, rows: List[tuple]) -> DataChunk:
+    """Row tuples → one DataChunk (host columns)."""
+    n = len(rows)
+    from risingwave_tpu.common.chunk import next_pow2
+    cap = next_pow2(max(n, 1))
+    cols: List[Column] = []
+    for i, f in enumerate(schema):
+        vals = [r[i] for r in rows]
+        dt = f.data_type
+        ok = np.ones(cap, dtype=bool)
+        has_null = any(v is None for v in vals)
+        if dt.is_device:
+            arr = np.zeros(cap, dtype=dt.np_dtype)
+            if has_null:
+                ok[:n] = [v is not None for v in vals]
+                arr[:n] = [0 if v is None else v for v in vals]
+            else:
+                arr[:n] = vals
+        else:
+            arr = np.empty(cap, dtype=object)
+            arr[:n] = vals
+            if has_null:
+                ok[:n] = [v is not None for v in vals]
+        cols.append(Column(dt, arr, ok if has_null else None))
+    vis = np.zeros(cap, dtype=bool)
+    vis[:n] = True
+    return DataChunk(schema, cols, vis)

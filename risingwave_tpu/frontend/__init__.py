@@ -1,0 +1,14 @@
+"""SQL frontend: parse → bind → plan → deploy/execute.
+
+Reference parity: src/sqlparser/ (hand-written recursive-descent parser
+with streaming extensions like TUMBLE), src/frontend/src/{binder,
+planner,optimizer,handler}/ and the pgwire session loop
+(src/utils/pgwire/src/pg_server.rs:53). Scaled to the supported
+surface: CREATE SOURCE / CREATE MATERIALIZED VIEW (deployed as live
+streaming pipelines), batch SELECT over committed MV snapshots,
+DROP / SHOW, one process, one session.
+"""
+
+from risingwave_tpu.frontend.session import Frontend
+
+__all__ = ["Frontend"]

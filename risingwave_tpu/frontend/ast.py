@@ -1,0 +1,125 @@
+"""SQL AST nodes (sqlparser-rs analog, scaled to the supported surface)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+# -- expressions ---------------------------------------------------------
+
+
+@dataclass
+class Expr:
+    pass
+
+
+@dataclass
+class Lit(Expr):
+    value: object            # int | float-string | str | bool | None
+    kind: str                # "number" | "string" | "bool" | "null"
+
+
+@dataclass
+class IntervalLit(Expr):
+    usecs: int
+
+
+@dataclass
+class ColRef(Expr):
+    name: str
+    table: Optional[str] = None
+
+
+@dataclass
+class Bin(Expr):
+    op: str
+    left: Expr
+    right: Expr
+
+
+@dataclass
+class Un(Expr):
+    op: str                  # "not" | "neg"
+    child: Expr
+
+
+@dataclass
+class Call(Expr):
+    name: str                # lowercased
+    args: List[Expr]
+    star: bool = False       # count(*)
+
+
+# -- statements ----------------------------------------------------------
+
+
+@dataclass
+class TableRef:
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclass
+class Tumble:
+    """TUMBLE(source, time_col, INTERVAL ...) — streaming window source."""
+
+    table: TableRef
+    time_col: str
+    window_usecs: int
+    alias: Optional[str] = None
+
+
+FromItem = object            # TableRef | Tumble
+
+
+@dataclass
+class Join:
+    item: FromItem
+    on: Expr
+
+
+@dataclass
+class Select:
+    projections: List[Tuple[Expr, Optional[str]]]   # (expr, alias)
+    from_item: Optional[FromItem]
+    joins: List[Join] = field(default_factory=list)
+    where: Optional[Expr] = None
+    group_by: List[Expr] = field(default_factory=list)
+    order_by: List[Tuple[Expr, bool]] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+
+
+@dataclass
+class CreateSource:
+    name: str
+    options: Dict[str, str]            # WITH (connector='nexmark', ...)
+
+
+@dataclass
+class CreateMaterializedView:
+    name: str
+    select: Select
+
+
+@dataclass
+class DropMaterializedView:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class DropSource:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class Show:
+    what: str                          # "tables" | "materialized views" | "sources"
+
+
+@dataclass
+class Flush:
+    pass

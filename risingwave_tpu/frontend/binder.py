@@ -1,0 +1,159 @@
+"""Binder: AST expressions → typed Expression trees over a scope.
+
+Reference parity: src/frontend/src/binder/ — name resolution against
+the catalog, type derivation, aggregate-call extraction (the reference
+splits these across binder + logical agg planning; here the bind pass
+returns both the bound scalar expression and any extracted AggCalls).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from risingwave_tpu.common.types import DataType, Field, Interval, Schema
+from risingwave_tpu.expr.expr import (
+    BinaryOp, Case, Expression, FuncCall, InputRef, Literal, UnaryOp, lit,
+    tumble_end, tumble_start,
+)
+from risingwave_tpu.frontend import ast
+from risingwave_tpu.ops.hash_agg import AggKind
+from risingwave_tpu.stream.executors.hash_agg import AggCall
+
+
+class BindError(ValueError):
+    pass
+
+
+@dataclass
+class Scope:
+    """Visible columns: (qualifier, name) → (index, type)."""
+
+    schema: Schema
+    qualifiers: List[Optional[str]]     # per column: its table alias
+
+    @staticmethod
+    def of(schema: Schema, alias: Optional[str] = None) -> "Scope":
+        return Scope(schema, [alias] * len(schema))
+
+    def concat(self, other: "Scope") -> "Scope":
+        return Scope(Schema(list(self.schema) + list(other.schema)),
+                     self.qualifiers + other.qualifiers)
+
+    def find(self, name: str, table: Optional[str]) -> Tuple[int, DataType]:
+        hits = []
+        for i, f in enumerate(self.schema):
+            if f.name != name:
+                continue
+            if table is not None and self.qualifiers[i] != table:
+                continue
+            hits.append((i, f.data_type))
+        if not hits:
+            raise BindError(f"column {name!r} not found"
+                            + (f" in {table!r}" if table else ""))
+        if len(hits) > 1:
+            raise BindError(f"column {name!r} is ambiguous")
+        return hits[0]
+
+
+_AGG_KINDS = {"count": AggKind.COUNT, "sum": AggKind.SUM,
+              "min": AggKind.MIN, "max": AggKind.MAX}
+
+
+class Binder:
+    """Binds scalar expressions; collects aggregate calls on demand."""
+
+    def __init__(self, scope: Scope, allow_aggs: bool = False):
+        self.scope = scope
+        self.allow_aggs = allow_aggs
+        self.agg_calls: List[AggCall] = []
+        # bound agg call → position (dedup: COUNT(*) used twice = one)
+        self._agg_index: Dict[Tuple, int] = {}
+
+    # returns (Expression | ("agg", index), ...)
+    def bind(self, e: ast.Expr) -> Expression:
+        out = self._bind(e)
+        if isinstance(out, tuple):
+            raise BindError("aggregate not allowed here")
+        return out
+
+    def bind_projection(self, e: ast.Expr):
+        """Bind a projection item: Expression or ('agg', call_index)."""
+        return self._bind(e)
+
+    def _bind(self, e: ast.Expr):
+        if isinstance(e, ast.Lit):
+            return _bind_lit(e)
+        if isinstance(e, ast.IntervalLit):
+            return Literal(Interval(usecs=e.usecs), DataType.INTERVAL)
+        if isinstance(e, ast.ColRef):
+            idx, dt = self.scope.find(e.name, e.table)
+            return InputRef(idx, dt)
+        if isinstance(e, ast.Un):
+            child = self.bind(e.child)
+            return UnaryOp("not" if e.op == "not" else "neg", child)
+        if isinstance(e, ast.Bin):
+            left, right = self.bind(e.left), self.bind(e.right)
+            return BinaryOp(e.op, left, right)
+        if isinstance(e, ast.Call):
+            return self._bind_call(e)
+        raise BindError(f"unsupported expression {e!r}")
+
+    def _bind_call(self, e: ast.Call):
+        name = e.name
+        if name in _AGG_KINDS:
+            if not self.allow_aggs:
+                raise BindError(f"aggregate {name}() not allowed here")
+            if e.star or not e.args:
+                if name != "count":
+                    raise BindError(f"{name}(*) is not valid")
+                call = AggCall(AggKind.COUNT, None)
+                key = ("count_star",)
+            else:
+                arg = self.bind(e.args[0])
+                if not isinstance(arg, InputRef):
+                    raise BindError(
+                        f"{name}(<expr>) needs a plain column (project "
+                        "it first)")
+                call = AggCall(_AGG_KINDS[name], arg.index)
+                key = (name, arg.index)
+            if key not in self._agg_index:
+                self._agg_index[key] = len(self.agg_calls)
+                self.agg_calls.append(call)
+            return ("agg", self._agg_index[key])
+        if name in ("tumble_start", "tumble_end"):
+            ts = self.bind(e.args[0])
+            iv = e.args[1]
+            if not isinstance(iv, ast.IntervalLit):
+                raise BindError(f"{name} needs an INTERVAL literal")
+            mk = tumble_start if name == "tumble_start" else tumble_end
+            return mk(ts, Interval(usecs=iv.usecs))
+        if name == "case":
+            args = [self.bind(a) for a in e.args]
+            whens = list(zip(args[:-1:2], args[1:-1:2]))
+            return Case(whens, args[-1])
+        # generic registered scalar function
+        args = [self.bind(a) for a in e.args]
+        return FuncCall(name, args)
+
+
+def _bind_lit(e: ast.Lit) -> Literal:
+    if e.kind == "number":
+        text = str(e.value)
+        if "." in text:
+            return lit(text, DataType.DECIMAL)
+        return lit(int(text), DataType.INT64)
+    if e.kind == "string":
+        return lit(str(e.value), DataType.VARCHAR)
+    if e.kind == "bool":
+        return lit(bool(e.value), DataType.BOOLEAN)
+    return Literal(None, DataType.INT64)       # bare NULL
+
+
+def expr_name(e: ast.Expr, fallback: str) -> str:
+    """Default output column name (pg-ish)."""
+    if isinstance(e, ast.ColRef):
+        return e.name
+    if isinstance(e, ast.Call):
+        return e.name
+    return fallback

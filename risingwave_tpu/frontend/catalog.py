@@ -1,0 +1,64 @@
+"""Catalog: named sources / materialized views + id allocation.
+
+Reference parity: src/meta/src/manager/catalog/mod.rs:135 (the meta
+CatalogManager) + the frontend's read mirror — collapsed to one
+in-process structure for the single-node deployment shape.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from risingwave_tpu.common.types import Schema
+
+
+@dataclass
+class SourceCatalog:
+    name: str
+    source_id: int
+    schema: Schema
+    options: Dict[str, str]
+
+
+@dataclass
+class MvCatalog:
+    name: str
+    table_id: int
+    schema: Schema
+    pk_indices: List[int]
+    definition: str
+    actor_id: int = 0
+    dependent_sources: List[str] = field(default_factory=list)
+
+
+class Catalog:
+    def __init__(self) -> None:
+        self.sources: Dict[str, SourceCatalog] = {}
+        self.mvs: Dict[str, MvCatalog] = {}
+        self._next_id = 1
+
+    def next_id(self) -> int:
+        i = self._next_id
+        self._next_id += 1
+        return i
+
+    def add_source(self, name: str, schema: Schema,
+                   options: Dict[str, str]) -> SourceCatalog:
+        if name in self.sources or name in self.mvs:
+            raise ValueError(f"catalog object {name!r} already exists")
+        sc = SourceCatalog(name, self.next_id(), schema, options)
+        self.sources[name] = sc
+        return sc
+
+    def add_mv(self, mv: MvCatalog) -> None:
+        if mv.name in self.sources or mv.name in self.mvs:
+            raise ValueError(f"catalog object {mv.name!r} already exists")
+        self.mvs[mv.name] = mv
+
+    def resolve(self, name: str):
+        if name in self.sources:
+            return self.sources[name]
+        if name in self.mvs:
+            return self.mvs[name]
+        raise KeyError(f"unknown relation {name!r}")

@@ -1,0 +1,412 @@
+"""Planner: bound SELECT → streaming executor chain or batch tree.
+
+Reference parity: src/frontend/src/planner/ + optimizer/mod.rs:346
+(gen_stream_plan) + the fragmenter — collapsed: the supported SQL
+surface maps directly onto executor chains (source → [tumble-project]
+→ [filter] → [join] → [pre-agg project → hash-agg] → project →
+materialize), so the logical/physical split and exchange insertion are
+not yet needed (single-fragment plans; the dispatch layer exists under
+stream/ for when the fragmenter lands).
+
+Supported streaming shapes: MV over one source (optionally TUMBLE),
+optional WHERE, optional GROUP BY + count/sum/min/max, one INNER JOIN
+of two sources on equi-keys. Batch: scan/filter/project/agg/join/
+order/limit over committed MV snapshots.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from risingwave_tpu.common.types import DataType, Field, Interval, Schema
+from risingwave_tpu.expr.expr import Expression, InputRef, tumble_start
+from risingwave_tpu.frontend import ast
+from risingwave_tpu.frontend.binder import (
+    BindError, Binder, Scope, expr_name,
+)
+from risingwave_tpu.frontend.catalog import Catalog, MvCatalog, SourceCatalog
+from risingwave_tpu.state.state_table import StateTable
+from risingwave_tpu.stream.executor import Executor
+from risingwave_tpu.stream.executors.hash_agg import (
+    AggCall, HashAggExecutor, agg_state_schema,
+)
+from risingwave_tpu.stream.executors.hash_join import HashJoinExecutor
+from risingwave_tpu.stream.executors.materialize import MaterializeExecutor
+from risingwave_tpu.stream.executors.row_id_gen import RowIdGenExecutor
+from risingwave_tpu.stream.executors.simple import (
+    FilterExecutor, ProjectExecutor,
+)
+from risingwave_tpu.stream.executors.source import SourceExecutor
+
+SPLIT_STATE_SCHEMA = Schema([Field("split_id", DataType.VARCHAR),
+                             Field("offset", DataType.INT64)])
+
+
+class PlanError(ValueError):
+    pass
+
+
+@dataclass
+class StreamPlan:
+    """Everything the session needs to deploy one MV pipeline."""
+
+    consumer: MaterializeExecutor
+    mv: MvCatalog
+    readers: Dict[int, object]          # actor_id → split reader
+
+
+def _source_reader(src: SourceCatalog):
+    opts = src.options
+    connector = opts.get("connector", "").lower()
+    if connector == "nexmark":
+        from risingwave_tpu.connectors.nexmark import (
+            NexmarkConfig, NexmarkSplitReader,
+        )
+        cfg = NexmarkConfig(
+            table_type=opts.get("nexmark.table.type", "bid"),
+            event_num=int(opts.get("nexmark.event.num", 1 << 62)),
+            max_chunk_size=int(opts.get("nexmark.max.chunk.size", 1024)),
+            min_event_gap_in_ns=int(
+                opts.get("nexmark.min.event.gap.in.ns", 100_000)),
+            seed=int(opts.get("nexmark.seed", 0x5EED0)),
+        )
+        return NexmarkSplitReader(cfg)
+    if connector == "datagen":
+        from risingwave_tpu.connectors.datagen import (
+            DatagenConfig, DatagenSplitReader,
+        )
+        return DatagenSplitReader(DatagenConfig.from_options(opts))
+    raise PlanError(f"unknown connector {connector!r}")
+
+
+def source_schema(options: Dict[str, str]) -> Schema:
+    connector = options.get("connector", "").lower()
+    if connector == "nexmark":
+        from risingwave_tpu.connectors.nexmark import TABLE_SCHEMAS
+        return TABLE_SCHEMAS[options.get("nexmark.table.type", "bid")]
+    if connector == "datagen":
+        from risingwave_tpu.connectors.datagen import DatagenConfig
+        return DatagenConfig.from_options(options).schema
+    raise PlanError(f"unknown connector {connector!r}")
+
+
+class StreamPlanner:
+    """Plans one CREATE MATERIALIZED VIEW into an executor chain."""
+
+    def __init__(self, catalog: Catalog, store, local, definition: str):
+        self.catalog = catalog
+        self.store = store
+        self.local = local           # LocalBarrierManager
+        self.definition = definition
+        self.readers: Dict[int, object] = {}
+
+    # -- source chains ---------------------------------------------------
+    def _base_chain(self, item, rate_limit: Optional[int],
+                    min_chunks: Optional[int]
+                    ) -> Tuple[Executor, Scope, List[str]]:
+        """FROM item → executor + scope (+ dependent source names)."""
+        from risingwave_tpu.stream.exchange import channel_for_test
+
+        if isinstance(item, ast.Tumble):
+            ref, alias = item.table, item.alias or item.table.name
+        elif isinstance(item, ast.TableRef):
+            ref, alias = item, item.alias or item.name
+        else:
+            raise PlanError(f"unsupported FROM item {item!r}")
+        obj = self.catalog.resolve(ref.name)
+        if isinstance(obj, MvCatalog):
+            raise PlanError("MV-on-MV (chain/backfill) not supported yet")
+        assert isinstance(obj, SourceCatalog)
+        reader = _source_reader(obj)
+        tx, rx = channel_for_test()
+        split_state = StateTable(self.catalog.next_id(),
+                                 SPLIT_STATE_SCHEMA, [0], self.store)
+        # source sender id: unique per source instance (shares the
+        # catalog id space; the barrier manager only needs uniqueness)
+        sid = self.catalog.next_id()
+        self.local.register_sender(sid, tx)
+        ex: Executor = SourceExecutor(
+            reader, rx, split_state, actor_id=sid,
+            rate_limit_chunks_per_barrier=rate_limit,
+            min_chunks_per_barrier=min_chunks)
+        self.readers[sid] = reader
+        scope = Scope.of(obj.schema, alias)
+        if isinstance(item, ast.Tumble):
+            idx, dt = scope.find(item.time_col, None)
+            if dt not in (DataType.TIMESTAMP, DataType.TIMESTAMPTZ):
+                raise PlanError("TUMBLE time column must be a timestamp")
+            exprs = [InputRef(i, f.data_type)
+                     for i, f in enumerate(scope.schema)]
+            names = [f.name for f in scope.schema]
+            exprs.append(tumble_start(InputRef(idx, dt),
+                                      Interval(usecs=item.window_usecs)))
+            names.append("window_start")
+            ex = ProjectExecutor(ex, exprs, names)
+            scope = Scope(ex.schema,
+                          scope.qualifiers + [alias])
+        return ex, scope, [obj.name]
+
+    # -- the main plan ---------------------------------------------------
+    def plan(self, name: str, sel: ast.Select, actor_id: int,
+             rate_limit: Optional[int] = 8,
+             min_chunks: Optional[int] = None) -> StreamPlan:
+        if sel.order_by or sel.limit is not None:
+            raise PlanError("ORDER BY / LIMIT in an MV needs the TopN "
+                            "executor wiring (batch SELECT supports it)")
+        if sel.from_item is None:
+            raise PlanError("an MV needs a FROM clause")
+        ex, scope, deps = self._base_chain(sel.from_item,
+                                           rate_limit, min_chunks)
+        join_pk_cols: Optional[List[int]] = None
+        if sel.joins:
+            if len(sel.joins) > 1:
+                raise PlanError("one JOIN per MV for now")
+            # append-only join of two sources; row-id pks on both sides
+            left = RowIdGenExecutor(ex)
+            lscope = Scope(left.schema, scope.qualifiers + [None])
+            jn = sel.joins[0]
+            rex, rscope, rdeps = self._base_chain(
+                jn.item, rate_limit, min_chunks)
+            deps += rdeps
+            right = RowIdGenExecutor(rex)
+            rscope = Scope(right.schema, rscope.qualifiers + [None])
+            lkeys, rkeys = _equi_keys(jn.on, lscope, rscope)
+            n_l = len(left.schema)
+            lt = StateTable(self.catalog.next_id(), left.schema,
+                            [n_l - 1], self.store,
+                            dist_key_indices=None)
+            rt = StateTable(self.catalog.next_id(), right.schema,
+                            [len(right.schema) - 1], self.store)
+            ex = HashJoinExecutor(left, right, lkeys, rkeys, lt, rt,
+                                  actor_id=actor_id)
+            scope = lscope.concat(rscope)
+            join_pk_cols = [n_l - 1, n_l + len(right.schema) - 1]
+        if sel.where is not None:
+            pred = Binder(scope).bind(sel.where)
+            ex = FilterExecutor(ex, pred)
+        projections = _expand_star(sel.projections, scope)
+        binder = Binder(scope, allow_aggs=True)
+        bound = [binder.bind_projection(e) for e, _a in projections]
+        names = [a or expr_name(e, f"col{i}")
+                 for i, (e, a) in enumerate(projections)]
+        if binder.agg_calls or sel.group_by:
+            ex, out_exprs = self._plan_agg(ex, scope, sel, binder, bound)
+            ex = ProjectExecutor(ex, out_exprs, names)
+            pk = _agg_output_pk(sel, out_exprs)
+        else:
+            exprs = list(bound)
+            if join_pk_cols is not None:
+                pk = list(range(len(exprs), len(exprs) + 2))
+                exprs += [InputRef(c, scope.schema[c].data_type)
+                          for c in join_pk_cols]
+                names += ["_row_id_l", "_row_id_r"]
+                ex = ProjectExecutor(ex, exprs, names)
+            else:
+                ex = RowIdGenExecutor(ProjectExecutor(ex, exprs, names))
+                pk = [len(exprs)]
+                names = names + ["_row_id"]
+        mv_table = StateTable(self.catalog.next_id(), ex.schema, pk,
+                              self.store)
+        mat = MaterializeExecutor(ex, mv_table)
+        mv = MvCatalog(name, mv_table.table_id, ex.schema, pk,
+                       self.definition, actor_id, deps)
+        return StreamPlan(mat, mv, self.readers)
+
+    def _plan_agg(self, ex: Executor, scope: Scope, sel: ast.Select,
+                  binder: Binder, bound) -> Tuple[Executor, List]:
+        """Insert pre-agg projection + HashAggExecutor; return output
+        exprs for the post-agg projection."""
+        group_bound = [Binder(scope).bind(g) for g in sel.group_by]
+        group_reprs = [repr(g) for g in group_bound]
+        # pre-agg projection: group exprs, then each agg input column
+        pre_exprs: List[Expression] = list(group_bound)
+        pre_names = [f"_g{i}" for i in range(len(group_bound))]
+        remapped: List[AggCall] = []
+        for call in binder.agg_calls:
+            if call.input_idx is None:
+                remapped.append(call)
+                continue
+            dt = scope.schema[call.input_idx].data_type
+            pre_exprs.append(InputRef(call.input_idx, dt))
+            remapped.append(AggCall(call.kind, len(pre_exprs) - 1))
+            pre_names.append(f"_a{len(remapped) - 1}")
+        pre = ProjectExecutor(ex, pre_exprs, pre_names)
+        g = len(group_bound)
+        calls = remapped
+        sch, agg_pk = agg_state_schema(pre.schema, list(range(g)), calls)
+        table = StateTable(self.catalog.next_id(), sch, agg_pk,
+                           self.store,
+                           dist_key_indices=list(range(len(agg_pk))))
+        agg = HashAggExecutor(pre, list(range(g)), calls, table,
+                              append_only=True)
+        # post-agg projection: map each SELECT item
+        out: List[Expression] = []
+        for b, (e, _a) in zip(bound, sel.projections):
+            if isinstance(b, tuple) and b[0] == "agg":
+                j = b[1]
+                out.append(InputRef(g + j, agg.schema[g + j].data_type))
+            else:
+                r = repr(b)
+                if r not in group_reprs:
+                    raise PlanError(
+                        f"projection {r} is neither grouped nor "
+                        "aggregated")
+                i = group_reprs.index(r)
+                out.append(InputRef(i, agg.schema[i].data_type))
+        return agg, out
+
+
+def _expand_star(projections, scope: Scope):
+    out = []
+    for e, a in projections:
+        if isinstance(e, ast.ColRef) and e.name == "*":
+            for i, f in enumerate(scope.schema):
+                out.append((ast.ColRef(f.name, scope.qualifiers[i]), None))
+        else:
+            out.append((e, a))
+    return out
+
+
+def _agg_output_pk(sel: ast.Select, out_exprs) -> List[int]:
+    """MV pk = the projected group keys (must all be projected)."""
+    pk = [i for i, e in enumerate(out_exprs)
+          if isinstance(e, InputRef) and e.index < len(sel.group_by)]
+    if len(pk) != len(sel.group_by):
+        raise PlanError("every GROUP BY key must appear in the MV's "
+                        "SELECT list (it is the MV primary key)")
+    return pk
+
+
+def _equi_keys(on: ast.Expr, lscope: Scope, rscope: Scope
+               ) -> Tuple[List[int], List[int]]:
+    """ON conjunction of col=col → (left key idxs, right key idxs)."""
+    conj: List[ast.Expr] = []
+
+    def flatten(e):
+        if isinstance(e, ast.Bin) and e.op == "and":
+            flatten(e.left)
+            flatten(e.right)
+        else:
+            conj.append(e)
+
+    flatten(on)
+    lkeys, rkeys = [], []
+    for c in conj:
+        if not (isinstance(c, ast.Bin) and c.op == "="
+                and isinstance(c.left, ast.ColRef)
+                and isinstance(c.right, ast.ColRef)):
+            raise PlanError("JOIN ON must be a conjunction of "
+                            "column = column")
+        sides = []
+        for col in (c.left, c.right):
+            try:
+                sides.append(("l", lscope.find(col.name, col.table)[0]))
+            except BindError:
+                sides.append(("r", rscope.find(col.name, col.table)[0]))
+        tags = {s[0] for s in sides}
+        if tags != {"l", "r"}:
+            raise PlanError("JOIN ON must compare the two sides")
+        for tag, idx in sides:
+            (lkeys if tag == "l" else rkeys).append(idx)
+    return lkeys, rkeys
+
+
+# -- batch planning -------------------------------------------------------
+
+
+def plan_batch(sel: ast.Select, catalog: Catalog, store, epoch: int):
+    """SELECT over committed snapshots → batch executor tree."""
+    from risingwave_tpu.batch import (
+        BatchFilter, BatchHashAgg, BatchHashJoin, BatchLimit,
+        BatchOrderBy, BatchProject, BatchValues, RowSeqScan, StorageTable,
+    )
+
+    def scan(item) -> Tuple[object, Scope]:
+        if not isinstance(item, ast.TableRef):
+            raise PlanError("batch FROM supports tables/MVs")
+        obj = catalog.resolve(item.name)
+        if isinstance(obj, SourceCatalog):
+            raise PlanError("cannot batch-scan a pure source; "
+                            "create a materialized view over it")
+        st = StorageTable(obj.table_id, obj.schema, obj.pk_indices, store)
+        return (RowSeqScan(st, epoch),
+                Scope.of(obj.schema, item.alias or item.name))
+
+    if sel.from_item is None:
+        # SELECT <exprs>: evaluate over one synthetic row
+        from risingwave_tpu.common.types import Schema as Sch
+        binder = Binder(Scope.of(Sch([]), None))
+        exprs = [binder.bind(e) for e, _ in sel.projections]
+        from risingwave_tpu.common.chunk import DataChunk
+        import numpy as np
+        one = DataChunk.empty(Sch([]), capacity=8)
+        one.visibility[0] = True
+        cols = [e.eval(one) for e in exprs]
+        row = tuple(
+            None if (c.validity is not None and not c.validity[0])
+            else (c.values[0].item() if hasattr(c.values[0], "item")
+                  else c.values[0])
+            for c in cols)
+        names = [a or expr_name(e, f"col{i}")
+                 for i, (e, a) in enumerate(sel.projections)]
+        sch = Sch([Field(n, c.data_type) for n, c in zip(names, cols)])
+        return BatchValues(sch, [row])
+
+    ex, scope = scan(sel.from_item)
+    for jn in sel.joins:
+        rex, rscope = scan(jn.item)
+        lkeys, rkeys = _equi_keys(jn.on, scope, rscope)
+        ex = BatchHashJoin(ex, rex, lkeys, rkeys)
+        scope = scope.concat(rscope)
+    if sel.where is not None:
+        ex = BatchFilter(ex, Binder(scope).bind(sel.where))
+    projections = _expand_star(sel.projections, scope)
+    binder = Binder(scope, allow_aggs=True)
+    bound = [binder.bind_projection(e) for e, _a in projections]
+    names = [a or expr_name(e, f"col{i}")
+             for i, (e, a) in enumerate(projections)]
+    if binder.agg_calls or sel.group_by:
+        group_bound = [Binder(scope).bind(g) for g in sel.group_by]
+        group_reprs = [repr(g) for g in group_bound]
+        pre_exprs = list(group_bound)
+        remapped = []
+        for call in binder.agg_calls:
+            if call.input_idx is None:
+                remapped.append(call)
+                continue
+            dt = scope.schema[call.input_idx].data_type
+            pre_exprs.append(InputRef(call.input_idx, dt))
+            remapped.append(AggCall(call.kind, len(pre_exprs) - 1))
+        pre = BatchProject(ex, pre_exprs)
+        g = len(group_bound)
+        agg = BatchHashAgg(pre, list(range(g)), remapped)
+        out = []
+        for b in bound:
+            if isinstance(b, tuple) and b[0] == "agg":
+                out.append(InputRef(g + b[1],
+                                    agg.schema[g + b[1]].data_type))
+            else:
+                r = repr(b)
+                if r not in group_reprs:
+                    raise PlanError(f"projection {r} is neither grouped "
+                                    "nor aggregated")
+                i = group_reprs.index(r)
+                out.append(InputRef(i, agg.schema[i].data_type))
+        ex = BatchProject(agg, out, names)
+        post_scope = Scope.of(ex.schema, None)
+    else:
+        ex = BatchProject(ex, bound, names)
+        post_scope = Scope.of(ex.schema, None)
+    if sel.order_by:
+        cols = []
+        for e, desc in sel.order_by:
+            b = Binder(post_scope).bind(e)
+            if not isinstance(b, InputRef):
+                raise PlanError("ORDER BY must reference output columns")
+            cols.append((b.index, desc))
+        ex = BatchOrderBy(ex, cols)
+    if sel.limit is not None or sel.offset is not None:
+        ex = BatchLimit(ex, sel.limit if sel.limit is not None else 1 << 62,
+                        sel.offset or 0)
+    return ex

@@ -1,0 +1,161 @@
+"""Frontend session: the run-one-query loop + streaming-job deployment.
+
+Reference parity: src/utils/pgwire/src/pg_server.rs:53
+(`Session::run_one_query`), src/frontend/src/handler/ (per-statement
+handlers) and the meta-side DdlController + GlobalStreamManager
+(create job → build actors → activate via barrier) — collapsed into
+one in-process object for the single-node deployment shape. The
+barrier loop is the session's heartbeat; FLUSH forces a checkpoint
+(handler/flush.rs analog).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional, Union
+
+from risingwave_tpu.frontend import ast
+from risingwave_tpu.frontend.catalog import Catalog, MvCatalog
+from risingwave_tpu.frontend.parser import parse_many
+from risingwave_tpu.frontend.planner import (
+    PlanError, StreamPlanner, plan_batch, source_schema,
+)
+from risingwave_tpu.meta.barrier import BarrierLoop
+from risingwave_tpu.state.store import MemoryStateStore, StateStore
+from risingwave_tpu.stream.actor import Actor, LocalBarrierManager
+from risingwave_tpu.stream.message import StopMutation
+
+Rows = List[tuple]
+
+
+class Frontend:
+    """One session over one in-process cluster."""
+
+    def __init__(self, store: Optional[StateStore] = None,
+                 rate_limit: Optional[int] = 8,
+                 min_chunks: Optional[int] = None):
+        self.store = store if store is not None else MemoryStateStore()
+        self.catalog = Catalog()
+        self.local = LocalBarrierManager()
+        self.loop = BarrierLoop(self.local, self.store)
+        self.actors: Dict[int, Actor] = {}
+        self.tasks: Dict[int, asyncio.Task] = {}
+        self.readers: Dict[str, Dict[int, object]] = {}   # mv → readers
+        self.rate_limit = rate_limit
+        self.min_chunks = min_chunks
+        self._next_actor = 1000
+
+    # -- public API -------------------------------------------------------
+    async def execute(self, sql: str) -> Union[Rows, str]:
+        """Run one or more ';'-separated statements; returns the last
+        statement's result (rows for SELECT/SHOW, status otherwise)."""
+        result: Union[Rows, str] = "OK"
+        for stmt in parse_many(sql):
+            result = await self._run(stmt)
+        return result
+
+    def execute_sync(self, sql: str) -> Union[Rows, str]:
+        return asyncio.get_event_loop().run_until_complete(
+            self.execute(sql))
+
+    async def step(self, n: int = 1) -> None:
+        """Drive n checkpoint barriers (deterministic test/bench mode)."""
+        for _ in range(n):
+            await self.loop.inject_and_collect(force_checkpoint=True)
+
+    async def close(self) -> None:
+        if self.actors:
+            stop_ids = set(self.actors)
+            for readers in self.readers.values():
+                stop_ids |= set(readers)
+            await self.loop.inject_and_collect(
+                mutation=StopMutation(frozenset(stop_ids)))
+            for t in self.tasks.values():
+                await t
+        for aid, a in self.actors.items():
+            if a.failure is not None:
+                raise a.failure
+
+    # -- dispatch ---------------------------------------------------------
+    async def _run(self, stmt) -> Union[Rows, str]:
+        if isinstance(stmt, ast.CreateSource):
+            schema = source_schema(stmt.options)
+            self.catalog.add_source(stmt.name, schema, stmt.options)
+            return "CREATE_SOURCE"
+        if isinstance(stmt, ast.CreateMaterializedView):
+            return await self._create_mv(stmt)
+        if isinstance(stmt, ast.DropMaterializedView):
+            return await self._drop_mv(stmt)
+        if isinstance(stmt, ast.DropSource):
+            if stmt.name not in self.catalog.sources:
+                if stmt.if_exists:
+                    return "DROP_SOURCE"
+                raise PlanError(f"unknown source {stmt.name!r}")
+            for mv in self.catalog.mvs.values():
+                if stmt.name in mv.dependent_sources:
+                    raise PlanError(
+                        f"source {stmt.name!r} is used by MV {mv.name!r}")
+            del self.catalog.sources[stmt.name]
+            return "DROP_SOURCE"
+        if isinstance(stmt, ast.Show):
+            if stmt.what == "sources":
+                return [(n,) for n in sorted(self.catalog.sources)]
+            return [(n,) for n in sorted(self.catalog.mvs)]
+        if isinstance(stmt, ast.Flush):
+            await self.loop.inject_and_collect(force_checkpoint=True)
+            return "FLUSH"
+        if isinstance(stmt, ast.Select):
+            return await self._select(stmt)
+        raise PlanError(f"unhandled statement {stmt!r}")
+
+    # -- handlers ---------------------------------------------------------
+    async def _create_mv(self, stmt: ast.CreateMaterializedView) -> str:
+        planner = StreamPlanner(self.catalog, self.store, self.local,
+                                definition="")
+        actor_id = self._next_actor
+        self._next_actor += 1
+        plan = planner.plan(stmt.name, stmt.select, actor_id,
+                            rate_limit=self.rate_limit,
+                            min_chunks=self.min_chunks)
+        self.catalog.add_mv(plan.mv)
+        actor = Actor(actor_id, plan.consumer, dispatchers=[],
+                      barrier_manager=self.local)
+        self.actors[actor_id] = actor
+        self.readers[stmt.name] = plan.readers
+        self.local.set_expected_actors(list(self.actors))
+        self.tasks[actor_id] = actor.spawn()
+        # activation barrier (Command::CreateStreamingJob analog)
+        await self.loop.inject_and_collect(force_checkpoint=True)
+        if actor.failure is not None:
+            raise actor.failure
+        return "CREATE_MATERIALIZED_VIEW"
+
+    async def _drop_mv(self, stmt: ast.DropMaterializedView) -> str:
+        mv = self.catalog.mvs.get(stmt.name)
+        if mv is None:
+            if stmt.if_exists:
+                return "DROP_MATERIALIZED_VIEW"
+            raise PlanError(f"unknown materialized view {stmt.name!r}")
+        # stop barrier addressed at this MV's sources + actor
+        stop_ids = frozenset(self.readers.get(stmt.name, {}).keys()
+                             | {mv.actor_id})
+        await self.loop.inject_and_collect(
+            mutation=StopMutation(stop_ids))
+        task = self.tasks.pop(mv.actor_id, None)
+        if task is not None:
+            await task
+        actor = self.actors.pop(mv.actor_id, None)
+        for sid in self.readers.pop(stmt.name, {}):
+            self.local.drop_actor(sid)
+        self.local.drop_actor(mv.actor_id)
+        self.local.set_expected_actors(list(self.actors))
+        del self.catalog.mvs[stmt.name]
+        if actor is not None and actor.failure is not None:
+            raise actor.failure
+        return "DROP_MATERIALIZED_VIEW"
+
+    async def _select(self, sel: ast.Select) -> Rows:
+        from risingwave_tpu.batch import collect
+        epoch = self.store.committed_epoch()
+        ex = plan_batch(sel, self.catalog, self.store, epoch)
+        return collect(ex)

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import heapq
 import json
-import struct
+from collections import OrderedDict
 from typing import Dict, Iterable, Iterator, List, Optional, Tuple
 
 from risingwave_tpu.state.store import StateStore, Value
@@ -52,7 +52,10 @@ class HummockLite(StateStore):
         self._next_sst_id = 1
         self._l0: List[dict] = []       # SST infos, newest LAST
         self._l1: List[dict] = []       # key-disjoint, sorted by smallest
-        self._cache: Dict[int, Sst] = {}
+        # decoded-SST LRU (block-cache analog); compaction's one-shot
+        # sequential scans bypass it so the full LSM never pins memory
+        self._cache: OrderedDict[int, Sst] = OrderedDict()
+        self._cache_max = 64
         self._load_current()
 
     # -- manifest ---------------------------------------------------------
@@ -111,15 +114,15 @@ class HummockLite(StateStore):
         take = [im for im in self._imms if im[0] <= epoch]
         self._imms = [im for im in self._imms if im[0] > epoch]
         info = None
-        if take:
-            entries: List[Tuple[bytes, bool, bytes]] = []
-            for e, tables in take:
-                for table_id, kv in tables.items():
-                    for key, value in kv.items():
-                        fk = full_key(table_id, key, e)
-                        tomb = value is None
-                        entries.append(
-                            (fk, tomb, b"" if tomb else encode_row(value)))
+        entries: List[Tuple[bytes, bool, bytes]] = []
+        for e, tables in take:
+            for table_id, kv in tables.items():
+                for key, value in kv.items():
+                    fk = full_key(table_id, key, e)
+                    tomb = value is None
+                    entries.append(
+                        (fk, tomb, b"" if tomb else encode_row(value)))
+        if entries:
             entries.sort(key=lambda t: t[0])
             sst_id = self._next_sst_id
             self._next_sst_id += 1
@@ -145,7 +148,17 @@ class HummockLite(StateStore):
         if s is None:
             s = Sst(self.obj.read(f"data/{info['id']}.sst"), info)
             self._cache[info["id"]] = s
+            while len(self._cache) > self._cache_max:
+                self._cache.popitem(last=False)
+        else:
+            self._cache.move_to_end(info["id"])
         return s
+
+    def _sst_once(self, info: dict) -> Sst:
+        """Uncached read for one-shot sequential scans (compaction)."""
+        s = self._cache.get(info["id"])
+        return s if s is not None else Sst(
+            self.obj.read(f"data/{info['id']}.sst"), info)
 
     # -- read path --------------------------------------------------------
     def get(self, table_id: int, key: bytes, epoch: int) -> Value:
@@ -274,7 +287,7 @@ class HummockLite(StateStore):
         safe = self._committed_epoch
 
         def source(info: dict, r: int):
-            for fk, tomb, row in self._sst(info).iter_from(b""):
+            for fk, tomb, row in self._sst_once(info).iter_from(b""):
                 yield (fk, r, tomb, row)
 
         merged = heapq.merge(

@@ -41,15 +41,29 @@ BLOOM_K = 7
 EPOCH_MASK = (1 << 64) - 1
 
 
+def _esc_user(user_key: bytes) -> bytes:
+    """Order-preserving PREFIX-FREE encoding of arbitrary byte keys:
+    0x00 → 0x00 0xFF, terminated by 0x00 0x00. Without this, a user key
+    that is a byte-prefix of another would compare differently once the
+    inverted-epoch suffix is appended, breaking full-key ordering (and
+    with it the merge iterators and the L1 disjoint-run search)."""
+    return user_key.replace(b"\x00", b"\x00\xff") + b"\x00\x00"
+
+
+def _unesc_user(enc: bytes) -> bytes:
+    assert enc.endswith(b"\x00\x00"), enc
+    return enc[:-2].replace(b"\x00\xff", b"\x00")
+
+
 def full_key(table_id: int, user_key: bytes, epoch: int) -> bytes:
-    return (struct.pack(">I", table_id) + user_key
+    return (struct.pack(">I", table_id) + _esc_user(user_key)
             + struct.pack(">Q", (~epoch) & EPOCH_MASK))
 
 
 def split_full_key(fk: bytes) -> Tuple[int, bytes, int]:
     table_id = struct.unpack_from(">I", fk, 0)[0]
     epoch = (~struct.unpack_from(">Q", fk, len(fk) - 8)[0]) & EPOCH_MASK
-    return table_id, fk[4:-8], epoch
+    return table_id, _unesc_user(fk[4:-8]), epoch
 
 
 def _bloom_hashes(data: bytes) -> Tuple[int, int]:
@@ -232,8 +246,9 @@ class Sst:
         self.bloom = data[pos:pos + bl]
 
     def may_contain(self, table_id: int, user_key: bytes) -> bool:
+        # bloom keys are the ESCAPED table+user prefix (what add() hashed)
         return bloom_may_contain(
-            self.bloom, struct.pack(">I", table_id) + user_key)
+            self.bloom, struct.pack(">I", table_id) + _esc_user(user_key))
 
     def _block_range(self, start_fk: bytes) -> int:
         """Index of the first block that could contain start_fk."""

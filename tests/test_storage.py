@@ -225,3 +225,31 @@ def test_state_table_parity_memory_vs_hummock():
     hum = _drive_state_table(HummockLite(MemObjectStore()))
     assert mem == hum
     assert [r for _pk, r in hum] == [(1, 11, "a2"), (3, 30, "c")]
+
+
+def test_hummock_prefix_related_keys():
+    """User keys where one is a byte-prefix of another must order and
+    shadow correctly (needs the prefix-free key escaping in sst.py)."""
+    h = HummockLite(MemObjectStore())
+    h.ingest_batch(1, [(b"ab", (1,)), (b"abc", (2,)), (b"a\x00b", (3,))],
+                   E1)
+    _checkpoint(h, E1)
+    h.ingest_batch(1, [(b"ab", (10,))], E2)
+    assert [kv for kv in h.iter(1, E2)] == \
+        [(b"a\x00b", (3,)), (b"ab", (10,)), (b"abc", (2,))]
+    _checkpoint(h, E2)
+    h.compact()
+    assert h.get(1, b"ab", E2) == (10,)
+    assert h.get(1, b"abc", E2) == (2,)
+    assert h.get(1, b"a\x00b", E2) == (3,)
+    assert [kv for kv in h.iter(1, E2)] == \
+        [(b"a\x00b", (3,)), (b"ab", (10,)), (b"abc", (2,))]
+
+
+def test_hummock_empty_checkpoint_uploads_nothing():
+    h = HummockLite(MemObjectStore())
+    h.ingest_batch(1, [], E1)
+    _checkpoint(h, E1)
+    assert h.levels == (0, 0)
+    assert h.obj.list("data/") == []
+    assert h.committed_epoch() == E1
