@@ -379,11 +379,15 @@ def parse(sql: str):
 
 
 def parse_many(sql: str) -> list:
-    """Split on top-level ';' and parse each statement."""
+    """Split on top-level ';' → [(statement text, parsed stmt)].
+
+    The text rides along so callers (the session's DDL log) can persist
+    exactly what was executed.
+    """
     out = []
     for part in _split_statements(sql):
         if part.strip():
-            out.append(parse(part))
+            out.append((part.strip(), parse(part)))
     return out
 
 

@@ -16,7 +16,6 @@ from typing import Dict, List, Optional, Union
 
 from risingwave_tpu.frontend import ast
 from risingwave_tpu.frontend.catalog import Catalog, MvCatalog
-from risingwave_tpu.frontend.parser import parse_many
 from risingwave_tpu.frontend.planner import (
     PlanError, StreamPlanner, plan_batch, source_schema,
 )
@@ -29,7 +28,14 @@ Rows = List[tuple]
 
 
 class Frontend:
-    """One session over one in-process cluster."""
+    """One session over one in-process cluster.
+
+    If the state store is object-store-backed (HummockLite), the DDL
+    log persists at meta/ddl.json — the MetaStore analog. A fresh
+    Frontend over the same objects replays it on boot: the catalog
+    rebuilds, every MV's pipeline redeploys, and state/offsets resume
+    from the committed epoch (recovery.rs semantics, collapsed to DDL
+    replay + StateTable recovery)."""
 
     def __init__(self, store: Optional[StateStore] = None,
                  rate_limit: Optional[int] = 8,
@@ -44,14 +50,54 @@ class Frontend:
         self.rate_limit = rate_limit
         self.min_chunks = min_chunks
         self._next_actor = 1000
+        self._ddl_log: List[str] = []
+        self._replaying = False
+
+    # -- DDL-log durability (MetaStore analog) ---------------------------
+    @property
+    def _meta_obj(self):
+        return getattr(self.store, "obj", None)
+
+    def _persist_ddl(self) -> None:
+        if self._meta_obj is not None and not self._replaying:
+            import json
+            self._meta_obj.upload(
+                "meta/ddl.json", json.dumps(self._ddl_log).encode())
+
+    async def recover(self) -> int:
+        """Replay the persisted DDL log (boot path). Returns #stmts."""
+        if self._meta_obj is None or not self._meta_obj.exists(
+                "meta/ddl.json"):
+            return 0
+        import json
+        log = json.loads(self._meta_obj.read("meta/ddl.json").decode())
+        # restore the durable history FIRST — the next DDL statement
+        # re-persists the whole log, so losing it here would truncate
+        # the catalog on the following recovery
+        self._ddl_log = list(log)
+        self._replaying = True
+        try:
+            for sql in log:
+                await self.execute(sql)
+        finally:
+            self._replaying = False
+        return len(log)
 
     # -- public API -------------------------------------------------------
     async def execute(self, sql: str) -> Union[Rows, str]:
         """Run one or more ';'-separated statements; returns the last
         statement's result (rows for SELECT/SHOW, status otherwise)."""
+        from risingwave_tpu.frontend.parser import parse_many
+
         result: Union[Rows, str] = "OK"
-        for stmt in parse_many(sql):
+        for text, stmt in parse_many(sql):
             result = await self._run(stmt)
+            if isinstance(stmt, (ast.CreateSource,
+                                 ast.CreateMaterializedView,
+                                 ast.DropMaterializedView,
+                                 ast.DropSource)) and not self._replaying:
+                self._ddl_log.append(text)
+                self._persist_ddl()
         return result
 
     def execute_sync(self, sql: str) -> Union[Rows, str]:

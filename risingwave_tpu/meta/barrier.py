@@ -24,6 +24,7 @@ from risingwave_tpu.common.epoch import Epoch, EpochPair
 from risingwave_tpu.state.store import StateStore
 from risingwave_tpu.stream.actor import LocalBarrierManager
 from risingwave_tpu.stream.message import Barrier, BarrierKind, Mutation
+from risingwave_tpu.utils.metrics import STREAMING
 
 
 @dataclass
@@ -133,7 +134,11 @@ class BarrierLoop:
                 self._committed_epoch = prev
         t0 = self._inject_times.pop(epoch, None)
         if t0 is not None:
-            self.stats.latencies_s.append(self.monotonic() - t0)
+            lat = self.monotonic() - t0
+            self.stats.latencies_s.append(lat)
+            STREAMING.barrier_latency.observe(lat)
+        if barrier.is_checkpoint:
+            STREAMING.checkpoint_count.inc()
         self.stats.completed_epochs.append(epoch)
         return barrier
 

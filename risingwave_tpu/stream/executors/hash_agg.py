@@ -43,6 +43,7 @@ from risingwave_tpu.stream.executors.keys import (
 from risingwave_tpu.stream.message import (
     Barrier, Message, Watermark, is_barrier, is_chunk, is_watermark,
 )
+from risingwave_tpu.utils.metrics import STREAMING as _METRICS
 
 _SUM_OUT = {
     DataType.INT16: DataType.INT64, DataType.INT32: DataType.INT64,
@@ -168,6 +169,9 @@ class HashAggExecutor(Executor):
 
     def _flush(self) -> Optional[StreamChunk]:
         fr = self.kernel.flush()
+        _METRICS.agg_dirty_groups.set(fr.n, executor=self.identity)
+        _METRICS.agg_table_capacity.set(self.kernel.capacity,
+                                        executor=self.identity)
         if fr.n == 0:
             self.kernel.advance()
             return None

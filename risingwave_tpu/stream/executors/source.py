@@ -24,6 +24,7 @@ from risingwave_tpu.stream.executor import Executor, ExecutorInfo
 from risingwave_tpu.stream.message import (
     Barrier, Message, SourceChangeSplitMutation, is_barrier,
 )
+from risingwave_tpu.utils.metrics import STREAMING as _METRICS
 
 
 class SplitReader(Protocol):
@@ -151,6 +152,8 @@ class SourceExecutor(Executor):
                 exhausted = True
                 continue
             chunks_this_epoch += 1
+            _METRICS.source_rows.inc(chunk.cardinality(),
+                                     source=self.reader.split_id)
             yield chunk
             # yield to the event loop so the barrier injector can run
             await asyncio.sleep(0)

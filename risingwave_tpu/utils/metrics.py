@@ -1,0 +1,181 @@
+"""Metrics: counters/gauges/histograms + Prometheus text rendering.
+
+Reference parity: src/common/src/metrics.rs + the per-subsystem
+registries (StreamingMetrics src/stream/src/executor/monitor/
+streaming_stats.rs, meta barrier_latency src/meta/src/rpc/metrics.rs:57)
+— a dependency-free in-process registry with the same exposition
+format, so the numbers can feed any Prometheus scraper later.
+"""
+
+from __future__ import annotations
+
+import bisect
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, str]) -> LabelKey:
+    return tuple(sorted(labels.items()))
+
+
+def _fmt_labels(key: LabelKey) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._values: Dict[LabelKey, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        k = _label_key(labels)
+        self._values[k] = self._values.get(k, 0.0) + amount
+
+    def get(self, **labels: str) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def render(self) -> List[str]:
+        out = [f"# TYPE {self.name} counter"]
+        for k, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(k)} {v:g}")
+        return out
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._values: Dict[LabelKey, float] = {}
+
+    def set(self, value: float, **labels: str) -> None:
+        self._values[_label_key(labels)] = value
+
+    def get(self, **labels: str) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def render(self) -> List[str]:
+        out = [f"# TYPE {self.name} gauge"]
+        for k, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(k)} {v:g}")
+        return out
+
+
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class Histogram:
+    """Fixed-bucket histogram with exact-quantile support for tests
+    (keeps raw observations up to a cap)."""
+
+    def __init__(self, name: str, help_: str = "",
+                 buckets: Sequence[float] = DEFAULT_BUCKETS,
+                 keep_raw: int = 100_000):
+        self.name = name
+        self.help = help_
+        self.buckets = list(buckets)
+        self._counts: Dict[LabelKey, List[int]] = {}
+        self._sum: Dict[LabelKey, float] = {}
+        self._total: Dict[LabelKey, int] = {}
+        self._raw: Dict[LabelKey, List[float]] = {}
+        self._keep_raw = keep_raw
+
+    def observe(self, value: float, **labels: str) -> None:
+        k = _label_key(labels)
+        counts = self._counts.setdefault(k, [0] * (len(self.buckets) + 1))
+        i = bisect.bisect_left(self.buckets, value)
+        counts[i] += 1
+        self._sum[k] = self._sum.get(k, 0.0) + value
+        self._total[k] = self._total.get(k, 0) + 1
+        raw = self._raw.setdefault(k, [])
+        if len(raw) < self._keep_raw:
+            raw.append(value)
+
+    def quantile(self, q: float, **labels: str) -> float:
+        raw = sorted(self._raw.get(_label_key(labels), []))
+        if not raw:
+            return 0.0
+        return raw[min(len(raw) - 1, int(len(raw) * q))]
+
+    def count(self, **labels: str) -> int:
+        return self._total.get(_label_key(labels), 0)
+
+    def render(self) -> List[str]:
+        out = [f"# TYPE {self.name} histogram"]
+        for k, counts in sorted(self._counts.items()):
+            acc = 0
+            for le, c in zip(self.buckets, counts):
+                acc += c
+                lk = k + (("le", f"{le:g}"),)
+                out.append(f"{self.name}_bucket{_fmt_labels(lk)} {acc}")
+            acc += counts[-1]
+            lk = k + (("le", "+Inf"),)
+            out.append(f"{self.name}_bucket{_fmt_labels(lk)} {acc}")
+            out.append(f"{self.name}_sum{_fmt_labels(k)} "
+                       f"{self._sum.get(k, 0.0):g}")
+            out.append(f"{self.name}_count{_fmt_labels(k)} "
+                       f"{self._total.get(k, 0)}")
+        return out
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._metrics: Dict[str, object] = {}
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get(name, lambda: Counter(name, help_))
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get(name, lambda: Gauge(name, help_))
+
+    def histogram(self, name: str, help_: str = "",
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get(name, lambda: Histogram(name, help_, buckets))
+
+    def _get(self, name: str, mk):
+        m = self._metrics.get(name)
+        if m is None:
+            m = self._metrics[name] = mk()
+        return m
+
+    def render(self) -> str:
+        lines: List[str] = []
+        for name in sorted(self._metrics):
+            lines.extend(self._metrics[name].render())
+        return "\n".join(lines) + "\n"
+
+
+# the process-global registry (per-node registry analog)
+GLOBAL = MetricsRegistry()
+
+
+class StreamingMetrics:
+    """The streaming-side metric family (streaming_stats.rs analog)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        r = registry or GLOBAL
+        self.source_rows = r.counter(
+            "stream_source_output_rows_counts",
+            "rows emitted by sources")
+        self.executor_rows = r.counter(
+            "stream_executor_row_count", "rows through executors")
+        self.barrier_latency = r.histogram(
+            "meta_barrier_duration_seconds",
+            "inject→commit latency per barrier")
+        self.agg_dirty_groups = r.gauge(
+            "stream_agg_dirty_groups_count",
+            "dirty groups at last flush")
+        self.agg_table_capacity = r.gauge(
+            "stream_agg_table_capacity", "device hash-table slots")
+        self.actor_count = r.gauge("stream_actor_count", "live actors")
+        self.checkpoint_count = r.counter(
+            "meta_checkpoint_count", "committed checkpoints")
+
+
+STREAMING = StreamingMetrics()

@@ -1,0 +1,96 @@
+"""Tracing: lightweight spans + an actor await-state registry.
+
+Reference parity: the tracing-crate spans threaded through the
+reference (barrier TracingContext, src/stream/src/executor/mod.rs:253)
+and the await-tree actor stack dumps exposed by MonitorService
+(src/compute/src/rpc/service/monitor_service.rs:72) — reduced to a
+ring buffer of spans plus a per-actor "currently awaiting" table that
+a debugger (or test) can dump when a barrier stalls.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple
+
+
+@dataclass
+class Span:
+    name: str
+    start_s: float
+    end_s: float = 0.0
+    attrs: Dict[str, object] = field(default_factory=dict)
+    parent: Optional[str] = None
+
+    @property
+    def duration_s(self) -> float:
+        return max(0.0, self.end_s - self.start_s)
+
+
+# per-task span stack: concurrent actors must not see each other's
+# frames (a shared list would cross-attribute parents under asyncio)
+_SPAN_STACK: contextvars.ContextVar[Tuple[str, ...]] = \
+    contextvars.ContextVar("rw_span_stack", default=())
+
+
+class Tracer:
+    """Ring buffer of completed spans (OTLP-export seam)."""
+
+    def __init__(self, capacity: int = 4096,
+                 clock=time.monotonic) -> None:
+        self.spans: Deque[Span] = deque(maxlen=capacity)
+        self.clock = clock
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        stack = _SPAN_STACK.get()
+        s = Span(name, self.clock(),
+                 attrs=attrs,
+                 parent=stack[-1] if stack else None)
+        token = _SPAN_STACK.set(stack + (name,))
+        try:
+            yield s
+        finally:
+            _SPAN_STACK.reset(token)
+            s.end_s = self.clock()
+            self.spans.append(s)
+
+    def find(self, name: str) -> List[Span]:
+        return [s for s in self.spans if s.name == name]
+
+
+GLOBAL_TRACER = Tracer()
+
+
+class AwaitRegistry:
+    """Who is waiting on what (await-tree analog).
+
+    Actors/executors report their current await point; ``dump()`` shows
+    the live picture — the first tool to reach for when an epoch never
+    collects.
+    """
+
+    def __init__(self, clock=time.monotonic) -> None:
+        self._state: Dict[str, tuple] = {}
+        self.clock = clock
+
+    def enter(self, who: str, what: str) -> None:
+        self._state[who] = (what, self.clock())
+
+    def exit(self, who: str) -> None:
+        self._state.pop(who, None)
+
+    def dump(self) -> str:
+        now = self.clock()
+        lines = []
+        for who in sorted(self._state):
+            what, since = self._state[who]
+            lines.append(f"{who}: {what} [{now - since:.3f}s]")
+        return "\n".join(lines)
+
+
+GLOBAL_AWAITS = AwaitRegistry()

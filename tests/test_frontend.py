@@ -169,3 +169,42 @@ def test_drop_mv_stops_pipeline():
 
     rows = asyncio.run(run())
     assert rows[0][0] > 0
+
+
+def test_q0_q2_q3_shaped_queries():
+    """q0 passthrough, q2 modulo filter, q3 filtered join — the rest of
+    the easily-expressible nexmark corpus (e2e_test/streaming/nexmark)."""
+    async def run():
+        fe = Frontend(min_chunks=8)
+        await fe.execute(NEXMARK_BID)
+        await fe.execute(
+            "CREATE SOURCE person WITH (connector='nexmark', "
+            "nexmark.table.type='person', nexmark.event.num=20000, "
+            "nexmark.min.event.gap.in.ns=100000000)")
+        await fe.execute(
+            "CREATE SOURCE auction WITH (connector='nexmark', "
+            "nexmark.table.type='auction', nexmark.event.num=20000, "
+            "nexmark.min.event.gap.in.ns=100000000)")
+        await fe.execute("CREATE MATERIALIZED VIEW q0 AS "
+                         "SELECT * FROM bid")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW q2 AS SELECT auction, price "
+            "FROM bid WHERE auction % 123 = 0")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW q3 AS SELECT p.name, p.city, "
+            "p.state, a.id FROM auction AS a JOIN person AS p "
+            "ON a.seller = p.id "
+            "WHERE a.category = 1 AND (p.state = 'OR' OR p.state = 'ID' "
+            "OR p.state = 'CA')")
+        await fe.step(8)
+        q0 = await fe.execute("SELECT COUNT(*) AS n FROM q0")
+        q2 = await fe.execute("SELECT auction, price FROM q2")
+        q3 = await fe.execute("SELECT * FROM q3")
+        await fe.close()
+        return q0, q2, q3
+
+    q0, q2, q3 = asyncio.run(run())
+    assert q0[0][0] == 20000 * 46 // 50          # all bids materialized
+    assert len(q2) > 0
+    assert all(a % 123 == 0 for a, _p in q2)
+    assert all(s in ("OR", "ID", "CA") for _n, _c, s, _i in q3)

@@ -1,0 +1,75 @@
+"""Metrics + tracing tests (aux subsystems, SURVEY §5)."""
+
+import asyncio
+
+from risingwave_tpu.utils.metrics import (
+    Counter, Gauge, Histogram, MetricsRegistry, STREAMING,
+)
+from risingwave_tpu.utils.trace import AwaitRegistry, Tracer
+
+
+def test_counter_gauge_histogram_render():
+    r = MetricsRegistry()
+    c = r.counter("rows_total")
+    c.inc(5, actor="1")
+    c.inc(2, actor="1")
+    c.inc(1, actor="2")
+    assert c.get(actor="1") == 7
+    g = r.gauge("cap")
+    g.set(1024)
+    h = r.histogram("lat_seconds", buckets=[0.01, 0.1, 1.0])
+    for v in (0.005, 0.05, 0.05, 0.5, 2.0):
+        h.observe(v)
+    assert h.count() == 5
+    assert h.quantile(0.5) == 0.05
+    text = r.render()
+    assert 'rows_total{actor="1"} 7' in text
+    assert "cap 1024" in text
+    assert 'lat_seconds_bucket{le="0.01"} 1' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 5' in text
+    assert "lat_seconds_count 5" in text
+
+
+def test_pipeline_populates_streaming_metrics():
+    from risingwave_tpu.frontend import Frontend
+
+    async def run():
+        before_rows = STREAMING.source_rows.get(source="nexmark-0")
+        before_cp = STREAMING.checkpoint_count.get()
+        fe = Frontend(min_chunks=2)
+        await fe.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', "
+            "nexmark.table.type='bid', nexmark.event.num=5000)")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW m AS SELECT window_start, "
+            "COUNT(*) AS c FROM TUMBLE(bid, date_time, "
+            "INTERVAL '10' SECOND) GROUP BY window_start")
+        await fe.step(3)
+        await fe.close()
+        return (STREAMING.source_rows.get(source="nexmark-0")
+                - before_rows,
+                STREAMING.checkpoint_count.get() - before_cp,
+                STREAMING.barrier_latency.count())
+
+    rows, cps, lat_n = asyncio.run(run())
+    assert rows > 0
+    assert cps >= 3
+    assert lat_n > 0
+
+
+def test_tracer_spans_and_await_registry():
+    t = Tracer()
+    with t.span("barrier", epoch=7):
+        with t.span("flush"):
+            pass
+    spans = t.find("flush")
+    assert len(spans) == 1 and spans[0].parent == "barrier"
+    assert t.find("barrier")[0].attrs == {"epoch": 7}
+
+    a = AwaitRegistry()
+    a.enter("actor-1", "barrier_align(left)")
+    a.enter("actor-2", "state_table.commit")
+    dump = a.dump()
+    assert "actor-1: barrier_align(left)" in dump
+    a.exit("actor-1")
+    assert "actor-1" not in a.dump()
