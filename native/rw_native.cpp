@@ -1,0 +1,176 @@
+// Native runtime kernels for hummock-lite's storage hot path.
+//
+// Reference parity: the role of the Rust block builder/decoder
+// (src/storage/src/hummock/sstable/block.rs) and bloom construction
+// (sstable/bloom.rs) — the per-entry byte-wrangling loops that sit on
+// the checkpoint-upload and scan paths. Byte-for-byte compatible with
+// the pure-Python implementation in risingwave_tpu/storage/sst.py:
+// either side can read the other's SSTs (mixed deployments, and the
+// Python path remains the portable fallback).
+//
+// Build: g++ -O2 -shared -fPIC -o librw_native.so rw_native.cpp
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// CRC-32 (IEEE, zlib-compatible): crc32(prev, data) semantics.
+uint32_t crc_table[256];
+bool crc_init_done = false;
+
+void crc_init() {
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int j = 0; j < 8; j++)
+            c = (c & 1) ? (0xEDB88320u ^ (c >> 1)) : (c >> 1);
+        crc_table[i] = c;
+    }
+    crc_init_done = true;
+}
+
+uint32_t crc32_z(uint32_t prev, const uint8_t* p, long n) {
+    if (!crc_init_done) crc_init();
+    uint32_t c = prev ^ 0xFFFFFFFFu;
+    for (long i = 0; i < n; i++)
+        c = crc_table[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+    return c ^ 0xFFFFFFFFu;
+}
+
+inline long put_uvarint(uint8_t* out, long pos, uint64_t v) {
+    while (v >= 0x80) {
+        out[pos++] = (uint8_t)((v & 0x7F) | 0x80);
+        v >>= 7;
+    }
+    out[pos++] = (uint8_t)v;
+    return pos;
+}
+
+// Bounded varint read: returns new pos, or -1 on truncation/overlong
+// input (corrupt object-store data must fail cleanly, not read OOB).
+inline long get_uvarint(const uint8_t* data, long pos, long len,
+                        uint64_t* v) {
+    int shift = 0;
+    uint64_t r = 0;
+    for (;;) {
+        if (pos >= len || shift > 63) return -1;
+        uint8_t b = data[pos++];
+        r |= (uint64_t)(b & 0x7F) << shift;
+        if (b < 0x80) break;
+        shift += 7;
+    }
+    *v = r;
+    return pos;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Prefix-compressed block encode. Entries must be pre-sorted by key.
+// Returns bytes written, or -1 if out_cap is insufficient.
+long rw_block_encode(const uint8_t* keys, const int32_t* key_lens,
+                     const uint8_t* vals, const int32_t* val_lens,
+                     int32_t n, int32_t restart_interval,
+                     uint8_t* out, long out_cap) {
+    long pos = 0;
+    const uint8_t* last_key = nullptr;
+    int32_t last_len = 0;
+    const uint8_t* kp = keys;
+    const uint8_t* vp = vals;
+    for (int32_t i = 0; i < n; i++) {
+        int32_t kl = key_lens[i], vl = val_lens[i];
+        int32_t shared = 0;
+        if (i % restart_interval != 0 && last_key != nullptr) {
+            int32_t m = kl < last_len ? kl : last_len;
+            while (shared < m && kp[shared] == last_key[shared]) shared++;
+        }
+        // worst case: 3 varints (≤10B each) + suffix + value
+        if (pos + 30 + (kl - shared) + vl > out_cap) return -1;
+        pos = put_uvarint(out, pos, (uint64_t)shared);
+        pos = put_uvarint(out, pos, (uint64_t)(kl - shared));
+        pos = put_uvarint(out, pos, (uint64_t)vl);
+        memcpy(out + pos, kp + shared, (size_t)(kl - shared));
+        pos += kl - shared;
+        memcpy(out + pos, vp, (size_t)vl);
+        pos += vl;
+        last_key = kp;
+        last_len = kl;
+        kp += kl;
+        vp += vl;
+    }
+    return pos;
+}
+
+// Block decode → concatenated keys/values + per-entry lengths.
+// Returns entry count, or -1 on buffer overflow / malformed input.
+long rw_block_decode(const uint8_t* data, long len,
+                     uint8_t* keys_out, long keys_cap,
+                     int32_t* key_lens,
+                     uint8_t* vals_out, long vals_cap,
+                     int32_t* val_lens, long max_entries) {
+    long pos = 0, n = 0;
+    long kpos = 0, vpos = 0;
+    uint8_t prev_key[4096];
+    long prev_len = 0;
+    while (pos < len) {
+        if (n >= max_entries) return -1;
+        uint64_t shared, unshared, vlen;
+        pos = get_uvarint(data, pos, len, &shared);
+        if (pos < 0) return -1;
+        pos = get_uvarint(data, pos, len, &unshared);
+        if (pos < 0) return -1;
+        pos = get_uvarint(data, pos, len, &vlen);
+        if (pos < 0) return -1;
+        long kl = (long)(shared + unshared);
+        if (kl > 4096 || (long)shared > prev_len) return -1;
+        if (pos + (long)unshared + (long)vlen > len) return -1;
+        if (kpos + kl > keys_cap || vpos + (long)vlen > vals_cap)
+            return -1;
+        memcpy(prev_key + shared, data + pos, (size_t)unshared);
+        pos += (long)unshared;
+        prev_len = kl;
+        memcpy(keys_out + kpos, prev_key, (size_t)kl);
+        kpos += kl;
+        key_lens[n] = (int32_t)kl;
+        memcpy(vals_out + vpos, data + pos, (size_t)vlen);
+        pos += (long)vlen;
+        vals_out += 0;
+        vpos += (long)vlen;
+        val_lens[n] = (int32_t)vlen;
+        n++;
+    }
+    return n;
+}
+
+// Bulk split-Bloom build: for each item, set k bits of bits[nbits].
+// Hashes match the Python side: h1 = crc32(item), h2 = crc32(item,
+// 0x9E3779B9) | 1, bit_j = (h1 + j*h2) % nbits, MSB-first packing.
+void rw_bloom_build(const uint8_t* items, const int32_t* lens,
+                    int32_t n, int32_t k, uint8_t* bits, long nbits) {
+    const uint8_t* p = items;
+    for (int32_t i = 0; i < n; i++) {
+        uint32_t h1 = crc32_z(0, p, lens[i]);
+        uint32_t h2 = crc32_z(0x9E3779B9u, p, lens[i]) | 1u;
+        for (int32_t j = 0; j < k; j++) {
+            uint64_t bit = ((uint64_t)h1 + (uint64_t)j * h2) % (uint64_t)nbits;
+            bits[bit >> 3] |= (uint8_t)(1u << (7 - (bit & 7)));
+        }
+        p += lens[i];
+    }
+}
+
+// Bloom probe for one item (same hash family). Returns 0/1.
+int32_t rw_bloom_may_contain(const uint8_t* item, int32_t len,
+                             const uint8_t* bits, long nbits,
+                             int32_t k) {
+    uint32_t h1 = crc32_z(0, item, len);
+    uint32_t h2 = crc32_z(0x9E3779B9u, item, len) | 1u;
+    for (int32_t j = 0; j < k; j++) {
+        uint64_t bit = ((uint64_t)h1 + (uint64_t)j * h2) % (uint64_t)nbits;
+        if (!((bits[bit >> 3] >> (7 - (bit & 7))) & 1)) return 0;
+    }
+    return 1;
+}
+
+}  // extern "C"

@@ -28,6 +28,7 @@ from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from risingwave_tpu import native as _native
 from risingwave_tpu.storage.value_codec import (
     read_uvarint, write_uvarint,
 )
@@ -74,17 +75,28 @@ def _bloom_hashes(data: bytes) -> Tuple[int, int]:
 
 class _BloomBuilder:
     def __init__(self) -> None:
-        self.hashes: List[Tuple[int, int]] = []
+        self.items: List[bytes] = []
 
     def add(self, data: bytes) -> None:
-        self.hashes.append(_bloom_hashes(data))
+        self.items.append(data)
 
     def finish(self) -> bytes:
-        n = max(1, len(self.hashes))
+        n = max(1, len(self.items))
         nbits = max(64, n * BLOOM_BITS_PER_KEY)
         nbits = (nbits + 7) // 8 * 8
+        nat = _native.lib()
+        if nat is not None and self.items:
+            import ctypes
+            blob = b"".join(self.items)
+            lens = (ctypes.c_int32 * len(self.items))(
+                *[len(i) for i in self.items])
+            bits = ctypes.create_string_buffer(nbits // 8)
+            nat.rw_bloom_build(blob, lens, len(self.items), BLOOM_K,
+                               bits, nbits)
+            return bits.raw
         bits = np.zeros(nbits, dtype=bool)
-        for h1, h2 in self.hashes:
+        for item in self.items:
+            h1, h2 = _bloom_hashes(item)
             for i in range(BLOOM_K):
                 bits[(h1 + i * h2) % nbits] = True
         return np.packbits(bits).tobytes()
@@ -94,6 +106,11 @@ def bloom_may_contain(filter_bytes: bytes, data: bytes) -> bool:
     if not filter_bytes:
         return True
     nbits = len(filter_bytes) * 8
+    nat = _native.lib()
+    if nat is not None:
+        return bool(nat.rw_bloom_may_contain(data, len(data),
+                                             filter_bytes, nbits,
+                                             BLOOM_K))
     h1, h2 = _bloom_hashes(data)
     for i in range(BLOOM_K):
         bit = (h1 + i * h2) % nbits
@@ -103,38 +120,64 @@ def bloom_may_contain(filter_bytes: bytes, data: bytes) -> bool:
 
 
 class _BlockBuilder:
+    """Buffers entries; encoding happens at finish() (native or py)."""
+
     def __init__(self) -> None:
-        self.buf = bytearray()
+        self.keys: List[bytes] = []
+        self.values: List[bytes] = []
+        self._size = 0
         self.count = 0
-        self.last_key = b""
         self.first_key = b""
 
     def add(self, key: bytes, value: bytes) -> None:
-        if self.count % RESTART_INTERVAL == 0:
-            shared = 0
-        else:
-            shared = 0
-            m = min(len(key), len(self.last_key))
-            while shared < m and key[shared] == self.last_key[shared]:
-                shared += 1
         if self.count == 0:
             self.first_key = key
-        write_uvarint(self.buf, shared)
-        write_uvarint(self.buf, len(key) - shared)
-        write_uvarint(self.buf, len(value))
-        self.buf.extend(key[shared:])
-        self.buf.extend(value)
-        self.last_key = key
+        self.keys.append(key)
+        self.values.append(value)
+        # conservative size estimate (uncompressed + varint headroom)
+        self._size += len(key) + len(value) + 6
         self.count += 1
 
     def size(self) -> int:
-        return len(self.buf)
+        return self._size
 
     def finish(self) -> bytes:
-        return bytes(self.buf)
+        nat = _native.lib()
+        if nat is not None and self.count:
+            import ctypes
+            kblob = b"".join(self.keys)
+            vblob = b"".join(self.values)
+            klens = (ctypes.c_int32 * self.count)(
+                *[len(k) for k in self.keys])
+            vlens = (ctypes.c_int32 * self.count)(
+                *[len(v) for v in self.values])
+            cap = self._size + 30 * self.count
+            out = ctypes.create_string_buffer(cap)
+            n = nat.rw_block_encode(kblob, klens, vblob, vlens,
+                                    self.count, RESTART_INTERVAL, out,
+                                    cap)
+            if n >= 0:
+                return out.raw[:n]
+        buf = bytearray()
+        last_key = b""
+        for i, (key, value) in enumerate(zip(self.keys, self.values)):
+            if i % RESTART_INTERVAL == 0:
+                shared = 0
+            else:
+                shared = 0
+                m = min(len(key), len(last_key))
+                while shared < m and key[shared] == last_key[shared]:
+                    shared += 1
+            write_uvarint(buf, shared)
+            write_uvarint(buf, len(key) - shared)
+            write_uvarint(buf, len(value))
+            buf.extend(key[shared:])
+            buf.extend(value)
+            last_key = key
+        return bytes(buf)
 
 
-def iter_block(data: bytes) -> Iterator[Tuple[bytes, bytes]]:
+def _iter_block_py(data: bytes) -> Iterator[Tuple[bytes, bytes]]:
     pos = 0
     key = b""
     n = len(data)
@@ -147,6 +190,37 @@ def iter_block(data: bytes) -> Iterator[Tuple[bytes, bytes]]:
         value = data[pos:pos + vlen]
         pos += vlen
         yield key, value
+
+
+def iter_block(data: bytes) -> Iterator[Tuple[bytes, bytes]]:
+    nat = _native.lib()
+    if nat is None or not data:
+        yield from _iter_block_py(data)
+        return
+    import ctypes
+    max_entries = len(data)           # ≥ true count (≥1 byte/entry)
+    # modest caps: prefix compression rarely expands 4x on real keys;
+    # the -1 overflow return falls back to the Python decoder
+    keys_cap = vals_cap = len(data) * 4 + 65536
+    keys_out = ctypes.create_string_buffer(keys_cap)
+    vals_out = ctypes.create_string_buffer(vals_cap)
+    klens = (ctypes.c_int32 * max_entries)()
+    vlens = (ctypes.c_int32 * max_entries)()
+    n = nat.rw_block_decode(data, len(data), keys_out, keys_cap, klens,
+                            vals_out, vals_cap, vlens, max_entries)
+    if n < 0:                          # overflow/malformed → fallback
+        yield from _iter_block_py(data)
+        return
+    kused = sum(klens[i] for i in range(n))
+    vused = sum(vlens[i] for i in range(n))
+    kraw = ctypes.string_at(keys_out, kused)   # copy USED bytes only
+    vraw = ctypes.string_at(vals_out, vused)
+    kp = vp = 0
+    for i in range(n):
+        kl, vl = klens[i], vlens[i]
+        yield kraw[kp:kp + kl], vraw[vp:vp + vl]
+        kp += kl
+        vp += vl
 
 
 class SstBuilder:

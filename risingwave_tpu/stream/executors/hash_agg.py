@@ -294,17 +294,22 @@ class HashAggExecutor(Executor):
         self.table.init_epoch(first.epoch)
         self._recover()
         yield first
-        async for msg in it:
-            if is_chunk(msg):
-                self._apply_chunk(msg)
-            elif is_barrier(msg):
-                out = self._flush()
-                self.table.commit(msg.epoch)
-                if out is not None:
-                    yield out
-                yield msg
-            elif is_watermark(msg):
-                # forward only group-key watermarks, re-indexed to output
-                if msg.col_idx in self.group_indices:
-                    yield msg.with_idx(
-                        self.group_indices.index(msg.col_idx))
+        try:
+            async for msg in it:
+                if is_chunk(msg):
+                    self._apply_chunk(msg)
+                elif is_barrier(msg):
+                    out = self._flush()
+                    self.table.commit(msg.epoch)
+                    if out is not None:
+                        yield out
+                    yield msg
+                elif is_watermark(msg):
+                    # forward only group-key watermarks, re-indexed
+                    if msg.col_idx in self.group_indices:
+                        yield msg.with_idx(
+                            self.group_indices.index(msg.col_idx))
+        finally:
+            # executor teardown: release this identity's gauge series
+            _METRICS.agg_dirty_groups.remove(executor=self.identity)
+            _METRICS.agg_table_capacity.remove(executor=self.identity)

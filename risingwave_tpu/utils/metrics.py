@@ -16,6 +16,14 @@ from typing import Dict, List, Optional, Sequence, Tuple
 LabelKey = Tuple[Tuple[str, str], ...]
 
 
+def _fmt_value(v: float) -> str:
+    """Full-precision exposition: '%g' truncates to 6 significant
+    digits, freezing large counters in a scraper's eyes."""
+    if float(v).is_integer() and abs(v) < 2**63:
+        return str(int(v))
+    return repr(float(v))
+
+
 def _label_key(labels: Dict[str, str]) -> LabelKey:
     return tuple(sorted(labels.items()))
 
@@ -43,7 +51,7 @@ class Counter:
     def render(self) -> List[str]:
         out = [f"# TYPE {self.name} counter"]
         for k, v in sorted(self._values.items()):
-            out.append(f"{self.name}{_fmt_labels(k)} {v:g}")
+            out.append(f"{self.name}{_fmt_labels(k)} {_fmt_value(v)}")
         return out
 
 
@@ -59,10 +67,15 @@ class Gauge:
     def get(self, **labels: str) -> float:
         return self._values.get(_label_key(labels), 0.0)
 
+    def remove(self, **labels: str) -> None:
+        """Drop a labeled series (executor teardown — avoids leaking
+        stale series in the process-global registry)."""
+        self._values.pop(_label_key(labels), None)
+
     def render(self) -> List[str]:
         out = [f"# TYPE {self.name} gauge"]
         for k, v in sorted(self._values.items()):
-            out.append(f"{self.name}{_fmt_labels(k)} {v:g}")
+            out.append(f"{self.name}{_fmt_labels(k)} {_fmt_value(v)}")
         return out
 
 
@@ -118,7 +131,7 @@ class Histogram:
             lk = k + (("le", "+Inf"),)
             out.append(f"{self.name}_bucket{_fmt_labels(lk)} {acc}")
             out.append(f"{self.name}_sum{_fmt_labels(k)} "
-                       f"{self._sum.get(k, 0.0):g}")
+                       f"{_fmt_value(self._sum.get(k, 0.0))}")
             out.append(f"{self.name}_count{_fmt_labels(k)} "
                        f"{self._total.get(k, 0)}")
         return out
