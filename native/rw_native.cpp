@@ -46,19 +46,23 @@ inline long put_uvarint(uint8_t* out, long pos, uint64_t v) {
     return pos;
 }
 
-// Bounded varint read: returns new pos, or -1 on truncation/overlong
-// input (corrupt object-store data must fail cleanly, not read OOB).
+// Bounded varint read for LENGTH fields: returns new pos, or -1 on
+// truncation or any value >= 2^28 (no block length is near that; a
+// larger value is corrupt data and, if cast to long, could turn the
+// caller's bounds checks negative — corrupt object-store bytes must
+// fail cleanly, not read OOB).
 inline long get_uvarint(const uint8_t* data, long pos, long len,
                         uint64_t* v) {
     int shift = 0;
     uint64_t r = 0;
     for (;;) {
-        if (pos >= len || shift > 63) return -1;
+        if (pos >= len || shift > 21) return -1;
         uint8_t b = data[pos++];
         r |= (uint64_t)(b & 0x7F) << shift;
         if (b < 0x80) break;
         shift += 7;
     }
+    if (r >= (1u << 28)) return -1;
     *v = r;
     return pos;
 }
@@ -135,7 +139,6 @@ long rw_block_decode(const uint8_t* data, long len,
         key_lens[n] = (int32_t)kl;
         memcpy(vals_out + vpos, data + pos, (size_t)vlen);
         pos += (long)vlen;
-        vals_out += 0;
         vpos += (long)vlen;
         val_lens[n] = (int32_t)vlen;
         n++;

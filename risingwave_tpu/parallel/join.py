@@ -1,0 +1,203 @@
+"""Vnode-sharded join matcher over a device mesh (multi-chip q8).
+
+Reference parity: N parallel HashJoinExecutor actors fed by HASH
+dispatchers on both inputs (dispatch.rs:582; hash_join.rs:227). TPU
+re-design: each mesh shard owns the join-key vnode range's slice of
+BOTH sides' key tables and row chains; a chunk routes to owners via the
+bucketized all_to_all (parallel/exchange.py) and then runs the exact
+single-chip kernels (ops/hash_join.py probe_pairs / link_rows) locally
+— one code path, two launch shapes, matching ShardedAggKernel's
+construction so the whole q8 plan shards the same way the q7 plan does.
+
+Host contract: row refs are GLOBAL (the host arena's); each shard's
+chains store the global refs routed to it, so probe results need no
+re-translation. Probe outputs return per-shard packed pair matrices
+with the probing row's global id as the left column.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from risingwave_tpu.common.hash import VNODE_COUNT
+from risingwave_tpu.ops import hash_table as ht
+from risingwave_tpu.ops.hash_join import ChainState, link_rows, probe_pairs
+from risingwave_tpu.parallel.exchange import (
+    bucketize_by_owner, exchange, vnodes_from_lanes,
+)
+
+AXIS = "d"
+
+
+class ShardedJoinSide:
+    """One join side's matcher sharded over a mesh (fixed capacity v1)."""
+
+    def __init__(self, mesh: Mesh, key_width: int,
+                 key_capacity: int = 1 << 12,
+                 row_capacity: int = 1 << 12,
+                 probe_capacity: int = 1 << 12):
+        self.mesh = mesh
+        self.n_dev = mesh.devices.size
+        self.key_width = key_width
+        self.key_capacity = key_capacity
+        self.row_capacity = row_capacity
+        self.probe_capacity = probe_capacity
+        owners = np.repeat(np.arange(self.n_dev, dtype=np.int32),
+                           VNODE_COUNT // self.n_dev)
+        pad = VNODE_COUNT - len(owners)
+        if pad:
+            owners = np.concatenate(
+                [owners, np.full(pad, self.n_dev - 1, np.int32)])
+        self.owner_map = jnp.asarray(owners)
+        sharding = NamedSharding(mesh, P(AXIS))
+
+        def stack(a):
+            return jax.device_put(
+                jnp.broadcast_to(a[None], (self.n_dev,) + a.shape),
+                sharding)
+
+        table = ht.make_state(key_capacity, key_width)
+        self.table = ht.TableState(stack(table.keys), stack(table.occ))
+        self.chains = ChainState(
+            head=stack(jnp.full(key_capacity, -1, dtype=jnp.int32)),
+            next=stack(jnp.full(row_capacity, -1, dtype=jnp.int32)),
+            live=stack(jnp.zeros(row_capacity, dtype=bool)))
+        self._insert_cache: Dict[Tuple[int, int], object] = {}
+        self._probe_cache: Dict[Tuple[int, int, int], object] = {}
+        self._rows_inserted = 0
+
+    # -- SPMD steps -------------------------------------------------------
+    def _build_insert(self, n: int, bucket: int):
+        n_dev = self.n_dev
+        cap = self.key_capacity
+
+        def local(table, chains, key_lanes, refs, vis, owner_map):
+            table = jax.tree.map(lambda a: a[0], table)
+            chains = jax.tree.map(lambda a: a[0], chains)
+            owner = owner_map[vnodes_from_lanes(key_lanes)]
+            buckets, bvalid, overflow = bucketize_by_owner(
+                owner, vis, [key_lanes, refs], n_dev, bucket)
+            recv, rvalid = exchange(buckets, bvalid, AXIS)
+            m = n_dev * bucket
+            rkeys = recv[0].reshape(m, key_lanes.shape[1])
+            rrefs = recv[1].reshape(m)
+            rvis = rvalid.reshape(m)
+            table, slots, _ins = ht.probe_insert(table, rkeys, rvis)
+            chains = link_rows(chains, slots, rrefs, rvis, cap)
+            return (jax.tree.map(lambda a: a[None], table),
+                    jax.tree.map(lambda a: a[None], chains),
+                    overflow[None])
+
+        tspec = jax.tree.map(lambda _: P(AXIS), self.table)
+        cspec = jax.tree.map(lambda _: P(AXIS), self.chains)
+        mapped = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(tspec, cspec, P(AXIS), P(AXIS), P(AXIS), P()),
+            out_specs=(tspec, cspec, P(AXIS)),
+            check_vma=False)
+        return jax.jit(mapped, donate_argnums=(0, 1))
+
+    def _build_probe(self, n: int, bucket: int, out_cap: int):
+        n_dev = self.n_dev
+
+        def local(table, chains, key_lanes, row_ids, vis, owner_map):
+            table = jax.tree.map(lambda a: a[0], table)
+            chains = jax.tree.map(lambda a: a[0], chains)
+            owner = owner_map[vnodes_from_lanes(key_lanes)]
+            buckets, bvalid, overflow = bucketize_by_owner(
+                owner, vis, [key_lanes, row_ids], n_dev, bucket)
+            recv, rvalid = exchange(buckets, bvalid, AXIS)
+            m = n_dev * bucket
+            rkeys = recv[0].reshape(m, key_lanes.shape[1])
+            rids = recv[1].reshape(m)
+            rvis = rvalid.reshape(m)
+            mat = probe_pairs(table, chains, rkeys, rvis, out_cap)
+            # rewrite probe-row indices (local post-exchange positions)
+            # to the routed global row ids; -1 stays -1
+            pairs = mat[1 + m:]
+            safe = jnp.maximum(pairs[:, 0], 0)
+            gprobe = jnp.where(pairs[:, 0] >= 0, rids[safe], -1)
+            pairs = jnp.stack([gprobe, pairs[:, 1]], axis=1)
+            out = jnp.concatenate([mat[:1], pairs], axis=0)
+            return out[None], overflow[None]
+
+        tspec = jax.tree.map(lambda _: P(AXIS), self.table)
+        cspec = jax.tree.map(lambda _: P(AXIS), self.chains)
+        mapped = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(tspec, cspec, P(AXIS), P(AXIS), P(AXIS), P()),
+            out_specs=(P(AXIS), P(AXIS)),
+            check_vma=False)
+        return jax.jit(mapped)
+
+    # -- host API ---------------------------------------------------------
+    def insert(self, key_lanes: np.ndarray, refs: np.ndarray,
+               vis: np.ndarray) -> None:
+        n = key_lanes.shape[0]
+        assert n % self.n_dev == 0, (n, self.n_dev)
+        # fixed-capacity v1 guards: overfilling a shard's key table
+        # would make probe_insert link rows under wrong keys, and a
+        # ref >= row_capacity would be silently dropped by the chain
+        # scatter — both must fail loudly until growth lands here.
+        n_valid = int(np.asarray(vis).sum())
+        self._rows_inserted += n_valid
+        if self._rows_inserted > ht.MAX_LOAD * self.key_capacity:
+            raise RuntimeError(
+                f"sharded join side over capacity: {self._rows_inserted}"
+                f" rows vs {self.key_capacity} key slots/shard — raise "
+                "key_capacity (growth TBD)")
+        if len(refs) and int(np.max(refs)) >= self.row_capacity:
+            raise RuntimeError(
+                f"row ref {int(np.max(refs))} >= row_capacity "
+                f"{self.row_capacity} — raise row_capacity (growth TBD)")
+        bucket = n // self.n_dev
+        key = (n, bucket)
+        if key not in self._insert_cache:
+            self._insert_cache[key] = self._build_insert(n, bucket)
+        step = self._insert_cache[key]
+        self.table, self.chains, overflow = step(
+            self.table, self.chains, jnp.asarray(key_lanes),
+            jnp.asarray(refs.astype(np.int32)), jnp.asarray(vis),
+            self.owner_map)
+        assert not bool(np.asarray(overflow).any()), "bucket overflow"
+
+    def probe(self, key_lanes: np.ndarray, vis: np.ndarray
+              ) -> Tuple[np.ndarray, np.ndarray]:
+        """(probe global row ids, matched refs) across all shards.
+        Doubles the per-shard pair buffer and retries on overflow."""
+        n = key_lanes.shape[0]
+        assert n % self.n_dev == 0, (n, self.n_dev)
+        bucket = n // self.n_dev
+        row_ids = np.arange(n, dtype=np.int32)
+        while True:
+            key = (n, bucket, self.probe_capacity)
+            if key not in self._probe_cache:
+                self._probe_cache[key] = self._build_probe(
+                    n, bucket, self.probe_capacity)
+            step = self._probe_cache[key]
+            mats, overflow = step(self.table, self.chains,
+                                  jnp.asarray(key_lanes),
+                                  jnp.asarray(row_ids), jnp.asarray(vis),
+                                  self.owner_map)
+            assert not bool(np.asarray(overflow).any()), "bucket overflow"
+            mats = np.asarray(mats)      # [n_dev, 1 + out_cap, 2]
+            worst = int(mats[:, 0, 0].max())
+            if worst <= self.probe_capacity:
+                break
+            while self.probe_capacity < worst:
+                self.probe_capacity *= 2
+        probes, refs = [], []
+        for d in range(self.n_dev):
+            total = int(mats[d, 0, 0])
+            pairs = mats[d, 1:1 + total]
+            probes.append(pairs[:, 0])
+            refs.append(pairs[:, 1])
+        return (np.concatenate(probes) if probes else
+                np.zeros(0, np.int32),
+                np.concatenate(refs) if refs else np.zeros(0, np.int32))

@@ -337,15 +337,20 @@ class Sst:
                 hi = mid - 1
         return ans
 
-    def iter_from(self, start_fk: bytes
+    def iter_from(self, start_fk: bytes, lazy: bool = False
                   ) -> Iterator[Tuple[bytes, bool, bytes]]:
-        """(full_key, tombstone, row_bytes) in order, from start_fk."""
+        """(full_key, tombstone, row_bytes) in order, from start_fk.
+
+        lazy=True decodes entry-by-entry in Python — right for point
+        gets that stop after one hit; the default native whole-block
+        decode wins for scans that consume most of the block."""
         if not self.index:
             return
+        decode = _iter_block_py if lazy else iter_block
         bi = self._block_range(start_fk)
         for i in range(bi, len(self.index)):
             _first, off, ln = self.index[i]
-            for fk, value in iter_block(self.data[off:off + ln]):
+            for fk, value in decode(self.data[off:off + ln]):
                 if fk < start_fk:
                     continue
                 yield fk, value[0] == 1, value[1:]
@@ -357,7 +362,7 @@ class Sst:
             return None
         start = full_key(table_id, user_key, epoch)   # epoch desc order
         prefix = start[:-8]
-        for fk, tomb, row in self.iter_from(start):
+        for fk, tomb, row in self.iter_from(start, lazy=True):
             if fk[:-8] != prefix:
                 return None
             return (True, tomb, row)
