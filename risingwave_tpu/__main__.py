@@ -1,0 +1,65 @@
+"""CLI: `python -m risingwave_tpu` — the unified-binary analog.
+
+Reference parity: src/cmd_all/src/bin/risingwave.rs playground /
+standalone modes — one process hosting frontend (pgwire), meta (barrier
+loop + catalog/DDL log) and compute (actors + device kernels), with
+hummock-on-local-FS persistence when --data-dir is given.
+
+    python -m risingwave_tpu playground                # in-memory
+    python -m risingwave_tpu serve --data-dir ./rwdata # durable
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+
+async def _serve(args) -> None:
+    from risingwave_tpu.frontend import Frontend
+    from risingwave_tpu.frontend.pgwire import PgServer
+
+    if args.data_dir:
+        from risingwave_tpu.storage.hummock import HummockLite
+        from risingwave_tpu.storage.object_store import LocalFsObjectStore
+        store = HummockLite(LocalFsObjectStore(args.data_dir))
+    else:
+        from risingwave_tpu.state.store import MemoryStateStore
+        store = MemoryStateStore()
+    fe = Frontend(store)
+    replayed = await fe.recover()
+    if replayed:
+        print(f"recovered {replayed} DDL statements", file=sys.stderr)
+    srv = PgServer(fe)
+    await srv.serve(args.host, args.port)
+    print(f"listening on {args.host}:{srv.port} "
+          f"(psql -h {args.host} -p {srv.port})", file=sys.stderr)
+    hb = asyncio.ensure_future(fe.run_heartbeat())
+    try:
+        # serve until the heartbeat dies — a failed heartbeat means
+        # checkpoints stopped; better to crash than serve stale MVs
+        await asyncio.wait({hb}, return_when=asyncio.FIRST_COMPLETED)
+        hb.result()
+    finally:
+        hb.cancel()
+        await srv.close()
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="risingwave_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    for name in ("playground", "serve"):
+        sp = sub.add_parser(name)
+        sp.add_argument("--host", default="127.0.0.1")
+        sp.add_argument("--port", type=int, default=4566)
+        if name == "serve":               # playground is in-memory only
+            sp.add_argument("--data-dir", required=True)
+    args = p.parse_args(argv)
+    if not hasattr(args, "data_dir"):
+        args.data_dir = None
+    asyncio.run(_serve(args))
+
+
+if __name__ == "__main__":
+    main()

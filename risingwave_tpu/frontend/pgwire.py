@@ -1,0 +1,185 @@
+"""pgwire: PostgreSQL wire-protocol (v3) server over asyncio.
+
+Reference parity: src/utils/pgwire/src/{pg_protocol.rs,pg_server.rs}
+— the simple-query protocol surface a psql client needs: startup
+handshake (SSL probe declined, AuthenticationOk, ParameterStatus,
+ReadyForQuery), 'Q' simple queries answered with RowDescription /
+DataRow / CommandComplete, errors as ErrorResponse, 'X' terminate.
+Extended protocol (parse/bind/execute) is declined politely. All
+values ship in text format (what psql uses).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import List, Optional, Tuple
+
+from risingwave_tpu.common.types import DataType, Schema
+from risingwave_tpu.frontend.session import Frontend
+
+_OID = {
+    DataType.BOOLEAN: 16,
+    DataType.INT16: 21, DataType.INT32: 23, DataType.INT64: 20,
+    DataType.SERIAL: 20,
+    DataType.FLOAT32: 700, DataType.FLOAT64: 701,
+    DataType.DECIMAL: 1700,
+    DataType.VARCHAR: 25,
+    DataType.DATE: 1082, DataType.TIME: 1083,
+    DataType.TIMESTAMP: 1114, DataType.TIMESTAMPTZ: 1184,
+    DataType.INTERVAL: 1186, DataType.BYTEA: 17, DataType.JSONB: 3802,
+}
+
+SSL_REQUEST = 80877103
+CANCEL_REQUEST = 80877102
+PROTOCOL_V3 = 196608
+
+
+def _msg(tag: bytes, payload: bytes) -> bytes:
+    return tag + struct.pack(">I", len(payload) + 4) + payload
+
+
+def _cstr(s: str) -> bytes:
+    return s.encode() + b"\x00"
+
+
+class PgServer:
+    """Serves one Frontend session per connection's statements.
+
+    All connections share the session's catalog and barrier loop (the
+    reference shares via meta; we share in-process)."""
+
+    def __init__(self, frontend: Frontend):
+        self.frontend = frontend
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def serve(self, host: str = "127.0.0.1", port: int = 4566):
+        self._server = await asyncio.start_server(
+            self._handle, host, port)
+        return self._server
+
+    @property
+    def port(self) -> int:
+        return self._server.sockets[0].getsockname()[1]
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # -- connection loop --------------------------------------------------
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            if not await self._startup(reader, writer):
+                return
+            while True:
+                hdr = await reader.readexactly(5)
+                tag = hdr[0:1]
+                ln = struct.unpack(">I", hdr[1:5])[0]
+                payload = await reader.readexactly(ln - 4)
+                if tag == b"X":
+                    return
+                if tag == b"Q":
+                    sql = payload.rstrip(b"\x00").decode()
+                    await self._simple_query(writer, sql)
+                else:
+                    writer.write(_error(
+                        f"unsupported message {tag!r} (extended "
+                        "protocol not implemented)"))
+                    writer.write(_ready())
+                    await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    async def _startup(self, reader, writer) -> bool:
+        while True:
+            ln, code = struct.unpack(
+                ">II", await reader.readexactly(8))
+            if code == SSL_REQUEST:
+                writer.write(b"N")            # no TLS
+                await writer.drain()
+                continue
+            if code == CANCEL_REQUEST:
+                return False
+            if code != PROTOCOL_V3:
+                writer.write(_error(f"unsupported protocol {code}"))
+                await writer.drain()
+                return False
+            await reader.readexactly(ln - 8)  # user/database params
+            break
+        out = _msg(b"R", struct.pack(">I", 0))       # AuthenticationOk
+        for k, v in (("server_version", "13.0 (risingwave-tpu)"),
+                     ("client_encoding", "UTF8"),
+                     ("server_encoding", "UTF8"),
+                     ("DateStyle", "ISO")):
+            out += _msg(b"S", _cstr(k) + _cstr(v))
+        out += _msg(b"K", struct.pack(">II", 0, 0))  # BackendKeyData
+        out += _ready()
+        writer.write(out)
+        await writer.drain()
+        return True
+
+    async def _simple_query(self, writer, sql: str) -> None:
+        try:
+            result = await self.frontend.execute(sql)
+            schema = getattr(self.frontend, "last_select_schema", None)
+        except (Exception,) as e:                    # noqa: BLE001
+            writer.write(_error(str(e)))
+            writer.write(_ready())
+            await writer.drain()
+            return
+        if isinstance(result, str):                  # DDL/command
+            writer.write(_msg(b"C", _cstr(result.replace("_", " "))))
+        else:
+            writer.write(_row_description(result, schema))
+            for row in result:
+                writer.write(_data_row(row))
+            writer.write(_msg(b"C", _cstr(f"SELECT {len(result)}")))
+        writer.write(_ready())
+        await writer.drain()
+
+
+def _ready() -> bytes:
+    return _msg(b"Z", b"I")
+
+
+def _error(message: str) -> bytes:
+    fields = b"SERROR\x00" + b"CXX000\x00" + b"M" + _cstr(message) + b"\x00"
+    return _msg(b"E", fields)
+
+
+def _row_description(rows: List[tuple],
+                     schema: Optional[Schema]) -> bytes:
+    if schema is not None:
+        cols: List[Tuple[str, int]] = [
+            (f.name, _OID.get(f.data_type, 25)) for f in schema]
+    else:
+        width = len(rows[0]) if rows else 0
+        cols = [(f"col{i}", 25) for i in range(width)]
+    payload = struct.pack(">H", len(cols))
+    for name, oid in cols:
+        payload += _cstr(name) + struct.pack(
+            ">IHIhih", 0, 0, oid, -1, -1, 0)
+    return _msg(b"T", payload)
+
+
+def _data_row(row: tuple) -> bytes:
+    payload = struct.pack(">H", len(row))
+    for v in row:
+        if v is None:
+            payload += struct.pack(">i", -1)
+        else:
+            b = _pg_text(v).encode()
+            payload += struct.pack(">I", len(b)) + b
+    return _msg(b"D", payload)
+
+
+def _pg_text(v) -> str:
+    if v is True:
+        return "t"
+    if v is False:
+        return "f"
+    return str(v)

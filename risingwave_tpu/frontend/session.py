@@ -52,6 +52,9 @@ class Frontend:
         self._next_actor = 1000
         self._ddl_log: List[str] = []
         self._replaying = False
+        # serializes barrier rounds between DDL handlers, step() and the
+        # background heartbeat (inject_and_collect is not reentrant)
+        self._barrier_lock = asyncio.Lock()
 
     # -- DDL-log durability (MetaStore analog) ---------------------------
     @property
@@ -104,26 +107,54 @@ class Frontend:
         return asyncio.get_event_loop().run_until_complete(
             self.execute(sql))
 
+    async def _barrier(self, **kw):
+        """One serialized barrier round — the ONLY way any session code
+        may call inject_and_collect (the lock also guards actor-topology
+        mutations; see _create_mv/_drop_mv)."""
+        async with self._barrier_lock:
+            return await self.loop.inject_and_collect(**kw)
+
     async def step(self, n: int = 1) -> None:
         """Drive n checkpoint barriers (deterministic test/bench mode)."""
         for _ in range(n):
-            await self.loop.inject_and_collect(force_checkpoint=True)
+            await self._barrier(force_checkpoint=True)
+
+    async def run_heartbeat(self, interval_s: float = 0.25) -> None:
+        """Background barrier heartbeat for server deployments
+        (GlobalBarrierManager::run analog; serialized with DDL). A
+        failure is loud: it propagates out of this task — the server
+        entry point watches it and dies rather than serving a cluster
+        whose checkpoints silently stopped."""
+        import sys
+        import traceback
+        try:
+            while True:
+                await asyncio.sleep(interval_s)
+                await self._barrier()
+        except asyncio.CancelledError:
+            pass
+        except BaseException:
+            print("barrier heartbeat failed:", file=sys.stderr)
+            traceback.print_exc()
+            raise
 
     async def close(self) -> None:
         if self.actors:
-            stop_ids = set(self.actors)
-            for readers in self.readers.values():
-                stop_ids |= set(readers)
-            await self.loop.inject_and_collect(
-                mutation=StopMutation(frozenset(stop_ids)))
-            for t in self.tasks.values():
-                await t
+            async with self._barrier_lock:
+                stop_ids = set(self.actors)
+                for readers in self.readers.values():
+                    stop_ids |= set(readers)
+                await self.loop.inject_and_collect(
+                    mutation=StopMutation(frozenset(stop_ids)))
+                for t in self.tasks.values():
+                    await t
         for aid, a in self.actors.items():
             if a.failure is not None:
                 raise a.failure
 
     # -- dispatch ---------------------------------------------------------
     async def _run(self, stmt) -> Union[Rows, str]:
+        self.last_select_schema = None
         if isinstance(stmt, ast.CreateSource):
             schema = source_schema(stmt.options)
             self.catalog.add_source(stmt.name, schema, stmt.options)
@@ -148,7 +179,7 @@ class Frontend:
                 return [(n,) for n in sorted(self.catalog.sources)]
             return [(n,) for n in sorted(self.catalog.mvs)]
         if isinstance(stmt, ast.Flush):
-            await self.loop.inject_and_collect(force_checkpoint=True)
+            await self._barrier(force_checkpoint=True)
             return "FLUSH"
         if isinstance(stmt, ast.Select):
             return await self._select(stmt)
@@ -156,22 +187,27 @@ class Frontend:
 
     # -- handlers ---------------------------------------------------------
     async def _create_mv(self, stmt: ast.CreateMaterializedView) -> str:
-        planner = StreamPlanner(self.catalog, self.store, self.local,
-                                definition="")
-        actor_id = self._next_actor
-        self._next_actor += 1
-        plan = planner.plan(stmt.name, stmt.select, actor_id,
-                            rate_limit=self.rate_limit,
-                            min_chunks=self.min_chunks)
-        self.catalog.add_mv(plan.mv)
-        actor = Actor(actor_id, plan.consumer, dispatchers=[],
-                      barrier_manager=self.local)
-        self.actors[actor_id] = actor
-        self.readers[stmt.name] = plan.readers
-        self.local.set_expected_actors(list(self.actors))
-        self.tasks[actor_id] = actor.spawn()
-        # activation barrier (Command::CreateStreamingJob analog)
-        await self.loop.inject_and_collect(force_checkpoint=True)
+        # topology mutations (sender registration in plan(), expected-
+        # actor set, spawn) MUST happen under the barrier lock: a
+        # concurrent heartbeat epoch dispatched to the old topology but
+        # collected against the new one would never complete
+        async with self._barrier_lock:
+            planner = StreamPlanner(self.catalog, self.store, self.local,
+                                    definition="")
+            actor_id = self._next_actor
+            self._next_actor += 1
+            plan = planner.plan(stmt.name, stmt.select, actor_id,
+                                rate_limit=self.rate_limit,
+                                min_chunks=self.min_chunks)
+            self.catalog.add_mv(plan.mv)
+            actor = Actor(actor_id, plan.consumer, dispatchers=[],
+                          barrier_manager=self.local)
+            self.actors[actor_id] = actor
+            self.readers[stmt.name] = plan.readers
+            self.local.set_expected_actors(list(self.actors))
+            self.tasks[actor_id] = actor.spawn()
+            # activation barrier (Command::CreateStreamingJob analog)
+            await self.loop.inject_and_collect(force_checkpoint=True)
         if actor.failure is not None:
             raise actor.failure
         return "CREATE_MATERIALIZED_VIEW"
@@ -182,19 +218,22 @@ class Frontend:
             if stmt.if_exists:
                 return "DROP_MATERIALIZED_VIEW"
             raise PlanError(f"unknown materialized view {stmt.name!r}")
-        # stop barrier addressed at this MV's sources + actor
-        stop_ids = frozenset(self.readers.get(stmt.name, {}).keys()
-                             | {mv.actor_id})
-        await self.loop.inject_and_collect(
-            mutation=StopMutation(stop_ids))
-        task = self.tasks.pop(mv.actor_id, None)
-        if task is not None:
-            await task
-        actor = self.actors.pop(mv.actor_id, None)
-        for sid in self.readers.pop(stmt.name, {}):
-            self.local.drop_actor(sid)
-        self.local.drop_actor(mv.actor_id)
-        self.local.set_expected_actors(list(self.actors))
+        # stop barrier + topology removal as ONE locked unit — a
+        # heartbeat barrier between them would still expect the
+        # stopped actor and hang
+        async with self._barrier_lock:
+            stop_ids = frozenset(self.readers.get(stmt.name, {}).keys()
+                                 | {mv.actor_id})
+            await self.loop.inject_and_collect(
+                mutation=StopMutation(stop_ids))
+            task = self.tasks.pop(mv.actor_id, None)
+            if task is not None:
+                await task
+            actor = self.actors.pop(mv.actor_id, None)
+            for sid in self.readers.pop(stmt.name, {}):
+                self.local.drop_actor(sid)
+            self.local.drop_actor(mv.actor_id)
+            self.local.set_expected_actors(list(self.actors))
         del self.catalog.mvs[stmt.name]
         if actor is not None and actor.failure is not None:
             raise actor.failure
@@ -204,4 +243,7 @@ class Frontend:
         from risingwave_tpu.batch import collect
         epoch = self.store.committed_epoch()
         ex = plan_batch(sel, self.catalog, self.store, epoch)
+        # one plan serves both rows and result typing (pgwire reads
+        # this right after execute instead of re-planning)
+        self.last_select_schema = ex.schema
         return collect(ex)

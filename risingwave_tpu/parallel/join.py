@@ -70,7 +70,7 @@ class ShardedJoinSide:
             live=stack(jnp.zeros(row_capacity, dtype=bool)))
         self._insert_cache: Dict[Tuple[int, int], object] = {}
         self._probe_cache: Dict[Tuple[int, int, int], object] = {}
-        self._rows_inserted = 0
+        self._keys_upper = 0      # distinct-key upper bound (host)
 
     # -- SPMD steps -------------------------------------------------------
     def _build_insert(self, n: int, bucket: int):
@@ -145,13 +145,15 @@ class ShardedJoinSide:
         # would make probe_insert link rows under wrong keys, and a
         # ref >= row_capacity would be silently dropped by the chain
         # scatter — both must fail loudly until growth lands here.
-        n_valid = int(np.asarray(vis).sum())
-        self._rows_inserted += n_valid
-        if self._rows_inserted > ht.MAX_LOAD * self.key_capacity:
+        # key-table occupancy grows with DISTINCT keys (duplicates
+        # chain in the row arena); bound it by the batch's unique keys
+        kv = np.asarray(key_lanes)[np.asarray(vis)]
+        self._keys_upper += len(np.unique(kv, axis=0)) if len(kv) else 0
+        if self._keys_upper > ht.MAX_LOAD * self.key_capacity:
             raise RuntimeError(
-                f"sharded join side over capacity: {self._rows_inserted}"
-                f" rows vs {self.key_capacity} key slots/shard — raise "
-                "key_capacity (growth TBD)")
+                f"sharded join side over capacity: ~{self._keys_upper}"
+                f" distinct keys vs {self.key_capacity} key slots/shard"
+                " — raise key_capacity (growth TBD)")
         if len(refs) and int(np.max(refs)) >= self.row_capacity:
             raise RuntimeError(
                 f"row ref {int(np.max(refs))} >= row_capacity "

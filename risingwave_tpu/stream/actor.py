@@ -96,11 +96,13 @@ class LocalBarrierManager:
     async def send_barrier(self, barrier: Barrier) -> None:
         epoch = barrier.epoch.curr.value
         self._collected.setdefault(epoch, set())
-        self._complete.setdefault(epoch, asyncio.Event())
+        ev = self._complete.setdefault(epoch, asyncio.Event())
         self._barriers[epoch] = barrier
         for senders in self._barrier_senders.values():
             for s in senders:
                 await s.send(barrier)
+        if not self._expected_actors:
+            ev.set()        # zero actors: the epoch completes trivially
 
     def collect(self, actor_id: int, barrier: Barrier) -> None:
         epoch = barrier.epoch.curr.value
