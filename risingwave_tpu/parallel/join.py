@@ -146,14 +146,23 @@ class ShardedJoinSide:
         # ref >= row_capacity would be silently dropped by the chain
         # scatter — both must fail loudly until growth lands here.
         # key-table occupancy grows with DISTINCT keys (duplicates
-        # chain in the row arena); bound it by the batch's unique keys
+        # chain in the row arena). The host tracks an UPPER BOUND
+        # (per-batch unique keys, which over-counts keys recurring
+        # across batches); when the bound crosses the load limit it is
+        # collapsed to the true worst-shard occupancy with one device
+        # sync — same scheme as GroupedAggKernel._reserve.
         kv = np.asarray(key_lanes)[np.asarray(vis)]
         self._keys_upper += len(np.unique(kv, axis=0)) if len(kv) else 0
-        if self._keys_upper > ht.MAX_LOAD * self.key_capacity:
-            raise RuntimeError(
-                f"sharded join side over capacity: ~{self._keys_upper}"
-                f" distinct keys vs {self.key_capacity} key slots/shard"
-                " — raise key_capacity (growth TBD)")
+        limit = ht.MAX_LOAD * self.key_capacity
+        if self._keys_upper > limit:
+            per_shard = np.asarray(jnp.sum(self.table.occ, axis=1))
+            self._keys_upper = int(per_shard.max())
+            if self._keys_upper + len(kv) > limit:
+                raise RuntimeError(
+                    f"sharded join side over capacity: "
+                    f"{self._keys_upper} keys on the fullest shard vs "
+                    f"{self.key_capacity} slots — raise key_capacity "
+                    "(growth TBD)")
         if len(refs) and int(np.max(refs)) >= self.row_capacity:
             raise RuntimeError(
                 f"row ref {int(np.max(refs))} >= row_capacity "

@@ -56,3 +56,21 @@ def test_sharded_join_state_is_sharded(eight_devices):
     specs = {str(a.sharding.spec) for a in
              [s.table.keys, s.chains.head, s.chains.next]}
     assert all("'d'" in x for x in specs), specs
+
+
+def test_sharded_join_recurring_keys_do_not_trip_guard(eight_devices):
+    """Keys recurring across many batches must NOT hit the capacity
+    guard: the bound collapses to true occupancy on overflow."""
+    mesh = Mesh(np.asarray(eight_devices), ("d",))
+    s = ShardedJoinSide(mesh, key_width=2, key_capacity=256,
+                        row_capacity=1 << 14)
+    ref = 0
+    for _ in range(40):                  # 40*64 rows, only 10 keys
+        keys = (np.arange(64, dtype=np.int64) % 10) * 999_999_937
+        hi, lo = lanes.split_i64(keys)
+        kl = np.stack([hi, lo], axis=1)
+        refs = np.arange(ref, ref + 64, dtype=np.int32)
+        ref += 64
+        s.insert(kl, refs, np.ones(64, dtype=bool))
+    gp, _gr = s.probe(kl, np.ones(64, dtype=bool))
+    assert len(gp) > 0

@@ -1,0 +1,123 @@
+"""Remote exchange over a real TCP socket: serde round-trips, delivery
+order, barrier/stop semantics, and credit backpressure."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.common.chunk import Op, StreamChunk
+from risingwave_tpu.common.epoch import Epoch, EpochPair
+from risingwave_tpu.common.types import DataType, Schema
+from risingwave_tpu.stream.message import (
+    Barrier, BarrierKind, StopMutation, Watermark, is_barrier, is_chunk,
+)
+from risingwave_tpu.stream.remote import (
+    ExchangeServer, RemoteInput, decode_chunk, encode_chunk,
+)
+
+SCH = Schema.of(k=DataType.INT64, s=DataType.VARCHAR, f=DataType.FLOAT64)
+
+
+def _chunk(ks, ss, fs, ops=None):
+    return StreamChunk.from_pydict(
+        SCH, {"k": ks, "s": ss, "f": fs}, ops=ops)
+
+
+def _barrier(n, mutation=None):
+    prev = Epoch.from_physical(n - 1) if n > 1 else Epoch.INVALID
+    return Barrier(EpochPair(Epoch.from_physical(n), prev),
+                   BarrierKind.CHECKPOINT, mutation)
+
+
+def test_chunk_serde_roundtrip():
+    c = _chunk([1, 2, 3], ["a", None, "ccc"], [1.5, 2.5, None],
+               ops=[1, 2, 1])
+    d = decode_chunk(encode_chunk(c), SCH)
+    assert d.to_records() == c.to_records()
+    assert np.array_equal(np.asarray(d.ops), np.asarray(c.ops))
+
+
+def test_remote_edge_end_to_end_with_stop():
+    async def run():
+        srv = ExchangeServer()
+        await srv.serve()
+        out = srv.register_edge(up=7, down=9)
+        rin = RemoteInput("127.0.0.1", srv.port, 7, 9, SCH)
+
+        async def producer():
+            await out.send(_barrier(1))
+            await out.send(_chunk([1, 2], ["x", "y"], [0.5, 1.5]))
+            await out.send(Watermark(0, DataType.INT64, 42))
+            await out.send(_chunk([3], ["z"], [2.5]))
+            await out.send(_barrier(2, StopMutation(frozenset({9}))))
+
+        prod = asyncio.ensure_future(producer())
+        msgs = [m async for m in rin.execute()]
+        await prod
+        await srv.close()
+        return msgs
+
+    msgs = asyncio.run(run())
+    kinds = [type(m).__name__ for m in msgs]
+    assert kinds == ["Barrier", "StreamChunk", "Watermark", "StreamChunk",
+                     "Barrier"]
+    assert msgs[1].to_records()[0][1][:2] == (1, "x")
+    assert msgs[2].value == 42
+    assert msgs[-1].is_stop(9)
+
+
+def test_credit_backpressure_blocks_sender():
+    async def run():
+        srv = ExchangeServer()
+        await srv.serve()
+        out = srv.register_edge(up=1, down=2)
+        # tiny credit window, consumer grants one credit per chunk
+        rin = RemoteInput("127.0.0.1", srv.port, 1, 2, SCH,
+                          initial_credits=2, credit_batch=1)
+        sent = []
+
+        async def producer():
+            for i in range(6):
+                await out.send(_chunk([i], ["v"], [0.0]))
+                sent.append(i)
+            await out.send(_barrier(1, StopMutation(frozenset({2}))))
+
+        prod = asyncio.ensure_future(producer())
+        await asyncio.sleep(0.1)
+        # consumer hasn't started: sender must be stuck at the window
+        assert len(sent) <= 3          # 2 credits + 1 queued in-flight
+        got = []
+        async for m in rin.execute():
+            if is_chunk(m):
+                got.append(m.to_records()[0][1][0])
+                await asyncio.sleep(0)
+        await prod
+        await srv.close()
+        return got
+
+    got = asyncio.run(run())
+    assert got == [0, 1, 2, 3, 4, 5]
+
+
+def test_peer_disconnect_fails_sender_loudly():
+    """A crashed downstream must error blocked senders, not wedge them
+    (a silent stall would hang barrier collection cluster-wide)."""
+    async def run():
+        srv = ExchangeServer()
+        await srv.serve()
+        out = srv.register_edge(up=1, down=2)
+        rin = RemoteInput("127.0.0.1", srv.port, 1, 2, SCH,
+                          initial_credits=1, credit_batch=1)
+        agen = rin.execute()
+        first = asyncio.ensure_future(agen.__anext__())  # connects
+        await out.send(_chunk([1], ["a"], [0.1]))
+        await asyncio.wait_for(first, 5)
+        await agen.aclose()              # peer "crashes"
+        with pytest.raises(ConnectionError):
+            for _ in range(10):          # credits are gone: must raise
+                await asyncio.wait_for(
+                    out.send(_chunk([2], ["b"], [0.2])), 5)
+        await srv.close()
+
+    asyncio.run(run())
