@@ -16,16 +16,19 @@ A from-scratch re-design of the capabilities of RisingWave (reference:
 Layering (mirrors SURVEY.md section 1):
 
     common/      foundation: types, arrays, chunks, hashing, epochs, config
-    ops/         jit + pallas device kernels (vnode hash, hash tables, aggs)
-    state/       state store + relational StateTable (epoch MVCC)
-    stream/      executors, actors, barrier manager, exchange
-    parallel/    device mesh, shardings, collective dispatch
-    storage/     hummock-lite LSM over object store
-    frontend/    SQL parser -> binder -> planner -> fragmenter
-    meta/        catalog, DDL, global barrier manager, recovery, scaling
-    connectors/  sources (nexmark, datagen, kafka-shaped) and sinks
-    models/      pre-built flagship pipelines (nexmark q1/q7/q8, tpch)
-    utils/       logging, metrics, misc
+    ops/         jit device kernels (hash tables, grouped agg, join match)
+    state/       state-store interface + relational StateTable (epoch MVCC)
+    stream/      executors, actors, barriers, local + remote exchange
+    parallel/    device-mesh SPMD: all_to_all dispatch, sharded agg/join,
+                 elastic resharding
+    storage/     hummock-lite LSM over object storage (SSTs, compaction)
+    batch/       snapshot scans + batch executor tree (SELECT serving)
+    frontend/    SQL parser -> binder -> planner; session; pgwire server
+    meta/        barrier/checkpoint loop (epoch issue, collect, commit)
+    connectors/  sources: nexmark, datagen (replayable, vectorized)
+    models/      pre-built flagship pipelines (nexmark q1/q7/q8)
+    native/      C++ runtime kernels (SST block codec, bloom) + loader
+    utils/       metrics, tracing, JAX runtime knobs
 """
 
 import jax

@@ -152,6 +152,8 @@ class ShardedAggKernel:
         flat: List[jnp.ndarray] = []
         for in_lanes, valid in inputs:
             flat.extend(jnp.asarray(a) for a in in_lanes)
+            if valid is None:            # count(*) — same API as the
+                valid = np.ones(n, dtype=bool)   # single-chip kernel
             flat.append(jnp.asarray(valid))
         # each shard holds n/n_dev local rows, so no owner can receive
         # more than that: bucket = n/n_dev is overflow-free by
@@ -166,6 +168,78 @@ class ShardedAggKernel:
             jnp.asarray(vis), tuple(flat), self.owner_map)
         assert not bool(np.asarray(overflow).any()), \
             "bucket overflow: raise `bucket` (host retry path TBD)"
+
+    # -- elastic resharding (scale.rs:174 / Mutation::Update analog) ------
+    def reshard(self, new_owner_map: np.ndarray) -> None:
+        """Move device state to a new vnode→shard mapping at a barrier.
+
+        The reference reschedules by swapping vnode bitmaps and lazily
+        reloading state from Hummock (state_table.rs:650); the TPU-
+        native equivalent moves the HBM-resident groups directly: one
+        SPMD step routes every live slot's (key, counters, accs,
+        emitted snapshot) to its new owner via the bucketized
+        all_to_all, then rebuilds each shard's table with the same
+        probe-insert kernel. No host round-trip for the state itself.
+        """
+        new_map = jnp.asarray(np.asarray(new_owner_map, dtype=np.int32))
+        n_dev = self.n_dev
+        cap = self.capacity
+        specs = self.specs
+        key_width = self.key_width
+
+        def local(state: AggState, owner_map):
+            state = jax.tree.map(lambda a: a[0], state)
+            live = state.table.occ & ((state.group_rows != 0)
+                                      | state.dirty | state.emitted_valid)
+            owner = owner_map[vnodes_from_lanes(state.table.keys)]
+            payloads = [state.table.keys, state.group_rows,
+                        state.dirty.astype(jnp.int32),
+                        state.emitted_valid.astype(jnp.int32),
+                        state.emitted_rows,
+                        *state.accs, *state.emitted_accs]
+            # bucket = cap: a shard can never receive more rows than
+            # fit in one table, so routing is overflow-free
+            buckets, bvalid, _overflow = bucketize_by_owner(
+                owner, live, payloads, n_dev, cap)
+            recv, rvalid = exchange(buckets, bvalid, AXIS)
+            m = n_dev * cap
+            rvis = rvalid.reshape(m)
+            rkeys = recv[0].reshape(m, key_width)
+            fresh = make_agg_state(cap, key_width, specs)
+            table, slots, _ins = ht.probe_insert(fresh.table, rkeys,
+                                                 rvis)
+            scat = jnp.where(rvis, slots, cap)
+
+            def put(dst, src, cast=None):
+                v = src.reshape(m)
+                if cast is not None:
+                    v = v.astype(cast)
+                return dst.at[scat].set(v, mode="drop")
+
+            na = len(state.accs)
+            new = AggState(
+                table=table,
+                group_rows=put(fresh.group_rows, recv[1]),
+                dirty=put(fresh.dirty, recv[2], jnp.bool_),
+                accs=tuple(put(f, r) for f, r in
+                           zip(fresh.accs, recv[5:5 + na])),
+                emitted_valid=put(fresh.emitted_valid, recv[3],
+                                  jnp.bool_),
+                emitted_rows=put(fresh.emitted_rows, recv[4]),
+                emitted_accs=tuple(put(f, r) for f, r in
+                                   zip(fresh.emitted_accs,
+                                       recv[5 + na:])),
+            )
+            return jax.tree.map(lambda a: a[None], new)
+
+        state_spec = jax.tree.map(lambda _: P(AXIS), self.state)
+        mapped = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(state_spec, P()), out_specs=state_spec,
+            check_vma=False)
+        step = jax.jit(mapped, donate_argnums=(0,))
+        self.state = step(self.state, new_map)
+        self.owner_map = new_map   # apply steps take it as a runtime arg
 
     # -- host-side full decode (tests + dryrun assertions) ---------------
     def snapshot(self) -> Dict[tuple, tuple]:
