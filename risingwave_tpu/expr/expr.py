@@ -364,6 +364,25 @@ class UnaryOp(Expression):
         return f"{self.op}({self.child!r})"
 
 
+class Cast(Expression):
+    """Explicit type conversion (expr_cast analog; physical-domain
+    aware: DECIMAL scaled-int64 → float divides out the scale)."""
+
+    def __init__(self, child: Expression, to: DataType):
+        self.child = child
+        self.return_type = to
+
+    def eval(self, chunk: DataChunk) -> Column:
+        c = self.child.eval(chunk)
+        if c.data_type == self.return_type:
+            return c
+        vals = _cast_values(c.values, c.data_type, self.return_type)
+        return Column(self.return_type, vals, c.validity)
+
+    def __repr__(self):
+        return f"cast({self.child!r} as {self.return_type.value})"
+
+
 # ---------------------------------------------------------------------------
 # function registry (sig/ analog, without the proc-macro machinery)
 

@@ -13,8 +13,8 @@ from typing import Dict, List, Optional, Tuple
 
 from risingwave_tpu.common.types import DataType, Field, Interval, Schema
 from risingwave_tpu.expr.expr import (
-    BinaryOp, Case, Expression, FuncCall, InputRef, Literal, UnaryOp, lit,
-    tumble_end, tumble_start,
+    BinaryOp, Case, Expression, FuncCall, InputRef, Literal,
+    UnaryOp, lit, tumble_end, tumble_start,
 )
 from risingwave_tpu.frontend import ast
 from risingwave_tpu.ops.hash_agg import AggKind
@@ -70,6 +70,12 @@ class Binder:
         # bound agg call → position (dedup: COUNT(*) used twice = one)
         self._agg_index: Dict[Tuple, int] = {}
 
+    def _register(self, call: AggCall, key: Tuple) -> int:
+        if key not in self._agg_index:
+            self._agg_index[key] = len(self.agg_calls)
+            self.agg_calls.append(call)
+        return self._agg_index[key]
+
     # returns (Expression | ("agg", index), ...)
     def bind(self, e: ast.Expr) -> Expression:
         out = self._bind(e)
@@ -101,6 +107,21 @@ class Binder:
 
     def _bind_call(self, e: ast.Call):
         name = e.name
+        if name == "avg":
+            # AVG rewrites to SUM/COUNT at bind time (the reference's
+            # logical_agg does the same rewrite in the optimizer)
+            if not self.allow_aggs:
+                raise BindError("aggregate avg() not allowed here")
+            if e.star or not e.args:
+                raise BindError("avg(*) is not valid")
+            arg = self.bind(e.args[0])
+            if not isinstance(arg, InputRef):
+                raise BindError("avg(<expr>) needs a plain column")
+            sj = self._register(AggCall(AggKind.SUM, arg.index),
+                                ("sum", arg.index))
+            cj = self._register(AggCall(AggKind.COUNT, arg.index),
+                                ("count", arg.index))
+            return ("avg", sj, cj)
         if name in _AGG_KINDS:
             if not self.allow_aggs:
                 raise BindError(f"aggregate {name}() not allowed here")
@@ -117,10 +138,7 @@ class Binder:
                         "it first)")
                 call = AggCall(_AGG_KINDS[name], arg.index)
                 key = (name, arg.index)
-            if key not in self._agg_index:
-                self._agg_index[key] = len(self.agg_calls)
-                self.agg_calls.append(call)
-            return ("agg", self._agg_index[key])
+            return ("agg", self._register(call, key))
         if name in ("tumble_start", "tumble_end"):
             ts = self.bind(e.args[0])
             iv = e.args[1]

@@ -20,7 +20,9 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from risingwave_tpu.common.types import DataType, Field, Interval, Schema
-from risingwave_tpu.expr.expr import Expression, InputRef, tumble_start
+from risingwave_tpu.expr.expr import (
+    BinaryOp, Cast, Expression, InputRef, tumble_start,
+)
 from risingwave_tpu.frontend import ast
 from risingwave_tpu.frontend.binder import (
     BindError, Binder, Scope, expr_name,
@@ -151,9 +153,6 @@ class StreamPlanner:
     def plan(self, name: str, sel: ast.Select, actor_id: int,
              rate_limit: Optional[int] = 8,
              min_chunks: Optional[int] = None) -> StreamPlan:
-        if sel.order_by or sel.limit is not None:
-            raise PlanError("ORDER BY / LIMIT in an MV needs the TopN "
-                            "executor wiring (batch SELECT supports it)")
         if sel.from_item is None:
             raise PlanError("an MV needs a FROM clause")
         ex, scope, deps = self._base_chain(sel.from_item,
@@ -206,12 +205,43 @@ class StreamPlanner:
                 ex = RowIdGenExecutor(ProjectExecutor(ex, exprs, names))
                 pk = [len(exprs)]
                 names = names + ["_row_id"]
+        if sel.order_by or sel.limit is not None:
+            # agg outputs retract (updates); plain source/join chains of
+            # append-only sources do not — let TopN prune beyond-window
+            # state in that case (top_n_appendonly analog)
+            ex = self._plan_topn(ex, sel, pk,
+                                 append_only=not (binder.agg_calls
+                                                  or sel.group_by))
         mv_table = StateTable(self.catalog.next_id(), ex.schema, pk,
                               self.store)
         mat = MaterializeExecutor(ex, mv_table)
         mv = MvCatalog(name, mv_table.table_id, ex.schema, pk,
                        self.definition, actor_id, deps)
         return StreamPlan(mat, mv, self.readers)
+
+    def _plan_topn(self, ex: Executor, sel: ast.Select,
+                   pk: List[int], append_only: bool = False) -> Executor:
+        """ORDER BY [+ LIMIT/OFFSET] MV → streaming TopN (top_n_plain
+        analog): maintains the window incrementally, emitting deltas."""
+        from risingwave_tpu.stream.executors.top_n import (
+            GroupTopNExecutor,
+        )
+        post = Scope.of(ex.schema, None)
+        order = []
+        for e_ast, desc in sel.order_by:
+            b = Binder(post).bind(e_ast)
+            if not isinstance(b, InputRef):
+                raise PlanError(
+                    "MV ORDER BY must reference output columns")
+            order.append((b.index, desc))
+        if not order:
+            # LIMIT without ORDER BY: deterministic order by pk
+            order = [(i, False) for i in pk]
+        state = StateTable(self.catalog.next_id(), ex.schema, pk,
+                           self.store)
+        return GroupTopNExecutor(
+            ex, order, offset=sel.offset or 0, limit=sel.limit,
+            state=state, pk_indices=pk, append_only=append_only)
 
     def _plan_agg(self, ex: Executor, scope: Scope, sel: ast.Select,
                   binder: Binder, bound) -> Tuple[Executor, List]:
@@ -241,20 +271,33 @@ class StreamPlanner:
         agg = HashAggExecutor(pre, list(range(g)), calls, table,
                               append_only=True)
         # post-agg projection: map each SELECT item
-        out: List[Expression] = []
-        for b, (e, _a) in zip(bound, sel.projections):
-            if isinstance(b, tuple) and b[0] == "agg":
-                j = b[1]
-                out.append(InputRef(g + j, agg.schema[g + j].data_type))
-            else:
-                r = repr(b)
-                if r not in group_reprs:
-                    raise PlanError(
-                        f"projection {r} is neither grouped nor "
-                        "aggregated")
-                i = group_reprs.index(r)
-                out.append(InputRef(i, agg.schema[i].data_type))
+        out = [_map_agg_projection(b, g, agg.schema, group_reprs)
+               for b in bound]
         return agg, out
+
+
+def _map_agg_projection(b, g: int, agg_schema, group_reprs):
+    """Post-agg SELECT item → expression over the agg output row.
+
+    b is a bound projection: Expression (must match a group expr),
+    ("agg", j), or ("avg", sum_j, count_j) — avg divides in float64
+    (documented approximation of pg's numeric avg)."""
+    if isinstance(b, tuple) and b[0] == "agg":
+        j = b[1]
+        return InputRef(g + j, agg_schema[g + j].data_type)
+    if isinstance(b, tuple) and b[0] == "avg":
+        _tag, sj, cj = b
+        s = Cast(InputRef(g + sj, agg_schema[g + sj].data_type),
+                 DataType.FLOAT64)
+        c = Cast(InputRef(g + cj, agg_schema[g + cj].data_type),
+                 DataType.FLOAT64)
+        return BinaryOp("/", s, c)
+    r = repr(b)
+    if r not in group_reprs:
+        raise PlanError(
+            f"projection {r} is neither grouped nor aggregated")
+    i = group_reprs.index(r)
+    return InputRef(i, agg_schema[i].data_type)
 
 
 def _expand_star(projections, scope: Scope):
@@ -381,18 +424,8 @@ def plan_batch(sel: ast.Select, catalog: Catalog, store, epoch: int):
         pre = BatchProject(ex, pre_exprs)
         g = len(group_bound)
         agg = BatchHashAgg(pre, list(range(g)), remapped)
-        out = []
-        for b in bound:
-            if isinstance(b, tuple) and b[0] == "agg":
-                out.append(InputRef(g + b[1],
-                                    agg.schema[g + b[1]].data_type))
-            else:
-                r = repr(b)
-                if r not in group_reprs:
-                    raise PlanError(f"projection {r} is neither grouped "
-                                    "nor aggregated")
-                i = group_reprs.index(r)
-                out.append(InputRef(i, agg.schema[i].data_type))
+        out = [_map_agg_projection(b, g, agg.schema, group_reprs)
+               for b in bound]
         ex = BatchProject(agg, out, names)
         post_scope = Scope.of(ex.schema, None)
     else:

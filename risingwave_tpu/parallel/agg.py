@@ -204,6 +204,7 @@ class ShardedAggKernel:
             recv, rvalid = exchange(buckets, bvalid, AXIS)
             m = n_dev * cap
             rvis = rvalid.reshape(m)
+            n_received = jnp.sum(rvis, dtype=jnp.int32)
             rkeys = recv[0].reshape(m, key_width)
             fresh = make_agg_state(cap, key_width, specs)
             table, slots, _ins = ht.probe_insert(fresh.table, rkeys,
@@ -230,15 +231,24 @@ class ShardedAggKernel:
                                    zip(fresh.emitted_accs,
                                        recv[5 + na:])),
             )
-            return jax.tree.map(lambda a: a[None], new)
+            return jax.tree.map(lambda a: a[None], new), n_received[None]
 
         state_spec = jax.tree.map(lambda _: P(AXIS), self.state)
         mapped = jax.shard_map(
             local, mesh=self.mesh,
-            in_specs=(state_spec, P()), out_specs=state_spec,
+            in_specs=(state_spec, P()), out_specs=(state_spec, P(AXIS)),
             check_vma=False)
         step = jax.jit(mapped, donate_argnums=(0,))
-        self.state = step(self.state, new_map)
+        new_state, received = step(self.state, new_map)
+        # destination-table contract: probe_insert needs a free slot
+        # per routed row; an overfull shard would silently corrupt
+        # accumulators — fail loudly instead
+        worst = int(np.asarray(received).max())
+        if worst > ht.MAX_LOAD * cap:
+            raise RuntimeError(
+                f"reshard overfills a shard: {worst} live groups vs "
+                f"{cap} slots — raise capacity before rescaling")
+        self.state = new_state
         self.owner_map = new_map   # apply steps take it as a runtime arg
 
     # -- host-side full decode (tests + dryrun assertions) ---------------

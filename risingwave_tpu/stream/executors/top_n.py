@@ -99,9 +99,14 @@ class GroupTopNExecutor(Executor):
     def __init__(self, input_: Executor, order_by: Sequence[Tuple[int, bool]],
                  offset: int, limit: Optional[int], state: StateTable,
                  group_indices: Sequence[int] = (),
-                 append_only: bool = False):
+                 append_only: bool = False,
+                 pk_indices: Optional[Sequence[int]] = None):
+        # planner chains sometimes know the pk better than the input
+        # executor advertises (e.g. a projection over an agg)
+        pk = list(pk_indices if pk_indices is not None
+                  else input_.pk_indices)
         super().__init__(ExecutorInfo(
-            input_.schema, list(input_.pk_indices),
+            input_.schema, pk,
             "GroupTopNExecutor" if group_indices else "TopNExecutor"))
         self.input = input_
         self.order_by = list(order_by)
@@ -112,7 +117,7 @@ class GroupTopNExecutor(Executor):
         self.append_only = append_only
         # sort = order cols, then pk for a total (deterministic) order
         self._sort_cols = [i for i, _ in self.order_by] + [
-            i for i in input_.pk_indices
+            i for i in pk
             if i not in {j for j, _ in self.order_by}]
         self._descs = tuple([d for _, d in self.order_by] +
                             [False] * (len(self._sort_cols)

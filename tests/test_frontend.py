@@ -210,3 +210,36 @@ def test_q0_q2_q3_shaped_queries():
     assert len(q3) > 0
     # join MVs carry trailing _row_id pk cols; state is column 2
     assert all(row[2] in ("OR", "ID", "CA") for row in q3)
+
+
+def test_avg_and_topn_mv():
+    """AVG (bind-time sum/count rewrite) + ORDER BY/LIMIT MVs (streaming
+    TopN) — q5-ish 'hottest items' shape."""
+    async def run():
+        fe = Frontend(min_chunks=8)
+        await fe.execute(NEXMARK_BID)
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW per_auction AS SELECT auction, "
+            "COUNT(*) AS bids, AVG(price) AS avg_price FROM bid "
+            "GROUP BY auction")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW top3 AS SELECT auction, "
+            "COUNT(*) AS bids FROM bid GROUP BY auction "
+            "ORDER BY bids DESC, auction LIMIT 3")
+        await fe.step(10)
+        pa = await fe.execute(
+            "SELECT auction, bids, avg_price FROM per_auction")
+        top3 = await fe.execute("SELECT auction, bids FROM top3 "
+                                "ORDER BY bids DESC, auction")
+        # batch recompute of the same top-3 over the full agg MV
+        want = await fe.execute(
+            "SELECT auction, bids FROM per_auction "
+            "ORDER BY bids DESC, auction LIMIT 3")
+        await fe.close()
+        return pa, top3, want
+
+    pa, top3, want = asyncio.run(run())
+    assert len(pa) > 100
+    for _a, bids, avg_price in pa[:50]:
+        assert isinstance(avg_price, float) and avg_price > 0
+    assert top3 == want and len(top3) == 3

@@ -111,7 +111,8 @@ def test_reshard_moves_state_and_preserves_results(eight_devices):
     sharded.reshard(new_map)
     occ_after = np.asarray(jnp.sum(sharded.state.table.occ, axis=1))
     assert occ_after[2:].sum() == 0          # state actually moved
-    assert occ_after.sum() >= occ_before.sum() * 0  # sanity
+    # nothing lost in transit: results identical right after the move
+    assert sharded.snapshot() == _single_chip_snapshot(single)
     feed()                                    # keep streaming after move
     # scale back "up" to all 8 shards
     sharded.reshard(np.arange(VNODE_COUNT, dtype=np.int32) % 8)
