@@ -205,7 +205,9 @@ class StreamPlanner:
                 ex = RowIdGenExecutor(ProjectExecutor(ex, exprs, names))
                 pk = [len(exprs)]
                 names = names + ["_row_id"]
-        if sel.order_by or sel.limit is not None:
+        if sel.limit is not None or (sel.offset or 0) > 0:
+            # ORDER BY alone is a no-op for a pk-keyed MV (pg drops it
+            # too) — only a real window needs the TopN executor.
             # agg outputs retract (updates); plain source/join chains of
             # append-only sources do not — let TopN prune beyond-window
             # state in that case (top_n_appendonly analog)

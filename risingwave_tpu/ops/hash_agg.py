@@ -503,6 +503,7 @@ class FlushResult:
     prev_outs: List[np.ndarray]
     prev_nulls: List[np.ndarray]
     prev_nns: List[Optional[np.ndarray]]
+    raw_accs: List[np.ndarray] = None  # device-layout acc cols (flush)
 
     @staticmethod
     def empty(specs: Sequence[AggSpec], key_width: int) -> "FlushResult":
@@ -667,16 +668,28 @@ class GroupedAggKernel:
             was_emitted=was,
             prev_rows=prows.astype(np.int64),
             prev_outs=pouts, prev_nulls=pnulls,
-            prev_nns=_nns_of(self.specs, paccs))
+            prev_nns=_nns_of(self.specs, paccs),
+            raw_accs=accs)
 
-    def patch_accs(self, decoded: List[Tuple[np.ndarray, np.ndarray]]
-                   ) -> None:
-        """Overwrite flushed groups' accumulators with corrected decoded
-        (value, nn) pairs per call (minput recompute path)."""
+    def patch_accs(self, decoded: List[Optional[
+            Tuple[np.ndarray, np.ndarray]]],
+                   raw_accs: Optional[List[np.ndarray]] = None) -> None:
+        """Overwrite flushed groups' accumulators (minput recompute).
+
+        `decoded[j]` is (value, nn) for a corrected call, or None for
+        an untouched one — untouched calls write back their RAW gathered
+        device columns bit-for-bit (re-encoding a float sum through the
+        decoded f64 would perturb the (hi, lo) pair)."""
         idx = self._flush_idx
         assert idx is not None and len(idx) > 0
         dev_cols: List[np.ndarray] = []
-        for s, (v, nn) in zip(self.specs, decoded):
+        for j, (s, d) in enumerate(zip(self.specs, decoded)):
+            if d is None:
+                assert raw_accs is not None, "raw accs needed for passthrough"
+                sl = _call_slices(self.specs)[j]
+                dev_cols.extend(raw_accs[sl])
+                continue
+            v, nn = d
             dev_cols.extend(s.encode_acc(v, nn))
         pad = next_pow2(len(idx))
         idx_padded = np.full(pad, self.capacity, dtype=np.int32)

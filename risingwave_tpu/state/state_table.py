@@ -249,6 +249,25 @@ class StateTable:
             start = encode_vnode_prefix(vnode)
             end = encode_vnode_prefix(vnode + 1) if vnode + 1 < VNODE_COUNT \
                 else None
+        yield from self._iter_range(start, end)
+
+    def iter_prefix(self, prefix_values: Sequence
+                    ) -> Iterator[Tuple[tuple, tuple]]:
+        """(pk, row) for every pk starting with the given leading pk
+        values (state_table.rs:1092 prefix iterators). The prefix must
+        cover the dist keys so the vnode is derivable."""
+        k = len(prefix_values)
+        for i in self.dist_key_indices:
+            assert self.pk_indices.index(i) < k, \
+                "prefix must include all dist keys"
+        vnode = self._vnode_of_pk(
+            list(prefix_values) + [None] * (len(self.pk_indices) - k))
+        start = (encode_vnode_prefix(vnode) +
+                 encode_memcomparable(prefix_values, self.pk_types[:k]))
+        yield from self._iter_range(start, _next_prefix(start))
+
+    def _iter_range(self, start: Optional[bytes], end: Optional[bytes]
+                    ) -> Iterator[Tuple[tuple, tuple]]:
         merged = {k: v for k, v in self.store.iter(
             self.table_id, self._read_epoch(), start, end)}
         for key, (op, _old, new) in self.mem_table.iter_ops():
@@ -275,6 +294,17 @@ class StateTable:
         prev = self.vnodes
         self.vnodes = np.asarray(new_vnodes, dtype=bool)
         return prev
+
+
+def _next_prefix(b: bytes) -> Optional[bytes]:
+    """Smallest byte string greater than every string prefixed by b."""
+    arr = bytearray(b)
+    while arr:
+        if arr[-1] != 0xFF:
+            arr[-1] += 1
+            return bytes(arr)
+        arr.pop()
+    return None
 
 
 def _key_lane(v, dt: DataType) -> np.ndarray:

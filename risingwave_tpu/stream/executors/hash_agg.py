@@ -23,7 +23,9 @@ into the kernel (``GroupedAggKernel.rebuild``).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import AsyncIterator, List, Optional, Sequence, Tuple
+from typing import (
+    AsyncIterator, Dict, List, Optional, Sequence, Tuple,
+)
 
 import numpy as np
 
@@ -104,6 +106,22 @@ def agg_state_schema(input_schema: Schema, group_indices: Sequence[int],
     return Schema(fields), list(range(len(group_indices)))
 
 
+def minput_state_schema(input_schema: Schema,
+                        group_indices: Sequence[int], call: AggCall
+                        ) -> Tuple[Schema, List[int], List[int]]:
+    """Materialized-input table for ONE retractable MIN/MAX call
+    (aggregation/minput.rs analog, value-multiset form): rows are
+    (group keys..., value, _cnt) with pk = (group keys, value) so a
+    prefix scan over the group yields the surviving values.
+
+    Returns (schema, pk_indices, dist_key_indices)."""
+    fields = [input_schema[i] for i in group_indices]
+    fields.append(Field("_value", input_schema[call.input_idx].data_type))
+    fields.append(Field("_cnt", DataType.INT64))
+    g = len(group_indices)
+    return Schema(fields), list(range(g + 1)), list(range(g))
+
+
 class HashAggExecutor(Executor):
     """Streaming hash aggregation over a device kernel (hash_agg.rs:67)."""
 
@@ -111,6 +129,7 @@ class HashAggExecutor(Executor):
                  agg_calls: Sequence[AggCall], table: StateTable,
                  append_only: bool = False,
                  output_names: Optional[Sequence[str]] = None,
+                 minput_tables: Optional[Dict[int, StateTable]] = None,
                  actor_id: int = 0):
         self.input = input_
         self.group_indices = list(group_indices)
@@ -125,11 +144,20 @@ class HashAggExecutor(Executor):
                 raise TypeError(
                     f"group key type {dt} not device-hashable yet")
         self.specs = [c.spec(in_schema) for c in self.agg_calls]
-        if not append_only and any(
-                s.kind in (AggKind.MIN, AggKind.MAX) for s in self.specs):
-            raise NotImplementedError(
-                "retractable min/max needs the materialized-input state "
-                "(minput) path — pass append_only=True or use sum/count")
+        # retractable MIN/MAX: device extremes go stale on deletes; the
+        # materialized-input tables (minput.rs analog) let the flush
+        # recompute and patch them (see _recompute_extremes)
+        self.minput: Dict[int, StateTable] = dict(minput_tables or {})
+        self._deleted_lanes: set = set()
+        if not append_only:
+            need = [j for j, s in enumerate(self.specs)
+                    if s.kind in (AggKind.MIN, AggKind.MAX)]
+            missing = [j for j in need if j not in self.minput]
+            if missing:
+                raise ValueError(
+                    "retractable min/max needs materialized-input state "
+                    f"tables for call(s) {missing} — pass minput_tables "
+                    "(see minput_state_schema) or append_only=True")
         self.kernel = GroupedAggKernel(
             key_width=_LANES_PER_KEY * len(self.group_indices),
             specs=self.specs)
@@ -156,10 +184,53 @@ class HashAggExecutor(Executor):
         return tuple(out)
 
     def _apply_chunk(self, chunk: StreamChunk) -> None:
-        self.kernel.apply(build_key_lanes(chunk, self.group_indices),
-                          chunk.signs(),
-                          np.asarray(chunk.visibility),
-                          self._inputs(chunk))
+        key_lanes = build_key_lanes(chunk, self.group_indices)
+        signs = np.asarray(chunk.signs())
+        vis = np.asarray(chunk.visibility)
+        if self.minput:
+            self._apply_minput(chunk, key_lanes, signs, vis)
+        self.kernel.apply(key_lanes, signs, vis, self._inputs(chunk))
+
+    def _apply_minput(self, chunk: StreamChunk, key_lanes: np.ndarray,
+                      signs: np.ndarray, vis: np.ndarray) -> None:
+        """Maintain the per-call value multisets; remember which groups
+        saw deletes (only those can have stale device extremes)."""
+        del_rows = np.flatnonzero(vis & (signs < 0))
+        for r in del_rows.tolist():
+            self._deleted_lanes.add(tuple(key_lanes[r].tolist()))
+        g_cols = [(np.asarray(chunk.columns[i].values),
+                   None if chunk.columns[i].validity is None
+                   else np.asarray(chunk.columns[i].validity))
+                  for i in self.group_indices]
+
+        def group_of(r: int) -> tuple:
+            return tuple(
+                None if (ok is not None and not ok[r])
+                else vals[r].item()
+                for vals, ok in g_cols)
+
+        for j, table in self.minput.items():
+            call = self.agg_calls[j]
+            c = chunk.columns[call.input_idx]
+            vals = np.asarray(c.values)
+            ok = vis if c.validity is None                 else vis & np.asarray(c.validity)
+            deltas: Dict[tuple, int] = {}
+            for r in np.flatnonzero(ok).tolist():
+                key = group_of(r) + (vals[r].item(),)
+                deltas[key] = deltas.get(key, 0) + int(signs[r])
+            for key, d in deltas.items():
+                if d == 0:
+                    continue
+                cur = table.get_row(key)
+                cnt = (0 if cur is None else cur[-1]) + d
+                row = key + (cnt,)
+                if cur is None:
+                    assert cnt > 0, f"retract of unseen value {key}"
+                    table.insert(row)
+                elif cnt == 0:
+                    table.delete(cur)
+                else:
+                    table.update(cur, row)
 
     # -- barrier path ----------------------------------------------------
     def _group_key_host(self, keys: np.ndarray
@@ -173,8 +244,12 @@ class HashAggExecutor(Executor):
         _METRICS.agg_table_capacity.set(self.kernel.capacity,
                                         executor=self.identity)
         if fr.n == 0:
+            self._deleted_lanes.clear()
             self.kernel.advance()
             return None
+        if self.minput and self._deleted_lanes:
+            self._recompute_extremes(fr)
+        self._deleted_lanes.clear()
         outs, nulls = fr.outs, fr.nulls
         pouts, pnulls = fr.prev_outs, fr.prev_nulls
         cur_live = fr.group_rows > 0
@@ -231,6 +306,38 @@ class HashAggExecutor(Executor):
         vis = np.zeros(cap, dtype=bool)
         vis[:t] = True
         return StreamChunk(self.schema, columns, vis, ops)
+
+    def _recompute_extremes(self, fr) -> None:
+        """Correct stale device MIN/MAX for groups that saw deletes by
+        scanning their surviving value multiset, then patch the device
+        accumulators (hash_agg.rs + minput.rs flush semantics)."""
+        gk = self._group_key_host(fr.keys)
+        need = [r for r in range(fr.n)
+                if tuple(fr.keys[r].tolist()) in self._deleted_lanes]
+        if not need:
+            return
+        for r in need:
+            group = tuple(
+                None if not ok[r] else vals[r].item()
+                for vals, ok in gk)
+            for j, table in self.minput.items():
+                is_max = self.specs[j].kind == AggKind.MAX
+                best = None
+                for _pk, row in table.iter_prefix(group):
+                    v = row[-2]
+                    if best is None or (v > best if is_max else v < best):
+                        best = v
+                nn = fr.nns[j][r]
+                if nn == 0 or best is None:
+                    fr.nulls[j][r] = True
+                    fr.nns[j][r] = 0
+                else:
+                    fr.outs[j][r] = best
+                    fr.nulls[j][r] = False
+        decoded = [
+            (fr.outs[j], fr.nns[j]) if j in self.minput else None
+            for j in range(len(self.specs))]
+        self.kernel.patch_accs(decoded, raw_accs=fr.raw_accs)
 
     def _state_rows(self, fr, gk, idx: np.ndarray,
                     prev: bool) -> List[tuple]:
@@ -292,6 +399,8 @@ class HashAggExecutor(Executor):
         first = await it.__anext__()
         assert is_barrier(first), f"expected init barrier, got {first!r}"
         self.table.init_epoch(first.epoch)
+        for t in self.minput.values():
+            t.init_epoch(first.epoch)
         self._recover()
         yield first
         try:
@@ -301,6 +410,8 @@ class HashAggExecutor(Executor):
                 elif is_barrier(msg):
                     out = self._flush()
                     self.table.commit(msg.epoch)
+                    for t in self.minput.values():
+                        t.commit(msg.epoch)
                     if out is not None:
                         yield out
                     yield msg

@@ -38,13 +38,24 @@ def chunk(gs, vs, ops=None) -> StreamChunk:
     return StreamChunk.from_pydict(SCHEMA, {"g": gs, "v": vs}, ops=ops)
 
 
-def build(messages, agg_calls, append_only=False):
-    store = MemoryStateStore()
+def build(messages, agg_calls, append_only=False, store=None):
+    store = store if store is not None else MemoryStateStore()
     src = MockSource(SCHEMA, messages)
     sschema, spk = agg_state_schema(SCHEMA, [0], agg_calls)
     table = StateTable(10, sschema, spk, store, dist_key_indices=[0])
+    minput = {}
+    if not append_only:
+        from risingwave_tpu.stream.executors.hash_agg import (
+            minput_state_schema,
+        )
+        from risingwave_tpu.ops.hash_agg import AggKind as _K
+        for j, call in enumerate(agg_calls):
+            if call.kind in (_K.MIN, _K.MAX):
+                msch, mpk, mdk = minput_state_schema(SCHEMA, [0], call)
+                minput[j] = StateTable(100 + j, msch, mpk, store,
+                                       dist_key_indices=mdk)
     ex = HashAggExecutor(src, [0], agg_calls, table,
-                         append_only=append_only)
+                         append_only=append_only, minput_tables=minput)
     return ex, table, store
 
 
@@ -193,9 +204,72 @@ def test_max_append_only_q7_shape():
                        ["max", "count*"], append_only=True)
 
 
-def test_retractable_max_rejected_without_minput():
-    with pytest.raises(NotImplementedError):
-        build([], [AggCall(AggKind.MAX, 1)], append_only=False)
+def test_retractable_max_with_deletes_matches_oracle():
+    """The minput path: deletes that remove the current extreme force a
+    recompute from the materialized value multiset."""
+    script = [
+        barrier(1),
+        chunk([1, 1, 1, 2], [5, 9, 7, 3]),
+        barrier(2),
+        # delete the max of group 1 (9) and the only row of group 2
+        chunk([1, 2], [9, 3], ops=[2, 2]),
+        barrier(3),
+        # delete ANOTHER max (7) and add a smaller value
+        chunk([1, 1], [7, 6], ops=[2, 1]),
+        barrier(4),
+    ]
+    run_case(script, [AggCall(AggKind.MAX, 1), AggCall(AggKind.MIN, 1),
+                      AggCall(AggKind.COUNT)],
+             ["max", "min", "count*"])
+
+
+def test_retractable_minmax_random_oracle():
+    rng = np.random.default_rng(31)
+    live = []
+    script = [barrier(1)]
+    for e in range(2, 8):
+        gs, vs, ops = [], [], []
+        for _ in range(40):
+            if live and rng.random() < 0.4:
+                i = rng.integers(0, len(live))
+                g, v = live.pop(int(i))
+                gs.append(g); vs.append(v); ops.append(2)
+            else:
+                g = int(rng.integers(0, 5))
+                v = int(rng.integers(-50, 50))
+                live.append((g, v))
+                gs.append(g); vs.append(v); ops.append(1)
+        script.append(chunk(gs, vs, ops=ops))
+        script.append(barrier(e))
+    run_case(script, [AggCall(AggKind.MAX, 1), AggCall(AggKind.MIN, 1),
+                      AggCall(AggKind.SUM, 1)], ["max", "min", "sum"])
+
+
+def test_retractable_max_recovers_from_state():
+    """Recovery mid-stream: minput + value state rebuild, then a delete
+    of the pre-recovery max must still recompute correctly."""
+    store = MemoryStateStore()
+    ex, table, store = build(
+        [barrier(1), chunk([1, 1], [10, 20]), barrier(2)],
+        [AggCall(AggKind.MAX, 1)], store=store)
+    asyncio.run(collect_until_n_barriers(ex, 2))
+    store.seal_epoch(Epoch.from_physical(1).value, True)
+    store.sync(Epoch.from_physical(1).value)
+    # "restart": fresh executor over the same store; delete the max
+    ex2, table2, _ = build(
+        [barrier(2), chunk([1], [20], ops=[2]),
+         barrier(3)],
+        [AggCall(AggKind.MAX, 1)], store=store)
+    msgs = asyncio.run(collect_until_n_barriers(ex2, 2))
+    # recovery marked the group emitted, so the delete emits an update
+    # pair retracting the stale max; the corrected value persists
+    from risingwave_tpu.common.chunk import Op as _Op
+    recs = [(op, row) for m in msgs if is_chunk(m)
+            for op, row in m.to_records()]
+    assert (_Op.UPDATE_DELETE, (1, 20)) in recs
+    assert (_Op.UPDATE_INSERT, (1, 10)) in recs
+    rows = {pk[0]: row for pk, row in table2.iter_rows()}
+    assert rows[1][2] == 10
 
 
 def test_random_stream_oracle_sum_count():
@@ -301,3 +375,12 @@ def test_flush_buffer_overflow_retries():
     assert got == want
     kern.advance()
     assert not bool(np.asarray(kern.state.dirty).any())
+
+
+def test_retractable_max_rejected_without_minput():
+    src = MockSource(SCHEMA, [])
+    sschema, spk = agg_state_schema(SCHEMA, [0], [AggCall(AggKind.MAX, 1)])
+    t = StateTable(10, sschema, spk, MemoryStateStore(),
+                   dist_key_indices=[0])
+    with pytest.raises(ValueError):
+        HashAggExecutor(src, [0], [AggCall(AggKind.MAX, 1)], t)
