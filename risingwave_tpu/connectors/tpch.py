@@ -1,0 +1,173 @@
+"""TPC-H table generators (streaming form): customer/orders/lineitem.
+
+Reference parity: the role of the TPC-H corpus the reference streams in
+e2e_test/streaming/tpch/ (tables loaded as append-only streams). The
+generators are deterministic, whole-chunk vectorized, and replayable by
+absolute offset (split recovery contract shared with nexmark/datagen).
+Columns cover the streaming q3/q5 baseline shapes; scale is controlled
+by row counts, not SF files — no external dbgen needed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import numpy as np
+
+from risingwave_tpu.common.chunk import Column, Op, StreamChunk, next_pow2
+from risingwave_tpu.common.types import (
+    DataType, Field, Schema, decimal_to_scaled,
+)
+
+CUSTOMER_SCHEMA = Schema([
+    Field("c_custkey", DataType.INT64),
+    Field("c_name", DataType.VARCHAR),
+    Field("c_mktsegment", DataType.VARCHAR),
+    Field("c_nationkey", DataType.INT64),
+])
+
+ORDERS_SCHEMA = Schema([
+    Field("o_orderkey", DataType.INT64),
+    Field("o_custkey", DataType.INT64),
+    Field("o_orderdate", DataType.DATE),
+    Field("o_shippriority", DataType.INT32),
+])
+
+LINEITEM_SCHEMA = Schema([
+    Field("l_orderkey", DataType.INT64),
+    Field("l_extendedprice", DataType.DECIMAL),
+    Field("l_discount", DataType.DECIMAL),
+    Field("l_shipdate", DataType.DATE),
+    Field("l_suppkey", DataType.INT64),
+])
+
+TABLE_SCHEMAS = {
+    "customer": CUSTOMER_SCHEMA,
+    "orders": ORDERS_SCHEMA,
+    "lineitem": LINEITEM_SCHEMA,
+}
+
+SEGMENTS = np.array(["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY",
+                     "HOUSEHOLD"], dtype=object)
+
+# date domain: 1992-01-01 .. 1998-08-02 as days-since-epoch int32
+DATE_LO = 8035      # 1992-01-01
+DATE_HI = 10440     # 1998-08-02
+LINES_PER_ORDER = 4
+
+
+@dataclass
+class TpchConfig:
+    table: str = "lineitem"
+    customers: int = 1500           # SF0.01-ish proportions
+    orders: int = 15000
+    row_count: Optional[int] = None  # rows of THIS table to emit
+    max_chunk_size: int = 1024
+    seed: int = 0x7C9
+
+    @property
+    def total_rows(self) -> int:
+        if self.row_count is not None:
+            return self.row_count
+        if self.table == "customer":
+            return self.customers
+        if self.table == "orders":
+            return self.orders
+        return self.orders * LINES_PER_ORDER
+
+
+def _mix(k: np.ndarray, seed: int) -> np.ndarray:
+    x = (k.astype(np.uint64)
+         + np.uint64((seed * 0x9E3779B97F4A7C15) & (2**64 - 1)))
+    with np.errstate(over="ignore"):
+        x ^= x >> np.uint64(30)
+        x *= np.uint64(0xBF58476D1CE4E5B9)
+        x ^= x >> np.uint64(27)
+        x *= np.uint64(0x94D049BB133111EB)
+        x ^= x >> np.uint64(31)
+    return x
+
+
+def _u01(k: np.ndarray, seed: int) -> np.ndarray:
+    return (_mix(k, seed) >> np.uint64(11)).astype(np.float64) / (1 << 53)
+
+
+def gen_customer(k: np.ndarray, cfg: TpchConfig) -> Dict[str, np.ndarray]:
+    return {
+        "c_custkey": k + 1,
+        "c_name": np.array([f"Customer#{i + 1:09d}" for i in k.tolist()],
+                           dtype=object),
+        "c_mktsegment": SEGMENTS[
+            (_mix(k, cfg.seed + 1) % 5).astype(np.int64)],
+        "c_nationkey": (_mix(k, cfg.seed + 2) % 25).astype(np.int64),
+    }
+
+
+def gen_orders(k: np.ndarray, cfg: TpchConfig) -> Dict[str, np.ndarray]:
+    return {
+        "o_orderkey": k + 1,
+        "o_custkey": (_mix(k, cfg.seed + 3)
+                      % cfg.customers).astype(np.int64) + 1,
+        "o_orderdate": (DATE_LO + _mix(k, cfg.seed + 4)
+                        % (DATE_HI - DATE_LO)).astype(np.int32),
+        "o_shippriority": np.zeros(len(k), dtype=np.int32),
+    }
+
+
+def gen_lineitem(k: np.ndarray, cfg: TpchConfig) -> Dict[str, np.ndarray]:
+    order_k = k // LINES_PER_ORDER
+    price_cents = (_mix(k, cfg.seed + 5) % 104949).astype(np.int64) + 10001
+    discount_pct = (_mix(k, cfg.seed + 6) % 11).astype(np.int64)  # 0..0.10
+    ship_delay = (_mix(k, cfg.seed + 7) % 122).astype(np.int64)
+    odate = (DATE_LO + _mix(order_k, cfg.seed + 4)
+             % (DATE_HI - DATE_LO)).astype(np.int64)
+    return {
+        "l_orderkey": order_k + 1,
+        # DECIMAL physical = scaled int64 (4 frac digits)
+        "l_extendedprice": price_cents * 100,     # cents → 4-digit scale
+        "l_discount": discount_pct * 100,         # 0.00..0.10 scaled
+        "l_shipdate": (odate + 1 + ship_delay).astype(np.int32),
+        "l_suppkey": (_mix(k, cfg.seed + 8) % 100).astype(np.int64) + 1,
+    }
+
+
+_GENERATORS = {"customer": gen_customer, "orders": gen_orders,
+               "lineitem": gen_lineitem}
+
+
+class TpchSplitReader:
+    """Replayable split reader (SplitReader protocol)."""
+
+    def __init__(self, cfg: TpchConfig, offset: int = 0):
+        assert cfg.table in _GENERATORS, cfg.table
+        self.cfg = cfg
+        self.schema = TABLE_SCHEMAS[cfg.table]
+        self.split_id = f"tpch-{cfg.table}-0"
+        self.offset = offset
+
+    def seek(self, offset: int) -> None:
+        self.offset = offset
+
+    def next_chunk(self) -> Optional[StreamChunk]:
+        n = min(self.cfg.max_chunk_size,
+                self.cfg.total_rows - self.offset)
+        if n <= 0:
+            return None
+        k = np.arange(self.offset, self.offset + n, dtype=np.int64)
+        self.offset += n
+        data = _GENERATORS[self.cfg.table](k, self.cfg)
+        cap = next_pow2(n)
+        cols = []
+        for f in self.schema:
+            arr = data[f.name]
+            if f.data_type.is_device:
+                full = np.zeros(cap, dtype=f.data_type.np_dtype)
+            else:
+                full = np.empty(cap, dtype=object)
+            full[:n] = arr
+            cols.append(Column(f.data_type, full, None))
+        vis = np.zeros(cap, dtype=bool)
+        vis[:n] = True
+        ops = np.full(cap, int(Op.INSERT), dtype=np.int8)
+        return StreamChunk(self.schema, cols, vis, ops)

@@ -1,0 +1,144 @@
+"""Hand-built TPC-H streaming pipelines: q3 (3-way join → agg → topn).
+
+Reference parity: e2e_test/streaming/tpch/q3 semantics —
+
+    SELECT l_orderkey, SUM(l_extendedprice * (1 - l_discount)) AS
+           revenue, o_orderdate, o_shippriority
+    FROM customer, orders, lineitem
+    WHERE c_mktsegment = 'BUILDING' AND c_custkey = o_custkey
+      AND l_orderkey = o_orderkey AND o_orderdate < DATE '1995-03-15'
+      AND l_shipdate > DATE '1995-03-15'
+    GROUP BY l_orderkey, o_orderdate, o_shippriority
+    ORDER BY revenue DESC, o_orderdate LIMIT 10
+
+The plan chains two HashJoinExecutors (nested barrier alignment over
+three sources), DECIMAL revenue arithmetic (exact scaled-int64), the
+device hash-agg, and the streaming TopN window. Hand-assembled here
+because the SQL planner currently supports one join per MV; the
+executor layer itself has no such limit — which is exactly what this
+model demonstrates.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Dict, Optional
+
+from risingwave_tpu.common.types import DataType, Schema
+from risingwave_tpu.connectors.tpch import TpchConfig, TpchSplitReader
+from risingwave_tpu.expr.expr import InputRef, lit
+from risingwave_tpu.meta.barrier import BarrierLoop
+from risingwave_tpu.models.nexmark import (
+    SPLIT_STATE_SCHEMA, Pipeline, drive_to_completion,
+)
+from risingwave_tpu.ops.hash_agg import AggKind
+from risingwave_tpu.state.state_table import StateTable
+from risingwave_tpu.stream.actor import Actor, LocalBarrierManager
+from risingwave_tpu.stream.exchange import channel_for_test
+from risingwave_tpu.stream.executors.hash_agg import (
+    AggCall, HashAggExecutor, agg_state_schema,
+)
+from risingwave_tpu.stream.executors.hash_join import HashJoinExecutor
+from risingwave_tpu.stream.executors.materialize import MaterializeExecutor
+from risingwave_tpu.stream.executors.row_id_gen import RowIdGenExecutor
+from risingwave_tpu.stream.executors.simple import (
+    FilterExecutor, ProjectExecutor,
+)
+from risingwave_tpu.stream.executors.source import SourceExecutor
+from risingwave_tpu.stream.executors.top_n import GroupTopNExecutor
+
+EPOCH_DAY = datetime.date(1970, 1, 1)
+CUTOFF = (datetime.date(1995, 3, 15) - EPOCH_DAY).days
+
+
+def _src(local, store, aid, cfg, tid, rate_limit, min_chunks):
+    reader = TpchSplitReader(cfg)
+    tx, rx = channel_for_test()
+    st = StateTable(tid, SPLIT_STATE_SCHEMA, [0], store)
+    local.register_sender(aid, tx)
+    return SourceExecutor(reader, rx, st, actor_id=aid,
+                          rate_limit_chunks_per_barrier=rate_limit,
+                          min_chunks_per_barrier=min_chunks), reader
+
+
+def build_q3(store, customers: int = 300, orders: int = 3000,
+             rate_limit: Optional[int] = 8,
+             min_chunks: Optional[int] = None,
+             top_limit: int = 10) -> Pipeline:
+    local = LocalBarrierManager()
+    mk = lambda t, rows=None: TpchConfig(table=t, customers=customers,
+                                         orders=orders, row_count=rows)
+    cust, cust_r = _src(local, store, 1, mk("customer"), 1,
+                        rate_limit, min_chunks)
+    ordr, ordr_r = _src(local, store, 2, mk("orders"), 2,
+                        rate_limit, min_chunks)
+    line, line_r = _src(local, store, 3, mk("lineitem"), 3,
+                        rate_limit, min_chunks)
+
+    cs = cust.schema
+    c_f = RowIdGenExecutor(FilterExecutor(
+        cust, InputRef(cs.index_of("c_mktsegment"), DataType.VARCHAR)
+        == lit("BUILDING")))
+    os_ = ordr.schema
+    o_f = RowIdGenExecutor(FilterExecutor(
+        ordr, InputRef(os_.index_of("o_orderdate"), DataType.DATE)
+        < lit(CUTOFF, DataType.DATE)))
+    ls = line.schema
+    l_f = RowIdGenExecutor(FilterExecutor(
+        line, InputRef(ls.index_of("l_shipdate"), DataType.DATE)
+        > lit(CUTOFF, DataType.DATE)))
+
+    # join 1: customer ⋈ orders on custkey
+    n_c = len(c_f.schema)
+    j1_lt = StateTable(4, c_f.schema, [n_c - 1], store)
+    j1_rt = StateTable(5, o_f.schema, [len(o_f.schema) - 1], store)
+    j1 = HashJoinExecutor(
+        c_f, o_f,
+        left_keys=[c_f.schema.index_of("c_custkey")],
+        right_keys=[o_f.schema.index_of("o_custkey")],
+        left_table=j1_lt, right_table=j1_rt)
+
+    # join 2: (customer ⋈ orders) ⋈ lineitem on orderkey
+    j1_pk = list(j1.pk_indices)
+    j2_lt = StateTable(6, j1.schema, j1_pk, store)
+    j2_rt = StateTable(7, l_f.schema, [len(l_f.schema) - 1], store)
+    j2 = HashJoinExecutor(
+        j1, l_f,
+        left_keys=[j1.schema.index_of("o_orderkey")],
+        right_keys=[l_f.schema.index_of("l_orderkey")],
+        left_table=j2_lt, right_table=j2_rt)
+
+    js = j2.schema
+    revenue = (InputRef(js.index_of("l_extendedprice"), DataType.DECIMAL)
+               * (lit(1, DataType.DECIMAL)
+                  - InputRef(js.index_of("l_discount"),
+                             DataType.DECIMAL)))
+    proj = ProjectExecutor(
+        j2,
+        exprs=[InputRef(js.index_of("l_orderkey"), DataType.INT64),
+               InputRef(js.index_of("o_orderdate"), DataType.DATE),
+               InputRef(js.index_of("o_shippriority"), DataType.INT32),
+               revenue],
+        names=["l_orderkey", "o_orderdate", "o_shippriority", "revenue"])
+
+    calls = [AggCall(AggKind.SUM, 3)]
+    agg_sch, agg_pk = agg_state_schema(proj.schema, [0, 1, 2], calls)
+    agg = HashAggExecutor(
+        proj, [0, 1, 2], calls,
+        StateTable(8, agg_sch, agg_pk, store,
+                   dist_key_indices=[0]),
+        append_only=True,
+        output_names=["l_orderkey", "o_orderdate", "o_shippriority",
+                      "revenue"])
+
+    topn_state = StateTable(9, agg.schema, [0, 1, 2], store)
+    topn = GroupTopNExecutor(
+        agg, order_by=[(3, True), (1, False)], offset=0,
+        limit=top_limit, state=topn_state, pk_indices=[0, 1, 2])
+
+    mv = StateTable(10, topn.schema, [0, 1, 2], store)
+    mat = MaterializeExecutor(topn, mv)
+    local.set_expected_actors([11])
+    actor = Actor(11, mat, dispatchers=[], barrier_manager=local)
+    return Pipeline(actor, BarrierLoop(local, store), mv,
+                    {1: cust_r, 2: ordr_r, 3: line_r})

@@ -503,7 +503,8 @@ class FlushResult:
     prev_outs: List[np.ndarray]
     prev_nulls: List[np.ndarray]
     prev_nns: List[Optional[np.ndarray]]
-    raw_accs: List[np.ndarray] = None  # device-layout acc cols (flush)
+    # device-layout acc columns from the flush gather (None on empty)
+    raw_accs: Optional[List[np.ndarray]] = None
 
     @staticmethod
     def empty(specs: Sequence[AggSpec], key_width: int) -> "FlushResult":
@@ -682,12 +683,13 @@ class GroupedAggKernel:
         decoded f64 would perturb the (hi, lo) pair)."""
         idx = self._flush_idx
         assert idx is not None and len(idx) > 0
+        slices = _call_slices(self.specs)
         dev_cols: List[np.ndarray] = []
         for j, (s, d) in enumerate(zip(self.specs, decoded)):
             if d is None:
-                assert raw_accs is not None, "raw accs needed for passthrough"
-                sl = _call_slices(self.specs)[j]
-                dev_cols.extend(raw_accs[sl])
+                assert raw_accs is not None, \
+                    "raw accs needed for passthrough"
+                dev_cols.extend(raw_accs[slices[j]])
                 continue
             v, nn = d
             dev_cols.extend(s.encode_acc(v, nn))

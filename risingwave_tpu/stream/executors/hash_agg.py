@@ -149,6 +149,10 @@ class HashAggExecutor(Executor):
         # recompute and patch them (see _recompute_extremes)
         self.minput: Dict[int, StateTable] = dict(minput_tables or {})
         self._deleted_lanes: set = set()
+        # per-epoch buffered value-multiset deltas: call → key → delta
+        # (written through to the StateTables once per barrier, keeping
+        # store round-trips off the chunk hot path)
+        self._minput_pending: Dict[int, Dict[tuple, int]] = {}
         if not append_only:
             need = [j for j, s in enumerate(self.specs)
                     if s.kind in (AggKind.MIN, AggKind.MAX)]
@@ -209,15 +213,22 @@ class HashAggExecutor(Executor):
                 else vals[r].item()
                 for vals, ok in g_cols)
 
-        for j, table in self.minput.items():
+        for j in self.minput:
             call = self.agg_calls[j]
             c = chunk.columns[call.input_idx]
             vals = np.asarray(c.values)
-            ok = vis if c.validity is None                 else vis & np.asarray(c.validity)
-            deltas: Dict[tuple, int] = {}
+            ok = vis if c.validity is None \
+                else vis & np.asarray(c.validity)
+            deltas = self._minput_pending.setdefault(j, {})
             for r in np.flatnonzero(ok).tolist():
                 key = group_of(r) + (vals[r].item(),)
                 deltas[key] = deltas.get(key, 0) + int(signs[r])
+
+    def _write_minput_pending(self) -> None:
+        """Write buffered multiset deltas through to the StateTables
+        (once per barrier; reads during recompute then see them)."""
+        for j, deltas in self._minput_pending.items():
+            table = self.minput[j]
             for key, d in deltas.items():
                 if d == 0:
                     continue
@@ -231,6 +242,7 @@ class HashAggExecutor(Executor):
                     table.delete(cur)
                 else:
                     table.update(cur, row)
+        self._minput_pending.clear()
 
     # -- barrier path ----------------------------------------------------
     def _group_key_host(self, keys: np.ndarray
@@ -243,12 +255,15 @@ class HashAggExecutor(Executor):
         _METRICS.agg_dirty_groups.set(fr.n, executor=self.identity)
         _METRICS.agg_table_capacity.set(self.kernel.capacity,
                                         executor=self.identity)
+        if self.minput:
+            self._write_minput_pending()
         if fr.n == 0:
             self._deleted_lanes.clear()
             self.kernel.advance()
             return None
+        gk = self._group_key_host(fr.keys)   # decode key lanes once
         if self.minput and self._deleted_lanes:
-            self._recompute_extremes(fr)
+            self._recompute_extremes(fr, gk)
         self._deleted_lanes.clear()
         outs, nulls = fr.outs, fr.nulls
         pouts, pnulls = fr.prev_outs, fr.prev_nulls
@@ -269,7 +284,6 @@ class HashAggExecutor(Executor):
                 state_moved |= nn != pnn
         persist_upd_i = np.flatnonzero(
             cur_live & was & (changed | state_moved))
-        gk = self._group_key_host(fr.keys)   # decode key lanes once
         self._persist(fr, gk, ins_i, persist_upd_i, del_i)
         self.kernel.advance()
         t = len(ins_i) + 2 * len(upd_i) + len(del_i)
@@ -307,11 +321,10 @@ class HashAggExecutor(Executor):
         vis[:t] = True
         return StreamChunk(self.schema, columns, vis, ops)
 
-    def _recompute_extremes(self, fr) -> None:
+    def _recompute_extremes(self, fr, gk) -> None:
         """Correct stale device MIN/MAX for groups that saw deletes by
         scanning their surviving value multiset, then patch the device
         accumulators (hash_agg.rs + minput.rs flush semantics)."""
-        gk = self._group_key_host(fr.keys)
         need = [r for r in range(fr.n)
                 if tuple(fr.keys[r].tolist()) in self._deleted_lanes]
         if not need:

@@ -26,7 +26,7 @@ import numpy as np
 
 from risingwave_tpu.common.chunk import Column, Op, StreamChunk
 from risingwave_tpu.common.types import Schema
-from risingwave_tpu.state.state_table import StateTable, to_logical_row
+from risingwave_tpu.state.state_table import StateTable
 from risingwave_tpu.stream.executor import Executor, ExecutorInfo
 from risingwave_tpu.stream.message import (
     Message, is_barrier, is_chunk, is_watermark,
@@ -136,8 +136,10 @@ class GroupTopNExecutor(Executor):
         return rows.window(self.offset, self.limit) if rows else []
 
     def _recover(self) -> None:
-        for _pk, raw in self.state.iter_rows():
-            row = to_logical_row(raw, self.schema)
+        # rows are PHYSICAL end to end (DECIMAL = scaled int64): order
+        # is preserved under the physical encoding, state-table writes
+        # expect it, and chunk rebuild must not lossily convert
+        for _pk, row in self.state.iter_rows():
             g = self._group_of(row)
             self.groups.setdefault(g, _SortedRows()).insert(
                 self._key_of(row), row)
@@ -145,15 +147,15 @@ class GroupTopNExecutor(Executor):
     # -- chunk path ------------------------------------------------------
     def _apply(self, chunk: StreamChunk) -> Optional[StreamChunk]:
         touched: Dict[tuple, List[tuple]] = {}
-        vis = np.asarray(chunk.visibility)
-        ops = np.asarray(chunk.ops)
-        for op, row in chunk.to_records():
+        _idx, prows, pops = chunk.to_physical_records()
+        for op_i, row in zip(pops.tolist(), prows):
+            is_ins = Op(op_i).is_insert
             g = self._group_of(row)
             if g not in touched:
                 touched[g] = self._window(g)
             rows = self.groups.setdefault(g, _SortedRows())
             key = self._key_of(row)
-            if op.is_insert:
+            if is_ins:
                 rows.insert(key, row)
                 self.state.insert(row)
                 if self.append_only and self.limit is not None:
@@ -166,7 +168,6 @@ class GroupTopNExecutor(Executor):
                         "delete on append-only TopN input")
                 rows.delete(key, row)
                 self.state.delete(row)
-        del vis, ops
         # net window delta per touched group
         deletes: List[tuple] = []
         inserts: List[tuple] = []
