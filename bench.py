@@ -5,8 +5,8 @@ Prints ONE JSON line: {"metric": ..., "value": N, "unit": ...,
 
 Baseline (BASELINE.md): ≥1M events/sec/chip on Nexmark q7/q8 (one v5e).
 The headline metric is the stateful device-kernel path (q7: HashAgg on
-TPU). Run `python bench.py --all` for the full table (q1, q7, q8) on
-stderr. Pipelines come from risingwave_tpu.models.nexmark — the
+TPU). Run `python bench.py --all` for the full table (q1, q7, q8 and
+TPC-H q3) on stderr. Pipelines come from risingwave_tpu.models.nexmark — the
 benchmarked plan is exactly the tested plan (tests/test_e2e_q*.py).
 """
 
@@ -77,20 +77,64 @@ def bench_q8(total_events: int = 50 * 40_000, chunk_size: int = 4096):
     return _result("nexmark_q8_events_per_sec", elapsed, rows, p.loop)
 
 
+def bench_q3(customers: int = 1500, orders: int = 15000):
+    """TPC-H q3 streaming: 3-way join → agg → top-10 (BASELINE config).
+
+    Throughput counts rows entering across all three tables."""
+    from risingwave_tpu.connectors.tpch import LINES_PER_ORDER
+    from risingwave_tpu.models.nexmark import drive_to_completion
+    from risingwave_tpu.models.tpch import build_q3
+    from risingwave_tpu.state.store import MemoryStateStore
+
+    p = build_q3(MemoryStateStore(), customers=customers, orders=orders,
+                 rate_limit=16, min_chunks=16)
+    targets = {1: customers, 2: orders, 3: orders * LINES_PER_ORDER}
+    elapsed, rows = asyncio.run(drive_to_completion(p, targets))
+    return _result("tpch_q3_events_per_sec", elapsed, rows, p.loop)
+
+
+def _probe_device(timeout_s: int = 180) -> None:
+    """Fail over to CPU if the TPU backend cannot initialize.
+
+    The axon tunnel can wedge (a killed client's remote claim takes
+    time to expire); jax backend init then blocks with no timeout and
+    the whole bench run would hang. Probe in a subprocess first; on
+    timeout, force this process onto the CPU backend so the bench still
+    reports a (clearly-labeled) number instead of nothing."""
+    import os
+    import subprocess
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True, check=True)
+        return
+    except (subprocess.SubprocessError, OSError):
+        print("WARNING: device backend unreachable — benching on CPU",
+              file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+
 def main(argv):
     from risingwave_tpu.utils.jaxtools import enable_compilation_cache
+    _probe_device()
     enable_compilation_cache()
+    import jax
+    platform = jax.devices()[0].platform
     run_all = "--all" in argv
     results = {}
     # headline: the stateful device-kernel path (q7). q1 (stateless host
-    # reference path) and q8 (device join) are reported on --all.
+    # reference path), q8 (device join) and tpch q3 on --all.
     results["q7"] = bench_q7()
     headline = dict(results["q7"])
     if run_all:
         results["q1"] = bench_q1()
         results["q8"] = bench_q8()
+        results["q3"] = bench_q3()
     headline["vs_baseline"] = round(
         headline["value"] / BASELINE_EVENTS_PER_SEC, 4)
+    headline["platform"] = platform
     if run_all:
         print(json.dumps(results, indent=2), file=sys.stderr)
     print(json.dumps(headline))

@@ -89,10 +89,6 @@ def _mix(k: np.ndarray, seed: int) -> np.ndarray:
     return x
 
 
-def _u01(k: np.ndarray, seed: int) -> np.ndarray:
-    return (_mix(k, seed) >> np.uint64(11)).astype(np.float64) / (1 << 53)
-
-
 def gen_customer(k: np.ndarray, cfg: TpchConfig) -> Dict[str, np.ndarray]:
     return {
         "c_custkey": k + 1,

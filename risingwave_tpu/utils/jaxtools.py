@@ -23,7 +23,9 @@ def enable_compilation_cache(path: str | None = None) -> str:
     cache_dir = path or os.environ.get("RW_TPU_JAX_CACHE", _DEFAULT_DIR)
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
-    # cache small programs too — the kernels are latency-critical
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    # cache EVERY program: the kernel zoo is many sub-100ms compiles
+    # (probe/link/flush per shape bucket) whose first-run total is the
+    # difference between a cold bench and a warm one
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     return cache_dir

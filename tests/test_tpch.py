@@ -10,7 +10,7 @@ import numpy as np
 
 from risingwave_tpu.common.types import scaled_to_decimal
 from risingwave_tpu.connectors.tpch import (
-    TpchConfig, gen_customer, gen_lineitem, gen_orders,
+    LINES_PER_ORDER, TpchConfig, gen_customer, gen_lineitem, gen_orders,
 )
 from risingwave_tpu.models.nexmark import drive_to_completion
 from risingwave_tpu.models.tpch import CUTOFF, build_q3
@@ -24,7 +24,8 @@ def q3_oracle(top_limit=10):
     cfg = TpchConfig(customers=CUSTOMERS, orders=ORDERS)
     cust = gen_customer(np.arange(CUSTOMERS, dtype=np.int64), cfg)
     ordr = gen_orders(np.arange(ORDERS, dtype=np.int64), cfg)
-    line = gen_lineitem(np.arange(ORDERS * 4, dtype=np.int64), cfg)
+    line = gen_lineitem(
+        np.arange(ORDERS * LINES_PER_ORDER, dtype=np.int64), cfg)
     building = {int(k) for k, seg in
                 zip(cust["c_custkey"], cust["c_mktsegment"])
                 if seg == "BUILDING"}
@@ -36,7 +37,7 @@ def q3_oracle(top_limit=10):
                 int(ordr["o_orderdate"][i]),
                 int(ordr["o_shippriority"][i]))
     groups = defaultdict(int)          # (okey, odate, prio) → scaled rev
-    for i in range(ORDERS * 4):
+    for i in range(ORDERS * LINES_PER_ORDER):
         ok = int(line["l_orderkey"][i])
         if ok in okeys and int(line["l_shipdate"][i]) > CUTOFF:
             price = int(line["l_extendedprice"][i])
@@ -54,7 +55,7 @@ def test_tpch_q3_end_to_end():
     store = MemoryStateStore()
     p = build_q3(store, customers=CUSTOMERS, orders=ORDERS,
                  rate_limit=8, min_chunks=8)
-    targets = {1: CUSTOMERS, 2: ORDERS, 3: ORDERS * 4}
+    targets = {1: CUSTOMERS, 2: ORDERS, 3: ORDERS * LINES_PER_ORDER}
     asyncio.run(drive_to_completion(p, targets))
     got = sorted(
         (to_logical_row(r, p.mv_table.schema)
