@@ -1,0 +1,152 @@
+"""SinkExecutor: deliver changelog streams to external systems.
+
+Reference parity: src/stream/src/executor/sink.rs:39 + the Sink/
+SinkWriter trait pair (src/connector/src/sink/mod.rs:156,171) and the
+in-memory log-store decoupling (common/log_store/mod.rs) — collapsed:
+the executor buffers the epoch's deltas and hands them to the writer at
+every barrier (`begin_epoch → write_batch* → commit(epoch)`), so a sink
+that talks to a slow external system naturally batches per epoch and a
+crash replays from the last committed epoch (at-least-once; writers
+that record the epoch get exactly-once dedup).
+
+Writers here: BlackholeSink (perf/testing), FileSink (newline-JSON
+changelog with epoch markers; idempotent replay via the epoch header),
+CollectSink (tests).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import AsyncIterator, List, Optional, Protocol, Tuple
+
+from risingwave_tpu.common.chunk import Op, StreamChunk
+from risingwave_tpu.common.types import Schema
+from risingwave_tpu.stream.executor import Executor, ExecutorInfo
+from risingwave_tpu.stream.message import (
+    Message, is_barrier, is_chunk,
+)
+
+
+class SinkWriter(Protocol):
+    """What the executor drives (sink/mod.rs:171 SinkWriter analog)."""
+
+    def begin_epoch(self, epoch: int) -> None: ...
+
+    def write_batch(self, records: List[Tuple[Op, tuple]]) -> None: ...
+
+    def commit(self, epoch: int) -> None: ...
+
+
+class BlackholeSink:
+    """Swallow everything (sink/blackhole.rs analog); counts rows."""
+
+    def __init__(self) -> None:
+        self.rows = 0
+        self.epochs = 0
+
+    def begin_epoch(self, epoch: int) -> None:
+        pass
+
+    def write_batch(self, records) -> None:
+        self.rows += len(records)
+
+    def commit(self, epoch: int) -> None:
+        self.epochs += 1
+
+
+class CollectSink:
+    """Test helper: keeps every committed record in memory."""
+
+    def __init__(self) -> None:
+        self.committed: List[Tuple[int, List[Tuple[Op, tuple]]]] = []
+        self._pending: List[Tuple[Op, tuple]] = []
+        self._epoch: Optional[int] = None
+
+    def begin_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+        self._pending = []
+
+    def write_batch(self, records) -> None:
+        self._pending.extend(records)
+
+    def commit(self, epoch: int) -> None:
+        self.committed.append((epoch, self._pending))
+        self._pending = []
+
+
+class FileSink:
+    """Newline-JSON changelog with epoch frames.
+
+    Replay-safe: each commit appends a {"epoch": e} marker AFTER the
+    epoch's records; a restarted pipeline re-emitting an epoch ≤ the
+    last marker is skipped (exactly-once against the file)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._buf: List[str] = []
+        self._epoch: Optional[int] = None
+        self._last_committed = 0
+        if os.path.exists(path):
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if "epoch" in rec:
+                        self._last_committed = max(
+                            self._last_committed, rec["epoch"])
+
+    def begin_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+        self._buf = []
+
+    def write_batch(self, records) -> None:
+        if self._epoch is not None and \
+                self._epoch <= self._last_committed:
+            return                     # replayed epoch: drop
+        for op, row in records:
+            self._buf.append(json.dumps(
+                {"op": op.name.lower(), "row": list(row)},
+                default=str))
+
+    def commit(self, epoch: int) -> None:
+        if epoch <= self._last_committed:
+            return
+        with open(self.path, "a", encoding="utf-8") as f:
+            for line in self._buf:
+                f.write(line + "\n")
+            f.write(json.dumps({"epoch": epoch}) + "\n")
+        self._buf = []
+        self._last_committed = epoch
+
+
+class SinkExecutor(Executor):
+    """Buffer deltas per epoch; flush through the writer at barriers."""
+
+    def __init__(self, input_: Executor, writer: SinkWriter,
+                 identity: str = "SinkExecutor"):
+        super().__init__(ExecutorInfo(
+            input_.schema, list(input_.pk_indices), identity))
+        self.input = input_
+        self.writer = writer
+
+    async def execute(self) -> AsyncIterator[Message]:
+        it = self.input.execute()
+        first = await it.__anext__()
+        assert is_barrier(first)
+        self.writer.begin_epoch(first.epoch.curr.value)
+        yield first
+        async for msg in it:
+            if is_chunk(msg):
+                self.writer.write_batch(msg.to_records())
+                yield msg
+            elif is_barrier(msg):
+                # commit the epoch that just ENDED (its data is durable
+                # once this barrier's state commits upstream)
+                self.writer.commit(msg.epoch.prev.value)
+                self.writer.begin_epoch(msg.epoch.curr.value)
+                yield msg
+            else:
+                yield msg
