@@ -215,3 +215,47 @@ def drive_to_completion(pipeline: Pipeline,
         return elapsed, timed_rows
 
     return run()
+
+
+def build_q5(store, cfg: NexmarkConfig,
+             rate_limit: Optional[int] = 8,
+             min_chunks: Optional[int] = None,
+             slide: Interval = Interval(usecs=2_000_000),
+             size: Interval = Interval(usecs=10_000_000),
+             top_per_window: int = 1) -> Pipeline:
+    """q5 (hot items): auctions with the most bids per sliding window.
+
+    source → hop-window expansion → per-(window, auction) device count
+    agg → per-window group top-n → materialize (e2e_test/streaming/
+    nexmark/q5 semantics; ties kept deterministically by auction id).
+    """
+    from risingwave_tpu.stream.executors.hop_window import (
+        HopWindowExecutor,
+    )
+    from risingwave_tpu.stream.executors.top_n import GroupTopNExecutor
+
+    local = LocalBarrierManager()
+    source = _source(local, store, 1, cfg, 1, rate_limit, min_chunks)
+    s = source.schema
+    hop = HopWindowExecutor(source, s.index_of("date_time"), slide, size)
+    hs = hop.schema
+    proj = ProjectExecutor(
+        hop,
+        exprs=[InputRef(hs.index_of("window_start"), DataType.TIMESTAMP),
+               InputRef(hs.index_of("auction"), DataType.INT64)],
+        names=["window_start", "auction"])
+    calls = [AggCall(AggKind.COUNT)]
+    agg_sch, agg_pk = agg_state_schema(proj.schema, [0, 1], calls)
+    agg = HashAggExecutor(
+        proj, [0, 1], calls,
+        StateTable(2, agg_sch, agg_pk, store, dist_key_indices=[0]),
+        append_only=True,
+        output_names=["window_start", "auction", "bid_count"])
+    topn_state = StateTable(3, agg.schema, [0, 1], store)
+    topn = GroupTopNExecutor(
+        agg, order_by=[(2, True), (1, False)], offset=0,
+        limit=top_per_window, state=topn_state,
+        group_indices=[0], pk_indices=[0, 1])
+    mv = StateTable(4, topn.schema, [0, 1], store)
+    mat = MaterializeExecutor(topn, mv)
+    return _finish(local, store, mat, mv, 1, {1: source.reader})
