@@ -104,6 +104,19 @@ class CreateMaterializedView:
 
 
 @dataclass
+class CreateSink:
+    name: str
+    select: Select
+    options: Dict[str, str]
+
+
+@dataclass
+class DropSink:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
 class DropMaterializedView:
     name: str
     if_exists: bool = False

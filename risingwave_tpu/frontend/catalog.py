@@ -32,10 +32,20 @@ class MvCatalog:
     dependent_sources: List[str] = field(default_factory=list)
 
 
+@dataclass
+class SinkCatalog:
+    name: str
+    actor_id: int
+    options: Dict[str, str]
+    definition: str = ""
+    dependent_sources: List[str] = field(default_factory=list)
+
+
 class Catalog:
     def __init__(self) -> None:
         self.sources: Dict[str, SourceCatalog] = {}
         self.mvs: Dict[str, MvCatalog] = {}
+        self.sinks: Dict[str, SinkCatalog] = {}
         self._next_id = 1
 
     def next_id(self) -> int:
@@ -43,18 +53,24 @@ class Catalog:
         self._next_id += 1
         return i
 
+    def _check_free(self, name: str) -> None:
+        if name in self.sources or name in self.mvs or name in self.sinks:
+            raise ValueError(f"catalog object {name!r} already exists")
+
     def add_source(self, name: str, schema: Schema,
                    options: Dict[str, str]) -> SourceCatalog:
-        if name in self.sources or name in self.mvs:
-            raise ValueError(f"catalog object {name!r} already exists")
+        self._check_free(name)
         sc = SourceCatalog(name, self.next_id(), schema, options)
         self.sources[name] = sc
         return sc
 
     def add_mv(self, mv: MvCatalog) -> None:
-        if mv.name in self.sources or mv.name in self.mvs:
-            raise ValueError(f"catalog object {mv.name!r} already exists")
+        self._check_free(mv.name)
         self.mvs[mv.name] = mv
+
+    def add_sink(self, sk: SinkCatalog) -> None:
+        self._check_free(sk.name)
+        self.sinks[sk.name] = sk
 
     def resolve(self, name: str):
         if name in self.sources:

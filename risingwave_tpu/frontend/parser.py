@@ -30,6 +30,7 @@ KEYWORDS = {
     "false", "if", "exists", "flush", "second", "seconds", "minute",
     "minutes", "hour", "hours", "day", "days", "millisecond",
     "milliseconds", "case", "when", "then", "else", "end", "cast",
+    "sink", "sinks",
 }
 
 # keywords that can never start a primary expression (a column named
@@ -144,6 +145,28 @@ class Parser:
             name = self._ident()
             self._expect_kw("as")
             return ast.CreateMaterializedView(name, self._select())
+        if self._kw("create", "sink"):
+            name = self._ident()
+            self._expect_kw("as")
+            sel = self._select()
+            self._expect_kw("with")
+            self._expect_op("(")
+            options = {}
+            while True:
+                key = self._ident()
+                while self._op("."):
+                    key += "." + self._ident()
+                self._expect_op("=")
+                kind, _text = self._peek()
+                options[key] = (self._string() if kind == "string"
+                                else self._next()[1])
+                if not self._op(","):
+                    break
+            self._expect_op(")")
+            return ast.CreateSink(name, sel, options)
+        if self._kw("drop", "sink"):
+            if_exists = self._kw("if", "exists")
+            return ast.DropSink(self._ident(), if_exists)
         if self._kw("drop", "materialized", "view"):
             if_exists = self._kw("if", "exists")
             return ast.DropMaterializedView(self._ident(), if_exists)
@@ -156,6 +179,8 @@ class Parser:
             return ast.Show("materialized views")
         if self._kw("show", "sources"):
             return ast.Show("sources")
+        if self._kw("show", "sinks"):
+            return ast.Show("sinks")
         if self._kw("flush"):
             return ast.Flush()
         if self._peek() == ("kw", "select"):

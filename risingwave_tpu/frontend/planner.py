@@ -58,6 +58,29 @@ class StreamPlan:
     readers: Dict[int, object]          # actor_id → split reader
 
 
+@dataclass
+class SinkPlan:
+    consumer: Executor                  # SinkExecutor chain
+    deps: List[str]
+    readers: Dict[int, object]
+
+
+def make_sink_writer(options: Dict[str, str]):
+    """connector= blackhole | file (sink/mod.rs build_sink analog)."""
+    from risingwave_tpu.stream.executors.sink import (
+        BlackholeSink, FileSink,
+    )
+    connector = options.get("connector", "").lower()
+    if connector == "blackhole":
+        return BlackholeSink()
+    if connector == "file":
+        path = options.get("path")
+        if not path:
+            raise PlanError("file sink needs path='...'")
+        return FileSink(path)
+    raise PlanError(f"unknown sink connector {connector!r}")
+
+
 def _source_reader(src: SourceCatalog):
     opts = src.options
     connector = opts.get("connector", "").lower()
@@ -153,8 +176,31 @@ class StreamPlanner:
     def plan(self, name: str, sel: ast.Select, actor_id: int,
              rate_limit: Optional[int] = 8,
              min_chunks: Optional[int] = None) -> StreamPlan:
+        ex, pk, deps = self._plan_query(sel, actor_id, rate_limit,
+                                        min_chunks)
+        mv_table = StateTable(self.catalog.next_id(), ex.schema, pk,
+                              self.store)
+        mat = MaterializeExecutor(ex, mv_table)
+        mv = MvCatalog(name, mv_table.table_id, ex.schema, pk,
+                       self.definition, actor_id, deps)
+        return StreamPlan(mat, mv, self.readers)
+
+    def plan_sink(self, sel: ast.Select, options: Dict[str, str],
+                  actor_id: int, rate_limit: Optional[int] = 8,
+                  min_chunks: Optional[int] = None) -> SinkPlan:
+        """CREATE SINK AS SELECT: same chain, terminal SinkExecutor."""
+        from risingwave_tpu.stream.executors.sink import SinkExecutor
+
+        ex, _pk, deps = self._plan_query(sel, actor_id, rate_limit,
+                                         min_chunks)
+        writer = make_sink_writer(options)
+        return SinkPlan(SinkExecutor(ex, writer), deps, self.readers)
+
+    def _plan_query(self, sel: ast.Select, actor_id: int,
+                    rate_limit: Optional[int],
+                    min_chunks: Optional[int]):
         if sel.from_item is None:
-            raise PlanError("an MV needs a FROM clause")
+            raise PlanError("a streaming job needs a FROM clause")
         ex, scope, deps = self._base_chain(sel.from_item,
                                            rate_limit, min_chunks)
         join_pk_cols: Optional[List[int]] = None
@@ -214,12 +260,7 @@ class StreamPlanner:
             ex = self._plan_topn(ex, sel, pk,
                                  append_only=not (binder.agg_calls
                                                   or sel.group_by))
-        mv_table = StateTable(self.catalog.next_id(), ex.schema, pk,
-                              self.store)
-        mat = MaterializeExecutor(ex, mv_table)
-        mv = MvCatalog(name, mv_table.table_id, ex.schema, pk,
-                       self.definition, actor_id, deps)
-        return StreamPlan(mat, mv, self.readers)
+        return ex, pk, deps
 
     def _plan_topn(self, ex: Executor, sel: ast.Select,
                    pk: List[int], append_only: bool = False) -> Executor:

@@ -97,6 +97,7 @@ class Frontend:
             result = await self._run(stmt)
             if isinstance(stmt, (ast.CreateSource,
                                  ast.CreateMaterializedView,
+                                 ast.CreateSink, ast.DropSink,
                                  ast.DropMaterializedView,
                                  ast.DropSource)) and not self._replaying:
                 self._ddl_log.append(text)
@@ -161,6 +162,12 @@ class Frontend:
             return "CREATE_SOURCE"
         if isinstance(stmt, ast.CreateMaterializedView):
             return await self._create_mv(stmt)
+        if isinstance(stmt, ast.CreateSink):
+            return await self._create_sink(stmt)
+        if isinstance(stmt, ast.DropSink):
+            return await self._drop_job(
+                stmt.name, self.catalog.sinks, stmt.if_exists,
+                "DROP_SINK")
         if isinstance(stmt, ast.DropMaterializedView):
             return await self._drop_mv(stmt)
         if isinstance(stmt, ast.DropSource):
@@ -168,15 +175,19 @@ class Frontend:
                 if stmt.if_exists:
                     return "DROP_SOURCE"
                 raise PlanError(f"unknown source {stmt.name!r}")
-            for mv in self.catalog.mvs.values():
-                if stmt.name in mv.dependent_sources:
+            dependents = (list(self.catalog.mvs.values())
+                          + list(self.catalog.sinks.values()))
+            for job in dependents:
+                if stmt.name in job.dependent_sources:
                     raise PlanError(
-                        f"source {stmt.name!r} is used by MV {mv.name!r}")
+                        f"source {stmt.name!r} is used by {job.name!r}")
             del self.catalog.sources[stmt.name]
             return "DROP_SOURCE"
         if isinstance(stmt, ast.Show):
             if stmt.what == "sources":
                 return [(n,) for n in sorted(self.catalog.sources)]
+            if stmt.what == "sinks":
+                return [(n,) for n in sorted(self.catalog.sinks)]
             return [(n,) for n in sorted(self.catalog.mvs)]
         if isinstance(stmt, ast.Flush):
             await self._barrier(force_checkpoint=True)
@@ -186,11 +197,26 @@ class Frontend:
         raise PlanError(f"unhandled statement {stmt!r}")
 
     # -- handlers ---------------------------------------------------------
+    async def _deploy_job(self, name: str, actor_id: int, consumer,
+                          readers, register) -> None:
+        """Shared deployment tail for MVs and sinks — runs UNDER the
+        barrier lock the caller holds: topology mutations (sender
+        registration in plan(), expected-actor set, spawn) racing a
+        heartbeat epoch would leave it collecting against actors that
+        never received it."""
+        register()                    # catalog entry (duplicate check)
+        actor = Actor(actor_id, consumer, dispatchers=[],
+                      barrier_manager=self.local)
+        self.actors[actor_id] = actor
+        self.readers[name] = readers
+        self.local.set_expected_actors(list(self.actors))
+        self.tasks[actor_id] = actor.spawn()
+        # activation barrier (Command::CreateStreamingJob analog)
+        await self.loop.inject_and_collect(force_checkpoint=True)
+        self._deployed_actor = actor
+
     async def _create_mv(self, stmt: ast.CreateMaterializedView) -> str:
-        # topology mutations (sender registration in plan(), expected-
-        # actor set, spawn) MUST happen under the barrier lock: a
-        # concurrent heartbeat epoch dispatched to the old topology but
-        # collected against the new one would never complete
+        self.catalog._check_free(stmt.name)    # validate BEFORE planning
         async with self._barrier_lock:
             planner = StreamPlanner(self.catalog, self.store, self.local,
                                     definition="")
@@ -199,45 +225,70 @@ class Frontend:
             plan = planner.plan(stmt.name, stmt.select, actor_id,
                                 rate_limit=self.rate_limit,
                                 min_chunks=self.min_chunks)
-            self.catalog.add_mv(plan.mv)
-            actor = Actor(actor_id, plan.consumer, dispatchers=[],
-                          barrier_manager=self.local)
-            self.actors[actor_id] = actor
-            self.readers[stmt.name] = plan.readers
-            self.local.set_expected_actors(list(self.actors))
-            self.tasks[actor_id] = actor.spawn()
-            # activation barrier (Command::CreateStreamingJob analog)
-            await self.loop.inject_and_collect(force_checkpoint=True)
-        if actor.failure is not None:
-            raise actor.failure
+            await self._deploy_job(
+                stmt.name, actor_id, plan.consumer, plan.readers,
+                lambda: self.catalog.add_mv(plan.mv))
+        if self._deployed_actor.failure is not None:
+            raise self._deployed_actor.failure
         return "CREATE_MATERIALIZED_VIEW"
 
-    async def _drop_mv(self, stmt: ast.DropMaterializedView) -> str:
-        mv = self.catalog.mvs.get(stmt.name)
-        if mv is None:
-            if stmt.if_exists:
-                return "DROP_MATERIALIZED_VIEW"
-            raise PlanError(f"unknown materialized view {stmt.name!r}")
-        # stop barrier + topology removal as ONE locked unit — a
-        # heartbeat barrier between them would still expect the
-        # stopped actor and hang
+    async def _create_sink(self, stmt: ast.CreateSink) -> str:
+        from risingwave_tpu.frontend.catalog import SinkCatalog
+        from risingwave_tpu.frontend.planner import make_sink_writer
+        # validate BEFORE planning registers any barrier sender: a
+        # planner failure after registration would orphan the channel
+        # and wedge every later barrier once its permits run out
+        self.catalog._check_free(stmt.name)
+        make_sink_writer(stmt.options)
         async with self._barrier_lock:
-            stop_ids = frozenset(self.readers.get(stmt.name, {}).keys()
-                                 | {mv.actor_id})
+            planner = StreamPlanner(self.catalog, self.store, self.local,
+                                    definition="")
+            actor_id = self._next_actor
+            self._next_actor += 1
+            plan = planner.plan_sink(stmt.select, stmt.options, actor_id,
+                                     rate_limit=self.rate_limit,
+                                     min_chunks=self.min_chunks)
+            await self._deploy_job(
+                stmt.name, actor_id, plan.consumer, plan.readers,
+                lambda: self.catalog.add_sink(SinkCatalog(
+                    stmt.name, actor_id, dict(stmt.options),
+                    dependent_sources=plan.deps)))
+        if self._deployed_actor.failure is not None:
+            raise self._deployed_actor.failure
+        return "CREATE_SINK"
+
+    async def _drop_job(self, name: str, registry, if_exists: bool,
+                        status: str) -> str:
+        """Shared drop path for MVs and sinks: stop barrier + topology
+        removal as ONE locked unit — a heartbeat barrier between them
+        would still expect the stopped actor and hang."""
+        entry = registry.get(name)
+        if entry is None:
+            if if_exists:
+                return status
+            raise PlanError(f"unknown object {name!r}")
+        async with self._barrier_lock:
+            stop_ids = frozenset(self.readers.get(name, {}).keys()
+                                 | {entry.actor_id})
             await self.loop.inject_and_collect(
                 mutation=StopMutation(stop_ids))
-            task = self.tasks.pop(mv.actor_id, None)
+            task = self.tasks.pop(entry.actor_id, None)
             if task is not None:
                 await task
-            actor = self.actors.pop(mv.actor_id, None)
-            for sid in self.readers.pop(stmt.name, {}):
+            actor = self.actors.pop(entry.actor_id, None)
+            for sid in self.readers.pop(name, {}):
                 self.local.drop_actor(sid)
-            self.local.drop_actor(mv.actor_id)
+            self.local.drop_actor(entry.actor_id)
             self.local.set_expected_actors(list(self.actors))
-        del self.catalog.mvs[stmt.name]
+        del registry[name]
         if actor is not None and actor.failure is not None:
             raise actor.failure
-        return "DROP_MATERIALIZED_VIEW"
+        return status
+
+    async def _drop_mv(self, stmt: ast.DropMaterializedView) -> str:
+        return await self._drop_job(stmt.name, self.catalog.mvs,
+                                    stmt.if_exists,
+                                    "DROP_MATERIALIZED_VIEW")
 
     async def _select(self, sel: ast.Select) -> Rows:
         from risingwave_tpu.batch import collect

@@ -243,3 +243,57 @@ def test_avg_and_topn_mv():
     for _a, bids, avg_price in pa[:50]:
         assert isinstance(avg_price, float) and avg_price > 0
     assert top3 == want and len(top3) == 3
+
+
+def test_create_sink_to_file(tmp_path):
+    """CREATE SINK AS SELECT streams a changelog to a file writer with
+    epoch framing; DROP SINK stops the job."""
+    import json
+    path = str(tmp_path / "out.jsonl")
+
+    async def run():
+        fe = Frontend(min_chunks=4)
+        await fe.execute(NEXMARK_BID)
+        await fe.execute(
+            "CREATE SINK s AS SELECT auction, price FROM bid "
+            f"WHERE price > 5000000 WITH (connector='file', "
+            f"path='{path}')")
+        await fe.step(4)
+        shows = await fe.execute("SHOW SINKS")
+        await fe.execute("DROP SINK s")
+        shows_after = await fe.execute("SHOW SINKS")
+        await fe.close()
+        return shows, shows_after
+
+    shows, shows_after = asyncio.run(run())
+    assert shows == [("s",)] and shows_after == []
+    with open(path) as f:
+        lines = [json.loads(x) for x in f]
+    rows = [x["row"] for x in lines if "row" in x]
+    epochs = [x["epoch"] for x in lines if "epoch" in x]
+    assert len(rows) > 100 and len(epochs) >= 3
+    assert all(r[1] > 5000000 for r in rows)
+
+
+def test_failed_create_sink_does_not_wedge_barriers():
+    """A CREATE SINK with a bad connector must fail cleanly BEFORE any
+    barrier sender registers — an orphaned sender channel would wedge
+    every later barrier once its permits ran out."""
+    async def run():
+        fe = Frontend(min_chunks=2)
+        await fe.execute(NEXMARK_BID)
+        with pytest.raises(Exception, match="unknown sink connector"):
+            await fe.execute("CREATE SINK bad AS SELECT auction FROM "
+                             "bid WITH (connector='kafka')")
+        # cluster must still make progress: deploy a real MV and step
+        # well past the 64-permit barrier budget
+        await fe.execute("CREATE MATERIALIZED VIEW m AS "
+                         "SELECT auction FROM bid")
+        for _ in range(70):
+            await fe.step(1)
+        n = await fe.execute("SELECT COUNT(*) AS n FROM m")
+        await fe.close()
+        return n
+
+    n = asyncio.run(run())
+    assert n[0][0] > 0
