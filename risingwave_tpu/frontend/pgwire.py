@@ -135,8 +135,10 @@ class PgServer:
             writer.write(_msg(b"C", _cstr(result.replace("_", " "))))
         else:
             writer.write(_row_description(result, schema))
+            types = ([f.data_type for f in schema]
+                     if schema is not None else None)
             for row in result:
-                writer.write(_data_row(row))
+                writer.write(_data_row(row, types))
             writer.write(_msg(b"C", _cstr(f"SELECT {len(result)}")))
         writer.write(_ready())
         await writer.drain()
@@ -166,20 +168,52 @@ def _row_description(rows: List[tuple],
     return _msg(b"T", payload)
 
 
-def _data_row(row: tuple) -> bytes:
+def _data_row(row: tuple,
+              types: Optional[List[DataType]] = None) -> bytes:
     payload = struct.pack(">H", len(row))
-    for v in row:
+    for i, v in enumerate(row):
         if v is None:
             payload += struct.pack(">i", -1)
         else:
-            b = _pg_text(v).encode()
+            dt = types[i] if types is not None and i < len(types) else None
+            b = _pg_text(v, dt).encode()
             payload += struct.pack(">I", len(b)) + b
     return _msg(b"D", payload)
 
 
-def _pg_text(v) -> str:
+_USECS_PER_SEC = 1_000_000
+_SECS_PER_DAY = 86_400
+
+
+def _fmt_usec_of_day(usecs: int) -> str:
+    s, us = divmod(usecs, _USECS_PER_SEC)
+    h, rem = divmod(s, 3600)
+    m, sec = divmod(rem, 60)
+    out = f"{h:02d}:{m:02d}:{sec:02d}"
+    return out + (f".{us:06d}" if us else "")
+
+
+def _fmt_date(days: int) -> str:
+    import datetime
+    d = datetime.date(1970, 1, 1) + datetime.timedelta(days=int(days))
+    return d.isoformat()
+
+
+def _pg_text(v, dt: Optional[DataType] = None) -> str:
+    """Text-format one value. Physical time types (raw ints — see
+    common/types.py:119-122) are rendered ISO-8601 so psql/psycopg can
+    parse them under the advertised OIDs (ADVICE r2)."""
     if v is True:
         return "t"
     if v is False:
         return "f"
+    if dt == DataType.DATE:
+        return _fmt_date(int(v))
+    if dt == DataType.TIME:
+        return _fmt_usec_of_day(int(v))
+    if dt in (DataType.TIMESTAMP, DataType.TIMESTAMPTZ):
+        usecs = int(v)
+        day, of_day = divmod(usecs, _SECS_PER_DAY * _USECS_PER_SEC)
+        out = f"{_fmt_date(day)} {_fmt_usec_of_day(of_day)}"
+        return out + "+00" if dt == DataType.TIMESTAMPTZ else out
     return str(v)

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from risingwave_tpu.ops import hash_table as ht
+from risingwave_tpu.utils import jaxtools
 
 
 class ChainState(NamedTuple):
@@ -163,9 +164,15 @@ class JoinSideKernel:
     and create cycles. Dead refs are reclaimed wholesale by `rebuild`
     (recovery / future compaction)."""
 
+    # pre-sized like GroupedAggKernel.DEFAULT_CAPACITY: the growth
+    # ladder costs a rehash + retrace per doubling, and the sync-free
+    # occupancy bound drains (70ms-1s blocked read on a tunneled chip)
+    # whenever an epoch's rows outrun the key table
+    DEFAULT_CAPACITY = 1 << 16
+
     def __init__(self, key_width: int,
-                 key_capacity: int = ht.MIN_CAPACITY,
-                 row_capacity: int = ht.MIN_CAPACITY,
+                 key_capacity: int = DEFAULT_CAPACITY,
+                 row_capacity: int = DEFAULT_CAPACITY,
                  probe_capacity: int = 1 << 14):
         self.key_width = key_width
         self.table = ht.DeviceHashTable(key_width, key_capacity)
@@ -224,7 +231,7 @@ class JoinSideKernel:
         retries if the header reports overflow)."""
         n = int(key_lanes.shape[0])
         while True:
-            mat = np.asarray(_probe_pairs_jit(
+            mat = jaxtools.fetch1(_probe_pairs_jit(
                 self.table.state, self.chains, key_lanes, vis,
                 self._probe_cap))
             total = int(mat[0, 0])

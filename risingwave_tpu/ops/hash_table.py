@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from risingwave_tpu.common.hash import hash_columns
+from risingwave_tpu.utils import jaxtools
 
 MAX_LOAD = 0.70          # grow when occupancy upper bound crosses this
 MIN_CAPACITY = 1 << 10
@@ -176,16 +177,14 @@ class DeviceHashTable:
 
     def __init__(self, key_width: int, capacity: int = MIN_CAPACITY):
         self.state = make_state(max(capacity, MIN_CAPACITY), key_width)
-        self._count_exact = 0          # as of last sync
-        self._pending: list = []       # device int32 insert counters
-        self._pending_rows = 0         # upper bound on pending insertions
+        self._counters = jaxtools.PendingCounters()
 
     @property
     def capacity(self) -> int:
         return self.state.capacity
 
     def _count_upper_bound(self) -> int:
-        return self._count_exact + self._pending_rows
+        return self._counters.bound()
 
     def probe_insert(self, batch_keys: jnp.ndarray,
                      valid: jnp.ndarray) -> jnp.ndarray:
@@ -193,8 +192,7 @@ class DeviceHashTable:
         self.reserve(n)
         self.state, slots, ins = _probe_insert_jit(
             self.state, batch_keys, valid)
-        self._pending.append(ins)
-        self._pending_rows += n
+        self._counters.push(ins, n)
         return slots
 
     def lookup(self, batch_keys: jnp.ndarray,
@@ -208,11 +206,12 @@ class DeviceHashTable:
         callers that cache slots must subscribe via on_grow).
         """
         grew = False
+        self._counters.drain_ready()
         while self._count_upper_bound() + n > MAX_LOAD * self.capacity:
-            if self._pending:          # bound too loose? sync before paying
-                self.sync_count()      # for a rehash we may not need
+            if self._counters.pending_rows():
+                self.sync_count()      # bound too loose? sync before paying
                 if self._count_upper_bound() + n <= MAX_LOAD * self.capacity:
-                    break
+                    break              # for a rehash we may not need
             self._grow()
             grew = True
         return grew
@@ -234,9 +233,6 @@ class DeviceHashTable:
         self._on_grow.append(hook)
 
     def sync_count(self) -> int:
-        """Collapse the occupancy bound to the exact device count (syncs)."""
-        for ins in self._pending:
-            self._count_exact += int(ins)
-        self._pending = []
-        self._pending_rows = 0
-        return self._count_exact
+        """Collapse the occupancy bound to the exact device count (syncs;
+        the DMAs were started at dispatch, so the wait is short)."""
+        return self._counters.drain_all()

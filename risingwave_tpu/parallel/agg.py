@@ -147,8 +147,9 @@ class ShardedAggKernel:
         assert n % self.n_dev == 0, (n, self.n_dev)
         # per-shard post-exchange batch is n_dev*bucket rows in ONE
         # scatter step — same int32 limb bound as the single-chip kernel
-        assert n <= lanes.MAX_CHUNK_ROWS, \
-            f"batch {n} > {lanes.MAX_CHUNK_ROWS} breaks limb math"
+        if n > lanes.MAX_CHUNK_ROWS:
+            raise RuntimeError(
+                f"batch {n} > {lanes.MAX_CHUNK_ROWS} breaks limb math")
         flat: List[jnp.ndarray] = []
         for in_lanes, valid in inputs:
             flat.extend(jnp.asarray(a) for a in in_lanes)
@@ -166,8 +167,11 @@ class ShardedAggKernel:
         self.state, _ins, overflow = step(
             self.state, jnp.asarray(key_lanes), jnp.asarray(signs),
             jnp.asarray(vis), tuple(flat), self.owner_map)
-        assert not bool(np.asarray(overflow).any()), \
-            "bucket overflow: raise `bucket` (host retry path TBD)"
+        if bool(np.asarray(overflow).any()):
+            # not an assert: dropping routed rows corrupts aggregates,
+            # and `python -O` must not strip this guard
+            raise RuntimeError(
+                "bucket overflow: raise `bucket` (host retry path TBD)")
 
     # -- elastic resharding (scale.rs:174 / Mutation::Update analog) ------
     def reshard(self, new_owner_map: np.ndarray) -> None:

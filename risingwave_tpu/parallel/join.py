@@ -176,7 +176,8 @@ class ShardedJoinSide:
             self.table, self.chains, jnp.asarray(key_lanes),
             jnp.asarray(refs.astype(np.int32)), jnp.asarray(vis),
             self.owner_map)
-        assert not bool(np.asarray(overflow).any()), "bucket overflow"
+        if bool(np.asarray(overflow).any()):
+            raise RuntimeError("bucket overflow inserting join rows")
 
     def probe(self, key_lanes: np.ndarray, vis: np.ndarray
               ) -> Tuple[np.ndarray, np.ndarray]:
@@ -196,7 +197,8 @@ class ShardedJoinSide:
                                   jnp.asarray(key_lanes),
                                   jnp.asarray(row_ids), jnp.asarray(vis),
                                   self.owner_map)
-            assert not bool(np.asarray(overflow).any()), "bucket overflow"
+            if bool(np.asarray(overflow).any()):
+                raise RuntimeError("bucket overflow routing probe rows")
             mats = np.asarray(mats)      # [n_dev, 1 + out_cap, 2]
             worst = int(mats[:, 0, 0].max())
             if worst <= self.probe_capacity:

@@ -13,6 +13,11 @@ from __future__ import annotations
 import struct
 from typing import List, Optional, Tuple
 
+import numpy as np
+
+_INT64_MIN = -(1 << 63)
+_INT64_MAX = (1 << 63) - 1
+
 _T_NULL = 0
 _T_INT = 1       # zigzag varint
 _T_FLOAT = 2     # 8-byte little-endian double
@@ -55,13 +60,14 @@ def encode_row(row: Tuple) -> bytes:
     for v in row:
         if v is None:
             out.append(_T_NULL)
-        elif v is True:
-            out.append(_T_TRUE)
-        elif v is False:
-            out.append(_T_FALSE)
+        elif isinstance(v, (bool, np.bool_)):
+            out.append(_T_TRUE if v else _T_FALSE)
         elif isinstance(v, int) or hasattr(v, "__index__"):
+            iv = int(v)
+            if not (_INT64_MIN <= iv <= _INT64_MAX):
+                raise TypeError(f"int out of int64 range: {iv}")
             out.append(_T_INT)
-            write_uvarint(out, _zigzag(int(v)))
+            write_uvarint(out, _zigzag(iv))
         elif isinstance(v, float) or (hasattr(v, "dtype")
                                       and v.dtype.kind == "f"):
             out.append(_T_FLOAT)

@@ -3,15 +3,16 @@
 Reference parity: src/stream/src/executor/sink.rs:39 + the Sink/
 SinkWriter trait pair (src/connector/src/sink/mod.rs:156,171) and the
 in-memory log-store decoupling (common/log_store/mod.rs) — collapsed:
-the executor buffers the epoch's deltas and hands them to the writer at
-every barrier (`begin_epoch → write_batch* → commit(epoch)`), so a sink
-that talks to a slow external system naturally batches per epoch and a
-crash replays from the last committed epoch (at-least-once; writers
-that record the epoch get exactly-once dedup).
+the executor buffers deltas and hands them to the writer at CHECKPOINT
+barriers only (`begin_epoch → write_batch* → commit(epoch)`), mirroring
+sink.rs's `flush_current_epoch(.., is_checkpoint)`: non-checkpoint
+epochs are not durable upstream, so committing them would write data a
+crash can silently re-emit under fresh epochs. Committing only what is
+checkpointed keeps the external system in lockstep with the recovery
+point (at-least-once overall; the dedup window is one checkpoint).
 
 Writers here: BlackholeSink (perf/testing), FileSink (newline-JSON
-changelog with epoch markers; idempotent replay via the epoch header),
-CollectSink (tests).
+changelog with epoch markers), CollectSink (tests).
 """
 
 from __future__ import annotations
@@ -24,7 +25,7 @@ from risingwave_tpu.common.chunk import Op, StreamChunk
 from risingwave_tpu.common.types import Schema
 from risingwave_tpu.stream.executor import Executor, ExecutorInfo
 from risingwave_tpu.stream.message import (
-    Message, is_barrier, is_chunk,
+    Message, StopMutation, is_barrier, is_chunk,
 )
 
 
@@ -78,9 +79,14 @@ class CollectSink:
 class FileSink:
     """Newline-JSON changelog with epoch frames.
 
-    Replay-safe: each commit appends a {"epoch": e} marker AFTER the
-    epoch's records; a restarted pipeline re-emitting an epoch ≤ the
-    last marker is skipped (exactly-once against the file)."""
+    At-least-once: each commit appends a {"epoch": e} marker AFTER the
+    epoch's records, and a replayed epoch ≤ the last marker is skipped —
+    but epochs are wall-clock derived and NOT deterministic across
+    restarts, so data re-emitted after a crash arrives under fresh
+    (larger) epochs and is appended again. The duplicate window is
+    bounded to one checkpoint because SinkExecutor only commits at
+    checkpoint barriers; consumers needing exactly-once must dedup on a
+    primary key."""
 
     def __init__(self, path: str):
         self.path = path
@@ -123,7 +129,11 @@ class FileSink:
 
 
 class SinkExecutor(Executor):
-    """Buffer deltas per epoch; flush through the writer at barriers."""
+    """Buffer deltas; flush through the writer at CHECKPOINT barriers.
+
+    Non-checkpoint barriers only accumulate (sink.rs commits via
+    flush_current_epoch(.., is_checkpoint)) — the external commit always
+    corresponds to a durable recovery point."""
 
     def __init__(self, input_: Executor, writer: SinkWriter,
                  identity: str = "SinkExecutor"):
@@ -131,22 +141,32 @@ class SinkExecutor(Executor):
             input_.schema, list(input_.pk_indices), identity))
         self.input = input_
         self.writer = writer
+        self._pending: List[Tuple[Op, tuple]] = []
 
     async def execute(self) -> AsyncIterator[Message]:
         it = self.input.execute()
         first = await it.__anext__()
         assert is_barrier(first)
-        self.writer.begin_epoch(first.epoch.curr.value)
         yield first
         async for msg in it:
             if is_chunk(msg):
-                self.writer.write_batch(msg.to_records())
+                self._pending.extend(msg.to_records())
                 yield msg
             elif is_barrier(msg):
-                # commit the epoch that just ENDED (its data is durable
-                # once this barrier's state commits upstream)
-                self.writer.commit(msg.epoch.prev.value)
-                self.writer.begin_epoch(msg.epoch.curr.value)
+                # a stop barrier ends this pipeline: flush even if the
+                # scheduler made it a plain barrier, else the records
+                # since the last checkpoint are dropped forever (no
+                # recovery run will replay a graceful shutdown)
+                stopping = isinstance(msg.mutation, StopMutation)
+                if msg.kind.is_checkpoint or stopping:
+                    # commit the epoch that just ENDED: its state is
+                    # durable once this checkpoint completes upstream
+                    epoch = msg.epoch.prev.value
+                    self.writer.begin_epoch(epoch)
+                    if self._pending:
+                        self.writer.write_batch(self._pending)
+                    self.writer.commit(epoch)
+                    self._pending = []
                 yield msg
             else:
                 yield msg

@@ -57,6 +57,31 @@ def test_file_sink_replay_is_idempotent(tmp_path):
     assert epochs == sorted(set(epochs))
 
 
+def test_sink_buffers_across_non_checkpoint_barriers():
+    """sink.rs flush_current_epoch(.., is_checkpoint): only checkpoint
+    barriers commit to the external system (ADVICE r2)."""
+    from risingwave_tpu.common.epoch import Epoch, EpochPair
+    from risingwave_tpu.stream.message import Barrier, BarrierKind
+
+    def plain(n):
+        return Barrier(
+            EpochPair(Epoch.from_physical(n), Epoch.from_physical(n - 1)),
+            BarrierKind.BARRIER)
+
+    sink = CollectSink()
+    src = MockSource(S2, [
+        barrier(1),
+        chunk([1], [10]),
+        plain(2),                  # non-checkpoint: must NOT commit
+        chunk([2], [20]),
+        barrier(3),                # checkpoint: commits epochs 1+2 data
+    ])
+    asyncio.run(collect_until_n_barriers(SinkExecutor(src, sink), 3))
+    assert len(sink.committed) == 1
+    _e, recs = sink.committed[0]
+    assert [r for _op, r in recs] == [(1, 10), (2, 20)]
+
+
 def test_blackhole_counts():
     sink = BlackholeSink()
     src = MockSource(S2, [barrier(1), chunk([1, 2, 3], [1, 2, 3]),

@@ -41,6 +41,16 @@ def test_value_codec_roundtrip():
         assert got == want, (got, want)
 
 
+def test_value_codec_rejects_out_of_range_and_keeps_np_bool():
+    import pytest
+    with pytest.raises(TypeError):
+        encode_row((1 << 63,))
+    with pytest.raises(TypeError):
+        encode_row((-(1 << 63) - 1,))
+    assert decode_row(encode_row((np.bool_(True), np.bool_(False)))) \
+        == (True, False)
+
+
 # -- full key ------------------------------------------------------------
 
 
