@@ -119,11 +119,13 @@ def source_schema(options: Dict[str, str]) -> Schema:
 class StreamPlanner:
     """Plans one CREATE MATERIALIZED VIEW into an executor chain."""
 
-    def __init__(self, catalog: Catalog, store, local, definition: str):
+    def __init__(self, catalog: Catalog, store, local, definition: str,
+                 mesh=None):
         self.catalog = catalog
         self.store = store
         self.local = local           # LocalBarrierManager
         self.definition = definition
+        self.mesh = mesh             # non-None ⇒ sharded GROUP BY plans
         self.readers: Dict[int, object] = {}
 
     # -- source chains ---------------------------------------------------
@@ -311,8 +313,19 @@ class StreamPlanner:
         table = StateTable(self.catalog.next_id(), sch, agg_pk,
                            self.store,
                            dist_key_indices=list(range(len(agg_pk))))
+        kernel = None
+        if self.mesh is not None:
+            # parallel plan: the hash exchange that the reference's
+            # fragmenter inserts before a parallel agg
+            # (stream_fragmenter/mod.rs:199, dispatch.rs:582) is the
+            # sharded kernel's in-program all_to_all
+            from risingwave_tpu.parallel.agg import ShardedAggKernel
+            from risingwave_tpu.stream.executors.keys import LANES_PER_KEY
+            kernel = ShardedAggKernel(
+                self.mesh, key_width=LANES_PER_KEY * g,
+                specs=[c.spec(pre.schema) for c in calls])
         agg = HashAggExecutor(pre, list(range(g)), calls, table,
-                              append_only=True)
+                              append_only=True, kernel=kernel)
         # post-agg projection: map each SELECT item
         out = [_map_agg_projection(b, g, agg.schema, group_reprs)
                for b in bound]

@@ -39,8 +39,23 @@ class Frontend:
 
     def __init__(self, store: Optional[StateStore] = None,
                  rate_limit: Optional[int] = 8,
-                 min_chunks: Optional[int] = None):
+                 min_chunks: Optional[int] = None,
+                 parallelism: int = 1):
         self.store = store if store is not None else MemoryStateStore()
+        # parallelism > 1: GROUP BY plans run on the vnode-sharded SPMD
+        # kernel over a device mesh (the fragmenter's hash-exchange
+        # parallelism, §2.12, as one all_to_all program)
+        self.mesh = None
+        if parallelism > 1:
+            import jax
+            from jax.sharding import Mesh
+
+            import numpy as _np
+            devs = jax.devices()
+            if len(devs) < parallelism:
+                raise ValueError(
+                    f"parallelism {parallelism} > {len(devs)} devices")
+            self.mesh = Mesh(_np.asarray(devs[:parallelism]), ("d",))
         self.catalog = Catalog()
         self.local = LocalBarrierManager()
         self.loop = BarrierLoop(self.local, self.store)
@@ -219,7 +234,7 @@ class Frontend:
         self.catalog._check_free(stmt.name)    # validate BEFORE planning
         async with self._barrier_lock:
             planner = StreamPlanner(self.catalog, self.store, self.local,
-                                    definition="")
+                                    definition="", mesh=self.mesh)
             actor_id = self._next_actor
             self._next_actor += 1
             plan = planner.plan(stmt.name, stmt.select, actor_id,
@@ -242,7 +257,7 @@ class Frontend:
         make_sink_writer(stmt.options)
         async with self._barrier_lock:
             planner = StreamPlanner(self.catalog, self.store, self.local,
-                                    definition="")
+                                    definition="", mesh=self.mesh)
             actor_id = self._next_actor
             self._next_actor += 1
             plan = planner.plan_sink(stmt.select, stmt.options, actor_id,

@@ -97,24 +97,56 @@ def build_q1(store, cfg: NexmarkConfig,
 def build_q7(store, cfg: NexmarkConfig,
              rate_limit: Optional[int] = 4,
              window: Interval = DEFAULT_WINDOW,
-             min_chunks: Optional[int] = None) -> Pipeline:
-    """q7-core: MAX(price), COUNT(*) per tumbling window (device agg)."""
+             min_chunks: Optional[int] = None,
+             watermark_delay: Optional[Interval] = None,
+             mesh=None, shard_capacity: int = 1 << 14) -> Pipeline:
+    """q7-core: MAX(price), COUNT(*) per tumbling window (device agg).
+
+    With ``watermark_delay``, a WatermarkFilter generates event-time
+    watermarks on date_time; the projection derives a window_start
+    watermark through tumble_start, and the agg retires closed windows
+    (bounded state — the honest steady-state configuration).
+
+    With ``mesh``, the aggregation runs vnode-sharded across the mesh
+    (parallel/agg.ShardedAggKernel): the reference's hash dispatch to N
+    parallel actors (dispatch.rs:582) becomes one SPMD all_to_all."""
     local = LocalBarrierManager()
     source = _source(local, store, 1, cfg, 1, rate_limit, min_chunks)
     s = source.schema
+    upstream: "SourceExecutor | WatermarkFilterExecutor" = source
+    derivations = None
+    if watermark_delay is not None:
+        from risingwave_tpu.stream.executors.watermark_filter import (
+            WATERMARK_STATE_SCHEMA, WatermarkFilterExecutor,
+        )
+        wm_state = StateTable(10, WATERMARK_STATE_SCHEMA, [0], store)
+        upstream = WatermarkFilterExecutor(
+            source, s.index_of("date_time"), watermark_delay, wm_state)
+        w = window.exact_usecs()
+        derivations = {s.index_of("date_time"): (0, lambda v: v - v % w)}
     project = ProjectExecutor(
-        source,
+        upstream,
         exprs=[tumble_start(
             InputRef(s.index_of("date_time"), DataType.TIMESTAMP), window),
             InputRef(s.index_of("price"), DataType.INT64)],
-        names=["window_start", "price"])
+        names=["window_start", "price"],
+        watermark_derivations=derivations)
     calls = [AggCall(AggKind.MAX, 1), AggCall(AggKind.COUNT)]
     agg_schema, agg_pk = agg_state_schema(project.schema, [0], calls)
     agg_state = StateTable(2, agg_schema, agg_pk, store,
                            dist_key_indices=[0])
+    kernel = None
+    if mesh is not None:
+        from risingwave_tpu.parallel.agg import ShardedAggKernel
+        from risingwave_tpu.stream.executors.keys import LANES_PER_KEY
+        kernel = ShardedAggKernel(
+            mesh, key_width=LANES_PER_KEY * 1,
+            specs=[c.spec(project.schema) for c in calls],
+            capacity=shard_capacity)
     agg = HashAggExecutor(project, [0], calls, agg_state,
                           append_only=True,
-                          output_names=["max_price", "bid_count"])
+                          output_names=["max_price", "bid_count"],
+                          kernel=kernel)
     mv_table = StateTable(3, agg.schema, [0], store)  # pk = window_start
     mat = MaterializeExecutor(agg, mv_table)
     return _finish(local, store, mat, mv_table, 1,

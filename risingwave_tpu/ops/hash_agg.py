@@ -412,63 +412,132 @@ def _col_i32(a: jnp.ndarray) -> jnp.ndarray:
     return a
 
 
-def build_gather_packed(key_width: int):
-    """Compile the barrier-flush gather: ONE packed device→host array.
+def gather_packed(state: AggState, flush_cap: int) -> jnp.ndarray:
+    """Traced barrier-flush gather: ONE packed device→host array.
 
-    gather(state, flush_cap) → int32[1 + flush_cap, W]. Row 0 is the
-    header [n_dirty, n_groups, 0…]; rows 1..1+n are the dirty slots:
-    slot idx | keys | group_rows | accs | emitted_valid | emitted_rows |
-    emitted accs (f32 accs bitcast). Dirty-slot compaction happens ON
-    DEVICE (cumsum positions) so the host never fetches the dirty bitmap;
-    the whole barrier costs one transfer. If n_dirty > flush_cap the host
-    retries with a doubled flush_cap (header tells it so).
+    → int32[1 + flush_cap, W]. Row 0 is the header [n_dirty, n_groups,
+    0…]; rows 1..1+n are the dirty slots: slot idx | keys | group_rows |
+    accs | emitted_valid | emitted_rows | emitted accs (f32 accs
+    bitcast). Dirty-slot compaction happens ON DEVICE (cumsum positions)
+    so the host never fetches the dirty bitmap; the whole barrier costs
+    one transfer. If n_dirty > flush_cap the host retries with a doubled
+    flush_cap (header tells it so). Module-level so the sharded kernel
+    can wrap it in shard_map (one gather per shard, one fetch total).
     """
+    cap = state.table.capacity
+    key_width = state.table.key_width
+    dirty = state.dirty
+    d32 = dirty.astype(jnp.int32)
+    pos = jnp.cumsum(d32, dtype=jnp.int32) - 1
+    n_dirty = jnp.sum(d32, dtype=jnp.int32)
+    scat = jnp.where(dirty & (pos < flush_cap), pos, flush_cap)
+    slot_ids = jnp.arange(cap, dtype=jnp.int32)
+    idx = jnp.zeros(flush_cap, dtype=jnp.int32) \
+        .at[scat].set(slot_ids, mode="drop")
+    cols = [idx]
+    for k in range(key_width):
+        cols.append(state.table.keys[idx, k])
+    cols.append(state.group_rows[idx])
+    for a in state.accs:
+        cols.append(_col_i32(a[idx]))
+    cols.append(state.emitted_valid[idx].astype(jnp.int32))
+    cols.append(state.emitted_rows[idx])
+    for a in state.emitted_accs:
+        cols.append(_col_i32(a[idx]))
+    mat = jnp.stack(cols, axis=1)
+    n_groups = jnp.sum(state.table.occ, dtype=jnp.int32)
+    header = jnp.zeros((1, mat.shape[1]), dtype=jnp.int32) \
+        .at[0, 0].set(n_dirty).at[0, 1].set(n_groups)
+    return jnp.concatenate([header, mat], axis=0)
 
-    @partial(jax.jit, static_argnums=(1,))
-    def gather(state: AggState, flush_cap: int):
-        cap = state.table.capacity
-        dirty = state.dirty
-        d32 = dirty.astype(jnp.int32)
-        pos = jnp.cumsum(d32, dtype=jnp.int32) - 1
-        n_dirty = jnp.sum(d32, dtype=jnp.int32)
-        scat = jnp.where(dirty & (pos < flush_cap), pos, flush_cap)
-        slot_ids = jnp.arange(cap, dtype=jnp.int32)
-        idx = jnp.zeros(flush_cap, dtype=jnp.int32) \
-            .at[scat].set(slot_ids, mode="drop")
-        cols = [idx]
-        for k in range(key_width):
-            cols.append(state.table.keys[idx, k])
-        cols.append(state.group_rows[idx])
-        for a in state.accs:
-            cols.append(_col_i32(a[idx]))
-        cols.append(state.emitted_valid[idx].astype(jnp.int32))
-        cols.append(state.emitted_rows[idx])
-        for a in state.emitted_accs:
-            cols.append(_col_i32(a[idx]))
-        mat = jnp.stack(cols, axis=1)
-        n_groups = jnp.sum(state.table.occ, dtype=jnp.int32)
-        header = jnp.zeros((1, mat.shape[1]), dtype=jnp.int32) \
-            .at[0, 0].set(n_dirty).at[0, 1].set(n_groups)
-        return jnp.concatenate([header, mat], axis=0)
 
-    return gather
+def build_gather_packed(key_width: int):
+    del key_width   # derived from the state shape at trace time
+    return jax.jit(gather_packed, static_argnums=(1,))
+
+
+def _rebuild_live(state: AggState, live: jnp.ndarray, new_cap: int,
+                  fills) -> Tuple[AggState, jnp.ndarray]:
+    """Traced same-or-larger-capacity rehash keeping only ``live`` slots.
+
+    Open-addressing linear probing cannot free slots in place — an
+    emptied slot truncates the probe chain of every key that collided
+    past it, orphaning live groups — so both growth and watermark
+    retirement rebuild the table by re-inserting survivors.
+    """
+    new_table = ht.make_state(new_cap, state.table.key_width)
+    new_table, old_to_new, n_live = ht.probe_insert(
+        new_table, state.table.keys, live)
+    new_state = AggState(
+        table=new_table,
+        group_rows=remap_slots(state.group_rows, old_to_new, new_cap, 0),
+        dirty=remap_slots(state.dirty, old_to_new, new_cap, 0),
+        accs=tuple(remap_slots(a, old_to_new, new_cap, f)
+                   for a, f in zip(state.accs, fills)),
+        emitted_valid=remap_slots(state.emitted_valid, old_to_new,
+                                  new_cap, 0),
+        emitted_rows=remap_slots(state.emitted_rows, old_to_new,
+                                 new_cap, 0),
+        emitted_accs=tuple(remap_slots(a, old_to_new, new_cap, f)
+                           for a, f in zip(state.emitted_accs, fills)),
+    )
+    return new_state, n_live
+
+
+_I32_SIGN_FLIP = jnp.int32(-0x80000000)
+
+
+def retire_state(state: AggState, wm_hi, wm_lo, lane_off: int,
+                 fills) -> Tuple[AggState, jnp.ndarray]:
+    """Traced watermark retirement (state_table.rs:894 state-cleaning
+    analog, device side): drop every group whose watermark key column is
+    strictly below the watermark, by rebuilding the table from survivors
+    in ONE device step (no host transfer; the count refreshes at the
+    next flush).
+
+    The key columns are 3 lanes each (keys.py): (hi = v>>32,
+    lo = uint32 image, valid). Order compare is (hi signed, lo
+    unsigned); the sign-flip XOR makes int32 compares act unsigned.
+    NULL keys (valid=0) are never below a watermark.
+    """
+    keys = state.table.keys
+    hi = keys[:, lane_off]
+    lo = keys[:, lane_off + 1] ^ _I32_SIGN_FLIP
+    nonnull = keys[:, lane_off + 2] != 0
+    wlo = wm_lo ^ _I32_SIGN_FLIP
+    below = (hi < wm_hi) | ((hi == wm_hi) & (lo < wlo))
+    closed = state.table.occ & nonnull & below
+    live = state.table.occ & ~closed & (
+        (state.group_rows != 0) | state.dirty | state.emitted_valid)
+    return _rebuild_live(state, live, state.table.capacity, fills)
+
+
+def build_retire(key_width: int, specs: Sequence[AggSpec]):
+    del key_width
+    fills = tuple(f for _dt, f in dev_layout(specs))
+    jitted = jax.jit(retire_state, static_argnums=(3, 4),
+                     donate_argnums=(0,))
+
+    def retire(state, wm_hi, wm_lo, lane_off):
+        return jitted(state, wm_hi, wm_lo, lane_off, fills)
+
+    return retire
+
+
+def advance_state(state: AggState) -> AggState:
+    """Traced post-flush snapshot advance — fully on device, no host
+    index round-trip: emitted := current for every dirty slot."""
+    d = state.dirty
+    ev = jnp.where(d, state.group_rows > 0, state.emitted_valid)
+    er = jnp.where(d, state.group_rows, state.emitted_rows)
+    ea = tuple(jnp.where(d, a, e)
+               for a, e in zip(state.accs, state.emitted_accs))
+    return AggState(state.table, state.group_rows,
+                    jnp.zeros_like(d), state.accs, ev, er, ea)
 
 
 def build_advance():
-    """Compile the post-flush snapshot advance — fully on device, no
-    host index round-trip: emitted := current for every dirty slot."""
-
-    @partial(jax.jit, donate_argnums=(0,))
-    def advance(state: AggState):
-        d = state.dirty
-        ev = jnp.where(d, state.group_rows > 0, state.emitted_valid)
-        er = jnp.where(d, state.group_rows, state.emitted_rows)
-        ea = tuple(jnp.where(d, a, e)
-                   for a, e in zip(state.accs, state.emitted_accs))
-        return AggState(state.table, state.group_rows,
-                        jnp.zeros_like(d), state.accs, ev, er, ea)
-
-    return advance
+    return jax.jit(advance_state, donate_argnums=(0,))
 
 
 def build_patch(specs: Sequence[AggSpec]):
@@ -497,7 +566,6 @@ def remap_slots(arr: jnp.ndarray, old_to_new: jnp.ndarray,
     return init.at[safe].set(arr, mode="drop")
 
 
-_remap_jit = jax.jit(remap_slots, static_argnums=(2, 3))
 
 
 @dataclass
@@ -531,6 +599,50 @@ class FlushResult:
             zb.copy(), z.copy(),
             [v.copy() for v in vals], [zb.copy() for _ in specs],
             [None if n is None else n.copy() for n in nns])
+
+
+def _unpack_acc_cols(specs: Sequence[AggSpec], data: np.ndarray,
+                     c0: int) -> List[np.ndarray]:
+    """Packed i32 matrix columns → device-layout acc arrays."""
+    out = []
+    for dt, _fill in dev_layout(specs):
+        col = np.ascontiguousarray(data[:, c0])
+        if dt == np.dtype(np.float32):
+            col = col.view(np.float32)
+        out.append(col)
+        c0 += 1
+    return out
+
+
+def decode_flush_data(specs: Sequence[AggSpec], key_width: int,
+                      data: np.ndarray) -> FlushResult:
+    """Decode gathered dirty-slot rows (gather_packed layout minus the
+    header) into a host FlushResult. Shared by the single-chip and
+    sharded kernels — sharded flushes concatenate per-shard segments
+    first (keys never span shards, so concat is a disjoint union)."""
+    p = data.shape[0]
+    k = key_width
+    keys = data[:, 1:1 + k]
+    rows = np.ascontiguousarray(data[:, 1 + k])
+    if not (rows >= 0).all():
+        raise RuntimeError(
+            "group_rows wrapped int32 — a group exceeded 2^31 rows")
+    n_acc = len(dev_layout(specs))
+    accs = _unpack_acc_cols(specs, data, 2 + k)
+    was = np.ascontiguousarray(data[:, 2 + k + n_acc]).astype(bool)
+    prows = np.ascontiguousarray(data[:, 3 + k + n_acc])
+    paccs = _unpack_acc_cols(specs, data, 4 + k + n_acc)
+    outs, nulls = decode_outputs(specs, accs)
+    pouts, pnulls = decode_outputs(specs, paccs)
+    return FlushResult(
+        n=p, keys=keys,
+        group_rows=rows.astype(np.int64),
+        outs=outs, nulls=nulls, nns=_nns_of(specs, accs),
+        was_emitted=was,
+        prev_rows=prows.astype(np.int64),
+        prev_outs=pouts, prev_nulls=pnulls,
+        prev_nns=_nns_of(specs, paccs),
+        raw_accs=accs)
 
 
 def _nns_of(specs, dev_cols) -> List[Optional[np.ndarray]]:
@@ -585,6 +697,13 @@ class GroupedAggKernel:
         self._gather = build_gather_packed(key_width)
         self._advance = build_advance()
         self._patch = build_patch(self.specs)
+        self._retire = build_retire(key_width, self.specs)
+        fills = tuple(f for _dt, f in dev_layout(self.specs))
+        self._grow_step = jax.jit(
+            lambda st, cap: _rebuild_live(
+                st, st.table.occ & ((st.group_rows != 0) | st.dirty
+                                    | st.emitted_valid), cap, fills),
+            static_argnums=(1,), donate_argnums=(0,))
         self._flush_cap = next_pow2(flush_capacity)
         self._counters = jaxtools.PendingCounters()
         self._backlog: List[np.ndarray] = []   # packed, not yet shipped
@@ -662,46 +781,28 @@ class GroupedAggKernel:
         A slot is live iff its group has rows OR a flush hasn't retired
         it yet (dirty / still-emitted) — tumbling-window churn leaves
         fully retracted groups behind, and carrying them forever would
-        grow the table without bound."""
-        old = self.state
-        new_cap = old.table.capacity * 2
-        new_table = ht.make_state(new_cap, self.key_width)
-        live = old.table.occ & ((old.group_rows != 0) | old.dirty
-                                | old.emitted_valid)
-        new_table, old_to_new, n_live = ht._probe_insert_jit(
-            new_table, old.table.keys, live)
-        fills = [f for _dt, f in dev_layout(self.specs)]
-        self.state = AggState(
-            table=new_table,
-            group_rows=_remap_jit(old.group_rows, old_to_new, new_cap, 0),
-            dirty=_remap_jit(old.dirty, old_to_new, new_cap, 0),
-            accs=tuple(_remap_jit(a, old_to_new, new_cap, f)
-                       for a, f in zip(old.accs, fills)),
-            emitted_valid=_remap_jit(old.emitted_valid, old_to_new,
-                                     new_cap, 0),
-            emitted_rows=_remap_jit(old.emitted_rows, old_to_new,
-                                    new_cap, 0),
-            emitted_accs=tuple(_remap_jit(a, old_to_new, new_cap, f)
-                               for a, f in zip(old.emitted_accs, fills)),
-        )
-        # Occupancy accounting: rehash can only RECLAIM (live ⊆ occupied),
-        # so the pre-grow exact count stays a valid upper bound — keeping
-        # it avoids a blocking n_live readback (70ms-1s on the tunnel);
-        # the next flush header collapses it to exact for free.
-        del n_live
+        grow the table without bound.
+
+        Occupancy accounting: rehash can only RECLAIM (live ⊆ occupied),
+        so the pre-grow count stays a valid upper bound — keeping it
+        avoids a blocking n_live readback (70ms-1s on the tunnel); the
+        next flush header collapses it to exact for free."""
+        self.state, _n_live = self._grow_step(
+            self.state, self.state.table.capacity * 2)
+
+    def retire_below(self, group_pos: int, wm_i64: int) -> None:
+        """Watermark state cleaning: drop groups whose ``group_pos``-th
+        key column is strictly below the watermark (device-side rebuild,
+        no transfers). Call after ``advance`` — a dirty group must emit
+        before it can be retired."""
+        if self._backlog_rows:
+            raise RuntimeError("retire_below with undispatched backlog")
+        hi, lo = lanes.split_i64(np.asarray([wm_i64], dtype=np.int64))
+        self.state, _n_live = self._retire(
+            self.state, jnp.int32(hi[0]), jnp.int32(lo[0]),
+            group_pos * 3)
 
     # -- barrier flush ---------------------------------------------------
-    def _unpack_accs(self, data: np.ndarray, c0: int) -> List[np.ndarray]:
-        """Packed i32 matrix columns → device-layout acc arrays."""
-        out = []
-        for dt, _fill in dev_layout(self.specs):
-            col = np.ascontiguousarray(data[:, c0])
-            if dt == np.dtype(np.float32):
-                col = col.view(np.float32)
-            out.append(col)
-            c0 += 1
-        return out
-
     def flush(self) -> FlushResult:
         """Gather dirty groups to host and decode — ONE device→host
         transfer. Call ``advance`` after consuming (optionally
@@ -720,30 +821,8 @@ class GroupedAggKernel:
             self._flush_idx = np.zeros(0, dtype=np.int32)
             return FlushResult.empty(self.specs, self.key_width)
         data = mat[1:1 + p]
-        k = self.key_width
-        idx = np.ascontiguousarray(data[:, 0])
-        self._flush_idx = idx
-        keys = data[:, 1:1 + k]
-        rows = np.ascontiguousarray(data[:, 1 + k])
-        if not (rows >= 0).all():
-            raise RuntimeError(
-                "group_rows wrapped int32 — a group exceeded 2^31 rows")
-        n_acc = len(dev_layout(self.specs))
-        accs = self._unpack_accs(data, 2 + k)
-        was = np.ascontiguousarray(data[:, 2 + k + n_acc]).astype(bool)
-        prows = np.ascontiguousarray(data[:, 3 + k + n_acc])
-        paccs = self._unpack_accs(data, 4 + k + n_acc)
-        outs, nulls = decode_outputs(self.specs, accs)
-        pouts, pnulls = decode_outputs(self.specs, paccs)
-        return FlushResult(
-            n=p, keys=keys,
-            group_rows=rows.astype(np.int64),
-            outs=outs, nulls=nulls, nns=_nns_of(self.specs, accs),
-            was_emitted=was,
-            prev_rows=prows.astype(np.int64),
-            prev_outs=pouts, prev_nulls=pnulls,
-            prev_nns=_nns_of(self.specs, paccs),
-            raw_accs=accs)
+        self._flush_idx = np.ascontiguousarray(data[:, 0])
+        return decode_flush_data(self.specs, self.key_width, data)
 
     def patch_accs(self, decoded: List[Optional[
             Tuple[np.ndarray, np.ndarray]]],

@@ -25,18 +25,82 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from risingwave_tpu.common.chunk import next_pow2
 from risingwave_tpu.common.hash import VNODE_COUNT
 from risingwave_tpu.ops import hash_table as ht
 from risingwave_tpu.ops import lanes
 from risingwave_tpu.ops.hash_agg import (
-    AggSpec, AggState, _call_slices, _update_call, decode_outputs,
-    make_agg_state, n_input_lanes,
+    AggSpec, AggState, FlushResult, _call_slices, _update_call,
+    advance_state, decode_flush_data, decode_outputs, dev_layout,
+    gather_packed, make_agg_state, n_input_lanes, retire_state,
 )
 from risingwave_tpu.parallel.exchange import (
     bucketize_by_owner, exchange, vnodes_from_lanes,
 )
+from risingwave_tpu.utils import jaxtools
 
 AXIS = "d"
+
+
+class _ShardedCounters:
+    """Per-shard sync-free occupancy accounting + deferred overflow.
+
+    The vector twin of jaxtools.PendingCounters: each SPMD apply returns
+    int32[n_dev] insert counts and a bucket-overflow flag; both ride the
+    async DMA and are folded in when they land, so the hot path never
+    blocks on the tunnel. Overflow raises when observed (barrier at the
+    latest) — the barrier rolls back, same contract as the reference's
+    error channel.
+    """
+
+    def __init__(self, n_dev: int):
+        self._count = np.zeros(n_dev, dtype=np.int64)
+        self._pending: List[tuple] = []   # (ins[n_dev], overflow, rows)
+        self._rows = 0
+
+    def push(self, ins, overflow, n_rows: int) -> None:
+        jaxtools.start_fetch(ins, overflow)
+        self._pending.append((ins, overflow, n_rows))
+        self._rows += n_rows
+
+    def _fold(self, ins, overflow, n_rows: int) -> None:
+        if bool(np.asarray(overflow).any()):
+            raise RuntimeError(
+                "bucket overflow: routed rows dropped — raise `bucket`")
+        self._count += np.asarray(ins, dtype=np.int64)
+        self._rows -= n_rows
+
+    def drain_ready(self) -> None:
+        while self._pending and self._pending[0][0].is_ready() \
+                and self._pending[0][1].is_ready():
+            self._fold(*self._pending.pop(0))
+
+    def drain_all(self) -> None:
+        pending, self._pending = self._pending, []
+        for entry in pending:
+            jaxtools.fetch(entry[0], entry[1])
+            self._fold(*entry)
+
+    def bound(self) -> int:
+        """Upper bound on the FULLEST shard's occupancy: every pending
+        row could in principle route to one shard."""
+        return int(self._count.max(initial=0)) + self._rows
+
+    def worst_exact(self) -> int:
+        return int(self._count.max(initial=0))
+
+    def reset(self, per_shard_counts: np.ndarray) -> None:
+        self._count = np.asarray(per_shard_counts, dtype=np.int64)
+        self._pending = []
+        self._rows = 0
+
+
+def _pad_rows(a: np.ndarray, m: int) -> np.ndarray:
+    """Zero/False-pad the leading axis to m rows (pad rows are routed
+    nowhere: the caller pads `vis` with False)."""
+    out = np.zeros((m,) + a.shape[1:], dtype=a.dtype)
+    out[:a.shape[0]] = a
+    return out
 
 
 def _stack_state(n_dev: int, capacity: int, key_width: int,
@@ -58,7 +122,8 @@ class ShardedAggKernel:
 
     def __init__(self, mesh: Mesh, key_width: int,
                  specs: Sequence[AggSpec], capacity: int = 1 << 12,
-                 bucket: Optional[int] = None):
+                 bucket: Optional[int] = None,
+                 flush_capacity: int = 1 << 10):
         self.mesh = mesh
         self.n_dev = mesh.devices.size
         self.specs = tuple(specs)
@@ -78,6 +143,31 @@ class ShardedAggKernel:
             lambda a: jax.device_put(a, sharding),
             _stack_state(self.n_dev, capacity, key_width, self.specs))
         self._step_cache: Dict[Tuple[int, int], object] = {}
+        self._fills = tuple(f for _dt, f in dev_layout(self.specs))
+        self._flush_cap = next_pow2(flush_capacity)
+        self._flush_idx: Optional[List[np.ndarray]] = None
+        self._counters = _ShardedCounters(self.n_dev)
+        self._state_spec = jax.tree.map(lambda _: P(AXIS), self.state)
+        self._advance_jit = self._shardwise(advance_state, donate=True)
+        self._retire_jit = None        # built lazily (lane_off static)
+        self._gather_cache: Dict[int, object] = {}
+
+    def _shardwise(self, fn, donate: bool, out_spec=None, extra_specs=()):
+        """Wrap a single-chip traced state transform in shard_map: each
+        shard applies `fn` to its slice (leading axis dropped/restored).
+        The single-chip and sharded kernels literally share programs."""
+        def local(state, *args):
+            state = jax.tree.map(lambda a: a[0], state)
+            out = fn(state, *args)
+            return jax.tree.map(lambda a: a[None], out)
+
+        mapped = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(self._state_spec,) + tuple(extra_specs),
+            out_specs=out_spec if out_spec is not None
+            else self._state_spec,
+            check_vma=False)
+        return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
     # -- the SPMD step ----------------------------------------------------
     def _build_step(self, n_rows: int, bucket: int):
@@ -144,12 +234,23 @@ class ShardedAggKernel:
         divide n_dev.
         """
         n = key_lanes.shape[0]
-        assert n % self.n_dev == 0, (n, self.n_dev)
+        if n % self.n_dev:
+            m = (n + self.n_dev - 1) // self.n_dev * self.n_dev
+            key_lanes = _pad_rows(np.asarray(key_lanes), m)
+            signs = _pad_rows(np.asarray(signs), m)
+            vis = _pad_rows(np.asarray(vis), m)   # pad rows invisible
+            inputs = [
+                (tuple(_pad_rows(np.asarray(a), m) for a in in_lanes),
+                 None if valid is None
+                 else _pad_rows(np.asarray(valid), m))
+                for in_lanes, valid in inputs]
+            n = m
         # per-shard post-exchange batch is n_dev*bucket rows in ONE
         # scatter step — same int32 limb bound as the single-chip kernel
         if n > lanes.MAX_CHUNK_ROWS:
             raise RuntimeError(
                 f"batch {n} > {lanes.MAX_CHUNK_ROWS} breaks limb math")
+        self._reserve(n)
         flat: List[jnp.ndarray] = []
         for in_lanes, valid in inputs:
             flat.extend(jnp.asarray(a) for a in in_lanes)
@@ -164,14 +265,164 @@ class ShardedAggKernel:
         if key not in self._step_cache:
             self._step_cache[key] = self._build_step(n, bucket)
         step = self._step_cache[key]
-        self.state, _ins, overflow = step(
+        self.state, ins, overflow = step(
             self.state, jnp.asarray(key_lanes), jnp.asarray(signs),
             jnp.asarray(vis), tuple(flat), self.owner_map)
-        if bool(np.asarray(overflow).any()):
-            # not an assert: dropping routed rows corrupts aggregates,
-            # and `python -O` must not strip this guard
+        # overflow/insert counters fold in asynchronously — a blocking
+        # read per apply costs 70ms-1s on the tunneled chip
+        self._counters.push(ins, overflow, n)
+
+    def _reserve(self, n: int) -> None:
+        """Fixed-capacity v1 guard: the fullest shard must keep room for
+        `n` pessimistic inserts. Growth lands with the reschedule path;
+        until then an over-full shard fails loudly, never silently."""
+        self._counters.drain_ready()
+        if self._counters.bound() + n <= ht.MAX_LOAD * self.capacity:
+            return
+        self._counters.drain_all()
+        if self._counters.worst_exact() + n > ht.MAX_LOAD * self.capacity:
             raise RuntimeError(
-                "bucket overflow: raise `bucket` (host retry path TBD)")
+                f"sharded agg table full: worst shard has "
+                f"{self._counters.worst_exact()} groups of "
+                f"{self.capacity} slots — raise capacity")
+
+    # -- barrier flush (GroupedAggKernel surface) -------------------------
+    def flush(self) -> FlushResult:
+        """Gather every shard's dirty groups — ONE [n_dev, 1+fc, W]
+        fetch — and decode the concatenation. Keys never span shards
+        (ownership is a function of the key hash), so the merged result
+        is a disjoint union and HashAggExecutor's emission/persistence
+        logic runs unchanged on it."""
+        # drain first: reset() would discard pending bucket-overflow
+        # flags, and an overflow MUST surface before this barrier's
+        # results are treated as complete
+        self._counters.drain_all()
+        fc = self._flush_cap
+        while True:
+            if fc not in self._gather_cache:
+                self._gather_cache[fc] = self._shardwise(
+                    partial(gather_packed, flush_cap=fc), donate=False,
+                    out_spec=P(AXIS))
+            mats = jaxtools.fetch1(self._gather_cache[fc](self.state))
+            ps = mats[:, 0, 0]
+            self._counters.reset(mats[:, 0, 1])
+            worst = int(ps.max())
+            if worst <= fc:
+                break
+            fc = max(fc * 2, next_pow2(worst))
+        self._flush_cap = fc
+        if int(ps.sum()) == 0:
+            self._flush_idx = [np.zeros(0, dtype=np.int32)
+                               for _ in range(self.n_dev)]
+            return FlushResult.empty(self.specs, self.key_width)
+        segs = [mats[d, 1:1 + int(ps[d])] for d in range(self.n_dev)]
+        self._flush_idx = [np.ascontiguousarray(s[:, 0]) for s in segs]
+        data = np.concatenate(segs, axis=0)
+        return decode_flush_data(self.specs, self.key_width, data)
+
+    def advance(self) -> None:
+        assert self._flush_idx is not None, "flush() first"
+        self._flush_idx = None
+        self.state = self._advance_jit(self.state)
+
+    def patch_accs(self, decoded, raw_accs=None) -> None:
+        raise NotImplementedError(
+            "retractable MIN/MAX acc patching is single-chip only for "
+            "now — use append_only or a non-sharded plan")
+
+    def retire_below(self, group_pos: int, wm_i64: int) -> None:
+        """Watermark state cleaning, every shard in one SPMD step."""
+        if self._retire_jit is None:
+            fills = self._fills
+            off = group_pos * 3
+            self._retire_jit = self._shardwise(
+                lambda st, hi, lo: retire_state(st, hi, lo, off, fills),
+                donate=True,
+                out_spec=(self._state_spec, P(AXIS)),
+                extra_specs=(P(), P()))
+            self._retire_off = off
+        assert self._retire_off == group_pos * 3, \
+            "one watermark column per kernel"
+        hi, lo = lanes.split_i64(np.asarray([wm_i64], dtype=np.int64))
+        self.state, _n_live = self._retire_jit(
+            self.state, jnp.int32(hi[0]), jnp.int32(lo[0]))
+
+    def rebuild(self, keys: np.ndarray, group_rows: np.ndarray,
+                acc_cols: Sequence[np.ndarray]) -> None:
+        """Reload committed value-state rows (recovery), routing each
+        group to its owning shard on the host (recovery is cold path;
+        the steady-state exchange stays on device)."""
+        n = len(group_rows)
+        self.state = jax.tree.map(
+            lambda a: jax.device_put(
+                a, NamedSharding(self.mesh, P(AXIS))),
+            _stack_state(self.n_dev, self.capacity, self.key_width,
+                         self.specs))
+        self._counters.reset(np.zeros(self.n_dev, dtype=np.int64))
+        if n == 0:
+            return
+        dev_cols: List[np.ndarray] = []
+        j = 0
+        for s in self.specs:
+            from risingwave_tpu.ops.hash_agg import AggKind
+            if s.kind == AggKind.COUNT:
+                dev_cols.extend(s.encode_acc(acc_cols[j], None))
+                j += 1
+            else:
+                dev_cols.extend(s.encode_acc(acc_cols[j], acc_cols[j + 1]))
+                j += 2
+        vn = np.asarray(vnodes_from_lanes(jnp.asarray(keys)))
+        owner = np.asarray(self.owner_map)[vn]
+        per_shard = np.bincount(owner, minlength=self.n_dev)
+        m = next_pow2(int(per_shard.max(initial=1)))
+        # stack into [n_dev, m, ...] padded blocks
+        order = np.argsort(owner, kind="stable")
+        pos_in_shard = np.empty(n, dtype=np.int64)
+        at = 0
+        for d in range(self.n_dev):
+            c = int(per_shard[d])
+            pos_in_shard[order[at:at + c]] = np.arange(c)
+            at += c
+
+        def blocks(col, fill=0):
+            out = np.full((self.n_dev, m) + col.shape[1:], fill,
+                          dtype=col.dtype)
+            out[owner, pos_in_shard] = col
+            return out
+
+        bkeys = blocks(keys)
+        brows = blocks(group_rows.astype(np.int32))
+        baccs = [blocks(np.asarray(c)) for c in dev_cols]
+        bvalid = np.zeros((self.n_dev, m), dtype=bool)
+        bvalid[owner, pos_in_shard] = True
+
+        def local(state, keys_b, rows_b, valid_b, *accs_b):
+            state = jax.tree.map(lambda a: a[0], state)
+            keys_l, rows_l, valid_l = keys_b[0], rows_b[0], valid_b[0]
+            table, slots, _ins = ht.probe_insert(
+                state.table, keys_l, valid_l)
+            scat = jnp.where(valid_l, slots, state.table.capacity)
+            accs = tuple(
+                a.at[scat].set(c[0], mode="drop")
+                for a, c in zip(state.accs, accs_b))
+            rows_dev = state.group_rows.at[scat].set(rows_l, mode="drop")
+            new = AggState(
+                table=table, group_rows=rows_dev, dirty=state.dirty,
+                accs=accs,
+                emitted_valid=state.emitted_valid.at[scat].set(
+                    True, mode="drop"),
+                emitted_rows=jnp.copy(rows_dev),
+                emitted_accs=tuple(jnp.copy(a) for a in accs),
+            )
+            return jax.tree.map(lambda a: a[None], new)
+
+        mapped = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(self._state_spec,) + (P(AXIS),) * (3 + len(baccs)),
+            out_specs=self._state_spec, check_vma=False)
+        self.state = jax.jit(mapped, donate_argnums=(0,))(
+            self.state, bkeys, brows, bvalid, *baccs)
+        self._counters.reset(per_shard.astype(np.int64))
 
     # -- elastic resharding (scale.rs:174 / Mutation::Update analog) ------
     def reshard(self, new_owner_map: np.ndarray) -> None:

@@ -118,6 +118,23 @@ class StateTable:
             self.mem_table.delete(ok, old_row)
             self.mem_table.insert(nk, new_row)
 
+    def delete_below_prefix(self, watermark) -> int:
+        """Watermark state cleaning (state_table.rs:894 update_watermark):
+        delete every row whose FIRST pk column is strictly below the
+        watermark. Cost is O(deleted) + an ordered seek per owned vnode
+        (rows below a watermark on the pk prefix form a contiguous range
+        in memcomparable order). Returns rows deleted."""
+        first_pk_type = self.pk_types[0]
+        end_suffix = encode_memcomparable([watermark], [first_pk_type])
+        deleted = 0
+        for vnode in self.owned_vnodes():
+            start = encode_vnode_prefix(vnode)
+            end = start + end_suffix
+            for _pk, row in self._iter_range(start, end):
+                self.delete(row)
+                deleted += 1
+        return deleted
+
     def write_chunk(self, chunk: StreamChunk) -> None:
         """Apply a visible-row StreamChunk — the barrier-flush hot path.
 

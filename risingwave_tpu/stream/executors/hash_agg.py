@@ -130,7 +130,8 @@ class HashAggExecutor(Executor):
                  append_only: bool = False,
                  output_names: Optional[Sequence[str]] = None,
                  minput_tables: Optional[Dict[int, StateTable]] = None,
-                 actor_id: int = 0):
+                 actor_id: int = 0,
+                 kernel: Optional[object] = None):
         self.input = input_
         self.group_indices = list(group_indices)
         self.agg_calls = list(agg_calls)
@@ -162,9 +163,24 @@ class HashAggExecutor(Executor):
                     "retractable min/max needs materialized-input state "
                     f"tables for call(s) {missing} — pass minput_tables "
                     "(see minput_state_schema) or append_only=True")
-        self.kernel = GroupedAggKernel(
+        # kernel injection: the planner passes a vnode-sharded kernel
+        # (parallel/agg.ShardedAggKernel) when parallelism > 1 — same
+        # host surface, SPMD launch shape (dispatch.rs:582's hash
+        # exchange becomes the in-kernel all_to_all)
+        if kernel is not None and self.minput:
+            raise ValueError(
+                "retractable MIN/MAX (minput) is single-chip only — "
+                "sharded kernels don't support acc patching yet")
+        self.kernel = kernel if kernel is not None else GroupedAggKernel(
             key_width=_LANES_PER_KEY * len(self.group_indices),
             specs=self.specs)
+        # watermark-driven state cleaning (state_table.rs:894 analog):
+        # latest watermark seen on the FIRST group column (the state
+        # tables' pk prefix — the only position a range delete covers,
+        # mirroring the reference's prefix rule), and the last value
+        # already applied to the kernel/table
+        self._clean_wm: Optional[int] = None
+        self._cleaned_wm: Optional[int] = None
         out_schema = agg_output_schema(in_schema, group_indices, agg_calls,
                                        output_names)
         super().__init__(ExecutorInfo(
@@ -243,6 +259,32 @@ class HashAggExecutor(Executor):
                 else:
                     table.update(cur, row)
         self._minput_pending.clear()
+
+    # -- watermark state cleaning ----------------------------------------
+    def _cleanable_type(self) -> bool:
+        """Integer-family first group col only: the device compare runs
+        on the bijective (hi, lo) i64 split, which is order-preserving
+        for ints/timestamps but not for bit-cast floats."""
+        dt = np.dtype(self.group_types[0].np_dtype)
+        return np.issubdtype(dt, np.integer) or dt == np.dtype(bool)
+
+    def _clean_state(self) -> None:
+        """Retire groups below the watermark: device rebuild + ordered
+        range delete from every state table. Runs after flush/advance
+        (a dirty group must emit its last change before retirement);
+        late rows for a retired group restart it from scratch — the
+        same contract as the reference's cleaned state tables."""
+        wm = self._clean_wm
+        if wm is None or (self._cleaned_wm is not None
+                          and wm <= self._cleaned_wm):
+            return
+        phys = int(wm)
+        self.kernel.retire_below(0, phys)
+        n = self.table.delete_below_prefix(phys)
+        for t in self.minput.values():
+            t.delete_below_prefix(phys)
+        self._cleaned_wm = wm
+        _METRICS.agg_rows_cleaned.inc(n, executor=self.identity)
 
     # -- barrier path ----------------------------------------------------
     def _group_key_host(self, keys: np.ndarray
@@ -422,6 +464,7 @@ class HashAggExecutor(Executor):
                     self._apply_chunk(msg)
                 elif is_barrier(msg):
                     out = self._flush()
+                    self._clean_state()
                     self.table.commit(msg.epoch)
                     for t in self.minput.values():
                         t.commit(msg.epoch)
@@ -431,8 +474,10 @@ class HashAggExecutor(Executor):
                 elif is_watermark(msg):
                     # forward only group-key watermarks, re-indexed
                     if msg.col_idx in self.group_indices:
-                        yield msg.with_idx(
-                            self.group_indices.index(msg.col_idx))
+                        pos = self.group_indices.index(msg.col_idx)
+                        if pos == 0 and self._cleanable_type():
+                            self._clean_wm = msg.value
+                        yield msg.with_idx(pos)
         finally:
             # executor teardown: release this identity's gauge series
             _METRICS.agg_dirty_groups.remove(executor=self.identity)

@@ -43,7 +43,7 @@ from risingwave_tpu.stream.executors.keys import (
     LANES_PER_KEY, build_key_lanes, build_key_lanes_arrays,
     key_lanes_of_values,
 )
-from risingwave_tpu.stream.message import Message, is_barrier
+from risingwave_tpu.stream.message import Message, Watermark, is_barrier
 
 
 class _Arena:
@@ -234,6 +234,47 @@ class _JoinSide:
                          dtype=np.int32),
                 new_refs)
 
+    def expire_below(self, key_pos: int, wm_physical) -> int:
+        """Watermark state expiry (hash_join.rs:860-945 analog): drop
+        every stored row whose ``key_pos``-th join-key column is below
+        the watermark. Host side: vectorized scan of live refs → dead
+        pks removed from the map, rows deleted from the state table,
+        refs tombstoned on device (the existing compaction reclaims the
+        arena/chain slots when the dead ratio crosses its threshold).
+        Cost is O(live) per call — the executor only calls this when the
+        combined watermark actually advances."""
+        if not self.pk_to_ref:
+            return 0
+        col = self.key_indices[key_pos]
+        refs = np.fromiter(self.pk_to_ref.values(), dtype=np.int64,
+                           count=len(self.pk_to_ref))
+        vals = self.arena.cols[col][refs]
+        ok = self.arena.valid[col][refs]
+        dead = ok & (vals.astype(np.int64) < int(wm_physical))
+        n_dead = int(dead.sum())
+        if n_dead == 0:
+            return 0
+        dead_refs = refs[dead].astype(np.int32)
+        pks = list(self.pk_to_ref.keys())
+        dead_pks = [pks[i] for i in np.flatnonzero(dead).tolist()]
+        for pk, ref in zip(dead_pks, dead_refs.tolist()):
+            del self.pk_to_ref[pk]
+            self.free.append(ref)
+            row = tuple(
+                None if not self.arena.valid[i][ref]
+                else (self.arena.cols[i][ref].item()
+                      if self.schema[i].data_type.is_device
+                      else self.arena.cols[i][ref])
+                for i in range(len(self.schema)))
+            self.table.delete(row)
+        cap = next_pow2(n_dead)
+        del_refs = np.zeros(cap, dtype=np.int32)
+        del_refs[:n_dead] = dead_refs
+        mask = np.zeros(cap, dtype=bool)
+        mask[:n_dead] = True
+        self.kernel.delete(del_refs, jnp.asarray(mask))
+        return n_dead
+
     def recover(self) -> None:
         keys_l, refs_l = [], []
         rows: List[tuple] = []
@@ -300,6 +341,13 @@ class HashJoinExecutor(Executor):
             [n_left + i for i in right_table.pk_indices]
         super().__init__(ExecutorInfo(
             out_schema, pk, f"HashJoinExecutor(actor={actor_id})"))
+        self.n_left = n_left
+        # join-key watermarks (hash_join.rs:860-945): per side, latest
+        # watermark per key POSITION; the forwarded/cleaning watermark
+        # is the min across sides, monotone
+        self._side_wm: List[Dict[int, int]] = [{}, {}]
+        self._combined_wm: Dict[int, int] = {}
+        self._expired_wm: Dict[int, int] = {}
 
     # -- emission ---------------------------------------------------------
     def _emit(self, side_idx: int, chunk: StreamChunk,
@@ -341,6 +389,44 @@ class HashJoinExecutor(Executor):
         out_vis[:t] = True
         return StreamChunk(self.schema, columns, out_vis, ops)
 
+    # -- watermarks -------------------------------------------------------
+    def _on_watermark(self, side_idx: int, msg: "Watermark"):
+        """Join-key watermarks combine as min across sides and forward
+        for BOTH output columns of the key pair (they are equal by the
+        join predicate); non-key watermarks are dropped (reference
+        behavior). The combined watermark also drives state expiry at
+        the next barrier."""
+        me = self.sides[side_idx]
+        if msg.col_idx not in me.key_indices:
+            return
+        pos = me.key_indices.index(msg.col_idx)
+        self._side_wm[side_idx][pos] = msg.value
+        other_wm = self._side_wm[1 - side_idx].get(pos)
+        if other_wm is None:
+            return
+        combined = min(msg.value, other_wm)
+        prev = self._combined_wm.get(pos)
+        if prev is not None and combined <= prev:
+            return
+        self._combined_wm[pos] = combined
+        left_col = self.sides[0].key_indices[pos]
+        right_col = self.n_left + self.sides[1].key_indices[pos]
+        yield Watermark(left_col, msg.data_type, combined)
+        yield Watermark(right_col, msg.data_type, combined)
+
+    def _expire_state(self) -> None:
+        for pos, wm in self._combined_wm.items():
+            done = self._expired_wm.get(pos)
+            if done is not None and wm <= done:
+                continue
+            dt = np.dtype(
+                self.sides[0].key_types[pos].np_dtype)
+            if not np.issubdtype(dt, np.integer):
+                continue       # float keys: no order-safe expiry
+            for side in self.sides:
+                side.expire_below(pos, int(wm))
+            self._expired_wm[pos] = wm
+
     # -- main loop --------------------------------------------------------
     async def execute(self) -> AsyncIterator[Message]:
         lit = self.left_in.execute()
@@ -355,13 +441,14 @@ class HashJoinExecutor(Executor):
         yield first_l
         async for tag, msg in barrier_align_2(lit, rit):
             if tag == "barrier":
+                self._expire_state()
                 for side in self.sides:
                     side.table.commit(msg.epoch)
                     side.maybe_compact()
                 yield msg
             elif tag in ("left", "right"):
+                i = 0 if tag == "left" else 1
                 if isinstance(msg, StreamChunk):
-                    i = 0 if tag == "left" else 1
                     # one host→device upload of the key lanes, shared by
                     # the probe and this side's insert
                     lanes_dev = jnp.asarray(build_key_lanes(
@@ -370,4 +457,6 @@ class HashJoinExecutor(Executor):
                     if out is not None:
                         yield out
                     self.sides[i].apply_chunk(msg, lanes_dev)
-                # watermarks: forwarded only for join-key cols — deferred
+                elif isinstance(msg, Watermark):
+                    for wm in self._on_watermark(i, msg):
+                        yield wm

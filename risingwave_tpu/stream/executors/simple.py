@@ -63,7 +63,11 @@ class ProjectExecutor(Executor):
             out_fields.append(Field(name, e.return_type))
         info = ExecutorInfo(Schema(out_fields), [], "ProjectExecutor")
         super().__init__(info)
-        # input col idx -> output col idx, for passing watermarks through
+        # input col idx -> output col idx OR (output col idx, transform)
+        # for a monotone expression over the watermark column (the
+        # reference derives output watermarks through monotone exprs,
+        # watermark.rs::transform_with_expr — e.g. tumble_start maps a
+        # date_time watermark to a window_start watermark)
         self.watermark_derivations = dict(watermark_derivations or {})
 
     async def execute(self) -> AsyncIterator[Message]:
@@ -72,9 +76,14 @@ class ProjectExecutor(Executor):
                 cols = [e.eval(msg) for e in self.exprs]
                 yield StreamChunk(self.schema, cols, msg.visibility, msg.ops)
             elif isinstance(msg, Watermark):
-                if msg.col_idx in self.watermark_derivations:
-                    yield msg.with_idx(
-                        self.watermark_derivations[msg.col_idx])
+                d = self.watermark_derivations.get(msg.col_idx)
+                if d is not None:
+                    if isinstance(d, tuple):
+                        out_idx, fn = d
+                        yield Watermark(out_idx, msg.data_type,
+                                        fn(msg.value))
+                    else:
+                        yield msg.with_idx(d)
                 # underivable watermarks are dropped (reference behavior)
             else:
                 yield msg

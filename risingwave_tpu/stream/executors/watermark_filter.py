@@ -92,13 +92,18 @@ class WatermarkFilterExecutor(Executor):
         vis = np.asarray(chunk.visibility)
         ok = vis if c.validity is None else \
             vis & np.asarray(c.validity)
+        # a row is late only relative to the watermark already EMITTED
+        # (before this chunk) — filtering against the watermark derived
+        # from this very chunk's max would drop every in-chunk row that
+        # precedes the max, i.e. nearly everything under a small delay
+        prev_wm = self.current
         if ok.any():
             mx = int(ts[ok].max()) - self.delay
             if self.current is None or mx > self.current:
                 self.current = mx
-        if self.current is None:
+        if prev_wm is None:
             return chunk
-        late = ok & (ts < self.current)
+        late = ok & (ts < prev_wm)
         if not late.any():
             return chunk
         new_vis = vis & ~late

@@ -186,6 +186,9 @@ class StreamingMetrics:
             "dirty groups at last flush")
         self.agg_table_capacity = r.gauge(
             "stream_agg_table_capacity", "device hash-table slots")
+        self.agg_rows_cleaned = r.counter(
+            "stream_agg_state_rows_cleaned",
+            "state rows deleted by watermark cleaning")
         self.actor_count = r.gauge("stream_actor_count", "live actors")
         self.checkpoint_count = r.counter(
             "meta_checkpoint_count", "committed checkpoints")

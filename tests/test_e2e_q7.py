@@ -55,6 +55,44 @@ def test_q7_end_to_end():
     assert got == expect
 
 
+def test_q7_watermark_cleaning_bounded_state():
+    """Watermark-driven state cleaning end to end (VERDICT r2 #3):
+    with a WatermarkFilter generating event-time watermarks and the agg
+    retiring closed tumble windows, (a) the MV still matches the oracle
+    exactly — nexmark event time is monotone, so no rows are late and
+    retirement never changes results — and (b) the agg value-state table
+    holds only the open windows at the end, not every window ever seen."""
+    from risingwave_tpu.common.types import Interval
+
+    n_epochs = 60
+    # gap 0.2s/event ⇒ a 10s window every 50 events: many windows
+    cfg = NexmarkConfig(event_num=50 * 30 * n_epochs, max_chunk_size=512,
+                        min_event_gap_in_ns=200_000_000)
+    pipeline = build_q7(MemoryStateStore(), cfg, rate_limit=2,
+                        watermark_delay=Interval(usecs=0))
+    n_bids = 46 * 30 * n_epochs
+    asyncio.run(drive_to_completion(pipeline, {1: n_bids}))
+
+    got = {row[0]: (row[1], row[2]) for _pk, row in
+           pipeline.mv_table.iter_rows()}
+    expect = q7_oracle(cfg, n_bids)
+    assert len(expect) > 10            # many windows closed over the run
+    assert got == expect               # retirement never changed results
+
+    # the agg's VALUE STATE kept only windows at/after the final
+    # watermark — closed windows were deleted (mv keeps final results)
+    agg_executor = pipeline.actor.consumer.input  # materialize ← agg
+    state_rows = list(agg_executor.table.iter_rows())
+    assert len(state_rows) < len(expect) / 2, \
+        (len(state_rows), len(expect))
+    final_wm = agg_executor._cleaned_wm
+    assert final_wm is not None
+    assert all(row[0] >= final_wm for _pk, row in state_rows)
+    # device table occupancy bounded too (survivors only)
+    occ = int(np.asarray(agg_executor.kernel.state.table.occ).sum())
+    assert occ <= len(state_rows) + 1
+
+
 def test_q7_on_hummock_with_restart(tmp_path):
     """The full stack: pipeline state checkpoints through HummockLite on
     a local-FS object store; a fresh process-equivalent (new store over

