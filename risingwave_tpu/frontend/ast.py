@@ -77,6 +77,7 @@ FromItem = object            # TableRef | Tumble
 class Join:
     item: FromItem
     on: Expr
+    kind: str = "inner"   # inner|left|right|full (OUTER implied)
 
 
 @dataclass

@@ -30,7 +30,7 @@ KEYWORDS = {
     "false", "if", "exists", "flush", "second", "seconds", "minute",
     "minutes", "hour", "hours", "day", "days", "millisecond",
     "milliseconds", "case", "when", "then", "else", "end", "cast",
-    "sink", "sinks",
+    "sink", "sinks", "left", "right", "full", "outer",
 }
 
 # keywords that can never start a primary expression (a column named
@@ -219,10 +219,21 @@ class Parser:
         joins: List[ast.Join] = []
         if self._kw("from"):
             from_item = self._from_item()
-            while self._kw("join") or self._kw("inner", "join"):
+            while True:
+                kind = None
+                if self._kw("join") or self._kw("inner", "join"):
+                    kind = "inner"
+                else:
+                    for k in ("left", "right", "full"):
+                        if self._kw(k, "outer", "join") \
+                                or self._kw(k, "join"):
+                            kind = k
+                            break
+                if kind is None:
+                    break
                 item = self._from_item()
                 self._expect_kw("on")
-                joins.append(ast.Join(item, self._expr()))
+                joins.append(ast.Join(item, self._expr(), kind))
         where = self._expr() if self._kw("where") else None
         group_by: List[ast.Expr] = []
         if self._kw("group", "by"):

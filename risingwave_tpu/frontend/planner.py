@@ -33,7 +33,9 @@ from risingwave_tpu.stream.executor import Executor
 from risingwave_tpu.stream.executors.hash_agg import (
     AggCall, HashAggExecutor, agg_state_schema,
 )
-from risingwave_tpu.stream.executors.hash_join import HashJoinExecutor
+from risingwave_tpu.stream.executors.hash_join import (
+    HashJoinExecutor, JoinType,
+)
 from risingwave_tpu.stream.executors.materialize import MaterializeExecutor
 from risingwave_tpu.stream.executors.row_id_gen import RowIdGenExecutor
 from risingwave_tpu.stream.executors.simple import (
@@ -225,8 +227,11 @@ class StreamPlanner:
                             dist_key_indices=None)
             rt = StateTable(self.catalog.next_id(), right.schema,
                             [len(right.schema) - 1], self.store)
+            jt = {"inner": JoinType.INNER, "left": JoinType.LEFT_OUTER,
+                  "right": JoinType.RIGHT_OUTER,
+                  "full": JoinType.FULL_OUTER}[jn.kind]
             ex = HashJoinExecutor(left, right, lkeys, rkeys, lt, rt,
-                                  actor_id=actor_id)
+                                  actor_id=actor_id, join_type=jt)
             scope = lscope.concat(rscope)
             join_pk_cols = [n_l - 1, n_l + len(right.schema) - 1]
         if sel.where is not None:

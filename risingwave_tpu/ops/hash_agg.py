@@ -163,6 +163,23 @@ class AggSpec:
         return (hi, lo, nn32)
 
 
+def encode_host_accs(specs: Sequence[AggSpec],
+                     acc_cols: Sequence[np.ndarray]) -> List[np.ndarray]:
+    """HOST state-row acc columns (acc_dtypes layout: per call value
+    [+ nn]) → device-layout columns, for recovery rebuilds (shared by
+    the single-chip and sharded kernels)."""
+    out: List[np.ndarray] = []
+    j = 0
+    for s in specs:
+        if s.kind == AggKind.COUNT:
+            out.extend(s.encode_acc(acc_cols[j], None))
+            j += 1
+        else:
+            out.extend(s.encode_acc(acc_cols[j], acc_cols[j + 1]))
+            j += 2
+    return out
+
+
 def acc_dtypes(specs: Sequence[AggSpec]) -> List[np.dtype]:
     """HOST (state-row) accumulator columns: per call value [+ nn]."""
     out: List[np.dtype] = []
@@ -878,15 +895,7 @@ class GroupedAggKernel:
         self._backlog_rows = 0
         if n == 0:
             return
-        dev_cols: List[np.ndarray] = []
-        j = 0
-        for s in self.specs:
-            if s.kind == AggKind.COUNT:
-                dev_cols.extend(s.encode_acc(acc_cols[j], None))
-                j += 1
-            else:
-                dev_cols.extend(s.encode_acc(acc_cols[j], acc_cols[j + 1]))
-                j += 2
+        dev_cols = encode_host_accs(self.specs, acc_cols)
         table, slots, _ = ht._probe_insert_jit(
             self.state.table, jnp.asarray(keys), jnp.ones(n, dtype=bool))
         accs = tuple(a.at[slots].set(jnp.asarray(col))

@@ -32,7 +32,8 @@ from risingwave_tpu.ops import lanes
 from risingwave_tpu.ops.hash_agg import (
     AggSpec, AggState, FlushResult, _call_slices, _update_call,
     advance_state, decode_flush_data, decode_outputs, dev_layout,
-    gather_packed, make_agg_state, n_input_lanes, retire_state,
+    encode_host_accs, gather_packed, make_agg_state, n_input_lanes,
+    retire_state,
 )
 from risingwave_tpu.parallel.exchange import (
     bucketize_by_owner, exchange, vnodes_from_lanes,
@@ -361,19 +362,17 @@ class ShardedAggKernel:
         self._counters.reset(np.zeros(self.n_dev, dtype=np.int64))
         if n == 0:
             return
-        dev_cols: List[np.ndarray] = []
-        j = 0
-        for s in self.specs:
-            from risingwave_tpu.ops.hash_agg import AggKind
-            if s.kind == AggKind.COUNT:
-                dev_cols.extend(s.encode_acc(acc_cols[j], None))
-                j += 1
-            else:
-                dev_cols.extend(s.encode_acc(acc_cols[j], acc_cols[j + 1]))
-                j += 2
+        dev_cols = encode_host_accs(self.specs, acc_cols)
         vn = np.asarray(vnodes_from_lanes(jnp.asarray(keys)))
         owner = np.asarray(self.owner_map)[vn]
         per_shard = np.bincount(owner, minlength=self.n_dev)
+        worst = int(per_shard.max(initial=0))
+        if worst > ht.MAX_LOAD * self.capacity:
+            # probe_insert's free-slot contract: an over-full shard
+            # would scatter rows into other groups' slots silently
+            raise RuntimeError(
+                f"sharded rebuild overfills a shard: {worst} groups vs "
+                f"{self.capacity} slots — raise capacity")
         m = next_pow2(int(per_shard.max(initial=1)))
         # stack into [n_dev, m, ...] padded blocks
         order = np.argsort(owner, kind="stable")

@@ -39,8 +39,7 @@ from risingwave_tpu.ops.hash_agg import (
 from risingwave_tpu.state.state_table import StateTable
 from risingwave_tpu.stream.executor import Executor, ExecutorInfo
 from risingwave_tpu.stream.executors.keys import (
-    LANES_PER_KEY as _LANES_PER_KEY, build_key_lanes, decode_key_lanes,
-    key_lanes_of_values,
+    LANES_PER_KEY as _LANES_PER_KEY, KeyCodec,
 )
 from risingwave_tpu.stream.message import (
     Barrier, Message, Watermark, is_barrier, is_chunk, is_watermark,
@@ -140,10 +139,9 @@ class HashAggExecutor(Executor):
         in_schema = input_.schema
         self.group_types = [in_schema[i].data_type
                             for i in self.group_indices]
-        for dt in self.group_types:
-            if not dt.is_device:
-                raise TypeError(
-                    f"group key type {dt} not device-hashable yet")
+        # varchar/host-typed group keys go through the exact interning
+        # codec (keys.py KeyCodec; key.rs:647 KeySerialized parity)
+        self.key_codec = KeyCodec(self.group_types)
         self.specs = [c.spec(in_schema) for c in self.agg_calls]
         # retractable MIN/MAX: device extremes go stale on deletes; the
         # materialized-input tables (minput.rs analog) let the flush
@@ -204,7 +202,7 @@ class HashAggExecutor(Executor):
         return tuple(out)
 
     def _apply_chunk(self, chunk: StreamChunk) -> None:
-        key_lanes = build_key_lanes(chunk, self.group_indices)
+        key_lanes = self.key_codec.build(chunk, self.group_indices)
         signs = np.asarray(chunk.signs())
         vis = np.asarray(chunk.visibility)
         if self.minput:
@@ -290,7 +288,7 @@ class HashAggExecutor(Executor):
     def _group_key_host(self, keys: np.ndarray
                         ) -> List[Tuple[np.ndarray, np.ndarray]]:
         """Key lanes → per group col (values in col dtype, valid mask)."""
-        return decode_key_lanes(keys, self.group_types)
+        return self.key_codec.decode(keys)
 
     def _flush(self) -> Optional[StreamChunk]:
         fr = self.kernel.flush()
@@ -433,7 +431,7 @@ class HashAggExecutor(Executor):
         accs_l: List[tuple] = []
         ng = len(self.group_indices)
         for _pk, row in self.table.iter_rows():
-            keys_l.append(key_lanes_of_values(row[:ng], self.group_types))
+            keys_l.append(self.key_codec.lanes_of_values(row[:ng]))
             rows_l.append(int(row[ng]))
             accs_l.append(row[ng + 1:])
         if not rows_l:

@@ -28,10 +28,70 @@ next increment.
 
 from __future__ import annotations
 
+import enum
 from typing import AsyncIterator, Dict, List, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np
+
+
+class JoinType(enum.Enum):
+    """The 8 streaming join types (hash_join.rs:61-71 const generics).
+
+    Outer sides track per-stored-row match DEGREES. The reference
+    persists degree state tables (managed_state/join/mod.rs:228); here
+    degrees are a host int64 array parallel to the arena, recomputed on
+    recovery by ONE batch probe of the recovered keys against the other
+    side — the degree is a pure function of both sides' state, so
+    persisting it buys nothing but write amplification.
+    """
+
+    INNER = "inner"
+    LEFT_OUTER = "left_outer"
+    RIGHT_OUTER = "right_outer"
+    FULL_OUTER = "full_outer"
+    LEFT_SEMI = "left_semi"
+    LEFT_ANTI = "left_anti"
+    RIGHT_SEMI = "right_semi"
+    RIGHT_ANTI = "right_anti"
+
+    @property
+    def is_semi_or_anti(self) -> bool:
+        return self in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI,
+                        JoinType.RIGHT_SEMI, JoinType.RIGHT_ANTI)
+
+    @property
+    def is_anti(self) -> bool:
+        return self in (JoinType.LEFT_ANTI, JoinType.RIGHT_ANTI)
+
+    @property
+    def subject(self) -> Optional[int]:
+        """Side whose rows a semi/anti join emits (0=left, 1=right)."""
+        if self in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
+            return 0
+        if self in (JoinType.RIGHT_SEMI, JoinType.RIGHT_ANTI):
+            return 1
+        return None
+
+    @property
+    def tracked_sides(self) -> tuple:
+        """Sides whose stored rows need degree maintenance."""
+        if self == JoinType.LEFT_OUTER:
+            return (0,)
+        if self == JoinType.RIGHT_OUTER:
+            return (1,)
+        if self == JoinType.FULL_OUTER:
+            return (0, 1)
+        if self.is_semi_or_anti:
+            return (self.subject,)
+        return ()
+
+    def outer_on(self, side: int) -> bool:
+        """Does `side` emit NULL-padded rows when unmatched?"""
+        if self == JoinType.FULL_OUTER:
+            return True
+        return (self == JoinType.LEFT_OUTER and side == 0) or \
+            (self == JoinType.RIGHT_OUTER and side == 1)
 
 from risingwave_tpu.common.chunk import Column, Op, StreamChunk, next_pow2
 from risingwave_tpu.common.types import Field, Schema
@@ -40,8 +100,7 @@ from risingwave_tpu.state.state_table import StateTable
 from risingwave_tpu.stream.executor import Executor, ExecutorInfo
 from risingwave_tpu.stream.merge import barrier_align_2
 from risingwave_tpu.stream.executors.keys import (
-    LANES_PER_KEY, build_key_lanes, build_key_lanes_arrays,
-    key_lanes_of_values,
+    LANES_PER_KEY, KeyCodec,
 )
 from risingwave_tpu.stream.message import Message, Watermark, is_barrier
 
@@ -106,14 +165,15 @@ class _JoinSide:
     """One side's state: device matcher + host arena + durability."""
 
     def __init__(self, schema: Schema, key_indices: Sequence[int],
-                 pk_indices: Sequence[int], table: StateTable):
+                 pk_indices: Sequence[int], table: StateTable,
+                 key_codec: KeyCodec):
         self.schema = schema
         self.key_indices = list(key_indices)
         self.pk_indices = list(pk_indices)
         self.key_types = [schema[i].data_type for i in self.key_indices]
-        for dt in self.key_types:
-            if not dt.is_device:
-                raise TypeError(f"join key type {dt} not device-hashable")
+        # SHARED with the other side: equal values must get equal
+        # interned ids or varchar keys would never match
+        self.key_codec = key_codec
         self.table = table
         self.kernel = JoinSideKernel(
             key_width=LANES_PER_KEY * len(self.key_indices))
@@ -121,6 +181,24 @@ class _JoinSide:
         self.pk_to_ref: Dict[tuple, int] = {}
         self.free: List[int] = []
         self.next_ref = 0
+        # per-ref match degree (outer/semi/anti bookkeeping; see
+        # JoinType docstring) — grown alongside the arena
+        self.degrees = np.zeros(self.arena.cap, dtype=np.int64)
+
+    def ensure_degrees(self, max_ref: int) -> None:
+        if max_ref < len(self.degrees):
+            return
+        grown = np.zeros(self.arena.cap, dtype=np.int64)
+        grown[:len(self.degrees)] = self.degrees
+        self.degrees = grown
+
+    def row_tuple(self, ref: int) -> tuple:
+        return tuple(
+            None if not self.arena.valid[i][ref]
+            else (self.arena.cols[i][ref].item()
+                  if self.schema[i].data_type.is_device
+                  else self.arena.cols[i][ref])
+            for i in range(len(self.schema)))
 
     def alloc_refs(self, k: int) -> np.ndarray:
         """Bump allocation ONLY: a tombstoned ref stays linked in its
@@ -138,10 +216,17 @@ class _JoinSide:
             c = chunk.columns[i]
             if c.validity is not None:
                 m &= np.asarray(c.validity)
+            if not c.data_type.is_device:
+                # host-typed columns carry NULL as the None object
+                vals = np.asarray(c.values)
+                m &= np.fromiter(
+                    (isinstance(v, (str, bytes)) for v in vals.tolist()),
+                    dtype=bool, count=chunk.capacity)
         return m
 
-    def apply_chunk(self, chunk: StreamChunk,
-                    key_lanes: np.ndarray) -> None:
+    def apply_chunk(self, chunk: StreamChunk, key_lanes: np.ndarray,
+                    nonnull: Optional[np.ndarray] = None
+                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Update this side's state with the chunk's inserts/deletes.
 
         pk→ref bookkeeping runs in ROW ORDER (a delete refers to the
@@ -150,7 +235,9 @@ class _JoinSide:
         device calls stay whole-batch: tombstoning and front-linking
         commute once each delete has resolved to the right ref."""
         vis = np.asarray(chunk.visibility)
-        storable = vis & self.key_nonnull_mask(chunk)
+        if nonnull is None:
+            nonnull = self.key_nonnull_mask(chunk)
+        storable = vis & nonnull
         ops = np.asarray(chunk.ops)
         is_ins = (ops == int(Op.INSERT)) | (ops == int(Op.UPDATE_INSERT))
         ins_idx = np.flatnonzero(storable & is_ins)
@@ -186,6 +273,7 @@ class _JoinSide:
                 self.free.append(ref)
         if len(ins_idx):
             self.arena.store(ins_refs, chunk, ins_idx)
+            self.ensure_degrees(int(ins_refs.max()))
             full_refs = np.zeros(chunk.capacity, dtype=np.int32)
             full_refs[ins_idx] = ins_refs
             mask = np.zeros(chunk.capacity, dtype=bool)
@@ -195,6 +283,7 @@ class _JoinSide:
         if del_mask.any():
             self.kernel.delete(del_refs, jnp.asarray(del_mask))
         self.table.write_chunk(chunk)
+        return ins_idx, ins_refs, del_mask
 
     # dead-ref fraction of the arena that triggers a compaction; dead
     # refs cannot be recycled in place (see alloc_refs), so churn-heavy
@@ -219,7 +308,10 @@ class _JoinSide:
         for i in range(len(self.schema)):
             new_arena.cols[i][:n] = self.arena.cols[i][live]
             new_arena.valid[i][:n] = self.arena.valid[i][live]
+        new_degrees = np.zeros(new_arena.cap, dtype=np.int64)
+        new_degrees[:n] = self.degrees[live]
         self.arena = new_arena
+        self.degrees = new_degrees
         new_refs = np.arange(n, dtype=np.int32)
         self.pk_to_ref = dict(zip(self.pk_to_ref.keys(), new_refs.tolist()))
         self.free = []
@@ -227,7 +319,7 @@ class _JoinSide:
         if n:
             key_cols = [(self.arena.cols[i][:n], self.arena.valid[i][:n])
                         for i in self.key_indices]
-            self.kernel.rebuild(build_key_lanes_arrays(key_cols), new_refs)
+            self.kernel.rebuild(self.key_codec.build_arrays(key_cols), new_refs)
         else:
             self.kernel.rebuild(
                 np.zeros((0, LANES_PER_KEY * len(self.key_indices)),
@@ -260,13 +352,7 @@ class _JoinSide:
         for pk, ref in zip(dead_pks, dead_refs.tolist()):
             del self.pk_to_ref[pk]
             self.free.append(ref)
-            row = tuple(
-                None if not self.arena.valid[i][ref]
-                else (self.arena.cols[i][ref].item()
-                      if self.schema[i].data_type.is_device
-                      else self.arena.cols[i][ref])
-                for i in range(len(self.schema)))
-            self.table.delete(row)
+            self.table.delete(self.row_tuple(ref))
         cap = next_pow2(n_dead)
         del_refs = np.zeros(cap, dtype=np.int32)
         del_refs[:n_dead] = dead_refs
@@ -300,8 +386,8 @@ class _JoinSide:
         for row, ref in zip(rows, refs.tolist()):
             pk = tuple(row[i] for i in self.pk_indices)
             self.pk_to_ref[pk] = ref
-            keys_l.append(key_lanes_of_values(
-                [row[i] for i in self.key_indices], self.key_types))
+            keys_l.append(self.key_codec.lanes_of_values(
+                [row[i] for i in self.key_indices]))
         # rows with NULL join keys were never stored on device
         keep = [j for j, row in enumerate(rows)
                 if all(row[i] is not None for i in self.key_indices)]
@@ -317,30 +403,44 @@ class HashJoinExecutor(Executor):
                  left_keys: Sequence[int], right_keys: Sequence[int],
                  left_table: StateTable, right_table: StateTable,
                  actor_id: int = 0,
-                 output_names: Optional[Sequence[str]] = None):
+                 output_names: Optional[Sequence[str]] = None,
+                 join_type: JoinType = JoinType.INNER):
         assert len(left_keys) == len(right_keys)
         self.left_in, self.right_in = left, right
+        self.join_type = join_type
+        key_codec = KeyCodec(
+            [left.schema[i].data_type for i in left_keys])
         self.sides = (
             _JoinSide(left.schema, left_keys, left_table.pk_indices,
-                      left_table),
+                      left_table, key_codec),
             _JoinSide(right.schema, right_keys, right_table.pk_indices,
-                      right_table),
+                      right_table, key_codec),
         )
-        fields: List[Field] = []
-        names = list(output_names) if output_names else None
-        k = 0
-        for sch in (left.schema, right.schema):
-            for f in sch:
-                name = names[k] if names else f.name
-                fields.append(Field(name, f.data_type))
-                k += 1
-        out_schema = Schema(fields)
-        # output pk: both sides' pks (joined row identity)
         n_left = len(left.schema)
-        pk = list(left_table.pk_indices) + \
-            [n_left + i for i in right_table.pk_indices]
+        names = list(output_names) if output_names else None
+        subj = join_type.subject
+        if subj is not None:
+            # semi/anti: output is the subject side's schema alone
+            src = (left if subj == 0 else right).schema
+            fields = [Field(names[i] if names else f.name, f.data_type)
+                      for i, f in enumerate(src)]
+            pk = list((left_table if subj == 0
+                       else right_table).pk_indices)
+        else:
+            fields = []
+            k = 0
+            for sch in (left.schema, right.schema):
+                for f in sch:
+                    fields.append(Field(names[k] if names else f.name,
+                                        f.data_type))
+                    k += 1
+            # output pk: both sides' pks (joined row identity)
+            pk = list(left_table.pk_indices) + \
+                [n_left + i for i in right_table.pk_indices]
+        out_schema = Schema(fields)
         super().__init__(ExecutorInfo(
-            out_schema, pk, f"HashJoinExecutor(actor={actor_id})"))
+            out_schema, pk,
+            f"HashJoinExecutor({join_type.value}, actor={actor_id})"))
         self.n_left = n_left
         # join-key watermarks (hash_join.rs:860-945): per side, latest
         # watermark per key POSITION; the forwarded/cleaning watermark
@@ -350,44 +450,189 @@ class HashJoinExecutor(Executor):
         self._expired_wm: Dict[int, int] = {}
 
     # -- emission ---------------------------------------------------------
-    def _emit(self, side_idx: int, chunk: StreamChunk,
-              key_lanes: np.ndarray) -> Optional[StreamChunk]:
-        """Probe the OTHER side and build the matched output chunk."""
-        me = self.sides[side_idx]
-        other = self.sides[1 - side_idx]
-        vis = np.asarray(chunk.visibility) & me.key_nonnull_mask(chunk)
-        if not vis.any():
-            return None
-        _deg, probe_idx, refs = other.kernel.probe(
-            jnp.asarray(key_lanes), jnp.asarray(vis))
-        t = len(probe_idx)
-        if t == 0:
-            return None
-        cap = next_pow2(t)
-        # my columns: gathered from the incoming chunk
-        my_cols: List[Column] = []
-        for f, c in zip(me.schema, chunk.columns):
-            src = np.asarray(c.values)[probe_idx]
+    @staticmethod
+    def _chunk_cols(schema: Schema, chunk: StreamChunk,
+                    idx: np.ndarray, cap: int) -> List[Column]:
+        """Columns gathered from incoming-chunk rows `idx`."""
+        t = len(idx)
+        out: List[Column] = []
+        for f, c in zip(schema, chunk.columns):
+            src = np.asarray(c.values)[idx]
             vals = np.zeros(cap, dtype=src.dtype) if src.dtype != object \
                 else np.empty(cap, dtype=object)
             vals[:t] = src
             ok = np.ones(cap, dtype=bool)
             if c.validity is not None:
-                ok[:t] = np.asarray(c.validity)[probe_idx]
-            my_cols.append(Column(f.data_type, vals,
-                                  None if ok.all() else ok))
-        other_cols = other.arena.gather(refs, cap)
+                ok[:t] = np.asarray(c.validity)[idx]
+            out.append(Column(f.data_type, vals,
+                              None if ok.all() else ok))
+        return out
+
+    @staticmethod
+    def _null_cols(schema: Schema, cap: int) -> List[Column]:
+        out: List[Column] = []
+        for f in schema:
+            dt = f.data_type
+            vals = np.zeros(cap, dtype=dt.np_dtype) if dt.is_device \
+                else np.empty(cap, dtype=object)
+            out.append(Column(dt, vals, np.zeros(cap, dtype=bool)))
+        return out
+
+    def _compose(self, side_idx: int, my_cols: List[Column],
+                 other_cols: List[Column], ops: np.ndarray,
+                 t: int, cap: int) -> StreamChunk:
         columns = my_cols + other_cols if side_idx == 0 \
             else other_cols + my_cols
-        # ops: degrade update pairs (split halves) to Delete/Insert
-        in_ops = np.asarray(chunk.ops)[probe_idx]
-        is_ins = (in_ops == int(Op.INSERT)) | \
-            (in_ops == int(Op.UPDATE_INSERT))
-        ops = np.full(cap, int(Op.INSERT), dtype=np.int8)
-        ops[:t] = np.where(is_ins, int(Op.INSERT), int(Op.DELETE))
         out_vis = np.zeros(cap, dtype=bool)
         out_vis[:t] = True
-        return StreamChunk(self.schema, columns, out_vis, ops)
+        full_ops = np.full(cap, int(Op.INSERT), dtype=np.int8)
+        full_ops[:t] = ops[:t]
+        return StreamChunk(self.schema, columns, out_vis, full_ops)
+
+    @staticmethod
+    def _ops_of(chunk: StreamChunk, idx: np.ndarray) -> np.ndarray:
+        """Degrade update pairs (split halves) to Delete/Insert — the
+        reference degrades split pairs the same way."""
+        in_ops = np.asarray(chunk.ops)[idx]
+        is_ins = (in_ops == int(Op.INSERT)) | \
+            (in_ops == int(Op.UPDATE_INSERT))
+        return np.where(is_ins, int(Op.INSERT),
+                        int(Op.DELETE)).astype(np.int8)
+
+    def _pairs_chunk(self, side_idx: int, chunk: StreamChunk,
+                     probe_idx: np.ndarray, refs: np.ndarray
+                     ) -> StreamChunk:
+        t = len(probe_idx)
+        cap = next_pow2(t)
+        me = self.sides[side_idx]
+        other = self.sides[1 - side_idx]
+        return self._compose(
+            side_idx, self._chunk_cols(me.schema, chunk, probe_idx, cap),
+            other.arena.gather(refs, cap),
+            self._ops_of(chunk, probe_idx), t, cap)
+
+    def _padded_from_chunk(self, side_idx: int, chunk: StreamChunk,
+                           idx: np.ndarray) -> StreamChunk:
+        """(row, NULLs) for unmatched rows of an outer incoming side."""
+        t = len(idx)
+        cap = next_pow2(t)
+        me = self.sides[side_idx]
+        other = self.sides[1 - side_idx]
+        return self._compose(
+            side_idx, self._chunk_cols(me.schema, chunk, idx, cap),
+            self._null_cols(other.schema, cap),
+            self._ops_of(chunk, idx), t, cap)
+
+    def _padded_from_arena(self, side_idx: int, refs: np.ndarray,
+                           op: Op) -> StreamChunk:
+        """(stored row, NULLs) for degree transitions of an outer side."""
+        t = len(refs)
+        cap = next_pow2(t)
+        me = self.sides[side_idx]
+        other = self.sides[1 - side_idx]
+        ops = np.full(cap, int(op), dtype=np.int8)
+        return self._compose(
+            side_idx, me.arena.gather(refs, cap),
+            self._null_cols(other.schema, cap), ops, t, cap)
+
+    def _subject_from_chunk(self, chunk: StreamChunk,
+                            idx: np.ndarray) -> StreamChunk:
+        t = len(idx)
+        cap = next_pow2(t)
+        cols = self._chunk_cols(
+            self.sides[self.join_type.subject].schema, chunk, idx, cap)
+        vis = np.zeros(cap, dtype=bool)
+        vis[:t] = True
+        ops = np.full(cap, int(Op.INSERT), dtype=np.int8)
+        ops[:t] = self._ops_of(chunk, idx)
+        return StreamChunk(self.schema, cols, vis, ops)
+
+    def _subject_from_arena(self, refs: np.ndarray, op: Op
+                            ) -> StreamChunk:
+        subj = self.join_type.subject
+        t = len(refs)
+        cap = next_pow2(t)
+        cols = self.sides[subj].arena.gather(refs, cap)
+        vis = np.zeros(cap, dtype=bool)
+        vis[:t] = True
+        ops = np.full(cap, int(op), dtype=np.int8)
+        return StreamChunk(self.schema, cols, vis, ops)
+
+    def _process_chunk(self, side_idx: int, chunk: StreamChunk,
+                       key_lanes) -> List[StreamChunk]:
+        """One chunk on side S: probe O, emit per join type, apply to S.
+
+        Emission per eq_join_oneside (hash_join.rs:990) generalized to
+        the degree-transition rule: a stored outer row flips its
+        NULL-padded emission exactly when its match degree crosses zero
+        (net per-chunk delta vs the old degree — intermediate flips
+        within one chunk cancel, leaving the same multiset)."""
+        jt = self.join_type
+        me = self.sides[side_idx]
+        other = self.sides[1 - side_idx]
+        vis = np.asarray(chunk.visibility)
+        nonnull = me.key_nonnull_mask(chunk)
+        probe_vis = vis & nonnull
+        n = chunk.capacity
+        deg = np.zeros(n, dtype=np.int64)
+        probe_idx = np.zeros(0, dtype=np.int32)
+        refs = np.zeros(0, dtype=np.int32)
+        if probe_vis.any():
+            deg_p, probe_idx, refs = other.kernel.probe(
+                jnp.asarray(key_lanes), jnp.asarray(probe_vis))
+            deg[:len(deg_p)] = deg_p
+        outs: List[StreamChunk] = []
+        # 1) matched pairs (all types except semi/anti)
+        if jt.subject is None and len(probe_idx):
+            outs.append(self._pairs_chunk(side_idx, chunk, probe_idx,
+                                          refs))
+        # 2) incoming-row direct emissions
+        if jt.outer_on(side_idx):
+            # NULL-key rows of an outer side always emit padded
+            unmatched = np.flatnonzero(vis & ((deg == 0) | ~nonnull))
+            if len(unmatched):
+                outs.append(self._padded_from_chunk(side_idx, chunk,
+                                                    unmatched))
+        elif jt.subject == side_idx:
+            if jt.is_anti:
+                sel = np.flatnonzero(vis & ((deg == 0) | ~nonnull))
+            else:
+                sel = np.flatnonzero(vis & nonnull & (deg > 0))
+            if len(sel):
+                outs.append(self._subject_from_chunk(chunk, sel))
+        # 3) stored-row degree transitions on the other side
+        if (1 - side_idx) in jt.tracked_sides and len(refs):
+            sgn = np.where(self._ops_of(chunk, probe_idx)
+                           == int(Op.INSERT), 1, -1)
+            uref, inv = np.unique(refs, return_inverse=True)
+            delta = np.zeros(len(uref), dtype=np.int64)
+            np.add.at(delta, inv, sgn)
+            old = other.degrees[uref]
+            new = old + delta
+            other.degrees[uref] = new
+            flip_on = uref[(old == 0) & (new > 0)]
+            flip_off = uref[(old > 0) & (new == 0)]
+            if jt.subject is not None:       # semi/anti subject = other
+                on_op = Op.DELETE if jt.is_anti else Op.INSERT
+                off_op = Op.INSERT if jt.is_anti else Op.DELETE
+                if len(flip_on):
+                    outs.append(self._subject_from_arena(flip_on, on_op))
+                if len(flip_off):
+                    outs.append(self._subject_from_arena(flip_off,
+                                                         off_op))
+            else:                            # outer side: padded flips
+                if len(flip_on):
+                    outs.append(self._padded_from_arena(
+                        1 - side_idx, flip_on, Op.DELETE))
+                if len(flip_off):
+                    outs.append(self._padded_from_arena(
+                        1 - side_idx, flip_off, Op.INSERT))
+        # 4) apply to my state (+ initial degrees for stored rows)
+        ins_idx, ins_refs, _dels = me.apply_chunk(chunk, key_lanes,
+                                                  nonnull=nonnull)
+        if side_idx in jt.tracked_sides and len(ins_idx):
+            me.degrees[ins_refs] = deg[ins_idx]
+        return outs
 
     # -- watermarks -------------------------------------------------------
     def _on_watermark(self, side_idx: int, msg: "Watermark"):
@@ -409,10 +654,17 @@ class HashJoinExecutor(Executor):
         if prev is not None and combined <= prev:
             return
         self._combined_wm[pos] = combined
-        left_col = self.sides[0].key_indices[pos]
-        right_col = self.n_left + self.sides[1].key_indices[pos]
-        yield Watermark(left_col, msg.data_type, combined)
-        yield Watermark(right_col, msg.data_type, combined)
+        subj = self.join_type.subject
+        if subj is not None:
+            # semi/anti output is the subject schema alone: one
+            # watermark at the subject's key column index
+            yield Watermark(self.sides[subj].key_indices[pos],
+                            msg.data_type, combined)
+        else:
+            left_col = self.sides[0].key_indices[pos]
+            right_col = self.n_left + self.sides[1].key_indices[pos]
+            yield Watermark(left_col, msg.data_type, combined)
+            yield Watermark(right_col, msg.data_type, combined)
 
     def _expire_state(self) -> None:
         for pos, wm in self._combined_wm.items():
@@ -427,6 +679,32 @@ class HashJoinExecutor(Executor):
                 side.expire_below(pos, int(wm))
             self._expired_wm[pos] = wm
 
+    def _recover_degrees(self) -> None:
+        """Degrees are a pure function of both sides' recovered state:
+        ONE batch probe of the tracked side's keys against the other
+        side's matcher (instead of persisting degree tables — see
+        JoinType docstring)."""
+        for t in self.join_type.tracked_sides:
+            side = self.sides[t]
+            other = self.sides[1 - t]
+            if not side.pk_to_ref:
+                continue
+            refs = np.fromiter(side.pk_to_ref.values(), dtype=np.int64,
+                               count=len(side.pk_to_ref))
+            key_cols = [(side.arena.cols[i][refs],
+                         side.arena.valid[i][refs])
+                        for i in side.key_indices]
+            lanes_ = side.key_codec.build_arrays(key_cols)
+            nonnull = np.ones(len(refs), dtype=bool)
+            for _vals, ok in key_cols:
+                nonnull &= ok
+            deg, _pi, _refs = other.kernel.probe(
+                jnp.asarray(lanes_), jnp.asarray(nonnull))
+            side.ensure_degrees(int(refs.max()))
+            side.degrees[refs] = np.where(nonnull, deg, 0)
+        # NOTE: host-typed arena key cols may contain None for NULL keys
+        # — build_arrays handles them (interner sanitization)
+
     # -- main loop --------------------------------------------------------
     async def execute(self) -> AsyncIterator[Message]:
         lit = self.left_in.execute()
@@ -438,6 +716,7 @@ class HashJoinExecutor(Executor):
         for side in self.sides:
             side.table.init_epoch(first_l.epoch)
             side.recover()
+        self._recover_degrees()
         yield first_l
         async for tag, msg in barrier_align_2(lit, rit):
             if tag == "barrier":
@@ -451,12 +730,10 @@ class HashJoinExecutor(Executor):
                 if isinstance(msg, StreamChunk):
                     # one host→device upload of the key lanes, shared by
                     # the probe and this side's insert
-                    lanes_dev = jnp.asarray(build_key_lanes(
+                    lanes_dev = jnp.asarray(self.sides[i].key_codec.build(
                         msg, self.sides[i].key_indices))
-                    out = self._emit(i, msg, lanes_dev)
-                    if out is not None:
+                    for out in self._process_chunk(i, msg, lanes_dev):
                         yield out
-                    self.sides[i].apply_chunk(msg, lanes_dev)
                 elif isinstance(msg, Watermark):
                     for wm in self._on_watermark(i, msg):
                         yield wm

@@ -5,11 +5,20 @@ three int32 lanes — (hi, lo) bijective split of a 64-bit image of the
 value plus a null-indicator lane (NULL is a distinct key, matching the
 reference's group/join key semantics). Used by HashAgg group keys and
 HashJoin join keys; host twin of the dispatch hashing.
+
+Varchar (and other host-typed) keys: the reference serializes them into
+its HashKey bytes (src/common/src/hash/key.rs:312,647 KeySerialized) so
+equality is exact. The TPU build cannot ship strings to HBM, so a
+``KeyCodec`` INTERNS each distinct value to a dense int64 id — the id
+lanes route/group on device exactly like native ints, and two distinct
+strings can never merge (no hash-collision class at all). The interner
+is per-operator host state, rebuilt on recovery from the state rows it
+decodes.
 """
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -18,6 +27,139 @@ from risingwave_tpu.common.types import DataType
 from risingwave_tpu.ops import lanes
 
 LANES_PER_KEY = 3
+
+
+class Interner:
+    """Exact value↔int64-id bijection for one host-typed key column."""
+
+    def __init__(self) -> None:
+        self.to_id: Dict[object, int] = {}
+        self.values: List[object] = []
+
+    def intern_col(self, vals: np.ndarray) -> np.ndarray:
+        """object array → int64 ids (vectorized over DISTINCT values)."""
+        uniq, inverse = np.unique(vals, return_inverse=True)
+        ids = np.empty(len(uniq), dtype=np.int64)
+        to_id = self.to_id
+        for i, v in enumerate(uniq.tolist()):
+            got = to_id.get(v)
+            if got is None:
+                got = len(self.values)
+                to_id[v] = got
+                self.values.append(v)
+            ids[i] = got
+        return ids[inverse]
+
+    def intern_one(self, v) -> int:
+        got = self.to_id.get(v)
+        if got is None:
+            got = len(self.values)
+            self.to_id[v] = got
+            self.values.append(v)
+        return got
+
+    def lookup(self, ids: np.ndarray) -> np.ndarray:
+        out = np.empty(len(ids), dtype=object)
+        vals = self.values
+        for i, x in enumerate(ids.tolist()):
+            out[i] = vals[x]
+        return out
+
+
+class KeyCodec:
+    """Key-lane builder/decoder for a fixed key-column type list.
+
+    Device-typed columns use the bijective i64 image; host-typed
+    columns (varchar/bytea) go through a per-position Interner. A
+    HashJoin shares ONE codec across both sides so equal strings get
+    equal ids.
+    """
+
+    def __init__(self, types: Sequence[DataType]):
+        self.types = list(types)
+        self.interners: Dict[int, Interner] = {
+            j: Interner() for j, dt in enumerate(self.types)
+            if not dt.is_device}
+
+    def _col_i64(self, j: int, vals: np.ndarray) -> np.ndarray:
+        it = self.interners.get(j)
+        if it is None:
+            return to_i64(vals)
+        return it.intern_col(vals)
+
+    def build(self, chunk: StreamChunk,
+              indices: Sequence[int]) -> np.ndarray:
+        cols = []
+        for i in indices:
+            c = chunk.columns[i]
+            cols.append((np.asarray(c.values),
+                         None if c.validity is None
+                         else np.asarray(c.validity)))
+        return self.build_arrays(cols)
+
+    def build_arrays(self, cols: Sequence[Tuple[np.ndarray, np.ndarray]]
+                     ) -> np.ndarray:
+        n = len(cols[0][0])
+        out = np.empty((n, LANES_PER_KEY * len(cols)), dtype=np.int32)
+        for j, (vals, ok) in enumerate(cols):
+            if j in self.interners:
+                # Host-typed columns carry NULL as the None OBJECT, not
+                # (only) a validity mask — and pad slots of a capacity-
+                # padded chunk are arbitrary. Both must stay out of the
+                # interner and read as null in the valid lane. The fill
+                # must match the column's value type: np.unique sorts,
+                # and str/bytes do not compare.
+                bad = np.fromiter(
+                    (not isinstance(v, (str, bytes))
+                     for v in vals.tolist()), dtype=bool, count=n)
+                ok = (~bad if ok is None else ok & ~bad)
+                if bad.any():
+                    vals = vals.copy()
+                    vals[bad] = b"" if self.types[j] == DataType.BYTEA \
+                        else ""
+            v64 = self._col_i64(j, vals)
+            if ok is not None:
+                v64 = np.where(ok, v64, 0)
+            hi, lo = lanes.split_i64(v64)
+            out[:, LANES_PER_KEY * j] = hi
+            out[:, LANES_PER_KEY * j + 1] = lo
+            out[:, LANES_PER_KEY * j + 2] = \
+                1 if ok is None else ok.astype(np.int32)
+        return out
+
+    def lanes_of_values(self, values: Sequence) -> np.ndarray:
+        lane = np.zeros(LANES_PER_KEY * len(self.types), dtype=np.int32)
+        for j, (v, dt) in enumerate(zip(values, self.types)):
+            if v is None:
+                continue
+            it = self.interners.get(j)
+            if it is not None:
+                v64 = np.asarray([it.intern_one(v)], dtype=np.int64)
+            else:
+                v64 = to_i64(np.asarray([v], dtype=dt.np_dtype))
+            hi, lo = lanes.split_i64(v64)
+            lane[LANES_PER_KEY * j] = hi[0]
+            lane[LANES_PER_KEY * j + 1] = lo[0]
+            lane[LANES_PER_KEY * j + 2] = 1
+        return lane
+
+    def decode(self, keys: np.ndarray
+               ) -> List[Tuple[np.ndarray, np.ndarray]]:
+        cols = []
+        for j, dt in enumerate(self.types):
+            hi = keys[:, LANES_PER_KEY * j]
+            lo = keys[:, LANES_PER_KEY * j + 1]
+            ok = keys[:, LANES_PER_KEY * j + 2] != 0
+            v64 = lanes.merge_i64(hi, lo)
+            it = self.interners.get(j)
+            if it is not None:
+                vals = it.lookup(np.where(ok, v64, 0))
+            elif np.issubdtype(np.dtype(dt.np_dtype), np.floating):
+                vals = v64.view(np.float64).astype(dt.np_dtype)
+            else:
+                vals = v64.astype(dt.np_dtype)
+            cols.append((vals, ok))
+        return cols
 
 
 def to_i64(vals: np.ndarray) -> np.ndarray:
@@ -31,62 +173,3 @@ def to_i64(vals: np.ndarray) -> np.ndarray:
     return vals.astype(np.int64)
 
 
-def build_key_lanes(chunk: StreamChunk,
-                    indices: Sequence[int]) -> np.ndarray:
-    """int32[capacity, 3*len(indices)] key lanes for the device kernels."""
-    cols = []
-    for i in indices:
-        c = chunk.columns[i]
-        cols.append((np.asarray(c.values),
-                     None if c.validity is None
-                     else np.asarray(c.validity)))
-    return build_key_lanes_arrays(cols)
-
-
-def build_key_lanes_arrays(
-        cols: Sequence[Tuple[np.ndarray, np.ndarray]]) -> np.ndarray:
-    """(values, valid|None) pairs → int32[n, 3*len(cols)] key lanes."""
-    n = len(cols[0][0])
-    out = np.empty((n, LANES_PER_KEY * len(cols)), dtype=np.int32)
-    for j, (vals, ok) in enumerate(cols):
-        v64 = to_i64(vals)
-        if ok is not None:
-            v64 = np.where(ok, v64, 0)
-        hi, lo = lanes.split_i64(v64)
-        out[:, LANES_PER_KEY * j] = hi
-        out[:, LANES_PER_KEY * j + 1] = lo
-        out[:, LANES_PER_KEY * j + 2] = \
-            1 if ok is None else ok.astype(np.int32)
-    return out
-
-
-def key_lanes_of_values(values: Sequence, types: Sequence[DataType]
-                        ) -> np.ndarray:
-    """One logical key tuple → int32[3*k] lanes (recovery path)."""
-    lane = np.zeros(LANES_PER_KEY * len(types), dtype=np.int32)
-    for j, (v, dt) in enumerate(zip(values, types)):
-        if v is None:
-            continue
-        v64 = to_i64(np.asarray([v], dtype=dt.np_dtype))
-        hi, lo = lanes.split_i64(v64)
-        lane[LANES_PER_KEY * j] = hi[0]
-        lane[LANES_PER_KEY * j + 1] = lo[0]
-        lane[LANES_PER_KEY * j + 2] = 1
-    return lane
-
-
-def decode_key_lanes(keys: np.ndarray, types: Sequence[DataType]
-                     ) -> List[Tuple[np.ndarray, np.ndarray]]:
-    """Key-lane matrix → per key col (values in col dtype, valid mask)."""
-    cols = []
-    for j, dt in enumerate(types):
-        hi = keys[:, LANES_PER_KEY * j]
-        lo = keys[:, LANES_PER_KEY * j + 1]
-        ok = keys[:, LANES_PER_KEY * j + 2] != 0
-        v64 = lanes.merge_i64(hi, lo)
-        if np.issubdtype(np.dtype(dt.np_dtype), np.floating):
-            vals = v64.view(np.float64).astype(dt.np_dtype)
-        else:
-            vals = v64.astype(dt.np_dtype)
-        cols.append((vals, ok))
-    return cols

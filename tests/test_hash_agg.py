@@ -384,3 +384,80 @@ def test_retractable_max_rejected_without_minput():
                    dist_key_indices=[0])
     with pytest.raises(ValueError):
         HashAggExecutor(src, [0], [AggCall(AggKind.MAX, 1)], t)
+
+
+def test_varchar_group_keys_streaming_tpch_q1_shape():
+    """Streaming TPC-H q1's GROUP BY l_returnflag, l_linestatus —
+    varchar group keys through the interning KeyCodec (VERDICT r2 #5:
+    previously rejected outright). Checked against a host oracle,
+    including NULL keys as their own group."""
+    import asyncio
+    from collections import defaultdict
+
+    from risingwave_tpu.common.types import DataType, Schema
+    from risingwave_tpu.state.state_table import StateTable
+    from risingwave_tpu.state.store import MemoryStateStore
+    from risingwave_tpu.stream.executors.hash_agg import (
+        AggCall, HashAggExecutor, agg_state_schema,
+    )
+    from risingwave_tpu.stream.executors.test_utils import (
+        MockSource, collect_until_n_barriers,
+    )
+    from risingwave_tpu.common.chunk import StreamChunk
+    from tests.test_operators import barrier
+
+    schema = Schema.of(flag=DataType.VARCHAR, status=DataType.VARCHAR,
+                       qty=DataType.INT64)
+    rng = np.random.default_rng(3)
+    flags = ["A", "N", "R", None]
+    statuses = ["F", "O"]
+    rows = [(flags[rng.integers(0, 4)], statuses[rng.integers(0, 2)],
+             int(rng.integers(1, 100))) for _ in range(500)]
+    script = [barrier(1)]
+    for lo in range(0, 500, 100):
+        part = rows[lo:lo + 100]
+        script.append(StreamChunk.from_pydict(schema, {
+            "flag": [r[0] for r in part],
+            "status": [r[1] for r in part],
+            "qty": [r[2] for r in part]}))
+        script.append(barrier(lo // 100 + 2))
+    store = MemoryStateStore()
+    calls = [AggCall(AggKind.SUM, 2), AggCall(AggKind.COUNT)]
+    sch, pk = agg_state_schema(schema, [0, 1], calls)
+    table = StateTable(31, sch, pk, store)
+    ex = HashAggExecutor(MockSource(schema, script), [0, 1], calls,
+                         table, append_only=True)
+    msgs = asyncio.run(collect_until_n_barriers(ex, 6))
+    # accumulate the changelog into final rows
+    final = {}
+    for m in msgs:
+        if hasattr(m, "to_records"):
+            for op, row in m.to_records():
+                if op.is_insert:
+                    final[row[:2]] = row[2:]
+                elif row[:2] in final and final[row[:2]] == row[2:]:
+                    del final[row[:2]]
+    oracle = defaultdict(lambda: [0, 0])
+    for f, s, q in rows:
+        oracle[(f, s)][0] += q
+        oracle[(f, s)][1] += 1
+    assert final == {k: (v[0], v[1]) for k, v in oracle.items()}
+    # the state table persisted the string keys durably
+    assert len(list(table.iter_rows())) == len(oracle)
+    assert {pk[:2] for pk, _r in table.iter_rows()} == set(oracle)
+
+
+def test_bytea_group_keys_with_nulls():
+    """BYTEA keys intern with a type-consistent fill (str fill would
+    crash np.unique's sort)."""
+    from risingwave_tpu.common.types import DataType
+    from risingwave_tpu.stream.executors.keys import KeyCodec
+
+    codec = KeyCodec([DataType.BYTEA])
+    vals = np.asarray([b"a", None, b"b", b"a"], dtype=object)
+    lanes_ = codec.build_arrays([(vals, None)])
+    assert lanes_[0].tolist() == lanes_[3].tolist()   # b"a" == b"a"
+    assert lanes_[1][2] == 0                          # NULL lane
+    decoded = codec.decode(lanes_)
+    v, ok = decoded[0]
+    assert v[0] == b"a" and v[2] == b"b" and not ok[1]
