@@ -16,7 +16,7 @@ order/limit over committed MV snapshots.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from risingwave_tpu.common.types import DataType, Field, Interval, Schema
@@ -58,6 +58,9 @@ class StreamPlan:
     consumer: MaterializeExecutor
     mv: MvCatalog
     readers: Dict[int, object]          # actor_id → split reader
+    # MV-on-MV chain edges: (upstream actor id, Output) to attach at
+    # deploy (NOT at plan time — a failed plan must leak nothing)
+    attaches: List[tuple] = field(default_factory=list)
 
 
 @dataclass
@@ -65,6 +68,7 @@ class SinkPlan:
     consumer: Executor                  # SinkExecutor chain
     deps: List[str]
     readers: Dict[int, object]
+    attaches: List[tuple] = field(default_factory=list)
 
 
 def make_sink_writer(options: Dict[str, str]):
@@ -122,13 +126,18 @@ class StreamPlanner:
     """Plans one CREATE MATERIALIZED VIEW into an executor chain."""
 
     def __init__(self, catalog: Catalog, store, local, definition: str,
-                 mesh=None):
+                 mesh=None, actors=None):
         self.catalog = catalog
         self.store = store
         self.local = local           # LocalBarrierManager
         self.definition = definition
         self.mesh = mesh             # non-None ⇒ sharded GROUP BY plans
+        self.actors = actors or {}   # actor_id → Actor (MV-on-MV attach)
         self.readers: Dict[int, object] = {}
+        # chain edges produced by _chain_upstream_mv, attached by the
+        # session once the WHOLE plan has validated
+        self.pending_attaches: List[tuple] = []
+        self._actor_id = 0           # downstream actor id (Output tag)
 
     # -- source chains ---------------------------------------------------
     def _base_chain(self, item, rate_limit: Optional[int],
@@ -145,7 +154,10 @@ class StreamPlanner:
             raise PlanError(f"unsupported FROM item {item!r}")
         obj = self.catalog.resolve(ref.name)
         if isinstance(obj, MvCatalog):
-            raise PlanError("MV-on-MV (chain/backfill) not supported yet")
+            if isinstance(item, ast.Tumble):
+                raise PlanError("TUMBLE over an MV not supported yet")
+            ex, scope = self._chain_upstream_mv(obj, alias)
+            return ex, scope, [obj.name]
         assert isinstance(obj, SourceCatalog)
         reader = _source_reader(obj)
         tx, rx = channel_for_test()
@@ -176,10 +188,50 @@ class StreamPlanner:
                           scope.qualifiers + [alias])
         return ex, scope, [obj.name]
 
+    def _chain_upstream_mv(self, mv: MvCatalog, alias: str):
+        """FROM <mv>: attach a new output to the upstream MV's actor
+        (chain.rs:28) and backfill its committed snapshot
+        (no_shuffle_backfill.rs:68). The attach happens under the
+        session's barrier lock — the pipeline is quiescent between
+        barrier rounds, so mutating the dispatcher's output set is the
+        Mutation::Add analog without the RPC hop."""
+        from risingwave_tpu.stream.dispatch import Output
+        from risingwave_tpu.stream.exchange import channel_for_test
+        from risingwave_tpu.stream.executor import ExecutorInfo
+        from risingwave_tpu.stream.executors.backfill import (
+            PROGRESS_SCHEMA, BackfillExecutor,
+        )
+        from risingwave_tpu.stream.executors.simple import (
+            ReceiverExecutor,
+        )
+
+        upstream = self.actors.get(mv.actor_id)
+        if upstream is None or not upstream.dispatchers:
+            raise PlanError(
+                f"upstream MV {mv.name!r} has no attachable actor")
+        tx, rx = channel_for_test()
+        # deferred: the session attaches AFTER the whole plan validates
+        # (a failed CREATE must not leave an orphan output that blocks
+        # the upstream on exhausted permits), tagged with the DOWNSTREAM
+        # actor id so drops can detach exactly this edge
+        self.pending_attaches.append(
+            (mv.actor_id, Output(self._actor_id, tx)))
+        recv = ReceiverExecutor(
+            ExecutorInfo(mv.schema, list(mv.pk_indices),
+                         f"Chain({mv.name})"), rx)
+        mv_read = StateTable(mv.table_id, mv.schema, mv.pk_indices,
+                             self.store)
+        progress = StateTable(self.catalog.next_id(), PROGRESS_SCHEMA,
+                              [0], self.store)
+        ex = BackfillExecutor(recv, mv_read, progress,
+                              identity=f"Backfill({mv.name})")
+        return ex, Scope.of(mv.schema, alias)
+
     # -- the main plan ---------------------------------------------------
     def plan(self, name: str, sel: ast.Select, actor_id: int,
              rate_limit: Optional[int] = 8,
              min_chunks: Optional[int] = None) -> StreamPlan:
+        self._actor_id = actor_id
         ex, pk, deps = self._plan_query(sel, actor_id, rate_limit,
                                         min_chunks)
         mv_table = StateTable(self.catalog.next_id(), ex.schema, pk,
@@ -187,7 +239,7 @@ class StreamPlanner:
         mat = MaterializeExecutor(ex, mv_table)
         mv = MvCatalog(name, mv_table.table_id, ex.schema, pk,
                        self.definition, actor_id, deps)
-        return StreamPlan(mat, mv, self.readers)
+        return StreamPlan(mat, mv, self.readers, self.pending_attaches)
 
     def plan_sink(self, sel: ast.Select, options: Dict[str, str],
                   actor_id: int, rate_limit: Optional[int] = 8,
@@ -195,10 +247,12 @@ class StreamPlanner:
         """CREATE SINK AS SELECT: same chain, terminal SinkExecutor."""
         from risingwave_tpu.stream.executors.sink import SinkExecutor
 
+        self._actor_id = actor_id
         ex, _pk, deps = self._plan_query(sel, actor_id, rate_limit,
                                          min_chunks)
         writer = make_sink_writer(options)
-        return SinkPlan(SinkExecutor(ex, writer), deps, self.readers)
+        return SinkPlan(SinkExecutor(ex, writer), deps, self.readers,
+                        self.pending_attaches)
 
     def _plan_query(self, sel: ast.Select, actor_id: int,
                     rate_limit: Optional[int],
@@ -212,12 +266,20 @@ class StreamPlanner:
             if len(sel.joins) > 1:
                 raise PlanError("one JOIN per MV for now")
             # append-only join of two sources; row-id pks on both sides
+            if ex.pk_indices:
+                raise PlanError(
+                    "JOIN over an MV not supported yet (a fresh row id "
+                    "per retraction half would corrupt join state)")
             left = RowIdGenExecutor(ex)
             lscope = Scope(left.schema, scope.qualifiers + [None])
             jn = sel.joins[0]
             rex, rscope, rdeps = self._base_chain(
                 jn.item, rate_limit, min_chunks)
             deps += rdeps
+            if rex.pk_indices:
+                raise PlanError(
+                    "JOIN over an MV not supported yet (a fresh row id "
+                    "per retraction half would corrupt join state)")
             right = RowIdGenExecutor(rex)
             rscope = Scope(right.schema, rscope.qualifiers + [None])
             lkeys, rkeys = _equi_keys(jn.on, lscope, rscope)
@@ -248,11 +310,21 @@ class StreamPlanner:
             pk = _agg_output_pk(sel, out_exprs)
         else:
             exprs = list(bound)
+            base_pk = list(ex.pk_indices)
             if join_pk_cols is not None:
                 pk = list(range(len(exprs), len(exprs) + 2))
                 exprs += [InputRef(c, scope.schema[c].data_type)
                           for c in join_pk_cols]
                 names += ["_row_id_l", "_row_id_r"]
+                ex = ProjectExecutor(ex, exprs, names)
+            elif base_pk:
+                # pk-keyed upstream (MV chain): carry its pk through as
+                # hidden columns — a generated row id would turn every
+                # upstream update pair into a fresh row (duplicates)
+                pk = list(range(len(exprs), len(exprs) + len(base_pk)))
+                exprs += [InputRef(c, scope.schema[c].data_type)
+                          for c in base_pk]
+                names += [f"_pk{j}" for j in range(len(base_pk))]
                 ex = ProjectExecutor(ex, exprs, names)
             else:
                 ex = RowIdGenExecutor(ProjectExecutor(ex, exprs, names))

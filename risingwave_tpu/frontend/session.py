@@ -22,7 +22,9 @@ from risingwave_tpu.frontend.planner import (
 from risingwave_tpu.meta.barrier import BarrierLoop
 from risingwave_tpu.state.store import MemoryStateStore, StateStore
 from risingwave_tpu.stream.actor import Actor, LocalBarrierManager
-from risingwave_tpu.stream.message import StopMutation
+from risingwave_tpu.stream.message import (
+    PauseMutation, ResumeMutation, StopMutation,
+)
 
 Rows = List[tuple]
 
@@ -65,6 +67,7 @@ class Frontend:
         self.rate_limit = rate_limit
         self.min_chunks = min_chunks
         self._next_actor = 1000
+        self.chain_edges: Dict[str, list] = {}   # job → [(uid, Output)]
         self._ddl_log: List[str] = []
         self._replaying = False
         # serializes barrier rounds between DDL handlers, step() and the
@@ -99,6 +102,8 @@ class Frontend:
                 await self.execute(sql)
         finally:
             self._replaying = False
+        if self.actors:
+            await self._barrier(mutation=ResumeMutation())
         return len(log)
 
     # -- public API -------------------------------------------------------
@@ -213,28 +218,48 @@ class Frontend:
 
     # -- handlers ---------------------------------------------------------
     async def _deploy_job(self, name: str, actor_id: int, consumer,
-                          readers, register) -> None:
+                          readers, register, attaches=()) -> None:
         """Shared deployment tail for MVs and sinks — runs UNDER the
         barrier lock the caller holds: topology mutations (sender
         registration in plan(), expected-actor set, spawn) racing a
         heartbeat epoch would leave it collecting against actors that
         never received it."""
         register()                    # catalog entry (duplicate check)
-        actor = Actor(actor_id, consumer, dispatchers=[],
+        # every MV actor carries an (initially empty) broadcast
+        # dispatcher so later MV-on-MV chains can attach outputs at a
+        # barrier boundary (Mutation::Add analog)
+        from risingwave_tpu.stream.dispatch import BroadcastDispatcher
+        actor = Actor(actor_id, consumer,
+                      dispatchers=[BroadcastDispatcher([])],
                       barrier_manager=self.local)
         self.actors[actor_id] = actor
         self.readers[name] = readers
         self.local.set_expected_actors(list(self.actors))
         self.tasks[actor_id] = actor.spawn()
-        # activation barrier (Command::CreateStreamingJob analog)
-        await self.loop.inject_and_collect(force_checkpoint=True)
+        # attach MV-on-MV chain edges now that the plan validated and
+        # the downstream actor exists — the activation barrier below
+        # must flow through these channels
+        self.chain_edges[name] = list(attaches)
+        for uid, out in attaches:
+            d = self.actors[uid].dispatchers[0]
+            d.update_outputs(d.outputs() + [out])
+        # activation barrier (Command::CreateStreamingJob analog).
+        # During DDL replay, sources stay PAUSED so no upstream data
+        # flows before every downstream chain has re-attached — a
+        # revived MV-on-MV chain with completed backfill would miss
+        # deltas emitted in earlier replayed jobs' activation epochs
+        # (recovery.rs: rebuild paused, resume at the end).
+        mutation = PauseMutation() if self._replaying else None
+        await self.loop.inject_and_collect(force_checkpoint=True,
+                                           mutation=mutation)
         self._deployed_actor = actor
 
     async def _create_mv(self, stmt: ast.CreateMaterializedView) -> str:
         self.catalog._check_free(stmt.name)    # validate BEFORE planning
         async with self._barrier_lock:
             planner = StreamPlanner(self.catalog, self.store, self.local,
-                                    definition="", mesh=self.mesh)
+                                    definition="", mesh=self.mesh,
+                                    actors=self.actors)
             actor_id = self._next_actor
             self._next_actor += 1
             plan = planner.plan(stmt.name, stmt.select, actor_id,
@@ -242,7 +267,8 @@ class Frontend:
                                 min_chunks=self.min_chunks)
             await self._deploy_job(
                 stmt.name, actor_id, plan.consumer, plan.readers,
-                lambda: self.catalog.add_mv(plan.mv))
+                lambda: self.catalog.add_mv(plan.mv),
+                attaches=plan.attaches)
         if self._deployed_actor.failure is not None:
             raise self._deployed_actor.failure
         return "CREATE_MATERIALIZED_VIEW"
@@ -257,7 +283,8 @@ class Frontend:
         make_sink_writer(stmt.options)
         async with self._barrier_lock:
             planner = StreamPlanner(self.catalog, self.store, self.local,
-                                    definition="", mesh=self.mesh)
+                                    definition="", mesh=self.mesh,
+                                    actors=self.actors)
             actor_id = self._next_actor
             self._next_actor += 1
             plan = planner.plan_sink(stmt.select, stmt.options, actor_id,
@@ -267,7 +294,8 @@ class Frontend:
                 stmt.name, actor_id, plan.consumer, plan.readers,
                 lambda: self.catalog.add_sink(SinkCatalog(
                     stmt.name, actor_id, dict(stmt.options),
-                    dependent_sources=plan.deps)))
+                    dependent_sources=plan.deps)),
+                attaches=plan.attaches)
         if self._deployed_actor.failure is not None:
             raise self._deployed_actor.failure
         return "CREATE_SINK"
@@ -294,6 +322,15 @@ class Frontend:
             for sid in self.readers.pop(name, {}):
                 self.local.drop_actor(sid)
             self.local.drop_actor(entry.actor_id)
+            # detach this job's chain edges from upstream dispatchers:
+            # an orphan output would block the upstream on exhausted
+            # channel permits a few barriers later
+            for uid, out in self.chain_edges.pop(name, []):
+                up = self.actors.get(uid)
+                if up is not None and up.dispatchers:
+                    d = up.dispatchers[0]
+                    d.update_outputs(
+                        [o for o in d.outputs() if o is not out])
             self.local.set_expected_actors(list(self.actors))
         del registry[name]
         if actor is not None and actor.failure is not None:
@@ -301,6 +338,17 @@ class Frontend:
         return status
 
     async def _drop_mv(self, stmt: ast.DropMaterializedView) -> str:
+        dependents = [
+            m.name for m in self.catalog.mvs.values()
+            if stmt.name in m.dependent_sources
+        ] + [
+            sk.name for sk in self.catalog.sinks.values()
+            if stmt.name in sk.dependent_sources
+        ]
+        if dependents:
+            raise PlanError(
+                f"cannot drop MV {stmt.name!r}: depended on by "
+                f"{dependents}")
         return await self._drop_job(stmt.name, self.catalog.mvs,
                                     stmt.if_exists,
                                     "DROP_MATERIALIZED_VIEW")

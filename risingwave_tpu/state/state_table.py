@@ -283,8 +283,9 @@ class StateTable:
                  encode_memcomparable(prefix_values, self.pk_types[:k]))
         yield from self._iter_range(start, _next_prefix(start))
 
-    def _iter_range(self, start: Optional[bytes], end: Optional[bytes]
-                    ) -> Iterator[Tuple[tuple, tuple]]:
+    def _iter_range_raw(self, start: Optional[bytes],
+                        end: Optional[bytes]
+                        ) -> Iterator[Tuple[bytes, tuple]]:
         merged = {k: v for k, v in self.store.iter(
             self.table_id, self._read_epoch(), start, end)}
         for key, (op, _old, new) in self.mem_table.iter_ops():
@@ -297,8 +298,19 @@ class StateTable:
             else:
                 merged[key] = new
         for key in sorted(merged):
-            pk = decode_memcomparable(key[2:], self.pk_types)
-            yield pk, merged[key]
+            yield key, merged[key]
+
+    def _iter_range(self, start: Optional[bytes], end: Optional[bytes]
+                    ) -> Iterator[Tuple[tuple, tuple]]:
+        for key, row in self._iter_range_raw(start, end):
+            yield decode_memcomparable(key[2:], self.pk_types), row
+
+    def iter_encoded_range(self, start: Optional[bytes] = None,
+                           end: Optional[bytes] = None
+                           ) -> Iterator[Tuple[bytes, tuple]]:
+        """(full encoded key incl. vnode prefix, row) in byte order —
+        the backfill scan order (vnode-major, then memcomparable pk)."""
+        yield from self._iter_range_raw(start, end)
 
     def owned_vnodes(self) -> List[int]:
         return np.flatnonzero(self.vnodes).tolist()

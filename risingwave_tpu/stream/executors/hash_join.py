@@ -559,7 +559,8 @@ class HashJoinExecutor(Executor):
         return StreamChunk(self.schema, cols, vis, ops)
 
     def _process_chunk(self, side_idx: int, chunk: StreamChunk,
-                       key_lanes) -> List[StreamChunk]:
+                       key_lanes, nonnull: np.ndarray
+                       ) -> List[StreamChunk]:
         """One chunk on side S: probe O, emit per join type, apply to S.
 
         Emission per eq_join_oneside (hash_join.rs:990) generalized to
@@ -571,7 +572,6 @@ class HashJoinExecutor(Executor):
         me = self.sides[side_idx]
         other = self.sides[1 - side_idx]
         vis = np.asarray(chunk.visibility)
-        nonnull = me.key_nonnull_mask(chunk)
         probe_vis = vis & nonnull
         n = chunk.capacity
         deg = np.zeros(n, dtype=np.int64)
@@ -729,10 +729,14 @@ class HashJoinExecutor(Executor):
                 i = 0 if tag == "left" else 1
                 if isinstance(msg, StreamChunk):
                     # one host→device upload of the key lanes, shared by
-                    # the probe and this side's insert
-                    lanes_dev = jnp.asarray(self.sides[i].key_codec.build(
-                        msg, self.sides[i].key_indices))
-                    for out in self._process_chunk(i, msg, lanes_dev):
+                    # the probe and this side's insert; the nonnull mask
+                    # falls out of the same pass
+                    lanes_np, nonnull = \
+                        self.sides[i].key_codec.build_with_mask(
+                            msg, self.sides[i].key_indices)
+                    lanes_dev = jnp.asarray(lanes_np)
+                    for out in self._process_chunk(i, msg, lanes_dev,
+                                                   nonnull):
                         yield out
                 elif isinstance(msg, Watermark):
                     for wm in self._on_watermark(i, msg):
