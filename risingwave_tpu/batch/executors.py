@@ -118,6 +118,7 @@ class BatchHashAgg(BatchExecutor):
 
     def execute(self) -> Iterator[DataChunk]:
         groups: Dict[tuple, List] = {}
+        seen: Dict[tuple, set] = {}      # DISTINCT dedup per (group, call)
         for chunk in self.child.execute():
             for row in chunk.to_pylist():
                 gk = tuple(row[i] for i in self.group_indices)
@@ -127,6 +128,11 @@ class BatchHashAgg(BatchExecutor):
                 for j, call in enumerate(self.agg_calls):
                     v = None if call.input_idx is None \
                         else row[call.input_idx]
+                    if call.distinct and v is not None:
+                        s = seen.setdefault((gk, j), set())
+                        if v in s:
+                            continue
+                        s.add(v)
                     accs[j] = _agg_step(call.kind, accs[j], v,
                                         call.input_idx is None)
         rows = []

@@ -24,11 +24,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from risingwave_tpu.common.chunk import Column, DataChunk, get_xp
+import decimal
+
 from risingwave_tpu.common.types import (
     DECIMAL_SCALE,
     DataType,
     Interval,
     decimal_to_scaled,
+    scaled_to_decimal,
 )
 
 # ---------------------------------------------------------------------------
@@ -52,10 +55,81 @@ def promote_numeric(lt: DataType, rt: DataType) -> DataType:
                               _NUMERIC_ORDER.index(rt))]
 
 
+def _parse_timestamp_us(s: str) -> int:
+    import datetime
+    s = s.strip().replace("T", " ")
+    dt = datetime.datetime.fromisoformat(s)
+    if dt.tzinfo is not None:
+        dt = dt.astimezone(datetime.timezone.utc).replace(tzinfo=None)
+    epoch = datetime.datetime(1970, 1, 1)
+    return int((dt - epoch).total_seconds() * 1_000_000)
+
+
+def _cast_one_string(v, dst: DataType):
+    if v is None:
+        return 0
+    if dst in (DataType.INT16, DataType.INT32, DataType.INT64,
+               DataType.SERIAL):
+        return int(v)
+    if dst in (DataType.FLOAT32, DataType.FLOAT64):
+        return float(v)
+    if dst == DataType.DECIMAL:
+        return decimal_to_scaled(decimal.Decimal(v))
+    if dst == DataType.BOOLEAN:
+        return v.strip().lower() in ("t", "true", "1", "yes", "on")
+    if dst in (DataType.TIMESTAMP, DataType.TIMESTAMPTZ):
+        return _parse_timestamp_us(v)
+    if dst == DataType.DATE:
+        import datetime
+        return (datetime.date.fromisoformat(v.strip())
+                - datetime.date(1970, 1, 1)).days
+    if dst == DataType.TIME:
+        import datetime
+        t = datetime.time.fromisoformat(v.strip())
+        return ((t.hour * 60 + t.minute) * 60 + t.second) * 1_000_000 \
+            + t.microsecond
+    raise TypeError(f"cannot cast string to {dst}")
+
+
+def _format_to_string(v, src: DataType) -> str:
+    """pg text-out for physical values (round-trips _cast_one_string)."""
+    import datetime
+    if src == DataType.DECIMAL:
+        return str(scaled_to_decimal(v))
+    if src == DataType.BOOLEAN:
+        return "true" if v else "false"
+    if src in (DataType.TIMESTAMP, DataType.TIMESTAMPTZ):
+        us = int(v)
+        base = datetime.datetime(1970, 1, 1) + \
+            datetime.timedelta(microseconds=us)
+        out = base.isoformat(sep=" ")
+        return out + "+00:00" if src == DataType.TIMESTAMPTZ else out
+    if src == DataType.DATE:
+        return (datetime.date(1970, 1, 1)
+                + datetime.timedelta(days=int(v))).isoformat()
+    if src == DataType.TIME:
+        us = int(v)
+        s_, rem = divmod(us, 1_000_000)
+        h, r2 = divmod(s_, 3600)
+        m, sec = divmod(r2, 60)
+        out = f"{h:02d}:{m:02d}:{sec:02d}"
+        return out + (f".{rem:06d}" if rem else "")
+    return str(v)
+
+
 def _cast_values(vals, src: DataType, dst: DataType):
     xp = get_xp(vals)
     if src == dst:
         return vals
+    if src == DataType.VARCHAR:
+        # host object arrays: per-element parse (pg text-in semantics)
+        out = [_cast_one_string(v, dst) for v in vals.tolist()]
+        return np.asarray(out, dtype=dst.np_dtype)
+    if dst == DataType.VARCHAR:
+        lst = [_format_to_string(v, src) for v in vals.tolist()]
+        out = np.empty(len(lst), dtype=object)
+        out[:] = lst
+        return out
     if dst == DataType.DECIMAL:
         if src in (DataType.FLOAT32, DataType.FLOAT64):
             return xp.rint(vals * DECIMAL_SCALE).astype(xp.int64)
@@ -376,8 +450,19 @@ class Cast(Expression):
         c = self.child.eval(chunk)
         if c.data_type == self.return_type:
             return c
+        validity = c.validity
+        if not c.data_type.is_device:
+            # host columns carry NULL as the None OBJECT — derive the
+            # mask here or NULL would cast to 0/false/epoch silently
+            vals_l = np.asarray(c.values).tolist()
+            nulls = np.fromiter((v is None for v in vals_l),
+                                dtype=bool, count=len(vals_l))
+            if nulls.any():
+                ok = ~nulls
+                validity = ok if validity is None \
+                    else np.asarray(validity) & ok
         vals = _cast_values(c.values, c.data_type, self.return_type)
-        return Column(self.return_type, vals, c.validity)
+        return Column(self.return_type, vals, validity)
 
     def __repr__(self):
         return f"cast({self.child!r} as {self.return_type.value})"

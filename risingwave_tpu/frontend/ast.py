@@ -49,6 +49,13 @@ class Call(Expr):
     name: str                # lowercased
     args: List[Expr]
     star: bool = False       # count(*)
+    distinct: bool = False   # count(DISTINCT x) etc.
+
+
+@dataclass
+class CastExpr(Expr):
+    child: Expr
+    type_name: str           # lowercased SQL type name
 
 
 # -- statements ----------------------------------------------------------

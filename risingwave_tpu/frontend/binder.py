@@ -103,6 +103,14 @@ class Binder:
             return BinaryOp(e.op, left, right)
         if isinstance(e, ast.Call):
             return self._bind_call(e)
+        if isinstance(e, ast.CastExpr):
+            from risingwave_tpu.common.types import DataType as _DT
+            from risingwave_tpu.expr.expr import Cast
+            try:
+                to = _DT.from_sql(e.type_name)
+            except KeyError:
+                raise BindError(f"unknown type {e.type_name!r}")
+            return Cast(self.bind(e.child), to)
         raise BindError(f"unsupported expression {e!r}")
 
     def _bind_call(self, e: ast.Call):
@@ -117,10 +125,15 @@ class Binder:
             arg = self.bind(e.args[0])
             if not isinstance(arg, InputRef):
                 raise BindError("avg(<expr>) needs a plain column")
-            sj = self._register(AggCall(AggKind.SUM, arg.index),
-                                ("sum", arg.index))
-            cj = self._register(AggCall(AggKind.COUNT, arg.index),
-                                ("count", arg.index))
+            # avg(DISTINCT x) = sum(DISTINCT x) / count(DISTINCT x):
+            # both calls dedup over the same value multiset
+            d = e.distinct
+            sj = self._register(
+                AggCall(AggKind.SUM, arg.index, distinct=d),
+                ("sum", arg.index, d))
+            cj = self._register(
+                AggCall(AggKind.COUNT, arg.index, distinct=d),
+                ("count", arg.index, d))
             return ("avg", sj, cj)
         if name in _AGG_KINDS:
             if not self.allow_aggs:
@@ -136,8 +149,11 @@ class Binder:
                     raise BindError(
                         f"{name}(<expr>) needs a plain column (project "
                         "it first)")
-                call = AggCall(_AGG_KINDS[name], arg.index)
-                key = (name, arg.index)
+                # MIN/MAX(DISTINCT) ≡ MIN/MAX — drop the flag there
+                distinct = e.distinct and name in ("count", "sum")
+                call = AggCall(_AGG_KINDS[name], arg.index,
+                               distinct=distinct)
+                key = (name, arg.index, distinct)
             return ("agg", self._register(call, key))
         if name in ("tumble_start", "tumble_end"):
             ts = self.bind(e.args[0])

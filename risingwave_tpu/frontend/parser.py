@@ -30,7 +30,7 @@ KEYWORDS = {
     "false", "if", "exists", "flush", "second", "seconds", "minute",
     "minutes", "hour", "hours", "day", "days", "millisecond",
     "milliseconds", "case", "when", "then", "else", "end", "cast",
-    "sink", "sinks", "left", "right", "full", "outer",
+    "sink", "sinks", "left", "right", "full", "outer", "distinct",
 }
 
 # keywords that can never start a primary expression (a column named
@@ -373,6 +373,18 @@ class Parser:
             return ast.IntervalLit(n * _INTERVAL_UNITS[unit])
         if self._kw("case"):
             return self._case()
+        if self._kw("cast"):
+            self._expect_op("(")
+            e = self._expr()
+            self._expect_kw("as")
+            words = [self._next()[1].lower()]
+            # multi-word type names (timestamp with time zone, etc.)
+            while self._peek()[0] in ("ident", "kw") and \
+                    self._peek()[1].lower() in ("with", "time", "zone",
+                                                "precision", "varying"):
+                words.append(self._next()[1].lower())
+            self._expect_op(")")
+            return ast.CastExpr(e, " ".join(words))
         if self._op("("):
             e = self._expr()
             self._expect_op(")")
@@ -385,13 +397,14 @@ class Parser:
                 if self._op("*"):
                     self._expect_op(")")
                     return ast.Call(name.lower(), [], star=True)
+                distinct = self._kw("distinct")
                 args = []
                 if not self._op(")"):
                     args.append(self._expr())
                     while self._op(","):
                         args.append(self._expr())
                     self._expect_op(")")
-                return ast.Call(name.lower(), args)
+                return ast.Call(name.lower(), args, distinct=distinct)
             if self._op("."):
                 col = self._ident()
                 return ast.ColRef(col, table=name)

@@ -381,7 +381,8 @@ class StreamPlanner:
                 continue
             dt = scope.schema[call.input_idx].data_type
             pre_exprs.append(InputRef(call.input_idx, dt))
-            remapped.append(AggCall(call.kind, len(pre_exprs) - 1))
+            remapped.append(AggCall(call.kind, len(pre_exprs) - 1,
+                                    distinct=call.distinct))
             pre_names.append(f"_a{len(remapped) - 1}")
         pre = ProjectExecutor(ex, pre_exprs, pre_names)
         g = len(group_bound)
@@ -401,8 +402,20 @@ class StreamPlanner:
             kernel = ShardedAggKernel(
                 self.mesh, key_width=LANES_PER_KEY * g,
                 specs=[c.spec(pre.schema) for c in calls])
+        from risingwave_tpu.stream.executors.hash_agg import (
+            minput_state_schema,
+        )
+        distinct_tables = {}
+        for c in calls:
+            if c.distinct and c.input_idx not in distinct_tables:
+                dsch, dpk, ddk = minput_state_schema(
+                    pre.schema, list(range(g)), c)
+                distinct_tables[c.input_idx] = StateTable(
+                    self.catalog.next_id(), dsch, dpk, self.store,
+                    dist_key_indices=ddk)
         agg = HashAggExecutor(pre, list(range(g)), calls, table,
-                              append_only=True, kernel=kernel)
+                              append_only=True, kernel=kernel,
+                              distinct_tables=distinct_tables)
         # post-agg projection: map each SELECT item
         out = [_map_agg_projection(b, g, agg.schema, group_reprs)
                for b in bound]
@@ -553,7 +566,8 @@ def plan_batch(sel: ast.Select, catalog: Catalog, store, epoch: int):
                 continue
             dt = scope.schema[call.input_idx].data_type
             pre_exprs.append(InputRef(call.input_idx, dt))
-            remapped.append(AggCall(call.kind, len(pre_exprs) - 1))
+            remapped.append(AggCall(call.kind, len(pre_exprs) - 1,
+                                    distinct=call.distinct))
         pre = BatchProject(ex, pre_exprs)
         g = len(group_bound)
         agg = BatchHashAgg(pre, list(range(g)), remapped)

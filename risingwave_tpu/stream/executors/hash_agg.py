@@ -59,6 +59,11 @@ class AggCall:
 
     kind: AggKind
     input_idx: Optional[int] = None      # None ⇒ count(*)
+    # DISTINCT dedup (aggregation/distinct.rs analog): the executor
+    # keeps a per-(group, value) multiset and gates the device kernel
+    # so each distinct value contributes once. MIN/MAX ignore it
+    # (semantically identity).
+    distinct: bool = False
 
     def out_type(self, input_schema: Schema) -> DataType:
         if self.kind == AggKind.COUNT:
@@ -130,7 +135,8 @@ class HashAggExecutor(Executor):
                  output_names: Optional[Sequence[str]] = None,
                  minput_tables: Optional[Dict[int, StateTable]] = None,
                  actor_id: int = 0,
-                 kernel: Optional[object] = None):
+                 kernel: Optional[object] = None,
+                 distinct_tables: Optional[Dict[int, StateTable]] = None):
         self.input = input_
         self.group_indices = list(group_indices)
         self.agg_calls = list(agg_calls)
@@ -152,6 +158,25 @@ class HashAggExecutor(Executor):
         # (written through to the StateTables once per barrier, keeping
         # store round-trips off the chunk hot path)
         self._minput_pending: Dict[int, Dict[tuple, int]] = {}
+        # DISTINCT dedup (distinct.rs): ONE durable (group, value, cnt)
+        # table + in-memory multiplicity mirror per distinct INPUT
+        # COLUMN — count(DISTINCT x) and sum(DISTINCT x) share it,
+        # like the reference's per-column dedup tables
+        self.distinct_tables: Dict[int, StateTable] = dict(
+            distinct_tables or {})
+        self._distinct_cols: Dict[int, List[int]] = {}
+        for j, c in enumerate(self.agg_calls):
+            if c.distinct and c.kind in (AggKind.COUNT, AggKind.SUM):
+                self._distinct_cols.setdefault(c.input_idx, []).append(j)
+        missing_d = [col for col in self._distinct_cols
+                     if col not in self.distinct_tables]
+        if missing_d:
+            raise ValueError(
+                f"DISTINCT column(s) {missing_d} need dedup state "
+                "tables — pass distinct_tables keyed by input column "
+                "(minput_state_schema shape)")
+        self._distinct_mult: Dict[int, Dict[tuple, int]] = {}
+        self._distinct_pending: Dict[int, Dict[tuple, int]] = {}
         if not append_only:
             need = [j for j, s in enumerate(self.specs)
                     if s.kind in (AggKind.MIN, AggKind.MAX)]
@@ -205,9 +230,65 @@ class HashAggExecutor(Executor):
         key_lanes = self.key_codec.build(chunk, self.group_indices)
         signs = np.asarray(chunk.signs())
         vis = np.asarray(chunk.visibility)
+        inputs = list(self._inputs(chunk))
         if self.minput:
             self._apply_minput(chunk, key_lanes, signs, vis)
-        self.kernel.apply(key_lanes, signs, vis, self._inputs(chunk))
+        for col, js in self._distinct_cols.items():
+            _in_lanes0, ok0 = inputs[js[0]]
+            mask = self._apply_distinct(col, chunk, key_lanes, signs,
+                                        vis & ok0)
+            for j in js:
+                inputs[j] = (inputs[j][0], mask)
+        self.kernel.apply(key_lanes, signs, vis, tuple(inputs))
+
+    # -- per-(group, value) multisets (minput + distinct) ----------------
+    def _multiset_groups(self, chunk: StreamChunk, key_lanes: np.ndarray,
+                         signs: np.ndarray, ok: np.ndarray,
+                         input_idx: int):
+        """Vectorized grouping of visible rows by (group key, value).
+
+        Returns (row_idx, first_row_per_key, per_key_row_lists? no —
+        (rows, uniq_inverse, n_uniq, deltas, key_tuple_fn)) where
+        python work is O(distinct keys), not O(rows)
+        (hash_agg.rs minput/distinct parity without the per-row loop).
+        """
+        from risingwave_tpu.stream.executors.keys import to_i64
+
+        rows = np.flatnonzero(ok)
+        if not len(rows):
+            return None
+        c = chunk.columns[input_idx]
+        vals = np.asarray(c.values)
+        comp = np.empty((len(rows), key_lanes.shape[1] + 1),
+                        dtype=np.int64)
+        comp[:, :key_lanes.shape[1]] = key_lanes[rows]
+        comp[:, -1] = to_i64(vals[rows])
+        _uniq, inverse = np.unique(comp, axis=0, return_inverse=True)
+        n_uniq = int(inverse.max()) + 1
+        deltas = np.zeros(n_uniq, dtype=np.int64)
+        np.add.at(deltas, inverse, signs[rows])
+        # first chunk-row index per unique key (stable order)
+        order = np.argsort(inverse, kind="stable")
+        starts = np.searchsorted(inverse[order],
+                                 np.arange(n_uniq, dtype=np.int64))
+        first_rows = rows[order[starts]]
+        g_cols = [(np.asarray(chunk.columns[i].values),
+                   None if chunk.columns[i].validity is None
+                   else np.asarray(chunk.columns[i].validity))
+                  for i in self.group_indices]
+
+        def _pyval(x):
+            return x.item() if hasattr(x, "item") else x
+
+        def key_tuple(u: int) -> tuple:
+            r = int(first_rows[u])
+            group = tuple(
+                None if (okc is not None and not okc[r])
+                else _pyval(gv[r])
+                for gv, okc in g_cols)
+            return group + (_pyval(vals[r]),)
+
+        return rows, inverse, n_uniq, deltas, key_tuple, order, starts
 
     def _apply_minput(self, chunk: StreamChunk, key_lanes: np.ndarray,
                       signs: np.ndarray, vis: np.ndarray) -> None:
@@ -216,33 +297,71 @@ class HashAggExecutor(Executor):
         del_rows = np.flatnonzero(vis & (signs < 0))
         for r in del_rows.tolist():
             self._deleted_lanes.add(tuple(key_lanes[r].tolist()))
-        g_cols = [(np.asarray(chunk.columns[i].values),
-                   None if chunk.columns[i].validity is None
-                   else np.asarray(chunk.columns[i].validity))
-                  for i in self.group_indices]
-
-        def group_of(r: int) -> tuple:
-            return tuple(
-                None if (ok is not None and not ok[r])
-                else vals[r].item()
-                for vals, ok in g_cols)
-
         for j in self.minput:
             call = self.agg_calls[j]
             c = chunk.columns[call.input_idx]
-            vals = np.asarray(c.values)
             ok = vis if c.validity is None \
                 else vis & np.asarray(c.validity)
-            deltas = self._minput_pending.setdefault(j, {})
-            for r in np.flatnonzero(ok).tolist():
-                key = group_of(r) + (vals[r].item(),)
-                deltas[key] = deltas.get(key, 0) + int(signs[r])
+            ms = self._multiset_groups(chunk, key_lanes, signs, ok,
+                                       call.input_idx)
+            if ms is None:
+                continue
+            _rows, _inv, n_uniq, deltas, key_tuple, _o, _s = ms
+            pend = self._minput_pending.setdefault(j, {})
+            for u in np.flatnonzero(deltas != 0).tolist():
+                key = key_tuple(u)
+                pend[key] = pend.get(key, 0) + int(deltas[u])
 
-    def _write_minput_pending(self) -> None:
+    def _apply_distinct(self, col: int, chunk: StreamChunk,
+                        key_lanes: np.ndarray, signs: np.ndarray,
+                        ok: np.ndarray) -> np.ndarray:
+        """DISTINCT gating (aggregation/distinct.rs): per (group, value)
+        multiset — the device kernel sees ONE representative row only
+        when the value's multiplicity crosses zero, with the chunk sign
+        matching the crossing direction. Returns the call's new valid
+        mask."""
+        new_ok = np.zeros(chunk.capacity, dtype=bool)
+        ms = self._multiset_groups(chunk, key_lanes, signs, ok, col)
+        if ms is None:
+            return new_ok
+        rows, inverse, n_uniq, deltas, key_tuple, order, starts = ms
+        mult = self._distinct_mult.setdefault(col, {})
+        pend = self._distinct_pending.setdefault(col, {})
+        srt = inverse[order]
+        for u in range(n_uniq):
+            d = int(deltas[u])
+            if d == 0:
+                continue
+            key = key_tuple(u)
+            old = mult.get(key, 0)
+            new = old + d
+            if new < 0:
+                raise ValueError(
+                    f"distinct retract below zero for {key}")
+            if new == 0:
+                del mult[key]
+            else:
+                mult[key] = new
+            pend[key] = pend.get(key, 0) + d
+            eff = (1 if new > 0 else 0) - (1 if old > 0 else 0)
+            if eff == 0:
+                continue
+            # representative row with the matching sign (exists: the
+            # net delta moved in that direction)
+            lo = int(starts[u])
+            hi = int(starts[u + 1]) if u + 1 < n_uniq else len(srt)
+            cand = rows[order[lo:hi]]
+            match = cand[signs[cand] == eff]
+            new_ok[int(match[0])] = True
+        return new_ok
+
+    @staticmethod
+    def _write_multiset_pending(pending: Dict[int, Dict[tuple, int]],
+                                tables: Dict[int, StateTable]) -> None:
         """Write buffered multiset deltas through to the StateTables
         (once per barrier; reads during recompute then see them)."""
-        for j, deltas in self._minput_pending.items():
-            table = self.minput[j]
+        for j, deltas in pending.items():
+            table = tables[j]
             for key, d in deltas.items():
                 if d == 0:
                     continue
@@ -256,7 +375,10 @@ class HashAggExecutor(Executor):
                     table.delete(cur)
                 else:
                     table.update(cur, row)
-        self._minput_pending.clear()
+        pending.clear()
+
+    def _write_minput_pending(self) -> None:
+        self._write_multiset_pending(self._minput_pending, self.minput)
 
     # -- watermark state cleaning ----------------------------------------
     def _cleanable_type(self) -> bool:
@@ -281,6 +403,13 @@ class HashAggExecutor(Executor):
         n = self.table.delete_below_prefix(phys)
         for t in self.minput.values():
             t.delete_below_prefix(phys)
+        for col, t in self.distinct_tables.items():
+            t.delete_below_prefix(phys)
+            mult = self._distinct_mult.get(col)
+            if mult:
+                self._distinct_mult[col] = {
+                    k: v for k, v in mult.items()
+                    if k[0] is None or k[0] >= phys}
         self._cleaned_wm = wm
         _METRICS.agg_rows_cleaned.inc(n, executor=self.identity)
 
@@ -297,6 +426,9 @@ class HashAggExecutor(Executor):
                                         executor=self.identity)
         if self.minput:
             self._write_minput_pending()
+        if self._distinct_pending:
+            self._write_multiset_pending(self._distinct_pending,
+                                         self.distinct_tables)
         if fr.n == 0:
             self._deleted_lanes.clear()
             self.kernel.advance()
@@ -454,6 +586,13 @@ class HashAggExecutor(Executor):
         self.table.init_epoch(first.epoch)
         for t in self.minput.values():
             t.init_epoch(first.epoch)
+        for col, t in self.distinct_tables.items():
+            t.init_epoch(first.epoch)
+            mult = {}
+            for _pk, row in t.iter_rows():
+                mult[tuple(row[:-1])] = int(row[-1])
+            if mult:
+                self._distinct_mult[col] = mult
         self._recover()
         yield first
         try:
@@ -465,6 +604,8 @@ class HashAggExecutor(Executor):
                     self._clean_state()
                     self.table.commit(msg.epoch)
                     for t in self.minput.values():
+                        t.commit(msg.epoch)
+                    for t in self.distinct_tables.values():
                         t.commit(msg.epoch)
                     if out is not None:
                         yield out

@@ -461,3 +461,73 @@ def test_bytea_group_keys_with_nulls():
     decoded = codec.decode(lanes_)
     v, ok = decoded[0]
     assert v[0] == b"a" and v[2] == b"b" and not ok[1]
+
+
+def _run_distinct_case(script, n_barriers, store=None):
+    from risingwave_tpu.stream.executors.hash_agg import (
+        minput_state_schema,
+    )
+    store = store if store is not None else MemoryStateStore()
+    calls = [AggCall(AggKind.COUNT, 1, distinct=True),
+             AggCall(AggKind.SUM, 1, distinct=True),
+             AggCall(AggKind.COUNT, 1)]
+    sschema, spk = agg_state_schema(SCHEMA, [0], calls)
+    table = StateTable(50, sschema, spk, store, dist_key_indices=[0])
+    dsch, dpk, ddk = minput_state_schema(SCHEMA, [0], calls[0])
+    dt_tables = {1: StateTable(51, dsch, dpk, store,
+                               dist_key_indices=ddk)}
+    ex = HashAggExecutor(MockSource(SCHEMA, script), [0], calls, table,
+                         append_only=False, distinct_tables=dt_tables)
+    msgs = asyncio.run(collect_until_n_barriers(ex, n_barriers))
+    return msgs, store
+
+
+def test_distinct_count_sum():
+    """count(DISTINCT v), sum(DISTINCT v) vs plain count(v), with
+    duplicates within and across chunks (distinct.rs semantics)."""
+    script = [barrier(1),
+              chunk([1, 1, 1, 2], [10, 10, 20, 10]),
+              barrier(2),
+              chunk([1, 2], [10, 10]),     # more duplicates
+              barrier(3)]
+    msgs, _ = _run_distinct_case(script, 3)
+    view = materialized_view(msgs)
+    assert view[1] == (2, 30, 4)    # distinct {10,20}; 4 raw rows
+    assert view[2] == (1, 10, 2)
+
+
+def test_distinct_retraction_and_recovery():
+    """Retracting one duplicate keeps the distinct count; retracting
+    the last occurrence drops it. A fresh executor over the same store
+    reloads the dedup multiset."""
+    store = MemoryStateStore()
+    script = [barrier(1),
+              chunk([1, 1, 1], [10, 10, 20]),
+              barrier(2),
+              chunk([1], [10], ops=[Op.DELETE]),     # dup remains
+              barrier(3)]
+    msgs, store = _run_distinct_case(script, 3, store=store)
+    view = materialized_view(msgs)
+    assert view[1] == (2, 30, 2)
+    # restart: new executor, retract the last 10 — distinct drops to 1
+    script2 = [barrier(4),
+               chunk([1], [10], ops=[Op.DELETE]),
+               barrier(5)]
+    _msgs2, store = _run_distinct_case(script2, 2, store=store)
+    # final value state: (g, rows, cnt_distinct, sum_distinct, nn, cnt)
+    from risingwave_tpu.state.state_table import StateTable
+    from risingwave_tpu.common.types import DataType, Schema
+    calls = [AggCall(AggKind.COUNT, 1, distinct=True),
+             AggCall(AggKind.SUM, 1, distinct=True),
+             AggCall(AggKind.COUNT, 1)]
+    sschema, spk = agg_state_schema(SCHEMA, [0], calls)
+    t = StateTable(50, sschema, spk, store, dist_key_indices=[0])
+    rows = {pk[0]: row for pk, row in _state_rows_of(t)}
+    assert rows[1][2] == 1 and rows[1][3] == 20   # distinct {20}
+
+
+def _state_rows_of(table):
+    from risingwave_tpu.common.epoch import Epoch, EpochPair
+    table.init_epoch(EpochPair(Epoch.from_physical(99),
+                               Epoch.from_physical(98)))
+    return list(table.iter_rows())
