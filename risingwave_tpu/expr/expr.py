@@ -55,6 +55,31 @@ def promote_numeric(lt: DataType, rt: DataType) -> DataType:
                               _NUMERIC_ORDER.index(rt))]
 
 
+_TIME_TYPES = (DataType.DATE, DataType.TIME, DataType.TIMESTAMP,
+               DataType.TIMESTAMPTZ)
+_INT_TYPES = (DataType.INT16, DataType.INT32, DataType.INT64,
+              DataType.SERIAL)
+
+
+def _promote_comparison(lt: DataType, rt: DataType) -> DataType:
+    """Comparison common type: numerics promote; a time type compares
+    against integer literals in its physical domain (days / µs), and
+    TIMESTAMP against TIMESTAMPTZ (same µs domain). Mixed-unit time
+    comparisons (DATE vs TIMESTAMP) are rejected — the physical values
+    live in different domains and a raw compare would be garbage."""
+    ts_pair = {DataType.TIMESTAMP, DataType.TIMESTAMPTZ}
+    if lt in ts_pair and rt in ts_pair:
+        return DataType.TIMESTAMP
+    if lt in _TIME_TYPES and rt in _TIME_TYPES:
+        raise TypeError(
+            f"cannot compare {lt.value} with {rt.value} — cast one "
+            "side explicitly")
+    for a, b in ((lt, rt), (rt, lt)):
+        if a in _TIME_TYPES and b in _INT_TYPES:
+            return a
+    return promote_numeric(lt, rt)
+
+
 def _parse_timestamp_us(s: str) -> int:
     import datetime
     s = s.strip().replace("T", " ")
@@ -291,7 +316,8 @@ class BinaryOp(Expression):
             self.return_type = DataType.BOOLEAN
             self._common = DataType.BOOLEAN
         elif op in _CMP_OPS:
-            self._common = lt if lt == rt else promote_numeric(lt, rt)
+            self._common = lt if lt == rt \
+                else _promote_comparison(lt, rt)
             self.return_type = DataType.BOOLEAN
         else:
             self._common = lt if lt == rt else promote_numeric(lt, rt)

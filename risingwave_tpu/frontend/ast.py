@@ -58,6 +58,11 @@ class CastExpr(Expr):
     type_name: str           # lowercased SQL type name
 
 
+@dataclass
+class Explain:
+    select: "Select"
+
+
 # -- statements ----------------------------------------------------------
 
 

@@ -67,13 +67,18 @@ class Binder:
         self.scope = scope
         self.allow_aggs = allow_aggs
         self.agg_calls: List[AggCall] = []
+        # bound input EXPRESSION per call (None for count(*)) — aggs
+        # over arbitrary expressions pre-project through these
+        self.agg_inputs: List[Optional[object]] = []
         # bound agg call → position (dedup: COUNT(*) used twice = one)
         self._agg_index: Dict[Tuple, int] = {}
 
-    def _register(self, call: AggCall, key: Tuple) -> int:
+    def _register(self, call: AggCall, key: Tuple,
+                  input_expr=None) -> int:
         if key not in self._agg_index:
             self._agg_index[key] = len(self.agg_calls)
             self.agg_calls.append(call)
+            self.agg_inputs.append(input_expr)
         return self._agg_index[key]
 
     # returns (Expression | ("agg", index), ...)
@@ -123,17 +128,16 @@ class Binder:
             if e.star or not e.args:
                 raise BindError("avg(*) is not valid")
             arg = self.bind(e.args[0])
-            if not isinstance(arg, InputRef):
-                raise BindError("avg(<expr>) needs a plain column")
             # avg(DISTINCT x) = sum(DISTINCT x) / count(DISTINCT x):
             # both calls dedup over the same value multiset
             d = e.distinct
+            akey = repr(arg)
             sj = self._register(
-                AggCall(AggKind.SUM, arg.index, distinct=d),
-                ("sum", arg.index, d))
+                AggCall(AggKind.SUM, None, distinct=d),
+                ("sum", akey, d), input_expr=arg)
             cj = self._register(
-                AggCall(AggKind.COUNT, arg.index, distinct=d),
-                ("count", arg.index, d))
+                AggCall(AggKind.COUNT, None, distinct=d),
+                ("count", akey, d), input_expr=arg)
             return ("avg", sj, cj)
         if name in _AGG_KINDS:
             if not self.allow_aggs:
@@ -145,15 +149,12 @@ class Binder:
                 key = ("count_star",)
             else:
                 arg = self.bind(e.args[0])
-                if not isinstance(arg, InputRef):
-                    raise BindError(
-                        f"{name}(<expr>) needs a plain column (project "
-                        "it first)")
                 # MIN/MAX(DISTINCT) ≡ MIN/MAX — drop the flag there
                 distinct = e.distinct and name in ("count", "sum")
-                call = AggCall(_AGG_KINDS[name], arg.index,
+                call = AggCall(_AGG_KINDS[name], None,
                                distinct=distinct)
-                key = (name, arg.index, distinct)
+                return ("agg", self._register(
+                    call, (name, repr(arg), distinct), input_expr=arg))
             return ("agg", self._register(call, key))
         if name in ("tumble_start", "tumble_end"):
             ts = self.bind(e.args[0])

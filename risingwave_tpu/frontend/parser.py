@@ -31,6 +31,7 @@ KEYWORDS = {
     "minutes", "hour", "hours", "day", "days", "millisecond",
     "milliseconds", "case", "when", "then", "else", "end", "cast",
     "sink", "sinks", "left", "right", "full", "outer", "distinct",
+    "explain",
 }
 
 # keywords that can never start a primary expression (a column named
@@ -183,6 +184,8 @@ class Parser:
             return ast.Show("sinks")
         if self._kw("flush"):
             return ast.Flush()
+        if self._kw("explain"):
+            return ast.Explain(self._select())
         if self._peek() == ("kw", "select"):
             return self._select()
         raise ParseError(f"unsupported statement at {self._peek()}")

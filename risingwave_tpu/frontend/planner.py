@@ -8,10 +8,13 @@ materialize), so the logical/physical split and exchange insertion are
 not yet needed (single-fragment plans; the dispatch layer exists under
 stream/ for when the fragmenter lands).
 
-Supported streaming shapes: MV over one source (optionally TUMBLE),
-optional WHERE, optional GROUP BY + count/sum/min/max, one INNER JOIN
-of two sources on equi-keys. Batch: scan/filter/project/agg/join/
-order/limit over committed MV snapshots.
+Supported streaming shapes: MV over one source (optionally TUMBLE) or
+over another MV (backfill chain), WHERE with per-conjunct predicate
+pushdown below joins (gated by join kind), multi-way left-deep
+INNER/LEFT/RIGHT/FULL joins of sources on equi-keys, GROUP BY with
+count/sum/min/max/avg (+DISTINCT) over arbitrary expressions, ORDER
+BY/LIMIT TopN, EXPLAIN. Batch: scan/filter/project/agg/join/order/
+limit over committed MV snapshots.
 """
 
 from __future__ import annotations
@@ -108,6 +111,16 @@ def _source_reader(src: SourceCatalog):
             DatagenConfig, DatagenSplitReader,
         )
         return DatagenSplitReader(DatagenConfig.from_options(opts))
+    if connector == "tpch":
+        from risingwave_tpu.connectors.tpch import (
+            TpchConfig, TpchSplitReader,
+        )
+        return TpchSplitReader(TpchConfig(
+            table=opts.get("tpch.table", "lineitem"),
+            customers=int(opts.get("tpch.customers", 1500)),
+            orders=int(opts.get("tpch.orders", 15000)),
+            max_chunk_size=int(opts.get("tpch.max.chunk.size", 1024)),
+        ))
     raise PlanError(f"unknown connector {connector!r}")
 
 
@@ -119,6 +132,9 @@ def source_schema(options: Dict[str, str]) -> Schema:
     if connector == "datagen":
         from risingwave_tpu.connectors.datagen import DatagenConfig
         return DatagenConfig.from_options(options).schema
+    if connector == "tpch":
+        from risingwave_tpu.connectors.tpch import TABLE_SCHEMAS
+        return TABLE_SCHEMAS[options.get("tpch.table", "lineitem")]
     raise PlanError(f"unknown connector {connector!r}")
 
 
@@ -137,6 +153,7 @@ class StreamPlanner:
         # chain edges produced by _chain_upstream_mv, attached by the
         # session once the WHOLE plan has validated
         self.pending_attaches: List[tuple] = []
+        self.registered_senders: List[int] = []   # cleanup on failure
         self._actor_id = 0           # downstream actor id (Output tag)
 
     # -- source chains ---------------------------------------------------
@@ -167,6 +184,7 @@ class StreamPlanner:
         # catalog id space; the barrier manager only needs uniqueness)
         sid = self.catalog.next_id()
         self.local.register_sender(sid, tx)
+        self.registered_senders.append(sid)
         ex: Executor = SourceExecutor(
             reader, rx, split_state, actor_id=sid,
             rate_limit_chunks_per_barrier=rate_limit,
@@ -262,43 +280,61 @@ class StreamPlanner:
         ex, scope, deps = self._base_chain(sel.from_item,
                                            rate_limit, min_chunks)
         join_pk_cols: Optional[List[int]] = None
+        conjuncts = _flatten_and(sel.where) if sel.where is not None \
+            else []
         if sel.joins:
-            if len(sel.joins) > 1:
-                raise PlanError("one JOIN per MV for now")
-            # append-only join of two sources; row-id pks on both sides
+            # Optimizer v0 (logical_optimization.rs:476 pushdown +
+            # multi-way planning, collapsed): a left-deep chain of
+            # HashJoins in syntax order, with WHERE conjuncts pushed to
+            # the lowest side whose scope binds them — below the first
+            # join when possible, else right after the join that first
+            # covers their columns. Joins of append-only sources emit no
+            # retractions, so chained join state stays row-id keyed.
             if ex.pk_indices:
                 raise PlanError(
                     "JOIN over an MV not supported yet (a fresh row id "
                     "per retraction half would corrupt join state)")
-            left = RowIdGenExecutor(ex)
+            left: Executor = RowIdGenExecutor(ex)
             lscope = Scope(left.schema, scope.qualifiers + [None])
-            jn = sel.joins[0]
-            rex, rscope, rdeps = self._base_chain(
-                jn.item, rate_limit, min_chunks)
-            deps += rdeps
-            if rex.pk_indices:
-                raise PlanError(
-                    "JOIN over an MV not supported yet (a fresh row id "
-                    "per retraction half would corrupt join state)")
-            right = RowIdGenExecutor(rex)
-            rscope = Scope(right.schema, rscope.qualifiers + [None])
-            lkeys, rkeys = _equi_keys(jn.on, lscope, rscope)
-            n_l = len(left.schema)
-            lt = StateTable(self.catalog.next_id(), left.schema,
-                            [n_l - 1], self.store,
-                            dist_key_indices=None)
-            rt = StateTable(self.catalog.next_id(), right.schema,
-                            [len(right.schema) - 1], self.store)
-            jt = {"inner": JoinType.INNER, "left": JoinType.LEFT_OUTER,
-                  "right": JoinType.RIGHT_OUTER,
-                  "full": JoinType.FULL_OUTER}[jn.kind]
-            ex = HashJoinExecutor(left, right, lkeys, rkeys, lt, rt,
-                                  actor_id=actor_id, join_type=jt)
-            scope = lscope.concat(rscope)
-            join_pk_cols = [n_l - 1, n_l + len(right.schema) - 1]
-        if sel.where is not None:
-            pred = Binder(scope).bind(sel.where)
-            ex = FilterExecutor(ex, pred)
+            for jn in sel.joins:
+                # pushdown legality by join kind: a conjunct may move
+                # below a side only if that side is NOT null-padded by
+                # this join (else filter-after-join semantics change)
+                if jn.kind in ("inner", "left"):
+                    left, conjuncts = _push_filters(left, lscope,
+                                                    conjuncts)
+                rex, rscope, rdeps = self._base_chain(
+                    jn.item, rate_limit, min_chunks)
+                deps += rdeps
+                if rex.pk_indices:
+                    raise PlanError(
+                        "JOIN over an MV not supported yet (a fresh row "
+                        "id per retraction half would corrupt join "
+                        "state)")
+                right: Executor = RowIdGenExecutor(rex)
+                rscope = Scope(right.schema, rscope.qualifiers + [None])
+                if jn.kind in ("inner", "right"):
+                    right, conjuncts = _push_filters(right, rscope,
+                                                     conjuncts)
+                lkeys, rkeys = _equi_keys(jn.on, lscope, rscope)
+                lt = StateTable(self.catalog.next_id(), left.schema,
+                                list(left.pk_indices), self.store,
+                                dist_key_indices=None)
+                rt = StateTable(self.catalog.next_id(), right.schema,
+                                list(right.pk_indices), self.store)
+                jt = {"inner": JoinType.INNER,
+                      "left": JoinType.LEFT_OUTER,
+                      "right": JoinType.RIGHT_OUTER,
+                      "full": JoinType.FULL_OUTER}[jn.kind]
+                left = HashJoinExecutor(left, right, lkeys, rkeys, lt,
+                                        rt, actor_id=actor_id,
+                                        join_type=jt)
+                lscope = lscope.concat(rscope)
+            ex = left
+            scope = lscope
+            join_pk_cols = list(ex.pk_indices)
+        for c in conjuncts:
+            ex = FilterExecutor(ex, Binder(scope).bind(c))
         projections = _expand_star(sel.projections, scope)
         binder = Binder(scope, allow_aggs=True)
         bound = [binder.bind_projection(e) for e, _a in projections]
@@ -312,10 +348,12 @@ class StreamPlanner:
             exprs = list(bound)
             base_pk = list(ex.pk_indices)
             if join_pk_cols is not None:
-                pk = list(range(len(exprs), len(exprs) + 2))
+                pk = list(range(len(exprs),
+                                len(exprs) + len(join_pk_cols)))
                 exprs += [InputRef(c, scope.schema[c].data_type)
                           for c in join_pk_cols]
-                names += ["_row_id_l", "_row_id_r"]
+                names += [f"_row_id_{j}"
+                          for j in range(len(join_pk_cols))]
                 ex = ProjectExecutor(ex, exprs, names)
             elif base_pk:
                 # pk-keyed upstream (MV chain): carry its pk through as
@@ -375,15 +413,21 @@ class StreamPlanner:
         pre_exprs: List[Expression] = list(group_bound)
         pre_names = [f"_g{i}" for i in range(len(group_bound))]
         remapped: List[AggCall] = []
-        for call in binder.agg_calls:
-            if call.input_idx is None:
+        in_expr_idx: Dict[str, int] = {}
+        for call, in_expr in zip(binder.agg_calls, binder.agg_inputs):
+            if in_expr is None:            # count(*)
                 remapped.append(call)
                 continue
-            dt = scope.schema[call.input_idx].data_type
-            pre_exprs.append(InputRef(call.input_idx, dt))
-            remapped.append(AggCall(call.kind, len(pre_exprs) - 1,
+            # identical input expressions share one projected column —
+            # count(DISTINCT x) + sum(DISTINCT x) then share their
+            # dedup table and per-chunk gating in the executor
+            k = repr(in_expr)
+            if k not in in_expr_idx:
+                pre_exprs.append(in_expr)
+                pre_names.append(f"_a{len(pre_exprs) - 1}")
+                in_expr_idx[k] = len(pre_exprs) - 1
+            remapped.append(AggCall(call.kind, in_expr_idx[k],
                                     distinct=call.distinct))
-            pre_names.append(f"_a{len(remapped) - 1}")
         pre = ProjectExecutor(ex, pre_exprs, pre_names)
         g = len(group_bound)
         calls = remapped
@@ -465,6 +509,47 @@ def _agg_output_pk(sel: ast.Select, out_exprs) -> List[int]:
         raise PlanError("every GROUP BY key must appear in the MV's "
                         "SELECT list (it is the MV primary key)")
     return pk
+
+
+def _flatten_and(e: ast.Expr) -> List[ast.Expr]:
+    """WHERE → list of AND conjuncts (pushdown granularity)."""
+    if isinstance(e, ast.Bin) and e.op == "and":
+        return _flatten_and(e.left) + _flatten_and(e.right)
+    return [e]
+
+
+def _push_filters(ex: Executor, scope: Scope,
+                  conjuncts: List[ast.Expr]
+                  ) -> Tuple[Executor, List[ast.Expr]]:
+    """Apply every conjunct bindable in `scope` as a filter on `ex`;
+    return the rest (predicate pushdown, rule/ pushdown analog)."""
+    rest: List[ast.Expr] = []
+    for c in conjuncts:
+        try:
+            pred = Binder(scope).bind(c)
+        except BindError:
+            rest.append(c)
+            continue
+        ex = FilterExecutor(ex, pred)
+    return ex, rest
+
+
+def explain_tree(ex, indent: int = 0) -> List[str]:
+    """Executor chain → indented plan text (planner_test snapshot
+    style; the EXPLAIN statement surfaces it)."""
+    label = getattr(ex, "identity", None) or type(ex).__name__
+    out = [("  " * indent) + label]
+    for attr in ("input", "upstream"):
+        child = getattr(ex, attr, None)
+        if child is not None:
+            out += explain_tree(child, indent + 1)
+            return out
+    left = getattr(ex, "left_in", None)
+    right = getattr(ex, "right_in", None)
+    if left is not None:
+        out += explain_tree(left, indent + 1)
+        out += explain_tree(right, indent + 1)
+    return out
 
 
 def _equi_keys(on: ast.Expr, lscope: Scope, rscope: Scope
@@ -560,12 +645,11 @@ def plan_batch(sel: ast.Select, catalog: Catalog, store, epoch: int):
         group_reprs = [repr(g) for g in group_bound]
         pre_exprs = list(group_bound)
         remapped = []
-        for call in binder.agg_calls:
-            if call.input_idx is None:
+        for call, in_expr in zip(binder.agg_calls, binder.agg_inputs):
+            if in_expr is None:            # count(*)
                 remapped.append(call)
                 continue
-            dt = scope.schema[call.input_idx].data_type
-            pre_exprs.append(InputRef(call.input_idx, dt))
+            pre_exprs.append(in_expr)      # agg over any expression
             remapped.append(AggCall(call.kind, len(pre_exprs) - 1,
                                     distinct=call.distinct))
         pre = BatchProject(ex, pre_exprs)

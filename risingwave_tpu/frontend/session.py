@@ -182,6 +182,8 @@ class Frontend:
             return "CREATE_SOURCE"
         if isinstance(stmt, ast.CreateMaterializedView):
             return await self._create_mv(stmt)
+        if isinstance(stmt, ast.Explain):
+            return self._explain(stmt.select)
         if isinstance(stmt, ast.CreateSink):
             return await self._create_sink(stmt)
         if isinstance(stmt, ast.DropSink):
@@ -254,6 +256,19 @@ class Frontend:
                                            mutation=mutation)
         self._deployed_actor = actor
 
+    def _explain(self, sel: ast.Select) -> Rows:
+        """EXPLAIN <select>: the streaming plan as indented text.
+        Plans against a throwaway barrier manager so no senders or
+        channels leak from a statement that deploys nothing."""
+        from risingwave_tpu.frontend.planner import explain_tree
+        planner = StreamPlanner(self.catalog, self.store,
+                                LocalBarrierManager(), definition="",
+                                mesh=self.mesh, actors=self.actors)
+        plan = planner.plan("__explain__", sel, actor_id=0,
+                            rate_limit=self.rate_limit,
+                            min_chunks=self.min_chunks)
+        return [(line,) for line in explain_tree(plan.consumer)]
+
     async def _create_mv(self, stmt: ast.CreateMaterializedView) -> str:
         self.catalog._check_free(stmt.name)    # validate BEFORE planning
         async with self._barrier_lock:
@@ -262,9 +277,17 @@ class Frontend:
                                     actors=self.actors)
             actor_id = self._next_actor
             self._next_actor += 1
-            plan = planner.plan(stmt.name, stmt.select, actor_id,
-                                rate_limit=self.rate_limit,
-                                min_chunks=self.min_chunks)
+            try:
+                plan = planner.plan(stmt.name, stmt.select, actor_id,
+                                    rate_limit=self.rate_limit,
+                                    min_chunks=self.min_chunks)
+            except BaseException:
+                # a failed plan must leak nothing: source senders were
+                # registered during planning and would wedge the next
+                # barrier round (messages pile into unconsumed channels)
+                for sid in planner.registered_senders:
+                    self.local.drop_actor(sid)
+                raise
             await self._deploy_job(
                 stmt.name, actor_id, plan.consumer, plan.readers,
                 lambda: self.catalog.add_mv(plan.mv),
@@ -287,9 +310,15 @@ class Frontend:
                                     actors=self.actors)
             actor_id = self._next_actor
             self._next_actor += 1
-            plan = planner.plan_sink(stmt.select, stmt.options, actor_id,
-                                     rate_limit=self.rate_limit,
-                                     min_chunks=self.min_chunks)
+            try:
+                plan = planner.plan_sink(
+                    stmt.select, stmt.options, actor_id,
+                    rate_limit=self.rate_limit,
+                    min_chunks=self.min_chunks)
+            except BaseException:
+                for sid in planner.registered_senders:
+                    self.local.drop_actor(sid)
+                raise
             await self._deploy_job(
                 stmt.name, actor_id, plan.consumer, plan.readers,
                 lambda: self.catalog.add_sink(SinkCatalog(

@@ -247,9 +247,8 @@ class HashAggExecutor(Executor):
                          input_idx: int):
         """Vectorized grouping of visible rows by (group key, value).
 
-        Returns (row_idx, first_row_per_key, per_key_row_lists? no —
-        (rows, uniq_inverse, n_uniq, deltas, key_tuple_fn)) where
-        python work is O(distinct keys), not O(rows)
+        Returns (rows, inverse, n_uniq, deltas, key_tuple_fn, order,
+        starts) — python work is O(distinct keys), not O(rows)
         (hash_agg.rs minput/distinct parity without the per-row loop).
         """
         from risingwave_tpu.stream.executors.keys import to_i64

@@ -375,3 +375,63 @@ def test_count_distinct_sql():
     got, want = asyncio.run(main())
     assert got == want
     assert any(r[1] < r[2] for r in got)   # dedup actually differs
+
+
+def test_failed_create_mv_leaks_nothing():
+    """A CREATE whose planning fails (bind error after sources were
+    registered) must not wedge later barrier rounds (r3 review)."""
+    import asyncio
+
+    from risingwave_tpu.frontend.session import Frontend
+
+    async def main():
+        f = Frontend(rate_limit=2)
+        await f.execute(NEXMARK_BID)
+        with pytest.raises(Exception):
+            await f.execute(
+                "CREATE MATERIALIZED VIEW bad AS SELECT nonexistent "
+                "FROM bid")
+        # pipeline still healthy: a real MV deploys and barriers flow
+        await f.execute(
+            "CREATE MATERIALIZED VIEW ok AS SELECT auction FROM bid")
+        for _ in range(12):
+            await asyncio.wait_for(f.step(), timeout=10)
+        n = (await f.execute("SELECT count(*) FROM ok"))[0][0]
+        await f.close()
+        return n
+
+    assert asyncio.run(main()) > 0
+
+
+def test_outer_join_where_is_not_pushed_below_padded_side():
+    """WHERE on the null-padded side of a LEFT JOIN must filter AFTER
+    the join (pushing it below changes results — r3 review)."""
+    import asyncio
+
+    from risingwave_tpu.frontend.session import Frontend
+
+    async def main():
+        f = Frontend(rate_limit=2)
+        await f.execute(
+            "CREATE SOURCE person WITH (connector='nexmark', "
+            "nexmark.table.type='person', nexmark.event.num=2000, "
+            "nexmark.max.chunk.size=128)")
+        await f.execute(
+            "CREATE SOURCE auction WITH (connector='nexmark', "
+            "nexmark.table.type='auction', nexmark.event.num=2000, "
+            "nexmark.max.chunk.size=128)")
+        await f.execute(
+            "CREATE MATERIALIZED VIEW v AS SELECT p.id, a.seller "
+            "FROM person AS p LEFT OUTER JOIN auction AS a "
+            "ON p.id = a.seller WHERE a.seller > 0")
+        for _ in range(25):
+            await f.step()
+        rows = await f.execute("SELECT * FROM v")
+        await f.close()
+        return rows
+
+    rows = asyncio.run(main())
+    # filter-after-join: NULL-padded rows fail a.seller > 0 and are
+    # dropped — pushing below the join would have KEPT them
+    assert rows
+    assert all(r[1] is not None for r in rows)
