@@ -8,29 +8,41 @@ whole-batch kernels, while row payloads stay in host arenas (varchar can
 never live in HBM anyway — the device's job is the equality/match
 structure, the host's job is materialization):
 
-    table  DeviceHashTable     join-key lanes → key slot
-    head   int32[cap]          key slot → first row ref (-1 end)
-    next   int32[row_cap]      row ref → next row ref in its key chain
-    live   bool[row_cap]       tombstones (deletes unlink lazily)
+    table    DeviceHashTable   join-key lanes → key slot
+    head     int32[cap]        key slot → first row ref (-1 end)
+    next     int32[row_cap]    row ref → next row ref in its key chain
+    ins_seq  int32[row_cap]    message sequence that inserted the row
+    del_seq  int32[row_cap]    message sequence that deleted it (MAX=∞)
+
+SEQUENCE-VERSIONED state (the load-bearing TPU design choice): every
+message carries a monotone sequence number, and a probe at sequence s
+sees exactly the rows with ``ins_seq < s <= del_seq`` — i.e. the state
+as of message s, regardless of when the probe's RESULT is read. That
+makes probes pure functions of (end-of-epoch state, s), so the host can
+dispatch every chunk's probe asynchronously, fetch ALL results in one
+DMA round at the barrier, and safely RE-dispatch any probe whose pair
+buffer overflowed — on a tunneled device where every blocking read
+costs 70ms+, this is the difference between per-chunk and per-epoch
+synchronization. (The reference's hashbrown map reads are synchronous
+CPU lookups and need none of this.)
 
 - ``insert``: whole-batch: one key probe-insert, then one chain-link
   kernel. Rows of one batch that share a key are chained to each other
   with one stable sort + shifted compares — no per-row host loop.
-- ``delete``: tombstone (live=False). Chains keep the node until a
-  rebuild; probes skip dead rows.
+- ``delete``: sets del_seq. Chains keep the node until a rebuild;
+  probes at later sequences skip it.
 - ``probe``: ONE fused kernel — degree-count walk, device cumsum, emit
   walk writing (probe_row, matched_ref) pairs at the cumsum offsets,
-  all returned as one packed matrix with a header (one device→host
-  transfer per chunk; host doubles the pair buffer and retries if the
-  header reports overflow). ``lax.while_loop`` runs exactly
-  max-chain-length iterations (dynamic trip count, static shapes).
+  all returned as one packed matrix with a header. ``lax.while_loop``
+  runs exactly max-chain-length iterations (dynamic trip count, static
+  shapes).
 
 All lanes int32 (ops/lanes.py rationale).
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -40,17 +52,21 @@ from risingwave_tpu.ops import hash_table as ht
 from risingwave_tpu.utils import jaxtools
 
 
+I32_MAX = (1 << 31) - 1
+
+
 class ChainState(NamedTuple):
     """Functional chain arrays (the non-key half of a join side)."""
 
-    head: jnp.ndarray    # int32[cap]
-    next: jnp.ndarray    # int32[row_cap]
-    live: jnp.ndarray    # bool[row_cap]
+    head: jnp.ndarray     # int32[cap]
+    next: jnp.ndarray     # int32[row_cap]
+    ins_seq: jnp.ndarray  # int32[row_cap] (I32_MAX = never inserted)
+    del_seq: jnp.ndarray  # int32[row_cap] (I32_MAX = live)
 
 
 def link_rows(chains: ChainState, slots: jnp.ndarray,
               row_refs: jnp.ndarray, vis: jnp.ndarray,
-              cap: int) -> ChainState:
+              cap: int, seq: jnp.ndarray = None) -> ChainState:
     """Front-insert a batch of rows into their key chains.
 
     `slots` comes from the key table's probe_insert for the same batch;
@@ -71,23 +87,24 @@ def link_rows(chains: ChainState, slots: jnp.ndarray,
         nxt_val, mode="drop")
     head = chains.head.at[jnp.where(valid & first, s, cap)].set(
         r, mode="drop")
-    live = chains.live.at[jnp.where(valid, r, row_cap)].set(
-        True, mode="drop")
-    return ChainState(head, nxt, live)
+    ins = chains.ins_seq.at[jnp.where(valid, r, row_cap)].set(
+        jnp.int32(0) if seq is None else seq, mode="drop")
+    return ChainState(head, nxt, ins, chains.del_seq)
 
 
 def tombstone_rows(chains: ChainState, row_refs: jnp.ndarray,
-                   vis: jnp.ndarray) -> ChainState:
-    """Tombstone deletes; the chain node is skipped by probes."""
+                   vis: jnp.ndarray,
+                   seq: jnp.ndarray = None) -> ChainState:
+    """Tombstone deletes; probes at sequences > seq skip the node."""
     row_cap = int(chains.next.shape[0])
-    live = chains.live.at[jnp.where(vis, row_refs, row_cap)].set(
-        False, mode="drop")
-    return chains._replace(live=live)
+    del_ = chains.del_seq.at[jnp.where(vis, row_refs, row_cap)].set(
+        jnp.int32(0) if seq is None else seq, mode="drop")
+    return chains._replace(del_seq=del_)
 
 
 def probe_pairs(table: ht.TableState, chains: ChainState,
                 key_lanes: jnp.ndarray, vis: jnp.ndarray,
-                out_cap: int) -> jnp.ndarray:
+                seq: jnp.ndarray, out_cap: int) -> jnp.ndarray:
     """Fused degrees + cumsum + emit: ONE kernel, ONE packed d2h array.
 
     Returns int32[1 + n + out_cap, 2]: row 0 header [total_pairs, 0];
@@ -105,10 +122,13 @@ def probe_pairs(table: ht.TableState, chains: ChainState,
     def cond(c):
         return jnp.any(c[0] >= 0)
 
+    def visible(safe):
+        return (chains.ins_seq[safe] < seq) & (chains.del_seq[safe] >= seq)
+
     def body1(c):
         cur, deg = c
         safe = jnp.maximum(cur, 0)
-        m = (cur >= 0) & chains.live[safe]
+        m = (cur >= 0) & visible(safe)
         return (jnp.where(cur >= 0, chains.next[safe], jnp.int32(-1)),
                 deg + m.astype(jnp.int32))
 
@@ -121,7 +141,7 @@ def probe_pairs(table: ht.TableState, chains: ChainState,
     def body2(c):
         cur, wp, op, orf = c
         safe = jnp.maximum(cur, 0)
-        m = (cur >= 0) & chains.live[safe]
+        m = (cur >= 0) & visible(safe)
         dest = jnp.where(m, wp, out_cap)
         op = op.at[dest].set(row_ids, mode="drop")
         orf = orf.at[dest].set(cur, mode="drop")
@@ -140,7 +160,7 @@ def probe_pairs(table: ht.TableState, chains: ChainState,
 
 _link_jit = jax.jit(link_rows, donate_argnums=(0,), static_argnums=(4,))
 _tombstone_jit = jax.jit(tombstone_rows, donate_argnums=(0,))
-_probe_pairs_jit = jax.jit(probe_pairs, static_argnums=(4,))
+_probe_pairs_jit = jax.jit(probe_pairs, static_argnums=(5,))
 
 
 def _remap_head(head: jnp.ndarray, old_to_new: jnp.ndarray,
@@ -151,6 +171,55 @@ def _remap_head(head: jnp.ndarray, old_to_new: jnp.ndarray,
 
 
 _remap_head_jit = jax.jit(_remap_head, static_argnums=(2,))
+
+
+@jax.jit
+def _rebase_seq(chains: ChainState) -> ChainState:
+    mx = jnp.int32(I32_MAX)
+    return chains._replace(
+        ins_seq=jnp.where(chains.ins_seq == mx, mx, jnp.int32(0)),
+        del_seq=jnp.where(chains.del_seq == mx, mx, jnp.int32(0)))
+
+
+_rebase_jit = _rebase_seq
+
+
+class PendingProbe:
+    """An in-flight probe: dispatched, DMA started, not yet read.
+
+    Sequence versioning makes collect() safe at any later point — the
+    kernel may have applied more messages, and a re-dispatch after a
+    pair-buffer overflow still returns the probe-time result."""
+
+    def __init__(self, kernel: "JoinSideKernel", mat, key_lanes, vis,
+                 seq, cap: int):
+        self.kernel = kernel
+        self.mat = mat
+        self.key_lanes = key_lanes
+        self.vis = vis
+        self.seq = seq
+        self.cap = cap
+
+    def collect(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(degrees, probe_idx[pairs], refs[pairs])."""
+        n = int(self.key_lanes.shape[0])
+        while True:
+            mat = jaxtools.fetch1(self.mat)
+            total = int(mat[0, 0])
+            if total <= self.cap:
+                break
+            from risingwave_tpu.common.chunk import next_pow2
+            self.cap = max(self.cap * 2, next_pow2(total))
+            self.kernel._probe_cap = max(self.kernel._probe_cap,
+                                         self.cap)
+            self.mat = _probe_pairs_jit(
+                self.kernel.table.state, self.kernel.chains,
+                self.key_lanes, self.vis, self.seq, self.cap)
+            jaxtools.start_fetch(self.mat)
+        deg = np.ascontiguousarray(mat[1:1 + n, 0])
+        pairs = mat[1 + n:1 + n + total]
+        return (deg, np.ascontiguousarray(pairs[:, 0]),
+                np.ascontiguousarray(pairs[:, 1]))
 
 
 class JoinSideKernel:
@@ -183,7 +252,8 @@ class JoinSideKernel:
         self.chains = ChainState(
             head=jnp.full(self.table.capacity, -1, dtype=jnp.int32),
             next=jnp.full(row_capacity, -1, dtype=jnp.int32),
-            live=jnp.zeros(row_capacity, dtype=bool))
+            ins_seq=jnp.full(row_capacity, I32_MAX, dtype=jnp.int32),
+            del_seq=jnp.full(row_capacity, I32_MAX, dtype=jnp.int32))
 
     @property
     def row_capacity(self) -> int:
@@ -207,42 +277,53 @@ class JoinSideKernel:
         self.chains = self.chains._replace(
             next=jnp.concatenate(
                 [self.chains.next, jnp.full(pad, -1, dtype=jnp.int32)]),
-            live=jnp.concatenate(
-                [self.chains.live, jnp.zeros(pad, dtype=bool)]))
+            ins_seq=jnp.concatenate(
+                [self.chains.ins_seq,
+                 jnp.full(pad, I32_MAX, dtype=jnp.int32)]),
+            del_seq=jnp.concatenate(
+                [self.chains.del_seq,
+                 jnp.full(pad, I32_MAX, dtype=jnp.int32)]))
 
     # -- ops --------------------------------------------------------------
+    # seq=0 defaults keep kernel-level tests/recovery simple: probes at
+    # seq 0 use I32_MAX and see everything inserted at seq 0.
     def insert(self, key_lanes: jnp.ndarray, row_refs: np.ndarray,
-               vis: jnp.ndarray) -> None:
+               vis: jnp.ndarray, seq: int = 0) -> None:
         if len(row_refs):
             self.reserve_rows(int(np.max(row_refs)))
         slots = self.table.probe_insert(key_lanes, vis)
         self.chains = _link_jit(self.chains, slots,
                                 jnp.asarray(row_refs), vis,
-                                self.table.capacity)
+                                self.table.capacity, jnp.int32(seq))
 
-    def delete(self, row_refs: np.ndarray, vis: jnp.ndarray) -> None:
+    def delete(self, row_refs: np.ndarray, vis: jnp.ndarray,
+               seq: int = 0) -> None:
         self.chains = _tombstone_jit(self.chains, jnp.asarray(row_refs),
-                                     vis)
+                                     vis, jnp.int32(seq))
 
-    def probe(self, key_lanes: jnp.ndarray, vis: jnp.ndarray
+    def probe_submit(self, key_lanes: jnp.ndarray, vis: jnp.ndarray,
+                     seq: Optional[int] = None) -> "PendingProbe":
+        """Dispatch the fused probe and kick its DMA; no blocking.
+        The result is a pure function of (state, seq): collect() may
+        run after later applies and may re-dispatch on overflow."""
+        s = jnp.int32(I32_MAX if seq is None else seq)
+        mat = _probe_pairs_jit(self.table.state, self.chains, key_lanes,
+                               vis, s, self._probe_cap)
+        jaxtools.start_fetch(mat)
+        return PendingProbe(self, mat, key_lanes, vis, s,
+                            self._probe_cap)
+
+    def probe(self, key_lanes: jnp.ndarray, vis: jnp.ndarray,
+              seq: Optional[int] = None
               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """(degrees, probe_idx[pairs], refs[pairs]) — ONE device→host
-        transfer (fused probe_pairs kernel; doubles the pair buffer and
-        retries if the header reports overflow)."""
-        n = int(key_lanes.shape[0])
-        while True:
-            mat = jaxtools.fetch1(_probe_pairs_jit(
-                self.table.state, self.chains, key_lanes, vis,
-                self._probe_cap))
-            total = int(mat[0, 0])
-            if total <= self._probe_cap:
-                break
-            from risingwave_tpu.common.chunk import next_pow2
-            self._probe_cap = max(self._probe_cap * 2, next_pow2(total))
-        deg = np.ascontiguousarray(mat[1:1 + n, 0])
-        pairs = mat[1 + n:1 + n + total]
-        return (deg, np.ascontiguousarray(pairs[:, 0]),
-                np.ascontiguousarray(pairs[:, 1]))
+        """Synchronous submit+collect (tests, recovery)."""
+        return self.probe_submit(key_lanes, vis, seq).collect()
+
+    def rebase_seq(self) -> None:
+        """Reset every finite ins/del sequence to 0 (a safe point with
+        no probes in flight) so the int32 message counter can restart
+        instead of wrapping."""
+        self.chains = _rebase_jit(self.chains)
 
     # -- recovery ---------------------------------------------------------
     def rebuild(self, key_lanes: np.ndarray, row_refs: np.ndarray) -> None:
@@ -258,8 +339,9 @@ class JoinSideKernel:
         self.chains = ChainState(
             head=jnp.full(self.table.capacity, -1, dtype=jnp.int32),
             next=jnp.full(row_cap, -1, dtype=jnp.int32),
-            live=jnp.zeros(row_cap, dtype=bool))
+            ins_seq=jnp.full(row_cap, I32_MAX, dtype=jnp.int32),
+            del_seq=jnp.full(row_cap, I32_MAX, dtype=jnp.int32))
         if n == 0:
             return
         self.insert(jnp.asarray(key_lanes), row_refs,
-                    jnp.ones(n, dtype=bool))
+                    jnp.ones(n, dtype=bool), seq=0)

@@ -27,7 +27,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from risingwave_tpu.common.hash import VNODE_COUNT
 from risingwave_tpu.ops import hash_table as ht
-from risingwave_tpu.ops.hash_join import ChainState, link_rows, probe_pairs
+from risingwave_tpu.ops.hash_join import (
+    I32_MAX, ChainState, link_rows, probe_pairs,
+)
 from risingwave_tpu.parallel.exchange import (
     bucketize_by_owner, exchange, vnodes_from_lanes,
 )
@@ -67,7 +69,10 @@ class ShardedJoinSide:
         self.chains = ChainState(
             head=stack(jnp.full(key_capacity, -1, dtype=jnp.int32)),
             next=stack(jnp.full(row_capacity, -1, dtype=jnp.int32)),
-            live=stack(jnp.zeros(row_capacity, dtype=bool)))
+            ins_seq=stack(jnp.full(row_capacity, I32_MAX,
+                                   dtype=jnp.int32)),
+            del_seq=stack(jnp.full(row_capacity, I32_MAX,
+                                   dtype=jnp.int32)))
         self._insert_cache: Dict[Tuple[int, int], object] = {}
         self._probe_cache: Dict[Tuple[int, int, int], object] = {}
         self._keys_upper = 0      # distinct-key upper bound (host)
@@ -89,7 +94,8 @@ class ShardedJoinSide:
             rrefs = recv[1].reshape(m)
             rvis = rvalid.reshape(m)
             table, slots, _ins = ht.probe_insert(table, rkeys, rvis)
-            chains = link_rows(chains, slots, rrefs, rvis, cap)
+            chains = link_rows(chains, slots, rrefs, rvis, cap,
+                               jnp.int32(0))
             return (jax.tree.map(lambda a: a[None], table),
                     jax.tree.map(lambda a: a[None], chains),
                     overflow[None])
@@ -117,7 +123,8 @@ class ShardedJoinSide:
             rkeys = recv[0].reshape(m, key_lanes.shape[1])
             rids = recv[1].reshape(m)
             rvis = rvalid.reshape(m)
-            mat = probe_pairs(table, chains, rkeys, rvis, out_cap)
+            mat = probe_pairs(table, chains, rkeys, rvis,
+                              jnp.int32(I32_MAX), out_cap)
             # rewrite probe-row indices (local post-exchange positions)
             # to the routed global row ids; -1 stays -1
             pairs = mat[1 + m:]

@@ -8,22 +8,27 @@ row chains probed as whole-batch kernels; the host owns row payloads
 (typed column arenas; varchar never ships to HBM) and materializes
 output chunks with vectorized gathers.
 
-Chunk lifecycle on side S (probing side O), mirroring eq_join_oneside:
-  1. probe every visible row of the chunk against O's current state
-     (two device passes: degrees, then pair emission at cumsum offsets)
-  2. emit matched rows: S columns gathered from the chunk, O columns
-     gathered from O's arena; Insert rows emit Insert matches, Delete
-     rows emit Delete matches (update pairs degrade to Delete+Insert —
-     the reference degrades split pairs the same way)
-  3. apply the chunk to S's own state: inserts allocate arena refs and
-     front-link into the device chains; deletes tombstone
-  4. barrier: both sides' StateTables commit (rows were written through
-     write_chunk as they flowed); recovery rebuilds arena + chains
+Chunk lifecycle on side S (probing side O), mirroring eq_join_oneside
+but ASYNC (sequence-versioned state, see ops/hash_join.py):
+  1. dispatch: submit the fused probe against O at the chunk's message
+     sequence (DMA starts; nothing blocks) and apply the chunk to S's
+     own state at the same sequence (inserts allocate arena refs and
+     front-link; deletes tombstone)
+  2. barrier (or a watermark that must trail the data): collect every
+     in-flight probe in message order — each result is exact for its
+     sequence no matter how much state advanced — and emit: matched
+     pairs (S columns from the chunk, O columns from O's arena), outer
+     NULL-padding, semi/anti rows, and degree-transition flips. Update
+     pairs degrade to Delete+Insert, as the reference degrades split
+     pairs.
+  3. both sides' StateTables commit; watermark expiry and compaction
+     run AFTER the sweep (they rewrite device state that a re-
+     dispatched probe would need); recovery rebuilds arena + chains
+     and recomputes degrees with one batch probe.
 
 Inner-join NULL semantics: rows whose join key contains NULL can never
 match and are not stored (the reference's null-safe flag is per-column;
-non-null-safe is the SQL default). Degree tables for outer joins are the
-next increment.
+non-null-safe is the SQL default).
 """
 
 from __future__ import annotations
@@ -225,7 +230,7 @@ class _JoinSide:
         return m
 
     def apply_chunk(self, chunk: StreamChunk, key_lanes: np.ndarray,
-                    nonnull: Optional[np.ndarray] = None
+                    nonnull: Optional[np.ndarray] = None, seq: int = 0
                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Update this side's state with the chunk's inserts/deletes.
 
@@ -279,9 +284,9 @@ class _JoinSide:
             mask = np.zeros(chunk.capacity, dtype=bool)
             mask[ins_idx] = True
             self.kernel.insert(jnp.asarray(key_lanes), full_refs,
-                               jnp.asarray(mask))
+                               jnp.asarray(mask), seq=seq)
         if del_mask.any():
-            self.kernel.delete(del_refs, jnp.asarray(del_mask))
+            self.kernel.delete(del_refs, jnp.asarray(del_mask), seq=seq)
         self.table.write_chunk(chunk)
         return ins_idx, ins_refs, del_mask
 
@@ -326,7 +331,8 @@ class _JoinSide:
                          dtype=np.int32),
                 new_refs)
 
-    def expire_below(self, key_pos: int, wm_physical) -> int:
+    def expire_below(self, key_pos: int, wm_physical,
+                     seq: int = 0) -> int:
         """Watermark state expiry (hash_join.rs:860-945 analog): drop
         every stored row whose ``key_pos``-th join-key column is below
         the watermark. Host side: vectorized scan of live refs → dead
@@ -358,7 +364,7 @@ class _JoinSide:
         del_refs[:n_dead] = dead_refs
         mask = np.zeros(cap, dtype=bool)
         mask[:n_dead] = True
-        self.kernel.delete(del_refs, jnp.asarray(mask))
+        self.kernel.delete(del_refs, jnp.asarray(mask), seq=seq)
         return n_dead
 
     def recover(self) -> None:
@@ -448,6 +454,10 @@ class HashJoinExecutor(Executor):
         self._side_wm: List[Dict[int, int]] = [{}, {}]
         self._combined_wm: Dict[int, int] = {}
         self._expired_wm: Dict[int, int] = {}
+        # message sequence (sequence-versioned device state; see
+        # ops/hash_join.py) + per-epoch in-flight probe list
+        self._seq = 1
+        self._pending: List[tuple] = []
 
     # -- emission ---------------------------------------------------------
     @staticmethod
@@ -558,13 +568,44 @@ class HashJoinExecutor(Executor):
         ops = np.full(cap, int(op), dtype=np.int8)
         return StreamChunk(self.schema, cols, vis, ops)
 
-    def _process_chunk(self, side_idx: int, chunk: StreamChunk,
-                       key_lanes, nonnull: np.ndarray
-                       ) -> List[StreamChunk]:
-        """One chunk on side S: probe O, emit per join type, apply to S.
+    def _ingest_chunk(self, side_idx: int, chunk: StreamChunk,
+                      key_lanes, nonnull: np.ndarray) -> None:
+        """Dispatch side: submit the probe (async DMA) and apply the
+        chunk to this side's state at its message sequence. NO blocking
+        reads — results are collected in one sweep at the barrier
+        (sequence versioning keeps the late-read probes exact)."""
+        me = self.sides[side_idx]
+        other = self.sides[1 - side_idx]
+        seq = self._seq
+        self._seq += 1
+        probe_vis = np.asarray(chunk.visibility) & nonnull
+        handle = None
+        if probe_vis.any():
+            handle = other.kernel.probe_submit(
+                jnp.asarray(key_lanes), jnp.asarray(probe_vis), seq)
+        ins_idx, ins_refs, _dels = me.apply_chunk(
+            chunk, key_lanes, nonnull=nonnull, seq=seq)
+        self._pending.append(
+            (side_idx, chunk, nonnull, handle, ins_idx, ins_refs))
 
-        Emission per eq_join_oneside (hash_join.rs:990) generalized to
-        the degree-transition rule: a stored outer row flips its
+    def _emit_pending(self) -> List[StreamChunk]:
+        """Barrier sweep: collect every in-flight probe (the DMAs have
+        been running since dispatch) and run emission in message order.
+        Degree bookkeeping happens here, in the same order the chunks
+        were applied."""
+        outs: List[StreamChunk] = []
+        for (side_idx, chunk, nonnull, handle, ins_idx,
+             ins_refs) in self._pending:
+            outs.extend(self._emit_one(side_idx, chunk, nonnull, handle,
+                                       ins_idx, ins_refs))
+        self._pending.clear()
+        return outs
+
+    def _emit_one(self, side_idx: int, chunk: StreamChunk,
+                  nonnull: np.ndarray, handle, ins_idx: np.ndarray,
+                  ins_refs: np.ndarray) -> List[StreamChunk]:
+        """Emission per eq_join_oneside (hash_join.rs:990) generalized
+        to the degree-transition rule: a stored outer row flips its
         NULL-padded emission exactly when its match degree crosses zero
         (net per-chunk delta vs the old degree — intermediate flips
         within one chunk cancel, leaving the same multiset)."""
@@ -572,14 +613,12 @@ class HashJoinExecutor(Executor):
         me = self.sides[side_idx]
         other = self.sides[1 - side_idx]
         vis = np.asarray(chunk.visibility)
-        probe_vis = vis & nonnull
         n = chunk.capacity
         deg = np.zeros(n, dtype=np.int64)
         probe_idx = np.zeros(0, dtype=np.int32)
         refs = np.zeros(0, dtype=np.int32)
-        if probe_vis.any():
-            deg_p, probe_idx, refs = other.kernel.probe(
-                jnp.asarray(key_lanes), jnp.asarray(probe_vis))
+        if handle is not None:
+            deg_p, probe_idx, refs = handle.collect()
             deg[:len(deg_p)] = deg_p
         outs: List[StreamChunk] = []
         # 1) matched pairs (all types except semi/anti)
@@ -627,10 +666,10 @@ class HashJoinExecutor(Executor):
                 if len(flip_off):
                     outs.append(self._padded_from_arena(
                         1 - side_idx, flip_off, Op.INSERT))
-        # 4) apply to my state (+ initial degrees for stored rows)
-        ins_idx, ins_refs, _dels = me.apply_chunk(chunk, key_lanes,
-                                                  nonnull=nonnull)
+        # 4) initial degrees for the rows this chunk stored (the state
+        # apply already ran at dispatch; deg is the probe-time count)
         if side_idx in jt.tracked_sides and len(ins_idx):
+            # degrees array already grown by apply_chunk at dispatch
             me.degrees[ins_refs] = deg[ins_idx]
         return outs
 
@@ -676,7 +715,10 @@ class HashJoinExecutor(Executor):
             if not np.issubdtype(dt, np.integer):
                 continue       # float keys: no order-safe expiry
             for side in self.sides:
-                side.expire_below(pos, int(wm))
+                side.expire_below(pos, int(wm), seq=self._seq)
+            # bump: visibility is del_seq >= probe_seq, so the NEXT
+            # chunk's sequence must exceed the tombstones' del_seq
+            self._seq += 1
             self._expired_wm[pos] = wm
 
     def _recover_degrees(self) -> None:
@@ -720,10 +762,22 @@ class HashJoinExecutor(Executor):
         yield first_l
         async for tag, msg in barrier_align_2(lit, rit):
             if tag == "barrier":
+                # consume pending probes FIRST — expiry/compaction
+                # rebuild device state and would invalidate a
+                # re-dispatched probe's sequence view
+                for out in self._emit_pending():
+                    yield out
                 self._expire_state()
                 for side in self.sides:
                     side.table.commit(msg.epoch)
                     side.maybe_compact()
+                if self._seq > (1 << 30):
+                    # int32 sequence headroom: with no probes in
+                    # flight, rebase every finite seq to 0 and restart
+                    # (a wrap would blank every probe's visibility)
+                    for side in self.sides:
+                        side.kernel.rebase_seq()
+                    self._seq = 1
                 yield msg
             elif tag in ("left", "right"):
                 i = 0 if tag == "left" else 1
@@ -734,10 +788,14 @@ class HashJoinExecutor(Executor):
                     lanes_np, nonnull = \
                         self.sides[i].key_codec.build_with_mask(
                             msg, self.sides[i].key_indices)
-                    lanes_dev = jnp.asarray(lanes_np)
-                    for out in self._process_chunk(i, msg, lanes_dev,
-                                                   nonnull):
-                        yield out
+                    self._ingest_chunk(i, msg, jnp.asarray(lanes_np),
+                                       nonnull)
                 elif isinstance(msg, Watermark):
-                    for wm in self._on_watermark(i, msg):
+                    wms = list(self._on_watermark(i, msg))
+                    if wms:
+                        # buffered join outputs must precede any
+                        # watermark that could close windows over them
+                        for out in self._emit_pending():
+                            yield out
+                    for wm in wms:
                         yield wm
