@@ -16,6 +16,7 @@ data flow: injection is pipelined up to the in-flight window.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
@@ -45,12 +46,49 @@ class BarrierStats:
                 if self.latencies_s else 0.0)
 
 
+class VirtualClock:
+    """Deterministic time source (the madsim stance, SURVEY §4:
+    replace time, keep the program): `sleep` advances virtual time and
+    yields once so actors run — a whole barrier schedule executes
+    deterministically at full speed. ``install()`` also rebinds the
+    EPOCH clock (common/epoch.py), so epoch values — and thus SST keys
+    and committed_epoch — are identical across runs, not wall-clock
+    residue."""
+
+    def __init__(self, start_s: float = 1_700_000_000.0) -> None:
+        self.t = 0.0
+        self.start_s = start_s
+
+    def monotonic(self) -> float:
+        return self.t
+
+    def time(self) -> float:
+        return self.start_s + self.t
+
+    async def sleep(self, delay: float) -> None:
+        # yield FIRST: a sleep cancelled by the barrier loop's
+        # first-completed race must not have consumed its interval
+        await asyncio.sleep(0)
+        self.t += delay
+
+    @contextlib.contextmanager
+    def install(self):
+        """Bind the global epoch clock to virtual time for the block."""
+        from risingwave_tpu.common.epoch import set_clock
+        prev = set_clock(self.time)
+        try:
+            yield self
+        finally:
+            set_clock(prev)
+
+
 class BarrierLoop:
     """GlobalBarrierManager-lite driving one LocalBarrierManager.
 
     Two driving modes:
     - `run()`: background task ticking `interval_ms` on the (injectable)
-      wall clock — production shape.
+      clock + sleeper — production shape on the wall clock, the
+      deterministic simulation under a VirtualClock.
     - `inject_and_collect()` / `checkpoint()`: explicit stepping for tests
       and benchmarks (deterministic; no timers).
     """
@@ -58,13 +96,15 @@ class BarrierLoop:
     def __init__(self, local: LocalBarrierManager, store: StateStore,
                  interval_ms: int = 250, checkpoint_frequency: int = 1,
                  in_flight_barrier_nums: int = 10,
-                 monotonic: Callable[[], float] = time.monotonic):
+                 monotonic: Callable[[], float] = time.monotonic,
+                 sleep=asyncio.sleep):
         self.local = local
         self.store = store
         self.interval_ms = interval_ms
         self.checkpoint_frequency = max(1, checkpoint_frequency)
         self.in_flight_barrier_nums = max(1, in_flight_barrier_nums)
         self.monotonic = monotonic
+        self.sleep = sleep
         self.stats = BarrierStats()
         self._epoch: Optional[Epoch] = None
         self._barriers_since_checkpoint = 0
@@ -181,7 +221,7 @@ class BarrierLoop:
                 if collector is None and self._in_flight:
                     collector = asyncio.ensure_future(self.collect_next())
                 delay = max(0.0, next_tick - self.monotonic())
-                sleeper = asyncio.ensure_future(asyncio.sleep(delay))
+                sleeper = asyncio.ensure_future(self.sleep(delay))
                 waits = {sleeper} | ({collector} if collector else set())
                 done, _ = await asyncio.wait(
                     waits, return_when=asyncio.FIRST_COMPLETED)

@@ -174,14 +174,11 @@ _remap_head_jit = jax.jit(_remap_head, static_argnums=(2,))
 
 
 @jax.jit
-def _rebase_seq(chains: ChainState) -> ChainState:
+def _rebase_jit(chains: ChainState) -> ChainState:
     mx = jnp.int32(I32_MAX)
     return chains._replace(
         ins_seq=jnp.where(chains.ins_seq == mx, mx, jnp.int32(0)),
         del_seq=jnp.where(chains.del_seq == mx, mx, jnp.int32(0)))
-
-
-_rebase_jit = _rebase_seq
 
 
 class PendingProbe:

@@ -27,6 +27,7 @@ from collections import OrderedDict
 from typing import Dict, Iterable, Iterator, List, Optional, Tuple
 
 from risingwave_tpu.state.store import StateStore, Value
+from risingwave_tpu.utils.failpoint import fail_point
 from risingwave_tpu.storage.object_store import ObjectStore
 from risingwave_tpu.storage.sst import (
     EPOCH_MASK, Sst, SstBuilder, full_key, split_full_key,
@@ -111,6 +112,7 @@ class HummockLite(StateStore):
 
     def sync(self, epoch: int) -> dict:
         """Upload all imms ≤ epoch as one SST; commit the version."""
+        fail_point("hummock.sync")
         take = [im for im in self._imms if im[0] <= epoch]
         self._imms = [im for im in self._imms if im[0] > epoch]
         info = None

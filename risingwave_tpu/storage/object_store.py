@@ -12,6 +12,8 @@ pattern (SSTs are write-once).
 from __future__ import annotations
 
 import os
+
+from risingwave_tpu.utils.failpoint import fail_point
 import tempfile
 from typing import Dict, List, Protocol
 
@@ -35,9 +37,11 @@ class MemObjectStore:
         self._objects: Dict[str, bytes] = {}
 
     def upload(self, path: str, data: bytes) -> None:
+        fail_point("object_store.upload")
         self._objects[path] = bytes(data)
 
     def read(self, path: str) -> bytes:
+        fail_point("object_store.read")
         return self._objects[path]
 
     def delete(self, path: str) -> None:
@@ -64,6 +68,7 @@ class LocalFsObjectStore:
         return p
 
     def upload(self, path: str, data: bytes) -> None:
+        fail_point("object_store.upload")
         dst = self._abs(path)
         os.makedirs(os.path.dirname(dst), exist_ok=True)
         fd, tmp = tempfile.mkstemp(dir=os.path.dirname(dst))
@@ -77,6 +82,7 @@ class LocalFsObjectStore:
             raise
 
     def read(self, path: str) -> bytes:
+        fail_point("object_store.read")
         with open(self._abs(path), "rb") as f:
             return f.read()
 

@@ -34,7 +34,7 @@ non-null-safe is the SQL default).
 from __future__ import annotations
 
 import enum
-from typing import AsyncIterator, Dict, List, Optional, Sequence
+from typing import AsyncIterator, Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np

@@ -1,0 +1,61 @@
+"""Failpoint injection (src/storage/src/storage_failpoints/ +
+`fail_point!` macro analog).
+
+Production cost is one dict lookup against an empty registry. Tests arm
+named points with an exception factory or a probability:
+
+    with failpoints({"object_store.upload": OSError("disk gone")}):
+        ...
+    with failpoints({"object_store.read": (0.2, OSError("flaky"))},
+                    seed=7):
+        ...
+
+Probabilistic points draw from a seeded Generator, so a chaos run is
+DETERMINISTIC for a given seed — the madsim stance (SURVEY §4): faults
+are reproducible, not racy.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional, Union
+
+import numpy as np
+
+_ARMED: Dict[str, object] = {}
+_RNG: Optional[np.random.Generator] = None
+FIRED: Dict[str, int] = {}
+
+
+def fail_point(name: str) -> None:
+    """Raise if `name` is armed (call this at the injection site)."""
+    if not _ARMED:
+        return
+    spec = _ARMED.get(name)
+    if spec is None:
+        return
+    if isinstance(spec, tuple):
+        prob, exc = spec
+        if _RNG is None or _RNG.random() >= prob:
+            return
+    else:
+        exc = spec
+    FIRED[name] = FIRED.get(name, 0) + 1
+    raise exc if isinstance(exc, BaseException) else exc()
+
+
+@contextlib.contextmanager
+def failpoints(points: Dict[str, Union[BaseException, type, tuple]],
+               seed: int = 0):
+    """Arm failpoints for the with-block (exclusive: no nesting)."""
+    global _RNG
+    if _ARMED:
+        raise RuntimeError("failpoints already armed")
+    _ARMED.update(points)
+    _RNG = np.random.default_rng(seed)
+    FIRED.clear()
+    try:
+        yield FIRED
+    finally:
+        _ARMED.clear()
+        _RNG = None
