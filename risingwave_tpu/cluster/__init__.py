@@ -1,0 +1,11 @@
+"""Multi-process deployment: coordinator + worker nodes.
+
+Reference parity: the meta/compute-node split (src/compute/src/server.rs:85
+compute_node_serve, proto/stream_service.proto InjectBarrier/BarrierComplete,
+proto/task_service.proto ExchangeService) — collapsed to two roles over two
+TCP planes: stream/remote.py carries data (credit-based exchange), a JSON
+control channel carries deploy/inject/stop (the gRPC services' verbs
+without protobuf — the wire schema is the next increment).
+"""
+
+from risingwave_tpu.cluster.coordinator import WorkerClient, WorkerHandle

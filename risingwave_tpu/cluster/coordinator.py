@@ -1,0 +1,162 @@
+"""Coordinator side: worker control client + cross-process barriers.
+
+Reference parity: the meta service's GlobalBarrierManager talking to
+compute nodes (barrier/mod.rs:558 inject → stream_service
+InjectBarrier → BarrierComplete) and GlobalStreamManager's actor
+deployment (stream_manager.rs:161) — the coordinator drives its OWN
+BarrierLoop and the worker participates as one more "actor": a
+registered barrier sender forwards each injection over the control
+channel, and the worker's completion reply collects the pseudo-actor.
+Everything the single-process session does (epochs, checkpoint
+frequency, in-flight window, stats) is reused unchanged.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import subprocess
+import sys
+from typing import Optional
+
+from risingwave_tpu.stream.message import (
+    Barrier, PauseMutation, ResumeMutation, StopMutation,
+)
+
+
+class WorkerClient:
+    """JSON-lines control channel to one worker (MetaClient analog)."""
+
+    def __init__(self, host: str, control_port: int,
+                 exchange_port: int):
+        self.host = host
+        self.control_port = control_port
+        self.exchange_port = exchange_port
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._lock = asyncio.Lock()
+
+    async def connect(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.control_port)
+
+    async def call(self, cmd: dict) -> dict:
+        async with self._lock:
+            self._writer.write((json.dumps(cmd) + "\n").encode())
+            await self._writer.drain()
+            line = await self._reader.readline()
+        if not line:
+            raise ConnectionError("worker control channel closed")
+        reply = json.loads(line)
+        if not reply.get("ok"):
+            raise RuntimeError(f"worker error: {reply.get('error')}")
+        return reply
+
+    async def deploy(self, fragment: str, **params) -> dict:
+        return await self.call({"cmd": "deploy", "fragment": fragment,
+                                "params": params})
+
+    async def inject(self, barrier: Barrier) -> dict:
+        m = None
+        if isinstance(barrier.mutation, StopMutation):
+            m = {"type": "stop",
+                 "actors": sorted(barrier.mutation.actors)}
+        elif isinstance(barrier.mutation, PauseMutation):
+            m = {"type": "pause"}
+        elif isinstance(barrier.mutation, ResumeMutation):
+            m = {"type": "resume"}
+        return await self.call({
+            "cmd": "inject",
+            "curr": barrier.epoch.curr.value,
+            "prev": barrier.epoch.prev.value,
+            "kind": barrier.kind.value,
+            "mutation": m,
+        })
+
+    async def stop(self) -> None:
+        try:
+            await self.call({"cmd": "stop"})
+        except (ConnectionError, RuntimeError):
+            pass
+        if self._writer is not None:
+            self._writer.close()
+
+
+class WorkerBarrierSender:
+    """Shaped like an exchange Sender: the coordinator's barrier
+    manager 'sends' each barrier to the worker over control, and the
+    worker's completion reply collects the pseudo-actor — InjectBarrier
+    + BarrierComplete as one round trip."""
+
+    def __init__(self, client: WorkerClient, local, pseudo_actor: int):
+        self.client = client
+        self.local = local
+        self.pseudo = pseudo_actor
+        self._tasks: set = set()   # strong refs: the loop holds tasks
+        #                            weakly and could drop one mid-RPC
+
+    async def send(self, barrier: Barrier) -> None:
+        async def roundtrip():
+            try:
+                await self.client.inject(barrier)
+                self.local.collect(self.pseudo, barrier)
+            except BaseException as e:  # noqa: BLE001 — fail the epoch
+                self.local.notify_failure(self.pseudo, e)
+
+        t = asyncio.ensure_future(roundtrip())
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
+
+    def close(self) -> None:
+        pass
+
+
+class WorkerHandle:
+    """Spawn + own a worker subprocess (GlobalStreamManager's node)."""
+
+    def __init__(self, store_dir: str):
+        self.store_dir = store_dir
+        self.proc: Optional[subprocess.Popen] = None
+        self.client: Optional[WorkerClient] = None
+
+    async def start(self, timeout_s: float = 60.0) -> WorkerClient:
+        import os
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "risingwave_tpu.cluster.worker",
+             "--store", self.store_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=env, cwd=None, text=True)
+        loop = asyncio.get_event_loop()
+        try:
+            line = await asyncio.wait_for(
+                loop.run_in_executor(None, self.proc.stdout.readline),
+                timeout_s)
+        except (asyncio.TimeoutError, TimeoutError):
+            self.kill()                 # no orphan on a hung boot
+            raise
+        ports = json.loads(line)
+        self.client = WorkerClient("127.0.0.1", ports["control_port"],
+                                   ports["exchange_port"])
+        await self.client.connect()
+        return self.client
+
+    def kill(self) -> None:
+        """SIGKILL — the chaos path (no goodbye, no flush)."""
+        if self.proc is not None:
+            self.proc.kill()
+            self.proc.wait()
+            self.proc = None
+
+    async def stop(self) -> None:
+        if self.client is not None:
+            await self.client.stop()
+        if self.proc is not None:
+            loop = asyncio.get_event_loop()
+            try:
+                await asyncio.wait_for(
+                    loop.run_in_executor(None, self.proc.wait), 20)
+            except (asyncio.TimeoutError, TimeoutError):
+                self.kill()             # wedged worker: no orphan
+            self.proc = None

@@ -1,0 +1,274 @@
+"""Worker node: actors + exchange server + control channel.
+
+Reference parity: the compute node (src/compute/src/server.rs:85) —
+hosts actors, serves its outputs over the exchange (stream/remote.py),
+executes barrier injections from the coordinator and reports collection
+(stream_service.proto InjectBarrier/BarrierComplete), owns a local
+state-store namespace whose checkpoints commit at the SAME epochs the
+coordinator drives, so a recovering cluster resumes consistently from
+the coordinator's committed epoch.
+
+Fragments deploy by NAME from a registry (``FRAGMENTS``) with JSON
+params — the stand-in for stream_plan.proto fragment graphs: the
+control verbs and lifecycles are the reference's; the plan wire schema
+is the next increment.
+
+Run as a process:  python -m risingwave_tpu.cluster.worker --store DIR
+(prints one JSON line {"control_port": N, "exchange_port": N}).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Dict, Optional
+
+from risingwave_tpu.common.epoch import Epoch, EpochPair
+from risingwave_tpu.state.state_table import StateTable
+from risingwave_tpu.stream.actor import Actor, LocalBarrierManager
+from risingwave_tpu.stream.dispatch import Output, SimpleDispatcher
+from risingwave_tpu.stream.exchange import channel_for_test
+from risingwave_tpu.stream.message import (
+    Barrier, BarrierKind, PauseMutation, ResumeMutation, StopMutation,
+)
+from risingwave_tpu.stream.remote import ExchangeServer
+
+
+def _make_nexmark_source(w: "WorkerServer", p: dict, table_type: str):
+    """Shared source wiring for nexmark fragments: reader + barrier
+    channel + split-offset state + SourceExecutor."""
+    from risingwave_tpu.common.types import Interval
+    from risingwave_tpu.connectors.nexmark import (
+        NexmarkConfig, NexmarkSplitReader,
+    )
+    from risingwave_tpu.frontend.planner import SPLIT_STATE_SCHEMA
+    from risingwave_tpu.stream.executors.source import SourceExecutor
+
+    cfg = NexmarkConfig(table_type=table_type,
+                        event_num=int(p["event_num"]),
+                        max_chunk_size=int(p.get("chunk", 512)))
+    reader = NexmarkSplitReader(cfg)
+    tx, rx = channel_for_test()
+    split = StateTable(int(p["split_table_id"]), SPLIT_STATE_SCHEMA,
+                       [0], w.store)
+    w.local.register_sender(int(p["actor_id"]), tx)
+    src = SourceExecutor(reader, rx, split, actor_id=int(p["actor_id"]),
+                         rate_limit_chunks_per_barrier=int(
+                             p.get("rate_limit", 4)),
+                         min_chunks_per_barrier=p.get("min_chunks"))
+    window = Interval(usecs=int(p.get("window_usecs", 10_000_000)))
+    return src, window
+
+
+def _fragment_q8_person(w: "WorkerServer", p: dict):
+    """person source → project(id, name, starttime) → remote out."""
+    from risingwave_tpu.common.types import DataType
+    from risingwave_tpu.expr.expr import InputRef, tumble_start
+    from risingwave_tpu.stream.executors.simple import ProjectExecutor
+
+    src, window = _make_nexmark_source(w, p, "person")
+    s = src.schema
+    proj = ProjectExecutor(
+        src,
+        exprs=[InputRef(s.index_of("id"), DataType.INT64),
+               InputRef(s.index_of("name"), DataType.VARCHAR),
+               tumble_start(InputRef(s.index_of("date_time"),
+                                     DataType.TIMESTAMP), window)],
+        names=["id", "name", "starttime"])
+    return src, proj
+
+
+def _fragment_q8_auction_dedup(w: "WorkerServer", p: dict):
+    """auction source → project → DEVICE dedup agg → project → remote.
+
+    Stateful fragment: the dedup HashAgg's kernel + value-state table
+    live on THIS worker — q8's two sides' state end up on different
+    processes."""
+    from risingwave_tpu.common.types import DataType
+    from risingwave_tpu.expr.expr import InputRef, tumble_start
+    from risingwave_tpu.ops.hash_agg import AggKind
+    from risingwave_tpu.stream.executors.hash_agg import (
+        AggCall, HashAggExecutor, agg_state_schema,
+    )
+    from risingwave_tpu.stream.executors.simple import ProjectExecutor
+
+    src, window = _make_nexmark_source(w, p, "auction")
+    s = src.schema
+    proj = ProjectExecutor(
+        src,
+        exprs=[InputRef(s.index_of("seller"), DataType.INT64),
+               tumble_start(InputRef(s.index_of("date_time"),
+                                     DataType.TIMESTAMP), window)],
+        names=["seller", "starttime"])
+    calls = [AggCall(AggKind.COUNT)]
+    sch, pk = agg_state_schema(proj.schema, [0, 1], calls)
+    dedup = HashAggExecutor(
+        proj, [0, 1], calls,
+        StateTable(int(p["agg_table_id"]), sch, pk, w.store,
+                   dist_key_indices=[0]),
+        append_only=True,
+        output_names=["seller", "starttime", "_cnt"])
+    out = ProjectExecutor(
+        dedup, exprs=[InputRef(0, DataType.INT64),
+                      InputRef(1, DataType.TIMESTAMP)],
+        names=["seller", "starttime"])
+    return src, out
+
+
+FRAGMENTS = {
+    "q8_person": _fragment_q8_person,
+    "q8_auction_dedup": _fragment_q8_auction_dedup,
+}
+
+
+class WorkerServer:
+    """One worker process: control + exchange + actors + local store."""
+
+    def __init__(self, store):
+        self.store = store
+        self.local = LocalBarrierManager()
+        self.exchange = ExchangeServer()
+        self.actors: Dict[int, Actor] = {}
+        self.tasks: Dict[int, asyncio.Task] = {}
+        self._control: Optional[asyncio.AbstractServer] = None
+        self._stopping = asyncio.Event()
+
+    async def serve(self, host: str = "127.0.0.1") -> dict:
+        await self.exchange.serve(host, 0)
+        self._control = await asyncio.start_server(
+            self._handle_control, host, 0)
+        return {"control_port":
+                self._control.sockets[0].getsockname()[1],
+                "exchange_port": self.exchange.port}
+
+    # -- control protocol: one JSON object per line ----------------------
+    async def _handle_control(self, reader: asyncio.StreamReader,
+                              writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                cmd = json.loads(line)
+                try:
+                    reply = await self._dispatch(cmd)
+                except BaseException as e:  # noqa: BLE001 — report,
+                    # don't kill the control channel: the coordinator
+                    # needs the REAL failure, not a closed socket
+                    reply = {"ok": False, "error": repr(e)}
+                writer.write((json.dumps(reply) + "\n").encode())
+                await writer.drain()
+                if cmd.get("cmd") == "stop":
+                    self._stopping.set()
+                    return
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+    async def _dispatch(self, cmd: dict) -> dict:
+        verb = cmd.get("cmd")
+        if verb == "deploy":
+            return await self._deploy(cmd)
+        if verb == "inject":
+            return await self._inject(cmd)
+        if verb == "stop":
+            return {"ok": True}
+        return {"ok": False, "error": f"unknown cmd {verb!r}"}
+
+    async def _deploy(self, cmd: dict) -> dict:
+        frag = FRAGMENTS[cmd["fragment"]]
+        p = cmd["params"]
+        actor_id = int(p["actor_id"])
+        _src, consumer = frag(self, p)   # fragment registers its sender
+        out = self.exchange.register_edge(actor_id,
+                                          int(p["down_actor"]))
+        actor = Actor(actor_id, consumer,
+                      dispatchers=[SimpleDispatcher(
+                          Output(int(p["down_actor"]), out))],
+                      barrier_manager=self.local)
+        self.actors[actor_id] = actor
+        self.local.set_expected_actors(list(self.actors))
+        self.tasks[actor_id] = actor.spawn()
+        return {"ok": True, "actor_id": actor_id}
+
+    async def _inject(self, cmd: dict) -> dict:
+        pair = EpochPair(Epoch(int(cmd["curr"])),
+                         Epoch(int(cmd["prev"])))
+        kind = BarrierKind(cmd["kind"])
+        mutation = None
+        m = cmd.get("mutation")
+        if m:
+            if m["type"] == "stop":
+                mutation = StopMutation(frozenset(m["actors"]))
+            elif m["type"] == "pause":
+                mutation = PauseMutation()
+            elif m["type"] == "resume":
+                mutation = ResumeMutation()
+        barrier = Barrier(pair, kind, mutation)
+        await self.local.send_barrier(barrier)
+        collected = await self.local.await_epoch_complete(
+            pair.curr.value)
+        # the worker may have committed AHEAD of the coordinator (crash
+        # between worker sync and coordinator commit): sealing an older
+        # epoch again must be a no-op, not an assertion failure
+        if pair.prev.value > self.store.committed_epoch():
+            self.store.seal_epoch(pair.prev.value, kind.is_checkpoint)
+            if kind.is_checkpoint:
+                self.store.sync(pair.prev.value)
+        # stopped actors are gone after this barrier
+        if isinstance(mutation, StopMutation):
+            for aid in list(self.actors):
+                if aid in mutation.actors:
+                    t = self.tasks.pop(aid, None)
+                    if t is not None:
+                        await t
+                    self.actors.pop(aid, None)
+                    self.local.drop_actor(aid)
+            self.local.set_expected_actors(list(self.actors))
+        for aid, a in self.actors.items():
+            if a.failure is not None:
+                return {"ok": False, "error": repr(a.failure)}
+        return {"ok": True, "collected": collected is not None,
+                "committed": pair.prev.value}
+
+    async def run_until_stopped(self) -> None:
+        await self._stopping.wait()
+        await self.exchange.close()
+        if self._control is not None:
+            self._control.close()
+            await self._control.wait_closed()
+
+
+def main(argv=None) -> None:
+    import argparse
+    import os
+
+    # honor JAX_PLATFORMS=cpu even where a sitecustomize rewrites the
+    # platform list at interpreter start (a worker pinned to CPU must
+    # not block on a wedged accelerator tunnel)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--store", required=True,
+                    help="object-store directory for this worker's "
+                         "hummock namespace")
+    args = ap.parse_args(argv)
+
+    from risingwave_tpu.storage.hummock import HummockLite
+    from risingwave_tpu.storage.object_store import LocalFsObjectStore
+
+    async def amain():
+        store = HummockLite(LocalFsObjectStore(args.store))
+        w = WorkerServer(store)
+        ports = await w.serve()
+        print(json.dumps(ports), flush=True)
+        await w.run_until_stopped()
+
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

@@ -12,10 +12,10 @@ pattern (SSTs are write-once).
 from __future__ import annotations
 
 import os
-
-from risingwave_tpu.utils.failpoint import fail_point
 import tempfile
 from typing import Dict, List, Protocol
+
+from risingwave_tpu.utils.failpoint import fail_point
 
 
 class ObjectStore(Protocol):

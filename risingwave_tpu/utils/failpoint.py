@@ -23,6 +23,7 @@ from typing import Dict, Optional, Union
 import numpy as np
 
 _ARMED: Dict[str, object] = {}
+_ACTIVE = False
 _RNG: Optional[np.random.Generator] = None
 FIRED: Dict[str, int] = {}
 
@@ -41,21 +42,30 @@ def fail_point(name: str) -> None:
     else:
         exc = spec
     FIRED[name] = FIRED.get(name, 0) + 1
-    raise exc if isinstance(exc, BaseException) else exc()
+    if isinstance(exc, BaseException):
+        # fresh instance per fire: re-raising one shared object chains
+        # tracebacks without bound and aliases state across catchers
+        raise type(exc)(*exc.args)
+    raise exc()
 
 
 @contextlib.contextmanager
 def failpoints(points: Dict[str, Union[BaseException, type, tuple]],
                seed: int = 0):
     """Arm failpoints for the with-block (exclusive: no nesting)."""
-    global _RNG
-    if _ARMED:
+    global _RNG, _ACTIVE
+    if _ACTIVE:
         raise RuntimeError("failpoints already armed")
-    _ARMED.update(points)
-    _RNG = np.random.default_rng(seed)
-    FIRED.clear()
+    # build everything fallible BEFORE mutating globals: a failed
+    # setup must not leave points permanently armed
+    rng = np.random.default_rng(seed)
+    _ACTIVE = True
     try:
+        _ARMED.update(points)
+        _RNG = rng
+        FIRED.clear()
         yield FIRED
     finally:
         _ARMED.clear()
         _RNG = None
+        _ACTIVE = False

@@ -1,0 +1,166 @@
+"""Two-process deployment: coordinator + worker over the remote
+exchange (VERDICT r3 #7).
+
+The worker process hosts q8's source fragments INCLUDING the stateful
+auction-side dedup agg (its kernel + value-state table live there); the
+coordinator hosts the join + materialize and drives barriers through
+its own BarrierLoop, with the worker participating as a pseudo-actor
+(InjectBarrier/BarrierComplete over a JSON control channel). Both roles
+checkpoint their own hummock namespaces at the same epochs.
+
+Includes the kill-the-worker chaos case: SIGKILL mid-stream, restart
+over the same stores, resume from the coordinator's committed epoch,
+finish with exactly the oracle result.
+"""
+
+import asyncio
+
+import pytest
+
+from risingwave_tpu.cluster.coordinator import (
+    WorkerBarrierSender, WorkerHandle,
+)
+from risingwave_tpu.common.types import DataType, Schema
+from risingwave_tpu.connectors.nexmark import NexmarkConfig
+from risingwave_tpu.meta.barrier import BarrierLoop
+from risingwave_tpu.state.state_table import StateTable
+from risingwave_tpu.storage.hummock import HummockLite
+from risingwave_tpu.storage.object_store import LocalFsObjectStore
+from risingwave_tpu.stream.actor import Actor, LocalBarrierManager
+from risingwave_tpu.stream.executors.hash_join import HashJoinExecutor
+from risingwave_tpu.stream.executors.materialize import (
+    MaterializeExecutor,
+)
+from risingwave_tpu.stream.message import StopMutation
+from risingwave_tpu.stream.remote import RemoteInput
+from tests.test_e2e_q8 import q8_oracle
+
+PERSON_ACTOR, AUCTION_ACTOR, JOIN_ACTOR, WORKER_PSEUDO = 11, 12, 20, 999
+P_SCHEMA = Schema.of(id=DataType.INT64, name=DataType.VARCHAR,
+                     starttime=DataType.TIMESTAMP)
+A_SCHEMA = Schema.of(seller=DataType.INT64,
+                     starttime=DataType.TIMESTAMP)
+EVENTS = 6000
+
+
+async def _deploy_fragments(client, event_num: int) -> None:
+    await client.deploy(
+        "q8_person", actor_id=PERSON_ACTOR, down_actor=JOIN_ACTOR,
+        event_num=event_num, split_table_id=101, rate_limit=2,
+        chunk=256)
+    await client.deploy(
+        "q8_auction_dedup", actor_id=AUCTION_ACTOR,
+        down_actor=JOIN_ACTOR, event_num=event_num,
+        split_table_id=102, agg_table_id=103, rate_limit=2, chunk=256)
+
+
+class _Coordinator:
+    """Join + materialize side, barriers driven cross-process."""
+
+    def __init__(self, client, coord_root: str):
+        self.store = HummockLite(LocalFsObjectStore(coord_root))
+        self.local = LocalBarrierManager()
+        left = RemoteInput("127.0.0.1", client.exchange_port,
+                           PERSON_ACTOR, JOIN_ACTOR, P_SCHEMA)
+        right = RemoteInput("127.0.0.1", client.exchange_port,
+                            AUCTION_ACTOR, JOIN_ACTOR, A_SCHEMA)
+        lt = StateTable(4, P_SCHEMA, [0, 2], self.store,
+                        dist_key_indices=[0])
+        rt = StateTable(5, A_SCHEMA, [0, 1], self.store,
+                        dist_key_indices=[0])
+        join = HashJoinExecutor(left, right, left_keys=[0, 2],
+                                right_keys=[0, 1], left_table=lt,
+                                right_table=rt)
+        self.mv = StateTable(6, join.schema, [0, 2], self.store)
+        mat = MaterializeExecutor(join, self.mv)
+        self.actor = Actor(JOIN_ACTOR, mat, dispatchers=[],
+                           barrier_manager=self.local)
+        self.loop = BarrierLoop(self.local, self.store)
+        self.local.register_sender(
+            WORKER_PSEUDO,
+            WorkerBarrierSender(client, self.local, WORKER_PSEUDO))
+        self.local.set_expected_actors([JOIN_ACTOR, WORKER_PSEUDO])
+
+    async def run_epochs(self, n: int) -> None:
+        for _ in range(n):
+            await self.loop.inject_and_collect(force_checkpoint=True)
+
+    async def stop(self) -> None:
+        await self.loop.inject_and_collect(
+            force_checkpoint=True,
+            mutation=StopMutation(frozenset(
+                {PERSON_ACTOR, AUCTION_ACTOR, JOIN_ACTOR,
+                 WORKER_PSEUDO})))
+
+
+def _mv_rows(coord: _Coordinator) -> set:
+    # join output = left(id, name, starttime) ++ right(seller, start):
+    # compare the q8 projection (id, name, starttime)
+    return {(row[0], row[1], row[2])
+            for _pk, row in coord.mv.iter_rows()}
+
+
+def test_two_node_q8(tmp_path):
+    worker_root = str(tmp_path / "worker")
+    coord_root = str(tmp_path / "coord")
+
+    async def main():
+        handle = WorkerHandle(worker_root)
+        client = await handle.start()
+        try:
+            await _deploy_fragments(client, EVENTS)
+            coord = _Coordinator(client, coord_root)
+            task = coord.actor.spawn()
+            await coord.run_epochs(25)
+            await coord.stop()
+            await task
+            assert coord.actor.failure is None
+            return _mv_rows(coord)
+        finally:
+            await handle.stop()
+
+    got = asyncio.run(main())
+    cfg = NexmarkConfig(event_num=EVENTS)
+    expect = q8_oracle(cfg, EVENTS // 50, EVENTS * 3 // 50)
+    assert got == expect
+    assert len(got) > 5
+
+
+def test_two_node_q8_kill_worker_recovers(tmp_path):
+    worker_root = str(tmp_path / "worker")
+    coord_root = str(tmp_path / "coord")
+
+    async def phase1():
+        handle = WorkerHandle(worker_root)
+        client = await handle.start()
+        await _deploy_fragments(client, EVENTS)
+        coord = _Coordinator(client, coord_root)
+        task = coord.actor.spawn()
+        await coord.run_epochs(6)
+        # SIGKILL mid-stream: no goodbye, no flush
+        handle.kill()
+        with pytest.raises(Exception):
+            await coord.run_epochs(3)
+        task.cancel()
+
+    async def phase2():
+        handle = WorkerHandle(worker_root)
+        client = await handle.start()
+        try:
+            await _deploy_fragments(client, EVENTS)
+            coord = _Coordinator(client, coord_root)
+            task = coord.actor.spawn()
+            await coord.run_epochs(40)
+            await coord.stop()
+            await task
+            assert coord.actor.failure is None
+            return _mv_rows(coord)
+        finally:
+            await handle.stop()
+
+    asyncio.run(phase1())
+    got = asyncio.run(phase2())
+    cfg = NexmarkConfig(event_num=EVENTS)
+    expect = q8_oracle(cfg, EVENTS // 50, EVENTS * 3 // 50)
+    assert got == expect
+    assert len(got) > 5
