@@ -26,7 +26,10 @@ def _result(metric, elapsed, rows, loop):
         "metric": metric,
         "value": round(rows / elapsed, 1),
         "unit": "events/s",
+        # inject→commit INCLUDING queueing behind in-flight barriers
+        # (the driver pipelines 2 deep; compare like with like)
         "p99_barrier_latency_s": round(loop.stats.p99_latency_s(), 4),
+        "barrier_in_flight": 2,
         "events": rows,
     }
 
@@ -163,7 +166,8 @@ def main(argv):
         try:
             r = fn()
             headline[name] = {k: r[k] for k in
-                              ("value", "p99_barrier_latency_s", "events")}
+                              ("value", "p99_barrier_latency_s",
+                               "barrier_in_flight", "events")}
         except Exception as e:                       # noqa: BLE001
             print(f"WARNING: {name} failed: {e!r}", file=sys.stderr)
             headline[name] = {"error": repr(e)[:200]}

@@ -122,6 +122,12 @@ class BarrierLoop:
     def committed_epoch(self) -> int:
         return self._committed_epoch
 
+    @property
+    def in_flight_count(self) -> int:
+        """Injected-but-uncollected barriers (drivers pipelining against
+        the window should read this, not the private list)."""
+        return len(self._in_flight)
+
     # -- one step -------------------------------------------------------
     def _next_kind(self, force_checkpoint: bool) -> BarrierKind:
         if self._epoch is None:
