@@ -19,6 +19,7 @@ import json
 import sys
 
 BASELINE_EVENTS_PER_SEC = 1_000_000.0
+IN_FLIGHT = 2          # barrier pipelining window used by every bench
 
 
 def _result(metric, elapsed, rows, loop):
@@ -27,9 +28,9 @@ def _result(metric, elapsed, rows, loop):
         "value": round(rows / elapsed, 1),
         "unit": "events/s",
         # inject→commit INCLUDING queueing behind in-flight barriers
-        # (the driver pipelines 2 deep; compare like with like)
+        # (compare like with like across rounds)
         "p99_barrier_latency_s": round(loop.stats.p99_latency_s(), 4),
-        "barrier_in_flight": 2,
+        "barrier_in_flight": IN_FLIGHT,
         "events": rows,
     }
 
@@ -43,7 +44,8 @@ def bench_q1(total_events: int = 50 * 4000, chunk_size: int = 4096):
     cfg = NexmarkConfig(event_num=total_events, max_chunk_size=chunk_size)
     p = build_q1(MemoryStateStore(), cfg, rate_limit=16, min_chunks=16)
     n_bids = total_events * 46 // 50
-    elapsed, rows = asyncio.run(drive_to_completion(p, {1: n_bids}))
+    elapsed, rows = asyncio.run(drive_to_completion(
+        p, {1: n_bids}, in_flight=IN_FLIGHT))
     return _result("nexmark_q1_events_per_sec", elapsed, rows, p.loop)
 
 
@@ -64,7 +66,8 @@ def bench_q7(total_events: int = 50 * 40_000, chunk_size: int = 8192):
     p = build_q7(MemoryStateStore(), cfg, rate_limit=32, min_chunks=32,
                  watermark_delay=Interval(usecs=0))
     n_bids = total_events * 46 // 50
-    elapsed, rows = asyncio.run(drive_to_completion(p, {1: n_bids}))
+    elapsed, rows = asyncio.run(drive_to_completion(
+        p, {1: n_bids}, in_flight=IN_FLIGHT))
     return _result("nexmark_q7_events_per_sec", elapsed, rows, p.loop)
 
 
@@ -78,7 +81,8 @@ def bench_q5(total_events: int = 50 * 8_000, chunk_size: int = 4096):
                         generate_strings=False)
     p = build_q5(MemoryStateStore(), cfg, rate_limit=16, min_chunks=16)
     n_bids = total_events * 46 // 50
-    elapsed, rows = asyncio.run(drive_to_completion(p, {1: n_bids}))
+    elapsed, rows = asyncio.run(drive_to_completion(
+        p, {1: n_bids}, in_flight=IN_FLIGHT))
     return _result("nexmark_q5_events_per_sec", elapsed, rows, p.loop)
 
 
@@ -96,7 +100,8 @@ def bench_q8(total_events: int = 50 * 40_000, chunk_size: int = 4096):
     p = build_q8(MemoryStateStore(), cfg_p, cfg_a, rate_limit=16,
                  min_chunks=16)
     targets = {1: total_events // 50, 2: total_events * 3 // 50}
-    elapsed, rows = asyncio.run(drive_to_completion(p, targets))
+    elapsed, rows = asyncio.run(drive_to_completion(
+        p, targets, in_flight=IN_FLIGHT))
     return _result("nexmark_q8_events_per_sec", elapsed, rows, p.loop)
 
 
@@ -112,7 +117,8 @@ def bench_q3(customers: int = 1500, orders: int = 15000):
     p = build_q3(MemoryStateStore(), customers=customers, orders=orders,
                  rate_limit=16, min_chunks=16)
     targets = {1: customers, 2: orders, 3: orders * LINES_PER_ORDER}
-    elapsed, rows = asyncio.run(drive_to_completion(p, targets))
+    elapsed, rows = asyncio.run(drive_to_completion(
+        p, targets, in_flight=IN_FLIGHT))
     return _result("tpch_q3_events_per_sec", elapsed, rows, p.loop)
 
 

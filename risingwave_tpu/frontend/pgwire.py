@@ -1,12 +1,15 @@
 """pgwire: PostgreSQL wire-protocol (v3) server over asyncio.
 
 Reference parity: src/utils/pgwire/src/{pg_protocol.rs,pg_server.rs}
-— the simple-query protocol surface a psql client needs: startup
+— the protocol surface psql AND driver clients need: startup
 handshake (SSL probe declined, AuthenticationOk, ParameterStatus,
 ReadyForQuery), 'Q' simple queries answered with RowDescription /
-DataRow / CommandComplete, errors as ErrorResponse, 'X' terminate.
-Extended protocol (parse/bind/execute) is declined politely. All
-values ship in text format (what psql uses).
+DataRow / CommandComplete, errors as ErrorResponse, 'X' terminate,
+plus the EXTENDED protocol (Parse/Bind/Describe/Execute/Close/Sync)
+that psycopg-style drivers use: $n parameters substitute as quoted
+text literals at Bind (per-bind re-plan; prepared-plan caching is a
+later increment), failures skip to Sync. All values ship in text
+format.
 """
 
 from __future__ import annotations
@@ -70,6 +73,12 @@ class PgServer:
     # -- connection loop --------------------------------------------------
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
+        # extended-protocol state (pg_protocol.rs): prepared statements
+        # and portals are per-connection; after an error the backend
+        # discards messages until Sync
+        stmts: dict = {}      # name → sql
+        portals: dict = {}    # name → ("rows", rows, schema)|("cmd", s)
+        failed = False
         try:
             if not await self._startup(reader, writer):
                 return
@@ -80,19 +89,140 @@ class PgServer:
                 payload = await reader.readexactly(ln - 4)
                 if tag == b"X":
                     return
+                if tag == b"S":                       # Sync
+                    failed = False
+                    writer.write(_ready())
+                    await writer.drain()
+                    continue
+                if failed:
+                    continue                          # skip until Sync
                 if tag == b"Q":
                     sql = payload.rstrip(b"\x00").decode()
                     await self._simple_query(writer, sql)
-                else:
-                    writer.write(_error(
-                        f"unsupported message {tag!r} (extended "
-                        "protocol not implemented)"))
-                    writer.write(_ready())
+                    continue
+                try:
+                    if tag == b"P":
+                        self._parse_msg(payload, stmts)
+                        writer.write(_msg(b"1", b""))  # ParseComplete
+                    elif tag == b"B":
+                        await self._bind_msg(payload, stmts, portals)
+                        writer.write(_msg(b"2", b""))  # BindComplete
+                    elif tag == b"D":
+                        await self._describe_msg(payload, stmts,
+                                                 portals, writer)
+                    elif tag == b"E":
+                        self._execute_msg(payload, portals, writer)
+                    elif tag == b"C":                  # Close
+                        kind = payload[0:1]
+                        name, _ = self._read_cstr(payload, 1)
+                        (stmts if kind == b"S" else portals).pop(
+                            name, None)
+                        writer.write(_msg(b"3", b""))  # CloseComplete
+                    elif tag == b"H":                  # Flush
+                        pass
+                    else:
+                        raise ValueError(
+                            f"unsupported message {tag!r}")
                     await writer.drain()
+                except (Exception,) as e:              # noqa: BLE001
+                    writer.write(_error(str(e)))
+                    await writer.drain()
+                    failed = True
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
             writer.close()
+
+    # -- extended protocol -------------------------------------------------
+    @staticmethod
+    def _read_cstr(payload: bytes, at: int):
+        end = payload.index(b"\x00", at)
+        return payload[at:end].decode(), end + 1
+
+    def _parse_msg(self, payload: bytes, stmts: dict) -> None:
+        name, at = self._read_cstr(payload, 0)
+        sql, at = self._read_cstr(payload, at)
+        # declared parameter-type OIDs are accepted and ignored (text
+        # parameters are substituted at bind time)
+        stmts[name] = sql
+
+    async def _bind_msg(self, payload: bytes, stmts: dict,
+                        portals: dict) -> None:
+        portal, at = self._read_cstr(payload, 0)
+        stmt, at = self._read_cstr(payload, at)
+        sql = stmts[stmt]
+        nfmt = struct.unpack_from(">H", payload, at)[0]
+        at += 2 + 2 * nfmt                  # per-param format codes
+        nparams = struct.unpack_from(">H", payload, at)[0]
+        at += 2
+        params = []
+        for _ in range(nparams):
+            plen = struct.unpack_from(">i", payload, at)[0]
+            at += 4
+            if plen < 0:
+                params.append(None)
+            else:
+                params.append(payload[at:at + plen].decode())
+                at += plen
+        # $n substitution with SQL-quoted text literals (the statement
+        # re-plans per bind; prepared-plan caching is a later increment)
+        for i in range(len(params), 0, -1):
+            v = params[i - 1]
+            lit_ = "NULL" if v is None else \
+                "'" + v.replace("'", "''") + "'"
+            sql = sql.replace(f"${i}", lit_)
+        result = await self.frontend.execute(sql)
+        if isinstance(result, str):
+            portals[portal] = ("cmd", result)
+        else:
+            schema = getattr(self.frontend, "last_select_schema", None)
+            portals[portal] = ("rows", result, schema)
+
+    async def _describe_msg(self, payload: bytes, stmts: dict,
+                            portals: dict, writer) -> None:
+        kind = payload[0:1]
+        name, _ = self._read_cstr(payload, 1)
+        if kind == b"S":
+            # statement describe: no parameter type inference yet
+            writer.write(_msg(b"t", struct.pack(">H", 0)))
+            sql = stmts.get(name, "")
+            head = sql.lstrip().split(None, 1)
+            is_select = bool(head) and head[0].lower() in (
+                "select", "show", "explain")
+            if is_select and "$" not in sql:
+                # parameterless SELECT: run it now so prepared-
+                # statement drivers get real result metadata
+                rows = await self.frontend.execute(sql)
+                schema = getattr(self.frontend,
+                                 "last_select_schema", None)
+                writer.write(_row_description(rows, schema))
+            elif is_select:
+                # parameterized: shape unknown until Bind — drivers
+                # that describe the PORTAL (psycopg default flow after
+                # Bind) get the real RowDescription there
+                writer.write(_msg(b"n", b""))
+            else:
+                writer.write(_msg(b"n", b""))          # NoData
+            return
+        p = portals[name]
+        if p[0] == "cmd":
+            writer.write(_msg(b"n", b""))              # NoData
+        else:
+            writer.write(_row_description(p[1], p[2]))
+
+    def _execute_msg(self, payload: bytes, portals: dict,
+                     writer) -> None:
+        name, _ = self._read_cstr(payload, 0)
+        p = portals[name]
+        if p[0] == "cmd":
+            writer.write(_msg(b"C", _cstr(p[1].replace("_", " "))))
+            return
+        rows, schema = p[1], p[2]
+        types = ([f.data_type for f in schema]
+                 if schema is not None else None)
+        for row in rows:
+            writer.write(_data_row(row, types))
+        writer.write(_msg(b"C", _cstr(f"SELECT {len(rows)}")))
 
     async def _startup(self, reader, writer) -> bool:
         while True:

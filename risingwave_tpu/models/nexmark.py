@@ -212,7 +212,6 @@ def drive_to_completion(pipeline: Pipeline,
                         targets: Dict[int, int],
                         max_epochs: int = 500,
                         in_flight: int = 2):
-    in_flight = max(1, in_flight)
     """Async driver: barrier-tick until every reader hits its target
     offset, one final checkpoint, then a Stop barrier.
 
@@ -230,6 +229,8 @@ def drive_to_completion(pipeline: Pipeline,
     import time
 
     from risingwave_tpu.stream.message import StopMutation
+
+    in_flight_w = max(1, in_flight)
 
     async def run():
         task = pipeline.actor.spawn()
@@ -251,7 +252,7 @@ def drive_to_completion(pipeline: Pipeline,
                     f"sources stalled: "
                     f"{ {a: readers[a].offset for a in targets} } "
                     f"vs {targets}")
-            while loop.in_flight_count < in_flight \
+            while loop.in_flight_count < in_flight_w \
                     and injected < max_epochs:
                 await loop.inject()
                 injected += 1

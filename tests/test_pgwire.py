@@ -99,3 +99,63 @@ def test_pgwire_end_to_end():
         await fe.close()
 
     asyncio.run(run())
+
+
+def test_pgwire_extended_protocol():
+    """Parse/Bind/Describe/Execute/Sync with $n text parameters — what
+    psycopg-style drivers send (pg_protocol.rs extended surface)."""
+    async def run():
+        fe = Frontend(rate_limit=2)
+        await fe.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', "
+            "nexmark.table.type='bid', nexmark.event.num=2000, "
+            "nexmark.max.chunk.size=256)")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW m AS SELECT auction, price "
+            "FROM bid")
+        await fe.step(6)
+        srv = PgServer(fe)
+        await srv.serve(port=0)
+        c = await _Client.connect(srv.port)
+
+        def ext(tag, body):
+            c.w.write(tag + struct.pack(">I", len(body) + 4) + body)
+
+        # Parse (named stmt, $1 parameter), Bind, Describe, Execute, Sync
+        sql = ("SELECT auction, count(*) AS n FROM m "
+               "WHERE auction = CAST($1 AS BIGINT) GROUP BY auction")
+        ext(b"P", b"s1\x00" + sql.encode() + b"\x00" +
+            struct.pack(">H", 0))
+        param = b"1000"
+        ext(b"B", b"\x00" + b"s1\x00" + struct.pack(">H", 0)
+            + struct.pack(">H", 1)
+            + struct.pack(">i", len(param)) + param
+            + struct.pack(">H", 0))
+        ext(b"D", b"P\x00")
+        ext(b"E", b"\x00" + struct.pack(">I", 0))
+        ext(b"S", b"")
+        await c.w.drain()
+        msgs = await c.read_until(b"Z")
+        tags = [t for t, _ in msgs]
+        assert b"1" in tags and b"2" in tags       # Parse/BindComplete
+        assert b"T" in tags                        # RowDescription
+        data = [p for t, p in msgs if t == b"D"]
+        assert len(data) == 1
+        # error inside extended mode skips to Sync, then recovers
+        ext(b"P", b"bad\x00SELECT nope FROM m\x00"
+            + struct.pack(">H", 0))
+        ext(b"B", b"\x00bad\x00" + struct.pack(">HHH", 0, 0, 0))
+        ext(b"E", b"\x00" + struct.pack(">I", 0))
+        ext(b"S", b"")
+        await c.w.drain()
+        msgs = await c.read_until(b"Z")
+        assert any(t == b"E" for t, _ in msgs)     # ErrorResponse
+        # connection still usable via simple query
+        rows = _rows(await c.query("SELECT count(*) AS n FROM m"))
+        c.close()
+        await srv.close()
+        await fe.close()
+        return rows
+
+    rows = asyncio.run(run())
+    assert int(rows[0][0]) > 0
