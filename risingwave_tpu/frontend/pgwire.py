@@ -15,6 +15,7 @@ format.
 from __future__ import annotations
 
 import asyncio
+import re
 import struct
 from typing import List, Optional, Tuple
 
@@ -55,6 +56,9 @@ class PgServer:
     def __init__(self, frontend: Frontend):
         self.frontend = frontend
         self._server: Optional[asyncio.AbstractServer] = None
+        # Describe(statement) results reusable by the following Bind
+        # (per server; keyed by statement name)
+        self._describe_cache: dict = {}
 
     async def serve(self, host: str = "127.0.0.1", port: int = 4566):
         self._server = await asyncio.start_server(
@@ -134,6 +138,45 @@ class PgServer:
             writer.close()
 
     # -- extended protocol -------------------------------------------------
+    _QUOTED = re.compile(r"'(?:[^']|'')*'")
+    _PARAM = re.compile(r"\$(\d+)")
+
+    @classmethod
+    def _sub_params_sql(cls, sql: str, params) -> str:
+        """Token-aware $n substitution: quoted regions are untouched,
+        and substituted values can never be re-scanned for $n (each
+        segment is processed exactly once)."""
+        def sub_segment(seg: str) -> str:
+            def repl(m):
+                i = int(m.group(1))
+                if not (1 <= i <= len(params)):
+                    raise ValueError(f"parameter ${i} not bound")
+                v = params[i - 1]
+                return "NULL" if v is None else \
+                    "'" + v.replace("'", "''") + "'"
+            return cls._PARAM.sub(repl, seg)
+
+        out = []
+        at = 0
+        for m in cls._QUOTED.finditer(sql):
+            out.append(sub_segment(sql[at:m.start()]))
+            out.append(m.group(0))
+            at = m.end()
+        out.append(sub_segment(sql[at:]))
+        return "".join(out)
+
+    @classmethod
+    def _param_count(cls, sql: str) -> int:
+        n = 0
+        at = 0
+        for m in cls._QUOTED.finditer(sql):
+            for pm in cls._PARAM.finditer(sql[at:m.start()]):
+                n = max(n, int(pm.group(1)))
+            at = m.end()
+        for pm in cls._PARAM.finditer(sql[at:]):
+            n = max(n, int(pm.group(1)))
+        return n
+
     @staticmethod
     def _read_cstr(payload: bytes, at: int):
         end = payload.index(b"\x00", at)
@@ -150,9 +193,16 @@ class PgServer:
                         portals: dict) -> None:
         portal, at = self._read_cstr(payload, 0)
         stmt, at = self._read_cstr(payload, at)
+        cached = self._describe_cache.pop(stmt, None)
         sql = stmts[stmt]
         nfmt = struct.unpack_from(">H", payload, at)[0]
-        at += 2 + 2 * nfmt                  # per-param format codes
+        fmts = struct.unpack_from(f">{nfmt}H", payload, at + 2) \
+            if nfmt else ()
+        if any(f == 1 for f in fmts):
+            raise ValueError(
+                "binary-format parameters are not supported — bind "
+                "parameters as text")
+        at += 2 + 2 * nfmt
         nparams = struct.unpack_from(">H", payload, at)[0]
         at += 2
         params = []
@@ -166,11 +216,10 @@ class PgServer:
                 at += plen
         # $n substitution with SQL-quoted text literals (the statement
         # re-plans per bind; prepared-plan caching is a later increment)
-        for i in range(len(params), 0, -1):
-            v = params[i - 1]
-            lit_ = "NULL" if v is None else \
-                "'" + v.replace("'", "''") + "'"
-            sql = sql.replace(f"${i}", lit_)
+        if cached is not None and not params:
+            portals[portal] = cached
+            return
+        sql = self._sub_params_sql(sql, params)
         result = await self.frontend.execute(sql)
         if isinstance(result, str):
             portals[portal] = ("cmd", result)
@@ -183,26 +232,29 @@ class PgServer:
         kind = payload[0:1]
         name, _ = self._read_cstr(payload, 1)
         if kind == b"S":
-            # statement describe: no parameter type inference yet
-            writer.write(_msg(b"t", struct.pack(">H", 0)))
             sql = stmts.get(name, "")
+            nparams = self._param_count(sql)
+            # parameter types are unknown (OID 0 = unspecified); the
+            # COUNT must be right or count-validating drivers bail
+            writer.write(_msg(b"t", struct.pack(
+                f">H{nparams}I", nparams, *([0] * nparams))))
             head = sql.lstrip().split(None, 1)
             is_select = bool(head) and head[0].lower() in (
                 "select", "show", "explain")
-            if is_select and "$" not in sql:
-                # parameterless SELECT: run it now so prepared-
-                # statement drivers get real result metadata
+            if is_select and nparams == 0:
+                # parameterless SELECT: run it now for real metadata
+                # and cache the rows — Bind reuses them instead of
+                # executing the same query twice per round trip
                 rows = await self.frontend.execute(sql)
                 schema = getattr(self.frontend,
                                  "last_select_schema", None)
+                self._describe_cache[name] = ("rows", rows, schema)
                 writer.write(_row_description(rows, schema))
-            elif is_select:
-                # parameterized: shape unknown until Bind — drivers
-                # that describe the PORTAL (psycopg default flow after
-                # Bind) get the real RowDescription there
-                writer.write(_msg(b"n", b""))
             else:
-                writer.write(_msg(b"n", b""))          # NoData
+                # parameterized (shape unknown until Bind — portal
+                # Describe returns the real RowDescription) or a
+                # command: NoData
+                writer.write(_msg(b"n", b""))
             return
         p = portals[name]
         if p[0] == "cmd":

@@ -413,3 +413,28 @@ def test_dynamic_filter_null_rows_never_match():
               [barrier(1), rhs([20]), barrier(2), barrier(3)], cmp=">")
     msgs = asyncio.run(collect_until_n_barriers(ex, 3))
     assert net_view(msgs) == Counter({(1, 50): 1})
+
+
+def test_append_only_dedup_first_wins_and_recovers():
+    """append_only_dedup.rs: first row per key passes, duplicates drop
+    (within and across chunks and across restarts)."""
+    from risingwave_tpu.stream.executors.dedup import (
+        AppendOnlyDedupExecutor,
+    )
+
+    store = MemoryStateStore()
+    key_schema = Schema.of(k=DataType.INT64)
+
+    def run(script, n):
+        state = StateTable(70, key_schema, [0], store)
+        ex = AppendOnlyDedupExecutor(
+            MockSource(S2, script), [0], state)
+        msgs = asyncio.run(collect_until_n_barriers(ex, n))
+        return records(msgs)
+
+    got = run([barrier(1), chunk([1, 2, 1], [10, 20, 11]),
+               barrier(2), chunk([2, 3], [21, 30]), barrier(3)], 3)
+    assert [r for _op, r in got] == [(1, 10), (2, 20), (3, 30)]
+    # restart over the same store: keys 1-3 stay deduped
+    got2 = run([barrier(4), chunk([3, 4], [31, 40]), barrier(5)], 2)
+    assert [r for _op, r in got2] == [(4, 40)]

@@ -159,3 +159,17 @@ def test_pgwire_extended_protocol():
 
     rows = asyncio.run(run())
     assert int(rows[0][0]) > 0
+
+
+def test_pgwire_param_substitution_is_token_aware():
+    from risingwave_tpu.frontend.pgwire import PgServer
+
+    sub = PgServer._sub_params_sql
+    # $n inside a string literal is untouched; a value containing $1
+    # is never re-scanned
+    assert sub("SELECT 'price $1', $1", ["x"]) == \
+        "SELECT 'price $1', 'x'"
+    assert sub("SELECT $1, $2", ["a", "$1"]) == "SELECT 'a', '$1'"
+    assert sub("SELECT $1", [None]) == "SELECT NULL"
+    assert sub("SELECT $1", ["O'Brien"]) == "SELECT 'O''Brien'"
+    assert PgServer._param_count("SELECT $2 + '$9'") == 2
