@@ -154,6 +154,29 @@ def _probe_device(timeout_s: int = 240, attempts: int = 3) -> None:
 
 
 def main(argv):
+    import contextlib
+    import os
+    from risingwave_tpu.utils.jaxtools import enable_compilation_cache
+    from risingwave_tpu.utils.tpulock import ChipBusy, chip_lock
+    # Chip discipline (VERDICT r3): hold the exclusive chip lock for
+    # the WHOLE run (probe included — the probe subprocess is itself a
+    # TPU client). Two concurrent clients wedge the tunnel for minutes.
+    lock = contextlib.nullcontext() \
+        if os.environ.get("JAX_PLATFORMS") == "cpu" else chip_lock()
+    try:
+        lock.__enter__()
+    except ChipBusy as e:
+        print(f"WARNING: {e} — benching on CPU", file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        lock = contextlib.nullcontext()
+        lock.__enter__()
+    try:
+        _main_locked(argv)
+    finally:
+        lock.__exit__(None, None, None)
+
+
+def _main_locked(argv):
     from risingwave_tpu.utils.jaxtools import enable_compilation_cache
     _probe_device()
     enable_compilation_cache()

@@ -56,9 +56,6 @@ class PgServer:
     def __init__(self, frontend: Frontend):
         self.frontend = frontend
         self._server: Optional[asyncio.AbstractServer] = None
-        # Describe(statement) results reusable by the following Bind
-        # (per server; keyed by statement name)
-        self._describe_cache: dict = {}
 
     async def serve(self, host: str = "127.0.0.1", port: int = 4566):
         self._server = await asyncio.start_server(
@@ -81,7 +78,13 @@ class PgServer:
         # and portals are per-connection; after an error the backend
         # discards messages until Sync
         stmts: dict = {}      # name → sql
-        portals: dict = {}    # name → ("rows", rows, schema)|("cmd", s)
+        portals: dict = {}    # name → ["rows", rows, schema, pos]|["cmd", s]
+        # Describe(statement) results reusable by the following Bind.
+        # PER CONNECTION (prepared statements are per-connection) and
+        # invalidated whenever Parse redefines the name (ADVICE r3:
+        # a server-global cache could hand one connection another
+        # connection's rows, or stale rows after re-Parse of "")
+        describe_cache: dict = {}
         failed = False
         try:
             if not await self._startup(reader, writer):
@@ -106,14 +109,15 @@ class PgServer:
                     continue
                 try:
                     if tag == b"P":
-                        self._parse_msg(payload, stmts)
+                        self._parse_msg(payload, stmts, describe_cache)
                         writer.write(_msg(b"1", b""))  # ParseComplete
                     elif tag == b"B":
-                        await self._bind_msg(payload, stmts, portals)
+                        await self._bind_msg(payload, stmts, portals,
+                                             describe_cache)
                         writer.write(_msg(b"2", b""))  # BindComplete
                     elif tag == b"D":
-                        await self._describe_msg(payload, stmts,
-                                                 portals, writer)
+                        await self._describe_msg(payload, stmts, portals,
+                                                 describe_cache, writer)
                     elif tag == b"E":
                         self._execute_msg(payload, portals, writer)
                     elif tag == b"C":                  # Close
@@ -182,18 +186,20 @@ class PgServer:
         end = payload.index(b"\x00", at)
         return payload[at:end].decode(), end + 1
 
-    def _parse_msg(self, payload: bytes, stmts: dict) -> None:
+    def _parse_msg(self, payload: bytes, stmts: dict,
+                   describe_cache: dict) -> None:
         name, at = self._read_cstr(payload, 0)
         sql, at = self._read_cstr(payload, at)
         # declared parameter-type OIDs are accepted and ignored (text
         # parameters are substituted at bind time)
         stmts[name] = sql
+        describe_cache.pop(name, None)   # re-Parse invalidates
 
     async def _bind_msg(self, payload: bytes, stmts: dict,
-                        portals: dict) -> None:
+                        portals: dict, describe_cache: dict) -> None:
         portal, at = self._read_cstr(payload, 0)
         stmt, at = self._read_cstr(payload, at)
-        cached = self._describe_cache.pop(stmt, None)
+        cached = describe_cache.pop(stmt, None)
         sql = stmts[stmt]
         nfmt = struct.unpack_from(">H", payload, at)[0]
         fmts = struct.unpack_from(f">{nfmt}H", payload, at + 2) \
@@ -217,18 +223,19 @@ class PgServer:
         # $n substitution with SQL-quoted text literals (the statement
         # re-plans per bind; prepared-plan caching is a later increment)
         if cached is not None and not params:
-            portals[portal] = cached
+            portals[portal] = ["rows", cached[1], cached[2], 0]
             return
         sql = self._sub_params_sql(sql, params)
         result = await self.frontend.execute(sql)
         if isinstance(result, str):
-            portals[portal] = ("cmd", result)
+            portals[portal] = ["cmd", result]
         else:
             schema = getattr(self.frontend, "last_select_schema", None)
-            portals[portal] = ("rows", result, schema)
+            portals[portal] = ["rows", result, schema, 0]
 
     async def _describe_msg(self, payload: bytes, stmts: dict,
-                            portals: dict, writer) -> None:
+                            portals: dict, describe_cache: dict,
+                            writer) -> None:
         kind = payload[0:1]
         name, _ = self._read_cstr(payload, 1)
         if kind == b"S":
@@ -248,7 +255,7 @@ class PgServer:
                 rows = await self.frontend.execute(sql)
                 schema = getattr(self.frontend,
                                  "last_select_schema", None)
-                self._describe_cache[name] = ("rows", rows, schema)
+                describe_cache[name] = ("rows", rows, schema)
                 writer.write(_row_description(rows, schema))
             else:
                 # parameterized (shape unknown until Bind — portal
@@ -264,17 +271,27 @@ class PgServer:
 
     def _execute_msg(self, payload: bytes, portals: dict,
                      writer) -> None:
-        name, _ = self._read_cstr(payload, 0)
+        name, at = self._read_cstr(payload, 0)
+        # fetch-size pagination (ADVICE r3): honor the int32 max-rows
+        # field — JDBC setFetchSize / psycopg server-side cursors expect
+        # PortalSuspended between partial result sets
+        max_rows = struct.unpack_from(">i", payload, at)[0]
         p = portals[name]
         if p[0] == "cmd":
             writer.write(_msg(b"C", _cstr(p[1].replace("_", " "))))
             return
-        rows, schema = p[1], p[2]
+        rows, schema, pos = p[1], p[2], p[3]
         types = ([f.data_type for f in schema]
                  if schema is not None else None)
-        for row in rows:
+        end = len(rows) if max_rows <= 0 else min(len(rows),
+                                                  pos + max_rows)
+        for row in rows[pos:end]:
             writer.write(_data_row(row, types))
-        writer.write(_msg(b"C", _cstr(f"SELECT {len(rows)}")))
+        p[3] = end
+        if end < len(rows):
+            writer.write(_msg(b"s", b""))            # PortalSuspended
+        else:
+            writer.write(_msg(b"C", _cstr(f"SELECT {end - pos}")))
 
     async def _startup(self, reader, writer) -> bool:
         while True:

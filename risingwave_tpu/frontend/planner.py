@@ -296,13 +296,14 @@ class StreamPlanner:
                     "per retraction half would corrupt join state)")
             left: Executor = RowIdGenExecutor(ex)
             lscope = Scope(left.schema, scope.qualifiers + [None])
+            # build every right chain up front so the FULL scope exists
+            # before any pushdown decision: a conjunct whose unqualified
+            # column lives on both sides must raise 'ambiguous', not
+            # silently bind to whichever partial scope sees it first
+            # (ADVICE r3)
+            rights = []
+            full_scope = lscope
             for jn in sel.joins:
-                # pushdown legality by join kind: a conjunct may move
-                # below a side only if that side is NOT null-padded by
-                # this join (else filter-after-join semantics change)
-                if jn.kind in ("inner", "left"):
-                    left, conjuncts = _push_filters(left, lscope,
-                                                    conjuncts)
                 rex, rscope, rdeps = self._base_chain(
                     jn.item, rate_limit, min_chunks)
                 deps += rdeps
@@ -313,9 +314,20 @@ class StreamPlanner:
                         "state)")
                 right: Executor = RowIdGenExecutor(rex)
                 rscope = Scope(right.schema, rscope.qualifiers + [None])
+                rights.append((jn, right, rscope))
+                full_scope = full_scope.concat(rscope)
+            for jn, right, rscope in rights:
+                # pushdown legality by join kind: a conjunct may move
+                # below a side only if that side is NOT null-padded by
+                # this join (else filter-after-join semantics change)
+                if jn.kind in ("inner", "left"):
+                    left, conjuncts = _push_filters(left, lscope,
+                                                    conjuncts,
+                                                    full_scope)
                 if jn.kind in ("inner", "right"):
                     right, conjuncts = _push_filters(right, rscope,
-                                                     conjuncts)
+                                                     conjuncts,
+                                                     full_scope)
                 lkeys, rkeys = _equi_keys(jn.on, lscope, rscope)
                 lt = StateTable(self.catalog.next_id(), left.schema,
                                 list(left.pk_indices), self.store,
@@ -519,14 +531,23 @@ def _flatten_and(e: ast.Expr) -> List[ast.Expr]:
 
 
 def _push_filters(ex: Executor, scope: Scope,
-                  conjuncts: List[ast.Expr]
+                  conjuncts: List[ast.Expr],
+                  full_scope: Optional[Scope] = None
                   ) -> Tuple[Executor, List[ast.Expr]]:
     """Apply every conjunct bindable in `scope` as a filter on `ex`;
-    return the rest (predicate pushdown, rule/ pushdown analog)."""
+    return the rest (predicate pushdown, rule/ pushdown analog).
+
+    A conjunct is pushed only if it ALSO binds in `full_scope`
+    (ADVICE r3): an unqualified column present on both join sides binds
+    fine against the partial scope but is ambiguous in the full query —
+    leaving it unpushed lets the post-join bind raise the proper error,
+    so pushdown never changes which queries are rejected."""
     rest: List[ast.Expr] = []
     for c in conjuncts:
         try:
             pred = Binder(scope).bind(c)
+            if full_scope is not None:
+                Binder(full_scope).bind(c)
         except BindError:
             rest.append(c)
             continue

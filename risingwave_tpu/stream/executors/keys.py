@@ -59,10 +59,14 @@ class Interner:
         return got
 
     def lookup(self, ids: np.ndarray) -> np.ndarray:
+        """id array → values. Unknown ids (NULL keys decode to id 0,
+        which may not exist yet — e.g. a recovered interner whose rows
+        were all NULL-keyed, ADVICE r3) map to None instead of raising."""
         out = np.empty(len(ids), dtype=object)
         vals = self.values
+        n = len(vals)
         for i, x in enumerate(ids.tolist()):
-            out[i] = vals[x]
+            out[i] = vals[x] if 0 <= x < n else None
         return out
 
 

@@ -1,0 +1,76 @@
+"""Chip-claim discipline: ONE TPU client process at a time.
+
+The axon-tunneled chip admits a single client; a second client blocks
+in backend init until the first's claim expires, and a SIGKILLed
+client's remote claim takes minutes to expire (this wedged round 3's
+bench for the whole round). The guard is an OS-level advisory lock:
+
+- `flock(2)` on a repo-local lockfile — the KERNEL releases it when
+  the holder dies, however it dies, so there is no stale-lock state
+  to clean up (a pidfile would lie after SIGKILL).
+- every in-repo TPU entrypoint (bench.py, profiling scripts) acquires
+  it BEFORE importing jax / initializing the backend, so two clients
+  can never race for the chip claim.
+- holders should still die by SIGTERM, never SIGKILL: the LOCAL lock
+  frees instantly either way, but the REMOTE claim only releases
+  promptly on a clean client shutdown.
+
+No reference counterpart — this guards a tunnel artifact, not a
+RisingWave concern.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import os
+import sys
+import time
+from contextlib import contextmanager
+from typing import Iterator, Optional
+
+LOCK_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), ".tpu.lock")
+
+
+class ChipBusy(TimeoutError):
+    """Another process holds the chip lock."""
+
+
+def _try_lock(fd: int) -> bool:
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        return True
+    except OSError:
+        return False
+
+
+@contextmanager
+def chip_lock(timeout_s: float = 600.0, poll_s: float = 2.0,
+              path: Optional[str] = None) -> Iterator[None]:
+    """Hold the exclusive chip claim for the duration of the block.
+
+    Blocks up to `timeout_s` waiting for the current holder to exit,
+    then raises ChipBusy (callers decide whether to fall back to CPU).
+    """
+    p = path or LOCK_PATH
+    fd = os.open(p, os.O_CREAT | os.O_RDWR, 0o644)
+    try:
+        deadline = time.monotonic() + timeout_s
+        while not _try_lock(fd):
+            if time.monotonic() >= deadline:
+                holder = ""
+                try:
+                    holder = os.read(fd, 64).decode(errors="replace")
+                except OSError:
+                    pass
+                raise ChipBusy(
+                    f"chip lock held (holder: {holder.strip() or '?'}) "
+                    f"after {timeout_s:.0f}s — refusing to start a "
+                    "second TPU client")
+            time.sleep(poll_s)
+        os.ftruncate(fd, 0)
+        os.pwrite(fd, f"pid={os.getpid()} argv={sys.argv[0]}\n".encode(),
+                  0)
+        yield
+    finally:
+        os.close(fd)     # closes → kernel drops the flock
