@@ -156,7 +156,6 @@ def _probe_device(timeout_s: int = 240, attempts: int = 3) -> None:
 def main(argv):
     import contextlib
     import os
-    from risingwave_tpu.utils.jaxtools import enable_compilation_cache
     from risingwave_tpu.utils.tpulock import ChipBusy, chip_lock
     # Chip discipline (VERDICT r3): hold the exclusive chip lock for
     # the WHOLE run (probe included — the probe subprocess is itself a

@@ -50,19 +50,33 @@ class StateStore:
 
 
 class _Table:
-    """One table's ordered MVCC map: sorted key index + version lists."""
+    """One table's ordered MVCC map: sorted key index + version lists.
 
-    __slots__ = ("keys", "versions")
+    The key index is LAZILY sorted: puts append (O(1)) and set a dirty
+    flag; the first ordered read re-sorts. Timsort on a sorted prefix +
+    appended tail is near O(n) — while ``bisect.insort`` per new key is
+    O(n) EACH, which made streaming ingest quadratic in table size (the
+    r3 join benches spent most of their p99 barrier here)."""
+
+    __slots__ = ("keys", "versions", "_dirty")
 
     def __init__(self) -> None:
-        self.keys: List[bytes] = []          # sorted
+        self.keys: List[bytes] = []          # sorted iff not _dirty
         self.versions: Dict[bytes, Versions] = {}
+        self._dirty = False
+
+    def sorted_keys(self) -> List[bytes]:
+        if self._dirty:
+            self.keys.sort()
+            self._dirty = False
+        return self.keys
 
     def put(self, key: bytes, epoch: int, value: Value) -> None:
         vs = self.versions.get(key)
         if vs is None:
             self.versions[key] = [(epoch, value)]
-            bisect.insort(self.keys, key)
+            self.keys.append(key)
+            self._dirty = True
             return
         # keep newest-first order even for out-of-order epoch ingest;
         # same-epoch overwrite replaces (linear scan: version lists are short)
@@ -132,10 +146,11 @@ class MemoryStateStore(StateStore):
              start: Optional[bytes] = None, end: Optional[bytes] = None
              ) -> Iterator[Tuple[bytes, tuple]]:
         t = self._table(table_id)
-        lo = bisect.bisect_left(t.keys, start) if start is not None else 0
-        hi = bisect.bisect_left(t.keys, end) if end is not None else len(t.keys)
+        keys = t.sorted_keys()
+        lo = bisect.bisect_left(keys, start) if start is not None else 0
+        hi = bisect.bisect_left(keys, end) if end is not None else len(keys)
         for i in range(lo, hi):
-            key = t.keys[i]
+            key = keys[i]
             v = t.read(key, epoch)
             if v is not None:
                 yield key, v

@@ -40,8 +40,11 @@ def _try_lock(fd: int) -> bool:
     try:
         fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
         return True
-    except OSError:
+    except BlockingIOError:
         return False
+    # any other OSError (ENOLCK/ENOTSUP: filesystem without flock)
+    # propagates — a spurious ChipBusy would silently cost the round
+    # its TPU number, the exact failure this lock exists to prevent
 
 
 @contextmanager
