@@ -229,16 +229,21 @@ class _JoinSide:
                     dtype=bool, count=chunk.capacity)
         return m
 
-    def apply_chunk(self, chunk: StreamChunk, key_lanes: np.ndarray,
-                    nonnull: Optional[np.ndarray] = None, seq: int = 0
-                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """Update this side's state with the chunk's inserts/deletes.
+    def apply_chunk_host(self, chunk: StreamChunk,
+                         nonnull: Optional[np.ndarray] = None
+                         ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                    np.ndarray, np.ndarray, np.ndarray]:
+        """HOST half of a chunk apply: pk→ref/arena bookkeeping only.
+        Returns (ins_idx, ins_refs, full_refs, ins_mask, del_refs,
+        del_mask) for ONE fused device dispatch (ops/hash_join.py
+        apply_and_probe) — per-chunk device calls are the TPU hot-path
+        cost, so the executor batches them all into one.
 
         pk→ref bookkeeping runs in ROW ORDER (a delete refers to the
         latest same-pk version, which may be an insert earlier in this
-        very chunk — update pairs land as [U-, U+] with one pk). The
-        device calls stay whole-batch: tombstoning and front-linking
-        commute once each delete has resolved to the right ref."""
+        very chunk — update pairs land as [U-, U+] with one pk); an
+        all-insert chunk (append-only sources — the common case) takes
+        a bulk dict.update instead of the per-row loop."""
         vis = np.asarray(chunk.visibility)
         if nonnull is None:
             nonnull = self.key_nonnull_mask(chunk)
@@ -259,36 +264,37 @@ class _JoinSide:
                 col = [None if not o else v
                        for v, o in zip(col, okv.tolist())]
             pk_lists.append(col)
-        pks = dict(zip(st_idx.tolist(), zip(*pk_lists))) \
-            if pk_lists else {int(r): () for r in st_idx.tolist()}
+        pk_tuples = list(zip(*pk_lists)) if pk_lists \
+            else [()] * len(st_idx)
 
         ins_refs = self.alloc_refs(len(ins_idx))
-        ins_pos = {int(r): j for j, r in enumerate(ins_idx)}
         del_refs = np.zeros(chunk.capacity, dtype=np.int32)
         del_mask = np.zeros(chunk.capacity, dtype=bool)
-        for r in st_idx.tolist():
-            if r in ins_pos:
-                self.pk_to_ref[pks[r]] = int(ins_refs[ins_pos[r]])
-            else:
-                ref = self.pk_to_ref.pop(pks[r], None)
-                if ref is None:
-                    continue   # delete of unseen row (inconsistent op)
-                del_refs[r] = ref
-                del_mask[r] = True
-                self.free.append(ref)
+        if len(ins_idx) == len(st_idx):
+            # append-only fast path: no deletes, refs align with pks
+            self.pk_to_ref.update(zip(pk_tuples, ins_refs.tolist()))
+        else:
+            pks = dict(zip(st_idx.tolist(), pk_tuples))
+            ins_pos = {int(r): j for j, r in enumerate(ins_idx)}
+            for r in st_idx.tolist():
+                if r in ins_pos:
+                    self.pk_to_ref[pks[r]] = int(ins_refs[ins_pos[r]])
+                else:
+                    ref = self.pk_to_ref.pop(pks[r], None)
+                    if ref is None:
+                        continue   # delete of unseen row (inconsistent)
+                    del_refs[r] = ref
+                    del_mask[r] = True
+                    self.free.append(ref)
+        full_refs = np.zeros(chunk.capacity, dtype=np.int32)
+        ins_mask = np.zeros(chunk.capacity, dtype=bool)
         if len(ins_idx):
             self.arena.store(ins_refs, chunk, ins_idx)
             self.ensure_degrees(int(ins_refs.max()))
-            full_refs = np.zeros(chunk.capacity, dtype=np.int32)
             full_refs[ins_idx] = ins_refs
-            mask = np.zeros(chunk.capacity, dtype=bool)
-            mask[ins_idx] = True
-            self.kernel.insert(jnp.asarray(key_lanes), full_refs,
-                               jnp.asarray(mask), seq=seq)
-        if del_mask.any():
-            self.kernel.delete(del_refs, jnp.asarray(del_mask), seq=seq)
+            ins_mask[ins_idx] = True
         self.table.write_chunk(chunk)
-        return ins_idx, ins_refs, del_mask
+        return ins_idx, ins_refs, full_refs, ins_mask, del_refs, del_mask
 
     # dead-ref fraction of the arena that triggers a compaction; dead
     # refs cannot be recycled in place (see alloc_refs), so churn-heavy
@@ -570,21 +576,25 @@ class HashJoinExecutor(Executor):
 
     def _ingest_chunk(self, side_idx: int, chunk: StreamChunk,
                       key_lanes, nonnull: np.ndarray) -> None:
-        """Dispatch side: submit the probe (async DMA) and apply the
-        chunk to this side's state at its message sequence. NO blocking
-        reads — results are collected in one sweep at the barrier
-        (sequence versioning keeps the late-read probes exact)."""
+        """Dispatch side: ONE fused device call per chunk — probe the
+        other side AND apply this side's inserts/deletes, all at the
+        chunk's message sequence (DMA starts; nothing blocks). Results
+        are collected in one sweep at the barrier (sequence versioning
+        keeps the late-read probes exact)."""
         me = self.sides[side_idx]
         other = self.sides[1 - side_idx]
         seq = self._seq
         self._seq += 1
         probe_vis = np.asarray(chunk.visibility) & nonnull
+        (ins_idx, ins_refs, full_refs, ins_mask, del_refs,
+         del_mask) = me.apply_chunk_host(chunk, nonnull)
+        # ins/del entries only exist at storable (= probe-visible) rows,
+        # so one mask decides both the dispatch and the collect
         handle = None
         if probe_vis.any():
-            handle = other.kernel.probe_submit(
-                jnp.asarray(key_lanes), jnp.asarray(probe_vis), seq)
-        ins_idx, ins_refs, _dels = me.apply_chunk(
-            chunk, key_lanes, nonnull=nonnull, seq=seq)
+            handle = me.kernel.apply_and_probe(
+                other.kernel, jnp.asarray(key_lanes), probe_vis,
+                full_refs, ins_mask, del_refs, del_mask, seq)
         self._pending.append(
             (side_idx, chunk, nonnull, handle, ins_idx, ins_refs))
 
