@@ -338,9 +338,12 @@ class StreamPlanner:
                       "left": JoinType.LEFT_OUTER,
                       "right": JoinType.RIGHT_OUTER,
                       "full": JoinType.FULL_OUTER}[jn.kind]
+                # parallel plan: the hash exchange feeding N parallel
+                # join actors (dispatch.rs:582) is the sharded kernel's
+                # in-program all_to_all — same wiring as the agg path
                 left = HashJoinExecutor(left, right, lkeys, rkeys, lt,
                                         rt, actor_id=actor_id,
-                                        join_type=jt)
+                                        join_type=jt, mesh=self.mesh)
                 lscope = lscope.concat(rscope)
             ex = left
             scope = lscope

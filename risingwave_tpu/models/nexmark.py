@@ -156,11 +156,16 @@ def build_q7(store, cfg: NexmarkConfig,
 def build_q8(store, cfg_p: NexmarkConfig, cfg_a: NexmarkConfig,
              rate_limit: Optional[int] = 4,
              window: Interval = DEFAULT_WINDOW,
-             min_chunks: Optional[int] = None) -> Pipeline:
+             min_chunks: Optional[int] = None, mesh=None) -> Pipeline:
     """q8: persons who created an auction in the same tumbling window.
 
     two sources → projects → auction-side hash-agg dedup → inner
-    HashJoin (device matcher) → project → materialize."""
+    HashJoin (device matcher) → project → materialize.
+
+    With ``mesh``, the join runs on the vnode-sharded SPMD matcher
+    (parallel/join.ShardedJoinKernel): both sides' state routes to key
+    owners over one all_to_all — the reference's hash dispatch to N
+    parallel join actors (dispatch.rs:582)."""
     local = LocalBarrierManager()
     persons = _source(local, store, 1, cfg_p, 1, rate_limit, min_chunks)
     ps = persons.schema
@@ -195,7 +200,7 @@ def build_q8(store, cfg_p: NexmarkConfig, cfg_a: NexmarkConfig,
                     dist_key_indices=[0])
     join = HashJoinExecutor(p_proj, a_dedup_proj,
                             left_keys=[0, 2], right_keys=[0, 1],
-                            left_table=lt, right_table=rt)
+                            left_table=lt, right_table=rt, mesh=mesh)
     out = ProjectExecutor(
         join,
         exprs=[InputRef(0, DataType.INT64),

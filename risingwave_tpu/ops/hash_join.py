@@ -325,7 +325,9 @@ class JoinSideKernel:
                                 self.table.capacity, jnp.int32(seq))
 
     def delete(self, row_refs: np.ndarray, vis: jnp.ndarray,
-               seq: int = 0) -> None:
+               seq: int = 0, key_lanes=None) -> None:
+        # key_lanes: routing info for the SHARDED kernel's API twin
+        # (parallel/join.py); a single chip tombstones by ref directly
         self.chains = _tombstone_jit(self.chains, jnp.asarray(row_refs),
                                      vis, jnp.int32(seq))
 

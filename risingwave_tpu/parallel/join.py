@@ -5,19 +5,21 @@ dispatchers on both inputs (dispatch.rs:582; hash_join.rs:227). TPU
 re-design: each mesh shard owns the join-key vnode range's slice of
 BOTH sides' key tables and row chains; a chunk routes to owners via the
 bucketized all_to_all (parallel/exchange.py) and then runs the exact
-single-chip kernels (ops/hash_join.py probe_pairs / link_rows) locally
-— one code path, two launch shapes, matching ShardedAggKernel's
-construction so the whole q8 plan shards the same way the q7 plan does.
+single-chip kernels (ops/hash_join.py probe_pairs / link_rows /
+tombstone_rows, sequence-versioned) locally — one code path, two
+launch shapes, matching ShardedAggKernel's construction so the whole
+q8 plan shards the same way the q7 plan does.
 
-Host contract: row refs are GLOBAL (the host arena's); each shard's
-chains store the global refs routed to it, so probe results need no
-re-translation. Probe outputs return per-shard packed pair matrices
-with the probing row's global id as the left column.
+Host contract: row refs are GLOBAL (the host arena's); a ref lives
+only on its key's owner shard, so each shard's chain arrays index by
+global ref directly and probe results need no re-translation. The
+executor (stream/executors/hash_join.py) cannot tell this kernel from
+the single-chip JoinSideKernel — same apply_and_probe / probe /
+delete / rebuild / rebase_seq API, same async PendingProbe contract.
 """
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -28,27 +30,86 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from risingwave_tpu.common.hash import VNODE_COUNT
 from risingwave_tpu.ops import hash_table as ht
 from risingwave_tpu.ops.hash_join import (
-    I32_MAX, ChainState, link_rows, probe_pairs,
+    I32_MAX, ChainState, link_rows, probe_pairs, tombstone_rows,
 )
 from risingwave_tpu.parallel.exchange import (
     bucketize_by_owner, exchange, vnodes_from_lanes,
 )
+from risingwave_tpu.utils import jaxtools
 
 AXIS = "d"
 
 
-class ShardedJoinSide:
-    """One join side's matcher sharded over a mesh (fixed capacity v1)."""
+class ShardedPendingProbe:
+    """In-flight sharded probe (DMA started at dispatch).
+
+    Mirrors ops/hash_join.PendingProbe: sequence versioning makes
+    collect() exact however late it runs, and an overflowed per-shard
+    pair buffer re-dispatches a probe-only step at the recorded seq."""
+
+    def __init__(self, kernel: "ShardedJoinKernel", mats, key_lanes,
+                 vis, seq: int, out_cap: int, n: int):
+        self.kernel = kernel
+        self.mats = mats
+        self.key_lanes = key_lanes      # host arrays (padded)
+        self.vis = vis
+        self.seq = seq
+        self.out_cap = out_cap
+        self.n = n                      # caller rows (pre-padding)
+
+    def collect(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(degrees[n], probe_idx[pairs], refs[pairs]) — pairs sorted
+        by probe row so same-pk delete/insert halves stay ordered."""
+        k = self.kernel
+        while True:
+            mats = np.asarray(jaxtools.fetch1(self.mats))
+            worst = int(mats[:, 0, 0].max())
+            if worst <= self.out_cap:
+                break
+            while k.probe_capacity < worst:
+                k.probe_capacity *= 2
+            self.out_cap = k.probe_capacity
+            self.mats = k._dispatch_probe(self.key_lanes, self.vis,
+                                          self.seq, self.out_cap)
+        m = mats.shape[1] - 1 - self.out_cap
+        deg = np.zeros(self.n, dtype=np.int32)
+        probes, refs = [], []
+        for d in range(mats.shape[0]):
+            blk = mats[d, 1:1 + m]
+            rid, dg = blk[:, 1], blk[:, 0]
+            sel = rid >= 0
+            deg[rid[sel]] = dg[sel]
+            total = int(mats[d, 0, 0])
+            pairs = mats[d, 1 + m:1 + m + total]
+            probes.append(pairs[:, 0])
+            refs.append(pairs[:, 1])
+        probe_idx = np.concatenate(probes) if probes else \
+            np.zeros(0, np.int32)
+        ref_arr = np.concatenate(refs) if refs else np.zeros(0, np.int32)
+        order = np.argsort(probe_idx, kind="stable")
+        return deg, probe_idx[order], ref_arr[order]
+
+
+class ShardedJoinKernel:
+    """JoinSideKernel's API over a device mesh (multi-chip join side).
+
+    Fixed-capacity v1: over-capacity is a loud error, growth is future
+    work. Key-table occupancy is tracked as an upper bound (per-batch
+    unique keys over-count keys recurring across batches); when the
+    bound crosses the load limit it collapses to the true worst-shard
+    occupancy with one device sync — GroupedAggKernel._reserve's
+    scheme. The bound is GLOBAL while the limit is PER-SHARD, so it is
+    conservative: a false trip costs one sync, never a false pass."""
 
     def __init__(self, mesh: Mesh, key_width: int,
-                 key_capacity: int = 1 << 12,
-                 row_capacity: int = 1 << 12,
+                 key_capacity: int = 1 << 14,
+                 row_capacity: int = 1 << 16,
                  probe_capacity: int = 1 << 12):
         self.mesh = mesh
         self.n_dev = mesh.devices.size
         self.key_width = key_width
         self.key_capacity = key_capacity
-        self.row_capacity = row_capacity
+        self._row_capacity = row_capacity
         self.probe_capacity = probe_capacity
         owners = np.repeat(np.arange(self.n_dev, dtype=np.int32),
                            VNODE_COUNT // self.n_dev)
@@ -57,108 +118,40 @@ class ShardedJoinSide:
             owners = np.concatenate(
                 [owners, np.full(pad, self.n_dev - 1, np.int32)])
         self.owner_map = jnp.asarray(owners)
-        sharding = NamedSharding(mesh, P(AXIS))
+        self._sharding = NamedSharding(mesh, P(AXIS))
+        self._fresh_state()
+        self._apply_cache: Dict[tuple, object] = {}
+        self._probe_only_cache: Dict[tuple, object] = {}
+        self._delete_cache: Dict[tuple, object] = {}
+        self._insert_cache: Dict[tuple, object] = {}
+        self._keys_upper = 0
 
-        def stack(a):
-            return jax.device_put(
-                jnp.broadcast_to(a[None], (self.n_dev,) + a.shape),
-                sharding)
+    @property
+    def row_capacity(self) -> int:
+        return self._row_capacity
 
-        table = ht.make_state(key_capacity, key_width)
-        self.table = ht.TableState(stack(table.keys), stack(table.occ))
+    def _stack(self, a):
+        return jax.device_put(
+            jnp.broadcast_to(a[None], (self.n_dev,) + a.shape),
+            self._sharding)
+
+    def _fresh_state(self) -> None:
+        table = ht.make_state(self.key_capacity, self.key_width)
+        self.table = ht.TableState(self._stack(table.keys),
+                                   self._stack(table.occ))
         self.chains = ChainState(
-            head=stack(jnp.full(key_capacity, -1, dtype=jnp.int32)),
-            next=stack(jnp.full(row_capacity, -1, dtype=jnp.int32)),
-            ins_seq=stack(jnp.full(row_capacity, I32_MAX,
-                                   dtype=jnp.int32)),
-            del_seq=stack(jnp.full(row_capacity, I32_MAX,
-                                   dtype=jnp.int32)))
-        self._insert_cache: Dict[Tuple[int, int], object] = {}
-        self._probe_cache: Dict[Tuple[int, int, int], object] = {}
-        self._keys_upper = 0      # distinct-key upper bound (host)
+            head=self._stack(jnp.full(self.key_capacity, -1,
+                                      dtype=jnp.int32)),
+            next=self._stack(jnp.full(self._row_capacity, -1,
+                                      dtype=jnp.int32)),
+            ins_seq=self._stack(jnp.full(self._row_capacity, I32_MAX,
+                                         dtype=jnp.int32)),
+            del_seq=self._stack(jnp.full(self._row_capacity, I32_MAX,
+                                         dtype=jnp.int32)))
 
-    # -- SPMD steps -------------------------------------------------------
-    def _build_insert(self, n: int, bucket: int):
-        n_dev = self.n_dev
-        cap = self.key_capacity
-
-        def local(table, chains, key_lanes, refs, vis, owner_map):
-            table = jax.tree.map(lambda a: a[0], table)
-            chains = jax.tree.map(lambda a: a[0], chains)
-            owner = owner_map[vnodes_from_lanes(key_lanes)]
-            buckets, bvalid, overflow = bucketize_by_owner(
-                owner, vis, [key_lanes, refs], n_dev, bucket)
-            recv, rvalid = exchange(buckets, bvalid, AXIS)
-            m = n_dev * bucket
-            rkeys = recv[0].reshape(m, key_lanes.shape[1])
-            rrefs = recv[1].reshape(m)
-            rvis = rvalid.reshape(m)
-            table, slots, _ins = ht.probe_insert(table, rkeys, rvis)
-            chains = link_rows(chains, slots, rrefs, rvis, cap,
-                               jnp.int32(0))
-            return (jax.tree.map(lambda a: a[None], table),
-                    jax.tree.map(lambda a: a[None], chains),
-                    overflow[None])
-
-        tspec = jax.tree.map(lambda _: P(AXIS), self.table)
-        cspec = jax.tree.map(lambda _: P(AXIS), self.chains)
-        mapped = jax.shard_map(
-            local, mesh=self.mesh,
-            in_specs=(tspec, cspec, P(AXIS), P(AXIS), P(AXIS), P()),
-            out_specs=(tspec, cspec, P(AXIS)),
-            check_vma=False)
-        return jax.jit(mapped, donate_argnums=(0, 1))
-
-    def _build_probe(self, n: int, bucket: int, out_cap: int):
-        n_dev = self.n_dev
-
-        def local(table, chains, key_lanes, row_ids, vis, owner_map):
-            table = jax.tree.map(lambda a: a[0], table)
-            chains = jax.tree.map(lambda a: a[0], chains)
-            owner = owner_map[vnodes_from_lanes(key_lanes)]
-            buckets, bvalid, overflow = bucketize_by_owner(
-                owner, vis, [key_lanes, row_ids], n_dev, bucket)
-            recv, rvalid = exchange(buckets, bvalid, AXIS)
-            m = n_dev * bucket
-            rkeys = recv[0].reshape(m, key_lanes.shape[1])
-            rids = recv[1].reshape(m)
-            rvis = rvalid.reshape(m)
-            mat = probe_pairs(table, chains, rkeys, rvis,
-                              jnp.int32(I32_MAX), out_cap)
-            # rewrite probe-row indices (local post-exchange positions)
-            # to the routed global row ids; -1 stays -1
-            pairs = mat[1 + m:]
-            safe = jnp.maximum(pairs[:, 0], 0)
-            gprobe = jnp.where(pairs[:, 0] >= 0, rids[safe], -1)
-            pairs = jnp.stack([gprobe, pairs[:, 1]], axis=1)
-            out = jnp.concatenate([mat[:1], pairs], axis=0)
-            return out[None], overflow[None]
-
-        tspec = jax.tree.map(lambda _: P(AXIS), self.table)
-        cspec = jax.tree.map(lambda _: P(AXIS), self.chains)
-        mapped = jax.shard_map(
-            local, mesh=self.mesh,
-            in_specs=(tspec, cspec, P(AXIS), P(AXIS), P(AXIS), P()),
-            out_specs=(P(AXIS), P(AXIS)),
-            check_vma=False)
-        return jax.jit(mapped)
-
-    # -- host API ---------------------------------------------------------
-    def insert(self, key_lanes: np.ndarray, refs: np.ndarray,
-               vis: np.ndarray) -> None:
-        n = key_lanes.shape[0]
-        assert n % self.n_dev == 0, (n, self.n_dev)
-        # fixed-capacity v1 guards: overfilling a shard's key table
-        # would make probe_insert link rows under wrong keys, and a
-        # ref >= row_capacity would be silently dropped by the chain
-        # scatter — both must fail loudly until growth lands here.
-        # key-table occupancy grows with DISTINCT keys (duplicates
-        # chain in the row arena). The host tracks an UPPER BOUND
-        # (per-batch unique keys, which over-counts keys recurring
-        # across batches); when the bound crosses the load limit it is
-        # collapsed to the true worst-shard occupancy with one device
-        # sync — same scheme as GroupedAggKernel._reserve.
-        kv = np.asarray(key_lanes)[np.asarray(vis)]
+    # -- capacity guards (fixed-capacity v1) ------------------------------
+    def _guard_keys(self, key_lanes: np.ndarray, vis: np.ndarray) -> None:
+        kv = key_lanes[vis]
         self._keys_upper += len(np.unique(kv, axis=0)) if len(kv) else 0
         limit = ht.MAX_LOAD * self.key_capacity
         if self._keys_upper > limit:
@@ -170,54 +163,325 @@ class ShardedJoinSide:
                     f"{self._keys_upper} keys on the fullest shard vs "
                     f"{self.key_capacity} slots — raise key_capacity "
                     "(growth TBD)")
-        if len(refs) and int(np.max(refs)) >= self.row_capacity:
+
+    def _guard_refs(self, refs: np.ndarray, mask: np.ndarray) -> None:
+        if mask.any():
+            mx = int(refs[mask].max())
+            if mx >= self._row_capacity:
+                raise RuntimeError(
+                    f"row ref {mx} >= row_capacity "
+                    f"{self._row_capacity} — raise row_capacity "
+                    "(growth TBD)")
+
+    def reserve_rows(self, max_ref: int) -> None:
+        """API parity with JoinSideKernel; growth is v2 — loud check."""
+        if max_ref >= self._row_capacity:
             raise RuntimeError(
-                f"row ref {int(np.max(refs))} >= row_capacity "
-                f"{self.row_capacity} — raise row_capacity (growth TBD)")
-        bucket = n // self.n_dev
-        key = (n, bucket)
-        if key not in self._insert_cache:
-            self._insert_cache[key] = self._build_insert(n, bucket)
-        step = self._insert_cache[key]
+                f"row ref {max_ref} >= row_capacity "
+                f"{self._row_capacity} — raise row_capacity (growth TBD)")
+
+    # -- SPMD step builders ----------------------------------------------
+    def _specs(self):
+        tspec = jax.tree.map(lambda _: P(AXIS), self.table)
+        cspec = jax.tree.map(lambda _: P(AXIS), self.chains)
+        return tspec, cspec
+
+    @staticmethod
+    def _route(owner_map, lanes, payloads, valid, n_dev, bucket):
+        """Shared bucketize+exchange prologue of every local step.
+
+        `lanes` etc. are the LOCAL shard's slice (bucket rows); after
+        the all_to_all each shard holds up to n_dev*bucket routed rows
+        (worst case: every row keyed to one shard)."""
+        owner = owner_map[vnodes_from_lanes(lanes)]
+        buckets, bvalid, overflow = bucketize_by_owner(
+            owner, valid, [lanes] + payloads, n_dev, bucket)
+        recv, rvalid = exchange(buckets, bvalid, AXIS)
+        m = n_dev * bucket
+        rlanes = recv[0].reshape(m, lanes.shape[1])
+        flat = [r.reshape(m) for r in recv[1:]]
+        return rlanes, flat, rvalid.reshape(m), overflow
+
+    def _build_apply_probe(self, bucket: int, out_cap: int):
+        n_dev = self.n_dev
+        cap = self.key_capacity
+
+        def local(my_t, my_c, o_t, o_c, lanes, rowids, refs, drefs,
+                  pvis, imask, dmask, seq, owner_map):
+            my_t = jax.tree.map(lambda a: a[0], my_t)
+            my_c = jax.tree.map(lambda a: a[0], my_c)
+            o_t = jax.tree.map(lambda a: a[0], o_t)
+            o_c = jax.tree.map(lambda a: a[0], o_c)
+            valid = pvis | imask | dmask
+            rlanes, (rids, rrefs, rdrefs, rpv, rim, rdm), rvalid, ovf = \
+                ShardedJoinKernel._route(
+                    owner_map, lanes,
+                    [rowids, refs, drefs, pvis.astype(jnp.int32),
+                     imask.astype(jnp.int32), dmask.astype(jnp.int32)],
+                    valid, n_dev, bucket)
+            rpv = rvalid & (rpv == 1)
+            rim = rvalid & (rim == 1)
+            rdm = rvalid & (rdm == 1)
+            m = n_dev * bucket
+            mat = probe_pairs(o_t, o_c, rlanes, rpv, seq, out_cap)
+            my_t2, slots, _ins = ht.probe_insert(my_t, rlanes, rim)
+            ch = link_rows(my_c, slots, rrefs, rim, cap, seq)
+            ch = tombstone_rows(ch, rdrefs, rdm, seq)
+            # output [1 + m + out_cap, 2]: header; (deg, rid) block;
+            # (global probe row, ref) pairs
+            deg_blk = jnp.stack(
+                [mat[1:1 + m, 0],
+                 jnp.where(rvalid, rids, jnp.int32(-1))], axis=1)
+            pairs = mat[1 + m:]
+            safe = jnp.maximum(pairs[:, 0], 0)
+            gprobe = jnp.where(pairs[:, 0] >= 0, rids[safe],
+                               jnp.int32(-1))
+            out = jnp.concatenate(
+                [mat[:1], deg_blk,
+                 jnp.stack([gprobe, pairs[:, 1]], axis=1)], axis=0)
+            return (jax.tree.map(lambda a: a[None], my_t2),
+                    jax.tree.map(lambda a: a[None], ch),
+                    out[None], ovf[None])
+
+        tspec, cspec = self._specs()
+        mapped = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(tspec, cspec, tspec, cspec, P(AXIS), P(AXIS),
+                      P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS),
+                      P(), P()),
+            out_specs=(tspec, cspec, P(AXIS), P(AXIS)),
+            check_vma=False)
+        return jax.jit(mapped, donate_argnums=(0, 1))
+
+    def _build_probe_only(self, bucket: int, out_cap: int):
+        n_dev = self.n_dev
+
+        def local(t, c, lanes, rowids, vis, seq, owner_map):
+            t = jax.tree.map(lambda a: a[0], t)
+            c = jax.tree.map(lambda a: a[0], c)
+            rlanes, (rids,), rvalid, ovf = ShardedJoinKernel._route(
+                owner_map, lanes, [rowids], vis, n_dev, bucket)
+            m = n_dev * bucket
+            mat = probe_pairs(t, c, rlanes, rvalid, seq, out_cap)
+            deg_blk = jnp.stack(
+                [mat[1:1 + m, 0],
+                 jnp.where(rvalid, rids, jnp.int32(-1))], axis=1)
+            pairs = mat[1 + m:]
+            safe = jnp.maximum(pairs[:, 0], 0)
+            gprobe = jnp.where(pairs[:, 0] >= 0, rids[safe],
+                               jnp.int32(-1))
+            out = jnp.concatenate(
+                [mat[:1], deg_blk,
+                 jnp.stack([gprobe, pairs[:, 1]], axis=1)], axis=0)
+            return out[None], ovf[None]
+
+        tspec, cspec = self._specs()
+        mapped = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(tspec, cspec, P(AXIS), P(AXIS), P(AXIS), P(),
+                      P()),
+            out_specs=(P(AXIS), P(AXIS)),
+            check_vma=False)
+        return jax.jit(mapped)
+
+    def _build_delete(self, bucket: int):
+        n_dev = self.n_dev
+
+        def local(c, lanes, drefs, dmask, seq, owner_map):
+            c = jax.tree.map(lambda a: a[0], c)
+            _rl, (rdrefs,), rvalid, ovf = ShardedJoinKernel._route(
+                owner_map, lanes, [drefs], dmask, n_dev, bucket)
+            ch = tombstone_rows(c, rdrefs, rvalid, seq)
+            return jax.tree.map(lambda a: a[None], ch), ovf[None]
+
+        tspec, cspec = self._specs()
+        mapped = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(cspec, P(AXIS), P(AXIS), P(AXIS), P(), P()),
+            out_specs=(cspec, P(AXIS)),
+            check_vma=False)
+        return jax.jit(mapped, donate_argnums=(0,))
+
+    def _build_insert(self, bucket: int):
+        """Insert-only step (rebuild/insert): route+probe_insert+link."""
+        n_dev = self.n_dev
+        cap = self.key_capacity
+
+        def local(t, c, lanes, refs, vis, seq, owner_map):
+            t = jax.tree.map(lambda a: a[0], t)
+            c = jax.tree.map(lambda a: a[0], c)
+            rlanes, (rrefs,), rvalid, ovf = ShardedJoinKernel._route(
+                owner_map, lanes, [refs], vis, n_dev, bucket)
+            t2, slots, _ins = ht.probe_insert(t, rlanes, rvalid)
+            ch = link_rows(c, slots, rrefs, rvalid, cap, seq)
+            return (jax.tree.map(lambda a: a[None], t2),
+                    jax.tree.map(lambda a: a[None], ch), ovf[None])
+
+        tspec, cspec = self._specs()
+        mapped = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(tspec, cspec, P(AXIS), P(AXIS), P(AXIS), P(),
+                      P()),
+            out_specs=(tspec, cspec, P(AXIS)),
+            check_vma=False)
+        return jax.jit(mapped, donate_argnums=(0, 1))
+
+    # -- host API (JoinSideKernel parity) ---------------------------------
+    def _pad(self, arrs, n: int):
+        """Pad host arrays to a multiple of n_dev rows."""
+        m = max(self.n_dev, n)
+        if m % self.n_dev:
+            m += self.n_dev - (m % self.n_dev)
+        if m == n:
+            return arrs, n
+        out = []
+        for a in arrs:
+            a = np.asarray(a)
+            pad_shape = (m - n,) + a.shape[1:]
+            out.append(np.concatenate(
+                [a, np.zeros(pad_shape, dtype=a.dtype)]))
+        return out, m
+
+    def apply_and_probe(self, other: "ShardedJoinKernel",
+                        key_lanes: np.ndarray, probe_vis: np.ndarray,
+                        ins_refs: np.ndarray, ins_mask: np.ndarray,
+                        del_refs: np.ndarray, del_mask: np.ndarray,
+                        seq: int) -> ShardedPendingProbe:
+        """One fused dispatch per chunk (executor hot path). All args
+        are HOST arrays — a device round-trip here would re-serialize
+        the async pipeline this kernel exists to keep non-blocking."""
+        key_lanes = np.asarray(key_lanes)
+        n = int(key_lanes.shape[0])
+        self._guard_keys(key_lanes, ins_mask)
+        self._guard_refs(ins_refs, ins_mask)
+        (lanes, rowids, refs, drefs, pv, im, dm), m = self._pad(
+            [key_lanes, np.arange(n, dtype=np.int32),
+             ins_refs.astype(np.int32), del_refs.astype(np.int32),
+             probe_vis, ins_mask, del_mask], n)
+        bucket = m // self.n_dev
+        out_cap = other.probe_capacity
+        key = (bucket, out_cap)
+        if key not in self._apply_cache:
+            self._apply_cache[key] = self._build_apply_probe(
+                bucket, out_cap)
+        step = self._apply_cache[key]
+        self.table, self.chains, mats, overflow = step(
+            self.table, self.chains, other.table, other.chains,
+            jnp.asarray(lanes), jnp.asarray(rowids), jnp.asarray(refs),
+            jnp.asarray(drefs), jnp.asarray(pv), jnp.asarray(im),
+            jnp.asarray(dm), jnp.int32(seq), self.owner_map)
+        if bool(np.asarray(overflow).any()):
+            raise RuntimeError("bucket overflow routing join chunk")
+        jaxtools.start_fetch(mats)
+        return ShardedPendingProbe(other, mats, lanes, pv, seq,
+                                   out_cap, n)
+
+    def _dispatch_probe(self, lanes: np.ndarray, vis: np.ndarray,
+                        seq: int, out_cap: int):
+        m = int(lanes.shape[0])
+        bucket = m // self.n_dev
+        key = (bucket, out_cap)
+        if key not in self._probe_only_cache:
+            self._probe_only_cache[key] = self._build_probe_only(
+                bucket, out_cap)
+        step = self._probe_only_cache[key]
+        mats, overflow = step(self.table, self.chains,
+                              jnp.asarray(lanes),
+                              jnp.arange(m, dtype=jnp.int32),
+                              jnp.asarray(vis), jnp.int32(seq),
+                              self.owner_map)
+        if bool(np.asarray(overflow).any()):
+            raise RuntimeError("bucket overflow routing probe rows")
+        jaxtools.start_fetch(mats)
+        return mats
+
+    def probe_submit(self, key_lanes, vis,
+                     seq: Optional[int] = None) -> ShardedPendingProbe:
+        n = int(np.asarray(key_lanes).shape[0])
+        s = I32_MAX if seq is None else seq
+        (lanes, pv), _m = self._pad(
+            [np.asarray(key_lanes), np.asarray(vis)], n)
+        mats = self._dispatch_probe(lanes, pv, s, self.probe_capacity)
+        return ShardedPendingProbe(self, mats, lanes, pv, s,
+                                   self.probe_capacity, n)
+
+    def probe(self, key_lanes, vis, seq: Optional[int] = None):
+        return self.probe_submit(key_lanes, vis, seq).collect()
+
+    def insert(self, key_lanes: np.ndarray, refs: np.ndarray,
+               vis: np.ndarray, seq: int = 0) -> None:
+        """Routed batch insert (recovery/rebuild; tests)."""
+        key_lanes = np.asarray(key_lanes)
+        vis = np.asarray(vis)
+        n = int(key_lanes.shape[0])
+        self._guard_keys(key_lanes, vis)
+        self._guard_refs(np.asarray(refs), vis)
+        (lanes, refs_, mask), m = self._pad(
+            [key_lanes, np.asarray(refs, np.int32), vis], n)
+        bucket = m // self.n_dev
+        if bucket not in self._insert_cache:
+            self._insert_cache[bucket] = self._build_insert(bucket)
+        step = self._insert_cache[bucket]
         self.table, self.chains, overflow = step(
-            self.table, self.chains, jnp.asarray(key_lanes),
-            jnp.asarray(refs.astype(np.int32)), jnp.asarray(vis),
+            self.table, self.chains, jnp.asarray(lanes),
+            jnp.asarray(refs_), jnp.asarray(mask), jnp.int32(seq),
             self.owner_map)
         if bool(np.asarray(overflow).any()):
             raise RuntimeError("bucket overflow inserting join rows")
 
-    def probe(self, key_lanes: np.ndarray, vis: np.ndarray
-              ) -> Tuple[np.ndarray, np.ndarray]:
-        """(probe global row ids, matched refs) across all shards.
-        Doubles the per-shard pair buffer and retries on overflow."""
-        n = key_lanes.shape[0]
-        assert n % self.n_dev == 0, (n, self.n_dev)
-        bucket = n // self.n_dev
-        row_ids = np.arange(n, dtype=np.int32)
-        while True:
-            key = (n, bucket, self.probe_capacity)
-            if key not in self._probe_cache:
-                self._probe_cache[key] = self._build_probe(
-                    n, bucket, self.probe_capacity)
-            step = self._probe_cache[key]
-            mats, overflow = step(self.table, self.chains,
-                                  jnp.asarray(key_lanes),
-                                  jnp.asarray(row_ids), jnp.asarray(vis),
-                                  self.owner_map)
-            if bool(np.asarray(overflow).any()):
-                raise RuntimeError("bucket overflow routing probe rows")
-            mats = np.asarray(mats)      # [n_dev, 1 + out_cap, 2]
-            worst = int(mats[:, 0, 0].max())
-            if worst <= self.probe_capacity:
-                break
-            while self.probe_capacity < worst:
-                self.probe_capacity *= 2
-        probes, refs = [], []
-        for d in range(self.n_dev):
-            total = int(mats[d, 0, 0])
-            pairs = mats[d, 1:1 + total]
-            probes.append(pairs[:, 0])
-            refs.append(pairs[:, 1])
-        return (np.concatenate(probes) if probes else
-                np.zeros(0, np.int32),
-                np.concatenate(refs) if refs else np.zeros(0, np.int32))
+    def delete(self, del_refs: np.ndarray, vis,
+               seq: int = 0, key_lanes=None) -> None:
+        """Tombstone by ref. Sharded routing needs the refs' KEY lanes
+        (the owner shard is a function of the key) — callers pass them
+        (the single-chip kernel ignores its optional param)."""
+        assert key_lanes is not None, \
+            "sharded delete requires key_lanes for routing"
+        vis = np.asarray(vis)
+        n = int(np.asarray(key_lanes).shape[0])
+        (lanes, drefs, dm), m = self._pad(
+            [np.asarray(key_lanes), np.asarray(del_refs, np.int32),
+             vis], n)
+        bucket = m // self.n_dev
+        if bucket not in self._delete_cache:
+            self._delete_cache[bucket] = self._build_delete(bucket)
+        step = self._delete_cache[bucket]
+        self.chains, overflow = step(
+            self.chains, jnp.asarray(lanes), jnp.asarray(drefs),
+            jnp.asarray(dm), jnp.int32(seq), self.owner_map)
+        if bool(np.asarray(overflow).any()):
+            raise RuntimeError("bucket overflow routing join deletes")
+
+    def rebase_seq(self) -> None:
+        mx = jnp.int32(I32_MAX)
+        self.chains = self.chains._replace(
+            ins_seq=jnp.where(self.chains.ins_seq == mx, mx,
+                              jnp.int32(0)),
+            del_seq=jnp.where(self.chains.del_seq == mx, mx,
+                              jnp.int32(0)))
+
+    def rebuild(self, key_lanes: np.ndarray,
+                row_refs: np.ndarray) -> None:
+        """Reload all live rows (recovery/compaction): fresh sharded
+        state + one routed batch insert at seq 0.
+
+        Per-shard key capacity is sized to hold ALL n keys (worst-case
+        skew: one shard owns every key) — a per-shard table that only
+        fits n/n_dev keys would corrupt chains under adversarial key
+        distributions, and the capacity guard compares a GLOBAL unique
+        bound against the per-shard limit anyway."""
+        n = len(row_refs)
+        while n and int(np.max(row_refs)) >= self._row_capacity:
+            self._row_capacity *= 2
+        need_keys = ht.MIN_CAPACITY if n == 0 else 1 << int(np.ceil(
+            np.log2(max(n / ht.MAX_LOAD, 1))))
+        self.key_capacity = max(self.key_capacity, need_keys,
+                                ht.MIN_CAPACITY)
+        self._fresh_state()
+        self._apply_cache.clear()
+        self._probe_only_cache.clear()
+        self._delete_cache.clear()
+        self._insert_cache.clear()
+        self._keys_upper = 0
+        if n == 0:
+            return
+        self.insert(key_lanes, row_refs, np.ones(n, dtype=bool), seq=0)

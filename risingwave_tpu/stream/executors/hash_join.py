@@ -167,11 +167,17 @@ class _Arena:
 
 
 class _JoinSide:
-    """One side's state: device matcher + host arena + durability."""
+    """One side's state: device matcher + host arena + durability.
+
+    With a mesh, the matcher is the vnode-sharded SPMD kernel
+    (parallel/join.ShardedJoinKernel) — same API, rows routed to their
+    key's owner shard by an in-program all_to_all (the reference's
+    hash dispatch to N parallel join actors, dispatch.rs:582)."""
 
     def __init__(self, schema: Schema, key_indices: Sequence[int],
                  pk_indices: Sequence[int], table: StateTable,
-                 key_codec: KeyCodec):
+                 key_codec: KeyCodec, mesh=None,
+                 shard_opts: Optional[dict] = None):
         self.schema = schema
         self.key_indices = list(key_indices)
         self.pk_indices = list(pk_indices)
@@ -180,8 +186,14 @@ class _JoinSide:
         # interned ids or varchar keys would never match
         self.key_codec = key_codec
         self.table = table
-        self.kernel = JoinSideKernel(
-            key_width=LANES_PER_KEY * len(self.key_indices))
+        if mesh is not None:
+            from risingwave_tpu.parallel.join import ShardedJoinKernel
+            self.kernel = ShardedJoinKernel(
+                mesh, key_width=LANES_PER_KEY * len(self.key_indices),
+                **(shard_opts or {}))
+        else:
+            self.kernel = JoinSideKernel(
+                key_width=LANES_PER_KEY * len(self.key_indices))
         self.arena = _Arena(schema)
         self.pk_to_ref: Dict[tuple, int] = {}
         self.free: List[int] = []
@@ -364,13 +376,22 @@ class _JoinSide:
         for pk, ref in zip(dead_pks, dead_refs.tolist()):
             del self.pk_to_ref[pk]
             self.free.append(ref)
-            self.table.delete(self.row_tuple(ref))
+        self.table.delete_rows([self.row_tuple(r)
+                                for r in dead_refs.tolist()])
         cap = next_pow2(n_dead)
         del_refs = np.zeros(cap, dtype=np.int32)
         del_refs[:n_dead] = dead_refs
         mask = np.zeros(cap, dtype=bool)
         mask[:n_dead] = True
-        self.kernel.delete(del_refs, jnp.asarray(mask), seq=seq)
+        # key lanes of the dead refs: the sharded kernel routes the
+        # tombstone to the key's owner shard (single-chip ignores them)
+        key_cols = [(self.arena.cols[i][dead_refs],
+                     self.arena.valid[i][dead_refs])
+                    for i in self.key_indices]
+        lanes_ = np.zeros((cap, LANES_PER_KEY * len(self.key_indices)),
+                          dtype=np.int32)
+        lanes_[:n_dead] = self.key_codec.build_arrays(key_cols)
+        self.kernel.delete(del_refs, mask, seq=seq, key_lanes=lanes_)
         return n_dead
 
     def recover(self) -> None:
@@ -416,7 +437,8 @@ class HashJoinExecutor(Executor):
                  left_table: StateTable, right_table: StateTable,
                  actor_id: int = 0,
                  output_names: Optional[Sequence[str]] = None,
-                 join_type: JoinType = JoinType.INNER):
+                 join_type: JoinType = JoinType.INNER,
+                 mesh=None, shard_opts: Optional[dict] = None):
         assert len(left_keys) == len(right_keys)
         self.left_in, self.right_in = left, right
         self.join_type = join_type
@@ -424,9 +446,11 @@ class HashJoinExecutor(Executor):
             [left.schema[i].data_type for i in left_keys])
         self.sides = (
             _JoinSide(left.schema, left_keys, left_table.pk_indices,
-                      left_table, key_codec),
+                      left_table, key_codec, mesh=mesh,
+                      shard_opts=shard_opts),
             _JoinSide(right.schema, right_keys, right_table.pk_indices,
-                      right_table, key_codec),
+                      right_table, key_codec, mesh=mesh,
+                      shard_opts=shard_opts),
         )
         n_left = len(left.schema)
         names = list(output_names) if output_names else None
@@ -589,11 +613,13 @@ class HashJoinExecutor(Executor):
         (ins_idx, ins_refs, full_refs, ins_mask, del_refs,
          del_mask) = me.apply_chunk_host(chunk, nonnull)
         # ins/del entries only exist at storable (= probe-visible) rows,
-        # so one mask decides both the dispatch and the collect
+        # so one mask decides both the dispatch and the collect.
+        # key_lanes stay HOST arrays end-to-end: the kernels upload
+        # them once; a jnp round-trip here would block on the tunnel.
         handle = None
         if probe_vis.any():
             handle = me.kernel.apply_and_probe(
-                other.kernel, jnp.asarray(key_lanes), probe_vis,
+                other.kernel, key_lanes, probe_vis,
                 full_refs, ins_mask, del_refs, del_mask, seq)
         self._pending.append(
             (side_idx, chunk, nonnull, handle, ins_idx, ins_refs))
@@ -750,8 +776,7 @@ class HashJoinExecutor(Executor):
             nonnull = np.ones(len(refs), dtype=bool)
             for _vals, ok in key_cols:
                 nonnull &= ok
-            deg, _pi, _refs = other.kernel.probe(
-                jnp.asarray(lanes_), jnp.asarray(nonnull))
+            deg, _pi, _refs = other.kernel.probe(lanes_, nonnull)
             side.ensure_degrees(int(refs.max()))
             side.degrees[refs] = np.where(nonnull, deg, 0)
         # NOTE: host-typed arena key cols may contain None for NULL keys
@@ -792,14 +817,14 @@ class HashJoinExecutor(Executor):
             elif tag in ("left", "right"):
                 i = 0 if tag == "left" else 1
                 if isinstance(msg, StreamChunk):
-                    # one host→device upload of the key lanes, shared by
-                    # the probe and this side's insert; the nonnull mask
-                    # falls out of the same pass
+                    # one host→device upload of the key lanes (inside
+                    # the kernel's fused dispatch), shared by the probe
+                    # and this side's insert; the nonnull mask falls
+                    # out of the same pass
                     lanes_np, nonnull = \
                         self.sides[i].key_codec.build_with_mask(
                             msg, self.sides[i].key_indices)
-                    self._ingest_chunk(i, msg, jnp.asarray(lanes_np),
-                                       nonnull)
+                    self._ingest_chunk(i, msg, lanes_np, nonnull)
                 elif isinstance(msg, Watermark):
                     wms = list(self._on_watermark(i, msg))
                     if wms:

@@ -10,12 +10,12 @@ from jax.sharding import Mesh
 
 from risingwave_tpu.ops import lanes
 from risingwave_tpu.ops.hash_join import JoinSideKernel
-from risingwave_tpu.parallel.join import ShardedJoinSide
+from risingwave_tpu.parallel.join import ShardedJoinKernel
 
 
 def test_sharded_join_matches_single_chip(eight_devices):
     mesh = Mesh(np.asarray(eight_devices), ("d",))
-    sharded = ShardedJoinSide(mesh, key_width=2, key_capacity=1 << 10,
+    sharded = ShardedJoinKernel(mesh, key_width=2, key_capacity=1 << 10,
                               row_capacity=1 << 10,
                               probe_capacity=1 << 10)
     single = JoinSideKernel(key_width=2)
@@ -37,7 +37,7 @@ def test_sharded_join_matches_single_chip(eight_devices):
         phi, plo = lanes.split_i64(pk)
         pkl = np.stack([phi, plo], axis=1)
         pvis = np.ones(64, dtype=bool)
-        gp, gr = sharded.probe(pkl, pvis)
+        _gdeg, gp, gr = sharded.probe(pkl, pvis)
         deg, sp, sr = single.probe(jnp.asarray(pkl), jnp.asarray(pvis))
 
         got = defaultdict(set)
@@ -52,7 +52,7 @@ def test_sharded_join_matches_single_chip(eight_devices):
 
 def test_sharded_join_state_is_sharded(eight_devices):
     mesh = Mesh(np.asarray(eight_devices), ("d",))
-    s = ShardedJoinSide(mesh, key_width=2, key_capacity=1 << 10)
+    s = ShardedJoinKernel(mesh, key_width=2, key_capacity=1 << 10)
     specs = {str(a.sharding.spec) for a in
              [s.table.keys, s.chains.head, s.chains.next]}
     assert all("'d'" in x for x in specs), specs
@@ -62,7 +62,7 @@ def test_sharded_join_recurring_keys_do_not_trip_guard(eight_devices):
     """Keys recurring across many batches must NOT hit the capacity
     guard: the bound collapses to true occupancy on overflow."""
     mesh = Mesh(np.asarray(eight_devices), ("d",))
-    s = ShardedJoinSide(mesh, key_width=2, key_capacity=256,
+    s = ShardedJoinKernel(mesh, key_width=2, key_capacity=256,
                         row_capacity=1 << 14)
     ref = 0
     for _ in range(40):                  # 40*64 rows, only 10 keys
@@ -72,5 +72,5 @@ def test_sharded_join_recurring_keys_do_not_trip_guard(eight_devices):
         refs = np.arange(ref, ref + 64, dtype=np.int32)
         ref += 64
         s.insert(kl, refs, np.ones(64, dtype=bool))
-    gp, _gr = s.probe(kl, np.ones(64, dtype=bool))
+    _d, gp, _gr = s.probe(kl, np.ones(64, dtype=bool))
     assert len(gp) > 0
