@@ -386,12 +386,12 @@ class StreamPlanner:
         if sel.limit is not None or (sel.offset or 0) > 0:
             # ORDER BY alone is a no-op for a pk-keyed MV (pg drops it
             # too) — only a real window needs the TopN executor.
-            # agg outputs retract (updates); plain source/join chains of
-            # append-only sources do not — let TopN prune beyond-window
-            # state in that case (top_n_appendonly analog)
+            # append-only-ness is DERIVED over the chain (agg outputs
+            # and outer joins retract; inner chains of append-only
+            # sources do not) — TopN prunes beyond-window state only
+            # when provably append-only (top_n_appendonly analog)
             ex = self._plan_topn(ex, sel, pk,
-                                 append_only=not (binder.agg_calls
-                                                  or sel.group_by))
+                                 append_only=self._derive_append_only(ex))
         return ex, pk, deps
 
     def _plan_topn(self, ex: Executor, sel: ast.Select,
@@ -417,6 +417,40 @@ class StreamPlanner:
         return GroupTopNExecutor(
             ex, order, offset=sel.offset or 0, limit=sel.limit,
             state=state, pk_indices=pk, append_only=append_only)
+
+    @staticmethod
+    def _derive_append_only(ex: Executor) -> bool:
+        """Conservative append-only derivation over the executor chain
+        (the reference's input_append_only on StreamHashAgg,
+        logical_agg.rs). Append-only ⇢ the cheap device agg path; any
+        possibility of retraction ⇢ the minput path. Unknown executors
+        default to False — silent wrongness is the only unacceptable
+        outcome (VERDICT r3 #7)."""
+        from risingwave_tpu.stream.executors.source import SourceExecutor
+        from risingwave_tpu.stream.executors.simple import (
+            FilterExecutor, ProjectExecutor,
+        )
+        from risingwave_tpu.stream.executors.row_id_gen import (
+            RowIdGenExecutor,
+        )
+        if isinstance(ex, SourceExecutor):
+            return True
+        if isinstance(ex, HashJoinExecutor):
+            # inner joins of append-only inputs emit only inserts;
+            # any outer/semi/anti kind emits padded-row flips
+            return (ex.join_type == JoinType.INNER
+                    and StreamPlanner._derive_append_only(ex.left_in)
+                    and StreamPlanner._derive_append_only(ex.right_in))
+        if isinstance(ex, (ProjectExecutor, FilterExecutor,
+                           RowIdGenExecutor)):
+            return StreamPlanner._derive_append_only(ex.input)
+        from risingwave_tpu.stream.executors.watermark_filter import (
+            WatermarkFilterExecutor,
+        )
+        if isinstance(ex, WatermarkFilterExecutor):
+            return StreamPlanner._derive_append_only(ex.input)
+        # HashAgg/TopN/Backfill/DynamicFilter/unknown: assume retracting
+        return False
 
     def _plan_agg(self, ex: Executor, scope: Scope, sel: ast.Select,
                   binder: Binder, bound) -> Tuple[Executor, List]:
@@ -450,20 +484,28 @@ class StreamPlanner:
         table = StateTable(self.catalog.next_id(), sch, agg_pk,
                            self.store,
                            dist_key_indices=list(range(len(agg_pk))))
+        # append-only-ness decides the agg mode (VERDICT r3 #7: the
+        # old hardcoded append_only=True was silently wrong over
+        # retracting upstreams, e.g. GROUP BY over an outer join)
+        append_only = self._derive_append_only(ex)
+        from risingwave_tpu.stream.executors.hash_agg import (
+            AggKind, minput_state_schema,
+        )
         kernel = None
-        if self.mesh is not None:
+        if self.mesh is not None and append_only:
             # parallel plan: the hash exchange that the reference's
             # fragmenter inserts before a parallel agg
             # (stream_fragmenter/mod.rs:199, dispatch.rs:582) is the
-            # sharded kernel's in-program all_to_all
+            # sharded kernel's in-program all_to_all. Retracting
+            # upstreams stay on the single-chip kernel: the sharded
+            # kernel's retractable MIN/MAX is not implemented yet
+            # (parallel/agg.py), and a wrong parallel answer is worse
+            # than a correct serial one.
             from risingwave_tpu.parallel.agg import ShardedAggKernel
             from risingwave_tpu.stream.executors.keys import LANES_PER_KEY
             kernel = ShardedAggKernel(
                 self.mesh, key_width=LANES_PER_KEY * g,
                 specs=[c.spec(pre.schema) for c in calls])
-        from risingwave_tpu.stream.executors.hash_agg import (
-            minput_state_schema,
-        )
         distinct_tables = {}
         for c in calls:
             if c.distinct and c.input_idx not in distinct_tables:
@@ -472,8 +514,20 @@ class StreamPlanner:
                 distinct_tables[c.input_idx] = StateTable(
                     self.catalog.next_id(), dsch, dpk, self.store,
                     dist_key_indices=ddk)
+        minput_tables = {}
+        if not append_only:
+            # materialized-input state for retractable MIN/MAX
+            # (aggregation/minput.rs analog)
+            for j, c in enumerate(calls):
+                if c.kind in (AggKind.MIN, AggKind.MAX):
+                    msch, mpk, mdk = minput_state_schema(
+                        pre.schema, list(range(g)), c)
+                    minput_tables[j] = StateTable(
+                        self.catalog.next_id(), msch, mpk, self.store,
+                        dist_key_indices=mdk)
         agg = HashAggExecutor(pre, list(range(g)), calls, table,
-                              append_only=True, kernel=kernel,
+                              append_only=append_only, kernel=kernel,
+                              minput_tables=minput_tables,
                               distinct_tables=distinct_tables)
         # post-agg projection: map each SELECT item
         out = [_map_agg_projection(b, g, agg.schema, group_reprs)

@@ -48,7 +48,7 @@ class ShardedPendingProbe:
     pair buffer re-dispatches a probe-only step at the recorded seq."""
 
     def __init__(self, kernel: "ShardedJoinKernel", mats, key_lanes,
-                 vis, seq: int, out_cap: int, n: int):
+                 vis, seq: int, out_cap: int, n: int, overflow=None):
         self.kernel = kernel
         self.mats = mats
         self.key_lanes = key_lanes      # host arrays (padded)
@@ -56,11 +56,19 @@ class ShardedPendingProbe:
         self.seq = seq
         self.out_cap = out_cap
         self.n = n                      # caller rows (pre-padding)
+        # routing-overflow flag, checked lazily at collect: a sync here
+        # would block the dispatch hot path, and the condition is
+        # impossible by construction (bucket = local row count) — this
+        # is an assertion, not a retry point
+        self.overflow = overflow
 
     def collect(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """(degrees[n], probe_idx[pairs], refs[pairs]) — pairs sorted
         by probe row so same-pk delete/insert halves stay ordered."""
         k = self.kernel
+        if self.overflow is not None and \
+                bool(np.asarray(self.overflow).any()):
+            raise RuntimeError("bucket overflow routing join chunk")
         while True:
             mats = np.asarray(jaxtools.fetch1(self.mats))
             worst = int(mats[:, 0, 0].max())
@@ -172,13 +180,6 @@ class ShardedJoinKernel:
                     f"row ref {mx} >= row_capacity "
                     f"{self._row_capacity} — raise row_capacity "
                     "(growth TBD)")
-
-    def reserve_rows(self, max_ref: int) -> None:
-        """API parity with JoinSideKernel; growth is v2 — loud check."""
-        if max_ref >= self._row_capacity:
-            raise RuntimeError(
-                f"row ref {max_ref} >= row_capacity "
-                f"{self._row_capacity} — raise row_capacity (growth TBD)")
 
     # -- SPMD step builders ----------------------------------------------
     def _specs(self):
@@ -370,11 +371,9 @@ class ShardedJoinKernel:
             jnp.asarray(lanes), jnp.asarray(rowids), jnp.asarray(refs),
             jnp.asarray(drefs), jnp.asarray(pv), jnp.asarray(im),
             jnp.asarray(dm), jnp.int32(seq), self.owner_map)
-        if bool(np.asarray(overflow).any()):
-            raise RuntimeError("bucket overflow routing join chunk")
         jaxtools.start_fetch(mats)
         return ShardedPendingProbe(other, mats, lanes, pv, seq,
-                                   out_cap, n)
+                                   out_cap, n, overflow=overflow)
 
     def _dispatch_probe(self, lanes: np.ndarray, vis: np.ndarray,
                         seq: int, out_cap: int):
@@ -385,13 +384,13 @@ class ShardedJoinKernel:
             self._probe_only_cache[key] = self._build_probe_only(
                 bucket, out_cap)
         step = self._probe_only_cache[key]
-        mats, overflow = step(self.table, self.chains,
-                              jnp.asarray(lanes),
-                              jnp.arange(m, dtype=jnp.int32),
-                              jnp.asarray(vis), jnp.int32(seq),
-                              self.owner_map)
-        if bool(np.asarray(overflow).any()):
-            raise RuntimeError("bucket overflow routing probe rows")
+        mats, _overflow = step(self.table, self.chains,
+                               jnp.asarray(lanes),
+                               jnp.arange(m, dtype=jnp.int32),
+                               jnp.asarray(vis), jnp.int32(seq),
+                               self.owner_map)
+        # overflow impossible by construction (bucket = local rows);
+        # no sync on the dispatch path
         jaxtools.start_fetch(mats)
         return mats
 

@@ -435,3 +435,102 @@ def test_outer_join_where_is_not_pushed_below_padded_side():
     # dropped — pushing below the join would have KEPT them
     assert rows
     assert all(r[1] is not None for r in rows)
+
+
+def test_group_by_over_retracting_join_oracle():
+    """GROUP BY over an OUTER join (a retraction-producing upstream)
+    must be oracle-correct — the planner derives append-only-ness
+    instead of assuming it (VERDICT r3 #7). The left-outer NULL-padding
+    flips (padded row retracted when a match arrives) exercise DELETE
+    handling plus retractable MIN/MAX via the minput path."""
+    import numpy as np
+
+    from risingwave_tpu.connectors.nexmark import gen_auctions, gen_persons, NexmarkConfig
+
+    n_events = 20000
+
+    async def run():
+        fe = Frontend(min_chunks=8)
+        await fe.execute(
+            "CREATE SOURCE person WITH (connector='nexmark', "
+            f"nexmark.table.type='person', nexmark.event.num={n_events}, "
+            "nexmark.min.event.gap.in.ns=100000000)")
+        await fe.execute(
+            "CREATE SOURCE auction WITH (connector='nexmark', "
+            f"nexmark.table.type='auction', nexmark.event.num={n_events}, "
+            "nexmark.min.event.gap.in.ns=100000000)")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW g AS SELECT p.id, count(*) AS c, "
+            "max(a.category) AS mc FROM person AS p LEFT JOIN auction "
+            "AS a ON p.id = a.seller GROUP BY p.id")
+        for _ in range(16):
+            await fe.step()
+        rows = await fe.execute("SELECT * FROM g")
+        await fe.close()
+        return rows
+
+    rows = asyncio.run(run())
+    # oracle: recompute LEFT JOIN + GROUP BY from the generators
+    cfg_p = NexmarkConfig(table_type="person", event_num=n_events,
+                          min_event_gap_in_ns=100_000_000)
+    cfg_a = NexmarkConfig(table_type="auction", event_num=n_events,
+                          min_event_gap_in_ns=100_000_000)
+    n_p, n_a = n_events // 50, n_events * 3 // 50
+    persons = gen_persons(np.arange(n_p, dtype=np.int64), cfg_p)
+    auctions = gen_auctions(np.arange(n_a, dtype=np.int64), cfg_a)
+    by_seller = {}
+    for s, cat in zip(auctions["seller"].tolist(),
+                      auctions["category"].tolist()):
+        by_seller.setdefault(s, []).append(cat)
+    want = {}
+    for pid in persons["id"].tolist():
+        cats = by_seller.get(pid)
+        if cats:
+            base = want.get(pid, (0, None))
+            want[pid] = (base[0] + len(cats),
+                         max(cats + ([base[1]] if base[1] is not None
+                                     else [])))
+        else:
+            c, m = want.get(pid, (0, None))
+            want[pid] = (c + 1, m)
+    got = {r[0]: (r[1], r[2]) for r in rows}
+    assert got == want, (len(got), len(want))
+    assert any(m is None for _c, m in want.values()), \
+        "test needs unmatched persons to exercise NULL-padding"
+    assert any(m is not None for _c, m in want.values()), \
+        "test needs matched persons to exercise padded-row retraction"
+
+
+def test_group_by_over_retracting_mv_histogram():
+    """GROUP BY over an MV whose rows UPDATE (count histogram over a
+    count MV): every upstream update retracts a real group member, so
+    a hardcoded append-only agg would overcount (VERDICT r3 #7)."""
+    from collections import Counter
+
+    async def run():
+        fe = Frontend(min_chunks=4)
+        await fe.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', "
+            "nexmark.table.type='bid', nexmark.event.num=6000, "
+            "nexmark.max.chunk.size=256)")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW m1 AS SELECT auction, count(*) "
+            "AS c FROM bid GROUP BY auction")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW m2 AS SELECT c, count(*) AS n, "
+            "min(auction) AS ma FROM m1 GROUP BY c")
+        for _ in range(30):
+            await fe.step()
+        m1 = await fe.execute("SELECT * FROM m1")
+        m2 = await fe.execute("SELECT * FROM m2")
+        await fe.close()
+        return m1, m2
+
+    m1, m2 = asyncio.run(run())
+    want_n = Counter(c for _a, c in m1)
+    want_ma = {}
+    for a, c in m1:
+        want_ma[c] = min(want_ma.get(c, a), a)
+    got = {c: (n, ma) for c, n, ma in m2}
+    assert got == {c: (n, want_ma[c]) for c, n in want_n.items()}
+    assert len(m1) > 100     # enough churn to have retracted members
