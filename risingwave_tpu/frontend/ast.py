@@ -59,6 +59,15 @@ class CastExpr(Expr):
 
 
 @dataclass
+class Over(Expr):
+    """fn(args) OVER (PARTITION BY ... ORDER BY ...)."""
+
+    call: Call
+    partition_by: List[Expr]
+    order_by: List[Tuple[Expr, bool]]    # (expr, desc)
+
+
+@dataclass
 class Explain:
     select: "Select"
 

@@ -72,6 +72,11 @@ class Binder:
         self.agg_inputs: List[Optional[object]] = []
         # bound agg call → position (dedup: COUNT(*) used twice = one)
         self._agg_index: Dict[Tuple, int] = {}
+        # window (OVER) calls: all items share ONE window spec in v1
+        # (the reference plans one OverWindow node per distinct window)
+        self.window_calls: List[object] = []      # expr.window.WindowCall
+        self.window_partition: Optional[List[int]] = None
+        self.window_order: Optional[List[Tuple[int, bool]]] = None
 
     def _register(self, call: AggCall, key: Tuple,
                   input_expr=None) -> int:
@@ -89,8 +94,81 @@ class Binder:
         return out
 
     def bind_projection(self, e: ast.Expr):
-        """Bind a projection item: Expression or ('agg', call_index)."""
+        """Bind a projection item: Expression, ('agg', call_index) or
+        ('win', call_index)."""
+        if isinstance(e, ast.Over):
+            return self._bind_over(e)
         return self._bind(e)
+
+    _WINDOW_KINDS = ("row_number", "rank", "dense_rank", "lag", "lead",
+                     "sum", "count", "min", "max", "first_value",
+                     "last_value")
+
+    def _bind_over(self, e: ast.Over):
+        from risingwave_tpu.expr.window import WindowCall, WindowFuncKind
+
+        name = e.call.name
+        if name == "avg":
+            raise BindError("avg() OVER is not supported yet — use "
+                            "sum()/count() OVER")
+        if name not in self._WINDOW_KINDS:
+            raise BindError(f"{name}() is not a window function")
+        kind = WindowFuncKind(name)
+
+        def col_idx(a: ast.Expr, what: str) -> int:
+            b = self.bind(a)
+            if not isinstance(b, InputRef):
+                raise BindError(
+                    f"window {what} must be a plain column (got "
+                    f"{a!r})")
+            return b.index
+
+        partition = [col_idx(a, "PARTITION BY") for a in e.partition_by]
+        order = [(col_idx(a, "ORDER BY"), desc)
+                 for a, desc in e.order_by]
+        if not order:
+            raise BindError("window functions need ORDER BY in OVER()")
+        if self.window_partition is None:
+            self.window_partition = partition
+            self.window_order = order
+        elif (self.window_partition != partition
+              or self.window_order != order):
+            raise BindError(
+                "all window functions in one SELECT must share the "
+                "same PARTITION BY / ORDER BY (for now)")
+        input_idx = None
+        offset = 1
+        if kind.needs_input:
+            if kind == WindowFuncKind.COUNT and (e.call.star
+                                                 or not e.call.args):
+                input_idx = None             # count(*): counts rows
+            else:
+                if not e.call.args:
+                    raise BindError(f"{name}() OVER needs an argument")
+                input_idx = col_idx(e.call.args[0], "argument")
+                if kind in (WindowFuncKind.SUM, WindowFuncKind.MIN,
+                            WindowFuncKind.MAX):
+                    dt = self.scope.schema[input_idx].data_type
+                    if not dt.is_device:
+                        raise BindError(
+                            f"{name}() OVER needs a numeric/time "
+                            f"argument (got {dt.name})")
+                if kind in (WindowFuncKind.LAG, WindowFuncKind.LEAD) \
+                        and len(e.call.args) > 1:
+                    off = e.call.args[1]
+                    try:
+                        offset = int(off.value) if (
+                            isinstance(off, ast.Lit)
+                            and off.kind == "number") else None
+                    except ValueError:
+                        offset = None
+                    if offset is None:
+                        raise BindError(
+                            f"{name}() offset must be an integer "
+                            "literal")
+        self.window_calls.append(
+            WindowCall(kind, input_idx=input_idx, offset=offset))
+        return ("win", len(self.window_calls) - 1)
 
     def _bind(self, e: ast.Expr):
         if isinstance(e, ast.Lit):

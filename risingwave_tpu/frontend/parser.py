@@ -31,7 +31,7 @@ KEYWORDS = {
     "minutes", "hour", "hours", "day", "days", "millisecond",
     "milliseconds", "case", "when", "then", "else", "end", "cast",
     "sink", "sinks", "left", "right", "full", "outer", "distinct",
-    "explain",
+    "explain", "over", "partition",
 }
 
 # keywords that can never start a primary expression (a column named
@@ -399,20 +399,51 @@ class Parser:
             if self._op("("):           # function call
                 if self._op("*"):
                     self._expect_op(")")
-                    return ast.Call(name.lower(), [], star=True)
-                distinct = self._kw("distinct")
-                args = []
-                if not self._op(")"):
-                    args.append(self._expr())
-                    while self._op(","):
+                    call = ast.Call(name.lower(), [], star=True)
+                else:
+                    distinct = self._kw("distinct")
+                    args = []
+                    if not self._op(")"):
                         args.append(self._expr())
-                    self._expect_op(")")
-                return ast.Call(name.lower(), args, distinct=distinct)
+                        while self._op(","):
+                            args.append(self._expr())
+                        self._expect_op(")")
+                    call = ast.Call(name.lower(), args,
+                                    distinct=distinct)
+                if self._kw("over"):
+                    return self._over(call)
+                return call
             if self._op("."):
                 col = self._ident()
                 return ast.ColRef(col, table=name)
             return ast.ColRef(name)
         raise ParseError(f"unexpected token {text!r}")
+
+    def _over(self, call: ast.Call) -> ast.Expr:
+        """OVER ( [PARTITION BY e, ...] [ORDER BY e [ASC|DESC], ...] )
+        — explicit frame clauses are not supported yet."""
+        self._expect_op("(")
+        partition: list = []
+        order: list = []
+        if self._kw("partition"):
+            self._expect_kw("by")
+            partition.append(self._expr())
+            while self._op(","):
+                partition.append(self._expr())
+        if self._kw("order"):
+            self._expect_kw("by")
+            while True:
+                e = self._expr()
+                desc = False
+                if self._kw("desc"):
+                    desc = True
+                else:
+                    self._kw("asc")
+                order.append((e, desc))
+                if not self._op(","):
+                    break
+        self._expect_op(")")
+        return ast.Over(call, partition, order)
 
     def _case(self) -> ast.Expr:
         whens = []

@@ -355,6 +355,11 @@ class StreamPlanner:
         bound = [binder.bind_projection(e) for e, _a in projections]
         names = [a or expr_name(e, f"col{i}")
                  for i, (e, a) in enumerate(projections)]
+        if binder.window_calls:
+            if binder.agg_calls or sel.group_by:
+                raise PlanError("window functions cannot be mixed "
+                                "with GROUP BY / aggregates (yet)")
+            ex, bound = self._plan_over_window(ex, binder, bound)
         if binder.agg_calls or sel.group_by:
             ex, out_exprs = self._plan_agg(ex, scope, sel, binder, bound)
             ex = ProjectExecutor(ex, out_exprs, names)
@@ -375,7 +380,9 @@ class StreamPlanner:
                 # hidden columns — a generated row id would turn every
                 # upstream update pair into a fresh row (duplicates)
                 pk = list(range(len(exprs), len(exprs) + len(base_pk)))
-                exprs += [InputRef(c, scope.schema[c].data_type)
+                # ex.schema, not scope.schema: the chain may have grown
+                # columns past the bind scope (row-id gen, window cols)
+                exprs += [InputRef(c, ex.schema[c].data_type)
                           for c in base_pk]
                 names += [f"_pk{j}" for j in range(len(base_pk))]
                 ex = ProjectExecutor(ex, exprs, names)
@@ -451,6 +458,39 @@ class StreamPlanner:
             return StreamPlanner._derive_append_only(ex.input)
         # HashAgg/TopN/Backfill/DynamicFilter/unknown: assume retracting
         return False
+
+    def _plan_over_window(self, ex: Executor, binder: Binder, bound):
+        """Insert an OverWindowExecutor (optimizer/plan_node/
+        stream_over_window.rs analog): output = input + one column per
+        window call; ('win', j) projection items map to those columns.
+        State pk = partition | order | input pk (general.rs:59)."""
+        from risingwave_tpu.stream.executors.over_window import (
+            OverWindowExecutor,
+        )
+        if not ex.pk_indices:
+            ex = RowIdGenExecutor(ex)
+        n_in = len(ex.schema)
+        pk = [i for i in ex.pk_indices]
+        order = list(binder.window_order)
+        partition = list(binder.window_partition)
+        # state pk = partition | order | input-pk tie-break suffix
+        # (pk columns that double as partition/order keys drop out of
+        # the suffix — rows are then unique by their order key alone);
+        # the executor's OUTPUT identity stays the FULL input pk
+        suffix = [i for i in pk if i not in partition
+                  and i not in [o for o, _ in order]]
+        state = StateTable(self.catalog.next_id(), ex.schema,
+                           partition + [i for i, _d in order] + suffix,
+                           self.store, dist_key_indices=partition)
+        win = OverWindowExecutor(ex, partition, order,
+                                 binder.window_calls, state,
+                                 input_pk=pk,
+                                 actor_id=self._actor_id)
+        out = [InputRef(n_in + b[1],
+                        win.schema[n_in + b[1]].data_type)
+               if isinstance(b, tuple) and b[0] == "win" else b
+               for b in bound]
+        return win, out
 
     def _plan_agg(self, ex: Executor, scope: Scope, sel: ast.Select,
                   binder: Binder, bound) -> Tuple[Executor, List]:

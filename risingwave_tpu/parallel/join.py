@@ -66,10 +66,10 @@ class ShardedPendingProbe:
         """(degrees[n], probe_idx[pairs], refs[pairs]) — pairs sorted
         by probe row so same-pk delete/insert halves stay ordered."""
         k = self.kernel
-        if self.overflow is not None and \
-                bool(np.asarray(self.overflow).any()):
-            raise RuntimeError("bucket overflow routing join chunk")
         while True:
+            if self.overflow is not None and \
+                    bool(np.asarray(self.overflow).any()):
+                raise RuntimeError("bucket overflow routing join rows")
             mats = np.asarray(jaxtools.fetch1(self.mats))
             worst = int(mats[:, 0, 0].max())
             if worst <= self.out_cap:
@@ -77,8 +77,8 @@ class ShardedPendingProbe:
             while k.probe_capacity < worst:
                 k.probe_capacity *= 2
             self.out_cap = k.probe_capacity
-            self.mats = k._dispatch_probe(self.key_lanes, self.vis,
-                                          self.seq, self.out_cap)
+            self.mats, self.overflow = k._dispatch_probe(
+                self.key_lanes, self.vis, self.seq, self.out_cap)
         m = mats.shape[1] - 1 - self.out_cap
         deg = np.zeros(self.n, dtype=np.int32)
         probes, refs = [], []
@@ -384,15 +384,15 @@ class ShardedJoinKernel:
             self._probe_only_cache[key] = self._build_probe_only(
                 bucket, out_cap)
         step = self._probe_only_cache[key]
-        mats, _overflow = step(self.table, self.chains,
-                               jnp.asarray(lanes),
-                               jnp.arange(m, dtype=jnp.int32),
-                               jnp.asarray(vis), jnp.int32(seq),
-                               self.owner_map)
-        # overflow impossible by construction (bucket = local rows);
-        # no sync on the dispatch path
+        mats, overflow = step(self.table, self.chains,
+                              jnp.asarray(lanes),
+                              jnp.arange(m, dtype=jnp.int32),
+                              jnp.asarray(vis), jnp.int32(seq),
+                              self.owner_map)
+        # overflow is impossible by construction (bucket = local rows)
+        # but still checked lazily at collect — never synced here
         jaxtools.start_fetch(mats)
-        return mats
+        return mats, overflow
 
     def probe_submit(self, key_lanes, vis,
                      seq: Optional[int] = None) -> ShardedPendingProbe:
@@ -400,9 +400,11 @@ class ShardedJoinKernel:
         s = I32_MAX if seq is None else seq
         (lanes, pv), _m = self._pad(
             [np.asarray(key_lanes), np.asarray(vis)], n)
-        mats = self._dispatch_probe(lanes, pv, s, self.probe_capacity)
+        mats, overflow = self._dispatch_probe(lanes, pv, s,
+                                              self.probe_capacity)
         return ShardedPendingProbe(self, mats, lanes, pv, s,
-                                   self.probe_capacity, n)
+                                   self.probe_capacity, n,
+                                   overflow=overflow)
 
     def probe(self, key_lanes, vis, seq: Optional[int] = None):
         return self.probe_submit(key_lanes, vis, seq).collect()

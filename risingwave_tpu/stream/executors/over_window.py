@@ -1,0 +1,292 @@
+"""OverWindowExecutor: window functions over a retractable stream.
+
+Reference parity: src/stream/src/executor/over_window/general.rs:59
+(OverWindowExecutor — state table pk = partition | order | input pk,
+output = input + window columns), delta application per partition
+(general.rs:295 apply_chunk, :443 build_changes_for_partition) and the
+partition cache of over_window/over_partition.rs. The EOWC variant
+(over_window/eowc.rs) is subsumed: append-only inputs simply never
+produce retraction deltas here.
+
+TPU re-design: the reference walks a delta BTreeMap row by row and
+steps one incremental WindowState per function; here each TOUCHED
+partition recomputes its window outputs as whole-column numpy passes
+(expr/window.compute_window_outputs) and emits the DIFF against the
+previous outputs. Deltas buffer per epoch and flush at the barrier —
+one recompute per touched partition per epoch, not per chunk. Output
+changes are a pure function of the partition's row set, so recovery
+needs only the input rows (the reference persists outputs too; we
+recompute on first touch).
+
+Window order: ORDER BY columns encode to memcomparable bytes (DESC
+inverts the bytes), then the input pk breaks ties — identical to the
+reference's StateKey = memcmp(order) | pk (general.rs:130).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import AsyncIterator, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from risingwave_tpu.common.chunk import Column, Op, StreamChunk, next_pow2
+from risingwave_tpu.common.types import Field, Schema
+from risingwave_tpu.expr.window import (
+    WindowCall, compute_window_outputs,
+)
+from risingwave_tpu.state.keycodec import encode_memcomparable
+from risingwave_tpu.state.state_table import StateTable
+from risingwave_tpu.stream.executor import Executor, ExecutorInfo
+from risingwave_tpu.stream.message import Message, is_barrier, is_chunk
+
+MAX_OUT_CHUNK = 4096
+PARTITION_CACHE_CAP = 256
+
+
+class _Partition:
+    """One partition's rows in window order + last emitted outputs."""
+
+    __slots__ = ("keys", "rows", "outs")
+
+    def __init__(self):
+        # sort keys: (memcmp order bytes, memcmp pk bytes) tuples
+        self.keys: List[Tuple[bytes, bytes]] = []
+        self.rows: List[tuple] = []
+        self.outs: Optional[List[Tuple[np.ndarray, np.ndarray]]] = None
+
+
+class OverWindowExecutor(Executor):
+    """Adds window-function columns to a retractable stream."""
+
+    def __init__(self, input_: Executor,
+                 partition_indices: Sequence[int],
+                 order_by: Sequence[Tuple[int, bool]],
+                 calls: Sequence[WindowCall],
+                 state: StateTable,
+                 input_pk: Optional[Sequence[int]] = None,
+                 output_names: Optional[Sequence[str]] = None,
+                 actor_id: int = 0):
+        self.input = input_
+        self.partition_indices = list(partition_indices)
+        self.order_by = [(i, bool(desc)) for i, desc in order_by]
+        self.calls = list(calls)
+        self.state = state
+        in_schema = input_.schema
+        self.n_in = len(in_schema)
+        # full input pk (the OUTPUT identity — may overlap the
+        # partition/order columns); the state pk tie-break suffix is
+        # the part that does not. Defaults to the suffix (correct when
+        # the pk is disjoint from partition/order keys — the planner
+        # always passes the full pk explicitly).
+        prefix = len(self.partition_indices) + len(self.order_by)
+        self.pk_suffix = list(state.pk_indices[prefix:])
+        self.input_pk = list(input_pk if input_pk is not None
+                             else self.pk_suffix)
+        assert state.pk_indices[:prefix] == \
+            self.partition_indices + [i for i, _ in self.order_by], \
+            "over-window state pk must be partition | order | suffix"
+        names = list(output_names) if output_names else \
+            [f"w{j}" for j in range(len(self.calls))]
+        fields = list(in_schema) + [
+            Field(names[j], c.output_type(in_schema))
+            for j, c in enumerate(self.calls)]
+        super().__init__(ExecutorInfo(
+            Schema(fields), list(self.input_pk),
+            f"OverWindowExecutor(actor={actor_id})"))
+        self.order_types = [in_schema[i].data_type
+                            for i, _ in self.order_by]
+        self.pk_types = [in_schema[i].data_type for i in self.pk_suffix]
+        # partition key tuple → _Partition (bounded LRU; a miss reloads
+        # from the state table — over_partition.rs cache analog)
+        self._cache: "OrderedDict[tuple, _Partition]" = OrderedDict()
+        # epoch delta buffer: partition key → [(sort_key, row, is_ins)]
+        self._delta: Dict[tuple, List[tuple]] = {}
+
+    # -- keys -------------------------------------------------------------
+    def _sort_key(self, row: tuple) -> Tuple[bytes, bytes]:
+        """(order bytes, pk bytes): sorts as the window order with pk
+        tie-break; the order half alone decides ORDER BY peerage."""
+        parts = []
+        for (i, desc), dt in zip(self.order_by, self.order_types):
+            b = encode_memcomparable([row[i]], [dt])
+            parts.append(bytes(255 - x for x in b) if desc else b)
+        return (b"".join(parts), encode_memcomparable(
+            [row[i] for i in self.pk_suffix], self.pk_types))
+
+    def _partition_key(self, row: tuple) -> tuple:
+        return tuple(row[i] for i in self.partition_indices)
+
+    # -- partition load / recompute --------------------------------------
+    def _load(self, pkey: tuple) -> _Partition:
+        p = self._cache.get(pkey)
+        if p is not None:
+            self._cache.move_to_end(pkey)
+            return p
+        p = _Partition()
+        pairs = []
+        for _pk, row in self.state.iter_prefix(list(pkey)):
+            pairs.append((self._sort_key(row), row))
+        pairs.sort(key=lambda t: t[0])   # DESC order differs from pk order
+        p.keys = [k for k, _ in pairs]
+        p.rows = [r for _, r in pairs]
+        self._cache[pkey] = p
+        while len(self._cache) > PARTITION_CACHE_CAP:
+            # never evict a partition with buffered deltas: its cached
+            # snapshot predates this epoch's state writes — a reload
+            # would see them in the memtable and double-apply
+            for victim in self._cache:
+                if victim not in self._delta:
+                    self._cache.pop(victim)
+                    break
+            else:
+                break
+        return p
+
+    def _compute(self, p: _Partition
+                 ) -> List[Tuple[np.ndarray, np.ndarray]]:
+        n = len(p.rows)
+        eq_prev = np.zeros(n, dtype=bool)
+        if n > 1:
+            eq_prev[1:] = [p.keys[j][0] == p.keys[j - 1][0]
+                           for j in range(1, n)]
+        inputs = []
+        for c in self.calls:
+            if c.input_idx is None:      # rank family + count(*)
+                inputs.append(None)
+                continue
+            dt = self.input.schema[c.input_idx].data_type
+            col = [r[c.input_idx] for r in p.rows]
+            ok = np.asarray([v is not None for v in col])
+            if dt.is_device:
+                vals = np.asarray(
+                    [0 if v is None else v for v in col],
+                    dtype=dt.np_dtype)
+            else:
+                vals = np.asarray(col, dtype=object)
+            inputs.append((vals, ok))
+        return compute_window_outputs(self.calls, n, eq_prev, inputs)
+
+    # -- delta application ------------------------------------------------
+    def _buffer_chunk(self, chunk: StreamChunk) -> None:
+        for op, row in chunk.to_records():
+            pkey = self._partition_key(row)
+            if pkey not in self._delta:
+                # snapshot the partition BEFORE this epoch's state
+                # writes land in the memtable (the delta will be
+                # applied on top at flush — loading later would see
+                # the rows twice)
+                self._load(pkey)
+                self._delta[pkey] = []
+            self._delta[pkey].append(
+                (self._sort_key(row), row, op.is_insert))
+        self.state.write_chunk(chunk)
+
+    def _flush(self) -> List[StreamChunk]:
+        """Apply buffered deltas partition by partition; emit the diff
+        of window outputs (general.rs build_changes_for_partition).
+
+        All retractions emit BEFORE all insertions, across partitions:
+        a row whose PARTITION KEY changed within the epoch appears as
+        a delete in its old partition's diff and an insert in the
+        new one's — a pk-keyed downstream must see D before I or the
+        row nets to deleted. Update pairs split into plain D/I halves
+        under this ordering (the reference degrades split pairs the
+        same way)."""
+        dels: List[Tuple[int, tuple]] = []
+        inss: List[Tuple[int, tuple]] = []
+        for pkey, deltas in self._delta.items():
+            p = self._load(pkey)
+            old_rows = p.rows
+            old_outs = p.outs if p.outs is not None else \
+                (self._compute(p) if old_rows else [])
+            # apply deltas to the sorted row list
+            import bisect
+            keys, rows = list(p.keys), list(p.rows)
+            for sk, row, is_ins in deltas:
+                at = bisect.bisect_left(keys, sk)
+                if is_ins:
+                    keys.insert(at, sk)
+                    rows.insert(at, row)
+                elif at < len(keys) and keys[at] == sk:
+                    keys.pop(at)
+                    rows.pop(at)
+                # else: delete of unseen row (inconsistent op) — skip
+            p.keys, p.rows = keys, rows
+            new_outs = self._compute(p)
+            p.outs = new_outs
+            # diff: old (row, outs) vs new (row, outs) as multisets
+            # keyed by input pk — emit D/I for rows added/removed and
+            # U-/U+ for rows whose window outputs changed
+            old_map = {}
+            for j, r in enumerate(old_rows):
+                o = tuple(
+                    (None if not old_outs[c][1][j]
+                     else _pyval(old_outs[c][0][j]))
+                    for c in range(len(self.calls)))
+                old_map[tuple(r[i] for i in self.input_pk)] = (r, o)
+            for j, r in enumerate(p.rows):
+                o = tuple(
+                    (None if not new_outs[c][1][j]
+                     else _pyval(new_outs[c][0][j]))
+                    for c in range(len(self.calls)))
+                k = tuple(r[i] for i in self.input_pk)
+                old = old_map.pop(k, None)
+                if old is None:
+                    inss.append((int(Op.INSERT), r + o))
+                elif old[1] != o or old[0] != r:
+                    dels.append((int(Op.DELETE), old[0] + old[1]))
+                    inss.append((int(Op.INSERT), r + o))
+            for r, o in old_map.values():
+                dels.append((int(Op.DELETE), r + o))
+        self._delta.clear()
+        return self._build_chunks(dels + inss)
+
+    def _build_chunks(self, records) -> List[StreamChunk]:
+        out = []
+        for at in range(0, len(records), MAX_OUT_CHUNK):
+            batch = records[at:at + MAX_OUT_CHUNK]
+            t = len(batch)
+            cap = next_pow2(t)
+            cols = []
+            for i, f in enumerate(self.schema):
+                dt = f.data_type
+                vals = [r[i] for _op, r in batch]
+                ok = np.ones(cap, dtype=bool)
+                ok[:t] = [v is not None for v in vals]
+                if dt.is_device:
+                    arr = np.zeros(cap, dtype=dt.np_dtype)
+                    arr[:t] = [0 if v is None else v for v in vals]
+                else:
+                    arr = np.empty(cap, dtype=object)
+                    arr[:t] = vals
+                cols.append(Column(dt, arr, None if ok.all() else ok))
+            ops = np.full(cap, int(Op.INSERT), dtype=np.int8)
+            ops[:t] = [op for op, _r in batch]
+            vis = np.zeros(cap, dtype=bool)
+            vis[:t] = True
+            out.append(StreamChunk(self.schema, cols, vis, ops))
+        return out
+
+    # -- main loop --------------------------------------------------------
+    async def execute(self) -> AsyncIterator[Message]:
+        it = self.input.execute()
+        first = await it.__anext__()
+        assert is_barrier(first), f"expected init barrier, got {first!r}"
+        self.state.init_epoch(first.epoch)
+        yield first
+        async for msg in it:
+            if is_chunk(msg):
+                self._buffer_chunk(msg)
+            elif is_barrier(msg):
+                for out in self._flush():
+                    yield out
+                self.state.commit(msg.epoch)
+                yield msg
+            # watermarks are dropped: windows over ordered history have
+            # no per-column monotonicity to forward (reference behavior
+            # for over-window is also conservative)
+
+
+def _pyval(x):
+    return x.item() if hasattr(x, "item") else x
