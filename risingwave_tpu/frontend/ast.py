@@ -117,6 +117,8 @@ class Select:
 class CreateSource:
     name: str
     options: Dict[str, str]            # WITH (connector='nexmark', ...)
+    # explicit (col_name, sql_type) list for external connectors
+    columns: Optional[List[Tuple[str, str]]] = None
 
 
 @dataclass

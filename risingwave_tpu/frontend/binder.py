@@ -113,6 +113,9 @@ class Binder:
                             "sum()/count() OVER")
         if name not in self._WINDOW_KINDS:
             raise BindError(f"{name}() is not a window function")
+        if e.call.distinct:
+            raise BindError(
+                f"{name}(DISTINCT ...) OVER is not supported")
         kind = WindowFuncKind(name)
 
         def col_idx(a: ast.Expr, what: str) -> int:
@@ -153,6 +156,15 @@ class Binder:
                         raise BindError(
                             f"{name}() OVER needs a numeric/time "
                             f"argument (got {dt.name})")
+                if kind in (WindowFuncKind.LAG, WindowFuncKind.LEAD) \
+                        and len(e.call.args) > 2:
+                    raise BindError(
+                        f"{name}() default-value argument is not "
+                        "supported yet")
+                if len(e.call.args) > 1 and kind not in (
+                        WindowFuncKind.LAG, WindowFuncKind.LEAD):
+                    raise BindError(
+                        f"{name}() OVER takes one argument")
                 if kind in (WindowFuncKind.LAG, WindowFuncKind.LEAD) \
                         and len(e.call.args) > 1:
                     off = e.call.args[1]

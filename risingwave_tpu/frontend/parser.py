@@ -192,6 +192,23 @@ class Parser:
 
     def _create_source(self) -> ast.CreateSource:
         name = self._ident()
+        columns = None
+        if self._op("("):
+            # explicit schema: (col type, ...) — external connectors
+            # cannot infer one (the generators carry fixed schemas)
+            columns = []
+            while True:
+                col = self._ident()
+                words = [self._next()[1].lower()]
+                while self._peek()[0] in ("ident", "kw") and \
+                        self._peek()[1].lower() in (
+                            "with", "time", "zone", "precision",
+                            "varying"):
+                    words.append(self._next()[1].lower())
+                columns.append((col, " ".join(words)))
+                if not self._op(","):
+                    break
+            self._expect_op(")")
         self._expect_kw("with")
         self._expect_op("(")
         options = {}
@@ -210,7 +227,7 @@ class Parser:
             if not self._op(","):
                 break
         self._expect_op(")")
-        return ast.CreateSource(name, options)
+        return ast.CreateSource(name, options, columns=columns)
 
     # -- SELECT ----------------------------------------------------------
     def _select(self) -> ast.Select:

@@ -111,6 +111,28 @@ def _source_reader(src: SourceCatalog):
             DatagenConfig, DatagenSplitReader,
         )
         return DatagenSplitReader(DatagenConfig.from_options(opts))
+    if connector == "filelog":
+        from risingwave_tpu.connectors.filelog import (
+            FileLogEnumerator, FileLogSplitReader,
+        )
+        path = opts.get("path")
+        topic = opts.get("topic", src.name)
+        if not path:
+            raise PlanError("filelog source needs path='...'")
+        splits = FileLogEnumerator(path, topic).list_splits()
+        # v0 single-pipeline sources: one reader drives partition 0
+        # (multi-split assignment lands with the fragmenter)
+        part = int(opts.get("partition", 0))
+        if splits and not any(
+                int(s.split_id.rsplit("-", 1)[1]) == part
+                for s in splits):
+            raise PlanError(
+                f"filelog partition {part} not found in {path!r}")
+        return FileLogSplitReader(
+            path, topic, part, src.schema,
+            fmt=opts.get("format", "json"),
+            max_chunk_size=int(opts.get("max.chunk.size", 1024)),
+            options=opts)
     if connector == "tpch":
         from risingwave_tpu.connectors.tpch import (
             TpchConfig, TpchSplitReader,
@@ -124,8 +146,17 @@ def _source_reader(src: SourceCatalog):
     raise PlanError(f"unknown connector {connector!r}")
 
 
-def source_schema(options: Dict[str, str]) -> Schema:
+def source_schema(options: Dict[str, str],
+                  columns=None) -> Schema:
     connector = options.get("connector", "").lower()
+    if columns is not None:
+        fields = []
+        for name, type_name in columns:
+            try:
+                fields.append(Field(name, DataType.from_sql(type_name)))
+            except KeyError:
+                raise PlanError(f"unknown type {type_name!r}")
+        return Schema(fields)
     if connector == "nexmark":
         from risingwave_tpu.connectors.nexmark import TABLE_SCHEMAS
         return TABLE_SCHEMAS[options.get("nexmark.table.type", "bid")]
@@ -135,6 +166,10 @@ def source_schema(options: Dict[str, str]) -> Schema:
     if connector == "tpch":
         from risingwave_tpu.connectors.tpch import TABLE_SCHEMAS
         return TABLE_SCHEMAS[options.get("tpch.table", "lineitem")]
+    if connector == "filelog":
+        raise PlanError(
+            "filelog sources need an explicit column list: "
+            "CREATE SOURCE t (a INT, ...) WITH (...)")
     raise PlanError(f"unknown connector {connector!r}")
 
 

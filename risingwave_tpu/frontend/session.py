@@ -177,7 +177,7 @@ class Frontend:
     async def _run(self, stmt) -> Union[Rows, str]:
         self.last_select_schema = None
         if isinstance(stmt, ast.CreateSource):
-            schema = source_schema(stmt.options)
+            schema = source_schema(stmt.options, stmt.columns)
             self.catalog.add_source(stmt.name, schema, stmt.options)
             return "CREATE_SOURCE"
         if isinstance(stmt, ast.CreateMaterializedView):

@@ -106,10 +106,15 @@ class OverWindowExecutor(Executor):
     # -- keys -------------------------------------------------------------
     def _sort_key(self, row: tuple) -> Tuple[bytes, bytes]:
         """(order bytes, pk bytes): sorts as the window order with pk
-        tie-break; the order half alone decides ORDER BY peerage."""
+        tie-break; the order half alone decides ORDER BY peerage.
+
+        NULL order values encode with tag 0x02 (> the 0x01 non-null
+        tag) so ASC sorts NULLS LAST; DESC inverts the bytes, putting
+        NULLS FIRST — both are PostgreSQL's defaults."""
         parts = []
         for (i, desc), dt in zip(self.order_by, self.order_types):
-            b = encode_memcomparable([row[i]], [dt])
+            b = b"\x02" if row[i] is None else \
+                encode_memcomparable([row[i]], [dt])
             parts.append(bytes(255 - x for x in b) if desc else b)
         return (b"".join(parts), encode_memcomparable(
             [row[i] for i in self.pk_suffix], self.pk_types))
@@ -132,11 +137,12 @@ class OverWindowExecutor(Executor):
         p.rows = [r for _, r in pairs]
         self._cache[pkey] = p
         while len(self._cache) > PARTITION_CACHE_CAP:
-            # never evict a partition with buffered deltas: its cached
-            # snapshot predates this epoch's state writes — a reload
-            # would see them in the memtable and double-apply
+            # never evict a partition with buffered deltas — or the one
+            # just loaded (its delta registers right after this call):
+            # a cached snapshot predates this epoch's state writes, so
+            # a reload would see them in the memtable and double-apply
             for victim in self._cache:
-                if victim not in self._delta:
+                if victim not in self._delta and victim != pkey:
                     self._cache.pop(victim)
                     break
             else:

@@ -115,6 +115,7 @@ class SourceExecutor(Executor):
             return
 
         exhausted = False
+        idle = False
         chunks_this_epoch = 0
         while True:
             # barrier wins the select — except for the FIRST chunk of an
@@ -124,7 +125,7 @@ class SourceExecutor(Executor):
             # driving pattern) can starve the stream forever: every
             # try_recv finds the next barrier already waiting.
             barrier: Optional[Barrier] = None
-            can_generate = not (self.paused or exhausted or (
+            can_generate = not (self.paused or exhausted or idle or (
                 self.rate_limit is not None
                 and chunks_this_epoch >= self.rate_limit))
             if not can_generate:
@@ -143,13 +144,19 @@ class SourceExecutor(Executor):
                 assert is_barrier(barrier)
                 self._handle_barrier(barrier)
                 chunks_this_epoch = 0
+                idle = False            # log sources re-poll per epoch
                 yield barrier
                 if barrier.is_stop(self.actor_id):
                     return
                 continue
             chunk = self.reader.next_chunk()
             if chunk is None:
-                exhausted = True
+                if getattr(self.reader, "unbounded", False):
+                    # log-style source with no complete records yet:
+                    # park on the barrier channel (not a busy-poll)
+                    idle = True
+                else:
+                    exhausted = True
                 continue
             chunks_this_epoch += 1
             _METRICS.source_rows.inc(chunk.cardinality(),

@@ -1,0 +1,145 @@
+"""External ingestion: filelog connector + parser framework.
+
+Reference parity targets: SplitEnumerator/SplitReader contract
+(src/connector/src/source/base.rs:86,282), JSON/CSV parsers
+(src/connector/src/parser/), Kafka-style offset recovery
+(src/connector/src/source/kafka/). The system ingests bytes it did NOT
+generate: records are appended to partition files by the test acting
+as an external producer, and kill/restart resumes exactly-once.
+"""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.common.types import DataType, Schema
+from risingwave_tpu.connectors.filelog import (
+    FileLogEnumerator, FileLogSplitReader, partition_path,
+)
+from risingwave_tpu.connectors.parser import (
+    CsvRowParser, JsonRowParser,
+)
+
+S = Schema.of(k=DataType.INT64, name=DataType.VARCHAR,
+              price=DataType.FLOAT64, ts=DataType.TIMESTAMP)
+
+
+def _produce(path, topic, part, records):
+    os.makedirs(path, exist_ok=True)
+    with open(partition_path(path, topic, part), "ab") as f:
+        for r in records:
+            f.write(json.dumps(r).encode() + b"\n")
+
+
+def test_json_parser_types_and_errors():
+    p = JsonRowParser(S)
+    rows = p.parse_batch([
+        b'{"k": 1, "name": "a", "price": 1.5, '
+        b'"ts": "2026-01-02T03:04:05"}',
+        b'{"k": 2, "name": null, "price": null}',   # missing ts → NULL
+        b'not json',
+        b'{"k": "3", "name": 7, "price": "2.5", "ts": 1700000000}',
+    ])
+    assert p.errors == 1
+    assert rows[0][0] == 1 and rows[0][1] == "a"
+    assert rows[0][3] == 1767323045000000
+    assert rows[1] == (2, None, None, None)
+    assert rows[2] == (3, "7", 2.5, 1700000000000000)
+
+
+def test_csv_parser():
+    p = CsvRowParser(Schema.of(a=DataType.INT64, b=DataType.VARCHAR))
+    rows = p.parse_batch([b"1,x", b"2,", b"junk"])
+    assert rows == [(1, "x"), (2, None)]
+    assert p.errors == 1
+
+
+def test_enumerator_and_reader_tailing(tmp_path):
+    path = str(tmp_path)
+    _produce(path, "t", 0, [{"k": i, "name": f"n{i}", "price": i * 1.0,
+                             "ts": 1000 + i} for i in range(5)])
+    _produce(path, "t", 1, [{"k": 100}])
+    splits = FileLogEnumerator(path, "t").list_splits()
+    assert [s.split_id for s in splits] == ["filelog-t-0",
+                                            "filelog-t-1"]
+    r = FileLogSplitReader(path, "t", 0, S, max_chunk_size=3)
+    c1 = r.next_chunk()
+    assert c1.cardinality() == 3
+    c2 = r.next_chunk()
+    assert c2.cardinality() == 2
+    assert r.next_chunk() is None            # idle, not exhausted
+    # torn trailing write stays unconsumed until completed
+    with open(partition_path(path, "t", 0), "ab") as f:
+        f.write(b'{"k": 7')
+    assert r.next_chunk() is None
+    with open(partition_path(path, "t", 0), "ab") as f:
+        f.write(b', "name": "late"}\n')
+    c3 = r.next_chunk()
+    assert c3.cardinality() == 1
+    rec = c3.to_records()
+    assert rec[0][1][0] == 7 and rec[0][1][1] == "late"
+    # byte-offset recovery: a fresh reader seeks and re-reads exactly
+    r2 = FileLogSplitReader(path, "t", 0, S)
+    r2.seek(r.offset)
+    assert r2.next_chunk() is None
+
+
+def test_sql_filelog_ingestion_and_exactly_once_recovery(tmp_path):
+    """CREATE SOURCE ... WITH (connector='filelog') ingests external
+    bytes; SIGKILL-style restart (fresh Frontend over the same store)
+    resumes from the committed offset exactly-once."""
+    from risingwave_tpu.frontend.session import Frontend
+    from risingwave_tpu.storage.hummock import HummockLite
+    from risingwave_tpu.storage.object_store import MemObjectStore
+
+    path = str(tmp_path)
+    obj = MemObjectStore()
+    _produce(path, "trades", 0,
+             [{"k": i, "name": f"sym{i % 3}", "price": float(i),
+               "ts": i} for i in range(40)])
+
+    ddl = (f"CREATE SOURCE trades (k BIGINT, name VARCHAR, "
+           f"price DOUBLE PRECISION, ts TIMESTAMP) "
+           f"WITH (connector='filelog', path='{path}', "
+           f"topic='trades', format='json', max.chunk.size=16)")
+
+    async def phase1():
+        fe = Frontend(store=HummockLite(obj), rate_limit=2)
+        await fe.execute(ddl)
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW v AS SELECT name, count(*) AS c, "
+            "sum(k) AS s FROM trades GROUP BY name")
+        for _ in range(4):
+            await fe.step()
+        rows = await fe.execute("SELECT * FROM v")
+        await fe.close()
+        return rows
+
+    rows1 = asyncio.run(phase1())
+    assert sum(r[1] for r in rows1) > 0      # ingested something
+
+    # external producer appends MORE while the session is down
+    _produce(path, "trades", 0,
+             [{"k": i, "name": f"sym{i % 3}", "price": float(i),
+               "ts": i} for i in range(40, 60)])
+
+    async def phase2():
+        fe = Frontend(store=HummockLite(obj), rate_limit=2)
+        await fe.recover()
+        for _ in range(20):
+            await fe.step()
+        rows = await fe.execute("SELECT * FROM v")
+        await fe.close()
+        return rows
+
+    rows2 = asyncio.run(phase2())
+    got = {name: (c, s) for name, c, s in rows2}
+    want = {}
+    for i in range(60):
+        name = f"sym{i % 3}"
+        c, s = want.get(name, (0, 0))
+        want[name] = (c + 1, s + i)
+    assert got == want, (got, want)   # no loss, no duplication

@@ -332,3 +332,28 @@ def test_over_window_partition_move_delete_before_insert():
     got = materialize(msgs)     # materialize() asserts D-before-I
     want = oracle([(2, 10, 100, 1), (2, 10, 200, 2)])
     assert got == want
+
+
+def test_over_window_null_order_keys_pg_defaults():
+    """pg defaults: ASC = NULLS LAST, DESC = NULLS FIRST."""
+    def run(desc):
+        store = MemoryStateStore()
+        st = StateTable(33, S, [0, 1, 3], store, dist_key_indices=[0])
+        ex = OverWindowExecutor(
+            MockSource(S, [barrier(1),
+                           chunk([1, 1, 1], [10, None, 20], [1, 2, 3],
+                                 [1, 2, 3]),
+                           barrier(2)]),
+            [0], [(1, desc)], [WindowCall(WindowFuncKind.ROW_NUMBER)],
+            st)
+        msgs = asyncio.run(collect_until_n_barriers(ex, 2))
+        got = {}
+        for m in msgs:
+            if is_chunk(m):
+                for op, r in m.to_records():
+                    if op.is_insert:
+                        got[r[3]] = r[4]
+        return got
+
+    assert run(False) == {1: 1, 3: 2, 2: 3}   # ASC: NULL last
+    assert run(True) == {2: 1, 3: 2, 1: 3}    # DESC: NULL first
