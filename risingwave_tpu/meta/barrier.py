@@ -185,6 +185,10 @@ class BarrierLoop:
             STREAMING.barrier_latency.observe(lat)
         if barrier.is_checkpoint:
             STREAMING.checkpoint_count.inc()
+            # host-memory accounting/eviction sweep piggybacks on the
+            # checkpoint (memory_manager.rs watermark-loop analog)
+            from risingwave_tpu.utils.memory import GLOBAL as _MEM
+            _MEM.tick()
         self.stats.completed_epochs.append(epoch)
         return barrier
 

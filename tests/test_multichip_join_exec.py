@@ -228,3 +228,38 @@ def test_sql_join_runs_sharded(eight_devices):
     want = asyncio.run(run(1))
     assert got == want
     assert len(got) > 0
+
+
+def test_sharded_join_grows_past_initial_capacity(eight_devices):
+    """Join state 10x the initial sharded key capacity: barrier-time
+    compact-with-growth replaces the fatal guard (VERDICT r3 #5)."""
+    mesh = Mesh(np.asarray(eight_devices), ("d",))
+    store = MemoryStateStore()
+    lt = StateTable(61, L_SCHEMA, [1], store, dist_key_indices=[])
+    rt = StateTable(62, R_SCHEMA, [1], store, dist_key_indices=[])
+    script_l, script_r = [barrier(1)], [barrier(1)]
+    oracle = JoinOracle()
+    b = 2
+    n_keys = 1280                    # 10x key_capacity=128
+    for r in range(10):
+        ks = list(range(r * 128, (r + 1) * 128))
+        vs = ks
+        oracle.left += list(zip(ks, vs))
+        script_l.append(lchunk(ks, vs))
+        script_l.append(barrier(b))
+        # right side joins a few of this round's keys
+        rk = ks[:4]
+        rv = [f"r{x}" for x in rk]
+        oracle.right += list(zip(rk, rv))
+        script_r.append(rchunk(rk, rv))
+        script_r.append(barrier(b))
+        b += 1
+    ex = HashJoinExecutor(
+        MockSource(L_SCHEMA, script_l), MockSource(R_SCHEMA, script_r),
+        left_keys=[0], right_keys=[0], left_table=lt, right_table=rt,
+        mesh=mesh,
+        shard_opts=dict(key_capacity=128, row_capacity=1 << 12,
+                        probe_capacity=256))
+    msgs = asyncio.run(collect_until_n_barriers(ex, b - 1))
+    assert ex.sides[0].kernel.key_capacity > 128      # grew
+    assert materialize_join(msgs) == oracle.view()

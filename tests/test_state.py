@@ -303,3 +303,39 @@ def test_negative_zero_and_null_distkey_key_consistency():
     assert t2.get_row((2.5,)) == (2.5, 8)
     t2.delete((None, 7))
     assert t2.get_row((None,)) is None
+
+
+def test_interner_gc_bounds_entries_to_live_state():
+    """Interner entries retire with their last referencing value; ids
+    stay stable for survivors and retired ids are reused only after GC
+    proves them dead (VERDICT r3 weak #6)."""
+    from risingwave_tpu.stream.executors.keys import Interner
+
+    it = Interner()
+    ids = {v: it.intern_one(v) for v in ("a", "b", "c", "d")}
+    assert it.gc(["b", "d"]) == 2
+    assert len(it) == 2
+    # survivors keep their ids
+    assert it.intern_one("b") == ids["b"]
+    assert it.intern_one("d") == ids["d"]
+    # dead ids are reused for NEW values
+    new_id = it.intern_one("e")
+    assert new_id in (ids["a"], ids["c"])
+    # lookup of a retired id (defensive decode) yields None
+    import numpy as np
+    dead = [i for i in (ids["a"], ids["c"]) if i != new_id][0]
+    assert it.lookup(np.asarray([dead]))[0] is None
+
+
+def test_memory_context_accounting_and_eviction():
+    from risingwave_tpu.utils.memory import MemoryContext
+
+    m = MemoryContext(soft_limit_bytes=100)
+    state = {"big": 200, "small": 10}
+    m.register("big", lambda: state["big"],
+               evict=lambda: state.__setitem__("big", 40) or 160)
+    m.register("small", lambda: state["small"])
+    assert m.total_bytes() == 210
+    total = m.tick()
+    assert state["big"] == 40          # evictor ran
+    assert total <= 100
