@@ -153,6 +153,14 @@ class DropSource:
 
 
 @dataclass
+class AlterParallelism:
+    """ALTER MATERIALIZED VIEW name SET PARALLELISM = n."""
+
+    name: str
+    parallelism: int
+
+
+@dataclass
 class Show:
     what: str                          # "tables" | "materialized views" | "sources"
 

@@ -30,6 +30,10 @@ class MvCatalog:
     definition: str
     actor_id: int = 0
     dependent_sources: List[str] = field(default_factory=list)
+    # catalog id-counter value when this MV was planned: a reschedule
+    # replans the same definition from the same base so every state
+    # table gets its ORIGINAL id back (state survives the replan)
+    id_base: int = -1
 
 
 @dataclass

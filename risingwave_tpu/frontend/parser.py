@@ -31,7 +31,7 @@ KEYWORDS = {
     "minutes", "hour", "hours", "day", "days", "millisecond",
     "milliseconds", "case", "when", "then", "else", "end", "cast",
     "sink", "sinks", "left", "right", "full", "outer", "distinct",
-    "explain", "over", "partition",
+    "explain", "over", "partition", "alter", "set", "parallelism",
 }
 
 # keywords that can never start a primary expression (a column named
@@ -140,6 +140,17 @@ class Parser:
         return stmt
 
     def _statement(self):
+        if self._kw("alter", "materialized", "view"):
+            name = self._ident()
+            self._expect_kw("set")
+            self._expect_kw("parallelism")
+            self._expect_op("=")
+            kind, text = self._next()
+            if kind != "number" or int(text) < 1:
+                raise ParseError(
+                    f"PARALLELISM must be a positive integer, "
+                    f"got {text!r}")
+            return ast.AlterParallelism(name, int(text))
         if self._kw("create", "source"):
             return self._create_source()
         if self._kw("create", "materialized", "view"):

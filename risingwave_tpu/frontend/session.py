@@ -47,17 +47,7 @@ class Frontend:
         # parallelism > 1: GROUP BY plans run on the vnode-sharded SPMD
         # kernel over a device mesh (the fragmenter's hash-exchange
         # parallelism, §2.12, as one all_to_all program)
-        self.mesh = None
-        if parallelism > 1:
-            import jax
-            from jax.sharding import Mesh
-
-            import numpy as _np
-            devs = jax.devices()
-            if len(devs) < parallelism:
-                raise ValueError(
-                    f"parallelism {parallelism} > {len(devs)} devices")
-            self.mesh = Mesh(_np.asarray(devs[:parallelism]), ("d",))
+        self.mesh = self._mesh_for(parallelism)
         self.catalog = Catalog()
         self.local = LocalBarrierManager()
         self.loop = BarrierLoop(self.local, self.store)
@@ -68,6 +58,9 @@ class Frontend:
         self.min_chunks = min_chunks
         self._next_actor = 1000
         self.chain_edges: Dict[str, list] = {}   # job → [(uid, Output)]
+        # name → CREATE MV select AST (reschedule replans from this —
+        # the DDL log may hold stale same-name CREATEs after drops)
+        self._mv_selects: Dict[str, object] = {}
         self._ddl_log: List[str] = []
         self._replaying = False
         # serializes barrier rounds between DDL handlers, step() and the
@@ -119,7 +112,9 @@ class Frontend:
                                  ast.CreateMaterializedView,
                                  ast.CreateSink, ast.DropSink,
                                  ast.DropMaterializedView,
-                                 ast.DropSource)) and not self._replaying:
+                                 ast.DropSource,
+                                 ast.AlterParallelism)) and \
+                    not self._replaying:
                 self._ddl_log.append(text)
                 self._persist_ddl()
         return result
@@ -182,6 +177,8 @@ class Frontend:
             return "CREATE_SOURCE"
         if isinstance(stmt, ast.CreateMaterializedView):
             return await self._create_mv(stmt)
+        if isinstance(stmt, ast.AlterParallelism):
+            return await self._alter_parallelism(stmt)
         if isinstance(stmt, ast.Explain):
             return self._explain(stmt.select)
         if isinstance(stmt, ast.CreateSink):
@@ -269,6 +266,21 @@ class Frontend:
                             min_chunks=self.min_chunks)
         return [(line,) for line in explain_tree(plan.consumer)]
 
+    @staticmethod
+    def _mesh_for(parallelism: int):
+        """n-device mesh for a parallel plan (None = single-chip)."""
+        if parallelism <= 1:
+            return None
+        import jax
+        from jax.sharding import Mesh
+
+        import numpy as _np
+        devs = jax.devices()
+        if len(devs) < parallelism:
+            raise ValueError(
+                f"parallelism {parallelism} > {len(devs)} devices")
+        return Mesh(_np.asarray(devs[:parallelism]), ("d",))
+
     async def _create_mv(self, stmt: ast.CreateMaterializedView) -> str:
         self.catalog._check_free(stmt.name)    # validate BEFORE planning
         async with self._barrier_lock:
@@ -277,6 +289,7 @@ class Frontend:
                                     actors=self.actors)
             actor_id = self._next_actor
             self._next_actor += 1
+            id_base = self.catalog._next_id
             try:
                 plan = planner.plan(stmt.name, stmt.select, actor_id,
                                     rate_limit=self.rate_limit,
@@ -288,13 +301,93 @@ class Frontend:
                 for sid in planner.registered_senders:
                     self.local.drop_actor(sid)
                 raise
+            plan.mv.id_base = id_base
             await self._deploy_job(
                 stmt.name, actor_id, plan.consumer, plan.readers,
                 lambda: self.catalog.add_mv(plan.mv),
                 attaches=plan.attaches)
+        self._mv_selects[stmt.name] = stmt.select
         if self._deployed_actor.failure is not None:
             raise self._deployed_actor.failure
         return "CREATE_MATERIALIZED_VIEW"
+
+    async def _alter_parallelism(self, stmt: ast.AlterParallelism) -> str:
+        """Runtime reschedule (meta/stream/scale.rs:717
+        reschedule_actors analog, collapsed to the TPU design): pause
+        the job at a stop barrier, replan the SAME definition over an
+        n-device mesh FROM THE SAME TABLE-ID BASE (state tables keep
+        their ids, so the redeployed executors recover every group/row
+        through the normal recovery path), then resume. The sharded
+        kernels' vnode routing makes the moved state land on its new
+        owner shard automatically at rebuild."""
+        name, n = stmt.name, stmt.parallelism
+        mv = self.catalog.mvs.get(name)
+        if mv is None:
+            raise PlanError(f"unknown materialized view {name!r}")
+        deps_on_me = [m.name for m in self.catalog.mvs.values()
+                      if name in m.dependent_sources] + \
+                     [s.name for s in self.catalog.sinks.values()
+                      if name in s.dependent_sources]
+        if deps_on_me or any(d in self.catalog.mvs
+                             for d in mv.dependent_sources):
+            raise PlanError(
+                "ALTER ... SET PARALLELISM on chained MVs is not "
+                "supported yet")
+        if mv.id_base < 0:
+            raise PlanError(f"{name!r} predates reschedule support")
+        sel = self._mv_selects.get(name)
+        if sel is None:
+            raise PlanError(f"no CREATE statement on record for "
+                            f"{name!r}")
+        mesh = self._mesh_for(n)
+        async with self._barrier_lock:
+            # 1) stop this job's actors at a barrier (keep state +
+            # catalog — this is a pause, not a drop)
+            old_actor = await self._stop_job(name, mv.actor_id)
+            try:
+                if old_actor is not None and \
+                        old_actor.failure is not None:
+                    raise old_actor.failure
+                # 2) replan from the recorded id base → same state
+                # tables (the id sequence is deterministic in the
+                # definition; mesh choice allocates no ids)
+                saved = self.catalog._next_id
+                self.catalog._next_id = mv.id_base
+                planner = StreamPlanner(
+                    self.catalog, self.store, self.local,
+                    definition="", mesh=mesh, actors=self.actors)
+                actor_id = self._next_actor
+                self._next_actor += 1
+                try:
+                    plan = planner.plan(name, sel, actor_id,
+                                        rate_limit=self.rate_limit,
+                                        min_chunks=self.min_chunks)
+                except BaseException:
+                    for sid in planner.registered_senders:
+                        self.local.drop_actor(sid)
+                    self.catalog._next_id = saved
+                    raise
+                self.catalog._next_id = max(saved,
+                                            self.catalog._next_id)
+                plan.mv.id_base = mv.id_base
+                del self.catalog.mvs[name]
+                # 3) redeploy; executors recover from the kept tables
+                await self._deploy_job(
+                    name, actor_id, plan.consumer, plan.readers,
+                    lambda: self.catalog.add_mv(plan.mv),
+                    attaches=plan.attaches)
+            except BaseException as e:
+                # the old pipeline is gone and cannot be restored:
+                # degrade to DROPPED (state tables kept) rather than
+                # leaving a catalog entry that serves frozen results
+                self.catalog.mvs.pop(name, None)
+                self._mv_selects.pop(name, None)
+                raise PlanError(
+                    f"reschedule of {name!r} failed after teardown — "
+                    f"the MV was dropped (state retained): {e}") from e
+        if self._deployed_actor.failure is not None:
+            raise self._deployed_actor.failure
+        return "ALTER_MATERIALIZED_VIEW"
 
     async def _create_sink(self, stmt: ast.CreateSink) -> str:
         from risingwave_tpu.frontend.catalog import SinkCatalog
@@ -329,39 +422,47 @@ class Frontend:
             raise self._deployed_actor.failure
         return "CREATE_SINK"
 
+    async def _stop_job(self, name: str, actor_id: int):
+        """Stop one job's actors at a barrier and remove its topology
+        (caller holds the barrier lock). Returns the stopped Actor (or
+        None) — shared by drop and reschedule; the sequence is delicate
+        (a heartbeat between steps would hang on the stopped actor)."""
+        stop_ids = frozenset(self.readers.get(name, {}).keys()
+                             | {actor_id})
+        await self.loop.inject_and_collect(
+            mutation=StopMutation(stop_ids))
+        task = self.tasks.pop(actor_id, None)
+        if task is not None:
+            await task
+        actor = self.actors.pop(actor_id, None)
+        for sid in self.readers.pop(name, {}):
+            self.local.drop_actor(sid)
+        self.local.drop_actor(actor_id)
+        # detach this job's chain edges from upstream dispatchers: an
+        # orphan output would block the upstream on exhausted channel
+        # permits a few barriers later
+        for uid, out in self.chain_edges.pop(name, []):
+            up = self.actors.get(uid)
+            if up is not None and up.dispatchers:
+                d = up.dispatchers[0]
+                d.update_outputs(
+                    [o for o in d.outputs() if o is not out])
+        self.local.set_expected_actors(list(self.actors))
+        return actor
+
     async def _drop_job(self, name: str, registry, if_exists: bool,
                         status: str) -> str:
         """Shared drop path for MVs and sinks: stop barrier + topology
-        removal as ONE locked unit — a heartbeat barrier between them
-        would still expect the stopped actor and hang."""
+        removal as ONE locked unit."""
         entry = registry.get(name)
         if entry is None:
             if if_exists:
                 return status
             raise PlanError(f"unknown object {name!r}")
         async with self._barrier_lock:
-            stop_ids = frozenset(self.readers.get(name, {}).keys()
-                                 | {entry.actor_id})
-            await self.loop.inject_and_collect(
-                mutation=StopMutation(stop_ids))
-            task = self.tasks.pop(entry.actor_id, None)
-            if task is not None:
-                await task
-            actor = self.actors.pop(entry.actor_id, None)
-            for sid in self.readers.pop(name, {}):
-                self.local.drop_actor(sid)
-            self.local.drop_actor(entry.actor_id)
-            # detach this job's chain edges from upstream dispatchers:
-            # an orphan output would block the upstream on exhausted
-            # channel permits a few barriers later
-            for uid, out in self.chain_edges.pop(name, []):
-                up = self.actors.get(uid)
-                if up is not None and up.dispatchers:
-                    d = up.dispatchers[0]
-                    d.update_outputs(
-                        [o for o in d.outputs() if o is not out])
-            self.local.set_expected_actors(list(self.actors))
+            actor = await self._stop_job(name, entry.actor_id)
         del registry[name]
+        self._mv_selects.pop(name, None)
         if actor is not None and actor.failure is not None:
             raise actor.failure
         return status
