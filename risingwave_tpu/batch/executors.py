@@ -141,6 +141,8 @@ class BatchHashAgg(BatchExecutor):
             for call, a in zip(self.agg_calls, accs):
                 if call.kind == AggKind.COUNT:
                     out.append(a or 0)
+                elif call.kind == AggKind.APPROX_COUNT_DISTINCT:
+                    out.append(len(a) if isinstance(a, set) else 0)
                 else:
                     out.append(a)
             rows.append(gk + tuple(out))
@@ -153,6 +155,14 @@ def _agg_step(kind: AggKind, acc, v, count_star: bool):
         if count_star or v is not None:
             return (acc or 0) + 1
         return acc
+    if kind == AggKind.APPROX_COUNT_DISTINCT:
+        # batch scans are bounded: the exact distinct count is cheap
+        # and strictly dominates the streaming sketch's estimate
+        if v is None:
+            return acc
+        s = acc if isinstance(acc, set) else set()
+        s.add(v)
+        return s
     if v is None:
         return acc
     if acc is None:

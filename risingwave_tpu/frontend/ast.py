@@ -91,7 +91,18 @@ class Tumble:
     alias: Optional[str] = None
 
 
-FromItem = object            # TableRef | Tumble
+@dataclass
+class Hop:
+    """HOP(source, time_col, INTERVAL slide, INTERVAL size)."""
+
+    table: TableRef
+    time_col: str
+    slide_usecs: int
+    size_usecs: int
+    alias: Optional[str] = None
+
+
+FromItem = object            # TableRef | Tumble | Hop
 
 
 @dataclass

@@ -57,7 +57,8 @@ class Scope:
 
 
 _AGG_KINDS = {"count": AggKind.COUNT, "sum": AggKind.SUM,
-              "min": AggKind.MIN, "max": AggKind.MAX}
+              "min": AggKind.MIN, "max": AggKind.MAX,
+              "approx_count_distinct": AggKind.APPROX_COUNT_DISTINCT}
 
 
 class Binder:

@@ -26,7 +26,8 @@ KEYWORDS = {
     "select", "from", "where", "group", "by", "order", "limit", "offset",
     "as", "and", "or", "not", "join", "inner", "on", "create", "drop",
     "show", "materialized", "view", "views", "source", "sources", "table",
-    "tables", "with", "interval", "tumble", "asc", "desc", "null", "true",
+    "tables", "with", "interval", "tumble", "hop", "asc", "desc",
+    "null", "true",
     "false", "if", "exists", "flush", "second", "seconds", "minute",
     "minutes", "hour", "hours", "day", "days", "millisecond",
     "milliseconds", "case", "when", "then", "else", "end", "cast",
@@ -313,6 +314,22 @@ class Parser:
             self._expect_op(")")
             alias = self._ident() if self._kw("as") else None
             return ast.Tumble(table, time_col, iv.usecs, alias)
+        if self._kw("hop"):
+            self._expect_op("(")
+            table = ast.TableRef(self._ident())
+            self._expect_op(",")
+            time_col = self._ident()
+            self._expect_op(",")
+            slide = self._expr()
+            self._expect_op(",")
+            size = self._expr()
+            if not (isinstance(slide, ast.IntervalLit)
+                    and isinstance(size, ast.IntervalLit)):
+                raise ParseError("HOP needs two INTERVAL literals")
+            self._expect_op(")")
+            alias = self._ident() if self._kw("as") else None
+            return ast.Hop(table, time_col, slide.usecs, size.usecs,
+                           alias)
         name = self._ident()
         alias = None
         if self._kw("as"):

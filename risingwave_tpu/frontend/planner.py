@@ -198,7 +198,7 @@ class StreamPlanner:
         """FROM item → executor + scope (+ dependent source names)."""
         from risingwave_tpu.stream.exchange import channel_for_test
 
-        if isinstance(item, ast.Tumble):
+        if isinstance(item, (ast.Tumble, ast.Hop)):
             ref, alias = item.table, item.alias or item.table.name
         elif isinstance(item, ast.TableRef):
             ref, alias = item, item.alias or item.name
@@ -206,8 +206,9 @@ class StreamPlanner:
             raise PlanError(f"unsupported FROM item {item!r}")
         obj = self.catalog.resolve(ref.name)
         if isinstance(obj, MvCatalog):
-            if isinstance(item, ast.Tumble):
-                raise PlanError("TUMBLE over an MV not supported yet")
+            if isinstance(item, (ast.Tumble, ast.Hop)):
+                raise PlanError(
+                    "TUMBLE/HOP over an MV not supported yet")
             ex, scope = self._chain_upstream_mv(obj, alias)
             return ex, scope, [obj.name]
         assert isinstance(obj, SourceCatalog)
@@ -239,6 +240,19 @@ class StreamPlanner:
             ex = ProjectExecutor(ex, exprs, names)
             scope = Scope(ex.schema,
                           scope.qualifiers + [alias])
+        elif isinstance(item, ast.Hop):
+            from risingwave_tpu.stream.executors.hop_window import (
+                HopWindowExecutor,
+            )
+            idx, dt = scope.find(item.time_col, None)
+            if dt not in (DataType.TIMESTAMP, DataType.TIMESTAMPTZ):
+                raise PlanError("HOP time column must be a timestamp")
+            ex = HopWindowExecutor(
+                ex, idx, Interval(usecs=item.slide_usecs),
+                Interval(usecs=item.size_usecs))
+            # schema gains window_start/window_end, same qualifier
+            scope = Scope(ex.schema,
+                          scope.qualifiers + [alias, alias])
         return ex, scope, [obj.name]
 
     def _chain_upstream_mv(self, mv: MvCatalog, alias: str):
@@ -483,8 +497,11 @@ class StreamPlanner:
             return (ex.join_type == JoinType.INNER
                     and StreamPlanner._derive_append_only(ex.left_in)
                     and StreamPlanner._derive_append_only(ex.right_in))
+        from risingwave_tpu.stream.executors.hop_window import (
+            HopWindowExecutor,
+        )
         if isinstance(ex, (ProjectExecutor, FilterExecutor,
-                           RowIdGenExecutor)):
+                           RowIdGenExecutor, HopWindowExecutor)):
             return StreamPlanner._derive_append_only(ex.input)
         from risingwave_tpu.stream.executors.watermark_filter import (
             WatermarkFilterExecutor,

@@ -66,7 +66,8 @@ class AggCall:
     distinct: bool = False
 
     def out_type(self, input_schema: Schema) -> DataType:
-        if self.kind == AggKind.COUNT:
+        if self.kind in (AggKind.COUNT,
+                         AggKind.APPROX_COUNT_DISTINCT):
             return DataType.INT64
         in_t = input_schema[self.input_idx].data_type
         if self.kind == AggKind.SUM:
@@ -207,6 +208,11 @@ class HashAggExecutor(Executor):
                     "retractable min/max needs materialized-input state "
                     f"tables for call(s) {missing} — pass minput_tables "
                     "(see minput_state_schema) or append_only=True")
+            if any(s.kind == AggKind.APPROX_COUNT_DISTINCT
+                   for s in self.specs):
+                raise ValueError(
+                    "approx_count_distinct needs an append-only "
+                    "upstream — an HLL sketch cannot retract")
         # kernel injection: the planner passes a vnode-sharded kernel
         # (parallel/agg.ShardedAggKernel) when parallelism > 1 — same
         # host surface, SPMD launch shape (dispatch.rs:582's hash
@@ -577,11 +583,14 @@ class HashAggExecutor(Executor):
 
     def _state_rows(self, fr, gk, idx: np.ndarray,
                     prev: bool) -> List[tuple]:
-        """Physical value-state rows for the given flush indices."""
+        """Physical value-state rows for the given flush indices
+        (per-call column layout: AggSpec.host_acc_cols)."""
+        from risingwave_tpu.ops.hash_agg import _call_slices
         rows_col = fr.prev_rows if prev else fr.group_rows
         outs = fr.prev_outs if prev else fr.outs
         nulls = fr.prev_nulls if prev else fr.nulls
         nns = fr.prev_nns if prev else fr.nns
+        raw = fr.prev_raw_accs if prev else fr.raw_accs
         cols: List[list] = []
         for vals, ok in gk:
             sel = vals[idx]
@@ -589,12 +598,14 @@ class HashAggExecutor(Executor):
             cols.append([v if o else None
                          for v, o in zip(sel.tolist(), okl.tolist())])
         cols.append(rows_col[idx].tolist())
-        for o, nu, nn in zip(outs, nulls, nns):
-            ol = o[idx].tolist()
-            nul = nu[idx].tolist()
-            cols.append([None if bad else v for v, bad in zip(ol, nul)])
-            if nn is not None:
-                cols.append(nn[idx].tolist())
+        for j, (spec, sl) in enumerate(
+                zip(self.specs, _call_slices(self.specs))):
+            nn = nns[j]
+            cols.extend(spec.host_acc_cols(
+                outs[j][idx], nulls[j][idx],
+                None if nn is None else nn[idx],
+                None if raw is None else
+                [raw[k][idx] for k in range(sl.start, sl.stop)]))
         return list(zip(*cols)) if cols else []
 
     def _persist(self, fr, gk, ins_i, upd_i, del_i) -> None:
