@@ -110,6 +110,9 @@ class Join:
     item: FromItem
     on: Expr
     kind: str = "inner"   # inner|left|right|full (OUTER implied)
+    # JOIN ... FOR SYSTEM_TIME AS OF PROCTIME(): probe the right side
+    # as a versioned table at process time (temporal join)
+    temporal: bool = False
 
 
 @dataclass

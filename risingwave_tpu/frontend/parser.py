@@ -33,6 +33,7 @@ KEYWORDS = {
     "milliseconds", "case", "when", "then", "else", "end", "cast",
     "sink", "sinks", "left", "right", "full", "outer", "distinct",
     "explain", "over", "partition", "alter", "set", "parallelism",
+    "for",
 }
 
 # keywords that can never start a primary expression (a column named
@@ -264,8 +265,24 @@ class Parser:
                 if kind is None:
                     break
                 item = self._from_item()
+                temporal = False
+                if self._kw("for"):
+                    # FOR SYSTEM_TIME AS OF PROCTIME()
+                    if self._ident().lower() != "system_time":
+                        raise ParseError(
+                            "expected SYSTEM_TIME after FOR")
+                    self._expect_kw("as")
+                    if self._ident().lower() != "of":
+                        raise ParseError("expected OF after AS")
+                    if self._ident().lower() != "proctime":
+                        raise ParseError(
+                            "only AS OF PROCTIME() is supported")
+                    self._expect_op("(")
+                    self._expect_op(")")
+                    temporal = True
                 self._expect_kw("on")
-                joins.append(ast.Join(item, self._expr(), kind))
+                joins.append(ast.Join(item, self._expr(), kind,
+                                      temporal=temporal))
         where = self._expr() if self._kw("where") else None
         group_by: List[ast.Expr] = []
         if self._kw("group", "by"):

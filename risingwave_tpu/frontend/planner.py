@@ -356,6 +356,22 @@ class StreamPlanner:
                 rex, rscope, rdeps = self._base_chain(
                     jn.item, rate_limit, min_chunks)
                 deps += rdeps
+                if getattr(jn, "temporal", False):
+                    # temporal join: the right side IS a versioned
+                    # table (MV chain with a pk) probed as-of process
+                    # time — no row-id wrapping, no join state
+                    if not rex.pk_indices:
+                        raise PlanError(
+                            "temporal join (FOR SYSTEM_TIME AS OF "
+                            "PROCTIME()) needs a materialized view "
+                            "on the right side")
+                    if jn.kind not in ("inner", "left"):
+                        raise PlanError(
+                            "temporal join supports INNER and LEFT "
+                            "only")
+                    rights.append((jn, rex, rscope))
+                    full_scope = full_scope.concat(rscope)
+                    continue
                 if rex.pk_indices:
                     raise PlanError(
                         "JOIN over an MV not supported yet (a fresh row "
@@ -366,6 +382,29 @@ class StreamPlanner:
                 rights.append((jn, right, rscope))
                 full_scope = full_scope.concat(rscope)
             for jn, right, rscope in rights:
+                if getattr(jn, "temporal", False):
+                    from risingwave_tpu.stream.executors.temporal_join \
+                        import TemporalJoinExecutor
+                    # left-side pushdown is legal (INNER/LEFT never
+                    # null-pad the left): filter before the probe loop
+                    left, conjuncts = _push_filters(left, lscope,
+                                                    conjuncts,
+                                                    full_scope)
+                    lkeys, rkeys = _equi_keys(jn.on, lscope, rscope)
+                    if sorted(rkeys) != sorted(right.pk_indices):
+                        raise PlanError(
+                            "temporal join ON keys must equal the "
+                            "right table's primary key")
+                    if not self._derive_append_only(left):
+                        raise PlanError(
+                            "temporal join left input must be "
+                            "append-only")
+                    left = TemporalJoinExecutor(
+                        left, right, lkeys, rkeys,
+                        outer=(jn.kind == "left"),
+                        actor_id=actor_id)
+                    lscope = lscope.concat(rscope)
+                    continue
                 # pushdown legality by join kind: a conjunct may move
                 # below a side only if that side is NOT null-padded by
                 # this join (else filter-after-join semantics change)
@@ -497,6 +536,12 @@ class StreamPlanner:
             return (ex.join_type == JoinType.INNER
                     and StreamPlanner._derive_append_only(ex.left_in)
                     and StreamPlanner._derive_append_only(ex.right_in))
+        from risingwave_tpu.stream.executors.temporal_join import (
+            TemporalJoinExecutor,
+        )
+        if isinstance(ex, TemporalJoinExecutor):
+            # temporal output is append-only by construction
+            return StreamPlanner._derive_append_only(ex.left_in)
         from risingwave_tpu.stream.executors.hop_window import (
             HopWindowExecutor,
         )

@@ -129,15 +129,22 @@ def hll_estimate(regs: Sequence[np.ndarray]) -> np.ndarray:
     return np.where(small, lin, e).round().astype(np.int64)
 
 
+_HLL_PER_WORD = 64 // 6     # registers per packed int64
+assert HLL_M <= 2 * _HLL_PER_WORD, \
+    "HLL registers no longer fit two packed int64 state columns — " \
+    "extend host_acc_dtypes before retuning HLL_M"
+
+
 def hll_pack(regs: Sequence[np.ndarray]
              ) -> Tuple[np.ndarray, np.ndarray]:
-    """16 registers (≤ 6 bits each) → (lo, hi) int64 host columns."""
+    """HLL_M registers (6 bits each) → (lo, hi) int64 host columns."""
     lo = np.zeros(regs[0].shape, dtype=np.uint64)
     hi = np.zeros(regs[0].shape, dtype=np.uint64)
-    for i in range(10):
+    for i in range(_HLL_PER_WORD):
         lo |= regs[i].astype(np.uint64) << np.uint64(6 * i)
-    for i in range(10, HLL_M):
-        hi |= regs[i].astype(np.uint64) << np.uint64(6 * (i - 10))
+    for i in range(_HLL_PER_WORD, HLL_M):
+        hi |= regs[i].astype(np.uint64) << np.uint64(
+            6 * (i - _HLL_PER_WORD))
     return lo.view(np.int64), hi.view(np.int64)
 
 
@@ -146,10 +153,10 @@ def hll_unpack(lo: np.ndarray, hi: np.ndarray) -> List[np.ndarray]:
     hi = np.asarray(hi, dtype=np.int64).view(np.uint64)
     out = []
     mask = np.uint64(0x3F)
-    for i in range(10):
+    for i in range(_HLL_PER_WORD):
         out.append(((lo >> np.uint64(6 * i)) & mask).astype(np.int32))
-    for i in range(10, HLL_M):
-        out.append(((hi >> np.uint64(6 * (i - 10))) & mask)
+    for i in range(_HLL_PER_WORD, HLL_M):
+        out.append(((hi >> np.uint64(6 * (i - _HLL_PER_WORD))) & mask)
                    .astype(np.int32))
     return out
 
@@ -241,8 +248,9 @@ class AggSpec:
         if self.kind == AggKind.COUNT:
             return [i64]
         if self.kind == AggKind.APPROX_COUNT_DISTINCT:
-            # estimate (for reads) + packed registers (exact recovery)
-            return [i64, i64, i64]
+            # packed registers only (exact recovery); the estimate is
+            # derivable and lives in the MV output, not the state row
+            return [i64, i64]
         return [self.out_dtype, i64]
 
     def host_acc_cols(self, vals: np.ndarray, nulls: np.ndarray,
@@ -257,7 +265,7 @@ class AggSpec:
             assert raw_cols is not None, \
                 "HLL persistence needs the raw register columns"
             lo, hi = hll_pack([c.astype(np.int64) for c in raw_cols])
-            return [vals.tolist(), lo.tolist(), hi.tolist()]
+            return [lo.tolist(), hi.tolist()]
         value_col = [None if bad else v
                      for v, bad in zip(vals.tolist(), nulls.tolist())]
         return [value_col, nn.tolist()]
@@ -268,7 +276,7 @@ class AggSpec:
         if self.kind == AggKind.COUNT:
             return (host_cols[0].astype(np.int32),)
         if self.kind == AggKind.APPROX_COUNT_DISTINCT:
-            return tuple(hll_unpack(host_cols[1], host_cols[2]))
+            return tuple(hll_unpack(host_cols[0], host_cols[1]))
         return self.encode_acc(host_cols[0], host_cols[1])
 
     def encode_acc(self, value: np.ndarray, nn: Optional[np.ndarray]

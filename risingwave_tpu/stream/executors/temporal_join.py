@@ -1,0 +1,139 @@
+"""TemporalJoinExecutor: stream ⋈ versioned table AS OF process time.
+
+Reference parity: src/stream/src/executor/temporal_join.rs:52 — the
+left stream probes the RIGHT side's current version at arrival time;
+matches emit immediately and are never revised when the right side
+later changes (append-only output, the defining temporal-join
+property). The right side is an ARRANGEMENT (arrange/lookup family,
+src/stream/src/executor/lookup.rs:42): a key → row map maintained
+from the right input's changelog — here a host dict upserted by the
+right MV's chain output (snapshot backfill + live deltas), since
+right-side rows must be readable by arbitrary key at probe time and
+varchar payloads cannot live in HBM anyway.
+
+Semantics:
+- right pk == join key (enforced by the planner): one row per key.
+- INNER: unmatched left rows drop. LEFT_OUTER: they emit NULL-padded.
+- left rows probe the arrangement AS OF their arrival epoch — the
+  process-time contract makes startup ordering best-effort by design
+  (FOR SYSTEM_TIME AS OF PROCTIME()).
+- no join state for the left side, no degrees: nothing to persist;
+  recovery replays the right chain (backfill) to rebuild the
+  arrangement.
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from risingwave_tpu.common.chunk import Column, Op, StreamChunk, next_pow2
+from risingwave_tpu.common.types import Field, Schema
+from risingwave_tpu.stream.executor import Executor, ExecutorInfo
+from risingwave_tpu.stream.merge import barrier_align_2
+from risingwave_tpu.stream.message import (
+    Message, Watermark, is_barrier,
+)
+
+
+class TemporalJoinExecutor(Executor):
+    """stream LEFT/INNER temporal join against an arranged table."""
+
+    def __init__(self, left: Executor, right: Executor,
+                 left_keys: Sequence[int], right_keys: Sequence[int],
+                 outer: bool = False, actor_id: int = 0,
+                 output_names: Optional[Sequence[str]] = None):
+        assert len(left_keys) == len(right_keys)
+        self.left_in, self.right_in = left, right
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.outer = outer
+        names = list(output_names) if output_names else None
+        fields = []
+        k = 0
+        for sch in (left.schema, right.schema):
+            for f in sch:
+                fields.append(Field(names[k] if names else f.name,
+                                    f.data_type))
+                k += 1
+        # output is APPEND-ONLY: identity is the left row (row-id'd by
+        # the planner); right columns are frozen as-of probe time
+        super().__init__(ExecutorInfo(
+            Schema(fields), list(left.pk_indices),
+            f"TemporalJoinExecutor(actor={actor_id})"))
+        self.n_left = len(left.schema)
+        # the arrangement: right join-key tuple → right row tuple
+        self._arranged: Dict[tuple, tuple] = {}
+
+    # -- arrangement maintenance ------------------------------------------
+    def _apply_right(self, chunk: StreamChunk) -> None:
+        for op, row in chunk.to_records():
+            key = tuple(row[i] for i in self.right_keys)
+            if any(v is None for v in key):
+                continue
+            if op.is_insert:
+                self._arranged[key] = tuple(row)
+            else:
+                self._arranged.pop(key, None)
+
+    # -- probe ------------------------------------------------------------
+    def _probe_left(self, chunk: StreamChunk) -> Optional[StreamChunk]:
+        recs = chunk.to_records()
+        out_rows: List[tuple] = []
+        null_right = (None,) * len(self.right_in.schema)
+        for op, row in recs:
+            assert op.is_insert, \
+                "temporal join left input must be append-only"
+            key = tuple(row[i] for i in self.left_keys)
+            match = None if any(v is None for v in key) else \
+                self._arranged.get(key)
+            if match is not None:
+                out_rows.append(tuple(row) + match)
+            elif self.outer:
+                out_rows.append(tuple(row) + null_right)
+        if not out_rows:
+            return None
+        t = len(out_rows)
+        cap = next_pow2(t)
+        cols = []
+        for i, f in enumerate(self.schema):
+            dt = f.data_type
+            vals = [r[i] for r in out_rows]
+            ok = np.ones(cap, dtype=bool)
+            ok[:t] = [v is not None for v in vals]
+            if dt.is_device:
+                arr = np.zeros(cap, dtype=dt.np_dtype)
+                arr[:t] = [0 if v is None else v for v in vals]
+            else:
+                arr = np.empty(cap, dtype=object)
+                arr[:t] = vals
+            cols.append(Column(dt, arr, None if ok.all() else ok))
+        vis = np.zeros(cap, dtype=bool)
+        vis[:t] = True
+        ops = np.full(cap, int(Op.INSERT), dtype=np.int8)
+        return StreamChunk(self.schema, cols, vis, ops)
+
+    # -- main loop --------------------------------------------------------
+    async def execute(self) -> AsyncIterator[Message]:
+        lit = self.left_in.execute()
+        rit = self.right_in.execute()
+        first_l = await lit.__anext__()
+        first_r = await rit.__anext__()
+        assert is_barrier(first_l) and is_barrier(first_r)
+        yield first_l
+        async for tag, msg in barrier_align_2(lit, rit):
+            if tag == "barrier":
+                yield msg
+            elif tag == "right":
+                if isinstance(msg, StreamChunk):
+                    self._apply_right(msg)
+                # right-side watermarks do not bound the output
+            else:                                    # left
+                if isinstance(msg, StreamChunk):
+                    out = self._probe_left(msg)
+                    if out is not None:
+                        yield out
+                elif isinstance(msg, Watermark):
+                    if msg.col_idx < self.n_left:
+                        yield msg
