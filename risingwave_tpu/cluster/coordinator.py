@@ -73,6 +73,21 @@ class WorkerClient:
             "mutation": m,
         })
 
+    async def ping(self) -> dict:
+        """Heartbeat probe (cluster.rs heartbeat RPC round trip)."""
+        return await self.call({"cmd": "ping"})
+
+    def abort(self) -> None:
+        """Hard-close the channel. The JSON-lines protocol has no
+        correlation ids, so once a framed call is cancelled mid-read
+        (ping timeout) the stream is desynchronized — a late reply
+        would be read as the NEXT call's response. Closing makes every
+        later call fail loudly instead."""
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+            self._reader = None
+
     async def stop(self) -> None:
         try:
             await self.call({"cmd": "stop"})
@@ -80,6 +95,60 @@ class WorkerClient:
             pass
         if self._writer is not None:
             self._writer.close()
+
+
+class Heartbeater:
+    """Coordinator-side liveness loop: ping every registered worker on
+    an interval, feed the ClusterManager, expire the silent ones
+    (meta/src/manager/cluster.rs:360 check loop + the compute node's
+    heartbeat sender, combined at the meta side since the coordinator
+    owns the control channel)."""
+
+    def __init__(self, cluster, interval_s: float = 1.0):
+        self.cluster = cluster
+        self.interval = interval_s
+        self._clients: dict = {}          # worker_id → WorkerClient
+        self._task = None
+
+    def register(self, worker_id: int, client: WorkerClient) -> None:
+        self._clients[worker_id] = client
+
+    async def tick(self) -> list:
+        """One round: ping all CONCURRENTLY (a dead worker's timeout
+        must not consume a healthy worker's lease), heartbeat the
+        responders, expire the rest. Returns the evicted workers."""
+        async def one(wid, client):
+            try:
+                reply = await asyncio.wait_for(client.ping(), 2.0)
+                self.cluster.heartbeat(wid, reply.get("info"))
+            except asyncio.TimeoutError:
+                # a cancelled framed call desyncs the channel — kill it
+                client.abort()
+            except (ConnectionError, RuntimeError, OSError,
+                    AttributeError):
+                pass                       # no heartbeat → may expire
+
+        await asyncio.gather(*(one(w, c)
+                               for w, c in list(self._clients.items())))
+        dead = self.cluster.expire_stale()
+        for w in dead:
+            client = self._clients.pop(w.worker_id, None)
+            if client is not None:
+                client.abort()             # no leaked half-open socket
+        return dead
+
+    def start(self) -> None:
+        async def loop():
+            while True:
+                await asyncio.sleep(self.interval)
+                await self.tick()
+
+        self._task = asyncio.ensure_future(loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
 
 
 class WorkerBarrierSender:

@@ -172,6 +172,10 @@ class WorkerServer:
             return await self._deploy(cmd)
         if verb == "inject":
             return await self._inject(cmd)
+        if verb == "ping":
+            # heartbeat probe (cluster.rs heartbeat RPC): liveness +
+            # a cheap resource summary for the membership table
+            return {"ok": True, "info": {"actors": len(self.actors)}}
         if verb == "stop":
             return {"ok": True}
         return {"ok": False, "error": f"unknown cmd {verb!r}"}

@@ -61,6 +61,11 @@ class Frontend:
         # name → CREATE MV select AST (reschedule replans from this —
         # the DDL log may hold stale same-name CREATEs after drops)
         self._mv_selects: Dict[str, object] = {}
+        # catalog-change broadcast (meta notification service analog):
+        # observers get a snapshot then versioned deltas
+        from risingwave_tpu.meta.notification import NotificationService
+        self.notifications = NotificationService(
+            snapshot_fn=self._catalog_snapshot)
         self._ddl_log: List[str] = []
         self._replaying = False
         # serializes barrier rounds between DDL handlers, step() and the
@@ -113,10 +118,17 @@ class Frontend:
                                  ast.CreateSink, ast.DropSink,
                                  ast.DropMaterializedView,
                                  ast.DropSource,
-                                 ast.AlterParallelism)) and \
-                    not self._replaying:
-                self._ddl_log.append(text)
-                self._persist_ddl()
+                                 ast.AlterParallelism)):
+                from risingwave_tpu.meta.notification import (
+                    Notification,
+                )
+                self.notifications.publish(Notification(
+                    type(stmt).__name__, {
+                        "name": getattr(stmt, "name", None),
+                        "version_hint": len(self._ddl_log)}))
+                if not self._replaying:
+                    self._ddl_log.append(text)
+                    self._persist_ddl()
         return result
 
     def execute_sync(self, sql: str) -> Union[Rows, str]:
@@ -265,6 +277,19 @@ class Frontend:
                             rate_limit=self.rate_limit,
                             min_chunks=self.min_chunks)
         return [(line,) for line in explain_tree(plan.consumer)]
+
+    def _catalog_snapshot(self) -> list:
+        """Current catalog as notification payloads (observers get
+        this before any live delta — snapshot-then-delta contract)."""
+        out = []
+        for s in self.catalog.sources.values():
+            out.append({"kind": "source", "name": s.name})
+        for m in self.catalog.mvs.values():
+            out.append({"kind": "mv", "name": m.name,
+                        "table_id": m.table_id})
+        for sk in self.catalog.sinks.values():
+            out.append({"kind": "sink", "name": sk.name})
+        return out
 
     @staticmethod
     def _mesh_for(parallelism: int):

@@ -1,0 +1,100 @@
+"""ClusterManager: worker membership with heartbeat expiry.
+
+Reference parity: src/meta/src/manager/cluster.rs — add_worker_node /
+heartbeat (:312) and the expiry check loop (:360-400) that deletes
+workers whose heartbeat lapses beyond ``max_heartbeat_interval`` and
+notifies observers. TPU re-design notes: membership is a meta-side
+map keyed by worker id; expiry drives the coordinator's failure
+handling (a dead worker's pipelines re-deploy from committed state —
+the recovery path the two-node tests already exercise). Time comes
+from an injectable clock so expiry is deterministic under the
+VirtualClock test harness.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from risingwave_tpu.meta.notification import (
+    Notification, NotificationService,
+)
+
+
+@dataclass
+class WorkerNode:
+    worker_id: int
+    host: str
+    port: int
+    started_at: float
+    last_heartbeat: float
+    # opaque worker-reported info (parallelism, resource summary)
+    info: dict = field(default_factory=dict)
+
+
+class ClusterManager:
+    """Membership + heartbeat liveness (cluster.rs analog)."""
+
+    def __init__(self, max_heartbeat_interval_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 notifications: Optional[NotificationService] = None):
+        self.max_interval = max_heartbeat_interval_s
+        self.clock = clock
+        self.notifications = notifications
+        self._workers: Dict[int, WorkerNode] = {}
+        self._next_id = 1
+
+    # -- membership -------------------------------------------------------
+    def add_worker(self, host: str, port: int,
+                   info: Optional[dict] = None) -> WorkerNode:
+        now = self.clock()
+        w = WorkerNode(self._next_id, host, port, now, now,
+                       dict(info or {}))
+        self._next_id += 1
+        self._workers[w.worker_id] = w
+        if self.notifications:
+            self.notifications.publish(Notification(
+                "worker_added", {"worker_id": w.worker_id,
+                                 "host": host, "port": port}))
+        return w
+
+    def remove_worker(self, worker_id: int) -> bool:
+        w = self._workers.pop(worker_id, None)
+        if w is None:
+            return False
+        if self.notifications:
+            self.notifications.publish(Notification(
+                "worker_removed", {"worker_id": worker_id}))
+        return True
+
+    def heartbeat(self, worker_id: int,
+                  info: Optional[dict] = None) -> bool:
+        """Refresh a worker's lease; False if it was already expired
+        (the worker must re-register — cluster.rs heartbeat returns
+        WorkerNotFound the same way)."""
+        w = self._workers.get(worker_id)
+        if w is None:
+            return False
+        w.last_heartbeat = self.clock()
+        if info:
+            w.info.update(info)
+        return True
+
+    def workers(self) -> List[WorkerNode]:
+        return list(self._workers.values())
+
+    # -- expiry (cluster.rs:360 check loop body) --------------------------
+    def expire_stale(self) -> List[WorkerNode]:
+        """Evict workers whose heartbeat lapsed; returns the evicted.
+        Callers run this on their own cadence (the coordinator ticks it
+        per barrier round; tests tick a VirtualClock)."""
+        now = self.clock()
+        dead = [w for w in self._workers.values()
+                if now - w.last_heartbeat > self.max_interval]
+        for w in dead:
+            del self._workers[w.worker_id]
+            if self.notifications:
+                self.notifications.publish(Notification(
+                    "worker_expired", {"worker_id": w.worker_id}))
+        return dead

@@ -1,0 +1,84 @@
+"""Notification service: versioned meta-change broadcast.
+
+Reference parity: src/meta/src/manager/notification.rs — observers
+(frontends, compute nodes, compactors) subscribe and receive catalog /
+cluster deltas with a monotone notification version; a new observer
+first gets a SNAPSHOT at the current version so it never observes a
+gap. TPU re-design: in-process pub/sub with per-observer asyncio
+queues — the cross-process transport (the coordinator's JSON control
+channel) forwards the same payloads; versioning and snapshot-then-
+delta semantics live here either way.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+
+@dataclass(frozen=True)
+class Notification:
+    kind: str                 # e.g. "mv_created", "worker_expired"
+    payload: dict
+    version: int = 0          # stamped by the service at publish
+
+
+class Observer:
+    """One subscription: an asyncio queue of notifications."""
+
+    def __init__(self, observer_id: int, snapshot: List[Notification]):
+        self.observer_id = observer_id
+        self.queue: "asyncio.Queue[Notification]" = asyncio.Queue()
+        # snapshot-then-delta: everything up to the subscribe version
+        # arrives as one batch before any live notification
+        self.snapshot = snapshot
+
+    async def recv(self) -> Notification:
+        return await self.queue.get()
+
+    def try_recv(self) -> Optional[Notification]:
+        try:
+            return self.queue.get_nowait()
+        except asyncio.QueueEmpty:
+            return None
+
+
+class NotificationService:
+    """Versioned broadcast hub (notification.rs NotificationManager)."""
+
+    def __init__(self, snapshot_fn: Optional[Callable[[], List[dict]]]
+                 = None, history_cap: int = 1024):
+        self.version = 0
+        self._observers: Dict[int, Observer] = {}
+        self._next_observer = 1
+        # bounded history so late subscribers can be given the recent
+        # deltas; a real snapshot (catalog dump) wins when provided
+        self._history: List[Notification] = []
+        self._history_cap = history_cap
+        self._snapshot_fn = snapshot_fn
+
+    def subscribe(self) -> Observer:
+        if self._snapshot_fn is not None:
+            snap = [Notification("snapshot", p, self.version)
+                    for p in self._snapshot_fn()]
+        else:
+            snap = list(self._history)
+        obs = Observer(self._next_observer, snap)
+        self._next_observer += 1
+        self._observers[obs.observer_id] = obs
+        return obs
+
+    def unsubscribe(self, observer_id: int) -> None:
+        self._observers.pop(observer_id, None)
+
+    def publish(self, n: Notification) -> int:
+        """Stamp, record, fan out. Returns the stamped version."""
+        self.version += 1
+        stamped = Notification(n.kind, n.payload, self.version)
+        self._history.append(stamped)
+        if len(self._history) > self._history_cap:
+            del self._history[:len(self._history) - self._history_cap]
+        for obs in list(self._observers.values()):
+            obs.queue.put_nowait(stamped)
+        return self.version

@@ -140,7 +140,7 @@ def hll_pack(regs: Sequence[np.ndarray]
     """HLL_M registers (6 bits each) → (lo, hi) int64 host columns."""
     lo = np.zeros(regs[0].shape, dtype=np.uint64)
     hi = np.zeros(regs[0].shape, dtype=np.uint64)
-    for i in range(_HLL_PER_WORD):
+    for i in range(min(_HLL_PER_WORD, HLL_M)):
         lo |= regs[i].astype(np.uint64) << np.uint64(6 * i)
     for i in range(_HLL_PER_WORD, HLL_M):
         hi |= regs[i].astype(np.uint64) << np.uint64(
@@ -153,7 +153,7 @@ def hll_unpack(lo: np.ndarray, hi: np.ndarray) -> List[np.ndarray]:
     hi = np.asarray(hi, dtype=np.int64).view(np.uint64)
     out = []
     mask = np.uint64(0x3F)
-    for i in range(_HLL_PER_WORD):
+    for i in range(min(_HLL_PER_WORD, HLL_M)):
         out.append(((lo >> np.uint64(6 * i)) & mask).astype(np.int32))
     for i in range(_HLL_PER_WORD, HLL_M):
         out.append(((hi >> np.uint64(6 * (i - _HLL_PER_WORD))) & mask)

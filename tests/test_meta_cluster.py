@@ -1,0 +1,119 @@
+"""ClusterManager heartbeats + NotificationService (meta plane).
+
+Reference parity: src/meta/src/manager/cluster.rs:312-400 (heartbeat
+lease + expiry check) and src/meta/src/manager/notification.rs
+(versioned observer broadcast, snapshot-then-delta).
+"""
+
+import asyncio
+
+import pytest
+
+from risingwave_tpu.meta.cluster import ClusterManager
+from risingwave_tpu.meta.notification import (
+    Notification, NotificationService,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_heartbeat_lease_and_expiry():
+    clk = FakeClock()
+    ns = NotificationService()
+    obs = ns.subscribe()
+    cm = ClusterManager(max_heartbeat_interval_s=5.0, clock=clk,
+                        notifications=ns)
+    w1 = cm.add_worker("h1", 1, {"parallelism": 4})
+    w2 = cm.add_worker("h2", 2)
+    assert {w.worker_id for w in cm.workers()} == {1, 2}
+    clk.t = 4.0
+    assert cm.heartbeat(w1.worker_id, {"actors": 3})
+    assert cm.expire_stale() == []        # both within lease
+    clk.t = 8.9    # w2 (last beat t=0) lapsed; w1 (t=4) not yet
+    dead = cm.expire_stale()
+    assert [w.worker_id for w in dead] == [w2.worker_id]
+    assert cm.heartbeat(w2.worker_id) is False   # must re-register
+    assert cm.workers()[0].info["actors"] == 3
+    kinds = []
+    while (n := obs.try_recv()) is not None:
+        kinds.append(n.kind)
+    assert kinds == ["worker_added", "worker_added", "worker_expired"]
+
+
+def test_notification_versions_and_snapshot():
+    state = [{"kind": "mv", "name": "v1"}]
+    ns = NotificationService(snapshot_fn=lambda: list(state))
+    v1 = ns.publish(Notification("mv_created", {"name": "v1"}))
+    obs = ns.subscribe()
+    # snapshot carries current state at the subscribe version
+    assert [s.payload["name"] for s in obs.snapshot] == ["v1"]
+    v2 = ns.publish(Notification("mv_created", {"name": "v2"}))
+    assert v2 == v1 + 1
+    n = obs.try_recv()
+    assert n.kind == "mv_created" and n.version == v2
+    ns.unsubscribe(obs.observer_id)
+    ns.publish(Notification("mv_dropped", {"name": "v2"}))
+    assert obs.try_recv() is None         # unsubscribed
+
+
+def test_frontend_publishes_catalog_notifications():
+    from risingwave_tpu.frontend.session import Frontend
+
+    async def run():
+        fe = Frontend(min_chunks=2)
+        await fe.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', "
+            "nexmark.table.type='bid', nexmark.event.num=1000)")
+        obs = fe.notifications.subscribe()
+        # snapshot sees the source created before subscribing
+        assert any(p.payload.get("name") == "bid"
+                   for p in obs.snapshot)
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW v AS SELECT auction FROM bid")
+        n = obs.try_recv()
+        await fe.close()
+        return n
+
+    n = asyncio.run(run())
+    assert n.kind == "CreateMaterializedView"
+    assert n.payload["name"] == "v"
+
+
+def test_heartbeater_detects_killed_worker(tmp_path):
+    """End-to-end failure DETECTION (VERDICT r3 §5 gap: 'no heartbeat-
+    based detection'): a SIGKILLed worker stops answering pings and is
+    evicted by lease expiry, with a notification."""
+    from risingwave_tpu.cluster.coordinator import (
+        Heartbeater, WorkerHandle,
+    )
+    from risingwave_tpu.meta.notification import NotificationService
+
+    async def run():
+        ns = NotificationService()
+        obs = ns.subscribe()
+        cm = ClusterManager(max_heartbeat_interval_s=1.5,
+                            notifications=ns)
+        hb = Heartbeater(cm, interval_s=0.2)
+        h = WorkerHandle(str(tmp_path / "s"))
+        client = await h.start()
+        w = cm.add_worker("127.0.0.1", client.control_port)
+        hb.register(w.worker_id, client)
+        assert await hb.tick() == []
+        assert cm.workers()[0].info.get("actors") == 0
+        h.kill()                            # SIGKILL: no goodbye
+        await asyncio.sleep(1.6)
+        dead = await hb.tick()
+        assert [x.worker_id for x in dead] == [w.worker_id]
+        kinds = []
+        while (n := obs.try_recv()) is not None:
+            kinds.append(n.kind)
+        assert kinds == ["worker_added", "worker_expired"]
+        return True
+
+    assert asyncio.run(run())
