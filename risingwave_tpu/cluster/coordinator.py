@@ -40,12 +40,31 @@ class WorkerClient:
         self._reader, self._writer = await asyncio.open_connection(
             self.host, self.control_port)
 
-    async def call(self, cmd: dict) -> dict:
+    async def call(self, cmd: dict,
+                   io_timeout: Optional[float] = None) -> dict:
+        """One framed RPC. `io_timeout` bounds the round trip AFTER
+        the channel lock is held (waiting behind another in-flight RPC
+        is not evidence of a dead worker); an expired timeout leaves a
+        desynchronized stream, so the channel is hard-closed."""
+        if self._writer is None:
+            raise ConnectionError("worker control channel closed")
         async with self._lock:
+            if self._writer is None:
+                raise ConnectionError("worker control channel closed")
             self._writer.write((json.dumps(cmd) + "\n").encode())
             await self._writer.drain()
-            line = await self._reader.readline()
-        if not line:
+            if io_timeout is None:
+                line = await self._reader.readline()
+            else:
+                try:
+                    line = await asyncio.wait_for(
+                        self._reader.readline(), io_timeout)
+                except asyncio.TimeoutError:
+                    self.abort()
+                    raise ConnectionError(
+                        "worker control RPC timed out") from None
+        if not line or not line.endswith(b"\n"):
+            # closed, or a torn reply from a worker killed mid-write
             raise ConnectionError("worker control channel closed")
         reply = json.loads(line)
         if not reply.get("ok"):
@@ -73,9 +92,9 @@ class WorkerClient:
             "mutation": m,
         })
 
-    async def ping(self) -> dict:
+    async def ping(self, io_timeout: float = 2.0) -> dict:
         """Heartbeat probe (cluster.rs heartbeat RPC round trip)."""
-        return await self.call({"cmd": "ping"})
+        return await self.call({"cmd": "ping"}, io_timeout=io_timeout)
 
     def abort(self) -> None:
         """Hard-close the channel. The JSON-lines protocol has no
@@ -116,17 +135,21 @@ class Heartbeater:
     async def tick(self) -> list:
         """One round: ping all CONCURRENTLY (a dead worker's timeout
         must not consume a healthy worker's lease), heartbeat the
-        responders, expire the rest. Returns the evicted workers."""
+        responders, expire the rest. Returns the evicted workers.
+        The ping's io-timeout starts after the channel lock is held —
+        waiting behind a long barrier RPC never counts against the
+        worker, and call() closes a genuinely desynced channel itself."""
         async def one(wid, client):
             try:
-                reply = await asyncio.wait_for(client.ping(), 2.0)
-                self.cluster.heartbeat(wid, reply.get("info"))
-            except asyncio.TimeoutError:
-                # a cancelled framed call desyncs the channel — kill it
-                client.abort()
+                reply = await client.ping()
             except (ConnectionError, RuntimeError, OSError,
-                    AttributeError):
-                pass                       # no heartbeat → may expire
+                    ValueError):            # incl. torn-reply JSON
+                return                     # no heartbeat → may expire
+            if not self.cluster.heartbeat(wid, reply.get("info")):
+                # expired/removed outside this loop: stop pinging it
+                stale = self._clients.pop(wid, None)
+                if stale is not None:
+                    stale.abort()
 
         await asyncio.gather(*(one(w, c)
                                for w, c in list(self._clients.items())))

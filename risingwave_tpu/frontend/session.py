@@ -118,17 +118,19 @@ class Frontend:
                                  ast.CreateSink, ast.DropSink,
                                  ast.DropMaterializedView,
                                  ast.DropSource,
-                                 ast.AlterParallelism)):
+                                 ast.AlterParallelism)) and \
+                    not self._replaying:
+                # replayed DDL publishes nothing: observers' snapshots
+                # already contain the replayed catalog
                 from risingwave_tpu.meta.notification import (
                     Notification,
                 )
+                self._ddl_log.append(text)
+                self._persist_ddl()
                 self.notifications.publish(Notification(
                     type(stmt).__name__, {
                         "name": getattr(stmt, "name", None),
                         "version_hint": len(self._ddl_log)}))
-                if not self._replaying:
-                    self._ddl_log.append(text)
-                    self._persist_ddl()
         return result
 
     def execute_sync(self, sql: str) -> Union[Rows, str]:

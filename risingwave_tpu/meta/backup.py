@@ -1,0 +1,100 @@
+"""Meta/storage backup & restore: consistent cluster snapshots.
+
+Reference parity: src/meta/src/backup_restore/ — a backup captures the
+meta snapshot (here: the DDL log) plus the hummock version and every
+SST it references, into a self-contained prefix of an object store;
+restore materializes a FRESH cluster root from a backup and a new
+session recovers from it (DDL replay + state recovery, the normal boot
+path). Backups are consistent by construction: the hummock version is
+an immutable snapshot (SSTs are never rewritten in place — compaction
+writes new objects and commits a new version), so copying CURRENT's
+closure needs no quiesce.
+
+Layout under the backup store:
+    backup/<id>/MANIFEST.json   {"id", "files": [...], "version_id"}
+    backup/<id>/<original path> (verbatim object copies)
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+BACKUP_PREFIX = "backup"
+
+
+def _closure(obj) -> List[str]:
+    """Every object a consistent snapshot needs: the CURRENT hummock
+    version file, every SST path it references, and the meta DDL log."""
+    files: List[str] = []
+    if obj.exists("meta/ddl.json"):
+        files.append("meta/ddl.json")
+    if not obj.exists("meta/CURRENT"):
+        return files
+    files.append("meta/CURRENT")
+    vid = int(obj.read("meta/CURRENT").decode())
+    vpath = f"meta/v{vid}.json"
+    files.append(vpath)
+    v = json.loads(obj.read(vpath).decode())
+    for level in ("l0", "l1"):
+        for sst in v.get(level, []):
+            files.append(f"data/{sst['id']}.sst")
+    return files
+
+
+def create_backup(obj, backup_obj=None,
+                  backup_id: Optional[str] = None) -> str:
+    """Copy the current consistent snapshot into the backup store
+    (defaults to the same object store under ``backup/<id>/``).
+    Returns the backup id."""
+    backup_obj = backup_obj if backup_obj is not None else obj
+    if backup_id is None:
+        existing = list_backups(backup_obj)
+        n = 1 + max((int(b) for b in existing if b.isdigit()),
+                    default=0)
+        backup_id = str(n)
+    files = _closure(obj)
+    base = f"{BACKUP_PREFIX}/{backup_id}"
+    for path in files:
+        backup_obj.upload(f"{base}/{path}", obj.read(path))
+    version_id = None
+    if obj.exists("meta/CURRENT"):
+        version_id = int(obj.read("meta/CURRENT").decode())
+    backup_obj.upload(f"{base}/MANIFEST.json", json.dumps({
+        "id": backup_id, "files": files,
+        "version_id": version_id}).encode())
+    return backup_id
+
+
+def list_backups(backup_obj) -> List[str]:
+    out = set()
+    for path in backup_obj.list(BACKUP_PREFIX + "/"):
+        rest = path[len(BACKUP_PREFIX) + 1:]
+        out.add(rest.split("/", 1)[0])
+    # numeric ids sort numerically ('10' after '2'); names after
+    return sorted(out, key=lambda b: (not b.isdigit(),
+                                      int(b) if b.isdigit() else 0, b))
+
+
+def delete_backup(backup_obj, backup_id: str) -> int:
+    base = f"{BACKUP_PREFIX}/{backup_id}/"
+    paths = list(backup_obj.list(base))
+    for p in paths:
+        backup_obj.delete(p)
+    return len(paths)
+
+
+def restore_backup(backup_obj, backup_id: str, target_obj) -> dict:
+    """Materialize a backup into a FRESH cluster root. Refuses a
+    non-empty target (restoring over live state silently merges two
+    histories — the reference's restore makes the same demand)."""
+    if target_obj.list(""):
+        raise ValueError(
+            "restore target must be empty — refusing to mix a backup "
+            "into live cluster state")
+    base = f"{BACKUP_PREFIX}/{backup_id}"
+    manifest = json.loads(
+        backup_obj.read(f"{base}/MANIFEST.json").decode())
+    for path in manifest["files"]:
+        target_obj.upload(path, backup_obj.read(f"{base}/{path}"))
+    return manifest
