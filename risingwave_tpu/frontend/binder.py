@@ -230,6 +230,31 @@ class Binder:
                 AggCall(AggKind.COUNT, None, distinct=d),
                 ("count", akey, d), input_expr=arg)
             return ("avg", sj, cj)
+        if name in ("string_agg", "array_agg"):
+            if not self.allow_aggs:
+                raise BindError(f"aggregate {name}() not allowed here")
+            if e.star or not e.args:
+                raise BindError(f"{name}() needs an argument")
+            if e.distinct:
+                raise BindError(
+                    f"{name}(DISTINCT ...) is not supported yet")
+            arg = self.bind(e.args[0])
+            delimiter = ","
+            if name == "string_agg":
+                if len(e.args) != 2 or not (
+                        isinstance(e.args[1], ast.Lit)
+                        and e.args[1].kind == "string"):
+                    raise BindError(
+                        "string_agg(expr, 'delimiter') needs a string "
+                        "literal delimiter")
+                delimiter = str(e.args[1].value)
+            elif len(e.args) != 1:
+                raise BindError("array_agg() takes one argument")
+            kind = AggKind.STRING_AGG if name == "string_agg" \
+                else AggKind.ARRAY_AGG
+            call = AggCall(kind, None, delimiter=delimiter)
+            return ("agg", self._register(
+                call, (name, repr(arg), delimiter), input_expr=arg))
         if name in _AGG_KINDS:
             if not self.allow_aggs:
                 raise BindError(f"aggregate {name}() not allowed here")

@@ -398,6 +398,25 @@ def _fmt_date(days: int) -> str:
     return d.isoformat()
 
 
+def _pg_list(v) -> str:
+    """array_agg output → pg array text: NULL elements literal, and
+    quoting whenever the element could be misread (delimiters, quotes,
+    backslashes, empty strings, or the literal word NULL)."""
+    parts = []
+    for x in v:
+        if x is None:
+            parts.append("NULL")
+            continue
+        s = str(x)
+        if s == "" or s.upper() == "NULL" or any(
+                c in s for c in ',{}"\\ '):
+            s = s.replace("\\", "\\\\").replace('"', '\\"')
+            parts.append(f'"{s}"')
+        else:
+            parts.append(s)
+    return "{" + ",".join(parts) + "}"
+
+
 def _pg_text(v, dt: Optional[DataType] = None) -> str:
     """Text-format one value. Physical time types (raw ints — see
     common/types.py:119-122) are rendered ISO-8601 so psql/psycopg can
@@ -406,6 +425,8 @@ def _pg_text(v, dt: Optional[DataType] = None) -> str:
         return "t"
     if v is False:
         return "f"
+    if dt == DataType.LIST or isinstance(v, (tuple, list)):
+        return _pg_list(v)
     if dt == DataType.DATE:
         return _fmt_date(int(v))
     if dt == DataType.TIME:

@@ -613,7 +613,8 @@ class StreamPlanner:
                 pre_names.append(f"_a{len(pre_exprs) - 1}")
                 in_expr_idx[k] = len(pre_exprs) - 1
             remapped.append(AggCall(call.kind, in_expr_idx[k],
-                                    distinct=call.distinct))
+                                    distinct=call.distinct,
+                                    delimiter=call.delimiter))
         pre = ProjectExecutor(ex, pre_exprs, pre_names)
         g = len(group_bound)
         calls = remapped
@@ -651,17 +652,18 @@ class StreamPlanner:
                 distinct_tables[c.input_idx] = StateTable(
                     self.catalog.next_id(), dsch, dpk, self.store,
                     dist_key_indices=ddk)
+        from risingwave_tpu.ops.hash_agg import HOST_AGG_KINDS
         minput_tables = {}
-        if not append_only:
-            # materialized-input state for retractable MIN/MAX
-            # (aggregation/minput.rs analog)
-            for j, c in enumerate(calls):
-                if c.kind in (AggKind.MIN, AggKind.MAX):
-                    msch, mpk, mdk = minput_state_schema(
-                        pre.schema, list(range(g)), c)
-                    minput_tables[j] = StateTable(
-                        self.catalog.next_id(), msch, mpk, self.store,
-                        dist_key_indices=mdk)
+        for j, c in enumerate(calls):
+            # retractable MIN/MAX need the value multiset; host aggs
+            # (string_agg/array_agg) ARE their value multiset
+            if (c.kind in (AggKind.MIN, AggKind.MAX)
+                    and not append_only) or c.kind in HOST_AGG_KINDS:
+                msch, mpk, mdk = minput_state_schema(
+                    pre.schema, list(range(g)), c)
+                minput_tables[j] = StateTable(
+                    self.catalog.next_id(), msch, mpk, self.store,
+                    dist_key_indices=mdk)
         agg = HashAggExecutor(pre, list(range(g)), calls, table,
                               append_only=append_only, kernel=kernel,
                               minput_tables=minput_tables,

@@ -67,6 +67,15 @@ class AggKind(enum.Enum):
     MAX = "max"
     # HyperLogLog cardinality sketch (append-only; see HLL_* below)
     APPROX_COUNT_DISTINCT = "approx_count_distinct"
+    # HOST-ONLY aggs (string/list outputs can never live in HBM): the
+    # device keeps one dummy lane for dirty-tracking arity; outputs
+    # recompute from the minput value multiset at flush
+    # (expr/src/aggregate string_agg.rs / array_agg.rs parity)
+    STRING_AGG = "string_agg"
+    ARRAY_AGG = "array_agg"
+
+
+HOST_AGG_KINDS = (AggKind.STRING_AGG, AggKind.ARRAY_AGG)
 
 
 # -- HyperLogLog (approx_count_distinct) ----------------------------------
@@ -170,6 +179,8 @@ class AggSpec:
 
     @property
     def out_dtype(self) -> np.dtype:
+        if self.kind in HOST_AGG_KINDS:
+            return np.dtype(object)
         if self.kind in (AggKind.COUNT,
                          AggKind.APPROX_COUNT_DISTINCT):
             return np.dtype(np.int64)
@@ -191,6 +202,8 @@ class AggSpec:
         f32 = np.dtype(np.float32)
         if self.kind == AggKind.COUNT:
             return [(i32, 0)]
+        if self.kind in HOST_AGG_KINDS:
+            return [(i32, 0)]             # dummy lane (arity only)
         if self.kind == AggKind.APPROX_COUNT_DISTINCT:
             return [(i32, 0)] * HLL_M     # one register per lane
         if self.kind == AggKind.SUM:
@@ -203,7 +216,7 @@ class AggSpec:
     # -- host codecs -----------------------------------------------------
     def encode_input(self, vals: np.ndarray) -> Tuple[np.ndarray, ...]:
         """Host value column → device input lanes (numpy, vectorized)."""
-        if self.kind == AggKind.COUNT:
+        if self.kind == AggKind.COUNT or self.kind in HOST_AGG_KINDS:
             return ()
         if self.kind == AggKind.APPROX_COUNT_DISTINCT:
             from risingwave_tpu.stream.executors.keys import to_i64
@@ -225,6 +238,12 @@ class AggSpec:
             assert (cnt >= 0).all(), \
                 "COUNT wrapped int32 — a group exceeded 2^31 rows"
             return cnt, np.zeros(cnt.shape, dtype=bool)
+        if self.kind in HOST_AGG_KINDS:
+            # placeholder: the executor overwrites these from the
+            # minput multiset at flush (host path)
+            n = len(cols[0])
+            return (np.full(n, None, dtype=object),
+                    np.ones(n, dtype=bool))
         if self.kind == AggKind.APPROX_COUNT_DISTINCT:
             est = hll_estimate([c.astype(np.int64) for c in cols])
             return est, np.zeros(est.shape, dtype=bool)
@@ -247,6 +266,10 @@ class AggSpec:
         i64 = np.dtype(np.int64)
         if self.kind == AggKind.COUNT:
             return [i64]
+        if self.kind in HOST_AGG_KINDS:
+            # nothing to persist: outputs recompute from the minput
+            # multiset; one placeholder keeps the row arity stable
+            return [i64]
         if self.kind == AggKind.APPROX_COUNT_DISTINCT:
             # packed registers only (exact recovery); the estimate is
             # derivable and lives in the MV output, not the state row
@@ -261,6 +284,8 @@ class AggSpec:
         python lists for state rows, NULLs as None."""
         if self.kind == AggKind.COUNT:
             return [vals.tolist()]
+        if self.kind in HOST_AGG_KINDS:
+            return [[0] * len(vals)]
         if self.kind == AggKind.APPROX_COUNT_DISTINCT:
             assert raw_cols is not None, \
                 "HLL persistence needs the raw register columns"
@@ -275,6 +300,8 @@ class AggSpec:
         """Recovered host acc columns → device-layout columns."""
         if self.kind == AggKind.COUNT:
             return (host_cols[0].astype(np.int32),)
+        if self.kind in HOST_AGG_KINDS:
+            return (host_cols[0].astype(np.int32),)   # dummy lane
         if self.kind == AggKind.APPROX_COUNT_DISTINCT:
             return tuple(hll_unpack(host_cols[0], host_cols[1]))
         return self.encode_acc(host_cols[0], host_cols[1])
@@ -335,7 +362,7 @@ def dev_layout(specs: Sequence[AggSpec]) -> List[Tuple[np.dtype, object]]:
 
 def n_input_lanes(spec: AggSpec) -> int:
     """Device input lanes per row for this call (encode_input arity)."""
-    if spec.kind == AggKind.COUNT:
+    if spec.kind == AggKind.COUNT or spec.kind in HOST_AGG_KINDS:
         return 0
     if spec.kind == AggKind.SUM:
         return 2 if spec.is_float_sum else lanes.N_LIMBS
@@ -397,6 +424,8 @@ def _update_call(spec: AggSpec, accs: List[jnp.ndarray], sl: slice,
     """Trace one call's accumulator updates in place (list mutation)."""
     live = vis & valid_ok
     scat = jnp.where(live, slots, cap)
+    if spec.kind in HOST_AGG_KINDS:
+        return                              # host path owns the value
     if spec.kind == AggKind.COUNT:
         accs[sl.start] = accs[sl.start].at[scat].add(sign, mode="drop")
         return
@@ -756,8 +785,9 @@ class FlushResult:
         z = np.zeros(0, dtype=np.int64)
         zb = np.zeros(0, dtype=bool)
         vals = [np.zeros(0, dtype=s.out_dtype) for s in specs]
-        nns = [None if s.kind in (AggKind.COUNT,
-                                  AggKind.APPROX_COUNT_DISTINCT)
+        nns = [None if (s.kind in (AggKind.COUNT,
+                                   AggKind.APPROX_COUNT_DISTINCT)
+                        or s.kind in HOST_AGG_KINDS)
                else z.copy() for s in specs]
         return FlushResult(
             0, np.zeros((0, key_width), dtype=np.int32), z.copy(),
@@ -814,8 +844,10 @@ def decode_flush_data(specs: Sequence[AggSpec], key_width: int,
 def _nns_of(specs, dev_cols) -> List[Optional[np.ndarray]]:
     out = []
     for s, sl in zip(specs, _call_slices(specs)):
-        out.append(None if s.kind in (AggKind.COUNT,
-                                      AggKind.APPROX_COUNT_DISTINCT)
+        plain = s.kind in (AggKind.COUNT,
+                           AggKind.APPROX_COUNT_DISTINCT) \
+            or s.kind in HOST_AGG_KINDS
+        out.append(None if plain
                    else dev_cols[sl][-1].astype(np.int64))
     return out
 

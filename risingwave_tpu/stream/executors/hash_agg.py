@@ -34,7 +34,7 @@ from risingwave_tpu.common.chunk import (
 )
 from risingwave_tpu.common.types import DataType, Field, Schema
 from risingwave_tpu.ops.hash_agg import (
-    AggKind, AggSpec, GroupedAggKernel, acc_dtypes,
+    HOST_AGG_KINDS, AggKind, AggSpec, GroupedAggKernel, acc_dtypes,
 )
 from risingwave_tpu.state.state_table import StateTable
 from risingwave_tpu.stream.executor import Executor, ExecutorInfo
@@ -64,11 +64,17 @@ class AggCall:
     # so each distinct value contributes once. MIN/MAX ignore it
     # (semantically identity).
     distinct: bool = False
+    # string_agg separator (ignored by other kinds)
+    delimiter: str = ","
 
     def out_type(self, input_schema: Schema) -> DataType:
         if self.kind in (AggKind.COUNT,
                          AggKind.APPROX_COUNT_DISTINCT):
             return DataType.INT64
+        if self.kind == AggKind.STRING_AGG:
+            return DataType.VARCHAR
+        if self.kind == AggKind.ARRAY_AGG:
+            return DataType.LIST
         in_t = input_schema[self.input_idx].data_type
         if self.kind == AggKind.SUM:
             try:
@@ -81,6 +87,8 @@ class AggCall:
         if self.kind == AggKind.COUNT and self.input_idx is None:
             return AggSpec(AggKind.COUNT, None)
         in_t = input_schema[self.input_idx].data_type
+        if self.kind in HOST_AGG_KINDS:
+            return AggSpec(self.kind, np.dtype(object))
         if not in_t.is_device:
             raise TypeError(f"agg over host type {in_t} needs the host path")
         return AggSpec(self.kind, np.dtype(in_t.np_dtype))
@@ -199,6 +207,16 @@ class HashAggExecutor(Executor):
             return s.key_codec.interner_nbytes() + distinct + pend
 
         _mem.GLOBAL.register(mem_name, _nbytes)
+        # host aggs (string_agg/array_agg) always need the value
+        # multiset — their output IS the multiset
+        self._host_calls = [j for j, s in enumerate(self.specs)
+                            if s.kind in HOST_AGG_KINDS]
+        missing_h = [j for j in self._host_calls if j not in self.minput]
+        if missing_h:
+            raise ValueError(
+                f"{[self.specs[j].kind.value for j in missing_h]} need "
+                "materialized-input state tables — pass minput_tables "
+                "(see minput_state_schema)")
         if not append_only:
             need = [j for j, s in enumerate(self.specs)
                     if s.kind in (AggKind.MIN, AggKind.MAX)]
@@ -271,7 +289,7 @@ class HashAggExecutor(Executor):
     # -- per-(group, value) multisets (minput + distinct) ----------------
     def _multiset_groups(self, chunk: StreamChunk, key_lanes: np.ndarray,
                          signs: np.ndarray, ok: np.ndarray,
-                         input_idx: int):
+                         input_idx: int, vals_override=None):
         """Vectorized grouping of visible rows by (group key, value).
 
         Returns (rows, inverse, n_uniq, deltas, key_tuple_fn, order,
@@ -284,11 +302,24 @@ class HashAggExecutor(Executor):
         if not len(rows):
             return None
         c = chunk.columns[input_idx]
-        vals = np.asarray(c.values)
+        vals = vals_override if vals_override is not None \
+            else np.asarray(c.values)
         comp = np.empty((len(rows), key_lanes.shape[1] + 1),
                         dtype=np.int64)
         comp[:, :key_lanes.shape[1]] = key_lanes[rows]
-        comp[:, -1] = to_i64(vals[rows])
+        if vals.dtype == object:
+            # host-typed values (string_agg/array_agg): EXACT local
+            # interning for the grouping image only — ids live for this
+            # call alone, so nothing accumulates across the stream (a
+            # hash image could merge distinct values; np.unique cannot
+            # sort mixed None/str)
+            local: Dict[object, int] = {}
+            comp[:, -1] = np.fromiter(
+                (local.setdefault(v, len(local))
+                 for v in vals[rows].tolist()),
+                dtype=np.int64, count=len(rows))
+        else:
+            comp[:, -1] = to_i64(vals[rows])
         _uniq, inverse = np.unique(comp, axis=0, return_inverse=True)
         n_uniq = int(inverse.max()) + 1
         deltas = np.zeros(n_uniq, dtype=np.int64)
@@ -326,10 +357,23 @@ class HashAggExecutor(Executor):
         for j in self.minput:
             call = self.agg_calls[j]
             c = chunk.columns[call.input_idx]
-            ok = vis if c.validity is None \
-                else vis & np.asarray(c.validity)
+            vals_override = None
+            if call.kind == AggKind.ARRAY_AGG:
+                # pg array_agg PRESERVES NULL elements: feed them into
+                # the multiset (string_agg and MIN/MAX skip NULLs); a
+                # device-typed column needs an object view so NULL
+                # slots carry None instead of buffer fill
+                ok = vis
+                if c.validity is not None and c.data_type.is_device:
+                    vo = np.asarray(c.values).astype(object)
+                    vo[~np.asarray(c.validity)] = None
+                    vals_override = vo
+            else:
+                ok = vis if c.validity is None \
+                    else vis & np.asarray(c.validity)
             ms = self._multiset_groups(chunk, key_lanes, signs, ok,
-                                       call.input_idx)
+                                       call.input_idx,
+                                       vals_override=vals_override)
             if ms is None:
                 continue
             _rows, _inv, n_uniq, deltas, key_tuple, _o, _s = ms
@@ -479,6 +523,13 @@ class HashAggExecutor(Executor):
         _METRICS.agg_dirty_groups.set(fr.n, executor=self.identity)
         _METRICS.agg_table_capacity.set(self.kernel.capacity,
                                         executor=self.identity)
+        gk = None
+        host_prev = None
+        if self._host_calls and fr.n:
+            # host-agg PREV outputs come from the multiset tables as
+            # of the LAST barrier — read before this epoch's writes
+            gk = self._group_key_host(fr.keys)
+            host_prev = self._host_agg_outputs(fr, gk)
         if self.minput:
             self._write_minput_pending()
         if self._distinct_pending:
@@ -488,9 +539,15 @@ class HashAggExecutor(Executor):
             self._deleted_lanes.clear()
             self.kernel.advance()
             return None
-        gk = self._group_key_host(fr.keys)   # decode key lanes once
+        if gk is None:
+            gk = self._group_key_host(fr.keys)   # decode lanes once
         if self.minput and self._deleted_lanes:
             self._recompute_extremes(fr, gk)
+        if self._host_calls:
+            host_new = self._host_agg_outputs(fr, gk)
+            for j in self._host_calls:
+                fr.prev_outs[j], fr.prev_nulls[j] = host_prev[j]
+                fr.outs[j], fr.nulls[j] = host_new[j]
         self._deleted_lanes.clear()
         outs, nulls = fr.outs, fr.nulls
         pouts, pnulls = fr.prev_outs, fr.prev_nulls
@@ -563,6 +620,8 @@ class HashAggExecutor(Executor):
                 None if not ok[r] else vals[r].item()
                 for vals, ok in gk)
             for j, table in self.minput.items():
+                if self.specs[j].kind in HOST_AGG_KINDS:
+                    continue       # host outputs recompute separately
                 is_max = self.specs[j].kind == AggKind.MAX
                 best = None
                 for _pk, row in table.iter_prefix(group):
@@ -577,9 +636,43 @@ class HashAggExecutor(Executor):
                     fr.outs[j][r] = best
                     fr.nulls[j][r] = False
         decoded = [
-            (fr.outs[j], fr.nns[j]) if j in self.minput else None
+            (fr.outs[j], fr.nns[j])
+            if j in self.minput
+            and self.specs[j].kind not in HOST_AGG_KINDS else None
             for j in range(len(self.specs))]
         self.kernel.patch_accs(decoded, raw_accs=fr.raw_accs)
+
+    def _host_agg_outputs(self, fr, gk):
+        """string_agg/array_agg outputs for the flushed groups, read
+        from the value multisets. Values compose in VALUE order (the
+        multiset has no arrival order and pg leaves the order
+        unspecified without an in-agg ORDER BY; value order is the
+        deterministic, recovery-stable choice)."""
+        out: Dict[int, tuple] = {}
+        for j in self._host_calls:
+            call = self.agg_calls[j]
+            table = self.minput[j]
+            vals_col = np.empty(fr.n, dtype=object)
+            nulls_col = np.zeros(fr.n, dtype=bool)
+            for r in range(fr.n):
+                group = tuple(
+                    None if not ok[r] else
+                    (vals[r].item() if hasattr(vals[r], "item")
+                     else vals[r])
+                    for vals, ok in gk)
+                items: List = []
+                for _pk, row in table.iter_prefix(group):
+                    v, cnt = row[-2], int(row[-1])
+                    items.extend([v] * cnt)
+                if not items:
+                    nulls_col[r] = True
+                elif call.kind == AggKind.STRING_AGG:
+                    vals_col[r] = call.delimiter.join(
+                        str(v) for v in items if v is not None)
+                else:                # ARRAY_AGG keeps NULL elements
+                    vals_col[r] = tuple(items)
+            out[j] = (vals_col, nulls_col)
+        return out
 
     def _state_rows(self, fr, gk, idx: np.ndarray,
                     prev: bool) -> List[tuple]:
