@@ -139,6 +139,9 @@ class CreateSource:
 class CreateMaterializedView:
     name: str
     select: Select
+    # EMIT ON WINDOW CLOSE: results emit once, when the watermark
+    # passes the window column (default: emit-on-update changelog)
+    emit_on_window_close: bool = False
 
 
 @dataclass

@@ -33,7 +33,7 @@ KEYWORDS = {
     "milliseconds", "case", "when", "then", "else", "end", "cast",
     "sink", "sinks", "left", "right", "full", "outer", "distinct",
     "explain", "over", "partition", "alter", "set", "parallelism",
-    "for",
+    "for", "emit", "window", "close",
 }
 
 # keywords that can never start a primary expression (a column named
@@ -158,7 +158,15 @@ class Parser:
         if self._kw("create", "materialized", "view"):
             name = self._ident()
             self._expect_kw("as")
-            return ast.CreateMaterializedView(name, self._select())
+            sel = self._select()
+            eowc = False
+            if self._kw("emit"):
+                self._expect_kw("on")
+                self._expect_kw("window")
+                self._expect_kw("close")
+                eowc = True
+            return ast.CreateMaterializedView(
+                name, sel, emit_on_window_close=eowc)
         if self._kw("create", "sink"):
             name = self._ident()
             self._expect_kw("as")

@@ -407,7 +407,10 @@ def _pg_list(v) -> str:
         if x is None:
             parts.append("NULL")
             continue
-        s = str(x)
+        # element type is unknown (LIST carries none yet): scalar
+        # formatting handles bool/nested; physical time ints pass
+        # through un-rendered until LIST gains an element type
+        s = _pg_text(x)
         if s == "" or s.upper() == "NULL" or any(
                 c in s for c in ',{}"\\ '):
             s = s.replace("\\", "\\\\").replace('"', '\\"')

@@ -227,6 +227,26 @@ class StreamPlanner:
             min_chunks_per_barrier=min_chunks)
         self.readers[sid] = reader
         scope = Scope.of(obj.schema, alias)
+        # event-time watermarks from SQL: WITH (watermark.column='ts',
+        # watermark.delay='4 seconds') — the WATERMARK FOR clause's
+        # role (source/watermark.rs), driving state cleaning and EOWC
+        wm_col_name = obj.options.get("watermark.column")
+        wm_idx = None
+        self._wm_scope_cols = set()
+        if wm_col_name:
+            from risingwave_tpu.stream.executors.watermark_filter \
+                import WATERMARK_STATE_SCHEMA, WatermarkFilterExecutor
+            wm_idx, wdt = scope.find(wm_col_name, None)
+            if wdt not in (DataType.TIMESTAMP, DataType.TIMESTAMPTZ):
+                raise PlanError(
+                    "watermark.column must be a timestamp")
+            delay = _parse_interval_opt(
+                obj.options.get("watermark.delay", "0 seconds"))
+            wm_state = StateTable(self.catalog.next_id(),
+                                  WATERMARK_STATE_SCHEMA, [0],
+                                  self.store)
+            ex = WatermarkFilterExecutor(ex, wm_idx, delay, wm_state)
+            self._wm_scope_cols.add(wm_idx)
         if isinstance(item, ast.Tumble):
             idx, dt = scope.find(item.time_col, None)
             if dt not in (DataType.TIMESTAMP, DataType.TIMESTAMPTZ):
@@ -237,7 +257,17 @@ class StreamPlanner:
             exprs.append(tumble_start(InputRef(idx, dt),
                                       Interval(usecs=item.window_usecs)))
             names.append("window_start")
-            ex = ProjectExecutor(ex, exprs, names)
+            derivs = {}
+            if wm_idx is not None:
+                # identity for the raw column; floor for window_start
+                derivs[wm_idx] = wm_idx
+                if wm_idx == idx:
+                    w = item.window_usecs
+                    derivs[idx] = (len(exprs) - 1,
+                                   (lambda v, _w=w: v - v % _w))
+                    self._wm_scope_cols.add(len(exprs) - 1)
+            ex = ProjectExecutor(ex, exprs, names,
+                                 watermark_derivations=derivs)
             scope = Scope(ex.schema,
                           scope.qualifiers + [alias])
         elif isinstance(item, ast.Hop):
@@ -297,10 +327,29 @@ class StreamPlanner:
     # -- the main plan ---------------------------------------------------
     def plan(self, name: str, sel: ast.Select, actor_id: int,
              rate_limit: Optional[int] = 8,
-             min_chunks: Optional[int] = None) -> StreamPlan:
+             min_chunks: Optional[int] = None,
+             emit_on_window_close: bool = False) -> StreamPlan:
         self._actor_id = actor_id
+        self._eowc_wm_col = None
         ex, pk, deps = self._plan_query(sel, actor_id, rate_limit,
                                         min_chunks)
+        if emit_on_window_close:
+            # gate results behind the window watermark (sort_buffer.rs
+            # / AggGroup::create_eowc semantics as a downstream gate)
+            from risingwave_tpu.stream.executors.eowc import (
+                EowcGateExecutor,
+            )
+            wm_col = self._eowc_wm_col
+            if wm_col is None:
+                raise PlanError(
+                    "EMIT ON WINDOW CLOSE needs a windowed GROUP BY "
+                    "whose first group key is projected and carries a "
+                    "watermark (e.g. TUMBLE window_start)")
+            gate_pk = [wm_col] + [p for p in pk if p != wm_col]
+            gate_state = StateTable(self.catalog.next_id(), ex.schema,
+                                    gate_pk, self.store)
+            ex = EowcGateExecutor(ex, wm_col, gate_state,
+                                  actor_id=actor_id)
         mv_table = StateTable(self.catalog.next_id(), ex.schema, pk,
                               self.store)
         mat = MaterializeExecutor(ex, mv_table)
@@ -436,6 +485,9 @@ class StreamPlanner:
             ex = left
             scope = lscope
             join_pk_cols = list(ex.pk_indices)
+            # join output watermark indices are combined/re-based; the
+            # EOWC feed proof does not track through joins yet
+            self._wm_scope_cols = set()
         for c in conjuncts:
             ex = FilterExecutor(ex, Binder(scope).bind(c))
         projections = _expand_star(sel.projections, scope)
@@ -450,8 +502,19 @@ class StreamPlanner:
             ex, bound = self._plan_over_window(ex, binder, bound)
         if binder.agg_calls or sel.group_by:
             ex, out_exprs = self._plan_agg(ex, scope, sel, binder, bound)
-            ex = ProjectExecutor(ex, out_exprs, names)
+            # plain group-key outputs carry the agg's watermarks (the
+            # EOWC gate and downstream window ops depend on them)
+            derivs = {e.index: j for j, e in enumerate(out_exprs)
+                      if isinstance(e, InputRef)}
+            ex = ProjectExecutor(ex, out_exprs, names,
+                                 watermark_derivations=derivs)
             pk = _agg_output_pk(sel, out_exprs)
+            # EOWC window column: the first group key that PROVABLY
+            # carries a watermark all the way from the source (a gate
+            # with no watermark feed would hold results forever)
+            self._eowc_wm_col = next(
+                (derivs[pos] for pos in self._agg_wm_positions
+                 if pos in derivs), None)
         else:
             exprs = list(bound)
             base_pk = list(ex.pk_indices)
@@ -615,7 +678,17 @@ class StreamPlanner:
             remapped.append(AggCall(call.kind, in_expr_idx[k],
                                     distinct=call.distinct,
                                     delimiter=call.delimiter))
-        pre = ProjectExecutor(ex, pre_exprs, pre_names)
+        # plain-column group keys pass their watermarks through the
+        # pre-agg projection (EOWC and agg state cleaning need them)
+        pre_derivs = {e.index: j for j, e in enumerate(group_bound)
+                      if isinstance(e, InputRef)}
+        pre = ProjectExecutor(ex, pre_exprs, pre_names,
+                              watermark_derivations=pre_derivs)
+        # group positions fed by a source watermark (EOWC validation)
+        wm_cols = getattr(self, "_wm_scope_cols", set())
+        self._agg_wm_positions = [
+            pos for pos, gb in enumerate(group_bound)
+            if isinstance(gb, InputRef) and gb.index in wm_cols]
         g = len(group_bound)
         calls = remapped
         sch, agg_pk = agg_state_schema(pre.schema, list(range(g)), calls)
@@ -629,21 +702,6 @@ class StreamPlanner:
         from risingwave_tpu.stream.executors.hash_agg import (
             AggKind, minput_state_schema,
         )
-        kernel = None
-        if self.mesh is not None and append_only:
-            # parallel plan: the hash exchange that the reference's
-            # fragmenter inserts before a parallel agg
-            # (stream_fragmenter/mod.rs:199, dispatch.rs:582) is the
-            # sharded kernel's in-program all_to_all. Retracting
-            # upstreams stay on the single-chip kernel: the sharded
-            # kernel's retractable MIN/MAX is not implemented yet
-            # (parallel/agg.py), and a wrong parallel answer is worse
-            # than a correct serial one.
-            from risingwave_tpu.parallel.agg import ShardedAggKernel
-            from risingwave_tpu.stream.executors.keys import LANES_PER_KEY
-            kernel = ShardedAggKernel(
-                self.mesh, key_width=LANES_PER_KEY * g,
-                specs=[c.spec(pre.schema) for c in calls])
         distinct_tables = {}
         for c in calls:
             if c.distinct and c.input_idx not in distinct_tables:
@@ -664,6 +722,22 @@ class StreamPlanner:
                 minput_tables[j] = StateTable(
                     self.catalog.next_id(), msch, mpk, self.store,
                     dist_key_indices=mdk)
+        kernel = None
+        if self.mesh is not None and append_only and not minput_tables:
+            # parallel plan: the hash exchange that the reference's
+            # fragmenter inserts before a parallel agg
+            # (stream_fragmenter/mod.rs:199, dispatch.rs:582) is the
+            # sharded kernel's in-program all_to_all. Retracting
+            # upstreams and minput-backed calls (retractable MIN/MAX,
+            # string_agg/array_agg) stay on the single-chip kernel —
+            # a wrong parallel answer is worse than a correct serial
+            # one. NOTE: this block allocates no catalog ids, so its
+            # position does not disturb the id-base replay contract.
+            from risingwave_tpu.parallel.agg import ShardedAggKernel
+            from risingwave_tpu.stream.executors.keys import LANES_PER_KEY
+            kernel = ShardedAggKernel(
+                self.mesh, key_width=LANES_PER_KEY * g,
+                specs=[c.spec(pre.schema) for c in calls])
         agg = HashAggExecutor(pre, list(range(g)), calls, table,
                               append_only=append_only, kernel=kernel,
                               minput_tables=minput_tables,
@@ -717,6 +791,26 @@ def _agg_output_pk(sel: ast.Select, out_exprs) -> List[int]:
         raise PlanError("every GROUP BY key must appear in the MV's "
                         "SELECT list (it is the MV primary key)")
     return pk
+
+
+_INTERVAL_UNITS_OPT = {
+    "second": 1_000_000, "seconds": 1_000_000,
+    "millisecond": 1_000, "milliseconds": 1_000,
+    "minute": 60_000_000, "minutes": 60_000_000,
+    "hour": 3_600_000_000, "hours": 3_600_000_000,
+}
+
+
+def _parse_interval_opt(s: str) -> Interval:
+    """'4 seconds' / '500 milliseconds' / raw µs number → Interval."""
+    s = str(s).strip()
+    parts = s.split()
+    if len(parts) == 2 and parts[1].lower() in _INTERVAL_UNITS_OPT:
+        return Interval(
+            usecs=int(parts[0]) * _INTERVAL_UNITS_OPT[parts[1].lower()])
+    if s.isdigit():
+        return Interval(usecs=int(s))
+    raise PlanError(f"bad interval option {s!r}")
 
 
 def _flatten_and(e: ast.Expr) -> List[ast.Expr]:

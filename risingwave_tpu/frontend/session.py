@@ -318,9 +318,12 @@ class Frontend:
             self._next_actor += 1
             id_base = self.catalog._next_id
             try:
-                plan = planner.plan(stmt.name, stmt.select, actor_id,
-                                    rate_limit=self.rate_limit,
-                                    min_chunks=self.min_chunks)
+                plan = planner.plan(
+                    stmt.name, stmt.select, actor_id,
+                    rate_limit=self.rate_limit,
+                    min_chunks=self.min_chunks,
+                    emit_on_window_close=getattr(
+                        stmt, "emit_on_window_close", False))
             except BaseException:
                 # a failed plan must leak nothing: source senders were
                 # registered during planning and would wedge the next

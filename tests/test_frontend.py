@@ -575,3 +575,67 @@ def test_hop_window_sql_oracle():
             want[(a, base - i * S)] += 1
     got = Counter({(a, w): c for a, w, c in rows})
     assert got == want
+
+
+def test_emit_on_window_close_sql():
+    """EOWC (sort_buffer.rs / AggGroup::create_eowc semantics): each
+    window emits ONCE when the watermark passes it, oracle-exact, and
+    never mutates after release. Watermarks come from the SQL surface
+    (WITH watermark.column/watermark.delay)."""
+    import numpy as np
+
+    from risingwave_tpu.connectors.nexmark import NexmarkConfig, gen_bids
+
+    async def run():
+        fe = Frontend(min_chunks=4)
+        await fe.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', "
+            "nexmark.table.type='bid', nexmark.event.num=4000, "
+            "nexmark.max.chunk.size=256, "
+            "watermark.column='date_time', "
+            "watermark.delay='0 seconds')")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW w AS SELECT window_start, "
+            "max(price) AS m, count(*) AS c FROM TUMBLE(bid, "
+            "date_time, INTERVAL '100' MILLISECONDS) GROUP BY "
+            "window_start EMIT ON WINDOW CLOSE")
+        views = []
+        for _ in range(12):
+            await fe.step()
+            views.append(sorted(await fe.execute("SELECT * FROM w")))
+        await fe.close()
+        return views
+
+    views = asyncio.run(run())
+    seen = {}
+    for v in views:
+        for w, m, c in v:
+            assert seen.get(w, (m, c)) == (m, c), "released row mutated"
+            seen[w] = (m, c)
+    cfg = NexmarkConfig(event_num=4000, max_chunk_size=256)
+    bids = gen_bids(np.arange(4000 * 46 // 50, dtype=np.int64), cfg)
+    want = {}
+    W = 100_000
+    for t, p in zip(bids["date_time"].tolist(),
+                    bids["price"].tolist()):
+        w0 = t // W * W
+        mx, c = want.get(w0, (0, 0))
+        want[w0] = (max(mx, p), c + 1)
+    assert all(want[w] == v for w, v in seen.items())
+    # every closed window released; the open tail window is withheld
+    assert len(seen) == len(want) - 1
+
+
+def test_eowc_without_watermark_rejected():
+    async def run():
+        fe = Frontend(min_chunks=4)
+        await fe.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', "
+            "nexmark.table.type='bid', nexmark.event.num=1000)")
+        with pytest.raises(Exception, match="WINDOW CLOSE"):
+            await fe.execute(
+                "CREATE MATERIALIZED VIEW w AS SELECT auction FROM "
+                "bid EMIT ON WINDOW CLOSE")
+        await fe.close()
+
+    asyncio.run(run())
