@@ -102,7 +102,16 @@ class Hop:
     alias: Optional[str] = None
 
 
-FromItem = object            # TableRef | Tumble | Hop
+@dataclass
+class TableFn:
+    """FROM-clause table function: generate_series(...) etc."""
+
+    name: str
+    args: List[Expr]
+    alias: Optional[str] = None
+
+
+FromItem = object            # TableRef | Tumble | Hop | TableFn
 
 
 @dataclass

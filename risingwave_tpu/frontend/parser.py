@@ -356,6 +356,16 @@ class Parser:
             return ast.Hop(table, time_col, slide.usecs, size.usecs,
                            alias)
         name = self._ident()
+        if self._op("("):
+            # FROM-clause table function: generate_series(a, b [, s])
+            args = []
+            if not self._op(")"):
+                args.append(self._expr())
+                while self._op(","):
+                    args.append(self._expr())
+                self._expect_op(")")
+            fn_alias = self._ident() if self._kw("as") else None
+            return ast.TableFn(name.lower(), args, fn_alias)
         alias = None
         if self._kw("as"):
             alias = self._ident()

@@ -53,8 +53,12 @@ class PgServer:
     All connections share the session's catalog and barrier loop (the
     reference shares via meta; we share in-process)."""
 
-    def __init__(self, frontend: Frontend):
+    def __init__(self, frontend: Frontend,
+                 password: Optional[str] = None):
         self.frontend = frontend
+        # cleartext password auth (pg_protocol.rs startup handshake;
+        # AuthenticationCleartextPassword). None ⇒ trust (no auth).
+        self.password = password
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def serve(self, host: str = "127.0.0.1", port: int = 4566):
@@ -309,6 +313,21 @@ class PgServer:
                 return False
             await reader.readexactly(ln - 8)  # user/database params
             break
+        if self.password is not None:
+            # AuthenticationCleartextPassword → expect PasswordMessage
+            writer.write(_msg(b"R", struct.pack(">I", 3)))
+            await writer.drain()
+            hdr = await reader.readexactly(5)
+            if hdr[0:1] != b"p":
+                writer.write(_error("expected PasswordMessage"))
+                await writer.drain()
+                return False
+            ln = struct.unpack(">I", hdr[1:5])[0]
+            pw = (await reader.readexactly(ln - 4)).rstrip(b"\x00")
+            if pw.decode(errors="replace") != self.password:
+                writer.write(_error("password authentication failed"))
+                await writer.drain()
+                return False
         out = _msg(b"R", struct.pack(">I", 0))       # AuthenticationOk
         for k, v in (("server_version", "13.0 (risingwave-tpu)"),
                      ("client_encoding", "UTF8"),

@@ -259,12 +259,15 @@ class StreamPlanner:
             names.append("window_start")
             derivs = {}
             if wm_idx is not None:
-                # identity for the raw column; floor for window_start
-                derivs[wm_idx] = wm_idx
+                # identity for the raw column AND (when it is the
+                # tumble column) the floored window_start image — one
+                # input watermark derives both outputs
+                derivs[wm_idx] = [wm_idx]
                 if wm_idx == idx:
                     w = item.window_usecs
-                    derivs[idx] = (len(exprs) - 1,
-                                   (lambda v, _w=w: v - v % _w))
+                    derivs[idx].append(
+                        (len(exprs) - 1,
+                         (lambda v, _w=w: v - v % _w)))
                     self._wm_scope_cols.add(len(exprs) - 1)
             ex = ProjectExecutor(ex, exprs, names,
                                  watermark_derivations=derivs)
@@ -793,21 +796,15 @@ def _agg_output_pk(sel: ast.Select, out_exprs) -> List[int]:
     return pk
 
 
-_INTERVAL_UNITS_OPT = {
-    "second": 1_000_000, "seconds": 1_000_000,
-    "millisecond": 1_000, "milliseconds": 1_000,
-    "minute": 60_000_000, "minutes": 60_000_000,
-    "hour": 3_600_000_000, "hours": 3_600_000_000,
-}
-
-
 def _parse_interval_opt(s: str) -> Interval:
-    """'4 seconds' / '500 milliseconds' / raw µs number → Interval."""
+    """'4 seconds' / '500 milliseconds' / raw µs number → Interval.
+    Shares the SQL parser's unit table (one source of truth)."""
+    from risingwave_tpu.frontend.parser import _INTERVAL_UNITS
     s = str(s).strip()
     parts = s.split()
-    if len(parts) == 2 and parts[1].lower() in _INTERVAL_UNITS_OPT:
+    if len(parts) == 2 and parts[1].lower() in _INTERVAL_UNITS:
         return Interval(
-            usecs=int(parts[0]) * _INTERVAL_UNITS_OPT[parts[1].lower()])
+            usecs=int(parts[0]) * _INTERVAL_UNITS[parts[1].lower()])
     if s.isdigit():
         return Interval(usecs=int(s))
     raise PlanError(f"bad interval option {s!r}")
@@ -908,6 +905,40 @@ def plan_batch(sel: ast.Select, catalog: Catalog, store, epoch: int):
     )
 
     def scan(item) -> Tuple[object, Scope]:
+        if isinstance(item, ast.TableFn):
+            # table functions (src/expr/src/table_function/ parity:
+            # generate_series); evaluated to rows at plan time — args
+            # are constant expressions
+            if item.name != "generate_series":
+                raise PlanError(
+                    f"unknown table function {item.name!r}")
+            if len(item.args) not in (2, 3):
+                raise PlanError(
+                    "generate_series(start, stop [, step])")
+            binder = Binder(Scope.of(Schema([]), None))
+            vals = []
+            for a in item.args:
+                b = binder.bind(a)
+                from risingwave_tpu.expr.expr import Literal, UnaryOp
+                if isinstance(b, Literal):
+                    vals.append(int(b.value))
+                elif isinstance(b, UnaryOp) and b.op == "neg" and \
+                        isinstance(b.child, Literal):
+                    vals.append(-int(b.child.value))
+                else:
+                    raise PlanError(
+                        "generate_series arguments must be integer "
+                        "literals")
+            start, stop = vals[0], vals[1]
+            step = vals[2] if len(vals) == 3 else 1
+            if step == 0:
+                raise PlanError("generate_series step must be nonzero")
+            rows = [(v,) for v in range(start, stop + (1 if step > 0
+                                                       else -1), step)]
+            # pg: the alias names BOTH the table and the single column
+            col = item.alias or "generate_series"
+            sch = Schema([Field(col, DataType.INT64)])
+            return (BatchValues(sch, rows), Scope.of(sch, col))
         if not isinstance(item, ast.TableRef):
             raise PlanError("batch FROM supports tables/MVs")
         obj = catalog.resolve(item.name)

@@ -336,7 +336,8 @@ class Frontend:
                 stmt.name, actor_id, plan.consumer, plan.readers,
                 lambda: self.catalog.add_mv(plan.mv),
                 attaches=plan.attaches)
-        self._mv_selects[stmt.name] = stmt.select
+        self._mv_selects[stmt.name] = (
+            stmt.select, getattr(stmt, "emit_on_window_close", False))
         if self._deployed_actor.failure is not None:
             raise self._deployed_actor.failure
         return "CREATE_MATERIALIZED_VIEW"
@@ -365,10 +366,11 @@ class Frontend:
                 "supported yet")
         if mv.id_base < 0:
             raise PlanError(f"{name!r} predates reschedule support")
-        sel = self._mv_selects.get(name)
-        if sel is None:
+        stored = self._mv_selects.get(name)
+        if stored is None:
             raise PlanError(f"no CREATE statement on record for "
                             f"{name!r}")
+        sel, eowc = stored
         mesh = self._mesh_for(n)
         async with self._barrier_lock:
             # 1) stop this job's actors at a barrier (keep state +
@@ -389,9 +391,13 @@ class Frontend:
                 actor_id = self._next_actor
                 self._next_actor += 1
                 try:
+                    # same flags as the CREATE: the id-base replay
+                    # contract requires the identical allocation
+                    # sequence (an EOWC gate allocates a table id)
                     plan = planner.plan(name, sel, actor_id,
                                         rate_limit=self.rate_limit,
-                                        min_chunks=self.min_chunks)
+                                        min_chunks=self.min_chunks,
+                                        emit_on_window_close=eowc)
                 except BaseException:
                     for sid in planner.registered_senders:
                         self.local.drop_actor(sid)

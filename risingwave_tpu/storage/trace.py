@@ -1,0 +1,134 @@
+"""Storage trace: record state-store operations, replay + verify.
+
+Reference parity: src/storage/hummock_trace/ (risingwave_hummock_trace)
+— a recording layer over the state-store API plus a replay tool that
+re-executes the trace against a fresh store and verifies every read
+returns byte-identical results. Used the same way: capture a failing
+workload's storage interaction once, then replay it deterministically
+(no stream, no timing) to bisect storage bugs.
+
+Records are JSONL-able dicts; values are host row tuples (bytes hex-
+tagged so the encoding is lossless and diffable).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterator, List, Optional, Tuple
+
+from risingwave_tpu.state.store import StateStore, Value
+
+
+def _enc_val(v):
+    if isinstance(v, bytes):
+        return {"__b": v.hex()}
+    if isinstance(v, tuple):
+        return {"__t": [_enc_val(x) for x in v]}
+    return v
+
+
+def _dec_val(v):
+    if isinstance(v, dict):
+        if "__b" in v:
+            return bytes.fromhex(v["__b"])
+        if "__t" in v:
+            return tuple(_dec_val(x) for x in v["__t"])
+    return v
+
+
+class TracingStateStore(StateStore):
+    """Record every store op + read result (hummock_trace recorder)."""
+
+    def __init__(self, inner: StateStore):
+        self.inner = inner
+        self.records: List[dict] = []
+
+    # -- write path -------------------------------------------------------
+    def ingest_batch(self, table_id, batch, epoch) -> int:
+        batch = list(batch)
+        self.records.append({
+            "op": "ingest", "table": table_id, "epoch": epoch,
+            "batch": [[k.hex(), _enc_val(v)] for k, v in batch]})
+        return self.inner.ingest_batch(table_id, batch, epoch)
+
+    def seal_epoch(self, epoch, is_checkpoint=True) -> None:
+        self.records.append({"op": "seal", "epoch": epoch,
+                             "ckpt": bool(is_checkpoint)})
+        self.inner.seal_epoch(epoch, is_checkpoint)
+
+    def sync(self, epoch) -> dict:
+        self.records.append({"op": "sync", "epoch": epoch})
+        return self.inner.sync(epoch)
+
+    def committed_epoch(self) -> int:
+        return self.inner.committed_epoch()
+
+    # -- read path (results recorded for replay verification) -------------
+    def get(self, table_id, key, epoch) -> Value:
+        v = self.inner.get(table_id, key, epoch)
+        self.records.append({"op": "get", "table": table_id,
+                             "key": key.hex(), "epoch": epoch,
+                             "result": _enc_val(v)})
+        return v
+
+    def iter(self, table_id, epoch, start=None, end=None
+             ) -> Iterator[Tuple[bytes, tuple]]:
+        out = list(self.inner.iter(table_id, epoch, start, end))
+        self.records.append({
+            "op": "iter", "table": table_id, "epoch": epoch,
+            "start": None if start is None else start.hex(),
+            "end": None if end is None else end.hex(),
+            "result": [[k.hex(), _enc_val(v)] for k, v in out]})
+        return iter(out)
+
+    # -- persistence ------------------------------------------------------
+    def dump(self, path: str) -> int:
+        with open(path, "w") as f:
+            for r in self.records:
+                f.write(json.dumps(r) + "\n")
+        return len(self.records)
+
+
+def load_trace(path: str) -> List[dict]:
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def replay_trace(records, store: StateStore) -> List[dict]:
+    """Re-execute a trace against a FRESH store; every recorded read
+    must return identical results. Returns the mismatches (empty =
+    the storage layer is deterministic for this workload) — the
+    hummock_trace replay verifier."""
+    mismatches: List[dict] = []
+    for i, r in enumerate(records):
+        op = r["op"]
+        if op == "ingest":
+            store.ingest_batch(
+                r["table"],
+                [(bytes.fromhex(k), _dec_val(v))
+                 for k, v in r["batch"]], r["epoch"])
+        elif op == "seal":
+            store.seal_epoch(r["epoch"], r["ckpt"])
+        elif op == "sync":
+            store.sync(r["epoch"])
+        elif op == "get":
+            got = store.get(r["table"], bytes.fromhex(r["key"]),
+                            r["epoch"])
+            want = _dec_val(r["result"])
+            if got != want:
+                mismatches.append({"at": i, "op": "get",
+                                   "got": got, "want": want})
+        elif op == "iter":
+            got = list(store.iter(
+                r["table"], r["epoch"],
+                None if r["start"] is None
+                else bytes.fromhex(r["start"]),
+                None if r["end"] is None
+                else bytes.fromhex(r["end"])))
+            want = [(bytes.fromhex(k), _dec_val(v))
+                    for k, v in r["result"]]
+            if got != want:
+                mismatches.append({"at": i, "op": "iter",
+                                   "got_n": len(got),
+                                   "want_n": len(want)})
+    return mismatches

@@ -77,7 +77,10 @@ class EowcGateExecutor(Executor):
         end = encode_vnode_prefix(0) + encode_memcomparable([wm], [dt])
         rows = [row for _k, row in
                 self.state.iter_encoded_range(start, end)]
-        self._released = max(self._released or 0, wm)
+        # NOT `max(self._released or 0, wm)`: pre-1970 windows are
+        # negative, and clamping to 0 would fake violations
+        self._released = wm if self._released is None \
+            else max(self._released, wm)
         if not rows:
             return []
         self.state.delete_rows(rows)

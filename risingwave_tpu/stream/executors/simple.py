@@ -77,13 +77,16 @@ class ProjectExecutor(Executor):
                 yield StreamChunk(self.schema, cols, msg.visibility, msg.ops)
             elif isinstance(msg, Watermark):
                 d = self.watermark_derivations.get(msg.col_idx)
-                if d is not None:
-                    if isinstance(d, tuple):
-                        out_idx, fn = d
+                # one input watermark may derive SEVERAL outputs (the
+                # raw column plus a windowed image of it): list form
+                for one in (d if isinstance(d, list)
+                            else [] if d is None else [d]):
+                    if isinstance(one, tuple):
+                        out_idx, fn = one
                         yield Watermark(out_idx, msg.data_type,
                                         fn(msg.value))
                     else:
-                        yield msg.with_idx(d)
+                        yield msg.with_idx(one)
                 # underivable watermarks are dropped (reference behavior)
             else:
                 yield msg

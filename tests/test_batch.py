@@ -97,3 +97,26 @@ def test_scan_over_hummock():
     store.sync(_pair(2).prev.value)
     rows = collect(RowSeqScan(StorageTable.of(t), store.committed_epoch()))
     assert rows == [(1, 11, "a"), (2, 22, "b")]
+
+
+def test_generate_series_table_function():
+    """FROM-clause table function (src/expr/src/table_function/
+    generate_series parity), incl. alias-as-column and negative step."""
+    import asyncio
+
+    from risingwave_tpu.frontend.session import Frontend
+
+    async def run():
+        fe = Frontend()
+        r1 = await fe.execute("SELECT * FROM generate_series(1, 5)")
+        r2 = await fe.execute(
+            "SELECT g * 2 AS d FROM generate_series(10, 2, -3) AS g")
+        r3 = await fe.execute(
+            "SELECT count(*) FROM generate_series(1, 100)")
+        await fe.close()
+        return r1, r2, r3
+
+    r1, r2, r3 = asyncio.run(run())
+    assert [r[0] for r in r1] == [1, 2, 3, 4, 5]
+    assert [r[0] for r in r2] == [20, 14, 8]     # 2 unreachable (pg)
+    assert r3[0][0] == 100

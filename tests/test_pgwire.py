@@ -173,3 +173,58 @@ def test_pgwire_param_substitution_is_token_aware():
     assert sub("SELECT $1", [None]) == "SELECT NULL"
     assert sub("SELECT $1", ["O'Brien"]) == "SELECT 'O''Brien'"
     assert PgServer._param_count("SELECT $2 + '$9'") == 2
+
+
+def test_pgwire_cleartext_password_auth():
+    """AuthenticationCleartextPassword round trip: wrong password is
+    rejected, right password reaches ReadyForQuery (pg_protocol.rs
+    startup auth parity)."""
+    import struct
+
+    from risingwave_tpu.frontend.pgwire import PgServer
+    from risingwave_tpu.frontend.session import Frontend
+
+    async def run():
+        fe = Frontend()
+        srv = PgServer(fe, password="sekrit")
+        await srv.serve(port=0)
+        port = srv.port
+
+        async def attempt(pw):
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            payload = b"user\x00u\x00\x00"
+            w.write(struct.pack(">II", 8 + len(payload), 196608)
+                    + payload)
+            await w.drain()
+            hdr = await r.readexactly(5)
+            assert hdr[0:1] == b"R"
+            ln = struct.unpack(">I", hdr[1:5])[0]
+            code = struct.unpack(
+                ">I", await r.readexactly(ln - 4))[0]
+            assert code == 3            # cleartext password request
+            pwb = pw.encode() + b"\x00"
+            w.write(b"p" + struct.pack(">I", len(pwb) + 4) + pwb)
+            await w.drain()
+            tags = []
+            try:
+                while True:
+                    hdr = await r.readexactly(5)
+                    ln = struct.unpack(">I", hdr[1:5])[0]
+                    await r.readexactly(ln - 4)
+                    tags.append(hdr[0:1])
+                    if hdr[0:1] in (b"Z", b"E"):
+                        break
+            except asyncio.IncompleteReadError:
+                pass
+            w.close()
+            return tags
+
+        bad = await attempt("wrong")
+        good = await attempt("sekrit")
+        await srv.close()
+        await fe.close()
+        return bad, good
+
+    bad, good = asyncio.run(run())
+    assert b"E" in bad and b"Z" not in bad
+    assert good[-1] == b"Z"
