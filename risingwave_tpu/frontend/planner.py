@@ -726,15 +726,15 @@ class StreamPlanner:
                     self.catalog.next_id(), msch, mpk, self.store,
                     dist_key_indices=mdk)
         kernel = None
-        if self.mesh is not None and append_only and not minput_tables:
+        if self.mesh is not None:
             # parallel plan: the hash exchange that the reference's
             # fragmenter inserts before a parallel agg
             # (stream_fragmenter/mod.rs:199, dispatch.rs:582) is the
             # sharded kernel's in-program all_to_all. Retracting
-            # upstreams and minput-backed calls (retractable MIN/MAX,
-            # string_agg/array_agg) stay on the single-chip kernel —
-            # a wrong parallel answer is worse than a correct serial
-            # one. NOTE: this block allocates no catalog ids, so its
+            # upstreams shard too (signed scatters + sharded acc
+            # patching for minput MIN/MAX recompute); host aggs keep
+            # their executor-side multiset path under any kernel.
+            # NOTE: this block allocates no catalog ids, so its
             # position does not disturb the id-base replay contract.
             from risingwave_tpu.parallel.agg import ShardedAggKernel
             from risingwave_tpu.stream.executors.keys import LANES_PER_KEY

@@ -733,6 +733,28 @@ def build_advance():
     return jax.jit(advance_state, donate_argnums=(0,))
 
 
+def encode_patch_cols(specs: Sequence[AggSpec], decoded,
+                      raw_accs) -> List[np.ndarray]:
+    """Corrected (value, nn) pairs → device acc columns for a patch.
+
+    `decoded[j]` is (value, nn) for a corrected call, or None for an
+    untouched one — untouched calls pass their RAW gathered device
+    columns through bit-for-bit (re-encoding a float sum through the
+    decoded f64 would perturb the (hi, lo) pair). Shared by the
+    single-chip and sharded kernels so the encoding can never drift."""
+    slices = _call_slices(specs)
+    dev_cols: List[np.ndarray] = []
+    for j, (s, d) in enumerate(zip(specs, decoded)):
+        if d is None:
+            assert raw_accs is not None, \
+                "raw accs needed for passthrough"
+            dev_cols.extend(raw_accs[slices[j]])
+        else:
+            v, nn = d
+            dev_cols.extend(s.encode_acc(v, nn))
+    return dev_cols
+
+
 def build_patch(specs: Sequence[AggSpec]):
     """Compile the host→device acc patch (retractable MIN/MAX recompute
     writes corrected extremes back before the snapshot advances)."""
@@ -1028,22 +1050,10 @@ class GroupedAggKernel:
                    raw_accs: Optional[List[np.ndarray]] = None) -> None:
         """Overwrite flushed groups' accumulators (minput recompute).
 
-        `decoded[j]` is (value, nn) for a corrected call, or None for
-        an untouched one — untouched calls write back their RAW gathered
-        device columns bit-for-bit (re-encoding a float sum through the
-        decoded f64 would perturb the (hi, lo) pair)."""
+        See encode_patch_cols for the passthrough contract."""
         idx = self._flush_idx
         assert idx is not None and len(idx) > 0
-        slices = _call_slices(self.specs)
-        dev_cols: List[np.ndarray] = []
-        for j, (s, d) in enumerate(zip(self.specs, decoded)):
-            if d is None:
-                assert raw_accs is not None, \
-                    "raw accs needed for passthrough"
-                dev_cols.extend(raw_accs[slices[j]])
-                continue
-            v, nn = d
-            dev_cols.extend(s.encode_acc(v, nn))
+        dev_cols = encode_patch_cols(self.specs, decoded, raw_accs)
         pad = next_pow2(len(idx))
         idx_padded = np.full(pad, self.capacity, dtype=np.int32)
         idx_padded[:len(idx)] = idx

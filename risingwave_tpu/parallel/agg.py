@@ -151,6 +151,7 @@ class ShardedAggKernel:
         self._state_spec = jax.tree.map(lambda _: P(AXIS), self.state)
         self._advance_jit = self._shardwise(advance_state, donate=True)
         self._retire_jit = None        # built lazily (lane_off static)
+        self._patch_step = None        # built lazily (col count static)
         self._gather_cache: Dict[int, object] = {}
 
     def _shardwise(self, fn, donate: bool, out_spec=None, extra_specs=()):
@@ -344,9 +345,41 @@ class ShardedAggKernel:
         self.state = self._advance_jit(self.state)
 
     def patch_accs(self, decoded, raw_accs=None) -> None:
-        raise NotImplementedError(
-            "retractable MIN/MAX acc patching is single-chip only for "
-            "now — use append_only or a non-sharded plan")
+        """Overwrite flushed groups' accumulators across all shards
+        (retractable MIN/MAX minput recompute — the single-chip
+        patch_accs, shard-mapped). The flush's per-shard slot indices
+        (self._flush_idx) route each corrected row back to its owning
+        shard; untouched calls pass their raw gathered columns through
+        bit-for-bit."""
+        idxs = self._flush_idx
+        assert idxs is not None and any(len(ix) for ix in idxs), \
+            "flush() first"
+        from risingwave_tpu.ops.hash_agg import encode_patch_cols
+        dev_cols = encode_patch_cols(self.specs, decoded, raw_accs)
+        counts = [len(ix) for ix in idxs]
+        m = next_pow2(max(counts))
+        bidx = np.full((self.n_dev, m), self.capacity, dtype=np.int32)
+        bcols = [np.zeros((self.n_dev, m), dtype=c.dtype)
+                 for c in dev_cols]
+        at = 0
+        for d_i, ix in enumerate(idxs):
+            c = len(ix)
+            bidx[d_i, :c] = ix
+            for bc, col in zip(bcols, dev_cols):
+                bc[d_i, :c] = col[at:at + c]
+            at += c
+
+        if self._patch_step is None:
+            from risingwave_tpu.ops.hash_agg import build_patch
+            patch = build_patch(self.specs)
+            n_cols = len(dev_cols)
+            self._patch_step = self._shardwise(
+                lambda st, ix, *cols: patch(st, ix, tuple(cols)),
+                donate=True,
+                extra_specs=(P(AXIS),) * (1 + n_cols))
+        self.state = self._patch_step(
+            self.state, jnp.asarray(bidx),
+            *(jnp.asarray(b) for b in bcols))
 
     def retire_below(self, group_pos: int, wm_i64: int) -> None:
         """Watermark state cleaning, every shard in one SPMD step."""

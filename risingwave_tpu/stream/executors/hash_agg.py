@@ -235,10 +235,6 @@ class HashAggExecutor(Executor):
         # (parallel/agg.ShardedAggKernel) when parallelism > 1 — same
         # host surface, SPMD launch shape (dispatch.rs:582's hash
         # exchange becomes the in-kernel all_to_all)
-        if kernel is not None and self.minput:
-            raise ValueError(
-                "retractable MIN/MAX (minput) is single-chip only — "
-                "sharded kernels don't support acc patching yet")
         self.kernel = kernel if kernel is not None else GroupedAggKernel(
             key_width=_LANES_PER_KEY * len(self.group_indices),
             specs=self.specs)
