@@ -54,15 +54,15 @@ class VnodeRangeScan(BatchExecutor):
         start = encode_vnode_prefix(self.lo)
         end = encode_vnode_prefix(self.hi) if self.hi < VNODE_COUNT \
             else None
-        rows: List[tuple] = []
-        for _k, row in self.table.store.iter(
-                self.table.table_id, self.epoch, start, end):
-            rows.append(row)
-            if len(rows) >= self.chunk_size:
-                yield rows_to_chunk(self.schema, rows)
-                rows = []
-        if rows:
-            yield rows_to_chunk(self.schema, rows)
+        # materialize the store scan EAGERLY: the task yields to the
+        # event loop between chunks, and a barrier-triggered compaction
+        # could vacuum a lazily-held SST mid-scan (bounded by the MV
+        # snapshot size, same stance as StateTable._iter_range_raw)
+        all_rows = [row for _k, row in self.table.store.iter(
+            self.table.table_id, self.epoch, start, end)]
+        for at in range(0, len(all_rows), self.chunk_size):
+            yield rows_to_chunk(self.schema,
+                                all_rows[at:at + self.chunk_size])
 
 
 class _StageSource(BatchExecutor):

@@ -315,9 +315,11 @@ class StateTable:
             return value
         return self.store.get(self.table_id, key, self._read_epoch())
 
-    def iter_rows(self, vnode: Optional[int] = None
+    def iter_rows(self, vnode: Optional[int] = None,
+                  reverse: bool = False
                   ) -> Iterator[Tuple[tuple, tuple]]:
-        """Yield (pk, row) in memcomparable pk order, memtable merged.
+        """Yield (pk, row) in memcomparable pk order (descending with
+        `reverse=True` — the backward iterator), memtable merged.
 
         v0 correctness-first: materializes the committed range then overlays
         buffered ops (the in-memory fake is small; hummock-lite gets a real
@@ -329,7 +331,7 @@ class StateTable:
             start = encode_vnode_prefix(vnode)
             end = encode_vnode_prefix(vnode + 1) if vnode + 1 < VNODE_COUNT \
                 else None
-        yield from self._iter_range(start, end)
+        yield from self._iter_range(start, end, reverse=reverse)
 
     def iter_prefix(self, prefix_values: Sequence
                     ) -> Iterator[Tuple[tuple, tuple]]:
@@ -347,7 +349,7 @@ class StateTable:
         yield from self._iter_range(start, _next_prefix(start))
 
     def _iter_range_raw(self, start: Optional[bytes],
-                        end: Optional[bytes]
+                        end: Optional[bytes], reverse: bool = False
                         ) -> Iterator[Tuple[bytes, tuple]]:
         merged = {k: v for k, v in self.store.iter(
             self.table_id, self._read_epoch(), start, end)}
@@ -360,12 +362,13 @@ class StateTable:
                 merged.pop(key, None)
             else:
                 merged[key] = new
-        for key in sorted(merged):
+        for key in sorted(merged, reverse=reverse):
             yield key, merged[key]
 
-    def _iter_range(self, start: Optional[bytes], end: Optional[bytes]
+    def _iter_range(self, start: Optional[bytes], end: Optional[bytes],
+                    reverse: bool = False
                     ) -> Iterator[Tuple[tuple, tuple]]:
-        for key, row in self._iter_range_raw(start, end):
+        for key, row in self._iter_range_raw(start, end, reverse):
             yield decode_memcomparable(key[2:], self.pk_types), row
 
     def iter_encoded_range(self, start: Optional[bytes] = None,

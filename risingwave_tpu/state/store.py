@@ -143,13 +143,14 @@ class MemoryStateStore(StateStore):
         return self._table(table_id).read(key, epoch)
 
     def iter(self, table_id: int, epoch: int,
-             start: Optional[bytes] = None, end: Optional[bytes] = None
-             ) -> Iterator[Tuple[bytes, tuple]]:
+             start: Optional[bytes] = None, end: Optional[bytes] = None,
+             reverse: bool = False) -> Iterator[Tuple[bytes, tuple]]:
         t = self._table(table_id)
         keys = t.sorted_keys()
         lo = bisect.bisect_left(keys, start) if start is not None else 0
         hi = bisect.bisect_left(keys, end) if end is not None else len(keys)
-        for i in range(lo, hi):
+        rng = range(hi - 1, lo - 1, -1) if reverse else range(lo, hi)
+        for i in rng:
             key = keys[i]
             v = t.read(key, epoch)
             if v is not None:

@@ -30,7 +30,7 @@ from risingwave_tpu.state.store import StateStore, Value
 from risingwave_tpu.utils.failpoint import fail_point
 from risingwave_tpu.storage.object_store import ObjectStore
 from risingwave_tpu.storage.sst import (
-    EPOCH_MASK, Sst, SstBuilder, full_key, split_full_key,
+    EPOCH_MASK, LazySst, Sst, SstBuilder, full_key, split_full_key,
 )
 from risingwave_tpu.storage.value_codec import decode_row, encode_row
 
@@ -53,10 +53,15 @@ class HummockLite(StateStore):
         self._next_sst_id = 1
         self._l0: List[dict] = []       # SST infos, newest LAST
         self._l1: List[dict] = []       # key-disjoint, sorted by smallest
-        # decoded-SST LRU (block-cache analog); compaction's one-shot
-        # sequential scans bypass it so the full LSM never pins memory
-        self._cache: OrderedDict[int, Sst] = OrderedDict()
-        self._cache_max = 64
+        # block-granular cache (sstable_store.rs block_cache analog):
+        # reads fetch byte ranges per block; hot blocks stay resident
+        # under a byte budget. SST HANDLES (index+bloom only) cache
+        # separately — they are small and bound the metadata round
+        # trips. Compaction's one-shot sequential scans bypass both.
+        from risingwave_tpu.storage.block_cache import BlockCache
+        self._blocks = BlockCache()
+        self._handles: OrderedDict[int, LazySst] = OrderedDict()
+        self._handles_max = 256
         self._load_current()
 
     # -- manifest ---------------------------------------------------------
@@ -145,22 +150,23 @@ class HummockLite(StateStore):
         return self._committed_epoch
 
     # -- SST access -------------------------------------------------------
-    def _sst(self, info: dict) -> Sst:
-        s = self._cache.get(info["id"])
+    def _sst(self, info: dict) -> LazySst:
+        s = self._handles.get(info["id"])
         if s is None:
-            s = Sst(self.obj.read(f"data/{info['id']}.sst"), info)
-            self._cache[info["id"]] = s
-            while len(self._cache) > self._cache_max:
-                self._cache.popitem(last=False)
+            s = LazySst(self.obj, f"data/{info['id']}.sst", info,
+                        cache=self._blocks)
+            self._handles[info["id"]] = s
+            while len(self._handles) > self._handles_max:
+                self._handles.popitem(last=False)
         else:
-            self._cache.move_to_end(info["id"])
+            self._handles.move_to_end(info["id"])
         return s
 
     def _sst_once(self, info: dict) -> Sst:
-        """Uncached read for one-shot sequential scans (compaction)."""
-        s = self._cache.get(info["id"])
-        return s if s is not None else Sst(
-            self.obj.read(f"data/{info['id']}.sst"), info)
+        """Whole-bytes read for one-shot sequential scans (compaction
+        consumes every block exactly once — caching would only evict
+        the hot read path)."""
+        return Sst(self.obj.read(f"data/{info['id']}.sst"), info)
 
     # -- read path --------------------------------------------------------
     def get(self, table_id: int, key: bytes, epoch: int) -> Value:
@@ -216,17 +222,19 @@ class HummockLite(StateStore):
         return ans
 
     def iter(self, table_id: int, epoch: int,
-             start: Optional[bytes] = None, end: Optional[bytes] = None
-             ) -> Iterator[Tuple[bytes, tuple]]:
+             start: Optional[bytes] = None, end: Optional[bytes] = None,
+             reverse: bool = False) -> Iterator[Tuple[bytes, tuple]]:
         """Snapshot range scan: newest version ≤ epoch per key, no
-        tombstones — a k-way merge across all layers."""
+        tombstones — a k-way merge across all layers. `reverse=True`
+        scans keys DESCENDING (backward iterator; the merge key flips
+        the user key but keeps newest-version-first within a key)."""
         start = start or b""
         sources = []
         rank = 0
 
         def mem_source(e: int, kv: Dict[bytes, Value], r: int):
             inv = (~e) & EPOCH_MASK
-            for k in sorted(kv):
+            for k in sorted(kv, reverse=reverse):
                 if k < start or (end is not None and k >= end):
                     continue
                 yield (k, inv, r, kv[k])
@@ -244,7 +252,7 @@ class HummockLite(StateStore):
                     sources.append(mem_source(e, kv, rank))
                     rank += 1
 
-        def sst_source(sst: Sst, r: int):
+        def sst_source(sst, r: int):
             sfk = full_key(table_id, start, EPOCH_MASK)
             for fk, tomb, row in sst.iter_from(sfk):
                 t, uk, e = split_full_key(fk)
@@ -257,16 +265,51 @@ class HummockLite(StateStore):
                 yield (uk, (~e) & EPOCH_MASK, r,
                        None if tomb else decode_row(row))
 
+        def sst_source_rev(sst, r: int):
+            # descending keys; within one user key iter_rev yields
+            # versions oldest-first (fk order), so buffer the tiny
+            # same-key run and re-emit newest-first
+            import struct as _s
+            ufk = _s.pack(">I", table_id + 1) if end is None else \
+                full_key(table_id, end, EPOCH_MASK)
+            run: List[tuple] = []
+            run_uk: Optional[bytes] = None
+            for fk, tomb, row in sst.iter_rev(ufk):
+                t, uk, e = split_full_key(fk)
+                if t != table_id or uk < start:
+                    break
+                if end is not None and uk >= end:
+                    continue
+                if e > epoch:
+                    continue
+                item = (uk, (~e) & EPOCH_MASK, r,
+                        None if tomb else decode_row(row))
+                if uk != run_uk:
+                    yield from reversed(run)
+                    run, run_uk = [], uk
+                run.append(item)
+            yield from reversed(run)
+
+        mk = sst_source_rev if reverse else sst_source
         for info in reversed(self._l0):
-            sources.append(sst_source(self._sst(info), rank))
+            sources.append(mk(self._sst(info), rank))
             rank += 1
         for info in self._l1:
-            sources.append(sst_source(self._sst(info), rank))
+            sources.append(mk(self._sst(info), rank))
             rank += 1
 
+        if reverse:
+            # descending user keys; within a key newest version first
+            # (EPOCH_MASK - inv descends with reverse=True ⇢ inv
+            # ascends), lowest rank breaking ties
+            merged = heapq.merge(
+                *sources, reverse=True,
+                key=lambda t: (t[0], EPOCH_MASK - t[1], -t[2]))
+        else:
+            merged = heapq.merge(
+                *sources, key=lambda t: (t[0], t[1], t[2]))
         last_key: Optional[bytes] = None
-        for uk, _inv, _r, value in heapq.merge(
-                *sources, key=lambda t: (t[0], t[1], t[2])):
+        for uk, _inv, _r, value in merged:
             if uk == last_key:
                 continue
             last_key = uk
@@ -349,9 +392,17 @@ class HummockLite(StateStore):
         self._l0 = []
         self._l1 = new_infos
         self._commit_version()
-        for info in olds:              # vacuum after commit
+        # DEFERRED vacuum (version-pinning lite): the block cache now
+        # fetches lazily, so an iterator opened before this compaction
+        # may still read the replaced SSTs — delete the PREVIOUS
+        # compaction's garbage instead, giving in-flight scans one full
+        # compaction cycle of grace (the reference pins versions per
+        # reader; eager consumers — StateTable materializes — need none)
+        for info in getattr(self, "_pending_vacuum", []):
             self.obj.delete(f"data/{info['id']}.sst")
-            self._cache.pop(info["id"], None)
+            self._handles.pop(info["id"], None)
+            self._blocks.drop_sst(info["id"])
+        self._pending_vacuum = olds
 
     # -- test/debug helpers ----------------------------------------------
     def table_size(self, table_id: int, epoch: int) -> int:

@@ -44,6 +44,15 @@ class MemObjectStore:
         fail_point("object_store.read")
         return self._objects[path]
 
+    def read_range(self, path: str, off: int, length: int) -> bytes:
+        """Ranged read (S3 byte-range GET analog) — the block cache's
+        way to touch one block without shipping the whole SST."""
+        fail_point("object_store.read")
+        return self._objects[path][off:off + length]
+
+    def size(self, path: str) -> int:
+        return len(self._objects[path])
+
     def delete(self, path: str) -> None:
         self._objects.pop(path, None)
 
@@ -85,6 +94,15 @@ class LocalFsObjectStore:
         fail_point("object_store.read")
         with open(self._abs(path), "rb") as f:
             return f.read()
+
+    def read_range(self, path: str, off: int, length: int) -> bytes:
+        fail_point("object_store.read")
+        with open(self._abs(path), "rb") as f:
+            f.seek(off)
+            return f.read(length)
+
+    def size(self, path: str) -> int:
+        return os.path.getsize(self._abs(path))
 
     def delete(self, path: str) -> None:
         try:

@@ -71,13 +71,15 @@ class TracingStateStore(StateStore):
                              "result": _enc_val(v)})
         return v
 
-    def iter(self, table_id, epoch, start=None, end=None
-             ) -> Iterator[Tuple[bytes, tuple]]:
-        out = list(self.inner.iter(table_id, epoch, start, end))
+    def iter(self, table_id, epoch, start=None, end=None,
+             reverse: bool = False) -> Iterator[Tuple[bytes, tuple]]:
+        out = list(self.inner.iter(table_id, epoch, start, end,
+                                   reverse=reverse))
         self.records.append({
             "op": "iter", "table": table_id, "epoch": epoch,
             "start": None if start is None else start.hex(),
             "end": None if end is None else end.hex(),
+            "reverse": reverse,
             "result": [[k.hex(), _enc_val(v)] for k, v in out]})
         return iter(out)
 
@@ -124,7 +126,8 @@ def replay_trace(records, store: StateStore) -> List[dict]:
                 None if r["start"] is None
                 else bytes.fromhex(r["start"]),
                 None if r["end"] is None
-                else bytes.fromhex(r["end"])))
+                else bytes.fromhex(r["end"]),
+                reverse=r.get("reverse", False)))
             want = [(bytes.fromhex(k), _dec_val(v))
                     for k, v in r["result"]]
             if got != want:
