@@ -3,10 +3,18 @@
 Reference parity: src/cmd_all/src/bin/risingwave.rs playground /
 standalone modes — one process hosting frontend (pgwire), meta (barrier
 loop + catalog/DDL log) and compute (actors + device kernels), with
-hummock-on-local-FS persistence when --data-dir is given.
+hummock-on-local-FS persistence when --data-dir is given — plus the
+risectl verb family (src/ctl/) for offline cluster inspection and
+backup operations against a data directory:
 
     python -m risingwave_tpu playground                # in-memory
     python -m risingwave_tpu serve --data-dir ./rwdata # durable
+    python -m risingwave_tpu ctl --data-dir D meta catalog
+    python -m risingwave_tpu ctl --data-dir D hummock version
+    python -m risingwave_tpu ctl --data-dir D hummock list-ssts
+    python -m risingwave_tpu ctl --data-dir D table scan <name> [-n N]
+    python -m risingwave_tpu ctl --data-dir D backup create|list|
+        delete <id> | restore <id> --target T
 """
 
 from __future__ import annotations
@@ -46,7 +54,112 @@ async def _serve(args) -> None:
         await srv.close()
 
 
+def _ctl(args) -> int:
+    """Offline inspection/ops against a data directory (risectl)."""
+    import json
+    import os
+
+    # ctl needs no device kernels: default to CPU so inspection never
+    # blocks on a TPU tunnel another process may hold (the operator
+    # can still export JAX_PLATFORMS to override)
+    if "JAX_PLATFORMS" not in os.environ:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    if not os.path.isdir(args.data_dir):
+        # an inspection tool must refuse to MINT a cluster: a typo'd
+        # path reporting an empty-but-healthy catalog is worse than
+        # an error
+        print(f"error: data dir {args.data_dir!r} does not exist",
+              file=sys.stderr)
+        return 1
+
+    from risingwave_tpu.storage.object_store import LocalFsObjectStore
+
+    obj = LocalFsObjectStore(args.data_dir)
+    verb = args.ctl_cmd
+
+    if verb == "meta" and args.what == "catalog":
+        if obj.exists("meta/ddl.json"):
+            for line in json.loads(obj.read("meta/ddl.json").decode()):
+                print(line)
+        return 0
+    if verb == "hummock" and args.what == "version":
+        if not obj.exists("meta/CURRENT"):
+            print("no committed version")
+            return 1
+        vid = int(obj.read("meta/CURRENT").decode())
+        print(json.dumps(json.loads(
+            obj.read(f"meta/v{vid}.json").decode()), indent=2))
+        return 0
+    if verb == "hummock" and args.what == "list-ssts":
+        for path in obj.list("data/"):
+            print(f"{path}\t{obj.size(path)}B")
+        return 0
+    if verb == "table":
+        return asyncio.run(_ctl_scan(obj, args))
+    if verb == "backup":
+        from risingwave_tpu.meta.backup import (
+            create_backup, delete_backup, list_backups, restore_backup,
+        )
+        if args.what in ("delete", "restore") and not args.ident:
+            print(f"error: backup {args.what} needs a backup id",
+                  file=sys.stderr)
+            return 2
+        if args.what == "create":
+            print(create_backup(obj))
+        elif args.what == "list":
+            for b in list_backups(obj):
+                print(b)
+        elif args.what == "delete":
+            if args.ident not in list_backups(obj):
+                print(f"error: no backup {args.ident!r}",
+                      file=sys.stderr)
+                return 1
+            print(delete_backup(obj, args.ident), "objects deleted")
+        elif args.what == "restore":
+            if not args.target:
+                print("error: backup restore needs --target",
+                      file=sys.stderr)
+                return 2
+            restore_backup(obj, args.ident,
+                           LocalFsObjectStore(args.target))
+            print(f"restored backup {args.ident} into {args.target}")
+        return 0
+    return 2
+
+
+async def _ctl_scan(obj, args) -> int:
+    """READ-ONLY scan: recovery replays DDL through deploy, which
+    commits checkpoint versions — so recover over an in-memory CLONE
+    of the objects. The data dir is never written (safe beside a live
+    serve process; snapshot-isolated at the copy instant)."""
+    from risingwave_tpu.frontend import Frontend
+    from risingwave_tpu.storage.hummock import HummockLite
+    from risingwave_tpu.storage.object_store import MemObjectStore
+
+    clone = MemObjectStore()
+    for path in obj.list(""):
+        clone.upload(path, obj.read(path))
+    fe = Frontend(HummockLite(clone))
+    await fe.recover()
+    try:
+        rows = await fe.execute(
+            f"SELECT * FROM {args.ident} LIMIT {args.limit}")
+    finally:
+        await fe.close()
+    for r in rows:
+        print("\t".join("NULL" if v is None else str(v) for v in r))
+    return 0
+
+
 def main(argv=None) -> None:
+    # the axon sitecustomize rewrites jax_platforms at interpreter
+    # start, overriding JAX_PLATFORMS=cpu — honor the env var so ctl /
+    # CI runs never block on a TPU tunnel they did not ask for
+    import os
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     p = argparse.ArgumentParser(prog="risingwave_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
     for name in ("playground", "serve"):
@@ -55,7 +168,25 @@ def main(argv=None) -> None:
         sp.add_argument("--port", type=int, default=4566)
         if name == "serve":               # playground is in-memory only
             sp.add_argument("--data-dir", required=True)
+    ctl = sub.add_parser("ctl")
+    ctl.add_argument("--data-dir", required=True)
+    csub = ctl.add_subparsers(dest="ctl_cmd", required=True)
+    meta = csub.add_parser("meta")
+    meta.add_argument("what", choices=["catalog"])
+    hm = csub.add_parser("hummock")
+    hm.add_argument("what", choices=["version", "list-ssts"])
+    tb = csub.add_parser("table")
+    tb.add_argument("what", choices=["scan"])
+    tb.add_argument("ident")
+    tb.add_argument("-n", "--limit", type=int, default=20)
+    bk = csub.add_parser("backup")
+    bk.add_argument("what",
+                    choices=["create", "list", "delete", "restore"])
+    bk.add_argument("ident", nargs="?")
+    bk.add_argument("--target")
     args = p.parse_args(argv)
+    if args.cmd == "ctl":
+        sys.exit(_ctl(args))
     if not hasattr(args, "data_dir"):
         args.data_dir = None
     asyncio.run(_serve(args))

@@ -639,3 +639,79 @@ def test_eowc_without_watermark_rejected():
         await fe.close()
 
     asyncio.run(run())
+
+
+def test_ctl_verbs(tmp_path):
+    """risectl analog: offline inspection + backup ops via the CLI."""
+    import subprocess
+    import sys
+
+    d = str(tmp_path / "data")
+    t = str(tmp_path / "restored")
+
+    async def build():
+        from risingwave_tpu.storage.hummock import HummockLite
+        from risingwave_tpu.storage.object_store import (
+            LocalFsObjectStore,
+        )
+        fe = Frontend(HummockLite(LocalFsObjectStore(d)), rate_limit=2,
+                      min_chunks=2)
+        await fe.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', "
+            "nexmark.table.type='bid', nexmark.event.num=2000, "
+            "nexmark.max.chunk.size=128)")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW v AS SELECT auction, count(*) "
+            "AS c FROM bid GROUP BY auction")
+        for _ in range(4):
+            await fe.step()
+        await fe.close()
+
+    asyncio.run(build())
+
+    def ctl(*argv):
+        r = subprocess.run(
+            [sys.executable, "-m", "risingwave_tpu", "ctl",
+             "--data-dir", d, *argv],
+            capture_output=True, text=True, timeout=120,
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 0, r.stderr[-500:]
+        return r.stdout
+
+    assert "CREATE MATERIALIZED VIEW" in ctl("meta", "catalog")
+    assert '"l0"' in ctl("hummock", "version") or \
+        '"l1"' in ctl("hummock", "version")
+    assert ".sst" in ctl("hummock", "list-ssts")
+    scan = ctl("table", "scan", "v", "-n", "5")
+    assert len(scan.strip().splitlines()) == 5
+    bid = ctl("backup", "create").strip()
+    assert bid in ctl("backup", "list")
+    ctl("backup", "restore", bid, "--target", t)
+    import os
+    assert os.path.exists(os.path.join(t, "meta", "ddl.json"))
+
+
+def test_ctl_read_only_and_validation(tmp_path):
+    import os
+    import subprocess
+    import sys
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+    def ctl(*argv, expect=0):
+        r = subprocess.run(
+            [sys.executable, "-m", "risingwave_tpu", "ctl", *argv],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert r.returncode == expect, (r.returncode, r.stderr[-300:])
+        return r
+
+    # nonexistent data dir refused, not minted
+    missing = str(tmp_path / "nope")
+    r = ctl("--data-dir", missing, "meta", "catalog", expect=1)
+    assert "does not exist" in r.stderr and not os.path.exists(missing)
+    # malformed backup commands fail loudly
+    d = str(tmp_path / "d")
+    os.makedirs(d)
+    ctl("--data-dir", d, "backup", "restore", "1", expect=2)
+    ctl("--data-dir", d, "backup", "delete", expect=2)
+    ctl("--data-dir", d, "backup", "delete", "99", expect=1)
