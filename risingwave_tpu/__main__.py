@@ -121,8 +121,16 @@ def _ctl(args) -> int:
                 print("error: backup restore needs --target",
                       file=sys.stderr)
                 return 2
-            restore_backup(obj, args.ident,
-                           LocalFsObjectStore(args.target))
+            if args.ident not in list_backups(obj):
+                print(f"error: no backup {args.ident!r}",
+                      file=sys.stderr)
+                return 1
+            try:
+                restore_backup(obj, args.ident,
+                               LocalFsObjectStore(args.target))
+            except ValueError as e:      # non-empty target
+                print(f"error: {e}", file=sys.stderr)
+                return 1
             print(f"restored backup {args.ident} into {args.target}")
         return 0
     return 2
@@ -130,15 +138,19 @@ def _ctl(args) -> int:
 
 async def _ctl_scan(obj, args) -> int:
     """READ-ONLY scan: recovery replays DDL through deploy, which
-    commits checkpoint versions — so recover over an in-memory CLONE
-    of the objects. The data dir is never written (safe beside a live
-    serve process; snapshot-isolated at the copy instant)."""
+    commits checkpoint versions — so recover over an in-memory CLONE.
+    The clone copies the CURRENT version's CLOSURE (the backup
+    helper's consistency argument: versions are immutable and vacuum
+    is deferred), so it is a true snapshot even beside a live serve
+    process racing compactions — a bare list-then-read-all could see
+    a torn CURRENT or a just-vacuumed SST."""
     from risingwave_tpu.frontend import Frontend
+    from risingwave_tpu.meta.backup import _closure
     from risingwave_tpu.storage.hummock import HummockLite
     from risingwave_tpu.storage.object_store import MemObjectStore
 
     clone = MemObjectStore()
-    for path in obj.list(""):
+    for path in _closure(obj):
         clone.upload(path, obj.read(path))
     fe = Frontend(HummockLite(clone))
     await fe.recover()

@@ -33,6 +33,25 @@ from risingwave_tpu.connectors.parser import RowParser, make_parser
 _PART_RE = re.compile(r"^(?P<topic>.+)-(?P<part>\d+)\.log$")
 
 
+def _read_complete_records(f, payloads: List[bytes],
+                           limit: int) -> int:
+    """Append up to `limit` COMPLETE newline-terminated records from an
+    open file handle; returns bytes consumed. A trailing line without
+    its newline is a torn write (or segment end) and stays unconsumed —
+    the one 'complete record' protocol both readers share."""
+    consumed = 0
+    while len(payloads) < limit:
+        line = f.readline()
+        if not line.endswith(b"\n"):
+            break
+        consumed += len(line)
+        rec = line.rstrip(b"\r\n")
+        if rec:
+            payloads.append(rec)
+    return consumed
+
+
+
 def partition_path(path: str, topic: str, partition: int) -> str:
     return os.path.join(path, f"{topic}-{partition}.log")
 
@@ -104,15 +123,8 @@ class FileLogSplitReader:
             with open(self.file_path, "rb") as f:
                 f.seek(self.offset)
                 payloads: List[bytes] = []
-                consumed = 0
-                while len(payloads) < self.max_chunk_size:
-                    line = f.readline()
-                    if not line.endswith(b"\n"):
-                        break              # EOF or torn trailing write
-                    consumed += len(line)
-                    rec = line.rstrip(b"\r\n")
-                    if rec:
-                        payloads.append(rec)
+                consumed = _read_complete_records(
+                    f, payloads, self.max_chunk_size)
         except FileNotFoundError:
             return None
         if not payloads:
@@ -120,5 +132,89 @@ class FileLogSplitReader:
         chunk = self.parser.build_chunk(payloads)
         # advance past malformed records too (they are counted by the
         # parser) — re-reading them forever would wedge the split
+        self.offset += consumed
+        return chunk
+
+
+def segment_path(path: str, topic: str, partition: int,
+                 epoch: int) -> str:
+    return os.path.join(path, f"{topic}-{partition}.seg-{epoch:016x}.log")
+
+
+def list_segments(path: str, topic: str, partition: int):
+    """Committed segment files in epoch order (immutable once named:
+    the sink publishes each epoch by atomic rename)."""
+    pre = f"{topic}-{partition}.seg-"
+    try:
+        names = [n for n in os.listdir(path)
+                 if n.startswith(pre) and n.endswith(".log")]
+    except FileNotFoundError:
+        return []
+    return sorted(os.path.join(path, n) for n in names)
+
+
+class SegmentedFileLogReader:
+    """SplitReader over a SEGMENTED topic (one immutable file per
+    committed epoch — the exactly-once sink's output). The offset is
+    the cumulative byte position across segments in epoch order;
+    segments never mutate after publication, so the mapping is stable
+    across restarts and new segments only extend it."""
+
+    unbounded = True
+
+    def __init__(self, path: str, topic: str, partition: int,
+                 schema: Schema, fmt: str = "json",
+                 max_chunk_size: int = 1024, offset: int = 0,
+                 options=None):
+        self.path = path
+        self.topic = topic
+        self.partition = partition
+        self.schema = schema
+        self.parser: RowParser = make_parser(fmt, schema, options)
+        self.max_chunk_size = int(max_chunk_size)
+        self.offset = int(offset)
+        # cached (path, size, cum_end) — segments are IMMUTABLE after
+        # publication, so sizes and cumulative offsets never change;
+        # the directory is re-listed only when the cached tail is
+        # exhausted (O(new segments) per poll, not O(all segments))
+        self._segs: List[tuple] = []
+
+    @property
+    def split_id(self) -> str:
+        return f"filelog-seg-{self.topic}-{self.partition}"
+
+    def seek(self, offset: int) -> None:
+        self.offset = int(offset)
+
+    def _refresh_segments(self) -> None:
+        known = {p for p, _sz, _cum in self._segs}
+        cum = self._segs[-1][2] if self._segs else 0
+        for seg in list_segments(self.path, self.topic,
+                                 self.partition):
+            if seg in known:
+                continue
+            size = os.path.getsize(seg)
+            cum += size
+            self._segs.append((seg, size, cum))
+
+    def next_chunk(self) -> Optional[StreamChunk]:
+        if not self._segs or self.offset >= self._segs[-1][2]:
+            self._refresh_segments()
+        payloads: List[bytes] = []
+        consumed = 0
+        # binary search the segment holding the current offset
+        import bisect
+        ends = [cum for _p, _sz, cum in self._segs]
+        at = bisect.bisect_right(ends, self.offset)
+        for seg, size, cum_end in self._segs[at:]:
+            with open(seg, "rb") as f:
+                f.seek(self.offset + consumed - (cum_end - size))
+                consumed += _read_complete_records(
+                    f, payloads, self.max_chunk_size)
+            if len(payloads) >= self.max_chunk_size:
+                break
+        if not payloads:
+            return None
+        chunk = self.parser.build_chunk(payloads)
         self.offset += consumed
         return chunk

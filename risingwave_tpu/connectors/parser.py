@@ -60,7 +60,14 @@ def _coerce(v, dt: DataType):
         return (datetime.date.fromisoformat(str(v))
                 - datetime.date(1970, 1, 1)).days
     if dt == DataType.BYTEA:
-        return v.encode() if isinstance(v, str) else bytes(v)
+        if isinstance(v, str):
+            # wire format is HEX (what the filelog sink writes);
+            # non-hex strings fall back to their utf-8 bytes
+            try:
+                return bytes.fromhex(v)
+            except ValueError:
+                return v.encode()
+        return bytes(v)
     return str(v)
 
 
@@ -79,41 +86,66 @@ class RowParser(abc.ABC):
     def parse_one(self, payload: bytes) -> Optional[tuple]:
         ...
 
-    def parse_batch(self, payloads: Sequence[bytes]) -> List[tuple]:
+    def parse_record(self, payload: bytes
+                     ) -> Optional[Tuple[bool, tuple]]:
+        """(is_insert, row) — formats with an op envelope (the filelog
+        sink's __op) override this; plain formats are inserts."""
+        row = self.parse_one(payload)
+        return None if row is None else (True, row)
+
+    def parse_records(self, payloads: Sequence[bytes]
+                      ) -> List[Tuple[bool, tuple]]:
         out = []
         for p in payloads:
             try:
-                row = self.parse_one(p)
+                rec = self.parse_record(p)
             except (ValueError, TypeError, KeyError,
                     json.JSONDecodeError):
-                row = None
-            if row is None:
+                rec = None
+            if rec is None:
                 self.errors += 1
             else:
-                out.append(row)
+                out.append(rec)
         return out
+
+    def parse_batch(self, payloads: Sequence[bytes]) -> List[tuple]:
+        """Rows only (op envelope dropped) — the plain-source shape."""
+        return [r for _ins, r in self.parse_records(payloads)]
 
     def build_chunk(self, payloads: Sequence[bytes]
                     ) -> Optional[StreamChunk]:
-        rows = self.parse_batch(payloads)
-        if not rows:
+        recs = self.parse_records(payloads)
+        if not recs:
             return None
         data: Dict[str, list] = {
-            f.name: [r[i] for r in rows]
+            f.name: [r[i] for _ins, r in recs]
             for i, f in enumerate(self.schema)}
-        return StreamChunk.from_pydict(self.schema, data)
+        ops = None
+        if not all(ins for ins, _r in recs):
+            from risingwave_tpu.common.chunk import Op
+            ops = [Op.INSERT if ins else Op.DELETE
+                   for ins, _r in recs]
+        return StreamChunk.from_pydict(self.schema, data, ops=ops)
 
 
 class JsonRowParser(RowParser):
     """One JSON object per record (parser/json_parser.rs analog);
-    missing keys read as NULL, unknown keys are ignored."""
+    missing keys read as NULL, unknown keys are ignored. A ``__op``
+    envelope field ("I"/"D" — the filelog sink's changelog wire
+    format) maps to the chunk op so retractions survive the wire."""
 
     def parse_one(self, payload: bytes) -> Optional[tuple]:
+        rec = self.parse_record(payload)
+        return None if rec is None else rec[1]
+
+    def parse_record(self, payload: bytes
+                     ) -> Optional[Tuple[bool, tuple]]:
         obj = json.loads(payload)
         if not isinstance(obj, dict):
             return None
-        return tuple(_coerce(obj.get(f.name), f.data_type)
-                     for f in self.schema)
+        row = tuple(_coerce(obj.get(f.name), f.data_type)
+                    for f in self.schema)
+        return (obj.get("__op", "I") != "D", row)
 
 
 class CsvRowParser(RowParser):

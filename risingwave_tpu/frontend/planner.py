@@ -75,9 +75,9 @@ class SinkPlan:
 
 
 def make_sink_writer(options: Dict[str, str]):
-    """connector= blackhole | file (sink/mod.rs build_sink analog)."""
+    """connector= blackhole | file | filelog (build_sink analog)."""
     from risingwave_tpu.stream.executors.sink import (
-        BlackholeSink, FileSink,
+        BlackholeSink, FileSink, FilelogSink,
     )
     connector = options.get("connector", "").lower()
     if connector == "blackhole":
@@ -87,6 +87,14 @@ def make_sink_writer(options: Dict[str, str]):
         if not path:
             raise PlanError("file sink needs path='...'")
         return FileSink(path)
+    if connector == "filelog":
+        path = options.get("path")
+        topic = options.get("topic")
+        if not path or not topic:
+            raise PlanError(
+                "filelog sink needs path='...' and topic='...'")
+        return FilelogSink(path, topic,
+                           partition=int(options.get("partition", 0)))
     raise PlanError(f"unknown sink connector {connector!r}")
 
 
@@ -119,10 +127,20 @@ def _source_reader(src: SourceCatalog):
         topic = opts.get("topic", src.name)
         if not path:
             raise PlanError("filelog source needs path='...'")
+        part = int(opts.get("partition", 0))
+        if opts.get("segmented", "").lower() in ("true", "1"):
+            # a filelog SINK's output: immutable per-epoch segments
+            from risingwave_tpu.connectors.filelog import (
+                SegmentedFileLogReader,
+            )
+            return SegmentedFileLogReader(
+                path, topic, part, src.schema,
+                fmt=opts.get("format", "json"),
+                max_chunk_size=int(opts.get("max.chunk.size", 1024)),
+                options=opts)
         splits = FileLogEnumerator(path, topic).list_splits()
         # v0 single-pipeline sources: one reader drives partition 0
         # (multi-split assignment lands with the fragmenter)
-        part = int(opts.get("partition", 0))
         if splits and not any(
                 int(s.split_id.rsplit("-", 1)[1]) == part
                 for s in splits):
@@ -370,8 +388,19 @@ class StreamPlanner:
         ex, _pk, deps = self._plan_query(sel, actor_id, rate_limit,
                                          min_chunks)
         writer = make_sink_writer(options)
-        return SinkPlan(SinkExecutor(ex, writer), deps, self.readers,
-                        self.pending_attaches)
+        # durable stream-position counter: the exactly-once writers'
+        # recovery reconciliation anchor (sink coordinator epoch-log);
+        # built only for writers that reconcile — an unread counter
+        # would cost a table id + a write per checkpoint for nothing
+        sink_state = None
+        if hasattr(writer, "reset_stream_position"):
+            sink_state = StateTable(
+                self.catalog.next_id(),
+                Schema([Field("_k", DataType.INT64),
+                        Field("_count", DataType.INT64)]),
+                [0], self.store)
+        return SinkPlan(SinkExecutor(ex, writer, state=sink_state),
+                        deps, self.readers, self.pending_attaches)
 
     def _plan_query(self, sel: ast.Select, actor_id: int,
                     rate_limit: Optional[int],

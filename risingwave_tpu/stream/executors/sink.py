@@ -136,17 +136,38 @@ class SinkExecutor(Executor):
     corresponds to a durable recovery point."""
 
     def __init__(self, input_: Executor, writer: SinkWriter,
-                 identity: str = "SinkExecutor"):
+                 identity: str = "SinkExecutor",
+                 state: Optional["StateTable"] = None):
         super().__init__(ExecutorInfo(
             input_.schema, list(input_.pk_indices), identity))
         self.input = input_
         self.writer = writer
+        # schema-aware writers (FilelogSink field names) bind late:
+        # the planner builds the writer before the chain exists
+        if getattr(writer, "schema", "n/a") is None:
+            writer.schema = input_.schema
         self._pending: List[Tuple[Op, tuple]] = []
+        # durable stream-position counter (the sink coordinator's
+        # epoch-log analog): committed with every checkpoint so a
+        # restarted writer can reconcile what the EXTERNAL side
+        # already has against what the replay will re-send — epoch
+        # numbers are NOT stable across recovery, counts are (sources
+        # replay deterministically from committed offsets)
+        self.state = state
+        self._count = 0
 
     async def execute(self) -> AsyncIterator[Message]:
         it = self.input.execute()
         first = await it.__anext__()
         assert is_barrier(first)
+        if self.state is not None:
+            self.state.init_epoch(first.epoch)
+            row = self.state.get_row((0,))
+            self._count = int(row[1]) if row is not None else 0
+            reconcile = getattr(self.writer, "reset_stream_position",
+                                None)
+            if reconcile is not None:
+                reconcile(self._count)
         yield first
         async for msg in it:
             if is_chunk(msg):
@@ -166,7 +187,137 @@ class SinkExecutor(Executor):
                     if self._pending:
                         self.writer.write_batch(self._pending)
                     self.writer.commit(epoch)
+                    self._count += len(self._pending)
                     self._pending = []
+                    if self.state is not None:
+                        old = self.state.get_row((0,))
+                        new = (0, self._count)
+                        if old is None:
+                            self.state.insert(new)
+                        elif tuple(old) != new:
+                            self.state.update(tuple(old), new)
+                if self.state is not None:
+                    # every barrier advances the table epoch (commit
+                    # asserts continuity); only checkpoints buffered
+                    # a counter write above
+                    self.state.commit(msg.epoch)
                 yield msg
             else:
                 yield msg
+
+
+def _jsonable(v):
+    """Physical value → JSON-safe, recursively (Decimal → str)."""
+    if isinstance(v, bytes):
+        return v.hex()
+    if isinstance(v, (tuple, list)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, (int, float, str, bool)) or v is None:
+        return v
+    return str(v)                           # Decimal and friends
+
+
+class FilelogSink:
+    """EXACTLY-ONCE external sink: one immutable segment per
+    checkpoint epoch, published by atomic rename.
+
+    Reference parity: the coordinated/two-phase sink commit
+    (src/connector/src/sink/mod.rs:156 + the sink coordinator's
+    epoch-aligned commits). PREPARE writes the epoch's records to a
+    staging file; COMMIT is one atomic rename to
+    ``<topic>-<part>.seg-<epoch>.log``.
+
+    Exactly-once rests on STREAM-POSITION reconciliation, not epoch
+    numbers (epochs are not stable across recovery): the SinkExecutor
+    checkpoints a durable record counter C and calls
+    ``reset_stream_position(C)`` on recovery; the sink counts what the
+    segments already hold (P) and silently drops the first P - C
+    replayed records — the crash window between segment publication
+    and the meta checkpoint can therefore never duplicate. Same-epoch
+    recommits additionally dedup by segment name. Output is a
+    segmented filelog topic for SegmentedFileLogReader (records carry
+    ``__op`` so retractions survive the wire).
+    """
+
+    def __init__(self, path: str, topic: str, partition: int = 0,
+                 schema: Optional[Schema] = None):
+        from risingwave_tpu.connectors.filelog import (
+            list_segments, segment_path,
+        )
+        self._segment_path = segment_path
+        self._list_segments = list_segments
+        self.path = path
+        self.topic = topic
+        self.partition = int(partition)
+        self.schema = schema
+        os.makedirs(path, exist_ok=True)
+        self._staging: Optional[str] = None
+        self._f = None
+        self._epoch: Optional[int] = None
+        self._rows_in_epoch = 0
+        self._skip = 0
+        # orphaned staging files from a crash mid-prepare are garbage
+        # (never published): sweep them at construction
+        for name in os.listdir(path):
+            if name.startswith(f".{topic}-{self.partition}.staging-"):
+                os.unlink(os.path.join(path, name))
+
+    def reset_stream_position(self, committed_count: int) -> None:
+        """Recovery reconciliation: P records are already published;
+        the replay resumes at stream position `committed_count` — the
+        first P - committed_count incoming records are duplicates."""
+        published = 0
+        for seg in self._list_segments(self.path, self.topic,
+                                       self.partition):
+            with open(seg, "rb") as f:
+                published += sum(1 for line in f
+                                 if line.endswith(b"\n"))
+        self._skip = max(0, published - committed_count)
+
+    def begin_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+        self._rows_in_epoch = 0
+        self._staging = None
+        self._f = None               # lazily opened on first write
+
+    def _ensure_staging(self):
+        if self._f is None:
+            self._staging = os.path.join(
+                self.path,
+                f".{self.topic}-{self.partition}"
+                f".staging-{self._epoch:016x}")
+            self._f = open(self._staging, "wb")
+        return self._f
+
+    def write_batch(self, records) -> None:
+        names = [f.name for f in self.schema] if self.schema else None
+        if self._skip:
+            take = records[self._skip:]
+            self._skip -= len(records) - len(take)
+            records = take
+        if not records:
+            return
+        f = self._ensure_staging()
+        for op, row in records:
+            obj = {"__op": "I" if op.is_insert else "D"}
+            for i, v in enumerate(row):
+                obj[names[i] if names else f"f{i}"] = _jsonable(v)
+            f.write(json.dumps(obj).encode() + b"\n")
+            self._rows_in_epoch += 1
+
+    def commit(self, epoch: int) -> None:
+        assert epoch == self._epoch
+        if self._f is None:
+            return                   # empty epoch: nothing staged
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        self._f = None
+        target = self._segment_path(self.path, self.topic,
+                                    self.partition, epoch)
+        # _f non-None ⇒ at least one post-skip record was staged
+        if os.path.exists(target):
+            os.unlink(self._staging)     # same-epoch recommit dup
+        else:
+            os.replace(self._staging, target)   # atomic publish
+        self._staging = None
