@@ -137,13 +137,19 @@ class FileLogSplitReader:
 
 
 def segment_path(path: str, topic: str, partition: int,
-                 epoch: int) -> str:
-    return os.path.join(path, f"{topic}-{partition}.seg-{epoch:016x}.log")
+                 start: int) -> str:
+    """Segment file for the records beginning at STREAM POSITION
+    `start` (record index since topic birth). Position-named segments
+    are monotone by construction — epoch numbers are not stable
+    across recovery, so naming by epoch would let a post-crash
+    segment sort before an orphaned pre-crash one."""
+    return os.path.join(path, f"{topic}-{partition}.seg-{start:016x}.log")
 
 
 def list_segments(path: str, topic: str, partition: int):
-    """Committed segment files in epoch order (immutable once named:
-    the sink publishes each epoch by atomic rename)."""
+    """Committed segment files in stream order (immutable once named:
+    the sink publishes each batch by atomic rename; names are the
+    zero-padded start position, so lexicographic = stream order)."""
     pre = f"{topic}-{partition}.seg-"
     try:
         names = [n for n in os.listdir(path)

@@ -167,7 +167,8 @@ class SinkExecutor(Executor):
             reconcile = getattr(self.writer, "reset_stream_position",
                                 None)
             if reconcile is not None:
-                reconcile(self._count)
+                reconcile(self._count,
+                          claim=str(self.state.table_id))
         yield first
         async for msg in it:
             if is_chunk(msg):
@@ -227,16 +228,19 @@ class FilelogSink:
     staging file; COMMIT is one atomic rename to
     ``<topic>-<part>.seg-<epoch>.log``.
 
-    Exactly-once rests on STREAM-POSITION reconciliation, not epoch
-    numbers (epochs are not stable across recovery): the SinkExecutor
-    checkpoints a durable record counter C and calls
-    ``reset_stream_position(C)`` on recovery; the sink counts what the
-    segments already hold (P) and silently drops the first P - C
-    replayed records — the crash window between segment publication
-    and the meta checkpoint can therefore never duplicate. Same-epoch
-    recommits additionally dedup by segment name. Output is a
-    segmented filelog topic for SegmentedFileLogReader (records carry
-    ``__op`` so retractions survive the wire).
+    Exactly-once rests on STREAM POSITIONS, not epoch numbers (epochs
+    are not stable across recovery). Segments are NAMED by the stream
+    position of their first record, so ordering is monotone by
+    construction and the published total reads from the LAST segment
+    alone (its start + its record count). The SinkExecutor checkpoints
+    a durable record counter C and calls ``reset_stream_position(C)``
+    on recovery; the sink silently drops the first P - C replayed
+    records (P = published total) — the crash window between segment
+    publication and the meta checkpoint can therefore never duplicate,
+    and every published segment starts exactly where the previous one
+    ended. Output is a segmented filelog topic for
+    SegmentedFileLogReader (records carry ``__op`` so retractions
+    survive the wire).
     """
 
     def __init__(self, path: str, topic: str, partition: int = 0,
@@ -261,18 +265,57 @@ class FilelogSink:
         for name in os.listdir(path):
             if name.startswith(f".{topic}-{self.partition}.staging-"):
                 os.unlink(os.path.join(path, name))
+        self._published = self._published_total()
 
-    def reset_stream_position(self, committed_count: int) -> None:
-        """Recovery reconciliation: P records are already published;
-        the replay resumes at stream position `committed_count` — the
-        first P - committed_count incoming records are duplicates."""
-        published = 0
-        for seg in self._list_segments(self.path, self.topic,
-                                       self.partition):
-            with open(seg, "rb") as f:
-                published += sum(1 for line in f
-                                 if line.endswith(b"\n"))
-        self._skip = max(0, published - committed_count)
+    def _published_total(self) -> int:
+        """Stream position after the last published record — O(one
+        segment): the name carries the start, only its lines count."""
+        segs = self._list_segments(self.path, self.topic,
+                                   self.partition)
+        if not segs:
+            return 0
+        last = segs[-1]
+        start = int(os.path.basename(last).rsplit("seg-", 1)[1]
+                    .split(".")[0], 16)
+        with open(last, "rb") as f:
+            n = sum(1 for line in f if line.endswith(b"\n"))
+        return start + n
+
+    def reset_stream_position(self, committed_count: int,
+                              claim: Optional[str] = None) -> None:
+        """Recovery reconciliation: the replay resumes at stream
+        position `committed_count`; the first P - committed_count
+        incoming records are already published.
+
+        `claim` disambiguates the one case (C=0, P>0) that positions
+        alone cannot: a crash between the FIRST segment publish and
+        the first counter checkpoint looks identical to a fresh sink
+        pointed at another sink's topic. The claim token (the sink's
+        state-table id — stable across recovery, fresh per CREATE
+        SINK) is written beside the topic on first contact; a
+        mismatch refuses the topic instead of silently skipping or
+        duplicating."""
+        if claim is not None:
+            cpath = os.path.join(
+                self.path, f".{self.topic}-{self.partition}.claim")
+            if os.path.exists(cpath):
+                holder = open(cpath).read().strip()
+                if holder != str(claim):
+                    raise ValueError(
+                        f"topic {self.topic!r} is claimed by sink "
+                        f"{holder!r} (this sink: {claim!r}) — use a "
+                        "fresh topic directory")
+            else:
+                if self._published > 0:
+                    raise ValueError(
+                        f"topic {self.topic!r} already holds "
+                        f"{self._published} unclaimed records — "
+                        "refusing to silently skip or duplicate")
+                tmp = cpath + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(str(claim))
+                os.replace(tmp, cpath)
+        self._skip = max(0, self._published - committed_count)
 
     def begin_epoch(self, epoch: int) -> None:
         self._epoch = epoch
@@ -285,7 +328,7 @@ class FilelogSink:
             self._staging = os.path.join(
                 self.path,
                 f".{self.topic}-{self.partition}"
-                f".staging-{self._epoch:016x}")
+                f".staging-{self._published:016x}")
             self._f = open(self._staging, "wb")
         return self._f
 
@@ -313,11 +356,17 @@ class FilelogSink:
         os.fsync(self._f.fileno())
         self._f.close()
         self._f = None
+        # the segment is NAMED by its start position: every published
+        # segment begins exactly where the previous ended (the skip
+        # reconciliation guarantees it), so a collision here can only
+        # mean a duplicate publisher — fail loudly, never overwrite
         target = self._segment_path(self.path, self.topic,
-                                    self.partition, epoch)
-        # _f non-None ⇒ at least one post-skip record was staged
+                                    self.partition, self._published)
         if os.path.exists(target):
-            os.unlink(self._staging)     # same-epoch recommit dup
-        else:
-            os.replace(self._staging, target)   # atomic publish
+            os.unlink(self._staging)
+            raise RuntimeError(
+                f"segment {target} already exists — two sinks are "
+                "publishing to one topic partition")
+        os.replace(self._staging, target)       # atomic publish
+        self._published += self._rows_in_epoch
         self._staging = None

@@ -86,29 +86,41 @@ def test_filelog_sink_sql_and_exactly_once_restart(tmp_path):
 
 
 def test_filelog_sink_recommit_skips(tmp_path):
-    """Direct 2PC contract: committing an epoch whose segment exists
-    drops the staging (duplicate suppressed)."""
+    """2PC contract, position-named segments: a crashed-and-replayed
+    window re-sends records the segments already hold; reconciliation
+    drops them and NO new segment appears. A fresh sink over a
+    non-empty topic is refused, and two publishers on one partition
+    fail loudly instead of overwriting."""
     from risingwave_tpu.common.chunk import Op
     from risingwave_tpu.stream.executors.sink import FilelogSink
 
     out = str(tmp_path)
     S = Schema.of(a=DataType.INT64)
     w = FilelogSink(out, "t", schema=S)
+    w.reset_stream_position(0, claim="sink-A")
     w.begin_epoch(7)
     w.write_batch([(Op.INSERT, (1,))])
     w.commit(7)
     assert len(list_segments(out, "t", 0)) == 1
-    # replayed epoch: same records re-written, commit must skip
-    w.begin_epoch(7)
-    w.write_batch([(Op.INSERT, (1,))])
-    w.commit(7)
+    # crash BEFORE the first counter checkpoint (C=0, P=1): the claim
+    # proves this is the same sink, and reconciliation drops the
+    # replayed record — no second segment, no duplicate line
+    w2 = FilelogSink(out, "t", schema=S)
+    w2.reset_stream_position(0, claim="sink-A")
+    w2.begin_epoch(999)            # fresh epoch (recovery renumbers)
+    w2.write_batch([(Op.INSERT, (1,))])
+    w2.commit(999)
     segs = list_segments(out, "t", 0)
     assert len(segs) == 1
     assert open(segs[0]).read().count("\n") == 1
     # empty epochs publish nothing
-    w.begin_epoch(8)
-    w.commit(8)
+    w2.begin_epoch(1000)
+    w2.commit(1000)
     assert len(list_segments(out, "t", 0)) == 1
+    # a DIFFERENT sink over the claimed topic: refused
+    w3 = FilelogSink(out, "t", schema=S)
+    with pytest.raises(ValueError, match="claimed"):
+        w3.reset_stream_position(0, claim="sink-B")
     # no staging litter
     assert not [n for n in os.listdir(out) if "staging" in n]
 
@@ -124,7 +136,7 @@ def test_filelog_sink_crash_window_no_duplicates(tmp_path):
     out = str(tmp_path)
     S = Schema.of(a=DataType.INT64)
     w = FilelogSink(out, "t", schema=S)
-    w.reset_stream_position(0)
+    w.reset_stream_position(0, claim="A")
     w.begin_epoch(100)
     w.write_batch([(Op.INSERT, (i,)) for i in range(10)])
     w.commit(100)                       # published [0,10)
@@ -133,7 +145,7 @@ def test_filelog_sink_crash_window_no_duplicates(tmp_path):
     w.commit(200)                       # published [10,15) — but the
     # meta checkpoint for this window is LOST (crash): committed C=10
     w2 = FilelogSink(out, "t", schema=S)
-    w2.reset_stream_position(10)
+    w2.reset_stream_position(10, claim="A")
     # replay re-sends [10,15) under a FRESH epoch + new data [15,18)
     w2.begin_epoch(777)
     w2.write_batch([(Op.INSERT, (i,)) for i in range(10, 18)])
