@@ -60,13 +60,12 @@ def _coerce(v, dt: DataType):
         return (datetime.date.fromisoformat(str(v))
                 - datetime.date(1970, 1, 1)).days
     if dt == DataType.BYTEA:
+        if isinstance(v, dict) and "__b" in v:
+            # the filelog sink's explicit bytes envelope — guessing
+            # hex from a bare string would corrupt hex-LOOKING text
+            return bytes.fromhex(v["__b"])
         if isinstance(v, str):
-            # wire format is HEX (what the filelog sink writes);
-            # non-hex strings fall back to their utf-8 bytes
-            try:
-                return bytes.fromhex(v)
-            except ValueError:
-                return v.encode()
+            return v.encode()
         return bytes(v)
     return str(v)
 

@@ -208,9 +208,12 @@ class SinkExecutor(Executor):
 
 
 def _jsonable(v):
-    """Physical value → JSON-safe, recursively (Decimal → str)."""
+    """Physical value → JSON-safe, recursively (Decimal → str).
+    Bytes ride an explicit ``{"__b": hex}`` envelope — a bare hex
+    string would be indistinguishable from a real string that merely
+    looks like hex on the consuming side."""
     if isinstance(v, bytes):
-        return v.hex()
+        return {"__b": v.hex()}
     if isinstance(v, (tuple, list)):
         return [_jsonable(x) for x in v]
     if isinstance(v, (int, float, str, bool)) or v is None:
