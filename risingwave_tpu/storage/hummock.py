@@ -38,6 +38,13 @@ L0_COMPACT_THRESHOLD = 4
 L1_TARGET_SST_BYTES = 4 * 1024 * 1024
 
 
+def _user_prefix(hex_key: str) -> bytes:
+    """SST-info boundary (hex) → table+user-key prefix: strips the
+    8-byte inverted-epoch suffix, which would mis-order comparisons
+    (shared by the level picker and the L1 binary search)."""
+    return bytes.fromhex(hex_key)[:-8]
+
+
 class HummockLite(StateStore):
     """Single-process LSM store: StateStore for every table id."""
 
@@ -209,7 +216,7 @@ class HummockLite(StateStore):
         lo, hi, ans = 0, len(self._l1) - 1, None
         while lo <= hi:
             mid = (lo + hi) // 2
-            if bytes.fromhex(self._l1[mid]["smallest"])[:-8] <= target:
+            if _user_prefix(self._l1[mid]["smallest"]) <= target:
                 ans = mid
                 lo = mid + 1
             else:
@@ -217,7 +224,7 @@ class HummockLite(StateStore):
         if ans is None:
             return None
         # key beyond this run's largest user key ⇒ in no run (disjoint)
-        if bytes.fromhex(self._l1[ans]["largest"])[:-8] < target:
+        if _user_prefix(self._l1[ans]["largest"]) < target:
             return None
         return ans
 
@@ -318,14 +325,36 @@ class HummockLite(StateStore):
 
     # -- compaction -------------------------------------------------------
     def compact(self) -> None:
-        """Full merge of L0+L1 into fresh key-disjoint L1 runs.
+        """Leveled compaction (level picker): merge L0 with ONLY the
+        L1 runs whose user-key range overlaps L0's — untouched runs
+        carry over unread (manager/compaction picker analog; the r3
+        build rewrote the whole L1 every trigger, O(total LSM) write
+        amplification per compaction instead of O(overlap)).
 
-        Versions shadowed below the committed epoch are dropped; a
-        tombstone that is the newest surviving version of its key is
-        dropped with the key (nothing older remains after a full merge).
-        Old objects are deleted after the new version commits (vacuum).
+        Within the compacted range every level participates, so the
+        old full-merge GC rules hold unchanged there: versions
+        shadowed below the committed epoch drop, and a tombstone that
+        is the newest surviving version drops with its key. Old
+        objects are deleted one compaction cycle later (deferred
+        vacuum).
         """
-        olds = list(self._l0) + list(self._l1)
+        # key range of the L0 files being absorbed (user-key compare:
+        # the inverted-epoch suffix would mis-order full keys)
+        if self._l0:
+            lo = min(_user_prefix(i["smallest"]) for i in self._l0)
+            hi = max(_user_prefix(i["largest"]) for i in self._l0)
+            overlap, keep_lo, keep_hi = [], [], []
+            for info in self._l1:
+                if _user_prefix(info["largest"]) < lo:
+                    keep_lo.append(info)
+                elif _user_prefix(info["smallest"]) > hi:
+                    keep_hi.append(info)
+                else:
+                    overlap.append(info)
+        else:
+            # manual full compaction (ctl / tests): absorb everything
+            overlap, keep_lo, keep_hi = list(self._l1), [], []
+        olds = list(self._l0) + overlap
         if not olds:
             self._commit_version()
             return
@@ -339,7 +368,7 @@ class HummockLite(StateStore):
             *[source(info, r)
               for r, info in enumerate(reversed(list(self._l0)))] +
             [source(info, len(self._l0) + r)
-             for r, info in enumerate(self._l1)],
+             for r, info in enumerate(overlap)],
             key=lambda t: (t[0], t[1]))
 
         new_infos: List[dict] = []
@@ -390,7 +419,9 @@ class HummockLite(StateStore):
             self.obj.upload(f"data/{info['id']}.sst", data)
             new_infos.append(info)
         self._l0 = []
-        self._l1 = new_infos
+        # splice: untouched runs below + rewritten range + above stays
+        # key-disjoint and sorted (the picker chose by range)
+        self._l1 = keep_lo + new_infos + keep_hi
         self._commit_version()
         # DEFERRED vacuum (version-pinning lite): the block cache now
         # fetches lazily, so an iterator opened before this compaction
