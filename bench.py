@@ -122,7 +122,7 @@ def bench_q3(customers: int = 1500, orders: int = 15000):
     return _result("tpch_q3_events_per_sec", elapsed, rows, p.loop)
 
 
-def _probe_device(timeout_s: int = 240, attempts: int = 3) -> None:
+def _probe_device(timeout_s: int = 180, attempts: int = 2) -> None:
     """Fail over to CPU if the TPU backend cannot initialize.
 
     The axon tunnel can wedge (a killed client's remote claim takes
