@@ -75,6 +75,12 @@ class WorkerClient:
         return await self.call({"cmd": "deploy", "fragment": fragment,
                                 "params": params})
 
+    async def deploy_plan(self, plan: list, **params) -> dict:
+        """Ship a plan-IR fragment (stream/plan_ir.py) — the typed
+        StreamNode-shipping path that replaces named fragments."""
+        return await self.call({"cmd": "deploy_plan", "plan": plan,
+                                "params": params})
+
     async def inject(self, barrier: Barrier) -> dict:
         m = None
         if isinstance(barrier.mutation, StopMutation):

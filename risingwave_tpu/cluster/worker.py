@@ -8,10 +8,11 @@ state-store namespace whose checkpoints commit at the SAME epochs the
 coordinator drives, so a recovering cluster resumes consistently from
 the coordinator's committed epoch.
 
-Fragments deploy by NAME from a registry (``FRAGMENTS``) with JSON
-params — the stand-in for stream_plan.proto fragment graphs: the
-control verbs and lifecycles are the reference's; the plan wire schema
-is the next increment.
+Fragments deploy two ways: by SHIPPED PLAN IR (``deploy_plan`` — the
+stream_plan.proto analog; stream/plan_ir.py nodes build into executors
+here, so any expressible plan runs on any worker) or by NAME from the
+legacy ``FRAGMENTS`` registry (``deploy``, kept for the hand-tuned q8
+demo fragments).
 
 Run as a process:  python -m risingwave_tpu.cluster.worker --store DIR
 (prints one JSON line {"control_port": N, "exchange_port": N}).
@@ -170,6 +171,8 @@ class WorkerServer:
         verb = cmd.get("cmd")
         if verb == "deploy":
             return await self._deploy(cmd)
+        if verb == "deploy_plan":
+            return await self._deploy_plan(cmd)
         if verb == "inject":
             return await self._inject(cmd)
         if verb == "ping":
@@ -180,21 +183,56 @@ class WorkerServer:
             return {"ok": True}
         return {"ok": False, "error": f"unknown cmd {verb!r}"}
 
-    async def _deploy(self, cmd: dict) -> dict:
-        frag = FRAGMENTS[cmd["fragment"]]
-        p = cmd["params"]
-        actor_id = int(p["actor_id"])
-        _src, consumer = frag(self, p)   # fragment registers its sender
-        out = self.exchange.register_edge(actor_id,
-                                          int(p["down_actor"]))
+    def _spawn_actor(self, actor_id: int, down_actor: int,
+                     consumer) -> dict:
+        """Shared deploy tail: exchange edge + actor + spawn (one
+        copy — both deploy verbs must wire actors identically)."""
+        out = self.exchange.register_edge(actor_id, down_actor)
         actor = Actor(actor_id, consumer,
                       dispatchers=[SimpleDispatcher(
-                          Output(int(p["down_actor"]), out))],
+                          Output(down_actor, out))],
                       barrier_manager=self.local)
         self.actors[actor_id] = actor
         self.local.set_expected_actors(list(self.actors))
         self.tasks[actor_id] = actor.spawn()
         return {"ok": True, "actor_id": actor_id}
+
+    async def _deploy_plan(self, cmd: dict) -> dict:
+        """Materialize a SHIPPED plan-IR fragment (from_proto/ analog):
+        the coordinator sends the node tree over the control channel
+        and this worker builds + spawns it — no per-query fragment
+        registry, any plan the IR expresses deploys anywhere.
+
+        The fragment's actor id comes from the PLAN's source node (one
+        source of truth — a divergent params id would register the
+        barrier sender under a key the stop path never drops). A build
+        failure after sender registration unregisters it: an undrained
+        barrier channel would wedge every later injection."""
+        from risingwave_tpu.stream.plan_ir import build_fragment
+
+        plan = cmd["plan"]
+        sources = [n for n in plan if n.get("op") == "source"]
+        if len(sources) != 1:
+            return {"ok": False,
+                    "error": "plan must have exactly one source node"}
+        actor_id = int(sources[0]["actor_id"])
+        try:
+            _src, consumer = build_fragment(
+                plan, self.store, self.local, channel_for_test)
+        except BaseException as e:     # noqa: BLE001 — report upstream
+            self.local.drop_actor(actor_id)
+            return {"ok": False, "error": f"plan build failed: {e}"}
+        return self._spawn_actor(actor_id,
+                                 int(cmd["params"]["down_actor"]),
+                                 consumer)
+
+    async def _deploy(self, cmd: dict) -> dict:
+        frag = FRAGMENTS[cmd["fragment"]]
+        p = cmd["params"]
+        actor_id = int(p["actor_id"])
+        _src, consumer = frag(self, p)   # fragment registers its sender
+        return self._spawn_actor(actor_id, int(p["down_actor"]),
+                                 consumer)
 
     async def _inject(self, cmd: dict) -> dict:
         pair = EpochPair(Epoch(int(cmd["curr"])),

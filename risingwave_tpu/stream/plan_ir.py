@@ -1,0 +1,184 @@
+"""Typed streaming plan IR: serializable fragments + executor factory.
+
+Reference parity: the plan/service protos (SURVEY §2.2 — the reference
+ships `StreamNode` protobufs from meta's fragmenter to compute nodes,
+src/stream/src/from_proto/ builds executors from them). TPU re-design:
+a JSON-able node tree — `source → project/filter → hash_agg → …` —
+plus `build_fragment`, the plan-IR→executor factory. The coordinator
+ships a fragment IR over the control channel and ANY worker
+materializes it (no more per-query hand-wired fragment functions);
+expressions serialize with full fidelity through `expr_to_ir`.
+
+Node shapes (dicts, `op` discriminated):
+  {"op": "source", "connector": {...opts}, "schema": [...],
+   "actor_id": n, "split_table_id": n, "rate_limit": n,
+   "min_chunks": n}
+  {"op": "project", "input": N, "exprs": [...], "names": [...]}
+  {"op": "filter",  "input": N, "pred": EXPR}
+  {"op": "row_id_gen", "input": N}
+  {"op": "hash_agg", "input": N, "group": [...],
+   "calls": [{"kind","input_idx","distinct","delimiter"}],
+   "table_id": n, "append_only": bool, "output_names": [...]}
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from risingwave_tpu.common.types import (
+    DataType, Field, Interval, Schema,
+)
+from risingwave_tpu.expr.expr import (
+    BinaryOp, Case, Cast, Expression, FuncCall, InputRef, Literal,
+    UnaryOp,
+)
+
+# -- expression serde -----------------------------------------------------
+
+
+def expr_to_ir(e: Expression) -> dict:
+    if isinstance(e, InputRef):
+        return {"t": "input", "i": e.index, "dt": e.return_type.value}
+    if isinstance(e, Literal):
+        v = e.value
+        if isinstance(v, Interval):
+            v = {"__interval": [v.months, v.days, v.usecs]}
+        return {"t": "lit", "v": v, "dt": e.return_type.value}
+    if isinstance(e, BinaryOp):
+        return {"t": "bin", "op": e.op, "l": expr_to_ir(e.left),
+                "r": expr_to_ir(e.right)}
+    if isinstance(e, UnaryOp):
+        return {"t": "un", "op": e.op, "c": expr_to_ir(e.child)}
+    if isinstance(e, Cast):
+        return {"t": "cast", "c": expr_to_ir(e.child),
+                "dt": e.return_type.value}
+    if isinstance(e, Case):
+        return {"t": "case",
+                "whens": [[expr_to_ir(c), expr_to_ir(v)]
+                          for c, v in e.whens],
+                "else": expr_to_ir(e.else_)}
+    if isinstance(e, FuncCall):
+        return {"t": "fn", "name": e.name,
+                "dt": e.return_type.value,
+                "args": [expr_to_ir(a) for a in e.args]}
+    raise TypeError(f"unserializable expression {type(e).__name__}")
+
+
+def _const_from_ir(v):
+    if isinstance(v, dict) and "__interval" in v:
+        m, d, us = v["__interval"]
+        return Interval(months=m, days=d, usecs=us)
+    return v
+
+
+def expr_from_ir(d: dict) -> Expression:
+    t = d["t"]
+    if t == "input":
+        return InputRef(d["i"], DataType(d["dt"]))
+    if t == "lit":
+        v = _const_from_ir(d["v"])
+        return Literal(v, DataType(d["dt"]))
+    if t == "bin":
+        return BinaryOp(d["op"], expr_from_ir(d["l"]),
+                        expr_from_ir(d["r"]))
+    if t == "un":
+        return UnaryOp(d["op"], expr_from_ir(d["c"]))
+    if t == "cast":
+        return Cast(expr_from_ir(d["c"]), DataType(d["dt"]))
+    if t == "case":
+        return Case([(expr_from_ir(c), expr_from_ir(v))
+                     for c, v in d["whens"]],
+                    expr_from_ir(d["else"]))
+    if t == "fn":
+        return FuncCall(d["name"],
+                        [expr_from_ir(a) for a in d["args"]],
+                        DataType(d["dt"]))
+    raise TypeError(f"unknown expression IR {t!r}")
+
+
+def schema_to_ir(schema: Schema) -> List[dict]:
+    return [{"name": f.name, "dt": f.data_type.value} for f in schema]
+
+
+def schema_from_ir(ir: List[dict]) -> Schema:
+    return Schema([Field(f["name"], DataType(f["dt"])) for f in ir])
+
+
+# -- fragment factory (from_proto/ analog) --------------------------------
+
+
+def build_fragment(nodes: List[dict], store, local,
+                   channel_factory) -> tuple:
+    """IR node list (topological; `input` indexes earlier nodes) →
+    (source_executor, consumer_executor). `channel_factory()` returns
+    (tx, rx) for the source's barrier channel; the caller registers
+    tx with its barrier manager under the source's actor id."""
+    from risingwave_tpu.frontend.planner import (
+        SPLIT_STATE_SCHEMA, _source_reader,
+    )
+    from risingwave_tpu.frontend.catalog import SourceCatalog
+    from risingwave_tpu.state.state_table import StateTable
+    from risingwave_tpu.stream.executors.hash_agg import (
+        AggCall, HashAggExecutor, agg_state_schema,
+    )
+    from risingwave_tpu.stream.executors.row_id_gen import (
+        RowIdGenExecutor,
+    )
+    from risingwave_tpu.stream.executors.simple import (
+        FilterExecutor, ProjectExecutor,
+    )
+    from risingwave_tpu.stream.executors.source import SourceExecutor
+    from risingwave_tpu.ops.hash_agg import AggKind
+
+    built: List[object] = []
+    src_executor = None
+    for node in nodes:
+        op = node["op"]
+        if op == "source":
+            cat = SourceCatalog(
+                name=node.get("name", "src"), source_id=0,
+                schema=schema_from_ir(node["schema"]),
+                options=dict(node["connector"]))
+            reader = _source_reader(cat)
+            tx, rx = channel_factory()
+            split = StateTable(int(node["split_table_id"]),
+                               SPLIT_STATE_SCHEMA, [0], store)
+            local.register_sender(int(node["actor_id"]), tx)
+            ex = SourceExecutor(
+                reader, rx, split, actor_id=int(node["actor_id"]),
+                rate_limit_chunks_per_barrier=node.get("rate_limit"),
+                min_chunks_per_barrier=node.get("min_chunks"))
+            src_executor = ex
+        elif op == "project":
+            child = built[node["input"]]
+            ex = ProjectExecutor(
+                child, [expr_from_ir(e) for e in node["exprs"]],
+                node["names"])
+        elif op == "filter":
+            child = built[node["input"]]
+            ex = FilterExecutor(child, expr_from_ir(node["pred"]))
+        elif op == "row_id_gen":
+            ex = RowIdGenExecutor(built[node["input"]])
+        elif op == "hash_agg":
+            child = built[node["input"]]
+            calls = [AggCall(AggKind(c["kind"]),
+                             c.get("input_idx"),
+                             distinct=bool(c.get("distinct", False)),
+                             delimiter=c.get("delimiter", ","))
+                     for c in node["calls"]]
+            group = list(node["group"])
+            sch, pk = agg_state_schema(child.schema, group, calls)
+            table = StateTable(int(node["table_id"]), sch, pk, store,
+                               dist_key_indices=list(range(len(pk))))
+            # default FALSE like HashAggExecutor itself: a silently
+            # append-only agg over a retracting input would produce
+            # wrong results; False at worst raises a clean
+            # missing-minput error at construction
+            ex = HashAggExecutor(
+                child, group, calls, table,
+                append_only=bool(node.get("append_only", False)),
+                output_names=node.get("output_names"))
+        else:
+            raise ValueError(f"unknown plan-IR op {op!r}")
+        built.append(ex)
+    return src_executor, built[-1]
