@@ -212,15 +212,21 @@ class WorkerBarrierSender:
 class WorkerHandle:
     """Spawn + own a worker subprocess (GlobalStreamManager's node)."""
 
-    def __init__(self, store_dir: str):
+    def __init__(self, store_dir: str, platform: str = "cpu"):
         self.store_dir = store_dir
+        self.platform = platform
         self.proc: Optional[subprocess.Popen] = None
         self.client: Optional[WorkerClient] = None
 
     async def start(self, timeout_s: float = 60.0) -> WorkerClient:
         import os
         env = dict(os.environ)
-        env.setdefault("JAX_PLATFORMS", "cpu")
+        # pin, don't setdefault: an ambient JAX_PLATFORMS naming an
+        # accelerator (e.g. a tunneled TPU) would otherwise leak into
+        # every worker, and a worker's first jax op blocks forever if
+        # that tunnel is down. Callers opt INTO an accelerator via
+        # platform=; the default worker is a CPU host process.
+        env["JAX_PLATFORMS"] = self.platform
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "risingwave_tpu.cluster.worker",
              "--store", self.store_dir],

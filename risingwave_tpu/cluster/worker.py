@@ -197,6 +197,24 @@ class WorkerServer:
         self.tasks[actor_id] = actor.spawn()
         return {"ok": True, "actor_id": actor_id}
 
+    def _guarded_spawn(self, actor_id: int, down_actor: int,
+                       build, what: str) -> dict:
+        """Shared deploy guard (one copy — both deploy verbs must
+        fail identically): refuse duplicate actor ids BEFORE anything
+        registers (the failure-path drop_actor would otherwise pop a
+        LIVE actor's barrier sender along with the half-built one),
+        and unwind the sender a failed build registered — an
+        undrained bounded barrier channel wedges injection."""
+        if actor_id in self.actors:
+            return {"ok": False,
+                    "error": f"actor {actor_id} already deployed"}
+        try:
+            consumer = build()
+            return self._spawn_actor(actor_id, down_actor, consumer)
+        except BaseException as e:     # noqa: BLE001 — report upstream
+            self.local.drop_actor(actor_id)
+            return {"ok": False, "error": f"{what} failed: {e}"}
+
     async def _deploy_plan(self, cmd: dict) -> dict:
         """Materialize a SHIPPED plan-IR fragment (from_proto/ analog):
         the coordinator sends the node tree over the control channel
@@ -215,24 +233,35 @@ class WorkerServer:
         if len(sources) != 1:
             return {"ok": False,
                     "error": "plan must have exactly one source node"}
-        actor_id = int(sources[0]["actor_id"])
         try:
-            _src, consumer = build_fragment(
-                plan, self.store, self.local, channel_for_test)
-        except BaseException as e:     # noqa: BLE001 — report upstream
-            self.local.drop_actor(actor_id)
-            return {"ok": False, "error": f"plan build failed: {e}"}
-        return self._spawn_actor(actor_id,
-                                 int(cmd["params"]["down_actor"]),
-                                 consumer)
+            # validate EVERYTHING that could fail before building:
+            # build_fragment registers the source's barrier sender,
+            # and a post-build failure would leave it undrained
+            down_actor = int(cmd["params"]["down_actor"])
+        except (KeyError, TypeError, ValueError) as e:
+            return {"ok": False, "error": f"bad down_actor: {e}"}
+        actor_id = int(sources[0]["actor_id"])
+        sent = cmd["params"].get("actor_id")
+        if sent is not None and int(sent) != actor_id:
+            # the PLAN is the source of truth; silently deploying under
+            # a different id than the caller thinks would wedge its
+            # stop/tracking path with no diagnostic
+            return {"ok": False,
+                    "error": f"params actor_id {sent} != plan "
+                             f"source actor_id {actor_id}"}
+        return self._guarded_spawn(
+            actor_id, down_actor,
+            lambda: build_fragment(plan, self.store, self.local,
+                                   channel_for_test)[1],
+            "plan build")
 
     async def _deploy(self, cmd: dict) -> dict:
         frag = FRAGMENTS[cmd["fragment"]]
         p = cmd["params"]
-        actor_id = int(p["actor_id"])
-        _src, consumer = frag(self, p)   # fragment registers its sender
-        return self._spawn_actor(actor_id, int(p["down_actor"]),
-                                 consumer)
+        return self._guarded_spawn(
+            int(p["actor_id"]), int(p["down_actor"]),
+            lambda: frag(self, p)[1],   # fragment registers its sender
+            "deploy")
 
     async def _inject(self, cmd: dict) -> dict:
         pair = EpochPair(Epoch(int(cmd["curr"])),

@@ -732,28 +732,12 @@ class StreamPlanner:
         # retracting upstreams, e.g. GROUP BY over an outer join)
         append_only = self._derive_append_only(ex)
         from risingwave_tpu.stream.executors.hash_agg import (
-            AggKind, minput_state_schema,
+            agg_aux_tables,
         )
-        distinct_tables = {}
-        for c in calls:
-            if c.distinct and c.input_idx not in distinct_tables:
-                dsch, dpk, ddk = minput_state_schema(
-                    pre.schema, list(range(g)), c)
-                distinct_tables[c.input_idx] = StateTable(
-                    self.catalog.next_id(), dsch, dpk, self.store,
-                    dist_key_indices=ddk)
-        from risingwave_tpu.ops.hash_agg import HOST_AGG_KINDS
-        minput_tables = {}
-        for j, c in enumerate(calls):
-            # retractable MIN/MAX need the value multiset; host aggs
-            # (string_agg/array_agg) ARE their value multiset
-            if (c.kind in (AggKind.MIN, AggKind.MAX)
-                    and not append_only) or c.kind in HOST_AGG_KINDS:
-                msch, mpk, mdk = minput_state_schema(
-                    pre.schema, list(range(g)), c)
-                minput_tables[j] = StateTable(
-                    self.catalog.next_id(), msch, mpk, self.store,
-                    dist_key_indices=mdk)
+        distinct_tables, minput_tables = agg_aux_tables(
+            pre.schema, list(range(g)), calls, append_only, self.store,
+            dedup_table_id=lambda _col: self.catalog.next_id(),
+            minput_table_id=lambda _j: self.catalog.next_id())
         kernel = None
         if self.mesh is not None:
             # parallel plan: the hash exchange that the reference's

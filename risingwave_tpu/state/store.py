@@ -71,6 +71,34 @@ class _Table:
             self._dirty = False
         return self.keys
 
+    def put_batch(self, batch: Iterable[Tuple[bytes, Value]],
+                  epoch: int) -> int:
+        """Barrier-flush hot loop (one call per written key per
+        epoch; a method call per key costs ~1/3 of q8 throughput).
+        Inlines put()'s new-key insert and newest-at-head update —
+        the in-order cases every barrier flush hits — and falls back
+        to put() only for out-of-order epoch ingest. Keep the two in
+        lockstep with put() below."""
+        versions = self.versions
+        keys = self.keys
+        n = 0
+        for key, value in batch:
+            vs = versions.get(key)
+            if vs is None:
+                versions[key] = [(epoch, value)]
+                keys.append(key)
+                self._dirty = True
+            else:
+                e0 = vs[0][0]
+                if e0 == epoch:
+                    vs[0] = (epoch, value)
+                elif e0 < epoch:
+                    vs.insert(0, (epoch, value))
+                else:
+                    self.put(key, epoch, value)
+            n += 1
+        return n
+
     def put(self, key: bytes, epoch: int, value: Value) -> None:
         vs = self.versions.get(key)
         if vs is None:
@@ -120,12 +148,7 @@ class MemoryStateStore(StateStore):
         if epoch <= self._sealed_epoch:
             raise ValueError(
                 f"write at epoch {epoch} <= sealed {self._sealed_epoch}")
-        t = self._table(table_id)
-        n = 0
-        for key, value in batch:
-            t.put(key, epoch, value)
-            n += 1
-        return n
+        return self._table(table_id).put_batch(batch, epoch)
 
     def seal_epoch(self, epoch: int, is_checkpoint: bool = True) -> None:
         assert epoch >= self._sealed_epoch, (epoch, self._sealed_epoch)

@@ -135,6 +135,47 @@ def minput_state_schema(input_schema: Schema,
     return Schema(fields), list(range(g + 1)), list(range(g))
 
 
+def agg_aux_tables(input_schema: Schema,
+                   group_indices: Sequence[int],
+                   agg_calls: Sequence["AggCall"], append_only: bool,
+                   store, dedup_table_id, minput_table_id
+                   ) -> Tuple[Dict[int, StateTable],
+                              Dict[int, StateTable]]:
+    """Build the aux state tables HashAggExecutor needs:
+    per-DISTINCT-column dedup tables and per-call materialized-input
+    tables (retractable MIN/MAX + host aggs). The ONE selection rule
+    shared by the planner and the shipped-plan factory — both callers
+    must agree or the same query gets different state tables.
+
+    ``dedup_table_id(input_idx)`` / ``minput_table_id(call_idx)``
+    supply ids. Iteration order is dedup tables first (call order,
+    first DISTINCT occurrence per column), then minput tables in call
+    order — the planner's sequential-id replay contract (ALTER
+    PARALLELISM re-plans from a recorded id base) depends on it.
+
+    Returns (distinct_tables, minput_tables)."""
+    distinct_tables: Dict[int, StateTable] = {}
+    for c in agg_calls:
+        if c.distinct and c.input_idx not in distinct_tables:
+            dsch, dpk, ddk = minput_state_schema(
+                input_schema, group_indices, c)
+            distinct_tables[c.input_idx] = StateTable(
+                dedup_table_id(c.input_idx), dsch, dpk, store,
+                dist_key_indices=ddk)
+    minput_tables: Dict[int, StateTable] = {}
+    for j, c in enumerate(agg_calls):
+        # retractable MIN/MAX need the value multiset; host aggs
+        # (string_agg/array_agg) ARE their value multiset
+        if ((c.kind in (AggKind.MIN, AggKind.MAX)
+             and not append_only) or c.kind in HOST_AGG_KINDS):
+            msch, mpk, mdk = minput_state_schema(
+                input_schema, group_indices, c)
+            minput_tables[j] = StateTable(
+                minput_table_id(j), msch, mpk, store,
+                dist_key_indices=mdk)
+    return distinct_tables, minput_tables
+
+
 class HashAggExecutor(Executor):
     """Streaming hash aggregation over a device kernel (hash_agg.rs:67)."""
 

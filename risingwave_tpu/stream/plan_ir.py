@@ -18,11 +18,15 @@ Node shapes (dicts, `op` discriminated):
   {"op": "row_id_gen", "input": N}
   {"op": "hash_agg", "input": N, "group": [...],
    "calls": [{"kind","input_idx","distinct","delimiter"}],
-   "table_id": n, "append_only": bool, "output_names": [...]}
+   "table_id": n, "append_only": bool, "output_names": [...],
+   "dedup_table_ids": {input_idx: n},   # required per DISTINCT column
+   "minput_table_ids": {call_idx: n}}   # required per retractable
+                                        # min/max + per host agg
 """
 
 from __future__ import annotations
 
+import decimal
 from typing import Dict, List, Optional
 
 from risingwave_tpu.common.types import (
@@ -43,6 +47,10 @@ def expr_to_ir(e: Expression) -> dict:
         v = e.value
         if isinstance(v, Interval):
             v = {"__interval": [v.months, v.days, v.usecs]}
+        elif isinstance(v, bytes):
+            v = {"__bytes": v.hex()}
+        elif isinstance(v, decimal.Decimal):
+            v = {"__decimal": str(v)}
         return {"t": "lit", "v": v, "dt": e.return_type.value}
     if isinstance(e, BinaryOp):
         return {"t": "bin", "op": e.op, "l": expr_to_ir(e.left),
@@ -65,9 +73,14 @@ def expr_to_ir(e: Expression) -> dict:
 
 
 def _const_from_ir(v):
-    if isinstance(v, dict) and "__interval" in v:
-        m, d, us = v["__interval"]
-        return Interval(months=m, days=d, usecs=us)
+    if isinstance(v, dict):
+        if "__interval" in v:
+            m, d, us = v["__interval"]
+            return Interval(months=m, days=d, usecs=us)
+        if "__bytes" in v:
+            return bytes.fromhex(v["__bytes"])
+        if "__decimal" in v:
+            return decimal.Decimal(v["__decimal"])
     return v
 
 
@@ -119,7 +132,7 @@ def build_fragment(nodes: List[dict], store, local,
     from risingwave_tpu.frontend.catalog import SourceCatalog
     from risingwave_tpu.state.state_table import StateTable
     from risingwave_tpu.stream.executors.hash_agg import (
-        AggCall, HashAggExecutor, agg_state_schema,
+        AggCall, HashAggExecutor, agg_aux_tables, agg_state_schema,
     )
     from risingwave_tpu.stream.executors.row_id_gen import (
         RowIdGenExecutor,
@@ -174,10 +187,35 @@ def build_fragment(nodes: List[dict], store, local,
             # append-only agg over a retracting input would produce
             # wrong results; False at worst raises a clean
             # missing-minput error at construction
+            append_only = bool(node.get("append_only", False))
+            # aux state tables, ids shipped in the IR (the coordinator
+            # owns catalog id allocation; deriving ids here could
+            # collide with other fragments sharing the store)
+            dedup_ids = {int(k): int(v) for k, v in
+                         (node.get("dedup_table_ids") or {}).items()}
+            minput_ids = {int(k): int(v) for k, v in
+                          (node.get("minput_table_ids") or {}).items()}
+
+            def _shipped_id(ids, field, key):
+                tid = ids.get(key)
+                if tid is None:
+                    raise ValueError(
+                        f"hash_agg: ship {field}[{key}] — the agg "
+                        "needs that aux state table")
+                return tid
+
+            distinct_tables, minput_tables = agg_aux_tables(
+                child.schema, group, calls, append_only, store,
+                dedup_table_id=lambda col: _shipped_id(
+                    dedup_ids, "dedup_table_ids", col),
+                minput_table_id=lambda j: _shipped_id(
+                    minput_ids, "minput_table_ids", j))
             ex = HashAggExecutor(
                 child, group, calls, table,
-                append_only=bool(node.get("append_only", False)),
-                output_names=node.get("output_names"))
+                append_only=append_only,
+                output_names=node.get("output_names"),
+                distinct_tables=distinct_tables,
+                minput_tables=minput_tables)
         else:
             raise ValueError(f"unknown plan-IR op {op!r}")
         built.append(ex)

@@ -40,6 +40,12 @@ def test_expr_ir_roundtrip():
         back = expr_from_ir(ir)
         assert repr(back) == repr(e) or \
             expr_to_ir(back) == expr_to_ir(e)
+    import decimal
+    for v, dt in [(decimal.Decimal("12.34"), DataType.DECIMAL),
+                  (b"\x00\xffbin", DataType.BYTEA)]:
+        ir = json.loads(json.dumps(expr_to_ir(Literal(v, dt))))
+        back = expr_from_ir(ir)
+        assert back.value == v and type(back.value) is type(v)
     s = Schema.of(a=DataType.INT64, b=DataType.VARCHAR)
     assert schema_from_ir(json.loads(json.dumps(
         schema_to_ir(s))))[1].name == "b"
@@ -73,6 +79,23 @@ def _q7ish_plan(event_num: int, actor_id: int) -> list:
          "table_id": 202, "append_only": True,
          "output_names": ["max_price", "bid_count"]},
     ]
+
+
+def _q7_oracle(n: int) -> dict:
+    """window_start → (max_price, count) over the same bid stream."""
+    import numpy as np
+
+    from risingwave_tpu.connectors.nexmark import NexmarkConfig, gen_bids
+
+    bids = gen_bids(np.arange(n * 46 // 50, dtype=np.int64),
+                    NexmarkConfig(event_num=n, max_chunk_size=256))
+    want = {}
+    for t, p in zip(bids["date_time"].tolist(),
+                    bids["price"].tolist()):
+        w = t // 10_000_000 * 10_000_000
+        mx, c = want.get(w, (0, 0))
+        want[w] = (max(mx, p), c + 1)
+    return want
 
 
 def test_build_fragment_runs_locally():
@@ -112,15 +135,7 @@ def test_build_fragment_runs_locally():
 
     asyncio.run(run())
     got = {r[0]: (r[1], r[2]) for _pk, r in mv.iter_rows()}
-    bids = gen_bids(np.arange(n * 46 // 50, dtype=np.int64),
-                    NexmarkConfig(event_num=n, max_chunk_size=256))
-    want = {}
-    for t, p in zip(bids["date_time"].tolist(),
-                    bids["price"].tolist()):
-        w = t // 10_000_000 * 10_000_000
-        mx, c = want.get(w, (0, 0))
-        want[w] = (max(mx, p), c + 1)
-    assert got == want
+    assert got == _q7_oracle(n)
 
 
 def test_shipped_plan_on_real_worker(tmp_path):
@@ -179,15 +194,39 @@ def test_shipped_plan_on_real_worker(tmp_path):
             await handle.stop()
 
     got = asyncio.run(main())
-    import numpy as np
+    assert got == _q7_oracle(n)
 
-    from risingwave_tpu.connectors.nexmark import NexmarkConfig, gen_bids
-    bids = gen_bids(np.arange(n * 46 // 50, dtype=np.int64),
-                    NexmarkConfig(event_num=n, max_chunk_size=256))
-    want = {}
-    for t, p in zip(bids["date_time"].tolist(),
-                    bids["price"].tolist()):
-        w = t // 10_000_000 * 10_000_000
-        mx, c = want.get(w, (0, 0))
-        want[w] = (max(mx, p), c + 1)
-    assert got == want
+
+def test_build_fragment_agg_aux_tables():
+    """DISTINCT / retractable min-max calls build their dedup and
+    minput state tables from the IR's shipped table ids, and a plan
+    missing a required id fails loudly at build (not at runtime)."""
+    from risingwave_tpu.state.store import MemoryStateStore
+    from risingwave_tpu.stream.actor import LocalBarrierManager
+    from risingwave_tpu.stream.exchange import channel_for_test
+
+    def plan(**agg_extra):
+        node = {"op": "hash_agg", "input": 1, "group": [0],
+                "calls": [
+                    {"kind": "count", "input_idx": 1,
+                     "distinct": True},
+                    {"kind": "min", "input_idx": 1}],
+                "table_id": 302, "append_only": False,
+                "output_names": ["dcount", "mn"]}
+        node.update(agg_extra)
+        return _q7ish_plan(100, actor_id=9)[:2] + [node]
+
+    store = MemoryStateStore()
+    local = LocalBarrierManager()
+    _src, agg = build_fragment(
+        plan(dedup_table_ids={"1": 303}, minput_table_ids={"1": 304}),
+        store, local, channel_for_test)
+    assert set(agg.distinct_tables) == {1}
+    assert agg.distinct_tables[1].table_id == 303
+    assert agg.minput[1].table_id == 304
+    for bad in [plan(minput_table_ids={"1": 304}),
+                plan(dedup_table_ids={"1": 303})]:
+        local2 = LocalBarrierManager()
+        with pytest.raises(ValueError, match="table_ids"):
+            build_fragment(bad, MemoryStateStore(), local2,
+                           channel_for_test)
