@@ -183,21 +183,26 @@ class WorkerServer:
             return {"ok": True}
         return {"ok": False, "error": f"unknown cmd {verb!r}"}
 
-    def _spawn_actor(self, actor_id: int, down_actor: int,
+    def _spawn_actor(self, actor_id: int, down_actor: Optional[int],
                      consumer) -> dict:
         """Shared deploy tail: exchange edge + actor + spawn (one
-        copy — both deploy verbs must wire actors identically)."""
-        out = self.exchange.register_edge(actor_id, down_actor)
-        actor = Actor(actor_id, consumer,
-                      dispatchers=[SimpleDispatcher(
-                          Output(down_actor, out))],
+        copy — both deploy verbs must wire actors identically).
+        down_actor=None: terminal fragment (e.g. a materialize) —
+        no exchange edge; an edge nobody consumes would buffer
+        chunks until the credit window blocks the actor."""
+        dispatchers = []
+        if down_actor is not None:
+            out = self.exchange.register_edge(actor_id, down_actor)
+            dispatchers = [SimpleDispatcher(Output(down_actor, out))]
+        actor = Actor(actor_id, consumer, dispatchers=dispatchers,
                       barrier_manager=self.local)
         self.actors[actor_id] = actor
         self.local.set_expected_actors(list(self.actors))
         self.tasks[actor_id] = actor.spawn()
         return {"ok": True, "actor_id": actor_id}
 
-    def _guarded_spawn(self, actor_id: int, down_actor: int,
+    def _guarded_spawn(self, actor_id: int,
+                       down_actor: Optional[int],
                        build, what: str) -> dict:
         """Shared deploy guard (one copy — both deploy verbs must
         fail identically): refuse duplicate actor ids BEFORE anything
@@ -230,29 +235,44 @@ class WorkerServer:
 
         plan = cmd["plan"]
         sources = [n for n in plan if n.get("op") == "source"]
-        if len(sources) != 1:
+        remote_fed = any(n.get("op") == "remote_input" for n in plan)
+        if len(sources) > 1 or (not sources and not remote_fed):
             return {"ok": False,
-                    "error": "plan must have exactly one source node"}
+                    "error": "plan must have exactly one source node "
+                             "or be fed by remote_input nodes"}
         try:
             # validate EVERYTHING that could fail before building:
             # build_fragment registers the source's barrier sender,
-            # and a post-build failure would leave it undrained
-            down_actor = int(cmd["params"]["down_actor"])
+            # and a post-build failure would leave it undrained.
+            # Terminal fragments (no exchange edge) must say so with
+            # an EXPLICIT down_actor=None — a merely omitted key is a
+            # wiring typo that would otherwise deploy ok and then
+            # starve the downstream actor with no diagnostic
+            raw_down = cmd["params"]["down_actor"]
+            down_actor = None if raw_down is None else int(raw_down)
         except (KeyError, TypeError, ValueError) as e:
             return {"ok": False, "error": f"bad down_actor: {e}"}
-        actor_id = int(sources[0]["actor_id"])
         sent = cmd["params"].get("actor_id")
-        if sent is not None and int(sent) != actor_id:
-            # the PLAN is the source of truth; silently deploying under
-            # a different id than the caller thinks would wedge its
-            # stop/tracking path with no diagnostic
+        if sources:
+            actor_id = int(sources[0]["actor_id"])
+            if sent is not None and int(sent) != actor_id:
+                # the PLAN is the source of truth; silently deploying
+                # under a different id than the caller thinks would
+                # wedge its stop/tracking path with no diagnostic
+                return {"ok": False,
+                        "error": f"params actor_id {sent} != plan "
+                                 f"source actor_id {actor_id}"}
+        elif sent is None:
             return {"ok": False,
-                    "error": f"params actor_id {sent} != plan "
-                             f"source actor_id {actor_id}"}
+                    "error": "a remote-fed plan needs params "
+                             "actor_id (no source node carries one)"}
+        else:
+            actor_id = int(sent)
         return self._guarded_spawn(
             actor_id, down_actor,
             lambda: build_fragment(plan, self.store, self.local,
-                                   channel_for_test)[1],
+                                   channel_for_test,
+                                   actor_id=actor_id)[1],
             "plan build")
 
     async def _deploy(self, cmd: dict) -> dict:

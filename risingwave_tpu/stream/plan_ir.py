@@ -22,6 +22,17 @@ Node shapes (dicts, `op` discriminated):
    "dedup_table_ids": {input_idx: n},   # required per DISTINCT column
    "minput_table_ids": {call_idx: n}}   # required per retractable
                                         # min/max + per host agg
+  {"op": "remote_input", "host": h, "port": n, "up_actor": n,
+   "schema": [...]}                     # consume another fragment's
+                                        # exchange; barriers arrive
+                                        # in-band, so a fragment fed
+                                        # only by these has no source
+  {"op": "hash_join", "left": N, "right": N, "left_keys": [...],
+   "right_keys": [...], "left_table_id": n, "right_table_id": n,
+   "left_pk": [...], "right_pk": [...], "join_type": "inner",
+   "left_dist_key": [...], "right_dist_key": [...],  # optional:
+   "output_names": [...]}   # vnode dist of the join state tables
+  {"op": "materialize", "input": N, "table_id": n, "pk": [...]}
 """
 
 from __future__ import annotations
@@ -121,11 +132,16 @@ def schema_from_ir(ir: List[dict]) -> Schema:
 
 
 def build_fragment(nodes: List[dict], store, local,
-                   channel_factory) -> tuple:
+                   channel_factory, actor_id: Optional[int] = None
+                   ) -> tuple:
     """IR node list (topological; `input` indexes earlier nodes) →
     (source_executor, consumer_executor). `channel_factory()` returns
     (tx, rx) for the source's barrier channel; the caller registers
-    tx with its barrier manager under the source's actor id."""
+    tx with its barrier manager under the source's actor id.
+    `actor_id` is THIS fragment's actor — required for remote_input
+    nodes (the exchange edge is keyed (up_actor, down_actor)); a
+    remote-fed fragment returns source_executor=None since its
+    barriers arrive in-band over the exchange."""
     from risingwave_tpu.frontend.planner import (
         SPLIT_STATE_SCHEMA, _source_reader,
     )
@@ -172,6 +188,42 @@ def build_fragment(nodes: List[dict], store, local,
             ex = FilterExecutor(child, expr_from_ir(node["pred"]))
         elif op == "row_id_gen":
             ex = RowIdGenExecutor(built[node["input"]])
+        elif op == "remote_input":
+            from risingwave_tpu.stream.remote import RemoteInput
+            if actor_id is None:
+                raise ValueError(
+                    "remote_input needs the fragment's actor_id")
+            ex = RemoteInput(node["host"], int(node["port"]),
+                             int(node["up_actor"]), int(actor_id),
+                             schema_from_ir(node["schema"]))
+        elif op == "hash_join":
+            from risingwave_tpu.stream.executors.hash_join import (
+                HashJoinExecutor, JoinType,
+            )
+            left = built[node["left"]]
+            right = built[node["right"]]
+            lt = StateTable(int(node["left_table_id"]), left.schema,
+                            [int(i) for i in node["left_pk"]], store,
+                            dist_key_indices=node.get("left_dist_key"))
+            rt = StateTable(int(node["right_table_id"]), right.schema,
+                            [int(i) for i in node["right_pk"]], store,
+                            dist_key_indices=node.get(
+                                "right_dist_key"))
+            ex = HashJoinExecutor(
+                left, right,
+                [int(i) for i in node["left_keys"]],
+                [int(i) for i in node["right_keys"]], lt, rt,
+                actor_id=int(actor_id or 0),
+                join_type=JoinType(node.get("join_type", "inner")),
+                output_names=node.get("output_names"))
+        elif op == "materialize":
+            from risingwave_tpu.stream.executors.materialize import (
+                MaterializeExecutor,
+            )
+            child = built[node["input"]]
+            mv = StateTable(int(node["table_id"]), child.schema,
+                            [int(i) for i in node["pk"]], store)
+            ex = MaterializeExecutor(child, mv)
         elif op == "hash_agg":
             child = built[node["input"]]
             calls = [AggCall(AggKind(c["kind"]),

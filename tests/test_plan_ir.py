@@ -197,6 +197,122 @@ def test_shipped_plan_on_real_worker(tmp_path):
     assert got == _q7_oracle(n)
 
 
+def test_shipped_join_pipeline_on_worker(tmp_path):
+    """Full q8 ships as THREE typed plans to one worker: two source
+    fragments + a remote-fed join+materialize fragment (remote_input/
+    hash_join/materialize IR nodes) whose join state AND the MV live
+    in the worker's hummock namespace — the coordinator only drives
+    barriers. The MV is read back from the worker's store AFTER
+    shutdown: durable exactly-once state, not streamed output."""
+    from risingwave_tpu.cluster.coordinator import (
+        WorkerBarrierSender, WorkerHandle,
+    )
+    from risingwave_tpu.common.types import Interval
+    from risingwave_tpu.connectors.nexmark import NexmarkConfig
+    from risingwave_tpu.expr.expr import InputRef, tumble_start
+    from risingwave_tpu.meta.barrier import BarrierLoop
+    from risingwave_tpu.state.state_table import StateTable
+    from risingwave_tpu.state.store import MemoryStateStore
+    from risingwave_tpu.storage.hummock import HummockLite
+    from risingwave_tpu.storage.object_store import LocalFsObjectStore
+    from risingwave_tpu.stream.actor import LocalBarrierManager
+    from risingwave_tpu.stream.message import StopMutation
+    from tests.test_e2e_q8 import q8_oracle
+
+    P_ACTOR, A_ACTOR, J_ACTOR, PSEUDO = 11, 12, 20, 999
+    EVENTS = 6000
+    W = Interval(usecs=10_000_000)
+    ir = expr_to_ir
+
+    def src(table, actor_id, split_tid):
+        from risingwave_tpu.connectors.nexmark import TABLE_SCHEMAS
+        return {"op": "source", "name": table,
+                "connector": {"connector": "nexmark",
+                              "nexmark.table.type": table,
+                              "nexmark.event.num": str(EVENTS),
+                              "nexmark.max.chunk.size": "256"},
+                "schema": schema_to_ir(TABLE_SCHEMAS[table]),
+                "actor_id": actor_id, "split_table_id": split_tid,
+                "rate_limit": 2, "min_chunks": 2}
+
+    TS, I64, VC = DataType.TIMESTAMP, DataType.INT64, DataType.VARCHAR
+    person_plan = [
+        src("person", P_ACTOR, 101),
+        {"op": "project", "input": 0,
+         "exprs": [ir(InputRef(0, I64)), ir(InputRef(1, VC)),
+                   ir(tumble_start(InputRef(6, TS), W))],
+         "names": ["id", "name", "starttime"]},
+    ]
+    auction_plan = [
+        src("auction", A_ACTOR, 102),
+        {"op": "project", "input": 0,
+         "exprs": [ir(InputRef(7, I64)),
+                   ir(tumble_start(InputRef(5, TS), W))],
+         "names": ["seller", "starttime"]},
+        {"op": "hash_agg", "input": 1, "group": [0, 1],
+         "calls": [{"kind": "count"}], "table_id": 103,
+         "append_only": True,
+         "output_names": ["seller", "starttime", "_cnt"]},
+        {"op": "project", "input": 2,
+         "exprs": [ir(InputRef(0, I64)), ir(InputRef(1, TS))],
+         "names": ["seller", "starttime"]},
+    ]
+    p_out = Schema.of(id=I64, name=VC, starttime=TS)
+    a_out = Schema.of(seller=I64, starttime=TS)
+    mv_schema = Schema.of(id=I64, name=VC, starttime=TS,
+                          seller=I64, starttime_r=TS)
+
+    async def main():
+        handle = WorkerHandle(str(tmp_path / "w"))
+        client = await handle.start()
+        try:
+            port = client.exchange_port
+            join_plan = [
+                {"op": "remote_input", "host": "127.0.0.1",
+                 "port": port, "up_actor": P_ACTOR,
+                 "schema": schema_to_ir(p_out)},
+                {"op": "remote_input", "host": "127.0.0.1",
+                 "port": port, "up_actor": A_ACTOR,
+                 "schema": schema_to_ir(a_out)},
+                {"op": "hash_join", "left": 0, "right": 1,
+                 "left_keys": [0, 2], "right_keys": [0, 1],
+                 "left_table_id": 4, "right_table_id": 5,
+                 "left_pk": [0, 2], "right_pk": [0, 1],
+                 "left_dist_key": [0], "right_dist_key": [0]},
+                {"op": "materialize", "input": 2, "table_id": 6,
+                 "pk": [0, 2]},
+            ]
+            await client.deploy_plan(person_plan, down_actor=J_ACTOR)
+            await client.deploy_plan(auction_plan, down_actor=J_ACTOR)
+            await client.deploy_plan(join_plan, actor_id=J_ACTOR,
+                                     down_actor=None)
+            local = LocalBarrierManager()
+            loop = BarrierLoop(local, MemoryStateStore())
+            local.register_sender(
+                PSEUDO, WorkerBarrierSender(client, local, PSEUDO))
+            local.set_expected_actors([PSEUDO])
+            for _ in range(25):
+                await loop.inject_and_collect(force_checkpoint=True)
+            await loop.inject_and_collect(
+                force_checkpoint=True,
+                mutation=StopMutation(frozenset(
+                    {P_ACTOR, A_ACTOR, J_ACTOR, PSEUDO})))
+        finally:
+            await handle.stop()
+
+    asyncio.run(main())
+    # the worker is gone; its durable namespace has the MV
+    store = HummockLite(LocalFsObjectStore(str(tmp_path / "w")))
+    from risingwave_tpu.common.epoch import Epoch, EpochPair
+    mv = StateTable(6, mv_schema, [0, 2], store)
+    ce = store.committed_epoch()
+    mv.init_epoch(EpochPair(Epoch(ce + 1), Epoch(ce)))
+    got = {(r[0], r[1], r[2]) for _pk, r in mv.iter_rows()}
+    cfg = NexmarkConfig(event_num=EVENTS)
+    assert got == q8_oracle(cfg, EVENTS // 50, EVENTS * 3 // 50)
+    assert len(got) > 5
+
+
 def test_build_fragment_agg_aux_tables():
     """DISTINCT / retractable min-max calls build their dedup and
     minput state tables from the IR's shipped table ids, and a plan
