@@ -523,6 +523,11 @@ class StreamPlanner:
         for c in conjuncts:
             ex = FilterExecutor(ex, Binder(scope).bind(c))
         projections = _expand_star(sel.projections, scope)
+        if any(isinstance(e, ast.Call)
+               and e.name in ("generate_series", "unnest")
+               for e, _a in projections):
+            return self._plan_project_set(ex, scope, sel, projections,
+                                          deps)
         binder = Binder(scope, allow_aggs=True)
         bound = [binder.bind_projection(e) for e, _a in projections]
         names = [a or expr_name(e, f"col{i}")
@@ -648,8 +653,79 @@ class StreamPlanner:
         )
         if isinstance(ex, WatermarkFilterExecutor):
             return StreamPlanner._derive_append_only(ex.input)
+        from risingwave_tpu.stream.executors.project_set import (
+            ProjectSetExecutor,
+        )
+        if isinstance(ex, ProjectSetExecutor):
+            # deterministic expansion of inserts is inserts
+            return StreamPlanner._derive_append_only(ex.input)
         # HashAgg/TopN/Backfill/DynamicFilter/unknown: assume retracting
         return False
+
+    def _plan_project_set(self, ex: Executor, scope: Scope,
+                          sel: ast.Select, projections, deps):
+        """SELECT list with set-returning functions → ProjectSet
+        (src/stream/src/executor/project_set.rs parity): each row
+        expands to the rows its table functions return, and the
+        hidden _projected_row_id joins the stream key so equal
+        per-element rows retract exactly."""
+        from risingwave_tpu.expr.expr import Literal
+        from risingwave_tpu.stream.executors.project_set import (
+            ProjectSetExecutor,
+        )
+        if sel.group_by:
+            raise PlanError("set-returning functions cannot be mixed "
+                            "with GROUP BY")
+        binder = Binder(scope)      # aggregates raise naturally
+        items, names = [], []
+        ints = (DataType.INT16, DataType.INT32, DataType.INT64)
+        for i, (e, a) in enumerate(projections):
+            if isinstance(e, ast.Call) and e.name == "unnest":
+                raise PlanError(
+                    "unnest is not supported yet — LIST columns do "
+                    "not carry an element type")
+            if isinstance(e, ast.Call) and e.name == "generate_series":
+                if len(e.args) not in (2, 3):
+                    raise PlanError(
+                        "generate_series(start, stop [, step])")
+                args = [binder.bind(x) for x in e.args]
+                for b in args:
+                    if b.return_type not in ints:
+                        raise PlanError("generate_series arguments "
+                                        "must be integers")
+                if len(args) == 2:
+                    args.append(Literal(1, DataType.INT64))
+                step = args[2]
+                if isinstance(step, Literal) and int(step.value) == 0:
+                    raise PlanError(
+                        "generate_series step must be nonzero")
+                items.append(("series", tuple(args)))
+                names.append(a or "generate_series")
+            else:
+                items.append(("scalar", binder.bind(e)))
+                names.append(a or expr_name(e, f"col{i}"))
+        seen: dict = {}
+        for idx, n in enumerate(names):
+            k = seen.get(n, 0)
+            seen[n] = k + 1
+            if k:
+                # two unaliased series items share a name; uniquify so
+                # the MV's columns stay addressable (SELECT * binds by
+                # name downstream)
+                names[idx] = f"{n}_{k}"
+        base_pk = list(ex.pk_indices)
+        if not base_pk:
+            ex = RowIdGenExecutor(ex)
+            base_pk = [len(ex.schema) - 1]
+        ex = ProjectSetExecutor(ex, items, names, pass_pk=base_pk)
+        pk = list(ex.pk_indices)
+        # expansion re-keys rows; the EOWC feed proof stops here
+        self._wm_scope_cols = set()
+        if sel.limit is not None or (sel.offset or 0) > 0:
+            ex = self._plan_topn(
+                ex, sel, pk,
+                append_only=self._derive_append_only(ex))
+        return ex, pk, deps
 
     def _plan_over_window(self, ex: Executor, binder: Binder, bound):
         """Insert an OverWindowExecutor (optimizer/plan_node/

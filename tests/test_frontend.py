@@ -715,3 +715,98 @@ def test_ctl_read_only_and_validation(tmp_path):
     ctl("--data-dir", d, "backup", "restore", "1", expect=2)
     ctl("--data-dir", d, "backup", "delete", expect=2)
     ctl("--data-dir", d, "backup", "delete", "99", expect=1)
+
+
+def test_project_set_generate_series_sql():
+    """Set-returning generate_series in the SELECT list (ProjectSet,
+    project_set.rs parity) over a RETRACTING upstream: the counts MV
+    updates as bids arrive, so every count bump retracts the old
+    expansion and re-emits 1..c — final rows must equal the oracle
+    expansion of the final counts."""
+    import numpy as np
+
+    from risingwave_tpu.connectors.nexmark import NexmarkConfig, gen_bids
+
+    n_events = 4000
+
+    async def run():
+        fe = Frontend(min_chunks=8)
+        await fe.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', "
+            f"nexmark.table.type='bid', nexmark.event.num={n_events})")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW g AS SELECT auction AS a, "
+            "count(*) AS c FROM bid GROUP BY auction")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW ps AS SELECT a, "
+            "generate_series(1, c) AS s FROM g")
+        for _ in range(20):
+            await fe.step()
+        rows = await fe.execute("SELECT a, s FROM ps")
+        bad = await fe.execute("SELECT s FROM ps WHERE s > 100000")
+        await fe.close()
+        return rows, bad
+
+    rows, bad = asyncio.run(run())
+    cfg = NexmarkConfig(event_num=n_events)
+    bids = gen_bids(np.arange(n_events * 46 // 50, dtype=np.int64),
+                    cfg)
+    counts = {}
+    for a in bids["auction"].tolist():
+        counts[a] = counts.get(a, 0) + 1
+    want = {(a, s) for a, c in counts.items()
+            for s in range(1, c + 1)}
+    assert set(map(tuple, rows)) == want, (len(rows), len(want))
+    assert len(rows) == len(want)        # no duplicate survivors
+    assert max(c for c in counts.values()) > 1, \
+        "test needs count bumps to exercise retraction"
+    assert bad == []
+
+
+def test_project_set_unnest_rejected():
+    async def run():
+        fe = Frontend()
+        await fe.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', "
+            "nexmark.table.type='bid', nexmark.event.num=100)")
+        with pytest.raises(Exception, match="unnest"):
+            await fe.execute(
+                "CREATE MATERIALIZED VIEW u AS SELECT "
+                "unnest(auction) FROM bid")
+        await fe.close()
+
+    asyncio.run(run())
+
+
+def test_project_set_duplicate_names_and_zero_step():
+    """Two unaliased series columns must keep distinct data (the
+    executor builds chunks positionally), and a literal zero step is
+    rejected at plan time like the batch path."""
+    async def run():
+        fe = Frontend(min_chunks=4)
+        await fe.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', "
+            "nexmark.table.type='bid', nexmark.event.num=500)")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW g AS SELECT auction AS a, "
+            "count(*) AS c FROM bid GROUP BY auction")
+        with pytest.raises(Exception, match="nonzero"):
+            await fe.execute(
+                "CREATE MATERIALIZED VIEW z AS SELECT a, "
+                "generate_series(1, 10, 0) AS s FROM g")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW two AS SELECT a, "
+            "generate_series(1, 2), generate_series(10, 13) FROM g")
+        for _ in range(8):
+            await fe.step()
+        g = await fe.execute("SELECT a FROM g")
+        rows = await fe.execute("SELECT * FROM two")
+        await fe.close()
+        return [r[:3] for r in rows], len(g)
+
+    rows, n_groups = asyncio.run(run())
+    want = set()
+    for (a,) in set(map(tuple, [[r[0]] for r in rows])):
+        want |= {(a, 1, 10), (a, 2, 11), (a, None, 12), (a, None, 13)}
+    assert set(map(tuple, rows)) == want, rows[:6]
+    assert len(rows) == 4 * n_groups
