@@ -187,6 +187,38 @@ class AlterParallelism:
 
 
 @dataclass
+class CreateTable:
+    name: str
+    columns: List[Tuple[str, str]]     # (col_name, sql type)
+    pk_cols: List[str]                 # PRIMARY KEY columns ([] = none)
+
+
+@dataclass
+class DropTable:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class Insert:
+    table: str
+    rows: List[List["Expr"]]           # VALUES rows (expressions)
+
+
+@dataclass
+class Delete:
+    table: str
+    where: Optional["Expr"] = None
+
+
+@dataclass
+class Update:
+    table: str
+    sets: List[Tuple[str, "Expr"]]     # SET col = expr
+    where: Optional["Expr"] = None
+
+
+@dataclass
 class Show:
     what: str                          # "tables" | "materialized views" | "sources"
 

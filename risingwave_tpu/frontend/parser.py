@@ -33,7 +33,8 @@ KEYWORDS = {
     "milliseconds", "case", "when", "then", "else", "end", "cast",
     "sink", "sinks", "left", "right", "full", "outer", "distinct",
     "explain", "over", "partition", "alter", "set", "parallelism",
-    "for", "emit", "window", "close",
+    "for", "emit", "window", "close", "insert", "into", "values",
+    "delete", "update", "primary", "key",
 }
 
 # keywords that can never start a primary expression (a column named
@@ -155,6 +156,43 @@ class Parser:
             return ast.AlterParallelism(name, int(text))
         if self._kw("create", "source"):
             return self._create_source()
+        if self._kw("create", "table"):
+            return self._create_table()
+        if self._kw("drop", "table"):
+            if_exists = self._kw("if", "exists")
+            return ast.DropTable(self._ident(), if_exists)
+        if self._kw("insert"):
+            self._expect_kw("into")
+            name = self._ident()
+            self._expect_kw("values")
+            rows = []
+            while True:
+                self._expect_op("(")
+                row = [self._expr()]
+                while self._op(","):
+                    row.append(self._expr())
+                self._expect_op(")")
+                rows.append(row)
+                if not self._op(","):
+                    break
+            return ast.Insert(name, rows)
+        if self._kw("delete"):
+            self._expect_kw("from")
+            name = self._ident()
+            where = self._expr() if self._kw("where") else None
+            return ast.Delete(name, where)
+        if self._kw("update"):
+            name = self._ident()
+            self._expect_kw("set")
+            sets = []
+            while True:
+                col = self._ident()
+                self._expect_op("=")
+                sets.append((col, self._expr()))
+                if not self._op(","):
+                    break
+            where = self._expr() if self._kw("where") else None
+            return ast.Update(name, sets, where)
         if self._kw("create", "materialized", "view"):
             name = self._ident()
             self._expect_kw("as")
@@ -210,6 +248,27 @@ class Parser:
         if self._peek() == ("kw", "select"):
             return self._select()
         raise ParseError(f"unsupported statement at {self._peek()}")
+
+    def _create_table(self) -> ast.CreateTable:
+        name = self._ident()
+        self._expect_op("(")
+        columns, pk_cols = [], []
+        while True:
+            col = self._ident()
+            words = [self._next()[1].lower()]
+            while self._peek()[0] in ("ident", "kw") and \
+                    self._peek()[1].lower() in (
+                        "with", "time", "zone", "precision",
+                        "varying"):
+                words.append(self._next()[1].lower())
+            columns.append((col, " ".join(words)))
+            if self._kw("primary"):
+                self._expect_kw("key")
+                pk_cols.append(col)
+            if not self._op(","):
+                break
+        self._expect_op(")")
+        return ast.CreateTable(name, columns, pk_cols)
 
     def _create_source(self) -> ast.CreateSource:
         name = self._ident()

@@ -68,6 +68,9 @@ class Frontend:
             snapshot_fn=self._catalog_snapshot)
         self._ddl_log: List[str] = []
         self._replaying = False
+        # table name → (DmlReader, schema, pk, RowIdSeq|None, tid):
+        # the DML write path into each CREATE TABLE job
+        self._tables: Dict[str, tuple] = {}
         # serializes barrier rounds between DDL handlers, step() and the
         # background heartbeat (inject_and_collect is not reentrant)
         self._barrier_lock = asyncio.Lock()
@@ -117,7 +120,8 @@ class Frontend:
                                  ast.CreateMaterializedView,
                                  ast.CreateSink, ast.DropSink,
                                  ast.DropMaterializedView,
-                                 ast.DropSource,
+                                 ast.DropSource, ast.CreateTable,
+                                 ast.DropTable,
                                  ast.AlterParallelism)) and \
                     not self._replaying:
                 # replayed DDL publishes nothing: observers' snapshots
@@ -216,12 +220,25 @@ class Frontend:
                         f"source {stmt.name!r} is used by {job.name!r}")
             del self.catalog.sources[stmt.name]
             return "DROP_SOURCE"
+        if isinstance(stmt, ast.CreateTable):
+            return await self._create_table(stmt)
+        if isinstance(stmt, ast.DropTable):
+            return await self._drop_table(stmt)
+        if isinstance(stmt, ast.Insert):
+            return await self._insert(stmt)
+        if isinstance(stmt, ast.Delete):
+            return await self._delete(stmt)
+        if isinstance(stmt, ast.Update):
+            return await self._update(stmt)
         if isinstance(stmt, ast.Show):
             if stmt.what == "sources":
                 return [(n,) for n in sorted(self.catalog.sources)]
             if stmt.what == "sinks":
                 return [(n,) for n in sorted(self.catalog.sinks)]
-            return [(n,) for n in sorted(self.catalog.mvs)]
+            if stmt.what == "tables":
+                return [(n,) for n in sorted(self._tables)]
+            return [(n,) for n in sorted(self.catalog.mvs)
+                    if n not in self._tables]
         if isinstance(stmt, ast.Flush):
             await self._barrier(force_checkpoint=True)
             return "FLUSH"
@@ -341,6 +358,262 @@ class Frontend:
         if self._deployed_actor.failure is not None:
             raise self._deployed_actor.failure
         return "CREATE_MATERIALIZED_VIEW"
+
+    async def _create_table(self, stmt: ast.CreateTable) -> str:
+        """CREATE TABLE: a DML-fed streaming job (DmlReader source →
+        materialize) so table writes ride the barrier pipeline and MV
+        chains over tables work like MV-on-MV (handler/create_table.rs
+        + dml_manager.rs analog). No PRIMARY KEY → hidden _row_id."""
+        from risingwave_tpu.common.types import DataType, Field, Schema
+        from risingwave_tpu.connectors.dml import DmlReader, RowIdSeq
+        from risingwave_tpu.state.state_table import StateTable
+        from risingwave_tpu.stream.exchange import channel_for_test
+        from risingwave_tpu.stream.executors.materialize import (
+            MaterializeExecutor,
+        )
+        from risingwave_tpu.stream.executors.source import SourceExecutor
+
+        self.catalog._check_free(stmt.name)
+        fields = []
+        for cname, tname in stmt.columns:
+            if any(f.name == cname for f in fields):
+                raise PlanError(f"duplicate column {cname!r}")
+            try:
+                fields.append(Field(cname, DataType.from_sql(tname)))
+            except KeyError:
+                raise PlanError(f"unknown type {tname!r}")
+        names = [f.name for f in fields]
+        for c in stmt.pk_cols:
+            if c not in names:
+                raise PlanError(f"PRIMARY KEY column {c!r} not found")
+        if stmt.pk_cols:
+            schema = Schema(fields)
+            pk = [names.index(c) for c in stmt.pk_cols]
+            rowid = None
+        else:
+            schema = Schema(fields + [Field("_row_id",
+                                            DataType.SERIAL)])
+            pk = [len(fields)]
+            rowid = RowIdSeq()
+        async with self._barrier_lock:
+            actor_id = self._next_actor
+            self._next_actor += 1
+            id_base = self.catalog._next_id
+            sid = self.catalog.next_id()
+            table_id = self.catalog.next_id()
+            reader = DmlReader(schema)
+            tx, rx = channel_for_test()
+            self.local.register_sender(sid, tx)
+            try:
+                src = SourceExecutor(reader, rx, None, actor_id=sid)
+                table = StateTable(table_id, schema, pk, self.store)
+                mat = MaterializeExecutor(src, table)
+                mv = MvCatalog(stmt.name, table_id, schema, pk,
+                               definition="", actor_id=actor_id,
+                               id_base=id_base)
+                await self._deploy_job(stmt.name, actor_id, mat,
+                                       {sid: reader},
+                                       lambda: self.catalog.add_mv(mv))
+            except BaseException:
+                self.local.drop_actor(sid)
+                raise
+        self._tables[stmt.name] = (reader, schema, pk, rowid,
+                                   table_id)
+        if self._deployed_actor.failure is not None:
+            raise self._deployed_actor.failure
+        return "CREATE_TABLE"
+
+    async def _drop_table(self, stmt: ast.DropTable) -> str:
+        if stmt.name not in self._tables:
+            if stmt.if_exists and stmt.name not in self.catalog.mvs:
+                return "DROP_TABLE"
+            if stmt.name not in self.catalog.mvs:
+                raise PlanError(f"unknown table {stmt.name!r}")
+            raise PlanError(f"{stmt.name!r} is not a table")
+        dependents = [m.name for m in self.catalog.mvs.values()
+                      if stmt.name in m.dependent_sources] + \
+                     [s.name for s in self.catalog.sinks.values()
+                      if stmt.name in s.dependent_sources]
+        if dependents:
+            raise PlanError(f"cannot drop table {stmt.name!r}: "
+                            f"depended on by {dependents}")
+        status = await self._drop_job(stmt.name, self.catalog.mvs,
+                                      stmt.if_exists, "DROP_TABLE")
+        self._tables.pop(stmt.name, None)
+        return status
+
+    def _table_job(self, name: str):
+        job = self._tables.get(name)
+        if job is None:
+            raise PlanError(f"{name!r} is not a table")
+        return job
+
+    @staticmethod
+    def _col0(col):
+        """First-row python value of an evaluated width-1 column."""
+        import numpy as np
+        if col.validity is not None and \
+                not bool(np.asarray(col.validity)[0]):
+            return None
+        v = np.asarray(col.values)[0]
+        return v.item() if hasattr(v, "item") else v
+
+    async def _insert(self, stmt: ast.Insert) -> str:
+        """INSERT ... VALUES: evaluate rows, push one chunk through
+        the table's DML channel, and return only after the checkpoint
+        that makes it durable+visible commits (batch insert.rs)."""
+        from risingwave_tpu.common.chunk import DataChunk, StreamChunk
+        from risingwave_tpu.common.types import Schema
+        from risingwave_tpu.expr.expr import Cast
+        from risingwave_tpu.frontend.binder import Binder, Scope
+
+        reader, schema, _pk, rowid, _tid = self._table_job(stmt.table)
+        data_fields = list(schema)[:-1] if rowid is not None \
+            else list(schema)
+        binder = Binder(Scope.of(Schema([]), None))
+        one = DataChunk.empty(Schema([]), capacity=8)
+        one.visibility[0] = True
+        rows = []
+        for r in stmt.rows:
+            if len(r) != len(data_fields):
+                raise PlanError(
+                    f"INSERT row has {len(r)} values, table has "
+                    f"{len(data_fields)} columns")
+            vals = []
+            for e_ast, f in zip(r, data_fields):
+                b = binder.bind(e_ast)
+                if b.return_type != f.data_type:
+                    b = Cast(b, f.data_type)
+                vals.append(self._col0(b.eval(one)))
+            rows.append(tuple(vals))
+        if rowid is not None:
+            ids = rowid.take(self.store.committed_epoch(), len(rows))
+            rows = [r + (i,) for r, i in zip(rows, ids)]
+        data = {f.name: [r[i] for r in rows]
+                for i, f in enumerate(schema)}
+        reader.push(StreamChunk.from_pydict(schema, data))
+        await self._dml_flush()
+        return f"INSERT 0 {len(rows)}"
+
+    async def _dml_flush(self) -> None:
+        """Make a just-pushed DML chunk durable AND visible before the
+        statement returns. Two barrier rounds: the table's source is
+        parked on its barrier channel, so the first barrier always
+        precedes the chunk (it re-arms generation for the next epoch)
+        and the second seals + checkpoints the epoch that carried
+        it."""
+        await self._barrier(force_checkpoint=True)
+        await self._barrier(force_checkpoint=True)
+
+    def _snapshot_rows(self, table_id: int, schema, pk) -> List[tuple]:
+        from risingwave_tpu.common.epoch import Epoch, EpochPair
+        from risingwave_tpu.state.state_table import StateTable
+
+        t = StateTable(table_id, schema, pk, self.store,
+                       sanity_check=False)
+        ce = self.store.committed_epoch()
+        t.init_epoch(EpochPair(Epoch(ce + 1), Epoch(ce)))
+        return [tuple(row) for _pk, row in t.iter_rows()]
+
+    def _match_rows(self, stmt_where, schema, rows):
+        """The subset of rows a DML WHERE clause selects."""
+        import numpy as np
+
+        from risingwave_tpu.common.chunk import DataChunk
+        from risingwave_tpu.frontend.binder import Binder, Scope
+
+        if not rows:
+            return []
+        if stmt_where is None:
+            return rows
+        chunk = DataChunk.from_pydict(
+            schema, {f.name: [r[i] for r in rows]
+                     for i, f in enumerate(schema)})
+        pred = Binder(Scope.of(schema, None)).bind(stmt_where)
+        col = pred.eval(chunk)
+        keep = np.asarray(col.values)[:len(rows)].astype(bool)
+        if col.validity is not None:
+            keep &= np.asarray(col.validity)[:len(rows)]
+        return [r for r, k in zip(rows, keep) if k]
+
+    async def _delete(self, stmt: ast.Delete) -> str:
+        """DELETE: snapshot-scan the committed rows, push their
+        retractions through the DML channel (batch delete.rs)."""
+        from risingwave_tpu.common.chunk import Op, StreamChunk
+
+        reader, schema, pk, _rowid, tid = self._table_job(stmt.table)
+        rows = self._match_rows(
+            stmt.where, schema, self._snapshot_rows(tid, schema, pk))
+        if rows:
+            data = {f.name: [r[i] for r in rows]
+                    for i, f in enumerate(schema)}
+            reader.push(StreamChunk.from_pydict(
+                schema, data, ops=[Op.DELETE] * len(rows)))
+            await self._dml_flush()
+        return f"DELETE {len(rows)}"
+
+    async def _update(self, stmt: ast.Update) -> str:
+        """UPDATE: snapshot-scan, re-evaluate SET expressions over the
+        matching rows, push UpdateDelete/UpdateInsert pairs."""
+        from risingwave_tpu.common.chunk import DataChunk, Op, StreamChunk
+        from risingwave_tpu.expr.expr import Cast
+        from risingwave_tpu.frontend.binder import Binder, Scope
+
+        reader, schema, pk, rowid, tid = self._table_job(stmt.table)
+        names = [f.name for f in schema]
+        settable = names[:-1] if rowid is not None else names
+        sets = []
+        binder = Binder(Scope.of(schema, None))
+        for col, e_ast in stmt.sets:
+            if col not in settable:
+                raise PlanError(f"column {col!r} not found")
+            b = binder.bind(e_ast)
+            dt = schema[names.index(col)].data_type
+            if b.return_type != dt:
+                b = Cast(b, dt)
+            sets.append((names.index(col), b))
+        rows = self._match_rows(
+            stmt.where, schema, self._snapshot_rows(tid, schema, pk))
+        if rows:
+            chunk = DataChunk.from_pydict(
+                schema, {f.name: [r[i] for r in rows]
+                         for i, f in enumerate(schema)})
+            import numpy as np
+            new_cols = {}
+            for idx, b in sets:
+                col = b.eval(chunk)
+                vals = np.asarray(col.values)[:len(rows)]
+                valid = None if col.validity is None else \
+                    np.asarray(col.validity)[:len(rows)]
+                new_cols[idx] = [
+                    None if (valid is not None and not valid[i])
+                    else (v.item() if hasattr(v, "item") else v)
+                    for i, v in enumerate(vals)]
+            out_rows, ops = [], []
+            new_pks = set()
+            pk_touched = any(idx in pk for idx, _b in sets)
+            for i, old in enumerate(rows):
+                new = list(old)
+                for idx, _b in sets:
+                    new[idx] = new_cols[idx][i]
+                if pk_touched:
+                    kp = tuple(new[j] for j in pk)
+                    if kp in new_pks:
+                        # two updated rows landing on one key would
+                        # collide inside a single chunk and kill the
+                        # table's actor — fail the STATEMENT instead
+                        raise PlanError(
+                            "UPDATE would assign the primary key "
+                            f"{kp!r} to more than one row")
+                    new_pks.add(kp)
+                out_rows += [old, tuple(new)]
+                ops += [Op.UPDATE_DELETE, Op.UPDATE_INSERT]
+            data = {f.name: [r[i] for r in out_rows]
+                    for i, f in enumerate(schema)}
+            reader.push(StreamChunk.from_pydict(schema, data,
+                                                ops=ops))
+            await self._dml_flush()
+        return f"UPDATE {len(rows)}"
 
     async def _alter_parallelism(self, stmt: ast.AlterParallelism) -> str:
         """Runtime reschedule (meta/stream/scale.rs:717
@@ -504,6 +777,11 @@ class Frontend:
         return status
 
     async def _drop_mv(self, stmt: ast.DropMaterializedView) -> str:
+        if stmt.name in self._tables:
+            # tables share catalog.mvs; dropping one here would orphan
+            # its DML channel (writes then vanish into a dead reader)
+            raise PlanError(
+                f"{stmt.name!r} is a table — use DROP TABLE")
         dependents = [
             m.name for m in self.catalog.mvs.values()
             if stmt.name in m.dependent_sources

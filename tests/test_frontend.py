@@ -810,3 +810,115 @@ def test_project_set_duplicate_names_and_zero_step():
         want |= {(a, 1, 10), (a, 2, 11), (a, None, 12), (a, None, 13)}
     assert set(map(tuple, rows)) == want, rows[:6]
     assert len(rows) == 4 * n_groups
+
+
+def test_create_table_dml_and_mv_chain():
+    """CREATE TABLE + INSERT/DELETE/UPDATE flow through the DML
+    channel into the barrier pipeline; an MV over the table sees every
+    delta (dml_manager.rs + handler/create_table.rs parity)."""
+    async def run():
+        fe = Frontend()
+        await fe.execute(
+            "CREATE TABLE t (a bigint, b varchar, ts timestamp)")
+        r = await fe.execute(
+            "INSERT INTO t VALUES (1, 'x', '2024-01-01 00:00:00'), "
+            "(2, 'y', null), (3, 'z', '2024-01-02 12:30:00')")
+        assert r == "INSERT 0 3"
+        rows = await fe.execute("SELECT a, b FROM t")
+        assert sorted(rows) == [(1, "x"), (2, "y"), (3, "z")]
+        ts = await fe.execute("SELECT ts FROM t WHERE a = 2")
+        assert ts == [(None,)]
+        assert await fe.execute("DELETE FROM t WHERE a = 2") == \
+            "DELETE 1"
+        assert await fe.execute(
+            "UPDATE t SET b = 'w', a = a + 10 WHERE a > 1") == \
+            "UPDATE 1"
+        rows = await fe.execute("SELECT a, b FROM t")
+        assert sorted(rows) == [(1, "x"), (13, "w")]
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW m AS SELECT b, count(*) AS c "
+            "FROM t GROUP BY b")
+        await fe.execute("INSERT INTO t VALUES (5, 'w', null)")
+        rows = await fe.execute("SELECT b, c FROM m")
+        assert sorted(rows) == [("w", 2), ("x", 1)]
+        assert await fe.execute("DELETE FROM t") == "DELETE 3"
+        assert await fe.execute("SELECT b, c FROM m") == []
+        assert await fe.execute("SHOW TABLES") == [("t",)]
+        assert ("t",) not in await fe.execute(
+            "SHOW MATERIALIZED VIEWS")
+        with pytest.raises(Exception, match="depended on"):
+            await fe.execute("DROP TABLE t")
+        await fe.execute("DROP MATERIALIZED VIEW m")
+        await fe.execute("DROP TABLE t")
+        with pytest.raises(Exception, match="not a table|unknown"):
+            await fe.execute("INSERT INTO t VALUES (1, 'x', null)")
+        await fe.close()
+
+    asyncio.run(run())
+
+
+def test_table_primary_key_upsert_and_recovery():
+    """A PRIMARY KEY table keys its state by that column (same-pk
+    insert overwrites); committed table rows survive a session crash
+    and the recovered table accepts further DML with fresh row ids."""
+    from risingwave_tpu.storage.hummock import HummockLite
+    from risingwave_tpu.storage.object_store import MemObjectStore
+
+    obj = MemObjectStore()
+
+    async def phase1():
+        fe = Frontend(HummockLite(obj), min_chunks=4)
+        await fe.execute(
+            "CREATE TABLE kv (k bigint PRIMARY KEY, v varchar)")
+        await fe.execute(
+            "INSERT INTO kv VALUES (1, 'a'), (2, 'b')")
+        await fe.execute("INSERT INTO kv VALUES (1, 'a2')")  # upsert
+        rows = await fe.execute("SELECT k, v FROM kv")
+        assert sorted(rows) == [(1, "a2"), (2, "b")]
+        await fe.execute("CREATE TABLE log (msg varchar)")   # _row_id
+        await fe.execute("INSERT INTO log VALUES ('m1'), ('m1')")
+        assert len(await fe.execute("SELECT msg FROM log")) == 2
+        # crash: NO close, no goodbye
+
+    async def phase2():
+        fe = Frontend(HummockLite(obj), min_chunks=4)
+        await fe.recover()
+        rows = await fe.execute("SELECT k, v FROM kv")
+        assert sorted(rows) == [(1, "a2"), (2, "b")]
+        await fe.execute("INSERT INTO log VALUES ('m2')")
+        assert len(await fe.execute("SELECT msg FROM log")) == 3
+        assert await fe.execute("DELETE FROM kv WHERE k = 1") == \
+            "DELETE 1"
+        assert await fe.execute("SELECT k FROM kv") == [(2,)]
+        await fe.close()
+
+    asyncio.run(phase1())
+    asyncio.run(phase2())
+
+
+def test_table_dml_guards():
+    """The review repros: DROP MATERIALIZED VIEW on a table is
+    refused, SET on the hidden _row_id is refused, and an UPDATE
+    collapsing two rows onto one primary key fails the statement
+    instead of killing the table's actor."""
+    async def run():
+        fe = Frontend()
+        await fe.execute("CREATE TABLE t (a bigint)")
+        await fe.execute("INSERT INTO t VALUES (1), (2)")
+        with pytest.raises(Exception, match="use DROP TABLE"):
+            await fe.execute("DROP MATERIALIZED VIEW t")
+        with pytest.raises(Exception, match="_row_id.*not found"):
+            await fe.execute("UPDATE t SET _row_id = 0")
+        await fe.execute(
+            "CREATE TABLE kv (k bigint PRIMARY KEY, v bigint)")
+        await fe.execute("INSERT INTO kv VALUES (1, 10), (2, 20)")
+        with pytest.raises(Exception, match="more than one row"):
+            await fe.execute("UPDATE kv SET k = 9")
+        # the failed statements left the pipeline healthy
+        await fe.execute("INSERT INTO kv VALUES (3, 30)")
+        assert len(await fe.execute("SELECT k FROM kv")) == 3
+        assert sorted(await fe.execute("SELECT a FROM t")) == \
+            [(1,), (2,)]
+        await fe.close()
+
+    asyncio.run(run())
