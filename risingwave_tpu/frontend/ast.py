@@ -203,6 +203,7 @@ class DropTable:
 class Insert:
     table: str
     rows: List[List["Expr"]]           # VALUES rows (expressions)
+    select: Optional[Select] = None    # INSERT INTO t SELECT ...
 
 
 @dataclass

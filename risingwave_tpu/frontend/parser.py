@@ -164,6 +164,8 @@ class Parser:
         if self._kw("insert"):
             self._expect_kw("into")
             name = self._ident()
+            if self._peek() == ("kw", "select"):
+                return ast.Insert(name, [], select=self._select())
             self._expect_kw("values")
             rows = []
             while True:

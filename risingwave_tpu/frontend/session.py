@@ -470,22 +470,37 @@ class Frontend:
         reader, schema, _pk, rowid, _tid = self._table_job(stmt.table)
         data_fields = list(schema)[:-1] if rowid is not None \
             else list(schema)
-        binder = Binder(Scope.of(Schema([]), None))
-        one = DataChunk.empty(Schema([]), capacity=8)
-        one.visibility[0] = True
-        rows = []
-        for r in stmt.rows:
-            if len(r) != len(data_fields):
+        if stmt.select is not None:
+            # INSERT INTO t SELECT …: batch-evaluate over the latest
+            # committed snapshot, then coerce column-wise
+            from risingwave_tpu.batch import collect
+            ex = plan_batch(stmt.select, self.catalog, self.store,
+                            self.store.committed_epoch())
+            if len(ex.schema) != len(data_fields):
                 raise PlanError(
-                    f"INSERT row has {len(r)} values, table has "
-                    f"{len(data_fields)} columns")
-            vals = []
-            for e_ast, f in zip(r, data_fields):
-                b = binder.bind(e_ast)
-                if b.return_type != f.data_type:
-                    b = Cast(b, f.data_type)
-                vals.append(self._col0(b.eval(one)))
-            rows.append(tuple(vals))
+                    f"INSERT SELECT has {len(ex.schema)} columns, "
+                    f"table has {len(data_fields)}")
+            rows = self._coerce_rows(collect(ex), ex.schema,
+                                     data_fields)
+        else:
+            binder = Binder(Scope.of(Schema([]), None))
+            one = DataChunk.empty(Schema([]), capacity=8)
+            one.visibility[0] = True
+            rows = []
+            for r in stmt.rows:
+                if len(r) != len(data_fields):
+                    raise PlanError(
+                        f"INSERT row has {len(r)} values, table has "
+                        f"{len(data_fields)} columns")
+                vals = []
+                for e_ast, f in zip(r, data_fields):
+                    b = binder.bind(e_ast)
+                    if b.return_type != f.data_type:
+                        b = Cast(b, f.data_type)
+                    vals.append(self._col0(b.eval(one)))
+                rows.append(tuple(vals))
+        if not rows:
+            return "INSERT 0 0"
         if rowid is not None:
             ids = rowid.take(self.store.committed_epoch(), len(rows))
             rows = [r + (i,) for r, i in zip(rows, ids)]
@@ -504,6 +519,36 @@ class Frontend:
         it."""
         await self._barrier(force_checkpoint=True)
         await self._barrier(force_checkpoint=True)
+
+    @staticmethod
+    def _coerce_rows(rows, src_schema, dst_fields) -> List[tuple]:
+        """Column-wise cast of batch-select output onto table types.
+        Positional (rows_to_chunk), NOT name-keyed: a SELECT output
+        may carry duplicate column names (aliases, join sides) and a
+        name-keyed rebuild would silently collapse them."""
+        import numpy as np
+
+        from risingwave_tpu.batch.storage_table import rows_to_chunk
+        from risingwave_tpu.expr.expr import Cast, InputRef
+
+        if not rows:
+            return []
+        if all(s.data_type == d.data_type
+               for s, d in zip(src_schema, dst_fields)):
+            return [tuple(r) for r in rows]
+        chunk = rows_to_chunk(src_schema, [tuple(r) for r in rows])
+        cols = []
+        for i, (s, d) in enumerate(zip(src_schema, dst_fields)):
+            col = Cast(InputRef(i, s.data_type), d.data_type) \
+                .eval(chunk)
+            vals = np.asarray(col.values)[:len(rows)]
+            valid = None if col.validity is None else \
+                np.asarray(col.validity)[:len(rows)]
+            cols.append([
+                None if (valid is not None and not valid[j])
+                else (v.item() if hasattr(v, "item") else v)
+                for j, v in enumerate(vals)])
+        return [tuple(c[j] for c in cols) for j in range(len(rows))]
 
     def _snapshot_rows(self, table_id: int, schema, pk) -> List[tuple]:
         from risingwave_tpu.common.epoch import Epoch, EpochPair

@@ -922,3 +922,49 @@ def test_table_dml_guards():
         await fe.close()
 
     asyncio.run(run())
+
+
+def test_insert_select():
+    """INSERT INTO t SELECT … batch-evaluates over the committed
+    snapshot (insert.rs analog), with column-wise coercion."""
+    async def run():
+        fe = Frontend()
+        await fe.execute("CREATE TABLE src (a bigint, b varchar)")
+        await fe.execute(
+            "INSERT INTO src VALUES (1, 'x'), (2, 'y'), (3, 'z')")
+        await fe.execute("CREATE TABLE dst (a bigint, b varchar)")
+        r = await fe.execute(
+            "INSERT INTO dst SELECT a + 10, b FROM src WHERE a > 1")
+        assert r == "INSERT 0 2"
+        rows = await fe.execute("SELECT a, b FROM dst")
+        assert sorted(rows) == [(12, "y"), (13, "z")]
+        # self-insert reads the snapshot, not the in-flight writes
+        r = await fe.execute("INSERT INTO src SELECT a, b FROM src")
+        assert r == "INSERT 0 3"
+        assert len(await fe.execute("SELECT a FROM src")) == 6
+        r = await fe.execute(
+            "INSERT INTO dst SELECT a, b FROM src WHERE a > 999")
+        assert r == "INSERT 0 0"
+        with pytest.raises(Exception, match="columns"):
+            await fe.execute("INSERT INTO dst SELECT a FROM src")
+        await fe.close()
+
+    asyncio.run(run())
+
+
+def test_insert_select_duplicate_output_names():
+    """Duplicate SELECT output names must keep distinct data through
+    the cast path (positional chunk build, not name-keyed)."""
+    async def run():
+        fe = Frontend()
+        await fe.execute("CREATE TABLE src (a bigint, b bigint)")
+        await fe.execute("INSERT INTO src VALUES (1, 100), (2, 200)")
+        await fe.execute("CREATE TABLE dst (x varchar, y bigint)")
+        r = await fe.execute(
+            "INSERT INTO dst SELECT a, b AS a FROM src")
+        assert r == "INSERT 0 2"
+        rows = sorted(await fe.execute("SELECT x, y FROM dst"))
+        assert rows == [("1", 100), ("2", 200)], rows
+        await fe.close()
+
+    asyncio.run(run())
