@@ -63,7 +63,19 @@ class StorageTable:
 
 
 def rows_to_chunk(schema: Schema, rows: List[tuple]) -> DataChunk:
-    """Row tuples → one DataChunk (host columns)."""
+    """Row tuples → one DataChunk (host columns).
+
+    DECIMAL cells accept BOTH value domains, distinguished by type:
+    physical scaled int64 (state rows, the storage scan path) passes
+    through; logical ``decimal.Decimal`` (``to_pylist`` output — the
+    batch agg/join/order executors round-trip rows through it) is
+    scaled here. Without this, a logical Decimal stuffed into the
+    int64 physical array silently truncates to its integer part and
+    then renders divided by the scale."""
+    import decimal as _decimal
+
+    from risingwave_tpu.common import types as _types
+
     n = len(rows)
     from risingwave_tpu.common.chunk import next_pow2
     cap = next_pow2(max(n, 1))
@@ -71,6 +83,11 @@ def rows_to_chunk(schema: Schema, rows: List[tuple]) -> DataChunk:
     for i, f in enumerate(schema):
         vals = [r[i] for r in rows]
         dt = f.data_type
+        if dt == DataType.DECIMAL and any(
+                isinstance(v, _decimal.Decimal) for v in vals):
+            vals = [_types.decimal_to_scaled(v)
+                    if isinstance(v, _decimal.Decimal) else v
+                    for v in vals]
         ok = np.ones(cap, dtype=bool)
         has_null = any(v is None for v in vals)
         if dt.is_device:

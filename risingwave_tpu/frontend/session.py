@@ -448,16 +448,6 @@ class Frontend:
             raise PlanError(f"{name!r} is not a table")
         return job
 
-    @staticmethod
-    def _col0(col):
-        """First-row python value of an evaluated width-1 column."""
-        import numpy as np
-        if col.validity is not None and \
-                not bool(np.asarray(col.validity)[0]):
-            return None
-        v = np.asarray(col.values)[0]
-        return v.item() if hasattr(v, "item") else v
-
     async def _insert(self, stmt: ast.Insert) -> str:
         """INSERT ... VALUES: evaluate rows, push one chunk through
         the table's DML channel, and return only after the checkpoint
@@ -483,22 +473,29 @@ class Frontend:
             rows = self._coerce_rows(collect(ex), ex.schema,
                                      data_fields)
         else:
+            from risingwave_tpu.common.types import Field
             binder = Binder(Scope.of(Schema([]), None))
             one = DataChunk.empty(Schema([]), capacity=8)
             one.visibility[0] = True
+            tmp_sch = Schema([Field(f"_c{i}", f.data_type)
+                              for i, f in enumerate(data_fields)])
             rows = []
             for r in stmt.rows:
                 if len(r) != len(data_fields):
                     raise PlanError(
                         f"INSERT row has {len(r)} values, table has "
                         f"{len(data_fields)} columns")
-                vals = []
+                cols = []
                 for e_ast, f in zip(r, data_fields):
                     b = binder.bind(e_ast)
                     if b.return_type != f.data_type:
                         b = Cast(b, f.data_type)
-                    vals.append(self._col0(b.eval(one)))
-                rows.append(tuple(vals))
+                    cols.append(b.eval(one))
+                # to_pylist converts physical->LOGICAL (DECIMAL
+                # unscales, bools); from_pydict at the push site
+                # expects logical values
+                rows.append(DataChunk(tmp_sch, cols,
+                                      one.visibility).to_pylist()[0])
         if not rows:
             return "INSERT 0 0"
         if rowid is not None:
@@ -522,13 +519,16 @@ class Frontend:
 
     @staticmethod
     def _coerce_rows(rows, src_schema, dst_fields) -> List[tuple]:
-        """Column-wise cast of batch-select output onto table types.
-        Positional (rows_to_chunk), NOT name-keyed: a SELECT output
-        may carry duplicate column names (aliases, join sides) and a
-        name-keyed rebuild would silently collapse them."""
-        import numpy as np
-
-        from risingwave_tpu.batch.storage_table import rows_to_chunk
+        """Column-wise cast of batch-select output (LOGICAL rows)
+        onto table types; returns logical rows for the DML channel.
+        Positional temp names, NOT the real ones: a SELECT output may
+        carry duplicate column names (aliases, join sides) and a
+        name-keyed build would silently collapse them. The chunk
+        round trip keeps the value domain honest — from_pydict takes
+        logical values physical, to_pylist brings the cast results
+        back logical (DECIMAL scale, bools)."""
+        from risingwave_tpu.common.chunk import DataChunk
+        from risingwave_tpu.common.types import Field, Schema
         from risingwave_tpu.expr.expr import Cast, InputRef
 
         if not rows:
@@ -536,29 +536,36 @@ class Frontend:
         if all(s.data_type == d.data_type
                for s, d in zip(src_schema, dst_fields)):
             return [tuple(r) for r in rows]
-        chunk = rows_to_chunk(src_schema, [tuple(r) for r in rows])
-        cols = []
-        for i, (s, d) in enumerate(zip(src_schema, dst_fields)):
-            col = Cast(InputRef(i, s.data_type), d.data_type) \
-                .eval(chunk)
-            vals = np.asarray(col.values)[:len(rows)]
-            valid = None if col.validity is None else \
-                np.asarray(col.validity)[:len(rows)]
-            cols.append([
-                None if (valid is not None and not valid[j])
-                else (v.item() if hasattr(v, "item") else v)
-                for j, v in enumerate(vals)])
-        return [tuple(c[j] for c in cols) for j in range(len(rows))]
+        tmp_src = Schema([Field(f"_c{i}", f.data_type)
+                          for i, f in enumerate(src_schema)])
+        chunk = DataChunk.from_pydict(
+            tmp_src, {f"_c{i}": [r[i] for r in rows]
+                      for i in range(len(src_schema))})
+        cols = [Cast(InputRef(i, s.data_type),
+                     d.data_type).eval(chunk)
+                for i, (s, d) in enumerate(zip(src_schema,
+                                               dst_fields))]
+        tmp_dst = Schema([Field(f"_c{i}", d.data_type)
+                          for i, d in enumerate(dst_fields)])
+        return DataChunk(tmp_dst, cols, chunk.visibility).to_pylist()
 
     def _snapshot_rows(self, table_id: int, schema, pk) -> List[tuple]:
         from risingwave_tpu.common.epoch import Epoch, EpochPair
         from risingwave_tpu.state.state_table import StateTable
 
+        from risingwave_tpu.batch.storage_table import rows_to_chunk
+
         t = StateTable(table_id, schema, pk, self.store,
                        sanity_check=False)
         ce = self.store.committed_epoch()
         t.init_epoch(EpochPair(Epoch(ce + 1), Epoch(ce)))
-        return [tuple(row) for _pk, row in t.iter_rows()]
+        phys = [tuple(row) for _pk, row in t.iter_rows()]
+        if not phys:
+            return []
+        # state rows are PHYSICAL (DECIMAL = scaled int64); everything
+        # the DML channel re-ingests via from_pydict must be LOGICAL,
+        # so convert through a chunk round trip
+        return rows_to_chunk(schema, phys).to_pylist()
 
     def _match_rows(self, stmt_where, schema, rows):
         """The subset of rows a DML WHERE clause selects."""
@@ -623,17 +630,14 @@ class Frontend:
             chunk = DataChunk.from_pydict(
                 schema, {f.name: [r[i] for r in rows]
                          for i, f in enumerate(schema)})
-            import numpy as np
+            from risingwave_tpu.common.types import Field, Schema
             new_cols = {}
             for idx, b in sets:
                 col = b.eval(chunk)
-                vals = np.asarray(col.values)[:len(rows)]
-                valid = None if col.validity is None else \
-                    np.asarray(col.validity)[:len(rows)]
-                new_cols[idx] = [
-                    None if (valid is not None and not valid[i])
-                    else (v.item() if hasattr(v, "item") else v)
-                    for i, v in enumerate(vals)]
+                one_sch = Schema([Field("_v",
+                                        schema[idx].data_type)])
+                new_cols[idx] = [r[0] for r in DataChunk(
+                    one_sch, [col], chunk.visibility).to_pylist()]
             out_rows, ops = [], []
             new_pks = set()
             pk_touched = any(idx in pk for idx, _b in sets)

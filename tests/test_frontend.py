@@ -968,3 +968,43 @@ def test_insert_select_duplicate_output_names():
         await fe.close()
 
     asyncio.run(run())
+
+
+def test_table_decimal_roundtrip():
+    """DECIMAL values survive every DML path unscaled (the physical
+    scaled-int64 representation must never leak into or out of the
+    DML channel): VALUES, INSERT SELECT with coercion, UPDATE, DELETE
+    by value, and MV aggregation over the table."""
+    from decimal import Decimal
+
+    async def run():
+        fe = Frontend()
+        await fe.execute("CREATE TABLE t (d numeric, tag varchar)")
+        await fe.execute(
+            "INSERT INTO t VALUES (1.5, 'a'), (2.25, 'b')")
+        rows = sorted(await fe.execute("SELECT d, tag FROM t"))
+        assert rows == [(Decimal("1.5"), "a"),
+                        (Decimal("2.25"), "b")], rows
+        # coercing sibling column must not truncate the decimal
+        await fe.execute("CREATE TABLE t2 (d numeric, n varchar)")
+        await fe.execute("INSERT INTO t2 SELECT d, 7 FROM t")
+        rows = sorted(await fe.execute("SELECT d, n FROM t2"))
+        assert rows == [(Decimal("1.5"), "7"),
+                        (Decimal("2.25"), "7")], rows
+        # cast INTO numeric from bigint: scaled exactly once
+        await fe.execute(
+            "INSERT INTO t SELECT CAST(3 AS BIGINT), n FROM t2 "
+            "WHERE d > 2")
+        assert (Decimal("3"), "7") in await fe.execute(
+            "SELECT d, tag FROM t")
+        assert await fe.execute(
+            "UPDATE t SET d = d + 1 WHERE tag = 'a'") == "UPDATE 1"
+        assert (Decimal("2.5"), "a") in await fe.execute(
+            "SELECT d, tag FROM t")
+        assert await fe.execute(
+            "DELETE FROM t WHERE d = 2.25") == "DELETE 1"
+        s = await fe.execute("SELECT sum(d) AS s FROM t")
+        assert s == [(Decimal("5.5"),)], s
+        await fe.close()
+
+    asyncio.run(run())

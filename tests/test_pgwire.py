@@ -228,3 +228,41 @@ def test_pgwire_cleartext_password_auth():
     bad, good = asyncio.run(run())
     assert b"E" in bad and b"Z" not in bad
     assert good[-1] == b"Z"
+
+
+def test_pgwire_extended_protocol_dml():
+    """Parameterized INSERT through Parse/Bind/Execute — the
+    prepared-statement write path every ORM uses."""
+    async def run():
+        fe = Frontend()
+        await fe.execute("CREATE TABLE t (a bigint, b varchar)")
+        srv = PgServer(fe)
+        await srv.serve(port=0)
+        c = await _Client.connect(srv.port)
+
+        def ext(tag, body):
+            c.w.write(tag + struct.pack(">I", len(body) + 4) + body)
+
+        sql = "INSERT INTO t VALUES (CAST($1 AS BIGINT), $2)"
+        ext(b"P", b"ins\x00" + sql.encode() + b"\x00"
+            + struct.pack(">H", 0))
+        for a, b in ((b"1", b"x"), (b"2", b"y")):
+            ext(b"B", b"\x00ins\x00" + struct.pack(">H", 0)
+                + struct.pack(">H", 2)
+                + struct.pack(">i", len(a)) + a
+                + struct.pack(">i", len(b)) + b
+                + struct.pack(">H", 0))
+            ext(b"E", b"\x00" + struct.pack(">I", 0))
+        ext(b"S", b"")
+        await c.w.drain()
+        msgs = await c.read_until(b"Z")
+        tags = [p for t, p in msgs if t == b"C"]
+        assert len(tags) == 2 and all(b"INSERT 0 1" in p
+                                      for p in tags), tags
+        rows = sorted(_rows(await c.query("SELECT a, b FROM t")))
+        assert rows == [("1", "x"), ("2", "y")], rows
+        c.close()
+        await srv.close()
+        await fe.close()
+
+    asyncio.run(run())
