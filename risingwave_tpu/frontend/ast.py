@@ -111,7 +111,15 @@ class TableFn:
     alias: Optional[str] = None
 
 
-FromItem = object            # TableRef | Tumble | Hop | TableFn
+@dataclass
+class Subquery:
+    """Derived table: FROM (SELECT ...) alias."""
+
+    select: "Select"
+    alias: str
+
+
+FromItem = object            # TableRef | Tumble | Hop | TableFn | Subquery
 
 
 @dataclass
@@ -134,6 +142,7 @@ class Select:
     order_by: List[Tuple[Expr, bool]] = field(default_factory=list)
     limit: Optional[int] = None
     offset: Optional[int] = None
+    having: Optional[Expr] = None
 
 
 @dataclass

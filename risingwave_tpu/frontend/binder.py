@@ -87,6 +87,21 @@ class Binder:
             self.agg_inputs.append(input_expr)
         return self._agg_index[key]
 
+    def agg_out_type(self, j: int) -> DataType:
+        """Output type of registered agg call j — computable at bind
+        time from the bound input expression (the executor later
+        derives the identical type from the pre-agg schema; both go
+        through agg_result_type)."""
+        from risingwave_tpu.stream.executors.hash_agg import (
+            agg_result_type,
+        )
+        call, in_expr = self.agg_calls[j], self.agg_inputs[j]
+        t = None if in_expr is None else in_expr.return_type
+        try:
+            return agg_result_type(call.kind, t)
+        except TypeError as e:
+            raise BindError(str(e))
+
     # returns (Expression | ("agg", index), ...)
     def bind(self, e: ast.Expr) -> Expression:
         out = self._bind(e)
@@ -299,6 +314,110 @@ def _bind_lit(e: ast.Lit) -> Literal:
     if e.kind == "bool":
         return lit(bool(e.value), DataType.BOOLEAN)
     return Literal(None, DataType.INT64)       # bare NULL
+
+
+_AGG_NAMES = set(_AGG_KINDS) | {"avg", "string_agg", "array_agg"}
+
+
+def contains_agg(e: ast.Expr) -> bool:
+    """AST walk: does the expression contain an aggregate call?
+    OVER windows are opaque (their calls are window functions)."""
+    if isinstance(e, ast.Over):
+        return False
+    if isinstance(e, ast.Call):
+        return e.name in _AGG_NAMES or any(contains_agg(a)
+                                           for a in e.args)
+    if isinstance(e, ast.Bin):
+        return contains_agg(e.left) or contains_agg(e.right)
+    if isinstance(e, ast.Un):
+        return contains_agg(e.child)
+    if isinstance(e, ast.CastExpr):
+        return contains_agg(e.child)
+    return False
+
+
+def contains_colref(e: ast.Expr) -> bool:
+    if isinstance(e, ast.ColRef):
+        return True
+    if isinstance(e, ast.Over):
+        return True
+    if isinstance(e, ast.Call):
+        return any(contains_colref(a) for a in e.args)
+    if isinstance(e, ast.Bin):
+        return contains_colref(e.left) or contains_colref(e.right)
+    if isinstance(e, ast.Un):
+        return contains_colref(e.child)
+    if isinstance(e, ast.CastExpr):
+        return contains_colref(e.child)
+    return False
+
+
+class PostAggBinder:
+    """Binds a post-aggregation expression (SELECT item or HAVING)
+    into an Expression over the agg OUTPUT row: group-expression
+    matches become column refs 0..g-1, aggregate calls become refs
+    g+j, and scalar operators recurse (the reference folds this into
+    LogicalAgg planning, logical_agg.rs rewrite_with_agg_calls).
+
+    Registers agg calls on the shared `binder` as it goes — run every
+    post-agg bind BEFORE constructing the HashAggExecutor."""
+
+    def __init__(self, binder: Binder, group_reprs: List[str]):
+        self.binder = binder
+        self.group_reprs = group_reprs
+        self.g = len(group_reprs)
+
+    def bind(self, e: ast.Expr):
+        from risingwave_tpu.expr.expr import Cast
+        # aggregate call at this node → agg output column(s)
+        if isinstance(e, ast.Call) and e.name in _AGG_NAMES:
+            b = self.binder._bind_call(e)
+            if isinstance(b, tuple) and b[0] == "agg":
+                j = b[1]
+                return InputRef(self.g + j, self.binder.agg_out_type(j))
+            if isinstance(b, tuple) and b[0] == "avg":
+                _tag, sj, cj = b
+                s = Cast(InputRef(self.g + sj,
+                                  self.binder.agg_out_type(sj)),
+                         DataType.FLOAT64)
+                c = Cast(InputRef(self.g + cj,
+                                  self.binder.agg_out_type(cj)),
+                         DataType.FLOAT64)
+                return BinaryOp("/", s, c)
+            return b
+        # whole expression matches a GROUP BY expression → group col
+        try:
+            plain = Binder(self.binder.scope).bind(e)
+        except BindError:
+            plain = None
+        if plain is not None:
+            r = repr(plain)
+            if r in self.group_reprs:
+                i = self.group_reprs.index(r)
+                return InputRef(i, plain.return_type)
+            if not contains_colref(e):
+                return plain           # constant — valid anywhere
+        # recurse: some subtree must be grouped or aggregated
+        if isinstance(e, ast.Bin):
+            return BinaryOp(e.op, self.bind(e.left), self.bind(e.right))
+        if isinstance(e, ast.Un):
+            return UnaryOp("not" if e.op == "not" else "neg",
+                           self.bind(e.child))
+        if isinstance(e, ast.CastExpr):
+            from risingwave_tpu.expr.expr import Cast
+            try:
+                to = DataType.from_sql(e.type_name)
+            except KeyError:
+                raise BindError(f"unknown type {e.type_name!r}")
+            return Cast(self.bind(e.child), to)
+        if isinstance(e, ast.Call):
+            if e.name == "case":
+                args = [self.bind(a) for a in e.args]
+                whens = list(zip(args[:-1:2], args[1:-1:2]))
+                return Case(whens, args[-1])
+            return FuncCall(e.name, [self.bind(a) for a in e.args])
+        raise BindError(
+            f"expression {e!r} is neither grouped nor aggregated")
 
 
 def expr_name(e: ast.Expr, fallback: str) -> str:

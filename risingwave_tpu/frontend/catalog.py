@@ -34,6 +34,16 @@ class MvCatalog:
     # replans the same definition from the same base so every state
     # table gets its ORIGINAL id back (state survives the replan)
     id_base: int = -1
+    # user-facing column count; trailing columns past it are hidden
+    # plumbing (_row_id, unprojected group keys) that SELECT * and
+    # downstream scopes must not expose (None = all visible)
+    n_visible: Optional[int] = None
+
+    @property
+    def visible_schema(self) -> Schema:
+        if self.n_visible is None:
+            return self.schema
+        return Schema(list(self.schema)[:self.n_visible])
 
 
 @dataclass

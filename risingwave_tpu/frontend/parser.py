@@ -34,7 +34,7 @@ KEYWORDS = {
     "sink", "sinks", "left", "right", "full", "outer", "distinct",
     "explain", "over", "partition", "alter", "set", "parallelism",
     "for", "emit", "window", "close", "insert", "into", "values",
-    "delete", "update", "primary", "key",
+    "delete", "update", "primary", "key", "having", "between",
 }
 
 # keywords that can never start a primary expression (a column named
@@ -42,7 +42,7 @@ KEYWORDS = {
 RESERVED = {
     "select", "from", "where", "group", "by", "order", "limit", "offset",
     "as", "and", "or", "not", "join", "inner", "on", "create", "drop",
-    "when", "then", "else", "end", "with",
+    "when", "then", "else", "end", "with", "having",
 }
 
 _INTERVAL_UNITS = {
@@ -358,6 +358,7 @@ class Parser:
             group_by.append(self._expr())
             while self._op(","):
                 group_by.append(self._expr())
+        having = self._expr() if self._kw("having") else None
         order_by: List[Tuple[ast.Expr, bool]] = []
         if self._kw("order", "by"):
             while True:
@@ -374,7 +375,7 @@ class Parser:
         if self._kw("offset"):
             offset = int(self._next()[1])
         return ast.Select(projections, from_item, joins, where, group_by,
-                          order_by, limit, offset)
+                          order_by, limit, offset, having=having)
 
     def _projection(self) -> Tuple[ast.Expr, Optional[str]]:
         if self._op("*"):
@@ -388,6 +389,20 @@ class Parser:
         return (e, alias)
 
     def _from_item(self):
+        if self._peek() == ("op", "(") and \
+                self._peek(1) == ("kw", "select"):
+            # derived table: FROM (SELECT ...) alias
+            self._expect_op("(")
+            sel = self._select()
+            self._expect_op(")")
+            if self._kw("as"):
+                alias = self._ident()
+            elif self._peek()[0] == "ident":
+                alias = self._ident()
+            else:
+                raise ParseError(
+                    "subquery in FROM must have an alias")
+            return ast.Subquery(sel, alias)
         if self._kw("tumble"):
             self._expect_op("(")
             table = ast.TableRef(self._ident())
@@ -459,6 +474,13 @@ class Parser:
 
     def _cmp_expr(self) -> ast.Expr:
         e = self._add_expr()
+        if self._kw("between"):
+            # e BETWEEN lo AND hi ⇒ e >= lo AND e <= hi
+            lo = self._add_expr()
+            self._expect_kw("and")
+            hi = self._add_expr()
+            return ast.Bin("and", ast.Bin(">=", e, lo),
+                           ast.Bin("<=", e, hi))
         kind, text = self._peek()
         if kind == "op" and text in self._CMP:
             self.i += 1

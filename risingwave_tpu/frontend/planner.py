@@ -216,6 +216,18 @@ class StreamPlanner:
         """FROM item → executor + scope (+ dependent source names)."""
         from risingwave_tpu.stream.exchange import channel_for_test
 
+        if isinstance(item, ast.Subquery):
+            # derived table (binder/bind_query subquery analog): plan
+            # the inner SELECT as this fragment's upstream chain; its
+            # hidden pk columns stay in the executor schema but out of
+            # the visible scope
+            ex, _pk, deps, n_vis = self._plan_query(
+                item.select, self._actor_id, rate_limit, min_chunks)
+            self._wm_scope_cols = set()   # wm feed unproven through
+            self._eowc_wm_col = None      # inner value is meaningless
+            #                               against the OUTER schema
+            vis = Schema(list(ex.schema)[:n_vis])
+            return ex, Scope(vis, [item.alias] * n_vis), deps
         if isinstance(item, (ast.Tumble, ast.Hop)):
             ref, alias = item.table, item.alias or item.table.name
         elif isinstance(item, ast.TableRef):
@@ -343,7 +355,9 @@ class StreamPlanner:
                               [0], self.store)
         ex = BackfillExecutor(recv, mv_read, progress,
                               identity=f"Backfill({mv.name})")
-        return ex, Scope.of(mv.schema, alias)
+        # expose only the MV's user-facing columns (hidden _row_id /
+        # group-key plumbing stays out of downstream scopes)
+        return ex, Scope.of(mv.visible_schema, alias)
 
     # -- the main plan ---------------------------------------------------
     def plan(self, name: str, sel: ast.Select, actor_id: int,
@@ -352,8 +366,8 @@ class StreamPlanner:
              emit_on_window_close: bool = False) -> StreamPlan:
         self._actor_id = actor_id
         self._eowc_wm_col = None
-        ex, pk, deps = self._plan_query(sel, actor_id, rate_limit,
-                                        min_chunks)
+        ex, pk, deps, nvis = self._plan_query(sel, actor_id,
+                                              rate_limit, min_chunks)
         if emit_on_window_close:
             # gate results behind the window watermark (sort_buffer.rs
             # / AggGroup::create_eowc semantics as a downstream gate)
@@ -375,7 +389,8 @@ class StreamPlanner:
                               self.store)
         mat = MaterializeExecutor(ex, mv_table)
         mv = MvCatalog(name, mv_table.table_id, ex.schema, pk,
-                       self.definition, actor_id, deps)
+                       self.definition, actor_id, deps,
+                       n_visible=nvis if nvis < len(ex.schema) else None)
         return StreamPlan(mat, mv, self.readers, self.pending_attaches)
 
     def plan_sink(self, sel: ast.Select, options: Dict[str, str],
@@ -385,8 +400,8 @@ class StreamPlanner:
         from risingwave_tpu.stream.executors.sink import SinkExecutor
 
         self._actor_id = actor_id
-        ex, _pk, deps = self._plan_query(sel, actor_id, rate_limit,
-                                         min_chunks)
+        ex, _pk, deps, _nvis = self._plan_query(sel, actor_id,
+                                                rate_limit, min_chunks)
         writer = make_sink_writer(options)
         # durable stream-position counter: the exactly-once writers'
         # recovery reconciliation anchor (sink coordinator epoch-log);
@@ -528,24 +543,47 @@ class StreamPlanner:
                for e, _a in projections):
             return self._plan_project_set(ex, scope, sel, projections,
                                           deps)
+        from risingwave_tpu.frontend.binder import contains_agg
         binder = Binder(scope, allow_aggs=True)
-        bound = [binder.bind_projection(e) for e, _a in projections]
         names = [a or expr_name(e, f"col{i}")
                  for i, (e, a) in enumerate(projections)]
-        if binder.window_calls:
-            if binder.agg_calls or sel.group_by:
+        has_agg = (bool(sel.group_by) or sel.having is not None
+                   or any(contains_agg(e) for e, _a in projections))
+        if has_agg:
+            if any(isinstance(e, ast.Over) for e, _a in projections):
                 raise PlanError("window functions cannot be mixed "
                                 "with GROUP BY / aggregates (yet)")
-            ex, bound = self._plan_over_window(ex, binder, bound)
-        if binder.agg_calls or sel.group_by:
-            ex, out_exprs = self._plan_agg(ex, scope, sel, binder, bound)
+            ex, out_exprs, having_pred = self._plan_agg(
+                ex, scope, sel, binder, projections)
+            # MV/stream key = the FULL group-key set. Unprojected group
+            # keys ride along as hidden trailing columns (nexmark q4's
+            # inner query groups by (id, category) but projects only
+            # category — without the hidden id the change stream would
+            # collide distinct groups)
+            g = len(sel.group_by)
+            proj_of_group: Dict[int, int] = {}
+            for pos, e in enumerate(out_exprs):
+                if isinstance(e, InputRef) and e.index < g \
+                        and e.index not in proj_of_group:
+                    proj_of_group[e.index] = pos
+            for gi in range(g):
+                if gi not in proj_of_group:
+                    proj_of_group[gi] = len(out_exprs)
+                    out_exprs.append(
+                        InputRef(gi, ex.schema[gi].data_type))
+                    names.append(f"_g{gi}")
+            pk = [proj_of_group[gi] for gi in range(g)]
             # plain group-key outputs carry the agg's watermarks (the
             # EOWC gate and downstream window ops depend on them)
             derivs = {e.index: j for j, e in enumerate(out_exprs)
                       if isinstance(e, InputRef)}
+            if having_pred is not None:
+                # HAVING filters the agg's change stream BEFORE the
+                # output projection (logical_agg.rs plans it as a
+                # LogicalFilter over the agg)
+                ex = FilterExecutor(ex, having_pred)
             ex = ProjectExecutor(ex, out_exprs, names,
                                  watermark_derivations=derivs)
-            pk = _agg_output_pk(sel, out_exprs)
             # EOWC window column: the first group key that PROVABLY
             # carries a watermark all the way from the source (a gate
             # with no watermark feed would hold results forever)
@@ -553,6 +591,9 @@ class StreamPlanner:
                 (derivs[pos] for pos in self._agg_wm_positions
                  if pos in derivs), None)
         else:
+            bound = [binder.bind_projection(e) for e, _a in projections]
+            if binder.window_calls:
+                ex, bound = self._plan_over_window(ex, binder, bound)
             exprs = list(bound)
             base_pk = list(ex.pk_indices)
             if join_pk_cols is not None:
@@ -587,7 +628,7 @@ class StreamPlanner:
             # when provably append-only (top_n_appendonly analog)
             ex = self._plan_topn(ex, sel, pk,
                                  append_only=self._derive_append_only(ex))
-        return ex, pk, deps
+        return ex, pk, deps, len(projections)
 
     def _plan_topn(self, ex: Executor, sel: ast.Select,
                    pk: List[int], append_only: bool = False) -> Executor:
@@ -673,9 +714,9 @@ class StreamPlanner:
         from risingwave_tpu.stream.executors.project_set import (
             ProjectSetExecutor,
         )
-        if sel.group_by:
+        if sel.group_by or sel.having is not None:
             raise PlanError("set-returning functions cannot be mixed "
-                            "with GROUP BY")
+                            "with GROUP BY / HAVING")
         binder = Binder(scope)      # aggregates raise naturally
         items, names = [], []
         ints = (DataType.INT16, DataType.INT32, DataType.INT64)
@@ -725,7 +766,7 @@ class StreamPlanner:
             ex = self._plan_topn(
                 ex, sel, pk,
                 append_only=self._derive_append_only(ex))
-        return ex, pk, deps
+        return ex, pk, deps, len(names)
 
     def _plan_over_window(self, ex: Executor, binder: Binder, bound):
         """Insert an OverWindowExecutor (optimizer/plan_node/
@@ -761,11 +802,23 @@ class StreamPlanner:
         return win, out
 
     def _plan_agg(self, ex: Executor, scope: Scope, sel: ast.Select,
-                  binder: Binder, bound) -> Tuple[Executor, List]:
-        """Insert pre-agg projection + HashAggExecutor; return output
-        exprs for the post-agg projection."""
+                  binder: Binder, projections) -> Tuple[Executor, List, object]:
+        """Insert pre-agg projection + HashAggExecutor; returns
+        (agg executor, output exprs over the agg row, HAVING predicate
+        over the agg row or None). SELECT items and HAVING bind through
+        PostAggBinder, so expressions OVER aggregates (sum(x)+1,
+        avg(q.final), HAVING count(*) > 5) work — the reference resolves
+        these in LogicalAgg planning (logical_agg.rs)."""
+        from risingwave_tpu.frontend.binder import PostAggBinder
         group_bound = [Binder(scope).bind(g) for g in sel.group_by]
         group_reprs = [repr(g) for g in group_bound]
+        pab = PostAggBinder(binder, group_reprs)
+        bound = [pab.bind(e) for e, _a in projections]
+        having_pred = None
+        if sel.having is not None:
+            having_pred = pab.bind(sel.having)
+            if having_pred.return_type != DataType.BOOLEAN:
+                raise PlanError("HAVING must be a boolean expression")
         # pre-agg projection: group exprs, then each agg input column
         pre_exprs: List[Expression] = list(group_bound)
         pre_names = [f"_g{i}" for i in range(len(group_bound))]
@@ -834,34 +887,8 @@ class StreamPlanner:
                               append_only=append_only, kernel=kernel,
                               minput_tables=minput_tables,
                               distinct_tables=distinct_tables)
-        # post-agg projection: map each SELECT item
-        out = [_map_agg_projection(b, g, agg.schema, group_reprs)
-               for b in bound]
-        return agg, out
-
-
-def _map_agg_projection(b, g: int, agg_schema, group_reprs):
-    """Post-agg SELECT item → expression over the agg output row.
-
-    b is a bound projection: Expression (must match a group expr),
-    ("agg", j), or ("avg", sum_j, count_j) — avg divides in float64
-    (documented approximation of pg's numeric avg)."""
-    if isinstance(b, tuple) and b[0] == "agg":
-        j = b[1]
-        return InputRef(g + j, agg_schema[g + j].data_type)
-    if isinstance(b, tuple) and b[0] == "avg":
-        _tag, sj, cj = b
-        s = Cast(InputRef(g + sj, agg_schema[g + sj].data_type),
-                 DataType.FLOAT64)
-        c = Cast(InputRef(g + cj, agg_schema[g + cj].data_type),
-                 DataType.FLOAT64)
-        return BinaryOp("/", s, c)
-    r = repr(b)
-    if r not in group_reprs:
-        raise PlanError(
-            f"projection {r} is neither grouped nor aggregated")
-    i = group_reprs.index(r)
-    return InputRef(i, agg_schema[i].data_type)
+        # bound items are already typed refs over the agg output row
+        return agg, bound, having_pred
 
 
 def _expand_star(projections, scope: Scope):
@@ -873,16 +900,6 @@ def _expand_star(projections, scope: Scope):
         else:
             out.append((e, a))
     return out
-
-
-def _agg_output_pk(sel: ast.Select, out_exprs) -> List[int]:
-    """MV pk = the projected group keys (must all be projected)."""
-    pk = [i for i, e in enumerate(out_exprs)
-          if isinstance(e, InputRef) and e.index < len(sel.group_by)]
-    if len(pk) != len(sel.group_by):
-        raise PlanError("every GROUP BY key must appear in the MV's "
-                        "SELECT list (it is the MV primary key)")
-    return pk
 
 
 def _parse_interval_opt(s: str) -> Interval:
@@ -1028,6 +1045,9 @@ def plan_batch(sel: ast.Select, catalog: Catalog, store, epoch: int):
             col = item.alias or "generate_series"
             sch = Schema([Field(col, DataType.INT64)])
             return (BatchValues(sch, rows), Scope.of(sch, col))
+        if isinstance(item, ast.Subquery):
+            sub = plan_batch(item.select, catalog, store, epoch)
+            return sub, Scope.of(sub.schema, item.alias)
         if not isinstance(item, ast.TableRef):
             raise PlanError("batch FROM supports tables/MVs")
         obj = catalog.resolve(item.name)
@@ -1035,8 +1055,10 @@ def plan_batch(sel: ast.Select, catalog: Catalog, store, epoch: int):
             raise PlanError("cannot batch-scan a pure source; "
                             "create a materialized view over it")
         st = StorageTable(obj.table_id, obj.schema, obj.pk_indices, store)
+        # scan decodes the FULL stored schema; the binding scope (and
+        # thus SELECT *) sees only the user-facing columns
         return (RowSeqScan(st, epoch),
-                Scope.of(obj.schema, item.alias or item.name))
+                Scope.of(obj.visible_schema, item.alias or item.name))
 
     if sel.from_item is None:
         # SELECT <exprs>: evaluate over one synthetic row
@@ -1067,13 +1089,22 @@ def plan_batch(sel: ast.Select, catalog: Catalog, store, epoch: int):
     if sel.where is not None:
         ex = BatchFilter(ex, Binder(scope).bind(sel.where))
     projections = _expand_star(sel.projections, scope)
+    from risingwave_tpu.frontend.binder import PostAggBinder, contains_agg
     binder = Binder(scope, allow_aggs=True)
-    bound = [binder.bind_projection(e) for e, _a in projections]
     names = [a or expr_name(e, f"col{i}")
              for i, (e, a) in enumerate(projections)]
-    if binder.agg_calls or sel.group_by:
+    has_agg = (bool(sel.group_by) or sel.having is not None
+               or any(contains_agg(e) for e, _a in projections))
+    if has_agg:
         group_bound = [Binder(scope).bind(g) for g in sel.group_by]
         group_reprs = [repr(g) for g in group_bound]
+        pab = PostAggBinder(binder, group_reprs)
+        bound = [pab.bind(e) for e, _a in projections]
+        having_pred = None
+        if sel.having is not None:
+            having_pred = pab.bind(sel.having)
+            if having_pred.return_type != DataType.BOOLEAN:
+                raise PlanError("HAVING must be a boolean expression")
         pre_exprs = list(group_bound)
         remapped = []
         for call, in_expr in zip(binder.agg_calls, binder.agg_inputs):
@@ -1082,15 +1113,17 @@ def plan_batch(sel: ast.Select, catalog: Catalog, store, epoch: int):
                 continue
             pre_exprs.append(in_expr)      # agg over any expression
             remapped.append(AggCall(call.kind, len(pre_exprs) - 1,
-                                    distinct=call.distinct))
+                                    distinct=call.distinct,
+                                    delimiter=call.delimiter))
         pre = BatchProject(ex, pre_exprs)
         g = len(group_bound)
-        agg = BatchHashAgg(pre, list(range(g)), remapped)
-        out = [_map_agg_projection(b, g, agg.schema, group_reprs)
-               for b in bound]
-        ex = BatchProject(agg, out, names)
+        ex = BatchHashAgg(pre, list(range(g)), remapped)
+        if having_pred is not None:
+            ex = BatchFilter(ex, having_pred)
+        ex = BatchProject(ex, bound, names)
         post_scope = Scope.of(ex.schema, None)
     else:
+        bound = [binder.bind_projection(e) for e, _a in projections]
         ex = BatchProject(ex, bound, names)
         post_scope = Scope.of(ex.schema, None)
     if sel.order_by:

@@ -410,7 +410,9 @@ class Frontend:
                 mat = MaterializeExecutor(src, table)
                 mv = MvCatalog(stmt.name, table_id, schema, pk,
                                definition="", actor_id=actor_id,
-                               id_base=id_base)
+                               id_base=id_base,
+                               n_visible=len(fields) if rowid is not None
+                               else None)
                 await self._deploy_job(stmt.name, actor_id, mat,
                                        {sid: reader},
                                        lambda: self.catalog.add_mv(mv))

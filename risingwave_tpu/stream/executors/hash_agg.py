@@ -53,6 +53,25 @@ _SUM_OUT = {
 }
 
 
+def agg_result_type(kind: AggKind,
+                    input_type: Optional[DataType]) -> DataType:
+    """Result type of one aggregate call — the ONE copy of these
+    rules (AggCall.out_type and the binder's post-agg typing both
+    call it; agg/mod.rs return-type derivation analog)."""
+    if kind in (AggKind.COUNT, AggKind.APPROX_COUNT_DISTINCT):
+        return DataType.INT64
+    if kind == AggKind.STRING_AGG:
+        return DataType.VARCHAR
+    if kind == AggKind.ARRAY_AGG:
+        return DataType.LIST
+    if kind == AggKind.SUM:
+        try:
+            return _SUM_OUT[input_type]
+        except KeyError:
+            raise TypeError(f"sum over {input_type} unsupported")
+    return input_type
+
+
 @dataclass(frozen=True)
 class AggCall:
     """Logical aggregate call (agg/mod.rs AggCall analog)."""
@@ -68,20 +87,9 @@ class AggCall:
     delimiter: str = ","
 
     def out_type(self, input_schema: Schema) -> DataType:
-        if self.kind in (AggKind.COUNT,
-                         AggKind.APPROX_COUNT_DISTINCT):
-            return DataType.INT64
-        if self.kind == AggKind.STRING_AGG:
-            return DataType.VARCHAR
-        if self.kind == AggKind.ARRAY_AGG:
-            return DataType.LIST
-        in_t = input_schema[self.input_idx].data_type
-        if self.kind == AggKind.SUM:
-            try:
-                return _SUM_OUT[in_t]
-            except KeyError:
-                raise TypeError(f"sum over {in_t} unsupported")
-        return in_t
+        in_t = None if self.input_idx is None \
+            else input_schema[self.input_idx].data_type
+        return agg_result_type(self.kind, in_t)
 
     def spec(self, input_schema: Schema) -> AggSpec:
         if self.kind == AggKind.COUNT and self.input_idx is None:
