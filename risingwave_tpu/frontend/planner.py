@@ -400,8 +400,16 @@ class StreamPlanner:
         from risingwave_tpu.stream.executors.sink import SinkExecutor
 
         self._actor_id = actor_id
-        ex, _pk, deps, _nvis = self._plan_query(sel, actor_id,
-                                                rate_limit, min_chunks)
+        ex, _pk, deps, nvis = self._plan_query(sel, actor_id,
+                                               rate_limit, min_chunks)
+        if nvis < len(ex.schema):
+            # hidden plumbing columns (_row_id, unprojected group keys)
+            # must not reach an EXTERNAL sink — emit exactly the
+            # declared SELECT list
+            ex = ProjectExecutor(
+                ex, [InputRef(i, f.data_type)
+                     for i, f in enumerate(list(ex.schema)[:nvis])],
+                [f.name for f in list(ex.schema)[:nvis]])
         writer = make_sink_writer(options)
         # durable stream-position counter: the exactly-once writers'
         # recovery reconciliation anchor (sink coordinator epoch-log);
@@ -1055,10 +1063,16 @@ def plan_batch(sel: ast.Select, catalog: Catalog, store, epoch: int):
             raise PlanError("cannot batch-scan a pure source; "
                             "create a materialized view over it")
         st = StorageTable(obj.table_id, obj.schema, obj.pk_indices, store)
-        # scan decodes the FULL stored schema; the binding scope (and
-        # thus SELECT *) sees only the user-facing columns
-        return (RowSeqScan(st, epoch),
-                Scope.of(obj.visible_schema, item.alias or item.name))
+        ex = RowSeqScan(st, epoch)
+        vis = obj.visible_schema
+        if len(vis) < len(obj.schema):
+            # hidden trailing columns (_row_id, unprojected group keys)
+            # must leave the EXECUTOR schema too, not just the binding
+            # scope — a downstream join concatenates executor schemas,
+            # and a width mismatch would shift every right-side index
+            ex = BatchProject(ex, [InputRef(i, f.data_type)
+                                   for i, f in enumerate(vis)])
+        return ex, Scope.of(vis, item.alias or item.name)
 
     if sel.from_item is None:
         # SELECT <exprs>: evaluate over one synthetic row

@@ -71,7 +71,11 @@ def link_rows(chains: ChainState, slots: jnp.ndarray,
 
     `slots` comes from the key table's probe_insert for the same batch;
     rows of the batch that share a slot are linked to each other via a
-    stable sort so the whole batch needs one scatter per array."""
+    stable sort so the whole batch needs one scatter per array.
+
+    Within one batch, rows sharing a key keep BATCH ORDER in the chain
+    via the stable sort; `seq` may be a per-row vector (epoch batching:
+    each row carries its message sequence) or a scalar."""
     row_cap = int(chains.next.shape[0])
     skey = jnp.where(vis & (slots >= 0), slots, cap)
     order = jnp.argsort(skey, stable=True)
@@ -87,8 +91,14 @@ def link_rows(chains: ChainState, slots: jnp.ndarray,
         nxt_val, mode="drop")
     head = chains.head.at[jnp.where(valid & first, s, cap)].set(
         r, mode="drop")
+    if seq is None:
+        sv = jnp.int32(0)
+    elif jnp.ndim(seq) == 0:
+        sv = seq
+    else:
+        sv = seq[order]                         # per-row seq follows r
     ins = chains.ins_seq.at[jnp.where(valid, r, row_cap)].set(
-        jnp.int32(0) if seq is None else seq, mode="drop")
+        sv, mode="drop")
     return ChainState(head, nxt, ins, chains.del_seq)
 
 
@@ -104,7 +114,8 @@ def tombstone_rows(chains: ChainState, row_refs: jnp.ndarray,
 
 def probe_pairs(table: ht.TableState, chains: ChainState,
                 key_lanes: jnp.ndarray, vis: jnp.ndarray,
-                seq: jnp.ndarray, out_cap: int) -> jnp.ndarray:
+                seq: jnp.ndarray, out_cap: int,
+                with_degrees: bool = True) -> jnp.ndarray:
     """Fused degrees + cumsum + emit: ONE kernel, ONE packed d2h array.
 
     Returns int32[1 + n + out_cap, 2]: row 0 header [total_pairs, 0];
@@ -113,6 +124,11 @@ def probe_pairs(table: ht.TableState, chains: ChainState,
     the separate degrees fetch + host cumsum + emit fetch cost three
     round-trips per chunk; this costs one (the host retries with a
     doubled out_cap if the header says the pair buffer overflowed).
+
+    `seq` may be a per-row vector (epoch batching: every row probes at
+    its own message sequence). `with_degrees=False` drops the n degree
+    rows from the output — inner joins never read them, and on a
+    ~20MB/s tunnel the d2h bytes are the barrier's dominant cost.
     """
     n = key_lanes.shape[0]
     slots = ht.lookup(table, key_lanes, vis)
@@ -153,14 +169,62 @@ def probe_pairs(table: ht.TableState, chains: ChainState,
         (cur0, offsets, jnp.full(out_cap, -1, dtype=jnp.int32),
          jnp.full(out_cap, -1, dtype=jnp.int32)))
     pairs = jnp.stack([out_probe, out_ref], axis=1)
-    degs = jnp.stack([deg, jnp.zeros(n, dtype=jnp.int32)], axis=1)
     header = jnp.zeros((1, 2), dtype=jnp.int32).at[0, 0].set(total)
+    if not with_degrees:
+        return jnp.concatenate([header, pairs], axis=0)
+    degs = jnp.stack([deg, jnp.zeros(n, dtype=jnp.int32)], axis=1)
     return jnp.concatenate([header, degs, pairs], axis=0)
 
 
 _link_jit = jax.jit(link_rows, donate_argnums=(0,), static_argnums=(4,))
 _tombstone_jit = jax.jit(tombstone_rows, donate_argnums=(0,))
-_probe_pairs_jit = jax.jit(probe_pairs, static_argnums=(5,))
+_probe_pairs_jit = jax.jit(probe_pairs, static_argnums=(5, 6))
+
+
+# -- epoch batching --------------------------------------------------------
+# One packed aux matrix rides along with the key lanes and feeds BOTH
+# the apply and the probe of a whole epoch: through the tunnel, per-
+# barrier transfer count (not compute) bounds throughput, so the
+# executor concatenates every chunk of the epoch and ships each side as
+# exactly two uploads + one apply dispatch + one probe dispatch.
+AUX_INS_REF, AUX_DEL_REF, AUX_FLAGS, AUX_SEQ = 0, 1, 2, 3
+FLAG_PROBE, FLAG_INS, FLAG_DEL = 1, 2, 4
+
+
+def epoch_apply(table: ht.TableState, chains: ChainState,
+                key_lanes: jnp.ndarray, aux: jnp.ndarray):
+    """Apply a whole epoch's inserts + tombstones in one dispatch.
+
+    Rows carry their message sequence in aux[:, AUX_SEQ]; sequence
+    visibility makes application order irrelevant (probes reconstruct
+    any interleaving exactly), so one batched apply per side per epoch
+    is semantically identical to per-chunk applies."""
+    flags = aux[:, AUX_FLAGS]
+    ins_mask = (flags & FLAG_INS) != 0
+    del_mask = (flags & FLAG_DEL) != 0
+    seq = aux[:, AUX_SEQ]
+    table2, slots, ins = ht.probe_insert(table, key_lanes, ins_mask)
+    chains2 = link_rows(chains, slots, aux[:, AUX_INS_REF], ins_mask,
+                        table2.capacity, seq)
+    chains2 = tombstone_rows(chains2, aux[:, AUX_DEL_REF], del_mask, seq)
+    return table2, chains2, ins
+
+
+_epoch_apply_jit = jax.jit(epoch_apply, donate_argnums=(0, 1))
+
+
+def epoch_probe(table: ht.TableState, chains: ChainState,
+                key_lanes: jnp.ndarray, aux: jnp.ndarray,
+                out_cap: int, with_degrees: bool) -> jnp.ndarray:
+    """Probe a whole epoch's rows (each at its own sequence) in one
+    dispatch against post-apply state — exact by sequence visibility."""
+    vis = (aux[:, AUX_FLAGS] & FLAG_PROBE) != 0
+    seq = aux[:, AUX_SEQ]
+    return probe_pairs(table, chains, key_lanes, vis, seq, out_cap,
+                       with_degrees)
+
+
+_epoch_probe_jit = jax.jit(epoch_probe, static_argnums=(4, 5))
 
 
 def apply_and_probe(my_table: ht.TableState, my_chains: ChainState,
@@ -217,20 +281,25 @@ class PendingProbe:
 
     Sequence versioning makes collect() safe at any later point — the
     kernel may have applied more messages, and a re-dispatch after a
-    pair-buffer overflow still returns the probe-time result."""
+    pair-buffer overflow still returns the probe-time result.
+    `redispatch(cap)` re-runs the probe against the kernel's CURRENT
+    state at a larger pair capacity; `bump(cap)` records the grown
+    capacity on the owning kernel."""
 
-    def __init__(self, kernel: "JoinSideKernel", mat, key_lanes, vis,
-                 seq, cap: int):
-        self.kernel = kernel
+    def __init__(self, mat, n: int, cap: int, redispatch,
+                 with_degrees: bool = True, bump=None):
         self.mat = mat
-        self.key_lanes = key_lanes
-        self.vis = vis
-        self.seq = seq
+        self.n = n
         self.cap = cap
+        self.redispatch = redispatch
+        self.with_degrees = with_degrees
+        self.bump = bump
 
-    def collect(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """(degrees, probe_idx[pairs], refs[pairs])."""
-        n = int(self.key_lanes.shape[0])
+    def collect(self) -> Tuple[Optional[np.ndarray], np.ndarray,
+                               np.ndarray]:
+        """(degrees | None, probe_idx[pairs], refs[pairs]). Pairs are
+        sorted by probe row index (device cumsum offsets)."""
+        n = self.n
         while True:
             mat = jaxtools.fetch1(self.mat)
             total = int(mat[0, 0])
@@ -238,14 +307,16 @@ class PendingProbe:
                 break
             from risingwave_tpu.common.chunk import next_pow2
             self.cap = max(self.cap * 2, next_pow2(total))
-            self.kernel._probe_cap = max(self.kernel._probe_cap,
-                                         self.cap)
-            self.mat = _probe_pairs_jit(
-                self.kernel.table.state, self.kernel.chains,
-                self.key_lanes, self.vis, self.seq, self.cap)
+            if self.bump is not None:
+                self.bump(self.cap)
+            self.mat = self.redispatch(self.cap)
             jaxtools.start_fetch(self.mat)
-        deg = np.ascontiguousarray(mat[1:1 + n, 0])
-        pairs = mat[1 + n:1 + n + total]
+        if self.with_degrees:
+            deg = np.ascontiguousarray(mat[1:1 + n, 0])
+            pairs = mat[1 + n:1 + n + total]
+        else:
+            deg = None
+            pairs = mat[1:1 + total]
         return (deg, np.ascontiguousarray(pairs[:, 0]),
                 np.ascontiguousarray(pairs[:, 1]))
 
@@ -300,7 +371,11 @@ class JoinSideKernel:
             return
         new_cap = row_cap
         while new_cap <= max_ref:
-            new_cap *= 2
+            # 4x, not 2x: every growth step retraces/recompiles the
+            # apply+probe programs at the new row shape (~0.1s trace on
+            # host, far worse through the tunnel); chains are 3 int32
+            # arrays, so the overshoot is cheap HBM
+            new_cap *= 4
         pad = new_cap - row_cap
         self.chains = self.chains._replace(
             next=jnp.concatenate(
@@ -345,17 +420,26 @@ class JoinSideKernel:
         self.table.reserve(n)
         s = jnp.int32(seq)
         out_cap = other._probe_cap
+        lanes_d = jnp.asarray(key_lanes)
+        vis_d = jnp.asarray(probe_vis)
         self.table.state, self.chains, ins, mat = _apply_and_probe_jit(
             self.table.state, self.chains,
             other.table.state, other.chains,
-            key_lanes, jnp.asarray(probe_vis),
+            lanes_d, vis_d,
             jnp.asarray(ins_refs), jnp.asarray(ins_mask),
             jnp.asarray(del_refs), jnp.asarray(del_mask),
             s, out_cap)
         self.table._counters.push(ins, n)
         jaxtools.start_fetch(mat)
-        return PendingProbe(other, mat, key_lanes,
-                            jnp.asarray(probe_vis), s, out_cap)
+
+        def redispatch(cap):
+            return _probe_pairs_jit(other.table.state, other.chains,
+                                    lanes_d, vis_d, s, cap, True)
+
+        def bump(cap):
+            other._probe_cap = max(other._probe_cap, cap)
+
+        return PendingProbe(mat, n, out_cap, redispatch, bump=bump)
 
     def probe_submit(self, key_lanes: jnp.ndarray, vis: jnp.ndarray,
                      seq: Optional[int] = None) -> "PendingProbe":
@@ -363,11 +447,55 @@ class JoinSideKernel:
         The result is a pure function of (state, seq): collect() may
         run after later applies and may re-dispatch on overflow."""
         s = jnp.int32(I32_MAX if seq is None else seq)
-        mat = _probe_pairs_jit(self.table.state, self.chains, key_lanes,
-                               vis, s, self._probe_cap)
+        lanes_d = jnp.asarray(key_lanes)
+        vis_d = jnp.asarray(vis)
+        mat = _probe_pairs_jit(self.table.state, self.chains, lanes_d,
+                               vis_d, s, self._probe_cap, True)
         jaxtools.start_fetch(mat)
-        return PendingProbe(self, mat, key_lanes, vis, s,
-                            self._probe_cap)
+
+        def redispatch(cap):
+            return _probe_pairs_jit(self.table.state, self.chains,
+                                    lanes_d, vis_d, s, cap, True)
+
+        def bump(cap):
+            self._probe_cap = max(self._probe_cap, cap)
+
+        return PendingProbe(mat, int(lanes_d.shape[0]),
+                            self._probe_cap, redispatch, bump=bump)
+
+    # -- epoch batching ---------------------------------------------------
+    def apply_epoch(self, key_lanes_dev, aux_dev, n_rows: int,
+                    max_ins_ref: int) -> None:
+        """Apply a whole epoch's concatenated inserts/tombstones in one
+        dispatch (aux layout: ops/hash_join.py AUX_*). The lanes/aux
+        device arrays are shared with probe_epoch — upload once."""
+        if max_ins_ref >= 0:
+            self.reserve_rows(max_ins_ref)
+        self.table.reserve(n_rows)
+        self.table.state, self.chains, ins = _epoch_apply_jit(
+            self.table.state, self.chains, key_lanes_dev, aux_dev)
+        self.table._counters.push(ins, n_rows)
+
+    def probe_epoch(self, key_lanes_dev, aux_dev,
+                    with_degrees: bool) -> "PendingProbe":
+        """Probe a whole epoch's rows against THIS side, each row at
+        its aux sequence; call after both sides' apply_epoch."""
+        out_cap = self._probe_cap
+
+        def dispatch(cap):
+            return _epoch_probe_jit(self.table.state, self.chains,
+                                    key_lanes_dev, aux_dev, cap,
+                                    with_degrees)
+
+        mat = dispatch(out_cap)
+        jaxtools.start_fetch(mat)
+
+        def bump(cap):
+            self._probe_cap = max(self._probe_cap, cap)
+
+        return PendingProbe(mat, int(key_lanes_dev.shape[0]), out_cap,
+                            dispatch, with_degrees=with_degrees,
+                            bump=bump)
 
     def probe(self, key_lanes: jnp.ndarray, vis: jnp.ndarray,
               seq: Optional[int] = None

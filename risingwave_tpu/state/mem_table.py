@@ -35,6 +35,29 @@ class MemTable:
     def is_dirty(self) -> bool:
         return bool(self._ops)
 
+    def insert_batch(self, keys, values) -> bool:
+        """All-insert bulk path: ONE C-speed dict merge when every key
+        is fresh (the append-only hot case — a method call per row cost
+        ~1/3 of q8 host throughput). Returns False when any key is
+        already buffered or duplicated in the batch: the caller must
+        then run the per-row merge rules instead."""
+        new = dict(zip(keys, values))
+        if len(new) != len(keys) or not self._ops.keys().isdisjoint(new):
+            return False
+        ins = KeyOp.INSERT
+        self._ops.update((k, (ins, None, v)) for k, v in new.items())
+        return True
+
+    def drain_bulk(self):
+        """(keys, values) lists for ingest_keyed; clears. Same content
+        as drain(), shaped for the store's bulk ingest."""
+        ops, self._ops = self._ops, {}
+        keys = list(ops.keys())
+        delete = KeyOp.DELETE
+        vals = [None if op is delete else new
+                for (op, _old, new) in ops.values()]
+        return keys, vals
+
     def insert(self, key: bytes, value: tuple) -> None:
         cur = self._ops.get(key)
         if cur is None:

@@ -75,7 +75,8 @@ class StateTable:
         """
         assert self.epoch is not None, "init_epoch first"
         assert new_epoch.prev == self.epoch.curr, (new_epoch, self.epoch)
-        n = self.store.ingest_batch(self.table_id, self.mem_table.drain(),
+        keys, vals = self.mem_table.drain_bulk()
+        n = self.store.ingest_keyed(self.table_id, keys, vals,
                                     self.epoch.curr.value)
         self.epoch = new_epoch
         return n
@@ -141,8 +142,12 @@ class StateTable:
         rows (one numpy pass per pk column instead of per-row hashing —
         the r3 profile spent half of q8 in per-row ``_encode_pk``)."""
         mt = self.mem_table
-        for key, row in zip(self._encode_pk_rows(rows), rows):
-            mt.insert(key, tuple(row))
+        keys = self._encode_pk_rows(rows)
+        rows_t = [tuple(r) for r in rows]
+        if mt.insert_batch(keys, rows_t):
+            return
+        for key, row in zip(keys, rows_t):
+            mt.insert(key, row)
 
     def delete_rows(self, rows: Sequence[Sequence]) -> None:
         mt = self.mem_table
@@ -202,6 +207,8 @@ class StateTable:
         keys = self._encode_pks_bulk(chunk, idx)
         is_ins = (ops == int(Op.INSERT)) | (ops == int(Op.UPDATE_INSERT))
         mt = self.mem_table
+        if is_ins.all() and mt.insert_batch(keys, rows):
+            return
         for key, row, ins in zip(keys, rows, is_ins.tolist()):
             if ins:
                 mt.insert(key, row)

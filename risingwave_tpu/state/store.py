@@ -28,6 +28,13 @@ class StateStore:
                      epoch: int) -> int:
         raise NotImplementedError
 
+    def ingest_keyed(self, table_id: int, keys: List[bytes],
+                     values: List[Value], epoch: int) -> int:
+        """Bulk ingest of parallel key/value lists (keys unique —
+        memtable-drained). Backends may take a C-speed merge path;
+        the default delegates to ingest_batch."""
+        return self.ingest_batch(table_id, zip(keys, values), epoch)
+
     def get(self, table_id: int, key: bytes, epoch: int) -> Value:
         raise NotImplementedError
 
@@ -149,6 +156,22 @@ class MemoryStateStore(StateStore):
             raise ValueError(
                 f"write at epoch {epoch} <= sealed {self._sealed_epoch}")
         return self._table(table_id).put_batch(batch, epoch)
+
+    def ingest_keyed(self, table_id: int, keys: List[bytes],
+                     values: List[Value], epoch: int) -> int:
+        if epoch <= self._sealed_epoch:
+            raise ValueError(
+                f"write at epoch {epoch} <= sealed {self._sealed_epoch}")
+        t = self._table(table_id)
+        versions = t.versions
+        if versions.keys().isdisjoint(keys):
+            # all-fresh bulk path (append-only streams): one dict merge
+            versions.update(
+                (k, [(epoch, v)]) for k, v in zip(keys, values))
+            t.keys.extend(keys)
+            t._dirty = True
+            return len(keys)
+        return t.put_batch(zip(keys, values), epoch)
 
     def seal_epoch(self, epoch: int, is_checkpoint: bool = True) -> None:
         assert epoch >= self._sealed_epoch, (epoch, self._sealed_epoch)

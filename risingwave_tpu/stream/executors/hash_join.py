@@ -496,6 +496,15 @@ class HashJoinExecutor(Executor):
         # ops/hash_join.py) + per-epoch in-flight probe list
         self._seq = 1
         self._pending: List[tuple] = []
+        # epoch batching (single-chip kernel): chunks buffer host-side
+        # and the whole epoch ships as 2 uploads + 2 dispatches per
+        # side at the barrier — through the tunnel, per-barrier
+        # transfer count bounds throughput (ops/hash_join.py AUX_*).
+        # The sharded kernel keeps the per-chunk dispatch path.
+        self._epoch_batch = isinstance(self.sides[0].kernel,
+                                       JoinSideKernel)
+        self._epoch_buf: tuple = ([], [])
+        self._epoch_rows = [0, 0]
         # host-state accounting (memory_manager.rs analog): weakref so
         # a dropped executor unregisters itself on the next tick
         import weakref
@@ -625,11 +634,10 @@ class HashJoinExecutor(Executor):
 
     def _ingest_chunk(self, side_idx: int, chunk: StreamChunk,
                       key_lanes, nonnull: np.ndarray) -> None:
-        """Dispatch side: ONE fused device call per chunk — probe the
-        other side AND apply this side's inserts/deletes, all at the
-        chunk's message sequence (DMA starts; nothing blocks). Results
-        are collected in one sweep at the barrier (sequence versioning
-        keeps the late-read probes exact)."""
+        """Ingest side: host bookkeeping per chunk; device work either
+        dispatches per chunk (sharded kernel) or buffers for the ONE
+        epoch dispatch at the barrier (single-chip; sequence versioning
+        makes the batched probes exact per-row)."""
         me = self.sides[side_idx]
         other = self.sides[1 - side_idx]
         seq = self._seq
@@ -637,50 +645,126 @@ class HashJoinExecutor(Executor):
         probe_vis = np.asarray(chunk.visibility) & nonnull
         (ins_idx, ins_refs, full_refs, ins_mask, del_refs,
          del_mask) = me.apply_chunk_host(chunk, nonnull)
-        # ins/del entries only exist at storable (= probe-visible) rows,
-        # so one mask decides both the dispatch and the collect.
-        # key_lanes stay HOST arrays end-to-end: the kernels upload
-        # them once; a jnp round-trip here would block on the tunnel.
-        handle = None
-        if probe_vis.any():
-            handle = me.kernel.apply_and_probe(
-                other.kernel, key_lanes, probe_vis,
-                full_refs, ins_mask, del_refs, del_mask, seq)
+        if not self._epoch_batch:
+            # ins/del entries only exist at storable (= probe-visible)
+            # rows, so one mask decides both dispatch and collect.
+            # key_lanes stay HOST arrays end-to-end: the kernels upload
+            # them once; a jnp round-trip here would block on the tunnel.
+            handle = None
+            if probe_vis.any():
+                handle = me.kernel.apply_and_probe(
+                    other.kernel, key_lanes, probe_vis,
+                    full_refs, ins_mask, del_refs, del_mask, seq)
+            self._pending.append(
+                (side_idx, chunk, nonnull, handle, ins_idx, ins_refs,
+                 0))
+            return
+        from risingwave_tpu.ops.hash_join import (
+            FLAG_DEL, FLAG_INS, FLAG_PROBE,
+        )
+        n = chunk.capacity
+        aux = np.zeros((n, 4), dtype=np.int32)
+        aux[:, 0] = full_refs
+        aux[:, 1] = del_refs
+        aux[:, 2] = (probe_vis * FLAG_PROBE + ins_mask * FLAG_INS
+                     + del_mask * FLAG_DEL)
+        aux[:, 3] = seq
+        off = self._epoch_rows[side_idx]
         self._pending.append(
-            (side_idx, chunk, nonnull, handle, ins_idx, ins_refs))
+            (side_idx, chunk, nonnull, None, ins_idx, ins_refs, off))
+        self._epoch_buf[side_idx].append(
+            (np.asarray(key_lanes), aux,
+             int(ins_refs.max()) if len(ins_refs) else -1))
+        self._epoch_rows[side_idx] = off + n
+
+    def _dispatch_epoch(self) -> Dict[int, tuple]:
+        """Ship each side's buffered epoch as 2 uploads + 1 apply + 1
+        probe dispatch, then collect both probes (overlapped DMAs).
+        Returns {side: (deg|None, probe_idx, refs)} in the CONCATENATED
+        row space; _emit_pending slices per chunk by offset."""
+        import jax
+        devs: Dict[int, tuple] = {}
+        for s in (0, 1):
+            buf = self._epoch_buf[s]
+            if not buf:
+                continue
+            total = self._epoch_rows[s]
+            cap = next_pow2(total)
+            w = buf[0][0].shape[1]
+            lanes = np.zeros((cap, w), dtype=np.int32)
+            aux = np.zeros((cap, 4), dtype=np.int32)
+            at = 0
+            max_ref = -1
+            for lan, a, mr in buf:
+                lanes[at:at + lan.shape[0]] = lan
+                aux[at:at + a.shape[0]] = a
+                at += lan.shape[0]
+                max_ref = max(max_ref, mr)
+            devs[s] = (jax.device_put(lanes), jax.device_put(aux),
+                       total, max_ref)
+        # both applies land before either probe dispatches: a probe at
+        # seq s must see the other side's same-epoch rows with seq < s
+        for s, (ld, ad, total, max_ref) in devs.items():
+            self.sides[s].kernel.apply_epoch(ld, ad, total, max_ref)
+        with_deg = self.join_type != JoinType.INNER
+        probes = {s: self.sides[1 - s].kernel.probe_epoch(ld, ad,
+                                                          with_deg)
+                  for s, (ld, ad, _t, _m) in devs.items()}
+        return {s: p.collect() for s, p in probes.items()}
 
     def _emit_pending(self) -> List[StreamChunk]:
-        """Barrier sweep: collect every in-flight probe (the DMAs have
-        been running since dispatch) and run emission in message order.
-        Degree bookkeeping happens here, in the same order the chunks
-        were applied."""
+        """Barrier sweep: collect the epoch's probes and run emission
+        in message order. Degree bookkeeping happens here, in the same
+        order the chunks were applied."""
         outs: List[StreamChunk] = []
+        results = self._dispatch_epoch() if self._epoch_batch \
+            and (self._epoch_buf[0] or self._epoch_buf[1]) else {}
         for (side_idx, chunk, nonnull, handle, ins_idx,
-             ins_refs) in self._pending:
-            outs.extend(self._emit_one(side_idx, chunk, nonnull, handle,
-                                       ins_idx, ins_refs))
+             ins_refs, off) in self._pending:
+            n = chunk.capacity
+            deg = None
+            probe_idx = np.zeros(0, dtype=np.int32)
+            refs = np.zeros(0, dtype=np.int32)
+            if handle is not None:
+                deg_p, probe_idx, refs = handle.collect()
+                deg = np.zeros(n, dtype=np.int64)
+                deg[:len(deg_p)] = deg_p
+            elif side_idx in results:
+                d_s, p_s, r_s = results[side_idx]
+                lo = np.searchsorted(p_s, off)
+                hi = np.searchsorted(p_s, off + n)
+                probe_idx = (p_s[lo:hi] - off).astype(np.int32)
+                refs = r_s[lo:hi]
+                if d_s is not None:
+                    deg = d_s[off:off + n].astype(np.int64)
+            outs.extend(self._emit_one(side_idx, chunk, nonnull, deg,
+                                       probe_idx, refs, ins_idx,
+                                       ins_refs))
         self._pending.clear()
+        self._epoch_buf = ([], [])
+        self._epoch_rows = [0, 0]
         return outs
 
     def _emit_one(self, side_idx: int, chunk: StreamChunk,
-                  nonnull: np.ndarray, handle, ins_idx: np.ndarray,
-                  ins_refs: np.ndarray) -> List[StreamChunk]:
+                  nonnull: np.ndarray, deg: Optional[np.ndarray],
+                  probe_idx: np.ndarray, refs: np.ndarray,
+                  ins_idx: np.ndarray, ins_refs: np.ndarray
+                  ) -> List[StreamChunk]:
         """Emission per eq_join_oneside (hash_join.rs:990) generalized
         to the degree-transition rule: a stored outer row flips its
         NULL-padded emission exactly when its match degree crosses zero
         (net per-chunk delta vs the old degree — intermediate flips
-        within one chunk cancel, leaving the same multiset)."""
+        within one chunk cancel, leaving the same multiset).
+
+        `deg` is None exactly when the join is INNER (the slim probe
+        skips degrees; no emission rule below reads them)."""
         jt = self.join_type
         me = self.sides[side_idx]
         other = self.sides[1 - side_idx]
         vis = np.asarray(chunk.visibility)
         n = chunk.capacity
-        deg = np.zeros(n, dtype=np.int64)
-        probe_idx = np.zeros(0, dtype=np.int32)
-        refs = np.zeros(0, dtype=np.int32)
-        if handle is not None:
-            deg_p, probe_idx, refs = handle.collect()
-            deg[:len(deg_p)] = deg_p
+        if deg is None and jt != JoinType.INNER:
+            deg = np.zeros(n, dtype=np.int64)
         outs: List[StreamChunk] = []
         # 1) matched pairs (all types except semi/anti)
         if jt.subject is None and len(probe_idx):

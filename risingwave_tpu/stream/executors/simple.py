@@ -70,11 +70,48 @@ class ProjectExecutor(Executor):
         # date_time watermark to a window_start watermark)
         self.watermark_derivations = dict(watermark_derivations or {})
 
+    @staticmethod
+    def _drop_noop_updates(cols, vis, ops):
+        """Mask out U-/U+ pairs whose halves are identical AFTER the
+        projection (project.rs noop-update elimination): when a
+        projection drops a changing column (e.g. a dedup agg's hidden
+        _cnt), every duplicate otherwise becomes a full update churning
+        join chains and state tables downstream. Dropping an identical
+        pair is multiset-exact regardless of keys."""
+        import numpy as np
+        ud = np.flatnonzero(vis[:-1] & vis[1:]
+                            & (ops[:-1] == int(Op.UPDATE_DELETE))
+                            & (ops[1:] == int(Op.UPDATE_INSERT)))
+        if not len(ud):
+            return vis
+        same = np.ones(len(ud), dtype=bool)
+        for c in cols:
+            v = np.asarray(c.values)
+            eq = np.asarray(v[ud] == v[ud + 1], dtype=bool)
+            if c.validity is not None:
+                ok = np.asarray(c.validity)
+                both_null = ~ok[ud] & ~ok[ud + 1]
+                eq = (eq & ok[ud] & ok[ud + 1]) | both_null
+            same &= eq
+            if not same.any():
+                return vis
+        drop = ud[same]
+        vis = vis.copy()
+        vis[drop] = False
+        vis[drop + 1] = False
+        return vis
+
     async def execute(self) -> AsyncIterator[Message]:
+        import numpy as np
         async for msg in self.input.execute():
             if is_chunk(msg):
                 cols = [e.eval(msg) for e in self.exprs]
-                yield StreamChunk(self.schema, cols, msg.visibility, msg.ops)
+                vis = msg.visibility
+                ops_np = np.asarray(msg.ops)
+                if (ops_np == int(Op.UPDATE_DELETE)).any():
+                    vis = self._drop_noop_updates(cols, np.asarray(vis),
+                                                  ops_np)
+                yield StreamChunk(self.schema, cols, vis, msg.ops)
             elif isinstance(msg, Watermark):
                 d = self.watermark_derivations.get(msg.col_idx)
                 # one input watermark may derive SEVERAL outputs (the
