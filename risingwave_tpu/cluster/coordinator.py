@@ -71,17 +71,33 @@ class WorkerClient:
             raise RuntimeError(f"worker error: {reply.get('error')}")
         return reply
 
-    async def deploy(self, fragment: str, **params) -> dict:
-        return await self.call({"cmd": "deploy", "fragment": fragment,
-                                "params": params})
-
     async def deploy_plan(self, plan: list, **params) -> dict:
         """Ship a plan-IR fragment (stream/plan_ir.py) — the typed
-        StreamNode-shipping path that replaces named fragments."""
+        StreamNode-shipping path (stream_plan.proto analog)."""
         return await self.call({"cmd": "deploy_plan", "plan": plan,
                                 "params": params})
 
-    async def inject(self, barrier: Barrier) -> dict:
+    async def scan_table(self, table_id: int,
+                         epoch: Optional[int] = None) -> list:
+        """Pull one table's committed rows (value-codec decoded) from
+        the worker's namespace — the distributed-SELECT data plane."""
+        from risingwave_tpu.storage.value_codec import decode_row
+        reply = await self.call({"cmd": "scan_table",
+                                 "table_id": table_id, "epoch": epoch})
+        return [(bytes.fromhex(k), decode_row(bytes.fromhex(r)))
+                for k, r in reply["rows"]]
+
+    async def ingest_table(self, table_id: int, rows: list) -> dict:
+        """Bulk-load (key_bytes, row_tuple) pairs — state migration."""
+        from risingwave_tpu.storage.value_codec import encode_row
+        return await self.call({
+            "cmd": "ingest_table", "table_id": table_id,
+            "rows": [[k.hex(),
+                      None if v is None else encode_row(tuple(v)).hex()]
+                     for k, v in rows]})
+
+    async def inject(self, barrier: Barrier,
+                     committed: Optional[int] = None) -> dict:
         m = None
         if isinstance(barrier.mutation, StopMutation):
             m = {"type": "stop",
@@ -96,6 +112,9 @@ class WorkerClient:
             "prev": barrier.epoch.prev.value,
             "kind": barrier.kind.value,
             "mutation": m,
+            # the coordinator's commit decision pipelined on this
+            # barrier (two-phase workers adopt staged SSTs ≤ this)
+            "committed": committed,
         })
 
     async def ping(self, io_timeout: float = 2.0) -> dict:
@@ -186,17 +205,25 @@ class WorkerBarrierSender:
     worker's completion reply collects the pseudo-actor — InjectBarrier
     + BarrierComplete as one round trip."""
 
-    def __init__(self, client: WorkerClient, local, pseudo_actor: int):
+    def __init__(self, client: WorkerClient, local, pseudo_actor: int,
+                 committed_fn=None):
         self.client = client
         self.local = local
         self.pseudo = pseudo_actor
+        # reads the coordinator's committed epoch at send time (the
+        # commit decision pipelined onto each barrier); None = legacy
+        # self-committing workers
+        self.committed_fn = committed_fn
         self._tasks: set = set()   # strong refs: the loop holds tasks
         #                            weakly and could drop one mid-RPC
 
     async def send(self, barrier: Barrier) -> None:
+        committed = (self.committed_fn()
+                     if self.committed_fn is not None else None)
+
         async def roundtrip():
             try:
-                await self.client.inject(barrier)
+                await self.client.inject(barrier, committed)
                 self.local.collect(self.pseudo, barrier)
             except BaseException as e:  # noqa: BLE001 — fail the epoch
                 self.local.notify_failure(self.pseudo, e)

@@ -1,0 +1,340 @@
+"""Cluster: N workers + fragment scheduling + coordinated barriers.
+
+Reference parity: GlobalStreamManager + the actor-graph scheduler
+(src/meta/src/stream/stream_manager.rs:161,
+src/meta/src/stream/stream_graph/schedule.rs:195-251 — fragments are
+scheduled onto parallel units across compute nodes, hash fragments get
+the 256-vnode bitmap split among their actors) and GlobalBarrierManager
+fan-out (barrier/mod.rs:558 — one InjectBarrier per compute node,
+collect-all, then HummockManager::commit_epoch). TPU re-design: each
+worker slot owns a hummock namespace under one root; the coordinator
+owns the BarrierLoop, pipelines its commit decision onto the next
+barrier (two-phase worker stores), and recovery = restart every slot
+over its namespace, replay the deployed jobs, resume from the
+coordinator's committed epoch (barrier/recovery.rs:110 collapsed).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from risingwave_tpu.cluster.coordinator import (
+    WorkerBarrierSender, WorkerClient, WorkerHandle,
+)
+from risingwave_tpu.frontend.fragmenter import Fragment, FragmentGraph
+from risingwave_tpu.meta.barrier import BarrierLoop
+from risingwave_tpu.stream.actor import LocalBarrierManager
+from risingwave_tpu.stream.message import StopMutation
+
+_PSEUDO_BASE = 1 << 20          # pseudo-actor ids for worker handles
+
+
+class _CoordEpochStore:
+    """BarrierLoop's store shim: epochs COMMIT on the workers (staged
+    SSTs adopted via commit_through); the coordinator only tracks the
+    committed watermark — the HummockManager version counter without
+    the SST bookkeeping."""
+
+    def __init__(self, floor: int = 0):
+        self._committed = floor
+
+    def committed_epoch(self) -> int:
+        return self._committed
+
+    def seal_epoch(self, epoch: int, is_checkpoint: bool = True) -> None:
+        pass
+
+    def sync(self, epoch: int) -> None:
+        self._committed = max(self._committed, epoch)
+
+
+@dataclass
+class JobDeployment:
+    """One deployed streaming job: its fragment graph + placements.
+    placements[fi] = [(actor_id, worker_slot), ...] per fragment."""
+
+    name: str
+    graph: FragmentGraph
+    placements: List[List[tuple]] = field(default_factory=list)
+
+    def actor_ids(self) -> List[int]:
+        return [aid for frag in self.placements for aid, _slot in frag]
+
+
+class Cluster:
+    """Coordinator-side handle on N worker processes."""
+
+    def __init__(self, root: str, n_workers: int = 2,
+                 platform: str = "cpu"):
+        self.root = root
+        self.n = n_workers
+        self.platform = platform
+        self.handles: List[Optional[WorkerHandle]] = [None] * n_workers
+        self.clients: List[Optional[WorkerClient]] = [None] * n_workers
+        self.jobs: Dict[str, JobDeployment] = {}
+        self.local: Optional[LocalBarrierManager] = None
+        self.loop: Optional[BarrierLoop] = None
+        self.store = _CoordEpochStore()
+        self._next_actor = 1000
+        self._rr = 0                      # placement cursor
+
+    # -- lifecycle --------------------------------------------------------
+    async def start(self) -> None:
+        for k in range(self.n):
+            await self._start_slot(k)
+        self._fresh_barrier_plane()
+
+    async def _start_slot(self, k: int) -> None:
+        h = WorkerHandle(os.path.join(self.root, f"w{k}"),
+                         platform=self.platform)
+        self.clients[k] = await h.start()
+        self.handles[k] = h
+
+    def _fresh_barrier_plane(self) -> None:
+        """(Re)build the barrier fan-out: one pseudo-actor per worker
+        slot; the commit decision pipelines via committed_fn."""
+        self.local = LocalBarrierManager()
+        self.loop = BarrierLoop(self.local, self.store)
+        for k in range(self.n):
+            pid = _PSEUDO_BASE + k
+            self.local.register_sender(
+                pid, WorkerBarrierSender(
+                    self.clients[k], self.local, pid,
+                    committed_fn=lambda: self.store.committed_epoch()))
+        self.local.set_expected_actors(
+            [_PSEUDO_BASE + k for k in range(self.n)])
+
+    async def stop(self) -> None:
+        stop_ids = frozenset(
+            set().union(*(set(j.actor_ids())
+                          for j in self.jobs.values()), set())
+            | {_PSEUDO_BASE + k for k in range(self.n)})
+        if self.loop is not None:
+            await self.loop.inject_and_collect(
+                force_checkpoint=True,
+                mutation=StopMutation(stop_ids))
+        for h in self.handles:
+            if h is not None:
+                await h.stop()
+
+    def kill_slot(self, k: int) -> None:
+        """SIGKILL one worker (chaos path: no goodbye, no flush)."""
+        if self.handles[k] is not None:
+            self.handles[k].kill()
+
+    # -- scheduling (schedule.rs analog) ----------------------------------
+    def _place(self, graph: FragmentGraph) -> List[List[tuple]]:
+        """Round-robin actors over worker slots; each hash fragment's
+        actor list order defines its vnode mapping order."""
+        placements = []
+        for frag in graph.fragments:
+            actors = []
+            for _ in range(frag.parallelism):
+                slot = self._rr % self.n
+                self._rr += 1
+                actors.append((self._next_actor, slot))
+                self._next_actor += 1
+            placements.append(actors)
+        return placements
+
+    def _expand_nodes(self, frag: Fragment, actor_id: int,
+                      placements: List[List[tuple]]) -> List[dict]:
+        """Resolve exchange_in placeholders into per-upstream-actor
+        remote_input nodes + a merge, and pin the source actor id."""
+        out: List[dict] = []
+        remap: Dict[int, int] = {}
+        for idx, node in enumerate(frag.nodes):
+            if node["op"] == "exchange_in":
+                inp = frag.inputs[node["port"]]
+                r_idxs = []
+                for up_aid, up_slot in placements[inp.up_frag]:
+                    out.append({
+                        "op": "remote_input", "host": "127.0.0.1",
+                        "port": self.clients[up_slot].exchange_port,
+                        "up_actor": up_aid, "schema": inp.schema})
+                    r_idxs.append(len(out) - 1)
+                out.append({"op": "merge", "inputs": r_idxs})
+                remap[idx] = len(out) - 1
+                continue
+            n2 = dict(node)
+            for key in ("input", "left", "right"):
+                if isinstance(n2.get(key), int):
+                    n2[key] = remap[n2[key]]
+            if n2["op"] == "source":
+                n2["actor_id"] = actor_id
+            out.append(n2)
+            remap[idx] = len(out) - 1
+        return out
+
+    def _wiring(self, fi: int, graph: FragmentGraph,
+                placements: List[List[tuple]]) -> tuple:
+        """(outputs, dispatch) for fragment fi's actors — hash over the
+        consumer's actors with a uniform vnode mapping, simple when the
+        consumer is a single actor."""
+        consumers = graph.consumers_of(fi)
+        if not consumers:
+            return [], None
+        assert len(consumers) == 1, "tree plans have one consumer"
+        down_fi, keys = consumers[0]
+        outs = [aid for aid, _slot in placements[down_fi]]
+        if len(outs) == 1:
+            return outs, {"type": "simple"}
+        from risingwave_tpu.common.hash import VnodeMapping
+        mapping = VnodeMapping.new_uniform(len(outs))
+        return outs, {"type": "hash", "keys": keys,
+                      "mapping": [int(o) for o in mapping.owners]}
+
+    async def deploy_graph(self, name: str,
+                           graph: FragmentGraph) -> JobDeployment:
+        """Schedule + deploy one job's fragments (upstream first so
+        exchange edges exist before consumers connect), then leave
+        activation to the caller's next barrier."""
+        if name in self.jobs:
+            raise ValueError(f"job {name!r} already deployed")
+        job = JobDeployment(name, graph, self._place(graph))
+        await self._deploy_job(job)
+        self.jobs[name] = job
+        return job
+
+    async def _deploy_job(self, job: JobDeployment) -> None:
+        for fi, frag in enumerate(job.graph.fragments):
+            outputs, dispatch = self._wiring(fi, job.graph,
+                                             job.placements)
+            for aid, slot in job.placements[fi]:
+                nodes = self._expand_nodes(frag, aid, job.placements)
+                await self.clients[slot].deploy_plan(
+                    nodes, actor_id=aid, outputs=outputs,
+                    dispatch=dispatch)
+
+    async def drop_job(self, name: str) -> None:
+        job = self.jobs.pop(name, None)
+        if job is None:
+            raise KeyError(name)
+        stop = frozenset(set(job.actor_ids())
+                         | {_PSEUDO_BASE + k for k in range(self.n)})
+        await self.loop.inject_and_collect(
+            force_checkpoint=True, mutation=StopMutation(stop))
+
+    # -- barriers ---------------------------------------------------------
+    async def step(self, n: int = 1) -> None:
+        for _ in range(n):
+            await self.loop.inject_and_collect(force_checkpoint=True)
+
+    # -- distributed reads ------------------------------------------------
+    async def scan_table(self, table_id: int) -> List[tuple]:
+        """Union a table's committed rows across every namespace
+        (vnode-disjoint, so plain concatenation then key-sort). The
+        scan pins the COORDINATOR's committed epoch: workers lag one
+        barrier behind (the commit decision pipelines), but their
+        staged SSTs are readable at any epoch — this keeps FLUSH →
+        SELECT read-your-writes like the in-process session."""
+        epoch = self.store.committed_epoch()
+        rows: List[tuple] = []
+        for c in self.clients:
+            if c is not None:
+                rows += await c.scan_table(table_id, epoch=epoch)
+        rows.sort(key=lambda kv: kv[0])
+        return rows
+
+    # -- recovery (recovery.rs:110 collapsed) -----------------------------
+    async def recover(self) -> None:
+        """Full-cluster recovery to the coordinator's committed epoch:
+        kill every slot, restart over the same namespaces, discard
+        uncommitted staged state, redeploy all jobs. The next barrier
+        resumes sources from their recovered offsets."""
+        floor = self.store.committed_epoch()
+        for k in range(self.n):
+            if self.handles[k] is not None:
+                self.handles[k].kill()
+        for k in range(self.n):
+            await self._start_slot(k)
+        for k in range(self.n):
+            await self.clients[k].call(
+                {"cmd": "recover_store", "epoch": floor})
+        self._fresh_barrier_plane()
+        for job in self.jobs.values():
+            await self._deploy_job(job)
+
+    # -- reschedule (scale.rs:717 analog, with state handoff) -------------
+    async def move_fragment(self, name: str, frag_idx: int,
+                            to_slots: List[int]) -> None:
+        """Move one fragment's actors to new worker slots at a stopped
+        barrier, shipping its state tables between namespaces (the
+        reference's shared storage makes this step implicit; per-slot
+        namespaces make it an explicit scan+ingest handoff)."""
+        job = self.jobs[name]
+        frag = job.graph.fragments[frag_idx]
+        if len(to_slots) != len(job.placements[frag_idx]):
+            raise ValueError("move keeps the actor count; use a "
+                             "replan for true rescale")
+        old = job.placements[frag_idx]
+        if [s for _a, s in old] == list(to_slots):
+            return
+        # 1) stop the WHOLE job at a barrier (keep state + catalog)
+        stop = frozenset(set(job.actor_ids())
+                         | {_PSEUDO_BASE + k for k in range(self.n)})
+        await self.loop.inject_and_collect(
+            force_checkpoint=True, mutation=StopMutation(stop))
+        # the stop barrier's epoch is committed on the COORDINATOR but
+        # its commit decision hasn't reached the workers (it pipelines
+        # on the next inject) — push it now, or the handoff scan would
+        # miss rows born in that epoch and leave them to resurrect on
+        # the old worker when its staged SST commits later
+        floor = self.store.committed_epoch()
+        for c in self.clients:
+            await c.call({"cmd": "recover_store", "epoch": floor})
+        # 2) ship the moved actors' state tables between namespaces
+        table_ids = _fragment_table_ids(frag)
+        for (aid, from_slot), to_slot in zip(old, to_slots):
+            if from_slot == to_slot:
+                continue
+            for tid in table_ids:
+                rows = await self.clients[from_slot].scan_table(tid)
+                # ship tombstones for the source rows? no — the whole
+                # table moves; the old namespace's copy is dropped so
+                # stale reads cannot resurrect it
+                if rows:
+                    await self.clients[to_slot].ingest_table(tid, rows)
+                    await self.clients[from_slot].ingest_table(
+                        tid, [(k, None) for k, _v in rows])
+        # 3) redeploy every fragment with the new placement (actor ids
+        # are fresh — the stopped ones are gone from the workers)
+        job.placements[frag_idx] = [
+            (self._fresh_actor(), s) for s in to_slots]
+        for fi in range(len(job.graph.fragments)):
+            if fi != frag_idx:
+                job.placements[fi] = [
+                    (self._fresh_actor(), s)
+                    for _a, s in job.placements[fi]]
+        await self._deploy_job(job)
+
+    def _fresh_actor(self) -> int:
+        aid = self._next_actor
+        self._next_actor += 1
+        return aid
+
+
+def _fragment_table_ids(frag: Fragment) -> List[int]:
+    """Every state-table id a fragment's nodes own (the state that must
+    move with it)."""
+    out: List[int] = []
+    for n in frag.nodes:
+        op = n["op"]
+        if op == "source" and n.get("split_table_id") is not None:
+            out.append(int(n["split_table_id"]))
+        elif op == "hash_agg":
+            out.append(int(n["table_id"]))
+            out += [int(v) for v in
+                    (n.get("dedup_table_ids") or {}).values()]
+            out += [int(v) for v in
+                    (n.get("minput_table_ids") or {}).values()]
+        elif op == "hash_join":
+            out += [int(n["left_table_id"]), int(n["right_table_id"])]
+        elif op == "materialize":
+            out.append(int(n["table_id"]))
+        elif op == "watermark_filter" and n.get("table_id") is not None:
+            out.append(int(n["table_id"]))
+    return out

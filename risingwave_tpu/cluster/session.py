@@ -1,0 +1,193 @@
+"""DistFrontend: SQL session over an N-worker cluster.
+
+Reference parity: the frontend node talking to meta + compute nodes —
+handler/create_mv.rs:147 (plan → fragment → deploy via DdlService) and
+the distributed batch read path (scheduler/distributed/stage.rs,
+RowSeqScan per node + exchange-gather). TPU re-design: CREATE
+MATERIALIZED VIEW plans on the coordinator with the SAME StreamPlanner
+the in-process session uses, then the fragmenter serializes the
+executor tree to plan IR, cuts it at hash exchanges, and the cluster
+scheduler lands the fragments on worker processes. SELECT gathers each
+referenced MV's committed rows from every worker namespace into a
+snapshot view and runs the ordinary batch planner over it.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Dict, List, Optional, Union
+
+from risingwave_tpu.cluster.scheduler import Cluster
+from risingwave_tpu.frontend import ast
+from risingwave_tpu.frontend.catalog import Catalog, MvCatalog
+from risingwave_tpu.frontend.fragmenter import Fragmenter
+from risingwave_tpu.frontend.planner import (
+    PlanError, StreamPlanner, plan_batch, source_schema,
+)
+from risingwave_tpu.state.store import MemoryStateStore
+from risingwave_tpu.stream.actor import LocalBarrierManager
+
+Rows = List[tuple]
+
+
+class ClusterStoreView:
+    """Read-only store over rows gathered from worker namespaces —
+    batch executors (RowSeqScan via StorageTable) read it like any
+    state store. Tables must be prefetched before the sync read."""
+
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+        self._tables: Dict[int, List[tuple]] = {}   # tid → [(k, row)]
+
+    async def prefetch(self, table_id: int) -> None:
+        self._tables[table_id] = await self.cluster.scan_table(table_id)
+
+    def committed_epoch(self) -> int:
+        return self.cluster.store.committed_epoch()
+
+    def get(self, table_id: int, key: bytes, epoch: int):
+        rows = self._tables.get(table_id, [])
+        i = bisect.bisect_left(rows, (key,))
+        if i < len(rows) and rows[i][0] == key:
+            return rows[i][1]
+        return None
+
+    def iter(self, table_id: int, epoch: int, start=None, end=None,
+             reverse: bool = False):
+        rows = self._tables.get(table_id, [])
+        out = [(k, v) for k, v in rows
+               if (start is None or k >= start)
+               and (end is None or k < end)]
+        return iter(reversed(out) if reverse else out)
+
+
+class DistFrontend:
+    """One SQL session driving an N-worker cluster."""
+
+    def __init__(self, root: str, n_workers: int = 2,
+                 parallelism: Optional[int] = None,
+                 rate_limit: Optional[int] = 8,
+                 min_chunks: Optional[int] = None):
+        self.cluster = Cluster(root, n_workers)
+        self.catalog = Catalog()
+        self.parallelism = parallelism or n_workers
+        self.rate_limit = rate_limit
+        self.min_chunks = min_chunks
+        self.last_select_schema = None
+
+    async def start(self) -> None:
+        await self.cluster.start()
+
+    async def close(self) -> None:
+        await self.cluster.stop()
+
+    async def step(self, n: int = 1) -> None:
+        await self.cluster.step(n)
+
+    async def recover(self) -> None:
+        await self.cluster.recover()
+
+    # -- statements -------------------------------------------------------
+    async def execute(self, sql: str) -> Union[Rows, str]:
+        from risingwave_tpu.frontend.parser import parse_many
+
+        result: Union[Rows, str] = "OK"
+        for _text, stmt in parse_many(sql):
+            result = await self._run(stmt)
+        return result
+
+    async def _run(self, stmt) -> Union[Rows, str]:
+        self.last_select_schema = None
+        if isinstance(stmt, ast.CreateSource):
+            schema = source_schema(stmt.options, stmt.columns)
+            self.catalog.add_source(stmt.name, schema, stmt.options)
+            return "CREATE_SOURCE"
+        if isinstance(stmt, ast.CreateMaterializedView):
+            return await self._create_mv(stmt)
+        if isinstance(stmt, ast.DropMaterializedView):
+            return await self._drop_mv(stmt)
+        if isinstance(stmt, ast.Show):
+            if stmt.what == "sources":
+                return [(n,) for n in sorted(self.catalog.sources)]
+            return [(n,) for n in sorted(self.catalog.mvs)]
+        if isinstance(stmt, ast.Flush):
+            await self.cluster.step(1)
+            return "FLUSH"
+        if isinstance(stmt, ast.Select):
+            return await self._select(stmt)
+        raise PlanError(
+            f"unhandled statement on the distributed session: {stmt!r}")
+
+    async def _create_mv(self, stmt: ast.CreateMaterializedView) -> str:
+        """Plan with the ordinary StreamPlanner (against throwaway
+        runtime objects), fragment the executor tree, deploy across the
+        cluster, then run the activation barrier."""
+        self.catalog._check_free(stmt.name)
+        if getattr(stmt, "emit_on_window_close", False):
+            raise PlanError("EMIT ON WINDOW CLOSE is not distributed "
+                            "yet — use the in-process session")
+        planner = StreamPlanner(self.catalog, MemoryStateStore(),
+                                LocalBarrierManager(), definition="",
+                                mesh=None, actors={})
+        plan = planner.plan(stmt.name, stmt.select, actor_id=0,
+                            rate_limit=self.rate_limit,
+                            min_chunks=self.min_chunks)
+        if plan.attaches:
+            raise PlanError("MV-on-MV chains are not distributed yet "
+                            "— use the in-process session")
+        graph = Fragmenter(self.parallelism).lower(plan.consumer)
+        await self.cluster.deploy_graph(stmt.name, graph)
+        await self.cluster.step(1)         # activation barrier
+        self.catalog.add_mv(plan.mv)
+        return "CREATE_MATERIALIZED_VIEW"
+
+    async def _drop_mv(self, stmt: ast.DropMaterializedView) -> str:
+        if stmt.name not in self.catalog.mvs:
+            if stmt.if_exists:
+                return "DROP_MATERIALIZED_VIEW"
+            raise PlanError(f"unknown materialized view {stmt.name!r}")
+        dependents = [m.name for m in self.catalog.mvs.values()
+                      if stmt.name in m.dependent_sources]
+        if dependents:
+            raise PlanError(f"cannot drop MV {stmt.name!r}: depended "
+                            f"on by {dependents}")
+        await self.cluster.drop_job(stmt.name)
+        del self.catalog.mvs[stmt.name]
+        return "DROP_MATERIALIZED_VIEW"
+
+    async def _select(self, sel: ast.Select) -> Rows:
+        from risingwave_tpu.batch import collect
+
+        view = ClusterStoreView(self.cluster)
+        for tid in self._referenced_table_ids(sel):
+            await view.prefetch(tid)
+        ex = plan_batch(sel, self.catalog, view,
+                        view.committed_epoch())
+        self.last_select_schema = ex.schema
+        return collect(ex)
+
+    def _referenced_table_ids(self, sel: ast.Select) -> List[int]:
+        """MV table ids a SELECT touches (FROM + JOINs + subqueries)."""
+        out: List[int] = []
+
+        def from_item(item):
+            if item is None:
+                return
+            if isinstance(item, ast.Subquery):
+                walk(item.select)
+                return
+            name = getattr(item, "name", None) or getattr(
+                getattr(item, "table", None), "name", None)
+            if name is None:
+                return
+            obj = self.catalog.mvs.get(name)
+            if isinstance(obj, MvCatalog):
+                out.append(obj.table_id)
+
+        def walk(s):
+            from_item(s.from_item)
+            for jn in getattr(s, "joins", []):
+                from_item(jn.item)
+
+        walk(sel)
+        return out

@@ -8,11 +8,19 @@ state-store namespace whose checkpoints commit at the SAME epochs the
 coordinator drives, so a recovering cluster resumes consistently from
 the coordinator's committed epoch.
 
-Fragments deploy two ways: by SHIPPED PLAN IR (``deploy_plan`` — the
+Fragments deploy by SHIPPED PLAN IR only (``deploy_plan`` — the
 stream_plan.proto analog; stream/plan_ir.py nodes build into executors
-here, so any expressible plan runs on any worker) or by NAME from the
-legacy ``FRAGMENTS`` registry (``deploy``, kept for the hand-tuned q8
-demo fragments).
+here, so any expressible plan runs on any worker). Each deployed actor
+may fan out through a dispatcher spec — simple / broadcast / hash with
+an explicit vnode→downstream-actor mapping (dispatch.rs:582; the
+coordinator's scheduler computes the mapping like
+meta/stream/stream_graph/schedule.rs:195-251 assigns vnode bitmaps).
+
+The batch data plane for distributed SELECT: ``scan_table`` streams a
+table's committed rows back over control (ExchangeService.GetData +
+RowSeqScan over the local store, task_service.proto:114), and
+``ingest_table`` bulk-loads rows at a fresh epoch (the state-migration
+half of a cross-worker reschedule).
 
 Run as a process:  python -m risingwave_tpu.cluster.worker --store DIR
 (prints one JSON line {"control_port": N, "exchange_port": N}).
@@ -22,104 +30,18 @@ from __future__ import annotations
 
 import asyncio
 import json
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from risingwave_tpu.common.epoch import Epoch, EpochPair
-from risingwave_tpu.state.state_table import StateTable
 from risingwave_tpu.stream.actor import Actor, LocalBarrierManager
-from risingwave_tpu.stream.dispatch import Output, SimpleDispatcher
+from risingwave_tpu.stream.dispatch import (
+    BroadcastDispatcher, HashDispatcher, Output, SimpleDispatcher,
+)
 from risingwave_tpu.stream.exchange import channel_for_test
 from risingwave_tpu.stream.message import (
     Barrier, BarrierKind, PauseMutation, ResumeMutation, StopMutation,
 )
 from risingwave_tpu.stream.remote import ExchangeServer
-
-
-def _make_nexmark_source(w: "WorkerServer", p: dict, table_type: str):
-    """Shared source wiring for nexmark fragments: reader + barrier
-    channel + split-offset state + SourceExecutor."""
-    from risingwave_tpu.common.types import Interval
-    from risingwave_tpu.connectors.nexmark import (
-        NexmarkConfig, NexmarkSplitReader,
-    )
-    from risingwave_tpu.frontend.planner import SPLIT_STATE_SCHEMA
-    from risingwave_tpu.stream.executors.source import SourceExecutor
-
-    cfg = NexmarkConfig(table_type=table_type,
-                        event_num=int(p["event_num"]),
-                        max_chunk_size=int(p.get("chunk", 512)))
-    reader = NexmarkSplitReader(cfg)
-    tx, rx = channel_for_test()
-    split = StateTable(int(p["split_table_id"]), SPLIT_STATE_SCHEMA,
-                       [0], w.store)
-    w.local.register_sender(int(p["actor_id"]), tx)
-    src = SourceExecutor(reader, rx, split, actor_id=int(p["actor_id"]),
-                         rate_limit_chunks_per_barrier=int(
-                             p.get("rate_limit", 4)),
-                         min_chunks_per_barrier=p.get("min_chunks"))
-    window = Interval(usecs=int(p.get("window_usecs", 10_000_000)))
-    return src, window
-
-
-def _fragment_q8_person(w: "WorkerServer", p: dict):
-    """person source → project(id, name, starttime) → remote out."""
-    from risingwave_tpu.common.types import DataType
-    from risingwave_tpu.expr.expr import InputRef, tumble_start
-    from risingwave_tpu.stream.executors.simple import ProjectExecutor
-
-    src, window = _make_nexmark_source(w, p, "person")
-    s = src.schema
-    proj = ProjectExecutor(
-        src,
-        exprs=[InputRef(s.index_of("id"), DataType.INT64),
-               InputRef(s.index_of("name"), DataType.VARCHAR),
-               tumble_start(InputRef(s.index_of("date_time"),
-                                     DataType.TIMESTAMP), window)],
-        names=["id", "name", "starttime"])
-    return src, proj
-
-
-def _fragment_q8_auction_dedup(w: "WorkerServer", p: dict):
-    """auction source → project → DEVICE dedup agg → project → remote.
-
-    Stateful fragment: the dedup HashAgg's kernel + value-state table
-    live on THIS worker — q8's two sides' state end up on different
-    processes."""
-    from risingwave_tpu.common.types import DataType
-    from risingwave_tpu.expr.expr import InputRef, tumble_start
-    from risingwave_tpu.ops.hash_agg import AggKind
-    from risingwave_tpu.stream.executors.hash_agg import (
-        AggCall, HashAggExecutor, agg_state_schema,
-    )
-    from risingwave_tpu.stream.executors.simple import ProjectExecutor
-
-    src, window = _make_nexmark_source(w, p, "auction")
-    s = src.schema
-    proj = ProjectExecutor(
-        src,
-        exprs=[InputRef(s.index_of("seller"), DataType.INT64),
-               tumble_start(InputRef(s.index_of("date_time"),
-                                     DataType.TIMESTAMP), window)],
-        names=["seller", "starttime"])
-    calls = [AggCall(AggKind.COUNT)]
-    sch, pk = agg_state_schema(proj.schema, [0, 1], calls)
-    dedup = HashAggExecutor(
-        proj, [0, 1], calls,
-        StateTable(int(p["agg_table_id"]), sch, pk, w.store,
-                   dist_key_indices=[0]),
-        append_only=True,
-        output_names=["seller", "starttime", "_cnt"])
-    out = ProjectExecutor(
-        dedup, exprs=[InputRef(0, DataType.INT64),
-                      InputRef(1, DataType.TIMESTAMP)],
-        names=["seller", "starttime"])
-    return src, out
-
-
-FRAGMENTS = {
-    "q8_person": _fragment_q8_person,
-    "q8_auction_dedup": _fragment_q8_auction_dedup,
-}
 
 
 class WorkerServer:
@@ -169,12 +91,25 @@ class WorkerServer:
 
     async def _dispatch(self, cmd: dict) -> dict:
         verb = cmd.get("cmd")
-        if verb == "deploy":
-            return await self._deploy(cmd)
         if verb == "deploy_plan":
             return await self._deploy_plan(cmd)
         if verb == "inject":
             return await self._inject(cmd)
+        if verb == "scan_table":
+            return self._scan_table(cmd)
+        if verb == "ingest_table":
+            return self._ingest_table(cmd)
+        if verb == "recover_store":
+            # recovery handshake: adopt everything the coordinator
+            # committed, discard the half-epoch a crash may have left
+            # staged (recovery.rs: the committed epoch is the truth)
+            epoch = int(cmd["epoch"])
+            dropped = 0
+            if getattr(self.store, "two_phase", False):
+                dropped = self.store.discard_staged_above(epoch)
+                self.store.commit_through(epoch)
+            return {"ok": True, "dropped": dropped,
+                    "committed": self.store.committed_epoch()}
         if verb == "ping":
             # heartbeat probe (cluster.rs heartbeat RPC): liveness +
             # a cheap resource summary for the membership table
@@ -183,42 +118,49 @@ class WorkerServer:
             return {"ok": True}
         return {"ok": False, "error": f"unknown cmd {verb!r}"}
 
-    def _spawn_actor(self, actor_id: int, down_actor: Optional[int],
-                     consumer) -> dict:
-        """Shared deploy tail: exchange edge + actor + spawn (one
-        copy — both deploy verbs must wire actors identically).
-        down_actor=None: terminal fragment (e.g. a materialize) —
-        no exchange edge; an edge nobody consumes would buffer
-        chunks until the credit window blocks the actor."""
-        dispatchers = []
-        if down_actor is not None:
-            out = self.exchange.register_edge(actor_id, down_actor)
-            dispatchers = [SimpleDispatcher(Output(down_actor, out))]
+    # -- exchange fan-out -------------------------------------------------
+    def _make_dispatchers(self, actor_id: int, outputs: List[int],
+                          dispatch: Optional[dict]) -> list:
+        """Downstream edges on THIS worker's exchange server; remote
+        peers connect in and pull (exchange_service.rs). The spec picks
+        the dispatcher (dispatch.rs:343): simple needs exactly one
+        output; hash carries dist keys + an explicit vnode mapping."""
+        outs = [Output(d, self.exchange.register_edge(actor_id, d))
+                for d in outputs]
+        if not outs:
+            return []
+        spec = dispatch or {"type": "simple"}
+        typ = spec.get("type", "simple")
+        if typ == "simple":
+            if len(outs) != 1:
+                raise ValueError(
+                    f"simple dispatch needs 1 output, got {len(outs)}")
+            return [SimpleDispatcher(outs[0])]
+        if typ == "broadcast":
+            return [BroadcastDispatcher(outs)]
+        if typ == "hash":
+            from risingwave_tpu.common.hash import VnodeMapping
+            import numpy as np
+            keys = [int(i) for i in spec["keys"]]
+            raw = spec.get("mapping")
+            mapping = (VnodeMapping(np.asarray(raw, dtype=np.int32))
+                       if raw is not None else None)
+            return [HashDispatcher(outs, keys, mapping)]
+        raise ValueError(f"unknown dispatch type {typ!r}")
+
+    def _spawn_actor(self, actor_id: int, outputs: List[int],
+                     dispatch: Optional[dict], consumer) -> dict:
+        """Shared deploy tail: exchange edges + actor + spawn.
+        outputs=[]: terminal fragment (e.g. a materialize) — no
+        exchange edge; an edge nobody consumes would buffer chunks
+        until the credit window blocks the actor."""
+        dispatchers = self._make_dispatchers(actor_id, outputs, dispatch)
         actor = Actor(actor_id, consumer, dispatchers=dispatchers,
                       barrier_manager=self.local)
         self.actors[actor_id] = actor
         self.local.set_expected_actors(list(self.actors))
         self.tasks[actor_id] = actor.spawn()
         return {"ok": True, "actor_id": actor_id}
-
-    def _guarded_spawn(self, actor_id: int,
-                       down_actor: Optional[int],
-                       build, what: str) -> dict:
-        """Shared deploy guard (one copy — both deploy verbs must
-        fail identically): refuse duplicate actor ids BEFORE anything
-        registers (the failure-path drop_actor would otherwise pop a
-        LIVE actor's barrier sender along with the half-built one),
-        and unwind the sender a failed build registered — an
-        undrained bounded barrier channel wedges injection."""
-        if actor_id in self.actors:
-            return {"ok": False,
-                    "error": f"actor {actor_id} already deployed"}
-        try:
-            consumer = build()
-            return self._spawn_actor(actor_id, down_actor, consumer)
-        except BaseException as e:     # noqa: BLE001 — report upstream
-            self.local.drop_actor(actor_id)
-            return {"ok": False, "error": f"{what} failed: {e}"}
 
     async def _deploy_plan(self, cmd: dict) -> dict:
         """Materialize a SHIPPED plan-IR fragment (from_proto/ analog):
@@ -234,6 +176,7 @@ class WorkerServer:
         from risingwave_tpu.stream.plan_ir import build_fragment
 
         plan = cmd["plan"]
+        params = cmd["params"]
         sources = [n for n in plan if n.get("op") == "source"]
         remote_fed = any(n.get("op") == "remote_input" for n in plan)
         if len(sources) > 1 or (not sources and not remote_fed):
@@ -245,14 +188,20 @@ class WorkerServer:
             # build_fragment registers the source's barrier sender,
             # and a post-build failure would leave it undrained.
             # Terminal fragments (no exchange edge) must say so with
-            # an EXPLICIT down_actor=None — a merely omitted key is a
-            # wiring typo that would otherwise deploy ok and then
-            # starve the downstream actor with no diagnostic
-            raw_down = cmd["params"]["down_actor"]
-            down_actor = None if raw_down is None else int(raw_down)
+            # an EXPLICIT outputs=[] / down_actor=None — a merely
+            # omitted key is a wiring typo that would otherwise deploy
+            # ok and then starve the downstream actor silently
+            if "outputs" in params:
+                outputs = [int(o) for o in params["outputs"]]
+            else:
+                raw_down = params["down_actor"]
+                outputs = [] if raw_down is None else [int(raw_down)]
+            dispatch = params.get("dispatch")
+            if dispatch is not None and dispatch.get("type") == "hash":
+                _ = [int(i) for i in dispatch["keys"]]
         except (KeyError, TypeError, ValueError) as e:
-            return {"ok": False, "error": f"bad down_actor: {e}"}
-        sent = cmd["params"].get("actor_id")
+            return {"ok": False, "error": f"bad output spec: {e}"}
+        sent = params.get("actor_id")
         if sources:
             actor_id = int(sources[0]["actor_id"])
             if sent is not None and int(sent) != actor_id:
@@ -268,20 +217,57 @@ class WorkerServer:
                              "actor_id (no source node carries one)"}
         else:
             actor_id = int(sent)
-        return self._guarded_spawn(
-            actor_id, down_actor,
-            lambda: build_fragment(plan, self.store, self.local,
-                                   channel_for_test,
-                                   actor_id=actor_id)[1],
-            "plan build")
+        if actor_id in self.actors:
+            return {"ok": False,
+                    "error": f"actor {actor_id} already deployed"}
+        try:
+            consumer = build_fragment(plan, self.store, self.local,
+                                      channel_for_test,
+                                      actor_id=actor_id)[1]
+            return self._spawn_actor(actor_id, outputs, dispatch,
+                                     consumer)
+        except BaseException as e:     # noqa: BLE001 — report upstream
+            self.local.drop_actor(actor_id)
+            return {"ok": False, "error": f"plan build failed: {e}"}
 
-    async def _deploy(self, cmd: dict) -> dict:
-        frag = FRAGMENTS[cmd["fragment"]]
-        p = cmd["params"]
-        return self._guarded_spawn(
-            int(p["actor_id"]), int(p["down_actor"]),
-            lambda: frag(self, p)[1],   # fragment registers its sender
-            "deploy")
+    # -- batch data plane -------------------------------------------------
+    def _scan_table(self, cmd: dict) -> dict:
+        """Stream one table's committed rows back to the coordinator
+        (RowSeqScan over the local store + GetData, collapsed to the
+        control channel). Rows are value-codec encoded — the
+        coordinator holds the schema; this side needs none."""
+        from risingwave_tpu.storage.value_codec import encode_row
+
+        tid = int(cmd["table_id"])
+        epoch = cmd.get("epoch")
+        epoch = (self.store.committed_epoch() if epoch is None
+                 else int(epoch))
+        rows = [[k.hex(), encode_row(tuple(v)).hex()]
+                for k, v in self.store.iter(tid, epoch)]
+        return {"ok": True, "epoch": epoch, "rows": rows}
+
+    def _ingest_table(self, cmd: dict) -> dict:
+        """Bulk-load rows into a table at a fresh sealed+synced epoch —
+        the receiving half of cross-worker state migration (the
+        reference moves no state because storage is shared; with
+        per-worker namespaces the reschedule barrier ships it)."""
+        from risingwave_tpu.storage.value_codec import decode_row
+
+        tid = int(cmd["table_id"])
+        batch = [(bytes.fromhex(k),
+                  None if r is None else decode_row(bytes.fromhex(r)))
+                 for k, r in cmd["rows"]]
+        epoch = max(self.store.committed_epoch(),
+                    getattr(self.store, "_sealed_epoch", 0)) + 1
+        self.store.ingest_batch(tid, batch, epoch)
+        self.store.seal_epoch(epoch, True)
+        self.store.sync(epoch)
+        if getattr(self.store, "two_phase", False):
+            # a coordinator-driven bulk load IS the commit decision:
+            # leaving it staged would let a recovery in the next two
+            # barriers discard freshly-migrated state
+            self.store.commit_through(epoch)
+        return {"ok": True, "rows": len(batch), "epoch": epoch}
 
     async def _inject(self, cmd: dict) -> dict:
         pair = EpochPair(Epoch(int(cmd["curr"])),
@@ -300,13 +286,23 @@ class WorkerServer:
         await self.local.send_barrier(barrier)
         collected = await self.local.await_epoch_complete(
             pair.curr.value)
-        # the worker may have committed AHEAD of the coordinator (crash
-        # between worker sync and coordinator commit): sealing an older
-        # epoch again must be a no-op, not an assertion failure
-        if pair.prev.value > self.store.committed_epoch():
+        # seal+stage the epoch that ENDED. The guard makes re-injection
+        # after recovery a no-op rather than an assertion failure.
+        sealed = max(self.store.committed_epoch(),
+                     getattr(self.store, "_sealed_epoch", 0))
+        if pair.prev.value > sealed:
             self.store.seal_epoch(pair.prev.value, kind.is_checkpoint)
             if kind.is_checkpoint:
                 self.store.sync(pair.prev.value)
+        if getattr(self.store, "two_phase", False):
+            # the coordinator's commit decision rides on this barrier
+            # (HummockManager::commit_epoch pipelined one barrier
+            # behind); absent — a legacy driver — self-commit through
+            # the sealed epoch, which degrades to the direct mode
+            committed = cmd.get("committed")
+            self.store.commit_through(
+                pair.prev.value if committed is None
+                else int(committed))
         # stopped actors are gone after this barrier
         if isinstance(mutation, StopMutation):
             for aid in list(self.actors):
@@ -352,7 +348,8 @@ def main(argv=None) -> None:
     from risingwave_tpu.storage.object_store import LocalFsObjectStore
 
     async def amain():
-        store = HummockLite(LocalFsObjectStore(args.store))
+        store = HummockLite(LocalFsObjectStore(args.store),
+                            two_phase=True)
         w = WorkerServer(store)
         ports = await w.serve()
         print(json.dumps(ports), flush=True)

@@ -1,0 +1,254 @@
+"""Fragmenter: executor plan → distributable fragment graph of plan IR.
+
+Reference parity: src/frontend/src/stream_fragmenter/mod.rs:115,199 —
+the reference splits the stream plan at exchanges into a
+StreamFragmentGraph whose fragments meta schedules onto compute nodes
+(meta/src/stream/stream_graph/schedule.rs:195-251). TPU re-design: the
+planner's EXECUTOR tree is already the physical plan, so the fragmenter
+walks it and serializes each segment to plan IR (stream/plan_ir.py),
+cutting where the reference inserts a hash exchange — before every
+HashAgg (dist keys = group keys) and on both inputs of every HashJoin
+(dist keys = join keys). Everything else stays colocated with its
+input (NoShuffle), including the terminal Materialize, so each parallel
+actor materializes its vnode slice into its worker's namespace.
+
+The cut carries `keys` in the UPSTREAM fragment's output schema; the
+scheduler (cluster/scheduler.py) turns each cut edge into a
+HashDispatcher on the upstream actors and remote_input+merge nodes on
+the downstream actors.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from risingwave_tpu.stream.executors.hash_agg import HashAggExecutor
+from risingwave_tpu.stream.executors.hash_join import HashJoinExecutor
+from risingwave_tpu.stream.executors.materialize import (
+    MaterializeExecutor,
+)
+from risingwave_tpu.stream.executors.row_id_gen import RowIdGenExecutor
+from risingwave_tpu.stream.executors.simple import (
+    FilterExecutor, ProjectExecutor,
+)
+from risingwave_tpu.stream.executors.source import SourceExecutor
+from risingwave_tpu.stream.plan_ir import expr_to_ir, schema_to_ir
+
+
+class FragmentError(ValueError):
+    """Plan shape the distributed lowering cannot express (yet)."""
+
+
+@dataclass
+class FragInput:
+    """One cut edge: this fragment consumes `up_frag`'s output hashed
+    on `keys` (indices into the upstream OUTPUT schema)."""
+
+    up_frag: int
+    keys: List[int]
+    schema: List[dict]              # IR schema of the exchanged rows
+    node_idx: int                   # index of the exchange_in placeholder
+
+
+@dataclass
+class Fragment:
+    """A deployable pipeline segment. `nodes` is plan IR where
+    {"op": "exchange_in", "port": k} placeholders stand for the k-th
+    entry of `inputs`; the scheduler expands each into per-upstream-
+    actor remote_input nodes plus a merge."""
+
+    nodes: List[dict] = field(default_factory=list)
+    parallelism: int = 1
+    inputs: List[FragInput] = field(default_factory=list)
+
+
+@dataclass
+class FragmentGraph:
+    """Fragments in topological order (every FragInput.up_frag precedes
+    its consumer). The LAST fragment holds the Materialize."""
+
+    fragments: List[Fragment] = field(default_factory=list)
+
+    def consumers_of(self, frag_idx: int) -> List[tuple]:
+        """[(down_frag_idx, keys)] — at most one in a tree plan."""
+        out = []
+        for di, f in enumerate(self.fragments):
+            for inp in f.inputs:
+                if inp.up_frag == frag_idx:
+                    out.append((di, inp.keys))
+        return out
+
+
+def _agg_call_ir(c) -> dict:
+    d = {"kind": c.kind.value}
+    if c.input_idx is not None:
+        d["input_idx"] = c.input_idx
+    if c.distinct:
+        d["distinct"] = True
+    if c.delimiter != ",":
+        d["delimiter"] = c.delimiter
+    return d
+
+
+class Fragmenter:
+    """One-shot walker over a planned executor tree."""
+
+    def __init__(self, parallelism: int):
+        self.parallelism = max(1, parallelism)
+        self.graph = FragmentGraph()
+
+    def lower(self, consumer) -> FragmentGraph:
+        self._lower(consumer)
+        return self.graph
+
+    # -- helpers ----------------------------------------------------------
+    def _new_fragment(self, parallelism: int) -> int:
+        self.graph.fragments.append(Fragment(parallelism=parallelism))
+        return len(self.graph.fragments) - 1
+
+    def _append(self, fi: int, node: dict) -> int:
+        self.graph.fragments[fi].nodes.append(node)
+        return len(self.graph.fragments[fi].nodes) - 1
+
+    def _cut(self, up_fi: int, keys: List[int], schema,
+             parallelism: int) -> tuple:
+        """Close `up_fi` at its current tail and start a new fragment
+        consuming it through a hash exchange. Returns (new_frag_idx,
+        node_idx of the exchange_in placeholder)."""
+        fi = self._new_fragment(parallelism)
+        frag = self.graph.fragments[fi]
+        port = len(frag.inputs)
+        ni = self._append(fi, {"op": "exchange_in", "port": port})
+        frag.inputs.append(FragInput(up_fi, list(keys),
+                                     schema_to_ir(schema), ni))
+        return fi, ni
+
+    def _cut_into(self, fi: int, up_fi: int, keys: List[int],
+                  schema) -> int:
+        """Add another exchange port to an existing fragment (the
+        second input of a join)."""
+        frag = self.graph.fragments[fi]
+        port = len(frag.inputs)
+        ni = self._append(fi, {"op": "exchange_in", "port": port})
+        frag.inputs.append(FragInput(up_fi, list(keys),
+                                     schema_to_ir(schema), ni))
+        return ni
+
+    # -- the walk ---------------------------------------------------------
+    def _lower(self, ex) -> tuple:
+        """Returns (frag_idx, node_idx) of ex's IR node."""
+        if isinstance(ex, SourceExecutor):
+            opts = getattr(ex, "ir_connector", None)
+            if opts is None:
+                raise FragmentError(
+                    "source executor carries no connector options "
+                    "(ir_connector) — planned outside the frontend?")
+            if ex.split_state is None:
+                raise FragmentError("distributed source needs durable "
+                                    "split state")
+            fi = self._new_fragment(1)
+            ni = self._append(fi, {
+                "op": "source", "name": ex.identity,
+                "connector": dict(opts),
+                "schema": schema_to_ir(ex.schema),
+                "actor_id": 0,              # scheduler assigns
+                "split_table_id": ex.split_state.table_id,
+                "rate_limit": ex.rate_limit,
+                "min_chunks": ex.min_chunks,
+            })
+            return fi, ni
+        if isinstance(ex, ProjectExecutor):
+            # note: watermark_derivations may hold host lambdas (tumble
+            # floor transforms) — fine in process, not shippable; the
+            # distributed plan drops derivations (EOWC rejects upstream)
+            fi, ci = self._lower(ex.input)
+            ni = self._append(fi, {
+                "op": "project", "input": ci,
+                "exprs": [expr_to_ir(e) for e in ex.exprs],
+                "names": [f.name for f in ex.schema]})
+            return fi, ni
+        if isinstance(ex, FilterExecutor):
+            fi, ci = self._lower(ex.input)
+            ni = self._append(fi, {"op": "filter", "input": ci,
+                                   "pred": expr_to_ir(ex.predicate)})
+            return fi, ni
+        if isinstance(ex, RowIdGenExecutor):
+            fi, ci = self._lower(ex.input)
+            ni = self._append(fi, {"op": "row_id_gen", "input": ci})
+            return fi, ni
+        from risingwave_tpu.stream.executors.watermark_filter import (
+            WatermarkFilterExecutor,
+        )
+        if isinstance(ex, WatermarkFilterExecutor):
+            fi, ci = self._lower(ex.input)
+            ni = self._append(fi, {
+                "op": "watermark_filter", "input": ci,
+                "time_col": ex.time_col, "delay_usecs": ex.delay,
+                "table_id": (ex.state.table_id
+                             if ex.state is not None else None)})
+            return fi, ni
+        from risingwave_tpu.stream.executors.hop_window import (
+            HopWindowExecutor,
+        )
+        if isinstance(ex, HopWindowExecutor):
+            fi, ci = self._lower(ex.input)
+            ni = self._append(fi, {
+                "op": "hop_window", "input": ci,
+                "time_col": ex.time_col,
+                "slide_usecs": ex.slide, "size_usecs": ex.size})
+            return fi, ni
+        if isinstance(ex, HashAggExecutor):
+            up_fi, ci = self._lower(ex.input)
+            node = {
+                "op": "hash_agg", "input": None,
+                "group": list(ex.group_indices),
+                "calls": [_agg_call_ir(c) for c in ex.agg_calls],
+                "table_id": ex.table.table_id,
+                "append_only": ex.append_only,
+                "output_names": [f.name for f in ex.schema],
+                "dedup_table_ids": {
+                    col: t.table_id
+                    for col, t in ex.distinct_tables.items()},
+                "minput_table_ids": {
+                    j: t.table_id for j, t in ex.minput.items()},
+            }
+            if self.parallelism > 1:
+                fi, xi = self._cut(up_fi, list(ex.group_indices),
+                                   ex.input.schema, self.parallelism)
+                node["input"] = xi
+            else:
+                # parallelism 1: colocate with the input chain
+                # (NoShuffle) — no exchange hop to pay for
+                fi, node["input"] = up_fi, ci
+            ni = self._append(fi, node)
+            return fi, ni
+        if isinstance(ex, HashJoinExecutor):
+            left, right = ex.sides
+            l_fi, _ = self._lower(ex.left_in)
+            r_fi, _ = self._lower(ex.right_in)
+            fi, lxi = self._cut(l_fi, list(left.key_indices),
+                                ex.left_in.schema, self.parallelism)
+            rxi = self._cut_into(fi, r_fi, list(right.key_indices),
+                                 ex.right_in.schema)
+            ni = self._append(fi, {
+                "op": "hash_join", "left": lxi, "right": rxi,
+                "left_keys": list(left.key_indices),
+                "right_keys": list(right.key_indices),
+                "left_table_id": left.table.table_id,
+                "right_table_id": right.table.table_id,
+                "left_pk": list(left.table.pk_indices),
+                "right_pk": list(right.table.pk_indices),
+                "join_type": ex.join_type.value,
+                "output_names": [f.name for f in ex.schema]})
+            return fi, ni
+        if isinstance(ex, MaterializeExecutor):
+            fi, ci = self._lower(ex.input)
+            ni = self._append(fi, {
+                "op": "materialize", "input": ci,
+                "table_id": ex.table.table_id,
+                "pk": list(ex.table.pk_indices)})
+            return fi, ni
+        raise FragmentError(
+            f"{type(ex).__name__} has no distributed lowering yet "
+            "(deploy this MV on the in-process session)")

@@ -255,6 +255,9 @@ class StreamPlanner:
             reader, rx, split_state, actor_id=sid,
             rate_limit_chunks_per_barrier=rate_limit,
             min_chunks_per_barrier=min_chunks)
+        # connector options ride along for the fragmenter: the shipped
+        # source IR node rebuilds the reader worker-side from these
+        ex.ir_connector = dict(obj.options)
         self.readers[sid] = reader
         scope = Scope.of(obj.schema, alias)
         # event-time watermarks from SQL: WITH (watermark.column='ts',

@@ -46,10 +46,25 @@ def _user_prefix(hex_key: str) -> bytes:
 
 
 class HummockLite(StateStore):
-    """Single-process LSM store: StateStore for every table id."""
+    """Single-process LSM store: StateStore for every table id.
 
-    def __init__(self, obj: ObjectStore) -> None:
+    ``two_phase=True`` (cluster workers): ``sync(epoch)`` only STAGES
+    the uploaded SST in a durable side manifest; the version advances
+    when the coordinator's commit decision arrives via
+    ``commit_through(epoch)`` — the HummockManager::commit_epoch split
+    (src/meta/src/hummock/manager/mod.rs:1335): compute nodes upload,
+    meta owns the version. This is what makes a cluster checkpoint
+    atomic: a worker that crashed after staging an epoch the
+    coordinator never committed discards it on recovery
+    (``discard_staged_above``) instead of resurrecting half an epoch.
+    Staged SSTs stay readable (they are the newest layer) so the
+    in-flight epoch's reads see the data it just flushed.
+    """
+
+    def __init__(self, obj: ObjectStore, two_phase: bool = False) -> None:
         self.obj = obj
+        self.two_phase = two_phase
+        self._staged: List[dict] = []   # [{"epoch": e, "sst": info}]
         # unsealed writes: epoch → table → key → (tombstone, row)
         self._mem: Dict[int, Dict[int, Dict[bytes, Value]]] = {}
         # sealed, not yet synced: newest last
@@ -73,16 +88,32 @@ class HummockLite(StateStore):
 
     # -- manifest ---------------------------------------------------------
     def _load_current(self) -> None:
+        if self.obj.exists("meta/STAGED.json"):
+            self._staged = json.loads(
+                self.obj.read("meta/STAGED.json").decode())
+            # staged maxima apply even with no committed version yet:
+            # a worker that crashed before its FIRST commit_through
+            # must not reuse a staged SST's id or re-seal its epoch
+            self._sealed_epoch = max(
+                (s["epoch"] for s in self._staged), default=0)
+            self._next_sst_id = max(
+                (s["sst"]["id"] + 1 for s in self._staged),
+                default=self._next_sst_id)
         if not self.obj.exists("meta/CURRENT"):
             return
         vid = int(self.obj.read("meta/CURRENT").decode())
         v = json.loads(self.obj.read(f"meta/v{vid}.json").decode())
         self._version_id = v["version_id"]
         self._committed_epoch = v["committed_epoch"]
-        self._sealed_epoch = v["committed_epoch"]
-        self._next_sst_id = v["next_sst_id"]
+        self._sealed_epoch = max(v["committed_epoch"],
+                                 self._sealed_epoch)
+        self._next_sst_id = max(v["next_sst_id"], self._next_sst_id)
         self._l0 = v["l0"]
         self._l1 = v["l1"]
+
+    def _persist_staged(self) -> None:
+        self.obj.upload("meta/STAGED.json",
+                        json.dumps(self._staged).encode())
 
     def _commit_version(self) -> None:
         self._version_id += 1
@@ -123,7 +154,9 @@ class HummockLite(StateStore):
         self._imms.sort(key=lambda t: t[0])
 
     def sync(self, epoch: int) -> dict:
-        """Upload all imms ≤ epoch as one SST; commit the version."""
+        """Upload all imms ≤ epoch as one SST. Direct mode commits the
+        version; two-phase mode only STAGES the SST (durably) and
+        waits for ``commit_through`` from the coordinator."""
         fail_point("hummock.sync")
         take = [im for im in self._imms if im[0] <= epoch]
         self._imms = [im for im in self._imms if im[0] > epoch]
@@ -145,13 +178,56 @@ class HummockLite(StateStore):
                 b.add(fk, tomb, row)
             data, info = b.finish()
             self.obj.upload(f"data/{sst_id}.sst", data)
+            if self.two_phase:
+                self._staged.append({"epoch": epoch, "sst": info})
+                self._persist_staged()
+                return {"sst": info}
             self._l0.append(info)
+        if self.two_phase:
+            return {"sst": None}
         self._committed_epoch = max(self._committed_epoch, epoch)
         if len(self._l0) >= L0_COMPACT_THRESHOLD:
             self.compact()
         else:
             self._commit_version()
         return {"sst": info}
+
+    # -- two-phase commit plane (coordinator-driven) ----------------------
+    def commit_through(self, epoch: int) -> None:
+        """Adopt every staged SST ≤ epoch into the committed version —
+        the commit decision the coordinator pipelines on the next
+        barrier (HummockManager::commit_epoch)."""
+        if epoch <= self._committed_epoch and not any(
+                s["epoch"] <= epoch for s in self._staged):
+            return
+        adopt = [s for s in self._staged if s["epoch"] <= epoch]
+        self._staged = [s for s in self._staged if s["epoch"] > epoch]
+        for s in adopt:
+            self._l0.append(s["sst"])
+        self._committed_epoch = max(self._committed_epoch, epoch)
+        if len(self._l0) >= L0_COMPACT_THRESHOLD:
+            self.compact()
+        else:
+            self._commit_version()
+        if adopt:
+            self._persist_staged()
+
+    def discard_staged_above(self, epoch: int) -> int:
+        """Recovery: drop staged SSTs the coordinator never committed
+        (a crashed cluster's half-epoch must not resurrect)."""
+        drop = [s for s in self._staged if s["epoch"] > epoch]
+        self._staged = [s for s in self._staged if s["epoch"] <= epoch]
+        for s in drop:
+            self.obj.delete(f"data/{s['sst']['id']}.sst")
+            self._handles.pop(s["sst"]["id"], None)
+            self._blocks.drop_sst(s["sst"]["id"])
+        if drop:
+            self._persist_staged()
+        # writes restart above what remains
+        self._sealed_epoch = max(self._committed_epoch,
+                                 max((s["epoch"] for s in self._staged),
+                                     default=0))
+        return len(drop)
 
     def committed_epoch(self) -> int:
         return self._committed_epoch
@@ -191,7 +267,16 @@ class HummockLite(StateStore):
             kv = tables.get(table_id)
             if kv is not None and key in kv:
                 return kv[key]
-        # 3) L0 newest → oldest, then L1 (bloom-pruned point lookups)
+        # 3) staged (two-phase, newest layer) → L0 newest → oldest,
+        # then L1 (bloom-pruned point lookups)
+        for s in reversed(self._staged):
+            info = s["sst"]
+            if info["min_epoch"] > epoch:
+                continue
+            hit = self._sst(info).get(table_id, key, epoch)
+            if hit is not None:
+                _found, tomb, row = hit
+                return None if tomb else decode_row(row)
         for info in reversed(self._l0):
             if info["min_epoch"] > epoch:
                 continue
@@ -298,6 +383,9 @@ class HummockLite(StateStore):
             yield from reversed(run)
 
         mk = sst_source_rev if reverse else sst_source
+        for s in reversed(self._staged):
+            sources.append(mk(self._sst(s["sst"]), rank))
+            rank += 1
         for info in reversed(self._l0):
             sources.append(mk(self._sst(info), rank))
             rank += 1

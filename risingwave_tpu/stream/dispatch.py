@@ -209,7 +209,7 @@ class HashDispatcher(Dispatcher):
         return list(self._outputs)
 
     def _set_outputs(self, outputs: List[Output]) -> None:
-        if len(outputs) != self.mapping.num_owners:
+        if len(outputs) != self.mapping.num_owners():
             self.mapping = self.mapping.rebalance(len(outputs))
         self._outputs = list(outputs)
 

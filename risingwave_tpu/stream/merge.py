@@ -141,6 +141,40 @@ class MergeExecutor(Executor):
                 rx.close()
 
 
+class MergeExecutors(Executor):
+    """Merge N upstream EXECUTORS (typically RemoteInputs pulling one
+    exchange edge each) into one barrier-aligned stream.
+
+    Reference parity: merge.rs:36 built over exchange/input.rs inputs —
+    the fan-in side of a cross-worker hash exchange. The channel-based
+    MergeExecutor above serves in-process wiring; this variant drives
+    executor streams directly so a shipped plan-IR fragment can merge
+    its remote_input nodes without an adapter task per input.
+    """
+
+    def __init__(self, info: ExecutorInfo, inputs: List[Executor],
+                 actor_id: int = 0):
+        super().__init__(info)
+        self.inputs = list(inputs)
+        self.actor_id = actor_id
+
+    async def execute(self) -> AsyncIterator[Message]:
+        assert self.inputs, "MergeExecutors needs at least one input"
+        wm_align = _WatermarkAligner(len(self.inputs))
+        async for tag, msg in barrier_align_n(
+                [i.execute() for i in self.inputs]):
+            if tag == "barrier":
+                yield msg.with_passed(self.actor_id)
+                if msg.is_stop(self.actor_id):
+                    return
+            elif isinstance(msg, Watermark):
+                w = wm_align.update(tag, msg)
+                if w is not None:
+                    yield w
+            else:
+                yield msg
+
+
 async def barrier_align_n(inputs: List[AsyncIterator[Message]]
                           ) -> AsyncIterator[tuple]:
     """N-way alignment over executor streams (barrier_align.rs:34 analog).

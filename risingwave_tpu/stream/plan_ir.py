@@ -27,6 +27,12 @@ Node shapes (dicts, `op` discriminated):
                                         # exchange; barriers arrive
                                         # in-band, so a fragment fed
                                         # only by these has no source
+  {"op": "merge", "inputs": [N, ...]}   # N-way barrier-aligned fan-in
+                                        # over earlier nodes (merge.rs
+                                        # over exchange inputs) — the
+                                        # receive side of a hash
+                                        # exchange from a parallel
+                                        # upstream fragment
   {"op": "hash_join", "left": N, "right": N, "left_keys": [...],
    "right_keys": [...], "left_table_id": n, "right_table_id": n,
    "left_pk": [...], "right_pk": [...], "join_type": "inner",
@@ -188,6 +194,25 @@ def build_fragment(nodes: List[dict], store, local,
             ex = FilterExecutor(child, expr_from_ir(node["pred"]))
         elif op == "row_id_gen":
             ex = RowIdGenExecutor(built[node["input"]])
+        elif op == "watermark_filter":
+            from risingwave_tpu.stream.executors.watermark_filter \
+                import WATERMARK_STATE_SCHEMA, WatermarkFilterExecutor
+            wm_state = None
+            if node.get("table_id") is not None:
+                wm_state = StateTable(int(node["table_id"]),
+                                      WATERMARK_STATE_SCHEMA, [0],
+                                      store)
+            ex = WatermarkFilterExecutor(
+                built[node["input"]], int(node["time_col"]),
+                Interval(usecs=int(node["delay_usecs"])), wm_state)
+        elif op == "hop_window":
+            from risingwave_tpu.stream.executors.hop_window import (
+                HopWindowExecutor,
+            )
+            ex = HopWindowExecutor(
+                built[node["input"]], int(node["time_col"]),
+                Interval(usecs=int(node["slide_usecs"])),
+                Interval(usecs=int(node["size_usecs"])))
         elif op == "remote_input":
             from risingwave_tpu.stream.remote import RemoteInput
             if actor_id is None:
@@ -196,6 +221,16 @@ def build_fragment(nodes: List[dict], store, local,
             ex = RemoteInput(node["host"], int(node["port"]),
                              int(node["up_actor"]), int(actor_id),
                              schema_from_ir(node["schema"]))
+        elif op == "merge":
+            from risingwave_tpu.stream.executor import ExecutorInfo
+            from risingwave_tpu.stream.merge import MergeExecutors
+            children = [built[i] for i in node["inputs"]]
+            if len({len(c.schema) for c in children}) != 1:
+                raise ValueError("merge inputs must share a schema")
+            ex = MergeExecutors(
+                ExecutorInfo(children[0].schema, [],
+                             f"Merge({len(children)})"),
+                children, actor_id=int(actor_id or 0))
         elif op == "hash_join":
             from risingwave_tpu.stream.executors.hash_join import (
                 HashJoinExecutor, JoinType,

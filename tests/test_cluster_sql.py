@@ -1,0 +1,176 @@
+"""SQL → N-worker cluster deployment (VERDICT r4 #1).
+
+CREATE MATERIALIZED VIEW on the DistFrontend plans with the ordinary
+StreamPlanner, fragments the executor tree at hash exchanges, and lands
+the fragments on 2 worker processes with worker↔worker remote exchange.
+The in-process Frontend over identical sources is the oracle: the
+distributed cluster must produce exactly the same MV rows.
+
+Covers: q8-shaped windowed join across 2 workers (hash exchange on the
+join keys), parallel GROUP BY agg (hash exchange on group keys),
+SIGKILL-one-worker full recovery to the committed epoch, and a
+reschedule that moves a fragment's actor between workers with state
+handoff.
+"""
+
+import asyncio
+
+import pytest
+
+from risingwave_tpu.cluster.session import DistFrontend
+from risingwave_tpu.frontend.session import Frontend
+
+EVENTS = 6000
+
+Q8_SOURCES = (
+    "CREATE SOURCE person WITH (connector='nexmark', "
+    "nexmark.table.type='person', nexmark.event.num={n}, "
+    "nexmark.max.chunk.size=256, nexmark.min.event.gap.in.ns=50000000)",
+    "CREATE SOURCE auction WITH (connector='nexmark', "
+    "nexmark.table.type='auction', nexmark.event.num={n}, "
+    "nexmark.max.chunk.size=256, nexmark.min.event.gap.in.ns=50000000)",
+)
+
+Q8_MV = (
+    "CREATE MATERIALIZED VIEW q8 AS "
+    "SELECT p.id, p.name, p.window_start "
+    "FROM TUMBLE(person, date_time, INTERVAL '10' SECOND) AS p "
+    "JOIN TUMBLE(auction, date_time, INTERVAL '10' SECOND) AS a "
+    "ON p.id = a.seller AND p.window_start = a.window_start")
+
+Q7ISH_SOURCES = (
+    "CREATE SOURCE bid WITH (connector='nexmark', "
+    "nexmark.table.type='bid', nexmark.event.num={n}, "
+    "nexmark.max.chunk.size=256, nexmark.min.event.gap.in.ns=50000000)",
+)
+
+Q7ISH_MV = (
+    "CREATE MATERIALIZED VIEW q7 AS "
+    "SELECT window_start, MAX(price) AS max_price, COUNT(*) AS cnt "
+    "FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND) "
+    "GROUP BY window_start")
+
+
+def _inprocess_oracle(sources, mv_sql, select_sql, events=EVENTS,
+                      steps=30):
+    """Run the same job on the single-process session → row set."""
+    async def run():
+        fe = Frontend(min_chunks=8)
+        for s in sources:
+            await fe.execute(s.format(n=events))
+        await fe.execute(mv_sql)
+        await fe.step(steps)
+        rows = await fe.execute(select_sql)
+        await fe.close()
+        return rows
+
+    return {tuple(r) for r in asyncio.run(run())}
+
+
+def test_dist_q8_two_workers(tmp_path):
+    async def run():
+        fe = DistFrontend(str(tmp_path), n_workers=2, parallelism=2)
+        await fe.start()
+        try:
+            for s in Q8_SOURCES:
+                await fe.execute(s.format(n=EVENTS))
+            await fe.execute(Q8_MV)
+            await fe.step(30)
+            return {tuple(r)
+                    for r in await fe.execute("SELECT * FROM q8")}
+        finally:
+            await fe.close()
+
+    got = asyncio.run(run())
+    expect = _inprocess_oracle(Q8_SOURCES, Q8_MV, "SELECT * FROM q8")
+    assert got == expect
+    assert len(got) > 5
+
+
+def test_dist_parallel_agg_two_workers(tmp_path):
+    async def run():
+        fe = DistFrontend(str(tmp_path), n_workers=2, parallelism=2)
+        await fe.start()
+        try:
+            for s in Q7ISH_SOURCES:
+                await fe.execute(s.format(n=EVENTS))
+            await fe.execute(Q7ISH_MV)
+            await fe.step(30)
+            job = fe.cluster.jobs["q7"]
+            # the agg fragment really is parallel over both workers
+            agg_frag = [fi for fi, f in
+                        enumerate(job.graph.fragments)
+                        if any(n["op"] == "hash_agg"
+                               for n in f.nodes)][0]
+            slots = {s for _a, s in job.placements[agg_frag]}
+            assert slots == {0, 1}, slots
+            return {tuple(r)
+                    for r in await fe.execute("SELECT * FROM q7")}
+        finally:
+            await fe.close()
+
+    got = asyncio.run(run())
+    expect = _inprocess_oracle(Q7ISH_SOURCES, Q7ISH_MV,
+                               "SELECT * FROM q7")
+    assert got == expect
+    assert len(got) > 2
+
+
+def test_dist_kill_worker_recovers(tmp_path):
+    """SIGKILL one worker mid-stream: the next barrier fails, recovery
+    restarts every slot over its namespace, discards the uncommitted
+    staged epoch, redeploys, and the job finishes oracle-exact."""
+    async def run():
+        fe = DistFrontend(str(tmp_path), n_workers=2, parallelism=2)
+        await fe.start()
+        try:
+            for s in Q8_SOURCES:
+                await fe.execute(s.format(n=EVENTS))
+            await fe.execute(Q8_MV)
+            await fe.step(5)
+            fe.cluster.kill_slot(1)      # no goodbye, no flush
+            with pytest.raises(Exception):
+                await fe.step(3)
+            await fe.recover()
+            await fe.step(40)
+            return {tuple(r)
+                    for r in await fe.execute("SELECT * FROM q8")}
+        finally:
+            await fe.close()
+
+    got = asyncio.run(run())
+    expect = _inprocess_oracle(Q8_SOURCES, Q8_MV, "SELECT * FROM q8")
+    assert got == expect
+    assert len(got) > 5
+
+
+def test_dist_move_fragment_between_workers(tmp_path):
+    """Reschedule: move the agg fragment's actors between workers at a
+    stopped barrier (scan+ingest state handoff), finish, stay exact."""
+    async def run():
+        fe = DistFrontend(str(tmp_path), n_workers=2, parallelism=1)
+        await fe.start()
+        try:
+            for s in Q7ISH_SOURCES:
+                await fe.execute(s.format(n=EVENTS))
+            await fe.execute(Q7ISH_MV)
+            await fe.step(4)
+            job = fe.cluster.jobs["q7"]
+            # parallelism=1 → agg colocated with the source chain in
+            # one fragment; move that single actor to the other slot
+            frag_idx = len(job.graph.fragments) - 1
+            old_slot = job.placements[frag_idx][0][1]
+            new_slot = 1 - old_slot
+            await fe.cluster.move_fragment("q7", frag_idx, [new_slot])
+            assert job.placements[frag_idx][0][1] == new_slot
+            await fe.step(30)
+            return {tuple(r)
+                    for r in await fe.execute("SELECT * FROM q7")}
+        finally:
+            await fe.close()
+
+    got = asyncio.run(run())
+    expect = _inprocess_oracle(Q7ISH_SOURCES, Q7ISH_MV,
+                               "SELECT * FROM q7")
+    assert got == expect
+    assert len(got) > 2

@@ -474,3 +474,44 @@ def test_leveled_compaction_keeps_disjoint_runs():
             assert l1 < s2
     finally:
         hm.L1_TARGET_SST_BYTES = old_target
+
+
+def test_two_phase_staging_semantics(tmp_path):
+    """Worker-mode HummockLite: sync() STAGES; the version advances
+    only at commit_through; discard_staged_above drops uncommitted
+    epochs; a restart before the FIRST commit neither reuses staged
+    SST ids nor re-seals staged epochs (coordinator-owned commit,
+    HummockManager::commit_epoch split)."""
+    from risingwave_tpu.storage.hummock import HummockLite
+    from risingwave_tpu.storage.object_store import LocalFsObjectStore
+
+    root = str(tmp_path / "tp")
+    s = HummockLite(LocalFsObjectStore(root), two_phase=True)
+    s.ingest_batch(7, [(b"a", (1,)), (b"b", (2,))], epoch=100)
+    s.seal_epoch(100)
+    s.sync(100)
+    # staged, not committed — but readable at its epoch
+    assert s.committed_epoch() == 0
+    assert s.get(7, b"a", 100) == (1,)
+    # restart BEFORE any commit: staged survives, ids/epochs reserved
+    s2 = HummockLite(LocalFsObjectStore(root), two_phase=True)
+    assert s2.committed_epoch() == 0
+    assert s2.get(7, b"a", 100) == (1,)
+    assert s2._next_sst_id > s._staged[0]["sst"]["id"] if s._staged \
+        else True
+    s2.ingest_batch(7, [(b"c", (3,))], epoch=200)
+    s2.seal_epoch(200)
+    s2.sync(200)
+    ids = {st["sst"]["id"] for st in s2._staged}
+    assert len(ids) == len(s2._staged) == 2      # no id reuse
+    # commit through 100: epoch 100 visible in the committed version
+    s2.commit_through(100)
+    assert s2.committed_epoch() == 100
+    # discard the uncommitted 200 (crash recovery to floor 100)
+    assert s2.discard_staged_above(100) == 1
+    assert s2.get(7, b"c", 300) is None
+    # fresh open sees exactly the committed state
+    s3 = HummockLite(LocalFsObjectStore(root), two_phase=True)
+    assert s3.committed_epoch() == 100
+    assert s3.get(7, b"a", 100) == (1,)
+    assert s3.get(7, b"c", 300) is None

@@ -43,15 +43,65 @@ A_SCHEMA = Schema.of(seller=DataType.INT64,
 EVENTS = 6000
 
 
+def _q8_side_plans(event_num: int) -> tuple:
+    """The two q8 source fragments as plan IR (the shipped-plan path
+    replaced the old named-fragment registry): person → project, and
+    auction → project → device dedup agg → project."""
+    from risingwave_tpu.common.types import DataType, Interval
+    from risingwave_tpu.connectors.nexmark import TABLE_SCHEMAS
+    from risingwave_tpu.expr.expr import InputRef, tumble_start
+    from risingwave_tpu.stream.plan_ir import expr_to_ir, schema_to_ir
+
+    window = Interval(usecs=10_000_000)
+    p = TABLE_SCHEMAS["person"]
+    a = TABLE_SCHEMAS["auction"]
+
+    def src(table, actor_id, split_tid):
+        return {"op": "source", "name": table,
+                "connector": {"connector": "nexmark",
+                              "nexmark.table.type": table,
+                              "nexmark.event.num": str(event_num),
+                              "nexmark.max.chunk.size": "256"},
+                "schema": schema_to_ir(TABLE_SCHEMAS[table]),
+                "actor_id": actor_id, "split_table_id": split_tid,
+                "rate_limit": 2}
+
+    person_plan = [
+        src("person", PERSON_ACTOR, 101),
+        {"op": "project", "input": 0,
+         "exprs": [
+             expr_to_ir(InputRef(p.index_of("id"), DataType.INT64)),
+             expr_to_ir(InputRef(p.index_of("name"), DataType.VARCHAR)),
+             expr_to_ir(tumble_start(
+                 InputRef(p.index_of("date_time"), DataType.TIMESTAMP),
+                 window))],
+         "names": ["id", "name", "starttime"]},
+    ]
+    auction_plan = [
+        src("auction", AUCTION_ACTOR, 102),
+        {"op": "project", "input": 0,
+         "exprs": [
+             expr_to_ir(InputRef(a.index_of("seller"), DataType.INT64)),
+             expr_to_ir(tumble_start(
+                 InputRef(a.index_of("date_time"), DataType.TIMESTAMP),
+                 window))],
+         "names": ["seller", "starttime"]},
+        {"op": "hash_agg", "input": 1, "group": [0, 1],
+         "calls": [{"kind": "count"}], "table_id": 103,
+         "append_only": True,
+         "output_names": ["seller", "starttime", "_cnt"]},
+        {"op": "project", "input": 2,
+         "exprs": [expr_to_ir(InputRef(0, DataType.INT64)),
+                   expr_to_ir(InputRef(1, DataType.TIMESTAMP))],
+         "names": ["seller", "starttime"]},
+    ]
+    return person_plan, auction_plan
+
+
 async def _deploy_fragments(client, event_num: int) -> None:
-    await client.deploy(
-        "q8_person", actor_id=PERSON_ACTOR, down_actor=JOIN_ACTOR,
-        event_num=event_num, split_table_id=101, rate_limit=2,
-        chunk=256)
-    await client.deploy(
-        "q8_auction_dedup", actor_id=AUCTION_ACTOR,
-        down_actor=JOIN_ACTOR, event_num=event_num,
-        split_table_id=102, agg_table_id=103, rate_limit=2, chunk=256)
+    person_plan, auction_plan = _q8_side_plans(event_num)
+    await client.deploy_plan(person_plan, down_actor=JOIN_ACTOR)
+    await client.deploy_plan(auction_plan, down_actor=JOIN_ACTOR)
 
 
 class _Coordinator:
