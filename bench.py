@@ -123,6 +123,175 @@ def bench_q3(customers: int = 1500, orders: int = 15000):
     return _result("tpch_q3_events_per_sec", elapsed, rows, p.loop)
 
 
+async def _drive_frontend(fe, expected_total: int, in_flight: int,
+                          max_epochs: int = 500):
+    """Pipelined barrier driver over a Frontend session (same
+    in-flight discipline as drive_to_completion, measured after a
+    one-epoch warmup). Returns (elapsed_s, rows)."""
+    import time
+
+    await fe.step(1)                         # warmup (traces compile)
+    readers = [r for d in fe.readers.values() for r in d.values()]
+
+    def rows_seen() -> int:
+        # filelog readers count rows explicitly (offset is bytes);
+        # generator readers' offset IS the row ordinal
+        return sum(r.rows_read if hasattr(r, "rows_read") else r.offset
+                   for r in readers)
+
+    warm = rows_seen()
+    if warm >= expected_total:
+        raise ValueError(
+            f"bench scale too small: warmup consumed all "
+            f"{expected_total} rows — raise total_events")
+    warm_epochs = len(fe.loop.stats.latencies_s)
+    loop = fe.loop
+    t0 = time.perf_counter()
+    injected = 0
+    while rows_seen() < expected_total:
+        if injected >= max_epochs:
+            raise RuntimeError(
+                f"sources stalled at {rows_seen()}/{expected_total}")
+        while loop.in_flight_count < in_flight:
+            await loop.inject()
+            injected += 1
+        await loop.collect_next()
+    while loop.in_flight_count:
+        await loop.collect_next()
+    elapsed = time.perf_counter() - t0
+    rows = rows_seen() - warm
+    loop.stats.latencies_s = loop.stats.latencies_s[warm_epochs:]
+    return elapsed, rows
+
+
+def bench_q4(total_events: int = 50 * 4000, chunk_size: int = 4096):
+    """Nexmark q4 (named baseline config): AVG of per-auction MAX bid
+    price per category — agg over join over a FROM-subquery, the full
+    SQL front-door path (e2e_test/streaming/nexmark/views/q4.slt.part).
+    Throughput counts rows entering (auctions + bids)."""
+    from risingwave_tpu.frontend.session import Frontend
+
+    async def run():
+        fe = Frontend(rate_limit=16, min_chunks=16)
+        for t in ("auction", "bid"):
+            await fe.execute(
+                f"CREATE SOURCE {t} WITH (connector='nexmark', "
+                f"nexmark.table.type='{t}', "
+                f"nexmark.event.num={total_events}, "
+                f"nexmark.max.chunk.size={chunk_size}, "
+                f"nexmark.generate.strings='false')")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW q4 AS "
+            "SELECT category, AVG(final) AS avg_final FROM ("
+            "  SELECT a.category AS category, MAX(b.price) AS final"
+            "  FROM auction AS a JOIN bid AS b ON a.id = b.auction"
+            "  WHERE b.date_time BETWEEN a.date_time AND a.expires"
+            "  GROUP BY a.id, a.category) AS q "
+            "GROUP BY category")
+        expected = total_events * 3 // 50 + total_events * 46 // 50
+        elapsed, rows = await _drive_frontend(fe, expected, IN_FLIGHT)
+        stats = fe.loop
+        await fe.close()
+        return elapsed, rows, stats
+
+    elapsed, rows, loop = asyncio.run(run())
+    return _result("nexmark_q4_events_per_sec", elapsed, rows, loop)
+
+
+def _adctr_produce(path: str, n_impressions: int, n_ads: int = 100):
+    """Filelog topics standing in for the ad-ctr demo's Kafka topics."""
+    import json as _json
+    import os
+
+    import numpy as np
+    os.makedirs(path, exist_ok=True)
+    rng = np.random.default_rng(42)
+    ads = rng.integers(0, n_ads, n_impressions)
+    base = 1_700_000_000_000_000
+    with open(os.path.join(path, "impressions-0.log"), "wb") as f:
+        for i in range(n_impressions):
+            f.write(_json.dumps({
+                "bid_id": i, "ad_id": int(ads[i]),
+                "its": base + i * 10_000}).encode() + b"\n")
+    with open(os.path.join(path, "clicks-0.log"), "wb") as f:
+        for i in range(0, n_impressions, 3):
+            f.write(_json.dumps({
+                "cbid": i, "cts": base + i * 10_000 + 500}).encode()
+                + b"\n")
+
+
+def bench_adctr(n_impressions: int = 200_000, parallelism: int = 4):
+    """ad-ctr (named baseline config #5): sources → HOP windows →
+    2-way join + temporal dim join → sliding-window agg at actor
+    parallelism 4 (integration_tests/ad-ctr analog). Runs on whatever
+    mesh the current process exposes — the driver launches this in a
+    4-device virtual-mesh subprocess when the chip count is 1."""
+    import tempfile
+
+    from risingwave_tpu.frontend.session import Frontend
+
+    async def run(path):
+        fe = Frontend(rate_limit=8, min_chunks=8,
+                      parallelism=parallelism)
+        await fe.execute(
+            f"CREATE SOURCE impression (bid_id BIGINT, ad_id BIGINT, "
+            f"its TIMESTAMP) WITH (connector='filelog', "
+            f"path='{path}', topic='impressions', "
+            f"max.chunk.size=4096)")
+        await fe.execute(
+            f"CREATE SOURCE click (cbid BIGINT, cts TIMESTAMP) WITH "
+            f"(connector='filelog', path='{path}', topic='clicks', "
+            f"max.chunk.size=4096)")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW ad_dim AS SELECT ad_id, "
+            "count(*) AS seen FROM impression GROUP BY ad_id")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW ad_ctr AS SELECT i.ad_id, "
+            "i.window_start, count(*) AS clicked "
+            "FROM HOP(impression, its, INTERVAL '2' SECOND, "
+            "INTERVAL '10' SECOND) AS i "
+            "JOIN click AS c ON i.bid_id = c.cbid "
+            "JOIN ad_dim AS d FOR SYSTEM_TIME AS OF PROCTIME() "
+            "ON i.ad_id = d.ad_id "
+            "GROUP BY i.ad_id, i.window_start")
+        # ad_dim consumes impressions too: expected totals count every
+        # reader the session drives
+        expected = 2 * n_impressions + (n_impressions + 2) // 3
+        elapsed, rows = await _drive_frontend(fe, expected, IN_FLIGHT)
+        stats = fe.loop
+        await fe.close()
+        return elapsed, rows, stats
+
+    with tempfile.TemporaryDirectory() as path:
+        _adctr_produce(path, n_impressions)
+        elapsed, rows, loop = asyncio.run(run(path))
+    r = _result("adctr_events_per_sec", elapsed, rows, loop)
+    import jax
+    r["parallelism"] = min(parallelism, len(jax.devices()))
+    return r
+
+
+def _bench_adctr_subprocess() -> dict:
+    """Run the ad-ctr config in a 4-virtual-device CPU-mesh subprocess
+    (BASELINE config #5 is 4-chip; with one real chip the mesh is
+    virtual — the result is labeled accordingly)."""
+    import os
+    import subprocess
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    out = subprocess.run(
+        [sys.executable, __file__, "--adctr-sub"],
+        capture_output=True, timeout=1200, env=env)
+    for line in reversed(out.stdout.decode().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(
+        f"adctr subprocess produced no JSON: rc={out.returncode} "
+        f"stderr={out.stderr.decode()[-300:]!r}")
+
+
 def _probe_device(timeout_s: int = 180, attempts: int = 2) -> None:
     """Fail over to CPU if the TPU backend cannot initialize.
 
@@ -178,7 +347,30 @@ def main(argv):
 
 def _main_locked(argv):
     from risingwave_tpu.utils.jaxtools import enable_compilation_cache
-    _probe_device()
+    if "--adctr-sub" in argv:
+        # child mode: env asks for the CPU virtual mesh, but the axon
+        # sitecustomize overrides JAX_PLATFORMS at interpreter start —
+        # override it back before any backend initializes (conftest.py
+        # does the same for the test suite)
+        import jax as _jax
+        _jax.config.update("jax_platforms", "cpu")
+        enable_compilation_cache()
+        r = bench_adctr(n_impressions=100_000)     # warmup
+        r = bench_adctr()
+        import jax
+        r["platform"] = (f"{jax.devices()[0].platform}"
+                         f"-mesh-{r['parallelism']}")
+        print(json.dumps(r))
+        return
+    import os
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # explicit CPU run: pin past the axon sitecustomize (which
+        # rewrites jax_platforms at interpreter start) instead of
+        # probing a chip the caller asked us not to touch
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        _probe_device()
     enable_compilation_cache()
     import jax
     platform = jax.devices()[0].platform
@@ -189,11 +381,15 @@ def _main_locked(argv):
     # Each query runs a small WARMUP first (criterion-style): the first
     # run traces/compiles every (shape) program — on a fresh process
     # that fixed cost would otherwise be reported as throughput.
-    benches = [("q7", bench_q7, {"total_events": 50 * 4000}),
-               ("q8", bench_q8, {"total_events": 50 * 4000}),
-               ("q3", bench_q3, {"orders": 1500}),
-               ("q5", bench_q5, {"total_events": 50 * 1000}),
-               ("q1", bench_q1, {"total_events": 50 * 400})]
+    # warmups run at FULL scale (warm_kw = {}): a smaller warmup
+    # leaves capacity-growth XLA compiles inside the timed run — the
+    # timed number then measures the compiler, not the pipeline
+    benches = [("q7", bench_q7, {}),
+               ("q8", bench_q8, {}),
+               ("q4", bench_q4, {}),
+               ("q3", bench_q3, {}),
+               ("q5", bench_q5, {}),
+               ("q1", bench_q1, {})]
     if quick:
         benches = benches[:1]
     headline = {}
@@ -207,6 +403,23 @@ def _main_locked(argv):
         except Exception as e:                       # noqa: BLE001
             print(f"WARNING: {name} failed: {e!r}", file=sys.stderr)
             headline[name] = {"error": repr(e)[:200]}
+    if not quick:
+        # ad-ctr is the 4-chip baseline config: with one local chip it
+        # measures on a 4-virtual-device CPU mesh in a subprocess
+        # (clearly labeled) so the parallel path always has a number
+        try:
+            if len(jax.devices()) >= 4:
+                r = bench_adctr()
+                r["platform"] = f"{platform}-mesh-{r['parallelism']}"
+            else:
+                r = _bench_adctr_subprocess()
+            headline["adctr"] = {
+                k: r[k] for k in ("value", "p99_barrier_latency_s",
+                                  "barrier_in_flight", "events",
+                                  "parallelism", "platform")}
+        except Exception as e:                       # noqa: BLE001
+            print(f"WARNING: adctr failed: {e!r}", file=sys.stderr)
+            headline["adctr"] = {"error": repr(e)[:200]}
     q7 = headline.get("q7", {})
     ok = "value" in q7
     headline.update({
@@ -217,6 +430,9 @@ def _main_locked(argv):
         "unit": "events/s",
         "vs_baseline": round(q7["value"] / BASELINE_EVENTS_PER_SEC, 4)
         if ok else None,
+        # the target is events/sec per TPU CHIP; a cpu-platform number
+        # is a fallback measurement, not a claim against that target
+        "vs_baseline_platform": platform,
         "platform": platform,
     })
     print(json.dumps(headline))

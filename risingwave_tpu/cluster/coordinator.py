@@ -87,11 +87,14 @@ class WorkerClient:
         return [(bytes.fromhex(k), decode_row(bytes.fromhex(r)))
                 for k, r in reply["rows"]]
 
-    async def ingest_table(self, table_id: int, rows: list) -> dict:
-        """Bulk-load (key_bytes, row_tuple) pairs — state migration."""
+    async def ingest_table(self, table_id: int, rows: list,
+                           min_epoch: Optional[int] = None) -> dict:
+        """Bulk-load (key_bytes, row_tuple) pairs — state migration.
+        `min_epoch` keeps the ingest epoch above in-flight barriers."""
         from risingwave_tpu.storage.value_codec import encode_row
         return await self.call({
             "cmd": "ingest_table", "table_id": table_id,
+            "min_epoch": min_epoch,
             "rows": [[k.hex(),
                       None if v is None else encode_row(tuple(v)).hex()]
                      for k, v in rows]})

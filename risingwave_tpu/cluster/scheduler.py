@@ -83,8 +83,8 @@ class Cluster:
 
     # -- lifecycle --------------------------------------------------------
     async def start(self) -> None:
-        for k in range(self.n):
-            await self._start_slot(k)
+        await asyncio.gather(*(self._start_slot(k)
+                               for k in range(self.n)))
         self._fresh_barrier_plane()
 
     async def _start_slot(self, k: int) -> None:
@@ -107,15 +107,19 @@ class Cluster:
         self.local.set_expected_actors(
             [_PSEUDO_BASE + k for k in range(self.n)])
 
+    def _stop_set(self, *jobs: JobDeployment) -> frozenset:
+        """Actor ids to stop (plus every worker pseudo-actor — the
+        stop barrier must still collect on every slot)."""
+        ids = {a for j in jobs for a in j.actor_ids()}
+        return frozenset(ids | {_PSEUDO_BASE + k
+                                for k in range(self.n)})
+
     async def stop(self) -> None:
-        stop_ids = frozenset(
-            set().union(*(set(j.actor_ids())
-                          for j in self.jobs.values()), set())
-            | {_PSEUDO_BASE + k for k in range(self.n)})
         if self.loop is not None:
             await self.loop.inject_and_collect(
                 force_checkpoint=True,
-                mutation=StopMutation(stop_ids))
+                mutation=StopMutation(
+                    self._stop_set(*self.jobs.values())))
         for h in self.handles:
             if h is not None:
                 await h.stop()
@@ -191,32 +195,43 @@ class Cluster:
                            graph: FragmentGraph) -> JobDeployment:
         """Schedule + deploy one job's fragments (upstream first so
         exchange edges exist before consumers connect), then leave
-        activation to the caller's next barrier."""
+        activation to the caller's next barrier. A partial failure
+        unwinds: already-deployed actors stop at a barrier — left
+        running, a source feeding an edge nobody consumes would block
+        on the credit window and wedge every later barrier."""
         if name in self.jobs:
             raise ValueError(f"job {name!r} already deployed")
         job = JobDeployment(name, graph, self._place(graph))
-        await self._deploy_job(job)
+        try:
+            await self._deploy_job(job)
+        except BaseException:
+            if self.loop is not None:
+                await self.loop.inject_and_collect(
+                    force_checkpoint=True,
+                    mutation=StopMutation(self._stop_set(job)))
+            raise
         self.jobs[name] = job
         return job
 
     async def _deploy_job(self, job: JobDeployment) -> None:
+        # fragments deploy upstream-first (edges must exist before
+        # consumers connect); a fragment's actors deploy concurrently
         for fi, frag in enumerate(job.graph.fragments):
             outputs, dispatch = self._wiring(fi, job.graph,
                                              job.placements)
-            for aid, slot in job.placements[fi]:
-                nodes = self._expand_nodes(frag, aid, job.placements)
-                await self.clients[slot].deploy_plan(
-                    nodes, actor_id=aid, outputs=outputs,
-                    dispatch=dispatch)
+            await asyncio.gather(*(
+                self.clients[slot].deploy_plan(
+                    self._expand_nodes(frag, aid, job.placements),
+                    actor_id=aid, outputs=outputs, dispatch=dispatch)
+                for aid, slot in job.placements[fi]))
 
     async def drop_job(self, name: str) -> None:
         job = self.jobs.pop(name, None)
         if job is None:
             raise KeyError(name)
-        stop = frozenset(set(job.actor_ids())
-                         | {_PSEUDO_BASE + k for k in range(self.n)})
         await self.loop.inject_and_collect(
-            force_checkpoint=True, mutation=StopMutation(stop))
+            force_checkpoint=True,
+            mutation=StopMutation(self._stop_set(job)))
 
     # -- barriers ---------------------------------------------------------
     async def step(self, n: int = 1) -> None:
@@ -232,10 +247,10 @@ class Cluster:
         staged SSTs are readable at any epoch — this keeps FLUSH →
         SELECT read-your-writes like the in-process session."""
         epoch = self.store.committed_epoch()
-        rows: List[tuple] = []
-        for c in self.clients:
-            if c is not None:
-                rows += await c.scan_table(table_id, epoch=epoch)
+        parts = await asyncio.gather(
+            *(c.scan_table(table_id, epoch=epoch)
+              for c in self.clients if c is not None))
+        rows: List[tuple] = [kv for part in parts for kv in part]
         rows.sort(key=lambda kv: kv[0])
         return rows
 
@@ -249,11 +264,12 @@ class Cluster:
         for k in range(self.n):
             if self.handles[k] is not None:
                 self.handles[k].kill()
-        for k in range(self.n):
-            await self._start_slot(k)
-        for k in range(self.n):
-            await self.clients[k].call(
-                {"cmd": "recover_store", "epoch": floor})
+        await asyncio.gather(*(self._start_slot(k)
+                               for k in range(self.n)))
+        await asyncio.gather(*(
+            self.clients[k].call({"cmd": "recover_store",
+                                  "epoch": floor})
+            for k in range(self.n)))
         self._fresh_barrier_plane()
         for job in self.jobs.values():
             await self._deploy_job(job)
@@ -271,13 +287,20 @@ class Cluster:
             raise ValueError("move keeps the actor count; use a "
                              "replan for true rescale")
         old = job.placements[frag_idx]
+        if len(old) != 1:
+            # a namespace scan returns EVERY actor's slice of a shared
+            # table id — moving one actor of a multi-actor fragment
+            # would ship its siblings' vnode slices too (and a swap
+            # would compound them). Needs vnode-sliced handoff.
+            raise ValueError(
+                "multi-actor fragment moves need vnode-sliced state "
+                "handoff (not implemented yet)")
         if [s for _a, s in old] == list(to_slots):
             return
         # 1) stop the WHOLE job at a barrier (keep state + catalog)
-        stop = frozenset(set(job.actor_ids())
-                         | {_PSEUDO_BASE + k for k in range(self.n)})
         await self.loop.inject_and_collect(
-            force_checkpoint=True, mutation=StopMutation(stop))
+            force_checkpoint=True,
+            mutation=StopMutation(self._stop_set(job)))
         # the stop barrier's epoch is committed on the COORDINATOR but
         # its commit decision hasn't reached the workers (it pipelines
         # on the next inject) — push it now, or the handoff scan would
@@ -286,20 +309,32 @@ class Cluster:
         floor = self.store.committed_epoch()
         for c in self.clients:
             await c.call({"cmd": "recover_store", "epoch": floor})
-        # 2) ship the moved actors' state tables between namespaces
+        # 2) ship the moved actors' state tables between namespaces.
+        # Ingest epochs stay ABOVE the last injected barrier (other
+        # jobs hold buffered flushes at that epoch; sealing it out from
+        # under them would fail their next commit), and the barrier
+        # loop then reserves past the handoff epochs.
+        min_epoch = (self.loop._epoch.value
+                     if self.loop._epoch is not None else 0)
+        handoff_max = 0
         table_ids = _fragment_table_ids(frag)
         for (aid, from_slot), to_slot in zip(old, to_slots):
             if from_slot == to_slot:
                 continue
             for tid in table_ids:
                 rows = await self.clients[from_slot].scan_table(tid)
-                # ship tombstones for the source rows? no — the whole
-                # table moves; the old namespace's copy is dropped so
-                # stale reads cannot resurrect it
+                # the whole table moves; the old namespace's copy is
+                # tombstoned so stale reads cannot resurrect it
                 if rows:
-                    await self.clients[to_slot].ingest_table(tid, rows)
-                    await self.clients[from_slot].ingest_table(
-                        tid, [(k, None) for k, _v in rows])
+                    r1 = await self.clients[to_slot].ingest_table(
+                        tid, rows, min_epoch=min_epoch)
+                    r2 = await self.clients[from_slot].ingest_table(
+                        tid, [(k, None) for k, _v in rows],
+                        min_epoch=min_epoch)
+                    handoff_max = max(handoff_max, int(r1["epoch"]),
+                                      int(r2["epoch"]))
+        if handoff_max:
+            self.loop.advance_epoch_to(handoff_max)
         # 3) redeploy every fragment with the new placement (actor ids
         # are fresh — the stopped ones are gone from the workers)
         job.placements[frag_idx] = [

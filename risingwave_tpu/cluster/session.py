@@ -158,9 +158,10 @@ class DistFrontend:
     async def _select(self, sel: ast.Select) -> Rows:
         from risingwave_tpu.batch import collect
 
+        import asyncio
         view = ClusterStoreView(self.cluster)
-        for tid in self._referenced_table_ids(sel):
-            await view.prefetch(tid)
+        await asyncio.gather(*(view.prefetch(tid)
+                               for tid in self._referenced_table_ids(sel)))
         ex = plan_batch(sel, self.catalog, view,
                         view.committed_epoch())
         self.last_select_schema = ex.schema

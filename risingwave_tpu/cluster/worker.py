@@ -257,8 +257,12 @@ class WorkerServer:
         batch = [(bytes.fromhex(k),
                   None if r is None else decode_row(bytes.fromhex(r)))
                  for k, r in cmd["rows"]]
+        # min_epoch: the coordinator's last-injected epoch — sealing
+        # at or below an in-flight barrier's curr would make OTHER
+        # jobs' buffered flushes at that epoch fail the sealed guard
         epoch = max(self.store.committed_epoch(),
-                    getattr(self.store, "_sealed_epoch", 0)) + 1
+                    getattr(self.store, "_sealed_epoch", 0),
+                    int(cmd.get("min_epoch") or 0)) + 1
         self.store.ingest_batch(tid, batch, epoch)
         self.store.seal_epoch(epoch, True)
         self.store.sync(epoch)
@@ -297,12 +301,15 @@ class WorkerServer:
         if getattr(self.store, "two_phase", False):
             # the coordinator's commit decision rides on this barrier
             # (HummockManager::commit_epoch pipelined one barrier
-            # behind); absent — a legacy driver — self-commit through
-            # the sealed epoch, which degrades to the direct mode
+            # behind). Absent — a legacy driver — self-commit through
+            # the epoch just SYNCED, and only on checkpoint barriers:
+            # committing a merely-sealed epoch would write a durable
+            # version that claims data still sitting in the imms
             committed = cmd.get("committed")
-            self.store.commit_through(
-                pair.prev.value if committed is None
-                else int(committed))
+            if committed is not None:
+                self.store.commit_through(int(committed))
+            elif kind.is_checkpoint:
+                self.store.commit_through(pair.prev.value)
         # stopped actors are gone after this barrier
         if isinstance(mutation, StopMutation):
             for aid in list(self.actors):

@@ -49,6 +49,22 @@ def next_pow2(n: int, floor: int = 8) -> int:
         c <<= 1
     return c
 
+def presize_cap(n: int, floor: int = 1 << 16, ceil: int = 1 << 20) -> int:
+    """Kernel table capacity for a KNOWN cardinality: pow2 with 2x load
+    headroom, clamped. Every growth doubling costs a device rehash plus
+    a fresh XLA compile of the per-shape programs — a builder that
+    knows its scale should skip the whole ladder."""
+    return min(next_pow2(max(2 * n, floor)), ceil)
+
+
+def presize_flush_cap(n: int, floor: int = 1 << 14,
+                      ceil: int = 1 << 17) -> int:
+    """Flush gather-buffer rows for a KNOWN dirty-group bound (same
+    compile-ladder argument as presize_cap; the gather cost scales with
+    the buffer, hence the lower ceiling)."""
+    return min(next_pow2(max(n, floor)), ceil)
+
+
 
 class Op(enum.IntEnum):
     """Row operation in a stream chunk (stream_chunk.rs:29-ish semantics)."""

@@ -101,6 +101,9 @@ class FileLogSplitReader:
         self.parser: RowParser = make_parser(fmt, schema, options)
         self.max_chunk_size = int(max_chunk_size)
         self.offset = int(offset)
+        # exact emitted-row counter: the offset is BYTES (the recovery
+        # cursor); throughput accounting needs rows
+        self.rows_read = 0
 
     @property
     def split_id(self) -> str:
@@ -133,6 +136,7 @@ class FileLogSplitReader:
         # advance past malformed records too (they are counted by the
         # parser) — re-reading them forever would wedge the split
         self.offset += consumed
+        self.rows_read += chunk.cardinality()
         return chunk
 
 

@@ -112,6 +112,9 @@ def _source_reader(src: SourceCatalog):
             min_event_gap_in_ns=int(
                 opts.get("nexmark.min.event.gap.in.ns", 100_000)),
             seed=int(opts.get("nexmark.seed", 0x5EED0)),
+            generate_strings=str(opts.get(
+                "nexmark.generate.strings", "true")).lower()
+            not in ("false", "0"),
         )
         return NexmarkSplitReader(cfg)
     if connector == "datagen":

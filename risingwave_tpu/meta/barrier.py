@@ -164,6 +164,14 @@ class BarrierLoop:
         await self.local.send_barrier(barrier)
         return barrier
 
+    def advance_epoch_to(self, value: int) -> None:
+        """Reserve every epoch ≤ `value` (out-of-band bulk ingest, e.g.
+        reschedule state handoff): the next barrier's curr will exceed
+        it, so no in-flight flush can collide with the reserved epoch."""
+        assert not self._in_flight, "advance with barriers in flight"
+        if self._epoch is None or self._epoch.value < value:
+            self._epoch = Epoch(value)
+
     async def collect_next(self) -> Barrier:
         """Await the oldest in-flight epoch; commit it to the store."""
         assert self._in_flight, "nothing in flight"

@@ -186,10 +186,17 @@ def build_q8(store, cfg_p: NexmarkConfig, cfg_a: NexmarkConfig,
         names=["seller", "starttime"])
     calls = [AggCall(AggKind.COUNT)]
     agg_sch, agg_pk = agg_state_schema(a_proj.schema, [0, 1], calls)
+    # capacity presize from the KNOWN nexmark cardinalities (see
+    # common/chunk.presize_cap — growth doublings compile mid-run)
+    from risingwave_tpu.common.chunk import presize_cap, presize_flush_cap
+    n_p = max(cfg_p.event_num // 50, 1)
+    n_a = max(cfg_a.event_num * 3 // 50, 1)
     a_dedup = HashAggExecutor(
         a_proj, [0, 1], calls,
         StateTable(3, agg_sch, agg_pk, store, dist_key_indices=[0]),
-        append_only=True, output_names=["seller", "starttime", "_cnt"])
+        append_only=True, output_names=["seller", "starttime", "_cnt"],
+        kernel_capacity=presize_cap(n_a, 1 << 18),
+        flush_capacity=presize_flush_cap(n_a))
     a_dedup_proj = ProjectExecutor(
         a_dedup,
         exprs=[InputRef(0, DataType.INT64),
@@ -198,9 +205,15 @@ def build_q8(store, cfg_p: NexmarkConfig, cfg_a: NexmarkConfig,
     lt = StateTable(4, p_proj.schema, [0, 2], store, dist_key_indices=[0])
     rt = StateTable(5, a_dedup_proj.schema, [0, 1], store,
                     dist_key_indices=[0])
+    join_opts = None if mesh is not None else {
+        "key_capacity": presize_cap(max(n_p, n_a)),
+        "row_capacity": presize_cap(max(n_p, n_a)),
+        "probe_capacity": 1 << 16,
+    }
     join = HashJoinExecutor(p_proj, a_dedup_proj,
                             left_keys=[0, 2], right_keys=[0, 1],
-                            left_table=lt, right_table=rt, mesh=mesh)
+                            left_table=lt, right_table=rt, mesh=mesh,
+                            shard_opts=join_opts)
     out = ProjectExecutor(
         join,
         exprs=[InputRef(0, DataType.INT64),

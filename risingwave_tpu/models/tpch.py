@@ -75,6 +75,16 @@ def build_q3(store, customers: int = 300, orders: int = 3000,
     line, line_r = _src(local, store, 3, mk("lineitem"), 3,
                         rate_limit, min_chunks)
 
+    # capacity presize from KNOWN tpch cardinalities (see
+    # common/chunk.presize_cap — growth doublings compile mid-run)
+    from risingwave_tpu.common.chunk import presize_cap, presize_flush_cap
+    from risingwave_tpu.connectors.tpch import LINES_PER_ORDER
+
+    n_line = orders * LINES_PER_ORDER
+    j_opts = {"key_capacity": presize_cap(n_line),
+              "row_capacity": presize_cap(n_line),
+              "probe_capacity": 1 << 16}
+
     cs = cust.schema
     c_f = RowIdGenExecutor(FilterExecutor(
         cust, InputRef(cs.index_of("c_mktsegment"), DataType.VARCHAR)
@@ -96,7 +106,7 @@ def build_q3(store, customers: int = 300, orders: int = 3000,
         c_f, o_f,
         left_keys=[c_f.schema.index_of("c_custkey")],
         right_keys=[o_f.schema.index_of("o_custkey")],
-        left_table=j1_lt, right_table=j1_rt)
+        left_table=j1_lt, right_table=j1_rt, shard_opts=j_opts)
 
     # join 2: (customer ⋈ orders) ⋈ lineitem on orderkey
     j1_pk = list(j1.pk_indices)
@@ -106,7 +116,7 @@ def build_q3(store, customers: int = 300, orders: int = 3000,
         j1, l_f,
         left_keys=[j1.schema.index_of("o_orderkey")],
         right_keys=[l_f.schema.index_of("l_orderkey")],
-        left_table=j2_lt, right_table=j2_rt)
+        left_table=j2_lt, right_table=j2_rt, shard_opts=j_opts)
 
     js = j2.schema
     revenue = (InputRef(js.index_of("l_extendedprice"), DataType.DECIMAL)
@@ -129,7 +139,9 @@ def build_q3(store, customers: int = 300, orders: int = 3000,
                    dist_key_indices=[0]),
         append_only=True,
         output_names=["l_orderkey", "o_orderdate", "o_shippriority",
-                      "revenue"])
+                      "revenue"],
+        kernel_capacity=presize_cap(orders, 1 << 18),
+        flush_capacity=presize_flush_cap(orders))
 
     topn_state = StateTable(9, agg.schema, [0, 1, 2], store)
     topn = GroupTopNExecutor(

@@ -677,7 +677,10 @@ def _rebuild_live(state: AggState, live: jnp.ndarray, new_cap: int,
     return new_state, n_live
 
 
-_I32_SIGN_FLIP = jnp.int32(-0x80000000)
+# int constant, NOT jnp.int32: a module-level jnp scalar initializes
+# the JAX backend at IMPORT — and a plan-only process (the distributed
+# frontend) must never touch the TPU. XLA folds the Python int the same.
+_I32_SIGN_FLIP = -0x80000000
 
 
 def retire_state(state: AggState, wm_hi, wm_lo, lane_off: int,

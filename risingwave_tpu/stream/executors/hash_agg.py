@@ -194,7 +194,9 @@ class HashAggExecutor(Executor):
                  minput_tables: Optional[Dict[int, StateTable]] = None,
                  actor_id: int = 0,
                  kernel: Optional[object] = None,
-                 distinct_tables: Optional[Dict[int, StateTable]] = None):
+                 distinct_tables: Optional[Dict[int, StateTable]] = None,
+                 kernel_capacity: Optional[int] = None,
+                 flush_capacity: Optional[int] = None):
         self.input = input_
         self.group_indices = list(group_indices)
         self.agg_calls = list(agg_calls)
@@ -284,9 +286,19 @@ class HashAggExecutor(Executor):
         # (parallel/agg.ShardedAggKernel) when parallelism > 1 — same
         # host surface, SPMD launch shape (dispatch.rs:582's hash
         # exchange becomes the in-kernel all_to_all)
-        self.kernel = kernel if kernel is not None else GroupedAggKernel(
-            key_width=_LANES_PER_KEY * len(self.group_indices),
-            specs=self.specs)
+        # capacity/flush presize: growth doublings and flush-buffer
+        # bumps each cost a fresh XLA compile — builders that know
+        # their cardinality pass hints and skip the ladder entirely.
+        # Construction is LAZY (first data touch): building device
+        # state here would initialize the JAX backend — and claim the
+        # TPU — in processes that only PLAN (the distributed frontend
+        # serializes this executor to IR and throws it away)
+        self._kern_kw = {}
+        if kernel_capacity is not None:
+            self._kern_kw["capacity"] = kernel_capacity
+        if flush_capacity is not None:
+            self._kern_kw["flush_capacity"] = flush_capacity
+        self._kernel = kernel
         # watermark-driven state cleaning (state_table.rs:894 analog):
         # latest watermark seen on the FIRST group column (the state
         # tables' pk prefix — the only position a range delete covers,
@@ -299,6 +311,20 @@ class HashAggExecutor(Executor):
         super().__init__(ExecutorInfo(
             out_schema, list(range(len(group_indices))),
             f"HashAggExecutor(actor={actor_id})"))
+
+    @property
+    def kernel(self):
+        """Device kernel, built on first touch (see __init__ note —
+        plan-only processes must not initialize a JAX backend)."""
+        if self._kernel is None:
+            self._kernel = GroupedAggKernel(
+                key_width=_LANES_PER_KEY * len(self.group_indices),
+                specs=self.specs, **self._kern_kw)
+        return self._kernel
+
+    @kernel.setter
+    def kernel(self, k) -> None:
+        self._kernel = k
 
     # -- chunk path ------------------------------------------------------
     def _inputs(self, chunk: StreamChunk) -> Tuple:

@@ -186,14 +186,13 @@ class _JoinSide:
         # interned ids or varchar keys would never match
         self.key_codec = key_codec
         self.table = table
-        if mesh is not None:
-            from risingwave_tpu.parallel.join import ShardedJoinKernel
-            self.kernel = ShardedJoinKernel(
-                mesh, key_width=LANES_PER_KEY * len(self.key_indices),
-                **(shard_opts or {}))
-        else:
-            self.kernel = JoinSideKernel(
-                key_width=LANES_PER_KEY * len(self.key_indices))
+        # device kernel is built LAZILY (first data touch): building it
+        # here would initialize the JAX backend — and claim the TPU —
+        # in processes that only PLAN (the distributed frontend
+        # serializes the executor tree to IR and discards it)
+        self._mesh = mesh
+        self._shard_opts = dict(shard_opts or {})
+        self._kernel = None
         self.arena = _Arena(schema)
         self.pk_to_ref: Dict[tuple, int] = {}
         self.free: List[int] = []
@@ -201,6 +200,29 @@ class _JoinSide:
         # per-ref match degree (outer/semi/anti bookkeeping; see
         # JoinType docstring) — grown alongside the arena
         self.degrees = np.zeros(self.arena.cap, dtype=np.int64)
+
+    @property
+    def kernel(self):
+        if self._kernel is None:
+            if self._mesh is not None:
+                from risingwave_tpu.parallel.join import ShardedJoinKernel
+                self._kernel = ShardedJoinKernel(
+                    self._mesh,
+                    key_width=LANES_PER_KEY * len(self.key_indices),
+                    **self._shard_opts)
+            else:
+                # capacity presize hints ride in shard_opts for the
+                # single-chip kernel too: every growth doubling costs
+                # a rehash + a fresh XLA trace/compile of the epoch
+                # programs, so a builder that knows its cardinality
+                # should say so
+                opts = {k: v for k, v in self._shard_opts.items()
+                        if k in ("key_capacity", "row_capacity",
+                                 "probe_capacity")}
+                self._kernel = JoinSideKernel(
+                    key_width=LANES_PER_KEY * len(self.key_indices),
+                    **opts)
+        return self._kernel
 
     def ensure_degrees(self, max_ref: int) -> None:
         if max_ref < len(self.degrees):
@@ -501,8 +523,9 @@ class HashJoinExecutor(Executor):
         # side at the barrier — through the tunnel, per-barrier
         # transfer count bounds throughput (ops/hash_join.py AUX_*).
         # The sharded kernel keeps the per-chunk dispatch path.
-        self._epoch_batch = isinstance(self.sides[0].kernel,
-                                       JoinSideKernel)
+        # derived WITHOUT touching .kernel: the lazy property exists so
+        # plan-only processes never build device state
+        self._epoch_batch = self.sides[0]._mesh is None
         self._epoch_buf: tuple = ([], [])
         self._epoch_rows = [0, 0]
         # host-state accounting (memory_manager.rs analog): weakref so

@@ -123,9 +123,29 @@ class GroupTopNExecutor(Executor):
                             [False] * (len(self._sort_cols)
                                        - len(self.order_by)))
         self.groups: Dict[tuple, _SortedRows] = {}
+        # fast-key eligibility: native tuples compare in C (an order of
+        # magnitude over _Key.__lt__'s per-column Python loop — the q5
+        # bench's single hottest path); DESC needs numeric negation, so
+        # any DESC column with a non-numeric physical type falls back
+        from risingwave_tpu.common.types import DataType
+        numeric = {DataType.INT16, DataType.INT32, DataType.INT64,
+                   DataType.SERIAL, DataType.DECIMAL, DataType.DATE,
+                   DataType.TIME, DataType.TIMESTAMP,
+                   DataType.TIMESTAMPTZ, DataType.FLOAT32,
+                   DataType.FLOAT64, DataType.BOOLEAN}
+        self._fast_keys = all(
+            (not d) or input_.schema[i].data_type in numeric
+            for i, d in zip(self._sort_cols, self._descs))
 
     # -- helpers ---------------------------------------------------------
-    def _key_of(self, row: tuple) -> _Key:
+    def _key_of(self, row: tuple):
+        if self._fast_keys:
+            # per-column (null_rank, value) pairs; physical rows make
+            # every DESC value negatable. NULLS LAST asc / FIRST desc.
+            return tuple(
+                ((1, 0) if not d else (-1, 0)) if row[i] is None
+                else (0, -row[i] if d else row[i])
+                for i, d in zip(self._sort_cols, self._descs))
         return _Key(tuple(row[i] for i in self._sort_cols), self._descs)
 
     def _group_of(self, row: tuple) -> tuple:
@@ -148,6 +168,11 @@ class GroupTopNExecutor(Executor):
     def _apply(self, chunk: StreamChunk) -> Optional[StreamChunk]:
         touched: Dict[tuple, List[tuple]] = {}
         _idx, prows, pops = chunk.to_physical_records()
+        # state writes batch as ONE vectorized chunk apply (the same
+        # insert/delete multiset the loop below maintains in memory) —
+        # a per-row insert() pays a full pk encode each (the other q5
+        # hot path); only append-only truncation drops need row calls
+        self.state.write_chunk(chunk)
         for op_i, row in zip(pops.tolist(), prows):
             is_ins = Op(op_i).is_insert
             g = self._group_of(row)
@@ -157,7 +182,6 @@ class GroupTopNExecutor(Executor):
             key = self._key_of(row)
             if is_ins:
                 rows.insert(key, row)
-                self.state.insert(row)
                 if self.append_only and self.limit is not None:
                     for dropped in rows.truncate_beyond(
                             self.offset + self.limit):
@@ -167,7 +191,6 @@ class GroupTopNExecutor(Executor):
                     raise ValueError(
                         "delete on append-only TopN input")
                 rows.delete(key, row)
-                self.state.delete(row)
         # net window delta per touched group
         deletes: List[tuple] = []
         inserts: List[tuple] = []

@@ -66,13 +66,24 @@ def fetch(*arrays, poll_s: float = 0.002) -> List[np.ndarray]:
     bare blocking read over the tunnel occasionally degrades to a
     multi-second wait quantum), then materializes. Host numpy arrays
     pass through untouched.
+
+    The wait ladders: GIL-yield spins first (XLA host compute lands in
+    µs — a fixed 2ms quantum was the q8 hot path's single biggest cost
+    on CPU), then sub-ms naps, then the tunnel-friendly `poll_s`.
     """
     import time
 
     start_fetch(*arrays)
     pending = _not_ready(arrays)
+    spins = 0
     while pending:
-        time.sleep(poll_s)
+        if spins < 50:
+            time.sleep(0)              # yield the GIL; compute threads run
+        elif spins < 80:
+            time.sleep(0.0002)
+        else:
+            time.sleep(poll_s)
+        spins += 1
         pending = _not_ready(pending)
     return [np.asarray(a) for a in arrays]
 
@@ -83,13 +94,17 @@ def fetch1(array) -> np.ndarray:
 
 async def fetch_async(*arrays, poll_s: float = 0.001) -> List[np.ndarray]:
     """fetch() that yields to the event loop during the wait, so
-    barrier/actor coroutines keep flowing during the DMA."""
+    barrier/actor coroutines keep flowing during the DMA. Same wait
+    ladder as fetch(): zero-delay yields first (they still run other
+    ready coroutines), timed naps only once the wait is clearly long."""
     import asyncio
 
     start_fetch(*arrays)
     pending = _not_ready(arrays)
+    spins = 0
     while pending:
-        await asyncio.sleep(poll_s)
+        await asyncio.sleep(0 if spins < 50 else poll_s)
+        spins += 1
         pending = _not_ready(pending)
     return [np.asarray(a) for a in arrays]
 
