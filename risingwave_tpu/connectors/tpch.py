@@ -40,7 +40,14 @@ LINEITEM_SCHEMA = Schema([
     Field("l_discount", DataType.DECIMAL),
     Field("l_shipdate", DataType.DATE),
     Field("l_suppkey", DataType.INT64),
+    Field("l_quantity", DataType.INT64),
+    Field("l_tax", DataType.DECIMAL),
+    Field("l_returnflag", DataType.VARCHAR),
+    Field("l_linestatus", DataType.VARCHAR),
 ])
+
+_RETURNFLAGS = np.array(["R", "A", "N"], dtype=object)
+_LINESTATUS = np.array(["O", "F"], dtype=object)
 
 TABLE_SCHEMAS = {
     "customer": CUSTOMER_SCHEMA,
@@ -125,6 +132,12 @@ def gen_lineitem(k: np.ndarray, cfg: TpchConfig) -> Dict[str, np.ndarray]:
         "l_discount": discount_pct * 100,         # 0.00..0.10 scaled
         "l_shipdate": (odate + 1 + ship_delay).astype(np.int32),
         "l_suppkey": (_mix(k, cfg.seed + 8) % 100).astype(np.int64) + 1,
+        "l_quantity": (_mix(k, cfg.seed + 9) % 50).astype(np.int64) + 1,
+        "l_tax": (_mix(k, cfg.seed + 10) % 9).astype(np.int64) * 100,
+        "l_returnflag": _RETURNFLAGS[
+            (_mix(k, cfg.seed + 11) % 3).astype(np.int64)],
+        "l_linestatus": _LINESTATUS[
+            (_mix(k, cfg.seed + 12) % 2).astype(np.int64)],
     }
 
 

@@ -447,14 +447,12 @@ class StreamPlanner:
             # HashJoins in syntax order, with WHERE conjuncts pushed to
             # the lowest side whose scope binds them — below the first
             # join when possible, else right after the join that first
-            # covers their columns. Joins of append-only sources emit no
-            # retractions, so chained join state stays row-id keyed.
-            if ex.pk_indices:
-                raise PlanError(
-                    "JOIN over an MV not supported yet (a fresh row id "
-                    "per retraction half would corrupt join state)")
-            left: Executor = RowIdGenExecutor(ex)
-            lscope = Scope(left.schema, scope.qualifiers + [None])
+            # covers their columns. Append-only sides get a generated
+            # row id; pk-keyed sides (MV chains, derived tables with
+            # GROUP BY) keep their pk so retractions replay into join
+            # state consistently (the delta-join-over-arrangement
+            # stance, lookup.rs:42).
+            left, lscope = self._joinable(ex, scope)
             # build every right chain up front so the FULL scope exists
             # before any pushdown decision: a conjunct whose unqualified
             # column lives on both sides must raise 'ambiguous', not
@@ -482,13 +480,7 @@ class StreamPlanner:
                     rights.append((jn, rex, rscope))
                     full_scope = full_scope.concat(rscope)
                     continue
-                if rex.pk_indices:
-                    raise PlanError(
-                        "JOIN over an MV not supported yet (a fresh row "
-                        "id per retraction half would corrupt join "
-                        "state)")
-                right: Executor = RowIdGenExecutor(rex)
-                rscope = Scope(right.schema, rscope.qualifiers + [None])
+                right, rscope = self._joinable(rex, rscope)
                 rights.append((jn, right, rscope))
                 full_scope = full_scope.concat(rscope)
             for jn, right, rscope in rights:
@@ -573,8 +565,9 @@ class StreamPlanner:
             # keys ride along as hidden trailing columns (nexmark q4's
             # inner query groups by (id, category) but projects only
             # category — without the hidden id the change stream would
-            # collide distinct groups)
-            g = len(sel.group_by)
+            # collide distinct groups). Global aggs carry ONE synthetic
+            # constant key (set by _plan_agg).
+            g = self._agg_group_arity
             proj_of_group: Dict[int, int] = {}
             for pos, e in enumerate(out_exprs):
                 if isinstance(e, InputRef) and e.index < g \
@@ -643,6 +636,37 @@ class StreamPlanner:
             ex = self._plan_topn(ex, sel, pk,
                                  append_only=self._derive_append_only(ex))
         return ex, pk, deps, len(projections)
+
+    @staticmethod
+    def _joinable(ex: Executor, scope: Scope) -> Tuple[Executor, Scope]:
+        """Make one join input key-stable with a scope covering its
+        whole schema. A pk-less (append-only) chain gets a generated
+        row id; a pk-keyed input KEEPS its pk — retractions replay by
+        pk, so join state updates consistently. Hidden columns beyond
+        the bind scope are projected down to visible + pk so scope and
+        executor schema stay index-aligned (the join's output offsets
+        are schema offsets)."""
+        from risingwave_tpu.stream.executor import ExecutorInfo
+
+        if not ex.pk_indices:
+            ex2: Executor = RowIdGenExecutor(ex)
+            return ex2, Scope(ex2.schema, scope.qualifiers + [None])
+        n_vis = len(scope.schema)
+        if n_vis == len(ex.schema):
+            return ex, scope
+        keep_hidden = [i for i in ex.pk_indices if i >= n_vis]
+        exprs = [InputRef(i, ex.schema[i].data_type)
+                 for i in range(n_vis)]
+        names = [f.name for f in scope.schema]
+        for k, i in enumerate(keep_hidden):
+            exprs.append(InputRef(i, ex.schema[i].data_type))
+            names.append(f"_jpk{k}")
+        proj = ProjectExecutor(ex, exprs, names)
+        new_pk = [i if i < n_vis else n_vis + keep_hidden.index(i)
+                  for i in ex.pk_indices]
+        proj._info = ExecutorInfo(proj.schema, new_pk, proj.identity)
+        return proj, Scope(proj.schema,
+                           scope.qualifiers + [None] * len(keep_hidden))
 
     def _plan_topn(self, ex: Executor, sel: ast.Select,
                    pk: List[int], append_only: bool = False) -> Executor:
@@ -825,6 +849,16 @@ class StreamPlanner:
         these in LogicalAgg planning (logical_agg.rs)."""
         from risingwave_tpu.frontend.binder import PostAggBinder
         group_bound = [Binder(scope).bind(g) for g in sel.group_by]
+        if not group_bound:
+            # global aggregation: a synthetic constant group key routes
+            # it through the SAME hash-agg machinery — one real group,
+            # full retraction support (minput MIN/MAX, host aggs); the
+            # hidden-group-key logic keys the single-row MV by it.
+            # (simple_agg.rs covers the append-only fast path; the
+            # planner prefers the general one.)
+            from risingwave_tpu.expr.expr import Literal
+            group_bound = [Literal(0, DataType.INT32)]
+        self._agg_group_arity = len(group_bound)
         group_reprs = [repr(g) for g in group_bound]
         pab = PostAggBinder(binder, group_reprs)
         bound = [pab.bind(e) for e, _a in projections]

@@ -79,16 +79,20 @@ HOST_AGG_KINDS = (AggKind.STRING_AGG, AggKind.ARRAY_AGG)
 
 
 # -- HyperLogLog (approx_count_distinct) ----------------------------------
-# Reference parity: src/expr/src/aggregate/approx_count_distinct/ —
-# the reference keeps per-bucket counters; the TPU design keeps HLL_M
-# int32 REGISTERS as ordinary device accumulators, updated by
-# scatter-max (one masked scatter per register — branchless, batched).
-# m=16 registers → standard error 1.04/√16 ≈ 26%; registers pack into
-# two int64 host columns for exact state persistence/recovery.
-HLL_M = 16              # registers (power of two)
-HLL_B = 4               # index bits
+# Reference parity: src/expr/src/aggregate/approx_count_distinct/mod.rs
+# :35-42 — the reference keeps 2^16 buckets; this build keeps a DENSE
+# 2^14-register sketch per group (standard error 1.04/sqrt(2^14) ≈
+# 0.8%) maintained host-side on the executor's host-agg path (one
+# uint8 register array per group, vectorized scatter-max per chunk)
+# and persisted as one BYTEA row per group. The device kernel carries
+# only the dummy lane (grouping/dirtiness); a register file this wide
+# does not fit the per-call scalar-accumulator layout. 2^16 registers
+# matches the reference's bucket count (theirs are u64 counters —
+# 512KB/group; one byte per register keeps ours at 64KB).
+HLL_B = 16              # index bits
+HLL_M = 1 << HLL_B      # registers (16384)
 HLL_RHO_MAX = 65 - HLL_B
-HLL_ALPHA = 0.673       # bias constant for m=16
+HLL_ALPHA = 0.7213 / (1 + 1.079 / HLL_M)   # bias constant, m >= 128
 
 
 def _clz64(x: np.ndarray) -> np.ndarray:
@@ -122,52 +126,19 @@ def hll_lanes(v64: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     return reg, np.minimum(rho, HLL_RHO_MAX).astype(np.int32)
 
 
-def hll_estimate(regs: Sequence[np.ndarray]) -> np.ndarray:
-    """Per-group estimate from HLL_M register columns (int64)."""
+def hll_estimate_dense(mat: np.ndarray) -> np.ndarray:
+    """Estimates for stacked register files: (G, HLL_M) uint8 → int64
+    per group, with linear-counting small-range correction."""
+    mat = np.atleast_2d(mat)
     m = float(HLL_M)
-    inv = np.zeros(regs[0].shape, dtype=np.float64)
-    zeros = np.zeros(regs[0].shape, dtype=np.int64)
-    for r in regs:
-        inv += np.power(2.0, -r.astype(np.float64))
-        zeros += (r == 0)
+    inv = np.power(2.0, -mat.astype(np.float64)).sum(axis=1)
+    zeros = (mat == 0).sum(axis=1)
     e = HLL_ALPHA * m * m / inv
     small = (e <= 2.5 * m) & (zeros > 0)
     with np.errstate(divide="ignore"):
         lin = m * np.log(np.where(zeros > 0, m / np.maximum(zeros, 1),
                                   1.0))
     return np.where(small, lin, e).round().astype(np.int64)
-
-
-_HLL_PER_WORD = 64 // 6     # registers per packed int64
-assert HLL_M <= 2 * _HLL_PER_WORD, \
-    "HLL registers no longer fit two packed int64 state columns — " \
-    "extend host_acc_dtypes before retuning HLL_M"
-
-
-def hll_pack(regs: Sequence[np.ndarray]
-             ) -> Tuple[np.ndarray, np.ndarray]:
-    """HLL_M registers (6 bits each) → (lo, hi) int64 host columns."""
-    lo = np.zeros(regs[0].shape, dtype=np.uint64)
-    hi = np.zeros(regs[0].shape, dtype=np.uint64)
-    for i in range(min(_HLL_PER_WORD, HLL_M)):
-        lo |= regs[i].astype(np.uint64) << np.uint64(6 * i)
-    for i in range(_HLL_PER_WORD, HLL_M):
-        hi |= regs[i].astype(np.uint64) << np.uint64(
-            6 * (i - _HLL_PER_WORD))
-    return lo.view(np.int64), hi.view(np.int64)
-
-
-def hll_unpack(lo: np.ndarray, hi: np.ndarray) -> List[np.ndarray]:
-    lo = np.asarray(lo, dtype=np.int64).view(np.uint64)
-    hi = np.asarray(hi, dtype=np.int64).view(np.uint64)
-    out = []
-    mask = np.uint64(0x3F)
-    for i in range(min(_HLL_PER_WORD, HLL_M)):
-        out.append(((lo >> np.uint64(6 * i)) & mask).astype(np.int32))
-    for i in range(_HLL_PER_WORD, HLL_M):
-        out.append(((hi >> np.uint64(6 * (i - _HLL_PER_WORD))) & mask)
-                   .astype(np.int32))
-    return out
 
 
 @dataclass(frozen=True)
@@ -205,7 +176,7 @@ class AggSpec:
         if self.kind in HOST_AGG_KINDS:
             return [(i32, 0)]             # dummy lane (arity only)
         if self.kind == AggKind.APPROX_COUNT_DISTINCT:
-            return [(i32, 0)] * HLL_M     # one register per lane
+            return [(i32, 0)]   # dummy lane: the dense sketch is host
         if self.kind == AggKind.SUM:
             if self.is_float_sum:
                 return [(f32, 0.0), (f32, 0.0), (i32, 0)]
@@ -219,8 +190,7 @@ class AggSpec:
         if self.kind == AggKind.COUNT or self.kind in HOST_AGG_KINDS:
             return ()
         if self.kind == AggKind.APPROX_COUNT_DISTINCT:
-            from risingwave_tpu.stream.executors.keys import to_i64
-            return hll_lanes(to_i64(vals))
+            return ()           # sketch updates are host-side
         if self.kind == AggKind.SUM:
             if self.is_float_sum:
                 hi = vals.astype(np.float32)
@@ -245,8 +215,10 @@ class AggSpec:
             return (np.full(n, None, dtype=object),
                     np.ones(n, dtype=bool))
         if self.kind == AggKind.APPROX_COUNT_DISTINCT:
-            est = hll_estimate([c.astype(np.int64) for c in cols])
-            return est, np.zeros(est.shape, dtype=bool)
+            # placeholder: the executor overwrites from the host
+            # sketch registry at flush
+            n = len(cols[0])
+            return np.zeros(n, dtype=np.int64), np.ones(n, dtype=bool)
         nn = cols[-1]
         assert (nn >= 0).all(), \
             "non-null count wrapped int32 — a group exceeded 2^31 rows"
@@ -271,9 +243,9 @@ class AggSpec:
             # multiset; one placeholder keeps the row arity stable
             return [i64]
         if self.kind == AggKind.APPROX_COUNT_DISTINCT:
-            # packed registers only (exact recovery); the estimate is
-            # derivable and lives in the MV output, not the state row
-            return [i64, i64]
+            # nothing to persist here: the sketch lives in its own
+            # BYTEA aux table; one placeholder keeps row arity stable
+            return [i64]
         return [self.out_dtype, i64]
 
     def host_acc_cols(self, vals: np.ndarray, nulls: np.ndarray,
@@ -287,10 +259,7 @@ class AggSpec:
         if self.kind in HOST_AGG_KINDS:
             return [[0] * len(vals)]
         if self.kind == AggKind.APPROX_COUNT_DISTINCT:
-            assert raw_cols is not None, \
-                "HLL persistence needs the raw register columns"
-            lo, hi = hll_pack([c.astype(np.int64) for c in raw_cols])
-            return [lo.tolist(), hi.tolist()]
+            return [[0] * len(vals)]
         value_col = [None if bad else v
                      for v, bad in zip(vals.tolist(), nulls.tolist())]
         return [value_col, nn.tolist()]
@@ -303,7 +272,7 @@ class AggSpec:
         if self.kind in HOST_AGG_KINDS:
             return (host_cols[0].astype(np.int32),)   # dummy lane
         if self.kind == AggKind.APPROX_COUNT_DISTINCT:
-            return tuple(hll_unpack(host_cols[0], host_cols[1]))
+            return (host_cols[0].astype(np.int32),)   # dummy lane
         return self.encode_acc(host_cols[0], host_cols[1])
 
     def encode_acc(self, value: np.ndarray, nn: Optional[np.ndarray]
@@ -430,17 +399,7 @@ def _update_call(spec: AggSpec, accs: List[jnp.ndarray], sl: slice,
         accs[sl.start] = accs[sl.start].at[scat].add(sign, mode="drop")
         return
     if spec.kind == AggKind.APPROX_COUNT_DISTINCT:
-        # HLL: each row maxes its rho into ONE register — one masked
-        # scatter-max per register (HLL_M branchless device scatters).
-        # Deletes cannot retract a sketch: append-only is enforced at
-        # executor construction.
-        reg, rho = in_lanes
-        for r in range(HLL_M):
-            m = live & (reg == r)
-            s_r = jnp.where(m, slots, cap)
-            accs[sl.start + r] = accs[sl.start + r].at[s_r].max(
-                rho, mode="drop")
-        return
+        return          # dense sketch is host-side (see HLL_B above)
     nn_i = sl.stop - 1
     accs[nn_i] = accs[nn_i].at[scat].add(sign, mode="drop")
     if spec.kind == AggKind.SUM:

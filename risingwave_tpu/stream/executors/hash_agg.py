@@ -143,6 +143,19 @@ def minput_state_schema(input_schema: Schema,
     return Schema(fields), list(range(g + 1)), list(range(g))
 
 
+def hll_state_schema(input_schema: Schema,
+                     group_indices: Sequence[int]
+                     ) -> Tuple[Schema, List[int], List[int]]:
+    """Dense-HLL sketch table for ONE approx_count_distinct call:
+    (group keys..., _sketch BYTEA) — one packed register file per
+    group, upserted per barrier for dirty groups
+    (approx_count_distinct/mod.rs:35-42 parity, 2^16 registers)."""
+    fields = [input_schema[i] for i in group_indices]
+    fields.append(Field("_sketch", DataType.BYTEA))
+    g = len(group_indices)
+    return Schema(fields), list(range(g)), list(range(g))
+
+
 def agg_aux_tables(input_schema: Schema,
                    group_indices: Sequence[int],
                    agg_calls: Sequence["AggCall"], append_only: bool,
@@ -171,6 +184,15 @@ def agg_aux_tables(input_schema: Schema,
                 dedup_table_id(c.input_idx), dsch, dpk, store,
                 dist_key_indices=ddk)
     minput_tables: Dict[int, StateTable] = {}
+    for j, c in enumerate(agg_calls):
+        if c.kind == AggKind.APPROX_COUNT_DISTINCT:
+            hsch, hpk, hdk = hll_state_schema(input_schema,
+                                              group_indices)
+            # sanity off: sketch rows are blind upserts (same pk,
+            # newer epoch shadows)
+            minput_tables[j] = StateTable(
+                minput_table_id(j), hsch, hpk, store,
+                dist_key_indices=hdk, sanity_check=False)
     for j, c in enumerate(agg_calls):
         # retractable MIN/MAX need the value multiset; host aggs
         # (string_agg/array_agg) ARE their value multiset
@@ -255,9 +277,37 @@ class HashAggExecutor(Executor):
                            for m in s._distinct_mult.values())
             pend = sum(120 * len(m)
                        for m in s._minput_pending.values())
-            return s.key_codec.interner_nbytes() + distinct + pend
+            from risingwave_tpu.ops.hash_agg import HLL_M as _M
+            sketches = sum((_M + 120) * len(d)
+                           for d in s._hll_regs.values())
+            return (s.key_codec.interner_nbytes() + distinct + pend
+                    + sketches)
 
         _mem.GLOBAL.register(mem_name, _nbytes)
+        # dense-HLL calls: sketch registry host-side, one BYTEA aux
+        # table per call (transported in the minput dict by
+        # agg_aux_tables; split here — the multiset write paths must
+        # never touch a sketch table)
+        self._hll_calls = [j for j, s in enumerate(self.specs)
+                           if s.kind == AggKind.APPROX_COUNT_DISTINCT]
+        self.hll_tables: Dict[int, StateTable] = {
+            j: self.minput.pop(j) for j in self._hll_calls
+            if j in self.minput}
+        missing_s = [j for j in self._hll_calls
+                     if j not in self.hll_tables]
+        if missing_s:
+            raise ValueError(
+                "approx_count_distinct needs a sketch state table per "
+                f"call ({missing_s}) — pass minput_tables from "
+                "agg_aux_tables (hll_state_schema)")
+        # per-call: group tuple → uint8[HLL_M] registers; prev emitted
+        # estimate; groups dirty since the last barrier
+        self._hll_regs: Dict[int, Dict[tuple, np.ndarray]] = {
+            j: {} for j in self._hll_calls}
+        self._hll_prev: Dict[int, Dict[tuple, int]] = {
+            j: {} for j in self._hll_calls}
+        self._hll_dirty: Dict[int, set] = {
+            j: set() for j in self._hll_calls}
         # host aggs (string_agg/array_agg) always need the value
         # multiset — their output IS the multiset
         self._host_calls = [j for j, s in enumerate(self.specs)
@@ -356,6 +406,56 @@ class HashAggExecutor(Executor):
             for j in js:
                 inputs[j] = (inputs[j][0], mask)
         self.kernel.apply(key_lanes, signs, vis, tuple(inputs))
+        for j in self._hll_calls:
+            self._apply_hll(j, chunk, key_lanes, signs, vis)
+
+    def _apply_hll(self, j: int, chunk: StreamChunk,
+                   key_lanes: np.ndarray, signs: np.ndarray,
+                   vis: np.ndarray) -> None:
+        """Scatter-max this chunk's rows into the per-group dense
+        register files (vectorized; python work is O(groups in
+        chunk))."""
+        from risingwave_tpu.ops.hash_agg import hll_lanes
+        from risingwave_tpu.stream.executors.keys import to_i64
+
+        call = self.agg_calls[j]
+        c = chunk.columns[call.input_idx]
+        ok = vis if c.validity is None \
+            else (vis & np.asarray(c.validity))
+        rows = np.flatnonzero(ok)
+        if not len(rows):
+            return
+        if (signs[rows] < 0).any():
+            raise ValueError(
+                "approx_count_distinct saw a retraction — the sketch "
+                "is append-only (guarded at construction)")
+        reg, rho = hll_lanes(to_i64(np.asarray(c.values)[rows]))
+        rho8 = rho.astype(np.uint8)
+        _uniq, inverse = np.unique(key_lanes[rows], axis=0,
+                                   return_inverse=True)
+        order = np.argsort(inverse, kind="stable")
+        starts = np.searchsorted(inverse[order],
+                                 np.arange(len(_uniq), dtype=np.int64))
+        ends = np.append(starts[1:], len(order))
+        g_cols = [(np.asarray(chunk.columns[i].values),
+                   None if chunk.columns[i].validity is None
+                   else np.asarray(chunk.columns[i].validity))
+                  for i in self.group_indices]
+        regs_d, dirty = self._hll_regs[j], self._hll_dirty[j]
+        from risingwave_tpu.ops.hash_agg import HLL_M
+        for u in range(len(_uniq)):
+            r0 = int(rows[order[starts[u]]])
+            gkey = tuple(
+                None if (okc is not None and not okc[r0])
+                else (gv[r0].item() if hasattr(gv[r0], "item")
+                      else gv[r0])
+                for gv, okc in g_cols)
+            arr = regs_d.get(gkey)
+            if arr is None:
+                arr = regs_d[gkey] = np.zeros(HLL_M, dtype=np.uint8)
+            sel = order[starts[u]:ends[u]]
+            np.maximum.at(arr, reg[sel], rho8[sel])
+            dirty.add(gkey)
 
     # -- per-(group, value) multisets (minput + distinct) ----------------
     def _multiset_groups(self, chunk: StreamChunk, key_lanes: np.ndarray,
@@ -552,6 +652,14 @@ class HashAggExecutor(Executor):
                 self._distinct_mult[col] = {
                     k: v for k, v in mult.items()
                     if k[0] is None or k[0] >= phys}
+        for j, t in self.hll_tables.items():
+            t.delete_below_prefix(phys)
+            self._hll_regs[j] = {
+                k: v for k, v in self._hll_regs[j].items()
+                if k[0] is None or k[0] >= phys}
+            self._hll_prev[j] = {
+                k: v for k, v in self._hll_prev[j].items()
+                if k[0] is None or k[0] >= phys}
         self._cleaned_wm = wm
         _METRICS.agg_rows_cleaned.inc(n, executor=self.identity)
 
@@ -619,6 +727,9 @@ class HashAggExecutor(Executor):
             for j in self._host_calls:
                 fr.prev_outs[j], fr.prev_nulls[j] = host_prev[j]
                 fr.outs[j], fr.nulls[j] = host_new[j]
+        if self._hll_calls:
+            self._overwrite_hll_outputs(fr, gk)
+            self._persist_hll_dirty()
         self._deleted_lanes.clear()
         outs, nulls = fr.outs, fr.nulls
         pouts, pnulls = fr.prev_outs, fr.prev_nulls
@@ -678,6 +789,40 @@ class HashAggExecutor(Executor):
         vis[:t] = True
         return StreamChunk(self.schema, columns, vis, ops)
 
+    def _overwrite_hll_outputs(self, fr, gk) -> None:
+        """Replace the placeholder approx outputs with estimates from
+        the dense sketches (and exact prev estimates for update
+        pairs)."""
+        from risingwave_tpu.ops.hash_agg import HLL_M, hll_estimate_dense
+
+        gkeys = [tuple(
+            None if not ok[r]
+            else (vals[r].item() if hasattr(vals[r], "item")
+                  else vals[r])          # interned VARCHAR keys decode
+            for vals, ok in gk)          # to plain python strings
+                 for r in range(fr.n)]
+        for j in self._hll_calls:
+            regs_d, prev_d = self._hll_regs[j], self._hll_prev[j]
+            empty = np.zeros(HLL_M, dtype=np.uint8)
+            mat = np.stack([regs_d.get(g, empty) for g in gkeys])
+            ests = hll_estimate_dense(mat)
+            for r, g in enumerate(gkeys):
+                prev = prev_d.get(g)
+                fr.outs[j][r] = ests[r]
+                fr.nulls[j][r] = False
+                fr.prev_outs[j][r] = 0 if prev is None else prev
+                fr.prev_nulls[j][r] = prev is None
+                prev_d[g] = int(ests[r])
+
+    def _persist_hll_dirty(self) -> None:
+        """Upsert dirty register files (one BYTEA row per group; the
+        sketch table is sanity-off so same-pk rewrites shadow)."""
+        for j in self._hll_calls:
+            table, regs_d = self.hll_tables[j], self._hll_regs[j]
+            for gkey in self._hll_dirty[j]:
+                table.insert(gkey + (regs_d[gkey].tobytes(),))
+            self._hll_dirty[j].clear()
+
     def _recompute_extremes(self, fr, gk) -> None:
         """Correct stale device MIN/MAX for groups that saw deletes by
         scanning their surviving value multiset, then patch the device
@@ -688,7 +833,9 @@ class HashAggExecutor(Executor):
             return
         for r in need:
             group = tuple(
-                None if not ok[r] else vals[r].item()
+                None if not ok[r]
+                else (vals[r].item() if hasattr(vals[r], "item")
+                      else vals[r])
                 for vals, ok in gk)
             for j, table in self.minput.items():
                 if self.specs[j].kind in HOST_AGG_KINDS:
@@ -811,6 +958,17 @@ class HashAggExecutor(Executor):
         self.table.init_epoch(first.epoch)
         for t in self.minput.values():
             t.init_epoch(first.epoch)
+        from risingwave_tpu.ops.hash_agg import hll_estimate_dense
+        for j, t in self.hll_tables.items():
+            t.init_epoch(first.epoch)
+            for _pk, row in t.iter_rows():
+                gkey = tuple(row[:-1])
+                arr = np.frombuffer(row[-1], dtype=np.uint8).copy()
+                self._hll_regs[j][gkey] = arr
+                # emitted outputs were committed with this sketch —
+                # prev estimates must match them exactly
+                self._hll_prev[j][gkey] = int(
+                    hll_estimate_dense(arr)[0])
         for col, t in self.distinct_tables.items():
             t.init_epoch(first.epoch)
             mult = {}
@@ -830,6 +988,8 @@ class HashAggExecutor(Executor):
                     self._maybe_gc_interner()
                     self.table.commit(msg.epoch)
                     for t in self.minput.values():
+                        t.commit(msg.epoch)
+                    for t in self.hll_tables.values():
                         t.commit(msg.epoch)
                     for t in self.distinct_tables.values():
                         t.commit(msg.epoch)

@@ -536,30 +536,24 @@ def _state_rows_of(table):
 # -- approx_count_distinct (HyperLogLog) ----------------------------------
 
 
-def test_hll_primitives_roundtrip_and_accuracy():
+def test_hll_primitives_dense_accuracy():
+    """Dense 2^14-register sketch (VERDICT r4 #8): error < 2% at 1M
+    distinct keys (standard error 1.04/sqrt(2^14) ≈ 0.8%), and the
+    small-range linear-counting correction stays tight."""
     from risingwave_tpu.ops.hash_agg import (
-        HLL_M, _clz64, hll_estimate, hll_lanes, hll_pack, hll_unpack,
+        HLL_M, _clz64, hll_estimate_dense, hll_lanes,
     )
 
+    assert HLL_M >= 1 << 14
     assert _clz64(np.asarray([1], np.uint64))[0] == 63
     assert _clz64(np.asarray([0], np.uint64))[0] == 64
     assert _clz64(np.asarray([1 << 63], np.uint64))[0] == 0
-    rng = np.random.default_rng(0)
-    regs = [rng.integers(0, 62, 50).astype(np.int64)
-            for _ in range(HLL_M)]
-    lo, hi = hll_pack(regs)
-    for a, b in zip(regs, hll_unpack(lo, hi)):
-        assert (a == b.astype(np.int64)).all()
-    # estimates within ~2.5 standard errors (1.04/sqrt(16) ≈ 26%)
-    for n in (1000, 50_000):
+    for n, tol in ((100, 0.05), (10_000, 0.03), (1_000_000, 0.02)):
         reg, rho = hll_lanes(np.arange(n, dtype=np.int64))
-        R = [np.zeros(1, np.int64) for _ in range(HLL_M)]
-        for r in range(HLL_M):
-            sel = rho[reg == r]
-            if len(sel):
-                R[r][0] = sel.max()
-        est = int(hll_estimate(R)[0])
-        assert abs(est - n) / n < 0.65, (n, est)
+        arr = np.zeros(HLL_M, dtype=np.uint8)
+        np.maximum.at(arr, reg, rho.astype(np.uint8))
+        est = int(hll_estimate_dense(arr)[0])
+        assert abs(est - n) / n < tol, (n, est)
 
 
 def test_approx_count_distinct_sql_and_recovery():
@@ -723,3 +717,40 @@ def test_string_agg_recovery():
         want.setdefault(st, []).append(city)
     assert {st: c for st, c in rows} == {
         st: "|".join(sorted(v)) for st, v in want.items()}
+
+
+def test_approx_count_distinct_varchar_group_key():
+    """ACD grouped by an interned VARCHAR column — the flush path must
+    handle decoded (plain python str) group keys."""
+    import asyncio
+
+    from risingwave_tpu.frontend.session import Frontend
+
+    async def run():
+        fe = Frontend(min_chunks=4)
+        await fe.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', "
+            "nexmark.table.type='bid', nexmark.event.num=3000)")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW a AS SELECT channel, "
+            "approx_count_distinct(bidder) AS acd FROM bid "
+            "GROUP BY channel")
+        for _ in range(6):
+            await fe.step()
+        rows = await fe.execute("SELECT * FROM a")
+        await fe.close()
+        return rows
+
+    rows = asyncio.run(run())
+    from risingwave_tpu.connectors.nexmark import NexmarkConfig, gen_bids
+    cfg = NexmarkConfig(event_num=3000)
+    bids = gen_bids(np.arange(3000 * 46 // 50, dtype=np.int64), cfg)
+    import collections
+    d = collections.defaultdict(set)
+    for ch, b in zip(bids["channel"], bids["bidder"].tolist()):
+        d[ch].add(b)
+    got = {ch: acd for ch, acd in rows}
+    assert set(got) == set(d)
+    for ch, exact in ((k, len(v)) for k, v in d.items()):
+        assert abs(got[ch] - exact) <= max(2, 0.05 * exact), \
+            (ch, got[ch], exact)
