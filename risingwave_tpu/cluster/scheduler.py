@@ -370,6 +370,11 @@ def _fragment_table_ids(frag: Fragment) -> List[int]:
             out += [int(n["left_table_id"]), int(n["right_table_id"])]
         elif op == "materialize":
             out.append(int(n["table_id"]))
+        elif op in ("top_n", "over_window", "eowc_gate", "dedup",
+                    "dynamic_filter"):
+            out.append(int(n["table_id"]))
+        elif op == "backfill":
+            out.append(int(n["progress_table_id"]))
         elif op == "watermark_filter" and n.get("table_id") is not None:
             out.append(int(n["table_id"]))
     return out

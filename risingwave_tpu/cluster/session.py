@@ -110,6 +110,16 @@ class DistFrontend:
             if stmt.what == "sources":
                 return [(n,) for n in sorted(self.catalog.sources)]
             return [(n,) for n in sorted(self.catalog.mvs)]
+        if isinstance(stmt, ast.Explain):
+            from risingwave_tpu.frontend.planner import explain_tree
+            planner = StreamPlanner(
+                self.catalog, MemoryStateStore(),
+                LocalBarrierManager(), definition="", mesh=None,
+                actors={}, dist_parallelism=self.parallelism)
+            plan = planner.plan("__explain__", stmt.select, actor_id=0,
+                                rate_limit=self.rate_limit,
+                                min_chunks=self.min_chunks)
+            return [(line,) for line in explain_tree(plan.consumer)]
         if isinstance(stmt, ast.Flush):
             await self.cluster.step(1)
             return "FLUSH"
@@ -128,7 +138,8 @@ class DistFrontend:
                             "yet — use the in-process session")
         planner = StreamPlanner(self.catalog, MemoryStateStore(),
                                 LocalBarrierManager(), definition="",
-                                mesh=None, actors={})
+                                mesh=None, actors={},
+                                dist_parallelism=self.parallelism)
         plan = planner.plan(stmt.name, stmt.select, actor_id=0,
                             rate_limit=self.rate_limit,
                             min_chunks=self.min_chunks)

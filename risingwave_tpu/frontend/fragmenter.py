@@ -213,13 +213,16 @@ class Fragmenter:
                 "minput_table_ids": {
                     j: t.table_id for j, t in ex.minput.items()},
             }
-            if self.parallelism > 1:
+            if self.parallelism > 1 and \
+                    getattr(ex, "two_phase_role", None) != "local":
                 fi, xi = self._cut(up_fi, list(ex.group_indices),
                                    ex.input.schema, self.parallelism)
                 node["input"] = xi
             else:
-                # parallelism 1: colocate with the input chain
-                # (NoShuffle) — no exchange hop to pay for
+                # parallelism 1, or the LOCAL phase of a two-phase
+                # split: colocate with the input chain (NoShuffle) —
+                # the local phase exists precisely to pre-reduce
+                # before the exchange
                 fi, node["input"] = up_fi, ci
             ni = self._append(fi, node)
             return fi, ni
@@ -241,6 +244,97 @@ class Fragmenter:
                 "right_pk": list(right.table.pk_indices),
                 "join_type": ex.join_type.value,
                 "output_names": [f.name for f in ex.schema]})
+            return fi, ni
+        from risingwave_tpu.stream.executors.top_n import (
+            GroupTopNExecutor,
+        )
+        if isinstance(ex, GroupTopNExecutor):
+            up_fi, ci = self._lower(ex.input)
+            node = {
+                "op": "top_n", "input": None,
+                "order_by": [[i, d] for i, d in ex.order_by],
+                "offset": ex.offset, "limit": ex.limit,
+                "table_id": ex.state.table_id,
+                "group": list(ex.group_indices),
+                "append_only": ex.append_only,
+                "pk": list(ex.pk_indices)}
+            if len(self.graph.fragments[up_fi].nodes) > 1 or \
+                    self.parallelism > 1:
+                # TopN is a SINGLETON: a global window cannot split
+                # across actors; grouped top-n would need group ⊆ dist
+                # keys — a singleton fragment is always correct
+                # (DispatcherType::SIMPLE, stream_graph/schedule.rs
+                # singleton placement)
+                keys = list(ex.group_indices)
+                fi, xi = self._cut(up_fi, keys, ex.input.schema, 1)
+                node["input"] = xi
+            else:
+                fi, node["input"] = up_fi, ci
+            ni = self._append(fi, node)
+            return fi, ni
+        from risingwave_tpu.stream.executors.over_window import (
+            OverWindowExecutor,
+        )
+        if isinstance(ex, OverWindowExecutor):
+            up_fi, ci = self._lower(ex.input)
+            node = {
+                "op": "over_window", "input": None,
+                "partition": list(ex.partition_indices),
+                "order_by": [[i, d] for i, d in ex.order_by],
+                "calls": [{"kind": c.kind.value,
+                           "input_idx": c.input_idx,
+                           "offset": c.offset} for c in ex.calls],
+                "table_id": ex.state.table_id,
+                "input_pk": list(ex.input_pk),
+                "output_names": [f.name for f in ex.schema]}
+            if self.parallelism > 1 and ex.partition_indices:
+                # hash exchange on the partition keys — each actor
+                # owns whole partitions
+                fi, xi = self._cut(up_fi, list(ex.partition_indices),
+                                   ex.input.schema, self.parallelism)
+                node["input"] = xi
+            elif self.parallelism > 1:
+                fi, xi = self._cut(up_fi, [], ex.input.schema, 1)
+                node["input"] = xi        # unpartitioned → singleton
+            else:
+                fi, node["input"] = up_fi, ci
+            ni = self._append(fi, node)
+            return fi, ni
+        from risingwave_tpu.stream.executors.project_set import (
+            ProjectSetExecutor,
+        )
+        if isinstance(ex, ProjectSetExecutor):
+            fi, ci = self._lower(ex.input)
+            items = []
+            for kind, payload in ex.items:
+                if kind == "scalar":
+                    items.append(["scalar", expr_to_ir(payload)])
+                else:
+                    items.append([kind,
+                                  [expr_to_ir(e) for e in payload]])
+            ni = self._append(fi, {
+                "op": "project_set", "input": ci, "items": items,
+                "names": list(ex.names), "pass_pk": list(ex.pass_pk)})
+            return fi, ni
+        from risingwave_tpu.stream.executors.eowc import (
+            EowcGateExecutor,
+        )
+        if isinstance(ex, EowcGateExecutor):
+            fi, ci = self._lower(ex.input)
+            ni = self._append(fi, {
+                "op": "eowc_gate", "input": ci, "wm_col": ex.wm_col,
+                "table_id": ex.state.table_id,
+                "pk": list(ex.state.pk_indices)})
+            return fi, ni
+        from risingwave_tpu.stream.executors.dedup import (
+            AppendOnlyDedupExecutor,
+        )
+        if isinstance(ex, AppendOnlyDedupExecutor):
+            fi, ci = self._lower(ex.input)
+            ni = self._append(fi, {
+                "op": "dedup", "input": ci,
+                "keys": list(ex.dedup_indices),
+                "table_id": ex.state.table_id})
             return fi, ni
         if isinstance(ex, MaterializeExecutor):
             fi, ci = self._lower(ex.input)

@@ -198,12 +198,22 @@ class StreamPlanner:
     """Plans one CREATE MATERIALIZED VIEW into an executor chain."""
 
     def __init__(self, catalog: Catalog, store, local, definition: str,
-                 mesh=None, actors=None):
+                 mesh=None, actors=None, dist_parallelism: int = 1,
+                 join_state_cap=None):
         self.catalog = catalog
         self.store = store
         self.local = local           # LocalBarrierManager
         self.definition = definition
         self.mesh = mesh             # non-None ⇒ sharded GROUP BY plans
+        # > 1 ⇒ the plan deploys over N cluster actors: eligible
+        # GROUP BYs split into local partial + global merge aggs
+        # (logical_agg.rs two-phase), with the hash exchange between
+        # them inserted by the fragmenter
+        self.dist_parallelism = max(1, dist_parallelism)
+        # resident-row cap per join side: INNER joins get the
+        # cold-state tier (evict to the state table, reload on probe
+        # miss — managed_state/join/mod.rs:379-420)
+        self.join_state_cap = join_state_cap
         self.actors = actors or {}   # actor_id → Actor (MV-on-MV attach)
         self.readers: Dict[int, object] = {}
         # chain edges produced by _chain_upstream_mv, attached by the
@@ -519,21 +529,39 @@ class StreamPlanner:
                                                      conjuncts,
                                                      full_scope)
                 lkeys, rkeys = _equi_keys(jn.on, lscope, rscope)
-                lt = StateTable(self.catalog.next_id(), left.schema,
-                                list(left.pk_indices), self.store,
-                                dist_key_indices=None)
-                rt = StateTable(self.catalog.next_id(), right.schema,
-                                list(right.pk_indices), self.store)
                 jt = {"inner": JoinType.INNER,
                       "left": JoinType.LEFT_OUTER,
                       "right": JoinType.RIGHT_OUTER,
                       "full": JoinType.FULL_OUTER}[jn.kind]
+                cap = (self.join_state_cap
+                       if jt == JoinType.INNER and self.mesh is None
+                       else None)
+                if cap is not None:
+                    # cold tier: state-table pks lead with the join
+                    # keys so evicted keys reload by prefix scan
+                    lpk = lkeys + [p for p in left.pk_indices
+                                   if p not in lkeys]
+                    rpk = rkeys + [p for p in right.pk_indices
+                                   if p not in rkeys]
+                    lt = StateTable(self.catalog.next_id(),
+                                    left.schema, lpk, self.store,
+                                    dist_key_indices=lkeys)
+                    rt = StateTable(self.catalog.next_id(),
+                                    right.schema, rpk, self.store,
+                                    dist_key_indices=rkeys)
+                else:
+                    lt = StateTable(self.catalog.next_id(), left.schema,
+                                    list(left.pk_indices), self.store,
+                                    dist_key_indices=None)
+                    rt = StateTable(self.catalog.next_id(), right.schema,
+                                    list(right.pk_indices), self.store)
                 # parallel plan: the hash exchange feeding N parallel
                 # join actors (dispatch.rs:582) is the sharded kernel's
                 # in-program all_to_all — same wiring as the agg path
                 left = HashJoinExecutor(left, right, lkeys, rkeys, lt,
                                         rt, actor_id=actor_id,
-                                        join_type=jt, mesh=self.mesh)
+                                        join_type=jt, mesh=self.mesh,
+                                        state_cap=cap)
                 lscope = lscope.concat(rscope)
             ex = left
             scope = lscope
@@ -900,14 +928,21 @@ class StreamPlanner:
             if isinstance(gb, InputRef) and gb.index in wm_cols]
         g = len(group_bound)
         calls = remapped
-        sch, agg_pk = agg_state_schema(pre.schema, list(range(g)), calls)
-        table = StateTable(self.catalog.next_id(), sch, agg_pk,
-                           self.store,
-                           dist_key_indices=list(range(len(agg_pk))))
         # append-only-ness decides the agg mode (VERDICT r3 #7: the
         # old hardcoded append_only=True was silently wrong over
         # retracting upstreams, e.g. GROUP BY over an outer join)
         append_only = self._derive_append_only(ex)
+        from risingwave_tpu.ops.hash_agg import AggKind as _AK
+        if (self.dist_parallelism > 1 and self.mesh is None
+                and all(c.kind in (_AK.COUNT, _AK.SUM, _AK.MIN,
+                                   _AK.MAX) and not c.distinct
+                        for c in calls)):
+            return self._plan_two_phase_agg(
+                pre, g, calls, append_only, bound, having_pred)
+        sch, agg_pk = agg_state_schema(pre.schema, list(range(g)), calls)
+        table = StateTable(self.catalog.next_id(), sch, agg_pk,
+                           self.store,
+                           dist_key_indices=list(range(len(agg_pk))))
         from risingwave_tpu.stream.executors.hash_agg import (
             agg_aux_tables,
         )
@@ -936,6 +971,61 @@ class StreamPlanner:
                               minput_tables=minput_tables,
                               distinct_tables=distinct_tables)
         # bound items are already typed refs over the agg output row
+        return agg, bound, having_pred
+
+    def _plan_two_phase_agg(self, pre: Executor, g: int,
+                            calls: List[AggCall], append_only: bool,
+                            bound, having_pred):
+        """Two-phase aggregation for distributed plans
+        (logical_agg.rs two-phase split): a LOCAL partial agg stays
+        colocated with its input fragment (the fragmenter cuts at the
+        GLOBAL agg's input, so the hash exchange carries per-group
+        partials instead of raw rows), and the global agg merges —
+        COUNT partials by SUM, SUM/MIN/MAX by themselves. The global
+        side is never append-only (local updates retract), so merged
+        MIN/MAX get materialized-input tables automatically."""
+        from risingwave_tpu.ops.hash_agg import AggKind
+        from risingwave_tpu.stream.executor import ExecutorInfo
+        from risingwave_tpu.stream.executors.hash_agg import (
+            agg_aux_tables,
+        )
+
+        group = list(range(g))
+        lsch, lpk = agg_state_schema(pre.schema, group, calls)
+        ltable = StateTable(self.catalog.next_id(), lsch, lpk,
+                            self.store,
+                            dist_key_indices=list(range(len(lpk))))
+        ldistinct, lminput = agg_aux_tables(
+            pre.schema, group, calls, append_only, self.store,
+            dedup_table_id=lambda _c: self.catalog.next_id(),
+            minput_table_id=lambda _j: self.catalog.next_id())
+        local = HashAggExecutor(pre, group, calls, ltable,
+                                append_only=append_only,
+                                distinct_tables=ldistinct,
+                                minput_tables=lminput)
+        local._info = ExecutorInfo(local.schema,
+                                   list(local.pk_indices),
+                                   "HashAggExecutor(phase=local)")
+        # the fragmenter colocates the local phase with its input
+        # (no exchange cut) — that IS the point of the split
+        local.two_phase_role = "local"
+        merge = [AggCall(AggKind.SUM if c.kind == AggKind.COUNT
+                         else c.kind, g + j)
+                 for j, c in enumerate(calls)]
+        gsch, gpk = agg_state_schema(local.schema, group, merge)
+        gtable = StateTable(self.catalog.next_id(), gsch, gpk,
+                            self.store,
+                            dist_key_indices=list(range(len(gpk))))
+        gdistinct, gminput = agg_aux_tables(
+            local.schema, group, merge, False, self.store,
+            dedup_table_id=lambda _c: self.catalog.next_id(),
+            minput_table_id=lambda _j: self.catalog.next_id())
+        agg = HashAggExecutor(local, group, merge, gtable,
+                              append_only=False,
+                              distinct_tables=gdistinct,
+                              minput_tables=gminput)
+        agg._info = ExecutorInfo(agg.schema, list(agg.pk_indices),
+                                 "HashAggExecutor(phase=global)")
         return agg, bound, having_pred
 
 

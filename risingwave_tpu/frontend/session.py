@@ -42,7 +42,8 @@ class Frontend:
     def __init__(self, store: Optional[StateStore] = None,
                  rate_limit: Optional[int] = 8,
                  min_chunks: Optional[int] = None,
-                 parallelism: int = 1):
+                 parallelism: int = 1,
+                 join_state_cap: Optional[int] = None):
         self.store = store if store is not None else MemoryStateStore()
         # parallelism > 1: GROUP BY plans run on the vnode-sharded SPMD
         # kernel over a device mesh (the fragmenter's hash-exchange
@@ -56,6 +57,8 @@ class Frontend:
         self.readers: Dict[str, Dict[int, object]] = {}   # mv → readers
         self.rate_limit = rate_limit
         self.min_chunks = min_chunks
+        # resident join-state cap (cold-tier eviction; None = unbounded)
+        self.join_state_cap = join_state_cap
         self._next_actor = 1000
         self.chain_edges: Dict[str, list] = {}   # job → [(uid, Output)]
         # name → CREATE MV select AST (reschedule replans from this —
@@ -330,7 +333,8 @@ class Frontend:
         async with self._barrier_lock:
             planner = StreamPlanner(self.catalog, self.store, self.local,
                                     definition="", mesh=self.mesh,
-                                    actors=self.actors)
+                                    actors=self.actors,
+                                    join_state_cap=self.join_state_cap)
             actor_id = self._next_actor
             self._next_actor += 1
             id_base = self.catalog._next_id

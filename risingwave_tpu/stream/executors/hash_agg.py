@@ -803,16 +803,27 @@ class HashAggExecutor(Executor):
                  for r in range(fr.n)]
         for j in self._hll_calls:
             regs_d, prev_d = self._hll_regs[j], self._hll_prev[j]
-            empty = np.zeros(HLL_M, dtype=np.uint8)
-            mat = np.stack([regs_d.get(g, empty) for g in gkeys])
-            ests = hll_estimate_dense(mat)
+            dirty = self._hll_dirty[j]
+            # estimate ONLY dirty sketches (64KB register files: a
+            # full re-stack per flushed row would move gigabytes per
+            # barrier at scale); clean groups reuse the cached value
+            fresh = [g for g in dict.fromkeys(gkeys) if g in dirty]
+            ests = {}
+            if fresh:
+                mat = np.stack([regs_d[g] for g in fresh])
+                for g, e in zip(fresh,
+                                hll_estimate_dense(mat).tolist()):
+                    ests[g] = int(e)
             for r, g in enumerate(gkeys):
                 prev = prev_d.get(g)
-                fr.outs[j][r] = ests[r]
+                new = ests.get(g)
+                if new is None:
+                    new = prev if prev is not None else 0
+                fr.outs[j][r] = new
                 fr.nulls[j][r] = False
                 fr.prev_outs[j][r] = 0 if prev is None else prev
                 fr.prev_nulls[j][r] = prev is None
-                prev_d[g] = int(ests[r])
+                prev_d[g] = new
 
     def _persist_hll_dirty(self) -> None:
         """Upsert dirty register files (one BYTEA row per group; the

@@ -197,6 +197,15 @@ class _JoinSide:
         self.pk_to_ref: Dict[tuple, int] = {}
         self.free: List[int] = []
         self.next_ref = 0
+        # cold-state tier (managed_state/join/mod.rs:379-420 LRU-over-
+        # StateTable analog): when resident rows exceed state_cap, the
+        # OLDEST keys evict — their rows leave the arena + device but
+        # stay durable in the state table; a later probe of an evicted
+        # key reloads it first (see HashJoinExecutor._reload_cold).
+        # cold_keys: key LANES tuple → key VALUES tuple (the values
+        # drive the state-table prefix scan on reload)
+        self.state_cap: Optional[int] = None
+        self.cold_keys: Dict[tuple, tuple] = {}
         # per-ref match degree (outer/semi/anti bookkeeping; see
         # JoinType docstring) — grown alongside the arena
         self.degrees = np.zeros(self.arena.cap, dtype=np.int64)
@@ -388,9 +397,26 @@ class _JoinSide:
         refs tombstoned on device (the existing compaction reclaims the
         arena/chain slots when the dead ratio crosses its threshold).
         Cost is O(live) per call — the executor only calls this when the
-        combined watermark actually advances."""
+        combined watermark actually advances. Cold (evicted) keys below
+        the watermark expire too: their durable rows delete and the
+        cold marker drops — otherwise the state table would grow
+        without bound on exactly the keys-drift workloads the cold
+        tier exists for."""
+        n_cold = 0
+        if self.cold_keys:
+            dead_cold = [
+                (lt, vt) for lt, vt in self.cold_keys.items()
+                if vt[key_pos] is not None
+                and int(vt[key_pos]) < int(wm_physical)]
+            for lt, vt in dead_cold:
+                del self.cold_keys[lt]
+                dead_rows = [tuple(row) for _pk, row
+                             in self.table.iter_prefix(list(vt))]
+                if dead_rows:
+                    self.table.delete_rows(dead_rows)
+                    n_cold += len(dead_rows)
         if not self.pk_to_ref:
-            return 0
+            return n_cold
         col = self.key_indices[key_pos]
         refs = np.fromiter(self.pk_to_ref.values(), dtype=np.int64,
                            count=len(self.pk_to_ref))
@@ -399,7 +425,7 @@ class _JoinSide:
         dead = ok & (vals.astype(np.int64) < int(wm_physical))
         n_dead = int(dead.sum())
         if n_dead == 0:
-            return 0
+            return n_cold
         dead_refs = refs[dead].astype(np.int32)
         pks = list(self.pk_to_ref.keys())
         dead_pks = [pks[i] for i in np.flatnonzero(dead).tolist()]
@@ -422,7 +448,112 @@ class _JoinSide:
                           dtype=np.int32)
         lanes_[:n_dead] = self.key_codec.build_arrays(key_cols)
         self.kernel.delete(del_refs, mask, seq=seq, key_lanes=lanes_)
-        return n_dead
+        return n_dead + n_cold
+
+    # keep ~this fraction of state_cap after an eviction sweep (room
+    # to absorb arrivals before the next sweep)
+    EVICT_TARGET_RATIO = 0.75
+
+    def evict_cold(self) -> int:
+        """FIFO-by-arrival eviction of whole KEYS down to the target
+        (arrival order ≈ recency for streaming windows; every row of an
+        evicted key goes together — a probe must see all or none).
+        Returns rows evicted. Caller guarantees no in-flight probes."""
+        if self.state_cap is None or                 len(self.pk_to_ref) <= self.state_cap:
+            return 0
+        target = int(self.state_cap * self.EVICT_TARGET_RATIO)
+        pks = list(self.pk_to_ref.keys())
+        refs = np.fromiter(self.pk_to_ref.values(), dtype=np.int64,
+                           count=len(pks))
+        key_vals = []
+        for i in self.key_indices:
+            vals = self.arena.cols[i][refs]
+            ok = self.arena.valid[i][refs]
+            key_vals.append([None if not o else
+                             (v.item() if hasattr(v, "item") else v)
+                             for v, o in zip(vals.tolist(),
+                                             ok.tolist())])
+        by_key: Dict[tuple, list] = {}
+        age: Dict[tuple, int] = {}
+        for j, pk in enumerate(pks):
+            kt = tuple(kv[j] for kv in key_vals)
+            by_key.setdefault(kt, []).append(pk)
+            r = int(refs[j])
+            if age.get(kt, -1) < r:
+                age[kt] = r
+        evicted = 0
+        live = len(self.pk_to_ref)
+        for kt in sorted(age, key=age.get):
+            if live - evicted <= target:
+                break
+            if any(v is None for v in kt):
+                continue               # null-key rows never probe-match
+            for pk in by_key[kt]:
+                ref = self.pk_to_ref.pop(pk)
+                self.free.append(ref)
+                evicted += 1
+            lanes_t = tuple(
+                self.key_codec.lanes_of_values(list(kt)).tolist())
+            self.cold_keys[lanes_t] = kt
+        if evicted:
+            # compaction rebuilds arena + device from the survivors —
+            # evicted rows leave the kernel wholesale
+            self.compact()
+        return evicted
+
+    def reload_keys(self, need: Dict[tuple, tuple]) -> tuple:
+        """Reload evicted keys' rows from the state table (arena +
+        pk_to_ref + a batched device insert at seq 0, visible to every
+        probe). Returns (lanes, aux, n, max_ref) for the device apply,
+        or None when nothing reloaded."""
+        from risingwave_tpu.ops.hash_join import FLAG_INS
+
+        rows: List[tuple] = []
+        lanes_rows: List[tuple] = []
+        for lanes_t, values_t in need.items():
+            if lanes_t not in self.cold_keys:
+                continue
+            del self.cold_keys[lanes_t]
+            for _pk, row in self.table.iter_prefix(list(values_t)):
+                row = tuple(row)
+                if tuple(row[i] for i in self.pk_indices) \
+                        in self.pk_to_ref:
+                    # a row inserted AFTER the key went cold is already
+                    # resident — re-adding it would double its matches
+                    continue
+                rows.append(row)
+                lanes_rows.append(lanes_t)
+        if not rows:
+            return None
+        n = len(rows)
+        refs = self.alloc_refs(n)
+        self.arena.ensure(int(refs.max()))
+        for i, f in enumerate(self.schema):
+            col_vals = [r[i] for r in rows]
+            if f.data_type.is_device:
+                ok = np.asarray([v is not None for v in col_vals])
+                vals = np.asarray(
+                    [0 if v is None else v for v in col_vals],
+                    dtype=f.data_type.np_dtype)
+                self.arena.cols[i][refs] = vals
+                self.arena.valid[i][refs] = ok
+            else:
+                self.arena.cols[i][refs] = np.asarray(col_vals,
+                                                      dtype=object)
+                self.arena.valid[i][refs] = True
+        self.ensure_degrees(int(refs.max()))
+        for row, ref in zip(rows, refs.tolist()):
+            self.pk_to_ref[tuple(row[i] for i in self.pk_indices)] = ref
+        cap = next_pow2(n)
+        lanes = np.zeros((cap, LANES_PER_KEY * len(self.key_indices)),
+                         dtype=np.int32)
+        lanes[:n] = np.asarray(lanes_rows, dtype=np.int32)
+        aux = np.zeros((cap, 4), dtype=np.int32)
+        aux[:n, 0] = refs
+        aux[:n, 2] = FLAG_INS
+        # seq 0: reloaded rows predate every live sequence, so every
+        # probe of this epoch sees them
+        return lanes, aux, n, int(refs.max())
 
     def recover(self) -> None:
         keys_l, refs_l = [], []
@@ -468,7 +599,8 @@ class HashJoinExecutor(Executor):
                  actor_id: int = 0,
                  output_names: Optional[Sequence[str]] = None,
                  join_type: JoinType = JoinType.INNER,
-                 mesh=None, shard_opts: Optional[dict] = None):
+                 mesh=None, shard_opts: Optional[dict] = None,
+                 state_cap: Optional[int] = None):
         assert len(left_keys) == len(right_keys)
         self.left_in, self.right_in = left, right
         self.join_type = join_type
@@ -526,6 +658,24 @@ class HashJoinExecutor(Executor):
         # derived WITHOUT touching .kernel: the lazy property exists so
         # plan-only processes never build device state
         self._epoch_batch = self.sides[0]._mesh is None
+        if state_cap is not None:
+            # cold-state tier prerequisites: epoch-batched single-chip
+            # path (reload hooks the epoch dispatch), INNER join
+            # (degree history of evicted rows would be lost), and
+            # key-prefixed state-table pks (reload prefix-scans by key)
+            if join_type != JoinType.INNER or not self._epoch_batch:
+                raise ValueError(
+                    "state_cap needs an INNER join on the single-chip "
+                    "epoch-batched path")
+            for side in self.sides:
+                k = len(side.key_indices)
+                if side.table.pk_indices[:k] != side.key_indices:
+                    raise ValueError(
+                        "state_cap needs state-table pks prefixed by "
+                        "the join keys (reload prefix-scans by key): "
+                        f"pk={side.table.pk_indices} "
+                        f"keys={side.key_indices}")
+                side.state_cap = int(state_cap)
         self._epoch_buf: tuple = ([], [])
         self._epoch_rows = [0, 0]
         # host-state accounting (memory_manager.rs analog): weakref so
@@ -706,6 +856,7 @@ class HashJoinExecutor(Executor):
         Returns {side: (deg|None, probe_idx, refs)} in the CONCATENATED
         row space; _emit_pending slices per chunk by offset."""
         import jax
+        self._reload_cold()
         devs: Dict[int, tuple] = {}
         for s in (0, 1):
             buf = self._epoch_buf[s]
@@ -734,6 +885,33 @@ class HashJoinExecutor(Executor):
                                                           with_deg)
                   for s, (ld, ad, _t, _m) in devs.items()}
         return {s: p.collect() for s, p in probes.items()}
+
+    def _reload_cold(self) -> None:
+        """Reload evicted keys this epoch's probes will need, BEFORE
+        the epoch's applies/probes dispatch (managed_state/join reload-
+        on-miss, batched per barrier). The reload insert applies at
+        seq 0 so every probe of the epoch sees the reloaded rows."""
+        from risingwave_tpu.ops.hash_join import FLAG_PROBE
+        import jax
+        for s in (0, 1):
+            other = self.sides[1 - s]
+            if not other.cold_keys or not self._epoch_buf[s]:
+                continue
+            need: Dict[tuple, tuple] = {}
+            for lan, aux, _mr in self._epoch_buf[s]:
+                rows = np.flatnonzero(aux[:, 2] & FLAG_PROBE)
+                for t in map(tuple, lan[rows].tolist()):
+                    v = other.cold_keys.get(t)
+                    if v is not None:
+                        need[t] = v
+            if not need:
+                continue
+            loaded = other.reload_keys(need)
+            if loaded is not None:
+                lanes, aux2, n, max_ref = loaded
+                other.kernel.apply_epoch(
+                    jax.device_put(lanes), jax.device_put(aux2), n,
+                    max_ref)
 
     def _emit_pending(self) -> List[StreamChunk]:
         """Barrier sweep: collect the epoch's probes and run emission
@@ -901,6 +1079,12 @@ class HashJoinExecutor(Executor):
         codec = self.sides[0].key_codec
         if not codec.interners:
             return
+        if any(side.cold_keys for side in self.sides):
+            # cold keys' lane tuples encode interner ids: retiring an
+            # id a COLD key holds would dangle its marker (a re-intern
+            # under a new id misses reload; id reuse cross-matches
+            # unrelated keys). GC resumes once cold keys drain.
+            return
         total = codec.interner_entries()
         live_refs = sum(len(s.pk_to_ref) for s in self.sides)
         if total < self.INTERNER_GC_MIN or \
@@ -967,7 +1151,15 @@ class HashJoinExecutor(Executor):
                 self._expire_state()
                 for side in self.sides:
                     side.table.commit(msg.epoch)
-                    side.maybe_compact()
+                    evicted = side.evict_cold()
+                    if evicted:
+                        from risingwave_tpu.utils.metrics import (
+                            STREAMING as _M,
+                        )
+                        _M.join_rows_evicted.inc(
+                            evicted, executor=self.identity)
+                    else:
+                        side.maybe_compact()
                 self._maybe_gc_interner()
                 if self._seq > (1 << 30):
                     # int32 sequence headroom: with no probes in

@@ -39,6 +39,26 @@ Node shapes (dicts, `op` discriminated):
    "left_dist_key": [...], "right_dist_key": [...],  # optional:
    "output_names": [...]}   # vnode dist of the join state tables
   {"op": "materialize", "input": N, "table_id": n, "pk": [...]}
+  {"op": "top_n", "input": N, "order_by": [[i, desc], ...],
+   "offset": n, "limit": n|null, "table_id": n, "group": [...],
+   "append_only": bool, "pk": [...]}
+  {"op": "over_window", "input": N, "partition": [...],
+   "order_by": [[i, desc], ...],
+   "calls": [{"kind", "input_idx", "offset"}], "table_id": n,
+   "input_pk": [...], "output_names": [...]}
+  {"op": "project_set", "input": N,
+   "items": [["scalar", EXPR] | ["series", [EXPR, ...]]],
+   "names": [...], "pass_pk": [...]}
+  {"op": "dynamic_filter", "left": N, "right": N, "left_col": n,
+   "cmp": "<"|"<="|">"|">=", "table_id": n}
+  {"op": "eowc_gate", "input": N, "wm_col": n, "table_id": n,
+   "pk": [...]}
+  {"op": "temporal_join", "left": N, "right": N, "left_keys": [...],
+   "right_keys": [...], "outer": bool, "output_names": [...]}
+  {"op": "dedup", "input": N, "keys": [...], "table_id": n}
+  {"op": "backfill", "input": N, "mv_table_id": n, "mv_pk": [...],
+   "progress_table_id": n}      # input feeds live deltas; the
+                                # snapshot reads the LOCAL store
 """
 
 from __future__ import annotations
@@ -303,6 +323,112 @@ def build_fragment(nodes: List[dict], store, local,
                 output_names=node.get("output_names"),
                 distinct_tables=distinct_tables,
                 minput_tables=minput_tables)
+        elif op == "top_n":
+            from risingwave_tpu.stream.executors.top_n import (
+                GroupTopNExecutor,
+            )
+            child = built[node["input"]]
+            pk = [int(i) for i in node["pk"]]
+            state = StateTable(int(node["table_id"]), child.schema,
+                               pk, store)
+            ex = GroupTopNExecutor(
+                child,
+                [(int(i), bool(d)) for i, d in node["order_by"]],
+                offset=int(node.get("offset", 0)),
+                limit=node.get("limit"), state=state,
+                group_indices=[int(i)
+                               for i in node.get("group", [])],
+                append_only=bool(node.get("append_only", False)),
+                pk_indices=pk)
+        elif op == "over_window":
+            from risingwave_tpu.expr.window import (
+                WindowCall, WindowFuncKind,
+            )
+            from risingwave_tpu.stream.executors.over_window import (
+                OverWindowExecutor,
+            )
+            child = built[node["input"]]
+            partition = [int(i) for i in node["partition"]]
+            order = [(int(i), bool(d)) for i, d in node["order_by"]]
+            calls = [WindowCall(WindowFuncKind(c["kind"]),
+                                c.get("input_idx"),
+                                offset=int(c.get("offset", 1)))
+                     for c in node["calls"]]
+            input_pk = [int(i) for i in node["input_pk"]]
+            suffix = [i for i in input_pk if i not in partition
+                      and i not in [o for o, _ in order]]
+            state = StateTable(
+                int(node["table_id"]), child.schema,
+                partition + [i for i, _d in order] + suffix, store,
+                dist_key_indices=partition)
+            ex = OverWindowExecutor(
+                child, partition, order, calls, state,
+                input_pk=input_pk,
+                output_names=node.get("output_names"),
+                actor_id=int(actor_id or 0))
+        elif op == "project_set":
+            from risingwave_tpu.stream.executors.project_set import (
+                ProjectSetExecutor,
+            )
+            child = built[node["input"]]
+            items = []
+            for kind, payload in node["items"]:
+                if kind == "scalar":
+                    items.append(("scalar", expr_from_ir(payload)))
+                else:
+                    items.append((kind, tuple(
+                        expr_from_ir(e) for e in payload)))
+            ex = ProjectSetExecutor(
+                child, items, list(node["names"]),
+                pass_pk=[int(i) for i in node.get("pass_pk", [])])
+        elif op == "dynamic_filter":
+            from risingwave_tpu.stream.executors.dynamic_filter \
+                import DynamicFilterExecutor
+            left = built[node["left"]]
+            lstate = StateTable(int(node["table_id"]), left.schema,
+                                list(left.pk_indices), store)
+            ex = DynamicFilterExecutor(
+                left, built[node["right"]], int(node["left_col"]),
+                node["cmp"], lstate)
+        elif op == "eowc_gate":
+            from risingwave_tpu.stream.executors.eowc import (
+                EowcGateExecutor,
+            )
+            child = built[node["input"]]
+            state = StateTable(int(node["table_id"]), child.schema,
+                               [int(i) for i in node["pk"]], store)
+            ex = EowcGateExecutor(child, int(node["wm_col"]), state,
+                                  actor_id=int(actor_id or 0))
+        elif op == "temporal_join":
+            from risingwave_tpu.stream.executors.temporal_join import (
+                TemporalJoinExecutor,
+            )
+            ex = TemporalJoinExecutor(
+                built[node["left"]], built[node["right"]],
+                [int(i) for i in node["left_keys"]],
+                [int(i) for i in node["right_keys"]],
+                outer=bool(node.get("outer", False)),
+                actor_id=int(actor_id or 0),
+                output_names=node.get("output_names"))
+        elif op == "dedup":
+            from risingwave_tpu.stream.executors.dedup import (
+                AppendOnlyDedupExecutor,
+            )
+            child = built[node["input"]]
+            keys = [int(i) for i in node["keys"]]
+            state = StateTable(int(node["table_id"]), child.schema,
+                               keys, store)
+            ex = AppendOnlyDedupExecutor(child, keys, state)
+        elif op == "backfill":
+            from risingwave_tpu.stream.executors.backfill import (
+                PROGRESS_SCHEMA, BackfillExecutor,
+            )
+            child = built[node["input"]]
+            mv = StateTable(int(node["mv_table_id"]), child.schema,
+                            [int(i) for i in node["mv_pk"]], store)
+            progress = StateTable(int(node["progress_table_id"]),
+                                  PROGRESS_SCHEMA, [0], store)
+            ex = BackfillExecutor(child, mv, progress)
         else:
             raise ValueError(f"unknown plan-IR op {op!r}")
         built.append(ex)

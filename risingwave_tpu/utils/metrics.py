@@ -186,6 +186,9 @@ class StreamingMetrics:
             "dirty groups at last flush")
         self.agg_table_capacity = r.gauge(
             "stream_agg_table_capacity", "device hash-table slots")
+        self.join_rows_evicted = r.counter(
+            "stream_join_rows_evicted",
+            "join-state rows evicted to the cold (state-table) tier")
         self.agg_rows_cleaned = r.counter(
             "stream_agg_state_rows_cleaned",
             "state rows deleted by watermark cleaning")

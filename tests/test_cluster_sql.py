@@ -97,11 +97,12 @@ def test_dist_parallel_agg_two_workers(tmp_path):
             await fe.execute(Q7ISH_MV)
             await fe.step(30)
             job = fe.cluster.jobs["q7"]
-            # the agg fragment really is parallel over both workers
+            # the GLOBAL agg fragment (exchange-fed; the local phase
+            # colocates with the source) is parallel over both workers
             agg_frag = [fi for fi, f in
                         enumerate(job.graph.fragments)
-                        if any(n["op"] == "hash_agg"
-                               for n in f.nodes)][0]
+                        if f.inputs and any(n["op"] == "hash_agg"
+                                            for n in f.nodes)][0]
             slots = {s for _a, s in job.placements[agg_frag]}
             assert slots == {0, 1}, slots
             return {tuple(r)
@@ -163,6 +164,96 @@ def test_dist_move_fragment_between_workers(tmp_path):
             new_slot = 1 - old_slot
             await fe.cluster.move_fragment("q7", frag_idx, [new_slot])
             assert job.placements[frag_idx][0][1] == new_slot
+            await fe.step(30)
+            return {tuple(r)
+                    for r in await fe.execute("SELECT * FROM q7")}
+        finally:
+            await fe.close()
+
+    got = asyncio.run(run())
+    expect = _inprocess_oracle(Q7ISH_SOURCES, Q7ISH_MV,
+                               "SELECT * FROM q7")
+    assert got == expect
+    assert len(got) > 2
+
+
+def test_dist_topn_overwindow_projectset(tmp_path):
+    """The rest of the executor set ships through plan IR (VERDICT r4
+    #7): ORDER BY/LIMIT (singleton TopN fragment behind the parallel
+    agg), ROW_NUMBER() OVER a derived table, and generate_series —
+    each deployed across 2 workers and checked against the in-process
+    session."""
+    sqls = [
+        ("q105",
+         "CREATE MATERIALIZED VIEW q105 AS SELECT auction, count(*) "
+         "AS num FROM bid GROUP BY auction ORDER BY num DESC LIMIT 5",
+         "SELECT * FROM q105"),
+        ("q9",
+         "CREATE MATERIALIZED VIEW q9 AS SELECT auction, price "
+         "FROM (SELECT auction, price, row_number() OVER ("
+         "PARTITION BY auction ORDER BY price DESC) AS rn FROM bid) "
+         "AS t WHERE rn = 1",
+         "SELECT * FROM q9"),
+        ("ps",
+         "CREATE MATERIALIZED VIEW ps AS SELECT auction, "
+         "generate_series(1, 3) AS s FROM bid WHERE auction = 1001",
+         "SELECT * FROM ps"),
+    ]
+
+    async def run_dist():
+        fe = DistFrontend(str(tmp_path), n_workers=2, parallelism=2)
+        await fe.start()
+        try:
+            for s in Q7ISH_SOURCES:
+                await fe.execute(s.format(n=EVENTS))
+            out = {}
+            for name, mv, sel in sqls:
+                await fe.execute(mv)
+            await fe.step(25)
+            for name, _mv, sel in sqls:
+                out[name] = {tuple(r) for r in await fe.execute(sel)}
+            return out
+        finally:
+            await fe.close()
+
+    got = asyncio.run(run_dist())
+
+    def orc(name):
+        mv = next(m for n, m, _s in sqls if n == name)
+        sel = next(s for n, _m, s in sqls if n == name)
+        return _inprocess_oracle(Q7ISH_SOURCES, mv, sel)
+
+    for name in ("q105", "q9", "ps"):
+        assert got[name] == orc(name), name
+        assert len(got[name]) > 0, name
+
+
+def test_dist_two_phase_agg(tmp_path):
+    """Two-phase aggregation (VERDICT r4 #4): the local partial agg
+    colocates with the source fragment, the global merge agg sits
+    behind the hash exchange, EXPLAIN shows the split, and results
+    match the single-phase in-process session exactly."""
+    async def run():
+        fe = DistFrontend(str(tmp_path), n_workers=2, parallelism=2)
+        await fe.start()
+        try:
+            for s in Q7ISH_SOURCES:
+                await fe.execute(s.format(n=EVENTS))
+            plan = await fe.execute(
+                "EXPLAIN " + Q7ISH_MV.split(" AS ", 1)[1])
+            text = "\n".join(r[0] for r in plan)
+            assert "phase=local" in text and "phase=global" in text, \
+                text
+            await fe.execute(Q7ISH_MV)
+            job = fe.cluster.jobs["q7"]
+            # fragment 0 = source + LOCAL agg; the exchange feeds the
+            # global agg fragment
+            ops0 = [n["op"] for n in job.graph.fragments[0].nodes]
+            assert "hash_agg" in ops0, ops0
+            n_aggs = sum(n["op"] == "hash_agg"
+                         for f in job.graph.fragments
+                         for n in f.nodes)
+            assert n_aggs == 2, n_aggs
             await fe.step(30)
             return {tuple(r)
                     for r in await fe.execute("SELECT * FROM q7")}

@@ -1,0 +1,154 @@
+"""S3-API object store backend (VERDICT r4 #9).
+
+A MinIO-style stub server (stdlib http.server, in-process thread)
+implements the S3 subset the store uses — PUT/GET (with byte ranges)/
+HEAD/DELETE + ListObjectsV2 — and checks SigV4 Authorization headers
+when credentials are configured. hummock-lite then checkpoints a
+state-table workload through it and recovers from a fresh handle
+(object/s3.rs parity: whole-object uploads, ranged block reads).
+"""
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+import pytest
+
+from risingwave_tpu.storage.object_store import S3ObjectStore
+
+
+class _S3Stub(BaseHTTPRequestHandler):
+    objects = {}
+    require_auth = False
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _key(self):
+        return unquote(urlparse(self.path).path.lstrip("/"))
+
+    def _authorized(self) -> bool:
+        if not self.require_auth:
+            return True
+        auth = self.headers.get("Authorization", "")
+        return (auth.startswith("AWS4-HMAC-SHA256 Credential=minio/")
+                and "SignedHeaders=" in auth
+                and "Signature=" in auth
+                and self.headers.get("x-amz-date") is not None
+                and self.headers.get("x-amz-content-sha256")
+                is not None)
+
+    def _reply(self, code, body=b"", headers=()):
+        self.send_response(code)
+        sent = {k.lower() for k, _v in headers}
+        for k, v in headers:
+            self.send_header(k, v)
+        if "content-length" not in sent:
+            self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_PUT(self):
+        if not self._authorized():
+            return self._reply(403)
+        n = int(self.headers.get("Content-Length", 0))
+        self.objects[self._key()] = self.rfile.read(n)
+        self._reply(200)
+
+    def do_GET(self):
+        if not self._authorized():
+            return self._reply(403)
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        if "list-type" in q:
+            prefix = q.get("prefix", [""])[0]
+            bucket = url.path.lstrip("/")
+            full = f"{bucket}/{prefix}"
+            keys = sorted(k[len(bucket) + 1:] for k in self.objects
+                          if k.startswith(full))
+            body = ("<ListBucketResult>" + "".join(
+                f"<Contents><Key>{k}</Key></Contents>" for k in keys)
+                + "</ListBucketResult>").encode()
+            return self._reply(200, body)
+        data = self.objects.get(self._key())
+        if data is None:
+            return self._reply(404)
+        rng = self.headers.get("Range")
+        if rng:
+            lo, hi = rng.split("=")[1].split("-")
+            part = data[int(lo):int(hi) + 1]
+            return self._reply(206, part)
+        self._reply(200, data)
+
+    def do_HEAD(self):
+        data = self.objects.get(self._key())
+        if data is None:
+            return self._reply(404)
+        self._reply(200, headers=[("Content-Length", str(len(data)))])
+
+    def do_DELETE(self):
+        self.objects.pop(self._key(), None)
+        self._reply(204)
+
+
+@pytest.fixture
+def s3_stub():
+    _S3Stub.objects = {}
+    _S3Stub.require_auth = False
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _S3Stub)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+def test_s3_object_store_roundtrip(s3_stub):
+    s = S3ObjectStore(s3_stub, "bucket", prefix="env1")
+    s.upload("data/1.sst", b"hello world" * 100)
+    assert s.exists("data/1.sst")
+    assert s.read("data/1.sst") == b"hello world" * 100
+    assert s.read_range("data/1.sst", 6, 5) == b"world"
+    assert s.size("data/1.sst") == 1100
+    s.upload("meta/CURRENT", b"7")
+    assert s.list("data/") == ["data/1.sst"]
+    assert sorted(s.list("")) == ["data/1.sst", "meta/CURRENT"]
+    s.delete("data/1.sst")
+    assert not s.exists("data/1.sst")
+
+
+def test_s3_sigv4_headers_required(s3_stub):
+    _S3Stub.require_auth = True
+    anon = S3ObjectStore(s3_stub, "bucket")
+    with pytest.raises(IOError):
+        anon.upload("x", b"1")
+    signed = S3ObjectStore(s3_stub, "bucket", access_key="minio",
+                           secret_key="minio123")
+    signed.upload("x", b"1")
+    assert signed.read("x") == b"1"
+
+
+def test_hummock_checkpoints_to_s3(s3_stub):
+    """hummock-lite over the S3 endpoint: write at epochs, seal, sync
+    (SST + version manifest PUTs), then recover from a fresh handle
+    and read the committed state back through ranged block GETs."""
+    from risingwave_tpu.storage.hummock import HummockLite
+
+    store = HummockLite(S3ObjectStore(s3_stub, "bucket", prefix="hum"))
+    rows = {f"k{i:04d}".encode(): (i, f"v{i}") for i in range(500)}
+    store.ingest_batch(7, rows.items(), epoch=100)
+    store.seal_epoch(100)
+    store.sync(100)
+    store.ingest_batch(7, [(b"k0001", None)], epoch=200)  # tombstone
+    store.seal_epoch(200)
+    store.sync(200)
+    assert any(k.startswith("bucket/hum/data/")
+               for k in _S3Stub.objects)
+    assert "bucket/hum/meta/CURRENT" in _S3Stub.objects
+
+    fresh = HummockLite(S3ObjectStore(s3_stub, "bucket", prefix="hum"))
+    assert fresh.committed_epoch() == 200
+    assert fresh.get(7, b"k0002", 300) == (2, "v2")
+    assert fresh.get(7, b"k0001", 300) is None
+    got = dict(fresh.iter(7, 300))
+    assert len(got) == 499
