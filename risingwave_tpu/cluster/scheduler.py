@@ -182,13 +182,15 @@ class Cluster:
         if not consumers:
             return [], None
         assert len(consumers) == 1, "tree plans have one consumer"
-        down_fi, keys = consumers[0]
+        down_fi, inp = consumers[0]
         outs = [aid for aid, _slot in placements[down_fi]]
+        if inp.mode == "broadcast" and len(outs) > 1:
+            return outs, {"type": "broadcast"}
         if len(outs) == 1:
             return outs, {"type": "simple"}
         from risingwave_tpu.common.hash import VnodeMapping
         mapping = VnodeMapping.new_uniform(len(outs))
-        return outs, {"type": "hash", "keys": keys,
+        return outs, {"type": "hash", "keys": inp.keys,
                       "mapping": [int(o) for o in mapping.owners]}
 
     async def deploy_graph(self, name: str,

@@ -74,6 +74,9 @@ class DistFrontend:
         self.rate_limit = rate_limit
         self.min_chunks = min_chunks
         self.last_select_schema = None
+        # name → (select AST, eowc): FROM <mv> inlines the view's
+        # definition (distributed MV-on-MV by view expansion)
+        self._mv_selects = {}
 
     async def start(self) -> None:
         await self.cluster.start()
@@ -115,7 +118,8 @@ class DistFrontend:
             planner = StreamPlanner(
                 self.catalog, MemoryStateStore(),
                 LocalBarrierManager(), definition="", mesh=None,
-                actors={}, dist_parallelism=self.parallelism)
+                actors={}, dist_parallelism=self.parallelism,
+                inline_mvs=self._mv_selects)
             plan = planner.plan("__explain__", stmt.select, actor_id=0,
                                 rate_limit=self.rate_limit,
                                 min_chunks=self.min_chunks)
@@ -139,17 +143,19 @@ class DistFrontend:
         planner = StreamPlanner(self.catalog, MemoryStateStore(),
                                 LocalBarrierManager(), definition="",
                                 mesh=None, actors={},
-                                dist_parallelism=self.parallelism)
+                                dist_parallelism=self.parallelism,
+                                inline_mvs=self._mv_selects)
         plan = planner.plan(stmt.name, stmt.select, actor_id=0,
                             rate_limit=self.rate_limit,
                             min_chunks=self.min_chunks)
-        if plan.attaches:
-            raise PlanError("MV-on-MV chains are not distributed yet "
-                            "— use the in-process session")
+        assert not plan.attaches, \
+            "inlined views must not produce chain attaches"
         graph = Fragmenter(self.parallelism).lower(plan.consumer)
         await self.cluster.deploy_graph(stmt.name, graph)
         await self.cluster.step(1)         # activation barrier
         self.catalog.add_mv(plan.mv)
+        self._mv_selects[stmt.name] = (
+            stmt.select, getattr(stmt, "emit_on_window_close", False))
         return "CREATE_MATERIALIZED_VIEW"
 
     async def _drop_mv(self, stmt: ast.DropMaterializedView) -> str:
@@ -164,6 +170,7 @@ class DistFrontend:
                             f"on by {dependents}")
         await self.cluster.drop_job(stmt.name)
         del self.catalog.mvs[stmt.name]
+        self._mv_selects.pop(stmt.name, None)
         return "DROP_MATERIALIZED_VIEW"
 
     async def _select(self, sel: ast.Select) -> Rows:

@@ -43,12 +43,15 @@ class FragmentError(ValueError):
 @dataclass
 class FragInput:
     """One cut edge: this fragment consumes `up_frag`'s output hashed
-    on `keys` (indices into the upstream OUTPUT schema)."""
+    on `keys` (indices into the upstream OUTPUT schema), or fully
+    REPLICATED when mode="broadcast" (temporal-join arrangements need
+    every row on every actor — dispatch.rs:507)."""
 
     up_frag: int
     keys: List[int]
     schema: List[dict]              # IR schema of the exchanged rows
     node_idx: int                   # index of the exchange_in placeholder
+    mode: str = "hash"              # "hash" | "broadcast"
 
 
 @dataclass
@@ -71,12 +74,12 @@ class FragmentGraph:
     fragments: List[Fragment] = field(default_factory=list)
 
     def consumers_of(self, frag_idx: int) -> List[tuple]:
-        """[(down_frag_idx, keys)] — at most one in a tree plan."""
+        """[(down_frag_idx, FragInput)] — at most one in a tree plan."""
         out = []
         for di, f in enumerate(self.fragments):
             for inp in f.inputs:
                 if inp.up_frag == frag_idx:
-                    out.append((di, inp.keys))
+                    out.append((di, inp))
         return out
 
 
@@ -112,27 +115,27 @@ class Fragmenter:
         return len(self.graph.fragments[fi].nodes) - 1
 
     def _cut(self, up_fi: int, keys: List[int], schema,
-             parallelism: int) -> tuple:
+             parallelism: int, mode: str = "hash") -> tuple:
         """Close `up_fi` at its current tail and start a new fragment
-        consuming it through a hash exchange. Returns (new_frag_idx,
+        consuming it through an exchange. Returns (new_frag_idx,
         node_idx of the exchange_in placeholder)."""
         fi = self._new_fragment(parallelism)
         frag = self.graph.fragments[fi]
         port = len(frag.inputs)
         ni = self._append(fi, {"op": "exchange_in", "port": port})
         frag.inputs.append(FragInput(up_fi, list(keys),
-                                     schema_to_ir(schema), ni))
+                                     schema_to_ir(schema), ni, mode))
         return fi, ni
 
     def _cut_into(self, fi: int, up_fi: int, keys: List[int],
-                  schema) -> int:
+                  schema, mode: str = "hash") -> int:
         """Add another exchange port to an existing fragment (the
         second input of a join)."""
         frag = self.graph.fragments[fi]
         port = len(frag.inputs)
         ni = self._append(fi, {"op": "exchange_in", "port": port})
         frag.inputs.append(FragInput(up_fi, list(keys),
-                                     schema_to_ir(schema), ni))
+                                     schema_to_ir(schema), ni, mode))
         return ni
 
     # -- the walk ---------------------------------------------------------
@@ -243,6 +246,26 @@ class Fragmenter:
                 "left_pk": list(left.table.pk_indices),
                 "right_pk": list(right.table.pk_indices),
                 "join_type": ex.join_type.value,
+                "output_names": [f.name for f in ex.schema]})
+            return fi, ni
+        from risingwave_tpu.stream.executors.temporal_join import (
+            TemporalJoinExecutor,
+        )
+        if isinstance(ex, TemporalJoinExecutor):
+            l_fi, _ = self._lower(ex.left_in)
+            r_fi, _ = self._lower(ex.right_in)
+            # left: hash on the probe keys; right: BROADCAST — every
+            # actor maintains the full arrangement (lookup.rs delta-
+            # join spirit; the dim side is small by design)
+            fi, lxi = self._cut(l_fi, list(ex.left_keys),
+                                ex.left_in.schema, self.parallelism)
+            rxi = self._cut_into(fi, r_fi, [], ex.right_in.schema,
+                                 mode="broadcast")
+            ni = self._append(fi, {
+                "op": "temporal_join", "left": lxi, "right": rxi,
+                "left_keys": list(ex.left_keys),
+                "right_keys": list(ex.right_keys),
+                "outer": ex.outer,
                 "output_names": [f.name for f in ex.schema]})
             return fi, ni
         from risingwave_tpu.stream.executors.top_n import (

@@ -199,7 +199,7 @@ class StreamPlanner:
 
     def __init__(self, catalog: Catalog, store, local, definition: str,
                  mesh=None, actors=None, dist_parallelism: int = 1,
-                 join_state_cap=None):
+                 join_state_cap=None, inline_mvs=None):
         self.catalog = catalog
         self.store = store
         self.local = local           # LocalBarrierManager
@@ -214,6 +214,11 @@ class StreamPlanner:
         # cold-state tier (evict to the state table, reload on probe
         # miss — managed_state/join/mod.rs:379-420)
         self.join_state_cap = join_state_cap
+        # name → (select AST, eowc): FROM <mv> replans the view's
+        # definition INLINE instead of attaching to its live actor —
+        # the distributed session's MV-on-MV form (classic view
+        # expansion; no cross-job edges needed, every fragment ships)
+        self.inline_mvs = dict(inline_mvs or {})
         self.actors = actors or {}   # actor_id → Actor (MV-on-MV attach)
         self.readers: Dict[int, object] = {}
         # chain edges produced by _chain_upstream_mv, attached by the
@@ -230,17 +235,8 @@ class StreamPlanner:
         from risingwave_tpu.stream.exchange import channel_for_test
 
         if isinstance(item, ast.Subquery):
-            # derived table (binder/bind_query subquery analog): plan
-            # the inner SELECT as this fragment's upstream chain; its
-            # hidden pk columns stay in the executor schema but out of
-            # the visible scope
-            ex, _pk, deps, n_vis = self._plan_query(
-                item.select, self._actor_id, rate_limit, min_chunks)
-            self._wm_scope_cols = set()   # wm feed unproven through
-            self._eowc_wm_col = None      # inner value is meaningless
-            #                               against the OUTER schema
-            vis = Schema(list(ex.schema)[:n_vis])
-            return ex, Scope(vis, [item.alias] * n_vis), deps
+            return self._plan_derived(item.select, item.alias,
+                                      rate_limit, min_chunks)
         if isinstance(item, (ast.Tumble, ast.Hop)):
             ref, alias = item.table, item.alias or item.table.name
         elif isinstance(item, ast.TableRef):
@@ -252,6 +248,18 @@ class StreamPlanner:
             if isinstance(item, (ast.Tumble, ast.Hop)):
                 raise PlanError(
                     "TUMBLE/HOP over an MV not supported yet")
+            inline = self.inline_mvs.get(obj.name)
+            if inline is not None:
+                sel_i, eowc_i = inline
+                if eowc_i:
+                    raise PlanError(
+                        "cannot inline an EMIT ON WINDOW CLOSE view")
+                ex, scope, deps = self._plan_derived(
+                    sel_i, alias, rate_limit, min_chunks)
+                # the VIEW name joins the dep list: DROP of the base
+                # view must refuse while this consumer runs (the
+                # in-process chain branch records it the same way)
+                return ex, scope, deps + [obj.name]
             ex, scope = self._chain_upstream_mv(obj, alias)
             return ex, scope, [obj.name]
         assert isinstance(obj, SourceCatalog)
@@ -333,6 +341,25 @@ class StreamPlanner:
             scope = Scope(ex.schema,
                           scope.qualifiers + [alias, alias])
         return ex, scope, [obj.name]
+
+    def _plan_derived(self, sel, alias, rate_limit, min_chunks):
+        """Derived table: plan an inner SELECT as this fragment's
+        upstream chain (binder/bind_query subquery analog — shared by
+        FROM-subqueries and inlined views). Hidden pk columns stay in
+        the executor schema but out of the visible scope; the derived
+        pk is STAMPED onto the executor so a consumer join keys its
+        state by it — fresh row ids instead would orphan every U-
+        retraction half and leave stale rows in join state."""
+        from risingwave_tpu.stream.executor import ExecutorInfo
+
+        ex, pk, deps, n_vis = self._plan_query(
+            sel, self._actor_id, rate_limit, min_chunks)
+        ex._info = ExecutorInfo(ex.schema, list(pk), ex.identity)
+        self._wm_scope_cols = set()   # wm feed unproven through
+        self._eowc_wm_col = None      # inner value is meaningless
+        #                               against the OUTER schema
+        vis = Schema(list(ex.schema)[:n_vis])
+        return ex, Scope(vis, [alias] * n_vis), deps
 
     def _chain_upstream_mv(self, mv: MvCatalog, alias: str):
         """FROM <mv>: attach a new output to the upstream MV's actor

@@ -715,7 +715,8 @@ class Frontend:
                 self.catalog._next_id = mv.id_base
                 planner = StreamPlanner(
                     self.catalog, self.store, self.local,
-                    definition="", mesh=mesh, actors=self.actors)
+                    definition="", mesh=mesh, actors=self.actors,
+                    join_state_cap=self.join_state_cap)
                 actor_id = self._next_actor
                 self._next_actor += 1
                 try:

@@ -201,6 +201,10 @@ class S3ObjectStore:
         import hashlib
         import http.client
         from urllib.parse import quote
+        if query:
+            # SigV4 canonicalizes query params SORTED; sending them in
+            # the same order keeps signature and request identical
+            query = "&".join(sorted(query.split("&")))
         uri = "/" + quote(f"{self.bucket}/{self._key(path)}"
                           if path else self.bucket)
         payload_hash = hashlib.sha256(body).hexdigest()

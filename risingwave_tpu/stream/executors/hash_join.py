@@ -1079,12 +1079,6 @@ class HashJoinExecutor(Executor):
         codec = self.sides[0].key_codec
         if not codec.interners:
             return
-        if any(side.cold_keys for side in self.sides):
-            # cold keys' lane tuples encode interner ids: retiring an
-            # id a COLD key holds would dangle its marker (a re-intern
-            # under a new id misses reload; id reuse cross-matches
-            # unrelated keys). GC resumes once cold keys drain.
-            return
         total = codec.interner_entries()
         live_refs = sum(len(s.pk_to_ref) for s in self.sides)
         if total < self.INTERNER_GC_MIN or \
@@ -1101,6 +1095,15 @@ class HashJoinExecutor(Executor):
                                    count=len(side.pk_to_ref))
                 ok = side.arena.valid[col][refs]
                 vals.extend(side.arena.cols[col][refs][ok].tolist())
+            for side in self.sides:
+                # COLD keys pin their interned values: retiring an id
+                # a cold marker holds would dangle it (a re-intern
+                # under a new id misses reload; id reuse cross-matches
+                # unrelated keys). vt is ordered by key position, like
+                # the codec's interners.
+                for vt in side.cold_keys.values():
+                    if vt[pos] is not None:
+                        vals.append(vt[pos])
             it.gc(vals)
 
     def _recover_degrees(self) -> None:

@@ -122,8 +122,24 @@ class TemporalJoinExecutor(Executor):
         first_r = await rit.__anext__()
         assert is_barrier(first_l) and is_barrier(first_r)
         yield first_l
+        # left messages BUFFER within the epoch and probe at the
+        # barrier, after every right row of the epoch has applied:
+        # probe-vs-arrangement interleave is then deterministic (all
+        # rights ≤ epoch N are visible to lefts of epoch N) — the same
+        # answer in process and across a cluster exchange, instead of
+        # racy as-of-arrival processing time. One barrier of added
+        # probe latency, matching the epoch-batched kernel stance.
+        left_buf: List[Message] = []
         async for tag, msg in barrier_align_2(lit, rit):
             if tag == "barrier":
+                for m in left_buf:
+                    if isinstance(m, StreamChunk):
+                        out = self._probe_left(m)
+                        if out is not None:
+                            yield out
+                    else:
+                        yield m          # left watermark, in order
+                left_buf.clear()
                 yield msg
             elif tag == "right":
                 if isinstance(msg, StreamChunk):
@@ -131,9 +147,7 @@ class TemporalJoinExecutor(Executor):
                 # right-side watermarks do not bound the output
             else:                                    # left
                 if isinstance(msg, StreamChunk):
-                    out = self._probe_left(msg)
-                    if out is not None:
-                        yield out
+                    left_buf.append(msg)
                 elif isinstance(msg, Watermark):
                     if msg.col_idx < self.n_left:
-                        yield msg
+                        left_buf.append(msg)

@@ -265,3 +265,83 @@ def test_dist_two_phase_agg(tmp_path):
                                "SELECT * FROM q7")
     assert got == expect
     assert len(got) > 2
+
+
+def test_dist_adctr_two_workers(tmp_path):
+    """ad-ctr (BASELINE config #5) across 2 workers: filelog sources →
+    HOP windows → hash join → TEMPORAL dim join (arrangement broadcast
+    to every join actor; the dim view distributes by inlining its
+    definition) → two-phase agg. Oracle = the in-process session."""
+    import json as _json
+    import os
+
+    import numpy as np
+
+    n_impressions, n_ads, click_every = 900, 12, 3
+    base_ts = 1_700_000_000_000_000
+    data = str(tmp_path / "logs")
+    os.makedirs(data)
+    rng = np.random.default_rng(7)
+    ads = rng.integers(0, n_ads, n_impressions)
+    with open(os.path.join(data, "impressions-0.log"), "wb") as f:
+        for i in range(n_impressions):
+            f.write(_json.dumps({
+                "bid_id": i, "ad_id": int(ads[i]),
+                "its": base_ts + i * 10_000}).encode() + b"\n")
+    with open(os.path.join(data, "clicks-0.log"), "wb") as f:
+        for i in range(0, n_impressions, click_every):
+            f.write(_json.dumps({
+                "cbid": i, "cts": base_ts + i * 10_000 + 500}).encode()
+                + b"\n")
+
+    sqls = [
+        f"CREATE SOURCE impression (bid_id BIGINT, ad_id BIGINT, "
+        f"its TIMESTAMP) WITH (connector='filelog', path='{data}', "
+        f"topic='impressions')",
+        f"CREATE SOURCE click (cbid BIGINT, cts TIMESTAMP) WITH "
+        f"(connector='filelog', path='{data}', topic='clicks')",
+        "CREATE MATERIALIZED VIEW ad_dim AS SELECT ad_id, count(*) "
+        "AS seen FROM impression GROUP BY ad_id",
+        "CREATE MATERIALIZED VIEW ad_ctr AS SELECT i.ad_id, "
+        "i.window_start, count(*) AS clicked "
+        "FROM HOP(impression, its, INTERVAL '2' SECOND, "
+        "INTERVAL '10' SECOND) AS i "
+        "JOIN click AS c ON i.bid_id = c.cbid "
+        "JOIN ad_dim AS d FOR SYSTEM_TIME AS OF PROCTIME() "
+        "ON i.ad_id = d.ad_id "
+        "GROUP BY i.ad_id, i.window_start",
+    ]
+
+    async def run_dist():
+        fe = DistFrontend(str(tmp_path / "cluster"), n_workers=2,
+                          parallelism=2)
+        await fe.start()
+        try:
+            for s in sqls:
+                await fe.execute(s)
+            await fe.step(30)
+            ctr = {tuple(r)
+                   for r in await fe.execute("SELECT * FROM ad_ctr")}
+            dim = {tuple(r)
+                   for r in await fe.execute("SELECT * FROM ad_dim")}
+            return ctr, dim
+        finally:
+            await fe.close()
+
+    async def run_local():
+        fe = Frontend(min_chunks=8)
+        for s in sqls:
+            await fe.execute(s)
+        await fe.step(30)
+        ctr = {tuple(r)
+               for r in await fe.execute("SELECT * FROM ad_ctr")}
+        dim = {tuple(r)
+               for r in await fe.execute("SELECT * FROM ad_dim")}
+        await fe.close()
+        return ctr, dim
+
+    got_ctr, got_dim = asyncio.run(run_dist())
+    exp_ctr, exp_dim = asyncio.run(run_local())
+    assert got_dim == exp_dim
+    assert got_ctr == exp_ctr
+    assert len(got_ctr) > 5

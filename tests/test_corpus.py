@@ -424,3 +424,36 @@ def test_tpch_q18_large_volume_orders():
     expect = {(ok, odate[ok], t) for ok, t in total.items() if t > 140}
     assert set(map(tuple, rows)) == expect
     assert len(rows) > 0
+
+
+def test_nexmark_q101_small_epochs_no_stale_rows():
+    """q101 with MANY small epochs: per-epoch MAX updates retract
+    through the join by the derived table's pk — a fresh-row-id wrap
+    would leave stale max rows (regression: derived-table pk
+    stamping)."""
+    async def run():
+        fe = Frontend(min_chunks=2, rate_limit=2)
+        for t in ("bid", "auction"):
+            await fe.execute(
+                f"CREATE SOURCE {t} WITH (connector='nexmark', "
+                f"nexmark.table.type='{t}', nexmark.event.num={N_EVENTS}, "
+                f"nexmark.max.chunk.size=64, "
+                f"nexmark.min.event.gap.in.ns={GAP_NS})")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW q101s AS SELECT a.id, b.m "
+            "FROM auction AS a JOIN ("
+            "  SELECT auction, MAX(price) AS m FROM bid "
+            "  GROUP BY auction) AS b ON a.id = b.auction")
+        await fe.step(40)
+        rows = await fe.execute("SELECT * FROM q101s")
+        await fe.close()
+        return rows
+
+    rows = asyncio.run(run())
+    bids, aucs, _p = _gen()
+    mx = {}
+    for a, p in zip(bids["auction"].tolist(), bids["price"].tolist()):
+        mx[a] = max(mx.get(a, 0), p)
+    ids = set(aucs["id"].tolist())
+    expect = {(a, m) for a, m in mx.items() if a in ids}
+    assert set(map(tuple, rows)) == expect
