@@ -277,40 +277,33 @@ def _bench_adctr_subprocess() -> dict:
     virtual — the result is labeled accordingly)."""
     import os
     import subprocess
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    out = subprocess.run(
-        [sys.executable, __file__, "--adctr-sub"],
-        capture_output=True, timeout=1200, env=env)
-    for line in reversed(out.stdout.decode().splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            return json.loads(line)
-    raise RuntimeError(
-        f"adctr subprocess produced no JSON: rc={out.returncode} "
-        f"stderr={out.stderr.decode()[-300:]!r}")
+    return _run_bench_subprocess(
+        ["--adctr-sub"],
+        {"JAX_PLATFORMS": "cpu",
+         "XLA_FLAGS": "--xla_force_host_platform_device_count=4"},
+        timeout=1200)
 
 
-def _probe_device(timeout_s: int = 180, attempts: int = 2) -> None:
-    """Fail over to CPU if the TPU backend cannot initialize.
+def _probe_device(timeout_s: int = 180, attempts: int = 2) -> str:
+    """Probe the device backend IN A SUBPROCESS and return the platform.
 
     The axon tunnel can wedge (a killed client's remote claim takes
     time to expire); jax backend init then blocks with no timeout and
-    the whole bench run would hang. Probe in a subprocess first with
-    retries (a wedged claim usually expires within minutes — VERDICT r2
-    lost the round's TPU number to a single-shot probe); only after all
-    attempts fail, force this process onto the CPU backend so the bench
-    still reports a (clearly-labeled) number instead of nothing."""
+    the whole bench run would hang. The PARENT never initializes a
+    device client itself — each per-query child owns the chip in turn
+    (a parent client alive alongside a child client is exactly the
+    two-concurrent-clients condition that wedges the tunnel). On probe
+    failure, force CPU in the env so every child inherits it."""
     import os
     import subprocess
     import time
     for i in range(attempts):
         try:
-            subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
+            out = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
                 timeout=timeout_s, capture_output=True, check=True)
-            return
+            return out.stdout.decode().strip().splitlines()[-1]
         except (subprocess.SubprocessError, OSError):
             print(f"WARNING: device probe {i + 1}/{attempts} failed",
                   file=sys.stderr)
@@ -319,8 +312,7 @@ def _probe_device(timeout_s: int = 180, attempts: int = 2) -> None:
     print("WARNING: device backend unreachable — benching on CPU",
           file=sys.stderr)
     os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
-    jax.config.update("jax_platforms", "cpu")
+    return "cpu"
 
 
 def main(argv):
@@ -330,8 +322,10 @@ def main(argv):
     # Chip discipline (VERDICT r3): hold the exclusive chip lock for
     # the WHOLE run (probe included — the probe subprocess is itself a
     # TPU client). Two concurrent clients wedge the tunnel for minutes.
+    # Per-query child subprocesses inherit the parent's lock.
     lock = contextlib.nullcontext() \
-        if os.environ.get("JAX_PLATFORMS") == "cpu" else chip_lock()
+        if (os.environ.get("JAX_PLATFORMS") == "cpu"
+            or os.environ.get("RW_TPU_CHIP_LOCK_HELD")) else chip_lock()
     try:
         lock.__enter__()
     except ChipBusy as e:
@@ -345,8 +339,53 @@ def main(argv):
         lock.__exit__(None, None, None)
 
 
+BENCH_FNS = {}
+
+
+def _run_bench_subprocess(args: list, env_overrides: dict,
+                          timeout: int = 1800) -> dict:
+    """Spawn a bench child and parse its one JSON line (shared by the
+    per-query and adctr runners — keep the scan/error shape in one
+    place)."""
+    import os
+    import subprocess
+    env = dict(os.environ)
+    env.update(env_overrides)
+    out = subprocess.run([sys.executable, __file__] + args,
+                         capture_output=True, timeout=timeout, env=env)
+    for line in reversed(out.stdout.decode().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(
+        f"bench {args} subprocess produced no JSON: "
+        f"rc={out.returncode} stderr={out.stderr.decode()[-300:]!r}")
+
+
+def _bench_one_subprocess(name: str) -> dict:
+    """Run ONE query's warmup+measure in a fresh subprocess: queries
+    measured back-to-back in one process interfere (q7 halves after
+    q8's run — accumulated allocator/registry state), so isolation is
+    part of the methodology. The child inherits the parent's platform
+    env and skips the chip lock the parent already holds."""
+    return _run_bench_subprocess(["--one", name],
+                                 {"RW_TPU_CHIP_LOCK_HELD": "1"})
+
+
 def _main_locked(argv):
     from risingwave_tpu.utils.jaxtools import enable_compilation_cache
+    if "--one" in argv:
+        # child mode: one query, full-scale warmup then measure
+        import os
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        enable_compilation_cache()
+        name = argv[argv.index("--one") + 1]
+        fn = BENCH_FNS[name]
+        fn()
+        print(json.dumps(fn()))
+        return
     if "--adctr-sub" in argv:
         # child mode: env asks for the CPU virtual mesh, but the axon
         # sitecustomize overrides JAX_PLATFORMS at interpreter start —
@@ -364,16 +403,11 @@ def _main_locked(argv):
         return
     import os
     if os.environ.get("JAX_PLATFORMS") == "cpu":
-        # explicit CPU run: pin past the axon sitecustomize (which
-        # rewrites jax_platforms at interpreter start) instead of
-        # probing a chip the caller asked us not to touch
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+        # explicit CPU run: children pin past the axon sitecustomize
+        # themselves; the parent touches no backend at all
+        platform = "cpu"
     else:
-        _probe_device()
-    enable_compilation_cache()
-    import jax
-    platform = jax.devices()[0].platform
+        platform = _probe_device()
     quick = "--quick" in argv
     # Every query lands in the ONE captured headline line (VERDICT r2:
     # stderr tables are not recorded by the driver). Per-query isolation:
@@ -384,19 +418,13 @@ def _main_locked(argv):
     # warmups run at FULL scale (warm_kw = {}): a smaller warmup
     # leaves capacity-growth XLA compiles inside the timed run — the
     # timed number then measures the compiler, not the pipeline
-    benches = [("q7", bench_q7, {}),
-               ("q8", bench_q8, {}),
-               ("q4", bench_q4, {}),
-               ("q3", bench_q3, {}),
-               ("q5", bench_q5, {}),
-               ("q1", bench_q1, {})]
+    names = ["q7", "q8", "q4", "q3", "q5", "q1"]
     if quick:
-        benches = benches[:1]
+        names = names[:1]
     headline = {}
-    for name, fn, warm_kw in benches:
+    for name in names:
         try:
-            fn(**warm_kw)                            # warmup (traced)
-            r = fn()
+            r = _bench_one_subprocess(name)
             headline[name] = {k: r[k] for k in
                               ("value", "p99_barrier_latency_s",
                                "barrier_in_flight", "events")}
@@ -408,11 +436,7 @@ def _main_locked(argv):
         # measures on a 4-virtual-device CPU mesh in a subprocess
         # (clearly labeled) so the parallel path always has a number
         try:
-            if len(jax.devices()) >= 4:
-                r = bench_adctr()
-                r["platform"] = f"{platform}-mesh-{r['parallelism']}"
-            else:
-                r = _bench_adctr_subprocess()
+            r = _bench_adctr_subprocess()
             headline["adctr"] = {
                 k: r[k] for k in ("value", "p99_barrier_latency_s",
                                   "barrier_in_flight", "events",
@@ -436,6 +460,10 @@ def _main_locked(argv):
         "platform": platform,
     })
     print(json.dumps(headline))
+
+
+BENCH_FNS.update({"q7": bench_q7, "q8": bench_q8, "q4": bench_q4,
+                  "q3": bench_q3, "q5": bench_q5, "q1": bench_q1})
 
 
 if __name__ == "__main__":

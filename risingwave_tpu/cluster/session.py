@@ -148,8 +148,14 @@ class DistFrontend:
         plan = planner.plan(stmt.name, stmt.select, actor_id=0,
                             rate_limit=self.rate_limit,
                             min_chunks=self.min_chunks)
-        assert not plan.attaches, \
-            "inlined views must not produce chain attaches"
+        if plan.attaches:
+            # every FROM <mv> should have inlined (the dict holds all
+            # session-created views); a chain attach here means a
+            # catalog/selects mismatch — refuse rather than ship a
+            # graph with dangling attach edges
+            raise PlanError(
+                "internal: distributed plan produced chain attaches "
+                "(view not inlined?) — cannot deploy")
         graph = Fragmenter(self.parallelism).lower(plan.consumer)
         await self.cluster.deploy_graph(stmt.name, graph)
         await self.cluster.step(1)         # activation barrier

@@ -1080,7 +1080,11 @@ class HashJoinExecutor(Executor):
         if not codec.interners:
             return
         total = codec.interner_entries()
-        live_refs = sum(len(s.pk_to_ref) for s in self.sides)
+        # COLD keys count as live in the gate: their values are pinned
+        # below, so running GC while they dominate would scan O(cold)
+        # every barrier to retire almost nothing
+        live_refs = sum(len(s.pk_to_ref) + len(s.cold_keys)
+                        for s in self.sides)
         if total < self.INTERNER_GC_MIN or \
                 total <= 2 * live_refs * len(codec.interners):
             return
