@@ -303,7 +303,10 @@ def _probe_device(timeout_s: int = 180, attempts: int = 2) -> str:
                 [sys.executable, "-c",
                  "import jax; print(jax.devices()[0].platform)"],
                 timeout=timeout_s, capture_output=True, check=True)
-            return out.stdout.decode().strip().splitlines()[-1]
+            lines = out.stdout.decode().strip().splitlines()
+            if lines:
+                return lines[-1]
+            raise OSError("probe printed no platform")
         except (subprocess.SubprocessError, OSError):
             print(f"WARNING: device probe {i + 1}/{attempts} failed",
                   file=sys.stderr)

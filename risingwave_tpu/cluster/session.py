@@ -77,6 +77,14 @@ class DistFrontend:
         # name → (select AST, eowc): FROM <mv> inlines the view's
         # definition (distributed MV-on-MV by view expansion)
         self._mv_selects = {}
+        # session vars (the in-process session's surface, minus knobs
+        # that have no distributed meaning yet)
+        self._VAR_ATTRS = {"streaming_rate_limit": "rate_limit",
+                           "streaming_min_chunks": "min_chunks",
+                           "parallelism": "parallelism"}
+        self._var_defaults = {"streaming_rate_limit": self.rate_limit,
+                              "streaming_min_chunks": self.min_chunks,
+                              "parallelism": self.parallelism}
 
     async def start(self) -> None:
         await self.cluster.start()
@@ -109,7 +117,28 @@ class DistFrontend:
             return await self._create_mv(stmt)
         if isinstance(stmt, ast.DropMaterializedView):
             return await self._drop_mv(stmt)
+        if isinstance(stmt, ast.SetVar):
+            if stmt.name not in self._var_defaults:
+                raise PlanError("unrecognized configuration "
+                                f"parameter {stmt.name!r}")
+            attr = self._VAR_ATTRS[stmt.name]
+            value = stmt.value
+            if value is None:
+                value = self._var_defaults[stmt.name]
+            elif not isinstance(value, int) or isinstance(value, bool):
+                raise PlanError(f"{stmt.name} must be an integer")
+            setattr(self, attr, value)
+            return "SET"
         if isinstance(stmt, ast.Show):
+            if stmt.what == "var:all":
+                return [(n, str(getattr(self, self._VAR_ATTRS[n])))
+                        for n in sorted(self._var_defaults)]
+            if stmt.what.startswith("var:"):
+                name = stmt.what[4:].lower()
+                if name not in self._var_defaults:
+                    raise PlanError("unrecognized configuration "
+                                    f"parameter {name!r}")
+                return [(str(getattr(self, self._VAR_ATTRS[name])),)]
             if stmt.what == "sources":
                 return [(n,) for n in sorted(self.catalog.sources)]
             return [(n,) for n in sorted(self.catalog.mvs)]

@@ -230,7 +230,17 @@ class Update:
 
 @dataclass
 class Show:
-    what: str                          # "tables" | "materialized views" | "sources"
+    what: str    # "tables" | "materialized views" | "sources" |
+    #              "sinks" | "all" (session vars) | "var:<name>"
+
+
+@dataclass
+class SetVar:
+    """SET <name> = <value> — session configuration
+    (src/common/src/session_config/ analog)."""
+
+    name: str
+    value: object
 
 
 @dataclass

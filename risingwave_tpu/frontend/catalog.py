@@ -38,6 +38,9 @@ class MvCatalog:
     # plumbing (_row_id, unprojected group keys) that SELECT * and
     # downstream scopes must not expose (None = all visible)
     n_visible: Optional[int] = None
+    # CREATE TABLE jobs share this registry; system catalogs and SHOW
+    # split on it
+    is_table: bool = False
 
     @property
     def visible_schema(self) -> Schema:

@@ -243,6 +243,33 @@ class Parser:
             return ast.Show("sources")
         if self._kw("show", "sinks"):
             return ast.Show("sinks")
+        if self._kw("show"):
+            # SHOW <session variable> ("all" is an ident — SHOW ALL
+            # arrives as var:all and lists every variable)
+            return ast.Show("var:" + self._ident())
+        if self._kw("set"):
+            name = self._ident()
+            if not self._op("="):
+                kind, text = self._next()
+                if not (kind in ("kw", "ident")
+                        and text.lower() == "to"):
+                    raise ParseError(
+                        f"expected = or TO after SET, got {text!r}")
+            kind, text = self._next()
+            if kind == "number":
+                value = int(text) if "." not in text else float(text)
+            elif kind == "string":
+                # string tokens are quote-delimited with '' escapes
+                # (same rule as _string())
+                value = text[1:-1].replace("''", "'")
+            elif kind in ("kw", "ident"):
+                low = text.lower()
+                value = {"true": True, "false": False,
+                         "on": True, "off": False,
+                         "default": None}.get(low, text)
+            else:
+                raise ParseError(f"bad SET value {text!r}")
+            return ast.SetVar(name.lower(), value)
         if self._kw("flush"):
             return ast.Flush()
         if self._kw("explain"):

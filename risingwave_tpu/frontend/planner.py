@@ -1165,6 +1165,38 @@ def _equi_keys(on: ast.Expr, lscope: Scope, rscope: Scope
     return lkeys, rkeys
 
 
+def _system_catalog_rows(name: str, catalog: Catalog):
+    """rw_catalog-style system tables (src/frontend/src/catalog/
+    system_catalog/ analog, bare-named): introspection over the live
+    catalog, served as batch values. Returns (schema, rows) or None."""
+    n = name.lower()
+    if n in ("rw_materialized_views", "rw_tables"):
+        want_tables = n == "rw_tables"
+        sch = Schema([Field("name", DataType.VARCHAR),
+                      Field("table_id", DataType.INT64),
+                      Field("actor_id", DataType.INT64),
+                      Field("definition", DataType.VARCHAR)])
+        rows = [(m.name, m.table_id, m.actor_id, m.definition or "")
+                for m in catalog.mvs.values()
+                if m.is_table == want_tables]
+        return sch, sorted(rows)
+    if n == "rw_sources":
+        sch = Schema([Field("name", DataType.VARCHAR),
+                      Field("connector", DataType.VARCHAR),
+                      Field("columns", DataType.INT64)])
+        rows = [(s.name, s.options.get("connector", ""),
+                 len(s.schema))
+                for s in catalog.sources.values()]
+        return sch, sorted(rows)
+    if n == "rw_sinks":
+        sch = Schema([Field("name", DataType.VARCHAR),
+                      Field("connector", DataType.VARCHAR)])
+        rows = [(s.name, s.options.get("connector", ""))
+                for s in catalog.sinks.values()]
+        return sch, sorted(rows)
+    return None
+
+
 # -- batch planning -------------------------------------------------------
 
 
@@ -1215,7 +1247,17 @@ def plan_batch(sel: ast.Select, catalog: Catalog, store, epoch: int):
             return sub, Scope.of(sub.schema, item.alias)
         if not isinstance(item, ast.TableRef):
             raise PlanError("batch FROM supports tables/MVs")
-        obj = catalog.resolve(item.name)
+        try:
+            obj = catalog.resolve(item.name)
+        except Exception:
+            # USER objects win over system catalogs (pg search-path
+            # spirit); only an unresolved name falls through to rw_*
+            sysrows = _system_catalog_rows(item.name, catalog)
+            if sysrows is None:
+                raise
+            sch, rows = sysrows
+            return (BatchValues(sch, rows),
+                    Scope.of(sch, item.alias or item.name))
         if isinstance(obj, SourceCatalog):
             raise PlanError("cannot batch-scan a pure source; "
                             "create a materialized view over it")

@@ -1,0 +1,134 @@
+"""Session config (SET/SHOW) + rw_* system catalogs (VERDICT r4
+missing #9: src/common/src/session_config/ and
+src/frontend/src/catalog/system_catalog/ analogs)."""
+
+import asyncio
+
+import pytest
+
+from risingwave_tpu.frontend.planner import PlanError
+from risingwave_tpu.frontend.session import Frontend
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def test_set_show_session_vars():
+    async def run():
+        fe = Frontend(min_chunks=4)
+        assert await fe.execute("SET streaming_rate_limit = 4") == "SET"
+        assert await fe.execute("SHOW streaming_rate_limit") == [("4",)]
+        await fe.execute("SET application_name = 'psql-test'")
+        assert await fe.execute("SHOW application_name") == \
+            [("psql-test",)]
+        rows = dict(await fe.execute("SHOW ALL"))
+        assert rows["streaming_rate_limit"] == "4"
+        assert rows["application_name"] == "psql-test"
+        # TO DEFAULT restores the session's construction-time value
+        await fe.execute("SET streaming_rate_limit TO default")
+        assert await fe.execute("SHOW streaming_rate_limit") == [("8",)]
+        with pytest.raises(PlanError, match="unrecognized"):
+            await fe.execute("SET no_such_var = 1")
+        with pytest.raises(PlanError, match="unrecognized"):
+            await fe.execute("SHOW no_such_var")
+        await fe.close()
+
+    _run(run())
+
+
+def test_set_vars_bind_to_new_jobs():
+    """Typed knobs feed future CREATEs: join_state_cap set via SQL
+    lands on the next join's executor sides."""
+    async def run():
+        fe = Frontend(min_chunks=4)
+        for t in ("person", "auction"):
+            await fe.execute(
+                f"CREATE SOURCE {t} WITH (connector='nexmark', "
+                f"nexmark.table.type='{t}', nexmark.event.num=2000)")
+        await fe.execute("SET join_state_cap = 32")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW j AS SELECT p.id FROM person "
+            "AS p JOIN auction AS a ON p.id = a.seller")
+        await fe.step(3)
+        join = None
+        for a in fe.actors.values():
+            ex = a.consumer
+            while ex is not None and not hasattr(ex, "sides"):
+                ex = getattr(ex, "input", None)
+            if ex is not None:
+                join = ex
+        assert join is not None
+        assert all(s.state_cap == 32 for s in join.sides)
+        await fe.close()
+
+    _run(run())
+
+
+def test_system_catalog_tables():
+    async def run():
+        fe = Frontend(min_chunks=4)
+        await fe.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', "
+            "nexmark.table.type='bid', nexmark.event.num=2000)")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW m AS SELECT auction, count(*) "
+            "AS c FROM bid GROUP BY auction")
+        await fe.step(2)
+        mvs = await fe.execute(
+            "SELECT name FROM rw_materialized_views")
+        assert ("m",) in mvs
+        srcs = await fe.execute(
+            "SELECT name, connector FROM rw_sources")
+        assert ("bid", "nexmark") in srcs
+        # system tables compose with the batch surface
+        cnt = await fe.execute(
+            "SELECT count(*) AS n FROM rw_sources")
+        assert cnt == [(1,)]
+        await fe.close()
+
+    _run(run())
+
+
+def test_user_table_shadows_system_catalog():
+    """A user table named rw_sources wins over the system view."""
+    async def run():
+        fe = Frontend(min_chunks=4)
+        await fe.execute("CREATE TABLE rw_sources (x INT)")
+        await fe.execute("INSERT INTO rw_sources VALUES (7)")
+        rows = await fe.execute("SELECT x FROM rw_sources")
+        assert rows == [(7,)]
+        await fe.close()
+
+    _run(run())
+
+
+def test_rw_tables_vs_mvs_split():
+    async def run():
+        fe = Frontend(min_chunks=4)
+        await fe.execute("CREATE TABLE t (x INT)")
+        await fe.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', "
+            "nexmark.table.type='bid', nexmark.event.num=1000)")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW m AS SELECT auction FROM bid")
+        await fe.step(2)
+        tables = await fe.execute("SELECT name FROM rw_tables")
+        mvs = await fe.execute(
+            "SELECT name FROM rw_materialized_views")
+        assert tables == [("t",)]
+        assert mvs == [("m",)]
+        await fe.close()
+
+    _run(run())
+
+
+def test_set_string_unescaping():
+    async def run():
+        fe = Frontend(min_chunks=4)
+        await fe.execute("SET application_name = 'it''s'")
+        assert await fe.execute("SHOW application_name") == \
+            [("it's",)]
+        await fe.close()
+
+    _run(run())
