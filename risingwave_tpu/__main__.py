@@ -9,6 +9,8 @@ backup operations against a data directory:
 
     python -m risingwave_tpu playground                # in-memory
     python -m risingwave_tpu serve --data-dir ./rwdata # durable
+    python -m risingwave_tpu serve-cluster --data-dir ./rw \
+        --workers 2                                    # N-worker
     python -m risingwave_tpu ctl --data-dir D meta catalog
     python -m risingwave_tpu ctl --data-dir D hummock version
     python -m risingwave_tpu ctl --data-dir D hummock list-ssts
@@ -52,6 +54,35 @@ async def _serve(args) -> None:
     finally:
         hb.cancel()
         await srv.close()
+
+
+async def _serve_cluster(args) -> None:
+    """pgwire over the DISTRIBUTED session: N worker processes under
+    one data dir, MVs fragment across them, psql talks to the
+    coordinator (frontend-node shape)."""
+    from risingwave_tpu.cluster.session import DistFrontend
+    from risingwave_tpu.frontend.pgwire import PgServer
+
+    fe = DistFrontend(args.data_dir, n_workers=args.workers,
+                      parallelism=args.parallelism or args.workers)
+    srv = PgServer(fe)
+    hb = None
+    try:
+        await fe.start()
+        # inside the try: a bind failure must still stop the worker
+        # subprocesses fe.start() just spawned
+        await srv.serve(args.host, args.port)
+        print(f"cluster of {args.workers} workers; listening on "
+              f"{args.host}:{srv.port} "
+              f"(psql -h {args.host} -p {srv.port})", file=sys.stderr)
+        hb = asyncio.ensure_future(fe.run_heartbeat())
+        await asyncio.wait({hb}, return_when=asyncio.FIRST_COMPLETED)
+        hb.result()
+    finally:
+        if hb is not None:
+            hb.cancel()
+        await srv.close()
+        await fe.close()
 
 
 def _ctl(args) -> int:
@@ -174,6 +205,13 @@ def main(argv=None) -> None:
         jax.config.update("jax_platforms", "cpu")
     p = argparse.ArgumentParser(prog="risingwave_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
+    sc = sub.add_parser("serve-cluster",
+                        help="pgwire over an N-worker cluster")
+    sc.add_argument("--data-dir", required=True)
+    sc.add_argument("--workers", type=int, default=2)
+    sc.add_argument("--parallelism", type=int, default=None)
+    sc.add_argument("--host", default="127.0.0.1")
+    sc.add_argument("--port", type=int, default=4566)
     for name in ("playground", "serve"):
         sp = sub.add_parser(name)
         sp.add_argument("--host", default="127.0.0.1")
@@ -201,6 +239,9 @@ def main(argv=None) -> None:
         sys.exit(_ctl(args))
     if not hasattr(args, "data_dir"):
         args.data_dir = None
+    if args.cmd == "serve-cluster":
+        asyncio.run(_serve_cluster(args))
+        return
     asyncio.run(_serve(args))
 
 

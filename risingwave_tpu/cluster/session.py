@@ -14,6 +14,7 @@ snapshot view and runs the ordinary batch planner over it.
 
 from __future__ import annotations
 
+import asyncio
 import bisect
 from typing import Dict, List, Optional, Union
 
@@ -77,14 +78,18 @@ class DistFrontend:
         # name → (select AST, eowc): FROM <mv> inlines the view's
         # definition (distributed MV-on-MV by view expansion)
         self._mv_selects = {}
-        # session vars (the in-process session's surface, minus knobs
-        # that have no distributed meaning yet)
-        self._VAR_ATTRS = {"streaming_rate_limit": "rate_limit",
-                           "streaming_min_chunks": "min_chunks",
-                           "parallelism": "parallelism"}
-        self._var_defaults = {"streaming_rate_limit": self.rate_limit,
-                              "streaming_min_chunks": self.min_chunks,
-                              "parallelism": self.parallelism}
+        # session vars (shared impl with the in-process session —
+        # session_vars.py; parallelism is the distributed knob)
+        from risingwave_tpu.frontend.session_vars import SessionVars
+        self.session_vars = SessionVars(
+            self, {"streaming_rate_limit": "rate_limit",
+                   "streaming_min_chunks": "min_chunks",
+                   "parallelism": "parallelism"})
+        # serializes barrier rounds between DDL, step(), SELECT
+        # snapshots and the background heartbeat (inject_and_collect
+        # is not reentrant; a heartbeat between per-table scans would
+        # tear a cross-MV snapshot)
+        self._barrier_lock = asyncio.Lock()
 
     async def start(self) -> None:
         await self.cluster.start()
@@ -93,10 +98,25 @@ class DistFrontend:
         await self.cluster.stop()
 
     async def step(self, n: int = 1) -> None:
-        await self.cluster.step(n)
+        async with self._barrier_lock:
+            await self.cluster.step(n)
 
     async def recover(self) -> None:
-        await self.cluster.recover()
+        async with self._barrier_lock:
+            await self.cluster.recover()
+
+    async def run_heartbeat(self, interval_s: float = 0.25) -> None:
+        """Background barrier heartbeat for server deployments — on
+        failure it recovers the cluster once, then re-raises if the
+        recovery barrier fails too (crash over serving stale MVs)."""
+        while True:
+            await asyncio.sleep(interval_s)
+            async with self._barrier_lock:
+                try:
+                    await self.cluster.step(1)
+                except Exception:
+                    await self.cluster.recover()
+                    await self.cluster.step(1)
 
     # -- statements -------------------------------------------------------
     async def execute(self, sql: str) -> Union[Rows, str]:
@@ -118,30 +138,26 @@ class DistFrontend:
         if isinstance(stmt, ast.DropMaterializedView):
             return await self._drop_mv(stmt)
         if isinstance(stmt, ast.SetVar):
-            if stmt.name not in self._var_defaults:
-                raise PlanError("unrecognized configuration "
-                                f"parameter {stmt.name!r}")
-            attr = self._VAR_ATTRS[stmt.name]
-            value = stmt.value
-            if value is None:
-                value = self._var_defaults[stmt.name]
-            elif not isinstance(value, int) or isinstance(value, bool):
-                raise PlanError(f"{stmt.name} must be an integer")
-            setattr(self, attr, value)
+            self.session_vars.set(stmt.name, stmt.value)
             return "SET"
         if isinstance(stmt, ast.Show):
             if stmt.what == "var:all":
-                return [(n, str(getattr(self, self._VAR_ATTRS[n])))
-                        for n in sorted(self._var_defaults)]
+                return self.session_vars.show_all()
             if stmt.what.startswith("var:"):
                 name = stmt.what[4:].lower()
-                if name not in self._var_defaults:
+                if not self.session_vars.known(name):
                     raise PlanError("unrecognized configuration "
                                     f"parameter {name!r}")
-                return [(str(getattr(self, self._VAR_ATTRS[name])),)]
+                return [(self.session_vars.get(name),)]
             if stmt.what == "sources":
                 return [(n,) for n in sorted(self.catalog.sources)]
-            return [(n,) for n in sorted(self.catalog.mvs)]
+            if stmt.what == "sinks":
+                return [(n,) for n in sorted(self.catalog.sinks)]
+            if stmt.what == "tables":
+                return [(n,) for n, m in sorted(self.catalog.mvs.items())
+                        if m.is_table]
+            return [(n,) for n, m in sorted(self.catalog.mvs.items())
+                    if not m.is_table]
         if isinstance(stmt, ast.Explain):
             from risingwave_tpu.frontend.planner import explain_tree
             planner = StreamPlanner(
@@ -154,7 +170,7 @@ class DistFrontend:
                                 min_chunks=self.min_chunks)
             return [(line,) for line in explain_tree(plan.consumer)]
         if isinstance(stmt, ast.Flush):
-            await self.cluster.step(1)
+            await self.step(1)
             return "FLUSH"
         if isinstance(stmt, ast.Select):
             return await self._select(stmt)
@@ -186,8 +202,9 @@ class DistFrontend:
                 "internal: distributed plan produced chain attaches "
                 "(view not inlined?) — cannot deploy")
         graph = Fragmenter(self.parallelism).lower(plan.consumer)
-        await self.cluster.deploy_graph(stmt.name, graph)
-        await self.cluster.step(1)         # activation barrier
+        async with self._barrier_lock:
+            await self.cluster.deploy_graph(stmt.name, graph)
+            await self.cluster.step(1)     # activation barrier
         self.catalog.add_mv(plan.mv)
         self._mv_selects[stmt.name] = (
             stmt.select, getattr(stmt, "emit_on_window_close", False))
@@ -203,7 +220,8 @@ class DistFrontend:
         if dependents:
             raise PlanError(f"cannot drop MV {stmt.name!r}: depended "
                             f"on by {dependents}")
-        await self.cluster.drop_job(stmt.name)
+        async with self._barrier_lock:
+            await self.cluster.drop_job(stmt.name)
         del self.catalog.mvs[stmt.name]
         self._mv_selects.pop(stmt.name, None)
         return "DROP_MATERIALIZED_VIEW"
@@ -211,10 +229,13 @@ class DistFrontend:
     async def _select(self, sel: ast.Select) -> Rows:
         from risingwave_tpu.batch import collect
 
-        import asyncio
         view = ClusterStoreView(self.cluster)
-        await asyncio.gather(*(view.prefetch(tid)
-                               for tid in self._referenced_table_ids(sel)))
+        # one consistent snapshot: the barrier lock keeps the
+        # heartbeat from committing an epoch between per-table scans
+        async with self._barrier_lock:
+            await asyncio.gather(
+                *(view.prefetch(tid)
+                  for tid in self._referenced_table_ids(sel)))
         ex = plan_batch(sel, self.catalog, view,
                         view.committed_epoch())
         self.last_select_schema = ex.schema

@@ -59,17 +59,15 @@ class Frontend:
         self.min_chunks = min_chunks
         # resident join-state cap (cold-tier eviction; None = unbounded)
         self.join_state_cap = join_state_cap
-        # session configuration (src/common/src/session_config/ analog):
-        # SET/SHOW mutate + read these; typed knobs bind to REAL planner
-        # inputs, the rest are pg-compatibility strings
-        self._session_var_defaults = {
-            "streaming_rate_limit": self.rate_limit,
-            "streaming_min_chunks": self.min_chunks,
-            "join_state_cap": self.join_state_cap,
-            "application_name": "",
-            "timezone": "UTC",
-        }
-        self._session_vars: Dict[str, object] = {}
+        # session configuration (src/common/src/session_config/
+        # analog): typed knobs bind to REAL planner inputs, the rest
+        # are pg-compatibility strings (shared impl: session_vars.py)
+        from risingwave_tpu.frontend.session_vars import SessionVars
+        self.session_vars = SessionVars(
+            self, {"streaming_rate_limit": "rate_limit",
+                   "streaming_min_chunks": "min_chunks",
+                   "join_state_cap": "join_state_cap"},
+            {"application_name": "", "timezone": "UTC"})
         self._next_actor = 1000
         self.chain_edges: Dict[str, list] = {}   # job → [(uid, Output)]
         # name → CREATE MV select AST (reschedule replans from this —
@@ -245,18 +243,18 @@ class Frontend:
         if isinstance(stmt, ast.Update):
             return await self._update(stmt)
         if isinstance(stmt, ast.SetVar):
-            return self._set_var(stmt)
+            self.session_vars.set(stmt.name, stmt.value)
+            return "SET"
         if isinstance(stmt, ast.Show):
             if stmt.what == "var:all":
-                return [(n, str(self._get_var(n)))
-                        for n in sorted(self._session_var_defaults)]
+                return self.session_vars.show_all()
             if stmt.what.startswith("var:"):
                 name = stmt.what[4:].lower()
-                if name not in self._session_var_defaults:
+                if not self.session_vars.known(name):
                     raise PlanError(
                         f"unrecognized configuration parameter "
                         f"{name!r}")
-                return [(str(self._get_var(name)),)]
+                return [(self.session_vars.get(name),)]
             if stmt.what == "sources":
                 return [(n,) for n in sorted(self.catalog.sources)]
             if stmt.what == "sinks":
@@ -875,40 +873,6 @@ class Frontend:
         return await self._drop_job(stmt.name, self.catalog.mvs,
                                     stmt.if_exists,
                                     "DROP_MATERIALIZED_VIEW")
-
-    _VAR_ATTRS = {"streaming_rate_limit": "rate_limit",
-                  "streaming_min_chunks": "min_chunks",
-                  "join_state_cap": "join_state_cap"}
-
-    def _get_var(self, name: str):
-        attr = self._VAR_ATTRS.get(name)
-        if attr is not None:
-            return getattr(self, attr)
-        return self._session_vars.get(
-            name, self._session_var_defaults[name])
-
-    def _set_var(self, stmt: ast.SetVar) -> str:
-        """SET <name> = <value> | TO DEFAULT. Typed knobs feed future
-        CREATE statements (existing jobs keep their plan-time values —
-        the reference's session-config semantics)."""
-        name = stmt.name
-        if name not in self._session_var_defaults:
-            raise PlanError(
-                f"unrecognized configuration parameter {name!r}")
-        value = stmt.value
-        attr = self._VAR_ATTRS.get(name)
-        if attr is not None:
-            if value is None:                  # TO DEFAULT
-                value = self._session_var_defaults[name]
-            elif not isinstance(value, int) or isinstance(value, bool):
-                raise PlanError(f"{name} must be an integer")
-            setattr(self, attr, value)
-        else:
-            if value is None:
-                self._session_vars.pop(name, None)
-            else:
-                self._session_vars[name] = value
-        return "SET"
 
     async def _select(self, sel: ast.Select) -> Rows:
         from risingwave_tpu.batch import collect

@@ -266,3 +266,39 @@ def test_pgwire_extended_protocol_dml():
         await fe.close()
 
     asyncio.run(run())
+
+
+def test_pgwire_over_distributed_cluster(tmp_path):
+    """psql-shaped traffic against the N-worker cluster session
+    (`serve-cluster` shape): DDL deploys fragments across worker
+    processes, SELECT gathers from their namespaces — all over the
+    wire protocol."""
+    from risingwave_tpu.cluster.session import DistFrontend
+
+    async def run():
+        fe = DistFrontend(str(tmp_path), n_workers=2, parallelism=2)
+        await fe.start()
+        srv = PgServer(fe)
+        await srv.serve(port=0)
+        try:
+            c = await _Client.connect(srv.port)
+            await c.query(
+                "CREATE SOURCE bid WITH (connector='nexmark', "
+                "nexmark.table.type='bid', nexmark.event.num=4000, "
+                "nexmark.min.event.gap.in.ns=50000000)")
+            msgs = await c.query(
+                "CREATE MATERIALIZED VIEW m AS SELECT auction, "
+                "count(*) AS c FROM bid GROUP BY auction")
+            assert any(t == b"C" for t, _p in msgs)
+            await fe.step(12)
+            msgs = await c.query("SELECT count(*) AS n FROM m")
+            rows = _rows(msgs)
+            assert len(rows) == 1 and int(rows[0][0]) > 10
+            msgs = await c.query("SHOW streaming_rate_limit")
+            assert _rows(msgs) == [("8",)]
+            c.close()
+        finally:
+            await srv.close()
+            await fe.close()
+
+    asyncio.run(run())
