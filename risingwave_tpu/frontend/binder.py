@@ -298,9 +298,65 @@ class Binder:
             args = [self.bind(a) for a in e.args]
             whens = list(zip(args[:-1:2], args[1:-1:2]))
             return Case(whens, args[-1])
-        # generic registered scalar function
+        # generic registered scalar function (sig/ analog: name →
+        # arity + return type; the expr registry holds the kernel)
+        sig = _SCALAR_SIGS.get(name)
+        if sig is None:
+            raise BindError(f"unknown function {name!r}")
+        lo, hi, rt = sig
+        if not (lo <= len(e.args) <= hi):
+            raise BindError(
+                f"{name}() takes {lo}"
+                + (f"..{hi}" if hi != lo else "")
+                + f" arguments, got {len(e.args)}")
         args = [self.bind(a) for a in e.args]
-        return FuncCall(name, args)
+        _check_scalar_args(name, e.args, args)
+        return FuncCall(name, args, rt)
+
+
+# scalar signatures: name → (min args, max args, return type)
+_SCALAR_SIGS = {
+    "lower": (1, 1, DataType.VARCHAR),
+    "upper": (1, 1, DataType.VARCHAR),
+    "char_length": (1, 1, DataType.INT64),
+    "length": (1, 1, DataType.INT64),
+    "substr": (2, 3, DataType.VARCHAR),
+    "split_part": (3, 3, DataType.VARCHAR),
+    "replace": (3, 3, DataType.VARCHAR),
+    "concat": (1, 64, DataType.VARCHAR),
+    "to_char": (2, 2, DataType.VARCHAR),
+    "date_part": (2, 2, DataType.INT64),
+    "date_trunc": (2, 2, DataType.TIMESTAMP),
+    "extract_epoch": (1, 1, DataType.DECIMAL),
+}
+
+_DATE_FIELDS = {"second", "minute", "hour", "year", "month", "day"}
+_TRUNC_FIELDS = {"second", "minute", "hour", "day"}
+
+
+def _check_scalar_args(name, raw_args, bound) -> None:
+    """Bind-time validation of LITERAL arguments: a bad field name or
+    position must fail the statement, not crash-loop the deployed
+    actor at eval time."""
+    from risingwave_tpu.expr.expr import Literal
+
+    def lit_of(i):
+        b = bound[i]
+        return b.value if isinstance(b, Literal) else None
+
+    if name in ("date_part", "date_trunc"):
+        f = lit_of(0)
+        if f is not None:
+            allowed = _DATE_FIELDS if name == "date_part" \
+                else _TRUNC_FIELDS
+            if str(f).lower() not in allowed:
+                raise BindError(
+                    f"{name} field {f!r} unsupported (one of "
+                    f"{sorted(allowed)})")
+    if name == "split_part":
+        k = lit_of(2)
+        if k is not None and int(k) == 0:
+            raise BindError("split_part position must not be zero")
 
 
 def _bind_lit(e: ast.Lit) -> Literal:
@@ -415,7 +471,12 @@ class PostAggBinder:
                 args = [self.bind(a) for a in e.args]
                 whens = list(zip(args[:-1:2], args[1:-1:2]))
                 return Case(whens, args[-1])
-            return FuncCall(e.name, [self.bind(a) for a in e.args])
+            sig = _SCALAR_SIGS.get(e.name)
+            if sig is None:
+                raise BindError(f"unknown function {e.name!r}")
+            args = [self.bind(a) for a in e.args]
+            _check_scalar_args(e.name, e.args, args)
+            return FuncCall(e.name, args, sig[2])
         raise BindError(
             f"expression {e!r} is neither grouped nor aggregated")
 

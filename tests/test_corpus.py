@@ -338,13 +338,13 @@ def test_tpch_q6_forecast_revenue():
 #               the hop-window top-1 core runs in test_e2e_q5
 #   q6          per-seller average of last 10 prices: needs
 #               group-top-n-then-agg chaining in one MV
-#   q10/q14/q21 need date/string scalar functions (to_char,
-#               date_format, split_part, regexp)
+#   q21         needs regexp_extract (split_part-only form runs as
+#               part of q22's coverage)
 #   q12         processing-time tumble (proctime())
 #   q13         side-input (bounded table) join
-#   q15-q19     count(distinct) over char/date projections of
-#               date_time (needs to_char); q18/q19 variants of q9/q105
-#               run above
+#   q16-q19     q16 needs filtered aggregates (COUNT(*) FILTER ...);
+#               q17 needs CASE-in-agg breadth; q18/q19 variants of
+#               q9/q105 run above
 #   q102/q104   scalar subquery over a grouped aggregate (avg of
 #               counts) in WHERE/HAVING
 
@@ -457,3 +457,114 @@ def test_nexmark_q101_small_epochs_no_stale_rows():
     ids = set(aucs["id"].tolist())
     expect = {(a, m) for a, m in mx.items() if a in ids}
     assert set(map(tuple, rows)) == expect
+
+
+def test_nexmark_q10_formatted_log():
+    rows = _run(
+        "CREATE MATERIALIZED VIEW q10 AS SELECT auction, bidder, "
+        "price, date_time, to_char(date_time, 'YYYY-MM-DD') AS dt, "
+        "to_char(date_time, 'HH24:MI') AS dm FROM bid",
+        "SELECT * FROM q10")
+    import datetime
+    bids, _a, _p = _gen()
+    epoch = datetime.datetime(1970, 1, 1,
+                              tzinfo=datetime.timezone.utc)
+
+    def fmt(us, f):
+        return (epoch + datetime.timedelta(
+            microseconds=int(us))).strftime(f)
+    expect = collections.Counter(
+        (a, b, p, t, fmt(t, "%Y-%m-%d"), fmt(t, "%H:%M"))
+        for a, b, p, t in zip(
+            bids["auction"].tolist(), bids["bidder"].tolist(),
+            bids["price"].tolist(), bids["date_time"].tolist()))
+    assert collections.Counter(map(tuple, rows)) == expect
+    assert len(rows) > 0
+
+
+def test_nexmark_q14_calculated_fields():
+    rows = _run(
+        "CREATE MATERIALIZED VIEW q14 AS SELECT auction, bidder, "
+        "0.908 * price AS price, CASE "
+        "WHEN date_part('hour', date_time) >= 8 AND "
+        "date_part('hour', date_time) <= 18 THEN 'dayTime' "
+        "WHEN date_part('hour', date_time) <= 6 OR "
+        "date_part('hour', date_time) >= 20 THEN 'nightTime' "
+        "ELSE 'otherTime' END AS bid_time_type, date_time "
+        "FROM bid WHERE 0.908 * price > 1000000",
+        "SELECT auction, bidder, price, bid_time_type FROM q14")
+    import decimal
+    bids, _a, _p = _gen()
+    rate = decimal.Decimal("0.908")
+
+    def btype(us):
+        h = (int(us) // 3_600_000_000) % 24
+        if 8 <= h <= 18:
+            return "dayTime"
+        if h <= 6 or h >= 20:
+            return "nightTime"
+        return "otherTime"
+    expect = collections.Counter()
+    for a, b, p, t in zip(bids["auction"].tolist(),
+                          bids["bidder"].tolist(),
+                          bids["price"].tolist(),
+                          bids["date_time"].tolist()):
+        adj = (rate * p).quantize(decimal.Decimal("0.0001"))
+        if adj > 1_000_000:
+            expect[(a, b, adj, btype(t))] += 1
+    got = collections.Counter(
+        (a, b, decimal.Decimal(p), bt) for a, b, p, bt in rows)
+    assert got == expect
+    assert len(rows) > 0
+
+
+def test_nexmark_q15_per_minute_stats():
+    """q15 shape: per-bucket bid stats with COUNT(DISTINCT ...) over a
+    to_char projection of the event time."""
+    rows = _run(
+        "CREATE MATERIALIZED VIEW q15 AS SELECT "
+        "to_char(date_time, 'HH24:MI') AS minute, count(*) AS bids, "
+        "count(DISTINCT bidder) AS bidders, "
+        "count(DISTINCT auction) AS auctions FROM bid "
+        "GROUP BY to_char(date_time, 'HH24:MI')",
+        "SELECT * FROM q15")
+    import datetime
+    bids, _a, _p = _gen()
+    epoch = datetime.datetime(1970, 1, 1,
+                              tzinfo=datetime.timezone.utc)
+    per = {}
+    for a, b, t in zip(bids["auction"].tolist(),
+                       bids["bidder"].tolist(),
+                       bids["date_time"].tolist()):
+        m = (epoch + datetime.timedelta(
+            microseconds=int(t))).strftime("%H:%M")
+        e = per.setdefault(m, [0, set(), set()])
+        e[0] += 1
+        e[1].add(b)
+        e[2].add(a)
+    expect = {(m, c, len(bs), len(as_))
+              for m, (c, bs, as_) in per.items()}
+    assert set(map(tuple, rows)) == expect
+    assert len(rows) > 1
+
+
+def test_nexmark_q22_url_dirs():
+    rows = _run(
+        "CREATE MATERIALIZED VIEW q22 AS SELECT auction, bidder, "
+        "price, channel, split_part(url, '/', 4) AS dir1, "
+        "split_part(url, '/', 5) AS dir2, "
+        "split_part(url, '/', 6) AS dir3 FROM bid",
+        "SELECT * FROM q22")
+    bids, _a, _p = _gen()
+
+    def part(u, k):
+        parts = u.split("/")
+        return parts[k - 1] if 1 <= k <= len(parts) else ""
+    expect = collections.Counter(
+        (a, b, p, ch, part(u, 4), part(u, 5), part(u, 6))
+        for a, b, p, ch, u in zip(
+            bids["auction"].tolist(), bids["bidder"].tolist(),
+            bids["price"].tolist(), bids["channel"].tolist(),
+            bids["url"].tolist()))
+    assert collections.Counter(map(tuple, rows)) == expect
+    assert len(rows) > 0

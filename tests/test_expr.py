@@ -198,3 +198,45 @@ def test_interval_justified_ordering():
     assert Interval(days=1) < Interval(usecs=360_000_000_000)
     assert Interval(months=1) == Interval(days=30)
     assert Interval(months=1) > Interval(days=29)
+
+
+def test_scalar_function_library_semantics():
+    """pg semantics of the new string/date scalars: substr window
+    clamping, split_part from-the-end, to_char, extract_epoch without
+    int64 overflow."""
+    import decimal
+
+    import numpy as np
+
+    from risingwave_tpu.common.chunk import DataChunk
+    from risingwave_tpu.common.types import DataType, Schema
+    from risingwave_tpu.expr.expr import FuncCall, InputRef, lit
+
+    sch = Schema.of(s=DataType.VARCHAR, ts=DataType.TIMESTAMP)
+    chunk = DataChunk.from_pydict(
+        sch, {"s": ["hello", "a/b/c"],
+              "ts": [1_436_918_400_000_000, 0]})
+    sref = InputRef(0, DataType.VARCHAR)
+    tref = InputRef(1, DataType.TIMESTAMP)
+
+    def run(fc):
+        col = fc.eval(chunk)
+        return list(np.asarray(col.values)[:2])
+
+    # substr clamps the WINDOW, not the length (pg)
+    assert run(FuncCall("substr", [sref, lit(0, DataType.INT64),
+                                   lit(3, DataType.INT64)],
+                        DataType.VARCHAR))[0] == "he"
+    assert run(FuncCall("substr", [sref, lit(-2, DataType.INT64),
+                                   lit(5, DataType.INT64)],
+                        DataType.VARCHAR))[0] == "he"
+    # split_part counts negative positions from the end
+    assert run(FuncCall("split_part",
+                        [sref, lit("/", DataType.VARCHAR),
+                         lit(-1, DataType.INT64)],
+                        DataType.VARCHAR))[1] == "c"
+    assert run(FuncCall("to_char",
+                        [tref, lit("YYYY-MM-DD", DataType.VARCHAR)],
+                        DataType.VARCHAR))[0] == "2015-07-15"
+    ep = run(FuncCall("extract_epoch", [tref], DataType.DECIMAL))[0]
+    assert int(ep) == 1_436_918_400 * 10_000   # scaled decimal seconds
