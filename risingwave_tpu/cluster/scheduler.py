@@ -276,7 +276,89 @@ class Cluster:
         for job in self.jobs.values():
             await self._deploy_job(job)
 
-    # -- reschedule (scale.rs:717 analog, with state handoff) -------------
+    # -- reschedule (scale.rs:717 + rebalance_actor_vnode :174) -----------
+    # ops whose state is either vnode-partitioned by the exchange keys
+    # or derivable from it — fragments of ONLY these ops can rescale
+    # with a vnode-sliced state handoff
+    _RESCALABLE_OPS = frozenset({"exchange_in", "hash_agg", "project",
+                                 "filter", "materialize"})
+
+    def _rescalable(self, frag: Fragment) -> bool:
+        if not frag.inputs or any(i.mode != "hash" for i in frag.inputs):
+            return False
+        for n in frag.nodes:
+            if n["op"] not in self._RESCALABLE_OPS:
+                return False
+            if n["op"] == "materialize" and not n.get("dist_key"):
+                return False
+        return True
+
+    async def rescale_fragment(self, name: str, frag_idx: int,
+                               to_slots: List[int]) -> None:
+        """Change one fragment's actor set (count AND placement) at a
+        stopped barrier: every state row moves to its vnode's NEW
+        owner (the 2-byte key prefix IS the vnode — scale.rs's bitmap
+        rebalance, made explicit as a scan/slice/ingest handoff across
+        per-slot namespaces)."""
+        from risingwave_tpu.common.hash import VnodeMapping
+
+        job = self.jobs[name]
+        frag = job.graph.fragments[frag_idx]
+        old = job.placements[frag_idx]
+        if len(to_slots) == len(old) and \
+                [s for _a, s in old] == list(to_slots):
+            return
+        if not self._rescalable(frag):
+            raise ValueError(
+                "fragment is not vnode-rescalable (needs hash inputs "
+                "and only exchange_in/hash_agg/project/filter/"
+                "materialize-with-dist_key nodes)")
+        # 1) stop the WHOLE job; align stores to the committed floor
+        await self.loop.inject_and_collect(
+            force_checkpoint=True,
+            mutation=StopMutation(self._stop_set(job)))
+        floor = self.store.committed_epoch()
+        for c in self.clients:
+            await c.call({"cmd": "recover_store", "epoch": floor})
+        # 2) vnode-sliced handoff: gather each table from every OLD
+        # slot, route rows by key-prefix vnode through the NEW mapping,
+        # tombstone the old copies, ingest the slices
+        mapping = VnodeMapping.new_uniform(len(to_slots))
+        min_epoch = (self.loop._epoch.value
+                     if self.loop._epoch is not None else 0)
+        handoff_max = 0
+        old_slots = sorted({s for _a, s in old})
+        for tid in _fragment_table_ids(frag):
+            slices: Dict[int, list] = {}
+            for slot in old_slots:
+                rows = await self.clients[slot].scan_table(tid)
+                if not rows:
+                    continue
+                for k, v in rows:
+                    vnode = int.from_bytes(k[:2], "big")
+                    dst = to_slots[mapping.owner_of(vnode)]
+                    slices.setdefault(dst, []).append((k, v))
+                r = await self.clients[slot].ingest_table(
+                    tid, [(k, None) for k, _v in rows],
+                    min_epoch=min_epoch)
+                handoff_max = max(handoff_max, int(r["epoch"]))
+            for dst, rows in slices.items():
+                r = await self.clients[dst].ingest_table(
+                    tid, rows, min_epoch=handoff_max or min_epoch)
+                handoff_max = max(handoff_max, int(r["epoch"]))
+        if handoff_max:
+            self.loop.advance_epoch_to(handoff_max)
+        # 3) redeploy every fragment; the rescaled one gets its new
+        # actor count/placement, wiring recomputes the vnode mapping
+        job.placements[frag_idx] = [
+            (self._fresh_actor(), s) for s in to_slots]
+        for fi in range(len(job.graph.fragments)):
+            if fi != frag_idx:
+                job.placements[fi] = [
+                    (self._fresh_actor(), s)
+                    for _a, s in job.placements[fi]]
+        await self._deploy_job(job)
+
     async def move_fragment(self, name: str, frag_idx: int,
                             to_slots: List[int]) -> None:
         """Move one fragment's actors to new worker slots at a stopped
@@ -286,17 +368,14 @@ class Cluster:
         job = self.jobs[name]
         frag = job.graph.fragments[frag_idx]
         if len(to_slots) != len(job.placements[frag_idx]):
-            raise ValueError("move keeps the actor count; use a "
-                             "replan for true rescale")
+            raise ValueError("move keeps the actor count; use "
+                             "rescale_fragment for true rescale")
         old = job.placements[frag_idx]
         if len(old) != 1:
-            # a namespace scan returns EVERY actor's slice of a shared
-            # table id — moving one actor of a multi-actor fragment
-            # would ship its siblings' vnode slices too (and a swap
-            # would compound them). Needs vnode-sliced handoff.
-            raise ValueError(
-                "multi-actor fragment moves need vnode-sliced state "
-                "handoff (not implemented yet)")
+            # a whole-namespace scan mixes sibling actors' slices; the
+            # vnode-sliced path handles multi-actor fragments
+            return await self.rescale_fragment(name, frag_idx,
+                                               to_slots)
         if [s for _a, s in old] == list(to_slots):
             return
         # 1) stop the WHOLE job at a barrier (keep state + catalog)

@@ -334,11 +334,25 @@ _DATE_FIELDS = {"second", "minute", "hour", "year", "month", "day"}
 _TRUNC_FIELDS = {"second", "minute", "hour", "day"}
 
 
+# argument positions the kernels treat as SCALARS (evaluated once for
+# the whole chunk) — they must be constants, or row 0's value would
+# silently apply to every row
+_CONST_ARG_POSITIONS = {
+    "substr": (1, 2), "split_part": (1, 2), "replace": (1, 2),
+    "to_char": (1,), "date_part": (0,), "date_trunc": (0,),
+}
+
+
 def _check_scalar_args(name, raw_args, bound) -> None:
-    """Bind-time validation of LITERAL arguments: a bad field name or
-    position must fail the statement, not crash-loop the deployed
-    actor at eval time."""
+    """Bind-time validation: scalar-treated argument positions must be
+    literals, and a bad field name or position must fail the
+    statement, not crash-loop the deployed actor at eval time."""
     from risingwave_tpu.expr.expr import Literal
+
+    for i in _CONST_ARG_POSITIONS.get(name, ()):
+        if i < len(bound) and not isinstance(bound[i], Literal):
+            raise BindError(
+                f"{name}() argument {i + 1} must be a constant")
 
     def lit_of(i):
         b = bound[i]

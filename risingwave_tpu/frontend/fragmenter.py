@@ -361,10 +361,27 @@ class Fragmenter:
             return fi, ni
         if isinstance(ex, MaterializeExecutor):
             fi, ci = self._lower(ex.input)
-            ni = self._append(fi, {
+            node = {
                 "op": "materialize", "input": ci,
                 "table_id": ex.table.table_id,
-                "pk": list(ex.table.pk_indices)})
+                "pk": list(ex.table.pk_indices)}
+            # vnode-partition the MV by its GROUP-KEY pk columns when
+            # this is an exchange-fed agg fragment: the planner orders
+            # the MV pk by group index, and agg output group j carries
+            # the SAME value as dispatched key j — so hashing the pk
+            # columns in pk order reproduces the dispatcher's vnode
+            # exactly (exchange keys index the UPSTREAM schema and
+            # must NOT be used as MV positions). Rescale then slices
+            # every fragment table by one consistent mapping.
+            frag = self.graph.fragments[fi]
+            if (frag.inputs
+                    and all(i.mode == "hash" for i in frag.inputs)
+                    and sum(n["op"] == "hash_agg"
+                            for n in frag.nodes) == 1
+                    and node["pk"]
+                    and len(frag.inputs[0].keys) == len(node["pk"])):
+                node["dist_key"] = list(node["pk"])
+            ni = self._append(fi, node)
             return fi, ni
         raise FragmentError(
             f"{type(ex).__name__} has no distributed lowering yet "

@@ -38,7 +38,12 @@ Node shapes (dicts, `op` discriminated):
    "left_pk": [...], "right_pk": [...], "join_type": "inner",
    "left_dist_key": [...], "right_dist_key": [...],  # optional:
    "output_names": [...]}   # vnode dist of the join state tables
-  {"op": "materialize", "input": N, "table_id": n, "pk": [...]}
+  {"op": "materialize", "input": N, "table_id": n, "pk": [...],
+   "dist_key": [...]}           # optional: vnode partitioning of the
+                                # MV rows (must be a pk subset) — set
+                                # by the fragmenter when the fragment's
+                                # exchange keys prefix the pk, so
+                                # rescale can slice state by vnode
   {"op": "top_n", "input": N, "order_by": [[i, desc], ...],
    "offset": n, "limit": n|null, "table_id": n, "group": [...],
    "append_only": bool, "pk": [...]}
@@ -276,8 +281,12 @@ def build_fragment(nodes: List[dict], store, local,
                 MaterializeExecutor,
             )
             child = built[node["input"]]
+            dist = node.get("dist_key")
             mv = StateTable(int(node["table_id"]), child.schema,
-                            [int(i) for i in node["pk"]], store)
+                            [int(i) for i in node["pk"]], store,
+                            dist_key_indices=(
+                                [int(i) for i in dist]
+                                if dist else None))
             ex = MaterializeExecutor(child, mv)
         elif op == "hash_agg":
             child = built[node["input"]]

@@ -132,3 +132,24 @@ def test_set_string_unescaping():
         await fe.close()
 
     _run(run())
+
+
+def test_scalar_args_must_be_constant():
+    """Kernel-scalar argument positions reject non-literals at bind
+    time (a column there would silently broadcast row 0)."""
+    async def run():
+        fe = Frontend(min_chunks=4)
+        await fe.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', "
+            "nexmark.table.type='bid', nexmark.event.num=500)")
+        with pytest.raises(Exception, match="constant"):
+            await fe.execute(
+                "CREATE MATERIALIZED VIEW b AS SELECT "
+                "substr(url, auction) AS s FROM bid")
+        with pytest.raises(Exception, match="constant"):
+            await fe.execute(
+                "CREATE MATERIALIZED VIEW b AS SELECT "
+                "split_part(url, channel, 1) AS s FROM bid")
+        await fe.close()
+
+    _run(run())
