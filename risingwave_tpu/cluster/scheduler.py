@@ -313,16 +313,11 @@ class Cluster:
                 "fragment is not vnode-rescalable (needs hash inputs "
                 "and only exchange_in/hash_agg/project/filter/"
                 "materialize-with-dist_key nodes)")
-        # 1) stop the WHOLE job; align stores to the committed floor
-        await self.loop.inject_and_collect(
-            force_checkpoint=True,
-            mutation=StopMutation(self._stop_set(job)))
-        floor = self.store.committed_epoch()
-        for c in self.clients:
-            await c.call({"cmd": "recover_store", "epoch": floor})
-        # 2) vnode-sliced handoff: gather each table from every OLD
-        # slot, route rows by key-prefix vnode through the NEW mapping,
-        # tombstone the old copies, ingest the slices
+        await self._stop_and_align(job)
+        # vnode-sliced handoff: gather each table from every OLD slot,
+        # route rows by key-prefix vnode through the NEW mapping, and
+        # move ONLY rows whose owner changes (the stationary majority
+        # of a small rescale stays put)
         mapping = VnodeMapping.new_uniform(len(to_slots))
         min_epoch = (self.loop._epoch.value
                      if self.loop._epoch is not None else 0)
@@ -332,32 +327,25 @@ class Cluster:
             slices: Dict[int, list] = {}
             for slot in old_slots:
                 rows = await self.clients[slot].scan_table(tid)
-                if not rows:
-                    continue
+                moved = []
                 for k, v in rows:
                     vnode = int.from_bytes(k[:2], "big")
                     dst = to_slots[mapping.owner_of(vnode)]
-                    slices.setdefault(dst, []).append((k, v))
-                r = await self.clients[slot].ingest_table(
-                    tid, [(k, None) for k, _v in rows],
-                    min_epoch=min_epoch)
-                handoff_max = max(handoff_max, int(r["epoch"]))
+                    if dst != slot:
+                        slices.setdefault(dst, []).append((k, v))
+                        moved.append(k)
+                if moved:
+                    r = await self.clients[slot].ingest_table(
+                        tid, [(k, None) for k in moved],
+                        min_epoch=min_epoch)
+                    handoff_max = max(handoff_max, int(r["epoch"]))
             for dst, rows in slices.items():
                 r = await self.clients[dst].ingest_table(
                     tid, rows, min_epoch=handoff_max or min_epoch)
                 handoff_max = max(handoff_max, int(r["epoch"]))
         if handoff_max:
             self.loop.advance_epoch_to(handoff_max)
-        # 3) redeploy every fragment; the rescaled one gets its new
-        # actor count/placement, wiring recomputes the vnode mapping
-        job.placements[frag_idx] = [
-            (self._fresh_actor(), s) for s in to_slots]
-        for fi in range(len(job.graph.fragments)):
-            if fi != frag_idx:
-                job.placements[fi] = [
-                    (self._fresh_actor(), s)
-                    for _a, s in job.placements[fi]]
-        await self._deploy_job(job)
+        await self._redeploy_with_fresh_actors(job, {frag_idx: to_slots})
 
     async def move_fragment(self, name: str, frag_idx: int,
                             to_slots: List[int]) -> None:
@@ -378,18 +366,7 @@ class Cluster:
                                                to_slots)
         if [s for _a, s in old] == list(to_slots):
             return
-        # 1) stop the WHOLE job at a barrier (keep state + catalog)
-        await self.loop.inject_and_collect(
-            force_checkpoint=True,
-            mutation=StopMutation(self._stop_set(job)))
-        # the stop barrier's epoch is committed on the COORDINATOR but
-        # its commit decision hasn't reached the workers (it pipelines
-        # on the next inject) — push it now, or the handoff scan would
-        # miss rows born in that epoch and leave them to resurrect on
-        # the old worker when its staged SST commits later
-        floor = self.store.committed_epoch()
-        for c in self.clients:
-            await c.call({"cmd": "recover_store", "epoch": floor})
+        await self._stop_and_align(job)
         # 2) ship the moved actors' state tables between namespaces.
         # Ingest epochs stay ABOVE the last injected barrier (other
         # jobs hold buffered flushes at that epoch; sealing it out from
@@ -416,15 +393,33 @@ class Cluster:
                                       int(r2["epoch"]))
         if handoff_max:
             self.loop.advance_epoch_to(handoff_max)
-        # 3) redeploy every fragment with the new placement (actor ids
-        # are fresh — the stopped ones are gone from the workers)
-        job.placements[frag_idx] = [
-            (self._fresh_actor(), s) for s in to_slots]
+        await self._redeploy_with_fresh_actors(job, {frag_idx: to_slots})
+
+    async def _stop_and_align(self, job: JobDeployment) -> None:
+        """Stop the WHOLE job at a barrier and push the coordinator's
+        commit decision to every worker: the stop barrier's epoch is
+        committed on the COORDINATOR but pipelines to workers on the
+        next inject — without the push, a handoff scan would miss rows
+        born in that epoch and leave them to resurrect on the old
+        worker when its staged SST commits later."""
+        await self.loop.inject_and_collect(
+            force_checkpoint=True,
+            mutation=StopMutation(self._stop_set(job)))
+        floor = self.store.committed_epoch()
+        for c in self.clients:
+            await c.call({"cmd": "recover_store", "epoch": floor})
+
+    async def _redeploy_with_fresh_actors(
+            self, job: JobDeployment,
+            replaced: Dict[int, List[int]]) -> None:
+        """Redeploy every fragment with fresh actor ids (the stopped
+        ones are gone from the workers); `replaced` overrides slot
+        lists per fragment index."""
         for fi in range(len(job.graph.fragments)):
-            if fi != frag_idx:
-                job.placements[fi] = [
-                    (self._fresh_actor(), s)
-                    for _a, s in job.placements[fi]]
+            slots = replaced.get(
+                fi, [s for _a, s in job.placements[fi]])
+            job.placements[fi] = [(self._fresh_actor(), s)
+                                  for s in slots]
         await self._deploy_job(job)
 
     def _fresh_actor(self) -> int:

@@ -50,6 +50,9 @@ class Call(Expr):
     args: List[Expr]
     star: bool = False       # count(*)
     distinct: bool = False   # count(DISTINCT x) etc.
+    # aggregate FILTER (WHERE cond) clause (pg); bound as a CASE
+    # rewrite in the binder
+    filter_where: object = None
 
 
 @dataclass

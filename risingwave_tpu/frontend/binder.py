@@ -123,6 +123,10 @@ class Binder:
     def _bind_over(self, e: ast.Over):
         from risingwave_tpu.expr.window import WindowCall, WindowFuncKind
 
+        if getattr(e.call, "filter_where", None) is not None:
+            raise BindError(
+                "FILTER (WHERE ...) on window functions is not "
+                "supported yet")
         name = e.call.name
         if name == "avg":
             raise BindError("avg() OVER is not supported yet — use "
@@ -225,6 +229,8 @@ class Binder:
         raise BindError(f"unsupported expression {e!r}")
 
     def _bind_call(self, e: ast.Call):
+        if getattr(e, "filter_where", None) is not None:
+            e = _rewrite_filter_clause(e)
         name = e.name
         if name == "avg":
             # AVG rewrites to SUM/COUNT at bind time (the reference's
@@ -295,9 +301,7 @@ class Binder:
             mk = tumble_start if name == "tumble_start" else tumble_end
             return mk(ts, Interval(usecs=iv.usecs))
         if name == "case":
-            args = [self.bind(a) for a in e.args]
-            whens = list(zip(args[:-1:2], args[1:-1:2]))
-            return Case(whens, args[-1])
+            return _bind_case(self.bind, e.args)
         # generic registered scalar function (sig/ analog: name →
         # arity + return type; the expr registry holds the kernel)
         sig = _SCALAR_SIGS.get(name)
@@ -312,6 +316,48 @@ class Binder:
         args = [self.bind(a) for a in e.args]
         _check_scalar_args(name, e.args, args)
         return FuncCall(name, args, rt)
+
+
+def _bind_case(bind, args_ast):
+    """CASE binding with NULL-branch unification: a bare NULL branch
+    (incl. the implicit ELSE NULL) adopts the case's value type — a
+    raw NULL literal binds INT64 and would fail Case's same-type
+    invariant for varchar/decimal branches."""
+    from risingwave_tpu.expr.expr import Case, Literal
+
+    args = [bind(a) for a in args_ast]
+    whens = list(zip(args[:-1:2], args[1:-1:2]))
+    else_ = args[-1]
+    vals = [v for _c, v in whens] + [else_]
+    vt = next((v.return_type for v in vals
+               if not (isinstance(v, Literal) and v.value is None)),
+              None)
+    if vt is not None:
+        def unify(v):
+            if isinstance(v, Literal) and v.value is None \
+                    and v.return_type != vt:
+                return Literal(None, vt)
+            return v
+        whens = [(c, unify(v)) for c, v in whens]
+        else_ = unify(else_)
+    return Case(whens, else_)
+
+
+def _rewrite_filter_clause(e):
+    """Aggregate FILTER (WHERE c) → CASE rewrite (pg semantics:
+    count(*) counts matches; sum/min/max/avg see NULL for
+    non-matches, so empty matches yield NULL — except count, 0)."""
+    fw = e.filter_where
+    if e.name == "count" and (e.star or not e.args):
+        return ast.Call("sum", [ast.Call(
+            "case", [fw, ast.Lit(1, "number"), ast.Lit(0, "number")])])
+    if e.name in ("sum", "min", "max", "avg") and e.args \
+            and not e.distinct:
+        return ast.Call(e.name, [ast.Call(
+            "case", [fw, e.args[0], ast.Lit(None, "null")])])
+    raise BindError(
+        "FILTER (WHERE ...) is supported for count(*)/sum/min/max/avg"
+        " (without DISTINCT)")
 
 
 # scalar signatures: name → (min args, max args, return type)
@@ -482,9 +528,7 @@ class PostAggBinder:
             return Cast(self.bind(e.child), to)
         if isinstance(e, ast.Call):
             if e.name == "case":
-                args = [self.bind(a) for a in e.args]
-                whens = list(zip(args[:-1:2], args[1:-1:2]))
-                return Case(whens, args[-1])
+                return _bind_case(self.bind, e.args)
             sig = _SCALAR_SIGS.get(e.name)
             if sig is None:
                 raise BindError(f"unknown function {e.name!r}")

@@ -600,6 +600,13 @@ class Parser:
                         self._expect_op(")")
                     call = ast.Call(name.lower(), args,
                                     distinct=distinct)
+                nk, nt = self._peek()
+                if nk in ("ident", "kw") and nt.lower() == "filter":
+                    self._next()
+                    self._expect_op("(")
+                    self._expect_kw("where")
+                    call.filter_where = self._expr()
+                    self._expect_op(")")
                 if self._kw("over"):
                     return self._over(call)
                 return call

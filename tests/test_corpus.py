@@ -342,9 +342,9 @@ def test_tpch_q6_forecast_revenue():
 #               part of q22's coverage)
 #   q12         processing-time tumble (proctime())
 #   q13         side-input (bounded table) join
-#   q16-q19     q16 needs filtered aggregates (COUNT(*) FILTER ...);
-#               q17 needs CASE-in-agg breadth; q18/q19 variants of
-#               q9/q105 run above
+#   q17-q19     q17 needs CASE-in-agg breadth; q18/q19 variants of
+#               q9/q105 run above (q16 runs: FILTER clauses rewrite
+#               to CASE)
 #   q102/q104   scalar subquery over a grouped aggregate (avg of
 #               counts) in WHERE/HAVING
 
@@ -568,3 +568,34 @@ def test_nexmark_q22_url_dirs():
             bids["url"].tolist()))
     assert collections.Counter(map(tuple, rows)) == expect
     assert len(rows) > 0
+
+
+def test_nexmark_q16_filtered_aggregates():
+    """q16 shape: per-channel stats with FILTER (WHERE ...) aggregate
+    clauses (rank buckets), rewritten to CASE at bind time."""
+    rows = _run(
+        "CREATE MATERIALIZED VIEW q16 AS SELECT channel, "
+        "count(*) AS total, "
+        "count(*) FILTER (WHERE price < 10000) AS rank1, "
+        "count(*) FILTER (WHERE price >= 10000 AND price < 1000000) "
+        "AS rank2, "
+        "count(*) FILTER (WHERE price >= 1000000) AS rank3, "
+        "max(price) FILTER (WHERE price < 10000) AS max1 "
+        "FROM bid GROUP BY channel",
+        "SELECT * FROM q16")
+    bids, _a, _p = _gen()
+    per = {}
+    for ch, p in zip(bids["channel"].tolist(), bids["price"].tolist()):
+        e = per.setdefault(ch, [0, 0, 0, 0, None])
+        e[0] += 1
+        if p < 10_000:
+            e[1] += 1
+            e[4] = p if e[4] is None else max(e[4], p)
+        elif p < 1_000_000:
+            e[2] += 1
+        else:
+            e[3] += 1
+    expect = {(ch, t, r1, r2, r3, m)
+              for ch, (t, r1, r2, r3, m) in per.items()}
+    assert set(map(tuple, rows)) == expect
+    assert len(rows) > 2

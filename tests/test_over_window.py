@@ -357,3 +357,27 @@ def test_over_window_null_order_keys_pg_defaults():
 
     assert run(False) == {1: 1, 3: 2, 2: 3}   # ASC: NULL last
     assert run(True) == {2: 1, 3: 2, 1: 3}    # DESC: NULL first
+
+
+def test_filter_clause_on_window_function_rejected():
+    """FILTER (WHERE ...) OVER must error, not silently ignore the
+    predicate (regression: it used to compute the unfiltered window)."""
+    import asyncio
+
+    import pytest
+
+    from risingwave_tpu.frontend.session import Frontend
+
+    async def run():
+        fe = Frontend(min_chunks=4)
+        await fe.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', "
+            "nexmark.table.type='bid', nexmark.event.num=500)")
+        with pytest.raises(Exception, match="FILTER"):
+            await fe.execute(
+                "CREATE MATERIALIZED VIEW w AS SELECT count(*) "
+                "FILTER (WHERE price < 10000) OVER (PARTITION BY "
+                "auction ORDER BY date_time) AS c FROM bid")
+        await fe.close()
+
+    asyncio.run(run())
