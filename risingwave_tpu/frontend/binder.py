@@ -527,6 +527,13 @@ class PostAggBinder:
                 raise BindError(f"unknown type {e.type_name!r}")
             return Cast(self.bind(e.child), to)
         if isinstance(e, ast.Call):
+            if getattr(e, "filter_where", None) is not None:
+                # anything reaching here is NOT an aggregate (those
+                # bound through the whole-expression pass) — pg:
+                # "FILTER specified, but <fn> is not an aggregate"
+                raise BindError(
+                    f"FILTER specified, but {e.name}() is not an "
+                    "aggregate function")
             if e.name == "case":
                 return _bind_case(self.bind, e.args)
             sig = _SCALAR_SIGS.get(e.name)

@@ -153,3 +153,19 @@ def test_scalar_args_must_be_constant():
         await fe.close()
 
     _run(run())
+
+
+def test_filter_on_non_aggregate_rejected():
+    async def run():
+        fe = Frontend(min_chunks=4)
+        await fe.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', "
+            "nexmark.table.type='bid', nexmark.event.num=500)")
+        with pytest.raises(Exception, match="not an aggregate"):
+            await fe.execute(
+                "CREATE MATERIALIZED VIEW b AS SELECT channel, "
+                "upper(channel) FILTER (WHERE price > 0) AS u "
+                "FROM bid GROUP BY channel")
+        await fe.close()
+
+    _run(run())
