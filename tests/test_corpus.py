@@ -342,9 +342,7 @@ def test_tpch_q6_forecast_revenue():
 #               part of q22's coverage)
 #   q12         processing-time tumble (proctime())
 #   q13         side-input (bounded table) join
-#   q19         top-10 bids per auction needs per-group LIMIT
-#               (rn <= 10 over the q18 window is expressible but
-#               untested at scale)
+#   (q19 runs above: rn <= 10 over the q18-style window)
 #   q102/q104   scalar subquery over a grouped aggregate (avg of
 #               counts) in WHERE/HAVING
 
@@ -673,6 +671,39 @@ def test_nexmark_q18_last_bid_per_bidder_auction():
         if cur is None or t > cur[3]:
             last[(b, a)] = (a, b, p, t)
     assert len(rows) == len(last)
+    src = {(a, b, p, t) for a, b, p, t in zip(
+        bids["auction"].tolist(), bids["bidder"].tolist(),
+        bids["price"].tolist(), bids["date_time"].tolist())}
     for a, b, p, t in rows:
         assert last[(b, a)][3] == t, (a, b, t)
+        # the whole ROW must be a real source bid (not just the time)
+        assert (a, b, p, t) in src, (a, b, p, t)
     assert len(rows) > 10
+
+
+def test_nexmark_q19_top10_bids_per_auction():
+    """q19: the 10 highest bids per auction via ROW_NUMBER() <= 10
+    over a derived table (per-group LIMIT)."""
+    rows = _run(
+        "CREATE MATERIALIZED VIEW q19 AS SELECT auction, bidder, "
+        "price FROM (SELECT auction, bidder, price, row_number() "
+        "OVER (PARTITION BY auction ORDER BY price DESC) AS rn "
+        "FROM bid) AS t WHERE rn <= 10",
+        "SELECT * FROM q19")
+    bids, _a, _p = _gen()
+    by_auction = collections.defaultdict(list)
+    for a, b, p in zip(bids["auction"].tolist(),
+                       bids["bidder"].tolist(),
+                       bids["price"].tolist()):
+        by_auction[a].append(p)
+    # the returned price MULTISET per auction must equal the exact
+    # top-10 multiset (counts + thresholds alone would accept a
+    # duplicated rank-1 row)
+    got_prices = collections.defaultdict(list)
+    for a, _b, p in rows:
+        got_prices[a].append(p)
+    assert set(got_prices) == set(by_auction)
+    for a, prices in by_auction.items():
+        top = sorted(prices, reverse=True)[:10]
+        assert sorted(got_prices[a], reverse=True) == top, a
+    assert len(rows) > 20
