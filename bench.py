@@ -22,6 +22,29 @@ BASELINE_EVENTS_PER_SEC = 1_000_000.0
 IN_FLIGHT = 2          # barrier pipelining window used by every bench
 
 
+def _metrics_snapshot(loop) -> dict:
+    """Registry snapshot riding along in every bench line: p99 barrier
+    breakdown, back-pressure and throughput totals, block-cache
+    traffic — BENCH_*.json carries the observability trajectory."""
+    from risingwave_tpu.utils.metrics import STORAGE, STREAMING
+    b = loop.profiler.p99_breakdown()
+    return {
+        "p99_inject_to_collect_s": round(b["inject_to_collect_s"], 5),
+        "p99_collect_to_commit_s": round(b["collect_to_commit_s"], 5),
+        "exchange_backpressure_s": round(
+            sum(v for _l, v in
+                STREAMING.exchange_backpressure.series()), 5),
+        "executor_rows": int(
+            sum(v for _l, v in STREAMING.executor_rows.series())),
+        "executor_busy_s": round(
+            sum(v for _l, v in STREAMING.executor_busy.series()), 4),
+        "block_cache_hits": int(STORAGE.block_cache_hits.get()),
+        "block_cache_misses": int(STORAGE.block_cache_misses.get()),
+        "sst_upload_bytes": int(
+            sum(v for _l, v in STORAGE.sst_upload_bytes.series())),
+    }
+
+
 def _result(metric, elapsed, rows, loop):
     return {
         "metric": metric,
@@ -32,6 +55,7 @@ def _result(metric, elapsed, rows, loop):
         "p99_barrier_latency_s": round(loop.stats.p99_latency_s(), 4),
         "barrier_in_flight": IN_FLIGHT,
         "events": rows,
+        "observability": _metrics_snapshot(loop),
     }
 
 
@@ -161,6 +185,7 @@ async def _drive_frontend(fe, expected_total: int, in_flight: int,
     elapsed = time.perf_counter() - t0
     rows = rows_seen() - warm
     loop.stats.latencies_s = loop.stats.latencies_s[warm_epochs:]
+    loop.profiler.drop_first(warm_epochs)
     return elapsed, rows
 
 
@@ -430,7 +455,8 @@ def _main_locked(argv):
             r = _bench_one_subprocess(name)
             headline[name] = {k: r[k] for k in
                               ("value", "p99_barrier_latency_s",
-                               "barrier_in_flight", "events")}
+                               "barrier_in_flight", "events",
+                               "observability") if k in r}
         except Exception as e:                       # noqa: BLE001
             print(f"WARNING: {name} failed: {e!r}", file=sys.stderr)
             headline[name] = {"error": repr(e)[:200]}
@@ -443,7 +469,8 @@ def _main_locked(argv):
             headline["adctr"] = {
                 k: r[k] for k in ("value", "p99_barrier_latency_s",
                                   "barrier_in_flight", "events",
-                                  "parallelism", "platform")}
+                                  "parallelism", "platform",
+                                  "observability") if k in r}
         except Exception as e:                       # noqa: BLE001
             print(f"WARNING: adctr failed: {e!r}", file=sys.stderr)
             headline["adctr"] = {"error": repr(e)[:200]}

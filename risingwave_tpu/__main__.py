@@ -15,6 +15,7 @@ backup operations against a data directory:
     python -m risingwave_tpu ctl --data-dir D hummock version
     python -m risingwave_tpu ctl --data-dir D hummock list-ssts
     python -m risingwave_tpu ctl --data-dir D table scan <name> [-n N]
+    python -m risingwave_tpu ctl --data-dir D metrics [--steps K]
     python -m risingwave_tpu ctl --data-dir D backup create|list|
         delete <id> | restore <id> --target T
 """
@@ -128,6 +129,8 @@ def _ctl(args) -> int:
         return 0
     if verb == "table":
         return asyncio.run(_ctl_scan(obj, args))
+    if verb == "metrics":
+        return asyncio.run(_ctl_metrics(obj, args))
     if verb == "backup":
         from risingwave_tpu.meta.backup import (
             create_backup, delete_backup, list_backups, restore_backup,
@@ -167,23 +170,34 @@ def _ctl(args) -> int:
     return 2
 
 
-async def _ctl_scan(obj, args) -> int:
-    """READ-ONLY scan: recovery replays DDL through deploy, which
-    commits checkpoint versions — so recover over an in-memory CLONE.
-    The clone copies the CURRENT version's CLOSURE (the backup
+def _snapshot_clone(obj):
+    """In-memory clone of the CURRENT version's CLOSURE (the backup
     helper's consistency argument: versions are immutable and vacuum
     is deferred), so it is a true snapshot even beside a live serve
     process racing compactions — a bare list-then-read-all could see
-    a torn CURRENT or a just-vacuumed SST."""
-    from risingwave_tpu.frontend import Frontend
+    a torn CURRENT or a just-vacuumed SST. The copy runs unmetered:
+    the tooling traffic must not inflate the object-store op counters
+    a later metrics dump reports."""
     from risingwave_tpu.meta.backup import _closure
-    from risingwave_tpu.storage.hummock import HummockLite
-    from risingwave_tpu.storage.object_store import MemObjectStore
+    from risingwave_tpu.storage.object_store import (
+        MemObjectStore, unmetered,
+    )
 
     clone = MemObjectStore()
-    for path in _closure(obj):
-        clone.upload(path, obj.read(path))
-    fe = Frontend(HummockLite(clone))
+    with unmetered():
+        for path in _closure(obj):
+            clone.upload(path, obj.read(path))
+    return clone
+
+
+async def _ctl_scan(obj, args) -> int:
+    """READ-ONLY scan: recovery replays DDL through deploy, which
+    commits checkpoint versions — so recover over an in-memory
+    snapshot clone."""
+    from risingwave_tpu.frontend import Frontend
+    from risingwave_tpu.storage.hummock import HummockLite
+
+    fe = Frontend(HummockLite(_snapshot_clone(obj)))
     await fe.recover()
     try:
         rows = await fe.execute(
@@ -192,6 +206,28 @@ async def _ctl_scan(obj, args) -> int:
         await fe.close()
     for r in rows:
         print("\t".join("NULL" if v is None else str(v) for v in r))
+    return 0
+
+
+async def _ctl_metrics(obj, args) -> int:
+    """Recover the cluster into an in-memory clone (same snapshot
+    discipline as `table scan`), drive a couple of checkpoints so
+    every metric family has live series, and dump the Prometheus text
+    exposition — what a scraper would see on a serving node."""
+    from risingwave_tpu.frontend import Frontend
+    from risingwave_tpu.storage.hummock import HummockLite
+    from risingwave_tpu.utils.metrics import GLOBAL
+
+    fe = Frontend(HummockLite(_snapshot_clone(obj)))
+    await fe.recover()
+    try:
+        await fe.step(args.steps)
+        # render BEFORE teardown: close() removes the liveness series
+        # (stream_actor_count, queue depths) the dump is for
+        text = GLOBAL.render()
+    finally:
+        await fe.close()
+    print(text, end="")
     return 0
 
 
@@ -229,6 +265,10 @@ def main(argv=None) -> None:
     tb.add_argument("what", choices=["scan"])
     tb.add_argument("ident")
     tb.add_argument("-n", "--limit", type=int, default=20)
+    mt = csub.add_parser(
+        "metrics", help="recover + dump the Prometheus exposition")
+    mt.add_argument("--steps", type=int, default=2,
+                    help="checkpoint barriers to drive before the dump")
     bk = csub.add_parser("backup")
     bk.add_argument("what",
                     choices=["create", "list", "delete", "restore"])

@@ -224,7 +224,8 @@ class Cluster:
             await asyncio.gather(*(
                 self.clients[slot].deploy_plan(
                     self._expand_nodes(frag, aid, job.placements),
-                    actor_id=aid, outputs=outputs, dispatch=dispatch)
+                    actor_id=aid, outputs=outputs, dispatch=dispatch,
+                    job=job.name)
                 for aid, slot in job.placements[fi]))
 
     async def drop_job(self, name: str) -> None:

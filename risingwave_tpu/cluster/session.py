@@ -261,8 +261,10 @@ class DistFrontend:
             await asyncio.gather(
                 *(view.prefetch(tid)
                   for tid in self._referenced_table_ids(sel)))
+        loop = getattr(self.cluster, "loop", None)
         ex = plan_batch(sel, self.catalog, view,
-                        view.committed_epoch())
+                        view.committed_epoch(),
+                        profiler=getattr(loop, "profiler", None))
         self.last_select_schema = ex.schema
         return collect(ex)
 

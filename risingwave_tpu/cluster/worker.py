@@ -149,14 +149,22 @@ class WorkerServer:
         raise ValueError(f"unknown dispatch type {typ!r}")
 
     def _spawn_actor(self, actor_id: int, outputs: List[int],
-                     dispatch: Optional[dict], consumer) -> dict:
+                     dispatch: Optional[dict], consumer,
+                     fragment: str = "") -> dict:
         """Shared deploy tail: exchange edges + actor + spawn.
         outputs=[]: terminal fragment (e.g. a materialize) — no
         exchange edge; an edge nobody consumes would buffer chunks
         until the credit window blocks the actor."""
+        from risingwave_tpu.stream.monitor import install_monitoring
         dispatchers = self._make_dispatchers(actor_id, outputs, dispatch)
+        # worker-side instrumentation feeds THIS process's registry
+        # (a worker-local scrape); the coordinator's rw_actor_metrics
+        # only sees coordinator-process actors — cross-process metric
+        # aggregation is future work
+        consumer = install_monitoring(consumer, fragment=fragment,
+                                      actor_id=actor_id)
         actor = Actor(actor_id, consumer, dispatchers=dispatchers,
-                      barrier_manager=self.local)
+                      barrier_manager=self.local, fragment=fragment)
         self.actors[actor_id] = actor
         self.local.set_expected_actors(list(self.actors))
         self.tasks[actor_id] = actor.spawn()
@@ -224,8 +232,9 @@ class WorkerServer:
             consumer = build_fragment(plan, self.store, self.local,
                                       channel_for_test,
                                       actor_id=actor_id)[1]
-            return self._spawn_actor(actor_id, outputs, dispatch,
-                                     consumer)
+            return self._spawn_actor(
+                actor_id, outputs, dispatch, consumer,
+                fragment=str(params.get("job") or f"actor-{actor_id}"))
         except BaseException as e:     # noqa: BLE001 — report upstream
             self.local.drop_actor(actor_id)
             return {"ok": False, "error": f"plan build failed: {e}"}

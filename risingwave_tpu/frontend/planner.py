@@ -226,6 +226,14 @@ class StreamPlanner:
         self.pending_attaches: List[tuple] = []
         self.registered_senders: List[int] = []   # cleanup on failure
         self._actor_id = 0           # downstream actor id (Output tag)
+        self._edge_seq = 0           # per-channel edge-label uniquifier
+
+    def _edge_label(self, kind: str, name: str) -> str:
+        """Unique exchange-edge label: kind:name->actor[.seq]."""
+        self._edge_seq += 1
+        base = f"{kind}:{name}->{self._actor_id}"
+        return base if self._edge_seq == 1 else \
+            f"{base}.{self._edge_seq}"
 
     # -- source chains ---------------------------------------------------
     def _base_chain(self, item, rate_limit: Optional[int],
@@ -264,7 +272,12 @@ class StreamPlanner:
             return ex, scope, [obj.name]
         assert isinstance(obj, SourceCatalog)
         reader = _source_reader(obj)
-        tx, rx = channel_for_test()
+        # edge labels are unique per CHANNEL (consumer actor id + a
+        # per-plan sequence for self-joins of one source): sharing a
+        # series would merge independent pipes, and teardown of one
+        # would remove the other's queue-depth gauge
+        tx, rx = channel_for_test(edge=self._edge_label("barrier",
+                                                        obj.name))
         split_state = StateTable(self.catalog.next_id(),
                                  SPLIT_STATE_SCHEMA, [0], self.store)
         # source sender id: unique per source instance (shares the
@@ -382,7 +395,8 @@ class StreamPlanner:
         if upstream is None or not upstream.dispatchers:
             raise PlanError(
                 f"upstream MV {mv.name!r} has no attachable actor")
-        tx, rx = channel_for_test()
+        tx, rx = channel_for_test(edge=self._edge_label("chain",
+                                                        mv.name))
         # deferred: the session attaches AFTER the whole plan validates
         # (a failed CREATE must not leave an orphan output that blocks
         # the upstream on exhausted permits), tagged with the DOWNSTREAM
@@ -1115,19 +1129,13 @@ def _push_filters(ex: Executor, scope: Scope,
 
 def explain_tree(ex, indent: int = 0) -> List[str]:
     """Executor chain → indented plan text (planner_test snapshot
-    style; the EXPLAIN statement surfaces it)."""
+    style; the EXPLAIN statement surfaces it). Walks the same
+    `executor_children` set install_monitoring wraps."""
+    from risingwave_tpu.stream.executor import executor_children
     label = getattr(ex, "identity", None) or type(ex).__name__
     out = [("  " * indent) + label]
-    for attr in ("input", "upstream"):
-        child = getattr(ex, attr, None)
-        if child is not None:
-            out += explain_tree(child, indent + 1)
-            return out
-    left = getattr(ex, "left_in", None)
-    right = getattr(ex, "right_in", None)
-    if left is not None:
-        out += explain_tree(left, indent + 1)
-        out += explain_tree(right, indent + 1)
+    for _attr, _i, child in executor_children(ex):
+        out += explain_tree(child, indent + 1)
     return out
 
 
@@ -1165,11 +1173,79 @@ def _equi_keys(on: ast.Expr, lscope: Scope, rscope: Scope
     return lkeys, rkeys
 
 
-def _system_catalog_rows(name: str, catalog: Catalog):
+def _system_catalog_rows(name: str, catalog: Catalog, profiler=None):
     """rw_catalog-style system tables (src/frontend/src/catalog/
     system_catalog/ analog, bare-named): introspection over the live
-    catalog, served as batch values. Returns (schema, rows) or None."""
+    catalog AND the process metrics registry, served as batch values.
+    Returns (schema, rows) or None. `profiler` is the session barrier
+    loop's EpochProfiler (rw_barrier_latency's source); sessions that
+    don't thread one through still serve the metric-backed tables."""
+    from risingwave_tpu.utils.metrics import STREAMING
+
     n = name.lower()
+    if n == "rw_actor_metrics":
+        # live actors (stream_actor_count series — torn-down actors'
+        # series are removed) joined with the per-executor counters
+        sch = Schema([Field("actor_id", DataType.INT64),
+                      Field("fragment", DataType.VARCHAR),
+                      Field("executor", DataType.VARCHAR),
+                      Field("node", DataType.INT64),
+                      Field("row_count", DataType.INT64),
+                      Field("chunk_count", DataType.INT64),
+                      Field("busy_seconds", DataType.FLOAT64)])
+        live = {labels["actor"]: labels.get("fragment", "")
+                for labels, _v in STREAMING.actor_count.series()
+                if "actor" in labels}
+        per_exec: Dict[tuple, List[float]] = {}
+        for metric, slot in ((STREAMING.executor_rows, 0),
+                             (STREAMING.executor_chunks, 1),
+                             (STREAMING.executor_busy, 2)):
+            for labels, v in metric.series():
+                a = labels.get("actor")
+                if a not in live:
+                    continue
+                key = (a, labels.get("executor", ""),
+                       labels.get("node", ""))
+                per_exec.setdefault(key, [0.0, 0.0, 0.0])[slot] += v
+        rows = []
+        seen_actors = set()
+        for (a, ex_name, node), (nrows, nchunks, busy) in \
+                per_exec.items():
+            seen_actors.add(a)
+            rows.append((int(a), live[a], ex_name,
+                         int(node) if node else 0,
+                         int(nrows), int(nchunks), busy))
+        for a, frag in live.items():
+            if a not in seen_actors:    # deployed but unmonitored
+                rows.append((int(a), frag, "", 0, 0, 0, 0.0))
+        return sch, sorted(rows)
+    if n == "rw_fragment_backpressure":
+        sch = Schema([Field("edge", DataType.VARCHAR),
+                      Field("send_count", DataType.INT64),
+                      Field("backpressure_seconds", DataType.FLOAT64),
+                      Field("queue_depth", DataType.INT64)])
+        edges: Dict[str, List[float]] = {}
+        for metric, slot in ((STREAMING.exchange_send_count, 0),
+                             (STREAMING.exchange_backpressure, 1),
+                             (STREAMING.exchange_queue_depth, 2)):
+            for labels, v in metric.series():
+                e = labels.get("edge")
+                if e is not None:
+                    edges.setdefault(e, [0.0, 0.0, 0.0])[slot] += v
+        rows = [(e, int(s[0]), s[1], int(s[2]))
+                for e, s in edges.items()]
+        return sch, sorted(rows)
+    if n == "rw_barrier_latency":
+        sch = Schema([Field("epoch", DataType.INT64),
+                      Field("kind", DataType.VARCHAR),
+                      Field("inject_to_collect_s", DataType.FLOAT64),
+                      Field("collect_to_commit_s", DataType.FLOAT64),
+                      Field("total_s", DataType.FLOAT64),
+                      Field("in_flight", DataType.INT64),
+                      Field("slowest_actor", DataType.INT64),
+                      Field("slowest_actor_lag_s", DataType.FLOAT64)])
+        rows = list(profiler.rows()) if profiler is not None else []
+        return sch, rows
     if n in ("rw_materialized_views", "rw_tables"):
         want_tables = n == "rw_tables"
         sch = Schema([Field("name", DataType.VARCHAR),
@@ -1200,8 +1276,12 @@ def _system_catalog_rows(name: str, catalog: Catalog):
 # -- batch planning -------------------------------------------------------
 
 
-def plan_batch(sel: ast.Select, catalog: Catalog, store, epoch: int):
-    """SELECT over committed snapshots → batch executor tree."""
+def plan_batch(sel: ast.Select, catalog: Catalog, store, epoch: int,
+               profiler=None):
+    """SELECT over committed snapshots → batch executor tree.
+
+    `profiler` (the session's EpochProfiler, optional) backs the
+    rw_barrier_latency system table."""
     from risingwave_tpu.batch import (
         BatchFilter, BatchHashAgg, BatchHashJoin, BatchLimit,
         BatchOrderBy, BatchProject, BatchValues, RowSeqScan, StorageTable,
@@ -1243,7 +1323,8 @@ def plan_batch(sel: ast.Select, catalog: Catalog, store, epoch: int):
             sch = Schema([Field(col, DataType.INT64)])
             return (BatchValues(sch, rows), Scope.of(sch, col))
         if isinstance(item, ast.Subquery):
-            sub = plan_batch(item.select, catalog, store, epoch)
+            sub = plan_batch(item.select, catalog, store, epoch,
+                             profiler)
             return sub, Scope.of(sub.schema, item.alias)
         if not isinstance(item, ast.TableRef):
             raise PlanError("batch FROM supports tables/MVs")
@@ -1252,7 +1333,8 @@ def plan_batch(sel: ast.Select, catalog: Catalog, store, epoch: int):
         except Exception:
             # USER objects win over system catalogs (pg search-path
             # spirit); only an unresolved name falls through to rw_*
-            sysrows = _system_catalog_rows(item.name, catalog)
+            sysrows = _system_catalog_rows(item.name, catalog,
+                                           profiler)
             if sysrows is None:
                 raise
             sch, rows = sysrows

@@ -279,13 +279,19 @@ class Frontend:
         heartbeat epoch would leave it collecting against actors that
         never received it."""
         register()                    # catalog entry (duplicate check)
+        # every deployed chain is instrumented node-by-node: row/chunk
+        # throughput and exclusive processing time per (fragment,
+        # actor, executor), feeding rw_actor_metrics + the profiler
+        from risingwave_tpu.stream.monitor import install_monitoring
+        consumer = install_monitoring(consumer, fragment=name,
+                                      actor_id=actor_id)
         # every MV actor carries an (initially empty) broadcast
         # dispatcher so later MV-on-MV chains can attach outputs at a
         # barrier boundary (Mutation::Add analog)
         from risingwave_tpu.stream.dispatch import BroadcastDispatcher
         actor = Actor(actor_id, consumer,
                       dispatchers=[BroadcastDispatcher([])],
-                      barrier_manager=self.local)
+                      barrier_manager=self.local, fragment=name)
         self.actors[actor_id] = actor
         self.readers[name] = readers
         self.local.set_expected_actors(list(self.actors))
@@ -427,7 +433,7 @@ class Frontend:
             sid = self.catalog.next_id()
             table_id = self.catalog.next_id()
             reader = DmlReader(schema)
-            tx, rx = channel_for_test()
+            tx, rx = channel_for_test(edge=f"dml:{stmt.name}")
             self.local.register_sender(sid, tx)
             try:
                 src = SourceExecutor(reader, rx, None, actor_id=sid)
@@ -492,7 +498,8 @@ class Frontend:
             # committed snapshot, then coerce column-wise
             from risingwave_tpu.batch import collect
             ex = plan_batch(stmt.select, self.catalog, self.store,
-                            self.store.committed_epoch())
+                            self.store.committed_epoch(),
+                            profiler=self.loop.profiler)
             if len(ex.schema) != len(data_fields):
                 raise PlanError(
                     f"INSERT SELECT has {len(ex.schema)} columns, "
@@ -833,6 +840,11 @@ class Frontend:
                 d = up.dispatchers[0]
                 d.update_outputs(
                     [o for o in d.outputs() if o is not out])
+        # with the edges detached, release the stopped chain's input
+        # receivers — drops their queue-depth series deterministically
+        if actor is not None:
+            from risingwave_tpu.stream.actor import close_receivers
+            close_receivers(actor.consumer)
         self.local.set_expected_actors(list(self.actors))
         return actor
 
@@ -877,7 +889,8 @@ class Frontend:
     async def _select(self, sel: ast.Select) -> Rows:
         from risingwave_tpu.batch import collect
         epoch = self.store.committed_epoch()
-        ex = plan_batch(sel, self.catalog, self.store, epoch)
+        ex = plan_batch(sel, self.catalog, self.store, epoch,
+                        profiler=self.loop.profiler)
         # one plan serves both rows and result typing (pgwire reads
         # this right after execute instead of re-planning)
         self.last_select_schema = ex.schema

@@ -18,14 +18,16 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Deque, Dict, List, Optional
 
 from risingwave_tpu.common.epoch import Epoch, EpochPair
 from risingwave_tpu.state.store import StateStore
 from risingwave_tpu.stream.actor import LocalBarrierManager
 from risingwave_tpu.stream.message import Barrier, BarrierKind, Mutation
-from risingwave_tpu.utils.metrics import STREAMING
+from risingwave_tpu.utils.metrics import STREAMING, exact_quantile
+from risingwave_tpu.utils.trace import GLOBAL_AWAITS
 
 
 @dataclass
@@ -36,14 +38,146 @@ class BarrierStats:
     latencies_s: List[float] = field(default_factory=list)
 
     def p99_latency_s(self) -> float:
-        if not self.latencies_s:
-            return 0.0
-        xs = sorted(self.latencies_s)
-        return xs[min(len(xs) - 1, int(len(xs) * 0.99))]
+        return exact_quantile(self.latencies_s, 0.99)
 
     def mean_latency_s(self) -> float:
         return (sum(self.latencies_s) / len(self.latencies_s)
                 if self.latencies_s else 0.0)
+
+
+@dataclass
+class EpochProfile:
+    """One barrier's breakdown + per-actor attribution snapshot."""
+
+    epoch: int
+    kind: str                         # "barrier" | "checkpoint"
+    inject_to_collect_s: float
+    collect_to_commit_s: float
+    in_flight: int                    # window depth at collection
+    actor_rows: Dict[int, float]      # rows moved this epoch, per actor
+    slowest_actor: Optional[int] = None
+    slowest_actor_lag_s: float = 0.0  # first-collect → last-collect
+    await_dump: str = ""              # attached only on slow barriers
+
+    @property
+    def total_s(self) -> float:
+        return self.inject_to_collect_s + self.collect_to_commit_s
+
+    def format(self) -> str:
+        lines = [
+            f"epoch {self.epoch:#x} ({self.kind}): "
+            f"inject→collect {self.inject_to_collect_s * 1e3:.2f}ms, "
+            f"collect→commit {self.collect_to_commit_s * 1e3:.2f}ms, "
+            f"in-flight {self.in_flight}"]
+        if self.slowest_actor is not None:
+            lines.append(
+                f"  slowest actor: {self.slowest_actor} "
+                f"(+{self.slowest_actor_lag_s * 1e3:.2f}ms after "
+                f"first collect)")
+        if self.actor_rows:
+            rows = ", ".join(f"{a}={int(n)}" for a, n in
+                             sorted(self.actor_rows.items()))
+            lines.append(f"  rows/actor: {rows}")
+        if self.await_dump:
+            lines.append("  await states at collect:")
+            lines += [f"    {ln}" for ln in
+                      self.await_dump.splitlines()]
+        return "\n".join(lines)
+
+
+class EpochProfiler:
+    """Barrier-aligned metric snapshots (the attribution layer).
+
+    At every collection the profiler diffs the per-actor row counters
+    (MonitoredExecutor series), splits the barrier into inject→collect
+    and collect→commit, and — when the barrier exceeds the slow
+    threshold — attaches the AwaitRegistry dump plus the slowest-actor
+    attribution, so a p99 outlier names its culprit instead of being
+    one opaque number.
+    """
+
+    def __init__(self, slow_threshold_s: float = 1.0,
+                 capacity: int = 1 << 16):
+        self.slow_threshold_s = slow_threshold_s
+        # bounded: profiles carry dicts and await dumps, and a 250ms
+        # heartbeat would append ~345k/day unbounded. 64k epochs keep
+        # rw_barrier_latency 1:1 with BarrierStats for any bench or
+        # test run (they trim warmup from the front of both) while a
+        # long-lived server just loses the oldest profiles.
+        self.profiles: Deque[EpochProfile] = deque(maxlen=capacity)
+        # baseline at profiler birth: the registry is process-global,
+        # so an earlier pipeline's totals must not bleed into this
+        # loop's first epoch delta
+        self._last_rows: Dict[tuple, float] = {}
+        self._actor_row_deltas()
+
+    def _actor_row_deltas(self) -> Dict[int, float]:
+        """Per-actor rows moved this epoch: the MAX over the actor's
+        monitored executor nodes — every wrapped node counts the same
+        rows flowing through, so summing would inflate by the chain
+        depth; the busiest node is the actor's true data volume."""
+        totals: Dict[tuple, float] = {}
+        for labels, v in STREAMING.executor_rows.series():
+            a = labels.get("actor")
+            if a is not None:
+                totals[(a, labels.get("node", ""))] = v
+        per_actor: Dict[int, float] = {}
+        for (a, node), v in totals.items():
+            d = v - self._last_rows.get((a, node), 0.0)
+            if d > 0:
+                try:
+                    aid = int(a)
+                except ValueError:
+                    continue
+                per_actor[aid] = max(per_actor.get(aid, 0.0), d)
+        self._last_rows = totals
+        return per_actor
+
+    def record(self, epoch: int, kind: str, inject_to_collect_s: float,
+               collect_to_commit_s: float, in_flight: int,
+               collect_times: Dict[int, float]) -> EpochProfile:
+        prof = EpochProfile(epoch, kind, inject_to_collect_s,
+                            collect_to_commit_s, in_flight,
+                            self._actor_row_deltas())
+        if collect_times:
+            slowest = max(collect_times, key=collect_times.get)
+            prof.slowest_actor = slowest
+            prof.slowest_actor_lag_s = (collect_times[slowest]
+                                        - min(collect_times.values()))
+        if prof.total_s >= self.slow_threshold_s:
+            prof.await_dump = GLOBAL_AWAITS.dump()
+        self.profiles.append(prof)
+        STREAMING.barrier_inject_to_collect.observe(inject_to_collect_s)
+        STREAMING.barrier_collect_to_commit.observe(collect_to_commit_s)
+        return prof
+
+    def drop_first(self, n: int) -> None:
+        """Discard the oldest n profiles (bench warmup epochs: the
+        trace-compile outliers must not masquerade as the steady-state
+        p99 the same result line reports)."""
+        for _ in range(min(n, len(self.profiles))):
+            self.profiles.popleft()
+
+    def rows(self) -> List[tuple]:
+        """(epoch, kind, i2c, c2c, total, in_flight, slowest_actor,
+        slowest_lag) per profiled barrier — the rw_barrier_latency
+        system-table payload."""
+        return [(p.epoch, p.kind, p.inject_to_collect_s,
+                 p.collect_to_commit_s, p.total_s, p.in_flight,
+                 p.slowest_actor, p.slowest_actor_lag_s)
+                for p in self.profiles]
+
+    def report(self, last_n: int = 10) -> str:
+        return "\n".join(p.format()
+                         for p in list(self.profiles)[-last_n:])
+
+    def p99_breakdown(self) -> Dict[str, float]:
+        return {
+            "inject_to_collect_s": exact_quantile(
+                [p.inject_to_collect_s for p in self.profiles], 0.99),
+            "collect_to_commit_s": exact_quantile(
+                [p.collect_to_commit_s for p in self.profiles], 0.99),
+        }
 
 
 class VirtualClock:
@@ -97,7 +231,8 @@ class BarrierLoop:
                  interval_ms: int = 250, checkpoint_frequency: int = 1,
                  in_flight_barrier_nums: int = 10,
                  monotonic: Callable[[], float] = time.monotonic,
-                 sleep=asyncio.sleep):
+                 sleep=asyncio.sleep,
+                 slow_barrier_threshold_s: float = 1.0):
         self.local = local
         self.store = store
         self.interval_ms = interval_ms
@@ -106,6 +241,7 @@ class BarrierLoop:
         self.monotonic = monotonic
         self.sleep = sleep
         self.stats = BarrierStats()
+        self.profiler = EpochProfiler(slow_barrier_threshold_s)
         self._epoch: Optional[Epoch] = None
         self._barriers_since_checkpoint = 0
         self._inject_times: Dict[int, float] = {}
@@ -159,6 +295,7 @@ class BarrierLoop:
         barrier = Barrier(pair, kind, mutation)
         self._inject_times[curr.value] = self.monotonic()
         self._in_flight.append(curr.value)
+        STREAMING.barrier_in_flight.set(len(self._in_flight))
         if kind.is_checkpoint:
             self._barriers_since_checkpoint = 0
         await self.local.send_barrier(barrier)
@@ -177,6 +314,8 @@ class BarrierLoop:
         assert self._in_flight, "nothing in flight"
         epoch = self._in_flight.pop(0)
         barrier = await self.local.await_epoch_complete(epoch)
+        t_collect = self.monotonic()
+        STREAMING.barrier_in_flight.set(len(self._in_flight))
         # the epoch whose data this barrier flushed is the one that ENDED:
         # barrier.epoch.prev (meta commits prev_epoch — barrier/mod.rs:652).
         # The INITIAL barrier has prev=INVALID: nothing to commit yet.
@@ -191,6 +330,13 @@ class BarrierLoop:
             lat = self.monotonic() - t0
             self.stats.latencies_s.append(lat)
             STREAMING.barrier_latency.observe(lat)
+            self.profiler.record(
+                epoch,
+                "checkpoint" if barrier.is_checkpoint else "barrier",
+                inject_to_collect_s=t_collect - t0,
+                collect_to_commit_s=self.monotonic() - t_collect,
+                in_flight=len(self._in_flight),
+                collect_times=self.local.take_collect_times(epoch))
         if barrier.is_checkpoint:
             STREAMING.checkpoint_count.inc()
             # host-memory accounting/eviction sweep piggybacks on the

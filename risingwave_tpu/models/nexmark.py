@@ -55,7 +55,8 @@ def _source(local: LocalBarrierManager, store, actor_id: int,
             rate_limit: Optional[int],
             min_chunks: Optional[int] = None) -> SourceExecutor:
     reader = NexmarkSplitReader(cfg)
-    tx, rx = channel_for_test()
+    tx, rx = channel_for_test(
+        edge=f"barrier:nexmark-{cfg.table_type}-{actor_id}")
     split_state = StateTable(table_id, SPLIT_STATE_SCHEMA, [0], store)
     local.register_sender(actor_id, tx)
     return SourceExecutor(reader, rx, split_state, actor_id=actor_id,
@@ -65,9 +66,14 @@ def _source(local: LocalBarrierManager, store, actor_id: int,
 
 def _finish(local: LocalBarrierManager, store, mat: MaterializeExecutor,
             mv_table: StateTable, actor_id: int,
-            readers: Dict[int, NexmarkSplitReader]) -> Pipeline:
+            readers: Dict[int, NexmarkSplitReader],
+            fragment: str = "nexmark") -> Pipeline:
+    from risingwave_tpu.stream.monitor import install_monitoring
     local.set_expected_actors([actor_id])
-    actor = Actor(actor_id, mat, dispatchers=[], barrier_manager=local)
+    consumer = install_monitoring(mat, fragment=fragment,
+                                  actor_id=actor_id)
+    actor = Actor(actor_id, consumer, dispatchers=[],
+                  barrier_manager=local, fragment=fragment)
     return Pipeline(actor, BarrierLoop(local, store), mv_table, readers)
 
 
@@ -91,7 +97,7 @@ def build_q1(store, cfg: NexmarkConfig,
     mv_table = StateTable(2, project.schema, [4], store)  # pk = _row_id
     mat = MaterializeExecutor(project, mv_table)
     return _finish(local, store, mat, mv_table, 1,
-                   {1: source.reader})
+                   {1: source.reader}, fragment="nexmark-q1")
 
 
 def build_q7(store, cfg: NexmarkConfig,
@@ -150,7 +156,7 @@ def build_q7(store, cfg: NexmarkConfig,
     mv_table = StateTable(3, agg.schema, [0], store)  # pk = window_start
     mat = MaterializeExecutor(agg, mv_table)
     return _finish(local, store, mat, mv_table, 1,
-                   {1: source.reader})
+                   {1: source.reader}, fragment="nexmark-q7")
 
 
 def build_q8(store, cfg_p: NexmarkConfig, cfg_a: NexmarkConfig,
@@ -223,7 +229,8 @@ def build_q8(store, cfg_p: NexmarkConfig, cfg_a: NexmarkConfig,
     mv = StateTable(6, out.schema, [0, 2], store)
     mat = MaterializeExecutor(out, mv)
     return _finish(local, store, mat, mv, 7,
-                   {1: persons.reader, 2: auctions.reader})
+                   {1: persons.reader, 2: auctions.reader},
+                   fragment="nexmark-q8")
 
 
 def drive_to_completion(pipeline: Pipeline,
@@ -285,6 +292,7 @@ def drive_to_completion(pipeline: Pipeline,
         if pipeline.actor.failure is not None:
             raise pipeline.actor.failure
         loop.stats.latencies_s = loop.stats.latencies_s[warm_epochs:]
+        loop.profiler.drop_first(warm_epochs)
         return elapsed, timed_rows
 
     return run()
@@ -331,4 +339,5 @@ def build_q5(store, cfg: NexmarkConfig,
         group_indices=[0], pk_indices=[0, 1])
     mv = StateTable(4, topn.schema, [0, 1], store)
     mat = MaterializeExecutor(topn, mv)
-    return _finish(local, store, mat, mv, 1, {1: source.reader})
+    return _finish(local, store, mat, mv, 1, {1: source.reader},
+                   fragment="nexmark-q5")

@@ -53,7 +53,7 @@ CUTOFF = (datetime.date(1995, 3, 15) - EPOCH_DAY).days
 
 def _src(local, store, aid, cfg, tid, rate_limit, min_chunks):
     reader = TpchSplitReader(cfg)
-    tx, rx = channel_for_test()
+    tx, rx = channel_for_test(edge=f"barrier:tpch-{cfg.table}-{aid}")
     st = StateTable(tid, SPLIT_STATE_SCHEMA, [0], store)
     local.register_sender(aid, tx)
     return SourceExecutor(reader, rx, st, actor_id=aid,
@@ -151,6 +151,9 @@ def build_q3(store, customers: int = 300, orders: int = 3000,
     mv = StateTable(10, topn.schema, [0, 1, 2], store)
     mat = MaterializeExecutor(topn, mv)
     local.set_expected_actors([11])
-    actor = Actor(11, mat, dispatchers=[], barrier_manager=local)
+    from risingwave_tpu.stream.monitor import install_monitoring
+    consumer = install_monitoring(mat, fragment="tpch-q3", actor_id=11)
+    actor = Actor(11, consumer, dispatchers=[], barrier_manager=local,
+                  fragment="tpch-q3")
     return Pipeline(actor, BarrierLoop(local, store), mv,
                     {1: cust_r, 2: ordr_r, 3: line_r})

@@ -13,6 +13,8 @@ from __future__ import annotations
 from collections import OrderedDict
 from typing import Callable, Tuple
 
+from risingwave_tpu.utils.metrics import STORAGE as _METRICS
+
 
 class BlockCache:
     """(sst_id, block_idx) → block bytes, evicted by byte budget."""
@@ -30,9 +32,11 @@ class BlockCache:
         b = self._blocks.get(key)
         if b is not None:
             self.hits += 1
+            _METRICS.block_cache_hits.inc()
             self._blocks.move_to_end(key)
             return b
         self.misses += 1
+        _METRICS.block_cache_misses.inc()
         b = loader()
         self._blocks[key] = b
         self._bytes += len(b)

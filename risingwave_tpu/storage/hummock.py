@@ -28,6 +28,7 @@ from typing import Dict, Iterable, Iterator, List, Optional, Tuple
 
 from risingwave_tpu.state.store import StateStore, Value
 from risingwave_tpu.utils.failpoint import fail_point
+from risingwave_tpu.utils.metrics import STORAGE as _METRICS
 from risingwave_tpu.storage.object_store import ObjectStore
 from risingwave_tpu.storage.sst import (
     EPOCH_MASK, LazySst, Sst, SstBuilder, full_key, split_full_key,
@@ -178,6 +179,8 @@ class HummockLite(StateStore):
                 b.add(fk, tomb, row)
             data, info = b.finish()
             self.obj.upload(f"data/{sst_id}.sst", data)
+            _METRICS.sst_upload_count.inc(source="sync")
+            _METRICS.sst_upload_bytes.inc(len(data), source="sync")
             if self.two_phase:
                 self._staged.append({"epoch": epoch, "sst": info})
                 self._persist_staged()
@@ -476,6 +479,9 @@ class HummockLite(StateStore):
                     and builder.largest[:-8] != fk[:-8]):
                 data, info = builder.finish()
                 self.obj.upload(f"data/{info['id']}.sst", data)
+                _METRICS.sst_upload_count.inc(source="compact")
+                _METRICS.sst_upload_bytes.inc(len(data),
+                                              source="compact")
                 new_infos.append(info)
                 builder = None
             if builder is None:
@@ -505,6 +511,8 @@ class HummockLite(StateStore):
         if builder is not None:
             data, info = builder.finish()
             self.obj.upload(f"data/{info['id']}.sst", data)
+            _METRICS.sst_upload_count.inc(source="compact")
+            _METRICS.sst_upload_bytes.inc(len(data), source="compact")
             new_infos.append(info)
         self._l0 = []
         # splice: untouched runs below + rewritten range + above stays

@@ -11,11 +11,38 @@ pattern (SSTs are write-once).
 
 from __future__ import annotations
 
+import contextlib
 import os
 import tempfile
+import time
 from typing import Dict, List, Protocol
 
 from risingwave_tpu.utils.failpoint import fail_point
+from risingwave_tpu.utils.metrics import STORAGE as _METRICS
+
+_suppress_ops = 0
+
+
+@contextlib.contextmanager
+def unmetered():
+    """Suppress op metering for the block (tooling copies — the ctl
+    snapshot clone — must not count as serving traffic)."""
+    global _suppress_ops
+    _suppress_ops += 1
+    try:
+        yield
+    finally:
+        _suppress_ops -= 1
+
+
+def _record_op(op: str, t0: float) -> None:
+    """Op count + latency per object-store verb (the object_store_
+    operation metric family every backend feeds)."""
+    if _suppress_ops:
+        return
+    _METRICS.object_store_ops.inc(op=op)
+    _METRICS.object_store_latency.observe(
+        time.perf_counter() - t0, op=op)
 
 
 class ObjectStore(Protocol):
@@ -38,17 +65,25 @@ class MemObjectStore:
 
     def upload(self, path: str, data: bytes) -> None:
         fail_point("object_store.upload")
+        t0 = time.perf_counter()
         self._objects[path] = bytes(data)
+        _record_op("upload", t0)
 
     def read(self, path: str) -> bytes:
         fail_point("object_store.read")
-        return self._objects[path]
+        t0 = time.perf_counter()
+        data = self._objects[path]
+        _record_op("read", t0)
+        return data
 
     def read_range(self, path: str, off: int, length: int) -> bytes:
         """Ranged read (S3 byte-range GET analog) — the block cache's
         way to touch one block without shipping the whole SST."""
         fail_point("object_store.read")
-        return self._objects[path][off:off + length]
+        t0 = time.perf_counter()
+        data = self._objects[path][off:off + length]
+        _record_op("read_range", t0)
+        return data
 
     def size(self, path: str) -> int:
         return len(self._objects[path])
@@ -78,6 +113,7 @@ class LocalFsObjectStore:
 
     def upload(self, path: str, data: bytes) -> None:
         fail_point("object_store.upload")
+        t0 = time.perf_counter()
         dst = self._abs(path)
         os.makedirs(os.path.dirname(dst), exist_ok=True)
         fd, tmp = tempfile.mkstemp(dir=os.path.dirname(dst))
@@ -89,17 +125,24 @@ class LocalFsObjectStore:
             if os.path.exists(tmp):
                 os.unlink(tmp)
             raise
+        _record_op("upload", t0)
 
     def read(self, path: str) -> bytes:
         fail_point("object_store.read")
+        t0 = time.perf_counter()
         with open(self._abs(path), "rb") as f:
-            return f.read()
+            data = f.read()
+        _record_op("read", t0)
+        return data
 
     def read_range(self, path: str, off: int, length: int) -> bytes:
         fail_point("object_store.read")
+        t0 = time.perf_counter()
         with open(self._abs(path), "rb") as f:
             f.seek(off)
-            return f.read(length)
+            data = f.read(length)
+        _record_op("read_range", t0)
+        return data
 
     def size(self, path: str) -> int:
         return os.path.getsize(self._abs(path))
@@ -224,26 +267,32 @@ class S3ObjectStore:
     # -- ObjectStore protocol -----------------------------------------
     def upload(self, path: str, data: bytes) -> None:
         fail_point("object_store.upload")
+        t0 = time.perf_counter()
         status, body, _h = self._request("PUT", path, body=data)
         if status not in (200, 201, 204):
             raise IOError(f"S3 PUT {path}: {status} {body[:200]!r}")
+        _record_op("upload", t0)
 
     def read(self, path: str) -> bytes:
         fail_point("object_store.read")
+        t0 = time.perf_counter()
         status, data, _h = self._request("GET", path)
         if status == 404:
             raise FileNotFoundError(path)
         if status != 200:
             raise IOError(f"S3 GET {path}: {status}")
+        _record_op("read", t0)
         return data
 
     def read_range(self, path: str, off: int, length: int) -> bytes:
         fail_point("object_store.read")
+        t0 = time.perf_counter()
         status, data, _h = self._request(
             "GET", path,
             headers={"Range": f"bytes={off}-{off + length - 1}"})
         if status in (200, 206):
             # a 200 means the endpoint ignored Range — slice locally
+            _record_op("read_range", t0)
             return data[off:off + length] if status == 200 else data
         if status == 404:
             raise FileNotFoundError(path)

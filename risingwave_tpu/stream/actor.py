@@ -15,12 +15,42 @@ barriers are free to run async until then).
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Set
 
 from risingwave_tpu.stream.dispatch import Dispatcher
-from risingwave_tpu.stream.exchange import ChannelClosed, Sender
-from risingwave_tpu.stream.executor import Executor
+from risingwave_tpu.stream.exchange import ChannelClosed, Receiver, Sender
+from risingwave_tpu.stream.executor import Executor, executor_children
 from risingwave_tpu.stream.message import Barrier, is_barrier, is_chunk
+from risingwave_tpu.utils.metrics import STREAMING as _METRICS
+
+
+def _remove_actor_series(actor_id: int) -> None:
+    """Drop every stream_actor_count series for one actor id (the
+    label set may carry a fragment the teardown path doesn't know)."""
+    sid = str(actor_id)
+    for labels, _v in _METRICS.actor_count.series():
+        if labels.get("actor") == sid:
+            _METRICS.actor_count.remove(**labels)
+
+
+def close_receivers(ex, attrs=("rx", "barrier_rx")) -> None:
+    """Release the exchange Receivers an executor tree owns.
+    Deterministic teardown: the generators' own finally blocks only
+    run when the abandoned async-generator chain is GC-finalized —
+    one event-loop tick per nesting level — which would leave dead
+    edges' queue-depth series in the registry for an unbounded number
+    of ticks after a drop. The actor exit path closes only its own
+    barrier channels (`barrier_rx`); chain-input receivers (`rx`)
+    close after the upstream dispatcher detached the edge — closing
+    them at actor exit would race a still-live upstream's dispatch
+    into a ChannelClosed failure."""
+    for attr in attrs:
+        r = getattr(ex, attr, None)
+        if isinstance(r, Receiver):
+            r.close()
+    for _attr, _i, child in executor_children(ex):
+        close_receivers(child, attrs)
 
 
 class Actor:
@@ -28,14 +58,18 @@ class Actor:
 
     def __init__(self, actor_id: int, consumer: Executor,
                  dispatchers: Sequence[Dispatcher],
-                 barrier_manager: Optional["LocalBarrierManager"] = None):
+                 barrier_manager: Optional["LocalBarrierManager"] = None,
+                 fragment: str = ""):
         self.actor_id = actor_id
         self.consumer = consumer
         self.dispatchers = list(dispatchers)
         self.barrier_manager = barrier_manager
+        self.fragment = fragment
         self.failure: Optional[BaseException] = None
 
     async def run(self) -> None:
+        _METRICS.actor_count.set(1, actor=str(self.actor_id),
+                                 fragment=self.fragment)
         try:
             await self._run_consumer()
         except asyncio.CancelledError:
@@ -46,6 +80,9 @@ class Actor:
                 self.barrier_manager.notify_failure(self.actor_id, e)
             else:
                 raise
+        finally:
+            _remove_actor_series(self.actor_id)
+            close_receivers(self.consumer, attrs=("barrier_rx",))
 
     async def _run_consumer(self) -> None:
         async for msg in self.consumer.execute():
@@ -83,6 +120,14 @@ class LocalBarrierManager:
         self._complete: Dict[int, asyncio.Event] = {}
         self._barriers: Dict[int, Barrier] = {}
         self._failed: Optional[BaseException] = None
+        # epoch -> actor -> wall time of its collect() (epoch-profiler
+        # input: the spread attributes a slow barrier to its straggler).
+        # Bounded: entries move to the single _last_collect slot at
+        # epoch completion — worker processes have no BarrierLoop to
+        # drain them, and an unpopped per-epoch dict would leak one
+        # entry per barrier for the life of the process.
+        self._collect_times: Dict[int, Dict[int, float]] = {}
+        self._last_collect: tuple = (None, {})
 
     # -- wiring --------------------------------------------------------
     def register_sender(self, actor_id: int, sender: Sender) -> None:
@@ -108,12 +153,23 @@ class LocalBarrierManager:
         epoch = barrier.epoch.curr.value
         got = self._collected.setdefault(epoch, set())
         got.add(actor_id)
+        self._collect_times.setdefault(epoch, {})[actor_id] = \
+            time.monotonic()
         ev = self._complete.setdefault(epoch, asyncio.Event())
         if self._expected_actors and got >= self._expected_actors:
             ev.set()
 
+    def take_collect_times(self, epoch: int) -> Dict[int, float]:
+        """Pop the per-actor collect timestamps for one epoch."""
+        e, times = self._last_collect
+        if e == epoch:
+            self._last_collect = (None, {})
+            return times
+        return self._collect_times.pop(epoch, {})
+
     def notify_failure(self, actor_id: int, err: BaseException) -> None:
         self._failed = err
+        _remove_actor_series(actor_id)
         for ev in self._complete.values():
             ev.set()
 
@@ -126,11 +182,13 @@ class LocalBarrierManager:
                 f"actor failure during epoch {epoch:#x}") from self._failed
         self._collected.pop(epoch, None)
         self._complete.pop(epoch, None)
+        self._last_collect = (epoch, self._collect_times.pop(epoch, {}))
         return self._barriers.pop(epoch)
 
     def drop_actor(self, actor_id: int) -> None:
         self._expected_actors.discard(actor_id)
         self._barrier_senders.pop(actor_id, None)
+        _remove_actor_series(actor_id)
         for epoch, got in self._collected.items():
             if self._expected_actors and got >= self._expected_actors:
                 self._complete[epoch].set()

@@ -15,10 +15,12 @@ traffic: source ingestion, cross-fragment pipes, sink output.
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import AsyncIterator, Optional, Tuple
 
 from risingwave_tpu.common.chunk import StreamChunk
 from risingwave_tpu.stream.message import Barrier, Message, Watermark
+from risingwave_tpu.utils.metrics import STREAMING as _METRICS
 
 
 class ChannelClosed(Exception):
@@ -27,13 +29,25 @@ class ChannelClosed(Exception):
 
 class _Shared:
     def __init__(self, chunk_permits: int, barrier_permits: int,
-                 max_chunk_cost: int):
+                 max_chunk_cost: int, edge: Optional[str] = None):
         self.queue: asyncio.Queue = asyncio.Queue()
         self.chunk_permits = chunk_permits
         self.barrier_permits = barrier_permits
         self.max_chunk_cost = max_chunk_cost
         self.cond = asyncio.Condition()
         self.closed = False
+        # labeled edges feed the back-pressure/queue-depth series
+        # (stream_exchange_backpressure analog); anonymous channels
+        # (unit-test plumbing) skip the metric path entirely. Series
+        # handles cache the label key — sends are per-message.
+        self.edge = edge
+        if edge:
+            self.m_backpressure = \
+                _METRICS.exchange_backpressure.labeled(edge=edge)
+            self.m_sends = _METRICS.exchange_send_count.labeled(
+                edge=edge)
+            self.m_depth = _METRICS.exchange_queue_depth.labeled(
+                edge=edge)
 
 
 def _chunk_cost(shared: _Shared, chunk: StreamChunk) -> int:
@@ -48,6 +62,7 @@ class Sender:
 
     async def send(self, msg: Message) -> None:
         s = self._s
+        t0 = time.perf_counter() if s.edge else 0.0
         if isinstance(msg, StreamChunk):
             cost = _chunk_cost(s, msg)
             async with s.cond:
@@ -69,6 +84,12 @@ class Sender:
             if s.closed:
                 raise ChannelClosed
             s.queue.put_nowait(("watermark", 0, msg))
+        if s.edge:
+            # permit-acquisition time IS the back-pressure signal: a
+            # full downstream queue shows up as senders parked here
+            s.m_backpressure.inc(time.perf_counter() - t0)
+            s.m_sends.inc()
+            s.m_depth.set(s.queue.qsize())
 
     def close(self) -> None:
         self._s.queue.put_nowait(("eos", 0, None))
@@ -82,7 +103,11 @@ class Receiver:
         s = self._s
         kind, cost, msg = await s.queue.get()
         if kind == "eos":
+            if s.edge:     # the edge is dead: no stale gauge series
+                _METRICS.exchange_queue_depth.remove(edge=s.edge)
             raise ChannelClosed
+        if s.edge and not s.closed:
+            s.m_depth.set(s.queue.qsize())
         if cost:
             async with s.cond:
                 if kind == "chunk":
@@ -100,6 +125,8 @@ class Receiver:
         except asyncio.QueueEmpty:
             return None
         if kind == "eos":
+            if s.edge:
+                _METRICS.exchange_queue_depth.remove(edge=s.edge)
             raise ChannelClosed
         if cost:
             # return permits without blocking: schedule the notify
@@ -128,6 +155,9 @@ class Receiver:
                 s.cond.notify_all()
 
         s.closed = True
+        if s.edge:
+            # stale gauge series would keep reporting a dead edge
+            _METRICS.exchange_queue_depth.remove(edge=s.edge)
         try:
             loop = asyncio.get_running_loop()
             loop.create_task(_close())
@@ -143,18 +173,22 @@ class Receiver:
 
 
 def channel(chunk_permits: int = 32768, barrier_permits: int = 4,
-            max_chunk_cost: Optional[int] = None
-            ) -> Tuple[Sender, Receiver]:
+            max_chunk_cost: Optional[int] = None,
+            edge: Optional[str] = None) -> Tuple[Sender, Receiver]:
     """Bounded exchange channel (permit.rs:35 `channel` analog).
 
     max_chunk_cost caps a single chunk's cost below the full budget so one
-    oversized chunk can always eventually pass.
+    oversized chunk can always eventually pass. `edge` names the channel
+    in the exchange metric families (back-pressure time, send count,
+    queue depth); unnamed channels are unmetered.
     """
     if max_chunk_cost is None:
         max_chunk_cost = max(1, chunk_permits // 2)
-    shared = _Shared(chunk_permits, barrier_permits, max_chunk_cost)
+    shared = _Shared(chunk_permits, barrier_permits, max_chunk_cost,
+                     edge=edge)
     return Sender(shared), Receiver(shared)
 
 
-def channel_for_test() -> Tuple[Sender, Receiver]:
-    return channel(chunk_permits=1 << 20, barrier_permits=64)
+def channel_for_test(edge: Optional[str] = None
+                     ) -> Tuple[Sender, Receiver]:
+    return channel(chunk_permits=1 << 20, barrier_permits=64, edge=edge)

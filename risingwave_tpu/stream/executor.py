@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import abc
 from dataclasses import dataclass, field
-from typing import AsyncIterator, List, Optional, Sequence
+from typing import AsyncIterator, List, Optional, Sequence, Tuple
 
 from risingwave_tpu.common.types import Schema
 from risingwave_tpu.stream.message import Message
@@ -53,3 +53,32 @@ class Executor(abc.ABC):
 
     def __repr__(self) -> str:
         return f"{self.identity}({self.schema!r})"
+
+
+# attributes under which executors hold their input executors, in plan
+# order (the conventional names every executor in stream/executors
+# uses; `inputs` is the list form — UnionExecutor)
+_CHILD_ATTRS = ("input", "upstream", "left_in", "right_in")
+_CHILD_LIST_ATTRS = ("inputs",)
+
+
+def executor_children(ex) -> List[Tuple[str, Optional[int],
+                                        "Executor"]]:
+    """(attr, list-index-or-None, child) per input executor of `ex`.
+
+    THE shared tree walk: explain_tree renders with it and
+    install_monitoring wraps with it — two drifting copies of this
+    list would silently drop a subtree out of monitoring (its parent's
+    'exclusive' time then absorbs the whole unwrapped subtree)."""
+    out: List[Tuple[str, Optional[int], Executor]] = []
+    for attr in _CHILD_ATTRS:
+        c = getattr(ex, attr, None)
+        if isinstance(c, Executor):
+            out.append((attr, None, c))
+    for attr in _CHILD_LIST_ATTRS:
+        cs = getattr(ex, attr, None)
+        if isinstance(cs, list):
+            for i, c in enumerate(cs):
+                if isinstance(c, Executor):
+                    out.append((attr, i, c))
+    return out

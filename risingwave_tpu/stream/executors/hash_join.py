@@ -108,6 +108,7 @@ from risingwave_tpu.stream.executors.keys import (
     LANES_PER_KEY, KeyCodec,
 )
 from risingwave_tpu.stream.message import Message, Watermark, is_barrier
+from risingwave_tpu.utils.metrics import STREAMING as _METRICS
 
 
 class _Arena:
@@ -1160,10 +1161,7 @@ class HashJoinExecutor(Executor):
                     side.table.commit(msg.epoch)
                     evicted = side.evict_cold()
                     if evicted:
-                        from risingwave_tpu.utils.metrics import (
-                            STREAMING as _M,
-                        )
-                        _M.join_rows_evicted.inc(
+                        _METRICS.join_rows_evicted.inc(
                             evicted, executor=self.identity)
                     else:
                         side.maybe_compact()

@@ -38,6 +38,11 @@ class ReceiverExecutor(Executor):
         self.actor_id = actor_id
 
     async def execute(self) -> AsyncIterator[Message]:
+        # NOTE: no rx.close() on teardown here — the chain edge may
+        # still be attached to a live upstream dispatcher (a close
+        # would turn its next dispatch into ChannelClosed and kill the
+        # healthy upstream); the session's _stop_job closes the rx via
+        # close_receivers AFTER detaching the edge
         while True:
             try:
                 msg = await self.rx.recv()

@@ -102,6 +102,9 @@ class SourceExecutor(Executor):
             self.split_state.commit(barrier.epoch)
 
     async def execute(self) -> AsyncIterator[Message]:
+        # (barrier_rx teardown lives in Actor.run's close_receivers —
+        # the owning actor's exit point, which runs deterministically
+        # instead of waiting on async-generator finalization)
         # protocol: first message is the init barrier (source_executor.rs
         # waits for the first barrier before opening the reader)
         first = await self.barrier_rx.recv()

@@ -1,0 +1,117 @@
+"""MonitoredExecutor: per-(fragment, actor, executor) instrumentation.
+
+Reference parity: src/stream/src/executor/monitor/streaming_stats.rs —
+every executor in a deployed chain is wrapped so row/chunk throughput
+and processing time land in the process registry under a
+`fragment/actor/executor` label scheme, and the await-registry always
+knows which executor an actor is currently parked in (the await-tree
+dump a stalled barrier attributes against).
+
+Exclusive processing time: in a pull pipeline, awaiting an inner
+executor's `__anext__` includes the whole upstream chain's work. Every
+node in the chain is wrapped, so a wrapper's *exclusive* time is its
+own cumulative pull time minus its wrapped inputs' — computed per
+epoch at each barrier passage (both sides of the subtraction observe
+the same barrier boundary: an input's clock only advances while its
+consumer awaits it).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import AsyncIterator, List, Optional
+
+from risingwave_tpu.stream.executor import (
+    Executor, ExecutorInfo, executor_children,
+)
+from risingwave_tpu.stream.message import Message, is_barrier, is_chunk
+from risingwave_tpu.utils.metrics import STREAMING as _METRICS
+from risingwave_tpu.utils.trace import GLOBAL_AWAITS as _AWAITS
+
+
+class MonitoredExecutor(Executor):
+    """Transparent metrics wrapper around one executor node."""
+
+    def __init__(self, inner: Executor, fragment: str, actor_id: int,
+                 node: int,
+                 children: Optional[List["MonitoredExecutor"]] = None):
+        super().__init__(ExecutorInfo(inner.schema,
+                                      list(inner.pk_indices),
+                                      inner.identity))
+        self.inner = inner
+        self.children = list(children or [])
+        self.labels = {"fragment": fragment, "actor": str(actor_id),
+                       "executor": inner.identity, "node": str(node)}
+        self.total_busy_s = 0.0     # cumulative time inside inner pulls
+        self._mark_own = 0.0        # totals at the last barrier
+        self._mark_kids = 0.0
+        self._who = f"actor-{actor_id}/{node}:{inner.identity}"
+
+    def __getattr__(self, name: str):
+        # transparent introspection: chain walkers (tests, debuggers)
+        # reach the inner executor's attributes (.input, .kernel,
+        # .sides, .table, …) through the wrapper
+        if name == "inner":
+            raise AttributeError(name)
+        return getattr(self.inner, name)
+
+    def _flush_epoch(self) -> None:
+        own = self.total_busy_s
+        kids = sum(c.total_busy_s for c in self.children)
+        excl = max(0.0, (own - self._mark_own)
+                   - (kids - self._mark_kids))
+        self._mark_own, self._mark_kids = own, kids
+        _METRICS.executor_busy.inc(excl, **self.labels)
+        _METRICS.executor_epoch_seconds.observe(excl, **self.labels)
+
+    async def execute(self) -> AsyncIterator[Message]:
+        it = self.inner.execute()
+        try:
+            while True:
+                t0 = time.perf_counter()
+                _AWAITS.enter(self._who, "poll_next")
+                try:
+                    msg = await it.__anext__()
+                except StopAsyncIteration:
+                    break
+                finally:
+                    _AWAITS.exit(self._who)
+                    self.total_busy_s += time.perf_counter() - t0
+                if is_chunk(msg):
+                    _METRICS.executor_rows.inc(msg.cardinality(),
+                                               **self.labels)
+                    _METRICS.executor_chunks.inc(1, **self.labels)
+                elif is_barrier(msg):
+                    self._flush_epoch()
+                yield msg
+        finally:
+            _AWAITS.exit(self._who)
+
+
+def install_monitoring(root: Executor, fragment: str,
+                       actor_id: int) -> Executor:
+    """Wrap every node of an executor tree in a MonitoredExecutor.
+
+    Walks the chain with the shared `executor_children` helper (the
+    same walk explain_tree renders with), REPLACES each child
+    reference with its wrapper (executors pull from whatever their
+    attribute points at), and returns the wrapped root for the actor
+    to drive.
+    """
+    counter = [0]
+
+    def wrap(ex: Executor) -> MonitoredExecutor:
+        node = counter[0]
+        counter[0] += 1
+        children: List[MonitoredExecutor] = []
+        for attr, idx, child in executor_children(ex):
+            w = wrap(child)
+            if idx is None:
+                setattr(ex, attr, w)
+            else:
+                getattr(ex, attr)[idx] = w
+            children.append(w)
+        return MonitoredExecutor(ex, fragment, actor_id, node,
+                                 children)
+
+    return wrap(root)

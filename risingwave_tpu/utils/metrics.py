@@ -16,12 +16,31 @@ from typing import Dict, List, Optional, Sequence, Tuple
 LabelKey = Tuple[Tuple[str, str], ...]
 
 
+def _help_lines(name: str, help_: str) -> List[str]:
+    """`# HELP` precedes `# TYPE` (Prometheus exposition order); an
+    empty help string renders nothing — real scrapers tolerate the
+    omission but tooling (promtool lint) wants the line when known."""
+    if not help_:
+        return []
+    text = help_.replace("\\", "\\\\").replace("\n", "\\n")
+    return [f"# HELP {name} {text}"]
+
+
 def _fmt_value(v: float) -> str:
     """Full-precision exposition: '%g' truncates to 6 significant
     digits, freezing large counters in a scraper's eyes."""
     if float(v).is_integer() and abs(v) < 2**63:
         return str(int(v))
     return repr(float(v))
+
+
+def exact_quantile(xs: Sequence[float], q: float) -> float:
+    """Exact quantile over raw observations (shared by Histogram,
+    BarrierStats and the epoch profiler — one index convention)."""
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(len(xs) * q))]
 
 
 def _label_key(labels: Dict[str, str]) -> LabelKey:
@@ -35,6 +54,24 @@ def _fmt_labels(key: LabelKey) -> str:
     return "{" + inner + "}"
 
 
+class Series:
+    """Cached-label handle onto one series: per-message hot paths
+    (exchange sends) skip rebuilding the sorted label key each call."""
+
+    __slots__ = ("_values", "_key")
+
+    def __init__(self, values: Dict[LabelKey, float], key: LabelKey):
+        self._values = values
+        self._key = key
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._values[self._key] = \
+            self._values.get(self._key, 0.0) + amount
+
+    def set(self, value: float) -> None:
+        self._values[self._key] = value
+
+
 class Counter:
     def __init__(self, name: str, help_: str = ""):
         self.name = name
@@ -45,11 +82,24 @@ class Counter:
         k = _label_key(labels)
         self._values[k] = self._values.get(k, 0.0) + amount
 
+    def labeled(self, **labels: str) -> Series:
+        return Series(self._values, _label_key(labels))
+
     def get(self, **labels: str) -> float:
         return self._values.get(_label_key(labels), 0.0)
 
+    def series(self) -> List[Tuple[Dict[str, str], float]]:
+        """Every labeled series as (labels, value) — the system-table
+        read path (rw_actor_metrics and friends)."""
+        return [(dict(k), v) for k, v in sorted(self._values.items())]
+
+    def remove(self, **labels: str) -> None:
+        """Drop a labeled series (actor teardown)."""
+        self._values.pop(_label_key(labels), None)
+
     def render(self) -> List[str]:
-        out = [f"# TYPE {self.name} counter"]
+        out = _help_lines(self.name, self.help)
+        out.append(f"# TYPE {self.name} counter")
         for k, v in sorted(self._values.items()):
             out.append(f"{self.name}{_fmt_labels(k)} {_fmt_value(v)}")
         return out
@@ -64,6 +114,9 @@ class Gauge:
     def set(self, value: float, **labels: str) -> None:
         self._values[_label_key(labels)] = value
 
+    def labeled(self, **labels: str) -> Series:
+        return Series(self._values, _label_key(labels))
+
     def get(self, **labels: str) -> float:
         return self._values.get(_label_key(labels), 0.0)
 
@@ -72,8 +125,12 @@ class Gauge:
         stale series in the process-global registry)."""
         self._values.pop(_label_key(labels), None)
 
+    def series(self) -> List[Tuple[Dict[str, str], float]]:
+        return [(dict(k), v) for k, v in sorted(self._values.items())]
+
     def render(self) -> List[str]:
-        out = [f"# TYPE {self.name} gauge"]
+        out = _help_lines(self.name, self.help)
+        out.append(f"# TYPE {self.name} gauge")
         for k, v in sorted(self._values.items()):
             out.append(f"{self.name}{_fmt_labels(k)} {_fmt_value(v)}")
         return out
@@ -111,16 +168,28 @@ class Histogram:
             raw.append(value)
 
     def quantile(self, q: float, **labels: str) -> float:
-        raw = sorted(self._raw.get(_label_key(labels), []))
-        if not raw:
-            return 0.0
-        return raw[min(len(raw) - 1, int(len(raw) * q))]
+        return exact_quantile(self._raw.get(_label_key(labels), []), q)
 
     def count(self, **labels: str) -> int:
         return self._total.get(_label_key(labels), 0)
 
+    def sum(self, **labels: str) -> float:
+        return self._sum.get(_label_key(labels), 0.0)
+
+    def series(self) -> List[Tuple[Dict[str, str], int, float]]:
+        """(labels, observation count, sum) per labeled series."""
+        return [(dict(k), self._total.get(k, 0),
+                 self._sum.get(k, 0.0))
+                for k in sorted(self._counts)]
+
+    def remove(self, **labels: str) -> None:
+        k = _label_key(labels)
+        for d in (self._counts, self._sum, self._total, self._raw):
+            d.pop(k, None)
+
     def render(self) -> List[str]:
-        out = [f"# TYPE {self.name} histogram"]
+        out = _help_lines(self.name, self.help)
+        out.append(f"# TYPE {self.name} histogram")
         for k, counts in sorted(self._counts.items()):
             acc = 0
             for le, c in zip(self.buckets, counts):
@@ -199,6 +268,64 @@ class StreamingMetrics:
             "stream_host_state_bytes",
             "accounted host-resident state per cache "
             "(EstimateSize analog)")
+        # -- per-executor instrumentation (MonitoredExecutor) ---------
+        self.executor_chunks = r.counter(
+            "stream_executor_chunk_count",
+            "chunks emitted per (fragment, actor, executor)")
+        self.executor_busy = r.counter(
+            "stream_executor_busy_seconds",
+            "exclusive processing time per (fragment, actor, "
+            "executor) — own pull time minus wrapped inputs'")
+        self.executor_epoch_seconds = r.histogram(
+            "stream_executor_epoch_processing_seconds",
+            "per-epoch exclusive processing time per executor")
+        # -- exchange edges (permit.rs back-pressure analog) ----------
+        self.exchange_backpressure = r.counter(
+            "stream_exchange_backpressure_seconds",
+            "time senders spent acquiring permits per edge "
+            "(stream_exchange_backpressure analog)")
+        self.exchange_send_count = r.counter(
+            "stream_exchange_send_count",
+            "messages sent per exchange edge")
+        self.exchange_queue_depth = r.gauge(
+            "stream_exchange_queue_depth",
+            "messages queued per exchange edge")
+        # -- barrier-loop breakdown (epoch profiler) ------------------
+        self.barrier_inject_to_collect = r.histogram(
+            "meta_barrier_inject_to_collect_seconds",
+            "inject→collect time per barrier")
+        self.barrier_collect_to_commit = r.histogram(
+            "meta_barrier_collect_to_commit_seconds",
+            "collect→commit (seal+sync) time per barrier")
+        self.barrier_in_flight = r.gauge(
+            "meta_barrier_in_flight_count",
+            "injected-but-uncollected barriers")
+
+
+class StorageMetrics:
+    """Storage-tier metric family (state_store/object_store analog)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        r = registry or GLOBAL
+        self.block_cache_hits = r.counter(
+            "state_store_block_cache_hit_count",
+            "block-cache hits (sstable_store block_cache analog)")
+        self.block_cache_misses = r.counter(
+            "state_store_block_cache_miss_count",
+            "block-cache misses → ranged object-store reads")
+        self.sst_upload_count = r.counter(
+            "state_store_sst_upload_count",
+            "SSTs built and uploaded at checkpoint sync")
+        self.sst_upload_bytes = r.counter(
+            "state_store_sst_upload_bytes",
+            "bytes of SST data uploaded")
+        self.object_store_ops = r.counter(
+            "object_store_operation_count",
+            "object-store operations by op (upload/read/read_range)")
+        self.object_store_latency = r.histogram(
+            "object_store_operation_latency_seconds",
+            "object-store operation latency by op")
 
 
 STREAMING = StreamingMetrics()
+STORAGE = StorageMetrics()
