@@ -31,6 +31,9 @@ def _metrics_snapshot(loop) -> dict:
     return {
         "p99_inject_to_collect_s": round(b["inject_to_collect_s"], 5),
         "p99_collect_to_commit_s": round(b["collect_to_commit_s"], 5),
+        # the async checkpoint tail (seal→durable commit), overlapped
+        # with younger barriers — NOT part of barrier latency
+        "p99_upload_s": round(b["upload_s"], 5),
         "exchange_backpressure_s": round(
             sum(v for _l, v in
                 STREAMING.exchange_backpressure.series()), 5),
@@ -300,8 +303,6 @@ def _bench_adctr_subprocess() -> dict:
     """Run the ad-ctr config in a 4-virtual-device CPU-mesh subprocess
     (BASELINE config #5 is 4-chip; with one real chip the mesh is
     virtual — the result is labeled accordingly)."""
-    import os
-    import subprocess
     return _run_bench_subprocess(
         ["--adctr-sub"],
         {"JAX_PLATFORMS": "cpu",

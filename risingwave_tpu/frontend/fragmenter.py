@@ -213,8 +213,17 @@ class Fragmenter:
                 "dedup_table_ids": {
                     col: t.table_id
                     for col, t in ex.distinct_tables.items()},
+                # sketch tables ride in the same map: the executor's
+                # __init__ POPPED approx_count_distinct entries out of
+                # minput into hll_tables, but the worker-side rebuild
+                # (plan_ir agg_aux_tables) transports them through
+                # minput_table_ids — omitting them made every
+                # distributed CREATE MV with approx_count_distinct
+                # fail at build ("ship minput_table_ids[j]")
                 "minput_table_ids": {
-                    j: t.table_id for j, t in ex.minput.items()},
+                    **{j: t.table_id for j, t in ex.minput.items()},
+                    **{j: t.table_id
+                       for j, t in ex.hll_tables.items()}},
             }
             if self.parallelism > 1 and \
                     getattr(ex, "two_phase_role", None) != "local":

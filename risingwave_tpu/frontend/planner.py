@@ -574,8 +574,16 @@ class StreamPlanner:
                       "left": JoinType.LEFT_OUTER,
                       "right": JoinType.RIGHT_OUTER,
                       "full": JoinType.FULL_OUTER}[jn.kind]
+                # cold-tier eligibility: INNER + single-chip AND both
+                # inputs PROVABLY append-only — a retraction for an
+                # evicted key cannot be applied against device state
+                # (ADVICE r5 high: the silent-skip would leave
+                # already-emitted join outputs permanently stale), so
+                # a retracting input runs uncapped instead
                 cap = (self.join_state_cap
                        if jt == JoinType.INNER and self.mesh is None
+                       and self._derive_append_only(left)
+                       and self._derive_append_only(right)
                        else None)
                 if cap is not None:
                     # cold tier: state-table pks lead with the join
@@ -1243,7 +1251,9 @@ def _system_catalog_rows(name: str, catalog: Catalog, profiler=None):
                       Field("total_s", DataType.FLOAT64),
                       Field("in_flight", DataType.INT64),
                       Field("slowest_actor", DataType.INT64),
-                      Field("slowest_actor_lag_s", DataType.FLOAT64)])
+                      Field("slowest_actor_lag_s", DataType.FLOAT64),
+                      Field("upload_s", DataType.FLOAT64),
+                      Field("queue_depth", DataType.INT64)])
         rows = list(profiler.rows()) if profiler is not None else []
         return sch, rows
     if n in ("rw_materialized_views", "rw_tables"):

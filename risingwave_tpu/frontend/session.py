@@ -109,6 +109,12 @@ class Frontend:
         # re-persists the whole log, so losing it here would truncate
         # the catalog on the following recovery
         self._ddl_log = list(log)
+        # the previous generation is dead (single-writer recovery):
+        # clear its crash residue — uploaded-but-uncommitted SSTs no
+        # version references would otherwise accumulate forever across
+        # kill/recover generations
+        if hasattr(self.store, "vacuum_orphans"):
+            self.store.vacuum_orphans()
         self._replaying = True
         try:
             for sql in log:
@@ -176,7 +182,13 @@ class Frontend:
         try:
             while True:
                 await asyncio.sleep(interval_s)
-                await self._barrier()
+                # no uploader drain: the heartbeat is exactly the
+                # driver the async checkpoint pipeline overlaps —
+                # draining every beat would stall barrier cadence on
+                # object-store latency again. Failures still surface
+                # on the next beat's collect; FLUSH/DDL/step() keep
+                # their durable (draining) semantics.
+                await self._barrier(drain_uploader=False)
         except asyncio.CancelledError:
             pass
         except BaseException:

@@ -24,6 +24,7 @@ from typing import Callable, Deque, Dict, List, Optional
 
 from risingwave_tpu.common.epoch import Epoch, EpochPair
 from risingwave_tpu.state.store import StateStore
+from risingwave_tpu.storage.uploader import CheckpointUploader
 from risingwave_tpu.stream.actor import LocalBarrierManager
 from risingwave_tpu.stream.message import Barrier, BarrierKind, Mutation
 from risingwave_tpu.utils.metrics import STREAMING, exact_quantile
@@ -58,6 +59,12 @@ class EpochProfile:
     slowest_actor: Optional[int] = None
     slowest_actor_lag_s: float = 0.0  # first-collect → last-collect
     await_dump: str = ""              # attached only on slow barriers
+    # async checkpoint tail: seal→durable-commit time (patched in by
+    # the uploader when the commit lands — OVERLAPPED with younger
+    # barriers, so it is deliberately NOT part of total_s) and the
+    # uploading-window depth right after this epoch was submitted
+    upload_s: float = 0.0
+    queue_depth: int = 0
 
     @property
     def total_s(self) -> float:
@@ -69,6 +76,10 @@ class EpochProfile:
             f"inject→collect {self.inject_to_collect_s * 1e3:.2f}ms, "
             f"collect→commit {self.collect_to_commit_s * 1e3:.2f}ms, "
             f"in-flight {self.in_flight}"]
+        if self.upload_s > 0.0 or self.queue_depth:
+            lines.append(
+                f"  async upload: {self.upload_s * 1e3:.2f}ms "
+                f"(queue depth {self.queue_depth})")
         if self.slowest_actor is not None:
             lines.append(
                 f"  slowest actor: {self.slowest_actor} "
@@ -160,11 +171,13 @@ class EpochProfiler:
 
     def rows(self) -> List[tuple]:
         """(epoch, kind, i2c, c2c, total, in_flight, slowest_actor,
-        slowest_lag) per profiled barrier — the rw_barrier_latency
-        system-table payload."""
+        slowest_lag, upload_s, queue_depth) per profiled barrier — the
+        rw_barrier_latency system-table payload (new columns appended
+        so existing positional consumers keep their indices)."""
         return [(p.epoch, p.kind, p.inject_to_collect_s,
                  p.collect_to_commit_s, p.total_s, p.in_flight,
-                 p.slowest_actor, p.slowest_actor_lag_s)
+                 p.slowest_actor, p.slowest_actor_lag_s,
+                 p.upload_s, p.queue_depth)
                 for p in self.profiles]
 
     def report(self, last_n: int = 10) -> str:
@@ -177,6 +190,10 @@ class EpochProfiler:
                 [p.inject_to_collect_s for p in self.profiles], 0.99),
             "collect_to_commit_s": exact_quantile(
                 [p.collect_to_commit_s for p in self.profiles], 0.99),
+            # the overlapped async tail — NOT part of barrier latency;
+            # reported so the overlap is visible, not invisible
+            "upload_s": exact_quantile(
+                [p.upload_s for p in self.profiles], 0.99),
         }
 
 
@@ -232,7 +249,8 @@ class BarrierLoop:
                  in_flight_barrier_nums: int = 10,
                  monotonic: Callable[[], float] = time.monotonic,
                  sleep=asyncio.sleep,
-                 slow_barrier_threshold_s: float = 1.0):
+                 slow_barrier_threshold_s: float = 1.0,
+                 max_uploading: int = 4):
         self.local = local
         self.store = store
         self.interval_ms = interval_ms
@@ -246,9 +264,19 @@ class BarrierLoop:
         self._barriers_since_checkpoint = 0
         self._inject_times: Dict[int, float] = {}
         self._in_flight: List[int] = []       # injected, not yet collected
-        self._committed_epoch = 0
+        self._committed_epoch = store.committed_epoch()
         self._pending_mutations: List[Mutation] = []
         self._stopped = False
+        # async checkpoint pipeline: collect_next only seals + submits;
+        # epochs commit in order when their uploads land. The sealed-
+        # but-uncommitted window (`uploading_count`) is bounded by
+        # max_uploading — submit back-pressures, collection stalls,
+        # the in-flight window fills, injection stops: total staging is
+        # bounded by in_flight_barrier_nums + max_uploading epochs.
+        self.uploader = CheckpointUploader(
+            store, max_uploading=max_uploading, monotonic=monotonic,
+            on_commit=self._on_epoch_committed)
+        self._upload_profiles: Dict[int, EpochProfile] = {}
 
     # -- command scheduling (BarrierScheduler analog) -------------------
     def schedule_mutation(self, mutation: Mutation) -> None:
@@ -263,6 +291,20 @@ class BarrierLoop:
         """Injected-but-uncollected barriers (drivers pipelining against
         the window should read this, not the private list)."""
         return len(self._in_flight)
+
+    @property
+    def uploading_count(self) -> int:
+        """Sealed-but-uncommitted checkpoint epochs (the async upload
+        window alongside in_flight)."""
+        return self.uploader.depth
+
+    def _on_epoch_committed(self, epoch: int, upload_s: float) -> None:
+        """Uploader commit callback — epochs arrive strictly in order,
+        so committed_epoch never skips past an unfinished older one."""
+        self._committed_epoch = epoch
+        prof = self._upload_profiles.pop(epoch, None)
+        if prof is not None:
+            prof.upload_s = upload_s
 
     # -- one step -------------------------------------------------------
     def _next_kind(self, force_checkpoint: bool) -> BarrierKind:
@@ -309,11 +351,42 @@ class BarrierLoop:
         if self._epoch is None or self._epoch.value < value:
             self._epoch = Epoch(value)
 
+    async def _await_complete_or_upload_failure(self, epoch: int
+                                                ) -> Barrier:
+        """Race epoch completion against a terminal uploader failure,
+        so a dead checkpoint pipeline fails the barrier promptly — and
+        as the ORIGINAL error (e.g. the object store's OSError), not a
+        later symptom."""
+        self.uploader.bind_loop()
+        waiter = asyncio.ensure_future(
+            self.local.await_epoch_complete(epoch))
+        failer = asyncio.ensure_future(self.uploader.failed.wait())
+        try:
+            done, _ = await asyncio.wait(
+                {waiter, failer}, return_when=asyncio.FIRST_COMPLETED)
+        except asyncio.CancelledError:
+            waiter.cancel()
+            raise
+        finally:
+            failer.cancel()
+        if waiter in done:
+            return waiter.result()
+        waiter.cancel()
+        with contextlib.suppress(asyncio.CancelledError):
+            await waiter
+        self.uploader.raise_if_failed()
+        raise RuntimeError("uploader failure event without a failure")
+
     async def collect_next(self) -> Barrier:
-        """Await the oldest in-flight epoch; commit it to the store."""
+        """Await the oldest in-flight epoch; seal it and hand the flush
+        to the checkpoint uploader. SST build and object-store upload
+        run OFF this path — the commit lands asynchronously, in epoch
+        order, once the uploads are durable (uploader.rs:567 analog)."""
         assert self._in_flight, "nothing in flight"
+        # a failed upload fails the barrier here, after its retries
+        self.uploader.raise_if_failed()
         epoch = self._in_flight.pop(0)
-        barrier = await self.local.await_epoch_complete(epoch)
+        barrier = await self._await_complete_or_upload_failure(epoch)
         t_collect = self.monotonic()
         STREAMING.barrier_in_flight.set(len(self._in_flight))
         # the epoch whose data this barrier flushed is the one that ENDED:
@@ -322,21 +395,30 @@ class BarrierLoop:
         prev = barrier.epoch.prev.value
         if prev > 0:
             self.store.seal_epoch(prev, barrier.is_checkpoint)
-            if barrier.is_checkpoint:
-                self.store.sync(prev)
-                self._committed_epoch = prev
         t0 = self._inject_times.pop(epoch, None)
+        prof = None
         if t0 is not None:
             lat = self.monotonic() - t0
             self.stats.latencies_s.append(lat)
             STREAMING.barrier_latency.observe(lat)
-            self.profiler.record(
+            prof = self.profiler.record(
                 epoch,
                 "checkpoint" if barrier.is_checkpoint else "barrier",
                 inject_to_collect_s=t_collect - t0,
                 collect_to_commit_s=self.monotonic() - t_collect,
                 in_flight=len(self._in_flight),
                 collect_times=self.local.take_collect_times(epoch))
+        if prev > 0 and barrier.is_checkpoint:
+            if prof is not None:
+                # registered BEFORE submit: the inline fallback commits
+                # inside submit and patches upload_s right away
+                self._upload_profiles[prev] = prof
+            if not await self.uploader.submit(prev):
+                # no flush needed (recovery-initial epoch): drop the
+                # registration or it pins the profile forever
+                self._upload_profiles.pop(prev, None)
+            if prof is not None:
+                prof.queue_depth = self.uploader.depth
         if barrier.is_checkpoint:
             STREAMING.checkpoint_count.inc()
             # host-memory accounting/eviction sweep piggybacks on the
@@ -348,17 +430,28 @@ class BarrierLoop:
 
     async def inject_and_collect(
             self, mutation: Optional[Mutation] = None,
-            force_checkpoint: bool = False) -> Barrier:
+            force_checkpoint: bool = False,
+            drain_uploader: bool = True) -> Barrier:
         await self.inject(mutation, force_checkpoint)
         # drain everything in flight, oldest first
         barrier = None
         while self._in_flight:
             barrier = await self.collect_next()
         assert barrier is not None
+        # explicit stepping keeps its synchronous contract: the barrier
+        # this returns is DURABLY committed (tests/DDL read
+        # committed_epoch right after). Background heartbeats pass
+        # drain_uploader=False — a periodic driver that drained every
+        # beat would re-serialize the pipeline it exists to overlap —
+        # and pipelined drivers use inject()/collect_next() directly,
+        # draining only at the end.
+        if drain_uploader:
+            await self.uploader.drain()
         return barrier
 
     async def checkpoint(self) -> Barrier:
-        """Force a durable checkpoint barrier and wait for it."""
+        """Force a durable checkpoint barrier and wait for it — the
+        uploader is drained, so every collected epoch has committed."""
         return await self.inject_and_collect(force_checkpoint=True)
 
     # -- background loop -------------------------------------------------
@@ -402,6 +495,10 @@ class BarrierLoop:
                     collector = None
                 else:
                     await self.collect_next()
+            # and the async tail: uploads still in flight at stop()
+            # must land (in order) before run() returns, or the last
+            # collected epochs never commit
+            await self.uploader.drain()
         finally:
             if collector is not None:
                 collector.cancel()

@@ -81,8 +81,8 @@ HOST_AGG_KINDS = (AggKind.STRING_AGG, AggKind.ARRAY_AGG)
 # -- HyperLogLog (approx_count_distinct) ----------------------------------
 # Reference parity: src/expr/src/aggregate/approx_count_distinct/mod.rs
 # :35-42 — the reference keeps 2^16 buckets; this build keeps a DENSE
-# 2^14-register sketch per group (standard error 1.04/sqrt(2^14) ≈
-# 0.8%) maintained host-side on the executor's host-agg path (one
+# 2^16-register sketch per group (standard error 1.04/sqrt(2^16) ≈
+# 0.4%) maintained host-side on the executor's host-agg path (one
 # uint8 register array per group, vectorized scatter-max per chunk)
 # and persisted as one BYTEA row per group. The device kernel carries
 # only the dummy lane (grouping/dirtiness); a register file this wide
@@ -90,7 +90,7 @@ HOST_AGG_KINDS = (AggKind.STRING_AGG, AggKind.ARRAY_AGG)
 # matches the reference's bucket count (theirs are u64 counters —
 # 512KB/group; one byte per register keeps ours at 64KB).
 HLL_B = 16              # index bits
-HLL_M = 1 << HLL_B      # registers (16384)
+HLL_M = 1 << HLL_B      # registers (65536)
 HLL_RHO_MAX = 65 - HLL_B
 HLL_ALPHA = 0.7213 / (1 + 1.079 / HLL_M)   # bias constant, m >= 128
 

@@ -66,18 +66,30 @@ class StateTable:
         """Set the epoch at which buffered writes will land (recovery/boot)."""
         self.epoch = epoch
 
+    def flush(self) -> Tuple[List[bytes], List, int]:
+        """Drain the buffered ops as staged imms — (keys, values,
+        write_epoch) — WITHOUT writing through to the store.
+        Extraction point only: ``commit`` below is its one caller
+        today (the async checkpoint pipeline decouples at the STORE
+        level — HummockLite.build_ssts drains imms, not state tables);
+        callers that need to route a flush elsewhere (worker shipping,
+        tests) take the staged batch from here."""
+        assert self.epoch is not None, "init_epoch first"
+        keys, vals = self.mem_table.drain_bulk()
+        return keys, vals, self.epoch.curr.value
+
     def commit(self, new_epoch: EpochPair) -> int:
         """Flush buffered ops at the sealed (current) epoch; advance.
 
         Returns the number of flushed entries. state_table.rs:901 analog —
         the caller (actor on barrier) invokes this for every state table,
-        then the barrier manager syncs the store.
+        then the barrier manager seals the epoch and hands the flush to
+        the checkpoint uploader.
         """
         assert self.epoch is not None, "init_epoch first"
         assert new_epoch.prev == self.epoch.curr, (new_epoch, self.epoch)
-        keys, vals = self.mem_table.drain_bulk()
-        n = self.store.ingest_keyed(self.table_id, keys, vals,
-                                    self.epoch.curr.value)
+        keys, vals, epoch = self.flush()
+        n = self.store.ingest_keyed(self.table_id, keys, vals, epoch)
         self.epoch = new_epoch
         return n
 

@@ -9,10 +9,12 @@ different encoding details.
 """
 
 from risingwave_tpu.storage.object_store import (
-    LocalFsObjectStore, MemObjectStore, ObjectStore,
+    DelayedObjectStore, LocalFsObjectStore, MemObjectStore, ObjectStore,
 )
 from risingwave_tpu.storage.hummock import HummockLite
+from risingwave_tpu.storage.uploader import CheckpointUploader
 
 __all__ = [
-    "ObjectStore", "MemObjectStore", "LocalFsObjectStore", "HummockLite",
+    "ObjectStore", "MemObjectStore", "LocalFsObjectStore",
+    "DelayedObjectStore", "HummockLite", "CheckpointUploader",
 ]

@@ -31,7 +31,8 @@ from risingwave_tpu.utils.failpoint import fail_point
 from risingwave_tpu.utils.metrics import STORAGE as _METRICS
 from risingwave_tpu.storage.object_store import ObjectStore
 from risingwave_tpu.storage.sst import (
-    EPOCH_MASK, LazySst, Sst, SstBuilder, full_key, split_full_key,
+    EPOCH_MASK, LazySst, Sst, SstBuilder, build_sst, full_key,
+    split_full_key,
 )
 from risingwave_tpu.storage.value_codec import decode_row, encode_row
 
@@ -66,6 +67,14 @@ class HummockLite(StateStore):
         self.obj = obj
         self.two_phase = two_phase
         self._staged: List[dict] = []   # [{"epoch": e, "sst": info}]
+        # built-but-not-yet-committed checkpoint SSTs (the async
+        # uploader's in-flight window): each entry carries the SST's
+        # BYTES so reads keep seeing the flushed data between
+        # ``build_ssts`` and ``commit_ssts`` without touching the
+        # object store. In-memory only — a crash here loses nothing
+        # the manifest ever referenced (recovery resumes at the last
+        # committed version). Newest last, like L0.
+        self._uploading: List[dict] = []
         # unsealed writes: epoch → table → key → (tombstone, row)
         self._mem: Dict[int, Dict[int, Dict[bytes, Value]]] = {}
         # sealed, not yet synced: newest last
@@ -155,13 +164,28 @@ class HummockLite(StateStore):
         self._imms.sort(key=lambda t: t[0])
 
     def sync(self, epoch: int) -> dict:
-        """Upload all imms ≤ epoch as one SST. Direct mode commits the
-        version; two-phase mode only STAGES the SST (durably) and
-        waits for ``commit_through`` from the coordinator."""
+        """Make all data ≤ epoch durable: build → upload → commit,
+        inline. The async checkpoint pipeline (storage/uploader.py)
+        calls the three phases separately so only the build mutates
+        loop-confined state and the upload runs off the event loop."""
+        payloads = self.build_ssts(epoch)
+        for p in payloads:
+            self.upload_payload(p)
+        return self.commit_ssts(epoch, payloads)
+
+    def build_ssts(self, epoch: int) -> List[dict]:
+        """CPU half of a checkpoint flush: drain every imm ≤ epoch into
+        built-but-unpublished SSTs. The built SSTs join the in-memory
+        ``_uploading`` read layer (newest above L0), so the flushed
+        data stays readable while its upload is in flight. Returns the
+        payloads to hand to ``upload_payload`` then ``commit_ssts``.
+
+        Builds MUST run in epoch order (the imm drain is cumulative:
+        a younger epoch's build would swallow an older epoch's imms) —
+        the CheckpointUploader chains them."""
         fail_point("hummock.sync")
         take = [im for im in self._imms if im[0] <= epoch]
         self._imms = [im for im in self._imms if im[0] > epoch]
-        info = None
         entries: List[Tuple[bytes, bool, bytes]] = []
         for e, tables in take:
             for table_id, kv in tables.items():
@@ -170,24 +194,45 @@ class HummockLite(StateStore):
                     tomb = value is None
                     entries.append(
                         (fk, tomb, b"" if tomb else encode_row(value)))
-        if entries:
-            entries.sort(key=lambda t: t[0])
-            sst_id = self._next_sst_id
-            self._next_sst_id += 1
-            b = SstBuilder(sst_id)
-            for fk, tomb, row in entries:
-                b.add(fk, tomb, row)
-            data, info = b.finish()
-            self.obj.upload(f"data/{sst_id}.sst", data)
-            _METRICS.sst_upload_count.inc(source="sync")
-            _METRICS.sst_upload_bytes.inc(len(data), source="sync")
+        if not entries:
+            return []
+        entries.sort(key=lambda t: t[0])
+        sst_id = self._next_sst_id
+        self._next_sst_id += 1
+        data, info = build_sst(sst_id, entries)
+        payload = {"epoch": epoch, "sst": info, "data": data}
+        self._uploading.append(payload)
+        return [payload]
+
+    def upload_payload(self, payload: dict) -> None:
+        """Durably store one built SST. Object-store I/O only — no
+        store state is touched, so the uploader may run this in a
+        worker thread (and retry it) while the event loop proceeds."""
+        data = payload["data"]
+        self.obj.upload(f"data/{payload['sst']['id']}.sst", data)
+        _METRICS.sst_upload_count.inc(source="sync")
+        _METRICS.sst_upload_bytes.inc(len(data), source="sync")
+
+    def commit_ssts(self, epoch: int, payloads: List[dict]) -> dict:
+        """Manifest-publish half: adopt the uploaded SSTs into the
+        version (or the durable staged manifest in two-phase mode) and
+        advance the committed epoch. Must be called in epoch order,
+        only after every payload's upload durably landed — the
+        version must never reference an object that may not exist."""
+        ids = {p["sst"]["id"] for p in payloads}
+        self._uploading = [u for u in self._uploading
+                           if u["sst"]["id"] not in ids]
+        info = None
+        for p in payloads:
+            info = p["sst"]
             if self.two_phase:
-                self._staged.append({"epoch": epoch, "sst": info})
-                self._persist_staged()
-                return {"sst": info}
-            self._l0.append(info)
+                self._staged.append({"epoch": p["epoch"], "sst": info})
+            else:
+                self._l0.append(info)
         if self.two_phase:
-            return {"sst": None}
+            if payloads:
+                self._persist_staged()
+            return {"sst": info}
         self._committed_epoch = max(self._committed_epoch, epoch)
         if len(self._l0) >= L0_COMPACT_THRESHOLD:
             self.compact()
@@ -235,6 +280,37 @@ class HummockLite(StateStore):
     def committed_epoch(self) -> int:
         return self._committed_epoch
 
+    def vacuum_orphans(self) -> int:
+        """Recovery-time GC: delete data objects no manifest layer
+        references — the async pipeline's crash residue (a kill with
+        uploads in flight can strand up to max_uploading
+        uploaded-but-uncommitted SSTs per generation, plus any
+        deferred-vacuum garbage the dead generation never deleted).
+        Single-writer assumption: call ONLY when this instance owns
+        the namespace (the session recovery path; ctl inspects
+        in-memory snapshot clones, where this is harmless). Returns
+        the number of objects deleted."""
+        live = {info["id"] for info in self._l0 + self._l1}
+        live |= {s["sst"]["id"] for s in self._staged}
+        live |= {u["sst"]["id"] for u in self._uploading}
+        live |= {info["id"]
+                 for info in getattr(self, "_pending_vacuum", [])}
+        dropped = 0
+        for path in self.obj.list("data/"):
+            name = path[len("data/"):]
+            if not name.endswith(".sst"):
+                continue
+            try:
+                sst_id = int(name[:-4])
+            except ValueError:
+                continue
+            if sst_id not in live:
+                self.obj.delete(path)
+                self._handles.pop(sst_id, None)
+                self._blocks.drop_sst(sst_id)
+                dropped += 1
+        return dropped
+
     # -- SST access -------------------------------------------------------
     def _sst(self, info: dict) -> LazySst:
         s = self._handles.get(info["id"])
@@ -246,6 +322,14 @@ class HummockLite(StateStore):
                 self._handles.popitem(last=False)
         else:
             self._handles.move_to_end(info["id"])
+        return s
+
+    def _upload_sst(self, entry: dict) -> Sst:
+        """Read handle over a built-but-uncommitted SST: the bytes are
+        still in memory, so no object-store round trip."""
+        s = entry.get("handle")
+        if s is None:
+            s = entry["handle"] = Sst(entry["data"], entry["sst"])
         return s
 
     def _sst_once(self, info: dict) -> Sst:
@@ -270,7 +354,16 @@ class HummockLite(StateStore):
             kv = tables.get(table_id)
             if kv is not None and key in kv:
                 return kv[key]
-        # 3) staged (two-phase, newest layer) → L0 newest → oldest,
+        # 3) built-but-uncommitted checkpoint SSTs (async upload in
+        # flight — newer than anything committed), newest first
+        for u in reversed(self._uploading):
+            if u["sst"]["min_epoch"] > epoch:
+                continue
+            hit = self._upload_sst(u).get(table_id, key, epoch)
+            if hit is not None:
+                _found, tomb, row = hit
+                return None if tomb else decode_row(row)
+        # 4) staged (two-phase, newest layer) → L0 newest → oldest,
         # then L1 (bloom-pruned point lookups)
         for s in reversed(self._staged):
             info = s["sst"]
@@ -386,6 +479,9 @@ class HummockLite(StateStore):
             yield from reversed(run)
 
         mk = sst_source_rev if reverse else sst_source
+        for u in reversed(self._uploading):
+            sources.append(mk(self._upload_sst(u), rank))
+            rank += 1
         for s in reversed(self._staged):
             sources.append(mk(self._sst(s["sst"]), rank))
             rank += 1

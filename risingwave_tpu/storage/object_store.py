@@ -168,6 +168,30 @@ class LocalFsObjectStore:
         return os.path.exists(self._abs(path))
 
 
+class DelayedObjectStore:
+    """Latency-injecting wrapper over any ObjectStore: sleeps
+    ``delay_s`` in ``upload`` for paths under ``prefix`` (SST data by
+    default), delegating everything else untouched. Stands in for real
+    object-store round trips when exercising the async checkpoint
+    pipeline — the sleep blocks the CALLING thread, so an upload
+    offloaded via ``asyncio.to_thread`` keeps the event loop live
+    while an inline upload visibly stalls it."""
+
+    def __init__(self, inner: ObjectStore, delay_s: float = 0.05,
+                 prefix: str = "data/") -> None:
+        self.inner = inner
+        self.delay_s = delay_s
+        self.prefix = prefix
+
+    def upload(self, path: str, data: bytes) -> None:
+        if path.startswith(self.prefix):
+            time.sleep(self.delay_s)
+        self.inner.upload(path, data)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
 class S3ObjectStore:
     """S3-API backend (object/s3.rs analog): whole-object PUT/GET/
     DELETE/HEAD, byte-range GET for the block cache, ListObjectsV2 —

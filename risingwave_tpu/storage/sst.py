@@ -223,6 +223,19 @@ def iter_block(data: bytes) -> Iterator[Tuple[bytes, bytes]]:
         vp += vl
 
 
+def build_sst(sst_id: int,
+              entries: Iterator[Tuple[bytes, bool, bytes]]
+              ) -> Tuple[bytes, dict]:
+    """Pre-sorted (full_key, tombstone, row_bytes) entries → one SST's
+    (bytes, info). The pure-CPU half of a checkpoint flush, shared by
+    the inline ``sync`` path and the async CheckpointUploader's
+    off-critical-path build (storage/uploader.py)."""
+    b = SstBuilder(sst_id)
+    for fk, tomb, row in entries:
+        b.add(fk, tomb, row)
+    return b.finish()
+
+
 class SstBuilder:
     """Builds one SST from pre-sorted (full_key, tombstone, row_bytes)."""
 

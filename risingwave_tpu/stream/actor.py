@@ -174,10 +174,21 @@ class LocalBarrierManager:
             ev.set()
 
     async def await_epoch_complete(self, epoch: int) -> Barrier:
-        """Block until every expected actor collected `epoch`."""
+        """Block until every expected actor collected `epoch`.
+
+        Cancellation-safe: the barrier loop's collect path races this
+        wait against an async-checkpoint failure and cancels the loser
+        — all bookkeeping mutation happens strictly AFTER the wait, so
+        a cancelled call leaves the epoch collectible by a retry. The
+        failure path cleans up its epoch's teardown state too: a wedged
+        pipeline must not pin barriers/collect-times forever."""
         ev = self._complete.setdefault(epoch, asyncio.Event())
         await ev.wait()
         if self._failed is not None:
+            self._collected.pop(epoch, None)
+            self._complete.pop(epoch, None)
+            self._collect_times.pop(epoch, None)
+            self._barriers.pop(epoch, None)
             raise RuntimeError(
                 f"actor failure during epoch {epoch:#x}") from self._failed
         self._collected.pop(epoch, None)

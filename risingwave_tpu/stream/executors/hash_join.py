@@ -234,6 +234,23 @@ class _JoinSide:
                     **opts)
         return self._kernel
 
+    def _row_key_lanes(self, chunk: StreamChunk, r: int
+                       ) -> Optional[tuple]:
+        """One row's join-key lanes tuple (the cold_keys key), or None
+        when any key column is NULL — null keys are never stored, so
+        they cannot be cold. Miss-path only (rare)."""
+        vals = []
+        for i in self.key_indices:
+            c = chunk.columns[i]
+            v = np.asarray(c.values)[r]
+            if c.validity is not None and \
+                    not bool(np.asarray(c.validity)[r]):
+                return None
+            vals.append(v.item() if hasattr(v, "item") else v)
+        if any(v is None for v in vals):
+            return None
+        return tuple(self.key_codec.lanes_of_values(vals).tolist())
+
     def ensure_degrees(self, max_ref: int) -> None:
         if max_ref < len(self.degrees):
             return
@@ -334,7 +351,24 @@ class _JoinSide:
                 else:
                     ref = self.pk_to_ref.pop(pks[r], None)
                     if ref is None:
-                        continue   # delete of unseen row (inconsistent)
+                        # unseen pk: either an inconsistent delete
+                        # (ignore, reference behavior) or — with the
+                        # cold tier on — a retraction for an EVICTED
+                        # key, whose device bookkeeping cannot be
+                        # applied. The planner only enables state_cap
+                        # on provably append-only inputs; failing loud
+                        # here beats leaving already-emitted join
+                        # outputs permanently stale (ADVICE r5 high).
+                        if self.cold_keys and \
+                                self._row_key_lanes(chunk, r) \
+                                in self.cold_keys:
+                            raise RuntimeError(
+                                "join cold-state tier got a retraction "
+                                "for an evicted key — state_cap "
+                                "requires append-only inputs (the "
+                                "planner disables the cap when it "
+                                "cannot prove them)")
+                        continue
                     del_refs[r] = ref
                     del_mask[r] = True
                     self.free.append(ref)

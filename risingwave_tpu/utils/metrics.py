@@ -10,10 +10,19 @@ format, so the numbers can feed any Prometheus scraper later.
 from __future__ import annotations
 
 import bisect
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 LabelKey = Tuple[Tuple[str, str], ...]
+
+# guards read-modify-write updates (Counter.inc, Histogram.observe):
+# the async checkpoint uploader runs object-store PUTs — and their
+# op/latency/byte metrics — in worker threads, and an unguarded
+# `d[k] = d.get(k) + v` can lose increments across a GIL preemption.
+# One uncontended lock acquire is ~100ns; every metered path is
+# per-chunk or per-object-store-op, not per-row.
+_WRITE_LOCK = threading.Lock()
 
 
 def _help_lines(name: str, help_: str) -> List[str]:
@@ -65,8 +74,9 @@ class Series:
         self._key = key
 
     def inc(self, amount: float = 1.0) -> None:
-        self._values[self._key] = \
-            self._values.get(self._key, 0.0) + amount
+        with _WRITE_LOCK:
+            self._values[self._key] = \
+                self._values.get(self._key, 0.0) + amount
 
     def set(self, value: float) -> None:
         self._values[self._key] = value
@@ -80,7 +90,8 @@ class Counter:
 
     def inc(self, amount: float = 1.0, **labels: str) -> None:
         k = _label_key(labels)
-        self._values[k] = self._values.get(k, 0.0) + amount
+        with _WRITE_LOCK:
+            self._values[k] = self._values.get(k, 0.0) + amount
 
     def labeled(self, **labels: str) -> Series:
         return Series(self._values, _label_key(labels))
@@ -158,14 +169,16 @@ class Histogram:
 
     def observe(self, value: float, **labels: str) -> None:
         k = _label_key(labels)
-        counts = self._counts.setdefault(k, [0] * (len(self.buckets) + 1))
         i = bisect.bisect_left(self.buckets, value)
-        counts[i] += 1
-        self._sum[k] = self._sum.get(k, 0.0) + value
-        self._total[k] = self._total.get(k, 0) + 1
-        raw = self._raw.setdefault(k, [])
-        if len(raw) < self._keep_raw:
-            raw.append(value)
+        with _WRITE_LOCK:
+            counts = self._counts.setdefault(
+                k, [0] * (len(self.buckets) + 1))
+            counts[i] += 1
+            self._sum[k] = self._sum.get(k, 0.0) + value
+            self._total[k] = self._total.get(k, 0) + 1
+            raw = self._raw.setdefault(k, [])
+            if len(raw) < self._keep_raw:
+                raw.append(value)
 
     def quantile(self, q: float, **labels: str) -> float:
         return exact_quantile(self._raw.get(_label_key(labels), []), q)
@@ -300,6 +313,14 @@ class StreamingMetrics:
         self.barrier_in_flight = r.gauge(
             "meta_barrier_in_flight_count",
             "injected-but-uncollected barriers")
+        # -- async checkpoint pipeline (storage/uploader.py) ----------
+        self.barrier_upload = r.histogram(
+            "meta_barrier_upload_seconds",
+            "seal→durable-commit time per checkpoint epoch (the "
+            "async upload tail, overlapped with later barriers)")
+        self.uploader_queue_depth = r.gauge(
+            "meta_checkpoint_uploader_queue_depth",
+            "checkpoint epochs sealed but not yet durably committed")
 
 
 class StorageMetrics:
@@ -319,6 +340,9 @@ class StorageMetrics:
         self.sst_upload_bytes = r.counter(
             "state_store_sst_upload_bytes",
             "bytes of SST data uploaded")
+        self.sst_upload_retries = r.counter(
+            "state_store_sst_upload_retry_count",
+            "checkpoint SST uploads retried after a transient failure")
         self.object_store_ops = r.counter(
             "object_store_operation_count",
             "object-store operations by op (upload/read/read_range)")

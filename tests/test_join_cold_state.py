@@ -223,3 +223,57 @@ def test_cold_state_insert_after_evict_no_duplicates():
                 got[tuple(row)] += 1
     assert got == _oracle([probe], right_rows)
     assert got[probe + late] == 1        # exactly once
+
+
+def test_cold_state_retracting_input_fails_loud():
+    """A retraction for an EVICTED key cannot be applied against
+    device state (ADVICE r5 high): the executor refuses loudly instead
+    of silently leaving already-emitted join outputs stale. (The
+    planner never enables state_cap on inputs it cannot prove
+    append-only — this guards direct executor users.)"""
+    from risingwave_tpu.common.chunk import Op
+
+    store = MemoryStateStore()
+    rmsgs = [_barrier(1)]
+    epoch = 2
+    for lo in range(0, 300, 100):        # 300 keys >> cap: key 0 cold
+        rows = [(k, k, k) for k in range(lo, lo + 100)]
+        rmsgs += [_chunk(R_SCHEMA, rows), _barrier(epoch)]
+        epoch += 1
+    dead = StreamChunk.from_pydict(
+        R_SCHEMA, {"k": [0], "rv": [0], "rid": [0]},
+        ops=[Op.DELETE])
+    rmsgs += [dead, _barrier(epoch)]
+    lmsgs = [_barrier(e) for e in range(1, epoch + 1)]
+    join = _build(store, lmsgs, rmsgs)
+    with pytest.raises(RuntimeError, match="evicted"):
+        asyncio.run(collect_until_n_barriers(join, epoch))
+
+
+def test_join_state_cap_disabled_for_retracting_inputs():
+    """join_state_cap set session-wide + a join over a RETRACTING
+    input (a GROUP BY subquery): the planner must NOT enable the cold
+    tier there — results stay exact, with no evicted-key retraction
+    error, while append-only joins keep the cap."""
+    from risingwave_tpu.frontend.session import Frontend
+
+    async def run(cap):
+        fe = Frontend(min_chunks=4, join_state_cap=cap)
+        await fe.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', "
+            "nexmark.table.type='bid', nexmark.event.num=4000, "
+            "nexmark.min.event.gap.in.ns=100000000)")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW j AS SELECT b.auction, b.price, "
+            "p.c FROM bid AS b JOIN (SELECT auction, count(*) AS c "
+            "FROM bid GROUP BY auction) AS p "
+            "ON b.auction = p.auction")
+        await fe.step(10)
+        rows = await fe.execute("SELECT * FROM j")
+        await fe.close()
+        return collections.Counter(map(tuple, rows))
+
+    capped = asyncio.run(run(8))         # cap must be ignored here
+    uncapped = asyncio.run(run(None))
+    assert capped == uncapped
+    assert len(capped) > 20

@@ -412,3 +412,54 @@ def test_build_fragment_dynamic_filter_and_dedup():
                                    channel_for_test, actor_id=9)
     assert type(consumer).__name__ == "AppendOnlyDedupExecutor"
     assert type(consumer.input).__name__ == "DynamicFilterExecutor"
+
+
+def test_fragmenter_ships_hll_sketch_tables():
+    """approx_count_distinct's sketch tables ride minput_table_ids
+    through the fragmenter (the executor popped them out of minput at
+    construction), so a distributed CREATE MV rebuilds the agg with
+    its HLL aux table instead of failing at build."""
+    from risingwave_tpu.frontend.catalog import Catalog
+    from risingwave_tpu.frontend.fragmenter import Fragmenter
+    from risingwave_tpu.frontend.parser import parse_many
+    from risingwave_tpu.frontend.planner import (
+        StreamPlanner, source_schema,
+    )
+    from risingwave_tpu.state.store import MemoryStateStore
+    from risingwave_tpu.stream.actor import LocalBarrierManager
+    from risingwave_tpu.stream.exchange import channel_for_test
+    from risingwave_tpu.stream.executor import executor_children
+    from risingwave_tpu.stream.executors.hash_agg import HashAggExecutor
+
+    opts = {"connector": "nexmark", "nexmark.table.type": "bid",
+            "nexmark.event.num": "1000"}
+    catalog = Catalog()
+    catalog.add_source("bid", source_schema(opts, None), opts)
+    [(_text, stmt)] = parse_many(
+        "CREATE MATERIALIZED VIEW v AS SELECT auction, "
+        "approx_count_distinct(bidder) AS d FROM bid GROUP BY auction")
+    planner = StreamPlanner(catalog, MemoryStateStore(),
+                            LocalBarrierManager(), definition="")
+    plan = planner.plan("v", stmt.select, 7, rate_limit=4)
+    graph = Fragmenter(1).lower(plan.consumer)
+    nodes = [n for f in graph.fragments for n in f.nodes]
+    agg_node = next(n for n in nodes if n["op"] == "hash_agg")
+    assert agg_node["minput_table_ids"], \
+        "sketch table id missing from the shipped IR"
+    # and the shipped IR round-trips into a working executor
+    _src, consumer = build_fragment(
+        graph.fragments[-1].nodes, MemoryStateStore(),
+        LocalBarrierManager(), channel_for_test)
+
+    def find_agg(ex):
+        if isinstance(ex, HashAggExecutor):
+            return ex
+        for _a, _i, child in executor_children(ex):
+            got = find_agg(child)
+            if got is not None:
+                return got
+        return None
+
+    agg = find_agg(consumer)
+    assert agg is not None
+    assert set(agg.hll_tables) == {0}

@@ -88,6 +88,48 @@ def test_sql_session_kill_restart_resumes():
     assert rows == _oracle()
 
 
+def test_kill_with_uploads_in_flight_recovers_and_matches_oracle():
+    """Pipelined (run-mode) barrier driving over a SLOW object store:
+    kill the session while checkpoint uploads are still in flight —
+    recovery resumes from the last FULLY committed epoch (the async
+    pipeline's ordered-commit invariant) and the finished result
+    equals the uninterrupted oracle."""
+    from risingwave_tpu.storage.object_store import DelayedObjectStore
+
+    obj = MemObjectStore()
+
+    async def phase1():
+        fe = Frontend(HummockLite(DelayedObjectStore(obj, delay_s=0.2)),
+                      min_chunks=4)
+        await fe.execute(DDL)
+        loop = fe.loop
+        # bench-style pipelined driving: no uploader drain between
+        # barriers, so uploads pile up behind the slow store
+        for _ in range(6):
+            while loop.in_flight_count < 2:
+                await loop.inject(force_checkpoint=True)
+            await loop.collect_next()
+        assert loop.uploading_count > 0    # in flight at the kill
+        # KILL: no close(), no drain — the in-flight epochs' commits
+        # never land; only fully committed epochs may survive
+
+    asyncio.run(phase1())
+
+    async def phase2():
+        fe = Frontend(HummockLite(obj), min_chunks=4)
+        assert await fe.recover() == 2
+        # recover() already vacuumed the dead generation's residue
+        # (uploaded-but-uncommitted SSTs + its deferred-compaction
+        # garbage): nothing unreferenced is left behind
+        assert fe.store.vacuum_orphans() == 0
+        await _drive_until_done(fe)
+        rows = await fe.execute(QUERY)
+        await fe.close()
+        return rows
+
+    assert asyncio.run(phase2()) == _oracle()
+
+
 def test_chaos_repeated_kills_match_oracle():
     """Three generations, each killed after a few epochs; the final
     result must still equal the uninterrupted run (nexmark_recovery.rs

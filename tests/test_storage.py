@@ -515,3 +515,60 @@ def test_two_phase_staging_semantics(tmp_path):
     assert s3.committed_epoch() == 100
     assert s3.get(7, b"a", 100) == (1,)
     assert s3.get(7, b"c", 300) is None
+
+
+# -- async checkpoint split (build → upload → commit) --------------------
+
+
+def test_build_commit_split_keeps_data_readable():
+    """Between build_ssts and commit_ssts the flushed data lives in the
+    in-memory uploading layer: reads see it, the object store doesn't
+    yet, and the manifest only advances at commit."""
+    obj = MemObjectStore()
+    h = HummockLite(obj)
+    h.ingest_batch(1, [(b"a", (1,)), (b"b", (2,))], 100)
+    h.seal_epoch(100, True)
+    payloads = h.build_ssts(100)
+    assert len(payloads) == 1
+    # readable while the upload is "in flight"...
+    assert h.get(1, b"a", 100) == (1,)
+    assert dict(h.iter(1, 100)) == {b"a": (1,), b"b": (2,)}
+    # ...but nothing uploaded or committed yet
+    assert not obj.list("data/")
+    assert h.committed_epoch() == 0
+    for p in payloads:
+        h.upload_payload(p)
+    h.commit_ssts(100, payloads)
+    assert h.committed_epoch() == 100
+    assert h.get(1, b"a", 100) == (1,)
+    # a reboot sees exactly the committed version
+    h2 = HummockLite(obj)
+    assert h2.committed_epoch() == 100
+    assert dict(h2.iter(1, 100)) == {b"a": (1,), b"b": (2,)}
+
+
+def test_build_commit_split_ordered_epochs():
+    """Two epochs built back-to-back (the uploader's chained builds):
+    each build drains only its own imms, reads merge both layers, and
+    in-order commits publish both."""
+    obj = MemObjectStore()
+    h = HummockLite(obj)
+    h.ingest_batch(1, [(b"k", (1,))], 100)
+    h.seal_epoch(100, True)
+    p1 = h.build_ssts(100)
+    h.ingest_batch(1, [(b"k", (2,)), (b"l", (9,))], 200)
+    h.seal_epoch(200, True)
+    p2 = h.build_ssts(200)
+    # snapshot semantics across the two uploading layers
+    assert h.get(1, b"k", 100) == (1,)
+    assert h.get(1, b"k", 200) == (2,)
+    assert h.get(1, b"l", 100) is None
+    for p in p1 + p2:
+        h.upload_payload(p)
+    h.commit_ssts(100, p1)
+    assert h.committed_epoch() == 100
+    h.commit_ssts(200, p2)
+    assert h.committed_epoch() == 200
+    h2 = HummockLite(obj)
+    assert h2.get(1, b"k", 200) == (2,)
+    assert h2.get(1, b"k", 100) == (1,)

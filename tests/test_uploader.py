@@ -1,0 +1,385 @@
+"""Async checkpoint pipeline (storage/uploader.py + meta/barrier.py).
+
+The barrier loop's collect path only SEALS an epoch and hands the
+flush to the CheckpointUploader: SST build and object-store upload run
+off the critical path, epochs commit strictly in order once their
+uploads durably land, the sealed-but-uncommitted window is bounded
+(back-pressure), and a crash with uploads in flight recovers to the
+last FULLY committed epoch — no partial manifest (uploader.rs:567
+semantics).
+"""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from risingwave_tpu.meta.barrier import BarrierLoop
+from risingwave_tpu.storage.hummock import HummockLite
+from risingwave_tpu.storage.object_store import (
+    DelayedObjectStore, MemObjectStore,
+)
+from risingwave_tpu.stream.actor import LocalBarrierManager
+
+
+def _loop(store, **kw):
+    # zero expected actors: every epoch completes trivially, so these
+    # tests exercise exactly the seal→build→upload→commit pipeline
+    return BarrierLoop(LocalBarrierManager(), store, **kw)
+
+
+async def _checkpoint_epochs(loop, store, n, table=1, start=0):
+    """Inject+collect n checkpoint barriers, writing one row at each
+    barrier's curr epoch (sealed and flushed by the NEXT collect).
+    Returns the written epochs."""
+    written = []
+    for i in range(start, start + n):
+        b = await loop.inject(force_checkpoint=True)
+        e = b.epoch.curr.value
+        store.ingest_batch(table, [(i.to_bytes(4, "big"), (i,))], e)
+        written.append(e)
+        await loop.collect_next()
+    return written
+
+
+class _FirstSlow:
+    """Delays only the FIRST data-SST upload (younger epochs' uploads
+    finish first — the ordered commit must still not skip)."""
+
+    def __init__(self, inner, delay_s):
+        self.inner = inner
+        self.delay_s = delay_s
+        self._seen = 0
+
+    def upload(self, path, data):
+        if path.startswith("data/"):
+            self._seen += 1
+            if self._seen == 1:
+                time.sleep(self.delay_s)
+        self.inner.upload(path, data)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+class _Flaky:
+    """Fails the first `fail_times` data uploads (transient outage)."""
+
+    def __init__(self, inner, fail_times):
+        self.inner = inner
+        self.left = fail_times
+
+    def upload(self, path, data):
+        if path.startswith("data/") and self.left > 0:
+            self.left -= 1
+            raise OSError("transient upload failure")
+        self.inner.upload(path, data)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+class _Gate:
+    """Once gated, data uploads park until `cut()` makes them fail —
+    a deterministic 'crash with uploads in flight'."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.gated = False
+        self._cut = threading.Event()
+
+    def upload(self, path, data):
+        if self.gated and path.startswith("data/"):
+            if not self._cut.wait(timeout=30):
+                raise TimeoutError("gate never cut")
+            raise OSError("power cut")
+        self.inner.upload(path, data)
+
+    def cut(self):
+        self._cut.set()
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def test_collect_does_not_block_on_upload():
+    """The acceptance shape: with a delay-injecting object store,
+    injection/collection of later barriers proceeds while older
+    checkpoints are still uploading."""
+    obj = MemObjectStore()
+    store = HummockLite(DelayedObjectStore(obj, delay_s=0.3))
+    loop = _loop(store, max_uploading=8)
+
+    async def run():
+        await loop.inject_and_collect(force_checkpoint=True)
+        t0 = time.perf_counter()
+        await _checkpoint_epochs(loop, store, 4)
+        t_collect = time.perf_counter() - t0
+        depth_mid = loop.uploading_count
+        await loop.uploader.drain()
+        return t_collect, depth_mid
+
+    t_collect, depth_mid = asyncio.run(run())
+    assert depth_mid >= 1, "no upload was in flight after collections"
+    # 3 sealed data epochs × 0.3s uploads; collections must not have
+    # serialized on even ONE of them
+    assert t_collect < 0.3, t_collect
+    log = list(loop.uploader.commit_log)
+    assert log == sorted(log) and len(set(log)) == len(log)
+    assert store.committed_epoch() == loop.committed_epoch
+
+
+def test_committed_epoch_never_skips_unfinished_older_epoch():
+    obj = MemObjectStore()
+    store = HummockLite(_FirstSlow(obj, 0.4))
+    loop = _loop(store, max_uploading=8)
+
+    async def run():
+        await loop.inject_and_collect(force_checkpoint=True)
+        written = await _checkpoint_epochs(loop, store, 3)
+        # one more barrier so the last written epoch seals too
+        await loop.inject(force_checkpoint=True)
+        await loop.collect_next()
+        # younger epochs' uploads are instant and land while the first
+        # data epoch's upload still sleeps — committed must NOT move
+        # past the unfinished older epoch
+        await asyncio.sleep(0.1)
+        stalled = loop.committed_epoch
+        await loop.uploader.drain()
+        return written, stalled
+
+    written, stalled = asyncio.run(run())
+    assert stalled < written[0], (stalled, written)
+    assert loop.committed_epoch == written[-1]
+    log = list(loop.uploader.commit_log)
+    assert log == sorted(log) and len(set(log)) == len(log)
+
+
+def test_backpressure_bounds_uploading_window():
+    obj = MemObjectStore()
+    store = HummockLite(DelayedObjectStore(obj, delay_s=0.15))
+    loop = _loop(store, max_uploading=2)
+
+    async def run():
+        await loop.inject_and_collect(force_checkpoint=True)
+        depths = []
+        t0 = time.perf_counter()
+        for i in range(5):
+            b = await loop.inject(force_checkpoint=True)
+            store.ingest_batch(1, [(i.to_bytes(4, "big"), (i,))],
+                               b.epoch.curr.value)
+            await loop.collect_next()
+            depths.append(loop.uploading_count)
+        elapsed = time.perf_counter() - t0
+        await loop.uploader.drain()
+        return depths, elapsed
+
+    depths, elapsed = asyncio.run(run())
+    assert max(depths) <= 2, depths          # window stayed bounded
+    assert elapsed >= 0.15, elapsed          # i.e. submit back-pressured
+
+
+def test_transient_upload_failure_retries_and_commits():
+    from risingwave_tpu.utils.metrics import STORAGE
+
+    obj = MemObjectStore()
+    store = HummockLite(_Flaky(obj, fail_times=2))
+    loop = _loop(store)
+    before = STORAGE.sst_upload_retries.get()
+
+    async def run():
+        await loop.inject_and_collect(force_checkpoint=True)
+        b = await loop.inject(force_checkpoint=True)
+        store.ingest_batch(1, [(b"k", (1,))], b.epoch.curr.value)
+        await loop.collect_next()
+        await loop.checkpoint()          # seals the write; drains
+
+    asyncio.run(run())
+    assert STORAGE.sst_upload_retries.get() - before >= 2
+    assert loop.committed_epoch > 0
+    assert store.committed_epoch() == loop.committed_epoch
+    h2 = HummockLite(obj)                # reboot: the retry was durable
+    assert h2.get(1, b"k", loop.committed_epoch) == (1,)
+
+
+def test_terminal_upload_failure_fails_barrier_with_original_error():
+    obj = MemObjectStore()
+    store = HummockLite(_Flaky(obj, fail_times=100))
+    loop = _loop(store)
+
+    async def run():
+        await loop.inject_and_collect(force_checkpoint=True)
+        b = await loop.inject(force_checkpoint=True)
+        store.ingest_batch(1, [(b"k", (1,))], b.epoch.curr.value)
+        await loop.collect_next()
+        with pytest.raises(OSError):
+            for _ in range(10):
+                await loop.inject_and_collect(force_checkpoint=True)
+
+    asyncio.run(run())
+
+
+def test_crash_with_uploads_in_flight_recovers_last_committed():
+    """Tentpole recovery invariant: kill with uploads in flight →
+    reboot at the last FULLY committed epoch; none of the in-flight
+    epochs' data resurrects, no partial manifest."""
+    obj = MemObjectStore()
+    gate = _Gate(obj)
+    store = HummockLite(gate)
+    loop = _loop(store, max_uploading=8)
+
+    async def run():
+        await loop.inject_and_collect(force_checkpoint=True)
+        await _checkpoint_epochs(loop, store, 3)
+        await loop.checkpoint()          # rows 0..2 durably committed
+        gate.gated = True                # uploads now hang
+        gated = await _checkpoint_epochs(loop, store, 3, start=10)
+        assert loop.uploading_count > 0  # in flight at the "crash"
+        gate.cut()                       # power cut: they never commit
+        with pytest.raises(OSError):
+            await loop.uploader.drain()
+        assert loop.committed_epoch < gated[0]
+        return loop.committed_epoch
+
+    durable = asyncio.run(run())
+    h2 = HummockLite(obj)                # reboot from the object store
+    assert h2.committed_epoch() == durable
+    got = dict(h2.iter(1, 1 << 62))
+    assert got == {i.to_bytes(4, "big"): (i,) for i in range(3)}
+    assert not obj.exists("meta/STAGED.json")
+
+
+def test_uploaded_but_uncommitted_sst_is_not_referenced():
+    """Crash AFTER the object-store PUT but BEFORE the manifest
+    commit: the orphan object exists but no version references it."""
+    obj = MemObjectStore()
+    h = HummockLite(obj)
+    h.ingest_batch(1, [(b"a", (1,))], 100)
+    h.seal_epoch(100, True)
+    for p in h.build_ssts(100):
+        h.upload_payload(p)              # durable object, no manifest
+    h2 = HummockLite(obj)                # reboot before commit_ssts
+    assert h2.committed_epoch() == 0
+    assert h2.get(1, b"a", 100) is None
+    assert obj.list("data/")             # the orphan is there, ignored
+
+
+def test_run_stop_with_uploads_in_flight_commits_every_epoch_once():
+    """Regression for the run()-drain hazard: stop() with uploads in
+    flight must still commit every collected epoch exactly once, in
+    order, before run() returns."""
+    obj = MemObjectStore()
+    store = HummockLite(DelayedObjectStore(obj, delay_s=0.05))
+    loop = BarrierLoop(LocalBarrierManager(), store, interval_ms=1,
+                       max_uploading=16)
+
+    async def run():
+        task = asyncio.ensure_future(loop.run())
+        for i in range(10):
+            await asyncio.sleep(0.004)
+            if loop._epoch is not None:
+                # the LATEST injected epoch cannot be sealed yet (only
+                # a later barrier's collect seals it), so this write
+                # always lands above the sealed watermark
+                store.ingest_batch(1, [(i.to_bytes(4, "big"), (i,))],
+                                   loop._epoch.value)
+        await asyncio.sleep(0.03)        # successor barriers seal the
+        loop.stop()                      # last write's epoch
+        await task
+
+    asyncio.run(run())
+    assert loop.uploading_count == 0     # run() drained the uploader
+    log = list(loop.uploader.commit_log)
+    assert log == sorted(log) and len(set(log)) == len(log)
+    # every collected barrier's prev committed exactly once (the first
+    # barrier has prev=0: nothing to commit)
+    assert len(log) == len(loop.stats.completed_epochs) - 1
+    assert loop.committed_epoch == log[-1] == store.committed_epoch()
+    h2 = HummockLite(obj)                # all rows durable after drain
+    got = dict(h2.iter(1, 1 << 62))
+    assert got == {i.to_bytes(4, "big"): (i,) for i in range(10)}
+
+
+def test_memory_store_fallback_stays_synchronous():
+    """Stores without the build/commit split (MemoryStateStore) take
+    the inline sync fallback: committed_epoch advances at collect."""
+    from risingwave_tpu.state.store import MemoryStateStore
+
+    store = MemoryStateStore()
+    loop = _loop(store)
+
+    async def run():
+        await loop.inject_and_collect(force_checkpoint=True)
+        b = await loop.inject(force_checkpoint=True)
+        store.ingest_batch(1, [(b"k", (1,))], b.epoch.curr.value)
+        await loop.collect_next()        # no drain needed:
+        assert loop.uploading_count == 0
+        b2 = await loop.inject(force_checkpoint=True)
+        await loop.collect_next()
+        assert loop.committed_epoch == b2.epoch.prev.value
+
+    asyncio.run(run())
+
+
+def test_inject_and_collect_can_skip_drain_for_heartbeats():
+    """The background heartbeat must not re-serialize the pipeline:
+    drain_uploader=False returns without waiting on in-flight PUTs."""
+    obj = MemObjectStore()
+    store = HummockLite(DelayedObjectStore(obj, delay_s=0.2))
+    loop = _loop(store, max_uploading=8)
+
+    async def run():
+        await loop.inject_and_collect(force_checkpoint=True)
+        b = await loop.inject(force_checkpoint=True)
+        store.ingest_batch(1, [(b"k", (1,))], b.epoch.curr.value)
+        await loop.collect_next()
+        t0 = time.perf_counter()
+        await loop.inject_and_collect(force_checkpoint=True,
+                                      drain_uploader=False)
+        dt = time.perf_counter() - t0
+        assert loop.uploading_count > 0    # the overlap survived
+        assert dt < 0.2, dt                # did not wait on the PUT
+        await loop.uploader.drain()
+
+    asyncio.run(run())
+    assert store.committed_epoch() == loop.committed_epoch
+
+
+def test_vacuum_orphans_clears_crash_residue_keeps_live_data():
+    obj = MemObjectStore()
+    h = HummockLite(obj)
+    h.ingest_batch(1, [(b"live", (1,))], 100)
+    h.seal_epoch(100, True)
+    h.sync(100)                          # committed: referenced SST
+    h.ingest_batch(1, [(b"lost", (2,))], 200)
+    h.seal_epoch(200, True)
+    for p in h.build_ssts(200):
+        h.upload_payload(p)              # crash before commit_ssts
+    h2 = HummockLite(obj)                # next generation recovers
+    assert h2.vacuum_orphans() == 1      # exactly the orphan
+    assert h2.get(1, b"live", 200) == (1,)
+    assert h2.get(1, b"lost", 200) is None
+    assert len(obj.list("data/")) == 1   # only the referenced SST
+
+
+def test_barrier_loop_reusable_across_event_loops():
+    """One BarrierLoop driven by separate asyncio.run() calls (each a
+    fresh event loop) — the uploader re-binds its idle loop-bound
+    primitives instead of raising 'bound to a different event loop'
+    (the pre-pipeline code supported this usage)."""
+    obj = MemObjectStore()
+    store = HummockLite(obj)
+    loop = _loop(store)
+
+    async def one_round(i):
+        b = await loop.inject(force_checkpoint=True)
+        store.ingest_batch(1, [(bytes([i]), (i,))], b.epoch.curr.value)
+        while loop.in_flight_count:
+            await loop.collect_next()
+        await loop.uploader.drain()
+
+    asyncio.run(one_round(1))
+    asyncio.run(one_round(2))        # fresh loop: must not raise
+    asyncio.run(loop.checkpoint())   # seals + commits the last write
+    assert store.get(1, bytes([2]), loop.committed_epoch) == (2,)
