@@ -28,7 +28,26 @@ def _metrics_snapshot(loop) -> dict:
     traffic — BENCH_*.json carries the observability trajectory."""
     from risingwave_tpu.utils.metrics import STORAGE, STREAMING
     b = loop.profiler.p99_breakdown()
+    # device-dispatch amortization (stream/coalesce.py): dispatch
+    # counts and rows-per-dispatch sit NEXT TO events/sec so a round
+    # diff shows the batching effect directly
+    dispatches = int(sum(v for _l, v in
+                         STREAMING.device_dispatch.series()))
+    disp_rows = sum(s for _l, _n, s in
+                    STREAMING.rows_per_dispatch.series())
+    co_in = int(sum(v for _l, v in
+                    STREAMING.coalesce_chunks_in.series()))
+    co_out = int(sum(v for _l, v in
+                     STREAMING.coalesce_chunks_out.series()))
     return {
+        "device_dispatches": dispatches,
+        "rows_per_dispatch_avg": round(disp_rows / dispatches, 1)
+        if dispatches else 0.0,
+        "coalesce_chunks_in": co_in,
+        "coalesce_chunks_out": co_out,
+        "compaction_rows_saved": int(sum(
+            v for _l, v in
+            STREAMING.compaction_rows_saved.series())),
         "p99_inject_to_collect_s": round(b["inject_to_collect_s"], 5),
         "p99_collect_to_commit_s": round(b["collect_to_commit_s"], 5),
         # the async checkpoint tail (seal→durable commit), overlapped

@@ -160,7 +160,17 @@ class Cluster:
                         "port": self.clients[up_slot].exchange_port,
                         "up_actor": up_aid, "schema": inp.schema})
                     r_idxs.append(len(out) - 1)
-                out.append({"op": "merge", "inputs": r_idxs})
+                from risingwave_tpu.stream.coalesce import (
+                    DEFAULT_MAX_CHUNKS,
+                )
+                out.append({"op": "merge", "inputs": r_idxs,
+                            # session knobs ride the cut edge: rows=0
+                            # disables fan-in re-coalescing end to end
+                            "coalesce_rows": int(getattr(
+                                inp, "coalesce_rows", 0)),
+                            "coalesce_chunks": int(getattr(
+                                inp, "coalesce_chunks",
+                                DEFAULT_MAX_CHUNKS))})
                 remap[idx] = len(out) - 1
                 continue
             n2 = dict(node)

@@ -75,6 +75,15 @@ class DistFrontend:
         self.rate_limit = rate_limit
         self.min_chunks = min_chunks
         self.last_select_schema = None
+        # chunk coalescing knobs — same surface as the in-process
+        # session (no-drift contract): the planner's keyed-input
+        # coalescers AND the scheduler's merge-node re-coalescing both
+        # read them (SET stream_chunk_target_rows = 0 disables both)
+        from risingwave_tpu.stream.coalesce import (
+            DEFAULT_MAX_CHUNKS, DEFAULT_TARGET_ROWS,
+        )
+        self.chunk_target_rows = DEFAULT_TARGET_ROWS
+        self.coalesce_linger_chunks = DEFAULT_MAX_CHUNKS
         # name → (select AST, eowc): FROM <mv> inlines the view's
         # definition (distributed MV-on-MV by view expansion)
         self._mv_selects = {}
@@ -84,7 +93,10 @@ class DistFrontend:
         self.session_vars = SessionVars(
             self, {"streaming_rate_limit": "rate_limit",
                    "streaming_min_chunks": "min_chunks",
-                   "parallelism": "parallelism"})
+                   "parallelism": "parallelism",
+                   "stream_chunk_target_rows": "chunk_target_rows",
+                   "stream_coalesce_linger_chunks":
+                       "coalesce_linger_chunks"})
         # serializes barrier rounds between DDL, step(), SELECT
         # snapshots and the background heartbeat (inject_and_collect
         # is not reentrant; a heartbeat between per-table scans would
@@ -164,7 +176,9 @@ class DistFrontend:
                 self.catalog, MemoryStateStore(),
                 LocalBarrierManager(), definition="", mesh=None,
                 actors={}, dist_parallelism=self.parallelism,
-                inline_mvs=self._mv_selects)
+                inline_mvs=self._mv_selects,
+                chunk_target_rows=self.chunk_target_rows,
+                coalesce_linger_chunks=self.coalesce_linger_chunks)
             plan = planner.plan("__explain__", stmt.select, actor_id=0,
                                 rate_limit=self.rate_limit,
                                 min_chunks=self.min_chunks)
@@ -191,7 +205,10 @@ class DistFrontend:
                                 LocalBarrierManager(), definition="",
                                 mesh=None, actors={},
                                 dist_parallelism=self.parallelism,
-                                inline_mvs=self._mv_selects)
+                                inline_mvs=self._mv_selects,
+                                chunk_target_rows=self.chunk_target_rows,
+                                coalesce_linger_chunks=self
+                                .coalesce_linger_chunks)
         plan = planner.plan(stmt.name, stmt.select, actor_id=0,
                             rate_limit=self.rate_limit,
                             min_chunks=self.min_chunks)
@@ -203,7 +220,11 @@ class DistFrontend:
             raise PlanError(
                 "internal: distributed plan produced chain attaches "
                 "(view not inlined?) — cannot deploy")
-        graph = Fragmenter(self.parallelism).lower(plan.consumer)
+        graph = Fragmenter(
+            self.parallelism,
+            merge_coalesce_rows=self.chunk_target_rows,
+            merge_coalesce_chunks=self.coalesce_linger_chunks
+        ).lower(plan.consumer)
         async with self._barrier_lock:
             await self.cluster.deploy_graph(stmt.name, graph)
             await self.cluster.step(1)     # activation barrier

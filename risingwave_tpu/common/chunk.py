@@ -201,6 +201,12 @@ class DataChunk:
         for c in self.columns:
             assert int(c.values.shape[0]) == cap, "column capacity mismatch"
         self._capacity = cap
+        # set by stream.coalesce.compact/merge_chunks on chunks whose
+        # visible rows are a KNOWN dense prefix: the visible-row count
+        # without a host sum. Exchange credit charges this instead of
+        # padded capacity (a compacted chunk costs its true rows, not
+        # 4x them); None means "not established".
+        self.dense_rows: Optional[int] = None
 
     # -- constructors --------------------------------------------------
     @staticmethod
@@ -241,7 +247,9 @@ class DataChunk:
         return self._capacity
 
     def cardinality(self) -> int:
-        """Number of visible rows (host sync)."""
+        """Number of visible rows (host sync unless dense_rows known)."""
+        if self.dense_rows is not None:
+            return self.dense_rows
         return int(np.sum(np.asarray(self.visibility)))
 
     def column(self, name: str) -> Column:

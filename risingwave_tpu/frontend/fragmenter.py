@@ -52,6 +52,11 @@ class FragInput:
     schema: List[dict]              # IR schema of the exchanged rows
     node_idx: int                   # index of the exchange_in placeholder
     mode: str = "hash"              # "hash" | "broadcast"
+    # downstream fan-in re-coalescing target + linger bound
+    # (stream/coalesce.py); rows=0 disables — the scheduler copies
+    # both onto the merge node
+    coalesce_rows: int = 0
+    coalesce_chunks: int = 0
 
 
 @dataclass
@@ -97,8 +102,21 @@ def _agg_call_ir(c) -> dict:
 class Fragmenter:
     """One-shot walker over a planned executor tree."""
 
-    def __init__(self, parallelism: int):
+    def __init__(self, parallelism: int,
+                 merge_coalesce_rows: Optional[int] = None,
+                 merge_coalesce_chunks: Optional[int] = None):
+        from risingwave_tpu.stream.coalesce import (
+            DEFAULT_MAX_CHUNKS, DEFAULT_TARGET_ROWS,
+        )
         self.parallelism = max(1, parallelism)
+        # fan-in re-coalescing knobs stamped on every cut edge (the
+        # session's stream_chunk_target_rows /
+        # stream_coalesce_linger_chunks; rows=0 disables end to end)
+        self.merge_coalesce_rows = DEFAULT_TARGET_ROWS \
+            if merge_coalesce_rows is None else int(merge_coalesce_rows)
+        self.merge_coalesce_chunks = DEFAULT_MAX_CHUNKS \
+            if merge_coalesce_chunks is None \
+            else int(merge_coalesce_chunks)
         self.graph = FragmentGraph()
 
     def lower(self, consumer) -> FragmentGraph:
@@ -124,7 +142,9 @@ class Fragmenter:
         port = len(frag.inputs)
         ni = self._append(fi, {"op": "exchange_in", "port": port})
         frag.inputs.append(FragInput(up_fi, list(keys),
-                                     schema_to_ir(schema), ni, mode))
+                                     schema_to_ir(schema), ni, mode,
+                                     self.merge_coalesce_rows,
+                                     self.merge_coalesce_chunks))
         return fi, ni
 
     def _cut_into(self, fi: int, up_fi: int, keys: List[int],
@@ -135,7 +155,9 @@ class Fragmenter:
         port = len(frag.inputs)
         ni = self._append(fi, {"op": "exchange_in", "port": port})
         frag.inputs.append(FragInput(up_fi, list(keys),
-                                     schema_to_ir(schema), ni, mode))
+                                     schema_to_ir(schema), ni, mode,
+                                     self.merge_coalesce_rows,
+                                     self.merge_coalesce_chunks))
         return ni
 
     # -- the walk ---------------------------------------------------------
@@ -175,6 +197,18 @@ class Fragmenter:
             fi, ci = self._lower(ex.input)
             ni = self._append(fi, {"op": "filter", "input": ci,
                                    "pred": expr_to_ir(ex.predicate)})
+            return fi, ni
+        from risingwave_tpu.stream.coalesce import CoalesceExecutor
+        if isinstance(ex, CoalesceExecutor):
+            # keyed-input coalescing ships with the plan: on the
+            # upstream side of a cut it densifies the exchange send
+            # path; the downstream merge re-coalesces post-dispatch
+            # slivers (scheduler merge nodes carry their own knob)
+            fi, ci = self._lower(ex.input)
+            ni = self._append(fi, {
+                "op": "coalesce", "input": ci,
+                "target_rows": ex.target_rows,
+                "max_chunks": ex.max_chunks})
             return fi, ni
         if isinstance(ex, RowIdGenExecutor):
             fi, ci = self._lower(ex.input)

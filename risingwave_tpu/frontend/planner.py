@@ -199,9 +199,21 @@ class StreamPlanner:
 
     def __init__(self, catalog: Catalog, store, local, definition: str,
                  mesh=None, actors=None, dist_parallelism: int = 1,
-                 join_state_cap=None, inline_mvs=None):
+                 join_state_cap=None, inline_mvs=None,
+                 chunk_target_rows: Optional[int] = None,
+                 coalesce_linger_chunks: Optional[int] = None):
+        from risingwave_tpu.stream.coalesce import (
+            DEFAULT_MAX_CHUNKS, DEFAULT_TARGET_ROWS,
+        )
         self.catalog = catalog
         self.store = store
+        # adaptive coalescing in front of keyed executors (session var
+        # stream_chunk_target_rows; 0 disables — the oracle-equivalence
+        # tests compare on vs off)
+        self.chunk_target_rows = DEFAULT_TARGET_ROWS \
+            if chunk_target_rows is None else chunk_target_rows
+        self.coalesce_linger_chunks = DEFAULT_MAX_CHUNKS \
+            if coalesce_linger_chunks is None else coalesce_linger_chunks
         self.local = local           # LocalBarrierManager
         self.definition = definition
         self.mesh = mesh             # non-None ⇒ sharded GROUP BY plans
@@ -234,6 +246,17 @@ class StreamPlanner:
         base = f"{kind}:{name}->{self._actor_id}"
         return base if self._edge_seq == 1 else \
             f"{base}.{self._edge_seq}"
+
+    def _coalesced(self, ex: Executor) -> Executor:
+        """Adaptive coalescing in front of a keyed executor's input:
+        every device dispatch then carries a dense target-sized batch
+        instead of per-upstream-chunk slivers (stream/coalesce.py).
+        Disabled when stream_chunk_target_rows = 0."""
+        if not self.chunk_target_rows or self.chunk_target_rows <= 0:
+            return ex
+        from risingwave_tpu.stream.coalesce import CoalesceExecutor
+        return CoalesceExecutor(ex, self.chunk_target_rows,
+                                self.coalesce_linger_chunks)
 
     # -- source chains ---------------------------------------------------
     def _base_chain(self, item, rate_limit: Optional[int],
@@ -607,7 +630,9 @@ class StreamPlanner:
                 # parallel plan: the hash exchange feeding N parallel
                 # join actors (dispatch.rs:582) is the sharded kernel's
                 # in-program all_to_all — same wiring as the agg path
-                left = HashJoinExecutor(left, right, lkeys, rkeys, lt,
+                left = HashJoinExecutor(self._coalesced(left),
+                                        self._coalesced(right),
+                                        lkeys, rkeys, lt,
                                         rt, actor_id=actor_id,
                                         join_type=jt, mesh=self.mesh,
                                         state_cap=cap)
@@ -808,6 +833,10 @@ class StreamPlanner:
             WatermarkFilterExecutor,
         )
         if isinstance(ex, WatermarkFilterExecutor):
+            return StreamPlanner._derive_append_only(ex.input)
+        from risingwave_tpu.stream.coalesce import CoalesceExecutor
+        if isinstance(ex, CoalesceExecutor):
+            # pure re-batching: op multiset is untouched
             return StreamPlanner._derive_append_only(ex.input)
         from risingwave_tpu.stream.executors.project_set import (
             ProjectSetExecutor,
@@ -1015,7 +1044,8 @@ class StreamPlanner:
             kernel = ShardedAggKernel(
                 self.mesh, key_width=LANES_PER_KEY * g,
                 specs=[c.spec(pre.schema) for c in calls])
-        agg = HashAggExecutor(pre, list(range(g)), calls, table,
+        agg = HashAggExecutor(self._coalesced(pre), list(range(g)),
+                              calls, table,
                               append_only=append_only, kernel=kernel,
                               minput_tables=minput_tables,
                               distinct_tables=distinct_tables)
@@ -1048,7 +1078,8 @@ class StreamPlanner:
             pre.schema, group, calls, append_only, self.store,
             dedup_table_id=lambda _c: self.catalog.next_id(),
             minput_table_id=lambda _j: self.catalog.next_id())
-        local = HashAggExecutor(pre, group, calls, ltable,
+        local = HashAggExecutor(self._coalesced(pre), group, calls,
+                                ltable,
                                 append_only=append_only,
                                 distinct_tables=ldistinct,
                                 minput_tables=lminput)
@@ -1200,7 +1231,8 @@ def _system_catalog_rows(name: str, catalog: Catalog, profiler=None):
                       Field("node", DataType.INT64),
                       Field("row_count", DataType.INT64),
                       Field("chunk_count", DataType.INT64),
-                      Field("busy_seconds", DataType.FLOAT64)])
+                      Field("busy_seconds", DataType.FLOAT64),
+                      Field("device_dispatch_count", DataType.INT64)])
         live = {labels["actor"]: labels.get("fragment", "")
                 for labels, _v in STREAMING.actor_count.series()
                 if "actor" in labels}
@@ -1215,6 +1247,11 @@ def _system_catalog_rows(name: str, catalog: Catalog, profiler=None):
                 key = (a, labels.get("executor", ""),
                        labels.get("node", ""))
                 per_exec.setdefault(key, [0.0, 0.0, 0.0])[slot] += v
+        # keyed executors label device dispatches by identity alone
+        # (identity embeds the actor, e.g. "HashAggExecutor(actor=N)")
+        # — join on the executor name the monitor also labels with
+        dispatches = {labels.get("executor", ""): v for labels, v in
+                      STREAMING.device_dispatch.series()}
         rows = []
         seen_actors = set()
         for (a, ex_name, node), (nrows, nchunks, busy) in \
@@ -1222,10 +1259,11 @@ def _system_catalog_rows(name: str, catalog: Catalog, profiler=None):
             seen_actors.add(a)
             rows.append((int(a), live[a], ex_name,
                          int(node) if node else 0,
-                         int(nrows), int(nchunks), busy))
+                         int(nrows), int(nchunks), busy,
+                         int(dispatches.get(ex_name, 0))))
         for a, frag in live.items():
             if a not in seen_actors:    # deployed but unmonitored
-                rows.append((int(a), frag, "", 0, 0, 0, 0.0))
+                rows.append((int(a), frag, "", 0, 0, 0, 0.0, 0))
         return sch, sorted(rows)
     if n == "rw_fragment_backpressure":
         sch = Schema([Field("edge", DataType.VARCHAR),

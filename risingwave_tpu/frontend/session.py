@@ -59,6 +59,14 @@ class Frontend:
         self.min_chunks = min_chunks
         # resident join-state cap (cold-tier eviction; None = unbounded)
         self.join_state_cap = join_state_cap
+        # adaptive chunk coalescing in front of keyed executors
+        # (stream/coalesce.py): target cardinality per device dispatch
+        # (0 disables) and the linger bound in buffered chunks
+        from risingwave_tpu.stream.coalesce import (
+            DEFAULT_MAX_CHUNKS, DEFAULT_TARGET_ROWS,
+        )
+        self.chunk_target_rows = DEFAULT_TARGET_ROWS
+        self.coalesce_linger_chunks = DEFAULT_MAX_CHUNKS
         # session configuration (src/common/src/session_config/
         # analog): typed knobs bind to REAL planner inputs, the rest
         # are pg-compatibility strings (shared impl: session_vars.py)
@@ -66,7 +74,10 @@ class Frontend:
         self.session_vars = SessionVars(
             self, {"streaming_rate_limit": "rate_limit",
                    "streaming_min_chunks": "min_chunks",
-                   "join_state_cap": "join_state_cap"},
+                   "join_state_cap": "join_state_cap",
+                   "stream_chunk_target_rows": "chunk_target_rows",
+                   "stream_coalesce_linger_chunks":
+                       "coalesce_linger_chunks"},
             {"application_name": "", "timezone": "UTC"})
         self._next_actor = 1000
         self.chain_edges: Dict[str, list] = {}   # job → [(uid, Output)]
@@ -333,7 +344,10 @@ class Frontend:
         from risingwave_tpu.frontend.planner import explain_tree
         planner = StreamPlanner(self.catalog, self.store,
                                 LocalBarrierManager(), definition="",
-                                mesh=self.mesh, actors=self.actors)
+                                mesh=self.mesh, actors=self.actors,
+                                chunk_target_rows=self.chunk_target_rows,
+                                coalesce_linger_chunks=self
+                                .coalesce_linger_chunks)
         plan = planner.plan("__explain__", sel, actor_id=0,
                             rate_limit=self.rate_limit,
                             min_chunks=self.min_chunks)
@@ -373,7 +387,11 @@ class Frontend:
             planner = StreamPlanner(self.catalog, self.store, self.local,
                                     definition="", mesh=self.mesh,
                                     actors=self.actors,
-                                    join_state_cap=self.join_state_cap)
+                                    join_state_cap=self.join_state_cap,
+                                    chunk_target_rows=self
+                                    .chunk_target_rows,
+                                    coalesce_linger_chunks=self
+                                    .coalesce_linger_chunks)
             actor_id = self._next_actor
             self._next_actor += 1
             id_base = self.catalog._next_id
@@ -756,7 +774,10 @@ class Frontend:
                 planner = StreamPlanner(
                     self.catalog, self.store, self.local,
                     definition="", mesh=mesh, actors=self.actors,
-                    join_state_cap=self.join_state_cap)
+                    join_state_cap=self.join_state_cap,
+                    chunk_target_rows=self.chunk_target_rows,
+                    coalesce_linger_chunks=self
+                    .coalesce_linger_chunks)
                 actor_id = self._next_actor
                 self._next_actor += 1
                 try:
@@ -805,7 +826,11 @@ class Frontend:
         async with self._barrier_lock:
             planner = StreamPlanner(self.catalog, self.store, self.local,
                                     definition="", mesh=self.mesh,
-                                    actors=self.actors)
+                                    actors=self.actors,
+                                    chunk_target_rows=self
+                                    .chunk_target_rows,
+                                    coalesce_linger_chunks=self
+                                    .coalesce_linger_chunks)
             actor_id = self._next_actor
             self._next_actor += 1
             try:

@@ -21,6 +21,7 @@ from risingwave_tpu.ops.hash_agg import AggKind
 from risingwave_tpu.state.state_table import StateTable
 from risingwave_tpu.stream.actor import Actor, LocalBarrierManager
 from risingwave_tpu.stream.exchange import channel_for_test
+from risingwave_tpu.stream.executor import Executor
 from risingwave_tpu.stream.executors.hash_agg import (
     AggCall, HashAggExecutor, agg_state_schema,
 )
@@ -105,7 +106,8 @@ def build_q7(store, cfg: NexmarkConfig,
              window: Interval = DEFAULT_WINDOW,
              min_chunks: Optional[int] = None,
              watermark_delay: Optional[Interval] = None,
-             mesh=None, shard_capacity: int = 1 << 14) -> Pipeline:
+             mesh=None, shard_capacity: int = 1 << 14,
+             coalesce_rows: Optional[int] = None) -> Pipeline:
     """q7-core: MAX(price), COUNT(*) per tumbling window (device agg).
 
     With ``watermark_delay``, a WatermarkFilter generates event-time
@@ -149,7 +151,15 @@ def build_q7(store, cfg: NexmarkConfig,
             mesh, key_width=LANES_PER_KEY * 1,
             specs=[c.spec(project.schema) for c in calls],
             capacity=shard_capacity)
-    agg = HashAggExecutor(project, [0], calls, agg_state,
+    agg_in: Executor = project
+    if coalesce_rows:
+        # barrier-bounded chunk coalescing in front of the keyed
+        # executor (stream/coalesce.py) — the SQL planner inserts this
+        # automatically; the hand-built pipeline takes it as a knob so
+        # the oracle test can compare on vs off
+        from risingwave_tpu.stream.coalesce import CoalesceExecutor
+        agg_in = CoalesceExecutor(project, coalesce_rows)
+    agg = HashAggExecutor(agg_in, [0], calls, agg_state,
                           append_only=True,
                           output_names=["max_price", "bid_count"],
                           kernel=kernel)

@@ -16,6 +16,9 @@ from risingwave_tpu.stream.executor import Executor, ExecutorInfo
 from risingwave_tpu.stream.exchange import (
     ChannelClosed, Receiver, Sender, channel, channel_for_test,
 )
+from risingwave_tpu.stream.coalesce import (
+    ChunkCoalescer, CoalesceExecutor, compact, merge_chunks,
+)
 from risingwave_tpu.stream.merge import MergeExecutor, barrier_align_2
 from risingwave_tpu.stream.dispatch import (
     BroadcastDispatcher, DispatchExecutor, Dispatcher, HashDispatcher,
@@ -30,6 +33,7 @@ __all__ = [
     "is_barrier", "is_chunk", "is_watermark",
     "Executor", "ExecutorInfo",
     "ChannelClosed", "Receiver", "Sender", "channel", "channel_for_test",
+    "ChunkCoalescer", "CoalesceExecutor", "compact", "merge_chunks",
     "MergeExecutor", "barrier_align_2",
     "BroadcastDispatcher", "DispatchExecutor", "Dispatcher",
     "HashDispatcher", "Output", "RoundRobinDispatcher", "SimpleDispatcher",

@@ -7,10 +7,14 @@ ActorMapping, per-output visibility masks, Update pairs kept atomic);
 DispatcherType proto/stream_plan.proto:671.
 
 TPU re-design: hashing the whole chunk is ONE vectorized device pass
-(`vnodes_of`); each downstream gets the same chunk with a different
-visibility mask — zero row copies, the mask is the route. On a multi-chip
-mesh the same vnode math becomes the all-to-all permutation in parallel/
-(this host dispatcher serves single-host fan-out and tests).
+(`vnodes_of`); each downstream gets its vnode slice COMPACTED to a
+dense chunk (stream/coalesce.compact) — at parallelism N a masked
+full-capacity chunk would otherwise charge N× its true exchange
+credit, ship N× its wire bytes and cost N full device dispatches
+downstream. Zero-visible-row slices are suppressed entirely. On a
+multi-chip mesh the same vnode math becomes the all-to-all permutation
+in parallel/ (this host dispatcher serves single-host fan-out and
+tests).
 """
 
 from __future__ import annotations
@@ -71,6 +75,13 @@ class Dispatcher(abc.ABC):
             out.close()
 
 
+def _is_empty(chunk: StreamChunk) -> bool:
+    """Zero visible rows: nothing downstream could do with it but pay
+    a send + a recv + (for keyed executors) a device dispatch."""
+    from risingwave_tpu.stream.coalesce import is_empty
+    return is_empty(chunk)
+
+
 class SimpleDispatcher(Dispatcher):
     """Single downstream (DispatcherType::SIMPLE)."""
 
@@ -79,6 +90,8 @@ class SimpleDispatcher(Dispatcher):
         self.dispatcher_id = dispatcher_id
 
     async def dispatch_data(self, chunk: StreamChunk) -> None:
+        if _is_empty(chunk):
+            return
         await self._output.send(chunk)
 
     async def dispatch_barrier(self, barrier: Barrier) -> None:
@@ -100,6 +113,8 @@ class BroadcastDispatcher(Dispatcher):
         self.dispatcher_id = dispatcher_id
 
     async def dispatch_data(self, chunk: StreamChunk) -> None:
+        if _is_empty(chunk):
+            return
         for out in self._outputs:
             await out.send(chunk)
 
@@ -123,6 +138,8 @@ class RoundRobinDispatcher(Dispatcher):
         self.dispatcher_id = dispatcher_id
 
     async def dispatch_data(self, chunk: StreamChunk) -> None:
+        if _is_empty(chunk):
+            return
         await self._outputs[self._cur].send(chunk)
         self._cur = (self._cur + 1) % len(self._outputs)
 
@@ -190,9 +207,17 @@ class HashDispatcher(Dispatcher):
                 new_ops[j] = int(Op.INSERT)
         out_ops = new_ops if (new_ops != ops).any() else chunk.ops
         vis_host = np.asarray(chunk.visibility)
+        from risingwave_tpu.stream.coalesce import compact
         for oi, out in enumerate(self._outputs):
             sub_vis = vis_host & (owner == oi)
-            sub = StreamChunk(chunk.schema, chunk.columns, sub_vis, out_ops)
+            # compact each slice: a 1/N-visible full-capacity chunk
+            # would charge N× its true exchange credit, ship N× its
+            # wire bytes and cost a full device dispatch downstream.
+            # Slices with zero visible rows are suppressed entirely.
+            sub = compact(StreamChunk(chunk.schema, chunk.columns,
+                                      sub_vis, out_ops))
+            if sub is None:
+                continue
             await out.send(sub)
 
     async def dispatch_barrier(self, barrier: Barrier) -> None:

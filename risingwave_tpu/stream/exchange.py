@@ -51,9 +51,16 @@ class _Shared:
 
 
 def _chunk_cost(shared: _Shared, chunk: StreamChunk) -> int:
-    # cardinality() is a host sync; capacity is free and is the true memory
-    # footprint of the padded device arrays, so credit by capacity.
-    return min(chunk.capacity, shared.max_chunk_cost)
+    # Compacted/coalesced chunks KNOW their visible cardinality
+    # (dense_rows, no host sum) — charge the true row count so a
+    # post-dispatch sliver no longer burns capacity-x credit and
+    # stalls its upstream early. For unestablished chunks cardinality()
+    # would be a host sync per send; capacity is free and is the true
+    # memory footprint of the padded arrays, so those keep paying
+    # capacity.
+    cost = chunk.dense_rows if chunk.dense_rows is not None \
+        else chunk.capacity
+    return max(1, min(cost, shared.max_chunk_cost))
 
 
 class Sender:

@@ -396,6 +396,12 @@ class HashAggExecutor(Executor):
         key_lanes = self.key_codec.build(chunk, self.group_indices)
         signs = np.asarray(chunk.signs())
         vis = np.asarray(chunk.visibility)
+        # one kernel.apply below = one fused device dispatch (~2ms host
+        # cost through the tunnel): the metric pair the coalescing
+        # layer optimizes — fewer dispatches, denser rows per dispatch
+        _METRICS.device_dispatch.inc(1, executor=self.identity)
+        _METRICS.rows_per_dispatch.observe(float(vis.sum()),
+                                           executor=self.identity)
         inputs = list(self._inputs(chunk))
         if self.minput:
             self._apply_minput(chunk, key_lanes, signs, vis)
@@ -698,7 +704,12 @@ class HashAggExecutor(Executor):
         return self.key_codec.decode(keys)
 
     def _flush(self) -> Optional[StreamChunk]:
+        _METRICS.device_dispatch.inc(1, executor=self.identity)
         fr = self.kernel.flush()
+        # the flush dispatch gathers the dirty groups — observe them so
+        # the histogram count tracks the dispatch counter exactly
+        _METRICS.rows_per_dispatch.observe(float(fr.n),
+                                           executor=self.identity)
         _METRICS.agg_dirty_groups.set(fr.n, executor=self.identity)
         _METRICS.agg_table_capacity.set(self.kernel.capacity,
                                         executor=self.identity)

@@ -860,6 +860,11 @@ class HashJoinExecutor(Executor):
             # them once; a jnp round-trip here would block on the tunnel.
             handle = None
             if probe_vis.any():
+                # one fused apply+probe = one device dispatch; its row
+                # density is what input coalescing buys back
+                _METRICS.device_dispatch.inc(1, executor=self.identity)
+                _METRICS.rows_per_dispatch.observe(
+                    float(probe_vis.sum()), executor=self.identity)
                 handle = me.kernel.apply_and_probe(
                     other.kernel, key_lanes, probe_vis,
                     full_refs, ins_mask, del_refs, del_mask, seq)
@@ -914,6 +919,14 @@ class HashJoinExecutor(Executor):
         # both applies land before either probe dispatches: a probe at
         # seq s must see the other side's same-epoch rows with seq < s
         for s, (ld, ad, total, max_ref) in devs.items():
+            # apply + probe below = 2 device dispatches per side/epoch,
+            # each carrying the epoch's rows (observe twice so the
+            # histogram's count matches the dispatch counter and
+            # sum/count stays the true per-dispatch density)
+            _METRICS.device_dispatch.inc(2, executor=self.identity)
+            for _ in range(2):
+                _METRICS.rows_per_dispatch.observe(
+                    float(total), executor=self.identity)
             self.sides[s].kernel.apply_epoch(ld, ad, total, max_ref)
         with_deg = self.join_type != JoinType.INNER
         probes = {s: self.sides[1 - s].kernel.probe_epoch(ld, ad,

@@ -116,6 +116,8 @@ class ProjectExecutor(Executor):
                 if (ops_np == int(Op.UPDATE_DELETE)).any():
                     vis = self._drop_noop_updates(cols, np.asarray(vis),
                                                   ops_np)
+                    if not np.asarray(vis).any():
+                        continue   # all pairs were noops: emit nothing
                 yield StreamChunk(self.schema, cols, vis, msg.ops)
             elif isinstance(msg, Watermark):
                 d = self.watermark_derivations.get(msg.col_idx)
@@ -145,9 +147,14 @@ class FilterExecutor(Executor):
         super().__init__(info)
 
     async def execute(self) -> AsyncIterator[Message]:
+        import numpy as np
         async for msg in self.input.execute():
             if is_chunk(msg):
-                yield self._apply(msg)
+                out = self._apply(msg)
+                # a fully-filtered chunk is dead weight downstream
+                # (empty-message suppression, end to end)
+                if np.asarray(out.visibility).any():
+                    yield out
             else:
                 yield msg
 

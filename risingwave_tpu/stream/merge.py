@@ -9,6 +9,14 @@ inputs, monotonically.
 
 This alignment is the Chandy-Lamport cut: everything before the barrier on
 every input is in epoch N, everything after in N+1.
+
+Both merge variants optionally COALESCE the merged data stream
+(`coalesce_rows`): a parallel upstream fan-in delivers N compacted
+slivers per upstream chunk, and merging them back into dense
+target-sized batches here is what keeps the downstream keyed
+executor's device dispatch count independent of upstream parallelism.
+Barriers/watermarks flush the buffer first — the coalescer never
+delays a control message (stream/coalesce.py contract).
 """
 
 from __future__ import annotations
@@ -17,6 +25,9 @@ import asyncio
 from typing import AsyncIterator, Dict, List, Optional
 
 from risingwave_tpu.common.chunk import StreamChunk
+from risingwave_tpu.stream.coalesce import (
+    DEFAULT_MAX_CHUNKS, ChunkCoalescer,
+)
 from risingwave_tpu.stream.exchange import ChannelClosed, Receiver
 from risingwave_tpu.stream.executor import Executor, ExecutorInfo
 from risingwave_tpu.stream.message import (
@@ -49,18 +60,32 @@ class _WatermarkAligner:
 
 
 class MergeExecutor(Executor):
-    """Merge N upstream channels into one aligned stream."""
+    """Merge N upstream channels into one aligned stream.
+
+    ``coalesce_rows`` (None = off) merges consecutive small data
+    chunks up to that cardinality before yielding; any barrier or
+    watermark flushes first."""
 
     def __init__(self, info: ExecutorInfo, inputs: List[Receiver],
-                 actor_id: int = 0):
+                 actor_id: int = 0,
+                 coalesce_rows: Optional[int] = None,
+                 coalesce_chunks: int = DEFAULT_MAX_CHUNKS):
         super().__init__(info)
         self.inputs = list(inputs)
         self.actor_id = actor_id
+        self.coalesce_rows = coalesce_rows
+        self.coalesce_chunks = coalesce_chunks
+
+    def _coalescer(self) -> Optional[ChunkCoalescer]:
+        if not self.coalesce_rows or self.coalesce_rows <= 0:
+            return None
+        return ChunkCoalescer(self.coalesce_rows, self.coalesce_chunks)
 
     async def execute(self) -> AsyncIterator[Message]:
         n = len(self.inputs)
         assert n > 0, "MergeExecutor needs at least one input"
         wm_align = _WatermarkAligner(n)
+        co = self._coalescer()
         out: asyncio.Queue = asyncio.Queue(maxsize=16)
         # per-input gate: the pump may proceed past a barrier only when the
         # aligner releases it for the next epoch
@@ -84,6 +109,26 @@ class MergeExecutor(Executor):
             except ChannelClosed:
                 arrived.put_nowait((i, "closed"))
 
+        def handle(i: int, msg) -> List[Message]:
+            """Route one data/watermark message through the aligner
+            and (optionally) the coalescer; returns what to yield."""
+            if isinstance(msg, Watermark):
+                w = wm_align.update(i, msg)
+                if w is None:
+                    return []
+                if co is None:
+                    return [w]
+                # re-sequence to the next flush — watermark-per-chunk
+                # upstreams must not force per-sliver batches
+                # (coalesce.py contract)
+                return co.push_watermark(w)
+            if co is None:
+                return [msg]
+            outs: List[Message] = co.push(msg)
+            if outs:
+                outs += co.drain_watermarks()
+            return outs
+
         pumps = [asyncio.ensure_future(pump(i, rx))
                  for i, rx in enumerate(self.inputs)]
         live = set(range(n))
@@ -99,12 +144,8 @@ class MergeExecutor(Executor):
                         {getter, arr}, return_when=asyncio.FIRST_COMPLETED)
                     if getter in done:
                         i, msg = getter.result()
-                        if isinstance(msg, Watermark):
-                            w = wm_align.update(i, msg)
-                            if w is not None:
-                                yield w
-                        else:
-                            yield msg
+                        for m in handle(i, msg):
+                            yield m
                     else:
                         getter.cancel()
                     if arr in done:
@@ -115,11 +156,32 @@ class MergeExecutor(Executor):
                             pending_barrier[ev] = barrier_box[ev]
                     else:
                         arr.cancel()
+                # every live input is parked at its gate (or closed),
+                # so no pump can enqueue concurrently — drain whatever
+                # the alignment race left in the queue: those messages
+                # PRECEDE the barriers (pumps are sequential) and must
+                # never slip into the next epoch
+                while True:
+                    try:
+                        i, msg = out.get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
+                    for m in handle(i, msg):
+                        yield m
                 # all inputs aligned (or closed): emit one barrier
                 for i in closed:
                     live.discard(i)
                     wm_align.remove_input(i)
                     wm_align.n = max(1, len(live))
+                if co is not None:
+                    # flush-on-barrier (and on close): the aligned
+                    # barrier below must never trail a lingering batch
+                    # or a held watermark
+                    f = co.flush()
+                    if f is not None:
+                        yield f
+                    for wm in co.drain_watermarks():
+                        yield wm
                 if not pending_barrier:
                     return  # every upstream closed without a barrier
                 barriers = list(pending_barrier.values())
@@ -153,24 +215,51 @@ class MergeExecutors(Executor):
     """
 
     def __init__(self, info: ExecutorInfo, inputs: List[Executor],
-                 actor_id: int = 0):
+                 actor_id: int = 0,
+                 coalesce_rows: Optional[int] = None,
+                 coalesce_chunks: int = DEFAULT_MAX_CHUNKS):
         super().__init__(info)
         self.inputs = list(inputs)
         self.actor_id = actor_id
+        self.coalesce_rows = coalesce_rows
+        self.coalesce_chunks = coalesce_chunks
 
     async def execute(self) -> AsyncIterator[Message]:
         assert self.inputs, "MergeExecutors needs at least one input"
         wm_align = _WatermarkAligner(len(self.inputs))
+        co = None
+        if self.coalesce_rows and self.coalesce_rows > 0:
+            co = ChunkCoalescer(self.coalesce_rows,
+                                self.coalesce_chunks)
         async for tag, msg in barrier_align_n(
                 [i.execute() for i in self.inputs]):
             if tag == "barrier":
+                if co is not None:
+                    f = co.flush()    # a barrier never waits on lingering rows
+                    if f is not None:
+                        yield f
+                    for wm in co.drain_watermarks():
+                        yield wm
                 yield msg.with_passed(self.actor_id)
                 if msg.is_stop(self.actor_id):
                     return
             elif isinstance(msg, Watermark):
                 w = wm_align.update(tag, msg)
                 if w is not None:
-                    yield w
+                    if co is None:
+                        yield w
+                    else:
+                        # re-sequence to the next flush point (see
+                        # coalesce.py: monotone bound stays valid)
+                        for m in co.push_watermark(w):
+                            yield m
+            elif co is not None:
+                outs = co.push(msg)
+                for merged in outs:
+                    yield merged
+                if outs:
+                    for wm in co.drain_watermarks():
+                        yield wm
             else:
                 yield msg
 

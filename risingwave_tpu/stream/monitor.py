@@ -29,6 +29,18 @@ from risingwave_tpu.utils.metrics import STREAMING as _METRICS
 from risingwave_tpu.utils.trace import GLOBAL_AWAITS as _AWAITS
 
 
+# assertion mode for zero-visible-row emissions: the spine suppresses
+# empty chunks end-to-end (dispatchers, filters, coalescers), so a
+# monitored executor emitting one is a regression. Tests flip this on
+# (tests/conftest.py) to REJECT empties; production only counts them.
+STRICT_EMPTY_CHUNKS = False
+
+
+def set_strict_empty_chunks(on: bool) -> None:
+    global STRICT_EMPTY_CHUNKS
+    STRICT_EMPTY_CHUNKS = bool(on)
+
+
 class MonitoredExecutor(Executor):
     """Transparent metrics wrapper around one executor node."""
 
@@ -78,8 +90,16 @@ class MonitoredExecutor(Executor):
                     _AWAITS.exit(self._who)
                     self.total_busy_s += time.perf_counter() - t0
                 if is_chunk(msg):
-                    _METRICS.executor_rows.inc(msg.cardinality(),
-                                               **self.labels)
+                    card = msg.cardinality()
+                    if card == 0:
+                        _METRICS.executor_empty_chunks.inc(
+                            1, **self.labels)
+                        if STRICT_EMPTY_CHUNKS:
+                            raise AssertionError(
+                                f"{self._who} emitted a zero-visible-"
+                                "row chunk (the spine suppresses "
+                                "empties end-to-end)")
+                    _METRICS.executor_rows.inc(card, **self.labels)
                     _METRICS.executor_chunks.inc(1, **self.labels)
                 elif is_barrier(msg):
                     self._flush_epoch()

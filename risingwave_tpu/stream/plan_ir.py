@@ -15,6 +15,9 @@ Node shapes (dicts, `op` discriminated):
    "min_chunks": n}
   {"op": "project", "input": N, "exprs": [...], "names": [...]}
   {"op": "filter",  "input": N, "pred": EXPR}
+  {"op": "coalesce", "input": N, "target_rows": n,
+   "max_chunks": n}                     # barrier-bounded chunk
+                                        # coalescing (stream/coalesce)
   {"op": "row_id_gen", "input": N}
   {"op": "hash_agg", "input": N, "group": [...],
    "calls": [{"kind","input_idx","distinct","delimiter"}],
@@ -27,7 +30,12 @@ Node shapes (dicts, `op` discriminated):
                                         # exchange; barriers arrive
                                         # in-band, so a fragment fed
                                         # only by these has no source
-  {"op": "merge", "inputs": [N, ...]}   # N-way barrier-aligned fan-in
+  {"op": "merge", "inputs": [N, ...],
+   "coalesce_rows": n,
+   "coalesce_chunks": n}                # N-way barrier-aligned fan-in
+                                        # (coalesce_rows: re-merge
+                                        # post-dispatch slivers, 0 off;
+                                        # coalesce_chunks: linger bound)
                                         # over earlier nodes (merge.rs
                                         # over exchange inputs) — the
                                         # receive side of a hash
@@ -217,6 +225,17 @@ def build_fragment(nodes: List[dict], store, local,
         elif op == "filter":
             child = built[node["input"]]
             ex = FilterExecutor(child, expr_from_ir(node["pred"]))
+        elif op == "coalesce":
+            from risingwave_tpu.stream.coalesce import (
+                DEFAULT_MAX_CHUNKS, DEFAULT_TARGET_ROWS,
+                CoalesceExecutor,
+            )
+            ex = CoalesceExecutor(
+                built[node["input"]],
+                target_rows=int(node.get("target_rows",
+                                         DEFAULT_TARGET_ROWS)),
+                max_chunks=int(node.get("max_chunks",
+                                        DEFAULT_MAX_CHUNKS)))
         elif op == "row_id_gen":
             ex = RowIdGenExecutor(built[node["input"]])
         elif op == "watermark_filter":
@@ -247,15 +266,27 @@ def build_fragment(nodes: List[dict], store, local,
                              int(node["up_actor"]), int(actor_id),
                              schema_from_ir(node["schema"]))
         elif op == "merge":
+            from risingwave_tpu.stream.coalesce import (
+                DEFAULT_MAX_CHUNKS,
+            )
             from risingwave_tpu.stream.executor import ExecutorInfo
             from risingwave_tpu.stream.merge import MergeExecutors
             children = [built[i] for i in node["inputs"]]
             if len({len(c.schema) for c in children}) != 1:
                 raise ValueError("merge inputs must share a schema")
+            # re-coalesce post-dispatch slivers at the fan-in: N
+            # parallel upstreams each deliver compacted 1/N slices,
+            # and downstream keyed executors should see dense
+            # target-sized batches again. The scheduler always writes
+            # coalesce_rows (from the session knob via the cut edge);
+            # absent == 0 == off, matching every other layer
             ex = MergeExecutors(
                 ExecutorInfo(children[0].schema, [],
                              f"Merge({len(children)})"),
-                children, actor_id=int(actor_id or 0))
+                children, actor_id=int(actor_id or 0),
+                coalesce_rows=int(node.get("coalesce_rows", 0)),
+                coalesce_chunks=int(node.get("coalesce_chunks",
+                                             DEFAULT_MAX_CHUNKS)))
         elif op == "hash_join":
             from risingwave_tpu.stream.executors.hash_join import (
                 HashJoinExecutor, JoinType,

@@ -52,6 +52,14 @@ _MUTATION_IDS = {type(None): 0, StopMutation: 1, PauseMutation: 2,
 
 
 def encode_chunk(chunk: StreamChunk) -> bytes:
+    # compact before encoding: invisible (masked/padding) rows are
+    # pure wire waste — a 1/N-visible dispatch slice would otherwise
+    # serialize N× its data. Zero-visible chunks (senders normally
+    # pre-suppress them) shrink to the minimal empty bucket.
+    from risingwave_tpu.stream.coalesce import compact
+    dense = compact(chunk)
+    chunk = dense if dense is not None else StreamChunk.from_pydict(
+        chunk.schema, {f.name: [] for f in chunk.schema}, capacity=8)
     out = bytearray()
     cap = chunk.capacity
     out += struct.pack(">IH", cap, len(chunk.columns))
@@ -153,6 +161,12 @@ def _frame(tag: bytes, payload: bytes) -> bytes:
     return tag + struct.pack(">I", len(payload)) + payload
 
 
+# per-connection write batching bound: frames already queued coalesce
+# into one socket write up to this many bytes (latency unaffected — we
+# never WAIT for more frames, only drain what is instantly available)
+_WRITE_BATCH_BYTES = 256 * 1024
+
+
 # -- server (upstream side) ----------------------------------------------
 
 
@@ -233,8 +247,27 @@ class ExchangeServer:
                     if frame is None:
                         clean = True
                         break
-                    writer.write(frame)
+                    # batch whatever else is already queued into ONE
+                    # write+drain: many small frames to the same edge
+                    # (compacted dispatch slices) otherwise pay a
+                    # syscall + flush each
+                    size = len(frame)
+                    batch = [frame]
+                    while size < _WRITE_BATCH_BYTES:
+                        try:
+                            nxt = q.get_nowait()
+                        except asyncio.QueueEmpty:
+                            break
+                        if nxt is None:
+                            clean = True
+                            break
+                        batch.append(nxt)
+                        size += len(nxt)
+                    writer.write(b"".join(batch) if len(batch) > 1
+                                 else frame)
                     await writer.drain()
+                    if clean:
+                        break
             finally:
                 pump.cancel()
         except (asyncio.IncompleteReadError, ConnectionResetError,
@@ -268,6 +301,9 @@ class RemoteOutputQueue:
         if self._broken:
             raise ConnectionError("remote exchange peer disconnected")
         if is_chunk(msg):
+            from risingwave_tpu.stream.coalesce import is_empty
+            if is_empty(msg):
+                return     # nothing to ship: no frame, no credit burned
             await self._credits.acquire()
             if self._broken:
                 self._credits.release()  # cascade the wake-up

@@ -292,6 +292,33 @@ class StreamingMetrics:
         self.executor_epoch_seconds = r.histogram(
             "stream_executor_epoch_processing_seconds",
             "per-epoch exclusive processing time per executor")
+        self.executor_empty_chunks = r.counter(
+            "stream_executor_empty_chunk_count",
+            "zero-visible-row chunks emitted per (fragment, actor, "
+            "executor) — should stay 0; the spine suppresses empties")
+        # -- chunk compaction + coalescing (stream/coalesce.py) -------
+        self.device_dispatch = r.counter(
+            "stream_device_dispatch_count",
+            "fused device kernel dispatches per executor (each is "
+            "~2ms of host time through the tunnel — the cost "
+            "coalescing amortizes)")
+        self.rows_per_dispatch = r.histogram(
+            "stream_rows_per_device_dispatch",
+            "visible rows carried per device dispatch (dense batches "
+            "amortize the per-dispatch overhead)",
+            buckets=(1.0, 8.0, 32.0, 128.0, 512.0, 2048.0, 8192.0,
+                     32768.0))
+        self.coalesce_chunks_in = r.counter(
+            "stream_coalesce_chunks_in",
+            "chunks entering coalescers (ratio vs _out is the "
+            "amortization factor)")
+        self.coalesce_chunks_out = r.counter(
+            "stream_coalesce_chunks_out",
+            "chunks leaving coalescers after merging")
+        self.compaction_rows_saved = r.counter(
+            "stream_compaction_rows_saved",
+            "padded row slots dropped by chunk compaction (capacity "
+            "that no longer ships over exchanges or the wire)")
         # -- exchange edges (permit.rs back-pressure analog) ----------
         self.exchange_backpressure = r.counter(
             "stream_exchange_backpressure_seconds",

@@ -35,6 +35,17 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.25)
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _strict_empty_chunks():
+    """Assertion mode for the empty-message-suppression invariant: a
+    MonitoredExecutor (i.e. any deployed chain) emitting a
+    zero-visible-row chunk fails the test instead of just counting."""
+    from risingwave_tpu.stream.monitor import set_strict_empty_chunks
+    set_strict_empty_chunks(True)
+    yield
+    set_strict_empty_chunks(False)
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     devs = jax.devices()

@@ -1,0 +1,596 @@
+"""Chunk compaction + adaptive coalescing (stream/coalesce.py).
+
+Covers the ISSUE-3 acceptance points: U-/U+ pair atomicity across
+compaction and coalescer merges, flush-on-barrier (a barrier is never
+delayed behind a lingering batch), coalescing across a remote-exchange
+serde round-trip, dispatcher output compaction + empty suppression,
+exchange credit by true cardinality, and q7 oracle equivalence with
+coalescing on vs off (including the device-dispatch amortization the
+layer exists for).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.common.chunk import Op, StreamChunk
+from risingwave_tpu.common.epoch import Epoch, EpochPair
+from risingwave_tpu.common.types import DataType, Schema
+from risingwave_tpu.stream import (
+    Barrier, BarrierKind, MergeExecutor, Watermark, channel_for_test,
+    is_barrier, is_chunk,
+)
+from risingwave_tpu.stream.coalesce import (
+    ChunkCoalescer, CoalesceExecutor, compact, merge_chunks,
+)
+from risingwave_tpu.stream.dispatch import HashDispatcher, Output
+from risingwave_tpu.stream.executor import ExecutorInfo
+from risingwave_tpu.stream.executors import MockSource
+from risingwave_tpu.stream.executors.test_utils import (
+    collect_until_n_barriers,
+)
+from risingwave_tpu.stream.remote import decode_chunk, encode_chunk
+
+SCHEMA = Schema.of(k=DataType.INT64, v=DataType.INT64)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def barrier(n: int, mutation=None,
+            kind=BarrierKind.CHECKPOINT) -> Barrier:
+    curr, prev = Epoch.from_physical(n), (
+        Epoch.from_physical(n - 1) if n > 1 else Epoch.INVALID)
+    return Barrier(EpochPair(curr, prev), kind, mutation)
+
+
+def chunk(ks, vs, ops=None, capacity=None) -> StreamChunk:
+    return StreamChunk.from_pydict(SCHEMA, {"k": ks, "v": vs}, ops=ops,
+                                   capacity=capacity)
+
+
+# -- compact ---------------------------------------------------------------
+
+
+def test_compact_drops_invisible_rows():
+    c = chunk(list(range(6)), [10 * i for i in range(6)], capacity=64)
+    vis = np.asarray(c.visibility).copy()
+    vis[[1, 3, 4]] = False
+    sparse = c.with_visibility(vis)
+    d = compact(sparse)
+    assert d.dense_rows == 3
+    assert d.capacity == 8            # next pow2 bucket, not 64
+    assert d.to_records() == [(Op.INSERT, (0, 0)), (Op.INSERT, (2, 20)),
+                              (Op.INSERT, (5, 50))]
+
+
+def test_compact_empty_returns_none():
+    c = chunk([1, 2], [1, 2])
+    empty = c.with_visibility(np.zeros(c.capacity, dtype=bool))
+    assert compact(empty) is None
+
+
+def test_compact_dense_prefix_is_identity():
+    c = chunk([1, 2, 3], [1, 2, 3])
+    d = compact(c)
+    assert d is c
+    assert d.dense_rows == 3
+
+
+def test_compact_update_pair_atomicity():
+    # rows: pair A (both visible), pair B (U- visible, U+ masked),
+    # pair C (U- masked, U+ visible)
+    c = chunk([1, 1, 2, 2, 3, 3], [10, 11, 20, 21, 30, 31],
+              ops=[Op.UPDATE_DELETE, Op.UPDATE_INSERT,
+                   Op.UPDATE_DELETE, Op.UPDATE_INSERT,
+                   Op.UPDATE_DELETE, Op.UPDATE_INSERT])
+    vis = np.asarray(c.visibility).copy()
+    vis[3] = False                    # hide B's U+
+    vis[4] = False                    # hide C's U-
+    d = compact(c.with_visibility(vis))
+    assert d.to_records() == [
+        (Op.UPDATE_DELETE, (1, 10)), (Op.UPDATE_INSERT, (1, 11)),
+        (Op.DELETE, (2, 20)),         # degraded: half a pair
+        (Op.INSERT, (3, 31)),         # degraded: half a pair
+    ]
+
+
+def test_compact_pair_straddling_dense_prefix_boundary():
+    """Regression: a dense-prefix chunk in a right-sized bucket whose
+    LAST visible row is a U- with its U+ masked must still degrade —
+    the identity fast path may not skip the boundary check."""
+    c = chunk([1, 1], [10, 11],
+              ops=[Op.UPDATE_DELETE, Op.UPDATE_INSERT])
+    vis = np.asarray(c.visibility).copy()
+    vis[1] = False
+    d = compact(c.with_visibility(vis))
+    assert d.to_records() == [(Op.DELETE, (1, 10))]
+
+
+def test_merge_chunks_preserves_order_and_pairs():
+    a = compact(chunk([1, 1], [10, 11],
+                      ops=[Op.UPDATE_DELETE, Op.UPDATE_INSERT]))
+    b = compact(chunk([2], [20], ops=[Op.DELETE]))
+    m = merge_chunks([a, b])
+    assert m.dense_rows == 3
+    assert m.to_records() == [
+        (Op.UPDATE_DELETE, (1, 10)), (Op.UPDATE_INSERT, (1, 11)),
+        (Op.DELETE, (2, 20))]
+
+
+def test_merge_chunks_null_validity():
+    sch = Schema.of(k=DataType.INT64, s=DataType.VARCHAR)
+    a = compact(StreamChunk.from_pydict(sch, {"k": [1], "s": [None]}))
+    b = compact(StreamChunk.from_pydict(sch, {"k": [2], "s": ["x"]}))
+    m = merge_chunks([a, b])
+    assert m.to_records() == [(Op.INSERT, (1, None)),
+                              (Op.INSERT, (2, "x"))]
+
+
+# -- coalescer -------------------------------------------------------------
+
+
+def test_coalescer_merges_small_chunks_to_target():
+    co = ChunkCoalescer(target_rows=8)
+    out = []
+    for i in range(4):                 # 4 chunks x 3 rows
+        out += co.push(chunk([i] * 3, [i] * 3))
+    # 3+3 <8, 3+3+3 >=8 → one merged chunk after the 3rd push
+    merged = [c for c in out if c is not None]
+    assert len(merged) == 1
+    assert merged[0].dense_rows == 9
+    assert co.buffered_rows == 3       # the 4th chunk lingers
+    tail = co.flush()
+    assert tail.dense_rows == 3
+
+
+def test_coalescer_big_chunk_flushes_older_rows_first():
+    co = ChunkCoalescer(target_rows=100)
+    assert co.push(chunk([1], [1])) == []
+    out = co.push(chunk(list(range(200)), list(range(200))))
+    assert len(out) == 2
+    assert out[0].to_records() == [(Op.INSERT, (1, 1))]   # older first
+    assert out[1].dense_rows == 200
+
+
+def test_coalescer_linger_bound():
+    co = ChunkCoalescer(target_rows=1 << 20, max_chunks=4)
+    out = []
+    for i in range(4):
+        out += co.push(chunk([i], [i]))
+    assert len(out) == 1 and out[0].dense_rows == 4
+
+
+def test_coalescer_drops_empty_chunks():
+    co = ChunkCoalescer(target_rows=8)
+    empty = chunk([1], [1]).with_visibility(np.zeros(8, dtype=bool))
+    assert co.push(empty) == []
+    assert co.flush() is None
+
+
+# -- CoalesceExecutor: flush-on-barrier ordering ---------------------------
+
+
+def test_barrier_never_delayed_behind_lingering_batch():
+    """A barrier must flush the buffer and pass IMMEDIATELY — rows of
+    epoch N precede barrier N, nothing lingers into epoch N+1."""
+    async def go():
+        msgs = [barrier(1),
+                chunk([1], [10]), chunk([2], [20]),    # below target
+                barrier(2),
+                chunk([3], [30]),
+                barrier(3)]
+        co = CoalesceExecutor(MockSource(SCHEMA, msgs),
+                              target_rows=1 << 20)    # never self-flush
+        out = await collect_until_n_barriers(co, 3)
+        kinds = ["B" if is_barrier(m) else "C" for m in out]
+        assert kinds == ["B", "C", "B", "C", "B"]
+        # epoch-2 rows merged into ONE dense chunk, before barrier 2
+        assert out[1].dense_rows == 2
+        assert out[1].to_records() == [(Op.INSERT, (1, 10)),
+                                       (Op.INSERT, (2, 20))]
+        assert out[3].to_records() == [(Op.INSERT, (3, 30))]
+    run(go())
+
+
+def test_watermark_resequences_to_flush_never_past_barrier():
+    """A watermark amid buffered rows re-sequences to the flush point
+    (monotone bound: later rows already satisfy it) — it is emitted
+    after the merged batch and ALWAYS before the next barrier."""
+    async def go():
+        msgs = [barrier(1), chunk([1], [10]),
+                Watermark(0, DataType.INT64, 42),
+                chunk([2], [20]), barrier(2)]
+        co = CoalesceExecutor(MockSource(SCHEMA, msgs),
+                              target_rows=1 << 20)
+        out = await collect_until_n_barriers(co, 2)
+        types = [type(m).__name__ for m in out]
+        assert types == ["Barrier", "StreamChunk", "Watermark",
+                         "Barrier"]
+        # both rows in one merged batch, then the held watermark
+        assert out[1].to_records() == [(Op.INSERT, (1, 10)),
+                                       (Op.INSERT, (2, 20))]
+        assert out[2].value == 42
+    run(go())
+
+
+def test_watermark_passes_through_when_buffer_empty():
+    async def go():
+        msgs = [barrier(1), Watermark(0, DataType.INT64, 7),
+                chunk([1], [10]), barrier(2)]
+        co = CoalesceExecutor(MockSource(SCHEMA, msgs),
+                              target_rows=1 << 20)
+        out = await collect_until_n_barriers(co, 2)
+        types = [type(m).__name__ for m in out]
+        assert types == ["Barrier", "Watermark", "StreamChunk",
+                         "Barrier"]
+    run(go())
+
+
+def test_held_watermarks_keep_only_latest_per_column():
+    async def go():
+        msgs = [barrier(1), chunk([1], [10]),
+                Watermark(0, DataType.INT64, 5),
+                chunk([2], [20]),
+                Watermark(0, DataType.INT64, 9),
+                barrier(2)]
+        co = CoalesceExecutor(MockSource(SCHEMA, msgs),
+                              target_rows=1 << 20)
+        out = await collect_until_n_barriers(co, 2)
+        wms = [m.value for m in out if isinstance(m, Watermark)]
+        assert wms == [9]               # monotone: newest subsumes
+    run(go())
+
+
+def test_coalescer_pair_atomicity_across_merges():
+    """Pairs never split across coalescer output chunks: merging is
+    whole-chunk only, so a surviving pair stays adjacent."""
+    async def go():
+        msgs = [barrier(1),
+                chunk([1, 1], [10, 11],
+                      ops=[Op.UPDATE_DELETE, Op.UPDATE_INSERT]),
+                chunk([2, 2], [20, 21],
+                      ops=[Op.UPDATE_DELETE, Op.UPDATE_INSERT]),
+                barrier(2)]
+        co = CoalesceExecutor(MockSource(SCHEMA, msgs), target_rows=4)
+        out = await collect_until_n_barriers(co, 2)
+        chunks = [m for m in out if is_chunk(m)]
+        recs = [r for c in chunks for r in c.to_records()]
+        assert recs == [
+            (Op.UPDATE_DELETE, (1, 10)), (Op.UPDATE_INSERT, (1, 11)),
+            (Op.UPDATE_DELETE, (2, 20)), (Op.UPDATE_INSERT, (2, 21))]
+        for c in chunks:               # each pair intact within a chunk
+            ops = [op for op, _ in c.to_records()]
+            for i, op in enumerate(ops):
+                if op == Op.UPDATE_DELETE:
+                    assert ops[i + 1] == Op.UPDATE_INSERT
+    run(go())
+
+
+# -- MergeExecutor coalescing ---------------------------------------------
+
+
+def test_merge_executor_coalesces_between_barriers():
+    async def go():
+        tx1, rx1 = channel_for_test()
+        tx2, rx2 = channel_for_test()
+        merge = MergeExecutor(ExecutorInfo(SCHEMA, [], "Merge"),
+                              [rx1, rx2], coalesce_rows=1 << 20)
+
+        async def feed():
+            await tx1.send(chunk([1], [1]))
+            await tx2.send(chunk([2], [2]))
+            await tx1.send(barrier(1))
+            await tx2.send(barrier(1))
+            tx1.close()
+            tx2.close()
+
+        feeder = asyncio.ensure_future(feed())
+        out = await collect_until_n_barriers(merge, 1)
+        await feeder
+        kinds = ["B" if is_barrier(m) else "C" for m in out]
+        assert kinds == ["C", "B"]       # both rows in ONE dense chunk
+        assert out[0].dense_rows == 2
+        assert sorted(r for _op, r in out[0].to_records()) == \
+            [(1, 1), (2, 2)]
+    run(go())
+
+
+# -- wire path -------------------------------------------------------------
+
+
+def test_encode_chunk_compacts_sparse_chunks():
+    c = chunk(list(range(8)), list(range(8)), capacity=256)
+    vis = np.asarray(c.visibility).copy()
+    vis[2:] = False                     # 2 visible of 256 capacity
+    sparse = c.with_visibility(vis)
+    data = encode_chunk(sparse)
+    full = encode_chunk(chunk(list(range(256)), list(range(256))))
+    assert len(data) < len(full) / 8    # wire shrinks with the rows
+    d = decode_chunk(data, SCHEMA)
+    assert d.capacity == 8              # wire carries the pow2 bucket
+    assert d.to_records() == [(Op.INSERT, (0, 0)), (Op.INSERT, (1, 1))]
+
+
+def test_remote_roundtrip_of_coalesced_chunk():
+    co = ChunkCoalescer(target_rows=4)
+    outs = co.push(chunk([1, 1], [10, 11],
+                         ops=[Op.UPDATE_DELETE, Op.UPDATE_INSERT]))
+    outs += co.push(chunk([2], [20], ops=[Op.DELETE]))
+    outs += [co.flush()]
+    merged = [c for c in outs if c is not None]
+    assert len(merged) == 1
+    d = decode_chunk(encode_chunk(merged[0]), SCHEMA)
+    assert d.to_records() == merged[0].to_records()
+
+
+# -- dispatcher compaction + suppression ----------------------------------
+
+
+def test_hash_dispatch_slices_arrive_compacted():
+    async def go():
+        chans = [channel_for_test() for _ in range(4)]
+        outputs = [Output(i, tx) for i, (tx, _) in enumerate(chans)]
+        disp = HashDispatcher(outputs, dist_key_indices=[0])
+        ks = list(range(64))
+        c = chunk(ks, [i * 10 for i in ks], capacity=1024)
+        await disp.dispatch_data(c)
+        total = 0
+        seen = {}
+        for i, (_tx, rx) in enumerate(chans):
+            sub = await rx.recv()
+            # every slice is DENSE: known cardinality, pow2 capacity,
+            # full-prefix visibility
+            assert sub.dense_rows == sub.cardinality() > 0
+            assert sub.capacity < 1024
+            total += sub.dense_rows
+            for _, (k, _v) in sub.to_records():
+                assert seen.setdefault(k, i) == i
+        assert total == 64
+    run(go())
+
+
+def test_hash_dispatch_suppresses_empty_slices():
+    async def go():
+        chans = [channel_for_test() for _ in range(2)]
+        outputs = [Output(i, tx) for i, (tx, _) in enumerate(chans)]
+        disp = HashDispatcher(outputs, dist_key_indices=[0])
+        # ALL rows route to one output: pick keys owned by output 0
+        probe = chunk(list(range(32)), [0] * 32)
+        owner = disp._route(probe)
+        mine = [k for k in range(32) if owner[k] == 0][:4]
+        await disp.dispatch_data(chunk(mine, [1] * len(mine)))
+        got = await chans[0][1].recv()
+        assert got.dense_rows == len(mine)
+        # output 1 received NOTHING (not an empty chunk)
+        assert chans[1][1].try_recv() is None
+    run(go())
+
+
+def test_exchange_credit_charges_true_cardinality():
+    """A compacted 4-row chunk costs 4 permits, not its capacity."""
+    async def go():
+        from risingwave_tpu.stream.exchange import channel
+        tx, rx = channel(chunk_permits=16, barrier_permits=2,
+                         max_chunk_cost=8)
+        dense = compact(chunk([1, 2], [1, 2], capacity=64)
+                        .with_visibility(
+                            np.r_[np.ones(2, bool),
+                                  np.zeros(62, bool)]))
+        assert dense.dense_rows == 2
+        # capacity-costed this would be 8 each (max_chunk_cost) and
+        # block after 2 sends; true-cardinality costing fits 8 of them
+        for _ in range(8):
+            await asyncio.wait_for(tx.send(dense), 1.0)
+        blocked = asyncio.ensure_future(tx.send(dense))
+        await asyncio.sleep(0.01)
+        assert not blocked.done()
+        await rx.recv()
+        await asyncio.wait_for(blocked, 1.0)
+    run(go())
+
+
+# -- monitor strict mode ---------------------------------------------------
+
+
+def test_monitored_executor_rejects_empty_emission_in_strict_mode():
+    from risingwave_tpu.stream.monitor import MonitoredExecutor
+
+    async def go():
+        empty = chunk([1], [1]).with_visibility(
+            np.zeros(8, dtype=bool))
+        src = MockSource(SCHEMA, [barrier(1), empty, barrier(2)])
+        mon = MonitoredExecutor(src, "t", 1, 0)
+        with pytest.raises(AssertionError):
+            await collect_until_n_barriers(mon, 2)
+    run(go())
+
+
+# -- oracle equivalence: q7 with coalescing on vs off ----------------------
+
+
+def _run_q7(coalesce_rows):
+    from risingwave_tpu.common.types import Interval
+    from risingwave_tpu.connectors.nexmark import NexmarkConfig
+    from risingwave_tpu.models.nexmark import (
+        build_q7, drive_to_completion,
+    )
+    from risingwave_tpu.state.store import MemoryStateStore
+    from risingwave_tpu.utils.metrics import STREAMING
+
+    cfg = NexmarkConfig(event_num=4000, max_chunk_size=128,
+                        generate_strings=False)
+    p = build_q7(MemoryStateStore(), cfg, rate_limit=8, min_chunks=8,
+                 watermark_delay=Interval(usecs=0),
+                 coalesce_rows=coalesce_rows)
+    before = sum(v for _l, v in STREAMING.device_dispatch.series())
+    asyncio.run(drive_to_completion(p, {1: 4000 * 46 // 50},
+                                    in_flight=1))
+    after = sum(v for _l, v in STREAMING.device_dispatch.series())
+    rows = sorted(tuple(r) for _pk, r in p.mv_table.iter_rows())
+    return rows, after - before
+
+
+def test_q7_oracle_identical_with_coalescing_on_vs_off():
+    rows_off, disp_off = _run_q7(None)
+    rows_on, disp_on = _run_q7(2048)
+    assert rows_on == rows_off          # bit-identical MV state
+    # the whole point: materially fewer device dispatches (128-row
+    # source chunks coalesce toward 2048-row batches)
+    assert disp_on < disp_off, (disp_on, disp_off)
+    assert disp_on <= disp_off * 0.75, (disp_on, disp_off)
+
+
+# -- oracle equivalence: q4 through the SQL front door ---------------------
+
+
+def _run_q4(target_rows):
+    from risingwave_tpu.frontend.session import Frontend
+
+    async def go():
+        fe = Frontend(rate_limit=16, min_chunks=16)
+        await fe.execute(
+            f"SET stream_chunk_target_rows = {target_rows}")
+        for t in ("auction", "bid"):
+            await fe.execute(
+                f"CREATE SOURCE {t} WITH (connector='nexmark', "
+                f"nexmark.table.type='{t}', nexmark.event.num=2000, "
+                f"nexmark.max.chunk.size=128, "
+                f"nexmark.generate.strings='false')")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW q4 AS "
+            "SELECT category, AVG(final) AS avg_final FROM ("
+            "  SELECT a.category AS category, MAX(b.price) AS final"
+            "  FROM auction AS a JOIN bid AS b ON a.id = b.auction"
+            "  WHERE b.date_time BETWEEN a.date_time AND a.expires"
+            "  GROUP BY a.id, a.category) AS q "
+            "GROUP BY category")
+        await fe.step(8)
+        rows = await fe.execute("SELECT * FROM q4")
+        await fe.close()
+        return sorted(rows)
+
+    return asyncio.run(go())
+
+
+def test_q4_oracle_identical_with_coalescing_on_vs_off():
+    rows_off = _run_q4(0)               # coalescing disabled
+    rows_on = _run_q4(4096)             # default-on path
+    assert rows_on == rows_off
+    assert rows_on, "q4 must produce output at this scale"
+
+
+# -- knob plumbing: distributed path --------------------------------------
+
+
+def test_fragmenter_cut_edges_carry_coalesce_knob():
+    from risingwave_tpu.frontend.fragmenter import Fragmenter
+    from risingwave_tpu.stream.coalesce import DEFAULT_TARGET_ROWS
+
+    f_off = Fragmenter(2, merge_coalesce_rows=0)
+    f_off._new_fragment(1)
+    fi, _ni = f_off._cut(0, [0], SCHEMA, 2)
+    assert f_off.graph.fragments[fi].inputs[0].coalesce_rows == 0
+
+    f_on = Fragmenter(2)                 # session default rides along
+    f_on._new_fragment(1)
+    fi, _ni = f_on._cut(0, [0], SCHEMA, 2)
+    assert f_on.graph.fragments[fi].inputs[0].coalesce_rows == \
+        DEFAULT_TARGET_ROWS
+
+
+def test_dist_frontend_accepts_coalesce_session_vars():
+    import tempfile
+
+    from risingwave_tpu.cluster.session import DistFrontend
+
+    async def go():
+        with tempfile.TemporaryDirectory() as root:
+            fe = DistFrontend(root)      # no cluster start needed
+            assert await fe.execute(
+                "SET stream_chunk_target_rows = 0") == "SET"
+            assert await fe.execute(
+                "SHOW stream_chunk_target_rows") == [("0",)]
+            assert fe.chunk_target_rows == 0
+    run(go())
+
+
+def test_merge_executor_resequences_watermarks():
+    """Aligned watermarks must not force a fan-in flush (a
+    watermark-per-chunk upstream would otherwise re-fragment every
+    batch); they re-sequence to the flush and precede the barrier."""
+    async def go():
+        tx1, rx1 = channel_for_test()
+        tx2, rx2 = channel_for_test()
+        merge = MergeExecutor(ExecutorInfo(SCHEMA, [], "Merge"),
+                              [rx1, rx2], coalesce_rows=1 << 20)
+
+        async def feed():
+            await tx1.send(chunk([1], [1]))
+            await tx1.send(Watermark(0, DataType.INT64, 50))
+            await tx2.send(chunk([2], [2]))
+            await tx2.send(Watermark(0, DataType.INT64, 60))
+            await tx1.send(barrier(1))
+            await tx2.send(barrier(1))
+            tx1.close()
+            tx2.close()
+
+        feeder = asyncio.ensure_future(feed())
+        out = await collect_until_n_barriers(merge, 1)
+        await feeder
+        types = [type(m).__name__ for m in out]
+        # ONE merged chunk, then the aligned (min) watermark, then
+        # the barrier — no per-watermark flush fragmentation
+        assert types == ["StreamChunk", "Watermark", "Barrier"], types
+        assert out[0].dense_rows == 2
+        assert out[1].value == 50
+    run(go())
+
+
+def test_merge_never_leaks_pre_barrier_data_past_the_barrier():
+    """Regression (found while wiring coalescing): messages still in
+    the merge queue when the last input parks must drain BEFORE the
+    aligned barrier — with or without coalescing."""
+    async def go():
+        for rows in (None, 1 << 20):     # un-coalesced and coalesced
+            tx1, rx1 = channel_for_test()
+            tx2, rx2 = channel_for_test()
+            merge = MergeExecutor(ExecutorInfo(SCHEMA, [], "Merge"),
+                                  [rx1, rx2], coalesce_rows=rows)
+            # burst everything before the consumer runs at all
+            for k in range(5):
+                await tx1.send(chunk([k], [k]))
+                await tx2.send(chunk([10 + k], [k]))
+            await tx1.send(barrier(1))
+            await tx2.send(barrier(1))
+            tx1.close()
+            tx2.close()
+            out = await collect_until_n_barriers(merge, 1)
+            data = [r for m in out if is_chunk(m)
+                    for _op, r in m.to_records()]
+            assert is_barrier(out[-1])
+            assert len(data) == 10, (rows, data)
+    run(go())
+
+
+def test_coalesce_executor_flushes_on_end_of_stream():
+    """A bounded upstream that ends without a trailing barrier must
+    not lose the lingering buffer."""
+    async def go():
+        msgs = [barrier(1), chunk([1], [10]), chunk([2], [20])]
+        co = CoalesceExecutor(MockSource(SCHEMA, msgs),
+                              target_rows=1 << 20)
+        out = [m async for m in co.execute()]
+        chunks = [m for m in out if is_chunk(m)]
+        assert len(chunks) == 1 and chunks[0].dense_rows == 2
+    run(go())
+
+
+def test_encode_zero_visible_chunk_is_tiny():
+    big = chunk(list(range(100)), list(range(100)), capacity=4096)
+    empty = big.with_visibility(np.zeros(4096, dtype=bool))
+    data = encode_chunk(empty)
+    d = decode_chunk(data, SCHEMA)
+    assert d.capacity == 8 and d.to_records() == []
