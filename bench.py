@@ -39,10 +39,20 @@ def _metrics_snapshot(loop) -> dict:
                     STREAMING.coalesce_chunks_in.series()))
     co_out = int(sum(v for _l, v in
                      STREAMING.coalesce_chunks_out.series()))
+    rewrites = int(sum(v for _l, v in
+                       STREAMING.rewrite_rule_fired.series()))
     return {
         "device_dispatches": dispatches,
         "rows_per_dispatch_avg": round(disp_rows / dispatches, 1)
         if dispatches else 0.0,
+        # plan-rewrite engine (frontend/opt): what the optimizer did
+        # to this run's plans, next to what the run then measured
+        "rewrite_rules_fired": rewrites,
+        "plan_columns_pruned": int(sum(
+            v for _l, v in STREAMING.plan_columns_pruned.series())),
+        "plan_exchanges_elided": int(sum(
+            v for _l, v in
+            STREAMING.plan_exchanges_elided.series())),
         "coalesce_chunks_in": co_in,
         "coalesce_chunks_out": co_out,
         "compaction_rows_saved": int(sum(
@@ -67,8 +77,8 @@ def _metrics_snapshot(loop) -> dict:
     }
 
 
-def _result(metric, elapsed, rows, loop):
-    return {
+def _result(metric, elapsed, rows, loop, plan=None):
+    out = {
         "metric": metric,
         "value": round(rows / elapsed, 1),
         "unit": "events/s",
@@ -79,6 +89,30 @@ def _result(metric, elapsed, rows, loop):
         "events": rows,
         "observability": _metrics_snapshot(loop),
     }
+    if plan is not None:
+        out["plan"] = plan
+    return out
+
+
+def _session_plan_stats(fe) -> dict:
+    """Deployed-plan stats of a Frontend session: executor count and
+    carried lane widths summed over every live actor chain (the
+    rewrite engine's narrowing shows up here, next to events/sec)."""
+    from risingwave_tpu.frontend.opt import plan_lane_stats
+    agg = {"executors": 0, "total_lanes": 0, "max_lane_width": 0}
+    for actor in fe.actors.values():
+        s = plan_lane_stats(actor.consumer)
+        agg["executors"] += s["executors"]
+        agg["total_lanes"] += s["total_lanes"]
+        agg["max_lane_width"] = max(agg["max_lane_width"],
+                                    s["max_lane_width"])
+    agg["avg_lane_width"] = round(
+        agg["total_lanes"] / agg["executors"], 2) \
+        if agg["executors"] else 0.0
+    # in-process exchange hops = MV-on-MV chain edges (distributed
+    # graphs report theirs via DistFrontend.last_plan_stats)
+    agg["exchange_hops"] = sum(len(v) for v in fe.chain_edges.values())
+    return agg
 
 
 def bench_q1(total_events: int = 50 * 4000, chunk_size: int = 4096):
@@ -236,13 +270,15 @@ def bench_q4(total_events: int = 50 * 4000, chunk_size: int = 4096):
             "  GROUP BY a.id, a.category) AS q "
             "GROUP BY category")
         expected = total_events * 3 // 50 + total_events * 46 // 50
+        plan = _session_plan_stats(fe)
         elapsed, rows = await _drive_frontend(fe, expected, IN_FLIGHT)
         stats = fe.loop
         await fe.close()
-        return elapsed, rows, stats
+        return elapsed, rows, stats, plan
 
-    elapsed, rows, loop = asyncio.run(run())
-    return _result("nexmark_q4_events_per_sec", elapsed, rows, loop)
+    elapsed, rows, loop, plan = asyncio.run(run())
+    return _result("nexmark_q4_events_per_sec", elapsed, rows, loop,
+                   plan=plan)
 
 
 def _adctr_produce(path: str, n_impressions: int, n_ads: int = 100):
@@ -304,15 +340,17 @@ def bench_adctr(n_impressions: int = 200_000, parallelism: int = 4):
         # ad_dim consumes impressions too: expected totals count every
         # reader the session drives
         expected = 2 * n_impressions + (n_impressions + 2) // 3
+        plan = _session_plan_stats(fe)
         elapsed, rows = await _drive_frontend(fe, expected, IN_FLIGHT)
         stats = fe.loop
         await fe.close()
-        return elapsed, rows, stats
+        return elapsed, rows, stats, plan
 
     with tempfile.TemporaryDirectory() as path:
         _adctr_produce(path, n_impressions)
-        elapsed, rows, loop = asyncio.run(run(path))
-    r = _result("adctr_events_per_sec", elapsed, rows, loop)
+        elapsed, rows, loop, plan = asyncio.run(run(path))
+    r = _result("adctr_events_per_sec", elapsed, rows, loop,
+                plan=plan)
     import jax
     r["parallelism"] = min(parallelism, len(jax.devices()))
     return r

@@ -28,6 +28,7 @@ from risingwave_tpu.frontend.fragmenter import Fragment, FragmentGraph
 from risingwave_tpu.meta.barrier import BarrierLoop
 from risingwave_tpu.stream.actor import LocalBarrierManager
 from risingwave_tpu.stream.message import StopMutation
+from risingwave_tpu.stream.plan_ir import remap_node_refs
 
 _PSEUDO_BASE = 1 << 20          # pseudo-actor ids for worker handles
 
@@ -173,10 +174,7 @@ class Cluster:
                                 DEFAULT_MAX_CHUNKS))})
                 remap[idx] = len(out) - 1
                 continue
-            n2 = dict(node)
-            for key in ("input", "left", "right"):
-                if isinstance(n2.get(key), int):
-                    n2[key] = remap[n2[key]]
+            n2 = remap_node_refs(node, remap)
             if n2["op"] == "source":
                 n2["actor_id"] = actor_id
             out.append(n2)

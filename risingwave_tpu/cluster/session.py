@@ -88,7 +88,10 @@ class DistFrontend:
         # definition (distributed MV-on-MV by view expansion)
         self._mv_selects = {}
         # session vars (shared impl with the in-process session —
-        # session_vars.py; parallelism is the distributed knob)
+        # session_vars.py; parallelism is the distributed knob).
+        # stream_rewrite_rules rides the same surface as
+        # stream_chunk_target_rows: SET here, honored at CREATE time
+        from risingwave_tpu.frontend.opt import parse_rules
         from risingwave_tpu.frontend.session_vars import SessionVars
         self.session_vars = SessionVars(
             self, {"streaming_rate_limit": "rate_limit",
@@ -96,7 +99,13 @@ class DistFrontend:
                    "parallelism": "parallelism",
                    "stream_chunk_target_rows": "chunk_target_rows",
                    "stream_coalesce_linger_chunks":
-                       "coalesce_linger_chunks"})
+                       "coalesce_linger_chunks"},
+            {"stream_rewrite_rules": "all"},
+            validators={"stream_rewrite_rules": parse_rules})
+        # fragment-graph stats of the last deployed job (exchange
+        # hops, exchanged lane widths) — bench + tests read this to
+        # see what the rewrite engine bought
+        self.last_plan_stats: Optional[dict] = None
         # serializes barrier rounds between DDL, step(), SELECT
         # snapshots and the background heartbeat (inject_and_collect
         # is not reentrant; a heartbeat between per-table scans would
@@ -171,7 +180,7 @@ class DistFrontend:
             return [(n,) for n, m in sorted(self.catalog.mvs.items())
                     if not m.is_table]
         if isinstance(stmt, ast.Explain):
-            from risingwave_tpu.frontend.planner import explain_tree
+            from risingwave_tpu.frontend.opt import explain_with_rewrite
             planner = StreamPlanner(
                 self.catalog, MemoryStateStore(),
                 LocalBarrierManager(), definition="", mesh=None,
@@ -182,7 +191,9 @@ class DistFrontend:
             plan = planner.plan("__explain__", stmt.select, actor_id=0,
                                 rate_limit=self.rate_limit,
                                 min_chunks=self.min_chunks)
-            return [(line,) for line in explain_tree(plan.consumer)]
+            return explain_with_rewrite(
+                plan.consumer,
+                self.session_vars.get("stream_rewrite_rules"))
         if isinstance(stmt, ast.AlterParallelism):
             return await self._alter_parallelism(stmt)
         if isinstance(stmt, ast.Flush):
@@ -212,6 +223,12 @@ class DistFrontend:
         plan = planner.plan(stmt.name, stmt.select, actor_id=0,
                             rate_limit=self.rate_limit,
                             min_chunks=self.min_chunks)
+        # executor-graph rewrite before lowering (same engine as the
+        # in-process session); the fragment-graph pass below then
+        # elides exchanges on the shipped plan IR
+        from risingwave_tpu.frontend.opt import apply_rewrites
+        rules = self.session_vars.get("stream_rewrite_rules")
+        apply_rewrites(plan, rules, label=stmt.name)
         if plan.attaches:
             # every FROM <mv> should have inlined (the dict holds all
             # session-created views); a chain attach here means a
@@ -225,6 +242,12 @@ class DistFrontend:
             merge_coalesce_rows=self.chunk_target_rows,
             merge_coalesce_chunks=self.coalesce_linger_chunks
         ).lower(plan.consumer)
+        from risingwave_tpu.frontend.opt import (
+            fragment_plan_stats, rewrite_fragment_graph,
+        )
+        graph, _elided = rewrite_fragment_graph(graph, rules,
+                                                label=stmt.name)
+        self.last_plan_stats = fragment_plan_stats(graph)
         async with self._barrier_lock:
             await self.cluster.deploy_graph(stmt.name, graph)
             await self.cluster.step(1)     # activation barrier

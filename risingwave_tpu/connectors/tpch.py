@@ -1,4 +1,5 @@
-"""TPC-H table generators (streaming form): customer/orders/lineitem.
+"""TPC-H table generators (streaming form): customer/orders/lineitem
+plus the q5 dimension tables supplier/nation/region.
 
 Reference parity: the role of the TPC-H corpus the reference streams in
 e2e_test/streaming/tpch/ (tables loaded as append-only streams). The
@@ -46,13 +47,47 @@ LINEITEM_SCHEMA = Schema([
     Field("l_linestatus", DataType.VARCHAR),
 ])
 
+SUPPLIER_SCHEMA = Schema([
+    Field("s_suppkey", DataType.INT64),
+    Field("s_name", DataType.VARCHAR),
+    Field("s_nationkey", DataType.INT64),
+])
+
+NATION_SCHEMA = Schema([
+    Field("n_nationkey", DataType.INT64),
+    Field("n_name", DataType.VARCHAR),
+    Field("n_regionkey", DataType.INT64),
+])
+
+REGION_SCHEMA = Schema([
+    Field("r_regionkey", DataType.INT64),
+    Field("r_name", DataType.VARCHAR),
+])
+
 _RETURNFLAGS = np.array(["R", "A", "N"], dtype=object)
 _LINESTATUS = np.array(["O", "F"], dtype=object)
+
+# the 25 nations / 5 regions of the TPC-H spec (nation → region)
+NATION_NAMES = np.array([
+    "ALGERIA", "ARGENTINA", "BRAZIL", "CANADA", "EGYPT", "ETHIOPIA",
+    "FRANCE", "GERMANY", "INDIA", "INDONESIA", "IRAN", "IRAQ",
+    "JAPAN", "JORDAN", "KENYA", "MOROCCO", "MOZAMBIQUE", "PERU",
+    "CHINA", "ROMANIA", "SAUDI ARABIA", "VIETNAM", "RUSSIA",
+    "UNITED KINGDOM", "UNITED STATES"], dtype=object)
+NATION_REGIONS = np.array([0, 1, 1, 1, 4, 0, 3, 3, 2, 2, 4, 4, 2, 4,
+                           0, 0, 0, 1, 2, 3, 4, 2, 3, 3, 1],
+                          dtype=np.int64)
+REGION_NAMES = np.array(["AFRICA", "AMERICA", "ASIA", "EUROPE",
+                         "MIDDLE EAST"], dtype=object)
+SUPPLIERS = 100                     # matches l_suppkey ∈ 1..100
 
 TABLE_SCHEMAS = {
     "customer": CUSTOMER_SCHEMA,
     "orders": ORDERS_SCHEMA,
     "lineitem": LINEITEM_SCHEMA,
+    "supplier": SUPPLIER_SCHEMA,
+    "nation": NATION_SCHEMA,
+    "region": REGION_SCHEMA,
 }
 
 SEGMENTS = np.array(["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY",
@@ -81,6 +116,12 @@ class TpchConfig:
             return self.customers
         if self.table == "orders":
             return self.orders
+        if self.table == "supplier":
+            return SUPPLIERS
+        if self.table == "nation":
+            return len(NATION_NAMES)
+        if self.table == "region":
+            return len(REGION_NAMES)
         return self.orders * LINES_PER_ORDER
 
 
@@ -141,8 +182,33 @@ def gen_lineitem(k: np.ndarray, cfg: TpchConfig) -> Dict[str, np.ndarray]:
     }
 
 
+def gen_supplier(k: np.ndarray, cfg: TpchConfig) -> Dict[str, np.ndarray]:
+    return {
+        "s_suppkey": k + 1,
+        "s_name": np.array([f"Supplier#{i + 1:09d}" for i in k.tolist()],
+                           dtype=object),
+        "s_nationkey": (_mix(k, cfg.seed + 13) % 25).astype(np.int64),
+    }
+
+
+def gen_nation(k: np.ndarray, cfg: TpchConfig) -> Dict[str, np.ndarray]:
+    return {
+        "n_nationkey": k.astype(np.int64),
+        "n_name": NATION_NAMES[k],
+        "n_regionkey": NATION_REGIONS[k],
+    }
+
+
+def gen_region(k: np.ndarray, cfg: TpchConfig) -> Dict[str, np.ndarray]:
+    return {
+        "r_regionkey": k.astype(np.int64),
+        "r_name": REGION_NAMES[k],
+    }
+
+
 _GENERATORS = {"customer": gen_customer, "orders": gen_orders,
-               "lineitem": gen_lineitem}
+               "lineitem": gen_lineitem, "supplier": gen_supplier,
+               "nation": gen_nation, "region": gen_region}
 
 
 class TpchSplitReader:

@@ -16,6 +16,12 @@ The cut carries `keys` in the UPSTREAM fragment's output schema; the
 scheduler (cluster/scheduler.py) turns each cut edge into a
 HashDispatcher on the upstream actors and remote_input+merge nodes on
 the downstream actors.
+
+Cuts are not final: the plan-rewrite engine's exchange-elision pass
+(frontend/opt/fragment_rules.py) runs over this graph before
+scheduling and fuses adjacent fragments whose distribution already
+satisfies the consumer's keys — the fragmenter cuts wherever the
+reference would, the rewrite removes the cuts that prove redundant.
 """
 
 from __future__ import annotations

@@ -9,8 +9,9 @@ not yet needed (single-fragment plans; the dispatch layer exists under
 stream/ for when the fragmenter lands).
 
 Supported streaming shapes: MV over one source (optionally TUMBLE) or
-over another MV (backfill chain), WHERE with per-conjunct predicate
-pushdown below joins (gated by join kind), multi-way left-deep
+over another MV (backfill chain), WHERE conjuncts as filters over the
+join chain (the frontend/opt filter_pushdown rule sinks them below
+joins, gated by join kind), multi-way left-deep
 INNER/LEFT/RIGHT/FULL joins of sources on equi-keys, GROUP BY with
 count/sum/min/max/avg (+DISTINCT) over arbitrary expressions, ORDER
 BY/LIMIT TopN, EXPLAIN. Batch: scan/filter/project/agg/join/order/
@@ -516,24 +517,16 @@ class StreamPlanner:
         conjuncts = _flatten_and(sel.where) if sel.where is not None \
             else []
         if sel.joins:
-            # Optimizer v0 (logical_optimization.rs:476 pushdown +
-            # multi-way planning, collapsed): a left-deep chain of
-            # HashJoins in syntax order, with WHERE conjuncts pushed to
-            # the lowest side whose scope binds them — below the first
-            # join when possible, else right after the join that first
-            # covers their columns. Append-only sides get a generated
-            # row id; pk-keyed sides (MV chains, derived tables with
-            # GROUP BY) keep their pk so retractions replay into join
-            # state consistently (the delta-join-over-arrangement
-            # stance, lookup.rs:42).
+            # Optimizer v0 (multi-way planning, collapsed): a
+            # left-deep chain of HashJoins in syntax order. WHERE
+            # conjuncts bind AFTER the chain against the full scope
+            # (ambiguous unqualified columns raise properly — ADVICE
+            # r3) and land as filters ABOVE the joins; the
+            # filter_pushdown rewrite rule (frontend/opt/rules.py, the
+            # former inline pushdown) then sinks each one below every
+            # side its join never null-pads.
             left, lscope = self._joinable(ex, scope)
-            # build every right chain up front so the FULL scope exists
-            # before any pushdown decision: a conjunct whose unqualified
-            # column lives on both sides must raise 'ambiguous', not
-            # silently bind to whichever partial scope sees it first
-            # (ADVICE r3)
             rights = []
-            full_scope = lscope
             for jn in sel.joins:
                 rex, rscope, rdeps = self._base_chain(
                     jn.item, rate_limit, min_chunks)
@@ -552,20 +545,13 @@ class StreamPlanner:
                             "temporal join supports INNER and LEFT "
                             "only")
                     rights.append((jn, rex, rscope))
-                    full_scope = full_scope.concat(rscope)
                     continue
                 right, rscope = self._joinable(rex, rscope)
                 rights.append((jn, right, rscope))
-                full_scope = full_scope.concat(rscope)
             for jn, right, rscope in rights:
                 if getattr(jn, "temporal", False):
                     from risingwave_tpu.stream.executors.temporal_join \
                         import TemporalJoinExecutor
-                    # left-side pushdown is legal (INNER/LEFT never
-                    # null-pad the left): filter before the probe loop
-                    left, conjuncts = _push_filters(left, lscope,
-                                                    conjuncts,
-                                                    full_scope)
                     lkeys, rkeys = _equi_keys(jn.on, lscope, rscope)
                     if sorted(rkeys) != sorted(right.pk_indices):
                         raise PlanError(
@@ -581,17 +567,6 @@ class StreamPlanner:
                         actor_id=actor_id)
                     lscope = lscope.concat(rscope)
                     continue
-                # pushdown legality by join kind: a conjunct may move
-                # below a side only if that side is NOT null-padded by
-                # this join (else filter-after-join semantics change)
-                if jn.kind in ("inner", "left"):
-                    left, conjuncts = _push_filters(left, lscope,
-                                                    conjuncts,
-                                                    full_scope)
-                if jn.kind in ("inner", "right"):
-                    right, conjuncts = _push_filters(right, rscope,
-                                                     conjuncts,
-                                                     full_scope)
                 lkeys, rkeys = _equi_keys(jn.on, lscope, rscope)
                 jt = {"inner": JoinType.INNER,
                       "left": JoinType.LEFT_OUTER,
@@ -1141,29 +1116,6 @@ def _flatten_and(e: ast.Expr) -> List[ast.Expr]:
     return [e]
 
 
-def _push_filters(ex: Executor, scope: Scope,
-                  conjuncts: List[ast.Expr],
-                  full_scope: Optional[Scope] = None
-                  ) -> Tuple[Executor, List[ast.Expr]]:
-    """Apply every conjunct bindable in `scope` as a filter on `ex`;
-    return the rest (predicate pushdown, rule/ pushdown analog).
-
-    A conjunct is pushed only if it ALSO binds in `full_scope`
-    (ADVICE r3): an unqualified column present on both join sides binds
-    fine against the partial scope but is ambiguous in the full query —
-    leaving it unpushed lets the post-join bind raise the proper error,
-    so pushdown never changes which queries are rejected."""
-    rest: List[ast.Expr] = []
-    for c in conjuncts:
-        try:
-            pred = Binder(scope).bind(c)
-            if full_scope is not None:
-                Binder(full_scope).bind(c)
-        except BindError:
-            rest.append(c)
-            continue
-        ex = FilterExecutor(ex, pred)
-    return ex, rest
 
 
 def explain_tree(ex, indent: int = 0) -> List[str]:
@@ -1294,6 +1246,16 @@ def _system_catalog_rows(name: str, catalog: Catalog, profiler=None):
                       Field("queue_depth", DataType.INT64)])
         rows = list(profiler.rows()) if profiler is not None else []
         return sch, rows
+    if n == "rw_plan_rewrites":
+        # plan-rewrite firing log (frontend/opt engine): one row per
+        # (job, rule) application, FALLBACK rows record checker trips
+        from risingwave_tpu.frontend.opt import rewrite_history_rows
+        sch = Schema([Field("seq", DataType.INT64),
+                      Field("job", DataType.VARCHAR),
+                      Field("rule", DataType.VARCHAR),
+                      Field("fired", DataType.INT64),
+                      Field("detail", DataType.VARCHAR)])
+        return sch, sorted(rewrite_history_rows())
     if n in ("rw_materialized_views", "rw_tables"):
         want_tables = n == "rw_tables"
         sch = Schema([Field("name", DataType.VARCHAR),

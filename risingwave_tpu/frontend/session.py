@@ -70,6 +70,7 @@ class Frontend:
         # session configuration (src/common/src/session_config/
         # analog): typed knobs bind to REAL planner inputs, the rest
         # are pg-compatibility strings (shared impl: session_vars.py)
+        from risingwave_tpu.frontend.opt import parse_rules
         from risingwave_tpu.frontend.session_vars import SessionVars
         self.session_vars = SessionVars(
             self, {"streaming_rate_limit": "rate_limit",
@@ -78,7 +79,15 @@ class Frontend:
                    "stream_chunk_target_rows": "chunk_target_rows",
                    "stream_coalesce_linger_chunks":
                        "coalesce_linger_chunks"},
-            {"application_name": "", "timezone": "UTC"})
+            {"application_name": "", "timezone": "UTC",
+             # plan-rewrite toggles (frontend/opt): 'all' | 'none' |
+             # comma-list of rule names, validated at SET time
+             "stream_rewrite_rules": "all"},
+            validators={"stream_rewrite_rules": parse_rules})
+        # rules spec each MV was created under: reschedule replans +
+        # re-rewrites with the SAME spec so state-table schemas from
+        # the original rewrite reproduce exactly (id-base contract)
+        self._mv_rules: Dict[str, str] = {}
         self._next_actor = 1000
         self.chain_edges: Dict[str, list] = {}   # job → [(uid, Output)]
         # name → CREATE MV select AST (reschedule replans from this —
@@ -145,6 +154,15 @@ class Frontend:
         result: Union[Rows, str] = "OK"
         for text, stmt in parse_many(sql):
             result = await self._run(stmt)
+            if isinstance(stmt, ast.SetVar) and \
+                    stmt.name == "stream_rewrite_rules" and \
+                    not self._replaying:
+                # the rewrite spec shapes STATE-TABLE schemas (pruned
+                # joins persist narrowed rows); recovery must replay
+                # CREATEs under the same spec, so the SET itself rides
+                # the DDL log
+                self._ddl_log.append(text)
+                self._persist_ddl()
             if isinstance(stmt, (ast.CreateSource,
                                  ast.CreateMaterializedView,
                                  ast.CreateSink, ast.DropSink,
@@ -338,9 +356,11 @@ class Frontend:
         self._deployed_actor = actor
 
     def _explain(self, sel: ast.Select) -> Rows:
-        """EXPLAIN <select>: the streaming plan as indented text.
-        Plans against a throwaway barrier manager so no senders or
-        channels leak from a statement that deploys nothing."""
+        """EXPLAIN <select>: the streaming plan as indented text —
+        BOTH the planner's tree and the rewritten tree, with per-rule
+        annotations and carried-lane stats in between. Plans against a
+        throwaway barrier manager so no senders or channels leak from
+        a statement that deploys nothing."""
         from risingwave_tpu.frontend.planner import explain_tree
         planner = StreamPlanner(self.catalog, self.store,
                                 LocalBarrierManager(), definition="",
@@ -351,7 +371,9 @@ class Frontend:
         plan = planner.plan("__explain__", sel, actor_id=0,
                             rate_limit=self.rate_limit,
                             min_chunks=self.min_chunks)
-        return [(line,) for line in explain_tree(plan.consumer)]
+        from risingwave_tpu.frontend.opt import explain_with_rewrite
+        rules = self.session_vars.get("stream_rewrite_rules")
+        return explain_with_rewrite(plan.consumer, rules)
 
     def _catalog_snapshot(self) -> list:
         """Current catalog as notification payloads (observers get
@@ -395,6 +417,7 @@ class Frontend:
             actor_id = self._next_actor
             self._next_actor += 1
             id_base = self.catalog._next_id
+            rules = self.session_vars.get("stream_rewrite_rules")
             try:
                 plan = planner.plan(
                     stmt.name, stmt.select, actor_id,
@@ -402,6 +425,11 @@ class Frontend:
                     min_chunks=self.min_chunks,
                     emit_on_window_close=getattr(
                         stmt, "emit_on_window_close", False))
+                # plan-rewrite pass (frontend/opt): runs between the
+                # planner and deployment; the checker falls back to
+                # the unrewritten plan on any invariant violation
+                from risingwave_tpu.frontend.opt import apply_rewrites
+                apply_rewrites(plan, rules, label=stmt.name)
             except BaseException:
                 # a failed plan must leak nothing: source senders were
                 # registered during planning and would wedge the next
@@ -416,6 +444,7 @@ class Frontend:
                 attaches=plan.attaches)
         self._mv_selects[stmt.name] = (
             stmt.select, getattr(stmt, "emit_on_window_close", False))
+        self._mv_rules[stmt.name] = rules
         if self._deployed_actor.failure is not None:
             raise self._deployed_actor.failure
         return "CREATE_MATERIALIZED_VIEW"
@@ -788,6 +817,15 @@ class Frontend:
                                         rate_limit=self.rate_limit,
                                         min_chunks=self.min_chunks,
                                         emit_on_window_close=eowc)
+                    # re-rewrite under the CREATE-time rule spec: the
+                    # kept state tables carry the schemas that rewrite
+                    # produced (e.g. pruned join sides)
+                    from risingwave_tpu.frontend.opt import (
+                        apply_rewrites,
+                    )
+                    apply_rewrites(plan,
+                                   self._mv_rules.get(name, "all"),
+                                   label=name)
                 except BaseException:
                     for sid in planner.registered_senders:
                         self.local.drop_actor(sid)
@@ -808,6 +846,7 @@ class Frontend:
                 # leaving a catalog entry that serves frozen results
                 self.catalog.mvs.pop(name, None)
                 self._mv_selects.pop(name, None)
+                self._mv_rules.pop(name, None)
                 raise PlanError(
                     f"reschedule of {name!r} failed after teardown — "
                     f"the MV was dropped (state retained): {e}") from e
@@ -838,6 +877,11 @@ class Frontend:
                     stmt.select, stmt.options, actor_id,
                     rate_limit=self.rate_limit,
                     min_chunks=self.min_chunks)
+                from risingwave_tpu.frontend.opt import apply_rewrites
+                apply_rewrites(
+                    plan,
+                    self.session_vars.get("stream_rewrite_rules"),
+                    label=stmt.name)
             except BaseException:
                 for sid in planner.registered_senders:
                     self.local.drop_actor(sid)
@@ -898,6 +942,7 @@ class Frontend:
             actor = await self._stop_job(name, entry.actor_id)
         del registry[name]
         self._mv_selects.pop(name, None)
+        self._mv_rules.pop(name, None)
         if actor is not None and actor.failure is not None:
             raise actor.failure
         return status

@@ -16,13 +16,18 @@ class SessionVars:
     DistFrontend (their SET semantics must not drift)."""
 
     def __init__(self, owner, attr_map: Dict[str, str],
-                 string_defaults: Optional[Dict[str, str]] = None):
+                 string_defaults: Optional[Dict[str, str]] = None,
+                 validators: Optional[Dict[str, object]] = None):
         self.owner = owner
         self.attr_map = dict(attr_map)           # name → owner attr
         self.defaults = {n: getattr(owner, a)
                          for n, a in self.attr_map.items()}
         self.strings = dict(string_defaults or {})
         self._string_vals: Dict[str, str] = {}
+        # name → callable(value) raising PlanError on a bad value —
+        # SET-time validation for free-form string vars (e.g.
+        # stream_rewrite_rules rejects unknown rule names)
+        self.validators = dict(validators or {})
 
     def names(self):
         return sorted(set(self.attr_map) | set(self.strings))
@@ -57,6 +62,9 @@ class SessionVars:
             if value is None:
                 self._string_vals.pop(name, None)
             else:
+                check = self.validators.get(name)
+                if check is not None:
+                    check(str(value))
                 self._string_vals[name] = str(value)
         else:
             raise PlanError(

@@ -639,6 +639,12 @@ class HashJoinExecutor(Executor):
         assert len(left_keys) == len(right_keys)
         self.left_in, self.right_in = left, right
         self.join_type = join_type
+        # rebuild recipe for plan rewrites (frontend/opt): the
+        # column-pruning rule reconstructs the join over narrowed
+        # inputs and must reproduce this exact configuration
+        self.rebuild_opts = {"actor_id": actor_id, "mesh": mesh,
+                             "shard_opts": shard_opts,
+                             "state_cap": state_cap}
         key_codec = KeyCodec(
             [left.schema[i].data_type for i in left_keys])
         self.sides = (

@@ -159,6 +159,26 @@ def expr_from_ir(d: dict) -> Expression:
     raise TypeError(f"unknown expression IR {t!r}")
 
 
+# node-index reference keys: every IR node points at earlier nodes in
+# its fragment through these (plus the list-valued "inputs" of merge).
+# Shared by the scheduler's exchange_in expansion and the exchange-
+# elision rewrite's fragment fusion — two drifting copies would let a
+# new ref key silently dangle after a splice.
+NODE_REF_KEYS = ("input", "left", "right")
+
+
+def remap_node_refs(node: dict, remap: Dict[int, int]) -> dict:
+    """Copy of an IR node with every node-index reference remapped
+    (fragment splicing / placeholder expansion)."""
+    n2 = dict(node)
+    for key in NODE_REF_KEYS:
+        if isinstance(n2.get(key), int):
+            n2[key] = remap[n2[key]]
+    if isinstance(n2.get("inputs"), list):
+        n2["inputs"] = [remap[i] for i in n2["inputs"]]
+    return n2
+
+
 def schema_to_ir(schema: Schema) -> List[dict]:
     return [{"name": f.name, "dt": f.data_type.value} for f in schema]
 

@@ -319,6 +319,19 @@ class StreamingMetrics:
             "stream_compaction_rows_saved",
             "padded row slots dropped by chunk compaction (capacity "
             "that no longer ships over exchanges or the wire)")
+        # -- plan-rewrite engine (frontend/opt/) ----------------------
+        self.rewrite_rule_fired = r.counter(
+            "rewrite_rule_fired_total",
+            "plan-rewrite rule applications by rule (frontend/opt "
+            "fixpoint engine; a FALLBACK records 0 fires)")
+        self.plan_columns_pruned = r.counter(
+            "plan_columns_pruned",
+            "column lanes removed from plans by the column-pruning "
+            "rewrite (narrower joins, exchanges and agg feeds)")
+        self.plan_exchanges_elided = r.counter(
+            "plan_exchanges_elided",
+            "hash exchanges removed because the producer's "
+            "distribution already satisfied the consumer's keys")
         # -- exchange edges (permit.rs back-pressure analog) ----------
         self.exchange_backpressure = r.counter(
             "stream_exchange_backpressure_seconds",

@@ -36,6 +36,17 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
+def _strict_plan_checker():
+    """Assert-don't-fallback mode for the plan-rewrite checker
+    (frontend/opt): a rewrite rule that breaks a plan invariant fails
+    the suite loudly instead of silently falling back."""
+    from risingwave_tpu.frontend.opt import set_strict_checker
+    set_strict_checker(True)
+    yield
+    set_strict_checker(False)
+
+
+@pytest.fixture(autouse=True)
 def _strict_empty_chunks():
     """Assertion mode for the empty-message-suppression invariant: a
     MonitoredExecutor (i.e. any deployed chain) emitting a
