@@ -16,10 +16,43 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os.path
 import sys
 
 BASELINE_EVENTS_PER_SEC = 1_000_000.0
 IN_FLIGHT = 2          # barrier pipelining window used by every bench
+
+# Device-probe outcome log, persisted ACROSS rounds (VERDICT r5 weak
+# #1): when a round's numbers collapse, this file distinguishes
+# "tunnel wedged" (probe failures with timestamps) from "kernels
+# broken" (probe fine, smoke/bench failed).
+PROBE_LOG_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_probe_log.json")
+PROBE_LOG_KEEP = 200
+
+
+def _log_probe(entry: dict) -> None:
+    """Append one probe/smoke outcome to BENCH_probe_log.json (bounded
+    to the last PROBE_LOG_KEEP entries; best-effort — logging must
+    never fail a bench run)."""
+    import datetime
+    import os
+    entry = {"ts": datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds"), **entry}
+    try:
+        log = []
+        if os.path.exists(PROBE_LOG_PATH):
+            with open(PROBE_LOG_PATH) as f:
+                log = json.load(f)
+        log.append(entry)
+        log = log[-PROBE_LOG_KEEP:]
+        tmp = PROBE_LOG_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(log, f, indent=1)
+        os.replace(tmp, PROBE_LOG_PATH)
+    except Exception as e:                           # noqa: BLE001
+        print(f"WARNING: probe log write failed: {e!r}",
+              file=sys.stderr)
 
 
 def _metrics_snapshot(loop) -> dict:
@@ -41,7 +74,15 @@ def _metrics_snapshot(loop) -> dict:
                      STREAMING.coalesce_chunks_out.series()))
     rewrites = int(sum(v for _l, v in
                        STREAMING.rewrite_rule_fired.series()))
+    tier_evicted = int(sum(v for _l, v in
+                           STREAMING.state_tier_evicted.series()))
+    tier_reloads = int(sum(v for _l, v in
+                           STREAMING.state_tier_reloads.series()))
     return {
+        # state-tiering activity (state/tier.py): nonzero here with a
+        # cap above the working set would explain a throughput diff
+        "state_tier_evicted": tier_evicted,
+        "state_tier_reloads": tier_reloads,
         "device_dispatches": dispatches,
         "rows_per_dispatch_avg": round(disp_rows / dispatches, 1)
         if dispatches else 0.0,
@@ -388,17 +429,73 @@ def _probe_device(timeout_s: int = 180, attempts: int = 2) -> str:
                 timeout=timeout_s, capture_output=True, check=True)
             lines = out.stdout.decode().strip().splitlines()
             if lines:
+                _log_probe({"event": "probe", "attempt": i + 1,
+                            "ok": True, "platform": lines[-1]})
                 return lines[-1]
             raise OSError("probe printed no platform")
-        except (subprocess.SubprocessError, OSError):
+        except (subprocess.SubprocessError, OSError) as e:
+            _log_probe({"event": "probe", "attempt": i + 1,
+                        "ok": False, "error": repr(e)[:300]})
             print(f"WARNING: device probe {i + 1}/{attempts} failed",
                   file=sys.stderr)
             if i + 1 < attempts:
                 time.sleep(30)
+    _log_probe({"event": "probe_exhausted", "attempt": attempts,
+                "ok": False, "error": "all attempts failed — CPU "
+                "fallback"})
     print("WARNING: device backend unreachable — benching on CPU",
           file=sys.stderr)
     os.environ["JAX_PLATFORMS"] = "cpu"
     return "cpu"
+
+
+def _smoke_device() -> dict:
+    """`--smoke-device`: compile ONE hash-agg kernel and time ONE
+    chunk+flush round — a minutes-cheaper signal than a full bench.
+    Probe ok + smoke ok + bench dead ⇒ pipeline bug; probe ok + smoke
+    dead ⇒ kernels/XLA broken; probe dead ⇒ tunnel wedged. The result
+    also lands in BENCH_probe_log.json."""
+    import time
+
+    import numpy as np
+
+    import jax
+    from risingwave_tpu.ops.hash_agg import (
+        AggKind, AggSpec, GroupedAggKernel,
+    )
+    platform = jax.devices()[0].platform
+    specs = [AggSpec(AggKind.SUM, np.dtype(np.int64)),
+             AggSpec(AggKind.COUNT, None)]
+    n, n_groups = 4096, 64
+    keys = np.zeros((n, 3), dtype=np.int32)
+    keys[:, 0] = np.arange(n) % n_groups
+    keys[:, 2] = 1
+    signs = np.ones(n, dtype=np.int64)
+    vis = np.ones(n, dtype=bool)
+    vals = np.arange(n, dtype=np.int64)
+    inputs = ((specs[0].encode_input(vals), np.ones(n, dtype=bool)),
+              ((), None))
+    t0 = time.perf_counter()
+    k = GroupedAggKernel(key_width=3, specs=specs, capacity=1 << 12)
+    k.apply(keys, signs, vis, inputs)
+    fr = k.flush()
+    k.advance()
+    compile_s = time.perf_counter() - t0
+    assert fr.n == n_groups, f"expected {n_groups} groups, got {fr.n}"
+    t1 = time.perf_counter()
+    k.apply(keys, signs, vis, inputs)
+    fr2 = k.flush()
+    k.advance()
+    chunk_s = time.perf_counter() - t1
+    assert fr2.n == n_groups
+    out = {"metric": "smoke_device", "ok": True, "platform": platform,
+           "compile_and_first_chunk_s": round(compile_s, 4),
+           "warm_chunk_s": round(chunk_s, 4),
+           "rows": n, "groups": n_groups}
+    _log_probe({"event": "smoke", "ok": True, "platform": platform,
+                "compile_s": round(compile_s, 4),
+                "chunk_s": round(chunk_s, 4)})
+    return out
 
 
 def main(argv):
@@ -460,6 +557,23 @@ def _bench_one_subprocess(name: str) -> dict:
 
 def _main_locked(argv):
     from risingwave_tpu.utils.jaxtools import enable_compilation_cache
+    if "--smoke-device" in argv:
+        # one kernel compile + one timed chunk, under the chip lock the
+        # parent already took; failures log to BENCH_probe_log.json
+        import os
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        enable_compilation_cache()
+        try:
+            print(json.dumps(_smoke_device()))
+        except BaseException as e:
+            _log_probe({"event": "smoke", "ok": False,
+                        "error": repr(e)[:300]})
+            print(json.dumps({"metric": "smoke_device", "ok": False,
+                              "error": repr(e)[:300]}))
+            raise
+        return
     if "--one" in argv:
         # child mode: one query, full-scale warmup then measure
         import os

@@ -131,6 +131,8 @@ def _ctl(args) -> int:
         return asyncio.run(_ctl_scan(obj, args))
     if verb == "metrics":
         return asyncio.run(_ctl_metrics(obj, args))
+    if verb == "memory":
+        return asyncio.run(_ctl_memory(obj, args))
     if verb == "backup":
         from risingwave_tpu.meta.backup import (
             create_backup, delete_backup, list_backups, restore_backup,
@@ -231,6 +233,41 @@ async def _ctl_metrics(obj, args) -> int:
     return 0
 
 
+async def _ctl_memory(obj, args) -> int:
+    """Recover into an in-memory clone (same snapshot discipline as
+    `table scan`), drive a couple of checkpoints, and dump the host-
+    memory accounting: MemoryContext.sizes() per cache plus per-
+    executor state-tier residency (cap / resident / evicted / reloads
+    / bytes) — what the memory manager and the tier see on a serving
+    node."""
+    from risingwave_tpu.frontend import Frontend
+    from risingwave_tpu.state.tier import GLOBAL as TIER
+    from risingwave_tpu.storage.hummock import HummockLite
+    from risingwave_tpu.utils.memory import GLOBAL as MEM
+
+    fe = Frontend(HummockLite(_snapshot_clone(obj)))
+    await fe.recover()
+    try:
+        await fe.step(args.steps)
+        sizes = MEM.sizes()
+        total = sum(sizes.values())
+        limit = MEM.soft_limit
+        print(f"accounted host state: {total}B"
+              + ("" if limit is None else f" (soft limit {limit}B)"))
+        for name in sorted(sizes, key=lambda n: -sizes[n]):
+            print(f"  {sizes[name]:>12}B  {name}")
+        rows = sorted(TIER.stats_rows())
+        if rows:
+            print("state tier (cap/resident/evicted/reloads/bytes):")
+            for name, cap, res, ev, rl, nb in rows:
+                cap_s = "-" if cap < 0 else str(cap)
+                print(f"  {name}: cap={cap_s} resident={res} "
+                      f"evicted={ev} reloads={rl} bytes={nb}")
+    finally:
+        await fe.close()
+    return 0
+
+
 def main(argv=None) -> None:
     # the axon sitecustomize rewrites jax_platforms at interpreter
     # start, overriding JAX_PLATFORMS=cpu — honor the env var so ctl /
@@ -268,6 +305,12 @@ def main(argv=None) -> None:
     mt = csub.add_parser(
         "metrics", help="recover + dump the Prometheus exposition")
     mt.add_argument("--steps", type=int, default=2,
+                    help="checkpoint barriers to drive before the dump")
+    mm = csub.add_parser(
+        "memory",
+        help="recover + dump host-memory accounting and state-tier "
+             "residency")
+    mm.add_argument("--steps", type=int, default=2,
                     help="checkpoint barriers to drive before the dump")
     bk = csub.add_parser("backup")
     bk.add_argument("what",

@@ -84,6 +84,12 @@ class DistFrontend:
         )
         self.chunk_target_rows = DEFAULT_TARGET_ROWS
         self.coalesce_linger_chunks = DEFAULT_MAX_CHUNKS
+        # unified state-tiering cap (state/tier.py): the planner stamps
+        # it on agg executors and the fragmenter ships it in the IR, so
+        # WORKER fragments rebuild with the same memory governance.
+        # (The soft-limit var governs the coordinator process only —
+        # each worker process has its own MemoryContext.)
+        self.state_tier_cap = None
         # name → (select AST, eowc): FROM <mv> inlines the view's
         # definition (distributed MV-on-MV by view expansion)
         self._mv_selects = {}
@@ -97,6 +103,9 @@ class DistFrontend:
             self, {"streaming_rate_limit": "rate_limit",
                    "streaming_min_chunks": "min_chunks",
                    "parallelism": "parallelism",
+                   "state_tier_cap": "state_tier_cap",
+                   "state_tier_soft_limit_mb":
+                       "state_tier_soft_limit_mb",
                    "stream_chunk_target_rows": "chunk_target_rows",
                    "stream_coalesce_linger_chunks":
                        "coalesce_linger_chunks"},
@@ -111,6 +120,19 @@ class DistFrontend:
         # is not reentrant; a heartbeat between per-table scans would
         # tear a cross-MV snapshot)
         self._barrier_lock = asyncio.Lock()
+
+    # same surface as the in-process session (no-drift contract);
+    # governs the COORDINATOR process's MemoryContext
+    @property
+    def state_tier_soft_limit_mb(self) -> int:
+        from risingwave_tpu.utils import memory as _mem
+        sl = _mem.GLOBAL.soft_limit
+        return 0 if sl is None else int(sl) >> 20
+
+    @state_tier_soft_limit_mb.setter
+    def state_tier_soft_limit_mb(self, v) -> None:
+        from risingwave_tpu.utils import memory as _mem
+        _mem.GLOBAL.soft_limit = None if not v else int(v) << 20
 
     async def start(self) -> None:
         await self.cluster.start()
@@ -219,7 +241,9 @@ class DistFrontend:
                                 inline_mvs=self._mv_selects,
                                 chunk_target_rows=self.chunk_target_rows,
                                 coalesce_linger_chunks=self
-                                .coalesce_linger_chunks)
+                                .coalesce_linger_chunks,
+                                state_tier_cap=self.state_tier_cap
+                                or None)
         plan = planner.plan(stmt.name, stmt.select, actor_id=0,
                             rate_limit=self.rate_limit,
                             min_chunks=self.min_chunks)

@@ -27,13 +27,34 @@ DECIMAL_SCALE_DIGITS = 4
 DECIMAL_SCALE = 10 ** DECIMAL_SCALE_DIGITS
 
 
+# int64 bound of the scaled domain: |value| < 2^63 / 10^4 ≈ 9.2e14.
+# Beyond it the fixed-point payload would WRAP silently (VERDICT r5
+# weak #6) — every ingest/cast boundary funnels through
+# decimal_to_scaled, so the check lives here, once.
+_SCALED_MAX = (1 << 63) - 1
+
+
+class DecimalOverflowError(ValueError):
+    """A DECIMAL value left the int64 fixed-point domain."""
+
+
 def decimal_to_scaled(v) -> int:
-    """Python number → scaled int64 payload (banker-free, half-up round)."""
+    """Python number → scaled int64 payload (banker-free, half-up
+    round). Raises DecimalOverflowError instead of silently wrapping
+    when |scaled| exceeds int64 (~9.2e14 in value units)."""
     if isinstance(v, int):
-        return v * DECIMAL_SCALE
-    d = v if isinstance(v, decimal.Decimal) else decimal.Decimal(str(v))
-    return int((d * DECIMAL_SCALE).to_integral_value(
-        rounding=decimal.ROUND_HALF_UP))
+        scaled = v * DECIMAL_SCALE
+    else:
+        d = v if isinstance(v, decimal.Decimal) \
+            else decimal.Decimal(str(v))
+        scaled = int((d * DECIMAL_SCALE).to_integral_value(
+            rounding=decimal.ROUND_HALF_UP))
+    if not -_SCALED_MAX <= scaled <= _SCALED_MAX:
+        raise DecimalOverflowError(
+            f"DECIMAL value {v} overflows the int64 fixed-point "
+            f"domain (|value| must stay under "
+            f"{_SCALED_MAX // DECIMAL_SCALE})")
+    return scaled
 
 
 def scaled_to_decimal(raw: int) -> decimal.Decimal:

@@ -264,6 +264,10 @@ class Fragmenter:
                     **{j: t.table_id for j, t in ex.minput.items()},
                     **{j: t.table_id
                        for j, t in ex.hll_tables.items()}},
+                # cold-tier resident-group cap (state/tier.py): worker
+                # fragments rebuild with the same memory governance the
+                # coordinator planned
+                "tier_cap": ex.tier_cap,
             }
             if self.parallelism > 1 and \
                     getattr(ex, "two_phase_role", None) != "local":
@@ -295,6 +299,10 @@ class Fragmenter:
                 "left_pk": list(left.table.pk_indices),
                 "right_pk": list(right.table.pk_indices),
                 "join_type": ex.join_type.value,
+                # cold-tier resident-key cap (state/tier.py): the
+                # shipped pks are already key-prefixed when set, and
+                # worker rebuilds run the same epoch-batched path
+                "state_cap": left.state_cap,
                 "output_names": [f.name for f in ex.schema]})
             return fi, ni
         from risingwave_tpu.stream.executors.temporal_join import (

@@ -202,7 +202,8 @@ class StreamPlanner:
                  mesh=None, actors=None, dist_parallelism: int = 1,
                  join_state_cap=None, inline_mvs=None,
                  chunk_target_rows: Optional[int] = None,
-                 coalesce_linger_chunks: Optional[int] = None):
+                 coalesce_linger_chunks: Optional[int] = None,
+                 state_tier_cap: Optional[int] = None):
         from risingwave_tpu.stream.coalesce import (
             DEFAULT_MAX_CHUNKS, DEFAULT_TARGET_ROWS,
         )
@@ -227,6 +228,11 @@ class StreamPlanner:
         # cold-state tier (evict to the state table, reload on probe
         # miss — managed_state/join/mod.rs:379-420)
         self.join_state_cap = join_state_cap
+        # unified state-tiering cap (SET state_tier_cap, state/tier.py):
+        # resident-key cap per stateful executor cache — applies to
+        # hash-agg groups AND join sides (where it takes precedence
+        # over the legacy join_state_cap)
+        self.state_tier_cap = state_tier_cap
         # name → (select AST, eowc): FROM <mv> replans the view's
         # definition INLINE instead of attaching to its live actor —
         # the distributed session's MV-on-MV form (classic view
@@ -572,17 +578,27 @@ class StreamPlanner:
                       "left": JoinType.LEFT_OUTER,
                       "right": JoinType.RIGHT_OUTER,
                       "full": JoinType.FULL_OUTER}[jn.kind]
-                # cold-tier eligibility: INNER + single-chip AND both
+                # cold-tier eligibility: INNER or OUTER (outer-side
+                # degrees recompute on reload — semi/anti transition
+                # history cannot be evicted) + single-chip AND both
                 # inputs PROVABLY append-only — a retraction for an
                 # evicted key cannot be applied against device state
                 # (ADVICE r5 high: the silent-skip would leave
                 # already-emitted join outputs permanently stale), so
                 # a retracting input runs uncapped instead
-                cap = (self.join_state_cap
-                       if jt == JoinType.INNER and self.mesh is None
-                       and self._derive_append_only(left)
-                       and self._derive_append_only(right)
-                       else None)
+                tierable = (jt in (JoinType.INNER, JoinType.LEFT_OUTER,
+                                   JoinType.RIGHT_OUTER,
+                                   JoinType.FULL_OUTER)
+                            and self.mesh is None
+                            # distributed joins are fine: the
+                            # fragmenter ships state_cap on the
+                            # hash_join IR node, and worker rebuilds
+                            # run the same single-chip epoch-batched
+                            # path (per-actor cap)
+                            and self._derive_append_only(left)
+                            and self._derive_append_only(right))
+                cap = (self.state_tier_cap or self.join_state_cap) \
+                    if tierable else None
                 if cap is not None:
                     # cold tier: state-table pks lead with the join
                     # keys so evicted keys reload by prefix scan
@@ -1023,7 +1039,12 @@ class StreamPlanner:
                               calls, table,
                               append_only=append_only, kernel=kernel,
                               minput_tables=minput_tables,
-                              distinct_tables=distinct_tables)
+                              distinct_tables=distinct_tables,
+                              # cold tier: single-chip lazy kernel only
+                              # (the sharded kernel has no targeted
+                              # evict path)
+                              tier_cap=self.state_tier_cap
+                              if kernel is None else None)
         # bound items are already typed refs over the agg output row
         return agg, bound, having_pred
 
@@ -1057,7 +1078,8 @@ class StreamPlanner:
                                 ltable,
                                 append_only=append_only,
                                 distinct_tables=ldistinct,
-                                minput_tables=lminput)
+                                minput_tables=lminput,
+                                tier_cap=self.state_tier_cap)
         local._info = ExecutorInfo(local.schema,
                                    list(local.pk_indices),
                                    "HashAggExecutor(phase=local)")
@@ -1078,7 +1100,8 @@ class StreamPlanner:
         agg = HashAggExecutor(local, group, merge, gtable,
                               append_only=False,
                               distinct_tables=gdistinct,
-                              minput_tables=gminput)
+                              minput_tables=gminput,
+                              tier_cap=self.state_tier_cap)
         agg._info = ExecutorInfo(agg.schema, list(agg.pk_indices),
                                  "HashAggExecutor(phase=global)")
         return agg, bound, having_pred
@@ -1246,6 +1269,18 @@ def _system_catalog_rows(name: str, catalog: Catalog, profiler=None):
                       Field("queue_depth", DataType.INT64)])
         rows = list(profiler.rows()) if profiler is not None else []
         return sch, rows
+    if n == "rw_state_tier":
+        # state-tiering residency (state/tier.py): one row per
+        # registered executor cache; cap = -1 means uncapped
+        # (pressure-only governance)
+        from risingwave_tpu.state.tier import GLOBAL as _TIER
+        sch = Schema([Field("executor", DataType.VARCHAR),
+                      Field("cap", DataType.INT64),
+                      Field("resident_keys", DataType.INT64),
+                      Field("evicted_total", DataType.INT64),
+                      Field("reload_total", DataType.INT64),
+                      Field("resident_bytes", DataType.INT64)])
+        return sch, sorted(_TIER.stats_rows())
     if n == "rw_plan_rewrites":
         # plan-rewrite firing log (frontend/opt engine): one row per
         # (job, rule) application, FALLBACK rows record checker trips

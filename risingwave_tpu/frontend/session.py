@@ -59,6 +59,13 @@ class Frontend:
         self.min_chunks = min_chunks
         # resident join-state cap (cold-tier eviction; None = unbounded)
         self.join_state_cap = join_state_cap
+        # unified state-tiering cap (state/tier.py): resident-KEY cap
+        # per stateful executor cache — agg groups, join sides, TopN
+        # group caches. None/0 = unbounded. Recorded per MV at CREATE
+        # (the cap shapes join state-table pks) and replayed at
+        # reschedule, like _mv_rules.
+        self.state_tier_cap: Optional[int] = None
+        self._mv_tier_caps: Dict[str, Optional[int]] = {}
         # adaptive chunk coalescing in front of keyed executors
         # (stream/coalesce.py): target cardinality per device dispatch
         # (0 disables) and the linger bound in buffered chunks
@@ -76,6 +83,9 @@ class Frontend:
             self, {"streaming_rate_limit": "rate_limit",
                    "streaming_min_chunks": "min_chunks",
                    "join_state_cap": "join_state_cap",
+                   "state_tier_cap": "state_tier_cap",
+                   "state_tier_soft_limit_mb":
+                       "state_tier_soft_limit_mb",
                    "stream_chunk_target_rows": "chunk_target_rows",
                    "stream_coalesce_linger_chunks":
                        "coalesce_linger_chunks"},
@@ -106,6 +116,21 @@ class Frontend:
         # serializes barrier rounds between DDL handlers, step() and the
         # background heartbeat (inject_and_collect is not reentrant)
         self._barrier_lock = asyncio.Lock()
+
+    # -- state-tier pressure knob (SET state_tier_soft_limit_mb) ---------
+    @property
+    def state_tier_soft_limit_mb(self) -> int:
+        """Pressure watermark for the state tier: the MemoryContext
+        soft limit (utils/memory.py) in MB; 0 = unlimited. Process-
+        global — the checkpoint tick sweeps ONE context per process."""
+        from risingwave_tpu.utils import memory as _mem
+        sl = _mem.GLOBAL.soft_limit
+        return 0 if sl is None else int(sl) >> 20
+
+    @state_tier_soft_limit_mb.setter
+    def state_tier_soft_limit_mb(self, v) -> None:
+        from risingwave_tpu.utils import memory as _mem
+        _mem.GLOBAL.soft_limit = None if not v else int(v) << 20
 
     # -- DDL-log durability (MetaStore analog) ---------------------------
     @property
@@ -155,12 +180,16 @@ class Frontend:
         for text, stmt in parse_many(sql):
             result = await self._run(stmt)
             if isinstance(stmt, ast.SetVar) and \
-                    stmt.name == "stream_rewrite_rules" and \
+                    stmt.name in ("stream_rewrite_rules",
+                                  "state_tier_cap",
+                                  "state_tier_soft_limit_mb") and \
                     not self._replaying:
-                # the rewrite spec shapes STATE-TABLE schemas (pruned
-                # joins persist narrowed rows); recovery must replay
-                # CREATEs under the same spec, so the SET itself rides
-                # the DDL log
+                # these SETs shape what CREATE produces — the rewrite
+                # spec shapes STATE-TABLE schemas (pruned joins persist
+                # narrowed rows) and the tier cap shapes join
+                # state-table pks (key-prefixed for prefix-scan
+                # reload); recovery must replay CREATEs under the same
+                # values, so the SET itself rides the DDL log
                 self._ddl_log.append(text)
                 self._persist_ddl()
             if isinstance(stmt, (ast.CreateSource,
@@ -410,6 +439,8 @@ class Frontend:
                                     definition="", mesh=self.mesh,
                                     actors=self.actors,
                                     join_state_cap=self.join_state_cap,
+                                    state_tier_cap=self.state_tier_cap
+                                    or None,
                                     chunk_target_rows=self
                                     .chunk_target_rows,
                                     coalesce_linger_chunks=self
@@ -445,6 +476,9 @@ class Frontend:
         self._mv_selects[stmt.name] = (
             stmt.select, getattr(stmt, "emit_on_window_close", False))
         self._mv_rules[stmt.name] = rules
+        # CREATE-time tier cap: reschedule replans under it (the cap
+        # shapes join state-table pk layouts — id-base contract)
+        self._mv_tier_caps[stmt.name] = self.state_tier_cap or None
         if self._deployed_actor.failure is not None:
             raise self._deployed_actor.failure
         return "CREATE_MATERIALIZED_VIEW"
@@ -804,6 +838,7 @@ class Frontend:
                     self.catalog, self.store, self.local,
                     definition="", mesh=mesh, actors=self.actors,
                     join_state_cap=self.join_state_cap,
+                    state_tier_cap=self._mv_tier_caps.get(name),
                     chunk_target_rows=self.chunk_target_rows,
                     coalesce_linger_chunks=self
                     .coalesce_linger_chunks)
@@ -847,6 +882,7 @@ class Frontend:
                 self.catalog.mvs.pop(name, None)
                 self._mv_selects.pop(name, None)
                 self._mv_rules.pop(name, None)
+                self._mv_tier_caps.pop(name, None)
                 raise PlanError(
                     f"reschedule of {name!r} failed after teardown — "
                     f"the MV was dropped (state retained): {e}") from e
@@ -943,6 +979,7 @@ class Frontend:
         del registry[name]
         self._mv_selects.pop(name, None)
         self._mv_rules.pop(name, None)
+        self._mv_tier_caps.pop(name, None)
         if actor is not None and actor.failure is not None:
             raise actor.failure
         return status

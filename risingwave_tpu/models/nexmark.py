@@ -107,7 +107,8 @@ def build_q7(store, cfg: NexmarkConfig,
              min_chunks: Optional[int] = None,
              watermark_delay: Optional[Interval] = None,
              mesh=None, shard_capacity: int = 1 << 14,
-             coalesce_rows: Optional[int] = None) -> Pipeline:
+             coalesce_rows: Optional[int] = None,
+             tier_cap: Optional[int] = None) -> Pipeline:
     """q7-core: MAX(price), COUNT(*) per tumbling window (device agg).
 
     With ``watermark_delay``, a WatermarkFilter generates event-time
@@ -159,10 +160,13 @@ def build_q7(store, cfg: NexmarkConfig,
         # the oracle test can compare on vs off
         from risingwave_tpu.stream.coalesce import CoalesceExecutor
         agg_in = CoalesceExecutor(project, coalesce_rows)
+    # tier_cap: resident-group cap for the state-tiering oracle tests
+    # and bench parity runs (state/tier.py; single-chip only)
     agg = HashAggExecutor(agg_in, [0], calls, agg_state,
                           append_only=True,
                           output_names=["max_price", "bid_count"],
-                          kernel=kernel)
+                          kernel=kernel,
+                          tier_cap=tier_cap if mesh is None else None)
     mv_table = StateTable(3, agg.schema, [0], store)  # pk = window_start
     mat = MaterializeExecutor(agg, mv_table)
     return _finish(local, store, mat, mv_table, 1,
@@ -313,7 +317,8 @@ def build_q5(store, cfg: NexmarkConfig,
              min_chunks: Optional[int] = None,
              slide: Interval = Interval(usecs=2_000_000),
              size: Interval = Interval(usecs=10_000_000),
-             top_per_window: int = 1) -> Pipeline:
+             top_per_window: int = 1,
+             tier_cap: Optional[int] = None) -> Pipeline:
     """q5 (hot items): auctions with the most bids per sliding window.
 
     source → hop-window expansion → per-(window, auction) device count
@@ -337,16 +342,19 @@ def build_q5(store, cfg: NexmarkConfig,
         names=["window_start", "auction"])
     calls = [AggCall(AggKind.COUNT)]
     agg_sch, agg_pk = agg_state_schema(proj.schema, [0, 1], calls)
+    # tier_cap governs BOTH stateful stages (state/tier.py): resident
+    # agg groups and resident TopN group caches
     agg = HashAggExecutor(
         proj, [0, 1], calls,
         StateTable(2, agg_sch, agg_pk, store, dist_key_indices=[0]),
         append_only=True,
-        output_names=["window_start", "auction", "bid_count"])
+        output_names=["window_start", "auction", "bid_count"],
+        tier_cap=tier_cap)
     topn_state = StateTable(3, agg.schema, [0, 1], store)
     topn = GroupTopNExecutor(
         agg, order_by=[(2, True), (1, False)], offset=0,
         limit=top_per_window, state=topn_state,
-        group_indices=[0], pk_indices=[0, 1])
+        group_indices=[0], pk_indices=[0, 1], tier_cap=tier_cap)
     mv = StateTable(4, topn.schema, [0, 1], store)
     mat = MaterializeExecutor(topn, mv)
     return _finish(local, store, mat, mv, 1, {1: source.reader},

@@ -679,6 +679,35 @@ def build_retire(key_width: int, specs: Sequence[AggSpec]):
     return retire
 
 
+def evict_state(state: AggState, key_lanes: jnp.ndarray,
+                valid: jnp.ndarray, fills
+                ) -> Tuple[AggState, jnp.ndarray]:
+    """Traced cold-tier eviction (state/tier.py): drop the given keys'
+    groups by rebuilding the table from the survivors in ONE device
+    step — the same rebuild path watermark retirement uses. The caller
+    guarantees the evicted groups are CLEAN (flushed + advanced), so
+    dropping their device slots loses nothing the state table does not
+    hold."""
+    slots = ht.lookup(state.table, key_lanes, valid)
+    cap = state.table.capacity
+    scat = jnp.where(slots >= 0, slots, cap)
+    dropped = jnp.zeros(cap, dtype=bool).at[scat].set(True, mode="drop")
+    live = state.table.occ & ~dropped & (
+        (state.group_rows != 0) | state.dirty | state.emitted_valid)
+    return _rebuild_live(state, live, cap, fills)
+
+
+def build_evict(specs: Sequence[AggSpec]):
+    fills = tuple(f for _dt, f in dev_layout(specs))
+    jitted = jax.jit(evict_state, static_argnums=(3,),
+                     donate_argnums=(0,))
+
+    def evict(state, key_lanes, valid):
+        return jitted(state, key_lanes, valid, fills)
+
+    return evict
+
+
 def advance_state(state: AggState) -> AggState:
     """Traced post-flush snapshot advance — fully on device, no host
     index round-trip: emitted := current for every dirty slot."""
@@ -881,6 +910,7 @@ class GroupedAggKernel:
         self._advance = build_advance()
         self._patch = build_patch(self.specs)
         self._retire = build_retire(key_width, self.specs)
+        self._evict = build_evict(self.specs)
         fills = tuple(f for _dt, f in dev_layout(self.specs))
         self._grow_step = jax.jit(
             lambda st, cap: _rebuild_live(
@@ -984,6 +1014,65 @@ class GroupedAggKernel:
         self.state, _n_live = self._retire(
             self.state, jnp.int32(hi[0]), jnp.int32(lo[0]),
             group_pos * 3)
+
+    # -- cold tier (state/tier.py) ---------------------------------------
+    def evict_keys(self, key_lanes: np.ndarray) -> None:
+        """Drop the given groups' device slots (cold-tier eviction;
+        their rows stay durable in the value-state table). Call only at
+        a barrier, after flush+advance, with no backlog — the tier
+        sweeps only there, so the evicted groups are provably clean."""
+        if self._backlog_rows:
+            raise RuntimeError("evict_keys with undispatched backlog")
+        n = len(key_lanes)
+        if n == 0:
+            return
+        cap_n = next_pow2(n)
+        lanes = np.zeros((cap_n, self.key_width), dtype=np.int32)
+        lanes[:n] = key_lanes
+        valid = np.zeros(cap_n, dtype=bool)
+        valid[:n] = True
+        self.state, _n_live = self._evict(self.state,
+                                          jnp.asarray(lanes),
+                                          jnp.asarray(valid))
+        # occupancy: the rebuild can only RECLAIM (live ⊆ occupied), so
+        # the standing upper bound stays valid — same argument as _grow;
+        # the next flush header collapses it to exact for free
+
+    def load_groups(self, keys: np.ndarray, group_rows: np.ndarray,
+                    acc_cols: Sequence[np.ndarray]) -> None:
+        """Reload evicted groups from committed state rows into the
+        LIVE table (cold-tier reload-on-touch). Mirrors ``rebuild``'s
+        insert without resetting resident state; reloaded groups are
+        marked emitted — their outputs were committed downstream before
+        eviction, so the next flush derives update pairs, not fresh
+        inserts. Dispatches BEFORE the touching chunk's apply (the
+        caller drains the backlog via this call)."""
+        n = len(group_rows)
+        if n == 0:
+            return
+        # the reload must land before any buffered chunk that may touch
+        # the same (still-cold-looking) keys could dispatch after it
+        self._dispatch_backlog()
+        self._reserve(n)
+        dev_cols = encode_host_accs(self.specs, acc_cols)
+        table, slots, ins = ht._probe_insert_jit(
+            self.state.table, jnp.asarray(keys),
+            jnp.ones(n, dtype=bool))
+        self._counters.push(ins, n)
+        rows32 = jnp.asarray(group_rows, dtype=jnp.int32)
+        accs = tuple(a.at[slots].set(jnp.asarray(col))
+                     for a, col in zip(self.state.accs, dev_cols))
+        self.state = AggState(
+            table=table,
+            group_rows=self.state.group_rows.at[slots].set(rows32),
+            dirty=self.state.dirty,
+            accs=accs,
+            emitted_valid=self.state.emitted_valid.at[slots].set(True),
+            emitted_rows=self.state.emitted_rows.at[slots].set(rows32),
+            emitted_accs=tuple(
+                a.at[slots].set(jnp.asarray(col))
+                for a, col in zip(self.state.emitted_accs, dev_cols)),
+        )
 
     # -- barrier flush ---------------------------------------------------
     def flush(self) -> FlushResult:

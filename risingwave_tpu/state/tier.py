@@ -1,0 +1,191 @@
+"""StateTier: memory-governed cold tier shared by stateful executors.
+
+Reference parity: src/stream/src/executor/managed_state/join/mod.rs
+:379-420 (JoinHashMap as an LRU over the StateTable), cache/
+managed_lru.rs (epoch-sequenced LRU eviction) and memory_management/
+memory_manager.rs:33-70 (the watermark memory manager driving those
+LRUs). TPU re-design: the join-only cold-keys mechanism generalizes to
+ONE manager every stateful executor can register with — the device
+holds the hot working set, the state table holds everything, and a
+touch of an evicted key reloads it.
+
+Contract per participant (an executor-owned cache of keyed state):
+
+- ``touch(part, keys, seq)`` on the ingest path records per-key
+  last-touched sequence (the executor's barrier counter — the
+  managed_lru epoch). The tier's map holds exactly the RESIDENT keys.
+- ``sweep(part, seq)`` runs at the executor's own CHECKPOINT barrier,
+  after its flush/commit — never mid-epoch, so eviction can never race
+  an in-flight epoch's probes or un-flushed device state (the
+  epoch-sequencing argument: all state observed by the tier is the
+  just-committed barrier snapshot). It picks the OLDEST keys past the
+  participant's cap — or past the pressure watermark when the
+  MemoryContext (utils/memory.py) has crossed its soft limit — and
+  hands them to the participant's ``evict(keys)`` callback, which moves
+  them out of device slots + host caches (they stay durable in the
+  state table; a later touch reloads).
+- ``forget(part, keys)`` drops keys that left the state entirely
+  (watermark expiry, retraction to zero). Stale entries self-heal:
+  an evicted key the participant no longer holds is a no-op evict.
+
+The tier never touches executor state itself — eviction/reload
+mechanics stay with the owners (kernel rebuild paths, arena
+compaction); this module owns WHICH keys and WHEN.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from risingwave_tpu.utils.metrics import STREAMING as _METRICS
+
+
+class TierParticipant:
+    """One registered executor-side cache (name, cap, evict hook)."""
+
+    __slots__ = ("name", "cap", "evict", "nbytes", "keys",
+                 "evicted_total", "reload_total")
+
+    def __init__(self, name: str, evict: Callable[[List], int],
+                 cap: Optional[int],
+                 nbytes: Optional[Callable[[], int]]):
+        # `evict(keys)` must return the number of KEYS actually
+        # evicted (units contract: every counter here is in keys)
+        self.name = name
+        self.evict = evict
+        self.cap = cap
+        self.nbytes = nbytes
+        # key → last-touched sequence. Python dicts preserve insertion
+        # order; a re-touch deletes + reinserts, so iteration order IS
+        # oldest-first — an O(1)-per-touch LRU without a linked list.
+        self.keys: Dict[object, int] = {}
+        self.evicted_total = 0
+        self.reload_total = 0
+
+
+class StateTier:
+    """Central registry + eviction policy (the managed-LRU watermark)."""
+
+    # keep ~this fraction of the cap after a cap-driven sweep (room to
+    # absorb arrivals before the next barrier)
+    EVICT_TARGET_RATIO = 0.75
+    # under memory pressure, evict each participant down to this
+    # fraction of its current residency at its next sweep
+    PRESSURE_KEEP_RATIO = 0.5
+
+    def __init__(self, memory=None):
+        # memory context injected for tests; default is the process
+        # global (resolved lazily — no import cycle at module load)
+        self._memory = memory
+        self._parts: Dict[str, TierParticipant] = {}
+
+    def _mem(self):
+        if self._memory is None:
+            from risingwave_tpu.utils import memory as _mem
+            self._memory = _mem.GLOBAL
+        return self._memory
+
+    # -- registration -----------------------------------------------------
+    def register(self, name: str, evict: Callable[[List], int],
+                 cap: Optional[int] = None,
+                 nbytes: Optional[Callable[[], int]] = None
+                 ) -> TierParticipant:
+        part = TierParticipant(name, evict, cap, nbytes)
+        self._parts[name] = part
+        return part
+
+    def unregister(self, part: TierParticipant) -> None:
+        self._parts.pop(part.name, None)
+        _METRICS.state_tier_resident.remove(executor=part.name)
+        _METRICS.state_tier_bytes.remove(executor=part.name)
+
+    # -- hot path ---------------------------------------------------------
+    @staticmethod
+    def touch(part: TierParticipant, keys: Iterable, seq: int,
+              insert: bool = True) -> None:
+        """Refresh recency for `keys`. ``insert=False`` refreshes only
+        keys already tracked (probe touches of the OTHER join side must
+        not mint phantom residents)."""
+        d = part.keys
+        for k in keys:
+            if k in d:
+                del d[k]
+            elif not insert:
+                continue
+            d[k] = seq
+
+    @staticmethod
+    def forget(part: TierParticipant, keys: Iterable) -> None:
+        d = part.keys
+        for k in keys:
+            d.pop(k, None)
+
+    @staticmethod
+    def note_reload(part: TierParticipant, n: int) -> None:
+        part.reload_total += n
+        _METRICS.state_tier_reloads.inc(n, executor=part.name)
+
+    # -- the barrier sweep ------------------------------------------------
+    def _pressure(self) -> bool:
+        mem = self._mem()
+        if mem.soft_limit is None:
+            return False
+        return mem.last_total > mem.soft_limit
+
+    def sweep(self, part: TierParticipant, seq: int) -> int:
+        """Evict this participant's oldest keys past its cap (or past
+        the pressure watermark). Runs ONLY at the owner's checkpoint
+        barrier — see the module docstring's epoch-sequencing argument.
+        Returns keys evicted."""
+        del seq                       # recency clock; policy is size-based
+        resident = len(part.keys)
+        target = None
+        if part.cap is not None and resident > part.cap:
+            target = int(part.cap * self.EVICT_TARGET_RATIO)
+        if self._pressure() and resident > 0:
+            ptarget = int(resident * self.PRESSURE_KEEP_RATIO)
+            target = ptarget if target is None else min(target, ptarget)
+        if target is None:
+            self._refresh_gauges(part)
+            return 0
+        n_evict = resident - target
+        victims = []
+        for k in part.keys:           # oldest-first iteration order
+            if len(victims) >= n_evict:
+                break
+            victims.append(k)
+        n = 0
+        if victims:
+            # the callback returns keys ACTUALLY evicted (stale/
+            # phantom entries are no-ops there) — count those, not the
+            # request, or rw_state_tier overreports
+            n = int(part.evict(victims))
+            for k in victims:
+                del part.keys[k]
+            if n:
+                part.evicted_total += n
+                _METRICS.state_tier_evicted.inc(n, executor=part.name)
+        self._refresh_gauges(part)
+        return n
+
+    def _refresh_gauges(self, part: TierParticipant) -> None:
+        _METRICS.state_tier_resident.set(len(part.keys),
+                                         executor=part.name)
+        if part.nbytes is not None:
+            _METRICS.state_tier_bytes.set(int(part.nbytes()),
+                                          executor=part.name)
+
+    # -- introspection (rw_state_tier) ------------------------------------
+    def stats_rows(self) -> List[Tuple]:
+        """(executor, cap, resident_keys, evicted_total, reload_total,
+        accounted_bytes) per participant — the rw_state_tier payload."""
+        out = []
+        for p in self._parts.values():
+            out.append((p.name, -1 if p.cap is None else int(p.cap),
+                        len(p.keys), p.evicted_total, p.reload_total,
+                        0 if p.nbytes is None else int(p.nbytes())))
+        return out
+
+
+# the process-global tier (managed-LRU registry analog)
+GLOBAL = StateTier()

@@ -218,7 +218,8 @@ class HashAggExecutor(Executor):
                  kernel: Optional[object] = None,
                  distinct_tables: Optional[Dict[int, StateTable]] = None,
                  kernel_capacity: Optional[int] = None,
-                 flush_capacity: Optional[int] = None):
+                 flush_capacity: Optional[int] = None,
+                 tier_cap: Optional[int] = None):
         self.input = input_
         self.group_indices = list(group_indices)
         self.agg_calls = list(agg_calls)
@@ -280,8 +281,9 @@ class HashAggExecutor(Executor):
             from risingwave_tpu.ops.hash_agg import HLL_M as _M
             sketches = sum((_M + 120) * len(d)
                            for d in s._hll_regs.values())
+            cold = 120 * len(getattr(s, "_cold_groups", ()))
             return (s.key_codec.interner_nbytes() + distinct + pend
-                    + sketches)
+                    + sketches + cold)
 
         _mem.GLOBAL.register(mem_name, _nbytes)
         # dense-HLL calls: sketch registry host-side, one BYTEA aux
@@ -361,6 +363,31 @@ class HashAggExecutor(Executor):
         super().__init__(ExecutorInfo(
             out_schema, list(range(len(group_indices))),
             f"HashAggExecutor(actor={actor_id})"))
+        # cold-tier participation (state/tier.py): groups past the cap
+        # evict — device slots + host mirrors (distinct multisets, HLL
+        # registers) drop, the value-state/aux tables stay durable —
+        # and a later touch of an evicted group reloads it before the
+        # chunk applies. Agg state is FULLY durable, so reload-on-touch
+        # is retraction-safe (a delete touching a cold group reloads
+        # first, then retracts normally). Single-chip lazy kernel only:
+        # the sharded kernel's vnode routing has no targeted-evict path.
+        self._tier = None
+        self._tier_part = None
+        self._cold_groups: Dict[tuple, tuple] = {}
+        self._tier_seq = 0            # barrier counter = LRU clock
+        self.tier_cap = tier_cap      # fragmenter ships this in the IR
+        if tier_cap is not None:
+            if kernel is not None:
+                raise ValueError(
+                    "tier_cap needs the single-chip lazy kernel "
+                    "(sharded kernels have no targeted-evict path)")
+            from risingwave_tpu.state import tier as _tier
+            self._tier = _tier.GLOBAL
+            # registration is DEFERRED to execute(): plan-only
+            # executors (EXPLAIN, distributed CREATEs that serialize
+            # to IR and discard) must leave no ghost entries in the
+            # process-global registry
+            self._tier_nbytes = _nbytes
 
     @property
     def kernel(self):
@@ -396,6 +423,8 @@ class HashAggExecutor(Executor):
         key_lanes = self.key_codec.build(chunk, self.group_indices)
         signs = np.asarray(chunk.signs())
         vis = np.asarray(chunk.visibility)
+        if self._tier is not None:
+            self._tier_touch(key_lanes, vis)
         # one kernel.apply below = one fused device dispatch (~2ms host
         # cost through the tunnel): the metric pair the coalescing
         # layer optimizes — fewer dispatches, denser rows per dispatch
@@ -627,6 +656,146 @@ class HashAggExecutor(Executor):
     def _write_minput_pending(self) -> None:
         self._write_multiset_pending(self._minput_pending, self.minput)
 
+    # -- cold tier (state/tier.py) ---------------------------------------
+    def _tier_register(self) -> None:
+        """Register with the global tier at execute() start — only
+        executors that actually RUN appear in the registry."""
+        import weakref
+        tref = weakref.ref(self)
+
+        def _evict_cb(keys):
+            s = tref()
+            return 0 if s is None else s._tier_evict(keys)
+
+        self._tier_part = self._tier.register(
+            f"{self.identity}#{id(self)}", _evict_cb,
+            cap=int(self.tier_cap), nbytes=self._tier_nbytes)
+
+    @staticmethod
+    def _pyval(x):
+        return x.item() if hasattr(x, "item") else x
+
+    def _tier_touch(self, key_lanes: np.ndarray,
+                    vis: np.ndarray) -> None:
+        """LRU recency + reload-on-touch: the chunk's distinct group
+        keys refresh the tier clock, and any that are COLD reload from
+        their committed state rows BEFORE this chunk's device apply."""
+        rows = np.flatnonzero(vis)
+        if not len(rows):
+            return
+        uniq = np.unique(key_lanes[rows], axis=0)
+        tuples = list(map(tuple, uniq.tolist()))
+        self._tier.touch(self._tier_part, tuples, self._tier_seq)
+        if self._cold_groups:
+            need = [t for t in tuples if t in self._cold_groups]
+            if need:
+                self._reload_groups(need)
+
+    def _reload_groups(self, lanes_ts: List[tuple]) -> None:
+        """Reload evicted groups (the _reload_cold analog): device
+        accumulators from the value-state row, distinct-multiset and
+        HLL-register mirrors from their aux tables. Fully durable state
+        makes this retraction-safe — a delete touching a cold group
+        reloads first, then retracts against exact state."""
+        from risingwave_tpu.ops.hash_agg import hll_estimate_dense
+        ng = len(self.group_indices)
+        rows: List[tuple] = []
+        lanes_keep: List[tuple] = []
+        groups: List[tuple] = []
+        for lt in lanes_ts:
+            vt = self._cold_groups.pop(lt)
+            row = self.table.get_row(vt)
+            if row is None:
+                continue       # retired under a watermark while cold
+            rows.append(row)
+            lanes_keep.append(lt)
+            groups.append(vt)
+        if not rows:
+            return
+        keys = np.asarray(lanes_keep, dtype=np.int32)
+        grows = np.asarray([int(r[ng]) for r in rows], dtype=np.int64)
+        acc_cols = [
+            np.asarray([0 if r[ng + 1 + j] is None else r[ng + 1 + j]
+                        for r in rows], dtype=dt)
+            for j, dt in enumerate(acc_dtypes(self.specs))]
+        self.kernel.load_groups(keys, grows, acc_cols)
+        for col, t in self.distinct_tables.items():
+            mult = self._distinct_mult.setdefault(col, {})
+            for vt in groups:
+                for _pk, row in t.iter_prefix(list(vt)):
+                    mult[tuple(row[:-1])] = int(row[-1])
+        for j, t in self.hll_tables.items():
+            for vt in groups:
+                row = t.get_row(vt)
+                if row is not None:
+                    arr = np.frombuffer(row[-1], dtype=np.uint8).copy()
+                    self._hll_regs[j][vt] = arr
+                    self._hll_prev[j][vt] = int(
+                        hll_estimate_dense(arr)[0])
+        self._tier.note_reload(self._tier_part, len(rows))
+
+    def _tier_evict(self, lanes_ts: List[tuple]) -> int:
+        """Tier sweep callback (checkpoint barriers only, post-flush):
+        move the given groups to the cold tier — device slots rebuild
+        away, host mirrors drop, durable rows stay. Groups with NO
+        durable row (retracted to zero, watermark-cleaned) are
+        phantoms: skipped, not marked cold, not counted — the tier's
+        counters are in keys ACTUALLY evicted."""
+        mat = np.asarray(lanes_ts, dtype=np.int32)
+        gk = self._group_key_host(mat)
+        kept_lanes: List[tuple] = []
+        kept_groups: List[tuple] = []
+        for r, lt in enumerate(lanes_ts):
+            vt = tuple(None if not ok[r] else self._pyval(vals[r])
+                       for vals, ok in gk)
+            if self.table.get_row(vt) is None:
+                continue
+            kept_lanes.append(lt)
+            kept_groups.append(vt)
+        if not kept_lanes:
+            return 0
+        self.kernel.evict_keys(np.asarray(kept_lanes, dtype=np.int32))
+        for lt, vt in zip(kept_lanes, kept_groups):
+            self._cold_groups[lt] = vt
+        gset = set(kept_groups)
+        ng = len(self.group_indices)
+        for col, mult in self._distinct_mult.items():
+            if mult:
+                self._distinct_mult[col] = {
+                    k: v for k, v in mult.items()
+                    if k[:ng] not in gset}
+        for j in self._hll_calls:
+            self._hll_regs[j] = {k: v for k, v in
+                                 self._hll_regs[j].items()
+                                 if k not in gset}
+            self._hll_prev[j] = {k: v for k, v in
+                                 self._hll_prev[j].items()
+                                 if k not in gset}
+        self._deleted_lanes -= set(kept_lanes)
+        return len(kept_lanes)
+
+    def _tier_forget_expired(self, phys: int) -> None:
+        """Watermark cleaning retired groups below `phys`: drop their
+        cold markers (rows already range-deleted) and their resident
+        tier entries (retired on device by retire_below)."""
+        if self._cold_groups:
+            self._cold_groups = {
+                lt: vt for lt, vt in self._cold_groups.items()
+                if vt[0] is None or vt[0] >= phys}
+        part = self._tier_part
+        if part is None or not part.keys:
+            return
+        from risingwave_tpu.ops import lanes as _lanes
+        keys_list = list(part.keys)
+        mat = np.asarray(keys_list, dtype=np.int64)
+        ok = mat[:, 2] != 0
+        v = _lanes.merge_i64(mat[:, 0].astype(np.int32),
+                             mat[:, 1].astype(np.int32))
+        dead = ok & (v < phys)
+        if dead.any():
+            self._tier.forget(part, [
+                k for k, d in zip(keys_list, dead.tolist()) if d])
+
     # -- watermark state cleaning ----------------------------------------
     def _cleanable_type(self) -> bool:
         """Integer-family first group col only: the device compare runs
@@ -666,6 +835,8 @@ class HashAggExecutor(Executor):
             self._hll_prev[j] = {
                 k: v for k, v in self._hll_prev[j].items()
                 if k[0] is None or k[0] >= phys}
+        if self._tier is not None:
+            self._tier_forget_expired(phys)
         self._cleaned_wm = wm
         _METRICS.agg_rows_cleaned.inc(n, executor=self.identity)
 
@@ -962,6 +1133,14 @@ class HashAggExecutor(Executor):
         self._live_groups = len(rows_l)
         if not rows_l:
             return
+        if self._tier is not None:
+            # recovery rebuilds EVERYTHING resident (cold markers do
+            # not survive a crash); seeding the tier clock with the
+            # recovered keys lets the first checkpoint sweep re-apply
+            # the cap instead of carrying the full set forever
+            self._tier.touch(self._tier_part,
+                             [tuple(k.tolist()) for k in keys_l],
+                             self._tier_seq)
         keys = np.stack(keys_l)
         dts = acc_dtypes(self.specs)
         acc_cols = []
@@ -977,6 +1156,8 @@ class HashAggExecutor(Executor):
         it = self.input.execute()
         first = await it.__anext__()
         assert is_barrier(first), f"expected init barrier, got {first!r}"
+        if self._tier is not None:
+            self._tier_register()
         self.table.init_epoch(first.epoch)
         for t in self.minput.values():
             t.init_epoch(first.epoch)
@@ -1015,6 +1196,15 @@ class HashAggExecutor(Executor):
                         t.commit(msg.epoch)
                     for t in self.distinct_tables.values():
                         t.commit(msg.epoch)
+                    if self._tier is not None:
+                        # sweep at CHECKPOINT barriers only, after the
+                        # flush+advance+commit above — the evicted
+                        # groups are provably clean and durable, and no
+                        # epoch is in flight (tier.py epoch-sequencing)
+                        self._tier_seq += 1
+                        if msg.kind.is_checkpoint:
+                            self._tier.sweep(self._tier_part,
+                                             self._tier_seq)
                     if out is not None:
                         yield out
                     yield msg
@@ -1029,3 +1219,5 @@ class HashAggExecutor(Executor):
             # executor teardown: release this identity's gauge series
             _METRICS.agg_dirty_groups.remove(executor=self.identity)
             _METRICS.agg_table_capacity.remove(executor=self.identity)
+            if self._tier_part is not None:
+                self._tier.unregister(self._tier_part)

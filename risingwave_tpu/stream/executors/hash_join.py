@@ -199,14 +199,18 @@ class _JoinSide:
         self.free: List[int] = []
         self.next_ref = 0
         # cold-state tier (managed_state/join/mod.rs:379-420 LRU-over-
-        # StateTable analog): when resident rows exceed state_cap, the
-        # OLDEST keys evict — their rows leave the arena + device but
-        # stay durable in the state table; a later probe of an evicted
-        # key reloads it first (see HashJoinExecutor._reload_cold).
-        # cold_keys: key LANES tuple → key VALUES tuple (the values
-        # drive the state-table prefix scan on reload)
+        # StateTable analog, driven by state/tier.py): the tier's sweep
+        # hands this side the coldest keys — their rows leave the arena
+        # + device (see evict_keys) but stay durable in the state
+        # table; a later probe of an evicted key reloads it first (see
+        # HashJoinExecutor._reload_cold). cold_keys: key LANES tuple →
+        # key VALUES tuple (the values drive the state-table prefix
+        # scan on reload)
         self.state_cap: Optional[int] = None
         self.cold_keys: Dict[tuple, tuple] = {}
+        # lanes of keys watermark-expiry dropped (resident AND cold) —
+        # the executor drains these into tier.forget after each sweep
+        self.expired_lanes: List[tuple] = []
         # per-ref match degree (outer/semi/anti bookkeeping; see
         # JoinType docstring) — grown alongside the arena
         self.degrees = np.zeros(self.arena.cap, dtype=np.int64)
@@ -445,6 +449,7 @@ class _JoinSide:
                 and int(vt[key_pos]) < int(wm_physical)]
             for lt, vt in dead_cold:
                 del self.cold_keys[lt]
+                self.expired_lanes.append(lt)
                 dead_rows = [tuple(row) for _pk, row
                              in self.table.iter_prefix(list(vt))]
                 if dead_rows:
@@ -479,62 +484,64 @@ class _JoinSide:
         key_cols = [(self.arena.cols[i][dead_refs],
                      self.arena.valid[i][dead_refs])
                     for i in self.key_indices]
+        dead_lanes = self.key_codec.build_arrays(key_cols)
+        if self.state_cap is not None:
+            # tier bookkeeping only: an uncapped side must not grow
+            # this list forever (the executor drains it per barrier,
+            # but only tiered sides have anything to forget)
+            self.expired_lanes.extend(
+                map(tuple, np.unique(dead_lanes, axis=0).tolist()))
         lanes_ = np.zeros((cap, LANES_PER_KEY * len(self.key_indices)),
                           dtype=np.int32)
-        lanes_[:n_dead] = self.key_codec.build_arrays(key_cols)
+        lanes_[:n_dead] = dead_lanes
         self.kernel.delete(del_refs, mask, seq=seq, key_lanes=lanes_)
         return n_dead + n_cold
 
-    # keep ~this fraction of state_cap after an eviction sweep (room
-    # to absorb arrivals before the next sweep)
-    EVICT_TARGET_RATIO = 0.75
-
-    def evict_cold(self) -> int:
-        """FIFO-by-arrival eviction of whole KEYS down to the target
-        (arrival order ≈ recency for streaming windows; every row of an
-        evicted key goes together — a probe must see all or none).
-        Returns rows evicted. Caller guarantees no in-flight probes."""
-        if self.state_cap is None or                 len(self.pk_to_ref) <= self.state_cap:
-            return 0
-        target = int(self.state_cap * self.EVICT_TARGET_RATIO)
+    def evict_keys(self, lanes_ts: Sequence[tuple]
+                   ) -> Tuple[int, int]:
+        """Targeted cold-tier eviction (state/tier.py sweep callback):
+        every row of each given key leaves the arena + device together
+        — a probe must see all or none — but stays durable in the
+        state table. Returns (keys evicted, rows evicted): the tier's
+        counters are in KEYS; the join_rows_evicted metric wants rows.
+        Caller (the tier, at this executor's own checkpoint barrier)
+        guarantees no in-flight probes."""
+        want = set(lanes_ts)
+        if not want or not self.pk_to_ref:
+            return 0, 0
         pks = list(self.pk_to_ref.keys())
         refs = np.fromiter(self.pk_to_ref.values(), dtype=np.int64,
                            count=len(pks))
-        key_vals = []
-        for i in self.key_indices:
-            vals = self.arena.cols[i][refs]
-            ok = self.arena.valid[i][refs]
-            key_vals.append([None if not o else
-                             (v.item() if hasattr(v, "item") else v)
-                             for v, o in zip(vals.tolist(),
-                                             ok.tolist())])
-        by_key: Dict[tuple, list] = {}
-        age: Dict[tuple, int] = {}
-        for j, pk in enumerate(pks):
-            kt = tuple(kv[j] for kv in key_vals)
-            by_key.setdefault(kt, []).append(pk)
-            r = int(refs[j])
-            if age.get(kt, -1) < r:
-                age[kt] = r
+        key_cols = [(self.arena.cols[i][refs],
+                     self.arena.valid[i][refs])
+                    for i in self.key_indices]
+        lane_rows = list(map(tuple,
+                             self.key_codec.build_arrays(key_cols)
+                             .tolist()))
         evicted = 0
-        live = len(self.pk_to_ref)
-        for kt in sorted(age, key=age.get):
-            if live - evicted <= target:
-                break
-            if any(v is None for v in kt):
-                continue               # null-key rows never probe-match
-            for pk in by_key[kt]:
-                ref = self.pk_to_ref.pop(pk)
-                self.free.append(ref)
-                evicted += 1
-            lanes_t = tuple(
-                self.key_codec.lanes_of_values(list(kt)).tolist())
-            self.cold_keys[lanes_t] = kt
+        vt_by_lane: Dict[tuple, tuple] = {}
+        for j, lt in enumerate(lane_rows):
+            if lt not in want:
+                continue
+            if lt not in vt_by_lane:
+                vt = tuple(
+                    None if not ok[j] else
+                    (v[j].item() if hasattr(v[j], "item") else v[j])
+                    for v, ok in key_cols)
+                if any(x is None for x in vt):
+                    continue       # null-key rows are never stored
+                vt_by_lane[lt] = vt
+            ref = self.pk_to_ref.pop(pks[j])
+            self.free.append(ref)
+            evicted += 1
+        self.cold_keys.update(vt_by_lane)
         if evicted:
             # compaction rebuilds arena + device from the survivors —
-            # evicted rows leave the kernel wholesale
+            # evicted rows leave the kernel wholesale (degree state of
+            # evicted rows drops too: a degree is a pure function of
+            # both sides' durable state, recomputed on reload)
             self.compact()
-        return evicted
+        return len(vt_by_lane), evicted
 
     def reload_keys(self, need: Dict[tuple, tuple]) -> tuple:
         """Reload evicted keys' rows from the state table (arena +
@@ -699,15 +706,22 @@ class HashJoinExecutor(Executor):
         # derived WITHOUT touching .kernel: the lazy property exists so
         # plan-only processes never build device state
         self._epoch_batch = self.sides[0]._mesh is None
+        self._tier = None
+        self._tier_parts: Tuple = (None, None)
+        self._tier_seq = 0
         if state_cap is not None:
             # cold-state tier prerequisites: epoch-batched single-chip
-            # path (reload hooks the epoch dispatch), INNER join
-            # (degree history of evicted rows would be lost), and
-            # key-prefixed state-table pks (reload prefix-scans by key)
-            if join_type != JoinType.INNER or not self._epoch_batch:
+            # path (reload hooks the epoch dispatch), a non-semi/anti
+            # join (semi/anti emission depends on degree TRANSITIONS
+            # whose history an eviction would lose; outer degrees are
+            # pure functions of both sides' durable state and recompute
+            # on reload — see _reload_cold), and key-prefixed
+            # state-table pks (reload prefix-scans by key)
+            if join_type.is_semi_or_anti or not self._epoch_batch:
                 raise ValueError(
-                    "state_cap needs an INNER join on the single-chip "
-                    "epoch-batched path")
+                    "state_cap needs an INNER or OUTER join on the "
+                    "single-chip epoch-batched path (semi/anti "
+                    "degree-transition history cannot be evicted)")
             for side in self.sides:
                 k = len(side.key_indices)
                 if side.table.pk_indices[:k] != side.key_indices:
@@ -717,6 +731,15 @@ class HashJoinExecutor(Executor):
                         f"pk={side.table.pk_indices} "
                         f"keys={side.key_indices}")
                 side.state_cap = int(state_cap)
+            # tier participation (state/tier.py): one participant per
+            # side; the sweep at this executor's checkpoint barrier
+            # picks the least-recently-touched keys. Registration is
+            # DEFERRED to execute() — plan-only executors (EXPLAIN,
+            # distributed CREATEs that serialize to IR and discard)
+            # must leave no ghost entries in the global registry.
+            from risingwave_tpu.state import tier as _tier_mod
+            self._tier = _tier_mod.GLOBAL
+            self._tier_cap = int(state_cap)
         self._epoch_buf: tuple = ([], [])
         self._epoch_rows = [0, 0]
         # host-state accounting (memory_manager.rs analog): weakref so
@@ -857,6 +880,18 @@ class HashJoinExecutor(Executor):
         seq = self._seq
         self._seq += 1
         probe_vis = np.asarray(chunk.visibility) & nonnull
+        if self._tier is not None:
+            rows = np.flatnonzero(probe_vis)
+            if len(rows):
+                uniq = list(map(tuple, np.unique(
+                    np.asarray(key_lanes)[rows], axis=0).tolist()))
+                # stored here → full touch; the probe only REFRESHES
+                # the other side's recency (insert=False: a probed key
+                # the other side never stored must not mint a phantom)
+                self._tier.touch(self._tier_parts[side_idx], uniq,
+                                 self._tier_seq)
+                self._tier.touch(self._tier_parts[1 - side_idx], uniq,
+                                 self._tier_seq, insert=False)
         (ins_idx, ins_refs, full_refs, ins_mask, del_refs,
          del_mask) = me.apply_chunk_host(chunk, nonnull)
         if not self._epoch_batch:
@@ -940,32 +975,97 @@ class HashJoinExecutor(Executor):
                   for s, (ld, ad, _t, _m) in devs.items()}
         return {s: p.collect() for s, p in probes.items()}
 
+    def _tier_register(self) -> None:
+        """Register both sides with the global tier at execute() start
+        — only executors that actually RUN appear in the registry."""
+        import weakref
+        sref = weakref.ref(self)
+        parts = []
+        for i in (0, 1):
+            def _evict_cb(keys, _i=i):
+                s = sref()
+                if s is None:
+                    return 0
+                n_keys, n_rows = s.sides[_i].evict_keys(keys)
+                if n_rows:
+                    _METRICS.join_rows_evicted.inc(
+                        n_rows, executor=s.identity)
+                return n_keys
+
+            def _nbytes_cb(_i=i):
+                s = sref()
+                return 0 if s is None else s.sides[_i].nbytes()
+
+            parts.append(self._tier.register(
+                f"{self.identity}/side{i}#{id(self)}", _evict_cb,
+                cap=self._tier_cap, nbytes=_nbytes_cb))
+        self._tier_parts = tuple(parts)
+
     def _reload_cold(self) -> None:
         """Reload evicted keys this epoch's probes will need, BEFORE
         the epoch's applies/probes dispatch (managed_state/join reload-
         on-miss, batched per barrier). The reload insert applies at
-        seq 0 so every probe of the epoch sees the reloaded rows."""
+        seq 0 so every probe of the epoch sees the reloaded rows.
+
+        Tracked (outer) joins reload a needed key on BOTH sides: the
+        reloaded rows' degrees recompute by probing the opposite
+        kernel, and a cold twin there would undercount. The recompute
+        runs after both sides' reload applies, against pre-epoch state
+        — this epoch's own chunks then layer their degree deltas on
+        top in message order (_emit_one step 3), exactly as if the
+        rows had never left."""
         from risingwave_tpu.ops.hash_join import FLAG_PROBE
         import jax
+        need: List[Dict[tuple, tuple]] = [{}, {}]
         for s in (0, 1):
             other = self.sides[1 - s]
             if not other.cold_keys or not self._epoch_buf[s]:
                 continue
-            need: Dict[tuple, tuple] = {}
             for lan, aux, _mr in self._epoch_buf[s]:
                 rows = np.flatnonzero(aux[:, 2] & FLAG_PROBE)
                 for t in map(tuple, lan[rows].tolist()):
                     v = other.cold_keys.get(t)
                     if v is not None:
-                        need[t] = v
-            if not need:
+                        need[1 - s][t] = v
+        if self.join_type.tracked_sides:
+            for s in (0, 1):
+                twin = self.sides[s]
+                if not twin.cold_keys:
+                    continue
+                for t in need[1 - s]:
+                    v = twin.cold_keys.get(t)
+                    if v is not None:
+                        need[s][t] = v
+        reloaded: List[Optional[tuple]] = [None, None]
+        for s in (0, 1):
+            if not need[s]:
                 continue
-            loaded = other.reload_keys(need)
+            loaded = self.sides[s].reload_keys(need[s])
             if loaded is not None:
                 lanes, aux2, n, max_ref = loaded
-                other.kernel.apply_epoch(
+                self.sides[s].kernel.apply_epoch(
                     jax.device_put(lanes), jax.device_put(aux2), n,
                     max_ref)
+                reloaded[s] = (lanes, aux2, n)
+                if self._tier is not None:
+                    part = self._tier_parts[s]
+                    uniq = np.unique(lanes[:n], axis=0)
+                    self._tier.touch(part,
+                                     map(tuple, uniq.tolist()),
+                                     self._tier_seq)
+                    # units contract: reload counters are in KEYS
+                    self._tier.note_reload(part, len(uniq))
+        for t_side in self.join_type.tracked_sides:
+            rl = reloaded[t_side]
+            if rl is None:
+                continue
+            lanes, aux2, n = rl
+            refs = aux2[:n, 0].astype(np.int64)
+            deg, _pi, _refs = self.sides[1 - t_side].kernel.probe(
+                lanes[:n], np.ones(n, dtype=bool))
+            side = self.sides[t_side]
+            side.ensure_degrees(int(refs.max()))
+            side.degrees[refs] = deg[:n]
 
     def _emit_pending(self) -> List[StreamChunk]:
         """Barrier sweep: collect the epoch's probes and run emission
@@ -1197,53 +1297,88 @@ class HashJoinExecutor(Executor):
         first_r = await rit.__anext__()
         assert is_barrier(first_l) and is_barrier(first_r)
         assert first_l.epoch == first_r.epoch
-        for side in self.sides:
+        if self._tier is not None:
+            self._tier_register()
+        for i, side in enumerate(self.sides):
             side.table.init_epoch(first_l.epoch)
             side.recover()
+            if self._tier_parts[i] is not None and side.pk_to_ref:
+                # recovery rebuilds everything RESIDENT (cold markers
+                # do not survive a crash); seed the tier clock so the
+                # first checkpoint sweep re-applies the cap
+                refs = np.fromiter(side.pk_to_ref.values(),
+                                   dtype=np.int64,
+                                   count=len(side.pk_to_ref))
+                key_cols = [(side.arena.cols[j][refs],
+                             side.arena.valid[j][refs])
+                            for j in side.key_indices]
+                lanes_all = side.key_codec.build_arrays(key_cols)
+                self._tier.touch(
+                    self._tier_parts[i],
+                    map(tuple, np.unique(lanes_all, axis=0).tolist()),
+                    self._tier_seq)
         self._recover_degrees()
         yield first_l
-        async for tag, msg in barrier_align_2(lit, rit):
-            if tag == "barrier":
-                # consume pending probes FIRST — expiry/compaction
-                # rebuild device state and would invalidate a
-                # re-dispatched probe's sequence view
-                for out in self._emit_pending():
-                    yield out
-                self._expire_state()
-                for side in self.sides:
-                    side.table.commit(msg.epoch)
-                    evicted = side.evict_cold()
-                    if evicted:
-                        _METRICS.join_rows_evicted.inc(
-                            evicted, executor=self.identity)
-                    else:
-                        side.maybe_compact()
-                self._maybe_gc_interner()
-                if self._seq > (1 << 30):
-                    # int32 sequence headroom: with no probes in
-                    # flight, rebase every finite seq to 0 and restart
-                    # (a wrap would blank every probe's visibility)
-                    for side in self.sides:
-                        side.kernel.rebase_seq()
-                    self._seq = 1
-                yield msg
-            elif tag in ("left", "right"):
-                i = 0 if tag == "left" else 1
-                if isinstance(msg, StreamChunk):
-                    # one host→device upload of the key lanes (inside
-                    # the kernel's fused dispatch), shared by the probe
-                    # and this side's insert; the nonnull mask falls
-                    # out of the same pass
-                    lanes_np, nonnull = \
-                        self.sides[i].key_codec.build_with_mask(
-                            msg, self.sides[i].key_indices)
-                    self._ingest_chunk(i, msg, lanes_np, nonnull)
-                elif isinstance(msg, Watermark):
-                    wms = list(self._on_watermark(i, msg))
-                    if wms:
-                        # buffered join outputs must precede any
-                        # watermark that could close windows over them
-                        for out in self._emit_pending():
-                            yield out
-                    for wm in wms:
-                        yield wm
+        try:
+            async for tag, msg in barrier_align_2(lit, rit):
+                if tag == "barrier":
+                    # consume pending probes FIRST — expiry/compaction
+                    # rebuild device state and would invalidate a
+                    # re-dispatched probe's sequence view
+                    for out in self._emit_pending():
+                        yield out
+                    self._expire_state()
+                    self._tier_seq += 1
+                    for i, side in enumerate(self.sides):
+                        side.table.commit(msg.epoch)
+                        swept = 0
+                        part = self._tier_parts[i]
+                        if side.expired_lanes:
+                            if part is not None:
+                                self._tier.forget(part,
+                                                  side.expired_lanes)
+                            side.expired_lanes = []
+                        if part is not None:
+                            if msg.kind.is_checkpoint:
+                                # sweep at checkpoints only, after the
+                                # commit above: evicted rows are durable
+                                # and no probe is in flight (tier.py
+                                # epoch-sequencing argument)
+                                swept = self._tier.sweep(part,
+                                                         self._tier_seq)
+                        if not swept:
+                            side.maybe_compact()
+                    self._maybe_gc_interner()
+                    if self._seq > (1 << 30):
+                        # int32 sequence headroom: with no probes in
+                        # flight, rebase every finite seq to 0 and restart
+                        # (a wrap would blank every probe's visibility)
+                        for side in self.sides:
+                            side.kernel.rebase_seq()
+                        self._seq = 1
+                    yield msg
+                elif tag in ("left", "right"):
+                    i = 0 if tag == "left" else 1
+                    if isinstance(msg, StreamChunk):
+                        # one host→device upload of the key lanes (inside
+                        # the kernel's fused dispatch), shared by the probe
+                        # and this side's insert; the nonnull mask falls
+                        # out of the same pass
+                        lanes_np, nonnull = \
+                            self.sides[i].key_codec.build_with_mask(
+                                msg, self.sides[i].key_indices)
+                        self._ingest_chunk(i, msg, lanes_np, nonnull)
+                    elif isinstance(msg, Watermark):
+                        wms = list(self._on_watermark(i, msg))
+                        if wms:
+                            # buffered join outputs must precede any
+                            # watermark that could close windows over them
+                            for out in self._emit_pending():
+                                yield out
+                        for wm in wms:
+                            yield wm
+        finally:
+            if self._tier is not None:
+                for p in self._tier_parts:
+                    if p is not None:
+                        self._tier.unregister(p)

@@ -100,7 +100,8 @@ class GroupTopNExecutor(Executor):
                  offset: int, limit: Optional[int], state: StateTable,
                  group_indices: Sequence[int] = (),
                  append_only: bool = False,
-                 pk_indices: Optional[Sequence[int]] = None):
+                 pk_indices: Optional[Sequence[int]] = None,
+                 tier_cap: Optional[int] = None):
         # planner chains sometimes know the pk better than the input
         # executor advertises (e.g. a projection over an agg)
         pk = list(pk_indices if pk_indices is not None
@@ -136,6 +137,57 @@ class GroupTopNExecutor(Executor):
         self._fast_keys = all(
             (not d) or input_.schema[i].data_type in numeric
             for i, d in zip(self._sort_cols, self._descs))
+        # host-state accounting (EstimateSize analog): sorted group
+        # caches are exactly the kind of unbounded host cache the
+        # memory manager wants on its books
+        import weakref
+
+        from risingwave_tpu.utils import memory as _mem
+        mem_name = f"{self.identity}#{id(self)}"
+        wref = weakref.ref(self)
+        row_est = 96 + 16 * len(input_.schema)
+
+        def _nbytes() -> int:
+            s = wref()
+            if s is None:
+                _mem.GLOBAL.unregister(mem_name)
+                return 0
+            entries = sum(len(sr.entries) for sr in s.groups.values())
+            return row_est * entries + 120 * len(s._cold_groups)
+
+        _mem.GLOBAL.register(mem_name, _nbytes)
+        # cold tier (state/tier.py): whole GROUP caches evict — the
+        # sorted candidate rows drop from memory but stay durable in
+        # the state table (pk leads with the group key, so reload is
+        # one prefix scan); a chunk touching an evicted group reloads
+        # it BEFORE the old-window capture, so emitted deltas stay
+        # exact. Grouped TopN only: plain TopN is one window — nothing
+        # to tier.
+        self._tier = None
+        self._tier_part = None
+        self._cold_groups: set = set()
+        self._tier_seq = 0
+        if tier_cap is not None:
+            g = len(self.group_indices)
+            if not g:
+                raise ValueError("tier_cap needs a grouped TopN")
+            if state.pk_indices[:g] != self.group_indices:
+                raise ValueError(
+                    "tier_cap needs the state-table pk prefixed by "
+                    "the group key (reload prefix-scans by group): "
+                    f"pk={state.pk_indices} group={self.group_indices}")
+            for i in state.dist_key_indices:
+                if state.pk_indices.index(i) >= g:
+                    raise ValueError(
+                        "tier_cap needs dist keys inside the group "
+                        "prefix")
+            from risingwave_tpu.state import tier as _tier
+            self._tier = _tier.GLOBAL
+            # registration deferred to execute(): plan-only executors
+            # must leave no ghost entries in the global registry
+            self._tier_cap = int(tier_cap)
+            self._tier_name = mem_name
+            self._tier_nbytes = _nbytes
 
     # -- helpers ---------------------------------------------------------
     def _key_of(self, row: tuple):
@@ -163,11 +215,65 @@ class GroupTopNExecutor(Executor):
             g = self._group_of(row)
             self.groups.setdefault(g, _SortedRows()).insert(
                 self._key_of(row), row)
+        if self._tier is not None and self.groups:
+            # everything recovers resident (cold markers do not survive
+            # a crash); seed the tier clock so the first checkpoint
+            # sweep re-applies the cap
+            self._tier.touch(self._tier_part, list(self.groups),
+                             self._tier_seq)
+
+    # -- cold tier (state/tier.py) ---------------------------------------
+    def _tier_register(self) -> None:
+        """Register at execute() start — only executors that actually
+        RUN appear in the global registry."""
+        import weakref
+        tref = weakref.ref(self)
+
+        def _evict_cb(keys):
+            s = tref()
+            return 0 if s is None else s._tier_evict(keys)
+
+        self._tier_part = self._tier.register(
+            self._tier_name, _evict_cb, cap=self._tier_cap,
+            nbytes=self._tier_nbytes)
+
+    def _tier_evict(self, groups: List[tuple]) -> int:
+        """Tier sweep callback (checkpoint barriers, post-commit): drop
+        the given groups' sorted caches; their candidate rows stay
+        durable in the state table."""
+        n = 0
+        for g in groups:
+            if self.groups.pop(g, None) is not None:
+                self._cold_groups.add(g)
+                n += 1
+        return n
+
+    def _reload_group(self, g: tuple) -> None:
+        """Reload an evicted group's candidates with one prefix scan —
+        runs BEFORE the old-window capture, so the emitted delta is
+        computed against the true pre-chunk window."""
+        self._cold_groups.discard(g)
+        rows = _SortedRows()
+        for _pk, row in self.state.iter_prefix(list(g)):
+            row = tuple(row)
+            rows.insert(self._key_of(row), row)
+        if rows.entries:
+            self.groups[g] = rows
+        self._tier.note_reload(self._tier_part, 1)
 
     # -- chunk path ------------------------------------------------------
     def _apply(self, chunk: StreamChunk) -> Optional[StreamChunk]:
         touched: Dict[tuple, List[tuple]] = {}
         _idx, prows, pops = chunk.to_physical_records()
+        # cold groups this chunk touches reload BEFORE write_chunk:
+        # the reload prefix-scan must see PRE-chunk state only, or the
+        # old-window capture would already contain this chunk's rows
+        # (suppressing deltas) and the loop would double-insert them
+        if self._cold_groups:
+            for row in prows:
+                g = self._group_of(row)
+                if g in self._cold_groups:
+                    self._reload_group(g)
         # state writes batch as ONE vectorized chunk apply (the same
         # insert/delete multiset the loop below maintains in memory) —
         # a per-row insert() pays a full pk encode each (the other q5
@@ -191,6 +297,9 @@ class GroupTopNExecutor(Executor):
                     raise ValueError(
                         "delete on append-only TopN input")
                 rows.delete(key, row)
+        if self._tier is not None and touched:
+            self._tier.touch(self._tier_part, list(touched),
+                             self._tier_seq)
         # net window delta per touched group
         deletes: List[tuple] = []
         inserts: List[tuple] = []
@@ -228,20 +337,34 @@ class GroupTopNExecutor(Executor):
         it = self.input.execute()
         first = await it.__anext__()
         assert is_barrier(first)
+        if self._tier is not None:
+            self._tier_register()
         self.state.init_epoch(first.epoch)
         self._recover()
         yield first
-        async for msg in it:
-            if is_chunk(msg):
-                out = self._apply(msg)
-                if out is not None:
-                    yield out
-            elif is_barrier(msg):
-                self.state.commit(msg.epoch)
-                yield msg
-            elif is_watermark(msg):
-                if msg.col_idx in self.group_indices:
-                    yield msg    # group-key watermarks pass through
+        try:
+            async for msg in it:
+                if is_chunk(msg):
+                    out = self._apply(msg)
+                    if out is not None:
+                        yield out
+                elif is_barrier(msg):
+                    self.state.commit(msg.epoch)
+                    if self._tier is not None:
+                        # sweep at checkpoints, post-commit: evicted
+                        # groups' rows are durable and no chunk is in
+                        # flight (tier.py epoch-sequencing argument)
+                        self._tier_seq += 1
+                        if msg.kind.is_checkpoint:
+                            self._tier.sweep(self._tier_part,
+                                             self._tier_seq)
+                    yield msg
+                elif is_watermark(msg):
+                    if msg.col_idx in self.group_indices:
+                        yield msg   # group-key watermarks pass through
+        finally:
+            if self._tier_part is not None:
+                self._tier.unregister(self._tier_part)
 
 
 def TopNExecutor(input_: Executor, order_by, offset, limit,

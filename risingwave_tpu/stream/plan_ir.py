@@ -320,13 +320,15 @@ def build_fragment(nodes: List[dict], store, local,
                             [int(i) for i in node["right_pk"]], store,
                             dist_key_indices=node.get(
                                 "right_dist_key"))
+            cap = node.get("state_cap")
             ex = HashJoinExecutor(
                 left, right,
                 [int(i) for i in node["left_keys"]],
                 [int(i) for i in node["right_keys"]], lt, rt,
                 actor_id=int(actor_id or 0),
                 join_type=JoinType(node.get("join_type", "inner")),
-                output_names=node.get("output_names"))
+                output_names=node.get("output_names"),
+                state_cap=None if cap is None else int(cap))
         elif op == "materialize":
             from risingwave_tpu.stream.executors.materialize import (
                 MaterializeExecutor,
@@ -377,12 +379,14 @@ def build_fragment(nodes: List[dict], store, local,
                     dedup_ids, "dedup_table_ids", col),
                 minput_table_id=lambda j: _shipped_id(
                     minput_ids, "minput_table_ids", j))
+            tier_cap = node.get("tier_cap")
             ex = HashAggExecutor(
                 child, group, calls, table,
                 append_only=append_only,
                 output_names=node.get("output_names"),
                 distinct_tables=distinct_tables,
-                minput_tables=minput_tables)
+                minput_tables=minput_tables,
+                tier_cap=None if tier_cap is None else int(tier_cap))
         elif op == "top_n":
             from risingwave_tpu.stream.executors.top_n import (
                 GroupTopNExecutor,

@@ -35,6 +35,10 @@ class MemoryContext:
 
     def __init__(self, soft_limit_bytes: Optional[int] = None):
         self.soft_limit = soft_limit_bytes
+        # last accounted total, refreshed at every tick() — the state
+        # tier (state/tier.py) reads this at its barrier sweeps instead
+        # of re-walking every reporter per executor per barrier
+        self.last_total = 0
         self._reporters: Dict[str, Callable[[], int]] = {}
         self._evictors: Dict[str, Callable[[], int]] = {}
 
@@ -57,7 +61,9 @@ class MemoryContext:
         return {n: int(f()) for n, f in list(self._reporters.items())}
 
     def total_bytes(self) -> int:
-        return sum(self.sizes().values())
+        total = sum(self.sizes().values())
+        self.last_total = total
+        return total
 
     def tick(self) -> int:
         """Refresh metrics; evict if over the soft limit. Returns the
@@ -66,6 +72,7 @@ class MemoryContext:
         for name, b in sizes.items():
             _METRICS.host_state_bytes.set(b, cache=name)
         total = sum(sizes.values())
+        self.last_total = total
         if self.soft_limit is None or total <= self.soft_limit:
             return total
         for name in sorted(self._evictors,
@@ -74,6 +81,9 @@ class MemoryContext:
             total -= freed
             if total <= self.soft_limit:
                 break
+        # deferred evictors (the state tier) see the over-limit total
+        # via last_total and sweep at their own barriers
+        self.last_total = max(total, 0)
         return total
 
 

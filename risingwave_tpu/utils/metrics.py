@@ -353,6 +353,20 @@ class StreamingMetrics:
         self.barrier_in_flight = r.gauge(
             "meta_barrier_in_flight_count",
             "injected-but-uncollected barriers")
+        # -- state tiering (state/tier.py cold tier) ------------------
+        self.state_tier_resident = r.gauge(
+            "state_tier_resident_keys",
+            "hot-tier resident keys per registered executor cache")
+        self.state_tier_evicted = r.counter(
+            "state_tier_evicted_keys",
+            "keys evicted to the cold (state-table) tier per executor")
+        self.state_tier_reloads = r.counter(
+            "state_tier_reload_keys",
+            "evicted keys reloaded on touch per executor (the "
+            "degrade-to-reload-traffic path)")
+        self.state_tier_bytes = r.gauge(
+            "state_tier_resident_bytes",
+            "accounted host bytes of tier-governed caches per executor")
         # -- async checkpoint pipeline (storage/uploader.py) ----------
         self.barrier_upload = r.histogram(
             "meta_barrier_upload_seconds",

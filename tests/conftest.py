@@ -57,6 +57,29 @@ def _strict_empty_chunks():
     set_strict_empty_chunks(False)
 
 
+@pytest.fixture(autouse=True)
+def _strict_memory_accounting():
+    """Tier-1 strict mode for the state-tier soft limit: a test that
+    configures MemoryContext.soft_limit (directly or via SET
+    state_tier_soft_limit_mb) fails if the accounted host-state bytes
+    still exceed it at teardown — the tier's pressure sweeps must have
+    brought the state back under the watermark. Tests that set no
+    limit are untouched. The limit is process-global, so it always
+    resets between tests."""
+    from risingwave_tpu.utils import memory as _mem
+    _mem.GLOBAL.soft_limit = None
+    yield
+    limit = _mem.GLOBAL.soft_limit
+    if limit is None:
+        return
+    total = _mem.GLOBAL.total_bytes()
+    _mem.GLOBAL.soft_limit = None
+    assert total <= limit, (
+        f"accounted host state {total}B exceeds the configured "
+        f"state-tier soft limit {limit}B at teardown — pressure "
+        f"eviction failed to bound it")
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     devs = jax.devices()

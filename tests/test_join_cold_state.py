@@ -155,12 +155,15 @@ def test_cold_state_guards():
                          left_keys=[0], right_keys=[0],
                          left_table=lt, right_table=rt, state_cap=8)
     lt2 = StateTable(3, L_SCHEMA, [0, 2], store, dist_key_indices=[0])
-    with pytest.raises(ValueError, match="INNER"):
+    # semi/anti stay excluded (degree-transition HISTORY cannot be
+    # evicted); outer joins are tier-eligible since the state-tiering
+    # subsystem landed — their degrees recompute on reload
+    with pytest.raises(ValueError, match="semi"):
         HashJoinExecutor(MockSource(L_SCHEMA, []),
                          MockSource(R_SCHEMA, []),
                          left_keys=[0], right_keys=[0],
                          left_table=lt2, right_table=rt,
-                         join_type=JoinType.LEFT_OUTER, state_cap=8)
+                         join_type=JoinType.LEFT_SEMI, state_cap=8)
 
 
 def test_cold_state_from_sql():
