@@ -170,7 +170,8 @@ def bench_q1(total_events: int = 50 * 4000, chunk_size: int = 4096):
     return _result("nexmark_q1_events_per_sec", elapsed, rows, p.loop)
 
 
-def bench_q7(total_events: int = 50 * 40_000, chunk_size: int = 8192):
+def bench_q7(total_events: int = 50 * 40_000, chunk_size: int = 8192,
+             fusion: bool = False):
     """q7 core: tumble-window MAX(price) on the device hash-agg kernel.
 
     The stateful baseline config (BASELINE.md: HashAgg on TPU, ≥1M
@@ -185,14 +186,15 @@ def bench_q7(total_events: int = 50 * 40_000, chunk_size: int = 8192):
     cfg = NexmarkConfig(event_num=total_events, max_chunk_size=chunk_size,
                         generate_strings=False)
     p = build_q7(MemoryStateStore(), cfg, rate_limit=32, min_chunks=32,
-                 watermark_delay=Interval(usecs=0))
+                 watermark_delay=Interval(usecs=0), fusion=fusion)
     n_bids = total_events * 46 // 50
     elapsed, rows = asyncio.run(drive_to_completion(
         p, {1: n_bids}, in_flight=IN_FLIGHT))
     return _result("nexmark_q7_events_per_sec", elapsed, rows, p.loop)
 
 
-def bench_q5(total_events: int = 50 * 8_000, chunk_size: int = 4096):
+def bench_q5(total_events: int = 50 * 8_000, chunk_size: int = 4096,
+             fusion: bool = False):
     """q5 (hot items): hop windows + per-window group top-n."""
     from risingwave_tpu.connectors.nexmark import NexmarkConfig
     from risingwave_tpu.models.nexmark import build_q5, drive_to_completion
@@ -200,14 +202,16 @@ def bench_q5(total_events: int = 50 * 8_000, chunk_size: int = 4096):
 
     cfg = NexmarkConfig(event_num=total_events, max_chunk_size=chunk_size,
                         generate_strings=False)
-    p = build_q5(MemoryStateStore(), cfg, rate_limit=16, min_chunks=16)
+    p = build_q5(MemoryStateStore(), cfg, rate_limit=16, min_chunks=16,
+                 fusion=fusion)
     n_bids = total_events * 46 // 50
     elapsed, rows = asyncio.run(drive_to_completion(
         p, {1: n_bids}, in_flight=IN_FLIGHT))
     return _result("nexmark_q5_events_per_sec", elapsed, rows, p.loop)
 
 
-def bench_q8(total_events: int = 50 * 40_000, chunk_size: int = 4096):
+def bench_q8(total_events: int = 50 * 40_000, chunk_size: int = 4096,
+             fusion: bool = False):
     """q8: windowed person⋈auction inner join on the device matcher.
 
     Throughput counts rows entering the pipeline (persons + auctions)."""
@@ -220,14 +224,15 @@ def bench_q8(total_events: int = 50 * 40_000, chunk_size: int = 4096):
     cfg_p = NexmarkConfig(**{**base.__dict__, "table_type": "person"})
     cfg_a = NexmarkConfig(**{**base.__dict__, "table_type": "auction"})
     p = build_q8(MemoryStateStore(), cfg_p, cfg_a, rate_limit=16,
-                 min_chunks=16)
+                 min_chunks=16, fusion=fusion)
     targets = {1: total_events // 50, 2: total_events * 3 // 50}
     elapsed, rows = asyncio.run(drive_to_completion(
         p, targets, in_flight=IN_FLIGHT))
     return _result("nexmark_q8_events_per_sec", elapsed, rows, p.loop)
 
 
-def bench_q3(customers: int = 1500, orders: int = 15000):
+def bench_q3(customers: int = 1500, orders: int = 15000,
+             fusion: bool = False):
     """TPC-H q3 streaming: 3-way join → agg → top-10 (BASELINE config).
 
     Throughput counts rows entering across all three tables."""
@@ -237,7 +242,7 @@ def bench_q3(customers: int = 1500, orders: int = 15000):
     from risingwave_tpu.state.store import MemoryStateStore
 
     p = build_q3(MemoryStateStore(), customers=customers, orders=orders,
-                 rate_limit=16, min_chunks=16)
+                 rate_limit=16, min_chunks=16, fusion=fusion)
     targets = {1: customers, 2: orders, 3: orders * LINES_PER_ORDER}
     elapsed, rows = asyncio.run(drive_to_completion(
         p, targets, in_flight=IN_FLIGHT))
@@ -618,7 +623,10 @@ def _main_locked(argv):
     # warmups run at FULL scale (warm_kw = {}): a smaller warmup
     # leaves capacity-growth XLA compiles inside the timed run — the
     # timed number then measures the compiler, not the pipeline
-    names = ["q7", "q8", "q4", "q3", "q5", "q1"]
+    # fused twins right after their interpretive baselines: the round
+    # diff shows fragment fusion's before/after per query (ISSUE 6)
+    names = ["q7", "q7_fused", "q8", "q8_fused", "q4", "q3",
+             "q3_fused", "q5", "q5_fused", "q1"]
     if quick:
         names = names[:1]
     headline = {}
@@ -664,8 +672,17 @@ def _main_locked(argv):
     print(json.dumps(headline))
 
 
+import functools as _functools
+
 BENCH_FNS.update({"q7": bench_q7, "q8": bench_q8, "q4": bench_q4,
-                  "q3": bench_q3, "q5": bench_q5, "q1": bench_q1})
+                  "q3": bench_q3, "q5": bench_q5, "q1": bench_q1,
+                  # fragment fusion on (SET stream_fusion equivalent
+                  # for the hand-built pipelines)
+                  "q7_fused": _functools.partial(bench_q7, fusion=True),
+                  "q8_fused": _functools.partial(bench_q8, fusion=True),
+                  "q3_fused": _functools.partial(bench_q3, fusion=True),
+                  "q5_fused": _functools.partial(bench_q5,
+                                                 fusion=True)})
 
 
 if __name__ == "__main__":

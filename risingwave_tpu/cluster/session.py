@@ -97,7 +97,7 @@ class DistFrontend:
         # session_vars.py; parallelism is the distributed knob).
         # stream_rewrite_rules rides the same surface as
         # stream_chunk_target_rows: SET here, honored at CREATE time
-        from risingwave_tpu.frontend.opt import parse_rules
+        from risingwave_tpu.frontend.opt import parse_fusion, parse_rules
         from risingwave_tpu.frontend.session_vars import SessionVars
         self.session_vars = SessionVars(
             self, {"streaming_rate_limit": "rate_limit",
@@ -109,8 +109,15 @@ class DistFrontend:
                    "stream_chunk_target_rows": "chunk_target_rows",
                    "stream_coalesce_linger_chunks":
                        "coalesce_linger_chunks"},
-            {"stream_rewrite_rules": "all"},
-            validators={"stream_rewrite_rules": parse_rules})
+            {"stream_rewrite_rules": "all",
+             # fragment fusion (opt/fusion.py). Distributed deploys
+             # fuse at parallelism 1 only: a hash-exchange-fed agg's
+             # index space is post-stage, so the cut would dispatch
+             # raw rows on the wrong columns — the interpretive chain
+             # stays until the sharded kernel grows a prelude path
+             "stream_fusion": "on"},
+            validators={"stream_rewrite_rules": parse_rules,
+                        "stream_fusion": parse_fusion})
         # fragment-graph stats of the last deployed job (exchange
         # hops, exchanged lane widths) — bench + tests read this to
         # see what the rewrite engine bought
@@ -213,9 +220,13 @@ class DistFrontend:
             plan = planner.plan("__explain__", stmt.select, actor_id=0,
                                 rate_limit=self.rate_limit,
                                 min_chunks=self.min_chunks)
+            from risingwave_tpu.frontend.opt import parse_fusion
             return explain_with_rewrite(
                 plan.consumer,
-                self.session_vars.get("stream_rewrite_rules"))
+                self.session_vars.get("stream_rewrite_rules"),
+                fusion=parse_fusion(
+                    self.session_vars.get("stream_fusion"))
+                and self.parallelism == 1)
         if isinstance(stmt, ast.AlterParallelism):
             return await self._alter_parallelism(stmt)
         if isinstance(stmt, ast.Flush):
@@ -250,9 +261,13 @@ class DistFrontend:
         # executor-graph rewrite before lowering (same engine as the
         # in-process session); the fragment-graph pass below then
         # elides exchanges on the shipped plan IR
-        from risingwave_tpu.frontend.opt import apply_rewrites
+        from risingwave_tpu.frontend.opt import (
+            apply_rewrites, parse_fusion,
+        )
         rules = self.session_vars.get("stream_rewrite_rules")
-        apply_rewrites(plan, rules, label=stmt.name)
+        fusion = parse_fusion(self.session_vars.get("stream_fusion")) \
+            and self.parallelism == 1
+        apply_rewrites(plan, rules, label=stmt.name, fusion=fusion)
         if plan.attaches:
             # every FROM <mv> should have inlined (the dict holds all
             # session-created views); a chain attach here means a

@@ -94,7 +94,10 @@ class RwConfig:
     @staticmethod
     def from_toml(path: str, overrides: Optional[Dict[str, Any]] = None
                   ) -> "RwConfig":
-        import tomllib
+        try:
+            import tomllib
+        except ModuleNotFoundError:      # Python < 3.11
+            import tomli as tomllib
         with open(path, "rb") as f:
             raw = tomllib.load(f)
         cfg = RwConfig()

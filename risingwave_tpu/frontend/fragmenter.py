@@ -94,6 +94,22 @@ class FragmentGraph:
         return out
 
 
+def _stages_ir(fs) -> List[dict]:
+    """FusedStages → serializable stage list ({"op":"fused"} payload
+    and the hash_agg node's "fused_stages"); plan_ir rebuilds the
+    composed normal form from it."""
+    out = []
+    for st in fs.stages:
+        if st.kind == "filter":
+            out.append({"kind": "filter",
+                        "pred": expr_to_ir(st.exprs[0])})
+        else:
+            out.append({"kind": "project",
+                        "exprs": [expr_to_ir(e) for e in st.exprs],
+                        "names": list(st.names)})
+    return out
+
+
 def _agg_call_ir(c) -> dict:
     d = {"kind": c.kind.value}
     if c.input_idx is not None:
@@ -220,6 +236,19 @@ class Fragmenter:
             fi, ci = self._lower(ex.input)
             ni = self._append(fi, {"op": "row_id_gen", "input": ci})
             return fi, ni
+        from risingwave_tpu.stream.executors.fused import (
+            FusedFragmentExecutor,
+        )
+        if isinstance(ex, FusedFragmentExecutor):
+            # fused filter/project block: ship the ORIGINAL stage list
+            # (plan_ir re-composes the normal form on the worker, so
+            # the traced program there is byte-equivalent). Watermark
+            # derivations drop like plain distributed projects do.
+            fi, ci = self._lower(ex.input)
+            ni = self._append(fi, {
+                "op": "fused", "input": ci,
+                "stages": _stages_ir(ex.fused_stages)})
+            return fi, ni
         from risingwave_tpu.stream.executors.watermark_filter import (
             WatermarkFilterExecutor,
         )
@@ -269,8 +298,21 @@ class Fragmenter:
                 # coordinator planned
                 "tier_cap": ex.tier_cap,
             }
+            if ex.fused_stages is not None:
+                # the agg's index space is the run's OUTPUT schema —
+                # worker rebuild re-composes the prelude from this
+                node["fused_stages"] = _stages_ir(ex.fused_stages)
             if self.parallelism > 1 and \
                     getattr(ex, "two_phase_role", None) != "local":
+                if ex.fused_stages is not None:
+                    # a hash-exchange cut would dispatch RAW rows on
+                    # post-stage key positions — the sessions gate
+                    # fusion to parallelism 1, so reaching here is a
+                    # planner bug, not a user error
+                    raise FragmentError(
+                        "fused agg cannot take a hash-exchange cut "
+                        "(fusion is parallelism-1 only on the "
+                        "distributed frontend)")
                 fi, xi = self._cut(up_fi, list(ex.group_indices),
                                    ex.input.schema, self.parallelism)
                 node["input"] = xi

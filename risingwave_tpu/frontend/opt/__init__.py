@@ -14,6 +14,11 @@ the StreamPlanner and deployment:
 - fragment-graph rules (fragment_rules.py): exchange elision — fuse
   adjacent fragments when the producer's hash distribution already
   satisfies the consumer's keys;
+- fragment fusion (fusion.py, SET stream_fusion): collapse maximal
+  filter/project runs into ONE traced dataflow step — inlined into the
+  agg kernel's jitted apply with donated state, or a standalone
+  FusedFragmentExecutor for join/materialize feeds (TiLT shape,
+  arxiv 2301.12030);
 - a plan-property checker (checker.py) that recomputes schema,
   append-only-ness and structural invariants after EVERY rewrite and
   falls back to the unrewritten plan on any violation (strict mode
@@ -24,9 +29,10 @@ from risingwave_tpu.frontend.opt.checker import (    # noqa: F401
     CheckError, set_strict_checker, strict_checker,
 )
 from risingwave_tpu.frontend.opt.engine import (     # noqa: F401
-    EXECUTOR_RULE_NAMES, FRAGMENT_RULE_NAMES, RULE_NAMES, RewriteReport,
-    apply_rewrites, explain_with_rewrite, parse_rules, plan_lane_stats,
-    rewrite_history_rows, rewrite_stream_plan,
+    EXECUTOR_RULE_NAMES, FRAGMENT_RULE_NAMES, FUSION_RULE_NAME,
+    RULE_NAMES, RewriteReport, apply_rewrites, explain_with_rewrite,
+    parse_fusion, parse_rules, plan_lane_stats, rewrite_history_rows,
+    rewrite_stream_plan,
 )
 from risingwave_tpu.frontend.opt.fragment_rules import (  # noqa: F401
     fragment_plan_stats, rewrite_fragment_graph,

@@ -249,7 +249,23 @@ def _verify_node(ex) -> None:
             raise CheckError("HashJoin: output arity != left + right")
         return
     if isinstance(ex, HashAggExecutor):
-        n_in = len(ex.input.schema)
+        # fused aggs (opt/fusion.py) absorb a filter/project run: the
+        # agg's index space is the run's OUTPUT schema, and the run
+        # itself must re-verify (traceable + planned against the raw
+        # input actually feeding it)
+        if ex.fused_stages is not None:
+            _verify_fused_stages(ex.fused_stages, ex.input.schema,
+                                 "HashAgg[fused]")
+            from risingwave_tpu.frontend.opt.fusion import (
+                agg_ineligible_reason,
+            )
+            r = agg_ineligible_reason(ex)
+            if r is not None:
+                raise CheckError(f"HashAgg[fused]: ineligible ({r})")
+            in_schema = ex.fused_stages.out_schema
+        else:
+            in_schema = ex.input.schema
+        n_in = len(in_schema)
         for g in ex.group_indices:
             if not (0 <= g < n_in):
                 raise CheckError(f"HashAgg: group index {g} out of "
@@ -258,7 +274,7 @@ def _verify_node(ex) -> None:
             if c.input_idx is not None and not (0 <= c.input_idx < n_in):
                 raise CheckError(
                     f"HashAgg: call input {c.input_idx} out of range")
-        sch, pk = agg_state_schema(ex.input.schema,
+        sch, pk = agg_state_schema(in_schema,
                                    list(ex.group_indices),
                                    list(ex.agg_calls))
         if not _same_types(sch, ex.table.schema) or \
@@ -272,6 +288,17 @@ def _verify_node(ex) -> None:
                     "HashAgg: planned append-only but the rewritten "
                     "input is not provably append-only")
         return
+    from risingwave_tpu.stream.executors.fused import (
+        FusedFragmentExecutor,
+    )
+    if isinstance(ex, FusedFragmentExecutor):
+        _verify_fused_stages(ex.fused_stages, ex.input.schema,
+                             "FusedFragment")
+        if not _same_types(ex.schema, ex.fused_stages.out_schema):
+            raise CheckError(
+                "FusedFragment: executor schema drifted from the "
+                "composed run's output schema")
+        return
     if isinstance(ex, MaterializeExecutor):
         if not _same_types(ex.schema, ex.input.schema):
             raise CheckError("Materialize: input schema drifted from "
@@ -279,6 +306,23 @@ def _verify_node(ex) -> None:
         return
     # other executor types carry no rewrite-visible contract beyond
     # the recursive child checks (rules never rebuild them)
+
+
+def _verify_fused_stages(fs, input_schema, where: str) -> None:
+    """A fused run must still bind against the raw input actually
+    feeding it AND stay traceable — the fallback contract of SET
+    stream_fusion: any violation reverts to the interpretive chain."""
+    if not _same_types(fs.in_schema, input_schema):
+        raise CheckError(
+            f"{where}: fused run planned against a different input "
+            "schema than the one feeding it")
+    for p in fs.preds:
+        _check_expr(p, fs.in_schema, f"{where} pred")
+    for j, e in enumerate(fs.out_exprs or []):
+        _check_expr(e, fs.in_schema, f"{where} expr")
+    r = fs.fusable_reason()
+    if r is not None:
+        raise CheckError(f"{where}: run is not traceable ({r})")
 
 
 def check_fragment_graph(graph) -> None:

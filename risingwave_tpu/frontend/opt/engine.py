@@ -25,7 +25,8 @@ MAX_ROUNDS = 8
 
 # applied in registry order each round: pushdown first (filters reach
 # their sources before liveness is computed), fusion + elision shrink
-# the chain, pruning runs over the settled shape
+# the chain, pruning runs over the settled shape; fragment fusion LAST
+# (opt/fusion.py) — it freezes the settled chain into traces
 EXECUTOR_RULES = {
     "filter_pushdown": _rules.push_filters,
     "project_fusion": _rules.fuse_projects,
@@ -35,6 +36,11 @@ EXECUTOR_RULES = {
 EXECUTOR_RULE_NAMES = tuple(EXECUTOR_RULES)
 FRAGMENT_RULE_NAMES = ("exchange_elision",)
 RULE_NAMES = EXECUTOR_RULE_NAMES + FRAGMENT_RULE_NAMES
+
+# fragment fusion rides its own knob (SET stream_fusion = on|off), not
+# the stream_rewrite_rules csv — it changes the EXECUTION substrate
+# (traced megakernel vs interpretive chain), not just the plan shape
+FUSION_RULE_NAME = "fusion_grouping"
 
 
 def parse_rules(spec: Optional[str]):
@@ -53,6 +59,18 @@ def parse_rules(spec: Optional[str]):
             f"unknown rewrite rule(s) {unknown}; known: "
             f"{', '.join(RULE_NAMES)}")
     return frozenset(names)
+
+
+def parse_fusion(spec: Optional[str]) -> bool:
+    """SET stream_fusion validator: 'on' | 'off' → bool."""
+    from risingwave_tpu.frontend.planner import PlanError
+    s = (spec or "on").strip().lower()
+    if s in ("on", "true", "1"):
+        return True
+    if s in ("off", "false", "0"):
+        return False
+    raise PlanError(
+        f"stream_fusion must be 'on' or 'off', got {spec!r}")
 
 
 class RewriteReport:
@@ -98,16 +116,24 @@ def rewrite_history_rows() -> List[tuple]:
 def rewrite_stream_plan(root, spec: Optional[str] = "all",
                         label: str = "",
                         record: bool = True,
-                        extra_rules: Optional[dict] = None
+                        extra_rules: Optional[dict] = None,
+                        fusion: bool = False
                         ) -> Tuple[object, RewriteReport]:
     """Rewrite one planned executor tree to fixpoint. Returns the
     (possibly identical) new root and a report; never raises in
     fallback mode — a rule that misbehaves is dropped, the plan that
-    deployed yesterday still deploys today."""
+    deployed yesterday still deploys today. ``fusion`` enables the
+    fragment-fusion rule (SET stream_fusion; opt/fusion.py) on top of
+    whatever ``spec`` enables — including spec='none', so fusion can
+    be measured in isolation."""
     from risingwave_tpu.utils.metrics import STREAMING
     report = RewriteReport(label)
     enabled = parse_rules(spec) & set(EXECUTOR_RULE_NAMES)
     registry = dict(EXECUTOR_RULES)
+    if fusion:
+        from risingwave_tpu.frontend.opt.fusion import fuse_fragments
+        registry[FUSION_RULE_NAME] = fuse_fragments
+        enabled = enabled | {FUSION_RULE_NAME}
     if extra_rules:
         registry.update(extra_rules)
         enabled = enabled | set(extra_rules)
@@ -157,20 +183,23 @@ def rewrite_stream_plan(root, spec: Optional[str] = "all",
 
 
 def apply_rewrites(plan, spec: Optional[str],
-                   label: str = "") -> RewriteReport:
+                   label: str = "",
+                   fusion: bool = False) -> RewriteReport:
     """Rewrite a StreamPlan/SinkPlan's consumer in place — the ONE
     deploy-path seam every session path (create MV/sink, reschedule,
     distributed create) goes through, so a future engine argument
     lands everywhere at once."""
     plan.consumer, report = rewrite_stream_plan(plan.consumer, spec,
-                                                label=label)
+                                                label=label,
+                                                fusion=fusion)
     return report
 
 
-def explain_with_rewrite(consumer, spec: Optional[str]
-                         ) -> List[tuple]:
+def explain_with_rewrite(consumer, spec: Optional[str],
+                         fusion: bool = False) -> List[tuple]:
     """EXPLAIN body shared by Frontend and DistFrontend: pre-rewrite
-    tree, per-rule annotations, post-rewrite tree, lane stats."""
+    tree, per-rule annotations (fusion groups included), post-rewrite
+    tree, lane stats."""
     from risingwave_tpu.frontend.planner import explain_tree
 
     def stats_line(tag, root):
@@ -182,7 +211,8 @@ def explain_with_rewrite(consumer, spec: Optional[str]
     pre = explain_tree(consumer)
     new_consumer, report = rewrite_stream_plan(consumer, spec,
                                                label="__explain__",
-                                               record=False)
+                                               record=False,
+                                               fusion=fusion)
     rows = [("-- streaming plan (pre-rewrite):",)]
     rows += [(line,) for line in pre]
     rows.append(stats_line("pre-rewrite", consumer))

@@ -1,0 +1,173 @@
+"""fusion_grouping: mark maximal fusable runs, emit fused executors.
+
+The plan-rewrite engine's fusion rule (ISSUE 6 tentpole; TiLT shape,
+arxiv 2301.12030). Walks the planned executor chain of each fragment
+and collapses maximal source/coalesce → filter → project runs feeding a
+keyed executor into ONE traced dataflow step:
+
+- run ends at an ELIGIBLE HashAgg → the agg absorbs the stages as a
+  kernel prelude (ops/fused.py build_agg_prelude): raw chunk upload →
+  filter → project → key/lane encode → accumulator update, one jitted
+  dispatch with donated state. A CoalesceExecutor directly under the
+  agg is absorbed too — the kernel's raw backlog IS the batcher now
+  (BATCH_ROWS), so the interpretive coalescer would only add a copy.
+- any other run of ≥2 consecutive filter/project stages (join input
+  sides, materialize feeds) → a standalone FusedFragmentExecutor: the
+  same composed chain as one jit per chunk, host passthrough columns
+  riding around the trace.
+
+Eligibility is checked BEFORE mutating anything (traceable_reason per
+expression, device group keys, no host state mirrors on the agg); an
+ineligible run is simply left interpretive — and the engine's property
+checker re-derives every plan invariant after the rule fires, falling
+back to the unfused chain if fusion broke one (opt/checker.py grew
+fused-shape checks for exactly this).
+
+Runs last in the registry: pushdown/projection-fusion/pruning settle
+the chain shape first, fusion freezes it into traces.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import List, Optional, Tuple
+
+from risingwave_tpu.stream.executor import executor_children
+
+
+def _as_stage(ex):
+    """FilterExecutor/ProjectExecutor → FusedStage, else None."""
+    from risingwave_tpu.ops.fused import FusedStage
+    from risingwave_tpu.stream.executors.simple import (
+        FilterExecutor, ProjectExecutor,
+    )
+    if isinstance(ex, FilterExecutor):
+        return FusedStage("filter", "FilterExecutor",
+                          exprs=(ex.predicate,))
+    if isinstance(ex, ProjectExecutor):
+        return FusedStage(
+            "project", "ProjectExecutor",
+            exprs=tuple(ex.exprs),
+            names=tuple(f.name for f in ex.schema),
+            watermark_derivations=dict(ex.watermark_derivations))
+    return None
+
+
+def _collect_run(top) -> Tuple[list, object]:
+    """Maximal consecutive filter/project run starting at `top` going
+    downstream→upstream. Returns (stages in DATAFLOW order, base)."""
+    rev: List = []
+    node = top
+    while True:
+        st = _as_stage(node)
+        if st is None:
+            break
+        rev.append(st)
+        node = node.input
+    return list(reversed(rev)), node
+
+
+def agg_ineligible_reason(agg) -> Optional[str]:
+    """THE eligibility predicate — the one copy. The rule gates on it
+    before fusing, HashAggExecutor's constructor/adopt guards call it,
+    and the checker re-verifies it on ALREADY-fused aggs after every
+    later rewrite round (so `fused_stages is not None` is deliberately
+    NOT a condition here)."""
+    if agg._kernel is not None:
+        return "sharded/injected kernel"
+    if agg.minput or agg.distinct_tables:
+        return "retractable MIN/MAX or DISTINCT (host multisets)"
+    if agg._hll_calls or agg._host_calls:
+        return "host-side agg state (HLL/string_agg/array_agg)"
+    if agg.tier_cap is not None:
+        return "cold-tier governed (per-chunk host touch)"
+    if agg.key_codec.interners:
+        return "host-typed group keys (interning)"
+    return None
+
+
+def agg_fusable_reason(agg) -> Optional[str]:
+    """None iff this HashAggExecutor can absorb a stage prelude NOW
+    (rule-side gate: refuses re-fusing on later fixpoint rounds)."""
+    if agg.fused_stages is not None:
+        return "already fused"
+    return agg_ineligible_reason(agg)
+
+
+def fuse_fragments(root) -> Tuple[object, int, str]:
+    """The rule entry point (engine registry signature). Non-
+    destructive: copy-on-write along every mutated path so the engine's
+    fallback plan stays intact."""
+    from risingwave_tpu.ops.fused import FusedStages
+    from risingwave_tpu.stream.coalesce import CoalesceExecutor
+    from risingwave_tpu.stream.executors.fused import (
+        FusedFragmentExecutor,
+    )
+    from risingwave_tpu.stream.executors.hash_agg import HashAggExecutor
+    details: List[str] = []
+
+    def try_fuse_agg(agg):
+        """Eligible agg + run below (coalesce absorbed) → fused copy."""
+        if agg_fusable_reason(agg) is not None:
+            return None
+        node = agg.input
+        if isinstance(node, CoalesceExecutor):
+            node = node.input
+        stages, base = _collect_run(node)
+        if not stages:
+            return None
+        fs = FusedStages(base.schema, stages)
+        reason = fs.fusable_reason()
+        if reason is not None:
+            details.append(f"agg run NOT fused ({reason})")
+            return None
+        new_agg = copy.copy(agg)
+        new_agg.adopt_fused_stages(fs, base)
+        new_agg._info = copy.copy(agg._info)
+        new_agg._info.identity = (
+            f"{agg.identity}[fused:{fs.describe()}]")
+        details.append(f"agg absorbed {fs.describe()}")
+        return new_agg
+
+    def try_fuse_standalone(top):
+        """≥2-stage run not feeding an eligible agg → fused block."""
+        stages, base = _collect_run(top)
+        if len(stages) < 2:
+            return None
+        fs = FusedStages(base.schema, stages)
+        reason = fs.fusable_reason()
+        if reason is not None:
+            details.append(f"run NOT fused ({reason})")
+            return None
+        details.append(f"block {fs.describe()}")
+        return FusedFragmentExecutor(base, fs)
+
+    def walk(ex):
+        """Top-down: an eligible agg absorbs its run BEFORE the
+        generic descent could carve a standalone block out of it; the
+        walk then resumes below the absorbed base. Returns a (possibly
+        new) executor; originals are never mutated."""
+        from risingwave_tpu.frontend.opt.rules import _swap_child
+        nonlocal fired
+        if isinstance(ex, HashAggExecutor):
+            fused = try_fuse_agg(ex)
+            if fused is not None:
+                fired += 1
+                fused.input = walk(fused.input)   # fused is a copy
+                return fused
+        elif _as_stage(ex) is not None:
+            fused = try_fuse_standalone(ex)
+            if fused is not None:
+                fired += 1
+                fused.input = walk(fused.input)
+                return fused
+        out = ex
+        for attr, idx, child in executor_children(ex):
+            new_child = walk(child)
+            if new_child is not child:
+                out = _swap_child(out, attr, idx, new_child)
+        return out
+
+    fired = 0
+    new_root = walk(root)
+    return new_root, fired, "; ".join(details)

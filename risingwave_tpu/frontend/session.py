@@ -77,7 +77,7 @@ class Frontend:
         # session configuration (src/common/src/session_config/
         # analog): typed knobs bind to REAL planner inputs, the rest
         # are pg-compatibility strings (shared impl: session_vars.py)
-        from risingwave_tpu.frontend.opt import parse_rules
+        from risingwave_tpu.frontend.opt import parse_fusion, parse_rules
         from risingwave_tpu.frontend.session_vars import SessionVars
         self.session_vars = SessionVars(
             self, {"streaming_rate_limit": "rate_limit",
@@ -92,12 +92,21 @@ class Frontend:
             {"application_name": "", "timezone": "UTC",
              # plan-rewrite toggles (frontend/opt): 'all' | 'none' |
              # comma-list of rule names, validated at SET time
-             "stream_rewrite_rules": "all"},
-            validators={"stream_rewrite_rules": parse_rules})
+             "stream_rewrite_rules": "all",
+             # fragment fusion (opt/fusion.py): compile each
+             # fragment's filter/project run into the keyed kernel's
+             # jitted step (one dispatch, donated state); 'off'
+             # restores the interpretive chain
+             "stream_fusion": "on"},
+            validators={"stream_rewrite_rules": parse_rules,
+                        "stream_fusion": parse_fusion})
         # rules spec each MV was created under: reschedule replans +
         # re-rewrites with the SAME spec so state-table schemas from
         # the original rewrite reproduce exactly (id-base contract)
         self._mv_rules: Dict[str, str] = {}
+        # fusion setting each MV was created under — reschedule
+        # re-fuses (or not) exactly as the CREATE did
+        self._mv_fusion: Dict[str, bool] = {}
         self._next_actor = 1000
         self.chain_edges: Dict[str, list] = {}   # job → [(uid, Output)]
         # name → CREATE MV select AST (reschedule replans from this —
@@ -181,6 +190,7 @@ class Frontend:
             result = await self._run(stmt)
             if isinstance(stmt, ast.SetVar) and \
                     stmt.name in ("stream_rewrite_rules",
+                                  "stream_fusion",
                                   "state_tier_cap",
                                   "state_tier_soft_limit_mb") and \
                     not self._replaying:
@@ -400,9 +410,13 @@ class Frontend:
         plan = planner.plan("__explain__", sel, actor_id=0,
                             rate_limit=self.rate_limit,
                             min_chunks=self.min_chunks)
-        from risingwave_tpu.frontend.opt import explain_with_rewrite
+        from risingwave_tpu.frontend.opt import (
+            explain_with_rewrite, parse_fusion,
+        )
         rules = self.session_vars.get("stream_rewrite_rules")
-        return explain_with_rewrite(plan.consumer, rules)
+        return explain_with_rewrite(
+            plan.consumer, rules,
+            fusion=parse_fusion(self.session_vars.get("stream_fusion")))
 
     def _catalog_snapshot(self) -> list:
         """Current catalog as notification payloads (observers get
@@ -449,6 +463,8 @@ class Frontend:
             self._next_actor += 1
             id_base = self.catalog._next_id
             rules = self.session_vars.get("stream_rewrite_rules")
+            from risingwave_tpu.frontend.opt import parse_fusion
+            fusion = parse_fusion(self.session_vars.get("stream_fusion"))
             try:
                 plan = planner.plan(
                     stmt.name, stmt.select, actor_id,
@@ -460,7 +476,8 @@ class Frontend:
                 # planner and deployment; the checker falls back to
                 # the unrewritten plan on any invariant violation
                 from risingwave_tpu.frontend.opt import apply_rewrites
-                apply_rewrites(plan, rules, label=stmt.name)
+                apply_rewrites(plan, rules, label=stmt.name,
+                               fusion=fusion)
             except BaseException:
                 # a failed plan must leak nothing: source senders were
                 # registered during planning and would wedge the next
@@ -476,6 +493,7 @@ class Frontend:
         self._mv_selects[stmt.name] = (
             stmt.select, getattr(stmt, "emit_on_window_close", False))
         self._mv_rules[stmt.name] = rules
+        self._mv_fusion[stmt.name] = fusion
         # CREATE-time tier cap: reschedule replans under it (the cap
         # shapes join state-table pk layouts — id-base contract)
         self._mv_tier_caps[stmt.name] = self.state_tier_cap or None
@@ -860,7 +878,9 @@ class Frontend:
                     )
                     apply_rewrites(plan,
                                    self._mv_rules.get(name, "all"),
-                                   label=name)
+                                   label=name,
+                                   fusion=self._mv_fusion.get(
+                                       name, False) and mesh is None)
                 except BaseException:
                     for sid in planner.registered_senders:
                         self.local.drop_actor(sid)
@@ -882,6 +902,7 @@ class Frontend:
                 self.catalog.mvs.pop(name, None)
                 self._mv_selects.pop(name, None)
                 self._mv_rules.pop(name, None)
+                self._mv_fusion.pop(name, None)
                 self._mv_tier_caps.pop(name, None)
                 raise PlanError(
                     f"reschedule of {name!r} failed after teardown — "
@@ -913,11 +934,15 @@ class Frontend:
                     stmt.select, stmt.options, actor_id,
                     rate_limit=self.rate_limit,
                     min_chunks=self.min_chunks)
-                from risingwave_tpu.frontend.opt import apply_rewrites
+                from risingwave_tpu.frontend.opt import (
+                    apply_rewrites, parse_fusion,
+                )
                 apply_rewrites(
                     plan,
                     self.session_vars.get("stream_rewrite_rules"),
-                    label=stmt.name)
+                    label=stmt.name,
+                    fusion=parse_fusion(
+                        self.session_vars.get("stream_fusion")))
             except BaseException:
                 for sid in planner.registered_senders:
                     self.local.drop_actor(sid)
@@ -979,6 +1004,7 @@ class Frontend:
         del registry[name]
         self._mv_selects.pop(name, None)
         self._mv_rules.pop(name, None)
+        self._mv_fusion.pop(name, None)
         self._mv_tier_caps.pop(name, None)
         if actor is not None and actor.failure is not None:
             raise actor.failure

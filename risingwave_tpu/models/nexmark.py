@@ -68,8 +68,16 @@ def _source(local: LocalBarrierManager, store, actor_id: int,
 def _finish(local: LocalBarrierManager, store, mat: MaterializeExecutor,
             mv_table: StateTable, actor_id: int,
             readers: Dict[int, NexmarkSplitReader],
-            fragment: str = "nexmark") -> Pipeline:
+            fragment: str = "nexmark",
+            fusion: bool = False) -> Pipeline:
     from risingwave_tpu.stream.monitor import install_monitoring
+    if fusion:
+        # fragment fusion (frontend/opt/fusion.py): same rule the SQL
+        # sessions apply under SET stream_fusion — the benched
+        # pipeline stays exactly the tested pipeline
+        from risingwave_tpu.frontend.opt import rewrite_stream_plan
+        mat, _report = rewrite_stream_plan(mat, "none", record=False,
+                                           fusion=True)
     local.set_expected_actors([actor_id])
     consumer = install_monitoring(mat, fragment=fragment,
                                   actor_id=actor_id)
@@ -80,7 +88,8 @@ def _finish(local: LocalBarrierManager, store, mat: MaterializeExecutor,
 
 def build_q1(store, cfg: NexmarkConfig,
              rate_limit: Optional[int] = 3,
-             min_chunks: Optional[int] = None) -> Pipeline:
+             min_chunks: Optional[int] = None,
+             fusion: bool = False) -> Pipeline:
     """q1: SELECT auction, bidder, 0.908*price, date_time FROM bid."""
     local = LocalBarrierManager()
     source = _source(local, store, 1, cfg, 1, rate_limit, min_chunks)
@@ -98,7 +107,8 @@ def build_q1(store, cfg: NexmarkConfig,
     mv_table = StateTable(2, project.schema, [4], store)  # pk = _row_id
     mat = MaterializeExecutor(project, mv_table)
     return _finish(local, store, mat, mv_table, 1,
-                   {1: source.reader}, fragment="nexmark-q1")
+                   {1: source.reader}, fragment="nexmark-q1",
+                   fusion=fusion)
 
 
 def build_q7(store, cfg: NexmarkConfig,
@@ -108,7 +118,8 @@ def build_q7(store, cfg: NexmarkConfig,
              watermark_delay: Optional[Interval] = None,
              mesh=None, shard_capacity: int = 1 << 14,
              coalesce_rows: Optional[int] = None,
-             tier_cap: Optional[int] = None) -> Pipeline:
+             tier_cap: Optional[int] = None,
+             fusion: bool = False) -> Pipeline:
     """q7-core: MAX(price), COUNT(*) per tumbling window (device agg).
 
     With ``watermark_delay``, a WatermarkFilter generates event-time
@@ -170,13 +181,15 @@ def build_q7(store, cfg: NexmarkConfig,
     mv_table = StateTable(3, agg.schema, [0], store)  # pk = window_start
     mat = MaterializeExecutor(agg, mv_table)
     return _finish(local, store, mat, mv_table, 1,
-                   {1: source.reader}, fragment="nexmark-q7")
+                   {1: source.reader}, fragment="nexmark-q7",
+                   fusion=fusion)
 
 
 def build_q8(store, cfg_p: NexmarkConfig, cfg_a: NexmarkConfig,
              rate_limit: Optional[int] = 4,
              window: Interval = DEFAULT_WINDOW,
-             min_chunks: Optional[int] = None, mesh=None) -> Pipeline:
+             min_chunks: Optional[int] = None, mesh=None,
+             fusion: bool = False) -> Pipeline:
     """q8: persons who created an auction in the same tumbling window.
 
     two sources → projects → auction-side hash-agg dedup → inner
@@ -244,7 +257,7 @@ def build_q8(store, cfg_p: NexmarkConfig, cfg_a: NexmarkConfig,
     mat = MaterializeExecutor(out, mv)
     return _finish(local, store, mat, mv, 7,
                    {1: persons.reader, 2: auctions.reader},
-                   fragment="nexmark-q8")
+                   fragment="nexmark-q8", fusion=fusion)
 
 
 def drive_to_completion(pipeline: Pipeline,
@@ -318,7 +331,8 @@ def build_q5(store, cfg: NexmarkConfig,
              slide: Interval = Interval(usecs=2_000_000),
              size: Interval = Interval(usecs=10_000_000),
              top_per_window: int = 1,
-             tier_cap: Optional[int] = None) -> Pipeline:
+             tier_cap: Optional[int] = None,
+             fusion: bool = False) -> Pipeline:
     """q5 (hot items): auctions with the most bids per sliding window.
 
     source → hop-window expansion → per-(window, auction) device count
@@ -358,4 +372,4 @@ def build_q5(store, cfg: NexmarkConfig,
     mv = StateTable(4, topn.schema, [0, 1], store)
     mat = MaterializeExecutor(topn, mv)
     return _finish(local, store, mat, mv, 1, {1: source.reader},
-                   fragment="nexmark-q5")
+                   fragment="nexmark-q5", fusion=fusion)

@@ -64,7 +64,8 @@ def _src(local, store, aid, cfg, tid, rate_limit, min_chunks):
 def build_q3(store, customers: int = 300, orders: int = 3000,
              rate_limit: Optional[int] = 8,
              min_chunks: Optional[int] = None,
-             top_limit: int = 10) -> Pipeline:
+             top_limit: int = 10,
+             fusion: bool = False) -> Pipeline:
     local = LocalBarrierManager()
     mk = lambda t, rows=None: TpchConfig(table=t, customers=customers,
                                          orders=orders, row_count=rows)
@@ -150,6 +151,11 @@ def build_q3(store, customers: int = 300, orders: int = 3000,
 
     mv = StateTable(10, topn.schema, [0, 1, 2], store)
     mat = MaterializeExecutor(topn, mv)
+    if fusion:
+        # same fusion rule the SQL sessions apply (SET stream_fusion)
+        from risingwave_tpu.frontend.opt import rewrite_stream_plan
+        mat, _report = rewrite_stream_plan(mat, "none", record=False,
+                                           fusion=True)
     local.set_expected_actors([11])
     from risingwave_tpu.stream.monitor import install_monitoring
     consumer = install_monitoring(mat, fragment="tpch-q3", actor_id=11)

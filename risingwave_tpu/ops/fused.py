@@ -1,0 +1,566 @@
+"""Composable traced stages: the kernel half of fragment fusion.
+
+TiLT thesis (arxiv 2301.12030) applied to the plan IR: instead of
+interpreting a fragment's executor chain one vectorized host pass per
+operator per chunk, compile the whole source→filter→project→keyed-input
+run into ONE traced dataflow step. The expression layer is already
+backend-polymorphic (``get_xp`` — common/chunk.py), so the SAME
+``Expression.eval`` / ``FilterExecutor`` math that runs interpretively
+on numpy traces under ``jax.jit`` bit-identically; this module supplies
+the static plumbing around it:
+
+- ``traceable_reason``: the eligibility walker. An expression tree is
+  fusable iff every node stays in the device domain end to end — host
+  comparisons (varchar), host scalar functions, and DECIMAL casts whose
+  numpy path carries overflow *detection* (raising is untraceable) all
+  refuse with a reason string the rewrite layer surfaces in EXPLAIN.
+- ``FusedStages``: a filter/project run in composed normal form — all
+  predicates and output expressions substituted back onto the ONE input
+  schema (subst_expr, the projection-composition machinery of the
+  plan-rewrite engine) — plus the raw-chunk codec for the agg-prelude
+  path and per-logical-stage row attribution.
+- ``build_chain_step``: the standalone traced step (chunk in → chunk
+  out), used by FusedStagesExecutor for runs feeding joins/materialize.
+- ``build_agg_prelude``: the same chain fused INTO ``hash_agg.py``'s
+  jitted apply — raw int64 chunk matrix → (key lanes, signs, vis,
+  per-call input lanes), inlined ahead of the accumulator updates so a
+  whole fragment step is one dispatch with donated state buffers.
+
+Pair semantics are preserved exactly: filter degradation (U-/U+ halves
+diverging under the predicate) reuses ``FilterExecutor.apply_predicate``
+— the one implementation — and the project noop-update drop runs as a
+branchless shifted-compare (identical result to the numpy early-out
+version: no U-/U+ pairs ⇒ no drops). Batched raw matrices place one
+always-invisible SEPARATOR row between chunks so the shifted compares
+never marry rows across chunk boundaries.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from risingwave_tpu.common.chunk import (
+    Column, Op, StreamChunk, ops_to_signs,
+)
+from risingwave_tpu.common.types import DataType, Field, Schema
+
+# FuncCalls whose registered implementations are xp-generic (no numpy
+# object arrays, no python loops) — verified by tests/test_fusion.py
+# against the interpretive path on random data.
+TRACEABLE_FUNCS = frozenset({"tumble_start", "tumble_end",
+                             "extract_epoch"})
+
+
+# -- eligibility walker ----------------------------------------------------
+
+
+def traceable_reason(e, schema: Schema) -> Optional[str]:
+    """None if `e` traces bit-identically under jit against `schema`;
+    otherwise a human-readable refusal (EXPLAIN shows it)."""
+    from risingwave_tpu.expr.expr import (
+        BinaryOp, Case, Cast, FuncCall, InputRef, Literal, UnaryOp,
+    )
+    if isinstance(e, InputRef):
+        if not e.return_type.is_device:
+            return f"host-typed column ref ${e.index}:{e.return_type.value}"
+        return None
+    if isinstance(e, Literal):
+        # host-typed literals (varchar format strings, intervals) are
+        # CONSTANT — they evaluate host-side even inside a trace (the
+        # chunk capacity is static), so they are fine as FuncCall args;
+        # standalone host literals in value position are not.
+        return None
+    if isinstance(e, BinaryOp):
+        if not e._common.is_device:
+            return (f"operator {e.op!r} over host type "
+                    f"{e._common.value}")
+        for side in (e.left, e.right):
+            # implicit float→DECIMAL promotion carries overflow/
+            # non-finite DETECTION on the numpy path (raising is
+            # untraceable); int→DECIMAL wraps identically wherever the
+            # interpretive path doesn't raise, which is the bit-identity
+            # contract — see ARCHITECTURE.md "Fragment fusion"
+            if (e._common == DataType.DECIMAL
+                    and side.return_type in (DataType.FLOAT32,
+                                             DataType.FLOAT64)):
+                return "float->DECIMAL promotion (overflow detection)"
+            r = traceable_reason(side, schema)
+            if r:
+                return r
+        return None
+    if isinstance(e, UnaryOp):
+        return traceable_reason(e.child, schema)
+    if isinstance(e, Cast):
+        if not e.return_type.is_device:
+            return f"cast to host type {e.return_type.value}"
+        src = e.child.return_type
+        if not src.is_device:
+            return f"cast from host type {src.value}"
+        if e.return_type == DataType.DECIMAL and src != DataType.DECIMAL:
+            return "cast to DECIMAL (overflow detection is host-only)"
+        return traceable_reason(e.child, schema)
+    if isinstance(e, Case):
+        if not e.return_type.is_device:
+            return f"CASE over host type {e.return_type.value}"
+        for c, v in e.whens:
+            r = traceable_reason(c, schema) or traceable_reason(v, schema)
+            if r:
+                return r
+        return traceable_reason(e.else_, schema)
+    if isinstance(e, FuncCall):
+        if e.name not in TRACEABLE_FUNCS:
+            return f"function {e.name}() has no traceable kernel"
+        from risingwave_tpu.expr.expr import Literal as _Lit
+        for a in e.args:
+            if isinstance(a, _Lit):
+                continue            # constant args evaluate host-side
+            r = traceable_reason(a, schema)
+            if r:
+                return r
+        return None
+    return f"unknown expression node {type(e).__name__}"
+
+
+# -- traced twins of the host key/lane codecs ------------------------------
+# Identical bit semantics to ops/lanes.py + executors/keys.py, running
+# on xp (numpy OR traced jnp). The integer paths of lanes.py are already
+# xp-generic and are called directly; only the float normalizations
+# needed get_xp (see lanes._order_u64_from_f64 / keys.to_i64).
+
+
+def key_lanes_traced(cols: Sequence[Tuple[object, Optional[object]]],
+                     xp) -> object:
+    """Device-typed key columns → int32[N, 3k] lanes, the exact
+    KeyCodec.build_arrays image (hi, lo, valid per column)."""
+    from risingwave_tpu.ops import lanes as _lanes
+    from risingwave_tpu.stream.executors.keys import to_i64
+    out = []
+    for vals, ok in cols:
+        v64 = to_i64(vals)
+        if ok is not None:
+            v64 = xp.where(ok, v64, xp.int64(0))
+        hi, lo = _lanes.split_i64(v64)
+        out.append(hi)
+        out.append(lo)
+        out.append(xp.ones(v64.shape[0], dtype=xp.int32)
+                   if ok is None else ok.astype(xp.int32))
+    return xp.stack(out, axis=1)
+
+
+# -- raw-chunk codec (the ONE upload of the fused agg path) ----------------
+# Layout (int64 columns): [ops, vis] then (value, valid) per referenced
+# input column. f64 travels bitcast; f32 widens exactly through f64.
+# One matrix = one host→device transfer per dispatch, mirroring
+# pack_chunk's rationale for the unfused path.
+
+RAW_META_COLS = 2
+
+
+def raw_width(n_ref_cols: int) -> int:
+    return RAW_META_COLS + 2 * n_ref_cols
+
+
+def encode_raw_chunk(chunk: StreamChunk,
+                     ref_cols: Sequence[int]) -> np.ndarray:
+    """Host side: one int64[N, W] matrix for the referenced columns."""
+    n = chunk.capacity
+    m = np.zeros((n, raw_width(len(ref_cols))), dtype=np.int64)
+    m[:, 0] = np.asarray(chunk.ops)
+    m[:, 1] = np.asarray(chunk.visibility)
+    for k, i in enumerate(ref_cols):
+        c = chunk.columns[i]
+        vals = np.asarray(c.values)
+        if vals.dtype == np.float64:
+            v = vals.view(np.int64)
+        elif vals.dtype == np.float32:
+            v = vals.astype(np.float64).view(np.int64)
+        else:
+            v = vals.astype(np.int64)
+        m[:, RAW_META_COLS + 2 * k] = v
+        m[:, RAW_META_COLS + 2 * k + 1] = (
+            1 if c.validity is None
+            else np.asarray(c.validity).astype(np.int64))
+    return m
+
+
+def decode_raw_cols(raw, in_schema: Schema,
+                    ref_cols: Sequence[int], xp
+                    ) -> Tuple[List[Column], object, object]:
+    """Traced inverse of encode_raw_chunk → (columns in FULL input
+    arity, vis bool, ops int8-domain). Unreferenced slots get dummy
+    zero columns (never evaluated — eligibility guarantees it)."""
+    cap = raw.shape[0]
+    ops = raw[:, 0].astype(xp.int8)
+    vis = raw[:, 1].astype(bool)
+    cols: List[Column] = []
+    by_pos = {i: k for k, i in enumerate(ref_cols)}
+    for i, f in enumerate(in_schema):
+        k = by_pos.get(i)
+        if k is None:
+            cols.append(Column(f.data_type, xp.zeros(cap, dtype=xp.int32)))
+            continue
+        v64 = raw[:, RAW_META_COLS + 2 * k]
+        okl = raw[:, RAW_META_COLS + 2 * k + 1].astype(bool)
+        dt = np.dtype(f.data_type.np_dtype)
+        if dt == np.float64:
+            vals = v64.view(xp.float64) if xp is np else \
+                _bitcast(v64, xp.float64)
+        elif dt == np.float32:
+            vals = (v64.view(np.float64) if xp is np
+                    else _bitcast(v64, xp.float64)).astype(dt)
+        else:
+            vals = v64.astype(dt)
+        cols.append(Column(f.data_type, vals, okl))
+    return cols, vis, ops
+
+
+def _bitcast(a, dtype):
+    import jax
+    return jax.lax.bitcast_convert_type(a, dtype)
+
+
+# -- composed stage normal form --------------------------------------------
+
+
+@dataclass(frozen=True)
+class FusedStage:
+    """One logical executor inside a fused block (metrics identity +
+    the pieces EXPLAIN and the fragmenter serialize)."""
+
+    kind: str                      # "filter" | "project"
+    identity: str                  # e.g. "FilterExecutor"
+    # filter: the ORIGINAL predicate (own column space); project: the
+    # original exprs/names. Serialized by the fragmenter.
+    exprs: tuple = ()
+    names: tuple = ()
+    watermark_derivations: dict = field(default_factory=dict)
+
+
+class FusedStages:
+    """A maximal fusable filter/project run in composed normal form.
+
+    ``stages`` is the run in dataflow order (closest-to-upstream
+    first). Construction composes everything onto ``in_schema``:
+    ``preds`` (each substituted back to input space, applied as one
+    conjunction + one pair-degradation pass) and ``out_exprs`` /
+    ``out_schema`` (the final projection; None means the run is
+    filter-only and the output schema is the input schema).
+
+    The composition is visible-semantics-exact w.r.t. the sequential
+    executors: predicate conjunction commutes, degradation of a pair
+    whose halves diverge under ANY predicate equals sequential
+    degradation, and the noop-update drop after the FINAL projection
+    drops exactly the pairs the per-stage drops would have (equal
+    inputs stay equal through every later projection). Invisible rows'
+    op bytes may differ — they are unobservable by contract (the spine
+    suppresses/compacts them end to end).
+    """
+
+    def __init__(self, in_schema: Schema, stages: Sequence[FusedStage]):
+        from risingwave_tpu.frontend.opt.rules import subst_expr
+        from risingwave_tpu.expr.expr import InputRef
+        self.in_schema = in_schema
+        self.stages = list(stages)
+        if not self.stages:
+            raise ValueError("FusedStages needs at least one stage")
+        # compose onto the input space
+        cur: Optional[list] = None          # None = identity projection
+        preds: List[object] = []
+        pred_stage: List[int] = []          # stage index per pred
+        names = [f.name for f in in_schema]
+        for si, st in enumerate(self.stages):
+            if st.kind == "filter":
+                (p,) = st.exprs
+                preds.append(p if cur is None else subst_expr(p, cur))
+                pred_stage.append(si)
+            elif st.kind == "project":
+                cur = [e if cur is None else subst_expr(e, cur)
+                       for e in st.exprs]
+                names = list(st.names)
+            else:
+                raise ValueError(f"unknown stage kind {st.kind!r}")
+        self.preds = preds
+        self._pred_stage = pred_stage
+        self.out_exprs = cur
+        if cur is None:
+            self.out_schema = in_schema
+        else:
+            self.out_schema = Schema([
+                Field(n, e.return_type) for n, e in zip(names, cur)])
+        # referenced input columns (trace inputs); everything else
+        # stays host-side. A filter-only run (out_exprs None) passes
+        # EVERY column through, so all device columns are referenced —
+        # omitting them would hand dummy zero columns to the consumer.
+        refs: set = set()
+        from risingwave_tpu.frontend.opt.checker import expr_refs
+        for p in self.preds:
+            refs |= expr_refs(p)
+        for e in (self.out_exprs or []):
+            refs |= expr_refs(e)
+        # host passthrough outputs: bare InputRefs to host-typed input
+        # columns ride AROUND the trace (positional vis/ops are shared)
+        self.host_out: Dict[int, int] = {}
+        if self.out_exprs is None:
+            for i, f in enumerate(in_schema):
+                if f.data_type.is_device:
+                    refs.add(i)
+                else:
+                    self.host_out[i] = i
+        else:
+            for j, e in enumerate(self.out_exprs):
+                if isinstance(e, InputRef) and not e.return_type.is_device:
+                    self.host_out[j] = e.index
+        self.ref_cols: List[int] = sorted(
+            i for i in refs if in_schema[i].data_type.is_device)
+        # per-stage row attribution drained by the monitor at barriers
+        self.stage_rows = np.zeros(len(self.stages), dtype=np.int64)
+        self.stage_chunks = np.zeros(len(self.stages), dtype=np.int64)
+
+    # -- eligibility -------------------------------------------------------
+    def fusable_reason(self) -> Optional[str]:
+        """None iff the composed run traces; else the first refusal."""
+        for p in self.preds:
+            r = traceable_reason(p, self.in_schema)
+            if r:
+                return r
+        for j, e in enumerate(self.out_exprs or []):
+            if j in self.host_out:
+                continue            # host passthrough, never traced
+            r = traceable_reason(e, self.in_schema)
+            if r:
+                return r
+        return None
+
+    def describe(self) -> str:
+        return "→".join(s.identity for s in self.stages)
+
+    # -- watermark path (host, per message) --------------------------------
+    def derive_watermarks(self, msg) -> List:
+        """Watermark(s) in OUTPUT column space, composing each stage's
+        semantics in order (filters pass through, projects derive or
+        drop — ProjectExecutor's exact rules)."""
+        from risingwave_tpu.stream.message import Watermark
+        outs = [msg]
+        for st in self.stages:
+            if st.kind != "project":
+                continue
+            nxt: List = []
+            for m in outs:
+                d = st.watermark_derivations.get(m.col_idx)
+                for one in (d if isinstance(d, list)
+                            else [] if d is None else [d]):
+                    if isinstance(one, tuple):
+                        oi, fn = one
+                        nxt.append(Watermark(oi, m.data_type,
+                                             fn(m.value)))
+                    else:
+                        nxt.append(m.with_idx(one))
+            outs = nxt
+        return outs
+
+    def note_stage_rows(self, counts: np.ndarray, chunks: int) -> None:
+        self.stage_rows += counts.astype(np.int64)
+        self.stage_chunks += chunks
+
+    def drain_stage_metrics(self) -> List[Tuple[str, int, int]]:
+        # same-kind stages in one run (e.g. filter→filter after an MV
+        # over a filtered view) get a position suffix so their metric
+        # series stay distinct instead of summing into one label
+        idents = [st.identity for st in self.stages]
+        dup = {n for n in idents if idents.count(n) > 1}
+        out = [(f"{st.identity}[{i}]" if st.identity in dup
+                else st.identity,
+                int(self.stage_rows[i]), int(self.stage_chunks[i]))
+               for i, st in enumerate(self.stages)]
+        self.stage_rows[:] = 0
+        self.stage_chunks[:] = 0
+        return out
+
+    # -- host half of the noop-pair drop ----------------------------------
+    def host_noop_eq(self, chunk) -> Optional[np.ndarray]:
+        """Adjacent-row equality over the HOST passthrough columns
+        (ProjectExecutor._drop_noop_updates' exact semantics, numpy).
+        Host columns bypass the trace, but a U-/U+ pair whose only
+        change is a varchar must NOT be dropped — this mask is ANDed
+        into the traced drop. None when there are no host columns."""
+        if not self.host_out or self.out_exprs is None:
+            return None
+        same = np.ones(chunk.capacity, dtype=bool)
+        for _j, src in self.host_out.items():
+            c = chunk.columns[src]
+            v = np.asarray(c.values)
+            eq = np.asarray(v == np.roll(v, -1), dtype=bool)
+            if c.validity is not None:
+                ok = np.asarray(c.validity)
+                ok_n = np.roll(ok, -1)
+                eq = (eq & ok & ok_n) | (~ok & ~ok_n)
+            same &= eq
+        return same
+
+    # -- the traced chain body --------------------------------------------
+    def chain_body(self, cols: List[Column], vis, ops, xp,
+                   host_same=None
+                   ) -> Tuple[List[Column], object, object, object]:
+        """Composed filter+project over (possibly traced) arrays.
+
+        Returns (out device columns, vis, ops, per-stage visible-row
+        counts int64[n_stages]). Host passthrough outputs come back as
+        None placeholders — the caller reattaches them positionally,
+        and passes ``host_same`` (host_noop_eq) so the noop-pair drop
+        sees their equality too. The agg-prelude path passes None: the
+        agg consumes only device columns, whose in-pair equality makes
+        drop-vs-keep output-invisible there (net-zero group delta with
+        unchanged accumulators either way).
+        """
+        from risingwave_tpu.stream.executors.simple import (
+            FilterExecutor,
+        )
+        chunk = StreamChunk(self.in_schema, cols, vis, ops)
+        # per-stage rows: each filter's post-predicate count; projects
+        # report the count AT THEIR POSITION in dataflow order (not the
+        # final count — a filter after a project must not retroactively
+        # shrink the project's attribution)
+        n_stages = len(self.stages)
+        stage_rows = [None] * n_stages
+        for p, si in zip(self.preds, self._pred_stage):
+            chunk = FilterExecutor.apply_predicate(chunk, p)
+            stage_rows[si] = xp.sum(chunk.visibility.astype(xp.int64))
+        out_cols: List[Optional[Column]] = []
+        if self.out_exprs is None:
+            # filter-only run: every column passes through — device
+            # columns from the (possibly traced) chunk, host columns as
+            # None placeholders the caller reattaches positionally
+            out_cols = [None if j in self.host_out else c
+                        for j, c in enumerate(chunk.columns)]
+        else:
+            for j, e in enumerate(self.out_exprs):
+                out_cols.append(None if j in self.host_out
+                                else e.eval(chunk))
+        vis2, ops2 = chunk.visibility, chunk.ops
+        # branchless noop-update-pair drop over the FINAL projection
+        # (identity when no U-/U+ pairs — ProjectExecutor parity)
+        if self.out_exprs is not None:
+            vis2 = _drop_noop_pairs_xp(
+                [c for c in out_cols if c is not None], vis2, ops2, xp,
+                host_same=host_same)
+        final_n = xp.sum(vis2.astype(xp.int64))
+        cur = xp.sum(vis.astype(xp.int64))   # input visible count
+        for si in range(n_stages):
+            if stage_rows[si] is None:       # project: rows at its slot
+                stage_rows[si] = cur
+            else:                            # filter: its own count
+                cur = stage_rows[si]
+        # the LAST stage's emission includes the composed noop-pair
+        # drop (the sequential chain's final project would drop there)
+        stage_rows[-1] = final_n
+        return out_cols, vis2, ops2, xp.stack(stage_rows)
+
+
+def _drop_noop_pairs_xp(cols: Sequence[Column], vis, ops, xp,
+                        host_same=None):
+    """Traced twin of ProjectExecutor._drop_noop_updates: clear both
+    halves of adjacent (U-, U+) pairs whose projected values (and
+    validities) are identical. ``host_same`` carries the host
+    passthrough columns' adjacent equality (they bypass the trace)."""
+    ud = xp.int8(int(Op.UPDATE_DELETE))
+    ui = xp.int8(int(Op.UPDATE_INSERT))
+    is_pair = (vis & xp.roll(vis, -1)
+               & (ops == ud) & (xp.roll(ops, -1) == ui))
+    # roll wraps the last row onto the first: a well-formed chunk never
+    # ends with a dangling U-, and batched matrices carry an invisible
+    # separator row per chunk, so the wrap term is always masked
+    same = xp.ones(vis.shape[0], dtype=bool) if host_same is None \
+        else host_same.astype(bool)
+    for c in cols:
+        v = c.values
+        eq = v == xp.roll(v, -1)
+        if c.validity is not None:
+            ok = c.validity
+            ok_n = xp.roll(ok, -1)
+            eq = (eq & ok & ok_n) | (~ok & ~ok_n)
+        same = same & eq
+    drop = is_pair & same
+    return vis & ~drop & ~xp.roll(drop, 1)
+
+
+# -- standalone traced step (chunk → chunk) --------------------------------
+
+
+def build_chain_step(fs: FusedStages):
+    """jit-compiled (device cols, valids, vis, ops) → (out cols+valids,
+    vis, ops, stage_rows). Host columns bypass; per-capacity compile
+    cache like every other per-shape program."""
+    import jax
+    import jax.numpy as jnp
+
+    in_schema = fs.in_schema
+    ref = list(fs.ref_cols)
+
+    def step(vals, valids, vis, ops, host_same):
+        cap = vis.shape[0]
+        cols: List[Column] = []
+        k = 0
+        for i, f in enumerate(in_schema):
+            if i in fs._ref_set:
+                cols.append(Column(f.data_type, vals[k], valids[k]))
+                k += 1
+            else:
+                cols.append(Column(f.data_type,
+                                   jnp.zeros(cap, dtype=jnp.int32)))
+        out_cols, vis2, ops2, stage_rows = fs.chain_body(
+            cols, vis, ops, jnp, host_same=host_same)
+        flat_vals = tuple(c.values for c in out_cols if c is not None)
+        flat_ok = tuple((jnp.ones(cap, dtype=bool)
+                         if c.validity is None else c.validity)
+                        for c in out_cols if c is not None)
+        return flat_vals, flat_ok, vis2, ops2, stage_rows
+
+    fs._ref_set = set(ref)
+    return jax.jit(step)
+
+
+# -- the agg prelude (inlined into hash_agg.build_apply) -------------------
+
+
+def build_agg_prelude(fs: FusedStages, group_indices: Sequence[int],
+                      agg_calls, specs):
+    """Traced fn: raw int64 matrix → (key_lanes i32[N,3g], signs i32,
+    vis bool, per-call (in_lanes, valid)) — the contract
+    ops/hash_agg.build_apply's core consumes. Everything between the
+    raw upload and the accumulator scatter happens inside the ONE
+    jitted step (filter, project, key/lane encode)."""
+    import jax.numpy as jnp
+
+    in_schema = fs.in_schema
+    ref = list(fs.ref_cols)
+    group = list(group_indices)
+
+    def prelude(raw):
+        cols, vis, ops = decode_raw_cols(raw, in_schema, ref, jnp)
+        out_cols, vis2, ops2, stage_rows = fs.chain_body(
+            cols, vis, ops, jnp)
+        signs = ops_to_signs(ops2)
+        gcols = []
+        for i in group:
+            c = out_cols[i]
+            gcols.append((c.values, c.validity))
+        key_lanes = key_lanes_traced(gcols, jnp)
+        call_inputs = []
+        for call, spec in zip(agg_calls, specs):
+            if call.input_idx is None:          # count(*)
+                call_inputs.append(((), None))
+                continue
+            c = out_cols[call.input_idx]
+            ok = (jnp.ones(vis2.shape[0], dtype=bool)
+                  if c.validity is None else c.validity)
+            # THE per-kind encoding — AggSpec.encode_input, same as
+            # the executor's interpretive _inputs path; the lane
+            # codecs it calls are xp-generic, so one implementation
+            # serves both (no drifting twin)
+            call_inputs.append((spec.encode_input(c.values), ok))
+        return key_lanes, signs, vis2, tuple(call_inputs), stage_rows
+
+    return prelude

@@ -515,7 +515,8 @@ def pack_chunk(key_width: int, specs: Sequence[AggSpec],
     return m
 
 
-def build_apply(key_width: int, specs: Sequence[AggSpec]):
+def build_apply(key_width: int, specs: Sequence[AggSpec],
+                prelude=None):
     """Compile the per-chunk step for a fixed agg plan.
 
     step(state, packed int32[N, W]) → (state, n_inserted int32 scalar).
@@ -523,35 +524,60 @@ def build_apply(key_width: int, specs: Sequence[AggSpec]):
     The insert counter is the sync-free occupancy feed: the host wrapper
     fetches it asynchronously (jaxtools.fetch) so growth decisions never
     block on the device queue.
+
+    With ``prelude`` (ops/fused.py build_agg_prelude), the step takes
+    the RAW int64 chunk matrix instead and the whole fragment chain —
+    filter, project, key/lane encode — inlines ahead of the accumulator
+    updates: ONE jitted dataflow step per dispatch, state donated. The
+    fused step additionally returns per-logical-stage visible-row
+    counts (int64[n_stages]) for metrics attribution.
     """
     specs = tuple(specs)
     slices = _call_slices(specs)
     call_cols = packed_layout(key_width, specs)
 
-    def step(state: AggState, packed):
+    def core(state: AggState, key_lanes, s32, vis, call_inputs):
         cap = state.table.capacity
-        key_lanes = packed[:, :key_width]
-        s32 = packed[:, key_width]
-        vis = packed[:, key_width + 1].astype(bool)
         table, slots, ins = ht.probe_insert(state.table, key_lanes, vis)
         scat = jnp.where(vis, slots, cap)   # invisible rows dropped
         group_rows = state.group_rows.at[scat].add(s32, mode="drop")
         dirty = state.dirty.at[scat].set(True, mode="drop")
         accs = list(state.accs)
-        all_true = jnp.ones(packed.shape[0], dtype=bool)
-        for spec, sl, (lc, vc) in zip(specs, slices, call_cols):
+        all_true = jnp.ones(key_lanes.shape[0], dtype=bool)
+        for spec, sl, (in_lanes, val_ok) in zip(specs, slices,
+                                                call_inputs):
+            _update_call(spec, accs, sl, in_lanes,
+                         all_true if val_ok is None else val_ok,
+                         slots, vis, s32, cap)
+        new_state = AggState(table, group_rows, dirty, tuple(accs),
+                             state.emitted_valid, state.emitted_rows,
+                             state.emitted_accs)
+        return new_state, ins
+
+    if prelude is not None:
+        def step(state: AggState, raw):
+            key_lanes, s32, vis, call_inputs, stage_rows = prelude(raw)
+            new_state, ins = core(state, key_lanes, s32, vis,
+                                  call_inputs)
+            return new_state, ins, stage_rows
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    def step(state: AggState, packed):
+        key_lanes = packed[:, :key_width]
+        s32 = packed[:, key_width]
+        vis = packed[:, key_width + 1].astype(bool)
+        call_inputs = []
+        for spec, (lc, vc) in zip(specs, call_cols):
             if spec.is_float_sum:
                 in_lanes = tuple(jax.lax.bitcast_convert_type(
                     packed[:, i], jnp.float32) for i in lc)
             else:
                 in_lanes = tuple(packed[:, i] for i in lc)
-            val_ok = all_true if vc is None else packed[:, vc].astype(bool)
-            _update_call(spec, accs, sl, in_lanes, val_ok, slots, vis,
-                         s32, cap)
-        new_state = AggState(table, group_rows, dirty, tuple(accs),
-                             state.emitted_valid, state.emitted_rows,
-                             state.emitted_accs)
-        return new_state, ins
+            call_inputs.append(
+                (in_lanes,
+                 None if vc is None else packed[:, vc].astype(bool)))
+        return core(state, key_lanes, s32, vis, tuple(call_inputs))
 
     return jax.jit(step, donate_argnums=(0,))
 
@@ -898,14 +924,25 @@ class GroupedAggKernel:
 
     def __init__(self, key_width: int, specs: Sequence[AggSpec],
                  capacity: Optional[int] = None,
-                 flush_capacity: int = 1 << 10):
+                 flush_capacity: int = 1 << 10,
+                 prelude=None, raw_width: Optional[int] = None,
+                 metrics_label: Optional[str] = None):
         if capacity is None:
             capacity = self.DEFAULT_CAPACITY
         capacity = max(next_pow2(capacity), ht.MIN_CAPACITY)
         self.specs = tuple(specs)
         self.key_width = key_width
         self.state = make_agg_state(capacity, key_width, self.specs)
-        self._apply = build_apply(key_width, self.specs)
+        # fused-fragment mode (ops/fused.py): the backlog holds RAW
+        # int64 chunk matrices and the jitted step runs the whole
+        # filter→project→encode→update chain in one dispatch
+        self._prelude = prelude
+        self._raw_width = raw_width
+        # real-dispatch metrics attribution (fused mode counts at the
+        # ACTUAL jit-invocation sites — one per backlog flush)
+        self.metrics_label = metrics_label
+        self._apply = build_apply(key_width, self.specs,
+                                  prelude=prelude)
         self._gather = build_gather_packed(key_width)
         self._advance = build_advance()
         self._patch = build_patch(self.specs)
@@ -921,6 +958,10 @@ class GroupedAggKernel:
         self._counters = jaxtools.PendingCounters()
         self._backlog: List[np.ndarray] = []   # packed, not yet shipped
         self._backlog_rows = 0
+        self._backlog_vis = 0                  # visible rows (raw mode)
+        # per-stage visible-row vectors from fused dispatches (DMA'd
+        # alongside the insert counters; drained at flush)
+        self._stage_pending: List = []
         self._flush_idx: Optional[np.ndarray] = None
 
     @property
@@ -939,6 +980,8 @@ class GroupedAggKernel:
 
     def apply(self, key_lanes: np.ndarray, signs: np.ndarray,
               vis: np.ndarray, inputs: Sequence) -> None:
+        assert self._prelude is None, \
+            "fused kernel takes raw chunks (apply_raw)"
         packed = pack_chunk(self.key_width, self.specs,
                             np.asarray(key_lanes), np.asarray(signs),
                             np.asarray(vis), inputs)
@@ -950,22 +993,74 @@ class GroupedAggKernel:
         if self._backlog_rows >= self.BATCH_ROWS:
             self._dispatch_backlog()
 
+    def apply_raw(self, raw: np.ndarray, n_visible: int) -> None:
+        """Fused-fragment hot path: backlog one RAW chunk matrix
+        (ops/fused.py encode_raw_chunk) plus an always-invisible
+        separator row — the traced chain's shifted compares must never
+        marry rows across chunk boundaries. Dispatch granularity and
+        padding match `apply` exactly."""
+        assert self._prelude is not None, \
+            "apply_raw needs a fused (prelude) kernel"
+        n = raw.shape[0] + 1
+        if self._backlog_rows + n > self.BATCH_ROWS:
+            self._dispatch_backlog()
+        self._backlog.append(raw)
+        self._backlog.append(np.zeros((1, raw.shape[1]),
+                                      dtype=np.int64))   # separator
+        self._backlog_rows += n
+        self._backlog_vis += int(n_visible)
+        if self._backlog_rows >= self.BATCH_ROWS:
+            self._dispatch_backlog()
+
     def _dispatch_backlog(self) -> None:
         if not self._backlog:
             return
         mats, n = self._backlog, self._backlog_rows
+        n_vis = self._backlog_vis
         self._backlog, self._backlog_rows = [], 0
+        self._backlog_vis = 0
         self._reserve(n)
         w = mats[0].shape[1]
         cap_rows = self.BATCH_ROWS if n <= self.BATCH_ROWS \
             else next_pow2(n)
-        packed = np.zeros((cap_rows, w), dtype=np.int32)  # pad rows: vis=0
-        at = 0
+        raw_mode = self._prelude is not None
+        packed = np.zeros((cap_rows, w),
+                          dtype=np.int64 if raw_mode else np.int32)
+        at = 0                       # pad rows: vis=0
         for m in mats:
             packed[at:at + m.shape[0]] = m
             at += m.shape[0]
-        self.state, ins = self._apply(self.state, jax.device_put(packed))
+        if raw_mode:
+            self.state, ins, stage_rows = self._apply(
+                self.state, jax.device_put(packed))
+            jaxtools.start_fetch(stage_rows)
+            self._stage_pending.append(stage_rows)
+            if self.metrics_label is not None:
+                # REAL dispatch accounting: the fused path launches one
+                # traced program per backlog flush — count it there,
+                # with the batch's true visible-row density
+                from risingwave_tpu.utils.metrics import STREAMING
+                STREAMING.device_dispatch.inc(
+                    1, executor=self.metrics_label)
+                STREAMING.rows_per_dispatch.observe(
+                    float(n_vis), executor=self.metrics_label)
+        else:
+            self.state, ins = self._apply(self.state,
+                                          jax.device_put(packed))
         self._counters.push(ins, n)
+
+    def drain_stage_rows(self) -> Optional[np.ndarray]:
+        """Sum of per-stage visible-row counts since the last drain
+        (fused mode; call at barrier flush — the gather already
+        synchronized the queue, so these fetches are landed DMAs)."""
+        if not self._stage_pending:
+            return None
+        total = None
+        for v in self._stage_pending:
+            a = jaxtools.fetch1(v)
+            total = a if total is None else total + a
+        self._stage_pending = []
+        return np.asarray(total)
 
     # -- growth ---------------------------------------------------------
     def _reserve(self, n: int) -> None:
@@ -1136,6 +1231,8 @@ class GroupedAggKernel:
         self._counters.reset(n)
         self._backlog = []
         self._backlog_rows = 0
+        self._backlog_vis = 0
+        self._stage_pending = []
         if n == 0:
             return
         dev_cols = encode_host_accs(self.specs, acc_cols)

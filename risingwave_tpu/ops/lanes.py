@@ -81,11 +81,17 @@ def _order_u64_from_i64(v: np.ndarray) -> np.ndarray:
 
 
 def _order_u64_from_f64(v: np.ndarray) -> np.ndarray:
-    """float64 → uint64 total order (IEEE bit trick; -0.0 == 0.0)."""
-    v = np.where(v == 0, np.zeros((), dtype=v.dtype), v)
-    bits = v.astype(np.float64).view(np.uint64)
+    """float64 → uint64 total order (IEEE bit trick; -0.0 == 0.0).
+
+    xp-generic (get_xp): the fused-stage prelude traces this exact
+    implementation under jit — one drifting twin would silently break
+    fused-vs-unfused bit identity for float MIN/MAX."""
+    from risingwave_tpu.common.chunk import get_xp
+    xp = get_xp(v)
+    v = xp.where(v == 0, xp.zeros((), dtype=v.dtype), v)
+    bits = v.astype(xp.float64).view(xp.uint64)
     neg = (bits >> np.uint64(63)) == 1
-    return np.where(neg, ~bits, bits | (np.uint64(1) << np.uint64(63)))
+    return xp.where(neg, ~bits, bits | (np.uint64(1) << np.uint64(63)))
 
 
 def _lanes_from_u64(m: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:

@@ -163,7 +163,7 @@ class ShardedAggKernel:
             out = fn(state, *args)
             return jax.tree.map(lambda a: a[None], out)
 
-        mapped = jax.shard_map(
+        mapped = jaxtools.shard_map(
             local, mesh=self.mesh,
             in_specs=(self._state_spec,) + tuple(extra_specs),
             out_specs=out_spec if out_spec is not None
@@ -215,7 +215,7 @@ class ShardedAggKernel:
             return new, ins[None], overflow[None]
 
         state_spec = jax.tree.map(lambda _: P(AXIS), self.state)
-        mapped = jax.shard_map(
+        mapped = jaxtools.shard_map(
             local_step, mesh=self.mesh,
             in_specs=(state_spec, P(AXIS), P(AXIS), P(AXIS), P(AXIS),
                       P()),
@@ -469,7 +469,7 @@ class ShardedAggKernel:
             )
             return jax.tree.map(lambda a: a[None], new)
 
-        mapped = jax.shard_map(
+        mapped = jaxtools.shard_map(
             local, mesh=self.mesh,
             in_specs=(self._state_spec,) + (P(AXIS),) * (3 + len(baccs)),
             out_specs=self._state_spec, check_vma=False)
@@ -542,7 +542,7 @@ class ShardedAggKernel:
             return jax.tree.map(lambda a: a[None], new), n_received[None]
 
         state_spec = jax.tree.map(lambda _: P(AXIS), self.state)
-        mapped = jax.shard_map(
+        mapped = jaxtools.shard_map(
             local, mesh=self.mesh,
             in_specs=(state_spec, P()), out_specs=(state_spec, P(AXIS)),
             check_vma=False)

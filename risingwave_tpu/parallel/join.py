@@ -215,7 +215,7 @@ class ShardedJoinKernel:
                     jax.tree.map(lambda a: a[None], nc))
 
         tspec, cspec = self._specs()
-        mapped = jax.shard_map(
+        mapped = jaxtools.shard_map(
             local, mesh=self.mesh, in_specs=(tspec, cspec),
             out_specs=(tspec, cspec), check_vma=False)
         step = jax.jit(mapped, donate_argnums=(0, 1))
@@ -323,7 +323,7 @@ class ShardedJoinKernel:
                     out[None], ovf[None])
 
         tspec, cspec = self._specs()
-        mapped = jax.shard_map(
+        mapped = jaxtools.shard_map(
             local, mesh=self.mesh,
             in_specs=(tspec, cspec, tspec, cspec, P(AXIS), P(AXIS),
                       P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS),
@@ -355,7 +355,7 @@ class ShardedJoinKernel:
             return out[None], ovf[None]
 
         tspec, cspec = self._specs()
-        mapped = jax.shard_map(
+        mapped = jaxtools.shard_map(
             local, mesh=self.mesh,
             in_specs=(tspec, cspec, P(AXIS), P(AXIS), P(AXIS), P(),
                       P()),
@@ -374,7 +374,7 @@ class ShardedJoinKernel:
             return jax.tree.map(lambda a: a[None], ch), ovf[None]
 
         tspec, cspec = self._specs()
-        mapped = jax.shard_map(
+        mapped = jaxtools.shard_map(
             local, mesh=self.mesh,
             in_specs=(cspec, P(AXIS), P(AXIS), P(AXIS), P(), P()),
             out_specs=(cspec, P(AXIS)),
@@ -397,7 +397,7 @@ class ShardedJoinKernel:
                     jax.tree.map(lambda a: a[None], ch), ovf[None])
 
         tspec, cspec = self._specs()
-        mapped = jax.shard_map(
+        mapped = jaxtools.shard_map(
             local, mesh=self.mesh,
             in_specs=(tspec, cspec, P(AXIS), P(AXIS), P(AXIS), P(),
                       P()),

@@ -219,13 +219,21 @@ class HashAggExecutor(Executor):
                  distinct_tables: Optional[Dict[int, StateTable]] = None,
                  kernel_capacity: Optional[int] = None,
                  flush_capacity: Optional[int] = None,
-                 tier_cap: Optional[int] = None):
+                 tier_cap: Optional[int] = None,
+                 fused_stages=None):
         self.input = input_
         self.group_indices = list(group_indices)
         self.agg_calls = list(agg_calls)
         self.table = table
         self.append_only = append_only
-        in_schema = input_.schema
+        # fragment fusion (ops/fused.py): when set, `input_` is the RAW
+        # upstream and the filter/project run in `fused_stages` inlines
+        # into the kernel's jitted apply (one dispatch per batch, state
+        # donated). Every index below (group, call inputs, schemas)
+        # lives in the POST-stage column space.
+        self.fused_stages = fused_stages
+        in_schema = input_.schema if fused_stages is None \
+            else fused_stages.out_schema
         self.group_types = [in_schema[i].data_type
                             for i in self.group_indices]
         # varchar/host-typed group keys go through the exact interning
@@ -388,20 +396,72 @@ class HashAggExecutor(Executor):
             # to IR and discard) must leave no ghost entries in the
             # process-global registry
             self._tier_nbytes = _nbytes
+        if fused_stages is not None:
+            # fusion eligibility — the rewrite rule refuses these
+            # before ever mutating the plan; failing loud here guards
+            # the IR-rebuild path too. THE one predicate lives in
+            # opt/fusion.py (rule, checker and both executor guards
+            # all call it — no drifting copies).
+            from risingwave_tpu.frontend.opt.fusion import (
+                agg_ineligible_reason,
+            )
+            r = agg_ineligible_reason(self)
+            if r is not None:
+                raise ValueError(f"agg is not fusion-eligible: {r}")
 
     @property
     def kernel(self):
         """Device kernel, built on first touch (see __init__ note —
         plan-only processes must not initialize a JAX backend)."""
         if self._kernel is None:
+            kw = dict(self._kern_kw)
+            if self.fused_stages is not None:
+                from risingwave_tpu.ops.fused import (
+                    build_agg_prelude, raw_width,
+                )
+                kw["prelude"] = build_agg_prelude(
+                    self.fused_stages, self.group_indices,
+                    self.agg_calls, self.specs)
+                kw["raw_width"] = raw_width(
+                    len(self.fused_stages.ref_cols))
+                kw["metrics_label"] = self.identity
             self._kernel = GroupedAggKernel(
                 key_width=_LANES_PER_KEY * len(self.group_indices),
-                specs=self.specs, **self._kern_kw)
+                specs=self.specs, **kw)
         return self._kernel
 
     @kernel.setter
     def kernel(self, k) -> None:
         self._kernel = k
+
+    # -- fragment fusion (frontend/opt/fusion.py mutates in place) -------
+    def adopt_fused_stages(self, fs, raw_input) -> None:
+        """Absorb a filter/project run: `raw_input` becomes the direct
+        input and `fs` (whose out_schema must equal the input schema
+        this executor was planned against) runs inside the kernel's
+        jitted apply. Only valid before the kernel is built."""
+        from risingwave_tpu.frontend.opt.fusion import (
+            agg_fusable_reason,
+        )
+        r = agg_fusable_reason(self)
+        if r is not None:
+            raise ValueError(f"agg is not fusion-eligible: {r}")
+        got = [f.data_type for f in fs.out_schema]
+        # fused_stages is None here (agg_fusable_reason refused
+        # re-fusing above), so the planned-against schema IS the input
+        want = [f.data_type for f in self.input.schema]
+        if got != want:
+            raise ValueError(
+                f"fused stage chain emits {got}, agg planned on {want}")
+        self.fused_stages = fs
+        self.input = raw_input
+
+    def drain_stage_metrics(self):
+        """Per-logical-stage (identity, rows, chunks) attribution for
+        the monitor; empty when unfused."""
+        if self.fused_stages is None:
+            return []
+        return self.fused_stages.drain_stage_metrics()
 
     # -- chunk path ------------------------------------------------------
     def _inputs(self, chunk: StreamChunk) -> Tuple:
@@ -420,6 +480,17 @@ class HashAggExecutor(Executor):
         return tuple(out)
 
     def _apply_chunk(self, chunk: StreamChunk) -> None:
+        if self.fused_stages is not None:
+            # fused fragment path: the RAW chunk ships as one int64
+            # matrix; filter/project/key-encode/lane-encode all run
+            # inside the kernel's jitted apply. Dispatch metrics are
+            # counted by the kernel at REAL dispatch sites (one per
+            # backlog flush), not per chunk — that granularity IS the
+            # fusion win the bench compares.
+            from risingwave_tpu.ops.fused import encode_raw_chunk
+            raw = encode_raw_chunk(chunk, self.fused_stages.ref_cols)
+            self.kernel.apply_raw(raw, chunk.cardinality())
+            return
         key_lanes = self.key_codec.build(chunk, self.group_indices)
         signs = np.asarray(chunk.signs())
         vis = np.asarray(chunk.visibility)
@@ -877,6 +948,13 @@ class HashAggExecutor(Executor):
     def _flush(self) -> Optional[StreamChunk]:
         _METRICS.device_dispatch.inc(1, executor=self.identity)
         fr = self.kernel.flush()
+        if self.fused_stages is not None:
+            # flush synchronized the queue — the per-stage row vectors
+            # are landed DMAs; attribute them to the logical executors
+            # inside the fused block (monitor drains at the barrier)
+            sr = self.kernel.drain_stage_rows()
+            if sr is not None:
+                self.fused_stages.note_stage_rows(sr, 0)
         # the flush dispatch gathers the dirty groups — observe them so
         # the histogram count tracks the dispatch counter exactly
         _METRICS.rows_per_dispatch.observe(float(fr.n),
@@ -1209,12 +1287,18 @@ class HashAggExecutor(Executor):
                         yield out
                     yield msg
                 elif is_watermark(msg):
+                    # fused blocks first map the watermark through the
+                    # absorbed projects' derivations (the sequential
+                    # ProjectExecutors' exact per-message semantics)
+                    wms = [msg] if self.fused_stages is None \
+                        else self.fused_stages.derive_watermarks(msg)
                     # forward only group-key watermarks, re-indexed
-                    if msg.col_idx in self.group_indices:
-                        pos = self.group_indices.index(msg.col_idx)
-                        if pos == 0 and self._cleanable_type():
-                            self._clean_wm = msg.value
-                        yield msg.with_idx(pos)
+                    for m in wms:
+                        if m.col_idx in self.group_indices:
+                            pos = self.group_indices.index(m.col_idx)
+                            if pos == 0 and self._cleanable_type():
+                                self._clean_wm = m.value
+                            yield m.with_idx(pos)
         finally:
             # executor teardown: release this identity's gauge series
             _METRICS.agg_dirty_groups.remove(executor=self.identity)

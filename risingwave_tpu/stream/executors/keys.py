@@ -220,10 +220,13 @@ def to_i64(vals: np.ndarray) -> np.ndarray:
     """Column values → int64, bijective per distinct key.
 
     Floats are bit-cast (1.2 and 1.7 are distinct keys) with -0.0
-    normalized so it groups with 0.0."""
-    if np.issubdtype(vals.dtype, np.floating):
-        vals = np.where(vals == 0, np.zeros((), dtype=vals.dtype), vals)
-        return vals.astype(np.float64).view(np.int64)
-    return vals.astype(np.int64)
+    normalized so it groups with 0.0. xp-generic (get_xp): the fused
+    key-lane prelude traces this exact implementation under jit."""
+    from risingwave_tpu.common.chunk import get_xp
+    xp = get_xp(vals)
+    if np.issubdtype(np.dtype(vals.dtype), np.floating):
+        vals = xp.where(vals == 0, xp.zeros((), dtype=vals.dtype), vals)
+        return vals.astype(xp.float64).view(xp.int64)
+    return vals.astype(xp.int64)
 
 

@@ -159,7 +159,16 @@ class FilterExecutor(Executor):
                 yield msg
 
     def _apply(self, chunk: StreamChunk) -> StreamChunk:
-        pcol = self.predicate.eval(chunk)
+        return self.apply_predicate(chunk, self.predicate)
+
+    @staticmethod
+    def apply_predicate(chunk: StreamChunk,
+                        predicate: Expression) -> StreamChunk:
+        """THE filter transform — xp-generic, so the interpretive path
+        (numpy) and the fused traced path (jit tracers, ops/fused.py)
+        run the same implementation: visibility mask plus U-/U+ pair
+        degradation by shifted compares."""
+        pcol = predicate.eval(chunk)
         xp = get_xp(pcol.values, chunk.ops)
         pred = pcol.values.astype(bool)
         if pcol.validity is not None:  # NULL predicate = not satisfied

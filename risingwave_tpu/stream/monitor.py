@@ -75,6 +75,20 @@ class MonitoredExecutor(Executor):
         self._mark_own, self._mark_kids = own, kids
         _METRICS.executor_busy.inc(excl, **self.labels)
         _METRICS.executor_epoch_seconds.observe(excl, **self.labels)
+        # per-LOGICAL-executor attribution inside fused blocks
+        # (ops/fused.py): a fused run is ONE node in the chain, but
+        # rw_actor_metrics keeps a row per absorbed stage — visible-row
+        # counts come from the traced step itself (filter selectivity
+        # stays observable after fusion)
+        drain = getattr(self.inner, "drain_stage_metrics", None)
+        if drain is None:
+            return
+        for ident, rows, chunks in drain():
+            labels = dict(self.labels)
+            labels["executor"] = f"{self.labels['executor']}::{ident}"
+            _METRICS.executor_rows.inc(rows, **labels)
+            if chunks:
+                _METRICS.executor_chunks.inc(chunks, **labels)
 
     async def execute(self) -> AsyncIterator[Message]:
         it = self.inner.execute()

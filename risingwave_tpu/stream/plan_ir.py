@@ -18,6 +18,16 @@ Node shapes (dicts, `op` discriminated):
   {"op": "coalesce", "input": N, "target_rows": n,
    "max_chunks": n}                     # barrier-bounded chunk
                                         # coalescing (stream/coalesce)
+  {"op": "fused", "input": N,
+   "stages": [{"kind": "filter", "pred": EXPR} |
+              {"kind": "project", "exprs": [...],
+               "names": [...]}]}        # fused filter/project run —
+                                        # ONE traced step per chunk
+                                        # (ops/fused.py); hash_agg
+                                        # nodes may instead carry the
+                                        # same list as "fused_stages"
+                                        # to inline it into the
+                                        # kernel's jitted apply
   {"op": "row_id_gen", "input": N}
   {"op": "hash_agg", "input": N, "group": [...],
    "calls": [{"kind","input_idx","distinct","delimiter"}],
@@ -159,6 +169,24 @@ def expr_from_ir(d: dict) -> Expression:
     raise TypeError(f"unknown expression IR {t!r}")
 
 
+def stages_from_ir(in_schema: Schema, stages_ir: List[dict]):
+    """IR stage list → FusedStages (the worker-side half of the
+    fragmenter's _stages_ir)."""
+    from risingwave_tpu.ops.fused import FusedStage, FusedStages
+    stages = []
+    for st in stages_ir:
+        if st["kind"] == "filter":
+            stages.append(FusedStage(
+                "filter", "FilterExecutor",
+                exprs=(expr_from_ir(st["pred"]),)))
+        else:
+            stages.append(FusedStage(
+                "project", "ProjectExecutor",
+                exprs=tuple(expr_from_ir(e) for e in st["exprs"]),
+                names=tuple(st["names"])))
+    return FusedStages(in_schema, stages)
+
+
 # node-index reference keys: every IR node points at earlier nodes in
 # its fragment through these (plus the list-valued "inputs" of merge).
 # Shared by the scheduler's exchange_in expansion and the exchange-
@@ -258,6 +286,13 @@ def build_fragment(nodes: List[dict], store, local,
                                         DEFAULT_MAX_CHUNKS)))
         elif op == "row_id_gen":
             ex = RowIdGenExecutor(built[node["input"]])
+        elif op == "fused":
+            from risingwave_tpu.stream.executors.fused import (
+                FusedFragmentExecutor,
+            )
+            child = built[node["input"]]
+            ex = FusedFragmentExecutor(
+                child, stages_from_ir(child.schema, node["stages"]))
         elif op == "watermark_filter":
             from risingwave_tpu.stream.executors.watermark_filter \
                 import WATERMARK_STATE_SCHEMA, WatermarkFilterExecutor
@@ -349,7 +384,16 @@ def build_fragment(nodes: List[dict], store, local,
                              delimiter=c.get("delimiter", ","))
                      for c in node["calls"]]
             group = list(node["group"])
-            sch, pk = agg_state_schema(child.schema, group, calls)
+            # a fused agg's index space is the absorbed run's OUTPUT
+            # schema — rebuild the composed prelude first and derive
+            # state schemas against it (coordinator parity)
+            fused = None
+            if node.get("fused_stages"):
+                fused = stages_from_ir(child.schema,
+                                       node["fused_stages"])
+            agg_in_schema = child.schema if fused is None \
+                else fused.out_schema
+            sch, pk = agg_state_schema(agg_in_schema, group, calls)
             table = StateTable(int(node["table_id"]), sch, pk, store,
                                dist_key_indices=list(range(len(pk))))
             # default FALSE like HashAggExecutor itself: a silently
@@ -374,7 +418,7 @@ def build_fragment(nodes: List[dict], store, local,
                 return tid
 
             distinct_tables, minput_tables = agg_aux_tables(
-                child.schema, group, calls, append_only, store,
+                agg_in_schema, group, calls, append_only, store,
                 dedup_table_id=lambda col: _shipped_id(
                     dedup_ids, "dedup_table_ids", col),
                 minput_table_id=lambda j: _shipped_id(
@@ -386,7 +430,8 @@ def build_fragment(nodes: List[dict], store, local,
                 output_names=node.get("output_names"),
                 distinct_tables=distinct_tables,
                 minput_tables=minput_tables,
-                tier_cap=None if tier_cap is None else int(tier_cap))
+                tier_cap=None if tier_cap is None else int(tier_cap),
+                fused_stages=fused)
         elif op == "top_n":
             from risingwave_tpu.stream.executors.top_n import (
                 GroupTopNExecutor,

@@ -26,6 +26,22 @@ _DEFAULT_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
 
 
+def shard_map(f, **kw):
+    """``jax.shard_map`` across jax versions: a top-level alias only in
+    newer jax; the pinned 0.4.x exposes it under
+    ``jax.experimental.shard_map`` with the replication check named
+    ``check_rep`` instead of ``check_vma``."""
+    import jax
+
+    try:
+        return jax.shard_map(f, **kw)
+    except AttributeError:  # pragma: no cover - depends on installed jax
+        from jax.experimental import shard_map as _esm
+
+        kw["check_rep"] = kw.pop("check_vma", True)
+        return _esm.shard_map(f, **kw)
+
+
 def enable_compilation_cache(path: str | None = None) -> str:
     """Point JAX's persistent compilation cache at a repo-local dir."""
     import jax

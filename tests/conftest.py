@@ -85,3 +85,55 @@ def eight_devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+class DispatchBudget:
+    """Tier-1 strict-mode guard for fragment fusion (ISSUE 6): a fused
+    pipeline must be at least as dispatch-dense as its unfused
+    baseline. Usage in fused-vs-unfused tests:
+
+        out_off, d_off, rpd_off = dispatch_budget.measure(run_unfused)
+        out_on,  d_on,  rpd_on  = dispatch_budget.measure(run_fused)
+        dispatch_budget.check(d_off, rpd_off, d_on, rpd_on)
+
+    check() fails the test if the fused run's rows-per-dispatch fell
+    below the unfused baseline's, or its dispatch count did not drop.
+
+    Granularity note (ARCHITECTURE.md "Metrics attribution"): the
+    unfused arm counts per-chunk dispatch REQUESTS (kernel.apply
+    enqueues) while the fused arm counts real backlogged launches, so
+    this guards the executor-level dispatch pressure the fusion
+    removes, not a launch-for-launch comparison.
+    """
+
+    @staticmethod
+    def totals():
+        from risingwave_tpu.utils.metrics import STREAMING
+        d = sum(v for _l, v in STREAMING.device_dispatch.series())
+        r = sum(s for _l, _n, s in
+                STREAMING.rows_per_dispatch.series())
+        return float(d), float(r)
+
+    def measure(self, fn):
+        """(fn result, dispatches, rows/dispatch) over fn's run."""
+        d0, r0 = self.totals()
+        out = fn()
+        d1, r1 = self.totals()
+        d = d1 - d0
+        return out, d, (r1 - r0) / max(d, 1.0)
+
+    @staticmethod
+    def check(d_unfused, rpd_unfused, d_fused, rpd_fused):
+        assert d_fused < d_unfused, (
+            f"fused pipeline dispatched {d_fused} times, unfused "
+            f"baseline {d_unfused} — fusion must strictly drop the "
+            "device dispatch count")
+        assert rpd_fused >= rpd_unfused, (
+            f"fused rows-per-dispatch {rpd_fused:.1f} fell below the "
+            f"unfused baseline {rpd_unfused:.1f} — dispatch-budget "
+            "guard (tier-1 strict mode)")
+
+
+@pytest.fixture
+def dispatch_budget():
+    return DispatchBudget()

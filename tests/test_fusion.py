@@ -1,0 +1,884 @@
+"""Fragment fusion (ISSUE 6): traced-stage units, fused-vs-unfused
+oracles, dispatch accounting, session plumbing, IR round-trips.
+
+Covers the acceptance points: the composed filter/project chain traces
+bit-identically to the sequential executors (including update-pair
+degradation, noop-pair drops and NULL handling), fused nexmark
+q1/q4/q7/q8 + TPC-H q3/q5 runs are bit-identical to unfused through the
+SQL front door, a fused hand-built q7/q3/q8 run shows STRICTLY fewer
+device dispatches at higher rows-per-dispatch (conftest dispatch-budget
+guard), SET stream_fusion rides the DDL log and reschedule replay, the
+checker falls back on a broken fusion, and the {"op":"fused"} /
+hash_agg["fused_stages"] IR rebuilds on cluster workers.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.common.chunk import Column, Op, StreamChunk
+from risingwave_tpu.common.types import DataType, Field, Interval, Schema
+from risingwave_tpu.expr.expr import (
+    BinaryOp, Cast, InputRef, lit, tumble_start,
+)
+from risingwave_tpu.frontend.session import Frontend
+from risingwave_tpu.ops.fused import (
+    FusedStage, FusedStages, encode_raw_chunk, key_lanes_traced,
+    traceable_reason,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+SCHEMA = Schema([Field("k", DataType.INT64),
+                 Field("v", DataType.INT64),
+                 Field("f", DataType.FLOAT64),
+                 Field("s", DataType.VARCHAR)])
+
+
+# -- eligibility walker ----------------------------------------------------
+
+
+def test_traceable_reason_units():
+    dev = BinaryOp("+", InputRef(0, DataType.INT64),
+                   InputRef(1, DataType.INT64))
+    assert traceable_reason(dev, SCHEMA) is None
+    host_ref = InputRef(3, DataType.VARCHAR)
+    assert "host-typed" in traceable_reason(host_ref, SCHEMA)
+    host_cmp = BinaryOp("=", InputRef(3, DataType.VARCHAR),
+                        lit("x"))
+    assert traceable_reason(host_cmp, SCHEMA) is not None
+    dec_cast = Cast(InputRef(2, DataType.FLOAT64), DataType.DECIMAL)
+    assert "DECIMAL" in traceable_reason(dec_cast, SCHEMA)
+    # tumble over a timestamp is the flagship traceable function
+    ts = tumble_start(InputRef(0, DataType.INT64),
+                      Interval(usecs=10))
+    assert traceable_reason(ts, SCHEMA) is None
+
+
+# -- composed chain vs sequential executors --------------------------------
+
+
+def _chunk(n=32, seed=0, with_pairs=True):
+    rng = np.random.default_rng(seed)
+    cap = n
+    k = rng.integers(-50, 50, size=cap).astype(np.int64)
+    v = rng.integers(-1000, 1000, size=cap).astype(np.int64)
+    f = rng.normal(size=cap)
+    f[0] = 0.0
+    if cap > 4:
+        f[4] = -0.0
+    s = np.empty(cap, dtype=object)
+    s[:] = [f"s{int(x) % 5}" for x in k]
+    vis = rng.random(cap) > 0.15
+    ops = np.full(cap, int(Op.INSERT), dtype=np.int8)
+    if with_pairs:
+        for i in range(0, cap - 1, 6):
+            ops[i] = int(Op.UPDATE_DELETE)
+            ops[i + 1] = int(Op.UPDATE_INSERT)
+            vis[i] = vis[i + 1] = True
+            k[i + 1] = k[i]              # same key, maybe same value
+            if i % 12 == 0:
+                v[i + 1] = v[i]          # noop pair after projection
+    val = rng.random(cap) > 0.1
+    cols = [Column(DataType.INT64, k, None),
+            Column(DataType.INT64, v,
+                   None if val.all() else val.copy()),
+            Column(DataType.FLOAT64, f, None),
+            Column(DataType.VARCHAR, s, None)]
+    return StreamChunk(SCHEMA, cols, vis, ops)
+
+
+def _sequential(chunk, pred, exprs, names):
+    """Reference semantics: real FilterExecutor + ProjectExecutor math."""
+    from risingwave_tpu.stream.executors.simple import (
+        FilterExecutor, ProjectExecutor,
+    )
+    c = chunk if pred is None \
+        else FilterExecutor.apply_predicate(chunk, pred)
+    cols = [e.eval(c) for e in exprs]
+    vis = np.asarray(c.visibility)
+    ops_np = np.asarray(c.ops)
+    if (ops_np == int(Op.UPDATE_DELETE)).any():
+        vis = ProjectExecutor._drop_noop_updates(cols, vis.copy(),
+                                                 ops_np)
+    out_schema = Schema([Field(nm, e.return_type)
+                         for nm, e in zip(names, exprs)])
+    return StreamChunk(out_schema, cols, vis, c.ops)
+
+
+def _rows(schema, cols, vis, ops):
+    out = []
+    vis = np.asarray(vis)
+    ops = np.asarray(ops)
+    for i in np.flatnonzero(vis):
+        row = [int(ops[i])]
+        for c in cols:
+            val = c.validity
+            if val is not None and not np.asarray(val)[i]:
+                row.append(None)
+            else:
+                x = np.asarray(c.values)[i]
+                row.append(x.item() if hasattr(x, "item") else x)
+        out.append(tuple(row))
+    return out
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_chain_step_bit_identical_to_sequential(seed):
+    """filter→project composed into one trace == sequential executors,
+    on visible rows (ops included), under numpy AND under jit."""
+    pred = InputRef(0, DataType.INT64) > lit(-10)
+    exprs = [InputRef(0, DataType.INT64),
+             BinaryOp("+", InputRef(1, DataType.INT64), lit(7)),
+             InputRef(2, DataType.FLOAT64)]
+    names = ["k", "v7", "f"]
+    fs = FusedStages(SCHEMA, [
+        FusedStage("filter", "FilterExecutor", exprs=(pred,)),
+        FusedStage("project", "ProjectExecutor", exprs=tuple(exprs),
+                   names=tuple(names))])
+    assert fs.fusable_reason() is None
+    chunk = _chunk(seed=seed)
+    ref = _sequential(chunk, pred, exprs, names)
+    want = _rows(ref.schema, ref.columns, ref.visibility, ref.ops)
+
+    # numpy path of the composed normal form
+    out_cols, vis, ops, stage_rows = fs.chain_body(
+        list(chunk.columns), np.asarray(chunk.visibility),
+        np.asarray(chunk.ops), np)
+    got = _rows(fs.out_schema, out_cols, vis, ops)
+    assert got == want
+
+    # traced path (the standalone executor's jitted step)
+    from risingwave_tpu.ops.fused import build_chain_step
+    step = build_chain_step(fs)
+    vals = tuple(np.asarray(chunk.columns[i].values)
+                 for i in fs.ref_cols)
+    oks = tuple(np.ones(chunk.capacity, dtype=bool)
+                if chunk.columns[i].validity is None
+                else np.asarray(chunk.columns[i].validity)
+                for i in fs.ref_cols)
+    fv, fo, vis2, ops2, srows = step(vals, oks,
+                                     np.asarray(chunk.visibility),
+                                     np.asarray(chunk.ops),
+                                     np.ones(chunk.capacity,
+                                             dtype=bool))
+    cols2 = [Column(f.data_type, np.asarray(a), np.asarray(o))
+             for f, a, o in zip(fs.out_schema, fv, fo)]
+    got2 = _rows(fs.out_schema, cols2, np.asarray(vis2),
+                 np.asarray(ops2))
+    assert got2 == want
+    # per-stage attribution: filter rows ≤ input, project == final
+    sr = np.asarray(srows)
+    assert sr[1] == int(np.asarray(vis2).sum())
+
+
+def test_noop_pair_drop_sees_host_passthrough_columns():
+    """Regression (review finding): a U-/U+ pair whose ONLY change is
+    in a varchar passthrough column must NOT be dropped — the host
+    columns bypass the trace, so their adjacent equality rides in via
+    host_noop_eq."""
+    exprs = [InputRef(0, DataType.INT64),
+             InputRef(3, DataType.VARCHAR)]
+    fs = FusedStages(SCHEMA, [
+        FusedStage("project", "ProjectExecutor", exprs=tuple(exprs),
+                   names=("k", "s"))])
+    assert fs.fusable_reason() is None and fs.host_out == {1: 3}
+    k = np.array([7, 7, 5, 5], dtype=np.int64)
+    s = np.empty(4, dtype=object)
+    s[:] = ["old", "new", "same", "same"]   # pair 0-1 differs ONLY in s
+    cols = [Column(DataType.INT64, k, None),
+            Column(DataType.INT64, np.zeros(4, dtype=np.int64), None),
+            Column(DataType.FLOAT64, np.zeros(4), None),
+            Column(DataType.VARCHAR, s, None)]
+    ops = np.array([int(Op.UPDATE_DELETE), int(Op.UPDATE_INSERT),
+                    int(Op.UPDATE_DELETE), int(Op.UPDATE_INSERT)],
+                   dtype=np.int8)
+    chunk = StreamChunk(SCHEMA, cols, np.ones(4, dtype=bool), ops)
+    out_cols, vis, _o, _sr = fs.chain_body(
+        cols, np.asarray(chunk.visibility), ops, np,
+        host_same=fs.host_noop_eq(chunk))
+    vis = np.asarray(vis)
+    assert vis[0] and vis[1], "varchar-only update pair was dropped"
+    assert not vis[2] and not vis[3], "true noop pair survived"
+    # and the sequential oracle agrees
+    ref = _sequential(chunk, None, exprs, ["k", "s"])
+    assert np.array_equal(vis, np.asarray(ref.visibility))
+
+
+def test_filter_only_run_passes_all_columns_through():
+    """Regression (review finding): a filter-only run has no output
+    projection, so EVERY column passes through — device columns via
+    the trace, host columns around it. Omitting them from ref_cols
+    handed the consumer dummy zero columns."""
+    pred = InputRef(0, DataType.INT64) > lit(0)
+    fs = FusedStages(SCHEMA, [
+        FusedStage("filter", "FilterExecutor", exprs=(pred,))])
+    assert fs.fusable_reason() is None
+    assert fs.ref_cols == [0, 1, 2]          # all device columns
+    assert fs.host_out == {3: 3}             # varchar rides around
+    chunk = _chunk(seed=5)
+    out_cols, vis, ops, _sr = fs.chain_body(
+        list(chunk.columns), np.asarray(chunk.visibility),
+        np.asarray(chunk.ops), np)
+    keep = np.asarray(vis)
+    assert keep.any()
+    # column 1 (never referenced by the predicate) keeps real values
+    assert np.array_equal(np.asarray(out_cols[1].values)[keep],
+                          np.asarray(chunk.columns[1].values)[keep])
+    assert out_cols[3] is None               # host placeholder
+
+
+def test_filter_only_fused_agg_front_door_oracle():
+    """End-to-end shape of the same regression: the fused agg groups
+    on a column the filter never references."""
+    mv = ("CREATE MATERIALIZED VIEW q AS SELECT bidder, "
+          "COUNT(*) AS c, SUM(price) AS s FROM bid "
+          "WHERE price > 100 GROUP BY bidder")
+    rows_off = _front_door_rows(NEXMARK_SOURCES, mv, False)
+    rows_on = _front_door_rows(NEXMARK_SOURCES, mv, True)
+    assert rows_on == rows_off and len(rows_on) > 1
+
+
+def test_key_lanes_traced_match_keycodec():
+    """Traced key-lane builder == KeyCodec.build_arrays, including
+    float bitcast keys with -0.0 normalization and NULLs."""
+    import jax
+    from risingwave_tpu.stream.executors.keys import KeyCodec
+    rng = np.random.default_rng(7)
+    k = rng.integers(-9, 9, size=64).astype(np.int64)
+    f = np.where(rng.random(64) < 0.2, 0.0, rng.normal(size=64))
+    f[3] = -0.0
+    ok = rng.random(64) > 0.3
+    import jax.numpy as jnp
+    codec = KeyCodec([DataType.INT64, DataType.FLOAT64])
+    want = codec.build_arrays([(k, None), (f, ok)])
+    got = jax.jit(lambda a, b, m: key_lanes_traced(
+        [(a, None), (b, m)], jnp))(k, f, ok)
+    assert np.array_equal(np.asarray(got), want)
+
+
+def test_lane_codecs_trace_bit_identical():
+    """ops/lanes.py order/sum codecs under jit == numpy (the fused
+    prelude calls the SAME implementations)."""
+    import jax
+    from risingwave_tpu.ops import lanes
+    v = np.array([0, 1, -1, 2**40, -(2**40), 2**62, -(2**62)],
+                 dtype=np.int64)
+    f = np.array([0.0, -0.0, 1.5, -3.25, 1e300, -1e-300, 7.0])
+    for arr, fn in ((v, lanes.sum_limbs), (v, lanes.order_lanes),
+                    (f, lanes.order_lanes)):
+        want = fn(arr)
+        got = jax.jit(fn)(arr)
+        for a, b in zip(got, want):
+            assert np.array_equal(np.asarray(a), b), fn.__name__
+
+
+# -- fused agg oracle + dispatch budget (hand-built q7) --------------------
+
+
+def _q7_rows(fusion: bool, steps=6):
+    from risingwave_tpu.models.nexmark import build_q7
+    from risingwave_tpu.connectors.nexmark import NexmarkConfig
+    from risingwave_tpu.state.store import MemoryStateStore
+
+    async def main():
+        cfg = NexmarkConfig(event_num=40_000, max_chunk_size=256,
+                            generate_strings=False)
+        p = build_q7(MemoryStateStore(), cfg, rate_limit=24,
+                     min_chunks=24, fusion=fusion)
+        task = p.actor.spawn()
+        for _ in range(steps):
+            await p.loop.inject_and_collect(force_checkpoint=True)
+        from risingwave_tpu.stream.message import StopMutation
+        await p.loop.inject_and_collect(
+            mutation=StopMutation(frozenset({1})))
+        await task
+        if p.actor.failure is not None:
+            raise p.actor.failure
+        return sorted(
+            tuple(row) for _pk, row in _iter_mv(p.mv_table))
+
+    return run(main())
+
+
+def _iter_mv(table):
+    from risingwave_tpu.common.epoch import Epoch, EpochPair
+    ce = table.store.committed_epoch() if hasattr(
+        table.store, "committed_epoch") else None
+    t = type(table)(table.table_id, table.schema,
+                    list(table.pk_indices), table.store,
+                    sanity_check=False)
+    ce = table.store.committed_epoch()
+    t.init_epoch(EpochPair(Epoch(ce + 1), Epoch(ce)))
+    return t.iter_rows()
+
+
+def test_q7_fused_oracle_and_dispatch_budget(dispatch_budget):
+    """THE acceptance test shape: bit-identical MV rows, strictly
+    fewer device dispatches, rows-per-dispatch at least the unfused
+    baseline's (conftest dispatch-budget guard)."""
+    rows_off, d_off, rpd_off = dispatch_budget.measure(
+        lambda: _q7_rows(False))
+    rows_on, d_on, rpd_on = dispatch_budget.measure(
+        lambda: _q7_rows(True))
+    assert rows_on == rows_off and rows_on
+    dispatch_budget.check(d_off, rpd_off, d_on, rpd_on)
+
+
+def test_q3_fused_oracle(dispatch_budget):
+    """TPC-H q3 (3-way join → DECIMAL-revenue project → agg → topn):
+    the revenue projection fuses into the agg kernel."""
+    from risingwave_tpu.models.nexmark import drive_to_completion
+    from risingwave_tpu.models.tpch import build_q3
+    from risingwave_tpu.state.store import MemoryStateStore
+    from risingwave_tpu.connectors.tpch import LINES_PER_ORDER
+
+    def go(fusion):
+        p = build_q3(MemoryStateStore(), customers=120, orders=1200,
+                     rate_limit=4, min_chunks=8, fusion=fusion)
+        targets = {1: 120, 2: 1200, 3: 1200 * LINES_PER_ORDER}
+        run(drive_to_completion(p, targets, in_flight=1))
+        return sorted(tuple(r) for _pk, r in _iter_mv(p.mv_table))
+
+    rows_off, d_off, rpd_off = dispatch_budget.measure(
+        lambda: go(False))
+    rows_on, d_on, rpd_on = dispatch_budget.measure(lambda: go(True))
+    assert rows_on == rows_off and rows_on
+    dispatch_budget.check(d_off, rpd_off, d_on, rpd_on)
+
+
+def test_q8_fused_oracle(dispatch_budget):
+    """q8's auction-side dedup agg absorbs its tumble projection."""
+    from risingwave_tpu.models.nexmark import (
+        build_q8, drive_to_completion,
+    )
+    from risingwave_tpu.connectors.nexmark import NexmarkConfig
+    from risingwave_tpu.state.store import MemoryStateStore
+
+    def go(fusion):
+        base = NexmarkConfig(event_num=40_000, max_chunk_size=256,
+                             generate_strings=False)
+        cfg_p = NexmarkConfig(**{**base.__dict__,
+                                 "table_type": "person"})
+        cfg_a = NexmarkConfig(**{**base.__dict__,
+                                 "table_type": "auction"})
+        p = build_q8(MemoryStateStore(), cfg_p, cfg_a, rate_limit=16,
+                     min_chunks=16, fusion=fusion)
+        targets = {1: 40_000 // 50, 2: 40_000 * 3 // 50}
+        run(drive_to_completion(p, targets, in_flight=1))
+        return sorted(tuple(r) for _pk, r in _iter_mv(p.mv_table))
+
+    rows_off, d_off, rpd_off = dispatch_budget.measure(
+        lambda: go(False))
+    rows_on, d_on, rpd_on = dispatch_budget.measure(lambda: go(True))
+    assert rows_on == rows_off and rows_on
+    dispatch_budget.check(d_off, rpd_off, d_on, rpd_on)
+
+
+# -- rewrite-rule units ----------------------------------------------------
+
+
+def _mini_agg_chain(distinct=False):
+    from risingwave_tpu.ops.hash_agg import AggKind
+    from risingwave_tpu.state.state_table import StateTable
+    from risingwave_tpu.state.store import MemoryStateStore
+    from risingwave_tpu.stream.executors import MockSource
+    from risingwave_tpu.stream.executors.hash_agg import (
+        AggCall, HashAggExecutor, agg_aux_tables, agg_state_schema,
+    )
+    from risingwave_tpu.stream.executors.materialize import (
+        MaterializeExecutor,
+    )
+    from risingwave_tpu.stream.executors.simple import (
+        FilterExecutor, ProjectExecutor,
+    )
+    store = MemoryStateStore()
+    src = MockSource(Schema.of(k=DataType.INT64, v=DataType.INT64), [])
+    filt = FilterExecutor(src, InputRef(1, DataType.INT64) > lit(0))
+    proj = ProjectExecutor(
+        filt, [InputRef(0, DataType.INT64),
+               BinaryOp("*", InputRef(1, DataType.INT64), lit(2))],
+        ["k", "v2"])
+    calls = [AggCall(AggKind.SUM, 1, distinct=distinct)]
+    sch, pk = agg_state_schema(proj.schema, [0], calls)
+    distinct_tables, minput = agg_aux_tables(
+        proj.schema, [0], calls, False, store,
+        dedup_table_id=lambda c: 90 + c,
+        minput_table_id=lambda j: 95 + j)
+    agg = HashAggExecutor(proj, [0], calls,
+                          StateTable(2, sch, pk, store),
+                          distinct_tables=distinct_tables,
+                          minput_tables=minput)
+    mv = StateTable(3, agg.schema, [0], store)
+    return MaterializeExecutor(agg, mv)
+
+
+def test_fusion_rule_absorbs_run_into_agg():
+    from risingwave_tpu.frontend.opt import rewrite_stream_plan
+    root = _mini_agg_chain()
+    new_root, report = rewrite_stream_plan(root, "none", record=False,
+                                           fusion=True)
+    assert report.fired.get("fusion_grouping") == 1
+    agg = new_root.input
+    assert agg.fused_stages is not None
+    assert agg.fused_stages.describe() == \
+        "FilterExecutor→ProjectExecutor"
+    from risingwave_tpu.stream.executors import MockSource
+    assert isinstance(agg.input, MockSource)
+    # without the fusion flag the rule never runs
+    _root2, report2 = rewrite_stream_plan(_mini_agg_chain(), "all",
+                                          record=False)
+    assert "fusion_grouping" not in report2.fired
+
+
+def test_fusion_rule_refuses_distinct_agg():
+    """A DISTINCT agg cannot absorb the run (host dedup multisets read
+    post-stage chunks) — the run still fuses as a STANDALONE block
+    feeding the interpretive agg."""
+    from risingwave_tpu.frontend.opt import rewrite_stream_plan
+    from risingwave_tpu.stream.executors.fused import (
+        FusedFragmentExecutor,
+    )
+    root = _mini_agg_chain(distinct=True)
+    new, report = rewrite_stream_plan(root, "none", record=False,
+                                      fusion=True)
+    agg = new.input
+    assert agg.fused_stages is None, \
+        "DISTINCT agg must not absorb a prelude"
+    assert isinstance(agg.input, FusedFragmentExecutor)
+    assert report.fired.get("fusion_grouping") == 1
+
+
+def test_checker_catches_broken_fused_block():
+    """A fused run planned against the wrong input schema must trip
+    the plan-property checker (fallback off-strict, raise in strict)."""
+    from risingwave_tpu.frontend.opt import (
+        rewrite_stream_plan, set_strict_checker,
+    )
+    from risingwave_tpu.stream.executors.fused import (
+        FusedFragmentExecutor,
+    )
+
+    def broken_rule(root):
+        import copy
+        agg = root.input
+        wrong = Schema.of(a=DataType.INT64)   # NOT the real base schema
+        fs = FusedStages(wrong, [FusedStage(
+            "filter", "FilterExecutor",
+            exprs=(InputRef(0, DataType.INT64) > lit(0),))])
+        bad = FusedFragmentExecutor.__new__(FusedFragmentExecutor)
+        # hand-assemble to bypass the constructor's own assertion —
+        # the checker must not depend on constructor diligence
+        from risingwave_tpu.stream.executor import ExecutorInfo
+        base = agg.input.input            # below the project
+        bad.input = base
+        bad.fused_stages = fs
+        bad._info = ExecutorInfo(fs.out_schema, [], "FusedFragment")
+        bad._step = None
+        bad._ref = list(fs.ref_cols)
+        new_agg = copy.copy(agg)
+        new_agg.input = bad
+        new_root = copy.copy(root)
+        new_root.input = new_agg
+        return new_root, 1, "broken"
+
+    root = _mini_agg_chain()
+    set_strict_checker(False)
+    try:
+        _new, report = rewrite_stream_plan(
+            root, "none", record=False,
+            extra_rules={"broken_fusion": broken_rule})
+        assert any(r == "broken_fusion" for r, _ in report.fallbacks)
+    finally:
+        set_strict_checker(True)
+    with pytest.raises(AssertionError):
+        rewrite_stream_plan(root, "none", record=False,
+                            extra_rules={"broken_fusion": broken_rule})
+
+
+# -- SQL front door: oracle + plumbing -------------------------------------
+
+
+NEXMARK_SOURCES = [
+    ("CREATE SOURCE {t} WITH (connector='nexmark', "
+     "nexmark.table.type='{t}', nexmark.event.num=2000, "
+     "nexmark.max.chunk.size=128, "
+     "nexmark.generate.strings='false')").format(t=t)
+    for t in ("bid", "auction", "person")
+]
+
+TPCH_SOURCES = [
+    ("CREATE SOURCE {t} WITH (connector='tpch', tpch.table='{t}', "
+     "tpch.customers=150, tpch.orders=1500)").format(t=t)
+    for t in ("customer", "orders", "lineitem", "supplier", "nation",
+              "region")
+]
+
+QUERIES = {
+    "nexmark_q1": (NEXMARK_SOURCES,
+                   "CREATE MATERIALIZED VIEW q AS SELECT auction, "
+                   "bidder, price * 89 AS price_dol, date_time "
+                   "FROM bid"),
+    "nexmark_q4": (NEXMARK_SOURCES,
+                   "CREATE MATERIALIZED VIEW q AS "
+                   "SELECT category, AVG(final) AS avg_final FROM ("
+                   "  SELECT a.category AS category, "
+                   "         MAX(b.price) AS final"
+                   "  FROM auction AS a JOIN bid AS b "
+                   "  ON a.id = b.auction"
+                   "  WHERE b.date_time BETWEEN a.date_time "
+                   "  AND a.expires"
+                   "  GROUP BY a.id, a.category) AS q4i "
+                   "GROUP BY category"),
+    "nexmark_q7": (NEXMARK_SOURCES,
+                   "CREATE MATERIALIZED VIEW q AS "
+                   "SELECT window_start, MAX(price) AS max_price, "
+                   "COUNT(*) AS cnt "
+                   "FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND) "
+                   "GROUP BY window_start"),
+    "nexmark_q8": (NEXMARK_SOURCES,
+                   "CREATE MATERIALIZED VIEW q AS "
+                   "SELECT p.id, p.name, p.window_start "
+                   "FROM TUMBLE(person, date_time, INTERVAL '10' "
+                   "SECOND) AS p "
+                   "JOIN TUMBLE(auction, date_time, INTERVAL '10' "
+                   "SECOND) AS a "
+                   "ON p.id = a.seller "
+                   "AND p.window_start = a.window_start"),
+    "tpch_q3": (TPCH_SOURCES,
+                "CREATE MATERIALIZED VIEW q AS SELECT "
+                "o.o_orderkey, o.o_orderdate, o.o_shippriority, "
+                "sum(l.l_extendedprice * (1 - l.l_discount)) "
+                "AS revenue "
+                "FROM customer AS c "
+                "JOIN orders AS o ON c.c_custkey = o.o_custkey "
+                "JOIN lineitem AS l ON o.o_orderkey = l.l_orderkey "
+                "WHERE c.c_mktsegment = 'BUILDING' "
+                "AND o.o_orderdate < 9204 AND l.l_shipdate > 9204 "
+                "GROUP BY o.o_orderkey, o.o_orderdate, "
+                "o.o_shippriority "
+                "ORDER BY revenue DESC, o_orderdate ASC LIMIT 10"),
+    "tpch_q5": (TPCH_SOURCES,
+                "CREATE MATERIALIZED VIEW q AS SELECT n.n_name, "
+                "sum(l.l_extendedprice * (1 - l.l_discount)) "
+                "AS revenue "
+                "FROM customer AS c "
+                "JOIN orders AS o ON c.c_custkey = o.o_custkey "
+                "JOIN lineitem AS l ON o.o_orderkey = l.l_orderkey "
+                "JOIN supplier AS s ON l.l_suppkey = s.s_suppkey "
+                "AND c.c_nationkey = s.s_nationkey "
+                "JOIN nation AS n ON s.s_nationkey = n.n_nationkey "
+                "JOIN region AS r ON n.n_regionkey = r.r_regionkey "
+                "WHERE r.r_name = 'ASIA' AND o.o_orderdate < 9500 "
+                "GROUP BY n.n_name"),
+}
+
+
+def _front_door_rows(sources, mv_sql, fusion, steps=16):
+    async def main():
+        fe = Frontend(rate_limit=16, min_chunks=16)
+        await fe.execute(
+            f"SET stream_fusion = '{'on' if fusion else 'off'}'")
+        for s in sources:
+            await fe.execute(s)
+        await fe.execute(mv_sql)
+        await fe.step(steps)
+        rows = await fe.execute("SELECT * FROM q")
+        await fe.close()
+        return sorted(tuple(r) for r in rows)
+    return run(main())
+
+
+@pytest.mark.parametrize("name", list(QUERIES))
+def test_front_door_oracle_fusion_on_vs_off(name):
+    sources, mv = QUERIES[name]
+    rows_off = _front_door_rows(sources, mv, False)
+    rows_on = _front_door_rows(sources, mv, True)
+    assert rows_on == rows_off, name
+    assert rows_on, f"{name} produced no output at this scale"
+
+
+def test_set_stream_fusion_validates():
+    from risingwave_tpu.frontend.planner import PlanError
+
+    async def main():
+        fe = Frontend()
+        await fe.execute("SET stream_fusion = 'off'")
+        assert (await fe.execute(
+            "SHOW stream_fusion")) == [("off",)]
+        with pytest.raises(PlanError):
+            await fe.execute("SET stream_fusion = 'sideways'")
+        await fe.close()
+    run(main())
+
+
+def test_explain_shows_fusion_group_annotation():
+    async def main():
+        fe = Frontend(rate_limit=4)
+        for s in NEXMARK_SOURCES:
+            await fe.execute(s)
+        rows = await fe.execute(
+            "EXPLAIN SELECT window_start, MAX(price) AS m, "
+            "COUNT(*) AS c "
+            "FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND) "
+            "GROUP BY window_start")
+        text = "\n".join(r[0] for r in rows)
+        assert "fusion_grouping" in text
+        assert "[fused:" in text
+        await fe.close()
+    run(main())
+
+
+def test_ddl_log_replays_create_time_fusion_setting(tmp_path):
+    """SET stream_fusion rides the DDL log: a recovery replays the
+    CREATE under the recorded setting, not the current default."""
+    from risingwave_tpu.storage.hummock import HummockLite
+    from risingwave_tpu.storage.object_store import LocalFsObjectStore
+    from risingwave_tpu.stream.executors.hash_agg import (
+        HashAggExecutor,
+    )
+    from risingwave_tpu.stream.executor import executor_children
+
+    def find_fused_agg(ex):
+        ex = getattr(ex, "inner", ex)       # unwrap monitoring
+        if isinstance(ex, HashAggExecutor) and \
+                ex.fused_stages is not None:
+            return True
+        return any(find_fused_agg(c)
+                   for _a, _i, c in executor_children(ex))
+
+    async def main():
+        store = HummockLite(LocalFsObjectStore(str(tmp_path)))
+        fe = Frontend(store=store, rate_limit=4, min_chunks=4)
+        await fe.execute("SET stream_fusion = 'off'")
+        for s in NEXMARK_SOURCES:
+            await fe.execute(s)
+        await fe.execute(QUERIES["nexmark_q7"][1])
+        await fe.step(4)
+        rows1 = sorted(await fe.execute("SELECT * FROM q"))
+        assert not any(find_fused_agg(a.consumer)
+                       for a in fe.actors.values())
+        await fe.close()
+
+        store2 = HummockLite(LocalFsObjectStore(str(tmp_path)))
+        fe2 = Frontend(store=store2, rate_limit=4, min_chunks=4)
+        await fe2.recover()
+        # the replayed CREATE ran under the RECORDED 'off', even
+        # though a fresh session defaults to 'on'
+        assert fe2.session_vars.get("stream_fusion") == "off"
+        assert not any(find_fused_agg(a.consumer)
+                       for a in fe2.actors.values())
+        rows2 = sorted(await fe2.execute("SELECT * FROM q"))
+        assert rows2 == rows1
+        await fe2.step(3)
+        await fe2.close()
+    run(main())
+
+
+def test_reschedule_replays_fusion(tmp_path):
+    """ALTER SET PARALLELISM back to 1 re-fuses exactly as the CREATE
+    did (the _mv_fusion replay map)."""
+    from risingwave_tpu.stream.executors.hash_agg import (
+        HashAggExecutor,
+    )
+    from risingwave_tpu.stream.executor import executor_children
+
+    def fused_aggs(fe):
+        out = []
+
+        def walk(ex):
+            ex = getattr(ex, "inner", ex)   # unwrap monitoring
+            if isinstance(ex, HashAggExecutor):
+                out.append(ex.fused_stages is not None)
+            for _a, _i, c in executor_children(ex):
+                walk(c)
+        for a in fe.actors.values():
+            walk(a.consumer)
+        return out
+
+    async def main():
+        fe = Frontend(rate_limit=8, min_chunks=8)
+        for s in NEXMARK_SOURCES:
+            await fe.execute(s)
+        await fe.execute(QUERIES["nexmark_q7"][1])
+        await fe.step(4)
+        assert any(fused_aggs(fe))
+        rows1 = sorted(await fe.execute("SELECT * FROM q"))
+        # flip the session default OFF: the replay must still fuse
+        await fe.execute("SET stream_fusion = 'off'")
+        await fe.execute(
+            "ALTER MATERIALIZED VIEW q SET PARALLELISM = 1")
+        await fe.step(4)
+        assert any(fused_aggs(fe)), \
+            "reschedule lost the CREATE-time fusion setting"
+        rows2 = sorted(await fe.execute("SELECT * FROM q"))
+        assert [r for r in rows1 if r in rows2]  # state survived
+        await fe.close()
+    run(main())
+
+
+# -- IR / cluster ----------------------------------------------------------
+
+
+def test_fragmenter_lowers_and_rebuilds_fused_agg():
+    """plan → fuse → fragment → {hash_agg + fused_stages} IR →
+    build_fragment reconstructs a fused executor (coordinator/worker
+    parity)."""
+    from risingwave_tpu.frontend.catalog import Catalog
+    from risingwave_tpu.frontend.fragmenter import Fragmenter
+    from risingwave_tpu.frontend.parser import parse_many
+    from risingwave_tpu.frontend.planner import (
+        StreamPlanner, source_schema,
+    )
+    from risingwave_tpu.frontend.opt import rewrite_stream_plan
+    from risingwave_tpu.state.store import MemoryStateStore
+    from risingwave_tpu.stream.actor import LocalBarrierManager
+    from risingwave_tpu.stream.exchange import channel_for_test
+    from risingwave_tpu.stream.plan_ir import build_fragment
+    from risingwave_tpu.stream.executor import executor_children
+    from risingwave_tpu.stream.executors.hash_agg import (
+        HashAggExecutor,
+    )
+
+    opts = {"connector": "nexmark", "nexmark.table.type": "bid",
+            "nexmark.event.num": "1000",
+            "nexmark.generate.strings": "false"}
+    catalog = Catalog()
+    catalog.add_source("bid", source_schema(opts, None), opts)
+    [(_t, stmt)] = parse_many(
+        "CREATE MATERIALIZED VIEW v AS SELECT auction, "
+        "COUNT(*) AS c, SUM(price) AS s FROM bid "
+        "WHERE price > 100 GROUP BY auction")
+    planner = StreamPlanner(catalog, MemoryStateStore(),
+                            LocalBarrierManager(), definition="")
+    plan = planner.plan("v", stmt.select, 7, rate_limit=4)
+    consumer, report = rewrite_stream_plan(plan.consumer, "all",
+                                           record=False, fusion=True)
+    assert report.fired.get("fusion_grouping")
+    graph = Fragmenter(1).lower(consumer)
+    nodes = [n for f in graph.fragments for n in f.nodes]
+    agg_node = next(n for n in nodes if n["op"] == "hash_agg")
+    assert agg_node.get("fused_stages"), \
+        "fused run missing from the shipped IR"
+    _src, rebuilt = build_fragment(
+        graph.fragments[-1].nodes, MemoryStateStore(),
+        LocalBarrierManager(), channel_for_test)
+
+    def find_agg(ex):
+        if isinstance(ex, HashAggExecutor):
+            return ex
+        for _a, _i, c in executor_children(ex):
+            got = find_agg(c)
+            if got is not None:
+                return got
+        return None
+
+    agg = find_agg(rebuilt)
+    assert agg is not None and agg.fused_stages is not None
+    assert agg.fused_stages.describe() == \
+        consumer.input.fused_stages.describe() \
+        if hasattr(consumer.input, "fused_stages") else True
+
+
+def test_cluster_session_fused_matches_inprocess(tmp_path):
+    """DistFrontend at parallelism 1 ships fused IR to a worker; rows
+    must equal the in-process unfused oracle."""
+    from risingwave_tpu.cluster.session import DistFrontend
+
+    sources = (
+        "CREATE SOURCE bid WITH (connector='nexmark', "
+        "nexmark.table.type='bid', nexmark.event.num=2000, "
+        "nexmark.max.chunk.size=128, "
+        "nexmark.generate.strings='false')",)
+    mv = ("CREATE MATERIALIZED VIEW q AS "
+          "SELECT window_start, MAX(price) AS max_price, "
+          "COUNT(*) AS cnt "
+          "FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND) "
+          "GROUP BY window_start")
+
+    want = set(_front_door_rows(list(sources), mv, False, steps=20))
+
+    async def main():
+        fe = DistFrontend(str(tmp_path), n_workers=1, parallelism=1)
+        await fe.start()
+        try:
+            assert (await fe.execute(
+                "SHOW stream_fusion")) == [("on",)]
+            for s in sources:
+                await fe.execute(s)
+            await fe.execute(mv)
+            await fe.step(20)
+            return {tuple(r)
+                    for r in await fe.execute("SELECT * FROM q")}
+        finally:
+            await fe.close()
+
+    got = asyncio.run(main())
+    assert got == want and got
+
+
+def test_cluster_session_approx_count_distinct(tmp_path):
+    """Regression (ADVICE r5 medium): distributed
+    approx_count_distinct MVs ship their HLL sketch-table ids in
+    minput_table_ids — the worker-side rebuild must succeed and serve
+    the same estimates as the in-process session."""
+    from risingwave_tpu.cluster.session import DistFrontend
+
+    sources = (
+        "CREATE SOURCE bid WITH (connector='nexmark', "
+        "nexmark.table.type='bid', nexmark.event.num=2000, "
+        "nexmark.max.chunk.size=128, "
+        "nexmark.generate.strings='false')",)
+    mv = ("CREATE MATERIALIZED VIEW q AS SELECT auction, "
+          "approx_count_distinct(bidder) AS d FROM bid "
+          "GROUP BY auction")
+
+    want = set(_front_door_rows(list(sources), mv, False, steps=16))
+
+    async def main():
+        fe = DistFrontend(str(tmp_path), n_workers=1, parallelism=1)
+        await fe.start()
+        try:
+            for s in sources:
+                await fe.execute(s)
+            await fe.execute(mv)
+            await fe.step(16)
+            return {tuple(r)
+                    for r in await fe.execute("SELECT * FROM q")}
+        finally:
+            await fe.close()
+
+    got = asyncio.run(main())
+    assert got == want and got
+
+
+# -- monitor attribution ---------------------------------------------------
+
+
+def test_fused_block_stage_metrics_attribution():
+    """rw_actor_metrics keeps a row per LOGICAL executor inside a
+    fused block: the absorbed filter/project stages stay observable."""
+    from risingwave_tpu.utils.metrics import STREAMING
+
+    async def main():
+        fe = Frontend(rate_limit=8, min_chunks=8)
+        for s in NEXMARK_SOURCES:
+            await fe.execute(s)
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW q AS SELECT auction, "
+            "COUNT(*) AS c FROM bid WHERE price > 100 "
+            "GROUP BY auction")
+        await fe.step(6)
+        await fe.close()
+
+    run(main())
+    stage_series = [(labels, v) for labels, v in
+                    STREAMING.executor_rows.series()
+                    if "::FilterExecutor" in labels.get("executor", "")]
+    assert stage_series, \
+        "no per-stage rows attributed inside the fused block"
+    assert sum(v for _l, v in stage_series) > 0
