@@ -83,6 +83,11 @@ def _metrics_snapshot(loop) -> dict:
         # cap above the working set would explain a throughput diff
         "state_tier_evicted": tier_evicted,
         "state_tier_reloads": tier_reloads,
+        # jitted-kernel (re)traces over the WHOLE run (warmup compiles
+        # included); a steady-state-only growth between rounds is a
+        # shape-churn regression — the conftest guard's bench-side twin
+        "kernel_recompiles": int(sum(
+            v for _l, v in STREAMING.kernel_recompile.series())),
         "device_dispatches": dispatches,
         "rows_per_dispatch_avg": round(disp_rows / dispatches, 1)
         if dispatches else 0.0,
@@ -503,6 +508,51 @@ def _smoke_device() -> dict:
     return out
 
 
+def _parse_latency_budgets(argv) -> dict:
+    """--latency-budget 'q7=0.5,adctr=15' (per query) or a bare float
+    (every measured query) → {query: p99 budget seconds}. {} = off."""
+    if "--latency-budget" not in argv:
+        return {}
+    spec = argv[argv.index("--latency-budget") + 1]
+    budgets = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            q, v = part.split("=", 1)
+            budgets[q.strip()] = float(v)
+        else:
+            budgets["*"] = float(part)
+    return budgets
+
+
+def _latency_verdict(headline: dict, budgets: dict) -> dict:
+    """Per-query p99-vs-budget verdicts (ROADMAP item 3's
+    latency-bounded bench mode). Recorded in the headline JSON the
+    driver snapshots into BENCH_r*.json; `ok` False → exit 1."""
+    default = budgets.get("*")
+    verdicts = {}
+    ok = True
+    for name, r in headline.items():
+        if not isinstance(r, dict):
+            continue
+        p99 = r.get("p99_barrier_latency_s")
+        budget = budgets.get(name, default)
+        if budget is None:
+            continue
+        if p99 is None:
+            verdicts[name] = {"budget_s": budget,
+                              "verdict": "no-measurement"}
+            ok = False
+            continue
+        over = p99 > budget
+        ok = ok and not over
+        verdicts[name] = {"budget_s": budget, "p99_s": p99,
+                          "verdict": "over-budget" if over else "ok"}
+    return {"budgets": budgets, "verdicts": verdicts, "ok": ok}
+
+
 def main(argv):
     import contextlib
     import os
@@ -669,7 +719,20 @@ def _main_locked(argv):
         "vs_baseline_platform": platform,
         "platform": platform,
     })
+    budgets = _parse_latency_budgets(argv)
+    verdict = None
+    if budgets:
+        verdict = _latency_verdict(headline, budgets)
+        headline["latency_budget"] = verdict
     print(json.dumps(headline))
+    if verdict is not None and not verdict["ok"]:
+        # latency-bounded mode: a query past its p99 budget fails the
+        # round AFTER the JSON line lands (the driver still records it)
+        over = [q for q, v in verdict["verdicts"].items()
+                if v["verdict"] != "ok"]
+        print(f"FAIL: p99 barrier latency budget exceeded: {over}",
+              file=sys.stderr)
+        sys.exit(1)
 
 
 import functools as _functools

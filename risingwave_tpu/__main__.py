@@ -16,6 +16,8 @@ backup operations against a data directory:
     python -m risingwave_tpu ctl --data-dir D hummock list-ssts
     python -m risingwave_tpu ctl --data-dir D table scan <name> [-n N]
     python -m risingwave_tpu ctl --data-dir D metrics [--steps K]
+    python -m risingwave_tpu ctl --data-dir D trace [--steps K] \
+        [--out trace.json]    # Chrome trace-event JSON (Perfetto)
     python -m risingwave_tpu ctl --data-dir D backup create|list|
         delete <id> | restore <id> --target T
 """
@@ -133,6 +135,8 @@ def _ctl(args) -> int:
         return asyncio.run(_ctl_metrics(obj, args))
     if verb == "memory":
         return asyncio.run(_ctl_memory(obj, args))
+    if verb == "trace":
+        return asyncio.run(_ctl_trace(obj, args))
     if verb == "backup":
         from risingwave_tpu.meta.backup import (
             create_backup, delete_backup, list_backups, restore_backup,
@@ -268,6 +272,38 @@ async def _ctl_memory(obj, args) -> int:
     return 0
 
 
+async def _ctl_trace(obj, args) -> int:
+    """Recover into an in-memory clone (same snapshot discipline as
+    `table scan`), drive a few checkpoints so the flight recorder
+    holds live epoch traces, and export them as Chrome trace-event
+    JSON — open the file at ui.perfetto.dev (or chrome://tracing) to
+    walk an epoch from barrier inject to commit."""
+    import json
+
+    from risingwave_tpu.frontend import Frontend
+    from risingwave_tpu.storage.hummock import HummockLite
+    from risingwave_tpu.utils.spans import EPOCH_TRACER
+
+    fe = Frontend(HummockLite(_snapshot_clone(obj)))
+    await fe.recover()
+    try:
+        await fe.step(args.steps)
+        trace = EPOCH_TRACER.export_chrome()
+    finally:
+        await fe.close()
+    text = json.dumps(trace, indent=1)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        n = sum(1 for e in trace["traceEvents"] if e["ph"] == "X")
+        print(f"wrote {n} spans across "
+              f"{len(EPOCH_TRACER.epochs())} epochs to {args.out}",
+              file=sys.stderr)
+    else:
+        print(text)
+    return 0
+
+
 def main(argv=None) -> None:
     # the axon sitecustomize rewrites jax_platforms at interpreter
     # start, overriding JAX_PLATFORMS=cpu — honor the env var so ctl /
@@ -312,6 +348,14 @@ def main(argv=None) -> None:
              "residency")
     mm.add_argument("--steps", type=int, default=2,
                     help="checkpoint barriers to drive before the dump")
+    tr = csub.add_parser(
+        "trace",
+        help="recover + export epoch-causal traces as Chrome "
+             "trace-event JSON (Perfetto-loadable)")
+    tr.add_argument("--steps", type=int, default=4,
+                    help="checkpoint barriers to drive before export")
+    tr.add_argument("--out", default=None,
+                    help="write the JSON here instead of stdout")
     bk = csub.add_parser("backup")
     bk.add_argument("what",
                     choices=["create", "list", "delete", "restore"])

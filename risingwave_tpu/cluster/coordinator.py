@@ -109,7 +109,7 @@ class WorkerClient:
             m = {"type": "pause"}
         elif isinstance(barrier.mutation, ResumeMutation):
             m = {"type": "resume"}
-        return await self.call({
+        cmd = {
             "cmd": "inject",
             "curr": barrier.epoch.curr.value,
             "prev": barrier.epoch.prev.value,
@@ -118,7 +118,16 @@ class WorkerClient:
             # the coordinator's commit decision pipelined on this
             # barrier (two-phase workers adopt staged SSTs ≤ this)
             "committed": committed,
-        })
+        }
+        from risingwave_tpu.utils import spans as _spans
+        if _spans.enabled():
+            # span context rides the injection: worker-side spans of
+            # this barrier round parent to the coordinator's inject
+            # span — the cross-process causal edge
+            cmd["trace"] = {
+                "span": _spans.EPOCH_TRACER.root_id(
+                    barrier.epoch.curr.value)}
+        return await self.call(cmd)
 
     async def ping(self, io_timeout: float = 2.0) -> dict:
         """Heartbeat probe (cluster.rs heartbeat RPC round trip)."""

@@ -249,6 +249,35 @@ class Cluster:
         for _ in range(n):
             await self.loop.inject_and_collect(force_checkpoint=True)
 
+    # -- epoch-causal tracing ---------------------------------------------
+    async def set_trace(self, on: bool) -> None:
+        """Fan the tracing toggle out to every worker process (the
+        coordinator's own tracer is the caller's to flip)."""
+        await asyncio.gather(*(
+            c.call({"cmd": "set_trace", "on": bool(on)})
+            for c in self.clients if c is not None))
+
+    async def drain_trace(self) -> int:
+        """Pull every worker's recorded spans into the coordinator's
+        flight recorder, tagged by worker slot — a drained span leaves
+        the worker, so repeated drains never duplicate."""
+        from risingwave_tpu.utils.spans import EPOCH_TRACER
+        # keep the REAL slot index next to each reply: enumerating the
+        # None-filtered list would shift every tag left of a dead slot
+        # and attribute a live worker's spans to the wrong process
+        live = [(k, c) for k, c in enumerate(self.clients)
+                if c is not None]
+        replies = await asyncio.gather(*(
+            c.call({"cmd": "drain_trace"}) for _k, c in live))
+        n = 0
+        for (k, _c), reply in zip(live, replies):
+            n += EPOCH_TRACER.ingest(reply.get("spans", ()),
+                                     worker=f"worker-{k}")
+        # the watchdog promoted slow barriers BEFORE these spans
+        # arrived: recompute their straggler lines over the full view
+        EPOCH_TRACER.refresh_diagnoses()
+        return n
+
     # -- distributed reads ------------------------------------------------
     async def scan_table(self, table_id: int) -> List[tuple]:
         """Union a table's committed rows across every namespace

@@ -99,6 +99,7 @@ class DistFrontend:
         # stream_chunk_target_rows: SET here, honored at CREATE time
         from risingwave_tpu.frontend.opt import parse_fusion, parse_rules
         from risingwave_tpu.frontend.session_vars import SessionVars
+        from risingwave_tpu.utils.spans import parse_trace
         self.session_vars = SessionVars(
             self, {"streaming_rate_limit": "rate_limit",
                    "streaming_min_chunks": "min_chunks",
@@ -115,9 +116,14 @@ class DistFrontend:
              # index space is post-stage, so the cut would dispatch
              # raw rows on the wrong columns — the interpretive chain
              # stays until the sharded kernel grows a prelude path
-             "stream_fusion": "on"},
+             "stream_fusion": "on",
+             # epoch-causal tracing: the SET fans out to every worker
+             # over the control channel (same on/off everywhere, or a
+             # drained trace would have holes per process)
+             "stream_trace": "on"},
             validators={"stream_rewrite_rules": parse_rules,
-                        "stream_fusion": parse_fusion})
+                        "stream_fusion": parse_fusion,
+                        "stream_trace": parse_trace})
         # fragment-graph stats of the last deployed job (exchange
         # hops, exchanged lane widths) — bench + tests read this to
         # see what the rewrite engine bought
@@ -189,6 +195,12 @@ class DistFrontend:
             return await self._drop_mv(stmt)
         if isinstance(stmt, ast.SetVar):
             self.session_vars.set(stmt.name, stmt.value)
+            if stmt.name == "stream_trace":
+                from risingwave_tpu.utils import spans as _spans
+                on = _spans.parse_trace(
+                    self.session_vars.get("stream_trace"))
+                _spans.set_enabled(on)
+                await self.cluster.set_trace(on)
             return "SET"
         if isinstance(stmt, ast.Show):
             if stmt.what == "var:all":
@@ -334,9 +346,19 @@ class DistFrontend:
         self._mv_selects.pop(stmt.name, None)
         return "DROP_MATERIALIZED_VIEW"
 
+    async def drain_trace(self) -> int:
+        """Merge every worker's recorded epoch-trace spans into the
+        coordinator's flight recorder (tagged worker-k); returns the
+        number of spans ingested."""
+        return await self.cluster.drain_trace()
+
     async def _select(self, sel: ast.Select) -> Rows:
         from risingwave_tpu.batch import collect
 
+        if self._references_epoch_trace(sel):
+            # the trace table serves the MERGED cluster view: pull
+            # worker spans in before the batch scan reads the tracer
+            await self.drain_trace()
         view = ClusterStoreView(self.cluster)
         # one consistent snapshot: the barrier lock keeps the
         # heartbeat from committing an epoch between per-table scans
@@ -350,6 +372,29 @@ class DistFrontend:
                         profiler=getattr(loop, "profiler", None))
         self.last_select_schema = ex.schema
         return collect(ex)
+
+    @staticmethod
+    def _references_epoch_trace(sel: ast.Select) -> bool:
+        names = []
+
+        def from_item(item):
+            if item is None:
+                return
+            if isinstance(item, ast.Subquery):
+                walk(item.select)
+                return
+            name = getattr(item, "name", None) or getattr(
+                getattr(item, "table", None), "name", None)
+            if name is not None:
+                names.append(str(name).lower())
+
+        def walk(s):
+            from_item(s.from_item)
+            for jn in getattr(s, "joins", []):
+                from_item(jn.item)
+
+        walk(sel)
+        return "rw_epoch_trace" in names
 
     def _referenced_table_ids(self, sel: ast.Select) -> List[int]:
         """MV table ids a SELECT touches (FROM + JOINs + subqueries)."""

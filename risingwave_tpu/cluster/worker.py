@@ -110,6 +110,15 @@ class WorkerServer:
                 self.store.commit_through(epoch)
             return {"ok": True, "dropped": dropped,
                     "committed": self.store.committed_epoch()}
+        if verb == "set_trace":
+            from risingwave_tpu.utils import spans as _spans
+            _spans.set_enabled(bool(cmd.get("on", True)))
+            return {"ok": True}
+        if verb == "drain_trace":
+            # pop this process's recorded spans for the coordinator to
+            # merge (tagged with the worker slot on the other side)
+            from risingwave_tpu.utils.spans import EPOCH_TRACER
+            return {"ok": True, "spans": EPOCH_TRACER.drain_dicts()}
         if verb == "ping":
             # heartbeat probe (cluster.rs heartbeat RPC): liveness +
             # a cheap resource summary for the membership table
@@ -296,6 +305,18 @@ class WorkerServer:
             elif m["type"] == "resume":
                 mutation = ResumeMutation()
         barrier = Barrier(pair, kind, mutation)
+        from risingwave_tpu.utils import spans as _spans
+        _spans.set_current_epoch(pair.curr.value)
+        if _spans.enabled():
+            # worker-side inject marker, parented to the coordinator's
+            # inject span when the injection shipped one: every span
+            # this process records for the epoch links under it
+            parent = (cmd.get("trace") or {}).get("span")
+            wroot = _spans.EPOCH_TRACER.record(
+                "barrier.inject.worker", "barrier",
+                epoch=pair.curr.value, parent=parent,
+                kind=kind.value)
+            _spans.EPOCH_TRACER.set_root(pair.curr.value, wroot)
         await self.local.send_barrier(barrier)
         collected = await self.local.await_epoch_complete(
             pair.curr.value)
@@ -353,6 +374,11 @@ def main(argv=None) -> None:
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         import jax
         jax.config.update("jax_platforms", "cpu")
+
+    # chaos/trace tests arm sleep-spec failpoints in worker
+    # subprocesses via the environment (utils/failpoint.py)
+    from risingwave_tpu.utils.failpoint import arm_from_env
+    arm_from_env()
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--store", required=True,

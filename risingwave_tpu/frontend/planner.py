@@ -1281,6 +1281,24 @@ def _system_catalog_rows(name: str, catalog: Catalog, profiler=None):
                       Field("reload_total", DataType.INT64),
                       Field("resident_bytes", DataType.INT64)])
         return sch, sorted(_TIER.stats_rows())
+    if n == "rw_epoch_trace":
+        # epoch-causal traces (utils/spans.py flight recorder +
+        # retained slow-barrier store): one row per span, plus one
+        # cat='diagnosis' row per retained trace carrying the
+        # straggler line. Joins rw_barrier_latency on epoch.
+        from risingwave_tpu.utils.spans import EPOCH_TRACER
+        sch = Schema([Field("epoch", DataType.INT64),
+                      Field("span_id", DataType.INT64),
+                      Field("parent_id", DataType.INT64),
+                      Field("name", DataType.VARCHAR),
+                      Field("cat", DataType.VARCHAR),
+                      Field("worker", DataType.VARCHAR),
+                      Field("actor", DataType.INT64),
+                      Field("start_s", DataType.FLOAT64),
+                      Field("dur_s", DataType.FLOAT64),
+                      Field("retained", DataType.INT64),
+                      Field("detail", DataType.VARCHAR)])
+        return sch, EPOCH_TRACER.rows()
     if n == "rw_plan_rewrites":
         # plan-rewrite firing log (frontend/opt engine): one row per
         # (job, rule) application, FALLBACK rows record checker trips

@@ -79,6 +79,7 @@ class Frontend:
         # are pg-compatibility strings (shared impl: session_vars.py)
         from risingwave_tpu.frontend.opt import parse_fusion, parse_rules
         from risingwave_tpu.frontend.session_vars import SessionVars
+        from risingwave_tpu.utils.spans import parse_trace
         self.session_vars = SessionVars(
             self, {"streaming_rate_limit": "rate_limit",
                    "streaming_min_chunks": "min_chunks",
@@ -97,9 +98,15 @@ class Frontend:
              # fragment's filter/project run into the keyed kernel's
              # jitted step (one dispatch, donated state); 'off'
              # restores the interpretive chain
-             "stream_fusion": "on"},
+             "stream_fusion": "on",
+             # epoch-causal tracing (utils/spans.py): always-on
+             # bounded flight recorder; 'off' reduces every hook to a
+             # predicate check (and keeps remote barrier frames free
+             # of the span-context trailer)
+             "stream_trace": "on"},
             validators={"stream_rewrite_rules": parse_rules,
-                        "stream_fusion": parse_fusion})
+                        "stream_fusion": parse_fusion,
+                        "stream_trace": parse_trace})
         # rules spec each MV was created under: reschedule replans +
         # re-rewrites with the SAME spec so state-table schemas from
         # the original rewrite reproduce exactly (id-base contract)
@@ -191,6 +198,7 @@ class Frontend:
             if isinstance(stmt, ast.SetVar) and \
                     stmt.name in ("stream_rewrite_rules",
                                   "stream_fusion",
+                                  "stream_trace",
                                   "state_tier_cap",
                                   "state_tier_soft_limit_mb") and \
                     not self._replaying:
@@ -324,6 +332,12 @@ class Frontend:
             return await self._update(stmt)
         if isinstance(stmt, ast.SetVar):
             self.session_vars.set(stmt.name, stmt.value)
+            if stmt.name == "stream_trace":
+                # runtime toggle, not a CREATE-time knob: flips the
+                # process-global tracer right away (TO DEFAULT → on)
+                from risingwave_tpu.utils import spans as _spans
+                _spans.set_enabled(_spans.parse_trace(
+                    self.session_vars.get("stream_trace")))
             return "SET"
         if isinstance(stmt, ast.Show):
             if stmt.what == "var:all":

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import sys
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -27,6 +28,7 @@ from risingwave_tpu.state.store import StateStore
 from risingwave_tpu.storage.uploader import CheckpointUploader
 from risingwave_tpu.stream.actor import LocalBarrierManager
 from risingwave_tpu.stream.message import Barrier, BarrierKind, Mutation
+from risingwave_tpu.utils import spans as _spans
 from risingwave_tpu.utils.metrics import STREAMING, exact_quantile
 from risingwave_tpu.utils.trace import GLOBAL_AWAITS
 
@@ -185,15 +187,24 @@ class EpochProfiler:
                          for p in list(self.profiles)[-last_n:])
 
     def p99_breakdown(self) -> Dict[str, float]:
+        """Per-phase p99 over the profiled barriers. An EMPTY deque —
+        a fresh loop, or a bench whose warmup trim consumed every
+        profile (drop_first(n) with n ≥ len) — yields all-zero phases,
+        never an exception: bench snapshot assembly runs after exactly
+        that trim and must not die on a short run."""
+        profs = list(self.profiles)
+        if not profs:
+            return {"inject_to_collect_s": 0.0,
+                    "collect_to_commit_s": 0.0, "upload_s": 0.0}
         return {
             "inject_to_collect_s": exact_quantile(
-                [p.inject_to_collect_s for p in self.profiles], 0.99),
+                [p.inject_to_collect_s for p in profs], 0.99),
             "collect_to_commit_s": exact_quantile(
-                [p.collect_to_commit_s for p in self.profiles], 0.99),
+                [p.collect_to_commit_s for p in profs], 0.99),
             # the overlapped async tail — NOT part of barrier latency;
             # reported so the overlap is visible, not invisible
             "upload_s": exact_quantile(
-                [p.upload_s for p in self.profiles], 0.99),
+                [p.upload_s for p in profs], 0.99),
         }
 
 
@@ -305,6 +316,14 @@ class BarrierLoop:
         prof = self._upload_profiles.pop(epoch, None)
         if prof is not None:
             prof.upload_s = upload_s
+            if _spans.enabled():
+                # the async checkpoint tail (seal→durable commit),
+                # overlapped with younger barriers — traced under the
+                # barrier that SEALED it so the overlap is visible
+                _spans.EPOCH_TRACER.record(
+                    "checkpoint.upload", "upload", epoch=prof.epoch,
+                    start_s=time.time() - upload_s, dur_s=upload_s,
+                    committed_epoch=epoch)
 
     # -- one step -------------------------------------------------------
     def _next_kind(self, force_checkpoint: bool) -> BarrierKind:
@@ -335,6 +354,16 @@ class BarrierLoop:
         if mutation is None and self._pending_mutations:
             mutation = self._pending_mutations.pop(0)
         barrier = Barrier(pair, kind, mutation)
+        # epoch-causal trace root: every span of this barrier round
+        # (actor processing, exchange edges, dispatches, commit) parents
+        # here. Dispatch spans recorded between barriers attribute to
+        # the newest injected epoch (utils/spans.py docstring).
+        _spans.set_current_epoch(curr.value)
+        if _spans.enabled():
+            root = _spans.EPOCH_TRACER.record(
+                "barrier.inject", "barrier", epoch=curr.value,
+                kind=kind.value)
+            _spans.EPOCH_TRACER.set_root(curr.value, root)
         self._inject_times[curr.value] = self.monotonic()
         self._in_flight.append(curr.value)
         STREAMING.barrier_in_flight.set(len(self._in_flight))
@@ -408,6 +437,27 @@ class BarrierLoop:
                 collect_to_commit_s=self.monotonic() - t_collect,
                 in_flight=len(self._in_flight),
                 collect_times=self.local.take_collect_times(epoch))
+            if _spans.enabled():
+                now = time.time()
+                _spans.EPOCH_TRACER.record(
+                    "barrier.collect", "barrier", epoch=epoch,
+                    start_s=now - prof.total_s,
+                    dur_s=prof.inject_to_collect_s,
+                    in_flight=prof.in_flight)
+                _spans.EPOCH_TRACER.record(
+                    "barrier.commit", "commit", epoch=epoch,
+                    start_s=now - prof.collect_to_commit_s,
+                    dur_s=prof.collect_to_commit_s, kind=prof.kind)
+                if prof.total_s >= self.profiler.slow_threshold_s:
+                    # slow-barrier watchdog: the flight ring rolls in
+                    # EPOCH_WINDOW barriers — promote the outlier's
+                    # full trace into the retained store NOW, with its
+                    # one-line straggler attribution
+                    diag = _spans.EPOCH_TRACER.diagnose(
+                        epoch, prof.total_s)
+                    _spans.EPOCH_TRACER.promote(epoch, diag,
+                                                prof.total_s)
+                    print(f"slow barrier: {diag}", file=sys.stderr)
         if prev > 0 and barrier.is_checkpoint:
             if prof is not None:
                 # registered BEFORE submit: the inline fallback commits

@@ -519,7 +519,8 @@ def build_chain_step(fs: FusedStages):
         return flat_vals, flat_ok, vis2, ops2, stage_rows
 
     fs._ref_set = set(ref)
-    return jax.jit(step)
+    from risingwave_tpu.utils import jaxtools
+    return jaxtools.instrumented_jit(step, "fused.chain_step")
 
 
 # -- the agg prelude (inlined into hash_agg.build_apply) -------------------

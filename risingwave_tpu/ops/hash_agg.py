@@ -54,7 +54,7 @@ import numpy as np
 from risingwave_tpu.common.chunk import next_pow2
 from risingwave_tpu.ops import hash_table as ht
 from risingwave_tpu.ops import lanes
-from risingwave_tpu.utils import jaxtools
+from risingwave_tpu.utils import jaxtools, spans
 
 I32_MIN = -(1 << 31)
 I32_MAX = (1 << 31) - 1
@@ -561,7 +561,8 @@ def build_apply(key_width: int, specs: Sequence[AggSpec],
                                   call_inputs)
             return new_state, ins, stage_rows
 
-        return jax.jit(step, donate_argnums=(0,))
+        return jaxtools.instrumented_jit(step, "hash_agg.apply_fused",
+                                         donate_argnums=(0,))
 
     def step(state: AggState, packed):
         key_lanes = packed[:, :key_width]
@@ -579,7 +580,8 @@ def build_apply(key_width: int, specs: Sequence[AggSpec],
                  None if vc is None else packed[:, vc].astype(bool)))
         return core(state, key_lanes, s32, vis, tuple(call_inputs))
 
-    return jax.jit(step, donate_argnums=(0,))
+    return jaxtools.instrumented_jit(step, "hash_agg.apply",
+                                     donate_argnums=(0,))
 
 
 def _col_i32(a: jnp.ndarray) -> jnp.ndarray:
@@ -631,7 +633,9 @@ def gather_packed(state: AggState, flush_cap: int) -> jnp.ndarray:
 
 def build_gather_packed(key_width: int):
     del key_width   # derived from the state shape at trace time
-    return jax.jit(gather_packed, static_argnums=(1,))
+    return jaxtools.instrumented_jit(gather_packed,
+                                     "hash_agg.flush_gather",
+                                     static_argnums=(1,))
 
 
 def _rebuild_live(state: AggState, live: jnp.ndarray, new_cap: int,
@@ -696,8 +700,9 @@ def retire_state(state: AggState, wm_hi, wm_lo, lane_off: int,
 def build_retire(key_width: int, specs: Sequence[AggSpec]):
     del key_width
     fills = tuple(f for _dt, f in dev_layout(specs))
-    jitted = jax.jit(retire_state, static_argnums=(3, 4),
-                     donate_argnums=(0,))
+    jitted = jaxtools.instrumented_jit(
+        retire_state, "hash_agg.retire", static_argnums=(3, 4),
+        donate_argnums=(0,))
 
     def retire(state, wm_hi, wm_lo, lane_off):
         return jitted(state, wm_hi, wm_lo, lane_off, fills)
@@ -725,8 +730,9 @@ def evict_state(state: AggState, key_lanes: jnp.ndarray,
 
 def build_evict(specs: Sequence[AggSpec]):
     fills = tuple(f for _dt, f in dev_layout(specs))
-    jitted = jax.jit(evict_state, static_argnums=(3,),
-                     donate_argnums=(0,))
+    jitted = jaxtools.instrumented_jit(
+        evict_state, "hash_agg.evict", static_argnums=(3,),
+        donate_argnums=(0,))
 
     def evict(state, key_lanes, valid):
         return jitted(state, key_lanes, valid, fills)
@@ -747,7 +753,8 @@ def advance_state(state: AggState) -> AggState:
 
 
 def build_advance():
-    return jax.jit(advance_state, donate_argnums=(0,))
+    return jaxtools.instrumented_jit(advance_state, "hash_agg.advance",
+                                     donate_argnums=(0,))
 
 
 def encode_patch_cols(specs: Sequence[AggSpec], decoded,
@@ -776,13 +783,12 @@ def build_patch(specs: Sequence[AggSpec]):
     """Compile the host→device acc patch (retractable MIN/MAX recompute
     writes corrected extremes back before the snapshot advances)."""
 
-    @jax.jit
     def patch(state: AggState, idx, new_accs):
         accs = tuple(a.at[idx].set(v, mode="drop")
                      for a, v in zip(state.accs, new_accs))
         return state._replace(accs=accs)
 
-    return patch
+    return jaxtools.instrumented_jit(patch, "hash_agg.patch")
 
 
 def remap_slots(arr: jnp.ndarray, old_to_new: jnp.ndarray,
@@ -941,6 +947,8 @@ class GroupedAggKernel:
         # real-dispatch metrics attribution (fused mode counts at the
         # ACTUAL jit-invocation sites — one per backlog flush)
         self.metrics_label = metrics_label
+        # epoch-trace identity stamped on every dispatch span
+        self._span_label = metrics_label or "GroupedAggKernel"
         self._apply = build_apply(key_width, self.specs,
                                   prelude=prelude)
         self._gather = build_gather_packed(key_width)
@@ -949,11 +957,11 @@ class GroupedAggKernel:
         self._retire = build_retire(key_width, self.specs)
         self._evict = build_evict(self.specs)
         fills = tuple(f for _dt, f in dev_layout(self.specs))
-        self._grow_step = jax.jit(
+        self._grow_step = jaxtools.instrumented_jit(
             lambda st, cap: _rebuild_live(
                 st, st.table.occ & ((st.group_rows != 0) | st.dirty
                                     | st.emitted_valid), cap, fills),
-            static_argnums=(1,), donate_argnums=(0,))
+            "hash_agg.grow", static_argnums=(1,), donate_argnums=(0,))
         self._flush_cap = next_pow2(flush_capacity)
         self._counters = jaxtools.PendingCounters()
         self._backlog: List[np.ndarray] = []   # packed, not yet shipped
@@ -1031,8 +1039,10 @@ class GroupedAggKernel:
             packed[at:at + m.shape[0]] = m
             at += m.shape[0]
         if raw_mode:
-            self.state, ins, stage_rows = self._apply(
-                self.state, jax.device_put(packed))
+            with spans.dispatch_span(self._span_label, n_vis,
+                                     batch_rows=n):
+                self.state, ins, stage_rows = self._apply(
+                    self.state, jax.device_put(packed))
             jaxtools.start_fetch(stage_rows)
             self._stage_pending.append(stage_rows)
             if self.metrics_label is not None:
@@ -1045,8 +1055,10 @@ class GroupedAggKernel:
                 STREAMING.rows_per_dispatch.observe(
                     float(n_vis), executor=self.metrics_label)
         else:
-            self.state, ins = self._apply(self.state,
-                                          jax.device_put(packed))
+            with spans.dispatch_span(self._span_label, n,
+                                     batch_rows=n):
+                self.state, ins = self._apply(self.state,
+                                              jax.device_put(packed))
         self._counters.push(ins, n)
 
     def drain_stage_rows(self) -> Optional[np.ndarray]:
@@ -1176,7 +1188,10 @@ class GroupedAggKernel:
         ``patch_accs`` in between)."""
         self._dispatch_backlog()
         while True:
-            mat = jaxtools.fetch1(self._gather(self.state, self._flush_cap))
+            with spans.dispatch_span(f"{self._span_label}.flush",
+                                     self._counters.bound()):
+                mat = jaxtools.fetch1(
+                    self._gather(self.state, self._flush_cap))
             p = int(mat[0, 0])
             # the gather runs after every queued apply, so its header
             # count subsumes all pending insert counters

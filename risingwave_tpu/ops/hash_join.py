@@ -176,9 +176,13 @@ def probe_pairs(table: ht.TableState, chains: ChainState,
     return jnp.concatenate([header, degs, pairs], axis=0)
 
 
-_link_jit = jax.jit(link_rows, donate_argnums=(0,), static_argnums=(4,))
-_tombstone_jit = jax.jit(tombstone_rows, donate_argnums=(0,))
-_probe_pairs_jit = jax.jit(probe_pairs, static_argnums=(5, 6))
+_link_jit = jaxtools.instrumented_jit(
+    link_rows, "hash_join.link", donate_argnums=(0,),
+    static_argnums=(4,))
+_tombstone_jit = jaxtools.instrumented_jit(
+    tombstone_rows, "hash_join.tombstone", donate_argnums=(0,))
+_probe_pairs_jit = jaxtools.instrumented_jit(
+    probe_pairs, "hash_join.probe", static_argnums=(5, 6))
 
 
 # -- epoch batching --------------------------------------------------------
@@ -210,7 +214,8 @@ def epoch_apply(table: ht.TableState, chains: ChainState,
     return table2, chains2, ins
 
 
-_epoch_apply_jit = jax.jit(epoch_apply, donate_argnums=(0, 1))
+_epoch_apply_jit = jaxtools.instrumented_jit(
+    epoch_apply, "hash_join.epoch_apply", donate_argnums=(0, 1))
 
 
 def epoch_probe(table: ht.TableState, chains: ChainState,
@@ -224,7 +229,8 @@ def epoch_probe(table: ht.TableState, chains: ChainState,
                        with_degrees)
 
 
-_epoch_probe_jit = jax.jit(epoch_probe, static_argnums=(4, 5))
+_epoch_probe_jit = jaxtools.instrumented_jit(
+    epoch_probe, "hash_join.epoch_probe", static_argnums=(4, 5))
 
 
 def apply_and_probe(my_table: ht.TableState, my_chains: ChainState,
@@ -253,9 +259,9 @@ def apply_and_probe(my_table: ht.TableState, my_chains: ChainState,
     return my_table2, chains, ins, mat
 
 
-_apply_and_probe_jit = jax.jit(apply_and_probe,
-                               donate_argnums=(0, 1),
-                               static_argnums=(11,))
+_apply_and_probe_jit = jaxtools.instrumented_jit(
+    apply_and_probe, "hash_join.apply_and_probe",
+    donate_argnums=(0, 1), static_argnums=(11,))
 
 
 def _remap_head(head: jnp.ndarray, old_to_new: jnp.ndarray,
@@ -265,15 +271,18 @@ def _remap_head(head: jnp.ndarray, old_to_new: jnp.ndarray,
         head, mode="drop")
 
 
-_remap_head_jit = jax.jit(_remap_head, static_argnums=(2,))
+_remap_head_jit = jaxtools.instrumented_jit(
+    _remap_head, "hash_join.remap_head", static_argnums=(2,))
 
 
-@jax.jit
 def _rebase_jit(chains: ChainState) -> ChainState:
     mx = jnp.int32(I32_MAX)
     return chains._replace(
         ins_seq=jnp.where(chains.ins_seq == mx, mx, jnp.int32(0)),
         del_seq=jnp.where(chains.del_seq == mx, mx, jnp.int32(0)))
+
+
+_rebase_jit = jaxtools.instrumented_jit(_rebase_jit, "hash_join.rebase")
 
 
 class PendingProbe:

@@ -162,8 +162,9 @@ def lookup(state: TableState, batch_keys: jnp.ndarray,
     return jnp.where(valid & found, slot, jnp.int32(-1))
 
 
-_probe_insert_jit = jax.jit(probe_insert, donate_argnums=(0,))
-_lookup_jit = jax.jit(lookup)
+_probe_insert_jit = jaxtools.instrumented_jit(
+    probe_insert, "hash_table.probe_insert", donate_argnums=(0,))
+_lookup_jit = jaxtools.instrumented_jit(lookup, "hash_table.lookup")
 
 
 class DeviceHashTable:

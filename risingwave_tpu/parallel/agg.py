@@ -169,7 +169,9 @@ class ShardedAggKernel:
             out_specs=out_spec if out_spec is not None
             else self._state_spec,
             check_vma=False)
-        return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+        return jaxtools.instrumented_jit(
+            mapped, "parallel_agg.sharded",
+            donate_argnums=(0,) if donate else ())
 
     # -- the SPMD step ----------------------------------------------------
     def _build_step(self, n_rows: int, bucket: int):
@@ -221,7 +223,8 @@ class ShardedAggKernel:
                       P()),
             out_specs=(state_spec, P(AXIS), P(AXIS)),
             check_vma=False)
-        return jax.jit(mapped, donate_argnums=(0,))
+        return jaxtools.instrumented_jit(
+            mapped, "parallel_agg.step", donate_argnums=(0,))
 
     def apply(self, key_lanes: np.ndarray, signs: np.ndarray,
               vis: np.ndarray,

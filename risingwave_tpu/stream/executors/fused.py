@@ -72,9 +72,11 @@ class FusedFragmentExecutor(Executor):
         host_same = self.fused_stages.host_noop_eq(chunk)
         if host_same is None:
             host_same = np.ones(chunk.capacity, dtype=bool)
-        return self._step(tuple(vals), tuple(oks),
-                          np.asarray(chunk.visibility),
-                          np.asarray(chunk.ops), host_same)
+        from risingwave_tpu.stream.trace_ctx import dispatch_span
+        with dispatch_span(self.identity, float(chunk.cardinality())):
+            return self._step(tuple(vals), tuple(oks),
+                              np.asarray(chunk.visibility),
+                              np.asarray(chunk.ops), host_same)
 
     async def execute(self) -> AsyncIterator[Message]:
         fs = self.fused_stages

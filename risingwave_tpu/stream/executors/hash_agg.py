@@ -428,6 +428,11 @@ class HashAggExecutor(Executor):
             self._kernel = GroupedAggKernel(
                 key_width=_LANES_PER_KEY * len(self.group_indices),
                 specs=self.specs, **kw)
+            # dispatch spans carry the executor identity even when the
+            # metrics_label is unset (unfused mode counts dispatches at
+            # the executor, but trace spans always stamp the kernel at
+            # its real jit sites)
+            self._kernel._span_label = self.identity
         return self._kernel
 
     @kernel.setter

@@ -108,6 +108,7 @@ from risingwave_tpu.stream.executors.keys import (
     LANES_PER_KEY, KeyCodec,
 )
 from risingwave_tpu.stream.message import Message, Watermark, is_barrier
+from risingwave_tpu.stream.trace_ctx import dispatch_span
 from risingwave_tpu.utils.metrics import STREAMING as _METRICS
 
 
@@ -906,9 +907,12 @@ class HashJoinExecutor(Executor):
                 _METRICS.device_dispatch.inc(1, executor=self.identity)
                 _METRICS.rows_per_dispatch.observe(
                     float(probe_vis.sum()), executor=self.identity)
-                handle = me.kernel.apply_and_probe(
-                    other.kernel, key_lanes, probe_vis,
-                    full_refs, ins_mask, del_refs, del_mask, seq)
+                with dispatch_span(self.identity,
+                                   float(probe_vis.sum()),
+                                   site="apply_and_probe"):
+                    handle = me.kernel.apply_and_probe(
+                        other.kernel, key_lanes, probe_vis,
+                        full_refs, ins_mask, del_refs, del_mask, seq)
             self._pending.append(
                 (side_idx, chunk, nonnull, handle, ins_idx, ins_refs,
                  0))
@@ -968,7 +972,10 @@ class HashJoinExecutor(Executor):
             for _ in range(2):
                 _METRICS.rows_per_dispatch.observe(
                     float(total), executor=self.identity)
-            self.sides[s].kernel.apply_epoch(ld, ad, total, max_ref)
+            with dispatch_span(self.identity, float(total),
+                               site="epoch_apply", side=s):
+                self.sides[s].kernel.apply_epoch(ld, ad, total,
+                                                 max_ref)
         with_deg = self.join_type != JoinType.INNER
         probes = {s: self.sides[1 - s].kernel.probe_epoch(ld, ad,
                                                           with_deg)

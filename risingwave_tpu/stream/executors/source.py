@@ -15,6 +15,7 @@ mutations gate generation without blocking barrier flow.
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import AsyncIterator, Optional, Protocol
 
 from risingwave_tpu.common.chunk import StreamChunk
@@ -68,6 +69,12 @@ class SourceExecutor(Executor):
         # epoch sizes deterministically. None = reference behavior.
         self.min_chunks = min_chunks_per_barrier
         self.paused = False
+        # cumulative wall time parked on the barrier channel with
+        # nothing to generate. The monitor subtracts this from the
+        # source's exclusive busy time: a source waiting out a slow
+        # downstream epoch is IDLE, and counting the park as busy
+        # would crown every source the straggler (trace diagnosis)
+        self.idle_wait_s = 0.0
 
     # -- split-state persistence (state_table_handler.rs analog) --------
     def _recover_offset(self) -> None:
@@ -107,7 +114,9 @@ class SourceExecutor(Executor):
         # instead of waiting on async-generator finalization)
         # protocol: first message is the init barrier (source_executor.rs
         # waits for the first barrier before opening the reader)
+        t0 = time.monotonic()
         first = await self.barrier_rx.recv()
+        self.idle_wait_s += time.monotonic() - t0
         assert is_barrier(first), f"source got {first!r} before init barrier"
         if self.split_state is not None:
             self.split_state.init_epoch(first.epoch)
@@ -132,10 +141,13 @@ class SourceExecutor(Executor):
                 self.rate_limit is not None
                 and chunks_this_epoch >= self.rate_limit))
             if not can_generate:
+                t0 = time.monotonic()
                 try:
                     barrier = await self.barrier_rx.recv()  # blocking
                 except ChannelClosed:
                     return
+                finally:
+                    self.idle_wait_s += time.monotonic() - t0
             elif chunks_this_epoch > 0 and (
                     self.min_chunks is None
                     or chunks_this_epoch >= self.min_chunks):

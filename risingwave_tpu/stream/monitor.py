@@ -24,7 +24,11 @@ from typing import AsyncIterator, List, Optional
 from risingwave_tpu.stream.executor import (
     Executor, ExecutorInfo, executor_children,
 )
-from risingwave_tpu.stream.message import Message, is_barrier, is_chunk
+from risingwave_tpu.stream.message import (
+    Barrier, Message, is_barrier, is_chunk,
+)
+from risingwave_tpu.utils import spans as _spans
+from risingwave_tpu.utils.failpoint import fail_point
 from risingwave_tpu.utils.metrics import STREAMING as _METRICS
 from risingwave_tpu.utils.trace import GLOBAL_AWAITS as _AWAITS
 
@@ -57,6 +61,7 @@ class MonitoredExecutor(Executor):
         self.total_busy_s = 0.0     # cumulative time inside inner pulls
         self._mark_own = 0.0        # totals at the last barrier
         self._mark_kids = 0.0
+        self._mark_idle = 0.0       # inner.idle_wait_s at last barrier
         self._who = f"actor-{actor_id}/{node}:{inner.identity}"
 
     def __getattr__(self, name: str):
@@ -67,14 +72,36 @@ class MonitoredExecutor(Executor):
             raise AttributeError(name)
         return getattr(self.inner, name)
 
-    def _flush_epoch(self) -> None:
+    def _flush_epoch(self, barrier: Barrier) -> None:
         own = self.total_busy_s
         kids = sum(c.total_busy_s for c in self.children)
         excl = max(0.0, (own - self._mark_own)
                    - (kids - self._mark_kids))
         self._mark_own, self._mark_kids = own, kids
+        # sources (no wrapped inputs to subtract) expose the time they
+        # spent PARKED on the barrier channel — idle, not processing:
+        # without this, a source waiting out a slow downstream epoch
+        # reads as the busiest executor in the chain
+        idle = getattr(self.inner, "idle_wait_s", None)
+        if idle is not None:
+            excl = max(0.0, excl - (idle - self._mark_idle))
+            self._mark_idle = idle
         _METRICS.executor_busy.inc(excl, **self.labels)
         _METRICS.executor_epoch_seconds.observe(excl, **self.labels)
+        if _spans.enabled():
+            # one actor-phase span per (executor, barrier): exclusive
+            # processing time for the epoch this barrier ends, keyed by
+            # the barrier's CURR epoch (the rw_barrier_latency key) and
+            # parented to its inject span — the causal timeline the
+            # straggler diagnosis reads
+            import time as _t
+            epoch = barrier.epoch.curr.value
+            _spans.EPOCH_TRACER.record(
+                self.labels["executor"], "actor", epoch=epoch,
+                start_s=_t.time() - excl, dur_s=excl,
+                actor=int(self.labels["actor"]),
+                node=self.labels["node"],
+                fragment=self.labels["fragment"])
         # per-LOGICAL-executor attribution inside fused blocks
         # (ops/fused.py): a fused run is ONE node in the chain, but
         # rw_actor_metrics keeps a row per absorbed stage — visible-row
@@ -116,7 +143,15 @@ class MonitoredExecutor(Executor):
                     _METRICS.executor_rows.inc(card, **self.labels)
                     _METRICS.executor_chunks.inc(1, **self.labels)
                 elif is_barrier(msg):
-                    self._flush_epoch()
+                    # armable per-executor-class delay (sleep-spec
+                    # failpoint): chaos/trace tests inject a laggard
+                    # here; the slept time counts as THIS executor's
+                    # busy time so attribution names the right actor
+                    t1 = time.perf_counter()
+                    fail_point("trace.slow."
+                               + type(self.inner).__name__)
+                    self.total_busy_s += time.perf_counter() - t1
+                    self._flush_epoch(msg)
                 yield msg
         finally:
             _AWAITS.exit(self._who)

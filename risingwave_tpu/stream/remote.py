@@ -36,6 +36,9 @@ from risingwave_tpu.stream.message import (
     Barrier, BarrierKind, Message, PauseMutation, ResumeMutation,
     StopMutation, Watermark, is_barrier, is_chunk,
 )
+from risingwave_tpu.stream.trace_ctx import (
+    barrier_trailer, record_remote_transfer,
+)
 
 # stable numeric wire ids per logical type (enum definition order;
 # append-only as types are added)
@@ -311,7 +314,10 @@ class RemoteOutputQueue:
                     "remote exchange peer disconnected")
             await self._q.put(_frame(b"D", encode_chunk(msg)))
         elif is_barrier(msg):
-            await self._q.put(_frame(b"B", encode_barrier(msg)))
+            # span-context trailer (stream/trace_ctx.py): empty bytes
+            # when tracing is off — the frame stays byte-identical
+            await self._q.put(_frame(
+                b"B", encode_barrier(msg) + barrier_trailer(msg)))
         elif isinstance(msg, Watermark):
             await self._q.put(_frame(b"W", encode_watermark(msg)))
         else:
@@ -340,8 +346,15 @@ class RemoteInput(Executor):
         self.up, self.down = up_actor, down_actor
         self.initial_credits = initial_credits
         self.credit_batch = credit_batch
+        # wall time parked on the wire waiting for the next frame —
+        # idle, not processing; the monitor subtracts it from this
+        # node's exclusive busy time (same contract as SourceExecutor:
+        # an input edge waiting out a slow remote epoch must not read
+        # as the chain's straggler)
+        self.idle_wait_s = 0.0
 
     async def execute(self) -> AsyncIterator[Message]:
+        import time as _time
         reader, writer = await asyncio.open_connection(self.host,
                                                        self.port)
         writer.write(_frame(b"H", struct.pack(
@@ -350,10 +363,13 @@ class RemoteInput(Executor):
         consumed = 0
         try:
             while True:
+                t0 = _time.monotonic()
                 try:
                     tag, payload = await _read_frame(reader)
                 except asyncio.IncompleteReadError:
                     return                      # upstream closed
+                finally:
+                    self.idle_wait_s += _time.monotonic() - t0
                 if tag == b"D":
                     consumed += 1
                     if consumed >= self.credit_batch:
@@ -364,6 +380,9 @@ class RemoteInput(Executor):
                     yield decode_chunk(payload, self.schema)
                 elif tag == b"B":
                     barrier = decode_barrier(payload)
+                    # cross-worker causal edge: links this process's
+                    # spans under the sender's inject span
+                    record_remote_transfer(payload, self.up, self.down)
                     yield barrier
                     if barrier.is_stop(self.down):
                         return

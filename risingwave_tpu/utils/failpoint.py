@@ -13,11 +13,19 @@ named points with an exception factory or a probability:
 Probabilistic points draw from a seeded Generator, so a chaos run is
 DETERMINISTIC for a given seed — the madsim stance (SURVEY §4): faults
 are reproducible, not racy.
+
+Delay actions (the fail crate's `sleep` analog): a spec of
+``{"sleep_s": 0.2}`` makes the point SLEEP instead of raise — how
+trace/latency tests inject a deterministic straggler. Subprocesses
+(cluster workers) arm points from the ``RW_TPU_FAILPOINTS`` env var
+(JSON name → sleep spec) at boot via ``arm_from_env()``; only sleep
+specs are env-armable — exceptions don't round-trip through JSON.
 """
 
 from __future__ import annotations
 
 import contextlib
+import time
 from typing import Dict, Optional, Union
 
 import numpy as np
@@ -35,6 +43,10 @@ def fail_point(name: str) -> None:
     spec = _ARMED.get(name)
     if spec is None:
         return
+    if isinstance(spec, dict):
+        FIRED[name] = FIRED.get(name, 0) + 1
+        time.sleep(float(spec["sleep_s"]))
+        return
     if isinstance(spec, tuple):
         prob, exc = spec
         if _RNG is None or _RNG.random() >= prob:
@@ -47,6 +59,25 @@ def fail_point(name: str) -> None:
         # tracebacks without bound and aliases state across catchers
         raise type(exc)(*exc.args)
     raise exc()
+
+
+def arm_from_env() -> int:
+    """Arm sleep-spec failpoints from RW_TPU_FAILPOINTS (subprocess
+    boot path — worker processes can't enter a parent's context
+    manager). Returns the number of points armed."""
+    import json
+    import os
+    raw = os.environ.get("RW_TPU_FAILPOINTS")
+    if not raw:
+        return 0
+    points = json.loads(raw)
+    for name, spec in points.items():
+        if not (isinstance(spec, dict) and "sleep_s" in spec):
+            raise ValueError(
+                f"env failpoint {name!r} must be a sleep spec, "
+                f"got {spec!r}")
+        _ARMED[name] = {"sleep_s": float(spec["sleep_s"])}
+    return len(points)
 
 
 @contextlib.contextmanager

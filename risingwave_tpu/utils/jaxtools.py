@@ -42,6 +42,30 @@ def shard_map(f, **kw):
         return _esm.shard_map(f, **kw)
 
 
+def instrumented_jit(fn, label: str | None = None, **jit_kw):
+    """``jax.jit`` with (re)trace visibility: the wrapper's Python body
+    runs only while jax TRACES it — once per new input shape bucket —
+    so each execution of the hook is exactly one compile event. It
+    lands in ``stream_kernel_recompile_count{kernel=label}`` and as a
+    compile span in the current epoch's trace (utils/spans.py), making
+    warmup compiles and steady-state shape-churn recompiles visible
+    instead of silent multi-second stalls. Steady state pays nothing:
+    jit dispatches the cached executable without entering the body."""
+    import functools
+
+    import jax
+
+    name = label or getattr(fn, "__name__", "kernel")
+
+    @functools.wraps(fn)
+    def traced(*a, **k):
+        from risingwave_tpu.utils.spans import note_compile
+        note_compile(name)
+        return fn(*a, **k)
+
+    return jax.jit(traced, **jit_kw)
+
+
 def enable_compilation_cache(path: str | None = None) -> str:
     """Point JAX's persistent compilation cache at a repo-local dir."""
     import jax

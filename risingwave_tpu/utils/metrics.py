@@ -308,6 +308,15 @@ class StreamingMetrics:
             "amortize the per-dispatch overhead)",
             buckets=(1.0, 8.0, 32.0, 128.0, 512.0, 2048.0, 8192.0,
                      32768.0))
+        self.kernel_recompile = r.counter(
+            "stream_kernel_recompile_count",
+            "jitted-kernel (re)traces by kernel label — nonzero "
+            "during warmup, any steady-state growth is a shape-churn "
+            "bug recompiling on the hot path")
+        self.trace_spans_dropped = r.counter(
+            "stream_trace_spans_dropped",
+            "epoch-trace spans dropped over the per-epoch cap "
+            "(utils/spans.py flight recorder bound)")
         self.coalesce_chunks_in = r.counter(
             "stream_coalesce_chunks_in",
             "chunks entering coalescers (ratio vs _out is the "

@@ -137,3 +137,43 @@ class DispatchBudget:
 @pytest.fixture
 def dispatch_budget():
     return DispatchBudget()
+
+
+class RecompileGuard:
+    """Tier-1 strict-mode guard for jitted-kernel shape stability
+    (ISSUE 7): a steady-state run must not retrace kernels after
+    warmup — a retrace on the hot path is a silent shape-churn
+    regression (each costs ~0.5-1s of compiler on a tunneled device).
+    Usage:
+
+        out, n_warm = recompile_guard.measure(run_warmup)
+        out, n_steady = recompile_guard.measure(run_steady_state)
+        recompile_guard.check_steady(n_steady)
+
+    measure() counts stream_kernel_recompile_count growth over fn;
+    check_steady() fails the test on ANY steady-state retrace.
+    """
+
+    @staticmethod
+    def total():
+        from risingwave_tpu.utils.metrics import STREAMING
+        return sum(v for _l, v in
+                   STREAMING.kernel_recompile.series())
+
+    def measure(self, fn):
+        t0 = self.total()
+        out = fn()
+        return out, self.total() - t0
+
+    @staticmethod
+    def check_steady(n_recompiles, what="steady state"):
+        assert n_recompiles == 0, (
+            f"{n_recompiles} jitted-kernel retraces during {what} — "
+            "warmup must have compiled every shape bucket; a "
+            "steady-state retrace is a shape-churn regression "
+            "(recompile-guard, tier-1 strict mode)")
+
+
+@pytest.fixture
+def recompile_guard():
+    return RecompileGuard()
