@@ -508,6 +508,99 @@ def _smoke_device() -> dict:
     return out
 
 
+def bench_chaos(seed: int = 7, events: int = 6000) -> dict:
+    """Deterministic chaos round (``bench.py --chaos``): replay the
+    seeded fault schedule — worker SIGKILL mid-epoch, object-store
+    flake (absorbed), upload fault past retries, straggler past the
+    barrier timeout — against distributed nexmark q7 and q4 pipelines
+    and assert each MV converges to its fault-free in-process oracle
+    bit-identically. The snapshot records recovery counts, causes and
+    MTTR: tail behavior under faults is a bench trajectory, not an
+    anecdote (Hazelcast Jet's stance, arxiv 2103.10169)."""
+    import tempfile
+
+    from risingwave_tpu.cluster.chaos import run_chaos
+    from risingwave_tpu.cluster.session import DistFrontend
+    from risingwave_tpu.frontend.session import Frontend
+
+    q7_srcs = [
+        ("CREATE SOURCE bid WITH (connector='nexmark', "
+         "nexmark.table.type='bid', nexmark.event.num={n}, "
+         "nexmark.max.chunk.size=256, "
+         "nexmark.min.event.gap.in.ns=50000000)")]
+    q7_mv = ("CREATE MATERIALIZED VIEW q7 AS SELECT window_start, "
+             "MAX(price) AS max_price, COUNT(*) AS cnt "
+             "FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND) "
+             "GROUP BY window_start")
+    q4_srcs = [
+        ("CREATE SOURCE auction WITH (connector='nexmark', "
+         "nexmark.table.type='auction', nexmark.event.num={n}, "
+         "nexmark.max.chunk.size=256)"),
+        ("CREATE SOURCE bid WITH (connector='nexmark', "
+         "nexmark.table.type='bid', nexmark.event.num={n}, "
+         "nexmark.max.chunk.size=256)")]
+    q4_mv = ("CREATE MATERIALIZED VIEW q4 AS "
+             "SELECT category, AVG(final) AS avg_final FROM ("
+             "  SELECT a.category AS category, MAX(b.price) AS final"
+             "  FROM auction AS a JOIN bid AS b ON a.id = b.auction"
+             "  WHERE b.date_time BETWEEN a.date_time AND a.expires"
+             "  GROUP BY a.id, a.category) AS q GROUP BY category")
+
+    def oracle(srcs, mv, select):
+        async def run():
+            fe = Frontend(min_chunks=8)
+            for s in srcs:
+                await fe.execute(s.format(n=events))
+            await fe.execute(mv)
+            await fe.step(40)
+            rows = await fe.execute(select)
+            await fe.close()
+            return {tuple(r) for r in rows}
+        return asyncio.run(run())
+
+    def chaos_run(srcs, mv, select):
+        async def run():
+            with tempfile.TemporaryDirectory() as tmp:
+                # wedge timeout with headroom over the natural worst
+                # post-recovery barrier (~2-4s on CPU): a spurious
+                # wedge would break the seeded schedule's determinism
+                fe = DistFrontend(tmp, n_workers=2, parallelism=2,
+                                  barrier_timeout_s=8.0)
+                await fe.start()
+                try:
+                    for s in srcs:
+                        await fe.execute(s.format(n=events))
+                    await fe.execute(mv)
+                    report = await run_chaos(fe, seed,
+                                             settle_steps=50)
+                    rows = {tuple(r)
+                            for r in await fe.execute(select)}
+                    return report, rows
+                finally:
+                    await fe.close()
+        return asyncio.run(run())
+
+    out = {"metric": "chaos_mttr_s", "unit": "s", "seed": seed,
+           "events": events}
+    mttrs = []
+    all_ok = True
+    for name, srcs, mv in (("q7", q7_srcs, q7_mv),
+                           ("q4", q4_srcs, q4_mv)):
+        select = f"SELECT * FROM {name}"
+        expect = oracle(srcs, mv, select)
+        report, rows = chaos_run(srcs, mv, select)
+        ok = rows == expect
+        all_ok = all_ok and ok
+        mttrs += report.mttr_s
+        out[name] = dict(report.summary(), oracle_ok=ok,
+                         oracle_rows=len(expect))
+    out["value"] = (round(sum(mttrs) / len(mttrs), 4)
+                    if mttrs else None)
+    out["recovery_count"] = len(mttrs)
+    out["oracle_ok"] = all_ok
+    return out
+
+
 def _parse_latency_budgets(argv) -> dict:
     """--latency-budget 'q7=0.5,adctr=15' (per query) or a bare float
     (every measured query) → {query: p99 budget seconds}. {} = off."""
@@ -629,6 +722,25 @@ def _main_locked(argv):
                               "error": repr(e)[:300]}))
             raise
         return
+    if "--chaos" in argv:
+        # deterministic chaos round: seeded fault schedule against
+        # distributed q7/q4, oracle-checked, MTTR in the snapshot.
+        # CPU-pinned: the faults under test are control-plane, and a
+        # killed worker must not wedge a shared accelerator tunnel
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        enable_compilation_cache()
+        seed = (int(argv[argv.index("--chaos-seed") + 1])
+                if "--chaos-seed" in argv else 7)
+        out = bench_chaos(seed=seed)
+        print(json.dumps(out))
+        if not out["oracle_ok"]:
+            print("FAIL: chaos run diverged from the fault-free "
+                  "oracle", file=sys.stderr)
+            sys.exit(1)
+        return
     if "--one" in argv:
         # child mode: one query, full-scale warmup then measure
         import os
@@ -719,6 +831,20 @@ def _main_locked(argv):
         "vs_baseline_platform": platform,
         "platform": platform,
     })
+    if "--with-chaos" in argv:
+        # the chaos round rides the headline snapshot: recovery counts
+        # and MTTR become part of the bench trajectory. Run it through
+        # the --chaos child so it gets that branch's CPU pinning — the
+        # in-process oracle must share the CPU workers' float
+        # semantics, and a killed worker must not touch a shared
+        # accelerator tunnel
+        try:
+            headline["chaos"] = _run_bench_subprocess(
+                ["--chaos"], {"JAX_PLATFORMS": "cpu",
+                              "RW_TPU_CHIP_LOCK_HELD": "1"})
+        except Exception as e:                       # noqa: BLE001
+            print(f"WARNING: chaos failed: {e!r}", file=sys.stderr)
+            headline["chaos"] = {"error": repr(e)[:200]}
     budgets = _parse_latency_budgets(argv)
     verdict = None
     if budgets:

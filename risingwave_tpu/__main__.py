@@ -35,8 +35,13 @@ async def _serve(args) -> None:
 
     if args.data_dir:
         from risingwave_tpu.storage.hummock import HummockLite
-        from risingwave_tpu.storage.object_store import LocalFsObjectStore
-        store = HummockLite(LocalFsObjectStore(args.data_dir))
+        from risingwave_tpu.storage.object_store import (
+            LocalFsObjectStore, RetryingObjectStore,
+        )
+        # serving deployments absorb transient PUT/GET faults in place
+        # (jittered-backoff retries) instead of failing a barrier round
+        store = HummockLite(
+            RetryingObjectStore(LocalFsObjectStore(args.data_dir)))
     else:
         from risingwave_tpu.state.store import MemoryStateStore
         store = MemoryStateStore()

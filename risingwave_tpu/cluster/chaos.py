@@ -1,0 +1,218 @@
+"""Deterministic chaos harness: a seeded fault schedule replayed
+against a distributed session.
+
+The madsim stance (SURVEY §4, already adopted by utils/failpoint.py):
+fault schedules are DETERMINISTIC and reproducible — a chaos run is an
+experiment you can replay, not a dice roll you describe. A seed fully
+determines the schedule (which faults, at which barrier steps, on
+which worker slots), every fault is injected at a step boundary, and
+the recovery supervisor's classification of each induced failure is a
+function of the fault — so the same seed reproduces the same
+(cause, action) recovery sequence, which tests assert literally.
+
+Fault vocabulary (each exercises one rung of the response ladder):
+
+- ``flake_object_store`` — one transient PUT failure inside a worker,
+  UNDER the RetryingObjectStore budget: absorbed in place, retry
+  metrics move, NO recovery event.
+- ``kill_worker`` — SIGKILL one worker subprocess mid-epoch: the next
+  barrier round fails, the supervisor classifies ``dead_worker`` and
+  respawns only the dead slot (live slots reset in place).
+- ``fail_upload`` — a worker's checkpoint upload fails PAST the retry
+  budget: surfaces as a worker-side OSError, classified
+  ``storage_fault``, full recovery (which also replaces the faulty
+  process, healing the injected fault — like swapping a dying disk).
+- ``straggler`` — one executor sleeps past the barrier collect
+  timeout: ``BarrierWedgedError``, classified ``wedged_barrier``,
+  full recovery.
+
+Faults inject into LIVE worker processes over the control channel's
+``arm_failpoints`` verb (exception specs are JSON — the failpoint
+env/wire restriction), so a respawned worker always comes back clean.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from risingwave_tpu.meta.supervisor import RecoveryEvent
+
+# absorbable flake: strictly under RetryingObjectStore's default
+# retry budget (3) so the bottom rung provably swallows it
+_FLAKE_TIMES = 1
+# terminal upload fault: strictly past the same budget
+_FAULT_TIMES = 16
+
+
+@dataclass(frozen=True)
+class ChaosEvent:
+    """One scheduled fault: injected right before barrier `step`."""
+
+    step: int
+    kind: str          # flake_object_store|kill_worker|fail_upload|straggler
+    slot: int
+
+    def row(self) -> tuple:
+        return (self.step, self.kind, self.slot)
+
+
+def generate_schedule(seed: int, n_workers: int = 2,
+                      steps: int = 24,
+                      kinds: Optional[List[str]] = None
+                      ) -> List[ChaosEvent]:
+    """Seeded schedule with guaranteed coverage: one fault of every
+    kind (default: flake + SIGKILL + upload fault + straggler — the
+    acceptance mix), at distinct PRNG-drawn steps/slots. Same seed ⇒
+    same schedule, byte for byte."""
+    rng = random.Random(seed)
+    kinds = list(kinds if kinds is not None else (
+        "flake_object_store", "kill_worker", "fail_upload",
+        "straggler"))
+    # termination bound for the rejection sampling below: each accepted
+    # pick blocks at most 3 candidate steps (itself ±1), so the
+    # candidate range (steps - 2 values) must outlast
+    # 3 * (len(kinds) - 1) blocked ones with one to spare
+    if steps < 3 * len(kinds):
+        raise ValueError(
+            f"schedule too dense: {len(kinds)} fault kinds need "
+            f"steps >= {3 * len(kinds)}, got {steps}")
+    # distinct steps, ≥2 apart, leaving step 0/1 for pipeline spin-up:
+    # two faults in the same round would make WHICH failure surfaces
+    # first racy, and determinism of the recovery sequence is the point
+    picks: List[int] = []
+    while len(picks) < len(kinds):
+        s = rng.randrange(2, steps)
+        if all(abs(s - p) >= 2 for p in picks):
+            picks.append(s)
+    rng.shuffle(kinds)
+    return sorted(
+        (ChaosEvent(s, k, rng.randrange(n_workers))
+         for s, k in zip(picks, kinds)),
+        key=lambda e: (e.step, e.kind))
+
+
+@dataclass
+class ChaosReport:
+    """What a chaos run produced — the bench-snapshot payload and the
+    determinism assertion's subject."""
+
+    seed: int
+    events: List[tuple] = field(default_factory=list)    # applied
+    recoveries: List[tuple] = field(default_factory=list)  # (cause, action)
+    mttr_s: List[float] = field(default_factory=list)
+    absorbed_retries: Dict[str, float] = field(default_factory=dict)
+    wall_s: float = 0.0
+
+    def summary(self) -> dict:
+        return {
+            "seed": self.seed,
+            "wall_s": self.wall_s,
+            "events": [list(e) for e in self.events],
+            "recoveries": [list(r) for r in self.recoveries],
+            "recovery_count": len(self.recoveries),
+            "mttr_mean_s": (sum(self.mttr_s) / len(self.mttr_s)
+                            if self.mttr_s else 0.0),
+            "mttr_max_s": max(self.mttr_s, default=0.0),
+            "absorbed_retries": dict(self.absorbed_retries),
+        }
+
+
+class ChaosRunner:
+    """Replay a schedule against a DistFrontend: inject each fault at
+    its step boundary, drive barriers, feed every failure to the
+    supervised-recovery path, then settle the pipeline to completion.
+    The caller owns the oracle comparison (and the frontend)."""
+
+    def __init__(self, fe, schedule: List[ChaosEvent], seed: int,
+                 steps: int = 24, settle_steps: int = 40):
+        self.fe = fe
+        self.schedule = list(schedule)
+        self.seed = seed
+        self.steps = steps
+        self.settle_steps = settle_steps
+        if any(e.kind == "straggler" for e in self.schedule):
+            assert fe.cluster.barrier_timeout_s is not None, (
+                "a straggler fault needs wedged-barrier detection: "
+                "construct the DistFrontend with barrier_timeout_s")
+
+    async def _arm(self, slot: int, points: dict) -> None:
+        await self.fe.cluster.clients[slot].call_idempotent(
+            {"cmd": "arm_failpoints", "points": points})
+
+    async def _apply(self, ev: ChaosEvent) -> None:
+        if ev.kind == "kill_worker":
+            self.fe.cluster.kill_slot(ev.slot)
+        elif ev.kind == "flake_object_store":
+            await self._arm(ev.slot, {"object_store.upload": {
+                "raise": "OSError", "msg": "chaos flake",
+                "times": _FLAKE_TIMES}})
+        elif ev.kind == "fail_upload":
+            await self._arm(ev.slot, {"object_store.upload": {
+                "raise": "OSError", "msg": "chaos upload fault",
+                "times": _FAULT_TIMES}})
+        elif ev.kind == "straggler":
+            timeout = self.fe.cluster.barrier_timeout_s
+            await self._arm(ev.slot, {"trace.slow.HashAggExecutor": {
+                "sleep_s": timeout * 2.5, "times": 1}})
+        else:
+            raise ValueError(f"unknown chaos event kind {ev.kind!r}")
+
+    async def _step_supervised(self, report: ChaosReport) -> None:
+        try:
+            await self.fe.step(1)
+            self.fe.cluster.supervisor.note_healthy()
+        except Exception as e:  # noqa: BLE001 — the supervisor's job
+            rec: RecoveryEvent = await self.fe.supervised_recover(e)
+            report.recoveries.append((rec.cause, rec.action))
+            report.mttr_s.append(rec.duration_s)
+
+    async def run(self) -> ChaosReport:
+        report = ChaosReport(self.seed)
+        by_step: Dict[int, List[ChaosEvent]] = {}
+        for ev in self.schedule:
+            by_step.setdefault(ev.step, []).append(ev)
+        for i in range(self.steps):
+            for ev in by_step.get(i, ()):
+                await self._apply(ev)
+                report.events.append(ev.row())
+            await self._step_supervised(report)
+        # settle: drain the sources to completion so the MV is final
+        # (recoveries rewind to the committed epoch — later faults cost
+        # re-processing, so the settle budget is generous)
+        for _ in range(self.settle_steps):
+            await self._step_supervised(report)
+        report.absorbed_retries = await worker_retry_totals(self.fe)
+        return report
+
+
+async def worker_retry_totals(fe) -> Dict[str, float]:
+    """Sum object_store_retry_total across live worker processes
+    (absorption happens inside workers; the coordinator's registry
+    never sees it)."""
+    totals: Dict[str, float] = {}
+    for c in fe.cluster.clients:
+        if c is None:
+            continue
+        text = (await c.call_idempotent({"cmd": "metrics"}))["text"]
+        for line in text.splitlines():
+            if line.startswith("object_store_retry_total{"):
+                name, val = line.rsplit(" ", 1)
+                totals[name] = totals.get(name, 0.0) + float(val)
+    return totals
+
+
+async def run_chaos(fe, seed: int, steps: int = 24,
+                    settle_steps: int = 40,
+                    kinds: Optional[List[str]] = None) -> ChaosReport:
+    """Generate + replay one seeded schedule (the bench entry point).
+    Wall-clock MTTR is recorded per recovery by the supervisor."""
+    schedule = generate_schedule(seed, n_workers=fe.cluster.n,
+                                 steps=steps, kinds=kinds)
+    t0 = time.monotonic()
+    report = await ChaosRunner(fe, schedule, seed, steps=steps,
+                               settle_steps=settle_steps).run()
+    report.wall_s = time.monotonic() - t0
+    return report

@@ -22,6 +22,18 @@ from typing import Optional
 from risingwave_tpu.stream.message import (
     Barrier, PauseMutation, ResumeMutation, StopMutation,
 )
+from risingwave_tpu.utils.metrics import CLUSTER as _METRICS
+
+# verbs safe to RE-SEND after a reconnect: each is a pure read or an
+# absolute-state write (recover_store/set_trace/arm_failpoints set a
+# target state, so applying twice equals applying once). inject /
+# deploy_plan / ingest_table / drain_trace are NOT here — replaying
+# them changes cluster state, and their failures belong to the
+# recovery supervisor, not a silent retry.
+_IDEMPOTENT_VERBS = frozenset({
+    "ping", "scan_table", "recover_store", "set_trace",
+    "arm_failpoints", "metrics", "reset",
+})
 
 
 class WorkerClient:
@@ -37,8 +49,49 @@ class WorkerClient:
         self._lock = asyncio.Lock()
 
     async def connect(self) -> None:
+        # 16MB line limit: control replies are one JSON line each, and
+        # scan_table/metrics payloads overflow asyncio's 64KB default
+        # (LimitOverrunError surfaces as an opaque ValueError)
         self._reader, self._writer = await asyncio.open_connection(
-            self.host, self.control_port)
+            self.host, self.control_port, limit=1 << 24)
+
+    async def call_idempotent(self, cmd: dict,
+                              io_timeout: Optional[float] = None,
+                              retries: int = 2,
+                              backoff_s: float = 0.05) -> dict:
+        """Transient-fault absorption for idempotent verbs: a torn or
+        timed-out channel reconnects and re-sends instead of staying
+        poisoned (the graduated-response ladder's RPC rung — a single
+        timeout must not cost a full-cluster recovery). Out-of-retries
+        errors surface to the caller/supervisor; each retry increments
+        ``rpc_retry_total{verb=...}``."""
+        verb = str(cmd.get("cmd"))
+        if verb not in _IDEMPOTENT_VERBS:
+            raise ValueError(
+                f"refusing to auto-retry non-idempotent verb {verb!r}")
+        delay = backoff_s
+        for attempt in range(retries + 1):
+            used = None
+            try:
+                # reconnect under the channel lock: two concurrent
+                # callers on one shared client must not double-connect
+                # (leaking a socket) — re-check after the await
+                async with self._lock:
+                    if self._writer is None:
+                        await self.connect()
+                    used = self._writer
+                return await self.call(cmd, io_timeout=io_timeout)
+            except (ConnectionError, OSError):
+                if attempt >= retries:
+                    raise
+                _METRICS.rpc_retry.inc(verb=verb)
+                # only tear down the channel WE failed on — a peer may
+                # have already reconnected it while we were failing
+                if self._writer is used:
+                    self.abort()
+                await asyncio.sleep(delay)
+                delay *= 2
+        raise AssertionError("unreachable")
 
     async def call(self, cmd: dict,
                    io_timeout: Optional[float] = None) -> dict:
@@ -82,8 +135,8 @@ class WorkerClient:
         """Pull one table's committed rows (value-codec decoded) from
         the worker's namespace — the distributed-SELECT data plane."""
         from risingwave_tpu.storage.value_codec import decode_row
-        reply = await self.call({"cmd": "scan_table",
-                                 "table_id": table_id, "epoch": epoch})
+        reply = await self.call_idempotent(
+            {"cmd": "scan_table", "table_id": table_id, "epoch": epoch})
         return [(bytes.fromhex(k), decode_row(bytes.fromhex(r)))
                 for k, r in reply["rows"]]
 
@@ -129,9 +182,15 @@ class WorkerClient:
                     barrier.epoch.curr.value)}
         return await self.call(cmd)
 
-    async def ping(self, io_timeout: float = 2.0) -> dict:
-        """Heartbeat probe (cluster.rs heartbeat RPC round trip)."""
-        return await self.call({"cmd": "ping"}, io_timeout=io_timeout)
+    async def ping(self, io_timeout: float = 2.0,
+                   retries: int = 1) -> dict:
+        """Heartbeat probe (cluster.rs heartbeat RPC round trip). One
+        timed-out or torn round trip reconnects and retries: a single
+        slow reply is a transient, not a death certificate — the lease
+        in ClusterManager is what decides expiry."""
+        return await self.call_idempotent({"cmd": "ping"},
+                                          io_timeout=io_timeout,
+                                          retries=retries)
 
     def abort(self) -> None:
         """Hard-close the channel. The JSON-lines protocol has no
@@ -160,11 +219,16 @@ class Heartbeater:
     heartbeat sender, combined at the meta side since the coordinator
     owns the control channel)."""
 
-    def __init__(self, cluster, interval_s: float = 1.0):
+    def __init__(self, cluster, interval_s: float = 1.0,
+                 on_expired=None):
         self.cluster = cluster
         self.interval = interval_s
         self._clients: dict = {}          # worker_id → WorkerClient
         self._task = None
+        # owner callback invoked with the evicted WorkerNode list —
+        # the supervisor's heartbeat-expiry detection input (tick used
+        # to compute the dead set and drop it on the floor)
+        self.on_expired = on_expired
 
     def register(self, worker_id: int, client: WorkerClient) -> None:
         self._clients[worker_id] = client
@@ -192,9 +256,12 @@ class Heartbeater:
                                for w, c in list(self._clients.items())))
         dead = self.cluster.expire_stale()
         for w in dead:
+            _METRICS.worker_expired.inc(worker=str(w.worker_id))
             client = self._clients.pop(w.worker_id, None)
             if client is not None:
                 client.abort()             # no leaked half-open socket
+        if dead and self.on_expired is not None:
+            self.on_expired(dead)
         return dead
 
     def start(self) -> None:
@@ -284,6 +351,11 @@ class WorkerHandle:
                                    ports["exchange_port"])
         await self.client.connect()
         return self.client
+
+    def alive(self) -> bool:
+        """Subprocess liveness (the supervisor's cheapest detection
+        input): started, not yet reaped, and not exited."""
+        return self.proc is not None and self.proc.poll() is None
 
     def kill(self) -> None:
         """SIGKILL — the chaos path (no goodbye, no flush)."""

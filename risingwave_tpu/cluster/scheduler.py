@@ -18,14 +18,19 @@ from __future__ import annotations
 
 import asyncio
 import os
+import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set
 
 from risingwave_tpu.cluster.coordinator import (
-    WorkerBarrierSender, WorkerClient, WorkerHandle,
+    Heartbeater, WorkerBarrierSender, WorkerClient, WorkerHandle,
 )
 from risingwave_tpu.frontend.fragmenter import Fragment, FragmentGraph
 from risingwave_tpu.meta.barrier import BarrierLoop
+from risingwave_tpu.meta.supervisor import (
+    ACTION_RESPAWN, RecoveryEvent, RecoverySupervisor,
+    trace_recovery_phase, trace_recovery_root,
+)
 from risingwave_tpu.stream.actor import LocalBarrierManager
 from risingwave_tpu.stream.message import StopMutation
 from risingwave_tpu.stream.plan_ir import remap_node_refs
@@ -69,7 +74,9 @@ class Cluster:
     """Coordinator-side handle on N worker processes."""
 
     def __init__(self, root: str, n_workers: int = 2,
-                 platform: str = "cpu"):
+                 platform: str = "cpu",
+                 barrier_timeout_s: Optional[float] = None,
+                 supervisor: Optional[RecoverySupervisor] = None):
         self.root = root
         self.n = n_workers
         self.platform = platform
@@ -81,6 +88,17 @@ class Cluster:
         self.store = _CoordEpochStore()
         self._next_actor = 1000
         self._rr = 0                      # placement cursor
+        # supervised recovery (meta/supervisor.py): classification +
+        # storm gate; barrier_timeout_s arms wedged-barrier detection
+        self.supervisor = supervisor or RecoverySupervisor()
+        self.barrier_timeout_s = barrier_timeout_s
+        # heartbeat-expiry detection (enable_liveness): lease-expired
+        # slots feed the supervisor's dead set even while their
+        # subprocess is technically alive (wedged, not exited)
+        self._manager = None
+        self._heartbeater: Optional[Heartbeater] = None
+        self._expired_slots: Set[int] = set()
+        self._wid_slot: Dict[int, int] = {}
 
     # -- lifecycle --------------------------------------------------------
     async def start(self) -> None:
@@ -98,7 +116,8 @@ class Cluster:
         """(Re)build the barrier fan-out: one pseudo-actor per worker
         slot; the commit decision pipelines via committed_fn."""
         self.local = LocalBarrierManager()
-        self.loop = BarrierLoop(self.local, self.store)
+        self.loop = BarrierLoop(self.local, self.store,
+                                collect_timeout_s=self.barrier_timeout_s)
         for k in range(self.n):
             pid = _PSEUDO_BASE + k
             self.local.register_sender(
@@ -126,9 +145,55 @@ class Cluster:
                 await h.stop()
 
     def kill_slot(self, k: int) -> None:
-        """SIGKILL one worker (chaos path: no goodbye, no flush)."""
-        if self.handles[k] is not None:
-            self.handles[k].kill()
+        """SIGKILL one worker (chaos path: no goodbye, no flush).
+        Deliberately does NOT reap: the corpse stays visible to
+        dead_slots() until a recovery handles it, like a real crash."""
+        if self.handles[k] is not None and self.handles[k].proc \
+                is not None:
+            self.handles[k].proc.kill()
+
+    # -- failure detection ------------------------------------------------
+    def dead_slots(self) -> List[int]:
+        """The supervisor's dead set: slots whose subprocess exited
+        (poll) plus slots whose heartbeat lease expired (alive but
+        wedged — enable_liveness feeds these)."""
+        out = {k for k, h in enumerate(self.handles)
+               if h is None or not h.alive()}
+        out |= self._expired_slots
+        return sorted(out)
+
+    def enable_liveness(self, max_interval_s: float = 5.0) -> None:
+        """Heartbeat-expiry detection: register every slot in a
+        ClusterManager and ping through a Heartbeater whose ticks the
+        serving loop drives explicitly (no background task — ticks are
+        deterministic under test drivers). Expired leases land in the
+        supervisor's dead set via ``dead_slots()``. Re-invoked after
+        every recovery (clients change)."""
+        from risingwave_tpu.meta.cluster import ClusterManager
+
+        self._manager = ClusterManager(
+            max_heartbeat_interval_s=max_interval_s)
+        self._wid_slot = {}
+        self._heartbeater = Heartbeater(
+            self._manager, on_expired=self._note_expired)
+        for k, c in enumerate(self.clients):
+            if c is None:
+                continue
+            w = self._manager.add_worker("127.0.0.1", c.control_port)
+            self._wid_slot[w.worker_id] = k
+            self._heartbeater.register(w.worker_id, c)
+
+    def _note_expired(self, dead_nodes) -> None:
+        for w in dead_nodes:
+            slot = self._wid_slot.get(w.worker_id)
+            if slot is not None:
+                self._expired_slots.add(slot)
+
+    async def liveness_tick(self) -> list:
+        """One heartbeat round (serving loops call this per beat)."""
+        if self._heartbeater is None:
+            return []
+        return await self._heartbeater.tick()
 
     # -- scheduling (schedule.rs analog) ----------------------------------
     def _place(self, graph: FragmentGraph) -> List[List[tuple]]:
@@ -313,6 +378,98 @@ class Cluster:
         self._fresh_barrier_plane()
         for job in self.jobs.values():
             await self._deploy_job(job)
+        if self._heartbeater is not None:
+            self.enable_liveness(self._manager.max_interval)
+
+    async def _respawn_slot(self, k: int) -> None:
+        """Restart one DEAD slot's subprocess over its namespace."""
+        if self.handles[k] is not None:
+            self.handles[k].kill()       # reap the corpse (idempotent)
+        await self._start_slot(k)
+
+    async def _reset_slot(self, k: int) -> None:
+        """Rejoin one LIVE slot in place: fresh control connection
+        (the old one may be desynced or holding a wedged RPC), then
+        the worker drops its actors and exchange edges while keeping
+        the process — and its warm jit caches — alive."""
+        old = self.clients[k]
+        c = WorkerClient(old.host, old.control_port,
+                         old.exchange_port)
+        await c.connect()
+        old.abort()
+        self.clients[k] = c
+        if self.handles[k] is not None:
+            self.handles[k].client = c
+        # bounded: a worker wedged in a blocking call would otherwise
+        # hang the recovery itself — past the bound the reset fails,
+        # the event records ok=False, and the next round classifies
+        # the still-broken state (ending in the storm gate if it
+        # never heals)
+        await c.call_idempotent({"cmd": "reset"}, io_timeout=20.0,
+                                retries=1)
+
+    async def respawn_recover(self, dead: List[int]) -> None:
+        """Rung-2 recovery: restart ONLY the dead slots' processes;
+        live slots reset in place. Everyone rejoins through the same
+        ``recover_store`` handshake at the coordinator's committed
+        floor, the barrier plane rebuilds, and every job redeploys —
+        all actors were dropped everywhere, because a fragment's
+        exchange peers span slots and actor state cannot survive
+        partially. With ``dead == []`` (a desynced control channel)
+        this degrades to reset-everything-in-place: zero process
+        restarts."""
+        floor = self.store.committed_epoch()
+        dead_set = set(dead)
+        await asyncio.gather(*(
+            self._respawn_slot(k) if k in dead_set
+            else self._reset_slot(k)
+            for k in range(self.n)))
+        await asyncio.gather(*(
+            self.clients[k].call_idempotent(
+                {"cmd": "recover_store", "epoch": floor},
+                io_timeout=20.0)
+            for k in range(self.n)))
+        self._fresh_barrier_plane()
+        for job in self.jobs.values():
+            await self._deploy_job(job)
+        if self._heartbeater is not None:
+            self.enable_liveness(self._manager.max_interval)
+
+    async def supervised_recover(self, exc: BaseException
+                                 ) -> RecoveryEvent:
+        """One supervised recovery round: detect (dead subprocesses +
+        expired leases) → classify → admit through the storm gate →
+        graduated response → record (rw_recovery row, recovery_total/
+        recovery_duration_seconds, recovery.* span chain). Raises
+        RecoveryStormError past the consecutive budget; a recovery
+        that itself fails records ok=False and re-raises — the next
+        beat classifies the new failure."""
+        dead = self.dead_slots()
+        self._expired_slots.clear()          # consumed into this round
+        cause = self.supervisor.classify(exc, dead_workers=dead)
+        action = self.supervisor.action_for(cause)
+        attempt = await self.supervisor.admit(cause)
+        floor = self.store.committed_epoch()
+        workers = tuple(dead) if (action == ACTION_RESPAWN and dead) \
+            else tuple(range(self.n))
+        root = trace_recovery_root(cause, action, floor, attempt)
+        t0_wall, t0 = time.time(), time.monotonic()
+        ok = False
+        try:
+            if action == ACTION_RESPAWN:
+                await self.respawn_recover(dead)
+            else:
+                await self.recover()
+            ok = True
+        finally:
+            dur = time.monotonic() - t0
+            trace_recovery_phase(
+                action, floor, root, t0_wall, dur,
+                workers=",".join(str(w) for w in workers))
+            ev = self.supervisor.record(
+                cause, action, workers, floor, dur, ok, attempt,
+                detail=repr(exc)[:200])
+        return ev
 
     # -- reschedule (scale.rs:717 + rebalance_actor_vnode :174) -----------
     # ops whose state is either vnode-partitioned by the exchange keys

@@ -20,6 +20,7 @@ from typing import Dict, List, Optional, Union
 
 from risingwave_tpu.cluster.scheduler import Cluster
 from risingwave_tpu.frontend import ast
+from risingwave_tpu.meta.supervisor import RecoveryStormError
 from risingwave_tpu.frontend.catalog import Catalog, MvCatalog
 from risingwave_tpu.frontend.fragmenter import Fragmenter
 from risingwave_tpu.frontend.planner import (
@@ -68,8 +69,10 @@ class DistFrontend:
     def __init__(self, root: str, n_workers: int = 2,
                  parallelism: Optional[int] = None,
                  rate_limit: Optional[int] = 8,
-                 min_chunks: Optional[int] = None):
-        self.cluster = Cluster(root, n_workers)
+                 min_chunks: Optional[int] = None,
+                 barrier_timeout_s: Optional[float] = None):
+        self.cluster = Cluster(root, n_workers,
+                               barrier_timeout_s=barrier_timeout_s)
         self.catalog = Catalog()
         self.parallelism = parallelism or n_workers
         self.rate_limit = rate_limit
@@ -161,18 +164,58 @@ class DistFrontend:
         async with self._barrier_lock:
             await self.cluster.recover()
 
+    async def supervised_recover(self, exc: BaseException):
+        """Classify `exc` and run the graduated recovery ladder (the
+        chaos harness and external drivers share the serving loop's
+        path); returns the recorded RecoveryEvent."""
+        async with self._barrier_lock:
+            return await self.cluster.supervised_recover(exc)
+
     async def run_heartbeat(self, interval_s: float = 0.25) -> None:
-        """Background barrier heartbeat for server deployments — on
-        failure it recovers the cluster once, then re-raises if the
-        recovery barrier fails too (crash over serving stale MVs)."""
-        while True:
-            await asyncio.sleep(interval_s)
-            async with self._barrier_lock:
-                try:
-                    await self.cluster.step(1)
-                except Exception:
-                    await self.cluster.recover()
-                    await self.cluster.step(1)
+        """Supervised serving loop (server deployments): each beat
+        steps one barrier and ticks worker liveness; a failed round
+        feeds the RecoverySupervisor — classify, then the cheapest
+        graduated response (absorb / respawn dead slots in place /
+        full kill-and-redeploy), with bounded attempts and jittered
+        backoff between consecutive recoveries. The only way out is a
+        RecoveryStormError: the recovery budget exhausted without a
+        healthy round — loud and terminal, never a silent loop and
+        never the old recover-once-then-die."""
+        import sys
+        import traceback
+        self.cluster.enable_liveness()
+        try:
+            while True:
+                await asyncio.sleep(interval_s)
+                async with self._barrier_lock:
+                    try:
+                        await self.cluster.step(1)
+                        self.cluster.supervisor.note_healthy()
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:  # noqa: BLE001 — classified
+                        try:
+                            await self.cluster.supervised_recover(e)
+                        except asyncio.CancelledError:
+                            raise
+                        except RecoveryStormError:
+                            raise
+                        except Exception as rexc:  # noqa: BLE001
+                            # a recovery that itself failed is already
+                            # recorded (ok=False); the next beat
+                            # reclassifies the still-broken state —
+                            # the storm gate bounds this loop, not
+                            # first-failure death
+                            print("recovery attempt failed "
+                                  f"(will reclassify): {rexc!r}",
+                                  file=sys.stderr)
+                await self.cluster.liveness_tick()
+        except asyncio.CancelledError:
+            raise
+        except BaseException:
+            print("serving heartbeat terminated:", file=sys.stderr)
+            traceback.print_exc()
+            raise
 
     # -- statements -------------------------------------------------------
     async def execute(self, sql: str) -> Union[Rows, str]:

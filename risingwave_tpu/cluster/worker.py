@@ -58,8 +58,11 @@ class WorkerServer:
 
     async def serve(self, host: str = "127.0.0.1") -> dict:
         await self.exchange.serve(host, 0)
+        # 16MB line limit, matching WorkerClient.connect: one JSON
+        # line per command, and shipped plans/ingest batches overflow
+        # asyncio's 64KB default
         self._control = await asyncio.start_server(
-            self._handle_control, host, 0)
+            self._handle_control, host, 0, limit=1 << 24)
         return {"control_port":
                 self._control.sockets[0].getsockname()[1],
                 "exchange_port": self.exchange.port}
@@ -91,6 +94,11 @@ class WorkerServer:
 
     async def _dispatch(self, cmd: dict) -> dict:
         verb = cmd.get("cmd")
+        # chaos seam: delay (sleep spec) or fail (raise spec) one
+        # control RPC by verb — how the harness injects an RPC timeout
+        # without killing the worker
+        from risingwave_tpu.utils.failpoint import fail_point
+        fail_point(f"worker.rpc.{verb}")
         if verb == "deploy_plan":
             return await self._deploy_plan(cmd)
         if verb == "inject":
@@ -110,6 +118,21 @@ class WorkerServer:
                 self.store.commit_through(epoch)
             return {"ok": True, "dropped": dropped,
                     "committed": self.store.committed_epoch()}
+        if verb == "reset":
+            return await self._reset()
+        if verb == "arm_failpoints":
+            # live chaos injection: arm/disarm JSON-able dict specs in
+            # THIS process (the env path only covers boot time)
+            from risingwave_tpu.utils.failpoint import arm_specs
+            return {"ok": True,
+                    "armed": arm_specs(cmd.get("points") or {})}
+        if verb == "metrics":
+            # this process's Prometheus exposition — how tests and
+            # tooling observe worker-side absorption counters
+            # (object_store_retry_total lives here, not on the
+            # coordinator)
+            from risingwave_tpu.utils.metrics import GLOBAL
+            return {"ok": True, "text": GLOBAL.render()}
         if verb == "set_trace":
             from risingwave_tpu.utils import spans as _spans
             _spans.set_enabled(bool(cmd.get("on", True)))
@@ -126,6 +149,33 @@ class WorkerServer:
         if verb == "stop":
             return {"ok": True}
         return {"ok": False, "error": f"unknown cmd {verb!r}"}
+
+    async def _reset(self) -> dict:
+        """Supervised-recovery rung 2 for a LIVE worker: drop every
+        actor in place (no stop barriers — the barrier plane is the
+        thing that failed), release the exchange plane, and present a
+        fresh LocalBarrierManager. The process — and its warm jit
+        caches — survives, which is exactly what makes respawn cheaper
+        than full recovery. Staged store state is NOT touched here:
+        the coordinator's ``recover_store`` handshake that follows is
+        the single source of truth for what rolls back."""
+        n = len(self.actors)
+        for t in self.tasks.values():
+            t.cancel()
+        if self.tasks:
+            await asyncio.gather(*self.tasks.values(),
+                                 return_exceptions=True)
+        self.actors.clear()
+        self.tasks.clear()
+        old = self.local
+        self.local = LocalBarrierManager()
+        # wake any control handler stuck awaiting an epoch on the old
+        # plane (e.g. a wedged inject on a torn connection): resolving
+        # its await with the failure beats leaking the coroutine
+        old.notify_failure(-1, RuntimeError(
+            "worker reset (supervised recovery)"))
+        self.exchange.reset_edges()
+        return {"ok": True, "dropped_actors": n}
 
     # -- exchange fan-out -------------------------------------------------
     def _make_dispatchers(self, actor_id: int, outputs: List[int],
@@ -387,11 +437,17 @@ def main(argv=None) -> None:
     args = ap.parse_args(argv)
 
     from risingwave_tpu.storage.hummock import HummockLite
-    from risingwave_tpu.storage.object_store import LocalFsObjectStore
+    from risingwave_tpu.storage.object_store import (
+        LocalFsObjectStore, RetryingObjectStore,
+    )
 
     async def amain():
-        store = HummockLite(LocalFsObjectStore(args.store),
-                            two_phase=True)
+        # transient-fault absorption at the bottom rung: a flaky
+        # PUT/GET retries with jittered backoff inside the worker
+        # before any error can fail a barrier round
+        store = HummockLite(
+            RetryingObjectStore(LocalFsObjectStore(args.store)),
+            two_phase=True)
         w = WorkerServer(store)
         ports = await w.serve()
         print(json.dumps(ports), flush=True)

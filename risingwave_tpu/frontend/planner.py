@@ -1299,6 +1299,22 @@ def _system_catalog_rows(name: str, catalog: Catalog, profiler=None):
                       Field("retained", DataType.INT64),
                       Field("detail", DataType.VARCHAR)])
         return sch, EPOCH_TRACER.rows()
+    if n == "rw_recovery":
+        # supervised-recovery event log (meta/supervisor.py): one row
+        # per recovery with its classified cause, graduated action,
+        # touched worker slots, recovered-to epoch and MTTR sample.
+        # Joins rw_epoch_trace on epoch for the recovery.* span chain.
+        from risingwave_tpu.meta.supervisor import recovery_rows
+        sch = Schema([Field("seq", DataType.INT64),
+                      Field("cause", DataType.VARCHAR),
+                      Field("action", DataType.VARCHAR),
+                      Field("workers", DataType.VARCHAR),
+                      Field("epoch", DataType.INT64),
+                      Field("duration_s", DataType.FLOAT64),
+                      Field("ok", DataType.INT64),
+                      Field("attempt", DataType.INT64),
+                      Field("detail", DataType.VARCHAR)])
+        return sch, recovery_rows()
     if n == "rw_plan_rewrites":
         # plan-rewrite firing log (frontend/opt engine): one row per
         # (job, rule) application, FALLBACK rows record checker trips

@@ -33,6 +33,14 @@ from risingwave_tpu.utils.metrics import STREAMING, exact_quantile
 from risingwave_tpu.utils.trace import GLOBAL_AWAITS
 
 
+class BarrierWedgedError(RuntimeError):
+    """Barrier collection exceeded the configured collect timeout —
+    the wedged-barrier failure class: some participant holds the epoch
+    open (a stuck executor, a starved exchange edge) without dying.
+    The recovery supervisor classifies this as unrecoverable in place
+    and escalates to full recovery."""
+
+
 @dataclass
 class BarrierStats:
     """Collected per-epoch latencies (meta barrier_latency metric analog)."""
@@ -261,7 +269,8 @@ class BarrierLoop:
                  monotonic: Callable[[], float] = time.monotonic,
                  sleep=asyncio.sleep,
                  slow_barrier_threshold_s: float = 1.0,
-                 max_uploading: int = 4):
+                 max_uploading: int = 4,
+                 collect_timeout_s: Optional[float] = None):
         self.local = local
         self.store = store
         self.interval_ms = interval_ms
@@ -269,6 +278,11 @@ class BarrierLoop:
         self.in_flight_barrier_nums = max(1, in_flight_barrier_nums)
         self.monotonic = monotonic
         self.sleep = sleep
+        # None: wait forever (the historical behavior — tests that
+        # step explicitly own their own timeouts). Set: a barrier that
+        # fails to collect within the bound raises BarrierWedgedError
+        # instead of wedging the whole control loop silently.
+        self.collect_timeout_s = collect_timeout_s
         self.stats = BarrierStats()
         self.profiler = EpochProfiler(slow_barrier_threshold_s)
         self._epoch: Optional[Epoch] = None
@@ -390,19 +404,32 @@ class BarrierLoop:
         waiter = asyncio.ensure_future(
             self.local.await_epoch_complete(epoch))
         failer = asyncio.ensure_future(self.uploader.failed.wait())
+        timer = (asyncio.ensure_future(
+            self.sleep(self.collect_timeout_s))
+            if self.collect_timeout_s is not None else None)
+        waits = {waiter, failer} | ({timer} if timer else set())
         try:
             done, _ = await asyncio.wait(
-                {waiter, failer}, return_when=asyncio.FIRST_COMPLETED)
+                waits, return_when=asyncio.FIRST_COMPLETED)
         except asyncio.CancelledError:
             waiter.cancel()
             raise
         finally:
             failer.cancel()
+            if timer is not None:
+                timer.cancel()
         if waiter in done:
             return waiter.result()
         waiter.cancel()
         with contextlib.suppress(asyncio.CancelledError):
             await waiter
+        if timer is not None and timer in done:
+            # wedged-barrier detection: the epoch is still collectible
+            # by a retry (await_epoch_complete is cancellation-safe),
+            # but the supervisor treats the wedge as terminal in place
+            raise BarrierWedgedError(
+                f"barrier collect for epoch {epoch:#x} exceeded "
+                f"{self.collect_timeout_s}s — wedged barrier")
         self.uploader.raise_if_failed()
         raise RuntimeError("uploader failure event without a failure")
 

@@ -15,7 +15,7 @@ import contextlib
 import os
 import tempfile
 import time
-from typing import Dict, List, Protocol
+from typing import Dict, List, Optional, Protocol
 
 from risingwave_tpu.utils.failpoint import fail_point
 from risingwave_tpu.utils.metrics import STORAGE as _METRICS
@@ -187,6 +187,64 @@ class DelayedObjectStore:
         if path.startswith(self.prefix):
             time.sleep(self.delay_s)
         self.inner.upload(path, data)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+class RetryingObjectStore:
+    """Transient-fault absorption for any ObjectStore: ``upload`` /
+    ``read`` / ``read_range`` retry with jittered exponential backoff
+    before the error surfaces (the graduated-response ladder's bottom
+    rung — a flaky PUT/GET never reaches the recovery supervisor).
+
+    Transient means OSError/IOError that is NOT a missing object:
+    ``FileNotFoundError`` (and path-escape ``ValueError``) surface
+    immediately — a 404 retried is a correctness bug hidden, not a
+    fault absorbed. Jitter draws from a PRNG seeded per PROCESS by
+    default (pid): N workers hitting one flaky endpoint must draw
+    DIFFERENT jitter or the anti-stampede spread is a no-op; pass an
+    explicit seed for fully reproducible timing. Each retry increments
+    ``object_store_retry_total{op=...}``.
+    """
+
+    def __init__(self, inner: ObjectStore, retries: int = 3,
+                 backoff_s: float = 0.02, backoff_cap_s: float = 1.0,
+                 seed: Optional[int] = None) -> None:
+        import random
+        self.inner = inner
+        self.retries = max(0, retries)
+        self.backoff_s = backoff_s
+        self.backoff_cap_s = backoff_cap_s
+        self._rng = random.Random(os.getpid() if seed is None
+                                  else seed)
+
+    def _retry(self, op: str, fn, *args):
+        delay = self.backoff_s
+        for attempt in range(self.retries + 1):
+            try:
+                return fn(*args)
+            except FileNotFoundError:
+                raise                      # missing ≠ transient
+            except (OSError, IOError):
+                if attempt >= self.retries:
+                    raise
+                _METRICS.object_store_retries.inc(op=op)
+                # full jitter: uniform in (0.5, 1.5)× the backoff —
+                # concurrent retriers (N upload threads against one
+                # flaky endpoint) must not stampede in lockstep
+                time.sleep(delay * (0.5 + self._rng.random()))
+                delay = min(delay * 2, self.backoff_cap_s)
+
+    def upload(self, path: str, data: bytes) -> None:
+        return self._retry("upload", self.inner.upload, path, data)
+
+    def read(self, path: str) -> bytes:
+        return self._retry("read", self.inner.read, path)
+
+    def read_range(self, path: str, off: int, length: int) -> bytes:
+        return self._retry("read_range", self.inner.read_range,
+                           path, off, length)
 
     def __getattr__(self, name):
         return getattr(self.inner, name)

@@ -202,6 +202,18 @@ class ExchangeServer:
             self._server.close()
             await self._server.wait_closed()
 
+    def reset_edges(self) -> None:
+        """Release every registered edge without closing the server
+        (the worker `reset` verb): connected peers get the clean-end
+        sentinel, the registries clear, and redeployed actors register
+        fresh edges on the SAME port — remote peers reconnect to the
+        address they already know."""
+        for q in self._edges.values():
+            q.put_nowait(None)
+        self._edges.clear()
+        self._credits.clear()
+        self._outputs.clear()
+
     def register_edge(self, up: int, down: int) -> "RemoteOutputQueue":
         key = (up, down)
         q: asyncio.Queue = asyncio.Queue()

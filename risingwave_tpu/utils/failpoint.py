@@ -14,12 +14,22 @@ Probabilistic points draw from a seeded Generator, so a chaos run is
 DETERMINISTIC for a given seed — the madsim stance (SURVEY §4): faults
 are reproducible, not racy.
 
-Delay actions (the fail crate's `sleep` analog): a spec of
-``{"sleep_s": 0.2}`` makes the point SLEEP instead of raise — how
-trace/latency tests inject a deterministic straggler. Subprocesses
-(cluster workers) arm points from the ``RW_TPU_FAILPOINTS`` env var
-(JSON name → sleep spec) at boot via ``arm_from_env()``; only sleep
-specs are env-armable — exceptions don't round-trip through JSON.
+Dict specs are the JSON-able subset — the forms that cross a process
+boundary (worker subprocesses arm them from the ``RW_TPU_FAILPOINTS``
+env var at boot via ``arm_from_env()``, or live over the worker
+control channel's ``arm_failpoints`` verb):
+
+- ``{"sleep_s": 0.2}`` — the fail crate's `sleep` analog: the point
+  SLEEPS instead of raising (how trace/latency tests inject a
+  deterministic straggler).
+- ``{"raise": "OSError", "msg": "disk gone"}`` — raise a BUILTIN
+  exception by name (crash injection inside worker processes). Only
+  builtin exception *names* round-trip through JSON — arbitrary
+  exception objects deliberately do not.
+- either form takes ``"times": N`` — the point fires N times then
+  goes inert (a transient fault that heals, the chaos harness's
+  bread and butter: N ≤ the retry budget is absorbed in place,
+  N past it escalates).
 """
 
 from __future__ import annotations
@@ -36,6 +46,19 @@ _RNG: Optional[np.random.Generator] = None
 FIRED: Dict[str, int] = {}
 
 
+def _resolve_exc(name: str) -> type:
+    """Builtin exception class by name (the JSON round-trip
+    restriction: {"raise": "OSError"} crosses the subprocess boundary,
+    a pickled exception object would not)."""
+    import builtins
+    exc = getattr(builtins, str(name), None)
+    if not (isinstance(exc, type) and issubclass(exc, BaseException)):
+        raise ValueError(
+            f"failpoint exception {name!r} must name a builtin "
+            "exception class (only names round-trip through JSON)")
+    return exc
+
+
 def fail_point(name: str) -> None:
     """Raise if `name` is armed (call this at the injection site)."""
     if not _ARMED:
@@ -44,9 +67,17 @@ def fail_point(name: str) -> None:
     if spec is None:
         return
     if isinstance(spec, dict):
+        left = spec.get("_left")
+        if left is not None:
+            if left <= 0:
+                return               # fired out: the fault has healed
+            spec["_left"] = left - 1
         FIRED[name] = FIRED.get(name, 0) + 1
-        time.sleep(float(spec["sleep_s"]))
-        return
+        if "sleep_s" in spec:
+            time.sleep(float(spec["sleep_s"]))
+            return
+        raise _resolve_exc(spec["raise"])(
+            spec.get("msg", f"failpoint {name}"))
     if isinstance(spec, tuple):
         prob, exc = spec
         if _RNG is None or _RNG.random() >= prob:
@@ -61,8 +92,33 @@ def fail_point(name: str) -> None:
     raise exc()
 
 
+def arm_specs(points: Dict[str, Optional[dict]]) -> int:
+    """Arm (or, with a None value, disarm) JSON-able dict specs —
+    shared by the env boot path and the worker control channel's
+    ``arm_failpoints`` verb. Validates eagerly: a bad spec must fail
+    the arming call, not the injection site. Returns points touched."""
+    for name, spec in points.items():
+        if spec is None:
+            _ARMED.pop(name, None)
+            continue
+        if not isinstance(spec, dict) or \
+                not ({"sleep_s", "raise"} & spec.keys()):
+            raise ValueError(
+                f"failpoint {name!r} must be a sleep or raise spec "
+                f"(JSON-able dict), got {spec!r}")
+        armed = dict(spec)
+        if "sleep_s" in armed:
+            armed["sleep_s"] = float(armed["sleep_s"])
+        else:
+            _resolve_exc(armed["raise"])
+        if "times" in armed:
+            armed["_left"] = int(armed["times"])
+        _ARMED[name] = armed
+    return len(points)
+
+
 def arm_from_env() -> int:
-    """Arm sleep-spec failpoints from RW_TPU_FAILPOINTS (subprocess
+    """Arm dict-spec failpoints from RW_TPU_FAILPOINTS (subprocess
     boot path — worker processes can't enter a parent's context
     manager). Returns the number of points armed."""
     import json
@@ -70,18 +126,12 @@ def arm_from_env() -> int:
     raw = os.environ.get("RW_TPU_FAILPOINTS")
     if not raw:
         return 0
-    points = json.loads(raw)
-    for name, spec in points.items():
-        if not (isinstance(spec, dict) and "sleep_s" in spec):
-            raise ValueError(
-                f"env failpoint {name!r} must be a sleep spec, "
-                f"got {spec!r}")
-        _ARMED[name] = {"sleep_s": float(spec["sleep_s"])}
-    return len(points)
+    return arm_specs(json.loads(raw))
 
 
 @contextlib.contextmanager
-def failpoints(points: Dict[str, Union[BaseException, type, tuple]],
+def failpoints(points: Dict[str, Union[BaseException, type, tuple,
+                                       dict]],
                seed: int = 0):
     """Arm failpoints for the with-block (exclusive: no nesting)."""
     global _RNG, _ACTIVE
@@ -90,9 +140,18 @@ def failpoints(points: Dict[str, Union[BaseException, type, tuple]],
     # build everything fallible BEFORE mutating globals: a failed
     # setup must not leave points permanently armed
     rng = np.random.default_rng(seed)
+    prepared = {}
+    for name, spec in points.items():
+        if isinstance(spec, dict):
+            armed = dict(spec)
+            if "times" in armed:
+                armed["_left"] = int(armed["times"])
+            prepared[name] = armed
+        else:
+            prepared[name] = spec
     _ACTIVE = True
     try:
-        _ARMED.update(points)
+        _ARMED.update(prepared)
         _RNG = rng
         FIRED.clear()
         yield FIRED

@@ -386,6 +386,31 @@ class StreamingMetrics:
             "checkpoint epochs sealed but not yet durably committed")
 
 
+class ClusterMetrics:
+    """Cluster control-plane metric family (meta recovery +
+    heartbeat/RPC liveness — the supervisor's evidence trail)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        r = registry or GLOBAL
+        self.recovery_total = r.counter(
+            "recovery_total",
+            "cluster recoveries by cause and action (respawn = dead "
+            "slots restarted in place, full = kill-and-redeploy); "
+            "absorbed transient faults do NOT count here")
+        self.recovery_duration = r.histogram(
+            "recovery_duration_seconds",
+            "failure-detected → cluster-recovered time per recovery "
+            "(MTTR samples)")
+        self.rpc_retry = r.counter(
+            "rpc_retry_total",
+            "idempotent worker-control RPCs retried after a "
+            "reconnect (transient faults absorbed below the "
+            "supervisor), by verb")
+        self.worker_expired = r.counter(
+            "cluster_worker_expired_total",
+            "workers evicted by heartbeat lease expiry, by worker id")
+
+
 class StorageMetrics:
     """Storage-tier metric family (state_store/object_store analog)."""
 
@@ -406,6 +431,10 @@ class StorageMetrics:
         self.sst_upload_retries = r.counter(
             "state_store_sst_upload_retry_count",
             "checkpoint SST uploads retried after a transient failure")
+        self.object_store_retries = r.counter(
+            "object_store_retry_total",
+            "object-store ops retried after a transient fault "
+            "(RetryingObjectStore jittered-backoff absorption), by op")
         self.object_store_ops = r.counter(
             "object_store_operation_count",
             "object-store operations by op (upload/read/read_range)")
@@ -416,3 +445,4 @@ class StorageMetrics:
 
 STREAMING = StreamingMetrics()
 STORAGE = StorageMetrics()
+CLUSTER = ClusterMetrics()

@@ -80,6 +80,57 @@ def _strict_memory_accounting():
         f"eviction failed to bound it")
 
 
+def _worker_children() -> list:
+    """PIDs of live `risingwave_tpu.cluster.worker` subprocesses whose
+    parent is this test process. Zombies (state Z) don't count — a
+    corpse holds no ports; what this hunts is the LIVE leak that
+    shadows a later test's exchange/control ports."""
+    import os
+    me = os.getpid()
+    out = []
+    if not os.path.isdir("/proc"):          # non-Linux: guard is off
+        return out
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                tail = f.read().rsplit(")", 1)[1].split()
+            state, ppid = tail[0], int(tail[1])
+            if ppid != me or state == "Z":
+                continue
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ")
+            if b"risingwave_tpu.cluster.worker" in cmd:
+                out.append(int(pid))
+        except (OSError, ValueError, IndexError):
+            continue
+    return out
+
+
+@pytest.fixture(autouse=True)
+def _no_orphan_workers():
+    """Tier-1 guard (ISSUE 8): a test that leaves worker subprocesses
+    running fails loudly — a leaked `WorkerHandle` child keeps serving
+    its old exchange/control ports and can shadow a later cluster
+    test's connections with stale state. The guard also SIGKILLs the
+    orphans so one broken test doesn't cascade."""
+    import os
+    import signal
+    yield
+    orphans = _worker_children()
+    if orphans:
+        for pid in orphans:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+        pytest.fail(
+            f"test leaked live worker subprocess(es) {orphans} — "
+            "every WorkerHandle/Cluster must be stopped (they were "
+            "killed now to protect the rest of the suite)")
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     devs = jax.devices()

@@ -44,6 +44,42 @@ def test_failpoint_registry_semantics():
     assert 5 < run(7) < 45
 
 
+def test_exception_specs_and_times(monkeypatch):
+    """ISSUE 8 satellite: dict specs now cover crash injection too —
+    {"raise": <builtin name>} raises by name (the only exception form
+    that round-trips through JSON across the subprocess boundary) and
+    {"times": N} makes any dict spec a healing transient."""
+    from risingwave_tpu.utils.failpoint import arm_from_env, arm_specs
+
+    # raise spec through the context manager, with healing
+    with failpoints({"x": {"raise": "OSError", "msg": "disk gone",
+                           "times": 2}}) as fired:
+        for _ in range(2):
+            with pytest.raises(OSError, match="disk gone"):
+                fail_point("x")
+        fail_point("x")                    # healed: inert
+        assert fired == {"x": 2}
+
+    # the env boot path (worker subprocesses) takes the same specs
+    monkeypatch.setenv(
+        "RW_TPU_FAILPOINTS",
+        '{"e1": {"raise": "ValueError"}, "e2": {"sleep_s": 0}}')
+    assert arm_from_env() == 2
+    try:
+        with pytest.raises(ValueError):
+            fail_point("e1")
+        fail_point("e2")                   # sleep spec still works
+    finally:
+        arm_specs({"e1": None, "e2": None})   # disarm form
+    fail_point("e1")
+
+    # validation is eager — at arm time, not at the injection site
+    with pytest.raises(ValueError, match="builtin"):
+        arm_specs({"bad": {"raise": "NotARealException"}})
+    with pytest.raises(ValueError, match="sleep or raise"):
+        arm_specs({"bad": {"whatever": 1}})
+
+
 def _oracle_total(store_root):
     async def main():
         f = Frontend(HummockLite(LocalFsObjectStore(store_root)),
