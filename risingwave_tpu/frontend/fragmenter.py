@@ -103,10 +103,24 @@ def _stages_ir(fs) -> List[dict]:
         if st.kind == "filter":
             out.append({"kind": "filter",
                         "pred": expr_to_ir(st.exprs[0])})
-        else:
+        elif st.kind == "project":
             out.append({"kind": "project",
                         "exprs": [expr_to_ir(e) for e in st.exprs],
                         "names": list(st.names)})
+        elif st.kind == "row_id_gen":
+            # runtime = the absorbed RowIdGenExecutor (host) — the
+            # worker rebuilds a bare RowIdCounter with the same shard
+            out.append({"kind": "row_id_gen",
+                        "vnode_base": st.runtime.vnode_base})
+        elif st.kind == "watermark_filter":
+            out.append({"kind": "watermark_filter",
+                        "time_col": st.time_col,
+                        "delay_usecs": st.delay_usecs,
+                        "table_id": (st.runtime.state.table_id
+                                     if st.runtime.state is not None
+                                     else None)})
+        else:
+            raise FragmentError(f"unknown fused stage kind {st.kind!r}")
     return out
 
 
@@ -328,11 +342,28 @@ class Fragmenter:
             left, right = ex.sides
             l_fi, _ = self._lower(ex.left_in)
             r_fi, _ = self._lower(ex.right_in)
-            fi, lxi = self._cut(l_fi, list(left.key_indices),
-                                ex.left_in.schema, self.parallelism)
-            rxi = self._cut_into(fi, r_fi, list(right.key_indices),
-                                 ex.right_in.schema)
-            ni = self._append(fi, {
+            if (left.fused_input is not None
+                    or right.fused_input is not None) \
+                    and self.parallelism > 1:
+                # the exchange would hash RAW rows on post-run key
+                # positions — the sessions gate fusion to parallelism
+                # 1, so reaching here is a planner bug
+                raise FragmentError(
+                    "fused join input cannot take a hash-exchange "
+                    "cut (fusion is parallelism-1 only on the "
+                    "distributed frontend)")
+            # a fused side's key positions live in the absorbed run's
+            # OUTPUT space; the exchange ships RAW rows, so the cut
+            # carries no hash keys there (parallelism 1: the single
+            # consumer makes routing trivial)
+            l_cut = [] if left.fused_input is not None \
+                else list(left.key_indices)
+            r_cut = [] if right.fused_input is not None \
+                else list(right.key_indices)
+            fi, lxi = self._cut(l_fi, l_cut, ex.left_in.schema,
+                                self.parallelism)
+            rxi = self._cut_into(fi, r_fi, r_cut, ex.right_in.schema)
+            node = {
                 "op": "hash_join", "left": lxi, "right": rxi,
                 "left_keys": list(left.key_indices),
                 "right_keys": list(right.key_indices),
@@ -345,7 +376,12 @@ class Fragmenter:
                 # shipped pks are already key-prefixed when set, and
                 # worker rebuilds run the same epoch-batched path
                 "state_cap": left.state_cap,
-                "output_names": [f.name for f in ex.schema]})
+                "output_names": [f.name for f in ex.schema]}
+            if left.fused_input is not None:
+                node["left_fused"] = _stages_ir(left.fused_input)
+            if right.fused_input is not None:
+                node["right_fused"] = _stages_ir(right.fused_input)
+            ni = self._append(fi, node)
             return fi, ni
         from risingwave_tpu.stream.executors.temporal_join import (
             TemporalJoinExecutor,

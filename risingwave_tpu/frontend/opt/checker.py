@@ -222,21 +222,41 @@ def _verify_node(ex) -> None:
         return
     if isinstance(ex, HashJoinExecutor):
         left, right = ex.sides
-        for side, inp, lbl in ((left, ex.left_in, "left"),
-                               (right, ex.right_in, "right")):
-            if not _same_types(side.schema, inp.schema):
+        eff_arity = 0
+        for idx, (side, inp, lbl) in enumerate(
+                ((left, ex.left_in, "left"),
+                 (right, ex.right_in, "right"))):
+            # a fused input side (opt/fusion.py try_fuse_join): the
+            # side's index space is the absorbed run's OUTPUT schema,
+            # and the run itself must re-verify against the raw input
+            # actually feeding it
+            if side.fused_input is not None:
+                _verify_fused_stages(side.fused_input, inp.schema,
+                                     f"HashJoin[{lbl} fused]")
+                from risingwave_tpu.frontend.opt.fusion import (
+                    join_side_ineligible_reason,
+                )
+                r = join_side_ineligible_reason(ex, idx)
+                if r is not None:
+                    raise CheckError(
+                        f"HashJoin[{lbl} fused]: ineligible ({r})")
+                eff = side.fused_input.out_schema
+            else:
+                eff = inp.schema
+            eff_arity += len(eff)
+            if not _same_types(side.schema, eff):
                 raise CheckError(
                     f"HashJoin: {lbl} side schema drifted from its "
                     "input")
             for k in side.key_indices:
-                if not (0 <= k < len(inp.schema)):
+                if not (0 <= k < len(eff)):
                     raise CheckError(
                         f"HashJoin: {lbl} key {k} out of range")
-            if not _same_types(side.table.schema, inp.schema):
+            if not _same_types(side.table.schema, eff):
                 raise CheckError(
                     f"HashJoin: {lbl} state-table schema drifted")
             for p in side.table.pk_indices:
-                if not (0 <= p < len(inp.schema)):
+                if not (0 <= p < len(eff)):
                     raise CheckError(
                         f"HashJoin: {lbl} state pk {p} out of range")
         lt = [left.schema[i].data_type for i in left.key_indices]
@@ -244,8 +264,7 @@ def _verify_node(ex) -> None:
         if lt != rt:
             raise CheckError("HashJoin: key types differ across sides")
         if ex.join_type.subject is None and \
-                len(ex.schema) != len(ex.left_in.schema) + \
-                len(ex.right_in.schema):
+                len(ex.schema) != eff_arity:
             raise CheckError("HashJoin: output arity != left + right")
         return
     if isinstance(ex, HashAggExecutor):
@@ -316,10 +335,13 @@ def _verify_fused_stages(fs, input_schema, where: str) -> None:
         raise CheckError(
             f"{where}: fused run planned against a different input "
             "schema than the one feeding it")
+    # composed exprs bind against the EXTENDED schema: synthetic
+    # runtime columns (absorbed row ids, watermark thresholds) are
+    # legal refs past the real input
     for p in fs.preds:
-        _check_expr(p, fs.in_schema, f"{where} pred")
+        _check_expr(p, fs.ext_schema, f"{where} pred")
     for j, e in enumerate(fs.out_exprs or []):
-        _check_expr(e, fs.in_schema, f"{where} expr")
+        _check_expr(e, fs.ext_schema, f"{where} expr")
     r = fs.fusable_reason()
     if r is not None:
         raise CheckError(f"{where}: run is not traceable ({r})")

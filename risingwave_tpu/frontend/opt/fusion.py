@@ -35,31 +35,57 @@ from typing import List, Optional, Tuple
 from risingwave_tpu.stream.executor import executor_children
 
 
-def _as_stage(ex):
-    """FilterExecutor/ProjectExecutor → FusedStage, else None."""
+# which executor kinds each absorption shape accepts: agg preludes
+# stay filter/project (the kernel's apply cannot emit watermark
+# messages or rebase id counters); join input runs add row_id_gen
+# (the generated pk column rides the raw matrix as a synthetic
+# device input); standalone blocks additionally absorb
+# watermark_filter (the block's own message loop does the watermark
+# emission/persistence the absorbed executor used to)
+AGG_KINDS = frozenset({"filter", "project"})
+JOIN_KINDS = AGG_KINDS | {"row_id_gen"}
+BLOCK_KINDS = JOIN_KINDS | {"watermark_filter"}
+
+
+def _as_stage(ex, kinds=BLOCK_KINDS):
+    """Fusable executor → FusedStage (kind-gated), else None."""
     from risingwave_tpu.ops.fused import FusedStage
+    from risingwave_tpu.stream.executors.row_id_gen import (
+        RowIdGenExecutor,
+    )
     from risingwave_tpu.stream.executors.simple import (
         FilterExecutor, ProjectExecutor,
     )
-    if isinstance(ex, FilterExecutor):
+    from risingwave_tpu.stream.executors.watermark_filter import (
+        WatermarkFilterExecutor,
+    )
+    if isinstance(ex, FilterExecutor) and "filter" in kinds:
         return FusedStage("filter", "FilterExecutor",
                           exprs=(ex.predicate,))
-    if isinstance(ex, ProjectExecutor):
+    if isinstance(ex, ProjectExecutor) and "project" in kinds:
         return FusedStage(
             "project", "ProjectExecutor",
             exprs=tuple(ex.exprs),
             names=tuple(f.name for f in ex.schema),
             watermark_derivations=dict(ex.watermark_derivations))
+    if isinstance(ex, RowIdGenExecutor) and "row_id_gen" in kinds:
+        return FusedStage("row_id_gen", "RowIdGenExecutor",
+                          runtime=ex)
+    if isinstance(ex, WatermarkFilterExecutor) \
+            and "watermark_filter" in kinds:
+        return FusedStage("watermark_filter", "WatermarkFilterExecutor",
+                          time_col=ex.time_col, delay_usecs=ex.delay,
+                          runtime=ex)
     return None
 
 
-def _collect_run(top) -> Tuple[list, object]:
-    """Maximal consecutive filter/project run starting at `top` going
+def _collect_run(top, kinds=BLOCK_KINDS) -> Tuple[list, object]:
+    """Maximal consecutive fusable run starting at `top` going
     downstream→upstream. Returns (stages in DATAFLOW order, base)."""
     rev: List = []
     node = top
     while True:
-        st = _as_stage(node)
+        st = _as_stage(node, kinds)
         if st is None:
             break
         rev.append(st)
@@ -94,6 +120,34 @@ def agg_fusable_reason(agg) -> Optional[str]:
     return agg_ineligible_reason(agg)
 
 
+def join_side_ineligible_reason(join, side_idx: int) -> Optional[str]:
+    """THE join-side eligibility predicate (rule, adopt guard, and
+    checker all call it — the checker re-verifies ALREADY-fused sides,
+    so `fused_input is not None` is deliberately not a condition).
+    The fused path needs the single-chip epoch dispatches (the
+    prelude inlines there), host-typed keys would need interning
+    inside the trace, and the cold tier reads buffered key lanes the
+    raw matrix no longer carries."""
+    side = join.sides[side_idx]
+    if side._mesh is not None:
+        return "sharded kernel (per-chunk dispatch path)"
+    if join.rebuild_opts.get("state_cap") is not None:
+        return ("cold-tier governed join (reload reads the buffered "
+                "key lanes)")
+    for i in side.key_indices:
+        if not side.schema[i].data_type.is_device:
+            return (f"host-typed join key column "
+                    f"{side.schema[i].data_type.value} (interned)")
+    return None
+
+
+def join_side_fusable_reason(join, side_idx: int) -> Optional[str]:
+    """None iff this join side can absorb its input run NOW."""
+    if join.sides[side_idx].fused_input is not None:
+        return "already fused"
+    return join_side_ineligible_reason(join, side_idx)
+
+
 def fuse_fragments(root) -> Tuple[object, int, str]:
     """The rule entry point (engine registry signature). Non-
     destructive: copy-on-write along every mutated path so the engine's
@@ -104,6 +158,9 @@ def fuse_fragments(root) -> Tuple[object, int, str]:
         FusedFragmentExecutor,
     )
     from risingwave_tpu.stream.executors.hash_agg import HashAggExecutor
+    from risingwave_tpu.stream.executors.hash_join import (
+        HashJoinExecutor,
+    )
     details: List[str] = []
 
     def try_fuse_agg(agg):
@@ -113,7 +170,7 @@ def fuse_fragments(root) -> Tuple[object, int, str]:
         node = agg.input
         if isinstance(node, CoalesceExecutor):
             node = node.input
-        stages, base = _collect_run(node)
+        stages, base = _collect_run(node, AGG_KINDS)
         if not stages:
             return None
         fs = FusedStages(base.schema, stages)
@@ -131,7 +188,7 @@ def fuse_fragments(root) -> Tuple[object, int, str]:
 
     def try_fuse_standalone(top):
         """≥2-stage run not feeding an eligible agg → fused block."""
-        stages, base = _collect_run(top)
+        stages, base = _collect_run(top, BLOCK_KINDS)
         if len(stages) < 2:
             return None
         fs = FusedStages(base.schema, stages)
@@ -142,8 +199,48 @@ def fuse_fragments(root) -> Tuple[object, int, str]:
         details.append(f"block {fs.describe()}")
         return FusedFragmentExecutor(base, fs)
 
+    def try_fuse_join(join):
+        """Eligible join sides absorb their input runs (coalesce
+        absorbed — the epoch buffer IS the batcher) into the side's
+        epoch apply+probe dispatches. Returns a fused COPY (join +
+        adopted sides) or None; each side fuses independently."""
+        import copy as _copy
+        new_join = None
+        for s, attr in ((0, "left_in"), (1, "right_in")):
+            r = join_side_fusable_reason(join, s)
+            if r is not None:
+                continue
+            node = getattr(new_join if new_join is not None else join,
+                           attr)
+            if isinstance(node, CoalesceExecutor):
+                node = node.input
+            stages, base = _collect_run(node, JOIN_KINDS)
+            if not stages:
+                continue
+            fs = FusedStages(base.schema, stages)
+            reason = fs.fusable_reason()
+            if reason is not None:
+                details.append(
+                    f"join side {s} run NOT fused ({reason})")
+                continue
+            if new_join is None:
+                new_join = _copy.copy(join)
+                new_join.sides = tuple(_copy.copy(sd)
+                                       for sd in join.sides)
+                new_join._info = _copy.copy(join._info)
+            new_join.adopt_fused_input(s, fs, base)
+            details.append(f"join side {s} absorbed {fs.describe()}")
+        if new_join is not None:
+            descs = "; ".join(
+                ("L:" if i == 0 else "R:") + sd.fused_input.describe()
+                for i, sd in enumerate(new_join.sides)
+                if sd.fused_input is not None)
+            new_join._info.identity = \
+                f"{join.identity}[fused:{descs}→join]"
+        return new_join
+
     def walk(ex):
-        """Top-down: an eligible agg absorbs its run BEFORE the
+        """Top-down: an eligible agg/join absorbs its run BEFORE the
         generic descent could carve a standalone block out of it; the
         walk then resumes below the absorbed base. Returns a (possibly
         new) executor; originals are never mutated."""
@@ -155,6 +252,11 @@ def fuse_fragments(root) -> Tuple[object, int, str]:
                 fired += 1
                 fused.input = walk(fused.input)   # fused is a copy
                 return fused
+        elif isinstance(ex, HashJoinExecutor):
+            fused = try_fuse_join(ex)
+            if fused is not None:
+                fired += 1
+                ex = fused            # descend below the fused copy
         elif _as_stage(ex) is not None:
             fused = try_fuse_standalone(ex)
             if fused is not None:

@@ -295,6 +295,11 @@ def push_filters(root) -> Tuple[object, int, str]:
     def try_push(f):
         """Filter f moves one level down (returns the replacement)."""
         j = f.input
+        if isinstance(j, HashJoinExecutor) and \
+                any(s.fused_input is not None for s in j.sides):
+            # the join's input executors sit in the absorbed run's RAW
+            # space — a join-space conjunct cannot move below them
+            return None
         if isinstance(j, HashJoinExecutor) and j.join_type in (
                 JoinType.INNER, JoinType.LEFT_OUTER,
                 JoinType.RIGHT_OUTER, JoinType.FULL_OUTER):
@@ -535,6 +540,12 @@ def _prune_join(j, live_full: Set[int], stats) -> tuple:
     if j.join_type not in (JoinType.INNER, JoinType.LEFT_OUTER,
                            JoinType.RIGHT_OUTER, JoinType.FULL_OUTER):
         # semi/anti outputs one side only; leave those plans alone
+        return _prune_opaque_2(j, stats)
+    if any(s.fused_input is not None for s in j.sides):
+        # a fused input side's index space is the absorbed run's
+        # OUTPUT schema — narrowing the raw input would unbind the
+        # run (and fusion runs LAST, so this only happens on later
+        # fixpoint rounds; the fused shape is final)
         return _prune_opaque_2(j, stats)
     left_side, right_side = j.sides
     n_left = j.n_left

@@ -835,6 +835,14 @@ class StreamPlanner:
         if isinstance(ex, ProjectSetExecutor):
             # deterministic expansion of inserts is inserts
             return StreamPlanner._derive_append_only(ex.input)
+        from risingwave_tpu.stream.executors.fused import (
+            FusedFragmentExecutor,
+        )
+        if isinstance(ex, FusedFragmentExecutor):
+            # a fused block composes filter/project/row_id_gen/
+            # watermark_filter stages — each append-only-transparent,
+            # so the block is too
+            return StreamPlanner._derive_append_only(ex.input)
         # HashAgg/TopN/Backfill/DynamicFilter/unknown: assume retracting
         return False
 

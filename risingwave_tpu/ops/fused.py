@@ -230,13 +230,21 @@ class FusedStage:
     """One logical executor inside a fused block (metrics identity +
     the pieces EXPLAIN and the fragmenter serialize)."""
 
-    kind: str                      # "filter" | "project"
+    kind: str    # "filter" | "project" | "row_id_gen" | "watermark_filter"
     identity: str                  # e.g. "FilterExecutor"
     # filter: the ORIGINAL predicate (own column space); project: the
     # original exprs/names. Serialized by the fragmenter.
     exprs: tuple = ()
     names: tuple = ()
     watermark_derivations: dict = field(default_factory=dict)
+    # watermark_filter: event-time column (own input space) + delay;
+    # row_id_gen / watermark_filter runtime state (the id counter's
+    # shard base, the watermark StateTable) is carried by `runtime` —
+    # a HOST-ONLY handle, never serialized (the fragmenter re-derives
+    # it from table ids)
+    time_col: int = -1
+    delay_usecs: int = 0
+    runtime: object = None
 
 
 class FusedStages:
@@ -266,6 +274,16 @@ class FusedStages:
         self.stages = list(stages)
         if not self.stages:
             raise ValueError("FusedStages needs at least one stage")
+        # synthetic RUNTIME columns appended past the real input: each
+        # row_id_gen stage contributes its per-chunk id column (host
+        # arithmetic: base + arange) and each watermark_filter its
+        # scalar threshold, broadcast per row. The trace sees them as
+        # ordinary device inputs; `augment` builds them per chunk.
+        self.syn_specs: List[tuple] = []     # ("row_id"|"wm", stage_i)
+        syn_fields: List[Field] = []
+        n_in = len(in_schema)
+        self.row_id_stages: List[tuple] = []   # (stage_i, ext col)
+        self.wm_stages: List[tuple] = []       # (stage_i, ext col)
         # compose onto the input space
         cur: Optional[list] = None          # None = identity projection
         preds: List[object] = []
@@ -280,8 +298,30 @@ class FusedStages:
                 cur = [e if cur is None else subst_expr(e, cur)
                        for e in st.exprs]
                 names = list(st.names)
+            elif st.kind == "row_id_gen":
+                syn = n_in + len(syn_fields)
+                syn_fields.append(Field("_row_id", DataType.SERIAL))
+                self.syn_specs.append(("row_id", si))
+                self.row_id_stages.append((si, syn))
+                if cur is None:
+                    cur = [InputRef(i, f.data_type)
+                           for i, f in enumerate(in_schema)]
+                cur = cur + [InputRef(syn, DataType.SERIAL)]
+                names = names + ["_row_id"]
+            elif st.kind == "watermark_filter":
+                # gated to the HEAD of the run (fusable_reason): the
+                # late mask then reads the raw event-time column and
+                # the synthetic threshold directly
+                dt_t = in_schema[st.time_col].data_type
+                syn = n_in + len(syn_fields)
+                syn_fields.append(Field(f"_wm_thr{si}", dt_t))
+                self.syn_specs.append(("wm", si))
+                self.wm_stages.append((si, syn))
             else:
                 raise ValueError(f"unknown stage kind {st.kind!r}")
+        self.ext_schema = Schema(list(in_schema.fields)
+                                 + syn_fields) if syn_fields \
+            else in_schema
         self.preds = preds
         self._pred_stage = pred_stage
         self.out_exprs = cur
@@ -300,6 +340,11 @@ class FusedStages:
             refs |= expr_refs(p)
         for e in (self.out_exprs or []):
             refs |= expr_refs(e)
+        for si, syn in self.wm_stages:
+            refs.add(self.stages[si].time_col)
+            refs.add(syn)
+        for _si, syn in self.row_id_stages:
+            refs.add(syn)
         # host passthrough outputs: bare InputRefs to host-typed input
         # columns ride AROUND the trace (positional vis/ops are shared)
         self.host_out: Dict[int, int] = {}
@@ -314,7 +359,7 @@ class FusedStages:
                 if isinstance(e, InputRef) and not e.return_type.is_device:
                     self.host_out[j] = e.index
         self.ref_cols: List[int] = sorted(
-            i for i in refs if in_schema[i].data_type.is_device)
+            i for i in refs if self.ext_schema[i].data_type.is_device)
         # per-stage row attribution drained by the monitor at barriers
         self.stage_rows = np.zeros(len(self.stages), dtype=np.int64)
         self.stage_chunks = np.zeros(len(self.stages), dtype=np.int64)
@@ -322,17 +367,135 @@ class FusedStages:
     # -- eligibility -------------------------------------------------------
     def fusable_reason(self) -> Optional[str]:
         """None iff the composed run traces; else the first refusal."""
+        if len(self.wm_stages) > 1:
+            return "more than one watermark_filter stage in the run"
+        for si, _syn in self.wm_stages:
+            if si != 0:
+                return ("watermark_filter stage not at the head of "
+                        "the run (its late mask must see raw rows)")
+            st = self.stages[si]
+            dt_t = self.in_schema[st.time_col].data_type
+            if not dt_t.is_device or \
+                    np.dtype(dt_t.np_dtype).kind not in "iu":
+                # float time columns would make the no-watermark-yet
+                # sentinel (I64_MIN broadcast) observable (-inf rows);
+                # integer event times are the planner's only shape
+                return ("watermark_filter over non-integer time "
+                        f"column {dt_t.value}")
         for p in self.preds:
-            r = traceable_reason(p, self.in_schema)
+            r = traceable_reason(p, self.ext_schema)
             if r:
                 return r
         for j, e in enumerate(self.out_exprs or []):
             if j in self.host_out:
                 continue            # host passthrough, never traced
-            r = traceable_reason(e, self.in_schema)
+            r = traceable_reason(e, self.ext_schema)
             if r:
                 return r
         return None
+
+    # -- synthetic runtime columns (host side, per chunk) ------------------
+    def augment(self, chunk):
+        """Chunk over in_schema → chunk over ext_schema: append each
+        row_id_gen stage's id column (base + arange — RowIdGenExecutor
+        assigns ids to EVERY slot, visible or padding) and each
+        watermark_filter's threshold column (the watermark EMITTED
+        before this chunk; dtype-min sentinel = no watermark yet,
+        which lates nothing since ts < dtype_min is unsatisfiable
+        in-dtype). Advances
+        the absorbed executors' runtime state exactly as their own
+        chunk loops would have — the id counter bumps by capacity, the
+        watermark advances to max(event_time) - delay."""
+        if not self.syn_specs:
+            return chunk
+        cap = chunk.capacity
+        cols = list(chunk.columns)
+        for kind, si in self.syn_specs:
+            rt = self.stages[si].runtime
+            if kind == "row_id":
+                cols.append(Column(
+                    DataType.SERIAL,
+                    rt._next + np.arange(cap, dtype=np.int64)))
+                rt._next += cap
+            else:
+                thr = rt.current          # the PRE-chunk watermark:
+                # the mask must not see this chunk's own max (see
+                # WatermarkFilterExecutor._apply)
+                dt = self.ext_schema[len(cols)].data_type
+                info = np.iinfo(np.dtype(dt.np_dtype))
+                # sentinel/clamp in the TIME COLUMN's OWN dtype:
+                # np.full would silently WRAP an out-of-range int64
+                # (int64-min → 0 on an int32 column), turning
+                # "no watermark yet" into "drop every negative ts".
+                # dtype-min is exact either way: ts < dtype_min is
+                # unsatisfiable for in-dtype ts, same as no filter
+                # (and a true threshold below dtype_min lates nothing
+                # a narrower column could hold).
+                val = info.min if thr is None \
+                    else min(max(int(thr), info.min), info.max)
+                cols.append(Column(dt, np.full(
+                    cap, val, dtype=np.dtype(dt.np_dtype))))
+                st = self.stages[si]
+                c = chunk.columns[st.time_col]
+                ts = np.asarray(c.values).astype(np.int64)
+                ok = np.asarray(chunk.visibility) if c.validity is None \
+                    else (np.asarray(chunk.visibility)
+                          & np.asarray(c.validity))
+                if ok.any():
+                    mx = int(ts[ok].max()) - st.delay_usecs
+                    if rt.current is None or mx > rt.current:
+                        rt.current = mx
+        return StreamChunk(self.ext_schema, cols, chunk.visibility,
+                           chunk.ops)
+
+    def on_barrier(self, barrier, first: bool = False) -> List:
+        """Absorbed-runtime barrier work (the hosting executor calls
+        this where the sequential executors' own barrier handling
+        would have run). Returns watermark messages to emit AFTER the
+        barrier (IN-schema column space — callers derive through the
+        projection stages). First barrier: restore the persisted
+        watermark; later barriers: persist + commit; row-id counters
+        rebase to the epoch floor either way."""
+        from risingwave_tpu.stream.message import Watermark
+        out: List = []
+        for si, _syn in self.row_id_stages:
+            self.stages[si].runtime._rebase(barrier.epoch.curr.value)
+        for si, _syn in self.wm_stages:
+            st = self.stages[si]
+            rt = st.runtime
+            if first:
+                if rt.state is not None:
+                    rt.state.init_epoch(barrier.epoch)
+                    row = rt.state.get_row((0,))
+                    if row is not None:
+                        rt.current = int(row[1])
+                # the restored watermark re-announces itself, exactly
+                # like the sequential executor's first-barrier yield
+                if rt.current is not None:
+                    out.append(Watermark(st.time_col,
+                                         DataType.TIMESTAMP,
+                                         rt.current))
+            else:
+                rt._persist()
+                if rt.state is not None:
+                    rt.state.commit(barrier.epoch)
+        return out
+
+    def post_chunk_watermarks(self) -> List:
+        """Watermark messages due after a data chunk (IN-schema space;
+        WatermarkFilterExecutor emits its current watermark after
+        every chunk it forwards)."""
+        from risingwave_tpu.stream.message import Watermark
+        return [Watermark(self.stages[si].time_col, DataType.TIMESTAMP,
+                          self.stages[si].runtime.current)
+                for si, _syn in self.wm_stages
+                if self.stages[si].runtime.current is not None]
+
+    def wm_time_cols(self) -> List[int]:
+        """IN-schema columns owned by absorbed watermark_filter stages
+        (upstream watermarks on them are superseded, like the
+        sequential executor's own-column drop)."""
+        return [self.stages[si].time_col for si, _syn in self.wm_stages]
 
     def describe(self) -> str:
         return "→".join(s.identity for s in self.stages)
@@ -418,23 +581,39 @@ class FusedStages:
         from risingwave_tpu.stream.executors.simple import (
             FilterExecutor,
         )
-        chunk = StreamChunk(self.in_schema, cols, vis, ops)
+        chunk = StreamChunk(self.ext_schema, cols, vis, ops)
         # per-stage rows: each filter's post-predicate count; projects
         # report the count AT THEIR POSITION in dataflow order (not the
         # final count — a filter after a project must not retroactively
         # shrink the project's attribution)
         n_stages = len(self.stages)
         stage_rows = [None] * n_stages
+        for si, syn in self.wm_stages:
+            # head-of-run late mask (WatermarkFilterExecutor._apply):
+            # rows with a valid event time BELOW the pre-chunk
+            # watermark (the synthetic threshold column) go invisible
+            st = self.stages[si]
+            c_ts = chunk.columns[st.time_col]
+            ts = c_ts.values
+            thr = chunk.columns[syn].values
+            okm = chunk.visibility if c_ts.validity is None \
+                else chunk.visibility & c_ts.validity
+            late = okm & (ts < thr)
+            chunk = StreamChunk(self.ext_schema, chunk.columns,
+                                chunk.visibility & ~late, chunk.ops)
+            stage_rows[si] = xp.sum(chunk.visibility.astype(xp.int64))
         for p, si in zip(self.preds, self._pred_stage):
             chunk = FilterExecutor.apply_predicate(chunk, p)
             stage_rows[si] = xp.sum(chunk.visibility.astype(xp.int64))
         out_cols: List[Optional[Column]] = []
         if self.out_exprs is None:
-            # filter-only run: every column passes through — device
-            # columns from the (possibly traced) chunk, host columns as
-            # None placeholders the caller reattaches positionally
+            # filter-only run: every INPUT column passes through —
+            # device columns from the (possibly traced) chunk, host
+            # columns as None placeholders the caller reattaches
+            # positionally. Synthetic runtime columns never leave.
             out_cols = [None if j in self.host_out else c
-                        for j, c in enumerate(chunk.columns)]
+                        for j, c in
+                        enumerate(chunk.columns[:len(self.in_schema)])]
         else:
             for j, e in enumerate(self.out_exprs):
                 out_cols.append(None if j in self.host_out
@@ -496,8 +675,9 @@ def build_chain_step(fs: FusedStages):
     import jax
     import jax.numpy as jnp
 
-    in_schema = fs.in_schema
-    ref = list(fs.ref_cols)
+    in_schema = fs.ext_schema     # synthetic runtime columns (row ids,
+    ref = list(fs.ref_cols)       # watermark thresholds) enter as
+                                  # ordinary device inputs
 
     def step(vals, valids, vis, ops, host_same):
         cap = vis.shape[0]
@@ -535,7 +715,7 @@ def build_agg_prelude(fs: FusedStages, group_indices: Sequence[int],
     jitted step (filter, project, key/lane encode)."""
     import jax.numpy as jnp
 
-    in_schema = fs.in_schema
+    in_schema = fs.ext_schema
     ref = list(fs.ref_cols)
     group = list(group_indices)
 
@@ -563,5 +743,59 @@ def build_agg_prelude(fs: FusedStages, group_indices: Sequence[int],
             # serves both (no drifting twin)
             call_inputs.append((spec.encode_input(c.values), ok))
         return key_lanes, signs, vis2, tuple(call_inputs), stage_rows
+
+    return prelude
+
+
+# -- the join input prelude (inlined into hash_join's epoch jits) ----------
+
+
+def payload_lanes_traced(cols: Sequence[Column], xp) -> object:
+    """Device-typed payload columns → int32[N, 3p] lanes: the ONE
+    encode in ops/lanes.py (bit-preserving payload_i64 — NOT the key
+    normalization, which would fold -0.0 into 0.0 on the emit path),
+    here traced under jit (xp=jnp) — same bytes as the host paths."""
+    from risingwave_tpu.ops.lanes import payload_lanes
+    return payload_lanes([(c.values, c.validity) for c in cols], xp)
+
+
+def build_join_prelude(fs: FusedStages, key_indices: Sequence[int],
+                       pay_indices: Sequence[int]):
+    """Traced fn: raw int64 matrix → the [key_lanes | payload_lanes]
+    int32 upload matrix ops/hash_join's epoch apply/probe consume —
+    the join twin of build_agg_prelude. The absorbed run's value
+    computation (projection exprs, key/lane encode, payload encode)
+    happens INSIDE the epoch dispatches; visibility decisions (filter
+    predicates, the watermark late mask, pair degradation) ride in the
+    host-built aux flags, which the executor derives from the SAME
+    composed chain run on numpy — bit-identical by the fusion
+    contract, so the device never needs to re-decide them."""
+    import jax.numpy as jnp
+
+    schema = fs.ext_schema
+    ref = list(fs.ref_cols)
+    keys = list(key_indices)
+    pays = list(pay_indices)
+    need = set(keys) | set(pays)
+
+    def prelude(raw):
+        cols, vis, ops = decode_raw_cols(raw, schema, ref, jnp)
+        chunk = StreamChunk(schema, cols, vis, ops)
+        if fs.out_exprs is None:
+            out_cols = list(chunk.columns[:len(fs.in_schema)])
+        else:
+            # only the columns the lanes read get evaluated — the rest
+            # are dead in this trace (XLA would DCE them anyway; not
+            # emitting them keeps the jaxpr small)
+            out_cols = [e.eval(chunk) if j in need else None
+                        for j, e in enumerate(fs.out_exprs)]
+        key_lanes = key_lanes_traced(
+            [(out_cols[i].values, out_cols[i].validity)
+             for i in keys], jnp)
+        if not pays:
+            return key_lanes
+        pay_lanes = payload_lanes_traced([out_cols[i] for i in pays],
+                                         jnp)
+        return jnp.concatenate([key_lanes, pay_lanes], axis=1)
 
     return prelude

@@ -186,23 +186,32 @@ _probe_pairs_jit = jaxtools.instrumented_jit(
 
 
 # -- epoch batching --------------------------------------------------------
-# One packed aux matrix rides along with the key lanes and feeds BOTH
-# the apply and the probe of a whole epoch: through the tunnel, per-
-# barrier transfer count (not compute) bounds throughput, so the
-# executor concatenates every chunk of the epoch and ships each side as
-# exactly two uploads + one apply dispatch + one probe dispatch.
+# One packed aux matrix rides along with the upload matrix (key lanes
+# concatenated with payload lanes) and feeds BOTH the apply and the
+# probe of a whole epoch: through the tunnel, per-barrier transfer
+# count (not compute) bounds throughput, so the executor concatenates
+# every chunk of the epoch and ships each side as exactly two uploads
+# + one apply dispatch + one probe dispatch.
 AUX_INS_REF, AUX_DEL_REF, AUX_FLAGS, AUX_SEQ = 0, 1, 2, 3
 FLAG_PROBE, FLAG_INS, FLAG_DEL = 1, 2, 4
+# probe row's op sign is negative (DELETE / UPDATE_DELETE) — the
+# device-side degree scatter needs it (see epoch_probe)
+FLAG_NEG = 8
 
 
 def epoch_apply(table: ht.TableState, chains: ChainState,
-                key_lanes: jnp.ndarray, aux: jnp.ndarray):
+                pay: jnp.ndarray, up: jnp.ndarray, aux: jnp.ndarray,
+                key_width: int):
     """Apply a whole epoch's inserts + tombstones in one dispatch.
 
-    Rows carry their message sequence in aux[:, AUX_SEQ]; sequence
-    visibility makes application order irrelevant (probes reconstruct
-    any interleaving exactly), so one batched apply per side per epoch
-    is semantically identical to per-chunk applies."""
+    ``up`` is [key_lanes | payload_lanes] int32[n, key_width + P]: the
+    payload lanes of inserted rows scatter into the device payload
+    store in the SAME dispatch that links their chains. Rows carry
+    their message sequence in aux[:, AUX_SEQ]; sequence visibility
+    makes application order irrelevant (probes reconstruct any
+    interleaving exactly), so one batched apply per side per epoch is
+    semantically identical to per-chunk applies."""
+    key_lanes = up[:, :key_width]
     flags = aux[:, AUX_FLAGS]
     ins_mask = (flags & FLAG_INS) != 0
     del_mask = (flags & FLAG_DEL) != 0
@@ -211,26 +220,195 @@ def epoch_apply(table: ht.TableState, chains: ChainState,
     chains2 = link_rows(chains, slots, aux[:, AUX_INS_REF], ins_mask,
                         table2.capacity, seq)
     chains2 = tombstone_rows(chains2, aux[:, AUX_DEL_REF], del_mask, seq)
-    return table2, chains2, ins
+    if pay.shape[1]:
+        row_cap = pay.shape[0]
+        dest = jnp.where(ins_mask, aux[:, AUX_INS_REF],
+                         jnp.int32(row_cap))
+        pay = pay.at[dest].set(up[:, key_width:], mode="drop")
+    return table2, chains2, pay, ins
 
 
 _epoch_apply_jit = jaxtools.instrumented_jit(
-    epoch_apply, "hash_join.epoch_apply", donate_argnums=(0, 1))
+    epoch_apply, "hash_join.epoch_apply", donate_argnums=(0, 1, 2),
+    static_argnums=(5,))
 
 
 def epoch_probe(table: ht.TableState, chains: ChainState,
-                key_lanes: jnp.ndarray, aux: jnp.ndarray,
-                out_cap: int, with_degrees: bool) -> jnp.ndarray:
+                pay: jnp.ndarray, deg_self: jnp.ndarray,
+                deg_sink: jnp.ndarray, up: jnp.ndarray,
+                aux: jnp.ndarray, key_width: int, out_cap: int,
+                with_degrees: bool):
     """Probe a whole epoch's rows (each at its own sequence) in one
-    dispatch against post-apply state — exact by sequence visibility."""
-    vis = (aux[:, AUX_FLAGS] & FLAG_PROBE) != 0
+    dispatch against post-apply state — exact by sequence visibility.
+
+    Fused degrees + cumsum + emit + payload gather + degree
+    maintenance: ONE kernel, ONE packed d2h matrix of width
+    W = 2 + P + (1 if with_degrees). Layout:
+
+      row 0                      header [total_pairs, 0, ...]
+      rows 1..1+n (deg only)     per-probe-row match degrees (col 0)
+      out_cap pair rows          [probe_row, ref, pay lanes..., old]
+
+    ``pay`` is THIS side's payload store: the emit walk gathers each
+    matched ref's lanes ON DEVICE, so the host materializes matched
+    rows from the one packed fetch instead of arena-gathering
+    column-by-column per chunk. With ``with_degrees``:
+
+    - ``old`` is deg_self[ref] BEFORE this epoch's updates — the host
+      replays per-chunk degree transitions from it without keeping a
+      host degrees array;
+    - deg_self gets one scatter-add of every pair's probe-row sign
+      (FLAG_NEG), i.e. the stored side's degree transitions;
+    - deg_sink (the PROBING side's degree array) gets one scatter-add
+      of each inserted row's probe-time match count at its ref — the
+      initial degree of rows stored this epoch. Adds commute, so the
+      two sides' probes may run in either order; fresh refs start at
+      zero by the bump-allocation invariant.
+
+    deg arrays are NOT donated: an overflow redispatch re-runs this
+    exact computation from the original arrays, and the host installs
+    the outputs only after a successful collect."""
+    key_lanes = up[:, :key_width]
+    flags = aux[:, AUX_FLAGS]
+    vis = (flags & FLAG_PROBE) != 0
     seq = aux[:, AUX_SEQ]
-    return probe_pairs(table, chains, key_lanes, vis, seq, out_cap,
-                       with_degrees)
+    n = key_lanes.shape[0]
+    P = pay.shape[1]
+    row_cap = chains.next.shape[0]
+    slots = ht.lookup(table, key_lanes, vis)
+    cur0 = jnp.where(slots >= 0,
+                     chains.head[jnp.maximum(slots, 0)], jnp.int32(-1))
+
+    def cond(c):
+        return jnp.any(c[0] >= 0)
+
+    def visible(safe):
+        return (chains.ins_seq[safe] < seq) & (chains.del_seq[safe] >= seq)
+
+    def body1(c):
+        cur, deg = c
+        safe = jnp.maximum(cur, 0)
+        m = (cur >= 0) & visible(safe)
+        return (jnp.where(cur >= 0, chains.next[safe], jnp.int32(-1)),
+                deg + m.astype(jnp.int32))
+
+    _cur, deg = jax.lax.while_loop(
+        cond, body1, (cur0, jnp.zeros(n, dtype=jnp.int32)))
+    offsets = jnp.cumsum(deg, dtype=jnp.int32) - deg
+    total = jnp.sum(deg, dtype=jnp.int32)
+    row_ids = jnp.arange(n, dtype=jnp.int32)
+
+    def body2(c):
+        cur, wp, op, orf, opay, oold = c
+        safe = jnp.maximum(cur, 0)
+        m = (cur >= 0) & visible(safe)
+        dest = jnp.where(m, wp, out_cap)
+        op = op.at[dest].set(row_ids, mode="drop")
+        orf = orf.at[dest].set(cur, mode="drop")
+        if P:
+            opay = opay.at[dest].set(pay[safe], mode="drop")
+        if with_degrees:
+            oold = oold.at[dest].set(deg_self[safe], mode="drop")
+        return (jnp.where(cur >= 0, chains.next[safe], jnp.int32(-1)),
+                wp + m.astype(jnp.int32), op, orf, opay, oold)
+
+    init2 = (cur0, offsets,
+             jnp.full(out_cap, -1, dtype=jnp.int32),
+             jnp.full(out_cap, -1, dtype=jnp.int32),
+             jnp.zeros((out_cap, P), dtype=jnp.int32),
+             jnp.zeros(out_cap, dtype=jnp.int32))
+    (_cur, _wp, out_probe, out_ref, out_pay,
+     out_old) = jax.lax.while_loop(cond, body2, init2)
+    parts = [out_probe[:, None], out_ref[:, None]]
+    if P:
+        parts.append(out_pay)
+    if with_degrees:
+        parts.append(out_old[:, None])
+    pairs = jnp.concatenate(parts, axis=1)
+    W = pairs.shape[1]
+    header = jnp.zeros((1, W), dtype=jnp.int32).at[0, 0].set(total)
+    if with_degrees:
+        # stored-side transitions: one scatter-add of pair signs
+        pair_mask = out_ref >= 0
+        sgn_row = jnp.where((flags & FLAG_NEG) != 0,
+                            jnp.int32(-1), jnp.int32(1))
+        pair_sgn = jnp.where(
+            pair_mask, sgn_row[jnp.maximum(out_probe, 0)], 0)
+        deg_self = deg_self.at[
+            jnp.where(pair_mask, out_ref, row_cap)].add(
+                pair_sgn, mode="drop")
+        # probing-side initial degrees: probe-time count at each
+        # inserted row's ref (add, not set — commutes with the other
+        # probe's transition adds; fresh slots are zero)
+        ins_mask = (flags & FLAG_INS) != 0
+        sink_cap = deg_sink.shape[0]
+        deg_sink = deg_sink.at[
+            jnp.where(ins_mask, aux[:, AUX_INS_REF], sink_cap)].add(
+                jnp.where(ins_mask, deg, 0), mode="drop")
+        degs = jnp.zeros((n, W), dtype=jnp.int32).at[:, 0].set(deg)
+        mat = jnp.concatenate([header, degs, pairs], axis=0)
+        return mat, deg_self, deg_sink
+    # degree-free (inner) probes return only the matrix: passing the
+    # untouched deg arrays through would force XLA output copies
+    return jnp.concatenate([header, pairs], axis=0)
 
 
 _epoch_probe_jit = jaxtools.instrumented_jit(
-    epoch_probe, "hash_join.epoch_probe", static_argnums=(4, 5))
+    epoch_probe, "hash_join.epoch_probe", static_argnums=(7, 8, 9))
+
+
+def _masked_scatter(arr: jnp.ndarray, refs: jnp.ndarray,
+                    vis: jnp.ndarray, vals: jnp.ndarray) -> jnp.ndarray:
+    """Masked write-by-ref into a donated device array (payload rows
+    AND degree values share this one scatter; masked rows drop on the
+    out-of-range sentinel)."""
+    cap = arr.shape[0]
+    dest = jnp.where(vis, refs, jnp.int32(cap))
+    return arr.at[dest].set(vals, mode="drop")
+
+
+_masked_scatter_jit = jaxtools.instrumented_jit(
+    _masked_scatter, "hash_join.masked_scatter", donate_argnums=(0,))
+
+
+def _pad_scatter_args(refs: np.ndarray, vals: np.ndarray):
+    """Host staging for _masked_scatter: pad refs/vals to the next
+    pow-2 row count (stable jit shapes) with a validity mask."""
+    from risingwave_tpu.common.chunk import next_pow2
+    n = len(refs)
+    cap = next_pow2(max(n, 1))
+    r = np.zeros(cap, dtype=np.int32)
+    r[:n] = refs
+    m = np.zeros(cap, dtype=bool)
+    m[:n] = True
+    v = np.zeros((cap,) + np.shape(vals)[1:], dtype=np.int32)
+    v[:n] = vals
+    return jnp.asarray(r), jnp.asarray(m), jnp.asarray(v)
+
+
+def make_prelude_epoch_jits(prelude, label: str):
+    """Jitted epoch apply/probe with a fused input run inlined: the
+    upload is the RAW int64 chunk matrix and ``prelude`` (ops/fused.py
+    build_join_prelude) computes the [key_lanes | payload_lanes]
+    matrix INSIDE the dispatch — projection exprs, key normalization
+    and payload bit-encode all trace into the same program that
+    scatters state (donated, exactly like the direct-upload twins)."""
+    def ap(table, chains, pay, raw, aux, key_width):
+        return epoch_apply(table, chains, pay, prelude(raw), aux,
+                           key_width)
+
+    def pr(table, chains, pay, deg_self, deg_sink, raw, aux,
+           key_width, out_cap, with_degrees):
+        return epoch_probe(table, chains, pay, deg_self, deg_sink,
+                           prelude(raw), aux, key_width, out_cap,
+                           with_degrees)
+
+    return (jaxtools.instrumented_jit(
+                ap, f"hash_join.epoch_apply[{label}]",
+                donate_argnums=(0, 1, 2), static_argnums=(5,)),
+            jaxtools.instrumented_jit(
+                pr, f"hash_join.epoch_probe[{label}]",
+                static_argnums=(7, 8, 9)))
 
 
 def apply_and_probe(my_table: ht.TableState, my_chains: ChainState,
@@ -330,6 +508,62 @@ class PendingProbe:
                 np.ascontiguousarray(pairs[:, 1]))
 
 
+class PendingEpochProbe:
+    """An in-flight epoch probe over the payload-widened matrix.
+
+    Like PendingProbe, but parses the packed layout of `epoch_probe`
+    (pair rows carry the probed side's payload lanes and, with
+    degrees, the pre-epoch degree per ref) and installs the updated
+    degree arrays into their owning kernels only once the collect
+    succeeds — an overflow redispatch recomputes them from the
+    original arrays, so a retry never double-counts a transition."""
+
+    def __init__(self, mat, n: int, cap: int, redispatch,
+                 pay_width: int, with_degrees: bool, install, bump):
+        self.mat = mat
+        self.n = n
+        self.cap = cap
+        self.redispatch = redispatch
+        self.pay_width = pay_width
+        self.with_degrees = with_degrees
+        self.install = install        # (deg_self, deg_sink) -> None
+        self.bump = bump
+        self._degs = None             # latest (deg_self, deg_sink)
+
+    def set_degs(self, deg_self, deg_sink) -> None:
+        self._degs = (deg_self, deg_sink)
+
+    def collect(self):
+        """(degrees | None, probe_idx, refs, pay_rows | None,
+        old_deg | None); pairs sorted by probe row index."""
+        n = self.n
+        while True:
+            mat = jaxtools.fetch1(self.mat)
+            total = int(mat[0, 0])
+            if total <= self.cap:
+                break
+            from risingwave_tpu.common.chunk import next_pow2
+            self.cap = max(self.cap * 2, next_pow2(total))
+            if self.bump is not None:
+                self.bump(self.cap)
+            self.mat = self.redispatch(self.cap)
+            jaxtools.start_fetch(self.mat)
+        if self.with_degrees and self._degs is not None:
+            self.install(*self._degs)
+        if self.with_degrees:
+            deg = np.ascontiguousarray(mat[1:1 + n, 0])
+            pairs = mat[1 + n:1 + n + total]
+        else:
+            deg = None
+            pairs = mat[1:1 + total]
+        P = self.pay_width
+        pay = np.ascontiguousarray(pairs[:, 2:2 + P]) if P else None
+        old = np.ascontiguousarray(pairs[:, 2 + P]) \
+            if self.with_degrees else None
+        return (deg, np.ascontiguousarray(pairs[:, 0]),
+                np.ascontiguousarray(pairs[:, 1]), pay, old)
+
+
 class JoinSideKernel:
     """Host wrapper: key table + chain arrays + arena growth.
 
@@ -350,8 +584,15 @@ class JoinSideKernel:
     def __init__(self, key_width: int,
                  key_capacity: int = DEFAULT_CAPACITY,
                  row_capacity: int = DEFAULT_CAPACITY,
-                 probe_capacity: int = 1 << 14):
+                 probe_capacity: int = 1 << 14,
+                 payload_width: int = 0):
         self.key_width = key_width
+        # payload lanes per stored row (3 int32 lanes per device-typed
+        # column — ops/lanes.py payload_i64): written at insert time in
+        # the same dispatch that links chains, gathered ON DEVICE by
+        # the probe's emit walk so matched rows materialize from the
+        # one packed fetch instead of a host arena gather per column
+        self.payload_width = payload_width
         self.table = ht.DeviceHashTable(key_width, key_capacity)
         self.table.on_grow(self._on_table_grow)
         # pair-output buffer rows for the fused probe; doubles on
@@ -362,10 +603,33 @@ class JoinSideKernel:
             next=jnp.full(row_capacity, -1, dtype=jnp.int32),
             ins_seq=jnp.full(row_capacity, I32_MAX, dtype=jnp.int32),
             del_seq=jnp.full(row_capacity, I32_MAX, dtype=jnp.int32))
+        self.pay = jnp.zeros((row_capacity, payload_width),
+                             dtype=jnp.int32)
+        # device-resident per-ref match degrees (outer/semi/anti
+        # bookkeeping): maintained inside the epoch probe dispatches;
+        # unallocated refs are 0 by the bump-allocation invariant
+        self.deg = jnp.zeros(row_capacity, dtype=jnp.int32)
+        # fused-input epoch jits, keyed by prelude label: this kernel
+        # may serve two preludes (its OWN side's on apply, the PROBING
+        # side's on probe)
+        self._prelude_jits: dict = {}
+
+    def _epoch_jits(self, prelude, key: str):
+        jits = self._prelude_jits.get(key)
+        if jits is None:
+            jits = make_prelude_epoch_jits(prelude, key)
+            self._prelude_jits[key] = jits
+        return jits
 
     @property
     def row_capacity(self) -> int:
         return int(self.chains.next.shape[0])
+
+    @property
+    def device_payload_bytes(self) -> int:
+        """HBM bytes held by the payload lane store + degree array
+        (the residency metric's device half)."""
+        return int(self.pay.size + self.deg.size) * 4
 
     # -- growth ----------------------------------------------------------
     def _on_table_grow(self, old_to_new: jnp.ndarray,
@@ -395,6 +659,11 @@ class JoinSideKernel:
             del_seq=jnp.concatenate(
                 [self.chains.del_seq,
                  jnp.full(pad, I32_MAX, dtype=jnp.int32)]))
+        self.pay = jnp.concatenate(
+            [self.pay, jnp.zeros((pad, self.payload_width),
+                                 dtype=jnp.int32)])
+        self.deg = jnp.concatenate(
+            [self.deg, jnp.zeros(pad, dtype=jnp.int32)])
 
     # -- ops --------------------------------------------------------------
     # seq=0 defaults keep kernel-level tests/recovery simple: probes at
@@ -473,38 +742,88 @@ class JoinSideKernel:
                             self._probe_cap, redispatch, bump=bump)
 
     # -- epoch batching ---------------------------------------------------
-    def apply_epoch(self, key_lanes_dev, aux_dev, n_rows: int,
-                    max_ins_ref: int) -> None:
-        """Apply a whole epoch's concatenated inserts/tombstones in one
-        dispatch (aux layout: ops/hash_join.py AUX_*). The lanes/aux
+    def apply_epoch(self, up_dev, aux_dev, n_rows: int,
+                    max_ins_ref: int, prelude=None,
+                    prelude_key: str = "") -> None:
+        """Apply a whole epoch's concatenated inserts/tombstones (and
+        their payload lanes) in one dispatch. ``up_dev`` is the
+        [key_lanes | payload_lanes] upload matrix — or, with a fused
+        input ``prelude``, the raw int64 chunk matrix the prelude
+        turns into that layout in-trace. aux layout AUX_*. The up/aux
         device arrays are shared with probe_epoch — upload once."""
         if max_ins_ref >= 0:
             self.reserve_rows(max_ins_ref)
         self.table.reserve(n_rows)
-        self.table.state, self.chains, ins = _epoch_apply_jit(
-            self.table.state, self.chains, key_lanes_dev, aux_dev)
+        jit = _epoch_apply_jit if prelude is None else \
+            self._epoch_jits(prelude, prelude_key)[0]
+        self.table.state, self.chains, self.pay, ins = jit(
+            self.table.state, self.chains, self.pay, up_dev, aux_dev,
+            self.key_width)
         self.table._counters.push(ins, n_rows)
 
-    def probe_epoch(self, key_lanes_dev, aux_dev,
-                    with_degrees: bool) -> "PendingProbe":
+    def probe_epoch(self, up_dev, aux_dev, with_degrees: bool,
+                    sink: "JoinSideKernel" = None, prelude=None,
+                    prelude_key: str = "") -> "PendingEpochProbe":
         """Probe a whole epoch's rows against THIS side, each row at
-        its aux sequence; call after both sides' apply_epoch."""
+        its aux sequence; call after both sides' apply_epoch. With
+        degrees, ``sink`` is the PROBING side's kernel: this side's
+        degree transitions and the sink's inserted-row initial degrees
+        both update on device in this dispatch (installed at collect —
+        see PendingEpochProbe). ``prelude`` is the PROBING side's
+        fused-input prelude (the uploaded rows are that side's raw
+        matrix)."""
         out_cap = self._probe_cap
+        sink = sink if sink is not None else self
+        probe_jit = _epoch_probe_jit if prelude is None else \
+            self._epoch_jits(prelude, prelude_key)[1]
+        # capture the degree arrays at ENTRY: an overflow redispatch
+        # must recompute from the same pre-probe state (the truncated
+        # first dispatch's adds are discarded wholesale)
+        deg0_self, deg0_sink = self.deg, sink.deg
 
         def dispatch(cap):
-            return _epoch_probe_jit(self.table.state, self.chains,
-                                    key_lanes_dev, aux_dev, cap,
-                                    with_degrees)
+            out = probe_jit(
+                self.table.state, self.chains, self.pay, deg0_self,
+                deg0_sink, up_dev, aux_dev, self.key_width, cap,
+                with_degrees)
+            return out if with_degrees else (out, None, None)
 
-        mat = dispatch(out_cap)
-        jaxtools.start_fetch(mat)
+        def install(d_self, d_sink):
+            self.deg = d_self
+            sink.deg = d_sink
 
         def bump(cap):
             self._probe_cap = max(self._probe_cap, cap)
 
-        return PendingProbe(mat, int(key_lanes_dev.shape[0]), out_cap,
-                            dispatch, with_degrees=with_degrees,
-                            bump=bump)
+        mat, d_self, d_sink = dispatch(out_cap)
+        jaxtools.start_fetch(mat)
+
+        def redispatch(cap):
+            m, ds, dk = dispatch(cap)
+            pending.set_degs(ds, dk)
+            return m
+
+        pending = PendingEpochProbe(
+            mat, int(up_dev.shape[0]), out_cap, redispatch,
+            pay_width=self.payload_width, with_degrees=with_degrees,
+            install=install, bump=bump)
+        if with_degrees:
+            pending.set_degs(d_self, d_sink)
+        return pending
+
+    # -- degrees (device-resident; recovery/reload writes) ---------------
+    def write_degrees(self, refs: np.ndarray, vals: np.ndarray) -> None:
+        """Scatter exact degree values (recovery / cold-tier reload:
+        the degree of a stored row is a pure function of both sides'
+        state, recomputed by one batch probe of the other side)."""
+        if len(refs) == 0:
+            return
+        self.deg = _masked_scatter_jit(
+            self.deg, *_pad_scatter_args(refs, vals))
+
+    def read_degrees(self, refs: np.ndarray) -> np.ndarray:
+        """Degree values by ref (host fetch; compaction-only path)."""
+        return np.asarray(self.deg)[refs].astype(np.int64)
 
     def probe(self, key_lanes: jnp.ndarray, vis: jnp.ndarray,
               seq: Optional[int] = None
@@ -519,8 +838,12 @@ class JoinSideKernel:
         self.chains = _rebase_jit(self.chains)
 
     # -- recovery ---------------------------------------------------------
-    def rebuild(self, key_lanes: np.ndarray, row_refs: np.ndarray) -> None:
-        """Reload all live rows (recovery): one batched insert."""
+    def rebuild(self, key_lanes: np.ndarray, row_refs: np.ndarray,
+                payload: Optional[np.ndarray] = None) -> None:
+        """Reload all live rows (recovery): one batched insert.
+        ``payload`` (int32[n, payload_width]) rebuilds the device
+        payload lanes exactly where the chains rebuild; degrees reset
+        to zero and are recomputed by the caller's batch probe."""
         n = len(row_refs)
         key_cap = max(self.table.capacity,
                       ht.MIN_CAPACITY if n == 0 else
@@ -534,7 +857,13 @@ class JoinSideKernel:
             next=jnp.full(row_cap, -1, dtype=jnp.int32),
             ins_seq=jnp.full(row_cap, I32_MAX, dtype=jnp.int32),
             del_seq=jnp.full(row_cap, I32_MAX, dtype=jnp.int32))
+        self.pay = jnp.zeros((row_cap, self.payload_width),
+                             dtype=jnp.int32)
+        self.deg = jnp.zeros(row_cap, dtype=jnp.int32)
         if n == 0:
             return
         self.insert(jnp.asarray(key_lanes), row_refs,
                     jnp.ones(n, dtype=bool), seq=0)
+        if payload is not None and self.payload_width:
+            self.pay = _masked_scatter_jit(
+                self.pay, *_pad_scatter_args(row_refs, payload))

@@ -72,6 +72,65 @@ def merge_limbs(*limbs: np.ndarray) -> np.ndarray:
     return acc
 
 
+# -- payload lanes: bit-preserving i64 image for stored row columns ---------
+# The join's device-resident payload store (ops/hash_join.py) keeps one
+# (hi, lo, valid) int32 lane triple per device-typed column, indexed by
+# row ref. Unlike key lanes (to_i64 normalizes -0.0 so it GROUPS with
+# 0.0), payload values must round-trip bit-exactly — the device-emit
+# path has to be indistinguishable from a host arena gather.
+
+
+def payload_i64(v, xp=np):
+    """Column values → int64, bit-preserving (xp-generic: the fused
+    join prelude traces this exact implementation under jit)."""
+    dt = np.dtype(v.dtype)
+    if dt == np.float64:
+        return v.view(xp.int64) if xp is np else _jax_bitcast_i64(v)
+    if dt == np.float32:
+        w = v.astype(xp.float64)
+        return w.view(xp.int64) if xp is np else _jax_bitcast_i64(w)
+    return v.astype(xp.int64)
+
+
+def _jax_bitcast_i64(a):
+    import jax
+    return jax.lax.bitcast_convert_type(a, np.int64)
+
+
+def payload_lanes(pairs, xp=np):
+    """[(values, validity | None)] → int32[N, 3p] payload lanes —
+    (hi, lo, valid) per column, NULL values zeroed. THE one encode
+    serving the host paths (_JoinSide payload_rows / payload_from_
+    arena, xp=numpy) and the traced join prelude (xp=jnp) — the
+    device scatter and the emit decode both depend on this exact
+    layout, so there is exactly one copy of it."""
+    out = []
+    for vals, ok in pairs:
+        n = vals.shape[0]
+        okm = xp.ones(n, dtype=bool) if ok is None else ok
+        v64 = xp.where(okm, payload_i64(vals, xp), xp.int64(0))
+        hi, lo = split_i64(v64)
+        out.append(hi)
+        out.append(lo)
+        out.append(okm.astype(xp.int32))
+    if not out:
+        return xp.zeros((0, 0), dtype=xp.int32)
+    return xp.stack(out, axis=1)
+
+
+def decode_payload_i64(v64: np.ndarray, dtype: np.dtype) -> np.ndarray:
+    """Host inverse of payload_i64 (numpy only; runs on the fetched
+    packed probe matrix)."""
+    dtype = np.dtype(dtype)
+    if dtype == np.float64:
+        return v64.view(np.float64)
+    if dtype == np.float32:
+        return v64.view(np.float64).astype(np.float32)
+    if dtype == np.bool_:
+        return v64 != 0
+    return v64.astype(dtype)
+
+
 # -- order-preserving lanes for MIN/MAX -------------------------------------
 
 def _order_u64_from_i64(v: np.ndarray) -> np.ndarray:

@@ -33,7 +33,7 @@ from risingwave_tpu.common.chunk import Column, StreamChunk
 from risingwave_tpu.ops.fused import FusedStages, build_chain_step
 from risingwave_tpu.stream.executor import Executor, ExecutorInfo
 from risingwave_tpu.stream.message import (
-    Message, Watermark, is_chunk,
+    Message, Watermark, is_barrier, is_chunk,
 )
 
 
@@ -72,8 +72,16 @@ class FusedFragmentExecutor(Executor):
         host_same = self.fused_stages.host_noop_eq(chunk)
         if host_same is None:
             host_same = np.ones(chunk.capacity, dtype=bool)
+        # one jitted chain step per chunk IS a device dispatch — count
+        # it (ISSUE 9 bench honesty: absorbing a run into a keyed
+        # executor's epoch dispatches must show up as a drop here)
+        from risingwave_tpu.utils.metrics import STREAMING
+        card = float(chunk.cardinality())
+        STREAMING.device_dispatch.inc(1, executor=self.identity)
+        STREAMING.rows_per_dispatch.observe(card,
+                                            executor=self.identity)
         from risingwave_tpu.stream.trace_ctx import dispatch_span
-        with dispatch_span(self.identity, float(chunk.cardinality())):
+        with dispatch_span(self.identity, card):
             return self._step(tuple(vals), tuple(oks),
                               np.asarray(chunk.visibility),
                               np.asarray(chunk.ops), host_same)
@@ -81,16 +89,23 @@ class FusedFragmentExecutor(Executor):
     async def execute(self) -> AsyncIterator[Message]:
         fs = self.fused_stages
         out_schema = fs.out_schema
+        wm_cols = set(fs.wm_time_cols())
+        first_seen = False
         async for msg in self.input.execute():
             if is_chunk(msg):
+                # synthetic runtime columns (absorbed row_id_gen ids,
+                # watermark thresholds) append host-side and enter the
+                # trace as ordinary device inputs
+                aug = fs.augment(msg)
                 flat_vals, flat_ok, vis, ops, stage_rows = \
-                    self._run_step(msg)
+                    self._run_step(aug)
                 vis = np.asarray(vis)
                 fs.note_stage_rows(np.asarray(stage_rows), 1)
                 if not vis.any():
                     # empty-suppression contract, end to end: the
                     # sequential filter/project would have emitted
-                    # nothing either
+                    # nothing either (and an all-late chunk emits no
+                    # watermark — WatermarkFilterExecutor parity)
                     continue
                 cols: List[Column] = []
                 k = 0
@@ -108,8 +123,25 @@ class FusedFragmentExecutor(Executor):
                     k += 1
                 yield StreamChunk(out_schema, cols, vis,
                                   np.asarray(ops))
+                # the absorbed watermark_filter announces its advanced
+                # watermark after every forwarded chunk, derived
+                # through the later projection stages
+                for wm in fs.post_chunk_watermarks():
+                    for d in fs.derive_watermarks(wm):
+                        yield d
             elif isinstance(msg, Watermark):
+                if msg.col_idx in wm_cols:
+                    # an absorbed watermark_filter owns this column —
+                    # upstream watermarks on it are superseded
+                    continue
                 for wm in fs.derive_watermarks(msg):
                     yield wm
+            elif is_barrier(msg):
+                wms = fs.on_barrier(msg, first=not first_seen)
+                first_seen = True
+                yield msg
+                for wm in wms:
+                    for d in fs.derive_watermarks(wm):
+                        yield d
             else:
                 yield msg

@@ -156,16 +156,18 @@ class _Arena:
 
     def gather(self, refs: np.ndarray, out_cap: int
                ) -> List[Column]:
-        out = []
-        for f, c, v in zip(self.schema, self.cols, self.valid):
-            vals = np.zeros(out_cap, dtype=c.dtype) if c.dtype != object \
-                else np.empty(out_cap, dtype=object)
-            vals[:len(refs)] = c[refs]
-            ok = np.ones(out_cap, dtype=bool)
-            ok[:len(refs)] = v[refs]
-            out.append(Column(f.data_type, vals,
-                              None if ok.all() else ok))
-        return out
+        return [self.gather_col(i, refs, out_cap)
+                for i in range(len(self.schema))]
+
+    def gather_col(self, i: int, refs: np.ndarray,
+                   out_cap: int) -> Column:
+        f, c, v = self.schema[i], self.cols[i], self.valid[i]
+        vals = np.zeros(out_cap, dtype=c.dtype) if c.dtype != object \
+            else np.empty(out_cap, dtype=object)
+        vals[:len(refs)] = c[refs]
+        ok = np.ones(out_cap, dtype=bool)
+        ok[:len(refs)] = v[refs]
+        return Column(f.data_type, vals, None if ok.all() else ok)
 
 
 class _JoinSide:
@@ -179,7 +181,8 @@ class _JoinSide:
     def __init__(self, schema: Schema, key_indices: Sequence[int],
                  pk_indices: Sequence[int], table: StateTable,
                  key_codec: KeyCodec, mesh=None,
-                 shard_opts: Optional[dict] = None):
+                 shard_opts: Optional[dict] = None,
+                 device_payload: bool = True):
         self.schema = schema
         self.key_indices = list(key_indices)
         self.pk_indices = list(pk_indices)
@@ -188,6 +191,27 @@ class _JoinSide:
         # interned ids or varchar keys would never match
         self.key_codec = key_codec
         self.table = table
+        # device-resident payload lanes (ops/hash_join.py): every
+        # device-typed column of a stored row lives in HBM as a
+        # (hi, lo, valid) int32 triple indexed by row ref, written in
+        # the same dispatch that links chains and gathered ON DEVICE
+        # by the probe's emit walk. Varchar/host-typed columns can
+        # never ship to HBM — they stay arena-gathered by ref from the
+        # same packed header. Single-chip epoch path only (the sharded
+        # kernel keeps the per-chunk host-gather shape).
+        self.device_payload = bool(device_payload) and mesh is None
+        self.pay_indices: List[int] = [
+            i for i, f in enumerate(schema) if f.data_type.is_device
+        ] if self.device_payload else []
+        self.pay_pos: Dict[int, int] = {
+            c: k for k, c in enumerate(self.pay_indices)}
+        # fused input run (frontend/opt/fusion.py try_fuse_join):
+        # `schema` above is the run's OUTPUT space; chunks arrive raw,
+        # the composed chain runs once on numpy for host bookkeeping,
+        # and the device prelude re-derives the upload lanes inside
+        # the epoch dispatches (ops/fused.build_join_prelude)
+        self.fused_input = None
+        self._prelude = None
         # device kernel is built LAZILY (first data touch): building it
         # here would initialize the JAX backend — and claim the TPU —
         # in processes that only PLAN (the distributed frontend
@@ -213,8 +237,26 @@ class _JoinSide:
         # the executor drains these into tier.forget after each sweep
         self.expired_lanes: List[tuple] = []
         # per-ref match degree (outer/semi/anti bookkeeping; see
-        # JoinType docstring) — grown alongside the arena
-        self.degrees = np.zeros(self.arena.cap, dtype=np.int64)
+        # JoinType docstring). On the single-chip epoch path the
+        # AUTHORITATIVE copy is the kernel's device array, maintained
+        # inside the probe dispatches (ops/hash_join.epoch_probe) —
+        # this host array then stays empty and emission replays
+        # per-chunk transitions from the packed matrix's old-degree
+        # column. The sharded per-chunk path keeps the host array.
+        self.dev_degrees = mesh is None
+        self.track_degrees = False      # set by the executor (tracked
+        self.degrees = np.zeros(         # sides only)
+            0 if self.dev_degrees else self.arena.cap, dtype=np.int64)
+
+    @property
+    def prelude(self):
+        """Traced lane builder for the fused input run (lazy — builds
+        against the jnp expression layer on first dispatch)."""
+        if self._prelude is None and self.fused_input is not None:
+            from risingwave_tpu.ops.fused import build_join_prelude
+            self._prelude = build_join_prelude(
+                self.fused_input, self.key_indices, self.pay_indices)
+        return self._prelude
 
     @property
     def kernel(self):
@@ -236,6 +278,7 @@ class _JoinSide:
                                  "probe_capacity")}
                 self._kernel = JoinSideKernel(
                     key_width=LANES_PER_KEY * len(self.key_indices),
+                    payload_width=3 * len(self.pay_indices),
                     **opts)
         return self._kernel
 
@@ -257,7 +300,7 @@ class _JoinSide:
         return tuple(self.key_codec.lanes_of_values(vals).tolist())
 
     def ensure_degrees(self, max_ref: int) -> None:
-        if max_ref < len(self.degrees):
+        if self.dev_degrees or max_ref < len(self.degrees):
             return
         grown = np.zeros(self.arena.cap, dtype=np.int64)
         grown[:len(self.degrees)] = self.degrees
@@ -270,6 +313,59 @@ class _JoinSide:
             c.nbytes if c.dtype != object else c.size * 8
             for c in self.arena.cols)
         return arena + self.degrees.nbytes + 120 * len(self.pk_to_ref)
+
+    def host_arena_bytes(self) -> int:
+        """The residency metric's host half (arena columns only)."""
+        return sum(c.nbytes if c.dtype != object else c.size * 8
+                   for c in self.arena.cols)
+
+    # -- device payload lanes (ops/lanes.py payload codecs) ---------------
+    def payload_rows(self, chunk: StreamChunk) -> np.ndarray:
+        """int32[cap, 3*len(pay_indices)] payload lanes for every slot
+        (the device scatter masks non-inserted rows itself)."""
+        from risingwave_tpu.ops.lanes import payload_lanes
+        return payload_lanes(
+            [(np.asarray(chunk.columns[i].values),
+              None if chunk.columns[i].validity is None
+              else np.asarray(chunk.columns[i].validity))
+             for i in self.pay_indices])
+
+    def payload_from_arena(self, refs: np.ndarray) -> np.ndarray:
+        """Payload lanes of stored rows (recovery / compaction /
+        cold-tier reload rebuild the device store from the durable
+        host copy)."""
+        from risingwave_tpu.ops.lanes import payload_lanes
+        return payload_lanes(
+            [(self.arena.cols[i][refs], self.arena.valid[i][refs])
+             for i in self.pay_indices])
+
+    def cols_from_payload(self, pay_rows: np.ndarray,
+                          refs: np.ndarray, out_cap: int
+                          ) -> List[Column]:
+        """Materialize matched rows from the packed probe matrix:
+        device-typed columns decode from the device-gathered payload
+        lanes; varchar/host-typed columns gather from the arena by ref
+        (the only host gathers left on the emit path)."""
+        from risingwave_tpu.ops import lanes as _lanes
+        t = len(refs)
+        out: List[Column] = []
+        for i, f in enumerate(self.schema):
+            k = self.pay_pos.get(i)
+            if k is None:
+                out.append(self.arena.gather_col(i, refs, out_cap))
+                continue
+            hi = pay_rows[:, 3 * k].astype(np.int64)
+            lo = pay_rows[:, 3 * k + 1]
+            v64 = (hi << np.int64(32)) | \
+                lo.view(np.uint32).astype(np.int64)
+            dt = np.dtype(f.data_type.np_dtype)
+            vals = np.zeros(out_cap, dtype=dt)
+            vals[:t] = _lanes.decode_payload_i64(v64, dt)
+            ok = np.ones(out_cap, dtype=bool)
+            ok[:t] = pay_rows[:, 3 * k + 2] != 0
+            out.append(Column(f.data_type, vals,
+                              None if ok.all() else ok))
+        return out
 
     def row_tuple(self, ref: int) -> tuple:
         return tuple(
@@ -405,15 +501,23 @@ class _JoinSide:
         live = np.fromiter(self.pk_to_ref.values(), dtype=np.int64,
                            count=len(self.pk_to_ref))
         n = len(live)
+        # degrees survive a pure compaction: snapshot before the device
+        # rebuild resets them (device mode reads the kernel array; the
+        # cold-tier evict path recomputes on reload instead, but a
+        # stale value at a never-again-probed ref is unobservable)
+        if self.dev_degrees:
+            live_deg = self.kernel.read_degrees(live) \
+                if (self.track_degrees and n) else None
         new_arena = _Arena(self.schema,
                            capacity=max(1024, next_pow2(max(n, 1))))
         for i in range(len(self.schema)):
             new_arena.cols[i][:n] = self.arena.cols[i][live]
             new_arena.valid[i][:n] = self.arena.valid[i][live]
-        new_degrees = np.zeros(new_arena.cap, dtype=np.int64)
-        new_degrees[:n] = self.degrees[live]
+        if not self.dev_degrees:
+            new_degrees = np.zeros(new_arena.cap, dtype=np.int64)
+            new_degrees[:n] = self.degrees[live]
+            self.degrees = new_degrees
         self.arena = new_arena
-        self.degrees = new_degrees
         new_refs = np.arange(n, dtype=np.int32)
         self.pk_to_ref = dict(zip(self.pk_to_ref.keys(), new_refs.tolist()))
         self.free = []
@@ -421,12 +525,17 @@ class _JoinSide:
         if n:
             key_cols = [(self.arena.cols[i][:n], self.arena.valid[i][:n])
                         for i in self.key_indices]
-            self.kernel.rebuild(self.key_codec.build_arrays(key_cols), new_refs)
+            kw = {"payload": self.payload_from_arena(new_refs)} \
+                if self.pay_indices else {}
+            self.kernel.rebuild(
+                self.key_codec.build_arrays(key_cols), new_refs, **kw)
         else:
             self.kernel.rebuild(
                 np.zeros((0, LANES_PER_KEY * len(self.key_indices)),
                          dtype=np.int32),
                 new_refs)
+        if self.dev_degrees and live_deg is not None:
+            self.kernel.write_degrees(new_refs, live_deg)
 
     def expire_below(self, key_pos: int, wm_physical,
                      seq: int = 0) -> int:
@@ -588,15 +697,21 @@ class _JoinSide:
         for row, ref in zip(rows, refs.tolist()):
             self.pk_to_ref[tuple(row[i] for i in self.pk_indices)] = ref
         cap = next_pow2(n)
-        lanes = np.zeros((cap, LANES_PER_KEY * len(self.key_indices)),
-                         dtype=np.int32)
-        lanes[:n] = np.asarray(lanes_rows, dtype=np.int32)
+        w = LANES_PER_KEY * len(self.key_indices)
+        up = np.zeros((cap, w + 3 * len(self.pay_indices)),
+                      dtype=np.int32)
+        up[:n, :w] = np.asarray(lanes_rows, dtype=np.int32)
+        if self.pay_indices:
+            # reloaded rows' payload lanes rebuild from the arena copy
+            # just stored above — the same scatter shape as a live
+            # insert
+            up[:n, w:] = self.payload_from_arena(refs)
         aux = np.zeros((cap, 4), dtype=np.int32)
         aux[:n, 0] = refs
         aux[:n, 2] = FLAG_INS
         # seq 0: reloaded rows predate every live sequence, so every
         # probe of this epoch sees them
-        return lanes, aux, n, int(refs.max())
+        return up, aux, n, int(refs.max())
 
     def recover(self) -> None:
         keys_l, refs_l = [], []
@@ -629,8 +744,13 @@ class _JoinSide:
         keep = [j for j, row in enumerate(rows)
                 if all(row[i] is not None for i in self.key_indices)]
         if keep:
+            # device payload lanes rebuild exactly where the chains
+            # rebuild — from the recovered arena rows (the sharded
+            # kernel has no payload store; don't pass the kwarg)
+            kw = {"payload": self.payload_from_arena(refs[keep])} \
+                if self.pay_indices else {}
             self.kernel.rebuild(np.stack([keys_l[j] for j in keep]),
-                                refs[keep])
+                                refs[keep], **kw)
 
 
 class HashJoinExecutor(Executor):
@@ -643,7 +763,8 @@ class HashJoinExecutor(Executor):
                  output_names: Optional[Sequence[str]] = None,
                  join_type: JoinType = JoinType.INNER,
                  mesh=None, shard_opts: Optional[dict] = None,
-                 state_cap: Optional[int] = None):
+                 state_cap: Optional[int] = None,
+                 device_payload: bool = True):
         assert len(left_keys) == len(right_keys)
         self.left_in, self.right_in = left, right
         self.join_type = join_type
@@ -652,17 +773,24 @@ class HashJoinExecutor(Executor):
         # inputs and must reproduce this exact configuration
         self.rebuild_opts = {"actor_id": actor_id, "mesh": mesh,
                              "shard_opts": shard_opts,
-                             "state_cap": state_cap}
+                             "state_cap": state_cap,
+                             "device_payload": device_payload}
         key_codec = KeyCodec(
             [left.schema[i].data_type for i in left_keys])
+        # device_payload=False forces the host-gather emit path (the
+        # bit-identity oracle's off arm; also exposed for debugging)
         self.sides = (
             _JoinSide(left.schema, left_keys, left_table.pk_indices,
                       left_table, key_codec, mesh=mesh,
-                      shard_opts=shard_opts),
+                      shard_opts=shard_opts,
+                      device_payload=device_payload),
             _JoinSide(right.schema, right_keys, right_table.pk_indices,
                       right_table, key_codec, mesh=mesh,
-                      shard_opts=shard_opts),
+                      shard_opts=shard_opts,
+                      device_payload=device_payload),
         )
+        for i, side in enumerate(self.sides):
+            side.track_degrees = i in join_type.tracked_sides
         n_left = len(left.schema)
         names = list(output_names) if output_names else None
         subj = join_type.subject
@@ -811,16 +939,95 @@ class HashJoinExecutor(Executor):
         return np.where(is_ins, int(Op.INSERT),
                         int(Op.DELETE)).astype(np.int8)
 
+    # -- fragment fusion (frontend/opt/fusion.py mutates a copy) ----------
+    def drain_stage_metrics(self):
+        """Per-logical-stage attribution of the fused input runs for
+        the monitor (side-tagged — both sides may absorb a same-kind
+        stage)."""
+        out = []
+        for tag, side in (("L", self.sides[0]), ("R", self.sides[1])):
+            if side.fused_input is not None:
+                out.extend(
+                    (f"{tag}:{ident}", rows, chunks)
+                    for ident, rows, chunks
+                    in side.fused_input.drain_stage_metrics())
+        return out
+
+    def adopt_fused_input(self, side_idx: int, fs, base) -> None:
+        """Absorb a filter/project/row_id_gen run on one input side:
+        ``base`` becomes the direct input and ``fs`` (whose out_schema
+        must equal the side schema this join was planned against)
+        runs as a numpy composed pass for host bookkeeping plus a
+        traced prelude inside the side's epoch dispatches. Only valid
+        on the single-chip epoch path before any data flows."""
+        from risingwave_tpu.frontend.opt.fusion import (
+            join_side_fusable_reason,
+        )
+        r = join_side_fusable_reason(self, side_idx)
+        if r is not None:
+            raise ValueError(f"join side is not fusion-eligible: {r}")
+        side = self.sides[side_idx]
+        got = [f.data_type for f in fs.out_schema]
+        want = [f.data_type for f in side.schema]
+        if got != want:
+            raise ValueError(
+                f"fused input run emits {got}, join side planned on "
+                f"{want}")
+        side.fused_input = fs
+        if side_idx == 0:
+            self.left_in = base
+        else:
+            self.right_in = base
+
+    def _run_fused_input(self, side_idx: int, chunk: StreamChunk):
+        """The host half of a fused input side: augment (runtime
+        columns), run the composed chain ONCE on numpy (the same
+        implementation the device prelude traces — no drifting twin),
+        reattach host passthrough columns, and encode the raw matrix
+        the epoch dispatches consume. Returns (post_chunk, raw) or
+        None when every row filtered out (empty-suppression
+        contract)."""
+        from risingwave_tpu.ops.fused import encode_raw_chunk
+        fs = self.sides[side_idx].fused_input
+        aug = fs.augment(chunk)
+        host_same = fs.host_noop_eq(aug)
+        out_cols, vis2, ops2, stage_rows = fs.chain_body(
+            list(aug.columns), np.asarray(aug.visibility),
+            np.asarray(aug.ops), np, host_same=host_same)
+        fs.note_stage_rows(np.asarray(stage_rows), 1)
+        if not vis2.any():
+            return None
+        cols: List[Column] = []
+        for j, f in enumerate(fs.out_schema):
+            host_src = fs.host_out.get(j)
+            if host_src is not None:
+                src = aug.columns[host_src]
+                cols.append(Column(f.data_type, src.values,
+                                   src.validity))
+            else:
+                cols.append(out_cols[j])
+        post = StreamChunk(fs.out_schema, cols, vis2, ops2)
+        return post, encode_raw_chunk(aug, fs.ref_cols)
+
     def _pairs_chunk(self, side_idx: int, chunk: StreamChunk,
-                     probe_idx: np.ndarray, refs: np.ndarray
-                     ) -> StreamChunk:
+                     probe_idx: np.ndarray, refs: np.ndarray,
+                     pay: Optional[np.ndarray] = None) -> StreamChunk:
         t = len(probe_idx)
         cap = next_pow2(t)
         me = self.sides[side_idx]
         other = self.sides[1 - side_idx]
+        # matched stored rows: device columns decode from the payload
+        # lanes the probe's emit walk gathered ON DEVICE (one packed
+        # fetch); only varchar/host columns still gather from the
+        # arena by ref. pay is None on the sharded per-chunk path and
+        # with device_payload off — full arena gather as before.
+        if pay is not None and other.pay_indices:
+            other_cols = other.cols_from_payload(pay, refs, cap)
+        else:
+            other_cols = other.arena.gather(refs, cap)
         return self._compose(
             side_idx, self._chunk_cols(me.schema, chunk, probe_idx, cap),
-            other.arena.gather(refs, cap),
+            other_cols,
             self._ops_of(chunk, probe_idx), t, cap)
 
     def _padded_from_chunk(self, side_idx: int, chunk: StreamChunk,
@@ -871,7 +1078,8 @@ class HashJoinExecutor(Executor):
         return StreamChunk(self.schema, cols, vis, ops)
 
     def _ingest_chunk(self, side_idx: int, chunk: StreamChunk,
-                      key_lanes, nonnull: np.ndarray) -> None:
+                      key_lanes, nonnull: np.ndarray,
+                      raw: Optional[np.ndarray] = None) -> None:
         """Ingest side: host bookkeeping per chunk; device work either
         dispatches per chunk (sharded kernel) or buffers for the ONE
         epoch dispatch at the barrier (single-chip; sequence versioning
@@ -881,7 +1089,7 @@ class HashJoinExecutor(Executor):
         seq = self._seq
         self._seq += 1
         probe_vis = np.asarray(chunk.visibility) & nonnull
-        if self._tier is not None:
+        if self._tier is not None and key_lanes is not None:
             rows = np.flatnonzero(probe_vis)
             if len(rows):
                 uniq = list(map(tuple, np.unique(
@@ -918,28 +1126,44 @@ class HashJoinExecutor(Executor):
                  0))
             return
         from risingwave_tpu.ops.hash_join import (
-            FLAG_DEL, FLAG_INS, FLAG_PROBE,
+            FLAG_DEL, FLAG_INS, FLAG_NEG, FLAG_PROBE,
         )
         n = chunk.capacity
+        ops = np.asarray(chunk.ops)
+        neg = (ops != int(Op.INSERT)) & (ops != int(Op.UPDATE_INSERT))
         aux = np.zeros((n, 4), dtype=np.int32)
         aux[:, 0] = full_refs
         aux[:, 1] = del_refs
         aux[:, 2] = (probe_vis * FLAG_PROBE + ins_mask * FLAG_INS
-                     + del_mask * FLAG_DEL)
+                     + del_mask * FLAG_DEL + neg * FLAG_NEG)
         aux[:, 3] = seq
         off = self._epoch_rows[side_idx]
         self._pending.append(
             (side_idx, chunk, nonnull, None, ins_idx, ins_refs, off))
+        if raw is not None:
+            # fused input side: the RAW int64 matrix is the upload —
+            # the side's prelude rebuilds [key | payload] lanes inside
+            # the epoch dispatches
+            up = raw
+        elif me.pay_indices:
+            # [key lanes | payload lanes]: ONE upload matrix per side
+            # per epoch carries both — the apply scatter writes the
+            # payload rows where it links the chains
+            up = np.concatenate(
+                [np.asarray(key_lanes), me.payload_rows(chunk)],
+                axis=1)
+        else:
+            up = np.asarray(key_lanes)
         self._epoch_buf[side_idx].append(
-            (np.asarray(key_lanes), aux,
-             int(ins_refs.max()) if len(ins_refs) else -1))
+            (up, aux, int(ins_refs.max()) if len(ins_refs) else -1))
         self._epoch_rows[side_idx] = off + n
 
     def _dispatch_epoch(self) -> Dict[int, tuple]:
         """Ship each side's buffered epoch as 2 uploads + 1 apply + 1
         probe dispatch, then collect both probes (overlapped DMAs).
-        Returns {side: (deg|None, probe_idx, refs)} in the CONCATENATED
-        row space; _emit_pending slices per chunk by offset."""
+        Returns {side: (deg|None, probe_idx, refs, pay, old_deg)} in
+        the CONCATENATED row space; _emit_pending slices per chunk by
+        offset."""
         import jax
         self._reload_cold()
         devs: Dict[int, tuple] = {}
@@ -950,17 +1174,29 @@ class HashJoinExecutor(Executor):
             total = self._epoch_rows[s]
             cap = next_pow2(total)
             w = buf[0][0].shape[1]
-            lanes = np.zeros((cap, w), dtype=np.int32)
+            # fused input sides buffer int64 RAW matrices; direct
+            # sides buffer int32 [key | payload] lanes
+            up = np.zeros((cap, w), dtype=buf[0][0].dtype)
             aux = np.zeros((cap, 4), dtype=np.int32)
             at = 0
             max_ref = -1
             for lan, a, mr in buf:
-                lanes[at:at + lan.shape[0]] = lan
+                up[at:at + lan.shape[0]] = lan
                 aux[at:at + a.shape[0]] = a
                 at += lan.shape[0]
                 max_ref = max(max_ref, mr)
-            devs[s] = (jax.device_put(lanes), jax.device_put(aux),
+            devs[s] = (jax.device_put(up), jax.device_put(aux),
                        total, max_ref)
+
+        def _prelude_kw(s: int) -> dict:
+            """The UPLOADING side's fused-input prelude (if any),
+            for both its apply and its probe of the other side."""
+            side = self.sides[s]
+            if side.fused_input is None:
+                return {}
+            return {"prelude": side.prelude,
+                    "prelude_key": f"side{s}:{id(side.fused_input)}"}
+
         # both applies land before either probe dispatches: a probe at
         # seq s must see the other side's same-epoch rows with seq < s
         for s, (ld, ad, total, max_ref) in devs.items():
@@ -975,12 +1211,29 @@ class HashJoinExecutor(Executor):
             with dispatch_span(self.identity, float(total),
                                site="epoch_apply", side=s):
                 self.sides[s].kernel.apply_epoch(ld, ad, total,
-                                                 max_ref)
+                                                 max_ref,
+                                                 **_prelude_kw(s))
         with_deg = self.join_type != JoinType.INNER
-        probes = {s: self.sides[1 - s].kernel.probe_epoch(ld, ad,
-                                                          with_deg)
-                  for s, (ld, ad, _t, _m) in devs.items()}
-        return {s: p.collect() for s, p in probes.items()}
+        if not with_deg:
+            # inner (the hot path): both probes dispatch before either
+            # collects, so the two d2h DMAs overlap
+            probes = {s: self.sides[1 - s].kernel.probe_epoch(
+                ld, ad, False, sink=self.sides[s].kernel,
+                **_prelude_kw(s))
+                for s, (ld, ad, _t, _m) in devs.items()}
+            return {s: p.collect() for s, p in probes.items()}
+        # degree-tracked joins: each probe updates BOTH sides' device
+        # degree arrays (transitions on the probed side, inserted-row
+        # inits on the probing side), and a pair-buffer overflow
+        # truncates the first dispatch's adds — so probe 2 must only
+        # dispatch after probe 1's collect has installed its final
+        # arrays. One sync point per epoch, tracked joins only.
+        out: Dict[int, tuple] = {}
+        for s, (ld, ad, _t, _m) in devs.items():
+            out[s] = self.sides[1 - s].kernel.probe_epoch(
+                ld, ad, True, sink=self.sides[s].kernel,
+                **_prelude_kw(s)).collect()
+        return out
 
     def _tier_register(self) -> None:
         """Register both sides with the global tier at execute() start
@@ -1023,6 +1276,7 @@ class HashJoinExecutor(Executor):
         rows had never left."""
         from risingwave_tpu.ops.hash_join import FLAG_PROBE
         import jax
+        kw = LANES_PER_KEY * len(self.sides[0].key_indices)
         need: List[Dict[tuple, tuple]] = [{}, {}]
         for s in (0, 1):
             other = self.sides[1 - s]
@@ -1030,7 +1284,9 @@ class HashJoinExecutor(Executor):
                 continue
             for lan, aux, _mr in self._epoch_buf[s]:
                 rows = np.flatnonzero(aux[:, 2] & FLAG_PROBE)
-                for t in map(tuple, lan[rows].tolist()):
+                # the buffered upload matrix is [key lanes | payload
+                # lanes]: cold-key lookups read the key slice only
+                for t in map(tuple, lan[rows, :kw].tolist()):
                     v = other.cold_keys.get(t)
                     if v is not None:
                         need[1 - s][t] = v
@@ -1049,14 +1305,14 @@ class HashJoinExecutor(Executor):
                 continue
             loaded = self.sides[s].reload_keys(need[s])
             if loaded is not None:
-                lanes, aux2, n, max_ref = loaded
+                up, aux2, n, max_ref = loaded
                 self.sides[s].kernel.apply_epoch(
-                    jax.device_put(lanes), jax.device_put(aux2), n,
+                    jax.device_put(up), jax.device_put(aux2), n,
                     max_ref)
-                reloaded[s] = (lanes, aux2, n)
+                reloaded[s] = (up, aux2, n)
                 if self._tier is not None:
                     part = self._tier_parts[s]
-                    uniq = np.unique(lanes[:n], axis=0)
+                    uniq = np.unique(up[:n, :kw], axis=0)
                     self._tier.touch(part,
                                      map(tuple, uniq.tolist()),
                                      self._tier_seq)
@@ -1066,51 +1322,72 @@ class HashJoinExecutor(Executor):
             rl = reloaded[t_side]
             if rl is None:
                 continue
-            lanes, aux2, n = rl
+            up, aux2, n = rl
             refs = aux2[:n, 0].astype(np.int64)
             deg, _pi, _refs = self.sides[1 - t_side].kernel.probe(
-                lanes[:n], np.ones(n, dtype=bool))
+                up[:n, :kw], np.ones(n, dtype=bool))
             side = self.sides[t_side]
-            side.ensure_degrees(int(refs.max()))
-            side.degrees[refs] = deg[:n]
+            if side.dev_degrees:
+                # reloaded rows' degrees recompute by one batch probe
+                # and scatter straight into the device degree array
+                side.kernel.write_degrees(
+                    refs.astype(np.int32), deg[:n])
+            else:
+                side.ensure_degrees(int(refs.max()))
+                side.degrees[refs] = deg[:n]
 
     def _emit_pending(self) -> List[StreamChunk]:
         """Barrier sweep: collect the epoch's probes and run emission
         in message order. Degree bookkeeping happens here, in the same
-        order the chunks were applied."""
+        order the chunks were applied — on the epoch path it replays
+        from the packed matrix's old-degree column (the device array
+        is the store; see _emit_one)."""
         outs: List[StreamChunk] = []
         results = self._dispatch_epoch() if self._epoch_batch \
             and (self._epoch_buf[0] or self._epoch_buf[1]) else {}
+        # per-epoch replay of stored-row degrees, keyed (side, ref):
+        # seeded lazily from the matrix old column, written through by
+        # inserted-row inits and per-chunk transition deltas
+        self._deg_replay: Dict[Tuple[int, int], int] = {}
         for (side_idx, chunk, nonnull, handle, ins_idx,
              ins_refs, off) in self._pending:
             n = chunk.capacity
             deg = None
             probe_idx = np.zeros(0, dtype=np.int32)
             refs = np.zeros(0, dtype=np.int32)
+            pay = None
+            old = None
             if handle is not None:
                 deg_p, probe_idx, refs = handle.collect()
                 deg = np.zeros(n, dtype=np.int64)
                 deg[:len(deg_p)] = deg_p
             elif side_idx in results:
-                d_s, p_s, r_s = results[side_idx]
+                d_s, p_s, r_s, pay_s, old_s = results[side_idx]
                 lo = np.searchsorted(p_s, off)
                 hi = np.searchsorted(p_s, off + n)
                 probe_idx = (p_s[lo:hi] - off).astype(np.int32)
                 refs = r_s[lo:hi]
+                if pay_s is not None:
+                    pay = pay_s[lo:hi]
+                if old_s is not None:
+                    old = old_s[lo:hi].astype(np.int64)
                 if d_s is not None:
                     deg = d_s[off:off + n].astype(np.int64)
             outs.extend(self._emit_one(side_idx, chunk, nonnull, deg,
                                        probe_idx, refs, ins_idx,
-                                       ins_refs))
+                                       ins_refs, pay, old))
         self._pending.clear()
         self._epoch_buf = ([], [])
         self._epoch_rows = [0, 0]
+        self._deg_replay = {}
         return outs
 
     def _emit_one(self, side_idx: int, chunk: StreamChunk,
                   nonnull: np.ndarray, deg: Optional[np.ndarray],
                   probe_idx: np.ndarray, refs: np.ndarray,
-                  ins_idx: np.ndarray, ins_refs: np.ndarray
+                  ins_idx: np.ndarray, ins_refs: np.ndarray,
+                  pay: Optional[np.ndarray] = None,
+                  old: Optional[np.ndarray] = None
                   ) -> List[StreamChunk]:
         """Emission per eq_join_oneside (hash_join.rs:990) generalized
         to the degree-transition rule: a stored outer row flips its
@@ -1119,7 +1396,11 @@ class HashJoinExecutor(Executor):
         within one chunk cancel, leaving the same multiset).
 
         `deg` is None exactly when the join is INNER (the slim probe
-        skips degrees; no emission rule below reads them)."""
+        skips degrees; no emission rule below reads them). On the
+        epoch path `pay` carries the matched refs' device-gathered
+        payload lanes and `old` their pre-epoch degrees — the replay
+        dict in _emit_pending reconstructs each chunk's old/new
+        exactly as the host degrees array used to."""
         jt = self.join_type
         me = self.sides[side_idx]
         other = self.sides[1 - side_idx]
@@ -1131,7 +1412,7 @@ class HashJoinExecutor(Executor):
         # 1) matched pairs (all types except semi/anti)
         if jt.subject is None and len(probe_idx):
             outs.append(self._pairs_chunk(side_idx, chunk, probe_idx,
-                                          refs))
+                                          refs, pay))
         # 2) incoming-row direct emissions
         if jt.outer_on(side_idx):
             # NULL-key rows of an outer side always emit padded
@@ -1153,11 +1434,33 @@ class HashJoinExecutor(Executor):
             uref, inv = np.unique(refs, return_inverse=True)
             delta = np.zeros(len(uref), dtype=np.int64)
             np.add.at(delta, inv, sgn)
-            old = other.degrees[uref]
-            new = old + delta
-            other.degrees[uref] = new
-            flip_on = uref[(old == 0) & (new > 0)]
-            flip_off = uref[(old > 0) & (new == 0)]
+            if other.dev_degrees:
+                # seed from the matrix's pre-epoch value on first
+                # touch; later chunks read the replay dict (exactly
+                # the running value the host array used to hold)
+                seed = np.zeros(len(uref), dtype=np.int64)
+                if old is not None and len(old):
+                    first = np.zeros(len(uref), dtype=np.int64)
+                    # inv maps pair → uref slot; any pair of the ref
+                    # carries the same old value
+                    first[inv] = old
+                    seed = first
+                rep = self._deg_replay
+                key = 1 - side_idx
+                cur = np.fromiter(
+                    (rep.get((key, int(r)), int(s))
+                     for r, s in zip(uref.tolist(), seed.tolist())),
+                    dtype=np.int64, count=len(uref))
+                new = cur + delta
+                for r, v in zip(uref.tolist(), new.tolist()):
+                    rep[(key, int(r))] = int(v)
+                old_v = cur
+            else:
+                old_v = other.degrees[uref]
+                new = old_v + delta
+                other.degrees[uref] = new
+            flip_on = uref[(old_v == 0) & (new > 0)]
+            flip_off = uref[(old_v > 0) & (new == 0)]
             if jt.subject is not None:       # semi/anti subject = other
                 on_op = Op.DELETE if jt.is_anti else Op.INSERT
                 off_op = Op.INSERT if jt.is_anti else Op.DELETE
@@ -1174,10 +1477,18 @@ class HashJoinExecutor(Executor):
                     outs.append(self._padded_from_arena(
                         1 - side_idx, flip_off, Op.INSERT))
         # 4) initial degrees for the rows this chunk stored (the state
-        # apply already ran at dispatch; deg is the probe-time count)
+        # apply already ran at dispatch; deg is the probe-time count;
+        # the device array already took the same init via the probe's
+        # scatter-add — only the replay dict needs the values here)
         if side_idx in jt.tracked_sides and len(ins_idx):
-            # degrees array already grown by apply_chunk at dispatch
-            me.degrees[ins_refs] = deg[ins_idx]
+            if me.dev_degrees:
+                rep = self._deg_replay
+                for r, v in zip(ins_refs.tolist(),
+                                deg[ins_idx].tolist()):
+                    rep[(side_idx, int(r))] = int(v)
+            else:
+                # degrees array already grown by apply_chunk at dispatch
+                me.degrees[ins_refs] = deg[ins_idx]
         return outs
 
     # -- watermarks -------------------------------------------------------
@@ -1291,8 +1602,12 @@ class HashJoinExecutor(Executor):
             for _vals, ok in key_cols:
                 nonnull &= ok
             deg, _pi, _refs = other.kernel.probe(lanes_, nonnull)
-            side.ensure_degrees(int(refs.max()))
-            side.degrees[refs] = np.where(nonnull, deg, 0)
+            if side.dev_degrees:
+                side.kernel.write_degrees(
+                    refs.astype(np.int32), np.where(nonnull, deg, 0))
+            else:
+                side.ensure_degrees(int(refs.max()))
+                side.degrees[refs] = np.where(nonnull, deg, 0)
         # NOTE: host-typed arena key cols may contain None for NULL keys
         # — build_arrays handles them (interner sanitization)
 
@@ -1356,6 +1671,24 @@ class HashJoinExecutor(Executor):
                         if not swept:
                             side.maybe_compact()
                     self._maybe_gc_interner()
+                    # payload residency: device lane bytes vs host
+                    # arena bytes, refreshed once per barrier (the
+                    # auditable half of "ship refs, not rows")
+                    dev_b = sum(
+                        s.kernel.device_payload_bytes
+                        for s in self.sides
+                        if s._kernel is not None and s._mesh is None)
+                    _METRICS.join_device_bytes.set(
+                        dev_b, executor=self.identity)
+                    _METRICS.join_host_bytes.set(
+                        sum(s.host_arena_bytes() for s in self.sides),
+                        executor=self.identity)
+                    for side in self.sides:
+                        if side.fused_input is not None:
+                            # absorbed-runtime barrier work (row-id
+                            # counters rebase to the epoch floor; join
+                            # runs carry no watermark stages)
+                            side.fused_input.on_barrier(msg)
                     if self._seq > (1 << 30):
                         # int32 sequence headroom: with no probes in
                         # flight, rebase every finite seq to 0 and restart
@@ -1366,17 +1699,37 @@ class HashJoinExecutor(Executor):
                     yield msg
                 elif tag in ("left", "right"):
                     i = 0 if tag == "left" else 1
+                    side = self.sides[i]
                     if isinstance(msg, StreamChunk):
+                        if side.fused_input is not None:
+                            # fused input run: composed numpy pass for
+                            # bookkeeping, raw matrix buffered for the
+                            # in-dispatch prelude
+                            r = self._run_fused_input(i, msg)
+                            if r is None:
+                                continue
+                            post, raw = r
+                            self._ingest_chunk(
+                                i, post, None,
+                                side.key_nonnull_mask(post), raw=raw)
+                            continue
                         # one host→device upload of the key lanes (inside
                         # the kernel's fused dispatch), shared by the probe
                         # and this side's insert; the nonnull mask falls
                         # out of the same pass
                         lanes_np, nonnull = \
-                            self.sides[i].key_codec.build_with_mask(
-                                msg, self.sides[i].key_indices)
+                            side.key_codec.build_with_mask(
+                                msg, side.key_indices)
                         self._ingest_chunk(i, msg, lanes_np, nonnull)
                     elif isinstance(msg, Watermark):
-                        wms = list(self._on_watermark(i, msg))
+                        # a fused input side receives watermarks in the
+                        # RUN's input space — derive them through the
+                        # absorbed projection stages first
+                        derived = [msg] if side.fused_input is None \
+                            else side.fused_input.derive_watermarks(msg)
+                        wms: List = []
+                        for one in derived:
+                            wms.extend(self._on_watermark(i, one))
                         if wms:
                             # buffered join outputs must precede any
                             # watermark that could close windows over them

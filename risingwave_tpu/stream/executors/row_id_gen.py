@@ -37,22 +37,37 @@ _SHARD_BITS = 10
 _SEQ_BITS = 12
 
 
-class RowIdGenExecutor(Executor):
-    """Appends `_row_id` (SERIAL) as the last column (row_id_gen.rs)."""
+class RowIdCounter:
+    """The id counter alone — the runtime of a `row_id_gen` stage
+    absorbed into a fused run (ops/fused.py). RowIdGenExecutor IS one
+    (plus the executor loop), so host fusion hands the executor itself
+    to the stage while worker-side IR rebuilds construct a bare
+    counter; both share this one id layout and rebase rule."""
 
-    def __init__(self, input_: Executor, vnode_base: int = 0):
-        schema = Schema(list(input_.schema.fields) + [ROW_ID_FIELD])
-        info = ExecutorInfo(schema, [len(input_.schema)], "RowIdGenExecutor")
-        super().__init__(info)
-        self.input = input_
+    def __init__(self, vnode_base: int = 0):
         assert 0 <= vnode_base < (1 << _SHARD_BITS)
         self._shard = vnode_base << (63 - _SHARD_BITS)
         self._next = self._shard
+
+    @property
+    def vnode_base(self) -> int:
+        return self._shard >> (63 - _SHARD_BITS)
 
     def _rebase(self, epoch_value: int) -> None:
         floor = self._shard | ((epoch_value >> 16) << _SEQ_BITS)
         if self._next < floor:
             self._next = floor
+
+
+class RowIdGenExecutor(RowIdCounter, Executor):
+    """Appends `_row_id` (SERIAL) as the last column (row_id_gen.rs)."""
+
+    def __init__(self, input_: Executor, vnode_base: int = 0):
+        schema = Schema(list(input_.schema.fields) + [ROW_ID_FIELD])
+        info = ExecutorInfo(schema, [len(input_.schema)], "RowIdGenExecutor")
+        Executor.__init__(self, info)
+        RowIdCounter.__init__(self, vnode_base)
+        self.input = input_
 
     async def execute(self) -> AsyncIterator[Message]:
         async for msg in self.input.execute():

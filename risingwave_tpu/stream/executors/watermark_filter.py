@@ -29,17 +29,13 @@ WATERMARK_STATE_SCHEMA = Schema([Field("pk", DataType.INT16),
                                  Field("watermark", DataType.TIMESTAMP)])
 
 
-class WatermarkFilterExecutor(Executor):
-    """Event-time watermark generator + late-row filter."""
+class WatermarkRuntime:
+    """The watermark value + its persistence alone — the runtime of a
+    `watermark_filter` stage absorbed into a fused run (ops/fused.py).
+    WatermarkFilterExecutor IS one (plus the executor loop); worker-
+    side IR rebuilds construct the bare runtime."""
 
-    def __init__(self, input_: Executor, time_col: int, delay: Interval,
-                 state: Optional[StateTable] = None):
-        super().__init__(ExecutorInfo(
-            input_.schema, list(input_.pk_indices),
-            "WatermarkFilterExecutor"))
-        self.input = input_
-        self.time_col = time_col
-        self.delay = delay.usecs
+    def __init__(self, state: Optional[StateTable] = None):
         self.state = state
         self.current: Optional[int] = None
 
@@ -52,6 +48,20 @@ class WatermarkFilterExecutor(Executor):
             self.state.insert(row)
         elif tuple(old) != row:
             self.state.update(tuple(old), row)
+
+
+class WatermarkFilterExecutor(WatermarkRuntime, Executor):
+    """Event-time watermark generator + late-row filter."""
+
+    def __init__(self, input_: Executor, time_col: int, delay: Interval,
+                 state: Optional[StateTable] = None):
+        Executor.__init__(self, ExecutorInfo(
+            input_.schema, list(input_.pk_indices),
+            "WatermarkFilterExecutor"))
+        WatermarkRuntime.__init__(self, state)
+        self.input = input_
+        self.time_col = time_col
+        self.delay = delay.usecs
 
     async def execute(self) -> AsyncIterator[Message]:
         first_seen = False

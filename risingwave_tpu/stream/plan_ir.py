@@ -169,9 +169,12 @@ def expr_from_ir(d: dict) -> Expression:
     raise TypeError(f"unknown expression IR {t!r}")
 
 
-def stages_from_ir(in_schema: Schema, stages_ir: List[dict]):
+def stages_from_ir(in_schema: Schema, stages_ir: List[dict],
+                   store=None):
     """IR stage list → FusedStages (the worker-side half of the
-    fragmenter's _stages_ir)."""
+    fragmenter's _stages_ir). ``store`` backs the bare runtimes of
+    absorbed row_id_gen / watermark_filter stages (their host-only
+    executor handles never serialize)."""
     from risingwave_tpu.ops.fused import FusedStage, FusedStages
     stages = []
     for st in stages_ir:
@@ -179,11 +182,34 @@ def stages_from_ir(in_schema: Schema, stages_ir: List[dict]):
             stages.append(FusedStage(
                 "filter", "FilterExecutor",
                 exprs=(expr_from_ir(st["pred"]),)))
-        else:
+        elif st["kind"] == "project":
             stages.append(FusedStage(
                 "project", "ProjectExecutor",
                 exprs=tuple(expr_from_ir(e) for e in st["exprs"]),
                 names=tuple(st["names"])))
+        elif st["kind"] == "row_id_gen":
+            from risingwave_tpu.stream.executors.row_id_gen import (
+                RowIdCounter,
+            )
+            stages.append(FusedStage(
+                "row_id_gen", "RowIdGenExecutor",
+                runtime=RowIdCounter(int(st.get("vnode_base", 0)))))
+        elif st["kind"] == "watermark_filter":
+            from risingwave_tpu.state.state_table import StateTable
+            from risingwave_tpu.stream.executors.watermark_filter \
+                import WATERMARK_STATE_SCHEMA, WatermarkRuntime
+            wm_state = None
+            if st.get("table_id") is not None and store is not None:
+                wm_state = StateTable(int(st["table_id"]),
+                                      WATERMARK_STATE_SCHEMA, [0],
+                                      store)
+            stages.append(FusedStage(
+                "watermark_filter", "WatermarkFilterExecutor",
+                time_col=int(st["time_col"]),
+                delay_usecs=int(st["delay_usecs"]),
+                runtime=WatermarkRuntime(wm_state)))
+        else:
+            raise TypeError(f"unknown fused stage IR {st['kind']!r}")
     return FusedStages(in_schema, stages)
 
 
@@ -205,6 +231,16 @@ def remap_node_refs(node: dict, remap: Dict[int, int]) -> dict:
     if isinstance(n2.get("inputs"), list):
         n2["inputs"] = [remap[i] for i in n2["inputs"]]
     return n2
+
+
+class _SchemaShim:
+    """Placeholder input for constructing a HashJoinExecutor whose
+    side schema is a fused run's OUTPUT space — adopt_fused_input
+    swaps in the real raw child right after construction."""
+
+    def __init__(self, schema: Schema):
+        self.schema = schema
+        self.pk_indices: List[int] = []
 
 
 def schema_to_ir(schema: Schema) -> List[dict]:
@@ -292,7 +328,8 @@ def build_fragment(nodes: List[dict], store, local,
             )
             child = built[node["input"]]
             ex = FusedFragmentExecutor(
-                child, stages_from_ir(child.schema, node["stages"]))
+                child, stages_from_ir(child.schema, node["stages"],
+                                      store=store))
         elif op == "watermark_filter":
             from risingwave_tpu.stream.executors.watermark_filter \
                 import WATERMARK_STATE_SCHEMA, WatermarkFilterExecutor
@@ -348,22 +385,38 @@ def build_fragment(nodes: List[dict], store, local,
             )
             left = built[node["left"]]
             right = built[node["right"]]
-            lt = StateTable(int(node["left_table_id"]), left.schema,
+            # fused input sides (opt/fusion.py try_fuse_join): the
+            # side's index space is the absorbed run's OUTPUT schema —
+            # construct against schema shims, then adopt the runs so
+            # the real (raw) children wire back in
+            l_fs = (stages_from_ir(left.schema, node["left_fused"],
+                                   store=store)
+                    if node.get("left_fused") else None)
+            r_fs = (stages_from_ir(right.schema, node["right_fused"],
+                                   store=store)
+                    if node.get("right_fused") else None)
+            l_in = left if l_fs is None else _SchemaShim(l_fs.out_schema)
+            r_in = right if r_fs is None else _SchemaShim(r_fs.out_schema)
+            lt = StateTable(int(node["left_table_id"]), l_in.schema,
                             [int(i) for i in node["left_pk"]], store,
                             dist_key_indices=node.get("left_dist_key"))
-            rt = StateTable(int(node["right_table_id"]), right.schema,
+            rt = StateTable(int(node["right_table_id"]), r_in.schema,
                             [int(i) for i in node["right_pk"]], store,
                             dist_key_indices=node.get(
                                 "right_dist_key"))
             cap = node.get("state_cap")
             ex = HashJoinExecutor(
-                left, right,
+                l_in, r_in,
                 [int(i) for i in node["left_keys"]],
                 [int(i) for i in node["right_keys"]], lt, rt,
                 actor_id=int(actor_id or 0),
                 join_type=JoinType(node.get("join_type", "inner")),
                 output_names=node.get("output_names"),
                 state_cap=None if cap is None else int(cap))
+            if l_fs is not None:
+                ex.adopt_fused_input(0, l_fs, left)
+            if r_fs is not None:
+                ex.adopt_fused_input(1, r_fs, right)
         elif op == "materialize":
             from risingwave_tpu.stream.executors.materialize import (
                 MaterializeExecutor,
@@ -390,7 +443,8 @@ def build_fragment(nodes: List[dict], store, local,
             fused = None
             if node.get("fused_stages"):
                 fused = stages_from_ir(child.schema,
-                                       node["fused_stages"])
+                                       node["fused_stages"],
+                                       store=store)
             agg_in_schema = child.schema if fused is None \
                 else fused.out_schema
             sch, pk = agg_state_schema(agg_in_schema, group, calls)

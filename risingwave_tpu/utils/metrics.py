@@ -268,6 +268,18 @@ class StreamingMetrics:
             "dirty groups at last flush")
         self.agg_table_capacity = r.gauge(
             "stream_agg_table_capacity", "device hash-table slots")
+        # join payload residency (ISSUE 9): which half of a stored
+        # join row lives where — device lane + degree HBM bytes vs the
+        # host arena's column bytes, per executor, refreshed at every
+        # barrier by HashJoinExecutor
+        self.join_device_bytes = r.gauge(
+            "stream_join_payload_device_bytes",
+            "HBM bytes of device-resident join payload lanes + degree "
+            "arrays per executor")
+        self.join_host_bytes = r.gauge(
+            "stream_join_payload_host_bytes",
+            "host arena bytes backing join rows per executor "
+            "(varchar/host columns + the durable rebuild copy)")
         self.join_rows_evicted = r.counter(
             "stream_join_rows_evicted",
             "join-state rows evicted to the cold (state-table) tier")

@@ -184,6 +184,17 @@ class DispatchBudget:
             f"unfused baseline {rpd_unfused:.1f} — dispatch-budget "
             "guard (tier-1 strict mode)")
 
+    @staticmethod
+    def check_ceiling(d_fused, d_baseline, what="baseline"):
+        """Join-query extension (ISSUE 9): a fused join run must not
+        exceed its comparison arm's dispatch count — the test-scale
+        analog of BENCH acceptance 'fused q5-shape dispatches below
+        the r08 unfused count'."""
+        assert d_fused <= d_baseline, (
+            f"fused join run dispatched {d_fused} times, {what} "
+            f"{d_baseline} — dispatch-budget guard (tier-1 strict "
+            "mode, join extension)")
+
 
 @pytest.fixture
 def dispatch_budget():

@@ -405,8 +405,21 @@ def test_bench_latency_budget_parse_and_verdict():
         {"q7": {"p99_barrier_latency_s": 0.5}}, {"*": 2.0})
     assert v3["ok"] is True
 
-    # no budgets armed -> mode off, nothing recorded
-    assert bench._parse_latency_budgets([]) == {}
+    # flag absent -> the DEFAULT budget string arms (ISSUE 9: adctr
+    # and the *_fused twins are gated every round — the bare-float
+    # default covers the twins, adctr/q5 get explicit headroom)
+    d = bench._parse_latency_budgets([])
+    assert d == bench._parse_latency_budgets(
+        ["--latency-budget", bench.DEFAULT_LATENCY_BUDGET])
+    assert "*" in d and "adctr" in d and "q5_fused" in d
+    # the '*' default must not gate entries with no p99 measurement
+    # (the chaos round reports MTTR, not barrier latency)
+    v4 = bench._latency_verdict(
+        {"q7": {"p99_barrier_latency_s": 0.5}, "chaos": {"mttr": 1.3}},
+        {"*": 2.0})
+    assert v4["ok"] is True and "chaos" not in v4["verdicts"]
+    # explicit empty spec -> mode off, nothing recorded
+    assert bench._parse_latency_budgets(["--latency-budget", ""]) == {}
 
 
 # -- steady-state recompile guard (satellite) ------------------------------

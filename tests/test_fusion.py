@@ -882,3 +882,232 @@ def test_fused_block_stage_metrics_attribution():
     assert stage_series, \
         "no per-stage rows attributed inside the fused block"
     assert sum(v for _l, v in stage_series) > 0
+
+
+# -- watermark_filter absorption (ISSUE 9 satellite) -----------------------
+
+
+def test_watermark_filter_absorbed_oracle():
+    """A wm→filter→project run fuses into ONE block whose traced late
+    mask, runtime watermark advancement, persistence and post-chunk
+    watermark emission are bit-identical to the sequential executors —
+    including actually-late rows and the no-watermark-yet chunk."""
+    from risingwave_tpu.frontend.opt.fusion import fuse_fragments
+    from risingwave_tpu.state.state_table import StateTable
+    from risingwave_tpu.state.store import MemoryStateStore
+    from risingwave_tpu.stream.executors import MockSource
+    from risingwave_tpu.stream.executors.simple import (
+        FilterExecutor, ProjectExecutor,
+    )
+    from risingwave_tpu.stream.executors.test_utils import (
+        collect_until_n_barriers,
+    )
+    from risingwave_tpu.stream.executors.fused import (
+        FusedFragmentExecutor,
+    )
+    from risingwave_tpu.stream.executors.watermark_filter import (
+        WATERMARK_STATE_SCHEMA, WatermarkFilterExecutor,
+    )
+    from risingwave_tpu.stream.message import (
+        Barrier, BarrierKind, Watermark, is_chunk,
+    )
+    from risingwave_tpu.common.epoch import Epoch, EpochPair
+
+    S = Schema.of(ts=DataType.TIMESTAMP, v=DataType.INT64,
+                  s=DataType.VARCHAR)
+
+    def barrier(n):
+        prev = Epoch.from_physical(n - 1) if n > 1 else Epoch.INVALID
+        return Barrier(EpochPair(Epoch.from_physical(n), prev),
+                       BarrierKind.CHECKPOINT)
+
+    def script():
+        # chunk 1 has no watermark yet (nothing late by contract);
+        # chunk 2's 5_000 and chunk 3's 2_000 are late once the
+        # watermark passes them; NULL ts rows are never late
+        data = [([10_000, 20_000, 15_000], [1, 2, 3]),
+                ([5_000, 25_000, None], [4, 5, 6]),
+                ([30_000, 2_000, 26_000], [7, 8, 9])]
+        out = [barrier(1)]
+        for b, (ts, v) in enumerate(data, start=2):
+            out.append(StreamChunk.from_pydict(S, {
+                "ts": ts, "v": v,
+                "s": [f"x{x}" for x in v]}))
+            out.append(barrier(b))
+        return out, 4
+
+    def arm(fused):
+        msgs_script, nb = script()
+        store = MemoryStateStore()
+        wm_state = StateTable(191, WATERMARK_STATE_SCHEMA, [0], store)
+        src = MockSource(S, msgs_script)
+        wm = WatermarkFilterExecutor(src, 0, Interval(usecs=4_000),
+                                     wm_state)
+        filt = FilterExecutor(
+            wm, InputRef(1, DataType.INT64) > lit(0))
+        proj = ProjectExecutor(
+            filt,
+            exprs=[InputRef(0, DataType.TIMESTAMP),
+                   InputRef(1, DataType.INT64) * lit(3),
+                   InputRef(2, DataType.VARCHAR)],
+            names=["ts", "v3", "s"],
+            watermark_derivations={0: 0})
+        top = proj
+        if fused:
+            top, fired, _details = fuse_fragments(proj)
+            assert fired == 1
+            assert isinstance(top, FusedFragmentExecutor)
+            kinds = [st.kind for st in top.fused_stages.stages]
+            assert kinds == ["watermark_filter", "filter", "project"]
+        msgs = asyncio.run(collect_until_n_barriers(top, nb))
+        out = []
+        for m in msgs:
+            if is_chunk(m):
+                out.extend(("row", r) for r in m.to_records())
+            elif isinstance(m, Watermark):
+                out.append(("wm", m.col_idx, m.value))
+        # the persisted watermark must round-trip identically too
+        row = wm_state.get_row((0,))
+        return out, None if row is None else tuple(row)
+
+    on, wm_on = arm(True)
+    off, wm_off = arm(False)
+    assert on == off, "absorbed watermark_filter diverged"
+    assert wm_on == wm_off and wm_on is not None
+    assert any(t[0] == "wm" for t in on), "no watermarks observed"
+
+
+def test_fragmenter_lowers_and_rebuilds_fused_join():
+    """plan → fuse (join absorbs its input runs) → fragment →
+    hash_join IR with left_fused/right_fused → build_fragment
+    reconstructs the fused join (coordinator/worker parity), with
+    row_id_gen stage runtimes rebuilt as bare counters."""
+    from risingwave_tpu.frontend.catalog import Catalog
+    from risingwave_tpu.frontend.fragmenter import Fragmenter
+    from risingwave_tpu.frontend.parser import parse_many
+    from risingwave_tpu.frontend.planner import (
+        StreamPlanner, source_schema,
+    )
+    from risingwave_tpu.frontend.opt import rewrite_stream_plan
+    from risingwave_tpu.state.store import MemoryStateStore
+    from risingwave_tpu.stream.actor import LocalBarrierManager
+    from risingwave_tpu.stream.exchange import channel_for_test
+    from risingwave_tpu.stream.plan_ir import build_fragment
+    from risingwave_tpu.stream.executor import executor_children
+    from risingwave_tpu.stream.executors.hash_join import (
+        HashJoinExecutor,
+    )
+
+    opts_p = {"connector": "nexmark", "nexmark.table.type": "person",
+              "nexmark.event.num": "500",
+              "nexmark.generate.strings": "false"}
+    opts_a = {"connector": "nexmark", "nexmark.table.type": "auction",
+              "nexmark.event.num": "500",
+              "nexmark.generate.strings": "false"}
+    catalog = Catalog()
+    catalog.add_source("person", source_schema(opts_p, None), opts_p)
+    catalog.add_source("auction", source_schema(opts_a, None), opts_a)
+    [(_t, stmt)] = parse_many(
+        "CREATE MATERIALIZED VIEW v AS SELECT p.id, a.seller "
+        "FROM person AS p JOIN auction AS a ON p.id = a.seller "
+        "WHERE a.seller > 0")
+    planner = StreamPlanner(catalog, MemoryStateStore(),
+                            LocalBarrierManager(), definition="")
+    plan = planner.plan("v", stmt.select, 7, rate_limit=4)
+    consumer, report = rewrite_stream_plan(plan.consumer, "all",
+                                           record=False, fusion=True)
+    assert report.fired.get("fusion_grouping")
+
+    def find_join(ex):
+        if isinstance(ex, HashJoinExecutor):
+            return ex
+        for _a, _i, c in executor_children(ex):
+            got = find_join(c)
+            if got is not None:
+                return got
+        return None
+
+    j0 = find_join(consumer)
+    fused_sides = [i for i, s in enumerate(j0.sides)
+                   if s.fused_input is not None]
+    assert fused_sides, "join fusion did not fire on the planned query"
+
+    graph = Fragmenter(1).lower(consumer)
+    nodes = [n for f in graph.fragments for n in f.nodes]
+    join_node = next(n for n in nodes if n["op"] == "hash_join")
+    assert any(join_node.get(k) for k in ("left_fused", "right_fused")), \
+        "fused input runs missing from the shipped hash_join IR"
+    join_fi = next(i for i, f in enumerate(graph.fragments)
+                   if any(n["op"] == "hash_join" for n in f.nodes))
+    frag = graph.fragments[join_fi]
+    # splice the upstream source fragments over the exchange_in
+    # placeholders (the scheduler's expansion, single-actor case)
+    nodes: list = []
+    up_tail = {}
+    for inp in frag.inputs:
+        up_nodes = graph.fragments[inp.up_frag].nodes
+        base = len(nodes)
+        from risingwave_tpu.stream.plan_ir import remap_node_refs
+        for n in up_nodes:
+            nodes.append(remap_node_refs(
+                n, {i: base + i for i in range(len(up_nodes))}))
+        up_tail[inp.node_idx] = len(nodes) - 1
+    base = len(nodes)
+    remap = {}
+    for i, n in enumerate(frag.nodes):
+        if n["op"] == "exchange_in":
+            remap[i] = up_tail[i]
+        else:
+            remap[i] = base + len(
+                [j for j in range(i) if frag.nodes[j]["op"]
+                 != "exchange_in"])
+    for i, n in enumerate(frag.nodes):
+        if n["op"] == "exchange_in":
+            continue
+        from risingwave_tpu.stream.plan_ir import remap_node_refs
+        nodes.append(remap_node_refs(n, remap))
+    _src, rebuilt = build_fragment(
+        nodes, MemoryStateStore(),
+        LocalBarrierManager(), channel_for_test, actor_id=1)
+    j1 = find_join(rebuilt)
+    assert j1 is not None
+    for i in fused_sides:
+        fs0, fs1 = j0.sides[i].fused_input, j1.sides[i].fused_input
+        assert fs1 is not None
+        assert fs1.describe() == fs0.describe()
+        assert [f.data_type for f in fs1.out_schema] == \
+            [f.data_type for f in fs0.out_schema]
+        for st in fs1.stages:
+            if st.kind == "row_id_gen":
+                assert st.runtime is not None and \
+                    hasattr(st.runtime, "_rebase")
+
+
+def test_watermark_sentinel_narrow_int_time_col():
+    """Regression: the no-watermark-yet sentinel must be the time
+    column's OWN dtype-min — np.full would silently wrap int64-min to
+    0 on an INT32 column and late every negative timestamp."""
+    from risingwave_tpu.stream.executors.watermark_filter import (
+        WatermarkRuntime,
+    )
+
+    S32 = Schema.of(t=DataType.INT32, v=DataType.INT64)
+    rt = WatermarkRuntime()
+    st = FusedStage("watermark_filter", "WatermarkFilterExecutor",
+                    time_col=0, delay_usecs=0, runtime=rt)
+    fs = FusedStages(S32, [st, FusedStage(
+        "filter", "FilterExecutor",
+        exprs=(InputRef(1, DataType.INT64) >= lit(0),))])
+    assert fs.fusable_reason() is None
+    chunk = StreamChunk.from_pydict(
+        S32, {"t": [-5, -1, 3], "v": [1, 2, 3]})
+    aug = fs.augment(chunk)
+    thr = np.asarray(aug.columns[2].values)
+    assert thr.dtype == np.int32
+    assert (thr == np.iinfo(np.int32).min).all(), thr
+    # and the traced mask keeps every row (no watermark yet)
+    out_cols, vis2, _ops, _sr = fs.chain_body(
+        list(aug.columns), np.asarray(aug.visibility),
+        np.asarray(aug.ops), np)
+    assert (vis2 == np.asarray(aug.visibility)).all(), \
+        "negative timestamps dropped with no watermark"

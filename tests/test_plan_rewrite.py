@@ -282,6 +282,10 @@ def test_filter_pushdown_gated_by_join_kind():
     null-padded side of a LEFT join must stay above it."""
     async def main():
         fe = Frontend()
+        # the assertions read executor POSITIONS in the rewritten
+        # tree; join-input fusion would absorb the pushed filter into
+        # the join's identity instead (covered by test_fusion.py)
+        await fe.execute("SET stream_fusion = 'off'")
         for s in NEXMARK_SOURCES:
             await fe.execute(s)
         inner = await fe.execute(
@@ -313,6 +317,10 @@ def test_explain_shows_both_trees_and_annotations():
         plan = await fe.execute(
             "EXPLAIN " + TPCH_Q5.split(" AS ", 1)[1])
         await fe.execute("SET stream_rewrite_rules = 'none'")
+        # fusion has its own knob and fires on q5's join inputs even
+        # with the rules csv empty — the 'no rewrites fired' arm must
+        # silence it too
+        await fe.execute("SET stream_fusion = 'off'")
         off = await fe.execute(
             "EXPLAIN " + TPCH_Q5.split(" AS ", 1)[1])
         await fe.close()
