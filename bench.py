@@ -425,6 +425,56 @@ def _bench_adctr_subprocess() -> dict:
         timeout=1200)
 
 
+def bench_q7_mesh(total_events: int = 50 * 8_000,
+                  parallelism: int = 8):
+    """Sharded mesh lane (ISSUE 10 satellite): nexmark q7 through the
+    SQL front door at parallelism 8 — the GROUP BY runs on the
+    vnode-sharded SPMD kernel with per-EPOCH batched dispatches, so
+    BENCH_r*.json carries mesh-parallel throughput and p99 in the
+    trajectory, not just the multichip dry-run's correctness gate
+    (ROADMAP item 2 tail)."""
+    from risingwave_tpu.frontend.session import Frontend
+
+    async def run():
+        fe = Frontend(rate_limit=16, min_chunks=16,
+                      parallelism=parallelism)
+        await fe.execute(
+            f"CREATE SOURCE bid WITH (connector='nexmark', "
+            f"nexmark.table.type='bid', "
+            f"nexmark.event.num={total_events}, "
+            f"nexmark.max.chunk.size=4096, "
+            f"nexmark.generate.strings='false')")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW q7_mesh AS "
+            "SELECT window_start, MAX(price) AS max_price, "
+            "COUNT(*) AS cnt "
+            "FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND) "
+            "GROUP BY window_start")
+        expected = total_events * 46 // 50
+        plan = _session_plan_stats(fe)
+        elapsed, rows = await _drive_frontend(fe, expected, IN_FLIGHT)
+        stats = fe.loop
+        await fe.close()
+        return elapsed, rows, stats, plan
+
+    elapsed, rows, loop, plan = asyncio.run(run())
+    r = _result("nexmark_q7_mesh_events_per_sec", elapsed, rows, loop,
+                plan=plan)
+    import jax
+    r["parallelism"] = min(parallelism, len(jax.devices()))
+    return r
+
+
+def _bench_q7_mesh_subprocess() -> dict:
+    """q7 on the 8-virtual-device CPU mesh in a subprocess (clearly
+    labeled: one real chip ⇒ the mesh is virtual)."""
+    return _run_bench_subprocess(
+        ["--mesh-sub"],
+        {"JAX_PLATFORMS": "cpu",
+         "XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+        timeout=1500)
+
+
 def _probe_device(timeout_s: int = 180, attempts: int = 2) -> str:
     """Probe the device backend IN A SUBPROCESS and return the platform.
 
@@ -615,7 +665,16 @@ def bench_chaos(seed: int = 7, events: int = 6000) -> dict:
 # bare float covers every measured query INCLUDING the *_fused twins;
 # adctr/q5 get explicit headroom (slowest pipelines at CPU scale).
 # Pass --latency-budget '' to disable.
-DEFAULT_LATENCY_BUDGET = "2.0,q5=4,q5_fused=4,adctr=30"
+#
+# adctr: 30 → 8 after sharded epoch batching (ISSUE 10) — measured
+# ~5.5s p99 on the 4-virtual-device mesh (was ~25s in r09: ~100ms of
+# shard_map host dispatch per chunk, plus worst-case-skew routed
+# shapes, plus warmup compiles riding the tail); the remaining tail is
+# host ingestion + the serialized virtual-mesh SPMD compute, tracked
+# toward the global 2s in ROADMAP item 3. Escape hatch if CI hardware
+# is slower: --latency-budget '2.0,q5=4,q5_fused=4,adctr=30' (or '')
+# overrides per run without a code change.
+DEFAULT_LATENCY_BUDGET = "2.0,q5=4,q5_fused=4,adctr=8"
 
 
 def _parse_latency_budgets(argv) -> dict:
@@ -777,6 +836,19 @@ def _main_locked(argv):
         fn()
         print(json.dumps(fn()))
         return
+    if "--mesh-sub" in argv:
+        # child mode: timed sharded lane on the 8-virtual-device CPU
+        # mesh (same sitecustomize override dance as --adctr-sub)
+        import jax as _jax
+        _jax.config.update("jax_platforms", "cpu")
+        enable_compilation_cache()
+        r = bench_q7_mesh()                            # full-scale warmup
+        r = bench_q7_mesh()
+        import jax
+        r["platform"] = (f"{jax.devices()[0].platform}"
+                         f"-mesh-{r['parallelism']}")
+        print(json.dumps(r))
+        return
     if "--adctr-sub" in argv:
         # child mode: env asks for the CPU virtual mesh, but the axon
         # sitecustomize overrides JAX_PLATFORMS at interpreter start —
@@ -785,7 +857,11 @@ def _main_locked(argv):
         import jax as _jax
         _jax.config.update("jax_platforms", "cpu")
         enable_compilation_cache()
-        r = bench_adctr(n_impressions=100_000)     # warmup
+        # FULL-scale warmup (the stated methodology): a half-scale
+        # warmup left the bigger catch-up epochs' pow2 shapes — and
+        # their XLA compiles — inside the timed window, which is
+        # exactly the p99 tail the latency budget gates
+        r = bench_adctr()                          # warmup
         r = bench_adctr()
         import jax
         r["platform"] = (f"{jax.devices()[0].platform}"
@@ -840,6 +916,18 @@ def _main_locked(argv):
         except Exception as e:                       # noqa: BLE001
             print(f"WARNING: adctr failed: {e!r}", file=sys.stderr)
             headline["adctr"] = {"error": repr(e)[:200]}
+        # sharded mesh lane (ISSUE 10): q7 at parallelism 8 — the
+        # epoch-batched SPMD kernels timed, not just dry-run-checked
+        try:
+            r = _bench_q7_mesh_subprocess()
+            headline["q7_mesh"] = {
+                k: r[k] for k in ("value", "p99_barrier_latency_s",
+                                  "barrier_in_flight", "events",
+                                  "parallelism", "platform",
+                                  "observability") if k in r}
+        except Exception as e:                       # noqa: BLE001
+            print(f"WARNING: q7_mesh failed: {e!r}", file=sys.stderr)
+            headline["q7_mesh"] = {"error": repr(e)[:200]}
     # Bench honesty (ISSUE 9): each *_fused twin carries its p99 delta
     # NEXT TO its dispatch delta vs the interpretive baseline. Fused
     # runs trade host interpretation for device dispatches — on CPU

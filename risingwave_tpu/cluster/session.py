@@ -115,10 +115,10 @@ class DistFrontend:
                        "coalesce_linger_chunks"},
             {"stream_rewrite_rules": "all",
              # fragment fusion (opt/fusion.py). Distributed deploys
-             # fuse at parallelism 1 only: a hash-exchange-fed agg's
-             # index space is post-stage, so the cut would dispatch
-             # raw rows on the wrong columns — the interpretive chain
-             # stays until the sharded kernel grows a prelude path
+             # fuse at ANY parallelism (ISSUE 10): the hash-exchange
+             # cut ships raw rows dispatched on key columns mapped
+             # back through the absorbed run; runs whose keys don't
+             # map to raw refs stay interpretive (rule-side refusal)
              "stream_fusion": "on",
              # epoch-causal tracing: the SET fans out to every worker
              # over the control channel (same on/off everywhere, or a
@@ -280,8 +280,8 @@ class DistFrontend:
                 plan.consumer,
                 self.session_vars.get("stream_rewrite_rules"),
                 fusion=parse_fusion(
-                    self.session_vars.get("stream_fusion"))
-                and self.parallelism == 1)
+                    self.session_vars.get("stream_fusion")),
+                dist_parallelism=self.parallelism)
         if isinstance(stmt, ast.AlterParallelism):
             return await self._alter_parallelism(stmt)
         if isinstance(stmt, ast.Flush):
@@ -320,9 +320,12 @@ class DistFrontend:
             apply_rewrites, parse_fusion,
         )
         rules = self.session_vars.get("stream_rewrite_rules")
-        fusion = parse_fusion(self.session_vars.get("stream_fusion")) \
-            and self.parallelism == 1
-        apply_rewrites(plan, rules, label=stmt.name, fusion=fusion)
+        # fusion at ANY parallelism since ISSUE 10: the fragmenter cuts
+        # below an absorbed run on raw-mapped key columns, and the rule
+        # refuses runs whose keys don't map (opt/fusion.py)
+        fusion = parse_fusion(self.session_vars.get("stream_fusion"))
+        apply_rewrites(plan, rules, label=stmt.name, fusion=fusion,
+                       dist_parallelism=self.parallelism)
         if plan.attaches:
             # every FROM <mv> should have inlined (the dict holds all
             # session-created views); a chain attach here means a

@@ -319,16 +319,23 @@ class Fragmenter:
             if self.parallelism > 1 and \
                     getattr(ex, "two_phase_role", None) != "local":
                 if ex.fused_stages is not None:
-                    # a hash-exchange cut would dispatch RAW rows on
-                    # post-stage key positions — the sessions gate
-                    # fusion to parallelism 1, so reaching here is a
-                    # planner bug, not a user error
-                    raise FragmentError(
-                        "fused agg cannot take a hash-exchange cut "
-                        "(fusion is parallelism-1 only on the "
-                        "distributed frontend)")
-                fi, xi = self._cut(up_fi, list(ex.group_indices),
-                                   ex.input.schema, self.parallelism)
+                    # fused cut (ISSUE 10): the exchange ships RAW
+                    # rows, hashed on the group keys mapped back
+                    # through the absorbed run — value-equal columns,
+                    # so the partition is consistent; the fusion rule
+                    # refused any run whose keys don't map, so a None
+                    # here is a planner bug, not a user error
+                    keys = ex.fused_stages.input_positions(
+                        ex.group_indices)
+                    if keys is None:
+                        raise FragmentError(
+                            "fused agg group keys do not map to raw "
+                            "input columns — the fusion rule should "
+                            "have refused this run")
+                else:
+                    keys = list(ex.group_indices)
+                fi, xi = self._cut(up_fi, keys, ex.input.schema,
+                                   self.parallelism)
                 node["input"] = xi
             else:
                 # parallelism 1, or the LOCAL phase of a two-phase
@@ -342,24 +349,28 @@ class Fragmenter:
             left, right = ex.sides
             l_fi, _ = self._lower(ex.left_in)
             r_fi, _ = self._lower(ex.right_in)
-            if (left.fused_input is not None
-                    or right.fused_input is not None) \
-                    and self.parallelism > 1:
-                # the exchange would hash RAW rows on post-run key
-                # positions — the sessions gate fusion to parallelism
-                # 1, so reaching here is a planner bug
-                raise FragmentError(
-                    "fused join input cannot take a hash-exchange "
-                    "cut (fusion is parallelism-1 only on the "
-                    "distributed frontend)")
             # a fused side's key positions live in the absorbed run's
-            # OUTPUT space; the exchange ships RAW rows, so the cut
-            # carries no hash keys there (parallelism 1: the single
-            # consumer makes routing trivial)
-            l_cut = [] if left.fused_input is not None \
-                else list(left.key_indices)
-            r_cut = [] if right.fused_input is not None \
-                else list(right.key_indices)
+            # OUTPUT space; the exchange ships RAW rows. At
+            # parallelism 1 the single consumer makes routing trivial
+            # (no hash keys); above 1 the keys map back through the
+            # run to raw columns (ISSUE 10 — the fusion rule refused
+            # any run whose keys don't map, so None is a planner bug)
+            def _side_cut(side):
+                if side.fused_input is None:
+                    return list(side.key_indices)
+                if self.parallelism <= 1:
+                    return []
+                keys = side.fused_input.input_positions(
+                    side.key_indices)
+                if keys is None:
+                    raise FragmentError(
+                        "fused join keys do not map to raw input "
+                        "columns — the fusion rule should have "
+                        "refused this run")
+                return keys
+
+            l_cut = _side_cut(left)
+            r_cut = _side_cut(right)
             fi, lxi = self._cut(l_fi, l_cut, ex.left_in.schema,
                                 self.parallelism)
             rxi = self._cut_into(fi, r_fi, r_cut, ex.right_in.schema)

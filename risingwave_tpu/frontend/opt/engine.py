@@ -117,7 +117,8 @@ def rewrite_stream_plan(root, spec: Optional[str] = "all",
                         label: str = "",
                         record: bool = True,
                         extra_rules: Optional[dict] = None,
-                        fusion: bool = False
+                        fusion: bool = False,
+                        dist_parallelism: int = 1
                         ) -> Tuple[object, RewriteReport]:
     """Rewrite one planned executor tree to fixpoint. Returns the
     (possibly identical) new root and a report; never raises in
@@ -125,14 +126,20 @@ def rewrite_stream_plan(root, spec: Optional[str] = "all",
     deployed yesterday still deploys today. ``fusion`` enables the
     fragment-fusion rule (SET stream_fusion; opt/fusion.py) on top of
     whatever ``spec`` enables — including spec='none', so fusion can
-    be measured in isolation."""
+    be measured in isolation. ``dist_parallelism`` is the distributed
+    session's actor parallelism: above 1 the fusion rule refuses runs
+    whose hash-cut keys do not map back to raw input columns (the
+    fragmenter's fused cut ships raw rows — opt/fusion.py)."""
     from risingwave_tpu.utils.metrics import STREAMING
     report = RewriteReport(label)
     enabled = parse_rules(spec) & set(EXECUTOR_RULE_NAMES)
     registry = dict(EXECUTOR_RULES)
     if fusion:
+        import functools
+
         from risingwave_tpu.frontend.opt.fusion import fuse_fragments
-        registry[FUSION_RULE_NAME] = fuse_fragments
+        registry[FUSION_RULE_NAME] = functools.partial(
+            fuse_fragments, dist_parallelism=dist_parallelism)
         enabled = enabled | {FUSION_RULE_NAME}
     if extra_rules:
         registry.update(extra_rules)
@@ -184,19 +191,21 @@ def rewrite_stream_plan(root, spec: Optional[str] = "all",
 
 def apply_rewrites(plan, spec: Optional[str],
                    label: str = "",
-                   fusion: bool = False) -> RewriteReport:
+                   fusion: bool = False,
+                   dist_parallelism: int = 1) -> RewriteReport:
     """Rewrite a StreamPlan/SinkPlan's consumer in place — the ONE
     deploy-path seam every session path (create MV/sink, reschedule,
     distributed create) goes through, so a future engine argument
     lands everywhere at once."""
-    plan.consumer, report = rewrite_stream_plan(plan.consumer, spec,
-                                                label=label,
-                                                fusion=fusion)
+    plan.consumer, report = rewrite_stream_plan(
+        plan.consumer, spec, label=label, fusion=fusion,
+        dist_parallelism=dist_parallelism)
     return report
 
 
 def explain_with_rewrite(consumer, spec: Optional[str],
-                         fusion: bool = False) -> List[tuple]:
+                         fusion: bool = False,
+                         dist_parallelism: int = 1) -> List[tuple]:
     """EXPLAIN body shared by Frontend and DistFrontend: pre-rewrite
     tree, per-rule annotations (fusion groups included), post-rewrite
     tree, lane stats."""
@@ -209,10 +218,9 @@ def explain_with_rewrite(consumer, spec: Optional[str],
                 f"max_width={s['max_lane_width']}",)
 
     pre = explain_tree(consumer)
-    new_consumer, report = rewrite_stream_plan(consumer, spec,
-                                               label="__explain__",
-                                               record=False,
-                                               fusion=fusion)
+    new_consumer, report = rewrite_stream_plan(
+        consumer, spec, label="__explain__", record=False,
+        fusion=fusion, dist_parallelism=dist_parallelism)
     rows = [("-- streaming plan (pre-rewrite):",)]
     rows += [(line,) for line in pre]
     rows.append(stats_line("pre-rewrite", consumer))

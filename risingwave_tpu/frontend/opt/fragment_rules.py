@@ -30,6 +30,43 @@ _PASSTHROUGH_OPS = frozenset({
 })
 
 
+def _stages_width(w: Optional[int], stages) -> Optional[int]:
+    """Output arity through a serialized fused-stage list (ISSUE 10:
+    fused nodes exist at parallelism > 1, so the derivation must walk
+    their absorbed runs)."""
+    if w is None:
+        return None
+    for st in stages:
+        k = st["kind"]
+        if k == "project":
+            w = len(st["exprs"])
+        elif k == "row_id_gen":
+            w = w + 1
+        # filter / watermark_filter keep the arity
+    return w
+
+
+def _stages_dist(d: Optional[List[set]], stages) -> Optional[List[set]]:
+    """Track a hash distribution through a serialized fused-stage
+    list: projects remap key-carrying columns (bare input refs only,
+    the same rule as the `project` IR node); filters/watermark_filter
+    pass through; row_id_gen appends a column (indices unchanged)."""
+    if d is None:
+        return None
+    for st in stages:
+        if st["kind"] != "project":
+            continue
+        ref_cols: Dict[int, set] = {}
+        for j, e in enumerate(st["exprs"]):
+            if e.get("t") == "input":
+                ref_cols.setdefault(e["i"], set()).add(j)
+        d = [set().union(*(ref_cols.get(c, set()) for c in s))
+             if s else set() for s in d]
+        if any(not s for s in d):
+            return None
+    return d
+
+
 def _node_widths(frag) -> List[Optional[int]]:
     """Output arity per IR node (None where not derivable)."""
     widths: List[Optional[int]] = []
@@ -42,6 +79,8 @@ def _node_widths(frag) -> List[Optional[int]]:
             w = len(frag.inputs[node["port"]].schema)
         elif op == "project":
             w = len(node["exprs"])
+        elif op == "fused":
+            w = _stages_width(widths[node["input"]], node["stages"])
         elif op in _PASSTHROUGH_OPS:
             inw = widths[node["input"]]
             w = inw if op != "row_id_gen" else (
@@ -50,6 +89,10 @@ def _node_widths(frag) -> List[Optional[int]]:
             w = len(node["group"]) + len(node["calls"])
         elif op in ("hash_join", "temporal_join"):
             lw, rw = widths[node["left"]], widths[node["right"]]
+            if node.get("left_fused"):
+                lw = _stages_width(lw, node["left_fused"])
+            if node.get("right_fused"):
+                rw = _stages_width(rw, node["right_fused"])
             w = lw + rw if lw is not None and rw is not None else None
         elif op == "over_window":
             inw = widths[node["input"]]
@@ -86,10 +129,16 @@ def fragment_output_dist(frag) -> Optional[List[set]]:
                      for s in ind]
                 if any(not s for s in d):
                     d = None
+        elif op == "fused":
+            d = _stages_dist(dists[node["input"]], node["stages"])
         elif op in _PASSTHROUGH_OPS:
             d = dists[node["input"]]
         elif op == "hash_agg":
             ind = dists[node["input"]]
+            # a fused agg's group indices live in the absorbed run's
+            # OUTPUT space — map the input distribution through it
+            if node.get("fused_stages"):
+                ind = _stages_dist(ind, node["fused_stages"])
             group = list(node["group"])
             if ind is not None:
                 d = [{group.index(c) for c in s if c in group}
@@ -98,10 +147,19 @@ def fragment_output_dist(frag) -> Optional[List[set]]:
                     d = None
         elif op == "hash_join":
             # both inputs are hashed on the join keys; every output
-            # row carries the key value in its left AND right column
+            # row carries the key value in its left AND right column.
+            # Fused sides: the exchange dispatched RAW rows on raw-
+            # mapped key columns; key positions (and the left width)
+            # live in each run's OUTPUT space — map through the run.
             lind = dists[node["left"]]
             rind = dists[node["right"]]
             n_left = widths[node["left"]]
+            if node.get("left_fused"):
+                lind = _stages_dist(lind, node["left_fused"])
+                n_left = _stages_width(widths[node["left"]],
+                                       node["left_fused"])
+            if node.get("right_fused"):
+                rind = _stages_dist(rind, node["right_fused"])
             lk = list(node["left_keys"])
             rk = list(node["right_keys"])
             if (n_left is not None

@@ -98,9 +98,16 @@ def agg_ineligible_reason(agg) -> Optional[str]:
     before fusing, HashAggExecutor's constructor/adopt guards call it,
     and the checker re-verifies it on ALREADY-fused aggs after every
     later rewrite round (so `fused_stages is not None` is deliberately
-    NOT a condition here)."""
-    if agg._kernel is not None:
-        return "sharded/injected kernel"
+    NOT a condition here).
+
+    Injected SHARDED kernels are eligible since ISSUE 10: the sharded
+    apply grew a prelude path (the absorbed run traces before vnode
+    routing inside the same SPMD step) — only a kernel that already
+    saw data, or an injected kernel with no prelude support at all,
+    refuses."""
+    k = agg._kernel
+    if k is not None and not getattr(k, "supports_prelude", False):
+        return "injected kernel without a prelude path"
     if agg.minput or agg.distinct_tables:
         return "retractable MIN/MAX or DISTINCT (host multisets)"
     if agg._hll_calls or agg._host_calls:
@@ -124,13 +131,14 @@ def join_side_ineligible_reason(join, side_idx: int) -> Optional[str]:
     """THE join-side eligibility predicate (rule, adopt guard, and
     checker all call it — the checker re-verifies ALREADY-fused sides,
     so `fused_input is not None` is deliberately not a condition).
-    The fused path needs the single-chip epoch dispatches (the
-    prelude inlines there), host-typed keys would need interning
-    inside the trace, and the cold tier reads buffered key lanes the
-    raw matrix no longer carries."""
+    The fused path needs the EPOCH dispatches (the prelude inlines
+    there — since ISSUE 10 the sharded kernels have them too, so the
+    old single-chip-only gate is gone), host-typed keys would need
+    interning inside the trace, and the cold tier reads buffered key
+    lanes the raw matrix no longer carries."""
     side = join.sides[side_idx]
-    if side._mesh is not None:
-        return "sharded kernel (per-chunk dispatch path)"
+    if not join._epoch_batch:
+        return "per-chunk dispatch path (epoch batching off)"
     if join.rebuild_opts.get("state_cap") is not None:
         return ("cold-tier governed join (reload reads the buffered "
                 "key lanes)")
@@ -148,10 +156,20 @@ def join_side_fusable_reason(join, side_idx: int) -> Optional[str]:
     return join_side_ineligible_reason(join, side_idx)
 
 
-def fuse_fragments(root) -> Tuple[object, int, str]:
-    """The rule entry point (engine registry signature). Non-
+def fuse_fragments(root, dist_parallelism: int = 1
+                   ) -> Tuple[object, int, str]:
+    """The rule entry point (engine registry signature; the engine
+    registers a partial binding ``dist_parallelism``). Non-
     destructive: copy-on-write along every mutated path so the engine's
-    fallback plan stays intact."""
+    fallback plan stays intact.
+
+    At distributed parallelism > 1 the fragmenter's hash-exchange cut
+    lands BELOW an absorbed run (raw rows ship, the prelude runs on
+    the consumer actors), so the cut's hash keys must map back through
+    the run to raw input columns (FusedStages.input_positions) — a key
+    computed by a non-trivial projection cannot be dispatched on and
+    the run stays interpretive. Value equality makes the raw-column
+    hash partition the post-stage keys consistently."""
     from risingwave_tpu.ops.fused import FusedStages
     from risingwave_tpu.stream.coalesce import CoalesceExecutor
     from risingwave_tpu.stream.executors.fused import (
@@ -177,6 +195,14 @@ def fuse_fragments(root) -> Tuple[object, int, str]:
         reason = fs.fusable_reason()
         if reason is not None:
             details.append(f"agg run NOT fused ({reason})")
+            return None
+        if dist_parallelism > 1 and \
+                getattr(agg, "two_phase_role", None) != "local" and \
+                fs.input_positions(agg.group_indices) is None:
+            details.append(
+                "agg run NOT fused (group keys do not map to raw "
+                "input columns — parallelism>1 cut dispatches raw "
+                "rows)")
             return None
         new_agg = copy.copy(agg)
         new_agg.adopt_fused_stages(fs, base)
@@ -222,6 +248,13 @@ def fuse_fragments(root) -> Tuple[object, int, str]:
             if reason is not None:
                 details.append(
                     f"join side {s} run NOT fused ({reason})")
+                continue
+            if dist_parallelism > 1 and fs.input_positions(
+                    join.sides[s].key_indices) is None:
+                details.append(
+                    f"join side {s} run NOT fused (join keys do not "
+                    "map to raw input columns — parallelism>1 cut "
+                    "dispatches raw rows)")
                 continue
             if new_join is None:
                 new_join = _copy.copy(join)

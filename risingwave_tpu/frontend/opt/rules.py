@@ -589,7 +589,9 @@ def _prune_join(j, live_full: Set[int], stats) -> tuple:
         lt, rt, output_names=names, join_type=j.join_type,
         actor_id=opts.get("actor_id", 0), mesh=opts.get("mesh"),
         shard_opts=opts.get("shard_opts"),
-        state_cap=opts.get("state_cap"))
+        state_cap=opts.get("state_cap"),
+        device_payload=opts.get("device_payload", True),
+        epoch_batch=opts.get("epoch_batch"))
     mapping = {old: new_i for old, new_i in lmap.items()}
     n_left_new = len(lnew.schema)
     for old, new_i in rmap.items():

@@ -500,6 +500,56 @@ class FusedStages:
     def describe(self) -> str:
         return "→".join(s.identity for s in self.stages)
 
+    def trace_key(self) -> str:
+        """STRUCTURAL identity of the traced program this run builds:
+        two FusedStages with equal keys trace byte-equivalent preludes
+        (runtime state — row-id counters, watermark tables — feeds the
+        host-built synthetic columns, never the trace). Keying jit
+        caches by this instead of object identity lets fresh sessions
+        and both join sides reuse compiled programs — warmup compiles
+        stop riding every run's p99 tail."""
+        import json as _json
+
+        from risingwave_tpu.stream.plan_ir import expr_to_ir
+        parts = []
+        for st in self.stages:
+            d = {"kind": st.kind}
+            if st.kind == "filter":
+                d["pred"] = expr_to_ir(st.exprs[0])
+            elif st.kind == "project":
+                d["exprs"] = [expr_to_ir(e) for e in st.exprs]
+            elif st.kind == "watermark_filter":
+                d["time_col"] = st.time_col
+            parts.append(d)
+        schema = [f.data_type.value for f in self.in_schema]
+        return _json.dumps([schema, parts], sort_keys=True,
+                           default=str)
+
+    def input_positions(self, cols) -> Optional[List[int]]:
+        """Map OUTPUT column positions back through the composed
+        projection to RAW input positions, or None when any is not a
+        pure input ref (a computed key cannot be hash-dispatched in
+        raw space; synthetic runtime columns — absorbed row ids,
+        watermark thresholds — do not exist pre-run either). The
+        parallelism>1 fused cut (fragmenter) hashes raw rows on the
+        mapped columns: value equality with the post-stage keys makes
+        the partition consistent."""
+        from risingwave_tpu.expr.expr import InputRef
+        n_in = len(self.in_schema)
+        out: List[int] = []
+        for c in cols:
+            if self.out_exprs is None:
+                if not (0 <= c < n_in):
+                    return None
+                out.append(int(c))
+                continue
+            e = self.out_exprs[c]
+            if isinstance(e, InputRef) and e.index < n_in:
+                out.append(int(e.index))
+            else:
+                return None
+        return out
+
     # -- watermark path (host, per message) --------------------------------
     def derive_watermarks(self, msg) -> List:
         """Watermark(s) in OUTPUT column space, composing each stage's

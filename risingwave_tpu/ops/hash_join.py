@@ -742,9 +742,20 @@ class JoinSideKernel:
                             self._probe_cap, redispatch, bump=bump)
 
     # -- epoch batching ---------------------------------------------------
+    def stage_epoch(self, up: np.ndarray, aux: np.ndarray, total: int,
+                    max_ins_ref: int, owners=None) -> tuple:
+        """Host→device staging of one side's epoch matrices (the
+        sharded kernel's twin additionally pads to the mesh width,
+        derives the skew-exact routing bucket from ``owners`` and
+        row-shards the upload; a single chip just device_puts and has
+        no routing bucket)."""
+        del total, max_ins_ref, owners
+        import jax
+        return jax.device_put(up), jax.device_put(aux), None
+
     def apply_epoch(self, up_dev, aux_dev, n_rows: int,
                     max_ins_ref: int, prelude=None,
-                    prelude_key: str = "") -> None:
+                    prelude_key: str = "", bucket=None) -> None:
         """Apply a whole epoch's concatenated inserts/tombstones (and
         their payload lanes) in one dispatch. ``up_dev`` is the
         [key_lanes | payload_lanes] upload matrix — or, with a fused
@@ -763,7 +774,8 @@ class JoinSideKernel:
 
     def probe_epoch(self, up_dev, aux_dev, with_degrees: bool,
                     sink: "JoinSideKernel" = None, prelude=None,
-                    prelude_key: str = "") -> "PendingEpochProbe":
+                    prelude_key: str = "",
+                    bucket=None) -> "PendingEpochProbe":
         """Probe a whole epoch's rows against THIS side, each row at
         its aux sequence; call after both sides' apply_epoch. With
         degrees, ``sink`` is the PROBING side's kernel: this side's

@@ -33,14 +33,34 @@ from risingwave_tpu.ops.hash_agg import (
     AggSpec, AggState, FlushResult, _call_slices, _rebuild_live,
     _update_call, advance_state, decode_flush_data, decode_outputs,
     dev_layout, encode_host_accs, gather_packed, make_agg_state,
-    n_input_lanes, retire_state,
+    n_input_lanes, pack_chunk, packed_layout, retire_state,
 )
 from risingwave_tpu.parallel.exchange import (
-    bucketize_by_owner, exchange, vnodes_from_lanes,
+    bucketize_by_owner, exchange, owners_host, skew_bucket,
+    vnodes_from_lanes,
 )
-from risingwave_tpu.utils import jaxtools
+from risingwave_tpu.utils import jaxtools, spans
 
 AXIS = "d"
+
+# Compiled SPMD programs shared ACROSS kernel instances (fresh
+# sessions, twin MVs and bench re-runs reuse traces instead of paying
+# warmup compiles on the p99 tail — the join's _STEP_CACHE scheme).
+# Keyed by (mesh device ids, program kind + statics, key_width,
+# specs); jit shape-keys per state capacity internally.
+_PROG_CACHE: Dict[tuple, object] = {}
+
+
+def _note_dispatch(rows: float) -> None:
+    """Real-SPMD-dispatch accounting at the jit sites (the sharded agg
+    counts its own launches — one per backlog flush / barrier gather —
+    so the executor layer must not also count per-chunk requests;
+    exactly one site counts each dispatch and the registry totals
+    stay launch-for-launch honest)."""
+    from risingwave_tpu.utils.metrics import STREAMING
+    STREAMING.device_dispatch.inc(1, kernel="sharded_agg")
+    STREAMING.rows_per_dispatch.observe(float(rows),
+                                        kernel="sharded_agg")
 
 
 class _ShardedCounters:
@@ -121,16 +141,48 @@ class ShardedAggKernel:
     groups (test/flush support).
     """
 
+    # one inc per shard_map launch, at the launch (metrics contract
+    # shared with the fused kernels): the executor layer checks this
+    # and skips its per-chunk request counting
+    counts_own_dispatches = True
+
+    # epoch batch bound, mirroring GroupedAggKernel.BATCH_ROWS: the
+    # backlog dispatches at this many rows mid-epoch (bounds host
+    # buffering and the int32 limb math), else once at the barrier
+    # flush — O(1) SPMD dispatches per epoch instead of one per chunk
+    # (each shard_map host dispatch costs ~100ms through the 4-virtual-
+    # device CPU mesh, BENCH_r09's whole ad-ctr tail). The FIXED batch
+    # shape also means one compiled program instead of per-chunk-shape
+    # churn — the RecompileGuard's sharded contract.
+    BATCH_ROWS = 1 << 15
+
     def __init__(self, mesh: Mesh, key_width: int,
                  specs: Sequence[AggSpec], capacity: int = 1 << 12,
                  bucket: Optional[int] = None,
-                 flush_capacity: int = 1 << 10):
+                 flush_capacity: int = 1 << 10,
+                 epoch_batch: bool = True):
         self.mesh = mesh
         self.n_dev = mesh.devices.size
         self.specs = tuple(specs)
         self.key_width = key_width
         self.capacity = capacity
         self.bucket = bucket
+        # epoch_batch=False is the per-chunk oracle arm (one SPMD
+        # dispatch per apply — the pre-ISSUE-10 behavior)
+        self.epoch_batch = bool(epoch_batch)
+        self._backlog: List[np.ndarray] = []
+        self._backlog_owners: List[Optional[np.ndarray]] = []
+        self._backlog_rows = 0
+        self._backlog_vis = 0
+        self._stage_pending: List = []
+        # fused-fragment mode (ops/fused.py build_agg_prelude): set via
+        # set_prelude BEFORE any data; the absorbed filter/project run
+        # traces ahead of the vnode routing inside the same SPMD step
+        self._prelude = None
+        self._raw_width: Optional[int] = None
+        self.metrics_label: Optional[str] = None
+        self._span_label = "ShardedAggKernel"
+        self._touched = False
         # vnode → owning shard: contiguous even split (VnodeMapping)
         owners = np.repeat(np.arange(self.n_dev, dtype=np.int32),
                            VNODE_COUNT // self.n_dev)
@@ -139,6 +191,7 @@ class ShardedAggKernel:
             owners = np.concatenate(
                 [owners, np.full(pad, self.n_dev - 1, np.int32)])
         self.owner_map = jnp.asarray(owners)
+        self._owner_map_host = owners
         sharding = NamedSharding(mesh, P(AXIS))
         self.state: AggState = jax.tree.map(
             lambda a: jax.device_put(a, sharding),
@@ -149,15 +202,30 @@ class ShardedAggKernel:
         self._flush_idx: Optional[List[np.ndarray]] = None
         self._counters = _ShardedCounters(self.n_dev)
         self._state_spec = jax.tree.map(lambda _: P(AXIS), self.state)
-        self._advance_jit = self._shardwise(advance_state, donate=True)
+        self._advance_jit = self._shardwise(advance_state, donate=True,
+                                            cache_key=("advance",))
         self._retire_jit = None        # built lazily (lane_off static)
         self._patch_step = None        # built lazily (col count static)
         self._gather_cache: Dict[int, object] = {}
 
-    def _shardwise(self, fn, donate: bool, out_spec=None, extra_specs=()):
+    def _prog_key(self, *parts) -> tuple:
+        return (tuple(int(d.id) for d in self.mesh.devices.flat),
+                self.key_width, self.specs) + parts
+
+    def _shardwise(self, fn, donate: bool, out_spec=None,
+                   extra_specs=(), cache_key=None):
         """Wrap a single-chip traced state transform in shard_map: each
         shard applies `fn` to its slice (leading axis dropped/restored).
-        The single-chip and sharded kernels literally share programs."""
+        The single-chip and sharded kernels literally share programs.
+        ``cache_key`` (structural statics) shares the COMPILED program
+        across kernel instances via the module cache."""
+        key = None
+        if cache_key is not None:
+            key = self._prog_key(*cache_key)
+            step = _PROG_CACHE.get(key)
+            if step is not None:
+                return step
+
         def local(state, *args):
             state = jax.tree.map(lambda a: a[0], state)
             out = fn(state, *args)
@@ -169,45 +237,82 @@ class ShardedAggKernel:
             out_specs=out_spec if out_spec is not None
             else self._state_spec,
             check_vma=False)
-        return jaxtools.instrumented_jit(
+        step = jaxtools.instrumented_jit(
             mapped, "parallel_agg.sharded",
             donate_argnums=(0,) if donate else ())
+        if key is not None:
+            _PROG_CACHE[key] = step
+        return step
+
+    # -- fused-fragment prelude (ops/fused.py) ----------------------------
+    @property
+    def supports_prelude(self) -> bool:
+        """Fusion eligibility hook (opt/fusion.agg_ineligible_reason):
+        the sharded apply traces an absorbed filter/project run BEFORE
+        vnode routing inside the same SPMD step — but only a kernel
+        that has not yet seen data can adopt one."""
+        return not self._touched
+
+    def set_prelude(self, prelude, raw_width: int,
+                    metrics_label: Optional[str] = None,
+                    prelude_key: Optional[str] = None) -> None:
+        """Install the fused-input prelude (build_agg_prelude). Must
+        run before any data touches the kernel — the raw codec changes
+        the upload layout. ``prelude_key`` is the run's STRUCTURAL
+        identity (FusedStages.trace_key): equal runs share compiled
+        steps across kernel instances and sessions."""
+        assert not self._touched, "set_prelude after data flowed"
+        self._prelude = prelude
+        self._raw_width = int(raw_width)
+        self._prelude_key = prelude_key or f"id:{id(prelude)}"
+        self.metrics_label = metrics_label
+        if metrics_label:
+            self._span_label = metrics_label
 
     # -- the SPMD step ----------------------------------------------------
-    def _build_step(self, n_rows: int, bucket: int):
+    # The step consumes the single-chip PACKED chunk matrix
+    # (ops/hash_agg.pack_chunk: keys | sign | vis | per call lanes +
+    # valid) — ONE routed payload through the all_to_all instead of a
+    # flat array per lane, and the same host codec as the single-chip
+    # kernel (no drifting twin). With a prelude, the upload is the RAW
+    # int64 matrix and the absorbed run traces ahead of the routing.
+    def _build_packed_step(self, bucket: int):
         specs = self.specs
         slices = _call_slices(specs)
+        call_cols = packed_layout(self.key_width, specs)
         n_dev = self.n_dev
+        kw = self.key_width
 
-        def local_step(state: AggState, key_lanes, signs, vis, flat_in,
-                       owner_map):
+        def local_step(state: AggState, packed, owner_map):
             # shard_map hands each shard a [1, ...] block: drop the axis
             state = jax.tree.map(lambda a: a[0], state)
+            key_lanes = packed[:, :kw]
+            vis = packed[:, kw + 1].astype(bool)
             vn = vnodes_from_lanes(key_lanes)
             owner = owner_map[vn]
-            # payload layout: keys, signs, then per call: lanes* + valid
-            payloads = [key_lanes, signs] + list(flat_in)
             buckets, bvalid, overflow = bucketize_by_owner(
-                owner, vis, payloads, n_dev, bucket)
+                owner, vis, [packed], n_dev, bucket)
             recv, rvalid = exchange(buckets, bvalid, AXIS)
             m = n_dev * bucket
-            rkeys = recv[0].reshape(m, key_lanes.shape[1])
-            rsigns = recv[1].reshape(m)
-            rflat = [r.reshape(m) for r in recv[2:]]
+            rp = recv[0].reshape(m, packed.shape[1])
             rvis = rvalid.reshape(m)
-            table, slots, ins = ht.probe_insert(state.table, rkeys, rvis)
+            rkeys = rp[:, :kw]
+            table, slots, ins = ht.probe_insert(state.table, rkeys,
+                                                rvis)
             cap = state.table.capacity
             scat = jnp.where(rvis, slots, cap)
-            s32 = rsigns.astype(jnp.int32)
+            s32 = rp[:, kw]
             group_rows = state.group_rows.at[scat].add(s32, mode="drop")
             dirty = state.dirty.at[scat].set(True, mode="drop")
             accs = list(state.accs)
-            k = 0
-            for spec, sl in zip(specs, slices):
-                n_in = n_input_lanes(spec)
-                in_lanes = tuple(rflat[k:k + n_in])
-                val_ok = rflat[k + n_in]
-                k += n_in + 1
+            for spec, sl, (lc, vc) in zip(specs, slices, call_cols):
+                if spec.is_float_sum:
+                    in_lanes = tuple(jax.lax.bitcast_convert_type(
+                        rp[:, i], jnp.float32) for i in lc)
+                else:
+                    in_lanes = tuple(rp[:, i] for i in lc)
+                val_ok = jnp.ones(m, dtype=bool) if vc is None \
+                    else rp[:, vc].astype(bool)
                 _update_call(spec, accs, sl, in_lanes, val_ok, slots,
                              rvis, s32, cap)
             new = AggState(table, group_rows, dirty, tuple(accs),
@@ -219,63 +324,252 @@ class ShardedAggKernel:
         state_spec = jax.tree.map(lambda _: P(AXIS), self.state)
         mapped = jaxtools.shard_map(
             local_step, mesh=self.mesh,
-            in_specs=(state_spec, P(AXIS), P(AXIS), P(AXIS), P(AXIS),
-                      P()),
+            in_specs=(state_spec, P(AXIS), P()),
             out_specs=(state_spec, P(AXIS), P(AXIS)),
             check_vma=False)
         return jaxtools.instrumented_jit(
             mapped, "parallel_agg.step", donate_argnums=(0,))
 
+    def _build_raw_step(self, bucket: int):
+        """The prelude (fused) twin: raw int64 rows → the absorbed
+        filter/project run → key/lane encode — all traced BEFORE the
+        vnode routing, per shard, in the same SPMD step (ISSUE 10:
+        `fusion_grouping` stops refusing mesh plans)."""
+        specs = self.specs
+        slices = _call_slices(specs)
+        n_dev = self.n_dev
+        prelude = self._prelude
+
+        def local_step(state: AggState, raw, owner_map):
+            state = jax.tree.map(lambda a: a[0], state)
+            key_lanes, s32, vis, call_inputs, stage_rows = prelude(raw)
+            local_n = key_lanes.shape[0]
+            vn = vnodes_from_lanes(key_lanes)
+            owner = owner_map[vn]
+            payloads = [key_lanes, s32.astype(jnp.int32)]
+            for spec, (in_lanes, val_ok) in zip(specs, call_inputs):
+                payloads.extend(in_lanes)
+                payloads.append(
+                    jnp.ones(local_n, dtype=bool) if val_ok is None
+                    else val_ok)
+            buckets, bvalid, overflow = bucketize_by_owner(
+                owner, vis, payloads, n_dev, bucket)
+            recv, rvalid = exchange(buckets, bvalid, AXIS)
+            m = n_dev * bucket
+            rkeys = recv[0].reshape(m, key_lanes.shape[1])
+            rsigns = recv[1].reshape(m)
+            rflat = [r.reshape(m) for r in recv[2:]]
+            rvis = rvalid.reshape(m)
+            table, slots, ins = ht.probe_insert(state.table, rkeys,
+                                                rvis)
+            cap = state.table.capacity
+            scat = jnp.where(rvis, slots, cap)
+            group_rows = state.group_rows.at[scat].add(rsigns,
+                                                       mode="drop")
+            dirty = state.dirty.at[scat].set(True, mode="drop")
+            accs = list(state.accs)
+            k = 0
+            for spec, sl in zip(specs, slices):
+                n_in = n_input_lanes(spec)
+                in_lanes = tuple(rflat[k:k + n_in])
+                val_ok = rflat[k + n_in]
+                k += n_in + 1
+                _update_call(spec, accs, sl, in_lanes, val_ok, slots,
+                             rvis, rsigns, cap)
+            new = AggState(table, group_rows, dirty, tuple(accs),
+                           state.emitted_valid, state.emitted_rows,
+                           state.emitted_accs)
+            new = jax.tree.map(lambda a: a[None], new)
+            return (new, ins[None], overflow[None],
+                    stage_rows[None])
+
+        state_spec = jax.tree.map(lambda _: P(AXIS), self.state)
+        mapped = jaxtools.shard_map(
+            local_step, mesh=self.mesh,
+            in_specs=(state_spec, P(AXIS), P()),
+            out_specs=(state_spec, P(AXIS), P(AXIS), P(AXIS)),
+            check_vma=False)
+        return jaxtools.instrumented_jit(
+            mapped, "parallel_agg.step_fused", donate_argnums=(0,))
+
     def apply(self, key_lanes: np.ndarray, signs: np.ndarray,
               vis: np.ndarray,
               inputs: Sequence[Tuple[Sequence[np.ndarray], np.ndarray]]
               ) -> None:
-        """One SPMD step over a host batch.
+        """Buffer one host chunk for the epoch's SPMD step.
 
-        Rows are split evenly across shards (row-sharded upload); the
-        all_to_all then moves each row to its vnode owner. `inputs` is
-        per call (value lanes, valid mask) — the single-chip layout;
-        lanes AND validity travel through the exchange. Batch rows must
-        divide n_dev.
+        ISSUE 10: chunks accumulate host-side (the single-chip packed
+        codec) and the whole epoch ships as ONE routed SPMD dispatch at
+        the barrier flush (or per BATCH_ROWS slab mid-epoch) — signs
+        and visibility ride the packed aux columns, and the adds
+        commute across the epoch fold (limb/count adds exactly;
+        MIN/MAX idempotently), so the batched application equals the
+        per-chunk one. `inputs` is per call (value lanes, valid mask).
+        With epoch_batch=False every apply dispatches immediately (the
+        per-chunk oracle arm).
         """
-        n = key_lanes.shape[0]
-        if n % self.n_dev:
-            m = (n + self.n_dev - 1) // self.n_dev * self.n_dev
-            key_lanes = _pad_rows(np.asarray(key_lanes), m)
-            signs = _pad_rows(np.asarray(signs), m)
-            vis = _pad_rows(np.asarray(vis), m)   # pad rows invisible
-            inputs = [
-                (tuple(_pad_rows(np.asarray(a), m) for a in in_lanes),
-                 None if valid is None
-                 else _pad_rows(np.asarray(valid), m))
-                for in_lanes, valid in inputs]
-            n = m
+        assert self._prelude is None, \
+            "fused kernel takes raw chunks (apply_raw)"
+        self._touched = True
+        packed = pack_chunk(self.key_width, self.specs,
+                            np.asarray(key_lanes), np.asarray(signs),
+                            np.asarray(vis), inputs)
+        n = packed.shape[0]
+        if self._backlog_rows + n > self.BATCH_ROWS:
+            self._dispatch_backlog()
+        self._backlog.append(packed)
+        self._backlog_rows += n
+        # growth decisions run per buffered chunk (pessimistic bound
+        # over the whole backlog): the rehash happens off the dispatch
+        # path, and a table sized for its stream never re-checks
+        self._reserve(self._backlog_rows)
+        if not self.epoch_batch or \
+                self._backlog_rows >= self.BATCH_ROWS:
+            self._dispatch_backlog()
+
+    def owners_of(self, key_lanes: np.ndarray) -> np.ndarray:
+        """Host twin of the device vnode routing (the executor feeds
+        per-row owners back for the skew-exact bucket on the fused
+        path, where the trace alone holds the derived lanes) — the
+        shared exchange helper, one copy with the join kernel."""
+        return owners_host(key_lanes, self._owner_map_host)
+
+    def apply_raw(self, raw: np.ndarray, n_visible: int,
+                  owners: Optional[np.ndarray] = None) -> None:
+        """Fused-fragment hot path: backlog one RAW int64 chunk matrix
+        (ops/fused.encode_raw_chunk) plus an always-invisible separator
+        row — the traced chain's shifted compares must never marry rows
+        across chunk boundaries (the separator-row codec of
+        ops/fused.py, reused as the epoch buffer's chunk-boundary aux
+        marker). ``owners`` (host-derived when the group keys map to
+        raw columns) rides along for the skew-exact routing bucket —
+        a PRE-filter superset of the routed rows, so the bound stays
+        safe when the traced filter drops rows."""
+        assert self._prelude is not None, \
+            "apply_raw needs a fused (set_prelude) kernel"
+        self._touched = True
+        n = raw.shape[0] + 1
+        if self._backlog_rows + n > self.BATCH_ROWS:
+            self._dispatch_backlog()
+        self._backlog.append(raw)
+        self._backlog.append(np.zeros((1, raw.shape[1]),
+                                      dtype=np.int64))   # separator
+        if owners is not None:
+            ow = np.full(n, -1, dtype=np.int64)
+            vis = raw[:, 1] != 0
+            ow[:n - 1][vis] = np.asarray(owners)[vis]
+            self._backlog_owners.append(ow)
+        else:
+            self._backlog_owners.append(None)
+        self._backlog_rows += n
+        self._backlog_vis += int(n_visible)
+        self._reserve(self._backlog_rows)
+        if not self.epoch_batch or \
+                self._backlog_rows >= self.BATCH_ROWS:
+            self._dispatch_backlog()
+
+    def _dispatch_backlog(self) -> None:
+        """Ship the buffered epoch rows as ONE SPMD dispatch: pad to
+        the fixed batch shape (one compiled program; pad rows are
+        invisible and route nowhere), route every row to its vnode
+        owner, apply locally."""
+        if not self._backlog:
+            return
+        mats, n = self._backlog, self._backlog_rows
+        n_vis = self._backlog_vis
+        owner_chunks = self._backlog_owners
+        self._backlog, self._backlog_rows = [], 0
+        self._backlog_owners = []
+        self._backlog_vis = 0
+        raw_mode = self._prelude is not None
         # per-shard post-exchange batch is n_dev*bucket rows in ONE
-        # scatter step — same int32 limb bound as the single-chip kernel
-        if n > lanes.MAX_CHUNK_ROWS:
-            raise RuntimeError(
-                f"batch {n} > {lanes.MAX_CHUNK_ROWS} breaks limb math")
+        # traced step; limb sums stay exact past MAX_CHUNK_ROWS
+        # because _update_call slices the batch and carry-normalizes
+        # per slab (the single-chip 32K backlog rides the same path)
         self._reserve(n)
-        flat: List[jnp.ndarray] = []
-        for in_lanes, valid in inputs:
-            flat.extend(jnp.asarray(a) for a in in_lanes)
-            if valid is None:            # count(*) — same API as the
-                valid = np.ones(n, dtype=bool)   # single-chip kernel
-            flat.append(jnp.asarray(valid))
-        # each shard holds n/n_dev local rows, so no owner can receive
-        # more than that: bucket = n/n_dev is overflow-free by
-        # construction AND keeps the exchanged tensor at n rows/shard
-        bucket = self.bucket or n // self.n_dev
-        key = (n, bucket)
-        if key not in self._step_cache:
-            self._step_cache[key] = self._build_step(n, bucket)
-        step = self._step_cache[key]
-        self.state, ins, overflow = step(
-            self.state, jnp.asarray(key_lanes), jnp.asarray(signs),
-            jnp.asarray(vis), tuple(flat), self.owner_map)
+        # pow2-bucketed batch shape (the join epoch path's convention):
+        # steady-state epochs repeat a handful of shapes — the
+        # RecompileGuard's sharded contract — without padding every
+        # small epoch to the full 32K slab
+        cap_rows = max(next_pow2(n), self.n_dev)
+        if cap_rows % self.n_dev:
+            cap_rows += self.n_dev - (cap_rows % self.n_dev)
+        w = mats[0].shape[1]
+        packed = np.zeros((cap_rows, w),
+                          dtype=np.int64 if raw_mode else np.int32)
+        at = 0                       # pad rows: vis=0
+        for m_ in mats:
+            packed[at:at + m_.shape[0]] = m_
+            at += m_.shape[0]
+        local = cap_rows // self.n_dev
+        bucket = self.bucket or local
+        if raw_mode and self.bucket is None and owner_chunks and \
+                all(o is not None for o in owner_chunks):
+            ow = np.full(cap_rows, -1, dtype=np.int64)
+            ow[:n] = np.concatenate(owner_chunks)
+            bucket = skew_bucket(ow, ow >= 0, self.n_dev, local)
+        if not raw_mode and self.bucket is None:
+            # skew-exact routing bucket (the join's stage_epoch
+            # scheme): the default (= local rows) makes every shard
+            # process the WHOLE batch post-exchange — n_dev× the
+            # single-chip compute; exact per-(sender, target) counts
+            # from the host key lanes collapse it to the real skew,
+            # pow2-quantized for shape stability. The fused raw path
+            # keeps the worst case (its lanes only exist in-trace).
+            kw_ = self.key_width
+            vis_col = packed[:, kw_ + 1] != 0
+            owner = owners_host(packed[:, :kw_], self._owner_map_host)
+            bucket = skew_bucket(owner, vis_col, self.n_dev, local)
+        key = (cap_rows, bucket, raw_mode)
+        step = self._step_cache.get(key)
+        if step is None:
+            if raw_mode:
+                # structural prelude key (set_prelude): equal fused
+                # runs share the compiled step across instances
+                mkey = self._prog_key("step_fused", bucket,
+                                      self._prelude_key)
+                step = _PROG_CACHE.get(mkey)
+                if step is None:
+                    step = self._build_raw_step(bucket)
+                    _PROG_CACHE[mkey] = step
+            else:
+                mkey = self._prog_key("step", bucket)
+                step = _PROG_CACHE.get(mkey)
+                if step is None:
+                    step = self._build_packed_step(bucket)
+                    _PROG_CACHE[mkey] = step
+            self._step_cache[key] = step
+        up = jax.device_put(packed,
+                            NamedSharding(self.mesh, P(AXIS)))
+        _note_dispatch(n_vis if raw_mode else n)
+        if raw_mode:
+            with spans.dispatch_span(self._span_label, n_vis,
+                                     batch_rows=n):
+                self.state, ins, overflow, stage_rows = step(
+                    self.state, up, self.owner_map)
+            jaxtools.start_fetch(stage_rows)
+            self._stage_pending.append(stage_rows)
+        else:
+            with spans.dispatch_span(self._span_label, n,
+                                     batch_rows=n):
+                self.state, ins, overflow = step(self.state, up,
+                                                 self.owner_map)
         # overflow/insert counters fold in asynchronously — a blocking
-        # read per apply costs 70ms-1s on the tunneled chip
+        # read per dispatch costs 70ms-1s on the tunneled chip
         self._counters.push(ins, overflow, n)
+
+    def drain_stage_rows(self) -> Optional[np.ndarray]:
+        """Sum of per-stage visible-row counts since the last drain
+        (fused mode; per-shard vectors sum across the mesh — each raw
+        row is counted by exactly one shard pre-routing)."""
+        if not self._stage_pending:
+            return None
+        total = None
+        for v in self._stage_pending:
+            a = np.asarray(jaxtools.fetch1(v)).sum(axis=0)
+            total = a if total is None else total + a
+        self._stage_pending = []
+        return np.asarray(total)
 
     def _reserve(self, n: int) -> None:
         """Grow (per-shard rehash) until the fullest shard keeps room
@@ -315,7 +609,10 @@ class ShardedAggKernel:
         (ownership is a function of the key hash), so the merged result
         is a disjoint union and HashAggExecutor's emission/persistence
         logic runs unchanged on it."""
-        # drain first: reset() would discard pending bucket-overflow
+        # the epoch's buffered rows ship as ONE SPMD dispatch here —
+        # the barrier IS the sharded batch boundary (ISSUE 10)
+        self._dispatch_backlog()
+        # drain next: reset() would discard pending bucket-overflow
         # flags, and an overflow MUST surface before this barrier's
         # results are treated as complete
         self._counters.drain_all()
@@ -324,9 +621,13 @@ class ShardedAggKernel:
             if fc not in self._gather_cache:
                 self._gather_cache[fc] = self._shardwise(
                     partial(gather_packed, flush_cap=fc), donate=False,
-                    out_spec=P(AXIS))
-            mats = jaxtools.fetch1(self._gather_cache[fc](self.state))
+                    out_spec=P(AXIS), cache_key=("gather", fc))
+            with spans.dispatch_span(f"{self._span_label}.flush",
+                                     self._counters.bound()):
+                mats = jaxtools.fetch1(
+                    self._gather_cache[fc](self.state))
             ps = mats[:, 0, 0]
+            _note_dispatch(float(ps.sum()))
             self._counters.reset(mats[:, 0, 1])
             worst = int(ps.max())
             if worst <= fc:
@@ -379,13 +680,18 @@ class ShardedAggKernel:
             self._patch_step = self._shardwise(
                 lambda st, ix, *cols: patch(st, ix, tuple(cols)),
                 donate=True,
-                extra_specs=(P(AXIS),) * (1 + n_cols))
+                extra_specs=(P(AXIS),) * (1 + n_cols),
+                cache_key=("patch", n_cols))
         self.state = self._patch_step(
             self.state, jnp.asarray(bidx),
             *(jnp.asarray(b) for b in bcols))
 
     def retire_below(self, group_pos: int, wm_i64: int) -> None:
-        """Watermark state cleaning, every shard in one SPMD step."""
+        """Watermark state cleaning, every shard in one SPMD step.
+        Runs post-flush only — a buffered epoch batch here would apply
+        rows to already-retired groups out of order."""
+        if self._backlog_rows:
+            raise RuntimeError("retire_below with undispatched backlog")
         if self._retire_jit is None:
             fills = self._fills
             off = group_pos * 3
@@ -393,7 +699,8 @@ class ShardedAggKernel:
                 lambda st, hi, lo: retire_state(st, hi, lo, off, fills),
                 donate=True,
                 out_spec=(self._state_spec, P(AXIS)),
-                extra_specs=(P(), P()))
+                extra_specs=(P(), P()),
+                cache_key=("retire", off))
             self._retire_off = off
         assert self._retire_off == group_pos * 3, \
             "one watermark column per kernel"
@@ -407,6 +714,11 @@ class ShardedAggKernel:
         group to its owning shard on the host (recovery is cold path;
         the steady-state exchange stays on device)."""
         n = len(group_rows)
+        self._backlog = []
+        self._backlog_owners = []
+        self._backlog_rows = 0
+        self._backlog_vis = 0
+        self._stage_pending = []
         self.state = jax.tree.map(
             lambda a: jax.device_put(
                 a, NamedSharding(self.mesh, P(AXIS))),
@@ -561,10 +873,15 @@ class ShardedAggKernel:
                 f"{cap} slots — raise capacity before rescaling")
         self.state = new_state
         self.owner_map = new_map   # apply steps take it as a runtime arg
+        # host twin follows (the skew-exact bucket counts against it)
+        self._owner_map_host = np.asarray(new_owner_map,
+                                          dtype=np.int32)
 
     # -- host-side full decode (tests + dryrun assertions) ---------------
     def snapshot(self) -> Dict[tuple, tuple]:
         """group key lanes tuple → decoded outputs, across all shards."""
+        self._dispatch_backlog()
+        self._counters.drain_all()
         st = jax.device_get(self.state)
         out: Dict[tuple, tuple] = {}
         for d in range(self.n_dev):

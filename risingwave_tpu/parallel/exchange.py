@@ -21,7 +21,9 @@ from typing import List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from risingwave_tpu.common.chunk import next_pow2
 from risingwave_tpu.common.hash import VNODE_COUNT
 from risingwave_tpu.ops.hash_table import hash_key_lanes
 
@@ -31,6 +33,40 @@ def vnodes_from_lanes(key_lanes: jnp.ndarray) -> jnp.ndarray:
     common.hash.vnodes_of for pre-split lanes)."""
     return (hash_key_lanes(key_lanes)
             & jnp.uint32(VNODE_COUNT - 1)).astype(jnp.int32)
+
+
+def owners_host(key_lanes: np.ndarray,
+                owner_map_host: np.ndarray) -> np.ndarray:
+    """HOST twin of the device routing above (same hash → same owner)
+    — the ONE copy both sharded kernels use for capacity guards and
+    the skew-exact bucket; drifting from `vnodes_from_lanes` would
+    silently break the overflow-impossible contract."""
+    from risingwave_tpu.common.hash import hash_columns_host
+    lanes = np.asarray(key_lanes)
+    h = hash_columns_host([lanes[:, i] for i in range(lanes.shape[1])])
+    return owner_map_host[
+        (h & np.uint32(VNODE_COUNT - 1)).astype(np.int64)]
+
+
+def skew_bucket(owner: np.ndarray, mask: np.ndarray, n_dev: int,
+                local: int) -> int:
+    """Skew-exact per-(sender, target) routing bound for one staged
+    batch of n_dev*local row-sharded rows: the all_to_all receive
+    shape is n_dev*bucket rows per shard, and the conservative
+    default (bucket = local) makes every shard process the WHOLE
+    batch — n_dev× the single-chip compute. Exact bincounts collapse
+    it to the real skew; the result is pow2-quantized on a coarse
+    3-step ladder (local/n_dev … local) so steady state reuses a
+    handful of compiled shapes. Overflow stays impossible: the bound
+    is computed, not guessed."""
+    worst = 1
+    for s in range(n_dev):
+        sl = owner[s * local:(s + 1) * local]
+        sl = sl[mask[s * local:(s + 1) * local]]
+        if len(sl):
+            worst = max(worst, int(np.bincount(
+                sl, minlength=n_dev).max()))
+    return min(local, max(local // n_dev, next_pow2(worst)))
 
 
 def bucketize_by_owner(owner: jnp.ndarray, valid: jnp.ndarray,

@@ -28,20 +28,43 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from risingwave_tpu.common.chunk import next_pow2
-from risingwave_tpu.common.hash import (
-    VNODE_COUNT, hash_columns_host,
-)
+from risingwave_tpu.common.hash import VNODE_COUNT
 from risingwave_tpu.ops import hash_table as ht
 from risingwave_tpu.ops.hash_join import (
-    I32_MAX, ChainState, _remap_head, link_rows, probe_pairs,
-    tombstone_rows,
+    AUX_DEL_REF, AUX_FLAGS, AUX_INS_REF, AUX_SEQ, FLAG_DEL, FLAG_INS,
+    FLAG_PROBE, I32_MAX, ChainState, _remap_head, link_rows,
+    probe_pairs, tombstone_rows,
 )
 from risingwave_tpu.parallel.exchange import (
-    bucketize_by_owner, exchange, vnodes_from_lanes,
+    bucketize_by_owner, exchange, owners_host, skew_bucket,
+    vnodes_from_lanes,
 )
 from risingwave_tpu.utils import jaxtools
 
 AXIS = "d"
+
+# Compiled SPMD steps, shared ACROSS kernel instances (both sides of a
+# join share shapes; capacity growth keys fresh entries instead of
+# clearing): keyed by (mesh device ids, program kind, every static the
+# closure bakes in). Before this cache, each _JoinSide's kernel rebuilt
+# — and re-traced — its own steps on any shape churn, which the
+# RecompileGuard now polices on the sharded path too.
+_STEP_CACHE: Dict[tuple, object] = {}
+
+
+def _step_key(mesh: Mesh, kind: str, *statics) -> tuple:
+    return ((kind,) + tuple(int(d.id) for d in mesh.devices.flat)
+            + statics)
+
+
+def _note_dispatch(rows: float, kernel: str) -> None:
+    """Real-SPMD-dispatch accounting at the jit sites (the sharded
+    twin of the fused kernels' metrics_label counting): one inc per
+    `shard_map` launch, with true row density — the executor layer
+    does NOT count for sharded kernels, so totals never double."""
+    from risingwave_tpu.utils.metrics import STREAMING
+    STREAMING.device_dispatch.inc(1, kernel=kernel)
+    STREAMING.rows_per_dispatch.observe(float(rows), kernel=kernel)
 
 
 class ShardedPendingProbe:
@@ -102,6 +125,73 @@ class ShardedPendingProbe:
         return deg, probe_idx[order], ref_arr[order]
 
 
+class ShardedPendingEpochProbe:
+    """In-flight sharded EPOCH probe (ops/hash_join.PendingEpochProbe
+    parity over the per-shard packed matrices).
+
+    collect() parses each shard's [1 + (m) + out_cap, 2] block —
+    header, per-routed-row degree rows (with_degrees only), then
+    (global probe row, ref) pairs — scatters degrees back to the
+    global epoch row space and concatenates pairs sorted stably by
+    probe row. A probe row's key routes to exactly ONE owner shard, so
+    per-row match order is that shard's chain walk, preserved by the
+    stable sort. Payload lanes and device old-degrees are None: the
+    sharded path materializes rows from the host arena and keeps
+    degrees in the executor's host arrays."""
+
+    def __init__(self, kernel: "ShardedJoinKernel", mats, n_rows: int,
+                 out_cap: int, with_degrees: bool, redispatch,
+                 overflow=None):
+        self.kernel = kernel
+        self.mats = mats
+        self.n = n_rows               # padded epoch rows
+        self.out_cap = out_cap
+        self.with_degrees = with_degrees
+        self.redispatch = redispatch
+        self.overflow = overflow
+
+    def collect(self):
+        """(degrees | None, probe_idx, refs, None, None) over the
+        CONCATENATED epoch row space, pairs sorted by probe row."""
+        k = self.kernel
+        k.drain_overflows()
+        while True:
+            if self.overflow is not None and \
+                    bool(np.asarray(jaxtools.fetch1(
+                        self.overflow)).any()):
+                raise RuntimeError(
+                    "bucket overflow routing epoch join probes")
+            mats = np.asarray(jaxtools.fetch1(self.mats))
+            worst = int(mats[:, 0, 0].max())
+            if worst <= self.out_cap:
+                break
+            while k.probe_capacity < worst:
+                k.probe_capacity *= 2
+            self.out_cap = k.probe_capacity
+            self.mats, self.overflow = self.redispatch(self.out_cap)
+        m = mats.shape[1] - 1 - self.out_cap
+        deg = None
+        if self.with_degrees:
+            deg = np.zeros(self.n, dtype=np.int32)
+        probes, refs = [], []
+        for d in range(mats.shape[0]):
+            if self.with_degrees:
+                blk = mats[d, 1:1 + m]
+                rid, dg = blk[:, 1], blk[:, 0]
+                sel = rid >= 0
+                deg[rid[sel]] = dg[sel]
+            total = int(mats[d, 0, 0])
+            pairs = mats[d, 1 + m:1 + m + total]
+            probes.append(pairs[:, 0])
+            refs.append(pairs[:, 1])
+        probe_idx = np.concatenate(probes) if probes else \
+            np.zeros(0, np.int32)
+        ref_arr = np.concatenate(refs) if refs else np.zeros(0, np.int32)
+        order = np.argsort(probe_idx, kind="stable")
+        return (deg, probe_idx[order].astype(np.int64),
+                ref_arr[order], None, None)
+
+
 class ShardedJoinKernel:
     """JoinSideKernel's API over a device mesh (multi-chip join side).
 
@@ -113,10 +203,14 @@ class ShardedJoinKernel:
     scheme. The bound is GLOBAL while the limit is PER-SHARD, so it is
     conservative: a false trip costs one sync, never a false pass."""
 
+    # pre-sized like JoinSideKernel.DEFAULT_CAPACITY: every growth
+    # doubling rehashes AND re-keys every compiled SPMD step (a fresh
+    # trace per program — multi-second stalls on the p99 tail), so the
+    # defaults absorb typical runs and growth multiplies by 4x
     def __init__(self, mesh: Mesh, key_width: int,
-                 key_capacity: int = 1 << 14,
-                 row_capacity: int = 1 << 16,
-                 probe_capacity: int = 1 << 12):
+                 key_capacity: int = 1 << 15,
+                 row_capacity: int = 1 << 17,
+                 probe_capacity: int = 1 << 13):
         self.mesh = mesh
         self.n_dev = mesh.devices.size
         self.key_width = key_width
@@ -133,12 +227,16 @@ class ShardedJoinKernel:
         self._owner_map_host = owners
         self._sharding = NamedSharding(mesh, P(AXIS))
         self._fresh_state()
-        self._apply_cache: Dict[tuple, object] = {}
-        self._probe_only_cache: Dict[tuple, object] = {}
-        self._delete_cache: Dict[tuple, object] = {}
-        self._insert_cache: Dict[tuple, object] = {}
         # per-shard distinct-key upper bound (host)
         self._keys_upper = np.zeros(self.n_dev, dtype=np.int64)
+        # apply-step overflow flags, checked lazily at the next probe
+        # collect (impossible by construction — an assertion, never a
+        # retry point; a sync here would block the dispatch hot path)
+        self._apply_overflows: list = []
+        # fused-input preludes by key (the epoch jits bake them in)
+        self._preludes: Dict[str, object] = {}
+        # epoch-trace identity stamped on dispatch metrics
+        self._span_label = "ShardedJoinKernel"
 
     @property
     def row_capacity(self) -> int:
@@ -165,11 +263,10 @@ class ShardedJoinKernel:
 
     # -- capacity management (state > device: grows, never fatal) ---------
     def _owners_host(self, key_lanes: np.ndarray) -> np.ndarray:
-        """Host twin of the device routing (same hash → same owner)."""
-        h = hash_columns_host([key_lanes[:, i]
-                               for i in range(key_lanes.shape[1])])
-        vn = (h & np.uint32(VNODE_COUNT - 1)).astype(np.int64)
-        return self._owner_map_host[vn]
+        """Host twin of the device routing (same hash → same owner) —
+        the shared exchange helper, so device and host routing live in
+        one place."""
+        return owners_host(key_lanes, self._owner_map_host)
 
     def _guard_keys(self, key_lanes: np.ndarray, vis: np.ndarray) -> None:
         """PER-SHARD distinct-key upper bound; grows the key tables
@@ -198,7 +295,10 @@ class ShardedJoinKernel:
             self._grow_keys(next_pow2(int(worst / ht.MAX_LOAD) + 1))
 
     def _grow_keys(self, new_capacity: int) -> None:
-        new_capacity = max(new_capacity, self.key_capacity * 2)
+        # 4x, not 2x: each growth re-traces every step at the new
+        # capacity statics (see _STEP_CACHE) — same amortization as
+        # JoinSideKernel.reserve_rows
+        new_capacity = max(new_capacity, self.key_capacity * 4)
         key_width = self.key_width
         n_dev = self.n_dev
 
@@ -221,10 +321,9 @@ class ShardedJoinKernel:
         step = jax.jit(mapped, donate_argnums=(0, 1))
         self.table, self.chains = step(self.table, self.chains)
         self.key_capacity = new_capacity
-        self._apply_cache.clear()
-        self._probe_only_cache.clear()
-        self._delete_cache.clear()
-        self._insert_cache.clear()
+        # no jit-cache clearing: the module-level _STEP_CACHE keys on
+        # the capacities, so the grown shapes simply compile fresh
+        # entries while the old ones stay valid for other kernels
 
     def _guard_refs(self, refs: np.ndarray, mask: np.ndarray) -> None:
         if mask.any():
@@ -235,7 +334,7 @@ class ShardedJoinKernel:
     def _grow_rows(self, new_capacity: int) -> None:
         """Row-array growth: concat padding along the per-shard axis
         (refs index rows directly; nothing remaps)."""
-        new_capacity = max(new_capacity, self._row_capacity * 2)
+        new_capacity = max(new_capacity, self._row_capacity * 4)
         pad = new_capacity - self._row_capacity
 
         def padded(a, fill):
@@ -250,10 +349,6 @@ class ShardedJoinKernel:
             ins_seq=padded(self.chains.ins_seq, I32_MAX),
             del_seq=padded(self.chains.del_seq, I32_MAX))
         self._row_capacity = new_capacity
-        self._apply_cache.clear()
-        self._probe_only_cache.clear()
-        self._delete_cache.clear()
-        self._insert_cache.clear()
 
     def reserve_rows(self, max_ref: int) -> None:
         if max_ref >= self._row_capacity:
@@ -281,7 +376,16 @@ class ShardedJoinKernel:
         flat = [r.reshape(m) for r in recv[1:]]
         return rlanes, flat, rvalid.reshape(m), overflow
 
+    def _statics(self) -> tuple:
+        """The closure-baked shape statics every step key carries."""
+        return (self.key_width, self.key_capacity, self._row_capacity)
+
     def _build_apply_probe(self, bucket: int, out_cap: int):
+        key = _step_key(self.mesh, "apply_probe", bucket, out_cap,
+                        *self._statics())
+        step = _STEP_CACHE.get(key)
+        if step is not None:
+            return step
         n_dev = self.n_dev
         cap = self.key_capacity
 
@@ -330,9 +434,17 @@ class ShardedJoinKernel:
                       P(), P()),
             out_specs=(tspec, cspec, P(AXIS), P(AXIS)),
             check_vma=False)
-        return jax.jit(mapped, donate_argnums=(0, 1))
+        step = jaxtools.instrumented_jit(
+            mapped, "parallel_join.apply_probe", donate_argnums=(0, 1))
+        _STEP_CACHE[key] = step
+        return step
 
     def _build_probe_only(self, bucket: int, out_cap: int):
+        key = _step_key(self.mesh, "probe_only", bucket, out_cap,
+                        *self._statics())
+        step = _STEP_CACHE.get(key)
+        if step is not None:
+            return step
         n_dev = self.n_dev
 
         def local(t, c, lanes, rowids, vis, seq, owner_map):
@@ -361,9 +473,16 @@ class ShardedJoinKernel:
                       P()),
             out_specs=(P(AXIS), P(AXIS)),
             check_vma=False)
-        return jax.jit(mapped)
+        step = jaxtools.instrumented_jit(mapped,
+                                         "parallel_join.probe")
+        _STEP_CACHE[key] = step
+        return step
 
     def _build_delete(self, bucket: int):
+        key = _step_key(self.mesh, "delete", bucket, *self._statics())
+        step = _STEP_CACHE.get(key)
+        if step is not None:
+            return step
         n_dev = self.n_dev
 
         def local(c, lanes, drefs, dmask, seq, owner_map):
@@ -379,10 +498,17 @@ class ShardedJoinKernel:
             in_specs=(cspec, P(AXIS), P(AXIS), P(AXIS), P(), P()),
             out_specs=(cspec, P(AXIS)),
             check_vma=False)
-        return jax.jit(mapped, donate_argnums=(0,))
+        step = jaxtools.instrumented_jit(
+            mapped, "parallel_join.delete", donate_argnums=(0,))
+        _STEP_CACHE[key] = step
+        return step
 
     def _build_insert(self, bucket: int):
         """Insert-only step (rebuild/insert): route+probe_insert+link."""
+        key = _step_key(self.mesh, "insert", bucket, *self._statics())
+        step = _STEP_CACHE.get(key)
+        if step is not None:
+            return step
         n_dev = self.n_dev
         cap = self.key_capacity
 
@@ -403,7 +529,276 @@ class ShardedJoinKernel:
                       P()),
             out_specs=(tspec, cspec, P(AXIS)),
             check_vma=False)
-        return jax.jit(mapped, donate_argnums=(0, 1))
+        step = jaxtools.instrumented_jit(
+            mapped, "parallel_join.insert", donate_argnums=(0, 1))
+        _STEP_CACHE[key] = step
+        return step
+
+    # -- epoch batching (ISSUE 10 tentpole) -------------------------------
+    # One SPMD dispatch per side per epoch instead of one per chunk:
+    # the executor concatenates every chunk of the epoch into the same
+    # [key_lanes] + aux matrices the single-chip epoch path ships, and
+    # the apply/probe steps below route the WHOLE epoch's rows to their
+    # vnode owners in one all_to_all, then run the exact single-chip
+    # kernels locally with PER-ROW sequences (sequence visibility makes
+    # the batched application order-equivalent to per-chunk applies).
+    # On the 4-virtual-device CPU mesh each shard_map host dispatch
+    # costs ~100ms (BENCH_r09: the whole ad-ctr p99 tail) — this drops
+    # the count by the chunks-per-epoch factor.
+
+    def _guard_keys_blind(self, n_ins: int) -> None:
+        """Conservative key guard when host key lanes are unavailable
+        (fused raw uploads: lanes derive in-trace). Every insert could
+        route to one shard; a false trip costs one exact-occupancy
+        sync, never a false pass — same contract as _guard_keys."""
+        if n_ins == 0:
+            return
+        self._keys_upper = self._keys_upper + n_ins
+        limit = ht.MAX_LOAD * self.key_capacity
+        if int(self._keys_upper.max()) <= limit:
+            return
+        per_shard = np.asarray(jnp.sum(self.table.occ, axis=1)) \
+            .astype(np.int64)
+        need = per_shard + n_ins
+        self._keys_upper = need
+        worst = int(need.max())
+        if worst > limit:
+            self._grow_keys(next_pow2(int(worst / ht.MAX_LOAD) + 1))
+
+    def owners_of(self, key_lanes: np.ndarray) -> np.ndarray:
+        """Host twin of the device routing, public (the executor
+        computes per-epoch owner counts for the skew-exact bucket)."""
+        return self._owners_host(np.asarray(key_lanes))
+
+    def stage_epoch(self, up: np.ndarray, aux: np.ndarray, total: int,
+                    max_ins_ref: int,
+                    owners: Optional[np.ndarray] = None) -> tuple:
+        """Host→device staging of one side's epoch batch: run the
+        growth guards against the HOST matrices (the device steps are
+        fixed-capacity programs), pad rows to a multiple of n_dev
+        (pad rows carry flags=0 — routed nowhere, probed never), and
+        upload row-sharded. Returns (up_dev, aux_dev, bucket) — the
+        arrays feed BOTH this side's apply_epoch and the probe_epoch
+        against the other side, exactly two uploads per side per
+        epoch.
+
+        ``owners`` (per-row owner shard, from owners_of) makes the
+        routing bucket SKEW-EXACT instead of worst-case: the receive
+        shape per shard is n_dev*bucket rows, and the default bucket
+        (= local rows) has every shard process the WHOLE epoch — n_dev
+        times the single-chip compute, which on the CPU virtual mesh
+        (devices share one host) was the post-dispatch-tax half of the
+        ad-ctr tail. With exact per-(sender, target) counts the bucket
+        collapses to ~local/n_dev·(1+skew), pow2-quantized so steady
+        state reuses a handful of compiled shapes. Overflow stays
+        impossible: the bound is computed, not guessed."""
+        n = up.shape[0]
+        ins_mask = (aux[:, AUX_FLAGS] & FLAG_INS) != 0
+        if up.dtype == np.int64:
+            # fused raw matrix: key lanes only exist in-trace
+            self._guard_keys_blind(int(ins_mask.sum()))
+        else:
+            self._guard_keys(up[:, :self.key_width], ins_mask)
+        if max_ins_ref >= 0:
+            self.reserve_rows(max_ins_ref)
+        m = max(n, self.n_dev)
+        if m % self.n_dev:
+            m += self.n_dev - (m % self.n_dev)
+        if m != n:
+            up2 = np.zeros((m, up.shape[1]), dtype=up.dtype)
+            up2[:n] = up
+            aux2 = np.zeros((m, 4), dtype=np.int32)
+            aux2[:n] = aux
+            up, aux = up2, aux2
+        local = m // self.n_dev
+        bucket = local
+        if owners is not None:
+            ow = np.full(m, -1, dtype=np.int64)
+            routed = aux[:total, AUX_FLAGS] != 0
+            ow[:total][routed] = np.asarray(owners)[:total][routed]
+            bucket = skew_bucket(ow, ow >= 0, self.n_dev, local)
+        return (jax.device_put(up, self._sharding),
+                jax.device_put(aux, self._sharding), bucket)
+
+    def _prelude_for(self, prelude, prelude_key: str):
+        """Pin the prelude under its key so cached steps stay valid
+        (the step cache closes over the callable via the key)."""
+        if prelude is not None:
+            self._preludes[prelude_key] = prelude
+        return self._preludes.get(prelude_key)
+
+    def _build_epoch_apply(self, bucket: int, width: int, raw: bool,
+                           prelude=None, prelude_key: str = ""):
+        key = _step_key(self.mesh, "epoch_apply", bucket, width, raw,
+                        prelude_key, *self._statics())
+        step = _STEP_CACHE.get(key)
+        if step is not None:
+            return step
+        n_dev = self.n_dev
+        cap = self.key_capacity
+        kw = self.key_width
+
+        def local(t, c, up, aux, owner_map):
+            t = jax.tree.map(lambda a: a[0], t)
+            c = jax.tree.map(lambda a: a[0], c)
+            # the prelude (ops/fused.build_join_prelude) traces the
+            # absorbed filter/project run BEFORE vnode routing: the
+            # raw local rows become key lanes here, inside the same
+            # SPMD step that routes and applies them
+            lanes = up[:, :kw] if prelude is None else \
+                prelude(up)[:, :kw]
+            flags = aux[:, AUX_FLAGS]
+            valid = (flags & (FLAG_INS | FLAG_DEL)) != 0
+            rlanes, (rins, rdel, rflags, rseq), rvalid, ovf = \
+                ShardedJoinKernel._route(
+                    owner_map, lanes,
+                    [aux[:, AUX_INS_REF], aux[:, AUX_DEL_REF], flags,
+                     aux[:, AUX_SEQ]],
+                    valid, n_dev, bucket)
+            rim = rvalid & ((rflags & FLAG_INS) != 0)
+            rdm = rvalid & ((rflags & FLAG_DEL) != 0)
+            t2, slots, _ins = ht.probe_insert(t, rlanes, rim)
+            ch = link_rows(c, slots, rins, rim, cap, rseq)
+            ch = tombstone_rows(ch, rdel, rdm, rseq)
+            return (jax.tree.map(lambda a: a[None], t2),
+                    jax.tree.map(lambda a: a[None], ch), ovf[None])
+
+        tspec, cspec = self._specs()
+        mapped = jaxtools.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(tspec, cspec, P(AXIS), P(AXIS), P()),
+            out_specs=(tspec, cspec, P(AXIS)),
+            check_vma=False)
+        step = jaxtools.instrumented_jit(
+            mapped, "parallel_join.epoch_apply", donate_argnums=(0, 1))
+        _STEP_CACHE[key] = step
+        return step
+
+    def apply_epoch(self, up_dev, aux_dev, n_rows: int,
+                    max_ins_ref: int, prelude=None,
+                    prelude_key: str = "", bucket=None) -> None:
+        """Apply a whole epoch's concatenated inserts/tombstones in ONE
+        SPMD dispatch (JoinSideKernel.apply_epoch parity; growth guards
+        already ran in stage_epoch). Rows carry their message sequence
+        in aux[:, AUX_SEQ]; link_rows/tombstone_rows take it per-row.
+        ``bucket`` is stage_epoch's skew-exact routing bound (None →
+        the overflow-free worst case)."""
+        del n_rows, max_ins_ref       # guards ran at stage_epoch
+        prelude = self._prelude_for(prelude, prelude_key)
+        m = int(up_dev.shape[0])
+        if bucket is None:
+            bucket = m // self.n_dev
+        step = self._build_epoch_apply(
+            bucket, int(up_dev.shape[1]), up_dev.dtype == jnp.int64,
+            prelude=prelude, prelude_key=prelude_key)
+        _note_dispatch(m, "sharded_join")
+        self.table, self.chains, ovf = step(
+            self.table, self.chains, up_dev, aux_dev, self.owner_map)
+        jaxtools.start_fetch(ovf)
+        self._apply_overflows.append(ovf)
+
+    def _build_epoch_probe(self, bucket: int, width: int,
+                           out_cap: int, with_degrees: bool,
+                           prelude=None, prelude_key: str = ""):
+        key = _step_key(self.mesh, "epoch_probe", bucket, width,
+                        out_cap, with_degrees, prelude_key,
+                        *self._statics())
+        step = _STEP_CACHE.get(key)
+        if step is not None:
+            return step
+        n_dev = self.n_dev
+        kw = self.key_width
+
+        def local(t, c, up, aux, owner_map):
+            t = jax.tree.map(lambda a: a[0], t)
+            c = jax.tree.map(lambda a: a[0], c)
+            lanes = up[:, :kw] if prelude is None else \
+                prelude(up)[:, :kw]
+            local_n = lanes.shape[0]
+            # global epoch row ids: the executor slices results back
+            # into per-chunk order by these (rows are row-sharded
+            # before routing, so id = shard offset + local position)
+            rowids = (jax.lax.axis_index(AXIS) * local_n
+                      + jnp.arange(local_n, dtype=jnp.int32)) \
+                .astype(jnp.int32)
+            flags = aux[:, AUX_FLAGS]
+            pvis = (flags & FLAG_PROBE) != 0
+            rlanes, (rids, rseq), rvalid, ovf = \
+                ShardedJoinKernel._route(
+                    owner_map, lanes, [rowids, aux[:, AUX_SEQ]],
+                    pvis, n_dev, bucket)
+            m = n_dev * bucket
+            mat = probe_pairs(t, c, rlanes, rvalid, rseq, out_cap,
+                              with_degrees=with_degrees)
+            if with_degrees:
+                deg_blk = jnp.stack(
+                    [mat[1:1 + m, 0],
+                     jnp.where(rvalid, rids, jnp.int32(-1))], axis=1)
+                pairs = mat[1 + m:]
+            else:
+                deg_blk = None
+                pairs = mat[1:]
+            safe = jnp.maximum(pairs[:, 0], 0)
+            gprobe = jnp.where(pairs[:, 0] >= 0, rids[safe],
+                               jnp.int32(-1))
+            gpairs = jnp.stack([gprobe, pairs[:, 1]], axis=1)
+            parts = [mat[:1], gpairs] if deg_blk is None else \
+                [mat[:1], deg_blk, gpairs]
+            return jnp.concatenate(parts, axis=0)[None], ovf[None]
+
+        tspec, cspec = self._specs()
+        mapped = jaxtools.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(tspec, cspec, P(AXIS), P(AXIS), P()),
+            out_specs=(P(AXIS), P(AXIS)),
+            check_vma=False)
+        step = jaxtools.instrumented_jit(
+            mapped, "parallel_join.epoch_probe")
+        _STEP_CACHE[key] = step
+        return step
+
+    def probe_epoch(self, up_dev, aux_dev, with_degrees: bool,
+                    sink=None, prelude=None, prelude_key: str = "",
+                    bucket=None) -> "ShardedPendingEpochProbe":
+        """Probe a whole epoch's rows against THIS side — each row at
+        its aux sequence — in one SPMD dispatch. `sink` is accepted for
+        JoinSideKernel API parity and unused: the sharded path keeps
+        degrees host-side (the executor's replay arrays), so the probe
+        only RETURNS per-row degrees, it maintains no device store.
+        ``bucket`` is the PROBING side's stage_epoch bound (the same
+        rows route by the same keys)."""
+        del sink
+        prelude = self._prelude_for(prelude, prelude_key)
+        m = int(up_dev.shape[0])
+        if bucket is None:
+            bucket = m // self.n_dev
+        out_cap = self.probe_capacity
+        width = int(up_dev.shape[1])
+
+        def dispatch(cap):
+            step = self._build_epoch_probe(
+                bucket, width, cap, with_degrees,
+                prelude=prelude, prelude_key=prelude_key)
+            _note_dispatch(m, "sharded_join")
+            mats, ovf = step(self.table, self.chains, up_dev, aux_dev,
+                             self.owner_map)
+            jaxtools.start_fetch(mats)
+            return mats, ovf
+
+        mats, ovf = dispatch(out_cap)
+        return ShardedPendingEpochProbe(self, mats, m, out_cap,
+                                        with_degrees, dispatch,
+                                        overflow=ovf)
+
+    def drain_overflows(self) -> None:
+        """Fold in the lazily-checked apply-step overflow flags (the
+        condition is impossible by construction — bucket = local rows
+        — so this is an assertion, surfaced at the barrier)."""
+        flags, self._apply_overflows = self._apply_overflows, []
+        for f in flags:
+            if bool(np.asarray(jaxtools.fetch1(f)).any()):
+                raise RuntimeError(
+                    "bucket overflow routing epoch join rows")
 
     # -- host API (JoinSideKernel parity) ---------------------------------
     def _pad(self, arrs, n: int):
@@ -439,11 +834,8 @@ class ShardedJoinKernel:
              probe_vis, ins_mask, del_mask], n)
         bucket = m // self.n_dev
         out_cap = other.probe_capacity
-        key = (bucket, out_cap)
-        if key not in self._apply_cache:
-            self._apply_cache[key] = self._build_apply_probe(
-                bucket, out_cap)
-        step = self._apply_cache[key]
+        step = self._build_apply_probe(bucket, out_cap)
+        _note_dispatch(m, "sharded_join")
         self.table, self.chains, mats, overflow = step(
             self.table, self.chains, other.table, other.chains,
             jnp.asarray(lanes), jnp.asarray(rowids), jnp.asarray(refs),
@@ -457,11 +849,8 @@ class ShardedJoinKernel:
                         seq: int, out_cap: int):
         m = int(lanes.shape[0])
         bucket = m // self.n_dev
-        key = (bucket, out_cap)
-        if key not in self._probe_only_cache:
-            self._probe_only_cache[key] = self._build_probe_only(
-                bucket, out_cap)
-        step = self._probe_only_cache[key]
+        step = self._build_probe_only(bucket, out_cap)
+        _note_dispatch(m, "sharded_join")
         mats, overflow = step(self.table, self.chains,
                               jnp.asarray(lanes),
                               jnp.arange(m, dtype=jnp.int32),
@@ -498,9 +887,8 @@ class ShardedJoinKernel:
         (lanes, refs_, mask), m = self._pad(
             [key_lanes, np.asarray(refs, np.int32), vis], n)
         bucket = m // self.n_dev
-        if bucket not in self._insert_cache:
-            self._insert_cache[bucket] = self._build_insert(bucket)
-        step = self._insert_cache[bucket]
+        step = self._build_insert(bucket)
+        _note_dispatch(m, "sharded_join")
         self.table, self.chains, overflow = step(
             self.table, self.chains, jnp.asarray(lanes),
             jnp.asarray(refs_), jnp.asarray(mask), jnp.int32(seq),
@@ -521,9 +909,8 @@ class ShardedJoinKernel:
             [np.asarray(key_lanes), np.asarray(del_refs, np.int32),
              vis], n)
         bucket = m // self.n_dev
-        if bucket not in self._delete_cache:
-            self._delete_cache[bucket] = self._build_delete(bucket)
-        step = self._delete_cache[bucket]
+        step = self._build_delete(bucket)
+        _note_dispatch(m, "sharded_join")
         self.chains, overflow = step(
             self.chains, jnp.asarray(lanes), jnp.asarray(drefs),
             jnp.asarray(dm), jnp.int32(seq), self.owner_map)
@@ -556,10 +943,6 @@ class ShardedJoinKernel:
         self.key_capacity = max(self.key_capacity, need_keys,
                                 ht.MIN_CAPACITY)
         self._fresh_state()
-        self._apply_cache.clear()
-        self._probe_only_cache.clear()
-        self._delete_cache.clear()
-        self._insert_cache.clear()
         self._keys_upper = np.zeros(self.n_dev, dtype=np.int64)
         if n == 0:
             return

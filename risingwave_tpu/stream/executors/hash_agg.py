@@ -433,6 +433,25 @@ class HashAggExecutor(Executor):
             # the executor, but trace spans always stamp the kernel at
             # its real jit sites)
             self._kernel._span_label = self.identity
+        elif self.fused_stages is not None and \
+                getattr(self._kernel, "supports_prelude", False) and \
+                self._kernel._prelude is None:
+            # injected SHARDED kernel + fused plan (ISSUE 10): install
+            # the prelude on first touch — the absorbed run then
+            # traces ahead of the vnode routing inside the SPMD step
+            from risingwave_tpu.ops.fused import (
+                build_agg_prelude, raw_width,
+            )
+            self._kernel.set_prelude(
+                build_agg_prelude(self.fused_stages,
+                                  self.group_indices, self.agg_calls,
+                                  self.specs),
+                raw_width(len(self.fused_stages.ref_cols)),
+                metrics_label=self.identity,
+                prelude_key=(
+                    f"{self.fused_stages.trace_key()}"
+                    f"|g={self.group_indices}"
+                    f"|c={[(c.kind.value, c.input_idx) for c in self.agg_calls]}"))
         return self._kernel
 
     @kernel.setter
@@ -468,6 +487,17 @@ class HashAggExecutor(Executor):
             return []
         return self.fused_stages.drain_stage_metrics()
 
+    @property
+    def _fused_raw_key_cols(self):
+        """Raw input columns carrying the group-key VALUES through the
+        absorbed run (None when any key is a computed expression) —
+        cached; drives the sharded kernel's host-side owner counts."""
+        if not hasattr(self, "_fused_raw_keys_cache"):
+            self._fused_raw_keys_cache = None if \
+                self.fused_stages is None else \
+                self.fused_stages.input_positions(self.group_indices)
+        return self._fused_raw_keys_cache
+
     # -- chunk path ------------------------------------------------------
     def _inputs(self, chunk: StreamChunk) -> Tuple:
         """Per call: (host input lane arrays, valid mask) — the kernel
@@ -494,7 +524,20 @@ class HashAggExecutor(Executor):
             # fusion win the bench compares.
             from risingwave_tpu.ops.fused import encode_raw_chunk
             raw = encode_raw_chunk(chunk, self.fused_stages.ref_cols)
-            self.kernel.apply_raw(raw, chunk.cardinality())
+            if getattr(self.kernel, "counts_own_dispatches", False):
+                # sharded fused kernel: when the group keys map to raw
+                # input columns, per-row owners compute host-side and
+                # feed the skew-exact routing bucket (a pre-filter
+                # superset — safe when the traced filter drops rows)
+                raw_keys = self._fused_raw_key_cols
+                owners = None
+                if raw_keys is not None:
+                    owners = self.kernel.owners_of(
+                        self.key_codec.build(chunk, raw_keys))
+                self.kernel.apply_raw(raw, chunk.cardinality(),
+                                      owners=owners)
+            else:
+                self.kernel.apply_raw(raw, chunk.cardinality())
             return
         key_lanes = self.key_codec.build(chunk, self.group_indices)
         signs = np.asarray(chunk.signs())
@@ -503,10 +546,13 @@ class HashAggExecutor(Executor):
             self._tier_touch(key_lanes, vis)
         # one kernel.apply below = one fused device dispatch (~2ms host
         # cost through the tunnel): the metric pair the coalescing
-        # layer optimizes — fewer dispatches, denser rows per dispatch
-        _METRICS.device_dispatch.inc(1, executor=self.identity)
-        _METRICS.rows_per_dispatch.observe(float(vis.sum()),
-                                           executor=self.identity)
+        # layer optimizes — fewer dispatches, denser rows per dispatch.
+        # Sharded kernels count at their own jit sites instead
+        # (kernel="sharded_agg", real epoch-batched launches).
+        if not getattr(self.kernel, "counts_own_dispatches", False):
+            _METRICS.device_dispatch.inc(1, executor=self.identity)
+            _METRICS.rows_per_dispatch.observe(float(vis.sum()),
+                                               executor=self.identity)
         inputs = list(self._inputs(chunk))
         if self.minput:
             self._apply_minput(chunk, key_lanes, signs, vis)
@@ -951,7 +997,9 @@ class HashAggExecutor(Executor):
         return self.key_codec.decode(keys)
 
     def _flush(self) -> Optional[StreamChunk]:
-        _METRICS.device_dispatch.inc(1, executor=self.identity)
+        own = getattr(self.kernel, "counts_own_dispatches", False)
+        if not own:
+            _METRICS.device_dispatch.inc(1, executor=self.identity)
         fr = self.kernel.flush()
         if self.fused_stages is not None:
             # flush synchronized the queue — the per-stage row vectors
@@ -962,8 +1010,9 @@ class HashAggExecutor(Executor):
                 self.fused_stages.note_stage_rows(sr, 0)
         # the flush dispatch gathers the dirty groups — observe them so
         # the histogram count tracks the dispatch counter exactly
-        _METRICS.rows_per_dispatch.observe(float(fr.n),
-                                           executor=self.identity)
+        if not own:
+            _METRICS.rows_per_dispatch.observe(float(fr.n),
+                                               executor=self.identity)
         _METRICS.agg_dirty_groups.set(fr.n, executor=self.identity)
         _METRICS.agg_table_capacity.set(self.kernel.capacity,
                                         executor=self.identity)

@@ -212,6 +212,7 @@ class _JoinSide:
         # the epoch dispatches (ops/fused.build_join_prelude)
         self.fused_input = None
         self._prelude = None
+        self._prelude_cache_key = None
         # device kernel is built LAZILY (first data touch): building it
         # here would initialize the JAX backend — and claim the TPU —
         # in processes that only PLAN (the distributed frontend
@@ -764,7 +765,8 @@ class HashJoinExecutor(Executor):
                  join_type: JoinType = JoinType.INNER,
                  mesh=None, shard_opts: Optional[dict] = None,
                  state_cap: Optional[int] = None,
-                 device_payload: bool = True):
+                 device_payload: bool = True,
+                 epoch_batch: Optional[bool] = None):
         assert len(left_keys) == len(right_keys)
         self.left_in, self.right_in = left, right
         self.join_type = join_type
@@ -774,7 +776,8 @@ class HashJoinExecutor(Executor):
         self.rebuild_opts = {"actor_id": actor_id, "mesh": mesh,
                              "shard_opts": shard_opts,
                              "state_cap": state_cap,
-                             "device_payload": device_payload}
+                             "device_payload": device_payload,
+                             "epoch_batch": epoch_batch}
         key_codec = KeyCodec(
             [left.schema[i].data_type for i in left_keys])
         # device_payload=False forces the host-gather emit path (the
@@ -827,14 +830,24 @@ class HashJoinExecutor(Executor):
         # ops/hash_join.py) + per-epoch in-flight probe list
         self._seq = 1
         self._pending: List[tuple] = []
-        # epoch batching (single-chip kernel): chunks buffer host-side
-        # and the whole epoch ships as 2 uploads + 2 dispatches per
-        # side at the barrier — through the tunnel, per-barrier
-        # transfer count bounds throughput (ops/hash_join.py AUX_*).
-        # The sharded kernel keeps the per-chunk dispatch path.
+        # epoch batching (ISSUE 10: now BOTH kernel shapes): chunks
+        # buffer host-side and the whole epoch ships as 2 uploads + 2
+        # dispatches per side at the barrier — through the tunnel (and
+        # through the sharded path's ~100ms-per-shard_map host
+        # dispatch, BENCH_r09), per-barrier dispatch count bounds
+        # throughput (ops/hash_join.py AUX_*; parallel/join.py epoch
+        # twins). epoch_batch=False is the sharded oracle's per-chunk
+        # off arm — single-chip kernels dropped that path in PR 9
+        # (device degrees live in the epoch dispatches).
         # derived WITHOUT touching .kernel: the lazy property exists so
         # plan-only processes never build device state
-        self._epoch_batch = self.sides[0]._mesh is None
+        if epoch_batch is None:
+            epoch_batch = True
+        elif not epoch_batch and mesh is None:
+            raise ValueError(
+                "epoch_batch=False is the sharded per-chunk oracle "
+                "arm — the single-chip kernel is epoch-only")
+        self._epoch_batch = bool(epoch_batch)
         self._tier = None
         self._tier_parts: Tuple = (None, None)
         self._tier_seq = 0
@@ -846,7 +859,7 @@ class HashJoinExecutor(Executor):
             # pure functions of both sides' durable state and recompute
             # on reload — see _reload_cold), and key-prefixed
             # state-table pks (reload prefix-scans by key)
-            if join_type.is_semi_or_anti or not self._epoch_batch:
+            if join_type.is_semi_or_anti or mesh is not None:
                 raise ValueError(
                     "state_cap needs an INNER or OUTER join on the "
                     "single-chip epoch-batched path (semi/anti "
@@ -1110,11 +1123,9 @@ class HashJoinExecutor(Executor):
             # them once; a jnp round-trip here would block on the tunnel.
             handle = None
             if probe_vis.any():
-                # one fused apply+probe = one device dispatch; its row
-                # density is what input coalescing buys back
-                _METRICS.device_dispatch.inc(1, executor=self.identity)
-                _METRICS.rows_per_dispatch.observe(
-                    float(probe_vis.sum()), executor=self.identity)
+                # one fused apply+probe = one device dispatch; the
+                # sharded kernel counts it at its own jit site under
+                # kernel="sharded_join" (real-launch granularity)
                 with dispatch_span(self.identity,
                                    float(probe_vis.sum()),
                                    site="apply_and_probe"):
@@ -1154,8 +1165,18 @@ class HashJoinExecutor(Executor):
                 axis=1)
         else:
             up = np.asarray(key_lanes)
+        owners = None
+        if me._mesh is not None:
+            # per-row owner shards for the skew-exact routing bucket
+            # (parallel/join.stage_epoch): the fused path derives key
+            # lanes from the POST chunk here — the raw matrix only
+            # carries them in-trace
+            lanes_o = np.asarray(key_lanes) if key_lanes is not None \
+                else me.key_codec.build(chunk, me.key_indices)
+            owners = me.kernel.owners_of(lanes_o)
         self._epoch_buf[side_idx].append(
-            (up, aux, int(ins_refs.max()) if len(ins_refs) else -1))
+            (up, aux, int(ins_refs.max()) if len(ins_refs) else -1,
+             owners))
         self._epoch_rows[side_idx] = off + n
 
     def _dispatch_epoch(self) -> Dict[int, tuple]:
@@ -1164,7 +1185,6 @@ class HashJoinExecutor(Executor):
         Returns {side: (deg|None, probe_idx, refs, pay, old_deg)} in
         the CONCATENATED row space; _emit_pending slices per chunk by
         offset."""
-        import jax
         self._reload_cold()
         devs: Dict[int, tuple] = {}
         for s in (0, 1):
@@ -1178,40 +1198,59 @@ class HashJoinExecutor(Executor):
             # sides buffer int32 [key | payload] lanes
             up = np.zeros((cap, w), dtype=buf[0][0].dtype)
             aux = np.zeros((cap, 4), dtype=np.int32)
+            owners = None if buf[0][3] is None else \
+                np.zeros(cap, dtype=np.int64)
             at = 0
             max_ref = -1
-            for lan, a, mr in buf:
+            for lan, a, mr, ow in buf:
                 up[at:at + lan.shape[0]] = lan
                 aux[at:at + a.shape[0]] = a
+                if owners is not None:
+                    owners[at:at + lan.shape[0]] = ow
                 at += lan.shape[0]
                 max_ref = max(max_ref, mr)
-            devs[s] = (jax.device_put(up), jax.device_put(aux),
-                       total, max_ref)
+            # staging is the kernel's job: the sharded kernel pads to
+            # the mesh width, runs its growth guards, computes the
+            # skew-exact routing bucket and row-shards the upload; a
+            # single chip device_puts (bucket None)
+            up_dev, aux_dev, bucket = self.sides[s].kernel.stage_epoch(
+                up, aux, total, max_ref, owners=owners)
+            devs[s] = (up_dev, aux_dev, total, max_ref, bucket)
 
         def _prelude_kw(s: int) -> dict:
             """The UPLOADING side's fused-input prelude (if any),
-            for both its apply and its probe of the other side."""
+            for both its apply and its probe of the other side. The
+            key is STRUCTURAL (FusedStages.trace_key + the lane
+            positions): equal runs trace equal programs, so jit caches
+            keyed by it survive session restarts and shared shapes."""
             side = self.sides[s]
             if side.fused_input is None:
                 return {}
+            if side._prelude_cache_key is None:
+                side._prelude_cache_key = (
+                    f"{side.fused_input.trace_key()}"
+                    f"|k={side.key_indices}|p={side.pay_indices}")
             return {"prelude": side.prelude,
-                    "prelude_key": f"side{s}:{id(side.fused_input)}"}
+                    "prelude_key": side._prelude_cache_key}
 
         # both applies land before either probe dispatches: a probe at
         # seq s must see the other side's same-epoch rows with seq < s
-        for s, (ld, ad, total, max_ref) in devs.items():
+        for s, (ld, ad, total, max_ref, bkt) in devs.items():
             # apply + probe below = 2 device dispatches per side/epoch,
             # each carrying the epoch's rows (observe twice so the
             # histogram's count matches the dispatch counter and
-            # sum/count stays the true per-dispatch density)
-            _METRICS.device_dispatch.inc(2, executor=self.identity)
-            for _ in range(2):
-                _METRICS.rows_per_dispatch.observe(
-                    float(total), executor=self.identity)
+            # sum/count stays the true per-dispatch density). Sharded
+            # kernels count at their own jit sites (kernel="sharded_
+            # join") — counting here too would double the totals.
+            if self.sides[s]._mesh is None:
+                _METRICS.device_dispatch.inc(2, executor=self.identity)
+                for _ in range(2):
+                    _METRICS.rows_per_dispatch.observe(
+                        float(total), executor=self.identity)
             with dispatch_span(self.identity, float(total),
                                site="epoch_apply", side=s):
                 self.sides[s].kernel.apply_epoch(ld, ad, total,
-                                                 max_ref,
+                                                 max_ref, bucket=bkt,
                                                  **_prelude_kw(s))
         with_deg = self.join_type != JoinType.INNER
         if not with_deg:
@@ -1219,8 +1258,8 @@ class HashJoinExecutor(Executor):
             # collects, so the two d2h DMAs overlap
             probes = {s: self.sides[1 - s].kernel.probe_epoch(
                 ld, ad, False, sink=self.sides[s].kernel,
-                **_prelude_kw(s))
-                for s, (ld, ad, _t, _m) in devs.items()}
+                bucket=bkt, **_prelude_kw(s))
+                for s, (ld, ad, _t, _m, bkt) in devs.items()}
             return {s: p.collect() for s, p in probes.items()}
         # degree-tracked joins: each probe updates BOTH sides' device
         # degree arrays (transitions on the probed side, inserted-row
@@ -1229,10 +1268,10 @@ class HashJoinExecutor(Executor):
         # dispatch after probe 1's collect has installed its final
         # arrays. One sync point per epoch, tracked joins only.
         out: Dict[int, tuple] = {}
-        for s, (ld, ad, _t, _m) in devs.items():
+        for s, (ld, ad, _t, _m, bkt) in devs.items():
             out[s] = self.sides[1 - s].kernel.probe_epoch(
                 ld, ad, True, sink=self.sides[s].kernel,
-                **_prelude_kw(s)).collect()
+                bucket=bkt, **_prelude_kw(s)).collect()
         return out
 
     def _tier_register(self) -> None:
@@ -1282,7 +1321,7 @@ class HashJoinExecutor(Executor):
             other = self.sides[1 - s]
             if not other.cold_keys or not self._epoch_buf[s]:
                 continue
-            for lan, aux, _mr in self._epoch_buf[s]:
+            for lan, aux, _mr, _ow in self._epoch_buf[s]:
                 rows = np.flatnonzero(aux[:, 2] & FLAG_PROBE)
                 # the buffered upload matrix is [key lanes | payload
                 # lanes]: cold-key lookups read the key slice only

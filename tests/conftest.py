@@ -195,6 +195,42 @@ class DispatchBudget:
             f"{d_baseline} — dispatch-budget guard (tier-1 strict "
             "mode, join extension)")
 
+    @staticmethod
+    def sharded_totals():
+        """(dispatches, rows) of the SHARDED kernels alone — counted
+        at their real shard_map launch sites under kernel="sharded_*"
+        labels (ISSUE 10 observability satellite)."""
+        from risingwave_tpu.utils.metrics import STREAMING
+        d = sum(v for l, v in STREAMING.device_dispatch.series()
+                if l.get("kernel", "").startswith("sharded"))
+        r = sum(s for l, _n, s in
+                STREAMING.rows_per_dispatch.series()
+                if l.get("kernel", "").startswith("sharded"))
+        return float(d), float(r)
+
+    def measure_sharded(self, fn):
+        """(fn result, sharded dispatches, sharded rows/dispatch)."""
+        d0, r0 = self.sharded_totals()
+        out = fn()
+        d1, r1 = self.sharded_totals()
+        d = d1 - d0
+        return out, d, (r1 - r0) / max(d, 1.0)
+
+    @staticmethod
+    def check_epoch_ceiling(dispatches, n_epochs, per_epoch,
+                            what="sharded epoch batching"):
+        """Distributed/sharded extension (ISSUE 10): SPMD dispatches
+        per epoch must stay O(1) per kernel — `per_epoch` is the
+        kernel count times its per-epoch dispatch budget (join: 2
+        apply + 2 probe; agg: 1 step + 1 gather), NOT a per-chunk
+        allowance. A regression back to per-chunk dispatch trips this
+        immediately."""
+        assert dispatches <= n_epochs * per_epoch, (
+            f"{what}: {dispatches} sharded SPMD dispatches over "
+            f"{n_epochs} epochs exceeds the O(1)-per-epoch ceiling "
+            f"({per_epoch}/epoch) — the per-epoch discipline "
+            "regressed to per-chunk dispatch (tier-1 strict mode)")
+
 
 @pytest.fixture
 def dispatch_budget():
