@@ -17,7 +17,15 @@ backup operations against a data directory:
     python -m risingwave_tpu ctl --data-dir D table scan <name> [-n N]
     python -m risingwave_tpu ctl --data-dir D metrics [--steps K]
     python -m risingwave_tpu ctl --data-dir D trace [--steps K] \
-        [--out trace.json]    # Chrome trace-event JSON (Perfetto)
+        [--out trace.json]    # Chrome trace-event JSON (Perfetto):
+                              # X/s/f span+flow events, phase lanes,
+                              # and 'C' counter tracks (transfer
+                              # bytes, uploader queue depth, backlog
+                              # rows) sampled at each epoch seal
+    python -m risingwave_tpu ctl --data-dir D phases [--steps K]
+                              # epoch phase ledger: per-epoch
+                              # host/device time+bytes breakdown,
+                              # conservation coverage, kernel costs
     python -m risingwave_tpu ctl --data-dir D backup create|list|
         delete <id> | restore <id> --target T
 """
@@ -142,6 +150,8 @@ def _ctl(args) -> int:
         return asyncio.run(_ctl_memory(obj, args))
     if verb == "trace":
         return asyncio.run(_ctl_trace(obj, args))
+    if verb == "phases":
+        return asyncio.run(_ctl_phases(obj, args))
     if verb == "backup":
         from risingwave_tpu.meta.backup import (
             create_backup, delete_backup, list_backups, restore_backup,
@@ -309,6 +319,43 @@ async def _ctl_trace(obj, args) -> int:
     return 0
 
 
+async def _ctl_phases(obj, args) -> int:
+    """Recover into an in-memory clone (same snapshot discipline as
+    `table scan`), drive a few checkpoints so the phase ledger holds
+    sealed epochs, and print the per-epoch breakdown: how every
+    millisecond of each barrier interval splits across host_ingest /
+    host_pack / h2d / device_compute / d2h / host_emit / barrier_wait,
+    the conservation coverage, transfer bytes, and the compiled
+    kernels' cost-analysis yardsticks."""
+    import json
+
+    from risingwave_tpu.frontend import Frontend
+    from risingwave_tpu.storage.hummock import HummockLite
+    from risingwave_tpu.utils.jaxtools import (
+        kernel_cost_rows, publish_kernel_costs,
+    )
+    from risingwave_tpu.utils.ledger import LEDGER
+
+    fe = Frontend(HummockLite(_snapshot_clone(obj)))
+    await fe.recover()
+    try:
+        await fe.step(args.steps)
+        report = LEDGER.report(last_n=args.steps + 2)
+        agg = LEDGER.phase_breakdown()
+        publish_kernel_costs()
+        costs = kernel_cost_rows()
+    finally:
+        await fe.close()
+    print(report)
+    print("aggregate (steady epochs):")
+    print(json.dumps(agg, indent=1))
+    if costs:
+        print("compiled kernel costs (flops / bytes accessed):")
+        for label, flops, nbytes in costs:
+            print(f"  {label}: {flops:.3g} flops, {nbytes:.3g} B")
+    return 0
+
+
 def main(argv=None) -> None:
     # the axon sitecustomize rewrites jax_platforms at interpreter
     # start, overriding JAX_PLATFORMS=cpu — honor the env var so ctl /
@@ -356,11 +403,20 @@ def main(argv=None) -> None:
     tr = csub.add_parser(
         "trace",
         help="recover + export epoch-causal traces as Chrome "
-             "trace-event JSON (Perfetto-loadable)")
+             "trace-event JSON (Perfetto-loadable; includes phase "
+             "lanes and byte/queue-depth counter tracks)")
     tr.add_argument("--steps", type=int, default=4,
                     help="checkpoint barriers to drive before export")
     tr.add_argument("--out", default=None,
                     help="write the JSON here instead of stdout")
+    ph = csub.add_parser(
+        "phases",
+        help="recover + print the epoch phase ledger: per-barrier "
+             "host/device time+bytes breakdown, conservation "
+             "coverage, compiled-kernel cost yardsticks")
+    ph.add_argument("--steps", type=int, default=4,
+                    help="checkpoint barriers to drive before the "
+                         "report")
     bk = csub.add_parser("backup")
     bk.add_argument("what",
                     choices=["create", "list", "delete", "restore"])

@@ -31,7 +31,7 @@ from risingwave_tpu.utils.metrics import CLUSTER as _METRICS
 # them changes cluster state, and their failures belong to the
 # recovery supervisor, not a silent retry.
 _IDEMPOTENT_VERBS = frozenset({
-    "ping", "scan_table", "recover_store", "set_trace",
+    "ping", "scan_table", "recover_store", "set_trace", "set_ledger",
     "arm_failpoints", "metrics", "reset",
 })
 
@@ -283,6 +283,11 @@ class WorkerBarrierSender:
     manager 'sends' each barrier to the worker over control, and the
     worker's completion reply collects the pseudo-actor — InjectBarrier
     + BarrierComplete as one round trip."""
+
+    # phase-ledger hint (meta/barrier.py seal): actor work behind this
+    # sender runs in ANOTHER process, so coordinator-side conservation
+    # is meaningless until drain_ledger merges the worker's books
+    remote = True
 
     def __init__(self, client: WorkerClient, local, pseudo_actor: int,
                  committed_fn=None):

@@ -116,8 +116,12 @@ class Cluster:
         """(Re)build the barrier fan-out: one pseudo-actor per worker
         slot; the commit decision pipelines via committed_fn."""
         self.local = LocalBarrierManager()
+        # distributed=True: the ledger's sealed records cover only
+        # coordinator-side phases until drain_ledger merges the worker
+        # accumulators in (conservation defers to the merge)
         self.loop = BarrierLoop(self.local, self.store,
-                                collect_timeout_s=self.barrier_timeout_s)
+                                collect_timeout_s=self.barrier_timeout_s,
+                                distributed=True)
         for k in range(self.n):
             pid = _PSEUDO_BASE + k
             self.local.register_sender(
@@ -317,9 +321,21 @@ class Cluster:
     # -- epoch-causal tracing ---------------------------------------------
     async def set_trace(self, on: bool) -> None:
         """Fan the tracing toggle out to every worker process (the
-        coordinator's own tracer is the caller's to flip)."""
+        coordinator's own tracer is the caller's to flip). Remembered
+        so a respawned worker rejoins with the operator's setting,
+        not the module default."""
+        self._trace_on = bool(on)
         await asyncio.gather(*(
             c.call({"cmd": "set_trace", "on": bool(on)})
+            for c in self.clients if c is not None))
+
+    async def set_ledger(self, on: bool) -> None:
+        """Fan the phase-ledger toggle out to every worker process
+        (same on/off everywhere, or a drained merge would have
+        per-process holes). Remembered for respawns like set_trace."""
+        self._ledger_on = bool(on)
+        await asyncio.gather(*(
+            c.call({"cmd": "set_ledger", "on": bool(on)})
             for c in self.clients if c is not None))
 
     async def drain_trace(self) -> int:
@@ -341,6 +357,28 @@ class Cluster:
         # the watchdog promoted slow barriers BEFORE these spans
         # arrived: recompute their straggler lines over the full view
         EPOCH_TRACER.refresh_diagnoses()
+        return n
+
+    async def drain_ledger(self) -> int:
+        """Pull every worker's open phase-ledger accumulators into the
+        coordinator's ledger (merged into the sealed records of the
+        same epochs — this is what makes a distributed epoch's
+        conservation residual meaningful). Drained accumulators leave
+        the worker, so repeated drains never double-count."""
+        from risingwave_tpu.utils.ledger import LEDGER
+        live = [(k, c) for k, c in enumerate(self.clients)
+                if c is not None]
+        replies = await asyncio.gather(*(
+            c.call({"cmd": "drain_ledger"}) for _k, c in live))
+        # conservation resolves only when EVERY worker's books arrived
+        # — with a dead slot the record's residual would be a phantom
+        # of the missing process, so the exemption stands
+        complete = len(live) == self.n
+        n = 0
+        for (k, _c), reply in zip(live, replies):
+            n += LEDGER.ingest(reply.get("epochs", ()),
+                               worker=f"worker-{k}",
+                               resolve=complete)
         return n
 
     # -- distributed reads ------------------------------------------------
@@ -386,6 +424,16 @@ class Cluster:
         if self.handles[k] is not None:
             self.handles[k].kill()       # reap the corpse (idempotent)
         await self._start_slot(k)
+        # a fresh process boots with the MODULE defaults — re-apply
+        # the operator's trace/ledger toggles or the respawned worker
+        # punches a per-process hole in every later drain/merge
+        for verb, on in (("set_trace", getattr(self, "_trace_on",
+                                               None)),
+                         ("set_ledger", getattr(self, "_ledger_on",
+                                                None))):
+            if on is not None:
+                await self.clients[k].call_idempotent(
+                    {"cmd": verb, "on": on}, io_timeout=20.0)
 
     async def _reset_slot(self, k: int) -> None:
         """Rejoin one LIVE slot in place: fresh control connection

@@ -102,6 +102,7 @@ class DistFrontend:
         # stream_chunk_target_rows: SET here, honored at CREATE time
         from risingwave_tpu.frontend.opt import parse_fusion, parse_rules
         from risingwave_tpu.frontend.session_vars import SessionVars
+        from risingwave_tpu.utils.ledger import parse_ledger
         from risingwave_tpu.utils.spans import parse_trace
         self.session_vars = SessionVars(
             self, {"streaming_rate_limit": "rate_limit",
@@ -123,10 +124,15 @@ class DistFrontend:
              # epoch-causal tracing: the SET fans out to every worker
              # over the control channel (same on/off everywhere, or a
              # drained trace would have holes per process)
-             "stream_trace": "on"},
+             "stream_trace": "on",
+             # epoch phase ledger (utils/ledger.py): fans out like
+             # stream_trace — a cross-process merge must be all-on or
+             # all-off
+             "stream_ledger": "on"},
             validators={"stream_rewrite_rules": parse_rules,
                         "stream_fusion": parse_fusion,
-                        "stream_trace": parse_trace})
+                        "stream_trace": parse_trace,
+                        "stream_ledger": parse_ledger})
         # fragment-graph stats of the last deployed job (exchange
         # hops, exchanged lane widths) — bench + tests read this to
         # see what the rewrite engine bought
@@ -244,6 +250,12 @@ class DistFrontend:
                     self.session_vars.get("stream_trace"))
                 _spans.set_enabled(on)
                 await self.cluster.set_trace(on)
+            if stmt.name == "stream_ledger":
+                from risingwave_tpu.utils import ledger as _ledger
+                on = _ledger.parse_ledger(
+                    self.session_vars.get("stream_ledger"))
+                _ledger.set_enabled(on)
+                await self.cluster.set_ledger(on)
             return "SET"
         if isinstance(stmt, ast.Show):
             if stmt.what == "var:all":
@@ -398,13 +410,26 @@ class DistFrontend:
         number of spans ingested."""
         return await self.cluster.drain_trace()
 
+    async def drain_ledger(self) -> int:
+        """Merge every worker's phase-ledger accumulators into the
+        coordinator's sealed records (the distributed conservation
+        story: worker host/device time folds into the epoch intervals
+        the coordinator measured); returns epochs ingested."""
+        return await self.cluster.drain_ledger()
+
     async def _select(self, sel: ast.Select) -> Rows:
         from risingwave_tpu.batch import collect
 
-        if self._references_epoch_trace(sel):
+        referenced = self._referenced_system_tables(sel)
+        if "rw_epoch_trace" in referenced:
             # the trace table serves the MERGED cluster view: pull
             # worker spans in before the batch scan reads the tracer
             await self.drain_trace()
+        if referenced & {"rw_metrics_history", "rw_kernel_costs"}:
+            # same discipline for the phase ledger: fold worker books
+            # into the sealed records before anything reads them (the
+            # conservation residuals recompute on merge)
+            await self.drain_ledger()
         view = ClusterStoreView(self.cluster)
         # one consistent snapshot: the barrier lock keeps the
         # heartbeat from committing an epoch between per-table scans
@@ -420,8 +445,10 @@ class DistFrontend:
         return collect(ex)
 
     @staticmethod
-    def _references_epoch_trace(sel: ast.Select) -> bool:
-        names = []
+    def _referenced_system_tables(sel: ast.Select) -> set:
+        """Lower-cased table names a SELECT touches (FROM + JOINs +
+        subqueries) — the drain-before-read triggers."""
+        names = set()
 
         def from_item(item):
             if item is None:
@@ -432,7 +459,7 @@ class DistFrontend:
             name = getattr(item, "name", None) or getattr(
                 getattr(item, "table", None), "name", None)
             if name is not None:
-                names.append(str(name).lower())
+                names.add(str(name).lower())
 
         def walk(s):
             from_item(s.from_item)
@@ -440,7 +467,7 @@ class DistFrontend:
                 from_item(jn.item)
 
         walk(sel)
-        return "rw_epoch_trace" in names
+        return names
 
     def _referenced_table_ids(self, sel: ast.Select) -> List[int]:
         """MV table ids a SELECT touches (FROM + JOINs + subqueries)."""

@@ -137,11 +137,21 @@ class WorkerServer:
             from risingwave_tpu.utils import spans as _spans
             _spans.set_enabled(bool(cmd.get("on", True)))
             return {"ok": True}
+        if verb == "set_ledger":
+            from risingwave_tpu.utils import ledger as _ledger
+            _ledger.set_enabled(bool(cmd.get("on", True)))
+            return {"ok": True}
         if verb == "drain_trace":
             # pop this process's recorded spans for the coordinator to
             # merge (tagged with the worker slot on the other side)
             from risingwave_tpu.utils.spans import EPOCH_TRACER
             return {"ok": True, "spans": EPOCH_TRACER.drain_dicts()}
+        if verb == "drain_ledger":
+            # pop this process's open phase-ledger accumulators —
+            # workers never seal (the coordinator owns the barrier
+            # interval); the other side merges them into its records
+            from risingwave_tpu.utils.ledger import LEDGER
+            return {"ok": True, "epochs": LEDGER.drain_dicts()}
         if verb == "ping":
             # heartbeat probe (cluster.rs heartbeat RPC): liveness +
             # a cheap resource summary for the membership table

@@ -19,6 +19,7 @@ import numpy as np
 
 from risingwave_tpu.common.chunk import StreamChunk
 from risingwave_tpu.common.types import DataType, Schema, decimal_to_scaled
+from risingwave_tpu.utils.ledger import LEDGER
 
 _USECS = 1_000_000
 
@@ -94,18 +95,21 @@ class RowParser(abc.ABC):
 
     def parse_records(self, payloads: Sequence[bytes]
                       ) -> List[Tuple[bool, tuple]]:
-        out = []
-        for p in payloads:
-            try:
-                rec = self.parse_record(p)
-            except (ValueError, TypeError, KeyError,
-                    json.JSONDecodeError):
-                rec = None
-            if rec is None:
-                self.errors += 1
-            else:
-                out.append(rec)
-        return out
+        # the connector-decode half of the epoch phase ledger's
+        # host_ingest: per-record parse/coerce work, timed per batch
+        with LEDGER.phase("host_ingest"):
+            out = []
+            for p in payloads:
+                try:
+                    rec = self.parse_record(p)
+                except (ValueError, TypeError, KeyError,
+                        json.JSONDecodeError):
+                    rec = None
+                if rec is None:
+                    self.errors += 1
+                else:
+                    out.append(rec)
+            return out
 
     def parse_batch(self, payloads: Sequence[bytes]) -> List[tuple]:
         """Rows only (op envelope dropped) — the plain-source shape."""
@@ -116,15 +120,18 @@ class RowParser(abc.ABC):
         recs = self.parse_records(payloads)
         if not recs:
             return None
-        data: Dict[str, list] = {
-            f.name: [r[i] for _ins, r in recs]
-            for i, f in enumerate(self.schema)}
-        ops = None
-        if not all(ins for ins, _r in recs):
-            from risingwave_tpu.common.chunk import Op
-            ops = [Op.INSERT if ins else Op.DELETE
-                   for ins, _r in recs]
-        return StreamChunk.from_pydict(self.schema, data, ops=ops)
+        # column transposition + chunk building is still ingest-side
+        # decode work (rows exist only after this lands)
+        with LEDGER.phase("host_ingest"):
+            data: Dict[str, list] = {
+                f.name: [r[i] for _ins, r in recs]
+                for i, f in enumerate(self.schema)}
+            ops = None
+            if not all(ins for ins, _r in recs):
+                from risingwave_tpu.common.chunk import Op
+                ops = [Op.INSERT if ins else Op.DELETE
+                       for ins, _r in recs]
+            return StreamChunk.from_pydict(self.schema, data, ops=ops)
 
 
 class JsonRowParser(RowParser):

@@ -231,6 +231,19 @@ def explain_with_rewrite(consumer, spec: Optional[str],
         rows.append((f"--   rule {rule}: FELL BACK ({reason})",))
     rows += [(line,) for line in explain_tree(new_consumer)]
     rows.append(stats_line("post-rewrite", new_consumer))
+    # compiled-kernel cost footer (utils/jaxtools.KERNELS): programs
+    # this process has already compiled, with the HLO cost model's
+    # flops / bytes-accessed — what the deployed plan's device steps
+    # SHOULD cost, next to the tree that dispatches them. Empty on a
+    # fresh process (nothing compiled yet).
+    from risingwave_tpu.utils.jaxtools import kernel_cost_rows
+    costs = kernel_cost_rows()
+    if costs:
+        rows.append(("-- compiled kernel costs "
+                     "(flops / bytes accessed):",))
+        rows += [(f"--   {label}: {flops:.3g} flops, "
+                  f"{nbytes:.3g} B", )
+                 for label, flops, nbytes in costs]
     return rows
 
 

@@ -1307,6 +1307,30 @@ def _system_catalog_rows(name: str, catalog: Catalog, profiler=None):
                       Field("retained", DataType.INT64),
                       Field("detail", DataType.VARCHAR)])
         return sch, EPOCH_TRACER.rows()
+    if n == "rw_metrics_history":
+        # bounded per-barrier time series (utils/metrics.HISTORY, fed
+        # at every ledger seal): counter deltas, gauge values and the
+        # epoch phase breakdown per barrier — the telemetry history
+        # the elastic-serving control loop (ROADMAP item 3) reads.
+        # Long format: one row per (barrier, series).
+        from risingwave_tpu.utils.metrics import HISTORY
+        sch = Schema([Field("seq", DataType.INT64),
+                      Field("epoch", DataType.INT64),
+                      Field("ts", DataType.FLOAT64),
+                      Field("interval_s", DataType.FLOAT64),
+                      Field("name", DataType.VARCHAR),
+                      Field("value", DataType.FLOAT64)])
+        return sch, HISTORY.rows()
+    if n == "rw_kernel_costs":
+        # compiled-program cost analysis (utils/jaxtools.KERNELS):
+        # flops / bytes-accessed from each kernel's lowered program —
+        # the yardstick the ledger's device_compute measurements are
+        # sanity-checked against
+        from risingwave_tpu.utils.jaxtools import kernel_cost_rows
+        sch = Schema([Field("kernel", DataType.VARCHAR),
+                      Field("flops", DataType.FLOAT64),
+                      Field("bytes_accessed", DataType.FLOAT64)])
+        return sch, kernel_cost_rows()
     if n == "rw_recovery":
         # supervised-recovery event log (meta/supervisor.py): one row
         # per recovery with its classified cause, graduated action,

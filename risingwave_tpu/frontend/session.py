@@ -79,6 +79,7 @@ class Frontend:
         # are pg-compatibility strings (shared impl: session_vars.py)
         from risingwave_tpu.frontend.opt import parse_fusion, parse_rules
         from risingwave_tpu.frontend.session_vars import SessionVars
+        from risingwave_tpu.utils.ledger import parse_ledger
         from risingwave_tpu.utils.spans import parse_trace
         self.session_vars = SessionVars(
             self, {"streaming_rate_limit": "rate_limit",
@@ -103,10 +104,16 @@ class Frontend:
              # bounded flight recorder; 'off' reduces every hook to a
              # predicate check (and keeps remote barrier frames free
              # of the span-context trailer)
-             "stream_trace": "on"},
+             "stream_trace": "on",
+             # epoch phase ledger (utils/ledger.py): per-epoch
+             # host/device time-and-bytes accounting with the
+             # conservation gate; 'off' reduces every hook to a
+             # predicate check (the ledger-on-vs-off bench arm)
+             "stream_ledger": "on"},
             validators={"stream_rewrite_rules": parse_rules,
                         "stream_fusion": parse_fusion,
-                        "stream_trace": parse_trace})
+                        "stream_trace": parse_trace,
+                        "stream_ledger": parse_ledger})
         # rules spec each MV was created under: reschedule replans +
         # re-rewrites with the SAME spec so state-table schemas from
         # the original rewrite reproduce exactly (id-base contract)
@@ -338,6 +345,10 @@ class Frontend:
                 from risingwave_tpu.utils import spans as _spans
                 _spans.set_enabled(_spans.parse_trace(
                     self.session_vars.get("stream_trace")))
+            if stmt.name == "stream_ledger":
+                from risingwave_tpu.utils import ledger as _ledger
+                _ledger.set_enabled(_ledger.parse_ledger(
+                    self.session_vars.get("stream_ledger")))
             return "SET"
         if isinstance(stmt, ast.Show):
             if stmt.what == "var:all":

@@ -28,7 +28,9 @@ from risingwave_tpu.state.store import StateStore
 from risingwave_tpu.storage.uploader import CheckpointUploader
 from risingwave_tpu.stream.actor import LocalBarrierManager
 from risingwave_tpu.stream.message import Barrier, BarrierKind, Mutation
+from risingwave_tpu.utils import ledger as _ledger
 from risingwave_tpu.utils import spans as _spans
+from risingwave_tpu.utils.failpoint import fail_point
 from risingwave_tpu.utils.metrics import STREAMING, exact_quantile
 from risingwave_tpu.utils.trace import GLOBAL_AWAITS
 
@@ -270,7 +272,8 @@ class BarrierLoop:
                  sleep=asyncio.sleep,
                  slow_barrier_threshold_s: float = 1.0,
                  max_uploading: int = 4,
-                 collect_timeout_s: Optional[float] = None):
+                 collect_timeout_s: Optional[float] = None,
+                 distributed: bool = False):
         self.local = local
         self.store = store
         self.interval_ms = interval_ms
@@ -278,6 +281,11 @@ class BarrierLoop:
         self.in_flight_barrier_nums = max(1, in_flight_barrier_nums)
         self.monotonic = monotonic
         self.sleep = sleep
+        # distributed coordinator: actor work runs in worker processes,
+        # so a sealed phase record covers only coordinator-side time
+        # until drain_ledger merges the workers' accumulators —
+        # conservation is deferred until then (utils/ledger.py)
+        self.distributed = distributed
         # None: wait forever (the historical behavior — tests that
         # step explicitly own their own timeouts). Set: a barrier that
         # fails to collect within the bound raises BarrierWedgedError
@@ -302,6 +310,14 @@ class BarrierLoop:
             store, max_uploading=max_uploading, monotonic=monotonic,
             on_commit=self._on_epoch_committed)
         self._upload_profiles: Dict[int, EpochProfile] = {}
+        # previous epoch's collect stamp (wall monotonic): the phase
+        # ledger starts each epoch's conservation interval here, so
+        # pipelined in-flight barriers PARTITION wall time instead of
+        # overlapping — time queued behind an older epoch belongs to
+        # that epoch's books, not to this one's as `unattributed`.
+        # (rw_barrier_latency keeps the overlapping inject→collect
+        # semantics: queueing IS part of user-visible latency.)
+        self._last_seal_stamp: Optional[float] = None
 
     # -- command scheduling (BarrierScheduler analog) -------------------
     def schedule_mutation(self, mutation: Mutation) -> None:
@@ -444,6 +460,11 @@ class BarrierLoop:
         epoch = self._in_flight.pop(0)
         barrier = await self._await_complete_or_upload_failure(epoch)
         t_collect = self.monotonic()
+        # ledger-test seam: a sleep spec here lands inside the commit
+        # half of the measured interval as wall time NO phase can
+        # claim — the conservation residual must surface it as
+        # `unattributed`
+        fail_point("barrier.collect")
         STREAMING.barrier_in_flight.set(len(self._in_flight))
         # the epoch whose data this barrier flushed is the one that ENDED:
         # barrier.epoch.prev (meta commits prev_epoch — barrier/mod.rs:652).
@@ -457,13 +478,14 @@ class BarrierLoop:
             lat = self.monotonic() - t0
             self.stats.latencies_s.append(lat)
             STREAMING.barrier_latency.observe(lat)
+            collect_times = self.local.take_collect_times(epoch)
             prof = self.profiler.record(
                 epoch,
                 "checkpoint" if barrier.is_checkpoint else "barrier",
                 inject_to_collect_s=t_collect - t0,
                 collect_to_commit_s=self.monotonic() - t_collect,
                 in_flight=len(self._in_flight),
-                collect_times=self.local.take_collect_times(epoch))
+                collect_times=collect_times)
             if _spans.enabled():
                 now = time.time()
                 _spans.EPOCH_TRACER.record(
@@ -485,6 +507,49 @@ class BarrierLoop:
                     _spans.EPOCH_TRACER.promote(epoch, diag,
                                                 prof.total_s)
                     print(f"slow barrier: {diag}", file=sys.stderr)
+            if _ledger.enabled():
+                # seal the epoch's phase books against the measured
+                # interval (residual → unattributed, metrics history
+                # row, Perfetto phase lanes). Virtual-clock loops
+                # DISCARD instead: the simulated interval and the
+                # wall-clock phases live on different clocks, so a
+                # conservation check there would be noise
+                if self.monotonic is time.monotonic:
+                    # the conservation interval ends when the LAST
+                    # actor collected (its wall stamp), not when this
+                    # coroutine got scheduled — the wake gap is event-
+                    # loop time during which actors already run the
+                    # NEXT epoch's pulls, which the ledger rightly
+                    # attributes to the next epoch. It STARTS at the
+                    # previous epoch's collect stamp when that is
+                    # later than this inject (pipelined injection:
+                    # queueing behind an older epoch is that epoch's
+                    # wall time, already on its books).
+                    t_true = max(collect_times.values(),
+                                 default=t_collect)
+                    start = t0 if self._last_seal_stamp is None \
+                        else max(t0, self._last_seal_stamp)
+                    interval = max(0.0, t_true - start) \
+                        + prof.collect_to_commit_s
+                    # the next epoch's books open where this one's
+                    # close — AFTER the commit half, which this
+                    # interval already claims (a stall there must not
+                    # land on two epochs' books)
+                    self._last_seal_stamp = \
+                        t_true + prof.collect_to_commit_s
+                    _ledger.LEDGER.seal(
+                        epoch, interval, prof.kind,
+                        # remote pseudo-actors ⇒ actor work ran in
+                        # other processes: conservation defers to the
+                        # drain_ledger merge (auto-detected so bare
+                        # coordinator loops in tests behave too)
+                        distributed=self.distributed
+                        or self.local.has_remote_participants(),
+                        # mutation barriers (deploy/stop/reschedule)
+                        # do topology work no phase claims — exempt
+                        warmup=barrier.mutation is not None)
+                else:
+                    _ledger.LEDGER.discard(epoch)
         if prev > 0 and barrier.is_checkpoint:
             if prof is not None:
                 # registered BEFORE submit: the inline fallback commits

@@ -55,6 +55,7 @@ from risingwave_tpu.common.chunk import next_pow2
 from risingwave_tpu.ops import hash_table as ht
 from risingwave_tpu.ops import lanes
 from risingwave_tpu.utils import jaxtools, spans
+from risingwave_tpu.utils.ledger import LEDGER
 
 I32_MIN = -(1 << 31)
 I32_MAX = (1 << 31) - 1
@@ -990,9 +991,11 @@ class GroupedAggKernel:
               vis: np.ndarray, inputs: Sequence) -> None:
         assert self._prelude is None, \
             "fused kernel takes raw chunks (apply_raw)"
-        packed = pack_chunk(self.key_width, self.specs,
-                            np.asarray(key_lanes), np.asarray(signs),
-                            np.asarray(vis), inputs)
+        with LEDGER.phase("host_pack", kernel=self._span_label):
+            packed = pack_chunk(self.key_width, self.specs,
+                                np.asarray(key_lanes),
+                                np.asarray(signs),
+                                np.asarray(vis), inputs)
         n = len(signs)
         if self._backlog_rows + n > self.BATCH_ROWS:
             self._dispatch_backlog()
@@ -1028,21 +1031,27 @@ class GroupedAggKernel:
         self._backlog, self._backlog_rows = [], 0
         self._backlog_vis = 0
         self._reserve(n)
-        w = mats[0].shape[1]
-        cap_rows = self.BATCH_ROWS if n <= self.BATCH_ROWS \
-            else next_pow2(n)
         raw_mode = self._prelude is not None
-        packed = np.zeros((cap_rows, w),
-                          dtype=np.int64 if raw_mode else np.int32)
-        at = 0                       # pad rows: vis=0
-        for m in mats:
-            packed[at:at + m.shape[0]] = m
-            at += m.shape[0]
+        # epoch-staging codec: backlog reassembly into the fixed-shape
+        # batch matrix is host_pack; the upload that follows is h2d
+        with LEDGER.phase("host_pack", kernel=self._span_label):
+            w = mats[0].shape[1]
+            cap_rows = self.BATCH_ROWS if n <= self.BATCH_ROWS \
+                else next_pow2(n)
+            packed = np.zeros((cap_rows, w),
+                              dtype=np.int64 if raw_mode else np.int32)
+            at = 0                   # pad rows: vis=0
+            for m in mats:
+                packed[at:at + m.shape[0]] = m
+                at += m.shape[0]
+        from risingwave_tpu.utils.ledger import note_backlog
+        note_backlog(self._span_label, n)
         if raw_mode:
             with spans.dispatch_span(self._span_label, n_vis,
                                      batch_rows=n):
                 self.state, ins, stage_rows = self._apply(
-                    self.state, jax.device_put(packed))
+                    self.state,
+                    jaxtools.upload(packed, kernel=self._span_label))
             jaxtools.start_fetch(stage_rows)
             self._stage_pending.append(stage_rows)
             if self.metrics_label is not None:
@@ -1057,8 +1066,9 @@ class GroupedAggKernel:
         else:
             with spans.dispatch_span(self._span_label, n,
                                      batch_rows=n):
-                self.state, ins = self._apply(self.state,
-                                              jax.device_put(packed))
+                self.state, ins = self._apply(
+                    self.state,
+                    jaxtools.upload(packed, kernel=self._span_label))
         self._counters.push(ins, n)
 
     def drain_stage_rows(self) -> Optional[np.ndarray]:
@@ -1202,9 +1212,10 @@ class GroupedAggKernel:
         if p == 0:
             self._flush_idx = np.zeros(0, dtype=np.int32)
             return FlushResult.empty(self.specs, self.key_width)
-        data = mat[1:1 + p]
-        self._flush_idx = np.ascontiguousarray(data[:, 0])
-        return decode_flush_data(self.specs, self.key_width, data)
+        with LEDGER.phase("host_emit", kernel=self._span_label):
+            data = mat[1:1 + p]
+            self._flush_idx = np.ascontiguousarray(data[:, 0])
+            return decode_flush_data(self.specs, self.key_width, data)
 
     def patch_accs(self, decoded: List[Optional[
             Tuple[np.ndarray, np.ndarray]]],

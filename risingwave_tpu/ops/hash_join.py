@@ -50,6 +50,7 @@ import numpy as np
 
 from risingwave_tpu.ops import hash_table as ht
 from risingwave_tpu.utils import jaxtools
+from risingwave_tpu.utils.ledger import LEDGER
 
 
 I32_MAX = (1 << 31) - 1
@@ -487,17 +488,18 @@ class PendingProbe:
         """(degrees | None, probe_idx[pairs], refs[pairs]). Pairs are
         sorted by probe row index (device cumsum offsets)."""
         n = self.n
-        while True:
-            mat = jaxtools.fetch1(self.mat)
-            total = int(mat[0, 0])
-            if total <= self.cap:
-                break
-            from risingwave_tpu.common.chunk import next_pow2
-            self.cap = max(self.cap * 2, next_pow2(total))
-            if self.bump is not None:
-                self.bump(self.cap)
-            self.mat = self.redispatch(self.cap)
-            jaxtools.start_fetch(self.mat)
+        with LEDGER.kernel_scope("hash_join"):
+            while True:
+                mat = jaxtools.fetch1(self.mat)
+                total = int(mat[0, 0])
+                if total <= self.cap:
+                    break
+                from risingwave_tpu.common.chunk import next_pow2
+                self.cap = max(self.cap * 2, next_pow2(total))
+                if self.bump is not None:
+                    self.bump(self.cap)
+                self.mat = self.redispatch(self.cap)
+                jaxtools.start_fetch(self.mat)
         if self.with_degrees:
             deg = np.ascontiguousarray(mat[1:1 + n, 0])
             pairs = mat[1 + n:1 + n + total]
@@ -537,17 +539,18 @@ class PendingEpochProbe:
         """(degrees | None, probe_idx, refs, pay_rows | None,
         old_deg | None); pairs sorted by probe row index."""
         n = self.n
-        while True:
-            mat = jaxtools.fetch1(self.mat)
-            total = int(mat[0, 0])
-            if total <= self.cap:
-                break
-            from risingwave_tpu.common.chunk import next_pow2
-            self.cap = max(self.cap * 2, next_pow2(total))
-            if self.bump is not None:
-                self.bump(self.cap)
-            self.mat = self.redispatch(self.cap)
-            jaxtools.start_fetch(self.mat)
+        with LEDGER.kernel_scope("hash_join"):
+            while True:
+                mat = jaxtools.fetch1(self.mat)
+                total = int(mat[0, 0])
+                if total <= self.cap:
+                    break
+                from risingwave_tpu.common.chunk import next_pow2
+                self.cap = max(self.cap * 2, next_pow2(total))
+                if self.bump is not None:
+                    self.bump(self.cap)
+                self.mat = self.redispatch(self.cap)
+                jaxtools.start_fetch(self.mat)
         if self.with_degrees and self._degs is not None:
             self.install(*self._degs)
         if self.with_degrees:
@@ -727,8 +730,10 @@ class JoinSideKernel:
         s = jnp.int32(I32_MAX if seq is None else seq)
         lanes_d = jnp.asarray(key_lanes)
         vis_d = jnp.asarray(vis)
-        mat = _probe_pairs_jit(self.table.state, self.chains, lanes_d,
-                               vis_d, s, self._probe_cap, True)
+        with LEDGER.phase("device_compute", kernel="hash_join"):
+            mat = _probe_pairs_jit(self.table.state, self.chains,
+                                   lanes_d, vis_d, s, self._probe_cap,
+                                   True)
         jaxtools.start_fetch(mat)
 
         def redispatch(cap):
@@ -749,9 +754,11 @@ class JoinSideKernel:
         derives the skew-exact routing bucket from ``owners`` and
         row-shards the upload; a single chip just device_puts and has
         no routing bucket)."""
-        del total, max_ins_ref, owners
-        import jax
-        return jax.device_put(up), jax.device_put(aux), None
+        del max_ins_ref, owners
+        from risingwave_tpu.utils.ledger import note_backlog
+        note_backlog("hash_join", total)
+        return (jaxtools.upload(up, kernel="hash_join"),
+                jaxtools.upload(aux, kernel="hash_join"), None)
 
     def apply_epoch(self, up_dev, aux_dev, n_rows: int,
                     max_ins_ref: int, prelude=None,
@@ -807,7 +814,8 @@ class JoinSideKernel:
         def bump(cap):
             self._probe_cap = max(self._probe_cap, cap)
 
-        mat, d_self, d_sink = dispatch(out_cap)
+        with LEDGER.phase("device_compute", kernel="hash_join"):
+            mat, d_self, d_sink = dispatch(out_cap)
         jaxtools.start_fetch(mat)
 
         def redispatch(cap):

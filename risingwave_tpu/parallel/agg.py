@@ -40,6 +40,7 @@ from risingwave_tpu.parallel.exchange import (
     vnodes_from_lanes,
 )
 from risingwave_tpu.utils import jaxtools, spans
+from risingwave_tpu.utils.ledger import LEDGER
 
 AXIS = "d"
 
@@ -411,9 +412,11 @@ class ShardedAggKernel:
         assert self._prelude is None, \
             "fused kernel takes raw chunks (apply_raw)"
         self._touched = True
-        packed = pack_chunk(self.key_width, self.specs,
-                            np.asarray(key_lanes), np.asarray(signs),
-                            np.asarray(vis), inputs)
+        with LEDGER.phase("host_pack", kernel=self._span_label):
+            packed = pack_chunk(self.key_width, self.specs,
+                                np.asarray(key_lanes),
+                                np.asarray(signs),
+                                np.asarray(vis), inputs)
         n = packed.shape[0]
         if self._backlog_rows + n > self.BATCH_ROWS:
             self._dispatch_backlog()
@@ -487,39 +490,44 @@ class ShardedAggKernel:
         # because _update_call slices the batch and carry-normalizes
         # per slab (the single-chip 32K backlog rides the same path)
         self._reserve(n)
-        # pow2-bucketed batch shape (the join epoch path's convention):
-        # steady-state epochs repeat a handful of shapes — the
-        # RecompileGuard's sharded contract — without padding every
-        # small epoch to the full 32K slab
-        cap_rows = max(next_pow2(n), self.n_dev)
-        if cap_rows % self.n_dev:
-            cap_rows += self.n_dev - (cap_rows % self.n_dev)
-        w = mats[0].shape[1]
-        packed = np.zeros((cap_rows, w),
-                          dtype=np.int64 if raw_mode else np.int32)
-        at = 0                       # pad rows: vis=0
-        for m_ in mats:
-            packed[at:at + m_.shape[0]] = m_
-            at += m_.shape[0]
-        local = cap_rows // self.n_dev
-        bucket = self.bucket or local
-        if raw_mode and self.bucket is None and owner_chunks and \
-                all(o is not None for o in owner_chunks):
-            ow = np.full(cap_rows, -1, dtype=np.int64)
-            ow[:n] = np.concatenate(owner_chunks)
-            bucket = skew_bucket(ow, ow >= 0, self.n_dev, local)
-        if not raw_mode and self.bucket is None:
-            # skew-exact routing bucket (the join's stage_epoch
-            # scheme): the default (= local rows) makes every shard
-            # process the WHOLE batch post-exchange — n_dev× the
-            # single-chip compute; exact per-(sender, target) counts
-            # from the host key lanes collapse it to the real skew,
-            # pow2-quantized for shape stability. The fused raw path
-            # keeps the worst case (its lanes only exist in-trace).
-            kw_ = self.key_width
-            vis_col = packed[:, kw_ + 1] != 0
-            owner = owners_host(packed[:, :kw_], self._owner_map_host)
-            bucket = skew_bucket(owner, vis_col, self.n_dev, local)
+        # epoch staging + routing-bucket computation is host_pack (the
+        # ledger's phase taxonomy); the sharded upload below is h2d
+        with LEDGER.phase("host_pack", kernel=self._span_label):
+            # pow2-bucketed batch shape (the join epoch path's
+            # convention): steady-state epochs repeat a handful of
+            # shapes — the RecompileGuard's sharded contract — without
+            # padding every small epoch to the full 32K slab
+            cap_rows = max(next_pow2(n), self.n_dev)
+            if cap_rows % self.n_dev:
+                cap_rows += self.n_dev - (cap_rows % self.n_dev)
+            w = mats[0].shape[1]
+            packed = np.zeros((cap_rows, w),
+                              dtype=np.int64 if raw_mode else np.int32)
+            at = 0                   # pad rows: vis=0
+            for m_ in mats:
+                packed[at:at + m_.shape[0]] = m_
+                at += m_.shape[0]
+            local = cap_rows // self.n_dev
+            bucket = self.bucket or local
+            if raw_mode and self.bucket is None and owner_chunks and \
+                    all(o is not None for o in owner_chunks):
+                ow = np.full(cap_rows, -1, dtype=np.int64)
+                ow[:n] = np.concatenate(owner_chunks)
+                bucket = skew_bucket(ow, ow >= 0, self.n_dev, local)
+            if not raw_mode and self.bucket is None:
+                # skew-exact routing bucket (the join's stage_epoch
+                # scheme): the default (= local rows) makes every shard
+                # process the WHOLE batch post-exchange — n_dev× the
+                # single-chip compute; exact per-(sender, target)
+                # counts from the host key lanes collapse it to the
+                # real skew, pow2-quantized for shape stability. The
+                # fused raw path keeps the worst case (its lanes only
+                # exist in-trace).
+                kw_ = self.key_width
+                vis_col = packed[:, kw_ + 1] != 0
+                owner = owners_host(packed[:, :kw_],
+                                    self._owner_map_host)
+                bucket = skew_bucket(owner, vis_col, self.n_dev, local)
         key = (cap_rows, bucket, raw_mode)
         step = self._step_cache.get(key)
         if step is None:
@@ -539,8 +547,12 @@ class ShardedAggKernel:
                     step = self._build_packed_step(bucket)
                     _PROG_CACHE[mkey] = step
             self._step_cache[key] = step
-        up = jax.device_put(packed,
-                            NamedSharding(self.mesh, P(AXIS)))
+        from risingwave_tpu.utils.ledger import note_backlog
+        # same kernel label as the phase scopes/transfer bytes above,
+        # so one kernel's series correlate across families
+        note_backlog(self._span_label, n)
+        up = jaxtools.upload(packed, NamedSharding(self.mesh, P(AXIS)),
+                             kernel=self._span_label)
         _note_dispatch(n_vis if raw_mode else n)
         if raw_mode:
             with spans.dispatch_span(self._span_label, n_vis,
@@ -638,10 +650,13 @@ class ShardedAggKernel:
             self._flush_idx = [np.zeros(0, dtype=np.int32)
                                for _ in range(self.n_dev)]
             return FlushResult.empty(self.specs, self.key_width)
-        segs = [mats[d, 1:1 + int(ps[d])] for d in range(self.n_dev)]
-        self._flush_idx = [np.ascontiguousarray(s[:, 0]) for s in segs]
-        data = np.concatenate(segs, axis=0)
-        return decode_flush_data(self.specs, self.key_width, data)
+        with LEDGER.phase("host_emit", kernel=self._span_label):
+            segs = [mats[d, 1:1 + int(ps[d])]
+                    for d in range(self.n_dev)]
+            self._flush_idx = [np.ascontiguousarray(s[:, 0])
+                               for s in segs]
+            data = np.concatenate(segs, axis=0)
+            return decode_flush_data(self.specs, self.key_width, data)
 
     def advance(self) -> None:
         assert self._flush_idx is not None, "flush() first"

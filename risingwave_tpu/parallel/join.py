@@ -40,6 +40,7 @@ from risingwave_tpu.parallel.exchange import (
     vnodes_from_lanes,
 )
 from risingwave_tpu.utils import jaxtools
+from risingwave_tpu.utils.ledger import LEDGER
 
 AXIS = "d"
 
@@ -93,19 +94,21 @@ class ShardedPendingProbe:
         """(degrees[n], probe_idx[pairs], refs[pairs]) — pairs sorted
         by probe row so same-pk delete/insert halves stay ordered."""
         k = self.kernel
-        while True:
-            if self.overflow is not None and \
-                    bool(np.asarray(self.overflow).any()):
-                raise RuntimeError("bucket overflow routing join rows")
-            mats = np.asarray(jaxtools.fetch1(self.mats))
-            worst = int(mats[:, 0, 0].max())
-            if worst <= self.out_cap:
-                break
-            while k.probe_capacity < worst:
-                k.probe_capacity *= 2
-            self.out_cap = k.probe_capacity
-            self.mats, self.overflow = k._dispatch_probe(
-                self.key_lanes, self.vis, self.seq, self.out_cap)
+        with LEDGER.kernel_scope("sharded_join"):
+            while True:
+                if self.overflow is not None and \
+                        bool(np.asarray(self.overflow).any()):
+                    raise RuntimeError(
+                        "bucket overflow routing join rows")
+                mats = np.asarray(jaxtools.fetch1(self.mats))
+                worst = int(mats[:, 0, 0].max())
+                if worst <= self.out_cap:
+                    break
+                while k.probe_capacity < worst:
+                    k.probe_capacity *= 2
+                self.out_cap = k.probe_capacity
+                self.mats, self.overflow = k._dispatch_probe(
+                    self.key_lanes, self.vis, self.seq, self.out_cap)
         m = mats.shape[1] - 1 - self.out_cap
         deg = np.zeros(self.n, dtype=np.int32)
         probes, refs = [], []
@@ -155,20 +158,21 @@ class ShardedPendingEpochProbe:
         CONCATENATED epoch row space, pairs sorted by probe row."""
         k = self.kernel
         k.drain_overflows()
-        while True:
-            if self.overflow is not None and \
-                    bool(np.asarray(jaxtools.fetch1(
-                        self.overflow)).any()):
-                raise RuntimeError(
-                    "bucket overflow routing epoch join probes")
-            mats = np.asarray(jaxtools.fetch1(self.mats))
-            worst = int(mats[:, 0, 0].max())
-            if worst <= self.out_cap:
-                break
-            while k.probe_capacity < worst:
-                k.probe_capacity *= 2
-            self.out_cap = k.probe_capacity
-            self.mats, self.overflow = self.redispatch(self.out_cap)
+        with LEDGER.kernel_scope("sharded_join"):
+            while True:
+                if self.overflow is not None and \
+                        bool(np.asarray(jaxtools.fetch1(
+                            self.overflow)).any()):
+                    raise RuntimeError(
+                        "bucket overflow routing epoch join probes")
+                mats = np.asarray(jaxtools.fetch1(self.mats))
+                worst = int(mats[:, 0, 0].max())
+                if worst <= self.out_cap:
+                    break
+                while k.probe_capacity < worst:
+                    k.probe_capacity *= 2
+                self.out_cap = k.probe_capacity
+                self.mats, self.overflow = self.redispatch(self.out_cap)
         m = mats.shape[1] - 1 - self.out_cap
         deg = None
         if self.with_degrees:
@@ -601,24 +605,31 @@ class ShardedJoinKernel:
             self._guard_keys(up[:, :self.key_width], ins_mask)
         if max_ins_ref >= 0:
             self.reserve_rows(max_ins_ref)
-        m = max(n, self.n_dev)
-        if m % self.n_dev:
-            m += self.n_dev - (m % self.n_dev)
-        if m != n:
-            up2 = np.zeros((m, up.shape[1]), dtype=up.dtype)
-            up2[:n] = up
-            aux2 = np.zeros((m, 4), dtype=np.int32)
-            aux2[:n] = aux
-            up, aux = up2, aux2
-        local = m // self.n_dev
-        bucket = local
-        if owners is not None:
-            ow = np.full(m, -1, dtype=np.int64)
-            routed = aux[:total, AUX_FLAGS] != 0
-            ow[:total][routed] = np.asarray(owners)[:total][routed]
-            bucket = skew_bucket(ow, ow >= 0, self.n_dev, local)
-        return (jax.device_put(up, self._sharding),
-                jax.device_put(aux, self._sharding), bucket)
+        # mesh-width padding + the skew-exact routing bucket are epoch
+        # staging (host_pack); the row-sharded upload below is h2d
+        with LEDGER.phase("host_pack", kernel="sharded_join"):
+            m = max(n, self.n_dev)
+            if m % self.n_dev:
+                m += self.n_dev - (m % self.n_dev)
+            if m != n:
+                up2 = np.zeros((m, up.shape[1]), dtype=up.dtype)
+                up2[:n] = up
+                aux2 = np.zeros((m, 4), dtype=np.int32)
+                aux2[:n] = aux
+                up, aux = up2, aux2
+            local = m // self.n_dev
+            bucket = local
+            if owners is not None:
+                ow = np.full(m, -1, dtype=np.int64)
+                routed = aux[:total, AUX_FLAGS] != 0
+                ow[:total][routed] = np.asarray(owners)[:total][routed]
+                bucket = skew_bucket(ow, ow >= 0, self.n_dev, local)
+        from risingwave_tpu.utils.ledger import note_backlog
+        note_backlog("sharded_join", total)
+        return (jaxtools.upload(up, self._sharding,
+                                kernel="sharded_join"),
+                jaxtools.upload(aux, self._sharding,
+                                kernel="sharded_join"), bucket)
 
     def _prelude_for(self, prelude, prelude_key: str):
         """Pin the prelude under its key so cached steps stay valid
@@ -692,8 +703,10 @@ class ShardedJoinKernel:
             bucket, int(up_dev.shape[1]), up_dev.dtype == jnp.int64,
             prelude=prelude, prelude_key=prelude_key)
         _note_dispatch(m, "sharded_join")
-        self.table, self.chains, ovf = step(
-            self.table, self.chains, up_dev, aux_dev, self.owner_map)
+        with LEDGER.phase("device_compute", kernel="sharded_join"):
+            self.table, self.chains, ovf = step(
+                self.table, self.chains, up_dev, aux_dev,
+                self.owner_map)
         jaxtools.start_fetch(ovf)
         self._apply_overflows.append(ovf)
 
@@ -780,8 +793,10 @@ class ShardedJoinKernel:
                 bucket, width, cap, with_degrees,
                 prelude=prelude, prelude_key=prelude_key)
             _note_dispatch(m, "sharded_join")
-            mats, ovf = step(self.table, self.chains, up_dev, aux_dev,
-                             self.owner_map)
+            with LEDGER.phase("device_compute",
+                              kernel="sharded_join"):
+                mats, ovf = step(self.table, self.chains, up_dev,
+                                 aux_dev, self.owner_map)
             jaxtools.start_fetch(mats)
             return mats, ovf
 
@@ -836,11 +851,13 @@ class ShardedJoinKernel:
         out_cap = other.probe_capacity
         step = self._build_apply_probe(bucket, out_cap)
         _note_dispatch(m, "sharded_join")
-        self.table, self.chains, mats, overflow = step(
-            self.table, self.chains, other.table, other.chains,
-            jnp.asarray(lanes), jnp.asarray(rowids), jnp.asarray(refs),
-            jnp.asarray(drefs), jnp.asarray(pv), jnp.asarray(im),
-            jnp.asarray(dm), jnp.int32(seq), self.owner_map)
+        with LEDGER.phase("device_compute", kernel="sharded_join"):
+            self.table, self.chains, mats, overflow = step(
+                self.table, self.chains, other.table, other.chains,
+                jnp.asarray(lanes), jnp.asarray(rowids),
+                jnp.asarray(refs), jnp.asarray(drefs),
+                jnp.asarray(pv), jnp.asarray(im),
+                jnp.asarray(dm), jnp.int32(seq), self.owner_map)
         jaxtools.start_fetch(mats)
         return ShardedPendingProbe(other, mats, lanes, pv, seq,
                                    out_cap, n, overflow=overflow)
@@ -851,11 +868,12 @@ class ShardedJoinKernel:
         bucket = m // self.n_dev
         step = self._build_probe_only(bucket, out_cap)
         _note_dispatch(m, "sharded_join")
-        mats, overflow = step(self.table, self.chains,
-                              jnp.asarray(lanes),
-                              jnp.arange(m, dtype=jnp.int32),
-                              jnp.asarray(vis), jnp.int32(seq),
-                              self.owner_map)
+        with LEDGER.phase("device_compute", kernel="sharded_join"):
+            mats, overflow = step(self.table, self.chains,
+                                  jnp.asarray(lanes),
+                                  jnp.arange(m, dtype=jnp.int32),
+                                  jnp.asarray(vis), jnp.int32(seq),
+                                  self.owner_map)
         # overflow is impossible by construction (bucket = local rows)
         # but still checked lazily at collect — never synced here
         jaxtools.start_fetch(mats)

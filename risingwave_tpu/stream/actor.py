@@ -100,6 +100,15 @@ class Actor:
                     self.barrier_manager.collect(self.actor_id, barrier)
                 if barrier.is_stop(self.actor_id):
                     break
+                # yield so the barrier loop observes the collect NOW:
+                # without this the actor task runs straight into the
+                # next epoch's first chunk (often the heaviest pull —
+                # lazy kernel init, a fresh batch) before the loop's
+                # waiter ever wakes, and that work lands inside the
+                # COLLECTED barrier's measured interval while the phase
+                # ledger attributes it to the next epoch — a systematic
+                # conservation hole on the first post-deploy barriers
+                await asyncio.sleep(0)
             else:
                 for d in self.dispatchers:
                     await d.dispatch_watermark(msg)
@@ -133,6 +142,14 @@ class LocalBarrierManager:
     def register_sender(self, actor_id: int, sender: Sender) -> None:
         """Source-like actors receive injected barriers via these senders."""
         self._barrier_senders.setdefault(actor_id, []).append(sender)
+
+    def has_remote_participants(self) -> bool:
+        """True when any registered sender proxies another process
+        (WorkerBarrierSender.remote) — the phase ledger then defers
+        conservation to the worker-ledger merge."""
+        return any(getattr(s, "remote", False)
+                   for senders in self._barrier_senders.values()
+                   for s in senders)
 
     def set_expected_actors(self, actor_ids: Sequence[int]) -> None:
         self._expected_actors = set(actor_ids)

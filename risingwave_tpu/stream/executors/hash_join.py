@@ -1314,7 +1314,6 @@ class HashJoinExecutor(Executor):
         top in message order (_emit_one step 3), exactly as if the
         rows had never left."""
         from risingwave_tpu.ops.hash_join import FLAG_PROBE
-        import jax
         kw = LANES_PER_KEY * len(self.sides[0].key_indices)
         need: List[Dict[tuple, tuple]] = [{}, {}]
         for s in (0, 1):
@@ -1345,8 +1344,10 @@ class HashJoinExecutor(Executor):
             loaded = self.sides[s].reload_keys(need[s])
             if loaded is not None:
                 up, aux2, n, max_ref = loaded
+                from risingwave_tpu.utils import jaxtools as _jt
                 self.sides[s].kernel.apply_epoch(
-                    jax.device_put(up), jax.device_put(aux2), n,
+                    _jt.upload(up, kernel="hash_join"),
+                    _jt.upload(aux2, kernel="hash_join"), n,
                     max_ref)
                 reloaded[s] = (up, aux2, n)
                 if self._tier is not None:

@@ -27,6 +27,7 @@ from risingwave_tpu.stream.executor import (
 from risingwave_tpu.stream.message import (
     Barrier, Message, is_barrier, is_chunk,
 )
+from risingwave_tpu.utils import ledger as _ledger
 from risingwave_tpu.utils import spans as _spans
 from risingwave_tpu.utils.failpoint import fail_point
 from risingwave_tpu.utils.metrics import STREAMING as _METRICS
@@ -63,6 +64,15 @@ class MonitoredExecutor(Executor):
         self._mark_kids = 0.0
         self._mark_idle = 0.0       # inner.idle_wait_s at last barrier
         self._who = f"actor-{actor_id}/{node}:{inner.identity}"
+        # phase-ledger attribution cell: named phases recorded during
+        # THIS executor's pulls land here (asyncio-context scoped, so
+        # interleaved actors never cross-charge); the barrier flush
+        # commits it epoch-exactly and classifies the residue
+        self._cell = _ledger.AttributionCell()
+        self._fallback_phase = (
+            "host_ingest"
+            if "Source" in inner.identity
+            or "Source" in type(inner).__name__ else "host_emit")
 
     def __getattr__(self, name: str):
         # transparent introspection: chain walkers (tests, debuggers)
@@ -73,6 +83,7 @@ class MonitoredExecutor(Executor):
         return getattr(self.inner, name)
 
     def _flush_epoch(self, barrier: Barrier) -> None:
+        epoch = barrier.epoch.curr.value
         own = self.total_busy_s
         kids = sum(c.total_busy_s for c in self.children)
         excl = max(0.0, (own - self._mark_own)
@@ -83,11 +94,34 @@ class MonitoredExecutor(Executor):
         # without this, a source waiting out a slow downstream epoch
         # reads as the busiest executor in the chain
         idle = getattr(self.inner, "idle_wait_s", None)
+        idle_delta = 0.0
         if idle is not None:
-            excl = max(0.0, excl - (idle - self._mark_idle))
+            idle_delta = max(0.0, idle - self._mark_idle)
+            excl = max(0.0, excl - idle_delta)
             self._mark_idle = idle
         _METRICS.executor_busy.inc(excl, **self.labels)
         _METRICS.executor_epoch_seconds.observe(excl, **self.labels)
+        if _ledger.enabled():
+            # phase ledger: named phases recorded during this
+            # executor's pulls commit epoch-exactly; the exclusive
+            # residue is host work that is provably NOT pack/transfer/
+            # compute — source decode loops (host_ingest) or downstream
+            # reassembly/state writes/dispatch (host_emit); the barrier
+            # park is barrier_wait
+            named = self._cell.named_total()
+            _ledger.LEDGER.commit_cell(epoch, self._cell)
+            resid = excl - named
+            if resid > 0:
+                _ledger.LEDGER.attribute(self._fallback_phase, resid,
+                                         epoch)
+            if idle_delta > 0:
+                _ledger.LEDGER.attribute("barrier_wait", idle_delta,
+                                         epoch)
+        else:
+            # drain even while off: seconds recorded before a mid-
+            # epoch SET stream_ledger=off must not leak into whatever
+            # epoch is current when the ledger comes back on
+            self._cell.take()
         if _spans.enabled():
             # one actor-phase span per (executor, barrier): exclusive
             # processing time for the epoch this barrier ends, keyed by
@@ -95,7 +129,6 @@ class MonitoredExecutor(Executor):
             # parented to its inject span — the causal timeline the
             # straggler diagnosis reads
             import time as _t
-            epoch = barrier.epoch.curr.value
             _spans.EPOCH_TRACER.record(
                 self.labels["executor"], "actor", epoch=epoch,
                 start_s=_t.time() - excl, dur_s=excl,
@@ -123,11 +156,20 @@ class MonitoredExecutor(Executor):
             while True:
                 t0 = time.perf_counter()
                 _AWAITS.enter(self._who, "poll_next")
+                # ledger cell: scopes fired while the INNER executor
+                # works (pack/h2d/dispatch/d2h inside this pull) are
+                # charged to this node — a nested wrapped child swaps
+                # its own cell in for its pulls, mirroring exactly how
+                # exclusive busy time nests
+                ctok = _ledger.LEDGER.push_cell(self._cell) \
+                    if _ledger.enabled() else None
                 try:
                     msg = await it.__anext__()
                 except StopAsyncIteration:
                     break
                 finally:
+                    if ctok is not None:
+                        _ledger.LEDGER.pop_cell(ctok)
                     _AWAITS.exit(self._who)
                     self.total_busy_s += time.perf_counter() - t0
                 if is_chunk(msg):

@@ -18,9 +18,11 @@ this codebase must go through fetch()/fetch_async — a stray bare
 from __future__ import annotations
 
 import os
-from typing import List
+from typing import Dict, List, Optional
 
 import numpy as np
+
+from risingwave_tpu.utils import ledger as _ledger
 
 _DEFAULT_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
@@ -42,6 +44,143 @@ def shard_map(f, **kw):
         return _esm.shard_map(f, **kw)
 
 
+# every InstrumentedJit by label (last construction wins): the
+# compiled-program cost-analysis registry behind EXPLAIN's kernel-cost
+# footer, ctl phases and the device_kernel_* gauges
+KERNELS: Dict[str, "InstrumentedJit"] = {}
+
+# True while cost_analysis() lowers a kernel: the traced body re-runs
+# during that lowering, and its note_compile/mark_stale side effects
+# (recompile counter, ledger warmup mark, shape recapture) must NOT
+# fire — a report read is not a compile event (RecompileGuard would
+# trip on an EXPLAIN otherwise)
+_COST_LOWERING = False
+
+
+class InstrumentedJit:
+    """A jitted kernel plus the bookkeeping the observability layer
+    needs: (re)trace counting (note_compile inside the traced body)
+    and compiled-program cost analysis. Whenever a call (re)traces —
+    the traced body marks the instance stale — the call's argument
+    SHAPES are captured (jax.ShapeDtypeStruct leaves, no array
+    pinning), so the analysis tracks the LATEST compiled shape bucket
+    through capacity growth. ``cost_analysis()`` lowers against them
+    on demand, which hits the in-process/persistent compilation cache
+    instead of re-running XLA, and returns the HLO cost model's
+    flops / bytes-accessed — the yardstick device_compute
+    measurements are sanity-checked against."""
+
+    __slots__ = ("label", "_jit", "_args", "_kw", "_cost", "_stale")
+
+    # sentinel: analysis attempted and unavailable on this backend —
+    # cached so an EXPLAIN never re-lowers per statement
+    _UNAVAILABLE = object()
+
+    def __init__(self, jitted, label: str):
+        self.label = label
+        self._jit = jitted
+        self._args = None
+        self._kw = None
+        self._cost = None
+        self._stale = True             # first call always captures
+        KERNELS[label] = self
+
+    def __call__(self, *args, **kw):
+        out = self._jit(*args, **kw)
+        if self._stale:
+            # capture AFTER the call: a retrace flips the flag while
+            # jax traces, so the shapes recorded always belong to a
+            # program that actually compiled (donated args keep their
+            # aval — .shape/.dtype stay readable past the buffer)
+            import jax
+
+            def _abstract(x):
+                if not (hasattr(x, "shape") and hasattr(x, "dtype")):
+                    return x
+                # keep the sharding when the aval supports it: a
+                # mesh kernel's cost lowering then matches the LIVE
+                # executable's cache entry instead of compiling a
+                # default-sharded twin on the reporting path
+                try:
+                    sh = getattr(x, "sharding", None)
+                except Exception:      # noqa: BLE001 — donated buffer
+                    sh = None
+                if sh is not None:
+                    try:
+                        return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                                    sharding=sh)
+                    except TypeError:   # older jax: no sharding param
+                        pass
+                return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+            self._args = jax.tree.map(_abstract, args)
+            self._kw = jax.tree.map(_abstract, kw)
+            self._cost = None
+            self._stale = False
+        return out
+
+    def mark_stale(self) -> None:
+        """A (re)trace happened: recapture shapes at this call."""
+        self._stale = True
+
+    def cost_analysis(self) -> Optional[dict]:
+        """{'flops': f, 'bytes_accessed': b} for the latest-captured
+        shapes, or None (never called yet / backend without a cost
+        model). Both outcomes cache — repeated reads never re-lower."""
+        if self._cost is self._UNAVAILABLE:
+            return None
+        if self._cost is not None:
+            return self._cost
+        if self._args is None:
+            return None
+        global _COST_LOWERING
+        _COST_LOWERING = True
+        try:
+            ca = self._jit.lower(*self._args,
+                                 **self._kw).compile().cost_analysis()
+        except Exception:              # noqa: BLE001 — backend-dependent
+            self._cost = self._UNAVAILABLE
+            return None
+        finally:
+            _COST_LOWERING = False
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if not isinstance(ca, dict):
+            self._cost = self._UNAVAILABLE
+            return None
+        self._cost = {
+            "flops": float(ca.get("flops", 0.0)),
+            "bytes_accessed": float(ca.get("bytes accessed",
+                                           ca.get("bytes_accessed",
+                                                  0.0))),
+        }
+        return self._cost
+
+
+def kernel_cost_rows() -> List[tuple]:
+    """(label, flops, bytes_accessed) per registered kernel with an
+    available cost analysis, sorted by label."""
+    out = []
+    for label in sorted(KERNELS):
+        ca = KERNELS[label].cost_analysis()
+        if ca is not None:
+            out.append((label, ca["flops"], ca["bytes_accessed"]))
+    return out
+
+
+def publish_kernel_costs() -> int:
+    """Refresh the device_kernel_flops/bytes_accessed gauges from the
+    registry (lazy by design: cost analysis compiles on first read, so
+    it runs at report points — ctl phases, bench snapshot — not on the
+    hot path). Returns the number of kernels published."""
+    from risingwave_tpu.utils.metrics import STREAMING
+    rows = kernel_cost_rows()
+    for label, flops, nbytes in rows:
+        STREAMING.kernel_flops.set(flops, kernel=label)
+        STREAMING.kernel_bytes_accessed.set(nbytes, kernel=label)
+    return len(rows)
+
+
 def instrumented_jit(fn, label: str | None = None, **jit_kw):
     """``jax.jit`` with (re)trace visibility: the wrapper's Python body
     runs only while jax TRACES it — once per new input shape bucket —
@@ -50,20 +189,31 @@ def instrumented_jit(fn, label: str | None = None, **jit_kw):
     compile span in the current epoch's trace (utils/spans.py), making
     warmup compiles and steady-state shape-churn recompiles visible
     instead of silent multi-second stalls. Steady state pays nothing:
-    jit dispatches the cached executable without entering the body."""
+    jit dispatches the cached executable without entering the body.
+
+    Returns an InstrumentedJit: call it like the jitted function; its
+    ``cost_analysis()`` serves the compiled program's flops/bytes."""
     import functools
 
     import jax
 
     name = label or getattr(fn, "__name__", "kernel")
+    inst_box: list = []
 
     @functools.wraps(fn)
     def traced(*a, **k):
-        from risingwave_tpu.utils.spans import note_compile
-        note_compile(name)
+        if not _COST_LOWERING:
+            from risingwave_tpu.utils.spans import note_compile
+            note_compile(name)
+            if inst_box:
+                # this call is (re)tracing: the wrapper recaptures the
+                # call's shapes so cost_analysis follows growth
+                inst_box[0].mark_stale()
         return fn(*a, **k)
 
-    return jax.jit(traced, **jit_kw)
+    inst = InstrumentedJit(jax.jit(traced, **jit_kw), name)
+    inst_box.append(inst)
+    return inst
 
 
 def enable_compilation_cache(path: str | None = None) -> str:
@@ -99,6 +249,34 @@ def _not_ready(arrays) -> List:
     return out
 
 
+def _ledger_d2h(arrays, out) -> None:
+    """Count the device→host payload of a completed fetch (host numpy
+    pass-throughs excluded — they never crossed the bus)."""
+    nbytes = sum(o.nbytes for a, o in zip(arrays, out)
+                 if hasattr(a, "copy_to_host_async"))
+    if nbytes:
+        _ledger.LEDGER.add_bytes("d2h", nbytes)
+
+
+def _wait_ready(pending, poll_s: float) -> None:
+    """The ONE copy of the ready-wait ladder: GIL-yield spins first
+    (XLA host compute lands in µs — a fixed 2ms quantum was the q8
+    hot path's single biggest cost on CPU), then sub-ms naps, then
+    the tunnel-friendly `poll_s`."""
+    import time
+
+    spins = 0
+    while pending:
+        if spins < 50:
+            time.sleep(0)              # yield the GIL; compute runs
+        elif spins < 80:
+            time.sleep(0.0002)
+        else:
+            time.sleep(poll_s)
+        spins += 1
+        pending = _not_ready(pending)
+
+
 def fetch(*arrays, poll_s: float = 0.002) -> List[np.ndarray]:
     """Read device arrays via the async-DMA path (see module docstring).
 
@@ -107,25 +285,22 @@ def fetch(*arrays, poll_s: float = 0.002) -> List[np.ndarray]:
     multi-second wait quantum), then materializes. Host numpy arrays
     pass through untouched.
 
-    The wait ladders: GIL-yield spins first (XLA host compute lands in
-    µs — a fixed 2ms quantum was the q8 hot path's single biggest cost
-    on CPU), then sub-ms naps, then the tunnel-friendly `poll_s`.
+    Phase ledger: the ready-wait segment is the device's compute tail
+    as the host observes it under async dispatch (device_compute); the
+    materialization is the d2h transfer, with exact bytes.
     """
-    import time
-
     start_fetch(*arrays)
     pending = _not_ready(arrays)
-    spins = 0
-    while pending:
-        if spins < 50:
-            time.sleep(0)              # yield the GIL; compute threads run
-        elif spins < 80:
-            time.sleep(0.0002)
-        else:
-            time.sleep(poll_s)
-        spins += 1
-        pending = _not_ready(pending)
-    return [np.asarray(a) for a in arrays]
+    if not _ledger.enabled():
+        _wait_ready(pending, poll_s)
+        return [np.asarray(a) for a in arrays]
+    if pending:
+        with _ledger.LEDGER.phase("device_compute"):
+            _wait_ready(pending, poll_s)
+    with _ledger.LEDGER.phase("d2h"):
+        out = [np.asarray(a) for a in arrays]
+    _ledger_d2h(arrays, out)
+    return out
 
 
 def fetch1(array) -> np.ndarray:
@@ -136,7 +311,11 @@ async def fetch_async(*arrays, poll_s: float = 0.001) -> List[np.ndarray]:
     """fetch() that yields to the event loop during the wait, so
     barrier/actor coroutines keep flowing during the DMA. Same wait
     ladder as fetch(): zero-delay yields first (they still run other
-    ready coroutines), timed naps only once the wait is clearly long."""
+    ready coroutines), timed naps only once the wait is clearly long.
+
+    Ledger note: the wait here is NOT attributed to device_compute —
+    other coroutines run during the yields and their own phases own
+    that wall time; only the materialization (d2h, with bytes) is."""
     import asyncio
 
     start_fetch(*arrays)
@@ -146,7 +325,30 @@ async def fetch_async(*arrays, poll_s: float = 0.001) -> List[np.ndarray]:
         await asyncio.sleep(0 if spins < 50 else poll_s)
         spins += 1
         pending = _not_ready(pending)
-    return [np.asarray(a) for a in arrays]
+    if not _ledger.enabled():
+        return [np.asarray(a) for a in arrays]
+    with _ledger.LEDGER.phase("d2h"):
+        out = [np.asarray(a) for a in arrays]
+    _ledger_d2h(arrays, out)
+    return out
+
+
+def upload(host, sharding=None, kernel: Optional[str] = None):
+    """``jax.device_put`` with h2d ledger accounting (phase time +
+    exact payload bytes under ``stream_transfer_bytes_total``). EVERY
+    hot-path host→device matrix upload should go through here — it is
+    the h2d half of the epoch phase ledger's conservation argument."""
+    import jax
+
+    if not _ledger.enabled():
+        return jax.device_put(host) if sharding is None \
+            else jax.device_put(host, sharding)
+    with _ledger.LEDGER.phase("h2d", kernel=kernel):
+        out = jax.device_put(host) if sharding is None \
+            else jax.device_put(host, sharding)
+    _ledger.LEDGER.add_bytes("h2d", int(getattr(host, "nbytes", 0)),
+                             kernel=kernel)
+    return out
 
 
 class PendingCounters:

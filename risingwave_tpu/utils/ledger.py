@@ -1,0 +1,582 @@
+"""Epoch phase ledger: host/device time-and-bytes accounting.
+
+Every barrier interval is classified into named phases (the Hazelcast
+Jet stance, arxiv 2103.10169: a p99 tail you cannot attribute is a tail
+you cannot fix — make every microsecond and every byte of an epoch
+attributable, continuously, not in one-off cProfile runs):
+
+- ``host_ingest``   — connector decode (JsonRowParser/CsvRowParser) and
+                      source-side chunk building.
+- ``host_pack``     — chunk codecs, epoch staging (backlog assembly),
+                      routing-bucket computation for the sharded kernels.
+- ``h2d``           — host→device upload of packed/raw matrices
+                      (``jaxtools.upload``), with exact byte counts.
+- ``device_compute``— the real ``instrumented_jit``/``shard_map``
+                      launch sites (dispatch_span) plus ready-wait time
+                      in ``jaxtools.fetch`` — under async dispatch the
+                      wait-until-ready segment IS the device's compute
+                      tail as seen from the host.
+- ``d2h``           — materializing packed results through
+                      ``jaxtools.fetch``/``start_fetch`` DMAs, with
+                      exact byte counts.
+- ``host_emit``     — downstream host processing: packed-matrix
+                      reassembly, arena gathers, state-table writes and
+                      dispatch. Measured as each non-source executor's
+                      EXCLUSIVE busy time minus the named phases
+                      recorded during its pulls (the residue that is
+                      provably host work but not pack/transfer).
+- ``barrier_wait``  — source executors parked on the barrier channel
+                      (idle, not processing).
+
+Two disciplines keep the ledger honest:
+
+- **Exclusive nesting.** Scopes may nest arbitrarily (a fetch inside a
+  dispatch span inside an executor pull); each scope records only its
+  exclusive time, so phase totals never double-count a wall-clock
+  second. Executor-level residue subtracts the named time recorded
+  during that executor's own pulls (an asyncio-context cell, so
+  interleaved actors never cross-charge).
+- **Conservation.** At barrier collection the loop seals the epoch
+  against its measured interval; the uncovered remainder is published
+  as ``unattributed`` — and gated in tier-1 strict mode (conftest), so
+  the ledger can never silently rot: a new uninstrumented stall shows
+  up as residual, not as silence.
+
+Attribution is epoch-exact for executor work (cells flush with the
+barrier that ends the epoch, the same CURR-epoch key rw_barrier_latency
+uses); scopes outside any executor attribute to the newest injected
+epoch (the utils/spans approximation).
+
+Output surfaces: ``stream_epoch_phase_seconds{phase,query}`` and
+``stream_transfer_bytes_total{dir,kernel}`` Prometheus families, phase
+lanes + byte counter tracks in the Perfetto export (utils/spans), the
+``rw_metrics_history`` per-barrier ring (utils/metrics.HISTORY — the
+feed the elastic-serving control loop reads), the per-query
+``phase_breakdown`` block in bench rounds, and ``ctl phases``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import OrderedDict, deque
+from contextvars import ContextVar
+from typing import Deque, Dict, Iterable, List, Optional
+
+PHASES = ("host_ingest", "host_pack", "h2d", "device_compute", "d2h",
+          "host_emit", "barrier_wait")
+UNATTRIBUTED = "unattributed"
+
+# open-epoch accumulators kept (epochs are injected faster than sealed
+# only up to the in-flight window; the bound guards leaks on epochs
+# that never collect, e.g. recovery rollbacks)
+OPEN_WINDOW = 64
+
+_ENABLED = True
+
+# active scope's child-duration accumulator (exclusive-nesting math);
+# ContextVars are asyncio-task aware, so interleaved actors keep
+# separate stacks
+_SCOPE: ContextVar[Optional[list]] = ContextVar("ledger_scope",
+                                                default=None)
+# active executor attribution cell (stream/monitor.py pushes around
+# each inner pull; named phases recorded during the pull land here and
+# flush epoch-exactly at the barrier)
+_CELL: ContextVar[Optional["AttributionCell"]] = ContextVar(
+    "ledger_cell", default=None)
+# current kernel identity for transfer/compute attribution
+_KERNEL: ContextVar[str] = ContextVar("ledger_kernel", default="")
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def set_enabled(on: bool) -> None:
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+def parse_ledger(spec: str) -> bool:
+    """'on'|'off' → bool (SET stream_ledger validator; PlanError so a
+    typo fails the SET, not a later epoch)."""
+    s = str(spec).strip().lower()
+    if s in ("on", "true", "1"):
+        return True
+    if s in ("off", "false", "0"):
+        return False
+    from risingwave_tpu.frontend.planner import PlanError
+    raise PlanError(f"stream_ledger must be on|off, got {spec!r}")
+
+
+def current_kernel() -> str:
+    return _KERNEL.get()
+
+
+@contextlib.contextmanager
+def kernel_scope(label: str):
+    """Stamp transfers/compute recorded in the block with `label`."""
+    tok = _KERNEL.set(label)
+    try:
+        yield
+    finally:
+        _KERNEL.reset(tok)
+
+
+def note_backlog(kernel: str, rows: float) -> None:
+    """Record one epoch-batch dispatch's staged-row volume (the
+    stream_epoch_backlog_rows gauge behind the Perfetto backlog
+    counter track) — the ONE copy all four epoch-batching kernels
+    call at their backlog flush."""
+    if not _ENABLED:
+        return
+    from risingwave_tpu.utils.metrics import STREAMING
+    STREAMING.backlog_rows.set(float(rows), kernel=kernel)
+
+
+class AttributionCell:
+    """Named-phase seconds + transfer bytes recorded during one
+    executor's pulls since the last barrier (stream/monitor.py owns
+    one per wrapped executor and flushes it epoch-exactly)."""
+
+    __slots__ = ("seconds", "h2d_bytes", "d2h_bytes")
+
+    def __init__(self) -> None:
+        self.seconds: Dict[str, float] = {}
+        self.h2d_bytes = 0
+        self.d2h_bytes = 0
+
+    def named_total(self) -> float:
+        return sum(self.seconds.values())
+
+    def take(self):
+        """Pop the accumulated contents (flush-at-barrier)."""
+        out = (self.seconds, self.h2d_bytes, self.d2h_bytes)
+        self.seconds = {}
+        self.h2d_bytes = 0
+        self.d2h_bytes = 0
+        return out
+
+
+class _EpochAcc:
+    """Open accumulator for one epoch (pre-seal)."""
+
+    __slots__ = ("seconds", "h2d_bytes", "d2h_bytes", "warmup")
+
+    def __init__(self) -> None:
+        self.seconds: Dict[str, float] = {}
+        self.h2d_bytes = 0
+        self.d2h_bytes = 0
+        self.warmup = False     # saw a kernel (re)compile this epoch
+
+    def add(self, phase: str, s: float) -> None:
+        if s > 0:
+            self.seconds[phase] = self.seconds.get(phase, 0.0) + s
+
+
+class LedgerRecord:
+    """One sealed epoch's phase breakdown."""
+
+    __slots__ = ("epoch", "kind", "interval_s", "seconds", "h2d_bytes",
+                 "d2h_bytes", "warmup", "distributed", "workers")
+
+    def __init__(self, epoch: int, kind: str, interval_s: float,
+                 seconds: Dict[str, float], h2d_bytes: int,
+                 d2h_bytes: int, warmup: bool, distributed: bool):
+        self.epoch = epoch
+        self.kind = kind
+        self.interval_s = interval_s
+        self.seconds = seconds          # includes UNATTRIBUTED
+        self.h2d_bytes = h2d_bytes
+        self.d2h_bytes = d2h_bytes
+        self.warmup = warmup
+        # sealed on a cluster coordinator BEFORE worker ledgers merged:
+        # conservation is not checkable until drain_ledger folds them in
+        self.distributed = distributed
+        self.workers: List[str] = []    # merged-in worker tags
+
+    @property
+    def attributed_s(self) -> float:
+        return sum(s for p, s in self.seconds.items()
+                   if p != UNATTRIBUTED)
+
+    @property
+    def unattributed_s(self) -> float:
+        return self.seconds.get(UNATTRIBUTED, 0.0)
+
+    def coverage(self) -> float:
+        """Attributed fraction of the barrier interval (capped at 1:
+        concurrent host threads can oversum wall clock)."""
+        if self.interval_s <= 0:
+            return 1.0
+        return min(1.0, self.attributed_s / self.interval_s)
+
+    def recompute_unattributed(self) -> None:
+        named = self.attributed_s
+        resid = max(0.0, self.interval_s - named)
+        if resid > 0:
+            self.seconds[UNATTRIBUTED] = resid
+        else:
+            self.seconds.pop(UNATTRIBUTED, None)
+
+    def to_dict(self) -> dict:
+        return {"epoch": self.epoch, "kind": self.kind,
+                "interval_s": self.interval_s,
+                "seconds": dict(self.seconds),
+                "h2d_bytes": self.h2d_bytes,
+                "d2h_bytes": self.d2h_bytes,
+                "warmup": self.warmup,
+                "distributed": self.distributed,
+                "workers": list(self.workers)}
+
+
+class PhaseLedger:
+    """Process-global phase ledger (worker processes drain theirs to
+    the coordinator over the control channel, like the span tracer)."""
+
+    # conservation gate (tier-1 strict mode, conftest): a steady-state
+    # epoch longer than GATE_MIN_INTERVAL_S whose residual exceeds
+    # BOTH the fraction and the absolute floor is a violation. The
+    # floor absorbs fixed per-barrier machinery (event loop, barrier
+    # send/collect) that dominates micro-epochs; the fraction is the
+    # rot detector on real epochs.
+    GATE_MIN_INTERVAL_S = 0.4
+    GATE_RESIDUAL_FRAC = 0.35
+    GATE_RESIDUAL_MIN_S = 0.25
+
+    def __init__(self, window: int = 512):
+        self.window = window
+        self._open: "OrderedDict[int, _EpochAcc]" = OrderedDict()
+        self.records: Deque[LedgerRecord] = deque(maxlen=window)
+        # label stamped on the stream_epoch_phase_seconds query axis
+        # (bench sets it per lane; sessions leave it "")
+        self.query = ""
+        # cell commits race the uploader's worker threads' scopes
+        self._lock = threading.Lock()
+
+    # module-level kernel-context scope, re-exported on the instance
+    # (call sites hold LEDGER, not the module)
+    kernel_scope = staticmethod(kernel_scope)
+
+    # -- recording -----------------------------------------------------
+    def _acc(self, epoch: Optional[int] = None) -> _EpochAcc:
+        if epoch is None:
+            from risingwave_tpu.utils import spans as _spans
+            epoch = _spans.current_epoch()
+        acc = self._open.get(epoch)
+        if acc is None:
+            acc = self._open[epoch] = _EpochAcc()
+            while len(self._open) > OPEN_WINDOW:
+                self._open.popitem(last=False)
+        return acc
+
+    @contextlib.contextmanager
+    def phase(self, name: str, kernel: Optional[str] = None):
+        """Scoped timer: the block's EXCLUSIVE wall time (minus nested
+        scopes) lands in `name` — in the active executor cell when one
+        is set (epoch-exact flush at the barrier), else directly in the
+        newest injected epoch's accumulator."""
+        if not _ENABLED:
+            yield
+            return
+        parent = _SCOPE.get()
+        mine = [0.0]
+        tok = _SCOPE.set(mine)
+        ktok = _KERNEL.set(kernel) if kernel else None
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dur = time.perf_counter() - t0
+            _SCOPE.reset(tok)
+            if ktok is not None:
+                _KERNEL.reset(ktok)
+            if parent is not None:
+                parent[0] += dur
+            excl = max(0.0, dur - mine[0])
+            cell = _CELL.get()
+            if cell is not None:
+                cell.seconds[name] = cell.seconds.get(name, 0.0) + excl
+            else:
+                with self._lock:
+                    self._acc().add(name, excl)
+
+    def attribute(self, name: str, seconds: float,
+                  epoch: Optional[int] = None) -> None:
+        """Direct (non-scoped) attribution — executor residue, source
+        barrier_wait, barrier-loop commit work."""
+        if not _ENABLED or seconds <= 0:
+            return
+        with self._lock:
+            self._acc(epoch).add(name, seconds)
+
+    def add_bytes(self, direction: str, nbytes: int,
+                  kernel: Optional[str] = None) -> None:
+        """One host↔device transfer's payload: live Prometheus counter
+        (stream_transfer_bytes_total{dir,kernel}) plus the per-epoch
+        byte accumulators behind the Perfetto counter tracks."""
+        if not _ENABLED or nbytes <= 0:
+            return
+        from risingwave_tpu.utils.metrics import STREAMING
+        STREAMING.transfer_bytes.inc(
+            float(nbytes), dir=direction,
+            kernel=kernel or _KERNEL.get() or "unlabeled")
+        cell = _CELL.get()
+        if cell is not None:
+            if direction == "h2d":
+                cell.h2d_bytes += int(nbytes)
+            else:
+                cell.d2h_bytes += int(nbytes)
+            return
+        with self._lock:
+            acc = self._acc()
+            if direction == "h2d":
+                acc.h2d_bytes += int(nbytes)
+            else:
+                acc.d2h_bytes += int(nbytes)
+
+    def note_compile(self) -> None:
+        """A kernel (re)trace marks the epoch warmup: compile stalls
+        are expected to blow the conservation budget and are exempt
+        from the strict gate (the RecompileGuard polices them)."""
+        if not _ENABLED:
+            return
+        with self._lock:
+            self._acc().warmup = True
+
+    # -- executor cells (stream/monitor.py) ----------------------------
+    def push_cell(self, cell: AttributionCell):
+        return _CELL.set(cell)
+
+    def pop_cell(self, token) -> None:
+        _CELL.reset(token)
+
+    def commit_cell(self, epoch: int, cell: AttributionCell) -> None:
+        """Fold one executor's cell into the epoch it just finished
+        (called at barrier passage with the barrier's CURR epoch)."""
+        if not _ENABLED:
+            cell.take()
+            return
+        seconds, h2d, d2h = cell.take()
+        if not seconds and not h2d and not d2h:
+            return
+        with self._lock:
+            acc = self._acc(epoch)
+            for name, s in seconds.items():
+                acc.add(name, s)
+            acc.h2d_bytes += h2d
+            acc.d2h_bytes += d2h
+
+    # -- sealing -------------------------------------------------------
+    def seal(self, epoch: int, interval_s: float, kind: str = "barrier",
+             distributed: bool = False,
+             warmup: bool = False) -> Optional[LedgerRecord]:
+        """Close the epoch's books against its measured barrier
+        interval: residual → ``unattributed``, publish the Prometheus
+        phase family, the trace phase lanes + counter tracks, and the
+        rw_metrics_history row. ``warmup=True`` force-exempts the
+        epoch from the conservation gate (callers pass it for
+        mutation/topology barriers — deploy work is not epoch work)."""
+        if not _ENABLED:
+            self._open.pop(epoch, None)
+            return None
+        with self._lock:
+            acc = self._open.pop(epoch, None) or _EpochAcc()
+        rec = LedgerRecord(epoch, kind, float(interval_s),
+                           dict(acc.seconds), acc.h2d_bytes,
+                           acc.d2h_bytes, acc.warmup or warmup,
+                           distributed)
+        rec.recompute_unattributed()
+        self.records.append(rec)
+        self._publish(rec)
+        return rec
+
+    def discard(self, epoch: int) -> None:
+        """Drop an open epoch without sealing (virtual-clock loops:
+        the measured interval is simulated time, which the wall-clock
+        phases can never cover)."""
+        with self._lock:
+            self._open.pop(epoch, None)
+
+    def _publish(self, rec: LedgerRecord) -> None:
+        from risingwave_tpu.utils import spans as _spans
+        from risingwave_tpu.utils.metrics import HISTORY, STREAMING
+        q = self.query
+        for name, s in rec.seconds.items():
+            STREAMING.epoch_phase_seconds.inc(s, phase=name, query=q)
+        extra = {f"phase.{p}": rec.seconds.get(p, 0.0)
+                 for p in PHASES + (UNATTRIBUTED,)}
+        extra["coverage"] = rec.coverage()
+        extra["epoch_h2d_bytes"] = float(rec.h2d_bytes)
+        extra["epoch_d2h_bytes"] = float(rec.d2h_bytes)
+        HISTORY.observe(rec.epoch, rec.interval_s, extra=extra)
+        if not _spans.enabled():
+            return
+        now = time.time()
+        at = now - rec.interval_s
+        for name in PHASES + (UNATTRIBUTED,):
+            s = rec.seconds.get(name, 0.0)
+            if s <= 0:
+                continue
+            # phase lanes: stacked from the interval start in taxonomy
+            # order — a share view, not a literal timeline (phases
+            # interleave within the epoch)
+            _spans.EPOCH_TRACER.record(
+                f"phase.{name}", "phase", epoch=rec.epoch, start_s=at,
+                dur_s=s, share=round(s / rec.interval_s, 4)
+                if rec.interval_s > 0 else 0.0)
+            at += s
+        # counter-track sample (export_chrome renders 'C' events)
+        _spans.EPOCH_TRACER.record(
+            "ledger.counters", "counter", epoch=rec.epoch, start_s=now,
+            transfer_h2d_bytes=rec.h2d_bytes,
+            transfer_d2h_bytes=rec.d2h_bytes,
+            uploader_queue_depth=STREAMING.uploader_queue_depth.get(),
+            backlog_rows=sum(v for _l, v in
+                             STREAMING.backlog_rows.series()))
+
+    # -- conservation gate ---------------------------------------------
+    def gate_violations(self) -> List[tuple]:
+        """(epoch, interval_s, unattributed_s, coverage) per sealed
+        steady-state epoch over budget — the tier-1 strict-mode gate."""
+        out = []
+        for rec in self.records:
+            if rec.warmup or rec.distributed:
+                continue
+            if rec.interval_s < self.GATE_MIN_INTERVAL_S:
+                continue
+            resid = rec.unattributed_s
+            if resid > max(self.GATE_RESIDUAL_FRAC * rec.interval_s,
+                           self.GATE_RESIDUAL_MIN_S):
+                out.append((rec.epoch, rec.interval_s, resid,
+                            rec.coverage()))
+        return out
+
+    # -- cross-process merge (cluster drain, like spans.drain_dicts) ---
+    def drain_dicts(self) -> List[dict]:
+        """Pop every OPEN accumulator as plain dicts (worker →
+        coordinator: workers never seal — the coordinator owns the
+        barrier interval)."""
+        with self._lock:
+            out = [{"epoch": e, "seconds": dict(a.seconds),
+                    "h2d_bytes": a.h2d_bytes, "d2h_bytes": a.d2h_bytes,
+                    "warmup": a.warmup}
+                   for e, a in self._open.items()]
+            self._open.clear()
+        return out
+
+    def ingest(self, dicts: Iterable[dict], worker: str = "",
+               resolve: bool = True) -> int:
+        """Merge drained worker accumulators: into the sealed record
+        of the same epoch when one exists (recomputing the residual —
+        this is what resolves a distributed record's conservation),
+        else into the open accumulator. ``resolve=False`` keeps the
+        record conservation-exempt: the caller knows some worker's
+        books never arrived (a dead slot), so the residual would be
+        a phantom of the missing process, not rot.
+
+        Merged seconds are also published into the
+        stream_epoch_phase_seconds family so the cluster's Prometheus
+        view carries worker time, not just the coordinator's (the
+        residual correction, in contrast, lives only in the records —
+        a counter cannot un-count the already-published coordinator
+        `unattributed`; rw_metrics_history rows likewise keep their
+        seal-time coordinator view)."""
+        from risingwave_tpu.utils.metrics import STREAMING
+        by_epoch = {r.epoch: r for r in self.records}
+        n = 0
+        for d in dicts:
+            e = int(d["epoch"])
+            rec = by_epoch.get(e)
+            if rec is not None:
+                for name, s in (d.get("seconds") or {}).items():
+                    rec.seconds[name] = rec.seconds.get(name, 0.0) \
+                        + float(s)
+                    STREAMING.epoch_phase_seconds.inc(
+                        float(s), phase=name, query=self.query)
+                rec.h2d_bytes += int(d.get("h2d_bytes", 0))
+                rec.d2h_bytes += int(d.get("d2h_bytes", 0))
+                rec.warmup = rec.warmup or bool(d.get("warmup"))
+                if worker and worker not in rec.workers:
+                    rec.workers.append(worker)
+                if resolve:
+                    rec.distributed = False  # conservation checkable
+                rec.recompute_unattributed()
+            else:
+                with self._lock:
+                    acc = self._acc(e)
+                    for name, s in (d.get("seconds") or {}).items():
+                        acc.add(name, float(s))
+                    acc.h2d_bytes += int(d.get("h2d_bytes", 0))
+                    acc.d2h_bytes += int(d.get("d2h_bytes", 0))
+                    acc.warmup = acc.warmup or bool(d.get("warmup"))
+            n += 1
+        return n
+
+    # -- reads ---------------------------------------------------------
+    # epochs shorter than this carry only fixed barrier machinery (an
+    # empty heartbeat is ~sub-ms of inject/collect bookkeeping): they
+    # hold no meaningful share of a run and are excluded from the
+    # coverage statistics (still counted, still summed into phases)
+    MICRO_EPOCH_S = 0.005
+
+    def phase_breakdown(self, steady_only: bool = True) -> dict:
+        """Aggregate share view over sealed epochs (bench's per-query
+        ``phase_breakdown`` block and the ``ctl phases`` totals).
+        ``steady_only`` drops warmup (compile-bearing) epochs."""
+        recs = [r for r in self.records
+                if not (steady_only and r.warmup)]
+        if not recs:
+            return {"epochs": 0}
+        total = sum(r.interval_s for r in recs)
+        phases = {}
+        for name in PHASES + (UNATTRIBUTED,):
+            s = sum(r.seconds.get(name, 0.0) for r in recs)
+            if s > 0 or name == UNATTRIBUTED:
+                phases[name] = {
+                    "seconds": round(s, 6),
+                    "share": round(s / total, 4) if total > 0 else 0.0}
+        full = [r for r in recs if r.interval_s >= self.MICRO_EPOCH_S]
+        covs = [r.coverage() for r in (full or recs)]
+        return {
+            "epochs": len(recs),
+            "micro_epochs": len(recs) - len(full),
+            "interval_s": round(total, 6),
+            "phases": phases,
+            "coverage_mean": round(sum(covs) / len(covs), 4),
+            "coverage_min": round(min(covs), 4),
+            "h2d_bytes": int(sum(r.h2d_bytes for r in recs)),
+            "d2h_bytes": int(sum(r.d2h_bytes for r in recs)),
+        }
+
+    def report(self, last_n: int = 16) -> str:
+        """Human-readable per-epoch table (``ctl phases``)."""
+        lines = []
+        for rec in list(self.records)[-last_n:]:
+            head = (f"epoch {rec.epoch:#x} ({rec.kind}"
+                    f"{', warmup' if rec.warmup else ''}): "
+                    f"{rec.interval_s * 1e3:.2f}ms, coverage "
+                    f"{rec.coverage() * 100:.0f}%")
+            lines.append(head)
+            for name in PHASES + (UNATTRIBUTED,):
+                s = rec.seconds.get(name, 0.0)
+                if s <= 0:
+                    continue
+                share = (100.0 * s / rec.interval_s
+                         if rec.interval_s > 0 else 0.0)
+                lines.append(f"  {name:<15} {s * 1e3:9.2f}ms "
+                             f"{share:5.1f}%")
+            if rec.h2d_bytes or rec.d2h_bytes:
+                lines.append(f"  bytes: h2d={rec.h2d_bytes} "
+                             f"d2h={rec.d2h_bytes}")
+        return "\n".join(lines)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._open.clear()
+            self.records.clear()
+
+
+# the process-global ledger (worker processes drain to the coordinator)
+LEDGER = PhaseLedger()

@@ -396,6 +396,31 @@ class StreamingMetrics:
         self.uploader_queue_depth = r.gauge(
             "meta_checkpoint_uploader_queue_depth",
             "checkpoint epochs sealed but not yet durably committed")
+        # -- epoch phase ledger (utils/ledger.py) ---------------------
+        self.epoch_phase_seconds = r.counter(
+            "stream_epoch_phase_seconds",
+            "barrier wall-clock attributed per phase "
+            "(host_ingest/host_pack/h2d/device_compute/d2h/host_emit/"
+            "barrier_wait; the conservation residual publishes as "
+            "phase=unattributed)")
+        self.transfer_bytes = r.counter(
+            "stream_transfer_bytes_total",
+            "host<->device transfer payload bytes by direction "
+            "(dir=h2d|d2h) and kernel")
+        self.backlog_rows = r.gauge(
+            "stream_epoch_backlog_rows",
+            "rows carried by the kernel's most recent epoch-batched "
+            "dispatch (set at each backlog flush; sampled at every "
+            "epoch seal as the Perfetto backlog counter track — the "
+            "per-epoch staging volume, not a live queue depth)")
+        self.kernel_flops = r.gauge(
+            "device_kernel_flops",
+            "XLA cost-analysis flops of the last-compiled program per "
+            "kernel label (published lazily: ctl phases / bench)")
+        self.kernel_bytes_accessed = r.gauge(
+            "device_kernel_bytes_accessed",
+            "XLA cost-analysis bytes-accessed of the last-compiled "
+            "program per kernel label")
 
 
 class ClusterMetrics:
@@ -458,3 +483,96 @@ class StorageMetrics:
 STREAMING = StreamingMetrics()
 STORAGE = StorageMetrics()
 CLUSTER = ClusterMetrics()
+
+
+class MetricsHistory:
+    """Bounded per-barrier time series: last N barriers × selected
+    counter DELTAS and gauge values (arxiv 1904.03800's concurrent-
+    bookkeeping stance: the control loop reads history, not one
+    instantaneous scrape). One row lands per sealed barrier
+    (utils/ledger.seal), carrying the tracked registry series plus the
+    ledger's phase seconds/coverage/bytes as ``extra``. Backs the
+    ``rw_metrics_history`` system table and the ROADMAP-item-3
+    autoscaler's telemetry feed."""
+
+    def __init__(self, capacity: int = 512):
+        self.capacity = capacity
+        from collections import deque
+        self._ring = deque(maxlen=capacity)
+        self._last: Dict[str, float] = {}
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def _tracked(self):
+        """(series name, read fn, kind) — counters report per-barrier
+        deltas, gauges report the value at seal."""
+        def csum(metric, **labels):
+            if labels:
+                return sum(v for l, v in metric.series()
+                           if all(l.get(k) == val
+                                  for k, val in labels.items()))
+            return sum(v for _l, v in metric.series())
+
+        S = STREAMING
+        return (
+            ("source_rows", lambda: csum(S.source_rows), "counter"),
+            ("device_dispatches",
+             lambda: csum(S.device_dispatch), "counter"),
+            ("h2d_bytes",
+             lambda: csum(S.transfer_bytes, dir="h2d"), "counter"),
+            ("d2h_bytes",
+             lambda: csum(S.transfer_bytes, dir="d2h"), "counter"),
+            ("checkpoints",
+             lambda: csum(S.checkpoint_count), "counter"),
+            ("kernel_recompiles",
+             lambda: csum(S.kernel_recompile), "counter"),
+            ("exchange_backpressure_s",
+             lambda: csum(S.exchange_backpressure), "counter"),
+            ("uploader_queue_depth",
+             lambda: S.uploader_queue_depth.get(), "gauge"),
+            ("barrier_in_flight",
+             lambda: S.barrier_in_flight.get(), "gauge"),
+            ("backlog_rows", lambda: csum(S.backlog_rows), "gauge"),
+        )
+
+    def observe(self, epoch: int, interval_s: float,
+                extra: Optional[Dict[str, float]] = None) -> None:
+        values: Dict[str, float] = {}
+        for name, fn, kind in self._tracked():
+            v = float(fn())
+            if kind == "counter":
+                values[name] = v - self._last.get(name, 0.0)
+                self._last[name] = v
+            else:
+                values[name] = v
+        if extra:
+            values.update(extra)
+        with self._lock:
+            self._seq += 1
+            self._ring.append((self._seq, int(epoch), time.time(),
+                               float(interval_s), values))
+
+    def rows(self) -> List[tuple]:
+        """(seq, epoch, ts, interval_s, name, value) long-format rows
+        — the rw_metrics_history system-table payload."""
+        with self._lock:
+            snap = list(self._ring)
+        out = []
+        for seq, epoch, ts, interval_s, values in snap:
+            for name in sorted(values):
+                out.append((seq, epoch, ts, interval_s, name,
+                            float(values[name])))
+        return out
+
+    def barriers(self) -> int:
+        return len(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._last.clear()
+            self._seq = 0
+
+
+# the process-global per-barrier history ring (fed at ledger seal)
+HISTORY = MetricsHistory()

@@ -339,6 +339,20 @@ class EpochTracer:
             spans = self.spans_for(e)
             by_id = {s.span_id: s for s in spans}
             for s in spans:
+                if s.cat == "counter":
+                    # counter tracks ('C' events): one per numeric arg
+                    # — transfer bytes, uploader queue depth, backlog
+                    # rows sampled at each epoch seal render as value-
+                    # over-time lanes next to the span timeline
+                    for key, val in s.args.items():
+                        if not isinstance(val, (int, float)):
+                            continue
+                        events.append({
+                            "name": key, "cat": "counter", "ph": "C",
+                            "ts": s.start_s * 1e6,
+                            "pid": s.worker or "coordinator",
+                            "args": {"value": float(val)}})
+                    continue
                 pid, tid = lane(s)
                 ts = s.start_s * 1e6
                 dur = max(s.dur_s * 1e6, 1.0)
@@ -397,27 +411,40 @@ def dispatch_span(kernel: str, rows: float, **args):
     launch enqueue) into the current epoch's trace, stamped with kernel
     identity and row payload. A retrace during the call shows up as a
     sibling compile span (note_compile). Near-free when tracing is
-    off."""
-    if not _ENABLED:
+    off.
+
+    Phase ledger: the span's EXCLUSIVE time (minus nested h2d/d2h
+    scopes) is the launch's device_compute share, stamped with the
+    kernel label so transfers recorded inside inherit it."""
+    from contextlib import nullcontext
+
+    from risingwave_tpu.utils import ledger as _ledger
+    if not _ENABLED and not _ledger.enabled():
         yield
         return
     t0 = time.time()
     try:
-        yield
+        with _ledger.LEDGER.phase("device_compute", kernel=kernel) \
+                if _ledger.enabled() else nullcontext():
+            yield
     finally:
-        EPOCH_TRACER.record(kernel, "dispatch", start_s=t0,
-                            dur_s=time.time() - t0, rows=float(rows),
-                            **args)
+        if _ENABLED:
+            EPOCH_TRACER.record(kernel, "dispatch", start_s=t0,
+                                dur_s=time.time() - t0,
+                                rows=float(rows), **args)
 
 
 def note_compile(label: str) -> None:
     """Called from INSIDE a jitted function's Python body — which runs
     only while jax traces it — so every call IS a (re)trace event:
     first-compile at warmup, shape-churn recompiles in steady state.
-    Counts stream_kernel_recompile_count and drops a compile span into
-    the current epoch's trace."""
+    Counts stream_kernel_recompile_count, drops a compile span into
+    the current epoch's trace, and marks the epoch warmup in the phase
+    ledger (compile stalls are exempt from the conservation gate)."""
     from risingwave_tpu.utils.metrics import STREAMING
     STREAMING.kernel_recompile.inc(1, kernel=label)
+    from risingwave_tpu.utils.ledger import LEDGER
+    LEDGER.note_compile()
     if _ENABLED:
         EPOCH_TRACER.record(f"compile:{label}", "compile",
                             kernel=label)

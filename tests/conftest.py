@@ -80,6 +80,30 @@ def _strict_memory_accounting():
         f"eviction failed to bound it")
 
 
+@pytest.fixture(autouse=True)
+def _conservation_gate():
+    """Tier-1 strict mode for the epoch phase ledger (utils/ledger.py):
+    any steady-state epoch a test drives whose `unattributed` residual
+    exceeds the conservation budget fails the test — the ledger can
+    never silently rot. Warmup (compile-bearing), mutation and
+    unmerged-distributed epochs are exempt; micro-epochs are below the
+    gate's interval floor. Sits next to the RecompileGuard and
+    DispatchBudget strict-mode guards."""
+    from risingwave_tpu.utils import ledger as _ledger
+    _ledger.set_enabled(True)
+    _ledger.LEDGER.clear()
+    yield
+    violations = _ledger.LEDGER.gate_violations()
+    _ledger.LEDGER.clear()
+    _ledger.set_enabled(True)
+    assert not violations, (
+        "epoch phase ledger conservation gate (tier-1 strict mode): "
+        "steady-state epochs carried unattributed wall-clock over "
+        "budget — an uninstrumented stall crept into the barrier "
+        "path. (epoch, interval_s, unattributed_s, coverage): "
+        f"{[(hex(e), round(i, 3), round(u, 3), c) for e, i, u, c in violations]}")
+
+
 def _worker_children() -> list:
     """PIDs of live `risingwave_tpu.cluster.worker` subprocesses whose
     parent is this test process. Zombies (state Z) don't count — a

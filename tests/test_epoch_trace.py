@@ -535,6 +535,8 @@ def test_cluster_two_worker_slow_barrier_causal_trace(tmp_path):
     out = json.loads(json.dumps(
         EPOCH_TRACER.export_chrome(epochs=[epoch])))
     xs = [e for e in out["traceEvents"] if e["ph"] == "X"]
-    assert len(xs) == len(spans)
+    # counter-sample spans (phase-ledger byte/queue tracks) render as
+    # 'C' counter events, not slices
+    assert len(xs) == len([s for s in spans if s.cat != "counter"])
     assert {e["pid"] for e in xs} >= {"coordinator", "worker-0",
                                       "worker-1"}
