@@ -33,21 +33,54 @@ from risingwave_tpu.connectors.parser import RowParser, make_parser
 _PART_RE = re.compile(r"^(?P<topic>.+)-(?P<part>\d+)\.log$")
 
 
+# block size for the bulk read path: big enough that a typical chunk's
+# records arrive in one read, small enough that an over-read past the
+# line limit stays cheap (the tail re-seeks by returned `consumed`)
+_READ_BLOCK = 1 << 20
+
+
 def _read_complete_records(f, payloads: List[bytes],
                            limit: int) -> int:
     """Append up to `limit` COMPLETE newline-terminated records from an
     open file handle; returns bytes consumed. A trailing line without
     its newline is a torn write (or segment end) and stays unconsumed —
-    the one 'complete record' protocol both readers share."""
+    the one 'complete record' protocol both readers share.
+
+    Reads in blocks and splits at C speed (ISSUE 12): the old
+    readline-per-record loop was ~1s of the ad-ctr ingest profile at
+    200K records. Callers advance their offset by the returned byte
+    count, so over-reading past `limit` lines costs nothing — the
+    unconsumed suffix is simply not counted."""
     consumed = 0
+    pending = b""
     while len(payloads) < limit:
-        line = f.readline()
-        if not line.endswith(b"\n"):
+        block = f.read(_READ_BLOCK)
+        if not block:
             break
-        consumed += len(line)
-        rec = line.rstrip(b"\r\n")
-        if rec:
-            payloads.append(rec)
+        data = pending + block
+        # only COMPLETE lines: the suffix after the last newline is
+        # torn (or mid-write) and carries over / stays unconsumed
+        cut = data.rfind(b"\n")
+        if cut < 0:
+            pending = data
+            continue
+        lines = data[:cut].split(b"\n")
+        rest = limit - len(payloads)
+        if len(lines) > rest:
+            lines = lines[:rest]
+            # consumed bytes = the kept lines + their newlines (any
+            # carried-over pending prefix is part of the first line)
+            consumed += sum(map(len, lines)) + len(lines)
+            payloads.extend(
+                ln.rstrip(b"\r") for ln in lines if ln.rstrip(b"\r"))
+            return consumed
+        consumed += cut + 1          # includes the pending prefix
+        # the partial line past the last newline carries into the
+        # next block — dropping it would corrupt any record that
+        # straddles a read-block boundary
+        pending = data[cut + 1:]
+        payloads.extend(
+            ln.rstrip(b"\r") for ln in lines if ln.rstrip(b"\r"))
     return consumed
 
 

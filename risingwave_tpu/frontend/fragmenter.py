@@ -119,6 +119,11 @@ def _stages_ir(fs) -> List[dict]:
                         "table_id": (st.runtime.state.table_id
                                      if st.runtime.state is not None
                                      else None)})
+        elif st.kind == "hop_window":
+            out.append({"kind": "hop_window",
+                        "time_col": st.time_col,
+                        "slide_usecs": st.slide_usecs,
+                        "size_usecs": st.size_usecs})
         else:
             raise FragmentError(f"unknown fused stage kind {st.kind!r}")
     return out

@@ -335,13 +335,13 @@ def _verify_fused_stages(fs, input_schema, where: str) -> None:
         raise CheckError(
             f"{where}: fused run planned against a different input "
             "schema than the one feeding it")
-    # composed exprs bind against the EXTENDED schema: synthetic
-    # runtime columns (absorbed row ids, watermark thresholds) are
-    # legal refs past the real input
+    # composed exprs bind against the BODY schema: synthetic runtime
+    # columns (absorbed row ids, watermark thresholds) and an absorbed
+    # hop's window columns are legal refs past the real input
     for p in fs.preds:
-        _check_expr(p, fs.ext_schema, f"{where} pred")
+        _check_expr(p, fs.body_schema, f"{where} pred")
     for j, e in enumerate(fs.out_exprs or []):
-        _check_expr(e, fs.ext_schema, f"{where} expr")
+        _check_expr(e, fs.body_schema, f"{where} expr")
     r = fs.fusable_reason()
     if r is not None:
         raise CheckError(f"{where}: run is not traceable ({r})")

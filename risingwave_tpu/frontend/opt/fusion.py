@@ -36,15 +36,19 @@ from risingwave_tpu.stream.executor import executor_children
 
 
 # which executor kinds each absorption shape accepts: agg preludes
-# stay filter/project (the kernel's apply cannot emit watermark
-# messages or rebase id counters); join input runs add row_id_gen
-# (the generated pk column rides the raw matrix as a synthetic
-# device input); standalone blocks additionally absorb
-# watermark_filter (the block's own message loop does the watermark
-# emission/persistence the absorbed executor used to)
-AGG_KINDS = frozenset({"filter", "project"})
-JOIN_KINDS = AGG_KINDS | {"row_id_gen"}
-BLOCK_KINDS = JOIN_KINDS | {"watermark_filter"}
+# take filter/project plus a head-of-run hop_window (ISSUE 12: the
+# units× row expansion and window-lane synthesis happen INSIDE the
+# jitted apply — the watermark transform is per-message host work the
+# executor's derive_watermarks path already runs); join input runs add
+# row_id_gen (the generated pk column rides the raw matrix as a
+# synthetic device input) but NOT hop_window — the expansion changes
+# cardinality, which the join's host-built per-row aux flags cannot
+# follow; standalone blocks additionally absorb watermark_filter (the
+# block's own message loop does the watermark emission/persistence
+# the absorbed executor used to) and hop_window
+AGG_KINDS = frozenset({"filter", "project", "hop_window"})
+JOIN_KINDS = frozenset({"filter", "project", "row_id_gen"})
+BLOCK_KINDS = JOIN_KINDS | {"watermark_filter", "hop_window"}
 
 
 def _as_stage(ex, kinds=BLOCK_KINDS):
@@ -76,6 +80,13 @@ def _as_stage(ex, kinds=BLOCK_KINDS):
         return FusedStage("watermark_filter", "WatermarkFilterExecutor",
                           time_col=ex.time_col, delay_usecs=ex.delay,
                           runtime=ex)
+    from risingwave_tpu.stream.executors.hop_window import (
+        HopWindowExecutor,
+    )
+    if isinstance(ex, HopWindowExecutor) and "hop_window" in kinds:
+        return FusedStage("hop_window", "HopWindowExecutor",
+                          time_col=ex.time_col,
+                          slide_usecs=ex.slide, size_usecs=ex.size)
     return None
 
 
@@ -90,6 +101,11 @@ def _collect_run(top, kinds=BLOCK_KINDS) -> Tuple[list, object]:
             break
         rev.append(st)
         node = node.input
+        if st.kind == "hop_window":
+            # a hop must HEAD the run (everything downstream composes
+            # in its output space) — stop extending upstream so the
+            # collected run ends exactly at the expansion
+            break
     return list(reversed(rev)), node
 
 
@@ -195,6 +211,16 @@ def fuse_fragments(root, dist_parallelism: int = 1
         reason = fs.fusable_reason()
         if reason is not None:
             details.append(f"agg run NOT fused ({reason})")
+            return None
+        if fs.hop is not None and agg._kernel is not None:
+            # injected (sharded) kernels size their vnode routing for
+            # the UPLOADED row count — a hop prelude multiplies rows
+            # in-trace past those shapes. Single-chip lazy kernels
+            # (the _kernel-is-None case) expand freely.
+            details.append(
+                "agg run NOT fused (hop expansion needs the "
+                "single-chip lazy kernel — sharded routing shapes "
+                "are sized pre-expansion)")
             return None
         if dist_parallelism > 1 and \
                 getattr(agg, "two_phase_role", None) != "local" and \

@@ -230,7 +230,8 @@ class FusedStage:
     """One logical executor inside a fused block (metrics identity +
     the pieces EXPLAIN and the fragmenter serialize)."""
 
-    kind: str    # "filter" | "project" | "row_id_gen" | "watermark_filter"
+    kind: str    # "filter" | "project" | "row_id_gen"
+    #            # | "watermark_filter" | "hop_window"
     identity: str                  # e.g. "FilterExecutor"
     # filter: the ORIGINAL predicate (own column space); project: the
     # original exprs/names. Serialized by the fragmenter.
@@ -241,10 +242,17 @@ class FusedStage:
     # row_id_gen / watermark_filter runtime state (the id counter's
     # shard base, the watermark StateTable) is carried by `runtime` —
     # a HOST-ONLY handle, never serialized (the fragmenter re-derives
-    # it from table ids)
+    # it from table ids); hop_window: time_col + slide/size (pure
+    # parameters, no runtime)
     time_col: int = -1
     delay_usecs: int = 0
+    slide_usecs: int = 0
+    size_usecs: int = 0
     runtime: object = None
+
+    @property
+    def units(self) -> int:
+        return self.size_usecs // self.slide_usecs
 
 
 class FusedStages:
@@ -284,12 +292,36 @@ class FusedStages:
         n_in = len(in_schema)
         self.row_id_stages: List[tuple] = []   # (stage_i, ext col)
         self.wm_stages: List[tuple] = []       # (stage_i, ext col)
-        # compose onto the input space
+        # hop_window absorption (ISSUE 12): a head-of-run hop stage
+        # replicates every row `units`× IN-TRACE and synthesizes
+        # window_start/window_end columns from the time column — the
+        # composition space for everything downstream is the hop
+        # OUTPUT space (in_schema + the two window columns), while the
+        # raw upload keeps the PRE-hop arity (the expansion never
+        # crosses the host boundary).
+        self.hop: Optional[FusedStage] = None
+        base_fields = list(in_schema.fields)
+        if self.stages[0].kind == "hop_window":
+            self.hop = self.stages[0]
+            base_fields = base_fields + [
+                Field("window_start", DataType.TIMESTAMP),
+                Field("window_end", DataType.TIMESTAMP)]
+        if any(st.kind == "hop_window" for st in self.stages[1:]):
+            # non-head hops never compose (the window columns would
+            # not exist in the downstream stages' spaces) — the rule
+            # pre-refuses these runs; fail loud on direct misuse
+            raise ValueError("hop_window stage must head the run")
+        base_schema = Schema(base_fields) if self.hop is not None \
+            else in_schema
+        self._base_schema = base_schema
+        # compose onto the (post-hop) input space
         cur: Optional[list] = None          # None = identity projection
         preds: List[object] = []
         pred_stage: List[int] = []          # stage index per pred
-        names = [f.name for f in in_schema]
+        names = [f.name for f in base_schema]
         for si, st in enumerate(self.stages):
+            if st.kind == "hop_window":
+                continue                     # space change handled above
             if st.kind == "filter":
                 (p,) = st.exprs
                 preds.append(p if cur is None else subst_expr(p, cur))
@@ -322,11 +354,17 @@ class FusedStages:
         self.ext_schema = Schema(list(in_schema.fields)
                                  + syn_fields) if syn_fields \
             else in_schema
+        # the space the composed preds/exprs bind against: the RAW
+        # trace-input space plus synthetics — or, with an absorbed
+        # hop, the hop OUTPUT space (window columns are synthesized
+        # in-trace from the time column, never uploaded)
+        self.body_schema = base_schema if self.hop is not None \
+            else self.ext_schema
         self.preds = preds
         self._pred_stage = pred_stage
         self.out_exprs = cur
         if cur is None:
-            self.out_schema = in_schema
+            self.out_schema = base_schema
         else:
             self.out_schema = Schema([
                 Field(n, e.return_type) for n, e in zip(names, cur)])
@@ -349,7 +387,9 @@ class FusedStages:
         # columns ride AROUND the trace (positional vis/ops are shared)
         self.host_out: Dict[int, int] = {}
         if self.out_exprs is None:
-            for i, f in enumerate(in_schema):
+            for i, f in enumerate(base_schema):
+                if i >= n_in:
+                    continue      # hop window cols: synthesized in-trace
                 if f.data_type.is_device:
                     refs.add(i)
                 else:
@@ -358,6 +398,12 @@ class FusedStages:
             for j, e in enumerate(self.out_exprs):
                 if isinstance(e, InputRef) and not e.return_type.is_device:
                     self.host_out[j] = e.index
+        if self.hop is not None:
+            # window-column refs resolve to the time column they are
+            # synthesized from; the raw matrix never carries them
+            refs = {self.hop.time_col if i >= n_in else i
+                    for i in refs}
+            refs.add(self.hop.time_col)
         self.ref_cols: List[int] = sorted(
             i for i in refs if self.ext_schema[i].data_type.is_device)
         # per-stage row attribution drained by the monitor at barriers
@@ -367,6 +413,20 @@ class FusedStages:
     # -- eligibility -------------------------------------------------------
     def fusable_reason(self) -> Optional[str]:
         """None iff the composed run traces; else the first refusal."""
+        if self.hop is not None:
+            if self.wm_stages or self.row_id_stages:
+                # both machineries claim the head/synthetic-column
+                # slots; the planner never emits these shapes anyway
+                return ("hop_window cannot share a run with absorbed "
+                        "runtime stages")
+            for st in self.stages[1:]:
+                if st.kind == "hop_window":
+                    return "more than one hop_window stage in the run"
+            dt_t = self.in_schema[self.hop.time_col].data_type
+            if not dt_t.is_device or \
+                    np.dtype(dt_t.np_dtype).kind not in "iu":
+                return ("hop_window over non-integer time column "
+                        f"{dt_t.value}")
         if len(self.wm_stages) > 1:
             return "more than one watermark_filter stage in the run"
         for si, _syn in self.wm_stages:
@@ -383,13 +443,13 @@ class FusedStages:
                 return ("watermark_filter over non-integer time "
                         f"column {dt_t.value}")
         for p in self.preds:
-            r = traceable_reason(p, self.ext_schema)
+            r = traceable_reason(p, self.body_schema)
             if r:
                 return r
         for j, e in enumerate(self.out_exprs or []):
             if j in self.host_out:
                 continue            # host passthrough, never traced
-            r = traceable_reason(e, self.ext_schema)
+            r = traceable_reason(e, self.body_schema)
             if r:
                 return r
         return None
@@ -520,6 +580,10 @@ class FusedStages:
                 d["exprs"] = [expr_to_ir(e) for e in st.exprs]
             elif st.kind == "watermark_filter":
                 d["time_col"] = st.time_col
+            elif st.kind == "hop_window":
+                d["time_col"] = st.time_col
+                d["slide"] = st.slide_usecs
+                d["size"] = st.size_usecs
             parts.append(d)
         schema = [f.data_type.value for f in self.in_schema]
         return _json.dumps([schema, parts], sort_keys=True,
@@ -558,6 +622,22 @@ class FusedStages:
         from risingwave_tpu.stream.message import Watermark
         outs = [msg]
         for st in self.stages:
+            if st.kind == "hop_window":
+                # HopWindowExecutor's exact rule: a bound on ts is a
+                # bound on the LAST covering window's start; every
+                # other watermark is consumed (the expansion breaks
+                # per-column monotonicity guarantees)
+                nxt = []
+                ws_idx = len(self.in_schema)
+                for m in outs:
+                    if m.col_idx == st.time_col:
+                        b = (int(m.value) // st.slide_usecs) \
+                            * st.slide_usecs
+                        nxt.append(Watermark(
+                            ws_idx, DataType.TIMESTAMP,
+                            b - (st.units - 1) * st.slide_usecs))
+                outs = nxt
+                continue
             if st.kind != "project":
                 continue
             nxt: List = []
@@ -631,13 +711,18 @@ class FusedStages:
         from risingwave_tpu.stream.executors.simple import (
             FilterExecutor,
         )
-        chunk = StreamChunk(self.ext_schema, cols, vis, ops)
         # per-stage rows: each filter's post-predicate count; projects
         # report the count AT THEIR POSITION in dataflow order (not the
         # final count — a filter after a project must not retroactively
         # shrink the project's attribution)
         n_stages = len(self.stages)
         stage_rows = [None] * n_stages
+        if self.hop is not None:
+            cols, vis, ops, host_same = self._expand_hop(
+                cols, vis, ops, xp, host_same)
+        chunk = StreamChunk(self.body_schema, cols, vis, ops)
+        if self.hop is not None:
+            stage_rows[0] = xp.sum(vis.astype(xp.int64))
         for si, syn in self.wm_stages:
             # head-of-run late mask (WatermarkFilterExecutor._apply):
             # rows with a valid event time BELOW the pre-chunk
@@ -660,10 +745,11 @@ class FusedStages:
             # filter-only run: every INPUT column passes through —
             # device columns from the (possibly traced) chunk, host
             # columns as None placeholders the caller reattaches
-            # positionally. Synthetic runtime columns never leave.
+            # positionally. Synthetic runtime columns never leave;
+            # hop window columns (part of the base space) do.
             out_cols = [None if j in self.host_out else c
                         for j, c in
-                        enumerate(chunk.columns[:len(self.in_schema)])]
+                        enumerate(chunk.columns[:len(self._base_schema)])]
         else:
             for j, e in enumerate(self.out_exprs):
                 out_cols.append(None if j in self.host_out
@@ -686,6 +772,38 @@ class FusedStages:
         # drop (the sequential chain's final project would drop there)
         stage_rows[-1] = final_n
         return out_cols, vis2, ops2, xp.stack(stage_rows)
+
+    def _expand_hop(self, cols: List[Column], vis, ops, xp,
+                    host_same=None):
+        """In-trace hop expansion (HopWindowExecutor's exact math):
+        `units` copy-major replicas of every column — copy i carries
+        window_start = floor(ts/slide)*slide - i*slide — with NULL-
+        timestamp rows masked invisible up front. Copy-major order
+        preserves U-/U+ adjacency inside every copy, and copy
+        boundaries end on the batch codec's invisible separator row,
+        so the shifted pair compares never marry rows across copies.
+        ``host_same`` (host passthrough adjacent-equality) tiles the
+        same way — its wrap element lands exactly on the copy
+        boundary's (last, first) pair, which the original wrap already
+        computed."""
+        st = self.hop
+        units = st.units
+        slide = st.slide_usecs
+        c_ts = cols[st.time_col]
+        ts = c_ts.values.astype(xp.int64)
+        okm = vis if c_ts.validity is None else vis & c_ts.validity
+        base = (ts // slide) * slide
+        ws = xp.concatenate([base - i * slide for i in range(units)])
+        out_cols = [Column(c.data_type, xp.tile(c.values, units),
+                           None if c.validity is None
+                           else xp.tile(c.validity, units))
+                    for c in cols]
+        out_cols.append(Column(DataType.TIMESTAMP, ws, None))
+        out_cols.append(Column(DataType.TIMESTAMP, ws + st.size_usecs,
+                               None))
+        return (out_cols, xp.tile(okm, units), xp.tile(ops, units),
+                None if host_same is None
+                else xp.tile(host_same, units))
 
 
 def _drop_noop_pairs_xp(cols: Sequence[Column], vis, ops, xp,
@@ -822,6 +940,8 @@ def build_join_prelude(fs: FusedStages, key_indices: Sequence[int],
     contract, so the device never needs to re-decide them."""
     import jax.numpy as jnp
 
+    assert fs.hop is None, \
+        "hop expansion changes cardinality — join preludes refuse it"
     schema = fs.ext_schema
     ref = list(fs.ref_cols)
     keys = list(key_indices)

@@ -933,10 +933,17 @@ class GroupedAggKernel:
                  capacity: Optional[int] = None,
                  flush_capacity: int = 1 << 10,
                  prelude=None, raw_width: Optional[int] = None,
-                 metrics_label: Optional[str] = None):
+                 metrics_label: Optional[str] = None,
+                 expand_units: int = 1):
         if capacity is None:
             capacity = self.DEFAULT_CAPACITY
         capacity = max(next_pow2(capacity), ht.MIN_CAPACITY)
+        # expand_units (hop-absorbing preludes) is advisory: the
+        # traced step multiplies raw rows `units`× before the scatter.
+        # Shrinking the raw backlog to match was measured SLOWER on
+        # CPU (more dispatches beat bigger ones only on the tunneled
+        # device) — kept as a parameter so device rounds can tune it.
+        self._expand_units = expand_units
         self.specs = tuple(specs)
         self.key_width = key_width
         self.state = make_agg_state(capacity, key_width, self.specs)
@@ -996,13 +1003,27 @@ class GroupedAggKernel:
                                 np.asarray(key_lanes),
                                 np.asarray(signs),
                                 np.asarray(vis), inputs)
+        # split-fill the batch slab (ISSUE 12): accumulator scatters
+        # are row-independent (U-/U+ halves are just ±1 rows — pair
+        # adjacency only matters in fused raw mode, which keeps chunk
+        # boundaries), so a packed chunk may straddle two dispatches.
+        # Without this, chunk sizes that don't divide BATCH_ROWS
+        # (hop-expanded 4-copy groups, coalesced odd sizes) quantize
+        # each dispatch to ~60% fill and pad the rest on device.
         n = len(signs)
-        if self._backlog_rows + n > self.BATCH_ROWS:
-            self._dispatch_backlog()
-        self._backlog.append(packed)
-        self._backlog_rows += n
-        if self._backlog_rows >= self.BATCH_ROWS:
-            self._dispatch_backlog()
+        at = 0
+        while at < n:
+            room = self.BATCH_ROWS - self._backlog_rows
+            if room <= 0:
+                self._dispatch_backlog()
+                continue
+            take = min(n - at, room)
+            self._backlog.append(
+                packed if take == n else packed[at:at + take])
+            self._backlog_rows += take
+            at += take
+            if self._backlog_rows >= self.BATCH_ROWS:
+                self._dispatch_backlog()
 
     def apply_raw(self, raw: np.ndarray, n_visible: int) -> None:
         """Fused-fragment hot path: backlog one RAW chunk matrix

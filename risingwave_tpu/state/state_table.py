@@ -56,6 +56,15 @@ class StateTable:
                 "dist key must be part of the state-table pk"
         self.store = store
         self.mem_table = MemTable(sanity_check=sanity_check)
+        # staged all-insert chunk batches (write_chunk(defer=True) —
+        # the materialize/join emit hot path): encoded keys + physical
+        # rows held OUTSIDE the memtable until flush, skipping the
+        # per-row op-merge dict entirely. Invariant: staged batches
+        # exist only while the memtable is CLEAN — any interleaved
+        # read or non-insert write spills them into the memtable
+        # first, restoring the exact merge semantics.
+        self._staged_keys: List[List[bytes]] = []
+        self._staged_vals: List[List[tuple]] = []
         # ownership bitmap: which vnodes this instance owns (scaling swaps it)
         self.vnodes = (np.ones(VNODE_COUNT, dtype=bool)
                        if vnodes is None else np.asarray(vnodes, dtype=bool))
@@ -75,6 +84,19 @@ class StateTable:
         callers that need to route a flush elsewhere (worker shipping,
         tests) take the staged batch from here."""
         assert self.epoch is not None, "init_epoch first"
+        if self._staged_keys:
+            if self.mem_table.is_dirty():
+                # defensive: the staged-while-clean invariant should
+                # make this unreachable — merge order-exactly anyway
+                self._spill_staged()
+            else:
+                kbs, vbs = self._staged_keys, self._staged_vals
+                self._staged_keys, self._staged_vals = [], []
+                if len(kbs) == 1:
+                    return kbs[0], vbs[0], self.epoch.curr.value
+                return ([k for b in kbs for k in b],
+                        [v for b in vbs for v in b],
+                        self.epoch.curr.value)
         keys, vals = self.mem_table.drain_bulk()
         return keys, vals, self.epoch.curr.value
 
@@ -112,16 +134,37 @@ class StateTable:
     def pk_of(self, row: Sequence) -> tuple:
         return tuple(row[i] for i in self.pk_indices)
 
+    # -- staged-batch spill (write_chunk(defer=True) fast path) ----------
+    def is_dirty(self) -> bool:
+        return bool(self._staged_keys) or self.mem_table.is_dirty()
+
+    def _spill_staged(self) -> None:
+        """Replay staged all-insert batches into the memtable (in
+        arrival order) so interleaved reads/non-insert writes see the
+        exact per-key merge semantics the fast path skipped."""
+        if not self._staged_keys:
+            return
+        kbs, vbs = self._staged_keys, self._staged_vals
+        self._staged_keys, self._staged_vals = [], []
+        mt = self.mem_table
+        for keys, rows in zip(kbs, vbs):
+            if not mt.insert_batch(keys, rows):
+                for key, row in zip(keys, rows):
+                    mt.insert(key, row)
+
     # -- write API -------------------------------------------------------
     def insert(self, row: Sequence) -> None:
+        self._spill_staged()
         row = tuple(row)
         self.mem_table.insert(self._encode_pk(self.pk_of(row)), row)
 
     def delete(self, row: Sequence) -> None:
+        self._spill_staged()
         row = tuple(row)
         self.mem_table.delete(self._encode_pk(self.pk_of(row)), row)
 
     def update(self, old_row: Sequence, new_row: Sequence) -> None:
+        self._spill_staged()
         old_row, new_row = tuple(old_row), tuple(new_row)
         ok, nk = self._encode_pk(self.pk_of(old_row)), \
             self._encode_pk(self.pk_of(new_row))
@@ -153,6 +196,7 @@ class StateTable:
         """Batch insert: pk encoding + vnode hashing vectorized over all
         rows (one numpy pass per pk column instead of per-row hashing —
         the r3 profile spent half of q8 in per-row ``_encode_pk``)."""
+        self._spill_staged()
         mt = self.mem_table
         keys = self._encode_pk_rows(rows)
         rows_t = [tuple(r) for r in rows]
@@ -162,12 +206,14 @@ class StateTable:
             mt.insert(key, row)
 
     def delete_rows(self, rows: Sequence[Sequence]) -> None:
+        self._spill_staged()
         mt = self.mem_table
         for key, row in zip(self._encode_pk_rows(rows), rows):
             mt.delete(key, tuple(row))
 
     def update_rows(self, old_rows: Sequence[Sequence],
                     new_rows: Sequence[Sequence]) -> None:
+        self._spill_staged()
         mt = self.mem_table
         ok_keys = self._encode_pk_rows(old_rows)
         nk_keys = self._encode_pk_rows(new_rows)
@@ -206,18 +252,35 @@ class StateTable:
             vnodes = vnodes_of_host(lanes).astype(np.int64)
         return self._pack_keys(vnodes, pk_cols)
 
-    def write_chunk(self, chunk: StreamChunk) -> None:
+    def write_chunk(self, chunk: StreamChunk,
+                    defer: bool = False) -> None:
         """Apply a visible-row StreamChunk — the barrier-flush hot path.
 
         Fully vectorized up to the memtable: physical row extraction, vnode
         hashing and pk encoding are whole-column numpy passes; only the
         final dict ops are per-row.
+
+        ``defer=True`` (ISSUE 12): all-insert chunks against a clean
+        memtable STAGE as (keys, rows) batches and flow to the store as
+        one bulk ingest at flush — no per-row memtable dict ops at all.
+        Only callers that trust upstream key discipline (the NO_CHECK
+        materialize contract, the join's append-fast state writes) pass
+        it: the fast path skips the memtable's double-insert sanity
+        check, and duplicate pks within one epoch resolve last-wins at
+        the store instead of raising. Any interleaved read, delete, or
+        row-API write spills the stage first, so mixed epochs keep the
+        exact merge semantics.
         """
         idx, rows, ops = chunk.to_physical_records()
         if not rows:
             return
         keys = self._encode_pks_bulk(chunk, idx)
         is_ins = (ops == int(Op.INSERT)) | (ops == int(Op.UPDATE_INSERT))
+        if defer and not self.mem_table.is_dirty() and is_ins.all():
+            self._staged_keys.append(keys)
+            self._staged_vals.append(rows)
+            return
+        self._spill_staged()
         mt = self.mem_table
         if is_ins.all() and mt.insert_batch(keys, rows):
             return
@@ -328,6 +391,7 @@ class StateTable:
         return self.epoch.prev.value
 
     def get_row(self, pk_values: Sequence) -> Optional[tuple]:
+        self._spill_staged()
         key = self._encode_pk(tuple(pk_values))
         present, value = self.mem_table.get(key)
         if present:
@@ -370,6 +434,7 @@ class StateTable:
     def _iter_range_raw(self, start: Optional[bytes],
                         end: Optional[bytes], reverse: bool = False
                         ) -> Iterator[Tuple[bytes, tuple]]:
+        self._spill_staged()
         merged = {k: v for k, v in self.store.iter(
             self.table_id, self._read_epoch(), start, end)}
         for key, (op, _old, new) in self.mem_table.iter_ops():
@@ -403,7 +468,7 @@ class StateTable:
     # -- scaling ---------------------------------------------------------
     def update_vnode_bitmap(self, new_vnodes: np.ndarray) -> np.ndarray:
         """Swap partition ownership at a barrier (state_table.rs:650)."""
-        assert not self.mem_table.is_dirty(), \
+        assert not self.is_dirty(), \
             "vnode bitmap swap with dirty memtable"
         prev = self.vnodes
         self.vnodes = np.asarray(new_vnodes, dtype=bool)

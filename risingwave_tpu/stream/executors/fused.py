@@ -109,12 +109,24 @@ class FusedFragmentExecutor(Executor):
                     continue
                 cols: List[Column] = []
                 k = 0
+                units = 1 if fs.hop is None else fs.hop.units
                 for j, f in enumerate(out_schema):
                     host_src = fs.host_out.get(j)
                     if host_src is not None:
                         src = msg.columns[host_src]
-                        cols.append(Column(f.data_type, src.values,
-                                           src.validity))
+                        if units > 1:
+                            # absorbed hop: the trace expanded rows
+                            # units× — host passthrough columns tile
+                            # copy-major to stay positionally aligned
+                            cols.append(Column(
+                                f.data_type,
+                                np.tile(np.asarray(src.values), units),
+                                None if src.validity is None else
+                                np.tile(np.asarray(src.validity),
+                                        units)))
+                        else:
+                            cols.append(Column(f.data_type, src.values,
+                                               src.validity))
                         continue
                     okc = np.asarray(flat_ok[k])
                     cols.append(Column(

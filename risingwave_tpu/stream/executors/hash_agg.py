@@ -425,6 +425,10 @@ class HashAggExecutor(Executor):
                 kw["raw_width"] = raw_width(
                     len(self.fused_stages.ref_cols))
                 kw["metrics_label"] = self.identity
+                if self.fused_stages.hop is not None:
+                    # in-trace hop expansion: keep per-dispatch
+                    # POST-expansion rows near the normal batch size
+                    kw["expand_units"] = self.fused_stages.hop.units
             self._kernel = GroupedAggKernel(
                 key_width=_LANES_PER_KEY * len(self.group_indices),
                 specs=self.specs, **kw)

@@ -481,7 +481,10 @@ class _JoinSide:
             self.ensure_degrees(int(ins_refs.max()))
             full_refs[ins_idx] = ins_refs
             ins_mask[ins_idx] = True
-        self.table.write_chunk(chunk)
+        # append-only epochs stage past the memtable (ISSUE 12): join
+        # state pks are upstream row identities, distinct per epoch by
+        # the changelog contract; mixed-op chunks spill and merge
+        self.table.write_chunk(chunk, defer=True)
         return ins_idx, ins_refs, full_refs, ins_mask, del_refs, del_mask
 
     # dead-ref fraction of the arena that triggers a compaction; dead
@@ -1134,37 +1137,46 @@ class HashJoinExecutor(Executor):
                         full_refs, ins_mask, del_refs, del_mask, seq)
             self._pending.append(
                 (side_idx, chunk, nonnull, handle, ins_idx, ins_refs,
-                 0))
+                 0, chunk.capacity))
             return
         from risingwave_tpu.ops.hash_join import (
             FLAG_DEL, FLAG_INS, FLAG_NEG, FLAG_PROBE,
         )
         n = chunk.capacity
+        # dense-prefix slice (ISSUE 12): compacted chunks stamp their
+        # visible-row count — buffering only the dense prefix keeps
+        # chunk PADDING out of the epoch's concatenated row space (a
+        # 62%-full hop-expanded chunk was inflating every routed epoch
+        # shape by ~1.6×); rows past the prefix are invisible and
+        # contribute nothing but routed zeros
+        dn = chunk.dense_rows if chunk.dense_rows is not None else n
         ops = np.asarray(chunk.ops)
         neg = (ops != int(Op.INSERT)) & (ops != int(Op.UPDATE_INSERT))
-        aux = np.zeros((n, 4), dtype=np.int32)
-        aux[:, 0] = full_refs
-        aux[:, 1] = del_refs
-        aux[:, 2] = (probe_vis * FLAG_PROBE + ins_mask * FLAG_INS
-                     + del_mask * FLAG_DEL + neg * FLAG_NEG)
+        aux = np.zeros((dn, 4), dtype=np.int32)
+        aux[:, 0] = full_refs[:dn]
+        aux[:, 1] = del_refs[:dn]
+        aux[:, 2] = (probe_vis[:dn] * FLAG_PROBE
+                     + ins_mask[:dn] * FLAG_INS
+                     + del_mask[:dn] * FLAG_DEL + neg[:dn] * FLAG_NEG)
         aux[:, 3] = seq
         off = self._epoch_rows[side_idx]
         self._pending.append(
-            (side_idx, chunk, nonnull, None, ins_idx, ins_refs, off))
+            (side_idx, chunk, nonnull, None, ins_idx, ins_refs, off,
+             dn))
         if raw is not None:
             # fused input side: the RAW int64 matrix is the upload —
             # the side's prelude rebuilds [key | payload] lanes inside
             # the epoch dispatches
-            up = raw
+            up = raw[:dn]
         elif me.pay_indices:
             # [key lanes | payload lanes]: ONE upload matrix per side
             # per epoch carries both — the apply scatter writes the
             # payload rows where it links the chains
             up = np.concatenate(
-                [np.asarray(key_lanes), me.payload_rows(chunk)],
-                axis=1)
+                [np.asarray(key_lanes)[:dn],
+                 me.payload_rows(chunk)[:dn]], axis=1)
         else:
-            up = np.asarray(key_lanes)
+            up = np.asarray(key_lanes)[:dn]
         owners = None
         if me._mesh is not None:
             # per-row owner shards for the skew-exact routing bucket
@@ -1173,11 +1185,11 @@ class HashJoinExecutor(Executor):
             # carries them in-trace
             lanes_o = np.asarray(key_lanes) if key_lanes is not None \
                 else me.key_codec.build(chunk, me.key_indices)
-            owners = me.kernel.owners_of(lanes_o)
+            owners = me.kernel.owners_of(lanes_o[:dn])
         self._epoch_buf[side_idx].append(
             (up, aux, int(ins_refs.max()) if len(ins_refs) else -1,
              owners))
-        self._epoch_rows[side_idx] = off + n
+        self._epoch_rows[side_idx] = off + dn
 
     def _dispatch_epoch(self) -> Dict[int, tuple]:
         """Ship each side's buffered epoch as 2 uploads + 1 apply + 1
@@ -1385,12 +1397,14 @@ class HashJoinExecutor(Executor):
         outs: List[StreamChunk] = []
         results = self._dispatch_epoch() if self._epoch_batch \
             and (self._epoch_buf[0] or self._epoch_buf[1]) else {}
-        # per-epoch replay of stored-row degrees, keyed (side, ref):
-        # seeded lazily from the matrix old column, written through by
+        # per-epoch replay of stored-row degrees, per side: a value
+        # array + written mask indexed by ref (ISSUE 12 — the dict it
+        # replaces cost a python get/set per matched pair), seeded
+        # lazily from the matrix old column, written through by
         # inserted-row inits and per-chunk transition deltas
-        self._deg_replay: Dict[Tuple[int, int], int] = {}
+        self._deg_replay = [None, None]
         for (side_idx, chunk, nonnull, handle, ins_idx,
-             ins_refs, off) in self._pending:
+             ins_refs, off, dn) in self._pending:
             n = chunk.capacity
             deg = None
             probe_idx = np.zeros(0, dtype=np.int32)
@@ -1403,8 +1417,11 @@ class HashJoinExecutor(Executor):
                 deg[:len(deg_p)] = deg_p
             elif side_idx in results:
                 d_s, p_s, r_s, pay_s, old_s = results[side_idx]
+                # the buffered epoch carries only this chunk's dense
+                # prefix (dn rows at offset off); degrees re-pad to
+                # the chunk's capacity for the chunk-relative masks
                 lo = np.searchsorted(p_s, off)
-                hi = np.searchsorted(p_s, off + n)
+                hi = np.searchsorted(p_s, off + dn)
                 probe_idx = (p_s[lo:hi] - off).astype(np.int32)
                 refs = r_s[lo:hi]
                 if pay_s is not None:
@@ -1412,15 +1429,37 @@ class HashJoinExecutor(Executor):
                 if old_s is not None:
                     old = old_s[lo:hi].astype(np.int64)
                 if d_s is not None:
-                    deg = d_s[off:off + n].astype(np.int64)
+                    deg = np.zeros(n, dtype=np.int64)
+                    deg[:dn] = d_s[off:off + dn]
             outs.extend(self._emit_one(side_idx, chunk, nonnull, deg,
                                        probe_idx, refs, ins_idx,
                                        ins_refs, pay, old))
         self._pending.clear()
         self._epoch_buf = ([], [])
         self._epoch_rows = [0, 0]
-        self._deg_replay = {}
+        self._deg_replay = [None, None]
         return outs
+
+    def _deg_replay_arrays(self, side_idx: int, max_ref: int):
+        """(values, written) replay arrays for `side_idx`, grown to
+        cover `max_ref` — the vectorized stand-in for the old
+        (side, ref)→degree dict."""
+        pair = self._deg_replay[side_idx]
+        need = max_ref + 1
+        if pair is None:
+            cap = max(next_pow2(need), 1024)
+            pair = (np.zeros(cap, dtype=np.int64),
+                    np.zeros(cap, dtype=bool))
+            self._deg_replay[side_idx] = pair
+        elif len(pair[0]) < need:
+            cap = next_pow2(need)
+            vals = np.zeros(cap, dtype=np.int64)
+            wr = np.zeros(cap, dtype=bool)
+            vals[:len(pair[0])] = pair[0]
+            wr[:len(pair[1])] = pair[1]
+            pair = (vals, wr)
+            self._deg_replay[side_idx] = pair
+        return pair
 
     def _emit_one(self, side_idx: int, chunk: StreamChunk,
                   nonnull: np.ndarray, deg: Optional[np.ndarray],
@@ -1476,8 +1515,9 @@ class HashJoinExecutor(Executor):
             np.add.at(delta, inv, sgn)
             if other.dev_degrees:
                 # seed from the matrix's pre-epoch value on first
-                # touch; later chunks read the replay dict (exactly
-                # the running value the host array used to hold)
+                # touch; later chunks read the replay arrays (exactly
+                # the running value the host array used to hold) —
+                # whole-column gathers/scatters, no per-pair python
                 seed = np.zeros(len(uref), dtype=np.int64)
                 if old is not None and len(old):
                     first = np.zeros(len(uref), dtype=np.int64)
@@ -1485,15 +1525,12 @@ class HashJoinExecutor(Executor):
                     # carries the same old value
                     first[inv] = old
                     seed = first
-                rep = self._deg_replay
-                key = 1 - side_idx
-                cur = np.fromiter(
-                    (rep.get((key, int(r)), int(s))
-                     for r, s in zip(uref.tolist(), seed.tolist())),
-                    dtype=np.int64, count=len(uref))
+                vals, wr = self._deg_replay_arrays(
+                    1 - side_idx, int(uref.max()))
+                cur = np.where(wr[uref], vals[uref], seed)
                 new = cur + delta
-                for r, v in zip(uref.tolist(), new.tolist()):
-                    rep[(key, int(r))] = int(v)
+                vals[uref] = new
+                wr[uref] = True
                 old_v = cur
             else:
                 old_v = other.degrees[uref]
@@ -1522,10 +1559,10 @@ class HashJoinExecutor(Executor):
         # scatter-add — only the replay dict needs the values here)
         if side_idx in jt.tracked_sides and len(ins_idx):
             if me.dev_degrees:
-                rep = self._deg_replay
-                for r, v in zip(ins_refs.tolist(),
-                                deg[ins_idx].tolist()):
-                    rep[(side_idx, int(r))] = int(v)
+                vals, wr = self._deg_replay_arrays(
+                    side_idx, int(ins_refs.max()))
+                vals[ins_refs] = deg[ins_idx]
+                wr[ins_refs] = True
             else:
                 # degrees array already grown by apply_chunk at dispatch
                 me.degrees[ins_refs] = deg[ins_idx]

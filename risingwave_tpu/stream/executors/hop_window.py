@@ -64,10 +64,41 @@ class HopWindowExecutor(Executor):
             if c.validity is not None:
                 vis = vis & np.asarray(c.validity)
             base = (ts.astype(np.int64) // self.slide) * self.slide
-            for i in range(self.units):
-                start = base - i * self.slide
-                cols = list(msg.columns)
-                cols.append(Column(DataType.TIMESTAMP, start, None))
-                cols.append(Column(DataType.TIMESTAMP, start + self.size,
-                                   None))
-                yield StreamChunk(self.schema, cols, vis, msg.ops)
+            # Batched expansion (ISSUE 12): pow2 GROUPS of copy-major
+            # replicas — ⌈log2⌉ chunks per input chunk instead of
+            # `units` (5 windows → one 4×-copy chunk + one 1×-copy
+            # chunk), so the downstream spine (exchange frames,
+            # coalescer, monitor, join ingest) pays ~2 chunks of
+            # overhead instead of 5 while every emitted capacity stays
+            # a power of two — kernel backlogs (BATCH_ROWS slabs) keep
+            # packing tight, which a single `units`×-cap chunk broke.
+            # Copy-major tiling keeps U-/U+ pairs adjacent inside every
+            # copy, group boundaries land exactly on copy boundaries,
+            # and a well-formed chunk never ends with a dangling U-,
+            # so pair scans never marry rows across copies.
+            host_cols = [(np.asarray(c.values),
+                          None if c.validity is None
+                          else np.asarray(c.validity))
+                         for c in msg.columns]
+            ops = np.asarray(msg.ops)
+            i = 0
+            units = self.units
+            while i < units:
+                g = 1 << ((units - i).bit_length() - 1)
+                starts = base - i * self.slide if g == 1 else \
+                    np.concatenate([base - (i + j) * self.slide
+                                    for j in range(g)])
+                cols = [Column(c.data_type,
+                               vals if g == 1 else np.tile(vals, g),
+                               ok if ok is None or g == 1
+                               else np.tile(ok, g))
+                        for c, (vals, ok) in zip(msg.columns,
+                                                 host_cols)]
+                cols.append(Column(DataType.TIMESTAMP, starts, None))
+                cols.append(Column(DataType.TIMESTAMP,
+                                   starts + self.size, None))
+                yield StreamChunk(
+                    self.schema, cols,
+                    vis if g == 1 else np.tile(vis, g),
+                    ops if g == 1 else np.tile(ops, g))
+                i += g

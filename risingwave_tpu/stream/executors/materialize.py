@@ -58,7 +58,10 @@ class MaterializeExecutor(Executor):
 
     def _apply(self, chunk: StreamChunk) -> None:
         if self.conflict == ConflictBehavior.NO_CHECK:
-            self.table.write_chunk(chunk)
+            # NO_CHECK trusts upstream ops by contract — all-insert
+            # epochs stage past the memtable and land in the store as
+            # one bulk ingest at the barrier (ISSUE 12 emit path)
+            self.table.write_chunk(chunk, defer=True)
             return
         _idx, rows, ops = chunk.to_physical_records()
         for op, row in zip(ops.tolist(), rows):

@@ -115,8 +115,12 @@ class MonitoredExecutor(Executor):
                 _ledger.LEDGER.attribute(self._fallback_phase, resid,
                                          epoch)
             if idle_delta > 0:
-                _ledger.LEDGER.attribute("barrier_wait", idle_delta,
-                                         epoch)
+                # keyed per source: parallel sources park CONCURRENTLY
+                # and the ledger folds the across-source max, not the
+                # sum, into barrier_wait at seal (share > 1.0 was the
+                # BENCH_r10 ad-ctr attribution bug)
+                _ledger.LEDGER.attribute_idle(idle_delta, epoch,
+                                              source=self._who)
         else:
             # drain even while off: seconds recorded before a mid-
             # epoch SET stream_ledger=off must not leak into whatever

@@ -208,6 +208,12 @@ def stages_from_ir(in_schema: Schema, stages_ir: List[dict],
                 time_col=int(st["time_col"]),
                 delay_usecs=int(st["delay_usecs"]),
                 runtime=WatermarkRuntime(wm_state)))
+        elif st["kind"] == "hop_window":
+            stages.append(FusedStage(
+                "hop_window", "HopWindowExecutor",
+                time_col=int(st["time_col"]),
+                slide_usecs=int(st["slide_usecs"]),
+                size_usecs=int(st["size_usecs"])))
         else:
             raise TypeError(f"unknown fused stage IR {st['kind']!r}")
     return FusedStages(in_schema, stages)

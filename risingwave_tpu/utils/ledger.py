@@ -162,24 +162,38 @@ class AttributionCell:
 class _EpochAcc:
     """Open accumulator for one epoch (pre-seal)."""
 
-    __slots__ = ("seconds", "h2d_bytes", "d2h_bytes", "warmup")
+    __slots__ = ("seconds", "h2d_bytes", "d2h_bytes", "warmup", "idle")
 
     def __init__(self) -> None:
         self.seconds: Dict[str, float] = {}
         self.h2d_bytes = 0
         self.d2h_bytes = 0
         self.warmup = False     # saw a kernel (re)compile this epoch
+        # per-SOURCE idle seconds (barrier_wait) — kept keyed so the
+        # seal can take the across-source MAX instead of the sum:
+        # parallel sources park CONCURRENTLY, and summing their idle
+        # against one wall-clock interval double-counts it (the
+        # BENCH_r10 ad-ctr share-1.05 bug)
+        self.idle: Dict[str, float] = {}
 
     def add(self, phase: str, s: float) -> None:
         if s > 0:
             self.seconds[phase] = self.seconds.get(phase, 0.0) + s
+
+    def add_idle(self, key: str, s: float) -> None:
+        if s > 0:
+            self.idle[key] = self.idle.get(key, 0.0) + s
+
+    def idle_max(self) -> float:
+        return max(self.idle.values()) if self.idle else 0.0
 
 
 class LedgerRecord:
     """One sealed epoch's phase breakdown."""
 
     __slots__ = ("epoch", "kind", "interval_s", "seconds", "h2d_bytes",
-                 "d2h_bytes", "warmup", "distributed", "workers")
+                 "d2h_bytes", "warmup", "distributed", "workers",
+                 "idle_max")
 
     def __init__(self, epoch: int, kind: str, interval_s: float,
                  seconds: Dict[str, float], h2d_bytes: int,
@@ -195,6 +209,10 @@ class LedgerRecord:
         # conservation is not checkable until drain_ledger folds them in
         self.distributed = distributed
         self.workers: List[str] = []    # merged-in worker tags
+        # largest single-source idle folded into barrier_wait so far
+        # (worker merges take max-then-cap, never sum — see
+        # attribute_idle)
+        self.idle_max = 0.0
 
     @property
     def attributed_s(self) -> float:
@@ -311,6 +329,19 @@ class PhaseLedger:
         with self._lock:
             self._acc(epoch).add(name, seconds)
 
+    def attribute_idle(self, seconds: float,
+                       epoch: Optional[int] = None,
+                       source: str = "") -> None:
+        """Source park time (barrier_wait), keyed per source. Parallel
+        sources idle CONCURRENTLY — the seal folds the across-source
+        MAX (the union approximation) into ``barrier_wait`` instead of
+        the sum, so N idle sources can never claim N× the epoch
+        (share > 1.0 is definitionally noise)."""
+        if not _ENABLED or seconds <= 0:
+            return
+        with self._lock:
+            self._acc(epoch).add_idle(source, seconds)
+
     def add_bytes(self, direction: str, nbytes: int,
                   kernel: Optional[str] = None) -> None:
         """One host↔device transfer's payload: live Prometheus counter
@@ -383,10 +414,20 @@ class PhaseLedger:
             return None
         with self._lock:
             acc = self._open.pop(epoch, None) or _EpochAcc()
+        seconds = dict(acc.seconds)
+        idle = acc.idle_max()
+        if idle > 0:
+            # across-source MAX (concurrent parks overlap), capped at
+            # the interval — idle can never exceed the epoch it's in
+            if interval_s > 0:
+                idle = min(idle, float(interval_s))
+            seconds["barrier_wait"] = seconds.get("barrier_wait",
+                                                  0.0) + idle
         rec = LedgerRecord(epoch, kind, float(interval_s),
-                           dict(acc.seconds), acc.h2d_bytes,
+                           seconds, acc.h2d_bytes,
                            acc.d2h_bytes, acc.warmup or warmup,
                            distributed)
+        rec.idle_max = idle
         rec.recompute_unattributed()
         self.records.append(rec)
         self._publish(rec)
@@ -461,7 +502,7 @@ class PhaseLedger:
         with self._lock:
             out = [{"epoch": e, "seconds": dict(a.seconds),
                     "h2d_bytes": a.h2d_bytes, "d2h_bytes": a.d2h_bytes,
-                    "warmup": a.warmup}
+                    "warmup": a.warmup, "idle_max": a.idle_max()}
                    for e, a in self._open.items()]
             self._open.clear()
         return out
@@ -495,6 +536,19 @@ class PhaseLedger:
                         + float(s)
                     STREAMING.epoch_phase_seconds.inc(
                         float(s), phase=name, query=self.query)
+                w_idle = float(d.get("idle_max", 0.0))
+                if w_idle > 0:
+                    # barrier_wait merges as MAX-then-cap across
+                    # processes (their sources park over the same wall
+                    # interval), never as a sum
+                    cap = rec.interval_s if rec.interval_s > 0 \
+                        else float("inf")
+                    new_max = max(rec.idle_max, w_idle)
+                    delta = min(new_max, cap) - min(rec.idle_max, cap)
+                    rec.idle_max = new_max
+                    if delta > 0:
+                        rec.seconds["barrier_wait"] = \
+                            rec.seconds.get("barrier_wait", 0.0) + delta
                 rec.h2d_bytes += int(d.get("h2d_bytes", 0))
                 rec.d2h_bytes += int(d.get("d2h_bytes", 0))
                 rec.warmup = rec.warmup or bool(d.get("warmup"))
@@ -508,6 +562,9 @@ class PhaseLedger:
                     acc = self._acc(e)
                     for name, s in (d.get("seconds") or {}).items():
                         acc.add(name, float(s))
+                    w_idle = float(d.get("idle_max", 0.0))
+                    if w_idle > 0:
+                        acc.add_idle(worker or "remote", w_idle)
                     acc.h2d_bytes += int(d.get("h2d_bytes", 0))
                     acc.d2h_bytes += int(d.get("d2h_bytes", 0))
                     acc.warmup = acc.warmup or bool(d.get("warmup"))

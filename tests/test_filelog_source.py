@@ -143,3 +143,24 @@ def test_sql_filelog_ingestion_and_exactly_once_recovery(tmp_path):
         c, s = want.get(name, (0, 0))
         want[name] = (c + 1, s + i)
     assert got == want, (got, want)   # no loss, no duplication
+
+
+def test_block_read_carries_partial_line_across_blocks(tmp_path):
+    """Review regression: a record straddling the read-block boundary
+    must carry over intact — dropping the partial tail corrupted the
+    record AND re-read the suffix (duplicate rows)."""
+    import io
+
+    from risingwave_tpu.connectors import filelog as fl
+
+    blob = b"aaa\nbbbbbb\nccc\n"
+    old = fl._READ_BLOCK
+    try:
+        fl._READ_BLOCK = 8            # boundary lands inside 'bbbbbb'
+        payloads = []
+        consumed = fl._read_complete_records(io.BytesIO(blob),
+                                             payloads, 100)
+    finally:
+        fl._READ_BLOCK = old
+    assert payloads == [b"aaa", b"bbbbbb", b"ccc"]
+    assert consumed == len(blob)

@@ -1111,3 +1111,189 @@ def test_watermark_sentinel_narrow_int_time_col():
         np.asarray(aug.ops), np)
     assert (vis2 == np.asarray(aug.visibility)).all(), \
         "negative timestamps dropped with no watermark"
+
+
+# -- hop-window absorption (ISSUE 12 tentpole c) ---------------------------
+
+
+HOP_MV = ("CREATE MATERIALIZED VIEW q AS SELECT window_start, "
+          "COUNT(*) AS c, MAX(price) AS m "
+          "FROM HOP(bid, date_time, INTERVAL '2' SECOND, "
+          "INTERVAL '10' SECOND) GROUP BY window_start")
+
+
+def test_hop_absorbed_vs_sequential_sql_oracle():
+    """The agg's traced prelude replicates rows units× in-trace; the
+    sequential HopWindowExecutor survives as the off arm — results
+    must be bit-identical, and the fused plan must actually absorb
+    the hop (EXPLAIN annotation)."""
+    rows_off = _front_door_rows(NEXMARK_SOURCES, HOP_MV, False)
+    rows_on = _front_door_rows(NEXMARK_SOURCES, HOP_MV, True)
+    assert rows_on == rows_off and len(rows_on) > 1
+
+    async def explain():
+        fe = Frontend(rate_limit=4)
+        for s in NEXMARK_SOURCES:
+            await fe.execute(s)
+        rows = await fe.execute(
+            "EXPLAIN SELECT window_start, COUNT(*) AS c "
+            "FROM HOP(bid, date_time, INTERVAL '2' SECOND, "
+            "INTERVAL '10' SECOND) GROUP BY window_start")
+        await fe.close()
+        return "\n".join(r[0] for r in rows)
+    text = run(explain())
+    assert "absorbed HopWindowExecutor" in text, text
+
+
+def test_hop_chain_body_matches_sequential_executor():
+    """Unit oracle: the composed hop+filter chain on numpy equals the
+    sequential HopWindowExecutor + FilterExecutor over random chunks —
+    NULL timestamps dropped, update pairs preserved per copy."""
+    from risingwave_tpu.common.types import Interval as Iv
+    from risingwave_tpu.stream.executors.hop_window import (
+        HopWindowExecutor,
+    )
+    from risingwave_tpu.stream.executors.simple import FilterExecutor
+
+    S = Schema.of(ts=DataType.TIMESTAMP, v=DataType.INT64)
+    rng = np.random.default_rng(11)
+    cap = 32
+    ts = rng.integers(0, 40_000_000, size=cap).astype(np.int64)
+    v = rng.integers(-10, 10, size=cap).astype(np.int64)
+    ok = rng.random(cap) > 0.2           # NULL timestamps
+    vis = rng.random(cap) > 0.1
+    ops = np.full(cap, int(Op.INSERT), dtype=np.int8)
+    ops[6] = int(Op.UPDATE_DELETE)
+    ops[7] = int(Op.UPDATE_INSERT)
+    chunk = StreamChunk(
+        S, [Column(DataType.TIMESTAMP, ts, ok.copy()),
+            Column(DataType.INT64, v, None)], vis, ops)
+
+    hop_st = FusedStage("hop_window", "HopWindowExecutor",
+                        time_col=0, slide_usecs=10_000_000,
+                        size_usecs=30_000_000)
+    pred = InputRef(1, DataType.INT64) >= lit(0)
+    fs = FusedStages(S, [hop_st,
+                         FusedStage("filter", "FilterExecutor",
+                                    exprs=(pred,))])
+    assert fs.fusable_reason() is None
+    out_cols, vis2, ops2, _sr = fs.chain_body(
+        list(chunk.columns), np.asarray(chunk.visibility),
+        np.asarray(chunk.ops), np)
+    got = StreamChunk(fs.out_schema,
+                      [c for c in out_cols if c is not None],
+                      np.asarray(vis2), np.asarray(ops2))
+
+    class _Src:
+        schema = S
+        async def execute(self):
+            from risingwave_tpu.common.epoch import Epoch, EpochPair
+            from risingwave_tpu.stream.message import Barrier
+            yield Barrier(EpochPair.new_initial(Epoch.from_physical(1)))
+            yield chunk
+            yield Barrier(EpochPair(
+                Epoch.from_physical(2), Epoch.from_physical(1)))
+        @property
+        def pk_indices(self):
+            return []
+        identity = "mock"
+
+    async def seq_records():
+        hop = HopWindowExecutor(_Src(), 0, Iv(usecs=10_000_000),
+                                Iv(usecs=30_000_000))
+        filt = FilterExecutor(hop, pred)
+        out = []
+        async for m in filt.execute():
+            from risingwave_tpu.stream.message import is_chunk
+            if is_chunk(m):
+                out.extend(m.to_records())
+        return out
+
+    want = run(seq_records())
+    assert got.to_records() == want
+
+
+def test_hop_watermark_rederivation_through_absorbed_stage():
+    """A watermark on the time column re-derives to the window_start
+    column (floor to slide, minus (units-1)*slide); all other
+    watermarks are consumed — HopWindowExecutor's exact rule."""
+    from risingwave_tpu.stream.message import Watermark
+    S = Schema.of(ts=DataType.TIMESTAMP, v=DataType.INT64)
+    fs = FusedStages(S, [FusedStage(
+        "hop_window", "HopWindowExecutor", time_col=0,
+        slide_usecs=10_000_000, size_usecs=30_000_000)])
+    out = fs.derive_watermarks(
+        Watermark(0, DataType.TIMESTAMP, 25_000_000))
+    assert [(w.col_idx, w.value) for w in out] == [(2, 0)]
+    assert fs.derive_watermarks(
+        Watermark(1, DataType.INT64, 5)) == []
+
+
+def test_hop_refuses_bad_shapes():
+    S = Schema.of(ts=DataType.TIMESTAMP, v=DataType.INT64)
+    hop = FusedStage("hop_window", "HopWindowExecutor", time_col=0,
+                     slide_usecs=10, size_usecs=30)
+    # non-head hop never composes
+    with pytest.raises(ValueError):
+        FusedStages(S, [FusedStage(
+            "filter", "FilterExecutor",
+            exprs=(InputRef(1, DataType.INT64) >= lit(0),)), hop])
+    # float time column refuses
+    SF = Schema.of(ts=DataType.FLOAT64, v=DataType.INT64)
+    fsf = FusedStages(SF, [FusedStage(
+        "hop_window", "HopWindowExecutor", time_col=0,
+        slide_usecs=10, size_usecs=30)])
+    assert "non-integer" in fsf.fusable_reason()
+    # hop group keys (window_start) never map to raw input columns —
+    # the parallel cut must refuse to dispatch on them
+    fs = FusedStages(S, [hop])
+    assert fs.input_positions([2]) is None
+    assert fs.input_positions([1]) == [1]
+
+
+def test_hop_executor_emits_pow2_copy_groups():
+    """The rewritten HopWindowExecutor emits pow2 COPY-GROUP chunks
+    (popcount(units) of them — e.g. 3 windows → a 2×-copy chunk + a
+    1×-copy chunk), not `units` chunks, and every capacity stays a
+    power of two so kernel backlogs pack tight."""
+    from risingwave_tpu.common.types import Interval as Iv
+    from risingwave_tpu.stream.executors.hop_window import (
+        HopWindowExecutor,
+    )
+    from risingwave_tpu.stream.message import is_chunk
+    S = Schema.of(ts=DataType.TIMESTAMP, v=DataType.INT64)
+    chunk = StreamChunk.from_pydict(
+        S, {"ts": [25_000_000, None], "v": [7, 8]})
+
+    class _Src:
+        schema = S
+        async def execute(self):
+            from risingwave_tpu.common.epoch import Epoch, EpochPair
+            from risingwave_tpu.stream.message import Barrier
+            yield Barrier(EpochPair.new_initial(Epoch.from_physical(1)))
+            yield chunk
+            yield Barrier(EpochPair(
+                Epoch.from_physical(2), Epoch.from_physical(1)))
+        @property
+        def pk_indices(self):
+            return []
+        identity = "mock"
+
+    async def main():
+        hop = HopWindowExecutor(_Src(), 0, Iv(usecs=10_000_000),
+                                Iv(usecs=30_000_000))
+        chunks = []
+        async for m in hop.execute():
+            if is_chunk(m):
+                chunks.append(m)
+        return chunks
+
+    chunks = run(main())
+    assert len(chunks) == bin(3).count("1")     # 3 = 2 + 1 copies
+    for c in chunks:
+        cap = c.capacity
+        assert cap & (cap - 1) == 0, "capacity must stay pow2"
+    recs = [r for c in chunks for _op, r in c.to_records()]
+    # NULL ts dropped; 3 windows for the valid row
+    assert sorted(r[2] for r in recs) == [0, 10_000_000, 20_000_000]
+    assert all(r[1] == 7 for r in recs)

@@ -1,0 +1,196 @@
+"""Columnar batch parser vs row-at-a-time oracle (ISSUE 12 tentpole a).
+
+The batch path (one combined decode + vectorized per-column coercion)
+must be bit-identical to the row path (the ``batch=False`` off arm)
+under every shape the wire can carry: NULLs, ``__op`` envelopes,
+malformed records interleaved (skip-and-count isolation), BOM and
+non-UTF-8 payloads, and every physical type — plus the DECIMAL
+single-scale regression (the old row path double-scaled parsed
+decimals through from_pydict's logical-ingest contract).
+"""
+
+import decimal
+import json
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.common.types import DataType, Schema
+from risingwave_tpu.connectors.parser import (
+    CsvRowParser, JsonRowParser, make_parser,
+)
+
+ALL_TYPES = Schema.of(
+    i16=DataType.INT16, i32=DataType.INT32, i64=DataType.INT64,
+    f32=DataType.FLOAT32, f64=DataType.FLOAT64, b=DataType.BOOLEAN,
+    d=DataType.DECIMAL, ts=DataType.TIMESTAMP, dt=DataType.DATE,
+    s=DataType.VARCHAR, by=DataType.BYTEA)
+
+
+def _chunk_records(chunk):
+    return None if chunk is None else chunk.to_records()
+
+
+def _oracle(schema, payloads):
+    """Both arms parse the same payloads; chunks must agree exactly."""
+    on = JsonRowParser(schema, batch=True)
+    off = JsonRowParser(schema, batch=False)
+    c_on = on.build_chunk(list(payloads))
+    c_off = off.build_chunk(list(payloads))
+    assert _chunk_records(c_on) == _chunk_records(c_off)
+    assert on.errors == off.errors
+    return c_on, on
+
+
+def _rec(rng, i, malform=False):
+    if malform:
+        return rng.choice([b"not json", b"{broken", b"[1,2]", b"17",
+                           b'"str"'])
+    obj = {}
+    if rng.random() > 0.2:
+        obj["i16"] = int(rng.integers(-30000, 30000))
+    if rng.random() > 0.2:
+        obj["i32"] = int(rng.integers(-2**31, 2**31 - 1))
+    if rng.random() > 0.2:
+        obj["i64"] = int(rng.integers(-2**53, 2**53))
+    if rng.random() > 0.2:
+        obj["f32"] = float(rng.normal())
+    if rng.random() > 0.2:
+        obj["f64"] = rng.choice([float(rng.normal()), -0.0, 1e308])
+    if rng.random() > 0.2:
+        obj["b"] = bool(rng.random() > 0.5)
+    if rng.random() > 0.2:
+        obj["d"] = rng.choice(["1.5", "-2", "0.0001", "99.99"])
+    if rng.random() > 0.2:
+        obj["ts"] = rng.choice([
+            1_700_000_000,                       # seconds heuristic
+            1_700_000_000_000_000,               # already µs
+            "2026-01-02T03:04:05",               # ISO
+            "2026-01-02T03:04:05Z",              # ISO + Z
+            int(rng.integers(0, 4_000_000_000)),
+        ])
+    if rng.random() > 0.2:
+        obj["dt"] = rng.choice([12345, "2026-01-02"])
+    if rng.random() > 0.2:
+        obj["s"] = rng.choice(["plain", "", "unié", "7"])
+    if rng.random() > 0.2:
+        obj["by"] = rng.choice([{"__b": "deadbeef"}, "text-bytes"])
+    if rng.random() > 0.7:
+        obj["__op"] = rng.choice(["I", "D"])
+    if rng.random() > 0.8:
+        obj["unknown_key"] = i
+    return json.dumps(obj).encode()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_fuzzed_batch_vs_row_oracle_all_types(seed):
+    rng = np.random.default_rng(seed)
+    payloads = [_rec(rng, i, malform=rng.random() < 0.07)
+                for i in range(300)]
+    chunk, parser = _oracle(ALL_TYPES, payloads)
+    assert chunk is not None and chunk.cardinality() > 0
+    assert parser.errors > 0          # fuzz interleaves malformed recs
+
+
+def test_bom_and_non_utf8_payloads():
+    schema = Schema.of(a=DataType.INT64, s=DataType.VARCHAR)
+    payloads = [
+        b'{"a": 1, "s": "x"}',
+        "﻿".encode("utf-8") + b'{"a": 2, "s": "bom"}',
+        '{"a": 3, "s": "wide"}'.encode("utf-16"),
+        b'\xff\xfe garbage that is not any json',
+        b'{"a": 4, "s": "tail"}',
+    ]
+    c_on, parser = _oracle(schema, payloads)
+    recs = [r for _op, r in c_on.to_records()]
+    assert (1, "x") in recs and (2, "bom") in recs
+    assert (3, "wide") in recs and (4, "tail") in recs
+    assert parser.errors == 1
+
+
+def test_op_envelope_maps_to_deletes_in_both_arms():
+    schema = Schema.of(k=DataType.INT64)
+    payloads = [b'{"k": 1}', b'{"k": 2, "__op": "D"}',
+                b'{"k": 3, "__op": "I"}']
+    c_on, _p = _oracle(schema, payloads)
+    ops = [op for op, _r in c_on.to_records()]
+    from risingwave_tpu.common.chunk import Op
+    assert ops == [Op.INSERT, Op.DELETE, Op.INSERT]
+
+
+def test_coercion_failure_isolates_single_record():
+    """A record whose FIELD refuses to coerce (not malformed JSON)
+    drops exactly that record in both arms."""
+    schema = Schema.of(a=DataType.INT64, s=DataType.VARCHAR)
+    payloads = [b'{"a": 1, "s": "x"}',
+                b'{"a": "3.5", "s": "bad-int"}',   # int("3.5") raises
+                b'{"a": "7", "s": "str-int-ok"}',
+                b'{"a": 2, "s": "y"}']
+    c_on, parser = _oracle(schema, payloads)
+    recs = [r for _op, r in c_on.to_records()]
+    assert recs == [(1, "x"), (7, "str-int-ok"), (2, "y")]
+    assert parser.errors == 1
+
+
+def test_decimal_parses_single_scaled():
+    """Regression: parsed DECIMALs reached the chunk DOUBLE-scaled
+    (physical scaled ints fed into from_pydict's logical ingest)."""
+    schema = Schema.of(d=DataType.DECIMAL)
+    for batch in (True, False):
+        p = JsonRowParser(schema, batch=batch)
+        c = p.build_chunk([b'{"d": 1.5}', b'{"d": "-2"}'])
+        assert c.to_pylist() == [(decimal.Decimal("1.5"),),
+                                 (decimal.Decimal("-2"),)]
+
+
+def test_all_malformed_batch_returns_none():
+    schema = Schema.of(a=DataType.INT64)
+    for batch in (True, False):
+        p = JsonRowParser(schema, batch=batch)
+        assert p.build_chunk([b"nope", b"{broken"]) is None
+        assert p.errors == 2
+
+
+def test_csv_batch_vs_row_oracle():
+    schema = Schema.of(a=DataType.INT64, f=DataType.FLOAT64,
+                       s=DataType.VARCHAR)
+    payloads = [b"1,1.5,x", b"2,,", b"junk", b"3,2.5,y,extra",
+                b"bad-int,1.0,z"]
+    on = CsvRowParser(schema, batch=True)
+    off = CsvRowParser(schema, batch=False)
+    c_on = on.build_chunk(list(payloads))
+    c_off = off.build_chunk(list(payloads))
+    assert _chunk_records(c_on) == _chunk_records(c_off)
+    assert on.errors == off.errors == 2
+    recs = [r for _op, r in c_on.to_records()]
+    assert recs == [(1, 1.5, "x"), (2, None, None), (3, 2.5, "y")]
+
+
+def test_csv_prebound_coercers_row_path():
+    """Satellite: CsvRowParser's row path uses prebound per-column
+    coercers (no per-field type dispatch) with unchanged semantics."""
+    p = CsvRowParser(Schema.of(a=DataType.INT64, t=DataType.TIMESTAMP,
+                               s=DataType.VARCHAR))
+    assert p.parse_one(b"5,2026-01-02T00:00:00,hello") == \
+        (5, 1767312000000000, "hello")
+    # the prebound list exists and has one entry per column
+    assert len(p._fields) == 3
+
+
+def test_make_parser_batch_option():
+    s = Schema.of(a=DataType.INT64)
+    assert make_parser("json", s).batch is True
+    assert make_parser("json", s,
+                       {"parse.batch": "false"}).batch is False
+    assert make_parser("csv", s, {"parse.batch": "off"}).batch is False
+
+
+def test_comma_concatenated_payload_is_isolated_not_exploded():
+    """Review regression: '{..},{..}' parses as TWO values inside the
+    synthesized array — it must count as ONE malformed record (row-path
+    parity), never mint phantom rows."""
+    schema = Schema.of(a=DataType.INT64)
+    payloads = [b'{"a": 1}', b'{"a": 2},{"a": 3}', b'{"a": 4}']
+    c_on, parser = _oracle(schema, payloads)
+    assert [r for _op, r in c_on.to_records()] == [(1,), (4,)]
+    assert parser.errors == 1

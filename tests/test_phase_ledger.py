@@ -378,3 +378,46 @@ def test_cluster_two_worker_ledger_merge(tmp_path):
     # — checked implicitly: records/workers are stable because the
     # drain above popped everything; the conftest gate then audits the
     # merged records' conservation like any other test's.
+
+
+# -- parallel-source idle dedup (ISSUE 12 satellite) -----------------------
+
+
+def test_parallel_idle_sources_cannot_exceed_share_one():
+    """Regression for the BENCH_r10 ad-ctr phase_breakdown: four
+    sources each parked ~the whole epoch summed to barrier_wait share
+    1.05. Idle is keyed per source and the seal folds the MAX (the
+    parks are concurrent), capped at the interval — the share can
+    never exceed 1.0."""
+    led = PhaseLedger()
+    epoch = 0x1000
+    for actor in range(4):
+        led.attribute_idle(0.95, epoch, source=f"actor-{actor}/src")
+    rec = led.seal(epoch, 1.0)
+    assert rec.seconds["barrier_wait"] == pytest.approx(0.95)
+    share = rec.seconds["barrier_wait"] / rec.interval_s
+    assert share <= 1.0
+    # and a single source longer than the interval still caps
+    led2 = PhaseLedger()
+    led2.attribute_idle(3.0, epoch, source="a")
+    rec2 = led2.seal(epoch, 1.0)
+    assert rec2.seconds["barrier_wait"] == pytest.approx(1.0)
+
+
+def test_worker_idle_merges_as_max_not_sum():
+    """Cross-process merge: each worker ships its own idle_max; the
+    sealed record folds max-then-cap, never the sum."""
+    led = PhaseLedger()
+    epoch = 0x2000
+    led.attribute_idle(0.4, epoch, source="coord-src")
+    rec = led.seal(epoch, 1.0, distributed=True)
+    assert rec.seconds["barrier_wait"] == pytest.approx(0.4)
+    led.ingest([{"epoch": epoch, "seconds": {}, "idle_max": 0.9}],
+               worker="w0")
+    led.ingest([{"epoch": epoch, "seconds": {}, "idle_max": 0.7}],
+               worker="w1")
+    assert rec.seconds["barrier_wait"] == pytest.approx(0.9)
+    # a worker idling past the interval caps at the interval
+    led.ingest([{"epoch": epoch, "seconds": {}, "idle_max": 5.0}],
+               worker="w2")
+    assert rec.seconds["barrier_wait"] == pytest.approx(1.0)

@@ -339,3 +339,112 @@ def test_memory_context_accounting_and_eviction():
     total = m.tick()
     assert state["big"] == 40          # evictor ran
     assert total <= 100
+
+
+# -- staged all-insert writes (ISSUE 12 emit path) ---------------------------
+
+
+def _epoch(n):
+    return EpochPair(Epoch.from_physical(n + 1), Epoch.from_physical(n))
+
+
+def test_deferred_write_chunk_skips_memtable_and_commits():
+    schema = Schema.of(k=DataType.INT64, v=DataType.INT64)
+    store = MemoryStateStore()
+    t = StateTable(61, schema, pk_indices=[0], store=store)
+    t.init_epoch(EpochPair.new_initial(Epoch.from_physical(1)))
+    chunk = StreamChunk.from_pydict(schema, {"k": [1, 2], "v": [10, 20]})
+    t.write_chunk(chunk, defer=True)
+    # the fast path bypasses the memtable entirely…
+    assert not t.mem_table.is_dirty() and t.is_dirty()
+    t.commit(_epoch(1))
+    # …and the rows are durable at commit
+    assert t.get_row((1,)) == (1, 10) and t.get_row((2,)) == (2, 20)
+    assert not t.is_dirty()
+
+
+def test_deferred_stage_spills_on_interleaved_delete():
+    """An insert staged this epoch then deleted this epoch must
+    annihilate exactly as the memtable path would."""
+    schema = Schema.of(k=DataType.INT64, v=DataType.INT64)
+    store = MemoryStateStore()
+    t = StateTable(62, schema, pk_indices=[0], store=store)
+    t.init_epoch(EpochPair.new_initial(Epoch.from_physical(1)))
+    t.write_chunk(StreamChunk.from_pydict(
+        schema, {"k": [1, 2], "v": [10, 20]}), defer=True)
+    t.delete((1, 10))              # spills the stage, then annihilates
+    t.commit(_epoch(1))
+    assert t.get_row((1,)) is None
+    assert t.get_row((2,)) == (2, 20)
+
+
+def test_deferred_stage_read_your_writes_mid_epoch():
+    schema = Schema.of(k=DataType.INT64, v=DataType.INT64)
+    store = MemoryStateStore()
+    t = StateTable(63, schema, pk_indices=[0], store=store)
+    t.init_epoch(EpochPair.new_initial(Epoch.from_physical(1)))
+    t.write_chunk(StreamChunk.from_pydict(
+        schema, {"k": [5], "v": [50]}), defer=True)
+    # a read mid-epoch spills the stage and sees the buffered row
+    assert t.get_row((5,)) == (5, 50)
+    t.commit(_epoch(1))
+    assert t.get_row((5,)) == (5, 50)
+
+
+def test_deferred_mixed_op_chunk_falls_back():
+    """A chunk carrying deletes never stages — it takes the memtable
+    merge path even under defer=True."""
+    schema = Schema.of(k=DataType.INT64, v=DataType.INT64)
+    store = MemoryStateStore()
+    t = StateTable(64, schema, pk_indices=[0], store=store)
+    t.init_epoch(EpochPair.new_initial(Epoch.from_physical(1)))
+    t.write_chunk(StreamChunk.from_pydict(
+        schema, {"k": [1], "v": [10]}), defer=True)
+    t.commit(_epoch(1))
+    mixed = StreamChunk.from_pydict(
+        schema, {"k": [1, 2], "v": [10, 20]},
+        ops=[Op.DELETE, Op.INSERT])
+    t.write_chunk(mixed, defer=True)
+    assert t.mem_table.is_dirty()
+    t.commit(_epoch(2))
+    assert t.get_row((1,)) is None
+    assert t.get_row((2,)) == (2, 20)
+
+
+def test_deferred_multi_chunk_epoch_bit_identical_to_memtable_path():
+    schema = Schema.of(k=DataType.INT64, v=DataType.FLOAT64)
+    rng = np.random.default_rng(3)
+    chunks = []
+    k0 = 0
+    for _ in range(4):
+        n = int(rng.integers(3, 9))
+        chunks.append(StreamChunk.from_pydict(
+            schema, {"k": list(range(k0, k0 + n)),
+                     "v": rng.normal(size=n).tolist()}))
+        k0 += n
+    stores = []
+    for defer in (True, False):
+        store = MemoryStateStore()
+        t = StateTable(65, schema, pk_indices=[0], store=store)
+        t.init_epoch(EpochPair.new_initial(Epoch.from_physical(1)))
+        for c in chunks:
+            t.write_chunk(c, defer=defer)
+        t.commit(_epoch(1))
+        stores.append(sorted(t.iter_rows()))
+    assert stores[0] == stores[1]
+
+
+def test_deferred_duplicate_pks_never_duplicate_scan_rows():
+    """Review regression: duplicate pks staged in one epoch resolve
+    last-wins in the store AND keep the key index unique — a scan must
+    yield the pk once."""
+    schema = Schema.of(k=DataType.INT64, v=DataType.INT64)
+    store = MemoryStateStore()
+    t = StateTable(66, schema, pk_indices=[0], store=store)
+    t.init_epoch(EpochPair.new_initial(Epoch.from_physical(1)))
+    t.write_chunk(StreamChunk.from_pydict(
+        schema, {"k": [1, 1, 2], "v": [10, 11, 20]}), defer=True)
+    t.commit(_epoch(1))
+    rows = sorted(r for _pk, r in t.iter_rows())
+    assert rows == [(1, 11), (2, 20)]
+    assert store.table_size(66, 2 ** 40) == 2
