@@ -386,6 +386,30 @@ def _adctr_produce(path: str, n_impressions: int, n_ads: int = 100):
                 + b"\n")
 
 
+def _adctr_ddl(path: str) -> list:
+    """The ad-ctr pipeline's DDL (shared by the adctr and multimv
+    lanes — one source of truth for the 3-MV shape)."""
+    return [
+        f"CREATE SOURCE impression (bid_id BIGINT, ad_id BIGINT, "
+        f"its TIMESTAMP) WITH (connector='filelog', "
+        f"path='{path}', topic='impressions', "
+        f"max.chunk.size=4096)",
+        f"CREATE SOURCE click (cbid BIGINT, cts TIMESTAMP) WITH "
+        f"(connector='filelog', path='{path}', topic='clicks', "
+        f"max.chunk.size=4096)",
+        "CREATE MATERIALIZED VIEW ad_dim AS SELECT ad_id, "
+        "count(*) AS seen FROM impression GROUP BY ad_id",
+        "CREATE MATERIALIZED VIEW ad_ctr AS SELECT i.ad_id, "
+        "i.window_start, count(*) AS clicked "
+        "FROM HOP(impression, its, INTERVAL '2' SECOND, "
+        "INTERVAL '10' SECOND) AS i "
+        "JOIN click AS c ON i.bid_id = c.cbid "
+        "JOIN ad_dim AS d FOR SYSTEM_TIME AS OF PROCTIME() "
+        "ON i.ad_id = d.ad_id "
+        "GROUP BY i.ad_id, i.window_start",
+    ]
+
+
 def bench_adctr(n_impressions: int = 200_000, parallelism: int = 4):
     """ad-ctr (named baseline config #5): sources → HOP windows →
     2-way join + temporal dim join → sliding-window agg at actor
@@ -399,27 +423,8 @@ def bench_adctr(n_impressions: int = 200_000, parallelism: int = 4):
     async def run(path):
         fe = Frontend(rate_limit=8, min_chunks=8,
                       parallelism=parallelism)
-        await fe.execute(
-            f"CREATE SOURCE impression (bid_id BIGINT, ad_id BIGINT, "
-            f"its TIMESTAMP) WITH (connector='filelog', "
-            f"path='{path}', topic='impressions', "
-            f"max.chunk.size=4096)")
-        await fe.execute(
-            f"CREATE SOURCE click (cbid BIGINT, cts TIMESTAMP) WITH "
-            f"(connector='filelog', path='{path}', topic='clicks', "
-            f"max.chunk.size=4096)")
-        await fe.execute(
-            "CREATE MATERIALIZED VIEW ad_dim AS SELECT ad_id, "
-            "count(*) AS seen FROM impression GROUP BY ad_id")
-        await fe.execute(
-            "CREATE MATERIALIZED VIEW ad_ctr AS SELECT i.ad_id, "
-            "i.window_start, count(*) AS clicked "
-            "FROM HOP(impression, its, INTERVAL '2' SECOND, "
-            "INTERVAL '10' SECOND) AS i "
-            "JOIN click AS c ON i.bid_id = c.cbid "
-            "JOIN ad_dim AS d FOR SYSTEM_TIME AS OF PROCTIME() "
-            "ON i.ad_id = d.ad_id "
-            "GROUP BY i.ad_id, i.window_start")
+        for sql in _adctr_ddl(path):
+            await fe.execute(sql)
         # ad_dim consumes impressions too: expected totals count every
         # reader the session drives
         expected = 2 * n_impressions + (n_impressions + 2) // 3
@@ -437,6 +442,94 @@ def bench_adctr(n_impressions: int = 200_000, parallelism: int = 4):
     import jax
     r["parallelism"] = min(parallelism, len(jax.devices()))
     return r
+
+
+def bench_multimv(n_impressions: int = 120_000,
+                  neighbor_events: int = 50 * 8_000) -> dict:
+    """Multi-MV barrier-domain lane (ISSUE 13): the ad-ctr pipeline
+    (impression/click sources → dim MV → hop/join/agg MV — ONE
+    connected domain via the shared impression source) next to a
+    q7-shaped neighbor MV on its own nexmark source, in ONE session.
+    With stream_epoch_pipeline=on each domain's barriers flow
+    independently: the neighbor's p99 stays sub-second while the
+    ad-ctr domain alone carries the tail — the per-domain breakdown
+    IS the measurement. Driven by the plane's per-domain pump (every
+    domain keeps its own in-flight window full)."""
+    import tempfile
+    import time as _time
+
+    from risingwave_tpu.frontend.session import Frontend
+
+    async def run(path):
+        fe = Frontend(rate_limit=8, min_chunks=8)
+        for sql in _adctr_ddl(path):
+            await fe.execute(sql)
+        await fe.execute(
+            f"CREATE SOURCE bid WITH (connector='nexmark', "
+            f"nexmark.table.type='bid', "
+            f"nexmark.event.num={neighbor_events}, "
+            f"nexmark.max.chunk.size=4096, "
+            f"nexmark.generate.strings='false')")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW q7_neighbor AS "
+            "SELECT window_start, MAX(price) AS max_price, "
+            "COUNT(*) AS cnt "
+            "FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND) "
+            "GROUP BY window_start")
+        expected = (2 * n_impressions + (n_impressions + 2) // 3
+                    + neighbor_events * 46 // 50)
+        await fe.step(1)                   # warmup (traces compile)
+        readers = [r for d in fe.readers.values()
+                   for r in d.values()]
+
+        def rows_seen() -> int:
+            return sum(r.rows_read if hasattr(r, "rows_read")
+                       else r.offset for r in readers)
+
+        warm = rows_seen()
+        warm_epochs = len(fe.loop.stats.latencies_s)
+        t0 = _time.perf_counter()
+        await fe.loop.drive(lambda: rows_seen() >= expected,
+                            in_flight=IN_FLIGHT,
+                            progress_fn=rows_seen)
+        elapsed = _time.perf_counter() - t0
+        rows = rows_seen() - warm
+        fe.loop.stats.latencies_s = \
+            fe.loop.stats.latencies_s[warm_epochs:]
+        fe.loop.profiler.drop_first(warm_epochs)
+        by_domain = fe.loop.p99_by_domain()
+        domains = fe.loop.describe()
+        await fe.close()
+        return elapsed, rows, fe.loop, by_domain, domains
+
+    with tempfile.TemporaryDirectory() as path:
+        _adctr_produce(path, n_impressions)
+        elapsed, rows, loop, by_domain, domains = \
+            asyncio.run(run(path))
+    r = _result("multimv_events_per_sec", elapsed, rows, loop)
+    from risingwave_tpu.utils.ledger import LEDGER
+    r["by_domain"] = {
+        dom: {"p99_s": round(p99, 4),
+              "phase_breakdown": LEDGER.phase_breakdown(domain=dom)}
+        for dom, p99 in sorted(by_domain.items())}
+    r["domains"] = domains
+    # the acceptance proof: every domain EXCEPT the ad-ctr one keeps
+    # a sub-second p99 — a slow fragment holds only its own domain
+    fast = {d: v["p99_s"] for d, v in r["by_domain"].items()
+            if d not in ("ad_dim", "ad_ctr")}
+    r["fast_domains_p99_max_s"] = max(fast.values(), default=None)
+    r["fast_domains_sub_second"] = all(v <= 1.0
+                                       for v in fast.values())
+    return r
+
+
+def _bench_multimv_subprocess() -> dict:
+    """Multi-MV domain lane in a CPU-pinned subprocess (domain
+    isolation is the subject; the virtual mesh lives in the adctr
+    lane)."""
+    return _run_bench_subprocess(
+        ["--multimv-sub"],
+        {"JAX_PLATFORMS": "cpu"}, timeout=1500)
 
 
 def _bench_adctr_subprocess() -> dict:
@@ -707,7 +800,13 @@ def bench_chaos(seed: int = 7, events: int = 6000) -> dict:
 # Escape hatch if CI hardware is slower:
 # --latency-budget '2.0,q5=4,q5_fused=8,adctr=8' (or '')
 # overrides per run without a code change.
-DEFAULT_LATENCY_BUDGET = "2.0,q5=4,q5_fused=5,adctr=5"
+#
+# multimv (ISSUE 13): the AGGREGATE p99 of the multi-MV domain lane is
+# dominated by the ad-ctr domain (single-chip, no mesh — slower than
+# the 4-virtual-device adctr lane), so it takes generous headroom; the
+# lane's own `fast_domains_sub_second` field carries the real
+# acceptance claim (every non-ad-ctr domain p99 ≤ 1s).
+DEFAULT_LATENCY_BUDGET = "2.0,q5=4,q5_fused=5,adctr=5,multimv=12"
 
 
 def _parse_latency_budgets(argv) -> dict:
@@ -890,6 +989,17 @@ def _main_locked(argv):
                          f"-mesh-{r['parallelism']}")
         print(json.dumps(r))
         return
+    if "--multimv-sub" in argv:
+        # child mode: multi-MV barrier-domain lane, CPU-pinned
+        import jax as _jax
+        _jax.config.update("jax_platforms", "cpu")
+        enable_compilation_cache()
+        from risingwave_tpu.utils.ledger import LEDGER
+        LEDGER.query = "multimv"
+        bench_multimv()                            # warmup
+        LEDGER.clear()
+        print(json.dumps(bench_multimv()))
+        return
     if "--adctr-sub" in argv:
         # child mode: env asks for the CPU virtual mesh, but the axon
         # sitecustomize overrides JAX_PLATFORMS at interpreter start —
@@ -962,6 +1072,21 @@ def _main_locked(argv):
         except Exception as e:                       # noqa: BLE001
             print(f"WARNING: adctr failed: {e!r}", file=sys.stderr)
             headline["adctr"] = {"error": repr(e)[:200]}
+        # multi-MV barrier-domain lane (ISSUE 13): ad-ctr next to a
+        # q7-shaped neighbor in one session — the per-domain p99
+        # breakdown shows the slow domain carrying the tail alone
+        try:
+            r = _bench_multimv_subprocess()
+            headline["multimv"] = {
+                k: r[k] for k in ("value", "p99_barrier_latency_s",
+                                  "barrier_in_flight", "events",
+                                  "platform", "by_domain", "domains",
+                                  "fast_domains_p99_max_s",
+                                  "fast_domains_sub_second",
+                                  "observability") if k in r}
+        except Exception as e:                       # noqa: BLE001
+            print(f"WARNING: multimv failed: {e!r}", file=sys.stderr)
+            headline["multimv"] = {"error": repr(e)[:200]}
         # sharded mesh lane (ISSUE 10): q7 at parallelism 8 — the
         # epoch-batched SPMD kernels timed, not just dry-run-checked
         try:

@@ -33,6 +33,9 @@ from risingwave_tpu.utils.metrics import CLUSTER as _METRICS
 _IDEMPOTENT_VERBS = frozenset({
     "ping", "scan_table", "recover_store", "set_trace", "set_ledger",
     "arm_failpoints", "metrics", "reset",
+    # absolute-state write: sealing/syncing to an epoch twice equals
+    # once (the aligned-checkpoint floor push, ISSUE 13)
+    "seal_sync",
 })
 
 
@@ -153,7 +156,8 @@ class WorkerClient:
                      for k, v in rows]})
 
     async def inject(self, barrier: Barrier,
-                     committed: Optional[int] = None) -> dict:
+                     committed: Optional[int] = None,
+                     extras: Optional[dict] = None) -> dict:
         m = None
         if isinstance(barrier.mutation, StopMutation):
             m = {"type": "stop",
@@ -172,6 +176,13 @@ class WorkerClient:
             # barrier (two-phase workers adopt staged SSTs ≤ this)
             "committed": committed,
         }
+        if extras:
+            # barrier-domain frame (ISSUE 13): "actors" scopes the
+            # barrier to one domain's actors on the worker; "seal"
+            # carries the cross-domain write floor the worker may
+            # fence to (per-domain prevs interleave globally, so the
+            # worker must never seal to its own prev eagerly)
+            cmd.update(extras)
         from risingwave_tpu.utils import spans as _spans
         if _spans.enabled():
             # span context rides the injection: worker-side spans of
@@ -290,7 +301,7 @@ class WorkerBarrierSender:
     remote = True
 
     def __init__(self, client: WorkerClient, local, pseudo_actor: int,
-                 committed_fn=None):
+                 committed_fn=None, extras_fn=None):
         self.client = client
         self.local = local
         self.pseudo = pseudo_actor
@@ -298,16 +309,22 @@ class WorkerBarrierSender:
         # commit decision pipelined onto each barrier); None = legacy
         # self-committing workers
         self.committed_fn = committed_fn
+        # barrier-domain frame builder (ISSUE 13): called per send
+        # with the barrier, returns the domain actor filter + seal
+        # floor to ride the inject cmd; None = legacy global frames
+        self.extras_fn = extras_fn
         self._tasks: set = set()   # strong refs: the loop holds tasks
         #                            weakly and could drop one mid-RPC
 
     async def send(self, barrier: Barrier) -> None:
         committed = (self.committed_fn()
                      if self.committed_fn is not None else None)
+        extras = (self.extras_fn(barrier)
+                  if self.extras_fn is not None else None)
 
         async def roundtrip():
             try:
-                await self.client.inject(barrier, committed)
+                await self.client.inject(barrier, committed, extras)
                 self.local.collect(self.pseudo, barrier)
             except BaseException as e:  # noqa: BLE001 — fail the epoch
                 self.local.notify_failure(self.pseudo, e)

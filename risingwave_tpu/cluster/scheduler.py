@@ -60,11 +60,15 @@ class _CoordEpochStore:
 @dataclass
 class JobDeployment:
     """One deployed streaming job: its fragment graph + placements.
-    placements[fi] = [(actor_id, worker_slot), ...] per fragment."""
+    placements[fi] = [(actor_id, worker_slot), ...] per fragment.
+    ``domain_keys`` are the job's barrier-domain reachability anchors
+    (its source/MV dependency names — jobs sharing one align in a
+    single domain; recorded so recovery rebuilds the same domains)."""
 
     name: str
     graph: FragmentGraph
     placements: List[List[tuple]] = field(default_factory=list)
+    domain_keys: frozenset = frozenset()
 
     def actor_ids(self) -> List[int]:
         return [aid for frag in self.placements for aid, _slot in frag]
@@ -76,7 +80,8 @@ class Cluster:
     def __init__(self, root: str, n_workers: int = 2,
                  platform: str = "cpu",
                  barrier_timeout_s: Optional[float] = None,
-                 supervisor: Optional[RecoverySupervisor] = None):
+                 supervisor: Optional[RecoverySupervisor] = None,
+                 epoch_pipeline: bool = True):
         self.root = root
         self.n = n_workers
         self.platform = platform
@@ -84,8 +89,17 @@ class Cluster:
         self.clients: List[Optional[WorkerClient]] = [None] * n_workers
         self.jobs: Dict[str, JobDeployment] = {}
         self.local: Optional[LocalBarrierManager] = None
-        self.loop: Optional[BarrierLoop] = None
+        self.loop = None        # BarrierLoop (off arm) or BarrierPlane
         self.store = _CoordEpochStore()
+        # pipelined epochs (ISSUE 13): per-job barrier domains with
+        # their own control connections per worker (two domains'
+        # injects must not serialize behind one request-response
+        # channel); off = the legacy single global loop, bit-identical
+        self.epoch_pipeline = bool(epoch_pipeline)
+        self._plane = None
+        # domain → {"pids": [per-slot pseudo ids], "clients": [...]}
+        self._domain_wiring: Dict[str, dict] = {}
+        self._domain_seq = 0
         self._next_actor = 1000
         self._rr = 0                      # placement cursor
         # supervised recovery (meta/supervisor.py): classification +
@@ -104,7 +118,7 @@ class Cluster:
     async def start(self) -> None:
         await asyncio.gather(*(self._start_slot(k)
                                for k in range(self.n)))
-        self._fresh_barrier_plane()
+        await self._fresh_barrier_plane()
 
     async def _start_slot(self, k: int) -> None:
         h = WorkerHandle(os.path.join(self.root, f"w{k}"),
@@ -112,31 +126,147 @@ class Cluster:
         self.clients[k] = await h.start()
         self.handles[k] = h
 
-    def _fresh_barrier_plane(self) -> None:
-        """(Re)build the barrier fan-out: one pseudo-actor per worker
-        slot; the commit decision pipelines via committed_fn."""
+    async def _fresh_barrier_plane(self) -> None:
+        """(Re)build the barrier fan-out. Off arm: one global loop,
+        one pseudo-actor per worker slot. Plane arm: one BarrierPlane
+        whose domains rebuild from the deployed jobs' recorded
+        ``domain_keys`` — after a recovery every domain's initial
+        barrier recovers ``prev = the committed floor``, re-aligning
+        all domains to the same durable point."""
         self.local = LocalBarrierManager()
-        # distributed=True: the ledger's sealed records cover only
-        # coordinator-side phases until drain_ledger merges the worker
-        # accumulators in (conservation defers to the merge)
-        self.loop = BarrierLoop(self.local, self.store,
-                                collect_timeout_s=self.barrier_timeout_s,
-                                distributed=True)
+        # release the previous generation's per-domain control
+        # connections (reset-in-place recoveries keep the worker
+        # processes alive — without the abort every recovery round
+        # would leak domains × workers open sockets)
+        for w in self._domain_wiring.values():
+            for c in w["clients"]:
+                if c is not None:
+                    c.abort()
+        self._domain_wiring = {}
+        if not self.epoch_pipeline:
+            # distributed=True: the ledger's sealed records cover only
+            # coordinator-side phases until drain_ledger merges the
+            # worker accumulators in (conservation defers to the merge)
+            self.loop = BarrierLoop(
+                self.local, self.store,
+                collect_timeout_s=self.barrier_timeout_s,
+                distributed=True)
+            self._plane = None
+            for k in range(self.n):
+                pid = _PSEUDO_BASE + k
+                self.local.register_sender(
+                    pid, WorkerBarrierSender(
+                        self.clients[k], self.local, pid,
+                        committed_fn=lambda:
+                        self.store.committed_epoch()))
+            self.local.set_expected_actors(
+                [_PSEUDO_BASE + k for k in range(self.n)])
+            return
+        from risingwave_tpu.meta.domains import BarrierPlane
+        self._plane = BarrierPlane(
+            self.local, self.store,
+            collect_timeout_s=self.barrier_timeout_s,
+            distributed=True)
+        self._plane.aligned_hook = self._seal_sync_workers
+        self.loop = self._plane
+        for name, job in self.jobs.items():
+            self._plane.assign_job(name, set(job.domain_keys),
+                                   sender_ids=(), expected_ids=(),
+                                   actor_ids=job.actor_ids())
+        await self._rewire_domains()
+
+    def _domain_extras_fn(self, domain: str):
+        """Builds the per-barrier domain frame: the actor filter the
+        worker scopes the barrier to, and the cross-domain write floor
+        it may fence the store to."""
+        def extras(_barrier) -> dict:
+            actors = sorted(a for a in
+                            self._plane.domain_actors(domain)
+                            if a < _PSEUDO_BASE)
+            return {"actors": actors,
+                    "seal": self._plane.allocator.write_floor()}
+        return extras
+
+    async def _wire_domain(self, domain: str) -> None:
+        """Open one control connection per worker slot for a new
+        domain and register its barrier senders. Separate connections
+        are the point: two domains' inject RPCs on one request-
+        response channel would serialize — the slow domain's collect
+        would block the fast domain's inject, resurrecting the global
+        lockstep at the transport layer."""
+        self._domain_seq += 1
+        pids, clients = [], []
         for k in range(self.n):
-            pid = _PSEUDO_BASE + k
+            base = self.clients[k]
+            if base is None:
+                pids.append(None)
+                clients.append(None)
+                continue
+            c = WorkerClient(base.host, base.control_port,
+                             base.exchange_port)
+            await c.connect()
+            pid = _PSEUDO_BASE + self._domain_seq * 256 + k
             self.local.register_sender(
                 pid, WorkerBarrierSender(
-                    self.clients[k], self.local, pid,
-                    committed_fn=lambda: self.store.committed_epoch()))
-        self.local.set_expected_actors(
-            [_PSEUDO_BASE + k for k in range(self.n)])
+                    c, self.local, pid,
+                    committed_fn=lambda: self.store.committed_epoch(),
+                    extras_fn=self._domain_extras_fn(domain)))
+            pids.append(pid)
+            clients.append(c)
+        self._domain_wiring[domain] = {"pids": pids,
+                                       "clients": clients}
+        self._plane.set_domain_channel(
+            domain, [p for p in pids if p is not None])
+
+    async def _rewire_domains(self) -> None:
+        """Reconcile per-domain wiring with the plane's live domains
+        (deploys create domains; merges absorb them; drops retire
+        them)."""
+        live = {d for d in self._plane.domains()
+                if self._plane.domain_actors(d)
+                or d in {self._plane.domain_of_job(j)
+                         for j in self.jobs}}
+        for dom in list(self._domain_wiring):
+            if dom not in live:
+                w = self._domain_wiring.pop(dom)
+                for pid in w["pids"]:
+                    if pid is not None:
+                        self.local.drop_actor(pid)
+                for c in w["clients"]:
+                    if c is not None:
+                        c.abort()
+        for dom in live:
+            if dom not in self._domain_wiring:
+                await self._wire_domain(dom)
+            else:
+                # a merge may have folded an absorbed domain's pseudo
+                # actors into the survivor's member sets — scrub them
+                # back to exactly this domain's wired channel, or the
+                # next barrier would wait on dead pseudo actors
+                self._plane.set_domain_channel(
+                    dom, [p for p in self._domain_wiring[dom]["pids"]
+                          if p is not None])
+
+    async def _seal_sync_workers(self, floor: int) -> None:
+        """Aligned-checkpoint push: every worker seals + stage-syncs
+        to the floor BEFORE the coordinator watermark advances — the
+        committed epoch recovery trusts is durable on every slot."""
+        await asyncio.gather(*(
+            c.call_idempotent({"cmd": "seal_sync", "epoch": floor},
+                              io_timeout=60.0)
+            for c in self.clients if c is not None))
+
+    def _all_pseudo(self) -> Set[int]:
+        if self._plane is None:
+            return {_PSEUDO_BASE + k for k in range(self.n)}
+        return {pid for w in self._domain_wiring.values()
+                for pid in w["pids"] if pid is not None}
 
     def _stop_set(self, *jobs: JobDeployment) -> frozenset:
         """Actor ids to stop (plus every worker pseudo-actor — the
         stop barrier must still collect on every slot)."""
         ids = {a for j in jobs for a in j.actor_ids()}
-        return frozenset(ids | {_PSEUDO_BASE + k
-                                for k in range(self.n)})
+        return frozenset(ids | self._all_pseudo())
 
     async def stop(self) -> None:
         if self.loop is not None:
@@ -270,17 +400,20 @@ class Cluster:
         return outs, {"type": "hash", "keys": inp.keys,
                       "mapping": [int(o) for o in mapping.owners]}
 
-    async def deploy_graph(self, name: str,
-                           graph: FragmentGraph) -> JobDeployment:
+    async def deploy_graph(self, name: str, graph: FragmentGraph,
+                           domain_keys=()) -> JobDeployment:
         """Schedule + deploy one job's fragments (upstream first so
         exchange edges exist before consumers connect), then leave
         activation to the caller's next barrier. A partial failure
         unwinds: already-deployed actors stop at a barrier — left
         running, a source feeding an edge nobody consumes would block
-        on the credit window and wedge every later barrier."""
+        on the credit window and wedge every later barrier.
+        ``domain_keys`` (source/MV names the job reads) anchor its
+        barrier domain: jobs sharing one align together."""
         if name in self.jobs:
             raise ValueError(f"job {name!r} already deployed")
-        job = JobDeployment(name, graph, self._place(graph))
+        job = JobDeployment(name, graph, self._place(graph),
+                            domain_keys=frozenset(domain_keys))
         try:
             await self._deploy_job(job)
         except BaseException:
@@ -290,6 +423,11 @@ class Cluster:
                     mutation=StopMutation(self._stop_set(job)))
             raise
         self.jobs[name] = job
+        if self._plane is not None:
+            self._plane.assign_job(name, set(job.domain_keys),
+                                   sender_ids=(), expected_ids=(),
+                                   actor_ids=job.actor_ids())
+            await self._rewire_domains()
         return job
 
     async def _deploy_job(self, job: JobDeployment) -> None:
@@ -312,6 +450,9 @@ class Cluster:
         await self.loop.inject_and_collect(
             force_checkpoint=True,
             mutation=StopMutation(self._stop_set(job)))
+        if self._plane is not None:
+            self._plane.remove_job(name)
+            await self._rewire_domains()
 
     # -- barriers ---------------------------------------------------------
     async def step(self, n: int = 1) -> None:
@@ -413,7 +554,7 @@ class Cluster:
             self.clients[k].call({"cmd": "recover_store",
                                   "epoch": floor})
             for k in range(self.n)))
-        self._fresh_barrier_plane()
+        await self._fresh_barrier_plane()
         for job in self.jobs.values():
             await self._deploy_job(job)
         if self._heartbeater is not None:
@@ -477,7 +618,7 @@ class Cluster:
                 {"cmd": "recover_store", "epoch": floor},
                 io_timeout=20.0)
             for k in range(self.n)))
-        self._fresh_barrier_plane()
+        await self._fresh_barrier_plane()
         for job in self.jobs.values():
             await self._deploy_job(job)
         if self._heartbeater is not None:
@@ -556,14 +697,14 @@ class Cluster:
                 "fragment is not vnode-rescalable (needs hash inputs "
                 "and only exchange_in/hash_agg/project/filter/"
                 "materialize-with-dist_key nodes)")
+        codomain = self._codomain_jobs(job)
         await self._stop_and_align(job)
         # vnode-sliced handoff: gather each table from every OLD slot,
         # route rows by key-prefix vnode through the NEW mapping, and
         # move ONLY rows whose owner changes (the stationary majority
         # of a small rescale stays put)
         mapping = VnodeMapping.new_uniform(len(to_slots))
-        min_epoch = (self.loop._epoch.value
-                     if self.loop._epoch is not None else 0)
+        min_epoch = self.loop.frontier_epoch()
         handoff_max = 0
         old_slots = sorted({s for _a, s in old})
         for tid in _fragment_table_ids(frag):
@@ -589,6 +730,10 @@ class Cluster:
         if handoff_max:
             self.loop.advance_epoch_to(handoff_max)
         await self._redeploy_with_fresh_actors(job, {frag_idx: to_slots})
+        for j in codomain:
+            if j is not job:
+                # stopped-with-the-domain siblings come back too
+                await self._redeploy_with_fresh_actors(j, {})
 
     async def move_fragment(self, name: str, frag_idx: int,
                             to_slots: List[int]) -> None:
@@ -609,14 +754,14 @@ class Cluster:
                                                to_slots)
         if [s for _a, s in old] == list(to_slots):
             return
+        codomain = self._codomain_jobs(job)
         await self._stop_and_align(job)
         # 2) ship the moved actors' state tables between namespaces.
         # Ingest epochs stay ABOVE the last injected barrier (other
         # jobs hold buffered flushes at that epoch; sealing it out from
         # under them would fail their next commit), and the barrier
         # loop then reserves past the handoff epochs.
-        min_epoch = (self.loop._epoch.value
-                     if self.loop._epoch is not None else 0)
+        min_epoch = self.loop.frontier_epoch()
         handoff_max = 0
         table_ids = _fragment_table_ids(frag)
         for (aid, from_slot), to_slot in zip(old, to_slots):
@@ -637,17 +782,40 @@ class Cluster:
         if handoff_max:
             self.loop.advance_epoch_to(handoff_max)
         await self._redeploy_with_fresh_actors(job, {frag_idx: to_slots})
+        for j in codomain:
+            if j is not job:
+                # stopped-with-the-domain siblings come back too
+                await self._redeploy_with_fresh_actors(j, {})
+
+    def _codomain_jobs(self, job: JobDeployment) -> List[JobDeployment]:
+        """Every deployed job sharing `job`'s barrier domain (itself
+        included). The state handoff seals the worker stores above the
+        coordinator floor, so every job whose actors could still flush
+        below that fence must stop — and redeploy — with it."""
+        if self._plane is None:
+            return [job]
+        dom = self._plane.domain_of_job(job.name)
+        if dom is None:
+            return [job]
+        return [self.jobs[n] for n in self._plane.jobs_of_domain(dom)
+                if n in self.jobs]
 
     async def _stop_and_align(self, job: JobDeployment) -> None:
-        """Stop the WHOLE job at a barrier and push the coordinator's
-        commit decision to every worker: the stop barrier's epoch is
-        committed on the COORDINATOR but pipelines to workers on the
-        next inject — without the push, a handoff scan would miss rows
-        born in that epoch and leave them to resurrect on the old
-        worker when its staged SST commits later."""
+        """Stop the job's WHOLE DOMAIN at a barrier and push the
+        coordinator's commit decision to every worker: the stop
+        barrier's epoch is committed on the COORDINATOR but pipelines
+        to workers on the next inject — without the push, a handoff
+        scan would miss rows born in that epoch and leave them to
+        resurrect on the old worker when its staged SST commits later.
+        Domain-wide (not just this job): the handoff's worker-side
+        seal fences everything below its ingest epochs, and a still-
+        running sibling job would have its next flush rejected under
+        that fence — stopped siblings have nothing pending, so the
+        fence is safe."""
         await self.loop.inject_and_collect(
             force_checkpoint=True,
-            mutation=StopMutation(self._stop_set(job)))
+            mutation=StopMutation(
+                self._stop_set(*self._codomain_jobs(job))))
         floor = self.store.committed_epoch()
         for c in self.clients:
             await c.call({"cmd": "recover_store", "epoch": floor})
@@ -664,6 +832,21 @@ class Cluster:
             job.placements[fi] = [(self._fresh_actor(), s)
                                   for s in slots]
         await self._deploy_job(job)
+        if self._plane is not None:
+            # the domain's actor filter must name the FRESH actor ids
+            # or the redeployed fragments never see another barrier
+            self._plane.remove_job(job.name)
+            dom = self._plane.assign_job(job.name,
+                                         set(job.domain_keys),
+                                         sender_ids=(),
+                                         expected_ids=(),
+                                         actor_ids=job.actor_ids())
+            # the handoff ingests committed worker-side ABOVE the
+            # coordinator floor — the fresh domain's first barrier
+            # must read at/above them, not at the stale floor
+            self._plane.advance_domain_to(
+                dom, self._plane.last_allocated)
+            await self._rewire_domains()
 
     def _fresh_actor(self) -> int:
         aid = self._next_actor

@@ -70,9 +70,11 @@ class DistFrontend:
                  parallelism: Optional[int] = None,
                  rate_limit: Optional[int] = 8,
                  min_chunks: Optional[int] = None,
-                 barrier_timeout_s: Optional[float] = None):
+                 barrier_timeout_s: Optional[float] = None,
+                 epoch_pipeline: bool = True):
         self.cluster = Cluster(root, n_workers,
-                               barrier_timeout_s=barrier_timeout_s)
+                               barrier_timeout_s=barrier_timeout_s,
+                               epoch_pipeline=epoch_pipeline)
         self.catalog = Catalog()
         self.parallelism = parallelism or n_workers
         self.rate_limit = rate_limit
@@ -358,7 +360,12 @@ class DistFrontend:
                                                 label=stmt.name)
         self.last_plan_stats = fragment_plan_stats(graph)
         async with self._barrier_lock:
-            await self.cluster.deploy_graph(stmt.name, graph)
+            # domain anchors: the job's own name + every source/MV it
+            # reads — shared-source fan-outs and view-expanded chains
+            # align in one barrier domain, disjoint jobs in their own
+            await self.cluster.deploy_graph(
+                stmt.name, graph,
+                domain_keys={stmt.name, *plan.mv.dependent_sources})
             await self.cluster.step(1)     # activation barrier
         self.catalog.add_mv(plan.mv)
         self._mv_selects[stmt.name] = (

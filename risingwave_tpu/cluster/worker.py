@@ -107,6 +107,21 @@ class WorkerServer:
             return self._scan_table(cmd)
         if verb == "ingest_table":
             return self._ingest_table(cmd)
+        if verb == "seal_sync":
+            # cross-domain aligned checkpoint (ISSUE 13): the
+            # coordinator pushes the write floor once EVERY domain of
+            # the round collected — seal + stage-sync everything at or
+            # below it in one absolute-state (idempotent) step; the
+            # commit decision still pipelines on the next barrier's
+            # "committed" field
+            epoch = int(cmd["epoch"])
+            sealed = max(self.store.committed_epoch(),
+                         getattr(self.store, "_sealed_epoch", 0))
+            if epoch > sealed:
+                self.store.seal_epoch(epoch, True)
+            self.store.sync(epoch)
+            return {"ok": True,
+                    "committed": self.store.committed_epoch()}
         if verb == "recover_store":
             # recovery handshake: adopt everything the coordinator
             # committed, discard the half-epoch a crash may have left
@@ -154,8 +169,14 @@ class WorkerServer:
             return {"ok": True, "epochs": LEDGER.drain_dicts()}
         if verb == "ping":
             # heartbeat probe (cluster.rs heartbeat RPC): liveness +
-            # a cheap resource summary for the membership table
-            return {"ok": True, "info": {"actors": len(self.actors)}}
+            # a cheap resource summary for the membership table (actor
+            # failures ride along so a dead-epoch diagnosis can name
+            # the culprit without waiting for the next inject)
+            return {"ok": True, "info": {
+                "actors": len(self.actors),
+                "failures": {str(aid): repr(a.failure)
+                             for aid, a in self.actors.items()
+                             if a.failure is not None}}}
         if verb == "stop":
             return {"ok": True}
         return {"ok": False, "error": f"unknown cmd {verb!r}"}
@@ -377,14 +398,43 @@ class WorkerServer:
                 epoch=pair.curr.value, parent=parent,
                 kind=kind.value)
             _spans.EPOCH_TRACER.set_root(pair.curr.value, wroot)
-        await self.local.send_barrier(barrier)
+        actors = cmd.get("actors")
+        if "seal" in cmd:
+            # domain-protocol marker: a coordinator-side domain merge
+            # can re-anchor live chains on THIS worker monotonely —
+            # commit() must accept prev > curr from here on
+            from risingwave_tpu.state.state_table import (
+                allow_monotone_reanchor,
+            )
+            allow_monotone_reanchor(True)
+        if actors is None:
+            await self.local.send_barrier(barrier)
+        else:
+            # barrier-domain frame (ISSUE 13): the barrier flows only
+            # through this domain's actors on this worker; sibling
+            # domains' actors neither receive nor block it. An empty
+            # intersection collects trivially — the worker simply
+            # hosts none of the domain's fragments.
+            acts = {int(a) for a in actors}
+            await self.local.send_barrier(
+                barrier, sender_ids=sorted(acts),
+                expected=[a for a in self.actors if a in acts])
         collected = await self.local.await_epoch_complete(
             pair.curr.value)
-        # seal+stage the epoch that ENDED. The guard makes re-injection
-        # after recovery a no-op rather than an assertion failure.
         sealed = max(self.store.committed_epoch(),
                      getattr(self.store, "_sealed_epoch", 0))
-        if pair.prev.value > sealed:
+        if "seal" in cmd:
+            # domain-plane protocol: per-domain prevs interleave
+            # globally, so the worker fences only to the cross-domain
+            # write floor the coordinator computed; durability arrives
+            # via the aligned seal_sync push, never inline here
+            s = int(cmd.get("seal") or 0)
+            if s > sealed:
+                self.store.seal_epoch(s, kind.is_checkpoint)
+        elif pair.prev.value > sealed:
+            # legacy global-lockstep protocol: seal+stage the epoch
+            # that ENDED. The guard makes re-injection after recovery
+            # a no-op rather than an assertion failure.
             self.store.seal_epoch(pair.prev.value, kind.is_checkpoint)
             if kind.is_checkpoint:
                 self.store.sync(pair.prev.value)
@@ -412,7 +462,9 @@ class WorkerServer:
             self.local.set_expected_actors(list(self.actors))
         for aid, a in self.actors.items():
             if a.failure is not None:
-                return {"ok": False, "error": repr(a.failure)}
+                return {"ok": False,
+                        "error": f"actor {aid} ({a.fragment}): "
+                                 f"{a.failure!r}"}
         return {"ok": True, "collected": collected is not None,
                 "committed": pair.prev.value}
 

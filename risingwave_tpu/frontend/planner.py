@@ -1274,7 +1274,8 @@ def _system_catalog_rows(name: str, catalog: Catalog, profiler=None):
                       Field("slowest_actor", DataType.INT64),
                       Field("slowest_actor_lag_s", DataType.FLOAT64),
                       Field("upload_s", DataType.FLOAT64),
-                      Field("queue_depth", DataType.INT64)])
+                      Field("queue_depth", DataType.INT64),
+                      Field("domain", DataType.VARCHAR)])
         rows = list(profiler.rows()) if profiler is not None else []
         return sch, rows
     if n == "rw_state_tier":
@@ -1319,7 +1320,8 @@ def _system_catalog_rows(name: str, catalog: Catalog, profiler=None):
                       Field("ts", DataType.FLOAT64),
                       Field("interval_s", DataType.FLOAT64),
                       Field("name", DataType.VARCHAR),
-                      Field("value", DataType.FLOAT64)])
+                      Field("value", DataType.FLOAT64),
+                      Field("domain", DataType.VARCHAR)])
         return sch, HISTORY.rows()
     if n == "rw_kernel_costs":
         # compiled-program cost analysis (utils/jaxtools.KERNELS):

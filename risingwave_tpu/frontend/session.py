@@ -43,7 +43,8 @@ class Frontend:
                  rate_limit: Optional[int] = 8,
                  min_chunks: Optional[int] = None,
                  parallelism: int = 1,
-                 join_state_cap: Optional[int] = None):
+                 join_state_cap: Optional[int] = None,
+                 epoch_pipeline: bool = True):
         self.store = store if store is not None else MemoryStateStore()
         # parallelism > 1: GROUP BY plans run on the vnode-sharded SPMD
         # kernel over a device mesh (the fragmenter's hash-exchange
@@ -51,7 +52,16 @@ class Frontend:
         self.mesh = self._mesh_for(parallelism)
         self.catalog = Catalog()
         self.local = LocalBarrierManager()
-        self.loop = BarrierLoop(self.local, self.store)
+        # pipelined epochs (ISSUE 13): with epoch_pipeline on (the
+        # default, SET stream_epoch_pipeline = off to opt out), a
+        # BarrierPlane partitions deployed jobs into alignment domains
+        # — each domain's barriers flow independently, checkpoints stay
+        # cross-domain aligned on their own cadence. Off reproduces the
+        # single global BarrierLoop bit-identically (the oracle arm).
+        self._epoch_pipeline = bool(epoch_pipeline)
+        self._plane = None
+        self._legacy_loop = None
+        self._rebuild_barrier_engine()
         self.actors: Dict[int, Actor] = {}
         self.tasks: Dict[int, asyncio.Task] = {}
         self.readers: Dict[str, Dict[int, object]] = {}   # mv → readers
@@ -89,6 +99,11 @@ class Frontend:
                    "state_tier_soft_limit_mb":
                        "state_tier_soft_limit_mb",
                    "stream_chunk_target_rows": "chunk_target_rows",
+                   # decoupled checkpoint cadence (ISSUE 13): durable
+                   # checkpoints every k-th round; plain barriers
+                   # advance per-domain in between
+                   "stream_checkpoint_frequency":
+                       "checkpoint_frequency",
                    "stream_coalesce_linger_chunks":
                        "coalesce_linger_chunks"},
             {"application_name": "", "timezone": "UTC",
@@ -109,11 +124,18 @@ class Frontend:
              # host/device time-and-bytes accounting with the
              # conservation gate; 'off' reduces every hook to a
              # predicate check (the ledger-on-vs-off bench arm)
-             "stream_ledger": "on"},
+             "stream_ledger": "on",
+             # barrier domains (meta/domains.py): 'off' restores one
+             # global BarrierLoop — today's lockstep, bit-identical
+             # (the oracle arm). Only changeable with no live jobs.
+             "stream_epoch_pipeline":
+                 "on" if self._epoch_pipeline else "off"},
             validators={"stream_rewrite_rules": parse_rules,
                         "stream_fusion": parse_fusion,
                         "stream_trace": parse_trace,
-                        "stream_ledger": parse_ledger})
+                        "stream_ledger": parse_ledger,
+                        "stream_epoch_pipeline":
+                            self._validate_epoch_pipeline})
         # rules spec each MV was created under: reschedule replans +
         # re-rewrites with the SAME spec so state-table schemas from
         # the original rewrite reproduce exactly (id-base contract)
@@ -139,6 +161,54 @@ class Frontend:
         # serializes barrier rounds between DDL handlers, step() and the
         # background heartbeat (inject_and_collect is not reentrant)
         self._barrier_lock = asyncio.Lock()
+
+    # -- barrier engine (ISSUE 13) ---------------------------------------
+    def _rebuild_barrier_engine(self) -> None:
+        """Swap between the domain plane and the legacy global loop
+        (only legal with no live jobs — the SET validator enforces)."""
+        freq = self.checkpoint_frequency if (
+            self._plane is not None or self._legacy_loop is not None) \
+            else 1
+        if self._epoch_pipeline:
+            from risingwave_tpu.meta.domains import BarrierPlane
+            self._plane = BarrierPlane(self.local, self.store,
+                                       checkpoint_frequency=freq)
+            self._legacy_loop = None
+        else:
+            self._legacy_loop = BarrierLoop(self.local, self.store,
+                                            checkpoint_frequency=freq)
+            self._plane = None
+
+    @property
+    def loop(self):
+        """The barrier engine: a BarrierPlane (domains) or a single
+        BarrierLoop (off arm) — same driving surface either way."""
+        return self._plane if self._plane is not None \
+            else self._legacy_loop
+
+    @property
+    def checkpoint_frequency(self) -> int:
+        """SET stream_checkpoint_frequency: durable checkpoints land
+        every k-th barrier round (aligned across domains); plain
+        rounds advance per-domain. 1 = every round (the historical
+        default)."""
+        eng = self.loop
+        return eng.checkpoint_frequency if eng is not None else 1
+
+    @checkpoint_frequency.setter
+    def checkpoint_frequency(self, v) -> None:
+        eng = self.loop
+        if eng is not None:
+            eng.checkpoint_frequency = max(1, int(v))
+
+    def _validate_epoch_pipeline(self, spec: str) -> bool:
+        from risingwave_tpu.meta.domains import parse_epoch_pipeline
+        want = parse_epoch_pipeline(spec)
+        if want != self._epoch_pipeline and self.actors:
+            raise PlanError(
+                "stream_epoch_pipeline cannot change with live jobs — "
+                "drop them first")
+        return want
 
     # -- state-tier pressure knob (SET state_tier_soft_limit_mb) ---------
     @property
@@ -349,6 +419,16 @@ class Frontend:
                 from risingwave_tpu.utils import ledger as _ledger
                 _ledger.set_enabled(_ledger.parse_ledger(
                     self.session_vars.get("stream_ledger")))
+            if stmt.name == "stream_epoch_pipeline":
+                from risingwave_tpu.meta.domains import (
+                    parse_epoch_pipeline,
+                )
+                want = parse_epoch_pipeline(
+                    self.session_vars.get("stream_epoch_pipeline"))
+                if want != self._epoch_pipeline:
+                    # validator already refused with live jobs
+                    self._epoch_pipeline = want
+                    self._rebuild_barrier_engine()
             return "SET"
         if isinstance(stmt, ast.Show):
             if stmt.what == "var:all":
@@ -377,12 +457,16 @@ class Frontend:
 
     # -- handlers ---------------------------------------------------------
     async def _deploy_job(self, name: str, actor_id: int, consumer,
-                          readers, register, attaches=()) -> None:
+                          readers, register, attaches=(),
+                          deps=()) -> None:
         """Shared deployment tail for MVs and sinks — runs UNDER the
         barrier lock the caller holds: topology mutations (sender
         registration in plan(), expected-actor set, spawn) racing a
         heartbeat epoch would leave it collecting against actors that
-        never received it."""
+        never received it. ``deps`` (source/MV names the job reads)
+        are the job's barrier-domain reachability anchors: jobs that
+        share a dep — a source fan-out, an MV-on-MV chain, a temporal
+        dim read — align in one domain; disjoint jobs get their own."""
         register()                    # catalog entry (duplicate check)
         # every deployed chain is instrumented node-by-node: row/chunk
         # throughput and exclusive processing time per (fragment,
@@ -401,6 +485,12 @@ class Frontend:
         self.readers[name] = readers
         self.local.set_expected_actors(list(self.actors))
         self.tasks[actor_id] = actor.spawn()
+        if self._plane is not None:
+            # domain derivation BEFORE the activation barrier: the new
+            # job's first barrier must already flow through its domain
+            self._plane.assign_job(name, set(deps),
+                                   sender_ids=set(readers),
+                                   expected_ids={actor_id})
         # attach MV-on-MV chain edges now that the plan validated and
         # the downstream actor exists — the activation barrier below
         # must flow through these channels
@@ -514,7 +604,8 @@ class Frontend:
             await self._deploy_job(
                 stmt.name, actor_id, plan.consumer, plan.readers,
                 lambda: self.catalog.add_mv(plan.mv),
-                attaches=plan.attaches)
+                attaches=plan.attaches,
+                deps=plan.mv.dependent_sources)
         self._mv_selects[stmt.name] = (
             stmt.select, getattr(stmt, "emit_on_window_close", False))
         self._mv_rules[stmt.name] = rules
@@ -919,7 +1010,8 @@ class Frontend:
                 await self._deploy_job(
                     name, actor_id, plan.consumer, plan.readers,
                     lambda: self.catalog.add_mv(plan.mv),
-                    attaches=plan.attaches)
+                    attaches=plan.attaches,
+                    deps=plan.mv.dependent_sources)
             except BaseException as e:
                 # the old pipeline is gone and cannot be restored:
                 # degrade to DROPPED (state tables kept) rather than
@@ -977,7 +1069,7 @@ class Frontend:
                 lambda: self.catalog.add_sink(SinkCatalog(
                     stmt.name, actor_id, dict(stmt.options),
                     dependent_sources=plan.deps)),
-                attaches=plan.attaches)
+                attaches=plan.attaches, deps=plan.deps)
         if self._deployed_actor.failure is not None:
             raise self._deployed_actor.failure
         return "CREATE_SINK"
@@ -1013,6 +1105,10 @@ class Frontend:
             from risingwave_tpu.stream.actor import close_receivers
             close_receivers(actor.consumer)
         self.local.set_expected_actors(list(self.actors))
+        if self._plane is not None:
+            # drop the job from its alignment domain (an empty domain
+            # retires — its frontier epoch stops blocking the fence)
+            self._plane.remove_job(name)
         return actor
 
     async def _drop_job(self, name: str, registry, if_exists: bool,

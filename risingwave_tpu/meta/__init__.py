@@ -2,8 +2,12 @@
 
 Reference parity: src/meta/ (GlobalBarrierManager src/meta/src/barrier/
 mod.rs:128; stream manager, catalog, recovery come in later rounds).
+Barrier domains + the cross-domain checkpoint plane live in
+meta/domains.py (ISSUE 13).
 """
 
 from risingwave_tpu.meta.barrier import BarrierLoop, BarrierStats
+from risingwave_tpu.meta.domains import BarrierPlane, EpochAllocator
 
-__all__ = ["BarrierLoop", "BarrierStats"]
+__all__ = ["BarrierLoop", "BarrierStats", "BarrierPlane",
+           "EpochAllocator"]

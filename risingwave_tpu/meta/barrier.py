@@ -45,10 +45,19 @@ class BarrierWedgedError(RuntimeError):
 
 @dataclass
 class BarrierStats:
-    """Collected per-epoch latencies (meta barrier_latency metric analog)."""
+    """Collected per-epoch latencies (meta barrier_latency metric
+    analog). A multi-domain plane shares ONE stats object so the
+    aggregate list keeps its historical meaning (bench warm-trims
+    assign it in place); per-domain p99 lives on the PROFILER
+    (``EpochProfiler.p99_by_domain`` — ``drop_first`` trims it in
+    step with the aggregate), never here, so the two views cannot
+    desync."""
 
     completed_epochs: List[int] = field(default_factory=list)
     latencies_s: List[float] = field(default_factory=list)
+
+    def observe(self, latency_s: float, domain: str = "") -> None:
+        self.latencies_s.append(latency_s)
 
     def p99_latency_s(self) -> float:
         return exact_quantile(self.latencies_s, 0.99)
@@ -77,6 +86,9 @@ class EpochProfile:
     # uploading-window depth right after this epoch was submitted
     upload_s: float = 0.0
     queue_depth: int = 0
+    # alignment domain that ran this barrier ("" = the global domain —
+    # single-loop deployments and the stream_epoch_pipeline=off arm)
+    domain: str = ""
 
     @property
     def total_s(self) -> float:
@@ -84,7 +96,9 @@ class EpochProfile:
 
     def format(self) -> str:
         lines = [
-            f"epoch {self.epoch:#x} ({self.kind}): "
+            f"epoch {self.epoch:#x} "
+            f"({self.kind}"
+            f"{', domain ' + self.domain if self.domain else ''}): "
             f"inject→collect {self.inject_to_collect_s * 1e3:.2f}ms, "
             f"collect→commit {self.collect_to_commit_s * 1e3:.2f}ms, "
             f"in-flight {self.in_flight}"]
@@ -158,10 +172,11 @@ class EpochProfiler:
 
     def record(self, epoch: int, kind: str, inject_to_collect_s: float,
                collect_to_commit_s: float, in_flight: int,
-               collect_times: Dict[int, float]) -> EpochProfile:
+               collect_times: Dict[int, float],
+               domain: str = "") -> EpochProfile:
         prof = EpochProfile(epoch, kind, inject_to_collect_s,
                             collect_to_commit_s, in_flight,
-                            self._actor_row_deltas())
+                            self._actor_row_deltas(), domain=domain)
         if collect_times:
             slowest = max(collect_times, key=collect_times.get)
             prof.slowest_actor = slowest
@@ -183,14 +198,24 @@ class EpochProfiler:
 
     def rows(self) -> List[tuple]:
         """(epoch, kind, i2c, c2c, total, in_flight, slowest_actor,
-        slowest_lag, upload_s, queue_depth) per profiled barrier — the
-        rw_barrier_latency system-table payload (new columns appended
-        so existing positional consumers keep their indices)."""
+        slowest_lag, upload_s, queue_depth, domain) per profiled
+        barrier — the rw_barrier_latency system-table payload (new
+        columns appended so existing positional consumers keep their
+        indices)."""
         return [(p.epoch, p.kind, p.inject_to_collect_s,
                  p.collect_to_commit_s, p.total_s, p.in_flight,
                  p.slowest_actor, p.slowest_actor_lag_s,
-                 p.upload_s, p.queue_depth)
+                 p.upload_s, p.queue_depth, p.domain)
                 for p in self.profiles]
+
+    def p99_by_domain(self) -> Dict[str, float]:
+        """Per-domain p99 barrier total over the retained profiles —
+        the multi-MV bench lane's per-domain breakdown source (the
+        warmup trim via ``drop_first`` applies to this view too)."""
+        by: Dict[str, List[float]] = {}
+        for p in self.profiles:
+            by.setdefault(p.domain, []).append(p.total_s)
+        return {d: exact_quantile(v, 0.99) for d, v in by.items()}
 
     def report(self, last_n: int = 10) -> str:
         return "\n".join(p.format()
@@ -273,7 +298,11 @@ class BarrierLoop:
                  slow_barrier_threshold_s: float = 1.0,
                  max_uploading: int = 4,
                  collect_timeout_s: Optional[float] = None,
-                 distributed: bool = False):
+                 distributed: bool = False,
+                 domain: str = "",
+                 plane=None,
+                 stats: Optional[BarrierStats] = None,
+                 profiler: Optional[EpochProfiler] = None):
         self.local = local
         self.store = store
         self.interval_ms = interval_ms
@@ -281,6 +310,17 @@ class BarrierLoop:
         self.in_flight_barrier_nums = max(1, in_flight_barrier_nums)
         self.monotonic = monotonic
         self.sleep = sleep
+        # barrier-domain membership (ISSUE 13): under a BarrierPlane
+        # this loop drives ONE alignment domain — epochs mint from the
+        # plane's shared allocator (globally unique, always above the
+        # committed floor), barriers flow only through the domain's
+        # senders/actors, the store's seal fence advances at the
+        # cross-domain low watermark, and checkpoint submission is the
+        # plane's (cross-domain aligned) job. With plane=None the loop
+        # is exactly the historical global-lockstep engine — the
+        # stream_epoch_pipeline=off oracle arm.
+        self.domain = domain
+        self._plane = plane
         # distributed coordinator: actor work runs in worker processes,
         # so a sealed phase record covers only coordinator-side time
         # until drain_ledger merges the workers' accumulators —
@@ -291,8 +331,12 @@ class BarrierLoop:
         # fails to collect within the bound raises BarrierWedgedError
         # instead of wedging the whole control loop silently.
         self.collect_timeout_s = collect_timeout_s
-        self.stats = BarrierStats()
-        self.profiler = EpochProfiler(slow_barrier_threshold_s)
+        # a plane shares ONE stats/profiler across its domain loops so
+        # the aggregate surfaces (bench warm-trim, rw_barrier_latency)
+        # keep working; standalone loops own theirs as before
+        self.stats = stats if stats is not None else BarrierStats()
+        self.profiler = profiler if profiler is not None \
+            else EpochProfiler(slow_barrier_threshold_s)
         self._epoch: Optional[Epoch] = None
         self._barriers_since_checkpoint = 0
         self._inject_times: Dict[int, float] = {}
@@ -306,9 +350,17 @@ class BarrierLoop:
         # max_uploading — submit back-pressures, collection stalls,
         # the in-flight window fills, injection stops: total staging is
         # bounded by in_flight_barrier_nums + max_uploading epochs.
-        self.uploader = CheckpointUploader(
-            store, max_uploading=max_uploading, monotonic=monotonic,
-            on_commit=self._on_epoch_committed)
+        if plane is not None:
+            # ONE checkpoint pipeline per store: domains share the
+            # plane's uploader (the imm drain is cumulative — two
+            # uploaders on one store would race each other's builds),
+            # and submission happens only at cross-domain aligned
+            # checkpoints (the plane's decoupled cadence).
+            self.uploader = plane.uploader
+        else:
+            self.uploader = CheckpointUploader(
+                store, max_uploading=max_uploading, monotonic=monotonic,
+                on_commit=self._on_epoch_committed)
         self._upload_profiles: Dict[int, EpochProfile] = {}
         # previous epoch's collect stamp (wall monotonic): the phase
         # ledger starts each epoch's conservation interval here, so
@@ -325,7 +377,15 @@ class BarrierLoop:
 
     @property
     def committed_epoch(self) -> int:
+        if self._plane is not None:
+            return self.store.committed_epoch()
         return self._committed_epoch
+
+    def frontier_epoch(self) -> int:
+        """The newest epoch this loop issued (0 before the first
+        barrier) — reschedule/state-handoff paths read this instead of
+        poking the private cursor."""
+        return self._epoch.value if self._epoch is not None else 0
 
     @property
     def in_flight_count(self) -> int:
@@ -359,6 +419,12 @@ class BarrierLoop:
     def _next_kind(self, force_checkpoint: bool) -> BarrierKind:
         if self._epoch is None:
             return BarrierKind.INITIAL
+        if self._plane is not None:
+            # decoupled cadence: the plane alone decides when a durable
+            # checkpoint happens (a cross-domain aligned event); plain
+            # domain barriers never auto-promote on a local counter
+            return (BarrierKind.CHECKPOINT if force_checkpoint
+                    else BarrierKind.BARRIER)
         self._barriers_since_checkpoint += 1
         if force_checkpoint or (self._barriers_since_checkpoint
                                 >= self.checkpoint_frequency):
@@ -369,7 +435,15 @@ class BarrierLoop:
                      force_checkpoint: bool = False) -> Barrier:
         """Issue the next epoch and send its barrier to source actors."""
         kind = self._next_kind(force_checkpoint)
-        if self._epoch is None:
+        if self._plane is not None:
+            # shared allocator: globally-unique, monotone epochs above
+            # the committed floor — concurrent domains can never mint
+            # colliding epoch values or write under the seal fence
+            curr = self._plane.allocator.allocate(self.domain)
+            prev = self._epoch if self._epoch is not None \
+                else Epoch(self.store.committed_epoch())
+            pair = EpochPair(curr=curr, prev=prev)
+        elif self._epoch is None:
             curr = Epoch.now()
             # recovery: the initial barrier's prev is the committed epoch,
             # so state-table reads see the checkpointed data (recovery.rs)
@@ -399,7 +473,13 @@ class BarrierLoop:
         STREAMING.barrier_in_flight.set(len(self._in_flight))
         if kind.is_checkpoint:
             self._barriers_since_checkpoint = 0
-        await self.local.send_barrier(barrier)
+        if self._plane is not None:
+            sender_ids, expected = self._plane.scope(self.domain)
+            await self.local.send_barrier(barrier,
+                                          sender_ids=sender_ids,
+                                          expected=expected)
+        else:
+            await self.local.send_barrier(barrier)
         return barrier
 
     def advance_epoch_to(self, value: int) -> None:
@@ -407,6 +487,8 @@ class BarrierLoop:
         reschedule state handoff): the next barrier's curr will exceed
         it, so no in-flight flush can collide with the reserved epoch."""
         assert not self._in_flight, "advance with barriers in flight"
+        if self._plane is not None:
+            self._plane.allocator.reserve_to(value)
         if self._epoch is None or self._epoch.value < value:
             self._epoch = Epoch(value)
 
@@ -471,12 +553,20 @@ class BarrierLoop:
         # The INITIAL barrier has prev=INVALID: nothing to commit yet.
         prev = barrier.epoch.prev.value
         if prev > 0:
-            self.store.seal_epoch(prev, barrier.is_checkpoint)
+            if self._plane is not None:
+                # domain epochs interleave globally: the store's seal
+                # fence may only advance at the cross-domain low
+                # watermark (an eager per-domain seal would fence out
+                # a sibling domain's still-open epoch)
+                self._plane.allocator.note_ended(
+                    prev, barrier.is_checkpoint)
+            else:
+                self.store.seal_epoch(prev, barrier.is_checkpoint)
         t0 = self._inject_times.pop(epoch, None)
         prof = None
         if t0 is not None:
             lat = self.monotonic() - t0
-            self.stats.latencies_s.append(lat)
+            self.stats.observe(lat, self.domain)
             STREAMING.barrier_latency.observe(lat)
             collect_times = self.local.take_collect_times(epoch)
             prof = self.profiler.record(
@@ -485,18 +575,21 @@ class BarrierLoop:
                 inject_to_collect_s=t_collect - t0,
                 collect_to_commit_s=self.monotonic() - t_collect,
                 in_flight=len(self._in_flight),
-                collect_times=collect_times)
+                collect_times=collect_times,
+                domain=self.domain)
             if _spans.enabled():
                 now = time.time()
                 _spans.EPOCH_TRACER.record(
                     "barrier.collect", "barrier", epoch=epoch,
                     start_s=now - prof.total_s,
                     dur_s=prof.inject_to_collect_s,
-                    in_flight=prof.in_flight)
+                    in_flight=prof.in_flight,
+                    **({"domain": self.domain} if self.domain else {}))
                 _spans.EPOCH_TRACER.record(
                     "barrier.commit", "commit", epoch=epoch,
                     start_s=now - prof.collect_to_commit_s,
-                    dur_s=prof.collect_to_commit_s, kind=prof.kind)
+                    dur_s=prof.collect_to_commit_s, kind=prof.kind,
+                    **({"domain": self.domain} if self.domain else {}))
                 if prof.total_s >= self.profiler.slow_threshold_s:
                     # slow-barrier watchdog: the flight ring rolls in
                     # EPOCH_WINDOW barriers — promote the outlier's
@@ -547,20 +640,30 @@ class BarrierLoop:
                         or self.local.has_remote_participants(),
                         # mutation barriers (deploy/stop/reschedule)
                         # do topology work no phase claims — exempt
-                        warmup=barrier.mutation is not None)
+                        warmup=barrier.mutation is not None,
+                        domain=self.domain)
                 else:
                     _ledger.LEDGER.discard(epoch)
         if prev > 0 and barrier.is_checkpoint:
-            if prof is not None:
-                # registered BEFORE submit: the inline fallback commits
-                # inside submit and patches upload_s right away
-                self._upload_profiles[prev] = prof
-            if not await self.uploader.submit(prev):
-                # no flush needed (recovery-initial epoch): drop the
-                # registration or it pins the profile forever
-                self._upload_profiles.pop(prev, None)
-            if prof is not None:
-                prof.queue_depth = self.uploader.depth
+            if self._plane is not None:
+                # checkpoint durability is a CROSS-DOMAIN aligned
+                # event: this loop only reports its sealed prev; the
+                # plane submits ONE floor epoch to the shared uploader
+                # once every domain of the round has collected
+                self._plane.note_checkpoint_sealed(self.domain, prev,
+                                                   prof)
+            else:
+                if prof is not None:
+                    # registered BEFORE submit: the inline fallback
+                    # commits inside submit and patches upload_s right
+                    # away
+                    self._upload_profiles[prev] = prof
+                if not await self.uploader.submit(prev):
+                    # no flush needed (recovery-initial epoch): drop
+                    # the registration or it pins the profile forever
+                    self._upload_profiles.pop(prev, None)
+                if prof is not None:
+                    prof.queue_depth = self.uploader.depth
         if barrier.is_checkpoint:
             STREAMING.checkpoint_count.inc()
             # host-memory accounting/eviction sweep piggybacks on the

@@ -35,6 +35,19 @@ from risingwave_tpu.state.keycodec import (
 from risingwave_tpu.state.mem_table import KeyOp, MemTable
 from risingwave_tpu.state.store import StateStore
 
+# barrier-domain mode (meta/domains.py flips this on when a
+# BarrierPlane exists in the process; workers flip it on the first
+# domain-protocol inject): commit() then accepts the MONOTONE epoch
+# re-anchor a domain merge produces. Off (the default and the
+# single-loop oracle arm), strict prev == curr continuity is enforced
+# so a missed barrier fails at the fault. Sticky per process.
+MONOTONE_REANCHOR = False
+
+
+def allow_monotone_reanchor(on: bool = True) -> None:
+    global MONOTONE_REANCHOR
+    MONOTONE_REANCHOR = bool(on)
+
 
 class StateTable:
     """One logical table of operator state, partitioned by vnode."""
@@ -109,7 +122,22 @@ class StateTable:
         the checkpoint uploader.
         """
         assert self.epoch is not None, "init_epoch first"
-        assert new_epoch.prev == self.epoch.curr, (new_epoch, self.epoch)
+        if MONOTONE_REANCHOR:
+            # barrier-domain mode (meta/domains.py): ``>`` happens at
+            # a domain MERGE/re-anchor — the absorbed chain continues
+            # under the merged loop, whose prev is the larger
+            # frontier; the buffered writes still flush at the OLD
+            # curr, which stays under the cross-domain seal fence
+            # until the merged round ends it, so monotone re-anchoring
+            # is safe
+            assert new_epoch.prev.value >= self.epoch.curr.value, \
+                (new_epoch, self.epoch)
+        else:
+            # strict continuity (the single-loop/off arm): a prev
+            # mismatch means a missed barrier — fail at the fault,
+            # not at a later opaque sealed-write rejection
+            assert new_epoch.prev == self.epoch.curr, \
+                (new_epoch, self.epoch)
         keys, vals, epoch = self.flush()
         n = self.store.ingest_keyed(self.table_id, keys, vals, epoch)
         self.epoch = new_epoch

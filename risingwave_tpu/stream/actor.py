@@ -120,11 +120,24 @@ class Actor:
 
 
 class LocalBarrierManager:
-    """Collects per-actor barrier completions (barrier_manager.rs:119)."""
+    """Collects per-actor barrier completions (barrier_manager.rs:119).
+
+    Barrier-domain scoping (ISSUE 13): ``send_barrier`` optionally
+    targets a SUBSET of senders and expects a SUBSET of actors — one
+    alignment domain's slice of the deployed graph. The expected set is
+    captured PER EPOCH at send time, so concurrently-flowing barriers
+    of different domains collect independently (their epoch values are
+    globally unique — the shared EpochAllocator mints them). With no
+    scope arguments the behavior is exactly the historical global
+    alignment."""
 
     def __init__(self):
         self._barrier_senders: Dict[int, List[Sender]] = {}
         self._expected_actors: Set[int] = set()
+        # epoch -> the actor set THAT barrier waits on (None: use the
+        # global expected set — the unscoped legacy path). Popped with
+        # the epoch's teardown state, so scoped epochs never leak.
+        self._epoch_expected: Dict[int, Optional[Set[int]]] = {}
         self._collected: Dict[int, Set[int]] = {}   # epoch -> actor ids
         self._complete: Dict[int, asyncio.Event] = {}
         self._barriers: Dict[int, Barrier] = {}
@@ -155,15 +168,32 @@ class LocalBarrierManager:
         self._expected_actors = set(actor_ids)
 
     # -- inject/collect (the InjectBarrier/BarrierComplete analog) -----
-    async def send_barrier(self, barrier: Barrier) -> None:
+    def _expected_for(self, epoch: int) -> Set[int]:
+        exp = self._epoch_expected.get(epoch)
+        return self._expected_actors if exp is None else exp
+
+    async def send_barrier(self, barrier: Barrier,
+                           sender_ids: Optional[Sequence[int]] = None,
+                           expected: Optional[Sequence[int]] = None
+                           ) -> None:
+        """Send one barrier; with ``sender_ids``/``expected`` it flows
+        only through that domain's senders and completes when that
+        domain's actors collected it."""
         epoch = barrier.epoch.curr.value
         self._collected.setdefault(epoch, set())
         ev = self._complete.setdefault(epoch, asyncio.Event())
         self._barriers[epoch] = barrier
-        for senders in self._barrier_senders.values():
+        exp = None if expected is None else set(expected)
+        self._epoch_expected[epoch] = exp
+        if sender_ids is None:
+            targets = list(self._barrier_senders.values())
+        else:
+            targets = [self._barrier_senders[a] for a in sender_ids
+                       if a in self._barrier_senders]
+        for senders in targets:
             for s in senders:
                 await s.send(barrier)
-        if not self._expected_actors:
+        if not self._expected_for(epoch):
             ev.set()        # zero actors: the epoch completes trivially
 
     def collect(self, actor_id: int, barrier: Barrier) -> None:
@@ -173,7 +203,8 @@ class LocalBarrierManager:
         self._collect_times.setdefault(epoch, {})[actor_id] = \
             time.monotonic()
         ev = self._complete.setdefault(epoch, asyncio.Event())
-        if self._expected_actors and got >= self._expected_actors:
+        exp = self._expected_for(epoch)
+        if exp and got >= exp:
             ev.set()
 
     def take_collect_times(self, epoch: int) -> Dict[int, float]:
@@ -206,10 +237,12 @@ class LocalBarrierManager:
             self._complete.pop(epoch, None)
             self._collect_times.pop(epoch, None)
             self._barriers.pop(epoch, None)
+            self._epoch_expected.pop(epoch, None)
             raise RuntimeError(
                 f"actor failure during epoch {epoch:#x}") from self._failed
         self._collected.pop(epoch, None)
         self._complete.pop(epoch, None)
+        self._epoch_expected.pop(epoch, None)
         self._last_collect = (epoch, self._collect_times.pop(epoch, {}))
         return self._barriers.pop(epoch)
 
@@ -217,6 +250,10 @@ class LocalBarrierManager:
         self._expected_actors.discard(actor_id)
         self._barrier_senders.pop(actor_id, None)
         _remove_actor_series(actor_id)
+        for exp in self._epoch_expected.values():
+            if exp is not None:
+                exp.discard(actor_id)
         for epoch, got in self._collected.items():
-            if self._expected_actors and got >= self._expected_actors:
+            exp = self._expected_for(epoch)
+            if exp and got >= exp:
                 self._complete[epoch].set()

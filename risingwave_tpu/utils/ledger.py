@@ -193,13 +193,20 @@ class LedgerRecord:
 
     __slots__ = ("epoch", "kind", "interval_s", "seconds", "h2d_bytes",
                  "d2h_bytes", "warmup", "distributed", "workers",
-                 "idle_max")
+                 "idle_max", "domain")
 
     def __init__(self, epoch: int, kind: str, interval_s: float,
                  seconds: Dict[str, float], h2d_bytes: int,
-                 d2h_bytes: int, warmup: bool, distributed: bool):
+                 d2h_bytes: int, warmup: bool, distributed: bool,
+                 domain: str = ""):
         self.epoch = epoch
         self.kind = kind
+        # barrier domain whose loop sealed this epoch ("" = global):
+        # domains partition wall time INDEPENDENTLY — two domains'
+        # records legitimately cover the same wall-clock second, and
+        # conservation holds per record because epochs are domain-
+        # unique (the shared allocator)
+        self.domain = domain
         self.interval_s = interval_s
         self.seconds = seconds          # includes UNATTRIBUTED
         self.h2d_bytes = h2d_bytes
@@ -240,6 +247,7 @@ class LedgerRecord:
 
     def to_dict(self) -> dict:
         return {"epoch": self.epoch, "kind": self.kind,
+                "domain": self.domain,
                 "interval_s": self.interval_s,
                 "seconds": dict(self.seconds),
                 "h2d_bytes": self.h2d_bytes,
@@ -402,13 +410,18 @@ class PhaseLedger:
     # -- sealing -------------------------------------------------------
     def seal(self, epoch: int, interval_s: float, kind: str = "barrier",
              distributed: bool = False,
-             warmup: bool = False) -> Optional[LedgerRecord]:
+             warmup: bool = False,
+             domain: str = "") -> Optional[LedgerRecord]:
         """Close the epoch's books against its measured barrier
         interval: residual → ``unattributed``, publish the Prometheus
         phase family, the trace phase lanes + counter tracks, and the
         rw_metrics_history row. ``warmup=True`` force-exempts the
         epoch from the conservation gate (callers pass it for
-        mutation/topology barriers — deploy work is not epoch work)."""
+        mutation/topology barriers — deploy work is not epoch work).
+        ``domain`` keys the record (and its history row) by the barrier
+        domain that ran the epoch — overlapped domains each partition
+        their OWN wall timeline, so per-record conservation survives
+        the compute/ingest overlap."""
         if not _ENABLED:
             self._open.pop(epoch, None)
             return None
@@ -426,7 +439,7 @@ class PhaseLedger:
         rec = LedgerRecord(epoch, kind, float(interval_s),
                            seconds, acc.h2d_bytes,
                            acc.d2h_bytes, acc.warmup or warmup,
-                           distributed)
+                           distributed, domain=domain)
         rec.idle_max = idle
         rec.recompute_unattributed()
         self.records.append(rec)
@@ -451,7 +464,8 @@ class PhaseLedger:
         extra["coverage"] = rec.coverage()
         extra["epoch_h2d_bytes"] = float(rec.h2d_bytes)
         extra["epoch_d2h_bytes"] = float(rec.d2h_bytes)
-        HISTORY.observe(rec.epoch, rec.interval_s, extra=extra)
+        HISTORY.observe(rec.epoch, rec.interval_s, extra=extra,
+                        domain=rec.domain)
         if not _spans.enabled():
             return
         now = time.time()
@@ -466,7 +480,8 @@ class PhaseLedger:
             _spans.EPOCH_TRACER.record(
                 f"phase.{name}", "phase", epoch=rec.epoch, start_s=at,
                 dur_s=s, share=round(s / rec.interval_s, 4)
-                if rec.interval_s > 0 else 0.0)
+                if rec.interval_s > 0 else 0.0,
+                **({"domain": rec.domain} if rec.domain else {}))
             at += s
         # counter-track sample (export_chrome renders 'C' events)
         _spans.EPOCH_TRACER.record(
@@ -479,8 +494,10 @@ class PhaseLedger:
 
     # -- conservation gate ---------------------------------------------
     def gate_violations(self) -> List[tuple]:
-        """(epoch, interval_s, unattributed_s, coverage) per sealed
-        steady-state epoch over budget — the tier-1 strict-mode gate."""
+        """(epoch, interval_s, unattributed_s, coverage, domain) per
+        sealed steady-state epoch over budget — the tier-1 strict-mode
+        gate, domain-keyed so a multi-domain violation names the
+        alignment domain whose books leaked."""
         out = []
         for rec in self.records:
             if rec.warmup or rec.distributed:
@@ -491,7 +508,7 @@ class PhaseLedger:
             if resid > max(self.GATE_RESIDUAL_FRAC * rec.interval_s,
                            self.GATE_RESIDUAL_MIN_S):
                 out.append((rec.epoch, rec.interval_s, resid,
-                            rec.coverage()))
+                            rec.coverage(), rec.domain))
         return out
 
     # -- cross-process merge (cluster drain, like spans.drain_dicts) ---
@@ -578,12 +595,16 @@ class PhaseLedger:
     # coverage statistics (still counted, still summed into phases)
     MICRO_EPOCH_S = 0.005
 
-    def phase_breakdown(self, steady_only: bool = True) -> dict:
+    def phase_breakdown(self, steady_only: bool = True,
+                        domain: Optional[str] = None) -> dict:
         """Aggregate share view over sealed epochs (bench's per-query
         ``phase_breakdown`` block and the ``ctl phases`` totals).
-        ``steady_only`` drops warmup (compile-bearing) epochs."""
+        ``steady_only`` drops warmup (compile-bearing) epochs;
+        ``domain`` restricts to one barrier domain's records (the
+        per-domain bench breakdown)."""
         recs = [r for r in self.records
-                if not (steady_only and r.warmup)]
+                if not (steady_only and r.warmup)
+                and (domain is None or r.domain == domain)]
         if not recs:
             return {"epochs": 0}
         total = sum(r.interval_s for r in recs)
@@ -606,6 +627,15 @@ class PhaseLedger:
             "h2d_bytes": int(sum(r.h2d_bytes for r in recs)),
             "d2h_bytes": int(sum(r.d2h_bytes for r in recs)),
         }
+
+    def domains_seen(self) -> List[str]:
+        """Distinct barrier domains among the sealed records (bench's
+        per-domain breakdown iterates these)."""
+        seen: List[str] = []
+        for r in self.records:
+            if r.domain not in seen:
+                seen.append(r.domain)
+        return seen
 
     def report(self, last_n: int = 16) -> str:
         """Human-readable per-epoch table (``ctl phases``)."""

@@ -536,7 +536,8 @@ class MetricsHistory:
         )
 
     def observe(self, epoch: int, interval_s: float,
-                extra: Optional[Dict[str, float]] = None) -> None:
+                extra: Optional[Dict[str, float]] = None,
+                domain: str = "") -> None:
         values: Dict[str, float] = {}
         for name, fn, kind in self._tracked():
             v = float(fn())
@@ -550,19 +551,27 @@ class MetricsHistory:
         with self._lock:
             self._seq += 1
             self._ring.append((self._seq, int(epoch), time.time(),
-                               float(interval_s), values))
+                               float(interval_s), values, domain))
 
     def rows(self) -> List[tuple]:
-        """(seq, epoch, ts, interval_s, name, value) long-format rows
-        — the rw_metrics_history system-table payload."""
+        """(seq, epoch, ts, interval_s, name, value, domain)
+        long-format rows — the rw_metrics_history system-table
+        payload. ``domain`` names the barrier domain whose seal
+        produced the row ("" = the global domain), so the ROADMAP-3
+        autoscaler can see WHICH domain is behind, not just the
+        cluster aggregate."""
         with self._lock:
             snap = list(self._ring)
         out = []
-        for seq, epoch, ts, interval_s, values in snap:
+        for seq, epoch, ts, interval_s, values, domain in snap:
             for name in sorted(values):
                 out.append((seq, epoch, ts, interval_s, name,
-                            float(values[name])))
+                            float(values[name]), domain))
         return out
+
+    def domain_rows(self, domain: str) -> List[tuple]:
+        """The rows of one barrier domain (autoscaler convenience)."""
+        return [r for r in self.rows() if r[6] == domain]
 
     def barriers(self) -> int:
         return len(self._ring)

@@ -404,6 +404,8 @@ EPOCH_TRACER = EpochTracer()
 
 from contextlib import contextmanager as _contextmanager
 
+from risingwave_tpu.utils import failpoint as _failpoint
+
 
 @_contextmanager
 def dispatch_span(kernel: str, rows: float, **args):
@@ -426,6 +428,13 @@ def dispatch_span(kernel: str, rows: float, **args):
     try:
         with _ledger.LEDGER.phase("device_compute", kernel=kernel) \
                 if _ledger.enabled() else nullcontext():
+            # ledger-test seam: a sleep spec here is wall time INSIDE
+            # one kernel's dispatch — it must land in the dispatching
+            # domain's device_compute books only (the per-domain
+            # overlap oracle). Guarded so the unarmed hot path pays
+            # one dict-truthiness check, not an f-string per dispatch.
+            if _failpoint._ARMED:
+                _failpoint.fail_point(f"ledger.dispatch.{kernel}")
             yield
     finally:
         if _ENABLED:

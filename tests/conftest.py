@@ -100,8 +100,8 @@ def _conservation_gate():
         "epoch phase ledger conservation gate (tier-1 strict mode): "
         "steady-state epochs carried unattributed wall-clock over "
         "budget — an uninstrumented stall crept into the barrier "
-        "path. (epoch, interval_s, unattributed_s, coverage): "
-        f"{[(hex(e), round(i, 3), round(u, 3), c) for e, i, u, c in violations]}")
+        "path. (epoch, interval_s, unattributed_s, coverage, domain): "
+        f"{[(hex(e), round(i, 3), round(u, 3), c, d) for e, i, u, c, d in violations]}")
 
 
 def _worker_children() -> list:

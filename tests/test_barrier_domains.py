@@ -1,0 +1,290 @@
+"""Pipelined epochs (ISSUE 13): barrier domains, overlap attribution,
+decoupled checkpoint cadence, and the off-arm oracle.
+
+Covers the in-process session plane: domain derivation by dataflow
+reachability (disjoint sources → own domains; shared sources / MV deps
+→ joined; live merge via a bridging MV), per-domain latency surfaces
+(rw_barrier_latency / rw_metrics_history domain columns), the
+sleep-failpoint overlap oracle (a stalled device dispatch lands in the
+slow DOMAIN's device_compute books only, with the conservation gate
+green in both domains), checkpoint cadence decoupled from barrier
+cadence, and bit-identical results between stream_epoch_pipeline on
+and off. The distributed plane's chaos coverage lives in
+tests/test_chaos.py.
+"""
+
+import asyncio
+
+import pytest
+
+from risingwave_tpu.frontend.session import Frontend
+
+BID_SOURCE = (
+    "CREATE SOURCE {name} WITH (connector='nexmark', "
+    "nexmark.table.type='bid', nexmark.event.num={n}, "
+    "nexmark.max.chunk.size=256, nexmark.generate.strings='false')")
+
+AGG_MV = (
+    "CREATE MATERIALIZED VIEW {mv} AS SELECT auction, "
+    "COUNT(*) AS cnt, MAX(price) AS max_price FROM {src} "
+    "GROUP BY auction")
+
+EVENTS = 4000
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+async def _two_domain_session(n=EVENTS, pipeline=True):
+    fe = Frontend(rate_limit=8, min_chunks=8, epoch_pipeline=pipeline)
+    await fe.execute(BID_SOURCE.format(name="bid_a", n=n))
+    await fe.execute(BID_SOURCE.format(name="bid_b", n=n))
+    await fe.execute(AGG_MV.format(mv="mv_a", src="bid_a"))
+    await fe.execute(AGG_MV.format(mv="mv_b", src="bid_b"))
+    return fe
+
+
+def test_disjoint_mvs_get_their_own_domains():
+    """Two MVs over disjoint sources align independently; a third MV
+    over a shared source joins the existing domain; dropping it
+    retires nothing while the domain still has a job."""
+    async def run():
+        fe = await _two_domain_session()
+        domains = {d["domain"]: d for d in fe.loop.describe()}
+        assert set(domains) == {"mv_a", "mv_b"}
+        assert domains["mv_a"]["jobs"] == ["mv_a"]
+        # shared-source fan-out stays joined
+        await fe.execute(AGG_MV.format(mv="mv_a2", src="bid_a"))
+        domains = {d["domain"]: sorted(d["jobs"])
+                   for d in fe.loop.describe()}
+        assert domains["mv_a"] == ["mv_a", "mv_a2"]
+        assert domains["mv_b"] == ["mv_b"]
+        await fe.execute("DROP MATERIALIZED VIEW mv_a2")
+        domains = {d["domain"]: sorted(d["jobs"])
+                   for d in fe.loop.describe()}
+        assert domains["mv_a"] == ["mv_a"]
+        await fe.step(3)
+        rows_a = await fe.execute("SELECT COUNT(*) FROM mv_a")
+        rows_b = await fe.execute("SELECT COUNT(*) FROM mv_b")
+        await fe.close()
+        return rows_a, rows_b
+
+    rows_a, rows_b = _run(run())
+    assert rows_a[0][0] > 0 and rows_b[0][0] > 0
+
+
+def test_bridging_mv_merges_live_domains_and_stays_exact():
+    """A new MV reading BOTH sources merges the two live domains (the
+    monotone epoch re-anchor): results on every MV stay exact vs the
+    off-arm oracle."""
+    async def run(pipeline):
+        fe = await _two_domain_session(pipeline=pipeline)
+        await fe.step(3)         # both domains flow before the merge
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW bridge AS SELECT a.auction, "
+            "a.cnt AS ca, b.cnt AS cb FROM mv_a AS a "
+            "JOIN mv_b AS b ON a.auction = b.auction")
+        if pipeline:
+            domains = {d["domain"]: sorted(d["jobs"])
+                       for d in fe.loop.describe()}
+            assert len(domains) == 1, domains
+            only = next(iter(domains.values()))
+            assert only == ["bridge", "mv_a", "mv_b"]
+        await fe.step(6)
+        out = {}
+        for mv in ("mv_a", "mv_b", "bridge"):
+            out[mv] = {tuple(r) for r in
+                       await fe.execute(f"SELECT * FROM {mv}")}
+        await fe.close()
+        return out
+
+    on = _run(run(True))
+    off = _run(run(False))
+    assert on == off
+    assert len(on["bridge"]) > 0
+
+
+def test_on_off_arms_bit_identical():
+    """stream_epoch_pipeline=off reproduces the plane's results
+    bit-identically on a disjoint 2-MV deploy."""
+    async def run(pipeline):
+        fe = await _two_domain_session(pipeline=pipeline)
+        await fe.step(8)
+        a = {tuple(r) for r in await fe.execute("SELECT * FROM mv_a")}
+        b = {tuple(r) for r in await fe.execute("SELECT * FROM mv_b")}
+        await fe.close()
+        return a, b
+
+    assert _run(run(True)) == _run(run(False))
+
+
+def test_epoch_pipeline_set_var_guarded():
+    """SET stream_epoch_pipeline flips the engine when idle and
+    refuses with live jobs."""
+    from risingwave_tpu.frontend.planner import PlanError
+    from risingwave_tpu.meta.barrier import BarrierLoop
+    from risingwave_tpu.meta.domains import BarrierPlane
+
+    async def run():
+        fe = Frontend()
+        assert isinstance(fe.loop, BarrierPlane)
+        await fe.execute("SET stream_epoch_pipeline = 'off'")
+        assert isinstance(fe.loop, BarrierLoop)
+        await fe.execute("SET stream_epoch_pipeline = 'on'")
+        assert isinstance(fe.loop, BarrierPlane)
+        await fe.execute(BID_SOURCE.format(name="bid_a", n=256))
+        await fe.execute(AGG_MV.format(mv="mv_a", src="bid_a"))
+        with pytest.raises(PlanError):
+            await fe.execute("SET stream_epoch_pipeline = 'off'")
+        assert isinstance(fe.loop, BarrierPlane)
+        await fe.close()
+
+    _run(run())
+
+
+def test_domain_latency_surfaces_over_sql():
+    """rw_barrier_latency and rw_metrics_history carry the domain
+    column; each domain's epochs appear under its own key."""
+    async def run():
+        fe = await _two_domain_session()
+        await fe.step(4)
+        lat = await fe.execute("SELECT * FROM rw_barrier_latency")
+        hist = await fe.execute("SELECT * FROM rw_metrics_history")
+        p99 = fe.loop.p99_by_domain()
+        await fe.close()
+        return lat, hist, p99
+
+    lat, hist, p99 = _run(run())
+    lat_domains = {r[10] for r in lat}
+    assert {"mv_a", "mv_b"} <= lat_domains, lat_domains
+    hist_domains = {r[6] for r in hist}
+    assert {"mv_a", "mv_b"} <= hist_domains, hist_domains
+    # per-domain barrier_wait/phase rows exist for the autoscaler
+    names = {r[4] for r in hist if r[6] == "mv_a"}
+    assert "phase.barrier_wait" in names
+    assert set(p99) >= {"mv_a", "mv_b"}
+    assert all(v >= 0 for v in p99.values())
+
+
+def test_overlap_ledger_slow_dispatch_stays_in_its_domain():
+    """The overlap oracle (ISSUE 13 satellite): a sleep failpoint
+    INSIDE one domain's device dispatch lands in that domain's
+    device_compute books only — the sibling domain's epochs stay
+    short (its barrier_wait cannot absorb the stall), and the
+    conservation gate stays green in both domains."""
+    from risingwave_tpu.utils.failpoint import arm_specs
+    from risingwave_tpu.utils.ledger import LEDGER
+
+    SLEEP_S = 0.6
+
+    async def run():
+        fe = await _two_domain_session()
+        await fe.step(2)          # warm: compiles land outside
+        # both domains' fused agg steps share one dispatch identity
+        # (the planner's node-actor label): arm ONE firing — exactly
+        # one domain's dispatch absorbs the stall; detect which below
+        slow_aid = fe.catalog.mvs["mv_a"].actor_id
+        ident = None
+
+        def find(ex):
+            nonlocal ident
+            if "HashAgg" in getattr(ex, "identity", ""):
+                ident = ex.identity
+            for child in getattr(ex, "children", []):
+                find(child)
+        find(fe.actors[slow_aid].consumer)
+        assert ident is not None
+        # small epochs dispatch at the barrier flush (the .flush
+        # label) — ONE firing total, so exactly one domain stalls
+        points = {f"ledger.dispatch.{ident}.flush": {
+            "sleep_s": SLEEP_S, "times": 1}}
+        arm_specs(points)
+        try:
+            await fe.step(2)
+        finally:
+            arm_specs({k: None for k in points})
+        recs = list(LEDGER.records)
+        await fe.close()
+        return recs
+
+    recs = _run(run())
+    by_dom = {}
+    for r in recs:
+        if r.domain in ("mv_a", "mv_b") and not r.warmup:
+            by_dom.setdefault(r.domain, []).append(r)
+    assert set(by_dom) == {"mv_a", "mv_b"}
+    # exactly ONE domain's epoch carries the stall AS device_compute
+    # (≥ 80% of the sleep inside its own books)
+    hit_doms = {d for d, rs in by_dom.items()
+                if any(r.seconds.get("device_compute", 0.0)
+                       >= SLEEP_S * 0.8 for r in rs)}
+    assert len(hit_doms) == 1, {
+        d: [(r.interval_s, r.seconds) for r in rs]
+        for d, rs in by_dom.items()}
+    fast_dom = ({"mv_a", "mv_b"} - hit_doms).pop()
+    # the sibling's concurrent epoch shares the frozen wall clock (a
+    # blocking CPU dispatch stalls the single event loop — the same
+    # physics as a real slow CPU kernel), but its books NEVER claim
+    # the stall as work: no phantom device_compute, no unattributed
+    # rot — the shared wall shows up as barrier-parked sources only
+    for r in by_dom[fast_dom]:
+        assert r.seconds.get("device_compute", 0.0) < SLEEP_S * 0.2, \
+            (r.interval_s, r.seconds)
+        assert r.unattributed_s < max(
+            0.1, 0.3 * r.interval_s), (r.interval_s, r.seconds)
+    # conservation green in BOTH domains (the autouse gate re-checks
+    # at teardown; assert explicitly for the record)
+    assert LEDGER.gate_violations() == []
+
+
+def test_checkpoint_cadence_decoupled_from_barriers():
+    """stream_checkpoint_frequency=k: plain rounds advance per-domain
+    without committing; every k-th round is an aligned checkpoint that
+    advances the durable floor."""
+    async def run():
+        fe = await _two_domain_session(n=8000)
+        await fe.execute("SET stream_checkpoint_frequency = 4")
+        base = fe.store.committed_epoch()
+        committed = []
+        for _ in range(8):
+            await fe.loop.inject_and_collect(drain_uploader=False)
+            committed.append(fe.store.committed_epoch())
+        await fe.close()
+        return base, committed
+
+    base, committed = _run(run())
+    # rounds 1-3 plain (floor parked), round 4 commits, 5-7 plain,
+    # round 8 commits again
+    assert committed[0] == base
+    assert committed[2] == base
+    assert committed[3] > base
+    assert committed[6] == committed[3]
+    assert committed[7] > committed[3]
+
+
+def test_plane_pipelined_driver_and_drive():
+    """The plane's inject/collect facade pipelines per-domain windows
+    and drive() pumps domains independently to completion."""
+    async def run():
+        fe = await _two_domain_session(n=6000)
+        readers = [r for d in fe.readers.values()
+                   for r in d.values()]
+        expected = 2 * (6000 * 46 // 50)
+
+        def rows_seen():
+            return sum(r.offset for r in readers)
+
+        await fe.loop.drive(lambda: rows_seen() >= expected,
+                            in_flight=2, progress_fn=rows_seen)
+        assert rows_seen() == expected
+        # every domain drained its window
+        assert fe.loop.in_flight_count == 0
+        await fe.step(1)     # final aligned checkpoint
+        a = await fe.execute("SELECT COUNT(*) FROM mv_a")
+        b = await fe.execute("SELECT COUNT(*) FROM mv_b")
+        await fe.close()
+        return a, b
+
+    a, b = _run(run())
+    assert a[0][0] > 0 and b[0][0] > 0

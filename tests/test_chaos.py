@@ -308,3 +308,95 @@ def test_recovery_storm_is_loud_and_terminal(tmp_path):
             await fe.close()
 
     assert asyncio.run(run())
+
+
+# -- barrier-domain chaos (ISSUE 13 satellite) ---------------------------
+
+SRC_B = ("CREATE SOURCE bid2 WITH (connector='nexmark', "
+         "nexmark.table.type='bid', nexmark.event.num={n}, "
+         "nexmark.max.chunk.size=256, "
+         "nexmark.min.event.gap.in.ns=60000000)")
+MV_B = ("CREATE MATERIALIZED VIEW q7b AS "
+        "SELECT window_start, MAX(price) AS max_price, "
+        "COUNT(*) AS cnt "
+        "FROM TUMBLE(bid2, date_time, INTERVAL '10' SECOND) "
+        "GROUP BY window_start")
+
+
+def _oracle_two():
+    async def run():
+        fe = Frontend(min_chunks=8)
+        await fe.execute(SRC.format(n=EVENTS))
+        await fe.execute(MV)
+        await fe.execute(SRC_B.format(n=EVENTS))
+        await fe.execute(MV_B)
+        await fe.step(35)
+        a = {tuple(r) for r in await fe.execute("SELECT * FROM q7")}
+        b = {tuple(r) for r in await fe.execute("SELECT * FROM q7b")}
+        await fe.close()
+        return a, b
+
+    return asyncio.run(run())
+
+
+def test_two_domain_chaos_converges_and_realigns(tmp_path):
+    """ISSUE 13 chaos satellite: a 2-domain deploy (two MVs on
+    disjoint sources → independent barrier domains) survives one
+    seeded schedule of worker SIGKILL + straggler failpoint; both MVs
+    converge bit-identical to the fault-free oracle, and every
+    recovery re-aligns BOTH domains to the same committed checkpoint
+    floor (each rebuilt domain's first barrier recovers
+    prev = committed)."""
+    exp_a, exp_b = _oracle_two()
+
+    async def run():
+        fe = DistFrontend(str(tmp_path), n_workers=2, parallelism=2,
+                          barrier_timeout_s=8.0)
+        await fe.start()
+        try:
+            await fe.execute(SRC.format(n=EVENTS))
+            await fe.execute(MV)
+            await fe.execute(SRC_B.format(n=EVENTS))
+            await fe.execute(MV_B)
+            plane = fe.cluster._plane
+            assert plane is not None
+            assert sorted(d for d in plane.domains() if d) \
+                == ["q7", "q7b"]
+            report = await run_chaos(
+                fe, seed=11, kinds=["kill_worker", "straggler"],
+                settle_steps=60)
+            # both induced faults produced classified recoveries
+            causes = sorted(c for c, _a in report.recoveries)
+            assert causes == ["dead_worker", "wedged_barrier"], causes
+            # the plane rebuilt the SAME 2-domain shape after recovery
+            plane = fe.cluster._plane
+            assert sorted(d for d in plane.domains() if d) \
+                == ["q7", "q7b"]
+            # re-alignment proof: drain, then observe each domain's
+            # next barrier anchored at ONE shared committed floor
+            async with fe._barrier_lock:
+                await fe.cluster.loop.inject_and_collect(
+                    force_checkpoint=True)
+                floor = fe.cluster.store.committed_epoch()
+                doms = [d for n, d in plane._domains.items() if n]
+                barriers = [await d.loop.inject(force_checkpoint=True)
+                            for d in doms]
+                for d in doms:
+                    while d.loop.in_flight_count:
+                        await d.loop.collect_next()
+                await plane._maybe_submit()
+                assert all(b.epoch.prev.value >= floor
+                           for b in barriers)
+                # prevs are the per-domain frontiers — all sealed at or
+                # above the floor every domain re-anchored to
+            rows_a = {tuple(r)
+                      for r in await fe.execute("SELECT * FROM q7")}
+            rows_b = {tuple(r)
+                      for r in await fe.execute("SELECT * FROM q7b")}
+            return rows_a, rows_b
+        finally:
+            await fe.close()
+
+    rows_a, rows_b = asyncio.run(run())
+    assert rows_a == exp_a
+    assert rows_b == exp_b

@@ -381,3 +381,48 @@ def test_dist_rescale_parallelism_sql(tmp_path):
                                "SELECT * FROM q7")
     assert got == expect
     assert len(got) > 2
+
+
+def test_dist_rescale_in_shared_domain(tmp_path):
+    """ISSUE 13 regression: rescaling a job that SHARES its barrier
+    domain with another live job (two MVs on one source) must not
+    abort — the redeployed job rejoins the live domain, whose cursor
+    re-anchors monotonely past the handoff epochs, and BOTH MVs stay
+    oracle-exact."""
+    MV2 = ("CREATE MATERIALIZED VIEW q7cnt AS "
+           "SELECT auction, COUNT(*) AS cnt FROM bid "
+           "GROUP BY auction")
+
+    async def run():
+        fe = DistFrontend(str(tmp_path), n_workers=2, parallelism=2)
+        await fe.start()
+        try:
+            for s in Q7ISH_SOURCES:
+                await fe.execute(s.format(n=EVENTS))
+            await fe.execute(Q7ISH_MV)
+            await fe.execute(MV2)
+            plane = fe.cluster._plane
+            assert plane is not None
+            # shared source ⇒ one live domain holding both jobs
+            dom = plane.domain_of_job("q7")
+            assert plane.domain_of_job("q7cnt") == dom
+            await fe.step(6)
+            await fe.execute(
+                "ALTER MATERIALIZED VIEW q7 SET PARALLELISM = 1")
+            assert plane.domain_of_job("q7") == \
+                plane.domain_of_job("q7cnt")
+            await fe.step(30)
+            a = {tuple(r)
+                 for r in await fe.execute("SELECT * FROM q7")}
+            b = {tuple(r)
+                 for r in await fe.execute("SELECT * FROM q7cnt")}
+            return a, b
+        finally:
+            await fe.close()
+
+    a, b = asyncio.run(run())
+    assert a == _inprocess_oracle(Q7ISH_SOURCES, Q7ISH_MV,
+                                  "SELECT * FROM q7")
+    assert b == _inprocess_oracle(Q7ISH_SOURCES, MV2,
+                                  "SELECT * FROM q7cnt")
+    assert len(a) > 2 and len(b) > 2
