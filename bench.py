@@ -127,6 +127,11 @@ def _metrics_snapshot(loop) -> dict:
         "exchange_backpressure_s": round(
             sum(v for _l, v in
                 STREAMING.exchange_backpressure.series()), 5),
+        # sender-side credit park time (ISSUE 14): the half of
+        # exchange backpressure now subtracted from executor busy
+        "backpressure_wait_s": round(
+            sum(v for _l, v in
+                STREAMING.backpressure_wait.series()), 5),
         "executor_rows": int(
             sum(v for _l, v in STREAMING.executor_rows.series())),
         "executor_busy_s": round(
@@ -139,6 +144,8 @@ def _metrics_snapshot(loop) -> dict:
 
 
 def _result(metric, elapsed, rows, loop, plan=None):
+    from risingwave_tpu.stream.bottleneck import BOTTLENECKS
+    from risingwave_tpu.stream.freshness import FRESHNESS
     from risingwave_tpu.utils.ledger import LEDGER
 
     # per-lane platform from the LIVE backend (never a literal): a
@@ -161,6 +168,14 @@ def _result(metric, elapsed, rows, loop, plan=None):
         # compiles are marked and excluded), with conservation
         # coverage and exact transfer bytes
         "phase_breakdown": LEDGER.phase_breakdown(),
+        # per-MV event-time freshness (ISSUE 14): per-barrier lag
+        # percentiles over the measured run — what a reader of the MV
+        # experienced, next to what the pipeline cost
+        "freshness": FRESHNESS.summary(),
+        # bottleneck walker verdict at end of run: the operator each
+        # domain's capacity change should target, with its streak and
+        # the ledger cross-check baked into the diagnosis
+        "bottleneck": BOTTLENECKS.summary(),
     }
     if plan is not None:
         out["plan"] = plan
@@ -203,7 +218,8 @@ def bench_q1(total_events: int = 50 * 4000, chunk_size: int = 4096):
 
 
 def bench_q7(total_events: int = 50 * 40_000, chunk_size: int = 8192,
-             fusion: bool = False, ledger: bool = True):
+             fusion: bool = False, ledger: bool = True,
+             tricolor: bool = True):
     """q7 core: tumble-window MAX(price) on the device hash-agg kernel.
 
     The stateful baseline config (BASELINE.md: HashAgg on TPU, ≥1M
@@ -211,15 +227,21 @@ def bench_q7(total_events: int = 50 * 40_000, chunk_size: int = 8192,
     retirement is ON, so the number reflects bounded state, not a
     forever-growing table (VERDICT r2 weak #2). ``ledger=False`` is
     the phase-ledger-off arm (ISSUE 11 acceptance: ledger-on
-    throughput within 5% of ledger-off on q7 CPU) — each query runs
-    in its own subprocess, so the toggle never leaks across lanes."""
+    throughput within 5% of ledger-off on q7 CPU); ``tricolor=False``
+    is the utilization-tricolor/freshness-off arm (ISSUE 14: on-vs-off
+    within 5%) — each query runs in its own subprocess, so the toggles
+    never leak across lanes."""
     from risingwave_tpu.common.types import Interval
     from risingwave_tpu.connectors.nexmark import NexmarkConfig
     from risingwave_tpu.models.nexmark import build_q7, drive_to_completion
     from risingwave_tpu.state.store import MemoryStateStore
+    from risingwave_tpu.stream import freshness as freshness_mod
+    from risingwave_tpu.stream import monitor as monitor_mod
     from risingwave_tpu.utils import ledger as ledger_mod
 
     ledger_mod.set_enabled(ledger)
+    monitor_mod.set_tricolor(tricolor)
+    freshness_mod.set_enabled(tricolor)
     cfg = NexmarkConfig(event_num=total_events, max_chunk_size=chunk_size,
                         generate_strings=False)
     p = build_q7(MemoryStateStore(), cfg, rate_limit=32, min_chunks=32,
@@ -809,15 +831,15 @@ def bench_chaos(seed: int = 7, events: int = 6000) -> dict:
 DEFAULT_LATENCY_BUDGET = "2.0,q5=4,q5_fused=5,adctr=5,multimv=12"
 
 
-def _parse_latency_budgets(argv) -> dict:
-    """--latency-budget 'q7=0.5,adctr=15' (per query) or a bare float
-    (every measured query) → {query: p99 budget seconds}. Defaults to
-    DEFAULT_LATENCY_BUDGET when the flag is absent; an empty spec
-    turns the gate off."""
-    if "--latency-budget" not in argv:
-        spec = DEFAULT_LATENCY_BUDGET
+def _parse_budget_spec(argv, flag: str, default_spec: str) -> dict:
+    """Shared budget-spec parser: `<flag> 'q7=0.5,adctr=15'` (per
+    lane) or a bare float (every lane) → {lane: budget seconds}.
+    Defaults to ``default_spec`` when the flag is absent; an empty
+    spec turns the gate off."""
+    if flag not in argv:
+        spec = default_spec
     else:
-        spec = argv[argv.index("--latency-budget") + 1]
+        spec = argv[argv.index(flag) + 1]
     budgets = {}
     for part in spec.split(","):
         part = part.strip()
@@ -829,6 +851,63 @@ def _parse_latency_budgets(argv) -> dict:
         else:
             budgets["*"] = float(part)
     return budgets
+
+
+def _parse_latency_budgets(argv) -> dict:
+    return _parse_budget_spec(argv, "--latency-budget",
+                              DEFAULT_LATENCY_BUDGET)
+
+
+# Freshness-bounded mode (ISSUE 14): every round also gates each
+# lane's per-MV WALL freshness lag p99 (the time from ingest to
+# visible — the number an MV reader actually experiences; EVENT-time
+# lag is recorded too but not gated: synthetic generators race through
+# event time far faster than the wall clock, so event-lag magnitudes
+# are workload constants, not regressions). Budgets are generous
+# multiples of each lane's p99 barrier latency: wall lag spans a
+# couple of epochs by construction. Pass --freshness-budget '' to
+# disable, or override per lane like the latency budget.
+DEFAULT_FRESHNESS_BUDGET = "20,adctr=45,multimv=120"
+
+
+def _parse_freshness_budgets(argv) -> dict:
+    """--freshness-budget 'q7=2,adctr=30' or a bare float (every lane
+    reporting freshness) → {lane: wall-lag p99 budget seconds}."""
+    return _parse_budget_spec(argv, "--freshness-budget",
+                              DEFAULT_FRESHNESS_BUDGET)
+
+
+def _freshness_verdict(headline: dict, budgets: dict) -> dict:
+    """Per-lane freshness-vs-budget verdicts: the lane's WORST per-MV
+    wall-lag p99 must fit its budget. Lanes without freshness blocks
+    (chaos, failed lanes) are gated only when explicitly budgeted."""
+    default = budgets.get("*")
+    verdicts = {}
+    ok = True
+    for name, r in headline.items():
+        if not isinstance(r, dict):
+            continue
+        budget = budgets.get(name, default)
+        if budget is None:
+            continue
+        fresh = r.get("freshness") or {}
+        worst = None
+        for mv, block in fresh.items():
+            w = block.get("wall_lag_p99_s")
+            if w is not None and (worst is None or w > worst):
+                worst = w
+        if worst is None:
+            if name in budgets:
+                verdicts[name] = {"budget_s": budget,
+                                  "verdict": "no-measurement"}
+                ok = False
+            continue
+        over = worst > budget
+        ok = ok and not over
+        verdicts[name] = {"budget_s": budget,
+                          "wall_lag_p99_s": worst,
+                          "verdict": "over-budget" if over else "ok"}
+    return {"budgets": budgets, "verdicts": verdicts, "ok": ok}
 
 
 def _latency_verdict(headline: dict, budgets: dict) -> dict:
@@ -886,6 +965,19 @@ def main(argv):
 
 
 BENCH_FNS = {}
+
+
+def _clear_attribution():
+    """Reset the process-global attribution state between a lane's
+    warmup and measured runs (records, freshness rings, bottleneck
+    streaks are all process-global — a warmup's epochs must not
+    dilute the measured run's blocks)."""
+    from risingwave_tpu.stream.bottleneck import BOTTLENECKS
+    from risingwave_tpu.stream.freshness import FRESHNESS
+    from risingwave_tpu.utils.ledger import LEDGER
+    LEDGER.clear()
+    FRESHNESS.clear()
+    BOTTLENECKS.clear()
 
 
 def _run_bench_subprocess(args: list, env_overrides: dict,
@@ -969,8 +1061,9 @@ def _main_locked(argv):
         fn = BENCH_FNS[name]
         fn()
         # the warmup run's epochs must not dilute the measured run's
-        # phase_breakdown (records are process-global)
-        LEDGER.clear()
+        # phase_breakdown / freshness / bottleneck blocks (all are
+        # process-global)
+        _clear_attribution()
         print(json.dumps(fn()))
         return
     if "--mesh-sub" in argv:
@@ -982,7 +1075,7 @@ def _main_locked(argv):
         from risingwave_tpu.utils.ledger import LEDGER
         LEDGER.query = "q7_mesh"
         r = bench_q7_mesh()                            # full-scale warmup
-        LEDGER.clear()
+        _clear_attribution()
         r = bench_q7_mesh()
         import jax
         r["platform"] = (f"{jax.devices()[0].platform}"
@@ -997,7 +1090,7 @@ def _main_locked(argv):
         from risingwave_tpu.utils.ledger import LEDGER
         LEDGER.query = "multimv"
         bench_multimv()                            # warmup
-        LEDGER.clear()
+        _clear_attribution()
         print(json.dumps(bench_multimv()))
         return
     if "--adctr-sub" in argv:
@@ -1015,7 +1108,7 @@ def _main_locked(argv):
         from risingwave_tpu.utils.ledger import LEDGER
         LEDGER.query = "adctr"
         r = bench_adctr()                          # warmup
-        LEDGER.clear()
+        _clear_attribution()
         r = bench_adctr()
         import jax
         r["platform"] = (f"{jax.devices()[0].platform}"
@@ -1041,8 +1134,9 @@ def _main_locked(argv):
     # timed number then measures the compiler, not the pipeline
     # fused twins right after their interpretive baselines: the round
     # diff shows fragment fusion's before/after per query (ISSUE 6)
-    names = ["q7", "q7_ledger_off", "q7_fused", "q8", "q8_fused",
-             "q4", "q3", "q3_fused", "q5", "q5_fused", "q1"]
+    names = ["q7", "q7_ledger_off", "q7_tricolor_off", "q7_fused",
+             "q8", "q8_fused", "q4", "q3", "q3_fused", "q5",
+             "q5_fused", "q1"]
     if quick:
         names = names[:1]
     headline = {}
@@ -1053,7 +1147,8 @@ def _main_locked(argv):
                               ("value", "p99_barrier_latency_s",
                                "barrier_in_flight", "events",
                                "platform", "phase_breakdown",
-                               "observability") if k in r}
+                               "observability", "freshness",
+                               "bottleneck") if k in r}
         except Exception as e:                       # noqa: BLE001
             print(f"WARNING: {name} failed: {e!r}", file=sys.stderr)
             headline[name] = {"error": repr(e)[:200]}
@@ -1067,8 +1162,9 @@ def _main_locked(argv):
                 k: r[k] for k in ("value", "p99_barrier_latency_s",
                                   "barrier_in_flight", "events",
                                   "parallelism", "platform",
-                                  "phase_breakdown",
-                                  "observability") if k in r}
+                                  "phase_breakdown", "observability",
+                                  "freshness", "bottleneck")
+                if k in r}
         except Exception as e:                       # noqa: BLE001
             print(f"WARNING: adctr failed: {e!r}", file=sys.stderr)
             headline["adctr"] = {"error": repr(e)[:200]}
@@ -1083,7 +1179,8 @@ def _main_locked(argv):
                                   "platform", "by_domain", "domains",
                                   "fast_domains_p99_max_s",
                                   "fast_domains_sub_second",
-                                  "observability") if k in r}
+                                  "observability", "freshness",
+                                  "bottleneck") if k in r}
         except Exception as e:                       # noqa: BLE001
             print(f"WARNING: multimv failed: {e!r}", file=sys.stderr)
             headline["multimv"] = {"error": repr(e)[:200]}
@@ -1095,8 +1192,9 @@ def _main_locked(argv):
                 k: r[k] for k in ("value", "p99_barrier_latency_s",
                                   "barrier_in_flight", "events",
                                   "parallelism", "platform",
-                                  "phase_breakdown",
-                                  "observability") if k in r}
+                                  "phase_breakdown", "observability",
+                                  "freshness", "bottleneck")
+                if k in r}
         except Exception as e:                       # noqa: BLE001
             print(f"WARNING: q7_mesh failed: {e!r}", file=sys.stderr)
             headline["q7_mesh"] = {"error": repr(e)[:200]}
@@ -1134,6 +1232,16 @@ def _main_locked(argv):
                 on_["value"] / off["value"], 4),
             "within_5pct": on_["value"] >= 0.95 * off["value"],
         }
+    # tricolor-overhead verdict (ISSUE 14 acceptance: utilization
+    # tricolor + freshness sampling on-vs-off q7 throughput within 5%)
+    toff = headline.get("q7_tricolor_off")
+    if isinstance(toff, dict) and isinstance(on_, dict) \
+            and toff.get("value") and on_.get("value"):
+        toff["tricolor_overhead"] = {
+            "on_vs_off_throughput_ratio": round(
+                on_["value"] / toff["value"], 4),
+            "within_5pct": on_["value"] >= 0.95 * toff["value"],
+        }
     q7 = headline.get("q7", {})
     ok = "value" in q7
     headline.update({
@@ -1168,7 +1276,13 @@ def _main_locked(argv):
     if budgets:
         verdict = _latency_verdict(headline, budgets)
         headline["latency_budget"] = verdict
+    fresh_budgets = _parse_freshness_budgets(argv)
+    fresh_verdict = None
+    if fresh_budgets:
+        fresh_verdict = _freshness_verdict(headline, fresh_budgets)
+        headline["freshness_budget"] = fresh_verdict
     print(json.dumps(headline))
+    failed = []
     if verdict is not None and not verdict["ok"]:
         # latency-bounded mode: a query past its p99 budget fails the
         # round AFTER the JSON line lands (the driver still records it)
@@ -1176,6 +1290,14 @@ def _main_locked(argv):
                 if v["verdict"] != "ok"]
         print(f"FAIL: p99 barrier latency budget exceeded: {over}",
               file=sys.stderr)
+        failed += over
+    if fresh_verdict is not None and not fresh_verdict["ok"]:
+        over = [q for q, v in fresh_verdict["verdicts"].items()
+                if v["verdict"] != "ok"]
+        print(f"FAIL: freshness wall-lag budget exceeded: {over}",
+              file=sys.stderr)
+        failed += over
+    if failed:
         sys.exit(1)
 
 
@@ -1188,6 +1310,12 @@ BENCH_FNS.update({"q7": bench_q7, "q8": bench_q8, "q4": bench_q4,
                   # check — the observability-tax control
                   "q7_ledger_off": _functools.partial(bench_q7,
                                                       ledger=False),
+                  # tricolor/freshness-off arm (ISSUE 14): same q7
+                  # config with the utilization bookkeeping and
+                  # freshness sampling reduced to predicate checks —
+                  # the attribution-tax control (on-vs-off < 5%)
+                  "q7_tricolor_off": _functools.partial(
+                      bench_q7, tricolor=False),
                   # fragment fusion on (SET stream_fusion equivalent
                   # for the hand-built pipelines)
                   "q7_fused": _functools.partial(bench_q7, fusion=True),

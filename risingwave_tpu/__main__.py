@@ -26,6 +26,13 @@ backup operations against a data directory:
                               # epoch phase ledger: per-epoch
                               # host/device time+bytes breakdown,
                               # conservation coverage, kernel costs
+    python -m risingwave_tpu ctl --data-dir D top [--steps K] \
+        [--watch N]           # live-ops view: actor utilization
+                              # tricolor (busy/backpressure/idle,
+                              # sorted busiest first), per-MV
+                              # event-time freshness, and each
+                              # domain's current bottleneck with its
+                              # one-line diagnosis
     python -m risingwave_tpu ctl --data-dir D backup create|list|
         delete <id> | restore <id> --target T
 """
@@ -152,6 +159,8 @@ def _ctl(args) -> int:
         return asyncio.run(_ctl_trace(obj, args))
     if verb == "phases":
         return asyncio.run(_ctl_phases(obj, args))
+    if verb == "top":
+        return asyncio.run(_ctl_top(obj, args))
     if verb == "backup":
         from risingwave_tpu.meta.backup import (
             create_backup, delete_backup, list_backups, restore_backup,
@@ -356,6 +365,62 @@ async def _ctl_phases(obj, args) -> int:
     return 0
 
 
+async def _ctl_top(obj, args) -> int:
+    """Recover into an in-memory clone (same snapshot discipline as
+    `table scan`), drive a few checkpoints per refresh, and print the
+    live-ops view: actor utilization tricolor sorted busiest first,
+    per-MV event-time freshness, and each barrier domain's current
+    walked bottleneck. ``--watch N`` repeats the drive+print cycle N
+    times (a poor man's `top` refresh over the recovered pipelines)."""
+    from risingwave_tpu.frontend import Frontend
+    from risingwave_tpu.storage.hummock import HummockLite
+    from risingwave_tpu.stream.bottleneck import BOTTLENECKS
+    from risingwave_tpu.stream.freshness import FRESHNESS
+    from risingwave_tpu.stream.monitor import UTILIZATION
+
+    fe = Frontend(HummockLite(_snapshot_clone(obj)))
+    await fe.recover()
+    try:
+        for cycle in range(max(1, args.watch)):
+            await fe.step(args.steps)
+            if cycle:
+                print()
+            print(f"== refresh {cycle + 1} — actor utilization "
+                  f"(share of last barrier) ==")
+            print(f"{'actor':>6} {'node':>4} {'busy':>6} {'bp':>6} "
+                  f"{'idle':>6}  fragment / executor")
+            for (a, frag, node, ex, _e, _i, busy, bp,
+                 idle) in UTILIZATION.rows():
+                print(f"{a:>6} {node:>4} {busy:>6.1%} {bp:>6.1%} "
+                      f"{idle:>6.1%}  {frag} / {ex}")
+            print("== per-MV freshness ==")
+            print(f"{'lag_s':>8} {'wall_s':>8} {'p99_s':>8} "
+                  f"{'n':>5}  mv (domain)")
+            for (mv, dom, n, _e, lag, wall, _p50, p99,
+                 _wp99) in FRESHNESS.rows():
+                if not n:
+                    continue
+                print(f"{lag:>8.3f} {wall:>8.3f} {p99:>8.3f} "
+                      f"{n:>5}  {mv}"
+                      + (f" ({dom})" if dom else ""))
+            print("== bottlenecks ==")
+            for (dom, op, _frag, actor, _node, busy, bp, streak,
+                 sustained, _e, diag) in BOTTLENECKS.rows():
+                label = dom or "(global)"
+                if op is None:
+                    print(f"{label}: no sustained bottleneck")
+                else:
+                    print(f"{label}: {op} (actor {actor}) busy "
+                          f"{busy:.0%}, downstream bp {bp:.0%}, "
+                          f"streak {streak}"
+                          + (" [SUSTAINED]" if sustained else ""))
+                    if diag:
+                        print(f"    {diag}")
+    finally:
+        await fe.close()
+    return 0
+
+
 def main(argv=None) -> None:
     # the axon sitecustomize rewrites jax_platforms at interpreter
     # start, overriding JAX_PLATFORMS=cpu — honor the env var so ctl /
@@ -417,6 +482,15 @@ def main(argv=None) -> None:
     ph.add_argument("--steps", type=int, default=4,
                     help="checkpoint barriers to drive before the "
                          "report")
+    tp = csub.add_parser(
+        "top",
+        help="recover + print the live-ops view: actor utilization "
+             "tricolor (busy/backpressure/idle), per-MV event-time "
+             "freshness, and each domain's walked bottleneck")
+    tp.add_argument("--steps", type=int, default=4,
+                    help="checkpoint barriers to drive per refresh")
+    tp.add_argument("--watch", type=int, default=1,
+                    help="refresh cycles to print (drive+print each)")
     bk = csub.add_parser("backup")
     bk.add_argument("what",
                     choices=["create", "list", "delete", "restore"])

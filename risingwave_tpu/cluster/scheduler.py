@@ -522,6 +522,28 @@ class Cluster:
                                resolve=complete)
         return n
 
+    async def drain_freshness(self) -> int:
+        """Pull every worker's raw freshness parts (ingest hwms, epoch
+        frontiers, visibility events) into the coordinator's tracker —
+        a source fragment on worker 0 and its materialize on worker 1
+        resolve into one per-MV lag series here. Returns visibility
+        events resolved."""
+        from risingwave_tpu.stream.freshness import FRESHNESS
+        live = [c for c in self.clients if c is not None]
+        replies = await asyncio.gather(*(
+            c.call({"cmd": "drain_freshness"}) for c in live))
+        n = 0
+        for reply in replies:
+            n += FRESHNESS.ingest(reply.get("parts") or {})
+        return n
+
+    def domain_of_job(self, name: str) -> str:
+        """The barrier domain a deployed job's epochs flow through
+        ("" = the global loop / off arm)."""
+        if self._plane is None:
+            return ""
+        return self._plane.domain_of_job(name) or ""
+
     # -- distributed reads ------------------------------------------------
     async def scan_table(self, table_id: int) -> List[tuple]:
         """Union a table's committed rows across every namespace

@@ -368,6 +368,29 @@ class DistFrontend:
                 domain_keys={stmt.name, *plan.mv.dependent_sources})
             await self.cluster.step(1)     # activation barrier
         self.catalog.add_mv(plan.mv)
+        # freshness lineage on the COORDINATOR tracker: the worker
+        # fragments report raw parts; the merge joins them under this
+        # registration (drain_freshness). MV deps resolve to their
+        # SOURCES transitively, same as the in-process session — a
+        # chained MV bound to no frontier would report constant
+        # zero-lag samples
+        from risingwave_tpu.stream.freshness import FRESHNESS
+        srcs, seen = [], set()
+
+        def _walk_dep(d):
+            if d in seen:
+                return
+            seen.add(d)
+            if d in self.catalog.sources:
+                srcs.append(d)
+            elif d in self.catalog.mvs:
+                for dd in self.catalog.mvs[d].dependent_sources:
+                    _walk_dep(dd)
+
+        for dep in plan.mv.dependent_sources:
+            _walk_dep(dep)
+        FRESHNESS.register_mv(stmt.name, srcs,
+                              self.cluster.domain_of_job(stmt.name))
         self._mv_selects[stmt.name] = (
             stmt.select, getattr(stmt, "emit_on_window_close", False))
         return "CREATE_MATERIALIZED_VIEW"
@@ -409,6 +432,8 @@ class DistFrontend:
             await self.cluster.drop_job(stmt.name)
         del self.catalog.mvs[stmt.name]
         self._mv_selects.pop(stmt.name, None)
+        from risingwave_tpu.stream.freshness import FRESHNESS
+        FRESHNESS.unregister_mv(stmt.name)
         return "DROP_MATERIALIZED_VIEW"
 
     async def drain_trace(self) -> int:
@@ -437,6 +462,10 @@ class DistFrontend:
             # into the sealed records before anything reads them (the
             # conservation residuals recompute on merge)
             await self.drain_ledger()
+        if referenced & {"rw_mv_freshness", "rw_metrics_history"}:
+            # freshness parts live on the workers (source + materialize
+            # fragments): merge them before the tracker serves rows
+            await self.cluster.drain_freshness()
         view = ClusterStoreView(self.cluster)
         # one consistent snapshot: the barrier lock keeps the
         # heartbeat from committing an epoch between per-table scans

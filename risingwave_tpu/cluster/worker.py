@@ -167,6 +167,13 @@ class WorkerServer:
             # interval); the other side merges them into its records
             from risingwave_tpu.utils.ledger import LEDGER
             return {"ok": True, "epochs": LEDGER.drain_dicts()}
+        if verb == "drain_freshness":
+            # pop this process's raw freshness parts (ingest hwms,
+            # epoch frontiers, visibility events) — the coordinator
+            # joins source and materialize fragments that landed on
+            # different workers into one per-MV lag series
+            from risingwave_tpu.stream.freshness import FRESHNESS
+            return {"ok": True, "parts": FRESHNESS.drain_dict()}
         if verb == "ping":
             # heartbeat probe (cluster.rs heartbeat RPC): liveness +
             # a cheap resource summary for the membership table (actor

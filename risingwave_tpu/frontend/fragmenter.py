@@ -222,6 +222,10 @@ class Fragmenter:
                 "split_table_id": ex.split_state.table_id,
                 "rate_limit": ex.rate_limit,
                 "min_chunks": ex.min_chunks,
+                # freshness accounting key (stream/freshness.py): the
+                # worker-side rebuild keeps the CATALOG source name so
+                # the coordinator merge joins MV ↔ source frontiers
+                "freshness_key": ex.freshness_key,
             })
             return fi, ni
         if isinstance(ex, ProjectExecutor):
@@ -515,7 +519,8 @@ class Fragmenter:
             node = {
                 "op": "materialize", "input": ci,
                 "table_id": ex.table.table_id,
-                "pk": list(ex.table.pk_indices)}
+                "pk": list(ex.table.pk_indices),
+                "mv_name": ex.mv_name}
             # vnode-partition the MV by its GROUP-KEY pk columns when
             # this is an exchange-fed agg fragment: the planner orders
             # the MV pk by group index, and agg output group j carries

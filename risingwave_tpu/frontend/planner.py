@@ -318,7 +318,11 @@ class StreamPlanner:
         ex: Executor = SourceExecutor(
             reader, rx, split_state, actor_id=sid,
             rate_limit_chunks_per_barrier=rate_limit,
-            min_chunks_per_barrier=min_chunks)
+            min_chunks_per_barrier=min_chunks,
+            # freshness accounting key (stream/freshness.py): the
+            # CATALOG name, so per-MV lag joins source frontiers by
+            # the name the MV's dependency list carries
+            freshness_key=obj.name)
         # connector options ride along for the fragmenter: the shipped
         # source IR node rebuilds the reader worker-side from these
         ex.ir_connector = dict(obj.options)
@@ -474,7 +478,7 @@ class StreamPlanner:
                                   actor_id=actor_id)
         mv_table = StateTable(self.catalog.next_id(), ex.schema, pk,
                               self.store)
-        mat = MaterializeExecutor(ex, mv_table)
+        mat = MaterializeExecutor(ex, mv_table, mv_name=name)
         mv = MvCatalog(name, mv_table.table_id, ex.schema, pk,
                        self.definition, actor_id, deps,
                        n_visible=nvis if nvis < len(ex.schema) else None)
@@ -1323,6 +1327,56 @@ def _system_catalog_rows(name: str, catalog: Catalog, profiler=None):
                       Field("value", DataType.FLOAT64),
                       Field("domain", DataType.VARCHAR)])
         return sch, HISTORY.rows()
+    if n == "rw_mv_freshness":
+        # per-MV event-time freshness (stream/freshness.py): how far
+        # the materialized result lags the data's own timestamps, per
+        # barrier, with percentiles over the retained sample ring —
+        # the consumer-experience half of the observability stack
+        from risingwave_tpu.stream.freshness import FRESHNESS
+        sch = Schema([Field("mv", DataType.VARCHAR),
+                      Field("domain", DataType.VARCHAR),
+                      Field("samples", DataType.INT64),
+                      Field("epoch", DataType.INT64),
+                      Field("lag_s", DataType.FLOAT64),
+                      Field("wall_lag_s", DataType.FLOAT64),
+                      Field("lag_p50_s", DataType.FLOAT64),
+                      Field("lag_p99_s", DataType.FLOAT64),
+                      Field("wall_lag_p99_s", DataType.FLOAT64)])
+        return sch, FRESHNESS.rows()
+    if n == "rw_bottlenecks":
+        # bottleneck walker (stream/bottleneck.py): the ranked
+        # per-domain culprit table — operator, busy share, downstream
+        # backpressure evidence, contiguous-barrier streak and a
+        # one-line diagnosis (the autoscaler's target signal)
+        from risingwave_tpu.stream.bottleneck import BOTTLENECKS
+        sch = Schema([Field("domain", DataType.VARCHAR),
+                      Field("operator", DataType.VARCHAR),
+                      Field("fragment", DataType.VARCHAR),
+                      Field("actor_id", DataType.INT64),
+                      Field("node", DataType.INT64),
+                      Field("busy_ratio", DataType.FLOAT64),
+                      Field("downstream_backpressure",
+                            DataType.FLOAT64),
+                      Field("streak", DataType.INT64),
+                      Field("sustained", DataType.INT64),
+                      Field("epoch", DataType.INT64),
+                      Field("diagnosis", DataType.VARCHAR)])
+        return sch, BOTTLENECKS.rows()
+    if n == "rw_actor_utilization":
+        # utilization tricolor (stream/monitor.py): busy /
+        # backpressure / idle shares of the last barrier interval per
+        # (actor, executor) — sorted busiest first, the `ctl top` feed
+        from risingwave_tpu.stream.monitor import UTILIZATION
+        sch = Schema([Field("actor_id", DataType.INT64),
+                      Field("fragment", DataType.VARCHAR),
+                      Field("node", DataType.INT64),
+                      Field("executor", DataType.VARCHAR),
+                      Field("epoch", DataType.INT64),
+                      Field("interval_s", DataType.FLOAT64),
+                      Field("busy_ratio", DataType.FLOAT64),
+                      Field("backpressure_ratio", DataType.FLOAT64),
+                      Field("idle_ratio", DataType.FLOAT64)])
+        return sch, UTILIZATION.rows()
     if n == "rw_kernel_costs":
         # compiled-program cost analysis (utils/jaxtools.KERNELS):
         # flops / bytes-accessed from each kernel's lowered program —

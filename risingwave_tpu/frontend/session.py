@@ -89,6 +89,9 @@ class Frontend:
         # are pg-compatibility strings (shared impl: session_vars.py)
         from risingwave_tpu.frontend.opt import parse_fusion, parse_rules
         from risingwave_tpu.frontend.session_vars import SessionVars
+        from risingwave_tpu.stream.monitor import (
+            parse_tricolor as _parse_tricolor,
+        )
         from risingwave_tpu.utils.ledger import parse_ledger
         from risingwave_tpu.utils.spans import parse_trace
         self.session_vars = SessionVars(
@@ -129,11 +132,17 @@ class Frontend:
              # global BarrierLoop — today's lockstep, bit-identical
              # (the oracle arm). Only changeable with no live jobs.
              "stream_epoch_pipeline":
-                 "on" if self._epoch_pipeline else "off"},
+                 "on" if self._epoch_pipeline else "off",
+             # freshness & bottleneck attribution (ISSUE 14): the
+             # utilization tricolor, per-MV freshness sampling and
+             # the bottleneck walker; 'off' reduces every hook to a
+             # predicate check (the q7_tricolor_off bench arm)
+             "stream_tricolor": "on"},
             validators={"stream_rewrite_rules": parse_rules,
                         "stream_fusion": parse_fusion,
                         "stream_trace": parse_trace,
                         "stream_ledger": parse_ledger,
+                        "stream_tricolor": _parse_tricolor,
                         "stream_epoch_pipeline":
                             self._validate_epoch_pipeline})
         # rules spec each MV was created under: reschedule replans +
@@ -419,6 +428,16 @@ class Frontend:
                 from risingwave_tpu.utils import ledger as _ledger
                 _ledger.set_enabled(_ledger.parse_ledger(
                     self.session_vars.get("stream_ledger")))
+            if stmt.name == "stream_tricolor":
+                # one knob for the whole attribution subsystem: the
+                # tricolor bookkeeping AND freshness sampling flip
+                # together (the bench off-arm measures both)
+                from risingwave_tpu.stream import freshness as _fresh
+                from risingwave_tpu.stream import monitor as _monitor
+                on = _monitor.parse_tricolor(
+                    self.session_vars.get("stream_tricolor"))
+                _monitor.set_tricolor(on)
+                _fresh.set_enabled(on)
             if stmt.name == "stream_epoch_pipeline":
                 from risingwave_tpu.meta.domains import (
                     parse_epoch_pipeline,
@@ -456,9 +475,31 @@ class Frontend:
         raise PlanError(f"unhandled statement {stmt!r}")
 
     # -- handlers ---------------------------------------------------------
+    def _freshness_sources(self, deps) -> list:
+        """Resolve a job's dependency anchors to the SOURCE names whose
+        ingest frontiers bound its freshness (MV-on-MV deps resolve
+        transitively — chained materializations preserve the barrier
+        cut, so the original source frontier is still the honest
+        visible-data bound)."""
+        out, seen = [], set()
+
+        def walk(d):
+            if d in seen:
+                return
+            seen.add(d)
+            if d in self.catalog.sources or d in self._tables:
+                out.append(d)
+            elif d in self.catalog.mvs:
+                for dd in self.catalog.mvs[d].dependent_sources:
+                    walk(dd)
+
+        for d in deps:
+            walk(d)
+        return out
+
     async def _deploy_job(self, name: str, actor_id: int, consumer,
                           readers, register, attaches=(),
-                          deps=()) -> None:
+                          deps=(), freshness_sources=None) -> None:
         """Shared deployment tail for MVs and sinks — runs UNDER the
         barrier lock the caller holds: topology mutations (sender
         registration in plan(), expected-actor set, spawn) racing a
@@ -491,6 +532,24 @@ class Frontend:
             self._plane.assign_job(name, set(deps),
                                    sender_ids=set(readers),
                                    expected_ids={actor_id})
+        # freshness lineage (stream/freshness.py): which source
+        # frontiers bound this job's visible data, keyed by the domain
+        # its barriers flow through
+        from risingwave_tpu.stream.freshness import FRESHNESS
+        domain = ""
+        if self._plane is not None:
+            domain = self._plane.domain_of_job(name) or ""
+        FRESHNESS.register_mv(
+            name,
+            self._freshness_sources(deps)
+            if freshness_sources is None else list(freshness_sources),
+            domain)
+        if self._plane is not None:
+            # a new job can MERGE domains (shared reachability): keep
+            # every registered job's freshness domain key current
+            for dom in self._plane.domains():
+                for job in self._plane.jobs_of_domain(dom):
+                    FRESHNESS.set_domain(job, dom)
         # attach MV-on-MV chain edges now that the plan validated and
         # the downstream actor exists — the activation barrier below
         # must flow through these channels
@@ -663,9 +722,11 @@ class Frontend:
             tx, rx = channel_for_test(edge=f"dml:{stmt.name}")
             self.local.register_sender(sid, tx)
             try:
-                src = SourceExecutor(reader, rx, None, actor_id=sid)
+                src = SourceExecutor(reader, rx, None, actor_id=sid,
+                                     freshness_key=stmt.name)
                 table = StateTable(table_id, schema, pk, self.store)
-                mat = MaterializeExecutor(src, table)
+                mat = MaterializeExecutor(src, table,
+                                          mv_name=stmt.name)
                 mv = MvCatalog(stmt.name, table_id, schema, pk,
                                definition="", actor_id=actor_id,
                                id_base=id_base,
@@ -673,7 +734,8 @@ class Frontend:
                                else None, is_table=True)
                 await self._deploy_job(stmt.name, actor_id, mat,
                                        {sid: reader},
-                                       lambda: self.catalog.add_mv(mv))
+                                       lambda: self.catalog.add_mv(mv),
+                                       freshness_sources=[stmt.name])
             except BaseException:
                 self.local.drop_actor(sid)
                 raise
@@ -1109,6 +1171,8 @@ class Frontend:
             # drop the job from its alignment domain (an empty domain
             # retires — its frontier epoch stops blocking the fence)
             self._plane.remove_job(name)
+        from risingwave_tpu.stream.freshness import FRESHNESS
+        FRESHNESS.unregister_mv(name)
         return actor
 
     async def _drop_job(self, name: str, registry, if_exists: bool,

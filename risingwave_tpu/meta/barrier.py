@@ -564,6 +564,8 @@ class BarrierLoop:
                 self.store.seal_epoch(prev, barrier.is_checkpoint)
         t0 = self._inject_times.pop(epoch, None)
         prof = None
+        seal_rec = None
+        seal_interval = None
         if t0 is not None:
             lat = self.monotonic() - t0
             self.stats.observe(lat, self.domain)
@@ -630,7 +632,8 @@ class BarrierLoop:
                     # land on two epochs' books)
                     self._last_seal_stamp = \
                         t_true + prof.collect_to_commit_s
-                    _ledger.LEDGER.seal(
+                    seal_interval = interval
+                    seal_rec = _ledger.LEDGER.seal(
                         epoch, interval, prof.kind,
                         # remote pseudo-actors ⇒ actor work ran in
                         # other processes: conservation defers to the
@@ -644,6 +647,29 @@ class BarrierLoop:
                         domain=self.domain)
                 else:
                     _ledger.LEDGER.discard(epoch)
+            # bottleneck walk (ISSUE 14): one candidate per domain per
+            # barrier off the just-published utilization tricolor,
+            # cross-checked against the sealed phase record. Wall-clock
+            # loops only — virtual-clock ratios would be meaningless.
+            from risingwave_tpu.stream import monitor as _monitor
+            if _monitor.TRICOLOR and barrier.mutation is None \
+                    and self.monotonic is time.monotonic:
+                # mutation barriers (deploy/stop/reschedule) do
+                # topology work, not epoch work — walking them would
+                # reset every streak right before a teardown report
+                from risingwave_tpu.stream.bottleneck import BOTTLENECKS
+                fragments = None
+                if self._plane is not None:
+                    jobs = self._plane.jobs_of_domain(self.domain)
+                    fragments = set(jobs) if jobs else None
+                BOTTLENECKS.observe(
+                    epoch=epoch, domain=self.domain,
+                    interval_s=(seal_interval
+                                if seal_interval is not None
+                                else prof.total_s),
+                    phase_seconds=(seal_rec.seconds
+                                   if seal_rec is not None else None),
+                    fragments=fragments)
         if prev > 0 and barrier.is_checkpoint:
             if self._plane is not None:
                 # checkpoint durability is a CROSS-DOMAIN aligned

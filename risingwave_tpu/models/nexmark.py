@@ -65,12 +65,32 @@ def _source(local: LocalBarrierManager, store, actor_id: int,
                           min_chunks_per_barrier=min_chunks)
 
 
+def _register_freshness(mat: MaterializeExecutor, fragment: str) -> None:
+    """Freshness lineage (stream/freshness.py) for a hand-built
+    pipeline: name the MV after its fragment and bind the chain's
+    source executors' ingest frontiers — the benched pipeline reports
+    per-MV lag exactly like a SQL-deployed one."""
+    from risingwave_tpu.stream.executor import executor_children
+    from risingwave_tpu.stream.freshness import FRESHNESS
+    mat.mv_name = fragment
+
+    def _source_keys(ex) -> list:
+        keys = [ex.freshness_key] if isinstance(ex, SourceExecutor) \
+            else []
+        for _a, _i, child in executor_children(ex):
+            keys += _source_keys(child)
+        return keys
+
+    FRESHNESS.register_mv(fragment, _source_keys(mat))
+
+
 def _finish(local: LocalBarrierManager, store, mat: MaterializeExecutor,
             mv_table: StateTable, actor_id: int,
             readers: Dict[int, NexmarkSplitReader],
             fragment: str = "nexmark",
             fusion: bool = False) -> Pipeline:
     from risingwave_tpu.stream.monitor import install_monitoring
+    _register_freshness(mat, fragment)
     if fusion:
         # fragment fusion (frontend/opt/fusion.py): same rule the SQL
         # sessions apply under SET stream_fusion — the benched

@@ -150,7 +150,9 @@ def build_q3(store, customers: int = 300, orders: int = 3000,
         limit=top_limit, state=topn_state, pk_indices=[0, 1, 2])
 
     mv = StateTable(10, topn.schema, [0, 1, 2], store)
-    mat = MaterializeExecutor(topn, mv)
+    mat = MaterializeExecutor(topn, mv, mv_name="tpch-q3")
+    from risingwave_tpu.models.nexmark import _register_freshness
+    _register_freshness(mat, "tpch-q3")
     if fusion:
         # same fusion rule the SQL sessions apply (SET stream_fusion)
         from risingwave_tpu.frontend.opt import rewrite_stream_plan

@@ -66,10 +66,17 @@ class Actor:
         self.barrier_manager = barrier_manager
         self.fragment = fragment
         self.failure: Optional[BaseException] = None
+        # task-scoped backpressure meter: dispatch sends that park for
+        # exchange credits BETWEEN executor pulls charge here; the
+        # monitor's root wrapper folds it into the actor's utilization
+        # tricolor at each barrier (stream/exchange.py accounting)
+        self.bp_meter = [0.0]
 
     async def run(self) -> None:
+        from risingwave_tpu.stream.exchange import set_actor_meter
         _METRICS.actor_count.set(1, actor=str(self.actor_id),
                                  fragment=self.fragment)
+        mtok = set_actor_meter(self.bp_meter)
         try:
             await self._run_consumer()
         except asyncio.CancelledError:
@@ -81,8 +88,16 @@ class Actor:
             else:
                 raise
         finally:
+            # restore the outer context's meter binding (no-op for
+            # spawned tasks; matters when run() is awaited inline)
+            import contextlib
+            from risingwave_tpu.stream import exchange as _xchg
+            with contextlib.suppress(ValueError):
+                _xchg._METER.reset(mtok)
             _remove_actor_series(self.actor_id)
             close_receivers(self.consumer, attrs=("barrier_rx",))
+            from risingwave_tpu.stream.monitor import TOPOLOGY
+            TOPOLOGY.drop_actor(self.actor_id)
 
     async def _run_consumer(self) -> None:
         async for msg in self.consumer.execute():

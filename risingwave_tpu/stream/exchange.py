@@ -16,7 +16,8 @@ from __future__ import annotations
 
 import asyncio
 import time
-from typing import AsyncIterator, Optional, Tuple
+from contextvars import ContextVar
+from typing import AsyncIterator, List, Optional, Tuple
 
 from risingwave_tpu.common.chunk import StreamChunk
 from risingwave_tpu.stream.message import Barrier, Message, Watermark
@@ -25,6 +26,61 @@ from risingwave_tpu.utils.metrics import STREAMING as _METRICS
 
 class ChannelClosed(Exception):
     """Send on a channel whose receiver is gone, or recv after close+drain."""
+
+
+# -- sender-side backpressure accounting (ISSUE 14) -----------------------
+# Credit park time used to disappear into whoever awaited the send: a
+# straggler diagnosis then blames the VICTIM of a slow consumer. Every
+# park is now (a) metered per channel (stream_backpressure_wait_seconds)
+# and (b) charged to the context's accumulator so the utilization
+# tricolor can subtract it from busy. Two ContextVar scopes:
+#   _PARK  — innermost MonitoredExecutor pull (stream/monitor.py pushes
+#            its cell around each inner __anext__, exactly like the
+#            phase-ledger cells), for sends that happen INSIDE a pull;
+#   _METER — the owning actor's task-scoped meter (stream/actor.py sets
+#            it for the whole run), for dispatch sends between pulls.
+# ContextVars are asyncio-task aware, so interleaved actors never
+# cross-charge; merge pumps inherit their parent actor's context.
+_PARK: ContextVar[Optional[List[float]]] = ContextVar(
+    "exchange_park_cell", default=None)
+_METER: ContextVar[Optional[List[float]]] = ContextVar(
+    "exchange_actor_meter", default=None)
+
+
+def set_actor_meter(meter: Optional[List[float]]):
+    """Bind the actor-task backpressure meter (stream/actor.py)."""
+    return _METER.set(meter)
+
+
+def current_actor_meter() -> Optional[List[float]]:
+    """The running actor task's meter (the monitor's root wrapper
+    drains it at each barrier flush)."""
+    return _METER.get()
+
+
+def push_park_cell(cell: List[float]):
+    return _PARK.set(cell)
+
+
+def pop_park_cell(token) -> None:
+    _PARK.reset(token)
+
+
+def note_backpressure(seconds: float,
+                      channel: Optional[str] = None) -> None:
+    """Record one sender park: per-channel Prometheus counter plus the
+    context's tricolor accumulator (shared with stream/remote.py)."""
+    if seconds <= 0:
+        return
+    if channel:
+        _METRICS.backpressure_wait.inc(seconds, channel=channel)
+    cell = _PARK.get()
+    if cell is not None:
+        cell[0] += seconds
+        return
+    meter = _METER.get()
+    if meter is not None:
+        meter[0] += seconds
 
 
 class _Shared:
@@ -72,20 +128,40 @@ class Sender:
         t0 = time.perf_counter() if s.edge else 0.0
         if isinstance(msg, StreamChunk):
             cost = _chunk_cost(s, msg)
+            park0 = 0.0
             async with s.cond:
-                await s.cond.wait_for(
-                    lambda: s.closed or s.chunk_permits >= cost)
+                if not (s.closed or s.chunk_permits >= cost):
+                    # the sender is about to PARK for credits: that
+                    # wall time is backpressure, not processing — meter
+                    # it per channel and charge the context's tricolor
+                    # accumulator (the fast path pays only this branch)
+                    park0 = time.perf_counter()
+                    await s.cond.wait_for(
+                        lambda: s.closed or s.chunk_permits >= cost)
                 if s.closed:
+                    if park0:
+                        note_backpressure(time.perf_counter() - park0,
+                                          s.edge)
                     raise ChannelClosed
                 s.chunk_permits -= cost
+            if park0:
+                note_backpressure(time.perf_counter() - park0, s.edge)
             s.queue.put_nowait(("chunk", cost, msg))
         elif isinstance(msg, Barrier):
+            park0 = 0.0
             async with s.cond:
-                await s.cond.wait_for(
-                    lambda: s.closed or s.barrier_permits >= 1)
+                if not (s.closed or s.barrier_permits >= 1):
+                    park0 = time.perf_counter()
+                    await s.cond.wait_for(
+                        lambda: s.closed or s.barrier_permits >= 1)
                 if s.closed:
+                    if park0:
+                        note_backpressure(time.perf_counter() - park0,
+                                          s.edge)
                     raise ChannelClosed
                 s.barrier_permits -= 1
+            if park0:
+                note_backpressure(time.perf_counter() - park0, s.edge)
             s.queue.put_nowait(("barrier", 1, msg))
         else:  # watermarks are control-plane: unmetered
             if s.closed:

@@ -31,15 +31,21 @@ class MaterializeExecutor(Executor):
     """Materialize a changelog into a StateTable (materialize.rs:53)."""
 
     def __init__(self, input_: Executor, table: StateTable,
-                 conflict: ConflictBehavior = ConflictBehavior.NO_CHECK):
+                 conflict: ConflictBehavior = ConflictBehavior.NO_CHECK,
+                 mv_name: str = ""):
         self.input = input_
         self.table = table
         self.conflict = conflict
+        # freshness accounting identity (stream/freshness.py): the MV
+        # name readers know; empty = an unnamed/test pipeline whose
+        # barrier passages still sample under the table id
+        self.mv_name = mv_name or f"table-{table.table_id}"
         info = ExecutorInfo(input_.schema, list(table.pk_indices),
                             "MaterializeExecutor")
         super().__init__(info)
 
     async def execute(self) -> AsyncIterator[Message]:
+        from risingwave_tpu.stream import freshness as _fresh
         it = self.input.execute()
         first = await it.__anext__()
         assert is_barrier(first), "executor protocol: first message is the " \
@@ -52,6 +58,13 @@ class MaterializeExecutor(Executor):
                 yield msg
             elif is_barrier(msg):
                 self.table.commit(msg.epoch)
+                if _fresh.enabled():
+                    # everything ingested before this barrier is now
+                    # applied (and commits with its collection): the
+                    # MV's visible event frontier advances to the
+                    # source frontiers recorded at the same barrier
+                    _fresh.FRESHNESS.note_visible(
+                        self.mv_name, msg.epoch.curr.value)
                 yield msg
             else:
                 yield msg

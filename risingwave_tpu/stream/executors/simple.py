@@ -36,18 +36,28 @@ class ReceiverExecutor(Executor):
         super().__init__(info)
         self.rx = rx
         self.actor_id = actor_id
+        # wall time parked on the channel waiting for the next message
+        # — idle, not processing; the monitor subtracts it from this
+        # node's exclusive busy (same contract as SourceExecutor and
+        # RemoteInput: a chain edge waiting out a slow upstream must
+        # not read as the downstream chain's straggler)
+        self.idle_wait_s = 0.0
 
     async def execute(self) -> AsyncIterator[Message]:
+        import time as _time
         # NOTE: no rx.close() on teardown here — the chain edge may
         # still be attached to a live upstream dispatcher (a close
         # would turn its next dispatch into ChannelClosed and kill the
         # healthy upstream); the session's _stop_job closes the rx via
         # close_receivers AFTER detaching the edge
         while True:
+            t0 = _time.monotonic()
             try:
                 msg = await self.rx.recv()
             except ChannelClosed:
                 return
+            finally:
+                self.idle_wait_s += _time.monotonic() - t0
             yield msg
             if is_barrier(msg) and msg.is_stop(self.actor_id):
                 return

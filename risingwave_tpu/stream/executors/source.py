@@ -51,13 +51,22 @@ class SourceExecutor(Executor):
                  actor_id: int = 0,
                  rate_limit_chunks_per_barrier: Optional[int] = None,
                  min_chunks_per_barrier: Optional[int] = None,
-                 identity: str = "SourceExecutor"):
+                 identity: str = "SourceExecutor",
+                 freshness_key: Optional[str] = None):
         info = ExecutorInfo(reader.schema, [], identity)
         super().__init__(info)
         self.reader = reader
         self.barrier_rx = barrier_rx
         self.split_state = split_state
         self.actor_id = actor_id
+        # freshness accounting key (stream/freshness.py): the SOURCE
+        # name MVs register against (planner passes the catalog name;
+        # hand-built pipelines default to the reader's split id), plus
+        # the event-time column the ingest high-watermark reads
+        from risingwave_tpu.stream.freshness import event_time_index
+        self.freshness_key = freshness_key or getattr(
+            reader, "split_id", identity)
+        self._event_ts_idx = event_time_index(reader.schema)
         # optional throttle: max chunks generated per barrier interval
         # (FlowControlExecutor analog, keeps tests/bench deterministic)
         self.rate_limit = rate_limit_chunks_per_barrier
@@ -107,6 +116,12 @@ class SourceExecutor(Executor):
         self._persist_offset()
         if self.split_state is not None:
             self.split_state.commit(barrier.epoch)
+        # epoch frontier: everything ingested so far precedes this
+        # barrier — the hwm recorded here IS the MV-visible event
+        # frontier once materialize passes the same barrier
+        from risingwave_tpu.stream.freshness import FRESHNESS
+        FRESHNESS.note_source_barrier(self.freshness_key,
+                                      barrier.epoch.curr.value)
 
     async def execute(self) -> AsyncIterator[Message]:
         # (barrier_rx teardown lives in Actor.run's close_receivers —
@@ -121,6 +136,9 @@ class SourceExecutor(Executor):
         if self.split_state is not None:
             self.split_state.init_epoch(first.epoch)
         self._recover_offset()
+        from risingwave_tpu.stream.freshness import FRESHNESS
+        FRESHNESS.note_source_barrier(self.freshness_key,
+                                      first.epoch.curr.value)
         self.paused = first.is_pause()
         yield first
         if first.is_stop(self.actor_id):
@@ -176,6 +194,14 @@ class SourceExecutor(Executor):
             chunks_this_epoch += 1
             _METRICS.source_rows.inc(chunk.cardinality(),
                                      source=self.reader.split_id)
+            from risingwave_tpu.stream import freshness as _fresh
+            if _fresh.enabled():
+                # ingest high-watermark: one vectorized max over the
+                # chunk's event-time column (arrival-clock fallback
+                # when the schema has none)
+                _fresh.FRESHNESS.note_ingest(
+                    self.freshness_key,
+                    _fresh.chunk_event_hwm(chunk, self._event_ts_idx))
             yield chunk
             # yield to the event loop so the barrier injector can run
             await asyncio.sleep(0)

@@ -18,9 +18,11 @@ consumer awaits it).
 
 from __future__ import annotations
 
+import threading
 import time
-from typing import AsyncIterator, List, Optional
+from typing import AsyncIterator, Dict, List, Optional, Tuple
 
+from risingwave_tpu.stream import exchange as _xchg
 from risingwave_tpu.stream.executor import (
     Executor, ExecutorInfo, executor_children,
 )
@@ -46,6 +48,150 @@ def set_strict_empty_chunks(on: bool) -> None:
     STRICT_EMPTY_CHUNKS = bool(on)
 
 
+# utilization tricolor toggle (ISSUE 14): SET stream_tricolor = off
+# reduces the per-barrier ratio bookkeeping (and the per-pull park-cell
+# context swap) to a predicate check — the observability-tax control
+# arm the bench's q7_tricolor_off lane measures.
+TRICOLOR = True
+
+
+def set_tricolor(on: bool) -> None:
+    global TRICOLOR
+    TRICOLOR = bool(on)
+
+
+def parse_tricolor(spec: str) -> bool:
+    """'on'|'off' → bool (SET stream_tricolor validator)."""
+    s = str(spec).strip().lower()
+    if s in ("on", "true", "1"):
+        return True
+    if s in ("off", "false", "0"):
+        return False
+    from risingwave_tpu.frontend.planner import PlanError
+    raise PlanError(f"stream_tricolor must be on|off, got {spec!r}")
+
+
+class UtilizationTable:
+    """Last-barrier utilization tricolor per (fragment, actor, node):
+    busy / backpressure / idle shares of the barrier interval — the
+    Flink-style triple, kept as a process-global snapshot the
+    bottleneck walker, ``rw_actor_utilization`` and ``ctl top`` read.
+
+    Accounting identity (gated in tier-1 strict mode, like the phase
+    ledger's conservation check): each triple sums to ≤ 1.0 + ε. Busy
+    is the node's EXCLUSIVE pull time minus its idle park (source /
+    RemoteInput / Receiver input waits) minus its credit park
+    (exchange backpressure), so the three parts partition disjoint
+    wall time inside one interval by construction — a sum above 1 is
+    a double-count bug, not noise."""
+
+    EPSILON = 0.05
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # (fragment, actor_id, node) → (executor, epoch, interval_s,
+        #                               busy, backpressure, idle)
+        self._rows: Dict[Tuple[str, int, int], tuple] = {}
+        self._violations: List[tuple] = []
+
+    def observe(self, labels: Dict[str, str], epoch: int,
+                interval_s: float, busy_s: float, bp_s: float,
+                idle_s: float) -> None:
+        if interval_s <= 0:
+            return
+        busy = busy_s / interval_s
+        bp = bp_s / interval_s
+        idle = idle_s / interval_s
+        key = (labels["fragment"], int(labels["actor"]),
+               int(labels["node"]))
+        with self._lock:
+            if busy + bp + idle > 1.0 + self.EPSILON:
+                self._violations.append(
+                    (key, labels["executor"], epoch,
+                     round(busy, 4), round(bp, 4), round(idle, 4)))
+            self._rows[key] = (labels["executor"], int(epoch),
+                               interval_s, busy, bp, idle)
+        for state, v in (("busy", busy), ("backpressure", bp),
+                         ("idle", idle)):
+            _METRICS.executor_utilization.set(v, state=state, **labels)
+
+    def get(self, fragment: str, actor_id: int, node: int
+            ) -> Optional[tuple]:
+        with self._lock:
+            return self._rows.get((fragment, actor_id, node))
+
+    def rows(self) -> List[tuple]:
+        """(actor_id, fragment, node, executor, epoch, interval_s,
+        busy_ratio, backpressure_ratio, idle_ratio) sorted by busy
+        desc — the rw_actor_utilization payload and ctl top's sort."""
+        with self._lock:
+            out = [(a, f, n, ex, e, round(i, 6), round(b, 6),
+                    round(bp, 6), round(idl, 6))
+                   for (f, a, n), (ex, e, i, b, bp, idl)
+                   in self._rows.items()]
+        return sorted(out, key=lambda r: -r[6])
+
+    def drop_actor(self, actor_id: int) -> None:
+        with self._lock:
+            dead = [k for k in self._rows if k[1] == actor_id]
+            for k in dead:
+                ex = self._rows.pop(k)[0]
+                for state in ("busy", "backpressure", "idle"):
+                    _METRICS.executor_utilization.remove(
+                        state=state, fragment=k[0],
+                        actor=str(actor_id), node=str(k[2]),
+                        executor=ex)
+
+    def gate_violations(self) -> List[tuple]:
+        with self._lock:
+            return list(self._violations)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rows.clear()
+            self._violations.clear()
+
+
+UTILIZATION = UtilizationTable()
+
+
+class Topology:
+    """Deployed monitored chains by actor: (fragment, root wrapper) —
+    the graph the bottleneck walker descends (wrapper .children edges
+    are exactly the dataflow's upstream edges, input-channel nodes
+    included). Registered by install_monitoring, dropped at actor
+    exit."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._actors: Dict[int, Tuple[str, "MonitoredExecutor"]] = {}
+
+    def register(self, actor_id: int, fragment: str,
+                 root: "MonitoredExecutor") -> None:
+        with self._lock:
+            self._actors[actor_id] = (fragment, root)
+
+    def drop_actor(self, actor_id: int) -> None:
+        with self._lock:
+            self._actors.pop(actor_id, None)
+        UTILIZATION.drop_actor(actor_id)
+
+    def roots(self, fragments=None) -> List[tuple]:
+        """[(actor_id, fragment, root wrapper)]; ``fragments`` (a set
+        of job names) restricts to one barrier domain's chains."""
+        with self._lock:
+            items = list(self._actors.items())
+        return [(a, f, r) for a, (f, r) in items
+                if fragments is None or f in fragments]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._actors.clear()
+
+
+TOPOLOGY = Topology()
+
+
 class MonitoredExecutor(Executor):
     """Transparent metrics wrapper around one executor node."""
 
@@ -63,6 +209,14 @@ class MonitoredExecutor(Executor):
         self._mark_own = 0.0        # totals at the last barrier
         self._mark_kids = 0.0
         self._mark_idle = 0.0       # inner.idle_wait_s at last barrier
+        # exchange-credit park time recorded during THIS node's pulls
+        # (stream/exchange.py cell contract, mirroring the ledger
+        # cells) — subtracted from busy and published as the tricolor's
+        # backpressure share
+        self._park_cell = [0.0]
+        self._mark_park = 0.0
+        self._mark_meter = 0.0      # actor-loop meter mark (root only)
+        self._last_flush_pc: Optional[float] = None
         self._who = f"actor-{actor_id}/{node}:{inner.identity}"
         # phase-ledger attribution cell: named phases recorded during
         # THIS executor's pulls land here (asyncio-context scoped, so
@@ -99,8 +253,40 @@ class MonitoredExecutor(Executor):
             idle_delta = max(0.0, idle - self._mark_idle)
             excl = max(0.0, excl - idle_delta)
             self._mark_idle = idle
+        # sender-side credit park (ISSUE 14): time this node's pulls
+        # spent BLOCKED for exchange credits is backpressure, not
+        # processing — without the subtraction a straggler diagnosis
+        # blames the victim of a slow consumer. Only the IN-PULL park
+        # (the cell) comes out of busy: the actor-loop meter's
+        # dispatch parks happen BETWEEN pulls and were never in
+        # total_busy_s — subtracting them too would deflate the
+        # root's real work. The root (node 0) still drains the meter
+        # into its backpressure share (the park is that actor's wall
+        # time either way).
+        park_pull = max(0.0, self._park_cell[0] - self._mark_park)
+        self._mark_park = self._park_cell[0]
+        if park_pull > 0:
+            excl = max(0.0, excl - park_pull)
+        park_delta = park_pull
+        if self.labels["node"] == "0":
+            meter = _xchg.current_actor_meter()
+            if meter is not None:
+                park_delta += max(0.0, meter[0] - self._mark_meter)
+                self._mark_meter = meter[0]
         _METRICS.executor_busy.inc(excl, **self.labels)
         _METRICS.executor_epoch_seconds.observe(excl, **self.labels)
+        if TRICOLOR:
+            # utilization tricolor: busy / backpressure / idle shares
+            # of THIS node's barrier-to-barrier interval (its own
+            # flush-to-flush wall clock — all three parts are disjoint
+            # wall time inside it, so the triple sums to ≤ 1)
+            now_pc = time.perf_counter()
+            if self._last_flush_pc is not None:
+                UTILIZATION.observe(
+                    self.labels, epoch,
+                    interval_s=now_pc - self._last_flush_pc,
+                    busy_s=excl, bp_s=park_delta, idle_s=idle_delta)
+            self._last_flush_pc = now_pc
         if _ledger.enabled():
             # phase ledger: named phases recorded during this
             # executor's pulls commit epoch-exactly; the exclusive
@@ -114,6 +300,11 @@ class MonitoredExecutor(Executor):
             if resid > 0:
                 _ledger.LEDGER.attribute(self._fallback_phase, resid,
                                          epoch)
+            if park_delta > 0:
+                # credit parks are their own ledger phase: the wall
+                # time subtracted from busy must still be conserved
+                _ledger.LEDGER.attribute("backpressure_wait",
+                                         park_delta, epoch)
             if idle_delta > 0:
                 # keyed per source: parallel sources park CONCURRENTLY
                 # and the ledger folds the across-source max, not the
@@ -167,11 +358,19 @@ class MonitoredExecutor(Executor):
                 # exclusive busy time nests
                 ctok = _ledger.LEDGER.push_cell(self._cell) \
                     if _ledger.enabled() else None
+                # park cell: exchange-credit parks fired while the
+                # inner executor works charge THIS node (a nested
+                # wrapped child swaps its own cell in for its pulls,
+                # mirroring the ledger cells)
+                ptok = _xchg.push_park_cell(self._park_cell) \
+                    if TRICOLOR else None
                 try:
                     msg = await it.__anext__()
                 except StopAsyncIteration:
                     break
                 finally:
+                    if ptok is not None:
+                        _xchg.pop_park_cell(ptok)
                     if ctok is not None:
                         _ledger.LEDGER.pop_cell(ctok)
                     _AWAITS.exit(self._who)
@@ -229,4 +428,8 @@ def install_monitoring(root: Executor, fragment: str,
         return MonitoredExecutor(ex, fragment, actor_id, node,
                                  children)
 
-    return wrap(root)
+    wrapped = wrap(root)
+    # the wrapped chain IS the dataflow graph the bottleneck walker
+    # descends — register it (actor teardown drops the entry)
+    TOPOLOGY.register(actor_id, fragment, wrapped)
+    return wrapped

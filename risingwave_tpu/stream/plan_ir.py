@@ -305,7 +305,8 @@ def build_fragment(nodes: List[dict], store, local,
             ex = SourceExecutor(
                 reader, rx, split, actor_id=int(node["actor_id"]),
                 rate_limit_chunks_per_barrier=node.get("rate_limit"),
-                min_chunks_per_barrier=node.get("min_chunks"))
+                min_chunks_per_barrier=node.get("min_chunks"),
+                freshness_key=node.get("freshness_key"))
             src_executor = ex
         elif op == "project":
             child = built[node["input"]]
@@ -434,7 +435,8 @@ def build_fragment(nodes: List[dict], store, local,
                             dist_key_indices=(
                                 [int(i) for i in dist]
                                 if dist else None))
-            ex = MaterializeExecutor(child, mv)
+            ex = MaterializeExecutor(child, mv,
+                                     mv_name=node.get("mv_name", ""))
         elif op == "hash_agg":
             child = built[node["input"]]
             calls = [AggCall(AggKind(c["kind"]),

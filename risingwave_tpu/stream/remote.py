@@ -220,7 +220,7 @@ class ExchangeServer:
         self._edges[key] = q
         sem = asyncio.Semaphore(0)
         self._credits[key] = sem
-        o = RemoteOutputQueue(q, sem)
+        o = RemoteOutputQueue(q, sem, label=f"remote:{up}->{down}")
         self._outputs[key] = o
         return o
 
@@ -301,10 +301,14 @@ class RemoteOutputQueue:
     barriers bypass the data budget so checkpoints can't be starved by
     backpressure (permit.rs's separate barrier budget)."""
 
-    def __init__(self, q: asyncio.Queue, credits: asyncio.Semaphore):
+    def __init__(self, q: asyncio.Queue, credits: asyncio.Semaphore,
+                 label: str = ""):
         self._q = q
         self._credits = credits
         self._broken = False
+        # channel label in stream_backpressure_wait_seconds — remote
+        # credit parks are the cross-node half of sender backpressure
+        self.label = label
 
     def mark_broken(self) -> None:
         """Downstream disconnected: wake blocked senders into an error
@@ -319,7 +323,18 @@ class RemoteOutputQueue:
             from risingwave_tpu.stream.coalesce import is_empty
             if is_empty(msg):
                 return     # nothing to ship: no frame, no credit burned
-            await self._credits.acquire()
+            if self._credits.locked():
+                # credit-starved: the wire peer is behind — park time
+                # is backpressure, not the sending executor's work
+                import time as _time
+                from risingwave_tpu.stream.exchange import (
+                    note_backpressure,
+                )
+                t0 = _time.perf_counter()
+                await self._credits.acquire()
+                note_backpressure(_time.perf_counter() - t0, self.label)
+            else:
+                await self._credits.acquire()
             if self._broken:
                 self._credits.release()  # cascade the wake-up
                 raise ConnectionError(

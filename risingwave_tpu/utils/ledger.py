@@ -27,6 +27,10 @@ attributable, continuously, not in one-off cProfile runs):
                       provably host work but not pack/transfer).
 - ``barrier_wait``  — source executors parked on the barrier channel
                       (idle, not processing).
+- ``backpressure_wait`` — senders parked for exchange credits (a slow
+                      consumer's wall time, subtracted from the parking
+                      executor's busy share — stream/monitor.py's
+                      utilization tricolor carries the per-actor view).
 
 Two disciplines keep the ledger honest:
 
@@ -65,7 +69,7 @@ from contextvars import ContextVar
 from typing import Deque, Dict, Iterable, List, Optional
 
 PHASES = ("host_ingest", "host_pack", "h2d", "device_compute", "d2h",
-          "host_emit", "barrier_wait")
+          "host_emit", "barrier_wait", "backpressure_wait")
 UNATTRIBUTED = "unattributed"
 
 # open-epoch accumulators kept (epochs are injected faster than sealed
@@ -464,6 +468,12 @@ class PhaseLedger:
         extra["coverage"] = rec.coverage()
         extra["epoch_h2d_bytes"] = float(rec.h2d_bytes)
         extra["epoch_d2h_bytes"] = float(rec.d2h_bytes)
+        # per-MV freshness of this domain's barrier (ISSUE 14): the
+        # materialize passages keyed by the same CURR epoch — so the
+        # autoscaler's rw_metrics_history feed carries event-time lag
+        # next to the phase shares it must explain
+        from risingwave_tpu.stream.freshness import FRESHNESS
+        extra.update(FRESHNESS.history_extra(rec.epoch, rec.domain))
         HISTORY.observe(rec.epoch, rec.interval_s, extra=extra,
                         domain=rec.domain)
         if not _spans.enabled():

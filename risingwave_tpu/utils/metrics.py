@@ -358,6 +358,36 @@ class StreamingMetrics:
             "stream_exchange_backpressure_seconds",
             "time senders spent acquiring permits per edge "
             "(stream_exchange_backpressure analog)")
+        # -- freshness & bottleneck attribution (ISSUE 14) ------------
+        self.backpressure_wait = r.counter(
+            "stream_backpressure_wait_seconds",
+            "sender-side credit park time per channel — wall time a "
+            "sender spent BLOCKED for exchange credits (subtracted "
+            "from the parking executor's busy time, so straggler "
+            "diagnoses stop blaming the victim of a slow consumer)")
+        self.executor_utilization = r.gauge(
+            "stream_executor_utilization_ratio",
+            "utilization tricolor per (fragment, actor, executor, "
+            "node) and state=busy|backpressure|idle: the share of the "
+            "last barrier interval spent processing / parked on "
+            "downstream credits / parked waiting for input; the "
+            "triple sums to <= 1.0 (gated in tier-1 strict mode)")
+        self.mv_freshness_lag = r.gauge(
+            "stream_mv_freshness_lag_seconds",
+            "per-MV event-time freshness lag at the last barrier: "
+            "source ingest high-watermark minus the event-time "
+            "frontier of what the MV has materialized (seconds of "
+            "event time the reader is behind the data)")
+        self.mv_freshness_wall_lag = r.gauge(
+            "stream_mv_freshness_wall_lag_seconds",
+            "per-MV wall-clock freshness lag at the last barrier: "
+            "now minus the wall stamp of the newest ingested data "
+            "visible in the MV")
+        self.bottleneck_streak = r.gauge(
+            "stream_bottleneck_streak",
+            "contiguous barriers the named operator has been its "
+            "domain's walked bottleneck (stream/bottleneck.py); the "
+            "series resets when the walk names another operator")
         self.exchange_send_count = r.counter(
             "stream_exchange_send_count",
             "messages sent per exchange edge")

@@ -104,6 +104,43 @@ def _conservation_gate():
         f"{[(hex(e), round(i, 3), round(u, 3), c, d) for e, i, u, c, d in violations]}")
 
 
+@pytest.fixture(autouse=True)
+def _tricolor_freshness_gate():
+    """Tier-1 strict mode for the utilization tricolor and per-MV
+    freshness (stream/monitor.py + stream/freshness.py): every
+    published busy/backpressure/idle triple must sum to ≤ 1.0 + ε
+    (the three parts partition disjoint wall time by construction —
+    an oversum is a double-count bug), and every resolved freshness
+    sample must be finite and non-negative once the first frontier
+    passes materialize. Same arming pattern as the ledger
+    conservation gate."""
+    from risingwave_tpu.stream import freshness as _fresh
+    from risingwave_tpu.stream import monitor as _monitor
+    from risingwave_tpu.stream.bottleneck import BOTTLENECKS
+    _monitor.set_tricolor(True)
+    _fresh.set_enabled(True)
+    _monitor.UTILIZATION.clear()
+    _fresh.FRESHNESS.clear()
+    BOTTLENECKS.clear()
+    yield
+    tri = _monitor.UTILIZATION.gate_violations()
+    lag = _fresh.FRESHNESS.gate_violations()
+    _monitor.UTILIZATION.clear()
+    _fresh.FRESHNESS.clear()
+    BOTTLENECKS.clear()
+    _monitor.set_tricolor(True)
+    _fresh.set_enabled(True)
+    assert not tri, (
+        "utilization tricolor gate (tier-1 strict mode): published "
+        "busy+backpressure+idle triples exceed 1.0 + ε — two states "
+        "claim the same wall time. ((fragment, actor, node), "
+        f"executor, epoch, busy, bp, idle): {tri[:5]}")
+    assert not lag, (
+        "freshness gate (tier-1 strict mode): per-MV lag samples "
+        "must be finite and non-negative once the first frontier "
+        f"passes materialize. (mv, epoch, lag, wall_lag): {lag[:5]}")
+
+
 def _worker_children() -> list:
     """PIDs of live `risingwave_tpu.cluster.worker` subprocesses whose
     parent is this test process. Zombies (state Z) don't count — a

@@ -1,0 +1,162 @@
+"""Bottleneck walker tests (ISSUE 14): the walk names the
+busy-dominated operator, streaks make it sustained, idle domains
+report none, and the SQL surface serves the ranked table."""
+
+import asyncio
+import time
+
+from risingwave_tpu.common.types import DataType, Field, Schema
+from risingwave_tpu.stream.bottleneck import (
+    BOTTLENECKS, BUSY_DOMINANT, SUSTAINED_STREAK,
+)
+from risingwave_tpu.stream.executor import Executor, ExecutorInfo
+from risingwave_tpu.stream.message import StopMutation, is_chunk
+
+SCH = Schema([Field("a", DataType.INT64)])
+
+
+class HotPass(Executor):
+    """Burns host CPU per chunk — the operator the walk must name."""
+
+    def __init__(self, input_, busy_s: float = 0.05,
+                 ident: str = "HotPass"):
+        super().__init__(ExecutorInfo(
+            input_.schema, list(input_.pk_indices), ident))
+        self.input = input_
+        self.busy_s = busy_s
+
+    async def execute(self):
+        async for msg in self.input.execute():
+            if is_chunk(msg):
+                t0 = time.perf_counter()
+                while time.perf_counter() - t0 < self.busy_s:
+                    pass
+            yield msg
+
+
+def _run_pipeline(n_data_epochs: int, idle_epochs: int = 0,
+                  busy_s: float = 0.35):
+    """One actor: MockSource → HotPass → CheapPass root, driven by a
+    real BarrierLoop (the walker hook runs at every seal). The
+    default busy burn pushes each data epoch past SLOW_INTERVAL_S so
+    the streak machine ticks."""
+    from risingwave_tpu.common.chunk import StreamChunk
+    from risingwave_tpu.meta.barrier import BarrierLoop
+    from risingwave_tpu.state.store import MemoryStateStore
+    from risingwave_tpu.stream.actor import Actor, LocalBarrierManager
+    from risingwave_tpu.stream.executors.test_utils import MockSource
+    from risingwave_tpu.stream.monitor import install_monitoring
+
+    class CheapRoot(Executor):
+        def __init__(self, input_):
+            super().__init__(ExecutorInfo(SCH, [], "CheapRoot"))
+            self.input = input_
+
+        async def execute(self):
+            async for msg in self.input.execute():
+                yield msg
+
+    async def run():
+        store = MemoryStateStore()
+        local = LocalBarrierManager()
+        tx, src = MockSource.channel(SCH)
+        local.register_sender(5, tx)
+        consumer = install_monitoring(
+            CheapRoot(HotPass(src, busy_s=busy_s)),
+            fragment="bn-test", actor_id=5)
+        local.set_expected_actors([5])
+        actor = Actor(5, consumer, dispatchers=[],
+                      barrier_manager=local, fragment="bn-test")
+        loop = BarrierLoop(local, store)
+        task = actor.spawn()
+        await loop.inject_and_collect(force_checkpoint=True)
+        for _ in range(n_data_epochs):
+            for _ in range(2):
+                await src._tx.send(StreamChunk.from_pydict(
+                    SCH, {"a": [1, 2, 3, 4]}))
+            await loop.inject_and_collect(force_checkpoint=True)
+        mid = BOTTLENECKS.summary().get("(global)", {})
+        for _ in range(idle_epochs):
+            await loop.inject_and_collect(force_checkpoint=True)
+        end = BOTTLENECKS.summary().get("(global)", {})
+        await loop.inject_and_collect(
+            mutation=StopMutation(frozenset({5})))
+        await task
+        assert actor.failure is None
+        return mid, end
+
+    return asyncio.run(run())
+
+
+def test_walker_names_hot_operator_and_sustains():
+    mid, _end = _run_pipeline(n_data_epochs=SUSTAINED_STREAK + 1)
+    assert mid.get("operator") == "HotPass", mid
+    assert mid["busy_ratio"] >= BUSY_DOMINANT
+    assert mid["streak"] >= SUSTAINED_STREAK
+    assert mid["sustained"] is True
+    assert "scale this operator first" in mid["diagnosis"]
+    # the Prometheus streak series named the same operator
+    from risingwave_tpu.utils.metrics import STREAMING
+    assert STREAMING.bottleneck_streak.get(
+        domain="", operator="HotPass") >= SUSTAINED_STREAK
+
+
+def test_fast_domain_never_sustains():
+    """A domain holding fast barriers is healthy: its hottest operator
+    never enters the streak machine — the q7-neighbor acceptance
+    shape (no sustained bottleneck)."""
+    mid, end = _run_pipeline(n_data_epochs=SUSTAINED_STREAK + 1,
+                             busy_s=0.005)
+    assert mid.get("operator") is None
+    assert end.get("operator") is None
+    assert end.get("sustained") is False
+    assert "no sustained bottleneck" in end.get("diagnosis", "")
+
+
+def test_idle_epochs_freeze_the_streak():
+    """Empty trailing epochs (a drained domain) FREEZE the machine:
+    the verdict its last slow barrier earned survives a drain — the
+    multimv ad-ctr acceptance shape (the streak must not vanish just
+    because the lane finished)."""
+    _mid, end = _run_pipeline(n_data_epochs=SUSTAINED_STREAK + 1,
+                              idle_epochs=3)
+    assert end.get("operator") == "HotPass"
+    assert end.get("sustained") is True
+
+
+def test_rw_bottlenecks_system_table():
+    from risingwave_tpu.frontend import Frontend
+
+    async def run():
+        fe = Frontend(min_chunks=4)
+        await fe.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', "
+            "nexmark.table.type='bid', nexmark.event.num=30000)")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW bn_mv AS SELECT window_start, "
+            "COUNT(*) AS c FROM TUMBLE(bid, date_time, "
+            "INTERVAL '10' SECOND) GROUP BY window_start")
+        await fe.step(5)
+        rows = await fe.execute("SELECT * FROM rw_bottlenecks")
+        util = await fe.execute(
+            "SELECT * FROM rw_actor_utilization")
+        await fe.close()
+        return rows, util
+
+    rows, util = asyncio.run(run())
+    assert rows, "rw_bottlenecks must serve the walker state"
+    # every triple the utilization table serves respects the identity
+    for r in util:
+        busy, bp, idle = r[6], r[7], r[8]
+        assert busy + bp + idle <= 1.05, r
+    # the MV's domain row exists (named bn_mv under the plane)
+    domains = {r[0] for r in rows}
+    assert "bn_mv" in domains or "" in domains
+
+
+def test_walker_clear_drops_gauges():
+    from risingwave_tpu.utils.metrics import STREAMING
+    _mid, _end = _run_pipeline(n_data_epochs=SUSTAINED_STREAK)
+    BOTTLENECKS.clear()
+    assert not [l for l, v in STREAMING.bottleneck_streak.series()
+                if l.get("domain") == ""]

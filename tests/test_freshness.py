@@ -1,0 +1,201 @@
+"""Per-MV event-time freshness tests (ISSUE 14): barrier-lineage lag
+accounting, the cross-process merge, and the SQL/system-table/history
+surfaces."""
+
+import asyncio
+
+import pytest
+
+from risingwave_tpu.stream.freshness import (
+    FRESHNESS, FreshnessTracker, chunk_event_hwm, event_time_index,
+)
+
+
+def test_tracker_lag_math():
+    t = FreshnessTracker()
+    t.register_mv("mv1", ["src"], domain="d1")
+    # ingest up to event-time 1_000_000us, then the barrier frontier
+    t.note_ingest("src", 1_000_000, wall_s=100.0)
+    t.note_source_barrier("src", 7)
+    # by visibility time the source is 5s of event time ahead
+    t.note_ingest("src", 6_000_000, wall_s=101.0)
+    t.note_visible("mv1", 7, wall_s=102.5)
+    rows = {r[0]: r for r in t.rows()}
+    mv, domain, n, epoch, lag, wall_lag, p50, p99, wp99 = rows["mv1"]
+    assert domain == "d1"
+    assert n == 1 and epoch == 7
+    assert lag == pytest.approx(5.0, abs=1e-6)
+    assert wall_lag > 0
+    assert p99 == pytest.approx(5.0, abs=1e-6)
+    assert not t.gate_violations()
+
+
+def test_tracker_multi_source_takes_worst_lag():
+    t = FreshnessTracker()
+    t.register_mv("mv", ["a", "b"])
+    t.note_ingest("a", 1_000_000)
+    t.note_ingest("b", 1_000_000)
+    t.note_source_barrier("a", 3)
+    t.note_source_barrier("b", 3)
+    t.note_ingest("a", 2_000_000)   # a: 1s ahead
+    t.note_ingest("b", 9_000_000)   # b: 8s ahead — the worst source
+    t.note_visible("mv", 3)
+    lag = t.rows()[0][4]
+    assert lag == pytest.approx(8.0, abs=1e-6)
+
+
+def test_pending_visibility_resolves_on_ingest_merge():
+    """Cross-process shape: the materialize fragment's tracker has no
+    source frontier — its visibility event parks pending and resolves
+    when the source worker's parts merge in."""
+    src_worker = FreshnessTracker()
+    src_worker.note_ingest("s", 500_000, wall_s=10.0)
+    src_worker.note_source_barrier("s", 11)
+    src_worker.note_ingest("s", 1_500_000, wall_s=11.0)
+
+    coord = FreshnessTracker()
+    coord.register_mv("mv", ["s"])
+    coord.note_visible("mv", 11, wall_s=12.0)       # frontier unknown
+    assert coord.rows()[0][2] == 0                  # no sample yet
+    n = coord.ingest(src_worker.drain_dict())
+    assert n == 1
+    mv_row = coord.rows()[0]
+    assert mv_row[2] == 1
+    assert mv_row[4] == pytest.approx(1.0, abs=1e-6)
+    # repeated drains never double-count: the source worker's pendings
+    # left with the first drain
+    assert coord.ingest(src_worker.drain_dict()) == 0
+    assert coord.rows()[0][2] == 1
+
+
+def test_worker_unregistered_visibility_ships_to_coordinator():
+    """The real cluster shape: registration lives ONLY on the
+    coordinator. A worker's materialize fragment (tracker with no
+    _mvs entry) must PARK its visibility event so drain_dict ships it
+    — dropping it would make the whole drain_freshness chain a
+    no-op."""
+    src_worker = FreshnessTracker()
+    src_worker.note_ingest("s", 500_000, wall_s=10.0)
+    src_worker.note_source_barrier("s", 21)
+    src_worker.note_ingest("s", 2_500_000, wall_s=11.0)
+
+    mat_worker = FreshnessTracker()          # no registration here
+    mat_worker.note_visible("mv", 21, wall_s=12.0)
+    parts = mat_worker.drain_dict()
+    assert parts["visible"], "worker must ship the visibility event"
+
+    coord = FreshnessTracker()
+    coord.register_mv("mv", ["s"])
+    coord.ingest(src_worker.drain_dict())
+    assert coord.ingest(parts) == 1
+    row = coord.rows()[0]
+    assert row[2] == 1
+    assert row[4] == pytest.approx(2.0, abs=1e-6)
+
+
+def test_empty_frontier_never_mints_negative_lag():
+    """A source passing a barrier BEFORE ingesting anything records an
+    empty frontier marker — later historical event times must yield
+    lag 0 (nothing was visible), never a negative wall-vs-event-time
+    artifact."""
+    t = FreshnessTracker()
+    t.register_mv("mv", ["s"])
+    t.note_source_barrier("s", 9)            # nothing ingested yet
+    # historical event times (a 2015-style dataset), far below any
+    # wall-clock microsecond value
+    t.note_ingest("s", 1_436_918_400_000_000)
+    t.note_visible("mv", 9)
+    row = t.rows()[0]
+    assert row[2] == 1
+    assert row[4] == 0.0                     # lag_s: empty frontier
+    assert row[5] >= 0.0                     # wall_lag_s
+    assert not t.gate_violations()
+
+
+def test_duplicate_slice_visibility_dedupes():
+    t = FreshnessTracker()
+    t.register_mv("mv", ["s"])
+    t.note_ingest("s", 1_000_000)
+    t.note_source_barrier("s", 5)
+    t.note_visible("mv", 5)
+    t.note_visible("mv", 5)      # a second slice of the same MV
+    assert t.rows()[0][2] == 1
+
+
+def test_event_time_index_and_chunk_hwm():
+    import numpy as np
+    from risingwave_tpu.common.chunk import StreamChunk
+    from risingwave_tpu.common.types import DataType, Field, Schema
+    sch = Schema([Field("id", DataType.INT64),
+                  Field("ts", DataType.TIMESTAMP)])
+    assert event_time_index(sch) == 1
+    assert event_time_index(
+        Schema([Field("id", DataType.INT64)])) is None
+    chunk = StreamChunk.from_pydict(
+        sch, {"id": [1, 2, 3], "ts": [100, 900, 300]})
+    assert chunk_event_hwm(chunk, 1) == 900
+    assert chunk_event_hwm(chunk, None) is None
+    # invisible rows don't count
+    vis = np.asarray(chunk.visibility).copy()
+    vis[:] = False
+    masked = StreamChunk(chunk.schema, chunk.columns, vis, chunk.ops)
+    assert chunk_event_hwm(masked, 1) is None
+
+
+def test_session_freshness_end_to_end():
+    """SQL front door: per-MV samples land with finite non-negative
+    lags, rw_mv_freshness serves them, rw_metrics_history carries the
+    per-barrier freshness rows, and DROP unregisters."""
+    from risingwave_tpu.frontend import Frontend
+
+    async def run():
+        fe = Frontend(min_chunks=2)
+        await fe.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', "
+            "nexmark.table.type='bid', nexmark.event.num=4000)")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW fresh_mv AS SELECT "
+            "window_start, COUNT(*) AS c FROM TUMBLE(bid, date_time, "
+            "INTERVAL '10' SECOND) GROUP BY window_start")
+        await fe.step(4)
+        fresh = await fe.execute("SELECT * FROM rw_mv_freshness")
+        hist = await fe.execute(
+            "SELECT * FROM rw_metrics_history")
+        gauge_rows = FRESHNESS.summary()
+        await fe.execute("DROP MATERIALIZED VIEW fresh_mv")
+        after_drop = FRESHNESS.summary()
+        await fe.close()
+        return fresh, hist, gauge_rows, after_drop
+
+    fresh, hist, summary, after_drop = asyncio.run(run())
+    row = next(r for r in fresh if r[0] == "fresh_mv")
+    assert row[2] > 0                       # samples recorded
+    assert row[4] is not None and row[4] >= 0.0   # lag_s
+    assert row[5] is not None and row[5] >= 0.0   # wall_lag_s
+    assert "fresh_mv" in summary
+    assert summary["fresh_mv"]["wall_lag_p99_s"] >= 0.0
+    # per-barrier history rows carry the freshness series
+    names = {r[4] for r in hist}
+    assert "freshness_lag_s.fresh_mv" in names
+    assert "freshness_wall_lag_s.fresh_mv" in names
+    assert "fresh_mv" not in after_drop
+
+
+def test_table_dml_freshness():
+    """CREATE TABLE jobs sample freshness through their DML source."""
+    from risingwave_tpu.frontend import Frontend
+
+    async def run():
+        fe = Frontend()
+        await fe.execute("CREATE TABLE t1 (v BIGINT, ts TIMESTAMP)")
+        await fe.execute(
+            "INSERT INTO t1 VALUES (1, '2024-01-01 00:00:00')")
+        await fe.step(2)
+        rows = await fe.execute(
+            "SELECT mv, samples FROM rw_mv_freshness")
+        await fe.close()
+        return rows
+
+    rows = asyncio.run(run())
+    row = next(r for r in rows if r[0] == "t1")
+    assert row[1] > 0
