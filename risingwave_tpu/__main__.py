@@ -33,6 +33,13 @@ backup operations against a data directory:
                               # event-time freshness, and each
                               # domain's current bottleneck with its
                               # one-line diagnosis
+    python -m risingwave_tpu ctl --data-dir D autoscale [--steps K]
+                              # elastic control loop: the
+                              # rw_autoscaler decision ledger plus
+                              # the bottleneck/freshness signals a
+                              # decision would read (live decisions
+                              # ride the serving coordinator — SET
+                              # stream_autoscale=on there)
     python -m risingwave_tpu ctl --data-dir D backup create|list|
         delete <id> | restore <id> --target T
 """
@@ -161,6 +168,8 @@ def _ctl(args) -> int:
         return asyncio.run(_ctl_phases(obj, args))
     if verb == "top":
         return asyncio.run(_ctl_top(obj, args))
+    if verb == "autoscale":
+        return asyncio.run(_ctl_autoscale(obj, args))
     if verb == "backup":
         from risingwave_tpu.meta.backup import (
             create_backup, delete_backup, list_backups, restore_backup,
@@ -421,6 +430,56 @@ async def _ctl_top(obj, args) -> int:
     return 0
 
 
+async def _ctl_autoscale(obj, args) -> int:
+    """Recover into an in-memory clone (same snapshot discipline as
+    `table scan`), drive a few checkpoints, and print the elastic
+    control loop's view: the decision ledger (rw_autoscaler — on a
+    serving cluster this holds the live history; offline it shows what
+    this inspection process decided, normally nothing) and the signals
+    a decision would read — per-domain bottleneck verdicts and per-MV
+    freshness. The live workflow: ``SET stream_autoscale = on`` on the
+    serving session, then ``SELECT * FROM rw_autoscaler`` /
+    ``rw_recovery`` over pgwire."""
+    from risingwave_tpu.frontend import Frontend
+    from risingwave_tpu.meta.autoscaler import autoscaler_rows
+    from risingwave_tpu.storage.hummock import HummockLite
+    from risingwave_tpu.stream.bottleneck import BOTTLENECKS
+    from risingwave_tpu.stream.freshness import FRESHNESS
+
+    fe = Frontend(HummockLite(_snapshot_clone(obj)))
+    await fe.recover()
+    try:
+        await fe.step(args.steps)
+        rows = autoscaler_rows()
+        print("== autoscaler decision ledger ==")
+        if not rows:
+            print("(empty — decisions live on the serving "
+                  "coordinator; query rw_autoscaler there)")
+        for (seq, mv, frag, op, direction, fp, tp, outcome, reason,
+             _e, dur, detail) in rows:
+            print(f"#{seq} {mv}/f{frag} {direction} {fp}->{tp} "
+                  f"[{outcome}] {dur:.2f}s  {reason}"
+                  + (f"  ({detail})" if detail else ""))
+        print("== signals a decision would read ==")
+        for (dom, op, _frag, actor, _node, busy, bp, streak,
+             sustained, _e, diag) in BOTTLENECKS.rows():
+            label = dom or "(global)"
+            if op is None:
+                print(f"{label}: no sustained bottleneck")
+            else:
+                print(f"{label}: {op} busy {busy:.0%} streak {streak}"
+                      + (" [SUSTAINED — actionable]" if sustained
+                         else " (not sustained — ignored)"))
+        for (mv, dom, n, _e, lag, wall, _p50, _p99,
+             wp99) in FRESHNESS.rows():
+            if n:
+                print(f"freshness {mv}: lag {lag:.3f}s wall "
+                      f"{wall:.3f}s wall_p99 {wp99:.3f}s")
+    finally:
+        await fe.close()
+    return 0
+
+
 def main(argv=None) -> None:
     # the axon sitecustomize rewrites jax_platforms at interpreter
     # start, overriding JAX_PLATFORMS=cpu — honor the env var so ctl /
@@ -491,6 +550,14 @@ def main(argv=None) -> None:
                     help="checkpoint barriers to drive per refresh")
     tp.add_argument("--watch", type=int, default=1,
                     help="refresh cycles to print (drive+print each)")
+    asc = csub.add_parser(
+        "autoscale",
+        help="recover + print the elastic control loop's view: the "
+             "rw_autoscaler decision ledger and the bottleneck/"
+             "freshness signals a decision would read")
+    asc.add_argument("--steps", type=int, default=4,
+                     help="checkpoint barriers to drive before the "
+                          "report")
     bk = csub.add_parser("backup")
     bk.add_argument("what",
                     choices=["create", "list", "delete", "restore"])

@@ -26,6 +26,26 @@ Fault vocabulary (each exercises one rung of the response ladder):
   timeout: ``BarrierWedgedError``, classified ``wedged_barrier``,
   full recovery.
 
+Mid-rescale faults (ISSUE 15 — every scaling action chaos-tested the
+same way the recovery ladder was proven; each event arms the fault
+THEN drives a guarded rescale through the session's ALTER path, the
+same protocol the autoscaler drives):
+
+- ``kill_mid_rescale`` — SIGKILL one worker exactly at the cohort
+  REDEPLOY phase (the cluster's one-shot rescale fault hook). The
+  rollback cannot complete against a dead slot, so the supervised
+  ladder finishes the job: ``dead_worker``/respawn at the prior
+  topology, with the rollback attempt in ``rw_recovery``.
+- ``fault_mid_handoff`` — one worker's ``ingest_table`` RPC raises
+  during the STATE HANDOFF (worker.rpc failpoint, times=1): the
+  guarded rescale reverses the moved rows from its in-memory log and
+  rolls back to the prior parallelism — no recovery needed, the
+  domain keeps serving.
+- ``straggler_mid_rescale`` — an executor sleeps past the collect
+  timeout under the rescale's STOP barrier: the failure lands before
+  any change (``phase="stop"``), the domain's health is unknown, and
+  the supervisor answers ``wedged_barrier``/full.
+
 Faults inject into LIVE worker processes over the control channel's
 ``arm_failpoints`` verb (exception specs are JSON — the failpoint
 env/wire restriction), so a respawned worker always comes back clean.
@@ -94,6 +114,13 @@ def generate_schedule(seed: int, n_workers: int = 2,
         key=lambda e: (e.step, e.kind))
 
 
+# mid-rescale fault kinds: each arms its fault, then drives a guarded
+# rescale (the session ALTER path — the same protocol the autoscaler
+# drives) so the fault lands inside the named rescale phase
+RESCALE_KINDS = frozenset({"kill_mid_rescale", "fault_mid_handoff",
+                           "straggler_mid_rescale"})
+
+
 @dataclass
 class ChaosReport:
     """What a chaos run produced — the bench-snapshot payload and the
@@ -104,6 +131,9 @@ class ChaosReport:
     recoveries: List[tuple] = field(default_factory=list)  # (cause, action)
     mttr_s: List[float] = field(default_factory=list)
     absorbed_retries: Dict[str, float] = field(default_factory=dict)
+    # guarded rescales that unwound in place: (phase, rolled_back) —
+    # rolled_back=True means no recovery was needed
+    rescale_rollbacks: List[tuple] = field(default_factory=list)
     wall_s: float = 0.0
 
     def summary(self) -> dict:
@@ -117,6 +147,8 @@ class ChaosReport:
                             if self.mttr_s else 0.0),
             "mttr_max_s": max(self.mttr_s, default=0.0),
             "absorbed_retries": dict(self.absorbed_retries),
+            "rescale_rollbacks": [list(r)
+                                  for r in self.rescale_rollbacks],
         }
 
 
@@ -127,13 +159,21 @@ class ChaosRunner:
     The caller owns the oracle comparison (and the frontend)."""
 
     def __init__(self, fe, schedule: List[ChaosEvent], seed: int,
-                 steps: int = 24, settle_steps: int = 40):
+                 steps: int = 24, settle_steps: int = 40,
+                 rescale_mv: Optional[str] = None):
         self.fe = fe
         self.schedule = list(schedule)
         self.seed = seed
         self.steps = steps
         self.settle_steps = settle_steps
-        if any(e.kind == "straggler" for e in self.schedule):
+        # the MV whose guarded rescale the mid-rescale faults target
+        # (required when the schedule contains RESCALE_KINDS)
+        self.rescale_mv = rescale_mv
+        if any(e.kind in RESCALE_KINDS for e in self.schedule):
+            assert rescale_mv is not None, (
+                "a mid-rescale fault schedule needs rescale_mv")
+        if any(e.kind in ("straggler", "straggler_mid_rescale")
+               for e in self.schedule):
             assert fe.cluster.barrier_timeout_s is not None, (
                 "a straggler fault needs wedged-barrier detection: "
                 "construct the DistFrontend with barrier_timeout_s")
@@ -142,7 +182,40 @@ class ChaosRunner:
         await self.fe.cluster.clients[slot].call_idempotent(
             {"cmd": "arm_failpoints", "points": points})
 
-    async def _apply(self, ev: ChaosEvent) -> None:
+    def _alter_target(self) -> int:
+        """Deterministic rescale target: shrink a scaled job, grow a
+        single-actor one (the first rescalable fragment decides)."""
+        job = self.fe.cluster.jobs[self.rescale_mv]
+        for fi, f in enumerate(job.graph.fragments):
+            if self.fe.cluster._rescalable(f) \
+                    or self.fe.cluster._source_rescalable(f):
+                return 1 if len(job.placements[fi]) >= 2 else 2
+        return 2
+
+    async def _alter_supervised(self, report: ChaosReport) -> None:
+        """Drive the guarded rescale with the fault armed. A clean
+        rollback needs no recovery (the protocol's point); a rollback
+        that could not complete feeds the supervised ladder like any
+        other failure."""
+        from risingwave_tpu.cluster.scheduler import RescaleError
+        n = self._alter_target()
+        try:
+            await self.fe.execute(
+                f"ALTER MATERIALIZED VIEW {self.rescale_mv} "
+                f"SET PARALLELISM = {n}")
+        except RescaleError as e:
+            report.rescale_rollbacks.append((e.phase, e.rolled_back))
+            if not e.rolled_back:
+                rec = await self.fe.supervised_recover(e)
+                report.recoveries.append((rec.cause, rec.action))
+                report.mttr_s.append(rec.duration_s)
+        except Exception as e:  # noqa: BLE001 — the supervisor's job
+            rec = await self.fe.supervised_recover(e)
+            report.recoveries.append((rec.cause, rec.action))
+            report.mttr_s.append(rec.duration_s)
+
+    async def _apply(self, ev: ChaosEvent,
+                     report: ChaosReport) -> None:
         if ev.kind == "kill_worker":
             self.fe.cluster.kill_slot(ev.slot)
         elif ev.kind == "flake_object_store":
@@ -157,6 +230,28 @@ class ChaosRunner:
             timeout = self.fe.cluster.barrier_timeout_s
             await self._arm(ev.slot, {"trace.slow.HashAggExecutor": {
                 "sleep_s": timeout * 2.5, "times": 1}})
+        elif ev.kind == "kill_mid_rescale":
+            slot = ev.slot
+            self.fe.cluster.rescale_fault_hook = (
+                "redeploy", lambda: self.fe.cluster.kill_slot(slot))
+            try:
+                await self._alter_supervised(report)
+            finally:
+                # the hook disarms when it FIRES; if the ALTER failed
+                # before reaching the redeploy phase it would stay
+                # armed and fire during a later, unscheduled rescale —
+                # decoupling the fault from its seeded ChaosEvent step
+                self.fe.cluster.rescale_fault_hook = None
+        elif ev.kind == "fault_mid_handoff":
+            await self._arm(ev.slot, {"worker.rpc.ingest_table": {
+                "raise": "OSError", "msg": "chaos handoff fault",
+                "times": 1}})
+            await self._alter_supervised(report)
+        elif ev.kind == "straggler_mid_rescale":
+            timeout = self.fe.cluster.barrier_timeout_s
+            await self._arm(ev.slot, {"trace.slow.HashAggExecutor": {
+                "sleep_s": timeout * 2.5, "times": 1}})
+            await self._alter_supervised(report)
         else:
             raise ValueError(f"unknown chaos event kind {ev.kind!r}")
 
@@ -176,7 +271,7 @@ class ChaosRunner:
             by_step.setdefault(ev.step, []).append(ev)
         for i in range(self.steps):
             for ev in by_step.get(i, ()):
-                await self._apply(ev)
+                await self._apply(ev, report)
                 report.events.append(ev.row())
             await self._step_supervised(report)
         # settle: drain the sources to completion so the MV is final
@@ -206,13 +301,17 @@ async def worker_retry_totals(fe) -> Dict[str, float]:
 
 async def run_chaos(fe, seed: int, steps: int = 24,
                     settle_steps: int = 40,
-                    kinds: Optional[List[str]] = None) -> ChaosReport:
+                    kinds: Optional[List[str]] = None,
+                    rescale_mv: Optional[str] = None) -> ChaosReport:
     """Generate + replay one seeded schedule (the bench entry point).
-    Wall-clock MTTR is recorded per recovery by the supervisor."""
+    Wall-clock MTTR is recorded per recovery by the supervisor.
+    ``rescale_mv`` names the job the mid-rescale fault kinds drive
+    their guarded ALTER against."""
     schedule = generate_schedule(seed, n_workers=fe.cluster.n,
                                  steps=steps, kinds=kinds)
     t0 = time.monotonic()
     report = await ChaosRunner(fe, schedule, seed, steps=steps,
-                               settle_steps=settle_steps).run()
+                               settle_steps=settle_steps,
+                               rescale_mv=rescale_mv).run()
     report.wall_s = time.monotonic() - t0
     return report

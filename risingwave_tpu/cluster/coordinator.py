@@ -24,6 +24,17 @@ from risingwave_tpu.stream.message import (
 )
 from risingwave_tpu.utils.metrics import CLUSTER as _METRICS
 
+# control-channel line framing, BOTH ends of every worker socket
+# (replies are one JSON line each; scan_table/metrics payloads
+# overflow asyncio's 64KB default, surfacing as an opaque
+# ValueError), and the request/reply page budget derived from it:
+# pages stay comfortably under the frame even with huge rows (an
+# approx_count_distinct sketch row hex-encodes to ~100KB — whole-
+# table replies broke the channel at real MV sizes). One constant
+# pair so the two ends can never drift apart.
+CONTROL_LINE_LIMIT = 1 << 24
+CONTROL_PAGE_BYTES = 4 << 20
+
 # verbs safe to RE-SEND after a reconnect: each is a pure read or an
 # absolute-state write (recover_store/set_trace/arm_failpoints set a
 # target state, so applying twice equals applying once). inject /
@@ -33,6 +44,9 @@ from risingwave_tpu.utils.metrics import CLUSTER as _METRICS
 _IDEMPOTENT_VERBS = frozenset({
     "ping", "scan_table", "recover_store", "set_trace", "set_ledger",
     "arm_failpoints", "metrics", "reset",
+    # pure reads: the autoscaler signal snapshot (tricolor + walker)
+    # and the wedge-diagnostic await dump
+    "signals", "awaits",
     # absolute-state write: sealing/syncing to an epoch twice equals
     # once (the aligned-checkpoint floor push, ISSUE 13)
     "seal_sync",
@@ -52,11 +66,8 @@ class WorkerClient:
         self._lock = asyncio.Lock()
 
     async def connect(self) -> None:
-        # 16MB line limit: control replies are one JSON line each, and
-        # scan_table/metrics payloads overflow asyncio's 64KB default
-        # (LimitOverrunError surfaces as an opaque ValueError)
         self._reader, self._writer = await asyncio.open_connection(
-            self.host, self.control_port, limit=1 << 24)
+            self.host, self.control_port, limit=CONTROL_LINE_LIMIT)
 
     async def call_idempotent(self, cmd: dict,
                               io_timeout: Optional[float] = None,
@@ -133,27 +144,60 @@ class WorkerClient:
         return await self.call({"cmd": "deploy_plan", "plan": plan,
                                 "params": params})
 
+    _PAGE_BYTES = CONTROL_PAGE_BYTES
+
     async def scan_table(self, table_id: int,
                          epoch: Optional[int] = None) -> list:
         """Pull one table's committed rows (value-codec decoded) from
-        the worker's namespace — the distributed-SELECT data plane."""
+        the worker's namespace — the distributed-SELECT data plane.
+        Pages through the worker's byte-budgeted replies (all pages
+        pinned to the FIRST page's epoch) so one giant table never
+        overflows the JSON-line channel."""
         from risingwave_tpu.storage.value_codec import decode_row
-        reply = await self.call_idempotent(
-            {"cmd": "scan_table", "table_id": table_id, "epoch": epoch})
-        return [(bytes.fromhex(k), decode_row(bytes.fromhex(r)))
-                for k, r in reply["rows"]]
+        out = []
+        after = None
+        while True:
+            reply = await self.call_idempotent(
+                {"cmd": "scan_table", "table_id": table_id,
+                 "epoch": epoch, "after": after})
+            out += [(bytes.fromhex(k), decode_row(bytes.fromhex(r)))
+                    for k, r in reply["rows"]]
+            if reply.get("done", True) or not reply["rows"]:
+                return out
+            epoch = reply["epoch"]        # later pages pin the snapshot
+            after = reply["rows"][-1][0]
 
     async def ingest_table(self, table_id: int, rows: list,
                            min_epoch: Optional[int] = None) -> dict:
         """Bulk-load (key_bytes, row_tuple) pairs — state migration.
-        `min_epoch` keeps the ingest epoch above in-flight barriers."""
+        `min_epoch` keeps the ingest epoch above in-flight barriers.
+        Large batches split into byte-budgeted requests (each commits
+        at its own fresh epoch; the returned epoch is the highest)."""
         from risingwave_tpu.storage.value_codec import encode_row
-        return await self.call({
-            "cmd": "ingest_table", "table_id": table_id,
-            "min_epoch": min_epoch,
-            "rows": [[k.hex(),
-                      None if v is None else encode_row(tuple(v)).hex()]
-                     for k, v in rows]})
+        batch, nbytes = [], 0
+        total = 0
+        top = None
+        for k, v in rows:
+            kx = k.hex()
+            vx = None if v is None else encode_row(tuple(v)).hex()
+            batch.append([kx, vx])
+            nbytes += len(kx) + (len(vx) if vx else 0)
+            if nbytes >= self._PAGE_BYTES:
+                top = await self.call({
+                    "cmd": "ingest_table", "table_id": table_id,
+                    "min_epoch": max(min_epoch or 0,
+                                     int(top["epoch"]) if top else 0),
+                    "rows": batch})
+                total += int(top["rows"])
+                batch, nbytes = [], 0
+        if batch or top is None:
+            top = await self.call({
+                "cmd": "ingest_table", "table_id": table_id,
+                "min_epoch": max(min_epoch or 0,
+                                 int(top["epoch"]) if top else 0),
+                "rows": batch})
+            total += int(top["rows"])
+        return {"ok": True, "rows": total, "epoch": int(top["epoch"])}
 
     async def inject(self, barrier: Barrier,
                      committed: Optional[int] = None,

@@ -17,10 +17,11 @@ coordinator's committed epoch (barrier/recovery.rs:110 collapsed).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import os
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from risingwave_tpu.cluster.coordinator import (
     Heartbeater, WorkerBarrierSender, WorkerClient, WorkerHandle,
@@ -28,14 +29,36 @@ from risingwave_tpu.cluster.coordinator import (
 from risingwave_tpu.frontend.fragmenter import Fragment, FragmentGraph
 from risingwave_tpu.meta.barrier import BarrierLoop
 from risingwave_tpu.meta.supervisor import (
-    ACTION_RESPAWN, RecoveryEvent, RecoverySupervisor,
-    trace_recovery_phase, trace_recovery_root,
+    ACTION_RESPAWN, ACTION_ROLLBACK, CAUSE_RESCALE_FAILED,
+    RecoveryEvent, RecoverySupervisor, trace_recovery_phase,
+    trace_recovery_root,
 )
 from risingwave_tpu.stream.actor import LocalBarrierManager
 from risingwave_tpu.stream.message import StopMutation
 from risingwave_tpu.stream.plan_ir import remap_node_refs
+from risingwave_tpu.utils.failpoint import fail_point
 
 _PSEUDO_BASE = 1 << 20          # pseudo-actor ids for worker handles
+
+
+class RescaleError(RuntimeError):
+    """A guarded rescale failed. ``rolled_back=True`` means the prior
+    topology (and state placement) was restored — the cluster is
+    consistent and serving; False means the unwind itself failed (or
+    the failure struck before any change, ``phase="stop"``, where the
+    domain's health is unknown) and the supervised-recovery ladder
+    owns what happens next. Either way the event is in rw_recovery."""
+
+    def __init__(self, msg: str, phase: str, rolled_back: bool):
+        super().__init__(msg)
+        self.phase = phase
+        self.rolled_back = rolled_back
+
+
+class RescaleInProgressError(RuntimeError):
+    """A topology change is already in flight for this cluster —
+    concurrent rescales of one domain must serialize, never
+    interleave (arxiv 1904.03800's concurrent-state discipline)."""
 
 
 class _CoordEpochStore:
@@ -69,6 +92,13 @@ class JobDeployment:
     graph: FragmentGraph
     placements: List[List[tuple]] = field(default_factory=list)
     domain_keys: frozenset = frozenset()
+    # fragment idx → per-actor-RANK partition lists (filelog sources):
+    # the split/offsets contract — deploys stamp each source actor's
+    # partition subset into its plan, rescales recompute it, and the
+    # split-state handoff moves each split's offset row to its new
+    # owner's namespace so reads resume exactly
+    split_assignments: Dict[int, List[List[int]]] = \
+        field(default_factory=dict)
 
     def actor_ids(self) -> List[int]:
         return [aid for frag in self.placements for aid, _slot in frag]
@@ -113,6 +143,17 @@ class Cluster:
         self._heartbeater: Optional[Heartbeater] = None
         self._expired_slots: Set[int] = set()
         self._wid_slot: Dict[int, int] = {}
+        # topology-change serialization (ISSUE 15): one rescale/move at
+        # a time per cluster — a second caller gets a clear
+        # RescaleInProgressError, never an interleaved redeploy
+        self._topology_busy: Optional[str] = None
+        # (job, fragment) → "vnode"|"source": a rescale whose ROLLBACK
+        # failed leaves state possibly straddling namespaces; the next
+        # recovery re-routes it to the recorded placements (repair)
+        self._pending_repair: Dict[Tuple[str, int], str] = {}
+        # chaos seam: one-shot (phase, fn) fired at that rescale phase
+        # — how the harness kills a worker mid-redeploy deterministically
+        self.rescale_fault_hook: Optional[tuple] = None
 
     # -- lifecycle --------------------------------------------------------
     async def start(self) -> None:
@@ -183,7 +224,10 @@ class Cluster:
             actors = sorted(a for a in
                             self._plane.domain_actors(domain)
                             if a < _PSEUDO_BASE)
-            return {"actors": actors,
+            # "domain" rides along so the WORKER can run the
+            # bottleneck walk over its own chains per barrier (the
+            # autoscaler's signal on a distributed session)
+            return {"actors": actors, "domain": domain,
                     "seal": self._plane.allocator.write_floor()}
         return extras
 
@@ -345,9 +389,13 @@ class Cluster:
         return placements
 
     def _expand_nodes(self, frag: Fragment, actor_id: int,
-                      placements: List[List[tuple]]) -> List[dict]:
+                      placements: List[List[tuple]],
+                      splits: Optional[List[int]] = None) -> List[dict]:
         """Resolve exchange_in placeholders into per-upstream-actor
-        remote_input nodes + a merge, and pin the source actor id."""
+        remote_input nodes + a merge, and pin the source actor id.
+        ``splits`` (filelog fragments) is THIS actor's partition
+        subset, stamped into the connector options so the worker
+        builds a reader over exactly those splits."""
         out: List[dict] = []
         remap: Dict[int, int] = {}
         for idx, node in enumerate(frag.nodes):
@@ -376,6 +424,11 @@ class Cluster:
             n2 = remap_node_refs(node, remap)
             if n2["op"] == "source":
                 n2["actor_id"] = actor_id
+                if splits is not None:
+                    conn = dict(n2.get("connector") or {})
+                    conn["partitions"] = ",".join(str(p)
+                                                  for p in splits)
+                    n2["connector"] = conn
             out.append(n2)
             remap[idx] = len(out) - 1
         return out
@@ -414,6 +467,14 @@ class Cluster:
             raise ValueError(f"job {name!r} already deployed")
         job = JobDeployment(name, graph, self._place(graph),
                             domain_keys=frozenset(domain_keys))
+        for fi, frag in enumerate(graph.fragments):
+            if self._source_rescalable(frag):
+                # Kafka-parity split assignment: ALL of the topic's
+                # partitions round-robin over the fragment's actors
+                # (one actor owns them all at parallelism 1)
+                job.split_assignments[fi] = self._round_robin_splits(
+                    self._source_partitions(frag),
+                    len(job.placements[fi]))
         try:
             await self._deploy_job(job)
         except BaseException:
@@ -436,12 +497,17 @@ class Cluster:
         for fi, frag in enumerate(job.graph.fragments):
             outputs, dispatch = self._wiring(fi, job.graph,
                                              job.placements)
+            assign = job.split_assignments.get(fi)
             await asyncio.gather(*(
                 self.clients[slot].deploy_plan(
-                    self._expand_nodes(frag, aid, job.placements),
+                    self._expand_nodes(
+                        frag, aid, job.placements,
+                        splits=assign[rank] if assign is not None
+                        else None),
                     actor_id=aid, outputs=outputs, dispatch=dispatch,
                     job=job.name)
-                for aid, slot in job.placements[fi]))
+                for rank, (aid, slot)
+                in enumerate(job.placements[fi])))
 
     async def drop_job(self, name: str) -> None:
         job = self.jobs.pop(name, None)
@@ -537,6 +603,34 @@ class Cluster:
             n += FRESHNESS.ingest(reply.get("parts") or {})
         return n
 
+    async def drain_signals(self) -> int:
+        """Pull every worker's autoscaler signal snapshot — the
+        utilization tricolor rows and the worker-side bottleneck-walker
+        state — into the coordinator's process-global views. Actor ids
+        are cluster-unique, so worker rows merge collision-free; the
+        walker merge keeps the strongest per-domain candidate across
+        processes. Feeds rw_actor_utilization / rw_bottlenecks on the
+        distributed session and the autoscaler's tick."""
+        from risingwave_tpu.stream.bottleneck import BOTTLENECKS
+        from risingwave_tpu.stream.monitor import UTILIZATION
+        live = [(k, c) for k, c in enumerate(self.clients)
+                if c is not None]
+        replies = await asyncio.gather(*(
+            c.call_idempotent({"cmd": "signals"}, io_timeout=20.0)
+            for _k, c in live))
+        n = 0
+        for (k, _c), reply in zip(live, replies):
+            n += UTILIZATION.ingest_rows(reply.get("utilization")
+                                         or ())
+            n += BOTTLENECKS.ingest(reply.get("bottlenecks") or (),
+                                    worker=f"worker-{k}")
+        # evict rows for actors no rescale/recovery kept: ingested
+        # copies have no worker-side drop to mirror, and every
+        # redeploy mints fresh actor ids
+        UTILIZATION.prune(a for j in self.jobs.values()
+                          for a in j.actor_ids())
+        return n
+
     def domain_of_job(self, name: str) -> str:
         """The barrier domain a deployed job's epochs flow through
         ("" = the global loop / off arm)."""
@@ -577,6 +671,7 @@ class Cluster:
                                   "epoch": floor})
             for k in range(self.n)))
         await self._fresh_barrier_plane()
+        await self._run_pending_repairs()
         for job in self.jobs.values():
             await self._deploy_job(job)
         if self._heartbeater is not None:
@@ -641,6 +736,7 @@ class Cluster:
                 io_timeout=20.0)
             for k in range(self.n)))
         await self._fresh_barrier_plane()
+        await self._run_pending_repairs()
         for job in self.jobs.values():
             await self._deploy_job(job)
         if self._heartbeater is not None:
@@ -699,13 +795,40 @@ class Cluster:
                 return False
         return True
 
+    @contextlib.contextmanager
+    def _topology_change(self, desc: str):
+        """Serialize topology changes: a second rescale/move arriving
+        while one is in flight gets a clear error, never an
+        interleaved redeploy of the same domain. (Callers going
+        through the session's barrier lock additionally QUEUE —
+        this guard is the explicit backstop for direct API use.)"""
+        if self._topology_busy is not None:
+            raise RescaleInProgressError(
+                f"rescale in progress ({self._topology_busy}) — "
+                f"topology changes serialize; retry when it completes")
+        self._topology_busy = desc
+        try:
+            yield
+        finally:
+            self._topology_busy = None
+
+    def _fire_rescale_hook(self, phase: str) -> None:
+        if self.rescale_fault_hook is not None \
+                and self.rescale_fault_hook[0] == phase:
+            _ph, fn = self.rescale_fault_hook
+            self.rescale_fault_hook = None
+            fn()
+
     async def rescale_fragment(self, name: str, frag_idx: int,
                                to_slots: List[int]) -> None:
         """Change one fragment's actor set (count AND placement) at a
         stopped barrier: every state row moves to its vnode's NEW
         owner (the 2-byte key prefix IS the vnode — scale.rs's bitmap
         rebalance, made explicit as a scan/slice/ingest handoff across
-        per-slot namespaces)."""
+        per-slot namespaces). Guarded (ISSUE 15): a failure mid-way
+        rolls the domain back to the prior topology and state
+        placement instead of leaving it half-deployed — see
+        ``_guarded_rescale``."""
         from risingwave_tpu.common.hash import VnodeMapping
 
         job = self.jobs[name]
@@ -719,43 +842,309 @@ class Cluster:
                 "fragment is not vnode-rescalable (needs hash inputs "
                 "and only exchange_in/hash_agg/project/filter/"
                 "materialize-with-dist_key nodes)")
-        codomain = self._codomain_jobs(job)
-        await self._stop_and_align(job)
-        # vnode-sliced handoff: gather each table from every OLD slot,
-        # route rows by key-prefix vnode through the NEW mapping, and
-        # move ONLY rows whose owner changes (the stationary majority
-        # of a small rescale stays put)
         mapping = VnodeMapping.new_uniform(len(to_slots))
-        min_epoch = self.loop.frontier_epoch()
+
+        def owner_of(_tid: int, k: bytes, _v) -> int:
+            return to_slots[mapping.owner_of(
+                int.from_bytes(k[:2], "big"))]
+
+        with self._topology_change(
+                f"{name}/f{frag_idx} -> slots {list(to_slots)}"):
+            await self._guarded_rescale(job, frag_idx, list(to_slots),
+                                        owner_of, source_assign=None)
+
+    async def rescale_source_fragment(self, name: str, frag_idx: int,
+                                      to_slots: List[int]) -> None:
+        """Rescale a SOURCE fragment by split reassignment (the
+        filelog splits/offsets contract): the topic's partitions
+        round-robin over the new actor set, each split's offset row
+        migrates to its new owner's namespace, and the redeployed
+        readers resume from those byte offsets exactly — no record
+        lost, none re-read. Guarded like the vnode path."""
+        job = self.jobs[name]
+        frag = job.graph.fragments[frag_idx]
+        if not self._source_rescalable(frag):
+            raise ValueError(
+                "fragment is not split-rescalable (needs a filelog "
+                "source with a topic and only source/project/filter/"
+                "coalesce/row_id_gen nodes)")
+        old = job.placements[frag_idx]
+        if len(to_slots) == len(old) and \
+                [s for _a, s in old] == list(to_slots):
+            return
+        parts = self._source_partitions(frag)
+        assign = self._round_robin_splits(parts, len(to_slots))
+        owner_of = self._split_owner_fn(assign, list(to_slots))
+        with self._topology_change(
+                f"{name}/f{frag_idx} splits -> slots {list(to_slots)}"):
+            await self._guarded_rescale(job, frag_idx, list(to_slots),
+                                        owner_of,
+                                        source_assign=assign)
+
+    @staticmethod
+    def _round_robin_splits(parts: List[int],
+                            n_actors: int) -> List[List[int]]:
+        return [[p for j, p in enumerate(parts)
+                 if j % n_actors == rank] for rank in range(n_actors)]
+
+    @staticmethod
+    def _split_owner_fn(assign: List[List[int]],
+                        to_slots: List[int]) -> Callable:
+        part_rank = {p: r for r, ps in enumerate(assign) for p in ps}
+
+        def owner_of(_tid: int, _k: bytes, v) -> int:
+            # split rows are (split_id, offset); the partition number
+            # is the split id's suffix ("filelog-<topic>-<N>")
+            try:
+                part = int(str(v[0]).rsplit("-", 1)[1])
+            except (ValueError, IndexError, TypeError):
+                part = 0
+            return to_slots[part_rank.get(part, 0)]
+        return owner_of
+
+    async def _guarded_rescale(self, job: JobDeployment, fi: int,
+                               to_slots: List[int],
+                               owner_of: Callable,
+                               source_assign) -> None:
+        """The guarded-rescale protocol shared by the vnode and
+        split paths: stop the world → route state (copy-at-
+        destination FIRST, tombstone second, so no crash point ever
+        destroys the only copy of a row) → redeploy the cohort. ANY
+        failure past the stop barrier unwinds from the in-memory moved
+        log — rows restored at their source, destination copies
+        tombstoned, prior topology redeployed — and records the
+        rollback in rw_recovery. A rollback that itself fails leaves a
+        repair marker the next recovery consumes (re-routing the
+        fragment's state to the recorded placements)."""
+        frag = job.graph.fragments[fi]
+        old_slots = [s for _a, s in job.placements[fi]]
+        old_assign = job.split_assignments.get(fi)
+        # the rescale cohort is EVERY deployed job, not just the
+        # rescaled job's barrier domain: the handoff's worker-side
+        # seal fences the whole per-worker store, and a live job in
+        # ANY domain would have its next buffered flush rejected under
+        # that fence (write at epoch ≤ sealed). Stop-the-world is the
+        # scale.rs-parity mechanism; the stall is bounded and recorded
+        # (the autoscaler ledger's duration / bench rescale_stall).
+        cohort = list(self.jobs.values())
+        moved_log: List[tuple] = []
+        phase = "stop"
+        try:
+            await self._stop_and_align_all()
+            phase = "handoff"
+            self._fire_rescale_hook("handoff")
+            fail_point("rescale.handoff")
+            handoff_max = await self._route_fragment_state(
+                frag, owner_of, sorted(set(old_slots) | set(to_slots)),
+                moved_log)
+            if handoff_max:
+                self.loop.advance_epoch_to(handoff_max)
+            phase = "redeploy"
+            if source_assign is not None:
+                job.split_assignments[fi] = source_assign
+            frag.parallelism = len(to_slots)
+            self._fire_rescale_hook("redeploy")
+            fail_point("rescale.redeploy")
+            await self._redeploy_with_fresh_actors(job, {fi: to_slots})
+            for j in cohort:
+                if j is not job:
+                    # stopped-with-the-world siblings come back too
+                    await self._redeploy_with_fresh_actors(j, {})
+        except BaseException as exc:  # noqa: BLE001 — unwind + rethrow
+            await self._rollback_rescale(
+                job, fi, old_slots, old_assign,
+                source_assign is not None, cohort, moved_log,
+                phase, exc)
+
+    async def _route_fragment_state(self, frag: Fragment,
+                                    owner_of: Callable,
+                                    scan_slots: List[int],
+                                    moved_log: List[tuple],
+                                    min_epoch: Optional[int] = None
+                                    ) -> int:
+        """Move every state row of ``frag``'s tables to its owner slot
+        (``owner_of(tid, key, row)``). Destination copies ingest
+        BEFORE source tombstones: at any interruption point every row
+        still exists in at least one namespace, which is what makes
+        both the rollback and the post-recovery repair pass sound.
+        Appends (tid, src, dst, key, row) per moved row to
+        ``moved_log``; returns the highest handoff epoch."""
+        if min_epoch is None:
+            min_epoch = self.loop.frontier_epoch()
         handoff_max = 0
-        old_slots = sorted({s for _a, s in old})
         for tid in _fragment_table_ids(frag):
             slices: Dict[int, list] = {}
-            for slot in old_slots:
-                rows = await self.clients[slot].scan_table(tid)
-                moved = []
-                for k, v in rows:
-                    vnode = int.from_bytes(k[:2], "big")
-                    dst = to_slots[mapping.owner_of(vnode)]
+            removals: Dict[int, list] = {}
+            for slot in scan_slots:
+                if self.clients[slot] is None:
+                    continue
+                for k, v in await self.clients[slot].scan_table(tid):
+                    dst = owner_of(tid, k, v)
                     if dst != slot:
                         slices.setdefault(dst, []).append((k, v))
-                        moved.append(k)
-                if moved:
-                    r = await self.clients[slot].ingest_table(
-                        tid, [(k, None) for k in moved],
-                        min_epoch=min_epoch)
-                    handoff_max = max(handoff_max, int(r["epoch"]))
+                        removals.setdefault(slot, []).append(k)
+                        moved_log.append((tid, slot, dst, k, v))
             for dst, rows in slices.items():
                 r = await self.clients[dst].ingest_table(
-                    tid, rows, min_epoch=handoff_max or min_epoch)
+                    tid, rows, min_epoch=max(handoff_max, min_epoch))
                 handoff_max = max(handoff_max, int(r["epoch"]))
-        if handoff_max:
-            self.loop.advance_epoch_to(handoff_max)
-        await self._redeploy_with_fresh_actors(job, {frag_idx: to_slots})
-        for j in codomain:
-            if j is not job:
-                # stopped-with-the-domain siblings come back too
-                await self._redeploy_with_fresh_actors(j, {})
+            for slot, keys in removals.items():
+                r = await self.clients[slot].ingest_table(
+                    tid, [(k, None) for k in keys],
+                    min_epoch=max(handoff_max, min_epoch))
+                handoff_max = max(handoff_max, int(r["epoch"]))
+        return handoff_max
+
+    async def _reverse_handoff(self, moved_log: List[tuple]) -> int:
+        """Undo a (possibly partial) handoff from its in-memory moved
+        log: restore each moved row at its source slot FIRST, then
+        tombstone the destination copy — idempotent at any
+        interruption point of the forward pass."""
+        min_epoch = self.loop.frontier_epoch()
+        handoff_max = 0
+        by_src: Dict[tuple, list] = {}
+        by_dst: Dict[tuple, list] = {}
+        for tid, src, dst, k, v in moved_log:
+            by_src.setdefault((src, tid), []).append((k, v))
+            by_dst.setdefault((dst, tid), []).append((k, None))
+        for (slot, tid), rows in by_src.items():
+            r = await self.clients[slot].ingest_table(
+                tid, rows, min_epoch=max(handoff_max, min_epoch))
+            handoff_max = max(handoff_max, int(r["epoch"]))
+        for (slot, tid), rows in by_dst.items():
+            r = await self.clients[slot].ingest_table(
+                tid, rows, min_epoch=max(handoff_max, min_epoch))
+            handoff_max = max(handoff_max, int(r["epoch"]))
+        return handoff_max
+
+    async def _rollback_rescale(self, job: JobDeployment, fi: int,
+                                old_slots: List[int], old_assign,
+                                is_source: bool, cohort,
+                                moved_log: List[tuple], phase: str,
+                                exc: BaseException) -> None:
+        """Unwind a failed rescale to the prior topology, record the
+        event in rw_recovery, and raise RescaleError. Failures at the
+        ``stop`` phase changed nothing (but the domain's health is
+        unknown — a wedged stop barrier needs the supervisor), so only
+        the later phases unwind state."""
+        name = job.name
+        floor = self.store.committed_epoch()
+        t0 = time.monotonic()
+        rolled = False
+        detail = f"phase={phase}: {exc!r}"[:160]
+        if phase in ("handoff", "redeploy"):
+            # bookkeeping FIRST: whatever recovery runs next must
+            # route state and deploy against the PRIOR topology
+            if is_source:
+                if old_assign is not None:
+                    job.split_assignments[fi] = old_assign
+                else:
+                    job.split_assignments.pop(fi, None)
+            job.graph.fragments[fi].parallelism = len(old_slots)
+            try:
+                handoff_max = await self._reverse_handoff(moved_log)
+                if handoff_max:
+                    self.loop.advance_epoch_to(handoff_max)
+                await self._redeploy_with_fresh_actors(
+                    job, {fi: old_slots})
+                for j in cohort:
+                    if j is not job:
+                        await self._redeploy_with_fresh_actors(j, {})
+                rolled = True
+            except BaseException as rexc:  # noqa: BLE001
+                detail += f"; rollback failed: {rexc!r}"[:100]
+                # repair marker: state may straddle namespaces — the
+                # next recovery re-routes it to the recorded prior
+                # placements before redeploying
+                self._pending_repair[(name, fi)] = \
+                    "source" if is_source else "vnode"
+                job.placements[fi] = [(self._fresh_actor(), s)
+                                      for s in old_slots]
+        self.supervisor.record(
+            CAUSE_RESCALE_FAILED, ACTION_ROLLBACK,
+            tuple(sorted(set(old_slots))), floor,
+            time.monotonic() - t0, rolled, 1,
+            detail=f"{name}/f{fi} {detail}")
+        if rolled:
+            tail = " (rolled back to the prior parallelism)"
+        elif phase == "stop":
+            tail = " (before any change; domain health unknown)"
+        else:
+            tail = " (rollback FAILED — the next recovery repairs " \
+                   "state placement)"
+        raise RescaleError(
+            f"rescale of {name!r} fragment {fi} failed during "
+            f"{phase}{tail}: {exc!r}", phase, rolled) from exc
+
+    async def _run_pending_repairs(self) -> None:
+        """Post-recovery repair pass for rescales whose rollback
+        failed: re-route each marked fragment's state to the CURRENT
+        recorded placements (dst-first, so the pass is idempotent and
+        crash-safe itself), then clear the marker."""
+        from risingwave_tpu.common.hash import VnodeMapping
+        for (name, fi), kind in list(self._pending_repair.items()):
+            job = self.jobs.get(name)
+            if job is None or fi >= len(job.placements):
+                self._pending_repair.pop((name, fi), None)
+                continue
+            frag = job.graph.fragments[fi]
+            slots = [s for _a, s in job.placements[fi]]
+            if kind == "source":
+                assign = job.split_assignments.get(
+                    fi, self._round_robin_splits(
+                        self._source_partitions(frag), len(slots)))
+                owner_of = self._split_owner_fn(assign, slots)
+            else:
+                mapping = VnodeMapping.new_uniform(len(slots))
+
+                def owner_of(_tid, k, _v, _m=mapping, _s=slots):
+                    return _s[_m.owner_of(
+                        int.from_bytes(k[:2], "big"))]
+            handoff_max = await self._route_fragment_state(
+                frag, owner_of, list(range(self.n)), [],
+                min_epoch=self.store.committed_epoch())
+            if handoff_max:
+                self.loop.advance_epoch_to(handoff_max)
+            self._pending_repair.pop((name, fi), None)
+
+    # source fragments rescalable by split reassignment: root
+    # fragments whose only durable state is the source's split/offset
+    # table (the filelog contract) — everything else in the chain is
+    # stateless
+    _SOURCE_RESCALABLE_OPS = frozenset({"source", "project", "filter",
+                                        "coalesce", "row_id_gen"})
+
+    def _source_rescalable(self, frag: Fragment) -> bool:
+        if frag.inputs:
+            return False
+        src = None
+        for n in frag.nodes:
+            if n["op"] not in self._SOURCE_RESCALABLE_OPS:
+                return False
+            if n["op"] == "source":
+                src = n
+        if src is None or src.get("split_table_id") is None:
+            return False
+        conn = src.get("connector") or {}
+        if str(conn.get("connector", "")).lower() != "filelog":
+            return False
+        if str(conn.get("segmented", "")).lower() in ("true", "1"):
+            return False
+        return bool(conn.get("topic"))
+
+    def _source_partitions(self, frag: Fragment) -> List[int]:
+        """The topic's current partition set (enumerated from the log
+        directory — the coordinator shares the filesystem with the
+        workers). Falls back to the single configured partition when
+        the directory lists none yet."""
+        from risingwave_tpu.connectors.filelog import FileLogEnumerator
+        src = next(n for n in frag.nodes if n["op"] == "source")
+        conn = src["connector"]
+        splits = FileLogEnumerator(conn["path"],
+                                   conn["topic"]).list_splits()
+        parts = sorted(int(s.split_id.rsplit("-", 1)[1])
+                       for s in splits)
+        return parts or [int(conn.get("partition", 0))]
 
     async def move_fragment(self, name: str, frag_idx: int,
                             to_slots: List[int]) -> None:
@@ -776,68 +1165,33 @@ class Cluster:
                                                to_slots)
         if [s for _a, s in old] == list(to_slots):
             return
-        codomain = self._codomain_jobs(job)
-        await self._stop_and_align(job)
-        # 2) ship the moved actors' state tables between namespaces.
-        # Ingest epochs stay ABOVE the last injected barrier (other
-        # jobs hold buffered flushes at that epoch; sealing it out from
-        # under them would fail their next commit), and the barrier
-        # loop then reserves past the handoff epochs.
-        min_epoch = self.loop.frontier_epoch()
-        handoff_max = 0
-        table_ids = _fragment_table_ids(frag)
-        for (aid, from_slot), to_slot in zip(old, to_slots):
-            if from_slot == to_slot:
-                continue
-            for tid in table_ids:
-                rows = await self.clients[from_slot].scan_table(tid)
-                # the whole table moves; the old namespace's copy is
-                # tombstoned so stale reads cannot resurrect it
-                if rows:
-                    r1 = await self.clients[to_slot].ingest_table(
-                        tid, rows, min_epoch=min_epoch)
-                    r2 = await self.clients[from_slot].ingest_table(
-                        tid, [(k, None) for k, _v in rows],
-                        min_epoch=min_epoch)
-                    handoff_max = max(handoff_max, int(r1["epoch"]),
-                                      int(r2["epoch"]))
-        if handoff_max:
-            self.loop.advance_epoch_to(handoff_max)
-        await self._redeploy_with_fresh_actors(job, {frag_idx: to_slots})
-        for j in codomain:
-            if j is not job:
-                # stopped-with-the-domain siblings come back too
-                await self._redeploy_with_fresh_actors(j, {})
+        # whole-table move through the same guarded protocol the
+        # rescales use (dst-first handoff + rollback on failure):
+        # every row of the fragment's tables is owned by the one
+        # destination slot
+        dst = int(to_slots[0])
 
-    def _codomain_jobs(self, job: JobDeployment) -> List[JobDeployment]:
-        """Every deployed job sharing `job`'s barrier domain (itself
-        included). The state handoff seals the worker stores above the
-        coordinator floor, so every job whose actors could still flush
-        below that fence must stop — and redeploy — with it."""
-        if self._plane is None:
-            return [job]
-        dom = self._plane.domain_of_job(job.name)
-        if dom is None:
-            return [job]
-        return [self.jobs[n] for n in self._plane.jobs_of_domain(dom)
-                if n in self.jobs]
+        def owner_of(_tid: int, _k: bytes, _v) -> int:
+            return dst
 
-    async def _stop_and_align(self, job: JobDeployment) -> None:
-        """Stop the job's WHOLE DOMAIN at a barrier and push the
-        coordinator's commit decision to every worker: the stop
-        barrier's epoch is committed on the COORDINATOR but pipelines
-        to workers on the next inject — without the push, a handoff
-        scan would miss rows born in that epoch and leave them to
-        resurrect on the old worker when its staged SST commits later.
-        Domain-wide (not just this job): the handoff's worker-side
-        seal fences everything below its ingest epochs, and a still-
-        running sibling job would have its next flush rejected under
-        that fence — stopped siblings have nothing pending, so the
-        fence is safe."""
+        with self._topology_change(
+                f"move {name}/f{frag_idx} -> slot {dst}"):
+            await self._guarded_rescale(job, frag_idx, list(to_slots),
+                                        owner_of, source_assign=None)
+
+    async def _stop_and_align_all(self) -> None:
+        """Stop EVERY deployed job at one aligned barrier and push the
+        commit decision to every worker — the guarded rescale's stop
+        phase. Cluster-wide (not just the rescaled job's domain): the
+        handoff's worker-side seal fences the whole per-worker store,
+        and a still-running job in ANY domain would have its next
+        buffered flush rejected under that fence. Stopped jobs have
+        nothing pending, so the fence is safe; everyone redeploys with
+        the rescaled cohort."""
         await self.loop.inject_and_collect(
             force_checkpoint=True,
             mutation=StopMutation(
-                self._stop_set(*self._codomain_jobs(job))))
+                self._stop_set(*self.jobs.values())))
         floor = self.store.committed_epoch()
         for c in self.clients:
             await c.call({"cmd": "recover_store", "epoch": floor})

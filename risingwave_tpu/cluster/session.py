@@ -104,6 +104,7 @@ class DistFrontend:
         # stream_chunk_target_rows: SET here, honored at CREATE time
         from risingwave_tpu.frontend.opt import parse_fusion, parse_rules
         from risingwave_tpu.frontend.session_vars import SessionVars
+        from risingwave_tpu.meta.autoscaler import parse_autoscale
         from risingwave_tpu.utils.ledger import parse_ledger
         from risingwave_tpu.utils.spans import parse_trace
         self.session_vars = SessionVars(
@@ -117,6 +118,11 @@ class DistFrontend:
                    "stream_coalesce_linger_chunks":
                        "coalesce_linger_chunks"},
             {"stream_rewrite_rules": "all",
+             # elastic control loop (meta/autoscaler.py): off by
+             # default — scaling actions are topology changes an
+             # operator opts into; the serving heartbeat ticks the
+             # loop while this is on
+             "stream_autoscale": "off",
              # fragment fusion (opt/fusion.py). Distributed deploys
              # fuse at ANY parallelism (ISSUE 10): the hash-exchange
              # cut ships raw rows dispatched on key columns mapped
@@ -134,7 +140,11 @@ class DistFrontend:
             validators={"stream_rewrite_rules": parse_rules,
                         "stream_fusion": parse_fusion,
                         "stream_trace": parse_trace,
-                        "stream_ledger": parse_ledger})
+                        "stream_ledger": parse_ledger,
+                        "stream_autoscale": parse_autoscale})
+        # the elastic control loop (created lazily on SET
+        # stream_autoscale=on; ticked by run_heartbeat while on)
+        self.autoscaler = None
         # fragment-graph stats of the last deployed job (exchange
         # hops, exchanged lane widths) — bench + tests read this to
         # see what the rewrite engine bought
@@ -179,6 +189,13 @@ class DistFrontend:
         async with self._barrier_lock:
             return await self.cluster.supervised_recover(exc)
 
+    def _autoscale_on(self) -> bool:
+        from risingwave_tpu.meta.autoscaler import parse_autoscale
+        return (self.autoscaler is not None
+                and self.autoscaler.enabled
+                and parse_autoscale(
+                    self.session_vars.get("stream_autoscale")))
+
     async def run_heartbeat(self, interval_s: float = 0.25) -> None:
         """Supervised serving loop (server deployments): each beat
         steps one barrier and ticks worker liveness; a failed round
@@ -199,6 +216,17 @@ class DistFrontend:
                     try:
                         await self.cluster.step(1)
                         self.cluster.supervisor.note_healthy()
+                        if self.autoscaler is not None:
+                            # a clean round closes the autoscaler's
+                            # storm window too (only after a SUCCESSFUL
+                            # action — rollbacks keep the backoff)
+                            self.autoscaler.note_healthy()
+                        if self._autoscale_on():
+                            # elastic control loop (ISSUE 15): signals
+                            # → decision → guarded rescale, inside the
+                            # barrier lock so a concurrent ALTER queues
+                            # behind the action instead of interleaving
+                            await self.autoscaler.tick()
                     except asyncio.CancelledError:
                         raise
                     except Exception as e:  # noqa: BLE001 — classified
@@ -258,6 +286,20 @@ class DistFrontend:
                     self.session_vars.get("stream_ledger"))
                 _ledger.set_enabled(on)
                 await self.cluster.set_ledger(on)
+            if stmt.name == "stream_autoscale":
+                from risingwave_tpu.meta.autoscaler import (
+                    Autoscaler, parse_autoscale,
+                )
+                if parse_autoscale(
+                        self.session_vars.get("stream_autoscale")):
+                    if self.autoscaler is None:
+                        self.autoscaler = Autoscaler(self.cluster)
+                    # re-enabling after a storm is an explicit
+                    # operator decision — reset the disabled latch
+                    # AND the exhausted backoff budget (a still-maxed
+                    # gate would re-raise the storm on the next
+                    # decision without attempting a single rescale)
+                    self.autoscaler.reset_storm()
             return "SET"
         if isinstance(stmt, ast.Show):
             if stmt.what == "var:all":
@@ -398,24 +440,39 @@ class DistFrontend:
     async def _alter_parallelism(self, stmt) -> str:
         """ALTER MATERIALIZED VIEW <name> SET PARALLELISM n on the
         cluster: every vnode-rescalable fragment of the job rescales
-        to n actors round-robined over the worker slots, with the
-        vnode-sliced state handoff (scale.rs:717 across processes)."""
+        to n actors round-robined over the worker slots with the
+        vnode-sliced state handoff (scale.rs:717 across processes),
+        and filelog SOURCE fragments rescale by split reassignment
+        (partitions rebalance over the new actors; offsets resume
+        exactly). Both paths run the guarded-rescale protocol: a
+        mid-way failure rolls the domain back to the prior topology
+        (visible in rw_recovery) instead of leaving it half-deployed,
+        and a concurrent topology change gets a clear 'rescale in
+        progress' error, never an interleaved redeploy."""
         name, n = stmt.name, stmt.parallelism
         job = self.cluster.jobs.get(name)
         if job is None:
             raise PlanError(f"unknown materialized view {name!r}")
-        targets = [fi for fi, f in enumerate(job.graph.fragments)
-                   if self.cluster._rescalable(f)]
+        targets = [
+            (fi, self.cluster._source_rescalable(f))
+            for fi, f in enumerate(job.graph.fragments)
+            if self.cluster._rescalable(f)
+            or self.cluster._source_rescalable(f)]
         if not targets:
             raise PlanError(
-                f"{name!r} has no vnode-rescalable fragment")
+                f"{name!r} has no rescalable fragment")
         async with self._barrier_lock:
             # one stop-the-world cycle per fragment; jobs today carry
-            # at most one rescalable (agg) fragment — batch into a
+            # at most a couple of rescalable fragments — batch into a
             # single stop/handoff/redeploy if that changes
-            for fi in targets:
+            for fi, is_source in targets:
                 to_slots = [(fi + k) % self.cluster.n for k in range(n)]
-                await self.cluster.rescale_fragment(name, fi, to_slots)
+                if is_source:
+                    await self.cluster.rescale_source_fragment(
+                        name, fi, to_slots)
+                else:
+                    await self.cluster.rescale_fragment(name, fi,
+                                                        to_slots)
         return "ALTER_MATERIALIZED_VIEW"
 
     async def _drop_mv(self, stmt: ast.DropMaterializedView) -> str:
@@ -466,6 +523,10 @@ class DistFrontend:
             # freshness parts live on the workers (source + materialize
             # fragments): merge them before the tracker serves rows
             await self.cluster.drain_freshness()
+        if referenced & {"rw_bottlenecks", "rw_actor_utilization"}:
+            # the tricolor + walker run where the chains run (worker
+            # processes): pull their snapshots before the read
+            await self.cluster.drain_signals()
         view = ClusterStoreView(self.cluster)
         # one consistent snapshot: the barrier lock keeps the
         # heartbeat from committing an epoch between per-table scans

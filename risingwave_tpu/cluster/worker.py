@@ -30,8 +30,12 @@ from __future__ import annotations
 
 import asyncio
 import json
+import time
 from typing import Dict, List, Optional
 
+from risingwave_tpu.cluster.coordinator import (
+    CONTROL_LINE_LIMIT, CONTROL_PAGE_BYTES,
+)
 from risingwave_tpu.common.epoch import Epoch, EpochPair
 from risingwave_tpu.stream.actor import Actor, LocalBarrierManager
 from risingwave_tpu.stream.dispatch import (
@@ -55,14 +59,17 @@ class WorkerServer:
         self.tasks: Dict[int, asyncio.Task] = {}
         self._control: Optional[asyncio.AbstractServer] = None
         self._stopping = asyncio.Event()
+        # per-domain stamp of the last non-mutation inject handled
+        # here: successive stamps bound the barrier interval the
+        # worker-side bottleneck walk observes (the coordinator hosts
+        # no monitored actors on a distributed session — the walker
+        # must run where the chains run)
+        self._domain_stamp: Dict[str, float] = {}
 
     async def serve(self, host: str = "127.0.0.1") -> dict:
         await self.exchange.serve(host, 0)
-        # 16MB line limit, matching WorkerClient.connect: one JSON
-        # line per command, and shipped plans/ingest batches overflow
-        # asyncio's 64KB default
         self._control = await asyncio.start_server(
-            self._handle_control, host, 0, limit=1 << 24)
+            self._handle_control, host, 0, limit=CONTROL_LINE_LIMIT)
         return {"control_port":
                 self._control.sockets[0].getsockname()[1],
                 "exchange_port": self.exchange.port}
@@ -167,6 +174,16 @@ class WorkerServer:
             # interval); the other side merges them into its records
             from risingwave_tpu.utils.ledger import LEDGER
             return {"ok": True, "epochs": LEDGER.drain_dicts()}
+        if verb == "signals":
+            # autoscaler signal snapshot (ISSUE 15): this process's
+            # utilization tricolor + bottleneck-walker state, merged
+            # coordinator-side by Cluster.drain_signals. A snapshot,
+            # not a drain — streak machines keep running here
+            from risingwave_tpu.stream.bottleneck import BOTTLENECKS
+            from risingwave_tpu.stream.monitor import UTILIZATION
+            return {"ok": True,
+                    "utilization": UTILIZATION.rows(),
+                    "bottlenecks": BOTTLENECKS.rows()}
         if verb == "drain_freshness":
             # pop this process's raw freshness parts (ingest hwms,
             # epoch frontiers, visibility events) — the coordinator
@@ -174,6 +191,21 @@ class WorkerServer:
             # different workers into one per-MV lag series
             from risingwave_tpu.stream.freshness import FRESHNESS
             return {"ok": True, "parts": FRESHNESS.drain_dict()}
+        if verb == "awaits":
+            # wedge diagnostics: where every registered coroutine in
+            # THIS process is parked (the PR-1 AwaitRegistry) plus the
+            # local barrier manager's open epochs — how a coordinator
+            # names the actor holding a barrier open on a live worker
+            # instead of guessing from the outside
+            from risingwave_tpu.utils.trace import GLOBAL_AWAITS
+            local = self.local
+            return {"ok": True, "text": GLOBAL_AWAITS.dump(),
+                    "actors": sorted(self.actors),
+                    "open_epochs": {
+                        f"{e:#x}": sorted(
+                            local._collected.get(e, ()))
+                        for e in getattr(local, "_complete", {})
+                        if not local._complete[e].is_set()}}
         if verb == "ping":
             # heartbeat probe (cluster.rs heartbeat RPC): liveness +
             # a cheap resource summary for the membership table (actor
@@ -205,6 +237,7 @@ class WorkerServer:
                                  return_exceptions=True)
         self.actors.clear()
         self.tasks.clear()
+        self._domain_stamp.clear()
         old = self.local
         self.local = LocalBarrierManager()
         # wake any control handler stuck awaiting an epoch on the old
@@ -336,21 +369,44 @@ class WorkerServer:
             self.local.drop_actor(actor_id)
             return {"ok": False, "error": f"plan build failed: {e}"}
 
+    _PAGE_BYTES = CONTROL_PAGE_BYTES
+
     # -- batch data plane -------------------------------------------------
     def _scan_table(self, cmd: dict) -> dict:
         """Stream one table's committed rows back to the coordinator
         (RowSeqScan over the local store + GetData, collapsed to the
         control channel). Rows are value-codec encoded — the
-        coordinator holds the schema; this side needs none."""
+        coordinator holds the schema; this side needs none. PAGED:
+        ``after`` (hex key, exclusive) resumes a scan and the reply
+        stops past a byte budget with ``done=False`` — one giant
+        table must not overflow the JSON-line framing."""
         from risingwave_tpu.storage.value_codec import encode_row
 
         tid = int(cmd["table_id"])
         epoch = cmd.get("epoch")
         epoch = (self.store.committed_epoch() if epoch is None
                  else int(epoch))
-        rows = [[k.hex(), encode_row(tuple(v)).hex()]
-                for k, v in self.store.iter(tid, epoch)]
-        return {"ok": True, "epoch": epoch, "rows": rows}
+        after = (bytes.fromhex(cmd["after"])
+                 if cmd.get("after") else None)
+        rows = []
+        nbytes = 0
+        done = True
+        # resume at the store level (start is inclusive; after+\x00 is
+        # the exclusive successor) so a P-page scan stays O(N), not
+        # O(P*N); the guard below keeps correctness if a store ever
+        # ignores start
+        start = None if after is None else after + b"\x00"
+        for k, v in self.store.iter(tid, epoch, start=start):
+            if after is not None and k <= after:
+                continue
+            kx, vx = k.hex(), encode_row(tuple(v)).hex()
+            rows.append([kx, vx])
+            nbytes += len(kx) + len(vx)
+            if nbytes >= self._PAGE_BYTES:
+                done = False
+                break
+        return {"ok": True, "epoch": epoch, "rows": rows,
+                "done": done}
 
     def _ingest_table(self, cmd: dict) -> dict:
         """Bulk-load rows into a table at a fresh sealed+synced epoch —
@@ -457,6 +513,26 @@ class WorkerServer:
                 self.store.commit_through(int(committed))
             elif kind.is_checkpoint:
                 self.store.commit_through(pair.prev.value)
+        # worker-side bottleneck walk (ISSUE 15): the tricolor rows
+        # this barrier just published decompose THIS process's chains;
+        # the inject frame's domain name + actor filter scope the walk,
+        # and successive inject stamps bound the interval. Mutation
+        # barriers (deploy/stop/reschedule) do topology work, not
+        # epoch work — they neither tick nor reset the streaks.
+        dom = cmd.get("domain")
+        if dom is not None:
+            from risingwave_tpu.stream import monitor as _monitor
+            now = time.monotonic()
+            last = self._domain_stamp.get(dom)
+            self._domain_stamp[dom] = now
+            if (mutation is None and last is not None
+                    and _monitor.TRICOLOR):
+                from risingwave_tpu.stream.bottleneck import BOTTLENECKS
+                BOTTLENECKS.observe(
+                    domain=dom, epoch=pair.curr.value,
+                    interval_s=now - last,
+                    actors={int(a) for a in actors}
+                    if actors is not None else None)
         # stopped actors are gone after this barrier
         if isinstance(mutation, StopMutation):
             for aid in list(self.actors):

@@ -173,6 +173,80 @@ class FileLogSplitReader:
         return chunk
 
 
+class FileLogMultiReader:
+    """One source actor driving SEVERAL partition splits (the split-
+    rebalancing contract, ISSUE 15): the scheduler assigns each source
+    actor a partition subset and stamps it into the shipped plan; this
+    reader round-robins over per-partition ``FileLogSplitReader``s so
+    no split starves, and exposes the per-split byte offsets —
+    ``splits()`` / ``seek_split()`` — that the SourceExecutor persists
+    one row per split. On rescale, each split's offset row migrates to
+    its new owner's namespace and the new reader resumes from exactly
+    that byte: no record lost, none re-read.
+
+    An EMPTY partition set is legal (scale-out past the partition
+    count): the reader idles forever and the actor just forwards
+    barriers."""
+
+    unbounded = True
+
+    def __init__(self, path: str, topic: str, partitions,
+                 schema: Schema, fmt: str = "json",
+                 max_chunk_size: int = 1024, options=None):
+        self.path = path
+        self.topic = topic
+        self.partitions = [int(p) for p in partitions]
+        self.schema = schema
+        self.readers = [FileLogSplitReader(
+            path, topic, p, schema, fmt=fmt,
+            max_chunk_size=max_chunk_size, options=options)
+            for p in self.partitions]
+        self._rr = 0
+
+    @property
+    def split_id(self) -> str:
+        parts = "+".join(str(p) for p in self.partitions) or "none"
+        return f"filelog-{self.topic}-p{parts}"
+
+    @property
+    def offset(self) -> int:
+        """Aggregate byte position (throughput accounting only — the
+        recovery cursors are the PER-SPLIT offsets)."""
+        return sum(r.offset for r in self.readers)
+
+    @property
+    def rows_read(self) -> int:
+        return sum(r.rows_read for r in self.readers)
+
+    # -- the per-split offset contract ---------------------------------
+    def splits(self) -> List[tuple]:
+        """[(split_id, byte offset)] — one durable row per split."""
+        return [(r.split_id, r.offset) for r in self.readers]
+
+    def seek_split(self, split_id: str, offset: int) -> None:
+        for r in self.readers:
+            if r.split_id == split_id:
+                r.seek(offset)
+                return
+
+    def seek(self, offset: int) -> None:
+        """Aggregate seek is meaningless across splits — recovery goes
+        through ``seek_split`` (SourceExecutor's multi-split path); a
+        fresh deployment starts every split at 0 anyway."""
+
+    def next_chunk(self) -> Optional[StreamChunk]:
+        """Round-robin the splits, starting after the last producer so
+        a hot partition cannot starve its siblings."""
+        n = len(self.readers)
+        for i in range(n):
+            r = self.readers[(self._rr + i) % n]
+            chunk = r.next_chunk()
+            if chunk is not None:
+                self._rr = (self._rr + i + 1) % n
+                return chunk
+        return None
+
+
 def segment_path(path: str, topic: str, partition: int,
                  start: int) -> str:
     """Segment file for the records beginning at STREAM POSITION

@@ -142,9 +142,24 @@ def _source_reader(src: SourceCatalog):
                 fmt=opts.get("format", "json"),
                 max_chunk_size=int(opts.get("max.chunk.size", 1024)),
                 options=opts)
+        if "partitions" in opts:
+            # explicit split subset (the scheduler stamps each source
+            # actor's assignment here — the split-rebalancing
+            # contract); "" is a legal EMPTY assignment: scale-out
+            # past the partition count leaves idle source actors
+            from risingwave_tpu.connectors.filelog import (
+                FileLogMultiReader,
+            )
+            spec = str(opts["partitions"]).strip()
+            parts = [int(p) for p in spec.split(",") if p != ""]
+            return FileLogMultiReader(
+                path, topic, parts, src.schema,
+                fmt=opts.get("format", "json"),
+                max_chunk_size=int(opts.get("max.chunk.size", 1024)),
+                options=opts)
         splits = FileLogEnumerator(path, topic).list_splits()
-        # v0 single-pipeline sources: one reader drives partition 0
-        # (multi-split assignment lands with the fragmenter)
+        # bare single-pipeline sources: one reader drives partition 0
+        # (the distributed scheduler assigns explicit partition sets)
         if splits and not any(
                 int(s.split_id.rsplit("-", 1)[1]) == part
                 for s in splits):
@@ -1403,6 +1418,26 @@ def _system_catalog_rows(name: str, catalog: Catalog, profiler=None):
                       Field("attempt", DataType.INT64),
                       Field("detail", DataType.VARCHAR)])
         return sch, recovery_rows()
+    if n == "rw_autoscaler":
+        # elastic-control-loop decision ledger (meta/autoscaler.py):
+        # one row per completed scaling decision — direction, the
+        # signal that triggered it, and the guarded-rescale outcome
+        # (applied / rolled_back / rollback_failed / storm_disabled).
+        # Joins rw_recovery on wall time for the rollback story.
+        from risingwave_tpu.meta.autoscaler import autoscaler_rows
+        sch = Schema([Field("seq", DataType.INT64),
+                      Field("mv", DataType.VARCHAR),
+                      Field("fragment", DataType.INT64),
+                      Field("operator", DataType.VARCHAR),
+                      Field("direction", DataType.VARCHAR),
+                      Field("from_parallelism", DataType.INT64),
+                      Field("to_parallelism", DataType.INT64),
+                      Field("outcome", DataType.VARCHAR),
+                      Field("reason", DataType.VARCHAR),
+                      Field("epoch", DataType.INT64),
+                      Field("duration_s", DataType.FLOAT64),
+                      Field("detail", DataType.VARCHAR)])
+        return sch, autoscaler_rows()
     if n == "rw_plan_rewrites":
         # plan-rewrite firing log (frontend/opt engine): one row per
         # (job, rule) application, FALLBACK rows record checker trips

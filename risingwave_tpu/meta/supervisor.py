@@ -56,9 +56,12 @@ CAUSE_WEDGED_BARRIER = "wedged_barrier"  # collect exceeded its timeout
 CAUSE_WORKER_FAULT = "worker_fault"      # worker-side executor/plan error
 CAUSE_UNKNOWN = "unknown"
 
+CAUSE_RESCALE_FAILED = "rescale_failed"  # guarded rescale unwound
+
 # -- graduated responses ------------------------------------------------
 ACTION_RESPAWN = "respawn"   # restart dead slots, reset live ones in place
 ACTION_FULL = "full"         # kill-and-redeploy every slot
+ACTION_ROLLBACK = "rollback"  # rescale reverted to the prior topology
 
 # causes a respawn (rung 2) can repair; everything else escalates to
 # full recovery (rung 3)

@@ -208,8 +208,17 @@ class LocalBarrierManager:
         for senders in targets:
             for s in senders:
                 await s.send(barrier)
-        if not self._expected_for(epoch):
+        exp_now = self._expected_for(epoch)
+        if not exp_now:
             ev.set()        # zero actors: the epoch completes trivially
+        elif self._collected.get(epoch, set()) >= exp_now:
+            # in-band collections can OUTRUN the inject RPC on a busy
+            # worker: a downstream actor whose barrier arrived over the
+            # exchange collected against the process-default expected
+            # set before this send installed the domain's scoped one —
+            # re-check completion against the scoped set, or a barrier
+            # that is already fully collected wedges forever
+            ev.set()
 
     def collect(self, actor_id: int, barrier: Barrier) -> None:
         epoch = barrier.epoch.curr.value

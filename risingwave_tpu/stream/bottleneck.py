@@ -102,19 +102,26 @@ class BottleneckAnalyzer:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._domains: Dict[str, _DomainState] = {}
+        # (worker, domain) → remote row in the rows() wire shape:
+        # worker processes run their own walkers per barrier (the
+        # coordinator hosts no monitored actors on a distributed
+        # session); Cluster.drain_signals lands their snapshots here
+        self._remote: Dict[tuple, tuple] = {}
 
     # -- per-barrier observation ---------------------------------------
     def observe(self, domain: str, epoch: int, interval_s: float,
                 phase_seconds: Optional[dict] = None,
-                fragments=None) -> None:
+                fragments=None, actors=None) -> None:
         """One sealed barrier of ``domain``: walk its chains and
         advance/reset the streak machine. ``fragments`` restricts the
         topology to the domain's jobs (None = every registered chain
-        — the single-loop pipelines); ``phase_seconds`` is the sealed
-        ledger record's phase dict for the cross-check."""
+        — the single-loop pipelines), ``actors`` to the domain's actor
+        ids (the worker-side walk, where the barrier frame carries the
+        actor filter but not the job list); ``phase_seconds`` is the
+        sealed ledger record's phase dict for the cross-check."""
         from risingwave_tpu.stream.monitor import TOPOLOGY, UTILIZATION
 
-        roots = TOPOLOGY.roots(fragments)
+        roots = TOPOLOGY.roots(fragments, actors=actors)
         if not roots:
             return
         cand = None
@@ -289,24 +296,53 @@ class BottleneckAnalyzer:
                          f"this operator first")
         return "; ".join(parts)
 
+    # -- cross-process merge -------------------------------------------
+    def ingest(self, rows, worker: str) -> int:
+        """Merge one worker's walker snapshot (rows in the ``rows()``
+        wire shape). Streak machines live where the chains live — each
+        worker sustains its own candidates; ``rows()`` then reports
+        the strongest candidate per domain across processes. Replaces
+        the worker's previous snapshot wholesale (the rows are
+        last-barrier state, not a log), dropping domains the worker no
+        longer reports."""
+        with self._lock:
+            for key in [k for k in self._remote if k[0] == worker]:
+                del self._remote[key]
+            n = 0
+            for r in rows:
+                if len(r) != 11:
+                    continue
+                self._remote[(worker, str(r[0]))] = tuple(r)
+                n += 1
+        return n
+
     # -- reads ---------------------------------------------------------
     def rows(self) -> List[tuple]:
         """(domain, operator, fragment, actor_id, node, busy_ratio,
         downstream_backpressure, streak, sustained, epoch, diagnosis)
-        ranked most-suspect first — the rw_bottlenecks payload."""
+        ranked most-suspect first — the rw_bottlenecks payload. Local
+        walker state and ingested worker snapshots merge per domain:
+        the row with the longest streak (busy share breaking ties)
+        wins — the strongest sustained evidence across processes."""
         with self._lock:
-            out = []
+            cand: Dict[str, tuple] = {}
             for domain in sorted(self._domains):
                 st = self._domains[domain]
                 if st.op is None:
-                    out.append((domain, None, "", 0, 0, 0.0, 0.0, 0,
-                                0, st.epoch, "no sustained bottleneck"))
+                    cand[domain] = (domain, None, "", 0, 0, 0.0, 0.0,
+                                    0, 0, st.epoch,
+                                    "no sustained bottleneck")
                     continue
-                out.append((domain, st.op, st.fragment, st.actor,
-                            st.node, st.busy, st.downstream_bp,
-                            st.streak,
-                            int(st.streak >= SUSTAINED_STREAK),
-                            st.epoch, st.diagnosis))
+                cand[domain] = (domain, st.op, st.fragment, st.actor,
+                                st.node, st.busy, st.downstream_bp,
+                                st.streak,
+                                int(st.streak >= SUSTAINED_STREAK),
+                                st.epoch, st.diagnosis)
+            for (_w, domain), r in self._remote.items():
+                cur = cand.get(domain)
+                if cur is None or (r[7], r[5]) > (cur[7], cur[5]):
+                    cand[domain] = tuple(r)
+            out = list(cand.values())
         return sorted(out, key=lambda r: (-(r[7] * max(r[5], 1e-9)),
                                           r[0]))
 
@@ -330,6 +366,7 @@ class BottleneckAnalyzer:
                     STREAMING.bottleneck_streak.remove(
                         domain=domain, operator=st.op)
             self._domains.clear()
+            self._remote.clear()
 
 
 # the process-global analyzer (coordinator-side: the walker reads the

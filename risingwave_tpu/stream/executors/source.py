@@ -89,19 +89,38 @@ class SourceExecutor(Executor):
     def _recover_offset(self) -> None:
         if self.split_state is None:
             return
+        splits = getattr(self.reader, "splits", None)
+        if splits is not None:
+            # multi-split reader (split rebalancing, ISSUE 15): one
+            # durable row PER split — after a rescale moved this
+            # split's row into our namespace, the byte offset resumes
+            # exactly where the previous owner checkpointed
+            for split_id, _off in splits():
+                row = self.split_state.get_row((split_id,))
+                if row is not None:
+                    self.reader.seek_split(split_id, row[1])
+            return
         row = self.split_state.get_row((self.reader.split_id,))
         if row is not None:
             self.reader.seek(row[1])
 
-    def _persist_offset(self) -> None:
-        if self.split_state is None:
-            return
-        row = (self.reader.split_id, self.reader.offset)
-        old = self.split_state.get_row((self.reader.split_id,))
+    def _persist_one(self, split_id: str, offset: int) -> None:
+        row = (split_id, offset)
+        old = self.split_state.get_row((split_id,))
         if old is None:
             self.split_state.insert(row)
         elif tuple(old) != row:
             self.split_state.update(old, row)
+
+    def _persist_offset(self) -> None:
+        if self.split_state is None:
+            return
+        splits = getattr(self.reader, "splits", None)
+        if splits is not None:
+            for split_id, off in splits():
+                self._persist_one(split_id, off)
+            return
+        self._persist_one(self.reader.split_id, self.reader.offset)
 
     def _handle_barrier(self, barrier: Barrier) -> None:
         if barrier.is_pause():

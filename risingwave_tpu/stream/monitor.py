@@ -120,6 +120,35 @@ class UtilizationTable:
         with self._lock:
             return self._rows.get((fragment, actor_id, node))
 
+    def ingest_rows(self, rows) -> int:
+        """Merge another process's utilization snapshot (the worker
+        ``signals`` drain): rows in the ``rows()`` wire shape land in
+        this table keyed exactly like local ones — actor ids are
+        cluster-unique, so worker and coordinator rows never collide.
+        Ratios arrive pre-computed; the accounting gate ran in the
+        process that measured them, so no re-validation here."""
+        n = 0
+        with self._lock:
+            for (a, f, node, ex, e, interval, busy, bp, idle) in rows:
+                self._rows[(str(f), int(a), int(node))] = (
+                    str(ex), int(e), float(interval), float(busy),
+                    float(bp), float(idle))
+                n += 1
+        return n
+
+    def prune(self, keep_actors) -> int:
+        """Drop rows for actors outside ``keep_actors`` — the merged
+        coordinator view's eviction path: workers drop their own rows
+        at actor exit, but ingested copies would otherwise outlive
+        every rescale/recovery (fresh actor ids each redeploy) and
+        grow the table without bound."""
+        keep = set(keep_actors)
+        with self._lock:
+            dead = [k for k in self._rows if k[1] not in keep]
+            for k in dead:
+                del self._rows[k]
+        return len(dead)
+
     def rows(self) -> List[tuple]:
         """(actor_id, fragment, node, executor, epoch, interval_s,
         busy_ratio, backpressure_ratio, idle_ratio) sorted by busy
@@ -176,13 +205,18 @@ class Topology:
             self._actors.pop(actor_id, None)
         UTILIZATION.drop_actor(actor_id)
 
-    def roots(self, fragments=None) -> List[tuple]:
+    def roots(self, fragments=None, actors=None) -> List[tuple]:
         """[(actor_id, fragment, root wrapper)]; ``fragments`` (a set
-        of job names) restricts to one barrier domain's chains."""
+        of job names) restricts to one barrier domain's chains, and
+        ``actors`` (a set of actor ids — the barrier-domain frame's
+        actor filter) restricts to that domain's actors on THIS
+        process (a worker hosts several domains' chains in one
+        registry)."""
         with self._lock:
             items = list(self._actors.items())
         return [(a, f, r) for a, (f, r) in items
-                if fragments is None or f in fragments]
+                if (fragments is None or f in fragments)
+                and (actors is None or a in actors)]
 
     def clear(self) -> None:
         with self._lock:

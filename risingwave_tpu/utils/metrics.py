@@ -476,6 +476,15 @@ class ClusterMetrics:
         self.worker_expired = r.counter(
             "cluster_worker_expired_total",
             "workers evicted by heartbeat lease expiry, by worker id")
+        self.autoscaler_decision = r.counter(
+            "autoscaler_decision_total",
+            "autoscaler scaling decisions by mv and direction "
+            "(up/down); every completed action counts here, including "
+            "ones later rolled back")
+        self.autoscaler_rollback = r.counter(
+            "autoscaler_rollback_total",
+            "autoscaler actions rolled back to the prior parallelism "
+            "(failed, timed-out, or health-failing rescales), by mv")
 
 
 class StorageMetrics:
