@@ -219,7 +219,7 @@ def bench_q1(total_events: int = 50 * 4000, chunk_size: int = 4096):
 
 def bench_q7(total_events: int = 50 * 40_000, chunk_size: int = 8192,
              fusion: bool = False, ledger: bool = True,
-             tricolor: bool = True):
+             tricolor: bool = True, costs: bool = True):
     """q7 core: tumble-window MAX(price) on the device hash-agg kernel.
 
     The stateful baseline config (BASELINE.md: HashAgg on TPU, ≥1M
@@ -229,12 +229,15 @@ def bench_q7(total_events: int = 50 * 40_000, chunk_size: int = 8192,
     the phase-ledger-off arm (ISSUE 11 acceptance: ledger-on
     throughput within 5% of ledger-off on q7 CPU); ``tricolor=False``
     is the utilization-tricolor/freshness-off arm (ISSUE 14: on-vs-off
-    within 5%) — each query runs in its own subprocess, so the toggles
-    never leak across lanes."""
+    within 5%); ``costs=False`` is the cost/skew-attribution-off arm
+    (ISSUE 16: per-MV rollup, topology upkeep and hot-key sketches
+    reduced to predicate checks) — each query runs in its own
+    subprocess, so the toggles never leak across lanes."""
     from risingwave_tpu.common.types import Interval
     from risingwave_tpu.connectors.nexmark import NexmarkConfig
     from risingwave_tpu.models.nexmark import build_q7, drive_to_completion
     from risingwave_tpu.state.store import MemoryStateStore
+    from risingwave_tpu.stream import costs as costs_mod
     from risingwave_tpu.stream import freshness as freshness_mod
     from risingwave_tpu.stream import monitor as monitor_mod
     from risingwave_tpu.utils import ledger as ledger_mod
@@ -242,6 +245,7 @@ def bench_q7(total_events: int = 50 * 40_000, chunk_size: int = 8192,
     ledger_mod.set_enabled(ledger)
     monitor_mod.set_tricolor(tricolor)
     freshness_mod.set_enabled(tricolor)
+    costs_mod.set_enabled(costs)
     cfg = NexmarkConfig(event_num=total_events, max_chunk_size=chunk_size,
                         generate_strings=False)
     p = build_q7(MemoryStateStore(), cfg, rate_limit=32, min_chunks=32,
@@ -521,12 +525,23 @@ def bench_multimv(n_impressions: int = 120_000,
         fe.loop.profiler.drop_first(warm_epochs)
         by_domain = fe.loop.p99_by_domain()
         domains = fe.loop.describe()
+        # marginal-cost snapshot (ISSUE 16): captured BEFORE close —
+        # close purges each dropped MV's cost/topology series, which
+        # is exactly the lifecycle the attribution surface promises
+        from risingwave_tpu.state.topology import TOPOLOGY
+        from risingwave_tpu.stream.costs import COSTS
+        marginal = COSTS.summary()
+        imbalance = TOPOLOGY.imbalance_by_mv()
+        topo_by_mv = TOPOLOGY.bytes_by_mv()
+        att_dev, led_dev = COSTS.coverage()
         await fe.close()
-        return elapsed, rows, fe.loop, by_domain, domains
+        return (elapsed, rows, fe.loop, by_domain, domains,
+                marginal, imbalance, topo_by_mv, att_dev, led_dev)
 
     with tempfile.TemporaryDirectory() as path:
         _adctr_produce(path, n_impressions)
-        elapsed, rows, loop, by_domain, domains = \
+        (elapsed, rows, loop, by_domain, domains, marginal,
+         imbalance, topo_by_mv, att_dev, led_dev) = \
             asyncio.run(run(path))
     r = _result("multimv_events_per_sec", elapsed, rows, loop)
     from risingwave_tpu.utils.ledger import LEDGER
@@ -535,6 +550,38 @@ def bench_multimv(n_impressions: int = 120_000,
               "phase_breakdown": LEDGER.phase_breakdown(domain=dom)}
         for dom, p99 in sorted(by_domain.items())}
     r["domains"] = domains
+    # per-MV serving-cost rollup + attribution coverage: the split
+    # must account (nearly) all ledgered device time and all state
+    # bytes to a NAMED MV — unattributed cost is the failure mode
+    mv_state = sum(b for mv, b in topo_by_mv.items() if mv)
+    topo_state = sum(topo_by_mv.values())
+    r["marginal_cost"] = {
+        "by_mv": {mv: {"device_s": round(d.get("device_s", 0.0), 6),
+                       "state_bytes": int(d.get("state_bytes", 0)),
+                       "h2d_bytes": int(d.get("h2d_bytes", 0)),
+                       "d2h_bytes": int(d.get("d2h_bytes", 0)),
+                       "compile_hits": int(d.get("compile_hits", 0)),
+                       "compile_misses":
+                           int(d.get("compile_misses", 0)),
+                       "shared_compile_hits":
+                           int(d.get("shared_hits", 0)),
+                       "hot_vnode_imbalance":
+                           round(imbalance.get(mv, 1.0), 3)}
+                 for mv, d in sorted(marginal.items())},
+        # both sides summed over the SAME sealed-epoch window
+        # (COSTS.coverage) — cumulative totals vs the ledger's bounded
+        # record deque would inflate past 1.0 as records age out
+        "ledgered_device_compute_s": round(led_dev, 6),
+        "attributed_device_s": round(att_dev, 6),
+        "device_coverage": round(att_dev / led_dev, 4)
+        if led_dev > 0 else None,
+        "attributed_state_bytes": int(mv_state),
+        # acceptance: >= 95% of ledgered device_compute and state
+        # bytes land on a named MV
+        "coverage_ok": (led_dev > 0
+                        and att_dev >= 0.95 * led_dev
+                        and mv_state >= 0.95 * topo_state),
+    }
     # the acceptance proof: every domain EXCEPT the ad-ctr one keeps
     # a sub-second p99 — a slow fragment holds only its own domain
     fast = {d: v["p99_s"] for d, v in r["by_domain"].items()
@@ -1372,9 +1419,9 @@ def _main_locked(argv):
     # timed number then measures the compiler, not the pipeline
     # fused twins right after their interpretive baselines: the round
     # diff shows fragment fusion's before/after per query (ISSUE 6)
-    names = ["q7", "q7_ledger_off", "q7_tricolor_off", "q7_fused",
-             "q8", "q8_fused", "q4", "q3", "q3_fused", "q5",
-             "q5_fused", "q1"]
+    names = ["q7", "q7_ledger_off", "q7_tricolor_off", "q7_costs_off",
+             "q7_fused", "q8", "q8_fused", "q4", "q3", "q3_fused",
+             "q5", "q5_fused", "q1"]
     if quick:
         names = names[:1]
     headline = {}
@@ -1417,6 +1464,7 @@ def _main_locked(argv):
                                   "platform", "by_domain", "domains",
                                   "fast_domains_p99_max_s",
                                   "fast_domains_sub_second",
+                                  "marginal_cost",
                                   "observability", "freshness",
                                   "bottleneck") if k in r}
         except Exception as e:                       # noqa: BLE001
@@ -1517,6 +1565,17 @@ def _main_locked(argv):
                 on_["value"] / toff["value"], 4),
             "within_5pct": on_["value"] >= 0.95 * toff["value"],
         }
+    # cost/skew-attribution-overhead verdict (ISSUE 16 acceptance:
+    # per-MV cost rollup + state topology + hot-key sketches on-vs-off
+    # q7 throughput within 5%)
+    coff = headline.get("q7_costs_off")
+    if isinstance(coff, dict) and isinstance(on_, dict) \
+            and coff.get("value") and on_.get("value"):
+        coff["costs_overhead"] = {
+            "on_vs_off_throughput_ratio": round(
+                on_["value"] / coff["value"], 4),
+            "within_5pct": on_["value"] >= 0.95 * coff["value"],
+        }
     q7 = headline.get("q7", {})
     ok = "value" in q7
     headline.update({
@@ -1591,6 +1650,12 @@ BENCH_FNS.update({"q7": bench_q7, "q8": bench_q8, "q4": bench_q4,
                   # the attribution-tax control (on-vs-off < 5%)
                   "q7_tricolor_off": _functools.partial(
                       bench_q7, tricolor=False),
+                  # cost/skew-attribution-off arm (ISSUE 16): same q7
+                  # config with the per-MV rollup, topology upkeep and
+                  # hot-key sketches reduced to predicate checks —
+                  # the serving-cost-attribution tax control (< 5%)
+                  "q7_costs_off": _functools.partial(
+                      bench_q7, costs=False),
                   # fragment fusion on (SET stream_fusion equivalent
                   # for the hand-built pipelines)
                   "q7_fused": _functools.partial(bench_q7, fusion=True),

@@ -170,6 +170,8 @@ def _ctl(args) -> int:
         return asyncio.run(_ctl_top(obj, args))
     if verb == "autoscale":
         return asyncio.run(_ctl_autoscale(obj, args))
+    if verb == "cost":
+        return asyncio.run(_ctl_cost(obj, args))
     if verb == "backup":
         from risingwave_tpu.meta.backup import (
             create_backup, delete_backup, list_backups, restore_backup,
@@ -279,6 +281,7 @@ async def _ctl_memory(obj, args) -> int:
     node."""
     from risingwave_tpu.frontend import Frontend
     from risingwave_tpu.state.tier import GLOBAL as TIER
+    from risingwave_tpu.state.topology import TOPOLOGY
     from risingwave_tpu.storage.hummock import HummockLite
     from risingwave_tpu.utils.memory import GLOBAL as MEM
 
@@ -300,6 +303,16 @@ async def _ctl_memory(obj, args) -> int:
                 cap_s = "-" if cap < 0 else str(cap)
                 print(f"  {name}: cap={cap_s} resident={res} "
                       f"evicted={ev} reloads={rl} bytes={nb}")
+        stats = TOPOLOGY.table_stats()
+        if stats:
+            print("state topology (per-table, hottest vnodes):")
+            for t, mv, nrows, nbytes, vns, imb in stats:
+                print(f"  table {t} ({mv or '?'}): {nrows} rows, "
+                      f"{nbytes}B over {vns} vnodes, "
+                      f"imbalance {imb:.2f}")
+                for vn, vrows, vbytes in TOPOLOGY.top_vnodes(t, 8):
+                    print(f"    vnode {vn:>5}: {vrows:>8} rows "
+                          f"{vbytes:>12}B")
     finally:
         await fe.close()
     return 0
@@ -480,6 +493,61 @@ async def _ctl_autoscale(obj, args) -> int:
     return 0
 
 
+async def _ctl_cost(obj, args) -> int:
+    """Recover into an in-memory clone (same snapshot discipline as
+    `table scan`), drive a few checkpoints per refresh, and print the
+    serving-cost attribution view: the per-MV resource ledger
+    (device-seconds, transfer bytes, resident state, compile-cache
+    economics, rescale/recovery charge-back), each MV's worst
+    hot-vnode imbalance, and the hottest keys per executor input.
+    ``--watch N`` repeats the drive+print cycle N times. On a serving
+    cluster, ``SELECT * FROM rw_mv_costs`` / ``rw_hot_keys`` /
+    ``rw_state_topology`` over pgwire see the live books."""
+    from risingwave_tpu.frontend import Frontend
+    from risingwave_tpu.state.topology import TOPOLOGY
+    from risingwave_tpu.storage.hummock import HummockLite
+    from risingwave_tpu.stream.costs import COSTS
+    from risingwave_tpu.stream.hotkeys import HOTKEYS
+
+    fe = Frontend(HummockLite(_snapshot_clone(obj)))
+    await fe.recover()
+    try:
+        for cycle in range(max(1, args.watch)):
+            await fe.step(args.steps)
+            if cycle:
+                print()
+            imb = TOPOLOGY.imbalance_by_mv()
+            print(f"== refresh {cycle + 1} — per-MV serving cost ==")
+            print(f"{'device_s':>10} {'h2d_B':>12} {'d2h_B':>12} "
+                  f"{'state_B':>12} {'compile':>12} {'charge_s':>9} "
+                  f"{'imb':>5}  mv (domain)")
+            rows = sorted(COSTS.rows(), key=lambda r: -r[2])
+            for (mv, dom, dev, h2d, d2h, state, hits, misses,
+                 shared, rescale_s, recovery_s) in rows:
+                comp = f"{hits}h/{misses}m"
+                if shared:
+                    comp += f"/{shared}s"
+                print(f"{dev:>10.4f} {h2d:>12} {d2h:>12} "
+                      f"{state:>12} {comp:>12} "
+                      f"{rescale_s + recovery_s:>9.2f} "
+                      f"{imb.get(mv, 1.0):>5.2f}  {mv}"
+                      + (f" ({dom})" if dom else ""))
+            if not rows:
+                print("(no attributed epochs yet — is stream_costs "
+                      "off?)")
+            hot = HOTKEYS.rows()
+            if hot:
+                print("== hot keys (top rank per input) ==")
+                for (mv, ex, rank, key, est, share, err) in hot:
+                    if rank:
+                        continue
+                    print(f"  {share:>6.1%} (±{err:.1%}) "
+                          f"{key!r}  {mv} / {ex}")
+    finally:
+        await fe.close()
+    return 0
+
+
 def main(argv=None) -> None:
     # the axon sitecustomize rewrites jax_platforms at interpreter
     # start, overriding JAX_PLATFORMS=cpu — honor the env var so ctl /
@@ -558,6 +626,16 @@ def main(argv=None) -> None:
     asc.add_argument("--steps", type=int, default=4,
                      help="checkpoint barriers to drive before the "
                           "report")
+    co = csub.add_parser(
+        "cost",
+        help="recover + print the serving-cost attribution view: "
+             "per-MV device-seconds / transfer / state / compile-"
+             "cache ledger, hot-vnode imbalance, and heavy-hitter "
+             "keys")
+    co.add_argument("--steps", type=int, default=4,
+                    help="checkpoint barriers to drive per refresh")
+    co.add_argument("--watch", type=int, default=1,
+                    help="refresh cycles to print (drive+print each)")
     bk = csub.add_parser("backup")
     bk.add_argument("what",
                     choices=["create", "list", "delete", "restore"])

@@ -545,6 +545,15 @@ class Cluster:
             c.call({"cmd": "set_ledger", "on": bool(on)})
             for c in self.clients if c is not None))
 
+    async def set_costs(self, on: bool) -> None:
+        """Fan the cost/skew-attribution toggle out to every worker
+        (per-MV cost books, topology upkeep and hot-key sketches flip
+        together). Remembered for respawns like set_ledger."""
+        self._costs_on = bool(on)
+        await asyncio.gather(*(
+            c.call({"cmd": "set_costs", "on": bool(on)})
+            for c in self.clients if c is not None))
+
     async def drain_trace(self) -> int:
         """Pull every worker's recorded spans into the coordinator's
         flight recorder, tagged by worker slot — a drained span leaves
@@ -603,7 +612,7 @@ class Cluster:
             n += FRESHNESS.ingest(reply.get("parts") or {})
         return n
 
-    async def drain_signals(self) -> int:
+    async def drain_signals(self, light: bool = False) -> int:
         """Pull every worker's autoscaler signal snapshot — the
         utilization tricolor rows and the worker-side bottleneck-walker
         state — into the coordinator's process-global views. Actor ids
@@ -611,12 +620,16 @@ class Cluster:
         walker merge keeps the strongest per-domain candidate across
         processes. Feeds rw_actor_utilization / rw_bottlenecks on the
         distributed session and the autoscaler's tick."""
+        from risingwave_tpu.state.topology import TOPOLOGY
         from risingwave_tpu.stream.bottleneck import BOTTLENECKS
+        from risingwave_tpu.stream.costs import COSTS
+        from risingwave_tpu.stream.hotkeys import HOTKEYS
         from risingwave_tpu.stream.monitor import UTILIZATION
         live = [(k, c) for k, c in enumerate(self.clients)
                 if c is not None]
         replies = await asyncio.gather(*(
-            c.call_idempotent({"cmd": "signals"}, io_timeout=20.0)
+            c.call_idempotent({"cmd": "signals", "light": light},
+                              io_timeout=20.0)
             for _k, c in live))
         n = 0
         for (k, _c), reply in zip(live, replies):
@@ -624,6 +637,18 @@ class Cluster:
                                          or ())
             n += BOTTLENECKS.ingest(reply.get("bottlenecks") or (),
                                     worker=f"worker-{k}")
+            # attribution surfaces (ISSUE 16): topology/hot-key
+            # snapshots replace per worker (absent on a light drain —
+            # replacing with () would wipe the cached snapshot); cost
+            # books fold as true-drain deltas every time
+            if "topology" in reply:
+                n += TOPOLOGY.ingest(reply["topology"] or (),
+                                     worker=f"worker-{k}")
+            if "hot_keys" in reply:
+                n += HOTKEYS.ingest(reply["hot_keys"] or (),
+                                    worker=f"worker-{k}")
+            n += COSTS.ingest(reply.get("mv_costs") or {},
+                              worker=f"worker-{k}")
         # evict rows for actors no rescale/recovery kept: ingested
         # copies have no worker-side drop to mirror, and every
         # redeploy mints fresh actor ids
@@ -688,7 +713,9 @@ class Cluster:
         for verb, on in (("set_trace", getattr(self, "_trace_on",
                                                None)),
                          ("set_ledger", getattr(self, "_ledger_on",
-                                                None))):
+                                                None)),
+                         ("set_costs", getattr(self, "_costs_on",
+                                               None))):
             if on is not None:
                 await self.clients[k].call_idempotent(
                     {"cmd": verb, "on": on}, io_timeout=20.0)

@@ -105,6 +105,9 @@ class DistFrontend:
         from risingwave_tpu.frontend.opt import parse_fusion, parse_rules
         from risingwave_tpu.frontend.session_vars import SessionVars
         from risingwave_tpu.meta.autoscaler import parse_autoscale
+        from risingwave_tpu.stream.costs import (
+            parse_costs as _parse_costs,
+        )
         from risingwave_tpu.utils.ledger import parse_ledger
         from risingwave_tpu.utils.spans import parse_trace
         self.session_vars = SessionVars(
@@ -136,11 +139,16 @@ class DistFrontend:
              # epoch phase ledger (utils/ledger.py): fans out like
              # stream_trace — a cross-process merge must be all-on or
              # all-off
-             "stream_ledger": "on"},
+             "stream_ledger": "on",
+             # cost & skew attribution (ISSUE 16): per-MV cost books,
+             # topology upkeep and hot-key sketches; fans out like
+             # stream_ledger
+             "stream_costs": "on"},
             validators={"stream_rewrite_rules": parse_rules,
                         "stream_fusion": parse_fusion,
                         "stream_trace": parse_trace,
                         "stream_ledger": parse_ledger,
+                        "stream_costs": _parse_costs,
                         "stream_autoscale": parse_autoscale})
         # the elastic control loop (created lazily on SET
         # stream_autoscale=on; ticked by run_heartbeat while on)
@@ -286,6 +294,12 @@ class DistFrontend:
                     self.session_vars.get("stream_ledger"))
                 _ledger.set_enabled(on)
                 await self.cluster.set_ledger(on)
+            if stmt.name == "stream_costs":
+                from risingwave_tpu.stream import costs as _mvcosts
+                on = _mvcosts.parse_costs(
+                    self.session_vars.get("stream_costs"))
+                _mvcosts.set_enabled(on)
+                await self.cluster.set_costs(on)
             if stmt.name == "stream_autoscale":
                 from risingwave_tpu.meta.autoscaler import (
                     Autoscaler, parse_autoscale,
@@ -489,8 +503,11 @@ class DistFrontend:
             await self.cluster.drop_job(stmt.name)
         del self.catalog.mvs[stmt.name]
         self._mv_selects.pop(stmt.name, None)
-        from risingwave_tpu.stream.freshness import FRESHNESS
-        FRESHNESS.unregister_mv(stmt.name)
+        # central series-lifecycle purge (freshness, costs, hot keys,
+        # topology): coordinator-side books — including drained worker
+        # copies — die with the job so no {mv=...} series lingers
+        from risingwave_tpu.stream.costs import purge_mv_series
+        purge_mv_series(stmt.name)
         return "DROP_MATERIALIZED_VIEW"
 
     async def drain_trace(self) -> int:
@@ -523,10 +540,18 @@ class DistFrontend:
             # freshness parts live on the workers (source + materialize
             # fragments): merge them before the tracker serves rows
             await self.cluster.drain_freshness()
-        if referenced & {"rw_bottlenecks", "rw_actor_utilization"}:
-            # the tricolor + walker run where the chains run (worker
-            # processes): pull their snapshots before the read
+        if referenced & {"rw_bottlenecks", "rw_actor_utilization",
+                         "rw_mv_costs", "rw_hot_keys",
+                         "rw_state_topology"}:
+            # the tricolor + walker + attribution surfaces live where
+            # the chains live (worker processes): pull their
+            # snapshots/books before the read
             await self.cluster.drain_signals()
+        if "rw_mv_costs" in referenced:
+            # cost rows join the ledgered device books — fold worker
+            # ledgers too so the per-MV split reads against merged
+            # totals
+            await self.drain_ledger()
         view = ClusterStoreView(self.cluster)
         # one consistent snapshot: the barrier lock keeps the
         # heartbeat from committing an epoch between per-table scans

@@ -175,15 +175,32 @@ class WorkerServer:
             from risingwave_tpu.utils.ledger import LEDGER
             return {"ok": True, "epochs": LEDGER.drain_dicts()}
         if verb == "signals":
-            # autoscaler signal snapshot (ISSUE 15): this process's
-            # utilization tricolor + bottleneck-walker state, merged
-            # coordinator-side by Cluster.drain_signals. A snapshot,
-            # not a drain — streak machines keep running here
+            # autoscaler signal snapshot (ISSUE 15/16): this process's
+            # utilization tricolor + bottleneck-walker state, plus the
+            # attribution surfaces (state topology + hot-key sketches
+            # as snapshots, per-MV cost books as a true drain — the
+            # coordinator owns the merged totals), merged
+            # coordinator-side by Cluster.drain_signals
+            from risingwave_tpu.state.topology import TOPOLOGY
             from risingwave_tpu.stream.bottleneck import BOTTLENECKS
+            from risingwave_tpu.stream.costs import COSTS
+            from risingwave_tpu.stream.hotkeys import HOTKEYS
             from risingwave_tpu.stream.monitor import UTILIZATION
-            return {"ok": True,
-                    "utilization": UTILIZATION.rows(),
-                    "bottlenecks": BOTTLENECKS.rows()}
+            out = {"ok": True,
+                   "utilization": UTILIZATION.rows(),
+                   "bottlenecks": BOTTLENECKS.rows(),
+                   "mv_costs": COSTS.drain_dict()}
+            if not cmd.get("light"):
+                # the per-vnode topology snapshot walks the per-key
+                # map — serve it only to query-driven drains, never
+                # the per-tick heartbeat (light=True)
+                out["topology"] = TOPOLOGY.drain_rows()
+                out["hot_keys"] = HOTKEYS.drain_rows()
+            return out
+        if verb == "set_costs":
+            from risingwave_tpu.stream import costs as _costs
+            _costs.set_enabled(bool(cmd.get("on", True)))
+            return {"ok": True}
         if verb == "drain_freshness":
             # pop this process's raw freshness parts (ingest hwms,
             # epoch frontiers, visibility events) — the coordinator

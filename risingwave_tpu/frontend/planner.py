@@ -1438,6 +1438,50 @@ def _system_catalog_rows(name: str, catalog: Catalog, profiler=None):
                       Field("duration_s", DataType.FLOAT64),
                       Field("detail", DataType.VARCHAR)])
         return sch, autoscaler_rows()
+    if n == "rw_mv_costs":
+        # per-MV resource ledger (stream/costs.py, ISSUE 16): the
+        # barrier-interval device/transfer split by owning MV, joined
+        # at read time with state bytes (topology), compile-cache
+        # attribution and recovery/rescale charge-back — `ctl cost`
+        # and the marginal-cost bench read this
+        from risingwave_tpu.stream.costs import COSTS
+        sch = Schema([Field("mv", DataType.VARCHAR),
+                      Field("domain", DataType.VARCHAR),
+                      Field("device_seconds", DataType.FLOAT64),
+                      Field("h2d_bytes", DataType.INT64),
+                      Field("d2h_bytes", DataType.INT64),
+                      Field("state_bytes", DataType.INT64),
+                      Field("compile_hits", DataType.INT64),
+                      Field("compile_misses", DataType.INT64),
+                      Field("shared_compile_hits", DataType.INT64),
+                      Field("rescale_s", DataType.FLOAT64),
+                      Field("recovery_s", DataType.FLOAT64)])
+        return sch, COSTS.rows()
+    if n == "rw_hot_keys":
+        # heavy-hitter telemetry (stream/hotkeys.py): sustained hot
+        # keys per hash-join/hash-agg input with share estimates —
+        # max_share_err bounds the space-saving overcount, so
+        # share - max_share_err is a guaranteed lower bound
+        from risingwave_tpu.stream.hotkeys import HOTKEYS
+        sch = Schema([Field("mv", DataType.VARCHAR),
+                      Field("executor", DataType.VARCHAR),
+                      Field("rank", DataType.INT64),
+                      Field("key", DataType.VARCHAR),
+                      Field("est_count", DataType.INT64),
+                      Field("share", DataType.FLOAT64),
+                      Field("max_share_err", DataType.FLOAT64)])
+        return sch, HOTKEYS.rows()
+    if n == "rw_state_topology":
+        # per-(table, vnode) state footprint (state/topology.py):
+        # maintained incrementally at flush — the rescale planner's
+        # move-cost input and `ctl memory`'s breakdown
+        from risingwave_tpu.state.topology import TOPOLOGY
+        sch = Schema([Field("table_id", DataType.INT64),
+                      Field("mv", DataType.VARCHAR),
+                      Field("vnode", DataType.INT64),
+                      Field("rows", DataType.INT64),
+                      Field("bytes", DataType.INT64)])
+        return sch, TOPOLOGY.rows()
     if n == "rw_plan_rewrites":
         # plan-rewrite firing log (frontend/opt engine): one row per
         # (job, rule) application, FALLBACK rows record checker trips

@@ -89,6 +89,9 @@ class Frontend:
         # are pg-compatibility strings (shared impl: session_vars.py)
         from risingwave_tpu.frontend.opt import parse_fusion, parse_rules
         from risingwave_tpu.frontend.session_vars import SessionVars
+        from risingwave_tpu.stream.costs import (
+            parse_costs as _parse_costs,
+        )
         from risingwave_tpu.stream.monitor import (
             parse_tricolor as _parse_tricolor,
         )
@@ -137,12 +140,18 @@ class Frontend:
              # utilization tricolor, per-MV freshness sampling and
              # the bottleneck walker; 'off' reduces every hook to a
              # predicate check (the q7_tricolor_off bench arm)
-             "stream_tricolor": "on"},
+             "stream_tricolor": "on",
+             # cost & skew attribution (ISSUE 16): per-MV resource
+             # ledger, state topology upkeep and hot-key sketches;
+             # 'off' reduces every hook to a predicate check (the
+             # q7_costs_off bench arm)
+             "stream_costs": "on"},
             validators={"stream_rewrite_rules": parse_rules,
                         "stream_fusion": parse_fusion,
                         "stream_trace": parse_trace,
                         "stream_ledger": parse_ledger,
                         "stream_tricolor": _parse_tricolor,
+                        "stream_costs": _parse_costs,
                         "stream_epoch_pipeline":
                             self._validate_epoch_pipeline})
         # rules spec each MV was created under: reschedule replans +
@@ -438,6 +447,13 @@ class Frontend:
                     self.session_vars.get("stream_tricolor"))
                 _monitor.set_tricolor(on)
                 _fresh.set_enabled(on)
+            if stmt.name == "stream_costs":
+                # flips the per-MV cost rollup, topology upkeep and
+                # hot-key sketches together (stream/costs.py owns the
+                # fan-out to its sibling flags)
+                from risingwave_tpu.stream import costs as _mvcosts
+                _mvcosts.set_enabled(_mvcosts.parse_costs(
+                    self.session_vars.get("stream_costs")))
             if stmt.name == "stream_epoch_pipeline":
                 from risingwave_tpu.meta.domains import (
                     parse_epoch_pipeline,
@@ -673,6 +689,11 @@ class Frontend:
         # shapes join state-table pk layouts — id-base contract)
         self._mv_tier_caps[stmt.name] = self.state_tier_cap or None
         if self._deployed_actor.failure is not None:
+            # a failed CREATE deployed far enough to register {mv=...}
+            # series — purge them before surfacing the failure, or the
+            # dead job haunts the exposition (series-lifecycle rule)
+            from risingwave_tpu.stream.costs import purge_mv_series
+            purge_mv_series(stmt.name)
             raise self._deployed_actor.failure
         return "CREATE_MATERIALIZED_VIEW"
 
@@ -742,6 +763,8 @@ class Frontend:
         self._tables[stmt.name] = (reader, schema, pk, rowid,
                                    table_id)
         if self._deployed_actor.failure is not None:
+            from risingwave_tpu.stream.costs import purge_mv_series
+            purge_mv_series(stmt.name)
             raise self._deployed_actor.failure
         return "CREATE_TABLE"
 
@@ -1133,6 +1156,8 @@ class Frontend:
                     dependent_sources=plan.deps)),
                 attaches=plan.attaches, deps=plan.deps)
         if self._deployed_actor.failure is not None:
+            from risingwave_tpu.stream.costs import purge_mv_series
+            purge_mv_series(stmt.name)
             raise self._deployed_actor.failure
         return "CREATE_SINK"
 
@@ -1171,8 +1196,11 @@ class Frontend:
             # drop the job from its alignment domain (an empty domain
             # retires — its frontier epoch stops blocking the fence)
             self._plane.remove_job(name)
-        from risingwave_tpu.stream.freshness import FRESHNESS
-        FRESHNESS.unregister_mv(name)
+        # central series-lifecycle purge: freshness, costs, hot-key
+        # and topology books (and their {mv=...} series) all die with
+        # the job — stream/costs.py owns the fan-out
+        from risingwave_tpu.stream.costs import purge_mv_series
+        purge_mv_series(name)
         return actor
 
     async def _drop_job(self, name: str, registry, if_exists: bool,

@@ -279,8 +279,11 @@ class Autoscaler:
         is what rw_bottlenecks / rw_actor_utilization serve on the
         distributed session too."""
         # one round-trip's latency for both sweeps: the verbs hit
-        # disjoint worker-side state, so they overlap safely
-        await asyncio.gather(self.cluster.drain_signals(),
+        # disjoint worker-side state, so they overlap safely. Light
+        # drain: the decision reads utilization/bottlenecks/costs —
+        # never the per-vnode topology, whose worker-side snapshot
+        # walks the whole per-key map
+        await asyncio.gather(self.cluster.drain_signals(light=True),
                              self.cluster.drain_freshness())
         from risingwave_tpu.stream.freshness import FRESHNESS
         for (mv, _dom, n, _e, _lag, wall_lag, _p50, _p99,

@@ -696,6 +696,14 @@ class BarrierLoop:
             # checkpoint (memory_manager.rs watermark-loop analog)
             from risingwave_tpu.utils.memory import GLOBAL as _MEM
             _MEM.tick()
+            # topology two-book recount (armed by the tier-1 gate
+            # fixture only — a no-op in production) and the per-MV
+            # state-bytes gauge refresh both ride the checkpoint:
+            # state only moves at checkpoints
+            from risingwave_tpu.state.topology import TOPOLOGY
+            from risingwave_tpu.stream.costs import COSTS
+            TOPOLOGY.checkpoint_verify()
+            COSTS.publish_state_bytes()
         self.stats.completed_epochs.append(epoch)
         return barrier
 

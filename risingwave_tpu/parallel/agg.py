@@ -48,8 +48,11 @@ AXIS = "d"
 # sessions, twin MVs and bench re-runs reuse traces instead of paying
 # warmup compiles on the p99 tail — the join's _STEP_CACHE scheme).
 # Keyed by (mesh device ids, program kind + statics, key_width,
-# specs); jit shape-keys per state capacity internally.
-_PROG_CACHE: Dict[tuple, object] = {}
+# specs); jit shape-keys per state capacity internally. A CompileCache
+# (stream/costs.py) so hits/misses bill the pulling MV.
+from risingwave_tpu.stream.costs import CompileCache as _CompileCache
+
+_PROG_CACHE: Dict[tuple, object] = _CompileCache("agg_prog")
 
 
 def _note_dispatch(rows: float) -> None:

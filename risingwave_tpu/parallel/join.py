@@ -49,8 +49,12 @@ AXIS = "d"
 # clearing): keyed by (mesh device ids, program kind, every static the
 # closure bakes in). Before this cache, each _JoinSide's kernel rebuilt
 # — and re-traced — its own steps on any shape churn, which the
-# RecompileGuard now polices on the sharded path too.
-_STEP_CACHE: Dict[tuple, object] = {}
+# RecompileGuard now polices on the sharded path too. A CompileCache
+# (stream/costs.py) so hits/misses bill the pulling MV: the first MV
+# to trace an entry pays the compile, later tenants record shared hits.
+from risingwave_tpu.stream.costs import CompileCache as _CompileCache
+
+_STEP_CACHE: Dict[tuple, object] = _CompileCache("join_step")
 
 
 def _step_key(mesh: Mesh, kind: str, *statics) -> tuple:

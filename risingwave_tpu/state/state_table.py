@@ -34,6 +34,7 @@ from risingwave_tpu.state.keycodec import (
 )
 from risingwave_tpu.state.mem_table import KeyOp, MemTable
 from risingwave_tpu.state.store import StateStore
+from risingwave_tpu.state import topology as _topology
 
 # barrier-domain mode (meta/domains.py flips this on when a
 # BarrierPlane exists in the process; workers flip it on the first
@@ -82,6 +83,10 @@ class StateTable:
         self.vnodes = (np.ones(VNODE_COUNT, dtype=bool)
                        if vnodes is None else np.asarray(vnodes, dtype=bool))
         self.epoch: Optional[EpochPair] = None
+        # schema-constant physical row size (None when host-typed
+        # fields size per value) — lets the topology books take their
+        # bulk-update fast path on the staged all-insert flush shape
+        self._fixed_row_nbytes = _topology.fixed_row_nbytes(schema)
 
     # -- epoch lifecycle ------------------------------------------------
     def init_epoch(self, epoch: EpochPair) -> None:
@@ -140,6 +145,11 @@ class StateTable:
                 (new_epoch, self.epoch)
         keys, vals, epoch = self.flush()
         n = self.store.ingest_keyed(self.table_id, keys, vals, epoch)
+        # per-(table, vnode) topology upkeep rides the SAME flush the
+        # store ingests — incremental at the write-through point, so
+        # reads (rw_state_topology, rescale costing) never scan state
+        _topology.TOPOLOGY.record(self.table_id, keys, vals,
+                                  self._fixed_row_nbytes)
         self.epoch = new_epoch
         return n
 

@@ -60,6 +60,10 @@ SUSTAINED_STREAK = 3
 # machine frozen (a drained domain keeps the verdict its last slow
 # barrier earned; the `epoch` column dates it).
 SLOW_INTERVAL_S = 0.5
+# a single key above this guaranteed input share earns the diagnosis a
+# skew:<key> clause (stream/hotkeys.py sketches; the share used is the
+# sketch's LOWER bound, so an overcounted cold key cannot fire it)
+SKEW_SHARE = 0.25
 
 
 class _DomainState:
@@ -294,6 +298,17 @@ class BottleneckAnalyzer:
         if st.streak >= SUSTAINED_STREAK:
             parts.append(f"sustained {st.streak} barriers — scale "
                          f"this operator first")
+        # skew verdict (ISSUE 16): a hot key holding ≥ SKEW_SHARE of
+        # the walked operator's input concentrates its work on ONE
+        # shard — name the key so the autoscaler can veto a futile
+        # parallelism scale-up instead of rescaling into the wall
+        from risingwave_tpu.stream.hotkeys import HOTKEYS
+        hot = HOTKEYS.hot_share(cand["wrapper"].labels["executor"],
+                                min_share=SKEW_SHARE)
+        if hot is not None:
+            key, share = hot
+            parts.append(f"skew:{key} ({share:.0%} of input keys — "
+                         f"parallelism won't help)")
         return "; ".join(parts)
 
     # -- cross-process merge -------------------------------------------

@@ -44,6 +44,7 @@ from risingwave_tpu.stream.executors.keys import (
 from risingwave_tpu.stream.message import (
     Barrier, Message, Watermark, is_barrier, is_chunk, is_watermark,
 )
+from risingwave_tpu.stream import hotkeys as _hotkeys
 from risingwave_tpu.utils.metrics import STREAMING as _METRICS
 
 _SUM_OUT = {
@@ -528,16 +529,24 @@ class HashAggExecutor(Executor):
             # fusion win the bench compares.
             from risingwave_tpu.ops.fused import encode_raw_chunk
             raw = encode_raw_chunk(chunk, self.fused_stages.ref_cols)
-            if getattr(self.kernel, "counts_own_dispatches", False):
-                # sharded fused kernel: when the group keys map to raw
-                # input columns, per-row owners compute host-side and
-                # feed the skew-exact routing bucket (a pre-filter
-                # superset — safe when the traced filter drops rows)
-                raw_keys = self._fused_raw_key_cols
-                owners = None
-                if raw_keys is not None:
-                    owners = self.kernel.owners_of(
-                        self.key_codec.build(chunk, raw_keys))
+            # when the group keys map to raw input columns, host-side
+            # lanes serve two consumers: the heavy-hitter sketch (a
+            # pre-filter superset of the grouped rows — safe when the
+            # traced filter drops rows) and, for sharded kernels, the
+            # skew-exact per-row owner routing bucket
+            sharded = getattr(self.kernel, "counts_own_dispatches",
+                              False)
+            raw_keys = self._fused_raw_key_cols
+            lanes = None
+            if raw_keys is not None and (sharded or _hotkeys.ENABLED):
+                lanes = self.key_codec.build(chunk, raw_keys)
+                if _hotkeys.ENABLED:
+                    _hotkeys.HOTKEYS.observe(
+                        self.identity, lanes,
+                        np.asarray(chunk.visibility), self.key_codec)
+            if sharded:
+                owners = None if lanes is None \
+                    else self.kernel.owners_of(lanes)
                 self.kernel.apply_raw(raw, chunk.cardinality(),
                                       owners=owners)
             else:
@@ -546,6 +555,12 @@ class HashAggExecutor(Executor):
         key_lanes = self.key_codec.build(chunk, self.group_indices)
         signs = np.asarray(chunk.signs())
         vis = np.asarray(chunk.visibility)
+        if _hotkeys.ENABLED:
+            # heavy-hitter sketch over the agg's group keys: the lanes
+            # are already built for the kernel — the sketch adds one
+            # hash+unique pass over the visible rows
+            _hotkeys.HOTKEYS.observe(self.identity, key_lanes, vis,
+                                     self.key_codec)
         if self._tier is not None:
             self._tier_touch(key_lanes, vis)
         # one kernel.apply below = one fused device dispatch (~2ms host

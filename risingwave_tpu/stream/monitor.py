@@ -29,6 +29,8 @@ from risingwave_tpu.stream.executor import (
 from risingwave_tpu.stream.message import (
     Barrier, Message, is_barrier, is_chunk,
 )
+from risingwave_tpu.stream import costs as _costs
+from risingwave_tpu.stream import hotkeys as _hotkeys
 from risingwave_tpu.utils import ledger as _ledger
 from risingwave_tpu.utils import spans as _spans
 from risingwave_tpu.utils.failpoint import fail_point
@@ -329,6 +331,15 @@ class MonitoredExecutor(Executor):
             # reassembly/state writes/dispatch (host_emit); the barrier
             # park is barrier_wait
             named = self._cell.named_total()
+            if _costs.enabled():
+                # per-MV split of the SAME cell the ledger is about to
+                # commit: the fragment label is the MV/job name, and
+                # cells nest exclusively, so summing fragments can
+                # never mint device time the domain didn't ledger
+                _costs.COSTS.observe_cell(
+                    self.labels["fragment"], epoch,
+                    self._cell.seconds.get("device_compute", 0.0),
+                    self._cell.h2d_bytes, self._cell.d2h_bytes)
             _ledger.LEDGER.commit_cell(epoch, self._cell)
             resid = excl - named
             if resid > 0:
@@ -392,6 +403,11 @@ class MonitoredExecutor(Executor):
                 # exclusive busy time nests
                 ctok = _ledger.LEDGER.push_cell(self._cell) \
                     if _ledger.enabled() else None
+                # compile-cache ownership: anything traced while this
+                # pull runs bills the pulling MV (first tracer pays,
+                # later MVs record shared hits — stream/costs.py)
+                mtok = _costs.push_mv(self.labels["fragment"]) \
+                    if _costs.enabled() else None
                 # park cell: exchange-credit parks fired while the
                 # inner executor works charge THIS node (a nested
                 # wrapped child swaps its own cell in for its pulls,
@@ -405,6 +421,8 @@ class MonitoredExecutor(Executor):
                 finally:
                     if ptok is not None:
                         _xchg.pop_park_cell(ptok)
+                    if mtok is not None:
+                        _costs.pop_mv(mtok)
                     if ctok is not None:
                         _ledger.LEDGER.pop_cell(ctok)
                     _AWAITS.exit(self._who)
@@ -459,6 +477,9 @@ def install_monitoring(root: Executor, fragment: str,
             else:
                 getattr(ex, attr)[idx] = w
             children.append(w)
+        # hot-key sketches key by executor identity; the fragment
+        # binding is what lets rw_hot_keys name the owning MV
+        _hotkeys.HOTKEYS.bind_fragment(ex.identity, fragment)
         return MonitoredExecutor(ex, fragment, actor_id, node,
                                  children)
 

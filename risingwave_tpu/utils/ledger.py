@@ -474,6 +474,12 @@ class PhaseLedger:
         # next to the phase shares it must explain
         from risingwave_tpu.stream.freshness import FRESHNESS
         extra.update(FRESHNESS.history_extra(rec.epoch, rec.domain))
+        # per-MV cost split of the same sealed epoch (ISSUE 16): the
+        # executor cells committed for this epoch roll up by owning MV
+        # here, so rw_metrics_history carries mv_device_s.<mv> columns
+        # next to the phase shares they partition
+        from risingwave_tpu.stream import costs as _costs
+        extra.update(_costs.COSTS.history_extra(rec))
         HISTORY.observe(rec.epoch, rec.interval_s, extra=extra,
                         domain=rec.domain)
         if not _spans.enabled():

@@ -451,6 +451,21 @@ class StreamingMetrics:
             "device_kernel_bytes_accessed",
             "XLA cost-analysis bytes-accessed of the last-compiled "
             "program per kernel label")
+        # -- per-MV cost attribution (stream/costs.py, ISSUE 16) ------
+        self.mv_device_seconds = r.counter(
+            "stream_mv_device_seconds_total",
+            "device_compute seconds attributed to the owning MV "
+            "(executor-cell split of the phase ledger's books — sums "
+            "to at most the ledgered device_compute per epoch)")
+        self.mv_state_bytes = r.gauge(
+            "stream_mv_state_bytes",
+            "accounted state bytes per MV (per-(table,vnode) topology "
+            "rollup, refreshed at each checkpoint)")
+        self.mv_transfer_bytes = r.counter(
+            "stream_mv_transfer_bytes_total",
+            "host<->device transfer payload bytes attributed to the "
+            "owning MV, by direction (dir splits like "
+            "stream_transfer_bytes_total)")
 
 
 class ClusterMetrics:

@@ -105,6 +105,47 @@ def _conservation_gate():
 
 
 @pytest.fixture(autouse=True)
+def _attribution_gate():
+    """Tier-1 strict mode for serving-cost attribution (ISSUE 16):
+    (a) the per-MV device-seconds split can redistribute the phase
+    ledger's books but never mint time — Σ per-MV ≤ the domain's
+    ledgered device_compute + ε for every sealed local epoch; (b) the
+    per-(table, vnode) topology's incremental totals must agree with a
+    full recount of the authoritative size map at every checkpoint
+    (armed here; a no-op in production). Same arming pattern as the
+    ledger conservation gate."""
+    from risingwave_tpu.state import topology as _topology
+    from risingwave_tpu.stream import costs as _costs
+    from risingwave_tpu.stream import hotkeys as _hotkeys
+    _costs.set_enabled(True)
+    _costs.COSTS.clear()
+    _topology.TOPOLOGY.clear()
+    _hotkeys.HOTKEYS.clear()
+    _topology.TOPOLOGY.arm_checkpoint_verify(True)
+    yield
+    split = _costs.COSTS.gate_violations()
+    _topology.TOPOLOGY.checkpoint_verify()
+    books = _topology.TOPOLOGY.gate_violations()
+    _costs.COSTS.clear()
+    _topology.TOPOLOGY.clear()
+    _hotkeys.HOTKEYS.clear()
+    _topology.TOPOLOGY.arm_checkpoint_verify(False)
+    _costs.set_enabled(True)
+    assert not split, (
+        "per-MV attribution gate (tier-1 strict mode): the MV split "
+        "claims more device time than the domain's phase ledger "
+        "recorded — the owner split minted time. (epoch, domain, "
+        "sum_mv_device_s, domain_device_s): "
+        f"{[(hex(e), d, round(s, 4), round(g, 4)) for e, d, s, g in split[:5]]}")
+    assert not books, (
+        "state-topology recount gate (tier-1 strict mode): the "
+        "incremental per-table totals disagree with a full recount of "
+        "the authoritative size map — delta arithmetic drifted. "
+        "(table_id, rows_inc, rows_true, bytes_inc, bytes_true): "
+        f"{books[:5]}")
+
+
+@pytest.fixture(autouse=True)
 def _tricolor_freshness_gate():
     """Tier-1 strict mode for the utilization tricolor and per-MV
     freshness (stream/monitor.py + stream/freshness.py): every

@@ -67,7 +67,7 @@ class FakeCluster:
     def domain_of_job(self, name):
         return "dom"
 
-    async def drain_signals(self):
+    async def drain_signals(self, light=False):
         return 0
 
     async def drain_freshness(self):
