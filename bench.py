@@ -953,6 +953,160 @@ def _bench_elastic_subprocess(autoscale: bool) -> dict:
         {"JAX_PLATFORMS": "cpu"}, timeout=1800)
 
 
+def bench_q7_compact(dedicated: bool = True,
+                     total_events: int = 48_000,
+                     obj_delay_s: float = 0.2) -> dict:
+    """Compaction-pressure lane (ISSUE 19): q7 through the SQL front
+    door over HummockLite with forced heavy state churn — small epochs
+    (min_chunks=4) land one L0 run per checkpoint, so the L0 trigger
+    fires repeatedly over the run — behind a latency-injecting object
+    store (every SST upload sleeps ``obj_delay_s``). The INLINE arm
+    runs ``compact()`` synchronously on the commit path: its merge
+    uploads stall the barrier loop and show up in serving p99 + the
+    barrier_wait share. The DEDICATED arm moves the same merges to the
+    off-path compactor (pinned inputs, version-delta commit), so its
+    p99 stays flat under identical churn. Recorded per arm: events/s,
+    serving p99, barrier_wait share, off-path tasks applied and the
+    per-arm compaction byte counters (the white-box evidence that
+    ZERO inline compactions ran on the dedicated arm)."""
+    import time as _time
+
+    from risingwave_tpu.frontend.session import Frontend
+    from risingwave_tpu.meta.compaction import compaction_rows
+    from risingwave_tpu.storage.hummock import HummockLite
+    from risingwave_tpu.storage.object_store import (
+        DelayedObjectStore, MemObjectStore,
+    )
+    from risingwave_tpu.utils.ledger import LEDGER
+    from risingwave_tpu.utils.metrics import STORAGE
+
+    arm = "dedicated" if dedicated else "inline"
+
+    def _bytes_by_arm() -> dict:
+        out = {"inline": 0, "dedicated": 0}
+        for labels, v in STORAGE.compaction_bytes_written.series():
+            a = labels.get("arm", "inline")
+            out[a] = out.get(a, 0) + int(v)
+        return out
+
+    # counters and the task log are process-global: baseline-diff so
+    # a same-process back-to-back arm (dev runs; the real bench
+    # isolates arms in subprocesses) reads only ITS run
+    base_bytes = _bytes_by_arm()
+    base_tasks = len(compaction_rows())
+
+    async def run():
+        store = HummockLite(DelayedObjectStore(
+            MemObjectStore(), delay_s=obj_delay_s))
+        fe = Frontend(store, rate_limit=8, min_chunks=4)
+        try:
+            await fe.execute(f"SET storage_compaction = '{arm}'")
+            await fe.execute(
+                f"CREATE SOURCE bid WITH (connector='nexmark', "
+                f"nexmark.table.type='bid', "
+                f"nexmark.event.num={total_events}, "
+                f"nexmark.max.chunk.size=512, "
+                f"nexmark.generate.strings='false')")
+            await fe.execute(
+                "CREATE MATERIALIZED VIEW q7c AS "
+                "SELECT window_start, MAX(price) AS max_price, "
+                "COUNT(*) AS cnt "
+                "FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND) "
+                "GROUP BY window_start")
+            expected = total_events * 46 // 50
+            # pipelined drive (same in-flight discipline as
+            # _drive_frontend) with the session's CompactionManager
+            # ticked per collected barrier: serial fe.step() would
+            # let the async uploader's commits — where the inline
+            # arm's compact() stalls the loop — land between barriers
+            # while the loop is idle, hiding exactly the stall the
+            # lane measures
+            await fe.step(1)                # warmup (traces compile)
+            warm_epochs = len(fe.loop.stats.latencies_s)
+            readers = [r for d in fe.readers.values()
+                       for r in d.values()]
+
+            def rows_seen() -> int:
+                return sum(r.rows_read if hasattr(r, "rows_read")
+                           else r.offset for r in readers)
+
+            if rows_seen() >= expected:
+                raise ValueError(
+                    "bench scale too small: warmup consumed all "
+                    f"{expected} rows — raise total_events")
+            loop = fe.loop
+            t0 = _time.perf_counter()
+            base = rows_seen()
+            injected = 0
+            while rows_seen() < expected:
+                if injected >= 500:
+                    raise RuntimeError(
+                        f"sources stalled at "
+                        f"{rows_seen()}/{expected}")
+                while loop.in_flight_count < IN_FLIGHT:
+                    await loop.inject()
+                    injected += 1
+                await loop.collect_next()
+                if fe._compaction_mgr is not None:
+                    await fe._compaction_mgr.tick()
+            while loop.in_flight_count:
+                await loop.collect_next()
+            elapsed = _time.perf_counter() - t0
+            rows = rows_seen() - base
+            loop.stats.latencies_s = \
+                loop.stats.latencies_s[warm_epochs:]
+            loop.profiler.drop_first(warm_epochs)
+            snap = store.level_snapshot()
+            return elapsed, rows, fe.loop, snap
+        finally:
+            await fe.close()
+
+    t0 = _time.perf_counter()
+    elapsed, rows, loop, snap = asyncio.run(run())
+    wall = _time.perf_counter() - t0
+    pb = LEDGER.phase_breakdown()
+    now_bytes = _bytes_by_arm()
+    by_arm = {a: now_bytes.get(a, 0) - base_bytes.get(a, 0)
+              for a in ("inline", "dedicated")}
+    led = compaction_rows()[base_tasks:]
+    import jax
+    return {
+        "metric": "nexmark_q7_compact_events_per_sec",
+        "arm": arm,
+        "value": round(rows / elapsed, 1) if elapsed else None,
+        "unit": "events/s",
+        "platform": jax.devices()[0].platform,
+        "events": rows,
+        "elapsed_s": round(elapsed, 2),
+        "wall_s": round(wall, 2),
+        "obj_delay_s": obj_delay_s,
+        "p99_barrier_latency_s": round(loop.stats.p99_latency_s(), 4),
+        "barrier_wait_share": pb.get("phases", {}).get(
+            "barrier_wait", {}).get("share"),
+        "phase_breakdown": pb,
+        # off-path ledger: tasks the dedicated manager applied (the
+        # inline arm must show zero — compact() never queues tasks)
+        "offpath_tasks_applied": len(
+            [r for r in led if r[3] == "applied"]),
+        "offpath_tasks_failed": len(
+            [r for r in led if r[3] in ("failed", "aborted")]),
+        # per-arm byte counters: on the dedicated arm
+        # inline_compaction_bytes MUST be 0 (zero compact() frames on
+        # the commit path — the acceptance's white-box form)
+        "inline_compaction_bytes": by_arm.get("inline", 0),
+        "dedicated_compaction_bytes": by_arm.get("dedicated", 0),
+        "l0_runs_final": len(snap["l0"]),
+        "l1_runs_final": len(snap["l1"]),
+        "space_amp": round(STORAGE.storage_space_amp.get(), 3),
+    }
+
+
+def _bench_q7_compact_subprocess(dedicated: bool) -> dict:
+    return _run_bench_subprocess(
+        ["--compact-sub", "dedicated" if dedicated else "inline"],
+        {"JAX_PLATFORMS": "cpu"}, timeout=1800)
+
+
 def bench_chaos(seed: int = 7, events: int = 6000) -> dict:
     """Deterministic chaos round (``bench.py --chaos``): replay the
     seeded fault schedule — worker SIGKILL mid-epoch, object-store
@@ -1367,6 +1521,18 @@ def _main_locked(argv):
         LEDGER.query = f"elastic_{arm}"
         print(json.dumps(bench_elastic(autoscale=(arm == "on"))))
         return
+    if "--compact-sub" in argv:
+        # child mode: compaction-pressure lane (ISSUE 19), CPU-pinned
+        # — the subject is the commit path, not the kernels
+        import jax as _jax
+        _jax.config.update("jax_platforms", "cpu")
+        enable_compilation_cache()
+        from risingwave_tpu.utils.ledger import LEDGER
+        arm = argv[argv.index("--compact-sub") + 1]
+        LEDGER.query = f"q7_compact_{arm}"
+        print(json.dumps(bench_q7_compact(
+            dedicated=(arm == "dedicated"))))
+        return
     if "--multimv-sub" in argv:
         # child mode: multi-MV barrier-domain lane, CPU-pinned
         import jax as _jax
@@ -1506,6 +1672,47 @@ def _main_locked(argv):
                     and el.get("neighbor_decisions", 0) == 0
                     and el["hot_domain_p99_s"]
                     < eo["hot_domain_p99_s"]),
+            }
+        # compaction-pressure lane (ISSUE 19): q7 under forced heavy
+        # state churn behind a latency-injecting object store; the
+        # dedicated arm must hold serving p99 flat while the inline
+        # arm pays its merges on the commit path
+        compact_keys = ("value", "arm", "events", "elapsed_s",
+                        "obj_delay_s", "p99_barrier_latency_s",
+                        "barrier_wait_share", "offpath_tasks_applied",
+                        "offpath_tasks_failed",
+                        "inline_compaction_bytes",
+                        "dedicated_compaction_bytes",
+                        "l0_runs_final", "l1_runs_final", "space_amp",
+                        "platform")
+        for lane, arm in (("q7_compact", True),
+                          ("q7_compact_inline", False)):
+            try:
+                r = _bench_q7_compact_subprocess(arm)
+                headline[lane] = {k: r[k] for k in compact_keys
+                                  if k in r}
+            except Exception as e:                   # noqa: BLE001
+                print(f"WARNING: {lane} failed: {e!r}",
+                      file=sys.stderr)
+                headline[lane] = {"error": repr(e)[:200]}
+        cd = headline.get("q7_compact")
+        ci = headline.get("q7_compact_inline")
+        if isinstance(cd, dict) and isinstance(ci, dict) \
+                and cd.get("p99_barrier_latency_s") \
+                and ci.get("p99_barrier_latency_s"):
+            cd["vs_inline"] = {
+                "p99_ratio": round(cd["p99_barrier_latency_s"]
+                                   / ci["p99_barrier_latency_s"], 4),
+                # the lane's acceptance: the dedicated arm did its
+                # merges OFF the commit path (≥1 applied task, zero
+                # inline bytes) and held p99 at-or-under the inline
+                # arm that paid the same merges on-path
+                "resolved": bool(
+                    cd.get("offpath_tasks_applied", 0) >= 1
+                    and cd.get("inline_compaction_bytes", 1) == 0
+                    and ci.get("inline_compaction_bytes", 0) > 0
+                    and cd["p99_barrier_latency_s"]
+                    <= ci["p99_barrier_latency_s"]),
             }
         # sharded mesh lane (ISSUE 10): q7 at parallelism 8 — the
         # epoch-batched SPMD kernels timed, not just dry-run-checked
@@ -1658,6 +1865,13 @@ BENCH_FNS.update({"q7": bench_q7, "q8": bench_q8, "q4": bench_q4,
                       bench_q7, costs=False),
                   # fragment fusion on (SET stream_fusion equivalent
                   # for the hand-built pipelines)
+                  # compaction-pressure arms (ISSUE 19): q7 under
+                  # forced churn behind a delayed object store —
+                  # merges off-path vs paid on the commit path
+                  "q7_compact": _functools.partial(
+                      bench_q7_compact, dedicated=True),
+                  "q7_compact_inline": _functools.partial(
+                      bench_q7_compact, dedicated=False),
                   "q7_fused": _functools.partial(bench_q7, fusion=True),
                   "q8_fused": _functools.partial(bench_q8, fusion=True),
                   "q3_fused": _functools.partial(bench_q3, fusion=True),

@@ -40,6 +40,13 @@ backup operations against a data directory:
                               # decision would read (live decisions
                               # ride the serving coordinator — SET
                               # stream_autoscale=on there)
+    python -m risingwave_tpu ctl --data-dir D compaction [--steps K] \
+        [--watch N]           # leveled-compaction view: per-level
+                              # topology (L0 run count, L1 runs,
+                              # tombstone density), space amp, and
+                              # the dedicated-arm task ledger
+                              # (rw_compaction) over a recovered
+                              # clone driven with the off-path arm
     python -m risingwave_tpu ctl --data-dir D backup create|list|
         delete <id> | restore <id> --target T
 """
@@ -172,6 +179,8 @@ def _ctl(args) -> int:
         return asyncio.run(_ctl_autoscale(obj, args))
     if verb == "cost":
         return asyncio.run(_ctl_cost(obj, args))
+    if verb == "compaction":
+        return asyncio.run(_ctl_compaction(obj, args))
     if verb == "backup":
         from risingwave_tpu.meta.backup import (
             create_backup, delete_backup, list_backups, restore_backup,
@@ -548,6 +557,63 @@ async def _ctl_cost(obj, args) -> int:
     return 0
 
 
+async def _ctl_compaction(obj, args) -> int:
+    """Recover into an in-memory clone (same snapshot discipline as
+    `table scan`), flip the DEDICATED arm on, drive a few checkpoints
+    per refresh, and print the compaction view: per-level topology
+    (L0 run count, L1 runs with tombstone density), the space-amp
+    gauge, and the task ledger (rw_compaction) the clone's manager
+    produced. ``--watch N`` repeats the drive+print cycle N times. On
+    a serving cluster, ``SET storage_compaction='dedicated'`` there
+    and ``SELECT * FROM rw_compaction`` over pgwire see the live
+    ledger."""
+    from risingwave_tpu.frontend import Frontend
+    from risingwave_tpu.meta.compaction import compaction_rows
+    from risingwave_tpu.storage.hummock import HummockLite
+    from risingwave_tpu.utils.metrics import STORAGE
+
+    store = HummockLite(_snapshot_clone(obj))
+    fe = Frontend(store)
+    await fe.recover()
+    try:
+        await fe.execute("SET storage_compaction = 'dedicated'")
+        for cycle in range(max(1, args.watch)):
+            await fe.step(args.steps)
+            if cycle:
+                print()
+            snap = store.level_snapshot()
+            l0, l1 = snap["l0"], snap["l1"]
+            print(f"== refresh {cycle + 1} — level topology "
+                  f"(version {snap['version_id']}) ==")
+            print(f"L0: {len(l0)} runs, "
+                  f"{sum(i.get('size', 0) for i in l0)}B")
+            for i in l1:
+                n = i.get("count", 0) or 1
+                print(f"L1 sst {i['id']}: {i.get('size', 0)}B "
+                      f"{i.get('count', 0)} keys, tombstones "
+                      f"{i.get('tombstones', 0) / n:.0%}")
+            if snap.get("reserved"):
+                print(f"reserved under in-flight tasks: "
+                      f"{snap['reserved']}")
+            print(f"space_amp {STORAGE.storage_space_amp.get():.3f}  "
+                  f"pending "
+                  f"{STORAGE.compaction_pending_tasks.get():.0f}")
+            rows = compaction_rows()
+            print("== compaction task ledger ==")
+            if not rows:
+                print("(no tasks — levels below every picker's "
+                      "trigger)")
+            for (tid, ns, picker, state, ins, outs, br, bw, att,
+                 dur, detail) in rows:
+                print(f"#{tid} [{ns}] {picker} {state} in=[{ins}] "
+                      f"out=[{outs}] read {br}B wrote {bw}B "
+                      f"attempts {att} {dur:.2f}s"
+                      + (f"  ({detail})" if detail else ""))
+    finally:
+        await fe.close()
+    return 0
+
+
 def main(argv=None) -> None:
     # the axon sitecustomize rewrites jax_platforms at interpreter
     # start, overriding JAX_PLATFORMS=cpu — honor the env var so ctl /
@@ -635,6 +701,15 @@ def main(argv=None) -> None:
     co.add_argument("--steps", type=int, default=4,
                     help="checkpoint barriers to drive per refresh")
     co.add_argument("--watch", type=int, default=1,
+                    help="refresh cycles to print (drive+print each)")
+    cp = csub.add_parser(
+        "compaction",
+        help="recover + print the leveled-compaction view: per-level "
+             "topology, tombstone density, space amp, and the "
+             "dedicated-arm task ledger (rw_compaction)")
+    cp.add_argument("--steps", type=int, default=4,
+                    help="checkpoint barriers to drive per refresh")
+    cp.add_argument("--watch", type=int, default=1,
                     help="refresh cycles to print (drive+print each)")
     bk = csub.add_parser("backup")
     bk.add_argument("what",

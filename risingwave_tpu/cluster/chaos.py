@@ -46,6 +46,22 @@ same protocol the autoscaler drives):
   any change (``phase="stop"``), the domain's health is unknown, and
   the supervisor answers ``wedged_barrier``/full.
 
+Compactor-domain faults (ISSUE 19 — the dedicated compaction subsystem
+rides the same ladder; both kinds require ``storage_compaction =
+'dedicated'`` on the session under test):
+
+- ``kill_compactor_mid_task`` — SIGKILL the compactor subprocess while
+  a leased task may be in flight: the next ``compaction_tick``
+  respawns the role, the orphaned lease expires and the task REQUEUES
+  against the current version. Classified ``compactor_dead``/requeue
+  in ``rw_recovery`` — a COMPACTOR-domain entry, never a serving
+  recovery (the storm gate doesn't budget it, serving never stalls).
+- ``storage_fault_during_vacuum`` — a worker's ``hummock.vacuum``
+  failpoint raises during retired-SST deletion: pin-exact GC is
+  delay-only (each entry deletes under its own try), so garbage
+  lingers until a later vacuum pass and NO recovery of any kind is
+  recorded.
+
 Faults inject into LIVE worker processes over the control channel's
 ``arm_failpoints`` verb (exception specs are JSON — the failpoint
 env/wire restriction), so a respawned worker always comes back clean.
@@ -120,6 +136,11 @@ def generate_schedule(seed: int, n_workers: int = 2,
 RESCALE_KINDS = frozenset({"kill_mid_rescale", "fault_mid_handoff",
                            "straggler_mid_rescale"})
 
+# compactor-domain fault kinds (ISSUE 19): only meaningful when the
+# session under test runs storage_compaction='dedicated'
+COMPACTOR_KINDS = frozenset({"kill_compactor_mid_task",
+                             "storage_fault_during_vacuum"})
+
 
 @dataclass
 class ChaosReport:
@@ -172,6 +193,10 @@ class ChaosRunner:
         if any(e.kind in RESCALE_KINDS for e in self.schedule):
             assert rescale_mv is not None, (
                 "a mid-rescale fault schedule needs rescale_mv")
+        if any(e.kind in COMPACTOR_KINDS for e in self.schedule):
+            assert fe.cluster._compaction_mode == "dedicated", (
+                "a compactor fault schedule needs the session under "
+                "test to SET storage_compaction = 'dedicated' first")
         if any(e.kind in ("straggler", "straggler_mid_rescale")
                for e in self.schedule):
             assert fe.cluster.barrier_timeout_s is not None, (
@@ -247,6 +272,15 @@ class ChaosRunner:
                 "raise": "OSError", "msg": "chaos handoff fault",
                 "times": 1}})
             await self._alter_supervised(report)
+        elif ev.kind == "kill_compactor_mid_task":
+            # the slot is irrelevant — there is ONE compactor role; a
+            # kill between tasks (nothing leased) must also converge,
+            # so the event never waits for a task to be in flight
+            self.fe.cluster.kill_compactor()
+        elif ev.kind == "storage_fault_during_vacuum":
+            await self._arm(ev.slot, {"hummock.vacuum": {
+                "raise": "OSError", "msg": "chaos vacuum fault",
+                "times": 4}})
         elif ev.kind == "straggler_mid_rescale":
             timeout = self.fe.cluster.barrier_timeout_s
             await self._arm(ev.slot, {"trace.slow.HashAggExecutor": {

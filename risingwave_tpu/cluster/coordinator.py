@@ -50,6 +50,12 @@ _IDEMPOTENT_VERBS = frozenset({
     # absolute-state write: sealing/syncing to an epoch twice equals
     # once (the aligned-checkpoint floor push, ISSUE 13)
     "seal_sync",
+    # compaction plane: mode toggle is absolute state, the level
+    # snapshot is a pure read, and aborting a task twice equals once
+    # (reservation release + delete-if-present). compact_reserve /
+    # compact_apply / compact_task are NOT here — replaying them
+    # allocates ids or commits versions.
+    "set_compaction", "level_snapshot", "compact_abort",
 })
 
 
@@ -382,11 +388,15 @@ class WorkerBarrierSender:
 
 
 class WorkerHandle:
-    """Spawn + own a worker subprocess (GlobalStreamManager's node)."""
+    """Spawn + own a worker subprocess (GlobalStreamManager's node).
+    ``role="compactor"`` spawns the dedicated merge executor instead —
+    same boot/heartbeat/kill lifecycle, no exchange plane."""
 
-    def __init__(self, store_dir: str, platform: str = "cpu"):
+    def __init__(self, store_dir: str, platform: str = "cpu",
+                 role: str = "worker"):
         self.store_dir = store_dir
         self.platform = platform
+        self.role = role
         self.proc: Optional[subprocess.Popen] = None
         self.client: Optional[WorkerClient] = None
 
@@ -399,10 +409,12 @@ class WorkerHandle:
         # that tunnel is down. Callers opt INTO an accelerator via
         # platform=; the default worker is a CPU host process.
         env["JAX_PLATFORMS"] = self.platform
+        argv = [sys.executable, "-m", "risingwave_tpu.cluster.worker",
+                "--store", self.store_dir]
+        if self.role != "worker":
+            argv += ["--role", self.role]
         self.proc = subprocess.Popen(
-            [sys.executable, "-m", "risingwave_tpu.cluster.worker",
-             "--store", self.store_dir],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            argv, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
             env=env, cwd=None, text=True)
         loop = asyncio.get_event_loop()
         try:

@@ -29,9 +29,9 @@ from risingwave_tpu.cluster.coordinator import (
 from risingwave_tpu.frontend.fragmenter import Fragment, FragmentGraph
 from risingwave_tpu.meta.barrier import BarrierLoop
 from risingwave_tpu.meta.supervisor import (
-    ACTION_RESPAWN, ACTION_ROLLBACK, CAUSE_RESCALE_FAILED,
-    RecoveryEvent, RecoverySupervisor, trace_recovery_phase,
-    trace_recovery_root,
+    ACTION_REQUEUE, ACTION_RESPAWN, ACTION_ROLLBACK,
+    CAUSE_COMPACTOR_DEAD, CAUSE_RESCALE_FAILED, RecoveryEvent,
+    RecoverySupervisor, trace_recovery_phase, trace_recovery_root,
 )
 from risingwave_tpu.stream.actor import LocalBarrierManager
 from risingwave_tpu.stream.message import StopMutation
@@ -154,6 +154,15 @@ class Cluster:
         # chaos seam: one-shot (phase, fn) fired at that rescale phase
         # — how the harness kills a worker mid-redeploy deterministically
         self.rescale_fault_hook: Optional[tuple] = None
+        # dedicated compaction (ISSUE 19): one compactor-role
+        # subprocess + a CompactionManager with one namespace per
+        # worker slot; 'inline' = workers compact on their own commit
+        # path (the oracle arm)
+        self._compaction_mode = "inline"
+        self._compaction_mgr = None
+        self._compactor_handle: Optional[WorkerHandle] = None
+        self._compactor_client: Optional[WorkerClient] = None
+        self.compactor_respawns = 0
 
     # -- lifecycle --------------------------------------------------------
     async def start(self) -> None:
@@ -313,6 +322,9 @@ class Cluster:
         return frozenset(ids | self._all_pseudo())
 
     async def stop(self) -> None:
+        if self._compaction_mgr is not None:
+            mgr, self._compaction_mgr = self._compaction_mgr, None
+            await mgr.drain()
         if self.loop is not None:
             await self.loop.inject_and_collect(
                 force_checkpoint=True,
@@ -321,6 +333,7 @@ class Cluster:
         for h in self.handles:
             if h is not None:
                 await h.stop()
+        await self._stop_compactor()
 
     def kill_slot(self, k: int) -> None:
         """SIGKILL one worker (chaos path: no goodbye, no flush).
@@ -554,6 +567,139 @@ class Cluster:
             c.call({"cmd": "set_costs", "on": bool(on)})
             for c in self.clients if c is not None))
 
+    # -- dedicated compaction (ISSUE 19) ----------------------------------
+    async def set_compaction(self, mode: str) -> None:
+        """Fan the compaction arm to every worker namespace and
+        (de)provision the compactor role. 'dedicated' spawns ONE
+        compactor subprocess plus a CompactionManager with one
+        namespace per worker slot; 'inline' drains in-flight tasks,
+        reverts workers to commit-path compaction and stops the
+        compactor. Remembered across respawns/recoveries like
+        set_trace."""
+        from risingwave_tpu.meta.compaction import parse_compaction
+        mode = parse_compaction(mode)
+        self._compaction_mode = mode
+        await asyncio.gather(*(
+            c.call_idempotent({"cmd": "set_compaction", "mode": mode},
+                              io_timeout=20.0)
+            for c in self.clients if c is not None))
+        if mode == "dedicated":
+            if self._compactor_handle is None:
+                await self._start_compactor()
+            if self._compaction_mgr is None:
+                from risingwave_tpu.meta.compaction import (
+                    CompactionManager,
+                )
+                self._compaction_mgr = CompactionManager(
+                    on_fault=self._on_compactor_fault)
+                for k in range(self.n):
+                    self._compaction_mgr.add_namespace(
+                        f"w{k}", self._compaction_hooks(k))
+        else:
+            mgr, self._compaction_mgr = self._compaction_mgr, None
+            if mgr is not None:
+                await mgr.drain()
+            await self._stop_compactor()
+
+    async def _start_compactor(self) -> None:
+        h = WorkerHandle(os.path.join(self.root, "compactor"),
+                         platform=self.platform, role="compactor")
+        self._compactor_client = await h.start()
+        self._compactor_handle = h
+
+    async def _stop_compactor(self) -> None:
+        h, self._compactor_handle = self._compactor_handle, None
+        self._compactor_client = None
+        if h is None:
+            return
+        try:
+            await h.stop()
+        except BaseException:  # noqa: BLE001 — a chaos-killed corpse
+            h.kill()           # cannot answer the stop verb; reap it
+
+    def kill_compactor(self) -> None:
+        """SIGKILL the compactor role (chaos path). Serving is
+        untouched by design: the in-flight task's lease expires, the
+        manager aborts + requeues, compaction_tick respawns the
+        process."""
+        h = self._compactor_handle
+        if h is not None and h.proc is not None:
+            h.proc.kill()
+
+    def _compaction_hooks(self, k: int):
+        """Hooks for slot k's namespace. snapshot/reserve/apply/abort
+        run on the OWNING worker over its control channel — resolved
+        at call time, because recoveries swap ``clients[k]``; execute
+        dispatches the merge to the compactor role pointed at the
+        worker's namespace directory."""
+        from risingwave_tpu.meta.compaction import CompactorHooks
+
+        def client() -> WorkerClient:
+            c = self.clients[k]
+            if c is None:
+                raise ConnectionError(f"worker slot {k} down")
+            return c
+
+        async def snapshot():
+            r = await client().call_idempotent(
+                {"cmd": "level_snapshot"}, io_timeout=20.0)
+            return r["snapshot"]
+
+        async def reserve(input_ids, id_block):
+            return await client().call(
+                {"cmd": "compact_reserve", "inputs": input_ids,
+                 "id_block": id_block}, io_timeout=20.0)
+
+        async def apply(input_ids, outputs):
+            return await client().call(
+                {"cmd": "compact_apply", "inputs": input_ids,
+                 "outputs": outputs}, io_timeout=20.0)
+
+        async def abort(input_ids, output_ids):
+            return await client().call_idempotent(
+                {"cmd": "compact_abort", "inputs": input_ids,
+                 "outputs": output_ids}, io_timeout=20.0)
+
+        async def execute(task):
+            c = self._compactor_client
+            if c is None:
+                raise ConnectionError("compactor down")
+            return await c.call(
+                {"cmd": "compact_task",
+                 "store": os.path.join(self.root, f"w{k}"),
+                 "task": task}, io_timeout=60.0)
+
+        return CompactorHooks(snapshot=snapshot, reserve=reserve,
+                              apply=apply, abort=abort,
+                              execute=execute)
+
+    def _on_compactor_fault(self, ns: str, kind: str, exc) -> None:
+        """A compactor fault costs a TASK, never a serving domain:
+        record the requeue in rw_recovery directly — NEVER through
+        supervisor.admit(), whose storm budget belongs to serving
+        recoveries."""
+        detail = f"{ns}: {kind}"
+        if exc is not None:
+            detail = f"{detail}: {exc!r}"
+        self.supervisor.record(
+            CAUSE_COMPACTOR_DEAD, ACTION_REQUEUE, (),
+            self.store.committed_epoch(), 0.0, True, 1,
+            detail=detail[:200])
+
+    async def compaction_tick(self) -> Optional[dict]:
+        """One manager round (the distributed session calls this after
+        each barrier). Heals a dead compactor process FIRST: task
+        recovery must not wait on a corpse that can never finish."""
+        mgr = self._compaction_mgr
+        if mgr is None:
+            return None
+        h = self._compactor_handle
+        if h is not None and not h.alive():
+            h.kill()                     # reap (idempotent)
+            await self._start_compactor()
+            self.compactor_respawns += 1
+        return await mgr.tick()
+
     async def drain_trace(self) -> int:
         """Pull every worker's recorded spans into the coordinator's
         flight recorder, tagged by worker slot — a drained span leaves
@@ -695,6 +841,12 @@ class Cluster:
             self.clients[k].call({"cmd": "recover_store",
                                   "epoch": floor})
             for k in range(self.n)))
+        if self._compaction_mode != "inline":
+            await asyncio.gather(*(
+                self.clients[k].call_idempotent(
+                    {"cmd": "set_compaction",
+                     "mode": self._compaction_mode}, io_timeout=20.0)
+                for k in range(self.n)))
         await self._fresh_barrier_plane()
         await self._run_pending_repairs()
         for job in self.jobs.values():
@@ -719,6 +871,13 @@ class Cluster:
             if on is not None:
                 await self.clients[k].call_idempotent(
                     {"cmd": verb, "on": on}, io_timeout=20.0)
+        if self._compaction_mode != "inline":
+            # a fresh process boots inline — without this re-apply the
+            # respawned worker would compact on its own commit path,
+            # racing (and conflicting with) the manager's reservations
+            await self.clients[k].call_idempotent(
+                {"cmd": "set_compaction",
+                 "mode": self._compaction_mode}, io_timeout=20.0)
 
     async def _reset_slot(self, k: int) -> None:
         """Rejoin one LIVE slot in place: fresh control connection

@@ -105,6 +105,9 @@ class DistFrontend:
         from risingwave_tpu.frontend.opt import parse_fusion, parse_rules
         from risingwave_tpu.frontend.session_vars import SessionVars
         from risingwave_tpu.meta.autoscaler import parse_autoscale
+        from risingwave_tpu.meta.compaction import (
+            parse_compaction as _parse_compaction,
+        )
         from risingwave_tpu.stream.costs import (
             parse_costs as _parse_costs,
         )
@@ -143,12 +146,17 @@ class DistFrontend:
              # cost & skew attribution (ISSUE 16): per-MV cost books,
              # topology upkeep and hot-key sketches; fans out like
              # stream_ledger
-             "stream_costs": "on"},
+             "stream_costs": "on",
+             # compaction arm (ISSUE 19): 'dedicated' provisions the
+             # compactor role + CompactionManager (one namespace per
+             # worker slot) and moves every merge off the serving path
+             "storage_compaction": "inline"},
             validators={"stream_rewrite_rules": parse_rules,
                         "stream_fusion": parse_fusion,
                         "stream_trace": parse_trace,
                         "stream_ledger": parse_ledger,
                         "stream_costs": _parse_costs,
+                        "storage_compaction": _parse_compaction,
                         "stream_autoscale": parse_autoscale})
         # the elastic control loop (created lazily on SET
         # stream_autoscale=on; ticked by run_heartbeat while on)
@@ -185,6 +193,10 @@ class DistFrontend:
     async def step(self, n: int = 1) -> None:
         async with self._barrier_lock:
             await self.cluster.step(n)
+            # dedicated compaction: settle/dispatch under the same
+            # lock a rescale or recovery would hold — an apply never
+            # interleaves a topology change
+            await self.cluster.compaction_tick()
 
     async def recover(self) -> None:
         async with self._barrier_lock:
@@ -235,6 +247,7 @@ class DistFrontend:
                             # barrier lock so a concurrent ALTER queues
                             # behind the action instead of interleaving
                             await self.autoscaler.tick()
+                        await self.cluster.compaction_tick()
                     except asyncio.CancelledError:
                         raise
                     except Exception as e:  # noqa: BLE001 — classified
@@ -300,6 +313,13 @@ class DistFrontend:
                     self.session_vars.get("stream_costs"))
                 _mvcosts.set_enabled(on)
                 await self.cluster.set_costs(on)
+            if stmt.name == "storage_compaction":
+                # fans to every worker + (de)provisions the compactor
+                # role; serialized with barrier rounds so the flip
+                # cannot interleave a commit with a manager drain
+                async with self._barrier_lock:
+                    await self.cluster.set_compaction(
+                        self.session_vars.get("storage_compaction"))
             if stmt.name == "stream_autoscale":
                 from risingwave_tpu.meta.autoscaler import (
                     Autoscaler, parse_autoscale,

@@ -223,6 +223,37 @@ class WorkerServer:
                             local._collected.get(e, ()))
                         for e in getattr(local, "_complete", {})
                         if not local._complete[e].is_set()}}
+        if verb == "set_compaction":
+            # absolute-state toggle: inline (commits compact in place)
+            # vs dedicated (commits never compact; version deltas land
+            # via compact_apply below)
+            mode = str(cmd.get("mode", "inline"))
+            self.store.compaction_mode = mode
+            return {"ok": True, "mode": mode}
+        if verb == "level_snapshot":
+            # pure read: per-level topology for the CompactionManager's
+            # pickers (L0 run count, sizes, tombstone density) + the
+            # ids frozen under in-flight tasks
+            return {"ok": True, "snapshot": self.store.level_snapshot()}
+        if verb == "compact_reserve":
+            # freeze a task's inputs + burn it a durable output-id
+            # block; a ValueError (inputs gone / already reserved) is
+            # an expected conflict the manager skips, not a fault
+            grant = self.store.reserve_task(
+                [int(i) for i in cmd["inputs"]],
+                int(cmd.get("id_block", 16)))
+            return {"ok": True, "grant": grant}
+        if verb == "compact_apply":
+            # compare-and-commit version delta: swap exactly the
+            # reserved inputs for the compactor's outputs
+            r = self.store.apply_version_delta(
+                [int(i) for i in cmd["inputs"]], cmd["outputs"])
+            return {"ok": True, **r}
+        if verb == "compact_abort":
+            self.store.abort_task(
+                [int(i) for i in cmd["inputs"]],
+                [int(i) for i in cmd.get("outputs") or []])
+            return {"ok": True}
         if verb == "ping":
             # heartbeat probe (cluster.rs heartbeat RPC): liveness +
             # a cheap resource summary for the membership table (actor
@@ -576,6 +607,96 @@ class WorkerServer:
             await self._control.wait_closed()
 
 
+class CompactorServer:
+    """Dedicated compactor role (``--role compactor``): a heartbeat-
+    leased subprocess that executes compaction merges against worker
+    object-store namespaces, OFF every serving path. It hosts no
+    actors and owns no store of its own — each ``compact_task`` names
+    the namespace directory and the frozen task; the merge runs on a
+    thread so the control loop keeps answering pings mid-task.
+    Compactor death mid-task surfaces as a torn control channel (or a
+    lease expiry) and the manager requeues the task — the merge wrote
+    only into its reserved id block, so a half-finished task leaves
+    nothing a vacuum pass cannot reclaim."""
+
+    def __init__(self) -> None:
+        self._control: Optional[asyncio.AbstractServer] = None
+        self._stopping = asyncio.Event()
+        self._running = 0            # tasks in flight (ping visibility)
+        self._done = 0
+
+    async def serve(self, host: str = "127.0.0.1") -> dict:
+        self._control = await asyncio.start_server(
+            self._handle_control, host, 0, limit=CONTROL_LINE_LIMIT)
+        return {"control_port":
+                self._control.sockets[0].getsockname()[1],
+                "exchange_port": 0}
+
+    async def _handle_control(self, reader: asyncio.StreamReader,
+                              writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                cmd = json.loads(line)
+                try:
+                    reply = await self._dispatch(cmd)
+                except BaseException as e:  # noqa: BLE001 — report
+                    reply = {"ok": False, "error": repr(e)}
+                writer.write((json.dumps(reply) + "\n").encode())
+                await writer.drain()
+                if cmd.get("cmd") == "stop":
+                    self._stopping.set()
+                    return
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+    async def _dispatch(self, cmd: dict) -> dict:
+        verb = cmd.get("cmd")
+        from risingwave_tpu.utils.failpoint import fail_point
+        fail_point(f"compactor.rpc.{verb}")
+        if verb == "ping":
+            return {"ok": True, "info": {"role": "compactor",
+                                         "running": self._running,
+                                         "done": self._done}}
+        if verb == "compact_task":
+            return await self._compact_task(cmd)
+        if verb == "arm_failpoints":
+            from risingwave_tpu.utils.failpoint import arm_specs
+            return {"ok": True,
+                    "armed": arm_specs(cmd.get("points") or {})}
+        if verb == "metrics":
+            from risingwave_tpu.utils.metrics import GLOBAL
+            return {"ok": True, "text": GLOBAL.render()}
+        if verb == "stop":
+            return {"ok": True}
+        return {"ok": False, "error": f"unknown cmd {verb!r}"}
+
+    async def _compact_task(self, cmd: dict) -> dict:
+        from risingwave_tpu.storage.compactor import execute_task
+        from risingwave_tpu.storage.object_store import (
+            LocalFsObjectStore, RetryingObjectStore,
+        )
+        store = RetryingObjectStore(LocalFsObjectStore(cmd["store"]))
+        self._running += 1
+        try:
+            result = await asyncio.to_thread(
+                execute_task, store, cmd["task"])
+        finally:
+            self._running -= 1
+        self._done += 1
+        return {"ok": True, **result}
+
+    async def run_until_stopped(self) -> None:
+        await self._stopping.wait()
+        if self._control is not None:
+            self._control.close()
+            await self._control.wait_closed()
+
+
 def main(argv=None) -> None:
     import argparse
     import os
@@ -595,7 +716,12 @@ def main(argv=None) -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--store", required=True,
                     help="object-store directory for this worker's "
-                         "hummock namespace")
+                         "hummock namespace (compactor role: unused "
+                         "default root — tasks name their namespace)")
+    ap.add_argument("--role", default="worker",
+                    choices=["worker", "compactor"],
+                    help="worker: actors + local store; compactor: "
+                         "dedicated off-path merge executor")
     args = ap.parse_args(argv)
 
     from risingwave_tpu.storage.hummock import HummockLite
@@ -604,6 +730,12 @@ def main(argv=None) -> None:
     )
 
     async def amain():
+        if args.role == "compactor":
+            c = CompactorServer()
+            ports = await c.serve()
+            print(json.dumps(ports), flush=True)
+            await c.run_until_stopped()
+            return
         # transient-fault absorption at the bottom rung: a flaky
         # PUT/GET retries with jittered backoff inside the worker
         # before any error can fail a barrier round

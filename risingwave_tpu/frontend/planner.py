@@ -1418,6 +1418,24 @@ def _system_catalog_rows(name: str, catalog: Catalog, profiler=None):
                       Field("attempt", DataType.INT64),
                       Field("detail", DataType.VARCHAR)])
         return sch, recovery_rows()
+    if n == "rw_compaction":
+        # dedicated-compaction task log (meta/compaction.py): one row
+        # per task with its picker, lifecycle state (pending/running/
+        # applied/aborted/requeued/failed), frozen inputs, landed
+        # outputs and merge I/O — `ctl compaction` reads this
+        from risingwave_tpu.meta.compaction import compaction_rows
+        sch = Schema([Field("task_id", DataType.INT64),
+                      Field("namespace", DataType.VARCHAR),
+                      Field("picker", DataType.VARCHAR),
+                      Field("state", DataType.VARCHAR),
+                      Field("inputs", DataType.VARCHAR),
+                      Field("outputs", DataType.VARCHAR),
+                      Field("bytes_read", DataType.INT64),
+                      Field("bytes_written", DataType.INT64),
+                      Field("attempts", DataType.INT64),
+                      Field("duration_s", DataType.FLOAT64),
+                      Field("detail", DataType.VARCHAR)])
+        return sch, compaction_rows()
     if n == "rw_autoscaler":
         # elastic-control-loop decision ledger (meta/autoscaler.py):
         # one row per completed scaling decision — direction, the

@@ -145,13 +145,20 @@ class Frontend:
              # ledger, state topology upkeep and hot-key sketches;
              # 'off' reduces every hook to a predicate check (the
              # q7_costs_off bench arm)
-             "stream_costs": "on"},
+             "stream_costs": "on",
+             # compaction arm (ISSUE 19): 'inline' compacts on the
+             # commit path (oracle arm); 'dedicated' moves every merge
+             # off-path through the CompactionManager + a background
+             # compactor — zero compact() frames on the barrier path
+             "storage_compaction": "inline"},
             validators={"stream_rewrite_rules": parse_rules,
                         "stream_fusion": parse_fusion,
                         "stream_trace": parse_trace,
                         "stream_ledger": parse_ledger,
                         "stream_tricolor": _parse_tricolor,
                         "stream_costs": _parse_costs,
+                        "storage_compaction":
+                            self._validate_compaction,
                         "stream_epoch_pipeline":
                             self._validate_epoch_pipeline})
         # rules spec each MV was created under: reschedule replans +
@@ -179,6 +186,11 @@ class Frontend:
         # serializes barrier rounds between DDL handlers, step() and the
         # background heartbeat (inject_and_collect is not reentrant)
         self._barrier_lock = asyncio.Lock()
+        # dedicated-compaction arm (SET storage_compaction): the
+        # manager ticks after each barrier round; merges run on the
+        # InProcessCompactor's background thread
+        self._compaction_mgr = None
+        self._compactor = None
 
     # -- barrier engine (ISSUE 13) ---------------------------------------
     def _rebuild_barrier_engine(self) -> None:
@@ -227,6 +239,50 @@ class Frontend:
                 "stream_epoch_pipeline cannot change with live jobs — "
                 "drop them first")
         return want
+
+    # -- dedicated compaction (ISSUE 19) ---------------------------------
+    def _validate_compaction(self, spec: str) -> str:
+        from risingwave_tpu.meta.compaction import parse_compaction
+        mode = parse_compaction(spec)
+        if mode == "dedicated" and not hasattr(self.store,
+                                               "level_snapshot"):
+            raise PlanError(
+                "storage_compaction='dedicated' requires an object-"
+                "store-backed state store (HummockLite)")
+        return mode
+
+    async def _set_compaction_mode(self, mode: str) -> None:
+        """Flip the arm at runtime. Dedicated wires the store into a
+        CompactionManager over an InProcessCompactor (ONE background
+        merge thread); inline tears both down — the L0 backlog then
+        drains at the next commit trigger."""
+        if not hasattr(self.store, "compaction_mode"):
+            return                       # memory store: inline only
+        if mode == self.store.compaction_mode:
+            return
+        self.store.compaction_mode = mode
+        if mode == "dedicated":
+            from risingwave_tpu.meta.compaction import (
+                CompactionManager, CompactorHooks,
+            )
+            from risingwave_tpu.storage.compactor import (
+                InProcessCompactor,
+            )
+            self._compactor = InProcessCompactor(self.store.obj)
+            self._compaction_mgr = CompactionManager()
+            self._compaction_mgr.add_namespace("local", CompactorHooks(
+                snapshot=self.store.level_snapshot,
+                reserve=self.store.reserve_task,
+                apply=self.store.apply_version_delta,
+                abort=self.store.abort_task,
+                execute=self._compactor.submit))
+        else:
+            mgr, self._compaction_mgr = self._compaction_mgr, None
+            comp, self._compactor = self._compactor, None
+            if mgr is not None:
+                await mgr.drain()    # land a finished merge, don't leak it
+            if comp is not None:
+                comp.close()
 
     # -- state-tier pressure knob (SET state_tier_soft_limit_mb) ---------
     @property
@@ -335,7 +391,13 @@ class Frontend:
         may call inject_and_collect (the lock also guards actor-topology
         mutations; see _create_mv/_drop_mv)."""
         async with self._barrier_lock:
-            return await self.loop.inject_and_collect(**kw)
+            r = await self.loop.inject_and_collect(**kw)
+        if self._compaction_mgr is not None:
+            # dedicated arm: the manager settles finished merges
+            # (cheap manifest swaps) and dispatches new ones to the
+            # background thread — no compact() frame ever runs here
+            await self._compaction_mgr.tick()
+        return r
 
     async def step(self, n: int = 1) -> None:
         """Drive n checkpoint barriers (deterministic test/bench mode)."""
@@ -368,6 +430,12 @@ class Frontend:
             raise
 
     async def close(self) -> None:
+        if self._compactor is not None:
+            mgr, self._compaction_mgr = self._compaction_mgr, None
+            comp, self._compactor = self._compactor, None
+            if mgr is not None:
+                await mgr.drain()
+            comp.close()
         if self.actors:
             async with self._barrier_lock:
                 stop_ids = set(self.actors)
@@ -454,6 +522,11 @@ class Frontend:
                 from risingwave_tpu.stream import costs as _mvcosts
                 _mvcosts.set_enabled(_mvcosts.parse_costs(
                     self.session_vars.get("stream_costs")))
+            if stmt.name == "storage_compaction":
+                # runtime arm flip (validated above): wires/tears the
+                # dedicated compactor — never rides the DDL log
+                await self._set_compaction_mode(
+                    self.session_vars.get("storage_compaction"))
             if stmt.name == "stream_epoch_pipeline":
                 from risingwave_tpu.meta.domains import (
                     parse_epoch_pipeline,

@@ -157,6 +157,13 @@ class AutoscalerConfig:
     # not already recovering (last sample ≥ trend_ratio × window
     # median) or the MV publishes no freshness samples at all
     trend_ratio: float = 0.8
+    # multi-step jump (ISSUE 19): a LOAD STEP (≥~4x input rate) shows
+    # up as a near-saturated busy mean AND a steeply rising wall-lag
+    # trend — jump +2 parallelism per decision (still ONE guarded
+    # rescale, still capped) instead of walking +1 per cooldown
+    # window while the backlog outruns each rung
+    jump_busy_mean: float = 0.85
+    jump_lag_slope: float = 2.0
     # storm gate (PR-8 admit() shape)
     max_attempts: int = 4
     backoff_s: float = 0.5
@@ -304,6 +311,26 @@ class Autoscaler:
         median = ordered[len(ordered) // 2]
         return window[-1] >= self.cfg.trend_ratio * median
 
+    def _step_size(self, busy_mean: float, mv: str) -> int:
+        """+1 normally; +2 when the signals say LOAD STEP rather than
+        drift: the fragment is saturated (busy mean ≥ jump_busy_mean)
+        and the MV's wall lag is growing steeply (last sample ≥
+        jump_lag_slope × window median). Under a 4x input step the +1
+        ladder accumulates more backlog per cooldown window than each
+        rung retires — the jump halves the rungs to reach the needed
+        parallelism."""
+        if busy_mean < self.cfg.jump_busy_mean:
+            return 1
+        window = self._lag.get(mv)
+        if not window or len(window) < 4:
+            return 1
+        ordered = sorted(window)
+        median = ordered[len(ordered) // 2]
+        if median <= 0:
+            return 1
+        return 2 if window[-1] >= self.cfg.jump_lag_slope * median \
+            else 1
+
     def _fragment_of_actor(self, job, actor_id: int) -> Optional[int]:
         for fi, placed in enumerate(job.placements):
             if any(aid == actor_id for aid, _slot in placed):
@@ -380,17 +407,22 @@ class Autoscaler:
             cap = self.cfg.max_parallelism or self.cluster.n
             if cur >= cap:
                 continue
-            if self._fragment_busy_mean(fragment, job, fi) \
-                    < self.cfg.up_busy_mean:
+            busy_mean = self._fragment_busy_mean(fragment, job, fi)
+            if busy_mean < self.cfg.up_busy_mean:
                 continue                     # tricolor cross-check
             if not self._lag_still_rising(fragment):
                 continue                     # freshness cross-check
             self._baseline.setdefault((fragment, fi), cur)
+            step = self._step_size(busy_mean, fragment)
+            to_p = min(cur + step, cap)      # bounded, ONE rescale
+            reason = (f"sustained bottleneck: {diag}" if diag
+                      else "sustained bottleneck")
+            if to_p - cur > 1:
+                reason += (f" (load step: busy {busy_mean:.0%}, "
+                           f"lag slope — jump +{to_p - cur})")
             return {"mv": fragment, "fi": fi, "operator": op,
-                    "direction": "up", "from_p": cur, "to_p": cur + 1,
-                    "source": source_kind,
-                    "reason": f"sustained bottleneck: {diag}"
-                    if diag else "sustained bottleneck"}
+                    "direction": "up", "from_p": cur, "to_p": to_p,
+                    "source": source_kind, "reason": reason}
         # scale-down sweep: fragments this loop scaled up whose demand
         # evaporated (quiet domain + idle actors for a long window)
         for (mv, fi), base in list(self._baseline.items()):

@@ -58,10 +58,18 @@ CAUSE_UNKNOWN = "unknown"
 
 CAUSE_RESCALE_FAILED = "rescale_failed"  # guarded rescale unwound
 
+# compactor-role faults (dedicated compaction, ISSUE 19): a dead or
+# lease-expired compactor costs a TASK, never a serving domain —
+# recorded via record() directly, NEVER admitted through the storm
+# gate (the gate budgets serving recoveries; background hygiene must
+# not spend it)
+CAUSE_COMPACTOR_DEAD = "compactor_dead"
+
 # -- graduated responses ------------------------------------------------
 ACTION_RESPAWN = "respawn"   # restart dead slots, reset live ones in place
 ACTION_FULL = "full"         # kill-and-redeploy every slot
 ACTION_ROLLBACK = "rollback"  # rescale reverted to the prior topology
+ACTION_REQUEUE = "requeue"   # compaction task aborted + re-picked
 
 # causes a respawn (rung 2) can repair; everything else escalates to
 # full recovery (rung 3)

@@ -13,10 +13,23 @@ Reference parity (semantics, not format):
   recovery reads resume at the committed epoch.
 - reads: merge shared-buffer → imms → L0 (newest first) → L1 with
   bloom-filter pruning for point gets (hummock_storage.rs read path).
-- compaction: when L0 grows past a threshold, a full merge of L0+L1
-  rewrites key-disjoint L1 runs, dropping versions shadowed below the
-  committed epoch and freeing objects (compactor/compactor_runner.rs,
-  vacuum.rs — collapsed to one in-process routine).
+- compaction: when L0 grows past a threshold, a merge of L0 with the
+  overlapping L1 runs rewrites key-disjoint L1 runs, dropping versions
+  shadowed below the committed epoch (compactor/compactor_runner.rs).
+  Two arms, ``compaction_mode``:
+    * ``"inline"`` (default): ``commit_ssts``/``commit_through`` call
+      ``compact()`` synchronously — the single-process/test arm.
+    * ``"dedicated"``: commits NEVER compact; a CompactionManager
+      (meta/compaction.py) picks tasks off level snapshots, a
+      compactor role executes the merge off the serving path
+      (storage/compactor.py), and the result lands here as a
+      compare-and-commit **version delta** (``reserve_task`` →
+      ``apply_version_delta``/``abort_task``).
+- GC: replaced objects are RETIRED, not deleted — a vacuum pass frees
+  them only once no pinned version still references them
+  (``pin_version``/``unpin_version``; every ``iter()`` pins at first
+  next()). This is exact pin-counting (vacuum.rs analog), replacing
+  the old "one compaction cycle of grace" heuristic.
 """
 
 from __future__ import annotations
@@ -94,6 +107,22 @@ class HummockLite(StateStore):
         self._blocks = BlockCache()
         self._handles: OrderedDict[int, LazySst] = OrderedDict()
         self._handles_max = 256
+        # -- compaction arms + pin-exact GC -----------------------------
+        # "inline": commits compact synchronously (test/oracle arm);
+        # "dedicated": commits never compact — the compactor subsystem
+        # applies version deltas through reserve/apply/abort below.
+        self.compaction_mode = "inline"
+        # version pins: pin id → version_id the reader opened against.
+        # A retired object is deletable only when every live pin is at
+        # or past the version that replaced it.
+        self._pins: Dict[int, int] = {}
+        self._next_pin = 1
+        # retired-but-not-deleted objects: {"id", "size", "since"}
+        # (since = first version_id that no longer references the id)
+        self._retired: List[dict] = []
+        # in-flight dedicated tasks: frozenset(input ids) → reserved
+        # output id block (base, cap)
+        self._reservations: Dict[frozenset, Tuple[int, int]] = {}
         self._load_current()
 
     # -- manifest ---------------------------------------------------------
@@ -234,7 +263,8 @@ class HummockLite(StateStore):
                 self._persist_staged()
             return {"sst": info}
         self._committed_epoch = max(self._committed_epoch, epoch)
-        if len(self._l0) >= L0_COMPACT_THRESHOLD:
+        if (self.compaction_mode == "inline"
+                and len(self._l0) >= L0_COMPACT_THRESHOLD):
             self.compact()
         else:
             self._commit_version()
@@ -253,7 +283,8 @@ class HummockLite(StateStore):
         for s in adopt:
             self._l0.append(s["sst"])
         self._committed_epoch = max(self._committed_epoch, epoch)
-        if len(self._l0) >= L0_COMPACT_THRESHOLD:
+        if (self.compaction_mode == "inline"
+                and len(self._l0) >= L0_COMPACT_THRESHOLD):
             self.compact()
         else:
             self._commit_version()
@@ -293,8 +324,11 @@ class HummockLite(StateStore):
         live = {info["id"] for info in self._l0 + self._l1}
         live |= {s["sst"]["id"] for s in self._staged}
         live |= {u["sst"]["id"] for u in self._uploading}
-        live |= {info["id"]
-                 for info in getattr(self, "_pending_vacuum", [])}
+        # retired objects vacuum through maybe_vacuum (pin-gated);
+        # reserved output blocks belong to in-flight compaction tasks
+        live |= {ent["id"] for ent in self._retired}
+        for base, cap in self._reservations.values():
+            live |= set(range(base, base + cap))
         dropped = 0
         for path in self.obj.list("data/"):
             name = path[len("data/"):]
@@ -310,6 +344,166 @@ class HummockLite(StateStore):
                 self._blocks.drop_sst(sst_id)
                 dropped += 1
         return dropped
+
+    # -- version pins + exact-count vacuum --------------------------------
+    def pin_version(self) -> int:
+        """Pin the CURRENT version: objects it references stay on disk
+        until ``unpin_version``. Every ``iter()`` takes one at its
+        first next(); the uploader window and staged layers are
+        protected structurally (they are in the live set)."""
+        pid = self._next_pin
+        self._next_pin += 1
+        self._pins[pid] = self._version_id
+        return pid
+
+    def unpin_version(self, pin: int) -> None:
+        self._pins.pop(pin, None)
+        self.maybe_vacuum()
+
+    def pinned_versions(self) -> List[int]:
+        return sorted(self._pins.values())
+
+    def _retire(self, infos: List[dict], since: int) -> None:
+        """Mark replaced objects for the pin-gated vacuum. ``since`` is
+        the first version_id that no longer references them."""
+        for info in infos:
+            self._retired.append({"id": info["id"],
+                                  "size": info.get("size", 0),
+                                  "since": since})
+
+    def maybe_vacuum(self) -> int:
+        """Delete retired objects no pinned version can still read:
+        deletable iff every live pin is ≥ the retiring version. A
+        storage fault here only DELAYS GC (the entry stays retired and
+        the next pass retries) — vacuum must never fail a commit or a
+        version-delta apply."""
+        if not self._retired:
+            return 0
+        floor = min(self._pins.values(), default=None)
+        keep: List[dict] = []
+        dropped = 0
+        for ent in self._retired:
+            if floor is not None and floor < ent["since"]:
+                keep.append(ent)
+                continue
+            try:
+                fail_point("hummock.vacuum")
+                self.obj.delete(f"data/{ent['id']}.sst")
+            except FileNotFoundError:
+                pass               # already gone (recovery vacuumed it)
+            except OSError:
+                keep.append(ent)
+                continue
+            self._handles.pop(ent["id"], None)
+            self._blocks.drop_sst(ent["id"])
+            dropped += 1
+        self._retired = keep
+        self._update_space_amp()
+        return dropped
+
+    def _update_space_amp(self) -> None:
+        """storage_space_amp gauge: (manifest-live + retired-on-disk)
+        bytes over manifest-live bytes — 1.0 when GC is caught up, the
+        honest measure of vacuum lag under pinned readers."""
+        logical = sum(i.get("size", 0) for i in self._l0 + self._l1)
+        dead = sum(ent.get("size", 0) for ent in self._retired)
+        if logical > 0:
+            _METRICS.storage_space_amp.set(
+                round((logical + dead) / logical, 4))
+
+    # -- dedicated-compaction plane (reserve → execute → apply) -----------
+    def level_snapshot(self) -> dict:
+        """Topology the CompactionManager's pickers read: per-level SST
+        infos + the ids already frozen under an in-flight task."""
+        reserved: set = set()
+        for key in self._reservations:
+            reserved |= set(key)
+        return {
+            "version_id": self._version_id,
+            "committed_epoch": self._committed_epoch,
+            "l0": [dict(i) for i in self._l0],
+            "l1": [dict(i) for i in self._l1],
+            "reserved": sorted(reserved),
+        }
+
+    def reserve_task(self, input_ids: List[int],
+                     id_block: int = 16) -> dict:
+        """Freeze a task's inputs and burn it a durable output-id
+        block. Serving commits proceed concurrently — new L0 runs are
+        simply not in the frozen input set. The id block commits to the
+        manifest NOW so a compactor crash after uploading outputs can
+        never race a later allocation onto the same ids."""
+        inset = frozenset(input_ids)
+        current = {i["id"] for i in self._l0 + self._l1}
+        missing = sorted(inset - current)
+        if missing:
+            raise ValueError(
+                f"compaction inputs not in current version: {missing}")
+        for key in self._reservations:
+            busy = sorted(inset & key)
+            if busy:
+                raise ValueError(
+                    f"compaction inputs already reserved: {busy}")
+        cap = max(1, id_block)
+        base = self._next_sst_id
+        self._next_sst_id += cap
+        self._commit_version()
+        self._reservations[inset] = (base, cap)
+        return {"read_version": self._version_id,
+                "safe_epoch": self._committed_epoch,
+                "output_base": base, "output_cap": cap}
+
+    def apply_version_delta(self, input_ids: List[int],
+                            outputs: List[dict]) -> dict:
+        """Compare-and-commit: swap EXACTLY the reserved inputs for the
+        task's outputs. Raises ValueError (conflict) if any input is no
+        longer in the current version — e.g. an inline compact ran in
+        between — leaving levels untouched; the manager aborts and
+        requeues. Inputs retire under the new version; vacuum frees
+        them once no pin predates the swap."""
+        inset = frozenset(input_ids)
+        olds = [i for i in self._l0 + self._l1 if i["id"] in inset]
+        if len(olds) != len(inset):
+            have = {i["id"] for i in olds}
+            self._reservations.pop(inset, None)
+            raise ValueError(
+                f"version delta conflict: inputs "
+                f"{sorted(inset - have)} no longer current")
+        keep = [i for i in self._l1 if i["id"] not in inset]
+        merged = sorted(keep + [dict(i) for i in outputs],
+                        key=lambda i: _user_prefix(i["smallest"]))
+        for a, b in zip(merged, merged[1:]):
+            if _user_prefix(a["largest"]) >= _user_prefix(b["smallest"]):
+                self._reservations.pop(inset, None)
+                raise ValueError(
+                    f"version delta conflict: outputs overlap L1 run "
+                    f"{b['id']} — task inputs were not range-complete")
+        self._l0 = [i for i in self._l0 if i["id"] not in inset]
+        self._l1 = merged
+        self._commit_version()
+        self._reservations.pop(inset, None)
+        self._retire(olds, self._version_id)
+        _METRICS.compaction_bytes_read.inc(
+            sum(i.get("size", 0) for i in olds), arm="dedicated")
+        _METRICS.compaction_bytes_written.inc(
+            sum(i.get("size", 0) for i in outputs), arm="dedicated")
+        self.maybe_vacuum()
+        self._update_space_amp()
+        return {"version_id": self._version_id}
+
+    def abort_task(self, input_ids: List[int],
+                   output_ids: List[int]) -> None:
+        """Release a failed/expired task: unfreeze its inputs and
+        delete any outputs it managed to upload (their ids stay
+        burned — never reused)."""
+        self._reservations.pop(frozenset(input_ids), None)
+        for sid in output_ids:
+            try:
+                self.obj.delete(f"data/{sid}.sst")
+            except OSError:
+                pass
+            self._handles.pop(sid, None)
+            self._blocks.drop_sst(sid)
 
     # -- SST access -------------------------------------------------------
     def _sst(self, info: dict) -> LazySst:
@@ -415,7 +609,25 @@ class HummockLite(StateStore):
         """Snapshot range scan: newest version ≤ epoch per key, no
         tombstones — a k-way merge across all layers. `reverse=True`
         scans keys DESCENDING (backward iterator; the merge key flips
-        the user key but keeps newest-version-first within a key)."""
+        the user key but keeps newest-version-first within a key).
+
+        The scan PINS the version at its first next() and unpins when
+        exhausted or closed: compactions committing mid-scan retire the
+        replaced objects but the vacuum cannot free them until this
+        reader finishes — an iterator opened before a compaction reads
+        its snapshot to completion, however many compactions land."""
+        def gen():
+            pin = self.pin_version()
+            try:
+                yield from self._iter_impl(table_id, epoch, start, end,
+                                           reverse)
+            finally:
+                self.unpin_version(pin)
+        return gen()
+
+    def _iter_impl(self, table_id: int, epoch: int,
+                   start: Optional[bytes], end: Optional[bytes],
+                   reverse: bool) -> Iterator[Tuple[bytes, tuple]]:
         start = start or b""
         sources = []
         rank = 0
@@ -521,9 +733,9 @@ class HummockLite(StateStore):
         Within the compacted range every level participates, so the
         old full-merge GC rules hold unchanged there: versions
         shadowed below the committed epoch drop, and a tombstone that
-        is the newest surviving version drops with its key. Old
-        objects are deleted one compaction cycle later (deferred
-        vacuum).
+        is the newest surviving version drops with its key. Replaced
+        objects retire into the pin-gated vacuum (an in-flight scan
+        that pinned an older version keeps them readable).
         """
         # key range of the L0 files being absorbed (user-key compare:
         # the inverted-epoch suffix would mis-order full keys)
@@ -615,17 +827,16 @@ class HummockLite(StateStore):
         # key-disjoint and sorted (the picker chose by range)
         self._l1 = keep_lo + new_infos + keep_hi
         self._commit_version()
-        # DEFERRED vacuum (version-pinning lite): the block cache now
-        # fetches lazily, so an iterator opened before this compaction
-        # may still read the replaced SSTs — delete the PREVIOUS
-        # compaction's garbage instead, giving in-flight scans one full
-        # compaction cycle of grace (the reference pins versions per
-        # reader; eager consumers — StateTable materializes — need none)
-        for info in getattr(self, "_pending_vacuum", []):
-            self.obj.delete(f"data/{info['id']}.sst")
-            self._handles.pop(info["id"], None)
-            self._blocks.drop_sst(info["id"])
-        self._pending_vacuum = olds
+        # pin-exact GC (vacuum.rs analog): retire the replaced objects
+        # under the new version; the vacuum frees each only once no
+        # pinned reader (in-flight scan) predates the swap
+        self._retire(olds, self._version_id)
+        _METRICS.compaction_bytes_read.inc(
+            sum(i.get("size", 0) for i in olds), arm="inline")
+        _METRICS.compaction_bytes_written.inc(
+            sum(i.get("size", 0) for i in new_infos), arm="inline")
+        self.maybe_vacuum()
+        self._update_space_amp()
 
     # -- test/debug helpers ----------------------------------------------
     def table_size(self, table_id: int, epoch: int) -> int:

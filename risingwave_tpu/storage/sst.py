@@ -248,6 +248,7 @@ class SstBuilder:
         self.smallest: Optional[bytes] = None
         self.largest: Optional[bytes] = None
         self.count = 0
+        self.tombstones = 0
         self.min_epoch = EPOCH_MASK
         self.max_epoch = 0
         self._off = 0
@@ -268,6 +269,8 @@ class SstBuilder:
         self.min_epoch = min(self.min_epoch, epoch)
         self.max_epoch = max(self.max_epoch, epoch)
         self.count += 1
+        if tombstone:
+            self.tombstones += 1
         if self.block.size() >= BLOCK_TARGET:
             self._flush_block()
 
@@ -304,6 +307,9 @@ class SstBuilder:
             "smallest": (self.smallest or b"").hex(),
             "largest": (self.largest or b"").hex(),
             "count": self.count,
+            # tombstone density feeds the reclaim picker; older
+            # manifests lack the field — readers .get(, 0)
+            "tombstones": self.tombstones,
             "min_epoch": self.min_epoch if self.count else 0,
             "max_epoch": self.max_epoch,
             "size": len(out),

@@ -532,6 +532,24 @@ class StorageMetrics:
         self.object_store_latency = r.histogram(
             "object_store_operation_latency_seconds",
             "object-store operation latency by op")
+        self.compaction_bytes_read = r.counter(
+            "compaction_bytes_read",
+            "bytes of SST input read by compaction merges, by arm "
+            "(inline/dedicated) — the write-amplification numerator's "
+            "read side")
+        self.compaction_bytes_written = r.counter(
+            "compaction_bytes_written",
+            "bytes of SST output written by compaction merges, by arm "
+            "(inline/dedicated); written/ingested = write amplification")
+        self.compaction_pending_tasks = r.gauge(
+            "compaction_pending_tasks",
+            "compaction tasks currently pending or running in the "
+            "CompactionManager (dedicated arm)")
+        self.storage_space_amp = r.gauge(
+            "storage_space_amp",
+            "space amplification: (manifest-live + retired-on-disk) "
+            "bytes / manifest-live bytes — 1.0 when the pin-gated "
+            "vacuum is caught up")
 
 
 STREAMING = StreamingMetrics()

@@ -187,6 +187,58 @@ def test_freshness_trend_cross_check():
     assert _tick(a).outcome == "applied"
 
 
+def test_load_step_jumps_two_rungs():
+    """A ≥4x load step — saturated busy mean AND a steeply rising
+    wall-lag trend — jumps parallelism +2 in ONE guarded rescale."""
+    import collections
+    c = FakeCluster(n=4)
+    a = _mk(c)
+    BOTTLENECKS.ingest([_sustained_row(busy=0.95)], "sig")
+    UTILIZATION.ingest_rows(_busy_util(busy=0.95))
+    # last sample ≥ jump_lag_slope (2.0) × window median
+    a._lag["hot"] = collections.deque([1.0, 1.0, 2.0, 8.0], maxlen=32)
+    ev = _tick(a)
+    assert ev is not None and ev.outcome == "applied"
+    assert ev.from_parallelism == 1 and ev.to_parallelism == 3
+    assert len(c.rescales) == 1          # one rescale, not two
+    assert "jump +2" in ev.reason
+
+
+def test_gentle_load_keeps_single_step():
+    """Busy-but-not-saturated, or a flat lag trend, walks +1."""
+    import collections
+    c = FakeCluster(n=4)
+    a = _mk(c)
+    BOTTLENECKS.ingest([_sustained_row()], "sig")
+    UTILIZATION.ingest_rows(_busy_util(busy=0.5))    # < jump_busy_mean
+    a._lag["hot"] = collections.deque([1.0, 1.0, 2.0, 8.0], maxlen=32)
+    ev = _tick(a)
+    assert ev is not None and ev.to_parallelism == 2
+    # saturated but the lag trend is flat (rising enough to pass the
+    # veto, nowhere near the jump slope) → still +1
+    c2 = FakeCluster(n=4)
+    a2 = _mk(c2)
+    BOTTLENECKS.ingest([_sustained_row(busy=0.95)], "sig")
+    UTILIZATION.ingest_rows(_busy_util(busy=0.95))
+    a2._lag["hot"] = collections.deque([4.0, 4.1, 4.0, 4.2],
+                                       maxlen=32)
+    ev2 = _tick(a2)
+    assert ev2 is not None and ev2.to_parallelism == 2
+
+
+def test_jump_clamps_to_max_parallelism():
+    """The jump is bounded: +2 from cur=1 on a 2-slot cluster lands
+    on 2, never past the cap."""
+    import collections
+    c = FakeCluster(n=2)
+    a = _mk(c)
+    BOTTLENECKS.ingest([_sustained_row(busy=0.95)], "sig")
+    UTILIZATION.ingest_rows(_busy_util(busy=0.95))
+    a._lag["hot"] = collections.deque([1.0, 1.0, 2.0, 8.0], maxlen=32)
+    ev = _tick(a)
+    assert ev is not None and ev.to_parallelism == 2
+
+
 def test_failed_rescale_rolls_back_and_records_both_ledgers():
     c = FakeCluster()
     a = _mk(c)

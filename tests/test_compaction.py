@@ -1,0 +1,406 @@
+"""Dedicated compactor subsystem (ISSUE 19), end to end.
+
+The tentpole's acceptance, white-box and black-box: multi-level
+pickers choose tasks off a level snapshot; ``reserve_task`` freezes a
+task's inputs and burns a durable output-id block while serving
+commits land concurrently; ``apply_version_delta`` is
+compare-and-commit; pinned readers survive any number of compactions
+landing mid-scan (pin-exact GC); a crash between the version delta
+and the vacuum leaves no dangling manifest refs; and with
+``storage_compaction = 'dedicated'`` the barrier/commit path carries
+ZERO ``compact()`` frames while the MV stays bit-identical to the
+inline oracle arm — including under the two compactor chaos schedules
+(SIGKILL mid-task, storage fault during vacuum), which must converge
+with zero SERVING-domain recoveries.
+"""
+
+import asyncio
+
+import pytest
+
+from risingwave_tpu.frontend.planner import PlanError
+from risingwave_tpu.frontend.session import Frontend
+from risingwave_tpu.meta.compaction import (
+    clear_compaction_log, compaction_rows, parse_compaction, pick_l0,
+    pick_size_ratio, pick_task, pick_tombstone,
+)
+from risingwave_tpu.meta.supervisor import clear_recovery_log
+from risingwave_tpu.storage.compactor import execute_task
+from risingwave_tpu.storage.hummock import HummockLite
+from risingwave_tpu.storage.object_store import (
+    LocalFsObjectStore, MemObjectStore,
+)
+from risingwave_tpu.utils.failpoint import failpoints
+
+
+def E(n: int) -> int:
+    return n << 16
+
+
+def _checkpoint(store, epoch):
+    store.seal_epoch(epoch, True)
+    store.sync(epoch)
+
+
+def _churn(h, epochs, keys=50, table=1):
+    """One full-keyspace overwrite per epoch: each checkpoint lands
+    one L0 run, the compaction pressure the pickers watch."""
+    for e in epochs:
+        h.ingest_batch(table, [(b"k%03d" % i, (e, i))
+                               for i in range(keys)], E(e))
+        _checkpoint(h, E(e))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_logs():
+    clear_compaction_log()
+    clear_recovery_log()
+    yield
+    clear_compaction_log()
+    clear_recovery_log()
+
+
+# -- parse + pickers (pure units) ---------------------------------------
+
+
+def test_parse_compaction_modes():
+    assert parse_compaction("inline") == "inline"
+    assert parse_compaction("DEDICATED") == "dedicated"
+    with pytest.raises(PlanError):
+        parse_compaction("sideways")
+
+
+def _info(sid, lo, hi, size=100, count=10, tombstones=0):
+    # smallest/largest are hex FULL keys: user key + 8-byte inverted
+    # epoch suffix (the pickers strip the suffix before comparing)
+    return {"id": sid, "smallest": (lo + bytes(8)).hex(),
+            "largest": (hi + bytes(8)).hex(), "size": size,
+            "count": count, "tombstones": tombstones}
+
+
+def test_pick_l0_threshold_overlap_and_reservations():
+    l0 = [_info(i, b"a", b"z") for i in range(1, 5)]
+    l1 = [_info(9, b"a", b"m"), _info(10, b"n", b"z")]
+    t = pick_l0({"l0": l0, "l1": l1, "reserved": []})
+    assert t is not None and t["picker"] == "l0" and t["bottom"]
+    assert [i["id"] for i in t["inputs_l0"]] == [1, 2, 3, 4]
+    assert [i["id"] for i in t["inputs_l1"]] == [9, 10]
+    # below threshold → no task; any frozen input → no task
+    assert pick_l0({"l0": l0[:3], "l1": l1, "reserved": []}) is None
+    assert pick_l0({"l0": l0, "l1": l1, "reserved": [9]}) is None
+    # disjoint L1 runs outside the L0 key range stay untouched
+    far = _info(11, b"zz", b"zzz")
+    t = pick_l0({"l0": l0, "l1": l1 + [far], "reserved": []})
+    assert far["id"] not in [i["id"] for i in t["inputs_l1"]]
+
+
+def test_pick_size_ratio_and_tombstone_reclaim():
+    l1 = [_info(5, b"a", b"z", size=1000)]
+    big = [_info(1, b"a", b"m", size=300), _info(2, b"a", b"m",
+                                                 size=200)]
+    t = pick_size_ratio({"l0": big, "l1": l1, "reserved": []})
+    assert t is not None and t["picker"] == "size_ratio"
+    small = [_info(1, b"a", b"m", size=10), _info(2, b"a", b"m",
+                                                  size=20)]
+    assert pick_size_ratio({"l0": small, "l1": l1,
+                            "reserved": []}) is None
+    dense = _info(7, b"a", b"z", count=10, tombstones=4)
+    t = pick_tombstone({"l0": [], "l1": [dense], "reserved": []})
+    assert t is not None and t["picker"] == "tombstone"
+    assert t["inputs_l1"] == [dense] and t["inputs_l0"] == []
+    # a reserved run is never re-picked, by any picker
+    assert pick_task({"l0": [], "l1": [dense], "reserved": [7]}) \
+        is None
+
+
+# -- reservation protocol against a live store --------------------------
+
+
+def test_reserve_execute_apply_with_concurrent_commits():
+    h = HummockLite(MemObjectStore())
+    h.compaction_mode = "dedicated"
+    _churn(h, range(1, 5))
+    snap = h.level_snapshot()
+    assert len(snap["l0"]) == 4 and not snap["l1"]
+    picked = pick_task(snap)
+    assert picked is not None
+    ids = [i["id"] for i in picked["inputs_l0"] + picked["inputs_l1"]]
+    grant = h.reserve_task(ids, id_block=8)
+    # frozen inputs: an overlapping second reservation is refused
+    with pytest.raises(ValueError):
+        h.reserve_task(ids[:1], id_block=8)
+    # a serving commit lands CONCURRENTLY — not in the frozen set
+    h.ingest_batch(1, [(b"k000", (99, 0))], E(5))
+    _checkpoint(h, E(5))
+    result = execute_task(h.obj, {
+        **picked, "safe_epoch": grant["safe_epoch"],
+        "output_base": grant["output_base"],
+        "output_cap": grant["output_cap"]})
+    out_ids = [i["id"] for i in result["outputs"]]
+    assert out_ids and all(
+        grant["output_base"] <= i
+        < grant["output_base"] + grant["output_cap"] for i in out_ids)
+    h.apply_version_delta(ids, result["outputs"])
+    snap2 = h.level_snapshot()
+    assert [i["id"] for i in snap2["l1"]] == out_ids
+    # only the concurrent commit's run remains in L0
+    assert len(snap2["l0"]) == 1
+    assert snap2["l0"][0]["id"] not in ids
+    # reads see the merged history AND the concurrent write
+    assert h.get(1, b"k000", E(5)) == (99, 0)
+    assert h.get(1, b"k001", E(5)) == (4, 1)
+    # compare-and-commit: replaying the same delta conflicts
+    with pytest.raises(ValueError):
+        h.apply_version_delta(ids, result["outputs"])
+
+
+def test_abort_releases_reservation_and_burns_ids():
+    h = HummockLite(MemObjectStore())
+    h.compaction_mode = "dedicated"
+    _churn(h, range(1, 5))
+    ids = [i["id"] for i in h.level_snapshot()["l0"]]
+    g1 = h.reserve_task(ids, id_block=8)
+    h.abort_task(ids, [])
+    assert h.level_snapshot()["reserved"] == []
+    # the aborted grant's id block stays burned: a crashed compactor
+    # that uploaded outputs can never race a later allocation
+    g2 = h.reserve_task(ids, id_block=8)
+    assert g2["output_base"] >= g1["output_base"] + 8
+    h.abort_task(ids, [])
+
+
+# -- pin-exact GC -------------------------------------------------------
+
+
+def test_iterator_opened_before_compaction_reads_old_version():
+    """The satellite's pin-safety case: a scan that starts before a
+    compaction commits reads its snapshot to completion even after
+    TWO further compactions, and the vacuum frees the replaced
+    objects only once the scan closes."""
+    h = HummockLite(MemObjectStore())
+    _churn(h, (1, 2, 3), keys=20)
+    expected = [(b"k%03d" % i, (3, i)) for i in range(20)]
+    it = h.iter(1, E(3))
+    assert next(it) == expected[0]          # pins the version here
+    old_ids = [i["id"] for i in h.level_snapshot()["l0"]]
+    # compaction #1 (4th L0 run trips the inline trigger) ...
+    _churn(h, (4,), keys=20)
+    assert h.level_snapshot()["l1"], "first compaction landed"
+    # ... and #2 (four more runs over the new L1)
+    _churn(h, (5, 6, 7, 8), keys=20)
+    assert h._retired, "replaced objects await the pinned reader"
+    assert all(h.obj.exists(f"data/{sid}.sst") for sid in old_ids)
+    # the open scan still reads the OLD snapshot, bit-exactly
+    assert list(it) == expected[1:]
+    # exhaustion unpinned → the vacuum drains every retired object
+    h.maybe_vacuum()
+    assert h._retired == []
+    assert not any(h.obj.exists(f"data/{sid}.sst") for sid in old_ids)
+    # and the current version still serves the newest data
+    assert h.get(1, b"k000", E(8)) == (8, 0)
+
+
+def test_storage_fault_during_vacuum_only_delays_gc():
+    h = HummockLite(MemObjectStore())
+    _churn(h, (1, 2, 3), keys=20)
+    with failpoints({"hummock.vacuum": OSError("chaos vacuum fault")}):
+        _churn(h, (4,), keys=20)      # trips compact; vacuum faults
+        snap = h.level_snapshot()
+        assert snap["l1"], "the commit must never fail on GC"
+        assert h._retired, "GC delayed, not lost"
+        kept = [ent["id"] for ent in h._retired]
+        assert all(h.obj.exists(f"data/{sid}.sst") for sid in kept)
+    # the next unarmed pass drains the backlog
+    assert h.maybe_vacuum() == len(kept)
+    assert h._retired == []
+    assert not any(h.obj.exists(f"data/{sid}.sst") for sid in kept)
+
+
+def test_crash_between_delta_and_vacuum_no_dangling_refs(tmp_path):
+    obj = LocalFsObjectStore(str(tmp_path))
+    h = HummockLite(obj)
+    h.compaction_mode = "dedicated"
+    _churn(h, range(1, 5))
+    picked = pick_task(h.level_snapshot())
+    ids = [i["id"] for i in picked["inputs_l0"] + picked["inputs_l1"]]
+    grant = h.reserve_task(ids, id_block=8)
+    result = execute_task(obj, {
+        **picked, "safe_epoch": grant["safe_epoch"],
+        "output_base": grant["output_base"],
+        "output_cap": grant["output_cap"]})
+    # the delta commits; the generation dies before its vacuum runs
+    with failpoints({"hummock.vacuum": OSError("crash window")}):
+        h.apply_version_delta(ids, result["outputs"])
+    assert h._retired
+    # recover a FRESH store over the same objects (the crash survivor)
+    h2 = HummockLite(obj)
+    snap = h2.level_snapshot()
+    assert [i["id"] for i in snap["l1"]] == \
+        [i["id"] for i in result["outputs"]]
+    for info in snap["l0"] + snap["l1"]:
+        assert obj.exists(f"data/{info['id']}.sst"), \
+            "manifest references a missing object"
+    # recovery GC removes the dead generation's residue ONLY
+    assert h2.vacuum_orphans() == len(ids)
+    for info in snap["l0"] + snap["l1"]:
+        assert obj.exists(f"data/{info['id']}.sst")
+    assert h2.get(1, b"k001", E(4)) == (4, 1)
+
+
+# -- the session arms: zero compact() frames, bit-identical MV ----------
+
+
+EVENTS = 12000
+SRC = ("CREATE SOURCE bid WITH (connector='nexmark', "
+       "nexmark.table.type='bid', nexmark.event.num={n}, "
+       "nexmark.max.chunk.size=512)")
+MV = ("CREATE MATERIALIZED VIEW q7 AS "
+      "SELECT window_start, MAX(price) AS max_price, COUNT(*) AS cnt "
+      "FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND) "
+      "GROUP BY window_start")
+
+
+def _run_arm(mode: str):
+    async def run():
+        store = HummockLite(MemObjectStore())
+        calls = {"n": 0}
+        orig = store.compact
+
+        def counted():
+            calls["n"] += 1
+            return orig()
+
+        store.compact = counted
+        fe = Frontend(store, min_chunks=4)
+        try:
+            await fe.execute(f"SET storage_compaction = '{mode}'")
+            await fe.execute(SRC.format(n=EVENTS))
+            await fe.execute(MV)
+            await fe.step(30)
+            rows = {tuple(r)
+                    for r in await fe.execute("SELECT * FROM q7")}
+            states = [s for (s,) in await fe.execute(
+                "SELECT state FROM rw_compaction")]
+            return rows, calls["n"], states, store.level_snapshot()
+        finally:
+            await fe.close()
+
+    return asyncio.run(run())
+
+
+def test_dedicated_arm_zero_compact_frames_bit_identical():
+    """The tentpole acceptance, white-box: with
+    ``storage_compaction='dedicated'`` the commit path carries ZERO
+    ``compact()`` frames, the level topology still shrinks (merges
+    land via ``apply_version_delta``), and the MV is bit-identical to
+    the inline oracle arm."""
+    rows_inline, calls_inline, _st, _snap = _run_arm("inline")
+    assert calls_inline >= 1, "the oracle arm must actually compact"
+    clear_compaction_log()
+    rows_ded, calls_ded, states, snap = _run_arm("dedicated")
+    assert rows_ded == rows_inline
+    assert calls_ded == 0
+    assert states.count("applied") >= 1, \
+        "merges must land OFF-path through the task manager"
+    # the applied deltas kept the read path shallow: L0 below the
+    # trigger after an off-path merge absorbed the older runs
+    assert snap["l1"], "off-path merge produced a leveled run"
+
+
+def test_rw_compaction_rows_shape():
+    """The system-table payload is the task ledger, column-stable."""
+    h = HummockLite(MemObjectStore())
+    h.compaction_mode = "dedicated"
+    _churn(h, range(1, 5))
+    picked = pick_task(h.level_snapshot())
+    ids = [i["id"] for i in picked["inputs_l0"]]
+
+    from risingwave_tpu.meta.compaction import (
+        CompactionManager, CompactorHooks,
+    )
+    from risingwave_tpu.storage.compactor import InProcessCompactor
+
+    comp = InProcessCompactor(h.obj)
+    mgr = CompactionManager()
+    mgr.add_namespace("local", CompactorHooks(
+        snapshot=h.level_snapshot, reserve=h.reserve_task,
+        apply=h.apply_version_delta, abort=h.abort_task,
+        execute=comp.submit))
+
+    async def drive():
+        await mgr.tick()            # dispatch
+        await mgr.drain()           # settle the in-flight merge
+    asyncio.run(drive())
+    comp.close()
+    rows = compaction_rows()
+    assert rows, "the dispatched task must appear in the ledger"
+    tid, ns, picker, state, ins, outs, br, bw, att, dur, det = rows[-1]
+    assert ns == "local" and picker == "l0" and state == "applied"
+    assert sorted(int(i) for i in ins.split(",")) == sorted(ids)
+    assert outs and br > 0 and bw > 0 and att == 1 and dur >= 0.0
+
+
+# -- chaos: the compactor rides its own ladder --------------------------
+
+
+def _oracle_rows(events: int):
+    async def run():
+        fe = Frontend(min_chunks=8)
+        await fe.execute(SRC.format(n=events))
+        await fe.execute(MV)
+        await fe.step(40)
+        rows = await fe.execute("SELECT * FROM q7")
+        await fe.close()
+        return {tuple(r) for r in rows}
+
+    return asyncio.run(run())
+
+
+def test_compactor_chaos_converges_zero_serving_recoveries(tmp_path):
+    """Satellite 4 acceptance: the two compactor fault kinds —
+    SIGKILL mid-task and a storage fault during vacuum — against a
+    2-worker dedicated-compaction cluster. The MV converges
+    bit-identical to the fault-free in-process oracle, the compactor
+    respawns, and rw_recovery carries NO serving-domain entry (only
+    ``compactor_dead`` rows are allowed)."""
+    from risingwave_tpu.cluster.chaos import run_chaos
+    from risingwave_tpu.cluster.session import DistFrontend
+
+    events = 24000
+    expect = _oracle_rows(events)
+
+    async def run():
+        fe = DistFrontend(str(tmp_path / "c"), n_workers=2,
+                          parallelism=2, barrier_timeout_s=30.0)
+        await fe.start()
+        try:
+            await fe.execute("SET storage_compaction = 'dedicated'")
+            await fe.execute(SRC.format(n=events))
+            await fe.execute(MV)
+            report = await run_chaos(
+                fe, seed=11, steps=12, settle_steps=48,
+                kinds=["kill_compactor_mid_task",
+                       "storage_fault_during_vacuum"])
+            rows = {tuple(r)
+                    for r in await fe.execute("SELECT * FROM q7")}
+            rec = await fe.execute(
+                "SELECT cause, action, ok FROM rw_recovery")
+            states = [s for (s,) in await fe.execute(
+                "SELECT state FROM rw_compaction")]
+            return report, rows, rec, states, \
+                fe.cluster.compactor_respawns
+        finally:
+            await fe.close()
+
+    report, rows, rec, states, respawns = asyncio.run(run())
+    assert rows == expect
+    assert {k for _s, k, _w in report.events} == {
+        "kill_compactor_mid_task", "storage_fault_during_vacuum"}
+    # the SIGKILL forced a respawn of the compactor role
+    assert respawns >= 1
+    # compaction kept landing off-path despite both faults
+    assert states.count("applied") >= 1
+    # THE invariant: zero serving-domain recoveries — every recovery
+    # row (if any) is a compactor-domain requeue
+    serving = [r for r in rec if r[0] != "compactor_dead"]
+    assert serving == []
