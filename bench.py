@@ -1107,6 +1107,156 @@ def _bench_q7_compact_subprocess(dedicated: bool) -> dict:
         {"JAX_PLATFORMS": "cpu"}, timeout=1800)
 
 
+def bench_q7_sink(sink_on: bool = True,
+                  total_events: int = 48_000) -> dict:
+    """Exactly-once sink lane (ISSUE 20): q7 through the SQL front
+    door over HummockLite with an epochlog sink attached to the MV
+    (vs the identical pipeline with the sink OFF — the control arm).
+    The sink's per-epoch staging is part of each checkpoint's
+    durability set but rides the uploader's ASYNC tail (upload_s)
+    exactly like the SST uploads — the lane's acceptance is that the
+    sink arm's p99 barrier latency stays at the control arm's level
+    while p99_upload_s carries the staging cost. (barrier_wait_share
+    is NOT comparable across the arms: the sink's chained
+    BackfillExecutor reader parks on the barrier channel while the
+    upstream agg computes, and the ledger attributes that idle as
+    source barrier_wait — reader idle, not commit-path stall.) After
+    the run the committed log is verified against the MV's own
+    content: the folded key→row state must match row for row (zero
+    duplicated, zero lost)."""
+    import tempfile
+    import time as _time
+
+    from risingwave_tpu.connectors.sink import make_sink_target
+    from risingwave_tpu.frontend.session import Frontend
+    from risingwave_tpu.storage.hummock import HummockLite
+    from risingwave_tpu.storage.object_store import MemObjectStore
+    from risingwave_tpu.utils.ledger import LEDGER
+    from risingwave_tpu.utils.metrics import STREAMING
+
+    arm = "sink" if sink_on else "control"
+    sink_dir = tempfile.mkdtemp(prefix="bench_q7_sink_")
+
+    async def run():
+        store = HummockLite(MemObjectStore())
+        fe = Frontend(store, rate_limit=8, min_chunks=4)
+        try:
+            await fe.execute(
+                f"CREATE SOURCE bid WITH (connector='nexmark', "
+                f"nexmark.table.type='bid', "
+                f"nexmark.event.num={total_events}, "
+                f"nexmark.max.chunk.size=512, "
+                f"nexmark.min.event.gap.in.ns=10000000, "
+                f"nexmark.generate.strings='false')")
+            await fe.execute(
+                "CREATE MATERIALIZED VIEW q7s AS "
+                "SELECT window_start, MAX(price) AS max_price, "
+                "COUNT(*) AS cnt "
+                "FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND) "
+                "GROUP BY window_start")
+            if sink_on:
+                await fe.execute(
+                    f"CREATE SINK s16 FROM q7s WITH "
+                    f"(connector='epochlog', path='{sink_dir}')")
+            expected = total_events * 46 // 50
+            await fe.step(1)                # warmup (traces compile)
+            warm_epochs = len(fe.loop.stats.latencies_s)
+            readers = [r for d in fe.readers.values()
+                       for r in d.values()]
+
+            def rows_seen() -> int:
+                return sum(r.rows_read if hasattr(r, "rows_read")
+                           else r.offset for r in readers)
+
+            if rows_seen() >= expected:
+                raise ValueError(
+                    "bench scale too small: warmup consumed all "
+                    f"{expected} rows — raise total_events")
+            loop = fe.loop
+            t0 = _time.perf_counter()
+            base = rows_seen()
+            injected = 0
+            while rows_seen() < expected:
+                if injected >= 500:
+                    raise RuntimeError(
+                        f"sources stalled at "
+                        f"{rows_seen()}/{expected}")
+                while loop.in_flight_count < IN_FLIGHT:
+                    await loop.inject()
+                    injected += 1
+                await loop.collect_next()
+            while loop.in_flight_count:
+                await loop.collect_next()
+            elapsed = _time.perf_counter() - t0
+            rows = rows_seen() - base
+            loop.stats.latencies_s = \
+                loop.stats.latencies_s[warm_epochs:]
+            loop.profiler.drop_first(warm_epochs)
+            # drain the source to completion OUTSIDE the timed window:
+            # close() finishes the stream anyway, so the verification
+            # below must compare final log vs final MV content
+            prev = -1
+            while rows_seen() != prev:
+                prev = rows_seen()
+                await fe.step(2)
+            mv_rows = [tuple(int(v) for v in r)
+                       for r in await fe.execute("SELECT * FROM q7s")]
+            return elapsed, rows, fe.loop, mv_rows
+        finally:
+            await fe.close()            # drains staging + final commit
+
+    t0 = _time.perf_counter()
+    elapsed, rows, loop, mv_rows = asyncio.run(run())
+    wall = _time.perf_counter() - t0
+    pb = LEDGER.phase_breakdown()
+    obs = _metrics_snapshot(loop)
+    out = {
+        "metric": "nexmark_q7_sink_events_per_sec",
+        "arm": arm,
+        "value": round(rows / elapsed, 1) if elapsed else None,
+        "unit": "events/s",
+        "events": rows,
+        "elapsed_s": round(elapsed, 2),
+        "wall_s": round(wall, 2),
+        "p99_barrier_latency_s": round(loop.stats.p99_latency_s(), 4),
+        "barrier_wait_share": pb.get("phases", {}).get(
+            "barrier_wait", {}).get("share"),
+        # the async checkpoint tail — where the staging cost must land
+        "p99_upload_s": obs["p99_upload_s"],
+        "phase_breakdown": pb,
+    }
+    import jax
+    out["platform"] = jax.devices()[0].platform
+    if not sink_on:
+        return out
+    # end-to-end verification off the committed log: the folded
+    # key→row state must equal the MV's final content exactly
+    target = make_sink_target({"path": sink_dir}, "upsert", [])
+    state = {}
+    for line in target.canonical_rows():
+        r = json.loads(line)
+        state[tuple(r["__k"])] = (int(r["max_price"]), int(r["cnt"]))
+    expect = {(r[0],): (r[1], r[2]) for r in mv_rows}
+    out.update({
+        "sink_committed_epoch": target.committed_epoch(),
+        "sink_uncommitted_epochs": len(target.uncommitted_epochs()),
+        "sink_rows_total": int(sum(
+            v for _l, v in STREAMING.sink_rows_total.series())),
+        "sink_staged_bytes": int(sum(
+            v for _l, v in STREAMING.sink_staged_bytes.series())),
+        "sink_state_rows": len(state),
+        "mv_rows": len(mv_rows),
+        "sink_matches_mv": state == expect,
+    })
+    return out
+
+
+def _bench_q7_sink_subprocess(sink_on: bool) -> dict:
+    return _run_bench_subprocess(
+        ["--sink-sub", "on" if sink_on else "off"],
+        {"JAX_PLATFORMS": "cpu"}, timeout=1800)
+
+
 def bench_chaos(seed: int = 7, events: int = 6000) -> dict:
     """Deterministic chaos round (``bench.py --chaos``): replay the
     seeded fault schedule — worker SIGKILL mid-epoch, object-store
@@ -1533,6 +1683,17 @@ def _main_locked(argv):
         print(json.dumps(bench_q7_compact(
             dedicated=(arm == "dedicated"))))
         return
+    if "--sink-sub" in argv:
+        # child mode: exactly-once sink lane (ISSUE 20), CPU-pinned
+        # — the subject is the checkpoint/staging path, not kernels
+        import jax as _jax
+        _jax.config.update("jax_platforms", "cpu")
+        enable_compilation_cache()
+        from risingwave_tpu.utils.ledger import LEDGER
+        arm = argv[argv.index("--sink-sub") + 1]
+        LEDGER.query = f"q7_sink_{arm}"
+        print(json.dumps(bench_q7_sink(sink_on=(arm == "on"))))
+        return
     if "--multimv-sub" in argv:
         # child mode: multi-MV barrier-domain lane, CPU-pinned
         import jax as _jax
@@ -1714,6 +1875,45 @@ def _main_locked(argv):
                     and cd["p99_barrier_latency_s"]
                     <= ci["p99_barrier_latency_s"]),
             }
+        # exactly-once sink lane (ISSUE 20): q7 with an epochlog sink
+        # attached vs the identical sink-off control — the staging
+        # cost must ride the async upload tail (p99 parity with the
+        # control, upload_s carries the staging; barrier_wait_share
+        # is reader-idle attribution, not comparable across arms),
+        # and the committed log must match the MV row for row
+        sink_keys = ("value", "arm", "events", "elapsed_s",
+                     "p99_barrier_latency_s", "barrier_wait_share",
+                     "p99_upload_s", "sink_committed_epoch",
+                     "sink_uncommitted_epochs", "sink_rows_total",
+                     "sink_staged_bytes", "sink_state_rows",
+                     "mv_rows", "sink_matches_mv", "platform")
+        for lane, on in (("q7_sink", True), ("q7_sink_off", False)):
+            try:
+                r = _bench_q7_sink_subprocess(on)
+                headline[lane] = {k: r[k] for k in sink_keys
+                                  if k in r}
+            except Exception as e:                   # noqa: BLE001
+                print(f"WARNING: {lane} failed: {e!r}",
+                      file=sys.stderr)
+                headline[lane] = {"error": repr(e)[:200]}
+        sk = headline.get("q7_sink")
+        so = headline.get("q7_sink_off")
+        if isinstance(sk, dict) and isinstance(so, dict) \
+                and sk.get("p99_barrier_latency_s") \
+                and so.get("p99_barrier_latency_s"):
+            sk["vs_control"] = {
+                "p99_ratio": round(sk["p99_barrier_latency_s"]
+                                   / so["p99_barrier_latency_s"], 4),
+                # the lane's acceptance: the committed log equals the
+                # MV exactly (zero dup/lost), nothing left staged,
+                # and the sink arm's p99 stays within 25% of the
+                # sink-off control (staging rode the async tail)
+                "resolved": bool(
+                    sk.get("sink_matches_mv")
+                    and sk.get("sink_uncommitted_epochs", 1) == 0
+                    and sk["p99_barrier_latency_s"]
+                    <= 1.25 * so["p99_barrier_latency_s"]),
+            }
         # sharded mesh lane (ISSUE 10): q7 at parallelism 8 — the
         # epoch-batched SPMD kernels timed, not just dry-run-checked
         try:
@@ -1872,6 +2072,12 @@ BENCH_FNS.update({"q7": bench_q7, "q8": bench_q8, "q4": bench_q4,
                       bench_q7_compact, dedicated=True),
                   "q7_compact_inline": _functools.partial(
                       bench_q7_compact, dedicated=False),
+                  # exactly-once sink arms (ISSUE 20): q7 with the
+                  # epochlog sink attached vs the sink-off control
+                  "q7_sink": _functools.partial(
+                      bench_q7_sink, sink_on=True),
+                  "q7_sink_off": _functools.partial(
+                      bench_q7_sink, sink_on=False),
                   "q7_fused": _functools.partial(bench_q7, fusion=True),
                   "q8_fused": _functools.partial(bench_q8, fusion=True),
                   "q3_fused": _functools.partial(bench_q3, fusion=True),

@@ -47,6 +47,11 @@ backup operations against a data directory:
                               # the dedicated-arm task ledger
                               # (rw_compaction) over a recovered
                               # clone driven with the off-path arm
+    python -m risingwave_tpu ctl --data-dir D sinks [--steps K]
+                              # exactly-once sink view (rw_sinks):
+                              # per-sink committed epoch, staged-but-
+                              # uncommitted epochs/bytes, writer lag —
+                              # listing-driven from each sink's root
     python -m risingwave_tpu ctl --data-dir D backup create|list|
         delete <id> | restore <id> --target T
 """
@@ -181,6 +186,8 @@ def _ctl(args) -> int:
         return asyncio.run(_ctl_cost(obj, args))
     if verb == "compaction":
         return asyncio.run(_ctl_compaction(obj, args))
+    if verb == "sinks":
+        return asyncio.run(_ctl_sinks(obj, args))
     if verb == "backup":
         from risingwave_tpu.meta.backup import (
             create_backup, delete_backup, list_backups, restore_backup,
@@ -614,6 +621,40 @@ async def _ctl_compaction(obj, args) -> int:
     return 0
 
 
+async def _ctl_sinks(obj, args) -> int:
+    """Recover into an in-memory clone (same snapshot discipline as
+    `table scan`) and print the sink view (rw_sinks): per-sink mode,
+    committed epoch, staged-but-uncommitted epochs/bytes, and writer
+    lag — all listing-driven from each sink's own object-store root,
+    so the numbers are the REAL sink's, not the clone's. Note: DDL
+    replay runs the standard recovery sweep on each epochlog sink
+    (promote floor-covered staging, truncate the rest), exactly as a
+    serving restart would. ``--steps K`` additionally drives K
+    checkpoints, which APPENDS real rows to the sinks — default 0
+    keeps inspection read-only."""
+    from risingwave_tpu.frontend import Frontend
+    from risingwave_tpu.storage.hummock import HummockLite
+
+    store = HummockLite(_snapshot_clone(obj))
+    fe = Frontend(store)
+    await fe.recover()
+    try:
+        if args.steps:
+            await fe.step(args.steps)
+        rows = await fe.execute("SELECT * FROM rw_sinks")
+        print("== sinks ==")
+        if not rows:
+            print("(no sinks)")
+        for (name, connector, mode, epoch, staged, nbytes, lag) in rows:
+            print(f"{name} [{connector}/{mode or 'legacy'}] "
+                  f"committed_epoch {int(epoch):#x} "
+                  f"staged_epochs {staged} staged {nbytes}B "
+                  f"writer_lag {lag}")
+    finally:
+        await fe.close()
+    return 0
+
+
 def main(argv=None) -> None:
     # the axon sitecustomize rewrites jax_platforms at interpreter
     # start, overriding JAX_PLATFORMS=cpu — honor the env var so ctl /
@@ -711,6 +752,14 @@ def main(argv=None) -> None:
                     help="checkpoint barriers to drive per refresh")
     cp.add_argument("--watch", type=int, default=1,
                     help="refresh cycles to print (drive+print each)")
+    sk = csub.add_parser(
+        "sinks",
+        help="recover + print the sink view (rw_sinks): per-sink "
+             "committed epoch, staged-but-uncommitted epochs/bytes, "
+             "writer lag — listing-driven from each sink's root")
+    sk.add_argument("--steps", type=int, default=0,
+                    help="checkpoint barriers to drive first (writes "
+                         "real sink rows; default 0 = read-only)")
     bk = csub.add_parser("backup")
     bk.add_argument("what",
                     choices=["create", "list", "delete", "restore"])

@@ -62,6 +62,30 @@ rides the same ladder; both kinds require ``storage_compaction =
   lingers until a later vacuum pass and NO recovery of any kind is
   recorded.
 
+Sink-domain faults (ISSUE 20 — the exactly-once epoch-segment sink
+chaos-proven on both halves of its visibility rule; the schedule's
+``rescale_mv`` names the SINK job when these kinds are present):
+
+- ``kill_writer_mid_stage`` — wedge one writer INSIDE its synchronous
+  segment stage (``sink.stage.mid`` sleep, fired at barrier passage
+  before collection), then SIGKILL the slot while it sleeps there.
+  The epoch's segment is absent or torn and UNMANIFESTED, the barrier
+  round fails, ``dead_worker``/respawn — and the recovery sweep
+  truncates the orphaned staging, so the epoch's rows replay under a
+  fresh epoch. Exactly-once half one: nothing uncommitted is visible.
+- ``fault_manifest_commit`` — the COORDINATOR's manifest PUT raises
+  once during ``commit_upto`` (in-process failpoint: the commit half
+  runs on the barrier owner, not in workers). The checkpoint floor
+  has already advanced past the epoch, so recovery PROMOTES it from
+  the durable staged listing. Exactly-once half two: a floor-covered
+  epoch is never lost, and the idempotent manifest re-PUT never
+  duplicates.
+- ``rescale_sink_fragment`` — a clean guarded rescale of the sink
+  job's fragment mid-stream (the session ALTER path): stop-and-align
+  forces a checkpoint (staged + committed through the stop barrier),
+  redeploy re-stamps writer ranks, and the output must stay oracle-
+  identical across the N-writers → M-writers handoff.
+
 Faults inject into LIVE worker processes over the control channel's
 ``arm_failpoints`` verb (exception specs are JSON — the failpoint
 env/wire restriction), so a respawned worker always comes back clean.
@@ -141,6 +165,19 @@ RESCALE_KINDS = frozenset({"kill_mid_rescale", "fault_mid_handoff",
 COMPACTOR_KINDS = frozenset({"kill_compactor_mid_task",
                              "storage_fault_during_vacuum"})
 
+# sink-domain fault kinds (ISSUE 20): exercise both halves of the
+# epoch-segment visibility rule plus the rescale handoff; the schedule
+# needs rescale_mv = the SINK job's name for the rescale kind
+SINK_KINDS = frozenset({"kill_writer_mid_stage",
+                        "fault_manifest_commit",
+                        "rescale_sink_fragment"})
+
+# how long the wedged writer sleeps inside stage() vs. how long the
+# harness waits before SIGKILLing the slot: the kill must land while
+# the writer is provably INSIDE the staging window
+_STAGE_WEDGE_S = 1.5
+_STAGE_KILL_AFTER_S = 0.4
+
 
 @dataclass
 class ChaosReport:
@@ -190,7 +227,13 @@ class ChaosRunner:
         # the MV whose guarded rescale the mid-rescale faults target
         # (required when the schedule contains RESCALE_KINDS)
         self.rescale_mv = rescale_mv
-        if any(e.kind in RESCALE_KINDS for e in self.schedule):
+        # delayed-SIGKILL task for kill_writer_mid_stage: fired during
+        # the NEXT barrier step (while the wedged writer sleeps inside
+        # stage()); awaited before the report returns
+        self._pending_kill = None
+        if any(e.kind in RESCALE_KINDS
+               or e.kind == "rescale_sink_fragment"
+               for e in self.schedule):
             assert rescale_mv is not None, (
                 "a mid-rescale fault schedule needs rescale_mv")
         if any(e.kind in COMPACTOR_KINDS for e in self.schedule):
@@ -281,6 +324,35 @@ class ChaosRunner:
             await self._arm(ev.slot, {"hummock.vacuum": {
                 "raise": "OSError", "msg": "chaos vacuum fault",
                 "times": 4}})
+        elif ev.kind == "kill_writer_mid_stage":
+            # arm the wedge on the worker, then SIGKILL it a beat into
+            # the next barrier step — the writer dies INSIDE stage(),
+            # leaving an unmanifested (possibly torn) segment that the
+            # recovery sweep must truncate before the rows replay
+            import asyncio
+            await self._arm(ev.slot, {"sink.stage.mid": {
+                "sleep_s": _STAGE_WEDGE_S, "times": 1}})
+            slot = ev.slot
+
+            async def _delayed_kill():
+                await asyncio.sleep(_STAGE_KILL_AFTER_S)
+                self.fe.cluster.kill_slot(slot)
+
+            self._pending_kill = asyncio.create_task(_delayed_kill())
+        elif ev.kind == "fault_manifest_commit":
+            # the manifest commit runs on the COORDINATOR (this
+            # process), not in a worker — arm the local registry, not
+            # the control channel. times=1: the re-derived commit
+            # after recovery must succeed
+            from risingwave_tpu.utils.failpoint import arm_specs
+            arm_specs({"sink.manifest_commit": {
+                "raise": "OSError", "msg": "chaos manifest fault",
+                "times": 1}})
+        elif ev.kind == "rescale_sink_fragment":
+            # no fault armed: the guarded rescale ITSELF is the event
+            # (stop-and-align checkpoint → writer-rank re-stamp) and
+            # exactly-once across the handoff is the assertion
+            await self._alter_supervised(report)
         elif ev.kind == "straggler_mid_rescale":
             timeout = self.fe.cluster.barrier_timeout_s
             await self._arm(ev.slot, {"trace.slow.HashAggExecutor": {
@@ -313,6 +385,16 @@ class ChaosRunner:
         # re-processing, so the settle budget is generous)
         for _ in range(self.settle_steps):
             await self._step_supervised(report)
+        if self._pending_kill is not None:
+            await self._pending_kill
+            self._pending_kill = None
+        if any(e.kind == "fault_manifest_commit"
+               for e in self.schedule):
+            # the manifest fault arms the LOCAL registry (times=1); if
+            # the schedule landed it after the last commit it never
+            # fired — disarm so it cannot leak into unrelated runs
+            from risingwave_tpu.utils.failpoint import arm_specs
+            arm_specs({"sink.manifest_commit": None})
         report.absorbed_retries = await worker_retry_totals(self.fe)
         return report
 

@@ -163,6 +163,12 @@ class Cluster:
         self._compactor_handle: Optional[WorkerHandle] = None
         self._compactor_client: Optional[WorkerClient] = None
         self.compactor_respawns = 0
+        # exactly-once sinks (ISSUE 20): the meta-side coordinator —
+        # workers stage INLINE at barrier passage (deferred=False
+        # registrations), this side owns manifest commits at the
+        # checkpoint floor and the recovery promote/truncate sweep
+        from risingwave_tpu.meta.sink_coordinator import SinkCoordinator
+        self.sinks = SinkCoordinator()
 
     # -- lifecycle --------------------------------------------------------
     async def start(self) -> None:
@@ -211,6 +217,7 @@ class Cluster:
                         self.store.committed_epoch()))
             self.local.set_expected_actors(
                 [_PSEUDO_BASE + k for k in range(self.n)])
+            self.loop.uploader.sinks = self.sinks
             return
         from risingwave_tpu.meta.domains import BarrierPlane
         self._plane = BarrierPlane(
@@ -219,6 +226,10 @@ class Cluster:
             distributed=True)
         self._plane.aligned_hook = self._seal_sync_workers
         self.loop = self._plane
+        # sink manifests commit in the uploader hooks: strictly after
+        # the floor is durable (and the aligned_hook has sealed every
+        # worker — inline staging is already on disk by collection)
+        self.loop.uploader.sinks = self.sinks
         for name, job in self.jobs.items():
             self._plane.assign_job(name, set(job.domain_keys),
                                    sender_ids=(), expected_ids=(),
@@ -403,12 +414,16 @@ class Cluster:
 
     def _expand_nodes(self, frag: Fragment, actor_id: int,
                       placements: List[List[tuple]],
-                      splits: Optional[List[int]] = None) -> List[dict]:
+                      splits: Optional[List[int]] = None,
+                      rank: int = 0,
+                      n_actors: int = 1) -> List[dict]:
         """Resolve exchange_in placeholders into per-upstream-actor
         remote_input nodes + a merge, and pin the source actor id.
         ``splits`` (filelog fragments) is THIS actor's partition
         subset, stamped into the connector options so the worker
-        builds a reader over exactly those splits."""
+        builds a reader over exactly those splits. ``rank`` /
+        ``n_actors`` stamp sink nodes with their writer identity —
+        each parallel actor is one of the N exactly-once writers."""
         out: List[dict] = []
         remap: Dict[int, int] = {}
         for idx, node in enumerate(frag.nodes):
@@ -442,6 +457,9 @@ class Cluster:
                     conn["partitions"] = ",".join(str(p)
                                                   for p in splits)
                     n2["connector"] = conn
+            elif n2["op"] == "sink":
+                n2["writer"] = int(rank)
+                n2["n_writers"] = int(n_actors)
             out.append(n2)
             remap[idx] = len(out) - 1
         return out
@@ -516,7 +534,8 @@ class Cluster:
                     self._expand_nodes(
                         frag, aid, job.placements,
                         splits=assign[rank] if assign is not None
-                        else None),
+                        else None, rank=rank,
+                        n_actors=len(job.placements[fi])),
                     actor_id=aid, outputs=outputs, dispatch=dispatch,
                     job=job.name)
                 for rank, (aid, slot)
@@ -841,6 +860,11 @@ class Cluster:
             self.clients[k].call({"cmd": "recover_store",
                                   "epoch": floor})
             for k in range(self.n)))
+        # sink sweep BEFORE any writer redeploys: epochs the floor
+        # covers promote (their staging was durable before the floor
+        # advanced), younger staging truncates — replayed rows
+        # re-stage under fresh epochs, never duplicating
+        self.sinks.recover(floor)
         if self._compaction_mode != "inline":
             await asyncio.gather(*(
                 self.clients[k].call_idempotent(
@@ -921,6 +945,9 @@ class Cluster:
                 {"cmd": "recover_store", "epoch": floor},
                 io_timeout=20.0)
             for k in range(self.n)))
+        # same promote/truncate sweep as full recovery — a writer
+        # killed mid-stage may have left segments above the floor
+        self.sinks.recover(floor)
         await self._fresh_barrier_plane()
         await self._run_pending_repairs()
         for job in self.jobs.values():
@@ -968,8 +995,12 @@ class Cluster:
     # ops whose state is either vnode-partitioned by the exchange keys
     # or derivable from it — fragments of ONLY these ops can rescale
     # with a vnode-sliced state handoff
+    # "sink" is trivially rescalable: the epoch-segment writer is
+    # STATELESS (visibility is manifest-existence; staged epochs above
+    # the recovery floor truncate) — the handoff moves nothing, and
+    # the redeploy re-stamps writer ranks for the new actor count
     _RESCALABLE_OPS = frozenset({"exchange_in", "hash_agg", "project",
-                                 "filter", "materialize"})
+                                 "filter", "materialize", "sink"})
 
     def _rescalable(self, frag: Fragment) -> bool:
         if not frag.inputs or any(i.mode != "hash" for i in frag.inputs):
@@ -1298,7 +1329,8 @@ class Cluster:
     # table (the filelog contract) — everything else in the chain is
     # stateless
     _SOURCE_RESCALABLE_OPS = frozenset({"source", "project", "filter",
-                                        "coalesce", "row_id_gen"})
+                                        "coalesce", "row_id_gen",
+                                        "sink"})
 
     def _source_rescalable(self, frag: Fragment) -> bool:
         if frag.inputs:

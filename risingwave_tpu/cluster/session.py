@@ -293,6 +293,10 @@ class DistFrontend:
             return await self._create_mv(stmt)
         if isinstance(stmt, ast.DropMaterializedView):
             return await self._drop_mv(stmt)
+        if isinstance(stmt, ast.CreateSink):
+            return await self._create_sink(stmt)
+        if isinstance(stmt, ast.DropSink):
+            return await self._drop_sink(stmt)
         if isinstance(stmt, ast.SetVar):
             self.session_vars.set(stmt.name, stmt.value)
             if stmt.name == "stream_trace":
@@ -507,6 +511,15 @@ class DistFrontend:
                 else:
                     await self.cluster.rescale_fragment(name, fi,
                                                         to_slots)
+        if name in self.catalog.sinks:
+            # sink jobs rescale through the same guarded path (the
+            # sink node is stateless; redeploy re-stamps writer=rank
+            # and n_writers on every actor) — keep the coordinator's
+            # writer count and the catalog in step for telemetry
+            self.catalog.sinks[name].n_writers = n
+            sk = self.cluster.sinks.sink(name)
+            if sk is not None:
+                sk.n_writers = n
         return "ALTER_MATERIALIZED_VIEW"
 
     async def _drop_mv(self, stmt: ast.DropMaterializedView) -> str:
@@ -529,6 +542,105 @@ class DistFrontend:
         from risingwave_tpu.stream.costs import purge_mv_series
         purge_mv_series(stmt.name)
         return "DROP_MATERIALIZED_VIEW"
+
+    async def _create_sink(self, stmt: ast.CreateSink) -> str:
+        """CREATE SINK on the cluster: plan with the ordinary
+        StreamPlanner (FROM <mv> inlines by view expansion, same as
+        distributed MVs), lower the sink as a colocated fragment node,
+        and register the encoder on the COORDINATOR's SinkCoordinator
+        with deferred=False — workers stage their own segments
+        synchronously at barrier passage (plan_ir builds inline
+        CoordinatedSinkExecutors), the coordinator only runs the
+        commit/recovery half off the checkpoint floor."""
+        from risingwave_tpu.frontend.catalog import SinkCatalog
+        from risingwave_tpu.frontend.planner import validate_sink_options
+        self.catalog._check_free(stmt.name)
+        validate_sink_options(stmt.options)
+        connector = stmt.options.get("connector", "filelog").lower()
+        if connector != "epochlog":
+            raise PlanError(
+                "distributed sinks require connector='epochlog' (the "
+                "epoch-segment exactly-once sink); legacy writer sinks "
+                "are in-process only")
+        planner = StreamPlanner(self.catalog, MemoryStateStore(),
+                                LocalBarrierManager(), definition="",
+                                mesh=None, actors={},
+                                dist_parallelism=self.parallelism,
+                                inline_mvs=self._mv_selects,
+                                chunk_target_rows=self.chunk_target_rows,
+                                coalesce_linger_chunks=self
+                                .coalesce_linger_chunks,
+                                state_tier_cap=self.state_tier_cap
+                                or None)
+        plan = planner.plan_sink(stmt.select, stmt.options, actor_id=0,
+                                 rate_limit=self.rate_limit,
+                                 min_chunks=self.min_chunks,
+                                 sink_name=stmt.name,
+                                 append_only=stmt.append_only,
+                                 coordinator=None)
+        from risingwave_tpu.frontend.opt import (
+            apply_rewrites, parse_fusion,
+        )
+        rules = self.session_vars.get("stream_rewrite_rules")
+        fusion = parse_fusion(self.session_vars.get("stream_fusion"))
+        apply_rewrites(plan, rules, label=stmt.name, fusion=fusion,
+                       dist_parallelism=self.parallelism)
+        if plan.attaches:
+            raise PlanError(
+                "internal: distributed sink plan produced chain "
+                "attaches (view not inlined?) — cannot deploy")
+        graph = Fragmenter(
+            self.parallelism,
+            merge_coalesce_rows=self.chunk_target_rows,
+            merge_coalesce_chunks=self.coalesce_linger_chunks
+        ).lower(plan.consumer)
+        from risingwave_tpu.frontend.opt import (
+            fragment_plan_stats, rewrite_fragment_graph,
+        )
+        graph, _elided = rewrite_fragment_graph(graph, rules,
+                                                label=stmt.name)
+        self.last_plan_stats = fragment_plan_stats(graph)
+        n_writers = max(
+            (f.parallelism for f in graph.fragments
+             if any(n.get("op") == "sink" for n in f.nodes)),
+            default=1)
+        # register BEFORE the activation barrier: the first checkpoint
+        # after deploy may already carry sink rows, and commit_upto on
+        # the coordinator must know the sink exists to manifest them.
+        # floor=-1: a fresh CREATE truncates any leftover staging under
+        # the same path (prior generation's uncommitted epochs) and
+        # promotes nothing.
+        self.cluster.sinks.register(stmt.name, plan.encoder,
+                                    n_writers=n_writers,
+                                    deferred=False, floor=-1)
+        try:
+            async with self._barrier_lock:
+                await self.cluster.deploy_graph(
+                    stmt.name, graph,
+                    domain_keys={stmt.name, *plan.deps})
+                await self.cluster.step(1)     # activation barrier
+        except BaseException:
+            self.cluster.sinks.unregister(stmt.name)
+            raise
+        self.catalog.add_sink(SinkCatalog(
+            stmt.name, 0, dict(stmt.options),
+            dependent_sources=plan.deps, mode=plan.mode,
+            n_writers=n_writers))
+        return "CREATE_SINK"
+
+    async def _drop_sink(self, stmt: ast.DropSink) -> str:
+        if stmt.name not in self.catalog.sinks:
+            if stmt.if_exists:
+                return "DROP_SINK"
+            raise PlanError(f"unknown sink {stmt.name!r}")
+        async with self._barrier_lock:
+            await self.cluster.drop_job(stmt.name)
+        # committed manifests + segments stay on disk (the sink's
+        # output is the product); only the coordinator registration
+        # dies with the job
+        self.cluster.sinks.unregister(stmt.name)
+        del self.catalog.sinks[stmt.name]
+        return "DROP_SINK"
 
     async def drain_trace(self) -> int:
         """Merge every worker's recorded epoch-trace spans into the

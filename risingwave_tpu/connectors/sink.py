@@ -1,0 +1,341 @@
+"""Epoch-segment sink targets: the exactly-once N-writer storage
+format (ISSUE 20).
+
+Reference parity: the coordinated two-phase sink commit
+(src/connector/src/sink/mod.rs:156 SinkCommitCoordinator +
+src/meta/src/manager/sink_coordination/) — N writers STAGE their
+epoch's rows concurrently as per-(epoch, writer) segment objects; the
+meta-side coordinator then commits ONE manifest object per checkpoint
+epoch. Visibility is manifest-existence: an epoch's rows are in the
+sink iff ``manifest/<epoch>.json`` exists. The concurrency stance is
+arxiv 1904.03800's — writers never coordinate with each other, the
+only serialized decision is the single manifest PUT.
+
+Layout (under one object-store root per sink)::
+
+    seg/<epoch:016x>/w<writer:04d>.seg    staged segment (atomic PUT)
+    manifest/<epoch:016x>.json            commit record (atomic PUT)
+
+The commit protocol's two crash-window invariants (enforced by WHERE
+the hooks live, storage/uploader.py):
+
+  1. manifest strictly AFTER the checkpoint floor covers the epoch —
+     else a crash before the floor advanced would replay rows that
+     are already visible (duplicates);
+  2. floor advance strictly AFTER all the epoch's staging is durable —
+     else a crash after the floor advanced would lose rows the
+     upstream will never replay (they are ≤ the recovery point).
+
+Together: floor ≥ E  ⟹  every segment of E is durable, so recovery
+can PROMOTE any unmanifested epoch ≤ floor (complete its manifest
+from the staged segments) and must TRUNCATE any epoch > floor (its
+rows replay under fresh epochs). Commit authority is the object-store
+LISTING, never drained pre-commit RPCs — a lost drain can delay a
+commit but never lose one.
+
+Record encodings (newline-delimited JSON, filelog-compatible):
+
+  append  ``{"__op": "I", <col>: <val>, ...}`` — inserts only; the
+          planner proves the input append-only before choosing this.
+  upsert  ``{"__op": "U"|"D", "__k": [key vals], <col>: <val>, ...}``
+          — retractions FOLD per key within the epoch (last write
+          wins); a D that survives folding is a tombstone.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+from risingwave_tpu.utils.failpoint import fail_point
+
+SEG_PREFIX = "seg/"
+MANIFEST_PREFIX = "manifest/"
+
+# every local-FS sink root this process built a target over — the
+# tier-1 conftest orphan guard sweeps these at test teardown: staged
+# segments without a manifest that outlive the test are exactly the
+# uncommitted-epoch leakage the protocol exists to prevent
+_TOUCHED_ROOTS: set = set()
+
+
+def touched_roots() -> List[str]:
+    return sorted(_TOUCHED_ROOTS)
+
+
+def reset_touched_roots() -> None:
+    _TOUCHED_ROOTS.clear()
+
+
+def _jsonable(v):
+    """Physical value → JSON-safe, recursively (Decimal → str).
+    Bytes ride an explicit ``{"__b": hex}`` envelope — a bare hex
+    string would be indistinguishable from a real string that merely
+    looks like hex on the consuming side."""
+    if isinstance(v, bytes):
+        return {"__b": v.hex()}
+    if isinstance(v, (tuple, list)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, (int, float, str, bool)) or v is None:
+        return v
+    return str(v)                           # Decimal and friends
+
+
+def seg_key(epoch: int, writer: int) -> str:
+    return f"{SEG_PREFIX}{epoch:016x}/w{writer:04d}.seg"
+
+
+def manifest_key(epoch: int) -> str:
+    return f"{MANIFEST_PREFIX}{epoch:016x}.json"
+
+
+def _parse_seg_key(key: str) -> Optional[Tuple[int, int]]:
+    """seg/<epoch>/w<writer>.seg → (epoch, writer); None for garbage
+    (mkstemp residue from a writer SIGKILLed mid-PUT, stray files)."""
+    if not key.startswith(SEG_PREFIX) or not key.endswith(".seg"):
+        return None
+    body = key[len(SEG_PREFIX):-len(".seg")]
+    parts = body.split("/")
+    if len(parts) != 2 or not parts[1].startswith("w"):
+        return None
+    try:
+        return int(parts[0], 16), int(parts[1][1:], 10)
+    except ValueError:
+        return None
+
+
+class EpochSegmentTarget:
+    """One sink's staging + manifest namespace over an ObjectStore.
+
+    Writer side (any process): ``stage``. Coordinator side:
+    ``commit_upto`` / ``recover`` / the read surface. Both sides are
+    listing-driven — no shared in-memory state, so worker processes
+    and the meta coordinator agree by construction."""
+
+    def __init__(self, store, mode: str = "append",
+                 field_names: Optional[List[str]] = None):
+        assert mode in ("append", "upsert"), mode
+        self.store = store
+        self.mode = mode
+        self.field_names = field_names
+
+    # -- writer side ----------------------------------------------------
+    def stage(self, epoch: int, writer: int,
+              records: List[bytes]) -> dict:
+        """Durably stage one writer's epoch payload (atomic PUT).
+        Empty payloads stage nothing — the listing-driven commit does
+        not require a segment per writer. Returns the pre-commit
+        handle (telemetry only; commit never depends on it)."""
+        if not records:
+            return {"epoch": epoch, "writer": writer, "rows": 0,
+                    "bytes": 0, "key": None}
+        data = b"".join(r + b"\n" for r in records)
+        # the SIGKILL-mid-stage chaos window: rows are folded and
+        # serialized but NOT yet durable while this point sleeps
+        fail_point("sink.stage.mid")
+        key = seg_key(epoch, writer)
+        self.store.upload(key, data)
+        return {"epoch": epoch, "writer": writer,
+                "rows": len(records), "bytes": len(data), "key": key}
+
+    # -- coordinator side -----------------------------------------------
+    def committed_epoch(self) -> int:
+        ms = self.store.list(MANIFEST_PREFIX)
+        best = 0
+        for m in ms:
+            name = m[len(MANIFEST_PREFIX):]
+            if name.endswith(".json"):
+                try:
+                    best = max(best, int(name[:-len(".json")], 16))
+                except ValueError:
+                    pass
+        return best
+
+    def staged_epochs(self) -> Dict[int, List[Tuple[int, str]]]:
+        """epoch → [(writer, key)] for every staged segment (garbage
+        keys — torn tmp files — excluded; ``recover`` sweeps them)."""
+        out: Dict[int, List[Tuple[int, str]]] = {}
+        for key in self.store.list(SEG_PREFIX):
+            parsed = _parse_seg_key(key)
+            if parsed is not None:
+                out.setdefault(parsed[0], []).append((parsed[1], key))
+        return out
+
+    def uncommitted_epochs(self) -> Dict[int, List[Tuple[int, str]]]:
+        return {e: segs for e, segs in self.staged_epochs().items()
+                if not self.store.exists(manifest_key(e))}
+
+    def commit(self, epoch: int, segs: List[Tuple[int, str]]) -> dict:
+        """The ONE serialized commit decision: write the epoch's
+        manifest from the staged listing (atomic PUT; idempotent —
+        re-deriving from the same durable listing yields the same
+        manifest, and existence is checked first)."""
+        mkey = manifest_key(epoch)
+        if self.store.exists(mkey):
+            return json.loads(self.store.read(mkey).decode())
+        manifest = {"epoch": epoch, "mode": self.mode,
+                    "segments": [
+                        {"writer": w, "key": k,
+                         "bytes": self.store.size(k)}
+                        for w, k in sorted(segs)]}
+        # the storage-fault-during-commit chaos point: an epoch whose
+        # manifest PUT fails stays invisible until recovery re-derives
+        # and re-PUTs it from the (durable) staging listing
+        fail_point("sink.manifest_commit")
+        self.store.upload(mkey, json.dumps(
+            manifest, sort_keys=True).encode())
+        return manifest
+
+    def commit_upto(self, floor: int) -> List[int]:
+        """Commit every staged-but-unmanifested epoch ≤ the checkpoint
+        floor (invariant 1: never past the floor). Listing-driven:
+        robust to lost pre-commit drains and to zero-row writers."""
+        done = []
+        for epoch, segs in sorted(self.uncommitted_epochs().items()):
+            if epoch <= floor:
+                self.commit(epoch, segs)
+                done.append(epoch)
+        return done
+
+    def recover(self, floor: int) -> Tuple[List[int], List[int]]:
+        """Post-crash reconciliation: PROMOTE unmanifested epochs ≤
+        floor (their staging is provably complete — invariant 2),
+        TRUNCATE epochs > floor (their rows replay under fresh
+        epochs), and sweep torn tmp garbage. Idempotent."""
+        promoted, truncated = [], []
+        staged = self.staged_epochs()
+        known = {k for segs in staged.values() for _w, k in segs}
+        for key in self.store.list(SEG_PREFIX):
+            if key not in known:
+                self.store.delete(key)      # mkstemp residue
+        for epoch, segs in sorted(staged.items()):
+            if self.store.exists(manifest_key(epoch)):
+                continue
+            if epoch <= floor:
+                self.commit(epoch, segs)
+                promoted.append(epoch)
+            else:
+                for _w, key in segs:
+                    self.store.delete(key)
+                truncated.append(epoch)
+        return promoted, truncated
+
+    # -- read surface -----------------------------------------------------
+    def manifests(self) -> List[dict]:
+        out = []
+        for key in sorted(self.store.list(MANIFEST_PREFIX)):
+            out.append(json.loads(self.store.read(key).decode()))
+        return sorted(out, key=lambda m: m["epoch"])
+
+    def committed_records(self):
+        """Yield decoded records of every committed epoch in commit
+        order (within an epoch: writer order — writers hold disjoint
+        key partitions, so the order is not load-bearing)."""
+        for m in self.manifests():
+            for seg in m["segments"]:
+                data = self.store.read(seg["key"])
+                for line in data.splitlines():
+                    if line:
+                        yield json.loads(line.decode())
+
+    def canonical_rows(self) -> List[str]:
+        """The canonical (replay-invariant) content view. Epoch
+        numbering is an artifact of one execution — a recovered run
+        re-stages replayed rows under fresh epochs — so bit-identity
+        across runs is defined on this view, not on raw manifests:
+        append → every committed record, sorted; upsert → the folded
+        final key→row state, sorted by key."""
+        if self.mode == "append":
+            return sorted(json.dumps(r, sort_keys=True)
+                          for r in self.committed_records())
+        state: Dict[str, dict] = {}
+        for r in self.committed_records():
+            k = json.dumps(r.get("__k"), sort_keys=True)
+            if r.get("__op") == "D":
+                state.pop(k, None)
+            else:
+                state[k] = r
+        return [json.dumps(state[k], sort_keys=True)
+                for k in sorted(state)]
+
+    def canonical_bytes(self) -> bytes:
+        return "\n".join(self.canonical_rows()).encode()
+
+
+class AppendSegmentSink:
+    """Append-only record encoder over an EpochSegmentTarget: inserts
+    serialize 1:1; a retraction reaching this sink is a planner bug
+    (the mode was PROVEN append-only), never silently dropped."""
+
+    mode = "append"
+
+    def __init__(self, target: EpochSegmentTarget):
+        self.target = target
+
+    def encode(self, records) -> List[bytes]:
+        names = self.target.field_names
+        out = []
+        for op, row in records:
+            if not op.is_insert:
+                raise RuntimeError(
+                    "retraction reached an append-only sink — the "
+                    "append-only derivation admitted a retracting "
+                    "plan")
+            obj = {"__op": "I"}
+            for i, v in enumerate(row):
+                obj[names[i] if names else f"f{i}"] = _jsonable(v)
+            out.append(json.dumps(obj, sort_keys=True).encode())
+        return out
+
+    def stage(self, epoch: int, writer: int, records) -> dict:
+        return self.target.stage(epoch, writer, self.encode(records))
+
+
+class UpsertSegmentSink:
+    """Keyed upsert encoder: retractions FOLD per key within the
+    epoch (last write wins; a surviving delete is a tombstone), so
+    the staged segment carries one record per touched key."""
+
+    mode = "upsert"
+
+    def __init__(self, target: EpochSegmentTarget,
+                 pk_indices: List[int]):
+        assert pk_indices, "upsert sink needs a primary key"
+        self.target = target
+        self.pk_indices = list(pk_indices)
+
+    def encode(self, records) -> List[bytes]:
+        names = self.target.field_names
+        folded: "Dict[tuple, Tuple[str, tuple]]" = {}
+        for op, row in records:
+            key = tuple(row[i] for i in self.pk_indices)
+            folded[key] = ("U" if op.is_insert else "D", row)
+        out = []
+        for key in sorted(folded, key=lambda k: json.dumps(
+                _jsonable(list(k)), sort_keys=True)):
+            kind, row = folded[key]
+            obj = {"__op": kind, "__k": _jsonable(list(key))}
+            if kind == "U":
+                for i, v in enumerate(row):
+                    obj[names[i] if names else f"f{i}"] = _jsonable(v)
+            out.append(json.dumps(obj, sort_keys=True).encode())
+        return out
+
+    def stage(self, epoch: int, writer: int, records) -> dict:
+        return self.target.stage(epoch, writer, self.encode(records))
+
+
+def make_sink_target(options: Dict[str, str], mode: str,
+                     field_names: Optional[List[str]] = None
+                     ) -> EpochSegmentTarget:
+    """connector='epochlog' → EpochSegmentTarget over a local-FS
+    object store at ``path`` (atomic temp+rename PUTs — the staging
+    and manifest protocol requires atomic publication)."""
+    from risingwave_tpu.storage.object_store import LocalFsObjectStore
+    path = options.get("path")
+    if not path:
+        raise ValueError("epochlog sink needs path='...'")
+    import os
+    _TOUCHED_ROOTS.add(os.path.abspath(path))
+    return EpochSegmentTarget(LocalFsObjectStore(path), mode=mode,
+                              field_names=field_names)

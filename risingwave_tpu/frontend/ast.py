@@ -170,6 +170,16 @@ class CreateSink:
     name: str
     select: Select
     options: Dict[str, str]
+    # CREATE SINK ... FROM <mv> sugar: the select above is the
+    # synthesized SELECT * FROM <mv>; the name is kept for catalog
+    # dependency tracking and mode derivation off the MV's own
+    # append-only proof
+    from_mv: Optional[str] = None
+    # AS APPEND-ONLY asserted by the user: the planner must PROVE the
+    # input append-only or refuse (force='true' in options overrides —
+    # retractions then fail loudly at the sink, never silently drop).
+    # None = derive the mode automatically
+    append_only: Optional[bool] = None
 
 
 @dataclass

@@ -41,6 +41,10 @@ class MvCatalog:
     # CREATE TABLE jobs share this registry; system catalogs and SHOW
     # split on it
     is_table: bool = False
+    # planner-proved append-only changelog (no retractions ever):
+    # sinks chained FROM this MV derive their mode from this proof
+    # without re-walking the MV's executor tree
+    append_only: bool = False
 
     @property
     def visible_schema(self) -> Schema:
@@ -56,6 +60,11 @@ class SinkCatalog:
     options: Dict[str, str]
     definition: str = ""
     dependent_sources: List[str] = field(default_factory=list)
+    # exactly-once epoch-segment sinks (connectors/sink.py): the
+    # derived record mode and writer count, kept so ctl/rw_sinks can
+    # rebuild the target from options without replanning
+    mode: str = ""               # "append" | "upsert" | "" (legacy)
+    n_writers: int = 1
 
 
 class Catalog:

@@ -514,6 +514,23 @@ class Fragmenter:
                 "keys": list(ex.dedup_indices),
                 "table_id": ex.state.table_id})
             return fi, ni
+        from risingwave_tpu.stream.executors.sink import (
+            CoordinatedSinkExecutor,
+        )
+        if isinstance(ex, CoordinatedSinkExecutor):
+            # terminal sink writer: colocated with its input (NoShuffle,
+            # like Materialize) — each parallel actor is one of N
+            # writers staging its slice per epoch; the scheduler stamps
+            # writer=rank and n_writers=parallelism per actor, and the
+            # coordinator (meta side) commits from the listing
+            fi, ci = self._lower(ex.input)
+            ni = self._append(fi, {
+                "op": "sink", "input": ci,
+                "sink_name": ex.sink_name,
+                "mode": ex.encoder.mode,
+                "path": ex.encoder.target.store.root,
+                "pk": list(getattr(ex.encoder, "pk_indices", []))})
+            return fi, ni
         if isinstance(ex, MaterializeExecutor):
             fi, ci = self._lower(ex.input)
             node = {

@@ -209,8 +209,34 @@ class Parser:
                 name, sel, emit_on_window_close=eowc)
         if self._kw("create", "sink"):
             name = self._ident()
-            self._expect_kw("as")
-            sel = self._select()
+            from_mv = None
+            append_only = None
+            if self._kw("from"):
+                # CREATE SINK s FROM mv [AS APPEND-ONLY] WITH (...) —
+                # sugar for SELECT * FROM mv; the MV name is kept so
+                # the planner can derive the mode from the MV's own
+                # append-only proof
+                from_mv = self._ident()
+                sel = ast.Select(
+                    projections=[(ast.ColRef("*"), None)],
+                    from_item=ast.TableRef(from_mv))
+                if self._kw("as"):
+                    # "append"/"only" are plain idents; the hyphen in
+                    # APPEND-ONLY is an op token (APPEND ONLY also
+                    # accepted)
+                    kind, text = self._next()
+                    if kind != "ident" or text.lower() != "append":
+                        raise ParseError(
+                            f"expected APPEND-ONLY, got {text!r}")
+                    self._op("-")
+                    kind, text = self._next()
+                    if kind != "ident" or text.lower() != "only":
+                        raise ParseError(
+                            f"expected APPEND-ONLY, got {text!r}")
+                    append_only = True
+            else:
+                self._expect_kw("as")
+                sel = self._select()
             self._expect_kw("with")
             self._expect_op("(")
             options = {}
@@ -225,7 +251,9 @@ class Parser:
                 if not self._op(","):
                     break
             self._expect_op(")")
-            return ast.CreateSink(name, sel, options)
+            return ast.CreateSink(name, sel, options,
+                                  from_mv=from_mv,
+                                  append_only=append_only)
         if self._kw("drop", "sink"):
             if_exists = self._kw("if", "exists")
             return ast.DropSink(self._ident(), if_exists)

@@ -61,6 +61,12 @@ class Frontend:
         self._epoch_pipeline = bool(epoch_pipeline)
         self._plane = None
         self._legacy_loop = None
+        # exactly-once sinks (ISSUE 20): ONE coordinator per frontend
+        # (= per barrier engine) — its commit authority is THIS
+        # engine's checkpoint floor, so two frontends in one process
+        # (oracle arm beside arm under test) never cross-commit
+        from risingwave_tpu.meta.sink_coordinator import SinkCoordinator
+        self.sinks = SinkCoordinator()
         self._rebuild_barrier_engine()
         self.actors: Dict[int, Actor] = {}
         self.tasks: Dict[int, asyncio.Task] = {}
@@ -208,6 +214,9 @@ class Frontend:
             self._legacy_loop = BarrierLoop(self.local, self.store,
                                             checkpoint_frequency=freq)
             self._plane = None
+        # sink staging/commit ride the engine's checkpoint pipeline:
+        # stage before the floor's durable commit, manifest after it
+        self.loop.uploader.sinks = self.sinks
 
     @property
     def loop(self):
@@ -1188,12 +1197,12 @@ class Frontend:
 
     async def _create_sink(self, stmt: ast.CreateSink) -> str:
         from risingwave_tpu.frontend.catalog import SinkCatalog
-        from risingwave_tpu.frontend.planner import make_sink_writer
+        from risingwave_tpu.frontend.planner import validate_sink_options
         # validate BEFORE planning registers any barrier sender: a
         # planner failure after registration would orphan the channel
         # and wedge every later barrier once its permits run out
         self.catalog._check_free(stmt.name)
-        make_sink_writer(stmt.options)
+        validate_sink_options(stmt.options)
         async with self._barrier_lock:
             planner = StreamPlanner(self.catalog, self.store, self.local,
                                     definition="", mesh=self.mesh,
@@ -1208,7 +1217,10 @@ class Frontend:
                 plan = planner.plan_sink(
                     stmt.select, stmt.options, actor_id,
                     rate_limit=self.rate_limit,
-                    min_chunks=self.min_chunks)
+                    min_chunks=self.min_chunks,
+                    sink_name=stmt.name,
+                    append_only=stmt.append_only,
+                    coordinator=self.sinks)
                 from risingwave_tpu.frontend.opt import (
                     apply_rewrites, parse_fusion,
                 )
@@ -1222,15 +1234,34 @@ class Frontend:
                 for sid in planner.registered_senders:
                     self.local.drop_actor(sid)
                 raise
-            await self._deploy_job(
-                stmt.name, actor_id, plan.consumer, plan.readers,
-                lambda: self.catalog.add_sink(SinkCatalog(
-                    stmt.name, actor_id, dict(stmt.options),
-                    dependent_sources=plan.deps)),
-                attaches=plan.attaches, deps=plan.deps)
+            if plan.encoder is not None:
+                # register only after the WHOLE plan validated. Fresh
+                # create: truncate any uncommitted staging leftover at
+                # the path (floor=-1 promotes nothing). Recovery
+                # replay: sweep against the recovered checkpoint floor
+                # — staged epochs the floor covers are durable
+                # upstream, so the sweep PROMOTES them (completes the
+                # manifest); younger staging truncates and replays
+                self.sinks.register(
+                    stmt.name, plan.encoder, n_writers=1,
+                    deferred=True,
+                    floor=(self.store.committed_epoch()
+                           if self._replaying else -1))
+            try:
+                await self._deploy_job(
+                    stmt.name, actor_id, plan.consumer, plan.readers,
+                    lambda: self.catalog.add_sink(SinkCatalog(
+                        stmt.name, actor_id, dict(stmt.options),
+                        dependent_sources=plan.deps, mode=plan.mode,
+                        n_writers=1)),
+                    attaches=plan.attaches, deps=plan.deps)
+            except BaseException:
+                self.sinks.unregister(stmt.name)
+                raise
         if self._deployed_actor.failure is not None:
             from risingwave_tpu.stream.costs import purge_mv_series
             purge_mv_series(stmt.name)
+            self.sinks.unregister(stmt.name)
             raise self._deployed_actor.failure
         return "CREATE_SINK"
 
@@ -1288,6 +1319,11 @@ class Frontend:
         async with self._barrier_lock:
             actor = await self._stop_job(name, entry.actor_id)
         del registry[name]
+        # epoch-segment sinks: deregister from the coordinator —
+        # committed manifests stay durable at the path; any pending
+        # (non-checkpointed) tail is dropped with the registration,
+        # consistent with manifests never outrunning the floor
+        self.sinks.unregister(name)
         self._mv_selects.pop(name, None)
         self._mv_rules.pop(name, None)
         self._mv_fusion.pop(name, None)

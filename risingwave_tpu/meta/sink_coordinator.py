@@ -1,0 +1,227 @@
+"""Meta-side sink coordinator: epoch-aligned N-writer commits.
+
+Reference parity: src/meta/src/manager/sink_coordination/ — the
+coordinator that collects N sink writers' pre-commit metadata for a
+checkpoint epoch and performs the single serialized commit. Here the
+commit decision is LISTING-DRIVEN (connectors/sink.py): the
+coordinator commits every staged-but-unmanifested epoch ≤ the
+checkpoint floor, so pre-commit handles are pure telemetry — a lost
+drain can delay nothing and lose nothing, and zero-row writers (which
+stage no segment) need no special case.
+
+One SinkCoordinator per barrier-engine owner (the in-process Frontend,
+the cluster coordinator) — NOT process-global: commit authority is
+"this engine's checkpoint floor", and two engines in one process (the
+oracle arm beside the arm under test) must not commit each other's
+sinks with each other's floors. The owner attaches the coordinator to
+its CheckpointUploader (``uploader.sinks``), which calls:
+
+  ``stage_upto(epoch)``  after the epoch's SST uploads, BEFORE the
+                         durable commit — staging rides the async
+                         upload tail (never barrier_wait), and the
+                         floor can only advance past fully-staged
+                         epochs (invariant 2 of connectors/sink.py);
+  ``commit_upto(floor)`` after the durable commit — manifests land
+                         strictly behind the floor (invariant 1).
+
+In-process pipelines run writers in DEFERRED mode: the executor hands
+its epoch payload (raw records) to ``submit`` at barrier passage — a
+cheap list append — and serialization + staging happen in the
+uploader's stage hook off the barrier path. Distributed workers run
+INLINE: each writer stages synchronously at barrier passage in its own
+process (before its barrier is collected, so collection ⟹ staged ⟹
+the coordinator floor covers only durable staging), and the
+coordinator process registers the sink for the commit/recovery half
+only.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional
+
+from risingwave_tpu.utils.metrics import STREAMING as _METRICS
+
+
+def note_staged(sink: str, mode: str, rows: int, nbytes: int) -> None:
+    """Metric taps shared by both staging paths (deferred coordinator
+    staging and inline worker staging)."""
+    if rows:
+        _METRICS.sink_rows_total.inc(rows, sink=sink, mode=mode)
+    if nbytes:
+        _METRICS.sink_staged_bytes.inc(nbytes, sink=sink)
+
+
+class _Sink:
+    __slots__ = ("name", "encoder", "n_writers", "deferred",
+                 "pending", "precommits", "committed")
+
+    def __init__(self, name, encoder, n_writers, deferred):
+        self.name = name
+        self.encoder = encoder              # Append/UpsertSegmentSink
+        self.n_writers = int(n_writers)
+        self.deferred = bool(deferred)
+        # deferred payloads: (epoch, writer, records) in submit order
+        self.pending: List[tuple] = []
+        # epoch → {writer: handle} — telemetry only, never authority
+        self.precommits: Dict[int, Dict[int, dict]] = {}
+        self.committed = 0
+
+    @property
+    def target(self):
+        return self.encoder.target
+
+
+class SinkCoordinator:
+    """Collects pre-commits, stages deferred payloads, and owns the
+    manifest commit + recovery truncation for every registered sink
+    of ONE barrier engine."""
+
+    def __init__(self) -> None:
+        self._sinks: Dict[str, _Sink] = {}
+
+    # -- registration -----------------------------------------------------
+    def register(self, name: str, encoder, n_writers: int = 1,
+                 deferred: bool = True,
+                 floor: Optional[int] = None) -> None:
+        """Register (or re-register after recovery — pending payloads
+        of the dead generation drop). With a floor, run the recovery
+        sweep immediately: promote ≤ floor, truncate the rest."""
+        self._sinks[name] = _Sink(name, encoder, n_writers, deferred)
+        if floor is not None:
+            self.recover(floor, only=name)
+
+    def unregister(self, name: str) -> None:
+        self._sinks.pop(name, None)
+
+    def names(self) -> List[str]:
+        return sorted(self._sinks)
+
+    def sink(self, name: str) -> Optional[_Sink]:
+        return self._sinks.get(name)
+
+    # -- writer side (deferred mode) --------------------------------------
+    def submit(self, name: str, epoch: int, writer: int,
+               records: list) -> None:
+        """Buffer one writer's epoch payload at barrier passage (raw
+        records; encoding happens in the stage hook, off the barrier
+        path)."""
+        s = self._sinks[name]
+        assert s.deferred, "inline writers stage directly"
+        s.pending.append((epoch, writer, records))
+
+    def note_precommit(self, name: str, epoch: int,
+                       handle: dict) -> None:
+        s = self._sinks.get(name)
+        if s is not None:
+            s.precommits.setdefault(epoch, {})[
+                handle.get("writer", 0)] = handle
+
+    # -- the uploader hooks -----------------------------------------------
+    def _take_pending(self, epoch: int):
+        work = []
+        for s in self._sinks.values():
+            if not s.deferred or not s.pending:
+                continue
+            take = [p for p in s.pending if p[0] <= epoch]
+            if take:
+                s.pending = [p for p in s.pending if p[0] > epoch]
+                work.append((s, take))
+        return work
+
+    def _stage_one(self, s: _Sink, epoch: int, writer: int,
+                   records: list) -> dict:
+        handle = s.encoder.stage(epoch, writer, records)
+        note_staged(s.name, s.encoder.mode, handle["rows"],
+                    handle["bytes"])
+        return handle
+
+    def stage_upto_sync(self, epoch: int) -> None:
+        """Inline fallback (memory stores, the coordinator epoch
+        shim): stage every pending payload ≤ epoch before the store's
+        durable sync."""
+        for s, take in self._take_pending(epoch):
+            for e, w, recs in take:
+                self.note_precommit(s.name, e,
+                                    self._stage_one(s, e, w, recs))
+
+    async def stage_upto(self, epoch: int) -> None:
+        """Split-path hook: stage concurrently via worker threads —
+        serialization and PUTs land in the ledger's async upload
+        tail, never in barrier_wait."""
+        work = [(s, e, w, recs)
+                for s, take in self._take_pending(epoch)
+                for e, w, recs in take]
+        if not work:
+            return
+        handles = await asyncio.gather(
+            *(asyncio.to_thread(self._stage_one, s, e, w, recs)
+              for s, e, w, recs in work))
+        for (s, e, _w, _r), h in zip(work, handles):
+            self.note_precommit(s.name, e, h)
+
+    def commit_upto(self, floor: int) -> Dict[str, List[int]]:
+        """Manifest-commit every sink's staged epochs ≤ floor (the
+        checkpoint floor just made durable). Raises on manifest-PUT
+        failure — the barrier round fails and supervised recovery
+        re-derives the commit from the staged listing."""
+        out = {}
+        for s in self._sinks.values():
+            done = s.target.commit_upto(floor)
+            if done:
+                out[s.name] = done
+                s.committed = max(s.committed, done[-1])
+                _METRICS.sink_committed_epoch.set(
+                    s.committed, sink=s.name)
+                for e in done:
+                    s.precommits.pop(e, None)
+        return out
+
+    # -- recovery ---------------------------------------------------------
+    def recover(self, floor: int,
+                only: Optional[str] = None) -> Dict[str, tuple]:
+        """Post-crash sweep for every registered sink: drop dead
+        in-memory payloads, promote staged epochs ≤ floor, truncate
+        the rest (connectors/sink.py recovery rule)."""
+        out = {}
+        for s in self._sinks.values():
+            if only is not None and s.name != only:
+                continue
+            s.pending = []
+            s.precommits = {}
+            promoted, truncated = s.target.recover(floor)
+            s.committed = s.target.committed_epoch()
+            _METRICS.sink_committed_epoch.set(s.committed, sink=s.name)
+            out[s.name] = (promoted, truncated)
+        return out
+
+    # -- telemetry --------------------------------------------------------
+    def snapshot(self) -> List[dict]:
+        """Per-sink serving view (ctl sinks / rw_sinks): committed
+        epoch, staged-but-uncommitted bytes, and writer lag at the
+        newest uncommitted epoch."""
+        out = []
+        for name in sorted(self._sinks):
+            s = self._sinks[name]
+            out.append(sink_stats(s.target, s.n_writers,
+                                  name=name, mode=s.encoder.mode))
+        return out
+
+
+def sink_stats(target, n_writers: int, name: str = "",
+               mode: str = "") -> dict:
+    """Listing-driven stats for one EpochSegmentTarget — usable from
+    any process that can list the sink's store (the rw_sinks system
+    table rebuilds targets from catalog options with this)."""
+    staged = target.uncommitted_epochs()
+    staged_bytes = sum(target.store.size(k)
+                      for segs in staged.values() for _w, k in segs)
+    lag = 0
+    if staged:
+        newest = max(staged)
+        lag = max(0, int(n_writers) - len(staged[newest]))
+    return {"name": name, "mode": mode or target.mode,
+            "committed_epoch": target.committed_epoch(),
+            "staged_epochs": len(staged),
+            "staged_bytes": staged_bytes,
+            "writer_lag": lag}

@@ -82,6 +82,14 @@ class CheckpointUploader:
         self.commit_log: Deque[int] = deque(maxlen=1 << 16)
         self.failed = asyncio.Event()        # set on terminal failure
         self._failure: Optional[BaseException] = None
+        # exactly-once sinks (meta/sink_coordinator.py): the owner of
+        # this uploader attaches its SinkCoordinator here. Deferred
+        # sink payloads stage in the epoch's async tail BEFORE the
+        # durable commit (the floor never advances past unstaged
+        # rows), and manifests commit strictly AFTER it (a manifest
+        # never outruns the floor) — the two crash-window invariants
+        # of connectors/sink.py live in this ordering
+        self.sinks = None
 
     # -- introspection ----------------------------------------------------
     @property
@@ -135,8 +143,12 @@ class CheckpointUploader:
             return False
         if not self._split:
             t0 = self.monotonic()
+            if self.sinks is not None:
+                self.sinks.stage_upto_sync(epoch)
             self.store.sync(epoch)
             self._note_commit(epoch, self.monotonic() - t0)
+            if self.sinks is not None:
+                self.sinks.commit_upto(epoch)
             return True
         while len(self._tasks) >= self.max_uploading:
             await asyncio.wait({next(iter(self._tasks.values()))})
@@ -178,12 +190,20 @@ class CheckpointUploader:
                     built.set_result(None)
             for p in payloads:
                 await self._upload(p)
+            if self.sinks is not None:
+                # sink staging is part of the epoch's durability set:
+                # it must land before the commit below advances the
+                # floor, and it rides the same async tail the SST
+                # uploads do (upload_s, never barrier_wait)
+                await self.sinks.stage_upto(epoch)
             if prev_committed is not None:
                 await prev_committed
             if self._failure is not None:
                 raise self._failure      # NEVER commit past a failure
             self.store.commit_ssts(epoch, payloads)
             self._note_commit(epoch, self.monotonic() - t0)
+            if self.sinks is not None:
+                await asyncio.to_thread(self.sinks.commit_upto, epoch)
         except asyncio.CancelledError:
             raise
         except BaseException as e:  # noqa: BLE001 — recorded, not lost

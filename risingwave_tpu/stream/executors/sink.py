@@ -221,6 +221,84 @@ def _jsonable(v):
     return str(v)                           # Decimal and friends
 
 
+class CoordinatedSinkExecutor(Executor):
+    """One of N exactly-once sink writers (ISSUE 20).
+
+    Buffers the interval's delta records and, at every CHECKPOINT
+    barrier, hands the payload off under ``epoch = msg.epoch.prev``
+    (the epoch that just ended — the one this checkpoint makes
+    durable upstream). Two handoff modes:
+
+      deferred (in-process; ``coordinator`` set) — the payload goes
+        to the engine's SinkCoordinator as a cheap list append;
+        encoding + staging run in the checkpoint uploader's async
+        tail, so the barrier path never carries serialization cost.
+      inline (worker processes; no coordinator) — the writer stages
+        synchronously BEFORE its barrier is collected, so the
+        cross-process floor can only advance past durable staging;
+        the meta-side coordinator commits manifests from the listing.
+
+    The executor is deliberately STATELESS: visibility is manifest-
+    existence, staged epochs above the recovery floor are truncated,
+    and replayed rows re-stage under fresh epochs — exactly-once
+    needs no durable counter here. A STOP that is not a checkpoint
+    discards the buffer: those rows are not durable upstream, and a
+    manifest may never outrun the checkpoint floor."""
+
+    def __init__(self, input_: Executor, sink_name: str, encoder,
+                 writer: int = 0, n_writers: int = 1,
+                 coordinator=None):
+        super().__init__(ExecutorInfo(
+            input_.schema, list(input_.pk_indices),
+            f"CoordinatedSink({sink_name})"))
+        self.input = input_
+        self.sink_name = sink_name
+        self.encoder = encoder          # Append/UpsertSegmentSink
+        self.writer = int(writer)
+        self.n_writers = int(n_writers)
+        self.coordinator = coordinator
+        self._pending: List[Tuple[Op, tuple]] = []
+        if getattr(encoder.target, "field_names", None) is None:
+            encoder.target.field_names = [
+                f.name for f in input_.schema]
+
+    def _stage(self, epoch: int) -> None:
+        from risingwave_tpu.meta.sink_coordinator import note_staged
+        if not self._pending:
+            return
+        if self.coordinator is not None:
+            self.coordinator.submit(self.sink_name, epoch,
+                                    self.writer, self._pending)
+        else:
+            handle = self.encoder.stage(epoch, self.writer,
+                                        self._pending)
+            note_staged(self.sink_name, self.encoder.mode,
+                        handle["rows"], handle["bytes"])
+        self._pending = []
+
+    async def execute(self) -> AsyncIterator[Message]:
+        it = self.input.execute()
+        first = await it.__anext__()
+        assert is_barrier(first)
+        yield first
+        async for msg in it:
+            if is_chunk(msg):
+                self._pending.extend(msg.to_records())
+            elif is_barrier(msg):
+                if msg.kind.is_checkpoint:
+                    # the epoch that just ENDED: durable upstream once
+                    # this checkpoint commits — the manifest follows
+                    # the floor, never leads it
+                    self._stage(msg.epoch.prev.value)
+                elif isinstance(msg.mutation, StopMutation):
+                    # graceful stop without a checkpoint: the buffer
+                    # is not durable upstream; committing it would let
+                    # a manifest outrun the floor (rescale stops use
+                    # force_checkpoint and never reach this branch)
+                    self._pending = []
+            yield msg
+
+
 class FilelogSink:
     """EXACTLY-ONCE external sink: one immutable segment per
     checkpoint epoch, published by atomic rename.

@@ -437,6 +437,29 @@ def build_fragment(nodes: List[dict], store, local,
                                 if dist else None))
             ex = MaterializeExecutor(child, mv,
                                      mv_name=node.get("mv_name", ""))
+        elif op == "sink":
+            from risingwave_tpu.connectors.sink import (
+                AppendSegmentSink, UpsertSegmentSink, make_sink_target,
+            )
+            from risingwave_tpu.stream.executors.sink import (
+                CoordinatedSinkExecutor,
+            )
+            child = built[node["input"]]
+            names = [f.name for f in child.schema]
+            target = make_sink_target({"path": node["path"]},
+                                      node["mode"], names)
+            enc = (AppendSegmentSink(target)
+                   if node["mode"] == "append"
+                   else UpsertSegmentSink(
+                       target, [int(i) for i in node.get("pk", [])]))
+            # INLINE mode (no coordinator): the worker stages
+            # synchronously at barrier passage, BEFORE the barrier is
+            # collected — the meta-side floor then only ever covers
+            # durable staging; manifests are the coordinator's job
+            ex = CoordinatedSinkExecutor(
+                child, node["sink_name"], enc,
+                writer=int(node.get("writer", 0)),
+                n_writers=int(node.get("n_writers", 1)))
         elif op == "hash_agg":
             child = built[node["input"]]
             calls = [AggCall(AggKind(c["kind"]),

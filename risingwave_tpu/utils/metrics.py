@@ -426,6 +426,21 @@ class StreamingMetrics:
         self.uploader_queue_depth = r.gauge(
             "meta_checkpoint_uploader_queue_depth",
             "checkpoint epochs sealed but not yet durably committed")
+        # -- exactly-once sinks (meta/sink_coordinator.py) ------------
+        self.sink_committed_epoch = r.gauge(
+            "sink_committed_epoch",
+            "newest manifest-committed epoch per sink — visibility is "
+            "manifest-existence, so this IS the sink's read frontier")
+        self.sink_rows_total = r.counter(
+            "sink_rows_total",
+            "records durably staged per sink and mode (append|upsert; "
+            "upsert counts post-fold records — one per touched key "
+            "per epoch)")
+        self.sink_staged_bytes = r.counter(
+            "sink_staged_bytes",
+            "segment bytes durably staged per sink (committed and "
+            "not-yet-committed epochs both count; staging precedes "
+            "the checkpoint floor by design)")
         # -- epoch phase ledger (utils/ledger.py) ---------------------
         self.epoch_phase_seconds = r.counter(
             "stream_epoch_phase_seconds",

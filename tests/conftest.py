@@ -233,6 +233,42 @@ def _no_orphan_workers():
             "killed now to protect the rest of the suite)")
 
 
+@pytest.fixture(autouse=True)
+def _no_orphan_sink_staging():
+    """Tier-1 guard (ISSUE 20): a test that leaves staged-but-
+    unmanifested sink segments behind fails loudly — uncommitted
+    staging outliving its test is exactly the leakage the exactly-once
+    protocol forbids (a converged pipeline either commits an epoch's
+    segments or recovery truncates them). The guard also SWEEPS the
+    orphans so a later test reusing the path can't promote a dead
+    generation's rows."""
+    from risingwave_tpu.connectors import sink as _sink
+    _sink.reset_touched_roots()
+    yield
+    import os
+    leaked = {}
+    for root in _sink.touched_roots():
+        if not os.path.isdir(root):
+            continue                 # tmp_path already torn down
+        from risingwave_tpu.storage.object_store import (
+            LocalFsObjectStore,
+        )
+        target = _sink.EpochSegmentTarget(LocalFsObjectStore(root))
+        orphans = target.uncommitted_epochs()
+        if orphans:
+            # sweep before failing: floor=-1 truncates everything
+            # unmanifested, protecting the rest of the suite
+            target.recover(-1)
+            leaked[root] = sorted(hex(e) for e in orphans)
+    _sink.reset_touched_roots()
+    if leaked:
+        pytest.fail(
+            f"test leaked uncommitted sink staging {leaked} — every "
+            "epoch-segment sink must converge (commit or truncate) "
+            "before the test ends (orphans were swept now to protect "
+            "the rest of the suite)")
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     devs = jax.devices()

@@ -1,0 +1,495 @@
+"""Exactly-once epoch-segment sink subsystem (ISSUE 20): the
+stage/manifest visibility protocol, the recovery promote/truncate
+rule, the append-only derivation through chained and fused plans,
+SQL wiring (CREATE SINK ... FROM mv [AS APPEND-ONLY]), exactly-once
+across kill/recover, and the observability surface (rw_sinks, sink
+metric families, ctl sinks)."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.connectors.sink import (
+    AppendSegmentSink, EpochSegmentTarget, UpsertSegmentSink,
+    manifest_key, seg_key,
+)
+from risingwave_tpu.frontend.parser import ParseError, Parser
+from risingwave_tpu.frontend.planner import PlanError
+from risingwave_tpu.storage.object_store import MemObjectStore
+from risingwave_tpu.utils.failpoint import failpoints
+
+
+class _Op:
+    def __init__(self, insert):
+        self.is_insert = insert
+
+
+I, D = _Op(True), _Op(False)
+
+
+def _records(*rows, op=None):
+    return [(op or I, r) for r in rows]
+
+
+# -- target protocol (unit) ------------------------------------------------
+
+def test_stage_then_manifest_visibility():
+    """Staged segments are INVISIBLE until the epoch's manifest
+    exists; commit is listing-driven and idempotent."""
+    t = EpochSegmentTarget(MemObjectStore(), mode="append",
+                          field_names=["a"])
+    enc = AppendSegmentSink(t)
+    enc.stage(100, 0, _records((1,), (2,)))
+    enc.stage(100, 1, _records((3,)))
+    assert t.canonical_rows() == []          # no manifest yet
+    assert sorted(t.uncommitted_epochs()) == [100]
+    done = t.commit_upto(100)
+    assert done == [100]
+    assert len(t.canonical_rows()) == 3
+    # idempotent: a re-derived commit from the same listing is a no-op
+    m1 = t.manifests()
+    assert t.commit_upto(100) == []
+    assert t.manifests() == m1
+    # zero-row writers stage nothing and the commit does not wait for
+    # a segment per writer
+    enc.stage(200, 0, [])
+    enc.stage(200, 1, _records((4,)))
+    assert t.commit_upto(200) == [200]
+    assert len(t.manifests()[-1]["segments"]) == 1
+
+
+def test_commit_never_passes_the_floor():
+    t = EpochSegmentTarget(MemObjectStore(), field_names=["a"])
+    enc = AppendSegmentSink(t)
+    enc.stage(100, 0, _records((1,)))
+    enc.stage(200, 0, _records((2,)))
+    assert t.commit_upto(150) == [100]       # invariant 1
+    assert sorted(t.uncommitted_epochs()) == [200]
+    assert t.committed_epoch() == 100
+
+
+def test_recover_promotes_and_truncates():
+    """The recovery rule: floor ≥ E ⟹ staging of E is provably
+    complete (invariant 2), so unmanifested epochs ≤ floor PROMOTE;
+    epochs > floor TRUNCATE (their rows replay under fresh epochs);
+    torn tmp garbage sweeps."""
+    store = MemObjectStore()
+    t = EpochSegmentTarget(store, field_names=["a"])
+    enc = AppendSegmentSink(t)
+    enc.stage(100, 0, _records((1,)))
+    enc.stage(100, 1, _records((2,)))
+    t.commit_upto(100)
+    enc.stage(200, 0, _records((3,)))        # floor-covered, no manifest
+    enc.stage(300, 0, _records((9,)))        # past the floor: dead rows
+    store.upload("seg/garbage.tmp", b"torn") # mkstemp residue
+    promoted, truncated = t.recover(200)
+    assert (promoted, truncated) == ([200], [300])
+    assert not store.exists(seg_key(300, 0))
+    assert not store.exists("seg/garbage.tmp")
+    rows = [json.loads(r)["a"] for r in t.canonical_rows()]
+    assert sorted(rows) == [1, 2, 3]
+    # idempotent: a second sweep changes nothing
+    assert t.recover(200) == ([], [])
+    # fresh-create sweep (floor=-1): truncate EVERYTHING unmanifested
+    enc.stage(400, 0, _records((8,)))
+    assert t.recover(-1) == ([], [400])
+    assert sorted(rows) == [1, 2, 3]
+
+
+def test_manifest_commit_fault_then_promote():
+    """The storage-fault-during-commit chaos window, in miniature: a
+    manifest PUT that raises leaves the epoch INVISIBLE (staging
+    intact); recovery re-derives the same manifest from the durable
+    listing — no row lost, none duplicated."""
+    t = EpochSegmentTarget(MemObjectStore(), field_names=["a"])
+    enc = AppendSegmentSink(t)
+    enc.stage(100, 0, _records((1,), (2,)))
+    with failpoints({"sink.manifest_commit": {
+            "raise": "OSError", "times": 1}}):
+        with pytest.raises(OSError):
+            t.commit_upto(100)
+        assert t.canonical_rows() == []      # invisible, not torn
+        assert t.recover(100) == ([100], []) # promote from listing
+    assert len(t.canonical_rows()) == 2
+
+
+def test_kill_mid_stage_leaves_nothing_visible():
+    """The SIGKILL-mid-stage window: death between fold/serialize and
+    the atomic PUT stages nothing — recovery has nothing to see."""
+    t = EpochSegmentTarget(MemObjectStore(), field_names=["a"])
+    enc = AppendSegmentSink(t)
+    with failpoints({"sink.stage.mid": {
+            "raise": "OSError", "times": 1}}):
+        with pytest.raises(OSError):
+            enc.stage(100, 0, _records((1,)))
+    assert t.staged_epochs() == {}
+    assert t.recover(100) == ([], [])
+
+
+def test_upsert_fold_and_tombstones():
+    """Retractions fold per key within the epoch (last write wins); a
+    surviving delete is a tombstone that erases across epochs."""
+    t = EpochSegmentTarget(MemObjectStore(), mode="upsert",
+                          field_names=["k", "v"])
+    enc = UpsertSegmentSink(t, [0])
+    # epoch 1: insert k=1,v=10; update k=1 to v=11 (D then I); k=2
+    enc.stage(100, 0, [(I, (1, 10)), (D, (1, 10)), (I, (1, 11)),
+                       (I, (2, 20))])
+    t.commit_upto(100)
+    state = {json.loads(r)["k"]: json.loads(r)["v"]
+             for r in t.canonical_rows()}
+    assert state == {1: 11, 2: 20}
+    # epoch 2: delete k=2 — the tombstone survives the fold and erases
+    # the earlier epoch's row from the canonical view
+    enc.stage(200, 0, [(D, (2, 20))])
+    t.commit_upto(200)
+    state = {json.loads(r)["k"]: json.loads(r)["v"]
+             for r in t.canonical_rows()}
+    assert state == {1: 11}
+
+
+def test_append_sink_refuses_retractions():
+    t = EpochSegmentTarget(MemObjectStore(), field_names=["a"])
+    enc = AppendSegmentSink(t)
+    with pytest.raises(RuntimeError, match="append-only"):
+        enc.encode([(D, (1,))])
+
+
+# -- parser ----------------------------------------------------------------
+
+def test_parse_create_sink_from_mv():
+    for sql, ao in [
+        ("CREATE SINK s FROM mv WITH (connector='epochlog', "
+         "path='/x')", None),
+        ("CREATE SINK s FROM mv AS APPEND-ONLY WITH "
+         "(connector='epochlog', path='/x')", True),
+        ("CREATE SINK s FROM mv AS APPEND ONLY WITH "
+         "(connector='epochlog', path='/x')", True),
+    ]:
+        stmt = Parser(sql).parse()
+        assert stmt.from_mv == "mv"
+        assert stmt.append_only is ao
+        # the synthesized select is SELECT * FROM mv
+        assert stmt.select.from_item.name == "mv"
+    # legacy AS-select form still parses
+    stmt = Parser("CREATE SINK s AS SELECT a FROM t WITH "
+                  "(connector='blackhole')").parse()
+    assert stmt.from_mv is None
+    with pytest.raises(ParseError, match="APPEND-ONLY"):
+        Parser("CREATE SINK s FROM mv AS UPSERT WITH "
+               "(connector='epochlog', path='/x')").parse()
+
+
+# -- append-only derivation (satellite) ------------------------------------
+
+def test_derive_append_only_chain_hint_and_fused():
+    """_derive_append_only reads the chain-boundary hint (stamped from
+    MvCatalog.append_only) and looks THROUGH FusedFragmentExecutor
+    blocks — both without touching a live pipeline."""
+    from risingwave_tpu.frontend.planner import StreamPlanner
+    from risingwave_tpu.stream.executors.fused import (
+        FusedFragmentExecutor,
+    )
+
+    class _Hinted:
+        pass
+
+    h = _Hinted()
+    h.append_only_hint = True
+    assert StreamPlanner._derive_append_only(h) is True
+    h.append_only_hint = False
+    assert StreamPlanner._derive_append_only(h) is False
+    # a fused block is append-only iff its input is (the block
+    # composes only append-only-transparent stages)
+    h.append_only_hint = True
+    fused = FusedFragmentExecutor.__new__(FusedFragmentExecutor)
+    fused.input = h
+    assert StreamPlanner._derive_append_only(fused) is True
+    h.append_only_hint = False
+    assert StreamPlanner._derive_append_only(fused) is False
+    # unknown executors stay conservative
+    assert StreamPlanner._derive_append_only(object()) is False
+
+
+def test_sink_mode_derivation_multi_domain(tmp_path):
+    """Two disjoint source→MV domains plus a fused plan: each sink's
+    mode derives from ITS upstream MV's proof — a filter/project MV is
+    append-only (append mode), an agg MV retracts (upsert mode), and
+    AS APPEND-ONLY over the agg MV is refused unless forced."""
+    from risingwave_tpu.frontend import Frontend
+
+    async def run():
+        fe = Frontend(min_chunks=2)
+        await fe.execute("SET stream_fusion = 'on'")
+        await fe.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', "
+            "nexmark.table.type='bid', nexmark.event.num=500, "
+            "nexmark.max.chunk.size=128)")
+        await fe.execute(
+            "CREATE SOURCE bid2 WITH (connector='nexmark', "
+            "nexmark.table.type='bid', nexmark.event.num=500, "
+            "nexmark.max.chunk.size=128)")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW ao AS SELECT auction, price "
+            "FROM bid WHERE price > 100")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW agg AS SELECT auction, "
+            "count(*) AS c FROM bid2 GROUP BY auction")
+        assert fe.catalog.mvs["ao"].append_only is True
+        assert fe.catalog.mvs["agg"].append_only is False
+        await fe.execute(
+            f"CREATE SINK s_ao FROM ao WITH (connector='epochlog', "
+            f"path='{tmp_path / 'ao'}')")
+        await fe.execute(
+            f"CREATE SINK s_agg FROM agg WITH (connector='epochlog', "
+            f"path='{tmp_path / 'agg'}')")
+        assert fe.catalog.sinks["s_ao"].mode == "append"
+        assert fe.catalog.sinks["s_agg"].mode == "upsert"
+        # AS APPEND-ONLY must be PROVEN, not asserted
+        with pytest.raises(PlanError, match="append-only"):
+            await fe.execute(
+                f"CREATE SINK s_bad FROM agg AS APPEND-ONLY WITH "
+                f"(connector='epochlog', path='{tmp_path / 'bad'}')")
+        assert "s_bad" not in fe.catalog.sinks
+        assert "s_bad" not in fe.sinks.names()   # no leaked registration
+        # ... unless explicitly forced
+        await fe.execute(
+            f"CREATE SINK s_forced FROM agg AS APPEND-ONLY WITH "
+            f"(connector='epochlog', path='{tmp_path / 'forced'}', "
+            f"force='true')")
+        assert fe.catalog.sinks["s_forced"].mode == "append"
+        await fe.step(4)
+        await fe.close()
+
+    asyncio.run(run())
+
+
+# -- SQL end to end --------------------------------------------------------
+
+def _gen_bids_oracle(n):
+    from risingwave_tpu.connectors.nexmark import NexmarkConfig, gen_bids
+    cfg = NexmarkConfig(event_num=n, max_chunk_size=128)
+    return gen_bids(np.arange(n * 46 // 50, dtype=np.int64), cfg)
+
+
+def test_epoch_sink_exactly_once_across_restart(tmp_path):
+    """The in-process acceptance arm: CREATE SINK ... FROM mv AS
+    APPEND-ONLY, SIGKILL-style restart mid-stream (DDL replay +
+    recovery sweep), and the committed sink content equals the source
+    oracle — no row lost, none duplicated."""
+    from risingwave_tpu.frontend import Frontend
+    from risingwave_tpu.storage.hummock import HummockLite
+
+    out = str(tmp_path / "sink")
+    obj = MemObjectStore()
+    n = 3000
+
+    async def phase1():
+        fe = Frontend(HummockLite(obj), rate_limit=2, min_chunks=2)
+        await fe.execute(
+            f"CREATE SOURCE bid WITH (connector='nexmark', "
+            f"nexmark.table.type='bid', nexmark.event.num={n}, "
+            f"nexmark.max.chunk.size=128)")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW mb AS SELECT auction, price "
+            "FROM bid")
+        await fe.execute(
+            f"CREATE SINK s FROM mb AS APPEND-ONLY WITH "
+            f"(connector='epochlog', path='{out}')")
+        for _ in range(4):
+            await fe.step()
+        await fe.close()       # hard stop mid-stream
+
+    async def phase2():
+        fe = Frontend(HummockLite(obj), rate_limit=2, min_chunks=2)
+        await fe.recover()
+        for _ in range(30):
+            await fe.step()
+        await fe.close()
+
+    asyncio.run(phase1())
+    t_mid = EpochSegmentTarget.__new__(EpochSegmentTarget)
+    from risingwave_tpu.connectors.sink import make_sink_target
+    t_mid = make_sink_target({"path": out}, "append")
+    assert t_mid.committed_epoch() > 0, "phase 1 committed nothing"
+    asyncio.run(phase2())
+
+    t = make_sink_target({"path": out}, "append")
+    assert t.uncommitted_epochs() == {}
+    got = sorted((json.loads(r)["auction"], json.loads(r)["price"])
+                 for r in t.canonical_rows())
+    bids = _gen_bids_oracle(n)
+    want = sorted(zip(bids["auction"].tolist(),
+                      bids["price"].tolist()))
+    assert got == want
+
+
+def test_epoch_sink_upsert_sql_matches_mv(tmp_path):
+    """Upsert mode over an agg MV: the folded key→row state equals the
+    MV's own content (the group key is the visible stream key, so no
+    primary_key option is needed)."""
+    from risingwave_tpu.frontend import Frontend
+    from risingwave_tpu.connectors.sink import make_sink_target
+
+    out = str(tmp_path / "sink")
+
+    async def run():
+        fe = Frontend(min_chunks=2)
+        await fe.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', "
+            "nexmark.table.type='bid', nexmark.event.num=2000, "
+            "nexmark.max.chunk.size=128)")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW agg AS SELECT auction, "
+            "count(*) AS c FROM bid GROUP BY auction")
+        await fe.execute(
+            f"CREATE SINK s FROM agg WITH (connector='epochlog', "
+            f"path='{out}')")
+        await fe.step(25)
+        rows = await fe.execute("SELECT * FROM agg")
+        await fe.close()
+        return rows
+
+    mv_rows = asyncio.run(run())
+    t = make_sink_target({"path": out}, "upsert")
+    got = sorted((json.loads(r)["auction"], json.loads(r)["c"])
+                 for r in t.canonical_rows())
+    assert got == sorted((a, c) for a, c in mv_rows)
+
+
+def test_upsert_sink_needs_visible_or_named_key(tmp_path):
+    """An MV whose stream key is a hidden column cannot feed an upsert
+    sink implicitly — the planner demands primary_key='...'; naming a
+    visible column works, naming a missing one is refused."""
+    from risingwave_tpu.frontend import Frontend
+
+    async def run():
+        fe = Frontend(min_chunks=2)
+        await fe.execute("CREATE TABLE t (k BIGINT, v BIGINT)")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW mv AS SELECT k, v FROM t")
+        with pytest.raises(PlanError, match="primary_key"):
+            await fe.execute(
+                f"CREATE SINK s FROM mv WITH (connector='epochlog', "
+                f"path='{tmp_path / 'a'}')")
+        with pytest.raises(PlanError, match="not in sink schema"):
+            await fe.execute(
+                f"CREATE SINK s FROM mv WITH (connector='epochlog', "
+                f"path='{tmp_path / 'b'}', primary_key='zz')")
+        await fe.execute(
+            f"CREATE SINK s FROM mv WITH (connector='epochlog', "
+            f"path='{tmp_path / 'c'}', primary_key='k')")
+        await fe.execute("INSERT INTO t VALUES (1, 10), (2, 20)")
+        await fe.step(2)
+        await fe.execute("INSERT INTO t VALUES (1, 11)")
+        await fe.step(2)
+        await fe.close()
+
+    asyncio.run(run())
+    from risingwave_tpu.connectors.sink import make_sink_target
+    t = make_sink_target({"path": str(tmp_path / "c")}, "upsert")
+    state = {json.loads(r)["k"]: json.loads(r)["v"]
+             for r in t.canonical_rows()}
+    assert state == {1: 11, 2: 20}
+
+
+def test_drop_sink_unregisters(tmp_path):
+    from risingwave_tpu.frontend import Frontend
+
+    async def run():
+        fe = Frontend(min_chunks=2)
+        await fe.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', "
+            "nexmark.table.type='bid', nexmark.event.num=500, "
+            "nexmark.max.chunk.size=128)")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW mb AS SELECT auction, price "
+            "FROM bid")
+        await fe.execute(
+            f"CREATE SINK s FROM mb WITH (connector='epochlog', "
+            f"path='{tmp_path / 's'}', primary_key='auction')")
+        assert fe.sinks.names() == ["s"]
+        await fe.step(3)
+        await fe.execute("DROP SINK s")
+        assert fe.sinks.names() == []
+        assert "s" not in fe.catalog.sinks
+        await fe.step(2)          # checkpoints keep flowing sink-free
+        await fe.close()
+
+    asyncio.run(run())
+
+
+# -- observability ---------------------------------------------------------
+
+def test_rw_sinks_and_metric_families(tmp_path):
+    from risingwave_tpu.frontend import Frontend
+    from risingwave_tpu.utils.metrics import GLOBAL
+
+    out = str(tmp_path / "sink")
+
+    async def run():
+        fe = Frontend(min_chunks=2)
+        await fe.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', "
+            "nexmark.table.type='bid', nexmark.event.num=1000, "
+            "nexmark.max.chunk.size=128)")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW mb AS SELECT auction, price "
+            "FROM bid")
+        await fe.execute(
+            f"CREATE SINK s FROM mb AS APPEND-ONLY WITH "
+            f"(connector='epochlog', path='{out}')")
+        await fe.step(12)
+        rows = await fe.execute("SELECT * FROM rw_sinks")
+        await fe.close()
+        return rows
+
+    rows = asyncio.run(run())
+    assert len(rows) == 1
+    name, connector, mode, epoch, staged, nbytes, lag = rows[0]
+    assert (name, connector, mode) == ("s", "epochlog", "append")
+    assert epoch > 0
+    assert staged == 0 and lag == 0      # converged: all committed
+    text = GLOBAL.render()
+    for family in ("sink_committed_epoch", "sink_rows_total",
+                   "sink_staged_bytes"):
+        assert f"# HELP {family}" in text, family
+    assert 'sink_rows_total{mode="append",sink="s"}' in text
+
+
+def test_ctl_sinks_verb(tmp_path, capsys):
+    """`ctl sinks` recovers the data dir and prints the listing-driven
+    sink view."""
+    from risingwave_tpu.__main__ import main as cli_main
+    from risingwave_tpu.frontend.session import Frontend
+    from risingwave_tpu.storage.hummock import HummockLite
+    from risingwave_tpu.storage.object_store import LocalFsObjectStore
+
+    d = str(tmp_path / "rw")
+    out = str(tmp_path / "sink")
+
+    async def seed():
+        fe = Frontend(HummockLite(LocalFsObjectStore(d)), min_chunks=2)
+        await fe.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', "
+            "nexmark.table.type='bid', nexmark.event.num=800, "
+            "nexmark.max.chunk.size=128)")
+        await fe.execute(
+            "CREATE MATERIALIZED VIEW mb AS SELECT auction, price "
+            "FROM bid")
+        await fe.execute(
+            f"CREATE SINK s FROM mb AS APPEND-ONLY WITH "
+            f"(connector='epochlog', path='{out}')")
+        await fe.step(4)
+        await fe.close()
+
+    asyncio.run(seed())
+    with pytest.raises(SystemExit) as e:
+        cli_main(["ctl", "--data-dir", d, "sinks"])
+    assert e.value.code == 0
+    text = capsys.readouterr().out
+    assert "== sinks ==" in text
+    assert "s [epochlog/append]" in text
+    assert "committed_epoch 0x" in text

@@ -1,0 +1,118 @@
+"""Sink-domain chaos (ISSUE 20 acceptance): the seeded schedule —
+writer SIGKILL mid-stage, storage fault during the manifest commit,
+and a guarded rescale of the sink fragment — replays against a
+2-worker cluster driving an N=2-writer upsert sink (hash-agg fragment,
+vnode-rescalable) and a colocated append-only sink; both committed
+logs must be BIT-identical to a fault-free in-process single-writer
+oracle (zero duplicated, zero lost rows) and the staging areas must
+hold zero uncommitted epochs when the dust settles.
+"""
+
+import asyncio
+
+from risingwave_tpu.cluster.chaos import run_chaos
+from risingwave_tpu.cluster.session import DistFrontend
+from risingwave_tpu.connectors.sink import make_sink_target
+from risingwave_tpu.frontend.session import Frontend
+from risingwave_tpu.meta.supervisor import clear_recovery_log
+from test_chaos import retry_or_skip_on_slow_host  # noqa: F401
+
+EVENTS = 4000
+SRC = ("CREATE SOURCE bid WITH (connector='nexmark', "
+       "nexmark.table.type='bid', nexmark.event.num={n}, "
+       "nexmark.max.chunk.size=256, "
+       "nexmark.min.event.gap.in.ns=50000000)")
+MV_APPEND = ("CREATE MATERIALIZED VIEW mb AS "
+             "SELECT auction, price FROM bid WHERE price > 100")
+MV_AGG = ("CREATE MATERIALIZED VIEW qa AS "
+          "SELECT auction, COUNT(*) AS cnt, MAX(price) AS mx "
+          "FROM bid GROUP BY auction")
+SINK_KINDS = ["kill_writer_mid_stage", "fault_manifest_commit",
+              "rescale_sink_fragment"]
+
+
+async def _ddl(fe, base: str) -> None:
+    await fe.execute(SRC.format(n=EVENTS))
+    await fe.execute(MV_APPEND)
+    await fe.execute(MV_AGG)
+    # s7: GROUP BY plan → retractions → upsert mode; its hash-agg +
+    # sink fragment runs at the session parallelism and is the
+    # guarded-rescale target.  sa: provably append-only.
+    await fe.execute(
+        f"CREATE SINK s7 FROM qa "
+        f"WITH (connector='epochlog', path='{base}/s7')")
+    await fe.execute(
+        f"CREATE SINK sa FROM mb AS APPEND-ONLY "
+        f"WITH (connector='epochlog', path='{base}/sa')")
+
+
+def _canon(base: str, name: str, mode: str):
+    t = make_sink_target({"path": f"{base}/{name}"}, mode, [])
+    return (t.canonical_rows(), t.canonical_bytes(),
+            t.uncommitted_epochs())
+
+
+def _oracle(base: str):
+    """Fault-free in-process N=1 arm: the ground truth the chaos arm
+    must reproduce byte for byte."""
+    async def run():
+        fe = Frontend(min_chunks=8)
+        await _ddl(fe, base)
+        await fe.step(30)
+        await fe.close()
+
+    asyncio.run(run())
+
+
+@retry_or_skip_on_slow_host
+def test_sink_chaos_converges_to_single_writer_oracle(tmp_path):
+    """The acceptance case: SIGKILL a writer INSIDE stage() (torn
+    segment truncated on recovery), fault the manifest PUT (commit
+    re-derived from the object-store listing), rescale the sink
+    fragment via the guarded protocol (writer ranks re-stamped) — and
+    the committed logs still equal the no-fault single-writer oracle
+    exactly."""
+    clear_recovery_log()
+    chaos_base = str(tmp_path / "chaos")
+
+    async def run():
+        fe = DistFrontend(str(tmp_path / "store"), n_workers=2,
+                          parallelism=2)
+        await fe.start()
+        try:
+            await _ddl(fe, chaos_base)
+            report = await run_chaos(fe, seed=11, kinds=SINK_KINDS,
+                                     rescale_mv="s7")
+            view = await fe.execute("SELECT * FROM rw_sinks")
+            return report, view
+        finally:
+            await fe.close()
+
+    report, view = asyncio.run(run())
+
+    # every scheduled sink fault actually fired, and the SIGKILL
+    # mid-stage surfaced as a supervised dead_worker recovery
+    assert {k for _s, k, _w in report.events} == set(SINK_KINDS)
+    causes = {c for c, _a in report.recoveries}
+    assert "dead_worker" in causes, report.recoveries
+
+    oracle_base = str(tmp_path / "oracle")
+    _oracle(oracle_base)
+    for name, mode in (("s7", "upsert"), ("sa", "append")):
+        rows, blob, uncommitted = _canon(chaos_base, name, mode)
+        o_rows, o_blob, o_unc = _canon(oracle_base, name, mode)
+        assert uncommitted == {}, (name, uncommitted)
+        assert o_unc == {}, (name, o_unc)
+        assert rows, f"chaos arm committed nothing for {name}"
+        # zero dup / zero loss, byte for byte: append canonical_rows
+        # keeps multiplicity (a duplicated replay fails equality);
+        # upsert folds to key→state and a lost retraction diverges
+        assert rows == o_rows, (name, len(rows), len(o_rows))
+        assert blob == o_blob, name
+
+    # the serving view agrees: fully drained, nothing staged
+    by_name = {r[0]: r for r in view}
+    for name, mode in (("s7", "upsert"), ("sa", "append")):
+        _n, conn, m, epoch, staged, _nbytes, lag = by_name[name]
+        assert (conn, m) == ("epochlog", mode)
+        assert epoch > 0 and staged == 0 and lag == 0, view
